@@ -1,0 +1,35 @@
+"""shifu_tpu — a TPU-native (JAX/XLA/Pallas) distributed training framework.
+
+Built from scratch, TPU-first:
+  * compute path: jax.numpy / lax on the MXU, pallas kernels for hot ops
+  * parallelism: jax.sharding.Mesh + NamedSharding + shard_map over
+    (dp, fsdp, pp, sp, tp) mesh axes, with expert parallelism (ep) as a
+    logical axis; collectives are XLA-inserted (psum / all_gather /
+    reduce_scatter / ppermute) and ride ICI
+  * training: functional train step under jit with buffer donation,
+    bf16 compute over f32 master params, rematerialised blocks,
+    microbatch gradient accumulation via lax.scan
+
+NOTE ON THE REFERENCE: the upstream reference (`klyan/shifu`, mounted at
+/root/reference) was an *empty repository* at crawl time — zero files; see
+SURVEY.md for the evidence. There is therefore no reference API or behaviour
+to replicate and no file:line parity citations are possible anywhere in this
+codebase. The framework is built to the build-task's explicit specification
+instead (decoder-only transformer family, long-context sequence parallelism,
+multi-chip dp/fsdp/tp/sp/pp/ep sharding, pallas kernels, checkpointing,
+benchmarking).
+"""
+
+__version__ = "0.1.0"
+
+from shifu_tpu.core.module import Module, ParamSpec, init_params, param_axes
+from shifu_tpu.core.dtypes import Policy
+
+__all__ = [
+    "Module",
+    "ParamSpec",
+    "init_params",
+    "param_axes",
+    "Policy",
+    "__version__",
+]

@@ -1,0 +1,4 @@
+from shifu_tpu.core.module import Module, ParamSpec, init_params, param_axes
+from shifu_tpu.core.dtypes import Policy
+
+__all__ = ["Module", "ParamSpec", "init_params", "param_axes", "Policy"]

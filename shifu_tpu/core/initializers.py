@@ -1,0 +1,59 @@
+"""Parameter initializers (init fns for ParamSpec).
+
+All have signature ``(key, shape, dtype) -> Array``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def zeros(key, shape, dtype):
+    del key
+    return jnp.zeros(shape, dtype)
+
+
+def ones(key, shape, dtype):
+    del key
+    return jnp.ones(shape, dtype)
+
+
+def normal(stddev: float = 1.0):
+    def init(key, shape, dtype):
+        return (stddev * jax.random.normal(key, shape)).astype(dtype)
+
+    return init
+
+
+def truncated_normal(stddev: float = 1.0):
+    """Truncated at ±2σ, variance-corrected like jax.nn.initializers."""
+
+    def init(key, shape, dtype):
+        # Correction so the post-truncation stddev equals `stddev`.
+        s = stddev / 0.87962566103423978
+        return (s * jax.random.truncated_normal(key, -2.0, 2.0, shape)).astype(
+            dtype
+        )
+
+    return init
+
+
+def fan_in_normal(axis: int = -2, scale: float = 1.0):
+    """Truncated normal with stddev = sqrt(scale / fan_in).
+
+    ``axis`` selects which dimension counts as fan-in (default: second to
+    last, matching ``x @ W`` with W of shape (in, out)).
+    """
+
+    def init(key, shape, dtype):
+        if len(shape) >= 2:
+            fan_in = shape[axis]
+        else:
+            fan_in = shape[0] if shape else 1
+        stddev = float(np.sqrt(scale / max(1, fan_in)))
+        return truncated_normal(stddev)(key, shape, dtype)
+
+    return init

@@ -1,0 +1,128 @@
+"""Functional module system.
+
+Design: a module is a frozen dataclass of *hyperparameters only*. Parameters
+live outside the module in a plain nested-dict pytree, so the whole model is
+a pure function ``module(params, *inputs)`` — exactly what jit/pjit/shard_map
+want. Each module declares its parameters once via :meth:`Module.specs`,
+returning a tree of :class:`ParamSpec` leaves that carry shape, dtype, an
+initializer, and *logical axis names* for every dimension. From that single
+source of truth we derive:
+
+  * ``init_params(module, rng)``   — materialised parameter pytree
+  * ``param_axes(module)``         — same-structure tree of logical-axis tuples,
+                                     consumed by shifu_tpu.parallel.sharding to
+                                     build NamedSharding trees for pjit.
+
+Why not flax/haiku: the framework's parallel layer wants to treat parameter
+sharding as data (a pytree of axis names) that flows through pjit and
+shard_map unchanged. A transparent dict-of-arrays representation with a
+parallel axes tree is the simplest structure that XLA's partitioner can
+consume directly, with no module-state threading or variable collections.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import zlib
+from typing import Any, Callable, Mapping
+
+import jax
+import jax.numpy as jnp
+
+# A params tree is a nested dict with jnp.ndarray leaves.
+Params = Any
+# An axes tree mirrors a params tree with tuple-of-str leaves.
+AxesTree = Any
+
+InitFn = Callable[[jax.Array, tuple, Any], jax.Array]
+
+
+@dataclasses.dataclass(frozen=True)
+class ParamSpec:
+    """Declaration of a single parameter tensor.
+
+    ``axes`` names every dimension with a *logical* axis ("embed", "mlp",
+    "heads", "kv_heads", "head_dim", "vocab", "layers", "experts", ...).
+    The parallel layer maps logical names onto mesh axes via rules; a name
+    mapped to None is replicated.
+    """
+
+    shape: tuple
+    axes: tuple
+    init: InitFn
+    dtype: Any = jnp.float32
+
+    def __post_init__(self):
+        if len(self.shape) != len(self.axes):
+            raise ValueError(
+                f"ParamSpec rank mismatch: shape {self.shape} has "
+                f"{len(self.shape)} dims but axes {self.axes} has "
+                f"{len(self.axes)} names"
+            )
+
+
+class Module:
+    """Base class for functional modules.
+
+    Subclasses are expected to be ``@dataclasses.dataclass(frozen=True)`` and
+    implement:
+
+      * ``specs(self) -> nested dict of ParamSpec``
+      * ``__call__(self, params, *args, **kwargs)``
+
+    Submodules compose by namespacing: a parent's ``specs`` embeds the
+    child's ``specs()`` under a key, and its ``__call__`` passes
+    ``params["child_key"]`` down. Nothing is registered or tracked — the
+    composition is ordinary dict nesting.
+    """
+
+    def specs(self) -> Mapping[str, Any]:
+        raise NotImplementedError
+
+    # -- convenience wrappers -------------------------------------------------
+    def init(self, rng: jax.Array) -> Params:
+        return init_params(self, rng)
+
+    def axes(self) -> AxesTree:
+        return param_axes(self)
+
+
+def _is_spec(x) -> bool:
+    return isinstance(x, ParamSpec)
+
+
+def init_params(module: Module, rng: jax.Array) -> Params:
+    """Materialise a parameter pytree from a module's specs.
+
+    Each leaf gets an independent key derived by chaining fold_in over its
+    tree-path components (crc32 of each component), so initialisation is
+    order-independent, stable under tree restructuring that preserves paths,
+    and collision-free for distinct paths by construction.
+    """
+    specs = module.specs()
+    leaves_with_paths = jax.tree_util.tree_flatten_with_path(
+        specs, is_leaf=_is_spec
+    )[0]
+
+    def make(path, spec: ParamSpec):
+        key = rng
+        for p in path:
+            component = str(getattr(p, "key", p))
+            key = jax.random.fold_in(key, zlib.crc32(component.encode()))
+        return spec.init(key, spec.shape, spec.dtype)
+
+    treedef = jax.tree_util.tree_structure(specs, is_leaf=_is_spec)
+    return jax.tree_util.tree_unflatten(
+        treedef, [make(path, spec) for path, spec in leaves_with_paths]
+    )
+
+
+def param_axes(module: Module) -> AxesTree:
+    """Extract the logical-axes tree (same structure as the params tree)."""
+    return jax.tree_util.tree_map(
+        lambda s: s.axes, module.specs(), is_leaf=_is_spec
+    )
+
+
+def param_count(params: Params) -> int:
+    return sum(x.size for x in jax.tree_util.tree_leaves(params))

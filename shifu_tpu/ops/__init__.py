@@ -1,0 +1,12 @@
+from shifu_tpu.ops.norms import rms_norm
+from shifu_tpu.ops.rope import apply_rope, rope_frequencies
+from shifu_tpu.ops.attention import dot_product_attention
+from shifu_tpu.ops.losses import softmax_cross_entropy
+
+__all__ = [
+    "rms_norm",
+    "apply_rope",
+    "rope_frequencies",
+    "dot_product_attention",
+    "softmax_cross_entropy",
+]

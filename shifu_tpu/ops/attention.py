@@ -1,0 +1,91 @@
+"""Attention ops.
+
+``dot_product_attention`` is the XLA reference path: grouped-query causal
+attention expressed as two einsums with an f32 softmax between them. XLA
+tiles the einsums onto the MXU; for long sequences the pallas flash kernel
+(shifu_tpu.ops.pallas.flash_attention) avoids materialising the (S, S)
+scores matrix in HBM — select it with ``impl="flash"`` on TPU.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -2.0e38  # large finite negative; avoids NaN from (-inf) - (-inf)
+
+
+def _causal_mask(q_len: int, kv_len: int, dtype=jnp.float32):
+    """(q_len, kv_len) additive mask; query i attends kv j <= i + offset.
+
+    When q_len < kv_len (decode with a KV cache), queries are aligned to the
+    *end* of the KV axis.
+    """
+    offset = kv_len - q_len
+    qi = jnp.arange(q_len)[:, None]
+    kj = jnp.arange(kv_len)[None, :]
+    return jnp.where(kj <= qi + offset, 0.0, NEG_INF).astype(dtype)
+
+
+def dot_product_attention(
+    q,
+    k,
+    v,
+    *,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    segment_ids: Optional[jax.Array] = None,
+    impl: str = "xla",
+):
+    """Grouped-query attention.
+
+    Args:
+      q: (batch, q_len, num_heads, head_dim)
+      k/v: (batch, kv_len, num_kv_heads, head_dim); num_heads must be a
+        multiple of num_kv_heads (heads are grouped onto kv heads).
+      causal: apply a causal mask (queries aligned to the end of kv axis).
+      scale: score scale; defaults to head_dim ** -0.5.
+      segment_ids: optional (batch, kv_len) int array for packed sequences;
+        tokens only attend within their segment. Requires q_len == kv_len.
+      impl: "xla" (this file) or "flash" (pallas TPU kernel).
+
+    Returns:
+      (batch, q_len, num_heads, head_dim) in q.dtype.
+    """
+    if impl == "flash":
+        from shifu_tpu.ops.pallas.flash_attention import flash_attention
+
+        return flash_attention(
+            q, k, v, causal=causal, scale=scale, segment_ids=segment_ids
+        )
+    if impl != "xla":
+        raise ValueError(f"unknown attention impl: {impl!r}")
+
+    b, q_len, n_heads, head_dim = q.shape
+    _, kv_len, n_kv, _ = k.shape
+    if n_heads % n_kv:
+        raise ValueError(f"num_heads={n_heads} not divisible by kv={n_kv}")
+    group = n_heads // n_kv
+    if scale is None:
+        scale = head_dim**-0.5
+
+    qg = q.reshape(b, q_len, n_kv, group, head_dim)
+    # Scores in f32: bf16 logits lose too much around the softmax max-shift.
+    scores = jnp.einsum(
+        "bqhgd,bkhd->bhgqk", qg, k, preferred_element_type=jnp.float32
+    )
+    scores = scores * scale
+
+    if causal:
+        scores = scores + _causal_mask(q_len, kv_len)
+    if segment_ids is not None:
+        if q_len != kv_len:
+            raise ValueError("segment_ids requires q_len == kv_len")
+        same = segment_ids[:, :, None] == segment_ids[:, None, :]
+        scores = scores + jnp.where(same, 0.0, NEG_INF)[:, None, None, :, :]
+
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v)
+    return out.reshape(b, q_len, n_heads, head_dim)

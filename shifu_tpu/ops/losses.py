@@ -1,0 +1,56 @@
+"""Loss ops.
+
+Cross-entropy takes logits in any float dtype, reduces in f32, and supports
+a z-loss term (pulls log-Z toward 0, stabilising bf16 logits over long runs)
+and a validity mask for padded / packed batches.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def softmax_cross_entropy(
+    logits,
+    labels,
+    *,
+    mask: Optional[jax.Array] = None,
+    z_loss: float = 0.0,
+):
+    """Mean token cross-entropy.
+
+    Args:
+      logits: (..., vocab), any float dtype.
+      labels: (...) int token ids.
+      mask: optional (...) weights; 0 drops a position. Mean is over the
+        mask sum, not the full shape.
+      z_loss: coefficient for log(Z)^2 regulariser (0 disables).
+
+    Returns:
+      (loss, aux) where aux = {"ce": ..., "z": ..., "denominator": ...}.
+    """
+    logits = logits.astype(jnp.float32)
+    log_z = jax.nn.logsumexp(logits, axis=-1)
+    label_logits = jnp.take_along_axis(
+        logits, labels[..., None], axis=-1
+    ).squeeze(-1)
+    ce = log_z - label_logits
+    z = jnp.square(log_z)
+
+    if mask is None:
+        denom = jnp.asarray(ce.size, jnp.float32)
+        ce_sum = jnp.sum(ce)
+        z_sum = jnp.sum(z)
+    else:
+        w = mask.astype(jnp.float32)
+        denom = jnp.maximum(jnp.sum(w), 1.0)
+        ce_sum = jnp.sum(ce * w)
+        z_sum = jnp.sum(z * w)
+
+    ce_mean = ce_sum / denom
+    z_mean = z_sum / denom
+    loss = ce_mean + z_loss * z_mean
+    return loss, {"ce": ce_mean, "z": z_mean, "denominator": denom}

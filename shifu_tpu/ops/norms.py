@@ -1,0 +1,25 @@
+"""Normalisation ops.
+
+RMSNorm is computed in float32 regardless of input dtype (bf16 mean-of-squares
+underflows badly at large widths) and cast back, which XLA fuses into a single
+VPU kernel around the adjacent matmul.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x, scale, *, eps: float = 1e-6):
+    """y = x / rms(x) * (1 + scale). ``scale`` is zero-initialised.
+
+    The (1 + scale) parameterisation keeps the parameter's init at zero,
+    which plays better with weight decay masks than ones-init.
+    """
+    orig_dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    y = xf * jax.lax.rsqrt(var + eps)
+    y = y * (1.0 + scale.astype(jnp.float32))
+    return y.astype(orig_dtype)

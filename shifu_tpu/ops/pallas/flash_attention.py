@@ -1,0 +1,14 @@
+"""Pallas TPU flash attention (blocked, causal, GQA).
+
+Placeholder until the kernel lands: raises with a clear message instead of
+silently falling back, so callers never believe they got the fused path.
+"""
+
+from __future__ import annotations
+
+
+def flash_attention(q, k, v, *, causal=True, scale=None, segment_ids=None):
+    raise NotImplementedError(
+        "pallas flash attention kernel not implemented yet; "
+        "use dot_product_attention(..., impl='xla')"
+    )

@@ -1,0 +1,35 @@
+"""Rotary position embeddings.
+
+Split-half convention (first half of head_dim pairs with second half), f32
+rotation math. Frequencies are computed once per forward at trace time —
+they are constants under jit, so XLA hoists them.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_frequencies(head_dim: int, positions, *, theta: float = 10000.0):
+    """Return (sin, cos) of shape positions.shape + (head_dim // 2,)."""
+    if head_dim % 2:
+        raise ValueError(f"head_dim must be even, got {head_dim}")
+    exponent = jnp.arange(head_dim // 2, dtype=jnp.float32) / (head_dim // 2)
+    inv_freq = theta**-exponent  # (head_dim/2,)
+    angles = positions.astype(jnp.float32)[..., None] * inv_freq
+    return jnp.sin(angles), jnp.cos(angles)
+
+
+def apply_rope(x, sin, cos):
+    """Rotate ``x`` of shape (..., seq, heads, head_dim).
+
+    ``sin``/``cos`` have shape (..., seq, head_dim // 2); a heads axis is
+    inserted for broadcast.
+    """
+    sin = sin[..., :, None, :]
+    cos = cos[..., :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    rotated = jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+    )
+    return rotated.astype(x.dtype)

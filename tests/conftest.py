@@ -1,0 +1,30 @@
+"""Test harness: force an 8-device virtual CPU platform BEFORE jax imports.
+
+Multi-chip TPU hardware is not available in this environment, so sharding /
+collective tests run on a virtual CPU mesh. Keep shapes tiny: the host has
+one physical core.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# Numerics tests compare against numpy: force true-f32 matmuls. Production
+# code keeps the default (bf16-on-MXU) precision.
+jax.config.update("jax_default_matmul_precision", "highest")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs

@@ -1,0 +1,82 @@
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from shifu_tpu.core import initializers
+from shifu_tpu.core.module import Module, ParamSpec, init_params, param_axes, param_count
+
+
+@dataclasses.dataclass(frozen=True)
+class Linear(Module):
+    in_dim: int
+    out_dim: int
+
+    def specs(self):
+        return {
+            "w": ParamSpec(
+                (self.in_dim, self.out_dim),
+                ("embed", "mlp"),
+                initializers.fan_in_normal(),
+            ),
+            "b": ParamSpec((self.out_dim,), ("mlp",), initializers.zeros),
+        }
+
+    def __call__(self, params, x):
+        return x @ params["w"] + params["b"]
+
+
+@dataclasses.dataclass(frozen=True)
+class TwoLayer(Module):
+    dim: int
+
+    def specs(self):
+        inner = Linear(self.dim, self.dim)
+        return {"l1": inner.specs(), "l2": inner.specs()}
+
+    def __call__(self, params, x):
+        inner = Linear(self.dim, self.dim)
+        return inner(params["l2"], jax.nn.relu(inner(params["l1"], x)))
+
+
+def test_init_shapes_and_dtypes():
+    m = Linear(4, 8)
+    params = init_params(m, jax.random.key(0))
+    assert params["w"].shape == (4, 8)
+    assert params["b"].shape == (8,)
+    assert params["w"].dtype == jnp.float32
+    assert param_count(params) == 4 * 8 + 8
+
+
+def test_axes_tree_matches_params_structure():
+    m = TwoLayer(4)
+    params = init_params(m, jax.random.key(0))
+    axes = param_axes(m)
+    assert jax.tree_util.tree_structure(params) == jax.tree_util.tree_structure(
+        axes, is_leaf=lambda x: isinstance(x, tuple)
+    )
+    assert axes["l1"]["w"] == ("embed", "mlp")
+
+
+def test_init_is_deterministic_and_path_dependent():
+    m = TwoLayer(4)
+    p1 = init_params(m, jax.random.key(7))
+    p2 = init_params(m, jax.random.key(7))
+    assert jnp.array_equal(p1["l1"]["w"], p2["l1"]["w"])
+    # Different paths get different keys.
+    assert not jnp.array_equal(p1["l1"]["w"], p1["l2"]["w"])
+
+
+def test_forward_runs_under_jit():
+    m = TwoLayer(4)
+    params = init_params(m, jax.random.key(0))
+    x = jnp.ones((2, 4))
+    y = jax.jit(lambda p, x: m(p, x))(params, x)
+    assert y.shape == (2, 4)
+
+
+def test_rank_mismatch_raises():
+    import pytest
+
+    with pytest.raises(ValueError):
+        ParamSpec((3, 4), ("embed",), initializers.zeros)

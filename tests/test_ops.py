@@ -1,0 +1,179 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from shifu_tpu.ops import (
+    apply_rope,
+    dot_product_attention,
+    rms_norm,
+    rope_frequencies,
+    softmax_cross_entropy,
+)
+
+
+# ---------------- rms_norm ----------------
+
+def test_rms_norm_matches_numpy():
+    x = np.random.RandomState(0).randn(2, 5, 16).astype(np.float32)
+    scale = np.random.RandomState(1).randn(16).astype(np.float32) * 0.1
+    got = rms_norm(jnp.asarray(x), jnp.asarray(scale))
+    want = x / np.sqrt((x**2).mean(-1, keepdims=True) + 1e-6) * (1 + scale)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_rms_norm_bf16_computes_in_f32():
+    # Large-magnitude input would overflow a bf16 mean-of-squares.
+    x = jnp.full((1, 8), 300.0, jnp.bfloat16)
+    y = rms_norm(x, jnp.zeros((8,)))
+    assert y.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(y, np.float32), np.ones((1, 8)), rtol=1e-2
+    )
+
+
+# ---------------- rope ----------------
+
+def test_rope_preserves_norm_and_dtype():
+    q = np.random.RandomState(0).randn(2, 7, 3, 8).astype(np.float32)
+    sin, cos = rope_frequencies(8, jnp.arange(7))
+    out = apply_rope(jnp.asarray(q), sin, cos)
+    assert out.shape == q.shape
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(out), axis=-1),
+        np.linalg.norm(q, axis=-1),
+        rtol=1e-5,
+    )
+
+
+def test_rope_position_zero_is_identity():
+    q = np.random.RandomState(0).randn(1, 1, 2, 8).astype(np.float32)
+    sin, cos = rope_frequencies(8, jnp.zeros((1,), jnp.int32))
+    out = apply_rope(jnp.asarray(q), sin, cos)
+    np.testing.assert_allclose(out, q, rtol=1e-6)
+
+
+def test_rope_relative_property():
+    """<rope(q, m), rope(k, n)> depends only on m - n."""
+    rs = np.random.RandomState(3)
+    q = jnp.asarray(rs.randn(1, 1, 1, 16).astype(np.float32))
+    k = jnp.asarray(rs.randn(1, 1, 1, 16).astype(np.float32))
+
+    def dot_at(m, n):
+        sq = rope_frequencies(16, jnp.array([m]))
+        sk = rope_frequencies(16, jnp.array([n]))
+        qq = apply_rope(q, *sq)
+        kk = apply_rope(k, *sk)
+        return float(jnp.sum(qq * kk))
+
+    np.testing.assert_allclose(dot_at(5, 2), dot_at(13, 10), rtol=1e-5)
+
+
+# ---------------- attention ----------------
+
+def _ref_attention(q, k, v, causal=True):
+    b, s, h, d = q.shape
+    _, skv, hkv, _ = k.shape
+    group = h // hkv
+    k = np.repeat(k, group, axis=2)
+    v = np.repeat(v, group, axis=2)
+    scores = np.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(d)
+    if causal:
+        mask = np.tril(np.ones((s, skv)), k=skv - s)
+        scores = np.where(mask, scores, -1e30)
+    scores = scores - scores.max(-1, keepdims=True)
+    p = np.exp(scores)
+    p /= p.sum(-1, keepdims=True)
+    return np.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def test_attention_matches_reference_mha():
+    rs = np.random.RandomState(0)
+    q = rs.randn(2, 6, 4, 8).astype(np.float32)
+    k = rs.randn(2, 6, 4, 8).astype(np.float32)
+    v = rs.randn(2, 6, 4, 8).astype(np.float32)
+    got = dot_product_attention(*map(jnp.asarray, (q, k, v)))
+    np.testing.assert_allclose(got, _ref_attention(q, k, v), rtol=1e-4, atol=1e-5)
+
+
+def test_attention_gqa_grouping():
+    rs = np.random.RandomState(1)
+    q = rs.randn(1, 5, 8, 4).astype(np.float32)
+    k = rs.randn(1, 5, 2, 4).astype(np.float32)
+    v = rs.randn(1, 5, 2, 4).astype(np.float32)
+    got = dot_product_attention(*map(jnp.asarray, (q, k, v)))
+    np.testing.assert_allclose(got, _ref_attention(q, k, v), rtol=1e-4, atol=1e-5)
+
+
+def test_attention_causality():
+    """Changing a future token must not change past outputs."""
+    rs = np.random.RandomState(2)
+    q = jnp.asarray(rs.randn(1, 6, 2, 4).astype(np.float32))
+    k = jnp.asarray(rs.randn(1, 6, 2, 4).astype(np.float32))
+    v = jnp.asarray(rs.randn(1, 6, 2, 4).astype(np.float32))
+    base = dot_product_attention(q, k, v)
+    k2 = k.at[:, -1].set(99.0)
+    v2 = v.at[:, -1].set(99.0)
+    pert = dot_product_attention(q, k2, v2)
+    np.testing.assert_allclose(base[:, :-1], pert[:, :-1], rtol=1e-6)
+    assert not np.allclose(base[:, -1], pert[:, -1])
+
+
+def test_attention_decode_alignment():
+    """q_len < kv_len: single query attends to the whole prefix."""
+    rs = np.random.RandomState(4)
+    k = jnp.asarray(rs.randn(1, 6, 2, 4).astype(np.float32))
+    v = jnp.asarray(rs.randn(1, 6, 2, 4).astype(np.float32))
+    q_full = jnp.asarray(rs.randn(1, 6, 2, 4).astype(np.float32))
+    full = dot_product_attention(q_full, k, v)
+    last = dot_product_attention(q_full[:, -1:], k, v)
+    np.testing.assert_allclose(last[:, 0], full[:, -1], rtol=1e-5, atol=1e-6)
+
+
+def test_attention_segment_ids_block_cross_attention():
+    rs = np.random.RandomState(5)
+    q = jnp.asarray(rs.randn(1, 4, 2, 4).astype(np.float32))
+    k = jnp.asarray(rs.randn(1, 4, 2, 4).astype(np.float32))
+    v = jnp.asarray(rs.randn(1, 4, 2, 4).astype(np.float32))
+    seg = jnp.asarray([[0, 0, 1, 1]])
+    out = dot_product_attention(q, k, v, segment_ids=seg)
+    # Position 2 is the first token of segment 1: attends only to itself.
+    solo = dot_product_attention(q[:, 2:3], k[:, 2:3], v[:, 2:3])
+    np.testing.assert_allclose(out[:, 2], solo[:, 0], rtol=1e-5, atol=1e-6)
+
+
+# ---------------- losses ----------------
+
+def test_cross_entropy_uniform_logits():
+    logits = jnp.zeros((2, 3, 7))
+    labels = jnp.zeros((2, 3), jnp.int32)
+    loss, aux = softmax_cross_entropy(logits, labels)
+    np.testing.assert_allclose(loss, np.log(7), rtol=1e-5)
+
+
+def test_cross_entropy_mask():
+    logits = jnp.zeros((1, 4, 5))
+    # Make position 0 a perfect prediction, mask out the rest.
+    logits = logits.at[0, 0, 2].set(100.0)
+    labels = jnp.asarray([[2, 0, 0, 0]])
+    mask = jnp.asarray([[1.0, 0.0, 0.0, 0.0]])
+    loss, aux = softmax_cross_entropy(logits, labels, mask=mask)
+    np.testing.assert_allclose(loss, 0.0, atol=1e-5)
+    assert float(aux["denominator"]) == 1.0
+
+
+def test_cross_entropy_z_loss_positive():
+    logits = jnp.asarray(np.random.RandomState(0).randn(2, 3, 11).astype(np.float32))
+    labels = jnp.zeros((2, 3), jnp.int32)
+    l0, _ = softmax_cross_entropy(logits, labels, z_loss=0.0)
+    l1, aux = softmax_cross_entropy(logits, labels, z_loss=0.1)
+    assert float(l1) > float(l0)
+    assert float(aux["z"]) > 0
+
+
+def test_cross_entropy_grad_is_finite_bf16():
+    logits = jnp.asarray(
+        np.random.RandomState(0).randn(2, 3, 11).astype(np.float32), jnp.bfloat16
+    )
+    labels = jnp.zeros((2, 3), jnp.int32)
+    g = jax.grad(lambda l: softmax_cross_entropy(l, labels)[0])(logits)
+    assert np.isfinite(np.asarray(g, np.float32)).all()
