@@ -1,0 +1,8 @@
+#!/bin/bash
+# Run the test suite on the virtual CPU mesh WITHOUT touching the TPU tunnel.
+# (sitecustomize registers the axon TPU client in every python process when
+# PALLAS_AXON_POOL_IPS is set; clearing it keeps CPU-only test runs off the
+# single-chip tunnel — faster, and immune to tunnel outages.)
+cd "$(dirname "$0")"
+if [ $# -eq 0 ]; then set -- tests/ -x -q; fi
+exec env PALLAS_AXON_POOL_IPS= python -m pytest "$@"

@@ -9,9 +9,11 @@ initializer, and *logical axis names* for every dimension. From that single
 source of truth we derive:
 
   * ``init_params(module, rng)``   — materialised parameter pytree
-  * ``param_axes(module)``         — same-structure tree of logical-axis tuples,
-                                     consumed by shifu_tpu.parallel.sharding to
-                                     build NamedSharding trees for pjit.
+  * ``param_axes(module)``         — same-structure tree of logical-axis
+                                     tuples, used by the train stack (weight-
+                                     decay masking) and available to user
+                                     tooling; the sharding layer reads specs()
+                                     directly since it also needs shapes.
 
 Why not flax/haiku: the framework's parallel layer wants to treat parameter
 sharding as data (a pytree of axis names) that flows through pjit and

@@ -1,0 +1,3 @@
+from shifu_tpu.models.transformer import Transformer, TransformerConfig
+
+__all__ = ["Transformer", "TransformerConfig"]

@@ -1,0 +1,351 @@
+"""Flagship decoder-only transformer (GQA + RoPE + SwiGLU + RMSNorm).
+
+TPU-first structural choices:
+
+  * **Scan over layers.** All blocks' parameters are stored *stacked* with a
+    leading ("layers",) logical axis, and the forward runs ``lax.scan`` over
+    that axis. One block is traced/compiled once regardless of depth, which
+    keeps compile times flat, and the stacked axis is exactly what pipeline
+    parallelism shards (shifu_tpu.parallel.pipeline).
+  * **Logical axes everywhere.** Every parameter dimension carries a logical
+    name ("embed", "mlp", "heads", "kv_heads", "head_dim", "vocab",
+    "layers"); shifu_tpu.parallel.sharding maps names onto mesh axes
+    (tp/fsdp/pp/...) so the model code never mentions devices.
+  * **bf16 compute over f32 masters** via core.dtypes.Policy; softmax, norms
+    and the final loss reduce in f32.
+  * **Static shapes only** — the decode path uses a preallocated KV cache and
+    ``dynamic_update_slice``, never growing arrays.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from shifu_tpu.core import initializers
+from shifu_tpu.core.dtypes import Policy
+from shifu_tpu.core.module import Module, ParamSpec
+from shifu_tpu.parallel.ctx import constrain
+from shifu_tpu.ops import (
+    apply_rope,
+    dot_product_attention,
+    rms_norm,
+    rope_frequencies,
+    softmax_cross_entropy,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32_000
+    dim: int = 2048
+    n_layers: int = 16
+    n_heads: int = 16
+    n_kv_heads: int = 4
+    mlp_dim: int = 8192
+    head_dim: Optional[int] = None  # default: dim // n_heads
+    rope_theta: float = 500_000.0
+    norm_eps: float = 1e-6
+    tie_embeddings: bool = False
+    z_loss: float = 1e-4
+    remat: bool = True  # rematerialise each block in the backward pass
+    attn_impl: str = "xla"  # "xla" | "flash" (pallas TPU kernel)
+
+    @property
+    def resolved_head_dim(self) -> int:
+        return self.head_dim if self.head_dim is not None else self.dim // self.n_heads
+
+    def __post_init__(self):
+        if self.n_heads % self.n_kv_heads:
+            raise ValueError(
+                f"n_heads={self.n_heads} must be divisible by "
+                f"n_kv_heads={self.n_kv_heads}"
+            )
+
+    # -- presets --------------------------------------------------------------
+    @classmethod
+    def tiny(cls, **kw):
+        """For tests: fits an 8-device virtual CPU mesh comfortably."""
+        d = dict(
+            vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+            mlp_dim=128, rope_theta=10_000.0, remat=False,
+        )
+        d.update(kw)
+        return cls(**d)
+
+    @classmethod
+    def small(cls, **kw):  # ~160M params
+        d = dict(
+            vocab_size=32_000, dim=768, n_layers=12, n_heads=12,
+            n_kv_heads=4, mlp_dim=3072,
+        )
+        d.update(kw)
+        return cls(**d)
+
+    @classmethod
+    def base_1b(cls, **kw):  # ~1.2B params
+        d = dict(
+            vocab_size=32_000, dim=2048, n_layers=16, n_heads=16,
+            n_kv_heads=4, mlp_dim=8192,
+        )
+        d.update(kw)
+        return cls(**d)
+
+    @classmethod
+    def large_7b(cls, **kw):  # llama-2-7b-shaped
+        d = dict(
+            vocab_size=32_000, dim=4096, n_layers=32, n_heads=32,
+            n_kv_heads=8, mlp_dim=11008,
+        )
+        d.update(kw)
+        return cls(**d)
+
+
+def _block_specs(cfg: TransformerConfig):
+    """Specs for ALL layers at once: leading ("layers",) stacked axis."""
+    L = cfg.n_layers
+    d, h, kv, hd, m = (
+        cfg.dim, cfg.n_heads, cfg.n_kv_heads, cfg.resolved_head_dim, cfg.mlp_dim,
+    )
+    # fan-in axis indices are relative to the *stacked* shapes below.
+    proj = initializers.fan_in_normal(axis=1)
+    return {
+        "attn_norm": ParamSpec((L, d), ("layers", "embed"), initializers.zeros),
+        "wq": ParamSpec(
+            (L, d, h, hd), ("layers", "embed", "heads", "head_dim"), proj
+        ),
+        "wk": ParamSpec(
+            (L, d, kv, hd), ("layers", "embed", "kv_heads", "head_dim"), proj
+        ),
+        "wv": ParamSpec(
+            (L, d, kv, hd), ("layers", "embed", "kv_heads", "head_dim"), proj
+        ),
+        # wo fans in from (heads, head_dim): use stddev ~ 1/sqrt(h * hd).
+        "wo": ParamSpec(
+            (L, h, hd, d),
+            ("layers", "heads", "head_dim", "embed"),
+            initializers.truncated_normal(1.0 / (h * hd) ** 0.5),
+        ),
+        "mlp_norm": ParamSpec((L, d), ("layers", "embed"), initializers.zeros),
+        "w_gate": ParamSpec((L, d, m), ("layers", "embed", "mlp"), proj),
+        "w_up": ParamSpec((L, d, m), ("layers", "embed", "mlp"), proj),
+        "w_down": ParamSpec(
+            (L, m, d),
+            ("layers", "mlp", "embed"),
+            initializers.fan_in_normal(axis=1),
+        ),
+    }
+
+
+@dataclasses.dataclass(frozen=True)
+class Transformer(Module):
+    cfg: TransformerConfig
+    policy: Policy = Policy()
+
+    # ------------------------------------------------------------------ specs
+    def specs(self):
+        cfg = self.cfg
+        s = {
+            "embed": ParamSpec(
+                (cfg.vocab_size, cfg.dim),
+                ("vocab", "embed"),
+                initializers.normal(1.0),
+            ),
+            "blocks": _block_specs(cfg),
+            "final_norm": ParamSpec((cfg.dim,), ("embed",), initializers.zeros),
+        }
+        if not cfg.tie_embeddings:
+            s["unembed"] = ParamSpec(
+                (cfg.dim, cfg.vocab_size),
+                ("embed", "vocab"),
+                initializers.fan_in_normal(axis=0),
+            )
+        return s
+
+    # ------------------------------------------------------------- one block
+    def _block(self, p, h, sin, cos, segment_ids, cache_slice, cache_index):
+        """One transformer block. ``p`` holds per-layer (unstacked) params.
+
+        Returns (h, new_cache_slice); cache_slice is None outside decode.
+        """
+        cfg = self.cfg
+        x = rms_norm(h, p["attn_norm"], eps=cfg.norm_eps)
+        q = jnp.einsum("bsd,dhk->bshk", x, p["wq"])
+        k = jnp.einsum("bsd,dhk->bshk", x, p["wk"])
+        v = jnp.einsum("bsd,dhk->bshk", x, p["wv"])
+        q = apply_rope(q, sin, cos)
+        k = apply_rope(k, sin, cos)
+
+        if cache_slice is None:
+            attn = dot_product_attention(
+                q, k, v, causal=True, segment_ids=segment_ids,
+                impl=cfg.attn_impl,
+            )
+            new_cache = None
+        else:
+            ck = jax.lax.dynamic_update_slice(
+                cache_slice["k"], k.astype(cache_slice["k"].dtype),
+                (0, cache_index, 0, 0),
+            )
+            cv = jax.lax.dynamic_update_slice(
+                cache_slice["v"], v.astype(cache_slice["v"].dtype),
+                (0, cache_index, 0, 0),
+            )
+            # Mask out cache positions beyond the current index by masking
+            # scores via an explicit validity trick: positions > index hold
+            # zeros-from-init; causal mask with end-alignment cannot be used
+            # because the cache is longer than (index + q_len). Instead we
+            # attend over the first (index + q_len) entries using a causal
+            # mask built for the full cache length with query offset.
+            attn = _decode_attention(q, ck, cv, cache_index, cfg.attn_impl)
+            new_cache = {"k": ck, "v": cv}
+
+        h = h + jnp.einsum("bshk,hkd->bsd", attn, p["wo"])
+
+        x = rms_norm(h, p["mlp_norm"], eps=cfg.norm_eps)
+        gate = jnp.einsum("bsd,dm->bsm", x, p["w_gate"])
+        up = jnp.einsum("bsd,dm->bsm", x, p["w_up"])
+        down = jnp.einsum("bsm,md->bsd", jax.nn.silu(gate) * up, p["w_down"])
+        h = h + down
+        h = constrain(h, ("batch", "seq", "act_embed"))
+        return h, new_cache
+
+    # ---------------------------------------------------------------- forward
+    def __call__(
+        self,
+        params,
+        tokens,
+        *,
+        positions=None,
+        segment_ids=None,
+        cache=None,
+        cache_index=None,
+    ):
+        """Compute logits.
+
+        Args:
+          params: parameter pytree from ``self.init``.
+          tokens: (batch, seq) int32.
+          positions: optional (batch, seq) or (seq,) positions for RoPE;
+            defaults to arange(seq) (+ cache_index in decode).
+          segment_ids: optional (batch, seq) packing segments.
+          cache: optional KV cache pytree from ``self.init_cache`` (decode).
+          cache_index: int32 scalar — write offset into the cache.
+
+        Returns:
+          (logits, new_cache) if cache is not None else logits.
+          logits: (batch, seq, vocab) in the policy's output dtype.
+        """
+        cfg = self.cfg
+        if cache is not None and segment_ids is not None:
+            raise ValueError(
+                "segment_ids with a KV cache is not supported: the decode "
+                "path has no packed-segment masking, and silently ignoring "
+                "packing would leak attention across sequences"
+            )
+        p = self.policy.cast_to_compute(params)
+        b, s = tokens.shape
+
+        h = jnp.take(p["embed"], tokens, axis=0)
+        h = constrain(h, ("batch", "seq", "act_embed"))
+
+        if positions is None:
+            positions = jnp.arange(s)
+            if cache_index is not None:
+                positions = positions + cache_index
+        sin, cos = rope_frequencies(
+            cfg.resolved_head_dim, positions, theta=cfg.rope_theta
+        )
+
+        block = self._block
+        if cfg.remat and cache is None:
+            block = jax.checkpoint(
+                block, static_argnums=(), policy=None
+            )
+
+        if cache is None:
+            def body(carry, layer_p):
+                out, _ = block(layer_p, carry, sin, cos, segment_ids, None, None)
+                return out, None
+
+            h, _ = jax.lax.scan(body, h, p["blocks"])
+            new_cache = None
+        else:
+            def body(carry, xs):
+                layer_p, cache_slice = xs
+                out, new_slice = block(
+                    layer_p, carry, sin, cos, None, cache_slice, cache_index
+                )
+                return out, new_slice
+
+            h, new_cache = jax.lax.scan(body, h, (p["blocks"], cache))
+
+        h = rms_norm(h, p["final_norm"], eps=cfg.norm_eps)
+        if cfg.tie_embeddings:
+            logits = jnp.einsum("bsd,vd->bsv", h, p["embed"])
+        else:
+            logits = jnp.einsum("bsd,dv->bsv", h, p["unembed"])
+        logits = constrain(logits, ("batch", "seq", "act_vocab"))
+        logits = self.policy.cast_to_output(logits)
+        return logits if cache is None else (logits, new_cache)
+
+    # ------------------------------------------------------------------- loss
+    def loss(self, params, batch):
+        """Next-token loss. batch: {"tokens": (b, s), optional "mask",
+        "segment_ids", "positions"}. Predicts tokens[:, 1:]."""
+        tokens = batch["tokens"]
+        logits = self(
+            params,
+            tokens[:, :-1],
+            segment_ids=(
+                batch["segment_ids"][:, :-1]
+                if batch.get("segment_ids") is not None
+                else None
+            ),
+            positions=(
+                batch["positions"][:, :-1]
+                if batch.get("positions") is not None
+                else None
+            ),
+        )
+        mask = batch.get("mask")
+        if mask is not None:
+            mask = mask[:, 1:]
+        return softmax_cross_entropy(
+            logits, tokens[:, 1:], mask=mask, z_loss=self.cfg.z_loss
+        )
+
+    # ------------------------------------------------------------------ cache
+    def init_cache(self, batch_size: int, max_seq_len: int, dtype=jnp.bfloat16):
+        """Preallocated stacked KV cache: leaves (layers, b, s_max, kv, hd)."""
+        cfg = self.cfg
+        shape = (
+            cfg.n_layers, batch_size, max_seq_len, cfg.n_kv_heads,
+            cfg.resolved_head_dim,
+        )
+        return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def _decode_attention(q, ck, cv, cache_index, impl):
+    """Attention over a preallocated cache: valid keys are [0, index + q_len).
+
+    Queries sit at absolute positions index .. index + q_len - 1.
+    """
+    del impl  # decode is tiny; XLA path is optimal (no S×S materialisation)
+    b, q_len, n_heads, head_dim = q.shape
+    _, s_max, n_kv, _ = ck.shape
+    group = n_heads // n_kv
+    qg = q.reshape(b, q_len, n_kv, group, head_dim)
+    scores = jnp.einsum(
+        "bqhgd,bkhd->bhgqk", qg, ck, preferred_element_type=jnp.float32
+    ) * (head_dim**-0.5)
+    qi = cache_index + jnp.arange(q_len)[:, None]
+    kj = jnp.arange(s_max)[None, :]
+    mask = jnp.where(kj <= qi, 0.0, -2.0e38)
+    scores = scores + mask
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, cv)
+    return out.reshape(b, q_len, n_heads, head_dim)

@@ -1,0 +1,25 @@
+from shifu_tpu.parallel.ctx import activation_sharding, constrain
+from shifu_tpu.parallel.mesh import MESH_AXES, MeshPlan
+from shifu_tpu.parallel.sharding import (
+    DEFAULT_RULES,
+    batch_spec,
+    init_sharded,
+    param_shardings,
+    param_specs_tree,
+    shard_batch,
+    spec_for,
+)
+
+__all__ = [
+    "activation_sharding",
+    "constrain",
+    "MESH_AXES",
+    "MeshPlan",
+    "DEFAULT_RULES",
+    "batch_spec",
+    "init_sharded",
+    "param_shardings",
+    "param_specs_tree",
+    "shard_batch",
+    "spec_for",
+]

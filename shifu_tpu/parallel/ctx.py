@@ -1,0 +1,66 @@
+"""Activation-sharding context.
+
+Parameters get shardings from their ParamSpec axes; *activations* get theirs
+from ``constrain(x, logical_axes)`` calls inside model code. The mesh+rules
+pair is carried in a context variable so model code stays device-free: with
+no context active, ``constrain`` is the identity.
+
+The training step enters the context around the loss (make_train_step), so
+constraints are recorded during jit tracing. Beyond steering XLA toward the
+intended layout (avoid accidental all-gathers of full activations), explicit
+anchors also sidestep partitioner corner cases observed on XLA:CPU where
+composite gather-backward programs under multi-axis sharding miscompiled to
+NaN (see tests/test_sharding.py::test_sharded_train_step_*).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import dataclasses
+from typing import Mapping, Optional, Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding
+
+from shifu_tpu.parallel.sharding import DEFAULT_RULES, spec_for
+
+
+@dataclasses.dataclass(frozen=True)
+class _ActEnv:
+    mesh: Mesh
+    rules: Mapping
+
+
+_env: contextvars.ContextVar[Optional[_ActEnv]] = contextvars.ContextVar(
+    "shifu_tpu_act_env", default=None
+)
+
+
+@contextlib.contextmanager
+def activation_sharding(mesh: Mesh, rules: Mapping = DEFAULT_RULES):
+    """Enable ``constrain`` within this (tracing) scope."""
+    token = _env.set(_ActEnv(mesh, rules))
+    try:
+        yield
+    finally:
+        _env.reset(token)
+
+
+def constrain(x: jax.Array, logical: Sequence[Optional[str]]) -> jax.Array:
+    """Pin ``x``'s sharding by logical axis names; identity without context.
+
+    Divisibility/uniqueness fall back to replication per-dimension (see
+    sharding.spec_for), so tiny shapes never fail on big meshes.
+    """
+    env = _env.get()
+    if env is None:
+        return x
+    if len(logical) != x.ndim:
+        raise ValueError(
+            f"constrain: {len(logical)} names for rank-{x.ndim} array"
+        )
+    spec = spec_for(x.shape, logical, env.mesh, env.rules)
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(env.mesh, spec)
+    )

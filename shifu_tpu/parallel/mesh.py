@@ -1,0 +1,75 @@
+"""Device mesh planning.
+
+One mesh, six named axes, fixed order:
+
+  ("dp", "fsdp", "ep", "pp", "sp", "tp")
+
+  * dp   — pure data parallelism (params replicated)
+  * fsdp — data parallelism with params/optimizer sharded (ZeRO-3 style;
+           XLA turns the annotations into all-gather / reduce-scatter)
+  * ep   — expert parallelism for MoE layers
+  * pp   — pipeline stages (layers axis)
+  * sp   — sequence/context parallelism (ring attention rides this axis)
+  * tp   — tensor parallelism (heads / mlp / vocab)
+
+Axis order is chosen so the innermost (fastest-varying, best ICI locality
+under ``create_device_mesh``) axes are tp and sp — the ones with per-layer
+collectives on the critical path. dp/fsdp gradient reductions happen once
+per step and tolerate the outer placement.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+MESH_AXES = ("dp", "fsdp", "ep", "pp", "sp", "tp")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshPlan:
+    dp: int = 1
+    fsdp: int = 1
+    ep: int = 1
+    pp: int = 1
+    sp: int = 1
+    tp: int = 1
+
+    @property
+    def shape(self) -> tuple:
+        return tuple(getattr(self, a) for a in MESH_AXES)
+
+    @property
+    def n_devices(self) -> int:
+        return math.prod(self.shape)
+
+    def build(self, devices=None) -> Mesh:
+        if devices is None:
+            devices = jax.devices()
+        if self.n_devices != len(devices):
+            raise ValueError(
+                f"MeshPlan {self.shape} needs {self.n_devices} devices, "
+                f"got {len(devices)}"
+            )
+        if len(devices) > 1 and devices[0].platform == "tpu":
+            from jax.experimental import mesh_utils
+
+            dev_array = mesh_utils.create_device_mesh(
+                self.shape, devices=devices
+            )
+        else:
+            dev_array = np.asarray(devices).reshape(self.shape)
+        return Mesh(dev_array, MESH_AXES)
+
+    @classmethod
+    def single_device(cls) -> "MeshPlan":
+        return cls()
+
+    @classmethod
+    def fsdp_only(cls, n: int) -> "MeshPlan":
+        return cls(fsdp=n)

@@ -1,0 +1,162 @@
+"""Logical-axis → mesh-axis sharding rules.
+
+The model layer names every parameter dimension with a *logical* axis
+(core.module.ParamSpec.axes). This module turns those names into
+``PartitionSpec``/``NamedSharding`` trees for pjit, applying two safety
+rails per tensor:
+
+  * divisibility — a logical axis only maps onto a mesh axis if the
+    dimension size divides by the mesh axis extent; otherwise that
+    dimension is replicated (tiny test configs stay valid on big meshes).
+  * uniqueness — a mesh axis may appear at most once in one tensor's spec;
+    later dimensions claiming an already-used mesh axis are replicated.
+
+Rules are an ordered mapping ``logical name -> mesh axis | tuple | None``.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from shifu_tpu.core.module import Module, ParamSpec
+
+MeshAxes = Union[None, str, tuple]
+
+# Default rules for the transformer family. fsdp shards the embed dimension
+# of weights (ZeRO-3); tp shards heads/mlp/vocab; pp shards the stacked
+# layers axis; experts ride ep.
+DEFAULT_RULES: dict = {
+    "layers": "pp",
+    "embed": "fsdp",
+    "heads": "tp",
+    "kv_heads": "tp",
+    "mlp": "tp",
+    "vocab": "tp",
+    "experts": "ep",
+    "expert_mlp": "tp",
+    "head_dim": None,
+    # activation axes
+    "batch": ("dp", "fsdp"),
+    "seq": "sp",
+    "act_embed": None,
+    "act_heads": "tp",
+    "act_mlp": "tp",
+    "act_vocab": "tp",
+}
+
+
+def _mesh_size(mesh: Mesh, axes: MeshAxes) -> int:
+    if axes is None:
+        return 1
+    if isinstance(axes, str):
+        axes = (axes,)
+    size = 1
+    for a in axes:
+        size *= mesh.shape[a]
+    return size
+
+
+def spec_for(
+    shape: Sequence[int],
+    logical: Sequence[Optional[str]],
+    mesh: Mesh,
+    rules: Mapping[str, MeshAxes] = DEFAULT_RULES,
+) -> P:
+    """PartitionSpec for one tensor, applying divisibility + uniqueness."""
+    used: set = set()
+    out = []
+    for dim, name in zip(shape, logical):
+        mapped = rules.get(name) if name is not None else None
+        if mapped is None:
+            out.append(None)
+            continue
+        axes = (mapped,) if isinstance(mapped, str) else tuple(mapped)
+        if any(a in used for a in axes):
+            out.append(None)
+            continue
+        if dim % _mesh_size(mesh, axes) != 0:
+            out.append(None)
+            continue
+        used.update(axes)
+        out.append(mapped if isinstance(mapped, str) else tuple(axes))
+    # Trim trailing Nones (cosmetic only).
+    while out and out[-1] is None:
+        out.pop()
+    return P(*out)
+
+
+def param_specs_tree(
+    module: Module, mesh: Mesh, rules: Mapping[str, MeshAxes] = DEFAULT_RULES
+):
+    """Tree of PartitionSpec matching the module's params tree."""
+    specs = module.specs()
+
+    def one(s: ParamSpec) -> P:
+        return spec_for(s.shape, s.axes, mesh, rules)
+
+    return jax.tree_util.tree_map(
+        one, specs, is_leaf=lambda x: isinstance(x, ParamSpec)
+    )
+
+
+def param_shardings(
+    module: Module, mesh: Mesh, rules: Mapping[str, MeshAxes] = DEFAULT_RULES
+):
+    """Tree of NamedSharding matching the module's params tree."""
+    return jax.tree_util.tree_map(
+        lambda p: NamedSharding(mesh, p),
+        param_specs_tree(module, mesh, rules),
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def init_sharded(
+    module: Module,
+    rng: jax.Array,
+    mesh: Mesh,
+    rules: Mapping[str, MeshAxes] = DEFAULT_RULES,
+):
+    """Initialise parameters directly into their shards.
+
+    The init runs under jit with ``out_shardings`` set, so every weight is
+    created on its owning devices — no host-side full copy, which is what
+    makes >HBM-sized models initialisable at all.
+    """
+    shardings = param_shardings(module, mesh, rules)
+    init_fn = jax.jit(
+        lambda key: module.init(key), out_shardings=shardings
+    )
+    return init_fn(rng)
+
+
+def batch_spec(mesh: Mesh, rules: Mapping[str, MeshAxes] = DEFAULT_RULES) -> P:
+    """PartitionSpec for a (batch, seq) token array.
+
+    Built with the sentinel shape (0, 0): 0 is divisible by every mesh axis
+    extent, so spec_for's divisibility rail never fires here. Divisibility
+    of real data is the caller's contract (batch % (dp*fsdp) == 0 etc.) —
+    shape-aware callers should prefer shard_batch.
+    """
+    return spec_for((0, 0), ("batch", "seq"), mesh, rules)
+
+
+def shard_batch(batch, mesh: Mesh, rules=DEFAULT_RULES, *, microbatched=False):
+    """Device_put a host batch tree of (b, s[, ...]) arrays onto the mesh.
+
+    With ``microbatched=True`` leaves are (microbatch, b, s[, ...]) — the
+    leading scan axis is left unsharded and batch/seq shift right one dim.
+    """
+    lead = (None,) if microbatched else ()
+
+    def put(x):
+        x = jnp.asarray(x)
+        names = lead + ("batch", "seq")
+        logical = names[: x.ndim] + (None,) * max(0, x.ndim - len(names))
+        spec = spec_for(x.shape, logical, mesh, rules)
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map(put, batch)

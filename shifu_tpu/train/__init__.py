@@ -1,0 +1,18 @@
+from shifu_tpu.train.optimizer import AdamW, constant, global_norm, warmup_cosine
+from shifu_tpu.train.step import (
+    TrainState,
+    create_sharded_state,
+    make_train_step,
+    state_shardings,
+)
+
+__all__ = [
+    "AdamW",
+    "constant",
+    "global_norm",
+    "warmup_cosine",
+    "TrainState",
+    "create_sharded_state",
+    "make_train_step",
+    "state_shardings",
+]

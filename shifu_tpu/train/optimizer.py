@@ -1,0 +1,124 @@
+"""Optimizers and LR schedules.
+
+The optimizer state is a pytree whose ``mu``/``nu`` subtrees mirror the
+params tree leaf-for-leaf, so the parallel layer shards optimizer state by
+reusing the param shardings unchanged — no structure matching against
+opaque library state. (optax remains available for research code; the
+training stack uses this native implementation.)
+
+All moment math runs in f32 regardless of the grad dtype.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+# ----------------------------------------------------------------- schedules
+def warmup_cosine(
+    peak_lr: float,
+    total_steps: int,
+    warmup_steps: int = 0,
+    final_fraction: float = 0.1,
+) -> Callable:
+    """Linear warmup then cosine decay to final_fraction * peak_lr."""
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        warm = step / jnp.maximum(1.0, warmup_steps)
+        progress = (step - warmup_steps) / jnp.maximum(
+            1.0, total_steps - warmup_steps
+        )
+        progress = jnp.clip(progress, 0.0, 1.0)
+        cos = final_fraction + (1 - final_fraction) * 0.5 * (
+            1 + jnp.cos(jnp.pi * progress)
+        )
+        return peak_lr * jnp.where(step < warmup_steps, warm, cos)
+
+    return schedule
+
+
+def constant(lr: float) -> Callable:
+    return lambda step: jnp.asarray(lr, jnp.float32)
+
+
+# ------------------------------------------------------------------- adamw
+@dataclasses.dataclass(frozen=True)
+class AdamW:
+    """AdamW with decoupled weight decay, global-norm clipping, and bias
+    correction. Which params are decayed is controlled by ``decay_mask``
+    (see update); the train stack derives it from logical axes so norm
+    scales — stacked or not — are never decayed.
+    """
+
+    schedule: Callable = constant(3e-4)
+    b1: float = 0.9
+    b2: float = 0.95
+    eps: float = 1e-8
+    weight_decay: float = 0.1
+    grad_clip_norm: Optional[float] = 1.0
+
+    def init(self, params):
+        zeros = lambda: jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params
+        )
+        return {"mu": zeros(), "nu": zeros(), "step": jnp.zeros((), jnp.int32)}
+
+    def update(self, grads, state, params, decay_mask=None):
+        """Returns (new_params, new_state, stats).
+
+        ``decay_mask``: optional pytree of bools (params structure) marking
+        which leaves receive weight decay. Without it, falls back to the
+        ndim>=2 heuristic — note that heuristic decays *stacked* norm scales
+        of shape (layers, dim); model-aware callers (train.step) should pass
+        a mask derived from logical axes instead.
+        """
+        step = state["step"] + 1
+        grads = jax.tree_util.tree_map(
+            lambda g: g.astype(jnp.float32), grads
+        )
+
+        gnorm = global_norm(grads)
+        if self.grad_clip_norm is not None:
+            scale = jnp.minimum(1.0, self.grad_clip_norm / (gnorm + 1e-9))
+            grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
+
+        b1, b2 = self.b1, self.b2
+        mu = jax.tree_util.tree_map(
+            lambda m, g: b1 * m + (1 - b1) * g, state["mu"], grads
+        )
+        nu = jax.tree_util.tree_map(
+            lambda v, g: b2 * v + (1 - b2) * jnp.square(g), state["nu"], grads
+        )
+        # Bias correction.
+        c1 = 1 - b1 ** step.astype(jnp.float32)
+        c2 = 1 - b2 ** step.astype(jnp.float32)
+        lr = self.schedule(step)
+
+        if decay_mask is None:
+            decay_mask = jax.tree_util.tree_map(
+                lambda p: p.ndim >= 2, params
+            )
+
+        def step_one(p, m, v, decay):
+            update = (m / c1) / (jnp.sqrt(v / c2) + self.eps)
+            if self.weight_decay and decay:
+                update = update + self.weight_decay * p.astype(jnp.float32)
+            return (p.astype(jnp.float32) - lr * update).astype(p.dtype)
+
+        new_params = jax.tree_util.tree_map(
+            step_one, params, mu, nu, decay_mask
+        )
+        new_state = {"mu": mu, "nu": nu, "step": step}
+        return new_params, new_state, {"grad_norm": gnorm, "lr": lr}
+
+
+def global_norm(tree) -> jax.Array:
+    leaves = jax.tree_util.tree_leaves(tree)
+    return jnp.sqrt(
+        sum(jnp.sum(jnp.square(x.astype(jnp.float32))) for x in leaves)
+    )

@@ -1,0 +1,150 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from shifu_tpu.core.module import param_count
+from shifu_tpu.models import Transformer, TransformerConfig
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = TransformerConfig.tiny()
+    model = Transformer(cfg)
+    params = model.init(jax.random.key(0))
+    return model, params
+
+
+def test_forward_shapes_and_dtype(tiny):
+    model, params = tiny
+    tokens = jnp.zeros((2, 16), jnp.int32)
+    logits = jax.jit(lambda p, t: model(p, t))(params, tokens)
+    assert logits.shape == (2, 16, model.cfg.vocab_size)
+    assert logits.dtype == jnp.float32  # policy output dtype
+
+
+def test_param_count_formula(tiny):
+    model, params = tiny
+    cfg = model.cfg
+    d, h, kv, hd, m, L, V = (
+        cfg.dim, cfg.n_heads, cfg.n_kv_heads, cfg.resolved_head_dim,
+        cfg.mlp_dim, cfg.n_layers, cfg.vocab_size,
+    )
+    per_layer = d * h * hd + 2 * d * kv * hd + h * hd * d + 3 * d * m + 2 * d
+    expected = V * d + L * per_layer + d + d * V
+    assert param_count(params) == expected
+
+
+def test_causality_end_to_end(tiny):
+    model, params = tiny
+    rng = np.random.RandomState(0)
+    t1 = jnp.asarray(rng.randint(0, 256, (1, 12)), jnp.int32)
+    t2 = t1.at[0, -1].set((int(t1[0, -1]) + 1) % 256)
+    l1 = model(params, t1)
+    l2 = model(params, t2)
+    np.testing.assert_allclose(l1[:, :-1], l2[:, :-1], rtol=2e-4, atol=1e-5)
+
+
+def test_loss_and_grads_finite(tiny):
+    model, params = tiny
+    tokens = jnp.asarray(
+        np.random.RandomState(1).randint(0, 256, (2, 16)), jnp.int32
+    )
+    (loss, aux), grads = jax.jit(
+        jax.value_and_grad(lambda p: model.loss(p, {"tokens": tokens}), has_aux=True)
+    )(params)
+    assert np.isfinite(float(loss))
+    # Near-uniform at init: loss ~ log(vocab) + small
+    assert abs(float(aux["ce"]) - np.log(256)) < 1.0
+    for leaf in jax.tree_util.tree_leaves(grads):
+        assert np.isfinite(np.asarray(leaf, np.float32)).all()
+
+
+def test_loss_decreases_with_sgd(tiny):
+    model, params = tiny
+    tokens = jnp.asarray(
+        np.random.RandomState(2).randint(0, 256, (4, 16)), jnp.int32
+    )
+    batch = {"tokens": tokens}
+
+    @jax.jit
+    def step(p):
+        (loss, _), g = jax.value_and_grad(model.loss, has_aux=True)(p, batch)
+        p = jax.tree_util.tree_map(lambda w, gw: w - 0.5 * gw, p, g)
+        return p, loss
+
+    losses = []
+    for _ in range(5):
+        params, loss = step(params)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] - 0.1, losses
+
+
+def test_remat_matches_no_remat():
+    cfg = TransformerConfig.tiny()
+    tokens = jnp.asarray(
+        np.random.RandomState(3).randint(0, 256, (2, 8)), jnp.int32
+    )
+    params = Transformer(cfg).init(jax.random.key(0))
+
+    from shifu_tpu.core.dtypes import FULL_F32
+
+    def grad_with(remat):
+        # f32 compute: bf16 rounding differs under remat's refusion.
+        m = Transformer(TransformerConfig.tiny(remat=remat), policy=FULL_F32)
+        return jax.grad(lambda p: m.loss(p, {"tokens": tokens})[0])(params)
+
+    g1, g2 = grad_with(False), grad_with(True)
+    for a, b in zip(jax.tree_util.tree_leaves(g1), jax.tree_util.tree_leaves(g2)):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6)
+
+
+def test_tied_embeddings():
+    cfg = TransformerConfig.tiny(tie_embeddings=True)
+    model = Transformer(cfg)
+    params = model.init(jax.random.key(0))
+    assert "unembed" not in params
+    logits = model(params, jnp.zeros((1, 4), jnp.int32))
+    assert logits.shape == (1, 4, cfg.vocab_size)
+
+
+def test_decode_cache_matches_full_forward(tiny):
+    model, params = tiny
+    rng = np.random.RandomState(4)
+    tokens = jnp.asarray(rng.randint(0, 256, (2, 10)), jnp.int32)
+    full = model(params, tokens)
+
+    cache = model.init_cache(batch_size=2, max_seq_len=16)
+    # Prefill the first 6 tokens, then decode 4 more one at a time.
+    logits, cache = model(
+        params, tokens[:, :6], cache=cache, cache_index=jnp.int32(0)
+    )
+    np.testing.assert_allclose(logits, full[:, :6], rtol=3e-2, atol=3e-3)
+    for i in range(6, 10):
+        logits, cache = model(
+            params, tokens[:, i : i + 1], cache=cache, cache_index=jnp.int32(i)
+        )
+        np.testing.assert_allclose(
+            logits[:, 0], full[:, i], rtol=3e-2, atol=3e-3,
+            err_msg=f"decode step {i}",
+        )
+
+
+def test_packed_segments_match_separate_sequences(tiny):
+    model, params = tiny
+    rng = np.random.RandomState(5)
+    a = jnp.asarray(rng.randint(0, 256, (1, 4)), jnp.int32)
+    b = jnp.asarray(rng.randint(0, 256, (1, 4)), jnp.int32)
+    packed = jnp.concatenate([a, b], axis=1)
+    seg = jnp.asarray([[0, 0, 0, 0, 1, 1, 1, 1]])
+    pos = jnp.asarray([[0, 1, 2, 3, 0, 1, 2, 3]])
+    lp = model(params, packed, segment_ids=seg, positions=pos)
+    la = model(params, a)
+    lb = model(params, b)
+    np.testing.assert_allclose(lp[:, :4], la, rtol=2e-4, atol=1e-5)
+    np.testing.assert_allclose(lp[:, 4:], lb, rtol=2e-4, atol=1e-5)
+
+
+def test_bad_gqa_config_raises():
+    with pytest.raises(ValueError):
+        TransformerConfig(n_heads=6, n_kv_heads=4)
