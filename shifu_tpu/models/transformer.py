@@ -36,6 +36,7 @@ from shifu_tpu.ops import (
     rope_frequencies,
     softmax_cross_entropy,
 )
+from shifu_tpu.ops.attention import NEG_INF
 
 
 @dataclasses.dataclass(frozen=True)
@@ -320,7 +321,14 @@ class Transformer(Module):
 
     # ------------------------------------------------------------------ cache
     def init_cache(self, batch_size: int, max_seq_len: int, dtype=jnp.bfloat16):
-        """Preallocated stacked KV cache: leaves (layers, b, s_max, kv, hd)."""
+        """Preallocated stacked KV cache: leaves (layers, b, s_max, kv, hd).
+
+        Contract: callers must keep ``cache_index + q_len <= max_seq_len``.
+        Writes past the end are clamped by ``dynamic_update_slice`` (XLA
+        semantics — no out-of-bounds error exists inside jit), which would
+        silently overwrite the last valid entries; the decode loop in
+        train/sampler enforces the bound on the host side.
+        """
         cfg = self.cfg
         shape = (
             cfg.n_layers, batch_size, max_seq_len, cfg.n_kv_heads,
@@ -344,7 +352,7 @@ def _decode_attention(q, ck, cv, cache_index, impl):
     ) * (head_dim**-0.5)
     qi = cache_index + jnp.arange(q_len)[:, None]
     kj = jnp.arange(s_max)[None, :]
-    mask = jnp.where(kj <= qi, 0.0, -2.0e38)
+    mask = jnp.where(kj <= qi, 0.0, NEG_INF)
     scores = scores + mask
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, cv)

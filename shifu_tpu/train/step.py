@@ -90,22 +90,23 @@ def make_train_step(
         grad_fn = jax.value_and_grad(model.loss, has_aux=True)
         if microbatches is None:
             (loss, aux), grads = grad_fn(params, batch)
-            return loss, grads
+            return loss, aux, grads
 
         def body(acc, mb):
-            (loss, _aux), grads = grad_fn(params, mb)
+            (loss, aux), grads = grad_fn(params, mb)
             acc = jax.tree_util.tree_map(
                 lambda a, g: a + g.astype(jnp.float32), acc, grads
             )
-            return acc, loss
+            return acc, (loss, aux)
 
         zero = jax.tree_util.tree_map(
             lambda p: jnp.zeros(p.shape, jnp.float32), params
         )
-        acc, losses = jax.lax.scan(body, zero, batch)
+        acc, (losses, auxes) = jax.lax.scan(body, zero, batch)
         inv = 1.0 / microbatches
         grads = jax.tree_util.tree_map(lambda g: g * inv, acc)
-        return jnp.mean(losses), grads
+        aux = jax.tree_util.tree_map(jnp.mean, auxes)
+        return jnp.mean(losses), aux, grads
 
     # Weight decay mask from logical axes: a param is decayed iff it has
     # >= 2 non-"layers" dimensions (so stacked norm scales stay undecayed).
@@ -122,12 +123,12 @@ def make_train_step(
         with contextlib.ExitStack() as ctx:
             if mesh is not None:
                 ctx.enter_context(activation_sharding(mesh, rules))
-            loss, grads = loss_and_grads(state.params, batch)
+            loss, aux, grads = loss_and_grads(state.params, batch)
             new_params, new_opt, stats = optimizer.update(
                 grads, state.opt, state.params, decay_mask=decay_mask
             )
         new_state = TrainState(params=new_params, opt=new_opt)
-        metrics = {"loss": loss, **stats}
+        metrics = {"loss": loss, **aux, **stats}
         return new_state, metrics
 
     if mesh is None:

@@ -7,7 +7,11 @@ one physical core.
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
+# jax may already be imported by an interpreter-startup hook (which pins the
+# platform via JAX_PLATFORMS=axon in the environment), so setting env vars
+# alone is not enough — override via jax.config, which takes effect as long
+# as no backend has been initialised yet. XLA_FLAGS is still read at backend
+# init time, so setting it here (before the first jax.devices()) works.
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -15,6 +19,8 @@ if "xla_force_host_platform_device_count" not in _flags:
     ).strip()
 
 import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 # Numerics tests compare against numpy: force true-f32 matmuls. Production
 # code keeps the default (bf16-on-MXU) precision.

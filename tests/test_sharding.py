@@ -106,29 +106,43 @@ def test_sharded_step_with_microbatches(mesh):
     assert int(state.step) == 1
 
 
-def test_decay_mask_skips_stacked_norm_scales(mesh):
-    cfg = TransformerConfig.tiny()
-    model = Transformer(cfg)
-    opt = AdamW(schedule=lambda s: jnp.float32(0.0), weight_decay=0.5)
-    state = create_sharded_state(model, opt, jax.random.key(0), mesh)
-    step = make_train_step(model, opt, mesh)
-    before = np.asarray(jax.device_get(state.params["blocks"]["attn_norm"]))
-    w_before = np.asarray(jax.device_get(state.params["blocks"]["w_up"]))
-    tokens = np.random.RandomState(2).randint(0, 256, (4, 16)).astype(np.int32)
-    state, _ = step(state, shard_batch({"tokens": jnp.asarray(tokens)}, mesh))
-    after = np.asarray(jax.device_get(state.params["blocks"]["attn_norm"]))
-    w_after = np.asarray(jax.device_get(state.params["blocks"]["w_up"]))
-    # lr=0: only weight decay could move params — and it must not touch
-    # stacked (layers, dim) norm scales, only real >=2D weights... but with
-    # lr=0 nothing moves at all. Instead check the mask directly:
-    from shifu_tpu.core.module import param_axes
-    mask = jax.tree_util.tree_map(
-        lambda a: len([x for x in a if x != "layers"]) >= 2,
-        model.axes(), is_leaf=lambda x: isinstance(x, tuple),
+def test_decay_mask_skips_stacked_norm_scales():
+    """Behavioral check that make_train_step derives the logical-axes decay
+    mask and passes it to the optimizer: with a loss whose gradient is zero,
+    the Adam update vanishes and the ONLY movement is decoupled weight decay
+    — which must shrink real weights but leave stacked (layers, dim) norm
+    scales untouched (the ndim>=2 fallback would wrongly decay them)."""
+
+    class FakeModel:
+        def axes(self):
+            return {"scale": ("layers", "embed"), "w": ("embed", "mlp")}
+
+        def loss(self, params, batch):
+            # Gradient is identically zero but depends on params, so
+            # value_and_grad produces zero grads of the right structure.
+            zero = sum(
+                jnp.sum(p * 0.0) for p in jax.tree_util.tree_leaves(params)
+            )
+            return zero, {}
+
+    model = FakeModel()
+    opt = AdamW(schedule=lambda s: jnp.float32(0.1), weight_decay=0.5)
+    params = {
+        "scale": jnp.ones((2, 4), jnp.float32),
+        "w": jnp.ones((4, 8), jnp.float32),
+    }
+    from shifu_tpu.train.step import TrainState
+
+    state = TrainState.create(params, opt)
+    step = make_train_step(model, opt)
+    state, _ = step(state, {"tokens": jnp.zeros((1, 1), jnp.int32)})
+    # scale: stacked norm param -> no decay -> unchanged.
+    np.testing.assert_array_equal(
+        np.asarray(state.params["scale"]), np.ones((2, 4), np.float32)
     )
-    assert mask["blocks"]["attn_norm"] is False
-    assert mask["blocks"]["w_up"] is True
-    assert mask["final_norm"] is False
-    assert mask["embed"] is True
-    np.testing.assert_array_equal(before, after)
-    np.testing.assert_array_equal(w_before, w_after)
+    # w: real weight -> decayed by lr * wd = 0.05.
+    np.testing.assert_allclose(
+        np.asarray(state.params["w"]),
+        np.full((4, 8), 0.95, np.float32),
+        rtol=1e-6,
+    )
