@@ -1,0 +1,107 @@
+"""Benchmark: sharded training-step throughput on the available chip(s).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
+
+The reference (klyan/shifu) publishes no benchmark numbers (see BASELINE.md:
+its repository is empty), so ``vs_baseline`` is reported as 1.0 by
+convention — there is nothing to normalise against. The extras document the
+absolute numbers that matter on TPU: tokens/s and model-FLOPs utilisation
+(MFU) against the chip's peak bf16 throughput.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+# Peak bf16 FLOP/s per chip, for MFU. Unknown platforms -> None (MFU omitted).
+PEAK_FLOPS = {
+    "TPU v5 lite": 197e12,  # v5e
+    "TPU v5e": 197e12,
+    "TPU v4": 275e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,  # v6e / Trillium
+}
+
+
+def _peak_flops(device) -> float | None:
+    kind = getattr(device, "device_kind", "")
+    for prefix, peak in PEAK_FLOPS.items():
+        if kind.startswith(prefix):
+            return peak
+    return None
+
+
+def main():
+    from shifu_tpu.models.transformer import Transformer, TransformerConfig
+    from shifu_tpu.train import AdamW, make_train_step
+    from shifu_tpu.train.step import TrainState
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+
+    if on_tpu:
+        cfg = TransformerConfig.small()  # ~160M params
+        batch, seq, steps = 8, 2048, 10
+    else:  # CPU smoke fallback so the bench never hard-fails
+        cfg = TransformerConfig.tiny()
+        batch, seq, steps = 2, 128, 3
+
+    model = Transformer(cfg)
+    opt = AdamW()
+    params = model.init(jax.random.key(0))
+    state = TrainState.create(params, opt)
+    step = make_train_step(model, opt)
+
+    tokens = jax.random.randint(jax.random.key(1), (batch, seq), 0, cfg.vocab_size)
+    batch_tree = {"tokens": tokens}
+
+    # Warmup (compile) + one executed step so timing excludes compilation.
+    # Sync via float(): a host round-trip, which (unlike block_until_ready
+    # on the tunnelled axon backend) reliably waits for execution.
+    state, metrics = step(step(state, batch_tree)[0], batch_tree)
+    float(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step(state, batch_tree)
+    float(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    toks_per_step = batch * (seq - 1)  # loss predicts tokens[:, 1:]
+    tokens_per_s = steps * toks_per_step / dt
+
+    # Model FLOPs: ~6*N per token (fwd+bwd) + attention 12*s*d_head*h*L
+    # (approx; remat adds an extra forward -> factor 8 instead of 6 would be
+    # the "hardware FLOPs" view; MFU conventionally uses the 6N model view).
+    from shifu_tpu.core.module import param_count
+
+    n_params = param_count(params)
+    hd = cfg.resolved_head_dim
+    attn_flops_per_tok = 12 * seq * hd * cfg.n_heads * cfg.n_layers
+    flops_per_tok = 6 * n_params + attn_flops_per_tok
+    achieved = tokens_per_s * flops_per_tok
+
+    out = {
+        "metric": "train_tokens_per_s",
+        "value": round(tokens_per_s, 1),
+        "unit": "tokens/s",
+        "vs_baseline": 1.0,  # reference publishes no numbers (BASELINE.md)
+        "model_params": n_params,
+        "batch": batch,
+        "seq": seq,
+        "steps_timed": steps,
+        "step_ms": round(1000 * dt / steps, 2),
+        "device": getattr(dev, "device_kind", dev.platform),
+    }
+    peak = _peak_flops(dev) if on_tpu else None
+    if peak:
+        out["mfu"] = round(achieved / peak, 4)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -326,8 +326,8 @@ class Transformer(Module):
         Contract: callers must keep ``cache_index + q_len <= max_seq_len``.
         Writes past the end are clamped by ``dynamic_update_slice`` (XLA
         semantics — no out-of-bounds error exists inside jit), which would
-        silently overwrite the last valid entries; the decode loop in
-        train/sampler enforces the bound on the host side.
+        silently overwrite the last valid entries — enforce the bound on
+        the host side when driving a decode loop.
         """
         cfg = self.cfg
         shape = (

@@ -105,7 +105,22 @@ def make_train_step(
         acc, (losses, auxes) = jax.lax.scan(body, zero, batch)
         inv = 1.0 / microbatches
         grads = jax.tree_util.tree_map(lambda g: g * inv, acc)
-        aux = jax.tree_util.tree_map(jnp.mean, auxes)
+        # Aux reduction across microbatches. Gradients (and therefore the
+        # optimised objective) weight each microbatch equally — that is the
+        # standard accumulation convention and stays as-is. But for
+        # *reporting*, a plain mean-of-means misstates ce/z when masked
+        # microbatches have uneven valid-token counts, so when the loss aux
+        # carries its "denominator" we token-weight the other entries and
+        # report the TOTAL denominator, not its per-microbatch average.
+        if isinstance(auxes, dict) and "denominator" in auxes:
+            w = auxes["denominator"].astype(jnp.float32)
+            total = jnp.sum(w)
+            aux = {
+                k: (total if k == "denominator" else jnp.sum(v * w) / total)
+                for k, v in auxes.items()
+            }
+        else:
+            aux = jax.tree_util.tree_map(jnp.mean, auxes)
         return jnp.mean(losses), aux, grads
 
     # Weight decay mask from logical axes: a param is decayed iff it has

@@ -146,3 +146,55 @@ def test_decay_mask_skips_stacked_norm_scales():
         np.full((4, 8), 0.95, np.float32),
         rtol=1e-6,
     )
+
+
+def test_transformer_axes_classify_decay_correctly():
+    """The real Transformer's logical axes must put norm scales (stacked or
+    not) outside weight decay and real weight matrices inside it, under the
+    rule make_train_step uses: decay iff >= 2 non-'layers' dims."""
+    model = Transformer(TransformerConfig.tiny())
+    axes = model.axes()
+
+    def decays(a):
+        return len([x for x in a if x != "layers"]) >= 2
+
+    assert not decays(axes["blocks"]["attn_norm"])   # (layers, embed)
+    assert not decays(axes["blocks"]["mlp_norm"])
+    assert not decays(axes["final_norm"])            # (embed,)
+    assert decays(axes["embed"])                     # (vocab, embed)
+    assert decays(axes["unembed"])
+    assert decays(axes["blocks"]["w_up"])            # (layers, embed, mlp)
+    assert decays(axes["blocks"]["wq"])              # (layers, embed, h, hd)
+
+
+def test_microbatch_aux_token_weighted():
+    """Reported ce must be weighted by each microbatch's valid-token count,
+    and 'denominator' must be the total across microbatches."""
+
+    class FakeModel:
+        def loss(self, params, batch):
+            zero = params["w"].sum() * 0.0
+            d = batch["denom"][0]
+            return zero + batch["ce"][0], {
+                "ce": batch["ce"][0] + zero,
+                "denominator": d,
+            }
+
+    model = FakeModel()
+    opt = AdamW(schedule=lambda s: jnp.float32(0.0), weight_decay=0.0)
+    from shifu_tpu.train.step import TrainState
+
+    state = TrainState.create({"w": jnp.ones((2,))}, opt)
+    step = make_train_step(model, opt, microbatches=2)
+    batch = {  # leading microbatch axis of 2
+        "ce": jnp.asarray([[2.0], [10.0]], jnp.float32),
+        "denom": jnp.asarray([[100.0], [1.0]], jnp.float32),
+    }
+    _, metrics = step(state, batch)
+    np.testing.assert_allclose(float(metrics["denominator"]), 101.0)
+    np.testing.assert_allclose(
+        float(metrics["ce"]), (2.0 * 100 + 10.0 * 1) / 101.0, rtol=1e-6
+    )
+    # The optimised loss stays the unweighted microbatch mean (matches the
+    # equal-weight gradient accumulation convention).
+    np.testing.assert_allclose(float(metrics["loss"]), 6.0, rtol=1e-6)
