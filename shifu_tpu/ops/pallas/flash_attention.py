@@ -1,14 +1,506 @@
-"""Pallas TPU flash attention (blocked, causal, GQA).
+"""Pallas TPU flash attention (blocked, causal, GQA, segment-aware).
 
-Placeholder until the kernel lands: raises with a clear message instead of
-silently falling back, so callers never believe they got the fused path.
+Memory-bound attention never materialises the (S, S) score matrix in HBM:
+the forward streams K/V blocks through VMEM with an online softmax
+(running max ``m``, normaliser ``l``, and f32 accumulator), and the
+backward recomputes probabilities from the saved logsumexp instead of
+storing them — the flash-attention recurrence, laid out for the TPU:
+
+  * grid order puts the KV-block dimension innermost, so the running
+    (m, l, acc) state lives in VMEM scratch across KV steps and the
+    output block is written exactly once, at the last step;
+  * every contraction is a ``dot_general`` with
+    ``preferred_element_type=f32`` — scores and accumulators stay f32
+    while the MXU consumes bf16 operands;
+  * GQA never materialises repeated K/V heads: the K/V BlockSpec index
+    map folds the query head onto its KV head (``h // group``), and the
+    dK/dV kernel accumulates over the group with an extra inner grid
+    dimension instead of an HBM-sized intermediate;
+  * causal masking skips fully-masked KV blocks via ``pl.when`` on the
+    block-level predicate, so the skipped grid steps do no FLOPs.
+
+Layout contract matches ops.attention.dot_product_attention:
+q (b, sq, h, d); k/v (b, skv, h_kv, d); queries end-aligned when
+sq < skv. Sequence lengths are padded to block multiples internally;
+padded KV columns are masked with finite NEG_INF (never -inf: a fully
+masked row would then produce NaN via (-inf) - (-inf)).
 """
 
 from __future__ import annotations
 
+import dataclasses
+import functools
+from typing import Optional
 
-def flash_attention(q, k, v, *, causal=True, scale=None, segment_ids=None):
-    raise NotImplementedError(
-        "pallas flash attention kernel not implemented yet; "
-        "use dot_product_attention(..., impl='xla')"
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from shifu_tpu.ops.attention import NEG_INF
+
+# Lane-replicated scratch width for the running max / normaliser. 128 is
+# the TPU lane count; replicating the per-row scalars across lanes keeps
+# every scratch op a plain (sublane, lane) vector op.
+_LANES = 128
+
+
+@dataclasses.dataclass(frozen=True)
+class FlashConfig:
+    causal: bool
+    scale: float
+    block_q: int
+    block_k: int
+    interpret: bool
+
+
+def _pad_to(x, multiple: int, axis: int):
+    size = x.shape[axis]
+    pad = (-size) % multiple
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+def _mask_for(rows0, cols0, bq, bk, kv_len, offset, causal, qs, ks):
+    """Boolean (bq, bk) tile mask. rows0/cols0: global tile origins.
+
+    ``qs`` is a (bq, 1) column of query segment ids and ``ks`` a (1, bk)
+    row of KV segment ids — pre-oriented by the wrapper so the compare is
+    a pure broadcast with no in-kernel transpose (sublane<->lane
+    relayouts are what Mosaic is worst at).
+    """
+    rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + rows0
+    cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + cols0
+    mask = cols < kv_len  # KV padding
+    if causal:
+        mask = jnp.logical_and(mask, cols <= rows + offset)
+    if qs is not None:
+        mask = jnp.logical_and(mask, qs == ks)
+    return mask
+
+
+def _dot(a, b, *, trans_a=False, trans_b=False):
+    """f32-accumulated matmul on possibly-bf16 operands."""
+    ca = 0 if trans_a else 1
+    cb = 1 if trans_b else 0
+    return jax.lax.dot_general(
+        a, b, (((ca,), (cb,)), ((), ())), preferred_element_type=jnp.float32
     )
+
+
+# --------------------------------------------------------------------------
+# forward
+# --------------------------------------------------------------------------
+
+
+def _fwd_kernel(cfg: FlashConfig, kv_len, offset, n_k, has_segs, *refs):
+    if has_segs:
+        q_ref, k_ref, v_ref, qs_ref, ks_ref, o_ref, lse_ref, m_sc, l_sc, acc_sc = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref, m_sc, l_sc, acc_sc = refs
+        qs_ref = ks_ref = None
+    iq = pl.program_id(2)
+    jk = pl.program_id(3)
+    bq = q_ref.shape[2]
+    bk = k_ref.shape[2]
+
+    @pl.when(jk == 0)
+    def _():
+        m_sc[...] = jnp.full_like(m_sc, NEG_INF)
+        l_sc[...] = jnp.zeros_like(l_sc)
+        acc_sc[...] = jnp.zeros_like(acc_sc)
+
+    run = jk * bk < kv_len
+    if cfg.causal:
+        run = jnp.logical_and(run, jk * bk <= iq * bq + (bq - 1) + offset)
+
+    @pl.when(run)
+    def _():
+        q = q_ref[0, 0]  # (bq, d)
+        k = k_ref[0, 0]  # (bk, d)
+        v = v_ref[0, 0]
+        s = _dot(q, k, trans_b=True) * cfg.scale
+        mask = _mask_for(
+            iq * bq, jk * bk, bq, bk, kv_len, offset, cfg.causal,
+            qs_ref[0] if has_segs else None,
+            ks_ref[0] if has_segs else None,
+        )
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_sc[...]                       # (bq, LANES) lane-replicated
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)          # rescale factor, <= 1
+        p = jnp.exp(s - m_new[:, :1])            # (bq, bk) f32
+        l_sc[...] = alpha * l_sc[...] + jnp.sum(p, axis=1, keepdims=True)
+        m_sc[...] = m_new
+        acc_sc[...] = acc_sc[...] * alpha[:, :1] + _dot(p.astype(v.dtype), v)
+
+    @pl.when(jk == n_k - 1)
+    def _():
+        l = l_sc[:, :1]
+        # Fully-masked rows (query padding) have l == 0; emit zeros for
+        # them instead of 0/0 NaN — the wrapper slices them off anyway.
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_sc[...] / safe_l).astype(o_ref.dtype)
+        lse_ref[0, 0] = m_sc[:, :1] + jnp.log(safe_l)
+
+
+def _flash_forward(q, k, v, segment_ids, cfg: FlashConfig):
+    """q (b, h, sq, d); k/v (b, h_kv, skv, d). Returns (o, lse)."""
+    b, h, sq, d = q.shape
+    _, h_kv, skv, _ = k.shape
+    group = h // h_kv
+    bq = min(cfg.block_q, sq)
+    bk = min(cfg.block_k, skv)
+    offset = skv - sq  # end-aligned queries (matches the XLA path)
+
+    qp = _pad_to(q, bq, 2)
+    kp = _pad_to(k, bk, 2)
+    vp = _pad_to(v, bk, 2)
+    n_q = qp.shape[2] // bq
+    n_k = kp.shape[2] // bk
+
+    in_specs = [
+        pl.BlockSpec((1, 1, bq, d), lambda ib, ih, iq, jk: (ib, ih, iq, 0)),
+        pl.BlockSpec(
+            (1, 1, bk, d), lambda ib, ih, iq, jk: (ib, ih // group, jk, 0)
+        ),
+        pl.BlockSpec(
+            (1, 1, bk, d), lambda ib, ih, iq, jk: (ib, ih // group, jk, 0)
+        ),
+    ]
+    inputs = [qp, kp, vp]
+    has_segs = segment_ids is not None
+    if has_segs:
+        # Mosaic tiling wants the last two block dims (8, 128)-aligned or
+        # full-size; orienting q segs as a (sq, 1) column and kv segs as a
+        # (1, skv) row satisfies that AND makes the in-kernel compare a
+        # plain broadcast.
+        seg = segment_ids.astype(jnp.int32)
+        inputs += [
+            _pad_to(seg[:, :, None], bq, 1),
+            _pad_to(seg[:, None, :], bk, 2),
+        ]
+        in_specs += [
+            pl.BlockSpec((1, bq, 1), lambda ib, ih, iq, jk: (ib, iq, 0)),
+            pl.BlockSpec((1, 1, bk), lambda ib, ih, iq, jk: (ib, 0, jk)),
+        ]
+
+    o, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, cfg, skv, offset, n_k, has_segs),
+        grid=(b, h, n_q, n_k),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda ib, ih, iq, jk: (ib, ih, iq, 0)),
+            pl.BlockSpec(
+                (1, 1, bq, 1), lambda ib, ih, iq, jk: (ib, ih, iq, 0)
+            ),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, n_q * bq, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, n_q * bq, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, _LANES), jnp.float32),  # running max m
+            pltpu.VMEM((bq, _LANES), jnp.float32),  # normaliser l
+            pltpu.VMEM((bq, d), jnp.float32),       # output accumulator
+        ],
+        interpret=cfg.interpret,
+    )(*inputs)
+    return o[:, :, :sq], lse[:, :, :sq]
+
+
+# --------------------------------------------------------------------------
+# backward
+# --------------------------------------------------------------------------
+
+
+def _recompute_p(cfg, q, k, lse_row, mask):
+    """Rebuild the probability tile from saved logsumexp. (bq, bk) f32."""
+    s = _dot(q, k, trans_b=True) * cfg.scale
+    s = jnp.where(mask, s, NEG_INF)
+    return jnp.exp(s - lse_row)
+
+
+def _dq_kernel(cfg, kv_len, offset, n_k, has_segs, *refs):
+    if has_segs:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qs_ref, ks_ref,
+         dq_ref, dq_sc) = refs
+    else:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_sc = refs
+        qs_ref = ks_ref = None
+    iq = pl.program_id(2)
+    jk = pl.program_id(3)
+    bq = q_ref.shape[2]
+    bk = k_ref.shape[2]
+
+    @pl.when(jk == 0)
+    def _():
+        dq_sc[...] = jnp.zeros_like(dq_sc)
+
+    run = jk * bk < kv_len
+    if cfg.causal:
+        run = jnp.logical_and(run, jk * bk <= iq * bq + (bq - 1) + offset)
+
+    @pl.when(run)
+    def _():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        mask = _mask_for(
+            iq * bq, jk * bk, bq, bk, kv_len, offset, cfg.causal,
+            qs_ref[0] if has_segs else None,
+            ks_ref[0] if has_segs else None,
+        )
+        lse_row = lse_ref[0, 0]                 # (bq, 1)
+        p = _recompute_p(cfg, q, k, lse_row, mask)
+        dp = _dot(do, v, trans_b=True)          # (bq, bk) f32
+        ds = p * (dp - delta_ref[0, 0])
+        dq_sc[...] += _dot(ds.astype(k.dtype), k) * cfg.scale
+
+    @pl.when(jk == n_k - 1)
+    def _():
+        dq_ref[0, 0] = dq_sc[...].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(cfg, kv_len, offset, group, n_q, has_segs, *refs):
+    if has_segs:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qs_ref, ks_ref,
+         dk_ref, dv_ref, dk_sc, dv_sc) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dk_sc, dv_sc) = refs
+        qs_ref = ks_ref = None
+    jk = pl.program_id(2)
+    g = pl.program_id(3)
+    iq = pl.program_id(4)
+    bq = q_ref.shape[2]
+    bk = k_ref.shape[2]
+
+    @pl.when(jnp.logical_and(g == 0, iq == 0))
+    def _():
+        dk_sc[...] = jnp.zeros_like(dk_sc)
+        dv_sc[...] = jnp.zeros_like(dv_sc)
+
+    # Padded KV columns are masked to p == 0, so only the causal predicate
+    # can skip a block here.
+    run = True
+    if cfg.causal:
+        run = jk * bk <= iq * bq + (bq - 1) + offset
+
+    @pl.when(run)
+    def _():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        mask = _mask_for(
+            iq * bq, jk * bk, bq, bk, kv_len, offset, cfg.causal,
+            qs_ref[0] if has_segs else None,
+            ks_ref[0] if has_segs else None,
+        )
+        lse_row = lse_ref[0, 0]
+        p = _recompute_p(cfg, q, k, lse_row, mask)
+        # Padded query rows carry do == 0 (the wrapper zero-pads the
+        # cotangent), so their p rows contribute nothing below.
+        dv_sc[...] += _dot(p.astype(do.dtype), do, trans_a=True)
+        dp = _dot(do, v, trans_b=True)
+        ds = p * (dp - delta_ref[0, 0])
+        dk_sc[...] += _dot(ds.astype(q.dtype), q, trans_a=True) * cfg.scale
+
+    @pl.when(jnp.logical_and(g == group - 1, iq == n_q - 1))
+    def _():
+        dk_ref[0, 0] = dk_sc[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_sc[...].astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, segment_ids, o, lse, do, cfg: FlashConfig):
+    b, h, sq, d = q.shape
+    _, h_kv, skv, _ = k.shape
+    group = h // h_kv
+    bq = min(cfg.block_q, sq)
+    bk = min(cfg.block_k, skv)
+    offset = skv - sq
+
+    # delta_i = sum_d dO_i * O_i  — one cheap fused elementwise reduce; no
+    # reason to burn a kernel on it. Trailing unit dim matches lse's
+    # Mosaic-friendly (bq, 1) tile orientation.
+    delta = jnp.sum(
+        do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True
+    )
+
+    qp = _pad_to(q, bq, 2)
+    kp = _pad_to(k, bk, 2)
+    vp = _pad_to(v, bk, 2)
+    dop = _pad_to(do, bq, 2)
+    lsep = _pad_to(lse, bq, 2)
+    deltap = _pad_to(delta, bq, 2)
+    n_q = qp.shape[2] // bq
+    n_k = kp.shape[2] // bk
+
+    has_segs = segment_ids is not None
+    seg_inputs = []
+    if has_segs:
+        seg = segment_ids.astype(jnp.int32)
+        seg_inputs = [
+            _pad_to(seg[:, :, None], bq, 1),   # (b, sq, 1) query column
+            _pad_to(seg[:, None, :], bk, 2),   # (b, 1, skv) KV row
+        ]
+
+    # ---- dq: grid (b, h, iq, jk), KV innermost --------------------------
+    dq_in_specs = [
+        pl.BlockSpec((1, 1, bq, d), lambda ib, ih, iq, jk: (ib, ih, iq, 0)),
+        pl.BlockSpec(
+            (1, 1, bk, d), lambda ib, ih, iq, jk: (ib, ih // group, jk, 0)
+        ),
+        pl.BlockSpec(
+            (1, 1, bk, d), lambda ib, ih, iq, jk: (ib, ih // group, jk, 0)
+        ),
+        pl.BlockSpec((1, 1, bq, d), lambda ib, ih, iq, jk: (ib, ih, iq, 0)),
+        pl.BlockSpec((1, 1, bq, 1), lambda ib, ih, iq, jk: (ib, ih, iq, 0)),
+        pl.BlockSpec((1, 1, bq, 1), lambda ib, ih, iq, jk: (ib, ih, iq, 0)),
+    ]
+    if has_segs:
+        dq_in_specs += [
+            pl.BlockSpec((1, bq, 1), lambda ib, ih, iq, jk: (ib, iq, 0)),
+            pl.BlockSpec((1, 1, bk), lambda ib, ih, iq, jk: (ib, 0, jk)),
+        ]
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, cfg, skv, offset, n_k, has_segs),
+        grid=(b, h, n_q, n_k),
+        in_specs=dq_in_specs,
+        out_specs=pl.BlockSpec(
+            (1, 1, bq, d), lambda ib, ih, iq, jk: (ib, ih, iq, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, h, n_q * bq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=cfg.interpret,
+    )(qp, kp, vp, dop, lsep, deltap, *seg_inputs)
+
+    # ---- dk/dv: grid (b, h_kv, jk, g, iq) — group and Q innermost so the
+    # per-KV-block accumulators sum over every query head in the group and
+    # every query block without an HBM-sized intermediate. ---------------
+    def qhead(ib, ih, jk, g, iq):
+        return (ib, ih * group + g, iq, 0)
+
+    dkv_in_specs = [
+        pl.BlockSpec((1, 1, bq, d), qhead),
+        pl.BlockSpec((1, 1, bk, d), lambda ib, ih, jk, g, iq: (ib, ih, jk, 0)),
+        pl.BlockSpec((1, 1, bk, d), lambda ib, ih, jk, g, iq: (ib, ih, jk, 0)),
+        pl.BlockSpec((1, 1, bq, d), qhead),
+        pl.BlockSpec((1, 1, bq, 1), qhead),
+        pl.BlockSpec((1, 1, bq, 1), qhead),
+    ]
+    if has_segs:
+        dkv_in_specs += [
+            pl.BlockSpec((1, bq, 1), lambda ib, ih, jk, g, iq: (ib, iq, 0)),
+            pl.BlockSpec((1, 1, bk), lambda ib, ih, jk, g, iq: (ib, 0, jk)),
+        ]
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, cfg, skv, offset, group, n_q, has_segs),
+        grid=(b, h_kv, n_k, group, n_q),
+        in_specs=dkv_in_specs,
+        out_specs=[
+            pl.BlockSpec(
+                (1, 1, bk, d), lambda ib, ih, jk, g, iq: (ib, ih, jk, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, bk, d), lambda ib, ih, jk, g, iq: (ib, ih, jk, 0)
+            ),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h_kv, n_k * bk, d), k.dtype),
+            jax.ShapeDtypeStruct((b, h_kv, n_k * bk, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+        ],
+        interpret=cfg.interpret,
+    )(qp, kp, vp, dop, lsep, deltap, *seg_inputs)
+
+    return dq[:, :, :sq], dk[:, :, :skv], dv[:, :, :skv]
+
+
+# --------------------------------------------------------------------------
+# custom-vjp wrapper
+# --------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def _flash(q, k, v, segment_ids, cfg: FlashConfig):
+    o, _ = _flash_forward(q, k, v, segment_ids, cfg)
+    return o
+
+
+def _flash_fwd(q, k, v, segment_ids, cfg):
+    o, lse = _flash_forward(q, k, v, segment_ids, cfg)
+    return o, (q, k, v, segment_ids, o, lse)
+
+
+def _flash_bwd(cfg, residuals, do):
+    q, k, v, segment_ids, o, lse = residuals
+    dq, dk, dv = _flash_backward(q, k, v, segment_ids, o, lse, do, cfg)
+    return dq, dk, dv, None
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q,
+    k,
+    v,
+    *,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    segment_ids: Optional[jax.Array] = None,
+    block_q: int = 512,
+    block_k: int = 1024,
+    interpret: Optional[bool] = None,
+):
+    """Flash attention with the dot_product_attention layout/semantics.
+
+    Args:
+      q: (batch, q_len, num_heads, head_dim).
+      k, v: (batch, kv_len, num_kv_heads, head_dim); num_heads must divide
+        evenly over num_kv_heads.
+      causal: causal mask, queries end-aligned to the KV axis.
+      scale: score scale; defaults to head_dim ** -0.5.
+      segment_ids: optional (batch, seq) int segments for packed sequences;
+        requires q_len == kv_len (same contract as the XLA path).
+      block_q, block_k: tile sizes (clamped to the sequence lengths).
+      interpret: force pallas interpret mode; default: interpret unless
+        running on TPU (so CPU tests exercise the same kernel code).
+
+    Returns:
+      (batch, q_len, num_heads, head_dim) in q.dtype.
+    """
+    b, sq, h, d = q.shape
+    _, skv, h_kv, _ = k.shape
+    if h % h_kv:
+        raise ValueError(f"num_heads={h} not divisible by kv={h_kv}")
+    if segment_ids is not None and sq != skv:
+        raise ValueError("segment_ids requires q_len == kv_len")
+    cfg = FlashConfig(
+        causal=causal,
+        scale=float(scale) if scale is not None else d**-0.5,
+        block_q=block_q,
+        block_k=block_k,
+        interpret=(
+            interpret
+            if interpret is not None
+            else jax.default_backend() != "tpu"
+        ),
+    )
+    # Kernel-native layout: heads outside the sequence axis so each grid
+    # step addresses one contiguous (seq_block, head_dim) tile.
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    o = _flash(qt, kt, vt, segment_ids, cfg)
+    return jnp.swapaxes(o, 1, 2)
