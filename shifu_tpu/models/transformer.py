@@ -53,7 +53,9 @@ class TransformerConfig:
     tie_embeddings: bool = False
     z_loss: float = 1e-4
     remat: bool = True  # rematerialise each block in the backward pass
-    attn_impl: str = "xla"  # "xla" | "flash" (pallas TPU kernel)
+    # "xla" | "flash" (pallas TPU kernel) | "ring" (sp sequence
+    # parallelism; falls back to xla off-mesh — ops.attention docstring)
+    attn_impl: str = "xla"
 
     @property
     def resolved_head_dim(self) -> int:

@@ -49,7 +49,11 @@ def dot_product_attention(
       scale: score scale; defaults to head_dim ** -0.5.
       segment_ids: optional (batch, kv_len) int array for packed sequences;
         tokens only attend within their segment. Requires q_len == kv_len.
-      impl: "xla" (this file) or "flash" (pallas TPU kernel).
+      impl: "xla" (this file), "flash" (pallas TPU kernel), or "ring"
+        (sequence-parallel ring over the sp mesh axis; needs an active
+        activation_sharding context with sp > 1 and mesh-divisible
+        shapes — see parallel.ring.ring_shardable — else it silently
+        falls back to the O(S^2)-memory XLA path).
 
     Returns:
       (batch, q_len, num_heads, head_dim) in q.dtype.
@@ -60,6 +64,24 @@ def dot_product_attention(
         return flash_attention(
             q, k, v, causal=causal, scale=scale, segment_ids=segment_ids
         )
+    if impl == "ring":
+        # Sequence-parallel ring attention over the sp mesh axis. Needs an
+        # active activation_sharding context to discover the mesh; falls
+        # back to the XLA path when there is no sp sharding to ride or the
+        # shapes don't divide the mesh (ring_shardable).
+        from shifu_tpu.parallel.ctx import current_env
+        from shifu_tpu.parallel.ring import (
+            ring_attention_sharded,
+            ring_shardable,
+        )
+
+        env = current_env()
+        if env is not None and ring_shardable(env.mesh, q.shape, k.shape):
+            return ring_attention_sharded(
+                q, k, v, env.mesh, causal=causal, scale=scale,
+                segment_ids=segment_ids,
+            )
+        impl = "xla"
     if impl != "xla":
         raise ValueError(f"unknown attention impl: {impl!r}")
 

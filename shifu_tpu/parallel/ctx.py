@@ -47,6 +47,16 @@ def activation_sharding(mesh: Mesh, rules: Mapping = DEFAULT_RULES):
         _env.reset(token)
 
 
+def current_env() -> Optional[_ActEnv]:
+    """The active (mesh, rules) pair, or None outside activation_sharding.
+
+    Lets ops discover the mesh during tracing (e.g. the ring-attention
+    dispatch needs it to build a shard_map) without threading the mesh
+    through every model signature.
+    """
+    return _env.get()
+
+
 def constrain(x: jax.Array, logical: Sequence[Optional[str]]) -> jax.Array:
     """Pin ``x``'s sharding by logical axis names; identity without context.
 

@@ -1,0 +1,248 @@
+"""Ring attention: sequence/context parallelism over the ``sp`` mesh axis.
+
+Long-context attention where no device ever holds the full KV: the
+sequence is sharded over ``sp``, queries stay put, and K/V chunks rotate
+around the ring via ``jax.lax.ppermute`` while each device folds every
+visiting chunk into an online softmax (the same running (m, l, acc)
+recurrence the flash kernel uses, here across devices instead of across
+VMEM blocks). Peak per-device attention memory is O(S/P * S/P) scores and
+O(S/P) KV — sequence length scales linearly with the ring size.
+
+TPU mapping: ppermute between ring neighbours rides the ICI torus, and
+because the ppermute of the *current* chunk and the attention compute on
+it have no data dependency, XLA's latency-hiding scheduler overlaps the
+transfer with the matmuls — the classic ring-attention compute/comm
+overlap falls out of the dataflow with no manual double buffering.
+
+Gradients flow through ``lax.scan`` + ``ppermute`` by plain autodiff
+(ppermute's transpose is the inverse rotation); the scan body is
+rematerialised per ring step so the backward never stores P score
+matrices at once.
+
+Causal note: with contiguous sequence chunks, device i skips chunks
+j > i entirely (the `run` predicate), so late ring steps idle for early
+devices — the classic causal imbalance. The striped/zigzag layout that
+fixes it changes the data layout contract; see striped_offsets() for the
+planned extension.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from shifu_tpu.ops.attention import NEG_INF
+
+
+def _partial_attention(q, k, v, bias, scale):
+    """Unnormalised blockwise attention with GQA.
+
+    q: (b, sq, h, d); k/v: (b, sk, h_kv, d); bias: (b, sq, sk) additive.
+    Returns (acc, m, l): acc (b, sq, h, d) f32 = sum_j exp(s - m) v;
+    m, l (b, sq, h) f32 row max / normaliser.
+    """
+    b, sq, h, d = q.shape
+    _, sk, h_kv, _ = k.shape
+    group = h // h_kv
+    qg = q.reshape(b, sq, h_kv, group, d)
+    s = jnp.einsum(
+        "bqhgd,bkhd->bqhgk", qg, k, preferred_element_type=jnp.float32
+    ) * scale
+    s = s + bias[:, :, None, None, :]
+    m = jnp.max(s, axis=-1)                          # (b, sq, h_kv, g)
+    p = jnp.exp(s - m[..., None])
+    l = jnp.sum(p, axis=-1)
+    acc = jnp.einsum(
+        "bqhgk,bkhd->bqhgd", p.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    )
+    return (
+        acc.reshape(b, sq, h, d),
+        m.reshape(b, sq, h),
+        l.reshape(b, sq, h),
+    )
+
+
+def ring_attention(
+    q,
+    k,
+    v,
+    *,
+    axis_name: str = "sp",
+    causal: bool = True,
+    scale: Optional[float] = None,
+    segment_ids: Optional[jax.Array] = None,
+):
+    """Per-shard ring attention; call inside shard_map over ``axis_name``.
+
+    Args (all local shards; the sequence axis is sharded over the ring):
+      q: (b, s_local, h, d).
+      k, v: (b, s_local, h_kv, d).
+      causal: causal mask over *global* positions (contiguous chunks:
+        device i holds positions [i*s_local, (i+1)*s_local)).
+      scale: score scale; defaults to head_dim ** -0.5.
+      segment_ids: optional local (b, s_local) packing segments; the KV
+        segment shard travels around the ring with its chunk.
+
+    Returns: (b, s_local, h, d) in q.dtype.
+    """
+    axis_size = jax.lax.axis_size(axis_name)
+    my = jax.lax.axis_index(axis_name)
+    b, s_local, h, d = q.shape
+    if scale is None:
+        scale = d**-0.5
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    q_pos = my * s_local + jnp.arange(s_local)       # global query positions
+
+    def fold(m, l, acc, k_cur, v_cur, ks_cur, t):
+        """Merge one visiting KV chunk into the running (m, l, acc)."""
+        src = (my - t) % axis_size                   # chunk's home device
+        kv_pos = src * s_local + jnp.arange(s_local)
+
+        # Combine masks as booleans and apply NEG_INF exactly once: adding
+        # two NEG_INF biases would overflow f32 to -inf, and a fully-masked
+        # row then hits exp((-inf) - (-inf)) = NaN in _partial_attention.
+        allowed = jnp.ones((b, s_local, s_local), bool)
+        if causal:
+            allowed = jnp.logical_and(
+                allowed, (kv_pos[None, :] <= q_pos[:, None])[None]
+            )
+        if segment_ids is not None:
+            allowed = jnp.logical_and(
+                allowed, segment_ids[:, :, None] == ks_cur[:, None, :]
+            )
+        bias = jnp.where(allowed, 0.0, NEG_INF)
+
+        # Entirely-masked chunks (causal, src chunk strictly in the
+        # future) contribute m_t == NEG_INF everywhere; the exp() terms
+        # below zero them out, so no explicit skip is needed for
+        # correctness — XLA still does the matmuls, which is the causal
+        # imbalance documented in the module docstring.
+        acc_t, m_t, l_t = _partial_attention(q, k_cur, v_cur, bias, scale)
+        m_new = jnp.maximum(m, m_t)
+        a_old = jnp.exp(m - m_new)
+        a_new = jnp.exp(m_t - m_new)
+        acc = acc * a_old[..., None] + acc_t * a_new[..., None]
+        l = l * a_old + l_t * a_new
+        return m_new, l, acc
+
+    def step(carry, t):
+        k_cur, v_cur, ks_cur, m, l, acc = carry
+        m, l, acc = fold(m, l, acc, k_cur, v_cur, ks_cur, t)
+        k_nxt, v_nxt, ks_nxt = jax.lax.ppermute(
+            (k_cur, v_cur, ks_cur), axis_name, perm
+        )
+        return (k_nxt, v_nxt, ks_nxt, m, l, acc), None
+
+    m0 = jnp.full((b, s_local, h), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, s_local, h), jnp.float32)
+    acc0 = jnp.zeros((b, s_local, h, d), jnp.float32)
+    ks0 = (
+        segment_ids
+        if segment_ids is not None
+        # Dummy so the carry structure is static; never read. (Cost: one
+        # (b, s_local) int32 per hop — noise next to the K/V payload.)
+        else jnp.zeros((b, s_local), jnp.int32)
+    )
+    # Scan the first P-1 steps (each rotates KV onward); the final chunk
+    # folds outside the scan with no trailing ppermute — that last
+    # rotation would be pure wasted ICI traffic. Both parts recompute in
+    # the backward (checkpoint) so P score matrices never coexist.
+    carry = (k, v, ks0, m0, l0, acc0)
+    if axis_size > 1:
+        carry, _ = jax.lax.scan(
+            jax.checkpoint(step), carry, jnp.arange(axis_size - 1)
+        )
+    k_l, v_l, ks_l, m, l, acc = carry
+    m, l, acc = jax.checkpoint(fold)(
+        m, l, acc, k_l, v_l, ks_l, jnp.int32(axis_size - 1)
+    )
+    # A query sees every key exactly once around the ring, so for causal
+    # self-attention l >= 1 always (each query attends at least itself);
+    # fully-masked rows under adversarial segment ids degenerate to the
+    # uniform softmax over NEG_INF scores (l = S, mean-of-v) — the same
+    # thing the XLA reference computes. No zero-division guard is needed.
+    return (acc / l[..., None]).astype(q.dtype)
+
+
+def ring_shardable(
+    mesh: Mesh,
+    q_shape,
+    kv_shape,
+    *,
+    batch_axes=("dp", "fsdp"),
+    seq_axis: str = "sp",
+    head_axis: str = "tp",
+) -> bool:
+    """Whether ring_attention_sharded's shard_map specs admit these shapes.
+
+    Lives beside the specs so the eligibility rule and the axis mapping
+    can't drift apart. shard_map is strict — every mapped dim must divide
+    evenly (no per-dim replication fallback like ctx.constrain has) — and
+    the ring additionally needs self-attention (q_len == kv_len).
+    """
+    if mesh.shape.get(seq_axis, 1) <= 1:
+        return False
+    dp_sz = 1
+    for a in batch_axes:
+        dp_sz *= mesh.shape.get(a, 1)
+    sp_sz = mesh.shape[seq_axis]
+    tp_sz = mesh.shape.get(head_axis, 1)
+    b, sq, h, _ = q_shape
+    _, skv, h_kv, _ = kv_shape
+    return (
+        sq == skv
+        and b % dp_sz == 0
+        and sq % sp_sz == 0
+        and h % tp_sz == 0
+        and h_kv % tp_sz == 0
+    )
+
+
+def ring_attention_sharded(
+    q,
+    k,
+    v,
+    mesh: Mesh,
+    *,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    segment_ids: Optional[jax.Array] = None,
+    batch_axes=("dp", "fsdp"),
+    seq_axis: str = "sp",
+    head_axis: str = "tp",
+):
+    """shard_map wrapper: global (b, s, h, d) arrays → ring attention.
+
+    Batch rides dp/fsdp, sequence rides sp (the ring), heads ride tp —
+    attention is per-head so the tp split needs no collective here; only
+    sp communicates (neighbour ppermute on the ICI torus).
+    """
+    qspec = P(batch_axes, seq_axis, head_axis, None)
+    sspec = P(batch_axes, seq_axis)
+    in_specs = (qspec, qspec, qspec)
+    args = (q, k, v)
+    if segment_ids is not None:
+        in_specs += (sspec,)
+        args += (segment_ids,)
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=qspec,
+        check_vma=False,
+    )
+    def mapped(q, k, v, *rest):
+        segs = rest[0] if rest else None
+        return ring_attention(
+            q, k, v, axis_name=seq_axis, causal=causal, scale=scale,
+            segment_ids=segs,
+        )
+
+    return mapped(*args)
