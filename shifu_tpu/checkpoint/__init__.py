@@ -1,0 +1,19 @@
+"""Checkpoint / resume subsystem.
+
+Sharding-aware save + restore of the full training state, built on orbax
+(the TPU-native checkpoint stack): every host writes only its own parameter
+shards, restore places each shard directly onto its owning devices (no
+host-side full copy), and saves run asynchronously so the step loop is not
+blocked on HBM->disk transfers.
+
+Reference parity note: the upstream reference (klyan/shifu) is an empty
+repository (SURVEY.md) — there is no reference checkpoint format to match.
+The format here is orbax's standard OCDBT + zarr3 layout.
+"""
+
+from shifu_tpu.checkpoint.checkpointer import (
+    Checkpointer,
+    abstract_train_state,
+)
+
+__all__ = ["Checkpointer", "abstract_train_state"]

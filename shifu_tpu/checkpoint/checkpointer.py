@@ -1,0 +1,169 @@
+"""Sharding-aware checkpointing on orbax.
+
+Two pieces:
+
+  * :func:`abstract_train_state` — builds the restore *template*: a
+    TrainState-shaped tree of ``jax.ShapeDtypeStruct`` leaves carrying
+    NamedShardings (when a mesh is given). Restoring against the template
+    materialises every weight directly into its shards — the checkpoint can
+    be larger than any single host's memory.
+  * :class:`Checkpointer` — thin lifecycle wrapper over
+    ``orbax.checkpoint.CheckpointManager``: async saves, retention,
+    save-interval gating, and a JSON side-channel for host state (data
+    iterator position, python RNG, config fingerprints, ...).
+
+Design choices (TPU-first):
+  * Saves are async by default: the save() call snapshots device buffers to
+    host memory and returns; serialisation/writes overlap the next steps.
+    ``wait()`` (or ``close()``) joins the writer — call before process exit.
+  * The train step counter lives *inside* the state (TrainState.opt["step"]),
+    so "which step is this checkpoint" is read off the state itself; the
+    manager's step index is only a directory label.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Mapping, Optional
+
+import jax
+import jax.numpy as jnp
+import orbax.checkpoint as ocp
+
+from shifu_tpu.parallel import sharding as shd
+from shifu_tpu.train.step import TrainState
+
+
+def abstract_train_state(model, mesh=None, rules=shd.DEFAULT_RULES):
+    """TrainState template of ShapeDtypeStructs for sharded restore.
+
+    Mirrors exactly what ``create_sharded_state(model, AdamW(), ...)``
+    produces: f32 moments shaped like params, an i32 scalar step. With
+    ``mesh=None`` the leaves carry no sharding (single-process restore).
+    """
+    specs = model.specs()
+    is_spec = lambda x: hasattr(x, "shape") and hasattr(x, "axes")
+
+    if mesh is not None:
+        scalar = jax.sharding.NamedSharding(
+            mesh, jax.sharding.PartitionSpec()
+        )
+
+        def sharding_of(s):
+            return jax.sharding.NamedSharding(
+                mesh, shd.spec_for(s.shape, s.axes, mesh, rules)
+            )
+    else:
+        scalar = None
+        sharding_of = lambda s: None
+
+    def tmpl(dtype_override=None):
+        return jax.tree_util.tree_map(
+            lambda s: jax.ShapeDtypeStruct(
+                s.shape, dtype_override or s.dtype, sharding=sharding_of(s)
+            ),
+            specs,
+            is_leaf=is_spec,
+        )
+
+    opt = {
+        "mu": tmpl(jnp.float32),
+        "nu": tmpl(jnp.float32),
+        "step": jax.ShapeDtypeStruct((), jnp.int32, sharding=scalar),
+    }
+    return TrainState(params=tmpl(), opt=opt)
+
+
+class Checkpointer:
+    """Manage a directory of step-indexed checkpoints.
+
+    Usage::
+
+        ckpt = Checkpointer(dir, max_to_keep=3, save_interval_steps=1000)
+        ckpt.save(step, state, host_state={"batches_seen": n})   # async
+        ...
+        template = abstract_train_state(model, mesh)
+        state, host = ckpt.restore(template)                      # latest
+        ckpt.close()
+    """
+
+    def __init__(
+        self,
+        directory: str,
+        *,
+        max_to_keep: Optional[int] = 3,
+        save_interval_steps: int = 1,
+        async_save: bool = True,
+    ):
+        options = ocp.CheckpointManagerOptions(
+            max_to_keep=max_to_keep,
+            save_interval_steps=save_interval_steps,
+            enable_async_checkpointing=async_save,
+        )
+        self._mgr = ocp.CheckpointManager(
+            os.path.abspath(directory), options=options
+        )
+
+    # ------------------------------------------------------------------ save
+    def save(
+        self,
+        step: int,
+        state: TrainState,
+        host_state: Optional[Mapping[str, Any]] = None,
+        *,
+        force: bool = False,
+    ) -> bool:
+        """Queue a checkpoint. Returns False when gated by the interval."""
+        return self._mgr.save(
+            int(step),
+            args=ocp.args.Composite(
+                state=ocp.args.StandardSave(state),
+                host=ocp.args.JsonSave(dict(host_state or {})),
+            ),
+            force=force,
+        )
+
+    # --------------------------------------------------------------- restore
+    def restore(self, template: TrainState, step: Optional[int] = None):
+        """Restore (state, host_state) at ``step`` (default: latest).
+
+        ``template`` is a concrete TrainState or the output of
+        :func:`abstract_train_state`; leaf shardings (when present) place
+        shards straight onto devices.
+        """
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise FileNotFoundError(
+                    f"no checkpoint found in {self._mgr.directory}"
+                )
+        out = self._mgr.restore(
+            int(step),
+            args=ocp.args.Composite(
+                state=ocp.args.StandardRestore(template),
+                host=ocp.args.JsonRestore(),
+            ),
+        )
+        return out["state"], out["host"]
+
+    # ------------------------------------------------------------- inventory
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def all_steps(self):
+        return sorted(self._mgr.all_steps())
+
+    # -------------------------------------------------------------- lifecycle
+    def wait(self):
+        """Block until queued async saves are durable on disk."""
+        self._mgr.wait_until_finished()
+
+    def close(self):
+        self._mgr.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
