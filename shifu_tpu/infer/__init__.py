@@ -1,0 +1,12 @@
+"""Inference stack: samplers + a jitted batched generation loop.
+
+Reference parity note: the upstream reference (klyan/shifu) is an empty
+repository (SURVEY.md) — there is no reference decoding API to match; this
+is the standard prefill + KV-cache decode design, TPU-first (static shapes,
+``lax.while_loop`` decode, whole loop under one jit).
+"""
+
+from shifu_tpu.infer.sampling import SampleConfig, sample_logits
+from shifu_tpu.infer.generate import generate, make_generate_fn
+
+__all__ = ["SampleConfig", "sample_logits", "generate", "make_generate_fn"]

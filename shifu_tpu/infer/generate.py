@@ -1,0 +1,152 @@
+"""Batched autoregressive generation with a preallocated KV cache.
+
+Shape discipline (everything static under jit):
+
+  * prompts arrive RIGHT-padded to a common length P; per-example true
+    lengths ride alongside. Prefill runs one forward over all P slots and
+    the first token is sampled from each row's ``lengths-1`` logit.
+  * decode is a ``lax.while_loop`` feeding one token per step into cache
+    slot ``P + t`` while the token's RoPE position is its *token-space*
+    index ``lengths + t`` — slot-space causality plus a static ``kv_mask``
+    (hide the prompt's padding slots) makes ragged batches exact, not
+    approximate.
+  * the loop exits early once every row has emitted EOS; the output buffer
+    is preallocated at ``max_new_tokens`` and padded with ``pad_id``.
+
+The whole thing — prefill, loop, sampling — is ONE jitted function from
+:func:`make_generate_fn`; nothing re-traces per step or per call.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from shifu_tpu.infer.sampling import SampleConfig, sample_logits
+
+
+def make_generate_fn(
+    model,
+    *,
+    max_new_tokens: int,
+    sample_cfg: SampleConfig = SampleConfig(),
+    eos_id: Optional[int] = None,
+    pad_id: int = 0,
+    cache_dtype=jnp.bfloat16,
+):
+    """Build a jitted ``fn(params, prompts, lengths, rng) -> dict``.
+
+    Args:
+      model: a Transformer-family module (needs ``__call__`` with
+        cache/cache_index/kv_mask and ``init_cache``).
+      max_new_tokens: static decode budget; output buffer size.
+      sample_cfg: static sampler settings.
+      eos_id: stop a row once it emits this token (None = never stop early).
+      pad_id: fills output rows after EOS and dead prompt slots.
+
+    Returns a function with:
+      prompts: (batch, P) int32, right-padded with anything (pad slots are
+        masked out of attention entirely).
+      lengths: (batch,) int32 true prompt lengths, 1 <= lengths <= P.
+      rng: jax PRNG key.
+      -> {"tokens": (batch, max_new_tokens) int32 (eos kept, then pad_id),
+          "lengths": (batch,) int32 generated-token counts (incl. eos)}
+    """
+    eos = -1 if eos_id is None else eos_id
+
+    @jax.jit
+    def fn(params, prompts, lengths, rng):
+        b, prompt_len = prompts.shape
+        total = prompt_len + max_new_tokens
+        cache = model.init_cache(b, total, dtype=cache_dtype)
+
+        # Cache slots a decode query may see: real prompt tokens plus the
+        # generated region (slot-space causality bounds the latter per step).
+        slot = jnp.arange(total)[None, :]
+        kv_mask = (slot < lengths[:, None]) | (slot >= prompt_len)
+
+        # ---- prefill: all prompt slots in one forward; unembed only the
+        # last real position per row (logits_at skips the (b, P, vocab)
+        # logits nobody reads).
+        logits, cache = model(
+            params, prompts, cache=cache, cache_index=0,
+            logits_at=lengths - 1,
+        )
+        rng, sub = jax.random.split(rng)
+        cur = sample_logits(logits[:, 0], sub, sample_cfg)
+
+        out = jnp.full((b, max_new_tokens), pad_id, jnp.int32)
+        done = jnp.zeros((b,), bool)
+        gen_len = jnp.full((b,), max_new_tokens, jnp.int32)
+
+        # ---- decode loop ------------------------------------------------
+        def cond(carry):
+            t, _, done, _, _, _, _ = carry
+            return (t < max_new_tokens) & ~jnp.all(done)
+
+        def body(carry):
+            t, cur, done, gen_len, out, cache, rng = carry
+            # Emit this step's token (pad for rows that finished earlier).
+            out = jax.lax.dynamic_update_slice(
+                out, jnp.where(done, pad_id, cur)[:, None], (0, t)
+            )
+            now_done = done | (cur == eos)
+            gen_len = jnp.where(now_done & ~done, t + 1, gen_len)
+
+            def step_fwd(cur, cache, rng):
+                # One decode forward: slot prompt_len + t, token-space
+                # position lengths + t.
+                positions = (lengths + t)[:, None]
+                logits, cache = model(
+                    params,
+                    cur[:, None],
+                    positions=positions,
+                    cache=cache,
+                    cache_index=prompt_len + t,
+                    kv_mask=kv_mask,
+                )
+                rng, sub = jax.random.split(rng)
+                nxt = sample_logits(logits[:, -1], sub, sample_cfg)
+                return jnp.where(now_done, pad_id, nxt), cache, rng
+
+            def skip_fwd(cur, cache, rng):
+                return cur, cache, rng
+
+            # The token just emitted was the last one anybody needs either
+            # when the budget is exhausted or when every row is done — skip
+            # the (discarded) forward in that case.
+            cur, cache, rng = jax.lax.cond(
+                (t + 1 < max_new_tokens) & ~jnp.all(now_done),
+                step_fwd, skip_fwd, cur, cache, rng,
+            )
+            return (t + 1, cur, now_done, gen_len, out, cache, rng)
+
+        _, _, _, gen_len, out, _, _ = jax.lax.while_loop(
+            cond, body, (jnp.int32(0), cur, done, gen_len, out, cache, rng)
+        )
+        return {"tokens": out, "lengths": gen_len}
+
+    return fn
+
+
+def generate(
+    model,
+    params,
+    prompts,
+    lengths=None,
+    *,
+    max_new_tokens: int,
+    rng=None,
+    **kwargs,
+):
+    """One-shot convenience wrapper (compiles per call shape — use
+    :func:`make_generate_fn` in serving loops)."""
+    prompts = jnp.asarray(prompts, jnp.int32)
+    if lengths is None:
+        lengths = jnp.full((prompts.shape[0],), prompts.shape[1], jnp.int32)
+    if rng is None:
+        rng = jax.random.key(0)
+    fn = make_generate_fn(model, max_new_tokens=max_new_tokens, **kwargs)
+    return fn(params, prompts, jnp.asarray(lengths, jnp.int32), rng)

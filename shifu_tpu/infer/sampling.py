@@ -1,0 +1,79 @@
+"""Token samplers.
+
+One functional entry point, ``sample_logits(logits, rng, cfg)``, fully
+jit-compatible: every branch is decided by *static* config fields, so a
+given :class:`SampleConfig` compiles to a single fused program (no
+data-dependent control flow).
+
+Filters compose in the conventional order: temperature -> top-k -> top-p ->
+categorical sample. ``temperature == 0`` is greedy argmax (filters are
+irrelevant and skipped).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from shifu_tpu.ops.attention import NEG_INF
+
+
+@dataclasses.dataclass(frozen=True)
+class SampleConfig:
+    """Static sampling hyperparameters (hashable — safe to close over jit).
+
+    temperature: 0.0 = greedy argmax; otherwise logits /= temperature.
+    top_k: keep only the k highest-probability tokens.
+    top_p: nucleus sampling — keep the smallest prefix of the
+      probability-sorted vocab whose mass reaches top_p. The first token
+      crossing the threshold is kept (standard inclusive convention), so
+      top_p -> 0 degrades to greedy, never to an empty support.
+    """
+
+    temperature: float = 1.0
+    top_k: Optional[int] = None
+    top_p: Optional[float] = None
+
+    def __post_init__(self):
+        if self.temperature < 0:
+            raise ValueError(f"temperature must be >= 0, got {self.temperature}")
+        if self.top_k is not None and self.top_k < 1:
+            raise ValueError(f"top_k must be >= 1, got {self.top_k}")
+        if self.top_p is not None and not (0.0 < self.top_p <= 1.0):
+            raise ValueError(f"top_p must be in (0, 1], got {self.top_p}")
+
+
+def _apply_top_k(logits, k: int):
+    """Mask all but the k largest logits per row."""
+    kth = jax.lax.top_k(logits, k)[0][..., -1:]
+    return jnp.where(logits >= kth, logits, NEG_INF)
+
+
+def _apply_top_p(logits, p: float):
+    """Nucleus filter: keep the smallest probability-sorted prefix >= p."""
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    # Exclusive cumulative mass BEFORE each token: token i survives iff the
+    # mass of strictly-better tokens is < p (inclusive-crossing convention).
+    cum = jnp.cumsum(probs, axis=-1) - probs
+    keep_sorted = cum < p
+    # Map the per-rank keep decision back to vocab order via the threshold
+    # logit: the smallest kept logit.
+    kept = jnp.where(keep_sorted, sorted_logits, jnp.inf)
+    threshold = jnp.min(kept, axis=-1, keepdims=True)
+    return jnp.where(logits >= threshold, logits, NEG_INF)
+
+
+def sample_logits(logits, rng, cfg: SampleConfig = SampleConfig()):
+    """Sample token ids from (..., vocab) logits. Returns (...,) int32."""
+    if cfg.temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits.astype(jnp.float32) / cfg.temperature
+    if cfg.top_k is not None and cfg.top_k < logits.shape[-1]:
+        logits = _apply_top_k(logits, cfg.top_k)
+    if cfg.top_p is not None and cfg.top_p < 1.0:
+        logits = _apply_top_p(logits, cfg.top_p)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)

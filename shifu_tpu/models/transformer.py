@@ -169,7 +169,10 @@ class Transformer(Module):
         return s
 
     # ------------------------------------------------------------- one block
-    def _block(self, p, h, sin, cos, segment_ids, cache_slice, cache_index):
+    def _block(
+        self, p, h, sin, cos, segment_ids, cache_slice, cache_index,
+        kv_mask=None,
+    ):
         """One transformer block. ``p`` holds per-layer (unstacked) params.
 
         Returns (h, new_cache_slice); cache_slice is None outside decode.
@@ -197,13 +200,32 @@ class Transformer(Module):
                 cache_slice["v"], v.astype(cache_slice["v"].dtype),
                 (0, cache_index, 0, 0),
             )
-            # Mask out cache positions beyond the current index by masking
-            # scores via an explicit validity trick: positions > index hold
-            # zeros-from-init; causal mask with end-alignment cannot be used
-            # because the cache is longer than (index + q_len). Instead we
-            # attend over the first (index + q_len) entries using a causal
-            # mask built for the full cache length with query offset.
-            attn = _decode_attention(q, ck, cv, cache_index, cfg.attn_impl)
+            if (
+                q.shape[1] > 1
+                and kv_mask is None
+                and type(cache_index) is int
+                and cache_index == 0
+            ):
+                # Prefill from an empty cache: the only valid keys are this
+                # call's own k/v, so attend locally through the real
+                # attention dispatch (flash kernel for long prompts) rather
+                # than scoring against the whole preallocated cache. Only
+                # valid without kv_mask — i.e. right-padded prompts, where
+                # causality already hides the tail from every real query;
+                # with a mask (left-padding/holes) fall through to the
+                # masked cache path below.
+                attn = dot_product_attention(
+                    q, k, v, causal=True, impl=cfg.attn_impl
+                )
+            else:
+                # Single-token decode (or chunked prefill at a traced
+                # offset): score against the cache. Positions > index hold
+                # zeros-from-init; causal mask with end-alignment cannot be
+                # used because the cache is longer than (index + q_len), so
+                # the mask is built in slot space with a query offset.
+                attn = _decode_attention(
+                    q, ck, cv, cache_index, cfg.attn_impl, kv_mask=kv_mask
+                )
             new_cache = {"k": ck, "v": cv}
 
         h = h + jnp.einsum("bshk,hkd->bsd", attn, p["wo"])
@@ -226,6 +248,8 @@ class Transformer(Module):
         segment_ids=None,
         cache=None,
         cache_index=None,
+        kv_mask=None,
+        logits_at=None,
     ):
         """Compute logits.
 
@@ -237,6 +261,14 @@ class Transformer(Module):
           segment_ids: optional (batch, seq) packing segments.
           cache: optional KV cache pytree from ``self.init_cache`` (decode).
           cache_index: int32 scalar — write offset into the cache.
+          kv_mask: optional (batch, max_seq_len) bool — cache slots a query
+            may attend (on top of slot-space causality). Used by the
+            generation stack to hide right-padding written during prefill
+            of ragged prompts. Decode path only.
+          logits_at: optional (batch,) int32 — compute logits only at this
+            one position per row. Skips the (batch, seq, vocab) unembed on
+            prefill, where just the last real token's logits feed the
+            sampler; returned logits are (batch, 1, vocab).
 
         Returns:
           (logits, new_cache) if cache is not None else logits.
@@ -248,6 +280,13 @@ class Transformer(Module):
                 "segment_ids with a KV cache is not supported: the decode "
                 "path has no packed-segment masking, and silently ignoring "
                 "packing would leak attention across sequences"
+            )
+        if cache is None and kv_mask is not None:
+            raise ValueError(
+                "kv_mask is a decode-path (cache) concept — cache slots a "
+                "query may attend. On the no-cache forward it would be "
+                "silently ignored; mask padding there via segment_ids or a "
+                "loss mask instead"
             )
         p = self.policy.cast_to_compute(params)
         b, s = tokens.shape
@@ -280,13 +319,16 @@ class Transformer(Module):
             def body(carry, xs):
                 layer_p, cache_slice = xs
                 out, new_slice = block(
-                    layer_p, carry, sin, cos, None, cache_slice, cache_index
+                    layer_p, carry, sin, cos, None, cache_slice, cache_index,
+                    kv_mask,
                 )
                 return out, new_slice
 
             h, new_cache = jax.lax.scan(body, h, (p["blocks"], cache))
 
         h = rms_norm(h, p["final_norm"], eps=cfg.norm_eps)
+        if logits_at is not None:
+            h = jnp.take_along_axis(h, logits_at[:, None, None], axis=1)
         if cfg.tie_embeddings:
             logits = jnp.einsum("bsd,vd->bsv", h, p["embed"])
         else:
@@ -339,10 +381,12 @@ class Transformer(Module):
         return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 
-def _decode_attention(q, ck, cv, cache_index, impl):
+def _decode_attention(q, ck, cv, cache_index, impl, kv_mask=None):
     """Attention over a preallocated cache: valid keys are [0, index + q_len).
 
-    Queries sit at absolute positions index .. index + q_len - 1.
+    Queries sit at cache slots index .. index + q_len - 1 (slot-space
+    causality). ``kv_mask`` (batch, s_max) additionally hides slots that
+    hold no real token (right-padding of ragged prompts).
     """
     del impl  # decode is tiny; XLA path is optimal (no S×S materialisation)
     b, q_len, n_heads, head_dim = q.shape
@@ -354,8 +398,16 @@ def _decode_attention(q, ck, cv, cache_index, impl):
     ) * (head_dim**-0.5)
     qi = cache_index + jnp.arange(q_len)[:, None]
     kj = jnp.arange(s_max)[None, :]
-    mask = jnp.where(kj <= qi, 0.0, NEG_INF)
+    valid = kj <= qi  # (q_len, s_max)
+    if kv_mask is not None:
+        valid = valid[None] & kv_mask[:, None, :]  # (b, q_len, s_max)
+        mask = jnp.where(valid, 0.0, NEG_INF)[:, None, None, :, :]
+    else:
+        mask = jnp.where(valid, 0.0, NEG_INF)
     scores = scores + mask
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
-    out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, cv)
+    # Cast back to q.dtype: the cache may be wider (e.g. f32 cache under a
+    # bf16 compute policy) and promotion would change the residual-stream
+    # dtype mid-scan.
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, cv).astype(q.dtype)
     return out.reshape(b, q_len, n_heads, head_dim)

@@ -1,0 +1,160 @@
+"""Generation: cache-vs-full-forward parity, ragged padding, EOS, samplers."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from shifu_tpu.core.dtypes import FULL_F32
+from shifu_tpu.infer import SampleConfig, generate, make_generate_fn, sample_logits
+from shifu_tpu.models import Transformer, TransformerConfig
+
+
+@pytest.fixture(scope="module")
+def setup():
+    # f32 end to end: the cache path and the full-forward reference are
+    # different computations, and bf16 rounding could flip argmax ties.
+    model = Transformer(TransformerConfig.tiny(), policy=FULL_F32)
+    params = model.init(jax.random.key(0))
+    return model, params
+
+
+GREEDY = SampleConfig(temperature=0.0)
+
+
+def _greedy_reference(model, params, prompt, n_new):
+    """No-cache loop: full forward over the growing sequence each step."""
+    toks = list(prompt)
+    out = []
+    for _ in range(n_new):
+        logits = model(params, jnp.asarray([toks], jnp.int32))
+        out.append(int(jnp.argmax(logits[0, -1])))
+        toks.append(out[-1])
+    return out
+
+
+def test_greedy_matches_full_forward(setup):
+    model, params = setup
+    prompt = [5, 17, 3, 250, 9]
+    want = _greedy_reference(model, params, prompt, 6)
+    got = generate(
+        model,
+        params,
+        jnp.asarray([prompt], jnp.int32),
+        max_new_tokens=6,
+        sample_cfg=GREEDY,
+        cache_dtype=jnp.float32,
+    )
+    assert got["tokens"][0].tolist() == want
+    assert int(got["lengths"][0]) == 6
+
+
+def test_ragged_padding_is_exact(setup):
+    """A row's output must not depend on other rows' lengths/padding."""
+    model, params = setup
+    p1, p2 = [5, 17, 3], [9, 1, 250, 30, 8, 77, 2]
+    fn = make_generate_fn(
+        model, max_new_tokens=5, sample_cfg=GREEDY, cache_dtype=jnp.float32
+    )
+    prompts = jnp.asarray(
+        [p1 + [0] * (len(p2) - len(p1)), p2], jnp.int32
+    )
+    lengths = jnp.asarray([len(p1), len(p2)], jnp.int32)
+    batched = fn(params, prompts, lengths, jax.random.key(1))
+
+    for row, p in ((0, p1), (1, p2)):
+        solo = generate(
+            model,
+            params,
+            jnp.asarray([p], jnp.int32),
+            max_new_tokens=5,
+            sample_cfg=GREEDY,
+            cache_dtype=jnp.float32,
+        )
+        assert batched["tokens"][row].tolist() == solo["tokens"][0].tolist()
+
+
+def test_eos_stops_row_and_pads(setup):
+    model, params = setup
+    prompt = jnp.asarray([[5, 17, 3]], jnp.int32)
+    free = generate(
+        model, params, prompt, max_new_tokens=4, sample_cfg=GREEDY,
+        cache_dtype=jnp.float32,
+    )
+    first = int(free["tokens"][0, 0])
+    stopped = generate(
+        model, params, prompt, max_new_tokens=4, sample_cfg=GREEDY,
+        eos_id=first, pad_id=-7, cache_dtype=jnp.float32,
+    )
+    assert stopped["tokens"][0].tolist() == [first, -7, -7, -7]
+    assert int(stopped["lengths"][0]) == 1
+
+
+def test_logits_at_matches_full_unembed(setup):
+    model, params = setup
+    tokens = jnp.asarray([[5, 17, 3, 250], [9, 1, 250, 30]], jnp.int32)
+    cache = model.init_cache(2, 8, dtype=jnp.float32)
+    full, _ = model(params, tokens, cache=cache, cache_index=0)
+    at = jnp.asarray([3, 1], jnp.int32)
+    sliced, _ = model(params, tokens, cache=cache, cache_index=0, logits_at=at)
+    want = jnp.take_along_axis(full, at[:, None, None], axis=1)
+    np.testing.assert_allclose(
+        np.asarray(sliced), np.asarray(want), rtol=1e-6
+    )
+
+
+def test_kv_mask_without_cache_raises(setup):
+    model, params = setup
+    tokens = jnp.zeros((1, 4), jnp.int32)
+    with pytest.raises(ValueError):
+        model(params, tokens, kv_mask=jnp.ones((1, 4), bool))
+
+
+def test_sampler_greedy_and_determinism():
+    logits = jnp.asarray([[1.0, 3.0, 2.0, -1.0]])
+    assert int(sample_logits(logits, jax.random.key(0), GREEDY)[0]) == 1
+    k = jax.random.key(42)
+    cfg = SampleConfig(temperature=0.7, top_k=3)
+    a = sample_logits(jnp.tile(logits, (8, 1)), k, cfg)
+    b = sample_logits(jnp.tile(logits, (8, 1)), k, cfg)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_top_k_restricts_support():
+    logits = jnp.asarray([0.0, 0.1, 5.0, 4.9])
+    cfg = SampleConfig(temperature=1.0, top_k=2)
+    keys = jax.random.split(jax.random.key(0), 64)
+    draws = jax.vmap(lambda k: sample_logits(logits, k, cfg))(keys)
+    assert set(np.asarray(draws).tolist()) <= {2, 3}
+
+
+def test_top_p_restricts_support():
+    # probs ~ [0.88, 0.08, ...]: top_p=0.5 keeps only the argmax.
+    logits = jnp.asarray([5.0, 2.6, 1.0, 0.0])
+    cfg = SampleConfig(temperature=1.0, top_p=0.5)
+    keys = jax.random.split(jax.random.key(1), 64)
+    draws = jax.vmap(lambda k: sample_logits(logits, k, cfg))(keys)
+    assert set(np.asarray(draws).tolist()) == {0}
+
+
+def test_top_p_keeps_crossing_token():
+    # probs ~ [0.51, 0.31, 0.19, ~0]; top_p=0.6: rank 0 (cum-before 0) and
+    # rank 1 (cum-before 0.51 < 0.6, the crossing token) survive.
+    logits = jnp.asarray([2.0, 1.5, 1.0, -5.0])
+    cfg = SampleConfig(temperature=1.0, top_p=0.6)
+    keys = jax.random.split(jax.random.key(2), 256)
+    draws = set(
+        np.asarray(
+            jax.vmap(lambda k: sample_logits(logits, k, cfg))(keys)
+        ).tolist()
+    )
+    assert draws == {0, 1}
+
+
+def test_sample_config_validation():
+    with pytest.raises(ValueError):
+        SampleConfig(temperature=-1.0)
+    with pytest.raises(ValueError):
+        SampleConfig(top_k=0)
+    with pytest.raises(ValueError):
+        SampleConfig(top_p=0.0)
