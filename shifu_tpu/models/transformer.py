@@ -1,4 +1,6 @@
-"""Flagship decoder-only transformer (GQA + RoPE + SwiGLU + RMSNorm).
+"""Flagship decoder-only transformer (GQA + RoPE + SwiGLU + RMSNorm),
+optionally MoE (top-k routed experts in every block, expert-parallel over
+the ep mesh axis — ops.moe).
 
 TPU-first structural choices:
 
@@ -32,8 +34,10 @@ from shifu_tpu.parallel.ctx import constrain
 from shifu_tpu.ops import (
     apply_rope,
     dot_product_attention,
+    moe_capacity,
     rms_norm,
     rope_frequencies,
+    route_top_k,
     softmax_cross_entropy,
 )
 from shifu_tpu.ops.attention import NEG_INF
@@ -53,6 +57,12 @@ class TransformerConfig:
     tie_embeddings: bool = False
     z_loss: float = 1e-4
     remat: bool = True  # rematerialise each block in the backward pass
+    # -- mixture of experts (0 experts = dense FFN in every block) ----------
+    n_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_lb_coef: float = 0.01  # load-balance aux-loss coefficient
+    moe_rz_coef: float = 1e-3  # router z-loss coefficient
     # "xla" | "flash" (pallas TPU kernel) | "ring" (sp sequence
     # parallelism; falls back to xla off-mesh — ops.attention docstring)
     attn_impl: str = "xla"
@@ -67,6 +77,10 @@ class TransformerConfig:
                 f"n_heads={self.n_heads} must be divisible by "
                 f"n_kv_heads={self.n_kv_heads}"
             )
+        if self.n_experts and self.moe_top_k > self.n_experts:
+            raise ValueError(
+                f"moe_top_k={self.moe_top_k} exceeds n_experts={self.n_experts}"
+            )
 
     # -- presets --------------------------------------------------------------
     @classmethod
@@ -78,6 +92,13 @@ class TransformerConfig:
         )
         d.update(kw)
         return cls(**d)
+
+    @classmethod
+    def tiny_moe(cls, **kw):
+        """MoE variant of tiny: 4 experts, top-2, for mesh tests (ep<=4)."""
+        d = dict(n_experts=4, moe_top_k=2, mlp_dim=64)
+        d.update(kw)
+        return cls.tiny(**d)
 
     @classmethod
     def small(cls, **kw):  # ~160M params
@@ -115,7 +136,7 @@ def _block_specs(cfg: TransformerConfig):
     )
     # fan-in axis indices are relative to the *stacked* shapes below.
     proj = initializers.fan_in_normal(axis=1)
-    return {
+    specs = {
         "attn_norm": ParamSpec((L, d), ("layers", "embed"), initializers.zeros),
         "wq": ParamSpec(
             (L, d, h, hd), ("layers", "embed", "heads", "head_dim"), proj
@@ -133,14 +154,36 @@ def _block_specs(cfg: TransformerConfig):
             initializers.truncated_normal(1.0 / (h * hd) ** 0.5),
         ),
         "mlp_norm": ParamSpec((L, d), ("layers", "embed"), initializers.zeros),
-        "w_gate": ParamSpec((L, d, m), ("layers", "embed", "mlp"), proj),
-        "w_up": ParamSpec((L, d, m), ("layers", "embed", "mlp"), proj),
-        "w_down": ParamSpec(
+    }
+    if cfg.n_experts:
+        E = cfg.n_experts
+        # Router output dim deliberately has no logical axis: the router is
+        # tiny and its (b, s, E) logits feed a cross-expert top_k, so
+        # sharding E there would only buy an all-gather.
+        specs["router"] = ParamSpec(
+            (L, d, E), ("layers", "embed", None), proj
+        )
+        eproj = initializers.fan_in_normal(axis=2)
+        specs["w_gate"] = ParamSpec(
+            (L, E, d, m), ("layers", "experts", "embed", "expert_mlp"), eproj
+        )
+        specs["w_up"] = ParamSpec(
+            (L, E, d, m), ("layers", "experts", "embed", "expert_mlp"), eproj
+        )
+        specs["w_down"] = ParamSpec(
+            (L, E, m, d),
+            ("layers", "experts", "expert_mlp", "embed"),
+            initializers.fan_in_normal(axis=2),
+        )
+    else:
+        specs["w_gate"] = ParamSpec((L, d, m), ("layers", "embed", "mlp"), proj)
+        specs["w_up"] = ParamSpec((L, d, m), ("layers", "embed", "mlp"), proj)
+        specs["w_down"] = ParamSpec(
             (L, m, d),
             ("layers", "mlp", "embed"),
             initializers.fan_in_normal(axis=1),
-        ),
-    }
+        )
+    return specs
 
 
 @dataclasses.dataclass(frozen=True)
@@ -175,7 +218,8 @@ class Transformer(Module):
     ):
         """One transformer block. ``p`` holds per-layer (unstacked) params.
 
-        Returns (h, new_cache_slice); cache_slice is None outside decode.
+        Returns (h, new_cache_slice, moe_aux); cache_slice is None outside
+        decode; moe_aux is None for a dense FFN, else a dict of scalars.
         """
         cfg = self.cfg
         x = rms_norm(h, p["attn_norm"], eps=cfg.norm_eps)
@@ -231,12 +275,47 @@ class Transformer(Module):
         h = h + jnp.einsum("bshk,hkd->bsd", attn, p["wo"])
 
         x = rms_norm(h, p["mlp_norm"], eps=cfg.norm_eps)
-        gate = jnp.einsum("bsd,dm->bsm", x, p["w_gate"])
-        up = jnp.einsum("bsd,dm->bsm", x, p["w_up"])
-        down = jnp.einsum("bsm,md->bsd", jax.nn.silu(gate) * up, p["w_down"])
+        if cfg.n_experts:
+            down, moe_aux = self._moe_ffn(p, x)
+        else:
+            gate = jnp.einsum("bsd,dm->bsm", x, p["w_gate"])
+            up = jnp.einsum("bsd,dm->bsm", x, p["w_up"])
+            down = jnp.einsum(
+                "bsm,md->bsd", jax.nn.silu(gate) * up, p["w_down"]
+            )
+            moe_aux = None
         h = h + down
         h = constrain(h, ("batch", "seq", "act_embed"))
-        return h, new_cache
+        return h, new_cache, moe_aux
+
+    # ------------------------------------------------------------- moe ffn
+    def _moe_ffn(self, p, x):
+        """Expert-parallel SwiGLU FFN via dispatch/combine einsums.
+
+        Expert buffers carry a leading E axis constrained onto the ``ep``
+        mesh axis; XLA inserts the token↔expert all-to-all between the
+        batch-sharded and expert-sharded layouts (ops.moe module docstring).
+        """
+        cfg = self.cfg
+        b, s, d = x.shape
+        cap = moe_capacity(s, cfg.moe_top_k, cfg.n_experts, cfg.moe_capacity_factor)
+        logits = jnp.einsum("bsd,de->bse", x, p["router"])
+        dispatch, combine, aux = route_top_k(logits, cfg.moe_top_k, cap)
+
+        # (E, b, C, d) expert input buffers — E leads so one constraint pins
+        # the ep sharding for the whole expert-compute segment.
+        xe = jnp.einsum("bsec,bsd->ebcd", dispatch.astype(x.dtype), x)
+        xe = constrain(xe, ("act_experts", "batch", None, "act_embed"))
+        gate = jnp.einsum("ebcd,edm->ebcm", xe, p["w_gate"])
+        up = jnp.einsum("ebcd,edm->ebcm", xe, p["w_up"])
+        dn = jnp.einsum("ebcm,emd->ebcd", jax.nn.silu(gate) * up, p["w_down"])
+        dn = constrain(dn, ("act_experts", "batch", None, "act_embed"))
+        # Combine in f32 (gate weights are f32), cast back to the residual
+        # stream dtype.
+        out = jnp.einsum(
+            "bsec,ebcd->bsd", combine, dn.astype(jnp.float32)
+        ).astype(x.dtype)
+        return out, aux
 
     # ---------------------------------------------------------------- forward
     def __call__(
@@ -250,6 +329,7 @@ class Transformer(Module):
         cache_index=None,
         kv_mask=None,
         logits_at=None,
+        return_aux=False,
     ):
         """Compute logits.
 
@@ -269,9 +349,13 @@ class Transformer(Module):
             one position per row. Skips the (batch, seq, vocab) unembed on
             prefill, where just the last real token's logits feed the
             sampler; returned logits are (batch, 1, vocab).
+          return_aux: also return the MoE aux-loss dict (mean over layers of
+            {"lb", "rz", "dropped"}; None for a dense model). Training-path
+            only — unsupported together with ``cache``.
 
         Returns:
-          (logits, new_cache) if cache is not None else logits.
+          (logits, new_cache) if cache is not None else logits; with
+          ``return_aux``, (logits, moe_aux).
           logits: (batch, seq, vocab) in the policy's output dtype.
         """
         cfg = self.cfg
@@ -310,21 +394,26 @@ class Transformer(Module):
 
         if cache is None:
             def body(carry, layer_p):
-                out, _ = block(layer_p, carry, sin, cos, segment_ids, None, None)
-                return out, None
+                out, _, aux = block(
+                    layer_p, carry, sin, cos, segment_ids, None, None
+                )
+                return out, aux
 
-            h, _ = jax.lax.scan(body, h, p["blocks"])
+            h, auxes = jax.lax.scan(body, h, p["blocks"])
             new_cache = None
         else:
+            if return_aux:
+                raise ValueError("return_aux is a training-path (no-cache) flag")
+
             def body(carry, xs):
                 layer_p, cache_slice = xs
-                out, new_slice = block(
+                out, new_slice, aux = block(
                     layer_p, carry, sin, cos, None, cache_slice, cache_index,
                     kv_mask,
                 )
-                return out, new_slice
+                return out, (new_slice, aux)
 
-            h, new_cache = jax.lax.scan(body, h, (p["blocks"], cache))
+            h, (new_cache, auxes) = jax.lax.scan(body, h, (p["blocks"], cache))
 
         h = rms_norm(h, p["final_norm"], eps=cfg.norm_eps)
         if logits_at is not None:
@@ -335,14 +424,22 @@ class Transformer(Module):
             logits = jnp.einsum("bsd,dv->bsv", h, p["unembed"])
         logits = constrain(logits, ("batch", "seq", "act_vocab"))
         logits = self.policy.cast_to_output(logits)
+        if return_aux:
+            moe_aux = (
+                jax.tree_util.tree_map(jnp.mean, auxes)
+                if cfg.n_experts
+                else None
+            )
+            return logits, moe_aux
         return logits if cache is None else (logits, new_cache)
 
     # ------------------------------------------------------------------- loss
     def loss(self, params, batch):
         """Next-token loss. batch: {"tokens": (b, s), optional "mask",
         "segment_ids", "positions"}. Predicts tokens[:, 1:]."""
+        cfg = self.cfg
         tokens = batch["tokens"]
-        logits = self(
+        logits, moe_aux = self(
             params,
             tokens[:, :-1],
             segment_ids=(
@@ -355,13 +452,22 @@ class Transformer(Module):
                 if batch.get("positions") is not None
                 else None
             ),
+            return_aux=True,
         )
         mask = batch.get("mask")
         if mask is not None:
             mask = mask[:, 1:]
-        return softmax_cross_entropy(
-            logits, tokens[:, 1:], mask=mask, z_loss=self.cfg.z_loss
+        loss, aux = softmax_cross_entropy(
+            logits, tokens[:, 1:], mask=mask, z_loss=cfg.z_loss
         )
+        if moe_aux is not None:
+            loss = (
+                loss
+                + cfg.moe_lb_coef * moe_aux["lb"]
+                + cfg.moe_rz_coef * moe_aux["rz"]
+            )
+            aux.update({f"moe_{k}": v for k, v in moe_aux.items()})
+        return loss, aux
 
     # ------------------------------------------------------------------ cache
     def init_cache(self, batch_size: int, max_seq_len: int, dtype=jnp.bfloat16):

@@ -2,6 +2,7 @@ from shifu_tpu.ops.norms import rms_norm
 from shifu_tpu.ops.rope import apply_rope, rope_frequencies
 from shifu_tpu.ops.attention import dot_product_attention
 from shifu_tpu.ops.losses import softmax_cross_entropy
+from shifu_tpu.ops.moe import moe_capacity, route_top_k
 
 __all__ = [
     "rms_norm",
@@ -9,4 +10,6 @@ __all__ = [
     "rope_frequencies",
     "dot_product_attention",
     "softmax_cross_entropy",
+    "moe_capacity",
+    "route_top_k",
 ]

@@ -1,0 +1,94 @@
+"""Mixture-of-Experts routing: top-k capacity-based dispatch.
+
+TPU-first design — the classic dispatch/combine-einsum formulation (as in
+GShard / Switch on TPU) rather than gather/scatter:
+
+  * Routing produces two dense (b, s, E, C) tensors — ``dispatch`` (0/1
+    token→slot assignment) and ``combine`` (dispatch × gate weight). Expert
+    input buffers are then a single einsum, expert FFNs run batched over a
+    leading E axis (one big MXU matmul per projection), and outputs come
+    back with a second einsum. Everything is static-shaped, so it jits once.
+  * Under a mesh, the E axis of the expert buffers is sharded over the
+    ``ep`` mesh axis by an activation constraint; XLA inserts the
+    all-to-all between the (batch-sharded) token layout and the
+    (expert-sharded) buffer layout on its own.
+  * Capacity C = ceil(capacity_factor * s * k / E) bounds per-expert work;
+    overflow tokens are dropped (their combine weight is 0, so the residual
+    stream passes them through untouched). Priority is choice-major: every
+    token's 1st choice beats any token's 2nd choice (GShard order).
+
+Reference parity note: the upstream reference (klyan/shifu) is an empty
+repository (SURVEY.md) — there is no reference MoE implementation to match.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def moe_capacity(seq_len: int, top_k: int, n_experts: int, factor: float) -> int:
+    """Static per-expert buffer length for one batch row."""
+    return max(1, int(-(-seq_len * top_k * factor // n_experts)))
+
+
+def route_top_k(
+    router_logits: jax.Array,
+    top_k: int,
+    capacity: int,
+    *,
+    normalize_weights: bool = True,
+):
+    """Top-k routing with per-row expert capacity.
+
+    Args:
+      router_logits: (b, s, E), any float dtype (softmax runs in f32).
+      top_k: experts per token.
+      capacity: per-expert slots per batch row (see :func:`moe_capacity`).
+      normalize_weights: renormalise the k gate weights to sum to 1
+        (Mixtral convention); otherwise raw softmax probabilities (Switch).
+
+    Returns:
+      (dispatch, combine, aux):
+        dispatch: (b, s, E, C) f32 in {0, 1} — token→(expert, slot).
+        combine:  (b, s, E, C) f32 — dispatch × gate weight.
+        aux: {"lb": load-balance loss (→1.0 at uniform routing),
+              "rz": router z-loss (mean logsumexp²),
+              "dropped": fraction of assignments dropped for capacity}.
+    """
+    b, s, n_experts = router_logits.shape
+    logits = router_logits.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+
+    gate_vals, gate_idx = jax.lax.top_k(probs, top_k)  # (b, s, k)
+    if normalize_weights:
+        gate_vals = gate_vals / jnp.sum(gate_vals, axis=-1, keepdims=True)
+
+    # (b, s, k, E) one-hot of each token's k choices.
+    expert_mask = jax.nn.one_hot(gate_idx, n_experts, dtype=jnp.float32)
+
+    # Choice-major priority: flatten (k, s) with k outermost so all 1st
+    # choices occupy slots before any 2nd choice.
+    mask_ks = expert_mask.transpose(0, 2, 1, 3).reshape(b, top_k * s, n_experts)
+    pos = jnp.cumsum(mask_ks, axis=1) - mask_ks  # slot index within expert
+    keep = (pos < capacity).astype(jnp.float32) * mask_ks
+
+    slot_hot = jax.nn.one_hot(pos.astype(jnp.int32), capacity, dtype=jnp.float32)
+    dispatch_ks = keep[..., None] * slot_hot  # (b, k*s, E, C)
+    dispatch = (
+        dispatch_ks.reshape(b, top_k, s, n_experts, capacity)
+        .transpose(0, 2, 1, 3, 4)
+    )  # (b, s, k, E, C)
+    combine = jnp.sum(dispatch * gate_vals[..., None, None], axis=2)
+    dispatch = jnp.sum(dispatch, axis=2)
+
+    # Load balance (Switch eq. 4, computed over all k assignments): with
+    # f_e the fraction of assignments routed to e and p_e the mean router
+    # prob, E·Σ f_e p_e is 1.0 at perfectly uniform routing.
+    f = jnp.mean(expert_mask, axis=(0, 1, 2))  # fraction per expert, Σ=1
+    p = jnp.mean(probs, axis=(0, 1))
+    lb = n_experts * jnp.sum(f * p)
+    rz = jnp.mean(jnp.square(jax.nn.logsumexp(logits, axis=-1)))
+    routed = jnp.sum(keep) / jnp.maximum(jnp.sum(mask_ks), 1.0)
+    aux = {"lb": lb, "rz": rz, "dropped": 1.0 - routed}
+    return dispatch, combine, aux

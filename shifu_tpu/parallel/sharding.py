@@ -46,6 +46,7 @@ DEFAULT_RULES: dict = {
     "act_heads": "tp",
     "act_mlp": "tp",
     "act_vocab": "tp",
+    "act_experts": "ep",
 }
 
 
