@@ -34,44 +34,28 @@ from shifu_tpu.parallel import sharding as shd
 from shifu_tpu.train.step import TrainState
 
 
-def abstract_train_state(model, mesh=None, rules=shd.DEFAULT_RULES):
+def abstract_train_state(model, mesh=None, rules=shd.DEFAULT_RULES, optimizer=None):
     """TrainState template of ShapeDtypeStructs for sharded restore.
 
-    Mirrors exactly what ``create_sharded_state(model, AdamW(), ...)``
-    produces: f32 moments shaped like params, an i32 scalar step. With
-    ``mesh=None`` the leaves carry no sharding (single-process restore).
+    Mirrors exactly what ``create_sharded_state(model, optimizer, ...)``
+    produces — the optimizer's ``state_template`` defines the opt-state
+    structure (``optimizer=None`` defaults to AdamW's mu/nu/step layout).
+    With ``mesh=None`` the leaves carry no sharding (single-process
+    restore).
     """
-    specs = model.specs()
-    is_spec = lambda x: hasattr(x, "shape") and hasattr(x, "axes")
+    from shifu_tpu.train.optimizer import AdamW
 
-    if mesh is not None:
-        scalar = jax.sharding.NamedSharding(
-            mesh, jax.sharding.PartitionSpec()
-        )
-
-        def sharding_of(s):
-            return jax.sharding.NamedSharding(
-                mesh, shd.spec_for(s.shape, s.axes, mesh, rules)
-            )
-    else:
-        scalar = None
-        sharding_of = lambda s: None
-
-    def tmpl(dtype_override=None):
-        return jax.tree_util.tree_map(
-            lambda s: jax.ShapeDtypeStruct(
-                s.shape, dtype_override or s.dtype, sharding=sharding_of(s)
-            ),
-            specs,
-            is_leaf=is_spec,
-        )
-
-    opt = {
-        "mu": tmpl(jnp.float32),
-        "nu": tmpl(jnp.float32),
-        "step": jax.ShapeDtypeStruct((), jnp.int32, sharding=scalar),
-    }
-    return TrainState(params=tmpl(), opt=opt)
+    optimizer = AdamW() if optimizer is None else optimizer
+    scalar = (
+        jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())
+        if mesh is not None
+        else None
+    )
+    params_tmpl = shd.abstract_params(model, mesh, rules)
+    opt = optimizer.state_template(
+        params_tmpl, jax.ShapeDtypeStruct((), jnp.int32, sharding=scalar)
+    )
+    return TrainState(params=params_tmpl, opt=opt)
 
 
 class Checkpointer:
@@ -82,7 +66,9 @@ class Checkpointer:
         ckpt = Checkpointer(dir, max_to_keep=3, save_interval_steps=1000)
         ckpt.save(step, state, host_state={"batches_seen": n})   # async
         ...
-        template = abstract_train_state(model, mesh)
+        # pass the SAME optimizer used for training — the restore template's
+        # opt-state structure comes from it (AdamW if omitted)
+        template = abstract_train_state(model, mesh, optimizer=opt)
         state, host = ckpt.restore(template)                      # latest
         ckpt.close()
     """

@@ -2,6 +2,7 @@ from shifu_tpu.parallel.ctx import activation_sharding, constrain
 from shifu_tpu.parallel.mesh import MESH_AXES, MeshPlan
 from shifu_tpu.parallel.sharding import (
     DEFAULT_RULES,
+    abstract_params,
     batch_spec,
     init_sharded,
     param_shardings,
@@ -16,6 +17,7 @@ __all__ = [
     "MESH_AXES",
     "MeshPlan",
     "DEFAULT_RULES",
+    "abstract_params",
     "batch_spec",
     "init_sharded",
     "param_shardings",

@@ -134,6 +134,27 @@ def init_sharded(
     return init_fn(rng)
 
 
+def abstract_params(
+    module: Module,
+    mesh: Optional[Mesh] = None,
+    rules: Mapping[str, MeshAxes] = DEFAULT_RULES,
+):
+    """Params-shaped tree of ShapeDtypeStruct (with NamedShardings if a mesh
+    is given). The single lowering used both for jit in/out shardings
+    (train.step) and checkpoint restore templates (checkpoint) — keeping
+    them structurally identical by construction.
+    """
+    is_spec = lambda x: isinstance(x, ParamSpec)
+
+    def one(s: ParamSpec):
+        sharding = None
+        if mesh is not None:
+            sharding = NamedSharding(mesh, spec_for(s.shape, s.axes, mesh, rules))
+        return jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sharding)
+
+    return jax.tree_util.tree_map(one, module.specs(), is_leaf=is_spec)
+
+
 def batch_spec(mesh: Mesh, rules: Mapping[str, MeshAxes] = DEFAULT_RULES) -> P:
     """PartitionSpec for a (batch, seq) token array.
 

@@ -1,4 +1,15 @@
-from shifu_tpu.train.optimizer import AdamW, constant, global_norm, warmup_cosine
+from shifu_tpu.train.optimizer import (
+    AdamW,
+    Adafactor,
+    Lion,
+    SGD,
+    constant,
+    global_norm,
+    inverse_sqrt,
+    linear,
+    warmup_cosine,
+    wsd,
+)
 from shifu_tpu.train.step import (
     TrainState,
     create_sharded_state,
@@ -8,9 +19,15 @@ from shifu_tpu.train.step import (
 
 __all__ = [
     "AdamW",
+    "Adafactor",
+    "Lion",
+    "SGD",
     "constant",
     "global_norm",
+    "inverse_sqrt",
+    "linear",
     "warmup_cosine",
+    "wsd",
     "TrainState",
     "create_sharded_state",
     "make_train_step",

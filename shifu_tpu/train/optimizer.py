@@ -1,12 +1,21 @@
 """Optimizers and LR schedules.
 
-The optimizer state is a pytree whose ``mu``/``nu`` subtrees mirror the
-params tree leaf-for-leaf, so the parallel layer shards optimizer state by
-reusing the param shardings unchanged — no structure matching against
-opaque library state. (optax remains available for research code; the
-training stack uses this native implementation.)
+Optimizer state is a plain dict pytree with a ``step`` counter plus moment
+trees, and every optimizer implements the same three-method contract:
 
-All moment math runs in f32 regardless of the grad dtype.
+  * ``init(params) -> state``
+  * ``update(grads, state, params, decay_mask) -> (params, state, stats)``
+  * ``state_template(params_tmpl, scalar_tmpl) -> state-shaped tree of
+    ShapeDtypeStruct`` — the single source of truth for the state's
+    structure/shapes/shardings, consumed by the parallel layer (jit
+    in/out shardings) and the checkpointer (sharded restore templates).
+    Moments that mirror params inherit the param shardings leaf-for-leaf;
+    Adafactor's factored moments inherit the param sharding minus the
+    reduced axis.
+
+(optax remains available for research code; the training stack uses this
+native implementation.) All moment math runs in f32 regardless of the grad
+dtype.
 """
 
 from __future__ import annotations
@@ -46,6 +55,103 @@ def constant(lr: float) -> Callable:
     return lambda step: jnp.asarray(lr, jnp.float32)
 
 
+def linear(
+    peak_lr: float,
+    total_steps: int,
+    warmup_steps: int = 0,
+    final_fraction: float = 0.0,
+) -> Callable:
+    """Linear warmup then linear decay to final_fraction * peak_lr."""
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        warm = step / jnp.maximum(1.0, warmup_steps)
+        progress = (step - warmup_steps) / jnp.maximum(
+            1.0, total_steps - warmup_steps
+        )
+        progress = jnp.clip(progress, 0.0, 1.0)
+        decay = 1.0 - (1.0 - final_fraction) * progress
+        return peak_lr * jnp.where(step < warmup_steps, warm, decay)
+
+    return schedule
+
+
+def wsd(
+    peak_lr: float,
+    total_steps: int,
+    warmup_steps: int = 0,
+    decay_steps: Optional[int] = None,
+    final_fraction: float = 0.0,
+) -> Callable:
+    """Warmup-stable-decay: warmup, hold at peak, linear-decay the tail.
+
+    ``decay_steps`` defaults to 10% of total. The stable plateau makes
+    mid-run checkpoints reusable as branch points (decay can be re-run from
+    any plateau checkpoint).
+    """
+    if decay_steps is None:
+        decay_steps = max(1, total_steps // 10)
+    decay_start = total_steps - decay_steps
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        warm = step / jnp.maximum(1.0, warmup_steps)
+        tail = jnp.clip((step - decay_start) / decay_steps, 0.0, 1.0)
+        decay = 1.0 - (1.0 - final_fraction) * tail
+        lr = jnp.where(step < warmup_steps, warm, decay)
+        return peak_lr * lr
+
+    return schedule
+
+
+def inverse_sqrt(peak_lr: float, warmup_steps: int = 1000) -> Callable:
+    """Linear warmup, then peak_lr * sqrt(warmup / step) (T5 convention)."""
+    warmup_steps = max(1, warmup_steps)  # 0 would make every lr sqrt(0)=0
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        warm = step / jnp.maximum(1.0, warmup_steps)
+        decay = jnp.sqrt(warmup_steps / jnp.maximum(step, warmup_steps))
+        return peak_lr * jnp.where(step < warmup_steps, warm, decay)
+
+    return schedule
+
+
+# --------------------------------------------------------------- shared bits
+def _to_f32(tree):
+    return jax.tree_util.tree_map(lambda g: g.astype(jnp.float32), tree)
+
+
+def _clipped(grads, max_norm: Optional[float]):
+    """(clipped grads, pre-clip global norm)."""
+    gnorm = global_norm(grads)
+    if max_norm is None:
+        return grads, gnorm
+    scale = jnp.minimum(1.0, max_norm / (gnorm + 1e-9))
+    return jax.tree_util.tree_map(lambda g: g * scale, grads), gnorm
+
+
+def _default_decay_mask(params, decay_mask):
+    if decay_mask is None:
+        return jax.tree_util.tree_map(lambda p: p.ndim >= 2, params)
+    return decay_mask
+
+
+def _f32_like(t) -> jax.ShapeDtypeStruct:
+    return jax.ShapeDtypeStruct(
+        t.shape, jnp.float32, sharding=getattr(t, "sharding", None)
+    )
+
+
+def _mirror_template(params_tmpl, scalar, *moment_names):
+    state = {name: jax.tree_util.tree_map(_f32_like, params_tmpl)
+             for name in moment_names}
+    state["step"] = jax.ShapeDtypeStruct(
+        (), jnp.int32, sharding=getattr(scalar, "sharding", None)
+    )
+    return state
+
+
 # ------------------------------------------------------------------- adamw
 @dataclasses.dataclass(frozen=True)
 class AdamW:
@@ -68,6 +174,9 @@ class AdamW:
         )
         return {"mu": zeros(), "nu": zeros(), "step": jnp.zeros((), jnp.int32)}
 
+    def state_template(self, params_tmpl, scalar):
+        return _mirror_template(params_tmpl, scalar, "mu", "nu")
+
     def update(self, grads, state, params, decay_mask=None):
         """Returns (new_params, new_state, stats).
 
@@ -78,14 +187,7 @@ class AdamW:
         a mask derived from logical axes instead.
         """
         step = state["step"] + 1
-        grads = jax.tree_util.tree_map(
-            lambda g: g.astype(jnp.float32), grads
-        )
-
-        gnorm = global_norm(grads)
-        if self.grad_clip_norm is not None:
-            scale = jnp.minimum(1.0, self.grad_clip_norm / (gnorm + 1e-9))
-            grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
+        grads, gnorm = _clipped(_to_f32(grads), self.grad_clip_norm)
 
         b1, b2 = self.b1, self.b2
         mu = jax.tree_util.tree_map(
@@ -99,10 +201,7 @@ class AdamW:
         c2 = 1 - b2 ** step.astype(jnp.float32)
         lr = self.schedule(step)
 
-        if decay_mask is None:
-            decay_mask = jax.tree_util.tree_map(
-                lambda p: p.ndim >= 2, params
-            )
+        decay_mask = _default_decay_mask(params, decay_mask)
 
         def step_one(p, m, v, decay):
             update = (m / c1) / (jnp.sqrt(v / c2) + self.eps)
@@ -114,6 +213,242 @@ class AdamW:
             step_one, params, mu, nu, decay_mask
         )
         new_state = {"mu": mu, "nu": nu, "step": step}
+        return new_params, new_state, {"grad_norm": gnorm, "lr": lr}
+
+
+# -------------------------------------------------------------------- lion
+@dataclasses.dataclass(frozen=True)
+class Lion:
+    """Lion (evolved sign momentum): update = sign(b1·mu + (1-b1)·g).
+
+    One moment instead of AdamW's two — half the optimizer memory — and the
+    sign makes per-parameter update magnitude exactly ``lr``, so typical
+    peak LRs are ~3-10x smaller than AdamW's with ~3x the weight decay.
+    """
+
+    schedule: Callable = constant(1e-4)
+    b1: float = 0.9
+    b2: float = 0.99
+    weight_decay: float = 0.3
+    grad_clip_norm: Optional[float] = 1.0
+
+    def init(self, params):
+        mu = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params
+        )
+        return {"mu": mu, "step": jnp.zeros((), jnp.int32)}
+
+    def state_template(self, params_tmpl, scalar):
+        return _mirror_template(params_tmpl, scalar, "mu")
+
+    def update(self, grads, state, params, decay_mask=None):
+        step = state["step"] + 1
+        grads, gnorm = _clipped(_to_f32(grads), self.grad_clip_norm)
+        lr = self.schedule(step)
+        decay_mask = _default_decay_mask(params, decay_mask)
+
+        def step_one(p, m, g, decay):
+            direction = jnp.sign(self.b1 * m + (1 - self.b1) * g)
+            if self.weight_decay and decay:
+                direction = direction + self.weight_decay * p.astype(jnp.float32)
+            return (p.astype(jnp.float32) - lr * direction).astype(p.dtype)
+
+        new_params = jax.tree_util.tree_map(
+            step_one, params, state["mu"], grads, decay_mask
+        )
+        mu = jax.tree_util.tree_map(
+            lambda m, g: self.b2 * m + (1 - self.b2) * g, state["mu"], grads
+        )
+        return new_params, {"mu": mu, "step": step}, {
+            "grad_norm": gnorm, "lr": lr,
+        }
+
+
+# --------------------------------------------------------------------- sgd
+@dataclasses.dataclass(frozen=True)
+class SGD:
+    """SGD with (optionally Nesterov) momentum and decoupled weight decay."""
+
+    schedule: Callable = constant(1e-2)
+    momentum: float = 0.9
+    nesterov: bool = False
+    weight_decay: float = 0.0
+    grad_clip_norm: Optional[float] = None
+
+    def init(self, params):
+        mu = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params
+        )
+        return {"mu": mu, "step": jnp.zeros((), jnp.int32)}
+
+    def state_template(self, params_tmpl, scalar):
+        return _mirror_template(params_tmpl, scalar, "mu")
+
+    def update(self, grads, state, params, decay_mask=None):
+        step = state["step"] + 1
+        grads, gnorm = _clipped(_to_f32(grads), self.grad_clip_norm)
+        lr = self.schedule(step)
+        decay_mask = _default_decay_mask(params, decay_mask)
+
+        mu = jax.tree_util.tree_map(
+            lambda m, g: self.momentum * m + g, state["mu"], grads
+        )
+
+        def step_one(p, m, g, decay):
+            u = g + self.momentum * m if self.nesterov else m
+            if self.weight_decay and decay:
+                u = u + self.weight_decay * p.astype(jnp.float32)
+            return (p.astype(jnp.float32) - lr * u).astype(p.dtype)
+
+        new_params = jax.tree_util.tree_map(
+            step_one, params, mu, grads, decay_mask
+        )
+        return new_params, {"mu": mu, "step": step}, {
+            "grad_norm": gnorm, "lr": lr,
+        }
+
+
+# --------------------------------------------------------------- adafactor
+def _factored(shape) -> bool:
+    return len(shape) >= 2
+
+
+def _drop_axis_tmpl(t, axis: int) -> jax.ShapeDtypeStruct:
+    """ShapeDtypeStruct of ``t`` with one axis reduced away, f32, keeping
+    the sharding of the surviving axes (factored moments stay sharded
+    exactly like their param minus the reduced dimension)."""
+    axis = axis % len(t.shape)
+    shape = t.shape[:axis] + t.shape[axis + 1 :]
+    sharding = getattr(t, "sharding", None)
+    if sharding is not None and hasattr(sharding, "spec"):
+        spec = list(sharding.spec) + [None] * (len(t.shape) - len(sharding.spec))
+        del spec[axis]
+        sharding = jax.sharding.NamedSharding(
+            sharding.mesh, jax.sharding.PartitionSpec(*spec)
+        )
+    return jax.ShapeDtypeStruct(shape, jnp.float32, sharding=sharding)
+
+
+@dataclasses.dataclass(frozen=True)
+class Adafactor:
+    """Adafactor: second moments factored over the trailing two axes.
+
+    For a (..., r, c) param the state holds row/col EMAs of the squared
+    gradient — O(r + c) memory instead of O(r·c) — reconstructed as the
+    rank-1 outer product at update time (Shazeer & Stern 2018). Sub-matrix
+    params keep a full second moment. Momentum (``b1``) is off by default,
+    making this the lowest-memory optimizer here.
+
+    This variant takes an explicit LR ``schedule`` (T5X convention) rather
+    than the paper's relative-step sizing; the update RMS is clipped to
+    ``clip_threshold`` which provides the same stability.
+    """
+
+    schedule: Callable = constant(1e-2)
+    b1: float = 0.0  # 0 disables the first moment entirely
+    b2_cap: float = 0.999
+    eps: float = 1e-30  # floor on squared grads
+    clip_threshold: float = 1.0
+    weight_decay: float = 0.0
+    grad_clip_norm: Optional[float] = None
+
+    def init(self, params):
+        def moment(p):
+            if _factored(p.shape):
+                return {
+                    "vr": jnp.zeros(p.shape[:-1], jnp.float32),
+                    "vc": jnp.zeros(p.shape[:-2] + p.shape[-1:], jnp.float32),
+                }
+            return {"v": jnp.zeros(p.shape, jnp.float32)}
+
+        state = {
+            "v": jax.tree_util.tree_map(moment, params),
+            "step": jnp.zeros((), jnp.int32),
+        }
+        if self.b1:
+            state["mu"] = jax.tree_util.tree_map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), params
+            )
+        return state
+
+    def state_template(self, params_tmpl, scalar):
+        def moment(t):
+            if _factored(t.shape):
+                return {
+                    "vr": _drop_axis_tmpl(t, -1),
+                    "vc": _drop_axis_tmpl(t, -2),
+                }
+            return {"v": _f32_like(t)}
+
+        state = {
+            "v": jax.tree_util.tree_map(moment, params_tmpl),
+            "step": jax.ShapeDtypeStruct(
+                (), jnp.int32, sharding=getattr(scalar, "sharding", None)
+            ),
+        }
+        if self.b1:
+            state["mu"] = jax.tree_util.tree_map(_f32_like, params_tmpl)
+        return state
+
+    def update(self, grads, state, params, decay_mask=None):
+        step = state["step"] + 1
+        grads, gnorm = _clipped(_to_f32(grads), self.grad_clip_norm)
+        lr = self.schedule(step)
+        decay_mask = _default_decay_mask(params, decay_mask)
+        # Paper's increasing decay: b2_t = 1 - t^-0.8, capped.
+        t = step.astype(jnp.float32)
+        b2t = jnp.minimum(self.b2_cap, 1.0 - t ** -0.8)
+
+        # state["v"] nests one dict per param leaf; flatten it *up to* the
+        # params structure so moments pair with their params positionally.
+        leaves, treedef = jax.tree_util.tree_flatten(params)
+        g_leaves = treedef.flatten_up_to(grads)
+        v_leaves = treedef.flatten_up_to(state["v"])
+        mask_leaves = treedef.flatten_up_to(decay_mask)
+
+        new_v, updates = [], []
+        for p, g, v in zip(leaves, g_leaves, v_leaves):
+            g2 = jnp.square(g) + self.eps
+            if _factored(p.shape):
+                vr = b2t * v["vr"] + (1 - b2t) * jnp.mean(g2, axis=-1)
+                vc = b2t * v["vc"] + (1 - b2t) * jnp.mean(g2, axis=-2)
+                # v̂ = (vr ⊗ vc) / mean(vr): rank-1 reconstruction whose
+                # row-sums match vr and col-sums match vc.
+                row = jax.lax.rsqrt(
+                    vr / jnp.mean(vr, axis=-1, keepdims=True)
+                )
+                col = jax.lax.rsqrt(vc)
+                u = g * row[..., :, None] * col[..., None, :]
+                new_v.append({"vr": vr, "vc": vc})
+            else:
+                vf = b2t * v["v"] + (1 - b2t) * g2
+                u = g * jax.lax.rsqrt(vf)
+                new_v.append({"v": vf})
+            if self.clip_threshold:
+                rms = jnp.sqrt(jnp.mean(jnp.square(u)))
+                u = u / jnp.maximum(1.0, rms / self.clip_threshold)
+            updates.append(u)
+        new_state = {
+            "v": jax.tree_util.tree_unflatten(treedef, new_v),
+            "step": step,
+        }
+
+        if self.b1:
+            mu_leaves = treedef.flatten_up_to(state["mu"])
+            mu = [
+                self.b1 * m + (1 - self.b1) * u
+                for m, u in zip(mu_leaves, updates)
+            ]
+            updates = mu
+            new_state["mu"] = jax.tree_util.tree_unflatten(treedef, mu)
+
+        out = []
+        for p, u, decay in zip(leaves, updates, mask_leaves):
+            pf = p.astype(jnp.float32)
+            if self.weight_decay and decay:
+                u = u + self.weight_decay * pf
+            out.append((pf - lr * u).astype(p.dtype))
+        new_params = jax.tree_util.tree_unflatten(treedef, out)
         return new_params, new_state, {"grad_norm": gnorm, "lr": lr}
 
 

@@ -42,22 +42,37 @@ class TrainState:
         return self.opt["step"]
 
     @classmethod
-    def create(cls, params, optimizer: AdamW):
+    def create(cls, params, optimizer):
         return cls(params=params, opt=optimizer.init(params))
 
 
-def state_shardings(model, mesh: Mesh, rules=shd.DEFAULT_RULES) -> TrainState:
-    """TrainState-of-NamedSharding: moments mirror params, scalars replicated."""
+def state_shardings(
+    model, mesh: Mesh, rules=shd.DEFAULT_RULES, optimizer=None
+) -> TrainState:
+    """TrainState-of-NamedSharding for any optimizer.
+
+    The optimizer's ``state_template`` is the source of truth for the opt
+    state's structure (AdamW mirrors params twice, Lion/SGD once, Adafactor
+    factors the trailing axes); this just lowers it to shardings.
+    ``optimizer=None`` defaults to AdamW (the mu/nu/step layout).
+    """
+    optimizer = AdamW() if optimizer is None else optimizer
     p = shd.param_shardings(model, mesh, rules)
     scalar = NamedSharding(mesh, jax.sharding.PartitionSpec())
-    return TrainState(params=p, opt={"mu": p, "nu": p, "step": scalar})
+
+    params_tmpl = shd.abstract_params(model, mesh, rules)
+    opt_tmpl = optimizer.state_template(
+        params_tmpl, jax.ShapeDtypeStruct((), jnp.int32, sharding=scalar)
+    )
+    opt = jax.tree_util.tree_map(lambda t: t.sharding, opt_tmpl)
+    return TrainState(params=p, opt=opt)
 
 
 def create_sharded_state(
-    model, optimizer: AdamW, rng, mesh: Mesh, rules=shd.DEFAULT_RULES
+    model, optimizer, rng, mesh: Mesh, rules=shd.DEFAULT_RULES
 ) -> TrainState:
     """Initialise params AND optimizer state directly into their shards."""
-    shardings = state_shardings(model, mesh, rules)
+    shardings = state_shardings(model, mesh, rules, optimizer)
 
     def build(key):
         params = model.init(key)
@@ -68,7 +83,7 @@ def create_sharded_state(
 
 def make_train_step(
     model,
-    optimizer: AdamW,
+    optimizer,
     mesh: Optional[Mesh] = None,
     rules: Mapping = shd.DEFAULT_RULES,
     microbatches: Optional[int] = None,
@@ -149,7 +164,7 @@ def make_train_step(
     if mesh is None:
         return jax.jit(step_fn, donate_argnums=(0,))
 
-    st_shard = state_shardings(model, mesh, rules)
+    st_shard = state_shardings(model, mesh, rules, optimizer)
     scalar = NamedSharding(mesh, jax.sharding.PartitionSpec())
 
     # The batch keeps whatever sharding parallel.shard_batch gave it
