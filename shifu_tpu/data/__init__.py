@@ -1,0 +1,21 @@
+"""Data subsystem: mmap token shards -> packed, resumable device batches.
+
+Pipeline: ``write_shards`` (corpus -> binary shards) -> ``TokenDataset``
+(mmap view) -> ``Packer`` (native C++ concat-and-chunk core, numpy
+fallback) -> ``PackedLoader`` (deterministic shuffle, resumable cursor)
+-> ``device_prefetch`` (overlapped H2D).
+"""
+
+from shifu_tpu.data.dataset import TokenDataset, write_shards
+from shifu_tpu.data.loader import PackedLoader, device_prefetch
+from shifu_tpu.data.packing import Packer
+from shifu_tpu.data._native import available as native_available
+
+__all__ = [
+    "TokenDataset",
+    "write_shards",
+    "PackedLoader",
+    "device_prefetch",
+    "Packer",
+    "native_available",
+]

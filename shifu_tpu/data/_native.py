@@ -1,0 +1,82 @@
+"""Build + load the native packing core (ctypes over a g++-built .so).
+
+The library is compiled on first use into ``_build/`` next to this file,
+keyed by a hash of the source, so edits recompile automatically and repeat
+imports are instant. No pybind11 in this toolchain — the C ABI + ctypes is
+the binding layer. Failure to build (no g++, readonly install, sandbox)
+degrades silently to the numpy fallback in packing.py; set
+``SHIFU_TPU_REQUIRE_NATIVE=1`` to make that an error instead, or
+``SHIFU_TPU_NO_NATIVE=1`` to skip native entirely.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "native", "packer.cc")
+_BUILD = os.path.join(_HERE, "_build")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _compile() -> Optional[str]:
+    with open(_SRC, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    so_path = os.path.join(_BUILD, f"libpacker-{digest}.so")
+    if os.path.exists(so_path):
+        return so_path
+    os.makedirs(_BUILD, exist_ok=True)
+    tmp = so_path + f".tmp{os.getpid()}"
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", tmp, _SRC]
+    subprocess.run(cmd, check=True, capture_output=True)
+    os.replace(tmp, so_path)  # atomic: concurrent builders race benignly
+    return so_path
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The packer library, or None when unavailable (numpy fallback)."""
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("SHIFU_TPU_NO_NATIVE"):
+            return None
+        try:
+            path = _compile()
+            lib = ctypes.CDLL(path)
+            for name in ("pack_chunks_u16", "pack_chunks_u32"):
+                fn = getattr(lib, name)
+                fn.restype = ctypes.c_int64
+                fn.argtypes = [
+                    ctypes.POINTER(ctypes.c_void_p),  # shard_bases
+                    ctypes.POINTER(ctypes.c_void_p),  # shard_offsets
+                    ctypes.c_void_p,  # order_shard (int32*)
+                    ctypes.c_void_p,  # order_doc (int64*)
+                    ctypes.c_int64,  # n_order
+                    ctypes.POINTER(ctypes.c_int64),  # cursor_doc
+                    ctypes.POINTER(ctypes.c_int64),  # cursor_tok
+                    ctypes.c_void_p,  # out_tokens (uint32*)
+                    ctypes.c_void_p,  # out_segments (int32*)
+                    ctypes.c_void_p,  # out_positions (int32*)
+                    ctypes.c_int64,  # rows
+                    ctypes.c_int64,  # seq
+                ]
+            _lib = lib
+        except Exception:
+            if os.environ.get("SHIFU_TPU_REQUIRE_NATIVE"):
+                raise
+            _lib = None
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None

@@ -1,0 +1,141 @@
+"""On-disk token dataset: memory-mapped binary shards.
+
+Layout of a dataset directory::
+
+    meta.json                 {"dtype": "uint16"|"uint32", "n_docs": N}
+    000000.bin                raw little-endian token stream (one shard)
+    000000.offsets.npy        int64[n_docs_shard + 1] doc boundaries
+    000001.bin / .offsets.npy ...
+
+Shards are memory-mapped (np.memmap), so the working set is paged in by
+the OS on demand — a dataset far larger than host RAM streams fine, and
+the packer reads token spans straight out of the page cache with zero
+Python-side copies of the full stream.
+
+Reference parity note: the upstream reference (klyan/shifu) is an empty
+repository (SURVEY.md) — there is no reference data format to match. The
+format here is the minimal mmap-friendly layout (flat stream + offsets,
+as used by Megatron-style indexed datasets).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Iterable, List, Sequence, Tuple
+
+import numpy as np
+
+_DTYPES = {"uint16": np.uint16, "uint32": np.uint32}
+
+
+def write_shards(
+    docs: Iterable[Sequence[int]],
+    directory: str,
+    *,
+    dtype: str = "uint16",
+    docs_per_shard: int = 1_000_000,
+) -> int:
+    """Write an iterable of token documents into a dataset directory.
+
+    Returns the number of documents written. ``dtype='uint16'`` halves disk
+    and bandwidth for vocabularies < 65536 (the common case).
+    """
+    np_dtype = _DTYPES[dtype]
+    os.makedirs(directory, exist_ok=True)
+    n_docs = 0
+    shard = 0
+    buf: List[np.ndarray] = []
+    offsets = [0]
+
+    def flush():
+        nonlocal shard, buf, offsets
+        if len(offsets) == 1:
+            return
+        stream = (
+            np.concatenate(buf) if buf else np.zeros((0,), np_dtype)
+        ).astype(np_dtype)
+        stream.tofile(os.path.join(directory, f"{shard:06d}.bin"))
+        np.save(
+            os.path.join(directory, f"{shard:06d}.offsets.npy"),
+            np.asarray(offsets, np.int64),
+        )
+        shard += 1
+        buf = []
+        offsets = [0]
+
+    for doc in docs:
+        arr = np.asarray(doc, np_dtype)
+        if arr.size == 0:
+            continue  # empty docs carry no trainable tokens
+        buf.append(arr)
+        offsets.append(offsets[-1] + arr.size)
+        n_docs += 1
+        if len(offsets) - 1 >= docs_per_shard:
+            flush()
+    flush()
+
+    with open(os.path.join(directory, "meta.json"), "w") as f:
+        json.dump({"dtype": dtype, "n_docs": n_docs}, f)
+    return n_docs
+
+
+class TokenDataset:
+    """Memory-mapped view over a dataset directory.
+
+    Documents are addressed globally: doc ``i`` lives in some shard at a
+    local index; :attr:`doc_shard` / :attr:`doc_local` give the mapping as
+    flat arrays so the packer (native or numpy) can follow any global
+    shuffle order without touching Python per document.
+    """
+
+    def __init__(self, directory: str):
+        with open(os.path.join(directory, "meta.json")) as f:
+            meta = json.load(f)
+        self.dtype = _DTYPES[meta["dtype"]]
+        self.dtype_name = meta["dtype"]
+        self.directory = directory
+
+        self.shards: List[np.memmap] = []
+        self.offsets: List[np.ndarray] = []
+        names = sorted(
+            f[:-4] for f in os.listdir(directory) if f.endswith(".bin")
+        )
+        doc_shard: List[np.ndarray] = []
+        doc_local: List[np.ndarray] = []
+        for i, name in enumerate(names):
+            off = np.load(os.path.join(directory, f"{name}.offsets.npy"))
+            data = np.memmap(
+                os.path.join(directory, f"{name}.bin"),
+                dtype=self.dtype,
+                mode="r",
+            )
+            self.shards.append(data)
+            self.offsets.append(off.astype(np.int64))
+            n = len(off) - 1
+            doc_shard.append(np.full((n,), i, np.int32))
+            doc_local.append(np.arange(n, dtype=np.int64))
+        if not self.shards:
+            raise FileNotFoundError(f"no .bin shards in {directory}")
+        self.doc_shard = np.concatenate(doc_shard)
+        self.doc_local = np.concatenate(doc_local)
+        self.n_docs = int(len(self.doc_shard))
+        if self.n_docs != meta["n_docs"]:
+            raise ValueError(
+                f"meta.json says {meta['n_docs']} docs; shards hold "
+                f"{self.n_docs}"
+            )
+        self.n_tokens = int(sum(int(o[-1]) for o in self.offsets))
+
+    def doc(self, i: int) -> np.ndarray:
+        """Token array of global document ``i`` (a zero-copy mmap slice)."""
+        s = int(self.doc_shard[i])
+        j = int(self.doc_local[i])
+        off = self.offsets[s]
+        return self.shards[s][off[j] : off[j + 1]]
+
+    def doc_len(self, i: int) -> int:
+        s = int(self.doc_shard[i])
+        j = int(self.doc_local[i])
+        off = self.offsets[s]
+        return int(off[j + 1] - off[j])

@@ -1,0 +1,143 @@
+"""Deterministic, resumable packed data loading.
+
+``PackedLoader`` turns a :class:`TokenDataset` into an infinite stream of
+fixed-shape training batches:
+
+  * **Deterministic shuffle** — epoch ``e``'s document order is
+    ``default_rng((seed, e)).permutation(n_docs)``; any (seed, state) pair
+    reproduces the exact stream on any host.
+  * **Resumable by value** — ``state_dict()`` is three integers; restoring
+    recomputes the epoch's permutation and continues mid-document. Designed
+    to ride the Checkpointer's JSON ``host_state`` side-channel.
+  * **Packed batches** — concat-and-chunk rows with segment_ids/positions/
+    mask, matching the Transformer.loss contract directly. Rows left
+    incomplete at an epoch boundary are dropped (standard practice; at most
+    one macro-batch per epoch).
+  * ``device_prefetch`` overlaps host packing + H2D transfer with device
+    compute by keeping ``size`` batches in flight.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Mapping, Optional
+
+import numpy as np
+
+from shifu_tpu.data.dataset import TokenDataset
+from shifu_tpu.data.packing import Packer
+
+
+class PackedLoader:
+    def __init__(
+        self,
+        dataset: TokenDataset,
+        *,
+        batch_size: int,
+        seq_len: int,
+        seed: int = 0,
+        shuffle: bool = True,
+        microbatches: Optional[int] = None,
+        use_native: bool = True,
+    ):
+        self.ds = dataset
+        self.batch_size = batch_size
+        self.seq_len = seq_len
+        self.seed = seed
+        self.shuffle = shuffle
+        self.microbatches = microbatches
+        self.packer = Packer(dataset, use_native=use_native)
+        self.rows = batch_size * (microbatches or 1)
+        self._epoch = 0
+        self._cursor = (0, 0)
+        self._set_epoch(0)
+
+    # ------------------------------------------------------------- state
+    def state_dict(self) -> Mapping[str, int]:
+        return {
+            "epoch": self._epoch,
+            "cursor_doc": self._cursor[0],
+            "cursor_tok": self._cursor[1],
+        }
+
+    def load_state_dict(self, state: Mapping[str, int]) -> None:
+        self._set_epoch(int(state["epoch"]))
+        self._cursor = (int(state["cursor_doc"]), int(state["cursor_tok"]))
+
+    def _set_epoch(self, epoch: int) -> None:
+        self._epoch = epoch
+        if self.shuffle:
+            perm = np.random.default_rng((self.seed, epoch)).permutation(
+                self.ds.n_docs
+            )
+        else:
+            perm = np.arange(self.ds.n_docs)
+        self._order_shard = np.ascontiguousarray(self.ds.doc_shard[perm])
+        self._order_doc = np.ascontiguousarray(self.ds.doc_local[perm])
+        self._cursor = (0, 0)
+
+    # ---------------------------------------------------------- iterate
+    def __iter__(self) -> Iterator[Mapping[str, np.ndarray]]:
+        while True:
+            fresh_epoch = self._cursor == (0, 0)
+            batch, cursor, filled = self.packer.pack(
+                self._order_shard,
+                self._order_doc,
+                self._cursor,
+                self.rows,
+                self.seq_len,
+            )
+            if filled < self.rows:  # epoch exhausted; drop partial batch
+                if fresh_epoch:
+                    # A whole epoch can't fill even one macro-batch: error
+                    # out instead of spinning on re-packing forever.
+                    raise ValueError(
+                        f"dataset too small: {self.ds.n_tokens} tokens "
+                        f"cannot fill one {self.rows}x{self.seq_len} batch"
+                    )
+                self._set_epoch(self._epoch + 1)
+                continue
+            self._cursor = cursor
+            if self.microbatches:
+                batch = {
+                    k: v.reshape(
+                        self.microbatches, self.batch_size, self.seq_len
+                    )
+                    for k, v in batch.items()
+                }
+            yield batch
+
+
+def device_prefetch(
+    iterator,
+    mesh=None,
+    rules=None,
+    *,
+    size: int = 2,
+    microbatched: bool = False,
+):
+    """Keep ``size`` batches resident on device ahead of the consumer.
+
+    With a mesh, batches are placed via parallel.shard_batch (batch/seq
+    sharding per rules); otherwise a plain device_put. H2D transfers for
+    batch N+1..N+size overlap the step running on batch N.
+    """
+    import collections
+
+    import jax
+
+    from shifu_tpu.parallel import sharding as shd
+
+    def put(b):
+        if mesh is not None:
+            return shd.shard_batch(
+                b, mesh, rules or shd.DEFAULT_RULES, microbatched=microbatched
+            )
+        return jax.device_put(b)
+
+    buf = collections.deque()
+    for batch in iterator:
+        buf.append(put(batch))
+        if len(buf) >= size:
+            yield buf.popleft()
+    while buf:
+        yield buf.popleft()

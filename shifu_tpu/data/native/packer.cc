@@ -1,0 +1,106 @@
+// Native sequence-packing core for the data loader.
+//
+// Hot host-side inner loop of pretraining input: follow a (shuffled) global
+// document order across memory-mapped shards and fill fixed-shape rows of
+// (tokens, segment_ids, positions) by concat-and-chunk packing. One C call
+// fills a whole macro-batch; Python never loops per document or per token.
+//
+// Semantics (mirrored exactly by the numpy fallback in packing.py):
+//   * documents are laid end-to-end in `order`; rows are consecutive
+//     seq-length windows of that stream;
+//   * segment_ids restart at 1 for the first document in each row and
+//     increment per document; 0 marks unwritten (padding) cells;
+//   * positions are within-document and *continue across row boundaries*
+//     when a document straddles rows (true document positions);
+//   * the cursor (order index, offset within current doc) is caller-owned
+//     state, so iteration is resumable from a checkpoint by value.
+//
+// Built as a plain shared library, loaded via ctypes (no pybind11 in this
+// toolchain). Reference parity note: upstream (klyan/shifu) is an empty
+// repository (SURVEY.md); there is no reference loader to match.
+
+#include <cstdint>
+
+namespace {
+
+template <typename T>
+int64_t pack_chunks(const T* const* shard_bases,
+                    const int64_t* const* shard_offsets,
+                    const int32_t* order_shard, const int64_t* order_doc,
+                    int64_t n_order,
+                    int64_t* cursor_doc,  // in/out: index into order
+                    int64_t* cursor_tok,  // in/out: offset within that doc
+                    uint32_t* out_tokens, int32_t* out_segments,
+                    int32_t* out_positions, int64_t rows, int64_t seq) {
+  int64_t d = *cursor_doc;
+  int64_t t = *cursor_tok;
+  int64_t filled_rows = 0;
+
+  for (int64_t r = 0; r < rows; ++r) {
+    int64_t col = 0;
+    int32_t seg = 0;
+    uint32_t* row_tok = out_tokens + r * seq;
+    int32_t* row_seg = out_segments + r * seq;
+    int32_t* row_pos = out_positions + r * seq;
+
+    while (col < seq && d < n_order) {
+      const int32_t s = order_shard[d];
+      const int64_t j = order_doc[d];
+      const int64_t beg = shard_offsets[s][j];
+      const int64_t end = shard_offsets[s][j + 1];
+      const int64_t remaining = (end - beg) - t;
+      const int64_t take = remaining < (seq - col) ? remaining : (seq - col);
+      ++seg;
+      const T* src = shard_bases[s] + beg + t;
+      for (int64_t k = 0; k < take; ++k) {
+        row_tok[col + k] = static_cast<uint32_t>(src[k]);
+        row_seg[col + k] = seg;
+        row_pos[col + k] = static_cast<int32_t>(t + k);
+      }
+      col += take;
+      t += take;
+      if (t >= end - beg) {  // document finished
+        ++d;
+        t = 0;
+      }
+    }
+    if (col == seq) ++filled_rows;
+    if (d >= n_order && col < seq) break;  // stream exhausted mid-row
+  }
+
+  *cursor_doc = d;
+  *cursor_tok = t;
+  return filled_rows;
+}
+
+}  // namespace
+
+extern "C" {
+
+int64_t pack_chunks_u16(const uint16_t* const* shard_bases,
+                        const int64_t* const* shard_offsets,
+                        const int32_t* order_shard, const int64_t* order_doc,
+                        int64_t n_order, int64_t* cursor_doc,
+                        int64_t* cursor_tok, uint32_t* out_tokens,
+                        int32_t* out_segments, int32_t* out_positions,
+                        int64_t rows, int64_t seq) {
+  return pack_chunks<uint16_t>(shard_bases, shard_offsets, order_shard,
+                               order_doc, n_order, cursor_doc, cursor_tok,
+                               out_tokens, out_segments, out_positions, rows,
+                               seq);
+}
+
+int64_t pack_chunks_u32(const uint32_t* const* shard_bases,
+                        const int64_t* const* shard_offsets,
+                        const int32_t* order_shard, const int64_t* order_doc,
+                        int64_t n_order, int64_t* cursor_doc,
+                        int64_t* cursor_tok, uint32_t* out_tokens,
+                        int32_t* out_segments, int32_t* out_positions,
+                        int64_t rows, int64_t seq) {
+  return pack_chunks<uint32_t>(shard_bases, shard_offsets, order_shard,
+                               order_doc, n_order, cursor_doc, cursor_tok,
+                               out_tokens, out_segments, out_positions, rows,
+                               seq);
+}
+
+}  // extern "C"

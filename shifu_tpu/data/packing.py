@@ -1,0 +1,128 @@
+"""Concat-and-chunk sequence packing over a TokenDataset.
+
+The :class:`Packer` fills fixed-shape (rows, seq) buffers by walking a
+global document order; the hot loop runs in the native core
+(native/packer.cc) when available, with an exactly-equivalent numpy
+fallback. Cursor state is caller-owned (resumable by value).
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Tuple
+
+import numpy as np
+
+from shifu_tpu.data import _native
+from shifu_tpu.data.dataset import TokenDataset
+
+
+class Packer:
+    """Binds a dataset's shard pointers once; packs many batches cheaply."""
+
+    def __init__(self, dataset: TokenDataset, use_native: bool = True):
+        self.ds = dataset
+        self.lib = _native.load() if use_native else None
+        if self.lib is not None:
+            n = len(dataset.shards)
+            self._bases = (ctypes.c_void_p * n)(
+                *[s.ctypes.data for s in dataset.shards]
+            )
+            self._offs = (ctypes.c_void_p * n)(
+                *[o.ctypes.data for o in dataset.offsets]
+            )
+            self._fn = (
+                self.lib.pack_chunks_u16
+                if dataset.dtype == np.uint16
+                else self.lib.pack_chunks_u32
+            )
+
+    @property
+    def native(self) -> bool:
+        return self.lib is not None
+
+    def pack(
+        self,
+        order_shard: np.ndarray,  # int32[n_order]
+        order_doc: np.ndarray,  # int64[n_order]
+        cursor: Tuple[int, int],  # (index into order, offset within doc)
+        rows: int,
+        seq: int,
+    ):
+        """Fill a (rows, seq) macro-batch starting at ``cursor``.
+
+        Returns (batch dict, new_cursor, filled_rows). Cells never written
+        stay 0 in tokens/positions and 0 in segment_ids — ``segment_ids >
+        0`` is the validity mask. ``filled_rows < rows`` means the order
+        was exhausted (end of epoch).
+        """
+        # Normalise the order arrays: the native core reads raw pointers
+        # (ctypes can't check), so an int64 order_shard from argsort or a
+        # strided slice would be read misaligned -> garbage shard indices.
+        # No-op (no copy) when the caller already passes the right layout.
+        order_shard = np.ascontiguousarray(order_shard, np.int32)
+        order_doc = np.ascontiguousarray(order_doc, np.int64)
+        tokens = np.zeros((rows, seq), np.uint32)
+        segments = np.zeros((rows, seq), np.int32)
+        positions = np.zeros((rows, seq), np.int32)
+        if self.lib is not None:
+            d = ctypes.c_int64(cursor[0])
+            t = ctypes.c_int64(cursor[1])
+            filled = self._fn(
+                self._bases,
+                self._offs,
+                order_shard.ctypes.data,
+                order_doc.ctypes.data,
+                len(order_shard),
+                ctypes.byref(d),
+                ctypes.byref(t),
+                tokens.ctypes.data,
+                segments.ctypes.data,
+                positions.ctypes.data,
+                rows,
+                seq,
+            )
+            new_cursor = (int(d.value), int(t.value))
+        else:
+            filled, new_cursor = self._pack_numpy(
+                order_shard, order_doc, cursor, tokens, segments, positions
+            )
+        batch = {
+            "tokens": tokens.astype(np.int32),
+            "segment_ids": segments,
+            "positions": positions,
+            "mask": (segments > 0).astype(np.float32),
+        }
+        return batch, new_cursor, int(filled)
+
+    # ---------------------------------------------------- numpy fallback
+    def _pack_numpy(self, order_shard, order_doc, cursor, tokens, segments,
+                    positions):
+        """Mirror of native/packer.cc (same cursor/segment semantics)."""
+        ds = self.ds
+        d, t = cursor
+        n_order = len(order_shard)
+        rows, seq = tokens.shape
+        filled = 0
+        for r in range(rows):
+            col, seg = 0, 0
+            while col < seq and d < n_order:
+                s = int(order_shard[d])
+                j = int(order_doc[d])
+                off = ds.offsets[s]
+                beg, end = int(off[j]), int(off[j + 1])
+                take = min((end - beg) - t, seq - col)
+                seg += 1
+                tokens[r, col : col + take] = ds.shards[s][beg + t : beg + t + take]
+                segments[r, col : col + take] = seg
+                positions[r, col : col + take] = np.arange(t, t + take)
+                col += take
+                t += take
+                if t >= end - beg:
+                    d += 1
+                    t = 0
+            if col == seq:
+                filled += 1
+            if d >= n_order and col < seq:
+                break
+        return filled, (d, t)

@@ -90,7 +90,8 @@ def wsd(
     any plateau checkpoint).
     """
     if decay_steps is None:
-        decay_steps = max(1, total_steps // 10)
+        decay_steps = total_steps // 10
+    decay_steps = max(1, decay_steps)  # 0 would divide by zero (NaN lr)
     decay_start = total_steps - decay_steps
 
     def schedule(step):
@@ -309,8 +310,11 @@ class SGD:
 
 
 # --------------------------------------------------------------- adafactor
-def _factored(shape) -> bool:
-    return len(shape) >= 2
+def _factored(shape, min_dim: int) -> bool:
+    """Factor only when both trailing dims are large enough to be worth a
+    rank-1 approximation — small trailing dims (stacked norm scales like
+    (layers, dim)) keep an exact full second moment, as in optax."""
+    return len(shape) >= 2 and min(shape[-2:]) >= min_dim
 
 
 def _drop_axis_tmpl(t, axis: int) -> jax.ShapeDtypeStruct:
@@ -348,13 +352,14 @@ class Adafactor:
     b1: float = 0.0  # 0 disables the first moment entirely
     b2_cap: float = 0.999
     eps: float = 1e-30  # floor on squared grads
+    min_dim_size_to_factor: int = 128
     clip_threshold: float = 1.0
     weight_decay: float = 0.0
     grad_clip_norm: Optional[float] = None
 
     def init(self, params):
         def moment(p):
-            if _factored(p.shape):
+            if _factored(p.shape, self.min_dim_size_to_factor):
                 return {
                     "vr": jnp.zeros(p.shape[:-1], jnp.float32),
                     "vc": jnp.zeros(p.shape[:-2] + p.shape[-1:], jnp.float32),
@@ -373,7 +378,7 @@ class Adafactor:
 
     def state_template(self, params_tmpl, scalar):
         def moment(t):
-            if _factored(t.shape):
+            if _factored(t.shape, self.min_dim_size_to_factor):
                 return {
                     "vr": _drop_axis_tmpl(t, -1),
                     "vc": _drop_axis_tmpl(t, -2),
@@ -409,7 +414,7 @@ class Adafactor:
         new_v, updates = [], []
         for p, g, v in zip(leaves, g_leaves, v_leaves):
             g2 = jnp.square(g) + self.eps
-            if _factored(p.shape):
+            if _factored(p.shape, self.min_dim_size_to_factor):
                 vr = b2t * v["vr"] + (1 - b2t) * jnp.mean(g2, axis=-1)
                 vc = b2t * v["vc"] + (1 - b2t) * jnp.mean(g2, axis=-2)
                 # v̂ = (vr ⊗ vc) / mean(vr): rank-1 reconstruction whose

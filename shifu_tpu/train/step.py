@@ -57,10 +57,10 @@ def state_shardings(
     ``optimizer=None`` defaults to AdamW (the mu/nu/step layout).
     """
     optimizer = AdamW() if optimizer is None else optimizer
-    p = shd.param_shardings(model, mesh, rules)
     scalar = NamedSharding(mesh, jax.sharding.PartitionSpec())
 
     params_tmpl = shd.abstract_params(model, mesh, rules)
+    p = jax.tree_util.tree_map(lambda t: t.sharding, params_tmpl)
     opt_tmpl = optimizer.state_template(
         params_tmpl, jax.ShapeDtypeStruct((), jnp.int32, sharding=scalar)
     )

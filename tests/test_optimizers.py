@@ -41,6 +41,12 @@ def test_linear_schedule_anchors():
     assert float(s(100)) == pytest.approx(0.0, abs=1e-6)
 
 
+def test_wsd_decay_steps_zero_no_nan():
+    s = wsd(1.0, 100, decay_steps=0)
+    for t in (0, 50, 100, 150):
+        assert np.isfinite(float(s(t)))
+
+
 def test_wsd_schedule_anchors():
     s = wsd(1.0, 100, warmup_steps=10, decay_steps=20)
     assert float(s(10)) == pytest.approx(1.0)
@@ -119,7 +125,7 @@ def test_state_template_matches_init(opt):
 
 def test_adafactor_factored_shapes():
     params = {"w": jnp.zeros((3, 8, 4)), "b": jnp.zeros((5,))}
-    state = Adafactor().init(params)
+    state = Adafactor(min_dim_size_to_factor=4).init(params)
     assert state["v"]["w"]["vr"].shape == (3, 8)
     assert state["v"]["w"]["vc"].shape == (3, 4)
     assert state["v"]["b"]["v"].shape == (5,)
@@ -127,12 +133,23 @@ def test_adafactor_factored_shapes():
     assert "mu" in Adafactor(b1=0.9).init(params)
 
 
+def test_adafactor_small_trailing_dims_not_factored():
+    # Stacked norm scales (layers, dim) with a small trailing dim keep an
+    # exact full second moment (the default 128 floor, as in optax).
+    params = {"scale": jnp.zeros((16, 64))}
+    state = Adafactor().init(params)
+    assert "v" in state["v"]["scale"]
+    assert state["v"]["scale"]["v"].shape == (16, 64)
+
+
 def test_adafactor_rank1_reconstruction_tracks_adam_nu():
     # For a rank-1 squared-grad pattern, the factored estimate must equal
     # the full second moment (the reconstruction is exact on rank-1).
     g = jnp.asarray(np.outer([1.0, 2.0, 4.0], [1.0, 3.0]), jnp.float32)
     params = {"w": jnp.zeros_like(g)}
-    opt = Adafactor(schedule=constant(1.0), clip_threshold=0.0)
+    opt = Adafactor(
+        schedule=constant(1.0), clip_threshold=0.0, min_dim_size_to_factor=2
+    )
     state = opt.init(params)
     _, state, _ = opt.update({"w": g}, state, params)
     vr, vc = state["v"]["w"]["vr"], state["v"]["w"]["vc"]
@@ -196,7 +213,9 @@ def test_sharded_train_step_with_optimizer(devices, opt):
 def test_adafactor_sharded_moments_inherit_param_sharding(devices):
     mesh = MeshPlan(fsdp=2, tp=2, sp=2).build()
     model = Transformer(TransformerConfig.tiny())
-    sh = state_shardings(model, mesh, optimizer=Adafactor())
+    sh = state_shardings(
+        model, mesh, optimizer=Adafactor(min_dim_size_to_factor=2)
+    )
     # w_gate: (L, d, m) -> P("pp", "fsdp", "tp"); vr drops the last axis.
     from jax.sharding import PartitionSpec as P
 
