@@ -17,22 +17,7 @@ import time
 import jax
 import jax.numpy as jnp
 
-# Peak bf16 FLOP/s per chip, for MFU. Unknown platforms -> None (MFU omitted).
-PEAK_FLOPS = {
-    "TPU v5 lite": 197e12,  # v5e
-    "TPU v5e": 197e12,
-    "TPU v4": 275e12,
-    "TPU v5p": 459e12,
-    "TPU v6 lite": 918e12,  # v6e / Trillium
-}
-
-
-def _peak_flops(device) -> float | None:
-    kind = getattr(device, "device_kind", "")
-    for prefix, peak in PEAK_FLOPS.items():
-        if kind.startswith(prefix):
-            return peak
-    return None
+from shifu_tpu.utils.metrics import peak_flops as _peak_flops
 
 
 def main():
@@ -79,10 +64,12 @@ def main():
     # the "hardware FLOPs" view; MFU conventionally uses the 6N model view).
     from shifu_tpu.core.module import param_count
 
+    from shifu_tpu.utils.metrics import transformer_flops_per_token
+
     n_params = param_count(params)
-    hd = cfg.resolved_head_dim
-    attn_flops_per_tok = 12 * seq * hd * cfg.n_heads * cfg.n_layers
-    flops_per_tok = 6 * n_params + attn_flops_per_tok
+    flops_per_tok = transformer_flops_per_token(
+        n_params, seq, cfg.resolved_head_dim, cfg.n_heads, cfg.n_layers
+    )
     achieved = tokens_per_s * flops_per_tok
 
     out = {

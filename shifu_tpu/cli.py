@@ -1,0 +1,171 @@
+"""Command-line entry points: ``python -m shifu_tpu <cmd>``.
+
+    train   run the Trainer loop (real corpus dir or --synthetic)
+    info    devices, native-extension status, version
+
+The CLI builds everything from flags — model preset (optionally MoE),
+optimizer + schedule, mesh plan — and is the reference example of wiring
+the framework end to end.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _build_mesh(spec: str):
+    """'fsdp=2,tp=2' -> built Mesh (axes validated by MeshPlan)."""
+    from shifu_tpu.parallel import MeshPlan
+
+    kw = {}
+    for part in spec.split(","):
+        name, _, val = part.partition("=")
+        kw[name.strip()] = int(val)
+    return MeshPlan(**kw).build()
+
+
+def _build_optimizer(args, total_steps: int):
+    from shifu_tpu import train as T
+
+    sched = {
+        "constant": lambda: T.constant(args.lr),
+        "cosine": lambda: T.warmup_cosine(
+            args.lr, total_steps, warmup_steps=args.warmup
+        ),
+        "linear": lambda: T.linear(args.lr, total_steps, warmup_steps=args.warmup),
+        "wsd": lambda: T.wsd(args.lr, total_steps, warmup_steps=args.warmup),
+        "inverse_sqrt": lambda: T.inverse_sqrt(args.lr, max(1, args.warmup)),
+    }[args.schedule]()
+    return {
+        "adamw": lambda: T.AdamW(schedule=sched),
+        "lion": lambda: T.Lion(schedule=sched),
+        "adafactor": lambda: T.Adafactor(schedule=sched),
+        "sgd": lambda: T.SGD(schedule=sched),
+    }[args.optimizer]()
+
+
+def _build_model(args):
+    import dataclasses
+
+    from shifu_tpu.models import Transformer, TransformerConfig
+
+    cfg = {
+        "tiny": TransformerConfig.tiny,
+        "small": TransformerConfig.small,
+        "1b": TransformerConfig.base_1b,
+        "7b": TransformerConfig.large_7b,
+    }[args.preset]()
+    if args.moe_experts:
+        cfg = dataclasses.replace(cfg, n_experts=args.moe_experts)
+    if args.attn:
+        cfg = dataclasses.replace(cfg, attn_impl=args.attn)
+    return Transformer(cfg)
+
+
+def cmd_train(args) -> int:
+    import jax
+
+    from shifu_tpu.train.loop import Trainer, TrainLoopConfig
+
+    model = _build_model(args)
+    optimizer = _build_optimizer(args, args.steps)
+    mesh = _build_mesh(args.mesh) if args.mesh else None
+
+    if args.data:
+        from shifu_tpu.data import PackedLoader, TokenDataset
+
+        loader = PackedLoader(
+            TokenDataset(args.data),
+            batch_size=args.batch_size,
+            seq_len=args.seq_len,
+            seed=args.seed,
+            microbatches=args.microbatches,
+        )
+    else:
+        from shifu_tpu.data.synthetic import SyntheticLoader
+
+        loader = SyntheticLoader(
+            vocab_size=model.cfg.vocab_size,
+            batch_size=args.batch_size,
+            seq_len=args.seq_len,
+            seed=args.seed,
+            microbatches=args.microbatches,
+        )
+
+    cfg = TrainLoopConfig(
+        total_steps=args.steps,
+        log_every=args.log_every,
+        ckpt_dir=args.ckpt_dir,
+        ckpt_every=args.ckpt_every,
+        metrics_path=args.metrics,
+        microbatches=args.microbatches,
+    )
+    trainer = Trainer(
+        model,
+        optimizer,
+        loader,
+        cfg,
+        mesh=mesh,
+        rng=jax.random.key(args.seed),
+    )
+    state = trainer.run()
+    print(f"done: step={int(state.step)}")
+    return 0
+
+
+def cmd_info(args) -> int:
+    import jax
+
+    import shifu_tpu
+    from shifu_tpu.data import native_available
+
+    info = {
+        "version": shifu_tpu.__version__,
+        "backend": jax.default_backend(),
+        "devices": [str(d) for d in jax.devices()],
+        "native_packer": native_available(),
+    }
+    print(json.dumps(info, indent=2))
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="shifu_tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    t = sub.add_parser("train", help="run the training loop")
+    t.add_argument("--data", help="dataset dir (write_shards layout)")
+    t.add_argument("--preset", default="tiny",
+                   choices=["tiny", "small", "1b", "7b"])
+    t.add_argument("--moe-experts", type=int, default=0)
+    t.add_argument("--attn", choices=["xla", "flash", "ring"], default=None)
+    t.add_argument("--steps", type=int, default=100)
+    t.add_argument("--batch-size", type=int, default=8)
+    t.add_argument("--seq-len", type=int, default=513)
+    t.add_argument("--microbatches", type=int, default=None)
+    t.add_argument("--optimizer", default="adamw",
+                   choices=["adamw", "lion", "adafactor", "sgd"])
+    t.add_argument("--schedule", default="cosine",
+                   choices=["constant", "cosine", "linear", "wsd",
+                            "inverse_sqrt"])
+    t.add_argument("--lr", type=float, default=3e-4)
+    t.add_argument("--warmup", type=int, default=0)
+    t.add_argument("--mesh", help="e.g. fsdp=4,tp=2 (axes of MeshPlan)")
+    t.add_argument("--ckpt-dir")
+    t.add_argument("--ckpt-every", type=int, default=1000)
+    t.add_argument("--metrics", help="JSONL metrics path")
+    t.add_argument("--log-every", type=int, default=10)
+    t.add_argument("--seed", type=int, default=0)
+    t.set_defaults(fn=cmd_train)
+
+    i = sub.add_parser("info", help="environment / device info")
+    i.set_defaults(fn=cmd_info)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -63,6 +63,10 @@ class PackedLoader:
         self._set_epoch(int(state["epoch"]))
         self._cursor = (int(state["cursor_doc"]), int(state["cursor_tok"]))
 
+    def reset(self) -> None:
+        """Rewind to the start of the stream (epoch 0, cursor 0)."""
+        self._set_epoch(0)
+
     def _set_epoch(self, epoch: int) -> None:
         self._epoch = epoch
         if self.shuffle:

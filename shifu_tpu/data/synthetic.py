@@ -1,0 +1,52 @@
+"""Synthetic token stream: loader-shaped random batches.
+
+Drop-in for PackedLoader in smoke tests, benchmarks and CLI runs without a
+corpus. Deterministic per batch index (rng keyed on (seed, index)), so it
+is resumable by value like the real loader.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Mapping, Optional
+
+import numpy as np
+
+
+class SyntheticLoader:
+    def __init__(
+        self,
+        *,
+        vocab_size: int,
+        batch_size: int,
+        seq_len: int,
+        seed: int = 0,
+        microbatches: Optional[int] = None,
+    ):
+        self.vocab_size = vocab_size
+        self.batch_size = batch_size
+        self.seq_len = seq_len
+        self.seed = seed
+        self.microbatches = microbatches
+        self._index = 0
+
+    def state_dict(self) -> Mapping[str, int]:
+        return {"index": self._index}
+
+    def load_state_dict(self, state: Mapping[str, int]) -> None:
+        self._index = int(state.get("index", 0))
+
+    def reset(self) -> None:
+        self._index = 0
+
+    def __iter__(self) -> Iterator[Mapping[str, np.ndarray]]:
+        while True:
+            shape = (self.batch_size, self.seq_len)
+            if self.microbatches:
+                shape = (self.microbatches,) + shape
+            rng = np.random.default_rng((self.seed, self._index))
+            self._index += 1
+            yield {
+                "tokens": rng.integers(
+                    0, self.vocab_size, size=shape, dtype=np.int32
+                )
+            }

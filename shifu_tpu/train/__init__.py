@@ -10,6 +10,7 @@ from shifu_tpu.train.optimizer import (
     warmup_cosine,
     wsd,
 )
+from shifu_tpu.train.loop import Trainer, TrainLoopConfig, evaluate
 from shifu_tpu.train.step import (
     TrainState,
     create_sharded_state,
@@ -28,6 +29,9 @@ __all__ = [
     "linear",
     "warmup_cosine",
     "wsd",
+    "Trainer",
+    "TrainLoopConfig",
+    "evaluate",
     "TrainState",
     "create_sharded_state",
     "make_train_step",

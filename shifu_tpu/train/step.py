@@ -26,7 +26,7 @@ from jax.sharding import Mesh, NamedSharding
 
 from shifu_tpu.parallel import sharding as shd
 from shifu_tpu.parallel.ctx import activation_sharding
-from shifu_tpu.train.optimizer import AdamW
+from shifu_tpu.train.optimizer import AdamW, global_norm
 
 
 @jax.tree_util.register_dataclass
@@ -87,6 +87,7 @@ def make_train_step(
     mesh: Optional[Mesh] = None,
     rules: Mapping = shd.DEFAULT_RULES,
     microbatches: Optional[int] = None,
+    skip_nonfinite: bool = False,
 ):
     """Build the jitted train step.
 
@@ -96,6 +97,11 @@ def make_train_step(
         batch over (dp/fsdp, sp)); if None, single-device jit.
       microbatches: if set, batch leaves must have a leading microbatch
         axis of this size; gradients are accumulated over it via lax.scan.
+      skip_nonfinite: fault-tolerance guard — when the gradient global
+        norm is NaN/Inf the optimizer update is skipped entirely (params,
+        moments and step counter unchanged) via ``lax.cond`` inside the
+        jit, and ``metrics["skipped"]`` is 1.0. One bad batch then costs
+        one data batch, not the run.
 
     Returns:
       step(state, batch) -> (state, metrics)
@@ -154,9 +160,33 @@ def make_train_step(
             if mesh is not None:
                 ctx.enter_context(activation_sharding(mesh, rules))
             loss, aux, grads = loss_and_grads(state.params, batch)
-            new_params, new_opt, stats = optimizer.update(
-                grads, state.opt, state.params, decay_mask=decay_mask
-            )
+            if not skip_nonfinite:
+                new_params, new_opt, stats = optimizer.update(
+                    grads, state.opt, state.params, decay_mask=decay_mask
+                )
+            else:
+                gnorm = global_norm(grads)
+                finite = jnp.isfinite(gnorm)
+
+                def do_update(_):
+                    return optimizer.update(
+                        grads, state.opt, state.params, decay_mask=decay_mask
+                    )
+
+                def skip_update(_):
+                    # Same pytree structure as optimizer.update's output:
+                    # untouched state, stats reporting the bad norm, lr 0.
+                    stats = {
+                        "grad_norm": gnorm,
+                        "lr": jnp.zeros((), jnp.float32),
+                    }
+                    return state.params, state.opt, stats
+
+                new_params, new_opt, stats = jax.lax.cond(
+                    finite, do_update, skip_update, None
+                )
+                stats = dict(stats)
+                stats["skipped"] = (~finite).astype(jnp.float32)
         new_state = TrainState(params=new_params, opt=new_opt)
         metrics = {"loss": loss, **aux, **stats}
         return new_state, metrics

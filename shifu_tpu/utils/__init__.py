@@ -1,0 +1,23 @@
+from shifu_tpu.utils.metrics import (
+    MetricsLogger,
+    Throughput,
+    attention_flops_per_token,
+    peak_flops,
+)
+from shifu_tpu.utils.profiling import (
+    device_memory_stats,
+    live_array_bytes,
+    profile_steps,
+    trace,
+)
+
+__all__ = [
+    "MetricsLogger",
+    "Throughput",
+    "attention_flops_per_token",
+    "peak_flops",
+    "device_memory_stats",
+    "live_array_bytes",
+    "profile_steps",
+    "trace",
+]

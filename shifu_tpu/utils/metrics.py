@@ -1,0 +1,117 @@
+"""Structured metrics logging + throughput/MFU accounting.
+
+``MetricsLogger`` writes one JSON line per step (the same shape the bench
+and the driver consume) and optionally mirrors a compact summary to stdout.
+``Throughput`` turns step wall-times into tokens/s and model-FLOPs
+utilisation against the chip's peak — the two numbers that matter when
+deciding whether a TPU run is healthy.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import time
+from typing import Any, Mapping, Optional
+
+# Peak bf16 FLOP/s per chip keyed by device_kind prefix (MFU denominator).
+PEAK_FLOPS = {
+    "TPU v5 lite": 197e12,  # v5e
+    "TPU v5e": 197e12,
+    "TPU v4": 275e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,  # v6e / Trillium
+}
+
+
+def peak_flops(device) -> Optional[float]:
+    kind = getattr(device, "device_kind", "")
+    for prefix, peak in PEAK_FLOPS.items():
+        if kind.startswith(prefix):
+            return peak
+    return None
+
+
+def attention_flops_per_token(seq: int, head_dim: int, n_heads: int,
+                              n_layers: int) -> float:
+    return 12.0 * seq * head_dim * n_heads * n_layers
+
+
+def transformer_flops_per_token(
+    n_params: int, seq: int, head_dim: int, n_heads: int, n_layers: int
+) -> float:
+    """6N + attention quadratic term — the standard MFU numerator (fwd+bwd)."""
+    return 6.0 * n_params + attention_flops_per_token(
+        seq, head_dim, n_heads, n_layers
+    )
+
+
+class Throughput:
+    """Rolling tokens/s + MFU over the last ``window`` steps."""
+
+    def __init__(self, tokens_per_step: int, flops_per_token: float = 0.0,
+                 window: int = 20):
+        self.tokens_per_step = tokens_per_step
+        self.flops_per_token = flops_per_token
+        self._times = collections.deque(maxlen=window + 1)
+
+    def tick(self) -> None:
+        self._times.append(time.perf_counter())
+
+    @property
+    def steps_per_s(self) -> Optional[float]:
+        if len(self._times) < 2:
+            return None
+        dt = self._times[-1] - self._times[0]
+        return (len(self._times) - 1) / dt if dt > 0 else None
+
+    @property
+    def tokens_per_s(self) -> Optional[float]:
+        sps = self.steps_per_s
+        return None if sps is None else sps * self.tokens_per_step
+
+    def mfu(self, peak: Optional[float]) -> Optional[float]:
+        tps = self.tokens_per_s
+        if tps is None or not peak or not self.flops_per_token:
+            return None
+        return tps * self.flops_per_token / peak
+
+
+class MetricsLogger:
+    """Append-only JSONL metrics stream (+ optional stdout echo).
+
+    Each ``log`` call writes ``{"step": n, ...scalars}``; values are
+    coerced to python floats (device scalars sync here — call it at the
+    logging cadence, not every step, if host round-trips matter).
+    """
+
+    def __init__(self, path: Optional[str] = None, echo: bool = True):
+        self.path = path
+        self.echo = echo
+        self._f = None
+        if path:
+            os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+            self._f = open(path, "a", buffering=1)
+
+    def log(self, step: int, metrics: Mapping[str, Any]) -> None:
+        rec = {"step": int(step)}
+        for k, v in metrics.items():
+            try:
+                rec[k] = float(v)
+            except (TypeError, ValueError):
+                rec[k] = v
+        if self._f:
+            self._f.write(json.dumps(rec) + "\n")
+        if self.echo:
+            body = " ".join(
+                f"{k}={v:.4g}" if isinstance(v, float) else f"{k}={v}"
+                for k, v in rec.items()
+                if k != "step"
+            )
+            print(f"[step {rec['step']}] {body}", flush=True)
+
+    def close(self) -> None:
+        if self._f:
+            self._f.close()
+            self._f = None

@@ -1,0 +1,68 @@
+"""Profiling + device introspection helpers.
+
+Thin, dependency-free wrappers over jax.profiler: capture a trace for N
+steps (viewable in Perfetto / TensorBoard), and read device memory stats
+without caring which backend populates which fields.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Dict, List, Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """Capture a profiler trace of the enclosed block into ``log_dir``."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def profile_steps(step_fn, state, batch, *, log_dir: str, steps: int = 3):
+    """Run ``steps`` iterations of ``step_fn`` under a trace.
+
+    The first call is executed OUTSIDE the trace so compilation doesn't
+    drown the timeline. Returns the final (state, metrics).
+    """
+    state, metrics = step_fn(state, batch)  # compile outside the trace
+    jax.block_until_ready(metrics)
+    with trace(log_dir):
+        for _ in range(steps):
+            state, metrics = step_fn(state, batch)
+        jax.block_until_ready(metrics)
+    return state, metrics
+
+
+def device_memory_stats() -> List[Dict[str, Any]]:
+    """Per-device memory stats (bytes_in_use / peak / limit when exposed).
+
+    Backends differ in which keys they populate; missing stats yield an
+    empty dict for that device rather than raising.
+    """
+    out = []
+    for d in jax.devices():
+        try:
+            stats = d.memory_stats() or {}
+        except Exception:
+            stats = {}
+        out.append(
+            {
+                "device": str(d),
+                "bytes_in_use": stats.get("bytes_in_use"),
+                "peak_bytes_in_use": stats.get("peak_bytes_in_use"),
+                "bytes_limit": stats.get("bytes_limit"),
+            }
+        )
+    return out
+
+
+def live_array_bytes() -> int:
+    """Total bytes of live jax.Arrays (host view; any backend)."""
+    return sum(
+        x.nbytes for x in jax.live_arrays() if hasattr(x, "nbytes")
+    )

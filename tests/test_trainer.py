@@ -1,0 +1,253 @@
+"""Trainer loop, fault tolerance, resume, eval, observability utils, CLI."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from shifu_tpu.data.synthetic import SyntheticLoader
+from shifu_tpu.models import Transformer, TransformerConfig
+from shifu_tpu.train import (
+    AdamW,
+    Trainer,
+    TrainLoopConfig,
+    TrainState,
+    constant,
+    evaluate,
+    make_train_step,
+)
+
+
+# ------------------------------------------------------- skip_nonfinite
+class _Dot:
+    """Minimal model: loss = w · x (grads = x, so NaN x -> NaN grads)."""
+
+    def init(self, rng):
+        return {"w": jnp.ones((4,))}
+
+    def loss(self, params, batch):
+        return jnp.dot(params["w"], batch["x"]), {"d": jnp.float32(1)}
+
+
+def test_skip_nonfinite_guard():
+    model = _Dot()
+    opt = AdamW(schedule=constant(0.1), weight_decay=0.0)
+    state = TrainState.create(model.init(None), opt)
+    step = make_train_step(model, opt, skip_nonfinite=True)
+
+    bad = {"x": jnp.asarray([1.0, jnp.nan, 1.0, 1.0])}
+    state2, m = step(state, bad)
+    assert float(m["skipped"]) == 1.0
+    assert int(state2.step) == 0  # counter untouched
+    np.testing.assert_array_equal(state2.params["w"], 1.0)
+
+    good = {"x": jnp.ones((4,))}
+    state3, m = step(state2, good)
+    assert float(m["skipped"]) == 0.0
+    assert int(state3.step) == 1
+    assert float(jnp.max(jnp.abs(state3.params["w"] - 1.0))) > 0
+
+
+# ------------------------------------------------------------- trainer
+def _trainer(tmp_path, steps, ckpt=False, seed=0):
+    model = Transformer(TransformerConfig.tiny())
+    loader = SyntheticLoader(
+        vocab_size=256, batch_size=2, seq_len=17, seed=seed
+    )
+    cfg = TrainLoopConfig(
+        total_steps=steps,
+        log_every=2,
+        ckpt_dir=str(tmp_path / "ckpt") if ckpt else None,
+        ckpt_every=2,
+        metrics_path=str(tmp_path / "metrics.jsonl"),
+        echo=False,
+        skip_nonfinite=False,
+    )
+    return Trainer(
+        model,
+        AdamW(schedule=constant(1e-3)),
+        loader,
+        cfg,
+        rng=jax.random.key(1),
+    )
+
+
+def test_trainer_runs_and_logs(tmp_path):
+    tr = _trainer(tmp_path, steps=4)
+    state = tr.run()
+    assert int(state.step) == 4
+    lines = [
+        json.loads(l)
+        for l in (tmp_path / "metrics.jsonl").read_text().splitlines()
+    ]
+    assert lines[-1]["step"] == 4
+    assert np.isfinite(lines[-1]["loss"])
+    assert "tokens_per_s" in lines[-1]
+
+
+def test_trainer_resume_matches_straight_run(tmp_path):
+    straight = _trainer(tmp_path / "a", steps=6)
+    s_final = straight.run()
+
+    part1 = _trainer(tmp_path / "b", steps=3, ckpt=True)
+    part1.run()
+    part2 = _trainer(tmp_path / "b", steps=6, ckpt=True)
+    assert int(part2.state.step) == 3  # auto-resumed
+    assert part2.loader.state_dict()["index"] == 3  # data cursor restored
+    r_final = part2.run()
+
+    assert int(r_final.step) == 6
+    for a, b in zip(
+        jax.tree_util.tree_leaves(s_final.params),
+        jax.tree_util.tree_leaves(r_final.params),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            rtol=2e-5, atol=1e-6,
+        )
+
+
+def test_trainer_aborts_on_persistent_nans(tmp_path):
+    class NaNLoader(SyntheticLoader):
+        def __iter__(self):
+            for b in super().__iter__():
+                yield {"tokens": b["tokens"], "mask": np.full(
+                    b["tokens"].shape, np.nan, np.float32
+                )}
+
+    model = Transformer(TransformerConfig.tiny())
+    loader = NaNLoader(vocab_size=256, batch_size=2, seq_len=17)
+    cfg = TrainLoopConfig(
+        total_steps=50,
+        log_every=1,
+        echo=False,
+        skip_nonfinite=True,
+        max_consecutive_skipped=3,
+    )
+    tr = Trainer(model, AdamW(), loader, cfg)
+    with pytest.raises(RuntimeError, match="non-finite"):
+        tr.run()
+
+
+def test_evaluate_restores_loader_and_reports_ppl(tmp_path):
+    model = Transformer(TransformerConfig.tiny())
+    params = model.init(jax.random.key(0))
+    loader = SyntheticLoader(vocab_size=256, batch_size=2, seq_len=17, seed=3)
+    # advance the cursor, then check evaluate rewinds + restores
+    it = iter(loader)
+    next(it), next(it)
+    before = loader.state_dict()
+    out = evaluate(model, params, loader, max_batches=3)
+    assert loader.state_dict() == before
+    assert out["tokens"] == 3 * 2 * 16
+    assert out["ppl"] == pytest.approx(np.exp(out["ce"]), rel=1e-6)
+    # untrained model on uniform-random tokens: ce ~ log(vocab)
+    assert abs(out["ce"] - np.log(256)) < 1.0
+
+
+# ---------------------------------------------------------------- utils
+def test_metrics_logger_jsonl(tmp_path):
+    from shifu_tpu.utils import MetricsLogger
+
+    path = str(tmp_path / "m.jsonl")
+    lg = MetricsLogger(path, echo=False)
+    lg.log(1, {"loss": jnp.float32(2.5), "note": "x"})
+    lg.log(2, {"loss": 2.0})
+    lg.close()
+    lines = [json.loads(l) for l in open(path)]
+    assert lines[0] == {"step": 1, "loss": 2.5, "note": "x"}
+    assert lines[1]["step"] == 2
+
+
+def test_throughput_window():
+    import time
+
+    from shifu_tpu.utils import Throughput
+
+    thr = Throughput(tokens_per_step=100, flops_per_token=10.0)
+    assert thr.tokens_per_s is None
+    for _ in range(3):
+        thr.tick()
+        time.sleep(0.01)
+    tps = thr.tokens_per_s
+    assert tps is not None and 100 < tps < 100 / 0.01 * 1.5
+    assert thr.mfu(peak=1e6) == pytest.approx(tps * 10.0 / 1e6)
+
+
+def test_device_memory_stats(devices):
+    from shifu_tpu.utils import device_memory_stats
+
+    stats = device_memory_stats()
+    assert len(stats) == 8
+    assert all("device" in s for s in stats)
+
+
+def test_profile_steps_writes_trace(tmp_path):
+    from shifu_tpu.utils import profile_steps
+
+    step = jax.jit(lambda s, b: (s + b["x"], {"loss": jnp.sum(s)}))
+    state = jnp.zeros((4,))
+    state, metrics = profile_steps(
+        step, state, {"x": jnp.ones((4,))}, log_dir=str(tmp_path), steps=2
+    )
+    assert float(metrics["loss"]) > 0
+    assert any(tmp_path.rglob("*"))  # trace artifacts exist
+
+
+# ------------------------------------------------------------------ cli
+def test_cli_info(capsys):
+    from shifu_tpu.cli import main
+
+    assert main(["info"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["native_packer"] is True
+    assert len(out["devices"]) == 8
+
+
+def test_cli_train_synthetic(tmp_path):
+    from shifu_tpu.cli import main
+
+    rc = main(
+        [
+            "train",
+            "--preset", "tiny",
+            "--steps", "3",
+            "--batch-size", "2",
+            "--seq-len", "17",
+            "--schedule", "constant",
+            "--log-every", "2",
+            "--metrics", str(tmp_path / "m.jsonl"),
+        ]
+    )
+    assert rc == 0
+    lines = (tmp_path / "m.jsonl").read_text().splitlines()
+    assert json.loads(lines[-1])["step"] == 3
+
+
+def test_cli_train_with_mesh_and_data(tmp_path):
+    import numpy as np
+
+    from shifu_tpu.cli import main
+    from shifu_tpu.data import write_shards
+
+    rng = np.random.RandomState(0)
+    d = str(tmp_path / "ds")
+    write_shards(
+        [rng.randint(1, 256, size=50).tolist() for _ in range(40)], d
+    )
+    rc = main(
+        [
+            "train",
+            "--data", d,
+            "--preset", "tiny",
+            "--steps", "2",
+            "--batch-size", "2",
+            "--seq-len", "17",
+            "--schedule", "constant",
+            "--mesh", "fsdp=2,sp=2,tp=2",
+            "--metrics", str(tmp_path / "m.jsonl"),
+        ]
+    )
+    assert rc == 0
