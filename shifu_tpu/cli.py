@@ -73,6 +73,9 @@ def cmd_train(args) -> int:
     optimizer = _build_optimizer(args, args.steps)
     mesh = _build_mesh(args.mesh) if args.mesh else None
 
+    if args.data and args.synthetic:
+        print("--data and --synthetic are mutually exclusive", file=sys.stderr)
+        return 2
     if args.data:
         from shifu_tpu.data import PackedLoader, TokenDataset
 
@@ -137,6 +140,11 @@ def main(argv=None) -> int:
 
     t = sub.add_parser("train", help="run the training loop")
     t.add_argument("--data", help="dataset dir (write_shards layout)")
+    t.add_argument(
+        "--synthetic",
+        action="store_true",
+        help="random-token data (the default when --data is omitted)",
+    )
     t.add_argument("--preset", default="tiny",
                    choices=["tiny", "small", "1b", "7b"])
     t.add_argument("--moe-experts", type=int, default=0)

@@ -8,5 +8,20 @@ is the standard prefill + KV-cache decode design, TPU-first (static shapes,
 
 from shifu_tpu.infer.sampling import SampleConfig, sample_logits
 from shifu_tpu.infer.generate import generate, make_generate_fn
+from shifu_tpu.infer.quant import (
+    QuantizedModel,
+    dequantize_params,
+    param_nbytes,
+    quantize_params,
+)
 
-__all__ = ["SampleConfig", "sample_logits", "generate", "make_generate_fn"]
+__all__ = [
+    "SampleConfig",
+    "sample_logits",
+    "generate",
+    "make_generate_fn",
+    "QuantizedModel",
+    "dequantize_params",
+    "param_nbytes",
+    "quantize_params",
+]

@@ -469,6 +469,39 @@ class Transformer(Module):
             aux.update({f"moe_{k}": v for k, v in moe_aux.items()})
         return loss, aux
 
+    # ------------------------------------------------------------- quant
+    def quant_spec(self):
+        """Params-structured tree of matmul-contraction axes for int8
+        weight-only quantization (infer.quant). ``()`` = keep full
+        precision: norm scales (tiny, sensitive), the embedding table (it
+        feeds a gather, not a matmul), and the MoE router (tiny, and its
+        logits pick experts — rounding them moves routing decisions).
+        """
+        cfg = self.cfg
+        blocks = {
+            "attn_norm": (),
+            "mlp_norm": (),
+            # stacked (L, d, h, hd): contraction is the embed axis.
+            "wq": (1,),
+            "wk": (1,),
+            "wv": (1,),
+            # (L, h, hd, d): contraction is (heads, head_dim).
+            "wo": (1, 2),
+        }
+        if cfg.n_experts:
+            blocks["router"] = ()
+            blocks["w_gate"] = (2,)  # (L, E, d, m): contract d
+            blocks["w_up"] = (2,)
+            blocks["w_down"] = (2,)  # (L, E, m, d): contract m
+        else:
+            blocks["w_gate"] = (1,)  # (L, d, m): contract d
+            blocks["w_up"] = (1,)
+            blocks["w_down"] = (1,)  # (L, m, d): contract m
+        spec = {"embed": (), "blocks": blocks, "final_norm": ()}
+        if not cfg.tie_embeddings:
+            spec["unembed"] = (0,)  # (d, V): contract d
+        return spec
+
     # ------------------------------------------------------------------ cache
     def init_cache(self, batch_size: int, max_seq_len: int, dtype=jnp.bfloat16):
         """Preallocated stacked KV cache: leaves (layers, b, s_max, kv, hd).

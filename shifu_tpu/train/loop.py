@@ -137,6 +137,15 @@ class Trainer:
             host["loader"] = dict(self._loader_state)
         return host
 
+    def close(self) -> None:
+        """Release the metrics file and the checkpointer's background
+        machinery. run() calls this on exit; call it directly if a Trainer
+        is constructed but never run."""
+        self.logger.close()
+        if self.ckpt is not None:
+            self.ckpt.close()
+            self.ckpt = None
+
     # -------------------------------------------------------------- run
     def run(self) -> TrainState:
         cfg = self.cfg
@@ -144,6 +153,7 @@ class Trainer:
         if start is None:
             start = int(self.state.step)
         if start >= cfg.total_steps:
+            self.close()
             return self.state
 
         from shifu_tpu.data.loader import device_prefetch
@@ -176,12 +186,16 @@ class Trainer:
         )
 
         def next_batch():
+            # Returns (batch, cursor-after-producing-it). The cursor is
+            # adopted into self._loader_state only AFTER step_fn for this
+            # batch is dispatched — a crash between fetch and step then
+            # checkpoints the OLD cursor, so resume retrains this batch
+            # instead of silently skipping it.
             b = next(prefetched)
-            if resumable:
-                self._loader_state = pending_states.popleft()
-            return b
+            st = dict(pending_states.popleft()) if resumable else None
+            return b, st
 
-        first = next_batch()
+        first, first_state = next_batch()
         tokens_per_step = int(
             np.prod(jax.tree_util.tree_leaves(first)[0].shape[:-1])
         ) * (first["tokens"].shape[-1] - 1)
@@ -197,10 +211,16 @@ class Trainer:
         opt_step_at_last_log = int(self.state.step)
         loop_at_last_log = start
         metrics = {}
-        batch = first
+        batch, batch_state = first, first_state
         try:
             for n in range(start, cfg.total_steps):
                 self.state, metrics = self.step_fn(self.state, batch)
+                # Adopt the cursor + loop label together, right after the
+                # step consuming this batch is dispatched — every later
+                # save (interval or crash-path) is then self-consistent.
+                if resumable:
+                    self._loader_state = batch_state
+                self._loop_step = n + 1
                 thr.tick()
 
                 if (n + 1) % cfg.log_every == 0 or n + 1 == cfg.total_steps:
@@ -246,10 +266,9 @@ class Trainer:
                     # save() gates itself on ckpt_every internally.
                     # Labels are LOOP steps (monotone even under skips).
                     self.ckpt.save(n + 1, self.state, self._host_state(n + 1))
-                self._loop_step = n + 1
 
                 if n + 1 < cfg.total_steps:
-                    batch = next_batch()
+                    batch, batch_state = next_batch()
         finally:
             if self.ckpt is not None:
                 final = getattr(self, "_loop_step", start)
@@ -261,7 +280,7 @@ class Trainer:
                         force=True,
                     )
                 self.ckpt.wait()
-            self.logger.close()
+            self.close()
         return self.state
 
     def _flops_per_token(self, seq: int) -> float:
