@@ -49,8 +49,19 @@ def _build_optimizer(args, total_steps: int):
 def _build_model(args):
     import dataclasses
 
-    from shifu_tpu.models import Transformer, TransformerConfig
+    from shifu_tpu.models import Mamba, MambaConfig, Transformer, TransformerConfig
 
+    if args.family == "mamba":
+        if args.moe_experts or args.attn:
+            raise SystemExit(
+                "--moe-experts/--attn are transformer-family flags"
+            )
+        cfg = {"tiny": MambaConfig.tiny, "small": MambaConfig.small}.get(
+            args.preset
+        )
+        if cfg is None:
+            raise SystemExit(f"no mamba preset {args.preset!r}")
+        return Mamba(cfg())
     cfg = {
         "tiny": TransformerConfig.tiny,
         "small": TransformerConfig.small,
@@ -145,6 +156,8 @@ def main(argv=None) -> int:
         action="store_true",
         help="random-token data (the default when --data is omitted)",
     )
+    t.add_argument("--family", default="transformer",
+                   choices=["transformer", "mamba"])
     t.add_argument("--preset", default="tiny",
                    choices=["tiny", "small", "1b", "7b"])
     t.add_argument("--moe-experts", type=int, default=0)

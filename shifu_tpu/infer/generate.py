@@ -69,10 +69,16 @@ def make_generate_fn(
 
         # ---- prefill: all prompt slots in one forward; unembed only the
         # last real position per row (logits_at skips the (b, P, vocab)
-        # logits nobody reads).
+        # logits nobody reads). Recurrent families (prefill_needs_mask)
+        # additionally get the validity mask: causality hides right-
+        # padding from attention for free, but a stateful scan must turn
+        # padded positions into explicit no-op steps.
+        prefill_kw = {}
+        if getattr(model, "prefill_needs_mask", False):
+            prefill_kw["kv_mask"] = kv_mask[:, :prompt_len]
         logits, cache = model(
             params, prompts, cache=cache, cache_index=0,
-            logits_at=lengths - 1,
+            logits_at=lengths - 1, **prefill_kw,
         )
         rng, sub = jax.random.split(rng)
         cur = sample_logits(logits[:, 0], sub, sample_cfg)

@@ -92,6 +92,13 @@ class QuantizedModel:
     def cfg(self):
         return self.inner.cfg
 
+    @property
+    def prefill_needs_mask(self) -> bool:
+        # Must mirror the wrapped family: a recurrent model behind this
+        # wrapper still needs the generation stack's prefill mask, or
+        # right-padded prompts silently corrupt its state.
+        return getattr(self.inner, "prefill_needs_mask", False)
+
     def __call__(self, qparams, *args, **kwargs):
         return self.inner(dequantize_params(qparams), *args, **kwargs)
 

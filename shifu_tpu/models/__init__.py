@@ -1,3 +1,4 @@
 from shifu_tpu.models.transformer import Transformer, TransformerConfig
+from shifu_tpu.models.mamba import Mamba, MambaConfig
 
-__all__ = ["Transformer", "TransformerConfig"]
+__all__ = ["Transformer", "TransformerConfig", "Mamba", "MambaConfig"]

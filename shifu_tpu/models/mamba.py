@@ -1,0 +1,438 @@
+"""Mamba (selective SSM) model family — the framework's second family.
+
+TPU-first structural choices:
+
+  * **Parallel scan, not recurrence.** Training runs the selective-SSM
+    linear recurrence h_t = dA_t h_{t-1} + dBx_t through
+    ``lax.associative_scan`` over the sequence axis — O(log s) depth of
+    elementwise combines, which XLA maps onto the VPU without any custom
+    kernel. (CUDA Mamba needs a hand-written selective-scan kernel; on TPU
+    the associative scan IS the idiomatic implementation.)
+  * **Scan over layers** with stacked parameters, like the transformer:
+    one compiled block regardless of depth; pp shards the stacked axis.
+  * **Sharding**: d_inner carries the "mlp" logical axis (tp), embeddings
+    "embed" (fsdp). The SSM state axis stays replicated — the recurrence
+    is elementwise over (channel, state), so tp slices channels cleanly.
+    The sequence axis is deliberately NOT sp-sharded here: a scan over a
+    sharded axis would serialise across shards; long-context SSM wants
+    the whole sequence resident (its memory is O(s·d), not O(s²)).
+  * **Decode is O(1) per token**: cache = rolling conv window (k-1 inputs)
+    + SSM state (d_inner, d_state) per layer — no KV growth at all, the
+    SSM's headline serving advantage.
+  * **Ragged prefill by dt-masking**: a padded position with dt=0 has
+    dA=exp(0·A)=1 and dBx=0 — the state passes through unchanged — so
+    right-padded batches stay exact with a validity mask instead of an
+    attention mask. ``prefill_needs_mask = True`` tells the shared
+    generation stack to supply it.
+
+Reference parity note: the upstream reference (klyan/shifu) is an empty
+repository (SURVEY.md); there is no reference SSM implementation to match.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from shifu_tpu.core import initializers
+from shifu_tpu.core.dtypes import Policy
+from shifu_tpu.core.module import Module, ParamSpec
+from shifu_tpu.ops import rms_norm, softmax_cross_entropy
+from shifu_tpu.parallel.ctx import constrain
+
+
+@dataclasses.dataclass(frozen=True)
+class MambaConfig:
+    vocab_size: int = 32_000
+    dim: int = 2048
+    n_layers: int = 24
+    d_state: int = 16
+    d_conv: int = 4
+    expand: int = 2
+    dt_rank: Optional[int] = None  # default ceil(dim / 16)
+    dt_min: float = 1e-3
+    dt_max: float = 0.1
+    norm_eps: float = 1e-6
+    z_loss: float = 1e-4
+    remat: bool = True
+
+    @property
+    def d_inner(self) -> int:
+        return self.expand * self.dim
+
+    @property
+    def resolved_dt_rank(self) -> int:
+        return (
+            self.dt_rank
+            if self.dt_rank is not None
+            else max(1, math.ceil(self.dim / 16))
+        )
+
+    @classmethod
+    def tiny(cls, **kw):
+        d = dict(
+            vocab_size=256, dim=32, n_layers=2, d_state=4, expand=2,
+            remat=False,
+        )
+        d.update(kw)
+        return cls(**d)
+
+    @classmethod
+    def small(cls, **kw):  # ~130M-class
+        d = dict(vocab_size=32_000, dim=768, n_layers=24)
+        d.update(kw)
+        return cls(**d)
+
+
+def _a_log_init(key, shape, dtype):
+    """S4D-real init: A = -(1..d_state) per channel, stored as log."""
+    n = shape[-1]
+    a = jnp.broadcast_to(jnp.arange(1, n + 1, dtype=jnp.float32), shape)
+    return jnp.log(a).astype(dtype)
+
+
+def _dt_bias_init(dt_min: float, dt_max: float):
+    """Inverse-softplus of dt ~ LogUniform[dt_min, dt_max] (Mamba init)."""
+
+    def init(key, shape, dtype):
+        u = jax.random.uniform(key, shape, jnp.float32)
+        dt = jnp.exp(
+            u * (math.log(dt_max) - math.log(dt_min)) + math.log(dt_min)
+        )
+        # softplus^-1(dt) = log(exp(dt) - 1); stable via log1p(-exp(-dt)).
+        return (jnp.log(-jnp.expm1(-dt)) + dt).astype(dtype)
+
+    return init
+
+
+def causal_depthwise_conv(x, w, b):
+    """x: (batch, s, ch), w: (k, ch), b: (ch). y[t] = Σ_i w[i]·x[t-k+1+i].
+
+    k is small and static, so the unrolled shift-and-add fuses into a few
+    VPU ops — no im2col, no conv primitive needed.
+    """
+    k = w.shape[0]
+    s = x.shape[1]
+    padded = jnp.pad(x, ((0, 0), (k - 1, 0), (0, 0)))
+    y = sum(padded[:, i : i + s] * w[i] for i in range(k))
+    return y + b
+
+
+def selective_scan(x, dt, a_log, bmat, cmat, d, *, h0=None):
+    """The selective SSM over a full sequence via associative scan.
+
+    Args:
+      x:    (batch, s, di) post-conv activations.
+      dt:   (batch, s, di) softplus'd step sizes (0 = skip/no-op step).
+      a_log:(di, n) log of -A.
+      bmat: (batch, s, n) input projection B_t.
+      cmat: (batch, s, n) output projection C_t.
+      d:    (di,) skip gain.
+      h0:   optional (batch, di, n) initial state (decode prefill chains).
+
+    Returns (y, h_last): y (batch, s, di); h_last (batch, di, n) f32.
+    """
+    f32 = jnp.float32
+    a = -jnp.exp(a_log.astype(f32))  # (di, n), strictly negative
+    dtf = dt.astype(f32)
+    dA = jnp.exp(dtf[..., None] * a)  # (b, s, di, n)
+    dBx = (
+        dtf[..., None]
+        * bmat.astype(f32)[:, :, None, :]
+        * x.astype(f32)[..., None]
+    )
+    if h0 is not None:
+        # Fold the initial state into the first step: h1 = dA1·h0 + dBx1.
+        dBx = dBx.at[:, 0].add(dA[:, 0] * h0.astype(f32))
+
+    def combine(left, right):
+        a1, b1 = left
+        a2, b2 = right
+        return a1 * a2, a2 * b1 + b2
+
+    _, h = jax.lax.associative_scan(combine, (dA, dBx), axis=1)
+    y = jnp.einsum("bsdn,bsn->bsd", h, cmat.astype(f32))
+    y = y + d.astype(f32) * x.astype(f32)
+    return y.astype(x.dtype), h[:, -1]
+
+
+def _block_specs(cfg: MambaConfig):
+    L, d, di, n, k, r = (
+        cfg.n_layers, cfg.dim, cfg.d_inner, cfg.d_state, cfg.d_conv,
+        cfg.resolved_dt_rank,
+    )
+    proj = initializers.fan_in_normal(axis=1)
+    return {
+        "norm": ParamSpec((L, d), ("layers", "embed"), initializers.zeros),
+        # x branch and gate z in one projection.
+        "in_proj": ParamSpec((L, d, 2 * di), ("layers", "embed", "mlp"), proj),
+        "conv_w": ParamSpec(
+            (L, k, di),
+            ("layers", None, "mlp"),
+            initializers.truncated_normal(1.0 / math.sqrt(k)),
+        ),
+        "conv_b": ParamSpec((L, di), ("layers", "mlp"), initializers.zeros),
+        # dt low-rank: di -> r -> di, bias carries the timescale init.
+        "dt_down": ParamSpec((L, di, r), ("layers", "mlp", None), proj),
+        "dt_up": ParamSpec(
+            (L, r, di),
+            ("layers", None, "mlp"),
+            initializers.truncated_normal(1.0 / math.sqrt(r)),
+        ),
+        "dt_bias": ParamSpec(
+            (L, di), ("layers", "mlp"), _dt_bias_init(cfg.dt_min, cfg.dt_max)
+        ),
+        "x_B": ParamSpec((L, di, n), ("layers", "mlp", None), proj),
+        "x_C": ParamSpec((L, di, n), ("layers", "mlp", None), proj),
+        "A_log": ParamSpec((L, di, n), ("layers", "mlp", None), _a_log_init),
+        "D": ParamSpec((L, di), ("layers", "mlp"), initializers.ones),
+        "out_proj": ParamSpec(
+            (L, di, d),
+            ("layers", "mlp", "embed"),
+            initializers.fan_in_normal(axis=1),
+        ),
+    }
+
+
+@dataclasses.dataclass(frozen=True)
+class Mamba(Module):
+    cfg: MambaConfig
+    policy: Policy = Policy()
+
+    # The shared generation stack must mask padded prompt slots at prefill
+    # (dt=0 no-op steps); attention models handle padding via causality.
+    prefill_needs_mask = True
+
+    def specs(self):
+        cfg = self.cfg
+        return {
+            "embed": ParamSpec(
+                (cfg.vocab_size, cfg.dim),
+                ("vocab", "embed"),
+                initializers.normal(1.0),
+            ),
+            "blocks": _block_specs(cfg),
+            "final_norm": ParamSpec(
+                (cfg.dim,), ("embed",), initializers.zeros
+            ),
+            "unembed": ParamSpec(
+                (cfg.dim, cfg.vocab_size),
+                ("embed", "vocab"),
+                initializers.fan_in_normal(axis=0),
+            ),
+        }
+
+    # ------------------------------------------------------------- block
+    def _block(self, p, h, valid, cache_slice):
+        """One Mamba block.
+
+        valid: optional (batch, s) f32/bool — 0 masks a position into a
+          state no-op (dt=0) and zeroes its conv contribution.
+        cache_slice: None (training) or {"conv": (b, k-1, di), "ssm":
+          (b, di, n)} — decode/prefill state for this layer.
+        Returns (h_out, new_cache_slice).
+        """
+        cfg = self.cfg
+        b, s, _ = h.shape
+        x = rms_norm(h, p["norm"], eps=cfg.norm_eps)
+        xz = jnp.einsum("bsd,dm->bsm", x, p["in_proj"])
+        xb, z = jnp.split(xz, 2, axis=-1)
+
+        if valid is not None:
+            # Padded positions must not leak into the conv window of later
+            # real positions (there are none to their right under right-
+            # padding, but decode appends real tokens after the pad region
+            # via the rolling cache — keep the window clean).
+            xb = xb * valid[..., None].astype(xb.dtype)
+
+        if cache_slice is not None:
+            k = cfg.d_conv
+            conv_in = jnp.concatenate([cache_slice["conv"], xb], axis=1)
+            if valid is None:
+                new_conv = conv_in[:, -(k - 1) :]
+            else:
+                # Ragged prefill: the rolling window must end at each
+                # row's LAST REAL token, not at the padded tail. conv_in
+                # position of real token j is (k-1)+j, so the last real
+                # token sits at len+k-2 and the k-1 window is
+                # conv_in[len .. len+k-2] (spilling into the old cache
+                # when the prompt is shorter than the window).
+                lengths = jnp.sum(
+                    valid.astype(jnp.int32), axis=1
+                )  # (b,)
+                idx = lengths[:, None] + jnp.arange(0, k - 1)[None, :]
+                new_conv = jnp.take_along_axis(
+                    conv_in, idx[..., None], axis=1
+                )
+            xc = causal_depthwise_conv(conv_in, p["conv_w"], p["conv_b"])[
+                :, -s:
+            ]
+        else:
+            new_conv = None
+            xc = causal_depthwise_conv(xb, p["conv_w"], p["conv_b"])
+        xc = jax.nn.silu(xc)
+
+        dt = jnp.einsum(
+            "bsm,mr,rn->bsn",
+            xc,
+            p["dt_down"],
+            p["dt_up"],
+        ) + p["dt_bias"]
+        dt = jax.nn.softplus(dt)
+        if valid is not None:
+            dt = dt * valid[..., None].astype(dt.dtype)  # no-op steps
+        bmat = jnp.einsum("bsm,mn->bsn", xc, p["x_B"])
+        cmat = jnp.einsum("bsm,mn->bsn", xc, p["x_C"])
+
+        h0 = cache_slice["ssm"] if cache_slice is not None else None
+        y, h_last = selective_scan(
+            xc, dt, p["A_log"], bmat, cmat, p["D"], h0=h0
+        )
+        y = y * jax.nn.silu(z)
+        out = h + jnp.einsum("bsm,md->bsd", y, p["out_proj"])
+        out = constrain(out, ("batch", None, "act_embed"))
+        new_cache = (
+            None
+            if cache_slice is None
+            else {"conv": new_conv.astype(cache_slice["conv"].dtype),
+                  "ssm": h_last}
+        )
+        return out, new_cache
+
+    # ----------------------------------------------------------- forward
+    def __call__(
+        self,
+        params,
+        tokens,
+        *,
+        positions=None,  # accepted for stack compatibility; SSMs are
+        segment_ids=None,  # positional by construction (positions unused)
+        cache=None,
+        cache_index=None,
+        kv_mask=None,
+        logits_at=None,
+        return_aux=False,
+    ):
+        """Compute logits; mirrors the Transformer call surface.
+
+        kv_mask: (batch, >=s) validity — only the leading s columns are
+          used; 0-positions become state no-ops (ragged prefill).
+        cache: from ``init_cache`` — rolling conv window + SSM state.
+          ``cache_index`` is accepted for interface parity but unused (the
+          cache is a rolling state, not an addressed buffer).
+        """
+        del positions, cache_index
+        if return_aux and cache is not None:
+            raise ValueError("return_aux is a training-path (no-cache) flag")
+        if segment_ids is not None:
+            raise ValueError(
+                "packed segments are not supported by the SSM family: state "
+                "flows across the whole row; pack with document boundaries "
+                "only via separate rows"
+            )
+        cfg = self.cfg
+        p = self.policy.cast_to_compute(params)
+        b, s = tokens.shape
+
+        valid = None
+        if kv_mask is not None and not (cache is not None and s == 1):
+            # Single-token decode steps are always real tokens; the slot-
+            # space kv_mask the generation stack threads through decode is
+            # an attention concept with no SSM meaning there.
+            valid = kv_mask[:, :s]
+
+        h = jnp.take(p["embed"], tokens, axis=0)
+        h = constrain(h, ("batch", None, "act_embed"))
+
+        block = self._block
+        if cfg.remat and cache is None:
+            block = jax.checkpoint(block)
+
+        if cache is None:
+            def body(carry, layer_p):
+                out, _ = block(layer_p, carry, valid, None)
+                return out, None
+
+            h, _ = jax.lax.scan(body, h, p["blocks"])
+            new_cache = None
+        else:
+            def body(carry, xs):
+                layer_p, cache_slice = xs
+                out, new_slice = block(layer_p, carry, valid, cache_slice)
+                return out, new_slice
+
+            h, new_cache = jax.lax.scan(body, h, (p["blocks"], cache))
+
+        h = rms_norm(h, p["final_norm"], eps=cfg.norm_eps)
+        if logits_at is not None:
+            h = jnp.take_along_axis(h, logits_at[:, None, None], axis=1)
+        logits = jnp.einsum("bsd,dv->bsv", h, p["unembed"])
+        logits = self.policy.cast_to_output(logits)
+        if return_aux:
+            return logits, None  # no aux losses in this family
+        return logits if cache is None else (logits, new_cache)
+
+    # -------------------------------------------------------------- loss
+    def loss(self, params, batch):
+        tokens = batch["tokens"]
+        mask = batch.get("mask")
+        kv_mask = None
+        if mask is not None:
+            # Loss-masked (padding) positions also become state no-ops so
+            # per-row results are independent of the padding content.
+            kv_mask = mask[:, :-1] > 0
+        logits = self(params, tokens[:, :-1], kv_mask=kv_mask)
+        return softmax_cross_entropy(
+            logits,
+            tokens[:, 1:],
+            mask=None if mask is None else mask[:, 1:],
+            z_loss=self.cfg.z_loss,
+        )
+
+    # ------------------------------------------------------------- cache
+    def init_cache(self, batch_size: int, max_seq_len: int = 0,
+                   dtype=jnp.bfloat16):
+        """Rolling recurrent cache; O(1) in sequence length.
+
+        ``max_seq_len`` is accepted for interface parity with attention
+        caches and ignored — SSM state does not grow with context.
+        """
+        cfg = self.cfg
+        return {
+            "conv": jnp.zeros(
+                (cfg.n_layers, batch_size, cfg.d_conv - 1, cfg.d_inner),
+                dtype,
+            ),
+            "ssm": jnp.zeros(
+                (cfg.n_layers, batch_size, cfg.d_inner, cfg.d_state),
+                jnp.float32,
+            ),
+        }
+
+    # ------------------------------------------------------------- quant
+    def quant_spec(self):
+        """Contraction axes for int8 weight-only quant (infer.quant)."""
+        blocks = {
+            "norm": (),
+            "in_proj": (1,),
+            "conv_w": (),
+            "conv_b": (),
+            "dt_down": (1,),
+            "dt_up": (1,),
+            "dt_bias": (),
+            "x_B": (1,),
+            "x_C": (1,),
+            "A_log": (),  # state dynamics: keep exact
+            "D": (),
+            "out_proj": (1,),
+        }
+        return {
+            "embed": (),
+            "blocks": blocks,
+            "final_norm": (),
+            "unembed": (0,),
+        }

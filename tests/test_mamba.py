@@ -1,0 +1,304 @@
+"""Mamba/SSM family: scan math, causality, decode parity, generation."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from shifu_tpu.models import Mamba, MambaConfig
+from shifu_tpu.models.mamba import causal_depthwise_conv, selective_scan
+from shifu_tpu.parallel import MeshPlan, shard_batch
+from shifu_tpu.train import AdamW, create_sharded_state, make_train_step
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = MambaConfig.tiny()
+    model = Mamba(cfg)
+    params = model.init(jax.random.key(0))
+    return model, params
+
+
+# ----------------------------------------------------------------- ops
+def test_causal_conv_matches_naive():
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(2, 9, 3), jnp.float32)
+    w = jnp.asarray(rng.randn(4, 3), jnp.float32)
+    b = jnp.asarray(rng.randn(3), jnp.float32)
+    y = causal_depthwise_conv(x, w, b)
+    k = 4
+    for t in range(9):
+        want = b.copy()
+        for i in range(k):
+            src = t - (k - 1) + i
+            if src >= 0:
+                want = want + w[i] * x[:, src]
+        np.testing.assert_allclose(y[:, t], want, rtol=1e-5, atol=1e-6)
+
+
+def test_selective_scan_matches_sequential():
+    rng = np.random.RandomState(1)
+    b, s, di, n = 2, 7, 3, 4
+    x = jnp.asarray(rng.randn(b, s, di), jnp.float32)
+    dt = jnp.asarray(rng.rand(b, s, di) * 0.1, jnp.float32)
+    a_log = jnp.asarray(np.log(rng.rand(di, n) + 0.5), jnp.float32)
+    B = jnp.asarray(rng.randn(b, s, n), jnp.float32)
+    C = jnp.asarray(rng.randn(b, s, n), jnp.float32)
+    D = jnp.asarray(rng.randn(di), jnp.float32)
+
+    y, h_last = selective_scan(x, dt, a_log, B, C, D)
+
+    a = -np.exp(np.asarray(a_log))
+    h = np.zeros((b, di, n), np.float32)
+    for t in range(s):
+        dA = np.exp(np.asarray(dt)[:, t, :, None] * a)
+        dBx = (
+            np.asarray(dt)[:, t, :, None]
+            * np.asarray(B)[:, t, None, :]
+            * np.asarray(x)[:, t, :, None]
+        )
+        h = dA * h + dBx
+        want = (h * np.asarray(C)[:, t, None, :]).sum(-1) + np.asarray(
+            D
+        ) * np.asarray(x)[:, t]
+        np.testing.assert_allclose(y[:, t], want, rtol=2e-4, atol=1e-5)
+    np.testing.assert_allclose(h_last, h, rtol=2e-4, atol=1e-5)
+
+
+def test_selective_scan_h0_chains():
+    # Scanning [first half] then [second half with h0] == full scan.
+    rng = np.random.RandomState(2)
+    b, s, di, n = 1, 8, 2, 3
+    x = jnp.asarray(rng.randn(b, s, di), jnp.float32)
+    dt = jnp.asarray(rng.rand(b, s, di) * 0.2, jnp.float32)
+    a_log = jnp.asarray(np.log(rng.rand(di, n) + 0.5), jnp.float32)
+    B = jnp.asarray(rng.randn(b, s, n), jnp.float32)
+    C = jnp.asarray(rng.randn(b, s, n), jnp.float32)
+    D = jnp.zeros((di,), jnp.float32)
+    y_full, h_full = selective_scan(x, dt, a_log, B, C, D)
+    _, h1 = selective_scan(x[:, :4], dt[:, :4], a_log, B[:, :4], C[:, :4], D)
+    y2, h2 = selective_scan(
+        x[:, 4:], dt[:, 4:], a_log, B[:, 4:], C[:, 4:], D, h0=h1
+    )
+    np.testing.assert_allclose(y2, y_full[:, 4:], rtol=2e-4, atol=1e-5)
+    np.testing.assert_allclose(h2, h_full, rtol=2e-4, atol=1e-5)
+
+
+def test_zero_dt_is_noop_step():
+    rng = np.random.RandomState(3)
+    b, s, di, n = 1, 4, 2, 3
+    x = jnp.asarray(rng.randn(b, s, di), jnp.float32)
+    dt = jnp.asarray(rng.rand(b, s, di) * 0.2, jnp.float32).at[:, 2].set(0.0)
+    a_log = jnp.asarray(np.log(rng.rand(di, n) + 0.5), jnp.float32)
+    B = jnp.asarray(rng.randn(b, s, n), jnp.float32)
+    C = jnp.asarray(rng.randn(b, s, n), jnp.float32)
+    D = jnp.zeros((di,), jnp.float32)
+    _, h_with = selective_scan(x, dt, a_log, B, C, D)
+    # Dropping the dt=0 position entirely gives the same final state.
+    keep = [0, 1, 3]
+    _, h_drop = selective_scan(
+        x[:, keep], dt[:, keep], a_log, B[:, keep], C[:, keep], D
+    )
+    np.testing.assert_allclose(h_with, h_drop, rtol=2e-4, atol=1e-6)
+
+
+# --------------------------------------------------------------- model
+def test_forward_shapes(tiny):
+    model, params = tiny
+    tokens = jnp.zeros((2, 12), jnp.int32)
+    logits = jax.jit(lambda p, t: model(p, t))(params, tokens)
+    assert logits.shape == (2, 12, model.cfg.vocab_size)
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_causality(tiny):
+    model, params = tiny
+    rng = np.random.RandomState(4)
+    t1 = jnp.asarray(rng.randint(0, 256, (1, 10)), jnp.int32)
+    t2 = t1.at[0, -1].set((int(t1[0, -1]) + 1) % 256)
+    l1, l2 = model(params, t1), model(params, t2)
+    np.testing.assert_allclose(l1[:, :-1], l2[:, :-1], rtol=2e-4, atol=1e-5)
+
+
+def test_loss_decreases(tiny):
+    model, params = tiny
+    tokens = jnp.asarray(
+        np.random.RandomState(5).randint(0, 256, (4, 16)), jnp.int32
+    )
+    batch = {"tokens": tokens}
+
+    @jax.jit
+    def step(p):
+        (loss, _), g = jax.value_and_grad(model.loss, has_aux=True)(p, batch)
+        p = jax.tree_util.tree_map(lambda w, gw: w - 0.5 * gw, p, g)
+        return p, loss
+
+    losses = []
+    for _ in range(5):
+        params, loss = step(params)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] - 0.1, losses
+
+
+def test_masked_loss_independent_of_padding(tiny):
+    model, params = tiny
+    rng = np.random.RandomState(6)
+    real = rng.randint(1, 256, (1, 6))
+    a = np.concatenate([real, np.zeros((1, 4), int)], axis=1)
+    b = np.concatenate([real, rng.randint(1, 256, (1, 4))], axis=1)
+    mask = np.concatenate([np.ones((1, 6)), np.zeros((1, 4))], axis=1)
+    la, _ = model.loss(
+        params, {"tokens": jnp.asarray(a, jnp.int32),
+                 "mask": jnp.asarray(mask, jnp.float32)}
+    )
+    lb, _ = model.loss(
+        params, {"tokens": jnp.asarray(b, jnp.int32),
+                 "mask": jnp.asarray(mask, jnp.float32)}
+    )
+    assert float(la) == pytest.approx(float(lb), rel=1e-5)
+
+
+def test_decode_cache_matches_full_forward(tiny):
+    model, params = tiny
+    rng = np.random.RandomState(7)
+    tokens = jnp.asarray(rng.randint(0, 256, (2, 10)), jnp.int32)
+    full = model(params, tokens)
+
+    cache = model.init_cache(batch_size=2)
+    logits, cache = model(params, tokens[:, :6], cache=cache, cache_index=0)
+    np.testing.assert_allclose(
+        logits, full[:, :6], rtol=3e-2, atol=3e-3
+    )
+    for i in range(6, 10):
+        logits, cache = model(
+            params, tokens[:, i : i + 1], cache=cache, cache_index=i
+        )
+        np.testing.assert_allclose(
+            logits[:, 0], full[:, i], rtol=3e-2, atol=3e-3,
+            err_msg=f"decode step {i}",
+        )
+
+
+def test_generate_ragged_matches_unpadded(tiny):
+    from shifu_tpu.infer import SampleConfig, make_generate_fn
+
+    model, params = tiny
+    rng = np.random.RandomState(8)
+    short = rng.randint(1, 256, (1, 5))
+
+    fn8 = make_generate_fn(
+        model, max_new_tokens=6, sample_cfg=SampleConfig(temperature=0.0)
+    )
+    # Row 0: the 5-token prompt right-padded to 8 (pad junk); row 1: filler.
+    padded = np.concatenate(
+        [short, rng.randint(1, 256, (1, 3))], axis=1
+    )
+    prompts = np.concatenate([padded, rng.randint(1, 256, (1, 8))], axis=0)
+    out_ragged = fn8(
+        params,
+        jnp.asarray(prompts, jnp.int32),
+        jnp.asarray([5, 8], jnp.int32),
+        jax.random.key(0),
+    )
+
+    fn5 = make_generate_fn(
+        model, max_new_tokens=6, sample_cfg=SampleConfig(temperature=0.0)
+    )
+    out_short = fn5(
+        params,
+        jnp.asarray(short, jnp.int32),
+        jnp.asarray([5], jnp.int32),
+        jax.random.key(0),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(out_ragged["tokens"])[0], np.asarray(out_short["tokens"])[0]
+    )
+
+
+def test_sharded_train_step(devices):
+    mesh = MeshPlan(fsdp=2, tp=2, dp=2).build()
+    cfg = MambaConfig.tiny()
+    model = Mamba(cfg)
+    opt = AdamW()
+    tokens = jnp.asarray(
+        np.random.RandomState(9).randint(0, 256, (4, 16)), jnp.int32
+    )
+    with mesh:
+        state = create_sharded_state(model, opt, jax.random.key(0), mesh)
+        step = make_train_step(model, opt, mesh)
+        batch = shard_batch({"tokens": tokens}, mesh)
+        state, metrics = step(state, batch)
+        assert np.isfinite(float(metrics["loss"]))
+        # in_proj sharded over (fsdp, tp): (L, d, 2di) -> pp x fsdp x tp.
+        wp = state.params["blocks"]["in_proj"]
+        assert wp.addressable_shards[0].data.shape[2] == cfg.d_inner  # 2di/2
+
+
+def test_quantized_mamba(tiny):
+    from shifu_tpu.infer import QuantizedModel, quantize_params
+
+    model, params = tiny
+    qp = quantize_params(model, params)
+    qm = QuantizedModel(model)
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    logits = qm(qp, tokens)
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_quantized_mamba_ragged_generation_masks_padding(tiny):
+    # The wrapper must forward prefill_needs_mask; otherwise right-padded
+    # prompts silently corrupt the SSM state (pad tokens get dt > 0).
+    from shifu_tpu.infer import (
+        QuantizedModel,
+        SampleConfig,
+        make_generate_fn,
+        quantize_params,
+    )
+
+    model, params = tiny
+    qp = quantize_params(model, params)
+    qm = QuantizedModel(model)
+    assert qm.prefill_needs_mask is True
+
+    rng = np.random.RandomState(10)
+    short = rng.randint(1, 256, (1, 5))
+    padded = np.concatenate([short, rng.randint(1, 256, (1, 3))], axis=1)
+    fn = make_generate_fn(
+        qm, max_new_tokens=5, sample_cfg=SampleConfig(temperature=0.0)
+    )
+    out_ragged = fn(
+        qp, jnp.asarray(padded, jnp.int32), jnp.asarray([5], jnp.int32),
+        jax.random.key(0),
+    )
+    out_short = fn(
+        qp,
+        jnp.asarray(
+            np.concatenate([short, np.zeros((1, 3), int)], axis=1), jnp.int32
+        ),
+        jnp.asarray([5], jnp.int32),
+        jax.random.key(0),
+    )
+    # Same real prompt, different pad junk -> identical greedy tokens.
+    np.testing.assert_array_equal(
+        np.asarray(out_ragged["tokens"]), np.asarray(out_short["tokens"])
+    )
+
+
+def test_return_aux_with_cache_raises(tiny):
+    model, params = tiny
+    cache = model.init_cache(batch_size=1)
+    with pytest.raises(ValueError, match="training-path"):
+        model(
+            params, jnp.zeros((1, 4), jnp.int32), cache=cache,
+            return_aux=True,
+        )
+
+
+def test_packed_segments_rejected(tiny):
+    model, params = tiny
+    with pytest.raises(ValueError, match="packed segments"):
+        model(
+            params,
+            jnp.zeros((1, 4), jnp.int32),
+            segment_ids=jnp.zeros((1, 4), jnp.int32),
+        )
