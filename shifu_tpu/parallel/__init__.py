@@ -25,3 +25,10 @@ __all__ = [
     "shard_batch",
     "spec_for",
 ]
+from shifu_tpu.parallel.pipeline import (  # noqa: E402
+    PipelinedModel,
+    pipeline_apply,
+    pipeline_loss_fn,
+)
+
+__all__ += ["PipelinedModel", "pipeline_apply", "pipeline_loss_fn"]

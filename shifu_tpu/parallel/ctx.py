@@ -71,6 +71,33 @@ def constrain(x: jax.Array, logical: Sequence[Optional[str]]) -> jax.Array:
             f"constrain: {len(logical)} names for rank-{x.ndim} array"
         )
     spec = spec_for(x.shape, logical, env.mesh, env.rules)
+
+    # Inside a partial-manual shard_map (e.g. the pp pipeline), the trace's
+    # abstract mesh marks the manual axes and rejects NamedShardings built
+    # from the outer all-Auto mesh. Drop the manual axes (they're already
+    # fixed by the shard_map) and constrain with a bare PartitionSpec,
+    # which binds to the context mesh.
+    from jax.sharding import AxisType, get_abstract_mesh
+
+    cur = get_abstract_mesh()
+    if not cur.empty and any(t == AxisType.Manual for t in cur.axis_types):
+        manual = {
+            name
+            for name, t in zip(cur.axis_names, cur.axis_types)
+            if t == AxisType.Manual
+        }
+        clean = []
+        for entry in spec:
+            if entry is None:
+                clean.append(None)
+            elif isinstance(entry, str):
+                clean.append(None if entry in manual else entry)
+            else:
+                kept = tuple(a for a in entry if a not in manual)
+                clean.append(kept if kept else None)
+        return jax.lax.with_sharding_constraint(
+            x, jax.sharding.PartitionSpec(*clean)
+        )
     return jax.lax.with_sharding_constraint(
         x, NamedSharding(env.mesh, spec)
     )

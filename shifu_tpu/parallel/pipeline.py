@@ -1,0 +1,285 @@
+"""Looped pipeline parallelism over the ``pp`` mesh axis.
+
+The stacked-layers models already *shard* their layer axis over pp, but a
+plain sharded scan serialises: stage p+1's first layer waits for stage p's
+last layer for the whole batch. This module adds the real pipelined
+schedule (GPipe-style) as a drop-in apply:
+
+  * the mesh's ``pp`` axis is made *manual* via ``jax.shard_map`` (other
+    axes — dp/fsdp/tp — stay automatic, so tensor/data sharding inside a
+    stage keeps working);
+  * each stage holds L/P contiguous layers and loops T = M + P - 1 ticks;
+    at every tick it receives its predecessor's activation via a ring
+    ``ppermute``, runs its layer slice, and passes on — after the P-1-tick
+    fill, all P stages compute different microbatches concurrently;
+  * the backward schedule comes from AD: ppermute's transpose is the
+    reverse permute, so differentiating the tick scan yields the reverse
+    pipeline automatically (rematerialise the stage body to keep the
+    T-tick activation buffer small).
+
+Cost model: bubble fraction = (P-1)/(M+P-1) — use M >= 4P microbatches.
+Activation traffic per tick is one (mb, s, d) block over ICI, overlapped
+with the next tick's compute by XLA's async collectives.
+
+Reference parity note: the upstream reference (klyan/shifu) is an empty
+repository (SURVEY.md); there is no reference pipeline engine to match.
+"""
+
+from __future__ import annotations
+
+import functools as _functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def pipeline_apply(
+    layer_fn: Callable,
+    stacked_params: Any,
+    x: jax.Array,
+    extras: Any = None,
+    *,
+    mesh: Mesh,
+    axis: str = "pp",
+    remat_stage: bool = True,
+):
+    """Run microbatches through pp-sharded stacked layers, pipelined.
+
+    Args:
+      layer_fn: ``(layer_params, h, extras) -> h`` — ONE layer;  each
+        stage scans it over its local slice of the stacked axis.
+      stacked_params: pytree whose leaves have a leading layer axis of
+        extent L with ``L % pp == 0``. May carry any dp/fsdp/tp sharding
+        on later axes (those stay automatic).
+      x: (M, mb, ...) microbatched inputs; M microbatches flow through
+        the pipeline. Batch/seq axes may be sharded over other mesh axes.
+      extras: replicated-per-stage constants (e.g. rope sin/cos tables),
+        passed to every layer invocation.
+      mesh: mesh containing ``axis``.
+      remat_stage: rematerialise each stage body in the backward pass.
+
+    Returns:
+      (M, mb, ...) outputs — the result of applying all L layers to every
+      microbatch, numerically equal to a sequential scan over layers.
+    """
+    n_stages = mesh.shape[axis]
+    if n_stages == 1:
+        # Degenerate pipeline: sequential scan, same contract.
+        def body(h, lp):
+            return layer_fn(lp, h, extras), None
+
+        def one(mb):
+            out, _ = jax.lax.scan(body, mb, stacked_params)
+            return out
+
+        return jax.lax.map(one, x)
+
+    # XLA:CPU partitioner workaround: transposing a dtype convert on an
+    # array that crosses the partial-manual shard_map boundary crashes the
+    # CPU SPMD partitioner ("Invalid binary instruction opcode copy").
+    # Keep the boundary f32 there and convert inside the manual region
+    # (where no resharding happens). TPU keeps the native narrow boundary.
+    compute_dtype = x.dtype
+    f32_boundary = (
+        jax.default_backend() == "cpu" and compute_dtype == jnp.bfloat16
+    )
+    if f32_boundary:
+        x = x.astype(jnp.float32)
+
+    fn = _pipeline_fn(layer_fn, mesh, axis, remat_stage)
+    staged = fn(stacked_params, x, extras)
+    out = staged[n_stages - 1]
+    return out.astype(compute_dtype) if f32_boundary else out
+
+
+@_functools.lru_cache(maxsize=32)
+def _pipeline_fn(layer_fn, mesh: Mesh, axis: str, remat_stage: bool):
+    """The jitted pipelined program, cached per (layer_fn, mesh, axis).
+
+    Everything shape-dependent (microbatch count, tick count, dtypes) is
+    derived at trace time from the arguments, so eager callers hit jit's
+    own shape-keyed cache instead of recompiling per call.
+    """
+    n_stages = mesh.shape[axis]
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def shard_body(params_local, x_local, extras_local):
+        stage = jax.lax.axis_index(axis)
+        n_micro = x_local.shape[0]
+        n_ticks = n_micro + n_stages - 1
+        # Compute in the params' dtype; the boundary (x_local) may be
+        # wider (the f32 CPU workaround above).
+        compute_dtype = jax.tree_util.tree_leaves(params_local)[0].dtype
+        boundary_dtype = x_local.dtype
+
+        def run_stage(h):
+            def body(carry, lp):
+                return layer_fn(lp, carry, extras_local), None
+
+            out, _ = jax.lax.scan(
+                body, h.astype(compute_dtype), params_local
+            )
+            return out.astype(boundary_dtype)
+
+        if remat_stage:
+            run_stage = jax.checkpoint(run_stage)
+
+        def tick(carry, t):
+            prev_out, out_buf = carry
+            recv = jax.lax.ppermute(prev_out, axis, perm)
+            mb = jax.lax.dynamic_index_in_dim(
+                x_local, jnp.clip(t, 0, n_micro - 1), 0, keepdims=False
+            )
+            h_in = jnp.where(stage == 0, mb, recv)
+            h_out = run_stage(h_in)
+            # The last stage finishes microbatch (t - (P-1)) at tick t.
+            emit = (stage == n_stages - 1) & (t >= n_stages - 1)
+            idx = jnp.clip(t - (n_stages - 1), 0, n_micro - 1)
+            cur = jax.lax.dynamic_index_in_dim(out_buf, idx, 0, keepdims=False)
+            out_buf = jax.lax.dynamic_update_index_in_dim(
+                out_buf, jnp.where(emit, h_out, cur), idx, 0
+            )
+            return (h_out, out_buf), None
+
+        init = (jnp.zeros_like(x_local[0]), jnp.zeros_like(x_local))
+        (_, out_buf), _ = jax.lax.scan(tick, init, jnp.arange(n_ticks))
+        # Only the last stage holds real outputs. Return with a leading
+        # per-stage axis (out_specs puts pp there) and let the caller
+        # slice stage P-1 — a plain resharding outside the manual region,
+        # cheaper than an in-region psum broadcast (and it sidesteps an
+        # XLA:CPU partitioner crash on bf16 psum of a replicated operand).
+        return out_buf[None]
+
+    # Specs are pytree prefixes: one spec covers each whole argument tree.
+    return jax.jit(
+        jax.shard_map(
+            shard_body,
+            mesh=mesh,
+            in_specs=(P(axis), P(), P()),
+            out_specs=P(axis),  # leading per-stage axis
+            axis_names={axis},
+            check_vma=False,
+        )
+    )
+
+
+def pipeline_loss_fn(
+    model,
+    *,
+    mesh: Mesh,
+    microbatches: int,
+    axis: str = "pp",
+):
+    """Pipelined next-token loss for a stacked-layers Transformer.
+
+    Returns ``loss_fn(params, batch) -> (loss, aux)`` — same contract as
+    ``model.loss`` so it plugs straight into ``make_train_step``'s
+    value_and_grad, but the block stack executes through
+    :func:`pipeline_apply`. Batch leaves are (b, s); rows are split into
+    ``microbatches`` along the batch axis (b % microbatches == 0).
+
+    Supports the dense Transformer training path (no KV cache, no MoE —
+    expert dispatch inside a pipeline stage needs its own schedule).
+    """
+    from shifu_tpu.ops import rms_norm, rope_frequencies, softmax_cross_entropy
+
+    cfg = model.cfg
+    if getattr(cfg, "n_experts", 0):
+        raise NotImplementedError(
+            "pipelined MoE is not supported yet: run MoE models with "
+            "ep/fsdp sharding instead"
+        )
+
+    def layer_fn(layer_p, h, extras):
+        sin, cos, segment_ids = extras
+        out, _, _ = model._block(layer_p, h, sin, cos, segment_ids, None, None)
+        return out
+
+    def loss_fn(params, batch):
+        tokens = batch["tokens"]
+        mask = batch.get("mask")
+        if batch.get("segment_ids") is not None:
+            # extras are per-stage constants; packing masks vary per
+            # microbatch and would need threading through the tick loop.
+            raise NotImplementedError(
+                "packed segment_ids are not supported on the pipelined "
+                "path yet; use the sharded scan path for packed batches"
+            )
+        if batch.get("positions") is not None:
+            # Same constraint: positions vary per microbatch, but rope
+            # tables ride the replicated extras. arange positions only.
+            raise NotImplementedError(
+                "explicit positions are not supported on the pipelined "
+                "path yet; use the sharded scan path"
+            )
+        inputs = tokens[:, :-1]
+        b, s = inputs.shape
+        if b % microbatches:
+            raise ValueError(
+                f"batch {b} not divisible into {microbatches} microbatches"
+            )
+        p = model.policy.cast_to_compute(params)
+
+        h = jnp.take(p["embed"], inputs, axis=0)
+        positions = jnp.arange(s)
+        sin, cos = rope_frequencies(
+            cfg.resolved_head_dim, positions, theta=cfg.rope_theta
+        )
+
+        h = h.reshape(microbatches, b // microbatches, s, -1)
+        h = pipeline_apply(
+            layer_fn,
+            p["blocks"],
+            h,
+            (sin, cos, None),
+            mesh=mesh,
+            axis=axis,
+        )
+        h = h.reshape(b, s, -1)
+
+        h = rms_norm(h, p["final_norm"], eps=cfg.norm_eps)
+        if cfg.tie_embeddings:
+            logits = jnp.einsum("bsd,vd->bsv", h, p["embed"])
+        else:
+            logits = jnp.einsum("bsd,dv->bsv", h, p["unembed"])
+        logits = model.policy.cast_to_output(logits)
+        return softmax_cross_entropy(
+            logits,
+            tokens[:, 1:],
+            mask=None if mask is None else mask[:, 1:],
+            z_loss=cfg.z_loss,
+        )
+
+    return loss_fn
+
+
+class PipelinedModel:
+    """Adapter: a model whose ``loss`` runs the looped-pipeline schedule.
+
+    Quacks like the wrapped model for the train stack (specs/axes/init for
+    sharded state creation and the decay mask) while ``loss`` goes through
+    :func:`pipeline_loss_fn` — so ``create_sharded_state`` and
+    ``make_train_step`` work unchanged:
+
+        pm = PipelinedModel(model, mesh=mesh, microbatches=8)
+        state = create_sharded_state(pm, opt, rng, mesh)
+        step = make_train_step(pm, opt, mesh)
+    """
+
+    def __init__(self, model, *, mesh, microbatches, axis: str = "pp"):
+        self.inner = model
+        self.cfg = model.cfg
+        self.loss = pipeline_loss_fn(
+            model, mesh=mesh, microbatches=microbatches, axis=axis
+        )
+
+    def specs(self):
+        return self.inner.specs()
+
+    def axes(self):
+        return self.inner.axes()
+
+    def init(self, rng):
+        return self.inner.init(rng)
