@@ -9,6 +9,8 @@ fallback) -> ``PackedLoader`` (deterministic shuffle, resumable cursor)
 from shifu_tpu.data.dataset import TokenDataset, write_shards
 from shifu_tpu.data.loader import PackedLoader, device_prefetch
 from shifu_tpu.data.packing import Packer
+from shifu_tpu.data.tokenizer import ByteTokenizer, HFTokenizer, tokenize_corpus
+from shifu_tpu.data.synthetic import SyntheticLoader
 from shifu_tpu.data._native import available as native_available
 
 __all__ = [
@@ -18,4 +20,8 @@ __all__ = [
     "device_prefetch",
     "Packer",
     "native_available",
+    "ByteTokenizer",
+    "HFTokenizer",
+    "tokenize_corpus",
+    "SyntheticLoader",
 ]

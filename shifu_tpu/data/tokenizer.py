@@ -1,0 +1,124 @@
+"""Tokenizers: byte-level baseline + HuggingFace adapter + corpus ingestion.
+
+The framework is tokenizer-agnostic — the data pipeline consumes token-id
+documents — so this module only provides (a) a dependency-free byte-level
+tokenizer that works for any text, (b) a thin adapter giving HuggingFace
+tokenizers (the `transformers` package) the same minimal protocol, and
+(c) ``tokenize_corpus`` to turn an iterable of texts into the on-disk
+shard format in one call.
+
+Protocol (duck-typed): ``vocab_size``, ``pad_id``, ``bos_id``, ``eos_id``,
+``encode(text) -> list[int]``, ``decode(ids) -> str``.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional, Sequence
+
+
+class ByteTokenizer:
+    """UTF-8 bytes with 3 specials: pad=0, bos=1, eos=2, bytes at 3..258.
+
+    Lossless on arbitrary text, zero files, vocab 259 — the right default
+    for smoke runs and for corpora where subword merges don't matter.
+    """
+
+    pad_id = 0
+    bos_id = 1
+    eos_id = 2
+    _OFFSET = 3
+
+    @property
+    def vocab_size(self) -> int:
+        return 256 + self._OFFSET
+
+    def encode(self, text: str, *, bos: bool = False, eos: bool = False):
+        ids = [b + self._OFFSET for b in text.encode("utf-8")]
+        if bos:
+            ids.insert(0, self.bos_id)
+        if eos:
+            ids.append(self.eos_id)
+        return ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        data = bytes(
+            i - self._OFFSET for i in ids if i >= self._OFFSET
+        )
+        return data.decode("utf-8", errors="replace")
+
+
+class HFTokenizer:
+    """Adapter over a HuggingFace tokenizer instance.
+
+    Wrap anything `transformers` produces::
+
+        tok = HFTokenizer.from_pretrained("gpt2")      # hub/file load
+        tok = HFTokenizer(my_fast_tokenizer)           # already built
+    """
+
+    def __init__(self, hf_tokenizer):
+        self._tok = hf_tokenizer
+
+    @classmethod
+    def from_pretrained(cls, name_or_path: str, **kw):
+        from transformers import AutoTokenizer
+
+        return cls(AutoTokenizer.from_pretrained(name_or_path, **kw))
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self._tok)
+
+    def _special(self, attr) -> Optional[int]:
+        return getattr(self._tok, attr, None)
+
+    @property
+    def pad_id(self) -> Optional[int]:
+        return self._special("pad_token_id")
+
+    @property
+    def bos_id(self) -> Optional[int]:
+        return self._special("bos_token_id")
+
+    @property
+    def eos_id(self) -> Optional[int]:
+        return self._special("eos_token_id")
+
+    def encode(self, text: str, *, bos: bool = False, eos: bool = False):
+        ids = self._tok.encode(text, add_special_tokens=False)
+        if bos and self.bos_id is not None:
+            ids.insert(0, self.bos_id)
+        if eos and self.eos_id is not None:
+            ids.append(self.eos_id)
+        return ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return self._tok.decode(list(ids), skip_special_tokens=True)
+
+
+def tokenize_corpus(
+    texts: Iterable[str],
+    tokenizer,
+    out_dir: str,
+    *,
+    append_eos: bool = True,
+    dtype: Optional[str] = None,
+    docs_per_shard: int = 1_000_000,
+) -> int:
+    """Texts -> token shards on disk (dataset.write_shards layout).
+
+    ``dtype`` defaults to uint16 when the vocab fits, else uint32.
+    Returns the number of documents written.
+    """
+    from shifu_tpu.data.dataset import write_shards
+
+    if dtype is None:
+        dtype = "uint16" if tokenizer.vocab_size <= 65_535 else "uint32"
+
+    def docs():
+        for t in texts:
+            yield tokenizer.encode(t, eos=append_eos)
+
+    return write_shards(
+        docs(), out_dir, dtype=dtype, docs_per_shard=docs_per_shard
+    )

@@ -330,6 +330,7 @@ class Transformer(Module):
         kv_mask=None,
         logits_at=None,
         return_aux=False,
+        blocks_fn=None,
     ):
         """Compute logits.
 
@@ -352,6 +353,11 @@ class Transformer(Module):
           return_aux: also return the MoE aux-loss dict (mean over layers of
             {"lb", "rz", "dropped"}; None for a dense model). Training-path
             only — unsupported together with ``cache``.
+          blocks_fn: optional override for the block-stack execution:
+            ``(stacked_block_params, h, sin, cos, segment_ids) -> h``. The
+            pipeline engine (parallel.pipeline) injects its schedule here
+            so embed/rope/norm/unembed/loss stay this method's single
+            implementation. Training path only (no cache), dense only.
 
         Returns:
           (logits, new_cache) if cache is not None else logits; with
@@ -393,13 +399,22 @@ class Transformer(Module):
             )
 
         if cache is None:
-            def body(carry, layer_p):
-                out, _, aux = block(
-                    layer_p, carry, sin, cos, segment_ids, None, None
-                )
-                return out, aux
+            if blocks_fn is not None:
+                if cfg.n_experts:
+                    raise NotImplementedError(
+                        "blocks_fn override does not support MoE blocks "
+                        "(aux losses cannot flow through the override)"
+                    )
+                h = blocks_fn(p["blocks"], h, sin, cos, segment_ids)
+                auxes = None
+            else:
+                def body(carry, layer_p):
+                    out, _, aux = block(
+                        layer_p, carry, sin, cos, segment_ids, None, None
+                    )
+                    return out, aux
 
-            h, auxes = jax.lax.scan(body, h, p["blocks"])
+                h, auxes = jax.lax.scan(body, h, p["blocks"])
             new_cache = None
         else:
             if return_aux:
@@ -434,7 +449,7 @@ class Transformer(Module):
         return logits if cache is None else (logits, new_cache)
 
     # ------------------------------------------------------------------- loss
-    def loss(self, params, batch):
+    def loss(self, params, batch, *, blocks_fn=None):
         """Next-token loss. batch: {"tokens": (b, s), optional "mask",
         "segment_ids", "positions"}. Predicts tokens[:, 1:]."""
         cfg = self.cfg
@@ -442,6 +457,7 @@ class Transformer(Module):
         logits, moe_aux = self(
             params,
             tokens[:, :-1],
+            blocks_fn=blocks_fn,
             segment_ids=(
                 batch["segment_ids"][:, :-1]
                 if batch.get("segment_ids") is not None

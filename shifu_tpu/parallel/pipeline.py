@@ -27,7 +27,7 @@ repository (SURVEY.md); there is no reference pipeline engine to match.
 
 from __future__ import annotations
 
-import functools as _functools
+import weakref
 from typing import Any, Callable, Optional
 
 import jax
@@ -66,9 +66,14 @@ def pipeline_apply(
     """
     n_stages = mesh.shape[axis]
     if n_stages == 1:
-        # Degenerate pipeline: sequential scan, same contract.
+        # Degenerate pipeline: sequential scan, same contract (including
+        # per-layer rematerialisation when requested).
+        step = lambda h, lp: layer_fn(lp, h, extras)
+        if remat_stage:
+            step = jax.checkpoint(step)
+
         def body(h, lp):
-            return layer_fn(lp, h, extras), None
+            return step(h, lp), None
 
         def one(mb):
             out, _ = jax.lax.scan(body, mb, stacked_params)
@@ -94,14 +99,29 @@ def pipeline_apply(
     return out.astype(compute_dtype) if f32_boundary else out
 
 
-@_functools.lru_cache(maxsize=32)
+_PIPELINE_CACHE: weakref.WeakKeyDictionary = weakref.WeakKeyDictionary()
+
+
 def _pipeline_fn(layer_fn, mesh: Mesh, axis: str, remat_stage: bool):
     """The jitted pipelined program, cached per (layer_fn, mesh, axis).
 
     Everything shape-dependent (microbatch count, tick count, dtypes) is
     derived at trace time from the arguments, so eager callers hit jit's
-    own shape-keyed cache instead of recompiling per call.
+    own shape-keyed cache instead of recompiling per call. The cache is
+    weak-keyed on ``layer_fn`` — entries (and their compiled executables)
+    die with the closure that owns them rather than being pinned by a
+    global LRU.
     """
+    per_fn = _PIPELINE_CACHE.setdefault(layer_fn, {})
+    key = (mesh, axis, remat_stage)
+    if key in per_fn:
+        return per_fn[key]
+    fn = _build_pipeline_fn(layer_fn, mesh, axis, remat_stage)
+    per_fn[key] = fn
+    return fn
+
+
+def _build_pipeline_fn(layer_fn, mesh: Mesh, axis: str, remat_stage: bool):
     n_stages = mesh.shape[axis]
     perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
 
@@ -171,86 +191,71 @@ def pipeline_loss_fn(
     mesh: Mesh,
     microbatches: int,
     axis: str = "pp",
+    remat_stage: Optional[bool] = None,
 ):
     """Pipelined next-token loss for a stacked-layers Transformer.
 
     Returns ``loss_fn(params, batch) -> (loss, aux)`` — same contract as
     ``model.loss`` so it plugs straight into ``make_train_step``'s
-    value_and_grad, but the block stack executes through
-    :func:`pipeline_apply`. Batch leaves are (b, s); rows are split into
-    ``microbatches`` along the batch axis (b % microbatches == 0).
+    value_and_grad. The implementation is ``model.loss`` itself with the
+    block stack swapped for :func:`pipeline_apply` via the model's
+    ``blocks_fn`` hook — embed/rope/norms/unembed/CE (and their
+    activation-sharding anchors) have exactly one implementation. Batch
+    leaves are (b, s); rows are split into ``microbatches`` along the
+    batch axis (b % microbatches == 0).
 
-    Supports the dense Transformer training path (no KV cache, no MoE —
-    expert dispatch inside a pipeline stage needs its own schedule).
+    ``remat_stage`` defaults to the model config's ``remat``. Supports the
+    dense Transformer training path (no KV cache; MoE dispatch inside a
+    pipeline stage needs its own schedule).
     """
-    from shifu_tpu.ops import rms_norm, rope_frequencies, softmax_cross_entropy
-
     cfg = model.cfg
     if getattr(cfg, "n_experts", 0):
         raise NotImplementedError(
             "pipelined MoE is not supported yet: run MoE models with "
             "ep/fsdp sharding instead"
         )
+    if remat_stage is None:
+        remat_stage = getattr(cfg, "remat", True)
 
     def layer_fn(layer_p, h, extras):
         sin, cos, segment_ids = extras
         out, _, _ = model._block(layer_p, h, sin, cos, segment_ids, None, None)
         return out
 
-    def loss_fn(params, batch):
-        tokens = batch["tokens"]
-        mask = batch.get("mask")
-        if batch.get("segment_ids") is not None:
+    def blocks_fn(stacked_blocks, h, sin, cos, segment_ids):
+        if segment_ids is not None:
             # extras are per-stage constants; packing masks vary per
             # microbatch and would need threading through the tick loop.
             raise NotImplementedError(
                 "packed segment_ids are not supported on the pipelined "
                 "path yet; use the sharded scan path for packed batches"
             )
-        if batch.get("positions") is not None:
-            # Same constraint: positions vary per microbatch, but rope
-            # tables ride the replicated extras. arange positions only.
-            raise NotImplementedError(
-                "explicit positions are not supported on the pipelined "
-                "path yet; use the sharded scan path"
-            )
-        inputs = tokens[:, :-1]
-        b, s = inputs.shape
+        b, s, d = h.shape
         if b % microbatches:
             raise ValueError(
                 f"batch {b} not divisible into {microbatches} microbatches"
             )
-        p = model.policy.cast_to_compute(params)
-
-        h = jnp.take(p["embed"], inputs, axis=0)
-        positions = jnp.arange(s)
-        sin, cos = rope_frequencies(
-            cfg.resolved_head_dim, positions, theta=cfg.rope_theta
-        )
-
-        h = h.reshape(microbatches, b // microbatches, s, -1)
+        h = h.reshape(microbatches, b // microbatches, s, d)
         h = pipeline_apply(
             layer_fn,
-            p["blocks"],
+            stacked_blocks,
             h,
             (sin, cos, None),
             mesh=mesh,
             axis=axis,
+            remat_stage=remat_stage,
         )
-        h = h.reshape(b, s, -1)
+        return h.reshape(b, s, d)
 
-        h = rms_norm(h, p["final_norm"], eps=cfg.norm_eps)
-        if cfg.tie_embeddings:
-            logits = jnp.einsum("bsd,vd->bsv", h, p["embed"])
-        else:
-            logits = jnp.einsum("bsd,dv->bsv", h, p["unembed"])
-        logits = model.policy.cast_to_output(logits)
-        return softmax_cross_entropy(
-            logits,
-            tokens[:, 1:],
-            mask=None if mask is None else mask[:, 1:],
-            z_loss=cfg.z_loss,
-        )
+    def loss_fn(params, batch):
+        if batch.get("positions") is not None:
+            # positions vary per microbatch, but the rope tables ride the
+            # replicated per-stage extras. arange positions only.
+            raise NotImplementedError(
+                "explicit positions are not supported on the pipelined "
+                "path yet; use the sharded scan path"
+            )
+        return model.loss(params, batch, blocks_fn=blocks_fn)
 
     return loss_fn
 

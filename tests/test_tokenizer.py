@@ -1,0 +1,65 @@
+"""Tokenizers: byte roundtrip, HF adapter, corpus ingestion end-to-end."""
+
+import numpy as np
+import pytest
+
+from shifu_tpu.data import PackedLoader, TokenDataset
+from shifu_tpu.data.tokenizer import ByteTokenizer, HFTokenizer, tokenize_corpus
+
+
+def test_byte_roundtrip_unicode():
+    tok = ByteTokenizer()
+    for text in ["hello world", "héllo — ünïcode 漢字 🙂", ""]:
+        assert tok.decode(tok.encode(text)) == text
+
+
+def test_byte_specials():
+    tok = ByteTokenizer()
+    ids = tok.encode("ab", bos=True, eos=True)
+    assert ids[0] == tok.bos_id and ids[-1] == tok.eos_id
+    assert tok.decode(ids) == "ab"  # specials dropped on decode
+    assert tok.vocab_size == 259
+    assert max(ids) < tok.vocab_size
+
+
+def test_hf_adapter_offline(tmp_path):
+    # BertTokenizer works from a local vocab file — no hub access needed.
+    from transformers import BertTokenizer
+
+    vocab = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "hello", "world", "##!"]
+    vf = tmp_path / "vocab.txt"
+    vf.write_text("\n".join(vocab))
+    tok = HFTokenizer(BertTokenizer(str(vf), do_lower_case=True))
+    ids = tok.encode("hello world")
+    assert tok.decode(ids) == "hello world"
+    # transformers auto-registers [MASK] on top of the file's vocab.
+    assert tok.vocab_size >= len(vocab)
+    assert tok.pad_id == 0
+
+
+def test_tokenize_corpus_feeds_loader(tmp_path):
+    tok = ByteTokenizer()
+    texts = [f"document number {i} with some text." for i in range(30)]
+    d = str(tmp_path / "corpus")
+    n = tokenize_corpus(texts, tok, d)
+    assert n == 30
+    ds = TokenDataset(d)
+    assert ds.n_docs == 30
+    # EOS appended to every doc.
+    assert int(ds.doc(0)[-1]) == tok.eos_id
+    assert tok.decode(ds.doc(7).tolist()) == texts[7]
+    loader = PackedLoader(ds, batch_size=2, seq_len=33, seed=0)
+    batch = next(iter(loader))
+    assert batch["tokens"].shape == (2, 33)
+    assert batch["tokens"].max() < tok.vocab_size
+
+
+def test_tokenize_corpus_dtype_autoselect(tmp_path):
+    class BigVocab(ByteTokenizer):
+        @property
+        def vocab_size(self):
+            return 100_000
+
+    d = str(tmp_path / "big")
+    tokenize_corpus(["abc"], BigVocab(), d)
+    assert TokenDataset(d).dtype == np.uint32
