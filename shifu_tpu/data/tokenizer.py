@@ -86,9 +86,20 @@ class HFTokenizer:
 
     def encode(self, text: str, *, bos: bool = False, eos: bool = False):
         ids = self._tok.encode(text, add_special_tokens=False)
-        if bos and self.bos_id is not None:
+        if bos:
+            if self.bos_id is None:
+                raise ValueError(
+                    "bos requested but this tokenizer has no bos token"
+                )
             ids.insert(0, self.bos_id)
-        if eos and self.eos_id is not None:
+        if eos:
+            # Silently dropping a requested eos would write corpora with
+            # no document boundaries — fail at ingestion time instead.
+            if self.eos_id is None:
+                raise ValueError(
+                    "eos requested but this tokenizer has no eos token; "
+                    "pass append_eos=False or use a tokenizer with one"
+                )
             ids.append(self.eos_id)
         return ids
 

@@ -27,7 +27,6 @@ repository (SURVEY.md); there is no reference pipeline engine to match.
 
 from __future__ import annotations
 
-import weakref
 from typing import Any, Callable, Optional
 
 import jax
@@ -99,26 +98,30 @@ def pipeline_apply(
     return out.astype(compute_dtype) if f32_boundary else out
 
 
-_PIPELINE_CACHE: weakref.WeakKeyDictionary = weakref.WeakKeyDictionary()
-
-
 def _pipeline_fn(layer_fn, mesh: Mesh, axis: str, remat_stage: bool):
     """The jitted pipelined program, cached per (layer_fn, mesh, axis).
 
     Everything shape-dependent (microbatch count, tick count, dtypes) is
     derived at trace time from the arguments, so eager callers hit jit's
-    own shape-keyed cache instead of recompiling per call. The cache is
-    weak-keyed on ``layer_fn`` — entries (and their compiled executables)
-    die with the closure that owns them rather than being pinned by a
-    global LRU.
+    own shape-keyed cache instead of recompiling per call. The cache
+    lives as an attribute ON ``layer_fn`` itself: the resulting reference
+    cycle (fn -> cache -> jitted program -> closure -> fn) is ordinary
+    gc-collectable garbage once the owner drops the closure, so compiled
+    executables die with the loss function that created them. (A
+    WeakKeyDictionary would NOT achieve this: its strong value reference
+    back to the key would make entries immortal.)
     """
-    per_fn = _PIPELINE_CACHE.setdefault(layer_fn, {})
+    cache = getattr(layer_fn, "__shifu_pipeline_cache__", None)
+    if cache is None:
+        cache = {}
+        try:
+            layer_fn.__shifu_pipeline_cache__ = cache
+        except AttributeError:  # non-function callable: skip caching
+            return _build_pipeline_fn(layer_fn, mesh, axis, remat_stage)
     key = (mesh, axis, remat_stage)
-    if key in per_fn:
-        return per_fn[key]
-    fn = _build_pipeline_fn(layer_fn, mesh, axis, remat_stage)
-    per_fn[key] = fn
-    return fn
+    if key not in cache:
+        cache[key] = _build_pipeline_fn(layer_fn, mesh, axis, remat_stage)
+    return cache[key]
 
 
 def _build_pipeline_fn(layer_fn, mesh: Mesh, axis: str, remat_stage: bool):

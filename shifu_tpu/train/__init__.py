@@ -11,6 +11,7 @@ from shifu_tpu.train.optimizer import (
     wsd,
 )
 from shifu_tpu.train.loop import Trainer, TrainLoopConfig, evaluate
+from shifu_tpu.train.lora import LoraConfig, LoraModel, merge_lora
 from shifu_tpu.train.step import (
     TrainState,
     create_sharded_state,
@@ -29,6 +30,9 @@ __all__ = [
     "linear",
     "warmup_cosine",
     "wsd",
+    "LoraConfig",
+    "LoraModel",
+    "merge_lora",
     "Trainer",
     "TrainLoopConfig",
     "evaluate",

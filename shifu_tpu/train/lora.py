@@ -1,0 +1,210 @@
+"""LoRA: low-rank adapter fine-tuning over a frozen base.
+
+For a targeted weight W of shape (L?, *in_dims, *out_dims) the adapter is
+a pair A: (L?, prod-free *in_dims, r) and B: (L?, r, *out_dims) with
+``W_eff = W + (alpha / r) · A·B`` — B is zero-initialised so training
+starts exactly at the base model. Which dims are inputs comes from the
+model's ``quant_spec()`` (the matmul contraction axes — the same model
+knowledge int8 quantization uses), so the adapter layer works for any
+module family that implements it.
+
+TPU-first mechanics:
+
+  * the merge ``W + scale·A·B`` happens inside the jit — XLA fuses the
+    rank-r matmul and the add into the step; the full-rank delta is a
+    transient, never a resident buffer;
+  * :class:`LoraModel` exposes the standard module surface (specs / axes /
+    init / loss / __call__) over the *adapter* parameters only, so
+    ``create_sharded_state``, ``make_train_step``, the Trainer, and the
+    checkpoint stack train/save just the adapters (optimizer moments
+    included — the memory win of LoRA);
+  * adapter logical axes inherit the base weight's input/output axis
+    names, so tp/fsdp sharding rules apply to A and B unchanged;
+  * base params ride the loss closure as jit constants (runtime buffer
+    arguments, shared across steps — not HLO literals).
+
+Reference parity note: the upstream reference (klyan/shifu) is an empty
+repository (SURVEY.md); there is no reference adapter implementation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from shifu_tpu.core.module import ParamSpec
+from shifu_tpu.core import initializers
+
+
+@dataclasses.dataclass(frozen=True)
+class LoraConfig:
+    rank: int = 8
+    alpha: float = 16.0
+    # Leaf names (the last key on the path) that get adapters.
+    targets: Tuple[str, ...] = ("wq", "wk", "wv", "wo")
+
+    @property
+    def scale(self) -> float:
+        return self.alpha / self.rank
+
+
+def _leaf_paths(tree):
+    leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+    return [
+        (tuple(str(getattr(k, "key", k)) for k in path), leaf)
+        for path, leaf in leaves
+    ]
+
+
+def _split_dims(shape, axes, contract):
+    """(has_layers, in_dims, out_dims, in_axes, out_axes) for one weight.
+
+    Requires the contraction axes to be contiguous and immediately after
+    the optional leading "layers" axis — true for every stacked einsum
+    weight in the in-tree families (wq (L,d,h,hd) contracts (1,), wo
+    contracts (1,2), unembed (d,V) contracts (0,)).
+    """
+    lead = 1 if axes and axes[0] == "layers" else 0
+    want = tuple(range(lead, lead + len(contract)))
+    got = tuple(sorted(a % len(shape) for a in contract))
+    if got != want:
+        raise NotImplementedError(
+            f"LoRA needs leading contraction dims; weight has shape "
+            f"{shape}, axes {axes}, contraction {got}"
+        )
+    k = lead + len(contract)
+    return (
+        lead == 1,
+        shape[lead:k],
+        shape[k:],
+        axes[lead:k],
+        axes[k:],
+    )
+
+
+class LoraModel:
+    """Adapter-parameter view of ``model`` with ``base_params`` frozen.
+
+    Usage::
+
+        lm = LoraModel(model, base_params, LoraConfig(rank=8))
+        state = create_sharded_state(lm, optimizer, rng, mesh)
+        step = make_train_step(lm, optimizer, mesh)   # trains adapters only
+        merged = lm.merge(state.params)               # fold for serving
+    """
+
+    def __init__(self, model, base_params, cfg: LoraConfig = LoraConfig()):
+        self.inner = model
+        self.cfg = getattr(model, "cfg", None)
+        self.lora_cfg = cfg
+        self.base_params = base_params
+
+        qspec = model.quant_spec()
+        mspecs = model.specs()
+        is_spec = lambda x: isinstance(x, ParamSpec)
+        treedef = jax.tree_util.tree_structure(mspecs, is_leaf=is_spec)
+        self._treedef = treedef
+        spec_leaves = _leaf_paths(
+            jax.tree_util.tree_map(lambda s: s, mspecs, is_leaf=is_spec)
+        )
+        contract_leaves = treedef.flatten_up_to(qspec)
+
+        self._adapters = {}  # path -> (ParamSpec A, ParamSpec B)
+        r = cfg.rank
+        for (path, spec), contract in zip(spec_leaves, contract_leaves):
+            if path[-1] not in cfg.targets:
+                continue
+            if not contract:
+                raise ValueError(
+                    f"target {'/'.join(path)} is not a quantizable matmul "
+                    f"weight (quant_spec marks it full-precision)"
+                )
+            has_layers, in_dims, out_dims, in_axes, out_axes = _split_dims(
+                spec.shape, spec.axes, contract
+            )
+            lead_shape = (spec.shape[0],) if has_layers else ()
+            lead_axes = ("layers",) if has_layers else ()
+            fan_in = math.prod(in_dims)
+            a = ParamSpec(
+                lead_shape + in_dims + (r,),
+                lead_axes + in_axes + (None,),
+                initializers.truncated_normal(1.0 / math.sqrt(fan_in)),
+            )
+            b = ParamSpec(
+                lead_shape + (r,) + out_dims,
+                lead_axes + (None,) + out_axes,
+                initializers.zeros,  # delta starts at exactly 0
+            )
+            self._adapters[path] = (a, b)
+        if not self._adapters:
+            raise ValueError(
+                f"no adapter targets matched: targets={cfg.targets}"
+            )
+
+    # --------------------------------------------------- module surface
+    def specs(self):
+        return {
+            "/".join(path): {"a": a, "b": b}
+            for path, (a, b) in self._adapters.items()
+        }
+
+    def axes(self):
+        return jax.tree_util.tree_map(
+            lambda s: s.axes,
+            self.specs(),
+            is_leaf=lambda x: isinstance(x, ParamSpec),
+        )
+
+    def init(self, rng):
+        from shifu_tpu.core.module import init_params
+
+        class _M:
+            specs = self.specs
+
+        return init_params(_M(), rng)
+
+    # --------------------------------------------------------- merging
+    def merge(self, lora_params, base_params=None):
+        """Base params with every adapter folded in (W + scale·A·B)."""
+        base = self.base_params if base_params is None else base_params
+        flat = dict(_leaf_paths(base))
+        scale = self.lora_cfg.scale
+        for path, (a_spec, b_spec) in self._adapters.items():
+            key = "/".join(path)
+            a = lora_params[key]["a"]
+            b = lora_params[key]["b"]
+            w = flat[path]
+            lead = 1 if a_spec.axes[0] == "layers" else 0
+            a2 = a.reshape(a.shape[:lead] + (-1, a.shape[-1]))  # (L?, In, r)
+            b2 = b.reshape(b.shape[: lead + 1] + (-1,))  # (L?, r, Out)
+            delta = (
+                jnp.einsum("lir,lro->lio", a2, b2)
+                if lead
+                else jnp.einsum("ir,ro->io", a2, b2)
+            )
+            delta = (scale * delta).reshape(w.shape).astype(w.dtype)
+            flat[path] = w + delta
+        # Rebuild the tree in the base params' structure.
+        base_leaves_paths = [p for p, _ in _leaf_paths(base)]
+        leaves = [flat[p] for p in base_leaves_paths]
+        treedef = jax.tree_util.tree_structure(base)
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+
+    # ------------------------------------------------------ model calls
+    def loss(self, lora_params, batch):
+        return self.inner.loss(self.merge(lora_params), batch)
+
+    def __call__(self, lora_params, *args, **kwargs):
+        return self.inner(self.merge(lora_params), *args, **kwargs)
+
+    def init_cache(self, *args, **kwargs):
+        return self.inner.init_cache(*args, **kwargs)
+
+
+def merge_lora(model, base_params, lora_params, cfg: LoraConfig):
+    """One-shot fold: returns base params with adapters merged in."""
+    return LoraModel(model, base_params, cfg).merge(lora_params)

@@ -1,0 +1,140 @@
+"""LoRA adapters: zero-start, adapter-only training, merge, sharding."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from shifu_tpu.core.module import param_count
+from shifu_tpu.models import Transformer, TransformerConfig
+from shifu_tpu.parallel import MeshPlan, shard_batch
+from shifu_tpu.train import AdamW, constant, create_sharded_state, make_train_step
+from shifu_tpu.train.lora import LoraConfig, LoraModel, merge_lora
+
+
+@pytest.fixture(scope="module")
+def base():
+    model = Transformer(TransformerConfig.tiny())
+    params = model.init(jax.random.key(0))
+    return model, params
+
+
+def test_init_is_identity(base):
+    model, params = base
+    lm = LoraModel(model, params, LoraConfig(rank=4))
+    lp = lm.init(jax.random.key(1))
+    # B zero-init -> merged == base exactly.
+    merged = lm.merge(lp)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(params), jax.tree_util.tree_leaves(merged)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    tokens = jnp.asarray(
+        np.random.RandomState(0).randint(0, 256, (2, 12)), jnp.int32
+    )
+    l0, _ = model.loss(params, {"tokens": tokens})
+    l1, _ = lm.loss(lp, {"tokens": tokens})
+    assert float(l0) == pytest.approx(float(l1), rel=1e-6)
+
+
+def test_adapter_param_count_small(base):
+    model, params = base
+    lm = LoraModel(model, params, LoraConfig(rank=4))
+    lp = lm.init(jax.random.key(1))
+    assert param_count(lp) < 0.2 * param_count(params)
+    # Structure: one {a, b} pair per target.
+    assert set(lp.keys()) == {
+        "blocks/wq", "blocks/wk", "blocks/wv", "blocks/wo",
+    }
+    cfg = model.cfg
+    L, d, h, hd = cfg.n_layers, cfg.dim, cfg.n_heads, cfg.resolved_head_dim
+    assert lp["blocks/wq"]["a"].shape == (L, d, 4)
+    assert lp["blocks/wq"]["b"].shape == (L, 4, h, hd)
+    assert lp["blocks/wo"]["a"].shape == (L, h, hd, 4)
+    assert lp["blocks/wo"]["b"].shape == (L, 4, d)
+
+
+def test_training_moves_adapters_not_base(base):
+    model, params = base
+    lm = LoraModel(model, params, LoraConfig(rank=4))
+    opt = AdamW(schedule=constant(5e-2), weight_decay=0.0)
+    from shifu_tpu.train import TrainState
+
+    state = TrainState.create(lm.init(jax.random.key(1)), opt)
+    step = make_train_step(lm, opt)
+    tokens = jnp.asarray(
+        np.random.RandomState(1).randint(0, 256, (4, 16)), jnp.int32
+    )
+    losses = []
+    for _ in range(8):
+        state, metrics = step(state, {"tokens": tokens})
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0] - 0.1, losses
+    # Base params untouched (frozen by construction).
+    fresh = model.init(jax.random.key(0))
+    for a, b in zip(
+        jax.tree_util.tree_leaves(params), jax.tree_util.tree_leaves(fresh)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_merge_matches_adapter_forward(base):
+    model, params = base
+    lm = LoraModel(model, params, LoraConfig(rank=4))
+    lp = lm.init(jax.random.key(2))
+    # Make the adapters nonzero.
+    lp = jax.tree_util.tree_map(
+        lambda x: x + 0.01 * jnp.ones_like(x), lp
+    )
+    tokens = jnp.asarray(
+        np.random.RandomState(3).randint(0, 256, (2, 10)), jnp.int32
+    )
+    via_wrapper = lm(lp, tokens)
+    merged = merge_lora(model, params, lp, LoraConfig(rank=4))
+    via_merged = model(merged, tokens)
+    np.testing.assert_allclose(
+        np.asarray(via_wrapper), np.asarray(via_merged), rtol=1e-5, atol=1e-6
+    )
+
+
+def test_sharded_lora_train_step(devices, base):
+    model, params = base
+    mesh = MeshPlan(fsdp=2, sp=2, tp=2).build()
+    lm = LoraModel(model, params, LoraConfig(rank=4))
+    opt = AdamW()
+    tokens = jnp.asarray(
+        np.random.RandomState(4).randint(0, 256, (4, 16)), jnp.int32
+    )
+    with mesh:
+        state = create_sharded_state(lm, opt, jax.random.key(1), mesh)
+        step = make_train_step(lm, opt, mesh)
+        batch = shard_batch({"tokens": tokens}, mesh)
+        state, metrics = step(state, batch)
+        assert np.isfinite(float(metrics["loss"]))
+        # Adapter A for wq: (L, d, r) -> sharded ("pp", "fsdp", None).
+        a = state.params["blocks/wq"]["a"]
+        assert a.addressable_shards[0].data.shape[1] == model.cfg.dim // 2
+
+
+def test_generation_with_adapters(base):
+    from shifu_tpu.infer import SampleConfig, make_generate_fn
+
+    model, params = base
+    lm = LoraModel(model, params, LoraConfig(rank=2))
+    lp = lm.init(jax.random.key(5))
+    fn = make_generate_fn(
+        lm, max_new_tokens=4, sample_cfg=SampleConfig(temperature=0.0)
+    )
+    prompts = jnp.asarray(
+        np.random.RandomState(5).randint(1, 256, (2, 6)), jnp.int32
+    )
+    out = fn(lp, prompts, jnp.asarray([6, 4], jnp.int32), jax.random.key(0))
+    assert out["tokens"].shape == (2, 4)
+
+
+def test_bad_target_raises(base):
+    model, params = base
+    with pytest.raises(ValueError, match="no adapter targets"):
+        LoraModel(model, params, LoraConfig(targets=("nope",)))
+    with pytest.raises(ValueError, match="not a quantizable"):
+        LoraModel(model, params, LoraConfig(targets=("attn_norm",)))

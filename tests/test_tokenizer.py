@@ -35,6 +35,10 @@ def test_hf_adapter_offline(tmp_path):
     # transformers auto-registers [MASK] on top of the file's vocab.
     assert tok.vocab_size >= len(vocab)
     assert tok.pad_id == 0
+    # BERT has no eos token: requesting one must fail loudly, not write a
+    # boundary-less corpus.
+    with pytest.raises(ValueError, match="no eos token"):
+        tok.encode("hello", eos=True)
 
 
 def test_tokenize_corpus_feeds_loader(tmp_path):
