@@ -32,3 +32,11 @@ from shifu_tpu.parallel.pipeline import (  # noqa: E402
 )
 
 __all__ += ["PipelinedModel", "pipeline_apply", "pipeline_loss_fn"]
+from shifu_tpu.parallel.distributed import (  # noqa: E402
+    HybridMeshPlan,
+    initialize,
+    is_coordinator,
+    shard_host_batch,
+)
+
+__all__ += ["HybridMeshPlan", "initialize", "is_coordinator", "shard_host_batch"]

@@ -1,0 +1,163 @@
+"""Multi-host (multi-process) runtime: init, hybrid meshes, host-local data.
+
+A multi-host TPU pod runs one Python process per host; every process
+executes the same program and owns a subset of the devices. Three pieces
+make the framework's single-host code work unchanged at pod scale:
+
+  * :func:`initialize` — bring up the JAX distributed runtime (GRPC
+    coordination service). On TPU pods all parameters auto-detect from
+    the metadata server; elsewhere pass coordinator/process counts (or
+    export JAX_COORDINATOR_ADDRESS etc.). No-op when single-process.
+  * :class:`HybridMeshPlan` — meshes that respect the two-tier network:
+    ICI (fast, within a slice) and DCN (slower, between slices). Each
+    logical axis is the product of its DCN and ICI extents, with DCN
+    placed on the outer (slower-varying) tier — put dp/fsdp there, keep
+    tp/sp/pp inside a slice, and gradient all-reduces are the only
+    cross-slice traffic (the scaling-book recipe).
+  * :func:`shard_host_batch` — per-process data feeding: every host
+    loads only its own rows (e.g. PackedLoader over a host-sharded file
+    set) and ``jax.make_array_from_process_local_data`` assembles the
+    logical global batch without any cross-host gather.
+
+Reference parity note: the upstream reference (klyan/shifu) is an empty
+repository (SURVEY.md); there is no reference distributed backend — this
+is the jax.distributed + Mesh idiom that replaces a NCCL/MPI stack.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Mapping, Optional
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding
+
+from shifu_tpu.parallel.mesh import MESH_AXES, MeshPlan
+from shifu_tpu.parallel import sharding as shd
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Start the distributed runtime if this is a multi-process job.
+
+    Returns True when initialization ran, False for a single-process run
+    (nothing to do). Safe to call unconditionally at program start —
+    mirrors how a torch.distributed/NCCL stack would init, but the
+    coordination here is only for control-plane bootstrap: the actual
+    collectives are XLA programs over ICI/DCN, no process-level
+    communicator objects exist.
+    """
+    explicit = coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    # Auto-detect only a genuinely multi-host TPU job: a single-host TPU VM
+    # also exports TPU_WORKER_HOSTNAMES (= "localhost"), so require >1 host.
+    hostnames = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    on_multihost_tpu = len([h for h in hostnames.split(",") if h]) > 1 or bool(
+        os.environ.get("MEGASCALE_COORDINATOR_ADDRESS")
+    )
+    if not explicit and not on_multihost_tpu:
+        return False
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return True
+
+
+def is_coordinator() -> bool:
+    """True on the process that should write checkpoints metadata / logs."""
+    return jax.process_index() == 0
+
+
+@dataclasses.dataclass(frozen=True)
+class HybridMeshPlan:
+    """Two-tier mesh: per-axis extents split into DCN (outer) x ICI (inner).
+
+    Example — 4 slices of 256 chips, fsdp across slices, tp/sp within::
+
+        mesh = HybridMeshPlan(
+            dcn=MeshPlan(fsdp=4),
+            ici=MeshPlan(fsdp=16, sp=4, tp=4),
+        ).build()
+
+    gives a (dp, fsdp, ep, pp, sp, tp) = (1, 64, 1, 1, 4, 4) mesh where
+    the 4-way outer factor of fsdp crosses DCN and everything else stays
+    on ICI.
+    """
+
+    dcn: MeshPlan = MeshPlan()
+    ici: MeshPlan = MeshPlan()
+
+    @property
+    def shape(self) -> tuple:
+        return tuple(d * i for d, i in zip(self.dcn.shape, self.ici.shape))
+
+    @property
+    def n_devices(self) -> int:
+        return int(np.prod(self.shape))
+
+    def build(self, devices=None) -> Mesh:
+        if devices is None:
+            devices = jax.devices()
+        if self.n_devices != len(devices):
+            raise ValueError(
+                f"HybridMeshPlan {self.shape} needs {self.n_devices} "
+                f"devices, got {len(devices)}"
+            )
+        multi_slice = (
+            devices[0].platform == "tpu"
+            and getattr(devices[0], "slice_index", None) is not None
+            and any(self.dcn.shape[i] > 1 for i in range(len(MESH_AXES)))
+        )
+        if multi_slice:
+            from jax.experimental import mesh_utils
+
+            dev_array = mesh_utils.create_hybrid_device_mesh(
+                self.ici.shape, self.dcn.shape, devices=devices
+            )
+        else:
+            # Single slice / no slice topology info: the DCN tier is
+            # vacuous — a plain reshape with the outer factor leading per
+            # axis preserves the intended axis extents.
+            dev_array = np.asarray(devices).reshape(self.shape)
+        return Mesh(dev_array, MESH_AXES)
+
+
+def shard_host_batch(
+    batch: Mapping[str, np.ndarray],
+    mesh: Mesh,
+    rules=None,
+    *,
+    microbatched: bool = False,
+):
+    """Assemble a GLOBAL batch from per-process LOCAL rows.
+
+    Each process passes only its own slice of the global batch (global
+    batch axis = local rows x process count, in process-index order).
+    Uses ``jax.make_array_from_process_local_data``, so no host ever
+    materialises other hosts' data. With one process this equals
+    parallel.shard_batch.
+    """
+    rules = rules or shd.DEFAULT_RULES
+    lead = (None,) if microbatched else ()
+
+    def put(x):
+        x = np.asarray(x)
+        names = lead + ("batch", "seq")
+        logical = names[: x.ndim] + (None,) * max(0, x.ndim - len(names))
+        global_shape = list(x.shape)
+        axis = 1 if microbatched else 0
+        if axis < x.ndim:  # leaves without a batch axis stay replicated
+            global_shape[axis] *= jax.process_count()
+        spec = shd.spec_for(tuple(global_shape), logical, mesh, rules)
+        return jax.make_array_from_process_local_data(
+            NamedSharding(mesh, spec), x, tuple(global_shape)
+        )
+
+    return jax.tree_util.tree_map(put, batch)

@@ -113,15 +113,24 @@ def _pipeline_fn(layer_fn, mesh: Mesh, axis: str, remat_stage: bool):
     """
     cache = getattr(layer_fn, "__shifu_pipeline_cache__", None)
     if cache is None:
-        cache = {}
         try:
+            cache = {}
             layer_fn.__shifu_pipeline_cache__ = cache
-        except AttributeError:  # non-function callable: skip caching
-            return _build_pipeline_fn(layer_fn, mesh, axis, remat_stage)
+        except AttributeError:
+            # Non-attributable callable (bound method, __slots__ object):
+            # fall back to a small bounded module cache — still cached (no
+            # silent per-call recompiles), just capped instead of
+            # owner-scoped.
+            cache = _FALLBACK_CACHE.setdefault(layer_fn, {})
+            while len(_FALLBACK_CACHE) > 8:
+                _FALLBACK_CACHE.pop(next(iter(_FALLBACK_CACHE)))
     key = (mesh, axis, remat_stage)
     if key not in cache:
         cache[key] = _build_pipeline_fn(layer_fn, mesh, axis, remat_stage)
     return cache[key]
+
+
+_FALLBACK_CACHE: dict = {}
 
 
 def _build_pipeline_fn(layer_fn, mesh: Mesh, axis: str, remat_stage: bool):

@@ -108,9 +108,7 @@ class LoraModel:
         is_spec = lambda x: isinstance(x, ParamSpec)
         treedef = jax.tree_util.tree_structure(mspecs, is_leaf=is_spec)
         self._treedef = treedef
-        spec_leaves = _leaf_paths(
-            jax.tree_util.tree_map(lambda s: s, mspecs, is_leaf=is_spec)
-        )
+        spec_leaves = _leaf_paths(mspecs)
         contract_leaves = treedef.flatten_up_to(qspec)
 
         self._adapters = {}  # path -> (ParamSpec A, ParamSpec B)
@@ -195,6 +193,13 @@ class LoraModel:
         return jax.tree_util.tree_unflatten(treedef, leaves)
 
     # ------------------------------------------------------ model calls
+    @property
+    def prefill_needs_mask(self) -> bool:
+        # Mirror the wrapped family (see infer.quant.QuantizedModel): a
+        # recurrent base behind this wrapper still needs the generation
+        # stack's prefill mask.
+        return getattr(self.inner, "prefill_needs_mask", False)
+
     def loss(self, lora_params, batch):
         return self.inner.loss(self.merge(lora_params), batch)
 

@@ -1,0 +1,75 @@
+"""Multi-host utilities (exercised single-process on the virtual mesh)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from shifu_tpu.models import Transformer, TransformerConfig
+from shifu_tpu.parallel import MeshPlan, shard_batch
+from shifu_tpu.parallel.distributed import (
+    HybridMeshPlan,
+    initialize,
+    is_coordinator,
+    shard_host_batch,
+)
+from shifu_tpu.train import AdamW, create_sharded_state, make_train_step
+
+
+def test_initialize_noop_single_process(monkeypatch):
+    for var in ("JAX_COORDINATOR_ADDRESS", "TPU_WORKER_HOSTNAMES",
+                "MEGASCALE_COORDINATOR_ADDRESS"):
+        monkeypatch.delenv(var, raising=False)
+    assert initialize() is False
+    assert is_coordinator() is True
+
+
+def test_hybrid_mesh_shape_and_order(devices):
+    plan = HybridMeshPlan(
+        dcn=MeshPlan(fsdp=2), ici=MeshPlan(fsdp=2, tp=2)
+    )
+    assert plan.shape == (1, 4, 1, 1, 1, 2)
+    mesh = plan.build()
+    assert mesh.shape["fsdp"] == 4 and mesh.shape["tp"] == 2
+    assert mesh.axis_names == ("dp", "fsdp", "ep", "pp", "sp", "tp")
+
+
+def test_hybrid_mesh_validates_count():
+    with pytest.raises(ValueError, match="needs 16"):
+        HybridMeshPlan(dcn=MeshPlan(fsdp=2), ici=MeshPlan(fsdp=8)).build()
+
+
+def test_train_step_on_hybrid_mesh(devices):
+    mesh = HybridMeshPlan(
+        dcn=MeshPlan(fsdp=2), ici=MeshPlan(fsdp=2, tp=2)
+    ).build()
+    model = Transformer(TransformerConfig.tiny())
+    opt = AdamW()
+    tokens = jnp.asarray(
+        np.random.RandomState(0).randint(0, 256, (4, 16)), jnp.int32
+    )
+    with mesh:
+        state = create_sharded_state(model, opt, jax.random.key(0), mesh)
+        step = make_train_step(model, opt, mesh)
+        batch = shard_batch({"tokens": tokens}, mesh)
+        state, metrics = step(state, batch)
+        assert np.isfinite(float(metrics["loss"]))
+
+
+def test_shard_host_batch_single_process_matches_shard_batch(devices):
+    mesh = MeshPlan(fsdp=2, sp=2, tp=2).build()
+    tokens = np.random.RandomState(1).randint(0, 256, (4, 16)).astype(np.int32)
+    a = shard_host_batch({"tokens": tokens}, mesh)
+    b = shard_batch({"tokens": tokens}, mesh)
+    assert a["tokens"].shape == b["tokens"].shape == (4, 16)
+    assert a["tokens"].sharding == b["tokens"].sharding
+    np.testing.assert_array_equal(
+        np.asarray(a["tokens"]), np.asarray(b["tokens"])
+    )
+
+
+def test_shard_host_batch_microbatched(devices):
+    mesh = MeshPlan(fsdp=4, sp=2).build()
+    tokens = np.zeros((3, 4, 16), np.int32)  # (microbatch, b, s)
+    out = shard_host_batch({"tokens": tokens}, mesh, microbatched=True)
+    assert out["tokens"].shape == (3, 4, 16)
