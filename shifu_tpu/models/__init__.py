@@ -1,4 +1,17 @@
 from shifu_tpu.models.transformer import Transformer, TransformerConfig
 from shifu_tpu.models.mamba import Mamba, MambaConfig
+from shifu_tpu.models.convert import (
+    config_from_hf_llama,
+    from_hf_llama,
+    params_from_hf_llama,
+)
 
-__all__ = ["Transformer", "TransformerConfig", "Mamba", "MambaConfig"]
+__all__ = [
+    "Transformer",
+    "TransformerConfig",
+    "Mamba",
+    "MambaConfig",
+    "config_from_hf_llama",
+    "from_hf_llama",
+    "params_from_hf_llama",
+]

@@ -1,0 +1,160 @@
+"""Weight interop: HuggingFace Llama-family checkpoints -> shifu_tpu.
+
+``from_hf_llama`` maps a `transformers` Llama model (or its config +
+state_dict) onto the native Transformer family so existing checkpoints
+can be served/fine-tuned on TPU without retraining. The numerical
+conventions line up exactly (verified by the parity test in
+tests/test_convert.py against the torch forward):
+
+  * RoPE: both use the split-half (rotate_half) convention with
+    inv_freq = theta^(-2i/head_dim) — weights transfer unpermuted.
+  * RMSNorm: HF stores the full gain g (y = x̂·g); this framework stores
+    (1 + scale) — so ``scale = g - 1``.
+  * Linear layers: torch keeps (out, in); einsum weights here are
+    (in, out[, ...]) — transpose + reshape, heads-major.
+
+Everything is stacked across layers into the (layers, ...) leaves the
+scan-based forward expects.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping, Tuple
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from shifu_tpu.models.transformer import Transformer, TransformerConfig
+
+
+def _to_np(t) -> np.ndarray:
+    if hasattr(t, "detach"):  # torch tensor
+        return t.detach().to("cpu").float().numpy()
+    return np.asarray(t, np.float32)
+
+
+def config_from_hf_llama(hf_config, **overrides) -> TransformerConfig:
+    """TransformerConfig mirroring a transformers LlamaConfig."""
+    scaling = getattr(hf_config, "rope_scaling", None)
+    if scaling and scaling.get("rope_type", scaling.get("type")) != "default":
+        # Llama-3.1-style frequency scaling is not implemented here;
+        # converting silently would give wrong logits at long context.
+        raise NotImplementedError(
+            f"rope_scaling={scaling!r} is not supported; only default "
+            "(unscaled) RoPE converts exactly"
+        )
+    kw = dict(
+        vocab_size=hf_config.vocab_size,
+        dim=hf_config.hidden_size,
+        n_layers=hf_config.num_hidden_layers,
+        n_heads=hf_config.num_attention_heads,
+        n_kv_heads=getattr(hf_config, "num_key_value_heads", None)
+        or hf_config.num_attention_heads,
+        mlp_dim=hf_config.intermediate_size,
+        head_dim=getattr(hf_config, "head_dim", None),
+        rope_theta=getattr(hf_config, "rope_theta", 10_000.0),
+        norm_eps=hf_config.rms_norm_eps,
+        tie_embeddings=getattr(hf_config, "tie_word_embeddings", False),
+    )
+    kw.update(overrides)
+    return TransformerConfig(**kw)
+
+
+def params_from_hf_llama(
+    state_dict: Mapping[str, Any], cfg: TransformerConfig, dtype=jnp.float32
+):
+    """shifu_tpu param tree from a HF Llama state_dict."""
+    sd = {k: v for k, v in state_dict.items()}
+    L = cfg.n_layers
+    d, h, kv, hd = (
+        cfg.dim, cfg.n_heads, cfg.n_kv_heads, cfg.resolved_head_dim,
+    )
+    consumed = set()
+
+    def get(name):
+        for prefix in ("model.", ""):
+            key = prefix + name
+            if key in sd:
+                consumed.add(key)
+                return _to_np(sd[key])
+        raise KeyError(f"missing weight {name!r} in state_dict")
+
+    def stack(fmt, transform):
+        return jnp.asarray(
+            np.stack([transform(get(fmt.format(l))) for l in range(L)]),
+            dtype,
+        )
+
+    blocks = {
+        "attn_norm": stack(
+            "layers.{}.input_layernorm.weight", lambda w: w - 1.0
+        ),
+        "mlp_norm": stack(
+            "layers.{}.post_attention_layernorm.weight", lambda w: w - 1.0
+        ),
+        # torch Linear weight (out, in): transpose, then split the out dim
+        # heads-major.
+        "wq": stack(
+            "layers.{}.self_attn.q_proj.weight",
+            lambda w: w.T.reshape(d, h, hd),
+        ),
+        "wk": stack(
+            "layers.{}.self_attn.k_proj.weight",
+            lambda w: w.T.reshape(d, kv, hd),
+        ),
+        "wv": stack(
+            "layers.{}.self_attn.v_proj.weight",
+            lambda w: w.T.reshape(d, kv, hd),
+        ),
+        "wo": stack(
+            "layers.{}.self_attn.o_proj.weight",
+            lambda w: w.T.reshape(h, hd, d),
+        ),
+        "w_gate": stack(
+            "layers.{}.mlp.gate_proj.weight", lambda w: w.T
+        ),
+        "w_up": stack("layers.{}.mlp.up_proj.weight", lambda w: w.T),
+        "w_down": stack("layers.{}.mlp.down_proj.weight", lambda w: w.T),
+    }
+    params = {
+        "embed": jnp.asarray(get("embed_tokens.weight"), dtype),
+        "blocks": blocks,
+        "final_norm": jnp.asarray(get("norm.weight") - 1.0, dtype),
+    }
+    if not cfg.tie_embeddings:
+        params["unembed"] = jnp.asarray(get("lm_head.weight").T, dtype)
+
+    # Every remaining tensor would be silently dropped — for a model with
+    # e.g. attention biases (Qwen2-style) that means numerically wrong
+    # logits with no error. Fail loudly instead. (Rotary inv_freq buffers
+    # are derived constants, safe to skip; a tied lm_head aliases embed.)
+    def ignorable(k):
+        return k.endswith("rotary_emb.inv_freq") or (
+            cfg.tie_embeddings and k == "lm_head.weight"
+        )
+
+    leftover = sorted(
+        k for k in sd if k not in consumed and not ignorable(k)
+    )
+    if leftover:
+        raise ValueError(
+            f"{len(leftover)} state_dict tensors were not consumed by the "
+            f"Llama layout (first few: {leftover[:4]}); this checkpoint "
+            "has weights (e.g. biases) the conversion does not map"
+        )
+    return params
+
+
+def from_hf_llama(
+    hf_model, dtype=jnp.float32, **config_overrides
+) -> Tuple[Transformer, Any]:
+    """(Transformer, params) from a transformers Llama(-ForCausalLM) model.
+
+    ``hf_model`` may be any module exposing ``.config`` and
+    ``.state_dict()`` with Llama weight names (LlamaForCausalLM,
+    MistralForCausalLM, and friends with the same layout).
+    """
+    cfg = config_from_hf_llama(hf_model.config, **config_overrides)
+    params = params_from_hf_llama(hf_model.state_dict(), cfg, dtype)
+    return Transformer(cfg), params

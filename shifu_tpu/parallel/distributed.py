@@ -121,17 +121,12 @@ class HybridMeshPlan:
             dev_array = mesh_utils.create_hybrid_device_mesh(
                 self.ici.shape, self.dcn.shape, devices=devices
             )
-        elif len(devices) > 1 and devices[0].platform == "tpu":
-            # Single slice: the DCN tier is vacuous, but keep the
-            # topology-aware ICI ordering (same as MeshPlan.build) so tp
-            # groups land on torus neighbours.
-            from jax.experimental import mesh_utils
-
-            dev_array = mesh_utils.create_device_mesh(
-                self.shape, devices=devices
-            )
         else:
-            dev_array = np.asarray(devices).reshape(self.shape)
+            # Single slice: the DCN tier is vacuous; shared helper keeps
+            # the topology-aware ICI ordering (tp on torus neighbours).
+            from shifu_tpu.parallel.mesh import device_array
+
+            dev_array = device_array(self.shape, devices)
         return Mesh(dev_array, MESH_AXES)
 
 
@@ -163,19 +158,26 @@ def shard_host_batch(
         if has_batch_axis:  # leaves without a batch axis stay replicated
             global_shape[axis] *= jax.process_count()
         spec = shd.spec_for(tuple(global_shape), logical, mesh, rules)
-        if (
-            jax.process_count() > 1
-            and has_batch_axis
-            and (len(spec) <= axis or spec[axis] is None)
-        ):
-            # The divisibility rail replicated the batch axis, but each
-            # process holds only ITS rows — a "replicated" global array
-            # cannot be assembled from per-process locals. Fail loudly.
-            raise ValueError(
-                f"global batch {global_shape[axis]} is not divisible by "
-                f"the mesh's data axes; per-process assembly requires a "
-                f"sharded batch axis (pad the batch or resize the mesh)"
+        if jax.process_count() > 1 and has_batch_axis:
+            # Per-process assembly needs the batch axis sharded into (a
+            # multiple of) process_count pieces; a replicated or
+            # under-sharded batch axis (pure tp/pp meshes, or the
+            # divisibility rail falling back) cannot be built from local
+            # rows — fail loudly before make_array_from_process_local_data
+            # produces its opaque shape-mismatch error.
+            entry = spec[axis] if len(spec) > axis else None
+            names = (
+                (entry,) if isinstance(entry, str) else tuple(entry or ())
             )
+            extent = int(np.prod([mesh.shape[n] for n in names])) if names else 1
+            if extent % jax.process_count() != 0:
+                raise ValueError(
+                    f"batch axis shards over {extent} devices, which is "
+                    f"not a multiple of process_count="
+                    f"{jax.process_count()}; per-process assembly needs "
+                    "the batch axis sharded across all hosts (resize the "
+                    "mesh's data axes)"
+                )
         return jax.make_array_from_process_local_data(
             NamedSharding(mesh, spec), x, tuple(global_shape)
         )

@@ -31,6 +31,17 @@ from jax.sharding import Mesh
 MESH_AXES = ("dp", "fsdp", "ep", "pp", "sp", "tp")
 
 
+def device_array(shape, devices) -> np.ndarray:
+    """Devices arranged for a mesh of ``shape``: topology-aware on real
+    multi-chip TPU (ICI-neighbour placement via create_device_mesh), plain
+    reshape elsewhere. Shared by MeshPlan and HybridMeshPlan builds."""
+    if len(devices) > 1 and devices[0].platform == "tpu":
+        from jax.experimental import mesh_utils
+
+        return mesh_utils.create_device_mesh(shape, devices=devices)
+    return np.asarray(devices).reshape(shape)
+
+
 @dataclasses.dataclass(frozen=True)
 class MeshPlan:
     dp: int = 1
@@ -56,15 +67,7 @@ class MeshPlan:
                 f"MeshPlan {self.shape} needs {self.n_devices} devices, "
                 f"got {len(devices)}"
             )
-        if len(devices) > 1 and devices[0].platform == "tpu":
-            from jax.experimental import mesh_utils
-
-            dev_array = mesh_utils.create_device_mesh(
-                self.shape, devices=devices
-            )
-        else:
-            dev_array = np.asarray(devices).reshape(self.shape)
-        return Mesh(dev_array, MESH_AXES)
+        return Mesh(device_array(self.shape, devices), MESH_AXES)
 
     @classmethod
     def single_device(cls) -> "MeshPlan":
