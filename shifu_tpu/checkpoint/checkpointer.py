@@ -132,6 +132,35 @@ class Checkpointer:
         )
         return out["state"], out["host"]
 
+    def restore_params(self, model, step: Optional[int] = None, *,
+                       mesh=None, rules=shd.DEFAULT_RULES):
+        """Restore ONLY the params subtree (partial read).
+
+        For eval/serving: reads ~1/3 of an AdamW checkpoint's bytes (no
+        optimizer moments) and needs no knowledge of which optimizer
+        trained it. ``model`` provides the params template via its specs.
+
+        Call on a FRESH Checkpointer: orbax pins one restore-handler type
+        per item per manager, so mixing with save()/restore() on the same
+        instance raises a handler-registry error.
+        """
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise FileNotFoundError(
+                    f"no checkpoint found in {self._mgr.directory}"
+                )
+        template = {"params": shd.abstract_params(model, mesh, rules)}
+        out = self._mgr.restore(
+            int(step),
+            args=ocp.args.Composite(
+                state=ocp.args.PyTreeRestore(
+                    item=template, partial_restore=True
+                ),
+            ),
+        )
+        return out["state"]["params"]
+
     # ------------------------------------------------------------- inventory
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()

@@ -1,7 +1,9 @@
 """Command-line entry points: ``python -m shifu_tpu <cmd>``.
 
-    train   run the Trainer loop (real corpus dir or --synthetic)
-    info    devices, native-extension status, version
+    train     run the Trainer loop (real corpus dir or --synthetic)
+    eval      perplexity over a dataset (params-only checkpoint read)
+    generate  byte-tokenizer text completion from a checkpoint
+    info      devices, native-extension status, version
 
 The CLI builds everything from flags — model preset (optionally MoE),
 optimizer + schedule, mesh plan — and is the reference example of wiring
@@ -129,6 +131,85 @@ def cmd_train(args) -> int:
     return 0
 
 
+def _restore_params(args, model):
+    """Latest checkpoint's params (params-only partial read — works for
+    any training optimizer); fresh init when no --ckpt-dir is given."""
+    import jax
+
+    if not args.ckpt_dir:
+        return model.init(jax.random.key(args.seed))
+    from shifu_tpu.checkpoint import Checkpointer
+
+    ckpt = Checkpointer(args.ckpt_dir)
+    try:
+        return ckpt.restore_params(model)
+    finally:
+        ckpt.close()
+
+
+def cmd_eval(args) -> int:
+    from shifu_tpu.data import PackedLoader, TokenDataset
+    from shifu_tpu.train.loop import evaluate
+
+    model = _build_model(args)
+    if not args.ckpt_dir:
+        print(
+            "warning: no --ckpt-dir; evaluating RANDOMLY INITIALIZED "
+            "weights (smoke-test mode)",
+            file=sys.stderr,
+        )
+    params = _restore_params(args, model)
+    loader = PackedLoader(
+        TokenDataset(args.data),
+        batch_size=args.batch_size,
+        seq_len=args.seq_len,
+        shuffle=False,
+    )
+    out = evaluate(model, params, loader, max_batches=args.batches)
+    print(json.dumps(out))
+    return 0
+
+
+def cmd_generate(args) -> int:
+    import jax
+    import jax.numpy as jnp
+
+    from shifu_tpu.data.tokenizer import ByteTokenizer
+    from shifu_tpu.infer import SampleConfig, make_generate_fn
+
+    model = _build_model(args)
+    params = _restore_params(args, model)
+    tok = ByteTokenizer()
+    if tok.vocab_size > model.cfg.vocab_size:
+        print(
+            f"warning: byte vocab {tok.vocab_size} exceeds model vocab "
+            f"{model.cfg.vocab_size}; ids are clipped",
+            file=sys.stderr,
+        )
+    ids = [min(i, model.cfg.vocab_size - 1) for i in tok.encode(args.prompt)]
+    if not ids:
+        print("--prompt must be non-empty", file=sys.stderr)
+        return 2
+    prompts = jnp.asarray([ids], jnp.int32)
+    fn = make_generate_fn(
+        model,
+        max_new_tokens=args.max_new_tokens,
+        sample_cfg=SampleConfig(
+            temperature=args.temperature, top_p=args.top_p
+        ),
+        eos_id=tok.eos_id,
+    )
+    out = fn(
+        params,
+        prompts,
+        jnp.asarray([len(ids)], jnp.int32),
+        jax.random.key(args.seed),
+    )
+    text = tok.decode([int(t) for t in out["tokens"][0]])
+    print(json.dumps({"prompt": args.prompt, "completion": text}))
+    return 0
+
+
 def cmd_info(args) -> int:
     import jax
 
@@ -149,37 +230,57 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="shifu_tpu")
     sub = p.add_subparsers(dest="cmd", required=True)
 
+    def model_flags(sp, *, schedule_default):
+        sp.add_argument("--family", default="transformer",
+                        choices=["transformer", "mamba"])
+        sp.add_argument("--preset", default="tiny",
+                        choices=["tiny", "small", "1b", "7b"])
+        sp.add_argument("--moe-experts", type=int, default=0)
+        sp.add_argument("--attn", choices=["xla", "flash", "ring"],
+                        default=None)
+        sp.add_argument("--optimizer", default="adamw",
+                        choices=["adamw", "lion", "adafactor", "sgd"])
+        sp.add_argument("--schedule", default=schedule_default,
+                        choices=["constant", "cosine", "linear", "wsd",
+                                 "inverse_sqrt"])
+        sp.add_argument("--lr", type=float, default=3e-4)
+        sp.add_argument("--warmup", type=int, default=0)
+        sp.add_argument("--ckpt-dir")
+        sp.add_argument("--seed", type=int, default=0)
+
     t = sub.add_parser("train", help="run the training loop")
+    model_flags(t, schedule_default="cosine")
     t.add_argument("--data", help="dataset dir (write_shards layout)")
     t.add_argument(
         "--synthetic",
         action="store_true",
         help="random-token data (the default when --data is omitted)",
     )
-    t.add_argument("--family", default="transformer",
-                   choices=["transformer", "mamba"])
-    t.add_argument("--preset", default="tiny",
-                   choices=["tiny", "small", "1b", "7b"])
-    t.add_argument("--moe-experts", type=int, default=0)
-    t.add_argument("--attn", choices=["xla", "flash", "ring"], default=None)
     t.add_argument("--steps", type=int, default=100)
     t.add_argument("--batch-size", type=int, default=8)
     t.add_argument("--seq-len", type=int, default=513)
     t.add_argument("--microbatches", type=int, default=None)
-    t.add_argument("--optimizer", default="adamw",
-                   choices=["adamw", "lion", "adafactor", "sgd"])
-    t.add_argument("--schedule", default="cosine",
-                   choices=["constant", "cosine", "linear", "wsd",
-                            "inverse_sqrt"])
-    t.add_argument("--lr", type=float, default=3e-4)
-    t.add_argument("--warmup", type=int, default=0)
     t.add_argument("--mesh", help="e.g. fsdp=4,tp=2 (axes of MeshPlan)")
-    t.add_argument("--ckpt-dir")
     t.add_argument("--ckpt-every", type=int, default=1000)
     t.add_argument("--metrics", help="JSONL metrics path")
     t.add_argument("--log-every", type=int, default=10)
-    t.add_argument("--seed", type=int, default=0)
     t.set_defaults(fn=cmd_train)
+
+    e = sub.add_parser("eval", help="perplexity over a dataset")
+    model_flags(e, schedule_default="constant")
+    e.add_argument("--data", required=True)
+    e.add_argument("--batch-size", type=int, default=8)
+    e.add_argument("--seq-len", type=int, default=513)
+    e.add_argument("--batches", type=int, default=32)
+    e.set_defaults(fn=cmd_eval)
+
+    g = sub.add_parser("generate", help="byte-tokenizer text completion")
+    model_flags(g, schedule_default="constant")
+    g.add_argument("--prompt", required=True)
+    g.add_argument("--max-new-tokens", type=int, default=128)
+    g.add_argument("--temperature", type=float, default=0.8)
+    g.add_argument("--top-p", type=float, default=0.95)
+    g.set_defaults(fn=cmd_generate)
 
     i = sub.add_parser("info", help="environment / device info")
     i.set_defaults(fn=cmd_info)

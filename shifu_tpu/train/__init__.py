@@ -12,6 +12,7 @@ from shifu_tpu.train.optimizer import (
 )
 from shifu_tpu.train.loop import Trainer, TrainLoopConfig, evaluate
 from shifu_tpu.train.lora import LoraConfig, LoraModel, merge_lora
+from shifu_tpu.train.ema import WithEMA, ema_params
 from shifu_tpu.train.step import (
     TrainState,
     create_sharded_state,
@@ -30,6 +31,8 @@ __all__ = [
     "linear",
     "warmup_cosine",
     "wsd",
+    "WithEMA",
+    "ema_params",
     "LoraConfig",
     "LoraModel",
     "merge_lora",

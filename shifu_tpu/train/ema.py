@@ -1,0 +1,77 @@
+"""Exponential moving average of parameters, as an optimizer combinator.
+
+``WithEMA(inner, decay)`` wraps any optimizer: the EMA rides the optimizer
+state (sharded like the params, checkpointed with everything else, updated
+inside the same jitted train step) and :func:`ema_params` extracts the
+averaged weights for eval/serving — the standard "eval the EMA, train the
+raw" recipe.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class WithEMA:
+    inner: Any
+    decay: float = 0.999
+
+    def init(self, params):
+        st = self.inner.init(params)
+        # copy=True: astype on an already-f32 leaf would ALIAS the live
+        # param buffer, and donating state.params + state.opt["ema"]
+        # together would then donate the same buffer twice.
+        ema = jax.tree_util.tree_map(
+            lambda p: jnp.array(p, jnp.float32, copy=True), params
+        )
+        # The top-level mirror of the step counter must be its OWN buffer:
+        # aliasing inner's array would donate the same buffer twice.
+        return {"inner": st, "ema": ema, "step": jnp.zeros((), jnp.int32)}
+
+    def state_template(self, params_tmpl, scalar):
+        from shifu_tpu.train.optimizer import _f32_like
+
+        return {
+            "inner": self.inner.state_template(params_tmpl, scalar),
+            "ema": jax.tree_util.tree_map(_f32_like, params_tmpl),
+            "step": jax.ShapeDtypeStruct(
+                (), jnp.int32, sharding=getattr(scalar, "sharding", None)
+            ),
+        }
+
+    def update(self, grads, state, params, decay_mask=None):
+        new_params, inner_state, stats = self.inner.update(
+            grads, state["inner"], params, decay_mask=decay_mask
+        )
+        d = self.decay
+        ema = jax.tree_util.tree_map(
+            lambda e, p: d * e + (1 - d) * p.astype(jnp.float32),
+            state["ema"],
+            new_params,
+        )
+        new_state = {
+            "inner": inner_state,
+            "ema": ema,
+            "step": inner_state["step"] + 0,  # copy: no buffer aliasing
+        }
+        return new_params, new_state, stats
+
+
+def ema_params(state, like=None):
+    """The averaged weights from a TrainState (or raw opt-state dict).
+
+    ``like``: optional params tree whose leaf dtypes the result is cast to
+    (e.g. the live params, so the EMA drops into the same forward).
+    """
+    opt = getattr(state, "opt", state)
+    ema = opt["ema"]
+    if like is None:
+        return ema
+    return jax.tree_util.tree_map(
+        lambda e, p: e.astype(p.dtype), ema, like
+    )
