@@ -8,6 +8,7 @@ is the standard prefill + KV-cache decode design, TPU-first (static shapes,
 
 from shifu_tpu.infer.sampling import SampleConfig, sample_logits
 from shifu_tpu.infer.generate import generate, make_generate_fn
+from shifu_tpu.infer.engine import Completion, Engine
 from shifu_tpu.infer.quant import (
     QuantizedModel,
     dequantize_params,
@@ -20,6 +21,8 @@ __all__ = [
     "sample_logits",
     "generate",
     "make_generate_fn",
+    "Completion",
+    "Engine",
     "QuantizedModel",
     "dequantize_params",
     "param_nbytes",

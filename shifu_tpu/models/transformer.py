@@ -244,14 +244,36 @@ class Transformer(Module):
             )
             new_cache = None
         else:
-            ck = jax.lax.dynamic_update_slice(
-                cache_slice["k"], k.astype(cache_slice["k"].dtype),
-                (0, cache_index, 0, 0),
-            )
-            cv = jax.lax.dynamic_update_slice(
-                cache_slice["v"], v.astype(cache_slice["v"].dtype),
-                (0, cache_index, 0, 0),
-            )
+            if getattr(cache_index, "ndim", 0) == 1:
+                # Per-row write offsets (continuous batching: every slot
+                # decodes at its own length). Single-token steps only —
+                # a longer chunk would silently write just token 0.
+                if k.shape[1] != 1:
+                    raise ValueError(
+                        f"per-row cache_index supports single-token decode "
+                        f"only, got q_len={k.shape[1]}"
+                    )
+                b = k.shape[0]
+                rows = jnp.arange(b)
+                ck = (
+                    cache_slice["k"]
+                    .at[rows, cache_index]
+                    .set(k[:, 0].astype(cache_slice["k"].dtype))
+                )
+                cv = (
+                    cache_slice["v"]
+                    .at[rows, cache_index]
+                    .set(v[:, 0].astype(cache_slice["v"].dtype))
+                )
+            else:
+                ck = jax.lax.dynamic_update_slice(
+                    cache_slice["k"], k.astype(cache_slice["k"].dtype),
+                    (0, cache_index, 0, 0),
+                )
+                cv = jax.lax.dynamic_update_slice(
+                    cache_slice["v"], v.astype(cache_slice["v"].dtype),
+                    (0, cache_index, 0, 0),
+                )
             if (
                 q.shape[1] > 1
                 and kv_mask is None
@@ -395,7 +417,10 @@ class Transformer(Module):
         if positions is None:
             positions = jnp.arange(s)
             if cache_index is not None:
-                positions = positions + cache_index
+                if getattr(cache_index, "ndim", 0) == 1:
+                    positions = positions[None, :] + cache_index[:, None]
+                else:
+                    positions = positions + cache_index
         sin, cos = rope_frequencies(
             cfg.resolved_head_dim, positions, theta=cfg.rope_theta
         )
@@ -551,8 +576,10 @@ def _decode_attention(q, ck, cv, cache_index, impl, kv_mask=None):
     """Attention over a preallocated cache: valid keys are [0, index + q_len).
 
     Queries sit at cache slots index .. index + q_len - 1 (slot-space
-    causality). ``kv_mask`` (batch, s_max) additionally hides slots that
-    hold no real token (right-padding of ragged prompts).
+    causality). ``cache_index`` may be a scalar (whole batch at one
+    offset) or a (batch,) vector (continuous batching: per-slot offsets).
+    ``kv_mask`` (batch, s_max) additionally hides slots that hold no real
+    token (right-padding of ragged prompts).
     """
     del impl  # decode is tiny; XLA path is optimal (no S×S materialisation)
     b, q_len, n_heads, head_dim = q.shape
@@ -562,14 +589,16 @@ def _decode_attention(q, ck, cv, cache_index, impl, kv_mask=None):
     scores = jnp.einsum(
         "bqhgd,bkhd->bhgqk", qg, ck, preferred_element_type=jnp.float32
     ) * (head_dim**-0.5)
-    qi = cache_index + jnp.arange(q_len)[:, None]
-    kj = jnp.arange(s_max)[None, :]
-    valid = kj <= qi  # (q_len, s_max)
-    if kv_mask is not None:
-        valid = valid[None] & kv_mask[:, None, :]  # (b, q_len, s_max)
-        mask = jnp.where(valid, 0.0, NEG_INF)[:, None, None, :, :]
+    kj = jnp.arange(s_max)
+    if getattr(cache_index, "ndim", 0) == 1:
+        qi = cache_index[:, None] + jnp.arange(q_len)[None, :]  # (b, q)
+        valid = kj[None, None, :] <= qi[:, :, None]  # (b, q, s)
     else:
-        mask = jnp.where(valid, 0.0, NEG_INF)
+        qi = cache_index + jnp.arange(q_len)[:, None]  # (q, 1)
+        valid = (kj[None, :] <= qi)[None]  # (1, q, s)
+    if kv_mask is not None:
+        valid = valid & kv_mask[:, None, :]  # (b, q, s)
+    mask = jnp.where(valid, 0.0, NEG_INF)[:, None, None, :, :]
     scores = scores + mask
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     # Cast back to q.dtype: the cache may be wider (e.g. f32 cache under a
