@@ -56,6 +56,14 @@ def config_from_hf_llama(hf_config, **overrides) -> TransformerConfig:
         rope_theta=getattr(hf_config, "rope_theta", 10_000.0),
         norm_eps=hf_config.rms_norm_eps,
         tie_embeddings=getattr(hf_config, "tie_word_embeddings", False),
+        # Qwen2-style configs carry sliding_window but gate it off with
+        # use_sliding_window=False — honoring the value unconditionally
+        # would silently diverge from the HF forward at long context.
+        window_size=(
+            getattr(hf_config, "sliding_window", None)
+            if getattr(hf_config, "use_sliding_window", True)
+            else None
+        ),
     )
     kw.update(overrides)
     return TransformerConfig(**kw)

@@ -70,6 +70,9 @@ class TransformerConfig:
     # "xla" | "flash" (pallas TPU kernel) | "ring" (sp sequence
     # parallelism; falls back to xla off-mesh — ops.attention docstring)
     attn_impl: str = "xla"
+    # Sliding-window attention (Mistral-style): each query sees at most
+    # the last window_size positions. None = full causal attention.
+    window_size: Optional[int] = None
 
     @property
     def resolved_head_dim(self) -> int:
@@ -89,6 +92,14 @@ class TransformerConfig:
             raise ValueError(
                 f"remat_policy={self.remat_policy!r} (want 'dots' or 'full')"
             )
+        if self.window_size is not None:
+            if self.window_size < 1:
+                raise ValueError(f"window_size={self.window_size} must be >= 1")
+            if self.attn_impl != "xla":
+                raise ValueError(
+                    "window_size requires attn_impl='xla' (the flash/ring "
+                    "paths do not implement sliding windows yet)"
+                )
 
     # -- presets --------------------------------------------------------------
     @classmethod
@@ -240,7 +251,7 @@ class Transformer(Module):
         if cache_slice is None:
             attn = dot_product_attention(
                 q, k, v, causal=True, segment_ids=segment_ids,
-                impl=cfg.attn_impl,
+                impl=cfg.attn_impl, window=cfg.window_size,
             )
             new_cache = None
         else:
@@ -289,7 +300,8 @@ class Transformer(Module):
                 # with a mask (left-padding/holes) fall through to the
                 # masked cache path below.
                 attn = dot_product_attention(
-                    q, k, v, causal=True, impl=cfg.attn_impl
+                    q, k, v, causal=True, impl=cfg.attn_impl,
+                    window=cfg.window_size,
                 )
             else:
                 # Single-token decode (or chunked prefill at a traced
@@ -298,7 +310,8 @@ class Transformer(Module):
                 # used because the cache is longer than (index + q_len), so
                 # the mask is built in slot space with a query offset.
                 attn = _decode_attention(
-                    q, ck, cv, cache_index, cfg.attn_impl, kv_mask=kv_mask
+                    q, ck, cv, cache_index, cfg.attn_impl, kv_mask=kv_mask,
+                    window=cfg.window_size,
                 )
             new_cache = {"k": ck, "v": cv}
 
@@ -572,7 +585,8 @@ class Transformer(Module):
         return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 
-def _decode_attention(q, ck, cv, cache_index, impl, kv_mask=None):
+def _decode_attention(q, ck, cv, cache_index, impl, kv_mask=None,
+                      window=None):
     """Attention over a preallocated cache: valid keys are [0, index + q_len).
 
     Queries sit at cache slots index .. index + q_len - 1 (slot-space
@@ -593,9 +607,13 @@ def _decode_attention(q, ck, cv, cache_index, impl, kv_mask=None):
     if getattr(cache_index, "ndim", 0) == 1:
         qi = cache_index[:, None] + jnp.arange(q_len)[None, :]  # (b, q)
         valid = kj[None, None, :] <= qi[:, :, None]  # (b, q, s)
+        if window is not None:
+            valid = valid & (kj[None, None, :] > qi[:, :, None] - window)
     else:
         qi = cache_index + jnp.arange(q_len)[:, None]  # (q, 1)
         valid = (kj[None, :] <= qi)[None]  # (1, q, s)
+        if window is not None:
+            valid = valid & (kj[None, :] > qi - window)[None]
     if kv_mask is not None:
         valid = valid & kv_mask[:, None, :]  # (b, q, s)
     mask = jnp.where(valid, 0.0, NEG_INF)[:, None, None, :, :]

@@ -17,16 +17,21 @@ import jax.numpy as jnp
 NEG_INF = -2.0e38  # large finite negative; avoids NaN from (-inf) - (-inf)
 
 
-def _causal_mask(q_len: int, kv_len: int, dtype=jnp.float32):
+def _causal_mask(q_len: int, kv_len: int, dtype=jnp.float32,
+                 window: Optional[int] = None):
     """(q_len, kv_len) additive mask; query i attends kv j <= i + offset.
 
     When q_len < kv_len (decode with a KV cache), queries are aligned to the
-    *end* of the KV axis.
+    *end* of the KV axis. ``window``: sliding-window attention — query i
+    additionally sees only the last ``window`` positions (itself included).
     """
     offset = kv_len - q_len
     qi = jnp.arange(q_len)[:, None]
     kj = jnp.arange(kv_len)[None, :]
-    return jnp.where(kj <= qi + offset, 0.0, NEG_INF).astype(dtype)
+    ok = kj <= qi + offset
+    if window is not None:
+        ok = ok & (kj > qi + offset - window)
+    return jnp.where(ok, 0.0, NEG_INF).astype(dtype)
 
 
 def dot_product_attention(
@@ -38,6 +43,7 @@ def dot_product_attention(
     scale: Optional[float] = None,
     segment_ids: Optional[jax.Array] = None,
     impl: str = "xla",
+    window: Optional[int] = None,
 ):
     """Grouped-query attention.
 
@@ -54,10 +60,23 @@ def dot_product_attention(
         activation_sharding context with sp > 1 and mesh-divisible
         shapes — see parallel.ring.ring_shardable — else it silently
         falls back to the O(S^2)-memory XLA path).
+      window: sliding-window attention — query i sees only keys in
+        (i - window, i], i.e. the last ``window`` positions INCLUDING
+        itself. Requires ``causal=True`` and ``impl="xla"`` (flash/ring
+        raise rather than silently attending outside the window).
 
     Returns:
       (batch, q_len, num_heads, head_dim) in q.dtype.
     """
+    if window is not None and not causal:
+        raise ValueError("window requires causal attention")
+    if window is not None and impl in ("flash", "ring"):
+        # The pallas/ring paths do not implement block skipping for
+        # windows yet; refusing beats silently attending outside it.
+        raise ValueError(
+            f"impl={impl!r} does not support sliding windows yet; use "
+            "impl='xla'"
+        )
     if impl == "flash":
         from shifu_tpu.ops.pallas.flash_attention import flash_attention
 
@@ -101,7 +120,7 @@ def dot_product_attention(
     scores = scores * scale
 
     if causal:
-        scores = scores + _causal_mask(q_len, kv_len)
+        scores = scores + _causal_mask(q_len, kv_len, window=window)
     if segment_ids is not None:
         if q_len != kv_len:
             raise ValueError("segment_ids requires q_len == kv_len")

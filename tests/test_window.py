@@ -1,0 +1,147 @@
+"""Sliding-window attention: op masks, receptive field, decode parity."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from shifu_tpu.models import Transformer, TransformerConfig
+from shifu_tpu.ops import dot_product_attention
+
+
+def test_window_ge_seq_equals_full():
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(2, 8, 4, 16), jnp.float32)
+    k = jnp.asarray(rng.randn(2, 8, 2, 16), jnp.float32)
+    v = jnp.asarray(rng.randn(2, 8, 2, 16), jnp.float32)
+    full = dot_product_attention(q, k, v, causal=True)
+    windowed = dot_product_attention(q, k, v, causal=True, window=8)
+    np.testing.assert_allclose(
+        np.asarray(full), np.asarray(windowed), rtol=1e-6
+    )
+
+
+def test_window_matches_numpy_reference():
+    rng = np.random.RandomState(1)
+    b, s, h, d, w = 1, 7, 2, 8, 3
+    q = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+    k = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+    v = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+    out = np.asarray(
+        dot_product_attention(q, k, v, causal=True, window=w)
+    )
+    qn, kn, vn = (np.asarray(x) for x in (q, k, v))
+    for i in range(s):
+        lo = max(0, i - w + 1)
+        for head in range(h):
+            scores = qn[0, i, head] @ kn[0, lo : i + 1, head].T * d**-0.5
+            p = np.exp(scores - scores.max())
+            p /= p.sum()
+            want = p @ vn[0, lo : i + 1, head]
+            np.testing.assert_allclose(
+                out[0, i, head], want, rtol=1e-5, atol=1e-6
+            )
+
+
+def test_window_rejected_on_flash_and_ring():
+    q = jnp.zeros((1, 8, 2, 8))
+    with pytest.raises(ValueError, match="does not support sliding"):
+        dot_product_attention(q, q, q, impl="flash", window=4)
+    with pytest.raises(ValueError, match="does not support sliding"):
+        dot_product_attention(q, q, q, impl="ring", window=4)
+
+
+def test_config_validation():
+    with pytest.raises(ValueError, match="window_size"):
+        TransformerConfig.tiny(window_size=0)
+    with pytest.raises(ValueError, match="attn_impl"):
+        TransformerConfig.tiny(window_size=4, attn_impl="flash")
+
+
+def test_receptive_field_bounded():
+    # L=2 layers, window=3: position i's receptive field reaches back
+    # L*(w-1)=4 positions; changing token 0 must not move logits at i>=5,
+    # while the full-attention model does move them.
+    cfg_w = TransformerConfig.tiny(window_size=3)
+    cfg_f = TransformerConfig.tiny()
+    rng = np.random.RandomState(2)
+    t1 = jnp.asarray(rng.randint(0, 256, (1, 12)), jnp.int32)
+    t2 = t1.at[0, 0].set((int(t1[0, 0]) + 1) % 256)
+
+    mw = Transformer(cfg_w)
+    params = mw.init(jax.random.key(0))
+    lw1, lw2 = mw(params, t1), mw(params, t2)
+    np.testing.assert_allclose(
+        np.asarray(lw1[:, 5:]), np.asarray(lw2[:, 5:]), rtol=2e-4, atol=1e-5
+    )
+
+    mf = Transformer(cfg_f)
+    lf1, lf2 = mf(params, t1), mf(params, t2)
+    assert np.abs(np.asarray(lf1[:, 5:]) - np.asarray(lf2[:, 5:])).max() > 1e-3
+
+
+def test_windowed_decode_matches_full_forward():
+    cfg = TransformerConfig.tiny(window_size=4)
+    model = Transformer(cfg)
+    params = model.init(jax.random.key(0))
+    tokens = jnp.asarray(
+        np.random.RandomState(3).randint(0, 256, (2, 10)), jnp.int32
+    )
+    full = model(params, tokens)
+    cache = model.init_cache(2, 16)
+    logits, cache = model(params, tokens[:, :6], cache=cache, cache_index=0)
+    np.testing.assert_allclose(logits, full[:, :6], rtol=3e-2, atol=3e-3)
+    for i in range(6, 10):
+        logits, cache = model(
+            params, tokens[:, i : i + 1], cache=cache, cache_index=jnp.int32(i)
+        )
+        np.testing.assert_allclose(
+            logits[:, 0], full[:, i], rtol=3e-2, atol=3e-3,
+            err_msg=f"decode step {i}",
+        )
+
+
+def test_windowed_engine_generation():
+    from shifu_tpu.infer import Engine, SampleConfig
+
+    cfg = TransformerConfig.tiny(window_size=4)
+    model = Transformer(cfg)
+    params = model.init(jax.random.key(0))
+    eng = Engine(
+        model, params, max_slots=2, max_len=32,
+        sample_cfg=SampleConfig(temperature=0.0), prefill_buckets=(8,),
+    )
+    rng = np.random.RandomState(4)
+    rids = [
+        eng.submit(rng.randint(1, 256, size=n).tolist(), max_new_tokens=4)
+        for n in (3, 6)
+    ]
+    done = eng.run()
+    assert sorted(c.rid for c in done) == sorted(rids)
+
+
+def test_mistral_conversion_parity():
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    from transformers import MistralConfig, MistralForCausalLM
+
+    from shifu_tpu.core.dtypes import FULL_F32
+    from shifu_tpu.models import from_hf_llama
+
+    torch.manual_seed(0)
+    hf = MistralForCausalLM(
+        MistralConfig(
+            vocab_size=128, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, sliding_window=5,
+            attn_implementation="eager",
+        )
+    ).eval()
+    model, params = from_hf_llama(hf)
+    assert model.cfg.window_size == 5
+    model = Transformer(model.cfg, policy=FULL_F32)
+    tokens = np.random.RandomState(5).randint(0, 128, (1, 12))
+    with torch.no_grad():
+        want = hf(torch.tensor(tokens)).logits.float().numpy()
+    got = np.asarray(model(params, jnp.asarray(tokens, jnp.int32)))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
