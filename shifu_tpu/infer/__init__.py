@@ -9,6 +9,11 @@ is the standard prefill + KV-cache decode design, TPU-first (static shapes,
 from shifu_tpu.infer.sampling import SampleConfig, sample_logits
 from shifu_tpu.infer.generate import generate, make_generate_fn
 from shifu_tpu.infer.engine import Completion, Engine
+from shifu_tpu.infer.speculative import (
+    SpecResult,
+    make_speculative_fns,
+    speculative_generate,
+)
 from shifu_tpu.infer.quant import (
     QuantizedModel,
     dequantize_params,
@@ -22,6 +27,9 @@ __all__ = [
     "generate",
     "make_generate_fn",
     "Completion",
+    "SpecResult",
+    "make_speculative_fns",
+    "speculative_generate",
     "Engine",
     "QuantizedModel",
     "dequantize_params",

@@ -67,13 +67,26 @@ def _apply_top_p(logits, p: float):
     return jnp.where(logits >= threshold, logits, NEG_INF)
 
 
-def sample_logits(logits, rng, cfg: SampleConfig = SampleConfig()):
-    """Sample token ids from (..., vocab) logits. Returns (...,) int32."""
-    if cfg.temperature == 0.0:
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+def filtered_logits(logits, cfg: SampleConfig):
+    """Temperature + top-k + top-p filtered logits (cfg.temperature > 0).
+
+    The single filtering implementation behind both :func:`sample_logits`
+    and the speculative-decoding probability computation — the two must
+    describe the same distribution or verification would be against a
+    different sampler than the one configured.
+    """
     logits = logits.astype(jnp.float32) / cfg.temperature
     if cfg.top_k is not None and cfg.top_k < logits.shape[-1]:
         logits = _apply_top_k(logits, cfg.top_k)
     if cfg.top_p is not None and cfg.top_p < 1.0:
         logits = _apply_top_p(logits, cfg.top_p)
-    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+    return logits
+
+
+def sample_logits(logits, rng, cfg: SampleConfig = SampleConfig()):
+    """Sample token ids from (..., vocab) logits. Returns (...,) int32."""
+    if cfg.temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return jax.random.categorical(
+        rng, filtered_logits(logits, cfg), axis=-1
+    ).astype(jnp.int32)

@@ -1,0 +1,266 @@
+"""Speculative decoding: a draft model proposes, the target verifies.
+
+One round:
+
+  1. the draft runs K cheap autoregressive steps from the current token,
+     yielding proposals d_1..d_K and their proposal probabilities;
+  2. the target scores the whole chunk [cur, d_1..d_K] in ONE forward
+     (chunked decode at a traced cache offset — K+1 positions for the
+     price of one memory-bound pass over the weights);
+  3. proposals are accepted left-to-right by the standard rejection rule
+     (accept d with prob min(1, p_target/p_draft); on the first rejection
+     sample from the residual max(p_t - p_d, 0)); with temperature 0 this
+     degrades to exact greedy token matching. The round always nets at
+     least one token (the "bonus" sample from the target).
+
+The output distribution equals sampling the target alone (Leviathan et
+al. / Chen et al.); with greedy sampling the output SEQUENCE is exactly
+the target's — tested against the plain generator.
+
+No cache rollback exists or is needed: both caches track a valid-length
+watermark; rejected slots hold stale K/V that slot-space causality masks
+and the next round's chunk overwrites.
+
+Single-sequence (batch 1): per-row acceptance lengths would need ragged
+chunk writes. Serve batches with infer.engine instead; speculation is a
+latency tool.
+
+Reference parity note: the upstream reference (klyan/shifu) is an empty
+repository (SURVEY.md); there is no reference implementation to match.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools as _functools
+from typing import List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from shifu_tpu.infer.sampling import SampleConfig, filtered_logits
+
+
+def _probs(logits, cfg: SampleConfig):
+    """The EXACT distribution sample_logits draws from (f32, (..., V)):
+    temperature 0 -> one-hot argmax; otherwise softmax of the
+    temperature/top-k/top-p filtered logits."""
+    logits = logits.astype(jnp.float32)
+    if cfg.temperature == 0.0:
+        return jax.nn.one_hot(
+            jnp.argmax(logits, axis=-1), logits.shape[-1], dtype=jnp.float32
+        )
+    return jax.nn.softmax(filtered_logits(logits, cfg), axis=-1)
+
+
+@dataclasses.dataclass(frozen=True)
+class SpecResult:
+    tokens: List[int]  # generated ids (eos included when hit)
+    acceptance_rate: float  # accepted draft tokens / proposed
+    rounds: int
+
+
+@_functools.lru_cache(maxsize=8)
+def make_speculative_fns(target, draft, k: int, sample_cfg: SampleConfig):
+    """The five jitted programs, cached per (target, draft, k, cfg) so
+    repeated speculative_generate calls reuse compiled executables.
+
+    Returns ((target_prefill, draft_prefill), (draft_k, draft_ingest),
+    verify). Models must be hashable (the frozen-dataclass module
+    convention); unhashable models fall back to uncached construction in
+    speculative_generate.
+    """
+
+    def prefill(params, model, cache, tokens, length):
+        logits, cache = model(
+            params, tokens, cache=cache, cache_index=0,
+            logits_at=(length - 1)[None],
+        )
+        return logits[:, 0], cache
+
+    target_prefill = jax.jit(
+        lambda p, c, t, n: prefill(p, target, c, t, n), donate_argnums=(1,)
+    )
+    draft_prefill = jax.jit(
+        lambda p, c, t, n: prefill(p, draft, c, t, n), donate_argnums=(1,)
+    )
+
+    def draft_k(params, cache, cur, n, rng):
+        """K draft steps; returns proposals, their probs, updated cache."""
+
+        def body(carry, sub):
+            cache, tok, idx = carry
+            logits, cache = draft(
+                params, tok[None, None], cache=cache, cache_index=idx
+            )
+            p = _probs(logits[0, -1], sample_cfg)  # FULL draft dist (V,)
+            nxt = jax.random.choice(sub, p.shape[-1], p=p)
+            return (cache, nxt, idx + 1), (nxt, p)
+
+        (cache, _, _), (toks, probs) = jax.lax.scan(
+            body, (cache, cur, n), jax.random.split(rng, k)
+        )
+        return toks, probs, cache  # probs: (k, V)
+
+    draft_k = jax.jit(draft_k, donate_argnums=(1,))
+
+    def draft_ingest(params, cache, tok, idx):
+        """Feed one token into the draft cache (no sampling) — needed when
+        a round accepts all k proposals: the draft never consumed d_k, and
+        leaving its slot zero would pollute later draft attention."""
+        _, cache = draft(params, tok[None, None], cache=cache, cache_index=idx)
+        return cache
+
+    draft_ingest = jax.jit(draft_ingest, donate_argnums=(1,))
+
+    def verify(params, cache, chunk, n, draft_toks, draft_probs, rng):
+        """Score [cur, d_1..d_K]; accept a prefix; sample one more.
+
+        Returns (m, tokens_out (K+1,), cache): tokens_out[:m] are the
+        accepted proposals, tokens_out[m] is the bonus/residual sample;
+        entries past m are padding.
+        """
+        logits, cache = target(
+            params, chunk[None, :], cache=cache, cache_index=n
+        )
+        probs = _probs(logits[0], sample_cfg)  # (K+1, V)
+
+        p_t = probs[jnp.arange(k), draft_toks]  # target prob of each d_j
+        q_t = draft_probs[jnp.arange(k), draft_toks]  # draft prob of d_j
+        accept_rng, residual_rng = jax.random.split(rng)
+        u = jax.random.uniform(accept_rng, (k,))
+        ok = u < jnp.minimum(1.0, p_t / jnp.maximum(q_t, 1e-20))
+        # First rejection index = number of accepted proposals m (the
+        # appended False guarantees argmin finds one; all-ok -> m = k).
+        m = jnp.argmin(
+            jnp.concatenate([ok, jnp.array([False])])
+        ).astype(jnp.int32)
+
+        # Exact residual at the rejection point: max(p_target - q_draft,
+        # 0) renormalised (Leviathan et al.); with everything accepted,
+        # the bonus samples the target's own distribution at position k.
+        p_target_at_m = probs[m]
+        p_draft_at_m = jnp.where(
+            m < k,
+            draft_probs[jnp.minimum(m, k - 1)],
+            jnp.zeros_like(p_target_at_m),
+        )
+        residual = jnp.maximum(p_target_at_m - p_draft_at_m, 0.0)
+        residual = jnp.where(
+            residual.sum() > 0, residual / residual.sum(), p_target_at_m
+        )
+        bonus = jax.random.choice(
+            residual_rng, residual.shape[-1], p=residual
+        )
+        out = jnp.concatenate(
+            [draft_toks, jnp.zeros((1,), draft_toks.dtype)]
+        )
+        out = out.at[m].set(bonus)
+        return m, out, cache
+
+    verify = jax.jit(verify, donate_argnums=(1,))
+    return (target_prefill, draft_prefill), (draft_k, draft_ingest), verify
+
+
+def speculative_generate(
+    target,
+    target_params,
+    draft,
+    draft_params,
+    prompt,
+    *,
+    max_new_tokens: int,
+    k: int = 4,
+    sample_cfg: SampleConfig = SampleConfig(temperature=0.0),
+    eos_id: Optional[int] = None,
+    max_len: Optional[int] = None,
+    rng: Optional[jax.Array] = None,
+) -> SpecResult:
+    """Generate with draft-assisted decoding (single sequence).
+
+    ``target`` and ``draft`` must share a vocabulary. ``k`` proposals per
+    round; each round costs one draft K-step scan + one target chunk
+    forward and nets between 1 and k+1 tokens.
+    """
+    prompt = list(map(int, prompt))
+    if not prompt:
+        raise ValueError("empty prompt")
+    rng = rng if rng is not None else jax.random.key(0)
+    p_len = len(prompt)
+    max_len = max_len or (p_len + max_new_tokens + k + 1)
+    if max_len < p_len + 1:
+        # Too-small caches would CLAMP the prefill writes (XLA dynamic
+        # update semantics) and return garbage with no error.
+        raise ValueError(
+            f"max_len={max_len} cannot hold the {p_len}-token prompt "
+            "plus one generated token"
+        )
+
+    try:
+        fns = make_speculative_fns(target, draft, k, sample_cfg)
+    except TypeError:  # unhashable custom model: uncached
+        fns = make_speculative_fns.__wrapped__(target, draft, k, sample_cfg)
+    (t_prefill, d_prefill), (draft_k_fn, draft_ingest_fn), verify_fn = fns
+
+    t_cache = target.init_cache(1, max_len)
+    d_cache = draft.init_cache(1, max_len)
+    tokens = jnp.asarray([prompt], jnp.int32)
+    length = jnp.asarray([p_len], jnp.int32)[0]
+
+    rng, sub = jax.random.split(rng)
+    logits, t_cache = t_prefill(target_params, t_cache, tokens, length)
+    first_probs = _probs(logits[0], sample_cfg)
+    cur = int(
+        jax.random.choice(sub, first_probs.shape[-1], p=first_probs)
+    )
+    _, d_cache = d_prefill(draft_params, d_cache, tokens, length)
+
+    out: List[int] = [cur]
+    n = p_len  # tokens resident in both caches
+    proposed = accepted = rounds = 0
+
+    while len(out) < max_new_tokens and (
+        eos_id is None or out[-1] != eos_id
+    ):
+        if n + k + 1 >= max_len:
+            break  # cache budget exhausted
+        rng, r_draft, r_verify = jax.random.split(rng, 3)
+        d_toks, d_probs, d_cache = draft_k_fn(
+            draft_params, d_cache, jnp.int32(cur), jnp.int32(n), r_draft
+        )
+        chunk = jnp.concatenate(
+            [jnp.asarray([cur], jnp.int32), d_toks.astype(jnp.int32)]
+        )
+        m, toks, t_cache = verify_fn(
+            target_params, t_cache, chunk, jnp.int32(n), d_toks, d_probs,
+            r_verify,
+        )
+        m = int(m)
+        emitted = [int(t) for t in np.asarray(toks[: m + 1])]
+        rounds += 1
+        proposed += k
+        accepted += m
+
+        for t in emitted[:-1]:
+            out.append(t)
+            if eos_id is not None and t == eos_id:
+                break
+        else:
+            out.append(emitted[-1])
+        if m == k:
+            # Fully-accepted round: the draft never consumed d_k — feed it
+            # so the draft cache stays aligned with the target's.
+            d_cache = draft_ingest_fn(
+                draft_params, d_cache, d_toks[k - 1].astype(jnp.int32),
+                jnp.int32(n + k),  # d_k is the (n+k)-th token
+            )
+        n += m + 1
+        cur = out[-1]
+
+    if eos_id is not None and eos_id in out:
+        out = out[: out.index(eos_id) + 1]
+    out = out[:max_new_tokens]
+    rate = accepted / proposed if proposed else 0.0
+    return SpecResult(tokens=out, acceptance_rate=rate, rounds=rounds)

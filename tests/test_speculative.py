@@ -1,0 +1,129 @@
+"""Speculative decoding: greedy parity with the target, acceptance stats."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from shifu_tpu.infer import SampleConfig, make_generate_fn
+from shifu_tpu.infer.speculative import speculative_generate
+from shifu_tpu.models import Transformer, TransformerConfig
+
+
+@pytest.fixture(scope="module")
+def models():
+    target = Transformer(TransformerConfig.tiny())
+    tp = target.init(jax.random.key(0))
+    draft = Transformer(
+        TransformerConfig.tiny(n_layers=1, dim=32, n_heads=2, n_kv_heads=1,
+                               mlp_dim=64)
+    )
+    dp = draft.init(jax.random.key(1))
+    return target, tp, draft, dp
+
+
+def _greedy_reference(model, params, prompt, max_new):
+    fn = make_generate_fn(
+        model, max_new_tokens=max_new, sample_cfg=SampleConfig(temperature=0.0)
+    )
+    out = fn(
+        params,
+        jnp.asarray([prompt], jnp.int32),
+        jnp.asarray([len(prompt)], jnp.int32),
+        jax.random.key(0),
+    )
+    return [int(t) for t in np.asarray(out["tokens"][0])]
+
+
+def test_greedy_parity_weak_draft(models):
+    # An unrelated random draft proposes junk; verification must still
+    # emit EXACTLY the target's greedy continuation.
+    target, tp, draft, dp = models
+    prompt = np.random.RandomState(0).randint(1, 256, size=7).tolist()
+    want = _greedy_reference(target, tp, prompt, 10)
+    got = speculative_generate(
+        target, tp, draft, dp, prompt, max_new_tokens=10, k=3,
+        sample_cfg=SampleConfig(temperature=0.0),
+    )
+    assert got.tokens == want
+    assert got.rounds >= 1
+
+
+def test_greedy_parity_perfect_draft(models):
+    # Draft == target: every proposal accepted, rounds ≈ max_new / (k+1).
+    target, tp, _, _ = models
+    prompt = np.random.RandomState(1).randint(1, 256, size=5).tolist()
+    want = _greedy_reference(target, tp, prompt, 12)
+    got = speculative_generate(
+        target, tp, target, tp, prompt, max_new_tokens=12, k=3,
+        sample_cfg=SampleConfig(temperature=0.0),
+    )
+    assert got.tokens == want
+    assert got.acceptance_rate == 1.0
+    assert got.rounds <= -(-12 // 4) + 1  # ceil(12 / (k+1)) (+1 slack)
+
+
+def test_acceptance_rate_reported(models):
+    target, tp, draft, dp = models
+    prompt = np.random.RandomState(2).randint(1, 256, size=6).tolist()
+    got = speculative_generate(
+        target, tp, draft, dp, prompt, max_new_tokens=8, k=4,
+        sample_cfg=SampleConfig(temperature=0.0),
+    )
+    assert 0.0 <= got.acceptance_rate <= 1.0
+    assert len(got.tokens) == 8
+
+
+def test_eos_truncates(models):
+    target, tp, draft, dp = models
+    prompt = np.random.RandomState(3).randint(1, 256, size=5).tolist()
+    ref = _greedy_reference(target, tp, prompt, 6)
+    eos = ref[2]
+    got = speculative_generate(
+        target, tp, draft, dp, prompt, max_new_tokens=6, k=3,
+        sample_cfg=SampleConfig(temperature=0.0), eos_id=eos,
+    )
+    assert got.tokens == ref[: 3]
+    assert got.tokens[-1] == eos
+
+
+def test_sampled_mode_runs(models):
+    target, tp, draft, dp = models
+    prompt = np.random.RandomState(4).randint(1, 256, size=5).tolist()
+    got = speculative_generate(
+        target, tp, draft, dp, prompt, max_new_tokens=8, k=3,
+        sample_cfg=SampleConfig(temperature=1.0), rng=jax.random.key(7),
+    )
+    assert len(got.tokens) == 8
+    assert all(0 <= t < 256 for t in got.tokens)
+
+
+def test_top_k_filter_respected(models):
+    # top_k=1 at temperature 1.0 is deterministic: the sampler's filters
+    # must flow into the speculative probabilities, so the output equals
+    # the greedy continuation exactly.
+    target, tp, draft, dp = models
+    prompt = np.random.RandomState(5).randint(1, 256, size=6).tolist()
+    want = _greedy_reference(target, tp, prompt, 8)
+    got = speculative_generate(
+        target, tp, draft, dp, prompt, max_new_tokens=8, k=3,
+        sample_cfg=SampleConfig(temperature=1.0, top_k=1),
+        rng=jax.random.key(9),
+    )
+    assert got.tokens == want
+
+
+def test_max_len_too_small_rejected(models):
+    target, tp, draft, dp = models
+    with pytest.raises(ValueError, match="max_len"):
+        speculative_generate(
+            target, tp, draft, dp, [1] * 10, max_new_tokens=4, max_len=8
+        )
+
+
+def test_empty_prompt_rejected(models):
+    target, tp, draft, dp = models
+    with pytest.raises(ValueError, match="empty"):
+        speculative_generate(
+            target, tp, draft, dp, [], max_new_tokens=4
+        )
