@@ -204,9 +204,16 @@ def speculative_generate(
         fns = make_speculative_fns.__wrapped__(target, draft, k, sample_cfg)
     (t_prefill, d_prefill), (draft_k_fn, draft_ingest_fn), verify_fn = fns
 
+    # Pad the prompt to a power-of-two bucket so varied prompt lengths in
+    # a serving loop reuse ONE compiled prefill (pad slots are hidden by
+    # slot-space causality and overwritten as decoding proceeds).
+    bucket = 1 << (p_len - 1).bit_length()
+    max_len = max(max_len, bucket)
     t_cache = target.init_cache(1, max_len)
     d_cache = draft.init_cache(1, max_len)
-    tokens = jnp.asarray([prompt], jnp.int32)
+    tokens = jnp.asarray(
+        [prompt + [0] * (bucket - p_len)], jnp.int32
+    )
     length = jnp.asarray([p_len], jnp.int32)[0]
 
     rng, sub = jax.random.split(rng)
@@ -224,7 +231,7 @@ def speculative_generate(
     while len(out) < max_new_tokens and (
         eos_id is None or out[-1] != eos_id
     ):
-        if n + k + 1 >= max_len:
+        if n + k + 1 > max_len:  # the chunk writes slots n..n+k inclusive
             break  # cache budget exhausted
         rng, r_draft, r_verify = jax.random.split(rng, 3)
         d_toks, d_probs, d_cache = draft_k_fn(

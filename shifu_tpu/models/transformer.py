@@ -95,10 +95,10 @@ class TransformerConfig:
         if self.window_size is not None:
             if self.window_size < 1:
                 raise ValueError(f"window_size={self.window_size} must be >= 1")
-            if self.attn_impl != "xla":
+            if self.attn_impl not in ("xla", "flash"):
                 raise ValueError(
-                    "window_size requires attn_impl='xla' (the flash/ring "
-                    "paths do not implement sliding windows yet)"
+                    "window_size requires attn_impl 'xla' or 'flash' (the "
+                    "ring path does not implement sliding windows yet)"
                 )
 
     # -- presets --------------------------------------------------------------

@@ -62,26 +62,29 @@ def dot_product_attention(
         falls back to the O(S^2)-memory XLA path).
       window: sliding-window attention — query i sees only keys in
         (i - window, i], i.e. the last ``window`` positions INCLUDING
-        itself. Requires ``causal=True`` and ``impl="xla"`` (flash/ring
-        raise rather than silently attending outside the window).
+        itself. Requires ``causal=True``; supported on the xla and flash
+        paths (the flash kernel additionally SKIPS out-of-window KV
+        blocks, making long-context windowed attention O(S·window));
+        ring raises rather than silently attending outside the window.
 
     Returns:
       (batch, q_len, num_heads, head_dim) in q.dtype.
     """
     if window is not None and not causal:
         raise ValueError("window requires causal attention")
-    if window is not None and impl in ("flash", "ring"):
-        # The pallas/ring paths do not implement block skipping for
-        # windows yet; refusing beats silently attending outside it.
+    if window is not None and impl == "ring":
+        # The ring path has no out-of-window block skipping yet; refusing
+        # beats silently attending outside the window.
         raise ValueError(
-            f"impl={impl!r} does not support sliding windows yet; use "
-            "impl='xla'"
+            "impl='ring' does not support sliding windows yet; use "
+            "impl='xla' or impl='flash'"
         )
     if impl == "flash":
         from shifu_tpu.ops.pallas.flash_attention import flash_attention
 
         return flash_attention(
-            q, k, v, causal=causal, scale=scale, segment_ids=segment_ids
+            q, k, v, causal=causal, scale=scale, segment_ids=segment_ids,
+            window=window,
         )
     if impl == "ring":
         # Sequence-parallel ring attention over the sp mesh axis. Needs an

@@ -52,6 +52,7 @@ class FlashConfig:
     block_q: int
     block_k: int
     interpret: bool
+    window: "Optional[int]" = None  # sliding window (causal only)
 
 
 def _pad_to(x, multiple: int, axis: int):
@@ -64,7 +65,8 @@ def _pad_to(x, multiple: int, axis: int):
     return jnp.pad(x, widths)
 
 
-def _mask_for(rows0, cols0, bq, bk, kv_len, offset, causal, qs, ks):
+def _mask_for(rows0, cols0, bq, bk, kv_len, offset, causal, qs, ks,
+              window=None):
     """Boolean (bq, bk) tile mask. rows0/cols0: global tile origins.
 
     ``qs`` is a (bq, 1) column of query segment ids and ``ks`` a (1, bk)
@@ -77,6 +79,8 @@ def _mask_for(rows0, cols0, bq, bk, kv_len, offset, causal, qs, ks):
     mask = cols < kv_len  # KV padding
     if causal:
         mask = jnp.logical_and(mask, cols <= rows + offset)
+        if window is not None:
+            mask = jnp.logical_and(mask, cols > rows + offset - window)
     if qs is not None:
         mask = jnp.logical_and(mask, qs == ks)
     return mask
@@ -96,7 +100,8 @@ def _dot(a, b, *, trans_a=False, trans_b=False):
 # --------------------------------------------------------------------------
 
 
-def _fwd_kernel(cfg: FlashConfig, kv_len, offset, n_k, has_segs, *refs):
+def _fwd_kernel(cfg: FlashConfig, kv_len, offset, n_k_grid, n_k, has_segs,
+                kv_base, *refs):
     if has_segs:
         q_ref, k_ref, v_ref, qs_ref, ks_ref, o_ref, lse_ref, m_sc, l_sc, acc_sc = refs
     else:
@@ -106,6 +111,9 @@ def _fwd_kernel(cfg: FlashConfig, kv_len, offset, n_k, has_segs, *refs):
     jk = pl.program_id(3)
     bq = q_ref.shape[2]
     bk = k_ref.shape[2]
+    # Windowed grids iterate a RESTRICTED set of KV blocks per query tile;
+    # kv_base maps (iq, jk) to the unclamped global KV block index.
+    jkb = jk if kv_base is None else kv_base(iq) + jk
 
     @pl.when(jk == 0)
     def _():
@@ -113,9 +121,17 @@ def _fwd_kernel(cfg: FlashConfig, kv_len, offset, n_k, has_segs, *refs):
         l_sc[...] = jnp.zeros_like(l_sc)
         acc_sc[...] = jnp.zeros_like(acc_sc)
 
-    run = jk * bk < kv_len
+    run = jkb * bk < kv_len
+    if kv_base is not None:
+        run = jnp.logical_and(run, jkb <= n_k - 1)  # clamped duplicates
     if cfg.causal:
-        run = jnp.logical_and(run, jk * bk <= iq * bq + (bq - 1) + offset)
+        run = jnp.logical_and(run, jkb * bk <= iq * bq + (bq - 1) + offset)
+        if cfg.window is not None:
+            # Skip KV blocks wholly left of the first query row's window.
+            run = jnp.logical_and(
+                run,
+                jkb * bk + (bk - 1) > iq * bq + offset - cfg.window,
+            )
 
     @pl.when(run)
     def _():
@@ -124,9 +140,10 @@ def _fwd_kernel(cfg: FlashConfig, kv_len, offset, n_k, has_segs, *refs):
         v = v_ref[0, 0]
         s = _dot(q, k, trans_b=True) * cfg.scale
         mask = _mask_for(
-            iq * bq, jk * bk, bq, bk, kv_len, offset, cfg.causal,
+            iq * bq, jkb * bk, bq, bk, kv_len, offset, cfg.causal,
             qs_ref[0] if has_segs else None,
             ks_ref[0] if has_segs else None,
+            window=cfg.window,
         )
         s = jnp.where(mask, s, NEG_INF)
 
@@ -138,7 +155,7 @@ def _fwd_kernel(cfg: FlashConfig, kv_len, offset, n_k, has_segs, *refs):
         m_sc[...] = m_new
         acc_sc[...] = acc_sc[...] * alpha[:, :1] + _dot(p.astype(v.dtype), v)
 
-    @pl.when(jk == n_k - 1)
+    @pl.when(jk == n_k_grid - 1)
     def _():
         l = l_sc[:, :1]
         # Fully-masked rows (query padding) have l == 0; emit zeros for
@@ -163,13 +180,40 @@ def _flash_forward(q, k, v, segment_ids, cfg: FlashConfig):
     n_q = qp.shape[2] // bq
     n_k = kp.shape[2] // bk
 
+    # Windowed causal attention visits only the KV blocks that can fall
+    # inside ANY query row of the tile: a contiguous span of
+    # ceil((window + bq)/bk) + 1 blocks starting at the window's left
+    # edge. The grid shrinks accordingly — DMA and FLOPs become
+    # O(S * window), not O(S^2).
+    kv_base = None
+    n_k_grid = n_k
+    if cfg.causal and cfg.window is not None:
+        span = (cfg.window + bq - 2) // bk + 2
+        # The iq-dependent index map breaks Mosaic's affine prefetching,
+        # costing ~2x per grid step (measured on v5e). Only restrict the
+        # grid when the block savings clearly dominate that overhead —
+        # window << S; otherwise keep the full grid (in-kernel pl.when
+        # still skips out-of-window blocks' FLOPs).
+        if span <= n_k // 4:
+            n_k_grid = span
+
+            def kv_base(iq, _bq=bq, _bk=bk, _off=offset, _w=cfg.window):
+                lo = iq * _bq + _off - _w + 1  # leftmost visible column
+                return jnp.maximum(lo // _bk, 0)
+
+    def kv_block(iq, jk):
+        base = jk if kv_base is None else kv_base(iq) + jk
+        return jnp.minimum(base, n_k - 1)  # clamp; kernel skips duplicates
+
     in_specs = [
         pl.BlockSpec((1, 1, bq, d), lambda ib, ih, iq, jk: (ib, ih, iq, 0)),
         pl.BlockSpec(
-            (1, 1, bk, d), lambda ib, ih, iq, jk: (ib, ih // group, jk, 0)
+            (1, 1, bk, d),
+            lambda ib, ih, iq, jk: (ib, ih // group, kv_block(iq, jk), 0),
         ),
         pl.BlockSpec(
-            (1, 1, bk, d), lambda ib, ih, iq, jk: (ib, ih // group, jk, 0)
+            (1, 1, bk, d),
+            lambda ib, ih, iq, jk: (ib, ih // group, kv_block(iq, jk), 0),
         ),
     ]
     inputs = [qp, kp, vp]
@@ -186,12 +230,17 @@ def _flash_forward(q, k, v, segment_ids, cfg: FlashConfig):
         ]
         in_specs += [
             pl.BlockSpec((1, bq, 1), lambda ib, ih, iq, jk: (ib, iq, 0)),
-            pl.BlockSpec((1, 1, bk), lambda ib, ih, iq, jk: (ib, 0, jk)),
+            pl.BlockSpec(
+                (1, 1, bk),
+                lambda ib, ih, iq, jk: (ib, 0, kv_block(iq, jk)),
+            ),
         ]
 
     o, lse = pl.pallas_call(
-        functools.partial(_fwd_kernel, cfg, skv, offset, n_k, has_segs),
-        grid=(b, h, n_q, n_k),
+        functools.partial(
+            _fwd_kernel, cfg, skv, offset, n_k_grid, n_k, has_segs, kv_base
+        ),
+        grid=(b, h, n_q, n_k_grid),
         in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, 1, bq, d), lambda ib, ih, iq, jk: (ib, ih, iq, 0)),
@@ -225,7 +274,7 @@ def _recompute_p(cfg, q, k, lse_row, mask):
     return jnp.exp(s - lse_row)
 
 
-def _dq_kernel(cfg, kv_len, offset, n_k, has_segs, *refs):
+def _dq_kernel(cfg, kv_len, offset, n_k_grid, n_k, has_segs, kv_base, *refs):
     if has_segs:
         (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qs_ref, ks_ref,
          dq_ref, dq_sc) = refs
@@ -236,14 +285,22 @@ def _dq_kernel(cfg, kv_len, offset, n_k, has_segs, *refs):
     jk = pl.program_id(3)
     bq = q_ref.shape[2]
     bk = k_ref.shape[2]
+    jkb = jk if kv_base is None else kv_base(iq) + jk
 
     @pl.when(jk == 0)
     def _():
         dq_sc[...] = jnp.zeros_like(dq_sc)
 
-    run = jk * bk < kv_len
+    run = jkb * bk < kv_len
+    if kv_base is not None:
+        run = jnp.logical_and(run, jkb <= n_k - 1)
     if cfg.causal:
-        run = jnp.logical_and(run, jk * bk <= iq * bq + (bq - 1) + offset)
+        run = jnp.logical_and(run, jkb * bk <= iq * bq + (bq - 1) + offset)
+        if cfg.window is not None:
+            run = jnp.logical_and(
+                run,
+                jkb * bk + (bk - 1) > iq * bq + offset - cfg.window,
+            )
 
     @pl.when(run)
     def _():
@@ -252,9 +309,10 @@ def _dq_kernel(cfg, kv_len, offset, n_k, has_segs, *refs):
         v = v_ref[0, 0]
         do = do_ref[0, 0]
         mask = _mask_for(
-            iq * bq, jk * bk, bq, bk, kv_len, offset, cfg.causal,
+            iq * bq, jkb * bk, bq, bk, kv_len, offset, cfg.causal,
             qs_ref[0] if has_segs else None,
             ks_ref[0] if has_segs else None,
+            window=cfg.window,
         )
         lse_row = lse_ref[0, 0]                 # (bq, 1)
         p = _recompute_p(cfg, q, k, lse_row, mask)
@@ -262,12 +320,13 @@ def _dq_kernel(cfg, kv_len, offset, n_k, has_segs, *refs):
         ds = p * (dp - delta_ref[0, 0])
         dq_sc[...] += _dot(ds.astype(k.dtype), k) * cfg.scale
 
-    @pl.when(jk == n_k - 1)
+    @pl.when(jk == n_k_grid - 1)
     def _():
         dq_ref[0, 0] = dq_sc[...].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(cfg, kv_len, offset, group, n_q, has_segs, *refs):
+def _dkv_kernel(cfg, kv_len, offset, group, n_q_grid, n_q, has_segs,
+                q_base, *refs):
     if has_segs:
         (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qs_ref, ks_ref,
          dk_ref, dv_ref, dk_sc, dv_sc) = refs
@@ -280,6 +339,7 @@ def _dkv_kernel(cfg, kv_len, offset, group, n_q, has_segs, *refs):
     iq = pl.program_id(4)
     bq = q_ref.shape[2]
     bk = k_ref.shape[2]
+    iqb = iq if q_base is None else q_base(jk) + iq
 
     @pl.when(jnp.logical_and(g == 0, iq == 0))
     def _():
@@ -289,8 +349,19 @@ def _dkv_kernel(cfg, kv_len, offset, group, n_q, has_segs, *refs):
     # Padded KV columns are masked to p == 0, so only the causal predicate
     # can skip a block here.
     run = True
+    if q_base is not None:
+        run = iqb <= n_q - 1  # clamped duplicates
     if cfg.causal:
-        run = jk * bk <= iq * bq + (bq - 1) + offset
+        run = jnp.logical_and(
+            run, jk * bk <= iqb * bq + (bq - 1) + offset
+        )
+        if cfg.window is not None:
+            # Skip query blocks whose EVERY row's window starts after this
+            # KV block ends (smallest row is iqb*bq).
+            run = jnp.logical_and(
+                run,
+                jk * bk + (bk - 1) > iqb * bq + offset - cfg.window,
+            )
 
     @pl.when(run)
     def _():
@@ -299,9 +370,10 @@ def _dkv_kernel(cfg, kv_len, offset, group, n_q, has_segs, *refs):
         v = v_ref[0, 0]
         do = do_ref[0, 0]
         mask = _mask_for(
-            iq * bq, jk * bk, bq, bk, kv_len, offset, cfg.causal,
+            iqb * bq, jk * bk, bq, bk, kv_len, offset, cfg.causal,
             qs_ref[0] if has_segs else None,
             ks_ref[0] if has_segs else None,
+            window=cfg.window,
         )
         lse_row = lse_ref[0, 0]
         p = _recompute_p(cfg, q, k, lse_row, mask)
@@ -312,7 +384,7 @@ def _dkv_kernel(cfg, kv_len, offset, group, n_q, has_segs, *refs):
         ds = p * (dp - delta_ref[0, 0])
         dk_sc[...] += _dot(ds.astype(q.dtype), q, trans_a=True) * cfg.scale
 
-    @pl.when(jnp.logical_and(g == group - 1, iq == n_q - 1))
+    @pl.when(jnp.logical_and(g == group - 1, iq == n_q_grid - 1))
     def _():
         dk_ref[0, 0] = dk_sc[...].astype(dk_ref.dtype)
         dv_ref[0, 0] = dv_sc[...].astype(dv_ref.dtype)
@@ -342,6 +414,34 @@ def _flash_backward(q, k, v, segment_ids, o, lse, do, cfg: FlashConfig):
     n_q = qp.shape[2] // bq
     n_k = kp.shape[2] // bk
 
+    # Restricted grids for windowed causal attention (see _flash_forward).
+    kv_base = q_base = None
+    n_k_grid, n_q_grid = n_k, n_q
+    if cfg.causal and cfg.window is not None:
+        # Same clear-win gate as the forward (see _flash_forward).
+        k_span = (cfg.window + bq - 2) // bk + 2
+        if k_span <= n_k // 4:
+            n_k_grid = k_span
+
+            def kv_base(iq, _bq=bq, _bk=bk, _off=offset, _w=cfg.window):
+                return jnp.maximum((iq * _bq + _off - _w + 1) // _bk, 0)
+
+        q_span = (cfg.window + bk - 2) // bq + 2
+        if q_span <= n_q // 4:
+            n_q_grid = q_span
+
+            def q_base(jk, _bq=bq, _bk=bk, _off=offset):
+                # First query row seeing this KV block: row >= col - off.
+                return jnp.maximum((jk * _bk - _off) // _bq, 0)
+
+    def kv_block(iq, jk):
+        base = jk if kv_base is None else kv_base(iq) + jk
+        return jnp.minimum(base, n_k - 1)
+
+    def q_block(jk, iq):
+        base = iq if q_base is None else q_base(jk) + iq
+        return jnp.minimum(base, n_q - 1)
+
     has_segs = segment_ids is not None
     seg_inputs = []
     if has_segs:
@@ -355,10 +455,12 @@ def _flash_backward(q, k, v, segment_ids, o, lse, do, cfg: FlashConfig):
     dq_in_specs = [
         pl.BlockSpec((1, 1, bq, d), lambda ib, ih, iq, jk: (ib, ih, iq, 0)),
         pl.BlockSpec(
-            (1, 1, bk, d), lambda ib, ih, iq, jk: (ib, ih // group, jk, 0)
+            (1, 1, bk, d),
+            lambda ib, ih, iq, jk: (ib, ih // group, kv_block(iq, jk), 0),
         ),
         pl.BlockSpec(
-            (1, 1, bk, d), lambda ib, ih, iq, jk: (ib, ih // group, jk, 0)
+            (1, 1, bk, d),
+            lambda ib, ih, iq, jk: (ib, ih // group, kv_block(iq, jk), 0),
         ),
         pl.BlockSpec((1, 1, bq, d), lambda ib, ih, iq, jk: (ib, ih, iq, 0)),
         pl.BlockSpec((1, 1, bq, 1), lambda ib, ih, iq, jk: (ib, ih, iq, 0)),
@@ -367,11 +469,16 @@ def _flash_backward(q, k, v, segment_ids, o, lse, do, cfg: FlashConfig):
     if has_segs:
         dq_in_specs += [
             pl.BlockSpec((1, bq, 1), lambda ib, ih, iq, jk: (ib, iq, 0)),
-            pl.BlockSpec((1, 1, bk), lambda ib, ih, iq, jk: (ib, 0, jk)),
+            pl.BlockSpec(
+                (1, 1, bk),
+                lambda ib, ih, iq, jk: (ib, 0, kv_block(iq, jk)),
+            ),
         ]
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, cfg, skv, offset, n_k, has_segs),
-        grid=(b, h, n_q, n_k),
+        functools.partial(
+            _dq_kernel, cfg, skv, offset, n_k_grid, n_k, has_segs, kv_base
+        ),
+        grid=(b, h, n_q, n_k_grid),
         in_specs=dq_in_specs,
         out_specs=pl.BlockSpec(
             (1, 1, bq, d), lambda ib, ih, iq, jk: (ib, ih, iq, 0)
@@ -385,7 +492,7 @@ def _flash_backward(q, k, v, segment_ids, o, lse, do, cfg: FlashConfig):
     # per-KV-block accumulators sum over every query head in the group and
     # every query block without an HBM-sized intermediate. ---------------
     def qhead(ib, ih, jk, g, iq):
-        return (ib, ih * group + g, iq, 0)
+        return (ib, ih * group + g, q_block(jk, iq), 0)
 
     dkv_in_specs = [
         pl.BlockSpec((1, 1, bq, d), qhead),
@@ -397,12 +504,18 @@ def _flash_backward(q, k, v, segment_ids, o, lse, do, cfg: FlashConfig):
     ]
     if has_segs:
         dkv_in_specs += [
-            pl.BlockSpec((1, bq, 1), lambda ib, ih, jk, g, iq: (ib, iq, 0)),
+            pl.BlockSpec(
+                (1, bq, 1),
+                lambda ib, ih, jk, g, iq: (ib, q_block(jk, iq), 0),
+            ),
             pl.BlockSpec((1, 1, bk), lambda ib, ih, jk, g, iq: (ib, 0, jk)),
         ]
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, cfg, skv, offset, group, n_q, has_segs),
-        grid=(b, h_kv, n_k, group, n_q),
+        functools.partial(
+            _dkv_kernel, cfg, skv, offset, group, n_q_grid, n_q, has_segs,
+            q_base,
+        ),
+        grid=(b, h_kv, n_k, group, n_q_grid),
         in_specs=dkv_in_specs,
         out_specs=[
             pl.BlockSpec(
@@ -462,6 +575,7 @@ def flash_attention(
     block_q: int = 512,
     block_k: int = 1024,
     interpret: Optional[bool] = None,
+    window: Optional[int] = None,
 ):
     """Flash attention with the dot_product_attention layout/semantics.
 
@@ -486,6 +600,8 @@ def flash_attention(
         raise ValueError(f"num_heads={h} not divisible by kv={h_kv}")
     if segment_ids is not None and sq != skv:
         raise ValueError("segment_ids requires q_len == kv_len")
+    if window is not None and not causal:
+        raise ValueError("window requires causal attention")
     cfg = FlashConfig(
         causal=causal,
         scale=float(scale) if scale is not None else d**-0.5,
@@ -496,6 +612,7 @@ def flash_attention(
             if interpret is not None
             else jax.default_backend() != "tpu"
         ),
+        window=int(window) if window is not None else None,
     )
     # Kernel-native layout: heads outside the sequence axis so each grid
     # step addresses one contiguous (seq_block, head_dim) tile.
