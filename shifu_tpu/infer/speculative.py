@@ -187,6 +187,16 @@ def speculative_generate(
     prompt = list(map(int, prompt))
     if not prompt:
         raise ValueError("empty prompt")
+    for m, name in ((target, "target"), (draft, "draft")):
+        if getattr(m, "prefill_needs_mask", False):
+            # A rolling recurrent state (SSM) mutates irreversibly on
+            # rejected proposals — the watermark trick only works for
+            # addressed attention caches.
+            raise NotImplementedError(
+                f"speculative decoding does not support recurrent-cache "
+                f"models ({name}): rejected tokens cannot be rolled back "
+                "out of an SSM state"
+            )
     rng = rng if rng is not None else jax.random.key(0)
     p_len = len(prompt)
     max_len = max_len or (p_len + max_new_tokens + k + 1)
@@ -204,11 +214,11 @@ def speculative_generate(
         fns = make_speculative_fns.__wrapped__(target, draft, k, sample_cfg)
     (t_prefill, d_prefill), (draft_k_fn, draft_ingest_fn), verify_fn = fns
 
-    # Pad the prompt to a power-of-two bucket so varied prompt lengths in
-    # a serving loop reuse ONE compiled prefill (pad slots are hidden by
-    # slot-space causality and overwritten as decoding proceeds).
-    bucket = 1 << (p_len - 1).bit_length()
-    max_len = max(max_len, bucket)
+    # Pad the prompt to a multiple of 128 so varied prompt lengths reuse
+    # a handful of compiled prefills (pad slots are hidden by slot-space
+    # causality and overwritten as decoding proceeds). Capped at the
+    # caller's max_len — never silently grow their memory budget.
+    bucket = min(-(-p_len // 128) * 128, max_len)
     t_cache = target.init_cache(1, max_len)
     d_cache = draft.init_cache(1, max_len)
     tokens = jnp.asarray(

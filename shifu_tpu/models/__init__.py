@@ -4,6 +4,7 @@ from shifu_tpu.models.convert import (
     config_from_hf_llama,
     from_hf_llama,
     params_from_hf_llama,
+    to_hf_llama_state_dict,
 )
 
 __all__ = [
@@ -14,4 +15,5 @@ __all__ = [
     "config_from_hf_llama",
     "from_hf_llama",
     "params_from_hf_llama",
+    "to_hf_llama_state_dict",
 ]

@@ -154,6 +154,55 @@ def params_from_hf_llama(
     return params
 
 
+def to_hf_llama_state_dict(params, cfg: TransformerConfig):
+    """shifu_tpu params -> HF Llama state_dict (numpy tensors).
+
+    Exact inverse of :func:`params_from_hf_llama` (round-trip tested), so
+    TPU-trained weights load into `transformers` for publication or
+    GPU serving: ``LlamaForCausalLM(config).load_state_dict({k:
+    torch.from_numpy(v) for k, v in sd.items()})``.
+    """
+    L = cfg.n_layers
+    d, h, kv, hd = (
+        cfg.dim, cfg.n_heads, cfg.n_kv_heads, cfg.resolved_head_dim,
+    )
+    blocks = params["blocks"]
+
+    def np_(x):
+        return np.asarray(x, np.float32)
+
+    sd = {"model.embed_tokens.weight": np_(params["embed"])}
+    for l in range(L):
+        p = f"model.layers.{l}."
+        sd[p + "input_layernorm.weight"] = np_(blocks["attn_norm"][l]) + 1.0
+        sd[p + "post_attention_layernorm.weight"] = (
+            np_(blocks["mlp_norm"][l]) + 1.0
+        )
+        sd[p + "self_attn.q_proj.weight"] = (
+            np_(blocks["wq"][l]).reshape(d, h * hd).T
+        )
+        sd[p + "self_attn.k_proj.weight"] = (
+            np_(blocks["wk"][l]).reshape(d, kv * hd).T
+        )
+        sd[p + "self_attn.v_proj.weight"] = (
+            np_(blocks["wv"][l]).reshape(d, kv * hd).T
+        )
+        sd[p + "self_attn.o_proj.weight"] = (
+            np_(blocks["wo"][l]).reshape(h * hd, d).T
+        )
+        sd[p + "mlp.gate_proj.weight"] = np_(blocks["w_gate"][l]).T
+        sd[p + "mlp.up_proj.weight"] = np_(blocks["w_up"][l]).T
+        sd[p + "mlp.down_proj.weight"] = np_(blocks["w_down"][l]).T
+    sd["model.norm.weight"] = np_(params["final_norm"]) + 1.0
+    if cfg.tie_embeddings:
+        # torch state_dicts list tied params under BOTH names; omitting
+        # lm_head.weight would fail the documented load_state_dict call.
+        sd["lm_head.weight"] = np_(params["embed"])
+    else:
+        sd["lm_head.weight"] = np_(params["unembed"]).T
+    return sd
+
+
 def from_hf_llama(
     hf_model, dtype=jnp.float32, **config_overrides
 ) -> Tuple[Transformer, Any]:

@@ -65,6 +65,27 @@ def _pad_to(x, multiple: int, axis: int):
     return jnp.pad(x, widths)
 
 
+def _restricted_grid(window, b_self, b_other, n_blocks, shift):
+    """(n_grid, base_fn) for a windowed-causal restricted grid.
+
+    A tile of ``b_self`` rows visits a contiguous span of ``b_other``-sized
+    blocks; ``base_fn(i)`` is the first (unclamped) visible block for tile
+    ``i`` and ``shift`` the column/row offset entering the bound. Returns
+    base_fn=None when the span isn't a clear win (the iq-dependent index
+    maps break Mosaic's affine prefetching, costing ~2x per grid step on
+    v5e) — callers then keep the full grid with in-kernel skipping.
+    """
+    span = (window + b_self - 2) // b_other + 2
+    if span > n_blocks // 4:
+        return n_blocks, None
+
+    def base(i, _bs=b_self, _bo=b_other, _shift=shift):
+        return jnp.maximum((i * _bs + _shift) // _bo, 0)
+
+    return span, base
+
+
+
 def _mask_for(rows0, cols0, bq, bk, kv_len, offset, causal, qs, ks,
               window=None):
     """Boolean (bq, bk) tile mask. rows0/cols0: global tile origins.
@@ -188,18 +209,9 @@ def _flash_forward(q, k, v, segment_ids, cfg: FlashConfig):
     kv_base = None
     n_k_grid = n_k
     if cfg.causal and cfg.window is not None:
-        span = (cfg.window + bq - 2) // bk + 2
-        # The iq-dependent index map breaks Mosaic's affine prefetching,
-        # costing ~2x per grid step (measured on v5e). Only restrict the
-        # grid when the block savings clearly dominate that overhead —
-        # window << S; otherwise keep the full grid (in-kernel pl.when
-        # still skips out-of-window blocks' FLOPs).
-        if span <= n_k // 4:
-            n_k_grid = span
-
-            def kv_base(iq, _bq=bq, _bk=bk, _off=offset, _w=cfg.window):
-                lo = iq * _bq + _off - _w + 1  # leftmost visible column
-                return jnp.maximum(lo // _bk, 0)
+        n_k_grid, kv_base = _restricted_grid(
+            cfg.window, bq, bk, n_k, offset - cfg.window + 1
+        )
 
     def kv_block(iq, jk):
         base = jk if kv_base is None else kv_base(iq) + jk
@@ -418,21 +430,14 @@ def _flash_backward(q, k, v, segment_ids, o, lse, do, cfg: FlashConfig):
     kv_base = q_base = None
     n_k_grid, n_q_grid = n_k, n_q
     if cfg.causal and cfg.window is not None:
-        # Same clear-win gate as the forward (see _flash_forward).
-        k_span = (cfg.window + bq - 2) // bk + 2
-        if k_span <= n_k // 4:
-            n_k_grid = k_span
-
-            def kv_base(iq, _bq=bq, _bk=bk, _off=offset, _w=cfg.window):
-                return jnp.maximum((iq * _bq + _off - _w + 1) // _bk, 0)
-
-        q_span = (cfg.window + bk - 2) // bq + 2
-        if q_span <= n_q // 4:
-            n_q_grid = q_span
-
-            def q_base(jk, _bq=bq, _bk=bk, _off=offset):
-                # First query row seeing this KV block: row >= col - off.
-                return jnp.maximum((jk * _bk - _off) // _bq, 0)
+        n_k_grid, kv_base = _restricted_grid(
+            cfg.window, bq, bk, n_k, offset - cfg.window + 1
+        )
+        # dkv iterates query tiles per KV block; first visible query row
+        # for block jk is jk*bk - offset.
+        n_q_grid, q_base = _restricted_grid(
+            cfg.window, bk, bq, n_q, -offset
+        )
 
     def kv_block(iq, jk):
         base = jk if kv_base is None else kv_base(iq) + jk

@@ -93,6 +93,40 @@ def test_converted_model_generates():
     assert out["tokens"].shape == (2, 5)
 
 
+def test_roundtrip_and_torch_load():
+    from shifu_tpu.models.convert import to_hf_llama_state_dict
+
+    hf = tiny_hf_llama()
+    model, params = from_hf_llama(hf)
+    sd = to_hf_llama_state_dict(params, model.cfg)
+    # Exact numeric round-trip against the original torch weights.
+    orig = hf.state_dict()
+    assert set(sd) == set(orig)
+    for k, v in sd.items():
+        np.testing.assert_allclose(
+            v, orig[k].float().numpy(), rtol=1e-6, atol=1e-7, err_msg=k
+        )
+    # And the exported dict loads back into transformers cleanly.
+    from transformers import LlamaForCausalLM
+
+    fresh = LlamaForCausalLM(hf.config)
+    fresh.load_state_dict({k: torch.from_numpy(v) for k, v in sd.items()})
+
+
+def test_roundtrip_tied_embeddings():
+    from shifu_tpu.models.convert import to_hf_llama_state_dict
+
+    hf = tiny_hf_llama(tie_word_embeddings=True)
+    model, params = from_hf_llama(hf)
+    assert model.cfg.tie_embeddings
+    sd = to_hf_llama_state_dict(params, model.cfg)
+    assert "lm_head.weight" in sd  # torch lists tied params twice
+    from transformers import LlamaForCausalLM
+
+    fresh = LlamaForCausalLM(hf.config)
+    fresh.load_state_dict({k: torch.from_numpy(v) for k, v in sd.items()})
+
+
 def test_missing_weight_errors():
     hf = tiny_hf_llama()
     cfg = config_from_hf_llama(hf.config)
