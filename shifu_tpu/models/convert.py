@@ -37,13 +37,22 @@ def _to_np(t) -> np.ndarray:
 def config_from_hf_llama(hf_config, **overrides) -> TransformerConfig:
     """TransformerConfig mirroring a transformers LlamaConfig."""
     scaling = getattr(hf_config, "rope_scaling", None)
-    if scaling and scaling.get("rope_type", scaling.get("type")) != "default":
-        # Llama-3.1-style frequency scaling is not implemented here;
-        # converting silently would give wrong logits at long context.
-        raise NotImplementedError(
-            f"rope_scaling={scaling!r} is not supported; only default "
-            "(unscaled) RoPE converts exactly"
-        )
+    rope_scaling = None
+    if scaling:
+        rope_type = scaling.get("rope_type", scaling.get("type"))
+        if rope_type == "llama3":
+            rope_scaling = (
+                float(scaling["factor"]),
+                float(scaling["low_freq_factor"]),
+                float(scaling["high_freq_factor"]),
+                int(scaling["original_max_position_embeddings"]),
+            )
+        elif rope_type != "default":
+            # linear/dynamic/yarn would convert to silently wrong logits.
+            raise NotImplementedError(
+                f"rope_scaling type {rope_type!r} is not supported "
+                "(implemented: default, llama3)"
+            )
     kw = dict(
         vocab_size=hf_config.vocab_size,
         dim=hf_config.hidden_size,
@@ -54,6 +63,7 @@ def config_from_hf_llama(hf_config, **overrides) -> TransformerConfig:
         mlp_dim=hf_config.intermediate_size,
         head_dim=getattr(hf_config, "head_dim", None),
         rope_theta=getattr(hf_config, "rope_theta", 10_000.0),
+        rope_scaling=rope_scaling,
         norm_eps=hf_config.rms_norm_eps,
         tie_embeddings=getattr(hf_config, "tie_word_embeddings", False),
         # Qwen2-style configs carry sliding_window but gate it off with

@@ -53,6 +53,9 @@ class TransformerConfig:
     mlp_dim: int = 8192
     head_dim: Optional[int] = None  # default: dim // n_heads
     rope_theta: float = 500_000.0
+    # Optional Llama-3.1-style RoPE frequency scaling:
+    # (factor, low_freq_factor, high_freq_factor, original_context_len).
+    rope_scaling: Optional[tuple] = None
     norm_eps: float = 1e-6
     tie_embeddings: bool = False
     z_loss: float = 1e-4
@@ -435,7 +438,8 @@ class Transformer(Module):
                 else:
                     positions = positions + cache_index
         sin, cos = rope_frequencies(
-            cfg.resolved_head_dim, positions, theta=cfg.rope_theta
+            cfg.resolved_head_dim, positions, theta=cfg.rope_theta,
+            scaling=cfg.rope_scaling,
         )
 
         block = self._block

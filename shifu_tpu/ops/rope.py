@@ -10,12 +10,38 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 
-def rope_frequencies(head_dim: int, positions, *, theta: float = 10000.0):
-    """Return (sin, cos) of shape positions.shape + (head_dim // 2,)."""
+def rope_frequencies(
+    head_dim: int,
+    positions,
+    *,
+    theta: float = 10000.0,
+    scaling=None,
+):
+    """Return (sin, cos) of shape positions.shape + (head_dim // 2,).
+
+    ``scaling``: optional Llama-3.1-style frequency scaling, a 4-tuple
+    ``(factor, low_freq_factor, high_freq_factor, original_context_len)``
+    — long-wavelength components are slowed by ``factor``, short ones
+    kept, and the band between smoothly interpolated (matches the HF
+    ``rope_type="llama3"`` implementation exactly).
+    """
     if head_dim % 2:
         raise ValueError(f"head_dim must be even, got {head_dim}")
     exponent = jnp.arange(head_dim // 2, dtype=jnp.float32) / (head_dim // 2)
     inv_freq = theta**-exponent  # (head_dim/2,)
+    if scaling is not None:
+        factor, low_fac, high_fac, orig_len = scaling
+        wavelen = 2.0 * jnp.pi / inv_freq
+        low_wl = orig_len / low_fac  # longest unscaled wavelength
+        high_wl = orig_len / high_fac
+        smooth = (orig_len / wavelen - low_fac) / (high_fac - low_fac)
+        smooth = jnp.clip(smooth, 0.0, 1.0)
+        mixed = (1.0 - smooth) * inv_freq / factor + smooth * inv_freq
+        inv_freq = jnp.where(
+            wavelen > low_wl,  # long wavelength: fully scaled
+            inv_freq / factor,
+            jnp.where(wavelen < high_wl, inv_freq, mixed),
+        )
     angles = positions.astype(jnp.float32)[..., None] * inv_freq
     return jnp.sin(angles), jnp.cos(angles)
 

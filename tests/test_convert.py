@@ -113,6 +113,40 @@ def test_roundtrip_and_torch_load():
     fresh.load_state_dict({k: torch.from_numpy(v) for k, v in sd.items()})
 
 
+def test_llama31_rope_scaling_parity():
+    # HF applies rope_type="llama3" frequency scaling; the converted
+    # model must match the torch forward with scaling active.
+    hf = tiny_hf_llama(
+        rope_scaling={
+            "rope_type": "llama3",
+            "factor": 8.0,
+            "low_freq_factor": 1.0,
+            "high_freq_factor": 4.0,
+            "original_max_position_embeddings": 32,
+        },
+        max_position_embeddings=64,
+    )
+    model, params = from_hf_llama(hf)
+    assert model.cfg.rope_scaling == (8.0, 1.0, 4.0, 32)
+    from shifu_tpu.core.dtypes import FULL_F32
+
+    model = Transformer(model.cfg, policy=FULL_F32)
+    tokens = np.random.RandomState(6).randint(0, 128, (1, 48))
+    with torch.no_grad():
+        want = hf(torch.tensor(tokens)).logits.float().numpy()
+    got = np.asarray(model(params, jnp.asarray(tokens, jnp.int32)))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_unsupported_rope_scaling_rejected():
+    from shifu_tpu.models.convert import config_from_hf_llama
+
+    hf = tiny_hf_llama()
+    hf.config.rope_scaling = {"rope_type": "yarn", "factor": 2.0}
+    with pytest.raises(NotImplementedError, match="yarn"):
+        config_from_hf_llama(hf.config)
+
+
 def test_roundtrip_tied_embeddings():
     from shifu_tpu.models.convert import to_hf_llama_state_dict
 
