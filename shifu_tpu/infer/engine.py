@@ -223,18 +223,30 @@ class Engine:
             lambda c: jax.lax.dynamic_slice_in_dim(c, slot, 1, axis=1),
             cache,
         )
-        # No kv_mask: right-padding is hidden from every real query by
-        # causality already, logits_at reads only the last real position,
-        # and decode's own `<= lengths` mask hides the padded cache slots
-        # later. Keeping the mask off lets the model take its local
-        # (flash-eligible) prefill fast path instead of scoring the
-        # bucket against the whole preallocated cache.
+        # A reused slot holds the PREVIOUS request's cache content. For
+        # attention caches that's provably never exposed (every slot is
+        # rewritten before the `<= lengths` mask reaches it), but a
+        # recurrent cache (Mamba's rolling conv/SSM state) would chain
+        # off it — zero the row for recurrent families only; skipping the
+        # memset keeps attention admission cheap.
+        if getattr(self.model, "prefill_needs_mask", False):
+            row = jax.tree_util.tree_map(jnp.zeros_like, row)
+        # Attention models skip the kv_mask: right-padding is hidden from
+        # every real query by causality, logits_at reads only the last
+        # real position, and decode's own `<= lengths` mask hides the
+        # padded cache slots later — keeping the mask off preserves the
+        # local (flash-eligible) prefill fast path. Recurrent models MUST
+        # mask: pad tokens would mutate the state (dt > 0).
+        prefill_kw = {}
+        if getattr(self.model, "prefill_needs_mask", False):
+            prefill_kw["kv_mask"] = (jnp.arange(bucket) < length)[None, :]
         logits, row = self.model(
             params,
             tokens[None, :],
             cache=row,
             cache_index=0,
             logits_at=(length - 1)[None],
+            **prefill_kw,
         )
         cache = jax.tree_util.tree_map(
             lambda c, r: jax.lax.dynamic_update_slice_in_dim(
