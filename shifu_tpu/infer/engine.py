@@ -223,22 +223,17 @@ class Engine:
             lambda c: jax.lax.dynamic_slice_in_dim(c, slot, 1, axis=1),
             cache,
         )
-        # A reused slot holds the PREVIOUS request's cache content. For
-        # attention caches that's provably never exposed (every slot is
-        # rewritten before the `<= lengths` mask reaches it), but a
-        # recurrent cache (Mamba's rolling conv/SSM state) would chain
-        # off it — zero the row for recurrent families only; skipping the
-        # memset keeps attention admission cheap.
-        if getattr(self.model, "prefill_needs_mask", False):
-            row = jax.tree_util.tree_map(jnp.zeros_like, row)
-        # Attention models skip the kv_mask: right-padding is hidden from
-        # every real query by causality, logits_at reads only the last
-        # real position, and decode's own `<= lengths` mask hides the
-        # padded cache slots later — keeping the mask off preserves the
-        # local (flash-eligible) prefill fast path. Recurrent models MUST
-        # mask: pad tokens would mutate the state (dt > 0).
+        # Recurrent families (prefill_needs_mask) need two things an
+        # attention cache provably does not: a ZERO row at admission (a
+        # reused slot's rolling conv/SSM state would chain into the new
+        # request; attention slots are always rewritten before the
+        # `<= lengths` mask exposes them, so they skip the memset) and a
+        # validity mask at prefill (pad tokens would mutate the state,
+        # dt > 0; attention hides right-padding via causality and keeps
+        # its flash-eligible local fast path by NOT passing a mask).
         prefill_kw = {}
         if getattr(self.model, "prefill_needs_mask", False):
+            row = jax.tree_util.tree_map(jnp.zeros_like, row)
             prefill_kw["kv_mask"] = (jnp.arange(bucket) < length)[None, :]
         logits, row = self.model(
             params,

@@ -39,6 +39,7 @@ def pipeline_apply(
     stacked_params: Any,
     x: jax.Array,
     extras: Any = None,
+    mb_extras: Any = None,
     *,
     mesh: Mesh,
     axis: str = "pp",
@@ -56,6 +57,13 @@ def pipeline_apply(
         the pipeline. Batch/seq axes may be sharded over other mesh axes.
       extras: replicated-per-stage constants (e.g. rope sin/cos tables),
         passed to every layer invocation.
+      mb_extras: PER-MICROBATCH constants — a pytree with a leading M
+        axis (e.g. packed segment_ids, explicit positions). Each stage
+        indexes its CURRENT microbatch (t - stage) out of the replicated
+        tree, so per-microbatch data never rides the ring. When given,
+        ``layer_fn`` receives ``(extras, current_mb_extras)`` as its
+        third argument; with mb_extras=None the contract is unchanged
+        (plain ``extras``).
       mesh: mesh containing ``axis``.
       remat_stage: rematerialise each stage body in the backward pass.
 
@@ -67,18 +75,21 @@ def pipeline_apply(
     if n_stages == 1:
         # Degenerate pipeline: sequential scan, same contract (including
         # per-layer rematerialisation when requested).
-        step = lambda h, lp: layer_fn(lp, h, extras)
-        if remat_stage:
-            step = jax.checkpoint(step)
+        def one(mb, mbe):
+            eff = extras if mb_extras is None else (extras, mbe)
+            step = lambda h, lp: layer_fn(lp, h, eff)
+            if remat_stage:
+                step = jax.checkpoint(step)
 
-        def body(h, lp):
-            return step(h, lp), None
+            def body(h, lp):
+                return step(h, lp), None
 
-        def one(mb):
             out, _ = jax.lax.scan(body, mb, stacked_params)
             return out
 
-        return jax.lax.map(one, x)
+        if mb_extras is None:
+            return jax.lax.map(lambda mb: one(mb, None), x)
+        return jax.lax.map(lambda args: one(*args), (x, mb_extras))
 
     # XLA:CPU partitioner workaround: transposing a dtype convert on an
     # array that crosses the partial-manual shard_map boundary crashes the
@@ -93,7 +104,7 @@ def pipeline_apply(
         x = x.astype(jnp.float32)
 
     fn = _pipeline_fn(layer_fn, mesh, axis, remat_stage)
-    staged = fn(stacked_params, x, extras)
+    staged = fn(stacked_params, x, extras, mb_extras)
     out = staged[n_stages - 1]
     return out.astype(compute_dtype) if f32_boundary else out
 
@@ -141,7 +152,7 @@ def _build_pipeline_fn(layer_fn, mesh: Mesh, axis: str, remat_stage: bool):
     n_stages = mesh.shape[axis]
     perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
 
-    def shard_body(params_local, x_local, extras_local):
+    def shard_body(params_local, x_local, extras_local, mb_extras_local):
         stage = jax.lax.axis_index(axis)
         n_micro = x_local.shape[0]
         n_ticks = n_micro + n_stages - 1
@@ -150,9 +161,17 @@ def _build_pipeline_fn(layer_fn, mesh: Mesh, axis: str, remat_stage: bool):
         compute_dtype = jax.tree_util.tree_leaves(params_local)[0].dtype
         boundary_dtype = x_local.dtype
 
-        def run_stage(h):
+        def run_stage(h, mbe):
+            # Contract: layer_fn sees plain ``extras`` when no
+            # per-microbatch data exists, else the pair (extras, mbe).
+            eff = (
+                extras_local
+                if mb_extras_local is None
+                else (extras_local, mbe)
+            )
+
             def body(carry, lp):
-                return layer_fn(lp, carry, extras_local), None
+                return layer_fn(lp, carry, eff), None
 
             out, _ = jax.lax.scan(
                 body, h.astype(compute_dtype), params_local
@@ -169,7 +188,16 @@ def _build_pipeline_fn(layer_fn, mesh: Mesh, axis: str, remat_stage: bool):
                 x_local, jnp.clip(t, 0, n_micro - 1), 0, keepdims=False
             )
             h_in = jnp.where(stage == 0, mb, recv)
-            h_out = run_stage(h_in)
+            # Stage p processes microbatch (t - p) at tick t; index its
+            # per-microbatch constants out of the replicated tree.
+            my_mb = jnp.clip(t - stage, 0, n_micro - 1)
+            mbe = jax.tree_util.tree_map(
+                lambda a: jax.lax.dynamic_index_in_dim(
+                    a, my_mb, 0, keepdims=False
+                ),
+                mb_extras_local,
+            )
+            h_out = run_stage(h_in, mbe)
             # The last stage finishes microbatch (t - (P-1)) at tick t.
             emit = (stage == n_stages - 1) & (t >= n_stages - 1)
             idx = jnp.clip(t - (n_stages - 1), 0, n_micro - 1)
@@ -193,7 +221,7 @@ def _build_pipeline_fn(layer_fn, mesh: Mesh, axis: str, remat_stage: bool):
         jax.shard_map(
             shard_body,
             mesh=mesh,
-            in_specs=(P(axis), P(), P()),
+            in_specs=(P(axis), P(), P(), P()),
             out_specs=P(axis),  # leading per-stage axis
             axis_names={axis},
             check_vma=False,
@@ -234,29 +262,43 @@ def pipeline_loss_fn(
         remat_stage = getattr(cfg, "remat", True)
 
     def layer_fn(layer_p, h, extras):
-        sin, cos, segment_ids = extras
-        out, _, _ = model._block(layer_p, h, sin, cos, segment_ids, None, None)
+        # blocks_fn always passes mb_extras (possibly an empty dict), so
+        # the contract is uniformly ((sin?, cos?) shared, mbe dict).
+        shared, mbe = extras
+        sin = mbe.get("sin", shared[0] if shared else None)
+        cos = mbe.get("cos", shared[1] if shared else None)
+        seg = mbe.get("seg")
+        out, _, _ = model._block(layer_p, h, sin, cos, seg, None, None)
         return out
 
     def blocks_fn(stacked_blocks, h, sin, cos, segment_ids):
-        if segment_ids is not None:
-            # extras are per-stage constants; packing masks vary per
-            # microbatch and would need threading through the tick loop.
-            raise NotImplementedError(
-                "packed segment_ids are not supported on the pipelined "
-                "path yet; use the sharded scan path for packed batches"
-            )
         b, s, d = h.shape
         if b % microbatches:
             raise ValueError(
                 f"batch {b} not divisible into {microbatches} microbatches"
             )
-        h = h.reshape(microbatches, b // microbatches, s, d)
+        mb = b // microbatches
+        h = h.reshape(microbatches, mb, s, d)
+        # Per-ROW rope tables (explicit positions) and packed segments
+        # vary per microbatch: ship them via mb_extras so each stage
+        # indexes its current microbatch's slice. Shared rope tables
+        # (positions=None -> (s, hd/2)) stay replicated extras.
+        per_mb = {}
+        shared = (sin, cos)
+        if sin.ndim == 3:  # (b, s, hd/2): per-row positions
+            per_mb["sin"] = sin.reshape(microbatches, mb, *sin.shape[1:])
+            per_mb["cos"] = cos.reshape(microbatches, mb, *cos.shape[1:])
+            shared = None
+        if segment_ids is not None:
+            per_mb["seg"] = segment_ids.reshape(microbatches, mb, s)
+        # Always pass the (possibly empty) dict: zero extra pytree leaves,
+        # and layer_fn gets one uniform contract to unpack.
         h = pipeline_apply(
             layer_fn,
             stacked_blocks,
             h,
-            (sin, cos, None),
+            shared,
+            per_mb,
             mesh=mesh,
             axis=axis,
             remat_stage=remat_stage,
@@ -264,13 +306,6 @@ def pipeline_loss_fn(
         return h.reshape(b, s, d)
 
     def loss_fn(params, batch):
-        if batch.get("positions") is not None:
-            # positions vary per microbatch, but the rope tables ride the
-            # replicated per-stage extras. arange positions only.
-            raise NotImplementedError(
-                "explicit positions are not supported on the pipelined "
-                "path yet; use the sharded scan path"
-            )
         return model.loss(params, batch, blocks_fn=blocks_fn)
 
     return loss_fn
