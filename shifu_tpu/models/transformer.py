@@ -95,14 +95,8 @@ class TransformerConfig:
             raise ValueError(
                 f"remat_policy={self.remat_policy!r} (want 'dots' or 'full')"
             )
-        if self.window_size is not None:
-            if self.window_size < 1:
-                raise ValueError(f"window_size={self.window_size} must be >= 1")
-            if self.attn_impl not in ("xla", "flash"):
-                raise ValueError(
-                    "window_size requires attn_impl 'xla' or 'flash' (the "
-                    "ring path does not implement sliding windows yet)"
-                )
+        if self.window_size is not None and self.window_size < 1:
+            raise ValueError(f"window_size={self.window_size} must be >= 1")
 
     # -- presets --------------------------------------------------------------
     @classmethod

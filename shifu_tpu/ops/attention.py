@@ -62,23 +62,16 @@ def dot_product_attention(
         falls back to the O(S^2)-memory XLA path).
       window: sliding-window attention — query i sees only keys in
         (i - window, i], i.e. the last ``window`` positions INCLUDING
-        itself. Requires ``causal=True``; supported on the xla and flash
-        paths (the flash kernel additionally SKIPS out-of-window KV
-        blocks, making long-context windowed attention O(S·window));
-        ring raises rather than silently attending outside the window.
+        itself. Requires ``causal=True``. All impls support it: flash
+        SKIPS out-of-window KV blocks (O(S·window) compute); ring skips
+        fully-out-of-window ring chunks the same way (lax.cond per
+        visiting chunk).
 
     Returns:
       (batch, q_len, num_heads, head_dim) in q.dtype.
     """
     if window is not None and not causal:
         raise ValueError("window requires causal attention")
-    if window is not None and impl == "ring":
-        # The ring path has no out-of-window block skipping yet; refusing
-        # beats silently attending outside the window.
-        raise ValueError(
-            "impl='ring' does not support sliding windows yet; use "
-            "impl='xla' or impl='flash'"
-        )
     if impl == "flash":
         from shifu_tpu.ops.pallas.flash_attention import flash_attention
 
@@ -101,7 +94,7 @@ def dot_product_attention(
         if env is not None and ring_shardable(env.mesh, q.shape, k.shape):
             return ring_attention_sharded(
                 q, k, v, env.mesh, causal=causal, scale=scale,
-                segment_ids=segment_ids,
+                segment_ids=segment_ids, window=window,
             )
         impl = "xla"
     if impl != "xla":

@@ -19,11 +19,15 @@ Gradients flow through ``lax.scan`` + ``ppermute`` by plain autodiff
 rematerialised per ring step so the backward never stores P score
 matrices at once.
 
-Causal note: with contiguous sequence chunks, device i skips chunks
-j > i entirely (the `run` predicate), so late ring steps idle for early
-devices — the classic causal imbalance. The striped/zigzag layout that
-fixes it changes the data layout contract; see striped_offsets() for the
-planned extension.
+Causal note: with contiguous sequence chunks, device i's chunks
+j > i are entirely masked; the fold is skipped via ``lax.cond`` (the
+chunk still rides the ring — other devices need it), so late ring
+steps cost only the ppermute for early devices — the classic causal
+imbalance in time, but not in FLOPs. Sliding windows
+(``window``) extend the same skip: chunks entirely below
+``q_pos - window`` contribute nothing and their fold is skipped too,
+making long-context windowed ring attention O(S * window / P) compute
+per device.
 """
 
 from __future__ import annotations
@@ -76,6 +80,7 @@ def ring_attention(
     causal: bool = True,
     scale: Optional[float] = None,
     segment_ids: Optional[jax.Array] = None,
+    window: Optional[int] = None,
 ):
     """Per-shard ring attention; call inside shard_map over ``axis_name``.
 
@@ -87,9 +92,15 @@ def ring_attention(
       scale: score scale; defaults to head_dim ** -0.5.
       segment_ids: optional local (b, s_local) packing segments; the KV
         segment shard travels around the ring with its chunk.
+      window: sliding-window attention — query i sees keys in
+        (i - window, i] in GLOBAL positions. Requires ``causal``.
+        Chunks entirely out of window skip their fold (module
+        docstring), so compute scales with the window, not S.
 
     Returns: (b, s_local, h, d) in q.dtype.
     """
+    if window is not None and not causal:
+        raise ValueError("window requires causal attention")
     axis_size = jax.lax.axis_size(axis_name)
     my = jax.lax.axis_index(axis_name)
     b, s_local, h, d = q.shape
@@ -112,17 +123,20 @@ def ring_attention(
             allowed = jnp.logical_and(
                 allowed, (kv_pos[None, :] <= q_pos[:, None])[None]
             )
+        if window is not None:
+            allowed = jnp.logical_and(
+                allowed, (kv_pos[None, :] > q_pos[:, None] - window)[None]
+            )
         if segment_ids is not None:
             allowed = jnp.logical_and(
                 allowed, segment_ids[:, :, None] == ks_cur[:, None, :]
             )
         bias = jnp.where(allowed, 0.0, NEG_INF)
 
-        # Entirely-masked chunks (causal, src chunk strictly in the
-        # future) contribute m_t == NEG_INF everywhere; the exp() terms
-        # below zero them out, so no explicit skip is needed for
-        # correctness — XLA still does the matmuls, which is the causal
-        # imbalance documented in the module docstring.
+        # Partially-masked rows inside a relevant chunk contribute
+        # m_t == NEG_INF; the exp() terms below zero them out. Chunks
+        # masked ENTIRELY (causal future / out of window) never reach
+        # here — maybe_fold skips the fold via lax.cond.
         acc_t, m_t, l_t = _partial_attention(q, k_cur, v_cur, bias, scale)
         m_new = jnp.maximum(m, m_t)
         a_old = jnp.exp(m - m_new)
@@ -131,9 +145,32 @@ def ring_attention(
         l = l * a_old + l_t * a_new
         return m_new, l, acc
 
+    def maybe_fold(m, l, acc, k_cur, v_cur, ks_cur, t):
+        """Fold unless the chunk is entirely masked (causal future /
+        fully below the window), in which case pass (m, l, acc) through
+        untouched — lax.cond executes only one branch at runtime, so the
+        skipped chunk costs zero FLOPs (the ppermute still runs; other
+        devices need the chunk)."""
+        src = (my - t) % axis_size
+        relevant = jnp.bool_(True)
+        if causal:
+            relevant = src <= my  # chunk not strictly in the future
+            if window is not None:
+                # Newest key of the chunk still visible to the OLDEST
+                # local query: kv_max > q_min - window.
+                relevant = relevant & (
+                    (src + 1) * s_local - 1 > my * s_local - window
+                )
+        return jax.lax.cond(
+            relevant,
+            lambda ops: fold(*ops),
+            lambda ops: (ops[0], ops[1], ops[2]),
+            (m, l, acc, k_cur, v_cur, ks_cur, t),
+        )
+
     def step(carry, t):
         k_cur, v_cur, ks_cur, m, l, acc = carry
-        m, l, acc = fold(m, l, acc, k_cur, v_cur, ks_cur, t)
+        m, l, acc = maybe_fold(m, l, acc, k_cur, v_cur, ks_cur, t)
         k_nxt, v_nxt, ks_nxt = jax.lax.ppermute(
             (k_cur, v_cur, ks_cur), axis_name, perm
         )
@@ -159,7 +196,7 @@ def ring_attention(
             jax.checkpoint(step), carry, jnp.arange(axis_size - 1)
         )
     k_l, v_l, ks_l, m, l, acc = carry
-    m, l, acc = jax.checkpoint(fold)(
+    m, l, acc = jax.checkpoint(maybe_fold)(
         m, l, acc, k_l, v_l, ks_l, jnp.int32(axis_size - 1)
     )
     # A query sees every key exactly once around the ring, so for causal
@@ -213,6 +250,7 @@ def ring_attention_sharded(
     causal: bool = True,
     scale: Optional[float] = None,
     segment_ids: Optional[jax.Array] = None,
+    window: Optional[int] = None,
     batch_axes=("dp", "fsdp"),
     seq_axis: str = "sp",
     head_axis: str = "tp",
@@ -242,7 +280,7 @@ def ring_attention_sharded(
         segs = rest[0] if rest else None
         return ring_attention(
             q, k, v, axis_name=seq_axis, causal=causal, scale=scale,
-            segment_ids=segs,
+            segment_ids=segs, window=window,
         )
 
     return mapped(*args)

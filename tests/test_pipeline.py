@@ -192,9 +192,17 @@ def test_pipelined_packed_segments_match_scan(devices):
     seg = jnp.asarray(
         np.sort(rng.randint(0, 3, (4, 17)), axis=1), jnp.int32
     )
+    # Segment-relative position restarts: DISTINCT per row, so a stage
+    # indexing the wrong microbatch's sin/cos out of mb_extras changes
+    # the loss (identical rows would mask that bug).
+    seg_np = np.asarray(seg)
     pos = jnp.asarray(
-        np.tile(np.arange(17), (4, 1)), jnp.int32
-    )  # per-row positions exercise the mb-extras rope path
+        np.stack([
+            np.arange(17) - np.searchsorted(seg_np[r], seg_np[r])
+            for r in range(4)
+        ]),
+        jnp.int32,
+    )
     batch = {"tokens": tokens, "segment_ids": seg, "positions": pos}
 
     want, want_aux = model.loss(params, batch)

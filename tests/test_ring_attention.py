@@ -63,6 +63,58 @@ def test_ring_segment_ids():
     np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
 
 
+@pytest.mark.parametrize("window", [1, 5, 8, 24, 64])
+def test_ring_window_matches_global(window):
+    """Window boundaries off, on, and spanning the 8-token ring chunks —
+    including w=8 (exactly one chunk) where earlier chunks' folds are
+    entirely skipped via lax.cond."""
+    mesh = MeshPlan(sp=8).build(jax.devices())
+    b, s = 2, 64
+    q, k, v = _qkv(jax.random.key(4), b, s, 4, 2, 16)
+    ref = dot_product_attention(q, k, v, causal=True, window=window)
+    out = jax.jit(
+        lambda q, k, v: ring_attention_sharded(
+            q, k, v, mesh, causal=True, window=window
+        )
+    )(q, k, v)
+    np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
+
+
+def test_ring_window_gradients():
+    mesh = MeshPlan(sp=8).build(jax.devices())
+    q, k, v = _qkv(jax.random.key(5), 1, 64, 2, 2, 8)
+
+    def loss(ring):
+        def f(q, k, v):
+            o = (
+                ring_attention_sharded(q, k, v, mesh, causal=True, window=11)
+                if ring
+                else dot_product_attention(q, k, v, causal=True, window=11)
+            )
+            return jnp.sum(jnp.sin(o))
+
+        return f
+
+    g_ref = jax.grad(loss(False), argnums=(0, 1, 2))(q, k, v)
+    g_ring = jax.jit(jax.grad(loss(True), argnums=(0, 1, 2)))(q, k, v)
+    for a, b_ in zip(g_ref, g_ring):
+        np.testing.assert_allclose(a, b_, atol=1e-5, rtol=1e-5)
+
+
+def test_ring_window_with_segments():
+    mesh = MeshPlan(sp=8).build(jax.devices())
+    b, s = 2, 64
+    q, k, v = _qkv(jax.random.key(6), b, s, 4, 2, 16)
+    seg = jnp.where(jnp.arange(s) < 37, 0, 1)[None, :].repeat(b, 0)
+    ref = dot_product_attention(
+        q, k, v, causal=True, segment_ids=seg, window=9
+    )
+    out = ring_attention_sharded(
+        q, k, v, mesh, causal=True, segment_ids=seg, window=9
+    )
+    np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
+
+
 def test_ring_gradients_match_global():
     mesh = MeshPlan(sp=8).build(jax.devices())
     q, k, v = _qkv(jax.random.key(3), 1, 64, 2, 2, 8)

@@ -43,17 +43,15 @@ def test_window_matches_numpy_reference():
             )
 
 
-def test_window_rejected_on_ring():
+def test_window_requires_causal():
     q = jnp.zeros((1, 8, 2, 8))
-    with pytest.raises(ValueError, match="does not support sliding"):
-        dot_product_attention(q, q, q, impl="ring", window=4)
+    with pytest.raises(ValueError, match="causal"):
+        dot_product_attention(q, q, q, causal=False, window=4)
 
 
 def test_config_validation():
     with pytest.raises(ValueError, match="window_size"):
         TransformerConfig.tiny(window_size=0)
-    with pytest.raises(ValueError, match="ring"):
-        TransformerConfig.tiny(window_size=4, attn_impl="ring")
 
 
 @pytest.mark.parametrize("w,bq,bk", [(3, 16, 16), (20, 16, 16), (7, 8, 32)])
