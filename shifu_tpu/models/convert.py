@@ -42,16 +42,56 @@ def config_from_hf_llama(hf_config, **overrides) -> TransformerConfig:
         rope_type = scaling.get("rope_type", scaling.get("type"))
         if rope_type == "llama3":
             rope_scaling = (
+                "llama3",
                 float(scaling["factor"]),
                 float(scaling["low_freq_factor"]),
                 float(scaling["high_freq_factor"]),
                 int(scaling["original_max_position_embeddings"]),
             )
+        elif rope_type == "linear":
+            rope_scaling = ("linear", float(scaling["factor"]))
+        elif rope_type == "dynamic":
+            rope_scaling = (
+                "dynamic",
+                float(scaling["factor"]),
+                # HF's _compute_dynamic_ntk_parameters stretches relative
+                # to max_position_embeddings UNconditionally — the
+                # original_max_position_embeddings key is validated but
+                # unused there (explicit TODO in HF); honoring it here
+                # would silently diverge from the torch forward.
+                int(hf_config.max_position_embeddings),
+            )
+        elif rope_type == "yarn":
+            from shifu_tpu.ops.rope import get_mscale
+
+            # attention_factor resolution order mirrors HF: explicit >
+            # mscale/mscale_all_dim pair (DeepSeek convention) > derived
+            # from factor inside rope_frequencies (None).
+            attn_factor = scaling.get("attention_factor")
+            mscale = scaling.get("mscale")
+            mscale_all = scaling.get("mscale_all_dim")
+            if attn_factor is None and mscale and mscale_all:
+                factor = float(scaling["factor"])
+                attn_factor = get_mscale(factor, mscale) / get_mscale(
+                    factor, mscale_all
+                )
+            rope_scaling = (
+                "yarn",
+                float(scaling["factor"]),
+                float(scaling.get("beta_fast") or 32.0),
+                float(scaling.get("beta_slow") or 1.0),
+                int(
+                    scaling.get("original_max_position_embeddings")
+                    or hf_config.max_position_embeddings
+                ),
+                None if attn_factor is None else float(attn_factor),
+                bool(scaling.get("truncate", True)),
+            )
         elif rope_type != "default":
-            # linear/dynamic/yarn would convert to silently wrong logits.
+            # longrope etc. would convert to silently wrong logits.
             raise NotImplementedError(
                 f"rope_scaling type {rope_type!r} is not supported "
-                "(implemented: default, llama3)"
+                "(implemented: default, linear, dynamic, yarn, llama3)"
             )
     kw = dict(
         vocab_size=hf_config.vocab_size,

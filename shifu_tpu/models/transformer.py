@@ -53,8 +53,11 @@ class TransformerConfig:
     mlp_dim: int = 8192
     head_dim: Optional[int] = None  # default: dim // n_heads
     rope_theta: float = 500_000.0
-    # Optional Llama-3.1-style RoPE frequency scaling:
-    # (factor, low_freq_factor, high_freq_factor, original_context_len).
+    # Optional RoPE context-extension scaling — a tagged tuple, e.g.
+    # ("linear", factor), ("dynamic", factor, orig_len),
+    # ("yarn", factor, beta_fast, beta_slow, orig_len, attn_factor),
+    # ("llama3", factor, low_freq, high_freq, orig_len); a legacy bare
+    # 4-tuple means llama3. Semantics: ops/rope.py module docstring.
     rope_scaling: Optional[tuple] = None
     norm_eps: float = 1e-6
     tie_embeddings: bool = False

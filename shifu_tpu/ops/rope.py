@@ -2,12 +2,96 @@
 
 Split-half convention (first half of head_dim pairs with second half), f32
 rotation math. Frequencies are computed once per forward at trace time —
-they are constants under jit, so XLA hoists them.
+they are constants under jit, so XLA hoists them (the "dynamic" NTK
+variant alone depends on the *values* of positions and stays a traced
+computation).
+
+Context-extension frequency scaling (``scaling``) follows the
+HuggingFace ``rope_type`` semantics exactly (verified against
+``transformers.modeling_rope_utils`` in tests/test_ops.py) so converted
+checkpoints keep their logits. Supported, as hashable tagged tuples
+(dataclass-config friendly — dicts are not hashable):
+
+  ``("linear", factor)``
+      Position-interpolation: every frequency divided by ``factor``.
+  ``("dynamic", factor, original_context_len)``
+      Dynamic NTK: the wavelength base is stretched as the sequence
+      grows past the original context, ``base' = base * ((factor *
+      L / orig - (factor - 1)) ** (d / (d - 2)))`` with L the largest
+      position in this call (>= orig).
+  ``("yarn", factor, beta_fast, beta_slow, original_context_len,
+     attention_factor[, truncate])``
+      YaRN (arXiv 2309.00071): interpolate low-frequency dims by
+      ``factor``, keep high-frequency dims, linear-ramp between the
+      correction dims found from beta_fast/beta_slow rotations; cos/sin
+      additionally scaled by ``attention_factor`` (None = the paper's
+      ``0.1 * ln(factor) + 1``). ``truncate`` (default True) floors/
+      ceils the correction dims as HF does; False keeps them fractional.
+  ``("llama3", factor, low_freq_factor, high_freq_factor,
+     original_context_len)``
+      Llama-3.1 wavelength-banded scaling. A legacy bare 4-tuple of
+      numbers means the same thing.
 """
 
 from __future__ import annotations
 
+import math
+
 import jax.numpy as jnp
+
+
+def _llama3_inv_freq(inv_freq, factor, low_fac, high_fac, orig_len):
+    wavelen = 2.0 * jnp.pi / inv_freq
+    low_wl = orig_len / low_fac  # longest unscaled wavelength
+    high_wl = orig_len / high_fac
+    smooth = (orig_len / wavelen - low_fac) / (high_fac - low_fac)
+    smooth = jnp.clip(smooth, 0.0, 1.0)
+    mixed = (1.0 - smooth) * inv_freq / factor + smooth * inv_freq
+    return jnp.where(
+        wavelen > low_wl,  # long wavelength: fully scaled
+        inv_freq / factor,
+        jnp.where(wavelen < high_wl, inv_freq, mixed),
+    )
+
+
+def get_mscale(scale: float, m: float = 1.0) -> float:
+    """YaRN attention-temperature scale: 0.1·m·ln(scale) + 1 (1 if
+    scale <= 1). Single home for the formula — convert.py's DeepSeek
+    mscale/mscale_all_dim path uses it too."""
+    return 0.1 * m * math.log(scale) + 1.0 if scale > 1 else 1.0
+
+
+def _yarn_inv_freq(
+    head_dim, theta, factor, beta_fast, beta_slow, orig_len, truncate=True
+):
+    def correction_dim(n_rot):
+        # Dim whose wavelength completes n_rot rotations over orig_len.
+        return (
+            head_dim
+            * math.log(orig_len / (n_rot * 2 * math.pi))
+            / (2 * math.log(theta))
+        )
+
+    low, high = correction_dim(beta_fast), correction_dim(beta_slow)
+    if truncate:
+        low, high = math.floor(low), math.ceil(high)
+    low = max(low, 0)
+    high = min(high, head_dim - 1)
+    if low == high:
+        high += 0.001  # avoid the ramp singularity (HF convention)
+    ramp = jnp.clip(
+        (jnp.arange(head_dim // 2, dtype=jnp.float32) - low) / (high - low),
+        0.0,
+        1.0,
+    )
+    extrap_frac = 1.0 - ramp  # 1 at high-frequency dims: keep as-is
+    exponent = (
+        jnp.arange(head_dim // 2, dtype=jnp.float32) / (head_dim // 2)
+    )
+    pos_freq = theta**exponent
+    return (1.0 / (factor * pos_freq)) * (1.0 - extrap_frac) + (
+        1.0 / pos_freq
+    ) * extrap_frac
 
 
 def rope_frequencies(
@@ -19,38 +103,59 @@ def rope_frequencies(
 ):
     """Return (sin, cos) of shape positions.shape + (head_dim // 2,).
 
-    ``scaling``: optional Llama-3.1-style frequency scaling, a 4-tuple
-    ``(factor, low_freq_factor, high_freq_factor, original_context_len)``
-    — long-wavelength components are slowed by ``factor``, short ones
-    kept, and the band between smoothly interpolated (matches the HF
-    ``rope_type="llama3"`` implementation exactly).
+    ``scaling``: optional context-extension frequency scaling — a tagged
+    tuple, see the module docstring for the supported variants.
     """
     if head_dim % 2:
         raise ValueError(f"head_dim must be even, got {head_dim}")
     exponent = jnp.arange(head_dim // 2, dtype=jnp.float32) / (head_dim // 2)
     inv_freq = theta**-exponent  # (head_dim/2,)
+    mscale = 1.0
     if scaling is not None:
-        factor, low_fac, high_fac, orig_len = scaling
-        wavelen = 2.0 * jnp.pi / inv_freq
-        low_wl = orig_len / low_fac  # longest unscaled wavelength
-        high_wl = orig_len / high_fac
-        smooth = (orig_len / wavelen - low_fac) / (high_fac - low_fac)
-        smooth = jnp.clip(smooth, 0.0, 1.0)
-        mixed = (1.0 - smooth) * inv_freq / factor + smooth * inv_freq
-        inv_freq = jnp.where(
-            wavelen > low_wl,  # long wavelength: fully scaled
-            inv_freq / factor,
-            jnp.where(wavelen < high_wl, inv_freq, mixed),
-        )
+        kind, args = scaling[0], scaling[1:]
+        if not isinstance(kind, str):  # legacy bare 4-tuple = llama3
+            kind, args = "llama3", tuple(scaling)
+        if kind == "llama3":
+            inv_freq = _llama3_inv_freq(inv_freq, *args)
+        elif kind == "linear":
+            (factor,) = args
+            inv_freq = inv_freq / factor
+        elif kind == "dynamic":
+            factor, orig_len = args
+            # Traced, value-dependent: the base stretches with the
+            # longest position actually used in this call.
+            seq_len = jnp.maximum(
+                jnp.max(positions).astype(jnp.float32) + 1.0,
+                float(orig_len),
+            )
+            base = theta * (factor * seq_len / orig_len - (factor - 1.0)) ** (
+                head_dim / (head_dim - 2)
+            )
+            inv_freq = base**-exponent
+        elif kind == "yarn":
+            factor, beta_fast, beta_slow, orig_len, attn_factor = args[:5]
+            truncate = args[5] if len(args) > 5 else True
+            inv_freq = _yarn_inv_freq(
+                head_dim, theta, factor, beta_fast, beta_slow, orig_len,
+                truncate,
+            )
+            mscale = (
+                attn_factor if attn_factor is not None else get_mscale(factor)
+            )
+        else:
+            raise ValueError(f"unknown rope scaling kind {kind!r}")
     angles = positions.astype(jnp.float32)[..., None] * inv_freq
-    return jnp.sin(angles), jnp.cos(angles)
+    return jnp.sin(angles) * mscale, jnp.cos(angles) * mscale
 
 
 def apply_rope(x, sin, cos):
     """Rotate ``x`` of shape (..., seq, heads, head_dim).
 
     ``sin``/``cos`` have shape (..., seq, head_dim // 2); a heads axis is
-    inserted for broadcast.
+    inserted for broadcast. YaRN's attention_factor is pre-folded into
+    the sin/cos tables (rope_frequencies), exactly as HF does — rotating
+    both q and k with the scaled tables yields the attention-temperature
+    scaling of the YaRN paper.
     """
     sin = sin[..., :, None, :]
     cos = cos[..., :, None, :]

@@ -127,11 +127,50 @@ def test_llama31_rope_scaling_parity():
         max_position_embeddings=64,
     )
     model, params = from_hf_llama(hf)
-    assert model.cfg.rope_scaling == (8.0, 1.0, 4.0, 32)
-    from shifu_tpu.core.dtypes import FULL_F32
+    assert model.cfg.rope_scaling == ("llama3", 8.0, 1.0, 4.0, 32)
 
     model = Transformer(model.cfg, policy=FULL_F32)
     tokens = np.random.RandomState(6).randint(0, 128, (1, 48))
+    with torch.no_grad():
+        want = hf(torch.tensor(tokens)).logits.float().numpy()
+    got = np.asarray(model(params, jnp.asarray(tokens, jnp.int32)))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("scaling", [
+    {"rope_type": "linear", "factor": 4.0},
+    {"rope_type": "dynamic", "factor": 4.0},
+    # HF ignores original_max_position_embeddings for dynamic (stretch
+    # reference is max_position_embeddings unconditionally); conversion
+    # must match that, not the key.
+    {
+        "rope_type": "dynamic",
+        "factor": 4.0,
+        "original_max_position_embeddings": 16,
+    },
+    {
+        "rope_type": "yarn",
+        "factor": 4.0,
+        "beta_fast": 32.0,
+        "beta_slow": 1.0,
+        "original_max_position_embeddings": 32,
+    },
+    # truncate=False keeps fractional correction dims — different ramp.
+    {
+        "rope_type": "yarn",
+        "factor": 4.0,
+        "truncate": False,
+        "original_max_position_embeddings": 32,
+    },
+])
+def test_rope_scaling_variants_parity(scaling):
+    # Each rope_type must match the torch forward with scaling active —
+    # seq 48 > orig 32 so dynamic-NTK actually stretches and yarn's
+    # interpolation band is exercised.
+    hf = tiny_hf_llama(rope_scaling=scaling, max_position_embeddings=32)
+    model, params = from_hf_llama(hf)
+    model = Transformer(model.cfg, policy=FULL_F32)
+    tokens = np.random.RandomState(7).randint(0, 128, (1, 48))
     with torch.no_grad():
         want = hf(torch.tensor(tokens)).logits.float().numpy()
     got = np.asarray(model(params, jnp.asarray(tokens, jnp.int32)))
@@ -142,8 +181,13 @@ def test_unsupported_rope_scaling_rejected():
     from shifu_tpu.models.convert import config_from_hf_llama
 
     hf = tiny_hf_llama()
-    hf.config.rope_scaling = {"rope_type": "yarn", "factor": 2.0}
-    with pytest.raises(NotImplementedError, match="yarn"):
+    hf.config.rope_scaling = {
+        "rope_type": "longrope",
+        "factor": 2.0,
+        "short_factor": [1.0] * 4,
+        "long_factor": [2.0] * 4,
+    }
+    with pytest.raises(NotImplementedError, match="longrope"):
         config_from_hf_llama(hf.config)
 
 

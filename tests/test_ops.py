@@ -68,6 +68,83 @@ def test_rope_relative_property():
     np.testing.assert_allclose(dot_at(5, 2), dot_at(13, 10), rtol=1e-5)
 
 
+def test_rope_scaling_matches_hf_rope_utils():
+    """Pin inv_freq (and yarn's attention factor) numerics to HF's
+    modeling_rope_utils for every supported rope_type, independent of
+    any model forward."""
+    import pytest
+
+    transformers = pytest.importorskip("transformers")
+    from transformers.modeling_rope_utils import ROPE_INIT_FUNCTIONS
+
+    head_dim, theta, orig = 16, 10_000.0, 32
+
+    class _Cfg:
+        rope_theta = theta
+        hidden_size = head_dim * 4
+        num_attention_heads = 4
+        max_position_embeddings = orig
+
+    cases = {
+        "linear": ({"factor": 4.0}, ("linear", 4.0), None),
+        "dynamic": ({"factor": 4.0}, ("dynamic", 4.0, orig), 48),
+        "yarn": (
+            {"factor": 4.0, "original_max_position_embeddings": orig},
+            ("yarn", 4.0, 32.0, 1.0, orig, None),
+            None,
+        ),
+        "llama3": (
+            {
+                "factor": 8.0,
+                "low_freq_factor": 1.0,
+                "high_freq_factor": 4.0,
+                "original_max_position_embeddings": orig,
+            },
+            ("llama3", 8.0, 1.0, 4.0, orig),
+            None,
+        ),
+    }
+    for rope_type, (hf_kw, ours, seq_len) in cases.items():
+        cfg = _Cfg()
+        cfg.rope_scaling = {"rope_type": rope_type, **hf_kw}
+        inv_hf, att_hf = ROPE_INIT_FUNCTIONS[rope_type](
+            cfg, device="cpu", seq_len=seq_len
+        )
+        s = seq_len or orig
+        pos = jnp.arange(s)
+        sin, cos = rope_frequencies(head_dim, pos, theta=theta, scaling=ours)
+        want_cos = np.cos(
+            np.arange(s)[:, None] * inv_hf.numpy()[None, :]
+        ) * att_hf
+        np.testing.assert_allclose(
+            np.asarray(cos), want_cos, rtol=1e-5, atol=1e-6,
+            err_msg=rope_type,
+        )
+
+
+def test_rope_legacy_bare_tuple_is_llama3():
+    pos = jnp.arange(16)
+    legacy = rope_frequencies(
+        16, pos, theta=10_000.0, scaling=(8.0, 1.0, 4.0, 32)
+    )
+    tagged = rope_frequencies(
+        16, pos, theta=10_000.0, scaling=("llama3", 8.0, 1.0, 4.0, 32)
+    )
+    for a, b in zip(legacy, tagged):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+
+
+def test_rope_dynamic_below_original_is_unscaled():
+    # Sequences within the original context must see vanilla frequencies.
+    pos = jnp.arange(16)
+    plain = rope_frequencies(16, pos, theta=10_000.0)
+    dyn = rope_frequencies(
+        16, pos, theta=10_000.0, scaling=("dynamic", 4.0, 32)
+    )
+    for a, b in zip(plain, dyn):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+
 # ---------------- attention ----------------
 
 def _ref_attention(q, k, v, causal=True):
