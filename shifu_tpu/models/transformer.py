@@ -397,10 +397,12 @@ class Transformer(Module):
             {"lb", "rz", "dropped"}; None for a dense model). Training-path
             only — unsupported together with ``cache``.
           blocks_fn: optional override for the block-stack execution:
-            ``(stacked_block_params, h, sin, cos, segment_ids) -> h``. The
-            pipeline engine (parallel.pipeline) injects its schedule here
-            so embed/rope/norm/unembed/loss stay this method's single
-            implementation. Training path only (no cache), dense only.
+            ``(stacked_block_params, h, sin, cos, segment_ids) -> h``, or
+            ``-> (h, moe_aux)`` for an MoE config (aux = pytree of f32
+            scalars, already averaged over layers). The pipeline engine
+            (parallel.pipeline) injects its schedule here so embed/rope/
+            norm/unembed/loss stay this method's single implementation.
+            Training path only (no cache).
 
         Returns:
           (logits, new_cache) if cache is not None else logits; with
@@ -450,13 +452,20 @@ class Transformer(Module):
 
         if cache is None:
             if blocks_fn is not None:
+                out = blocks_fn(p["blocks"], h, sin, cos, segment_ids)
+                # MoE overrides return (h, aux-scalars); tree_map(mean)
+                # below is then an identity on already-averaged scalars.
                 if cfg.n_experts:
-                    raise NotImplementedError(
-                        "blocks_fn override does not support MoE blocks "
-                        "(aux losses cannot flow through the override)"
-                    )
-                h = blocks_fn(p["blocks"], h, sin, cos, segment_ids)
-                auxes = None
+                    if not (isinstance(out, tuple) and len(out) == 2):
+                        # A bare array would tuple-unpack along its
+                        # leading axis into garbage h/aux — fail fast.
+                        raise TypeError(
+                            "blocks_fn must return (h, moe_aux) for an "
+                            f"MoE config, got {type(out).__name__}"
+                        )
+                    h, auxes = out
+                else:
+                    h, auxes = out, None
             else:
                 def body(carry, layer_p):
                     out, _, aux = block(

@@ -44,12 +44,16 @@ def pipeline_apply(
     mesh: Mesh,
     axis: str = "pp",
     remat_stage: bool = True,
+    has_aux: bool = False,
 ):
     """Run microbatches through pp-sharded stacked layers, pipelined.
 
     Args:
       layer_fn: ``(layer_params, h, extras) -> h`` — ONE layer;  each
-        stage scans it over its local slice of the stacked axis.
+        stage scans it over its local slice of the stacked axis. With
+        ``has_aux``, returns ``(h, aux)`` where aux is a pytree of f32
+        SCALARS (e.g. MoE load-balance losses); pipeline_apply returns
+        their mean over all (layer, microbatch) applications.
       stacked_params: pytree whose leaves have a leading layer axis of
         extent L with ``L % pp == 0``. May carry any dp/fsdp/tp sharding
         on later axes (those stay automatic).
@@ -66,10 +70,13 @@ def pipeline_apply(
         (plain ``extras``).
       mesh: mesh containing ``axis``.
       remat_stage: rematerialise each stage body in the backward pass.
+      has_aux: layer_fn returns (h, aux-scalars); see above.
 
     Returns:
       (M, mb, ...) outputs — the result of applying all L layers to every
       microbatch, numerically equal to a sequential scan over layers.
+      With ``has_aux``: ``(outputs, aux)`` where aux is the layer- and
+      microbatch-mean of layer_fn's aux pytree.
     """
     n_stages = mesh.shape[axis]
     if n_stages == 1:
@@ -82,14 +89,23 @@ def pipeline_apply(
                 step = jax.checkpoint(step)
 
             def body(h, lp):
-                return step(h, lp), None
+                out = step(h, lp)
+                return (out[0], out[1]) if has_aux else (out, None)
 
-            out, _ = jax.lax.scan(body, mb, stacked_params)
+            out, auxes = jax.lax.scan(body, mb, stacked_params)
+            if has_aux:  # mean over this microbatch's layers
+                return out, jax.tree_util.tree_map(jnp.mean, auxes)
             return out
 
-        if mb_extras is None:
-            return jax.lax.map(lambda mb: one(mb, None), x)
-        return jax.lax.map(lambda args: one(*args), (x, mb_extras))
+        mapped = (
+            jax.lax.map(lambda mb: one(mb, None), x)
+            if mb_extras is None
+            else jax.lax.map(lambda args: one(*args), (x, mb_extras))
+        )
+        if has_aux:
+            out, auxes = mapped
+            return out, jax.tree_util.tree_map(jnp.mean, auxes)
+        return mapped
 
     # XLA:CPU partitioner workaround: transposing a dtype convert on an
     # array that crosses the partial-manual shard_map boundary crashes the
@@ -103,13 +119,27 @@ def pipeline_apply(
     if f32_boundary:
         x = x.astype(jnp.float32)
 
-    fn = _pipeline_fn(layer_fn, mesh, axis, remat_stage)
+    fn = _pipeline_fn(layer_fn, mesh, axis, remat_stage, has_aux)
     staged = fn(stacked_params, x, extras, mb_extras)
+    if has_aux:
+        staged, aux_stages = staged
+        # Per-stage aux sums (leading pp axis, one entry per stage) add
+        # up to the total over all (layer, microbatch) applications;
+        # normalise to the mean. Summing OUTSIDE the manual region
+        # avoids an in-region psum (and its XLA:CPU partitioner issues).
+        n_layers = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+        n_micro = x.shape[0]
+        aux = jax.tree_util.tree_map(
+            lambda a: jnp.sum(a, axis=0) / (n_layers * n_micro), aux_stages
+        )
     out = staged[n_stages - 1]
-    return out.astype(compute_dtype) if f32_boundary else out
+    out = out.astype(compute_dtype) if f32_boundary else out
+    return (out, aux) if has_aux else out
 
 
-def _pipeline_fn(layer_fn, mesh: Mesh, axis: str, remat_stage: bool):
+def _pipeline_fn(
+    layer_fn, mesh: Mesh, axis: str, remat_stage: bool, has_aux: bool
+):
     """The jitted pipelined program, cached per (layer_fn, mesh, axis).
 
     Everything shape-dependent (microbatch count, tick count, dtypes) is
@@ -139,16 +169,20 @@ def _pipeline_fn(layer_fn, mesh: Mesh, axis: str, remat_stage: bool):
             _FALLBACK_CACHE[layer_fn] = cache  # (re)insert most-recent
             while len(_FALLBACK_CACHE) > 8:
                 _FALLBACK_CACHE.pop(next(iter(_FALLBACK_CACHE)))
-    key = (mesh, axis, remat_stage)
+    key = (mesh, axis, remat_stage, has_aux)
     if key not in cache:
-        cache[key] = _build_pipeline_fn(layer_fn, mesh, axis, remat_stage)
+        cache[key] = _build_pipeline_fn(
+            layer_fn, mesh, axis, remat_stage, has_aux
+        )
     return cache[key]
 
 
 _FALLBACK_CACHE: dict = {}
 
 
-def _build_pipeline_fn(layer_fn, mesh: Mesh, axis: str, remat_stage: bool):
+def _build_pipeline_fn(
+    layer_fn, mesh: Mesh, axis: str, remat_stage: bool, has_aux: bool
+):
     n_stages = mesh.shape[axis]
     perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
 
@@ -171,18 +205,28 @@ def _build_pipeline_fn(layer_fn, mesh: Mesh, axis: str, remat_stage: bool):
             )
 
             def body(carry, lp):
-                return layer_fn(lp, carry, eff), None
+                out = layer_fn(lp, carry, eff)
+                return (out[0], out[1]) if has_aux else (out, None)
 
-            out, _ = jax.lax.scan(
+            out, auxes = jax.lax.scan(
                 body, h.astype(compute_dtype), params_local
             )
-            return out.astype(boundary_dtype)
+            # Aux: SUM over this stage's local layers (normalised to a
+            # mean once, outside the manual region).
+            stage_aux = (
+                jax.tree_util.tree_map(
+                    lambda a: jnp.sum(a.astype(jnp.float32)), auxes
+                )
+                if has_aux
+                else None
+            )
+            return out.astype(boundary_dtype), stage_aux
 
         if remat_stage:
             run_stage = jax.checkpoint(run_stage)
 
         def tick(carry, t):
-            prev_out, out_buf = carry
+            prev_out, out_buf, aux_acc = carry
             recv = jax.lax.ppermute(prev_out, axis, perm)
             mb = jax.lax.dynamic_index_in_dim(
                 x_local, jnp.clip(t, 0, n_micro - 1), 0, keepdims=False
@@ -197,7 +241,16 @@ def _build_pipeline_fn(layer_fn, mesh: Mesh, axis: str, remat_stage: bool):
                 ),
                 mb_extras_local,
             )
-            h_out = run_stage(h_in, mbe)
+            h_out, stage_aux = run_stage(h_in, mbe)
+            if has_aux:
+                # Fill/drain ticks run on a clipped (garbage) microbatch;
+                # only real ones count toward the aux sums.
+                real = (t >= stage) & (t - stage <= n_micro - 1)
+                aux_acc = jax.tree_util.tree_map(
+                    lambda acc, a: acc + jnp.where(real, a, 0.0),
+                    aux_acc,
+                    stage_aux,
+                )
             # The last stage finishes microbatch (t - (P-1)) at tick t.
             emit = (stage == n_stages - 1) & (t >= n_stages - 1)
             idx = jnp.clip(t - (n_stages - 1), 0, n_micro - 1)
@@ -205,15 +258,31 @@ def _build_pipeline_fn(layer_fn, mesh: Mesh, axis: str, remat_stage: bool):
             out_buf = jax.lax.dynamic_update_index_in_dim(
                 out_buf, jnp.where(emit, h_out, cur), idx, 0
             )
-            return (h_out, out_buf), None
+            return (h_out, out_buf, aux_acc), None
 
-        init = (jnp.zeros_like(x_local[0]), jnp.zeros_like(x_local))
-        (_, out_buf), _ = jax.lax.scan(tick, init, jnp.arange(n_ticks))
+        aux0 = None
+        if has_aux:
+            mbe0 = jax.tree_util.tree_map(
+                lambda a: a[0], mb_extras_local
+            )
+            aux_shapes = jax.eval_shape(run_stage, x_local[0], mbe0)[1]
+            aux0 = jax.tree_util.tree_map(
+                lambda s: jnp.zeros(s.shape, s.dtype), aux_shapes
+            )
+        init = (jnp.zeros_like(x_local[0]), jnp.zeros_like(x_local), aux0)
+        (_, out_buf, aux_acc), _ = jax.lax.scan(
+            tick, init, jnp.arange(n_ticks)
+        )
         # Only the last stage holds real outputs. Return with a leading
         # per-stage axis (out_specs puts pp there) and let the caller
         # slice stage P-1 — a plain resharding outside the manual region,
         # cheaper than an in-region psum broadcast (and it sidesteps an
         # XLA:CPU partitioner crash on bf16 psum of a replicated operand).
+        # Aux sums get the same per-stage axis; the caller adds them up.
+        if has_aux:
+            return out_buf[None], jax.tree_util.tree_map(
+                lambda a: a[None], aux_acc
+            )
         return out_buf[None]
 
     # Specs are pytree prefixes: one spec covers each whole argument tree.
@@ -249,15 +318,13 @@ def pipeline_loss_fn(
     batch axis (b % microbatches == 0).
 
     ``remat_stage`` defaults to the model config's ``remat``. Supports the
-    dense Transformer training path (no KV cache; MoE dispatch inside a
-    pipeline stage needs its own schedule).
+    Transformer training path (no KV cache), dense or MoE — MoE blocks'
+    expert buffers keep their ep sharding inside a stage (constrain is
+    partial-manual aware), and the router aux losses ride pipeline_apply's
+    ``has_aux`` path back to ``model.loss``.
     """
     cfg = model.cfg
-    if getattr(cfg, "n_experts", 0):
-        raise NotImplementedError(
-            "pipelined MoE is not supported yet: run MoE models with "
-            "ep/fsdp sharding instead"
-        )
+    has_aux = bool(getattr(cfg, "n_experts", 0))
     if remat_stage is None:
         remat_stage = getattr(cfg, "remat", True)
 
@@ -268,8 +335,8 @@ def pipeline_loss_fn(
         sin = mbe.get("sin", shared[0] if shared else None)
         cos = mbe.get("cos", shared[1] if shared else None)
         seg = mbe.get("seg")
-        out, _, _ = model._block(layer_p, h, sin, cos, seg, None, None)
-        return out
+        out, _, aux = model._block(layer_p, h, sin, cos, seg, None, None)
+        return (out, aux) if has_aux else out
 
     def blocks_fn(stacked_blocks, h, sin, cos, segment_ids):
         b, s, d = h.shape
@@ -293,7 +360,7 @@ def pipeline_loss_fn(
             per_mb["seg"] = segment_ids.reshape(microbatches, mb, s)
         # Always pass the (possibly empty) dict: zero extra pytree leaves,
         # and layer_fn gets one uniform contract to unpack.
-        h = pipeline_apply(
+        out = pipeline_apply(
             layer_fn,
             stacked_blocks,
             h,
@@ -302,8 +369,12 @@ def pipeline_loss_fn(
             mesh=mesh,
             axis=axis,
             remat_stage=remat_stage,
+            has_aux=has_aux,
         )
-        return h.reshape(b, s, d)
+        if has_aux:
+            h, aux = out
+            return h.reshape(b, s, d), aux
+        return out.reshape(b, s, d)
 
     def loss_fn(params, batch):
         return model.loss(params, batch, blocks_fn=blocks_fn)
