@@ -8,7 +8,7 @@ is the standard prefill + KV-cache decode design, TPU-first (static shapes,
 
 from shifu_tpu.infer.sampling import SampleConfig, sample_logits
 from shifu_tpu.infer.generate import generate, make_generate_fn
-from shifu_tpu.infer.engine import Completion, Engine
+from shifu_tpu.infer.engine import Completion, Engine, PagedEngine
 from shifu_tpu.infer.speculative import (
     SpecResult,
     make_speculative_fns,
@@ -31,6 +31,7 @@ __all__ = [
     "make_speculative_fns",
     "speculative_generate",
     "Engine",
+    "PagedEngine",
     "QuantizedModel",
     "dequantize_params",
     "param_nbytes",

@@ -86,7 +86,7 @@ class Engine:
             raise ValueError("no prefill bucket fits max_len")
         self._rng = rng if rng is not None else jax.random.key(0)
 
-        self.cache = model.init_cache(max_slots, max_len, dtype=cache_dtype)
+        self.cache = self._init_cache(cache_dtype)
         self._free = list(range(max_slots))[::-1]
         self._queue: collections.deque = collections.deque()
         self._active: Dict[int, _Request] = {}  # slot -> request
@@ -139,12 +139,17 @@ class Engine:
         """Admit queued requests into free slots, then decode one token for
         every active slot. Returns requests that completed this step."""
         while self._free and self._queue:
-            self._admit(self._queue.popleft())
+            if not self._try_admit(self._queue[0]):
+                break  # admission blocked (e.g. paged pool dry): wait
+            self._queue.popleft()
         # Requests can finish AT admission (prefill sampled eos, or a
         # 1-token budget) — sweep before decoding would append an extra
         # token past eos/budget.
         done = self._sweep()
         if not self._active:
+            return done
+        self._pre_decode()
+        if not self._active:  # paged preemption can clear the field
             return done
 
         lengths = jnp.asarray(self._lengths)
@@ -154,7 +159,8 @@ class Engine:
         )
         self._rng, sub = jax.random.split(self._rng)
         nxt, self.cache = self._decode_jit(
-            self.params, self.cache, cur, lengths, active, sub
+            self.params, self.cache, cur, lengths, active,
+            *self._decode_extra_args(), sub,
         )
         nxt = np.asarray(nxt)
 
@@ -165,6 +171,29 @@ class Engine:
             self._cur[slot] = token
         done.extend(self._sweep())
         return done
+
+    def _try_admit(self, req: "_Request") -> bool:
+        """Admit ``req`` (a free slot is guaranteed by the caller).
+        Subclasses may refuse (return False) to leave it queued."""
+        self._admit(req)
+        return True
+
+    def _pre_decode(self) -> None:
+        """Hook before each decode dispatch (paged: page allocation)."""
+
+    def _decode_extra_args(self) -> tuple:
+        """Extra positional args for _decode_impl, before rng."""
+        return ()
+
+    def _init_cache(self, cache_dtype):
+        """Device cache for the slot pool; paged engines override."""
+        return self.model.init_cache(
+            self.max_slots, self.max_len, dtype=cache_dtype
+        )
+
+    def _release(self, slot: int) -> None:
+        """Per-slot cleanup on completion/preemption (paged: free pages).
+        The caller returns the slot to the free list itself."""
 
     def _sweep(self) -> List[Completion]:
         out: List[Completion] = []
@@ -181,6 +210,7 @@ class Engine:
                     )
                 )
                 del self._active[slot]
+                self._release(slot)
                 self._free.append(slot)
         return out
 
@@ -269,4 +299,252 @@ class Engine:
         nxt = sample_logits(logits[:, -1], rng, self.sample_cfg)
         # Freeze inactive slots' cur so their cache rows stay untouched in
         # spirit (they are written, but their lengths never advance).
+        return jnp.where(active, nxt, cur), cache
+
+
+class PagedEngine(Engine):
+    """Continuous batching over a PAGED KV pool (vLLM-style on TPU).
+
+    The dense :class:`Engine` reserves ``max_slots × max_len`` cache, so
+    HBM — not compute — caps concurrency. Here physical KV lives in a
+    shared pool of ``n_pages`` fixed-size pages (page 0 = scratch);
+    each slot maps logical positions onto pages it allocated, so a slot
+    costs only as many pages as it has tokens, and the pool can be sized
+    for expected TOTAL live tokens instead of the worst case.
+
+    Static shapes are preserved: the page table is a dense
+    (max_slots, max_len/page_size) int32 array fed to the same two
+    compiled programs per bucket + one decode program; only the table's
+    VALUES change per step, so nothing recompiles (the model gathers
+    pages with one ``take`` per layer — _paged_block_attention).
+
+    When the pool runs dry mid-decode the YOUNGEST active request is
+    preempted: its pages are freed and it re-enters the queue head for
+    recompute-style re-prefill (prompt + tokens generated so far). The
+    oldest request is only preempted when it is alone, so admission-order
+    progress is guaranteed.
+
+    Reference parity note: the upstream reference (klyan/shifu) is an
+    empty repository (SURVEY.md); there is no reference paged allocator
+    to match. The page-pool + table + recompute-preemption design
+    follows the public vLLM PagedAttention scheme, re-expressed with
+    static shapes and scatter/gather for XLA.
+    """
+
+    def __init__(
+        self,
+        model,
+        params,
+        *,
+        max_slots: int,
+        max_len: int,
+        page_size: int = 64,
+        n_pages: Optional[int] = None,
+        **kw,
+    ):
+        if getattr(model, "prefill_needs_mask", False):
+            raise ValueError(
+                "recurrent models carry O(1) state per slot — a paged KV "
+                "pool only makes sense for attention caches; use Engine"
+            )
+        if max_len % page_size:
+            raise ValueError(
+                f"max_len {max_len} must be a multiple of page_size "
+                f"{page_size}"
+            )
+        self.page_size = page_size
+        self.pages_per_slot = max_len // page_size
+        # Default pool: dense-equivalent capacity (+1 scratch page) —
+        # callers size it DOWN for memory savings.
+        self.n_pages = (
+            n_pages
+            if n_pages is not None
+            else max_slots * self.pages_per_slot + 1
+        )
+        if self.n_pages < 2:
+            raise ValueError("need at least one non-scratch page")
+        super().__init__(
+            model, params, max_slots=max_slots, max_len=max_len, **kw
+        )
+        self.buckets = tuple(
+            b for b in self.buckets if b % page_size == 0
+        )
+        if not self.buckets:
+            raise ValueError(
+                f"no prefill bucket is a multiple of page_size "
+                f"{page_size} (paged prefill scatters whole pages)"
+            )
+        if self.buckets[-1] < max_len - 1:
+            raise ValueError(
+                f"largest usable prefill bucket {self.buckets[-1]} must "
+                f"cover max_len-1={max_len - 1}: preemption re-prefills "
+                "prompt+generated, which can approach max_len"
+            )
+
+        self._table = np.zeros(
+            (max_slots, self.pages_per_slot), np.int32
+        )  # physical page per (slot, logical page); 0 = scratch
+        self._free_pages = list(range(1, self.n_pages))[::-1]
+        self._slot_pages: Dict[int, List[int]] = {}
+        self._admit_seq = itertools.count()
+        self._admit_order: Dict[int, int] = {}
+        self.preemptions = 0  # observability: recompute events
+
+    # ------------------------------------------------------------- sizing
+    @property
+    def free_pages(self) -> int:
+        return len(self._free_pages)
+
+    def submit(self, prompt_tokens, max_new_tokens: int) -> int:
+        prompt_tokens = list(map(int, prompt_tokens))
+        total = len(prompt_tokens) + max_new_tokens
+        if total - 1 > self.buckets[-1]:
+            raise ValueError(
+                f"prompt+max_new-1 = {total - 1} exceeds the largest "
+                f"usable bucket {self.buckets[-1]}; preemption could "
+                "not re-prefill this request"
+            )
+        # Transient worst case is the RECOMPUTE prefill after a late
+        # preemption (prompt + all-but-one generated tokens = total - 1
+        # tokens, rounded up to its bucket) — checking only the initial
+        # prompt's bucket would admit requests that can become
+        # permanently un-admittable after preemption (host livelock).
+        worst = max(
+            -(-total // self.page_size),
+            self._bucket_for(total - 1) // self.page_size,
+        )
+        if worst > self.n_pages - 1:
+            raise ValueError(
+                f"request needs up to {worst} pages but the pool has "
+                f"{self.n_pages - 1}"
+            )
+        return super().submit(prompt_tokens, max_new_tokens)
+
+    def _bucket_for(self, p: int) -> int:
+        return next(b for b in self.buckets if b >= p)
+
+    def _init_cache(self, cache_dtype):
+        return self.model.init_paged_cache(
+            self.n_pages, self.page_size, dtype=cache_dtype
+        )
+
+    # --------------------------------------------------------- allocation
+    def _release(self, slot: int) -> None:
+        self._free_pages.extend(self._slot_pages.pop(slot, ()))
+        self._table[slot] = 0
+        self._lengths[slot] = 0
+        self._cur[slot] = 0
+        self._admit_order.pop(slot, None)
+
+    def _preempt(self, slot: int) -> None:
+        """Free a slot mid-flight; the request re-enters the queue head
+        and re-prefills from prompt + generated-so-far (recompute)."""
+        req = self._active.pop(slot)
+        self._release(slot)
+        self._free.append(slot)
+        req.slot = None
+        self._queue.appendleft(req)
+        self.preemptions += 1
+
+    def _try_admit(self, req: _Request) -> bool:
+        """Admit if a slot AND enough pages exist; False = leave queued."""
+        if not self._free:
+            return False
+        # Recompute path: generated-so-far becomes part of the prompt.
+        prompt = req.tokens + req.generated
+        p = len(prompt)
+        bucket = self._bucket_for(p)
+        need = bucket // self.page_size  # prefill scatters whole bucket
+        if len(self._free_pages) < need:
+            return False
+        pages = [self._free_pages.pop() for _ in range(need)]
+        slot = self._free.pop()
+        req.slot = slot
+        row = np.zeros((self.pages_per_slot,), np.int32)
+        row[:need] = pages
+        self._table[slot] = row
+        padded = np.zeros((bucket,), np.int32)
+        padded[:p] = prompt
+        self._rng, sub = jax.random.split(self._rng)
+        first, self.cache = self._prefill_jit(
+            self.params,
+            self.cache,
+            jnp.asarray(padded),
+            jnp.int32(p),
+            jnp.asarray(row),
+            sub,
+            bucket=bucket,
+        )
+        # Keep only the pages that hold real tokens; the bucket's tail
+        # pages hold masked garbage and go straight back to the pool.
+        keep = -(-p // self.page_size)
+        self._free_pages.extend(pages[keep:])
+        self._table[slot, keep:] = 0
+        self._slot_pages[slot] = pages[:keep]
+        self._admit_order[slot] = next(self._admit_seq)
+        self._lengths[slot] = p
+        self._cur[slot] = int(first)
+        req.generated.append(int(first))
+        self._active[slot] = req
+        return True
+
+    def _ensure_decode_pages(self) -> None:
+        """Every active slot about to write at a page boundary gets a
+        fresh page, preempting youngest-first when the pool is dry."""
+        for slot in sorted(self._active, key=self._admit_order.__getitem__):
+            if slot not in self._active:
+                continue  # preempted as a victim earlier in this loop
+            used = len(self._slot_pages[slot]) * self.page_size
+            if self._lengths[slot] < used:
+                continue
+            while not self._free_pages:
+                victim = max(
+                    self._active, key=self._admit_order.__getitem__
+                )
+                self._preempt(victim)
+                if victim == slot:
+                    break
+            if slot not in self._active:
+                continue
+            page = self._free_pages.pop()
+            self._table[slot, len(self._slot_pages[slot])] = page
+            self._slot_pages[slot].append(page)
+
+    # ------------------------------------------------------------- driving
+    # The decode driver is Engine.step itself, via its hooks:
+    def _pre_decode(self) -> None:
+        self._ensure_decode_pages()
+
+    def _decode_extra_args(self) -> tuple:
+        return (jnp.asarray(self._table),)
+
+    # ----------------------------------------------------------- programs
+    def _prefill_impl(self, params, cache, tokens, length, table_row, rng,
+                      *, bucket):
+        """Prefill one request straight into its pages; sample token 1."""
+        logits, cache = self.model(
+            params,
+            tokens[None, :],
+            cache=cache,
+            cache_index=0,
+            page_table=table_row[None, :],
+            logits_at=(length - 1)[None],
+        )
+        tok = sample_logits(logits[:, 0], rng, self.sample_cfg)[0]
+        return tok, cache
+
+    def _decode_impl(self, params, cache, cur, lengths, active, table, rng):
+        kv_mask = (
+            jnp.arange(self.pages_per_slot * self.page_size)[None, :]
+            <= lengths[:, None]
+        )
+        logits, cache = self.model(
+            params,
+            cur[:, None],
+            cache=cache,
+            cache_index=lengths,
+            kv_mask=kv_mask,
+            page_table=table,
+        )
+        nxt = sample_logits(logits[:, -1], rng, self.sample_cfg)
         return jnp.where(active, nxt, cur), cache

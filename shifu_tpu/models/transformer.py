@@ -233,12 +233,15 @@ class Transformer(Module):
     # ------------------------------------------------------------- one block
     def _block(
         self, p, h, sin, cos, segment_ids, cache_slice, cache_index,
-        kv_mask=None,
+        kv_mask=None, page_table=None,
     ):
         """One transformer block. ``p`` holds per-layer (unstacked) params.
 
         Returns (h, new_cache_slice, moe_aux); cache_slice is None outside
         decode; moe_aux is None for a dense FFN, else a dict of scalars.
+        With ``page_table`` the cache_slice leaves are a PAGED pool
+        (n_pages, page_size, kv, hd) shared across rows — see
+        :meth:`init_paged_cache`.
         """
         cfg = self.cfg
         x = rms_norm(h, p["attn_norm"], eps=cfg.norm_eps)
@@ -254,6 +257,10 @@ class Transformer(Module):
                 impl=cfg.attn_impl, window=cfg.window_size,
             )
             new_cache = None
+        elif page_table is not None:
+            attn, new_cache = self._paged_block_attention(
+                q, k, v, cache_slice, cache_index, page_table, kv_mask
+            )
         else:
             if getattr(cache_index, "ndim", 0) == 1:
                 # Per-row write offsets (continuous batching: every slot
@@ -331,6 +338,88 @@ class Transformer(Module):
         h = constrain(h, ("batch", "seq", "act_embed"))
         return h, new_cache, moe_aux
 
+    # ------------------------------------------------------------ paged kv
+    def _paged_block_attention(
+        self, q, k, v, pool, cache_index, page_table, kv_mask
+    ):
+        """Attention over a PAGED kv pool (one layer's slice).
+
+        pool: {"k","v"} of (n_pages, page_size, kv, hd) — physical pages
+        shared by all rows. page_table: (b, pages_per_row) int32 mapping
+        row-logical page j to a physical page (unallocated entries point
+        at the scratch page 0; kv_mask hides whatever lands there).
+        Logical position t of row b lives at
+        pool[table[b, t // ps], t % ps].
+
+        Two call shapes, mirroring the dense path:
+          * prefill (q_len > 1, cache_index == 0): k/v for the whole
+            bucket scatter to this row's pages in one batched write
+            (q_len % page_size == 0 enforced by the engine's buckets);
+            attention runs locally over the fresh k/v (right-padding is
+            hidden by causality, exactly the dense fast path).
+          * decode (q_len == 1, cache_index a (b,) vector): one-token
+            scatter at (table[b, t//ps], t%ps), then attention over the
+            row's gathered pages with the same slot-space masking as the
+            dense cache (_decode_attention).
+        """
+        b, q_len, _, _ = q.shape
+        n_pages, ps, n_kv, hd = pool["k"].shape
+        pages_per_row = page_table.shape[1]
+        kc = k.astype(pool["k"].dtype)
+        vc = v.astype(pool["v"].dtype)
+
+        if q_len > 1:
+            if not (type(cache_index) is int and cache_index == 0):
+                raise ValueError(
+                    "paged prefill must start at cache_index=0 (chunked "
+                    "prefill at an offset is a dense-cache feature)"
+                )
+            if q_len % ps:
+                raise ValueError(
+                    f"paged prefill length {q_len} must be a multiple of "
+                    f"the page size {ps}"
+                )
+            if b != 1:
+                raise ValueError(
+                    "paged prefill is per-request (batch 1); batch decode "
+                    "is where rows share the pool"
+                )
+            if kv_mask is not None:
+                raise ValueError(
+                    "paged prefill attends locally (causality hides "
+                    "right-padding); kv_mask would be silently ignored"
+                )
+            phys = page_table[0, : q_len // ps]  # (np_b,)
+            ck = pool["k"].at[phys].set(kc[0].reshape(q_len // ps, ps, n_kv, hd))
+            cv = pool["v"].at[phys].set(vc[0].reshape(q_len // ps, ps, n_kv, hd))
+            attn = dot_product_attention(
+                q, k, v, causal=True, impl=self.cfg.attn_impl,
+                window=self.cfg.window_size,
+            )
+        else:
+            if getattr(cache_index, "ndim", 0) != 1:
+                raise ValueError(
+                    "paged decode needs per-row cache_index (continuous "
+                    "batching is the point of a paged pool)"
+                )
+            rows = jnp.arange(b)
+            phys = page_table[rows, cache_index // ps]  # (b,)
+            off = cache_index % ps
+            # Inactive slots all point at scratch page 0 — duplicate
+            # scatter indices there are benign (nothing reads scratch).
+            ck = pool["k"].at[phys, off].set(kc[:, 0])
+            cv = pool["v"].at[phys, off].set(vc[:, 0])
+            # Gather each row's pages into its logical view. One take per
+            # layer; XLA fuses the reshape, and traffic matches what the
+            # dense cache's attention would read anyway.
+            gk = ck[page_table].reshape(b, pages_per_row * ps, n_kv, hd)
+            gv = cv[page_table].reshape(b, pages_per_row * ps, n_kv, hd)
+            attn = _decode_attention(
+                q, gk, gv, cache_index, self.cfg.attn_impl, kv_mask=kv_mask,
+                window=self.cfg.window_size,
+            )
+        return attn, {"k": ck, "v": cv}
+
     # ------------------------------------------------------------- moe ffn
     def _moe_ffn(self, p, x):
         """Expert-parallel SwiGLU FFN via dispatch/combine einsums.
@@ -371,6 +460,7 @@ class Transformer(Module):
         cache=None,
         cache_index=None,
         kv_mask=None,
+        page_table=None,
         logits_at=None,
         return_aux=False,
         blocks_fn=None,
@@ -389,6 +479,10 @@ class Transformer(Module):
             may attend (on top of slot-space causality). Used by the
             generation stack to hide right-padding written during prefill
             of ragged prompts. Decode path only.
+          page_table: optional (batch, pages_per_row) int32 — the cache is
+            a PAGED pool from ``init_paged_cache`` and this maps each
+            row's logical pages onto physical ones (_paged_block_attention
+            docstring). Requires ``cache``.
           logits_at: optional (batch,) int32 — compute logits only at this
             one position per row. Skips the (batch, seq, vocab) unembed on
             prefill, where just the last real token's logits feed the
@@ -422,6 +516,11 @@ class Transformer(Module):
                 "query may attend. On the no-cache forward it would be "
                 "silently ignored; mask padding there via segment_ids or a "
                 "loss mask instead"
+            )
+        if page_table is not None and cache is None:
+            raise ValueError(
+                "page_table maps a paged cache pool; pass the pool from "
+                "init_paged_cache as cache="
             )
         p = self.policy.cast_to_compute(params)
         b, s = tokens.shape
@@ -483,7 +582,7 @@ class Transformer(Module):
                 layer_p, cache_slice = xs
                 out, new_slice, aux = block(
                     layer_p, carry, sin, cos, None, cache_slice, cache_index,
-                    kv_mask,
+                    kv_mask, page_table,
                 )
                 return out, (new_slice, aux)
 
@@ -590,6 +689,26 @@ class Transformer(Module):
         cfg = self.cfg
         shape = (
             cfg.n_layers, batch_size, max_seq_len, cfg.n_kv_heads,
+            cfg.resolved_head_dim,
+        )
+        return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+    def init_paged_cache(
+        self, n_pages: int, page_size: int, dtype=jnp.bfloat16
+    ):
+        """Paged KV pool: leaves (layers, n_pages, page_size, kv, hd).
+
+        Physical pages are shared by all rows via per-row page tables
+        (``page_table`` on the forward). Page 0 is the SCRATCH page by
+        convention: unallocated table entries point there, stray writes
+        land there, and nothing may ever read it (mask those positions).
+        Unlike the dense cache, pool capacity is decoupled from
+        max_slots × max_len — size it for the expected TOTAL live tokens,
+        which is what makes continuous batching memory-efficient.
+        """
+        cfg = self.cfg
+        shape = (
+            cfg.n_layers, n_pages, page_size, cfg.n_kv_heads,
             cfg.resolved_head_dim,
         )
         return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}

@@ -199,6 +199,138 @@ def test_engine_mamba_matches_batch_generation():
         )
 
 
+def test_paged_decode_matches_dense_cache(tiny):
+    """Model level: decoding against gathered pages == decoding against
+    the dense cache, same contents (ragged rows, page size 4)."""
+    model, params = tiny
+    rng = np.random.RandomState(6)
+    p0 = jnp.asarray(rng.randint(0, 256, (1, 4)), jnp.int32)
+    p1 = jnp.asarray(rng.randint(0, 256, (1, 8)), jnp.int32)
+    step = jnp.asarray(rng.randint(0, 256, (2, 1)), jnp.int32)
+    ps, max_len = 4, 12
+
+    # Dense reference (same construction as the ragged-decode test).
+    cache = model.init_cache(2, max_len)
+    row0 = jax.tree_util.tree_map(lambda c: c[:, :1], cache)
+    _, row0 = model(params, p0, cache=row0, cache_index=0)
+    row1 = jax.tree_util.tree_map(lambda c: c[:, 1:2], cache)
+    _, row1 = model(params, p1, cache=row1, cache_index=0)
+    cache = jax.tree_util.tree_map(
+        lambda a, b: jnp.concatenate([a, b], axis=1), row0, row1
+    )
+    lengths = jnp.asarray([4, 8], jnp.int32)
+    kv_mask = jnp.arange(max_len)[None, :] <= lengths[:, None]
+    want, _ = model(
+        params, step, cache=cache, cache_index=lengths, kv_mask=kv_mask
+    )
+
+    # Paged: row 0 -> pages 2, 3; row 1 -> pages 4, 1, 5 (deliberately
+    # non-contiguous, out-of-order physical pages). The second/third
+    # entries cover the decode WRITE at positions 4 / 8 — the engine's
+    # _ensure_decode_pages allocates those before each step.
+    pool = model.init_paged_cache(6, ps)
+    t0 = jnp.asarray([[2, 3, 0]], jnp.int32)
+    t1 = jnp.asarray([[4, 1, 5]], jnp.int32)
+    _, pool = model(params, p0, cache=pool, cache_index=0, page_table=t0)
+    _, pool = model(params, p1, cache=pool, cache_index=0, page_table=t1)
+    table = jnp.concatenate([t0, t1], axis=0)
+    got, _ = model(
+        params, step, cache=pool, cache_index=lengths, kv_mask=kv_mask,
+        page_table=table,
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-6
+    )
+
+
+def test_paged_engine_matches_dense_engine(tiny):
+    from shifu_tpu.infer.engine import PagedEngine
+
+    model, params = tiny
+    rng = np.random.RandomState(7)
+    prompts = [rng.randint(1, 256, size=n).tolist() for n in (5, 9, 3, 7)]
+    kw = dict(
+        max_slots=2, max_len=32,
+        sample_cfg=SampleConfig(temperature=0.0), prefill_buckets=(16, 32),
+    )
+    dense = Engine(model, params, **kw)
+    paged = PagedEngine(model, params, page_size=8, **kw)
+    out_d = {}
+    out_p = {}
+    for eng, out in ((dense, out_d), (paged, out_p)):
+        rids = [eng.submit(p, max_new_tokens=6) for p in prompts]
+        for c in eng.run():
+            out[rids.index(c.rid)] = c.tokens
+    assert paged.preemptions == 0  # default pool is dense-equivalent
+    assert paged.free_pages == paged.n_pages - 1  # all pages returned
+    for i in range(len(prompts)):
+        np.testing.assert_array_equal(out_d[i], out_p[i], err_msg=f"req {i}")
+
+
+def test_paged_engine_preemption_recompute_parity(tiny):
+    """A pool too small for both requests forces a preemption; greedy
+    recompute must still produce exactly the dense engine's tokens."""
+    from shifu_tpu.infer.engine import PagedEngine
+
+    model, params = tiny
+    rng = np.random.RandomState(8)
+    prompts = [rng.randint(1, 256, size=5).tolist() for _ in range(2)]
+    kw = dict(
+        max_slots=2, max_len=16,
+        sample_cfg=SampleConfig(temperature=0.0), prefill_buckets=(8, 16),
+    )
+    dense = Engine(model, params, **kw)
+    paged = PagedEngine(model, params, page_size=4, n_pages=6, **kw)
+    out_d, out_p = {}, {}
+    for eng, out in ((dense, out_d), (paged, out_p)):
+        rids = [eng.submit(p, max_new_tokens=8) for p in prompts]
+        for c in eng.run():
+            out[rids.index(c.rid)] = c.tokens
+    assert paged.preemptions >= 1, "pool was not tight enough to test"
+    assert paged.free_pages == paged.n_pages - 1
+    for i in range(2):
+        np.testing.assert_array_equal(out_d[i], out_p[i], err_msg=f"req {i}")
+
+
+def test_paged_engine_validation(tiny):
+    from shifu_tpu.infer.engine import PagedEngine
+
+    model, params = tiny
+    with pytest.raises(ValueError, match="multiple of page_size"):
+        PagedEngine(model, params, max_slots=1, max_len=30, page_size=8)
+    with pytest.raises(ValueError, match="multiple of page_size"):
+        PagedEngine(
+            model, params, max_slots=1, max_len=16, page_size=16,
+            prefill_buckets=(8,),
+        )
+    eng = PagedEngine(
+        model, params, max_slots=1, max_len=32, page_size=8, n_pages=3,
+        prefill_buckets=(8, 32),
+    )
+    with pytest.raises(ValueError, match="pages"):
+        eng.submit([1] * 8, max_new_tokens=12)  # needs 3 pages, pool has 2
+
+    # Livelock guard: the worst case is the RECOMPUTE bucket (total-1),
+    # not the initial prompt's. prompt 5 fits bucket 8 (1 page) but a
+    # late preemption re-prefills up to 20 tokens -> bucket 32 -> 4
+    # pages > the pool's 3; admitting would allow a permanent stall.
+    eng2 = PagedEngine(
+        model, params, max_slots=2, max_len=32, page_size=8, n_pages=4,
+        prefill_buckets=(8, 16, 32),
+    )
+    with pytest.raises(ValueError, match="pages"):
+        eng2.submit([1] * 5, max_new_tokens=16)
+
+    from shifu_tpu.models import Mamba, MambaConfig
+
+    mamba = Mamba(MambaConfig.tiny())
+    with pytest.raises(ValueError, match="recurrent"):
+        PagedEngine(
+            mamba, mamba.init(jax.random.key(0)), max_slots=1, max_len=16,
+            page_size=8,
+        )
+
+
 def test_engine_validation(tiny):
     model, params = tiny
     eng = Engine(model, params, max_slots=1, max_len=16,
