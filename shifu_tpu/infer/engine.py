@@ -222,23 +222,36 @@ class Engine:
         return out
 
     # ----------------------------------------------------------- internals
+    def _bucket_for(self, p: int) -> int:
+        return next(b for b in self.buckets if b >= p)
+
     def _admit(self, req: _Request) -> None:
         slot = self._free.pop()
         req.slot = slot
         p = len(req.tokens)
-        bucket = next(b for b in self.buckets if b >= p)
+        bucket = self._bucket_for(p)
         padded = np.zeros((bucket,), np.int32)
         padded[:p] = req.tokens
         self._rng, sub = jax.random.split(self._rng)
+        first = self._dispatch_prefill(slot, padded, p, bucket, sub)
+        self._finish_admission(req, slot, p, first)
+
+    def _dispatch_prefill(self, slot, padded, p, bucket, rng):
+        """Run the compiled prefill for one request; return token 1.
+        (Paged engines override to pass the slot's page-table row.)"""
         first, self.cache = self._prefill_jit(
             self.params,
             self.cache,
             jnp.asarray(padded),
             jnp.int32(p),
             jnp.int32(slot),
-            sub,
+            rng,
             bucket=bucket,
         )
+        return first
+
+    def _finish_admission(self, req: _Request, slot, p, first) -> None:
+        """Shared post-prefill bookkeeping, dense and paged."""
         self._lengths[slot] = p
         self._cur[slot] = int(first)
         req.generated.append(int(first))
@@ -420,9 +433,6 @@ class PagedEngine(Engine):
             )
         return super().submit(prompt_tokens, max_new_tokens)
 
-    def _bucket_for(self, p: int) -> int:
-        return next(b for b in self.buckets if b >= p)
-
     def _init_cache(self, cache_dtype):
         return self.model.init_paged_cache(
             self.n_pages, self.page_size, dtype=cache_dtype
@@ -466,15 +476,7 @@ class PagedEngine(Engine):
         padded = np.zeros((bucket,), np.int32)
         padded[:p] = prompt
         self._rng, sub = jax.random.split(self._rng)
-        first, self.cache = self._prefill_jit(
-            self.params,
-            self.cache,
-            jnp.asarray(padded),
-            jnp.int32(p),
-            jnp.asarray(row),
-            sub,
-            bucket=bucket,
-        )
+        first = self._dispatch_prefill(slot, padded, p, bucket, sub)
         # Keep only the pages that hold real tokens; the bucket's tail
         # pages hold masked garbage and go straight back to the pool.
         keep = -(-p // self.page_size)
@@ -482,11 +484,20 @@ class PagedEngine(Engine):
         self._table[slot, keep:] = 0
         self._slot_pages[slot] = pages[:keep]
         self._admit_order[slot] = next(self._admit_seq)
-        self._lengths[slot] = p
-        self._cur[slot] = int(first)
-        req.generated.append(int(first))
-        self._active[slot] = req
+        self._finish_admission(req, slot, p, first)
         return True
+
+    def _dispatch_prefill(self, slot, padded, p, bucket, rng):
+        first, self.cache = self._prefill_jit(
+            self.params,
+            self.cache,
+            jnp.asarray(padded),
+            jnp.int32(p),
+            jnp.asarray(self._table[slot]),
+            rng,
+            bucket=bucket,
+        )
+        return first
 
     def _ensure_decode_pages(self) -> None:
         """Every active slot about to write at a page boundary gets a

@@ -1,12 +1,19 @@
-"""Int8 weight-only quantization for inference.
+"""Weight-only quantization for inference: int8 and fp8.
 
-Per-channel symmetric int8: each weight stores ``{"_q8": int8, "_scale":
-f32}`` where the scale is the per-output-channel max-abs over the matmul's
-*contraction* axes divided by 127. At rest the params are ~4x smaller than
-f32 (2x vs bf16) — decode is HBM-bandwidth-bound, so weight bytes are
-latency; dequantisation happens inside the jit (``int8 load -> convert ->
-matmul``), which XLA fuses, so full-precision weights never materialise in
-HBM.
+Per-channel symmetric formats: each weight stores ``{"_q8"|"_qf8": data,
+"_scale": f32}`` where the scale is the per-output-channel max-abs over
+the matmul's *contraction* axes divided by the format's max
+representable (127 for int8, 448 for e4m3, 57344 for e5m2). At rest the
+params are ~4x smaller than f32 (2x vs bf16) — decode is HBM-bandwidth-
+bound, so weight bytes are latency; dequantisation happens inside the
+jit (``narrow load -> convert -> matmul``), which XLA fuses, so
+full-precision weights never materialise in HBM.
+
+Format guidance on TPU: ``int8`` has 8 significand bits of resolution
+over each channel's range — tightest error bound. ``fp8_e4m3`` trades
+resolution near the channel max for dynamic range (useful when channels
+mix large and tiny weights); ``fp8_e5m2`` is mostly for KV/activation
+experiments — for weights its 2-bit mantissa is usually too coarse.
 
 Which axes are "contraction" is model knowledge: modules expose
 ``quant_spec()`` — a params-structured tree of contraction-axis tuples,
@@ -29,37 +36,61 @@ import jax
 import jax.numpy as jnp
 
 QKEY, SKEY = "_q8", "_scale"
+FKEY = "_qf8"
+
+# fmt -> (storage dtype, symmetric max representable)
+FORMATS = {
+    "int8": (jnp.int8, 127.0),
+    "fp8_e4m3": (jnp.float8_e4m3fn, 448.0),
+    "fp8_e5m2": (jnp.float8_e5m2, 57344.0),
+}
 
 
 def is_qtensor(x) -> bool:
-    return isinstance(x, dict) and set(x.keys()) == {QKEY, SKEY}
+    return isinstance(x, dict) and (
+        set(x.keys()) == {QKEY, SKEY} or set(x.keys()) == {FKEY, SKEY}
+    )
 
 
-def quantize_tensor(w: jax.Array, contract_axes: Tuple[int, ...]):
-    """Symmetric per-channel int8 over the given contraction axes."""
+def quantize_tensor(
+    w: jax.Array, contract_axes: Tuple[int, ...], fmt: str = "int8"
+):
+    """Symmetric per-channel quantization over the given contraction axes."""
+    try:
+        dtype, qmax = FORMATS[fmt]
+    except KeyError:
+        raise ValueError(
+            f"unknown quant format {fmt!r} (have {sorted(FORMATS)})"
+        ) from None
     w32 = jnp.asarray(w, jnp.float32)
     amax = jnp.max(jnp.abs(w32), axis=contract_axes, keepdims=True)
-    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
-    q = jnp.clip(jnp.round(w32 / scale), -127, 127).astype(jnp.int8)
-    return {QKEY: q, SKEY: scale}
+    scale = jnp.where(amax > 0, amax / qmax, 1.0)
+    scaled = w32 / scale
+    if fmt == "int8":
+        q = jnp.clip(jnp.round(scaled), -127, 127).astype(dtype)
+        return {QKEY: q, SKEY: scale}
+    # fp8: the cast rounds to nearest-even; values are pre-scaled into
+    # [-qmax, qmax] so no clipping/overflow is possible.
+    return {FKEY: scaled.astype(dtype), SKEY: scale}
 
 
 def dequantize_tensor(q, dtype=jnp.float32) -> jax.Array:
-    return (q[QKEY].astype(jnp.float32) * q[SKEY]).astype(dtype)
+    data = q[QKEY] if QKEY in q else q[FKEY]
+    return (data.astype(jnp.float32) * q[SKEY]).astype(dtype)
 
 
-def quantize_params(model, params):
+def quantize_params(model, params, fmt: str = "int8"):
     """Quantize eligible leaves per the model's ``quant_spec()``.
 
     Leaves whose spec is ``()`` pass through untouched; everything else
-    becomes a ``{"_q8", "_scale"}`` dict. The result is a valid pytree for
-    jit/checkpointing.
+    becomes a ``{"_q8"|"_qf8", "_scale"}`` dict. The result is a valid
+    pytree for jit/checkpointing.
     """
     spec = model.quant_spec()
     leaves, treedef = jax.tree_util.tree_flatten(params)
     spec_leaves = treedef.flatten_up_to(spec)
     out = [
-        quantize_tensor(w, axes) if axes else w
+        quantize_tensor(w, axes, fmt) if axes else w
         for w, axes in zip(leaves, spec_leaves)
     ]
     return jax.tree_util.tree_unflatten(treedef, out)
@@ -79,7 +110,7 @@ def param_nbytes(params) -> int:
 
 @dataclasses.dataclass(frozen=True)
 class QuantizedModel:
-    """Drop-in wrapper: same call surface, int8 params.
+    """Drop-in wrapper: same call surface, quantized params.
 
     ``qm(qparams, ...)`` dequantises inside the traced computation and
     delegates to the wrapped model, so make_generate_fn / evaluate / any
@@ -107,3 +138,6 @@ class QuantizedModel:
 
     def init_cache(self, *args, **kwargs):
         return self.inner.init_cache(*args, **kwargs)
+
+    def init_paged_cache(self, *args, **kwargs):
+        return self.inner.init_paged_cache(*args, **kwargs)

@@ -1,4 +1,4 @@
-"""Int8 weight-only quantization: error bounds, structure, end-to-end."""
+"""Weight-only quantization (int8/fp8): error bounds, structure, e2e."""
 
 import jax
 import jax.numpy as jnp
@@ -30,6 +30,52 @@ def test_zero_channel_safe():
     w = jnp.zeros((8, 4))
     q = quantize_tensor(w, (0,))
     np.testing.assert_array_equal(dequantize_tensor(q), 0.0)
+
+
+def test_fp8_roundtrip_error_bound():
+    w = jnp.asarray(np.random.RandomState(3).randn(64, 32), jnp.float32)
+    q = quantize_tensor(w, (0,), fmt="fp8_e4m3")
+    assert q["_qf8"].dtype == jnp.float8_e4m3fn
+    assert q["_qf8"].nbytes == w.size  # 1 byte/weight
+    deq = np.asarray(dequantize_tensor(q))
+    # e4m3 relative step is 2^-3 per binade: elementwise error is
+    # bounded by max(|w|)/16 within each channel's scaled range.
+    err = np.abs(np.asarray(w) - deq)
+    bound = np.abs(np.asarray(w)) / 16 + np.asarray(q["_scale"]) + 1e-7
+    assert (err <= bound).all()
+    # No overflow to inf/nan at the channel max.
+    assert np.isfinite(deq).all()
+
+
+def test_fp8_zero_channel_safe():
+    q = quantize_tensor(jnp.zeros((8, 4)), (0,), fmt="fp8_e4m3")
+    np.testing.assert_array_equal(dequantize_tensor(q), 0.0)
+
+
+def test_unknown_format_rejected():
+    with pytest.raises(ValueError, match="unknown quant format"):
+        quantize_tensor(jnp.ones((2, 2)), (0,), fmt="int4")
+
+
+@pytest.mark.parametrize("fmt", ["fp8_e4m3", "fp8_e5m2"])
+def test_fp8_quantized_logits_close(fmt):
+    model = Transformer(TransformerConfig.tiny())
+    params = model.init(jax.random.key(0))
+    qp = quantize_params(model, params, fmt=fmt)
+    assert is_qtensor(qp["blocks"]["wq"])
+    assert param_nbytes(qp) < 0.55 * param_nbytes(params)
+    qm = QuantizedModel(model)
+    tokens = jnp.asarray(
+        np.random.RandomState(4).randint(0, 256, (2, 16)), jnp.int32
+    )
+    full = np.asarray(model(params, tokens))
+    quant = np.asarray(qm(qp, tokens))
+    err = np.abs(full - quant)
+    # e5m2's 2-bit mantissa is coarse; e4m3 should be int8-like.
+    tol = 0.06 if fmt == "fp8_e4m3" else 0.25
+    assert err.mean() < tol * full.std() + 1e-3
+    agree = (full.argmax(-1) == quant.argmax(-1)).mean()
+    assert agree > (0.9 if fmt == "fp8_e4m3" else 0.6)
 
 
 def test_quantize_params_structure():
