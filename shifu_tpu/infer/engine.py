@@ -281,6 +281,11 @@ class Engine:
         logits, row = self.model(
             params,
             tokens[None, :],
+            # Clamp bucket-padding positions to the last real one: the
+            # pad region is masked anyway, and length-sensitive rope
+            # scaling (dynamic NTK, longrope) must key its regime off
+            # the REAL prompt length, not the bucket width.
+            positions=jnp.minimum(jnp.arange(bucket), length - 1)[None, :],
             cache=row,
             cache_index=0,
             logits_at=(length - 1)[None],
@@ -536,6 +541,9 @@ class PagedEngine(Engine):
         logits, cache = self.model(
             params,
             tokens[None, :],
+            # Same padding clamp as the dense prefill (regime-sensitive
+            # rope scaling must see the real length).
+            positions=jnp.minimum(jnp.arange(bucket), length - 1)[None, :],
             cache=cache,
             cache_index=0,
             page_table=table_row[None, :],

@@ -78,6 +78,12 @@ def make_generate_fn(
             prefill_kw["kv_mask"] = kv_mask[:, :prompt_len]
         logits, cache = model(
             params, prompts, cache=cache, cache_index=0,
+            # Per-row clamp of right-padding positions: masked anyway,
+            # and length-sensitive rope scaling (dynamic NTK, longrope)
+            # must key off real prompt lengths, not the padded width.
+            positions=jnp.minimum(
+                jnp.arange(prompt_len)[None, :], lengths[:, None] - 1
+            ),
             logits_at=lengths - 1, **prefill_kw,
         )
         rng, sub = jax.random.split(rng)

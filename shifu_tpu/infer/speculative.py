@@ -76,6 +76,11 @@ def make_speculative_fns(target, draft, k: int, sample_cfg: SampleConfig):
     def prefill(params, model, cache, tokens, length):
         logits, cache = model(
             params, tokens, cache=cache, cache_index=0,
+            # Clamp pad positions to the real length (masked anyway;
+            # regime-sensitive rope scaling keys off max position).
+            positions=jnp.minimum(
+                jnp.arange(tokens.shape[1]), length - 1
+            )[None, :],
             logits_at=(length - 1)[None],
         )
         return logits[:, 0], cache

@@ -87,11 +87,41 @@ def config_from_hf_llama(hf_config, **overrides) -> TransformerConfig:
                 None if attn_factor is None else float(attn_factor),
                 bool(scaling.get("truncate", True)),
             )
+        elif rope_type == "longrope":
+            # HF quirk (Phi-3): a config-level original_max_position_
+            # embeddings both sets the short/long switch point AND
+            # overrides rope_scaling["factor"] with the max/original
+            # ratio for the default attention factor.
+            orig = getattr(
+                hf_config, "original_max_position_embeddings", None
+            )
+            if orig:
+                factor = hf_config.max_position_embeddings / orig
+            else:
+                orig = hf_config.max_position_embeddings
+                if scaling.get("factor") is None:
+                    # HF's longrope validation requires `factor` in this
+                    # case; silently defaulting would change the
+                    # attention scale vs any torch reference.
+                    raise ValueError(
+                        "longrope needs rope_scaling['factor'] when the "
+                        "config has no original_max_position_embeddings"
+                    )
+                factor = float(scaling["factor"])
+            attn_factor = scaling.get("attention_factor")
+            rope_scaling = (
+                "longrope",
+                tuple(float(f) for f in scaling["short_factor"]),
+                tuple(float(f) for f in scaling["long_factor"]),
+                int(orig),
+                float(factor),
+                None if attn_factor is None else float(attn_factor),
+            )
         elif rope_type != "default":
-            # longrope etc. would convert to silently wrong logits.
             raise NotImplementedError(
                 f"rope_scaling type {rope_type!r} is not supported "
-                "(implemented: default, linear, dynamic, yarn, llama3)"
+                "(implemented: default, linear, dynamic, yarn, llama3, "
+                "longrope)"
             )
     kw = dict(
         vocab_size=hf_config.vocab_size,

@@ -31,6 +31,13 @@ checkpoints keep their logits. Supported, as hashable tagged tuples
      original_context_len)``
       Llama-3.1 wavelength-banded scaling. A legacy bare 4-tuple of
       numbers means the same thing.
+  ``("longrope", short_factors, long_factors, original_context_len,
+     factor, attention_factor)``
+      LongRoPE (Phi-3): per-dimension frequency divisors — the
+      ``short_factors`` tuple (length head_dim/2) applies while every
+      position fits the original context, ``long_factors`` once the
+      call's max position exceeds it (a traced switch); cos/sin scaled
+      by ``attention_factor`` (None = ``sqrt(1 + ln(factor)/ln(orig))``).
 """
 
 from __future__ import annotations
@@ -123,11 +130,19 @@ def rope_frequencies(
         elif kind == "dynamic":
             factor, orig_len = args
             # Traced, value-dependent: the base stretches with the
-            # longest position actually used in this call.
+            # longest position used — PER ROW when positions are (b, s),
+            # so one long request in a served batch cannot stretch the
+            # short requests sharing its decode dispatch. (HF applies
+            # one global stretch per forward; per-row is strictly more
+            # faithful to the single-request semantics its parity tests
+            # pin, and identical for 1-D positions.)
             seq_len = jnp.maximum(
-                jnp.max(positions).astype(jnp.float32) + 1.0,
+                jnp.max(positions, axis=-1, keepdims=True).astype(
+                    jnp.float32
+                )
+                + 1.0,
                 float(orig_len),
-            )
+            )[..., None]  # (..., 1, 1): broadcasts against (d/2,)
             base = theta * (factor * seq_len / orig_len - (factor - 1.0)) ** (
                 head_dim / (head_dim - 2)
             )
@@ -141,6 +156,38 @@ def rope_frequencies(
             )
             mscale = (
                 attn_factor if attn_factor is not None else get_mscale(factor)
+            )
+        elif kind == "longrope":
+            short, long_, orig_len, factor, attn_factor = args
+            if len(short) != head_dim // 2 or len(long_) != head_dim // 2:
+                raise ValueError(
+                    f"longrope factor vectors must have length "
+                    f"head_dim/2={head_dim // 2}, got "
+                    f"{len(short)}/{len(long_)}"
+                )
+            # NOTE: callers that right-pad (prefill buckets) must clamp
+            # positions to the real length, or padding flips the regime.
+            # The switch is PER ROW for (b, s) positions (same rationale
+            # as "dynamic" above: co-batched requests must not flip each
+            # other); a request whose own decode crosses orig_len still
+            # flips mid-request, inherent to longrope-with-cache.
+            over = (
+                jnp.max(positions, axis=-1, keepdims=True) + 1 > orig_len
+            )[..., None]  # (..., 1, 1)
+            ext = jnp.where(
+                over,
+                jnp.asarray(long_, jnp.float32),
+                jnp.asarray(short, jnp.float32),
+            )
+            inv_freq = inv_freq / ext
+            mscale = (
+                attn_factor
+                if attn_factor is not None
+                else (
+                    math.sqrt(1.0 + math.log(factor) / math.log(orig_len))
+                    if factor > 1.0
+                    else 1.0
+                )
             )
         else:
             raise ValueError(f"unknown rope scaling kind {kind!r}")

@@ -177,17 +177,37 @@ def test_rope_scaling_variants_parity(scaling):
     np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
 
 
+def test_longrope_scaling_parity():
+    # Phi-3-style LongRoPE through a Llama body: per-dim long factors
+    # engage at seq 48 > original 32, with the sqrt(1+ln f/ln orig)
+    # attention factor derived from the config-level original_max_*.
+    hf = tiny_hf_llama(
+        rope_scaling={
+            "rope_type": "longrope",
+            "short_factor": [1.0, 1.2, 1.5, 2.0],
+            "long_factor": [2.0, 3.0, 5.0, 8.0],
+        },
+        max_position_embeddings=64,
+        original_max_position_embeddings=32,
+    )
+    model, params = from_hf_llama(hf)
+    assert model.cfg.rope_scaling[0] == "longrope"
+    assert model.cfg.rope_scaling[3] == 32  # switch point
+    assert model.cfg.rope_scaling[4] == 2.0  # factor = 64/32
+    model = Transformer(model.cfg, policy=FULL_F32)
+    tokens = np.random.RandomState(9).randint(0, 128, (1, 48))
+    with torch.no_grad():
+        want = hf(torch.tensor(tokens)).logits.float().numpy()
+    got = np.asarray(model(params, jnp.asarray(tokens, jnp.int32)))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
 def test_unsupported_rope_scaling_rejected():
     from shifu_tpu.models.convert import config_from_hf_llama
 
     hf = tiny_hf_llama()
-    hf.config.rope_scaling = {
-        "rope_type": "longrope",
-        "factor": 2.0,
-        "short_factor": [1.0] * 4,
-        "long_factor": [2.0] * 4,
-    }
-    with pytest.raises(NotImplementedError, match="longrope"):
+    hf.config.rope_scaling = {"rope_type": "made_up_scheme", "factor": 2.0}
+    with pytest.raises(NotImplementedError, match="made_up_scheme"):
         config_from_hf_llama(hf.config)
 
 

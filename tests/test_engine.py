@@ -331,6 +331,40 @@ def test_paged_engine_validation(tiny):
         )
 
 
+def test_prefill_bucket_padding_keeps_rope_regime():
+    """Bucket padding must not flip length-sensitive rope scaling: a
+    5-token prompt served through a 32-wide bucket stays in longrope's
+    SHORT regime (orig=16), matching the unpadded forward exactly."""
+    short = (1.0,) * 8
+    long_ = (8.0,) * 8
+    cfg = TransformerConfig.tiny(
+        rope_scaling=("longrope", short, long_, 16, 2.0, 1.0)
+    )
+    model = Transformer(cfg)
+    params = model.init(jax.random.key(3))
+    rng = np.random.RandomState(9)
+    prompt = rng.randint(1, 256, size=5).tolist()
+
+    logits = model(params, jnp.asarray([prompt], jnp.int32))
+    want_first = int(jnp.argmax(logits[0, -1]))
+
+    from shifu_tpu.infer.engine import PagedEngine
+
+    for eng in (
+        Engine(
+            model, params, max_slots=1, max_len=32,
+            sample_cfg=SampleConfig(temperature=0.0), prefill_buckets=(32,),
+        ),
+        PagedEngine(
+            model, params, max_slots=1, max_len=32, page_size=8,
+            sample_cfg=SampleConfig(temperature=0.0), prefill_buckets=(32,),
+        ),
+    ):
+        eng.submit(prompt, max_new_tokens=1)
+        (done,) = eng.run()
+        assert done.tokens[0] == want_first, type(eng).__name__
+
+
 def test_engine_validation(tiny):
     model, params = tiny
     eng = Engine(model, params, max_slots=1, max_len=16,

@@ -134,6 +134,53 @@ def test_rope_legacy_bare_tuple_is_llama3():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b))
 
 
+def test_rope_longrope_regime_switch():
+    """Short factors while positions fit the original context; long
+    factors (a traced switch on max position) once they exceed it."""
+    short = (1.0, 1.0, 1.0, 1.0)
+    long_ = (4.0, 4.0, 4.0, 4.0)
+    scaling = ("longrope", short, long_, 32, 2.0, 1.0)  # attn_factor=1
+    plain = rope_frequencies(8, jnp.arange(16), theta=10_000.0)
+    got_short = rope_frequencies(
+        8, jnp.arange(16), theta=10_000.0, scaling=scaling
+    )
+    for a, b in zip(plain, got_short):  # short factors of 1.0 = vanilla
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+    quarter = rope_frequencies(8, jnp.arange(48) / 4.0, theta=10_000.0)
+    got_long = rope_frequencies(
+        8, jnp.arange(48), theta=10_000.0, scaling=scaling
+    )
+    for a, b in zip(quarter, got_long):  # all-4.0 long = positions / 4
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+        )
+
+
+def test_rope_regime_switch_is_per_row():
+    """In a (b, s) batch, each row picks its own regime — a long row
+    co-batched with a short one must not flip the short row (the served
+    decode path batches requests at different lengths)."""
+    short = (1.0,) * 4
+    long_ = (4.0,) * 4
+    scaling = ("longrope", short, long_, 32, 2.0, 1.0)
+    pos_short = jnp.asarray([[5]])  # within orig ctx
+    pos_long = jnp.asarray([[100]])  # past it
+    both = jnp.asarray([[5], [100]])
+    s_alone = rope_frequencies(8, pos_short, theta=10_000.0, scaling=scaling)
+    l_alone = rope_frequencies(8, pos_long, theta=10_000.0, scaling=scaling)
+    mixed = rope_frequencies(8, both, theta=10_000.0, scaling=scaling)
+    for got, want in ((mixed[0][0], s_alone[0][0]), (mixed[0][1], l_alone[0][0])):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-6)
+
+    # Same property for dynamic NTK's per-row base stretch.
+    dyn = ("dynamic", 4.0, 32)
+    s_alone = rope_frequencies(8, pos_short, theta=10_000.0, scaling=dyn)
+    mixed = rope_frequencies(8, both, theta=10_000.0, scaling=dyn)
+    np.testing.assert_allclose(
+        np.asarray(mixed[0][0]), np.asarray(s_alone[0][0]), rtol=1e-6
+    )
+
+
 def test_rope_dynamic_below_original_is_unscaled():
     # Sequences within the original context must see vanilla frequencies.
     pos = jnp.arange(16)
