@@ -3,6 +3,7 @@
     train     run the Trainer loop (real corpus dir or --synthetic)
     eval      perplexity over a dataset (params-only checkpoint read)
     generate  byte-tokenizer text completion from a checkpoint
+    serve     HTTP completions server (continuous batching, paged KV)
     info      devices, native-extension status, version
 
 The CLI builds everything from flags — model preset (optionally MoE),
@@ -210,6 +211,54 @@ def cmd_generate(args) -> int:
     return 0
 
 
+def cmd_serve(args) -> int:
+    from shifu_tpu.data.tokenizer import ByteTokenizer
+    from shifu_tpu.infer import Engine, PagedEngine, SampleConfig, make_server
+
+    model = _build_model(args)
+    params = _restore_params(args, model)
+    kw = dict(
+        max_slots=args.max_slots,
+        max_len=args.max_len,
+        sample_cfg=SampleConfig(
+            temperature=args.temperature, top_p=args.top_p
+        ),
+    )
+    if args.paged:
+        engine = PagedEngine(
+            model, params, page_size=args.page_size,
+            n_pages=args.n_pages, **kw,
+        )
+    else:
+        engine = Engine(model, params, **kw)
+    server = make_server(
+        engine,
+        host=args.host,
+        port=args.port,
+        tokenizer=ByteTokenizer(),
+        default_max_new=args.max_new_tokens,
+    )
+    print(
+        json.dumps(
+            {
+                "serving": f"http://{args.host}:{server.server_port}",
+                "engine": type(engine).__name__,
+                "slots": args.max_slots,
+                "max_len": args.max_len,
+            }
+        ),
+        flush=True,
+    )
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.shutdown()
+        server.runner.shutdown()
+    return 0
+
+
 def cmd_info(args) -> int:
     import jax
 
@@ -281,6 +330,22 @@ def main(argv=None) -> int:
     g.add_argument("--temperature", type=float, default=0.8)
     g.add_argument("--top-p", type=float, default=0.95)
     g.set_defaults(fn=cmd_generate)
+
+    s = sub.add_parser("serve", help="HTTP completions server")
+    model_flags(s, schedule_default="constant")
+    s.add_argument("--host", default="127.0.0.1")
+    s.add_argument("--port", type=int, default=8000)
+    s.add_argument("--max-slots", type=int, default=8)
+    s.add_argument("--max-len", type=int, default=2048)
+    s.add_argument("--max-new-tokens", type=int, default=128)
+    s.add_argument("--temperature", type=float, default=0.8)
+    s.add_argument("--top-p", type=float, default=0.95)
+    s.add_argument("--paged", action="store_true",
+                   help="paged KV pool instead of dense per-slot cache")
+    s.add_argument("--page-size", type=int, default=64)
+    s.add_argument("--n-pages", type=int, default=None,
+                   help="pool size (default: dense-equivalent)")
+    s.set_defaults(fn=cmd_serve)
 
     i = sub.add_parser("info", help="environment / device info")
     i.set_defaults(fn=cmd_info)

@@ -9,6 +9,7 @@ is the standard prefill + KV-cache decode design, TPU-first (static shapes,
 from shifu_tpu.infer.sampling import SampleConfig, sample_logits
 from shifu_tpu.infer.generate import generate, make_generate_fn
 from shifu_tpu.infer.engine import Completion, Engine, PagedEngine
+from shifu_tpu.infer.server import EngineRunner, make_server
 from shifu_tpu.infer.speculative import (
     SpecResult,
     make_speculative_fns,
@@ -31,7 +32,9 @@ __all__ = [
     "make_speculative_fns",
     "speculative_generate",
     "Engine",
+    "EngineRunner",
     "PagedEngine",
+    "make_server",
     "QuantizedModel",
     "dequantize_params",
     "param_nbytes",

@@ -1,0 +1,268 @@
+"""HTTP serving front-end over the continuous-batching engines.
+
+Stdlib-only (http.server + threading): one background thread owns the
+engine and the device — JAX dispatch stays single-threaded — while any
+number of HTTP worker threads block on per-request events. Submissions
+hand off through a locked inbox; the engine thread drains it between
+``step()`` calls, so a long decode never blocks admission for more than
+one step.
+
+    POST /v1/completions  {"prompt": "text"} | {"tokens": [int, ...]}
+                          + optional "max_new_tokens"
+                          -> {"tokens": [...], "text"?, "finished_by"}
+    GET  /healthz         -> engine stats (slots, queue, pages, ...)
+
+Sampling is engine-level (one compiled decode program per engine);
+per-request temperatures would mean per-request recompiles — serve
+multiple sampling profiles with multiple engines behind a router
+instead.
+
+Reference parity note: the upstream reference (klyan/shifu) is an empty
+repository (SURVEY.md); there is no reference server to match. The API
+shape follows the common completions-endpoint convention.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from shifu_tpu.infer.engine import Completion, Engine
+
+
+@dataclasses.dataclass
+class _Waiter:
+    event: threading.Event
+    completion: Optional[Completion] = None
+    error: Optional[Exception] = None
+
+
+class EngineRunner:
+    """Thread-safe facade: many callers, ONE engine/device thread.
+
+    ``complete(tokens, max_new)`` blocks the calling thread until the
+    engine finishes that request (or rejects it), without ever touching
+    the engine from the caller's thread.
+    """
+
+    def __init__(self, engine: Engine, *, poll_idle_s: float = 0.005):
+        self.engine = engine
+        self._poll_idle_s = poll_idle_s
+        self._lock = threading.Lock()
+        self._inbox: collections.deque = collections.deque()
+        self._waiters: dict = {}  # rid -> _Waiter
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+        self.fatal: Optional[Exception] = None  # set if the loop dies
+        self._thread = threading.Thread(
+            target=self._loop, name="shifu-engine", daemon=True
+        )
+        self._thread.start()
+
+    # ------------------------------------------------------------- callers
+    def complete(
+        self, tokens, max_new_tokens: int, timeout: Optional[float] = None
+    ) -> Completion:
+        if self.fatal is not None:
+            raise RuntimeError(
+                f"engine thread died: {self.fatal!r}"
+            ) from self.fatal
+        if self._stop.is_set():
+            raise RuntimeError("engine runner is shut down")
+        w = _Waiter(threading.Event())
+        with self._lock:
+            self._inbox.append((list(tokens), int(max_new_tokens), w))
+        self._wake.set()
+        if not w.event.wait(timeout):
+            raise TimeoutError(
+                f"no completion within {timeout}s (request may still run)"
+            )
+        if w.error is not None:
+            raise w.error
+        return w.completion
+
+    def stats(self) -> dict:
+        eng = self.engine
+        out = {
+            "active_slots": eng.active_slots,
+            "max_slots": eng.max_slots,
+            "queued": len(eng._queue) + len(self._inbox),
+            "idle": eng.idle,
+            "healthy": self.fatal is None and not self._stop.is_set(),
+        }
+        if self.fatal is not None:
+            out["fatal"] = repr(self.fatal)
+        for attr in ("free_pages", "n_pages", "preemptions"):
+            if hasattr(eng, attr):
+                out[attr] = getattr(eng, attr)
+        return out
+
+    def shutdown(self, timeout: float = 10.0) -> None:
+        self._stop.set()
+        self._wake.set()
+        self._thread.join(timeout)
+        # Unblock anyone still waiting: their work died with the loop.
+        with self._lock:
+            pending = list(self._inbox)
+            self._inbox.clear()
+            waiters = list(self._waiters.values())
+            self._waiters.clear()
+        for item in pending:
+            item[2].error = RuntimeError("engine runner shut down")
+            item[2].event.set()
+        for w in waiters:
+            w.error = RuntimeError("engine runner shut down")
+            w.event.set()
+
+    # ------------------------------------------------------------ the loop
+    def _drain_inbox(self) -> None:
+        while True:
+            with self._lock:
+                if not self._inbox:
+                    return
+                tokens, max_new, w = self._inbox.popleft()
+            try:
+                rid = self.engine.submit(tokens, max_new_tokens=max_new)
+            except Exception as e:  # validation error -> the caller
+                w.error = e
+                w.event.set()
+                continue
+            self._waiters[rid] = w
+
+    def _loop(self) -> None:
+        try:
+            while not self._stop.is_set():
+                self._drain_inbox()
+                if self.engine.idle:
+                    # Nothing in flight: sleep until a submission arrives.
+                    self._wake.wait(timeout=0.5)
+                    self._wake.clear()
+                    continue
+                for done in self.engine.step():
+                    w = self._waiters.pop(done.rid, None)
+                    if w is not None:
+                        w.completion = done
+                        w.event.set()
+        except Exception as e:  # device/engine failure: fail loudly,
+            # unblock EVERY current and queued waiter, mark unhealthy
+            # (healthz flips, complete() refuses new work).
+            self.fatal = e
+            self._stop.set()
+            err = RuntimeError(f"engine thread died: {e!r}")
+            err.__cause__ = e
+            with self._lock:
+                pending = list(self._inbox)
+                self._inbox.clear()
+                waiters = list(self._waiters.values())
+                self._waiters.clear()
+            for item in pending:
+                item[2].error = err
+                item[2].event.set()
+            for w in waiters:
+                w.error = err
+                w.event.set()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # Set by make_server():
+    runner: EngineRunner = None
+    tokenizer = None
+    default_max_new: int = 128
+    request_timeout_s: Optional[float] = None
+
+    def log_message(self, fmt, *args):  # quiet by default
+        pass
+
+    def _send(self, code: int, obj: dict) -> None:
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        if self.path == "/healthz":
+            self._send(200, self.runner.stats())
+        else:
+            self._send(404, {"error": f"no route {self.path}"})
+
+    def do_POST(self):
+        if self.path != "/v1/completions":
+            self._send(404, {"error": f"no route {self.path}"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            req = json.loads(self.rfile.read(length) or b"{}")
+        except (ValueError, json.JSONDecodeError):
+            self._send(400, {"error": "body must be JSON"})
+            return
+        tokens = req.get("tokens")
+        prompt = req.get("prompt")
+        if (tokens is None) == (prompt is None):
+            self._send(
+                400, {"error": "exactly one of 'tokens'/'prompt' required"}
+            )
+            return
+        if prompt is not None:
+            if self.tokenizer is None:
+                self._send(
+                    400,
+                    {"error": "no tokenizer configured; send 'tokens'"},
+                )
+                return
+            try:
+                tokens = self.tokenizer.encode(prompt)
+            except Exception as e:  # non-string prompt etc. -> a clean 400
+                self._send(400, {"error": f"cannot tokenize prompt: {e!r}"})
+                return
+        try:
+            max_new = int(req.get("max_new_tokens", self.default_max_new))
+            done = self.runner.complete(
+                tokens, max_new, timeout=self.request_timeout_s
+            )
+        except (ValueError, TypeError) as e:
+            self._send(400, {"error": str(e)})
+            return
+        except TimeoutError as e:
+            self._send(504, {"error": str(e)})
+            return
+        except RuntimeError as e:
+            self._send(503, {"error": str(e)})
+            return
+        out = {"tokens": done.tokens, "finished_by": done.finished_by}
+        if self.tokenizer is not None:
+            out["text"] = self.tokenizer.decode(done.tokens)
+        self._send(200, out)
+
+
+def make_server(
+    engine: Engine,
+    *,
+    host: str = "127.0.0.1",
+    port: int = 8000,
+    tokenizer=None,
+    default_max_new: int = 128,
+    request_timeout_s: Optional[float] = None,
+) -> ThreadingHTTPServer:
+    """Build (not start) the HTTP server; ``.runner`` holds the engine
+    thread. Serve with ``serve_forever()``; stop with ``shutdown()``
+    then ``server.runner.shutdown()``."""
+    runner = EngineRunner(engine)
+    handler = type(
+        "BoundHandler",
+        (_Handler,),
+        {
+            "runner": runner,
+            "tokenizer": tokenizer,
+            "default_max_new": default_max_new,
+            "request_timeout_s": request_timeout_s,
+        },
+    )
+    server = ThreadingHTTPServer((host, port), handler)
+    server.runner = runner
+    return server

@@ -1,0 +1,224 @@
+"""HTTP serving front-end: request/response, concurrency, errors."""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from shifu_tpu.infer import Engine, PagedEngine, SampleConfig, make_server
+from shifu_tpu.models import Transformer, TransformerConfig
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = TransformerConfig.tiny()
+    model = Transformer(cfg)
+    return model, model.init(jax.random.key(0))
+
+
+@pytest.fixture()
+def served(tiny):
+    model, params = tiny
+    engine = PagedEngine(
+        model, params, max_slots=2, max_len=32, page_size=8,
+        sample_cfg=SampleConfig(temperature=0.0), prefill_buckets=(16, 32),
+    )
+    server = make_server(engine, port=0)  # ephemeral port
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    try:
+        yield f"http://127.0.0.1:{server.server_port}", engine
+    finally:
+        server.shutdown()
+        server.runner.shutdown()
+        t.join(5)
+
+
+def _post(base, path, obj, timeout=120):
+    req = urllib.request.Request(
+        base + path,
+        data=json.dumps(obj).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def test_completion_matches_direct_engine(served, tiny):
+    base, _ = served
+    model, params = tiny
+    rng = np.random.RandomState(0)
+    prompt = rng.randint(1, 256, size=6).tolist()
+
+    status, out = _post(
+        base, "/v1/completions", {"tokens": prompt, "max_new_tokens": 5}
+    )
+    assert status == 200
+    assert out["finished_by"] == "length"
+
+    ref_eng = Engine(
+        model, params, max_slots=1, max_len=32,
+        sample_cfg=SampleConfig(temperature=0.0), prefill_buckets=(16,),
+    )
+    ref_eng.submit(prompt, max_new_tokens=5)
+    (ref,) = ref_eng.run()
+    assert out["tokens"] == ref.tokens
+
+
+def test_concurrent_requests_batch(served):
+    base, engine = served
+    rng = np.random.RandomState(1)
+    prompts = [rng.randint(1, 256, size=n).tolist() for n in (4, 7, 5, 9)]
+    results = [None] * len(prompts)
+
+    def worker(i):
+        results[i] = _post(
+            base, "/v1/completions",
+            {"tokens": prompts[i], "max_new_tokens": 4},
+        )
+
+    threads = [
+        threading.Thread(target=worker, args=(i,))
+        for i in range(len(prompts))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(120)
+    for i, r in enumerate(results):
+        assert r is not None, f"request {i} hung"
+        status, out = r
+        assert status == 200
+        assert len(out["tokens"]) == 4
+    assert engine.idle
+    assert engine.free_pages == engine.n_pages - 1
+
+
+def test_healthz(served):
+    base, _ = served
+    with urllib.request.urlopen(base + "/healthz", timeout=30) as r:
+        stats = json.loads(r.read())
+    assert stats["max_slots"] == 2
+    assert "free_pages" in stats  # paged engine exposes pool stats
+
+
+def test_error_paths(served):
+    base, _ = served
+    # Validation errors surface as 400 with the engine's message.
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(base, "/v1/completions", {"tokens": [], "max_new_tokens": 2})
+    assert e.value.code == 400
+    assert "empty" in json.loads(e.value.read())["error"]
+
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(base, "/v1/completions", {"max_new_tokens": 2})
+    assert e.value.code == 400
+
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(base, "/v1/completions", {"tokens": [1], "prompt": "x"})
+    assert e.value.code == 400
+
+    # No tokenizer configured on this server: text prompts are rejected.
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(base, "/v1/completions", {"prompt": "hello"})
+    assert e.value.code == 400
+
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(base, "/nope", {})
+    assert e.value.code == 404
+
+
+def test_text_prompt_with_tokenizer(tiny):
+    from shifu_tpu.data.tokenizer import ByteTokenizer
+
+    model, params = tiny
+    engine = Engine(
+        model, params, max_slots=1, max_len=32,
+        sample_cfg=SampleConfig(temperature=0.0), prefill_buckets=(16,),
+    )
+    server = make_server(engine, port=0, tokenizer=ByteTokenizer())
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    try:
+        base = f"http://127.0.0.1:{server.server_port}"
+        status, out = _post(
+            base, "/v1/completions",
+            {"prompt": "hi", "max_new_tokens": 3},
+        )
+        assert status == 200
+        assert isinstance(out["text"], str)
+        assert len(out["tokens"]) == 3
+    finally:
+        server.shutdown()
+        server.runner.shutdown()
+        t.join(5)
+
+
+def test_engine_thread_death_fails_waiters(tiny):
+    """A crashing engine must fail in-flight requests loudly and flip
+    healthz, not hang clients forever."""
+    from shifu_tpu.infer import EngineRunner
+
+    model, params = tiny
+
+    class Exploding(Engine):
+        def step(self):
+            raise RuntimeError("synthetic device failure")
+
+    engine = Exploding(
+        model, params, max_slots=1, max_len=32,
+        sample_cfg=SampleConfig(temperature=0.0), prefill_buckets=(16,),
+    )
+    runner = EngineRunner(engine)
+    with pytest.raises(RuntimeError, match="engine thread died"):
+        runner.complete([1, 2, 3], 4, timeout=120)
+    assert runner.fatal is not None
+    assert runner.stats()["healthy"] is False
+    # Subsequent submissions are refused immediately, not queued forever.
+    with pytest.raises(RuntimeError, match="engine thread died"):
+        runner.complete([1, 2, 3], 4, timeout=5)
+
+
+def test_non_string_prompt_is_400(tiny):
+    from shifu_tpu.data.tokenizer import ByteTokenizer
+
+    model, params = tiny
+    engine = Engine(
+        model, params, max_slots=1, max_len=32,
+        sample_cfg=SampleConfig(temperature=0.0), prefill_buckets=(16,),
+    )
+    server = make_server(engine, port=0, tokenizer=ByteTokenizer())
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    try:
+        base = f"http://127.0.0.1:{server.server_port}"
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(base, "/v1/completions", {"prompt": 5})
+        assert e.value.code == 400
+        assert "tokenize" in json.loads(e.value.read())["error"]
+    finally:
+        server.shutdown()
+        server.runner.shutdown()
+        t.join(5)
+
+
+def test_runner_shutdown_unblocks_waiters(tiny):
+    from shifu_tpu.infer import EngineRunner
+
+    model, params = tiny
+    engine = Engine(
+        model, params, max_slots=1, max_len=32,
+        sample_cfg=SampleConfig(temperature=0.0), prefill_buckets=(16,),
+    )
+    runner = EngineRunner(engine)
+    out = runner.complete([1, 2, 3], 2, timeout=120)
+    assert len(out.tokens) == 2
+    runner.shutdown()
+    with pytest.raises(RuntimeError, match="shut down"):
+        runner.complete([1, 2, 3], 2)
