@@ -217,12 +217,16 @@ def cmd_serve(args) -> int:
 
     model = _build_model(args)
     params = _restore_params(args, model)
+    tok = ByteTokenizer()
     kw = dict(
         max_slots=args.max_slots,
         max_len=args.max_len,
         sample_cfg=SampleConfig(
             temperature=args.temperature, top_p=args.top_p
         ),
+        # Same stop condition as cmd_generate for the same checkpoint:
+        # without it every request burns its whole budget past eos.
+        eos_id=tok.eos_id,
     )
     if args.paged:
         engine = PagedEngine(
@@ -235,7 +239,7 @@ def cmd_serve(args) -> int:
         engine,
         host=args.host,
         port=args.port,
-        tokenizer=ByteTokenizer(),
+        tokenizer=tok,
         default_max_new=args.max_new_tokens,
     )
     print(

@@ -67,14 +67,18 @@ class EngineRunner:
     def complete(
         self, tokens, max_new_tokens: int, timeout: Optional[float] = None
     ) -> Completion:
-        if self.fatal is not None:
-            raise RuntimeError(
-                f"engine thread died: {self.fatal!r}"
-            ) from self.fatal
-        if self._stop.is_set():
-            raise RuntimeError("engine runner is shut down")
         w = _Waiter(threading.Event())
+        # Check-and-append under ONE lock acquisition: the fatal/shutdown
+        # handlers drain the inbox under the same lock after setting
+        # _stop, so a waiter can never slip in behind the final drain
+        # and block forever.
         with self._lock:
+            if self.fatal is not None:
+                raise RuntimeError(
+                    f"engine thread died: {self.fatal!r}"
+                ) from self.fatal
+            if self._stop.is_set():
+                raise RuntimeError("engine runner is shut down")
             self._inbox.append((list(tokens), int(max_new_tokens), w))
         self._wake.set()
         if not w.event.wait(timeout):
@@ -131,7 +135,8 @@ class EngineRunner:
                 w.error = e
                 w.event.set()
                 continue
-            self._waiters[rid] = w
+            with self._lock:
+                self._waiters[rid] = w
 
     def _loop(self) -> None:
         try:
@@ -143,7 +148,8 @@ class EngineRunner:
                     self._wake.clear()
                     continue
                 for done in self.engine.step():
-                    w = self._waiters.pop(done.rid, None)
+                    with self._lock:
+                        w = self._waiters.pop(done.rid, None)
                     if w is not None:
                         w.completion = done
                         w.event.set()
@@ -236,7 +242,13 @@ class _Handler(BaseHTTPRequestHandler):
             return
         out = {"tokens": done.tokens, "finished_by": done.finished_by}
         if self.tokenizer is not None:
-            out["text"] = self.tokenizer.decode(done.tokens)
+            try:
+                out["text"] = self.tokenizer.decode(done.tokens)
+            except Exception as e:
+                # Sampled ids outside the tokenizer's range (e.g. byte
+                # tokenizer under a 32k-vocab model) must not turn a
+                # finished completion into a dropped connection.
+                out["text_error"] = repr(e)
         self._send(200, out)
 
 

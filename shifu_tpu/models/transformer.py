@@ -34,6 +34,7 @@ from shifu_tpu.parallel.ctx import constrain
 from shifu_tpu.ops import (
     apply_rope,
     dot_product_attention,
+    fused_softmax_cross_entropy,
     moe_capacity,
     rms_norm,
     rope_frequencies,
@@ -63,6 +64,10 @@ class TransformerConfig:
     tie_embeddings: bool = False
     z_loss: float = 1e-4
     remat: bool = True  # rematerialise each block in the backward pass
+    # Fused chunked cross-entropy: never materialise (b, s, vocab)
+    # logits (see Transformer.loss docstring). Off by default — it
+    # trades ~4% step time for gigabytes of HBM headroom.
+    fused_ce: bool = False
     # "dots" keeps matmul outputs and recomputes only elementwise ops in
     # the backward pass (~2.5% faster than "full" at equal fit on v5e);
     # "full" recomputes the whole block.
@@ -463,6 +468,7 @@ class Transformer(Module):
         page_table=None,
         logits_at=None,
         return_aux=False,
+        return_hidden=False,
         blocks_fn=None,
     ):
         """Compute logits.
@@ -490,6 +496,10 @@ class Transformer(Module):
           return_aux: also return the MoE aux-loss dict (mean over layers of
             {"lb", "rz", "dropped"}; None for a dense model). Training-path
             only — unsupported together with ``cache``.
+          return_hidden: return the post-final-norm hidden states
+            (b, s, d) INSTEAD of logits, skipping the unembed — the
+            fused-CE loss consumes these so the (b, s, vocab) logits
+            never materialise. Training path only (no cache).
           blocks_fn: optional override for the block-stack execution:
             ``(stacked_block_params, h, sin, cos, segment_ids) -> h``, or
             ``-> (h, moe_aux)`` for an MoE config (aux = pytree of f32
@@ -589,6 +599,15 @@ class Transformer(Module):
             h, (new_cache, auxes) = jax.lax.scan(body, h, (p["blocks"], cache))
 
         h = rms_norm(h, p["final_norm"], eps=cfg.norm_eps)
+        moe_aux = (
+            jax.tree_util.tree_map(jnp.mean, auxes)
+            if (return_aux or return_hidden) and cfg.n_experts
+            else None
+        )
+        if return_hidden:
+            if cache is not None:
+                raise ValueError("return_hidden is a training-path flag")
+            return (h, moe_aux) if return_aux else h
         if logits_at is not None:
             h = jnp.take_along_axis(h, logits_at[:, None, None], axis=1)
         if cfg.tie_embeddings:
@@ -598,21 +617,28 @@ class Transformer(Module):
         logits = constrain(logits, ("batch", "seq", "act_vocab"))
         logits = self.policy.cast_to_output(logits)
         if return_aux:
-            moe_aux = (
-                jax.tree_util.tree_map(jnp.mean, auxes)
-                if cfg.n_experts
-                else None
-            )
             return logits, moe_aux
         return logits if cache is None else (logits, new_cache)
 
     # ------------------------------------------------------------------- loss
-    def loss(self, params, batch, *, blocks_fn=None):
+    def loss(self, params, batch, *, blocks_fn=None, fused_ce=None):
         """Next-token loss. batch: {"tokens": (b, s), optional "mask",
-        "segment_ids", "positions"}. Predicts tokens[:, 1:]."""
+        "segment_ids", "positions"}. Predicts tokens[:, 1:].
+
+        ``fused_ce`` (default: the config's ``fused_ce`` flag): fuse the
+        unembed matmul into a sequence-chunked, rematerialised
+        cross-entropy so the (b, s, vocab) logits — the largest tensor
+        of a training step — never materialise in HBM
+        (ops.losses.fused_softmax_cross_entropy). A MEMORY feature: the
+        backward recomputes the unembed, costing ~4% throughput at
+        b8 x s2048 x v32k on v5e — enable it when the logits tensor is
+        what forces a smaller batch/model (large vocab, long seq).
+        """
         cfg = self.cfg
+        if fused_ce is None:
+            fused_ce = cfg.fused_ce
         tokens = batch["tokens"]
-        logits, moe_aux = self(
+        out = self(
             params,
             tokens[:, :-1],
             blocks_fn=blocks_fn,
@@ -627,13 +653,30 @@ class Transformer(Module):
                 else None
             ),
             return_aux=True,
+            return_hidden=fused_ce,
         )
         mask = batch.get("mask")
         if mask is not None:
             mask = mask[:, 1:]
-        loss, aux = softmax_cross_entropy(
-            logits, tokens[:, 1:], mask=mask, z_loss=cfg.z_loss
-        )
+        if fused_ce:
+            h, moe_aux = out
+            w = (
+                params["embed"].T
+                if cfg.tie_embeddings
+                else params["unembed"]
+            )
+            loss, aux = fused_softmax_cross_entropy(
+                h,
+                self.policy.cast_to_compute(w),
+                tokens[:, 1:],
+                mask=mask,
+                z_loss=cfg.z_loss,
+            )
+        else:
+            logits, moe_aux = out
+            loss, aux = softmax_cross_entropy(
+                logits, tokens[:, 1:], mask=mask, z_loss=cfg.z_loss
+            )
         if moe_aux is not None:
             loss = (
                 loss

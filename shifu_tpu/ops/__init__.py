@@ -1,7 +1,10 @@
 from shifu_tpu.ops.norms import rms_norm
 from shifu_tpu.ops.rope import apply_rope, rope_frequencies
 from shifu_tpu.ops.attention import dot_product_attention
-from shifu_tpu.ops.losses import softmax_cross_entropy
+from shifu_tpu.ops.losses import (
+    fused_softmax_cross_entropy,
+    softmax_cross_entropy,
+)
 from shifu_tpu.ops.moe import moe_capacity, route_top_k
 
 __all__ = [
@@ -9,6 +12,7 @@ __all__ = [
     "apply_rope",
     "rope_frequencies",
     "dot_product_attention",
+    "fused_softmax_cross_entropy",
     "softmax_cross_entropy",
     "moe_capacity",
     "route_top_k",

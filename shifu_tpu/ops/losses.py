@@ -3,6 +3,13 @@
 Cross-entropy takes logits in any float dtype, reduces in f32, and supports
 a z-loss term (pulls log-Z toward 0, stabilising bf16 logits over long runs)
 and a validity mask for padded / packed batches.
+
+``fused_softmax_cross_entropy`` additionally fuses the unembed matmul into
+the loss, chunked over the sequence: the (b, s, vocab) logits tensor —
+the single largest array in a training step (2 GB+ at b8 s2048 v32k f32)
+— is never materialised in HBM; each chunk's logits live only inside a
+rematerialised scan step and are recomputed for the backward. Same math,
+same f32 reductions, minus gigabytes of HBM traffic and residency.
 """
 
 from __future__ import annotations
@@ -11,6 +18,8 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+
+from shifu_tpu.parallel.ctx import constrain
 
 
 def softmax_cross_entropy(
@@ -50,6 +59,82 @@ def softmax_cross_entropy(
         ce_sum = jnp.sum(ce * w)
         z_sum = jnp.sum(z * w)
 
+    ce_mean = ce_sum / denom
+    z_mean = z_sum / denom
+    loss = ce_mean + z_loss * z_mean
+    return loss, {"ce": ce_mean, "z": z_mean, "denominator": denom}
+
+
+def fused_softmax_cross_entropy(
+    h,
+    unembed,
+    labels,
+    *,
+    mask: Optional[jax.Array] = None,
+    z_loss: float = 0.0,
+    chunk: int = 512,
+):
+    """Mean token cross-entropy with the unembed matmul fused in.
+
+    Args:
+      h: (b, s, d) final hidden states (post final-norm), any float dtype.
+      unembed: (d, vocab) projection (pass ``embed.T`` for tied
+        embeddings; under jit the transpose is a layout change XLA folds
+        into the matmul).
+      labels: (b, s) int token ids.
+      mask / z_loss: as :func:`softmax_cross_entropy`.
+      chunk: sequence positions per scan step. Each step materialises
+        only a (b, chunk, vocab) logits block; the step is
+        rematerialised so the backward recomputes it instead of saving
+        it. 512 is throughput-neutral vs unfused on v5e while bounding
+        transient logits to ~b*chunk*vocab*4 bytes (smaller chunks
+        trade a few % of throughput for tighter memory).
+
+    Returns: (loss, aux) — identical contract (and, up to summation
+    order, identical values) to computing full logits then
+    :func:`softmax_cross_entropy`.
+    """
+    b, s, d = h.shape
+    chunk = min(chunk, s)
+    pad = (-s) % chunk
+    w = (
+        mask.astype(jnp.float32)
+        if mask is not None
+        else jnp.ones((b, s), jnp.float32)
+    )
+    if pad:
+        h = jnp.pad(h, ((0, 0), (0, pad), (0, 0)))
+        labels = jnp.pad(labels, ((0, 0), (0, pad)))
+        w = jnp.pad(w, ((0, 0), (0, pad)))  # pad positions weigh 0
+    n = (s + pad) // chunk
+    hc = h.reshape(b, n, chunk, d).swapaxes(0, 1)  # (n, b, chunk, d)
+    lc = labels.reshape(b, n, chunk).swapaxes(0, 1)
+    wc = w.reshape(b, n, chunk).swapaxes(0, 1)
+
+    @jax.checkpoint
+    def body(carry, xs):
+        ce_sum, z_sum = carry
+        h_c, lbl_c, w_c = xs
+        logits = jnp.einsum(
+            "bcd,dv->bcv", h_c, unembed, preferred_element_type=jnp.float32
+        )
+        logits = constrain(logits, ("batch", "seq", "act_vocab"))
+        log_z = jax.nn.logsumexp(logits, axis=-1)
+        label_logits = jnp.take_along_axis(
+            logits, lbl_c[..., None], axis=-1
+        ).squeeze(-1)
+        ce_sum = ce_sum + jnp.sum((log_z - label_logits) * w_c)
+        z_sum = z_sum + jnp.sum(jnp.square(log_z) * w_c)
+        return (ce_sum, z_sum), None
+
+    (ce_sum, z_sum), _ = jax.lax.scan(
+        body, (jnp.float32(0.0), jnp.float32(0.0)), (hc, lc, wc)
+    )
+    denom = (
+        jnp.asarray(b * s, jnp.float32)
+        if mask is None
+        else jnp.maximum(jnp.sum(w), 1.0)
+    )
     ce_mean = ce_sum / denom
     z_mean = z_sum / denom
     loss = ce_mean + z_loss * z_mean

@@ -577,7 +577,7 @@ def flash_attention(
     causal: bool = True,
     scale: Optional[float] = None,
     segment_ids: Optional[jax.Array] = None,
-    block_q: int = 512,
+    block_q: int = 1024,
     block_k: int = 1024,
     interpret: Optional[bool] = None,
     window: Optional[int] = None,
@@ -593,6 +593,8 @@ def flash_attention(
       segment_ids: optional (batch, seq) int segments for packed sequences;
         requires q_len == kv_len (same contract as the XLA path).
       block_q, block_k: tile sizes (clamped to the sequence lengths).
+        1024/1024 measured best for the training shapes on v5e (~4%
+        over 512/1024; smaller tiles lose up to 15%).
       interpret: force pallas interpret mode; default: interpret unless
         running on TPU (so CPU tests exercise the same kernel code).
 

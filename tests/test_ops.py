@@ -192,6 +192,102 @@ def test_rope_dynamic_below_original_is_unscaled():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
 
 
+# ---------------- fused cross-entropy ----------------
+
+def test_fused_ce_matches_reference():
+    from shifu_tpu.ops import fused_softmax_cross_entropy
+
+    rs = np.random.RandomState(0)
+    b, s, d, v = 2, 37, 16, 64  # s deliberately not a chunk multiple
+    h = jnp.asarray(rs.randn(b, s, d), jnp.float32)
+    w = jnp.asarray(rs.randn(d, v) * 0.1, jnp.float32)
+    labels = jnp.asarray(rs.randint(0, v, (b, s)), jnp.int32)
+    mask = jnp.asarray(rs.rand(b, s) > 0.3, jnp.float32)
+
+    logits = jnp.einsum("bsd,dv->bsv", h, w)
+    for m in (None, mask):
+        want, want_aux = softmax_cross_entropy(
+            logits, labels, mask=m, z_loss=1e-3
+        )
+        got, got_aux = fused_softmax_cross_entropy(
+            h, w, labels, mask=m, z_loss=1e-3, chunk=16
+        )
+        np.testing.assert_allclose(
+            float(got), float(want), rtol=1e-6, err_msg=str(m is None)
+        )
+        for k in want_aux:
+            np.testing.assert_allclose(
+                float(got_aux[k]), float(want_aux[k]), rtol=1e-6, err_msg=k
+            )
+
+
+def test_fused_ce_gradients_match():
+    from shifu_tpu.ops import fused_softmax_cross_entropy
+
+    rs = np.random.RandomState(1)
+    b, s, d, v = 2, 24, 8, 32
+    h = jnp.asarray(rs.randn(b, s, d), jnp.float32)
+    w = jnp.asarray(rs.randn(d, v) * 0.1, jnp.float32)
+    labels = jnp.asarray(rs.randint(0, v, (b, s)), jnp.int32)
+
+    def ref(h, w):
+        return softmax_cross_entropy(
+            jnp.einsum("bsd,dv->bsv", h, w), labels, z_loss=1e-3
+        )[0]
+
+    def fused(h, w):
+        return fused_softmax_cross_entropy(
+            h, w, labels, z_loss=1e-3, chunk=8
+        )[0]
+
+    g_ref = jax.grad(ref, argnums=(0, 1))(h, w)
+    g_fused = jax.jit(jax.grad(fused, argnums=(0, 1)))(h, w)
+    for a, b_ in zip(g_ref, g_fused):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b_), rtol=1e-5, atol=1e-7
+        )
+
+
+def test_model_loss_fused_matches_unfused():
+    from shifu_tpu.core.dtypes import FULL_F32
+    from shifu_tpu.models import Transformer, TransformerConfig
+
+    for cfg in (
+        TransformerConfig.tiny(remat=False),
+        TransformerConfig.tiny(remat=False, tie_embeddings=True),
+    ):
+        model = Transformer(cfg, policy=FULL_F32)
+        params = model.init(jax.random.key(0))
+        rs = np.random.RandomState(2)
+        batch = {
+            "tokens": jnp.asarray(rs.randint(0, 256, (2, 33)), jnp.int32),
+            "mask": jnp.asarray(rs.rand(2, 33) > 0.2, jnp.float32),
+        }
+        want, want_aux = model.loss(params, batch, fused_ce=False)
+        got, got_aux = model.loss(params, batch, fused_ce=True)
+        np.testing.assert_allclose(
+            float(got), float(want), rtol=1e-5,
+            err_msg=f"tied={cfg.tie_embeddings}",
+        )
+        np.testing.assert_allclose(
+            float(got_aux["ce"]), float(want_aux["ce"]), rtol=1e-5
+        )
+        g_want = jax.grad(lambda p: model.loss(p, batch, fused_ce=False)[0])(
+            params
+        )
+        g_got = jax.grad(lambda p: model.loss(p, batch, fused_ce=True)[0])(
+            params
+        )
+        for (ka, a), (_, b_) in zip(
+            jax.tree_util.tree_leaves_with_path(g_want),
+            jax.tree_util.tree_leaves_with_path(g_got),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b_), rtol=2e-4, atol=1e-6,
+                err_msg=str(ka),
+            )
+
+
 # ---------------- attention ----------------
 
 def _ref_attention(q, k, v, causal=True):

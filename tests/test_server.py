@@ -208,6 +208,40 @@ def test_non_string_prompt_is_400(tiny):
         t.join(5)
 
 
+def test_undecodable_tokens_still_return_200(tiny):
+    """A tokenizer that cannot decode the sampled ids (byte tokenizer
+    under a big-vocab model) must not turn a completion into a dropped
+    connection."""
+    model, params = tiny
+
+    class HalfTokenizer:
+        def encode(self, s):
+            return [1 + (b % 250) for b in s.encode()]
+
+        def decode(self, ids):
+            raise ValueError("id out of range")
+
+    engine = Engine(
+        model, params, max_slots=1, max_len=32,
+        sample_cfg=SampleConfig(temperature=0.0), prefill_buckets=(16,),
+    )
+    server = make_server(engine, port=0, tokenizer=HalfTokenizer())
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    try:
+        base = f"http://127.0.0.1:{server.server_port}"
+        status, out = _post(
+            base, "/v1/completions", {"prompt": "abc", "max_new_tokens": 3}
+        )
+        assert status == 200
+        assert len(out["tokens"]) == 3
+        assert "text" not in out and "out of range" in out["text_error"]
+    finally:
+        server.shutdown()
+        server.runner.shutdown()
+        t.join(5)
+
+
 def test_runner_shutdown_unblocks_waiters(tiny):
     from shifu_tpu.infer import EngineRunner
 
