@@ -224,9 +224,14 @@ def cmd_serve(args) -> int:
         sample_cfg=SampleConfig(
             temperature=args.temperature, top_p=args.top_p
         ),
-        # Same stop condition as cmd_generate for the same checkpoint:
-        # without it every request burns its whole budget past eos.
-        eos_id=tok.eos_id,
+        # Same default stop condition as cmd_generate (the CLI is wired
+        # to the byte tokenizer); --eos-id overrides for checkpoints
+        # trained with another vocab, --eos-id -1 disables.
+        eos_id=(
+            None
+            if args.eos_id == -1
+            else (tok.eos_id if args.eos_id is None else args.eos_id)
+        ),
     )
     if args.paged:
         engine = PagedEngine(
@@ -344,6 +349,9 @@ def main(argv=None) -> int:
     s.add_argument("--max-new-tokens", type=int, default=128)
     s.add_argument("--temperature", type=float, default=0.8)
     s.add_argument("--top-p", type=float, default=0.95)
+    s.add_argument("--eos-id", type=int, default=None,
+                   help="stop token id (default: byte-tokenizer eos; "
+                        "-1 disables eos stopping)")
     s.add_argument("--paged", action="store_true",
                    help="paged KV pool instead of dense per-slot cache")
     s.add_argument("--page-size", type=int, default=64)

@@ -136,6 +136,15 @@ def config_from_hf_llama(hf_config, **overrides) -> TransformerConfig:
         rope_scaling=rope_scaling,
         norm_eps=hf_config.rms_norm_eps,
         tie_embeddings=getattr(hf_config, "tie_word_embeddings", False),
+        # Qwen2 hardcodes q/k/v biases (no o bias); Llama-family configs
+        # say so via attention_bias. attention_bias=True on an actual
+        # LlamaConfig ALSO biases o_proj, which this layout does not
+        # carry — params_from_hf_llama then fails loudly on the
+        # unconsumed o_proj.bias tensors rather than dropping them.
+        qkv_bias=(
+            bool(getattr(hf_config, "attention_bias", False))
+            or getattr(hf_config, "model_type", "") == "qwen2"
+        ),
         # Qwen2-style configs carry sliding_window but gate it off with
         # use_sliding_window=False — honoring the value unconditionally
         # would silently diverge from the HF forward at long context.
@@ -205,6 +214,16 @@ def params_from_hf_llama(
         "w_up": stack("layers.{}.mlp.up_proj.weight", lambda w: w.T),
         "w_down": stack("layers.{}.mlp.down_proj.weight", lambda w: w.T),
     }
+    if cfg.qkv_bias:
+        blocks["bq"] = stack(
+            "layers.{}.self_attn.q_proj.bias", lambda b: b.reshape(h, hd)
+        )
+        blocks["bk"] = stack(
+            "layers.{}.self_attn.k_proj.bias", lambda b: b.reshape(kv, hd)
+        )
+        blocks["bv"] = stack(
+            "layers.{}.self_attn.v_proj.bias", lambda b: b.reshape(kv, hd)
+        )
     params = {
         "embed": jnp.asarray(get("embed_tokens.weight"), dtype),
         "blocks": blocks,
@@ -235,12 +254,16 @@ def params_from_hf_llama(
 
 
 def to_hf_llama_state_dict(params, cfg: TransformerConfig):
-    """shifu_tpu params -> HF Llama state_dict (numpy tensors).
+    """shifu_tpu params -> HF Llama-layout state_dict (numpy tensors).
 
     Exact inverse of :func:`params_from_hf_llama` (round-trip tested), so
     TPU-trained weights load into `transformers` for publication or
     GPU serving: ``LlamaForCausalLM(config).load_state_dict({k:
-    torch.from_numpy(v) for k, v in sd.items()})``.
+    torch.from_numpy(v) for k, v in sd.items()})``. With
+    ``cfg.qkv_bias`` the export carries q/k/v (not o) bias keys — the
+    Qwen2 convention — so load it into ``Qwen2ForCausalLM``; Llama's
+    ``attention_bias=True`` expects an o_proj bias this layout does not
+    have.
     """
     L = cfg.n_layers
     d, h, kv, hd = (
@@ -273,6 +296,16 @@ def to_hf_llama_state_dict(params, cfg: TransformerConfig):
         sd[p + "mlp.gate_proj.weight"] = np_(blocks["w_gate"][l]).T
         sd[p + "mlp.up_proj.weight"] = np_(blocks["w_up"][l]).T
         sd[p + "mlp.down_proj.weight"] = np_(blocks["w_down"][l]).T
+        if cfg.qkv_bias:
+            sd[p + "self_attn.q_proj.bias"] = np_(blocks["bq"][l]).reshape(
+                h * hd
+            )
+            sd[p + "self_attn.k_proj.bias"] = np_(blocks["bk"][l]).reshape(
+                kv * hd
+            )
+            sd[p + "self_attn.v_proj.bias"] = np_(blocks["bv"][l]).reshape(
+                kv * hd
+            )
     sd["model.norm.weight"] = np_(params["final_norm"]) + 1.0
     if cfg.tie_embeddings:
         # torch state_dicts list tied params under BOTH names; omitting

@@ -84,6 +84,8 @@ class TransformerConfig:
     # Sliding-window attention (Mistral-style): each query sees at most
     # the last window_size positions. None = full causal attention.
     window_size: Optional[int] = None
+    # Biases on the q/k/v projections (Qwen2 convention: qkv yes, o no).
+    qkv_bias: bool = False
 
     @property
     def resolved_head_dim(self) -> int:
@@ -179,6 +181,18 @@ def _block_specs(cfg: TransformerConfig):
         ),
         "mlp_norm": ParamSpec((L, d), ("layers", "embed"), initializers.zeros),
     }
+    if cfg.qkv_bias:
+        specs["bq"] = ParamSpec(
+            (L, h, hd), ("layers", "heads", "head_dim"), initializers.zeros
+        )
+        specs["bk"] = ParamSpec(
+            (L, kv, hd), ("layers", "kv_heads", "head_dim"),
+            initializers.zeros,
+        )
+        specs["bv"] = ParamSpec(
+            (L, kv, hd), ("layers", "kv_heads", "head_dim"),
+            initializers.zeros,
+        )
     if cfg.n_experts:
         E = cfg.n_experts
         # Router output dim deliberately has no logical axis: the router is
@@ -253,6 +267,10 @@ class Transformer(Module):
         q = jnp.einsum("bsd,dhk->bshk", x, p["wq"])
         k = jnp.einsum("bsd,dhk->bshk", x, p["wk"])
         v = jnp.einsum("bsd,dhk->bshk", x, p["wv"])
+        if cfg.qkv_bias:
+            q = q + p["bq"]
+            k = k + p["bk"]
+            v = v + p["bv"]
         q = apply_rope(q, sin, cos)
         k = apply_rope(k, sin, cos)
 
@@ -607,6 +625,12 @@ class Transformer(Module):
         if return_hidden:
             if cache is not None:
                 raise ValueError("return_hidden is a training-path flag")
+            if logits_at is not None:
+                raise ValueError(
+                    "logits_at selects positions of the LOGITS; with "
+                    "return_hidden it would be silently ignored — slice "
+                    "the returned hidden states instead"
+                )
             return (h, moe_aux) if return_aux else h
         if logits_at is not None:
             h = jnp.take_along_axis(h, logits_at[:, None, None], axis=1)
@@ -705,6 +729,8 @@ class Transformer(Module):
             # (L, h, hd, d): contraction is (heads, head_dim).
             "wo": (1, 2),
         }
+        if cfg.qkv_bias:
+            blocks["bq"] = blocks["bk"] = blocks["bv"] = ()  # tiny; exact
         if cfg.n_experts:
             blocks["router"] = ()
             blocks["w_gate"] = (2,)  # (L, E, d, m): contract d

@@ -81,6 +81,18 @@ def create_sharded_state(
     return jax.jit(build, out_shardings=shardings)(rng)
 
 
+def decayed_by_axes(axes: tuple) -> bool:
+    """Weight-decay classification from a param's logical axes: decayed
+    iff it has >= 2 non-"layers" dimensions (stacked norm scales stay
+    undecayed) — EXCEPT per-head biases (("heads"|"kv_heads"),
+    "head_dim"), which are morally 1-D (shaped per-head only so tp
+    sharding lines up) and stay undecayed like every bias/scale."""
+    non_layer = tuple(x for x in axes if x != "layers")
+    if non_layer in (("heads", "head_dim"), ("kv_heads", "head_dim")):
+        return False
+    return len(non_layer) >= 2
+
+
 def make_train_step(
     model,
     optimizer,
@@ -144,12 +156,10 @@ def make_train_step(
             aux = jax.tree_util.tree_map(jnp.mean, auxes)
         return jnp.mean(losses), aux, grads
 
-    # Weight decay mask from logical axes: a param is decayed iff it has
-    # >= 2 non-"layers" dimensions (so stacked norm scales stay undecayed).
     decay_mask = None
     if hasattr(model, "axes"):
         decay_mask = jax.tree_util.tree_map(
-            lambda a: len([x for x in a if x != "layers"]) >= 2,
+            decayed_by_axes,
             model.axes(),
             is_leaf=lambda x: isinstance(x, tuple),
         )

@@ -177,6 +177,61 @@ def test_rope_scaling_variants_parity(scaling):
     np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
 
 
+def tiny_hf_qwen2(**kw):
+    from transformers import Qwen2Config, Qwen2ForCausalLM
+
+    defaults = dict(
+        vocab_size=128,
+        hidden_size=32,
+        intermediate_size=64,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        rms_norm_eps=1e-6,
+        rope_theta=10_000.0,
+        tie_word_embeddings=False,
+    )
+    defaults.update(kw)
+    torch.manual_seed(1)
+    return Qwen2ForCausalLM(Qwen2Config(**defaults)).eval()
+
+
+def test_qwen2_logits_match_torch_forward():
+    # Qwen2 = Llama layout + q/k/v biases (hardcoded in HF, no o bias).
+    hf = tiny_hf_qwen2()
+    model, params = from_hf_llama(hf)
+    assert model.cfg.qkv_bias
+    assert params["blocks"]["bq"].shape == (2, 4, 8)
+    model = Transformer(model.cfg, policy=FULL_F32)
+    tokens = np.random.RandomState(10).randint(0, 128, (2, 11))
+    with torch.no_grad():
+        want = hf(torch.tensor(tokens)).logits.float().numpy()
+    got = np.asarray(model(params, jnp.asarray(tokens, jnp.int32)))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_qwen2_roundtrip_state_dict():
+    from shifu_tpu.models.convert import to_hf_llama_state_dict
+
+    hf = tiny_hf_qwen2()
+    model, params = from_hf_llama(hf)
+    sd = to_hf_llama_state_dict(params, model.cfg)
+    orig = hf.state_dict()
+    assert set(sd) == set(orig)
+    for k, v in sd.items():
+        np.testing.assert_allclose(
+            v, orig[k].float().numpy(), rtol=1e-6, atol=1e-7, err_msg=k
+        )
+
+
+def test_llama_attention_bias_o_proj_fails_loudly():
+    # attention_bias=True on Llama biases o_proj too, which this layout
+    # does not carry — must raise, not silently drop trained weights.
+    hf = tiny_hf_llama(attention_bias=True)
+    with pytest.raises(ValueError, match="not consumed"):
+        from_hf_llama(hf)
+
+
 def test_longrope_scaling_parity():
     # Phi-3-style LongRoPE through a Llama body: per-dim long factors
     # engage at seq 48 > original 32, with the sqrt(1+ln f/ln orig)

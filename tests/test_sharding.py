@@ -149,14 +149,14 @@ def test_decay_mask_skips_stacked_norm_scales():
 
 
 def test_transformer_axes_classify_decay_correctly():
-    """The real Transformer's logical axes must put norm scales (stacked or
-    not) outside weight decay and real weight matrices inside it, under the
-    rule make_train_step uses: decay iff >= 2 non-'layers' dims."""
-    model = Transformer(TransformerConfig.tiny())
-    axes = model.axes()
+    """The real Transformer's logical axes must put norm scales and
+    per-head biases outside weight decay and real weight matrices inside
+    it, under THE rule make_train_step uses (train.step.decayed_by_axes,
+    imported — not re-derived — so this test cannot drift)."""
+    from shifu_tpu.train.step import decayed_by_axes as decays
 
-    def decays(a):
-        return len([x for x in a if x != "layers"]) >= 2
+    model = Transformer(TransformerConfig.tiny(qkv_bias=True))
+    axes = model.axes()
 
     assert not decays(axes["blocks"]["attn_norm"])   # (layers, embed)
     assert not decays(axes["blocks"]["mlp_norm"])
@@ -165,6 +165,10 @@ def test_transformer_axes_classify_decay_correctly():
     assert decays(axes["unembed"])
     assert decays(axes["blocks"]["w_up"])            # (layers, embed, mlp)
     assert decays(axes["blocks"]["wq"])              # (layers, embed, h, hd)
+    # Per-head biases: 2 non-layer dims but morally 1-D -> undecayed.
+    assert not decays(axes["blocks"]["bq"])          # (layers, h, hd)
+    assert not decays(axes["blocks"]["bk"])
+    assert not decays(axes["blocks"]["bv"])
 
 
 def test_microbatch_aux_token_weighted():
