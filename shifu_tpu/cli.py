@@ -232,6 +232,7 @@ def cmd_serve(args) -> int:
             if args.eos_id == -1
             else (tok.eos_id if args.eos_id is None else args.eos_id)
         ),
+        decode_chunk=args.decode_chunk,
     )
     if args.paged:
         engine = PagedEngine(
@@ -349,6 +350,10 @@ def main(argv=None) -> int:
     s.add_argument("--max-new-tokens", type=int, default=128)
     s.add_argument("--temperature", type=float, default=0.8)
     s.add_argument("--top-p", type=float, default=0.95)
+    s.add_argument("--decode-chunk", type=int, default=8,
+                   help="tokens decoded per host round-trip (1 = sync "
+                        "every token; higher amortises dispatch latency "
+                        "at the cost of chunk-granular admission)")
     s.add_argument("--eos-id", type=int, default=None,
                    help="stop token id (default: byte-tokenizer eos; "
                         "-1 disables eos stopping)")

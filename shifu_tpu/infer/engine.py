@@ -72,13 +72,24 @@ class Engine:
         prefill_buckets=(64, 128, 256, 512, 1024, 2048),
         cache_dtype=jnp.bfloat16,
         rng: Optional[jax.Array] = None,
+        decode_chunk: int = 1,
     ):
+        """``decode_chunk``: tokens decoded per host round-trip. 1 (the
+        default) syncs every token — finest admission granularity. >1
+        runs a K-step on-device scan with per-row eos/budget masking and
+        syncs once per chunk: on a remote/tunnelled TPU where dispatch
+        latency dominates decode, throughput scales almost linearly with
+        K, at the cost of admitting new requests only at chunk
+        boundaries (and, paged, preempting at chunk granularity)."""
         self.model = model
         self.params = params
         self.max_slots = max_slots
         self.max_len = max_len
         self.sample_cfg = sample_cfg
         self.eos_id = eos_id
+        if decode_chunk < 1:
+            raise ValueError(f"decode_chunk must be >= 1, got {decode_chunk}")
+        self.decode_chunk = int(decode_chunk)
         self.buckets = tuple(
             b for b in sorted(prefill_buckets) if b <= max_len
         )
@@ -100,6 +111,9 @@ class Engine:
             self._prefill_impl, static_argnames=("bucket",), donate_argnums=(1,)
         )
         self._decode_jit = jax.jit(self._decode_impl, donate_argnums=(1,))
+        self._decode_chunk_jit = jax.jit(
+            self._decode_chunk_impl, donate_argnums=(1,)
+        )
 
     # ------------------------------------------------------------ public
     def submit(self, prompt_tokens, max_new_tokens: int) -> int:
@@ -148,7 +162,7 @@ class Engine:
         done = self._sweep()
         if not self._active:
             return done
-        self._pre_decode()
+        self._pre_decode(self.decode_chunk)
         if not self._active:  # paged preemption can clear the field
             return done
 
@@ -158,17 +172,34 @@ class Engine:
             [s in self._active for s in range(self.max_slots)], bool
         )
         self._rng, sub = jax.random.split(self._rng)
-        nxt, self.cache = self._decode_jit(
-            self.params, self.cache, cur, lengths, active,
-            *self._decode_extra_args(), sub,
-        )
-        nxt = np.asarray(nxt)
-
-        for slot, req in self._active.items():
-            token = int(nxt[slot])
-            req.generated.append(token)
-            self._lengths[slot] += 1
-            self._cur[slot] = token
+        if self.decode_chunk == 1:
+            nxt, self.cache = self._decode_jit(
+                self.params, self.cache, cur, lengths, active,
+                *self._decode_extra_args(), sub,
+            )
+            nxt = np.asarray(nxt)
+            for slot, req in self._active.items():
+                token = int(nxt[slot])
+                req.generated.append(token)
+                self._lengths[slot] += 1
+                self._cur[slot] = token
+        else:
+            remaining = np.zeros((self.max_slots,), np.int32)
+            for slot, req in self._active.items():
+                remaining[slot] = req.max_new_tokens - len(req.generated)
+            toks, n_emit, cur2, lengths2, self.cache = (
+                self._decode_chunk_jit(
+                    self.params, self.cache, cur, lengths, active,
+                    jnp.asarray(remaining), *self._decode_extra_args(), sub,
+                )
+            )
+            toks, n_emit = np.asarray(toks), np.asarray(n_emit)
+            cur2, lengths2 = np.asarray(cur2), np.asarray(lengths2)
+            for slot, req in self._active.items():
+                n = int(n_emit[slot])
+                req.generated.extend(int(t) for t in toks[slot, :n])
+                self._lengths[slot] = int(lengths2[slot])
+                self._cur[slot] = int(cur2[slot])
         done.extend(self._sweep())
         return done
 
@@ -178,12 +209,53 @@ class Engine:
         self._admit(req)
         return True
 
-    def _pre_decode(self) -> None:
-        """Hook before each decode dispatch (paged: page allocation)."""
+    def _pre_decode(self, k: int) -> None:
+        """Hook before each decode dispatch of up to ``k`` tokens per
+        row (paged: page allocation)."""
 
     def _decode_extra_args(self) -> tuple:
         """Extra positional args for _decode_impl, before rng."""
         return ()
+
+    def _decode_chunk_impl(
+        self, params, cache, cur, lengths, active, remaining, *rest
+    ):
+        """K on-device decode steps with per-row eos/budget masking;
+        ONE host sync per chunk (see ``decode_chunk``).
+
+        Rows stop being "live" at their budget or at eos; a non-live row
+        keeps executing (static shapes) with cur/lengths frozen — its
+        writes land at its frozen position, which is past its final
+        token and masked for every real read. Returns (tokens
+        (slots, K), n_emitted (slots,), cur, lengths, cache).
+        """
+        *extra, rng = rest
+        k = self.decode_chunk
+        eos = self.eos_id
+
+        def body(carry, t):
+            cache, cur, lengths, done = carry
+            live = active & ~done & (t < remaining)
+            nxt, cache = self._decode_impl(
+                params, cache, cur, lengths, live, *extra,
+                jax.random.fold_in(rng, t),
+            )
+            lengths = jnp.where(live, lengths + 1, lengths)
+            if eos is not None:
+                done = done | (live & (nxt == eos))
+            return (cache, nxt, lengths, done), (nxt, live)
+
+        done0 = jnp.zeros((self.max_slots,), bool)
+        (cache, cur, lengths, _), (toks, lives) = jax.lax.scan(
+            body, (cache, cur, lengths, done0), jnp.arange(k)
+        )
+        return (
+            toks.T,  # (slots, K)
+            jnp.sum(lives, axis=0).astype(jnp.int32),
+            cur,
+            lengths,
+            cache,
+        )
 
     def _init_cache(self, cache_dtype):
         """Device cache for the slot pool; paged engines override."""
@@ -504,32 +576,37 @@ class PagedEngine(Engine):
         )
         return first
 
-    def _ensure_decode_pages(self) -> None:
-        """Every active slot about to write at a page boundary gets a
-        fresh page, preempting youngest-first when the pool is dry."""
+    def _ensure_decode_pages(self, k: int = 1) -> None:
+        """Every active slot gets pages covering its next (up to) ``k``
+        write positions — capped at its remaining budget — preempting
+        youngest-first when the pool is dry."""
         for slot in sorted(self._active, key=self._admit_order.__getitem__):
             if slot not in self._active:
                 continue  # preempted as a victim earlier in this loop
-            used = len(self._slot_pages[slot]) * self.page_size
-            if self._lengths[slot] < used:
-                continue
-            while not self._free_pages:
-                victim = max(
-                    self._active, key=self._admit_order.__getitem__
-                )
-                self._preempt(victim)
-                if victim == slot:
+            req = self._active[slot]
+            steps = min(k, req.max_new_tokens - len(req.generated))
+            if steps < 1:
+                continue  # budget exhausted; sweep picks it up
+            # Last write position this chunk -> highest page index needed.
+            need = (self._lengths[slot] + steps - 1) // self.page_size + 1
+            while len(self._slot_pages[slot]) < need:
+                while not self._free_pages:
+                    victim = max(
+                        self._active, key=self._admit_order.__getitem__
+                    )
+                    self._preempt(victim)
+                    if victim == slot:
+                        break
+                if slot not in self._active:
                     break
-            if slot not in self._active:
-                continue
-            page = self._free_pages.pop()
-            self._table[slot, len(self._slot_pages[slot])] = page
-            self._slot_pages[slot].append(page)
+                page = self._free_pages.pop()
+                self._table[slot, len(self._slot_pages[slot])] = page
+                self._slot_pages[slot].append(page)
 
     # ------------------------------------------------------------- driving
     # The decode driver is Engine.step itself, via its hooks:
-    def _pre_decode(self) -> None:
-        self._ensure_decode_pages()
+    def _pre_decode(self, k: int) -> None:
+        self._ensure_decode_pages(k)
 
     def _decode_extra_args(self) -> tuple:
         return (jnp.asarray(self._table),)

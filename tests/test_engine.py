@@ -331,6 +331,98 @@ def test_paged_engine_validation(tiny):
         )
 
 
+@pytest.mark.parametrize("chunk", [2, 4, 7])
+def test_chunked_decode_matches_per_token(tiny, chunk):
+    """decode_chunk=K (one host sync per K tokens) must produce exactly
+    the per-token engine's greedy outputs — mixed budgets so rows
+    exhaust mid-chunk."""
+    from shifu_tpu.infer.engine import PagedEngine
+
+    model, params = tiny
+    rng = np.random.RandomState(12)
+    prompts = [rng.randint(1, 256, size=n).tolist() for n in (5, 9, 3)]
+    budgets = (6, 3, 8)
+    kw = dict(
+        max_slots=2, max_len=32,
+        sample_cfg=SampleConfig(temperature=0.0), prefill_buckets=(16,),
+    )
+    ref = Engine(model, params, **kw)
+    rids = [ref.submit(p, max_new_tokens=b) for p, b in zip(prompts, budgets)]
+    want = {rids.index(c.rid): c.tokens for c in ref.run()}
+
+    for eng in (
+        Engine(model, params, decode_chunk=chunk, **kw),
+        PagedEngine(
+            model, params, decode_chunk=chunk, page_size=8,
+            prefill_buckets=(16, 32), max_slots=2, max_len=32,
+            sample_cfg=SampleConfig(temperature=0.0),
+        ),
+    ):
+        rids = [
+            eng.submit(p, max_new_tokens=b)
+            for p, b in zip(prompts, budgets)
+        ]
+        got = {rids.index(c.rid): c.tokens for c in eng.run()}
+        for i in range(len(prompts)):
+            np.testing.assert_array_equal(
+                want[i], got[i],
+                err_msg=f"{type(eng).__name__} chunk={chunk} req {i}",
+            )
+
+
+def test_chunked_decode_eos_mid_chunk(tiny):
+    model, params = tiny
+    rng = np.random.RandomState(13)
+    prompt = rng.randint(1, 256, size=5).tolist()
+    kw = dict(
+        max_slots=1, max_len=32,
+        sample_cfg=SampleConfig(temperature=0.0), prefill_buckets=(8,),
+    )
+    probe = Engine(model, params, **kw)
+    probe.submit(prompt, max_new_tokens=6)
+    full = probe.run()[0].tokens
+    eos = full[2]  # stops 3 tokens in, mid-chunk for chunk=4
+
+    ref = Engine(model, params, eos_id=eos, **kw)
+    ref.submit(prompt, max_new_tokens=6)
+    want = ref.run()[0]
+    assert want.finished_by == "eos"
+
+    eng = Engine(model, params, eos_id=eos, decode_chunk=4, **kw)
+    eng.submit(prompt, max_new_tokens=6)
+    got = eng.run()[0]
+    assert got.finished_by == "eos"
+    assert got.tokens == want.tokens
+
+
+def test_chunked_paged_preemption_parity(tiny):
+    """Tight pool + chunked decode: pages for the whole chunk allocate
+    up front, preemption happens at chunk granularity, and greedy
+    outputs still match the dense per-token engine exactly."""
+    from shifu_tpu.infer.engine import PagedEngine
+
+    model, params = tiny
+    rng = np.random.RandomState(14)
+    prompts = [rng.randint(1, 256, size=5).tolist() for _ in range(2)]
+    kw = dict(
+        max_slots=2, max_len=16,
+        sample_cfg=SampleConfig(temperature=0.0), prefill_buckets=(8, 16),
+    )
+    ref = Engine(model, params, **kw)
+    rids = [ref.submit(p, max_new_tokens=8) for p in prompts]
+    want = {rids.index(c.rid): c.tokens for c in ref.run()}
+
+    paged = PagedEngine(
+        model, params, page_size=4, n_pages=6, decode_chunk=3, **kw
+    )
+    rids = [paged.submit(p, max_new_tokens=8) for p in prompts]
+    got = {rids.index(c.rid): c.tokens for c in paged.run()}
+    assert paged.preemptions >= 1
+    assert paged.free_pages == paged.n_pages - 1
+    for i in range(2):
+        np.testing.assert_array_equal(want[i], got[i], err_msg=f"req {i}")
+
+
 def test_prefill_bucket_padding_keeps_rope_regime():
     """Bucket padding must not flip length-sensitive rope scaling: a
     5-token prompt served through a 32-wide bucket stays in longrope's
