@@ -73,6 +73,8 @@ class Engine:
         cache_dtype=jnp.bfloat16,
         rng: Optional[jax.Array] = None,
         decode_chunk: int = 1,
+        mesh=None,
+        sharding_rules=None,
     ):
         """``decode_chunk``: tokens decoded per host round-trip. 1 (the
         default) syncs every token — finest admission granularity. >1
@@ -80,13 +82,25 @@ class Engine:
         syncs once per chunk: on a remote/tunnelled TPU where dispatch
         latency dominates decode, throughput scales almost linearly with
         K, at the cost of admitting new requests only at chunk
-        boundaries (and, paged, preempting at chunk granularity)."""
+        boundaries (and, paged, preempting at chunk granularity).
+
+        ``mesh``: serve on a ``jax.sharding.Mesh`` (tensor-parallel
+        multi-chip inference). Pass params already placed in their
+        sharded layout (``parallel.sharding.shard_params``); the cache
+        is created directly into its shards via the model's
+        ``cache_logical_axes`` (kv heads over tp; models without the
+        hook get a replicated cache), and the model's
+        activation-sharding constraints are recorded while tracing the
+        engine's programs. ``sharding_rules`` must match what
+        shard_params used (default: the shared DEFAULT_RULES)."""
         self.model = model
         self.params = params
         self.max_slots = max_slots
         self.max_len = max_len
         self.sample_cfg = sample_cfg
         self.eos_id = eos_id
+        self.mesh = mesh
+        self.sharding_rules = sharding_rules
         if decode_chunk < 1:
             raise ValueError(f"decode_chunk must be >= 1, got {decode_chunk}")
         self.decode_chunk = int(decode_chunk)
@@ -108,11 +122,15 @@ class Engine:
         self._cur = np.zeros((max_slots,), np.int32)  # last sampled token
 
         self._prefill_jit = jax.jit(
-            self._prefill_impl, static_argnames=("bucket",), donate_argnums=(1,)
+            self._in_act_ctx(self._prefill_impl),
+            static_argnames=("bucket",),
+            donate_argnums=(1,),
         )
-        self._decode_jit = jax.jit(self._decode_impl, donate_argnums=(1,))
+        self._decode_jit = jax.jit(
+            self._in_act_ctx(self._decode_impl), donate_argnums=(1,)
+        )
         self._decode_chunk_jit = jax.jit(
-            self._decode_chunk_impl, donate_argnums=(1,)
+            self._in_act_ctx(self._decode_chunk_impl), donate_argnums=(1,)
         )
 
     # ------------------------------------------------------------ public
@@ -259,9 +277,72 @@ class Engine:
 
     def _init_cache(self, cache_dtype):
         """Device cache for the slot pool; paged engines override."""
-        return self.model.init_cache(
-            self.max_slots, self.max_len, dtype=cache_dtype
+        return self._make_cache(
+            lambda: self.model.init_cache(
+                self.max_slots, self.max_len, dtype=cache_dtype
+            )
         )
+
+    def _make_cache(self, init_fn):
+        """Build the cache; on a mesh, create it DIRECTLY into its
+        shards (jit with out_shardings, like sharding.init_sharded for
+        params) — allocate-then-reshard would materialise the full pool
+        on one chip and OOM exactly the aggregate-HBM-sized caches mesh
+        serving exists for. Models expose ``cache_logical_axes``;
+        without it the cache is replicated — correct, just not
+        memory-scaled."""
+        if self.mesh is None:
+            return init_fn()
+        from jax.sharding import NamedSharding
+
+        from shifu_tpu.parallel.sharding import DEFAULT_RULES, spec_for
+
+        rules = self.sharding_rules or DEFAULT_RULES
+        axes_fn = getattr(self.model, "cache_logical_axes", None)
+        logical = axes_fn() if axes_fn is not None else None
+
+        def sharding_of(shape_struct):
+            names = (
+                logical
+                if logical is not None
+                and len(logical) == len(shape_struct.shape)
+                else (None,) * len(shape_struct.shape)
+            )
+            return NamedSharding(
+                self.mesh,
+                spec_for(shape_struct.shape, names, self.mesh, rules),
+            )
+
+        shardings = jax.tree_util.tree_map(
+            sharding_of, jax.eval_shape(init_fn)
+        )
+        return jax.jit(init_fn, out_shardings=shardings)()
+
+    def _act_ctx(self):
+        """Activation-sharding scope for tracing the engine programs."""
+        import contextlib
+
+        if self.mesh is None:
+            return contextlib.nullcontext()
+        from shifu_tpu.parallel.ctx import activation_sharding
+        from shifu_tpu.parallel.sharding import DEFAULT_RULES
+
+        return activation_sharding(
+            self.mesh, self.sharding_rules or DEFAULT_RULES
+        )
+
+    def _in_act_ctx(self, fn):
+        """Wrap a program so its TRACE runs under the mesh's
+        activation-sharding context (constraints are recorded at trace
+        time; re-runs of the compiled program are unaffected)."""
+        import functools
+
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            with self._act_ctx():
+                return fn(*args, **kwargs)
+
+        return wrapped
 
     def _release(self, slot: int) -> None:
         """Per-slot cleanup on completion/preemption (paged: free pages).
@@ -511,8 +592,10 @@ class PagedEngine(Engine):
         return super().submit(prompt_tokens, max_new_tokens)
 
     def _init_cache(self, cache_dtype):
-        return self.model.init_paged_cache(
-            self.n_pages, self.page_size, dtype=cache_dtype
+        return self._make_cache(
+            lambda: self.model.init_paged_cache(
+                self.n_pages, self.page_size, dtype=cache_dtype
+            )
         )
 
     # --------------------------------------------------------- allocation

@@ -141,3 +141,9 @@ class QuantizedModel:
 
     def init_paged_cache(self, *args, **kwargs):
         return self.inner.init_paged_cache(*args, **kwargs)
+
+    def cache_logical_axes(self):
+        # Mirror the wrapped family; None = "no hook" (the engine then
+        # replicates the cache) for models without one, e.g. Mamba.
+        fn = getattr(self.inner, "cache_logical_axes", None)
+        return fn() if fn is not None else None

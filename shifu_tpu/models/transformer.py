@@ -762,6 +762,14 @@ class Transformer(Module):
         )
         return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
+    def cache_logical_axes(self):
+        """Logical axis names of the KV cache leaves — dense
+        (layers, batch, seq, kv, hd) and paged (layers, pages, page,
+        kv, hd) both map the same way. The serving engines use this to
+        shard the cache (kv heads over tp) on a mesh; models without it
+        get a replicated cache."""
+        return ("layers", None, None, "kv_heads", "head_dim")
+
     def init_paged_cache(
         self, n_pages: int, page_size: int, dtype=jnp.bfloat16
     ):

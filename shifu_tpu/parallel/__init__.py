@@ -6,6 +6,7 @@ from shifu_tpu.parallel.sharding import (
     batch_spec,
     init_sharded,
     param_shardings,
+    shard_params,
     param_specs_tree,
     shard_batch,
     spec_for,
@@ -21,6 +22,7 @@ __all__ = [
     "batch_spec",
     "init_sharded",
     "param_shardings",
+    "shard_params",
     "param_specs_tree",
     "shard_batch",
     "spec_for",

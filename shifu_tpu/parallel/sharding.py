@@ -115,6 +115,19 @@ def param_shardings(
     )
 
 
+def shard_params(
+    module: Module,
+    params,
+    mesh: Mesh,
+    rules: Mapping[str, MeshAxes] = DEFAULT_RULES,
+):
+    """Place an EXISTING params tree into its sharded layout (e.g.
+    checkpoint- or HF-loaded weights before mesh serving). For fresh
+    params prefer :func:`init_sharded`, which never materialises a full
+    host copy."""
+    return jax.device_put(params, param_shardings(module, mesh, rules))
+
+
 def init_sharded(
     module: Module,
     rng: jax.Array,

@@ -209,6 +209,15 @@ class LoraModel:
     def init_cache(self, *args, **kwargs):
         return self.inner.init_cache(*args, **kwargs)
 
+    def init_paged_cache(self, *args, **kwargs):
+        return self.inner.init_paged_cache(*args, **kwargs)
+
+    def cache_logical_axes(self):
+        # Mirror the wrapped family; None = "no hook" (replicated cache
+        # on a serving mesh) for families without one.
+        fn = getattr(self.inner, "cache_logical_axes", None)
+        return fn() if fn is not None else None
+
 
 def merge_lora(model, base_params, lora_params, cfg: LoraConfig):
     """One-shot fold: returns base params with adapters merged in."""

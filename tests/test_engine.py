@@ -457,6 +457,48 @@ def test_prefill_bucket_padding_keeps_rope_regime():
         assert done.tokens[0] == want_first, type(eng).__name__
 
 
+def test_mesh_serving_matches_single_device():
+    """Tensor-parallel serving: engines on a tp(+dp) mesh with sharded
+    params and a kv-sharded cache produce exactly the single-device
+    greedy outputs (f32 so reduction order cannot flip argmaxes)."""
+    from shifu_tpu.core.dtypes import FULL_F32
+    from shifu_tpu.infer.engine import PagedEngine
+    from shifu_tpu.parallel import MeshPlan, shard_params
+
+    cfg = TransformerConfig.tiny()
+    model = Transformer(cfg, policy=FULL_F32)
+    params = model.init(jax.random.key(0))
+    rng = np.random.RandomState(15)
+    prompts = [rng.randint(1, 256, size=n).tolist() for n in (5, 9, 3)]
+    kw = dict(
+        max_slots=2, max_len=32, cache_dtype=jnp.float32,
+        sample_cfg=SampleConfig(temperature=0.0), prefill_buckets=(16, 32),
+    )
+
+    ref = Engine(model, params, **kw)
+    rids = [ref.submit(p, max_new_tokens=5) for p in prompts]
+    want = {rids.index(c.rid): c.tokens for c in ref.run()}
+
+    mesh = MeshPlan(dp=2, tp=2).build(jax.devices()[:4])
+    sharded = shard_params(model, params, mesh)
+    for eng in (
+        Engine(model, sharded, mesh=mesh, **kw),
+        PagedEngine(
+            model, sharded, mesh=mesh, page_size=8, decode_chunk=3, **kw
+        ),
+    ):
+        # The cache is actually sharded over tp on its kv-heads axis.
+        kv_shard = jax.tree_util.tree_leaves(eng.cache)[0].sharding
+        assert "tp" in str(kv_shard.spec), kv_shard
+        rids = [eng.submit(p, max_new_tokens=5) for p in prompts]
+        got = {rids.index(c.rid): c.tokens for c in eng.run()}
+        for i in range(len(prompts)):
+            np.testing.assert_array_equal(
+                want[i], got[i],
+                err_msg=f"{type(eng).__name__} req {i}",
+            )
+
+
 def test_engine_validation(tiny):
     model, params = tiny
     eng = Engine(model, params, max_slots=1, max_len=16,
