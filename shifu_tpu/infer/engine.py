@@ -495,6 +495,16 @@ class PagedEngine(Engine):
     oldest request is only preempted when it is alone, so admission-order
     progress is guaranteed.
 
+    ``enable_prefix_cache``: requests sharing a page-aligned prompt
+    prefix share the pages that hold it. Full pages are immutable by
+    construction (prefill writes whole pages; decode only appends at a
+    slot's tail), so a completed request's full prompt pages stay
+    resident, refcounted, and back any later request with the same
+    prefix — its prefill then covers only the suffix (one compiled
+    suffix-prefill program per bucket). Resident-but-unreferenced pages
+    are evicted LRU before any preemption. ``prefix_hits_tokens``
+    counts prompt tokens served from cache.
+
     Reference parity note: the upstream reference (klyan/shifu) is an
     empty repository (SURVEY.md); there is no reference paged allocator
     to match. The page-pool + table + recompute-preemption design
@@ -511,6 +521,7 @@ class PagedEngine(Engine):
         max_len: int,
         page_size: int = 64,
         n_pages: Optional[int] = None,
+        enable_prefix_cache: bool = False,
         **kw,
     ):
         if getattr(model, "prefill_needs_mask", False):
@@ -561,6 +572,23 @@ class PagedEngine(Engine):
         self._admit_order: Dict[int, int] = {}
         self.preemptions = 0  # observability: recompute events
 
+        # ---- prefix caching (see class docstring) --------------------
+        # Full pages are immutable (prefill writes whole pages; decode
+        # only ever writes a slot's TAIL), so a page holding a
+        # page-aligned prompt prefix can back every request sharing it.
+        self.enable_prefix_cache = enable_prefix_cache
+        self._prefix_pages: Dict[bytes, int] = {}  # prefix -> last page
+        self._prefix_lru: Dict[bytes, None] = {}  # ordered; LRU first
+        self._page_rc: Dict[int, int] = {}  # page -> active-slot users
+        self._page_key: Dict[int, bytes] = {}  # registered page -> key
+        self.prefix_hits_tokens = 0  # observability
+        if enable_prefix_cache:
+            self._prefill_at_jit = jax.jit(
+                self._in_act_ctx(self._prefill_at_impl),
+                static_argnames=("bucket",),
+                donate_argnums=(1,),
+            )
+
     # ------------------------------------------------------------- sizing
     @property
     def free_pages(self) -> int:
@@ -599,8 +627,46 @@ class PagedEngine(Engine):
         )
 
     # --------------------------------------------------------- allocation
+    def _alloc_page(self) -> Optional[int]:
+        """A free page, evicting the LRU unreferenced prefix-cache page
+        when the pool proper is empty. None = truly dry (preempt)."""
+        if self._free_pages:
+            return self._free_pages.pop()
+        for key in list(self._prefix_lru):  # LRU first
+            pg = self._prefix_pages[key]
+            if self._page_rc.get(pg, 0) == 0:
+                del self._prefix_pages[key]
+                del self._prefix_lru[key]
+                self._page_key.pop(pg, None)
+                return pg
+        return None
+
+    def _can_alloc(self, n: int) -> bool:
+        free = len(self._free_pages)
+        if free >= n:
+            return True
+        evictable = sum(
+            1
+            for pg in self._prefix_pages.values()
+            if self._page_rc.get(pg, 0) == 0
+        )
+        return free + evictable >= n
+
+    def _free_page(self, pg: int) -> None:
+        """Unreference a page; registered prefix pages stay RESIDENT
+        (evictable via _alloc_page), everything else returns to the
+        pool."""
+        if pg not in self._page_key:
+            self._free_pages.append(pg)
+
     def _release(self, slot: int) -> None:
-        self._free_pages.extend(self._slot_pages.pop(slot, ()))
+        for pg in self._slot_pages.pop(slot, ()):
+            rc = self._page_rc.get(pg, 1) - 1
+            if rc:
+                self._page_rc[pg] = rc
+            else:
+                self._page_rc.pop(pg, None)
+                self._free_page(pg)
         self._table[slot] = 0
         self._lengths[slot] = 0
         self._cur[slot] = 0
@@ -616,34 +682,91 @@ class PagedEngine(Engine):
         self._queue.appendleft(req)
         self.preemptions += 1
 
+    def _prefix_key(self, prompt, k: int):
+        return tuple(prompt[:k])
+
     def _try_admit(self, req: _Request) -> bool:
         """Admit if a slot AND enough pages exist; False = leave queued."""
         if not self._free:
             return False
+        ps = self.page_size
         # Recompute path: generated-so-far becomes part of the prompt.
         prompt = req.tokens + req.generated
         p = len(prompt)
-        bucket = self._bucket_for(p)
-        need = bucket // self.page_size  # prefill scatters whole bucket
-        if len(self._free_pages) < need:
+        # Longest cached page-aligned prefix, capped at p-1 so at least
+        # one token remains to prefill (its logits feed the sampler).
+        shared: List[int] = []
+        hit = 0
+        if self.enable_prefix_cache:
+            while hit + ps <= p - 1:
+                pg = self._prefix_pages.get(self._prefix_key(prompt, hit + ps))
+                if pg is None:
+                    break
+                shared.append(pg)
+                hit += ps
+            # Suffix-bucket rounding must still fit the row: shared
+            # pages + the whole prefill bucket <= max_len's pages.
+            while hit and hit + self._bucket_for(p - hit) > self.max_len:
+                hit -= ps
+                shared.pop()
+        # PIN the matched pages before allocating: rc > 0 keeps them
+        # out of _alloc_page's eviction — otherwise an empty pool could
+        # evict a just-matched prefix page and hand it back as a suffix
+        # page, which the suffix prefill would then overwrite.
+        for pg in shared:
+            self._page_rc[pg] = self._page_rc.get(pg, 0) + 1
+        suffix = prompt[hit:]
+        bucket = self._bucket_for(len(suffix))
+        need = bucket // ps  # prefill scatters whole buckets of pages
+        if not self._can_alloc(need):
+            for pg in shared:  # unpin: the request stays queued
+                rc = self._page_rc.get(pg, 1) - 1
+                if rc:
+                    self._page_rc[pg] = rc
+                else:
+                    self._page_rc.pop(pg, None)
             return False
-        pages = [self._free_pages.pop() for _ in range(need)]
+        own = [self._alloc_page() for _ in range(need)]
         slot = self._free.pop()
         req.slot = slot
         row = np.zeros((self.pages_per_slot,), np.int32)
-        row[:need] = pages
+        row[: len(shared)] = shared
+        row[len(shared) : len(shared) + need] = own
         self._table[slot] = row
         padded = np.zeros((bucket,), np.int32)
-        padded[:p] = prompt
+        padded[: len(suffix)] = suffix
         self._rng, sub = jax.random.split(self._rng)
-        first = self._dispatch_prefill(slot, padded, p, bucket, sub)
+        if hit:
+            first = self._dispatch_prefill_at(
+                slot, padded, len(suffix), hit, bucket, sub
+            )
+            self.prefix_hits_tokens += hit
+        else:
+            first = self._dispatch_prefill(slot, padded, p, bucket, sub)
         # Keep only the pages that hold real tokens; the bucket's tail
         # pages hold masked garbage and go straight back to the pool.
-        keep = -(-p // self.page_size)
-        self._free_pages.extend(pages[keep:])
-        self._table[slot, keep:] = 0
-        self._slot_pages[slot] = pages[:keep]
+        keep = -(-len(suffix) // ps)
+        self._free_pages.extend(own[keep:])
+        self._table[slot, len(shared) + keep :] = 0
+        pages_used = shared + own[:keep]
+        for pg in own[:keep]:  # shared pages were pinned at match time
+            self._page_rc[pg] = self._page_rc.get(pg, 0) + 1
+        self._slot_pages[slot] = pages_used
         self._admit_order[slot] = next(self._admit_seq)
+        if self.enable_prefix_cache:
+            # Register this prompt's NEW full pages (the partial tail
+            # page takes decode writes and is never shareable) and bump
+            # every touched prefix to MRU.
+            for i in range(p // ps):
+                key = self._prefix_key(prompt, (i + 1) * ps)
+                if key not in self._prefix_pages and i < len(pages_used):
+                    pg = pages_used[i]
+                    if pg not in self._page_key:
+                        self._prefix_pages[key] = pg
+                        self._page_key[pg] = key
+                if key in self._prefix_pages:
+                    self._prefix_lru.pop(key, None)
+                    self._prefix_lru[key] = None
         self._finish_admission(req, slot, p, first)
         return True
 
@@ -659,6 +782,41 @@ class PagedEngine(Engine):
         )
         return first
 
+    def _dispatch_prefill_at(self, slot, padded, suffix_len, offset, bucket,
+                             rng):
+        first, self.cache = self._prefill_at_jit(
+            self.params,
+            self.cache,
+            jnp.asarray(padded),
+            jnp.int32(suffix_len),
+            jnp.int32(offset),
+            jnp.asarray(self._table[slot]),
+            rng,
+            bucket=bucket,
+        )
+        return first
+
+    def _prefill_at_impl(self, params, cache, tokens, length, offset,
+                         table_row, rng, *, bucket):
+        """SUFFIX prefill after a prefix-cache hit: the row's leading
+        pages already hold the shared prefix; write the suffix's pages
+        at the (page-aligned) offset and attend over the gathered pages
+        with slot-space causality, so suffix queries see the prefix."""
+        pos = jnp.minimum(
+            offset + jnp.arange(bucket), offset + length - 1
+        )
+        logits, cache = self.model(
+            params,
+            tokens[None, :],
+            positions=pos[None, :],
+            cache=cache,
+            cache_index=offset,
+            page_table=table_row[None, :],
+            logits_at=(length - 1)[None],
+        )
+        tok = sample_logits(logits[:, 0], rng, self.sample_cfg)[0]
+        return tok, cache
+
     def _ensure_decode_pages(self, k: int = 1) -> None:
         """Every active slot gets pages covering its next (up to) ``k``
         write positions — capped at its remaining budget — preempting
@@ -673,18 +831,20 @@ class PagedEngine(Engine):
             # Last write position this chunk -> highest page index needed.
             need = (self._lengths[slot] + steps - 1) // self.page_size + 1
             while len(self._slot_pages[slot]) < need:
-                while not self._free_pages:
+                page = self._alloc_page()
+                while page is None:
                     victim = max(
                         self._active, key=self._admit_order.__getitem__
                     )
                     self._preempt(victim)
                     if victim == slot:
                         break
-                if slot not in self._active:
+                    page = self._alloc_page()
+                if slot not in self._active or page is None:
                     break
-                page = self._free_pages.pop()
                 self._table[slot, len(self._slot_pages[slot])] = page
                 self._slot_pages[slot].append(page)
+                self._page_rc[page] = self._page_rc.get(page, 0) + 1
 
     # ------------------------------------------------------------- driving
     # The decode driver is Engine.step itself, via its hooks:

@@ -100,7 +100,9 @@ class EngineRunner:
         }
         if self.fatal is not None:
             out["fatal"] = repr(self.fatal)
-        for attr in ("free_pages", "n_pages", "preemptions"):
+        for attr in (
+            "free_pages", "n_pages", "preemptions", "prefix_hits_tokens",
+        ):
             if hasattr(eng, attr):
                 out[attr] = getattr(eng, attr)
         return out

@@ -374,12 +374,19 @@ class Transformer(Module):
         Logical position t of row b lives at
         pool[table[b, t // ps], t % ps].
 
-        Two call shapes, mirroring the dense path:
-          * prefill (q_len > 1, cache_index == 0): k/v for the whole
-            bucket scatter to this row's pages in one batched write
-            (q_len % page_size == 0 enforced by the engine's buckets);
-            attention runs locally over the fresh k/v (right-padding is
-            hidden by causality, exactly the dense fast path).
+        Three call shapes, mirroring the dense path:
+          * prefill (q_len > 1, cache_index == 0, the static int): k/v
+            for the whole bucket scatter to this row's pages in one
+            batched write (q_len % page_size == 0 enforced by the
+            engine's buckets); attention runs locally over the fresh
+            k/v (right-padding is hidden by causality, exactly the
+            dense fast path).
+          * SUFFIX prefill (q_len > 1, cache_index a traced scalar —
+            the page-aligned offset where the suffix starts): writes
+            land in the pages at offset//ps onward, attention runs
+            over the row's gathered pages with slot-space causality —
+            queries see the already-cached prefix. This is what prefix
+            caching prefills after a page-table hit.
           * decode (q_len == 1, cache_index a (b,) vector): one-token
             scatter at (table[b, t//ps], t%ps), then attention over the
             row's gathered pages with the same slot-space masking as the
@@ -392,11 +399,6 @@ class Transformer(Module):
         vc = v.astype(pool["v"].dtype)
 
         if q_len > 1:
-            if not (type(cache_index) is int and cache_index == 0):
-                raise ValueError(
-                    "paged prefill must start at cache_index=0 (chunked "
-                    "prefill at an offset is a dense-cache feature)"
-                )
             if q_len % ps:
                 raise ValueError(
                     f"paged prefill length {q_len} must be a multiple of "
@@ -409,16 +411,41 @@ class Transformer(Module):
                 )
             if kv_mask is not None:
                 raise ValueError(
-                    "paged prefill attends locally (causality hides "
-                    "right-padding); kv_mask would be silently ignored"
+                    "paged prefill attends via causality over real "
+                    "positions; kv_mask would be silently ignored"
                 )
-            phys = page_table[0, : q_len // ps]  # (np_b,)
-            ck = pool["k"].at[phys].set(kc[0].reshape(q_len // ps, ps, n_kv, hd))
-            cv = pool["v"].at[phys].set(vc[0].reshape(q_len // ps, ps, n_kv, hd))
-            attn = dot_product_attention(
-                q, k, v, causal=True, impl=self.cfg.attn_impl,
-                window=self.cfg.window_size,
-            )
+            kv_block = kc[0].reshape(q_len // ps, ps, n_kv, hd)
+            v_block = vc[0].reshape(q_len // ps, ps, n_kv, hd)
+            if type(cache_index) is int and cache_index == 0:
+                # Fresh prefill: local attention fast path (flash for
+                # long prompts), nothing cached to look at.
+                phys = page_table[0, : q_len // ps]  # (np_b,)
+                ck = pool["k"].at[phys].set(kv_block)
+                cv = pool["v"].at[phys].set(v_block)
+                attn = dot_product_attention(
+                    q, k, v, causal=True, impl=self.cfg.attn_impl,
+                    window=self.cfg.window_size,
+                )
+            else:
+                # Page-aligned suffix prefill at a traced offset: the
+                # caller guarantees cache_index % ps == 0 and that the
+                # pages below the offset hold the shared prefix.
+                start = cache_index // ps
+                phys = jax.lax.dynamic_slice_in_dim(
+                    page_table[0], start, q_len // ps
+                )
+                ck = pool["k"].at[phys].set(kv_block)
+                cv = pool["v"].at[phys].set(v_block)
+                gk = ck[page_table].reshape(
+                    b, page_table.shape[1] * ps, n_kv, hd
+                )
+                gv = cv[page_table].reshape(
+                    b, page_table.shape[1] * ps, n_kv, hd
+                )
+                attn = _decode_attention(
+                    q, gk, gv, cache_index, self.cfg.attn_impl,
+                    window=self.cfg.window_size,
+                )
         else:
             if getattr(cache_index, "ndim", 0) != 1:
                 raise ValueError(

@@ -457,6 +457,103 @@ def test_prefill_bucket_padding_keeps_rope_regime():
         assert done.tokens[0] == want_first, type(eng).__name__
 
 
+def test_prefix_cache_hit_exact_parity(tiny):
+    """A repeated prompt is served from cached prefix pages (suffix-only
+    prefill) with exactly the same greedy output; divergent suffixes on
+    a shared prefix hit too."""
+    from shifu_tpu.infer.engine import PagedEngine
+
+    model, params = tiny
+    rng = np.random.RandomState(16)
+    common = rng.randint(1, 256, size=17).tolist()  # 2 full 8-pages + 1
+    a = common + rng.randint(1, 256, size=3).tolist()
+    b = common + rng.randint(1, 256, size=5).tolist()
+    kw = dict(
+        max_slots=1, max_len=64,
+        sample_cfg=SampleConfig(temperature=0.0), prefill_buckets=(8, 16, 32, 64),
+    )
+    ref = PagedEngine(model, params, page_size=8, **kw)
+    want = {}
+    for name, prompt in (("a1", a), ("a2", a), ("b", b)):
+        ref.submit(prompt, max_new_tokens=4)
+        want[name] = ref.run()[0].tokens
+    assert ref.prefix_hits_tokens == 0  # disabled by default
+
+    eng = PagedEngine(
+        model, params, page_size=8, enable_prefix_cache=True, **kw
+    )
+    got = {}
+    for name, prompt in (("a1", a), ("a2", a), ("b", b)):
+        eng.submit(prompt, max_new_tokens=4)
+        got[name] = eng.run()[0].tokens
+    # a2 reuses a's two full prompt pages (16 tokens); b shares them too.
+    assert eng.prefix_hits_tokens == 32
+    for name in want:
+        np.testing.assert_array_equal(want[name], got[name], err_msg=name)
+
+
+def test_prefix_hit_bucket_fits_row(tiny):
+    """A long prefix hit plus suffix-bucket rounding must not overflow
+    the row: hit length backs off until shared + bucket <= max_len."""
+    from shifu_tpu.infer.engine import PagedEngine
+
+    model, params = tiny
+    rng = np.random.RandomState(18)
+    eng = PagedEngine(
+        model, params, max_slots=1, max_len=64, page_size=8,
+        enable_prefix_cache=True,
+        sample_cfg=SampleConfig(temperature=0.0),
+        prefill_buckets=(8, 16, 32, 64),
+    )
+    seed = rng.randint(1, 256, size=41).tolist()  # registers 5 pages
+    eng.submit(seed, max_new_tokens=1)
+    eng.run()
+    # 63-token prompt sharing 40: naive hit=40 + bucket(23)=32 needs 9
+    # pages on an 8-page row — admission must back the hit off, not die.
+    long = seed[:40] + rng.randint(1, 256, size=23).tolist()
+    ref = PagedEngine(
+        model, params, max_slots=1, max_len=64, page_size=8,
+        sample_cfg=SampleConfig(temperature=0.0),
+        prefill_buckets=(8, 16, 32, 64),
+    )
+    eng.submit(long, max_new_tokens=1)
+    ref.submit(long, max_new_tokens=1)
+    np.testing.assert_array_equal(ref.run()[0].tokens, eng.run()[0].tokens)
+
+
+def test_prefix_cache_eviction_under_pressure(tiny):
+    """Resident-but-unreferenced cached pages are evicted (LRU) before
+    any preemption, and correctness survives eviction."""
+    from shifu_tpu.infer.engine import PagedEngine
+
+    model, params = tiny
+    rng = np.random.RandomState(17)
+    kw = dict(
+        max_slots=1, max_len=32,
+        sample_cfg=SampleConfig(temperature=0.0), prefill_buckets=(16, 32),
+    )
+    # Pool of 5 usable pages, page 8: each 17-token prompt keeps 3.
+    eng = PagedEngine(
+        model, params, page_size=8, n_pages=6, enable_prefix_cache=True,
+        **kw,
+    )
+    ref = PagedEngine(model, params, page_size=8, n_pages=6, **kw)
+    prompts = [rng.randint(1, 256, size=17).tolist() for _ in range(3)]
+    for p in prompts:  # distinct prompts: each admission must evict
+        eng.submit(p, max_new_tokens=3)
+        ref.submit(p, max_new_tokens=3)
+        got = eng.run()[0].tokens
+        want = ref.run()[0].tokens
+        np.testing.assert_array_equal(want, got)
+    assert eng.preemptions == 0  # eviction sufficed
+    # Re-submitting the LAST prompt still hits whatever stayed resident.
+    eng.submit(prompts[-1], max_new_tokens=3)
+    got = eng.run()[0].tokens
+    ref.submit(prompts[-1], max_new_tokens=3)
+    np.testing.assert_array_equal(ref.run()[0].tokens, got)
+    assert eng.prefix_hits_tokens >= 16
+
+
 def test_mesh_serving_matches_single_device():
     """Tensor-parallel serving: engines on a tp(+dp) mesh with sharded
     params and a kv-sharded cache produce exactly the single-device
