@@ -237,7 +237,8 @@ def cmd_serve(args) -> int:
     if args.paged:
         engine = PagedEngine(
             model, params, page_size=args.page_size,
-            n_pages=args.n_pages, **kw,
+            n_pages=args.n_pages,
+            enable_prefix_cache=args.prefix_cache, **kw,
         )
     else:
         engine = Engine(model, params, **kw)
@@ -362,6 +363,9 @@ def main(argv=None) -> int:
     s.add_argument("--page-size", type=int, default=64)
     s.add_argument("--n-pages", type=int, default=None,
                    help="pool size (default: dense-equivalent)")
+    s.add_argument("--prefix-cache", action="store_true",
+                   help="share page-aligned prompt prefixes across "
+                        "requests (paged only)")
     s.set_defaults(fn=cmd_serve)
 
     i = sub.add_parser("info", help="environment / device info")

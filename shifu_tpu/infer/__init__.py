@@ -8,6 +8,7 @@ is the standard prefill + KV-cache decode design, TPU-first (static shapes,
 
 from shifu_tpu.infer.sampling import SampleConfig, sample_logits
 from shifu_tpu.infer.generate import generate, make_generate_fn
+from shifu_tpu.infer.beam import make_beam_search_fn
 from shifu_tpu.infer.engine import Completion, Engine, PagedEngine
 from shifu_tpu.infer.server import EngineRunner, make_server
 from shifu_tpu.infer.speculative import (
@@ -26,6 +27,7 @@ __all__ = [
     "SampleConfig",
     "sample_logits",
     "generate",
+    "make_beam_search_fn",
     "make_generate_fn",
     "Completion",
     "SpecResult",

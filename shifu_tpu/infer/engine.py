@@ -529,6 +529,20 @@ class PagedEngine(Engine):
                 "recurrent models carry O(1) state per slot — a paged KV "
                 "pool only makes sense for attention caches; use Engine"
             )
+        if enable_prefix_cache:
+            scaling = getattr(
+                getattr(model, "cfg", None), "rope_scaling", None
+            )
+            kind = scaling[0] if scaling else None
+            if kind in ("dynamic", "longrope"):
+                # Cached prefix K was rotated under the DONOR's length
+                # regime; a different-length borrower would need
+                # different frequencies — reuse would be silently wrong.
+                raise ValueError(
+                    f"prefix caching is unsound with length-sensitive "
+                    f"rope_scaling {kind!r}: cached keys bake in the "
+                    "donor request's frequency regime"
+                )
         if max_len % page_size:
             raise ValueError(
                 f"max_len {max_len} must be a multiple of page_size "
@@ -659,14 +673,21 @@ class PagedEngine(Engine):
         if pg not in self._page_key:
             self._free_pages.append(pg)
 
+    def _unref(self, pg: int, *, free: bool = True) -> None:
+        """Drop one refcount; at zero, optionally return the page to
+        the pool (free=False: a pin being undone before the page was
+        ever handed out — it is still resident/registered)."""
+        rc = self._page_rc.get(pg, 1) - 1
+        if rc:
+            self._page_rc[pg] = rc
+        else:
+            self._page_rc.pop(pg, None)
+            if free:
+                self._free_page(pg)
+
     def _release(self, slot: int) -> None:
         for pg in self._slot_pages.pop(slot, ()):
-            rc = self._page_rc.get(pg, 1) - 1
-            if rc:
-                self._page_rc[pg] = rc
-            else:
-                self._page_rc.pop(pg, None)
-                self._free_page(pg)
+            self._unref(pg)
         self._table[slot] = 0
         self._lengths[slot] = 0
         self._cur[slot] = 0
@@ -682,8 +703,18 @@ class PagedEngine(Engine):
         self._queue.appendleft(req)
         self.preemptions += 1
 
-    def _prefix_key(self, prompt, k: int):
-        return tuple(prompt[:k])
+    @staticmethod
+    def _chain_key(parent: bytes, page_tokens) -> bytes:
+        """Key of a prefix one page longer than ``parent``'s: a sha256
+        chain digest — O(page_size) to extend, 32 bytes resident per
+        page regardless of prefix depth (a flat tuple-of-tokens key
+        would cost O(prefix) memory per page and O(prefix) hashing per
+        probe)."""
+        import hashlib
+
+        h = hashlib.sha256(parent)
+        h.update(np.asarray(page_tokens, np.int32).tobytes())
+        return h.digest()
 
     def _try_admit(self, req: _Request) -> bool:
         """Admit if a slot AND enough pages exist; False = leave queued."""
@@ -698,8 +729,10 @@ class PagedEngine(Engine):
         shared: List[int] = []
         hit = 0
         if self.enable_prefix_cache:
+            key = b""
             while hit + ps <= p - 1:
-                pg = self._prefix_pages.get(self._prefix_key(prompt, hit + ps))
+                key = self._chain_key(key, prompt[hit : hit + ps])
+                pg = self._prefix_pages.get(key)
                 if pg is None:
                     break
                 shared.append(pg)
@@ -720,11 +753,7 @@ class PagedEngine(Engine):
         need = bucket // ps  # prefill scatters whole buckets of pages
         if not self._can_alloc(need):
             for pg in shared:  # unpin: the request stays queued
-                rc = self._page_rc.get(pg, 1) - 1
-                if rc:
-                    self._page_rc[pg] = rc
-                else:
-                    self._page_rc.pop(pg, None)
+                self._unref(pg, free=False)
             return False
         own = [self._alloc_page() for _ in range(need)]
         slot = self._free.pop()
@@ -755,15 +784,22 @@ class PagedEngine(Engine):
         self._admit_order[slot] = next(self._admit_seq)
         if self.enable_prefix_cache:
             # Register this prompt's NEW full pages (the partial tail
-            # page takes decode writes and is never shareable) and bump
-            # every touched prefix to MRU.
+            # page takes decode writes and is never shareable)...
+            keys = []
+            key = b""
             for i in range(p // ps):
-                key = self._prefix_key(prompt, (i + 1) * ps)
+                key = self._chain_key(key, prompt[i * ps : (i + 1) * ps])
+                keys.append(key)
                 if key not in self._prefix_pages and i < len(pages_used):
                     pg = pages_used[i]
                     if pg not in self._page_key:
                         self._prefix_pages[key] = pg
                         self._page_key[pg] = key
+            # ...then bump touched prefixes to MRU, LONGEST first so
+            # shorter (more reusable) links of a chain evict LAST — a
+            # chain missing its head can never be matched, stranding
+            # its longer pages as unreachable residents.
+            for key in reversed(keys):
                 if key in self._prefix_pages:
                     self._prefix_lru.pop(key, None)
                     self._prefix_lru[key] = None

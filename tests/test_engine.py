@@ -521,6 +521,27 @@ def test_prefix_hit_bucket_fits_row(tiny):
     np.testing.assert_array_equal(ref.run()[0].tokens, eng.run()[0].tokens)
 
 
+def test_prefix_cache_rejects_length_sensitive_rope(tiny):
+    """Cached prefix K bakes in the donor's frequency regime — prefix
+    caching with dynamic-NTK/longrope scaling must be refused."""
+    from shifu_tpu.infer.engine import PagedEngine
+
+    model, params = tiny
+    cfg = TransformerConfig.tiny(rope_scaling=("dynamic", 2.0, 16))
+    dyn = Transformer(cfg)
+    with pytest.raises(ValueError, match="unsound"):
+        PagedEngine(
+            dyn, params, max_slots=1, max_len=32, page_size=8,
+            enable_prefix_cache=True,
+        )
+    # Position-independent scalings stay allowed.
+    PagedEngine(
+        Transformer(TransformerConfig.tiny(rope_scaling=("linear", 2.0))),
+        params, max_slots=1, max_len=32, page_size=8,
+        enable_prefix_cache=True, prefill_buckets=(8, 16, 32),
+    )
+
+
 def test_prefix_cache_eviction_under_pressure(tiny):
     """Resident-but-unreferenced cached pages are evicted (LRU) before
     any preemption, and correctness survives eviction."""
