@@ -163,6 +163,15 @@ class Engine:
     def idle(self) -> bool:
         return not self._queue and not self._active
 
+    def live_generated(self) -> Dict[int, List[int]]:
+        """rid -> tokens generated so far, for in-flight requests.
+        The streaming front-end diffs this between steps; it is the
+        public contract so callers stay off engine internals."""
+        return {
+            req.rid: list(req.generated)
+            for req in self._active.values()
+        }
+
     @property
     def active_slots(self) -> int:
         return len(self._active)

@@ -27,6 +27,7 @@ from __future__ import annotations
 import collections
 import dataclasses
 import json
+import queue
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
@@ -36,9 +37,42 @@ from shifu_tpu.infer.engine import Completion, Engine
 
 @dataclasses.dataclass
 class _Waiter:
+    """Blocking caller: one event, one completion."""
+
     event: threading.Event
     completion: Optional[Completion] = None
     error: Optional[Exception] = None
+
+    def push(self, tokens) -> None:  # streaming only; no-op here
+        pass
+
+    def complete(self, c: Completion) -> None:
+        self.completion = c
+        self.event.set()
+
+    def fail(self, e: Exception) -> None:
+        self.error = e
+        self.event.set()
+
+
+@dataclasses.dataclass
+class _StreamWaiter:
+    """Streaming caller: a queue of ("delta", tokens) items followed by
+    one ("done", Completion) or ("error", exc)."""
+
+    q: "queue.Queue"
+    sent: int = 0
+
+    def push(self, tokens) -> None:
+        if tokens:
+            self.q.put(("delta", tokens))
+
+    def complete(self, c: Completion) -> None:
+        self.push(c.tokens[self.sent :])
+        self.q.put(("done", c))
+
+    def fail(self, e: Exception) -> None:
+        self.q.put(("error", e))
 
 
 class EngineRunner:
@@ -89,6 +123,59 @@ class EngineRunner:
             raise w.error
         return w.completion
 
+    def stream(self, tokens, max_new_tokens: int,
+               timeout: Optional[float] = None):
+        """Returns a generator of ("delta", [ids]) items ending with
+        ("done", Completion); tokens arrive as the engine emits them
+        (per decode chunk). The submission (and the dead-runner check)
+        happens EAGERLY in this call — so callers see RuntimeError
+        before consuming anything — while validation errors surface on
+        the generator's first iteration. Raises on failure/timeout; a
+        timed-out or abandoned generator unregisters its waiter
+        (``close()`` it on client disconnect)."""
+        w = _StreamWaiter(queue.Queue())
+        with self._lock:
+            if self.fatal is not None:
+                raise RuntimeError(
+                    f"engine thread died: {self.fatal!r}"
+                ) from self.fatal
+            if self._stop.is_set():
+                raise RuntimeError("engine runner is shut down")
+            self._inbox.append((list(tokens), int(max_new_tokens), w))
+        self._wake.set()
+
+        def events():
+            try:
+                while True:
+                    try:
+                        kind, payload = w.q.get(timeout=timeout)
+                    except queue.Empty:
+                        raise TimeoutError(
+                            f"no progress within {timeout}s"
+                        ) from None
+                    if kind == "error":
+                        raise payload
+                    yield kind, payload
+                    if kind == "done":
+                        return
+            finally:
+                # Timeout, error, exhaustion, or close(): nobody will
+                # read this queue again — unregister so the loop stops
+                # feeding it. (The request itself runs on; the engine
+                # has no cancel.)
+                self._abandon(w)
+
+        return events()
+
+    def _abandon(self, w) -> None:
+        with self._lock:
+            for rid, ww in list(self._waiters.items()):
+                if ww is w:
+                    del self._waiters[rid]
+            self._inbox = collections.deque(
+                item for item in self._inbox if item[2] is not w
+            )
+
     def stats(self) -> dict:
         eng = self.engine
         out = {
@@ -118,11 +205,9 @@ class EngineRunner:
             waiters = list(self._waiters.values())
             self._waiters.clear()
         for item in pending:
-            item[2].error = RuntimeError("engine runner shut down")
-            item[2].event.set()
+            item[2].fail(RuntimeError("engine runner shut down"))
         for w in waiters:
-            w.error = RuntimeError("engine runner shut down")
-            w.event.set()
+            w.fail(RuntimeError("engine runner shut down"))
 
     # ------------------------------------------------------------ the loop
     def _drain_inbox(self) -> None:
@@ -134,8 +219,7 @@ class EngineRunner:
             try:
                 rid = self.engine.submit(tokens, max_new_tokens=max_new)
             except Exception as e:  # validation error -> the caller
-                w.error = e
-                w.event.set()
+                w.fail(e)
                 continue
             with self._lock:
                 self._waiters[rid] = w
@@ -149,12 +233,24 @@ class EngineRunner:
                     self._wake.wait(timeout=0.5)
                     self._wake.clear()
                     continue
-                for done in self.engine.step():
+                done_now = self.engine.step()
+                # Stream incremental tokens for in-flight requests.
+                live = {
+                    req.rid: req for req in self.engine._active.values()
+                }
+                with self._lock:
+                    watched = list(self._waiters.items())
+                for rid, w in watched:
+                    req = live.get(rid)
+                    if req is not None and isinstance(w, _StreamWaiter):
+                        gen = list(req.generated)
+                        w.push(gen[w.sent :])
+                        w.sent = len(gen)
+                for done in done_now:
                     with self._lock:
                         w = self._waiters.pop(done.rid, None)
                     if w is not None:
-                        w.completion = done
-                        w.event.set()
+                        w.complete(done)
         except Exception as e:  # device/engine failure: fail loudly,
             # unblock EVERY current and queued waiter, mark unhealthy
             # (healthz flips, complete() refuses new work).
@@ -168,11 +264,9 @@ class EngineRunner:
                 waiters = list(self._waiters.values())
                 self._waiters.clear()
             for item in pending:
-                item[2].error = err
-                item[2].event.set()
+                item[2].fail(err)
             for w in waiters:
-                w.error = err
-                w.event.set()
+                w.fail(err)
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -230,6 +324,9 @@ class _Handler(BaseHTTPRequestHandler):
                 return
         try:
             max_new = int(req.get("max_new_tokens", self.default_max_new))
+            if req.get("stream"):
+                self._stream_response(tokens, max_new)
+                return
             done = self.runner.complete(
                 tokens, max_new, timeout=self.request_timeout_s
             )
@@ -252,6 +349,42 @@ class _Handler(BaseHTTPRequestHandler):
                 # finished completion into a dropped connection.
                 out["text_error"] = repr(e)
         self._send(200, out)
+
+    def _stream_response(self, tokens, max_new: int) -> None:
+        """Server-sent events: one ``data:`` line per token delta, a
+        final one with finished_by, then ``data: [DONE]``. Errors after
+        the 200 has been sent arrive as a ``data:`` error event — the
+        status line cannot be rewritten mid-stream."""
+        gen = self.runner.stream(
+            tokens, max_new, timeout=self.request_timeout_s
+        )
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        self.send_header("Connection", "close")
+        self.end_headers()
+
+        def emit(obj) -> None:
+            self.wfile.write(
+                b"data: " + json.dumps(obj).encode() + b"\n\n"
+            )
+            self.wfile.flush()
+
+        try:
+            for kind, payload in gen:
+                if kind == "delta":
+                    out = {"tokens": payload}
+                    if self.tokenizer is not None:
+                        try:
+                            out["text"] = self.tokenizer.decode(payload)
+                        except Exception:
+                            pass  # partial sequences may not decode
+                    emit(out)
+                else:  # done
+                    emit({"finished_by": payload.finished_by})
+        except Exception as e:
+            emit({"error": str(e)})
+        self.wfile.write(b"data: [DONE]\n\n")
 
 
 def make_server(

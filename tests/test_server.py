@@ -242,6 +242,60 @@ def test_undecodable_tokens_still_return_200(tiny):
         t.join(5)
 
 
+def test_streaming_sse(served):
+    """stream=true yields SSE deltas that concatenate to exactly the
+    blocking endpoint's tokens, ending with finished_by + [DONE]."""
+    base, _ = served
+    prompt = list(range(1, 8))
+    _, blocking = _post(
+        base, "/v1/completions", {"tokens": prompt, "max_new_tokens": 5}
+    )
+
+    req = urllib.request.Request(
+        base + "/v1/completions",
+        data=json.dumps(
+            {"tokens": prompt, "max_new_tokens": 5, "stream": True}
+        ).encode(),
+        method="POST",
+    )
+    events = []
+    with urllib.request.urlopen(req, timeout=120) as r:
+        assert r.headers["Content-Type"] == "text/event-stream"
+        for raw in r:
+            line = raw.decode().strip()
+            if not line.startswith("data: "):
+                continue
+            body = line[len("data: "):]
+            if body == "[DONE]":
+                events.append("DONE")
+                break
+            events.append(json.loads(body))
+    assert events[-1] == "DONE"
+    assert events[-2] == {"finished_by": "length"}
+    streamed = [t for e in events[:-2] for t in e["tokens"]]
+    assert streamed == blocking["tokens"]
+    assert len(events) > 3  # actually incremental, not one blob
+
+
+def test_streaming_runner_api(tiny):
+    from shifu_tpu.infer import Engine, EngineRunner
+
+    model, params = tiny
+    engine = Engine(
+        model, params, max_slots=1, max_len=32,
+        sample_cfg=SampleConfig(temperature=0.0), prefill_buckets=(16,),
+    )
+    runner = EngineRunner(engine)
+    got, done = [], None
+    for kind, payload in runner.stream([1, 2, 3], 4, timeout=120):
+        if kind == "delta":
+            got.extend(payload)
+        else:
+            done = payload
+    assert done is not None and done.tokens == got
+    runner.shutdown()
+
+
 def test_runner_shutdown_unblocks_waiters(tiny):
     from shifu_tpu.infer import EngineRunner
 
