@@ -580,7 +580,21 @@ class Transformer(Module):
         p = self.policy.cast_to_compute(params)
         b, s = tokens.shape
 
-        h = jnp.take(p["embed"], tokens, axis=0)
+        # Embedding lookup. The table's embed axis is fsdp-sharded at rest,
+        # but the gather OUTPUT wants (batch->fsdp, seq->sp): if the gather
+        # inherits operand-passthrough sharding, SPMD must replicate-then-
+        # repartition the (b, s, d) output EVERY microbatch ("involuntary
+        # full rematerialization"). Un-shard the table's embed axis first:
+        # that all-gather is loop-invariant, so XLA hoists it out of the
+        # microbatch scan, and the gather is born index-passthrough sharded.
+        # Training path only — on the decode path (cache) there is no scan
+        # to hoist out of, and forcing a per-step table all-gather over
+        # fsdp would cost far more than the row gather it replaces.
+        w_embed = (
+            constrain(p["embed"], ("vocab", None)) if cache is None
+            else p["embed"]
+        )
+        h = jnp.take(w_embed, tokens, axis=0)
         h = constrain(h, ("batch", "seq", "act_embed"))
 
         if positions is None:
