@@ -918,16 +918,18 @@ class PagedEngine(Engine):
         return tok, cache
 
     def _decode_impl(self, params, cache, cur, lengths, active, table, rng):
-        kv_mask = (
-            jnp.arange(self.pages_per_slot * self.page_size)[None, :]
-            <= lengths[:, None]
-        )
+        # No kv_mask: on the paged path it would be ``pos <= lengths`` —
+        # exactly the slot-space causality the decode attention already
+        # enforces from ``cache_index`` (both the Pallas kernel and the
+        # XLA fallback). Stale data beyond a row's length (bucket padding
+        # written at prefill, pages of preempted donors) sits at
+        # positions > lengths[b] and is causally hidden; passing the
+        # redundant mask would cost a per-layer mask expansion and DMA.
         logits, cache = self.model(
             params,
             cur[:, None],
             cache=cache,
             cache_index=lengths,
-            kv_mask=kv_mask,
             page_table=table,
         )
         nxt = sample_logits(logits[:, -1], rng, self.sample_cfg)

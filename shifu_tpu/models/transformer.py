@@ -252,15 +252,18 @@ class Transformer(Module):
     # ------------------------------------------------------------- one block
     def _block(
         self, p, h, sin, cos, segment_ids, cache_slice, cache_index,
-        kv_mask=None, page_table=None,
+        kv_mask=None, page_table=None, layer_idx=None,
     ):
         """One transformer block. ``p`` holds per-layer (unstacked) params.
 
         Returns (h, new_cache_slice, moe_aux); cache_slice is None outside
         decode; moe_aux is None for a dense FFN, else a dict of scalars.
-        With ``page_table`` the cache_slice leaves are a PAGED pool
-        (n_pages, page_size, kv, hd) shared across rows — see
-        :meth:`init_paged_cache`.
+        With ``page_table`` the cache_slice leaves are the FULL stacked
+        paged pool (n_layers, n_pages, page_size, kv, hd) and
+        ``layer_idx`` the (traced) layer to touch — the pool rides the
+        layer scan as a carry and is only ever updated in place, page by
+        page; materialising a per-layer slice would copy the entire
+        layer every decode step — see :meth:`init_paged_cache`.
         """
         cfg = self.cfg
         x = rms_norm(h, p["attn_norm"], eps=cfg.norm_eps)
@@ -282,7 +285,8 @@ class Transformer(Module):
             new_cache = None
         elif page_table is not None:
             attn, new_cache = self._paged_block_attention(
-                q, k, v, cache_slice, cache_index, page_table, kv_mask
+                q, k, v, cache_slice, cache_index, page_table, kv_mask,
+                layer_idx,
             )
         else:
             if getattr(cache_index, "ndim", 0) == 1:
@@ -363,16 +367,20 @@ class Transformer(Module):
 
     # ------------------------------------------------------------ paged kv
     def _paged_block_attention(
-        self, q, k, v, pool, cache_index, page_table, kv_mask
+        self, q, k, v, pool, cache_index, page_table, kv_mask, layer_idx
     ):
-        """Attention over a PAGED kv pool (one layer's slice).
+        """Attention over the PAGED kv pool (full stack, one layer live).
 
-        pool: {"k","v"} of (n_pages, page_size, kv, hd) — physical pages
-        shared by all rows. page_table: (b, pages_per_row) int32 mapping
-        row-logical page j to a physical page (unallocated entries point
-        at the scratch page 0; kv_mask hides whatever lands there).
-        Logical position t of row b lives at
-        pool[table[b, t // ps], t % ps].
+        pool: {"k","v"} of (n_layers, n_pages, page_size, kv, hd) —
+        physical pages shared by all rows; ``layer_idx`` (traced int32)
+        selects the layer this block touches. The pool is a scan CARRY:
+        all writes are in-place page scatters and (on the Pallas path)
+        all reads are per-page DMAs, so the multi-GB pool is never
+        sliced or restacked per layer. page_table: (b, pages_per_row)
+        int32 mapping row-logical page j to a physical page (unallocated
+        entries point at the scratch page 0; kv_mask hides whatever
+        lands there). Logical position t of row b lives at
+        pool[layer, table[b, t // ps], t % ps].
 
         Three call shapes, mirroring the dense path:
           * prefill (q_len > 1, cache_index == 0, the static int): k/v
@@ -393,8 +401,9 @@ class Transformer(Module):
             dense cache (_decode_attention).
         """
         b, q_len, _, _ = q.shape
-        n_pages, ps, n_kv, hd = pool["k"].shape
+        _, n_pages, ps, n_kv, hd = pool["k"].shape
         pages_per_row = page_table.shape[1]
+        li = layer_idx
         kc = k.astype(pool["k"].dtype)
         vc = v.astype(pool["v"].dtype)
 
@@ -420,8 +429,8 @@ class Transformer(Module):
                 # Fresh prefill: local attention fast path (flash for
                 # long prompts), nothing cached to look at.
                 phys = page_table[0, : q_len // ps]  # (np_b,)
-                ck = pool["k"].at[phys].set(kv_block)
-                cv = pool["v"].at[phys].set(v_block)
+                ck = pool["k"].at[li, phys].set(kv_block)
+                cv = pool["v"].at[li, phys].set(v_block)
                 attn = dot_product_attention(
                     q, k, v, causal=True, impl=self.cfg.attn_impl,
                     window=self.cfg.window_size,
@@ -434,12 +443,12 @@ class Transformer(Module):
                 phys = jax.lax.dynamic_slice_in_dim(
                     page_table[0], start, q_len // ps
                 )
-                ck = pool["k"].at[phys].set(kv_block)
-                cv = pool["v"].at[phys].set(v_block)
-                gk = ck[page_table].reshape(
+                ck = pool["k"].at[li, phys].set(kv_block)
+                cv = pool["v"].at[li, phys].set(v_block)
+                gk = ck[li][page_table].reshape(
                     b, page_table.shape[1] * ps, n_kv, hd
                 )
-                gv = cv[page_table].reshape(
+                gv = cv[li][page_table].reshape(
                     b, page_table.shape[1] * ps, n_kv, hd
                 )
                 attn = _decode_attention(
@@ -457,17 +466,38 @@ class Transformer(Module):
             off = cache_index % ps
             # Inactive slots all point at scratch page 0 — duplicate
             # scatter indices there are benign (nothing reads scratch).
-            ck = pool["k"].at[phys, off].set(kc[:, 0])
-            cv = pool["v"].at[phys, off].set(vc[:, 0])
-            # Gather each row's pages into its logical view. One take per
-            # layer; XLA fuses the reshape, and traffic matches what the
-            # dense cache's attention would read anyway.
-            gk = ck[page_table].reshape(b, pages_per_row * ps, n_kv, hd)
-            gv = cv[page_table].reshape(b, pages_per_row * ps, n_kv, hd)
-            attn = _decode_attention(
-                q, gk, gv, cache_index, self.cfg.attn_impl, kv_mask=kv_mask,
-                window=self.cfg.window_size,
-            )
+            ck = pool["k"].at[li, phys, off].set(kc[:, 0])
+            cv = pool["v"].at[li, phys, off].set(vc[:, 0])
+            if self.cfg.attn_impl == "flash" and _pallas_paged_ok():
+                # Pallas paged-decode kernel: reads each live page once,
+                # straight from the stacked pool via the scalar-prefetched
+                # page table and layer index — neither the per-layer
+                # slice nor the (b, pages_per_row * ps, kv, hd) gather
+                # ever exists (ops/pallas/paged_attention.py).
+                from shifu_tpu.ops.pallas.paged_attention import (
+                    paged_decode_attention,
+                )
+
+                attn = paged_decode_attention(
+                    q[:, 0], ck, cv, page_table, cache_index, layer=li,
+                    window=self.cfg.window_size, kv_mask=kv_mask,
+                )[:, None]
+            else:
+                # Gather each row's pages into its logical view (copies
+                # one layer's slice — the XLA fallback's structural
+                # cost; the kernel path above avoids it).
+                gk = (
+                    ck[li][page_table]
+                    .reshape(b, pages_per_row * ps, n_kv, hd)
+                )
+                gv = (
+                    cv[li][page_table]
+                    .reshape(b, pages_per_row * ps, n_kv, hd)
+                )
+                attn = _decode_attention(
+                    q, gk, gv, cache_index, self.cfg.attn_impl,
+                    kv_mask=kv_mask, window=self.cfg.window_size,
+                )
         return attn, {"k": ck, "v": cv}
 
     # ------------------------------------------------------------- moe ffn
@@ -647,15 +677,38 @@ class Transformer(Module):
             if return_aux:
                 raise ValueError("return_aux is a training-path (no-cache) flag")
 
-            def body(carry, xs):
-                layer_p, cache_slice = xs
-                out, new_slice, aux = block(
-                    layer_p, carry, sin, cos, None, cache_slice, cache_index,
-                    kv_mask, page_table,
-                )
-                return out, (new_slice, aux)
+            if page_table is not None:
+                # Paged pool: the multi-GB pool rides the scan as a CARRY
+                # updated in place (page scatters + per-page kernel reads
+                # addressed by the layer index). Passing it as scan xs/ys
+                # would dynamic-slice AND restack one full layer per
+                # block — reading and writing the entire pool every
+                # decode step.
+                def body(carry, xs):
+                    hh, pool = carry
+                    layer_p, li = xs
+                    out, pool, aux = block(
+                        layer_p, hh, sin, cos, None, pool, cache_index,
+                        kv_mask, page_table, li,
+                    )
+                    return (out, pool), aux
 
-            h, (new_cache, auxes) = jax.lax.scan(body, h, (p["blocks"], cache))
+                (h, new_cache), auxes = jax.lax.scan(
+                    body, (h, cache),
+                    (p["blocks"], jnp.arange(cfg.n_layers)),
+                )
+            else:
+                def body(carry, xs):
+                    layer_p, cache_slice = xs
+                    out, new_slice, aux = block(
+                        layer_p, carry, sin, cos, None, cache_slice,
+                        cache_index, kv_mask, page_table,
+                    )
+                    return out, (new_slice, aux)
+
+                h, (new_cache, auxes) = jax.lax.scan(
+                    body, h, (p["blocks"], cache)
+                )
 
         h = rms_norm(h, p["final_norm"], eps=cfg.norm_eps)
         moe_aux = (
@@ -830,6 +883,20 @@ class Transformer(Module):
             cfg.resolved_head_dim,
         )
         return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def _pallas_paged_ok() -> bool:
+    """Whether the Pallas paged-decode kernel may be dispatched.
+
+    The kernel is a single-device program: under a multi-device
+    activation-sharding mesh the cache pool is sharded (kv heads over
+    tp) and a bare ``pallas_call`` would not be partitioned — there the
+    decode falls back to the XLA gather path (tp mesh serving keeps
+    working, just without the kernel)."""
+    from shifu_tpu.parallel.ctx import current_env
+
+    env = current_env()
+    return env is None or env.mesh.size == 1
 
 
 def _decode_attention(q, ck, cv, cache_index, impl, kv_mask=None,

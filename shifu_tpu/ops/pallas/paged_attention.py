@@ -1,0 +1,299 @@
+"""Pallas TPU paged-attention decode kernel.
+
+Serving decode on the paged engine is HBM-bandwidth-bound: each step must
+read every live KV page once. The XLA fallback (models/transformer.py
+``_paged_block_attention``) materialises the gather ``pool[page_table]``
+as a (b, pages_per_row * page_size, kv, hd) intermediate in HBM and then
+reads it again inside attention — ~3x the compulsory traffic (write the
+gathered copy, read it back, plus the pool read itself). This kernel
+reads each page exactly once, straight from the pool:
+
+  * the page table and per-row lengths are **scalar-prefetched**
+    (``pltpu.PrefetchScalarGridSpec``), so the K/V BlockSpec index maps
+    resolve logical page ``j`` of row ``b`` to its physical page
+    ``table[b, j]`` at DMA-issue time — the gather never exists as a
+    tensor;
+  * grid is (batch, ceil(pages_per_row / U)) with U pages fetched per
+    step (U BlockSpec'd inputs each); every page is shared by ALL query
+    heads of the row, so GQA reads each page once, not once per head;
+  * index maps clamp the logical page to the row's last live page, so
+    grid steps past a short row's length re-issue the same block index —
+    Mosaic elides the repeat DMA, making per-row traffic O(row length),
+    not O(pages_per_row);
+  * scores for every head against one page are ONE dot: the page block
+    (ps, kv, hd) reinterprets as (ps*kv, hd) — kv*hd is already the
+    native (8, 128)-tiled layout, so the reshape is free — and
+    q (heads, hd) contracts against it in a single MXU op. Lanes whose
+    kv head doesn't serve the query head are masked to NEG_INF; their
+    exp underflows to exactly 0, so they add nothing to the normaliser
+    or the accumulator. Decode is DMA-bound — the kv-fold FLOP waste is
+    invisible, and it removes per-head strided slices and per-head
+    scratch read-modify-writes entirely;
+  * online softmax (running max / normaliser / f32 accumulator) is
+    carried in registers across the U unrolled pages and hits VMEM
+    scratch once per grid step; the output block is written once, at
+    the last step. Fully-masked (dead) steps are exact no-ops (alpha=1,
+    p=0), so there is no in-kernel control flow at all.
+
+Masking reproduces the engine's slot-space semantics exactly: key
+position ``pos`` is visible iff ``pos <= lengths[b]`` (the current
+token was scattered at ``lengths[b]`` before the call), optionally
+``pos > lengths[b] - window`` (sliding window) and ``kv_mask[b, pos]``.
+
+Layout contract matches the caller (models/transformer.py paged decode):
+q (b, n_heads, hd) — one decode token per row, already RoPE'd; pool
+(n_pages, page_size, kv, hd) — POST-scatter (current token written);
+page_table (b, pages_per_row) int32; lengths (b,) int32. Page 0 is the
+engine's scratch page; rows whose table entries point there are hidden
+by the length mask, never read.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from shifu_tpu.ops.attention import NEG_INF
+
+# Lane-replicated scratch width for the per-head running max/normaliser
+# (see ops/pallas/flash_attention.py — same convention).
+_LANES = 128
+
+# Floor for the running max. Strictly above NEG_INF (-2e38) and strictly
+# below any real score, so exp(NEG_INF - floor) underflows to exactly 0:
+# a fully-masked page (or a row kv_mask hid entirely) contributes
+# nothing to the normaliser or the accumulator in EVERY scratch state.
+# Initialising the running max at NEG_INF itself would make the first
+# fully-masked page compute p = exp(NEG_INF - NEG_INF) = 1 on every
+# lane and average stale V pages into the output.
+_MASK_FLOOR = -1e30
+
+
+def _decode_kernel(scale, window, n_kv, group, unroll, ps, has_mask, *refs):
+    """One (row, page-group) grid step: U pages against all query heads.
+
+    refs: table_ref, len_ref, layer_ref (scalar prefetch), q_ref
+    (1, heads, hd), U k_refs + U v_refs (1, 1, ps*n_kv, hd) each,
+    [mask_ref (1, 1, U*ps*n_kv) — pre-expanded kv-interleaved], o_ref
+    (1, heads, hd), scratch m/l (heads, _LANES) and acc (heads, hd).
+    """
+    len_ref = refs[1]
+    q_ref = refs[3]
+    k_refs = refs[4 : 4 + unroll]
+    v_refs = refs[4 + unroll : 4 + 2 * unroll]
+    rest = refs[4 + 2 * unroll :]
+    if has_mask:
+        mask_ref, o_ref, m_sc, l_sc, acc_sc = rest
+    else:
+        o_ref, m_sc, l_sc, acc_sc = rest
+        mask_ref = None
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    heads = q_ref.shape[1]
+    lanes = ps * n_kv
+
+    @pl.when(j == 0)
+    def _():
+        m_sc[...] = jnp.full_like(m_sc, _MASK_FLOOR)
+        l_sc[...] = jnp.zeros_like(l_sc)
+        acc_sc[...] = jnp.zeros_like(acc_sc)
+
+    length = len_ref[b]  # valid keys: pos <= length (current token incl.)
+    q = q_ref[0]  # (heads, hd)
+
+    # Lane r of a flattened page holds position r // n_kv, kv head
+    # r % n_kv; query head i is served by kv head i // group. Static over
+    # the whole kernel.
+    lane_pos = jax.lax.broadcasted_iota(jnp.int32, (heads, lanes), 1) // n_kv
+    lane_kv = jax.lax.broadcasted_iota(jnp.int32, (heads, lanes), 1) % n_kv
+    head_kv = jax.lax.broadcasted_iota(jnp.int32, (heads, lanes), 0) // group
+    head_match = lane_kv == head_kv
+
+    m = m_sc[...]
+    l = l_sc[...]
+    acc = acc_sc[...]
+    for u in range(unroll):
+        base = (j * unroll + u) * ps
+        k = k_refs[u][0, 0]  # (ps*kv, hd) — pool pre-flattened by wrapper
+        v = v_refs[u][0, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # (heads, ps*kv)
+        pos = base + lane_pos
+        valid = jnp.logical_and(head_match, pos <= length)
+        if window is not None:
+            valid = jnp.logical_and(valid, pos > length - window)
+        if mask_ref is not None:
+            mrow = mask_ref[0, 0, u * lanes : (u + 1) * lanes]  # (ps*kv,)
+            valid = jnp.logical_and(valid, mrow[None, :] != 0)
+        s = jnp.where(valid, s, NEG_INF)
+
+        # m never drops below _MASK_FLOOR, so masked lanes (s = NEG_INF)
+        # give p = exp(NEG_INF - m) = 0 exactly, in every state.
+        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m - m_new)  # 1.0 on fully-masked steps
+        p = jnp.exp(s - m_new[:, :1])  # exact 0 on masked lanes
+        l = alpha * l + jnp.sum(p, axis=1, keepdims=True)
+        m = m_new
+        acc = acc * alpha[:, :1] + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+    m_sc[...] = m
+    l_sc[...] = l
+    acc_sc[...] = acc
+
+    @pl.when(j == pl.num_programs(1) - 1)
+    def _():
+        l1 = l_sc[:, :1]
+        # Position 0 is always <= length, so l > 0 for every real row;
+        # the guard only protects rows a caller fully masked via kv_mask.
+        safe_l = jnp.where(l1 == 0.0, 1.0, l1)
+        o_ref[0] = (acc_sc[...] / safe_l).astype(o_ref.dtype)
+
+
+def paged_decode_attention(
+    q,
+    k_pool,
+    v_pool,
+    page_table,
+    lengths,
+    *,
+    layer=None,
+    scale: Optional[float] = None,
+    window: Optional[int] = None,
+    kv_mask: Optional[jax.Array] = None,
+    pages_per_step: int = 4,
+    interpret: Optional[bool] = None,
+):
+    """Single-token decode attention over a paged KV pool.
+
+    Args:
+      q: (batch, n_heads, head_dim) — this step's queries, RoPE applied.
+      k_pool, v_pool: (n_pages, page_size, n_kv_heads, head_dim) —
+        physical pages, POST-scatter (the current token's K/V already
+        written at position ``lengths[b]`` of row ``b``). With ``layer``
+        given, the STACKED pools (n_layers, n_pages, page_size, kv, hd):
+        the kernel addresses pages of layer ``layer`` directly in the
+        stacked array, so the caller never materialises a per-layer
+        slice (inside a scan-over-layers, slicing the pool would copy
+        the entire layer — the whole point of this mode is that the
+        pool is only ever touched page-by-page).
+      page_table: (batch, pages_per_row) int32 — logical→physical page
+        map; entries past a row's length may point anywhere live (the
+        engine points them at scratch page 0) — they are never read.
+      lengths: (batch,) int32 — the current token's position; keys at
+        ``pos <= lengths[b]`` are visible (slot-space causality).
+      layer: optional traced int32 scalar — which layer of stacked
+        5-D pools to read (scalar-prefetched into the index maps).
+      scale: score scale; defaults to head_dim ** -0.5.
+      window: sliding window — keys further than ``window - 1`` behind
+        the current position are hidden.
+      kv_mask: optional (batch, pages_per_row * page_size) bool — extra
+        per-position visibility AND'ed onto the causal mask.
+      pages_per_step: pages fetched per grid step (DMA/compute grain).
+      interpret: force pallas interpret mode; defaults to interpret
+        unless running on TPU (CPU tests exercise this same kernel).
+
+    Returns:
+      (batch, n_heads, head_dim) in q.dtype.
+    """
+    b, n_heads, hd = q.shape
+    if layer is not None:
+        n_layers, n_pages, ps, n_kv, _ = k_pool.shape
+    else:
+        n_pages, ps, n_kv, _ = k_pool.shape
+    pages_per_row = page_table.shape[1]
+    if n_heads % n_kv:
+        raise ValueError(f"n_heads={n_heads} not divisible by kv={n_kv}")
+    group = n_heads // n_kv
+    scale = float(scale) if scale is not None else hd**-0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    unroll = max(1, min(pages_per_step, pages_per_row))
+    n_steps = -(-pages_per_row // unroll)
+
+    table = page_table.astype(jnp.int32)
+    lengths = lengths.astype(jnp.int32)
+    # Unified layout: the single-pool call is layer 0 of a 1-layer stack
+    # (a free leading-axis reshape), so one kernel serves both modes.
+    li_arr = jnp.asarray(layer if layer is not None else 0, jnp.int32)[None]
+    n_layers_ = n_layers if layer is not None else 1
+
+    def page_of(u):
+        def index(ib, j, table_ref, len_ref, li_ref):
+            # Clamp to the row's live page range: steps past the row's
+            # length (and, with a sliding window, steps wholly before
+            # the window) repeat a neighbouring block index, which
+            # Mosaic never re-fetches — per-row DMA is O(live pages)
+            # (O(window) pages when windowed), not O(pages_per_row).
+            jl = j * unroll + u
+            hi = len_ref[ib] // ps  # <= pages_per_row - 1 always
+            if window is not None:
+                lo = jnp.maximum(len_ref[ib] - (window - 1), 0) // ps
+                jl = jnp.maximum(jl, lo)
+            jc = jnp.minimum(jl, hi)
+            return (li_ref[0], table_ref[ib, jc], 0, 0)
+
+        return index
+
+    # Flatten (ps, kv) into the sublane axis OUTSIDE the kernel — the
+    # trailing (kv, hd) dims are already one native (8, 128) tile, so
+    # this is a free reinterpretation for XLA, and the kernel's blocks
+    # arrive in their compute layout with no in-kernel relayout.
+    k_flat = k_pool.reshape(n_layers_, n_pages, ps * n_kv, hd)
+    v_flat = v_pool.reshape(n_layers_, n_pages, ps * n_kv, hd)
+    kv_spec = [
+        pl.BlockSpec((1, 1, ps * n_kv, hd), page_of(u))
+        for u in range(unroll)
+    ]
+    in_specs = (
+        [pl.BlockSpec((1, n_heads, hd), lambda ib, j, t, l, li: (ib, 0, 0))]
+        + kv_spec
+        + kv_spec
+    )
+    inputs = [q] + [k_flat] * unroll + [v_flat] * unroll
+    has_mask = kv_mask is not None
+    if has_mask:
+        # Pre-expand to lane space: lane r of a flattened page = position
+        # r // n_kv, so repeat each position's bit n_kv times. Padded to
+        # the grid (pad bits are 0 = invalid; causality hides them too).
+        m = jnp.repeat(kv_mask.astype(jnp.int32), n_kv, axis=1)
+        pad = n_steps * unroll * ps * n_kv - m.shape[1]
+        if pad:
+            m = jnp.pad(m, ((0, 0), (0, pad)))
+        inputs.append(m[:, None, :])
+        in_specs.append(
+            pl.BlockSpec(
+                (1, 1, unroll * ps * n_kv),
+                lambda ib, j, t, l, li: (ib, 0, j),
+            )
+        )
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(b, n_steps),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec(
+            (1, n_heads, hd), lambda ib, j, t, l, li: (ib, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((n_heads, _LANES), jnp.float32),  # running max
+            pltpu.VMEM((n_heads, _LANES), jnp.float32),  # normaliser
+            pltpu.VMEM((n_heads, hd), jnp.float32),      # accumulator
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(
+            _decode_kernel, scale, window, n_kv, group, unroll, ps, has_mask
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, n_heads, hd), q.dtype),
+        interpret=interpret,
+    )(table, lengths, li_arr, *inputs)
