@@ -445,10 +445,12 @@ class Transformer(Module):
                 )
                 ck = pool["k"].at[li, phys].set(kv_block)
                 cv = pool["v"].at[li, phys].set(v_block)
-                gk = ck[li][page_table].reshape(
+                # One mixed-index gather: the scalar layer index rides the
+                # gather instead of materialising the full layer slice.
+                gk = ck[li, page_table].reshape(
                     b, page_table.shape[1] * ps, n_kv, hd
                 )
-                gv = cv[li][page_table].reshape(
+                gv = cv[li, page_table].reshape(
                     b, page_table.shape[1] * ps, n_kv, hd
                 )
                 attn = _decode_attention(
@@ -483,16 +485,16 @@ class Transformer(Module):
                     window=self.cfg.window_size, kv_mask=kv_mask,
                 )[:, None]
             else:
-                # Gather each row's pages into its logical view (copies
-                # one layer's slice — the XLA fallback's structural
-                # cost; the kernel path above avoids it).
-                gk = (
-                    ck[li][page_table]
-                    .reshape(b, pages_per_row * ps, n_kv, hd)
+                # Gather each row's pages into its logical view with ONE
+                # mixed-index gather (scalar layer + page indices): the
+                # layer slice itself is never materialised. Traffic is
+                # the gathered copy's write+read — the kernel path above
+                # avoids even that.
+                gk = ck[li, page_table].reshape(
+                    b, pages_per_row * ps, n_kv, hd
                 )
-                gv = (
-                    cv[li][page_table]
-                    .reshape(b, pages_per_row * ps, n_kv, hd)
+                gv = cv[li, page_table].reshape(
+                    b, pages_per_row * ps, n_kv, hd
                 )
                 attn = _decode_attention(
                     q, gk, gv, cache_index, self.cfg.attn_impl,
