@@ -39,6 +39,9 @@ class _Request:
     max_new_tokens: int
     generated: Optional[List[int]] = None
     slot: Optional[int] = None
+    # Chunked prefill progress: prompt tokens already written to the
+    # cache (prefix-cache hits included). Reset on preemption.
+    prefilled: int = 0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -115,6 +118,10 @@ class Engine:
         self._free = list(range(max_slots))[::-1]
         self._queue: collections.deque = collections.deque()
         self._active: Dict[int, _Request] = {}  # slot -> request
+        # Slots mid-way through a CHUNKED prefill (paged engines with
+        # prefill_chunk set): they hold a slot + pages but do not decode
+        # until their last chunk lands (_advance_prefills).
+        self._prefilling: Dict[int, _Request] = {}
         self._rid = itertools.count()
 
         # Host mirrors of per-slot decode state.
@@ -148,10 +155,13 @@ class Engine:
                 f"prompt {len(prompt_tokens)} + max_new {max_new_tokens} "
                 f"exceeds max_len {self.max_len}"
             )
-        if len(prompt_tokens) > self.buckets[-1]:
+        if (
+            len(prompt_tokens) > self.buckets[-1]
+            and not getattr(self, "prefill_chunk", None)
+        ):
             raise ValueError(
                 f"prompt longer than the largest prefill bucket "
-                f"{self.buckets[-1]}"
+                f"{self.buckets[-1]} (chunked prefill not enabled)"
             )
         rid = next(self._rid)
         self._queue.append(
@@ -161,28 +171,44 @@ class Engine:
 
     @property
     def idle(self) -> bool:
-        return not self._queue and not self._active
+        return (
+            not self._queue and not self._active and not self._prefilling
+        )
 
     def live_generated(self) -> Dict[int, List[int]]:
         """rid -> tokens generated so far, for in-flight requests.
         The streaming front-end diffs this between steps; it is the
-        public contract so callers stay off engine internals."""
-        return {
+        public contract so callers stay off engine internals. Includes
+        slots mid-chunked-prefill and queued (e.g. preempted) requests,
+        whose already-generated tokens must not vanish from the live
+        view while they wait to (re-)enter the decode pool."""
+        live = {
             req.rid: list(req.generated)
             for req in self._active.values()
         }
+        for req in self._prefilling.values():
+            live[req.rid] = list(req.generated)
+        for req in self._queue:
+            live[req.rid] = list(req.generated or [])
+        return live
 
     @property
     def active_slots(self) -> int:
-        return len(self._active)
+        """Occupied slots: decoding + mid-chunked-prefill."""
+        return len(self._active) + len(self._prefilling)
 
     def step(self) -> List[Completion]:
-        """Admit queued requests into free slots, then decode one token for
-        every active slot. Returns requests that completed this step."""
+        """Admit queued requests into free slots, advance any chunked
+        prefills by one chunk, then decode one token for every active
+        slot. Returns requests that completed this step."""
         while self._free and self._queue:
             if not self._try_admit(self._queue[0]):
                 break  # admission blocked (e.g. paged pool dry): wait
             self._queue.popleft()
+        # One prompt chunk per prefilling slot per step, so a long
+        # admission never stalls active decodes (paged engines with
+        # prefill_chunk; no-op otherwise).
+        self._advance_prefills()
         # Requests can finish AT admission (prefill sampled eos, or a
         # 1-token budget) — sweep before decoding would append an extra
         # token past eos/budget.
@@ -356,6 +382,9 @@ class Engine:
     def _release(self, slot: int) -> None:
         """Per-slot cleanup on completion/preemption (paged: free pages).
         The caller returns the slot to the free list itself."""
+
+    def _advance_prefills(self) -> None:
+        """Advance in-flight chunked prefills (paged engines override)."""
 
     def _sweep(self) -> List[Completion]:
         out: List[Completion] = []
@@ -531,14 +560,24 @@ class PagedEngine(Engine):
         page_size: int = 64,
         n_pages: Optional[int] = None,
         enable_prefix_cache: bool = False,
+        prefill_chunk: Optional[int] = None,
         **kw,
     ):
+        """``prefill_chunk``: when set, prompts longer than this many
+        tokens prefill in page-aligned chunks, ONE chunk per engine
+        step, interleaved with decode dispatches for the active slots —
+        a long admission never stalls decoding. Also lifts the
+        bucket-coverage constraints: any prompt with
+        prompt + max_new <= max_len is admittable, the largest bucket
+        only needs to cover one chunk. The prefilling slot's table row
+        stays pending (all scratch) until its last chunk lands, so
+        interleaved decode dispatches touch only the scratch page."""
         if getattr(model, "prefill_needs_mask", False):
             raise ValueError(
                 "recurrent models carry O(1) state per slot — a paged KV "
                 "pool only makes sense for attention caches; use Engine"
             )
-        if enable_prefix_cache:
+        if enable_prefix_cache or prefill_chunk:
             scaling = getattr(
                 getattr(model, "cfg", None), "rope_scaling", None
             )
@@ -547,11 +586,30 @@ class PagedEngine(Engine):
                 # Cached prefix K was rotated under the DONOR's length
                 # regime; a different-length borrower would need
                 # different frequencies — reuse would be silently wrong.
-                raise ValueError(
-                    f"prefix caching is unsound with length-sensitive "
-                    f"rope_scaling {kind!r}: cached keys bake in the "
-                    "donor request's frequency regime"
+                # Chunked prefill has the same unsoundness: an early
+                # chunk's keys would bake in a shorter-length regime
+                # than the prompt's final length.
+                feature = (
+                    "prefix caching" if enable_prefix_cache
+                    else "chunked prefill"
                 )
+                raise ValueError(
+                    f"{feature} is unsound with length-sensitive "
+                    f"rope_scaling {kind!r}: cached keys bake in a "
+                    "shorter frequency regime than the final length"
+                )
+        if prefill_chunk is not None:
+            if prefill_chunk < page_size or prefill_chunk % page_size:
+                raise ValueError(
+                    f"prefill_chunk {prefill_chunk} must be a positive "
+                    f"multiple of page_size {page_size}"
+                )
+            if prefill_chunk > max_len:
+                raise ValueError(
+                    f"prefill_chunk {prefill_chunk} exceeds max_len "
+                    f"{max_len}"
+                )
+        self.prefill_chunk = prefill_chunk
         if max_len % page_size:
             raise ValueError(
                 f"max_len {max_len} must be a multiple of page_size "
@@ -574,16 +632,21 @@ class PagedEngine(Engine):
         self.buckets = tuple(
             b for b in self.buckets if b % page_size == 0
         )
+        if prefill_chunk is not None and prefill_chunk not in self.buckets:
+            # Mid-prompt chunks dispatch at exactly chunk width; make
+            # sure that program exists.
+            self.buckets = tuple(sorted((*self.buckets, prefill_chunk)))
         if not self.buckets:
             raise ValueError(
                 f"no prefill bucket is a multiple of page_size "
                 f"{page_size} (paged prefill scatters whole pages)"
             )
-        if self.buckets[-1] < max_len - 1:
+        if prefill_chunk is None and self.buckets[-1] < max_len - 1:
             raise ValueError(
                 f"largest usable prefill bucket {self.buckets[-1]} must "
                 f"cover max_len-1={max_len - 1}: preemption re-prefills "
-                "prompt+generated, which can approach max_len"
+                "prompt+generated, which can approach max_len (enable "
+                "prefill_chunk to lift this)"
             )
 
         self._table = np.zeros(
@@ -605,7 +668,13 @@ class PagedEngine(Engine):
         self._page_rc: Dict[int, int] = {}  # page -> active-slot users
         self._page_key: Dict[int, bytes] = {}  # registered page -> key
         self.prefix_hits_tokens = 0  # observability
-        if enable_prefix_cache:
+        # Chunked-prefill pending state: the slot's REAL page-table row
+        # and full prompt live host-side until the last chunk lands;
+        # self._table[slot] stays all-scratch meanwhile so interleaved
+        # decode dispatches write only to the scratch page.
+        self._pending_rows: Dict[int, np.ndarray] = {}
+        self._pending_prompt: Dict[int, List[int]] = {}
+        if enable_prefix_cache or prefill_chunk is not None:
             self._prefill_at_jit = jax.jit(
                 self._in_act_ctx(self._prefill_at_impl),
                 static_argnames=("bucket",),
@@ -620,21 +689,32 @@ class PagedEngine(Engine):
     def submit(self, prompt_tokens, max_new_tokens: int) -> int:
         prompt_tokens = list(map(int, prompt_tokens))
         total = len(prompt_tokens) + max_new_tokens
-        if total - 1 > self.buckets[-1]:
-            raise ValueError(
-                f"prompt+max_new-1 = {total - 1} exceeds the largest "
-                f"usable bucket {self.buckets[-1]}; preemption could "
-                "not re-prefill this request"
+        if self.prefill_chunk is None:
+            if total - 1 > self.buckets[-1]:
+                raise ValueError(
+                    f"prompt+max_new-1 = {total - 1} exceeds the largest "
+                    f"usable bucket {self.buckets[-1]}; preemption could "
+                    "not re-prefill this request (enable prefill_chunk "
+                    "to lift this)"
+                )
+            # Transient worst case is the RECOMPUTE prefill after a late
+            # preemption (prompt + all-but-one generated tokens =
+            # total - 1 tokens, rounded up to its bucket) — checking only
+            # the initial prompt's bucket would admit requests that can
+            # become permanently un-admittable after preemption (host
+            # livelock).
+            worst = max(
+                -(-total // self.page_size),
+                self._bucket_for(total - 1) // self.page_size,
             )
-        # Transient worst case is the RECOMPUTE prefill after a late
-        # preemption (prompt + all-but-one generated tokens = total - 1
-        # tokens, rounded up to its bucket) — checking only the initial
-        # prompt's bucket would admit requests that can become
-        # permanently un-admittable after preemption (host livelock).
-        worst = max(
-            -(-total // self.page_size),
-            self._bucket_for(total - 1) // self.page_size,
-        )
+        else:
+            # Chunked: any prefill (initial or recompute) proceeds chunk
+            # by chunk, so the transient overshoot is at most one
+            # chunk's bucket of pages.
+            worst = (
+                -(-total // self.page_size)
+                + self.prefill_chunk // self.page_size
+            )
         if worst > self.n_pages - 1:
             raise ValueError(
                 f"request needs up to {worst} pages but the pool has "
@@ -663,6 +743,21 @@ class PagedEngine(Engine):
                 self._page_key.pop(pg, None)
                 return pg
         return None
+
+    def _alloc_page_preempting(self, slot: int) -> Optional[int]:
+        """Allocate a page, preempting the youngest occupied slot
+        (decoding OR mid-chunked-prefill; the oldest only when alone)
+        while the pool is dry. Returns None when ``slot`` itself became
+        the victim — the caller must abandon its allocation."""
+        page = self._alloc_page()
+        while page is None:
+            victims = set(self._active) | set(self._prefilling)
+            victim = max(victims, key=self._admit_order.__getitem__)
+            self._preempt(victim)
+            if victim == slot:
+                return None
+            page = self._alloc_page()
+        return page
 
     def _can_alloc(self, n: int) -> bool:
         free = len(self._free_pages)
@@ -701,11 +796,17 @@ class PagedEngine(Engine):
         self._lengths[slot] = 0
         self._cur[slot] = 0
         self._admit_order.pop(slot, None)
+        self._pending_rows.pop(slot, None)
+        self._pending_prompt.pop(slot, None)
 
     def _preempt(self, slot: int) -> None:
         """Free a slot mid-flight; the request re-enters the queue head
-        and re-prefills from prompt + generated-so-far (recompute)."""
-        req = self._active.pop(slot)
+        and re-prefills from prompt + generated-so-far (recompute).
+        Mid-chunked-prefill slots lose their progress the same way."""
+        req = self._active.pop(slot, None)
+        if req is None:
+            req = self._prefilling.pop(slot)
+        req.prefilled = 0
         self._release(slot)
         self._free.append(slot)
         req.slot = None
@@ -748,7 +849,18 @@ class PagedEngine(Engine):
                 hit += ps
             # Suffix-bucket rounding must still fit the row: shared
             # pages + the whole prefill bucket <= max_len's pages.
-            while hit and hit + self._bucket_for(p - hit) > self.max_len:
+            # Chunk-capable engines only cap while on the
+            # single-dispatch path — the chunked path's pending rows
+            # carry bucket-tail slack, and popping a page can only grow
+            # the suffix ONTO that path, never strand it.
+            while (
+                hit
+                and (
+                    self.prefill_chunk is None
+                    or p - hit <= self.prefill_chunk
+                )
+                and hit + self._bucket_for(p - hit) > self.max_len
+            ):
                 hit -= ps
                 shared.pop()
         # PIN the matched pages before allocating: rc > 0 keeps them
@@ -758,6 +870,39 @@ class PagedEngine(Engine):
         for pg in shared:
             self._page_rc[pg] = self._page_rc.get(pg, 0) + 1
         suffix = prompt[hit:]
+        if (
+            self.prefill_chunk is not None
+            and len(suffix) > self.prefill_chunk
+        ):
+            # CHUNKED admission: reserve the slot and the pinned prefix
+            # pages now; _advance_prefills dispatches one chunk per
+            # engine step. The slot's _table row stays all-scratch until
+            # the last chunk, so decode dispatches in between write only
+            # to the scratch page.
+            if not self._can_alloc(self.prefill_chunk // ps):
+                for pg in shared:  # unpin: the request stays queued
+                    self._unref(pg, free=False)
+                return False
+            slot = self._free.pop()
+            req.slot = slot
+            req.prefilled = hit
+            # Slack entries past pages_per_slot absorb the last chunk's
+            # bucket-tail pages (freed right after its dispatch) when
+            # the bucket rounds past max_len; they are scratch by the
+            # time the row is installed (finalize slices them off).
+            row = np.zeros(
+                (self.pages_per_slot + self.prefill_chunk // ps,),
+                np.int32,
+            )
+            row[: len(shared)] = shared
+            self._pending_rows[slot] = row
+            self._pending_prompt[slot] = prompt
+            self._slot_pages[slot] = list(shared)
+            self._admit_order[slot] = next(self._admit_seq)
+            self._prefilling[slot] = req
+            if hit:
+                self.prefix_hits_tokens += hit
+            return True
         bucket = self._bucket_for(len(suffix))
         need = bucket // ps  # prefill scatters whole buckets of pages
         if not self._can_alloc(need):
@@ -791,29 +936,102 @@ class PagedEngine(Engine):
             self._page_rc[pg] = self._page_rc.get(pg, 0) + 1
         self._slot_pages[slot] = pages_used
         self._admit_order[slot] = next(self._admit_seq)
-        if self.enable_prefix_cache:
-            # Register this prompt's NEW full pages (the partial tail
-            # page takes decode writes and is never shareable)...
-            keys = []
-            key = b""
-            for i in range(p // ps):
-                key = self._chain_key(key, prompt[i * ps : (i + 1) * ps])
-                keys.append(key)
-                if key not in self._prefix_pages and i < len(pages_used):
-                    pg = pages_used[i]
-                    if pg not in self._page_key:
-                        self._prefix_pages[key] = pg
-                        self._page_key[pg] = key
-            # ...then bump touched prefixes to MRU, LONGEST first so
-            # shorter (more reusable) links of a chain evict LAST — a
-            # chain missing its head can never be matched, stranding
-            # its longer pages as unreachable residents.
-            for key in reversed(keys):
-                if key in self._prefix_pages:
-                    self._prefix_lru.pop(key, None)
-                    self._prefix_lru[key] = None
+        self._register_prefix(prompt, pages_used)
         self._finish_admission(req, slot, p, first)
         return True
+
+    def _register_prefix(self, prompt, pages_used) -> None:
+        """Register a freshly-prefilled prompt's full pages with the
+        prefix cache (no-op when disabled)."""
+        if not self.enable_prefix_cache:
+            return
+        ps = self.page_size
+        p = len(prompt)
+        # Register this prompt's NEW full pages (the partial tail
+        # page takes decode writes and is never shareable)...
+        keys = []
+        key = b""
+        for i in range(p // ps):
+            key = self._chain_key(key, prompt[i * ps : (i + 1) * ps])
+            keys.append(key)
+            if key not in self._prefix_pages and i < len(pages_used):
+                pg = pages_used[i]
+                if pg not in self._page_key:
+                    self._prefix_pages[key] = pg
+                    self._page_key[pg] = key
+        # ...then bump touched prefixes to MRU, LONGEST first so
+        # shorter (more reusable) links of a chain evict LAST — a
+        # chain missing its head can never be matched, stranding
+        # its longer pages as unreachable residents.
+        for key in reversed(keys):
+            if key in self._prefix_pages:
+                self._prefix_lru.pop(key, None)
+                self._prefix_lru[key] = None
+
+    def _advance_prefills(self) -> None:
+        """One chunk per prefilling slot: allocate the chunk's pages
+        (preempting youngest-first when the pool is dry, like decode
+        allocation), dispatch the suffix-prefill program at the chunk's
+        page-aligned offset, and finalize the slot after its last chunk
+        (install the real table row, register prefix pages, enter the
+        decode pool). Non-final chunks' sampled token is discarded."""
+        if not self._prefilling:
+            return
+        ps = self.page_size
+        for slot in sorted(
+            self._prefilling, key=self._admit_order.__getitem__
+        ):
+            if slot not in self._prefilling:
+                continue  # preempted as a victim earlier in this loop
+            req = self._prefilling[slot]
+            prompt = self._pending_prompt[slot]
+            off = req.prefilled
+            this_chunk = min(self.prefill_chunk, len(prompt) - off)
+            bucket = self._bucket_for(this_chunk)
+            need = bucket // ps
+            own: List[int] = []
+            for _ in range(need):
+                page = self._alloc_page_preempting(slot)
+                if page is None or slot not in self._prefilling:
+                    break
+                own.append(page)
+            if len(own) < need:
+                # Self got preempted: `own` pages were never recorded in
+                # _slot_pages, so hand them straight back.
+                for pg in own:
+                    self._free_page(pg)
+                continue
+            row = self._pending_rows[slot]
+            row[off // ps : off // ps + need] = own
+            padded = np.zeros((bucket,), np.int32)
+            padded[:this_chunk] = prompt[off : off + this_chunk]
+            self._rng, sub = jax.random.split(self._rng)
+            # Mid chunks always fit the real row; only a final chunk
+            # whose bucket rounds past max_len needs the slack-widened
+            # row (a distinct compiled program per table width).
+            narrow = off // ps + need <= self.pages_per_slot
+            first = self._dispatch_prefill_at(
+                slot, padded, this_chunk, off, bucket, sub,
+                row=row[: self.pages_per_slot] if narrow else row,
+            )
+            # Bucket-tail pages hold only masked garbage; return them.
+            keep = -(-this_chunk // ps)
+            self._free_pages.extend(own[keep:])
+            row[off // ps + keep : off // ps + need] = 0
+            for pg in own[:keep]:
+                self._page_rc[pg] = self._page_rc.get(pg, 0) + 1
+            self._slot_pages[slot].extend(own[:keep])
+            req.prefilled = off + this_chunk
+            if req.prefilled >= len(prompt):
+                self._finalize_chunked(slot, req, first)
+
+    def _finalize_chunked(self, slot, req, first) -> None:
+        prompt = self._pending_prompt.pop(slot)
+        row = self._pending_rows.pop(slot)
+        del self._prefilling[slot]
+        self._table[slot] = row[: self.pages_per_slot]
+        self._register_prefix(prompt, self._slot_pages[slot])
+        self._finish_admission(req, slot, len(prompt), first)
 
     def _dispatch_prefill(self, slot, padded, p, bucket, rng):
         first, self.cache = self._prefill_jit(
@@ -828,14 +1046,14 @@ class PagedEngine(Engine):
         return first
 
     def _dispatch_prefill_at(self, slot, padded, suffix_len, offset, bucket,
-                             rng):
+                             rng, row=None):
         first, self.cache = self._prefill_at_jit(
             self.params,
             self.cache,
             jnp.asarray(padded),
             jnp.int32(suffix_len),
             jnp.int32(offset),
-            jnp.asarray(self._table[slot]),
+            jnp.asarray(self._table[slot] if row is None else row),
             rng,
             bucket=bucket,
         )
@@ -876,15 +1094,7 @@ class PagedEngine(Engine):
             # Last write position this chunk -> highest page index needed.
             need = (self._lengths[slot] + steps - 1) // self.page_size + 1
             while len(self._slot_pages[slot]) < need:
-                page = self._alloc_page()
-                while page is None:
-                    victim = max(
-                        self._active, key=self._admit_order.__getitem__
-                    )
-                    self._preempt(victim)
-                    if victim == slot:
-                        break
-                    page = self._alloc_page()
+                page = self._alloc_page_preempting(slot)
                 if slot not in self._active or page is None:
                     break
                 self._table[slot, len(self._slot_pages[slot])] = page
