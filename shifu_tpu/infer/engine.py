@@ -29,7 +29,12 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from shifu_tpu.infer.sampling import SampleConfig, sample_logits
+from shifu_tpu.infer.sampling import (
+    SampleConfig,
+    row_params,
+    sample_logits,
+    sample_logits_per_row,
+)
 
 
 @dataclasses.dataclass
@@ -42,6 +47,8 @@ class _Request:
     # Chunked prefill progress: prompt tokens already written to the
     # cache (prefix-cache hits included). Reset on preemption.
     prefilled: int = 0
+    # Per-request sampling override (engines with per_request_sampling).
+    sampling: Optional[SampleConfig] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -78,8 +85,16 @@ class Engine:
         decode_chunk: int = 1,
         mesh=None,
         sharding_rules=None,
+        per_request_sampling: bool = False,
     ):
-        """``decode_chunk``: tokens decoded per host round-trip. 1 (the
+        """``per_request_sampling``: temperature/top-k/top-p become
+        per-slot TRACED arrays in the decode/prefill programs, so one
+        compiled program serves any mix of greedy and sampled requests
+        (``submit(..., sampling=SampleConfig(...))``) with zero
+        recompiles. Off by default: the traced path pays one vocab sort
+        per row per step that engine-level greedy skips.
+
+        ``decode_chunk``: tokens decoded per host round-trip. 1 (the
         default) syncs every token — finest admission granularity. >1
         runs a K-step on-device scan with per-row eos/budget masking and
         syncs once per chunk: on a remote/tunnelled TPU where dispatch
@@ -128,6 +143,15 @@ class Engine:
         self._lengths = np.zeros((max_slots,), np.int32)  # tokens in cache
         self._cur = np.zeros((max_slots,), np.int32)  # last sampled token
 
+        # Per-slot sampling params (per_request_sampling mode): plain
+        # host arrays fed to the programs as traced values — admission
+        # writes a slot's entries, nothing recompiles.
+        self.per_request_sampling = bool(per_request_sampling)
+        t0, k0, p0 = row_params(sample_cfg)
+        self._row_temp = np.full((max_slots,), t0, np.float32)
+        self._row_topk = np.full((max_slots,), k0, np.int32)
+        self._row_topp = np.full((max_slots,), p0, np.float32)
+
         self._prefill_jit = jax.jit(
             self._in_act_ctx(self._prefill_impl),
             static_argnames=("bucket",),
@@ -141,7 +165,18 @@ class Engine:
         )
 
     # ------------------------------------------------------------ public
-    def submit(self, prompt_tokens, max_new_tokens: int) -> int:
+    def submit(
+        self,
+        prompt_tokens,
+        max_new_tokens: int,
+        sampling: Optional[SampleConfig] = None,
+    ) -> int:
+        if sampling is not None and not self.per_request_sampling:
+            raise ValueError(
+                "per-request sampling requires "
+                "Engine(per_request_sampling=True); this engine samples "
+                "with its engine-level SampleConfig"
+            )
         prompt_tokens = list(map(int, prompt_tokens))
         if not prompt_tokens:
             raise ValueError("empty prompt")
@@ -165,7 +200,10 @@ class Engine:
             )
         rid = next(self._rid)
         self._queue.append(
-            _Request(rid, prompt_tokens, max_new_tokens, generated=[])
+            _Request(
+                rid, prompt_tokens, max_new_tokens, generated=[],
+                sampling=sampling,
+            )
         )
         return rid
 
@@ -268,7 +306,35 @@ class Engine:
 
     def _decode_extra_args(self) -> tuple:
         """Extra positional args for _decode_impl, before rng."""
-        return ()
+        return self._sampling_args()
+
+    # -------------------------------------------- per-request sampling
+    def _sampling_args(self) -> tuple:
+        """Traced per-slot sampling arrays ((), when engine-level)."""
+        if not self.per_request_sampling:
+            return ()
+        return (
+            jnp.asarray(self._row_temp),
+            jnp.asarray(self._row_topk),
+            jnp.asarray(self._row_topp),
+        )
+
+    def _req_sampling_args(self, req: _Request) -> tuple:
+        """Traced (1,) sampling arrays for one request's prefill."""
+        if not self.per_request_sampling:
+            return ()
+        t, k, p = row_params(req.sampling or self.sample_cfg)
+        return (
+            jnp.asarray([t], jnp.float32),
+            jnp.asarray([k], jnp.int32),
+            jnp.asarray([p], jnp.float32),
+        )
+
+    def _sample_rows(self, logits, rng, samp: tuple):
+        """Engine-level static sampler, or the per-row traced one."""
+        if not samp:
+            return sample_logits(logits, rng, self.sample_cfg)
+        return sample_logits_per_row(logits, rng, *samp)
 
     def _decode_chunk_impl(
         self, params, cache, cur, lengths, active, remaining, *rest
@@ -424,10 +490,12 @@ class Engine:
         padded = np.zeros((bucket,), np.int32)
         padded[:p] = req.tokens
         self._rng, sub = jax.random.split(self._rng)
-        first = self._dispatch_prefill(slot, padded, p, bucket, sub)
+        first = self._dispatch_prefill(
+            slot, padded, p, bucket, sub, self._req_sampling_args(req)
+        )
         self._finish_admission(req, slot, p, first)
 
-    def _dispatch_prefill(self, slot, padded, p, bucket, rng):
+    def _dispatch_prefill(self, slot, padded, p, bucket, rng, samp=()):
         """Run the compiled prefill for one request; return token 1.
         (Paged engines override to pass the slot's page-table row.)"""
         first, self.cache = self._prefill_jit(
@@ -436,6 +504,7 @@ class Engine:
             jnp.asarray(padded),
             jnp.int32(p),
             jnp.int32(slot),
+            *samp,
             rng,
             bucket=bucket,
         )
@@ -443,6 +512,11 @@ class Engine:
 
     def _finish_admission(self, req: _Request, slot, p, first) -> None:
         """Shared post-prefill bookkeeping, dense and paged."""
+        if self.per_request_sampling:
+            t, k, pp = row_params(req.sampling or self.sample_cfg)
+            self._row_temp[slot] = t
+            self._row_topk[slot] = k
+            self._row_topp[slot] = pp
         self._lengths[slot] = p
         self._cur[slot] = int(first)
         req.generated.append(int(first))
@@ -450,9 +524,11 @@ class Engine:
         # A 1-token budget can finish at admission; step() sweeps it on
         # the next call via the normal bookkeeping (generated >= budget).
 
-    def _prefill_impl(self, params, cache, tokens, length, slot, rng,
-                      *, bucket):
-        """Prefill one request into cache row ``slot``; sample token 1."""
+    def _prefill_impl(self, params, cache, tokens, length, slot, *rest,
+                      bucket):
+        """Prefill one request into cache row ``slot``; sample token 1.
+        ``rest`` = optional per-request sampling triple, then rng."""
+        *samp, rng = rest
         row = jax.tree_util.tree_map(
             lambda c: jax.lax.dynamic_slice_in_dim(c, slot, 1, axis=1),
             cache,
@@ -489,12 +565,14 @@ class Engine:
             cache,
             row,
         )
-        tok = sample_logits(logits[:, 0], rng, self.sample_cfg)[0]
+        tok = self._sample_rows(logits[:, 0], rng, tuple(samp))[0]
         return tok, cache
 
-    def _decode_impl(self, params, cache, cur, lengths, active, rng):
+    def _decode_impl(self, params, cache, cur, lengths, active, *rest):
         """One token for every slot (inactive slots compute but are
-        ignored — static shapes beat host-side gather/scatter here)."""
+        ignored — static shapes beat host-side gather/scatter here).
+        ``rest`` = optional per-slot sampling triple, then rng."""
+        *samp, rng = rest
         kv_mask = (
             jnp.arange(self.max_len)[None, :] <= lengths[:, None]
         )
@@ -505,7 +583,7 @@ class Engine:
             cache_index=lengths,  # per-row write offsets
             kv_mask=kv_mask,
         )
-        nxt = sample_logits(logits[:, -1], rng, self.sample_cfg)
+        nxt = self._sample_rows(logits[:, -1], rng, tuple(samp))
         # Freeze inactive slots' cur so their cache rows stay untouched in
         # spirit (they are written, but their lengths never advance).
         return jnp.where(active, nxt, cur), cache
@@ -686,7 +764,12 @@ class PagedEngine(Engine):
     def free_pages(self) -> int:
         return len(self._free_pages)
 
-    def submit(self, prompt_tokens, max_new_tokens: int) -> int:
+    def submit(
+        self,
+        prompt_tokens,
+        max_new_tokens: int,
+        sampling: Optional[SampleConfig] = None,
+    ) -> int:
         prompt_tokens = list(map(int, prompt_tokens))
         total = len(prompt_tokens) + max_new_tokens
         if self.prefill_chunk is None:
@@ -720,7 +803,7 @@ class PagedEngine(Engine):
                 f"request needs up to {worst} pages but the pool has "
                 f"{self.n_pages - 1}"
             )
-        return super().submit(prompt_tokens, max_new_tokens)
+        return super().submit(prompt_tokens, max_new_tokens, sampling)
 
     def _init_cache(self, cache_dtype):
         return self._make_cache(
@@ -919,13 +1002,16 @@ class PagedEngine(Engine):
         padded = np.zeros((bucket,), np.int32)
         padded[: len(suffix)] = suffix
         self._rng, sub = jax.random.split(self._rng)
+        samp = self._req_sampling_args(req)
         if hit:
             first = self._dispatch_prefill_at(
-                slot, padded, len(suffix), hit, bucket, sub
+                slot, padded, len(suffix), hit, bucket, sub, samp=samp
             )
             self.prefix_hits_tokens += hit
         else:
-            first = self._dispatch_prefill(slot, padded, p, bucket, sub)
+            first = self._dispatch_prefill(
+                slot, padded, p, bucket, sub, samp
+            )
         # Keep only the pages that hold real tokens; the bucket's tail
         # pages hold masked garbage and go straight back to the pool.
         keep = -(-len(suffix) // ps)
@@ -1013,6 +1099,7 @@ class PagedEngine(Engine):
             first = self._dispatch_prefill_at(
                 slot, padded, this_chunk, off, bucket, sub,
                 row=row[: self.pages_per_slot] if narrow else row,
+                samp=self._req_sampling_args(req),
             )
             # Bucket-tail pages hold only masked garbage; return them.
             keep = -(-this_chunk // ps)
@@ -1033,20 +1120,21 @@ class PagedEngine(Engine):
         self._register_prefix(prompt, self._slot_pages[slot])
         self._finish_admission(req, slot, len(prompt), first)
 
-    def _dispatch_prefill(self, slot, padded, p, bucket, rng):
+    def _dispatch_prefill(self, slot, padded, p, bucket, rng, samp=()):
         first, self.cache = self._prefill_jit(
             self.params,
             self.cache,
             jnp.asarray(padded),
             jnp.int32(p),
             jnp.asarray(self._table[slot]),
+            *samp,
             rng,
             bucket=bucket,
         )
         return first
 
     def _dispatch_prefill_at(self, slot, padded, suffix_len, offset, bucket,
-                             rng, row=None):
+                             rng, row=None, samp=()):
         first, self.cache = self._prefill_at_jit(
             self.params,
             self.cache,
@@ -1054,17 +1142,20 @@ class PagedEngine(Engine):
             jnp.int32(suffix_len),
             jnp.int32(offset),
             jnp.asarray(self._table[slot] if row is None else row),
+            *samp,
             rng,
             bucket=bucket,
         )
         return first
 
     def _prefill_at_impl(self, params, cache, tokens, length, offset,
-                         table_row, rng, *, bucket):
+                         table_row, *rest, bucket):
         """SUFFIX prefill after a prefix-cache hit: the row's leading
         pages already hold the shared prefix; write the suffix's pages
         at the (page-aligned) offset and attend over the gathered pages
-        with slot-space causality, so suffix queries see the prefix."""
+        with slot-space causality, so suffix queries see the prefix.
+        ``rest`` = optional per-request sampling triple, then rng."""
+        *samp, rng = rest
         pos = jnp.minimum(
             offset + jnp.arange(bucket), offset + length - 1
         )
@@ -1077,7 +1168,7 @@ class PagedEngine(Engine):
             page_table=table_row[None, :],
             logits_at=(length - 1)[None],
         )
-        tok = sample_logits(logits[:, 0], rng, self.sample_cfg)[0]
+        tok = self._sample_rows(logits[:, 0], rng, tuple(samp))[0]
         return tok, cache
 
     def _ensure_decode_pages(self, k: int = 1) -> None:
@@ -1107,12 +1198,14 @@ class PagedEngine(Engine):
         self._ensure_decode_pages(k)
 
     def _decode_extra_args(self) -> tuple:
-        return (jnp.asarray(self._table),)
+        return (jnp.asarray(self._table),) + self._sampling_args()
 
     # ----------------------------------------------------------- programs
-    def _prefill_impl(self, params, cache, tokens, length, table_row, rng,
-                      *, bucket):
-        """Prefill one request straight into its pages; sample token 1."""
+    def _prefill_impl(self, params, cache, tokens, length, table_row,
+                      *rest, bucket):
+        """Prefill one request straight into its pages; sample token 1.
+        ``rest`` = optional per-request sampling triple, then rng."""
+        *samp, rng = rest
         logits, cache = self.model(
             params,
             tokens[None, :],
@@ -1124,10 +1217,13 @@ class PagedEngine(Engine):
             page_table=table_row[None, :],
             logits_at=(length - 1)[None],
         )
-        tok = sample_logits(logits[:, 0], rng, self.sample_cfg)[0]
+        tok = self._sample_rows(logits[:, 0], rng, tuple(samp))[0]
         return tok, cache
 
-    def _decode_impl(self, params, cache, cur, lengths, active, table, rng):
+    def _decode_impl(self, params, cache, cur, lengths, active, table,
+                     *rest):
+        # ``rest`` = optional per-slot sampling triple, then rng.
+        *samp, rng = rest
         # No kv_mask: on the paged path it would be ``pos <= lengths`` —
         # exactly the slot-space causality the decode attention already
         # enforces from ``cache_index`` (both the Pallas kernel and the
@@ -1142,5 +1238,5 @@ class PagedEngine(Engine):
             cache_index=lengths,
             page_table=table,
         )
-        nxt = sample_logits(logits[:, -1], rng, self.sample_cfg)
+        nxt = self._sample_rows(logits[:, -1], rng, tuple(samp))
         return jnp.where(active, nxt, cur), cache

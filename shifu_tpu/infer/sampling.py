@@ -90,3 +90,56 @@ def sample_logits(logits, rng, cfg: SampleConfig = SampleConfig()):
     return jax.random.categorical(
         rng, filtered_logits(logits, cfg), axis=-1
     ).astype(jnp.int32)
+
+
+def row_params(cfg: SampleConfig):
+    """Lower a SampleConfig to the (temperature, top_k, top_p) scalars
+    the per-row sampler traces over (disabled filters become their
+    identity values — top_k clamps to the vocab in the sampler — so one
+    compiled program covers every config)."""
+    return (
+        float(cfg.temperature),
+        int(cfg.top_k) if cfg.top_k is not None else 1 << 30,
+        float(cfg.top_p) if cfg.top_p is not None else 1.0,
+    )
+
+
+def sample_logits_per_row(logits, rng, temperature, top_k, top_p):
+    """Per-row sampling with TRACED hyperparameters — one compiled
+    program serves any mix of greedy / temperature / top-k / top-p
+    rows (the continuous-batching engines' ``per_request_sampling``).
+
+    Args:
+      logits: (batch, vocab).
+      rng: PRNG key (shared across rows; categorical splits per row).
+      temperature: (batch,) f32 — 0.0 selects greedy argmax for that row.
+      top_k: (batch,) int32 — vocab_size (or any >= vocab) disables.
+      top_p: (batch,) f32 — 1.0 disables.
+
+    Semantics per row match :func:`sample_logits` with the equivalent
+    static config: temperature scaling, then top-k, then top-p (both
+    thresholds come off ONE descending sort), inclusive-crossing
+    nucleus convention, categorical sample.
+    """
+    b, v = logits.shape
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    t = jnp.where(temperature <= 0.0, 1.0, temperature)[:, None]
+    x = logits.astype(jnp.float32) / t
+    sorted_desc = jnp.sort(x, axis=-1)[:, ::-1]
+    # top-k threshold: the value at rank k-1 (clamped to the vocab).
+    k = jnp.clip(top_k, 1, v).astype(jnp.int32)
+    kth = jnp.take_along_axis(sorted_desc, (k - 1)[:, None], axis=-1)
+    # top-p threshold over the top-k-FILTERED distribution — the static
+    # path applies the nucleus to the renormalized top-k survivors
+    # (filtered_logits composes _apply_top_k THEN _apply_top_p), so the
+    # cumulative mass here must ignore sub-kth entries entirely.
+    # Inclusive-crossing convention, as in _apply_top_p.
+    sk = jnp.where(sorted_desc >= kth, sorted_desc, NEG_INF)
+    probs = jax.nn.softmax(sk, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1) - probs
+    keep = cum < jnp.clip(top_p, 1e-9, 1.0)[:, None]
+    kept = jnp.where(keep, sk, jnp.inf)
+    pth = jnp.min(kept, axis=-1, keepdims=True)
+    x = jnp.where(x >= jnp.maximum(kth, pth), x, NEG_INF)
+    sampled = jax.random.categorical(rng, x, axis=-1).astype(jnp.int32)
+    return jnp.where(temperature == 0.0, greedy, sampled)

@@ -12,10 +12,11 @@ one step.
                           -> {"tokens": [...], "text"?, "finished_by"}
     GET  /healthz         -> engine stats (slots, queue, pages, ...)
 
-Sampling is engine-level (one compiled decode program per engine);
-per-request temperatures would mean per-request recompiles — serve
-multiple sampling profiles with multiple engines behind a router
-instead.
+Sampling: engine-level by default (one compiled decode program). On an
+engine built with ``per_request_sampling=True``, requests may carry
+"temperature" / "top_k" / "top_p" fields — they become per-slot traced
+values in the SAME compiled program, so mixed greedy/sampled traffic
+never recompiles.
 
 Reference parity note: the upstream reference (klyan/shifu) is an empty
 repository (SURVEY.md); there is no reference server to match. The API
@@ -33,6 +34,23 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from shifu_tpu.infer.engine import Completion, Engine
+from shifu_tpu.infer.sampling import SampleConfig
+
+
+def _parse_sampling(req: dict) -> Optional[SampleConfig]:
+    """Per-request sampling fields -> SampleConfig, or None when absent.
+    Validation errors (negative temperature etc.) raise ValueError and
+    surface as a 400, like every other bad field."""
+    fields = ("temperature", "top_k", "top_p")
+    if not any(f in req for f in fields):
+        return None
+    return SampleConfig(
+        temperature=float(req.get("temperature", 1.0)),
+        top_k=(int(req["top_k"]) if req.get("top_k") is not None else None),
+        top_p=(
+            float(req["top_p"]) if req.get("top_p") is not None else None
+        ),
+    )
 
 
 @dataclasses.dataclass
@@ -99,7 +117,8 @@ class EngineRunner:
 
     # ------------------------------------------------------------- callers
     def complete(
-        self, tokens, max_new_tokens: int, timeout: Optional[float] = None
+        self, tokens, max_new_tokens: int, timeout: Optional[float] = None,
+        sampling: Optional[SampleConfig] = None,
     ) -> Completion:
         w = _Waiter(threading.Event())
         # Check-and-append under ONE lock acquisition: the fatal/shutdown
@@ -113,7 +132,9 @@ class EngineRunner:
                 ) from self.fatal
             if self._stop.is_set():
                 raise RuntimeError("engine runner is shut down")
-            self._inbox.append((list(tokens), int(max_new_tokens), w))
+            self._inbox.append(
+                (list(tokens), int(max_new_tokens), sampling, w)
+            )
         self._wake.set()
         if not w.event.wait(timeout):
             raise TimeoutError(
@@ -124,7 +145,8 @@ class EngineRunner:
         return w.completion
 
     def stream(self, tokens, max_new_tokens: int,
-               timeout: Optional[float] = None):
+               timeout: Optional[float] = None,
+               sampling: Optional[SampleConfig] = None):
         """Returns a generator of ("delta", [ids]) items ending with
         ("done", Completion); tokens arrive as the engine emits them
         (per decode chunk). The submission (and the dead-runner check)
@@ -141,7 +163,9 @@ class EngineRunner:
                 ) from self.fatal
             if self._stop.is_set():
                 raise RuntimeError("engine runner is shut down")
-            self._inbox.append((list(tokens), int(max_new_tokens), w))
+            self._inbox.append(
+                (list(tokens), int(max_new_tokens), sampling, w)
+            )
         self._wake.set()
 
         def events():
@@ -173,7 +197,7 @@ class EngineRunner:
                 if ww is w:
                     del self._waiters[rid]
             self._inbox = collections.deque(
-                item for item in self._inbox if item[2] is not w
+                item for item in self._inbox if item[3] is not w
             )
 
     def stats(self) -> dict:
@@ -205,7 +229,7 @@ class EngineRunner:
             waiters = list(self._waiters.values())
             self._waiters.clear()
         for item in pending:
-            item[2].fail(RuntimeError("engine runner shut down"))
+            item[3].fail(RuntimeError("engine runner shut down"))
         for w in waiters:
             w.fail(RuntimeError("engine runner shut down"))
 
@@ -215,9 +239,11 @@ class EngineRunner:
             with self._lock:
                 if not self._inbox:
                     return
-                tokens, max_new, w = self._inbox.popleft()
+                tokens, max_new, sampling, w = self._inbox.popleft()
             try:
-                rid = self.engine.submit(tokens, max_new_tokens=max_new)
+                rid = self.engine.submit(
+                    tokens, max_new_tokens=max_new, sampling=sampling
+                )
             except Exception as e:  # validation error -> the caller
                 w.fail(e)
                 continue
@@ -264,7 +290,7 @@ class EngineRunner:
                 waiters = list(self._waiters.values())
                 self._waiters.clear()
             for item in pending:
-                item[2].fail(err)
+                item[3].fail(err)
             for w in waiters:
                 w.fail(err)
 
@@ -324,11 +350,13 @@ class _Handler(BaseHTTPRequestHandler):
                 return
         try:
             max_new = int(req.get("max_new_tokens", self.default_max_new))
+            sampling = _parse_sampling(req)
             if req.get("stream"):
-                self._stream_response(tokens, max_new)
+                self._stream_response(tokens, max_new, sampling)
                 return
             done = self.runner.complete(
-                tokens, max_new, timeout=self.request_timeout_s
+                tokens, max_new, timeout=self.request_timeout_s,
+                sampling=sampling,
             )
         except (ValueError, TypeError) as e:
             self._send(400, {"error": str(e)})
@@ -350,13 +378,14 @@ class _Handler(BaseHTTPRequestHandler):
                 out["text_error"] = repr(e)
         self._send(200, out)
 
-    def _stream_response(self, tokens, max_new: int) -> None:
+    def _stream_response(self, tokens, max_new: int, sampling=None) -> None:
         """Server-sent events: one ``data:`` line per token delta, a
         final one with finished_by, then ``data: [DONE]``. Errors after
         the 200 has been sent arrive as a ``data:`` error event — the
         status line cannot be rewritten mid-stream."""
         gen = self.runner.stream(
-            tokens, max_new, timeout=self.request_timeout_s
+            tokens, max_new, timeout=self.request_timeout_s,
+            sampling=sampling,
         )
         self.send_response(200)
         self.send_header("Content-Type", "text/event-stream")

@@ -310,3 +310,60 @@ def test_runner_shutdown_unblocks_waiters(tiny):
     runner.shutdown()
     with pytest.raises(RuntimeError, match="shut down"):
         runner.complete([1, 2, 3], 2)
+
+
+def test_per_request_sampling_fields(tiny):
+    """temperature/top_k/top_p request fields ride one compiled program
+    (engine built with per_request_sampling=True); top_k=1 rows must
+    equal the greedy reference, and an invalid value is a clean 400."""
+    model, params = tiny
+    engine = PagedEngine(
+        model, params, max_slots=2, max_len=32, page_size=8,
+        sample_cfg=SampleConfig(temperature=0.0),
+        prefill_buckets=(16, 32), per_request_sampling=True,
+    )
+    server = make_server(engine, port=0)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    base = f"http://127.0.0.1:{server.server_port}"
+    try:
+        prompt = [5, 9, 2, 7]
+        st, greedy = _post(
+            base, "/v1/completions",
+            {"tokens": prompt, "max_new_tokens": 5},
+        )
+        assert st == 200
+        st, via_topk1 = _post(
+            base, "/v1/completions",
+            {"tokens": prompt, "max_new_tokens": 5,
+             "temperature": 1.0, "top_k": 1},
+        )
+        assert st == 200
+        assert via_topk1["tokens"] == greedy["tokens"]
+        # invalid temperature -> 400, not a crashed engine thread
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(
+                base, "/v1/completions",
+                {"tokens": prompt, "temperature": -1.0},
+            )
+        assert e.value.code == 400
+        # engine still serves afterwards
+        st, again = _post(
+            base, "/v1/completions",
+            {"tokens": prompt, "max_new_tokens": 5},
+        )
+        assert st == 200 and again["tokens"] == greedy["tokens"]
+    finally:
+        server.shutdown()
+        server.runner.shutdown()
+        t.join(5)
+
+
+def test_sampling_fields_rejected_without_flag(served):
+    base, _ = served
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(
+            base, "/v1/completions",
+            {"tokens": [1, 2, 3], "temperature": 0.5},
+        )
+    assert e.value.code == 400
