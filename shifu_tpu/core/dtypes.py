@@ -30,12 +30,19 @@ class Policy:
 
 
 def _cast_floating(tree, dtype):
+    from shifu_tpu.core.qtensor import is_qtensor
+
     def cast(x):
+        # Quantized leaves stay in their storage format (int8/fp8 data +
+        # f32 scales) — the model dequantizes them at their consumption
+        # point, per layer, so the full-precision copy never exists.
+        if is_qtensor(x):
+            return x
         if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating):
             return jnp.asarray(x, dtype)
         return x
 
-    return jax.tree_util.tree_map(cast, tree)
+    return jax.tree_util.tree_map(cast, tree, is_leaf=is_qtensor)
 
 
 DEFAULT = Policy()

@@ -1,0 +1,51 @@
+"""Quantized-tensor primitive: the storage format models can consume.
+
+A "qtensor" is a dict leaf ``{"_q8"|"_qf8": data, "_scale": f32}`` —
+per-channel symmetric quantization over a matmul's contraction axes
+(see infer/quant.py for the quantization API and format guidance; this
+module holds only the format primitives so the MODEL layer can consume
+qtensors without importing the serving stack).
+
+Why models consume these natively instead of a wrapper dequantizing the
+whole tree up front: dequantizing params BEFORE the forward materialises
+the full-precision copy in HBM and the compiled step then reads that —
+weight bytes double and the int8 storage saves nothing (measured SLOWER
+than bf16 on v5e). Dequantizing each layer's slice at its consumption
+point keeps int8 as the HBM-resident format; XLA fuses the
+convert-and-scale into the consuming matmul's operand read.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+QKEY, SKEY = "_q8", "_scale"
+FKEY = "_qf8"
+
+# fmt -> (storage dtype, symmetric max representable)
+FORMATS = {
+    "int8": (jnp.int8, 127.0),
+    "fp8_e4m3": (jnp.float8_e4m3fn, 448.0),
+    "fp8_e5m2": (jnp.float8_e5m2, 57344.0),
+}
+
+
+def is_qtensor(x) -> bool:
+    return isinstance(x, dict) and (
+        set(x.keys()) == {QKEY, SKEY} or set(x.keys()) == {FKEY, SKEY}
+    )
+
+
+def dequantize_tensor(q, dtype=jnp.float32) -> jax.Array:
+    data = q[QKEY] if QKEY in q else q[FKEY]
+    return (data.astype(jnp.float32) * q[SKEY]).astype(dtype)
+
+
+def dequantize_tree(tree, dtype=jnp.float32):
+    """Dequantize every qtensor leaf; other leaves pass through."""
+    return jax.tree_util.tree_map(
+        lambda x: dequantize_tensor(x, dtype) if is_qtensor(x) else x,
+        tree,
+        is_leaf=is_qtensor,
+    )

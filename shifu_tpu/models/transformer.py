@@ -30,6 +30,7 @@ import jax.numpy as jnp
 from shifu_tpu.core import initializers
 from shifu_tpu.core.dtypes import Policy
 from shifu_tpu.core.module import Module, ParamSpec
+from shifu_tpu.core.qtensor import dequantize_tree, is_qtensor
 from shifu_tpu.parallel.ctx import constrain
 from shifu_tpu.ops import (
     apply_rope,
@@ -229,6 +230,11 @@ class Transformer(Module):
     cfg: TransformerConfig
     policy: Policy = Policy()
 
+    # Quantized param trees (core.qtensor leaves) are consumed natively:
+    # blocks dequantize per layer, the unembed at its matmul
+    # (infer.quant.QuantizedModel passes the tree through untouched).
+    supports_qtensors = True
+
     # ------------------------------------------------------------------ specs
     def specs(self):
         cfg = self.cfg
@@ -266,6 +272,10 @@ class Transformer(Module):
         layer every decode step — see :meth:`init_paged_cache`.
         """
         cfg = self.cfg
+        # Dequantize any quantized leaves HERE — per layer, at the
+        # consumption point — so int8/fp8 stays the HBM format and the
+        # convert+scale fuses into each matmul's operand read.
+        p = dequantize_tree(p, h.dtype)
         x = rms_norm(h, p["attn_norm"], eps=cfg.norm_eps)
         q = jnp.einsum("bsd,dhk->bshk", x, p["wq"])
         k = jnp.einsum("bsd,dhk->bshk", x, p["wk"])
@@ -733,7 +743,8 @@ class Transformer(Module):
         if cfg.tie_embeddings:
             logits = jnp.einsum("bsd,vd->bsv", h, p["embed"])
         else:
-            logits = jnp.einsum("bsd,dv->bsv", h, p["unembed"])
+            w_un = dequantize_tree(p["unembed"], h.dtype)
+            logits = jnp.einsum("bsd,dv->bsv", h, w_un)
         logits = constrain(logits, ("batch", "seq", "act_vocab"))
         logits = self.policy.cast_to_output(logits)
         if return_aux:
@@ -783,7 +794,7 @@ class Transformer(Module):
             w = (
                 params["embed"].T
                 if cfg.tie_embeddings
-                else params["unembed"]
+                else dequantize_tree(params["unembed"], h.dtype)
             )
             loss, aux = fused_softmax_cross_entropy(
                 h,
