@@ -13,8 +13,9 @@ from shifu_tpu.infer.engine import Completion, Engine, PagedEngine
 from shifu_tpu.infer.server import EngineRunner, make_server
 from shifu_tpu.infer.speculative import (
     SpecResult,
-    make_speculative_fns,
+    make_speculative_batch_fns,
     speculative_generate,
+    speculative_generate_batch,
 )
 from shifu_tpu.infer.quant import (
     QuantizedModel,
@@ -31,8 +32,9 @@ __all__ = [
     "make_generate_fn",
     "Completion",
     "SpecResult",
-    "make_speculative_fns",
+    "make_speculative_batch_fns",
     "speculative_generate",
+    "speculative_generate_batch",
     "Engine",
     "EngineRunner",
     "PagedEngine",

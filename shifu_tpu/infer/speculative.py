@@ -21,9 +21,18 @@ No cache rollback exists or is needed: both caches track a valid-length
 watermark; rejected slots hold stale K/V that slot-space causality masks
 and the next round's chunk overwrites.
 
-Single-sequence (batch 1): per-row acceptance lengths would need ragged
-chunk writes. Serve batches with infer.engine instead; speculation is a
-latency tool.
+Two drivers share the round machinery:
+
+  * :func:`speculative_generate` — single sequence, the latency tool;
+  * :func:`speculative_generate_batch` — B sequences with RAGGED
+    per-row progress: every row verifies its own K+1-token chunk at its
+    own cache offset in one forward (the dense cache scatters per-row
+    chunks; slot-space causality masks everything stale), rows accept
+    different prefix lengths each round, and finished rows freeze while
+    the rest keep going. No kv_mask is needed despite ragged right-
+    padding: a pad/stale slot p only becomes causally visible in the
+    round whose chunk write covers p (writes land before reads), so it
+    is always overwritten first.
 
 Reference parity note: the upstream reference (klyan/shifu) is an empty
 repository (SURVEY.md); there is no reference implementation to match.
@@ -62,113 +71,6 @@ class SpecResult:
     rounds: int
 
 
-@_functools.lru_cache(maxsize=8)
-def make_speculative_fns(target, draft, k: int, sample_cfg: SampleConfig):
-    """The five jitted programs, cached per (target, draft, k, cfg) so
-    repeated speculative_generate calls reuse compiled executables.
-
-    Returns ((target_prefill, draft_prefill), (draft_k, draft_ingest),
-    verify). Models must be hashable (the frozen-dataclass module
-    convention); unhashable models fall back to uncached construction in
-    speculative_generate.
-    """
-
-    def prefill(params, model, cache, tokens, length):
-        logits, cache = model(
-            params, tokens, cache=cache, cache_index=0,
-            # Clamp pad positions to the real length (masked anyway;
-            # regime-sensitive rope scaling keys off max position).
-            positions=jnp.minimum(
-                jnp.arange(tokens.shape[1]), length - 1
-            )[None, :],
-            logits_at=(length - 1)[None],
-        )
-        return logits[:, 0], cache
-
-    target_prefill = jax.jit(
-        lambda p, c, t, n: prefill(p, target, c, t, n), donate_argnums=(1,)
-    )
-    draft_prefill = jax.jit(
-        lambda p, c, t, n: prefill(p, draft, c, t, n), donate_argnums=(1,)
-    )
-
-    def draft_k(params, cache, cur, n, rng):
-        """K draft steps; returns proposals, their probs, updated cache."""
-
-        def body(carry, sub):
-            cache, tok, idx = carry
-            logits, cache = draft(
-                params, tok[None, None], cache=cache, cache_index=idx
-            )
-            p = _probs(logits[0, -1], sample_cfg)  # FULL draft dist (V,)
-            nxt = jax.random.choice(sub, p.shape[-1], p=p)
-            return (cache, nxt, idx + 1), (nxt, p)
-
-        (cache, _, _), (toks, probs) = jax.lax.scan(
-            body, (cache, cur, n), jax.random.split(rng, k)
-        )
-        return toks, probs, cache  # probs: (k, V)
-
-    draft_k = jax.jit(draft_k, donate_argnums=(1,))
-
-    def draft_ingest(params, cache, tok, idx):
-        """Feed one token into the draft cache (no sampling) — needed when
-        a round accepts all k proposals: the draft never consumed d_k, and
-        leaving its slot zero would pollute later draft attention."""
-        _, cache = draft(params, tok[None, None], cache=cache, cache_index=idx)
-        return cache
-
-    draft_ingest = jax.jit(draft_ingest, donate_argnums=(1,))
-
-    def verify(params, cache, chunk, n, draft_toks, draft_probs, rng):
-        """Score [cur, d_1..d_K]; accept a prefix; sample one more.
-
-        Returns (m, tokens_out (K+1,), cache): tokens_out[:m] are the
-        accepted proposals, tokens_out[m] is the bonus/residual sample;
-        entries past m are padding.
-        """
-        logits, cache = target(
-            params, chunk[None, :], cache=cache, cache_index=n
-        )
-        probs = _probs(logits[0], sample_cfg)  # (K+1, V)
-
-        p_t = probs[jnp.arange(k), draft_toks]  # target prob of each d_j
-        q_t = draft_probs[jnp.arange(k), draft_toks]  # draft prob of d_j
-        accept_rng, residual_rng = jax.random.split(rng)
-        u = jax.random.uniform(accept_rng, (k,))
-        ok = u < jnp.minimum(1.0, p_t / jnp.maximum(q_t, 1e-20))
-        # First rejection index = number of accepted proposals m (the
-        # appended False guarantees argmin finds one; all-ok -> m = k).
-        m = jnp.argmin(
-            jnp.concatenate([ok, jnp.array([False])])
-        ).astype(jnp.int32)
-
-        # Exact residual at the rejection point: max(p_target - q_draft,
-        # 0) renormalised (Leviathan et al.); with everything accepted,
-        # the bonus samples the target's own distribution at position k.
-        p_target_at_m = probs[m]
-        p_draft_at_m = jnp.where(
-            m < k,
-            draft_probs[jnp.minimum(m, k - 1)],
-            jnp.zeros_like(p_target_at_m),
-        )
-        residual = jnp.maximum(p_target_at_m - p_draft_at_m, 0.0)
-        residual = jnp.where(
-            residual.sum() > 0, residual / residual.sum(), p_target_at_m
-        )
-        bonus = jax.random.choice(
-            residual_rng, residual.shape[-1], p=residual
-        )
-        out = jnp.concatenate(
-            [draft_toks, jnp.zeros((1,), draft_toks.dtype)]
-        )
-        out = out.at[m].set(bonus)
-        return m, out, cache
-
-    verify = jax.jit(verify, donate_argnums=(1,))
-    return (target_prefill, draft_prefill), (draft_k, draft_ingest), verify
-
-
 def speculative_generate(
     target,
     target_params,
@@ -185,104 +87,277 @@ def speculative_generate(
 ) -> SpecResult:
     """Generate with draft-assisted decoding (single sequence).
 
-    ``target`` and ``draft`` must share a vocabulary. ``k`` proposals per
-    round; each round costs one draft K-step scan + one target chunk
-    forward and nets between 1 and k+1 tokens.
+    The batch-1 case of :func:`speculative_generate_batch` — one round
+    machinery, two drivers. ``target`` and ``draft`` must share a
+    vocabulary; each round costs one draft K-step scan + one target
+    chunk forward and nets between 1 and k+1 tokens.
     """
-    prompt = list(map(int, prompt))
-    if not prompt:
-        raise ValueError("empty prompt")
-    for m, name in ((target, "target"), (draft, "draft")):
-        if getattr(m, "prefill_needs_mask", False):
-            # A rolling recurrent state (SSM) mutates irreversibly on
-            # rejected proposals — the watermark trick only works for
-            # addressed attention caches.
+    r = speculative_generate_batch(
+        target, target_params, draft, draft_params, [prompt],
+        max_new_tokens=max_new_tokens, k=k, sample_cfg=sample_cfg,
+        eos_id=eos_id, max_len=max_len, rng=rng,
+    )
+    return SpecResult(
+        tokens=r.tokens[0],
+        acceptance_rate=r.acceptance_rate,
+        rounds=r.rounds,
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class SpecBatchResult:
+    tokens: List[List[int]]  # per row, eos included when hit
+    acceptance_rate: float  # accepted draft tokens / proposed (live rows)
+    rounds: int
+    # Rows frozen early because their next chunk would overrun max_len —
+    # their outputs are truncated below max_new_tokens.
+    rows_cache_exhausted: int = 0
+
+
+@_functools.lru_cache(maxsize=8)
+def make_speculative_batch_fns(target, draft, k: int,
+                               sample_cfg: SampleConfig):
+    """Batched round programs: (target_prefill, draft_prefill),
+    draft_k, verify, ingest — every row at its own offset."""
+
+    def prefill(params, model, cache, tokens, lengths):
+        logits, cache = model(
+            params, tokens, cache=cache, cache_index=0,
+            positions=jnp.minimum(
+                jnp.arange(tokens.shape[1])[None, :], lengths[:, None] - 1
+            ),
+            logits_at=lengths - 1,
+        )
+        return logits[:, 0], cache  # (b, V)
+
+    t_prefill = jax.jit(
+        lambda p, c, t, n: prefill(p, target, c, t, n), donate_argnums=(1,)
+    )
+    d_prefill = jax.jit(
+        lambda p, c, t, n: prefill(p, draft, c, t, n), donate_argnums=(1,)
+    )
+
+    def draft_k(params, cache, cur, n, rng):
+        """K per-row draft steps. cur/n: (b,). Returns proposals
+        (k, b), their full distributions (k, b, V), cache."""
+
+        def body(carry, sub):
+            cache, tok, idx = carry
+            logits, cache = draft(
+                params, tok[:, None], cache=cache, cache_index=idx
+            )
+            p = _probs(logits[:, -1], sample_cfg)  # (b, V)
+            nxt = jax.random.categorical(
+                sub, jnp.log(jnp.maximum(p, 1e-38))
+            ).astype(jnp.int32)
+            return (cache, nxt, idx + 1), (nxt, p)
+
+        (cache, _, _), (toks, probs) = jax.lax.scan(
+            body, (cache, cur, n), jax.random.split(rng, k)
+        )
+        return toks, probs, cache
+
+    draft_k = jax.jit(draft_k, donate_argnums=(1,))
+
+    def verify(params, cache, chunk, n, draft_toks, draft_probs, rng):
+        """Score each row's [cur, d_1..d_K] at its own offset; accept
+        per-row prefixes; sample each row's bonus/residual token.
+
+        chunk (b, k+1); n (b,); draft_toks (k, b); draft_probs
+        (k, b, V). Returns (m (b,), out (b, k+1), cache)."""
+        b = chunk.shape[0]
+        logits, cache = target(
+            params, chunk, cache=cache, cache_index=n
+        )
+        probs = _probs(logits, sample_cfg)  # (b, K+1, V)
+
+        d_toks = draft_toks.T  # (b, k)
+        rowix = jnp.arange(b)[:, None]
+        p_t = probs[rowix, jnp.arange(k)[None, :], d_toks]  # (b, k)
+        q_t = jnp.moveaxis(draft_probs, 1, 0)[  # (b, k, V)
+            rowix, jnp.arange(k)[None, :], d_toks
+        ]
+        accept_rng, residual_rng = jax.random.split(rng)
+        u = jax.random.uniform(accept_rng, (b, k))
+        ok = u < jnp.minimum(1.0, p_t / jnp.maximum(q_t, 1e-20))
+        m = jnp.argmin(
+            jnp.concatenate([ok, jnp.zeros((b, 1), bool)], axis=1), axis=1
+        ).astype(jnp.int32)
+
+        p_target_at_m = jnp.take_along_axis(
+            probs, m[:, None, None], axis=1
+        )[:, 0]  # (b, V)
+        d_probs_bkv = jnp.moveaxis(draft_probs, 1, 0)
+        p_draft_at_m = jnp.where(
+            (m < k)[:, None],
+            jnp.take_along_axis(
+                d_probs_bkv, jnp.minimum(m, k - 1)[:, None, None], axis=1
+            )[:, 0],
+            0.0,
+        )
+        residual = jnp.maximum(p_target_at_m - p_draft_at_m, 0.0)
+        rsum = residual.sum(axis=-1, keepdims=True)
+        residual = jnp.where(rsum > 0, residual / rsum, p_target_at_m)
+        bonus = jax.random.categorical(
+            residual_rng, jnp.log(jnp.maximum(residual, 1e-38))
+        ).astype(jnp.int32)
+        out = jnp.concatenate(
+            [d_toks, jnp.zeros((b, 1), d_toks.dtype)], axis=1
+        )
+        out = jnp.where(
+            jnp.arange(k + 1)[None, :] == m[:, None], bonus[:, None], out
+        )
+        return m, out, cache
+
+    verify = jax.jit(verify, donate_argnums=(1,))
+
+    def ingest(params, cache, tok, idx):
+        """Feed each row's d_k at its (n + k) slot. Unconditional for
+        every row: rows that accepted all k need it, and for the rest
+        the next round's chunk write covers slot n+k before any query
+        can see it (module docstring), so the write is harmless."""
+        _, cache = draft(
+            params, tok[:, None], cache=cache, cache_index=idx
+        )
+        return cache
+
+    ingest = jax.jit(ingest, donate_argnums=(1,))
+    return (t_prefill, d_prefill), draft_k, verify, ingest
+
+
+def speculative_generate_batch(
+    target,
+    target_params,
+    draft,
+    draft_params,
+    prompts,
+    *,
+    max_new_tokens: int,
+    k: int = 4,
+    sample_cfg: SampleConfig = SampleConfig(temperature=0.0),
+    eos_id: Optional[int] = None,
+    max_len: Optional[int] = None,
+    rng: Optional[jax.Array] = None,
+) -> SpecBatchResult:
+    """Draft-assisted decoding for a BATCH of ragged prompts.
+
+    Every row runs the rejection-sampling round at its own pace: one
+    draft K-scan + one target chunk forward per round serves all rows,
+    each at its own cache offset. Greedy (temperature 0) output equals
+    the target-alone generation per row exactly.
+    """
+    prompts = [list(map(int, p)) for p in prompts]
+    if not prompts or any(not p for p in prompts):
+        raise ValueError("empty prompt list / empty prompt")
+    for mdl, name in ((target, "target"), (draft, "draft")):
+        if getattr(mdl, "prefill_needs_mask", False):
             raise NotImplementedError(
                 f"speculative decoding does not support recurrent-cache "
-                f"models ({name}): rejected tokens cannot be rolled back "
-                "out of an SSM state"
+                f"models ({name}): rejected tokens cannot be rolled back"
             )
     rng = rng if rng is not None else jax.random.key(0)
-    p_len = len(prompt)
-    max_len = max_len or (p_len + max_new_tokens + k + 1)
-    if max_len < p_len + 1:
+    b = len(prompts)
+    p_max = max(len(p) for p in prompts)
+    max_len = max_len or (p_max + max_new_tokens + k + 1)
+    if max_len < p_max + 1:
         # Too-small caches would CLAMP the prefill writes (XLA dynamic
         # update semantics) and return garbage with no error.
         raise ValueError(
-            f"max_len={max_len} cannot hold the {p_len}-token prompt "
-            "plus one generated token"
+            f"max_len={max_len} cannot hold the longest "
+            f"({p_max}-token) prompt plus one generated token"
         )
 
     try:
-        fns = make_speculative_fns(target, draft, k, sample_cfg)
+        fns = make_speculative_batch_fns(target, draft, k, sample_cfg)
     except TypeError:  # unhashable custom model: uncached
-        fns = make_speculative_fns.__wrapped__(target, draft, k, sample_cfg)
-    (t_prefill, d_prefill), (draft_k_fn, draft_ingest_fn), verify_fn = fns
+        fns = make_speculative_batch_fns.__wrapped__(
+            target, draft, k, sample_cfg
+        )
+    (t_prefill, d_prefill), draft_k_fn, verify_fn, ingest_fn = fns
 
-    # Pad the prompt to a multiple of 128 so varied prompt lengths reuse
-    # a handful of compiled prefills (pad slots are hidden by slot-space
-    # causality and overwritten as decoding proceeds). Capped at the
-    # caller's max_len — never silently grow their memory budget.
-    bucket = min(-(-p_len // 128) * 128, max_len)
-    t_cache = target.init_cache(1, max_len)
-    d_cache = draft.init_cache(1, max_len)
-    tokens = jnp.asarray(
-        [prompt + [0] * (bucket - p_len)], jnp.int32
-    )
-    length = jnp.asarray([p_len], jnp.int32)[0]
+    bucket = min(-(-p_max // 128) * 128, max_len)
+    t_cache = target.init_cache(b, max_len)
+    d_cache = draft.init_cache(b, max_len)
+    padded = np.zeros((b, bucket), np.int32)
+    for i, p in enumerate(prompts):
+        padded[i, : len(p)] = p
+    tokens = jnp.asarray(padded)
+    lengths = jnp.asarray([len(p) for p in prompts], jnp.int32)
 
     rng, sub = jax.random.split(rng)
-    logits, t_cache = t_prefill(target_params, t_cache, tokens, length)
-    first_probs = _probs(logits[0], sample_cfg)
-    cur = int(
-        jax.random.choice(sub, first_probs.shape[-1], p=first_probs)
+    logits, t_cache = t_prefill(target_params, t_cache, tokens, lengths)
+    first_probs = _probs(logits, sample_cfg)  # (b, V)
+    cur = np.array(
+        jax.random.categorical(
+            sub, jnp.log(jnp.maximum(first_probs, 1e-38))
+        ),
+        np.int32,
     )
-    _, d_cache = d_prefill(draft_params, d_cache, tokens, length)
+    _, d_cache = d_prefill(draft_params, d_cache, tokens, lengths)
 
-    out: List[int] = [cur]
-    n = p_len  # tokens resident in both caches
+    out: List[List[int]] = [[int(c)] for c in cur]
+    n = np.asarray(lengths).copy()  # per-row resident tokens
+    done = np.array(
+        [eos_id is not None and o[-1] == eos_id for o in out]
+    )
+    done |= np.array([len(o) >= max_new_tokens for o in out])
     proposed = accepted = rounds = 0
 
-    while len(out) < max_new_tokens and (
-        eos_id is None or out[-1] != eos_id
-    ):
-        if n + k + 1 > max_len:  # the chunk writes slots n..n+k inclusive
-            break  # cache budget exhausted
+    exhausted = 0
+    while not done.all():
+        # Per-row cache budget: a row whose next chunk would not fit
+        # freezes alone (its output is truncated and counted in
+        # ``rounds_exhausted``); other rows keep going.
+        over = ~done & (n + k + 1 > max_len)
+        if over.any():
+            exhausted += int(over.sum())
+            done |= over
+            if done.all():
+                break
         rng, r_draft, r_verify = jax.random.split(rng, 3)
+        cur_j = jnp.asarray(cur)
+        n_j = jnp.asarray(n)
         d_toks, d_probs, d_cache = draft_k_fn(
-            draft_params, d_cache, jnp.int32(cur), jnp.int32(n), r_draft
+            draft_params, d_cache, cur_j, n_j, r_draft
         )
         chunk = jnp.concatenate(
-            [jnp.asarray([cur], jnp.int32), d_toks.astype(jnp.int32)]
+            [cur_j[:, None], d_toks.T.astype(jnp.int32)], axis=1
         )
         m, toks, t_cache = verify_fn(
-            target_params, t_cache, chunk, jnp.int32(n), d_toks, d_probs,
-            r_verify,
+            target_params, t_cache, chunk, n_j, d_toks, d_probs, r_verify
         )
-        m = int(m)
-        emitted = [int(t) for t in np.asarray(toks[: m + 1])]
+        d_cache = ingest_fn(
+            draft_params, d_cache,
+            d_toks[k - 1].astype(jnp.int32), n_j + k,
+        )
+        m_np = np.asarray(m)
+        toks_np = np.asarray(toks)
         rounds += 1
-        proposed += k
-        accepted += m
+        for i in range(b):
+            if done[i]:
+                continue
+            proposed += k
+            accepted += int(m_np[i])
+            emitted = [int(t) for t in toks_np[i, : m_np[i] + 1]]
+            for t in emitted:
+                out[i].append(t)
+                if (eos_id is not None and t == eos_id) or len(
+                    out[i]
+                ) >= max_new_tokens:
+                    done[i] = True
+                    break
+            if not done[i]:
+                n[i] += m_np[i] + 1
+                cur[i] = out[i][-1]
+        # Frozen rows keep decoding with stale cur/n; their emissions
+        # are discarded above, and their writes are causally masked.
 
-        for t in emitted[:-1]:
-            out.append(t)
-            if eos_id is not None and t == eos_id:
-                break
-        else:
-            out.append(emitted[-1])
-        if m == k:
-            # Fully-accepted round: the draft never consumed d_k — feed it
-            # so the draft cache stays aligned with the target's.
-            d_cache = draft_ingest_fn(
-                draft_params, d_cache, d_toks[k - 1].astype(jnp.int32),
-                jnp.int32(n + k),  # d_k is the (n+k)-th token
-            )
-        n += m + 1
-        cur = out[-1]
-
-    if eos_id is not None and eos_id in out:
-        out = out[: out.index(eos_id) + 1]
-    out = out[:max_new_tokens]
+    for i in range(b):
+        if eos_id is not None and eos_id in out[i]:
+            out[i] = out[i][: out[i].index(eos_id) + 1]
+        out[i] = out[i][:max_new_tokens]
     rate = accepted / proposed if proposed else 0.0
-    return SpecResult(tokens=out, acceptance_rate=rate, rounds=rounds)
+    return SpecBatchResult(
+        tokens=out, acceptance_rate=rate, rounds=rounds,
+        rows_cache_exhausted=exhausted,
+    )

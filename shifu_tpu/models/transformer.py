@@ -301,25 +301,34 @@ class Transformer(Module):
         else:
             if getattr(cache_index, "ndim", 0) == 1:
                 # Per-row write offsets (continuous batching: every slot
-                # decodes at its own length). Single-token steps only —
-                # a longer chunk would silently write just token 0.
-                if k.shape[1] != 1:
-                    raise ValueError(
-                        f"per-row cache_index supports single-token decode "
-                        f"only, got q_len={k.shape[1]}"
-                    )
-                b = k.shape[0]
+                # decodes at its own length). q_len > 1 scatters each
+                # row's chunk at its own offset (batched speculative
+                # verify: K+1 positions per row).
+                b, q_len_w = k.shape[:2]
                 rows = jnp.arange(b)
-                ck = (
-                    cache_slice["k"]
-                    .at[rows, cache_index]
-                    .set(k[:, 0].astype(cache_slice["k"].dtype))
-                )
-                cv = (
-                    cache_slice["v"]
-                    .at[rows, cache_index]
-                    .set(v[:, 0].astype(cache_slice["v"].dtype))
-                )
+                if q_len_w == 1:
+                    ck = (
+                        cache_slice["k"]
+                        .at[rows, cache_index]
+                        .set(k[:, 0].astype(cache_slice["k"].dtype))
+                    )
+                    cv = (
+                        cache_slice["v"]
+                        .at[rows, cache_index]
+                        .set(v[:, 0].astype(cache_slice["v"].dtype))
+                    )
+                else:
+                    cols = cache_index[:, None] + jnp.arange(q_len_w)[None]
+                    ck = (
+                        cache_slice["k"]
+                        .at[rows[:, None], cols]
+                        .set(k.astype(cache_slice["k"].dtype))
+                    )
+                    cv = (
+                        cache_slice["v"]
+                        .at[rows[:, None], cols]
+                        .set(v.astype(cache_slice["v"].dtype))
+                    )
             else:
                 ck = jax.lax.dynamic_update_slice(
                     cache_slice["k"], k.astype(cache_slice["k"].dtype),

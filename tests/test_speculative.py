@@ -127,3 +127,94 @@ def test_empty_prompt_rejected(models):
         speculative_generate(
             target, tp, draft, dp, [], max_new_tokens=4
         )
+
+
+# ------------------------------------------------------------- batched
+
+from shifu_tpu.infer.speculative import speculative_generate_batch
+
+
+def _greedy_reference_batch(model, params, prompts, max_new):
+    fn = make_generate_fn(
+        model, max_new_tokens=max_new,
+        sample_cfg=SampleConfig(temperature=0.0),
+    )
+    P = max(len(p) for p in prompts)
+    padded = np.zeros((len(prompts), P), np.int32)
+    for i, p in enumerate(prompts):
+        padded[i, : len(p)] = p
+    out = fn(
+        params,
+        jnp.asarray(padded),
+        jnp.asarray([len(p) for p in prompts], jnp.int32),
+        jax.random.key(0),
+    )
+    return [
+        [int(t) for t in np.asarray(out["tokens"][i])]
+        for i in range(len(prompts))
+    ]
+
+
+def test_batch_greedy_parity_weak_draft(models):
+    """Ragged batch, junk draft: every row must equal the target's own
+    greedy continuation exactly."""
+    target, tp, draft, dp = models
+    rng = np.random.RandomState(3)
+    prompts = [rng.randint(1, 256, size=n).tolist() for n in (7, 4, 11)]
+    want = _greedy_reference_batch(target, tp, prompts, 9)
+    got = speculative_generate_batch(
+        target, tp, draft, dp, prompts, max_new_tokens=9, k=3,
+        sample_cfg=SampleConfig(temperature=0.0),
+    )
+    assert got.tokens == want
+    assert got.rounds >= 1
+
+
+def test_batch_greedy_parity_perfect_draft(models):
+    target, tp, _, _ = models
+    rng = np.random.RandomState(4)
+    prompts = [rng.randint(1, 256, size=n).tolist() for n in (5, 8)]
+    want = _greedy_reference_batch(target, tp, prompts, 12)
+    got = speculative_generate_batch(
+        target, tp, target, tp, prompts, max_new_tokens=12, k=3,
+        sample_cfg=SampleConfig(temperature=0.0),
+    )
+    assert got.tokens == want
+    # Draft == target at greedy: every proposal accepted.
+    assert got.acceptance_rate > 0.99
+    assert got.rounds <= 12 // 4 + 1
+
+
+def test_batch_rows_finish_independently(models):
+    """eos freezes one row while others continue to their budget."""
+    target, tp, draft, dp = models
+    rng = np.random.RandomState(5)
+    prompts = [rng.randint(1, 256, size=n).tolist() for n in (6, 9)]
+    ref = _greedy_reference_batch(target, tp, prompts, 14)
+    # Pick row 0's 3rd generated token as "eos": row 0 must stop there,
+    # row 1 must be unaffected.
+    eos = ref[0][2]
+    got = speculative_generate_batch(
+        target, tp, draft, dp, prompts, max_new_tokens=14, k=3,
+        sample_cfg=SampleConfig(temperature=0.0), eos_id=eos,
+    )
+    assert got.tokens[0] == ref[0][: ref[0].index(eos) + 1]
+    if eos in ref[1]:
+        assert got.tokens[1] == ref[1][: ref[1].index(eos) + 1]
+    else:
+        assert got.tokens[1] == ref[1]
+
+
+def test_batch_sampled_mode_runs(models):
+    target, tp, draft, dp = models
+    rng = np.random.RandomState(6)
+    prompts = [rng.randint(1, 256, size=n).tolist() for n in (5, 7)]
+    got = speculative_generate_batch(
+        target, tp, draft, dp, prompts, max_new_tokens=8, k=2,
+        sample_cfg=SampleConfig(temperature=0.9, top_k=40),
+        rng=jax.random.key(11),
+    )
+    assert all(len(t) == 8 for t in got.tokens)
+    assert all(
+        0 <= tok < target.cfg.vocab_size for t in got.tokens for tok in t
+    )
