@@ -1,0 +1,183 @@
+"""Instruction/SFT example pipeline: loss on TARGETS only.
+
+Supervised fine-tuning trains on (prompt, response) pairs where the
+model must not be optimised to reproduce the prompt — only the response
+(and optionally an EOS terminator). This module turns token-id pairs
+into the exact batch contract ``Transformer.loss`` consumes:
+
+    {"tokens": (b, s) int32, "mask": (b, s) f32}
+
+where ``mask[i, t]`` weights the loss of PREDICTING ``tokens[i, t]``
+(the loss predicts tokens[:, 1:] and applies ``mask[:, 1:]``): prompt
+positions and padding get 0, response positions (and the EOS, when
+appended) get 1. The last prompt token's PREDICTION — the first
+response token — IS trained, which is the standard SFT convention.
+
+Two packing modes:
+
+  * :func:`encode_examples` — one example per row, right-padded. Simple,
+    wasteful when lengths vary.
+  * :func:`pack_examples` — greedy first-fit packing of whole examples
+    into rows with ``segment_ids`` (the model's packed-attention path
+    keeps examples from attending to each other); loss masks compose
+    with packing since mask and segments are independent channels.
+
+Both truncate oversized examples from the LEFT of the prompt (keep the
+response: it is the supervision signal; dropping its tail would train a
+mid-sentence stop).
+
+Reference parity note: the upstream reference (klyan/shifu) is an empty
+repository (SURVEY.md); there is no reference SFT pipeline to match.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+Example = Tuple[Sequence[int], Sequence[int]]  # (prompt_ids, response_ids)
+
+
+def _fit(prompt, response, seq_len: int, eos_id: Optional[int]):
+    """Truncate one example to seq_len, keeping the response whole when
+    possible (prompt truncates from the LEFT); an over-long response
+    truncates from the right as a last resort."""
+    prompt = list(map(int, prompt))
+    response = list(map(int, response))
+    if eos_id is not None:
+        response = response + [int(eos_id)]
+    if not response:
+        raise ValueError("SFT example with empty response")
+    room = seq_len - len(response)
+    if room < 1:
+        # Keep one prompt token so the first response prediction has a
+        # conditioning token; truncate the response tail.
+        prompt = prompt[-1:]
+        response = response[: seq_len - 1]
+    else:
+        prompt = prompt[-room:]
+    if not prompt:
+        raise ValueError("SFT example with empty prompt")
+    return prompt, response
+
+
+def encode_examples(
+    examples: Sequence[Example],
+    seq_len: int,
+    *,
+    eos_id: Optional[int] = None,
+    pad_id: int = 0,
+):
+    """One example per row, right-padded to ``seq_len``.
+
+    Returns {"tokens": (n, s) int32, "mask": (n, s) f32} — feed straight
+    to ``Transformer.loss`` (or slice into train-step batches).
+    """
+    n = len(examples)
+    tokens = np.full((n, seq_len), pad_id, np.int32)
+    mask = np.zeros((n, seq_len), np.float32)
+    for i, (prompt, response) in enumerate(examples):
+        prompt, response = _fit(prompt, response, seq_len, eos_id)
+        row = prompt + response
+        tokens[i, : len(row)] = row
+        # Loss weights the PREDICTION of each response token.
+        mask[i, len(prompt) : len(row)] = 1.0
+    return {"tokens": tokens, "mask": mask}
+
+
+def pack_examples(
+    examples: Sequence[Example],
+    rows: int,
+    seq_len: int,
+    *,
+    eos_id: Optional[int] = None,
+    pad_id: int = 0,
+):
+    """Greedy first-fit packing of whole examples into ``rows`` rows.
+
+    Returns ({"tokens", "mask", "segment_ids"}, n_packed): segment_ids
+    are 1-based per example within a row (0 = padding) so the model's
+    packed-attention path isolates examples; ``mask`` covers response
+    predictions only. Packing consumes a strict PREFIX of ``examples``
+    — it stops at the first example that fits in no row — so a
+    streaming caller advancing its cursor by ``n_packed`` neither drops
+    nor duplicates examples (first-fit-with-skip would break that:
+    skipped examples vanish while later ones get re-yielded).
+    """
+    tokens = np.full((rows, seq_len), pad_id, np.int32)
+    mask = np.zeros((rows, seq_len), np.float32)
+    segs = np.zeros((rows, seq_len), np.int32)
+    fill = [0] * rows
+    next_seg = [1] * rows
+    n_packed = 0
+    for prompt, response in examples:
+        p, r = _fit(prompt, response, seq_len, eos_id)
+        length = len(p) + len(r)
+        placed = False
+        for i in range(rows):
+            if seq_len - fill[i] >= length:
+                at = fill[i]
+                tokens[i, at : at + length] = p + r
+                mask[i, at + len(p) : at + length] = 1.0
+                segs[i, at : at + length] = next_seg[i]
+                fill[i] += length
+                next_seg[i] += 1
+                n_packed += 1
+                placed = True
+                break
+        if not placed:
+            break
+    return (
+        {"tokens": tokens, "mask": mask, "segment_ids": segs},
+        n_packed,
+    )
+
+
+def iter_sft_batches(
+    examples: Sequence[Example],
+    batch_size: int,
+    seq_len: int,
+    *,
+    eos_id: Optional[int] = None,
+    pad_id: int = 0,
+    packed: bool = False,
+    drop_remainder: bool = True,
+    seed: Optional[int] = None,
+):
+    """Yield shuffled SFT batches, unpacked or packed.
+
+    Packed mode fills ``batch_size`` rows per batch from a stream of
+    examples (denser, needs the model's segment_ids path); unpacked is
+    one example per row. With ``drop_remainder`` the tail that cannot
+    fill a batch is dropped (static shapes every step).
+    """
+    order = np.arange(len(examples))
+    if seed is not None:
+        np.random.default_rng(seed).shuffle(order)
+    if not packed:
+        for at in range(0, len(order), batch_size):
+            idx = order[at : at + batch_size]
+            if len(idx) < batch_size and drop_remainder:
+                return
+            yield encode_examples(
+                [examples[i] for i in idx], seq_len,
+                eos_id=eos_id, pad_id=pad_id,
+            )
+        return
+    # Packed: consume the stream batch_size-rows at a time; a batch
+    # takes as many examples as fit.
+    at = 0
+    while at < len(order):
+        # Estimate a generous slice, pack it, advance by what fit.
+        take = order[at : at + batch_size * 8]
+        batch, n = pack_examples(
+            [examples[i] for i in take], batch_size, seq_len,
+            eos_id=eos_id, pad_id=pad_id,
+        )
+        if n == 0:
+            return
+        if drop_remainder and at + n >= len(order) and n < batch_size:
+            return
+        yield batch
+        at += n
