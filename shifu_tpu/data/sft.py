@@ -165,19 +165,22 @@ def iter_sft_batches(
                 eos_id=eos_id, pad_id=pad_id,
             )
         return
-    # Packed: consume the stream batch_size-rows at a time; a batch
-    # takes as many examples as fit.
+    # Packed: offer ALL remaining examples each batch — pack_examples
+    # consumes a prefix and stops at the first non-fit, so rows fill to
+    # capacity regardless of how short examples are.
     at = 0
     while at < len(order):
-        # Estimate a generous slice, pack it, advance by what fit.
-        take = order[at : at + batch_size * 8]
         batch, n = pack_examples(
-            [examples[i] for i in take], batch_size, seq_len,
+            [examples[i] for i in order[at:]], batch_size, seq_len,
             eos_id=eos_id, pad_id=pad_id,
         )
         if n == 0:
             return
-        if drop_remainder and at + n >= len(order) and n < batch_size:
-            return
+        if drop_remainder and at + n >= len(order):
+            # Tail batch: drop it only when it left whole rows empty
+            # (static-shape training would see pure-padding rows).
+            empty_rows = int((batch["segment_ids"].max(axis=1) == 0).sum())
+            if empty_rows > 0:
+                return
         yield batch
         at += n

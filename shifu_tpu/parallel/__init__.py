@@ -33,7 +33,16 @@ from shifu_tpu.parallel.pipeline import (  # noqa: E402
     pipeline_loss_fn,
 )
 
-__all__ += ["PipelinedModel", "pipeline_apply", "pipeline_loss_fn"]
+from shifu_tpu.parallel.pipeline_1f1b import (  # noqa: E402
+    Pipelined1F1BModel,
+)
+
+__all__ += [
+    "PipelinedModel",
+    "Pipelined1F1BModel",
+    "pipeline_apply",
+    "pipeline_loss_fn",
+]
 from shifu_tpu.parallel.distributed import (  # noqa: E402
     HybridMeshPlan,
     initialize,

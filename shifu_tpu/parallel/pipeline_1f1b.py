@@ -1,0 +1,457 @@
+"""1F1B pipeline schedule: backward starts before forward finishes.
+
+The looped pipeline (parallel/pipeline.py) is GPipe-shaped: ALL M
+microbatches flow forward, then JAX's AD replays the tick scan in
+reverse. Correct and simple — but every stage must keep its boundary
+input for every in-flight microbatch until the backward reaches it, an
+O(M) stash: (M + P - 1) x (mb, s, d) tensors per stage.
+
+1F1B ("one forward, one backward") turns each microbatch around as soon
+as the LAST stage finishes it: stage P-1 computes the head loss and its
+cotangent immediately, and the cotangent chases back up the ring while
+later microbatches still flow down. A stage then holds at most the
+microbatches between its forward and its backward — a 2P-1-deep
+CIRCULAR stash, O(P) and independent of M.
+
+JAX's AD cannot express this (backward of a scan runs after the whole
+forward), so this module computes the GRADIENTS ITSELF inside one
+``shard_map`` scan and exposes the result through ``jax.custom_vjp``:
+
+  * one scan over M + 2P - 2 slots; per slot every stage does one
+    (validity-masked) FORWARD microbatch step and one BACKWARD step —
+    the classic 1F1B steady state where each device alternates F and B;
+  * two ring ``ppermute``s per slot: activations downstream, cotangents
+    upstream. Uniform collectives — no stage-dependent control flow;
+  * a backward step re-runs its stage from the stashed boundary input
+    under ``jax.vjp`` (rematerialisation is inherent: nothing but the
+    boundary is ever stored) and accumulates f32 parameter grads;
+  * the head (final norm + unembed + CE with z-loss) runs on the last
+    stage inside the same slot, producing UNNORMALISED sums
+    (ce_sum, z_sum, denominator) and the cotangent of d(ce_sum +
+    z_coef * z_sum)/dh. The custom_vjp backward scales every stored
+    gradient by cot / denominator — normalisation distributes over the
+    sum, so grads of the MEAN loss come out exactly;
+  * the custom_vjp's residuals ARE the gradients ("self-grad" pattern):
+    the forward computes them; the backward is one multiply.
+
+Activation-memory comparison (per stage, boundary tensors of size
+A = mb*s*d; in-layer activations are remat'ed in BOTH schedules):
+
+  looped GPipe (pipeline.py):  (M + P - 1) * A
+  1F1B (this module):          (2P - 1) * A   (+ the (M, ...) input-
+                               cotangent buffer dx, boundary dtype,
+                               live on stage 0 only — the same O(M)
+                               term the embed backward needs in ANY
+                               schedule)
+
+At M = 4P the boundary stash shrinks ~2.6x; for M >> P it approaches
+M/(2P).
+
+Scope: dense Transformer training path (no MoE aux, no packed
+segment_ids — use the looped pipeline for those). Numerics match the
+looped pipeline/sequential scan to float tolerance; grads are f32.
+Validated mesh envelope: pp and pp x tp (tests + the driver dryrun).
+Composing with an fsdp mesh axis currently trips an XLA:CPU SPMD
+partitioner INTERNAL check ("partition_group_list.num_replica_groups
+..." in spmd_partitioner_util.cc) when the train step pins
+fsdp-sharded state on the custom_vjp's per-stage grad outputs; the
+looped pipeline covers pp+fsdp meshes until that is resolved (it may
+be CPU-partitioner-specific — multi-chip TPU hardware was not
+available to check).
+
+Reference parity note: the upstream reference (klyan/shifu) is an empty
+repository (SURVEY.md); there is no reference schedule to match. The
+schedule itself is the published 1F1B (PipeDream-flush / Megatron-LM);
+this is an original XLA/shard_map expression of it.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from shifu_tpu.ops import rms_norm, rope_frequencies
+
+
+def _build_1f1b(layer_fn, head_fn, mesh: Mesh, axis: str):
+    """The shard_map program: returns per-stage grads + loss sums."""
+    n_stages = mesh.shape[axis]
+    fwd_perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+    bwd_perm = [((i + 1) % n_stages, i) for i in range(n_stages)]
+
+    def shard_body(params_local, head_params, x_local, tgt, msk, extras):
+        stage = jax.lax.axis_index(axis)
+        n_micro = x_local.shape[0]
+        stash_len = 2 * n_stages - 1
+        n_slots = n_micro + 2 * n_stages - 2
+        compute_dtype = jax.tree_util.tree_leaves(params_local)[0].dtype
+        boundary_dtype = x_local.dtype
+
+        def run_stage(p_loc, h):
+            def body(carry, lp):
+                return layer_fn(lp, carry.astype(compute_dtype), extras), None
+
+            out, _ = jax.lax.scan(body, h.astype(compute_dtype), p_loc)
+            return out.astype(boundary_dtype)
+
+        def head_vjp(h, targets, mask):
+            """Unnormalised loss sums and the cotangent of
+            (ce_sum + z_coef * z_sum) w.r.t. h and the head params."""
+            _, vjp, (ce_s, z_s, den) = jax.vjp(
+                lambda hh, hp: _head_objective(
+                    head_fn, hh.astype(compute_dtype), hp, targets, mask
+                ),
+                h, head_params, has_aux=True,
+            )
+            dh, dhp = vjp(jnp.float32(1.0))
+            return (ce_s, z_s, den), dh.astype(boundary_dtype), dhp
+
+        zero_pgrads = jax.tree_util.tree_map(
+            lambda a: jnp.zeros(a.shape, jnp.float32), params_local
+        )
+        zero_hgrads = jax.tree_util.tree_map(
+            lambda a: jnp.zeros(a.shape, jnp.float32), head_params
+        )
+        # The cond's false branch must match head_vjp's dhp dtypes
+        # (grads come back in the head params' dtypes).
+        zero_hgrads_c = jax.tree_util.tree_map(
+            lambda a: jnp.zeros(a.shape, a.dtype), head_params
+        )
+
+        def slot(carry, s):
+            (h_prev, cot_prev, stash, pg, hg, dx, sums) = carry
+            recv_f = jax.lax.ppermute(h_prev, axis, fwd_perm)
+            recv_b = jax.lax.ppermute(cot_prev, axis, bwd_perm)
+
+            # ---- forward step: microbatch mF = s - stage ------------
+            mF = s - stage
+            validF = (mF >= 0) & (mF < n_micro)
+            mFc = jnp.clip(mF, 0, n_micro - 1)
+            mb_in = jax.lax.dynamic_index_in_dim(
+                x_local, mFc, 0, keepdims=False
+            )
+            h_in = jnp.where(stage == 0, mb_in, recv_f)
+            h_out = run_stage(params_local, h_in)
+            # Invalid F slots must NOT clobber a live stash entry (the
+            # drain phase clips mF onto real microbatch indices whose
+            # backward may still be pending).
+            old_entry = jax.lax.dynamic_index_in_dim(
+                stash, mFc % stash_len, 0, keepdims=False
+            )
+            stash = jax.lax.dynamic_update_index_in_dim(
+                stash,
+                jnp.where(validF, h_in, old_entry),
+                mFc % stash_len,
+                0,
+            )
+
+            # ---- head turn-around on the last stage -----------------
+            # lax.cond, not masking: the head (vocab-wide logits + VJP)
+            # is real FLOPs — running it on every stage would multiply
+            # head compute by n_stages. head_vjp contains no collectives,
+            # so a stage-dependent branch is safe; only the ppermutes
+            # must stay uniform.
+            tF = jax.lax.dynamic_index_in_dim(tgt, mFc, 0, keepdims=False)
+            kF = jax.lax.dynamic_index_in_dim(msk, mFc, 0, keepdims=False)
+            at_head = (stage == n_stages - 1) & validF
+
+            def do_head(_):
+                return head_vjp(h_out, tF, kF)
+
+            def skip_head(_):
+                return (
+                    (jnp.float32(0), jnp.float32(0), jnp.float32(0)),
+                    jnp.zeros_like(h_out),
+                    zero_hgrads_c,
+                )
+
+            (ce_s, z_s, den), head_cot, dhp = jax.lax.cond(
+                at_head, do_head, skip_head, None
+            )
+            sums = (sums[0] + ce_s, sums[1] + z_s, sums[2] + den)
+            hg = jax.tree_util.tree_map(
+                lambda acc, g: acc + g.astype(jnp.float32), hg, dhp
+            )
+
+            # ---- backward step: microbatch mB -----------------------
+            mB = s - (2 * n_stages - 2 - stage)
+            validB = (mB >= 0) & (mB < n_micro)
+            mBc = jnp.clip(mB, 0, n_micro - 1)
+            h_in_b = jax.lax.dynamic_index_in_dim(
+                stash, mBc % stash_len, 0, keepdims=False
+            )
+            cot_in = jnp.where(stage == n_stages - 1, head_cot, recv_b)
+            _, stage_vjp = jax.vjp(run_stage, params_local, h_in_b)
+            dp, dh_in = stage_vjp(cot_in.astype(boundary_dtype))
+            pg = jax.tree_util.tree_map(
+                lambda acc, g: acc
+                + jnp.where(validB, g.astype(jnp.float32), 0.0),
+                pg,
+                dp,
+            )
+            # dx holds each microbatch's input cotangent ONCE (no
+            # accumulation), so the boundary dtype loses nothing and
+            # halves the buffer vs f32.
+            dx = jax.lax.dynamic_update_index_in_dim(
+                dx,
+                jnp.where(
+                    validB & (stage == 0),
+                    dh_in.astype(boundary_dtype),
+                    jax.lax.dynamic_index_in_dim(dx, mBc, 0, keepdims=False),
+                ),
+                mBc,
+                0,
+            )
+            return (h_out, dh_in, stash, pg, hg, dx, sums), None
+
+        mb_shape = x_local[0]
+        init = (
+            jnp.zeros_like(mb_shape),
+            jnp.zeros_like(mb_shape),
+            jnp.zeros((stash_len, *mb_shape.shape), boundary_dtype),
+            zero_pgrads,
+            zero_hgrads,
+            jnp.zeros(x_local.shape, boundary_dtype),
+            (jnp.float32(0.0), jnp.float32(0.0), jnp.float32(0.0)),
+        )
+        (_, _, _, pg, hg, dx, sums), _ = jax.lax.scan(
+            slot, init, jnp.arange(n_slots)
+        )
+        # Per-stage leading axis on everything (out_specs pins pp there):
+        # block grads reassemble into the stacked layer axis; head grads
+        # and sums add up across stages (only the last stage's are
+        # nonzero); dx is real only on stage 0.
+        lead = lambda t: jax.tree_util.tree_map(lambda a: a[None], t)
+        return lead(pg), lead(hg), lead(dx), lead(sums)
+
+    return jax.jit(
+        jax.shard_map(
+            shard_body,
+            mesh=mesh,
+            in_specs=(P(axis), P(), P(), P(), P(), P()),
+            out_specs=(P(axis), P(axis), P(axis), P(axis)),
+            axis_names={axis},
+            check_vma=False,
+        )
+    )
+
+
+def _head_objective(head_fn, h, head_params, targets, mask):
+    """(ce_sum + z_coef*z_sum) as the differentiated scalar; sums as aux."""
+    ce_s, z_s, den, z_coef = head_fn(h, head_params, targets, mask)
+    return ce_s + z_coef * z_s, (ce_s, z_s, den)
+
+
+class Pipelined1F1BModel:
+    """Adapter: a dense Transformer whose ``loss`` runs the 1F1B
+    schedule with self-computed gradients (module docstring).
+
+    Quacks like the wrapped model for the train stack, exactly like
+    ``parallel.pipeline.PipelinedModel``:
+
+        pm = Pipelined1F1BModel(model, mesh=mesh, microbatches=8)
+        state = create_sharded_state(pm, opt, rng, mesh)
+        step = make_train_step(pm, opt, mesh)
+
+    ``loss`` is differentiable (custom_vjp): its forward computes loss
+    AND gradients on the 1F1B schedule; value_and_grad's backward just
+    scales them. Dense models only (no MoE aux path, no packed
+    segment_ids).
+    """
+
+    def __init__(self, model, *, mesh: Mesh, microbatches: int,
+                 axis: str = "pp"):
+        cfg = model.cfg
+        if getattr(cfg, "n_experts", 0):
+            raise NotImplementedError(
+                "1F1B schedule supports dense models; MoE aux losses "
+                "ride the looped pipeline (PipelinedModel)"
+            )
+        self.inner = model
+        self.cfg = cfg
+        self.mesh = mesh
+        self.microbatches = microbatches
+        self.axis = axis
+
+        def layer_fn(layer_p, h, extras):
+            sin, cos = extras
+            out, _, _ = model._block(layer_p, h, sin, cos, None, None, None)
+            return out
+
+        z_coef = float(cfg.z_loss)
+
+        def head_fn(h, head_params, targets, mask):
+            """Unnormalised CE/z sums for ONE microbatch (f32)."""
+            h = rms_norm(
+                h, head_params["final_norm"].astype(h.dtype),
+                eps=cfg.norm_eps,
+            )
+            w = head_params["unembed"].astype(h.dtype)
+            logits = jnp.einsum("bsd,dv->bsv", h, w).astype(jnp.float32)
+            log_z = jax.nn.logsumexp(logits, axis=-1)
+            label_logits = jnp.take_along_axis(
+                logits, targets[..., None], axis=-1
+            ).squeeze(-1)
+            ce = log_z - label_logits
+            z = jnp.square(log_z)
+            w_ = mask.astype(jnp.float32)
+            return (
+                jnp.sum(ce * w_),
+                jnp.sum(z * w_),
+                jnp.sum(w_),
+                jnp.float32(z_coef),
+            )
+
+        self._fn = _build_1f1b(layer_fn, head_fn, mesh, axis)
+        self._model = model
+
+        # --- the differentiable pipelined loss -----------------------
+        @jax.custom_vjp
+        def pipelined_loss(params, batch):
+            loss, aux, _grads = _forward(params, batch)
+            return loss, aux
+
+        def _forward(params, batch):
+            model_ = self._model
+            cfg_ = self.cfg
+            tokens = batch["tokens"]
+            if batch.get("segment_ids") is not None:
+                raise NotImplementedError(
+                    "packed segment_ids: use the looped pipeline"
+                )
+            if batch.get("positions") is not None:
+                raise NotImplementedError(
+                    "explicit positions: use the looped pipeline"
+                )
+            b, s_full = tokens.shape
+            M = self.microbatches
+            if b % M:
+                raise ValueError(
+                    f"batch {b} not divisible into {M} microbatches"
+                )
+            inp = tokens[:, :-1]
+            tgt = tokens[:, 1:]
+            msk = batch.get("mask")
+            msk = (
+                jnp.ones_like(tgt, jnp.float32)
+                if msk is None
+                else msk[:, 1:].astype(jnp.float32)
+            )
+            s = s_full - 1
+
+            p = model_.policy.cast_to_compute(params)
+            h = jnp.take(p["embed"], inp, axis=0)
+            # XLA:CPU partitioner workaround (see pipeline.py): keep the
+            # shard_map boundary f32 there; TPU keeps the narrow dtype.
+            if (
+                jax.default_backend() == "cpu"
+                and h.dtype == jnp.bfloat16
+            ):
+                h = h.astype(jnp.float32)
+            positions = jnp.arange(s)
+            sin, cos = rope_frequencies(
+                cfg_.resolved_head_dim, positions, theta=cfg_.rope_theta,
+                scaling=cfg_.rope_scaling,
+            )
+            mb = b // M
+            d = h.shape[-1]
+            head_params = {
+                "final_norm": p["final_norm"],
+                "unembed": (
+                    p["embed"].T if cfg_.tie_embeddings else p["unembed"]
+                ),
+            }
+            pg, hg, dx, sums = self._fn(
+                p["blocks"],
+                head_params,
+                h.reshape(M, mb, s, d),
+                tgt.reshape(M, mb, s),
+                msk.reshape(M, mb, s),
+                (sin, cos),
+            )
+            # Reassemble: block grads carry the stacked layer axis back
+            # (the per-stage leading axis IS the pp sharding of layers);
+            # head grads / sums add over stages; dx is stage 0's.
+            n_l = jax.tree_util.tree_leaves(p["blocks"])[0].shape[0]
+            pg = jax.tree_util.tree_map(
+                lambda g: g.reshape(n_l, *g.shape[2:]), pg
+            )
+            hg = jax.tree_util.tree_map(lambda g: g.sum(0), hg)
+            dx = dx[0].reshape(b, s, d)
+            ce_s = sums[0].sum()
+            z_s = sums[1].sum()
+            den = jnp.maximum(sums[2].sum(), 1.0)
+            loss = (ce_s + float(cfg_.z_loss) * z_s) / den
+            aux = {"ce": ce_s / den, "z": z_s / den, "denominator": den}
+            return loss, aux, (pg, hg, dx, den, inp)
+
+        def fwd(params, batch):
+            loss, aux, grads = _forward(params, batch)
+            return (loss, aux), (params, grads)
+
+        def bwd(res, g):
+            params, (pg, hg, dx, den, inp) = res
+            # aux is reporting-only; its cotangent (g[1]) is dropped.
+            scale = g[0] / den
+            # Embed grad: transpose of the gather. Expressed as a
+            # one-hot matmul rather than a scatter-add: the SPMD
+            # partitioner handles a dot over a (vocab->tp, embed->fsdp)
+            # sharded output cleanly where the equivalent scatter
+            # crashes the XLA:CPU partitioner on pp+tp+fsdp meshes, and
+            # on TPU the dot rides the MXU (~1% of a train step at 1B).
+            # CHUNKED over microbatches: a whole-batch one-hot would be
+            # (b*s, V) — bigger than everything the O(P) schedule saves.
+            v = params["embed"].shape[0]
+            d_model = dx.shape[-1]
+            dx_m = dx.reshape(self.microbatches, -1, d_model)
+            inp_m = inp.reshape(self.microbatches, -1)
+
+            def acc_embed(acc, mi):
+                dxc, ic = mi
+                onehot = jax.nn.one_hot(ic, v, dtype=jnp.bfloat16)
+                return acc + jnp.einsum(
+                    "nv,nd->vd", onehot, dxc.astype(jnp.bfloat16),
+                    preferred_element_type=jnp.float32,
+                ), None
+
+            d_embed, _ = jax.lax.scan(
+                acc_embed,
+                jnp.zeros((v, d_model), jnp.float32),
+                (dx_m, inp_m),
+            )
+            out = {
+                "blocks": jax.tree_util.tree_map(
+                    lambda gq, pp_: (gq * scale).astype(pp_.dtype),
+                    pg,
+                    params["blocks"],
+                ),
+                "final_norm": (hg["final_norm"] * scale).astype(
+                    params["final_norm"].dtype
+                ),
+            }
+            if self.cfg.tie_embeddings:
+                d_embed = d_embed + hg["unembed"].T
+            else:
+                out["unembed"] = (hg["unembed"] * scale).astype(
+                    params["unembed"].dtype
+                )
+            out["embed"] = (d_embed * scale).astype(params["embed"].dtype)
+            return out, None
+
+        pipelined_loss.defvjp(fwd, bwd)
+        self._pipelined_loss = pipelined_loss
+        self._forward_impl = _forward
+
+    def loss(self, params, batch):
+        # ONE pipelined forward: the custom_vjp's primal is (loss, aux).
+        return self._pipelined_loss(params, batch)
+
+    def specs(self):
+        return self.inner.specs()
+
+    def axes(self):
+        return self.inner.axes()
+
+    def init(self, rng):
+        return self.inner.init(rng)
