@@ -32,6 +32,7 @@ repository (SURVEY.md); there is no reference SFT pipeline to match.
 
 from __future__ import annotations
 
+import warnings
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -52,9 +53,22 @@ def _fit(prompt, response, seq_len: int, eos_id: Optional[int]):
     room = seq_len - len(response)
     if room < 1:
         # Keep one prompt token so the first response prediction has a
-        # conditioning token; truncate the response tail.
+        # conditioning token; truncate the response tail. seq_len < 2
+        # cannot hold even (one prompt token, one response token) — that
+        # would yield an all-zero loss mask (a silent no-op example), so
+        # reject it instead.
+        if seq_len < 2:
+            raise ValueError(
+                f"seq_len={seq_len} cannot fit any (prompt, response) pair"
+            )
         prompt = prompt[-1:]
         response = response[: seq_len - 1]
+        warnings.warn(
+            "SFT response truncated from the right to fit seq_len"
+            + ("; the EOS terminator was dropped" if eos_id is not None else "")
+            + " — the example trains a mid-sentence stop-less continuation",
+            stacklevel=3,
+        )
     else:
         prompt = prompt[-room:]
     if not prompt:

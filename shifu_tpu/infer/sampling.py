@@ -142,4 +142,7 @@ def sample_logits_per_row(logits, rng, temperature, top_k, top_p):
     pth = jnp.min(kept, axis=-1, keepdims=True)
     x = jnp.where(x >= jnp.maximum(kth, pth), x, NEG_INF)
     sampled = jax.random.categorical(rng, x, axis=-1).astype(jnp.int32)
-    return jnp.where(temperature == 0.0, greedy, sampled)
+    # One convention for non-positive temperatures: t <= 0 is greedy, both
+    # in the scaling guard above and in this final select (a negative
+    # temperature must not silently sample at t=1).
+    return jnp.where(temperature <= 0.0, greedy, sampled)

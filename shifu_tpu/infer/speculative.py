@@ -307,7 +307,7 @@ def speculative_generate_batch(
     while not done.all():
         # Per-row cache budget: a row whose next chunk would not fit
         # freezes alone (its output is truncated and counted in
-        # ``rounds_exhausted``); other rows keep going.
+        # ``rows_cache_exhausted``); other rows keep going.
         over = ~done & (n + k + 1 > max_len)
         if over.any():
             exhausted += int(over.sum())
