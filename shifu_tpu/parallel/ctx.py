@@ -47,6 +47,24 @@ def activation_sharding(mesh: Mesh, rules: Mapping = DEFAULT_RULES):
         _env.reset(token)
 
 
+@contextlib.contextmanager
+def no_activation_sharding():
+    """Disable ``constrain`` within this (tracing) scope.
+
+    Subsystems that manage sharding END-TO-END through a partial-manual
+    shard_map (the 1F1B pipeline) suppress the ambient constraints while
+    tracing their body: auto-axis layouts propagate from the shard_map's
+    inputs, and mixing ambient per-activation constraints with the
+    body's own reshards has tripped XLA SPMD partitioner internal
+    checks on 3-axis (pp x tp x fsdp) meshes.
+    """
+    token = _env.set(None)
+    try:
+        yield
+    finally:
+        _env.reset(token)
+
+
 def current_env() -> Optional[_ActEnv]:
     """The active (mesh, rules) pair, or None outside activation_sharding.
 

@@ -50,14 +50,39 @@ M/(2P).
 Scope: dense Transformer training path (no MoE aux, no packed
 segment_ids — use the looped pipeline for those). Numerics match the
 looped pipeline/sequential scan to float tolerance; grads are f32.
-Validated mesh envelope: pp and pp x tp (tests + the driver dryrun).
-Composing with an fsdp mesh axis currently trips an XLA:CPU SPMD
-partitioner INTERNAL check ("partition_group_list.num_replica_groups
-..." in spmd_partitioner_util.cc) when the train step pins
-fsdp-sharded state on the custom_vjp's per-stage grad outputs; the
-looped pipeline covers pp+fsdp meshes until that is resolved (it may
-be CPU-partitioner-specific — multi-chip TPU hardware was not
-available to check).
+Validated mesh envelope: pp, pp x tp, pp x fsdp, pp x dp x fsdp and
+pp x tp x fsdp (tests + the driver dryrun).
+
+SPMD-uniformity notes (the root causes behind the round-2 "cannot
+compose with fsdp" limitation, each with its fix in place):
+
+  1. The head runs inside a STAGE-DEPENDENT ``lax.cond``. Any operand
+     arriving sharded over an auto (non-pp) mesh axis invites the
+     partitioner to insert resharding collectives INSIDE the branch —
+     collectives only the last pp stage executes. That is an SPMD
+     uniformity violation on every backend (observed concretely as a
+     collective-permute rendezvous deadlock on the 8-device CPU mesh:
+     the partitioner emitted a cross-fsdp reshard of the targets
+     gather, channel pairs spanning all devices, inside branch_1).
+     Fixes: the head's small operands (targets, mask, head params) are
+     REPLICATED over auto axes before the shard_map (one uniform
+     all-gather outside); the loss sums are PER-ROW vectors reduced
+     OUTSIDE the shard_map, so no cross-shard reduction ever needs to
+     live in the branch.
+  2. The two ring ppermutes per slot are data-independent, and at
+     pp=2 their source-target pair SETS coincide — XLA assigned both
+     the same channel id, so concurrent execution mixes their
+     rendezvous. An ``optimization_barrier`` orders the backward
+     permute after the forward one, giving every device one total
+     order of collectives.
+  3. Ambient activation-sharding constraints (the train step's
+     ``activation_sharding`` context) landing inside the partial-
+     manual body, combined with (1)'s replicated head operands,
+     tripped an XLA SPMD partitioner internal CHECK
+     ("partition_group_list.num_replica_groups ..." in
+     spmd_partitioner_util.cc) on pp x tp x fsdp. The body's auto-axis
+     layouts propagate fine from the shard_map inputs, so the adapter
+     traces its shard_map under ``no_activation_sharding()``.
 
 Reference parity note: the upstream reference (klyan/shifu) is an empty
 repository (SURVEY.md); there is no reference schedule to match. The
@@ -96,16 +121,24 @@ def _build_1f1b(layer_fn, head_fn, mesh: Mesh, axis: str):
             return out.astype(boundary_dtype)
 
         def head_vjp(h, targets, mask):
-            """Unnormalised loss sums and the cotangent of
-            (ce_sum + z_coef * z_sum) w.r.t. h and the head params."""
-            _, vjp, (ce_s, z_s, den) = jax.vjp(
+            """Unnormalised PER-ROW loss sums and the cotangent of
+            (ce_sum + z_coef * z_sum) w.r.t. h and the head params.
+
+            Per-row (not scalar) sums are load-bearing under partial-
+            manual partitioning: a scalar sum over fsdp-sharded rows
+            would force the partitioner to insert an all-reduce INSIDE
+            this stage-dependent branch — a collective only the last
+            pp stage executes, which deadlocks (see module docstring).
+            Row vectors keep every op here row-local; the reduction
+            happens outside the shard_map, in uniform code."""
+            _, vjp, (ce_r, z_r, den_r) = jax.vjp(
                 lambda hh, hp: _head_objective(
                     head_fn, hh.astype(compute_dtype), hp, targets, mask
                 ),
                 h, head_params, has_aux=True,
             )
             dh, dhp = vjp(jnp.float32(1.0))
-            return (ce_s, z_s, den), dh.astype(boundary_dtype), dhp
+            return (ce_r, z_r, den_r), dh.astype(boundary_dtype), dhp
 
         zero_pgrads = jax.tree_util.tree_map(
             lambda a: jnp.zeros(a.shape, jnp.float32), params_local
@@ -122,6 +155,19 @@ def _build_1f1b(layer_fn, head_fn, mesh: Mesh, axis: str):
         def slot(carry, s):
             (h_prev, cot_prev, stash, pg, hg, dx, sums) = carry
             recv_f = jax.lax.ppermute(h_prev, axis, fwd_perm)
+            # ORDER the two ring permutes. They are data-independent, and
+            # XLA:CPU's thunk executor runs independent collectives
+            # concurrently — device threads can then enter the two
+            # rendezvous in opposite orders and deadlock (observed on
+            # 8-device fsdp-bearing meshes: half the devices blocked on
+            # the forward permute's op_id, half on the backward's). The
+            # barrier ties the backward permute's operand to the forward
+            # permute's result, forcing one schedule on every backend;
+            # the tensors are microbatch boundaries, so the serialization
+            # cost is noise.
+            recv_f, cot_prev = jax.lax.optimization_barrier(
+                (recv_f, cot_prev)
+            )
             recv_b = jax.lax.ppermute(cot_prev, axis, bwd_perm)
 
             # ---- forward step: microbatch mF = s - stage ------------
@@ -156,20 +202,19 @@ def _build_1f1b(layer_fn, head_fn, mesh: Mesh, axis: str):
             kF = jax.lax.dynamic_index_in_dim(msk, mFc, 0, keepdims=False)
             at_head = (stage == n_stages - 1) & validF
 
+            mb_rows = x_local.shape[1]
+
             def do_head(_):
                 return head_vjp(h_out, tF, kF)
 
             def skip_head(_):
-                return (
-                    (jnp.float32(0), jnp.float32(0), jnp.float32(0)),
-                    jnp.zeros_like(h_out),
-                    zero_hgrads_c,
-                )
+                z = jnp.zeros((mb_rows,), jnp.float32)
+                return (z, z, z), jnp.zeros_like(h_out), zero_hgrads_c
 
-            (ce_s, z_s, den), head_cot, dhp = jax.lax.cond(
+            (ce_r, z_r, den_r), head_cot, dhp = jax.lax.cond(
                 at_head, do_head, skip_head, None
             )
-            sums = (sums[0] + ce_s, sums[1] + z_s, sums[2] + den)
+            sums = (sums[0] + ce_r, sums[1] + z_r, sums[2] + den_r)
             hg = jax.tree_util.tree_map(
                 lambda acc, g: acc + g.astype(jnp.float32), hg, dhp
             )
@@ -206,6 +251,7 @@ def _build_1f1b(layer_fn, head_fn, mesh: Mesh, axis: str):
             return (h_out, dh_in, stash, pg, hg, dx, sums), None
 
         mb_shape = x_local[0]
+        zrow = jnp.zeros((x_local.shape[1],), jnp.float32)
         init = (
             jnp.zeros_like(mb_shape),
             jnp.zeros_like(mb_shape),
@@ -213,7 +259,7 @@ def _build_1f1b(layer_fn, head_fn, mesh: Mesh, axis: str):
             zero_pgrads,
             zero_hgrads,
             jnp.zeros(x_local.shape, boundary_dtype),
-            (jnp.float32(0.0), jnp.float32(0.0), jnp.float32(0.0)),
+            (zrow, zrow, zrow),
         )
         (_, _, _, pg, hg, dx, sums), _ = jax.lax.scan(
             slot, init, jnp.arange(n_slots)
@@ -238,9 +284,10 @@ def _build_1f1b(layer_fn, head_fn, mesh: Mesh, axis: str):
 
 
 def _head_objective(head_fn, h, head_params, targets, mask):
-    """(ce_sum + z_coef*z_sum) as the differentiated scalar; sums as aux."""
-    ce_s, z_s, den, z_coef = head_fn(h, head_params, targets, mask)
-    return ce_s + z_coef * z_s, (ce_s, z_s, den)
+    """(ce_sum + z_coef*z_sum) as the differentiated scalar; PER-ROW
+    sums as aux (row-local — see head_vjp for why)."""
+    ce_r, z_r, den_r, z_coef = head_fn(h, head_params, targets, mask)
+    return jnp.sum(ce_r) + z_coef * jnp.sum(z_r), (ce_r, z_r, den_r)
 
 
 class Pipelined1F1BModel:
@@ -282,7 +329,10 @@ class Pipelined1F1BModel:
         z_coef = float(cfg.z_loss)
 
         def head_fn(h, head_params, targets, mask):
-            """Unnormalised CE/z sums for ONE microbatch (f32)."""
+            """Unnormalised PER-ROW CE/z sums for ONE microbatch (f32).
+            Row-local by construction (reduce over seq only) so the
+            partitioner never needs a cross-shard reduction inside the
+            stage-dependent head branch."""
             h = rms_norm(
                 h, head_params["final_norm"].astype(h.dtype),
                 eps=cfg.norm_eps,
@@ -297,9 +347,9 @@ class Pipelined1F1BModel:
             z = jnp.square(log_z)
             w_ = mask.astype(jnp.float32)
             return (
-                jnp.sum(ce * w_),
-                jnp.sum(z * w_),
-                jnp.sum(w_),
+                jnp.sum(ce * w_, axis=-1),
+                jnp.sum(z * w_, axis=-1),
+                jnp.sum(w_, axis=-1),
                 jnp.float32(z_coef),
             )
 
@@ -362,14 +412,50 @@ class Pipelined1F1BModel:
                     p["embed"].T if cfg_.tie_embeddings else p["unembed"]
                 ),
             }
-            pg, hg, dx, sums = self._fn(
-                p["blocks"],
-                head_params,
-                h.reshape(M, mb, s, d),
-                tgt.reshape(M, mb, s),
-                msk.reshape(M, mb, s),
-                (sin, cos),
-            )
+
+            # Replicate the head branch's operands over the AUTO mesh
+            # axes (fsdp/dp/tp) OUTSIDE the shard_map. The head runs
+            # inside a stage-dependent lax.cond; if any of its operands
+            # arrive sharded over an auto axis, the partitioner inserts
+            # resharding collectives INSIDE the branch — collectives
+            # only the last pp stage executes, which is an SPMD
+            # uniformity violation (observed as a collective-permute
+            # rendezvous deadlock on the 8-device CPU mesh; on TPU the
+            # same non-uniform collective would hang the program).
+            # Targets/mask are int32/f32 (b, s) and the head params are
+            # the final norm + unembed — replicating them here is one
+            # uniform all-gather, after which every op in the branch is
+            # local. Activations (h) stay sharded: the head's row-local
+            # math composes with them without collectives once the
+            # row-sum outputs are vectors (see head_vjp).
+            if self.mesh.size > 1:
+                from jax.sharding import NamedSharding
+
+                rep = NamedSharding(self.mesh, P())
+                head_params = jax.tree_util.tree_map(
+                    lambda a: jax.lax.with_sharding_constraint(a, rep),
+                    head_params,
+                )
+                tgt = jax.lax.with_sharding_constraint(tgt, rep)
+                msk = jax.lax.with_sharding_constraint(msk, rep)
+            # The shard_map body manages its own sharding (pp manually,
+            # auto axes by propagation from the inputs). Ambient
+            # per-activation constraints from the train step's
+            # activation_sharding context would land INSIDE the body
+            # and, combined with the replicated head operands above,
+            # trip an XLA SPMD partitioner internal check on
+            # pp x tp x fsdp meshes — suppress them for this trace.
+            from shifu_tpu.parallel.ctx import no_activation_sharding
+
+            with no_activation_sharding():
+                pg, hg, dx, sums = self._fn(
+                    p["blocks"],
+                    head_params,
+                    h.reshape(M, mb, s, d),
+                    tgt.reshape(M, mb, s),
+                    msk.reshape(M, mb, s),
+                    (sin, cos),
+                )
             # Reassemble: block grads carry the stacked layer axis back
             # (the per-stage leading axis IS the pp sharding of layers);
             # head grads / sums add over stages; dx is stage 0's.

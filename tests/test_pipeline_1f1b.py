@@ -14,12 +14,12 @@ from shifu_tpu.parallel.pipeline_1f1b import Pipelined1F1BModel
 from shifu_tpu.train import AdamW, create_sharded_state, make_train_step
 
 
-def _mesh(pp, tp=1):
-    n = pp * tp
+def _mesh(pp, tp=1, fsdp=1, dp=1):
+    n = pp * tp * fsdp * dp
     devs = jax.devices()[:n]
     if len(devs) < n:
         pytest.skip(f"needs {n} virtual devices")
-    return MeshPlan(pp=pp, tp=tp).build(devs)
+    return MeshPlan(pp=pp, tp=tp, fsdp=fsdp, dp=dp).build(devs)
 
 
 def _grads(loss_fn, params, batch):
@@ -141,6 +141,61 @@ def test_1f1b_full_train_step():
         )
         losses = []
         for _ in range(8):
+            state, m = step(state, batch)
+            losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0], losses
+
+
+@pytest.mark.parametrize(
+    "axes,micro",
+    [
+        (dict(pp=2, fsdp=2), 4),
+        (dict(pp=2, fsdp=4), 2),
+        (dict(pp=2, dp=2, fsdp=2), 2),
+    ],
+)
+def test_1f1b_fsdp_matches_sequential(axes, micro):
+    """fsdp-bearing meshes: grads match the unsharded sequential scan.
+
+    These layouts were impossible in round 2 (stage-dependent head
+    branch attracting partitioner collectives — module docstring
+    SPMD-uniformity notes); parity here pins both the deadlock fix and
+    the numerics."""
+    mesh = _mesh(**axes)
+    cfg = TransformerConfig.tiny(n_layers=4)
+    model = Transformer(cfg)
+    params = model.init(jax.random.key(1))
+    pm = Pipelined1F1BModel(model, mesh=mesh, microbatches=micro)
+    tokens = jnp.asarray(
+        np.random.RandomState(7).randint(1, 256, (8, 16)), jnp.int32
+    )
+    batch = {"tokens": tokens}
+    with mesh:
+        l1, a1, g1 = _grads(pm.loss, params, batch)
+    l0, a0, g0 = _grads(model.loss, params, batch)
+    assert abs(l1 - l0) < 1e-2
+    _assert_tree_close(g0, g1, rtol=5e-2, atol=5e-3)
+
+
+def test_1f1b_full_train_step_pp_tp_fsdp():
+    """The 3-axis mesh (pp x tp x fsdp) — the round-2 partitioner-CHECK
+    case — compiles, runs, and learns."""
+    mesh = _mesh(2, tp=2, fsdp=2)
+    cfg = TransformerConfig.tiny(n_layers=4)
+    model = Transformer(cfg)
+    pm = Pipelined1F1BModel(model, mesh=mesh, microbatches=2)
+    opt = AdamW()
+    from shifu_tpu.parallel import shard_batch
+
+    with mesh:
+        state = create_sharded_state(pm, opt, jax.random.key(0), mesh)
+        step = make_train_step(pm, opt, mesh)
+        tokens = np.random.RandomState(5).randint(1, 256, (4, 16))
+        batch = shard_batch(
+            {"tokens": jnp.asarray(tokens, jnp.int32)}, mesh
+        )
+        losses = []
+        for _ in range(6):
             state, m = step(state, batch)
             losses.append(float(m["loss"]))
     assert losses[-1] < losses[0], losses
