@@ -116,9 +116,15 @@ def bench_serving():
     """PagedEngine decode throughput + prefill latency on the real chip.
 
     Mix: 1.2B-param model, 16 slots, 1900-token prompts, page_size=64,
-    Pallas paged-decode kernel (attn_impl="flash"), measured twice: bf16
-    weights and int8 weight-only quantization (native qtensor path —
-    per-layer fused dequant, int8 stays the HBM format).
+    Pallas paged-decode kernel (attn_impl="flash"), three legs: bf16
+    weights, int8 weight-only (native qtensor path — per-layer fused
+    dequant), and int8 weights + int8 KV pool (per-token scales
+    dequantized inside the paged kernel).
+
+    Each leg reports ``bandwidth_util``: a bytes-moved model (weight
+    bytes + live KV bytes read per decode step) over the measured step
+    time, as a fraction of the chip's peak HBM bandwidth — decode is
+    HBM-bound, so this is the roofline gap the step time hides.
 
     Timing discipline for the tunnelled backend: ``block_until_ready``
     does NOT synchronise here and a dispatch costs ~0.3s of host
@@ -133,8 +139,13 @@ def bench_serving():
 
     from shifu_tpu.infer import SampleConfig
     from shifu_tpu.infer.engine import PagedEngine
-    from shifu_tpu.infer.quant import QuantizedModel, quantize_params
+    from shifu_tpu.infer.quant import (
+        QuantizedModel,
+        param_nbytes,
+        quantize_params,
+    )
     from shifu_tpu.models.transformer import Transformer, TransformerConfig
+    from shifu_tpu.utils.metrics import peak_hbm_bw
 
     rng = np.random.RandomState(0)
     cfg = TransformerConfig.base_1b(attn_impl="flash")
@@ -151,12 +162,24 @@ def bench_serving():
         rng.randint(1, cfg.vocab_size, size=prompt_len).tolist()
         for _ in range(slots)
     ]
+    peak_bw = peak_hbm_bw(jax.devices()[0])
 
-    def measure(m, params):
+    def kv_bytes_per_step(kv_dtype_bytes, scales: bool):
+        # Average live tokens per slot across the timed chunk: the timed
+        # step starts at prompt_len + chunk (warm chunk already decoded)
+        # and ends at prompt_len + 2*chunk.
+        avg_len = prompt_len + 1.5 * chunk
+        per_tok = 2 * cfg.n_kv_heads * (
+            cfg.resolved_head_dim * kv_dtype_bytes + (4 if scales else 0)
+        )
+        return cfg.n_layers * slots * avg_len * per_tok
+
+    def measure(m, params, cache_dtype=jnp.bfloat16):
         eng = PagedEngine(
             m, params, max_slots=slots, max_len=2560, page_size=64,
             prefill_buckets=(2048, 2560), decode_chunk=chunk,
             sample_cfg=SampleConfig(temperature=0.0),
+            cache_dtype=cache_dtype,
         )
         # Warm-up: compiles the prefill bucket and the decode chunk.
         eng.submit(prompts[0], max_new_tokens=chunk + 1)
@@ -180,15 +203,27 @@ def bench_serving():
         t0 = time.perf_counter()
         eng.step()
         dt = time.perf_counter() - t0
-        return {
+        step_s = dt / chunk
+        quant_kv = cache_dtype == jnp.int8
+        bytes_step = param_nbytes(params) + kv_bytes_per_step(
+            1 if quant_kv else 2, scales=quant_kv
+        )
+        out = {
             "decode_tokens_per_s": round(chunk * slots / dt, 1),
-            "decode_step_ms": round(1000 * dt / chunk, 2),
+            "decode_step_ms": round(1000 * step_s, 2),
             "prefill_ms": round(1000 * min(pres), 1),
+            "bytes_per_step_gb": round(bytes_step / 1e9, 2),
         }
+        if peak_bw:
+            out["bandwidth_util"] = round(bytes_step / step_s / peak_bw, 4)
+        return out
 
     out = {
         "bf16": measure(model, params_bf),
         "int8": measure(QuantizedModel(model), params_q8),
+        "int8_kv": measure(
+            QuantizedModel(model), params_q8, cache_dtype=jnp.int8
+        ),
         "model_params": "1.2B",
         "slots": slots,
         "prompt_len": prompt_len,
@@ -197,7 +232,9 @@ def bench_serving():
         "attn": "pallas paged-decode kernel",
         "note": (
             "decode rate: one 256-step dispatch, host-synced; int8 = "
-            "weight-only, native qtensor path (per-layer fused dequant)"
+            "weight-only (native qtensor path); int8_kv adds the int8 "
+            "paged pool, dequantized inside the kernel; bandwidth_util "
+            "= modelled bytes/step over measured step time vs peak HBM"
         ),
     }
     return out

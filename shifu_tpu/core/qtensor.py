@@ -49,3 +49,34 @@ def dequantize_tree(tree, dtype=jnp.float32):
         tree,
         is_leaf=is_qtensor,
     )
+
+
+# ------------------------------------------------------------- KV cache
+# Symmetric int8 over the trailing head_dim axis: one scale per
+# (position, kv head). Decode is HBM-bound and the KV pool is read in
+# full every step, so halving its bytes is latency; per-token-per-head
+# granularity keeps the error bound tight (each vector quantized over
+# its own range) at ~3% scale overhead (4 bytes per head_dim values).
+# Consumed by the paged pool (models/transformer.py init_paged_cache
+# with dtype=int8) and dequantized INSIDE the Pallas paged-decode
+# kernel (ops/pallas/paged_attention.py): scores multiply by the key
+# scale per lane, attention weights by the value scale before the V
+# dot, so the f32/bf16 copy of a page never exists anywhere.
+
+
+def quantize_kv(x):
+    """(..., head_dim) -> (int8 same shape, f32 scale (...,)).
+
+    scale = absmax over head_dim / 127 (1.0 for all-zero vectors, so
+    dequantizing an untouched pool slot yields exact zeros).
+    """
+    x32 = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(x32), axis=-1)
+    scale = jnp.where(amax > 0.0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(x32 / scale[..., None]), -127.0, 127.0)
+    return q.astype(jnp.int8), scale
+
+
+def dequantize_kv(q, scale, dtype=jnp.float32):
+    """Inverse of :func:`quantize_kv` (max abs error amax/254 per lane)."""
+    return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)

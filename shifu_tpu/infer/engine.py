@@ -403,12 +403,17 @@ class Engine:
         logical = axes_fn() if axes_fn is not None else None
 
         def sharding_of(shape_struct):
-            names = (
-                logical
-                if logical is not None
-                and len(logical) == len(shape_struct.shape)
-                else (None,) * len(shape_struct.shape)
-            )
+            rank = len(shape_struct.shape)
+            if logical is not None and len(logical) == rank:
+                names = logical
+            elif logical is not None and len(logical) == rank + 1:
+                # Quantized-pool scale leaves: the data shape minus its
+                # trailing head_dim axis, so the leading names apply
+                # (layers, pages, page, kv_heads) — scales shard with
+                # their data (kv heads over tp).
+                names = logical[:rank]
+            else:
+                names = (None,) * rank
             return NamedSharding(
                 self.mesh,
                 spec_for(shape_struct.shape, names, self.mesh, rules),

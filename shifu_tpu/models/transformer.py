@@ -423,8 +423,20 @@ class Transformer(Module):
         _, n_pages, ps, n_kv, hd = pool["k"].shape
         pages_per_row = page_table.shape[1]
         li = layer_idx
-        kc = k.astype(pool["k"].dtype)
-        vc = v.astype(pool["v"].dtype)
+        # Quantized pool (init_paged_cache(dtype=int8)): writes quantize
+        # at the scatter (int8 data + per-(pos, kv) f32 scale), reads
+        # dequantize — inside the Pallas kernel on the decode fast path,
+        # at the gather on the XLA fallback/suffix paths.
+        quantized = "k_scale" in pool
+        if quantized:
+            from shifu_tpu.core.qtensor import dequantize_kv, quantize_kv
+
+            kc, vc = k, v  # quantize_kv converts at each write below
+        else:
+            kc = k.astype(pool["k"].dtype)
+            vc = v.astype(pool["v"].dtype)
+        csk = pool.get("k_scale")
+        csv = pool.get("v_scale")
 
         if q_len > 1:
             if q_len % ps:
@@ -444,12 +456,18 @@ class Transformer(Module):
                 )
             kv_block = kc[0].reshape(q_len // ps, ps, n_kv, hd)
             v_block = vc[0].reshape(q_len // ps, ps, n_kv, hd)
+            if quantized:
+                kv_block, ks_block = quantize_kv(kv_block)
+                v_block, vs_block = quantize_kv(v_block)
             if type(cache_index) is int and cache_index == 0:
                 # Fresh prefill: local attention fast path (flash for
                 # long prompts), nothing cached to look at.
                 phys = page_table[0, : q_len // ps]  # (np_b,)
                 ck = pool["k"].at[li, phys].set(kv_block)
                 cv = pool["v"].at[li, phys].set(v_block)
+                if quantized:
+                    csk = csk.at[li, phys].set(ks_block)
+                    csv = csv.at[li, phys].set(vs_block)
                 attn = dot_product_attention(
                     q, k, v, causal=True, impl=self.cfg.attn_impl,
                     window=self.cfg.window_size,
@@ -464,14 +482,18 @@ class Transformer(Module):
                 )
                 ck = pool["k"].at[li, phys].set(kv_block)
                 cv = pool["v"].at[li, phys].set(v_block)
+                if quantized:
+                    csk = csk.at[li, phys].set(ks_block)
+                    csv = csv.at[li, phys].set(vs_block)
                 # One mixed-index gather: the scalar layer index rides the
                 # gather instead of materialising the full layer slice.
-                gk = ck[li, page_table].reshape(
-                    b, page_table.shape[1] * ps, n_kv, hd
-                )
-                gv = cv[li, page_table].reshape(
-                    b, page_table.shape[1] * ps, n_kv, hd
-                )
+                gk = ck[li, page_table]
+                gv = cv[li, page_table]
+                if quantized:
+                    gk = dequantize_kv(gk, csk[li, page_table], k.dtype)
+                    gv = dequantize_kv(gv, csv[li, page_table], v.dtype)
+                gk = gk.reshape(b, page_table.shape[1] * ps, n_kv, hd)
+                gv = gv.reshape(b, page_table.shape[1] * ps, n_kv, hd)
                 attn = _decode_attention(
                     q, gk, gv, cache_index, self.cfg.attn_impl,
                     window=self.cfg.window_size,
@@ -485,16 +507,24 @@ class Transformer(Module):
             rows = jnp.arange(b)
             phys = page_table[rows, cache_index // ps]  # (b,)
             off = cache_index % ps
+            kw, vw = kc[:, 0], vc[:, 0]
+            if quantized:
+                kw, ksw = quantize_kv(kw)
+                vw, vsw = quantize_kv(vw)
             # Inactive slots all point at scratch page 0 — duplicate
             # scatter indices there are benign (nothing reads scratch).
-            ck = pool["k"].at[li, phys, off].set(kc[:, 0])
-            cv = pool["v"].at[li, phys, off].set(vc[:, 0])
+            ck = pool["k"].at[li, phys, off].set(kw)
+            cv = pool["v"].at[li, phys, off].set(vw)
+            if quantized:
+                csk = csk.at[li, phys, off].set(ksw)
+                csv = csv.at[li, phys, off].set(vsw)
             if self.cfg.attn_impl == "flash" and _pallas_paged_ok():
                 # Pallas paged-decode kernel: reads each live page once,
                 # straight from the stacked pool via the scalar-prefetched
                 # page table and layer index — neither the per-layer
                 # slice nor the (b, pages_per_row * ps, kv, hd) gather
-                # ever exists (ops/pallas/paged_attention.py).
+                # ever exists (ops/pallas/paged_attention.py). An int8
+                # pool dequantizes INSIDE the kernel (per-lane scales).
                 from shifu_tpu.ops.pallas.paged_attention import (
                     paged_decode_attention,
                 )
@@ -502,6 +532,8 @@ class Transformer(Module):
                 attn = paged_decode_attention(
                     q[:, 0], ck, cv, page_table, cache_index, layer=li,
                     window=self.cfg.window_size, kv_mask=kv_mask,
+                    k_scale=csk if quantized else None,
+                    v_scale=csv if quantized else None,
                 )[:, None]
             else:
                 # Gather each row's pages into its logical view with ONE
@@ -509,17 +541,22 @@ class Transformer(Module):
                 # layer slice itself is never materialised. Traffic is
                 # the gathered copy's write+read — the kernel path above
                 # avoids even that.
-                gk = ck[li, page_table].reshape(
-                    b, pages_per_row * ps, n_kv, hd
-                )
-                gv = cv[li, page_table].reshape(
-                    b, pages_per_row * ps, n_kv, hd
-                )
+                gk = ck[li, page_table]
+                gv = cv[li, page_table]
+                if quantized:
+                    gk = dequantize_kv(gk, csk[li, page_table], q.dtype)
+                    gv = dequantize_kv(gv, csv[li, page_table], q.dtype)
+                gk = gk.reshape(b, pages_per_row * ps, n_kv, hd)
+                gv = gv.reshape(b, pages_per_row * ps, n_kv, hd)
                 attn = _decode_attention(
                     q, gk, gv, cache_index, self.cfg.attn_impl,
                     kv_mask=kv_mask, window=self.cfg.window_size,
                 )
-        return attn, {"k": ck, "v": cv}
+        new_pool = {"k": ck, "v": cv}
+        if quantized:
+            new_pool["k_scale"] = csk
+            new_pool["v_scale"] = csv
+        return attn, new_pool
 
     # ------------------------------------------------------------- moe ffn
     def _moe_ffn(self, p, x):
@@ -871,6 +908,12 @@ class Transformer(Module):
         silently overwrite the last valid entries — enforce the bound on
         the host side when driving a decode loop.
         """
+        if jnp.issubdtype(jnp.dtype(dtype), jnp.integer):
+            raise ValueError(
+                "quantized KV is supported on the PAGED pool only "
+                "(init_paged_cache(dtype=jnp.int8)); the dense cache "
+                "has no scale channel"
+            )
         cfg = self.cfg
         shape = (
             cfg.n_layers, batch_size, max_seq_len, cfg.n_kv_heads,
@@ -898,12 +941,31 @@ class Transformer(Module):
         Unlike the dense cache, pool capacity is decoupled from
         max_slots × max_len — size it for the expected TOTAL live tokens,
         which is what makes continuous batching memory-efficient.
+
+        ``dtype=jnp.int8`` returns a QUANTIZED pool: int8 K/V plus
+        per-(position, kv head) f32 scales ("k_scale"/"v_scale" leaves,
+        (layers, pages, page, kv)) — core.qtensor.quantize_kv's format.
+        Writes quantize at the scatter, decode dequantizes inside the
+        Pallas paged kernel (per-lane score/weight scaling), so the
+        pool's HBM footprint AND per-step read are halved vs bf16.
+        Scales init to 1.0: an untouched slot dequantizes to exact 0.
         """
         cfg = self.cfg
         shape = (
             cfg.n_layers, n_pages, page_size, cfg.n_kv_heads,
             cfg.resolved_head_dim,
         )
+        if jnp.issubdtype(jnp.dtype(dtype), jnp.integer):
+            if jnp.dtype(dtype) != jnp.int8:
+                raise ValueError(
+                    f"quantized paged pools are int8 only, got {dtype}"
+                )
+            return {
+                "k": jnp.zeros(shape, jnp.int8),
+                "v": jnp.zeros(shape, jnp.int8),
+                "k_scale": jnp.ones(shape[:-1], jnp.float32),
+                "v_scale": jnp.ones(shape[:-1], jnp.float32),
+            }
         return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 

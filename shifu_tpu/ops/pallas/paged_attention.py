@@ -74,19 +74,40 @@ _LANES = 128
 _MASK_FLOOR = -1e30
 
 
-def _decode_kernel(scale, window, n_kv, group, unroll, ps, has_mask, *refs):
+def _decode_kernel(
+    scale, window, n_kv, group, unroll, ps, has_mask, has_scale, *refs
+):
     """One (row, page-group) grid step: U pages against all query heads.
 
     refs: table_ref, len_ref, layer_ref (scalar prefetch), q_ref
     (1, heads, hd), U k_refs + U v_refs (1, 1, ps*n_kv, hd) each,
-    [mask_ref (1, 1, U*ps*n_kv) — pre-expanded kv-interleaved], o_ref
-    (1, heads, hd), scratch m/l (heads, _LANES) and acc (heads, hd).
+    [ks_ref + vs_ref (1, 1, U*ps*n_kv) f32 — int8-pool per-lane scales,
+    pre-gathered into the row's LOGICAL layout like the mask: one DMA
+    per grid step, not one per page — per-page scale blocks measured
+    SLOWER than bf16 KV (decode compute per grid step is tiny, so DMA
+    issue count dominates)], [mask_ref (1, 1, U*ps*n_kv) — pre-expanded
+    kv-interleaved], o_ref (1, heads, hd), scratch m/l (heads, _LANES)
+    and acc (heads, hd).
+
+    With ``has_scale`` the K/V blocks are int8 and dequantization happens
+    HERE, per lane: scores multiply by the key scale after the QK dot
+    (each lane is one (position, kv head) vector with one scale), and
+    attention weights multiply by the value scale before the V dot —
+    sum_l p[l] * vs[l] * v[l, :] == dot(p * vs, v). The full-precision
+    page never exists; the pool's HBM read is the int8 bytes + the
+    (b, pages_per_row*ps*n_kv) gathered scales (~3% of the pool).
     """
     len_ref = refs[1]
     q_ref = refs[3]
     k_refs = refs[4 : 4 + unroll]
     v_refs = refs[4 + unroll : 4 + 2 * unroll]
-    rest = refs[4 + 2 * unroll :]
+    at = 4 + 2 * unroll
+    if has_scale:
+        ks_ref, vs_ref = refs[at], refs[at + 1]
+        at += 2
+    else:
+        ks_ref = vs_ref = None
+    rest = refs[at:]
     if has_mask:
         mask_ref, o_ref, m_sc, l_sc, acc_sc = rest
     else:
@@ -121,10 +142,16 @@ def _decode_kernel(scale, window, n_kv, group, unroll, ps, has_mask, *refs):
         base = (j * unroll + u) * ps
         k = k_refs[u][0, 0]  # (ps*kv, hd) — pool pre-flattened by wrapper
         v = v_refs[u][0, 0]
+        if has_scale:
+            # int8 -> q.dtype is exact (|values| <= 127); the per-lane
+            # scale rides the SCORE, not a dequantized K copy.
+            k = k.astype(q.dtype)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale  # (heads, ps*kv)
+        if has_scale:
+            s = s * ks_ref[0, 0, u * lanes : (u + 1) * lanes][None, :]
         pos = base + lane_pos
         valid = jnp.logical_and(head_match, pos <= length)
         if window is not None:
@@ -141,8 +168,17 @@ def _decode_kernel(scale, window, n_kv, group, unroll, ps, has_mask, *refs):
         p = jnp.exp(s - m_new[:, :1])  # exact 0 on masked lanes
         l = alpha * l + jnp.sum(p, axis=1, keepdims=True)
         m = m_new
+        if has_scale:
+            # Fold the per-lane value scale into p (masked lanes are
+            # exactly 0, so garbage scales on dead lanes are inert).
+            vsl = vs_ref[0, 0, u * lanes : (u + 1) * lanes]
+            pv = (p * vsl[None, :]).astype(q.dtype)
+            vv = v.astype(q.dtype)
+        else:
+            pv = p.astype(v.dtype)
+            vv = v
         acc = acc * alpha[:, :1] + jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            pv, vv, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
     m_sc[...] = m
@@ -169,6 +205,8 @@ def paged_decode_attention(
     scale: Optional[float] = None,
     window: Optional[int] = None,
     kv_mask: Optional[jax.Array] = None,
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
     pages_per_step: int = 4,
     interpret: Optional[bool] = None,
 ):
@@ -197,6 +235,12 @@ def paged_decode_attention(
         the current position are hidden.
       kv_mask: optional (batch, pages_per_row * page_size) bool — extra
         per-position visibility AND'ed onto the causal mask.
+      k_scale, v_scale: per-(position, kv head) f32 dequantization
+        scales for an int8 pool — (n_pages, page_size, n_kv) or,
+        stacked, (n_layers, n_pages, page_size, n_kv), matching the
+        pool layout (core.qtensor.quantize_kv). Pass both or neither;
+        with them the K/V pools must be int8 and dequantization happens
+        inside the kernel (see _decode_kernel).
       pages_per_step: pages fetched per grid step (DMA/compute grain).
       interpret: force pallas interpret mode; defaults to interpret
         unless running on TPU (CPU tests exercise this same kernel).
@@ -226,22 +270,25 @@ def paged_decode_attention(
     li_arr = jnp.asarray(layer if layer is not None else 0, jnp.int32)[None]
     n_layers_ = n_layers if layer is not None else 1
 
+    def _clamped_page(u, ib, j, table_ref, len_ref):
+        # Clamp to the row's live page range: steps past the row's
+        # length (and, with a sliding window, steps wholly before
+        # the window) repeat a neighbouring block index, which
+        # Mosaic never re-fetches — per-row DMA is O(live pages)
+        # (O(window) pages when windowed), not O(pages_per_row).
+        jl = j * unroll + u
+        hi = len_ref[ib] // ps  # <= pages_per_row - 1 always
+        if window is not None:
+            lo = jnp.maximum(len_ref[ib] - (window - 1), 0) // ps
+            jl = jnp.maximum(jl, lo)
+        return table_ref[ib, jnp.minimum(jl, hi)]
+
     def page_of(u):
         def index(ib, j, table_ref, len_ref, li_ref):
-            # Clamp to the row's live page range: steps past the row's
-            # length (and, with a sliding window, steps wholly before
-            # the window) repeat a neighbouring block index, which
-            # Mosaic never re-fetches — per-row DMA is O(live pages)
-            # (O(window) pages when windowed), not O(pages_per_row).
-            jl = j * unroll + u
-            hi = len_ref[ib] // ps  # <= pages_per_row - 1 always
-            if window is not None:
-                lo = jnp.maximum(len_ref[ib] - (window - 1), 0) // ps
-                jl = jnp.maximum(jl, lo)
-            jc = jnp.minimum(jl, hi)
-            return (li_ref[0], table_ref[ib, jc], 0, 0)
+            return (li_ref[0], _clamped_page(u, ib, j, table_ref, len_ref), 0, 0)
 
         return index
+
 
     # Flatten (ps, kv) into the sublane axis OUTSIDE the kernel — the
     # trailing (kv, hd) dims are already one native (8, 128) tile, so
@@ -259,6 +306,36 @@ def paged_decode_attention(
         + kv_spec
     )
     inputs = [q] + [k_flat] * unroll + [v_flat] * unroll
+    has_scale = k_scale is not None
+    if has_scale != (v_scale is not None):
+        raise ValueError("pass both k_scale and v_scale or neither")
+    if has_scale:
+        if k_pool.dtype != jnp.int8:
+            raise ValueError(
+                f"k_scale/v_scale imply an int8 pool, got {k_pool.dtype}"
+            )
+        # Gather the live scales into each row's LOGICAL layout OUTSIDE
+        # the kernel and stream them like the mask (one (1, 1, U*ps*kv)
+        # block per grid step). Feeding pool-layout scales as per-page
+        # blocks measured SLOWER than bf16 KV: 2 extra DMAs per PAGE
+        # (vs per grid step) at ~1 KB each — decode's per-step compute
+        # is tiny, so the DMA issue count is the cost that matters. The
+        # gather itself is ~3% of the pool's bytes (f32 per (pos, kv)).
+        def gather_scales(s_pool):
+            s5 = s_pool.reshape(n_layers_, n_pages, ps, n_kv)
+            g = s5[li_arr[0], table]  # (b, pages_per_row, ps, n_kv)
+            flat = g.astype(jnp.float32).reshape(b, -1)
+            pad = n_steps * unroll * ps * n_kv - flat.shape[1]
+            if pad:
+                flat = jnp.pad(flat, ((0, 0), (0, pad)))
+            return flat[:, None, :]
+
+        scale_spec = pl.BlockSpec(
+            (1, 1, unroll * ps * n_kv),
+            lambda ib, j, t, l, li: (ib, 0, j),
+        )
+        in_specs += [scale_spec, scale_spec]
+        inputs += [gather_scales(k_scale), gather_scales(v_scale)]
     has_mask = kv_mask is not None
     if has_mask:
         # Pre-expand to lane space: lane r of a flattened page = position
@@ -291,7 +368,8 @@ def paged_decode_attention(
     )
     return pl.pallas_call(
         functools.partial(
-            _decode_kernel, scale, window, n_kv, group, unroll, ps, has_mask
+            _decode_kernel, scale, window, n_kv, group, unroll, ps,
+            has_mask, has_scale,
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, n_heads, hd), q.dtype),

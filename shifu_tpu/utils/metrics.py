@@ -24,13 +24,32 @@ PEAK_FLOPS = {
     "TPU v6 lite": 918e12,  # v6e / Trillium
 }
 
+# Peak HBM bandwidth per chip (bytes/s) — the denominator for decode
+# bandwidth utilisation (serving decode is HBM-bound: weights + KV read
+# once per step).
+PEAK_HBM_BW = {
+    "TPU v5 lite": 819e9,  # v5e
+    "TPU v5e": 819e9,
+    "TPU v4": 1228e9,
+    "TPU v5p": 2765e9,
+    "TPU v6 lite": 1640e9,  # v6e / Trillium
+}
+
+
+def _by_device_kind(table, device) -> Optional[float]:
+    kind = getattr(device, "device_kind", "")
+    for prefix, val in table.items():
+        if kind.startswith(prefix):
+            return val
+    return None
+
 
 def peak_flops(device) -> Optional[float]:
-    kind = getattr(device, "device_kind", "")
-    for prefix, peak in PEAK_FLOPS.items():
-        if kind.startswith(prefix):
-            return peak
-    return None
+    return _by_device_kind(PEAK_FLOPS, device)
+
+
+def peak_hbm_bw(device) -> Optional[float]:
+    return _by_device_kind(PEAK_HBM_BW, device)
 
 
 def attention_flops_per_token(seq: int, head_dim: int, n_heads: int,

@@ -1,0 +1,241 @@
+"""int8 KV-cache quantization: format, kernel dequant, engine parity.
+
+The quantized paged pool (models/transformer.py ``init_paged_cache``
+with dtype=int8) stores int8 K/V plus per-(position, kv head) f32
+scales; decode dequantizes INSIDE the Pallas paged-decode kernel
+(ops/pallas/paged_attention.py — per-lane score/weight scaling) and at
+the gather on the XLA fallback. Tests pin three things:
+
+  * the format primitive's error bound (core.qtensor.quantize_kv);
+  * the kernel's dequantization against an explicit
+    dequantize-then-attend reference — same int8 data, so the match is
+    tight (plumbing exactness, not quantization error);
+  * engine-level token parity between the kernel path and the XLA
+    fallback on the SAME quantized pool — the whole serving stack
+    agrees on what the quantized cache means.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from shifu_tpu.core.qtensor import dequantize_kv, quantize_kv
+from shifu_tpu.infer import SampleConfig
+from shifu_tpu.models import Transformer, TransformerConfig
+from shifu_tpu.ops.pallas.paged_attention import paged_decode_attention
+
+from test_paged_attention import _reference, _setup
+
+
+# ------------------------------------------------------------ primitive
+
+
+def test_quantize_kv_roundtrip_bound():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((5, 7, 3, 64)) * 3.0, jnp.float32)
+    q, s = quantize_kv(x)
+    assert q.dtype == jnp.int8 and s.shape == x.shape[:-1]
+    back = dequantize_kv(q, s)
+    # Symmetric rounding: error <= scale/2 = amax/254 per element.
+    amax = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
+    bound = amax / 254.0 + 1e-6
+    assert bool(jnp.all(jnp.abs(back - x) <= bound))
+
+
+def test_quantize_kv_zero_vector_exact():
+    x = jnp.zeros((4, 2, 8), jnp.float32)
+    q, s = quantize_kv(x)
+    assert bool(jnp.all(s == 1.0))  # scale 1.0 => dequant exact zeros
+    assert bool(jnp.all(dequantize_kv(q, s) == 0.0))
+
+
+# --------------------------------------------------------------- kernel
+
+
+def _quantize_pools(pk, pv):
+    qk, sk = quantize_kv(pk)
+    qv, sv = quantize_kv(pv)
+    return qk, sk, qv, sv
+
+
+@pytest.mark.parametrize("unroll", [1, 3])
+@pytest.mark.parametrize("window", [None, 40])
+def test_kernel_int8_matches_dequant_reference(unroll, window):
+    """Kernel-side dequant == dequantize-then-attend, on the SAME int8
+    data: any mismatch is a plumbing bug, so the tolerance is tight."""
+    _, q, pk, pv, table, lengths = _setup()
+    qk, sk, qv, sv = _quantize_pools(pk, pv)
+    out = paged_decode_attention(
+        q, qk, qv, table, lengths,
+        k_scale=sk, v_scale=sv,
+        window=window, pages_per_step=unroll, interpret=True,
+    )
+    dk = dequantize_kv(qk, sk, jnp.float32)
+    dv = dequantize_kv(qv, sv, jnp.float32)
+    ref = _reference(q, dk, dv, table, lengths, window=window)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_kernel_int8_quantization_error_bounded():
+    """Against the FULL-PRECISION reference the only difference is the
+    int8 rounding — standard-normal data stays within a few 1e-2."""
+    _, q, pk, pv, table, lengths = _setup(seed=5)
+    qk, sk, qv, sv = _quantize_pools(pk, pv)
+    out = paged_decode_attention(
+        q, qk, qv, table, lengths, k_scale=sk, v_scale=sv, interpret=True
+    )
+    ref = _reference(q, pk, pv, table, lengths)
+    err = np.max(np.abs(np.asarray(out) - np.asarray(ref)))
+    assert err < 0.05, err
+
+
+def test_kernel_int8_kv_mask_and_gqa():
+    rng, q, pk, pv, table, lengths = _setup(seed=6, heads=8, kv=4)
+    qk, sk, qv, sv = _quantize_pools(pk, pv)
+    P_ps = table.shape[1] * pk.shape[1]
+    kv_mask = jnp.asarray(rng.random((q.shape[0], P_ps)) > 0.2)
+    kv_mask = kv_mask.at[:, 0].set(True)
+    out = paged_decode_attention(
+        q, qk, qv, table, lengths,
+        k_scale=sk, v_scale=sv, kv_mask=kv_mask, interpret=True,
+    )
+    dk = dequantize_kv(qk, sk, jnp.float32)
+    dv = dequantize_kv(qv, sv, jnp.float32)
+    ref = _reference(q, dk, dv, table, lengths, kv_mask=kv_mask)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_kernel_scale_args_validated():
+    _, q, pk, pv, table, lengths = _setup(seed=7)
+    qk, sk, qv, sv = _quantize_pools(pk, pv)
+    with pytest.raises(ValueError, match="both k_scale and v_scale"):
+        paged_decode_attention(
+            q, qk, qv, table, lengths, k_scale=sk, interpret=True
+        )
+    with pytest.raises(ValueError, match="int8 pool"):
+        paged_decode_attention(
+            q, pk, pv, table, lengths, k_scale=sk, v_scale=sv,
+            interpret=True,
+        )
+
+
+# --------------------------------------------------------------- engine
+
+
+def _engine_tokens(model, params, prompts, max_new, **kw):
+    from shifu_tpu.infer.engine import PagedEngine
+
+    eng = PagedEngine(
+        model, params, sample_cfg=SampleConfig(temperature=0.0), **kw
+    )
+    rids = [eng.submit(p, max_new_tokens=max_new) for p in prompts]
+    out = {c.rid: c for c in eng.run()}
+    return [np.asarray(out[r].tokens) for r in rids]
+
+
+def test_paged_engine_int8_flash_matches_int8_xla():
+    """Kernel path vs XLA gather path on the SAME int8 pool semantics:
+    greedy tokens must match exactly (both dequantize the same data)."""
+    cfg_x = TransformerConfig.tiny()
+    cfg_f = TransformerConfig.tiny(attn_impl="flash")
+    model_x, model_f = Transformer(cfg_x), Transformer(cfg_f)
+    params = model_x.init(jax.random.key(0))
+
+    rng = np.random.RandomState(11)
+    prompts = [rng.randint(1, 256, size=n).tolist() for n in (5, 11, 3)]
+    kw = dict(
+        max_slots=2, max_len=32, page_size=8, prefill_buckets=(16, 32),
+        cache_dtype=jnp.int8,
+    )
+    ref = _engine_tokens(model_x, params, prompts, 6, **kw)
+    got = _engine_tokens(model_f, params, prompts, 6, **kw)
+    for i, (a, b) in enumerate(zip(ref, got)):
+        np.testing.assert_array_equal(a, b, err_msg=f"request {i}")
+
+
+def test_paged_engine_int8_top1_tracks_bf16():
+    """Quantization error must not derail greedy decoding on a tiny
+    model: int8-KV tokens agree with the bf16-KV engine for a short
+    horizon (same params, same greedy sampler)."""
+    cfg = TransformerConfig.tiny()
+    model = Transformer(cfg)
+    params = model.init(jax.random.key(3))
+    rng = np.random.RandomState(12)
+    prompts = [rng.randint(1, 256, size=9).tolist()]
+    kw = dict(max_slots=1, max_len=32, page_size=8, prefill_buckets=(16, 32))
+    bf = _engine_tokens(model, params, prompts, 4, **kw)
+    q8 = _engine_tokens(
+        model, params, prompts, 4, cache_dtype=jnp.int8, **kw
+    )
+    np.testing.assert_array_equal(bf[0], q8[0])
+
+
+def test_paged_engine_int8_chunked_prefill_and_decode_chunks():
+    """int8 pool composes with chunked prefill and multi-step decode:
+    kernel path == XLA path exactly."""
+    cfg_x = TransformerConfig.tiny()
+    cfg_f = TransformerConfig.tiny(attn_impl="flash")
+    model_x, model_f = Transformer(cfg_x), Transformer(cfg_f)
+    params = model_x.init(jax.random.key(4))
+    rng = np.random.RandomState(13)
+    prompts = [rng.randint(1, 256, size=n).tolist() for n in (19, 7)]
+    kw = dict(
+        max_slots=2, max_len=48, page_size=8, prefill_buckets=(8, 16),
+        prefill_chunk=8, cache_dtype=jnp.int8,
+    )
+    ref = _engine_tokens(model_x, params, prompts, 5, **kw)
+    got = _engine_tokens(
+        model_f, params, prompts, 5, decode_chunk=3, **kw
+    )
+    for i, (a, b) in enumerate(zip(ref, got)):
+        np.testing.assert_array_equal(a, b, err_msg=f"request {i}")
+
+
+def test_paged_engine_int8_prefix_cache():
+    """Shared int8 prefix pages dequantize identically for every
+    borrower: prefix-cache on == off, token for token."""
+    cfg = TransformerConfig.tiny()
+    model = Transformer(cfg)
+    params = model.init(jax.random.key(5))
+    rng = np.random.RandomState(14)
+    shared = rng.randint(1, 256, size=16).tolist()
+    prompts = [shared + rng.randint(1, 256, size=4).tolist()
+               for _ in range(2)]
+    kw = dict(
+        max_slots=2, max_len=32, page_size=8, prefill_buckets=(8, 16, 32),
+        cache_dtype=jnp.int8,
+    )
+    plain = _engine_tokens(model, params, prompts, 5, **kw)
+    cached = _engine_tokens(
+        model, params, prompts, 5, enable_prefix_cache=True, **kw
+    )
+    for i, (a, b) in enumerate(zip(plain, cached)):
+        np.testing.assert_array_equal(a, b, err_msg=f"request {i}")
+
+
+# ---------------------------------------------------------------- guards
+
+
+def test_dense_cache_rejects_int8():
+    model = Transformer(TransformerConfig.tiny())
+    with pytest.raises(ValueError, match="PAGED pool only"):
+        model.init_cache(2, 32, dtype=jnp.int8)
+
+
+def test_paged_cache_rejects_other_int_dtypes():
+    model = Transformer(TransformerConfig.tiny())
+    with pytest.raises(ValueError, match="int8 only"):
+        model.init_paged_cache(8, 8, dtype=jnp.int16)
+
+
+def test_paged_cache_int8_leaves():
+    model = Transformer(TransformerConfig.tiny())
+    pool = model.init_paged_cache(8, 8, dtype=jnp.int8)
+    assert pool["k"].dtype == jnp.int8
+    assert pool["k_scale"].shape == pool["k"].shape[:-1]
+    assert bool(jnp.all(pool["v_scale"] == 1.0))
