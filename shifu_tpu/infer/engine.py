@@ -37,6 +37,16 @@ from shifu_tpu.infer.sampling import (
 )
 
 
+def _token_logprob(logits, ids):
+    """Raw-model logprob of ``ids`` under (batch, vocab) logits — the
+    pre-temperature/pre-filter distribution, the conventional
+    per-token ``logprobs`` surface. Cost per decode step is one
+    logsumexp over the row — noise next to the forward."""
+    lg = logits.astype(jnp.float32)
+    sel = jnp.take_along_axis(lg, ids[:, None].astype(jnp.int32), axis=-1)
+    return sel[:, 0] - jax.nn.logsumexp(lg, axis=-1)
+
+
 @dataclasses.dataclass
 class _Request:
     rid: int
@@ -49,13 +59,24 @@ class _Request:
     prefilled: int = 0
     # Per-request sampling override (engines with per_request_sampling).
     sampling: Optional[SampleConfig] = None
+    # Model logprob of each generated token, parallel to ``generated``.
+    logprobs: Optional[List[float]] = None
+    # Stop sequences: token-id sequences / decoded-text substrings.
+    stop_token_ids: Optional[List[List[int]]] = None
+    stop_strings: Optional[List[str]] = None
+    # Tokens already cleared of stop matches (resume point for the
+    # sweep's scan — keeps per-step stop checking incremental).
+    stop_scanned: int = 0
 
 
 @dataclasses.dataclass(frozen=True)
 class Completion:
     rid: int
     tokens: List[int]  # generated ids (eos included when hit)
-    finished_by: str  # "eos" | "length"
+    finished_by: str  # "eos" | "length" | "stop"
+    # Raw-model logprob (pre-temperature/filter distribution) of each
+    # returned token — the conventional per-token logprobs surface.
+    logprobs: Optional[List[float]] = None
 
 
 class Engine:
@@ -86,6 +107,7 @@ class Engine:
         mesh=None,
         sharding_rules=None,
         per_request_sampling: bool = False,
+        tokenizer=None,
     ):
         """``per_request_sampling``: temperature/top-k/top-p become
         per-slot TRACED arrays in the decode/prefill programs, so one
@@ -110,7 +132,12 @@ class Engine:
         hook get a replicated cache), and the model's
         activation-sharding constraints are recorded while tracing the
         engine's programs. ``sharding_rules`` must match what
-        shard_params used (default: the shared DEFAULT_RULES)."""
+        shard_params used (default: the shared DEFAULT_RULES).
+
+        ``tokenizer``: optional; needed only for STRING stop sequences
+        (``submit(..., stop_strings=...)`` — the sweep decodes the
+        generated tokens to find the stop text). Token-id stop
+        sequences need no tokenizer."""
         self.model = model
         self.params = params
         self.max_slots = max_slots
@@ -119,6 +146,8 @@ class Engine:
         self.eos_id = eos_id
         self.mesh = mesh
         self.sharding_rules = sharding_rules
+        self.tokenizer = tokenizer
+        self.cancellations = 0  # observability: cancel() calls that hit
         if decode_chunk < 1:
             raise ValueError(f"decode_chunk must be >= 1, got {decode_chunk}")
         self.decode_chunk = int(decode_chunk)
@@ -170,13 +199,41 @@ class Engine:
         prompt_tokens,
         max_new_tokens: int,
         sampling: Optional[SampleConfig] = None,
+        stop_token_ids=None,
+        stop_strings=None,
     ) -> int:
+        """Queue one request; returns its rid.
+
+        ``stop_token_ids``: iterable of stop sequences — each entry an
+        int (single-token stop) or a sequence of ints. On a match the
+        request finishes with ``finished_by="stop"`` and the matched
+        sequence is EXCLUDED from the returned tokens.
+        ``stop_strings``: iterable of substrings checked against the
+        DECODED generation (requires the engine's ``tokenizer``); the
+        returned tokens end at the first token whose decoding completes
+        a stop string (the server trims the trailing text)."""
         if sampling is not None and not self.per_request_sampling:
             raise ValueError(
                 "per-request sampling requires "
                 "Engine(per_request_sampling=True); this engine samples "
                 "with its engine-level SampleConfig"
             )
+        if stop_token_ids is not None:
+            stop_token_ids = [
+                [int(seq)] if isinstance(seq, int) else list(map(int, seq))
+                for seq in stop_token_ids
+            ]
+            if any(not seq for seq in stop_token_ids):
+                raise ValueError("empty stop_token_ids sequence")
+        if stop_strings is not None:
+            stop_strings = [str(s) for s in stop_strings]
+            if any(not s for s in stop_strings):
+                raise ValueError("empty stop string")
+            if self.tokenizer is None:
+                raise ValueError(
+                    "stop_strings need Engine(tokenizer=...) to decode "
+                    "the generation; pass stop_token_ids instead"
+                )
         prompt_tokens = list(map(int, prompt_tokens))
         if not prompt_tokens:
             raise ValueError("empty prompt")
@@ -202,10 +259,31 @@ class Engine:
         self._queue.append(
             _Request(
                 rid, prompt_tokens, max_new_tokens, generated=[],
-                sampling=sampling,
+                sampling=sampling, logprobs=[],
+                stop_token_ids=stop_token_ids, stop_strings=stop_strings,
             )
         )
         return rid
+
+    def cancel(self, rid: int) -> bool:
+        """Drop a request wherever it is — queued, decoding, or
+        mid-chunked-prefill. Frees its slot/pages immediately; no
+        Completion is emitted. Returns whether anything was dropped
+        (False: unknown rid or already finished)."""
+        for req in self._queue:
+            if req.rid == rid:
+                self._queue.remove(req)
+                self.cancellations += 1
+                return True
+        for pool in (self._active, self._prefilling):
+            for slot, req in list(pool.items()):
+                if req.rid == rid:
+                    del pool[slot]
+                    self._release(slot)
+                    self._free.append(slot)
+                    self.cancellations += 1
+                    return True
+        return False
 
     @property
     def idle(self) -> bool:
@@ -264,31 +342,34 @@ class Engine:
         )
         self._rng, sub = jax.random.split(self._rng)
         if self.decode_chunk == 1:
-            nxt, self.cache = self._decode_jit(
+            nxt, lps, self.cache = self._decode_jit(
                 self.params, self.cache, cur, lengths, active,
                 *self._decode_extra_args(), sub,
             )
-            nxt = np.asarray(nxt)
+            nxt, lps = np.asarray(nxt), np.asarray(lps)
             for slot, req in self._active.items():
                 token = int(nxt[slot])
                 req.generated.append(token)
+                req.logprobs.append(float(lps[slot]))
                 self._lengths[slot] += 1
                 self._cur[slot] = token
         else:
             remaining = np.zeros((self.max_slots,), np.int32)
             for slot, req in self._active.items():
                 remaining[slot] = req.max_new_tokens - len(req.generated)
-            toks, n_emit, cur2, lengths2, self.cache = (
+            toks, lps, n_emit, cur2, lengths2, self.cache = (
                 self._decode_chunk_jit(
                     self.params, self.cache, cur, lengths, active,
                     jnp.asarray(remaining), *self._decode_extra_args(), sub,
                 )
             )
             toks, n_emit = np.asarray(toks), np.asarray(n_emit)
+            lps = np.asarray(lps)
             cur2, lengths2 = np.asarray(cur2), np.asarray(lengths2)
             for slot, req in self._active.items():
                 n = int(n_emit[slot])
                 req.generated.extend(int(t) for t in toks[slot, :n])
+                req.logprobs.extend(float(x) for x in lps[slot, :n])
                 self._lengths[slot] = int(lengths2[slot])
                 self._cur[slot] = int(cur2[slot])
         done.extend(self._sweep())
@@ -346,7 +427,8 @@ class Engine:
         keeps executing (static shapes) with cur/lengths frozen — its
         writes land at its frozen position, which is past its final
         token and masked for every real read. Returns (tokens
-        (slots, K), n_emitted (slots,), cur, lengths, cache).
+        (slots, K), logprobs (slots, K), n_emitted (slots,), cur,
+        lengths, cache).
         """
         *extra, rng = rest
         k = self.decode_chunk
@@ -355,21 +437,22 @@ class Engine:
         def body(carry, t):
             cache, cur, lengths, done = carry
             live = active & ~done & (t < remaining)
-            nxt, cache = self._decode_impl(
+            nxt, lp, cache = self._decode_impl(
                 params, cache, cur, lengths, live, *extra,
                 jax.random.fold_in(rng, t),
             )
             lengths = jnp.where(live, lengths + 1, lengths)
             if eos is not None:
                 done = done | (live & (nxt == eos))
-            return (cache, nxt, lengths, done), (nxt, live)
+            return (cache, nxt, lengths, done), (nxt, lp, live)
 
         done0 = jnp.zeros((self.max_slots,), bool)
-        (cache, cur, lengths, _), (toks, lives) = jax.lax.scan(
+        (cache, cur, lengths, _), (toks, lps, lives) = jax.lax.scan(
             body, (cache, cur, lengths, done0), jnp.arange(k)
         )
         return (
             toks.T,  # (slots, K)
+            lps.T,
             jnp.sum(lives, axis=0).astype(jnp.int32),
             cur,
             lengths,
@@ -457,9 +540,63 @@ class Engine:
     def _advance_prefills(self) -> None:
         """Advance in-flight chunked prefills (paged engines override)."""
 
+    def _stop_cut(self, req: _Request) -> Optional[int]:
+        """Index into ``req.generated`` to truncate at for the earliest
+        stop-sequence match, or None. Token-sequence stops cut BEFORE
+        the match (the stop is excluded); string stops cut AFTER the
+        token whose decoding completes the stop (the server trims the
+        trailing text).
+
+        INCREMENTAL: ``req.stop_scanned`` records how many tokens the
+        previous sweeps cleared, so each sweep only examines the new
+        tail (minus a token-sequence overlap window). Without this a
+        string-stop request would re-decode every prefix every step —
+        O(n^2) decodes per step on the single engine thread. (Prefix
+        decoding is treated as monotone: once decode(gen[:k]) contains
+        no stop, later tokens cannot create a match ENDING at k. A stop
+        string made of U+FFFD replacement characters could violate
+        this; matching on replacement chars is not supported.)"""
+        gen = req.generated
+        scanned = req.stop_scanned
+        best: Optional[int] = None
+        if req.stop_token_ids:
+            overlap = max(len(s) for s in req.stop_token_ids) - 1
+            lo = max(0, scanned - overlap)
+            for seq in req.stop_token_ids:
+                n = len(seq)
+                for i in range(lo, len(gen) - n + 1):
+                    if gen[i : i + n] == seq:
+                        best = i if best is None else min(best, i)
+                        break
+        if req.stop_strings:
+            for k in range(scanned + 1, len(gen) + 1):
+                text = self.tokenizer.decode(gen[:k])
+                if any(s in text for s in req.stop_strings):
+                    best = k if best is None else min(best, k)
+                    break
+        if best is None:
+            req.stop_scanned = len(gen)
+        return best
+
     def _sweep(self) -> List[Completion]:
         out: List[Completion] = []
         for slot, req in list(self._active.items()):
+            cut = (
+                self._stop_cut(req)
+                if (req.stop_token_ids or req.stop_strings)
+                else None
+            )
+            if cut is not None:
+                out.append(
+                    Completion(
+                        req.rid, req.generated[:cut], "stop",
+                        logprobs=req.logprobs[:cut],
+                    )
+                )
+                del self._active[slot]
+                self._release(slot)
+                self._free.append(slot)
+                continue
             last = req.generated[-1] if req.generated else None
             hit_eos = self.eos_id is not None and last == self.eos_id
             full = len(req.generated) >= req.max_new_tokens
@@ -469,6 +606,7 @@ class Engine:
                         req.rid,
                         list(req.generated),
                         "eos" if hit_eos else "length",
+                        logprobs=list(req.logprobs),
                     )
                 )
                 del self._active[slot]
@@ -495,15 +633,16 @@ class Engine:
         padded = np.zeros((bucket,), np.int32)
         padded[:p] = req.tokens
         self._rng, sub = jax.random.split(self._rng)
-        first = self._dispatch_prefill(
+        first, lp = self._dispatch_prefill(
             slot, padded, p, bucket, sub, self._req_sampling_args(req)
         )
-        self._finish_admission(req, slot, p, first)
+        self._finish_admission(req, slot, p, first, lp)
 
     def _dispatch_prefill(self, slot, padded, p, bucket, rng, samp=()):
-        """Run the compiled prefill for one request; return token 1.
-        (Paged engines override to pass the slot's page-table row.)"""
-        first, self.cache = self._prefill_jit(
+        """Run the compiled prefill for one request; return (token 1,
+        its logprob). (Paged engines override to pass the slot's
+        page-table row.)"""
+        first, lp, self.cache = self._prefill_jit(
             self.params,
             self.cache,
             jnp.asarray(padded),
@@ -513,9 +652,9 @@ class Engine:
             rng,
             bucket=bucket,
         )
-        return first
+        return first, lp
 
-    def _finish_admission(self, req: _Request, slot, p, first) -> None:
+    def _finish_admission(self, req: _Request, slot, p, first, lp) -> None:
         """Shared post-prefill bookkeeping, dense and paged."""
         if self.per_request_sampling:
             t, k, pp = row_params(req.sampling or self.sample_cfg)
@@ -525,6 +664,7 @@ class Engine:
         self._lengths[slot] = p
         self._cur[slot] = int(first)
         req.generated.append(int(first))
+        req.logprobs.append(float(lp))
         self._active[slot] = req
         # A 1-token budget can finish at admission; step() sweeps it on
         # the next call via the normal bookkeeping (generated >= budget).
@@ -571,12 +711,13 @@ class Engine:
             row,
         )
         tok = self._sample_rows(logits[:, 0], rng, tuple(samp))[0]
-        return tok, cache
+        lp = _token_logprob(logits[:, 0], tok[None])[0]
+        return tok, lp, cache
 
     def _decode_impl(self, params, cache, cur, lengths, active, *rest):
-        """One token for every slot (inactive slots compute but are
-        ignored — static shapes beat host-side gather/scatter here).
-        ``rest`` = optional per-slot sampling triple, then rng."""
+        """One (token, logprob) for every slot (inactive slots compute
+        but are ignored — static shapes beat host-side gather/scatter
+        here). ``rest`` = optional per-slot sampling triple, then rng."""
         *samp, rng = rest
         kv_mask = (
             jnp.arange(self.max_len)[None, :] <= lengths[:, None]
@@ -589,9 +730,10 @@ class Engine:
             kv_mask=kv_mask,
         )
         nxt = self._sample_rows(logits[:, -1], rng, tuple(samp))
+        lp = _token_logprob(logits[:, -1], nxt)
         # Freeze inactive slots' cur so their cache rows stay untouched in
         # spirit (they are written, but their lengths never advance).
-        return jnp.where(active, nxt, cur), cache
+        return jnp.where(active, nxt, cur), lp, cache
 
 
 class PagedEngine(Engine):
@@ -774,6 +916,7 @@ class PagedEngine(Engine):
         prompt_tokens,
         max_new_tokens: int,
         sampling: Optional[SampleConfig] = None,
+        **kw,
     ) -> int:
         prompt_tokens = list(map(int, prompt_tokens))
         total = len(prompt_tokens) + max_new_tokens
@@ -808,7 +951,7 @@ class PagedEngine(Engine):
                 f"request needs up to {worst} pages but the pool has "
                 f"{self.n_pages - 1}"
             )
-        return super().submit(prompt_tokens, max_new_tokens, sampling)
+        return super().submit(prompt_tokens, max_new_tokens, sampling, **kw)
 
     def _init_cache(self, cache_dtype):
         return self._make_cache(
@@ -1009,12 +1152,12 @@ class PagedEngine(Engine):
         self._rng, sub = jax.random.split(self._rng)
         samp = self._req_sampling_args(req)
         if hit:
-            first = self._dispatch_prefill_at(
+            first, lp = self._dispatch_prefill_at(
                 slot, padded, len(suffix), hit, bucket, sub, samp=samp
             )
             self.prefix_hits_tokens += hit
         else:
-            first = self._dispatch_prefill(
+            first, lp = self._dispatch_prefill(
                 slot, padded, p, bucket, sub, samp
             )
         # Keep only the pages that hold real tokens; the bucket's tail
@@ -1028,7 +1171,7 @@ class PagedEngine(Engine):
         self._slot_pages[slot] = pages_used
         self._admit_order[slot] = next(self._admit_seq)
         self._register_prefix(prompt, pages_used)
-        self._finish_admission(req, slot, p, first)
+        self._finish_admission(req, slot, p, first, lp)
         return True
 
     def _register_prefix(self, prompt, pages_used) -> None:
@@ -1101,7 +1244,7 @@ class PagedEngine(Engine):
             # whose bucket rounds past max_len needs the slack-widened
             # row (a distinct compiled program per table width).
             narrow = off // ps + need <= self.pages_per_slot
-            first = self._dispatch_prefill_at(
+            first, lp = self._dispatch_prefill_at(
                 slot, padded, this_chunk, off, bucket, sub,
                 row=row[: self.pages_per_slot] if narrow else row,
                 samp=self._req_sampling_args(req),
@@ -1115,18 +1258,18 @@ class PagedEngine(Engine):
             self._slot_pages[slot].extend(own[:keep])
             req.prefilled = off + this_chunk
             if req.prefilled >= len(prompt):
-                self._finalize_chunked(slot, req, first)
+                self._finalize_chunked(slot, req, first, lp)
 
-    def _finalize_chunked(self, slot, req, first) -> None:
+    def _finalize_chunked(self, slot, req, first, lp) -> None:
         prompt = self._pending_prompt.pop(slot)
         row = self._pending_rows.pop(slot)
         del self._prefilling[slot]
         self._table[slot] = row[: self.pages_per_slot]
         self._register_prefix(prompt, self._slot_pages[slot])
-        self._finish_admission(req, slot, len(prompt), first)
+        self._finish_admission(req, slot, len(prompt), first, lp)
 
     def _dispatch_prefill(self, slot, padded, p, bucket, rng, samp=()):
-        first, self.cache = self._prefill_jit(
+        first, lp, self.cache = self._prefill_jit(
             self.params,
             self.cache,
             jnp.asarray(padded),
@@ -1136,11 +1279,11 @@ class PagedEngine(Engine):
             rng,
             bucket=bucket,
         )
-        return first
+        return first, lp
 
     def _dispatch_prefill_at(self, slot, padded, suffix_len, offset, bucket,
                              rng, row=None, samp=()):
-        first, self.cache = self._prefill_at_jit(
+        first, lp, self.cache = self._prefill_at_jit(
             self.params,
             self.cache,
             jnp.asarray(padded),
@@ -1151,7 +1294,7 @@ class PagedEngine(Engine):
             rng,
             bucket=bucket,
         )
-        return first
+        return first, lp
 
     def _prefill_at_impl(self, params, cache, tokens, length, offset,
                          table_row, *rest, bucket):
@@ -1174,7 +1317,8 @@ class PagedEngine(Engine):
             logits_at=(length - 1)[None],
         )
         tok = self._sample_rows(logits[:, 0], rng, tuple(samp))[0]
-        return tok, cache
+        lp = _token_logprob(logits[:, 0], tok[None])[0]
+        return tok, lp, cache
 
     def _ensure_decode_pages(self, k: int = 1) -> None:
         """Every active slot gets pages covering its next (up to) ``k``
@@ -1223,7 +1367,8 @@ class PagedEngine(Engine):
             logits_at=(length - 1)[None],
         )
         tok = self._sample_rows(logits[:, 0], rng, tuple(samp))[0]
-        return tok, cache
+        lp = _token_logprob(logits[:, 0], tok[None])[0]
+        return tok, lp, cache
 
     def _decode_impl(self, params, cache, cur, lengths, active, table,
                      *rest):
@@ -1244,4 +1389,5 @@ class PagedEngine(Engine):
             page_table=table,
         )
         nxt = self._sample_rows(logits[:, -1], rng, tuple(samp))
-        return jnp.where(active, nxt, cur), cache
+        lp = _token_logprob(logits[:, -1], nxt)
+        return jnp.where(active, nxt, cur), lp, cache

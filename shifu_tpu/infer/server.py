@@ -8,8 +8,11 @@ hand off through a locked inbox; the engine thread drains it between
 one step.
 
     POST /v1/completions  {"prompt": "text"} | {"tokens": [int, ...]}
-                          + optional "max_new_tokens"
-                          -> {"tokens": [...], "text"?, "finished_by"}
+                          + optional "max_new_tokens", "stop" (string or
+                          list of strings), "stop_token_ids" (ints or
+                          int-lists), "logprobs" (bool)
+                          -> {"tokens": [...], "text"?, "finished_by",
+                              "logprobs"?}
     GET  /healthz         -> engine stats (slots, queue, pages, ...)
 
 Sampling: engine-level by default (one compiled decode program). On an
@@ -17,6 +20,13 @@ engine built with ``per_request_sampling=True``, requests may carry
 "temperature" / "top_k" / "top_p" fields — they become per-slot traced
 values in the SAME compiled program, so mixed greedy/sampled traffic
 never recompiles.
+
+Stop sequences truncate in the ENGINE host loop (finished_by="stop");
+string stops additionally trim the trailing text in the response here.
+Client disconnects CANCEL the in-flight request: the streaming
+generator's close unregisters the waiter and queues an engine-side
+``cancel`` that frees the slot/pages — abandoned requests stop burning
+decode capacity.
 
 Reference parity note: the upstream reference (klyan/shifu) is an empty
 repository (SURVEY.md); there is no reference server to match. The API
@@ -61,7 +71,7 @@ class _Waiter:
     completion: Optional[Completion] = None
     error: Optional[Exception] = None
 
-    def push(self, tokens) -> None:  # streaming only; no-op here
+    def push(self, tokens, logprobs=None) -> None:  # streaming only
         pass
 
     def complete(self, c: Completion) -> None:
@@ -75,22 +85,38 @@ class _Waiter:
 
 @dataclasses.dataclass
 class _StreamWaiter:
-    """Streaming caller: a queue of ("delta", tokens) items followed by
-    one ("done", Completion) or ("error", exc)."""
+    """Streaming caller: a queue of ("delta", (tokens, logprobs)) items
+    followed by one ("done", Completion) or ("error", exc)."""
 
     q: "queue.Queue"
     sent: int = 0
 
-    def push(self, tokens) -> None:
+    def push(self, tokens, logprobs=None) -> None:
         if tokens:
-            self.q.put(("delta", tokens))
+            self.q.put(("delta", (tokens, logprobs)))
 
     def complete(self, c: Completion) -> None:
-        self.push(c.tokens[self.sent :])
+        # A stop-sequence truncation can finish BEHIND what was already
+        # streamed; the slice is then empty and the done event carries
+        # the definitive token count.
+        self.push(
+            c.tokens[self.sent :],
+            c.logprobs[self.sent :] if c.logprobs else None,
+        )
         self.q.put(("done", c))
 
     def fail(self, e: Exception) -> None:
         self.q.put(("error", e))
+
+
+@dataclasses.dataclass
+class _Submission:
+    tokens: list
+    max_new: int
+    sampling: Optional[SampleConfig]
+    stop_token_ids: Optional[list]
+    stop_strings: Optional[list]
+    waiter: object
 
 
 class EngineRunner:
@@ -106,7 +132,15 @@ class EngineRunner:
         self._poll_idle_s = poll_idle_s
         self._lock = threading.Lock()
         self._inbox: collections.deque = collections.deque()
+        self._cancels: collections.deque = collections.deque()  # rids
         self._waiters: dict = {}  # rid -> _Waiter
+        # The ONE submission currently between inbox-pop and waiter
+        # registration on the engine thread, and whether its caller
+        # abandoned it meanwhile. Registration checks the flag and
+        # cancels instead of registering a dead waiter — closing the
+        # window where a disconnect would silently lose the cancel.
+        self._inflight = None
+        self._inflight_abandoned = False
         self._stop = threading.Event()
         self._wake = threading.Event()
         self.fatal: Optional[Exception] = None  # set if the loop dies
@@ -119,6 +153,7 @@ class EngineRunner:
     def complete(
         self, tokens, max_new_tokens: int, timeout: Optional[float] = None,
         sampling: Optional[SampleConfig] = None,
+        stop_token_ids=None, stop_strings=None,
     ) -> Completion:
         w = _Waiter(threading.Event())
         # Check-and-append under ONE lock acquisition: the fatal/shutdown
@@ -133,12 +168,17 @@ class EngineRunner:
             if self._stop.is_set():
                 raise RuntimeError("engine runner is shut down")
             self._inbox.append(
-                (list(tokens), int(max_new_tokens), sampling, w)
+                _Submission(
+                    list(tokens), int(max_new_tokens), sampling,
+                    stop_token_ids, stop_strings, w,
+                )
             )
         self._wake.set()
         if not w.event.wait(timeout):
+            # Nobody will consume the result: cancel so the slot frees.
+            self._abandon(w)
             raise TimeoutError(
-                f"no completion within {timeout}s (request may still run)"
+                f"no completion within {timeout}s (request canceled)"
             )
         if w.error is not None:
             raise w.error
@@ -146,15 +186,17 @@ class EngineRunner:
 
     def stream(self, tokens, max_new_tokens: int,
                timeout: Optional[float] = None,
-               sampling: Optional[SampleConfig] = None):
-        """Returns a generator of ("delta", [ids]) items ending with
-        ("done", Completion); tokens arrive as the engine emits them
-        (per decode chunk). The submission (and the dead-runner check)
-        happens EAGERLY in this call — so callers see RuntimeError
-        before consuming anything — while validation errors surface on
-        the generator's first iteration. Raises on failure/timeout; a
-        timed-out or abandoned generator unregisters its waiter
-        (``close()`` it on client disconnect)."""
+               sampling: Optional[SampleConfig] = None,
+               stop_token_ids=None, stop_strings=None):
+        """Returns a generator of ("delta", (ids, logprobs)) items
+        ending with ("done", Completion); tokens arrive as the engine
+        emits them (per decode chunk). The submission (and the
+        dead-runner check) happens EAGERLY in this call — so callers
+        see RuntimeError before consuming anything — while validation
+        errors surface on the generator's first iteration. Raises on
+        failure/timeout; a timed-out or abandoned generator
+        unregisters its waiter AND cancels the in-flight request
+        (``close()`` it on client disconnect — the slot frees)."""
         w = _StreamWaiter(queue.Queue())
         with self._lock:
             if self.fatal is not None:
@@ -164,7 +206,10 @@ class EngineRunner:
             if self._stop.is_set():
                 raise RuntimeError("engine runner is shut down")
             self._inbox.append(
-                (list(tokens), int(max_new_tokens), sampling, w)
+                _Submission(
+                    list(tokens), int(max_new_tokens), sampling,
+                    stop_token_ids, stop_strings, w,
+                )
             )
         self._wake.set()
 
@@ -185,20 +230,32 @@ class EngineRunner:
             finally:
                 # Timeout, error, exhaustion, or close(): nobody will
                 # read this queue again — unregister so the loop stops
-                # feeding it. (The request itself runs on; the engine
-                # has no cancel.)
+                # feeding it, and cancel the request so its slot frees.
                 self._abandon(w)
 
         return events()
 
     def _abandon(self, w) -> None:
+        """Caller gave up (timeout, disconnect, close): unregister the
+        waiter and queue an engine-side cancel for anything already
+        submitted. The cancel executes on the ENGINE thread (the engine
+        is single-threaded by design) at its next loop turn."""
         with self._lock:
+            found = False
             for rid, ww in list(self._waiters.items()):
                 if ww is w:
                     del self._waiters[rid]
+                    self._cancels.append(rid)
+                    found = True
             self._inbox = collections.deque(
-                item for item in self._inbox if item[3] is not w
+                item for item in self._inbox if item.waiter is not w
             )
+            if not found and self._inflight is w:
+                # Popped from the inbox but not yet registered (the
+                # engine thread is inside submit): flag it so the
+                # registration step cancels instead.
+                self._inflight_abandoned = True
+        self._wake.set()
 
     def stats(self) -> dict:
         eng = self.engine
@@ -213,6 +270,7 @@ class EngineRunner:
             out["fatal"] = repr(self.fatal)
         for attr in (
             "free_pages", "n_pages", "preemptions", "prefix_hits_tokens",
+            "cancellations",
         ):
             if hasattr(eng, attr):
                 out[attr] = getattr(eng, attr)
@@ -229,30 +287,52 @@ class EngineRunner:
             waiters = list(self._waiters.values())
             self._waiters.clear()
         for item in pending:
-            item[3].fail(RuntimeError("engine runner shut down"))
+            item.waiter.fail(RuntimeError("engine runner shut down"))
         for w in waiters:
             w.fail(RuntimeError("engine runner shut down"))
 
     # ------------------------------------------------------------ the loop
+    def _drain_cancels(self) -> None:
+        while True:
+            with self._lock:
+                if not self._cancels:
+                    return
+                rid = self._cancels.popleft()
+            self.engine.cancel(rid)
+
     def _drain_inbox(self) -> None:
         while True:
             with self._lock:
                 if not self._inbox:
                     return
-                tokens, max_new, sampling, w = self._inbox.popleft()
+                sub = self._inbox.popleft()
+                self._inflight = sub.waiter
+                self._inflight_abandoned = False
             try:
                 rid = self.engine.submit(
-                    tokens, max_new_tokens=max_new, sampling=sampling
+                    sub.tokens, max_new_tokens=sub.max_new,
+                    sampling=sub.sampling,
+                    stop_token_ids=sub.stop_token_ids,
+                    stop_strings=sub.stop_strings,
                 )
             except Exception as e:  # validation error -> the caller
-                w.fail(e)
+                with self._lock:
+                    self._inflight = None
+                sub.waiter.fail(e)
                 continue
             with self._lock:
-                self._waiters[rid] = w
+                if self._inflight_abandoned:
+                    # Abandoned while the submit was in flight: cancel
+                    # now instead of registering a dead waiter.
+                    self._cancels.append(rid)
+                else:
+                    self._waiters[rid] = sub.waiter
+                self._inflight = None
 
     def _loop(self) -> None:
         try:
             while not self._stop.is_set():
+                self._drain_cancels()
                 self._drain_inbox()
                 if self.engine.idle:
                     # Nothing in flight: sleep until a submission arrives.
@@ -270,7 +350,8 @@ class EngineRunner:
                     req = live.get(rid)
                     if req is not None and isinstance(w, _StreamWaiter):
                         gen = list(req.generated)
-                        w.push(gen[w.sent :])
+                        lps = list(req.logprobs)
+                        w.push(gen[w.sent :], lps[w.sent :])
                         w.sent = len(gen)
                 for done in done_now:
                     with self._lock:
@@ -290,7 +371,7 @@ class EngineRunner:
                 waiters = list(self._waiters.values())
                 self._waiters.clear()
             for item in pending:
-                item[3].fail(err)
+                item.waiter.fail(err)
             for w in waiters:
                 w.fail(err)
 
@@ -351,12 +432,21 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             max_new = int(req.get("max_new_tokens", self.default_max_new))
             sampling = _parse_sampling(req)
+            stop_strings = req.get("stop")
+            if isinstance(stop_strings, str):
+                stop_strings = [stop_strings]
+            stop_token_ids = req.get("stop_token_ids")
+            want_logprobs = bool(req.get("logprobs"))
             if req.get("stream"):
-                self._stream_response(tokens, max_new, sampling)
+                self._stream_response(
+                    tokens, max_new, sampling, stop_token_ids,
+                    stop_strings, want_logprobs,
+                )
                 return
             done = self.runner.complete(
                 tokens, max_new, timeout=self.request_timeout_s,
-                sampling=sampling,
+                sampling=sampling, stop_token_ids=stop_token_ids,
+                stop_strings=stop_strings,
             )
         except (ValueError, TypeError) as e:
             self._send(400, {"error": str(e)})
@@ -368,9 +458,21 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(503, {"error": str(e)})
             return
         out = {"tokens": done.tokens, "finished_by": done.finished_by}
+        if want_logprobs:
+            out["logprobs"] = done.logprobs
         if self.tokenizer is not None:
             try:
-                out["text"] = self.tokenizer.decode(done.tokens)
+                text = self.tokenizer.decode(done.tokens)
+                if done.finished_by == "stop" and stop_strings:
+                    # The engine truncated at the token completing the
+                    # stop; trim the trailing text at the match itself.
+                    cuts = [
+                        text.find(s) for s in stop_strings
+                        if text.find(s) >= 0
+                    ]
+                    if cuts:
+                        text = text[: min(cuts)]
+                out["text"] = text
             except Exception as e:
                 # Sampled ids outside the tokenizer's range (e.g. byte
                 # tokenizer under a 32k-vocab model) must not turn a
@@ -378,14 +480,22 @@ class _Handler(BaseHTTPRequestHandler):
                 out["text_error"] = repr(e)
         self._send(200, out)
 
-    def _stream_response(self, tokens, max_new: int, sampling=None) -> None:
+    def _stream_response(
+        self, tokens, max_new: int, sampling=None,
+        stop_token_ids=None, stop_strings=None, want_logprobs=False,
+    ) -> None:
         """Server-sent events: one ``data:`` line per token delta, a
-        final one with finished_by, then ``data: [DONE]``. Errors after
-        the 200 has been sent arrive as a ``data:`` error event — the
-        status line cannot be rewritten mid-stream."""
+        final one with finished_by (and the definitive token count —
+        stop truncation can end BEHIND what was streamed), then
+        ``data: [DONE]``. Errors after the 200 has been sent arrive as
+        a ``data:`` error event — the status line cannot be rewritten
+        mid-stream. A broken client connection closes the generator,
+        which CANCELS the in-flight request (the engine frees its
+        slot)."""
         gen = self.runner.stream(
             tokens, max_new, timeout=self.request_timeout_s,
-            sampling=sampling,
+            sampling=sampling, stop_token_ids=stop_token_ids,
+            stop_strings=stop_strings,
         )
         self.send_response(200)
         self.send_header("Content-Type", "text/event-stream")
@@ -402,18 +512,58 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             for kind, payload in gen:
                 if kind == "delta":
-                    out = {"tokens": payload}
+                    ids, lps = payload
+                    out = {"tokens": ids}
+                    if want_logprobs and lps is not None:
+                        out["logprobs"] = lps
                     if self.tokenizer is not None:
                         try:
-                            out["text"] = self.tokenizer.decode(payload)
+                            out["text"] = self.tokenizer.decode(ids)
                         except Exception:
                             pass  # partial sequences may not decode
                     emit(out)
                 else:  # done
-                    emit({"finished_by": payload.finished_by})
+                    final = {
+                        "finished_by": payload.finished_by,
+                        "n_tokens": len(payload.tokens),
+                    }
+                    if want_logprobs:
+                        final["logprobs"] = payload.logprobs
+                    if self.tokenizer is not None:
+                        # The definitive text: deltas may have streamed
+                        # past a stop truncation, and a tokenizer-less
+                        # client could not reconstruct it otherwise.
+                        try:
+                            text = self.tokenizer.decode(payload.tokens)
+                            if (
+                                payload.finished_by == "stop"
+                                and stop_strings
+                            ):
+                                cuts = [
+                                    text.find(s) for s in stop_strings
+                                    if text.find(s) >= 0
+                                ]
+                                if cuts:
+                                    text = text[: min(cuts)]
+                            final["text"] = text
+                        except Exception:
+                            pass
+                    emit(final)
+        except OSError:
+            # Client went away: the finally closes the generator, which
+            # cancels the request so its slot frees.
+            return
         except Exception as e:
-            emit({"error": str(e)})
-        self.wfile.write(b"data: [DONE]\n\n")
+            try:
+                emit({"error": str(e)})
+            except OSError:
+                return
+        finally:
+            gen.close()
+        try:
+            self.wfile.write(b"data: [DONE]\n\n")
+        except OSError:
+            pass
 
 
 def make_server(
@@ -428,6 +578,11 @@ def make_server(
     """Build (not start) the HTTP server; ``.runner`` holds the engine
     thread. Serve with ``serve_forever()``; stop with ``shutdown()``
     then ``server.runner.shutdown()``."""
+    # String stop sequences are truncated by the ENGINE host loop, which
+    # needs the tokenizer; share the server's unless the engine has its
+    # own.
+    if tokenizer is not None and getattr(engine, "tokenizer", None) is None:
+        engine.tokenizer = tokenizer
     runner = EngineRunner(engine)
     handler = type(
         "BoundHandler",

@@ -271,7 +271,8 @@ def test_streaming_sse(served):
                 break
             events.append(json.loads(body))
     assert events[-1] == "DONE"
-    assert events[-2] == {"finished_by": "length"}
+    assert events[-2]["finished_by"] == "length"
+    assert events[-2]["n_tokens"] == len(blocking["tokens"])
     streamed = [t for e in events[:-2] for t in e["tokens"]]
     assert streamed == blocking["tokens"]
     assert len(events) > 3  # actually incremental, not one blob
@@ -289,7 +290,8 @@ def test_streaming_runner_api(tiny):
     got, done = [], None
     for kind, payload in runner.stream([1, 2, 3], 4, timeout=120):
         if kind == "delta":
-            got.extend(payload)
+            ids, lps = payload
+            got.extend(ids)
         else:
             done = payload
     assert done is not None and done.tokens == got
