@@ -28,6 +28,22 @@ def main():
     # before the serving bench allocates the 1.2B serving model + pool.
     out = bench_train(on_tpu, dev)
     if on_tpu:
+        # Extra train legs re-measure claims that would otherwise
+        # regress silently: long-context flash (and its windowed
+        # variant) and MoE routing. Each leg is fenced — a failure
+        # reports in place of its numbers, never sinks the line.
+        out["train_legs"] = {}
+        for name, fn in (
+            ("long_context", bench_train_long),
+            ("long_context_windowed", bench_train_long_windowed),
+            ("moe", bench_train_moe),
+        ):
+            try:
+                out["train_legs"][name] = fn(dev)
+            except Exception as e:
+                out["train_legs"][name] = {
+                    "error": f"{type(e).__name__}: {e}"
+                }
         try:
             out["serving"] = bench_serving()
         except Exception as e:  # serving bench must never sink the line
@@ -36,9 +52,8 @@ def main():
 
 
 def bench_train(on_tpu, dev):
-    from shifu_tpu.models.transformer import Transformer, TransformerConfig
-    from shifu_tpu.train import Adafactor, AdamW, make_train_step
-    from shifu_tpu.train.step import TrainState
+    from shifu_tpu.models.transformer import TransformerConfig
+    from shifu_tpu.train import Adafactor, AdamW
 
     if on_tpu:
         # Measured-best single-chip config (v5e): 1.2B params, pallas
@@ -57,59 +72,102 @@ def bench_train(on_tpu, dev):
         opt = AdamW()
         batch, seq, steps = 2, 128, 3
 
+    leg = _train_leg(cfg, dev, batch=batch, seq=seq, steps=steps, opt=opt)
+    out = {
+        "metric": "train_tokens_per_s",
+        "value": leg.pop("tokens_per_s"),
+        "unit": "tokens/s",
+        "vs_baseline": 1.0,  # reference publishes no numbers (BASELINE.md)
+        **leg,
+        "steps_timed": steps,
+        "device": getattr(dev, "device_kind", dev.platform),
+        "optimizer": type(opt).__name__,
+    }
+    return out
+
+
+def _train_leg(cfg, dev, *, batch, seq, steps=3, opt=None):
+    """One timed train-step leg in its own frame (state freed on exit)."""
+    from shifu_tpu.core.module import param_count
+    from shifu_tpu.models.transformer import Transformer
+    from shifu_tpu.train import Adafactor, make_train_step
+    from shifu_tpu.train.step import TrainState
+    from shifu_tpu.utils.metrics import transformer_flops_per_token
+
     model = Transformer(cfg)
     params = model.init(jax.random.key(0))
+    opt = opt if opt is not None else Adafactor()
     state = TrainState.create(params, opt)
     step = make_train_step(model, opt)
-
-    tokens = jax.random.randint(jax.random.key(1), (batch, seq), 0, cfg.vocab_size)
+    tokens = jax.random.randint(
+        jax.random.key(1), (batch, seq), 0, cfg.vocab_size
+    )
     batch_tree = {"tokens": tokens}
-
-    # Warmup (compile) + one executed step so timing excludes compilation.
-    # Sync via float(): a host round-trip, which (unlike block_until_ready
-    # on the tunnelled axon backend) reliably waits for execution.
     state, metrics = step(step(state, batch_tree)[0], batch_tree)
-    float(metrics["loss"])
-
+    float(metrics["loss"])  # sync (see bench_train timing note)
     t0 = time.perf_counter()
     for _ in range(steps):
         state, metrics = step(state, batch_tree)
     float(metrics["loss"])
     dt = time.perf_counter() - t0
-
-    toks_per_step = batch * (seq - 1)  # loss predicts tokens[:, 1:]
-    tokens_per_s = steps * toks_per_step / dt
-
-    # Model FLOPs: ~6*N per token (fwd+bwd) + attention 12*s*d_head*h*L
-    # (approx; remat adds an extra forward -> factor 8 instead of 6 would be
-    # the "hardware FLOPs" view; MFU conventionally uses the 6N model view).
-    from shifu_tpu.core.module import param_count
-
-    from shifu_tpu.utils.metrics import transformer_flops_per_token
-
+    tokens_per_s = steps * batch * (seq - 1) / dt
     n_params = param_count(params)
-    flops_per_tok = transformer_flops_per_token(
-        n_params, seq, cfg.resolved_head_dim, cfg.n_heads, cfg.n_layers
-    )
-    achieved = tokens_per_s * flops_per_tok
-
     out = {
-        "metric": "train_tokens_per_s",
-        "value": round(tokens_per_s, 1),
-        "unit": "tokens/s",
-        "vs_baseline": 1.0,  # reference publishes no numbers (BASELINE.md)
-        "model_params": n_params,
+        "tokens_per_s": round(tokens_per_s, 1),
+        "step_ms": round(1000 * dt / steps, 2),
         "batch": batch,
         "seq": seq,
-        "steps_timed": steps,
-        "step_ms": round(1000 * dt / steps, 2),
-        "device": getattr(dev, "device_kind", dev.platform),
-        "optimizer": type(opt).__name__,
+        "model_params": n_params,
     }
-    peak = _peak_flops(dev) if on_tpu else None
-    if peak:
-        out["mfu"] = round(achieved / peak, 4)
+    peak = _peak_flops(dev)
+    if peak and not cfg.n_experts:
+        # MFU via the dense 6N+attention model; for MoE the 6N count
+        # would mix active and total params, so the leg reports raw
+        # throughput only. Windowed attention's quadratic term counts
+        # the WINDOW span — crediting full-causal FLOPs would let a
+        # windowed run report impossible MFU.
+        span = min(seq, cfg.window_size or seq)
+        fpt = transformer_flops_per_token(
+            n_params, span, cfg.resolved_head_dim, cfg.n_heads,
+            cfg.n_layers,
+        )
+        out["mfu"] = round(tokens_per_s * fpt / peak, 4)
     return out
+
+
+def bench_train_long(dev):
+    """Long-context leg: the flash-attention kernel at s=8192 (the
+    attention quadratic dominates — re-measures the kernel claim)."""
+    from shifu_tpu.models.transformer import TransformerConfig
+
+    cfg = TransformerConfig.base_1b(
+        attn_impl="flash", remat_policy="full"
+    )
+    return _train_leg(cfg, dev, batch=2, seq=8192)
+
+
+def bench_train_long_windowed(dev):
+    """Sliding-window variant: the kernel's chunk-skip at w=1024 over
+    s=8192 should beat full causal by a wide margin."""
+    from shifu_tpu.models.transformer import TransformerConfig
+
+    cfg = TransformerConfig.base_1b(
+        attn_impl="flash", remat_policy="full", window_size=1024
+    )
+    return _train_leg(cfg, dev, batch=2, seq=8192)
+
+
+def bench_train_moe(dev):
+    """MoE leg: top-2 of 8 experts, dispatch/combine einsums + aux
+    losses on-chip (routing overhead is what this re-measures)."""
+    from shifu_tpu.models.transformer import TransformerConfig
+
+    cfg = TransformerConfig(
+        vocab_size=32_000, dim=1024, n_layers=12, n_heads=16,
+        n_kv_heads=4, mlp_dim=2816, n_experts=8, moe_top_k=2,
+        attn_impl="flash", remat_policy="full",
+    )
+    return _train_leg(cfg, dev, batch=8, seq=2048)
 
 
 def bench_serving():

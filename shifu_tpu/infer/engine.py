@@ -569,11 +569,26 @@ class Engine:
                         best = i if best is None else min(best, i)
                         break
         if req.stop_strings:
-            for k in range(scanned + 1, len(gen) + 1):
-                text = self.tokenizer.decode(gen[:k])
-                if any(s in text for s in req.stop_strings):
-                    best = k if best is None else min(best, k)
-                    break
+            # One full decode per sweep for the (common) no-match case;
+            # only on a hit scan prefixes to locate the exact cut — the
+            # per-request total is then O(n) decodes, not O(n^2). A
+            # decode failure (sampled ids outside the tokenizer's
+            # range) must not escape step() and kill the engine thread
+            # for every client: string stops are simply disabled for
+            # that request (the same degradation the server applies to
+            # its response text).
+            try:
+                if any(
+                    s in self.tokenizer.decode(gen)
+                    for s in req.stop_strings
+                ):
+                    for k in range(scanned + 1, len(gen) + 1):
+                        text = self.tokenizer.decode(gen[:k])
+                        if any(s in text for s in req.stop_strings):
+                            best = k if best is None else min(best, k)
+                            break
+            except Exception:
+                req.stop_strings = None
         if best is None:
             req.stop_scanned = len(gen)
         return best

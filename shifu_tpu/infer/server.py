@@ -47,6 +47,14 @@ from shifu_tpu.infer.engine import Completion, Engine
 from shifu_tpu.infer.sampling import SampleConfig
 
 
+def _trim_stop(text: str, stop_strings) -> str:
+    """Cut the response text at the earliest stop-string match (the
+    engine truncates TOKENS at the match-completing token; the matched
+    text itself is excluded from the response)."""
+    cuts = [text.find(s) for s in stop_strings if text.find(s) >= 0]
+    return text[: min(cuts)] if cuts else text
+
+
 def _parse_sampling(req: dict) -> Optional[SampleConfig]:
     """Per-request sampling fields -> SampleConfig, or None when absent.
     Validation errors (negative temperature etc.) raise ValueError and
@@ -464,14 +472,7 @@ class _Handler(BaseHTTPRequestHandler):
             try:
                 text = self.tokenizer.decode(done.tokens)
                 if done.finished_by == "stop" and stop_strings:
-                    # The engine truncated at the token completing the
-                    # stop; trim the trailing text at the match itself.
-                    cuts = [
-                        text.find(s) for s in stop_strings
-                        if text.find(s) >= 0
-                    ]
-                    if cuts:
-                        text = text[: min(cuts)]
+                    text = _trim_stop(text, stop_strings)
                 out["text"] = text
             except Exception as e:
                 # Sampled ids outside the tokenizer's range (e.g. byte
@@ -539,12 +540,7 @@ class _Handler(BaseHTTPRequestHandler):
                                 payload.finished_by == "stop"
                                 and stop_strings
                             ):
-                                cuts = [
-                                    text.find(s) for s in stop_strings
-                                    if text.find(s) >= 0
-                                ]
-                                if cuts:
-                                    text = text[: min(cuts)]
+                                text = _trim_stop(text, stop_strings)
                             final["text"] = text
                         except Exception:
                             pass

@@ -19,15 +19,31 @@ Gradients flow through ``lax.scan`` + ``ppermute`` by plain autodiff
 rematerialised per ring step so the backward never stores P score
 matrices at once.
 
-Causal note: with contiguous sequence chunks, device i's chunks
+Causal note: with CONTIGUOUS sequence chunks, device i's chunks
 j > i are entirely masked; the fold is skipped via ``lax.cond`` (the
 chunk still rides the ring — other devices need it), so late ring
-steps cost only the ppermute for early devices — the classic causal
-imbalance in time, but not in FLOPs. Sliding windows
-(``window``) extend the same skip: chunks entirely below
-``q_pos - window`` contribute nothing and their fold is skipped too,
-making long-context windowed ring attention O(S * window / P) compute
-per device.
+steps cost only the ppermute for early devices — FLOPs are balanced by
+the skip, but TIME is not: device 0 folds once while device P-1 folds
+P times, and the lockstep ppermutes make everyone wait for the busiest
+device each step.
+
+The ZIGZAG layout fixes the time imbalance: the global sequence is
+split into 2P half-chunks and device i holds half-chunks ``i`` and
+``2P-1-i`` (one early, one late). Per visiting ring chunk the fold
+decomposes into (query half, kv half) PAIRS, each skipped or computed
+by the same positional-relevance rule; causal work per device becomes
+uniform — every device computes exactly 2P+1 half-pair blocks over the
+ring (vs. i+1 full blocks, i.e. 2(i+1) half-pairs, contiguous), and
+per ring step the skew is at most one half-pair instead of a whole
+fold. ``ring_fold_counts`` exposes the analytic per-device counts (the
+same relevance rule the traced code runs) so tests can assert the
+balance. ``ring_attention_sharded(layout="zigzag")`` reorders the
+globally-contiguous sequence into the zigzag placement on entry and
+inverts it on exit, so callers keep contiguous semantics.
+
+Sliding windows extend the same relevance rule: half-pairs entirely
+below ``q_pos - window`` skip, keeping long-context windowed ring
+attention O(S * window / P) compute per device in either layout.
 """
 
 from __future__ import annotations
@@ -71,6 +87,82 @@ def _partial_attention(q, k, v, bias, scale):
     )
 
 
+def _layout_blocks(layout: str, axis_size: int, s_local: int):
+    """Static (lo, hi, chunk_index_fn) list describing how a device's
+    local s_local positions map to global half-chunks.
+
+    contiguous: one block — device d holds global chunk d.
+    zigzag: two half-blocks — device d holds half-chunks d and
+      2P-1-d of the 2P-way split (one early, one late), which is what
+      balances causal work across devices (module docstring).
+    ``chunk_index_fn(d)`` works on python ints AND traced scalars, so
+    the same rule drives the compiled skip conds and the analytic
+    ``ring_fold_counts``.
+    """
+    if layout == "zigzag":
+        hc = s_local // 2
+        return [
+            (0, hc, lambda d: d),
+            (hc, 2 * hc, lambda d: 2 * axis_size - 1 - d),
+        ]
+    if layout == "contiguous":
+        return [(0, s_local, lambda d: d)]
+    raise ValueError(f"unknown ring layout {layout!r}")
+
+
+def _pair_relevant(q_first, q_last, k_first, k_last, causal, window):
+    """Whether a (query block, kv block) pair has ANY visible entry,
+    from the blocks' first/last global positions. Works on python ints
+    (ring_fold_counts) and traced scalars (the lax.cond predicates)."""
+    if not causal:
+        return (
+            jnp.bool_(True)
+            if isinstance(q_first, jax.Array)
+            else True
+        )
+    r = k_first <= q_last
+    if window is not None:
+        r = r & (k_last > q_first - window)
+    return r
+
+
+def ring_fold_counts(
+    layout: str,
+    axis_size: int,
+    s_local: int,
+    *,
+    causal: bool = True,
+    window: Optional[int] = None,
+):
+    """Analytic per-device computed-block counts over a full ring pass,
+    in units of (q block x kv block) pairs actually folded — the SAME
+    relevance rule the compiled code conds on, so tests can assert the
+    zigzag layout's balance without introspecting traced code. Note the
+    units differ between layouts (zigzag blocks are half-sized), so
+    compare balance within a layout, FLOPs across layouts by weighting
+    with block area."""
+    blocks = _layout_blocks(layout, axis_size, s_local)
+    size = {
+        "contiguous": s_local,
+        "zigzag": s_local // 2,
+    }[layout]
+    counts = []
+    for dev in range(axis_size):
+        n = 0
+        for src in range(axis_size):
+            for _, _, q_ci in blocks:
+                for _, _, k_ci in blocks:
+                    q_lo = q_ci(dev) * size
+                    k_lo = k_ci(src) * size
+                    if _pair_relevant(
+                        q_lo, q_lo + size - 1, k_lo, k_lo + size - 1,
+                        causal, window,
+                    ):
+                        n += 1
+        counts.append(n)
+    return counts
+
+
 def ring_attention(
     q,
     k,
@@ -81,21 +173,25 @@ def ring_attention(
     scale: Optional[float] = None,
     segment_ids: Optional[jax.Array] = None,
     window: Optional[int] = None,
+    layout: str = "contiguous",
 ):
     """Per-shard ring attention; call inside shard_map over ``axis_name``.
 
     Args (all local shards; the sequence axis is sharded over the ring):
       q: (b, s_local, h, d).
       k, v: (b, s_local, h_kv, d).
-      causal: causal mask over *global* positions (contiguous chunks:
-        device i holds positions [i*s_local, (i+1)*s_local)).
+      causal: causal mask over *global* positions.
       scale: score scale; defaults to head_dim ** -0.5.
       segment_ids: optional local (b, s_local) packing segments; the KV
         segment shard travels around the ring with its chunk.
       window: sliding-window attention — query i sees keys in
         (i - window, i] in GLOBAL positions. Requires ``causal``.
-        Chunks entirely out of window skip their fold (module
+        Blocks entirely out of window skip their fold (module
         docstring), so compute scales with the window, not S.
+      layout: "contiguous" (device i holds positions
+        [i*s_local, (i+1)*s_local)) or "zigzag" (device i holds global
+        half-chunks i and 2P-1-i — causal time balance; the caller owns
+        placing the data accordingly, e.g. ring_attention_sharded).
 
     Returns: (b, s_local, h, d) in q.dtype.
     """
@@ -104,68 +200,104 @@ def ring_attention(
     axis_size = jax.lax.axis_size(axis_name)
     my = jax.lax.axis_index(axis_name)
     b, s_local, h, d = q.shape
+    if layout == "zigzag" and s_local % 2:
+        raise ValueError("zigzag needs an even per-device sequence")
     if scale is None:
         scale = d**-0.5
     perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
 
-    q_pos = my * s_local + jnp.arange(s_local)       # global query positions
+    blocks = _layout_blocks(layout, axis_size, s_local)
 
-    def fold(m, l, acc, k_cur, v_cur, ks_cur, t):
-        """Merge one visiting KV chunk into the running (m, l, acc)."""
-        src = (my - t) % axis_size                   # chunk's home device
-        kv_pos = src * s_local + jnp.arange(s_local)
+    def block_pos(ci, size):
+        return ci * size + jnp.arange(size)
 
+    def fold_pair(m_b, l_b, acc_b, qb, qseg, qpos, kb, vb, ks_b, kpos):
+        """Merge ONE (q block, kv block) pair into the q block's
+        running (m_b, l_b, acc_b) — all operands are the BLOCK slices,
+        so nothing here scatters (structurally identical to the whole-
+        chunk fold; .at[].set updates of the full carry tripped the
+        shardy partitioner when this shard_map nests under a scanned,
+        rematerialised pjit block)."""
         # Combine masks as booleans and apply NEG_INF exactly once: adding
         # two NEG_INF biases would overflow f32 to -inf, and a fully-masked
         # row then hits exp((-inf) - (-inf)) = NaN in _partial_attention.
-        allowed = jnp.ones((b, s_local, s_local), bool)
+        allowed = jnp.ones((b, qb.shape[1], kb.shape[1]), bool)
         if causal:
             allowed = jnp.logical_and(
-                allowed, (kv_pos[None, :] <= q_pos[:, None])[None]
+                allowed, (kpos[None, :] <= qpos[:, None])[None]
             )
         if window is not None:
             allowed = jnp.logical_and(
-                allowed, (kv_pos[None, :] > q_pos[:, None] - window)[None]
+                allowed, (kpos[None, :] > qpos[:, None] - window)[None]
             )
         if segment_ids is not None:
             allowed = jnp.logical_and(
-                allowed, segment_ids[:, :, None] == ks_cur[:, None, :]
+                allowed, qseg[:, :, None] == ks_b[:, None, :]
             )
         bias = jnp.where(allowed, 0.0, NEG_INF)
 
-        # Partially-masked rows inside a relevant chunk contribute
-        # m_t == NEG_INF; the exp() terms below zero them out. Chunks
-        # masked ENTIRELY (causal future / out of window) never reach
-        # here — maybe_fold skips the fold via lax.cond.
-        acc_t, m_t, l_t = _partial_attention(q, k_cur, v_cur, bias, scale)
-        m_new = jnp.maximum(m, m_t)
-        a_old = jnp.exp(m - m_new)
+        # Partially-masked rows inside a relevant pair contribute
+        # m_t == NEG_INF; the exp() terms below zero them out. Pairs
+        # masked ENTIRELY never reach here (the relevance cond skips).
+        acc_t, m_t, l_t = _partial_attention(qb, kb, vb, bias, scale)
+        m_new = jnp.maximum(m_b, m_t)
+        a_old = jnp.exp(m_b - m_new)
         a_new = jnp.exp(m_t - m_new)
-        acc = acc * a_old[..., None] + acc_t * a_new[..., None]
-        l = l * a_old + l_t * a_new
-        return m_new, l, acc
+        acc_b = acc_b * a_old[..., None] + acc_t * a_new[..., None]
+        l_b = l_b * a_old + l_t * a_new
+        return m_new, l_b, acc_b
 
     def maybe_fold(m, l, acc, k_cur, v_cur, ks_cur, t):
-        """Fold unless the chunk is entirely masked (causal future /
-        fully below the window), in which case pass (m, l, acc) through
-        untouched — lax.cond executes only one branch at runtime, so the
-        skipped chunk costs zero FLOPs (the ppermute still runs; other
-        devices need the chunk)."""
+        """Fold every (q block, kv block) pair of the visiting chunk
+        whose position ranges overlap the mask — lax.cond executes only
+        one branch at runtime, so skipped pairs cost zero FLOPs (the
+        ppermute still runs; other devices need the chunk). Each q
+        block's state folds independently; the carry reassembles by
+        concatenation (single block: passthrough)."""
         src = (my - t) % axis_size
-        relevant = jnp.bool_(True)
-        if causal:
-            relevant = src <= my  # chunk not strictly in the future
-            if window is not None:
-                # Newest key of the chunk still visible to the OLDEST
-                # local query: kv_max > q_min - window.
-                relevant = relevant & (
-                    (src + 1) * s_local - 1 > my * s_local - window
+        size = s_local // len(blocks)
+        parts = []
+        for qlo, qhi, q_ci in blocks:
+            qc = q_ci(my)
+            qpos = block_pos(qc, size)
+            qb = q[:, qlo:qhi]
+            qseg = (
+                segment_ids[:, qlo:qhi]
+                if segment_ids is not None
+                else None
+            )
+            m_b = m[:, qlo:qhi]
+            l_b = l[:, qlo:qhi]
+            acc_b = acc[:, qlo:qhi]
+            for klo, khi, k_ci in blocks:
+                kc = k_ci(src)
+                kpos = block_pos(kc, size)
+                relevant = _pair_relevant(
+                    qc * size, qc * size + size - 1,
+                    kc * size, kc * size + size - 1,
+                    causal, window,
                 )
-        return jax.lax.cond(
-            relevant,
-            lambda ops: fold(*ops),
-            lambda ops: (ops[0], ops[1], ops[2]),
-            (m, l, acc, k_cur, v_cur, ks_cur, t),
+
+                def do(mm, ll, aa, kk, vv, ks, kp,
+                       _qb=qb, _qseg=qseg, _qpos=qpos):
+                    return fold_pair(
+                        mm, ll, aa, _qb, _qseg, _qpos, kk, vv, ks, kp
+                    )
+
+                m_b, l_b, acc_b = jax.lax.cond(
+                    relevant,
+                    do,
+                    lambda mm, ll, aa, kk, vv, ks, kp: (mm, ll, aa),
+                    m_b, l_b, acc_b,
+                    k_cur[:, klo:khi], v_cur[:, klo:khi],
+                    ks_cur[:, klo:khi], kpos,
+                )
+            parts.append((m_b, l_b, acc_b))
+        if len(parts) == 1:
+            return parts[0]
+        return tuple(
+            jnp.concatenate([p[i] for p in parts], axis=1)
+            for i in range(3)
         )
 
     def step(carry, t):
@@ -241,6 +373,22 @@ def ring_shardable(
     )
 
 
+def zigzag_order(seq_len: int, axis_size: int):
+    """Permutation placing a contiguous global sequence into the zigzag
+    layout: position j of the permuted sequence holds original position
+    ``order[j]``; device d's shard (the d-th s_local block of the
+    permuted sequence) then holds half-chunks d and 2P-1-d."""
+    hc = seq_len // (2 * axis_size)
+    order = []
+    for dv in range(axis_size):
+        order.extend(range(dv * hc, (dv + 1) * hc))
+        late = 2 * axis_size - 1 - dv
+        order.extend(range(late * hc, (late + 1) * hc))
+    import numpy as np
+
+    return np.asarray(order, np.int32)
+
+
 def ring_attention_sharded(
     q,
     k,
@@ -254,13 +402,38 @@ def ring_attention_sharded(
     batch_axes=("dp", "fsdp"),
     seq_axis: str = "sp",
     head_axis: str = "tp",
+    layout: str = "contiguous",
 ):
     """shard_map wrapper: global (b, s, h, d) arrays → ring attention.
 
     Batch rides dp/fsdp, sequence rides sp (the ring), heads ride tp —
     attention is per-head so the tp split needs no collective here; only
     sp communicates (neighbour ppermute on the ICI torus).
-    """
+
+    ``layout="zigzag"`` balances causal work across the ring in TIME
+    (module docstring): the globally-contiguous inputs are permuted
+    into the zigzag placement before the shard_map and the output is
+    permuted back, so the caller's semantics don't change. The two
+    permutations are one sharded gather each (XLA lowers them to
+    neighbour exchanges); their cost is linear in S versus the ring's
+    quadratic attention, and buys up to ~2x less tail latency at large
+    P (the contiguous layout's last device folds P blocks while the
+    first folds one)."""
+    if layout == "zigzag":
+        s = q.shape[1]
+        sp_sz = mesh.shape.get(seq_axis, 1)
+        if s % (2 * sp_sz):
+            raise ValueError(
+                f"zigzag needs seq {s} divisible by 2*sp ({2 * sp_sz})"
+            )
+        order = jnp.asarray(zigzag_order(s, sp_sz))
+        inv = jnp.argsort(order)
+        q = jnp.take(q, order, axis=1)
+        k = jnp.take(k, order, axis=1)
+        v = jnp.take(v, order, axis=1)
+        if segment_ids is not None:
+            segment_ids = jnp.take(segment_ids, order, axis=1)
+
     qspec = P(batch_axes, seq_axis, head_axis, None)
     sspec = P(batch_axes, seq_axis)
     in_specs = (qspec, qspec, qspec)
@@ -280,7 +453,10 @@ def ring_attention_sharded(
         segs = rest[0] if rest else None
         return ring_attention(
             q, k, v, axis_name=seq_axis, causal=causal, scale=scale,
-            segment_ids=segs, window=window,
+            segment_ids=segs, window=window, layout=layout,
         )
 
-    return mapped(*args)
+    out = mapped(*args)
+    if layout == "zigzag":
+        out = jnp.take(out, inv, axis=1)
+    return out
