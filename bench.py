@@ -48,6 +48,10 @@ def main():
             out["serving"] = bench_serving()
         except Exception as e:  # serving bench must never sink the line
             out["serving"] = {"error": f"{type(e).__name__}: {e}"}
+        try:
+            out["serving_spec"] = bench_serving_spec()
+        except Exception as e:
+            out["serving_spec"] = {"error": f"{type(e).__name__}: {e}"}
     print(json.dumps(out))
 
 
@@ -296,6 +300,84 @@ def bench_serving():
         ),
     }
     return out
+
+
+def bench_serving_spec():
+    """Speculative serving: the SpeculativePagedEngine vs the plain
+    engine's decode rate, same 1.2B target and mix.
+
+    The draft is the target TRUNCATED to its first 2 layers (shared
+    embed/unembed — the early-exit drafting pattern), so its quality —
+    and therefore the measured ``acceptance_rate`` — is what untrained
+    random weights give; the honest headline is the measured tok/s AT
+    that acceptance plus the round-cost decomposition. With a real
+    (trained) model pair, tokens/round = 1 + k*acceptance while the
+    round cost stays what this leg measures.
+    """
+    import numpy as np
+
+    from shifu_tpu.infer import SampleConfig, SpeculativePagedEngine
+    from shifu_tpu.models.transformer import Transformer, TransformerConfig
+
+    rng = np.random.RandomState(0)
+    cfg = TransformerConfig.base_1b(attn_impl="flash")
+    model = Transformer(cfg)
+    params = jax.tree_util.tree_map(
+        lambda x: x.astype(jnp.bfloat16), model.init(jax.random.key(0))
+    )
+    d_layers = 2
+    draft_cfg = TransformerConfig.base_1b(
+        attn_impl="flash", n_layers=d_layers
+    )
+    draft = Transformer(draft_cfg)
+    draft_params = {
+        "embed": params["embed"],
+        "blocks": jax.tree_util.tree_map(
+            lambda a: a[:d_layers], params["blocks"]
+        ),
+        "final_norm": params["final_norm"],
+        "unembed": params["unembed"],
+    }
+
+    slots, prompt_len, k, rounds = 16, 1900, 4, 50
+    prompts = [
+        rng.randint(1, cfg.vocab_size, size=prompt_len).tolist()
+        for _ in range(slots)
+    ]
+    budget = rounds * (k + 1)
+    eng = SpeculativePagedEngine(
+        model, params, draft, draft_params, k=k,
+        rounds_per_step=rounds, max_slots=slots, max_len=2560,
+        page_size=64, prefill_buckets=(2048, 2560),
+        sample_cfg=SampleConfig(temperature=0.0),
+    )
+    # Warm-up compiles: prefill bucket, draft prefill, the round program.
+    eng.submit(prompts[0], max_new_tokens=budget)
+    for _ in eng.run():
+        pass
+    for p in prompts:
+        eng.submit(p, max_new_tokens=2 * budget)
+    eng.step()  # prefill all + first round chunk
+    before = sum(len(g) for g in eng.live_generated().values())
+    t0 = time.perf_counter()
+    eng.step()
+    dt = time.perf_counter() - t0
+    after = sum(len(g) for g in eng.live_generated().values())
+    emitted = after - before
+    return {
+        "decode_tokens_per_s": round(emitted / dt, 1),
+        "tokens_per_round": round(emitted / (rounds * slots), 3),
+        "acceptance_rate": round(eng.acceptance_rate, 4),
+        "round_ms": round(1000 * dt / rounds, 2),
+        "k": k,
+        "rounds_per_step": rounds,
+        "draft_layers": d_layers,
+        "note": (
+            "draft = target truncated to 2 layers (untrained weights "
+            "-> low acceptance); tokens/round = 1 + k*acceptance, so "
+            "trained-pair throughput scales from round_ms accordingly"
+        ),
+    }
 
 
 if __name__ == "__main__":

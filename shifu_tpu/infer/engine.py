@@ -331,7 +331,7 @@ class Engine:
         done = self._sweep()
         if not self._active:
             return done
-        self._pre_decode(self.decode_chunk)
+        self._pre_decode(self._decode_reach())
         if not self._active:  # paged preemption can clear the field
             return done
 
@@ -341,6 +341,20 @@ class Engine:
             [s in self._active for s in range(self.max_slots)], bool
         )
         self._rng, sub = jax.random.split(self._rng)
+        self._dispatch_decode(cur, lengths, active, sub)
+        done.extend(self._sweep())
+        return done
+
+    def _decode_reach(self) -> int:
+        """Cache positions one decode dispatch may write per row (the
+        _pre_decode page-allocation horizon). Speculative engines
+        override (rounds x (k+1))."""
+        return self.decode_chunk
+
+    def _dispatch_decode(self, cur, lengths, active, sub) -> None:
+        """Run one decode dispatch for all active slots and fold the
+        results into host state. Speculative engines override with the
+        propose/verify round program."""
         if self.decode_chunk == 1:
             nxt, lps, self.cache = self._decode_jit(
                 self.params, self.cache, cur, lengths, active,
@@ -372,8 +386,6 @@ class Engine:
                 req.logprobs.extend(float(x) for x in lps[slot, :n])
                 self._lengths[slot] = int(lengths2[slot])
                 self._cur[slot] = int(cur2[slot])
-        done.extend(self._sweep())
-        return done
 
     def _try_admit(self, req: "_Request") -> bool:
         """Admit ``req`` (a free slot is guaranteed by the caller).

@@ -104,25 +104,20 @@ def row_params(cfg: SampleConfig):
     )
 
 
-def sample_logits_per_row(logits, rng, temperature, top_k, top_p):
-    """Per-row sampling with TRACED hyperparameters — one compiled
-    program serves any mix of greedy / temperature / top-k / top-p
-    rows (the continuous-batching engines' ``per_request_sampling``).
+def filtered_logits_per_row(logits, temperature, top_k, top_p):
+    """Per-row temperature/top-k/top-p filtered logits with TRACED
+    hyperparameters — the per-row counterpart of :func:`filtered_logits`
+    (same composition order, same inclusive-crossing nucleus).
 
     Args:
       logits: (batch, vocab).
-      rng: PRNG key (shared across rows; categorical splits per row).
-      temperature: (batch,) f32 — 0.0 selects greedy argmax for that row.
+      temperature: (batch,) f32 — non-positive rows are scaled at t=1
+        here; the CALLER must treat those rows as greedy (see
+        sample_logits_per_row / the speculative verifier's one-hot).
       top_k: (batch,) int32 — vocab_size (or any >= vocab) disables.
       top_p: (batch,) f32 — 1.0 disables.
-
-    Semantics per row match :func:`sample_logits` with the equivalent
-    static config: temperature scaling, then top-k, then top-p (both
-    thresholds come off ONE descending sort), inclusive-crossing
-    nucleus convention, categorical sample.
     """
     b, v = logits.shape
-    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     t = jnp.where(temperature <= 0.0, 1.0, temperature)[:, None]
     x = logits.astype(jnp.float32) / t
     sorted_desc = jnp.sort(x, axis=-1)[:, ::-1]
@@ -140,7 +135,42 @@ def sample_logits_per_row(logits, rng, temperature, top_k, top_p):
     keep = cum < jnp.clip(top_p, 1e-9, 1.0)[:, None]
     kept = jnp.where(keep, sk, jnp.inf)
     pth = jnp.min(kept, axis=-1, keepdims=True)
-    x = jnp.where(x >= jnp.maximum(kth, pth), x, NEG_INF)
+    return jnp.where(x >= jnp.maximum(kth, pth), x, NEG_INF)
+
+
+def probs_per_row(logits, temperature, top_k, top_p):
+    """The EXACT per-row distribution sample_logits_per_row draws from:
+    greedy rows (t <= 0) are one-hot argmax; the rest softmax their
+    filtered logits. The speculative verifier needs this to accept
+    against each row's CONFIGURED sampler, not some other distribution."""
+    onehot = jax.nn.one_hot(
+        jnp.argmax(logits, axis=-1), logits.shape[-1], dtype=jnp.float32
+    )
+    soft = jax.nn.softmax(
+        filtered_logits_per_row(logits, temperature, top_k, top_p),
+        axis=-1,
+    )
+    return jnp.where((temperature <= 0.0)[:, None], onehot, soft)
+
+
+def sample_logits_per_row(logits, rng, temperature, top_k, top_p):
+    """Per-row sampling with TRACED hyperparameters — one compiled
+    program serves any mix of greedy / temperature / top-k / top-p
+    rows (the continuous-batching engines' ``per_request_sampling``).
+
+    Args:
+      logits: (batch, vocab).
+      rng: PRNG key (shared across rows; categorical splits per row).
+      temperature: (batch,) f32 — 0.0 selects greedy argmax for that row.
+      top_k: (batch,) int32 — vocab_size (or any >= vocab) disables.
+      top_p: (batch,) f32 — 1.0 disables.
+
+    Semantics per row match :func:`sample_logits` with the equivalent
+    static config (the shared :func:`filtered_logits_per_row` does the
+    filtering), then a categorical sample; greedy rows take argmax.
+    """
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    x = filtered_logits_per_row(logits, temperature, top_k, top_p)
     sampled = jax.random.categorical(rng, x, axis=-1).astype(jnp.int32)
     # One convention for non-positive temperatures: t <= 0 is greedy, both
     # in the scaling guard above and in this final select (a negative

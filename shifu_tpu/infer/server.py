@@ -278,7 +278,8 @@ class EngineRunner:
             out["fatal"] = repr(self.fatal)
         for attr in (
             "free_pages", "n_pages", "preemptions", "prefix_hits_tokens",
-            "cancellations",
+            "cancellations", "spec_proposed", "spec_accepted",
+            "acceptance_rate",
         ):
             if hasattr(eng, attr):
                 out[attr] = getattr(eng, attr)

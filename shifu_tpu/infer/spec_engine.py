@@ -1,0 +1,370 @@
+"""Speculative decoding inside the paged serving engine.
+
+The standalone drivers (infer/speculative.py) prove the round machinery
+— draft proposes K tokens, the target verifies the whole chunk in one
+memory-bound forward, the rejection rule keeps the target's exact
+distribution. This module folds those rounds into the CONTINUOUS
+BATCHING engine, where they matter for the serving product:
+
+  * the TARGET keeps its paged KV pool untouched — verification uses
+    the pool's new batch-chunk shape (models/transformer.py
+    ``_paged_block_attention``: per-row multi-token scatter + gathered
+    slot-space attention), so paging/preemption/prefix caching all
+    compose;
+  * the DRAFT gets a per-slot DENSE cache beside the pool (draft
+    models are small — its worst case is max_slots x max_len of a
+    narrow kv), prefilled at admission (and re-prefilled after
+    preemption's recompute, by construction: admission always runs the
+    draft prefill);
+  * each engine ``step()`` runs ``rounds_per_step`` complete
+    propose/verify rounds ON DEVICE (one dispatch, one host sync) with
+    per-row ragged progress: every row advances by its own accepted
+    prefix + bonus, freezes at eos/budget, and rejected positions hold
+    stale K/V that slot-space causality masks until the next round's
+    chunk write covers them (the same watermark argument as the
+    standalone driver — writes land before any read can see the slot);
+  * sampling composes: with ``per_request_sampling`` the verifier
+    accepts against each row's CONFIGURED distribution
+    (sampling.probs_per_row — the same filtering sample_logits_per_row
+    draws from); engine-level greedy degrades to exact token matching,
+    so greedy speculative output == the non-speculative engine token
+    for token (tested).
+
+Acceptance statistics (``spec_proposed`` / ``spec_accepted``) feed the
+server's /healthz.
+
+Reference parity note: the upstream reference (klyan/shifu) is an empty
+repository (SURVEY.md); there is no reference engine to match. The
+rejection rule is the published Leviathan/Chen scheme, re-expressed for
+static shapes and ragged rows.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from shifu_tpu.infer.engine import PagedEngine, _token_logprob
+from shifu_tpu.infer.sampling import probs_per_row
+from shifu_tpu.infer.speculative import _probs
+
+
+class SpeculativePagedEngine(PagedEngine):
+    """PagedEngine whose decode dispatch is draft-assisted.
+
+    Usage::
+
+        eng = SpeculativePagedEngine(
+            target, target_params, draft, draft_params,
+            k=4, max_slots=8, max_len=1024, ...
+        )
+
+    ``k``: draft tokens proposed per round (a round nets 1..k+1 tokens
+    per row). ``rounds_per_step``: rounds per engine step — one
+    compiled program and ONE host sync regardless (the speculative
+    analogue of ``decode_chunk``, which this engine therefore forbids).
+    """
+
+    def __init__(
+        self,
+        model,
+        params,
+        draft,
+        draft_params,
+        *,
+        k: int = 4,
+        rounds_per_step: int = 1,
+        **kw,
+    ):
+        if kw.get("decode_chunk", 1) != 1:
+            raise ValueError(
+                "speculative engines advance multiple tokens per round "
+                "already; use rounds_per_step, not decode_chunk"
+            )
+        if getattr(draft, "prefill_needs_mask", False):
+            raise NotImplementedError(
+                "recurrent draft models cannot roll back rejected tokens"
+            )
+        if k < 1 or rounds_per_step < 1:
+            raise ValueError("k and rounds_per_step must be >= 1")
+        if kw.get("mesh") is not None:
+            raise NotImplementedError(
+                "speculative serving on a mesh needs a sharded draft "
+                "cache; serve tensor-parallel with PagedEngine for now"
+            )
+        self.draft = draft
+        self.draft_params = draft_params
+        self.k = int(k)
+        self.rounds_per_step = int(rounds_per_step)
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+        super().__init__(model, params, **kw)
+        # Dense per-slot draft cache. Rounds write up to k slots past a
+        # row's final token (the chunk is always k+1 wide) — pad the
+        # length so those stale writes never clamp onto real slots.
+        self.d_cache = draft.init_cache(
+            self.max_slots, self.max_len + self.k + 1
+        )
+        self._draft_prefill_jit = jax.jit(
+            self._in_act_ctx(self._draft_prefill_impl),
+            static_argnames=("bucket",),
+            donate_argnums=(1,),
+        )
+        self._spec_jit = jax.jit(
+            self._in_act_ctx(self._spec_impl), donate_argnums=(1, 2)
+        )
+
+    # ------------------------------------------------------------ admission
+    def _finish_admission(self, req, slot, p, first, lp) -> None:
+        # The draft mirrors the target's resident prompt (positions
+        # 0..p-1). Runs on EVERY admission — including the recompute
+        # re-prefill after preemption — so the draft cache can never be
+        # stale relative to the pool.
+        prompt = (req.tokens + req.generated)[:p]
+        self._draft_prefill(slot, prompt)
+        super()._finish_admission(req, slot, p, first, lp)
+
+    def _draft_prefill(self, slot: int, prompt) -> None:
+        """Write the whole prompt into the draft's row, largest-bucket
+        chunks at a time (the draft is cheap; chunking only bounds the
+        compiled shapes to the engine's existing buckets)."""
+        at = 0
+        while at < len(prompt):
+            n_chunk = min(self.buckets[-1], len(prompt) - at)
+            bucket = self._bucket_for(n_chunk)
+            padded = np.zeros((bucket,), np.int32)
+            padded[:n_chunk] = prompt[at : at + n_chunk]
+            self.d_cache = self._draft_prefill_jit(
+                self.draft_params,
+                self.d_cache,
+                jnp.asarray(padded),
+                jnp.int32(n_chunk),
+                jnp.int32(at),
+                jnp.int32(slot),
+                bucket=bucket,
+            )
+            at += n_chunk
+
+    def _draft_prefill_impl(
+        self, d_params, d_cache, tokens, length, offset, slot, *, bucket
+    ):
+        row = jax.tree_util.tree_map(
+            lambda c: jax.lax.dynamic_slice_in_dim(c, slot, 1, axis=1),
+            d_cache,
+        )
+        _, row = self.draft(
+            d_params,
+            tokens[None, :],
+            positions=(
+                offset + jnp.minimum(jnp.arange(bucket), length - 1)
+            )[None, :],
+            cache=row,
+            cache_index=offset,
+        )
+        return jax.tree_util.tree_map(
+            lambda c, r: jax.lax.dynamic_update_slice_in_dim(
+                c, r, slot, axis=1
+            ),
+            d_cache,
+            row,
+        )
+
+    # -------------------------------------------------------------- decode
+    def _decode_reach(self) -> int:
+        return self.rounds_per_step * (self.k + 1)
+
+    def _dispatch_decode(self, cur, lengths, active, sub) -> None:
+        remaining = np.zeros((self.max_slots,), np.int32)
+        for slot, req in self._active.items():
+            remaining[slot] = req.max_new_tokens - len(req.generated)
+        (
+            outs, lps, n_accs, ms, lives,
+            cur2, lengths2, self.cache, self.d_cache,
+        ) = self._spec_jit(
+            self.params, self.cache, self.d_cache, self.draft_params,
+            cur, lengths, active,
+            jnp.asarray(remaining), jnp.asarray(self._table),
+            *self._sampling_args(), sub,
+        )
+        outs, lps = np.asarray(outs), np.asarray(lps)
+        n_accs, ms = np.asarray(n_accs), np.asarray(ms)
+        lives = np.asarray(lives)
+        cur2, lengths2 = np.asarray(cur2), np.asarray(lengths2)
+        for slot, req in self._active.items():
+            for r in range(self.rounds_per_step):
+                n = int(n_accs[r, slot])
+                req.generated.extend(int(t) for t in outs[r, slot, :n])
+                req.logprobs.extend(float(x) for x in lps[r, slot, :n])
+                if lives[r, slot]:
+                    self.spec_proposed += self.k
+                    self.spec_accepted += int(ms[r, slot])
+            self._lengths[slot] = int(lengths2[slot])
+            self._cur[slot] = int(cur2[slot])
+
+    @property
+    def acceptance_rate(self) -> float:
+        return (
+            self.spec_accepted / self.spec_proposed
+            if self.spec_proposed
+            else 0.0
+        )
+
+    def _spec_impl(
+        self, params, cache, d_cache, d_params, cur, lengths, active,
+        remaining, table, *rest,
+    ):
+        """``rounds_per_step`` propose/verify rounds, one program.
+
+        Returns per-round (out tokens (R, b, k+1), their raw-model
+        logprobs, accepted counts (R, b), draft-accept counts, live
+        masks) plus the final cur/lengths and both caches.
+
+        ``d_params`` rides as an ARGUMENT, never a closure: closed-over
+        weights embed as program constants, and shipping hundreds of MB
+        of constants breaks the remote-compile path (HTTP 413) besides
+        duplicating the params in HBM.
+        """
+        *samp, rng = rest
+        k, rounds = self.k, self.rounds_per_step
+        eos = self.eos_id
+
+        def probs2(logits2d):
+            """(rows, V) -> each row's configured sampling distribution
+            (the EXACT one the non-speculative engine draws from)."""
+            if samp:
+                t, kk, pp = samp
+                reps = logits2d.shape[0] // t.shape[0]
+                return probs_per_row(
+                    logits2d,
+                    jnp.repeat(t, reps),
+                    jnp.repeat(kk, reps),
+                    jnp.repeat(pp, reps),
+                )
+            return _probs(logits2d, self.sample_cfg)
+
+        def round_body(carry, rsub):
+            cache, d_cache, cur, n, rem, done = carry
+            live = active & ~done & (rem > 0)
+            r_d, r_a, r_b = jax.random.split(rsub, 3)
+
+            # ---- draft: K cheap autoregressive steps ----------------
+            def dbody(c, sub):
+                d_cache, tok, idx = c
+                lg, d_cache = self.draft(
+                    d_params, tok[:, None], cache=d_cache, cache_index=idx
+                )
+                p = probs2(lg[:, -1])
+                nxt = jax.random.categorical(
+                    sub, jnp.log(jnp.maximum(p, 1e-38))
+                ).astype(jnp.int32)
+                return (d_cache, nxt, idx + 1), (nxt, p)
+
+            (d_cache, _, _), (d_toks, d_probs) = jax.lax.scan(
+                dbody, (d_cache, cur, n), jax.random.split(r_d, k)
+            )
+
+            # ---- target: verify the whole chunk in one forward ------
+            chunk = jnp.concatenate(
+                [cur[:, None], d_toks.T.astype(jnp.int32)], axis=1
+            )
+            lg, cache = self.model(
+                params, chunk, cache=cache, cache_index=n,
+                page_table=table,
+            )
+            b, width, V = lg.shape
+            probs = probs2(lg.reshape(b * width, V)).reshape(b, width, V)
+
+            # ---- rejection rule (Leviathan/Chen) --------------------
+            d_toks_bt = d_toks.T  # (b, k)
+            rowix = jnp.arange(b)[:, None]
+            colix = jnp.arange(k)[None, :]
+            p_t = probs[rowix, colix, d_toks_bt]
+            d_probs_bkv = jnp.moveaxis(d_probs, 1, 0)  # (b, k, V)
+            q_t = d_probs_bkv[rowix, colix, d_toks_bt]
+            u = jax.random.uniform(r_a, (b, k))
+            ok = u < jnp.minimum(1.0, p_t / jnp.maximum(q_t, 1e-20))
+            m = jnp.argmin(
+                jnp.concatenate([ok, jnp.zeros((b, 1), bool)], axis=1),
+                axis=1,
+            ).astype(jnp.int32)
+            p_at_m = jnp.take_along_axis(probs, m[:, None, None], axis=1)[
+                :, 0
+            ]
+            p_d_at_m = jnp.where(
+                (m < k)[:, None],
+                jnp.take_along_axis(
+                    d_probs_bkv,
+                    jnp.minimum(m, k - 1)[:, None, None],
+                    axis=1,
+                )[:, 0],
+                0.0,
+            )
+            residual = jnp.maximum(p_at_m - p_d_at_m, 0.0)
+            rsum = residual.sum(axis=-1, keepdims=True)
+            residual = jnp.where(rsum > 0, residual / rsum, p_at_m)
+            bonus = jax.random.categorical(
+                r_b, jnp.log(jnp.maximum(residual, 1e-38))
+            ).astype(jnp.int32)
+            out = jnp.concatenate(
+                [d_toks_bt, jnp.zeros((b, 1), d_toks_bt.dtype)], axis=1
+            )
+            out = jnp.where(
+                jnp.arange(k + 1)[None, :] == m[:, None],
+                bonus[:, None],
+                out,
+            )
+            # Raw-model logprob of each emitted token (the engine's
+            # logprobs surface), from the verify logits we already have.
+            raw_lp = _token_logprob(
+                lg.reshape(b * width, V), out.reshape(b * width)
+            ).reshape(b, width)
+
+            # ---- draft ingests its own d_k (slot n + k) -------------
+            _, d_cache = self.draft(
+                d_params,
+                d_toks[k - 1][:, None].astype(jnp.int32),
+                cache=d_cache,
+                cache_index=n + k,
+            )
+
+            # ---- per-row emitted count: eos + budget ----------------
+            n_acc = m + 1
+            if eos is not None:
+                iseos = out == eos
+                first_eos = jnp.min(
+                    jnp.where(
+                        iseos, jnp.arange(k + 1)[None, :], k + 1
+                    ),
+                    axis=1,
+                ).astype(jnp.int32)
+                n_acc = jnp.minimum(n_acc, first_eos + 1)
+                hit_eos = first_eos < n_acc
+            else:
+                hit_eos = jnp.zeros((b,), bool)
+            n_acc = jnp.minimum(n_acc, rem)
+            n_acc = jnp.where(live, n_acc, 0)
+            done = done | (live & (hit_eos | (rem - n_acc <= 0)))
+            new_cur = jnp.take_along_axis(
+                out, jnp.maximum(n_acc - 1, 0)[:, None], axis=1
+            )[:, 0]
+            cur = jnp.where(n_acc > 0, new_cur, cur)
+            n = n + n_acc
+            rem = rem - n_acc
+            return (
+                (cache, d_cache, cur, n, rem, done),
+                (out, raw_lp, n_acc, m, live),
+            )
+
+        done0 = jnp.zeros((self.max_slots,), bool)
+        (cache, d_cache, cur, n, _, _), (outs, lps, n_accs, ms, lives) = (
+            jax.lax.scan(
+                round_body,
+                (cache, d_cache, cur, lengths, remaining, done0),
+                jax.random.split(rng, rounds),
+            )
+        )
+        return outs, lps, n_accs, ms, lives, cur, n, cache, d_cache

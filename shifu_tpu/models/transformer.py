@@ -401,7 +401,7 @@ class Transformer(Module):
         lands there). Logical position t of row b lives at
         pool[layer, table[b, t // ps], t % ps].
 
-        Three call shapes, mirroring the dense path:
+        Four call shapes, mirroring the dense path:
           * prefill (q_len > 1, cache_index == 0, the static int): k/v
             for the whole bucket scatter to this row's pages in one
             batched write (q_len % page_size == 0 enforced by the
@@ -418,6 +418,13 @@ class Transformer(Module):
             scatter at (table[b, t//ps], t%ps), then attention over the
             row's gathered pages with the same slot-space masking as the
             dense cache (_decode_attention).
+          * BATCH CHUNK (q_len > 1, cache_index a (b,) vector): every
+            row writes q_len consecutive tokens starting at its own
+            offset — positions freely cross page boundaries (per-token
+            (phys, off) scatter indices) — then attends over its
+            gathered pages with slot-space causality (queries at
+            n..n+q_len-1). This is the speculative-verify shape: K+1
+            positions for one memory-bound pass.
         """
         b, q_len, _, _ = q.shape
         _, n_pages, ps, n_kv, hd = pool["k"].shape
@@ -437,6 +444,53 @@ class Transformer(Module):
             vc = v.astype(pool["v"].dtype)
         csk = pool.get("k_scale")
         csv = pool.get("v_scale")
+
+        if q_len > 1 and getattr(cache_index, "ndim", 0) == 1:
+            # BATCH CHUNK: per-row multi-token scatter + slot-space
+            # attention (docstring). No page-alignment requirement —
+            # per-token scatter indices cross page boundaries freely.
+            pos = cache_index[:, None] + jnp.arange(q_len)[None, :]
+            rows = jnp.arange(b)[:, None]
+            # Positions past the row's logical capacity go to SCRATCH
+            # page 0 (never read), not to a clamped table column: XLA
+            # clamps out-of-bounds gather indices, and the last column
+            # holds the row's real last page — a speculative verifier
+            # writing its full k+1-wide chunk near max_len would
+            # otherwise overwrite real cached K/V that this same pass
+            # then attends over.
+            in_range = pos < pages_per_row * ps
+            phys = jnp.where(
+                in_range,
+                page_table[
+                    rows, jnp.minimum(pos // ps, pages_per_row - 1)
+                ],
+                0,
+            )  # (b, q_len)
+            off = pos % ps
+            kw_, vw_ = kc, vc
+            if quantized:
+                kw_, ksw_ = quantize_kv(kw_)
+                vw_, vsw_ = quantize_kv(vw_)
+                csk = csk.at[li, phys, off].set(ksw_)
+                csv = csv.at[li, phys, off].set(vsw_)
+            ck = pool["k"].at[li, phys, off].set(kw_)
+            cv = pool["v"].at[li, phys, off].set(vw_)
+            gk = ck[li, page_table]
+            gv = cv[li, page_table]
+            if quantized:
+                gk = dequantize_kv(gk, csk[li, page_table], q.dtype)
+                gv = dequantize_kv(gv, csv[li, page_table], q.dtype)
+            gk = gk.reshape(b, pages_per_row * ps, n_kv, hd)
+            gv = gv.reshape(b, pages_per_row * ps, n_kv, hd)
+            attn = _decode_attention(
+                q, gk, gv, cache_index, self.cfg.attn_impl,
+                kv_mask=kv_mask, window=self.cfg.window_size,
+            )
+            new_pool = {"k": ck, "v": cv}
+            if quantized:
+                new_pool["k_scale"] = csk
+                new_pool["v_scale"] = csv
+            return attn, new_pool
 
         if q_len > 1:
             if q_len % ps:

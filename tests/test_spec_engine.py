@@ -1,0 +1,252 @@
+"""Speculative decoding inside the paged engine: exactness + stats.
+
+The load-bearing property: with greedy sampling the speculative engine
+must emit EXACTLY the non-speculative engine's tokens (the rejection
+rule degrades to token matching), whatever the draft proposes. With
+draft == target, every greedy proposal matches, so acceptance must be
+100% — pinning the accept bookkeeping. Composition tests cover chunked
+prefill, prefix caching, preemption-recompute, int8 KV pools and
+per-request sampling.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from shifu_tpu.infer import PagedEngine, SampleConfig, SpeculativePagedEngine
+from shifu_tpu.models import Transformer, TransformerConfig
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = TransformerConfig.tiny()
+    model = Transformer(cfg)
+    return model, model.init(jax.random.key(0))
+
+
+@pytest.fixture(scope="module")
+def tiny_draft():
+    cfg = TransformerConfig.tiny(n_layers=1, dim=32, mlp_dim=64)
+    model = Transformer(cfg)
+    return model, model.init(jax.random.key(9))
+
+
+_KW = dict(
+    max_slots=2, max_len=64, page_size=8, prefill_buckets=(16, 32, 64),
+    sample_cfg=SampleConfig(temperature=0.0),
+)
+
+
+def _run(eng, prompts, max_new, **skw):
+    rids = [eng.submit(p, max_new_tokens=max_new, **skw) for p in prompts]
+    out = {c.rid: c for c in eng.run()}
+    return [out[r] for r in rids]
+
+
+def _prompts(seed, sizes):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(1, 256, size=n).tolist() for n in sizes]
+
+
+@pytest.mark.parametrize("k,rounds", [(3, 1), (2, 2), (4, 1)])
+def test_spec_greedy_matches_plain_engine(tiny, tiny_draft, k, rounds):
+    model, params = tiny
+    draft, d_params = tiny_draft
+    prompts = _prompts(0, (5, 11))
+    ref = _run(PagedEngine(model, params, **_KW), prompts, 9)
+    spec = _run(
+        SpeculativePagedEngine(
+            model, params, draft, d_params, k=k,
+            rounds_per_step=rounds, **_KW,
+        ),
+        prompts, 9,
+    )
+    for a, b in zip(ref, spec):
+        assert a.tokens == b.tokens
+        np.testing.assert_allclose(
+            a.logprobs, b.logprobs, rtol=1e-4, atol=1e-4
+        )
+
+
+def test_spec_draft_equals_target_accepts_everything(tiny):
+    model, params = tiny
+    prompts = _prompts(1, (7,))
+    eng = SpeculativePagedEngine(
+        model, params, model, params, k=3, **_KW
+    )
+    (done,) = _run(eng, prompts, 8)
+    ref = _run(PagedEngine(model, params, **_KW), prompts, 8)
+    assert done.tokens == ref[0].tokens
+    assert eng.spec_proposed > 0
+    assert eng.acceptance_rate == 1.0  # greedy self-draft: all accepted
+
+
+def test_spec_eos_stops_exactly(tiny, tiny_draft):
+    model, params = tiny
+    draft, d_params = tiny_draft
+    prompts = _prompts(2, (6,))
+    ref = _run(PagedEngine(model, params, **_KW), prompts, 10)
+    eos = ref[0].tokens[4]  # force an "eos" the generation will hit
+    kw = dict(_KW, eos_id=eos)
+    ref2 = _run(PagedEngine(model, params, **kw), prompts, 10)
+    spec = _run(
+        SpeculativePagedEngine(
+            model, params, draft, d_params, k=3, rounds_per_step=2, **kw
+        ),
+        prompts, 10,
+    )
+    assert spec[0].tokens == ref2[0].tokens
+    assert spec[0].finished_by == "eos"
+
+
+def test_spec_with_chunked_prefill_and_prefix_cache(tiny, tiny_draft):
+    model, params = tiny
+    draft, d_params = tiny_draft
+    rng = np.random.RandomState(3)
+    shared = rng.randint(1, 256, size=16).tolist()
+    prompts = [shared + rng.randint(1, 256, size=4).tolist()
+               for _ in range(2)]
+    kw = dict(
+        _KW, prefill_chunk=8, enable_prefix_cache=True,
+        prefill_buckets=(8, 16, 32, 64),
+    )
+    ref = _run(PagedEngine(model, params, **kw), prompts, 6)
+    spec = _run(
+        SpeculativePagedEngine(
+            model, params, draft, d_params, k=2, **kw
+        ),
+        prompts, 6,
+    )
+    for a, b in zip(ref, spec):
+        assert a.tokens == b.tokens
+
+
+def test_spec_preemption_recompute_parity(tiny, tiny_draft):
+    """A pool too small for both rows forces preemption + recompute;
+    the draft cache re-prefills at re-admission, so tokens still match
+    the unconstrained engine."""
+    model, params = tiny
+    draft, d_params = tiny_draft
+    prompts = _prompts(4, (9, 13))
+    ref = _run(PagedEngine(model, params, **_KW), prompts, 8)
+    kw = dict(_KW, n_pages=9)  # tight: forces eviction mid-flight
+    eng = SpeculativePagedEngine(
+        model, params, draft, d_params, k=2, **kw
+    )
+    spec = _run(eng, prompts, 8)
+    for a, b in zip(ref, spec):
+        assert a.tokens == b.tokens
+
+
+def test_spec_int8_kv_pool(tiny, tiny_draft):
+    model, params = tiny
+    draft, d_params = tiny_draft
+    prompts = _prompts(5, (6, 10))
+    kw = dict(_KW, cache_dtype=jnp.int8)
+    ref = _run(PagedEngine(model, params, **kw), prompts, 7)
+    spec = _run(
+        SpeculativePagedEngine(
+            model, params, draft, d_params, k=3, **kw
+        ),
+        prompts, 7,
+    )
+    for a, b in zip(ref, spec):
+        assert a.tokens == b.tokens
+
+
+def test_spec_per_request_sampling_greedy_rows_exact(tiny, tiny_draft):
+    """per_request_sampling on: a greedy row must still match the
+    non-speculative engine exactly even while its neighbour samples."""
+    model, params = tiny
+    draft, d_params = tiny_draft
+    prompts = _prompts(6, (5, 8))
+    kw = dict(_KW, per_request_sampling=True)
+    ref = _run(PagedEngine(model, params, **kw), [prompts[0]], 7)
+    eng = SpeculativePagedEngine(
+        model, params, draft, d_params, k=2, **kw
+    )
+    r0 = eng.submit(prompts[0], max_new_tokens=7)  # engine-level greedy
+    r1 = eng.submit(
+        prompts[1], max_new_tokens=7,
+        sampling=SampleConfig(temperature=0.9, top_k=40),
+    )
+    out = {c.rid: c for c in eng.run()}
+    assert out[r0].tokens == ref[0].tokens
+    assert len(out[r1].tokens) == 7
+    assert all(0 <= t < 256 for t in out[r1].tokens)
+
+
+def test_spec_rejects_decode_chunk_and_mesh(tiny, tiny_draft):
+    model, params = tiny
+    draft, d_params = tiny_draft
+    with pytest.raises(ValueError, match="rounds_per_step"):
+        SpeculativePagedEngine(
+            model, params, draft, d_params, decode_chunk=4, **_KW
+        )
+    import jax as _jax
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(_jax.devices()[:1]), ("tp",))
+    with pytest.raises(NotImplementedError, match="mesh"):
+        SpeculativePagedEngine(
+            model, params, draft, d_params, mesh=mesh, **_KW
+        )
+
+
+def test_spec_chunk_write_at_max_len_boundary(tiny, tiny_draft):
+    """A row whose budget ends within k of max_len: the verifier's
+    full-width chunk writes past the row's capacity — those must land
+    on scratch, not clamp onto the row's last real page (which would
+    corrupt cached K/V the same pass attends over)."""
+    model, params = tiny
+    draft, d_params = tiny_draft
+    kw = dict(
+        max_slots=1, max_len=24, page_size=8, prefill_buckets=(8, 16, 24),
+        sample_cfg=SampleConfig(temperature=0.0),
+    )
+    prompts = _prompts(7, (15,))  # 15 + 9 = 24 == max_len exactly
+    ref = _run(PagedEngine(model, params, **kw), prompts, 9)
+    spec = _run(
+        SpeculativePagedEngine(
+            model, params, draft, d_params, k=4, **kw
+        ),
+        prompts, 9,
+    )
+    assert spec[0].tokens == ref[0].tokens
+
+
+def test_spec_healthz_stats(tiny, tiny_draft):
+    import json
+    import threading
+    import urllib.request
+
+    from shifu_tpu.infer import make_server
+
+    model, params = tiny
+    draft, d_params = tiny_draft
+    eng = SpeculativePagedEngine(
+        model, params, draft, d_params, k=2, **_KW
+    )
+    server = make_server(eng, port=0)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    try:
+        base = f"http://127.0.0.1:{server.server_port}"
+        req = urllib.request.Request(
+            base + "/v1/completions",
+            data=json.dumps(
+                {"tokens": [1, 2, 3], "max_new_tokens": 6}
+            ).encode(),
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=120) as r:
+            assert r.status == 200
+        with urllib.request.urlopen(base + "/healthz", timeout=30) as r:
+            stats = json.loads(r.read())
+        assert stats["spec_proposed"] > 0
+        assert 0.0 <= stats["acceptance_rate"] <= 1.0
+    finally:
+        server.shutdown()
+        server.runner.shutdown()
+        t.join(5)
