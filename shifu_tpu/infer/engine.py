@@ -829,7 +829,7 @@ class PagedEngine(Engine):
                 "recurrent models carry O(1) state per slot — a paged KV "
                 "pool only makes sense for attention caches; use Engine"
             )
-        if enable_prefix_cache or prefill_chunk:
+        if enable_prefix_cache:
             scaling = getattr(
                 getattr(model, "cfg", None), "rope_scaling", None
             )
@@ -837,18 +837,15 @@ class PagedEngine(Engine):
             if kind in ("dynamic", "longrope"):
                 # Cached prefix K was rotated under the DONOR's length
                 # regime; a different-length borrower would need
-                # different frequencies — reuse would be silently wrong.
-                # Chunked prefill has the same unsoundness: an early
-                # chunk's keys would bake in a shorter-length regime
-                # than the prompt's final length.
-                feature = (
-                    "prefix caching" if enable_prefix_cache
-                    else "chunked prefill"
-                )
+                # different frequencies — reuse would be silently
+                # wrong. (Chunked prefill is fine: each chunk passes
+                # the prompt's FINAL length as rope_regime_len, so all
+                # chunks bake the same frequencies the one-shot
+                # prefill would — see _prefill_at_impl.)
                 raise ValueError(
-                    f"{feature} is unsound with length-sensitive "
-                    f"rope_scaling {kind!r}: cached keys bake in a "
-                    "shorter frequency regime than the final length"
+                    f"prefix caching is unsound with length-sensitive "
+                    f"rope_scaling {kind!r}: cached keys bake in the "
+                    "donor's frequency regime, not the borrower's"
                 )
         if prefill_chunk is not None:
             if prefill_chunk < page_size or prefill_chunk % page_size:
@@ -1180,7 +1177,8 @@ class PagedEngine(Engine):
         samp = self._req_sampling_args(req)
         if hit:
             first, lp = self._dispatch_prefill_at(
-                slot, padded, len(suffix), hit, bucket, sub, samp=samp
+                slot, padded, len(suffix), hit, bucket, sub, samp=samp,
+                final_len=p,
             )
             self.prefix_hits_tokens += hit
         else:
@@ -1275,6 +1273,7 @@ class PagedEngine(Engine):
                 slot, padded, this_chunk, off, bucket, sub,
                 row=row[: self.pages_per_slot] if narrow else row,
                 samp=self._req_sampling_args(req),
+                final_len=len(prompt),
             )
             # Bucket-tail pages hold only masked garbage; return them.
             keep = -(-this_chunk // ps)
@@ -1309,13 +1308,16 @@ class PagedEngine(Engine):
         return first, lp
 
     def _dispatch_prefill_at(self, slot, padded, suffix_len, offset, bucket,
-                             rng, row=None, samp=()):
+                             rng, row=None, samp=(), final_len=None):
         first, lp, self.cache = self._prefill_at_jit(
             self.params,
             self.cache,
             jnp.asarray(padded),
             jnp.int32(suffix_len),
             jnp.int32(offset),
+            jnp.int32(
+                final_len if final_len is not None else offset + suffix_len
+            ),
             jnp.asarray(self._table[slot] if row is None else row),
             *samp,
             rng,
@@ -1324,12 +1326,20 @@ class PagedEngine(Engine):
         return first, lp
 
     def _prefill_at_impl(self, params, cache, tokens, length, offset,
-                         table_row, *rest, bucket):
-        """SUFFIX prefill after a prefix-cache hit: the row's leading
-        pages already hold the shared prefix; write the suffix's pages
-        at the (page-aligned) offset and attend over the gathered pages
-        with slot-space causality, so suffix queries see the prefix.
-        ``rest`` = optional per-request sampling triple, then rng."""
+                         final_len, table_row, *rest, bucket):
+        """SUFFIX prefill at a page-aligned traced offset — the chunked
+        prefill's mid-prompt chunks, and the prefix-cache hit's suffix
+        (the row's leading pages already hold the shared prefix). Writes
+        land at offset onward; attention runs over the gathered pages
+        with slot-space causality, so queries see what is cached below.
+
+        ``final_len``: the PROMPT's final length, known at admission —
+        the length-sensitive rope scalings (dynamic NTK, longrope) key
+        their frequency regime off it, so every chunk bakes the same
+        frequencies a one-shot prefill of the whole prompt would (a
+        mid-prompt chunk's own max position would pick a shorter, WRONG
+        regime). ``rest`` = optional per-request sampling triple, then
+        rng."""
         *samp, rng = rest
         pos = jnp.minimum(
             offset + jnp.arange(bucket), offset + length - 1
@@ -1342,6 +1352,7 @@ class PagedEngine(Engine):
             cache_index=offset,
             page_table=table_row[None, :],
             logits_at=(length - 1)[None],
+            rope_regime_len=final_len,
         )
         tok = self._sample_rows(logits[:, 0], rng, tuple(samp))[0]
         lp = _token_logprob(logits[:, 0], tok[None])[0]

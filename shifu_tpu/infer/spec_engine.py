@@ -103,11 +103,18 @@ class SpeculativePagedEngine(PagedEngine):
         self.spec_proposed = 0
         self.spec_accepted = 0
         super().__init__(model, params, **kw)
-        # Dense per-slot draft cache. Rounds write up to k slots past a
-        # row's final token (the chunk is always k+1 wide) — pad the
-        # length so those stale writes never clamp onto real slots.
+        # Dense per-slot draft cache, padded past max_len for BOTH
+        # overshooting write paths: rounds write up to k slots past a
+        # row's final token (the chunk is always k+1 wide), and the
+        # draft prefill writes whole BUCKETS whose tail can overshoot
+        # the chunk by up to the largest bucket. dynamic_update_slice
+        # CLAMPS an out-of-range write start (XLA semantics), which
+        # would silently shift a tail chunk down over real prompt K/V —
+        # padding the cache is what makes every overshoot land on
+        # slots nothing reads.
         self.d_cache = draft.init_cache(
-            self.max_slots, self.max_len + self.k + 1
+            self.max_slots,
+            self.max_len + max(self.k + 1, self.buckets[-1]),
         )
         self._draft_prefill_jit = jax.jit(
             self._in_act_ctx(self._draft_prefill_impl),
@@ -144,13 +151,15 @@ class SpeculativePagedEngine(PagedEngine):
                 jnp.asarray(padded),
                 jnp.int32(n_chunk),
                 jnp.int32(at),
+                jnp.int32(len(prompt)),
                 jnp.int32(slot),
                 bucket=bucket,
             )
             at += n_chunk
 
     def _draft_prefill_impl(
-        self, d_params, d_cache, tokens, length, offset, slot, *, bucket
+        self, d_params, d_cache, tokens, length, offset, final_len, slot,
+        *, bucket,
     ):
         row = jax.tree_util.tree_map(
             lambda c: jax.lax.dynamic_slice_in_dim(c, slot, 1, axis=1),
@@ -164,6 +173,10 @@ class SpeculativePagedEngine(PagedEngine):
             )[None, :],
             cache=row,
             cache_index=offset,
+            # Length-sensitive rope scalings must key every chunk's
+            # frequency regime off the prompt's FINAL length, exactly
+            # like the target's chunked prefill (engine._prefill_at_impl).
+            rope_regime_len=final_len,
         )
         return jax.tree_util.tree_map(
             lambda c, r: jax.lax.dynamic_update_slice_in_dim(

@@ -657,6 +657,7 @@ class Transformer(Module):
         return_aux=False,
         return_hidden=False,
         blocks_fn=None,
+        rope_regime_len=None,
     ):
         """Compute logits.
 
@@ -746,9 +747,13 @@ class Transformer(Module):
                     positions = positions[None, :] + cache_index[:, None]
                 else:
                     positions = positions + cache_index
+        # rope_regime_len: the sequence length the length-sensitive rope
+        # scalings key off, when the caller knows better than this
+        # call's positions — a chunked prefill's chunks must all bake
+        # the FINAL prompt length's frequencies (ops/rope.py).
         sin, cos = rope_frequencies(
             cfg.resolved_head_dim, positions, theta=cfg.rope_theta,
-            scaling=cfg.rope_scaling,
+            scaling=cfg.rope_scaling, regime_len=rope_regime_len,
         )
 
         block = self._block

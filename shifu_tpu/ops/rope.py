@@ -107,11 +107,20 @@ def rope_frequencies(
     *,
     theta: float = 10000.0,
     scaling=None,
+    regime_len=None,
 ):
     """Return (sin, cos) of shape positions.shape + (head_dim // 2,).
 
     ``scaling``: optional context-extension frequency scaling — a tagged
     tuple, see the module docstring for the supported variants.
+
+    ``regime_len``: optional override of the sequence length the
+    length-SENSITIVE scalings ("dynamic", "longrope") key their regime
+    off (default: ``max(positions, axis=-1) + 1``). A chunked prefill
+    knows the prompt's FINAL length at admission while each chunk's
+    positions top out mid-prompt — passing the final length here makes
+    every chunk bake the same frequencies the one-shot prefill would.
+    Scalar or broadcastable to positions' leading axes.
     """
     if head_dim % 2:
         raise ValueError(f"head_dim must be even, got {head_dim}")
@@ -136,13 +145,20 @@ def rope_frequencies(
             # one global stretch per forward; per-row is strictly more
             # faithful to the single-request semantics its parity tests
             # pin, and identical for 1-D positions.)
-            seq_len = jnp.maximum(
+            used_len = (
                 jnp.max(positions, axis=-1, keepdims=True).astype(
                     jnp.float32
                 )
-                + 1.0,
-                float(orig_len),
-            )[..., None]  # (..., 1, 1): broadcasts against (d/2,)
+                + 1.0
+                if regime_len is None
+                else jnp.broadcast_to(
+                    jnp.asarray(regime_len, jnp.float32),
+                    positions.shape[:-1],
+                )[..., None]
+            )
+            seq_len = jnp.maximum(used_len, float(orig_len))[
+                ..., None
+            ]  # (..., 1, 1): broadcasts against (d/2,)
             base = theta * (factor * seq_len / orig_len - (factor - 1.0)) ** (
                 head_dim / (head_dim - 2)
             )
@@ -171,9 +187,15 @@ def rope_frequencies(
             # as "dynamic" above: co-batched requests must not flip each
             # other); a request whose own decode crosses orig_len still
             # flips mid-request, inherent to longrope-with-cache.
-            over = (
-                jnp.max(positions, axis=-1, keepdims=True) + 1 > orig_len
-            )[..., None]  # (..., 1, 1)
+            used = (
+                jnp.max(positions, axis=-1, keepdims=True) + 1
+                if regime_len is None
+                else jnp.broadcast_to(
+                    jnp.asarray(regime_len, jnp.int32),
+                    positions.shape[:-1],
+                )[..., None]
+            )
+            over = (used > orig_len)[..., None]  # (..., 1, 1)
             ext = jnp.where(
                 over,
                 jnp.asarray(long_, jnp.float32),

@@ -179,3 +179,54 @@ def test_chunked_with_prefix_cache(tiny):
     assert eng.prefix_hits_tokens > 0
     for i, (a, b) in enumerate(zip(ref, got)):
         np.testing.assert_array_equal(a, b, err_msg=f"request {i}")
+
+
+# ----------------------------------------------- length-sensitive rope
+
+
+@pytest.mark.parametrize(
+    "scaling",
+    [
+        ("dynamic", 2.0, 8),
+        (
+            "longrope",
+            tuple([1.0] * 8),
+            tuple([2.0] * 8),
+            8, 2.0, 1.0,
+        ),
+    ],
+    ids=["dynamic-ntk", "longrope"],
+)
+def test_chunked_prefill_length_sensitive_rope_parity(scaling):
+    """Chunked prefill with dynamic-NTK/longrope: every chunk bakes the
+    prompt's FINAL length regime (rope_regime_len), so tokens match the
+    one-shot prefill exactly. These configs were REJECTED before; the
+    prompts straddle the original context length (8) so the regime
+    switch is actually exercised."""
+    cfg = TransformerConfig.tiny(rope_scaling=scaling)
+    model = Transformer(cfg)
+    params = model.init(jax.random.key(2))
+    rng = np.random.RandomState(21)
+    # One prompt inside the original regime, one far past it.
+    prompts = [
+        rng.randint(1, 256, size=n).tolist() for n in (5, 26)
+    ]
+    kw = dict(
+        max_slots=2, max_len=48, page_size=8,
+        prefill_buckets=(8, 16, 32),
+        sample_cfg=SampleConfig(temperature=0.0),
+    )
+    ref = PagedEngine(
+        model, params, prefill_buckets=(8, 16, 32, 48), max_slots=2,
+        max_len=48, page_size=8, prefill_chunk=48,
+        sample_cfg=SampleConfig(temperature=0.0),
+    )
+    # Reference: one-shot prefill (prefill_chunk=48 covers any prompt
+    # whole, so no prompt actually chunks).
+    rids = [ref.submit(p, max_new_tokens=6) for p in prompts]
+    ref_out = {c.rid: c.tokens for c in ref.run()}
+    chunked = PagedEngine(model, params, prefill_chunk=8, **kw)
+    rids2 = [chunked.submit(p, max_new_tokens=6) for p in prompts]
+    got = {c.rid: c.tokens for c in chunked.run()}
+    for r1, r2 in zip(rids, rids2):
+        assert ref_out[r1] == got[r2]

@@ -225,3 +225,55 @@ def test_paged_sampled_rows_draw_from_filtered_support(tiny):
         )
         assert tok in np.argsort(logits)[-3:], tok
         ctx.append(tok)
+
+
+# ------------------------------------------------- partial-sort fast path
+
+
+def test_partial_cap_fast_path_matches_full_sort():
+    """At vocabs where the top_k(cap) fast path engages, tokens must
+    equal the full-sort path bit-for-bit (same rng, same distribution;
+    the cond predicate guarantees the kept sets coincide)."""
+    from shifu_tpu.infer.sampling import sample_logits_per_row
+
+    rng = np.random.default_rng(3)
+    v = 512
+    logits = jnp.asarray(rng.standard_normal((6, v)) * 3, jnp.float32)
+    temp = jnp.asarray([0.0, 0.7, 1.0, 1.3, 0.9, 0.5], jnp.float32)
+    topk = jnp.asarray([1 << 30, 40, 5, 1 << 30, 128, 2], jnp.int32)
+    topp = jnp.asarray([1.0, 0.9, 1.0, 0.5, 0.8, 1.0], jnp.float32)
+    for seed in range(5):
+        key = jax.random.key(seed)
+        fast = sample_logits_per_row(
+            logits, key, temp, topk, topp, partial_cap=128
+        )
+        slow = sample_logits_per_row(
+            logits, key, temp, topk, topp, partial_cap=None
+        )
+        np.testing.assert_array_equal(
+            np.asarray(fast), np.asarray(slow), err_msg=f"seed {seed}"
+        )
+
+
+def test_partial_cap_falls_back_when_invalid():
+    """cap < top_k < vocab, and a top-p nucleus wider than the cap
+    (near-uniform logits), must take the exact fallback — tokens again
+    equal the full-sort path."""
+    from shifu_tpu.infer.sampling import sample_logits_per_row
+
+    rng = np.random.default_rng(4)
+    v = 512
+    # Near-flat logits: top-p 0.9 needs ~0.9*512 candidates >> cap.
+    logits = jnp.asarray(rng.standard_normal((3, v)) * 0.01, jnp.float32)
+    temp = jnp.asarray([1.0, 1.0, 1.0], jnp.float32)
+    topk = jnp.asarray([300, 1 << 30, 1 << 30], jnp.int32)
+    topp = jnp.asarray([1.0, 0.9, 1.0], jnp.float32)
+    for seed in range(3):
+        key = jax.random.key(seed)
+        fast = sample_logits_per_row(
+            logits, key, temp, topk, topp, partial_cap=128
+        )
+        slow = sample_logits_per_row(
+            logits, key, temp, topk, topp, partial_cap=None
+        )
+        np.testing.assert_array_equal(np.asarray(fast), np.asarray(slow))
