@@ -10,9 +10,19 @@ one step.
     POST /v1/completions  {"prompt": "text"} | {"tokens": [int, ...]}
                           + optional "max_new_tokens", "stop" (string or
                           list of strings), "stop_token_ids" (ints or
-                          int-lists), "logprobs" (bool)
+                          int-lists), "logprobs" (bool), "n" (int),
+                          "best_of" (int, beam width), "length_penalty"
                           -> {"tokens": [...], "text"?, "finished_by",
                               "logprobs"?}
+                          n > 1 -> {"choices": [completion, ...]} — n
+                          independent engine requests (one per slot;
+                          prefix caching shares the prompt's pages).
+                          best_of = W -> beam search of width W via the
+                          standalone jitted searcher (infer/beam.py) on
+                          the engine thread; the top n beams return as
+                          {"choices": [{"tokens", "score", "text"?}]}.
+                          Beam occupies the device for its search, so
+                          active slots pause — a quality-first mode.
     GET  /healthz         -> engine stats (slots, queue, pages, ...)
 
 Sampling: engine-level by default (one compiled decode program). On an
@@ -127,6 +137,21 @@ class _Submission:
     waiter: object
 
 
+@dataclasses.dataclass
+class _BeamJob:
+    """A beam-search request. Runs on the engine thread between steps
+    via the standalone jitted beam searcher (infer/beam.py) — it
+    OCCUPIES the device for its whole search, so active slots pause
+    for its duration (documented; beam is a latency-insensitive,
+    quality-first mode)."""
+
+    tokens: list
+    max_new: int
+    num_beams: int
+    length_penalty: float
+    waiter: _Waiter
+
+
 class EngineRunner:
     """Thread-safe facade: many callers, ONE engine/device thread.
 
@@ -142,6 +167,9 @@ class EngineRunner:
         self._inbox: collections.deque = collections.deque()
         self._cancels: collections.deque = collections.deque()  # rids
         self._waiters: dict = {}  # rid -> _Waiter
+        # Compiled beam searchers, keyed (num_beams, max_new, penalty,
+        # prompt bucket) — each key compiles once, like prefill buckets.
+        self._beam_fns: dict = {}
         # The ONE submission currently between inbox-pop and waiter
         # registration on the engine thread, and whether its caller
         # abandoned it meanwhile. Registration checks the flag and
@@ -163,11 +191,80 @@ class EngineRunner:
         sampling: Optional[SampleConfig] = None,
         stop_token_ids=None, stop_strings=None,
     ) -> Completion:
+        return self.complete_n(
+            tokens, max_new_tokens, 1, timeout=timeout, sampling=sampling,
+            stop_token_ids=stop_token_ids, stop_strings=stop_strings,
+        )[0]
+
+    def complete_n(
+        self, tokens, max_new_tokens: int, n: int,
+        timeout: Optional[float] = None,
+        sampling: Optional[SampleConfig] = None,
+        stop_token_ids=None, stop_strings=None,
+    ):
+        """N independent completions of one prompt (the API's ``n``).
+
+        Each is its own engine request — the engine's rng advances per
+        admission, so sampled requests draw independently; with prefix
+        caching enabled the shared prompt's full pages are prefilled
+        once and shared. Greedy requests are deterministic, so n>1
+        greedy returns n identical completions (documented behavior).
+        On timeout every unfinished request is canceled. (``complete``
+        is the n=1 case — ONE submission/wait/abandon lifecycle to
+        maintain.) Check-and-append happens under ONE lock acquisition:
+        the fatal/shutdown handlers drain the inbox under the same lock
+        after setting _stop, so a waiter can never slip in behind the
+        final drain and block forever."""
+        import time as _time
+
+        waiters = [_Waiter(threading.Event()) for _ in range(n)]
+        with self._lock:
+            if self.fatal is not None:
+                raise RuntimeError(
+                    f"engine thread died: {self.fatal!r}"
+                ) from self.fatal
+            if self._stop.is_set():
+                raise RuntimeError("engine runner is shut down")
+            for w in waiters:
+                self._inbox.append(
+                    _Submission(
+                        list(tokens), int(max_new_tokens), sampling,
+                        stop_token_ids, stop_strings, w,
+                    )
+                )
+        self._wake.set()
+        deadline = (
+            _time.monotonic() + timeout if timeout is not None else None
+        )
+        out = []
+        for w in waiters:
+            left = (
+                None if deadline is None
+                else max(0.0, deadline - _time.monotonic())
+            )
+            if not w.event.wait(left):
+                for ww in waiters:
+                    if ww.completion is None and ww.error is None:
+                        self._abandon(ww)
+                raise TimeoutError(
+                    f"no completion within {timeout}s "
+                    "(unfinished requests canceled)"
+                )
+            if w.error is not None:
+                raise w.error
+            out.append(w.completion)
+        return out
+
+    def beam(
+        self, tokens, max_new_tokens: int, num_beams: int,
+        length_penalty: float = 1.0, timeout: Optional[float] = None,
+    ) -> dict:
+        """Beam-search one prompt on the engine thread (``best_of``).
+
+        Returns the standalone searcher's dict (beam_tokens /
+        beam_scores / beam_lengths, best first) — exactly
+        ``infer.beam.make_beam_search_fn``'s output for this prompt."""
         w = _Waiter(threading.Event())
-        # Check-and-append under ONE lock acquisition: the fatal/shutdown
-        # handlers drain the inbox under the same lock after setting
-        # _stop, so a waiter can never slip in behind the final drain
-        # and block forever.
         with self._lock:
             if self.fatal is not None:
                 raise RuntimeError(
@@ -176,18 +273,15 @@ class EngineRunner:
             if self._stop.is_set():
                 raise RuntimeError("engine runner is shut down")
             self._inbox.append(
-                _Submission(
-                    list(tokens), int(max_new_tokens), sampling,
-                    stop_token_ids, stop_strings, w,
+                _BeamJob(
+                    list(tokens), int(max_new_tokens), int(num_beams),
+                    float(length_penalty), w,
                 )
             )
         self._wake.set()
         if not w.event.wait(timeout):
-            # Nobody will consume the result: cancel so the slot frees.
             self._abandon(w)
-            raise TimeoutError(
-                f"no completion within {timeout}s (request canceled)"
-            )
+            raise TimeoutError(f"no beam result within {timeout}s")
         if w.error is not None:
             raise w.error
         return w.completion
@@ -309,14 +403,71 @@ class EngineRunner:
                 rid = self._cancels.popleft()
             self.engine.cancel(rid)
 
+    # Distinct (num_beams, max_new, penalty, bucket) tuples each compile
+    # a beam searcher, and max_new/penalty are CLIENT inputs — bound the
+    # cache (FIFO) so adversarial variation cannot accumulate compiled
+    # executables without limit. Each miss still stalls the engine loop
+    # for its compile; the beam API is a quality-first mode, documented.
+    _BEAM_CACHE_MAX = 8
+
+    def _run_beam(self, job: _BeamJob) -> None:
+        import numpy as np
+
+        from shifu_tpu.infer.beam import make_beam_search_fn
+
+        eng = self.engine
+        try:
+            if not job.tokens:
+                raise ValueError("empty prompt")
+            bucket = next(
+                (b for b in eng.buckets if b >= len(job.tokens)), None
+            )
+            if bucket is None:
+                raise ValueError(
+                    f"prompt {len(job.tokens)} exceeds the largest beam "
+                    f"prefill bucket {eng.buckets[-1]}"
+                )
+            # Quantize the penalty so float dust can't mint cache keys.
+            penalty = round(float(job.length_penalty), 2)
+            key = (job.num_beams, job.max_new, penalty, bucket)
+            fn = self._beam_fns.get(key)
+            if fn is None:
+                fn = make_beam_search_fn(
+                    eng.model,
+                    num_beams=job.num_beams,
+                    max_new_tokens=job.max_new,
+                    length_penalty=penalty,
+                    eos_id=eng.eos_id,
+                )
+                while len(self._beam_fns) >= self._BEAM_CACHE_MAX:
+                    self._beam_fns.pop(next(iter(self._beam_fns)))
+                self._beam_fns[key] = fn
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, : len(job.tokens)] = job.tokens
+            out = fn(
+                eng.params, padded,
+                np.asarray([len(job.tokens)], np.int32),
+            )
+            job.waiter.complete(
+                {k: np.asarray(v) for k, v in out.items()}
+            )
+        except Exception as e:
+            job.waiter.fail(e)
+
     def _drain_inbox(self) -> None:
         while True:
             with self._lock:
                 if not self._inbox:
                     return
                 sub = self._inbox.popleft()
-                self._inflight = sub.waiter
-                self._inflight_abandoned = False
+                if not isinstance(sub, _BeamJob):
+                    self._inflight = sub.waiter
+                    self._inflight_abandoned = False
+            if isinstance(sub, _BeamJob):
+                # Outside the lock: the search occupies the device but
+                # must not block submitters.
+                self._run_beam(sub)
+                continue
             try:
                 rid = self.engine.submit(
                     sub.tokens, max_new_tokens=sub.max_new,
@@ -446,11 +597,80 @@ class _Handler(BaseHTTPRequestHandler):
                 stop_strings = [stop_strings]
             stop_token_ids = req.get("stop_token_ids")
             want_logprobs = bool(req.get("logprobs"))
+            n = int(req.get("n", 1))
+            best_of = req.get("best_of")
+            if n < 1:
+                raise ValueError(f"n must be >= 1, got {n}")
             if req.get("stream"):
+                if n > 1 or best_of:
+                    raise ValueError(
+                        "stream does not compose with n>1/best_of"
+                    )
                 self._stream_response(
                     tokens, max_new, sampling, stop_token_ids,
                     stop_strings, want_logprobs,
                 )
+                return
+            if best_of is not None:
+                # BEAM SEARCH: best_of = beam width; the top n beams
+                # come back as choices ranked by length-penalised
+                # logprob (parity with infer/beam.py, which this runs).
+                best_of = int(best_of)
+                if not (1 <= best_of <= 32):
+                    raise ValueError(
+                        f"best_of must be in [1, 32], got {best_of}"
+                    )
+                if n > best_of:
+                    raise ValueError(
+                        f"n={n} exceeds best_of={best_of} beams"
+                    )
+                if max_new < 1:
+                    raise ValueError("max_new_tokens must be >= 1")
+                out = self.runner.beam(
+                    tokens, max_new, best_of,
+                    length_penalty=float(req.get("length_penalty", 1.0)),
+                    timeout=self.request_timeout_s,
+                )
+                choices = []
+                for i in range(n):
+                    length = int(out["beam_lengths"][0, i])
+                    ids = [int(t) for t in out["beam_tokens"][0, i, :length]]
+                    c = {
+                        "tokens": ids,
+                        "score": float(out["beam_scores"][0, i]),
+                    }
+                    if self.tokenizer is not None:
+                        try:
+                            c["text"] = self.tokenizer.decode(ids)
+                        except Exception as e:
+                            c["text_error"] = repr(e)
+                    choices.append(c)
+                self._send(200, {"choices": choices})
+                return
+            if n > 1:
+                dones = self.runner.complete_n(
+                    tokens, max_new, n, timeout=self.request_timeout_s,
+                    sampling=sampling, stop_token_ids=stop_token_ids,
+                    stop_strings=stop_strings,
+                )
+                choices = []
+                for done in dones:
+                    c = {
+                        "tokens": done.tokens,
+                        "finished_by": done.finished_by,
+                    }
+                    if want_logprobs:
+                        c["logprobs"] = done.logprobs
+                    if self.tokenizer is not None:
+                        try:
+                            text = self.tokenizer.decode(done.tokens)
+                            if done.finished_by == "stop" and stop_strings:
+                                text = _trim_stop(text, stop_strings)
+                            c["text"] = text
+                        except Exception as e:
+                            c["text_error"] = repr(e)
+                    choices.append(c)
+                self._send(200, {"choices": choices})
                 return
             done = self.runner.complete(
                 tokens, max_new, timeout=self.request_timeout_s,

@@ -280,3 +280,102 @@ def test_stream_close_cancels_request(tiny):
         assert engine.free_pages == engine.n_pages - 1
     finally:
         runner.shutdown()
+
+
+# ----------------------------------------------------------- n / beam
+
+
+def test_http_n_sampled_choices(tiny):
+    model, params = tiny
+    engine = PagedEngine(
+        model, params, max_slots=2, max_len=32, page_size=8,
+        prefill_buckets=(16, 32), per_request_sampling=True,
+        sample_cfg=SampleConfig(temperature=0.0),
+    )
+    server = make_server(engine, port=0)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    try:
+        base = f"http://127.0.0.1:{server.server_port}"
+        status, out = _post(
+            base,
+            {
+                "tokens": [3, 5, 7], "max_new_tokens": 5, "n": 3,
+                "temperature": 1.1,
+            },
+        )
+        assert status == 200
+        assert len(out["choices"]) == 3
+        for c in out["choices"]:
+            assert len(c["tokens"]) == 5
+        # Greedy n=2: deterministic -> identical choices.
+        status, out = _post(
+            base,
+            {
+                "tokens": [3, 5, 7], "max_new_tokens": 5, "n": 2,
+                "temperature": 0.0,
+            },
+        )
+        assert out["choices"][0]["tokens"] == out["choices"][1]["tokens"]
+    finally:
+        server.shutdown()
+        server.runner.shutdown()
+        t.join(5)
+
+
+def test_http_best_of_matches_standalone_beam(tiny, served):
+    """best_of routes through infer/beam.py — the server's choices must
+    equal a direct make_beam_search_fn call on the same padded prompt."""
+    import jax.numpy as jnp
+
+    from shifu_tpu.infer import make_beam_search_fn
+
+    base, engine = served
+    prompt = [4, 9, 2, 6, 1]
+    status, out = _post(
+        base,
+        {"tokens": prompt, "max_new_tokens": 6, "best_of": 4, "n": 2},
+    )
+    assert status == 200
+    assert len(out["choices"]) == 2
+    model, params = tiny
+    fn = make_beam_search_fn(
+        model, num_beams=4, max_new_tokens=6, length_penalty=1.0,
+        eos_id=None,
+    )
+    bucket = engine._bucket_for(len(prompt))
+    padded = np.zeros((1, bucket), np.int32)
+    padded[0, : len(prompt)] = prompt
+    ref = fn(params, jnp.asarray(padded), jnp.asarray([len(prompt)]))
+    for i, c in enumerate(out["choices"]):
+        length = int(np.asarray(ref["beam_lengths"])[0, i])
+        assert c["tokens"] == [
+            int(x) for x in np.asarray(ref["beam_tokens"])[0, i, :length]
+        ]
+        np.testing.assert_allclose(
+            c["score"], float(np.asarray(ref["beam_scores"])[0, i]),
+            rtol=1e-5,
+        )
+    # Normal serving still works after a beam job.
+    status, out = _post(base, {"tokens": prompt, "max_new_tokens": 3})
+    assert status == 200 and len(out["tokens"]) == 3
+
+
+def test_http_stream_rejects_n_and_best_of(tiny, served):
+    base, _ = served
+    import urllib.error
+
+    for extra in ({"n": 2}, {"best_of": 3}):
+        req = urllib.request.Request(
+            base + "/v1/completions",
+            data=json.dumps(
+                {"tokens": [1, 2], "max_new_tokens": 2, "stream": True,
+                 **extra}
+            ).encode(),
+            method="POST",
+        )
+        try:
+            urllib.request.urlopen(req, timeout=60)
+            raise AssertionError("expected 400")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
