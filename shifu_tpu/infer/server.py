@@ -57,6 +57,26 @@ from shifu_tpu.infer.engine import Completion, Engine
 from shifu_tpu.infer.sampling import SampleConfig
 
 
+def _build_choice(done, tokenizer, want_logprobs, stop_strings) -> dict:
+    """One completion's response dict — the SINGLE assembly point for
+    tokens/finished_by/logprobs/decoded-and-trimmed text (n=1, n>1 and
+    SSE final events must not drift apart)."""
+    c = {"tokens": done.tokens, "finished_by": done.finished_by}
+    if want_logprobs:
+        c["logprobs"] = done.logprobs
+    if tokenizer is not None:
+        try:
+            text = tokenizer.decode(done.tokens)
+            if done.finished_by == "stop" and stop_strings:
+                text = _trim_stop(text, stop_strings)
+            c["text"] = text
+        except Exception as e:
+            # Sampled ids outside the tokenizer's range must not turn a
+            # finished completion into a dropped connection.
+            c["text_error"] = repr(e)
+    return c
+
+
 def _trim_stop(text: str, stop_strings) -> str:
     """Cut the response text at the earliest stop-string match (the
     engine truncates TOKENS at the match-completing token; the matched
@@ -599,8 +619,10 @@ class _Handler(BaseHTTPRequestHandler):
             want_logprobs = bool(req.get("logprobs"))
             n = int(req.get("n", 1))
             best_of = req.get("best_of")
-            if n < 1:
-                raise ValueError(f"n must be >= 1, got {n}")
+            if not (1 <= n <= 16):
+                # Each unit of n is a full engine submission; unbounded
+                # n would let one request flood the queue.
+                raise ValueError(f"n must be in [1, 16], got {n}")
             if req.get("stream"):
                 if n > 1 or best_of:
                     raise ValueError(
@@ -624,8 +646,32 @@ class _Handler(BaseHTTPRequestHandler):
                     raise ValueError(
                         f"n={n} exceeds best_of={best_of} beams"
                     )
-                if max_new < 1:
-                    raise ValueError("max_new_tokens must be >= 1")
+                if not (
+                    1 <= max_new
+                    <= self.runner.engine.max_len - len(tokens)
+                ):
+                    # Mirror engine.submit's prompt+max_new <= max_len
+                    # bound: the beam cache is num_beams x (bucket +
+                    # max_new) and an unbounded client budget would
+                    # compile/allocate without limit on the engine
+                    # thread.
+                    raise ValueError(
+                        f"max_new_tokens must be in [1, max_len - "
+                        f"prompt] = [1, "
+                        f"{self.runner.engine.max_len - len(tokens)}]"
+                    )
+                if (
+                    sampling is not None
+                    or stop_strings
+                    or stop_token_ids
+                    or want_logprobs
+                ):
+                    # Beam is deterministic max-logprob search; these
+                    # fields would be silently dropped — refuse instead.
+                    raise ValueError(
+                        "best_of composes with none of temperature/"
+                        "top_k/top_p/stop/stop_token_ids/logprobs"
+                    )
                 out = self.runner.beam(
                     tokens, max_new, best_of,
                     length_penalty=float(req.get("length_penalty", 1.0)),
@@ -653,24 +699,18 @@ class _Handler(BaseHTTPRequestHandler):
                     sampling=sampling, stop_token_ids=stop_token_ids,
                     stop_strings=stop_strings,
                 )
-                choices = []
-                for done in dones:
-                    c = {
-                        "tokens": done.tokens,
-                        "finished_by": done.finished_by,
-                    }
-                    if want_logprobs:
-                        c["logprobs"] = done.logprobs
-                    if self.tokenizer is not None:
-                        try:
-                            text = self.tokenizer.decode(done.tokens)
-                            if done.finished_by == "stop" and stop_strings:
-                                text = _trim_stop(text, stop_strings)
-                            c["text"] = text
-                        except Exception as e:
-                            c["text_error"] = repr(e)
-                    choices.append(c)
-                self._send(200, {"choices": choices})
+                self._send(
+                    200,
+                    {
+                        "choices": [
+                            _build_choice(
+                                d, self.tokenizer, want_logprobs,
+                                stop_strings,
+                            )
+                            for d in dones
+                        ]
+                    },
+                )
                 return
             done = self.runner.complete(
                 tokens, max_new, timeout=self.request_timeout_s,
@@ -686,21 +726,10 @@ class _Handler(BaseHTTPRequestHandler):
         except RuntimeError as e:
             self._send(503, {"error": str(e)})
             return
-        out = {"tokens": done.tokens, "finished_by": done.finished_by}
-        if want_logprobs:
-            out["logprobs"] = done.logprobs
-        if self.tokenizer is not None:
-            try:
-                text = self.tokenizer.decode(done.tokens)
-                if done.finished_by == "stop" and stop_strings:
-                    text = _trim_stop(text, stop_strings)
-                out["text"] = text
-            except Exception as e:
-                # Sampled ids outside the tokenizer's range (e.g. byte
-                # tokenizer under a 32k-vocab model) must not turn a
-                # finished completion into a dropped connection.
-                out["text_error"] = repr(e)
-        self._send(200, out)
+        self._send(
+            200,
+            _build_choice(done, self.tokenizer, want_logprobs, stop_strings),
+        )
 
     def _stream_response(
         self, tokens, max_new: int, sampling=None,

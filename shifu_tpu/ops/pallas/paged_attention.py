@@ -207,7 +207,7 @@ def paged_decode_attention(
     kv_mask: Optional[jax.Array] = None,
     k_scale: Optional[jax.Array] = None,
     v_scale: Optional[jax.Array] = None,
-    pages_per_step: int = 4,
+    pages_per_step: Optional[int] = None,
     interpret: Optional[bool] = None,
 ):
     """Single-token decode attention over a paged KV pool.
@@ -242,6 +242,12 @@ def paged_decode_attention(
         with them the K/V pools must be int8 and dequantization happens
         inside the kernel (see _decode_kernel).
       pages_per_step: pages fetched per grid step (DMA/compute grain).
+        Default: adaptive, ~512 tokens per grid group — grid-step fixed
+        costs (DMA issue, scalar work, MXU ramp on tiny dots) dominate
+        the kernel below that grain. Measured at 1.2B/16 slots/1900-tok
+        prompts on v5e: page 64 x unroll 4 ran the kernel at ~3.4x its
+        compulsory traffic (60% of the decode step); page 256 x
+        unroll 2 cut the whole step 8.7 -> 6.8 ms (bf16).
       interpret: force pallas interpret mode; defaults to interpret
         unless running on TPU (CPU tests exercise this same kernel).
 
@@ -260,6 +266,8 @@ def paged_decode_attention(
     scale = float(scale) if scale is not None else hd**-0.5
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    if pages_per_step is None:
+        pages_per_step = max(1, 512 // ps)
     unroll = max(1, min(pages_per_step, pages_per_row))
     n_steps = -(-pages_per_row // unroll)
 
