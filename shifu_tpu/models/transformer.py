@@ -433,7 +433,14 @@ class Transformer(Module):
         # Quantized pool (init_paged_cache(dtype=int8)): writes quantize
         # at the scatter (int8 data + per-(pos, kv) f32 scale), reads
         # dequantize — inside the Pallas kernel on the decode fast path,
-        # at the gather on the XLA fallback/suffix paths.
+        # at the gather on the XLA fallback/suffix paths. Scales stay in
+        # pool layout and are gathered per layer at the read: an
+        # all-layer pre-gather into slot-logical layout (page-major
+        # scale pool + scan xs + per-write logical mirror) was built and
+        # MEASURED SLOWER on v5e at the production page-256 grain
+        # (8.3 vs 6.8 ms/step at the bench mix — the one-shot gather's
+        # transpose and the in-scan mirror scatters both materialise
+        # badly, while 160 contiguous 8KB slices per layer gather fine).
         quantized = "k_scale" in pool
         if quantized:
             from shifu_tpu.core.qtensor import dequantize_kv, quantize_kv
@@ -475,17 +482,36 @@ class Transformer(Module):
                 csv = csv.at[li, phys, off].set(vsw_)
             ck = pool["k"].at[li, phys, off].set(kw_)
             cv = pool["v"].at[li, phys, off].set(vw_)
-            gk = ck[li, page_table]
-            gv = cv[li, page_table]
-            if quantized:
-                gk = dequantize_kv(gk, csk[li, page_table], q.dtype)
-                gv = dequantize_kv(gv, csv[li, page_table], q.dtype)
-            gk = gk.reshape(b, pages_per_row * ps, n_kv, hd)
-            gv = gv.reshape(b, pages_per_row * ps, n_kv, hd)
-            attn = _decode_attention(
-                q, gk, gv, cache_index, self.cfg.attn_impl,
-                kv_mask=kv_mask, window=self.cfg.window_size,
-            )
+            if self.cfg.attn_impl == "flash" and _pallas_paged_ok():
+                # Multi-query paged kernel: the whole chunk scores in
+                # ONE pass over the pool (queries fold into the row
+                # axis) — the (b, pages_per_row * ps, kv, hd) gathered
+                # copy never exists. This is the speculative-verify
+                # hot path: verify traffic drops from ~3x the pool
+                # bytes (gather write + read + pool read) to the pool
+                # read itself.
+                from shifu_tpu.ops.pallas.paged_attention import (
+                    paged_decode_attention,
+                )
+
+                attn = paged_decode_attention(
+                    q, ck, cv, page_table, cache_index, layer=li,
+                    window=self.cfg.window_size, kv_mask=kv_mask,
+                    k_scale=csk if quantized else None,
+                    v_scale=csv if quantized else None,
+                )
+            else:
+                gk = ck[li, page_table]
+                gv = cv[li, page_table]
+                if quantized:
+                    gk = dequantize_kv(gk, csk[li, page_table], q.dtype)
+                    gv = dequantize_kv(gv, csv[li, page_table], q.dtype)
+                gk = gk.reshape(b, pages_per_row * ps, n_kv, hd)
+                gv = gv.reshape(b, pages_per_row * ps, n_kv, hd)
+                attn = _decode_attention(
+                    q, gk, gv, cache_index, self.cfg.attn_impl,
+                    kv_mask=kv_mask, window=self.cfg.window_size,
+                )
             new_pool = {"k": ck, "v": cv}
             if quantized:
                 new_pool["k_scale"] = csk

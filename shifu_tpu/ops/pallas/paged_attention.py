@@ -75,19 +75,28 @@ _MASK_FLOOR = -1e30
 
 
 def _decode_kernel(
-    scale, window, n_kv, group, unroll, ps, has_mask, has_scale, *refs
+    scale, window, n_kv, group, unroll, ps, has_mask, has_scale, heads,
+    *refs,
 ):
-    """One (row, page-group) grid step: U pages against all query heads.
+    """One (row, page-group) grid step: U pages against all query rows.
 
     refs: table_ref, len_ref, layer_ref (scalar prefetch), q_ref
-    (1, heads, hd), U k_refs + U v_refs (1, 1, ps*n_kv, hd) each,
+    (1, qw*heads, hd), U k_refs + U v_refs (1, 1, ps*n_kv, hd) each,
     [ks_ref + vs_ref (1, 1, U*ps*n_kv) f32 — int8-pool per-lane scales,
     pre-gathered into the row's LOGICAL layout like the mask: one DMA
     per grid step, not one per page — per-page scale blocks measured
     SLOWER than bf16 KV (decode compute per grid step is tiny, so DMA
     issue count dominates)], [mask_ref (1, 1, U*ps*n_kv) — pre-expanded
-    kv-interleaved], o_ref (1, heads, hd), scratch m/l (heads, _LANES)
-    and acc (heads, hd).
+    kv-interleaved], o_ref (1, qw*heads, hd), scratch m/l
+    (qw*heads, _LANES) and acc (qw*heads, hd).
+
+    MULTI-QUERY (qw > 1, the speculative-verify / batch-chunk shape):
+    the qw chunk queries FOLD into the row axis — row r is query offset
+    ``t = r // heads``, head ``r % heads``, sitting at slot position
+    ``lengths[b] + t``. Per-row causality rides the same lane mask that
+    already handles GQA head matching, the pages still stream exactly
+    once for ALL queries and heads, and qw == 1 reduces to the plain
+    decode kernel (one extra iota row the compiler folds).
 
     With ``has_scale`` the K/V blocks are int8 and dequantization happens
     HERE, per lane: scores multiply by the key scale after the QK dot
@@ -115,7 +124,7 @@ def _decode_kernel(
         mask_ref = None
     b = pl.program_id(0)
     j = pl.program_id(1)
-    heads = q_ref.shape[1]
+    rows = q_ref.shape[1]  # qw * heads
     lanes = ps * n_kv
 
     @pl.when(j == 0)
@@ -124,15 +133,17 @@ def _decode_kernel(
         l_sc[...] = jnp.zeros_like(l_sc)
         acc_sc[...] = jnp.zeros_like(acc_sc)
 
-    length = len_ref[b]  # valid keys: pos <= length (current token incl.)
-    q = q_ref[0]  # (heads, hd)
+    length = len_ref[b]  # query t's position: length + t (t=0 incl.)
+    q = q_ref[0]  # (qw*heads, hd)
 
     # Lane r of a flattened page holds position r // n_kv, kv head
-    # r % n_kv; query head i is served by kv head i // group. Static over
-    # the whole kernel.
-    lane_pos = jax.lax.broadcasted_iota(jnp.int32, (heads, lanes), 1) // n_kv
-    lane_kv = jax.lax.broadcasted_iota(jnp.int32, (heads, lanes), 1) % n_kv
-    head_kv = jax.lax.broadcasted_iota(jnp.int32, (heads, lanes), 0) // group
+    # r % n_kv; query row i is query offset i // heads, head i % heads,
+    # served by kv head (i % heads) // group. Static over the kernel.
+    lane_pos = jax.lax.broadcasted_iota(jnp.int32, (rows, lanes), 1) // n_kv
+    lane_kv = jax.lax.broadcasted_iota(jnp.int32, (rows, lanes), 1) % n_kv
+    row_iota = jax.lax.broadcasted_iota(jnp.int32, (rows, lanes), 0)
+    row_t = row_iota // heads
+    head_kv = (row_iota % heads) // group
     head_match = lane_kv == head_kv
 
     m = m_sc[...]
@@ -149,13 +160,13 @@ def _decode_kernel(
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        ) * scale  # (heads, ps*kv)
+        ) * scale  # (qw*heads, ps*kv)
         if has_scale:
             s = s * ks_ref[0, 0, u * lanes : (u + 1) * lanes][None, :]
         pos = base + lane_pos
-        valid = jnp.logical_and(head_match, pos <= length)
+        valid = jnp.logical_and(head_match, pos <= length + row_t)
         if window is not None:
-            valid = jnp.logical_and(valid, pos > length - window)
+            valid = jnp.logical_and(valid, pos > length + row_t - window)
         if mask_ref is not None:
             mrow = mask_ref[0, 0, u * lanes : (u + 1) * lanes]  # (ps*kv,)
             valid = jnp.logical_and(valid, mrow[None, :] != 0)
@@ -210,10 +221,17 @@ def paged_decode_attention(
     pages_per_step: Optional[int] = None,
     interpret: Optional[bool] = None,
 ):
-    """Single-token decode attention over a paged KV pool.
+    """Decode / chunk-verify attention over a paged KV pool.
 
     Args:
-      q: (batch, n_heads, head_dim) — this step's queries, RoPE applied.
+      q: (batch, n_heads, head_dim) — this step's queries, RoPE
+        applied. MULTI-QUERY: (batch, qw, n_heads, head_dim) scores a
+        qw-token chunk per row in ONE pass over the pool (the
+        speculative-verify shape): query t of row b sits at slot
+        position ``lengths[b] + t`` and sees keys at ``pos <=
+        lengths[b] + t`` — the chunk's K/V must already be scattered
+        into the pool. Pages still stream exactly once for all
+        queries; the chunk folds into the kernel's row axis.
       k_pool, v_pool: (n_pages, page_size, n_kv_heads, head_dim) —
         physical pages, POST-scatter (the current token's K/V already
         written at position ``lengths[b]`` of row ``b``). With ``layer``
@@ -226,8 +244,10 @@ def paged_decode_attention(
       page_table: (batch, pages_per_row) int32 — logical→physical page
         map; entries past a row's length may point anywhere live (the
         engine points them at scratch page 0) — they are never read.
-      lengths: (batch,) int32 — the current token's position; keys at
-        ``pos <= lengths[b]`` are visible (slot-space causality).
+      lengths: (batch,) int32 — the FIRST query's position (the current
+        token for plain decode, the chunk start for multi-query); keys
+        at ``pos <= lengths[b] + t`` are visible to query t
+        (slot-space causality).
       layer: optional traced int32 scalar — which layer of stacked
         5-D pools to read (scalar-prefetched into the index maps).
       scale: score scale; defaults to head_dim ** -0.5.
@@ -240,7 +260,12 @@ def paged_decode_attention(
         stacked, (n_layers, n_pages, page_size, n_kv), matching the
         pool layout (core.qtensor.quantize_kv). Pass both or neither;
         with them the K/V pools must be int8 and dequantization happens
-        inside the kernel (see _decode_kernel).
+        inside the kernel (see _decode_kernel). The per-layer scale
+        gather below is the MEASURED-best design at the production
+        page-256 grain: an engine-wide all-layer pre-gather into
+        slot-logical layout was built and ran SLOWER (transpose +
+        per-write mirror materialisation; see
+        models/transformer.py _paged_block_attention).
       pages_per_step: pages fetched per grid step (DMA/compute grain).
         Default: adaptive, ~512 tokens per grid group — grid-step fixed
         costs (DMA issue, scalar work, MXU ramp on tiny dots) dominate
@@ -252,9 +277,17 @@ def paged_decode_attention(
         unless running on TPU (CPU tests exercise this same kernel).
 
     Returns:
-      (batch, n_heads, head_dim) in q.dtype.
+      (batch, n_heads, head_dim) — or (batch, qw, n_heads, head_dim)
+      for a 4-D q — in q.dtype.
     """
-    b, n_heads, hd = q.shape
+    if q.ndim == 4:
+        b, qw, n_heads, hd = q.shape
+        chunked = True
+    else:
+        b, n_heads, hd = q.shape
+        qw, chunked = 1, False
+    rows = qw * n_heads
+    q = q.reshape(b, rows, hd)
     if layer is not None:
         n_layers, n_pages, ps, n_kv, _ = k_pool.shape
     else:
@@ -284,8 +317,13 @@ def paged_decode_attention(
         # the window) repeat a neighbouring block index, which
         # Mosaic never re-fetches — per-row DMA is O(live pages)
         # (O(window) pages when windowed), not O(pages_per_row).
+        # Multi-query: the last chunk query sits at length + qw - 1
+        # (capacity-clamped — overshooting chunk tails were scattered
+        # to scratch and are masked by the caller/causality).
         jl = j * unroll + u
-        hi = len_ref[ib] // ps  # <= pages_per_row - 1 always
+        hi = jnp.minimum(
+            (len_ref[ib] + (qw - 1)) // ps, pages_per_row - 1
+        )
         if window is not None:
             lo = jnp.maximum(len_ref[ib] - (window - 1), 0) // ps
             jl = jnp.maximum(jl, lo)
@@ -309,7 +347,7 @@ def paged_decode_attention(
         for u in range(unroll)
     ]
     in_specs = (
-        [pl.BlockSpec((1, n_heads, hd), lambda ib, j, t, l, li: (ib, 0, 0))]
+        [pl.BlockSpec((1, rows, hd), lambda ib, j, t, l, li: (ib, 0, 0))]
         + kv_spec
         + kv_spec
     )
@@ -322,6 +360,7 @@ def paged_decode_attention(
             raise ValueError(
                 f"k_scale/v_scale imply an int8 pool, got {k_pool.dtype}"
             )
+
         # Gather the live scales into each row's LOGICAL layout OUTSIDE
         # the kernel and stream them like the mask (one (1, 1, U*ps*kv)
         # block per grid step). Feeding pool-layout scales as per-page
@@ -366,20 +405,21 @@ def paged_decode_attention(
         grid=(b, n_steps),
         in_specs=in_specs,
         out_specs=pl.BlockSpec(
-            (1, n_heads, hd), lambda ib, j, t, l, li: (ib, 0, 0)
+            (1, rows, hd), lambda ib, j, t, l, li: (ib, 0, 0)
         ),
         scratch_shapes=[
-            pltpu.VMEM((n_heads, _LANES), jnp.float32),  # running max
-            pltpu.VMEM((n_heads, _LANES), jnp.float32),  # normaliser
-            pltpu.VMEM((n_heads, hd), jnp.float32),      # accumulator
+            pltpu.VMEM((rows, _LANES), jnp.float32),  # running max
+            pltpu.VMEM((rows, _LANES), jnp.float32),  # normaliser
+            pltpu.VMEM((rows, hd), jnp.float32),      # accumulator
         ],
     )
-    return pl.pallas_call(
+    out = pl.pallas_call(
         functools.partial(
             _decode_kernel, scale, window, n_kv, group, unroll, ps,
-            has_mask, has_scale,
+            has_mask, has_scale, n_heads,
         ),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, n_heads, hd), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((b, rows, hd), q.dtype),
         interpret=interpret,
     )(table, lengths, li_arr, *inputs)
+    return out.reshape(b, qw, n_heads, hd) if chunked else out

@@ -120,6 +120,69 @@ def test_kernel_gqa_groups():
     )
 
 
+def _chunk_reference(q4, pk, pv, table, start, window=None, kv_mask=None):
+    """Slot-space multi-query reference: query t sees pos <= start + t."""
+    return jnp.stack(
+        [
+            _reference(
+                q4[:, t], pk, pv, table, start + t,
+                window=window, kv_mask=kv_mask,
+            )
+            for t in range(q4.shape[1])
+        ],
+        axis=1,
+    )
+
+
+@pytest.mark.parametrize("unroll", [1, 3])
+@pytest.mark.parametrize("window", [None, 40])
+def test_kernel_multi_query_chunk(unroll, window):
+    """4-D q (the speculative-verify shape): each chunk query applies
+    its own slot-space causality in one pass over the pool."""
+    rng, _, pk, pv, table, _ = _setup(seed=10)
+    b, qw, heads, hd = 4, 5, 8, 64
+    P_ps = table.shape[1] * pk.shape[1]
+    q4 = jnp.asarray(rng.standard_normal((b, qw, heads, hd)), jnp.float32)
+    # Chunk start positions: keep start + qw - 1 inside capacity.
+    start = jnp.asarray(rng.integers(0, P_ps - qw, size=b), jnp.int32)
+    out = paged_decode_attention(
+        q4, pk, pv, table, start,
+        window=window, pages_per_step=unroll, interpret=True,
+    )
+    assert out.shape == (b, qw, heads, hd)
+    ref = _chunk_reference(q4, pk, pv, table, start, window=window)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_kernel_multi_query_gqa_and_mask():
+    rng, _, pk, pv, table, _ = _setup(seed=11, heads=8, kv=4)
+    b, qw, heads, hd = 4, 3, 8, 64
+    P_ps = table.shape[1] * pk.shape[1]
+    q4 = jnp.asarray(rng.standard_normal((b, qw, heads, hd)), jnp.float32)
+    start = jnp.asarray(rng.integers(0, P_ps - qw, size=b), jnp.int32)
+    kv_mask = jnp.asarray(rng.random((b, P_ps)) > 0.2)
+    kv_mask = kv_mask.at[:, 0].set(True)
+    out = paged_decode_attention(
+        q4, pk, pv, table, start, kv_mask=kv_mask, interpret=True
+    )
+    ref = _chunk_reference(q4, pk, pv, table, start, kv_mask=kv_mask)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_kernel_multi_query_qw1_equals_decode():
+    """The folded multi-query path at qw == 1 is the decode kernel."""
+    _, q, pk, pv, table, lengths = _setup(seed=12)
+    a = paged_decode_attention(q, pk, pv, table, lengths, interpret=True)
+    b4 = paged_decode_attention(
+        q[:, None], pk, pv, table, lengths, interpret=True
+    )
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b4[:, 0]))
+
+
 # ---------------------------------------------------------------- engine
 
 
