@@ -26,11 +26,13 @@ forward), so this module computes the GRADIENTS ITSELF inside one
     under ``jax.vjp`` (rematerialisation is inherent: nothing but the
     boundary is ever stored) and accumulates f32 parameter grads;
   * the head (final norm + unembed + CE with z-loss) runs on the last
-    stage inside the same slot, producing UNNORMALISED sums
-    (ce_sum, z_sum, denominator) and the cotangent of d(ce_sum +
-    z_coef * z_sum)/dh. The custom_vjp backward scales every stored
-    gradient by cot / denominator — normalisation distributes over the
-    sum, so grads of the MEAN loss come out exactly;
+    stage inside the same slot, producing UNNORMALISED per-row
+    ce/z sums and the cotangent of d((ce_sum + z_coef * z_sum)/den)/dh
+    — the denominator is just the mask sum, known BEFORE the scan, so
+    the head VJP seeds with 1/den and every cotangent in the scan is
+    already d(final loss)/d(·) (this is also what lets MoE aux
+    cotangents, constants, ride the same backward). The custom_vjp
+    backward is then one multiply by the incoming loss cotangent;
   * the custom_vjp's residuals ARE the gradients ("self-grad" pattern):
     the forward computes them; the backward is one multiply.
 
@@ -47,11 +49,13 @@ A = mb*s*d; in-layer activations are remat'ed in BOTH schedules):
 At M = 4P the boundary stash shrinks ~2.6x; for M >> P it approaches
 M/(2P).
 
-Scope: dense Transformer training path (no MoE aux, no packed
-segment_ids — use the looped pipeline for those). Numerics match the
-looped pipeline/sequential scan to float tolerance; grads are f32.
-Validated mesh envelope: pp, pp x tp, pp x fsdp, pp x dp x fsdp and
-pp x tp x fsdp (tests + the driver dryrun).
+Scope: the Transformer training path — dense or MoE (router aux
+losses accumulate on the forward; their constant pre-normalised
+cotangents join the stage VJP on the backward), packed segment_ids
+and explicit positions ride as per-microbatch extras. Numerics match
+the looped pipeline/sequential scan to float tolerance; grads are
+f32. Validated mesh envelope: pp, pp x tp, pp x fsdp, pp x dp x fsdp
+and pp x tp x fsdp (tests + the driver dryrun).
 
 SPMD-uniformity notes (the root causes behind the round-2 "cannot
 compose with fsdp" limitation, each with its fix in place):
@@ -99,13 +103,28 @@ from jax.sharding import Mesh, PartitionSpec as P
 from shifu_tpu.ops import rms_norm, rope_frequencies
 
 
-def _build_1f1b(layer_fn, head_fn, mesh: Mesh, axis: str):
-    """The shard_map program: returns per-stage grads + loss sums."""
+def _build_1f1b(layer_fn, head_fn, mesh: Mesh, axis: str,
+                has_aux: bool = False, aux_cot=None):
+    """The shard_map program: returns per-stage grads + loss sums.
+
+    ``has_aux``: layer_fn returns ``(h, aux)`` (f32 scalar pytree — the
+    MoE router losses). The forward accumulates validity-masked aux
+    sums for reporting; the backward feeds ``aux_cot`` (the CONSTANT
+    d(final loss)/d(aux sum) — e.g. lb_coef / (n_layers * n_micro)) as
+    the aux cotangent of the stage VJP, so router gradients flow in the
+    same backward pass as the activation cotangents. This only works
+    because cotangents are pre-normalised: the head VJP seeds with
+    1/denominator (known before the scan — it is just the mask sum), so
+    CE and aux cotangents share one scale and one ppermute.
+    """
     n_stages = mesh.shape[axis]
     fwd_perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
     bwd_perm = [((i + 1) % n_stages, i) for i in range(n_stages)]
 
-    def shard_body(params_local, head_params, x_local, tgt, msk, extras):
+    def shard_body(
+        params_local, head_params, x_local, tgt, msk, extras, per_mb,
+        inv_den,
+    ):
         stage = jax.lax.axis_index(axis)
         n_micro = x_local.shape[0]
         stash_len = 2 * n_stages - 1
@@ -113,16 +132,29 @@ def _build_1f1b(layer_fn, head_fn, mesh: Mesh, axis: str):
         compute_dtype = jax.tree_util.tree_leaves(params_local)[0].dtype
         boundary_dtype = x_local.dtype
 
-        def run_stage(p_loc, h):
+        def run_stage(p_loc, h, mbe):
             def body(carry, lp):
-                return layer_fn(lp, carry.astype(compute_dtype), extras), None
+                out = layer_fn(lp, carry.astype(compute_dtype), (extras, mbe))
+                if has_aux:
+                    return out[0], jax.tree_util.tree_map(
+                        lambda a: a.astype(jnp.float32), out[1]
+                    )
+                return out, None
 
-            out, _ = jax.lax.scan(body, h.astype(compute_dtype), p_loc)
+            out, auxes = jax.lax.scan(body, h.astype(compute_dtype), p_loc)
+            if has_aux:  # sum over this stage's layers (f32 scalars)
+                return out.astype(boundary_dtype), jax.tree_util.tree_map(
+                    lambda a: jnp.sum(a), auxes
+                )
             return out.astype(boundary_dtype)
 
         def head_vjp(h, targets, mask):
-            """Unnormalised PER-ROW loss sums and the cotangent of
-            (ce_sum + z_coef * z_sum) w.r.t. h and the head params.
+            """Unnormalised PER-ROW ce/z sums and the cotangent of
+            (ce_sum + z_coef * z_sum) / den w.r.t. h and the head
+            params (the 1/den seed pre-normalises every downstream
+            cotangent — see _build_1f1b docstring; the denominator
+            itself is plain data, computed from the mask OUTSIDE the
+            scan).
 
             Per-row (not scalar) sums are load-bearing under partial-
             manual partitioning: a scalar sum over fsdp-sharded rows
@@ -131,14 +163,14 @@ def _build_1f1b(layer_fn, head_fn, mesh: Mesh, axis: str):
             pp stage executes, which deadlocks (see module docstring).
             Row vectors keep every op here row-local; the reduction
             happens outside the shard_map, in uniform code."""
-            _, vjp, (ce_r, z_r, den_r) = jax.vjp(
+            _, vjp, (ce_r, z_r) = jax.vjp(
                 lambda hh, hp: _head_objective(
                     head_fn, hh.astype(compute_dtype), hp, targets, mask
                 ),
                 h, head_params, has_aux=True,
             )
-            dh, dhp = vjp(jnp.float32(1.0))
-            return (ce_r, z_r, den_r), dh.astype(boundary_dtype), dhp
+            dh, dhp = vjp(inv_den)
+            return (ce_r, z_r), dh.astype(boundary_dtype), dhp
 
         zero_pgrads = jax.tree_util.tree_map(
             lambda a: jnp.zeros(a.shape, jnp.float32), params_local
@@ -152,8 +184,18 @@ def _build_1f1b(layer_fn, head_fn, mesh: Mesh, axis: str):
             lambda a: jnp.zeros(a.shape, a.dtype), head_params
         )
 
+        def mbe_at(m):
+            # This microbatch's per-mb extras (packed segment_ids,
+            # per-row rope tables) — empty dict when none.
+            return jax.tree_util.tree_map(
+                lambda a: jax.lax.dynamic_index_in_dim(
+                    a, m, 0, keepdims=False
+                ),
+                per_mb,
+            )
+
         def slot(carry, s):
-            (h_prev, cot_prev, stash, pg, hg, dx, sums) = carry
+            (h_prev, cot_prev, stash, pg, hg, dx, sums, aux_acc) = carry
             recv_f = jax.lax.ppermute(h_prev, axis, fwd_perm)
             # ORDER the two ring permutes. They are data-independent, and
             # XLA:CPU's thunk executor runs independent collectives
@@ -178,7 +220,15 @@ def _build_1f1b(layer_fn, head_fn, mesh: Mesh, axis: str):
                 x_local, mFc, 0, keepdims=False
             )
             h_in = jnp.where(stage == 0, mb_in, recv_f)
-            h_out = run_stage(params_local, h_in)
+            mbeF = mbe_at(mFc)
+            if has_aux:
+                h_out, auxF = run_stage(params_local, h_in, mbeF)
+                aux_acc = jax.tree_util.tree_map(
+                    lambda acc, a: acc + jnp.where(validF, a, 0.0),
+                    aux_acc, auxF,
+                )
+            else:
+                h_out = run_stage(params_local, h_in, mbeF)
             # Invalid F slots must NOT clobber a live stash entry (the
             # drain phase clips mF onto real microbatch indices whose
             # backward may still be pending).
@@ -209,12 +259,12 @@ def _build_1f1b(layer_fn, head_fn, mesh: Mesh, axis: str):
 
             def skip_head(_):
                 z = jnp.zeros((mb_rows,), jnp.float32)
-                return (z, z, z), jnp.zeros_like(h_out), zero_hgrads_c
+                return (z, z), jnp.zeros_like(h_out), zero_hgrads_c
 
-            (ce_r, z_r, den_r), head_cot, dhp = jax.lax.cond(
+            (ce_r, z_r), head_cot, dhp = jax.lax.cond(
                 at_head, do_head, skip_head, None
             )
-            sums = (sums[0] + ce_r, sums[1] + z_r, sums[2] + den_r)
+            sums = (sums[0] + ce_r, sums[1] + z_r)
             hg = jax.tree_util.tree_map(
                 lambda acc, g: acc + g.astype(jnp.float32), hg, dhp
             )
@@ -227,8 +277,25 @@ def _build_1f1b(layer_fn, head_fn, mesh: Mesh, axis: str):
                 stash, mBc % stash_len, 0, keepdims=False
             )
             cot_in = jnp.where(stage == n_stages - 1, head_cot, recv_b)
-            _, stage_vjp = jax.vjp(run_stage, params_local, h_in_b)
-            dp, dh_in = stage_vjp(cot_in.astype(boundary_dtype))
+            mbeB = mbe_at(mBc)
+            _, stage_vjp = jax.vjp(
+                lambda pl, hh: run_stage(pl, hh, mbeB),
+                params_local, h_in_b,
+            )
+            if has_aux:
+                # The aux sums' cotangent is a CONSTANT (coef / (L*M),
+                # pre-normalised like everything else) — zeroed on
+                # invalid slots so drain-phase re-runs of clipped
+                # microbatches add nothing.
+                acm = jax.tree_util.tree_map(
+                    lambda c: jnp.where(validB, jnp.float32(c), 0.0),
+                    aux_cot,
+                )
+                dp, dh_in = stage_vjp(
+                    (cot_in.astype(boundary_dtype), acm)
+                )
+            else:
+                dp, dh_in = stage_vjp(cot_in.astype(boundary_dtype))
             pg = jax.tree_util.tree_map(
                 lambda acc, g: acc
                 + jnp.where(validB, g.astype(jnp.float32), 0.0),
@@ -248,10 +315,15 @@ def _build_1f1b(layer_fn, head_fn, mesh: Mesh, axis: str):
                 mBc,
                 0,
             )
-            return (h_out, dh_in, stash, pg, hg, dx, sums), None
+            return (h_out, dh_in, stash, pg, hg, dx, sums, aux_acc), None
 
         mb_shape = x_local[0]
         zrow = jnp.zeros((x_local.shape[1],), jnp.float32)
+        aux0 = None
+        if has_aux:
+            aux0 = jax.tree_util.tree_map(
+                lambda _: jnp.zeros((), jnp.float32), aux_cot
+            )
         init = (
             jnp.zeros_like(mb_shape),
             jnp.zeros_like(mb_shape),
@@ -259,24 +331,25 @@ def _build_1f1b(layer_fn, head_fn, mesh: Mesh, axis: str):
             zero_pgrads,
             zero_hgrads,
             jnp.zeros(x_local.shape, boundary_dtype),
-            (zrow, zrow, zrow),
+            (zrow, zrow),
+            aux0,
         )
-        (_, _, _, pg, hg, dx, sums), _ = jax.lax.scan(
+        (_, _, _, pg, hg, dx, sums, aux_acc), _ = jax.lax.scan(
             slot, init, jnp.arange(n_slots)
         )
         # Per-stage leading axis on everything (out_specs pins pp there):
         # block grads reassemble into the stacked layer axis; head grads
         # and sums add up across stages (only the last stage's are
-        # nonzero); dx is real only on stage 0.
+        # nonzero); dx is real only on stage 0; aux sums add over stages.
         lead = lambda t: jax.tree_util.tree_map(lambda a: a[None], t)
-        return lead(pg), lead(hg), lead(dx), lead(sums)
+        return lead(pg), lead(hg), lead(dx), lead(sums), lead(aux_acc)
 
     return jax.jit(
         jax.shard_map(
             shard_body,
             mesh=mesh,
-            in_specs=(P(axis), P(), P(), P(), P(), P()),
-            out_specs=(P(axis), P(axis), P(axis), P(axis)),
+            in_specs=(P(axis), P(), P(), P(), P(), P(), P(), P()),
+            out_specs=(P(axis), P(axis), P(axis), P(axis), P(axis)),
             axis_names={axis},
             check_vma=False,
         )
@@ -286,8 +359,8 @@ def _build_1f1b(layer_fn, head_fn, mesh: Mesh, axis: str):
 def _head_objective(head_fn, h, head_params, targets, mask):
     """(ce_sum + z_coef*z_sum) as the differentiated scalar; PER-ROW
     sums as aux (row-local — see head_vjp for why)."""
-    ce_r, z_r, den_r, z_coef = head_fn(h, head_params, targets, mask)
-    return jnp.sum(ce_r) + z_coef * jnp.sum(z_r), (ce_r, z_r, den_r)
+    ce_r, z_r, z_coef = head_fn(h, head_params, targets, mask)
+    return jnp.sum(ce_r) + z_coef * jnp.sum(z_r), (ce_r, z_r)
 
 
 class Pipelined1F1BModel:
@@ -303,30 +376,43 @@ class Pipelined1F1BModel:
 
     ``loss`` is differentiable (custom_vjp): its forward computes loss
     AND gradients on the 1F1B schedule; value_and_grad's backward just
-    scales them. Dense models only (no MoE aux path, no packed
-    segment_ids).
+    scales them. MoE models ride the same schedule: router aux losses
+    accumulate on the forward and their (constant, pre-normalised)
+    cotangents join the stage VJP on the backward. Packed segment_ids
+    and explicit positions ship as per-microbatch extras.
     """
 
     def __init__(self, model, *, mesh: Mesh, microbatches: int,
                  axis: str = "pp"):
         cfg = model.cfg
-        if getattr(cfg, "n_experts", 0):
-            raise NotImplementedError(
-                "1F1B schedule supports dense models; MoE aux losses "
-                "ride the looped pipeline (PipelinedModel)"
-            )
         self.inner = model
         self.cfg = cfg
         self.mesh = mesh
         self.microbatches = microbatches
         self.axis = axis
+        has_aux = bool(getattr(cfg, "n_experts", 0))
 
         def layer_fn(layer_p, h, extras):
-            sin, cos = extras
-            out, _, _ = model._block(layer_p, h, sin, cos, None, None, None)
-            return out
+            shared, mbe = extras
+            sin = mbe.get("sin", shared[0] if shared else None)
+            cos = mbe.get("cos", shared[1] if shared else None)
+            seg = mbe.get("seg")
+            out, _, aux = model._block(layer_p, h, sin, cos, seg, None, None)
+            return (out, aux) if has_aux else out
 
         z_coef = float(cfg.z_loss)
+        # d(final loss)/d(per-stage aux sums): the aggregate aux is the
+        # layer-and-microbatch MEAN (matching PipelinedModel /
+        # model.loss), so each summed term's cotangent is coef / (L*M).
+        # "dropped" is reporting-only — zero cotangent.
+        aux_cot = None
+        if has_aux:
+            denom_lm = float(cfg.n_layers * microbatches)
+            aux_cot = {
+                "lb": float(cfg.moe_lb_coef) / denom_lm,
+                "rz": float(cfg.moe_rz_coef) / denom_lm,
+                "dropped": 0.0,
+            }
 
         def head_fn(h, head_params, targets, mask):
             """Unnormalised PER-ROW CE/z sums for ONE microbatch (f32).
@@ -349,12 +435,16 @@ class Pipelined1F1BModel:
             return (
                 jnp.sum(ce * w_, axis=-1),
                 jnp.sum(z * w_, axis=-1),
-                jnp.sum(w_, axis=-1),
                 jnp.float32(z_coef),
             )
 
-        self._fn = _build_1f1b(layer_fn, head_fn, mesh, axis)
+        self._fn = _build_1f1b(
+            layer_fn, head_fn, mesh, axis, has_aux=has_aux,
+            aux_cot=aux_cot,
+        )
         self._model = model
+        self._has_aux = has_aux
+        self._aux_cot = aux_cot
 
         # --- the differentiable pipelined loss -----------------------
         @jax.custom_vjp
@@ -366,14 +456,6 @@ class Pipelined1F1BModel:
             model_ = self._model
             cfg_ = self.cfg
             tokens = batch["tokens"]
-            if batch.get("segment_ids") is not None:
-                raise NotImplementedError(
-                    "packed segment_ids: use the looped pipeline"
-                )
-            if batch.get("positions") is not None:
-                raise NotImplementedError(
-                    "explicit positions: use the looped pipeline"
-                )
             b, s_full = tokens.shape
             M = self.microbatches
             if b % M:
@@ -399,13 +481,29 @@ class Pipelined1F1BModel:
                 and h.dtype == jnp.bfloat16
             ):
                 h = h.astype(jnp.float32)
-            positions = jnp.arange(s)
+            mb = b // M
+            d = h.shape[-1]
+            # Rope tables + packed-segment extras. Shared tables (no
+            # explicit positions) replicate to every slot; per-row
+            # tables and segment_ids ship per-microbatch, indexed by
+            # the slot's mF/mB inside the scan.
+            positions = batch.get("positions")
+            positions = (
+                jnp.arange(s) if positions is None else positions[:, :-1]
+            )
             sin, cos = rope_frequencies(
                 cfg_.resolved_head_dim, positions, theta=cfg_.rope_theta,
                 scaling=cfg_.rope_scaling,
             )
-            mb = b // M
-            d = h.shape[-1]
+            per_mb = {}
+            shared = (sin, cos)
+            if sin.ndim == 3:  # (b, s, hd/2): per-row positions
+                per_mb["sin"] = sin.reshape(M, mb, *sin.shape[1:])
+                per_mb["cos"] = cos.reshape(M, mb, *cos.shape[1:])
+                shared = None
+            seg = batch.get("segment_ids")
+            if seg is not None:
+                per_mb["seg"] = seg[:, :-1].reshape(M, mb, s)
             head_params = {
                 "final_norm": p["final_norm"],
                 "unembed": (
@@ -447,14 +545,23 @@ class Pipelined1F1BModel:
             # pp x tp x fsdp meshes — suppress them for this trace.
             from shifu_tpu.parallel.ctx import no_activation_sharding
 
+            # The denominator is data, not model output — computing it
+            # UP FRONT lets the head VJP seed with 1/den, so every
+            # cotangent in the scan (CE and MoE aux alike) is already
+            # d(final loss)/d(·) and the custom_vjp backward is one
+            # multiply by the incoming loss cotangent.
+            den = jnp.maximum(jnp.sum(msk), 1.0)
+            inv_den = (1.0 / den).astype(jnp.float32)
             with no_activation_sharding():
-                pg, hg, dx, sums = self._fn(
+                pg, hg, dx, sums, aux_acc = self._fn(
                     p["blocks"],
                     head_params,
                     h.reshape(M, mb, s, d),
                     tgt.reshape(M, mb, s),
                     msk.reshape(M, mb, s),
-                    (sin, cos),
+                    shared,
+                    per_mb,
+                    inv_den,
                 )
             # Reassemble: block grads carry the stacked layer axis back
             # (the per-stage leading axis IS the pp sharding of layers);
@@ -467,19 +574,34 @@ class Pipelined1F1BModel:
             dx = dx[0].reshape(b, s, d)
             ce_s = sums[0].sum()
             z_s = sums[1].sum()
-            den = jnp.maximum(sums[2].sum(), 1.0)
             loss = (ce_s + float(cfg_.z_loss) * z_s) / den
             aux = {"ce": ce_s / den, "z": z_s / den, "denominator": den}
-            return loss, aux, (pg, hg, dx, den, inp)
+            if self._has_aux:
+                # Layer-and-microbatch mean, matching PipelinedModel /
+                # model.loss semantics.
+                n_layers = cfg_.n_layers
+                moe_aux = jax.tree_util.tree_map(
+                    lambda a: a.sum() / (n_layers * M), aux_acc
+                )
+                loss = (
+                    loss
+                    + float(cfg_.moe_lb_coef) * moe_aux["lb"]
+                    + float(cfg_.moe_rz_coef) * moe_aux["rz"]
+                )
+                aux.update({f"moe_{k}": v for k, v in moe_aux.items()})
+            return loss, aux, (pg, hg, dx, inp)
 
         def fwd(params, batch):
             loss, aux, grads = _forward(params, batch)
             return (loss, aux), (params, grads)
 
         def bwd(res, g):
-            params, (pg, hg, dx, den, inp) = res
+            params, (pg, hg, dx, inp) = res
             # aux is reporting-only; its cotangent (g[1]) is dropped.
-            scale = g[0] / den
+            # Grads are already d(loss)/d(·) — the 1/den normalisation
+            # rode the head VJP's seed — so the only scale left is the
+            # incoming loss cotangent itself.
+            scale = g[0]
             # Embed grad: transpose of the gather. Expressed as a
             # one-hot matmul rather than a scatter-add: the SPMD
             # partitioner handles a dot over a (vocab->tp, embed->fsdp)
