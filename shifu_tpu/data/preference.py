@@ -1,0 +1,75 @@
+"""Preference-pair pipeline for DPO: (prompt, chosen, rejected) ->
+the train/dpo.py batch contract.
+
+Each completion encodes exactly like an SFT example (response-only loss
+mask, EOS terminator, left-truncated prompt — data/sft.py's fitting
+rules), yielding paired rows:
+
+    {"chosen_tokens": (n, s) int32, "chosen_mask": (n, s) f32,
+     "rejected_tokens": (n, s), "rejected_mask": (n, s)}
+
+The two completions of a pair share the prompt but encode
+independently: they may truncate differently when lengths differ, which
+is correct — each row's mask covers its own response predictions, and
+the DPO loss only ever compares per-row SUMS.
+
+Reference parity note: the upstream reference (klyan/shifu) is an empty
+repository (SURVEY.md); there is no reference pipeline to match.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from shifu_tpu.data.sft import encode_examples
+
+# (prompt_ids, chosen_ids, rejected_ids)
+Pair = Tuple[Sequence[int], Sequence[int], Sequence[int]]
+
+
+def encode_pairs(
+    pairs: Sequence[Pair],
+    seq_len: int,
+    *,
+    eos_id: Optional[int] = None,
+    pad_id: int = 0,
+):
+    """One pair per row-pair, right-padded to ``seq_len``."""
+    chosen = encode_examples(
+        [(p, c) for p, c, _ in pairs], seq_len, eos_id=eos_id, pad_id=pad_id
+    )
+    rejected = encode_examples(
+        [(p, r) for p, _, r in pairs], seq_len, eos_id=eos_id, pad_id=pad_id
+    )
+    return {
+        "chosen_tokens": chosen["tokens"],
+        "chosen_mask": chosen["mask"],
+        "rejected_tokens": rejected["tokens"],
+        "rejected_mask": rejected["mask"],
+    }
+
+
+def iter_pair_batches(
+    pairs: Sequence[Pair],
+    batch_size: int,
+    seq_len: int,
+    *,
+    eos_id: Optional[int] = None,
+    pad_id: int = 0,
+    drop_remainder: bool = True,
+    seed: Optional[int] = None,
+):
+    """Yield preference batches of ``batch_size`` pairs — in corpus
+    order by default, shuffled when ``seed`` is given."""
+    order = np.arange(len(pairs))
+    if seed is not None:
+        np.random.default_rng(seed).shuffle(order)
+    for at in range(0, len(order), batch_size):
+        idx = order[at : at + batch_size]
+        if len(idx) < batch_size and drop_remainder:
+            return
+        yield encode_pairs(
+            [pairs[i] for i in idx], seq_len, eos_id=eos_id, pad_id=pad_id
+        )

@@ -11,6 +11,13 @@ from shifu_tpu.train.optimizer import (
     wsd,
 )
 from shifu_tpu.train.loop import Trainer, TrainLoopConfig, evaluate
+from shifu_tpu.train.dpo import (
+    DPOConfig,
+    DPOModel,
+    dpo_loss,
+    reference_logprobs,
+    sequence_logprobs,
+)
 from shifu_tpu.train.lora import LoraConfig, LoraModel, merge_lora
 from shifu_tpu.train.ema import WithEMA, ema_params
 from shifu_tpu.train.step import (
@@ -39,6 +46,11 @@ __all__ = [
     "Trainer",
     "TrainLoopConfig",
     "evaluate",
+    "DPOConfig",
+    "DPOModel",
+    "dpo_loss",
+    "reference_logprobs",
+    "sequence_logprobs",
     "TrainState",
     "create_sharded_state",
     "make_train_step",

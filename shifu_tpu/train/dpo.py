@@ -1,0 +1,208 @@
+"""Direct Preference Optimization (DPO) on the shifu_tpu train stack.
+
+DPO fine-tunes a policy directly on preference pairs (prompt, chosen,
+rejected) without a reward model or RL loop: the implicit reward of a
+completion is ``beta * (log pi(y|x) - log ref(y|x))`` and the loss is a
+logistic (or IPO squared) objective on the chosen-vs-rejected reward
+margin [Rafailov et al., 2023; Azar et al., 2023 for IPO].
+
+TPU-first mechanics:
+
+  * ONE policy forward per step scores both completions — chosen and
+    rejected rows concatenate along the batch axis, so the MXU sees one
+    (2b, s) batch instead of two half-sized launches, and the train
+    step stays a single jit (microbatching/donation/sharding all ride
+    the existing ``make_train_step``).
+  * The frozen REFERENCE model's log-probs are computed OUTSIDE the
+    train step (:func:`reference_logprobs`, one jitted forward per
+    batch) and ride the batch as two (b,) arrays. Closing the train
+    step over ``ref_params`` would embed hundreds of MB of constants in
+    the program (the same trap infer/spec_engine.py documents) and
+    re-score the reference every gradient microbatch; as data, the ref
+    forward runs exactly once per batch and the step's HBM working set
+    holds ONE model + optimizer state, not two models.
+  * :class:`DPOModel` quacks like the wrapped model (loss/specs/axes/
+    init), so ``create_sharded_state``/``make_train_step``/the trainer
+    loop work unchanged on any mesh.
+
+Batch contract (see data/preference.py for the encoder):
+
+    {"chosen_tokens": (b, s) int32, "chosen_mask": (b, s) f32,
+     "rejected_tokens": (b, s), "rejected_mask": (b, s),
+     "ref_chosen_lp": (b,) f32, "ref_rejected_lp": (b,) f32}
+
+masks weight the loss-bearing positions exactly like SFT
+(``mask[i, t]`` covers PREDICTING token t — response tokens + EOS).
+``reference_free=True`` drops the two ref entries (ref logprobs 0).
+
+Reference parity note: the upstream reference (klyan/shifu) is an empty
+repository (SURVEY.md); there is no reference DPO implementation to
+match. The objective follows the published DPO/IPO formulations.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class DPOConfig:
+    """``beta``: inverse temperature of the implicit reward.
+    ``label_smoothing``: conservative-DPO smoothing (assumes this
+    fraction of preference labels are flipped). ``loss_type``:
+    "sigmoid" (standard DPO) or "ipo" (squared hinge to 1/(2*beta) —
+    bounded, no winner-take-all collapse). ``reference_free``: score
+    against a uniform reference (ref logprobs identically 0)."""
+
+    beta: float = 0.1
+    label_smoothing: float = 0.0
+    loss_type: str = "sigmoid"
+    reference_free: bool = False
+
+    def __post_init__(self):
+        if self.loss_type not in ("sigmoid", "ipo"):
+            raise ValueError(
+                f"loss_type must be 'sigmoid' or 'ipo', got {self.loss_type!r}"
+            )
+        if not 0.0 <= self.label_smoothing < 0.5:
+            raise ValueError(
+                "label_smoothing must be in [0, 0.5) — 0.5 erases the "
+                f"preference signal entirely, got {self.label_smoothing}"
+            )
+        if self.label_smoothing > 0.0 and self.loss_type == "ipo":
+            raise ValueError(
+                "label_smoothing applies to the sigmoid objective only; "
+                "IPO's squared loss has no smoothing term — it would be "
+                "silently ignored"
+            )
+        if self.beta <= 0.0:
+            raise ValueError(f"beta must be > 0, got {self.beta}")
+
+
+def sequence_logprobs(model, params, tokens, mask):
+    """Per-row sum of target log-probs: sum_t mask[t] * log p(tok_t).
+
+    tokens (b, s); mask (b, s) weighting the PREDICTION of each token
+    (the SFT convention — data/sft.py builds exactly this). Returns
+    (b,) f32. The (b, s, vocab) logits materialise for one forward;
+    at DPO batch sizes this is the straightforward-and-fast path (the
+    fused-CE machinery exists for the pretraining loss, where batches
+    are an order of magnitude larger).
+    """
+    logits = model(params, tokens[:, :-1])
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    lp = jnp.take_along_axis(
+        logp, tokens[:, 1:][..., None], axis=-1
+    )[..., 0]
+    return jnp.sum(lp * mask[:, 1:].astype(jnp.float32), axis=-1)
+
+
+def reference_logprobs(model, ref_params, batch):
+    """Augment ``batch`` with the frozen reference's per-row logprobs.
+
+    Run this OUTSIDE the train step (jit it once per shape); the train
+    step then never touches ``ref_params`` (module docstring). Returns
+    a new dict with "ref_chosen_lp"/"ref_rejected_lp" added.
+    """
+    b = batch["chosen_tokens"].shape[0]
+    tokens = jnp.concatenate(
+        [batch["chosen_tokens"], batch["rejected_tokens"]], axis=0
+    )
+    mask = jnp.concatenate(
+        [batch["chosen_mask"], batch["rejected_mask"]], axis=0
+    )
+    lp = sequence_logprobs(model, ref_params, tokens, mask)
+    out = dict(batch)
+    out["ref_chosen_lp"] = jax.lax.stop_gradient(lp[:b])
+    out["ref_rejected_lp"] = jax.lax.stop_gradient(lp[b:])
+    return out
+
+
+def dpo_loss(model, cfg: DPOConfig, params, batch):
+    """(loss, aux) for one preference batch — ``make_train_step``'s
+    ``model.loss`` contract (aux carries the standard DPO telemetry:
+    implicit rewards, margin, preference accuracy)."""
+    b = batch["chosen_tokens"].shape[0]
+    tokens = jnp.concatenate(
+        [batch["chosen_tokens"], batch["rejected_tokens"]], axis=0
+    )
+    mask = jnp.concatenate(
+        [batch["chosen_mask"], batch["rejected_mask"]], axis=0
+    )
+    lp = sequence_logprobs(model, params, tokens, mask)
+    pi_c, pi_r = lp[:b], lp[b:]
+    if cfg.reference_free:
+        ref_c = jnp.zeros_like(pi_c)
+        ref_r = jnp.zeros_like(pi_r)
+    else:
+        if "ref_chosen_lp" not in batch:
+            raise ValueError(
+                "batch lacks ref_chosen_lp/ref_rejected_lp — run "
+                "reference_logprobs(model, ref_params, batch) first, or "
+                "set DPOConfig(reference_free=True)"
+            )
+        ref_c = batch["ref_chosen_lp"].astype(jnp.float32)
+        ref_r = batch["ref_rejected_lp"].astype(jnp.float32)
+
+    # h: the centred reward margin; beta*h is what the sigmoid sees.
+    h = (pi_c - pi_r) - (ref_c - ref_r)
+    beta = jnp.float32(cfg.beta)
+    if cfg.loss_type == "ipo":
+        per_pair = jnp.square(h - 1.0 / (2.0 * beta))
+    else:
+        ls = jnp.float32(cfg.label_smoothing)
+        logits = beta * h
+        per_pair = (
+            -(1.0 - ls) * jax.nn.log_sigmoid(logits)
+            - ls * jax.nn.log_sigmoid(-logits)
+        )
+    loss = jnp.mean(per_pair)
+    reward_c = beta * (pi_c - ref_c)
+    reward_r = beta * (pi_r - ref_r)
+    aux = {
+        "reward_chosen": jnp.mean(reward_c),
+        "reward_rejected": jnp.mean(reward_r),
+        "reward_margin": jnp.mean(reward_c - reward_r),
+        "accuracy": jnp.mean((h > 0).astype(jnp.float32)),
+        # Pairs per (micro)batch: lets make_train_step's microbatch aux
+        # weighting treat uneven splits correctly.
+        "denominator": jnp.float32(b),
+    }
+    return loss, aux
+
+
+class DPOModel:
+    """Adapter: the wrapped model's ``loss`` becomes the DPO objective.
+
+    Plugs into the existing train stack on any mesh::
+
+        dm = DPOModel(model, DPOConfig(beta=0.1))
+        state = create_sharded_state(dm, opt, rng, mesh)
+        step = make_train_step(dm, opt, mesh)
+        ref_fn = jax.jit(lambda b: reference_logprobs(model, ref_params, b))
+        for batch in batches:
+            state, metrics = step(state, ref_fn(batch))
+
+    ``ref_params`` is typically the SFT checkpoint the run started from
+    (state.params at step 0).
+    """
+
+    def __init__(self, model, dpo_cfg: DPOConfig = DPOConfig()):
+        self.inner = model
+        self.cfg = model.cfg
+        self.dpo_cfg = dpo_cfg
+
+    def loss(self, params, batch):
+        return dpo_loss(self.inner, self.dpo_cfg, params, batch)
+
+    def specs(self):
+        return self.inner.specs()
+
+    def axes(self):
+        return self.inner.axes()
+
+    def init(self, rng):
+        return self.inner.init(rng)
