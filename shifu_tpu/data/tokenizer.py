@@ -106,6 +106,17 @@ class HFTokenizer:
     def decode(self, ids: Sequence[int]) -> str:
         return self._tok.decode(list(ids), skip_special_tokens=True)
 
+    def apply_chat_template(self, messages, *, add_generation_prompt=True):
+        """Render a chat message list to token ids via the underlying
+        HF tokenizer's chat template (raises when the tokenizer has
+        none configured — callers fall back to a generic rendering;
+        see infer/server.py ``_chat_tokens``)."""
+        return self._tok.apply_chat_template(
+            messages,
+            add_generation_prompt=add_generation_prompt,
+            tokenize=True,
+        )
+
 
 def tokenize_corpus(
     texts: Iterable[str],

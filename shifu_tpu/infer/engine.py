@@ -31,6 +31,8 @@ import jax.numpy as jnp
 
 from shifu_tpu.infer.sampling import (
     SampleConfig,
+    apply_penalties,
+    penalty_params,
     row_params,
     sample_logits,
     sample_logits_per_row,
@@ -107,6 +109,7 @@ class Engine:
         mesh=None,
         sharding_rules=None,
         per_request_sampling: bool = False,
+        enable_penalties: bool = False,
         tokenizer=None,
     ):
         """``per_request_sampling``: temperature/top-k/top-p become
@@ -133,6 +136,16 @@ class Engine:
         activation-sharding constraints are recorded while tracing the
         engine's programs. ``sharding_rules`` must match what
         shard_params used (default: the shared DEFAULT_RULES).
+
+        ``enable_penalties``: maintain per-slot occurrence counts of
+        GENERATED tokens ((max_slots, vocab) int32, host-mirrored,
+        carried through the decode-chunk scan) and apply
+        presence/frequency/repetition penalties to the raw logits
+        before sampling — per-request strengths with
+        ``per_request_sampling``, else the engine-level config's.
+        Auto-enabled when ``sample_cfg`` carries penalties. Off by
+        default: the counts buffer costs slots x vocab x 4 bytes of
+        host->device traffic per dispatch.
 
         ``tokenizer``: optional; needed only for STRING stop sequences
         (``submit(..., stop_strings=...)`` — the sweep decodes the
@@ -176,10 +189,28 @@ class Engine:
         # host arrays fed to the programs as traced values — admission
         # writes a slot's entries, nothing recompiles.
         self.per_request_sampling = bool(per_request_sampling)
-        t0, k0, p0 = row_params(sample_cfg)
+        t0, k0, p0, mp0 = row_params(sample_cfg)
         self._row_temp = np.full((max_slots,), t0, np.float32)
         self._row_topk = np.full((max_slots,), k0, np.int32)
         self._row_topp = np.full((max_slots,), p0, np.float32)
+        self._row_minp = np.full((max_slots,), mp0, np.float32)
+
+        # Penalty state (enable_penalties): per-slot strengths + a
+        # host-mirrored (slots, vocab) count of GENERATED tokens. The
+        # decode programs take these as traced args; the chunk scan
+        # carries the counts so mid-chunk emissions penalise the very
+        # next step.
+        self.enable_penalties = bool(enable_penalties) or (
+            sample_cfg.has_penalties
+        )
+        pp0, fp0, rp0 = penalty_params(sample_cfg)
+        self._row_pres = np.full((max_slots,), pp0, np.float32)
+        self._row_freq = np.full((max_slots,), fp0, np.float32)
+        self._row_rep = np.full((max_slots,), rp0, np.float32)
+        if self.enable_penalties:
+            self._counts = np.zeros(
+                (max_slots, self.model.cfg.vocab_size), np.int32
+            )
 
         self._prefill_jit = jax.jit(
             self._in_act_ctx(self._prefill_impl),
@@ -217,6 +248,16 @@ class Engine:
                 "per-request sampling requires "
                 "Engine(per_request_sampling=True); this engine samples "
                 "with its engine-level SampleConfig"
+            )
+        if (
+            sampling is not None
+            and sampling.has_penalties
+            and not self.enable_penalties
+        ):
+            raise ValueError(
+                "per-request penalties require "
+                "Engine(enable_penalties=True) — the counts buffer is "
+                "not maintained otherwise"
             )
         if stop_token_ids is not None:
             stop_token_ids = [
@@ -367,6 +408,8 @@ class Engine:
                 req.logprobs.append(float(lps[slot]))
                 self._lengths[slot] += 1
                 self._cur[slot] = token
+                if self.enable_penalties:
+                    self._counts[slot, token] += 1
         else:
             remaining = np.zeros((self.max_slots,), np.int32)
             for slot, req in self._active.items():
@@ -386,6 +429,9 @@ class Engine:
                 req.logprobs.extend(float(x) for x in lps[slot, :n])
                 self._lengths[slot] = int(lengths2[slot])
                 self._cur[slot] = int(cur2[slot])
+                if self.enable_penalties:
+                    for t in toks[slot, :n]:
+                        self._counts[slot, int(t)] += 1
 
     def _try_admit(self, req: "_Request") -> bool:
         """Admit ``req`` (a free slot is guaranteed by the caller).
@@ -398,8 +444,10 @@ class Engine:
         row (paged: page allocation)."""
 
     def _decode_extra_args(self) -> tuple:
-        """Extra positional args for _decode_impl, before rng."""
-        return self._sampling_args()
+        """Extra positional args for _decode_impl, before rng:
+        per-slot sampling arrays, then penalty arrays (flat; impls
+        re-split with _split_extra)."""
+        return self._sampling_args() + self._penalty_args()
 
     # -------------------------------------------- per-request sampling
     def _sampling_args(self) -> tuple:
@@ -410,21 +458,75 @@ class Engine:
             jnp.asarray(self._row_temp),
             jnp.asarray(self._row_topk),
             jnp.asarray(self._row_topp),
+            jnp.asarray(self._row_minp),
         )
 
     def _req_sampling_args(self, req: _Request) -> tuple:
         """Traced (1,) sampling arrays for one request's prefill."""
         if not self.per_request_sampling:
             return ()
-        t, k, p = row_params(req.sampling or self.sample_cfg)
+        t, k, p, mp = row_params(req.sampling or self.sample_cfg)
         return (
             jnp.asarray([t], jnp.float32),
             jnp.asarray([k], jnp.int32),
             jnp.asarray([p], jnp.float32),
+            jnp.asarray([mp], jnp.float32),
         )
 
-    def _sample_rows(self, logits, rng, samp: tuple):
-        """Engine-level static sampler, or the per-row traced one."""
+    def _req_penalty_args(self, req: _Request) -> tuple:
+        """Traced (1, ...) penalty arrays for one request's prefill —
+        counts over the tokens it has ALREADY generated (zeros for a
+        fresh request, the resumed generation for a preemption
+        recompute, so the re-prefill's sample is penalised exactly like
+        the decode it replaces)."""
+        if not self.enable_penalties:
+            return ()
+        counts = np.zeros((1, self.model.cfg.vocab_size), np.int32)
+        if req.generated:
+            np.add.at(counts[0], np.asarray(req.generated, np.int64), 1)
+        pp, fp, rp = penalty_params(req.sampling or self.sample_cfg)
+        return (
+            jnp.asarray(counts),
+            jnp.asarray([pp], jnp.float32),
+            jnp.asarray([fp], jnp.float32),
+            jnp.asarray([rp], jnp.float32),
+        )
+
+    def _penalty_args(self) -> tuple:
+        """Traced penalty arrays: (counts, presence, frequency,
+        repetition) — () when penalties are disabled."""
+        if not self.enable_penalties:
+            return ()
+        return (
+            jnp.asarray(self._counts),
+            jnp.asarray(self._row_pres),
+            jnp.asarray(self._row_freq),
+            jnp.asarray(self._row_rep),
+        )
+
+    def _split_extra(self, rest: tuple):
+        """Parse a program's trailing args into (lead, samp, pen, rng)
+        — the flat layout _decode_extra_args produced, parsed from the
+        END so subclass-specific leading extras (the paged engine's
+        page table) pass through untouched."""
+        rng = rest[-1]
+        rest = rest[:-1]
+        pen = ()
+        if self.enable_penalties:
+            pen = tuple(rest[-4:])
+            rest = rest[:-4]
+        samp = ()
+        if self.per_request_sampling:
+            samp = tuple(rest[-4:])
+            rest = rest[:-4]
+        return tuple(rest), samp, pen, rng
+
+    def _sample_rows(self, logits, rng, samp: tuple, pen: tuple = ()):
+        """Engine-level static sampler, or the per-row traced one —
+        penalties (when enabled) transform the raw logits first."""
+        if pen:
+            counts, pres, freq, rep = pen
+            logits = apply_penalties(logits, counts, pres, freq, rep)
         if not samp:
             return sample_logits(logits, rng, self.sample_cfg)
         return sample_logits_per_row(logits, rng, *samp)
@@ -442,25 +544,33 @@ class Engine:
         (slots, K), logprobs (slots, K), n_emitted (slots,), cur,
         lengths, cache).
         """
-        *extra, rng = rest
+        lead, samp, pen, rng = self._split_extra(rest)
         k = self.decode_chunk
         eos = self.eos_id
+        counts0 = pen[0] if pen else None
 
         def body(carry, t):
-            cache, cur, lengths, done = carry
+            cache, cur, lengths, done, counts = carry
             live = active & ~done & (t < remaining)
+            pen_t = (counts, *pen[1:]) if pen else ()
             nxt, lp, cache = self._decode_impl(
-                params, cache, cur, lengths, live, *extra,
+                params, cache, cur, lengths, live, *lead, *samp, *pen_t,
                 jax.random.fold_in(rng, t),
             )
+            if pen:
+                # Mid-chunk emissions penalise the very next step; the
+                # host rebuilds its mirror from the emitted tokens.
+                counts = counts.at[
+                    jnp.arange(self.max_slots), nxt
+                ].add(live.astype(jnp.int32))
             lengths = jnp.where(live, lengths + 1, lengths)
             if eos is not None:
                 done = done | (live & (nxt == eos))
-            return (cache, nxt, lengths, done), (nxt, lp, live)
+            return (cache, nxt, lengths, done, counts), (nxt, lp, live)
 
         done0 = jnp.zeros((self.max_slots,), bool)
-        (cache, cur, lengths, _), (toks, lps, lives) = jax.lax.scan(
-            body, (cache, cur, lengths, done0), jnp.arange(k)
+        (cache, cur, lengths, _, _), (toks, lps, lives) = jax.lax.scan(
+            body, (cache, cur, lengths, done0, counts0), jnp.arange(k)
         )
         return (
             toks.T,  # (slots, K)
@@ -479,14 +589,16 @@ class Engine:
             )
         )
 
-    def _make_cache(self, init_fn):
+    def _make_cache(self, init_fn, axes_model=None):
         """Build the cache; on a mesh, create it DIRECTLY into its
         shards (jit with out_shardings, like sharding.init_sharded for
         params) — allocate-then-reshard would materialise the full pool
         on one chip and OOM exactly the aggregate-HBM-sized caches mesh
         serving exists for. Models expose ``cache_logical_axes``;
         without it the cache is replicated — correct, just not
-        memory-scaled."""
+        memory-scaled. ``axes_model``: whose axes to consult (default
+        the engine's model; the speculative engine passes its DRAFT for
+        the dense draft cache)."""
         if self.mesh is None:
             return init_fn()
         from jax.sharding import NamedSharding
@@ -494,7 +606,11 @@ class Engine:
         from shifu_tpu.parallel.sharding import DEFAULT_RULES, spec_for
 
         rules = self.sharding_rules or DEFAULT_RULES
-        axes_fn = getattr(self.model, "cache_logical_axes", None)
+        axes_fn = getattr(
+            axes_model if axes_model is not None else self.model,
+            "cache_logical_axes",
+            None,
+        )
         logical = axes_fn() if axes_fn is not None else None
 
         def sharding_of(shape_struct):
@@ -661,7 +777,8 @@ class Engine:
         padded[:p] = req.tokens
         self._rng, sub = jax.random.split(self._rng)
         first, lp = self._dispatch_prefill(
-            slot, padded, p, bucket, sub, self._req_sampling_args(req)
+            slot, padded, p, bucket, sub,
+            self._req_sampling_args(req) + self._req_penalty_args(req),
         )
         self._finish_admission(req, slot, p, first, lp)
 
@@ -683,15 +800,29 @@ class Engine:
 
     def _finish_admission(self, req: _Request, slot, p, first, lp) -> None:
         """Shared post-prefill bookkeeping, dense and paged."""
+        cfg = req.sampling or self.sample_cfg
         if self.per_request_sampling:
-            t, k, pp = row_params(req.sampling or self.sample_cfg)
+            t, k, pp, mp = row_params(cfg)
             self._row_temp[slot] = t
             self._row_topk[slot] = k
             self._row_topp[slot] = pp
+            self._row_minp[slot] = mp
         self._lengths[slot] = p
         self._cur[slot] = int(first)
         req.generated.append(int(first))
         req.logprobs.append(float(lp))
+        if self.enable_penalties:
+            self._row_pres[slot], self._row_freq[slot], self._row_rep[slot] = (
+                penalty_params(cfg)
+            )
+            # Rebuild this slot's counts from the request's generated
+            # tokens — correct for fresh admissions (just the first
+            # token) AND preemption-recompute re-admissions (the whole
+            # resumed generation).
+            self._counts[slot] = 0
+            np.add.at(
+                self._counts[slot], np.asarray(req.generated, np.int64), 1
+            )
         self._active[slot] = req
         # A 1-token budget can finish at admission; step() sweeps it on
         # the next call via the normal bookkeeping (generated >= budget).
@@ -699,8 +830,9 @@ class Engine:
     def _prefill_impl(self, params, cache, tokens, length, slot, *rest,
                       bucket):
         """Prefill one request into cache row ``slot``; sample token 1.
-        ``rest`` = optional per-request sampling triple, then rng."""
-        *samp, rng = rest
+        ``rest`` = optional per-request sampling arrays, optional
+        penalty arrays, then rng."""
+        _, samp, pen, rng = self._split_extra(rest)
         row = jax.tree_util.tree_map(
             lambda c: jax.lax.dynamic_slice_in_dim(c, slot, 1, axis=1),
             cache,
@@ -737,15 +869,16 @@ class Engine:
             cache,
             row,
         )
-        tok = self._sample_rows(logits[:, 0], rng, tuple(samp))[0]
+        tok = self._sample_rows(logits[:, 0], rng, samp, pen)[0]
         lp = _token_logprob(logits[:, 0], tok[None])[0]
         return tok, lp, cache
 
     def _decode_impl(self, params, cache, cur, lengths, active, *rest):
         """One (token, logprob) for every slot (inactive slots compute
         but are ignored — static shapes beat host-side gather/scatter
-        here). ``rest`` = optional per-slot sampling triple, then rng."""
-        *samp, rng = rest
+        here). ``rest`` = optional per-slot sampling arrays, optional
+        penalty arrays, then rng (_split_extra's layout)."""
+        _, samp, pen, rng = self._split_extra(rest)
         kv_mask = (
             jnp.arange(self.max_len)[None, :] <= lengths[:, None]
         )
@@ -756,7 +889,7 @@ class Engine:
             cache_index=lengths,  # per-row write offsets
             kv_mask=kv_mask,
         )
-        nxt = self._sample_rows(logits[:, -1], rng, tuple(samp))
+        nxt = self._sample_rows(logits[:, -1], rng, samp, pen)
         lp = _token_logprob(logits[:, -1], nxt)
         # Freeze inactive slots' cur so their cache rows stay untouched in
         # spirit (they are written, but their lengths never advance).
@@ -1174,7 +1307,7 @@ class PagedEngine(Engine):
         padded = np.zeros((bucket,), np.int32)
         padded[: len(suffix)] = suffix
         self._rng, sub = jax.random.split(self._rng)
-        samp = self._req_sampling_args(req)
+        samp = self._req_sampling_args(req) + self._req_penalty_args(req)
         if hit:
             first, lp = self._dispatch_prefill_at(
                 slot, padded, len(suffix), hit, bucket, sub, samp=samp,
@@ -1272,7 +1405,10 @@ class PagedEngine(Engine):
             first, lp = self._dispatch_prefill_at(
                 slot, padded, this_chunk, off, bucket, sub,
                 row=row[: self.pages_per_slot] if narrow else row,
-                samp=self._req_sampling_args(req),
+                samp=(
+                    self._req_sampling_args(req)
+                    + self._req_penalty_args(req)
+                ),
                 final_len=len(prompt),
             )
             # Bucket-tail pages hold only masked garbage; return them.
@@ -1338,9 +1474,9 @@ class PagedEngine(Engine):
         their frequency regime off it, so every chunk bakes the same
         frequencies a one-shot prefill of the whole prompt would (a
         mid-prompt chunk's own max position would pick a shorter, WRONG
-        regime). ``rest`` = optional per-request sampling triple, then
-        rng."""
-        *samp, rng = rest
+        regime). ``rest`` = optional per-request sampling arrays,
+        optional penalty arrays, then rng."""
+        _, samp, pen, rng = self._split_extra(rest)
         pos = jnp.minimum(
             offset + jnp.arange(bucket), offset + length - 1
         )
@@ -1354,7 +1490,7 @@ class PagedEngine(Engine):
             logits_at=(length - 1)[None],
             rope_regime_len=final_len,
         )
-        tok = self._sample_rows(logits[:, 0], rng, tuple(samp))[0]
+        tok = self._sample_rows(logits[:, 0], rng, samp, pen)[0]
         lp = _token_logprob(logits[:, 0], tok[None])[0]
         return tok, lp, cache
 
@@ -1385,14 +1521,19 @@ class PagedEngine(Engine):
         self._ensure_decode_pages(k)
 
     def _decode_extra_args(self) -> tuple:
-        return (jnp.asarray(self._table),) + self._sampling_args()
+        return (
+            (jnp.asarray(self._table),)
+            + self._sampling_args()
+            + self._penalty_args()
+        )
 
     # ----------------------------------------------------------- programs
     def _prefill_impl(self, params, cache, tokens, length, table_row,
                       *rest, bucket):
         """Prefill one request straight into its pages; sample token 1.
-        ``rest`` = optional per-request sampling triple, then rng."""
-        *samp, rng = rest
+        ``rest`` = optional per-request sampling arrays, optional
+        penalty arrays, then rng."""
+        _, samp, pen, rng = self._split_extra(rest)
         logits, cache = self.model(
             params,
             tokens[None, :],
@@ -1404,14 +1545,15 @@ class PagedEngine(Engine):
             page_table=table_row[None, :],
             logits_at=(length - 1)[None],
         )
-        tok = self._sample_rows(logits[:, 0], rng, tuple(samp))[0]
+        tok = self._sample_rows(logits[:, 0], rng, samp, pen)[0]
         lp = _token_logprob(logits[:, 0], tok[None])[0]
         return tok, lp, cache
 
     def _decode_impl(self, params, cache, cur, lengths, active, table,
                      *rest):
-        # ``rest`` = optional per-slot sampling triple, then rng.
-        *samp, rng = rest
+        # ``rest`` = optional per-slot sampling arrays, optional penalty
+        # arrays, then rng (_split_extra's layout).
+        _, samp, pen, rng = self._split_extra(rest)
         # No kv_mask: on the paged path it would be ``pos <= lengths`` —
         # exactly the slot-space causality the decode attention already
         # enforces from ``cache_index`` (both the Pallas kernel and the
@@ -1426,6 +1568,6 @@ class PagedEngine(Engine):
             cache_index=lengths,
             page_table=table,
         )
-        nxt = self._sample_rows(logits[:, -1], rng, tuple(samp))
+        nxt = self._sample_rows(logits[:, -1], rng, samp, pen)
         lp = _token_logprob(logits[:, -1], nxt)
         return jnp.where(active, nxt, cur), lp, cache

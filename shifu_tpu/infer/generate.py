@@ -42,7 +42,11 @@ def make_generate_fn(
       model: a Transformer-family module (needs ``__call__`` with
         cache/cache_index/kv_mask and ``init_cache``).
       max_new_tokens: static decode budget; output buffer size.
-      sample_cfg: static sampler settings.
+      sample_cfg: static sampler settings. Penalty fields are
+        REJECTED: they need per-sequence occurrence counts, which the
+        serving engines maintain (Engine/PagedEngine with
+        enable_penalties) and this stateless path does not — silently
+        ignoring them would misreport what was sampled.
       eos_id: stop a row once it emits this token (None = never stop early).
       pad_id: fills output rows after EOS and dead prompt slots.
 
@@ -55,6 +59,13 @@ def make_generate_fn(
           "lengths": (batch,) int32 generated-token counts (incl. eos)}
     """
     eos = -1 if eos_id is None else eos_id
+
+    if sample_cfg.has_penalties:
+        raise NotImplementedError(
+            "repetition/presence/frequency penalties need per-sequence "
+            "occurrence counts — use Engine/PagedEngine with "
+            "enable_penalties=True"
+        )
 
     @jax.jit
     def fn(params, prompts, lengths, rng):

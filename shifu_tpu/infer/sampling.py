@@ -31,11 +31,27 @@ class SampleConfig:
       probability-sorted vocab whose mass reaches top_p. The first token
       crossing the threshold is kept (standard inclusive convention), so
       top_p -> 0 degrades to greedy, never to an empty support.
+    min_p: keep only tokens whose probability is >= min_p times the
+      most likely token's, measured on the TEMPERATURE-SCALED
+      distribution before other filters (the vLLM convention); composes
+      by intersection with top-k/top-p. The argmax always survives, so
+      the support never empties.
+    presence_penalty / frequency_penalty: OpenAI-style additive
+      penalties over tokens already GENERATED in the request
+      (presence: flat subtraction for any occurrence; frequency:
+      per-occurrence). Applied to the raw logits before temperature.
+    repetition_penalty: HF-style multiplicative penalty (> 1 discourages
+      repeats) over generated tokens: positive logits divide by it,
+      negative multiply. Applied before the additive penalties.
     """
 
     temperature: float = 1.0
     top_k: Optional[int] = None
     top_p: Optional[float] = None
+    min_p: Optional[float] = None
+    presence_penalty: float = 0.0
+    frequency_penalty: float = 0.0
+    repetition_penalty: float = 1.0
 
     def __post_init__(self):
         if self.temperature < 0:
@@ -44,6 +60,30 @@ class SampleConfig:
             raise ValueError(f"top_k must be >= 1, got {self.top_k}")
         if self.top_p is not None and not (0.0 < self.top_p <= 1.0):
             raise ValueError(f"top_p must be in (0, 1], got {self.top_p}")
+        if self.min_p is not None and not (0.0 < self.min_p <= 1.0):
+            raise ValueError(f"min_p must be in (0, 1], got {self.min_p}")
+        # Penalties are unconditional floats (no None-disables-it
+        # convention — their identities are 0.0/0.0/1.0). A None here
+        # would construct fine and then kill the engine thread at
+        # penalty_params()'s float() — validate at the boundary.
+        for name in (
+            "presence_penalty", "frequency_penalty", "repetition_penalty"
+        ):
+            v = getattr(self, name)
+            if not isinstance(v, (int, float)) or isinstance(v, bool):
+                raise ValueError(f"{name} must be a number, got {v!r}")
+        if self.repetition_penalty <= 0.0:
+            raise ValueError(
+                f"repetition_penalty must be > 0, got {self.repetition_penalty}"
+            )
+
+    @property
+    def has_penalties(self) -> bool:
+        return (
+            self.presence_penalty != 0.0
+            or self.frequency_penalty != 0.0
+            or self.repetition_penalty != 1.0
+        )
 
 
 def _apply_top_k(logits, k: int):
@@ -67,20 +107,55 @@ def _apply_top_p(logits, p: float):
     return jnp.where(logits >= threshold, logits, NEG_INF)
 
 
+def _apply_min_p(filtered, scaled, min_p):
+    """Drop tokens with p < min_p * p_max on the SCALED distribution
+    (normalisers cancel: p_i/p_max == exp(x_i - x_max)), intersected
+    with whatever ``filtered`` already masked."""
+    thresh = jnp.max(scaled, axis=-1, keepdims=True) + jnp.log(min_p)
+    return jnp.where(scaled >= thresh, filtered, NEG_INF)
+
+
 def filtered_logits(logits, cfg: SampleConfig):
-    """Temperature + top-k + top-p filtered logits (cfg.temperature > 0).
+    """Temperature + top-k + top-p + min-p filtered logits
+    (cfg.temperature > 0).
 
     The single filtering implementation behind both :func:`sample_logits`
     and the speculative-decoding probability computation — the two must
     describe the same distribution or verification would be against a
     different sampler than the one configured.
     """
-    logits = logits.astype(jnp.float32) / cfg.temperature
+    scaled = logits.astype(jnp.float32) / cfg.temperature
+    logits = scaled
     if cfg.top_k is not None and cfg.top_k < logits.shape[-1]:
         logits = _apply_top_k(logits, cfg.top_k)
     if cfg.top_p is not None and cfg.top_p < 1.0:
         logits = _apply_top_p(logits, cfg.top_p)
+    if cfg.min_p is not None and cfg.min_p > 0.0:
+        logits = _apply_min_p(logits, scaled, cfg.min_p)
     return logits
+
+
+def apply_penalties(logits, counts, presence, frequency, repetition):
+    """Penalise already-generated tokens on the RAW logits (before
+    temperature), per row with traced strengths.
+
+    Args:
+      logits: (batch, vocab) raw model logits.
+      counts: (batch, vocab) int32 — occurrence counts of each token in
+        the row's GENERATED output so far (the engines maintain this;
+        prompt tokens are not counted — the OpenAI convention).
+      presence: (batch,) f32 — flat subtraction where counts > 0.
+      frequency: (batch,) f32 — per-occurrence subtraction.
+      repetition: (batch,) f32 — HF multiplicative penalty where
+        counts > 0 (identity at 1.0), applied first.
+    """
+    seen = counts > 0
+    x = logits.astype(jnp.float32)
+    rp = repetition[:, None]
+    x = jnp.where(seen, jnp.where(x > 0, x / rp, x * rp), x)
+    x = x - jnp.where(seen, presence[:, None], 0.0)
+    x = x - frequency[:, None] * counts.astype(jnp.float32)
+    return x
 
 
 def sample_logits(logits, rng, cfg: SampleConfig = SampleConfig()):
@@ -93,19 +168,30 @@ def sample_logits(logits, rng, cfg: SampleConfig = SampleConfig()):
 
 
 def row_params(cfg: SampleConfig):
-    """Lower a SampleConfig to the (temperature, top_k, top_p) scalars
-    the per-row sampler traces over (disabled filters become their
-    identity values — top_k clamps to the vocab in the sampler — so one
-    compiled program covers every config)."""
+    """Lower a SampleConfig to the (temperature, top_k, top_p, min_p)
+    scalars the per-row sampler traces over (disabled filters become
+    their identity values — top_k clamps to the vocab in the sampler —
+    so one compiled program covers every config)."""
     return (
         float(cfg.temperature),
         int(cfg.top_k) if cfg.top_k is not None else 1 << 30,
         float(cfg.top_p) if cfg.top_p is not None else 1.0,
+        float(cfg.min_p) if cfg.min_p is not None else 0.0,
     )
 
 
-def filtered_logits_per_row(logits, temperature, top_k, top_p):
-    """Per-row temperature/top-k/top-p filtered logits with TRACED
+def penalty_params(cfg: SampleConfig):
+    """Lower a SampleConfig to the (presence, frequency, repetition)
+    scalars :func:`apply_penalties` traces over."""
+    return (
+        float(cfg.presence_penalty),
+        float(cfg.frequency_penalty),
+        float(cfg.repetition_penalty),
+    )
+
+
+def filtered_logits_per_row(logits, temperature, top_k, top_p, min_p=None):
+    """Per-row temperature/top-k/top-p/min-p filtered logits with TRACED
     hyperparameters — the per-row counterpart of :func:`filtered_logits`
     (same composition order, same inclusive-crossing nucleus).
 
@@ -116,15 +202,16 @@ def filtered_logits_per_row(logits, temperature, top_k, top_p):
         sample_logits_per_row / the speculative verifier's one-hot).
       top_k: (batch,) int32 — vocab_size (or any >= vocab) disables.
       top_p: (batch,) f32 — 1.0 disables.
+      min_p: (batch,) f32 — 0.0 disables (None = all disabled).
     """
     t = jnp.where(temperature <= 0.0, 1.0, temperature)[:, None]
     return _filtered_scaled_per_row(
-        logits.astype(jnp.float32) / t, top_k, top_p
+        logits.astype(jnp.float32) / t, top_k, top_p, min_p
     )
 
 
-def _filtered_scaled_per_row(x, top_k, top_p):
-    """Full-sort top-k/top-p filter over already temperature-scaled
+def _filtered_scaled_per_row(x, top_k, top_p, min_p=None):
+    """Full-sort top-k/top-p/min-p filter over already temperature-scaled
     ``x`` — the exact reference path (and the fast path's fallback)."""
     b, v = x.shape
     sorted_desc = jnp.sort(x, axis=-1)[:, ::-1]
@@ -142,10 +229,20 @@ def _filtered_scaled_per_row(x, top_k, top_p):
     keep = cum < jnp.clip(top_p, 1e-9, 1.0)[:, None]
     kept = jnp.where(keep, sk, jnp.inf)
     pth = jnp.min(kept, axis=-1, keepdims=True)
-    return jnp.where(x >= jnp.maximum(kth, pth), x, NEG_INF)
+    thresh = jnp.maximum(kth, pth)
+    if min_p is not None:
+        # p_i/p_max == exp(x_i - x_max) on the scaled distribution, so
+        # min-p is one more value threshold (NEG_INF when disabled).
+        mpth = jnp.where(
+            min_p > 0.0,
+            sorted_desc[:, 0] + jnp.log(jnp.clip(min_p, 1e-9, 1.0)),
+            NEG_INF,
+        )[:, None]
+        thresh = jnp.maximum(thresh, mpth)
+    return jnp.where(x >= thresh, x, NEG_INF)
 
 
-def probs_per_row(logits, temperature, top_k, top_p):
+def probs_per_row(logits, temperature, top_k, top_p, min_p=None):
     """The EXACT per-row distribution sample_logits_per_row draws from:
     greedy rows (t <= 0) are one-hot argmax; the rest softmax their
     filtered logits. The speculative verifier needs this to accept
@@ -154,7 +251,7 @@ def probs_per_row(logits, temperature, top_k, top_p):
         jnp.argmax(logits, axis=-1), logits.shape[-1], dtype=jnp.float32
     )
     soft = jax.nn.softmax(
-        filtered_logits_per_row(logits, temperature, top_k, top_p),
+        filtered_logits_per_row(logits, temperature, top_k, top_p, min_p),
         axis=-1,
     )
     return jnp.where((temperature <= 0.0)[:, None], onehot, soft)
@@ -168,10 +265,12 @@ _PARTIAL_CAP = 128
 
 
 def sample_logits_per_row(logits, rng, temperature, top_k, top_p,
+                          min_p=None,
                           partial_cap: Optional[int] = _PARTIAL_CAP):
     """Per-row sampling with TRACED hyperparameters — one compiled
-    program serves any mix of greedy / temperature / top-k / top-p
-    rows (the continuous-batching engines' ``per_request_sampling``).
+    program serves any mix of greedy / temperature / top-k / top-p /
+    min-p rows (the continuous-batching engines'
+    ``per_request_sampling``).
 
     Args:
       logits: (batch, vocab).
@@ -179,6 +278,9 @@ def sample_logits_per_row(logits, rng, temperature, top_k, top_p,
       temperature: (batch,) f32 — 0.0 selects greedy argmax for that row.
       top_k: (batch,) int32 — vocab_size (or any >= vocab) disables.
       top_p: (batch,) f32 — 1.0 disables.
+      min_p: (batch,) f32 — 0.0 disables (None = all disabled). min-p
+        is a pure value threshold off the row max, so it is EXACT on
+        the fast path (no fallback pressure).
       partial_cap: width of the PARTIAL-SORT fast path (None/0
         disables). The full-vocab descending sort costs ~30% of a
         decode step at 128k vocabs; instead the kept set is built from
@@ -203,7 +305,7 @@ def sample_logits_per_row(logits, rng, temperature, top_k, top_p,
     x = logits.astype(jnp.float32) / t
 
     def slow_sample(rng):
-        filt = _filtered_scaled_per_row(x, top_k, top_p)
+        filt = _filtered_scaled_per_row(x, top_k, top_p, min_p)
         return jax.random.categorical(rng, filt, axis=-1).astype(jnp.int32)
 
     if not partial_cap or v <= 2 * partial_cap:
@@ -255,6 +357,14 @@ def sample_logits_per_row(logits, rng, temperature, top_k, top_p,
             NEG_INF,
         )
         thresh = jnp.maximum(kth, pth)
+        if min_p is not None:
+            # Depends only on the row max (vals[:, 0]) — exact at any cap.
+            mpth = jnp.where(
+                min_p > 0.0,
+                vals[:, 0] + jnp.log(jnp.clip(min_p, 1e-9, 1.0)),
+                NEG_INF,
+            )
+            thresh = jnp.maximum(thresh, mpth)
         filt = jnp.where(x >= thresh[:, None], x, NEG_INF)
         return jax.random.categorical(rng, filt, axis=-1).astype(jnp.int32)
 

@@ -85,19 +85,39 @@ def _trim_stop(text: str, stop_strings) -> str:
     return text[: min(cuts)] if cuts else text
 
 
-def _parse_sampling(req: dict) -> Optional[SampleConfig]:
+def _parse_sampling(req: dict, base: SampleConfig) -> Optional[SampleConfig]:
     """Per-request sampling fields -> SampleConfig, or None when absent.
-    Validation errors (negative temperature etc.) raise ValueError and
-    surface as a 400, like every other bad field."""
-    fields = ("temperature", "top_k", "top_p")
+
+    Fields the request does NOT set inherit from ``base`` (the engine's
+    configured sampling) — a request adding only a penalty to a greedy
+    engine stays greedy; defaulting temperature to 1.0 here would
+    silently flip it to stochastic sampling. Validation errors
+    (negative temperature etc.) raise ValueError and surface as a 400,
+    like every other bad field."""
+    fields = (
+        "temperature", "top_k", "top_p", "min_p",
+        "presence_penalty", "frequency_penalty", "repetition_penalty",
+    )
     if not any(f in req for f in fields):
         return None
+
+    def pick(name, conv, null):
+        """Field value: absent -> engine default; JSON null -> ``null``
+        (the field's OWN identity — None disables a filter, but a None
+        penalty would crash the engine thread at float() time, so
+        penalties null to their no-op strengths)."""
+        if name in req:
+            return null if req[name] is None else conv(req[name])
+        return getattr(base, name)
+
     return SampleConfig(
-        temperature=float(req.get("temperature", 1.0)),
-        top_k=(int(req["top_k"]) if req.get("top_k") is not None else None),
-        top_p=(
-            float(req["top_p"]) if req.get("top_p") is not None else None
-        ),
+        temperature=pick("temperature", float, base.temperature),
+        top_k=pick("top_k", int, None),
+        top_p=pick("top_p", float, None),
+        min_p=pick("min_p", float, None),
+        presence_penalty=pick("presence_penalty", float, 0.0),
+        frequency_penalty=pick("frequency_penalty", float, 0.0),
+        repetition_penalty=pick("repetition_penalty", float, 1.0),
     )
 
 
@@ -581,37 +601,113 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(404, {"error": f"no route {self.path}"})
 
     def do_POST(self):
-        if self.path != "/v1/completions":
+        if self.path == "/v1/completions":
+            self._handle_completions(chat=False)
+        elif self.path == "/v1/chat/completions":
+            self._handle_completions(chat=True)
+        else:
             self._send(404, {"error": f"no route {self.path}"})
-            return
+
+    def _chat_tokens(self, messages):
+        """Render a chat message list to prompt token ids.
+
+        Uses the tokenizer's chat template when it has one (the HF
+        adapter delegates to ``apply_chat_template`` with
+        add_generation_prompt=True); otherwise a plain generic
+        rendering (``<|role|>\\ncontent`` blocks + assistant header) so
+        template-less tokenizers still serve chat traffic."""
+        if not isinstance(messages, list) or not messages:
+            raise ValueError("'messages' must be a non-empty list")
+        for m in messages:
+            if (
+                not isinstance(m, dict)
+                or not isinstance(m.get("role"), str)
+                or not isinstance(m.get("content"), str)
+            ):
+                raise ValueError(
+                    "each message needs string 'role' and 'content'"
+                )
+        if self.tokenizer is None:
+            raise ValueError(
+                "chat completions need a server tokenizer (messages "
+                "must be rendered and encoded)"
+            )
+        apply = getattr(self.tokenizer, "apply_chat_template", None)
+        if apply is not None:
+            try:
+                # Explicit add_generation_prompt: raw HF tokenizers
+                # default it to False (the adapter defaults True) —
+                # without it the model would continue the user turn
+                # instead of answering it.
+                return [
+                    int(t)
+                    for t in apply(messages, add_generation_prompt=True)
+                ]
+            except ValueError:
+                # transformers raises ValueError for "no chat template
+                # configured" — THAT falls back to the generic
+                # rendering. Template-execution failures (jinja errors
+                # etc.) propagate and surface as a 400 instead of
+                # silently serving a rendering the model never saw.
+                pass
+        text = "".join(
+            f"<|{m['role']}|>\n{m['content']}\n" for m in messages
+        ) + "<|assistant|>\n"
+        return self.tokenizer.encode(text)
+
+    @staticmethod
+    def _as_chat_choice(choice: dict) -> dict:
+        """Completion choice -> chat shape (text moves into message)."""
+        out = dict(choice)
+        content = out.pop("text", None)
+        msg = {"role": "assistant"}
+        if content is not None:
+            msg["content"] = content
+        out["message"] = msg
+        return out
+
+    def _handle_completions(self, chat: bool):
         try:
             length = int(self.headers.get("Content-Length", 0))
             req = json.loads(self.rfile.read(length) or b"{}")
         except (ValueError, json.JSONDecodeError):
             self._send(400, {"error": "body must be JSON"})
             return
-        tokens = req.get("tokens")
-        prompt = req.get("prompt")
-        if (tokens is None) == (prompt is None):
-            self._send(
-                400, {"error": "exactly one of 'tokens'/'prompt' required"}
-            )
-            return
-        if prompt is not None:
-            if self.tokenizer is None:
+        if chat:
+            try:
+                tokens = self._chat_tokens(req.get("messages"))
+            except ValueError as e:
+                self._send(400, {"error": str(e)})
+                return
+            except Exception as e:
+                self._send(400, {"error": f"cannot render messages: {e!r}"})
+                return
+        else:
+            tokens = req.get("tokens")
+            prompt = req.get("prompt")
+            if (tokens is None) == (prompt is None):
                 self._send(
                     400,
-                    {"error": "no tokenizer configured; send 'tokens'"},
+                    {"error": "exactly one of 'tokens'/'prompt' required"},
                 )
                 return
-            try:
-                tokens = self.tokenizer.encode(prompt)
-            except Exception as e:  # non-string prompt etc. -> a clean 400
-                self._send(400, {"error": f"cannot tokenize prompt: {e!r}"})
-                return
+            if prompt is not None:
+                if self.tokenizer is None:
+                    self._send(
+                        400,
+                        {"error": "no tokenizer configured; send 'tokens'"},
+                    )
+                    return
+                try:
+                    tokens = self.tokenizer.encode(prompt)
+                except Exception as e:  # non-string prompt -> a clean 400
+                    self._send(
+                        400, {"error": f"cannot tokenize prompt: {e!r}"}
+                    )
+                    return
         try:
             max_new = int(req.get("max_new_tokens", self.default_max_new))
-            sampling = _parse_sampling(req)
+            sampling = _parse_sampling(req, self.runner.engine.sample_cfg)
             stop_strings = req.get("stop")
             if isinstance(stop_strings, str):
                 stop_strings = [stop_strings]
@@ -630,7 +726,7 @@ class _Handler(BaseHTTPRequestHandler):
                     )
                 self._stream_response(
                     tokens, max_new, sampling, stop_token_ids,
-                    stop_strings, want_logprobs,
+                    stop_strings, want_logprobs, chat=chat,
                 )
                 return
             if best_of is not None:
@@ -691,6 +787,8 @@ class _Handler(BaseHTTPRequestHandler):
                         except Exception as e:
                             c["text_error"] = repr(e)
                     choices.append(c)
+                if chat:
+                    choices = [self._as_chat_choice(c) for c in choices]
                 self._send(200, {"choices": choices})
                 return
             if n > 1:
@@ -699,18 +797,15 @@ class _Handler(BaseHTTPRequestHandler):
                     sampling=sampling, stop_token_ids=stop_token_ids,
                     stop_strings=stop_strings,
                 )
-                self._send(
-                    200,
-                    {
-                        "choices": [
-                            _build_choice(
-                                d, self.tokenizer, want_logprobs,
-                                stop_strings,
-                            )
-                            for d in dones
-                        ]
-                    },
-                )
+                choices = [
+                    _build_choice(
+                        d, self.tokenizer, want_logprobs, stop_strings
+                    )
+                    for d in dones
+                ]
+                if chat:
+                    choices = [self._as_chat_choice(c) for c in choices]
+                self._send(200, {"choices": choices})
                 return
             done = self.runner.complete(
                 tokens, max_new, timeout=self.request_timeout_s,
@@ -726,14 +821,15 @@ class _Handler(BaseHTTPRequestHandler):
         except RuntimeError as e:
             self._send(503, {"error": str(e)})
             return
-        self._send(
-            200,
-            _build_choice(done, self.tokenizer, want_logprobs, stop_strings),
+        choice = _build_choice(
+            done, self.tokenizer, want_logprobs, stop_strings
         )
+        self._send(200, self._as_chat_choice(choice) if chat else choice)
 
     def _stream_response(
         self, tokens, max_new: int, sampling=None,
         stop_token_ids=None, stop_strings=None, want_logprobs=False,
+        chat: bool = False,
     ) -> None:
         """Server-sent events: one ``data:`` line per token delta, a
         final one with finished_by (and the definitive token count —
@@ -769,7 +865,11 @@ class _Handler(BaseHTTPRequestHandler):
                         out["logprobs"] = lps
                     if self.tokenizer is not None:
                         try:
-                            out["text"] = self.tokenizer.decode(ids)
+                            text = self.tokenizer.decode(ids)
+                            if chat:
+                                out["delta"] = {"content": text}
+                            else:
+                                out["text"] = text
                         except Exception:
                             pass  # partial sequences may not decode
                     emit(out)
@@ -791,7 +891,12 @@ class _Handler(BaseHTTPRequestHandler):
                                 and stop_strings
                             ):
                                 text = _trim_stop(text, stop_strings)
-                            final["text"] = text
+                            if chat:
+                                final["message"] = {
+                                    "role": "assistant", "content": text,
+                                }
+                            else:
+                                final["text"] = text
                         except Exception:
                             pass
                     emit(final)

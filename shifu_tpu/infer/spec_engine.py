@@ -49,7 +49,7 @@ import jax
 import jax.numpy as jnp
 
 from shifu_tpu.infer.engine import PagedEngine, _token_logprob
-from shifu_tpu.infer.sampling import probs_per_row
+from shifu_tpu.infer.sampling import SampleConfig, probs_per_row
 from shifu_tpu.infer.speculative import _probs
 
 
@@ -91,10 +91,14 @@ class SpeculativePagedEngine(PagedEngine):
             )
         if k < 1 or rounds_per_step < 1:
             raise ValueError("k and rounds_per_step must be >= 1")
-        if kw.get("mesh") is not None:
+        if kw.get("enable_penalties") or kw.get(
+            "sample_cfg", SampleConfig(temperature=0.0)
+        ).has_penalties:
             raise NotImplementedError(
-                "speculative serving on a mesh needs a sharded draft "
-                "cache; serve tensor-parallel with PagedEngine for now"
+                "repetition/presence/frequency penalties inside the "
+                "speculative verifier need per-position counts that "
+                "depend on the SAME round's accepted prefix; serve "
+                "penalised requests with PagedEngine"
             )
         self.draft = draft
         self.draft_params = draft_params
@@ -112,9 +116,15 @@ class SpeculativePagedEngine(PagedEngine):
         # would silently shift a tail chunk down over real prompt K/V —
         # padding the cache is what makes every overshoot land on
         # slots nothing reads.
-        self.d_cache = draft.init_cache(
-            self.max_slots,
-            self.max_len + max(self.k + 1, self.buckets[-1]),
+        # On a mesh the draft cache is created directly into its shards
+        # (kv heads over tp via the DRAFT's cache_logical_axes — same
+        # mechanism as the target's pool; see Engine._make_cache).
+        self.d_cache = self._make_cache(
+            lambda: draft.init_cache(
+                self.max_slots,
+                self.max_len + max(self.k + 1, self.buckets[-1]),
+            ),
+            axes_model=draft,
         )
         self._draft_prefill_jit = jax.jit(
             self._in_act_ctx(self._draft_prefill_impl),
@@ -249,13 +259,14 @@ class SpeculativePagedEngine(PagedEngine):
             """(rows, V) -> each row's configured sampling distribution
             (the EXACT one the non-speculative engine draws from)."""
             if samp:
-                t, kk, pp = samp
+                t, kk, pp, mp = samp
                 reps = logits2d.shape[0] // t.shape[0]
                 return probs_per_row(
                     logits2d,
                     jnp.repeat(t, reps),
                     jnp.repeat(kk, reps),
                     jnp.repeat(pp, reps),
+                    jnp.repeat(mp, reps),
                 )
             return _probs(logits2d, self.sample_cfg)
 

@@ -119,6 +119,12 @@ def make_speculative_batch_fns(target, draft, k: int,
                                sample_cfg: SampleConfig):
     """Batched round programs: (target_prefill, draft_prefill),
     draft_k, verify, ingest — every row at its own offset."""
+    if sample_cfg.has_penalties:
+        raise NotImplementedError(
+            "repetition/presence/frequency penalties need per-sequence "
+            "occurrence counts the stateless speculative drivers do not "
+            "keep — use PagedEngine(enable_penalties=True)"
+        )
 
     def prefill(params, model, cache, tokens, lengths):
         logits, cache = model(

@@ -1,0 +1,270 @@
+"""min-p sampling + presence/frequency/repetition penalties.
+
+Pinned properties:
+  * apply_penalties against a hand-rolled numpy reference (HF
+    multiplicative repetition first, then the OpenAI additive terms,
+    only over generated-token counts);
+  * min-p masks exactly the tokens with p < min_p * p_max on the
+    temperature-scaled distribution, in the static filter, the per-row
+    exact path, and the partial-sort fast path (bit-equal fast == slow
+    — min-p is a pure value threshold off the row max);
+  * engine-level: a large presence penalty makes greedy decoding never
+    repeat a generated token; dense == paged == decode_chunk>1 under
+    penalties (counts carried through the chunk scan); per-request
+    penalties penalise only the requesting row;
+  * paged preemption-recompute replays the SAME penalised tokens (the
+    re-prefill's sample sees the resumed generation's counts);
+  * validation: per-request penalties need enable_penalties; the
+    speculative engine refuses penalties outright.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from shifu_tpu.infer import SampleConfig
+from shifu_tpu.infer.engine import Engine, PagedEngine
+from shifu_tpu.infer.sampling import (
+    apply_penalties,
+    filtered_logits,
+    sample_logits_per_row,
+)
+from shifu_tpu.models import Transformer, TransformerConfig
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    model = Transformer(TransformerConfig.tiny())
+    return model, model.init(jax.random.key(0))
+
+
+# ------------------------------------------------------------ primitives
+
+
+def test_apply_penalties_matches_numpy():
+    rng = np.random.default_rng(0)
+    logits = rng.standard_normal((3, 16)).astype(np.float32) * 2
+    counts = rng.integers(0, 4, size=(3, 16)).astype(np.int32)
+    pres = np.asarray([0.5, 0.0, 1.2], np.float32)
+    freq = np.asarray([0.1, 0.3, 0.0], np.float32)
+    rep = np.asarray([1.3, 1.0, 0.8], np.float32)
+
+    got = np.asarray(apply_penalties(
+        jnp.asarray(logits), jnp.asarray(counts),
+        jnp.asarray(pres), jnp.asarray(freq), jnp.asarray(rep),
+    ))
+    want = logits.copy()
+    for i in range(3):
+        for t in range(16):
+            if counts[i, t] > 0:
+                want[i, t] = (
+                    want[i, t] / rep[i] if want[i, t] > 0
+                    else want[i, t] * rep[i]
+                )
+                want[i, t] -= pres[i]
+            want[i, t] -= freq[i] * counts[i, t]
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+
+def test_min_p_static_filter_masks_exactly():
+    logits = jnp.asarray([[3.0, 2.0, 1.0, 0.0, -1.0]], jnp.float32)
+    cfg = SampleConfig(temperature=1.0, min_p=0.2)
+    out = np.asarray(filtered_logits(logits, cfg))[0]
+    p = np.exp(np.asarray(logits)[0] - 3.0)  # p_i / p_max
+    for i in range(5):
+        if p[i] >= 0.2:
+            assert np.isfinite(out[i]), i
+        else:
+            assert out[i] < -1e29, i
+
+
+def test_min_p_per_row_matches_static():
+    from shifu_tpu.infer.sampling import row_params, sample_logits
+
+    rng = np.random.default_rng(1)
+    logits = jnp.asarray(rng.standard_normal((5, 64)) * 3, jnp.float32)
+    cfg = SampleConfig(temperature=0.8, min_p=0.1)
+    t, k, p, mp = row_params(cfg)
+    for seed in range(5):
+        key = jax.random.key(seed)
+        ref = sample_logits(logits, key, cfg)
+        got = sample_logits_per_row(
+            logits, key,
+            jnp.full((5,), t, jnp.float32),
+            jnp.full((5,), k, jnp.int32),
+            jnp.full((5,), p, jnp.float32),
+            jnp.full((5,), mp, jnp.float32),
+        )
+        np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
+
+
+def test_min_p_fast_path_bit_equals_slow():
+    rng = np.random.default_rng(2)
+    v = 512
+    logits = jnp.asarray(rng.standard_normal((4, v)) * 2, jnp.float32)
+    temp = jnp.asarray([0.7, 1.0, 1.2, 0.9], jnp.float32)
+    topk = jnp.asarray([1 << 30, 40, 1 << 30, 5], jnp.int32)
+    topp = jnp.asarray([1.0, 0.9, 1.0, 1.0], jnp.float32)
+    minp = jnp.asarray([0.05, 0.0, 0.3, 0.1], jnp.float32)
+    for seed in range(5):
+        key = jax.random.key(seed)
+        fast = sample_logits_per_row(
+            logits, key, temp, topk, topp, minp, partial_cap=128
+        )
+        slow = sample_logits_per_row(
+            logits, key, temp, topk, topp, minp, partial_cap=None
+        )
+        np.testing.assert_array_equal(np.asarray(fast), np.asarray(slow))
+
+
+def test_sample_config_validation():
+    with pytest.raises(ValueError, match="min_p"):
+        SampleConfig(min_p=1.5)
+    with pytest.raises(ValueError, match="repetition_penalty"):
+        SampleConfig(repetition_penalty=0.0)
+    assert SampleConfig(presence_penalty=0.5).has_penalties
+    assert SampleConfig(repetition_penalty=1.2).has_penalties
+    assert not SampleConfig(temperature=0.7).has_penalties
+
+
+# --------------------------------------------------------------- engines
+
+
+def _run(eng, prompts, max_new, **skw):
+    rids = [eng.submit(p, max_new_tokens=max_new, **skw) for p in prompts]
+    out = {c.rid: c for c in eng.run()}
+    return [out[r].tokens for r in rids]
+
+
+def _prompts(seed, sizes):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(1, 256, size=n).tolist() for n in sizes]
+
+
+_NO_REPEAT = SampleConfig(temperature=0.0, presence_penalty=1e9)
+
+
+def test_engine_presence_penalty_never_repeats(tiny):
+    """Greedy + an effectively-infinite presence penalty: every
+    generated token is distinct (each emission bans itself)."""
+    model, params = tiny
+    kw = dict(max_slots=2, max_len=48, prefill_buckets=(16, 48),
+              sample_cfg=_NO_REPEAT)
+    for eng in (
+        Engine(model, params, **kw),
+        PagedEngine(model, params, page_size=8, **kw),
+    ):
+        outs = _run(eng, _prompts(0, (5, 9)), 12)
+        for toks in outs:
+            assert len(toks) == len(set(toks)), toks
+
+
+def test_engine_penalties_dense_paged_chunk_parity(tiny):
+    """The same penalised greedy stream from the dense engine, the
+    paged engine, and the K-step decode chunk (counts carried through
+    the on-device scan)."""
+    model, params = tiny
+    cfg = SampleConfig(
+        temperature=0.0, presence_penalty=0.7, frequency_penalty=0.2,
+        repetition_penalty=1.3,
+    )
+    kw = dict(max_slots=2, max_len=48, prefill_buckets=(16, 48),
+              sample_cfg=cfg)
+    prompts = _prompts(1, (6, 11))
+    ref = _run(Engine(model, params, **kw), prompts, 10)
+    paged = _run(PagedEngine(model, params, page_size=8, **kw), prompts, 10)
+    chunked = _run(
+        PagedEngine(model, params, page_size=8, decode_chunk=4, **kw),
+        prompts, 10,
+    )
+    assert ref == paged == chunked
+
+
+def test_engine_per_request_penalties_isolated(tiny):
+    """One penalised row, one plain greedy row: the greedy row matches
+    the no-penalty engine exactly; the penalised row never repeats."""
+    model, params = tiny
+    prompts = _prompts(2, (7, 7))
+    kw = dict(max_slots=2, max_len=48, prefill_buckets=(16, 48),
+              sample_cfg=SampleConfig(temperature=0.0))
+    plain = _run(PagedEngine(model, params, page_size=8, **kw), prompts, 10)
+    eng = PagedEngine(
+        model, params, page_size=8, per_request_sampling=True,
+        enable_penalties=True, **kw,
+    )
+    r0 = eng.submit(prompts[0], max_new_tokens=10, sampling=_NO_REPEAT)
+    r1 = eng.submit(prompts[1], max_new_tokens=10)
+    out = {c.rid: c.tokens for c in eng.run()}
+    assert len(out[r0]) == len(set(out[r0]))
+    assert out[r1] == plain[1]
+
+
+def test_paged_preemption_recompute_with_penalties(tiny):
+    """A pool small enough to force preemption: penalised greedy output
+    must equal the roomy-pool engine's (the recompute re-prefill
+    rebuilds the slot's counts from the resumed generation)."""
+    model, params = tiny
+    cfg = SampleConfig(temperature=0.0, presence_penalty=0.9,
+                       repetition_penalty=1.2)
+    prompts = _prompts(3, (5, 5))
+    kw = dict(max_slots=2, max_len=16, prefill_buckets=(8, 16),
+              sample_cfg=cfg)
+    roomy = _run(
+        PagedEngine(model, params, page_size=4, **kw), prompts, 8
+    )
+    tight = PagedEngine(model, params, page_size=4, n_pages=6, **kw)
+    got = _run(tight, prompts, 8)
+    assert tight.preemptions >= 1  # the pool pressure actually bit
+    assert got == roomy
+
+
+def test_penalty_validation(tiny):
+    model, params = tiny
+    eng = PagedEngine(
+        model, params, page_size=8, max_slots=1, max_len=32,
+        prefill_buckets=(16, 32), per_request_sampling=True,
+    )
+    with pytest.raises(ValueError, match="enable_penalties"):
+        eng.submit([1, 2, 3], max_new_tokens=2, sampling=_NO_REPEAT)
+
+
+def test_spec_engine_rejects_penalties(tiny):
+    from shifu_tpu.infer import SpeculativePagedEngine
+
+    model, params = tiny
+    with pytest.raises(NotImplementedError, match="penalties"):
+        SpeculativePagedEngine(
+            model, params, model, params,
+            max_slots=1, max_len=32, prefill_buckets=(16, 32),
+            sample_cfg=SampleConfig(temperature=0.0, presence_penalty=1.0),
+        )
+
+def test_stateless_paths_reject_penalties(tiny):
+    """make_generate_fn and the standalone speculative drivers keep no
+    occurrence counts — penalties must be rejected, not silently
+    dropped (a silent drop misreports the sampled distribution)."""
+    from shifu_tpu.infer.generate import make_generate_fn
+    from shifu_tpu.infer.speculative import make_speculative_batch_fns
+
+    model, _ = tiny
+    with pytest.raises(NotImplementedError, match="penalties"):
+        make_generate_fn(
+            model, max_new_tokens=4,
+            sample_cfg=SampleConfig(repetition_penalty=1.2),
+        )
+    with pytest.raises(NotImplementedError, match="penalties"):
+        make_speculative_batch_fns(
+            model, model, 2,
+            SampleConfig(temperature=0.0, presence_penalty=0.5),
+        )
+
+
+def test_sample_config_rejects_none_penalties():
+    """None penalties would construct fine and then kill the engine
+    thread at penalty_params() — validated at the boundary instead."""
+    with pytest.raises(ValueError, match="must be a number"):
+        SampleConfig(presence_penalty=None)
+    with pytest.raises(ValueError, match="must be a number"):
+        SampleConfig(frequency_penalty=None)

@@ -38,13 +38,14 @@ def test_per_row_matches_static_config(cfg):
     logits = jnp.asarray(rng.standard_normal((6, 64)) * 3, jnp.float32)
     key = jax.random.key(7)
     ref = sample_logits(logits, key, cfg)
-    t, k, p = row_params(cfg)
+    t, k, p, mp = row_params(cfg)
     got = sample_logits_per_row(
         logits,
         key,
         jnp.full((6,), t, jnp.float32),
         jnp.full((6,), k, jnp.int32),
         jnp.full((6,), p, jnp.float32),
+        jnp.full((6,), mp, jnp.float32),
     )
     np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
 
@@ -57,7 +58,7 @@ def test_per_row_top_k_top_p_composition():
     cumulative would wrongly keep token 1 too. Checked over many keys."""
     logits = jnp.asarray([[2.0, 1.5, 1.0, -5.0, -6.0]], jnp.float32)
     cfg = SampleConfig(temperature=1.0, top_k=2, top_p=0.55)
-    t, k, p = row_params(cfg)
+    t, k, p, _ = row_params(cfg)
     for i in range(50):
         key = jax.random.key(i)
         ref = sample_logits(logits, key, cfg)

@@ -369,3 +369,203 @@ def test_sampling_fields_rejected_without_flag(served):
             {"tokens": [1, 2, 3], "temperature": 0.5},
         )
     assert e.value.code == 400
+
+
+# ------------------------------------------------------------------ chat
+
+
+def _serve(engine, tokenizer=None):
+    server = make_server(engine, port=0, tokenizer=tokenizer)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    return server, t, f"http://127.0.0.1:{server.server_port}"
+
+
+def test_chat_completions_generic_template(tiny):
+    """Template-less tokenizer: messages render via the generic
+    <|role|> blocks + assistant header; the reply equals a plain
+    completion on exactly those rendered tokens."""
+    from shifu_tpu.data.tokenizer import ByteTokenizer
+
+    model, params = tiny
+    tok = ByteTokenizer()
+    engine = PagedEngine(
+        model, params, max_slots=2, max_len=96, page_size=8,
+        sample_cfg=SampleConfig(temperature=0.0), prefill_buckets=(64, 96),
+    )
+    server, t, base = _serve(engine, tokenizer=tok)
+    try:
+        messages = [
+            {"role": "system", "content": "be brief"},
+            {"role": "user", "content": "hi"},
+        ]
+        status, out = _post(
+            base, "/v1/chat/completions",
+            {"messages": messages, "max_new_tokens": 4},
+        )
+        assert status == 200
+        assert out["message"]["role"] == "assistant"
+        assert isinstance(out["message"]["content"], str)
+        assert "text" not in out
+
+        rendered = "".join(
+            f"<|{m['role']}|>\n{m['content']}\n" for m in messages
+        ) + "<|assistant|>\n"
+        status2, ref = _post(
+            base, "/v1/completions",
+            {"tokens": tok.encode(rendered), "max_new_tokens": 4},
+        )
+        assert status2 == 200
+        assert out["tokens"] == ref["tokens"]
+    finally:
+        server.shutdown()
+        server.runner.shutdown()
+        t.join(5)
+
+
+def test_chat_completions_template_tokenizer(tiny):
+    """A tokenizer WITH apply_chat_template: the server must use the
+    template's ids verbatim (pinned by comparing against /v1/completions
+    on those exact ids)."""
+    from shifu_tpu.data.tokenizer import ByteTokenizer
+
+    class TemplTok(ByteTokenizer):
+        def apply_chat_template(self, messages, **kw):
+            ids = []
+            for m in messages:
+                ids.extend(self.encode(m["content"]))
+                ids.append(7)  # role separator "token"
+            return ids
+
+    model, params = tiny
+    tok = TemplTok()
+    engine = PagedEngine(
+        model, params, max_slots=2, max_len=64, page_size=8,
+        sample_cfg=SampleConfig(temperature=0.0), prefill_buckets=(32, 64),
+    )
+    server, t, base = _serve(engine, tokenizer=tok)
+    try:
+        messages = [{"role": "user", "content": "abc"}]
+        status, out = _post(
+            base, "/v1/chat/completions",
+            {"messages": messages, "max_new_tokens": 3},
+        )
+        assert status == 200
+        want_ids = tok.apply_chat_template(messages)
+        status2, ref = _post(
+            base, "/v1/completions",
+            {"tokens": want_ids, "max_new_tokens": 3},
+        )
+        assert out["tokens"] == ref["tokens"]
+    finally:
+        server.shutdown()
+        server.runner.shutdown()
+        t.join(5)
+
+
+def test_chat_validation(served):
+    base, _ = served  # served has NO tokenizer
+    for body, want in (
+        ({"messages": [{"role": "user", "content": "x"}]}, "tokenizer"),
+        ({"messages": []}, "non-empty"),
+        ({"messages": [{"role": "user"}]}, "content"),
+        ({}, "messages"),
+    ):
+        try:
+            status, out = _post(base, "/v1/chat/completions", body)
+        except urllib.error.HTTPError as e:
+            status, out = e.code, json.loads(e.read())
+        assert status == 400, body
+        assert want in out["error"], (body, out)
+
+
+def test_chat_streaming_deltas(tiny):
+    from shifu_tpu.data.tokenizer import ByteTokenizer
+
+    model, params = tiny
+    engine = PagedEngine(
+        model, params, max_slots=1, max_len=96, page_size=8,
+        sample_cfg=SampleConfig(temperature=0.0), prefill_buckets=(64, 96),
+    )
+    server, t, base = _serve(engine, tokenizer=ByteTokenizer())
+    try:
+        req = urllib.request.Request(
+            base + "/v1/chat/completions",
+            data=json.dumps({
+                "messages": [{"role": "user", "content": "hey"}],
+                "max_new_tokens": 3, "stream": True,
+            }).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        events = []
+        with urllib.request.urlopen(req, timeout=120) as r:
+            assert r.status == 200
+            for line in r:
+                line = line.decode().strip()
+                if line.startswith("data: ") and line != "data: [DONE]":
+                    events.append(json.loads(line[len("data: "):]))
+        deltas = [e for e in events if "delta" in e]
+        finals = [e for e in events if "message" in e]
+        assert deltas and all(
+            isinstance(e["delta"]["content"], str) for e in deltas
+        )
+        assert len(finals) == 1
+        assert finals[0]["finished_by"] == "length"
+    finally:
+        server.shutdown()
+        server.runner.shutdown()
+        t.join(5)
+
+
+def test_penalty_fields_through_server(tiny):
+    """presence_penalty through the HTTP API: a huge penalty on a
+    penalties-enabled engine yields an all-distinct generation."""
+    model, params = tiny
+    engine = PagedEngine(
+        model, params, max_slots=1, max_len=48, page_size=8,
+        sample_cfg=SampleConfig(temperature=0.0),
+        prefill_buckets=(16, 48),
+        per_request_sampling=True, enable_penalties=True,
+    )
+    server, t, base = _serve(engine)
+    try:
+        prompt = np.random.RandomState(3).randint(1, 256, size=6).tolist()
+        status, out = _post(
+            base, "/v1/completions",
+            {
+                "tokens": prompt, "max_new_tokens": 10,
+                "temperature": 0.0, "presence_penalty": 1e9,
+            },
+        )
+        assert status == 200
+        assert len(out["tokens"]) == len(set(out["tokens"]))
+    finally:
+        server.shutdown()
+        server.runner.shutdown()
+        t.join(5)
+
+
+def test_min_p_field_through_server(tiny):
+    model, params = tiny
+    engine = PagedEngine(
+        model, params, max_slots=1, max_len=48, page_size=8,
+        sample_cfg=SampleConfig(temperature=0.0),
+        prefill_buckets=(16, 48), per_request_sampling=True,
+    )
+    server, t, base = _serve(engine)
+    try:
+        prompt = np.random.RandomState(4).randint(1, 256, size=6).tolist()
+        status, out = _post(
+            base, "/v1/completions",
+            {
+                "tokens": prompt, "max_new_tokens": 5,
+                "temperature": 0.9, "min_p": 0.3,
+            },
+        )
+        assert status == 200
+        assert len(out["tokens"]) == 5
+    finally:
+        server.shutdown()
+        server.runner.shutdown()
+        t.join(5)

@@ -69,6 +69,53 @@ def test_spec_greedy_matches_plain_engine(tiny, tiny_draft, k, rounds):
         )
 
 
+def test_spec_flash_verify_kernel_matches_plain_engine(tiny_draft):
+    """attn_impl='flash' routes the verify chunk through the
+    MULTI-QUERY paged kernel (one pass over the pool); greedy tokens
+    must still match the plain engine exactly — and the plain flash
+    engine itself matches the XLA one (pinned elsewhere)."""
+    cfg = TransformerConfig.tiny(attn_impl="flash")
+    model = Transformer(cfg)
+    params = model.init(jax.random.key(0))
+    draft, d_params = tiny_draft
+    prompts = _prompts(7, (5, 11))
+    ref = _run(PagedEngine(model, params, **_KW), prompts, 9)
+    spec = _run(
+        SpeculativePagedEngine(
+            model, params, draft, d_params, k=3, rounds_per_step=2, **_KW
+        ),
+        prompts, 9,
+    )
+    for a, b in zip(ref, spec):
+        assert a.tokens == b.tokens
+
+
+def test_spec_flash_verify_kernel_int8_pool(tiny_draft):
+    """Multi-query kernel + int8 pool (logical scales cover the chunk's
+    freshly quantized writes) == the XLA verify path on the same pool."""
+    cfg_f = TransformerConfig.tiny(attn_impl="flash")
+    cfg_x = TransformerConfig.tiny()
+    model_f, model_x = Transformer(cfg_f), Transformer(cfg_x)
+    params = model_x.init(jax.random.key(1))
+    draft, d_params = tiny_draft
+    prompts = _prompts(8, (6, 9))
+    kw = dict(_KW, cache_dtype=jnp.int8)
+    ref = _run(
+        SpeculativePagedEngine(
+            model_x, params, draft, d_params, k=3, **kw
+        ),
+        prompts, 8,
+    )
+    got = _run(
+        SpeculativePagedEngine(
+            model_f, params, draft, d_params, k=3, **kw
+        ),
+        prompts, 8,
+    )
+    for a, b in zip(ref, got):
+        assert a.tokens == b.tokens
+
+
 def test_spec_draft_equals_target_accepts_everything(tiny):
     model, params = tiny
     prompts = _prompts(1, (7,))
@@ -177,21 +224,51 @@ def test_spec_per_request_sampling_greedy_rows_exact(tiny, tiny_draft):
     assert all(0 <= t < 256 for t in out[r1].tokens)
 
 
-def test_spec_rejects_decode_chunk_and_mesh(tiny, tiny_draft):
+def test_spec_rejects_decode_chunk(tiny, tiny_draft):
     model, params = tiny
     draft, d_params = tiny_draft
     with pytest.raises(ValueError, match="rounds_per_step"):
         SpeculativePagedEngine(
             model, params, draft, d_params, decode_chunk=4, **_KW
         )
-    import jax as _jax
-    from jax.sharding import Mesh
 
-    mesh = Mesh(np.array(_jax.devices()[:1]), ("tp",))
-    with pytest.raises(NotImplementedError, match="mesh"):
-        SpeculativePagedEngine(
-            model, params, draft, d_params, mesh=mesh, **_KW
-        )
+
+def test_spec_mesh_serving_matches_single_device():
+    """Speculative serving on a tp mesh: sharded target pool AND
+    sharded dense draft cache; greedy tokens == the single-device
+    speculative engine (f32 so reduction order cannot flip argmaxes)."""
+    from shifu_tpu.core.dtypes import FULL_F32
+    from shifu_tpu.parallel import MeshPlan, shard_params
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 virtual devices")
+    cfg = TransformerConfig.tiny()
+    model = Transformer(cfg, policy=FULL_F32)
+    params = model.init(jax.random.key(0))
+    d_cfg = TransformerConfig.tiny(n_layers=1, dim=32, mlp_dim=64)
+    draft = Transformer(d_cfg, policy=FULL_F32)
+    d_params = draft.init(jax.random.key(9))
+    prompts = _prompts(15, (5, 9))
+    kw = dict(
+        max_slots=2, max_len=64, page_size=8,
+        prefill_buckets=(16, 32, 64), cache_dtype=jnp.float32,
+        sample_cfg=SampleConfig(temperature=0.0),
+    )
+    ref = _run(
+        SpeculativePagedEngine(model, params, draft, d_params, k=3, **kw),
+        prompts, 7,
+    )
+    mesh = MeshPlan(tp=2).build(jax.devices()[:2])
+    eng = SpeculativePagedEngine(
+        model, shard_params(model, params, mesh),
+        draft, shard_params(draft, d_params, mesh),
+        k=3, mesh=mesh, **kw,
+    )
+    d_shard = jax.tree_util.tree_leaves(eng.d_cache)[0].sharding
+    assert "tp" in str(d_shard.spec), d_shard
+    got = _run(eng, prompts, 7)
+    for a, b in zip(ref, got):
+        assert a.tokens == b.tokens
 
 
 def test_spec_chunk_write_at_max_len_boundary(tiny, tiny_draft):
