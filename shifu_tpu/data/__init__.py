@@ -12,6 +12,7 @@ from shifu_tpu.data.packing import Packer
 from shifu_tpu.data.tokenizer import ByteTokenizer, HFTokenizer, tokenize_corpus
 from shifu_tpu.data.synthetic import SyntheticLoader
 from shifu_tpu.data._native import available as native_available
+from shifu_tpu.data.bpe import BPETokenizer, native_bpe_available
 
 __all__ = [
     "TokenDataset",
@@ -20,6 +21,8 @@ __all__ = [
     "device_prefetch",
     "Packer",
     "native_available",
+    "BPETokenizer",
+    "native_bpe_available",
     "ByteTokenizer",
     "HFTokenizer",
     "tokenize_corpus",

@@ -1,0 +1,304 @@
+"""Trainable byte-level BPE tokenizer with a NATIVE C++ core.
+
+Fills the gap between :class:`ByteTokenizer` (no merges — 1 token per
+byte) and :class:`HFTokenizer` (pretrained vocab required): train a
+subword vocabulary on YOUR corpus, then feed the rest of the data
+pipeline (``tokenize_corpus``, shards, loaders) like any tokenizer.
+
+The trainer and encoder are C++ (``native/bpe.cc``, compiled on first
+use exactly like the packing core — ctypes over a g++-built .so, no
+pybind11); a pure-Python implementation of the SAME algorithm is both
+the fallback and the parity oracle the tests pin the native core
+against. Training is the classic greedy BPE over whitespace-attached
+word counts; encoding applies merges lowest-rank-first, reproducing
+the trainer's segmentation.
+
+Id space (matches ByteTokenizer's layout so corpora stay comparable):
+pad=0, bos=1, eos=2, raw bytes at 3..258, merged symbols from 259 in
+merge order. ``vocab_size`` is therefore ``259 + n_merges``.
+
+Reference parity note: the upstream reference (klyan/shifu) is an
+empty repository (SURVEY.md); there is no reference tokenizer to
+match. The algorithm is the published BPE (Sennrich et al.) in its
+byte-level form.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import json
+import os
+import subprocess
+import threading
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "native", "bpe.cc")
+_BUILD = os.path.join(_HERE, "_build")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _compile() -> str:
+    with open(_SRC, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    so_path = os.path.join(_BUILD, f"libbpe-{digest}.so")
+    if os.path.exists(so_path):
+        return so_path
+    os.makedirs(_BUILD, exist_ok=True)
+    tmp = so_path + f".tmp{os.getpid()}"
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", tmp, _SRC]
+    subprocess.run(cmd, check=True, capture_output=True)
+    os.replace(tmp, so_path)  # atomic: concurrent builders race benignly
+    return so_path
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("SHIFU_TPU_NO_NATIVE"):
+            return None
+        try:
+            lib = ctypes.CDLL(_compile())
+            lib.bpe_train.restype = ctypes.c_int32
+            lib.bpe_train.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+                ctypes.c_int32, ctypes.c_void_p,
+            ]
+            lib.bpe_encoder_new.restype = ctypes.c_void_p
+            lib.bpe_encoder_new.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+            lib.bpe_encoder_free.argtypes = [ctypes.c_void_p]
+            lib.bpe_encode.restype = ctypes.c_int64
+            lib.bpe_encode.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+                ctypes.c_void_p,
+            ]
+            _lib = lib
+        except Exception:
+            if os.environ.get("SHIFU_TPU_REQUIRE_NATIVE"):
+                raise
+            _lib = None
+        return _lib
+
+
+def native_bpe_available() -> bool:
+    return _load() is not None
+
+
+# ------------------------------------------------ python reference core
+# The exact algorithm of native/bpe.cc — fallback AND parity oracle.
+
+
+def _words(data: bytes):
+    start = 0
+    for i in range(1, len(data)):
+        if data[i] <= 0x20:
+            yield data[start:i]
+            start = i
+    if data:
+        yield data[start:]
+
+
+def _py_train(docs: Sequence[bytes], n_merges: int) -> List[tuple]:
+    counts = {}
+    for d in docs:
+        for w in _words(d):
+            counts[w] = counts.get(w, 0) + 1
+    words = [list(w) for w in counts]
+    freq = list(counts.values())
+    merges = []
+    for mi in range(n_merges):
+        pair_counts = {}
+        for syms, f in zip(words, freq):
+            for a, b in zip(syms, syms[1:]):
+                pair_counts[(a, b)] = pair_counts.get((a, b), 0) + f
+        best = None
+        best_count = 1
+        for pair, c in pair_counts.items():
+            if c > best_count or (c == best_count and best is not None
+                                  and pair < best):
+                best, best_count = pair, c
+        if best is None:
+            break
+        merges.append(best)
+        sym = 256 + mi
+        l, r = best
+        for syms in words:
+            out = []
+            i = 0
+            while i < len(syms):
+                if i + 1 < len(syms) and syms[i] == l and syms[i + 1] == r:
+                    out.append(sym)
+                    i += 2
+                else:
+                    out.append(syms[i])
+                    i += 1
+            syms[:] = out
+    return merges
+
+
+def _py_encode(ranks: dict, data: bytes) -> List[int]:
+    out = []
+    for w in _words(data):
+        syms = list(w)
+        while True:
+            best_rank = None
+            best_i = 0
+            for i in range(len(syms) - 1):
+                rk = ranks.get((syms[i], syms[i + 1]))
+                if rk is not None and (best_rank is None or rk < best_rank):
+                    best_rank, best_i = rk, i
+            if best_rank is None:
+                break
+            syms[best_i : best_i + 2] = [256 + best_rank]
+        out.extend(syms)
+    return out
+
+
+# -------------------------------------------------------------- tokenizer
+
+
+class BPETokenizer:
+    """Byte-level BPE over a trained merge table.
+
+    Train::
+
+        tok = BPETokenizer.train(texts, vocab_size=1024)
+        tok.save("bpe.json"); tok = BPETokenizer.load("bpe.json")
+    """
+
+    pad_id = 0
+    bos_id = 1
+    eos_id = 2
+    _OFFSET = 3  # bytes at 3..258; merge i at 259 + i
+
+    def __init__(self, merges: Sequence[Sequence[int]]):
+        # merges are in the NATIVE id space (bytes 0..255, merge i ->
+        # 256+i); validated so a truncated/corrupt file fails loudly.
+        self.merges = [(int(l), int(r)) for l, r in merges]
+        for i, (l, r) in enumerate(self.merges):
+            if not (0 <= l < 256 + i and 0 <= r < 256 + i):
+                raise ValueError(
+                    f"merge {i} references symbol {max(l, r)} before it "
+                    "exists"
+                )
+        self._ranks = {p: i for i, p in enumerate(self.merges)}
+        self._enc_handle = None
+        # id -> byte string, for decode.
+        table = [bytes([b]) for b in range(256)]
+        for l, r in self.merges:
+            table.append(table[l] + table[r])
+        self._bytes_of = table
+
+    @property
+    def vocab_size(self) -> int:
+        return 256 + len(self.merges) + self._OFFSET
+
+    # ------------------------------------------------------------ train
+    @classmethod
+    def train(cls, texts: Sequence[str], vocab_size: int) -> "BPETokenizer":
+        """Learn merges so the full vocab (specials + bytes + merges)
+        reaches ``vocab_size`` (fewer when the corpus runs out of
+        repeating pairs)."""
+        base = 256 + cls._OFFSET
+        if vocab_size < base:
+            raise ValueError(
+                f"vocab_size must be >= {base} (specials + raw bytes), "
+                f"got {vocab_size}"
+            )
+        n_merges = vocab_size - base
+        docs = [t.encode("utf-8") for t in texts]
+        lib = _load()
+        if n_merges == 0 or not docs:
+            return cls([])
+        if lib is None:
+            return cls(_py_train(docs, n_merges))
+        blob = b"".join(docs)
+        offsets = np.zeros((len(docs) + 1,), np.int64)
+        np.cumsum([len(d) for d in docs], out=offsets[1:])
+        out = np.zeros((n_merges, 2), np.int32)
+        buf = np.frombuffer(blob, np.uint8) if blob else np.zeros(1, np.uint8)
+        n = lib.bpe_train(
+            buf.ctypes.data, offsets.ctypes.data, len(docs),
+            n_merges, out.ctypes.data,
+        )
+        return cls(out[:n].tolist())
+
+    # ----------------------------------------------------------- encode
+    def _native_encoder(self):
+        lib = _load()
+        if lib is None:
+            return None
+        if self._enc_handle is None:
+            # Same lock as _load: two threads racing the first encode
+            # would otherwise both allocate and leak one Encoder.
+            with _lock:
+                if self._enc_handle is None:
+                    m = np.asarray(self.merges, np.int32).reshape(-1, 2)
+                    self._enc_handle = lib.bpe_encoder_new(
+                        m.ctypes.data if len(m) else None, len(m)
+                    )
+        return lib
+
+    def encode(self, text: str, *, bos: bool = False, eos: bool = False):
+        data = text.encode("utf-8")
+        if not data:
+            ids = []
+        else:
+            lib = self._native_encoder()
+            if lib is not None:
+                out = np.zeros((len(data),), np.int32)
+                buf = np.frombuffer(data, np.uint8)
+                n = lib.bpe_encode(
+                    self._enc_handle, buf.ctypes.data, len(data),
+                    out.ctypes.data,
+                )
+                ids = out[:n].tolist()
+            else:
+                ids = _py_encode(self._ranks, data)
+        ids = [i + self._OFFSET for i in ids]
+        if bos:
+            ids.insert(0, self.bos_id)
+        if eos:
+            ids.append(self.eos_id)
+        return ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        parts = []
+        for i in ids:
+            i = int(i)
+            if i < self._OFFSET:
+                continue  # specials render as nothing
+            parts.append(self._bytes_of[i - self._OFFSET])
+        return b"".join(parts).decode("utf-8", errors="replace")
+
+    # ------------------------------------------------------- persistence
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump({"format": "shifu-bpe-v1", "merges": self.merges}, f)
+
+    @classmethod
+    def load(cls, path: str) -> "BPETokenizer":
+        with open(path) as f:
+            obj = json.load(f)
+        if obj.get("format") != "shifu-bpe-v1":
+            raise ValueError(f"not a shifu-bpe-v1 file: {path}")
+        return cls(obj["merges"])
+
+    def __del__(self):
+        # getattr: __init__ may have raised before the handle existed.
+        h = getattr(self, "_enc_handle", None)
+        self._enc_handle = None
+        if h is not None and _lib is not None:
+            try:
+                _lib.bpe_encoder_free(h)
+            except Exception:
+                pass

@@ -1,0 +1,175 @@
+// Byte-level BPE: trainer + encoder, C ABI for ctypes (see data/bpe.py).
+//
+// Symbol ids in THIS layer: bytes are 0..255, the i-th learned merge
+// creates symbol 256 + i. The Python wrapper shifts into the
+// tokenizer's id space (specials + offset) — one id convention per
+// layer, mapped at the boundary.
+//
+// Pre-tokenization: a new word starts before every byte <= 0x20, so a
+// space attaches to the word it precedes (GPT-2's " word" convention
+// approximated without regex). Merges never cross word boundaries —
+// this is what keeps training O(unique words) and makes encoding
+// cacheable per word.
+//
+// Trainer: classic greedy BPE over word counts — each round counts
+// adjacent symbol pairs weighted by word frequency, merges the most
+// frequent pair (ties break toward the smaller (left, right) pair for
+// determinism), stops early when no pair occurs twice.
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+using std::int32_t;
+using std::int64_t;
+using std::uint8_t;
+
+inline bool is_boundary(uint8_t b) { return b <= 0x20; }
+
+// Split [data, data+len) into words (byte ranges). A word starts at
+// every boundary byte; boundary bytes attach to the word they start.
+template <typename F>
+void for_each_word(const uint8_t* data, int64_t len, F&& fn) {
+  int64_t start = 0;
+  for (int64_t i = 1; i < len; ++i) {
+    if (is_boundary(data[i])) {
+      fn(data + start, i - start);
+      start = i;
+    }
+  }
+  if (len > 0) fn(data + start, len - start);
+}
+
+struct PairHash {
+  size_t operator()(int64_t v) const {
+    return std::hash<int64_t>()(v);
+  }
+};
+
+inline int64_t pack(int32_t l, int32_t r) {
+  return (static_cast<int64_t>(l) << 32) | static_cast<uint32_t>(r);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Learn up to n_merges merges from concatenated docs. offsets has
+// n_docs + 1 entries. out_merges receives (left, right) per merge.
+// Returns the number of merges actually learned.
+int32_t bpe_train(const uint8_t* data, const int64_t* offsets,
+                  int64_t n_docs, int32_t n_merges, int32_t* out_merges) {
+  // 1. Word frequency table.
+  std::unordered_map<std::string, int64_t> counts;
+  for (int64_t d = 0; d < n_docs; ++d) {
+    const uint8_t* p = data + offsets[d];
+    int64_t len = offsets[d + 1] - offsets[d];
+    for_each_word(p, len, [&](const uint8_t* w, int64_t n) {
+      counts[std::string(reinterpret_cast<const char*>(w), n)] += 1;
+    });
+  }
+  // 2. Unique words as symbol vectors.
+  std::vector<std::vector<int32_t>> words;
+  std::vector<int64_t> freq;
+  words.reserve(counts.size());
+  for (auto& kv : counts) {
+    std::vector<int32_t> syms(kv.first.size());
+    for (size_t i = 0; i < kv.first.size(); ++i)
+      syms[i] = static_cast<uint8_t>(kv.first[i]);
+    words.push_back(std::move(syms));
+    freq.push_back(kv.second);
+  }
+  // 3. Greedy merge rounds.
+  int32_t learned = 0;
+  std::unordered_map<int64_t, int64_t, PairHash> pair_counts;
+  for (; learned < n_merges; ++learned) {
+    pair_counts.clear();
+    for (size_t w = 0; w < words.size(); ++w) {
+      const auto& syms = words[w];
+      for (size_t i = 0; i + 1 < syms.size(); ++i)
+        pair_counts[pack(syms[i], syms[i + 1])] += freq[w];
+    }
+    int64_t best_pair = -1;
+    int64_t best_count = 1;  // a pair must occur at least twice
+    for (auto& kv : pair_counts) {
+      if (kv.second > best_count ||
+          (kv.second == best_count && best_pair >= 0 &&
+           kv.first < best_pair)) {
+        best_count = kv.second;
+        best_pair = kv.first;
+      }
+    }
+    if (best_pair < 0) break;
+    int32_t l = static_cast<int32_t>(best_pair >> 32);
+    int32_t r = static_cast<int32_t>(best_pair & 0xffffffff);
+    out_merges[2 * learned] = l;
+    out_merges[2 * learned + 1] = r;
+    int32_t sym = 256 + learned;
+    for (auto& syms : words) {
+      size_t out = 0;
+      for (size_t i = 0; i < syms.size();) {
+        if (i + 1 < syms.size() && syms[i] == l && syms[i + 1] == r) {
+          syms[out++] = sym;
+          i += 2;
+        } else {
+          syms[out++] = syms[i++];
+        }
+      }
+      syms.resize(out);
+    }
+  }
+  return learned;
+}
+
+struct Encoder {
+  // pair -> (rank, merged symbol)
+  std::unordered_map<int64_t, std::pair<int32_t, int32_t>, PairHash> ranks;
+};
+
+void* bpe_encoder_new(const int32_t* merges, int32_t n_merges) {
+  auto* e = new Encoder();
+  for (int32_t i = 0; i < n_merges; ++i) {
+    e->ranks[pack(merges[2 * i], merges[2 * i + 1])] = {i, 256 + i};
+  }
+  return e;
+}
+
+void bpe_encoder_free(void* h) { delete static_cast<Encoder*>(h); }
+
+// Encode text; out must hold at least len entries (merges only ever
+// shrink a word). Returns the token count.
+int64_t bpe_encode(void* h, const uint8_t* text, int64_t len,
+                   int32_t* out) {
+  auto* e = static_cast<Encoder*>(h);
+  int64_t n_out = 0;
+  std::vector<int32_t> syms;
+  for_each_word(text, len, [&](const uint8_t* w, int64_t n) {
+    syms.assign(w, w + n);
+    // Lowest-rank adjacent merge first — the canonical BPE encode
+    // order, which reproduces the trainer's segmentation.
+    for (;;) {
+      int32_t best_rank = INT32_MAX;
+      size_t best_i = 0;
+      int32_t best_sym = -1;
+      for (size_t i = 0; i + 1 < syms.size(); ++i) {
+        auto it = e->ranks.find(pack(syms[i], syms[i + 1]));
+        if (it != e->ranks.end() && it->second.first < best_rank) {
+          best_rank = it->second.first;
+          best_i = i;
+          best_sym = it->second.second;
+        }
+      }
+      if (best_sym < 0) break;
+      syms[best_i] = best_sym;
+      syms.erase(syms.begin() + best_i + 1);
+    }
+    for (int32_t s : syms) out[n_out++] = s;
+  });
+  return n_out;
+}
+
+}  // extern "C"

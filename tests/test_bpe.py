@@ -1,0 +1,117 @@
+"""Native byte-level BPE: trainer/encoder parity, roundtrips, format.
+
+The C++ core (data/native/bpe.cc) and the pure-Python reference in
+data/bpe.py implement the SAME algorithm; the tests pin them to each
+other (any divergence is a bug in one of them), then pin tokenizer
+semantics: lossless roundtrip, actual compression on repetitive text,
+id-space layout shared with ByteTokenizer, save/load.
+"""
+
+import numpy as np
+import pytest
+
+from shifu_tpu.data.bpe import (
+    BPETokenizer,
+    _py_encode,
+    _py_train,
+    native_bpe_available,
+)
+
+CORPUS = [
+    "the cat sat on the mat",
+    "the dog sat on the log",
+    "the cat and the dog",
+    "a log and a mat and a cat",
+] * 3
+
+
+def test_train_learns_merges_and_compresses():
+    tok = BPETokenizer.train(CORPUS, vocab_size=300)
+    assert len(tok.merges) > 0
+    text = "the cat sat on the mat"
+    ids = tok.encode(text)
+    assert len(ids) < len(text.encode())  # merges actually fired
+    assert tok.decode(ids) == text
+
+
+def test_native_matches_python_reference():
+    if not native_bpe_available():
+        pytest.skip("native core unavailable")
+    docs = [t.encode() for t in CORPUS]
+    want = _py_train(docs, 30)
+    tok = BPETokenizer.train(CORPUS, vocab_size=259 + 30)
+    assert tok.merges == [tuple(m) for m in want]
+    ranks = {tuple(p): i for i, p in enumerate(want)}
+    for text in CORPUS + ["unseen words zebra!", "  double  spaces"]:
+        py = [i + 3 for i in _py_encode(ranks, text.encode())]
+        assert tok.encode(text) == py, text
+
+
+def test_roundtrip_arbitrary_text():
+    tok = BPETokenizer.train(CORPUS, vocab_size=280)
+    for text in (
+        "completely unseen: φύλλο 漢字 emoji 🎉 tabs\tand\nnewlines",
+        "",
+        " leading and trailing ",
+    ):
+        assert tok.decode(tok.encode(text)) == text
+
+
+def test_bos_eos_and_id_layout():
+    tok = BPETokenizer.train(CORPUS, vocab_size=270)
+    ids = tok.encode("hi", bos=True, eos=True)
+    assert ids[0] == tok.bos_id and ids[-1] == tok.eos_id
+    assert all(i >= 3 for i in ids[1:-1])  # specials never collide
+    assert tok.vocab_size == 259 + len(tok.merges)
+    # No merges -> byte-identical to ByteTokenizer's mapping.
+    raw = BPETokenizer([])
+    from shifu_tpu.data.tokenizer import ByteTokenizer
+
+    assert raw.encode("abc") == ByteTokenizer().encode("abc")
+
+
+def test_save_load_roundtrip(tmp_path):
+    tok = BPETokenizer.train(CORPUS, vocab_size=290)
+    p = str(tmp_path / "bpe.json")
+    tok.save(p)
+    tok2 = BPETokenizer.load(p)
+    assert tok2.merges == tok.merges
+    text = "the cat sat"
+    assert tok2.encode(text) == tok.encode(text)
+
+
+def test_validation():
+    with pytest.raises(ValueError, match="vocab_size"):
+        BPETokenizer.train(CORPUS, vocab_size=100)
+    with pytest.raises(ValueError, match="before it exists"):
+        BPETokenizer([(300, 1)])
+    import json
+
+    with pytest.raises(ValueError, match="shifu-bpe-v1"):
+        import tempfile
+
+        with tempfile.NamedTemporaryFile(
+            "w", suffix=".json", delete=False
+        ) as f:
+            json.dump({"merges": []}, f)
+            name = f.name
+        BPETokenizer.load(name)
+
+
+def test_trains_less_when_corpus_exhausted():
+    tok = BPETokenizer.train(["ab"], vocab_size=1000)
+    # "ab" repeats nothing — zero merges possible.
+    assert tok.merges == []
+
+
+def test_corpus_pipeline_integration(tmp_path):
+    """BPE tokenizer drives tokenize_corpus -> shards like any other."""
+    from shifu_tpu.data import TokenDataset, tokenize_corpus
+
+    tok = BPETokenizer.train(CORPUS, vocab_size=300)
+    n = tokenize_corpus(CORPUS[:4], tok, str(tmp_path / "shards"))
+    assert n == 4
+    ds = TokenDataset(str(tmp_path / "shards"))
+    doc = ds.doc(0)
+    got = tok.decode([int(t) for t in doc])
+    assert got.rstrip() == CORPUS[0]
