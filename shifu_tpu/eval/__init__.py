@@ -1,0 +1,13 @@
+from shifu_tpu.eval.tasks import (
+    MCExample,
+    encode_mc_example,
+    evaluate_multiple_choice,
+    score_options,
+)
+
+__all__ = [
+    "MCExample",
+    "encode_mc_example",
+    "evaluate_multiple_choice",
+    "score_options",
+]

@@ -1,0 +1,181 @@
+"""Downstream-task evaluation: multiple-choice logprob scoring.
+
+The standard harness pattern (HellaSwag/ARC/MMLU-style): each example
+is a context plus N candidate continuations; the model's answer is the
+continuation with the highest summed logprob (raw, and length-
+normalised — both are reported because they disagree systematically
+when option lengths differ).
+
+TPU-first mechanics: every (context, option) pair is one row of a
+padded (rows, seq_len) batch scored by ONE jitted forward per batch
+(``train.dpo.sequence_logprobs`` — same masked-target convention as
+SFT/DPO, one implementation of "sum of target logprobs" across the
+framework). Rows bucket to a fixed ``seq_len``, so the whole eval
+compiles once per (batch_rows, seq_len).
+
+Reference parity note: the upstream reference (klyan/shifu) is an
+empty repository (SURVEY.md); there is no reference harness to match.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from shifu_tpu.train.dpo import sequence_logprobs
+
+# ONE jit for all evaluations (cached on the static model + shapes) —
+# wrapping a fresh lambda per score_options call would recompile the
+# forward every evaluation of the training loop.
+_scorer = jax.jit(sequence_logprobs, static_argnums=0)
+
+
+@dataclasses.dataclass(frozen=True)
+class MCExample:
+    """One multiple-choice example, already tokenized.
+
+    ``context``: conditioning token ids (the "question").
+    ``options``: candidate continuation token id sequences.
+    ``answer``: index of the gold option.
+    """
+
+    context: Sequence[int]
+    options: Sequence[Sequence[int]]
+    answer: int
+
+    def __post_init__(self):
+        if not self.context:
+            # The first option token needs a conditioning position;
+            # with an empty context its logprob would silently drop
+            # from the score (loss masks weight PREDICTIONS).
+            raise ValueError("example with empty context")
+        if not self.options:
+            raise ValueError("example with no options")
+        if not 0 <= self.answer < len(self.options):
+            raise ValueError(
+                f"answer {self.answer} out of range for "
+                f"{len(self.options)} options"
+            )
+        if any(len(o) == 0 for o in self.options):
+            raise ValueError("empty option (nothing to score)")
+
+
+def _encode_rows(pairs, seq_len: int, pad_id: int):
+    """(context, option) pairs -> padded tokens + option-target masks.
+
+    Context truncates from the LEFT when context+option overflows (the
+    option is what gets scored; clipping it would change the measured
+    quantity). An option longer than seq_len-1 is rejected — silently
+    truncating it would score a different continuation.
+    """
+    tokens = np.full((len(pairs), seq_len), pad_id, np.int32)
+    mask = np.zeros((len(pairs), seq_len), np.float32)
+    for i, (ctx, opt) in enumerate(pairs):
+        ctx, opt = list(map(int, ctx)), list(map(int, opt))
+        if len(opt) > seq_len - 1:
+            raise ValueError(
+                f"option of {len(opt)} tokens cannot fit seq_len "
+                f"{seq_len} with at least one context token"
+            )
+        room = seq_len - len(opt)
+        ctx = ctx[-room:] if room < len(ctx) else ctx
+        row = ctx + opt
+        tokens[i, : len(row)] = row
+        mask[i, len(ctx) : len(row)] = 1.0
+    return tokens, mask
+
+
+def score_options(
+    model,
+    params,
+    examples: Sequence[MCExample],
+    *,
+    seq_len: int,
+    batch_rows: int = 32,
+    pad_id: int = 0,
+):
+    """Summed option logprobs for every example.
+
+    Returns (scores, lengths): two lists parallel to ``examples``, each
+    entry an array over that example's options — raw summed logprob and
+    option token count (for length normalisation). One compiled forward
+    per (batch_rows, seq_len); the last batch pads with repeat rows.
+    """
+    pairs = []
+    owners = []
+    for ei, ex in enumerate(examples):
+        for opt in ex.options:
+            pairs.append((ex.context, opt))
+            owners.append(ei)
+    tokens, mask = _encode_rows(pairs, seq_len, pad_id)
+
+    fn = functools.partial(_scorer, model)
+    flat = np.zeros((len(pairs),), np.float64)
+    for at in range(0, len(pairs), batch_rows):
+        idx = np.arange(at, min(at + batch_rows, len(pairs)))
+        # Pad the tail batch by repeating its last row: static shapes,
+        # and the repeats' scores are simply ignored.
+        take = np.concatenate(
+            [idx, np.full((batch_rows - len(idx),), idx[-1])]
+        )
+        lp = fn(params, jnp.asarray(tokens[take]), jnp.asarray(mask[take]))
+        flat[idx] = np.asarray(lp)[: len(idx)]
+
+    scores: List[np.ndarray] = []
+    lengths: List[np.ndarray] = []
+    at = 0
+    for ex in examples:
+        n = len(ex.options)
+        scores.append(flat[at : at + n].copy())
+        lengths.append(np.asarray([len(o) for o in ex.options], np.float64))
+        at += n
+    return scores, lengths
+
+
+def evaluate_multiple_choice(
+    model,
+    params,
+    examples: Sequence[MCExample],
+    *,
+    seq_len: int,
+    batch_rows: int = 32,
+    pad_id: int = 0,
+) -> dict:
+    """Accuracy (raw argmax) and length-normalised accuracy."""
+    scores, lengths = score_options(
+        model, params, examples,
+        seq_len=seq_len, batch_rows=batch_rows, pad_id=pad_id,
+    )
+    hits = 0
+    hits_norm = 0
+    for ex, s, n in zip(examples, scores, lengths):
+        hits += int(np.argmax(s) == ex.answer)
+        hits_norm += int(np.argmax(s / n) == ex.answer)
+    total = max(len(examples), 1)
+    return {
+        "accuracy": hits / total,
+        "accuracy_norm": hits_norm / total,
+        "examples": len(examples),
+    }
+
+
+def encode_mc_example(
+    tokenizer,
+    context: str,
+    options: Sequence[str],
+    answer: int,
+) -> MCExample:
+    """Text -> MCExample. Options encode as continuations of the
+    context (leading-space convention is the caller's concern — pass
+    options exactly as they should follow the context text)."""
+    return MCExample(
+        context=tokenizer.encode(context),
+        options=[tokenizer.encode(o) for o in options],
+        answer=answer,
+    )

@@ -1,0 +1,114 @@
+"""Multiple-choice eval harness: scoring parity and accuracy logic."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from shifu_tpu.eval import (
+    MCExample,
+    encode_mc_example,
+    evaluate_multiple_choice,
+    score_options,
+)
+from shifu_tpu.models import Transformer, TransformerConfig
+from shifu_tpu.train import sequence_logprobs
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    model = Transformer(TransformerConfig.tiny())
+    return model, model.init(jax.random.key(0))
+
+
+def _examples(seed, n, n_opts=3):
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        out.append(MCExample(
+            context=rng.randint(1, 250, size=rng.randint(2, 6)).tolist(),
+            options=[
+                rng.randint(1, 250, size=rng.randint(1, 4)).tolist()
+                for _ in range(n_opts)
+            ],
+            answer=int(rng.randint(n_opts)),
+        ))
+    return out
+
+
+def test_score_options_matches_direct_logprobs(tiny):
+    """Each option's score == sequence_logprobs on an individually
+    built (context + option) row — batching/padding changes nothing."""
+    model, params = tiny
+    examples = _examples(0, 4)
+    scores, lengths = score_options(
+        model, params, examples, seq_len=12, batch_rows=4
+    )
+    for ex, s, n in zip(examples, scores, lengths):
+        assert len(s) == len(ex.options)
+        np.testing.assert_array_equal(
+            n, [len(o) for o in ex.options]
+        )
+        for j, opt in enumerate(ex.options):
+            row = list(ex.context) + list(opt)
+            tokens = np.zeros((1, 12), np.int32)
+            tokens[0, : len(row)] = row
+            mask = np.zeros((1, 12), np.float32)
+            mask[0, len(ex.context) : len(row)] = 1.0
+            want = float(sequence_logprobs(
+                model, params, jnp.asarray(tokens), jnp.asarray(mask)
+            )[0])
+            np.testing.assert_allclose(s[j], want, rtol=1e-4, atol=1e-5)
+
+
+def test_evaluate_self_consistent(tiny):
+    """Label every example with the model's OWN preferred option: raw
+    accuracy must then be exactly 1.0 (the harness agrees with itself)."""
+    model, params = tiny
+    examples = _examples(1, 5)
+    scores, _ = score_options(
+        model, params, examples, seq_len=12, batch_rows=8
+    )
+    relabeled = [
+        MCExample(ex.context, ex.options, int(np.argmax(s)))
+        for ex, s in zip(examples, scores)
+    ]
+    out = evaluate_multiple_choice(
+        model, params, relabeled, seq_len=12, batch_rows=8
+    )
+    assert out["accuracy"] == 1.0
+    assert out["examples"] == 5
+
+
+def test_context_left_truncates_option_rejected(tiny):
+    model, params = tiny
+    # Long context: fits by left-truncation.
+    ex = MCExample(context=list(range(1, 40)), options=[[5, 6]], answer=0)
+    scores, _ = score_options(model, params, [ex], seq_len=8, batch_rows=1)
+    assert np.isfinite(scores[0]).all()
+    # Option longer than seq_len - 1: refused, not silently clipped.
+    ex2 = MCExample(context=[1], options=[list(range(1, 12))], answer=0)
+    with pytest.raises(ValueError, match="cannot fit"):
+        score_options(model, params, [ex2], seq_len=8, batch_rows=1)
+
+
+def test_mc_example_validation():
+    with pytest.raises(ValueError, match="empty context"):
+        MCExample(context=[], options=[[2]], answer=0)
+    with pytest.raises(ValueError, match="no options"):
+        MCExample(context=[1], options=[], answer=0)
+    with pytest.raises(ValueError, match="out of range"):
+        MCExample(context=[1], options=[[2]], answer=1)
+    with pytest.raises(ValueError, match="empty option"):
+        MCExample(context=[1], options=[[2], []], answer=0)
+
+
+def test_encode_mc_example():
+    from shifu_tpu.data.tokenizer import ByteTokenizer
+
+    tok = ByteTokenizer()
+    ex = encode_mc_example(tok, "q: 2+2=", [" 4", " 5"], 0)
+    assert ex.answer == 0
+    assert ex.options[0] == tok.encode(" 4")
+    assert ex.context == tok.encode("q: 2+2=")
