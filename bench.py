@@ -237,15 +237,24 @@ def bench_serving():
         )
         return cfg.n_layers * slots * avg_len * per_tok
 
-    def measure(m, params, cache_dtype=jnp.bfloat16):
+    def measure(m, params, cache_dtype=jnp.bfloat16, decode_chunk=None,
+                warm_chunks=1, timed_chunks=1):
+        """One serving leg. ``warm_chunks``/``timed_chunks``: dispatches
+        before/inside the timed window — the two-point fit times the
+        SAME token window (decode positions prompt+256..prompt+512)
+        once as 1x256-step dispatch and once as 4x64-step dispatches,
+        so the time difference is PURE dispatch count (identical KV
+        traffic), not a chunk-size-vs-context confound."""
         eng = PagedEngine(
             m, params, max_slots=slots, max_len=2560, page_size=page_size,
-            prefill_buckets=(2048, 2560), decode_chunk=chunk,
+            prefill_buckets=(2048, 2560),
+            decode_chunk=decode_chunk or chunk,
             sample_cfg=SampleConfig(temperature=0.0),
             cache_dtype=cache_dtype,
         )
+        dc = decode_chunk or chunk
         # Warm-up: compiles the prefill bucket and the decode chunk.
-        eng.submit(prompts[0], max_new_tokens=chunk + 1)
+        eng.submit(prompts[0], max_new_tokens=dc + 1)
         for _ in eng.run():
             pass
         # Prefill latency on the warm program (single request, idle
@@ -265,33 +274,65 @@ def bench_serving():
         # hiccups that would otherwise land in the ledger as fake
         # regressions.
         times = []
+        n_steps = timed_chunks * dc
         for _ in range(2):
             for p in prompts:
-                eng.submit(p, max_new_tokens=2 * chunk + 1)
-            eng.step()
+                eng.submit(
+                    p, max_new_tokens=(warm_chunks + timed_chunks) * dc + 1
+                )
+            for _ in range(warm_chunks):
+                eng.step()
             t0 = time.perf_counter()
-            eng.step()
+            for _ in range(timed_chunks):
+                eng.step()
             times.append(time.perf_counter() - t0)
             for _ in eng.run():
                 pass
         dt = min(times)
-        step_s = dt / chunk
+        step_s = dt / n_steps
         quant_kv = cache_dtype == jnp.int8
         bytes_step = param_nbytes(params) + kv_bytes_per_step(
             1 if quant_kv else 2, scales=quant_kv
         )
         out = {
-            "decode_tokens_per_s": round(chunk * slots / dt, 1),
+            "decode_tokens_per_s": round(n_steps * slots / dt, 1),
             "decode_step_ms": round(1000 * step_s, 2),
             "prefill_ms": round(1000 * min(pres), 1),
             "bytes_per_step_gb": round(bytes_step / 1e9, 2),
+            "_dt": dt,
+            "_dispatches": timed_chunks,
+            "_steps": n_steps,
+            "_bytes": bytes_step,
         }
         if peak_bw:
             out["bandwidth_util"] = round(bytes_step / step_s / peak_bw, 4)
         return out
 
+    bf16 = measure(model, params_bf)
+    # TWO-POINT FIT: a device profile showed the chunk dispatch carries
+    # ~0.3-0.5 s of TUNNEL latency (host<->chip relay), ~2 ms/step at
+    # chunk 256 — chip time is what a real deployment sees, so separate
+    # them. Both points decode the SAME 256-token window (identical KV
+    # traffic): once as one 256-step dispatch, once as four 64-step
+    # dispatches; the difference is exactly 3 extra dispatch costs.
+    # Each point is min-of-2 passes (tunnel hiccup guard). The
+    # profile's direct device measurement, 4.6-4.8 ms/step at this
+    # mix, corroborates the fit.
+    bf16_small = measure(
+        model, params_bf, decode_chunk=64, warm_chunks=4, timed_chunks=4
+    )
+    extra = bf16_small["_dispatches"] - bf16["_dispatches"]
+    disp = (bf16_small["_dt"] - bf16["_dt"]) / extra
+    dps = (bf16["_dt"] - bf16["_dispatches"] * disp) / bf16["_steps"]
+    bf16["decode_step_device_ms"] = round(1000 * dps, 2)
+    bf16["tunnel_dispatch_ms"] = round(1000 * disp, 1)
+    if peak_bw and dps > 0:
+        bf16["bandwidth_util_device"] = round(
+            bf16["_bytes"] / dps / peak_bw, 4
+        )
+
     out = {
-        "bf16": measure(model, params_bf),
+        "bf16": bf16,
         "int8": measure(QuantizedModel(model), params_q8),
         "int8_kv": measure(
             QuantizedModel(model), params_q8, cache_dtype=jnp.int8
@@ -306,9 +347,15 @@ def bench_serving():
             "decode rate: one 256-step dispatch, host-synced; int8 = "
             "weight-only (native qtensor path); int8_kv adds the int8 "
             "paged pool, dequantized inside the kernel; bandwidth_util "
-            "= modelled bytes/step over measured step time vs peak HBM"
+            "= modelled bytes/step over measured step time vs peak HBM; "
+            "decode_step_device_ms/tunnel_dispatch_ms = two-point fit "
+            "separating chip time from the tunnel's per-dispatch cost"
         ),
     }
+    for leg in out.values():
+        if isinstance(leg, dict):
+            for k in ("_dt", "_dispatches", "_steps", "_bytes"):
+                leg.pop(k, None)
     return out
 
 
@@ -349,43 +396,71 @@ def bench_serving_spec():
         "unembed": params["unembed"],
     }
 
-    slots, prompt_len, k, rounds = 16, 1900, 4, 50
-    prompts = [
-        rng.randint(1, cfg.vocab_size, size=prompt_len).tolist()
-        for _ in range(slots)
-    ]
-    budget = rounds * (k + 1)
-    eng = SpeculativePagedEngine(
-        model, params, draft, draft_params, k=k,
-        rounds_per_step=rounds, max_slots=slots, max_len=2560,
-        page_size=256, prefill_buckets=(2048, 2560),
-        sample_cfg=SampleConfig(temperature=0.0),
+    slots, prompt_len, k = 16, 1900, 4
+    R_BIG, R_SMALL, SPLIT = 48, 12, 4  # 1x48 rounds vs 4x12 rounds
+
+    def run_rounds(rounds, warm_steps, timed_steps):
+        """min-of-2 timings of ``timed_steps`` successive engine steps
+        after ``warm_steps`` warm ones — the two fit points cover the
+        SAME round window (rounds x steps equal), so their time
+        difference is pure dispatch count (tunnel cost), not a
+        context-depth confound; min-of-2 guards tunnel hiccups."""
+        prompts = [
+            rng.randint(1, cfg.vocab_size, size=prompt_len).tolist()
+            for _ in range(slots)
+        ]
+        budget = (warm_steps + timed_steps) * rounds * (k + 1)
+        eng = SpeculativePagedEngine(
+            model, params, draft, draft_params, k=k,
+            rounds_per_step=rounds, max_slots=slots, max_len=2560,
+            page_size=256, prefill_buckets=(2048, 2560),
+            sample_cfg=SampleConfig(temperature=0.0),
+        )
+        # Warm-up compiles: prefill bucket, draft prefill, round program.
+        eng.submit(prompts[0], max_new_tokens=rounds * (k + 1))
+        for _ in eng.run():
+            pass
+        times, emitted = [], 0
+        for _ in range(2):
+            rids = [eng.submit(p, max_new_tokens=budget + 1)
+                    for p in prompts]
+            for _ in range(warm_steps):
+                eng.step()  # first step also prefills all slots
+            before = sum(len(g) for g in eng.live_generated().values())
+            t0 = time.perf_counter()
+            for _ in range(timed_steps):
+                eng.step()
+            times.append(time.perf_counter() - t0)
+            emitted = (
+                sum(len(g) for g in eng.live_generated().values()) - before
+            )
+            for r in rids:  # cancel the remaining budget: the drain
+                eng.cancel(r)  # would cost hundreds more rounds
+        return min(times), emitted, eng.acceptance_rate
+
+    dt, emitted, acc = run_rounds(R_BIG, warm_steps=1, timed_steps=1)
+    dt_small, _, _ = run_rounds(
+        R_SMALL, warm_steps=SPLIT, timed_steps=SPLIT
     )
-    # Warm-up compiles: prefill bucket, draft prefill, the round program.
-    eng.submit(prompts[0], max_new_tokens=budget)
-    for _ in eng.run():
-        pass
-    for p in prompts:
-        eng.submit(p, max_new_tokens=2 * budget)
-    eng.step()  # prefill all + first round chunk
-    before = sum(len(g) for g in eng.live_generated().values())
-    t0 = time.perf_counter()
-    eng.step()
-    dt = time.perf_counter() - t0
-    after = sum(len(g) for g in eng.live_generated().values())
-    emitted = after - before
+    # Both points ran R_BIG == SPLIT * R_SMALL rounds over the same
+    # window; the small point paid (SPLIT - 1) extra dispatches.
+    disp = (dt_small - dt) / (SPLIT - 1)
+    rps = (dt - disp) / R_BIG
     return {
         "decode_tokens_per_s": round(emitted / dt, 1),
-        "tokens_per_round": round(emitted / (rounds * slots), 3),
-        "acceptance_rate": round(eng.acceptance_rate, 4),
-        "round_ms": round(1000 * dt / rounds, 2),
+        "tokens_per_round": round(emitted / (R_BIG * slots), 3),
+        "acceptance_rate": round(acc, 4),
+        "round_ms": round(1000 * dt / R_BIG, 2),
+        "round_device_ms": round(1000 * rps, 2),
+        "tunnel_dispatch_ms": round(1000 * disp, 1),
         "k": k,
-        "rounds_per_step": rounds,
+        "rounds_per_step": R_BIG,
         "draft_layers": d_layers,
         "note": (
             "draft = target truncated to 2 layers (untrained weights "
             "-> low acceptance); tokens/round = 1 + k*acceptance, so "
-            "trained-pair throughput scales from round_ms accordingly"
+            "trained-pair throughput scales from round_device_ms "
+            "(two-point fit stripping the tunnel's per-dispatch cost)"
         ),
     }
 
