@@ -1,14 +1,18 @@
 """Command-line entry points: ``python -m shifu_tpu <cmd>``.
 
-    train     run the Trainer loop (real corpus dir or --synthetic)
-    eval      perplexity over a dataset (params-only checkpoint read)
-    generate  byte-tokenizer text completion from a checkpoint
-    serve     HTTP completions server (continuous batching, paged KV)
-    info      devices, native-extension status, version
+    train      run the Trainer loop (real corpus dir or --synthetic)
+    dpo        DPO preference tuning from a JSONL of pairs
+    eval       perplexity over a dataset (params-only checkpoint read)
+    generate   text completion from a checkpoint
+    serve      HTTP completions server (continuous batching, paged KV)
+    bpe-train  train a byte-level BPE tokenizer (native C++ core)
+    info       devices, native-extension status, version
 
 The CLI builds everything from flags — model preset (optionally MoE),
 optimizer + schedule, mesh plan — and is the reference example of wiring
-the framework end to end.
+the framework end to end. ``generate``/``serve`` default to the byte
+tokenizer; pass ``--tokenizer bpe.json`` (a bpe-train artifact) to use
+a trained vocabulary.
 """
 
 from __future__ import annotations
@@ -132,6 +136,180 @@ def cmd_train(args) -> int:
     return 0
 
 
+def _build_tokenizer(args):
+    """The byte tokenizer, or a trained BPE table (--tokenizer)."""
+    if getattr(args, "tokenizer", None):
+        from shifu_tpu.data.bpe import BPETokenizer
+
+        return BPETokenizer.load(args.tokenizer)
+    from shifu_tpu.data.tokenizer import ByteTokenizer
+
+    return ByteTokenizer()
+
+
+def cmd_bpe_train(args) -> int:
+    from shifu_tpu.data.bpe import BPETokenizer, native_bpe_available
+
+    texts = []
+    for path in args.data:
+        with open(path, encoding="utf-8") as f:
+            if args.per_line:
+                texts.extend(line.rstrip("\n") for line in f)
+            else:
+                texts.append(f.read())
+    if not texts:
+        print("no input text", file=sys.stderr)
+        return 2
+    tok = BPETokenizer.train(texts, vocab_size=args.vocab_size)
+    tok.save(args.out)
+    print(json.dumps({
+        "out": args.out,
+        "vocab_size": tok.vocab_size,
+        "merges": len(tok.merges),
+        "native_core": native_bpe_available(),
+        "docs": len(texts),
+    }))
+    return 0
+
+
+def cmd_dpo(args) -> int:
+    """DPO from a JSONL of {"prompt", "chosen", "rejected"} — token-id
+    lists, or strings when a tokenizer is given. The restored
+    checkpoint is BOTH the starting policy and the frozen reference
+    (the standard recipe: tune away from the SFT model)."""
+    import jax
+
+    from shifu_tpu.data.preference import iter_pair_batches
+    from shifu_tpu.train import (
+        DPOConfig,
+        DPOModel,
+        TrainState,
+        make_train_step,
+        reference_logprobs,
+    )
+
+    model = _build_model(args)
+    tok = _build_tokenizer(args) if args.tokenizer else None
+    pairs = []
+    with open(args.data, encoding="utf-8") as f:
+        for line in f:
+            if not line.strip():
+                continue
+            obj = json.loads(line)
+            row = []
+            for key in ("prompt", "chosen", "rejected"):
+                v = obj[key]
+                if isinstance(v, str):
+                    if tok is None:
+                        print(
+                            f"string {key!r} needs --tokenizer",
+                            file=sys.stderr,
+                        )
+                        return 2
+                    v = tok.encode(v)
+                row.append([int(t) for t in v])
+            pairs.append(tuple(row))
+    if not pairs:
+        print("no pairs in --data", file=sys.stderr)
+        return 2
+
+    import contextlib
+    import itertools
+
+    import jax.numpy as jnp
+
+    if tok is not None and tok.vocab_size > model.cfg.vocab_size:
+        print(
+            f"warning: tokenizer vocab {tok.vocab_size} exceeds model "
+            f"vocab {model.cfg.vocab_size}; ids are clipped",
+            file=sys.stderr,
+        )
+        pairs = [
+            tuple(
+                [min(t, model.cfg.vocab_size - 1) for t in seq]
+                for seq in row
+            )
+            for row in pairs
+        ]
+    params = _restore_params(args, model)
+    ref_params = params  # frozen; the step never donates it (see below)
+    dm = DPOModel(model, DPOConfig(beta=args.beta, loss_type=args.loss_type))
+    optimizer = _build_optimizer(args, args.steps)
+    mesh = _build_mesh(args.mesh) if args.mesh else None
+    with contextlib.ExitStack() as ctx:
+        if mesh is not None:
+            ctx.enter_context(mesh)
+        if mesh is None:
+            # The train step DONATES its state; start it from a copy so
+            # ref_params stays alive for reference_logprobs all run.
+            state = TrainState.create(
+                jax.tree_util.tree_map(lambda x: x.copy(), params),
+                optimizer,
+            )
+        else:
+            # The standard mesh recipe (Trainer does the same): state
+            # created directly into its shards, batches sharded per
+            # step — a host-resident state would fight the step's
+            # in_shardings.
+            from shifu_tpu.train import state_shardings
+
+            st_shard = state_shardings(dm, mesh, optimizer=optimizer)
+            state = jax.jit(
+                lambda p: TrainState.create(p, optimizer),
+                out_shardings=st_shard,
+            )(params)
+        step = make_train_step(dm, optimizer, mesh)
+        eos = tok.eos_id if tok is not None else None
+        raw_batches = list(iter_pair_batches(
+            pairs, args.batch_size, args.seq_len, eos_id=eos,
+            seed=args.seed,
+        ))
+        if not raw_batches:
+            print(
+                f"{len(pairs)} pairs cannot fill one batch of "
+                f"{args.batch_size}; lower --batch-size",
+                file=sys.stderr,
+            )
+            return 2
+        # Score the frozen reference ONCE per distinct batch (jitted,
+        # params as an argument — a closure would embed them as program
+        # constants), then cycle the augmented batches.
+        ref_fn = jax.jit(
+            lambda p, b: reference_logprobs(model, p, b)
+        )
+
+        def prep(raw):
+            b = {k: jnp.asarray(v) for k, v in raw.items()}
+            if mesh is not None:
+                from shifu_tpu.parallel import shard_batch
+
+                b = shard_batch(b, mesh)
+            return ref_fn(ref_params, b)
+
+        batches = itertools.cycle([prep(r) for r in raw_batches])
+
+        for i in range(args.steps):
+            state, m = step(state, next(batches))
+            if args.log_every and (i % args.log_every == 0):
+                print(json.dumps({
+                    "step": i,
+                    "loss": round(float(m["loss"]), 5),
+                    "reward_margin": round(float(m["reward_margin"]), 5),
+                    "accuracy": round(float(m["accuracy"]), 4),
+                }), flush=True)
+    if args.out_ckpt_dir:
+        from shifu_tpu.checkpoint import Checkpointer
+
+        ckpt = Checkpointer(args.out_ckpt_dir)
+        try:
+            ckpt.save(args.steps, state, force=True)
+            ckpt.wait()
+        finally:
+            ckpt.close()
+    print(json.dumps({"done": args.steps, "pairs": len(pairs)}))
+    return 0
+
+
 def _restore_params(args, model):
     """Latest checkpoint's params (params-only partial read — works for
     any training optimizer); fresh init when no --ckpt-dir is given."""
@@ -175,16 +353,15 @@ def cmd_generate(args) -> int:
     import jax
     import jax.numpy as jnp
 
-    from shifu_tpu.data.tokenizer import ByteTokenizer
     from shifu_tpu.infer import SampleConfig, make_generate_fn
 
     model = _build_model(args)
     params = _restore_params(args, model)
-    tok = ByteTokenizer()
+    tok = _build_tokenizer(args)
     if tok.vocab_size > model.cfg.vocab_size:
         print(
-            f"warning: byte vocab {tok.vocab_size} exceeds model vocab "
-            f"{model.cfg.vocab_size}; ids are clipped",
+            f"warning: tokenizer vocab {tok.vocab_size} exceeds model "
+            f"vocab {model.cfg.vocab_size}; ids are clipped",
             file=sys.stderr,
         )
     ids = [min(i, model.cfg.vocab_size - 1) for i in tok.encode(args.prompt)]
@@ -212,12 +389,19 @@ def cmd_generate(args) -> int:
 
 
 def cmd_serve(args) -> int:
-    from shifu_tpu.data.tokenizer import ByteTokenizer
     from shifu_tpu.infer import Engine, PagedEngine, SampleConfig, make_server
 
     model = _build_model(args)
     params = _restore_params(args, model)
-    tok = ByteTokenizer()
+    tok = _build_tokenizer(args)
+    if tok.vocab_size > model.cfg.vocab_size:
+        print(
+            f"warning: tokenizer vocab {tok.vocab_size} exceeds model "
+            f"vocab {model.cfg.vocab_size}; out-of-range prompt ids "
+            "reach the embedding unclipped (XLA clamps them) — train "
+            "the model with a matching vocab",
+            file=sys.stderr,
+        )
     kw = dict(
         max_slots=args.max_slots,
         max_len=args.max_len,
@@ -334,16 +518,50 @@ def main(argv=None) -> int:
     e.add_argument("--batches", type=int, default=32)
     e.set_defaults(fn=cmd_eval)
 
-    g = sub.add_parser("generate", help="byte-tokenizer text completion")
+    d = sub.add_parser(
+        "dpo", help="DPO preference tuning from a JSONL of pairs"
+    )
+    model_flags(d, schedule_default="constant")
+    d.add_argument("--data", required=True,
+                   help='JSONL: {"prompt", "chosen", "rejected"} — '
+                        "token-id lists, or strings with --tokenizer")
+    d.add_argument("--tokenizer", help="bpe-train artifact (bpe.json)")
+    d.add_argument("--steps", type=int, default=100)
+    d.add_argument("--batch-size", type=int, default=8)
+    d.add_argument("--seq-len", type=int, default=512)
+    d.add_argument("--beta", type=float, default=0.1)
+    d.add_argument("--loss-type", default="sigmoid",
+                   choices=["sigmoid", "ipo"])
+    d.add_argument("--mesh", help="e.g. fsdp=4,tp=2 (axes of MeshPlan)")
+    d.add_argument("--out-ckpt-dir", help="save the tuned state here")
+    d.add_argument("--log-every", type=int, default=10)
+    d.set_defaults(fn=cmd_dpo)
+
+    g = sub.add_parser("generate", help="text completion from a checkpoint")
     model_flags(g, schedule_default="constant")
     g.add_argument("--prompt", required=True)
+    g.add_argument("--tokenizer", help="bpe-train artifact (bpe.json); "
+                                       "default: byte tokenizer")
     g.add_argument("--max-new-tokens", type=int, default=128)
     g.add_argument("--temperature", type=float, default=0.8)
     g.add_argument("--top-p", type=float, default=0.95)
     g.set_defaults(fn=cmd_generate)
 
+    b = sub.add_parser(
+        "bpe-train", help="train a byte-level BPE tokenizer (native core)"
+    )
+    b.add_argument("--data", nargs="+", required=True,
+                   help="text file(s); whole-file docs unless --per-line")
+    b.add_argument("--per-line", action="store_true",
+                   help="treat each line as one document")
+    b.add_argument("--vocab-size", type=int, default=8192)
+    b.add_argument("--out", required=True, help="output bpe.json path")
+    b.set_defaults(fn=cmd_bpe_train)
+
     s = sub.add_parser("serve", help="HTTP completions server")
     model_flags(s, schedule_default="constant")
+    s.add_argument("--tokenizer", help="bpe-train artifact (bpe.json); "
+                                       "default: byte tokenizer")
     s.add_argument("--host", default="127.0.0.1")
     s.add_argument("--port", type=int, default=8000)
     s.add_argument("--max-slots", type=int, default=8)
