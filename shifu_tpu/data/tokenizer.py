@@ -106,6 +106,14 @@ class HFTokenizer:
     def decode(self, ids: Sequence[int]) -> str:
         return self._tok.decode(list(ids), skip_special_tokens=True)
 
+    @property
+    def chat_template(self):
+        """The underlying HF tokenizer's chat template (None when it
+        has none — the probe infer/server.py uses to choose between
+        the template and the generic rendering, without reaching into
+        ``_tok``)."""
+        return getattr(self._tok, "chat_template", None)
+
     def apply_chat_template(self, messages, *, add_generation_prompt=True):
         """Render a chat message list to token ids via the underlying
         HF tokenizer's chat template (raises when the tokenizer has

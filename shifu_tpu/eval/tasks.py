@@ -43,11 +43,19 @@ class MCExample:
     ``context``: conditioning token ids (the "question").
     ``options``: candidate continuation token id sequences.
     ``answer``: index of the gold option.
+    ``option_char_lengths``: character length of each option's TEXT,
+    when known. accuracy_norm divides scores by these (the lm-eval /
+    HellaSwag acc_norm convention — byte/char length, not token count,
+    so numbers are comparable to published results and invariant to
+    the tokenizer). When absent (pre-tokenized data with no text),
+    token counts are the fallback denominator and the result is NOT
+    lm-eval-comparable.
     """
 
     context: Sequence[int]
     options: Sequence[Sequence[int]]
     answer: int
+    option_char_lengths: Optional[Sequence[int]] = None
 
     def __post_init__(self):
         if not self.context:
@@ -64,6 +72,15 @@ class MCExample:
             )
         if any(len(o) == 0 for o in self.options):
             raise ValueError("empty option (nothing to score)")
+        if self.option_char_lengths is not None:
+            if len(self.option_char_lengths) != len(self.options):
+                raise ValueError(
+                    "option_char_lengths must parallel options "
+                    f"({len(self.option_char_lengths)} vs "
+                    f"{len(self.options)})"
+                )
+            if any(c <= 0 for c in self.option_char_lengths):
+                raise ValueError("option_char_lengths must be positive")
 
 
 def _encode_rows(pairs, seq_len: int, pad_id: int):
@@ -147,7 +164,14 @@ def evaluate_multiple_choice(
     batch_rows: int = 32,
     pad_id: int = 0,
 ) -> dict:
-    """Accuracy (raw argmax) and length-normalised accuracy."""
+    """Accuracy (raw argmax) and length-normalised accuracy.
+
+    accuracy_norm divides each option's score by its CHARACTER length
+    (``MCExample.option_char_lengths`` — the lm-eval acc_norm
+    convention) when the example carries it; token count is the
+    fallback for pre-tokenized examples without text. Mixed inputs are
+    fine — the denominator is chosen per example.
+    """
     scores, lengths = score_options(
         model, params, examples,
         seq_len=seq_len, batch_rows=batch_rows, pad_id=pad_id,
@@ -155,6 +179,8 @@ def evaluate_multiple_choice(
     hits = 0
     hits_norm = 0
     for ex, s, n in zip(examples, scores, lengths):
+        if ex.option_char_lengths is not None:
+            n = np.asarray(ex.option_char_lengths, np.float64)
         hits += int(np.argmax(s) == ex.answer)
         hits_norm += int(np.argmax(s / n) == ex.answer)
     total = max(len(examples), 1)
@@ -173,9 +199,12 @@ def encode_mc_example(
 ) -> MCExample:
     """Text -> MCExample. Options encode as continuations of the
     context (leading-space convention is the caller's concern — pass
-    options exactly as they should follow the context text)."""
+    options exactly as they should follow the context text). Records
+    option character lengths so accuracy_norm uses the lm-eval
+    convention."""
     return MCExample(
         context=tokenizer.encode(context),
         options=[tokenizer.encode(o) for o in options],
         answer=answer,
+        option_char_lengths=[len(o) for o in options],
     )

@@ -40,9 +40,17 @@ class SampleConfig:
       penalties over tokens already GENERATED in the request
       (presence: flat subtraction for any occurrence; frequency:
       per-occurrence). Applied to the raw logits before temperature.
-    repetition_penalty: HF-style multiplicative penalty (> 1 discourages
+    repetition_penalty: multiplicative penalty (> 1 discourages
       repeats) over generated tokens: positive logits divide by it,
       negative multiply. Applied before the additive penalties.
+      DIVERGENCE from HF/vLLM: both also penalise tokens that appear
+      in the PROMPT (HF penalises all input ids; vLLM counts
+      prompt+output); here only generated tokens count, so
+      prompt-echoed tokens get weaker suppression. Deliberate — the
+      count buffer is rebuilt from generated ids on preemption and
+      prompt tokens would make long-document prompts self-censoring —
+      but clients porting HF/vLLM settings should expect the
+      difference.
     """
 
     temperature: float = 1.0

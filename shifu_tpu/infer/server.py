@@ -633,23 +633,41 @@ class _Handler(BaseHTTPRequestHandler):
                 "must be rendered and encoded)"
             )
         apply = getattr(self.tokenizer, "apply_chat_template", None)
-        if apply is not None:
-            try:
-                # Explicit add_generation_prompt: raw HF tokenizers
-                # default it to False (the adapter defaults True) —
-                # without it the model would continue the user turn
-                # instead of answering it.
-                return [
-                    int(t)
-                    for t in apply(messages, add_generation_prompt=True)
-                ]
-            except ValueError:
-                # transformers raises ValueError for "no chat template
-                # configured" — THAT falls back to the generic
-                # rendering. Template-execution failures (jinja errors
-                # etc.) propagate and surface as a 400 instead of
-                # silently serving a rendering the model never saw.
-                pass
+        # Fall back to the generic rendering only when the tokenizer
+        # POSITIVELY has no template: the HF convention is a
+        # ``chat_template`` attribute explicitly set to None (probed up
+        # front on the adapter's underlying tokenizer). Catching
+        # ValueError here would be wrong — transformers raises
+        # ValueError for several template-EXECUTION failures too, and
+        # those must surface as 400s rather than silently serving a
+        # rendering the model never saw. Custom tokenizers that define
+        # apply_chat_template without a chat_template attribute are
+        # trusted to have one. The framework's HF adapter exposes
+        # ``chat_template`` directly (data/tokenizer.py); the ``_tok``
+        # reach-through covers raw HF tokenizers handed to the server.
+        probe = (
+            self.tokenizer
+            if hasattr(self.tokenizer, "chat_template")
+            else getattr(self.tokenizer, "_tok", self.tokenizer)
+        )
+        templateless = (
+            hasattr(probe, "chat_template")
+            and probe.chat_template is None
+            # transformers < 4.43 could still render via the legacy
+            # class-level default_chat_template when chat_template was
+            # None — honour it rather than silently switching those
+            # installs to the generic rendering.
+            and getattr(probe, "default_chat_template", None) is None
+        )
+        if apply is not None and not templateless:
+            # Explicit add_generation_prompt: raw HF tokenizers
+            # default it to False (the adapter defaults True) —
+            # without it the model would continue the user turn
+            # instead of answering it.
+            return [
+                int(t)
+                for t in apply(messages, add_generation_prompt=True)
+            ]
         text = "".join(
             f"<|{m['role']}|>\n{m['content']}\n" for m in messages
         ) + "<|assistant|>\n"

@@ -43,6 +43,7 @@ match. The objective follows the published DPO/IPO formulations.
 from __future__ import annotations
 
 import dataclasses
+import warnings
 
 import jax
 import jax.numpy as jnp
@@ -194,6 +195,20 @@ class DPOModel:
         self.inner = model
         self.cfg = model.cfg
         self.dpo_cfg = dpo_cfg
+        if getattr(self.cfg, "n_experts", 0):
+            # sequence_logprobs runs the forward without return_aux, so
+            # the router load-balancing losses do NOT reach the DPO
+            # objective — routers can drift over a long DPO run. This is
+            # the standard choice (preference tuning optimises the
+            # policy margin, not routing entropy) but it must not be
+            # silent.
+            warnings.warn(
+                "DPOModel on an MoE config: router aux (load-balancing) "
+                "losses are not part of the DPO objective — router "
+                "distributions are unconstrained during DPO. Keep DPO "
+                "runs short or monitor routing entropy.",
+                stacklevel=2,
+            )
 
     def loss(self, params, batch):
         return dpo_loss(self.inner, self.dpo_cfg, params, batch)
