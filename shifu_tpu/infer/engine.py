@@ -31,7 +31,9 @@ import jax.numpy as jnp
 
 from shifu_tpu.infer.sampling import (
     SampleConfig,
+    apply_logit_bias,
     apply_penalties,
+    bias_row,
     penalty_params,
     row_params,
     sample_logits,
@@ -66,6 +68,12 @@ class _Request:
     # Stop sequences: token-id sequences / decoded-text substrings.
     stop_token_ids: Optional[List[List[int]]] = None
     stop_strings: Optional[List[str]] = None
+    # Constrained decoding (engines with enable_logit_bias): additive
+    # per-token biases and/or a hard allowed-token set — kept on the
+    # request so preemption-recompute re-admissions rebuild the slot's
+    # bias row exactly.
+    logit_bias: Optional[dict] = None
+    allowed_token_ids: Optional[List[int]] = None
     # Tokens already cleared of stop matches (resume point for the
     # sweep's scan — keeps per-step stop checking incremental).
     stop_scanned: int = 0
@@ -110,6 +118,7 @@ class Engine:
         sharding_rules=None,
         per_request_sampling: bool = False,
         enable_penalties: bool = False,
+        enable_logit_bias: bool = False,
         tokenizer=None,
     ):
         """``per_request_sampling``: temperature/top-k/top-p become
@@ -146,6 +155,14 @@ class Engine:
         Auto-enabled when ``sample_cfg`` carries penalties. Off by
         default: the counts buffer costs slots x vocab x 4 bytes of
         host->device traffic per dispatch.
+
+        ``enable_logit_bias``: maintain a per-slot (max_slots, vocab)
+        f32 additive-bias buffer and add it to the raw logits before
+        sampling — the constrained-decoding seam
+        (``submit(..., logit_bias=..., allowed_token_ids=...)``, OpenAI
+        ban semantics; see ``sampling.bias_row``). Off by default for
+        the same reason as penalties: the buffer is slots x vocab x 4
+        bytes of host->device traffic per dispatch.
 
         ``tokenizer``: optional; needed only for STRING stop sequences
         (``submit(..., stop_strings=...)`` — the sweep decodes the
@@ -208,8 +225,27 @@ class Engine:
         self._row_freq = np.full((max_slots,), fp0, np.float32)
         self._row_rep = np.full((max_slots,), rp0, np.float32)
         if self.enable_penalties:
-            self._counts = np.zeros(
-                (max_slots, self.model.cfg.vocab_size), np.int32
+            # DEVICE-RESIDENT counts: the (slots, vocab) buffer lives
+            # on device across dispatches — the decode programs update
+            # and RETURN it, admission resets one slot's row (built
+            # host-side from req.generated, the only mirror needed).
+            # The old design re-uploaded the whole buffer every decode
+            # dispatch (slots x vocab x 4B of host->device traffic on
+            # the product path) and discarded the device updates.
+            self._counts_dev = jnp.zeros(
+                (max_slots, self.model.cfg.vocab_size), jnp.int32
+            )
+
+        # Constrained decoding (enable_logit_bias): per-slot additive
+        # bias rows, DEVICE-resident (like the penalty counts — but
+        # read-only between admissions, so only admission touches it:
+        # one (vocab,) row write per admitted request, zero recurring
+        # host->device traffic on the decode path). Unused slots stay
+        # all-zero (identity).
+        self.enable_logit_bias = bool(enable_logit_bias)
+        if self.enable_logit_bias:
+            self._bias_dev = jnp.zeros(
+                (max_slots, self.model.cfg.vocab_size), jnp.float32
             )
 
         self._prefill_jit = jax.jit(
@@ -232,6 +268,8 @@ class Engine:
         sampling: Optional[SampleConfig] = None,
         stop_token_ids=None,
         stop_strings=None,
+        logit_bias: Optional[dict] = None,
+        allowed_token_ids=None,
     ) -> int:
         """Queue one request; returns its rid.
 
@@ -242,7 +280,11 @@ class Engine:
         ``stop_strings``: iterable of substrings checked against the
         DECODED generation (requires the engine's ``tokenizer``); the
         returned tokens end at the first token whose decoding completes
-        a stop string (the server trims the trailing text)."""
+        a stop string (the server trims the trailing text).
+        ``logit_bias``: {token_id: additive bias}, OpenAI semantics
+        (<= -100 is a hard ban). ``allowed_token_ids``: restrict
+        sampling to exactly these ids (everything else hard-banned).
+        Both need ``Engine(enable_logit_bias=True)``."""
         if sampling is not None and not self.per_request_sampling:
             raise ValueError(
                 "per-request sampling requires "
@@ -259,6 +301,23 @@ class Engine:
                 "Engine(enable_penalties=True) — the counts buffer is "
                 "not maintained otherwise"
             )
+        if logit_bias is not None or allowed_token_ids is not None:
+            if not self.enable_logit_bias:
+                raise ValueError(
+                    "logit_bias/allowed_token_ids require "
+                    "Engine(enable_logit_bias=True) — the bias buffer "
+                    "is not maintained otherwise"
+                )
+            # Validate NOW (bias_row raises on bad ids/values) so the
+            # error surfaces at submit, not on the engine thread mid-
+            # admission; the row itself is rebuilt at admission time.
+            bias_row(
+                self.model.cfg.vocab_size, logit_bias, allowed_token_ids
+            )
+            if logit_bias is not None:
+                logit_bias = {int(t): float(v) for t, v in logit_bias.items()}
+            if allowed_token_ids is not None:
+                allowed_token_ids = [int(t) for t in allowed_token_ids]
         if stop_token_ids is not None:
             stop_token_ids = [
                 [int(seq)] if isinstance(seq, int) else list(map(int, seq))
@@ -302,6 +361,7 @@ class Engine:
                 rid, prompt_tokens, max_new_tokens, generated=[],
                 sampling=sampling, logprobs=[],
                 stop_token_ids=stop_token_ids, stop_strings=stop_strings,
+                logit_bias=logit_bias, allowed_token_ids=allowed_token_ids,
             )
         )
         return rid
@@ -397,10 +457,12 @@ class Engine:
         results into host state. Speculative engines override with the
         propose/verify round program."""
         if self.decode_chunk == 1:
-            nxt, lps, self.cache = self._decode_jit(
+            nxt, lps, self.cache, *cts = self._decode_jit(
                 self.params, self.cache, cur, lengths, active,
                 *self._decode_extra_args(), sub,
             )
+            if cts:
+                self._counts_dev = cts[0]
             nxt, lps = np.asarray(nxt), np.asarray(lps)
             for slot, req in self._active.items():
                 token = int(nxt[slot])
@@ -408,18 +470,18 @@ class Engine:
                 req.logprobs.append(float(lps[slot]))
                 self._lengths[slot] += 1
                 self._cur[slot] = token
-                if self.enable_penalties:
-                    self._counts[slot, token] += 1
         else:
             remaining = np.zeros((self.max_slots,), np.int32)
             for slot, req in self._active.items():
                 remaining[slot] = req.max_new_tokens - len(req.generated)
-            toks, lps, n_emit, cur2, lengths2, self.cache = (
+            toks, lps, n_emit, cur2, lengths2, self.cache, *cts = (
                 self._decode_chunk_jit(
                     self.params, self.cache, cur, lengths, active,
                     jnp.asarray(remaining), *self._decode_extra_args(), sub,
                 )
             )
+            if cts:
+                self._counts_dev = cts[0]
             toks, n_emit = np.asarray(toks), np.asarray(n_emit)
             lps = np.asarray(lps)
             cur2, lengths2 = np.asarray(cur2), np.asarray(lengths2)
@@ -429,9 +491,6 @@ class Engine:
                 req.logprobs.extend(float(x) for x in lps[slot, :n])
                 self._lengths[slot] = int(lengths2[slot])
                 self._cur[slot] = int(cur2[slot])
-                if self.enable_penalties:
-                    for t in toks[slot, :n]:
-                        self._counts[slot, int(t)] += 1
 
     def _try_admit(self, req: "_Request") -> bool:
         """Admit ``req`` (a free slot is guaranteed by the caller).
@@ -445,9 +504,11 @@ class Engine:
 
     def _decode_extra_args(self) -> tuple:
         """Extra positional args for _decode_impl, before rng:
-        per-slot sampling arrays, then penalty arrays (flat; impls
-        re-split with _split_extra)."""
-        return self._sampling_args() + self._penalty_args()
+        per-slot sampling arrays, then penalty arrays, then the bias
+        buffer (flat; impls re-split with _split_extra)."""
+        return (
+            self._sampling_args() + self._penalty_args() + self._bias_args()
+        )
 
     # -------------------------------------------- per-request sampling
     def _sampling_args(self) -> tuple:
@@ -494,23 +555,51 @@ class Engine:
 
     def _penalty_args(self) -> tuple:
         """Traced penalty arrays: (counts, presence, frequency,
-        repetition) — () when penalties are disabled."""
+        repetition) — () when penalties are disabled. ``counts`` is the
+        PERSISTENT device array (no per-dispatch host->device upload;
+        the strengths are (slots,) scalars, noise)."""
         if not self.enable_penalties:
             return ()
         return (
-            jnp.asarray(self._counts),
+            self._counts_dev,
             jnp.asarray(self._row_pres),
             jnp.asarray(self._row_freq),
             jnp.asarray(self._row_rep),
         )
 
+    def _bias_args(self) -> tuple:
+        """The persistent device (slots, vocab) bias buffer — () when
+        disabled. No per-dispatch upload: admission is the only
+        writer."""
+        if not self.enable_logit_bias:
+            return ()
+        return (self._bias_dev,)
+
+    def _req_bias_args(self, req: _Request) -> tuple:
+        """Traced (1, vocab) bias row for one request's prefill."""
+        if not self.enable_logit_bias:
+            return ()
+        return (
+            jnp.asarray(
+                bias_row(
+                    self.model.cfg.vocab_size,
+                    req.logit_bias,
+                    req.allowed_token_ids,
+                )[None, :]
+            ),
+        )
+
     def _split_extra(self, rest: tuple):
-        """Parse a program's trailing args into (lead, samp, pen, rng)
-        — the flat layout _decode_extra_args produced, parsed from the
-        END so subclass-specific leading extras (the paged engine's
+        """Parse a program's trailing args into (lead, samp, pen, bias,
+        rng) — the flat layout _decode_extra_args produced, parsed from
+        the END so subclass-specific leading extras (the paged engine's
         page table) pass through untouched."""
         rng = rest[-1]
         rest = rest[:-1]
+        bias = ()
+        if self.enable_logit_bias:
+            bias = (rest[-1],)
+            rest = rest[:-1]
         pen = ()
         if self.enable_penalties:
             pen = tuple(rest[-4:])
@@ -519,14 +608,20 @@ class Engine:
         if self.per_request_sampling:
             samp = tuple(rest[-4:])
             rest = rest[:-4]
-        return tuple(rest), samp, pen, rng
+        return tuple(rest), samp, pen, bias, rng
 
-    def _sample_rows(self, logits, rng, samp: tuple, pen: tuple = ()):
+    def _sample_rows(self, logits, rng, samp: tuple, pen: tuple = (),
+                     bias: tuple = ()):
         """Engine-level static sampler, or the per-row traced one —
-        penalties (when enabled) transform the raw logits first."""
+        penalties (when enabled) transform the raw logits first, then
+        the additive bias lands LAST so a hard ban is the final word
+        (greedy argmax included: both samplers argmax the transformed
+        logits, so a ban holds at temperature 0 too)."""
         if pen:
             counts, pres, freq, rep = pen
             logits = apply_penalties(logits, counts, pres, freq, rep)
+        if bias:
+            logits = apply_logit_bias(logits, bias[0])
         if not samp:
             return sample_logits(logits, rng, self.sample_cfg)
         return sample_logits_per_row(logits, rng, *samp)
@@ -544,7 +639,7 @@ class Engine:
         (slots, K), logprobs (slots, K), n_emitted (slots,), cur,
         lengths, cache).
         """
-        lead, samp, pen, rng = self._split_extra(rest)
+        lead, samp, pen, bias, rng = self._split_extra(rest)
         k = self.decode_chunk
         eos = self.eos_id
         counts0 = pen[0] if pen else None
@@ -553,26 +648,35 @@ class Engine:
             cache, cur, lengths, done, counts = carry
             live = active & ~done & (t < remaining)
             pen_t = (counts, *pen[1:]) if pen else ()
-            nxt, lp, cache = self._decode_impl(
+            # ``bias`` is chunk-constant (admission writes it; nothing
+            # mid-chunk changes a slot's constraints) — passed through
+            # each step unchanged, unlike the counts carry.
+            res = self._decode_impl(
                 params, cache, cur, lengths, live, *lead, *samp, *pen_t,
-                jax.random.fold_in(rng, t),
+                *bias, jax.random.fold_in(rng, t),
             )
             if pen:
-                # Mid-chunk emissions penalise the very next step; the
-                # host rebuilds its mirror from the emitted tokens.
-                counts = counts.at[
-                    jnp.arange(self.max_slots), nxt
-                ].add(live.astype(jnp.int32))
+                # _decode_impl already folded this step's emission into
+                # the counts (mid-chunk emissions penalise the very
+                # next step); the updated buffer rides the carry and is
+                # RETURNED — it becomes the engine's persistent device
+                # buffer, never re-uploaded from the host.
+                nxt, lp, cache, counts = res
+            else:
+                nxt, lp, cache = res
             lengths = jnp.where(live, lengths + 1, lengths)
             if eos is not None:
                 done = done | (live & (nxt == eos))
             return (cache, nxt, lengths, done, counts), (nxt, lp, live)
 
         done0 = jnp.zeros((self.max_slots,), bool)
-        (cache, cur, lengths, _, _), (toks, lps, lives) = jax.lax.scan(
-            body, (cache, cur, lengths, done0, counts0), jnp.arange(k)
+        (cache, cur, lengths, _, counts), (toks, lps, lives) = (
+            jax.lax.scan(
+                body, (cache, cur, lengths, done0, counts0),
+                jnp.arange(k),
+            )
         )
-        return (
+        out = (
             toks.T,  # (slots, K)
             lps.T,
             jnp.sum(lives, axis=0).astype(jnp.int32),
@@ -580,6 +684,7 @@ class Engine:
             lengths,
             cache,
         )
+        return out + ((counts,) if pen else ())
 
     def _init_cache(self, cache_dtype):
         """Device cache for the slot pool; paged engines override."""
@@ -778,7 +883,9 @@ class Engine:
         self._rng, sub = jax.random.split(self._rng)
         first, lp = self._dispatch_prefill(
             slot, padded, p, bucket, sub,
-            self._req_sampling_args(req) + self._req_penalty_args(req),
+            self._req_sampling_args(req)
+            + self._req_penalty_args(req)
+            + self._req_bias_args(req),
         )
         self._finish_admission(req, slot, p, first, lp)
 
@@ -815,13 +922,26 @@ class Engine:
             self._row_pres[slot], self._row_freq[slot], self._row_rep[slot] = (
                 penalty_params(cfg)
             )
-            # Rebuild this slot's counts from the request's generated
-            # tokens — correct for fresh admissions (just the first
-            # token) AND preemption-recompute re-admissions (the whole
-            # resumed generation).
-            self._counts[slot] = 0
-            np.add.at(
-                self._counts[slot], np.asarray(req.generated, np.int64), 1
+            # Rebuild this slot's DEVICE row from the request's
+            # generated tokens — correct for fresh admissions (just the
+            # first token) AND preemption-recompute re-admissions (the
+            # whole resumed generation). One (vocab,) row upload per
+            # admission, not a buffer upload per dispatch.
+            row = np.zeros((self.model.cfg.vocab_size,), np.int32)
+            np.add.at(row, np.asarray(req.generated, np.int64), 1)
+            self._counts_dev = self._counts_dev.at[slot].set(
+                jnp.asarray(row)
+            )
+        if self.enable_logit_bias:
+            # Rebuilt from the request (not carried from the prefill
+            # args) so preemption-recompute re-admissions restore the
+            # slot's constraints and freed slots return to identity.
+            self._bias_dev = self._bias_dev.at[slot].set(
+                jnp.asarray(bias_row(
+                    self.model.cfg.vocab_size,
+                    req.logit_bias,
+                    req.allowed_token_ids,
+                ))
             )
         self._active[slot] = req
         # A 1-token budget can finish at admission; step() sweeps it on
@@ -831,8 +951,8 @@ class Engine:
                       bucket):
         """Prefill one request into cache row ``slot``; sample token 1.
         ``rest`` = optional per-request sampling arrays, optional
-        penalty arrays, then rng."""
-        _, samp, pen, rng = self._split_extra(rest)
+        penalty arrays, optional bias row, then rng."""
+        _, samp, pen, bias, rng = self._split_extra(rest)
         row = jax.tree_util.tree_map(
             lambda c: jax.lax.dynamic_slice_in_dim(c, slot, 1, axis=1),
             cache,
@@ -869,7 +989,7 @@ class Engine:
             cache,
             row,
         )
-        tok = self._sample_rows(logits[:, 0], rng, samp, pen)[0]
+        tok = self._sample_rows(logits[:, 0], rng, samp, pen, bias)[0]
         lp = _token_logprob(logits[:, 0], tok[None])[0]
         return tok, lp, cache
 
@@ -877,8 +997,9 @@ class Engine:
         """One (token, logprob) for every slot (inactive slots compute
         but are ignored — static shapes beat host-side gather/scatter
         here). ``rest`` = optional per-slot sampling arrays, optional
-        penalty arrays, then rng (_split_extra's layout)."""
-        _, samp, pen, rng = self._split_extra(rest)
+        penalty arrays, optional bias buffer, then rng (_split_extra's
+        layout)."""
+        _, samp, pen, bias, rng = self._split_extra(rest)
         kv_mask = (
             jnp.arange(self.max_len)[None, :] <= lengths[:, None]
         )
@@ -889,11 +1010,20 @@ class Engine:
             cache_index=lengths,  # per-row write offsets
             kv_mask=kv_mask,
         )
-        nxt = self._sample_rows(logits[:, -1], rng, samp, pen)
+        nxt = self._sample_rows(logits[:, -1], rng, samp, pen, bias)
         lp = _token_logprob(logits[:, -1], nxt)
         # Freeze inactive slots' cur so their cache rows stay untouched in
         # spirit (they are written, but their lengths never advance).
-        return jnp.where(active, nxt, cur), lp, cache
+        out = jnp.where(active, nxt, cur), lp, cache
+        if pen:
+            # Fold this step's emission into the device counts (active
+            # rows only) and return the updated buffer — the engine
+            # keeps it resident across dispatches.
+            counts = pen[0].at[
+                jnp.arange(self.max_slots), nxt
+            ].add(active.astype(jnp.int32))
+            return out + (counts,)
+        return out
 
 
 class PagedEngine(Engine):
@@ -1307,7 +1437,11 @@ class PagedEngine(Engine):
         padded = np.zeros((bucket,), np.int32)
         padded[: len(suffix)] = suffix
         self._rng, sub = jax.random.split(self._rng)
-        samp = self._req_sampling_args(req) + self._req_penalty_args(req)
+        samp = (
+            self._req_sampling_args(req)
+            + self._req_penalty_args(req)
+            + self._req_bias_args(req)
+        )
         if hit:
             first, lp = self._dispatch_prefill_at(
                 slot, padded, len(suffix), hit, bucket, sub, samp=samp,
@@ -1408,6 +1542,7 @@ class PagedEngine(Engine):
                 samp=(
                     self._req_sampling_args(req)
                     + self._req_penalty_args(req)
+                    + self._req_bias_args(req)
                 ),
                 final_len=len(prompt),
             )
@@ -1475,8 +1610,8 @@ class PagedEngine(Engine):
         frequencies a one-shot prefill of the whole prompt would (a
         mid-prompt chunk's own max position would pick a shorter, WRONG
         regime). ``rest`` = optional per-request sampling arrays,
-        optional penalty arrays, then rng."""
-        _, samp, pen, rng = self._split_extra(rest)
+        optional penalty arrays, optional bias row, then rng."""
+        _, samp, pen, bias, rng = self._split_extra(rest)
         pos = jnp.minimum(
             offset + jnp.arange(bucket), offset + length - 1
         )
@@ -1490,7 +1625,7 @@ class PagedEngine(Engine):
             logits_at=(length - 1)[None],
             rope_regime_len=final_len,
         )
-        tok = self._sample_rows(logits[:, 0], rng, samp, pen)[0]
+        tok = self._sample_rows(logits[:, 0], rng, samp, pen, bias)[0]
         lp = _token_logprob(logits[:, 0], tok[None])[0]
         return tok, lp, cache
 
@@ -1525,6 +1660,7 @@ class PagedEngine(Engine):
             (jnp.asarray(self._table),)
             + self._sampling_args()
             + self._penalty_args()
+            + self._bias_args()
         )
 
     # ----------------------------------------------------------- programs
@@ -1532,8 +1668,8 @@ class PagedEngine(Engine):
                       *rest, bucket):
         """Prefill one request straight into its pages; sample token 1.
         ``rest`` = optional per-request sampling arrays, optional
-        penalty arrays, then rng."""
-        _, samp, pen, rng = self._split_extra(rest)
+        penalty arrays, optional bias row, then rng."""
+        _, samp, pen, bias, rng = self._split_extra(rest)
         logits, cache = self.model(
             params,
             tokens[None, :],
@@ -1545,15 +1681,15 @@ class PagedEngine(Engine):
             page_table=table_row[None, :],
             logits_at=(length - 1)[None],
         )
-        tok = self._sample_rows(logits[:, 0], rng, samp, pen)[0]
+        tok = self._sample_rows(logits[:, 0], rng, samp, pen, bias)[0]
         lp = _token_logprob(logits[:, 0], tok[None])[0]
         return tok, lp, cache
 
     def _decode_impl(self, params, cache, cur, lengths, active, table,
                      *rest):
         # ``rest`` = optional per-slot sampling arrays, optional penalty
-        # arrays, then rng (_split_extra's layout).
-        _, samp, pen, rng = self._split_extra(rest)
+        # arrays, optional bias buffer, then rng (_split_extra's layout).
+        _, samp, pen, bias, rng = self._split_extra(rest)
         # No kv_mask: on the paged path it would be ``pos <= lengths`` —
         # exactly the slot-space causality the decode attention already
         # enforces from ``cache_index`` (both the Pallas kernel and the
@@ -1568,6 +1704,12 @@ class PagedEngine(Engine):
             cache_index=lengths,
             page_table=table,
         )
-        nxt = self._sample_rows(logits[:, -1], rng, samp, pen)
+        nxt = self._sample_rows(logits[:, -1], rng, samp, pen, bias)
         lp = _token_logprob(logits[:, -1], nxt)
-        return jnp.where(active, nxt, cur), lp, cache
+        out = jnp.where(active, nxt, cur), lp, cache
+        if pen:
+            counts = pen[0].at[
+                jnp.arange(self.max_slots), nxt
+            ].add(active.astype(jnp.int32))
+            return out + (counts,)
+        return out

@@ -15,6 +15,8 @@ from __future__ import annotations
 import dataclasses
 from typing import Optional
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 
@@ -141,6 +143,64 @@ def filtered_logits(logits, cfg: SampleConfig):
     if cfg.min_p is not None and cfg.min_p > 0.0:
         logits = _apply_min_p(logits, scaled, cfg.min_p)
     return logits
+
+
+def bias_row(
+    vocab_size: int,
+    logit_bias: Optional[dict] = None,
+    allowed_token_ids=None,
+) -> np.ndarray:
+    """One request's additive logit-bias row — the constrained-decoding
+    primitive behind ``logit_bias`` / ``allowed_token_ids``.
+
+    OpenAI semantics for ``logit_bias`` ({token_id: value}): the value
+    adds to that token's raw logit before sampling; values <= -100 are
+    a HARD ban (the row entry becomes NEG_INF, which survives every
+    downstream filter). ``allowed_token_ids`` is the complementary hard
+    constraint: every OTHER token is banned (row starts at NEG_INF,
+    listed ids reset to 0). Biases then apply on top, adjusting
+    preferences WITHIN the allowed set — a positive bias cannot
+    resurrect a token outside it (NEG_INF + 100 is still a ban).
+
+    The row is plain additive data: engines keep a (slots, vocab) f32
+    buffer of these, admission writes a slot's row, and the sampler
+    adds it to the logits — no recompilation, composes with penalties
+    and all per-row filters (greedy argmax included, so a ban holds at
+    temperature 0 too).
+    """
+    row = np.zeros((vocab_size,), np.float32)
+    if allowed_token_ids is not None:
+        ids = [int(t) for t in allowed_token_ids]
+        if not ids:
+            raise ValueError("allowed_token_ids must be non-empty")
+        if any(not 0 <= t < vocab_size for t in ids):
+            raise ValueError(
+                f"allowed_token_ids outside [0, {vocab_size})"
+            )
+        row[:] = NEG_INF
+        row[ids] = 0.0
+    if logit_bias:
+        for tid, v in logit_bias.items():
+            t = int(tid)
+            if not 0 <= t < vocab_size:
+                raise ValueError(
+                    f"logit_bias token id {t} outside [0, {vocab_size})"
+                )
+            v = float(v)
+            if not np.isfinite(v):
+                raise ValueError(f"logit_bias value for {t} not finite")
+            if v <= -100.0:
+                row[t] = NEG_INF  # the OpenAI ban convention
+            else:
+                row[t] += v
+    return row
+
+
+def apply_logit_bias(logits, bias):
+    """Add a (batch, vocab) bias row-set to raw logits, clamped so
+    stacked bans (NEG_INF base + negative bias) cannot overflow f32 to
+    -inf and feed (-inf)-(-inf) NaNs into downstream softmaxes."""
+    return jnp.maximum(logits.astype(jnp.float32) + bias, NEG_INF)
 
 
 def apply_penalties(logits, counts, presence, frequency, repetition):

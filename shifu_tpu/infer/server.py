@@ -121,6 +121,49 @@ def _parse_sampling(req: dict, base: SampleConfig) -> Optional[SampleConfig]:
     )
 
 
+def _parse_bias(req: dict):
+    """JSON ``logit_bias`` / ``allowed_token_ids`` fields -> the
+    engine's submit kwargs (TYPE validation here so bad shapes 400 at
+    the handler; id-range/value checks live in the engine's
+    ``sampling.bias_row``, whose ValueError also surfaces as a 400).
+
+    ``logit_bias`` follows the OpenAI wire shape: an object whose keys
+    are token-id STRINGS (JSON objects cannot have int keys) and whose
+    values are numbers, <= -100 meaning a hard ban."""
+    lb = req.get("logit_bias")
+    allowed = req.get("allowed_token_ids")
+    if lb is not None:
+        if not isinstance(lb, dict) or not lb:
+            raise ValueError(
+                "logit_bias must be a non-empty object of "
+                "token_id -> number"
+            )
+        out = {}
+        for key, v in lb.items():
+            try:
+                t = int(key)
+            except (TypeError, ValueError):
+                raise ValueError(
+                    f"logit_bias key {key!r} is not a token id"
+                ) from None
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                raise ValueError(
+                    f"logit_bias value for {key!r} must be a number"
+                )
+            out[t] = float(v)
+        lb = out
+    if allowed is not None:
+        if not isinstance(allowed, list) or not allowed:
+            raise ValueError(
+                "allowed_token_ids must be a non-empty list of token ids"
+            )
+        if any(
+            isinstance(t, bool) or not isinstance(t, int) for t in allowed
+        ):
+            raise ValueError("allowed_token_ids entries must be ints")
+    return lb, allowed
+
+
 @dataclasses.dataclass
 class _Waiter:
     """Blocking caller: one event, one completion."""
@@ -175,6 +218,8 @@ class _Submission:
     stop_token_ids: Optional[list]
     stop_strings: Optional[list]
     waiter: object
+    logit_bias: Optional[dict] = None
+    allowed_token_ids: Optional[list] = None
 
 
 @dataclasses.dataclass
@@ -230,10 +275,12 @@ class EngineRunner:
         self, tokens, max_new_tokens: int, timeout: Optional[float] = None,
         sampling: Optional[SampleConfig] = None,
         stop_token_ids=None, stop_strings=None,
+        logit_bias=None, allowed_token_ids=None,
     ) -> Completion:
         return self.complete_n(
             tokens, max_new_tokens, 1, timeout=timeout, sampling=sampling,
             stop_token_ids=stop_token_ids, stop_strings=stop_strings,
+            logit_bias=logit_bias, allowed_token_ids=allowed_token_ids,
         )[0]
 
     def complete_n(
@@ -241,6 +288,7 @@ class EngineRunner:
         timeout: Optional[float] = None,
         sampling: Optional[SampleConfig] = None,
         stop_token_ids=None, stop_strings=None,
+        logit_bias=None, allowed_token_ids=None,
     ):
         """N independent completions of one prompt (the API's ``n``).
 
@@ -270,6 +318,8 @@ class EngineRunner:
                     _Submission(
                         list(tokens), int(max_new_tokens), sampling,
                         stop_token_ids, stop_strings, w,
+                        logit_bias=logit_bias,
+                        allowed_token_ids=allowed_token_ids,
                     )
                 )
         self._wake.set()
@@ -329,7 +379,8 @@ class EngineRunner:
     def stream(self, tokens, max_new_tokens: int,
                timeout: Optional[float] = None,
                sampling: Optional[SampleConfig] = None,
-               stop_token_ids=None, stop_strings=None):
+               stop_token_ids=None, stop_strings=None,
+               logit_bias=None, allowed_token_ids=None):
         """Returns a generator of ("delta", (ids, logprobs)) items
         ending with ("done", Completion); tokens arrive as the engine
         emits them (per decode chunk). The submission (and the
@@ -351,6 +402,8 @@ class EngineRunner:
                 _Submission(
                     list(tokens), int(max_new_tokens), sampling,
                     stop_token_ids, stop_strings, w,
+                    logit_bias=logit_bias,
+                    allowed_token_ids=allowed_token_ids,
                 )
             )
         self._wake.set()
@@ -514,6 +567,8 @@ class EngineRunner:
                     sampling=sub.sampling,
                     stop_token_ids=sub.stop_token_ids,
                     stop_strings=sub.stop_strings,
+                    logit_bias=sub.logit_bias,
+                    allowed_token_ids=sub.allowed_token_ids,
                 )
             except Exception as e:  # validation error -> the caller
                 with self._lock:
@@ -650,14 +705,18 @@ class _Handler(BaseHTTPRequestHandler):
             if hasattr(self.tokenizer, "chat_template")
             else getattr(self.tokenizer, "_tok", self.tokenizer)
         )
+        # transformers < 4.43 could still render via the legacy
+        # class-level default_chat_template when chat_template was
+        # None — honour it rather than silently switching those
+        # installs to the generic rendering. The legacy attribute
+        # lives on the RAW tokenizer, so consult the adapter's _tok
+        # (the adapter itself only exposes chat_template).
+        legacy_holder = getattr(self.tokenizer, "_tok", probe)
         templateless = (
             hasattr(probe, "chat_template")
             and probe.chat_template is None
-            # transformers < 4.43 could still render via the legacy
-            # class-level default_chat_template when chat_template was
-            # None — honour it rather than silently switching those
-            # installs to the generic rendering.
-            and getattr(probe, "default_chat_template", None) is None
+            and getattr(legacy_holder, "default_chat_template", None)
+            is None
         )
         if apply is not None and not templateless:
             # Explicit add_generation_prompt: raw HF tokenizers
@@ -730,6 +789,7 @@ class _Handler(BaseHTTPRequestHandler):
             if isinstance(stop_strings, str):
                 stop_strings = [stop_strings]
             stop_token_ids = req.get("stop_token_ids")
+            logit_bias, allowed_ids = _parse_bias(req)
             want_logprobs = bool(req.get("logprobs"))
             n = int(req.get("n", 1))
             best_of = req.get("best_of")
@@ -745,6 +805,7 @@ class _Handler(BaseHTTPRequestHandler):
                 self._stream_response(
                     tokens, max_new, sampling, stop_token_ids,
                     stop_strings, want_logprobs, chat=chat,
+                    logit_bias=logit_bias, allowed_token_ids=allowed_ids,
                 )
                 return
             if best_of is not None:
@@ -779,12 +840,15 @@ class _Handler(BaseHTTPRequestHandler):
                     or stop_strings
                     or stop_token_ids
                     or want_logprobs
+                    or logit_bias is not None
+                    or allowed_ids is not None
                 ):
                     # Beam is deterministic max-logprob search; these
                     # fields would be silently dropped — refuse instead.
                     raise ValueError(
                         "best_of composes with none of temperature/"
-                        "top_k/top_p/stop/stop_token_ids/logprobs"
+                        "top_k/top_p/stop/stop_token_ids/logprobs/"
+                        "logit_bias/allowed_token_ids"
                     )
                 out = self.runner.beam(
                     tokens, max_new, best_of,
@@ -813,7 +877,8 @@ class _Handler(BaseHTTPRequestHandler):
                 dones = self.runner.complete_n(
                     tokens, max_new, n, timeout=self.request_timeout_s,
                     sampling=sampling, stop_token_ids=stop_token_ids,
-                    stop_strings=stop_strings,
+                    stop_strings=stop_strings, logit_bias=logit_bias,
+                    allowed_token_ids=allowed_ids,
                 )
                 choices = [
                     _build_choice(
@@ -828,7 +893,8 @@ class _Handler(BaseHTTPRequestHandler):
             done = self.runner.complete(
                 tokens, max_new, timeout=self.request_timeout_s,
                 sampling=sampling, stop_token_ids=stop_token_ids,
-                stop_strings=stop_strings,
+                stop_strings=stop_strings, logit_bias=logit_bias,
+                allowed_token_ids=allowed_ids,
             )
         except (ValueError, TypeError) as e:
             self._send(400, {"error": str(e)})
@@ -847,7 +913,7 @@ class _Handler(BaseHTTPRequestHandler):
     def _stream_response(
         self, tokens, max_new: int, sampling=None,
         stop_token_ids=None, stop_strings=None, want_logprobs=False,
-        chat: bool = False,
+        chat: bool = False, logit_bias=None, allowed_token_ids=None,
     ) -> None:
         """Server-sent events: one ``data:`` line per token delta, a
         final one with finished_by (and the definitive token count —
@@ -860,7 +926,8 @@ class _Handler(BaseHTTPRequestHandler):
         gen = self.runner.stream(
             tokens, max_new, timeout=self.request_timeout_s,
             sampling=sampling, stop_token_ids=stop_token_ids,
-            stop_strings=stop_strings,
+            stop_strings=stop_strings, logit_bias=logit_bias,
+            allowed_token_ids=allowed_token_ids,
         )
         self.send_response(200)
         self.send_header("Content-Type", "text/event-stream")

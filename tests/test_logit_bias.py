@@ -1,0 +1,285 @@
+"""Constrained decoding: logit_bias / allowed_token_ids.
+
+Pinned properties:
+  * ``bias_row`` builds the OpenAI-semantics additive row (<= -100 is a
+    hard ban; allowed_token_ids hard-bans the complement; a positive
+    bias cannot resurrect a disallowed token) and validates ids/values;
+  * engine-level, greedy: banning the unconstrained argmax re-routes
+    every step to the runner-up; allowed_token_ids confines the whole
+    generation to the allowed set (eos included, so budget finishes);
+  * a +bias large enough shifts greedy argmax to the biased token;
+  * dense == paged == decode_chunk>1 under bias (the buffer rides every
+    decode program identically);
+  * per-request isolation: an unconstrained row next to a constrained
+    one matches the bias-free engine exactly;
+  * paged preemption-recompute replays the SAME constrained tokens
+    (the re-admission rebuilds the slot's bias row from the request);
+  * validation: submit without enable_logit_bias refuses; bad ids and
+    non-finite values refuse; the speculative engine refuses the flag;
+  * server: logit_bias (string-keyed, the JSON wire shape) and
+    allowed_token_ids reach the engine; malformed fields 400.
+"""
+
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+
+from shifu_tpu.infer import SampleConfig
+from shifu_tpu.infer.engine import Engine, PagedEngine
+from shifu_tpu.infer.sampling import NEG_INF, bias_row
+from shifu_tpu.models import Transformer, TransformerConfig
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    model = Transformer(TransformerConfig.tiny())
+    return model, model.init(jax.random.key(0))
+
+
+# ------------------------------------------------------------ primitives
+
+
+def test_bias_row_semantics():
+    row = bias_row(8, {1: 2.5, 3: -100.0, "4": -5.0})
+    assert row[0] == 0.0
+    assert row[1] == pytest.approx(2.5)
+    assert row[3] == NEG_INF  # the OpenAI ban convention
+    assert row[4] == pytest.approx(-5.0)
+
+    row = bias_row(8, None, [2, 5])
+    assert row[2] == 0.0 and row[5] == 0.0
+    assert all(row[i] == NEG_INF for i in (0, 1, 3, 4, 6, 7))
+
+    # A positive bias cannot resurrect a token outside the allowed set.
+    row = bias_row(8, {0: 99.0}, [2])
+    assert row[0] < -1e37
+
+
+def test_bias_row_validation():
+    with pytest.raises(ValueError, match="outside"):
+        bias_row(8, {8: 1.0})
+    with pytest.raises(ValueError, match="outside"):
+        bias_row(8, None, [7, 9])
+    with pytest.raises(ValueError, match="not finite"):
+        bias_row(8, {1: float("nan")})
+    with pytest.raises(ValueError, match="non-empty"):
+        bias_row(8, None, [])
+
+
+# --------------------------------------------------------------- engines
+
+
+def _run(eng, prompts, max_new, **skw):
+    rids = [eng.submit(p, max_new_tokens=max_new, **skw) for p in prompts]
+    out = {c.rid: c for c in eng.run()}
+    return [out[r].tokens for r in rids]
+
+
+def _prompts(seed, sizes):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(1, 256, size=n).tolist() for n in sizes]
+
+
+def test_banned_token_never_sampled(tiny):
+    """Greedy: ban the free-run generation's tokens one round at a
+    time — each banned id disappears from the constrained output."""
+    model, params = tiny
+    kw = dict(max_slots=1, max_len=48, prefill_buckets=(16, 48),
+              sample_cfg=SampleConfig(temperature=0.0))
+    prompts = _prompts(0, (7,))
+    free = _run(Engine(model, params, **kw), prompts, 10)[0]
+    banned = {int(free[0]): -100.0, int(free[1]): -101.5}
+    eng = Engine(model, params, enable_logit_bias=True, **kw)
+    got = _run(eng, prompts, 10, logit_bias=banned)[0]
+    assert not set(banned) & set(got)
+
+
+def test_allowed_token_ids_confine_generation(tiny):
+    model, params = tiny
+    allowed = [5, 9, 17, 33]
+    eng = Engine(
+        model, params, max_slots=2, max_len=48, prefill_buckets=(16, 48),
+        sample_cfg=SampleConfig(temperature=0.0), enable_logit_bias=True,
+    )
+    outs = _run(
+        eng, _prompts(1, (5, 9)), 12, allowed_token_ids=allowed
+    )
+    for toks in outs:
+        assert set(toks) <= set(allowed), toks
+
+
+def test_bias_shifts_greedy_argmax(tiny):
+    """A +1e4 bias beats any finite logit: greedy emits only that id."""
+    model, params = tiny
+    eng = Engine(
+        model, params, max_slots=1, max_len=32, prefill_buckets=(16, 32),
+        sample_cfg=SampleConfig(temperature=0.0), enable_logit_bias=True,
+    )
+    got = _run(eng, _prompts(2, (6,)), 5, logit_bias={42: 1e4})[0]
+    assert got == [42] * 5
+
+
+def test_bias_dense_paged_chunk_parity(tiny):
+    model, params = tiny
+    kw = dict(max_slots=2, max_len=48, prefill_buckets=(16, 48),
+              sample_cfg=SampleConfig(temperature=0.0),
+              enable_logit_bias=True)
+    prompts = _prompts(3, (6, 11))
+    bias = {7: 3.0, 11: -100.0, 200: 2.0}
+    ref = _run(Engine(model, params, **kw), prompts, 10, logit_bias=bias)
+    paged = _run(
+        PagedEngine(model, params, page_size=8, **kw), prompts, 10,
+        logit_bias=bias,
+    )
+    chunked = _run(
+        PagedEngine(model, params, page_size=8, decode_chunk=4, **kw),
+        prompts, 10, logit_bias=bias,
+    )
+    assert ref == paged == chunked
+
+
+def test_per_request_bias_isolated(tiny):
+    """One constrained row, one free row: the free row matches the
+    bias-free engine exactly (slot rows are per-request, and a freed
+    slot's stale row is rewritten at re-admission)."""
+    model, params = tiny
+    prompts = _prompts(4, (7, 7))
+    kw = dict(max_slots=2, max_len=48, prefill_buckets=(16, 48),
+              sample_cfg=SampleConfig(temperature=0.0))
+    plain = _run(PagedEngine(model, params, page_size=8, **kw), prompts, 10)
+    eng = PagedEngine(
+        model, params, page_size=8, enable_logit_bias=True, **kw
+    )
+    r0 = eng.submit(
+        prompts[0], max_new_tokens=10, allowed_token_ids=[3, 4, 5]
+    )
+    r1 = eng.submit(prompts[1], max_new_tokens=10)
+    out = {c.rid: c.tokens for c in eng.run()}
+    assert set(out[r0]) <= {3, 4, 5}
+    assert out[r1] == plain[1]
+
+
+def test_paged_preemption_recompute_with_bias(tiny):
+    """Pool pressure forces preemption: the recompute re-admission must
+    rebuild the slot's bias row, or the replayed prefix would sample
+    unconstrained and diverge from the roomy-pool engine."""
+    model, params = tiny
+    prompts = _prompts(5, (5, 5))
+    kw = dict(max_slots=2, max_len=16, prefill_buckets=(8, 16),
+              sample_cfg=SampleConfig(temperature=0.0),
+              enable_logit_bias=True)
+    bias = {13: 4.0, 77: -100.0}
+    roomy = _run(
+        PagedEngine(model, params, page_size=4, **kw), prompts, 8,
+        logit_bias=bias,
+    )
+    tight = PagedEngine(model, params, page_size=4, n_pages=6, **kw)
+    got = _run(tight, prompts, 8, logit_bias=bias)
+    assert tight.preemptions >= 1
+    assert got == roomy
+
+
+def test_bias_validation(tiny):
+    model, params = tiny
+    eng = PagedEngine(
+        model, params, page_size=8, max_slots=1, max_len=32,
+        prefill_buckets=(16, 32),
+    )
+    with pytest.raises(ValueError, match="enable_logit_bias"):
+        eng.submit([1, 2, 3], max_new_tokens=2, logit_bias={1: -100})
+    eng2 = Engine(
+        model, params, max_slots=1, max_len=32, prefill_buckets=(16, 32),
+        enable_logit_bias=True,
+    )
+    with pytest.raises(ValueError, match="outside"):
+        eng2.submit(
+            [1, 2, 3], max_new_tokens=2,
+            logit_bias={model.cfg.vocab_size: 1.0},
+        )
+
+
+def test_spec_engine_rejects_logit_bias(tiny):
+    from shifu_tpu.infer import SpeculativePagedEngine
+
+    model, params = tiny
+    with pytest.raises(NotImplementedError, match="logit_bias"):
+        SpeculativePagedEngine(
+            model, params, model, params,
+            max_slots=1, max_len=32, prefill_buckets=(16, 32),
+            enable_logit_bias=True,
+        )
+
+
+# ---------------------------------------------------------------- server
+
+
+def _post(base, path, body):
+    req = urllib.request.Request(
+        base + path, json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=60) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_server_logit_bias_end_to_end(tiny):
+    from shifu_tpu.infer.server import make_server
+
+    model, params = tiny
+    eng = PagedEngine(
+        model, params, page_size=8, max_slots=2, max_len=64,
+        prefill_buckets=(32, 64), sample_cfg=SampleConfig(temperature=0.0),
+        enable_logit_bias=True,
+    )
+    server = make_server(eng, host="127.0.0.1", port=0)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    base = f"http://127.0.0.1:{server.server_port}"
+    try:
+        # The wire shape: string token-id keys (JSON objects).
+        status, out = _post(base, "/v1/completions", {
+            "tokens": [1, 2, 3, 4], "max_new_tokens": 5,
+            "logit_bias": {"42": 1e4},
+        })
+        assert status == 200
+        assert out["tokens"] == [42] * 5
+
+        status, out = _post(base, "/v1/completions", {
+            "tokens": [1, 2, 3, 4], "max_new_tokens": 4,
+            "allowed_token_ids": [3, 9],
+        })
+        assert status == 200
+        assert set(out["tokens"]) <= {3, 9}
+
+        # Malformed fields 400 (validated before touching the engine).
+        for bad in (
+            {"logit_bias": {"not-an-id": 1.0}},
+            {"logit_bias": {"1": "x"}},
+            {"logit_bias": []},
+            {"allowed_token_ids": "nope"},
+            {"allowed_token_ids": [1.5]},
+            {"logit_bias": {str(model.cfg.vocab_size): 1.0}},
+        ):
+            status, out = _post(base, "/v1/completions", {
+                "tokens": [1, 2, 3], "max_new_tokens": 2, **bad,
+            })
+            assert status == 400, (bad, out)
+
+        # best_of refuses constraints rather than dropping them.
+        status, out = _post(base, "/v1/completions", {
+            "tokens": [1, 2, 3], "max_new_tokens": 2, "best_of": 2,
+            "logit_bias": {"1": 1.0},
+        })
+        assert status == 400
+    finally:
+        server.shutdown()
+        server.runner.shutdown()
+        t.join(5)

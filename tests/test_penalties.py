@@ -268,3 +268,33 @@ def test_sample_config_rejects_none_penalties():
         SampleConfig(presence_penalty=None)
     with pytest.raises(ValueError, match="must be a number"):
         SampleConfig(frequency_penalty=None)
+
+
+def test_counts_buffer_is_device_resident(tiny):
+    """The (slots, vocab) counts buffer must not re-upload host->device
+    per decode dispatch: _penalty_args returns the engine's PERSISTENT
+    device array (updated and returned by the decode programs), and it
+    advances across dispatches without any host rebuild."""
+    model, params = tiny
+    eng = PagedEngine(
+        model, params, page_size=8, max_slots=2, max_len=48,
+        prefill_buckets=(16, 48), sample_cfg=SampleConfig(
+            temperature=0.0, presence_penalty=0.5,
+        ),
+    )
+    assert not hasattr(eng, "_counts")  # the host mirror is gone
+    r = eng.submit(_prompts(7, (5,))[0], max_new_tokens=6)
+    # Admission writes the slot row on device.
+    eng.step()
+    buf0 = eng._penalty_args()[0]
+    assert isinstance(buf0, jax.Array)
+    assert buf0 is eng._counts_dev  # no fresh upload per dispatch
+    eng.step()
+    buf1 = eng._penalty_args()[0]
+    assert buf1 is eng._counts_dev
+    assert buf1 is not buf0  # the program RETURNED an updated buffer
+    # The device counts match the request's generated tokens exactly.
+    done = {c.rid: c for c in eng.run()}[r]
+    row = np.zeros((model.cfg.vocab_size,), np.int32)
+    np.add.at(row, np.asarray(done.tokens, np.int64), 1)
+    np.testing.assert_array_equal(np.asarray(eng._counts_dev[0]), row)
