@@ -310,6 +310,150 @@ def cmd_dpo(args) -> int:
     return 0
 
 
+def cmd_grpo(args) -> int:
+    """Online RL (GRPO) with a verifiable reward: sample a group per
+    prompt through the serving engine, score completions by whether
+    their decoded text contains the example's "target" string, take a
+    group-normalised policy-gradient step. The restored checkpoint is
+    both the starting policy and (when --beta > 0) the frozen KL
+    reference."""
+    import contextlib
+    import itertools
+
+    import jax
+    import jax.numpy as jnp
+
+    from shifu_tpu.infer import Engine, SampleConfig
+    from shifu_tpu.train import (
+        GRPOConfig,
+        GRPOModel,
+        TrainState,
+        grpo_rollout,
+        make_train_step,
+        reference_token_logprobs,
+    )
+
+    model = _build_model(args)
+    tok = _build_tokenizer(args)
+    rows = []
+    with open(args.data, encoding="utf-8") as f:
+        for line in f:
+            if not line.strip():
+                continue
+            obj = json.loads(line)
+            p = obj["prompt"]
+            ids = tok.encode(p) if isinstance(p, str) else [int(t) for t in p]
+            ids = [min(t, model.cfg.vocab_size - 1) for t in ids]
+            rows.append((ids, str(obj["target"])))
+    if not rows:
+        print("no examples in --data", file=sys.stderr)
+        return 2
+    if args.temperature <= 0.0:
+        print(
+            "--temperature must be > 0: greedy rollouts make every "
+            "group member identical, so every advantage is 0",
+            file=sys.stderr,
+        )
+        return 2
+
+    params = _restore_params(args, model)
+    ref_params = params  # frozen; enters the step as batch data only
+    cfg = GRPOConfig(
+        group_size=args.group_size, beta=args.beta,
+        clip_eps=args.clip_eps,
+    )
+    gm = GRPOModel(model, cfg)
+    optimizer = _build_optimizer(args, args.steps)
+    mesh = _build_mesh(args.mesh) if args.mesh else None
+
+    targets = {tuple(ids): t for ids, t in rows}
+
+    def reward(prompt_ids, gen_ids):
+        want = targets[tuple(prompt_ids)]
+        return float(want in tok.decode(gen_ids))
+
+    engine = Engine(
+        model, params,
+        max_slots=args.max_slots,
+        max_len=args.seq_len,
+        sample_cfg=SampleConfig(temperature=args.temperature),
+        prefill_buckets=tuple(
+            b for b in (64, 128, 256, 512, 1024, 2048) if b < args.seq_len
+        ) + (args.seq_len,),
+        rng=jax.random.key(args.seed),
+    )
+    prompt_cycle = itertools.cycle([ids for ids, _ in rows])
+
+    with contextlib.ExitStack() as ctx:
+        if mesh is not None:
+            ctx.enter_context(mesh)
+            from shifu_tpu.train import state_shardings
+
+            st_shard = state_shardings(gm, mesh, optimizer=optimizer)
+            state = jax.jit(
+                lambda p: TrainState.create(p, optimizer),
+                out_shardings=st_shard,
+            )(params)
+        else:
+            state = TrainState.create(
+                jax.tree_util.tree_map(lambda x: x.copy(), params),
+                optimizer,
+            )
+        step = make_train_step(gm, optimizer, mesh)
+        ref_fn = jax.jit(
+            lambda p, b: reference_token_logprobs(model, p, b)
+        )
+        rollout_dev = jax.devices()[0]
+        for i in range(args.steps):
+            # Keep the rollout params ON DEVICE: handing the engine
+            # host numpy would re-upload the whole tree on every
+            # prefill/decode dispatch of the round. Single-device
+            # training shares the train buffers directly (the step's
+            # donation only invalidates the PREVIOUS state, and this
+            # rebinds from the fresh state each round); a mesh state
+            # is gathered and placed once per round.
+            if mesh is None:
+                engine.params = state.params
+            else:
+                engine.params = jax.device_put(
+                    jax.device_get(state.params), rollout_dev
+                )
+            prompts = [
+                next(prompt_cycle) for _ in range(args.prompts_per_step)
+            ]
+            batch, stats = grpo_rollout(
+                engine, prompts, reward, cfg,
+                max_new_tokens=args.max_new_tokens,
+                seq_len=args.seq_len,
+            )
+            b = {k: jnp.asarray(v) for k, v in batch.items()}
+            if mesh is not None:
+                from shifu_tpu.parallel import shard_batch
+
+                b = shard_batch(b, mesh)
+            if cfg.beta > 0.0:
+                b = ref_fn(ref_params, b)
+            state, m = step(state, b)
+            if args.log_every and (i % args.log_every == 0):
+                print(json.dumps({
+                    "step": i,
+                    "loss": round(float(m["loss"]), 5),
+                    "reward_mean": round(stats["reward_mean"], 4),
+                    "kl": round(float(m["kl"]), 6),
+                }), flush=True)
+    if args.out_ckpt_dir:
+        from shifu_tpu.checkpoint import Checkpointer
+
+        ckpt = Checkpointer(args.out_ckpt_dir)
+        try:
+            ckpt.save(args.steps, state, force=True)
+            ckpt.wait()
+        finally:
+            ckpt.close()
+    print(json.dumps({"done": args.steps, "examples": len(rows)}))
+    return 0
+
+
 def _restore_params(args, model):
     """Latest checkpoint's params (params-only partial read — works for
     any training optimizer); fresh init when no --ckpt-dir is given."""
@@ -327,9 +471,6 @@ def _restore_params(args, model):
 
 
 def cmd_eval(args) -> int:
-    from shifu_tpu.data import PackedLoader, TokenDataset
-    from shifu_tpu.train.loop import evaluate
-
     model = _build_model(args)
     if not args.ckpt_dir:
         print(
@@ -338,13 +479,75 @@ def cmd_eval(args) -> int:
             file=sys.stderr,
         )
     params = _restore_params(args, model)
-    loader = PackedLoader(
-        TokenDataset(args.data),
-        batch_size=args.batch_size,
-        seq_len=args.seq_len,
-        shuffle=False,
+
+    if args.task == "ppl":
+        from shifu_tpu.data import PackedLoader, TokenDataset
+        from shifu_tpu.train.loop import evaluate
+
+        loader = PackedLoader(
+            TokenDataset(args.data),
+            batch_size=args.batch_size,
+            seq_len=args.seq_len,
+            shuffle=False,
+        )
+        out = evaluate(model, params, loader, max_batches=args.batches)
+        print(json.dumps(out))
+        return 0
+
+    tok = _build_tokenizer(args)
+    rows = []
+    with open(args.data) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                rows.append(json.loads(line))
+    if not rows:
+        print(f"no examples in {args.data}", file=sys.stderr)
+        return 2
+
+    if args.task == "mc":
+        # JSONL rows: {"context": str, "options": [str], "answer": int}
+        from shifu_tpu.eval import encode_mc_example, evaluate_multiple_choice
+
+        examples = [
+            encode_mc_example(
+                tok, r["context"], r["options"], int(r["answer"])
+            )
+            for r in rows
+        ]
+        out = evaluate_multiple_choice(
+            model, params, examples,
+            seq_len=args.seq_len, batch_rows=args.batch_size,
+        )
+        print(json.dumps(out))
+        return 0
+
+    # gen: JSONL rows {"prompt": str, "answers": [str]} (or "answer").
+    from shifu_tpu.eval import encode_gen_example, evaluate_generative
+    from shifu_tpu.infer import Engine, SampleConfig
+
+    examples = [
+        encode_gen_example(
+            tok, r["prompt"],
+            r["answers"] if "answers" in r else [r["answer"]],
+        )
+        for r in rows
+    ]
+    engine = Engine(
+        model, params,
+        max_slots=args.max_slots,
+        max_len=args.seq_len,
+        sample_cfg=SampleConfig(temperature=0.0),
+        eos_id=tok.eos_id,
+        prefill_buckets=tuple(
+            b for b in (64, 128, 256, 512, 1024, 2048) if b < args.seq_len
+        ) + (args.seq_len,),
     )
-    out = evaluate(model, params, loader, max_batches=args.batches)
+    out = evaluate_generative(
+        engine, tok, examples, max_new_tokens=args.max_new_tokens,
+    )
+    if not args.predictions:
+        del out["predictions"]
     print(json.dumps(out))
     return 0
 
@@ -388,20 +591,19 @@ def cmd_generate(args) -> int:
     return 0
 
 
-def cmd_serve(args) -> int:
-    from shifu_tpu.infer import Engine, PagedEngine, SampleConfig, make_server
+def build_serve_engine(args, model, params, tok):
+    """Flags -> constructed serving engine — the single seam between
+    the CLI surface and the engine classes (unit-tested directly; a
+    feature cmd_serve cannot construct is a feature the binary does
+    not ship). Raises ValueError on incoherent flag combinations."""
+    from shifu_tpu.infer import (
+        Engine,
+        PagedEngine,
+        PromptLookupPagedEngine,
+        SampleConfig,
+        SpeculativePagedEngine,
+    )
 
-    model = _build_model(args)
-    params = _restore_params(args, model)
-    tok = _build_tokenizer(args)
-    if tok.vocab_size > model.cfg.vocab_size:
-        print(
-            f"warning: tokenizer vocab {tok.vocab_size} exceeds model "
-            f"vocab {model.cfg.vocab_size}; out-of-range prompt ids "
-            "reach the embedding unclipped (XLA clamps them) — train "
-            "the model with a matching vocab",
-            file=sys.stderr,
-        )
     kw = dict(
         max_slots=args.max_slots,
         max_len=args.max_len,
@@ -417,15 +619,84 @@ def cmd_serve(args) -> int:
             else (tok.eos_id if args.eos_id is None else args.eos_id)
         ),
         decode_chunk=args.decode_chunk,
+        # Penalties and logit_bias are per-REQUEST features; without the
+        # per-slot traced sampler their strengths could not vary by
+        # request, so these flags imply it.
+        per_request_sampling=(
+            args.per_request_sampling or args.penalties or args.logit_bias
+        ),
+        enable_penalties=args.penalties,
+        enable_logit_bias=args.logit_bias,
     )
+    if args.spec != "off":
+        # Speculative engines are paged by construction; the spec
+        # guards refuse penalties/logit_bias, so surface that here
+        # instead of at the first request.
+        if args.penalties or args.logit_bias:
+            raise ValueError(
+                "--spec does not compose with --penalties/--logit-bias "
+                "(the verifier cannot honour them); serve those with a "
+                "plain engine"
+            )
+        kw.pop("enable_penalties"), kw.pop("enable_logit_bias")
+        kw.pop("decode_chunk")  # spec rounds replace the chunk scan
+        paged_kw = dict(
+            page_size=args.page_size, n_pages=args.n_pages,
+            enable_prefix_cache=args.prefix_cache,
+        )
+        if args.spec == "prompt-lookup":
+            return PromptLookupPagedEngine(
+                model, params, k=args.spec_k, ngram=args.spec_ngram,
+                rounds_per_step=args.spec_rounds, **paged_kw, **kw,
+            )
+        # draft-model speculation
+        if not args.draft_preset:
+            raise ValueError(
+                "--spec draft needs --draft-preset (and usually "
+                "--draft-ckpt-dir with trained weights — an untrained "
+                "draft accepts ~nothing)"
+            )
+        import argparse as _argparse
+
+        dargs = _argparse.Namespace(**vars(args))
+        dargs.preset = args.draft_preset
+        dargs.ckpt_dir = args.draft_ckpt_dir
+        dargs.moe_experts = 0
+        draft = _build_model(dargs)
+        draft_params = _restore_params(dargs, draft)
+        return SpeculativePagedEngine(
+            model, params, draft, draft_params,
+            k=args.spec_k, rounds_per_step=args.spec_rounds,
+            **paged_kw, **kw,
+        )
     if args.paged:
-        engine = PagedEngine(
+        return PagedEngine(
             model, params, page_size=args.page_size,
             n_pages=args.n_pages,
             enable_prefix_cache=args.prefix_cache, **kw,
         )
-    else:
-        engine = Engine(model, params, **kw)
+    return Engine(model, params, **kw)
+
+
+def cmd_serve(args) -> int:
+    from shifu_tpu.infer import make_server
+
+    model = _build_model(args)
+    params = _restore_params(args, model)
+    tok = _build_tokenizer(args)
+    if tok.vocab_size > model.cfg.vocab_size:
+        print(
+            f"warning: tokenizer vocab {tok.vocab_size} exceeds model "
+            f"vocab {model.cfg.vocab_size}; out-of-range prompt ids "
+            "reach the embedding unclipped (XLA clamps them) — train "
+            "the model with a matching vocab",
+            file=sys.stderr,
+        )
+    try:
+        engine = build_serve_engine(args, model, params, tok)
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return 2
     server = make_server(
         engine,
         host=args.host,
@@ -510,12 +781,30 @@ def main(argv=None) -> int:
     t.add_argument("--log-every", type=int, default=10)
     t.set_defaults(fn=cmd_train)
 
-    e = sub.add_parser("eval", help="perplexity over a dataset")
+    e = sub.add_parser(
+        "eval",
+        help="evaluate: perplexity (ppl), multiple-choice logprob "
+             "scoring (mc), or greedy exact-match generation (gen)",
+    )
     model_flags(e, schedule_default="constant")
-    e.add_argument("--data", required=True)
-    e.add_argument("--batch-size", type=int, default=8)
-    e.add_argument("--seq-len", type=int, default=513)
-    e.add_argument("--batches", type=int, default=32)
+    e.add_argument("--task", default="ppl", choices=["ppl", "mc", "gen"])
+    e.add_argument("--data", required=True,
+                   help="ppl: dataset dir (write_shards layout); "
+                        'mc: JSONL {"context","options","answer"}; '
+                        'gen: JSONL {"prompt","answers"}')
+    e.add_argument("--tokenizer", help="bpe-train artifact for mc/gen "
+                                       "(default: byte tokenizer)")
+    e.add_argument("--batch-size", type=int, default=8,
+                   help="ppl batch / mc scoring rows per forward")
+    e.add_argument("--seq-len", type=int, default=513,
+                   help="ppl/mc row length; gen: engine max_len")
+    e.add_argument("--batches", type=int, default=32, help="ppl only")
+    e.add_argument("--max-new-tokens", type=int, default=64,
+                   help="gen decode budget")
+    e.add_argument("--max-slots", type=int, default=8,
+                   help="gen engine concurrency")
+    e.add_argument("--predictions", action="store_true",
+                   help="gen: include decoded predictions in the JSON")
     e.set_defaults(fn=cmd_eval)
 
     d = sub.add_parser(
@@ -536,6 +825,38 @@ def main(argv=None) -> int:
     d.add_argument("--out-ckpt-dir", help="save the tuned state here")
     d.add_argument("--log-every", type=int, default=10)
     d.set_defaults(fn=cmd_dpo)
+
+    r = sub.add_parser(
+        "grpo",
+        help="online RL (GRPO) with a contains-target verifiable reward",
+    )
+    model_flags(r, schedule_default="constant")
+    r.add_argument("--data", required=True,
+                   help='JSONL: {"prompt": str|ids, "target": str} — '
+                        "reward 1 when the decoded completion contains "
+                        "the target substring")
+    r.add_argument("--tokenizer", help="bpe-train artifact (bpe.json); "
+                                       "default: byte tokenizer")
+    r.add_argument("--steps", type=int, default=50,
+                   help="rollout+update rounds")
+    r.add_argument("--group-size", type=int, default=8)
+    r.add_argument("--prompts-per-step", type=int, default=4)
+    r.add_argument("--max-new-tokens", type=int, default=32)
+    r.add_argument("--seq-len", type=int, default=256,
+                   help="packed row width / engine max_len")
+    r.add_argument("--max-slots", type=int, default=16,
+                   help="rollout engine concurrency")
+    r.add_argument("--temperature", type=float, default=1.0,
+                   help="rollout sampling temperature (must be > 0 — "
+                        "greedy groups have no variance)")
+    r.add_argument("--beta", type=float, default=0.0,
+                   help="KL-to-reference coefficient (0 skips the "
+                        "reference forward entirely)")
+    r.add_argument("--clip-eps", type=float, default=0.2)
+    r.add_argument("--mesh", help="e.g. fsdp=4 (axes of MeshPlan)")
+    r.add_argument("--out-ckpt-dir", help="save the tuned state here")
+    r.add_argument("--log-every", type=int, default=5)
+    r.set_defaults(fn=cmd_grpo)
 
     g = sub.add_parser("generate", help="text completion from a checkpoint")
     model_flags(g, schedule_default="constant")
@@ -584,6 +905,36 @@ def main(argv=None) -> int:
     s.add_argument("--prefix-cache", action="store_true",
                    help="share page-aligned prompt prefixes across "
                         "requests (paged only)")
+    s.add_argument("--per-request-sampling", action="store_true",
+                   help="honour per-request temperature/top_k/top_p/"
+                        "min_p fields (traced per-slot sampler; costs "
+                        "one vocab partial-sort per row per step)")
+    s.add_argument("--penalties", action="store_true",
+                   help="honour presence/frequency/repetition penalty "
+                        "fields (slots x vocab count buffer; implies "
+                        "--per-request-sampling)")
+    s.add_argument("--logit-bias", action="store_true",
+                   help="honour logit_bias / allowed_token_ids fields "
+                        "(slots x vocab f32 bias buffer; implies "
+                        "--per-request-sampling)")
+    s.add_argument("--spec", default="off",
+                   choices=["off", "prompt-lookup", "draft"],
+                   help="speculative decoding: prompt-lookup proposes "
+                        "each request's own n-gram continuations (no "
+                        "draft model — wins on repetitive/structured "
+                        "text); draft uses a trained draft model")
+    s.add_argument("--spec-k", type=int, default=8,
+                   help="proposed tokens per round")
+    s.add_argument("--spec-ngram", type=int, default=3,
+                   help="prompt-lookup match length")
+    s.add_argument("--spec-rounds", type=int, default=8,
+                   help="rounds per dispatch (the speculative analogue "
+                        "of --decode-chunk)")
+    s.add_argument("--draft-preset",
+                   choices=["tiny", "small", "1b", "7b"],
+                   help="draft model preset (--spec draft)")
+    s.add_argument("--draft-ckpt-dir",
+                   help="draft checkpoint (--spec draft)")
     s.set_defaults(fn=cmd_serve)
 
     i = sub.add_parser("info", help="environment / device info")

@@ -1,13 +1,21 @@
 from shifu_tpu.eval.tasks import (
+    GenExample,
     MCExample,
+    encode_gen_example,
     encode_mc_example,
+    evaluate_generative,
     evaluate_multiple_choice,
+    normalize_answer,
     score_options,
 )
 
 __all__ = [
+    "GenExample",
     "MCExample",
+    "encode_gen_example",
     "encode_mc_example",
+    "evaluate_generative",
     "evaluate_multiple_choice",
+    "normalize_answer",
     "score_options",
 ]

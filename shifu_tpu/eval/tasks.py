@@ -1,10 +1,17 @@
-"""Downstream-task evaluation: multiple-choice logprob scoring.
+"""Downstream-task evaluation: multiple-choice logprob scoring and
+generative exact-match.
 
-The standard harness pattern (HellaSwag/ARC/MMLU-style): each example
-is a context plus N candidate continuations; the model's answer is the
-continuation with the highest summed logprob (raw, and length-
-normalised — both are reported because they disagree systematically
-when option lengths differ).
+Multiple choice is the standard harness pattern (HellaSwag/ARC/
+MMLU-style): each example is a context plus N candidate continuations;
+the model's answer is the continuation with the highest summed logprob
+(raw, and length-normalised — both are reported because they disagree
+systematically when option lengths differ).
+
+Generative exact-match is the GSM8K-style pattern: greedy-decode each
+prompt through a serving engine (continuous batching — the whole set
+rides the slot pool concurrently), optionally extract the answer span
+from the decoded text, normalise, and compare against the gold
+answers.
 
 TPU-first mechanics: every (context, option) pair is one row of a
 padded (rows, seq_len) batch scored by ONE jitted forward per batch
@@ -207,4 +214,105 @@ def encode_mc_example(
         options=[tokenizer.encode(o) for o in options],
         answer=answer,
         option_char_lengths=[len(o) for o in options],
+    )
+
+
+# ------------------------------------------------- generative exact-match
+
+
+@dataclasses.dataclass(frozen=True)
+class GenExample:
+    """One generative example: a tokenized prompt and the acceptable
+    gold answer STRINGS (compared after extraction + normalisation)."""
+
+    prompt: Sequence[int]
+    answers: Sequence[str]
+
+    def __post_init__(self):
+        if not self.prompt:
+            raise ValueError("example with empty prompt")
+        if not self.answers:
+            raise ValueError("example with no gold answers")
+
+
+def normalize_answer(s: str) -> str:
+    """The exact-match comparison key: lowercase, surrounding
+    punctuation stripped, internal whitespace collapsed. Deliberately
+    minimal — task-specific extraction (e.g. "the final number after
+    '####'") belongs in ``evaluate_generative``'s ``extract`` hook, not
+    hidden in the normaliser."""
+    s = s.strip().lower()
+    s = " ".join(s.split())
+    return s.strip(" .,;:!?\"'()[]")
+
+
+def evaluate_generative(
+    engine,
+    tokenizer,
+    examples: Sequence[GenExample],
+    *,
+    max_new_tokens: int,
+    stop_strings=None,
+    extract=None,
+) -> dict:
+    """Greedy-decode exact-match over ``examples``.
+
+    ``engine``: a constructed Engine/PagedEngine — greedy
+    (temperature 0) for reproducible numbers; the whole example set is
+    submitted up front so continuous batching fills the slot pool.
+    ``stop_strings``: forwarded per request; matched text is trimmed
+    from the decoded completion (the serving path's convention).
+    ``extract``: optional ``str -> str`` applied to the decoded
+    completion before normalisation (e.g. pull the final number for
+    GSM8K-style tasks). A prediction scores 1 when its normalised
+    extraction equals ANY normalised gold answer.
+
+    Returns {"exact_match", "examples", "predictions"} — predictions
+    (decoded, untrimmed-of-whitespace) in example order, kept so
+    harness callers can log errors.
+    """
+    if stop_strings is not None and getattr(engine, "tokenizer", None) is None:
+        # The engine scans DECODED text for string stops; without its
+        # own tokenizer submit() would refuse — fail with the fix here.
+        raise ValueError(
+            "stop_strings need the engine constructed with "
+            "tokenizer=... (it scans decoded text during decode)"
+        )
+    rids = [
+        engine.submit(
+            list(map(int, ex.prompt)),
+            max_new_tokens=max_new_tokens,
+            stop_strings=stop_strings,
+        )
+        for ex in examples
+    ]
+    done = {c.rid: c for c in engine.run()}
+    hits = 0
+    predictions: List[str] = []
+    for ex, rid in zip(examples, rids):
+        text = tokenizer.decode(done[rid].tokens)
+        if stop_strings:
+            cuts = [text.find(s) for s in stop_strings if text.find(s) >= 0]
+            if cuts:
+                text = text[: min(cuts)]
+        predictions.append(text)
+        pred = normalize_answer(extract(text) if extract else text)
+        hits += int(
+            any(pred == normalize_answer(a) for a in ex.answers)
+        )
+    total = max(len(examples), 1)
+    return {
+        "exact_match": hits / total,
+        "examples": len(examples),
+        "predictions": predictions,
+    }
+
+
+def encode_gen_example(
+    tokenizer, prompt: str, answers: Sequence[str]
+) -> GenExample:
+    """Text -> GenExample (prompt encodes; answers stay text — the
+    comparison is on decoded output)."""
+    return GenExample(
+        prompt=tokenizer.encode(prompt), answers=list(answers)
     )

@@ -18,6 +18,15 @@ from shifu_tpu.train.dpo import (
     reference_logprobs,
     sequence_logprobs,
 )
+from shifu_tpu.train.grpo import (
+    GRPOConfig,
+    GRPOModel,
+    group_advantages,
+    grpo_loss,
+    grpo_rollout,
+    reference_token_logprobs,
+    token_logprobs,
+)
 from shifu_tpu.train.lora import LoraConfig, LoraModel, merge_lora
 from shifu_tpu.train.ema import WithEMA, ema_params
 from shifu_tpu.train.step import (
@@ -51,6 +60,13 @@ __all__ = [
     "dpo_loss",
     "reference_logprobs",
     "sequence_logprobs",
+    "GRPOConfig",
+    "GRPOModel",
+    "group_advantages",
+    "grpo_loss",
+    "grpo_rollout",
+    "reference_token_logprobs",
+    "token_logprobs",
     "TrainState",
     "create_sharded_state",
     "make_train_step",

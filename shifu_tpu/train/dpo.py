@@ -178,7 +178,18 @@ def dpo_loss(model, cfg: DPOConfig, params, batch):
 class DPOModel:
     """Adapter: the wrapped model's ``loss`` becomes the DPO objective.
 
-    Plugs into the existing train stack on any mesh::
+    SCOPE: composes with the train stack on DATA-AXIS meshes (dp /
+    fsdp / tp / sp — anything that shards the batch or the weights of
+    an intact forward). It does NOT compose with the pipeline wrappers
+    (``PipelinedModel`` / 1F1B): those restructure the forward itself
+    into per-stage programs with their own loss/grad schedule, while
+    this adapter wraps a whole-model forward — ``DPOModel(
+    PipelinedModel(...))`` is untested and structurally unsupported.
+    Preference-tune pp-scale models by running DPO on a data-axis mesh
+    of the unpipelined model (the memory win of pp matters for
+    pretraining step time, not the short DPO phase).
+
+    Plugs into the existing train stack::
 
         dm = DPOModel(model, DPOConfig(beta=0.1))
         state = create_sharded_state(dm, opt, rng, mesh)

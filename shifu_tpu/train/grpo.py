@@ -1,0 +1,281 @@
+"""GRPO — online RL post-training on the shifu_tpu train + serve stack.
+
+Group Relative Policy Optimization [Shao et al., 2024 (DeepSeekMath);
+the PPO clipped surrogate is Schulman et al., 2017]: sample a GROUP of
+G completions per prompt from the current policy, score each with a
+(programmatic) reward, normalise rewards WITHIN the group to get
+per-completion advantages — no value network — and take a token-level
+clipped policy-gradient step with a KL penalty to a frozen reference.
+
+TPU-first mechanics (the same three moves as DPO, train/dpo.py):
+
+  * ROLLOUTS ride the existing serving engines: :func:`grpo_rollout`
+    submits prompt x G requests to an Engine/PagedEngine (continuous
+    batching fills the slot pool; the engine rng advances per
+    admission, so group members draw independently) and packs the
+    results into fixed (b, s) arrays — the train step sees ONE static
+    shape regardless of ragged completion lengths.
+  * The REFERENCE model's per-token logprobs enter as batch data
+    (:func:`reference_token_logprobs`, jitted once per shape), never as
+    captured params — the train step's HBM working set holds one model.
+  * :class:`GRPOModel` quacks like the wrapped model, so
+    ``create_sharded_state`` / ``make_train_step`` / the trainer loop
+    run unchanged on any data-axis mesh.
+
+On-policy ratios: with one gradient step per rollout batch (the default
+loop), ``old_logprobs`` defaults to ``stop_gradient(lp)`` — the ratio
+is exactly 1 at evaluation and its gradient is the plain policy
+gradient ``A * grad log pi``. For multi-epoch reuse of a rollout batch,
+pass the sampling-time logprobs (the engines' per-token ``logprobs``
+surface) as ``old_logprobs`` and the clipped surrogate does its usual
+trust-region work.
+
+KL penalty: the k3 estimator ``exp(ref - lp) - (ref - lp) - 1``
+(non-negative, unbiased in expectation under pi), token-level,
+weighted by ``beta`` — the GRPO convention, applied inside the
+surrogate rather than folded into the reward.
+
+Batch contract (``grpo_rollout`` builds exactly this):
+
+    {"tokens": (b, s) int32   — prompt + completion, right-padded,
+     "mask":   (b, s) f32     — 1 where position t is a COMPLETION
+                                token being predicted (SFT convention),
+     "advantages": (b,) f32   — group-normalised rewards,
+     "ref_logprobs": (b, s-1) f32  — reference per-token logprobs
+                                (required when beta > 0),
+     "old_logprobs": (b, s-1) f32  — optional sampling-time logprobs}
+
+Reference parity note: the upstream reference (klyan/shifu) is an empty
+repository (SURVEY.md); there is no reference RL loop to match. The
+objective follows the published GRPO formulation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class GRPOConfig:
+    """``group_size``: completions sampled per prompt (G).
+    ``beta``: KL-to-reference coefficient (0 disables the ref model
+    entirely). ``clip_eps``: PPO ratio clip half-width."""
+
+    group_size: int = 4
+    beta: float = 0.04
+    clip_eps: float = 0.2
+
+    def __post_init__(self):
+        if self.group_size < 2:
+            raise ValueError(
+                "group_size must be >= 2 — a single-completion group "
+                f"has no relative baseline, got {self.group_size}"
+            )
+        if self.beta < 0.0:
+            raise ValueError(f"beta must be >= 0, got {self.beta}")
+        if not 0.0 < self.clip_eps < 1.0:
+            raise ValueError(
+                f"clip_eps must be in (0, 1), got {self.clip_eps}"
+            )
+
+
+def token_logprobs(model, params, tokens):
+    """Per-token log p(tokens[:, 1:]) — (b, s-1) f32. The per-token
+    counterpart of ``dpo.sequence_logprobs`` (same shift: position t
+    of the output scores PREDICTING token t+1)."""
+    logits = model(params, tokens[:, :-1])
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    return jnp.take_along_axis(
+        logp, tokens[:, 1:][..., None], axis=-1
+    )[..., 0]
+
+
+def reference_token_logprobs(model, ref_params, batch):
+    """Augment ``batch`` with the frozen reference's (b, s-1) per-token
+    logprobs. Run OUTSIDE the train step (jit once per shape) — the
+    step then never touches ``ref_params`` (module docstring)."""
+    out = dict(batch)
+    out["ref_logprobs"] = jax.lax.stop_gradient(
+        token_logprobs(model, ref_params, batch["tokens"])
+    )
+    return out
+
+
+def group_advantages(
+    rewards, group_size: int, eps: float = 1e-4
+) -> np.ndarray:
+    """(n,) rewards, rows grouped consecutively per prompt ->
+    group-normalised advantages ``(r - mean_g) / (std_g + eps)``.
+
+    A zero-variance group (all members scored identically) contributes
+    zero advantage — no signal, not a division blow-up.
+    """
+    r = np.asarray(rewards, np.float32)
+    if r.ndim != 1 or r.size % group_size:
+        raise ValueError(
+            f"rewards of length {r.size} do not tile groups of "
+            f"{group_size}"
+        )
+    g = r.reshape(-1, group_size)
+    mean = g.mean(axis=1, keepdims=True)
+    std = g.std(axis=1, keepdims=True)
+    return ((g - mean) / (std + eps)).reshape(-1)
+
+
+def grpo_loss(model, cfg: GRPOConfig, params, batch):
+    """(loss, aux) for one rollout batch — ``make_train_step``'s
+    ``model.loss`` contract. Token-level mean over completion tokens of
+    the clipped surrogate minus ``beta`` times the k3 KL estimator."""
+    tokens = batch["tokens"]
+    mask = batch["mask"][:, 1:].astype(jnp.float32)
+    adv = batch["advantages"].astype(jnp.float32)[:, None]
+
+    lp = token_logprobs(model, params, tokens)
+    old = batch.get("old_logprobs")
+    if old is None:
+        # Pure on-policy: ratio == 1 at evaluation; the surrogate's
+        # gradient reduces to A * grad log pi.
+        old = jax.lax.stop_gradient(lp)
+    else:
+        old = old.astype(jnp.float32)
+    ratio = jnp.exp(lp - old)
+    clipped = jnp.clip(ratio, 1.0 - cfg.clip_eps, 1.0 + cfg.clip_eps)
+    surrogate = jnp.minimum(ratio * adv, clipped * adv)
+
+    if cfg.beta > 0.0:
+        if "ref_logprobs" not in batch:
+            raise ValueError(
+                "beta > 0 needs batch['ref_logprobs'] — run "
+                "reference_token_logprobs(model, ref_params, batch) "
+                "first, or set GRPOConfig(beta=0.0)"
+            )
+        d = batch["ref_logprobs"].astype(jnp.float32) - lp
+        kl = jnp.exp(d) - d - 1.0  # k3: >= 0, unbiased under pi
+        surrogate = surrogate - cfg.beta * kl
+    else:
+        kl = jnp.zeros_like(lp)
+
+    denom = jnp.maximum(jnp.sum(mask), 1.0)
+    loss = -jnp.sum(surrogate * mask) / denom
+    aux = {
+        "kl": jnp.sum(kl * mask) / denom,
+        "ratio_mean": jnp.sum(ratio * mask) / denom,
+        "clip_frac": jnp.sum(
+            (jnp.abs(ratio - 1.0) > cfg.clip_eps) * mask
+        ) / denom,
+        # Token count: make_train_step's microbatch aux weighting.
+        "denominator": jnp.sum(mask),
+    }
+    return loss, aux
+
+
+class GRPOModel:
+    """Adapter: the wrapped model's ``loss`` becomes the GRPO objective
+    — plugs into ``create_sharded_state`` / ``make_train_step`` on any
+    data-axis mesh (dp/fsdp; the pipeline wrappers restructure the
+    forward itself and do not compose with loss adapters — the same
+    scoping as DPOModel)."""
+
+    def __init__(self, model, grpo_cfg: GRPOConfig = GRPOConfig()):
+        self.inner = model
+        self.cfg = model.cfg
+        self.grpo_cfg = grpo_cfg
+
+    def loss(self, params, batch):
+        return grpo_loss(self.inner, self.grpo_cfg, params, batch)
+
+    def specs(self):
+        return self.inner.specs()
+
+    def axes(self):
+        return self.inner.axes()
+
+    def init(self, rng):
+        return self.inner.init(rng)
+
+
+# ------------------------------------------------------------- rollouts
+
+
+def grpo_rollout(
+    engine,
+    prompts: Sequence[Sequence[int]],
+    reward_fn: Callable[[List[int], List[int]], float],
+    cfg: GRPOConfig,
+    *,
+    max_new_tokens: int,
+    seq_len: int,
+    pad_id: int = 0,
+) -> dict:
+    """Sample G completions per prompt through ``engine`` and build the
+    GRPO train batch.
+
+    ``engine``: a constructed Engine/PagedEngine holding the CURRENT
+    policy params with a STOCHASTIC ``sample_cfg`` (greedy rollouts
+    have zero group variance — every advantage is 0). Swap
+    ``engine.params`` to the latest trained params between rounds; the
+    compiled programs are shape-keyed, nothing retraces.
+    ``reward_fn(prompt_tokens, completion_tokens) -> float``: the
+    verifiable reward, host-side.
+    ``seq_len``: static packed width; prompt + completion truncate to
+    it (completions first — the reward has already seen the full text).
+
+    Returns ``(batch, stats)``: the train batch (module docstring
+    contract, ``old_logprobs`` filled from the engine's per-token
+    logprobs surface) and host-side rollout stats
+    (reward_mean/reward_std/completion_tokens).
+    """
+    G = cfg.group_size
+    rids = []
+    for p in prompts:
+        for _ in range(G):
+            rids.append(
+                engine.submit(
+                    list(map(int, p)), max_new_tokens=max_new_tokens
+                )
+            )
+    done = {c.rid: c for c in engine.run()}
+
+    n = len(prompts) * G
+    tokens = np.full((n, seq_len), pad_id, np.int32)
+    mask = np.zeros((n, seq_len), np.float32)
+    old_lp = np.zeros((n, seq_len - 1), np.float32)
+    rewards = np.zeros((n,), np.float32)
+    i = 0
+    for p in prompts:
+        p = list(map(int, p))
+        for _ in range(G):
+            c = done[rids[i]]
+            gen = list(c.tokens)
+            rewards[i] = float(reward_fn(p, gen))
+            row = (p + gen)[:seq_len]
+            ngen = len(row) - min(len(p), seq_len)
+            tokens[i, : len(row)] = row
+            if ngen > 0:
+                mask[i, len(row) - ngen : len(row)] = 1.0
+                # Engine logprobs are raw-model per-token values for
+                # the generated ids, aligned to the same shifted
+                # positions token_logprobs scores.
+                lps = (c.logprobs or [])[:ngen]
+                old_lp[i, len(row) - ngen - 1 : len(row) - 1] = lps
+            i += 1
+
+    adv = group_advantages(rewards, G)
+    batch = {
+        "tokens": tokens,
+        "mask": mask,
+        "advantages": adv.astype(np.float32),
+        "old_logprobs": old_lp,
+    }
+    stats = {
+        "reward_mean": float(rewards.mean()),
+        "reward_std": float(rewards.std()),
+        "completion_tokens": float(mask.sum()),
+    }
+    return batch, stats
