@@ -7,17 +7,64 @@ cd "$(dirname "$0")"
 if [ $# -gt 0 ]; then
   exec env PALLAS_AXON_POOL_IPS= python -m pytest "$@"
 fi
-# Full suite: TWO pytest processes, not one. A single process running all
-# ~500 tests segfaults in XLA:CPU's compiler near the end of the run
-# (reproducible on an idle host, crash inside backend_compile_and_load
-# while compiling a beam program; every subset re-run passes, so it is
-# per-process state accumulation in the compiler, not a test bug —
-# predates round 3's changes). Splitting bounds process lifetime; -x
-# semantics hold per shard and the second shard only runs if the first
-# is green. The split enumerates ls output (NOT letter-range globs, which
-# would silently skip files starting with digits/uppercase).
+# Full suite: MULTIPLE pytest processes, not one. A single process running
+# the whole suite (~500 tests) segfaults in XLA:CPU's compiler near the end
+# of the run — per-process state accumulation in the compiler, not a test
+# bug (see docs/xla_cpu_segfault.md for the characterisation + repro).
+# Splitting bounds process lifetime.
+#
+# The split is COUNT-ROBUST: one --collect-only pass counts tests per file,
+# then files pack greedily into shards of at most MAX_TESTS_PER_SHARD
+# collected tests — adding tests grows the shard count automatically
+# instead of silently fattening a hand-tuned second shard back over the
+# crash threshold. -x semantics hold per shard; later shards only run if
+# every earlier one is green (set -e).
 set -e
-FILES=( $(ls tests/test_*.py | sort) )
-H=$(( (${#FILES[@]} + 1) / 2 ))
-env PALLAS_AXON_POOL_IPS= python -m pytest "${FILES[@]:0:H}" -x -q
-env PALLAS_AXON_POOL_IPS= python -m pytest "${FILES[@]:H}" -x -q
+MAX_TESTS_PER_SHARD=${MAX_TESTS_PER_SHARD:-220}
+
+mapfile -t SHARDS < <(
+  env PALLAS_AXON_POOL_IPS= python - "$MAX_TESTS_PER_SHARD" <<'PYEOF'
+import subprocess
+import sys
+from collections import Counter
+
+cap = int(sys.argv[1])
+out = subprocess.run(
+    [sys.executable, "-m", "pytest", "--collect-only", "-q", "tests/"],
+    capture_output=True, text=True,
+)
+counts = Counter()
+for line in out.stdout.splitlines():
+    if "::" in line:
+        counts[line.split("::", 1)[0]] += 1
+if out.returncode != 0 or not counts:
+    # A collection ERROR (import failure in any test file) must fail
+    # the suite loudly — a broken file would otherwise silently drop
+    # out of every shard and CI would stay green without running it.
+    sys.exit(
+        f"test collection failed (rc={out.returncode}):\n"
+        f"{out.stdout[-4000:]}\n{out.stderr[-2000:]}"
+    )
+shard, n = [], 0
+for f in sorted(counts):
+    if shard and n + counts[f] > cap:
+        print(" ".join(shard))
+        shard, n = [], 0
+    shard.append(f)
+    n += counts[f]
+if shard:
+    print(" ".join(shard))
+PYEOF
+)
+
+if [ "${#SHARDS[@]}" -eq 0 ]; then
+  # mapfile swallows the process substitution's exit status (set -e
+  # does not see it) — an empty shard list IS the failure signal.
+  echo "test collection produced no shards; see errors above" >&2
+  exit 1
+fi
+echo "running ${#SHARDS[@]} shard(s) (<= $MAX_TESTS_PER_SHARD tests each)"
+for files in "${SHARDS[@]}"; do
+  # shellcheck disable=SC2086 — word-splitting the file list is intended
+  env PALLAS_AXON_POOL_IPS= python -m pytest $files -x -q
+done

@@ -10,7 +10,11 @@ from shifu_tpu.infer.sampling import SampleConfig, sample_logits
 from shifu_tpu.infer.generate import generate, make_generate_fn
 from shifu_tpu.infer.beam import make_beam_search_fn
 from shifu_tpu.infer.engine import Completion, Engine, PagedEngine
-from shifu_tpu.infer.spec_engine import SpeculativePagedEngine
+from shifu_tpu.infer.spec_engine import (
+    PromptLookupPagedEngine,
+    SpeculativePagedEngine,
+    prompt_lookup_propose,
+)
 from shifu_tpu.infer.server import EngineRunner, make_server
 from shifu_tpu.infer.speculative import (
     SpecResult,
@@ -39,7 +43,9 @@ __all__ = [
     "Engine",
     "EngineRunner",
     "PagedEngine",
+    "PromptLookupPagedEngine",
     "SpeculativePagedEngine",
+    "prompt_lookup_propose",
     "make_server",
     "QuantizedModel",
     "dequantize_params",

@@ -4,39 +4,50 @@ The standalone drivers (infer/speculative.py) prove the round machinery
 — draft proposes K tokens, the target verifies the whole chunk in one
 memory-bound forward, the rejection rule keeps the target's exact
 distribution. This module folds those rounds into the CONTINUOUS
-BATCHING engine, where they matter for the serving product:
+BATCHING engine, where they matter for the serving product. Two
+drafting sources share the verification machinery:
+
+:class:`SpeculativePagedEngine` — a trained DRAFT MODEL proposes
+(k sequential cheap forwards per round, dense per-slot draft cache
+beside the target's paged pool);
+
+:class:`PromptLookupPagedEngine` — NO draft model: each row proposes
+the continuation of the most recent earlier occurrence of its own
+trailing n-gram, searched ON DEVICE over a per-slot token-history
+buffer (prompt-lookup / n-gram drafting — near-zero propose cost, wins
+on repetitive or structured text: long-document QA, code, summaries
+that quote the source). Deterministic proposals are the q = one-hot
+case of the rejection rule — accept token t with probability p_t, on
+rejection resample from p with t zeroed — so the target's exact
+distribution is preserved with NO draft forward at all, and the whole
+round costs one (k+1)-wide target verify (the multi-query paged
+kernel) plus an O(history) integer scan that is noise next to it.
+
+Shared engine mechanics:
 
   * the TARGET keeps its paged KV pool untouched — verification uses
-    the pool's new batch-chunk shape (models/transformer.py
-    ``_paged_block_attention``: per-row multi-token scatter + gathered
-    slot-space attention), so paging/preemption/prefix caching all
+    the pool's batch-chunk shape (models/transformer.py
+    ``_paged_block_attention``), so paging/preemption/prefix caching
     compose;
-  * the DRAFT gets a per-slot DENSE cache beside the pool (draft
-    models are small — its worst case is max_slots x max_len of a
-    narrow kv), prefilled at admission (and re-prefilled after
-    preemption's recompute, by construction: admission always runs the
-    draft prefill);
-  * each engine ``step()`` runs ``rounds_per_step`` complete
-    propose/verify rounds ON DEVICE (one dispatch, one host sync) with
-    per-row ragged progress: every row advances by its own accepted
-    prefix + bonus, freezes at eos/budget, and rejected positions hold
-    stale K/V that slot-space causality masks until the next round's
-    chunk write covers them (the same watermark argument as the
-    standalone driver — writes land before any read can see the slot);
+  * each engine ``step()`` runs ``rounds_per_step`` complete rounds ON
+    DEVICE (one dispatch, one host sync) with per-row ragged progress:
+    every row advances by its own accepted prefix + bonus, freezes at
+    eos/budget, and rejected positions hold stale K/V that slot-space
+    causality masks until the next round's chunk write covers them;
   * sampling composes: with ``per_request_sampling`` the verifier
     accepts against each row's CONFIGURED distribution
-    (sampling.probs_per_row — the same filtering sample_logits_per_row
-    draws from); engine-level greedy degrades to exact token matching,
-    so greedy speculative output == the non-speculative engine token
-    for token (tested).
+    (sampling.probs_per_row); engine-level greedy degrades to exact
+    token matching, so greedy speculative output == the
+    non-speculative engine token for token (tested, both drafters).
 
 Acceptance statistics (``spec_proposed`` / ``spec_accepted``) feed the
 server's /healthz.
 
 Reference parity note: the upstream reference (klyan/shifu) is an empty
 repository (SURVEY.md); there is no reference engine to match. The
-rejection rule is the published Leviathan/Chen scheme, re-expressed for
-static shapes and ragged rows.
+rejection rule is the published Leviathan/Chen scheme; prompt-lookup
+drafting follows the published prompt-lookup/n-gram speculation idea,
+re-derived for static shapes and ragged rows.
 """
 
 from __future__ import annotations
@@ -53,8 +64,161 @@ from shifu_tpu.infer.sampling import SampleConfig, probs_per_row
 from shifu_tpu.infer.speculative import _probs
 
 
-class SpeculativePagedEngine(PagedEngine):
-    """PagedEngine whose decode dispatch is draft-assisted.
+def prompt_lookup_propose(buf, n, k: int, g: int):
+    """Per-row n-gram lookup proposals — the prompt-lookup drafter.
+
+    ``buf`` (b, L) int32: each row's token history (prompt + generated,
+    positions >= its length hold junk). ``n`` (b,) int32: the row's
+    current length (``buf[i, n[i]-1]`` is its last accepted token).
+    Returns (b, k) int32: the k tokens FOLLOWING the most recent
+    earlier occurrence of the row's trailing ``g``-gram; rows with no
+    occurrence fall back to repeating their last token (better than a
+    fixed junk id on repetition-heavy text, and exactness never
+    depends on proposal quality).
+
+    Static-shape mechanics: the window match is ``g`` shifted
+    elementwise compares over a fixed (b, L-g-k) grid (an integer scan,
+    ~L ops/row — noise next to a forward); the "most recent" pick is a
+    masked max over window starts; all gathers are clamped
+    take_along_axis. Window start j is valid iff j + g <= n - 1 — the
+    continuation begins inside the known history, which also excludes
+    the trailing g-gram matching itself.
+    """
+    b, L = buf.shape
+    jmax = L - g - k
+    # The trailing g-gram, gathered at n-g .. n-1 (clamped; short rows
+    # are handled by the validity mask below — with n <= g no window
+    # start is valid, so they take the fallback).
+    sidx = jnp.clip(n[:, None] - g + jnp.arange(g)[None, :], 0, L - 1)
+    suffix = jnp.take_along_axis(buf, sidx, axis=1)  # (b, g)
+    eq = jnp.ones((b, jmax), bool)
+    for i in range(g):  # static unroll: g shifted compares
+        eq &= buf[:, i : i + jmax] == suffix[:, i : i + 1]
+    j = jnp.arange(jmax)[None, :]
+    valid = eq & (j + g <= (n - 1)[:, None])
+    jstar = jnp.max(jnp.where(valid, j, -1), axis=1)  # most recent
+    found = jstar >= 0
+    cidx = jnp.clip(
+        jstar[:, None] + g + jnp.arange(k)[None, :], 0, L - 1
+    )
+    prop = jnp.take_along_axis(buf, cidx, axis=1)
+    last = jnp.take_along_axis(
+        buf, jnp.clip(n - 1, 0, L - 1)[:, None], axis=1
+    )
+    return jnp.where(found[:, None], prop, last).astype(jnp.int32)
+
+
+class _SpeculativeBase(PagedEngine):
+    """Shared skeleton: guards, acceptance stats, the per-round
+    emission bookkeeping (eos/budget/ragged advance), and the host-side
+    fold of round results — everything except HOW proposals are made
+    and scored (subclass ``_spec_impl`` + ``_dispatch_decode``)."""
+
+    def __init__(self, model, params, *, k: int = 4,
+                 rounds_per_step: int = 1, **kw):
+        if kw.get("decode_chunk", 1) != 1:
+            raise ValueError(
+                "speculative engines advance multiple tokens per round "
+                "already; use rounds_per_step, not decode_chunk"
+            )
+        if k < 1 or rounds_per_step < 1:
+            raise ValueError("k and rounds_per_step must be >= 1")
+        if kw.get("enable_penalties") or kw.get(
+            "sample_cfg", SampleConfig(temperature=0.0)
+        ).has_penalties:
+            raise NotImplementedError(
+                "repetition/presence/frequency penalties inside the "
+                "speculative verifier need per-position counts that "
+                "depend on the SAME round's accepted prefix; serve "
+                "penalised requests with PagedEngine"
+            )
+        if kw.get("enable_logit_bias"):
+            raise NotImplementedError(
+                "logit_bias inside the speculative verifier needs the "
+                "bias composed into BOTH the proposal distribution and "
+                "the verifier's per-position acceptance probabilities; "
+                "serve constrained requests with PagedEngine"
+            )
+        self.k = int(k)
+        self.rounds_per_step = int(rounds_per_step)
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+        super().__init__(model, params, **kw)
+
+    # ------------------------------------------------------------ shared
+    def _decode_reach(self) -> int:
+        return self.rounds_per_step * (self.k + 1)
+
+    @property
+    def acceptance_rate(self) -> float:
+        return (
+            self.spec_accepted / self.spec_proposed
+            if self.spec_proposed
+            else 0.0
+        )
+
+    def _probs2(self, samp, logits2d):
+        """(rows, V) -> each row's configured sampling distribution
+        (the EXACT one the non-speculative engine draws from)."""
+        if samp:
+            t, kk, pp, mp = samp
+            reps = logits2d.shape[0] // t.shape[0]
+            return probs_per_row(
+                logits2d,
+                jnp.repeat(t, reps),
+                jnp.repeat(kk, reps),
+                jnp.repeat(pp, reps),
+                jnp.repeat(mp, reps),
+            )
+        return _probs(logits2d, self.sample_cfg)
+
+    def _advance(self, out, m, live, rem, done, cur, n):
+        """Post-rejection per-row bookkeeping, identical for every
+        drafter: clip the emitted count at eos and budget, freeze
+        finished rows, advance cur/n/rem. Returns
+        (n_acc, done, cur, n, rem)."""
+        k, eos = self.k, self.eos_id
+        n_acc = m + 1
+        if eos is not None:
+            iseos = out == eos
+            first_eos = jnp.min(
+                jnp.where(iseos, jnp.arange(k + 1)[None, :], k + 1),
+                axis=1,
+            ).astype(jnp.int32)
+            n_acc = jnp.minimum(n_acc, first_eos + 1)
+            hit_eos = first_eos < n_acc
+        else:
+            hit_eos = jnp.zeros(out.shape[:1], bool)
+        n_acc = jnp.minimum(n_acc, rem)
+        n_acc = jnp.where(live, n_acc, 0)
+        done = done | (live & (hit_eos | (rem - n_acc <= 0)))
+        new_cur = jnp.take_along_axis(
+            out, jnp.maximum(n_acc - 1, 0)[:, None], axis=1
+        )[:, 0]
+        cur = jnp.where(n_acc > 0, new_cur, cur)
+        return n_acc, done, cur, n + n_acc, rem - n_acc
+
+    def _fold_rounds(self, outs, lps, n_accs, ms, lives, cur2, lengths2):
+        """Host-side: extend each active request by its per-round
+        accepted tokens and update acceptance stats."""
+        outs, lps = np.asarray(outs), np.asarray(lps)
+        n_accs, ms = np.asarray(n_accs), np.asarray(ms)
+        lives = np.asarray(lives)
+        cur2, lengths2 = np.asarray(cur2), np.asarray(lengths2)
+        for slot, req in self._active.items():
+            for r in range(self.rounds_per_step):
+                n = int(n_accs[r, slot])
+                req.generated.extend(int(t) for t in outs[r, slot, :n])
+                req.logprobs.extend(float(x) for x in lps[r, slot, :n])
+                if lives[r, slot]:
+                    self.spec_proposed += self.k
+                    self.spec_accepted += int(ms[r, slot])
+            self._lengths[slot] = int(lengths2[slot])
+            self._cur[slot] = int(cur2[slot])
+
+
+class SpeculativePagedEngine(_SpeculativeBase):
+    """PagedEngine whose decode dispatch is DRAFT-MODEL-assisted.
 
     Usage::
 
@@ -80,33 +244,15 @@ class SpeculativePagedEngine(PagedEngine):
         rounds_per_step: int = 1,
         **kw,
     ):
-        if kw.get("decode_chunk", 1) != 1:
-            raise ValueError(
-                "speculative engines advance multiple tokens per round "
-                "already; use rounds_per_step, not decode_chunk"
-            )
         if getattr(draft, "prefill_needs_mask", False):
             raise NotImplementedError(
                 "recurrent draft models cannot roll back rejected tokens"
             )
-        if k < 1 or rounds_per_step < 1:
-            raise ValueError("k and rounds_per_step must be >= 1")
-        if kw.get("enable_penalties") or kw.get(
-            "sample_cfg", SampleConfig(temperature=0.0)
-        ).has_penalties:
-            raise NotImplementedError(
-                "repetition/presence/frequency penalties inside the "
-                "speculative verifier need per-position counts that "
-                "depend on the SAME round's accepted prefix; serve "
-                "penalised requests with PagedEngine"
-            )
         self.draft = draft
         self.draft_params = draft_params
-        self.k = int(k)
-        self.rounds_per_step = int(rounds_per_step)
-        self.spec_proposed = 0
-        self.spec_accepted = 0
-        super().__init__(model, params, **kw)
+        super().__init__(
+            model, params, k=k, rounds_per_step=rounds_per_step, **kw
+        )
         # Dense per-slot draft cache, padded past max_len for BOTH
         # overshooting write paths: rounds write up to k slots past a
         # row's final token (the chunk is always k+1 wide), and the
@@ -197,9 +343,6 @@ class SpeculativePagedEngine(PagedEngine):
         )
 
     # -------------------------------------------------------------- decode
-    def _decode_reach(self) -> int:
-        return self.rounds_per_step * (self.k + 1)
-
     def _dispatch_decode(self, cur, lengths, active, sub) -> None:
         remaining = np.zeros((self.max_slots,), np.int32)
         for slot, req in self._active.items():
@@ -213,28 +356,7 @@ class SpeculativePagedEngine(PagedEngine):
             jnp.asarray(remaining), jnp.asarray(self._table),
             *self._sampling_args(), sub,
         )
-        outs, lps = np.asarray(outs), np.asarray(lps)
-        n_accs, ms = np.asarray(n_accs), np.asarray(ms)
-        lives = np.asarray(lives)
-        cur2, lengths2 = np.asarray(cur2), np.asarray(lengths2)
-        for slot, req in self._active.items():
-            for r in range(self.rounds_per_step):
-                n = int(n_accs[r, slot])
-                req.generated.extend(int(t) for t in outs[r, slot, :n])
-                req.logprobs.extend(float(x) for x in lps[r, slot, :n])
-                if lives[r, slot]:
-                    self.spec_proposed += self.k
-                    self.spec_accepted += int(ms[r, slot])
-            self._lengths[slot] = int(lengths2[slot])
-            self._cur[slot] = int(cur2[slot])
-
-    @property
-    def acceptance_rate(self) -> float:
-        return (
-            self.spec_accepted / self.spec_proposed
-            if self.spec_proposed
-            else 0.0
-        )
+        self._fold_rounds(outs, lps, n_accs, ms, lives, cur2, lengths2)
 
     def _spec_impl(
         self, params, cache, d_cache, d_params, cur, lengths, active,
@@ -253,22 +375,6 @@ class SpeculativePagedEngine(PagedEngine):
         """
         *samp, rng = rest
         k, rounds = self.k, self.rounds_per_step
-        eos = self.eos_id
-
-        def probs2(logits2d):
-            """(rows, V) -> each row's configured sampling distribution
-            (the EXACT one the non-speculative engine draws from)."""
-            if samp:
-                t, kk, pp, mp = samp
-                reps = logits2d.shape[0] // t.shape[0]
-                return probs_per_row(
-                    logits2d,
-                    jnp.repeat(t, reps),
-                    jnp.repeat(kk, reps),
-                    jnp.repeat(pp, reps),
-                    jnp.repeat(mp, reps),
-                )
-            return _probs(logits2d, self.sample_cfg)
 
         def round_body(carry, rsub):
             cache, d_cache, cur, n, rem, done = carry
@@ -281,7 +387,7 @@ class SpeculativePagedEngine(PagedEngine):
                 lg, d_cache = self.draft(
                     d_params, tok[:, None], cache=d_cache, cache_index=idx
                 )
-                p = probs2(lg[:, -1])
+                p = self._probs2(samp, lg[:, -1])
                 nxt = jax.random.categorical(
                     sub, jnp.log(jnp.maximum(p, 1e-38))
                 ).astype(jnp.int32)
@@ -300,7 +406,9 @@ class SpeculativePagedEngine(PagedEngine):
                 page_table=table,
             )
             b, width, V = lg.shape
-            probs = probs2(lg.reshape(b * width, V)).reshape(b, width, V)
+            probs = self._probs2(samp, lg.reshape(b * width, V)).reshape(
+                b, width, V
+            )
 
             # ---- rejection rule (Leviathan/Chen) --------------------
             d_toks_bt = d_toks.T  # (b, k)
@@ -356,28 +464,9 @@ class SpeculativePagedEngine(PagedEngine):
             )
 
             # ---- per-row emitted count: eos + budget ----------------
-            n_acc = m + 1
-            if eos is not None:
-                iseos = out == eos
-                first_eos = jnp.min(
-                    jnp.where(
-                        iseos, jnp.arange(k + 1)[None, :], k + 1
-                    ),
-                    axis=1,
-                ).astype(jnp.int32)
-                n_acc = jnp.minimum(n_acc, first_eos + 1)
-                hit_eos = first_eos < n_acc
-            else:
-                hit_eos = jnp.zeros((b,), bool)
-            n_acc = jnp.minimum(n_acc, rem)
-            n_acc = jnp.where(live, n_acc, 0)
-            done = done | (live & (hit_eos | (rem - n_acc <= 0)))
-            new_cur = jnp.take_along_axis(
-                out, jnp.maximum(n_acc - 1, 0)[:, None], axis=1
-            )[:, 0]
-            cur = jnp.where(n_acc > 0, new_cur, cur)
-            n = n + n_acc
-            rem = rem - n_acc
+            n_acc, done, cur, n, rem = self._advance(
+                out, m, live, rem, done, cur, n
+            )
             return (
                 (cache, d_cache, cur, n, rem, done),
                 (out, raw_lp, n_acc, m, live),
@@ -392,3 +481,183 @@ class SpeculativePagedEngine(PagedEngine):
             )
         )
         return outs, lps, n_accs, ms, lives, cur, n, cache, d_cache
+
+
+class PromptLookupPagedEngine(_SpeculativeBase):
+    """PagedEngine whose decode dispatch is PROMPT-LOOKUP-assisted —
+    speculation with no draft model.
+
+    Usage::
+
+        eng = PromptLookupPagedEngine(
+            model, params, k=8, ngram=3,
+            rounds_per_step=16, max_slots=16, max_len=2048, ...
+        )
+
+    Each round, every row proposes the k tokens that followed the most
+    recent earlier occurrence of its trailing ``ngram``-gram in its OWN
+    history (prompt + generated so far), then the target verifies the
+    (k+1)-chunk in one forward. Proposals are deterministic, so the
+    rejection rule specialises to q = one-hot: accept proposal t with
+    probability p_t (greedy rows: iff t is the argmax), resample from p
+    with t zeroed on rejection — the target's exact distribution, no
+    draft forward anywhere. A round costs ONE memory-bound verify
+    (roughly one decode step) + an integer scan, so ANY nonzero
+    acceptance is pure profit; ``rounds_per_step`` folds many rounds
+    into one dispatch because the token-history buffer advances on
+    device between rounds.
+
+    The history buffer is (max_slots, max_len + k + 1) int32 — 4 bytes
+    per cached token, ~0.1% of the KV pool — rebuilt from the host
+    mirrors at each dispatch (admission/preemption stay host-side
+    concerns) and scattered forward on device as rounds accept tokens.
+    """
+
+    def __init__(self, model, params, *, k: int = 8, ngram: int = 3,
+                 rounds_per_step: int = 1, **kw):
+        if ngram < 1:
+            raise ValueError(f"ngram must be >= 1, got {ngram}")
+        self.ngram = int(ngram)
+        super().__init__(
+            model, params, k=k, rounds_per_step=rounds_per_step, **kw
+        )
+        # History rows hold cache tokens + cur (lengths + 1) and each
+        # round writes k+1 emitted tokens after cur: worst-case index
+        # is max_len + 1 + k, hence the + k + 2 slack.
+        self._buf_len = self.max_len + self.k + 2
+        if self._buf_len - self.ngram - self.k < 1:
+            raise ValueError(
+                f"max_len {self.max_len} too small for ngram "
+                f"{self.ngram} + k {self.k}"
+            )
+        self._spec_jit = jax.jit(
+            self._in_act_ctx(self._spec_impl), donate_argnums=(1,)
+        )
+
+    def _dispatch_decode(self, cur, lengths, active, sub) -> None:
+        remaining = np.zeros((self.max_slots,), np.int32)
+        buf = np.zeros((self.max_slots, self._buf_len), np.int32)
+        for slot, req in self._active.items():
+            remaining[slot] = req.max_new_tokens - len(req.generated)
+            # The FULL history: cache-resident tokens plus cur (the
+            # engine's lengths count excludes the last sampled token,
+            # which is exactly the one the trailing n-gram must end on
+            # — row length is lengths[slot] + 1).
+            seq = (req.tokens + req.generated)[: self.max_len + 1]
+            buf[slot, : len(seq)] = seq
+        (
+            outs, lps, n_accs, ms, lives, cur2, lengths2, self.cache,
+        ) = self._spec_jit(
+            self.params, self.cache, cur, lengths, active,
+            jnp.asarray(remaining), jnp.asarray(self._table),
+            jnp.asarray(buf), *self._sampling_args(), sub,
+        )
+        self._fold_rounds(outs, lps, n_accs, ms, lives, cur2, lengths2)
+
+    def _spec_impl(
+        self, params, cache, cur, lengths, active, remaining, table,
+        buf, *rest,
+    ):
+        """``rounds_per_step`` lookup/verify rounds, one program.
+
+        Per round: propose via :func:`prompt_lookup_propose` on the
+        history buffer, verify the (k+1)-chunk with the target (the
+        multi-query paged path), accept with the q = one-hot rule,
+        scatter the emitted tokens into the buffer so the NEXT round's
+        lookup sees them. Returns the same per-round stack as the
+        draft-model engine, minus the draft cache."""
+        *samp, rng = rest
+        k, rounds, g = self.k, self.rounds_per_step, self.ngram
+
+        def round_body(carry, rsub):
+            cache, buf, cur, n, rem, done = carry
+            live = active & ~done & (rem > 0)
+            r_a, r_b = jax.random.split(rsub)
+
+            # ---- propose: n-gram lookup, no forward -----------------
+            # History length is n + 1: the buffer's row ends on cur
+            # (cache holds n tokens, cur is sampled-but-unwritten), and
+            # the trailing n-gram must END on cur for the continuation
+            # to predict the very next token.
+            d_toks = prompt_lookup_propose(buf, n + 1, k, g)  # (b, k)
+
+            # ---- target: verify the whole chunk in one forward ------
+            chunk = jnp.concatenate([cur[:, None], d_toks], axis=1)
+            lg, cache = self.model(
+                params, chunk, cache=cache, cache_index=n,
+                page_table=table,
+            )
+            b, width, V = lg.shape
+            probs = self._probs2(samp, lg.reshape(b * width, V)).reshape(
+                b, width, V
+            )
+
+            # ---- rejection rule, q = one-hot specialisation ---------
+            rowix = jnp.arange(b)[:, None]
+            colix = jnp.arange(k)[None, :]
+            p_t = probs[rowix, colix, d_toks]
+            u = jax.random.uniform(r_a, (b, k))
+            ok = u < p_t  # q_t == 1: accept with probability p_t
+            m = jnp.argmin(
+                jnp.concatenate([ok, jnp.zeros((b, 1), bool)], axis=1),
+                axis=1,
+            ).astype(jnp.int32)
+            p_at_m = jnp.take_along_axis(probs, m[:, None, None], axis=1)[
+                :, 0
+            ]
+            # Residual: p with the rejected proposal zeroed (q is a
+            # point mass there); at m == k (all accepted) there is no
+            # rejected token — the bonus samples p itself.
+            rej_tok = jnp.take_along_axis(
+                d_toks, jnp.minimum(m, k - 1)[:, None], axis=1
+            )[:, 0]
+            residual = jnp.where(
+                (m < k)[:, None]
+                & (jnp.arange(V)[None, :] == rej_tok[:, None]),
+                0.0,
+                p_at_m,
+            )
+            rsum = residual.sum(axis=-1, keepdims=True)
+            residual = jnp.where(rsum > 0, residual / rsum, p_at_m)
+            bonus = jax.random.categorical(
+                r_b, jnp.log(jnp.maximum(residual, 1e-38))
+            ).astype(jnp.int32)
+            out = jnp.concatenate(
+                [d_toks, jnp.zeros((b, 1), d_toks.dtype)], axis=1
+            )
+            out = jnp.where(
+                jnp.arange(k + 1)[None, :] == m[:, None],
+                bonus[:, None],
+                out,
+            )
+            raw_lp = _token_logprob(
+                lg.reshape(b * width, V), out.reshape(b * width)
+            ).reshape(b, width)
+
+            # ---- history buffer ingests the emitted chunk -----------
+            # The emitted tokens FOLLOW cur (history position n), so
+            # all k+1 land at n+1 .. n+k+1 (in-range by construction:
+            # n <= max_len, buffer is max_len + k + 2 wide); positions
+            # past the accepted count hold junk that the next round's
+            # validity mask never reads and later real writes
+            # overwrite.
+            widx = n[:, None] + 1 + jnp.arange(k + 1)[None, :]
+            buf = buf.at[rowix, widx].set(out)
+
+            n_acc, done, cur, n, rem = self._advance(
+                out, m, live, rem, done, cur, n
+            )
+            return (
+                (cache, buf, cur, n, rem, done),
+                (out, raw_lp, n_acc, m, live),
+            )
+
+        done0 = jnp.zeros((self.max_slots,), bool)
+        (cache, buf, cur, n, _, _), (outs, lps, n_accs, ms, lives) = (
+            jax.lax.scan(
+                round_body,
+                (cache, buf, cur, lengths, remaining, done0),
+                jax.random.split(rng, rounds),
+            )
+        )
+        return outs, lps, n_accs, ms, lives, cur, n, cache

@@ -26,6 +26,7 @@ from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name as _checkpoint_name
 
 from shifu_tpu.core import initializers
 from shifu_tpu.core.dtypes import Policy
@@ -71,7 +72,13 @@ class TransformerConfig:
     fused_ce: bool = False
     # "dots" keeps matmul outputs and recomputes only elementwise ops in
     # the backward pass (~2.5% faster than "full" at equal fit on v5e);
-    # "full" recomputes the whole block.
+    # "full" recomputes the whole block. "flash" saves ONLY the
+    # attention outputs (named "attn_out") — the backward skips
+    # re-running the attention forward (the block's quadratic) while
+    # still recomputing everything else, costing just (b, s, dim) x
+    # n_layers of residency: the policy for models whose "dots" set
+    # does not fit (the 1.2B bench case). "dots_flash" combines both
+    # (fastest backward, largest residency).
     remat_policy: str = "dots"
     # -- mixture of experts (0 experts = dense FFN in every block) ----------
     n_experts: int = 0
@@ -102,9 +109,10 @@ class TransformerConfig:
             raise ValueError(
                 f"moe_top_k={self.moe_top_k} exceeds n_experts={self.n_experts}"
             )
-        if self.remat_policy not in ("dots", "full"):
+        if self.remat_policy not in ("dots", "full", "flash", "dots_flash"):
             raise ValueError(
-                f"remat_policy={self.remat_policy!r} (want 'dots' or 'full')"
+                f"remat_policy={self.remat_policy!r} (want 'dots', "
+                "'full', 'flash', or 'dots_flash')"
             )
         if self.window_size is not None and self.window_size < 1:
             raise ValueError(f"window_size={self.window_size} must be >= 1")
@@ -292,6 +300,12 @@ class Transformer(Module):
                 q, k, v, causal=True, segment_ids=segment_ids,
                 impl=cfg.attn_impl, window=cfg.window_size,
             )
+            # Named for the selective remat policies ("flash" /
+            # "dots_flash"): saving this one (b, s, h, hd) tensor per
+            # layer spares the backward pass a full re-run of the
+            # attention forward — the block's only non-matmul
+            # FLOPs-heavy op — at ~2 bytes/position of extra HBM.
+            attn = _checkpoint_name(attn, "attn_out")
             new_cache = None
         elif page_table is not None:
             attn, new_cache = self._paged_block_attention(
@@ -784,11 +798,16 @@ class Transformer(Module):
 
         block = self._block
         if cfg.remat and cache is None:
-            policy = (
-                jax.checkpoint_policies.dots_with_no_batch_dims_saveable
-                if cfg.remat_policy == "dots"
-                else None
-            )
+            cp = jax.checkpoint_policies
+            policy = {
+                "dots": cp.dots_with_no_batch_dims_saveable,
+                "full": None,
+                "flash": cp.save_only_these_names("attn_out"),
+                "dots_flash": cp.save_from_both_policies(
+                    cp.dots_with_no_batch_dims_saveable,
+                    cp.save_only_these_names("attn_out"),
+                ),
+            }[cfg.remat_policy]
             block = jax.checkpoint(block, static_argnums=(), policy=policy)
 
         if cache is None:

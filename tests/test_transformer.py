@@ -148,3 +148,35 @@ def test_packed_segments_match_separate_sequences(tiny):
 def test_bad_gqa_config_raises():
     with pytest.raises(ValueError):
         TransformerConfig(n_heads=6, n_kv_heads=4)
+
+
+def test_remat_policies_grad_parity():
+    """All four remat policies compute the same loss and grads (tight
+    tolerance — bf16 save-vs-recompute rounding only); the selective
+    policies exist for memory/time shape, never numerics."""
+    import numpy as np
+
+    tokens = jnp.asarray(
+        np.random.RandomState(0).randint(1, 250, (2, 32)), jnp.int32
+    )
+    results = {}
+    for pol in ("dots", "full", "flash", "dots_flash"):
+        cfg = TransformerConfig.tiny(remat=True, remat_policy=pol)
+        m = Transformer(cfg)
+        p = m.init(jax.random.key(0))
+        loss, grads = jax.value_and_grad(
+            lambda pp: m.loss(pp, {"tokens": tokens})[0]
+        )(p)
+        results[pol] = (float(loss), grads)
+    ref_l, ref_g = results["full"]
+    for pol, (l, g) in results.items():
+        assert abs(l - ref_l) < 1e-3, (pol, l, ref_l)
+        for a, b in zip(
+            jax.tree_util.tree_leaves(g), jax.tree_util.tree_leaves(ref_g)
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a, np.float32), np.asarray(b, np.float32),
+                atol=2e-3, err_msg=pol,
+            )
+    with pytest.raises(ValueError, match="remat_policy"):
+        TransformerConfig.tiny(remat_policy="nope")
