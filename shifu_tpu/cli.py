@@ -628,7 +628,49 @@ def build_serve_engine(args, model, params, tok):
         enable_penalties=args.penalties,
         enable_logit_bias=args.logit_bias,
     )
+    lora_cfg = None
+    lora_dirs = getattr(args, "lora_ckpt_dir", None) or []
+    if lora_dirs:
+        from shifu_tpu.infer import LoraServingConfig
+
+        lora_cfg = LoraServingConfig(
+            rank=args.lora_rank,
+            alpha=args.lora_alpha,
+            targets=tuple(
+                t.strip() for t in args.lora_targets.split(",") if t.strip()
+            ),
+            max_adapters=max(len(lora_dirs), 1),
+        )
+        kw["lora"] = lora_cfg
+
+    def load_adapters(engine):
+        """Register each --lora-ckpt-dir (ids 1..n, in flag order)."""
+        if not lora_dirs:
+            return engine
+        from shifu_tpu.checkpoint import Checkpointer
+        from shifu_tpu.train import LoraConfig, LoraModel
+
+        lm = LoraModel(
+            model, params,
+            LoraConfig(
+                rank=lora_cfg.rank, alpha=lora_cfg.alpha,
+                targets=lora_cfg.targets,
+            ),
+        )
+        for d in lora_dirs:
+            ckpt = Checkpointer(d)
+            try:
+                engine.add_adapter(ckpt.restore_params(lm))
+            finally:
+                ckpt.close()
+        return engine
+
     if args.spec != "off":
+        if lora_dirs:
+            raise ValueError(
+                "--lora-ckpt-dir does not compose with --spec (the "
+                "speculative round programs do not thread adapters)"
+            )
         # Speculative engines are paged by construction; the spec
         # guards refuse penalties/logit_bias, so surface that here
         # instead of at the first request.
@@ -670,12 +712,12 @@ def build_serve_engine(args, model, params, tok):
             **paged_kw, **kw,
         )
     if args.paged:
-        return PagedEngine(
+        return load_adapters(PagedEngine(
             model, params, page_size=args.page_size,
             n_pages=args.n_pages,
             enable_prefix_cache=args.prefix_cache, **kw,
-        )
-    return Engine(model, params, **kw)
+        ))
+    return load_adapters(Engine(model, params, **kw))
 
 
 def cmd_serve(args) -> int:
@@ -917,6 +959,13 @@ def main(argv=None) -> int:
                    help="honour logit_bias / allowed_token_ids fields "
                         "(slots x vocab f32 bias buffer; implies "
                         "--per-request-sampling)")
+    s.add_argument("--lora-ckpt-dir", action="append",
+                   help="LoRA adapter checkpoint dir (repeatable; "
+                        "adapter ids are assigned 1..n in flag order; "
+                        'requests pick one via the "adapter" field)')
+    s.add_argument("--lora-rank", type=int, default=8)
+    s.add_argument("--lora-alpha", type=float, default=16.0)
+    s.add_argument("--lora-targets", default="wq,wk,wv,wo")
     s.add_argument("--spec", default="off",
                    choices=["off", "prompt-lookup", "draft"],
                    help="speculative decoding: prompt-lookup proposes "

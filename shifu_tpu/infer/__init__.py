@@ -9,7 +9,12 @@ is the standard prefill + KV-cache decode design, TPU-first (static shapes,
 from shifu_tpu.infer.sampling import SampleConfig, sample_logits
 from shifu_tpu.infer.generate import generate, make_generate_fn
 from shifu_tpu.infer.beam import make_beam_search_fn
-from shifu_tpu.infer.engine import Completion, Engine, PagedEngine
+from shifu_tpu.infer.engine import (
+    Completion,
+    Engine,
+    LoraServingConfig,
+    PagedEngine,
+)
 from shifu_tpu.infer.spec_engine import (
     PromptLookupPagedEngine,
     SpeculativePagedEngine,
@@ -41,6 +46,7 @@ __all__ = [
     "speculative_generate",
     "speculative_generate_batch",
     "Engine",
+    "LoraServingConfig",
     "EngineRunner",
     "PagedEngine",
     "PromptLookupPagedEngine",

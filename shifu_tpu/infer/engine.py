@@ -41,6 +41,38 @@ from shifu_tpu.infer.sampling import (
 )
 
 
+@dataclasses.dataclass(frozen=True)
+class LoraServingConfig:
+    """Multi-adapter serving (``Engine(lora=LoraServingConfig(...))``).
+
+    ``max_adapters`` live adapters share one (L, max_adapters+1, ...)
+    factor table per target weight (index 0 is the all-zero
+    no-adapter row); requests pick an adapter at submit
+    (``submit(..., adapter=id)``) and the decode programs apply each
+    row's ``x·A_i·B_i`` delta on the targeted projections — one batch,
+    many tenants, no weight swapping. HBM cost per adapter ~=
+    rank * sum(In + Out) * L * 4 bytes (f32 factors; e.g. rank 8 on
+    q/k/v/o of a 1.2B model ~= 8 MB per adapter).
+
+    ``targets`` follow train.lora naming (wq/wk/wv/wo and, for dense
+    FFNs, w_gate/w_up/w_down); ``alpha / rank`` scales the delta,
+    folded into the B factors at registration.
+    """
+
+    rank: int = 8
+    alpha: float = 16.0
+    targets: tuple = ("wq", "wk", "wv", "wo")
+    max_adapters: int = 8
+
+    def __post_init__(self):
+        if self.rank < 1 or self.max_adapters < 1:
+            raise ValueError("rank and max_adapters must be >= 1")
+        allowed = {"wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down"}
+        bad = set(self.targets) - allowed
+        if bad:
+            raise ValueError(f"unknown lora targets {sorted(bad)}")
+
+
 def _token_logprob(logits, ids):
     """Raw-model logprob of ``ids`` under (batch, vocab) logits — the
     pre-temperature/pre-filter distribution, the conventional
@@ -74,6 +106,8 @@ class _Request:
     # bias row exactly.
     logit_bias: Optional[dict] = None
     allowed_token_ids: Optional[List[int]] = None
+    # Multi-LoRA serving: registered adapter id (0 = none).
+    adapter: int = 0
     # Tokens already cleared of stop matches (resume point for the
     # sweep's scan — keeps per-step stop checking incremental).
     stop_scanned: int = 0
@@ -119,6 +153,7 @@ class Engine:
         per_request_sampling: bool = False,
         enable_penalties: bool = False,
         enable_logit_bias: bool = False,
+        lora: Optional[LoraServingConfig] = None,
         tokenizer=None,
     ):
         """``per_request_sampling``: temperature/top-k/top-p become
@@ -163,6 +198,10 @@ class Engine:
         ban semantics; see ``sampling.bias_row``). Off by default for
         the same reason as penalties: the buffer is slots x vocab x 4
         bytes of host->device traffic per dispatch.
+
+        ``lora``: multi-adapter serving — see :class:`LoraServingConfig`.
+        Register adapters with :meth:`add_adapter`; requests pick one
+        via ``submit(..., adapter=id)``.
 
         ``tokenizer``: optional; needed only for STRING stop sequences
         (``submit(..., stop_strings=...)`` — the sweep decodes the
@@ -248,6 +287,43 @@ class Engine:
                 (max_slots, self.model.cfg.vocab_size), jnp.float32
             )
 
+        # Multi-LoRA serving: stacked per-target factor tables, device-
+        # resident (index 0 = all-zero no-adapter row; registration is
+        # the only writer). Flattened In/Out dims — the model's
+        # lora_delta contract (models/transformer.py _block).
+        self.lora = lora
+        if lora is not None:
+            cfg_m = self.model.cfg
+            if cfg_m.n_experts and (
+                set(lora.targets) & {"w_gate", "w_up", "w_down"}
+            ):
+                raise NotImplementedError(
+                    "FFN lora targets on an MoE config: expert FFNs "
+                    "take the dispatch/combine path the serving delta "
+                    "does not cover; target the attention projections"
+                )
+            d = cfg_m.dim
+            hd = cfg_m.resolved_head_dim
+            io = {
+                "wq": (d, cfg_m.n_heads * hd),
+                "wk": (d, cfg_m.n_kv_heads * hd),
+                "wv": (d, cfg_m.n_kv_heads * hd),
+                "wo": (cfg_m.n_heads * hd, d),
+                "w_gate": (d, cfg_m.mlp_dim),
+                "w_up": (d, cfg_m.mlp_dim),
+                "w_down": (cfg_m.mlp_dim, d),
+            }
+            L, A, r = cfg_m.n_layers, lora.max_adapters, lora.rank
+            self._lora_tables = {
+                t: {
+                    "a": jnp.zeros((L, A + 1, io[t][0], r), jnp.float32),
+                    "b": jnp.zeros((L, A + 1, r, io[t][1]), jnp.float32),
+                }
+                for t in lora.targets
+            }
+            self._n_adapters = 0
+            self._row_adapter = np.zeros((max_slots,), np.int32)
+
         self._prefill_jit = jax.jit(
             self._in_act_ctx(self._prefill_impl),
             static_argnames=("bucket",),
@@ -270,6 +346,7 @@ class Engine:
         stop_strings=None,
         logit_bias: Optional[dict] = None,
         allowed_token_ids=None,
+        adapter: Optional[int] = None,
     ) -> int:
         """Queue one request; returns its rid.
 
@@ -284,7 +361,9 @@ class Engine:
         ``logit_bias``: {token_id: additive bias}, OpenAI semantics
         (<= -100 is a hard ban). ``allowed_token_ids``: restrict
         sampling to exactly these ids (everything else hard-banned).
-        Both need ``Engine(enable_logit_bias=True)``."""
+        Both need ``Engine(enable_logit_bias=True)``.
+        ``adapter``: a registered adapter id (:meth:`add_adapter`);
+        None/0 serves the base model."""
         if sampling is not None and not self.per_request_sampling:
             raise ValueError(
                 "per-request sampling requires "
@@ -318,6 +397,16 @@ class Engine:
                 logit_bias = {int(t): float(v) for t, v in logit_bias.items()}
             if allowed_token_ids is not None:
                 allowed_token_ids = [int(t) for t in allowed_token_ids]
+        if adapter:
+            if self.lora is None:
+                raise ValueError(
+                    "adapter requires Engine(lora=LoraServingConfig(...))"
+                )
+            if not 1 <= int(adapter) <= self._n_adapters:
+                raise ValueError(
+                    f"unknown adapter id {adapter} "
+                    f"({self._n_adapters} registered)"
+                )
         if stop_token_ids is not None:
             stop_token_ids = [
                 [int(seq)] if isinstance(seq, int) else list(map(int, seq))
@@ -362,9 +451,54 @@ class Engine:
                 sampling=sampling, logprobs=[],
                 stop_token_ids=stop_token_ids, stop_strings=stop_strings,
                 logit_bias=logit_bias, allowed_token_ids=allowed_token_ids,
+                adapter=int(adapter) if adapter else 0,
             )
         )
         return rid
+
+    def add_adapter(self, lora_params) -> int:
+        """Register one adapter; returns its id (1-based; 0 = none).
+
+        ``lora_params`` is the train-side format (train/lora.py
+        LoraModel): {"blocks/<target>": {"a": (L, *In, r),
+        "b": (L, r, *Out)}}. Factors are flattened, the alpha/rank
+        scale folds into b, and one row of each device table is
+        written — admission never touches the tables again.
+        """
+        if self.lora is None:
+            raise ValueError("engine built without lora=LoraServingConfig")
+        if self._n_adapters >= self.lora.max_adapters:
+            raise ValueError(
+                f"adapter capacity {self.lora.max_adapters} exhausted"
+            )
+        idx = self._n_adapters + 1
+        scale = self.lora.alpha / self.lora.rank
+        for t in self.lora.targets:
+            key = f"blocks/{t}"
+            if key not in lora_params:
+                raise ValueError(f"lora_params lacks {key!r}")
+            a = jnp.asarray(lora_params[key]["a"], jnp.float32)
+            bm = jnp.asarray(lora_params[key]["b"], jnp.float32)
+            L = self.model.cfg.n_layers
+            a2 = a.reshape(L, -1, a.shape[-1])
+            b2 = bm.reshape(L, bm.shape[1], -1) * scale
+            want_a = self._lora_tables[t]["a"].shape
+            want_b = self._lora_tables[t]["b"].shape
+            if a2.shape != (L, want_a[2], want_a[3]) or b2.shape != (
+                L, want_b[2], want_b[3]
+            ):
+                raise ValueError(
+                    f"adapter factors for {t!r} have shape "
+                    f"{a2.shape}/{b2.shape}; engine expects "
+                    f"{(L, want_a[2], want_a[3])}/{(L, want_b[2], want_b[3])}"
+                    " (check rank/targets against LoraServingConfig)"
+                )
+            self._lora_tables[t] = {
+                "a": self._lora_tables[t]["a"].at[:, idx].set(a2),
+                "b": self._lora_tables[t]["b"].at[:, idx].set(b2),
+            }
+        self._n_adapters = idx
+        return idx
 
     def cancel(self, rid: int) -> bool:
         """Drop a request wherever it is — queued, decoding, or
@@ -505,9 +639,28 @@ class Engine:
     def _decode_extra_args(self) -> tuple:
         """Extra positional args for _decode_impl, before rng:
         per-slot sampling arrays, then penalty arrays, then the bias
-        buffer (flat; impls re-split with _split_extra)."""
+        buffer, then the lora tables + row ids (flat; impls re-split
+        with _split_extra)."""
         return (
-            self._sampling_args() + self._penalty_args() + self._bias_args()
+            self._sampling_args() + self._penalty_args()
+            + self._bias_args() + self._lora_args()
+        )
+
+    def _lora_args(self) -> tuple:
+        """(tables pytree, (slots,) adapter row ids) — () without lora.
+        Tables are persistent device arrays; the row ids are a (slots,)
+        int32 upload per dispatch (noise)."""
+        if self.lora is None:
+            return ()
+        return (self._lora_tables, jnp.asarray(self._row_adapter))
+
+    def _req_lora_args(self, req: _Request) -> tuple:
+        """Single-row lora args for one request's prefill."""
+        if self.lora is None:
+            return ()
+        return (
+            self._lora_tables,
+            jnp.asarray([req.adapter], jnp.int32),
         )
 
     # -------------------------------------------- per-request sampling
@@ -591,11 +744,15 @@ class Engine:
 
     def _split_extra(self, rest: tuple):
         """Parse a program's trailing args into (lead, samp, pen, bias,
-        rng) — the flat layout _decode_extra_args produced, parsed from
-        the END so subclass-specific leading extras (the paged engine's
-        page table) pass through untouched."""
+        lora, rng) — the flat layout _decode_extra_args produced,
+        parsed from the END so subclass-specific leading extras (the
+        paged engine's page table) pass through untouched."""
         rng = rest[-1]
         rest = rest[:-1]
+        lora = None
+        if self.lora is not None:
+            lora = (rest[-2], rest[-1])
+            rest = rest[:-2]
         bias = ()
         if self.enable_logit_bias:
             bias = (rest[-1],)
@@ -608,7 +765,7 @@ class Engine:
         if self.per_request_sampling:
             samp = tuple(rest[-4:])
             rest = rest[:-4]
-        return tuple(rest), samp, pen, bias, rng
+        return tuple(rest), samp, pen, bias, lora, rng
 
     def _sample_rows(self, logits, rng, samp: tuple, pen: tuple = (),
                      bias: tuple = ()):
@@ -639,7 +796,8 @@ class Engine:
         (slots, K), logprobs (slots, K), n_emitted (slots,), cur,
         lengths, cache).
         """
-        lead, samp, pen, bias, rng = self._split_extra(rest)
+        lead, samp, pen, bias, lora, rng = self._split_extra(rest)
+        lora_t = lora if lora is None else tuple(lora)
         k = self.decode_chunk
         eos = self.eos_id
         counts0 = pen[0] if pen else None
@@ -653,7 +811,8 @@ class Engine:
             # each step unchanged, unlike the counts carry.
             res = self._decode_impl(
                 params, cache, cur, lengths, live, *lead, *samp, *pen_t,
-                *bias, jax.random.fold_in(rng, t),
+                *bias, *(lora_t if lora_t else ()),
+                jax.random.fold_in(rng, t),
             )
             if pen:
                 # _decode_impl already folded this step's emission into
@@ -885,7 +1044,8 @@ class Engine:
             slot, padded, p, bucket, sub,
             self._req_sampling_args(req)
             + self._req_penalty_args(req)
-            + self._req_bias_args(req),
+            + self._req_bias_args(req)
+            + self._req_lora_args(req),
         )
         self._finish_admission(req, slot, p, first, lp)
 
@@ -932,6 +1092,8 @@ class Engine:
             self._counts_dev = self._counts_dev.at[slot].set(
                 jnp.asarray(row)
             )
+        if self.lora is not None:
+            self._row_adapter[slot] = req.adapter
         if self.enable_logit_bias:
             # Rebuilt from the request (not carried from the prefill
             # args) so preemption-recompute re-admissions restore the
@@ -951,8 +1113,9 @@ class Engine:
                       bucket):
         """Prefill one request into cache row ``slot``; sample token 1.
         ``rest`` = optional per-request sampling arrays, optional
-        penalty arrays, optional bias row, then rng."""
-        _, samp, pen, bias, rng = self._split_extra(rest)
+        penalty arrays, optional bias row, optional lora args, then
+        rng."""
+        _, samp, pen, bias, lora, rng = self._split_extra(rest)
         row = jax.tree_util.tree_map(
             lambda c: jax.lax.dynamic_slice_in_dim(c, slot, 1, axis=1),
             cache,
@@ -980,6 +1143,7 @@ class Engine:
             cache=row,
             cache_index=0,
             logits_at=(length - 1)[None],
+            **({"lora": lora} if lora is not None else {}),
             **prefill_kw,
         )
         cache = jax.tree_util.tree_map(
@@ -997,9 +1161,9 @@ class Engine:
         """One (token, logprob) for every slot (inactive slots compute
         but are ignored — static shapes beat host-side gather/scatter
         here). ``rest`` = optional per-slot sampling arrays, optional
-        penalty arrays, optional bias buffer, then rng (_split_extra's
-        layout)."""
-        _, samp, pen, bias, rng = self._split_extra(rest)
+        penalty arrays, optional bias buffer, optional lora args,
+        then rng (_split_extra's layout)."""
+        _, samp, pen, bias, lora, rng = self._split_extra(rest)
         kv_mask = (
             jnp.arange(self.max_len)[None, :] <= lengths[:, None]
         )
@@ -1009,6 +1173,7 @@ class Engine:
             cache=cache,
             cache_index=lengths,  # per-row write offsets
             kv_mask=kv_mask,
+            **({"lora": lora} if lora is not None else {}),
         )
         nxt = self._sample_rows(logits[:, -1], rng, samp, pen, bias)
         lp = _token_logprob(logits[:, -1], nxt)
@@ -1357,7 +1522,7 @@ class PagedEngine(Engine):
         shared: List[int] = []
         hit = 0
         if self.enable_prefix_cache:
-            key = b""
+            key = self._prefix_salt(req.adapter)
             while hit + ps <= p - 1:
                 key = self._chain_key(key, prompt[hit : hit + ps])
                 pg = self._prefix_pages.get(key)
@@ -1441,6 +1606,7 @@ class PagedEngine(Engine):
             self._req_sampling_args(req)
             + self._req_penalty_args(req)
             + self._req_bias_args(req)
+            + self._req_lora_args(req)
         )
         if hit:
             first, lp = self._dispatch_prefill_at(
@@ -1462,11 +1628,20 @@ class PagedEngine(Engine):
             self._page_rc[pg] = self._page_rc.get(pg, 0) + 1
         self._slot_pages[slot] = pages_used
         self._admit_order[slot] = next(self._admit_seq)
-        self._register_prefix(prompt, pages_used)
+        self._register_prefix(prompt, pages_used, req.adapter)
         self._finish_admission(req, slot, p, first, lp)
         return True
 
-    def _register_prefix(self, prompt, pages_used) -> None:
+    @staticmethod
+    def _prefix_salt(adapter: int) -> bytes:
+        """Chain-key seed. K/V baked with a LoRA adapter's wk/wv
+        deltas is only reusable by requests with the SAME adapter —
+        salting the chain root partitions the cache per adapter (the
+        base model is partition 0), so cross-adapter reuse is
+        impossible by construction rather than guarded by policy."""
+        return b"" if not adapter else f"adapter:{adapter}".encode()
+
+    def _register_prefix(self, prompt, pages_used, adapter: int = 0) -> None:
         """Register a freshly-prefilled prompt's full pages with the
         prefix cache (no-op when disabled)."""
         if not self.enable_prefix_cache:
@@ -1476,7 +1651,7 @@ class PagedEngine(Engine):
         # Register this prompt's NEW full pages (the partial tail
         # page takes decode writes and is never shareable)...
         keys = []
-        key = b""
+        key = self._prefix_salt(adapter)
         for i in range(p // ps):
             key = self._chain_key(key, prompt[i * ps : (i + 1) * ps])
             keys.append(key)
@@ -1543,6 +1718,7 @@ class PagedEngine(Engine):
                     self._req_sampling_args(req)
                     + self._req_penalty_args(req)
                     + self._req_bias_args(req)
+                    + self._req_lora_args(req)
                 ),
                 final_len=len(prompt),
             )
@@ -1562,7 +1738,7 @@ class PagedEngine(Engine):
         row = self._pending_rows.pop(slot)
         del self._prefilling[slot]
         self._table[slot] = row[: self.pages_per_slot]
-        self._register_prefix(prompt, self._slot_pages[slot])
+        self._register_prefix(prompt, self._slot_pages[slot], req.adapter)
         self._finish_admission(req, slot, len(prompt), first, lp)
 
     def _dispatch_prefill(self, slot, padded, p, bucket, rng, samp=()):
@@ -1610,8 +1786,9 @@ class PagedEngine(Engine):
         frequencies a one-shot prefill of the whole prompt would (a
         mid-prompt chunk's own max position would pick a shorter, WRONG
         regime). ``rest`` = optional per-request sampling arrays,
-        optional penalty arrays, optional bias row, then rng."""
-        _, samp, pen, bias, rng = self._split_extra(rest)
+        optional penalty arrays, optional bias row, optional lora args,
+        then rng."""
+        _, samp, pen, bias, lora, rng = self._split_extra(rest)
         pos = jnp.minimum(
             offset + jnp.arange(bucket), offset + length - 1
         )
@@ -1624,6 +1801,7 @@ class PagedEngine(Engine):
             page_table=table_row[None, :],
             logits_at=(length - 1)[None],
             rope_regime_len=final_len,
+            **({"lora": lora} if lora is not None else {}),
         )
         tok = self._sample_rows(logits[:, 0], rng, samp, pen, bias)[0]
         lp = _token_logprob(logits[:, 0], tok[None])[0]
@@ -1661,6 +1839,7 @@ class PagedEngine(Engine):
             + self._sampling_args()
             + self._penalty_args()
             + self._bias_args()
+            + self._lora_args()
         )
 
     # ----------------------------------------------------------- programs
@@ -1668,8 +1847,9 @@ class PagedEngine(Engine):
                       *rest, bucket):
         """Prefill one request straight into its pages; sample token 1.
         ``rest`` = optional per-request sampling arrays, optional
-        penalty arrays, optional bias row, then rng."""
-        _, samp, pen, bias, rng = self._split_extra(rest)
+        penalty arrays, optional bias row, optional lora args, then
+        rng."""
+        _, samp, pen, bias, lora, rng = self._split_extra(rest)
         logits, cache = self.model(
             params,
             tokens[None, :],
@@ -1680,6 +1860,7 @@ class PagedEngine(Engine):
             cache_index=0,
             page_table=table_row[None, :],
             logits_at=(length - 1)[None],
+            **({"lora": lora} if lora is not None else {}),
         )
         tok = self._sample_rows(logits[:, 0], rng, samp, pen, bias)[0]
         lp = _token_logprob(logits[:, 0], tok[None])[0]
@@ -1688,8 +1869,9 @@ class PagedEngine(Engine):
     def _decode_impl(self, params, cache, cur, lengths, active, table,
                      *rest):
         # ``rest`` = optional per-slot sampling arrays, optional penalty
-        # arrays, optional bias buffer, then rng (_split_extra's layout).
-        _, samp, pen, bias, rng = self._split_extra(rest)
+        # arrays, optional bias buffer, optional lora args, then rng
+        # (_split_extra's layout).
+        _, samp, pen, bias, lora, rng = self._split_extra(rest)
         # No kv_mask: on the paged path it would be ``pos <= lengths`` —
         # exactly the slot-space causality the decode attention already
         # enforces from ``cache_index`` (both the Pallas kernel and the
@@ -1703,6 +1885,7 @@ class PagedEngine(Engine):
             cache=cache,
             cache_index=lengths,
             page_table=table,
+            **({"lora": lora} if lora is not None else {}),
         )
         nxt = self._sample_rows(logits[:, -1], rng, samp, pen, bias)
         lp = _token_logprob(logits[:, -1], nxt)

@@ -220,6 +220,7 @@ class _Submission:
     waiter: object
     logit_bias: Optional[dict] = None
     allowed_token_ids: Optional[list] = None
+    adapter: Optional[int] = None
 
 
 @dataclasses.dataclass
@@ -275,12 +276,13 @@ class EngineRunner:
         self, tokens, max_new_tokens: int, timeout: Optional[float] = None,
         sampling: Optional[SampleConfig] = None,
         stop_token_ids=None, stop_strings=None,
-        logit_bias=None, allowed_token_ids=None,
+        logit_bias=None, allowed_token_ids=None, adapter=None,
     ) -> Completion:
         return self.complete_n(
             tokens, max_new_tokens, 1, timeout=timeout, sampling=sampling,
             stop_token_ids=stop_token_ids, stop_strings=stop_strings,
             logit_bias=logit_bias, allowed_token_ids=allowed_token_ids,
+            adapter=adapter,
         )[0]
 
     def complete_n(
@@ -288,7 +290,7 @@ class EngineRunner:
         timeout: Optional[float] = None,
         sampling: Optional[SampleConfig] = None,
         stop_token_ids=None, stop_strings=None,
-        logit_bias=None, allowed_token_ids=None,
+        logit_bias=None, allowed_token_ids=None, adapter=None,
     ):
         """N independent completions of one prompt (the API's ``n``).
 
@@ -320,6 +322,7 @@ class EngineRunner:
                         stop_token_ids, stop_strings, w,
                         logit_bias=logit_bias,
                         allowed_token_ids=allowed_token_ids,
+                        adapter=adapter,
                     )
                 )
         self._wake.set()
@@ -380,7 +383,7 @@ class EngineRunner:
                timeout: Optional[float] = None,
                sampling: Optional[SampleConfig] = None,
                stop_token_ids=None, stop_strings=None,
-               logit_bias=None, allowed_token_ids=None):
+               logit_bias=None, allowed_token_ids=None, adapter=None):
         """Returns a generator of ("delta", (ids, logprobs)) items
         ending with ("done", Completion); tokens arrive as the engine
         emits them (per decode chunk). The submission (and the
@@ -404,6 +407,7 @@ class EngineRunner:
                     stop_token_ids, stop_strings, w,
                     logit_bias=logit_bias,
                     allowed_token_ids=allowed_token_ids,
+                    adapter=adapter,
                 )
             )
         self._wake.set()
@@ -569,6 +573,7 @@ class EngineRunner:
                     stop_strings=sub.stop_strings,
                     logit_bias=sub.logit_bias,
                     allowed_token_ids=sub.allowed_token_ids,
+                    adapter=sub.adapter,
                 )
             except Exception as e:  # validation error -> the caller
                 with self._lock:
@@ -790,6 +795,11 @@ class _Handler(BaseHTTPRequestHandler):
                 stop_strings = [stop_strings]
             stop_token_ids = req.get("stop_token_ids")
             logit_bias, allowed_ids = _parse_bias(req)
+            adapter = req.get("adapter")
+            if adapter is not None and (
+                isinstance(adapter, bool) or not isinstance(adapter, int)
+            ):
+                raise ValueError("adapter must be an integer id")
             want_logprobs = bool(req.get("logprobs"))
             n = int(req.get("n", 1))
             best_of = req.get("best_of")
@@ -806,6 +816,7 @@ class _Handler(BaseHTTPRequestHandler):
                     tokens, max_new, sampling, stop_token_ids,
                     stop_strings, want_logprobs, chat=chat,
                     logit_bias=logit_bias, allowed_token_ids=allowed_ids,
+                    adapter=adapter,
                 )
                 return
             if best_of is not None:
@@ -842,13 +853,14 @@ class _Handler(BaseHTTPRequestHandler):
                     or want_logprobs
                     or logit_bias is not None
                     or allowed_ids is not None
+                    or adapter is not None
                 ):
                     # Beam is deterministic max-logprob search; these
                     # fields would be silently dropped — refuse instead.
                     raise ValueError(
                         "best_of composes with none of temperature/"
                         "top_k/top_p/stop/stop_token_ids/logprobs/"
-                        "logit_bias/allowed_token_ids"
+                        "logit_bias/allowed_token_ids/adapter"
                     )
                 out = self.runner.beam(
                     tokens, max_new, best_of,
@@ -878,7 +890,7 @@ class _Handler(BaseHTTPRequestHandler):
                     tokens, max_new, n, timeout=self.request_timeout_s,
                     sampling=sampling, stop_token_ids=stop_token_ids,
                     stop_strings=stop_strings, logit_bias=logit_bias,
-                    allowed_token_ids=allowed_ids,
+                    allowed_token_ids=allowed_ids, adapter=adapter,
                 )
                 choices = [
                     _build_choice(
@@ -894,7 +906,7 @@ class _Handler(BaseHTTPRequestHandler):
                 tokens, max_new, timeout=self.request_timeout_s,
                 sampling=sampling, stop_token_ids=stop_token_ids,
                 stop_strings=stop_strings, logit_bias=logit_bias,
-                allowed_token_ids=allowed_ids,
+                allowed_token_ids=allowed_ids, adapter=adapter,
             )
         except (ValueError, TypeError) as e:
             self._send(400, {"error": str(e)})
@@ -914,6 +926,7 @@ class _Handler(BaseHTTPRequestHandler):
         self, tokens, max_new: int, sampling=None,
         stop_token_ids=None, stop_strings=None, want_logprobs=False,
         chat: bool = False, logit_bias=None, allowed_token_ids=None,
+        adapter=None,
     ) -> None:
         """Server-sent events: one ``data:`` line per token delta, a
         final one with finished_by (and the definitive token count —
@@ -927,7 +940,7 @@ class _Handler(BaseHTTPRequestHandler):
             tokens, max_new, timeout=self.request_timeout_s,
             sampling=sampling, stop_token_ids=stop_token_ids,
             stop_strings=stop_strings, logit_bias=logit_bias,
-            allowed_token_ids=allowed_token_ids,
+            allowed_token_ids=allowed_token_ids, adapter=adapter,
         )
         self.send_response(200)
         self.send_header("Content-Type", "text/event-stream")

@@ -266,7 +266,7 @@ class Transformer(Module):
     # ------------------------------------------------------------- one block
     def _block(
         self, p, h, sin, cos, segment_ids, cache_slice, cache_index,
-        kv_mask=None, page_table=None, layer_idx=None,
+        kv_mask=None, page_table=None, layer_idx=None, lora_slice=None,
     ):
         """One transformer block. ``p`` holds per-layer (unstacked) params.
 
@@ -278,16 +278,51 @@ class Transformer(Module):
         layer scan as a carry and is only ever updated in place, page by
         page; materialising a per-layer slice would copy the entire
         layer every decode step — see :meth:`init_paged_cache`.
+
+        ``lora_slice``: per-request serving adapters for THIS layer —
+        ``(tables, row_ids)`` where tables maps a target weight name to
+        {"a": (n_adapters, In, r), "b": (n_adapters, r, Out)} (flattened
+        input/output dims, scale folded into b) and row_ids (b,) picks
+        each row's adapter (0 = the all-zero no-adapter row). The delta
+        ``x·A_i·B_i`` adds to the projection OUTPUT before bias/rope —
+        exactly what merging W + scale·A·B into the weight would
+        compute, but per row, so one batch serves many adapters.
         """
         cfg = self.cfg
         # Dequantize any quantized leaves HERE — per layer, at the
         # consumption point — so int8/fp8 stays the HBM format and the
         # convert+scale fuses into each matmul's operand read.
         p = dequantize_tree(p, h.dtype)
+
+        def lora_delta(name, xin):
+            """Per-row adapter delta (b, s, Out) for target ``name``,
+            or None. xin: (b, s, In) — the flattened matmul input. The
+            rank-r factors gather per ROW (adapters are small; the
+            gather is b·In·r elements), so rows with different
+            adapters ride one program."""
+            if lora_slice is None:
+                return None
+            tabs, rows = lora_slice
+            if name not in tabs:
+                return None
+            a = tabs[name]["a"][rows].astype(xin.dtype)  # (b, In, r)
+            bm = tabs[name]["b"][rows].astype(xin.dtype)  # (b, r, Out)
+            za = jnp.einsum("bsi,bir->bsr", xin, a)
+            return jnp.einsum("bsr,bro->bso", za, bm)
+
         x = rms_norm(h, p["attn_norm"], eps=cfg.norm_eps)
         q = jnp.einsum("bsd,dhk->bshk", x, p["wq"])
         k = jnp.einsum("bsd,dhk->bshk", x, p["wk"])
         v = jnp.einsum("bsd,dhk->bshk", x, p["wv"])
+        dq = lora_delta("wq", x)
+        if dq is not None:
+            q = q + dq.reshape(q.shape)
+        dk = lora_delta("wk", x)
+        if dk is not None:
+            k = k + dk.reshape(k.shape)
+        dv = lora_delta("wv", x)
+        if dv is not None:
+            v = v + dv.reshape(v.shape)
         if cfg.qkv_bias:
             q = q + p["bq"]
             k = k + p["bk"]
@@ -382,7 +417,11 @@ class Transformer(Module):
                 )
             new_cache = {"k": ck, "v": cv}
 
-        h = h + jnp.einsum("bshk,hkd->bsd", attn, p["wo"])
+        o = jnp.einsum("bshk,hkd->bsd", attn, p["wo"])
+        do = lora_delta("wo", attn.reshape(*attn.shape[:2], -1))
+        if do is not None:
+            o = o + do
+        h = h + o
 
         x = rms_norm(h, p["mlp_norm"], eps=cfg.norm_eps)
         if cfg.n_experts:
@@ -390,9 +429,18 @@ class Transformer(Module):
         else:
             gate = jnp.einsum("bsd,dm->bsm", x, p["w_gate"])
             up = jnp.einsum("bsd,dm->bsm", x, p["w_up"])
-            down = jnp.einsum(
-                "bsm,md->bsd", jax.nn.silu(gate) * up, p["w_down"]
-            )
+            for name in ("w_gate", "w_up"):
+                d = lora_delta(name, x)
+                if d is not None:
+                    if name == "w_gate":
+                        gate = gate + d
+                    else:
+                        up = up + d
+            act = jax.nn.silu(gate) * up
+            down = jnp.einsum("bsm,md->bsd", act, p["w_down"])
+            dd = lora_delta("w_down", act)
+            if dd is not None:
+                down = down + dd
             moe_aux = None
         h = h + down
         h = constrain(h, ("batch", "seq", "act_embed"))
@@ -698,6 +746,7 @@ class Transformer(Module):
         return_hidden=False,
         blocks_fn=None,
         rope_regime_len=None,
+        lora=None,
     ):
         """Compute logits.
 
@@ -728,6 +777,15 @@ class Transformer(Module):
             (b, s, d) INSTEAD of logits, skipping the unembed — the
             fused-CE loss consumes these so the (b, s, vocab) logits
             never materialise. Training path only (no cache).
+          lora: optional per-request serving adapters ``(tables,
+            row_ids)``: tables map target weight names to
+            {"a": (L, n_adapters, In, r), "b": (L, n_adapters, r, Out)}
+            stacked factors (layer axis leading — they ride the block
+            scan beside the layer params) and row_ids (b,) int32 picks
+            each row's adapter, 0 = none. See ``_block.lora_delta``;
+            the serving engines build these (infer.engine
+            ``lora=LoraServingConfig(...)``). Unsupported with
+            ``blocks_fn`` (the pipeline schedules own the scan).
           blocks_fn: optional override for the block-stack execution:
             ``(stacked_block_params, h, sin, cos, segment_ids) -> h``, or
             ``-> (h, moe_aux)`` for an MoE config (aux = pytree of f32
@@ -810,6 +868,13 @@ class Transformer(Module):
             }[cfg.remat_policy]
             block = jax.checkpoint(block, static_argnums=(), policy=policy)
 
+        if lora is not None and blocks_fn is not None:
+            raise ValueError(
+                "lora adapters do not compose with blocks_fn (the "
+                "pipeline schedules restructure the block scan)"
+            )
+        lora_tabs, lora_rows = lora if lora is not None else (None, None)
+
         if cache is None:
             if blocks_fn is not None:
                 out = blocks_fn(p["blocks"], h, sin, cos, segment_ids)
@@ -827,13 +892,17 @@ class Transformer(Module):
                 else:
                     h, auxes = out, None
             else:
-                def body(carry, layer_p):
+                def body(carry, xs):
+                    layer_p, tab = xs
                     out, _, aux = block(
-                        layer_p, carry, sin, cos, segment_ids, None, None
+                        layer_p, carry, sin, cos, segment_ids, None,
+                        None, lora_slice=(
+                            (tab, lora_rows) if tab is not None else None
+                        ),
                     )
                     return out, aux
 
-                h, auxes = jax.lax.scan(body, h, p["blocks"])
+                h, auxes = jax.lax.scan(body, h, (p["blocks"], lora_tabs))
             new_cache = None
         else:
             if return_aux:
@@ -853,28 +922,32 @@ class Transformer(Module):
                 # read in place and the scan stays.)
                 def body(carry, xs):
                     hh, pool = carry
-                    layer_p, li = xs
+                    layer_p, li, tab = xs
                     out, pool, aux = block(
                         layer_p, hh, sin, cos, None, pool, cache_index,
-                        kv_mask, page_table, li,
+                        kv_mask, page_table, li, lora_slice=(
+                            (tab, lora_rows) if tab is not None else None
+                        ),
                     )
                     return (out, pool), aux
 
                 (h, new_cache), auxes = jax.lax.scan(
                     body, (h, cache),
-                    (p["blocks"], jnp.arange(cfg.n_layers)),
+                    (p["blocks"], jnp.arange(cfg.n_layers), lora_tabs),
                 )
             else:
                 def body(carry, xs):
-                    layer_p, cache_slice = xs
+                    layer_p, cache_slice, tab = xs
                     out, new_slice, aux = block(
                         layer_p, carry, sin, cos, None, cache_slice,
-                        cache_index, kv_mask, page_table,
+                        cache_index, kv_mask, page_table, lora_slice=(
+                            (tab, lora_rows) if tab is not None else None
+                        ),
                     )
                     return out, (new_slice, aux)
 
                 h, (new_cache, auxes) = jax.lax.scan(
-                    body, h, (p["blocks"], cache)
+                    body, h, (p["blocks"], cache, lora_tabs)
                 )
 
         h = rms_norm(h, p["final_norm"], eps=cfg.norm_eps)

@@ -1,0 +1,384 @@
+"""Multi-LoRA serving: per-request adapters in one batch.
+
+Pinned properties:
+  * MERGED-WEIGHTS PARITY — the defining contract: a mixed batch
+    (adapter 1, adapter 2, no adapter) produces, row for row, exactly
+    what three separate engines serving the per-adapter MERGED weights
+    (train.lora merge: W + alpha/r * A*B) produce — dense, paged, and
+    decode_chunk>1;
+  * the same parity through the chunked-prefill + preemption paths
+    (re-admission restores the slot's adapter row);
+  * per-request isolation: the no-adapter row equals the plain engine
+    bit for bit;
+  * FFN targets (w_gate/w_up/w_down) compose on dense-FFN configs and
+    are refused on MoE configs;
+  * validation: unknown adapter ids, capacity, shape/rank mismatches,
+    adapter without lora config, speculative engines refuse the flag.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from shifu_tpu.infer import LoraServingConfig, SampleConfig
+from shifu_tpu.infer.engine import Engine, PagedEngine
+from shifu_tpu.models import Transformer, TransformerConfig
+from shifu_tpu.train import LoraConfig, LoraModel
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    model = Transformer(TransformerConfig.tiny())
+    return model, model.init(jax.random.key(0))
+
+
+def _adapters(model, params, seed, targets=("wq", "wk", "wv", "wo"),
+              rank=4, alpha=8.0):
+    """Two random NON-ZERO adapters in the train-side format, plus the
+    LoraModel used to merge them (the reference path)."""
+    lcfg = LoraConfig(rank=rank, alpha=alpha, targets=targets)
+    lm = LoraModel(model, params, lcfg)
+    out = []
+    for s in (seed, seed + 1):
+        lp = lm.init(jax.random.key(s))
+        # b is zero-initialised (identity); give it real values so the
+        # adapters actually change the decode.
+        lp = jax.tree_util.tree_map(
+            lambda x: x + 0.02 * jax.random.normal(
+                jax.random.key(s + 7), x.shape, x.dtype
+            ),
+            lp,
+        )
+        out.append(lp)
+    return lm, lcfg, out
+
+
+def _run(eng, jobs, max_new=8):
+    rids = [eng.submit(p, max_new_tokens=max_new, **kw) for p, kw in jobs]
+    done = {c.rid: c for c in eng.run()}
+    return [done[r].tokens for r in rids]
+
+
+def _prompts(seed, sizes):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(1, 256, size=n).tolist() for n in sizes]
+
+
+def _merged_reference(model, lm, lora_params, prompts, max_new, kw):
+    """Per-adapter merged-weights engines — the ground truth."""
+    outs = []
+    for lp, prompt in zip(lora_params, prompts):
+        merged = lm.merge(lp) if lp is not None else lm.base_params
+        eng = Engine(model, merged, **kw)
+        outs.append(_run(eng, [(prompt, {})], max_new)[0])
+    return outs
+
+
+def test_mixed_batch_matches_merged_weights(tiny):
+    model, params = tiny
+    lm, lcfg, (lp1, lp2) = _adapters(model, params, seed=3)
+    kw = dict(max_slots=3, max_len=48, prefill_buckets=(16, 48),
+              sample_cfg=SampleConfig(temperature=0.0))
+    prompts = _prompts(0, (5, 9, 7))
+    want = _merged_reference(
+        model, lm, [lp1, lp2, None], prompts, 8, kw
+    )
+
+    scfg = LoraServingConfig(
+        rank=lcfg.rank, alpha=lcfg.alpha, targets=lcfg.targets,
+        max_adapters=4,
+    )
+    for build in (
+        lambda: Engine(model, params, lora=scfg, **kw),
+        lambda: PagedEngine(model, params, page_size=8, lora=scfg, **kw),
+        lambda: PagedEngine(
+            model, params, page_size=8, decode_chunk=4, lora=scfg, **kw
+        ),
+    ):
+        eng = build()
+        a1 = eng.add_adapter(lp1)
+        a2 = eng.add_adapter(lp2)
+        got = _run(eng, [
+            (prompts[0], {"adapter": a1}),
+            (prompts[1], {"adapter": a2}),
+            (prompts[2], {}),
+        ], 8)
+        for i in range(3):
+            assert got[i] == want[i], (type(eng).__name__, i)
+
+
+def test_no_adapter_row_matches_plain_engine(tiny):
+    model, params = tiny
+    lm, lcfg, (lp1, _) = _adapters(model, params, seed=5)
+    kw = dict(max_slots=2, max_len=48, prefill_buckets=(16, 48),
+              sample_cfg=SampleConfig(temperature=0.0))
+    prompts = _prompts(1, (6, 6))
+    plain = _run(
+        PagedEngine(model, params, page_size=8, **kw),
+        [(prompts[1], {})], 8,
+    )[0]
+    eng = PagedEngine(
+        model, params, page_size=8,
+        lora=LoraServingConfig(rank=lcfg.rank, alpha=lcfg.alpha), **kw,
+    )
+    a1 = eng.add_adapter(lp1)
+    got = _run(eng, [(prompts[0], {"adapter": a1}), (prompts[1], {})], 8)
+    assert got[1] == plain
+
+
+def test_preemption_recompute_restores_adapter(tiny):
+    """Pool pressure forces a preemption mid-decode: the re-admission
+    must restore the victim's adapter row or the replayed prefix
+    decodes with the wrong weights."""
+    model, params = tiny
+    lm, lcfg, (lp1, lp2) = _adapters(model, params, seed=9)
+    scfg = LoraServingConfig(rank=lcfg.rank, alpha=lcfg.alpha)
+    kw = dict(max_slots=2, max_len=16, prefill_buckets=(8, 16),
+              sample_cfg=SampleConfig(temperature=0.0))
+    prompts = _prompts(2, (5, 5))
+
+    def serve(n_pages):
+        eng = PagedEngine(
+            model, params, page_size=4, n_pages=n_pages, lora=scfg, **kw
+        )
+        a1, a2 = eng.add_adapter(lp1), eng.add_adapter(lp2)
+        return eng, _run(eng, [
+            (prompts[0], {"adapter": a1}),
+            (prompts[1], {"adapter": a2}),
+        ], 8)
+
+    _, roomy = serve(None)
+    tight_eng, tight = serve(6)
+    assert tight_eng.preemptions >= 1
+    assert tight == roomy
+
+
+def test_ffn_targets_dense_and_moe_guard(tiny):
+    model, params = tiny
+    targets = ("wq", "wo", "w_gate", "w_up", "w_down")
+    lm, lcfg, (lp1, _) = _adapters(model, params, seed=11, targets=targets)
+    kw = dict(max_slots=2, max_len=48, prefill_buckets=(16, 48),
+              sample_cfg=SampleConfig(temperature=0.0))
+    prompts = _prompts(3, (7, 6))
+    want = _merged_reference(model, lm, [lp1, None], prompts, 8, kw)
+    eng = Engine(
+        model, params,
+        lora=LoraServingConfig(
+            rank=lcfg.rank, alpha=lcfg.alpha, targets=targets
+        ),
+        **kw,
+    )
+    a1 = eng.add_adapter(lp1)
+    got = _run(eng, [(prompts[0], {"adapter": a1}), (prompts[1], {})], 8)
+    assert got == want
+
+    moe = Transformer(TransformerConfig.tiny_moe())
+    with pytest.raises(NotImplementedError, match="MoE"):
+        Engine(
+            moe, moe.init(jax.random.key(0)),
+            lora=LoraServingConfig(targets=targets),
+            max_slots=1, max_len=32, prefill_buckets=(16, 32),
+        )
+
+
+def test_validation(tiny):
+    model, params = tiny
+    lm, lcfg, (lp1, lp2) = _adapters(model, params, seed=13)
+    kw = dict(max_slots=1, max_len=32, prefill_buckets=(16, 32))
+    plain = Engine(model, params, **kw)
+    with pytest.raises(ValueError, match="LoraServingConfig"):
+        plain.submit([1, 2, 3], max_new_tokens=2, adapter=1)
+    with pytest.raises(ValueError, match="LoraServingConfig"):
+        plain.add_adapter(lp1)
+
+    eng = Engine(
+        model, params,
+        lora=LoraServingConfig(
+            rank=lcfg.rank, alpha=lcfg.alpha, max_adapters=1
+        ),
+        **kw,
+    )
+    with pytest.raises(ValueError, match="unknown adapter"):
+        eng.submit([1, 2, 3], max_new_tokens=2, adapter=1)
+    eng.add_adapter(lp1)
+    with pytest.raises(ValueError, match="capacity"):
+        eng.add_adapter(lp2)
+    # Rank mismatch between trained factors and the serving config.
+    eng2 = Engine(
+        model, params, lora=LoraServingConfig(rank=lcfg.rank + 2), **kw
+    )
+    with pytest.raises(ValueError, match="rank/targets"):
+        eng2.add_adapter(lp1)
+
+    with pytest.raises(ValueError, match="unknown lora targets"):
+        LoraServingConfig(targets=("wq", "nope"))
+
+    from shifu_tpu.infer import PromptLookupPagedEngine
+
+    with pytest.raises(NotImplementedError, match="LoRA"):
+        PromptLookupPagedEngine(
+            model, params, page_size=8,
+            lora=LoraServingConfig(), max_slots=1, max_len=32,
+            prefill_buckets=(16, 32),
+        )
+
+
+def test_server_adapter_field(tiny):
+    """The "adapter" request field reaches the engine; responses match
+    the merged-weights reference; bad ids 400; best_of refuses it."""
+    import json
+    import threading
+    import urllib.error
+    import urllib.request
+
+    from shifu_tpu.infer.server import make_server
+
+    model, params = tiny
+    lm, lcfg, (lp1, _) = _adapters(model, params, seed=17)
+    kw = dict(max_slots=2, max_len=64, prefill_buckets=(32, 64),
+              sample_cfg=SampleConfig(temperature=0.0))
+    want = _merged_reference(
+        model, lm, [lp1], [_prompts(4, (6,))[0]], 6, kw
+    )[0]
+
+    eng = PagedEngine(
+        model, params, page_size=8,
+        lora=LoraServingConfig(rank=lcfg.rank, alpha=lcfg.alpha), **kw,
+    )
+    a1 = eng.add_adapter(lp1)
+    server = make_server(eng, host="127.0.0.1", port=0)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    base = f"http://127.0.0.1:{server.server_port}"
+
+    def post(body):
+        req = urllib.request.Request(
+            base + "/v1/completions", json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=60) as r:
+                return r.status, json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    try:
+        status, out = post({
+            "tokens": _prompts(4, (6,))[0], "max_new_tokens": 6,
+            "adapter": a1,
+        })
+        assert status == 200 and out["tokens"] == want
+        status, _ = post({
+            "tokens": [1, 2, 3], "max_new_tokens": 2, "adapter": 99,
+        })
+        assert status == 400
+        status, _ = post({
+            "tokens": [1, 2, 3], "max_new_tokens": 2, "adapter": "x",
+        })
+        assert status == 400
+        status, _ = post({
+            "tokens": [1, 2, 3], "max_new_tokens": 2, "best_of": 2,
+            "adapter": a1,
+        })
+        assert status == 400
+    finally:
+        server.shutdown()
+        server.runner.shutdown()
+        t.join(5)
+
+
+def test_cli_lora_flags(tiny, tmp_path):
+    """build_serve_engine loads --lora-ckpt-dir checkpoints (ids in
+    flag order) and refuses the flag with --spec."""
+    import argparse
+
+    from shifu_tpu.checkpoint import Checkpointer
+    from shifu_tpu.cli import build_serve_engine
+    from shifu_tpu.data.tokenizer import ByteTokenizer
+    from shifu_tpu.train import AdamW, TrainState, constant
+
+    model, params = tiny
+    lm, lcfg, (lp1, _) = _adapters(model, params, seed=21)
+    ck = str(tmp_path / "adapter1")
+    ckpt = Checkpointer(ck)
+    try:
+        ckpt.save(0, TrainState.create(lp1, AdamW(constant(1e-3))),
+                  force=True)
+        ckpt.wait()
+    finally:
+        ckpt.close()
+
+    base = dict(
+        family="transformer", preset="tiny", moe_experts=0, attn=None,
+        optimizer="adamw", schedule="constant", lr=3e-4, warmup=0,
+        ckpt_dir=None, seed=0, tokenizer=None, host="127.0.0.1", port=0,
+        max_slots=2, max_len=64, max_new_tokens=8, temperature=0.0,
+        top_p=0.95, decode_chunk=1, eos_id=-1, paged=True, page_size=8,
+        n_pages=None, prefix_cache=False, per_request_sampling=False,
+        penalties=False, logit_bias=False, spec="off", spec_k=3,
+        spec_ngram=2, spec_rounds=2, draft_preset=None,
+        draft_ckpt_dir=None, lora_ckpt_dir=[ck], lora_rank=lcfg.rank,
+        lora_alpha=lcfg.alpha, lora_targets=",".join(lcfg.targets),
+    )
+    eng = build_serve_engine(
+        argparse.Namespace(**base), model, params, ByteTokenizer()
+    )
+    assert eng._n_adapters == 1
+    prompt = _prompts(5, (6,))[0]
+    want = _merged_reference(
+        model, lm, [lp1],
+        [prompt], 6,
+        dict(max_slots=2, max_len=64, prefill_buckets=(32, 64),
+             sample_cfg=SampleConfig(temperature=0.0)),
+    )[0]
+    rid = eng.submit(prompt, max_new_tokens=6, adapter=1)
+    got = {c.rid: c for c in eng.run()}[rid].tokens
+    assert got == want
+
+    with pytest.raises(ValueError, match="compose"):
+        build_serve_engine(
+            argparse.Namespace(**{**base, "spec": "prompt-lookup"}),
+            model, params, ByteTokenizer(),
+        )
+
+
+def test_prefix_cache_partitions_by_adapter(tiny):
+    """Prefix-cached K/V bakes in the donor's wk/wv deltas, so reuse
+    is only sound within one adapter: the chain key is salted by
+    adapter id, and a same-prompt request under a different adapter
+    (or none) must decode exactly like a cache-cold engine — not
+    attend against the donor's pages."""
+    model, params = tiny
+    lm, lcfg, (lp1, _) = _adapters(model, params, seed=25)
+    scfg = LoraServingConfig(rank=lcfg.rank, alpha=lcfg.alpha)
+    kw = dict(max_slots=2, max_len=64, prefill_buckets=(16, 64),
+              sample_cfg=SampleConfig(temperature=0.0))
+    prompt = _prompts(6, (24,))[0]  # 3 full pages of shareable prefix
+
+    # Cold references (no prefix cache anywhere).
+    cold = PagedEngine(model, params, page_size=8, lora=scfg, **kw)
+    a1 = cold.add_adapter(lp1)
+    want_base = _run(cold, [(prompt, {})], 6)[0]
+    cold2 = PagedEngine(model, params, page_size=8, lora=scfg, **kw)
+    a1c = cold2.add_adapter(lp1)
+    want_ad = _run(cold2, [(prompt, {"adapter": a1c})], 6)[0]
+
+    eng = PagedEngine(
+        model, params, page_size=8, lora=scfg,
+        enable_prefix_cache=True, **kw,
+    )
+    aid = eng.add_adapter(lp1)
+    # Adapter request donates its pages first...
+    got_ad = _run(eng, [(prompt, {"adapter": aid})], 6)[0]
+    assert got_ad == want_ad
+    # ...then a base request with the SAME prompt must NOT hit them.
+    before = eng.prefix_hits_tokens
+    got_base = _run(eng, [(prompt, {})], 6)[0]
+    assert got_base == want_base
+    assert eng.prefix_hits_tokens == before  # no cross-adapter hit
+    # Same adapter re-requesting DOES hit, and stays exact.
+    got_ad2 = _run(eng, [(prompt, {"adapter": aid})], 6)[0]
+    assert got_ad2 == want_ad
+    assert eng.prefix_hits_tokens > before
