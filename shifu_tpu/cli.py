@@ -639,7 +639,7 @@ def build_serve_engine(args, model, params, tok):
             targets=tuple(
                 t.strip() for t in args.lora_targets.split(",") if t.strip()
             ),
-            max_adapters=max(len(lora_dirs), 1),
+            max_adapters=len(lora_dirs),
         )
         kw["lora"] = lora_cfg
 

@@ -797,7 +797,6 @@ class Engine:
         lengths, cache).
         """
         lead, samp, pen, bias, lora, rng = self._split_extra(rest)
-        lora_t = lora if lora is None else tuple(lora)
         k = self.decode_chunk
         eos = self.eos_id
         counts0 = pen[0] if pen else None
@@ -811,7 +810,7 @@ class Engine:
             # each step unchanged, unlike the counts carry.
             res = self._decode_impl(
                 params, cache, cur, lengths, live, *lead, *samp, *pen_t,
-                *bias, *(lora_t if lora_t else ()),
+                *bias, *(lora or ()),
                 jax.random.fold_in(rng, t),
             )
             if pen:

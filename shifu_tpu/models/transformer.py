@@ -80,6 +80,16 @@ class TransformerConfig:
     # does not fit (the 1.2B bench case). "dots_flash" combines both
     # (fastest backward, largest residency).
     remat_policy: str = "dots"
+    # int8-KV pools only: run the paged-decode kernel's QK score as an
+    # s8 x s8 -> s32 MXU dot (q quantized per row, scales applied after
+    # the dot) instead of casting K to bf16 in-kernel. BUILT AND
+    # MEASURED INERT on v5e at the bench mix (4.71 vs 4.74 ms/step
+    # chip-true): the int8->bf16 cast the dot removes was never the
+    # int8-KV leg's cost — the per-lane scale streams are (see
+    # STATUS.md Known gaps). Default OFF: it adds ~1/127-relative
+    # q-rounding error for no measured speed. Top-1 agreement and
+    # error bounds are test-pinned either way (tests/test_kv_quant.py).
+    int8_qk_dot: bool = False
     # -- mixture of experts (0 experts = dense FFN in every block) ----------
     n_experts: int = 0
     moe_top_k: int = 2
@@ -425,6 +435,18 @@ class Transformer(Module):
 
         x = rms_norm(h, p["mlp_norm"], eps=cfg.norm_eps)
         if cfg.n_experts:
+            if lora_slice is not None and (
+                set(lora_slice[0]) & {"w_gate", "w_up", "w_down"}
+            ):
+                # Guard at the seam where the drop would happen: the
+                # expert dispatch/combine path has no per-row delta
+                # hook, so FFN adapter tables here would be silently
+                # ignored. (The serving engine refuses this combination
+                # earlier with a friendlier message.)
+                raise NotImplementedError(
+                    "FFN lora targets on an MoE config are not applied "
+                    "by the expert path"
+                )
             down, moe_aux = self._moe_ffn(p, x)
         else:
             gate = jnp.einsum("bsd,dm->bsm", x, p["w_gate"])
@@ -561,6 +583,7 @@ class Transformer(Module):
                     window=self.cfg.window_size, kv_mask=kv_mask,
                     k_scale=csk if quantized else None,
                     v_scale=csv if quantized else None,
+                    int8_qk=quantized and self.cfg.int8_qk_dot,
                 )
             else:
                 gk = ck[li, page_table]
@@ -676,6 +699,7 @@ class Transformer(Module):
                     window=self.cfg.window_size, kv_mask=kv_mask,
                     k_scale=csk if quantized else None,
                     v_scale=csv if quantized else None,
+                    int8_qk=quantized and self.cfg.int8_qk_dot,
                 )[:, None]
             else:
                 # Gather each row's pages into its logical view with ONE

@@ -76,6 +76,7 @@ _MASK_FLOOR = -1e30
 
 def _decode_kernel(
     scale, window, n_kv, group, unroll, ps, has_mask, has_scale, heads,
+    int8_qk,
     *refs,
 ):
     """One (row, page-group) grid step: U pages against all query rows.
@@ -108,9 +109,15 @@ def _decode_kernel(
     """
     len_ref = refs[1]
     q_ref = refs[3]
-    k_refs = refs[4 : 4 + unroll]
-    v_refs = refs[4 + unroll : 4 + 2 * unroll]
-    at = 4 + 2 * unroll
+    at = 4
+    if int8_qk:
+        qs_ref = refs[at]  # (1, rows, 1) per-row q scales
+        at += 1
+    else:
+        qs_ref = None
+    k_refs = refs[at : at + unroll]
+    v_refs = refs[at + unroll : at + 2 * unroll]
+    at = at + 2 * unroll
     if has_scale:
         ks_ref, vs_ref = refs[at], refs[at + 1]
         at += 2
@@ -153,14 +160,26 @@ def _decode_kernel(
         base = (j * unroll + u) * ps
         k = k_refs[u][0, 0]  # (ps*kv, hd) — pool pre-flattened by wrapper
         v = v_refs[u][0, 0]
-        if has_scale:
-            # int8 -> q.dtype is exact (|values| <= 127); the per-lane
-            # scale rides the SCORE, not a dequantized K copy.
-            k = k.astype(q.dtype)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale  # (qw*heads, ps*kv)
+        if int8_qk:
+            # s8 x s8 -> s32 on the MXU (v5e-native): q was quantized
+            # per row by the wrapper, so the score is
+            # (q_i8 . k_i8) * q_scale[row] * k_scale[lane] * sm_scale —
+            # no int8->bf16 K cast anywhere in the kernel.
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.int32,
+            ).astype(jnp.float32) * scale
+            s = s * qs_ref[0]  # (rows, 1) broadcast
+        else:
+            if has_scale:
+                # int8 -> q.dtype is exact (|values| <= 127); the
+                # per-lane scale rides the SCORE, not a dequantized K
+                # copy.
+                k = k.astype(q.dtype)
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale  # (qw*heads, ps*kv)
         if has_scale:
             s = s * ks_ref[0, 0, u * lanes : (u + 1) * lanes][None, :]
         pos = base + lane_pos
@@ -182,9 +201,12 @@ def _decode_kernel(
         if has_scale:
             # Fold the per-lane value scale into p (masked lanes are
             # exactly 0, so garbage scales on dead lanes are inert).
+            # With int8_qk the q block is int8 — the PV dot still runs
+            # in the output dtype (o_ref's), never integer.
+            pv_dtype = o_ref.dtype if int8_qk else q.dtype
             vsl = vs_ref[0, 0, u * lanes : (u + 1) * lanes]
-            pv = (p * vsl[None, :]).astype(q.dtype)
-            vv = v.astype(q.dtype)
+            pv = (p * vsl[None, :]).astype(pv_dtype)
+            vv = v.astype(pv_dtype)
         else:
             pv = p.astype(v.dtype)
             vv = v
@@ -218,6 +240,7 @@ def paged_decode_attention(
     kv_mask: Optional[jax.Array] = None,
     k_scale: Optional[jax.Array] = None,
     v_scale: Optional[jax.Array] = None,
+    int8_qk: bool = False,
     pages_per_step: Optional[int] = None,
     interpret: Optional[bool] = None,
 ):
@@ -266,6 +289,15 @@ def paged_decode_attention(
         slot-logical layout was built and ran SLOWER (transpose +
         per-write mirror materialisation; see
         models/transformer.py _paged_block_attention).
+      int8_qk: quantize q per ROW (scale = max|q|/127) and run the QK
+        score as an s8 x s8 -> s32 MXU dot, the per-row q scale applied
+        after — removes the kernel's int8->bf16 K cast entirely.
+        Requires an int8 pool (k_scale/v_scale). Adds q-rounding error
+        ~1/127 relative per component on top of the pool's own
+        quantization; exactness tests pin the bound and engine top-1
+        agreement. Off by default at this seam (the tight
+        kernel==dequant-reference parity tests use bf16 QK); the model
+        layer opts in for int8 pools (TransformerConfig.int8_qk_dot).
       pages_per_step: pages fetched per grid step (DMA/compute grain).
         Default: adaptive, ~512 tokens per grid group — grid-step fixed
         costs (DMA issue, scalar work, MXU ramp on tiny dots) dominate
@@ -288,6 +320,15 @@ def paged_decode_attention(
         qw, chunked = 1, False
     rows = qw * n_heads
     q = q.reshape(b, rows, hd)
+    out_dtype = q.dtype
+    if int8_qk:
+        if k_scale is None:
+            raise ValueError("int8_qk needs an int8 pool (k_scale/v_scale)")
+        qf = q.astype(jnp.float32)
+        q_scales = jnp.maximum(
+            jnp.max(jnp.abs(qf), axis=-1, keepdims=True), 1e-30
+        ) / 127.0  # (b, rows, 1)
+        q = jnp.round(qf / q_scales).astype(jnp.int8)
     if layer is not None:
         n_layers, n_pages, ps, n_kv, _ = k_pool.shape
     else:
@@ -348,10 +389,19 @@ def paged_decode_attention(
     ]
     in_specs = (
         [pl.BlockSpec((1, rows, hd), lambda ib, j, t, l, li: (ib, 0, 0))]
+        + (
+            [pl.BlockSpec((1, rows, 1), lambda ib, j, t, l, li: (ib, 0, 0))]
+            if int8_qk else []
+        )
         + kv_spec
         + kv_spec
     )
-    inputs = [q] + [k_flat] * unroll + [v_flat] * unroll
+    inputs = (
+        [q]
+        + ([q_scales] if int8_qk else [])
+        + [k_flat] * unroll
+        + [v_flat] * unroll
+    )
     has_scale = k_scale is not None
     if has_scale != (v_scale is not None):
         raise ValueError("pass both k_scale and v_scale or neither")
@@ -416,10 +466,10 @@ def paged_decode_attention(
     out = pl.pallas_call(
         functools.partial(
             _decode_kernel, scale, window, n_kv, group, unroll, ps,
-            has_mask, has_scale, n_heads,
+            has_mask, has_scale, n_heads, int8_qk,
         ),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, rows, hd), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((b, rows, hd), out_dtype),
         interpret=interpret,
     )(table, lengths, li_arr, *inputs)
     return out.reshape(b, qw, n_heads, hd) if chunked else out

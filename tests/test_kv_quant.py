@@ -140,9 +140,12 @@ def _engine_tokens(model, params, prompts, max_new, **kw):
 
 def test_paged_engine_int8_flash_matches_int8_xla():
     """Kernel path vs XLA gather path on the SAME int8 pool semantics:
-    greedy tokens must match exactly (both dequantize the same data)."""
+    greedy tokens must match exactly (both dequantize the same data).
+    int8_qk_dot off — this parity is about the dequant plumbing; the
+    int8 QK dot adds q-rounding the XLA path does not have (its own
+    bound + top-1 tests below)."""
     cfg_x = TransformerConfig.tiny()
-    cfg_f = TransformerConfig.tiny(attn_impl="flash")
+    cfg_f = TransformerConfig.tiny(attn_impl="flash", int8_qk_dot=False)
     model_x, model_f = Transformer(cfg_x), Transformer(cfg_f)
     params = model_x.init(jax.random.key(0))
 
@@ -239,3 +242,62 @@ def test_paged_cache_int8_leaves():
     assert pool["k"].dtype == jnp.int8
     assert pool["k_scale"].shape == pool["k"].shape[:-1]
     assert bool(jnp.all(pool["v_scale"] == 1.0))
+
+
+# ------------------------------------------------------- int8 QK dot
+
+
+def test_kernel_int8_qk_error_bounded():
+    """int8_qk (s8 x s8 -> s32 QK dot, per-row q scales after): against
+    the full-precision reference the added error is q's ~1/127-relative
+    rounding on top of the pool's — still a few 1e-2 on standard-normal
+    data."""
+    _, q, pk, pv, table, lengths = _setup(seed=8)
+    qk, sk, qv, sv = _quantize_pools(pk, pv)
+    out = paged_decode_attention(
+        q, qk, qv, table, lengths, k_scale=sk, v_scale=sv,
+        int8_qk=True, interpret=True,
+    )
+    ref = _reference(q, pk, pv, table, lengths)
+    err = np.max(np.abs(np.asarray(out) - np.asarray(ref)))
+    assert err < 0.08, err
+
+
+def test_kernel_int8_qk_multi_query_and_window():
+    """The multi-query (speculative-verify) shape and sliding windows
+    ride the int8 QK dot too, within the same bound."""
+    _, q, pk, pv, table, lengths = _setup(seed=9)
+    qk, sk, qv, sv = _quantize_pools(pk, pv)
+    out = paged_decode_attention(
+        q, qk, qv, table, lengths, k_scale=sk, v_scale=sv,
+        int8_qk=True, window=40, interpret=True,
+    )
+    ref = _reference(q, pk, pv, table, lengths, window=40)
+    assert np.max(np.abs(np.asarray(out) - np.asarray(ref))) < 0.08
+
+
+def test_kernel_int8_qk_requires_int8_pool():
+    _, q, pk, pv, table, lengths = _setup(seed=10)
+    with pytest.raises(ValueError, match="int8_qk"):
+        paged_decode_attention(
+            q, pk, pv, table, lengths, int8_qk=True, interpret=True
+        )
+
+
+def test_paged_engine_int8_qk_top1_tracks_bf16():
+    """With the int8 QK dot opted in, greedy decode still tracks the
+    bf16 engine token for token on a short horizon. (The dot measured
+    INERT on v5e — the scale streams, not the cast, are the int8-KV
+    kernel's cost — so it defaults OFF; the mode stays correct and
+    available for hardware where an integer QK path pays.)"""
+    cfg = TransformerConfig.tiny(attn_impl="flash", int8_qk_dot=True)
+    model = Transformer(cfg)
+    params = model.init(jax.random.key(3))
+    rng = np.random.RandomState(12)
+    prompts = [rng.randint(1, 256, size=9).tolist()]
+    kw = dict(max_slots=1, max_len=32, page_size=8, prefill_buckets=(16, 32))
+    bf = _engine_tokens(model, params, prompts, 4, **kw)
+    q8 = _engine_tokens(
+        model, params, prompts, 4, cache_dtype=jnp.int8, **kw
+    )
+    np.testing.assert_array_equal(bf[0], q8[0])
