@@ -627,6 +627,9 @@ def build_serve_engine(args, model, params, tok):
         ),
         enable_penalties=args.penalties,
         enable_logit_bias=args.logit_bias,
+        # The engine's own tokenizer: string stop sequences and regex
+        # constraints decode/lift tokens inside the engine loop.
+        tokenizer=tok,
     )
     lora_cfg = None
     lora_dirs = getattr(args, "lora_ckpt_dir", None) or []

@@ -20,6 +20,7 @@ from shifu_tpu.infer.spec_engine import (
     SpeculativePagedEngine,
     prompt_lookup_propose,
 )
+from shifu_tpu.infer.constrain import ByteDFA, TokenFSM, compile_regex
 from shifu_tpu.infer.server import EngineRunner, make_server
 from shifu_tpu.infer.speculative import (
     SpecResult,
@@ -41,6 +42,9 @@ __all__ = [
     "make_beam_search_fn",
     "make_generate_fn",
     "Completion",
+    "ByteDFA",
+    "TokenFSM",
+    "compile_regex",
     "SpecResult",
     "make_speculative_batch_fns",
     "speculative_generate",

@@ -1,0 +1,464 @@
+"""FSM-constrained decoding: regex -> byte DFA -> per-step token masks.
+
+The missing piece above ``logit_bias``/``allowed_token_ids``: constrain
+a GENERATION to a regular language (ids, enum values, JSON-ish shapes)
+so the sampler can only ever pick tokens that keep the output valid.
+
+Pipeline:
+
+  1. :func:`compile_regex` — a self-contained regex compiler (no
+     dependency on ``re``'s internals): pattern -> Thompson NFA ->
+     subset-construction DFA over BYTES. Supported syntax: literals,
+     escapes (``\\d \\w \\s \\. ...``), ``.``, character classes
+     ``[a-z0-9_]`` / ``[^...]``, grouping ``( )``, alternation ``|``,
+     quantifiers ``* + ? {m} {m,} {m,n}``. Anchoring is implicit: the
+     WHOLE generation must match (the serving semantics people expect
+     from "constrain the output to this pattern").
+  2. :class:`TokenFSM` — lifts the byte DFA to the TOKENIZER's
+     alphabet: in DFA state s, token t is allowed iff feeding t's
+     UTF-8 bytes keeps the DFA out of the dead state; the per-state
+     (vocab,) allow-mask and (vocab,) next-state table are computed
+     LAZILY and cached — a decode visits a handful of DFA states, so
+     the full states x vocab product never materialises.
+  3. The engines keep one FSM state per constrained slot on the HOST,
+     advance it on each emitted token, and write the next mask into
+     the device bias buffer row (the same constrained-decoding seam
+     ``allowed_token_ids`` uses — one (vocab,) row write per token).
+     EOS is allowed exactly in ACCEPTING states, so a constrained
+     request can only finish on a complete match (or its budget).
+
+TPU-first notes: the device program never changes — constraints ride
+the existing per-slot additive-bias buffer, so one compiled decode
+program serves constrained and free rows together. The FSM advance is
+host-side and token-at-a-time, which requires ``decode_chunk == 1``
+for constrained traffic (the host must see token N before it can mask
+token N+1); the engine enforces that loudly rather than silently
+weakening the constraint.
+
+Reference parity note: the upstream reference (klyan/shifu) is an
+empty repository (SURVEY.md); there is no reference implementation.
+The approach is the published FSM-constrained-decoding idea
+(Willard & Louf's Outlines, vLLM's guided decoding), re-derived.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, FrozenSet, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+# ------------------------------------------------------------- regex -> NFA
+
+_DIGITS = frozenset(range(ord("0"), ord("9") + 1))
+_WORD = frozenset(
+    list(range(ord("a"), ord("z") + 1))
+    + list(range(ord("A"), ord("Z") + 1))
+    + list(range(ord("0"), ord("9") + 1))
+    + [ord("_")]
+)
+_SPACE = frozenset(map(ord, " \t\n\r\f\v"))
+_ANY = frozenset(range(256))  # '.' spans everything (DOTALL — generated
+# text may contain newlines; a serving constraint that silently forbade
+# them would surprise)
+
+_ESCAPES = {
+    "d": _DIGITS,
+    "D": _ANY - _DIGITS,
+    "w": _WORD,
+    "W": _ANY - _WORD,
+    "s": _SPACE,
+    "S": _ANY - _SPACE,
+    "n": frozenset([10]),
+    "t": frozenset([9]),
+    "r": frozenset([13]),
+}
+
+
+class _Parser:
+    """Recursive-descent regex parser producing an AST of tuples:
+    ("lit", charset) | ("cat", [..]) | ("alt", [..]) |
+    ("rep", node, lo, hi|None)."""
+
+    def __init__(self, pattern: str):
+        self.p = pattern
+        self.i = 0
+
+    def error(self, msg: str):
+        raise ValueError(
+            f"regex error at position {self.i} in {self.p!r}: {msg}"
+        )
+
+    def peek(self) -> Optional[str]:
+        return self.p[self.i] if self.i < len(self.p) else None
+
+    def next(self) -> str:
+        c = self.peek()
+        if c is None:
+            self.error("unexpected end")
+        self.i += 1
+        return c
+
+    def parse(self):
+        node = self.alt()
+        if self.i != len(self.p):
+            self.error(f"unexpected {self.peek()!r}")
+        return node
+
+    def alt(self):
+        branches = [self.cat()]
+        while self.peek() == "|":
+            self.next()
+            branches.append(self.cat())
+        return branches[0] if len(branches) == 1 else ("alt", branches)
+
+    def cat(self):
+        parts = []
+        while self.peek() not in (None, "|", ")"):
+            parts.append(self.repeat())
+        if not parts:
+            return ("cat", [])  # empty branch: matches ""
+        return parts[0] if len(parts) == 1 else ("cat", parts)
+
+    def repeat(self):
+        node = self.atom()
+        while True:
+            c = self.peek()
+            if c == "*":
+                self.next()
+                node = ("rep", node, 0, None)
+            elif c == "+":
+                self.next()
+                node = ("rep", node, 1, None)
+            elif c == "?":
+                self.next()
+                node = ("rep", node, 0, 1)
+            elif c == "{":
+                save = self.i
+                self.next()
+                digits = ""
+                while self.peek() is not None and self.peek().isdigit():
+                    digits += self.next()
+                if not digits:
+                    # Not a quantifier — treat '{' as a literal (the
+                    # common lenient convention).
+                    self.i = save
+                    break
+                lo = int(digits)
+                hi = lo
+                if self.peek() == ",":
+                    self.next()
+                    digits = ""
+                    while (
+                        self.peek() is not None and self.peek().isdigit()
+                    ):
+                        digits += self.next()
+                    hi = int(digits) if digits else None
+                if self.peek() != "}":
+                    self.i = save
+                    break
+                self.next()
+                if hi is not None and hi < lo:
+                    self.error(f"bad repeat bounds {{{lo},{hi}}}")
+                node = ("rep", node, lo, hi)
+            else:
+                break
+        return node
+
+    def atom(self):
+        c = self.next()
+        if c == "(":
+            node = self.alt()
+            if self.peek() != ")":
+                self.error("unclosed group")
+            self.next()
+            return node
+        if c == "[":
+            return ("lit", self.char_class())
+        if c == ".":
+            return ("lit", _ANY)
+        if c == "\\":
+            return ("lit", self.escape())
+        if c in ")|":
+            self.error(f"unexpected {c!r}")
+        if c in "*+?":
+            self.error(f"nothing to repeat before {c!r}")
+        return ("lit", frozenset(c.encode("utf-8")))
+
+    def escape(self) -> FrozenSet[int]:
+        c = self.next()
+        if c in _ESCAPES:
+            return _ESCAPES[c]
+        # Escaped literal (covers \. \\ \[ \{ \+ etc. and any byte).
+        return frozenset(c.encode("utf-8"))
+
+    def char_class(self) -> FrozenSet[int]:
+        negate = False
+        if self.peek() == "^":
+            self.next()
+            negate = True
+        chars: set = set()
+        first = True
+        while True:
+            c = self.peek()
+            if c is None:
+                self.error("unclosed character class")
+            if c == "]" and not first:
+                self.next()
+                break
+            first = False
+            self.next()
+            if c == "\\":
+                chars |= self.escape()
+                continue
+            start = c.encode("utf-8")
+            if len(start) == 1 and self.peek() == "-":
+                nxt = self.p[self.i + 1] if self.i + 1 < len(self.p) else None
+                if nxt is not None and nxt != "]":
+                    self.next()  # consume '-'
+                    end = self.next()
+                    eb = end.encode("utf-8")
+                    if len(eb) != 1 or eb[0] < start[0]:
+                        self.error(f"bad range {c}-{end}")
+                    chars |= set(range(start[0], eb[0] + 1))
+                    continue
+            chars |= set(start)
+        return frozenset(_ANY - chars) if negate else frozenset(chars)
+
+
+# NFA: states are ints; transitions: list of dict byte -> set(states);
+# eps: list of set(states).
+
+
+class _NFA:
+    def __init__(self):
+        self.trans: List[Dict[int, set]] = []
+        self.eps: List[set] = []
+
+    def state(self) -> int:
+        self.trans.append({})
+        self.eps.append(set())
+        return len(self.trans) - 1
+
+    def add(self, s: int, byte: int, t: int):
+        self.trans[s].setdefault(byte, set()).add(t)
+
+    def add_eps(self, s: int, t: int):
+        self.eps[s].add(t)
+
+
+def _build(nfa: _NFA, node) -> Tuple[int, int]:
+    """Thompson construction: returns (start, end) states."""
+    kind = node[0]
+    if kind == "lit":
+        s, e = nfa.state(), nfa.state()
+        for b in node[1]:
+            nfa.add(s, b, e)
+        return s, e
+    if kind == "cat":
+        s = e = nfa.state()
+        for part in node[1]:
+            ps, pe = _build(nfa, part)
+            nfa.add_eps(e, ps)
+            e = pe
+        return s, e
+    if kind == "alt":
+        s, e = nfa.state(), nfa.state()
+        for br in node[1]:
+            bs, be = _build(nfa, br)
+            nfa.add_eps(s, bs)
+            nfa.add_eps(be, e)
+        return s, e
+    if kind == "rep":
+        _, inner, lo, hi = node
+        s = e = nfa.state()
+        for _ in range(lo):  # mandatory copies
+            ps, pe = _build(nfa, inner)
+            nfa.add_eps(e, ps)
+            e = pe
+        if hi is None:  # unbounded tail: one looping optional copy
+            ps, pe = _build(nfa, inner)
+            ne = nfa.state()
+            nfa.add_eps(e, ps)   # enter the loop...
+            nfa.add_eps(pe, ps)  # ...repeat it...
+            nfa.add_eps(pe, ne)  # ...or leave after an iteration
+            nfa.add_eps(e, ne)   # or skip the tail entirely (lo copies done)
+            return s, ne
+        for _ in range((hi or 0) - lo):  # optional copies
+            ps, pe = _build(nfa, inner)
+            nfa.add_eps(e, ps)
+            ne = nfa.state()
+            nfa.add_eps(pe, ne)
+            nfa.add_eps(e, ne)  # skip
+            e = ne
+        return s, e
+    raise AssertionError(kind)
+
+
+@dataclasses.dataclass(frozen=True)
+class ByteDFA:
+    """Deterministic automaton over bytes. State 0 is the start;
+    ``dead`` marks the sink. ``table[s]`` maps byte -> next state (the
+    dead state when absent); ``accepting`` flags whole-match states."""
+
+    table: Tuple[Dict[int, int], ...]
+    accepting: Tuple[bool, ...]
+    dead: int = -1  # sentinel, not an index
+
+    def step(self, state: int, byte: int) -> int:
+        if state == self.dead:
+            return self.dead
+        return self.table[state].get(byte, self.dead)
+
+    def matches(self, data: bytes) -> bool:
+        s = 0
+        for b in data:
+            s = self.step(s, b)
+            if s == self.dead:
+                return False
+        return self.accepting[s]
+
+
+_MAX_DFA_STATES = 4096
+
+
+def compile_regex(pattern: str) -> ByteDFA:
+    """Pattern -> whole-match byte DFA (module docstring syntax).
+
+    Subset construction is exponential in the worst case; the state
+    count is capped (ValueError past ~4k states) so a hostile pattern
+    from the serving API costs bounded compile work and memory."""
+    ast = _Parser(pattern).parse()
+    nfa = _NFA()
+    start, end = _build(nfa, ast)
+
+    def closure(states: frozenset) -> frozenset:
+        out = set(states)
+        stack = list(states)
+        while stack:
+            s = stack.pop()
+            for t in nfa.eps[s]:
+                if t not in out:
+                    out.add(t)
+                    stack.append(t)
+        return frozenset(out)
+
+    start_set = closure(frozenset([start]))
+    ids: Dict[frozenset, int] = {start_set: 0}
+    table: List[Dict[int, int]] = [{}]
+    accepting: List[bool] = [end in start_set]
+    work = [start_set]
+    while work:
+        cur = work.pop()
+        ci = ids[cur]
+        by_byte: Dict[int, set] = {}
+        for s in cur:
+            for b, ts in nfa.trans[s].items():
+                by_byte.setdefault(b, set()).update(ts)
+        for b, ts in by_byte.items():
+            nxt = closure(frozenset(ts))
+            ni = ids.get(nxt)
+            if ni is None:
+                if len(table) >= _MAX_DFA_STATES:
+                    raise ValueError(
+                        f"regex compiles past {_MAX_DFA_STATES} DFA "
+                        "states; simplify the pattern"
+                    )
+                ni = len(table)
+                ids[nxt] = ni
+                table.append({})
+                accepting.append(end in nxt)
+                work.append(nxt)
+            table[ci][b] = ni
+    return ByteDFA(tuple(table), tuple(accepting))
+
+
+# ------------------------------------------------------- token lifting
+
+
+def token_byte_table(tokenizer, vocab_size: int) -> List[bytes]:
+    """Each token id's byte string, decoded in isolation — exact for
+    byte-level vocabularies (the framework's byte + BPE tokenizers);
+    ids that fail to decode map to b"" and are never allowed. The ONE
+    implementation behind TokenFSM.from_tokenizer and the engines'
+    cached table."""
+    out = []
+    for t in range(vocab_size):
+        try:
+            out.append(tokenizer.decode([t]).encode("utf-8"))
+        except Exception:
+            out.append(b"")
+    return out
+
+
+class TokenFSM:
+    """Byte DFA lifted to a tokenizer's id space.
+
+    ``token_bytes``: sequence indexed by token id giving each token's
+    byte string (b"" entries — special/unused ids — are never allowed).
+    Per-DFA-state masks/next-states are computed lazily and cached;
+    ``eos_id`` (optional) is allowed exactly in accepting states.
+    """
+
+    def __init__(self, dfa: ByteDFA, token_bytes: Sequence[bytes],
+                 eos_id: Optional[int] = None):
+        self.dfa = dfa
+        self.vocab = len(token_bytes)
+        self.eos_id = eos_id
+        self._tok = list(token_bytes)
+        self._cache: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
+
+    @classmethod
+    def from_tokenizer(cls, dfa: ByteDFA, tokenizer, vocab_size: int,
+                       eos_id: Optional[int] = None) -> "TokenFSM":
+        """Build token byte strings via :func:`token_byte_table`;
+        adapters with context-dependent detokenisation should pass
+        explicit token_bytes instead."""
+        return cls(
+            dfa, token_byte_table(tokenizer, vocab_size), eos_id=eos_id
+        )
+
+    @property
+    def initial_state(self) -> int:
+        return 0
+
+    def tables(self, state: int) -> Tuple[np.ndarray, np.ndarray]:
+        """(allow (vocab,) bool, next_state (vocab,) int32) for one DFA
+        state. O(vocab x avg token bytes) once per distinct state."""
+        hit = self._cache.get(state)
+        if hit is not None:
+            return hit
+        allow = np.zeros((self.vocab,), bool)
+        nxt = np.full((self.vocab,), -1, np.int32)
+        for t, bs in enumerate(self._tok):
+            if not bs:
+                continue
+            s = state
+            for b in bs:
+                s = self.dfa.step(s, b)
+                if s == self.dfa.dead:
+                    break
+            else:
+                allow[t] = True
+                nxt[t] = s
+        if self.eos_id is not None and 0 <= self.eos_id < self.vocab:
+            allow[self.eos_id] = self.dfa.accepting[state]
+            nxt[self.eos_id] = state
+        self._cache[state] = (allow, nxt)
+        return allow, nxt
+
+    def allowed(self, state: int) -> np.ndarray:
+        return self.tables(state)[0]
+
+    def advance(self, state: int, token: int) -> int:
+        allow, nxt = self.tables(state)
+        if not allow[token]:
+            raise ValueError(
+                f"token {token} is not allowed in FSM state {state} — "
+                "the engine masked incorrectly (bug) or the token came "
+                "from an unconstrained path"
+            )
+        return int(nxt[token])
+
+    def is_accepting(self, state: int) -> bool:
+        return self.dfa.accepting[state]

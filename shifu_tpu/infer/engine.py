@@ -29,6 +29,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from shifu_tpu.ops.attention import NEG_INF
 from shifu_tpu.infer.sampling import (
     SampleConfig,
     apply_logit_bias,
@@ -108,6 +109,11 @@ class _Request:
     allowed_token_ids: Optional[List[int]] = None
     # Multi-LoRA serving: registered adapter id (0 = none).
     adapter: int = 0
+    # FSM-constrained decoding (infer/constrain.py): the compiled
+    # TokenFSM and the slot's current DFA state (replayable from
+    # ``generated`` — preemption recompute does exactly that).
+    constraint: Optional[object] = None
+    fsm_state: int = 0
     # Tokens already cleared of stop matches (resume point for the
     # sweep's scan — keeps per-step stop checking incremental).
     stop_scanned: int = 0
@@ -203,7 +209,8 @@ class Engine:
         Register adapters with :meth:`add_adapter`; requests pick one
         via ``submit(..., adapter=id)``.
 
-        ``tokenizer``: optional; needed only for STRING stop sequences
+        ``tokenizer``: optional; needed for STRING stop sequences
+        and for ``submit(regex=...)`` constraints (token byte strings)
         (``submit(..., stop_strings=...)`` — the sweep decodes the
         generated tokens to find the stop text). Token-id stop
         sequences need no tokenizer."""
@@ -347,6 +354,8 @@ class Engine:
         logit_bias: Optional[dict] = None,
         allowed_token_ids=None,
         adapter: Optional[int] = None,
+        regex: Optional[str] = None,
+        constraint=None,
     ) -> int:
         """Queue one request; returns its rid.
 
@@ -363,7 +372,19 @@ class Engine:
         sampling to exactly these ids (everything else hard-banned).
         Both need ``Engine(enable_logit_bias=True)``.
         ``adapter``: a registered adapter id (:meth:`add_adapter`);
-        None/0 serves the base model."""
+        None/0 serves the base model.
+        ``regex``: constrain the GENERATION to fully match this
+        pattern (infer/constrain.py syntax) — every step's sampler
+        sees only tokens that keep a match reachable, and eos is
+        allowed exactly at complete matches. Needs
+        ``enable_logit_bias`` (the mask rides the bias buffer), the
+        engine's ``tokenizer`` (token byte strings), and per-token
+        dispatch (``decode_chunk == 1``; speculative engines refuse —
+        the host advances the FSM between steps). When a state has no
+        continuation and no eos is configured, the request finishes at
+        that boundary (reported as "length"). ``constraint``: a
+        prebuilt ``TokenFSM`` instead of a pattern (reusable across
+        requests — the per-state tables cache inside it)."""
         if sampling is not None and not self.per_request_sampling:
             raise ValueError(
                 "per-request sampling requires "
@@ -397,6 +418,72 @@ class Engine:
                 logit_bias = {int(t): float(v) for t, v in logit_bias.items()}
             if allowed_token_ids is not None:
                 allowed_token_ids = [int(t) for t in allowed_token_ids]
+        if regex is not None and constraint is not None:
+            raise ValueError("pass regex OR constraint, not both")
+        if regex is not None or constraint is not None:
+            if not self.enable_logit_bias:
+                raise ValueError(
+                    "regex/constraint requires "
+                    "Engine(enable_logit_bias=True) — the FSM mask "
+                    "rides the bias buffer"
+                )
+            if self._decode_reach() > 1:
+                raise ValueError(
+                    "regex/constraint needs per-token dispatch: the "
+                    "host advances the FSM between steps "
+                    "(decode_chunk must be 1; speculative engines "
+                    "cannot serve constrained requests)"
+                )
+            if regex is not None:
+                if self.tokenizer is None:
+                    raise ValueError(
+                        "regex needs Engine(tokenizer=...) to lift "
+                        "the byte DFA onto token ids; or pass a "
+                        "prebuilt constraint="
+                    )
+                # One TokenFSM per distinct pattern: its lazily-built
+                # per-state tables are the expensive part and they are
+                # shared by every request using the pattern. BOUNDED
+                # (FIFO, 64 patterns): the pattern string is CLIENT
+                # input on the serving path — an unbounded dict keyed
+                # on it is a memory leak an adversary can drive.
+                cache = getattr(self, "_fsm_cache", None)
+                if cache is None:
+                    import collections as _collections
+
+                    cache = self._fsm_cache = _collections.OrderedDict()
+                constraint = cache.get(regex)
+                if constraint is None:
+                    from shifu_tpu.infer.constrain import (
+                        TokenFSM,
+                        compile_regex,
+                    )
+
+                    constraint = TokenFSM(
+                        compile_regex(regex),
+                        self._token_byte_table(),
+                        eos_id=self.eos_id,
+                    )
+                    cache[regex] = constraint
+                    while len(cache) > 64:
+                        cache.popitem(last=False)
+            first_allow = constraint.allowed(
+                constraint.initial_state
+            ).copy()
+            if logit_bias is not None or allowed_token_ids is not None:
+                first_allow &= (
+                    bias_row(
+                        self.model.cfg.vocab_size,
+                        logit_bias, allowed_token_ids,
+                    )
+                    > -1e37
+                )
+            if not np.any(first_allow):
+                raise ValueError(
+                    "constraint allows no first token (empty language "
+                    "for this tokenizer, or the intersection with "
+                    "logit_bias/allowed_token_ids hard bans is empty)"
+                )
         if adapter:
             if self.lora is None:
                 raise ValueError(
@@ -452,6 +539,7 @@ class Engine:
                 stop_token_ids=stop_token_ids, stop_strings=stop_strings,
                 logit_bias=logit_bias, allowed_token_ids=allowed_token_ids,
                 adapter=int(adapter) if adapter else 0,
+                constraint=constraint,
             )
         )
         return rid
@@ -604,6 +692,37 @@ class Engine:
                 req.logprobs.append(float(lps[slot]))
                 self._lengths[slot] += 1
                 self._cur[slot] = token
+                if req.constraint is not None:
+                    if not req.constraint.allowed(req.fsm_state)[token]:
+                        # Starved sampler (empty effective mask slipped
+                        # a dispatch — e.g. exhaustion detected between
+                        # chunks): the token is not part of any match;
+                        # drop it and finish the request rather than
+                        # faulting the engine thread.
+                        req.generated.pop()
+                        req.logprobs.pop()
+                        req.max_new_tokens = max(len(req.generated), 1)
+                        continue
+                    # Advance the FSM with the emitted token and put
+                    # the NEXT state's mask on the slot's bias row —
+                    # one (vocab,) device write per constrained token.
+                    req.fsm_state = req.constraint.advance(
+                        req.fsm_state, token
+                    )
+                    allow = req.constraint.allowed(req.fsm_state)
+                    row = bias_row(
+                        self.model.cfg.vocab_size,
+                        req.logit_bias,
+                        req.allowed_token_ids,
+                    )
+                    self._bias_dev = self._bias_dev.at[slot].set(
+                        jnp.asarray(
+                            np.where(allow, row, NEG_INF).astype(
+                                np.float32
+                            )
+                        )
+                    )
+                    self._check_fsm_exhausted(req)
         else:
             remaining = np.zeros((self.max_slots,), np.int32)
             for slot, req in self._active.items():
@@ -728,19 +847,74 @@ class Engine:
             return ()
         return (self._bias_dev,)
 
+    def _token_byte_table(self):
+        """Each token id's byte string (cached per engine) — the
+        TokenFSM alphabet, built by constrain.token_byte_table (the one
+        implementation shared with TokenFSM.from_tokenizer)."""
+        tbl = getattr(self, "_token_bytes", None)
+        if tbl is None:
+            from shifu_tpu.infer.constrain import token_byte_table
+
+            tbl = self._token_bytes = token_byte_table(
+                self.tokenizer, self.model.cfg.vocab_size
+            )
+        return tbl
+
+    def _slot_bias_row(self, req: _Request) -> np.ndarray:
+        """One request's CURRENT (vocab,) bias row: the static
+        logit_bias/allowed_token_ids fields, intersected with the
+        FSM's allow-mask at the request's current state. Replays
+        ``generated`` to set the state when it is stale (fresh
+        admissions and preemption-recompute re-admissions both land
+        here with fsm_state reset)."""
+        row = bias_row(
+            self.model.cfg.vocab_size,
+            req.logit_bias,
+            req.allowed_token_ids,
+        )
+        if req.constraint is None:
+            return row
+        st = req.constraint.initial_state
+        for t in req.generated:
+            st = req.constraint.advance(st, int(t))
+        req.fsm_state = st
+        allow = req.constraint.allowed(st)
+        return np.where(allow, row, NEG_INF).astype(np.float32)
+
     def _req_bias_args(self, req: _Request) -> tuple:
         """Traced (1, vocab) bias row for one request's prefill."""
         if not self.enable_logit_bias:
             return ()
-        return (
-            jnp.asarray(
-                bias_row(
-                    self.model.cfg.vocab_size,
-                    req.logit_bias,
-                    req.allowed_token_ids,
-                )[None, :]
-            ),
-        )
+        return (jnp.asarray(self._slot_bias_row(req)[None, :]),)
+
+    def _effective_allow(self, req: _Request) -> np.ndarray:
+        """The tokens a constrained request can actually emit next: the
+        FSM's allow-mask INTERSECTED with the static hard bans
+        (logit_bias <= -100 / allowed_token_ids) — the sampler sees
+        NEG_INF outside this set."""
+        allow = req.constraint.allowed(req.fsm_state).copy()
+        if req.logit_bias or req.allowed_token_ids is not None:
+            static = bias_row(
+                self.model.cfg.vocab_size,
+                req.logit_bias,
+                req.allowed_token_ids,
+            )
+            allow &= static > -1e37
+        return allow
+
+    def _check_fsm_exhausted(self, req: _Request) -> None:
+        """A constrained request with NO emittable token — complete
+        match with nothing extendable and no eos, or an empty
+        intersection with the request's own hard bans — cannot
+        continue: clamp its budget to what it has, and the normal sweep
+        finishes it (finished_by "length", documented in submit). Left
+        unchecked, the all-NEG_INF row would make the sampler pick an
+        arbitrary token and the FSM advance would fault the engine
+        thread."""
+        if req.constraint is None:
+            return
+        if not np.any(self._effective_allow(req)):
+            req.max_new_tokens = max(len(req.generated), 1)
 
     def _split_extra(self, rest: tuple):
         """Parse a program's trailing args into (lead, samp, pen, bias,
@@ -1097,13 +1271,14 @@ class Engine:
             # Rebuilt from the request (not carried from the prefill
             # args) so preemption-recompute re-admissions restore the
             # slot's constraints and freed slots return to identity.
+            # _slot_bias_row replays the generated tokens, so an FSM
+            # constraint lands in the state AFTER the prefill-sampled
+            # token (and after the whole resumed generation on a
+            # preemption recompute).
             self._bias_dev = self._bias_dev.at[slot].set(
-                jnp.asarray(bias_row(
-                    self.model.cfg.vocab_size,
-                    req.logit_bias,
-                    req.allowed_token_ids,
-                ))
+                jnp.asarray(self._slot_bias_row(req))
             )
+            self._check_fsm_exhausted(req)
         self._active[slot] = req
         # A 1-token budget can finish at admission; step() sweeps it on
         # the next call via the normal bookkeeping (generated >= budget).

@@ -221,6 +221,7 @@ class _Submission:
     logit_bias: Optional[dict] = None
     allowed_token_ids: Optional[list] = None
     adapter: Optional[int] = None
+    regex: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -277,12 +278,13 @@ class EngineRunner:
         sampling: Optional[SampleConfig] = None,
         stop_token_ids=None, stop_strings=None,
         logit_bias=None, allowed_token_ids=None, adapter=None,
+        regex=None,
     ) -> Completion:
         return self.complete_n(
             tokens, max_new_tokens, 1, timeout=timeout, sampling=sampling,
             stop_token_ids=stop_token_ids, stop_strings=stop_strings,
             logit_bias=logit_bias, allowed_token_ids=allowed_token_ids,
-            adapter=adapter,
+            adapter=adapter, regex=regex,
         )[0]
 
     def complete_n(
@@ -291,6 +293,7 @@ class EngineRunner:
         sampling: Optional[SampleConfig] = None,
         stop_token_ids=None, stop_strings=None,
         logit_bias=None, allowed_token_ids=None, adapter=None,
+        regex=None,
     ):
         """N independent completions of one prompt (the API's ``n``).
 
@@ -322,7 +325,7 @@ class EngineRunner:
                         stop_token_ids, stop_strings, w,
                         logit_bias=logit_bias,
                         allowed_token_ids=allowed_token_ids,
-                        adapter=adapter,
+                        adapter=adapter, regex=regex,
                     )
                 )
         self._wake.set()
@@ -383,7 +386,8 @@ class EngineRunner:
                timeout: Optional[float] = None,
                sampling: Optional[SampleConfig] = None,
                stop_token_ids=None, stop_strings=None,
-               logit_bias=None, allowed_token_ids=None, adapter=None):
+               logit_bias=None, allowed_token_ids=None, adapter=None,
+               regex=None):
         """Returns a generator of ("delta", (ids, logprobs)) items
         ending with ("done", Completion); tokens arrive as the engine
         emits them (per decode chunk). The submission (and the
@@ -407,7 +411,7 @@ class EngineRunner:
                     stop_token_ids, stop_strings, w,
                     logit_bias=logit_bias,
                     allowed_token_ids=allowed_token_ids,
-                    adapter=adapter,
+                    adapter=adapter, regex=regex,
                 )
             )
         self._wake.set()
@@ -573,7 +577,7 @@ class EngineRunner:
                     stop_strings=sub.stop_strings,
                     logit_bias=sub.logit_bias,
                     allowed_token_ids=sub.allowed_token_ids,
-                    adapter=sub.adapter,
+                    adapter=sub.adapter, regex=sub.regex,
                 )
             except Exception as e:  # validation error -> the caller
                 with self._lock:
@@ -800,6 +804,9 @@ class _Handler(BaseHTTPRequestHandler):
                 isinstance(adapter, bool) or not isinstance(adapter, int)
             ):
                 raise ValueError("adapter must be an integer id")
+            regex = req.get("regex")
+            if regex is not None and not isinstance(regex, str):
+                raise ValueError("regex must be a string pattern")
             want_logprobs = bool(req.get("logprobs"))
             n = int(req.get("n", 1))
             best_of = req.get("best_of")
@@ -816,7 +823,7 @@ class _Handler(BaseHTTPRequestHandler):
                     tokens, max_new, sampling, stop_token_ids,
                     stop_strings, want_logprobs, chat=chat,
                     logit_bias=logit_bias, allowed_token_ids=allowed_ids,
-                    adapter=adapter,
+                    adapter=adapter, regex=regex,
                 )
                 return
             if best_of is not None:
@@ -854,13 +861,14 @@ class _Handler(BaseHTTPRequestHandler):
                     or logit_bias is not None
                     or allowed_ids is not None
                     or adapter is not None
+                    or regex is not None
                 ):
                     # Beam is deterministic max-logprob search; these
                     # fields would be silently dropped — refuse instead.
                     raise ValueError(
                         "best_of composes with none of temperature/"
                         "top_k/top_p/stop/stop_token_ids/logprobs/"
-                        "logit_bias/allowed_token_ids/adapter"
+                        "logit_bias/allowed_token_ids/adapter/regex"
                     )
                 out = self.runner.beam(
                     tokens, max_new, best_of,
@@ -891,6 +899,7 @@ class _Handler(BaseHTTPRequestHandler):
                     sampling=sampling, stop_token_ids=stop_token_ids,
                     stop_strings=stop_strings, logit_bias=logit_bias,
                     allowed_token_ids=allowed_ids, adapter=adapter,
+                    regex=regex,
                 )
                 choices = [
                     _build_choice(
@@ -907,6 +916,7 @@ class _Handler(BaseHTTPRequestHandler):
                 sampling=sampling, stop_token_ids=stop_token_ids,
                 stop_strings=stop_strings, logit_bias=logit_bias,
                 allowed_token_ids=allowed_ids, adapter=adapter,
+                regex=regex,
             )
         except (ValueError, TypeError) as e:
             self._send(400, {"error": str(e)})
@@ -926,7 +936,7 @@ class _Handler(BaseHTTPRequestHandler):
         self, tokens, max_new: int, sampling=None,
         stop_token_ids=None, stop_strings=None, want_logprobs=False,
         chat: bool = False, logit_bias=None, allowed_token_ids=None,
-        adapter=None,
+        adapter=None, regex=None,
     ) -> None:
         """Server-sent events: one ``data:`` line per token delta, a
         final one with finished_by (and the definitive token count —
@@ -941,6 +951,7 @@ class _Handler(BaseHTTPRequestHandler):
             sampling=sampling, stop_token_ids=stop_token_ids,
             stop_strings=stop_strings, logit_bias=logit_bias,
             allowed_token_ids=allowed_token_ids, adapter=adapter,
+            regex=regex,
         )
         self.send_response(200)
         self.send_header("Content-Type", "text/event-stream")

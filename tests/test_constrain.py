@@ -1,0 +1,358 @@
+"""FSM-constrained decoding: regex compiler, token lifting, engine and
+server semantics.
+
+Pinned properties:
+  * compile_regex agrees with Python re.fullmatch (DOTALL) across the
+    supported syntax, including quantifier bounds, classes, escapes,
+    alternation and nesting;
+  * TokenFSM masks exactly the tokens whose bytes keep a match
+    reachable, per state; eos is allowed exactly at accepting states;
+    advance() follows the byte DFA;
+  * ENGINE: every constrained generation FULLY MATCHES its pattern
+    when it finishes by eos, and every PREFIX of a budget-finished
+    generation stays viable (no dead states ever); unconstrained rows
+    in the same batch are untouched; dense == paged parity; preemption
+    recompute replays the FSM state;
+  * a completed match with no extension and no eos finishes the
+    request at the boundary;
+  * validation: needs enable_logit_bias, per-token dispatch, a
+    tokenizer (or prebuilt constraint); speculative engines refuse;
+  * SERVER: the "regex" field produces matching text end to end; bad
+    patterns 400.
+"""
+
+import json
+import re as pyre
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+
+from shifu_tpu.data.tokenizer import ByteTokenizer
+from shifu_tpu.infer import SampleConfig, TokenFSM, compile_regex
+from shifu_tpu.infer.engine import Engine, PagedEngine
+from shifu_tpu.models import Transformer, TransformerConfig
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    model = Transformer(TransformerConfig.tiny())
+    return model, model.init(jax.random.key(0))
+
+
+# ------------------------------------------------------------- compiler
+
+
+@pytest.mark.parametrize("pattern,samples", [
+    (r"abc", ["abc", "ab", "abcd", "", "abd"]),
+    (r"a|bc", ["a", "bc", "b", "abc", ""]),
+    (r"a*b+", ["b", "aab", "abbb", "aa", ""]),
+    (r"[a-c]+", ["abccba", "d", "", "a"]),
+    (r"[^0-9]+", ["abc", "a1", "", "!?"]),
+    (r"\d{2,4}", ["1", "12", "1234", "12345"]),
+    (r"(ab|cd)*ef", ["ef", "abef", "cdabef", "abcef", "abab"]),
+    (r"-?\d+(\.\d+)?", ["-12", "3.14", "3.", ".5", "42", "-"]),
+    (r'\{"x": \d+\}', ['{"x": 7}', '{"x": }', '{"x": 12}']),
+    (r"(yes|no)", ["yes", "no", "maybe", "y"]),
+    (r"a{3}", ["aa", "aaa", "aaaa"]),
+    (r"\s*ok\s*", ["ok", " ok\n", "okk", "o k"]),
+])
+def test_compile_regex_matches_python_re(pattern, samples):
+    dfa = compile_regex(pattern)
+    for s in samples:
+        want = pyre.fullmatch(pattern, s, pyre.DOTALL) is not None
+        assert dfa.matches(s.encode()) == want, (pattern, s)
+
+
+def test_compile_regex_rejects_malformed():
+    for bad in ("(", "[", "a)", "*a", "a{3,1}"):
+        with pytest.raises(ValueError):
+            compile_regex(bad)
+
+
+# -------------------------------------------------------- token lifting
+
+
+def _byte_fsm(pattern, eos_id=None, vocab=256):
+    tok = ByteTokenizer()
+    toks = [tok.decode([t]).encode("utf-8") for t in range(vocab)]
+    return TokenFSM(compile_regex(pattern), toks, eos_id=eos_id)
+
+
+def test_token_fsm_masks_and_advance():
+    tok = ByteTokenizer()
+    tid = lambda ch: tok.encode(ch)[0]  # byte-token id (bytes sit at +3)
+    fsm = _byte_fsm("(cat|car)s?", eos_id=tok.eos_id)
+    st = fsm.initial_state
+    allow = fsm.allowed(st)
+    assert allow[tid("c")] and not allow[tid("a")]
+    assert not allow[tok.eos_id]
+    st = fsm.advance(st, tid("c"))
+    st = fsm.advance(st, tid("a"))
+    allow = fsm.allowed(st)
+    assert allow[tid("t")] and allow[tid("r")] and not allow[tid("s")]
+    st = fsm.advance(st, tid("t"))
+    assert fsm.is_accepting(st)
+    assert fsm.allowed(st)[tok.eos_id]  # eos at a complete match
+    assert fsm.allowed(st)[tid("s")]  # ...or extend to "cats"
+    with pytest.raises(ValueError, match="not allowed"):
+        fsm.advance(st, tid("z"))
+
+
+# --------------------------------------------------------------- engine
+
+
+def _serve(model, params, jobs, max_new=16, paged=False, eos_id=None,
+           **ekw):
+    cls_kw = dict(
+        max_slots=max(len(jobs), 1), max_len=64, prefill_buckets=(32, 64),
+        sample_cfg=SampleConfig(temperature=0.0), eos_id=eos_id,
+        enable_logit_bias=True, tokenizer=ByteTokenizer(), **ekw,
+    )
+    eng = (
+        PagedEngine(model, params, page_size=8, **cls_kw)
+        if paged else Engine(model, params, **cls_kw)
+    )
+    rids = [eng.submit(p, max_new_tokens=max_new, **kw) for p, kw in jobs]
+    done = {c.rid: c for c in eng.run()}
+    return [done[r] for r in rids]
+
+
+def test_engine_generation_matches_pattern(tiny):
+    """Greedy decode under several patterns: eos-finished outputs FULLY
+    match; budget-finished outputs are viable prefixes (the DFA is
+    alive after every emitted token)."""
+    model, params = tiny
+    tok = ByteTokenizer()
+    prompt = tok.encode("x")
+    for pattern in (r"(yes|no)", r"-?\d+", r'\{"k": \d{1,3}\}',
+                    r"[ab]{4,8}"):
+        done = _serve(
+            model, params, [(prompt, {"regex": pattern})],
+            eos_id=tok.eos_id,
+        )[0]
+        text = tok.decode(done.tokens)
+        if done.finished_by == "eos":
+            assert pyre.fullmatch(pattern, text, pyre.DOTALL), (
+                pattern, text, done.finished_by,
+            )
+        else:
+            dfa = compile_regex(pattern)
+            s = 0
+            for b in text.encode():
+                s = dfa.step(s, b)
+                assert s != dfa.dead, (pattern, text)
+
+
+def test_engine_exact_match_no_eos_finishes_at_boundary(tiny):
+    """A finite pattern with nothing extendable and NO eos configured:
+    the request finishes exactly at the complete match."""
+    model, params = tiny
+    tok = ByteTokenizer()
+    done = _serve(
+        model, params, [(tok.encode("q"), {"regex": r"(yes|no)"})],
+        eos_id=None,
+    )[0]
+    assert tok.decode(done.tokens) in ("yes", "no")
+
+
+def test_engine_unconstrained_rows_unaffected_and_paged_parity(tiny):
+    model, params = tiny
+    tok = ByteTokenizer()
+    free_prompt = tok.encode("hello")
+    plain = _serve(model, params, [(free_prompt, {})], max_new=8)[0]
+    for paged in (False, True):
+        got = _serve(
+            model, params,
+            [
+                (tok.encode("n"), {"regex": r"\d+"}),
+                (free_prompt, {}),
+            ],
+            max_new=8, paged=paged,
+        )
+        assert got[1].tokens == plain.tokens, paged
+        text = tok.decode(got[0].tokens)
+        assert text and all(c.isdigit() for c in text), (paged, text)
+    dense = _serve(
+        model, params, [(tok.encode("n"), {"regex": r"\d+"})], max_new=8
+    )[0]
+    paged_out = _serve(
+        model, params, [(tok.encode("n"), {"regex": r"\d+"})],
+        max_new=8, paged=True,
+    )[0]
+    assert dense.tokens == paged_out.tokens
+
+
+def test_engine_preemption_replays_fsm(tiny):
+    """Pool pressure preempts a constrained request mid-decode: the
+    recompute re-admission replays the FSM over the resumed generation,
+    so the final output still matches, and equals the roomy run."""
+    model, params = tiny
+    tok = ByteTokenizer()
+    kw = dict(
+        max_slots=2, max_len=24, prefill_buckets=(8, 24),
+        sample_cfg=SampleConfig(temperature=0.0),
+        enable_logit_bias=True, tokenizer=tok,
+    )
+    jobs = [
+        (tok.encode("abc"), {"regex": r"[xy]{6,12}"}),
+        (tok.encode("de"), {"regex": r"\d{6,12}"}),
+    ]
+
+    def run(n_pages):
+        eng = PagedEngine(
+            model, params, page_size=4, n_pages=n_pages, **kw
+        )
+        rids = [
+            eng.submit(p, max_new_tokens=12, **j) for p, j in jobs
+        ]
+        done = {c.rid: c for c in eng.run()}
+        return eng, [done[r].tokens for r in rids]
+
+    _, roomy = run(None)
+    tight_eng, tight = run(8)
+    assert tight_eng.preemptions >= 1
+    assert tight == roomy
+    assert pyre.fullmatch(r"[xy]{6,12}", tok.decode(tight[0]))
+
+
+def test_validation(tiny):
+    model, params = tiny
+    tok = ByteTokenizer()
+    no_bias = Engine(
+        model, params, max_slots=1, max_len=32, prefill_buckets=(16, 32),
+        tokenizer=tok,
+    )
+    with pytest.raises(ValueError, match="enable_logit_bias"):
+        no_bias.submit([1, 2], max_new_tokens=2, regex=r"\d+")
+    chunked = Engine(
+        model, params, max_slots=1, max_len=32, prefill_buckets=(16, 32),
+        decode_chunk=4, enable_logit_bias=True, tokenizer=tok,
+    )
+    with pytest.raises(ValueError, match="per-token"):
+        chunked.submit([1, 2], max_new_tokens=2, regex=r"\d+")
+    no_tok = Engine(
+        model, params, max_slots=1, max_len=32, prefill_buckets=(16, 32),
+        enable_logit_bias=True,
+    )
+    with pytest.raises(ValueError, match="tokenizer"):
+        no_tok.submit([1, 2], max_new_tokens=2, regex=r"\d+")
+    ok = Engine(
+        model, params, max_slots=1, max_len=32, prefill_buckets=(16, 32),
+        enable_logit_bias=True, tokenizer=tok,
+    )
+    with pytest.raises(ValueError, match="regex OR constraint"):
+        ok.submit(
+            [1, 2], max_new_tokens=2, regex=r"\d+",
+            constraint=_byte_fsm(r"\d+"),
+        )
+    with pytest.raises(ValueError):  # malformed pattern -> compile error
+        ok.submit([1, 2], max_new_tokens=2, regex="(")
+
+    from shifu_tpu.infer import PromptLookupPagedEngine
+
+    spec = PromptLookupPagedEngine(
+        model, params, page_size=8, max_slots=1, max_len=32,
+        prefill_buckets=(16, 32), tokenizer=tok,
+    )
+    # Speculative engines cannot even enable the bias buffer (their
+    # constructor refuses it), so a constrained submit fails at that
+    # earlier gate — refused either way.
+    with pytest.raises(ValueError, match="enable_logit_bias"):
+        spec.submit([1, 2], max_new_tokens=2, constraint=_byte_fsm(r"a+"))
+
+
+# ---------------------------------------------------------------- server
+
+
+def test_server_regex_field(tiny):
+    model, params = tiny
+    tok = ByteTokenizer()
+    eng = PagedEngine(
+        model, params, page_size=8, max_slots=2, max_len=64,
+        prefill_buckets=(32, 64), sample_cfg=SampleConfig(temperature=0.0),
+        enable_logit_bias=True, tokenizer=tok, eos_id=tok.eos_id,
+    )
+    server = __import__(
+        "shifu_tpu.infer.server", fromlist=["make_server"]
+    ).make_server(eng, host="127.0.0.1", port=0, tokenizer=tok)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    base = f"http://127.0.0.1:{server.server_port}"
+
+    def post(body):
+        req = urllib.request.Request(
+            base + "/v1/completions", json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=120) as r:
+                return r.status, json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    try:
+        status, out = post({
+            "prompt": "answer: ", "max_new_tokens": 8,
+            "regex": r"(yes|no)",
+        })
+        assert status == 200
+        assert out["text"] in ("yes", "no"), out
+        status, _ = post({
+            "prompt": "x", "max_new_tokens": 4, "regex": "(",
+        })
+        assert status == 400
+        status, _ = post({
+            "prompt": "x", "max_new_tokens": 4, "regex": 7,
+        })
+        assert status == 400
+    finally:
+        server.shutdown()
+        server.runner.shutdown()
+        t.join(5)
+
+
+def test_empty_intersection_is_safe(tiny):
+    """A regex whose effective token set is emptied by the request's
+    own hard bans must not kill the engine: the request finishes at
+    the boundary (or is refused up front when the FIRST step is
+    already empty) and the engine keeps serving."""
+    model, params = tiny
+    tok = ByteTokenizer()
+    eng = Engine(
+        model, params, max_slots=2, max_len=64, prefill_buckets=(32, 64),
+        sample_cfg=SampleConfig(temperature=0.0),
+        enable_logit_bias=True, tokenizer=tok,
+    )
+    digit_ids = [tok.encode(str(d))[0] for d in range(10)]
+    letter_id = tok.encode("z")[0]
+    # First token already impossible: digits required, letters allowed.
+    with pytest.raises(ValueError, match="no first token"):
+        eng.submit(
+            tok.encode("x"), max_new_tokens=4, regex=r"\d+",
+            allowed_token_ids=[letter_id],
+        )
+    # Becomes impossible AFTER one token: \d[a-z] with only digits
+    # allowed — one digit emits, then the intersection empties and the
+    # request finishes instead of faulting the thread.
+    rid = eng.submit(
+        tok.encode("x"), max_new_tokens=6, regex=r"\d[a-z]",
+        allowed_token_ids=digit_ids,
+    )
+    done = {c.rid: c for c in eng.run()}[rid]
+    text = tok.decode(done.tokens)
+    assert len(text) == 1 and text.isdigit(), text
+    # The engine is still alive and serves the next request.
+    rid2 = eng.submit(tok.encode("y"), max_new_tokens=3, regex=r"\d+")
+    done2 = {c.rid: c for c in eng.run()}[rid2]
+    assert tok.decode(done2.tokens).isdigit()
+
+
+def test_dfa_state_cap():
+    with pytest.raises(ValueError, match="DFA"):
+        # Classic subset-construction blowup: (a|b)*a(a|b){N}.
+        compile_regex("(a|b)*a" + "(a|b)" * 16)

@@ -263,9 +263,8 @@ def test_kernel_int8_qk_error_bounded():
     assert err < 0.08, err
 
 
-def test_kernel_int8_qk_multi_query_and_window():
-    """The multi-query (speculative-verify) shape and sliding windows
-    ride the int8 QK dot too, within the same bound."""
+def test_kernel_int8_qk_window():
+    """Sliding windows ride the int8 QK dot within the same bound."""
     _, q, pk, pv, table, lengths = _setup(seed=9)
     qk, sk, qv, sv = _quantize_pools(pk, pv)
     out = paged_decode_attention(
@@ -273,6 +272,30 @@ def test_kernel_int8_qk_multi_query_and_window():
         int8_qk=True, window=40, interpret=True,
     )
     ref = _reference(q, pk, pv, table, lengths, window=40)
+    assert np.max(np.abs(np.asarray(out) - np.asarray(ref))) < 0.08
+
+
+def test_kernel_int8_qk_multi_query():
+    """The 4-D multi-query (speculative-verify) shape: qw queries fold
+    into the row axis, so the per-row q scales and the qs_ref block
+    must broadcast per (query, head) row. Pinned against the SAME call
+    with the bf16-QK dequant path — the only difference is q's
+    rounding, so the bound is the q-quantization error alone."""
+    rng, q, pk, pv, table, lengths = _setup(seed=13)
+    qk, sk, qv, sv = _quantize_pools(pk, pv)
+    b, heads, hd = q.shape
+    q4 = jnp.stack(
+        [q, jnp.asarray(rng.standard_normal(q.shape), q.dtype)], axis=1
+    )  # (b, qw=2, heads, hd)
+    out = paged_decode_attention(
+        q4, qk, qv, table, lengths, k_scale=sk, v_scale=sv,
+        int8_qk=True, interpret=True,
+    )
+    ref = paged_decode_attention(
+        q4, qk, qv, table, lengths, k_scale=sk, v_scale=sv,
+        int8_qk=False, interpret=True,
+    )
+    assert out.shape == (b, 2, heads, hd)
     assert np.max(np.abs(np.asarray(out) - np.asarray(ref))) < 0.08
 
 
