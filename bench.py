@@ -306,7 +306,12 @@ def bench_serving():
         # regressions.
         times = []
         n_steps = timed_chunks * dc
-        for _ in range(2):
+        # min-of-3: the tunnel's per-dispatch latency has multi-ms
+        # session-dependent variance, and the two-point fit DIFFERENCES
+        # two of these minima — two passes proved not always enough
+        # (one hiccup produced a >1.0 "bandwidth_util_device", i.e. a
+        # physically impossible fit; see fit_unstable below).
+        for _ in range(3):
             for p in prompts:
                 eng.submit(
                     p, max_new_tokens=(warm_chunks + timed_chunks) * dc + 1
@@ -362,9 +367,14 @@ def bench_serving():
         leg["decode_step_device_ms"] = round(1000 * dps, 2)
         leg["tunnel_dispatch_ms"] = round(1000 * disp, 1)
         if peak_bw and dps > 0:
-            leg["bandwidth_util_device"] = round(
-                leg["_bytes"] / dps / peak_bw, 4
-            )
+            util = leg["_bytes"] / dps / peak_bw
+            leg["bandwidth_util_device"] = round(util, 4)
+            if util > 1.05:
+                # The fit differenced two noisy tunnel minima into a
+                # chip time FASTER than physically possible — flag it
+                # rather than let an impossible number sit unmarked in
+                # the ledger (wall numbers above remain valid).
+                leg["fit_unstable"] = True
         return leg
 
     out = {

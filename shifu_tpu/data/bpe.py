@@ -280,6 +280,15 @@ class BPETokenizer:
             parts.append(self._bytes_of[i - self._OFFSET])
         return b"".join(parts).decode("utf-8", errors="replace")
 
+    def token_bytes(self, token_id: int) -> bytes:
+        """One token's RAW merge bytes (b"" for specials) — exact even
+        for merges that are not standalone valid UTF-8, where decode()
+        would smear them into U+FFFD. The FSM-constrained-decoding
+        alphabet (infer/constrain.py token_byte_table)."""
+        if token_id < self._OFFSET or token_id >= self.vocab_size:
+            return b""
+        return self._bytes_of[token_id - self._OFFSET]
+
     # ------------------------------------------------------- persistence
     def save(self, path: str) -> None:
         with open(path, "w") as f:

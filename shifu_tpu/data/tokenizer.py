@@ -46,6 +46,15 @@ class ByteTokenizer:
         )
         return data.decode("utf-8", errors="replace")
 
+    def token_bytes(self, token_id: int) -> bytes:
+        """One token's RAW bytes (b"" for specials) — exact even for a
+        lone byte of a multi-byte character, where decode() would
+        smear it into U+FFFD. The FSM-constrained-decoding alphabet
+        (infer/constrain.py token_byte_table)."""
+        if token_id < self._OFFSET or token_id >= self.vocab_size:
+            return b""
+        return bytes([token_id - self._OFFSET])
+
 
 class HFTokenizer:
     """Adapter over a HuggingFace tokenizer instance.

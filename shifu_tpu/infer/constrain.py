@@ -75,6 +75,17 @@ _ESCAPES = {
 }
 
 
+def _char_node(c: str):
+    """One literal character as an AST node: a single byte set for
+    ASCII, a concatenated byte SEQUENCE for multi-byte UTF-8 (the
+    bytes must appear in order — a set would accept any ONE of them,
+    matching invalid UTF-8 and never the character)."""
+    bs = c.encode("utf-8")
+    if len(bs) == 1:
+        return ("lit", frozenset(bs))
+    return ("cat", [("lit", frozenset([b])) for b in bs])
+
+
 class _Parser:
     """Recursive-descent regex parser producing an AST of tuples:
     ("lit", charset) | ("cat", [..]) | ("alt", [..]) |
@@ -178,19 +189,36 @@ class _Parser:
         if c == ".":
             return ("lit", _ANY)
         if c == "\\":
-            return ("lit", self.escape())
+            return self.escape_node()
         if c in ")|":
             self.error(f"unexpected {c!r}")
         if c in "*+?":
             self.error(f"nothing to repeat before {c!r}")
-        return ("lit", frozenset(c.encode("utf-8")))
+        return _char_node(c)
+
+    def escape_node(self):
+        """An escape in NODE position: classes stay byte-sets; a
+        multi-byte escaped literal becomes a byte SEQUENCE."""
+        c = self.next()
+        if c in _ESCAPES:
+            return ("lit", _ESCAPES[c])
+        return _char_node(c)
 
     def escape(self) -> FrozenSet[int]:
+        """An escape inside a character CLASS: must be a byte set —
+        multi-byte characters cannot be one alternative byte, so they
+        are rejected with a clear error (classes are byte-level)."""
         c = self.next()
         if c in _ESCAPES:
             return _ESCAPES[c]
-        # Escaped literal (covers \. \\ \[ \{ \+ etc. and any byte).
-        return frozenset(c.encode("utf-8"))
+        b = c.encode("utf-8")
+        if len(b) != 1:
+            self.error(
+                f"non-ASCII {c!r} in a character class: classes are "
+                "byte-level — write it as a literal or alternation "
+                "instead"
+            )
+        return frozenset(b)
 
     def char_class(self) -> FrozenSet[int]:
         negate = False
@@ -212,6 +240,12 @@ class _Parser:
                 chars |= self.escape()
                 continue
             start = c.encode("utf-8")
+            if len(start) != 1:
+                self.error(
+                    f"non-ASCII {c!r} in a character class: classes "
+                    "are byte-level — write it as a literal or "
+                    "alternation instead"
+                )
             if len(start) == 1 and self.peek() == "-":
                 nxt = self.p[self.i + 1] if self.i + 1 < len(self.p) else None
                 if nxt is not None and nxt != "]":
@@ -230,12 +264,24 @@ class _Parser:
 # eps: list of set(states).
 
 
+_MAX_NFA_STATES = 100_000
+
+
 class _NFA:
     def __init__(self):
         self.trans: List[Dict[int, set]] = []
         self.eps: List[set] = []
 
     def state(self) -> int:
+        if len(self.trans) >= _MAX_NFA_STATES:
+            # Counted repetitions expand multiplicatively during
+            # CONSTRUCTION (e.g. (((a{60}){60}){60}){60}) — the DFA
+            # cap alone fires too late to protect the serving thread
+            # from a 24-character hostile pattern.
+            raise ValueError(
+                f"regex expands past {_MAX_NFA_STATES} NFA states "
+                "(nested counted repetition?); simplify the pattern"
+            )
         self.trans.append({})
         self.eps.append(set())
         return len(self.trans) - 1
@@ -377,15 +423,25 @@ def compile_regex(pattern: str) -> ByteDFA:
 
 
 def token_byte_table(tokenizer, vocab_size: int) -> List[bytes]:
-    """Each token id's byte string, decoded in isolation — exact for
-    byte-level vocabularies (the framework's byte + BPE tokenizers);
-    ids that fail to decode map to b"" and are never allowed. The ONE
+    """Each token id's RAW byte string — the TokenFSM alphabet; ids
+    that produce nothing map to b"" and are never allowed. The ONE
     implementation behind TokenFSM.from_tokenizer and the engines'
-    cached table."""
+    cached table.
+
+    Prefers the tokenizer's ``token_bytes(id)`` hook (the framework's
+    byte + BPE tokenizers implement it — EXACT even for tokens that
+    are not standalone valid UTF-8, e.g. one byte of a multi-byte
+    character, which ``decode()`` would smear into U+FFFD); falls back
+    to decode-in-isolation for adapters without the hook, which is
+    only exact for tokens that round-trip through text."""
+    hook = getattr(tokenizer, "token_bytes", None)
     out = []
     for t in range(vocab_size):
         try:
-            out.append(tokenizer.decode([t]).encode("utf-8"))
+            if hook is not None:
+                out.append(bytes(hook(t)))
+            else:
+                out.append(tokenizer.decode([t]).encode("utf-8"))
         except Exception:
             out.append(b"")
     return out

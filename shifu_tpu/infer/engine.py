@@ -114,6 +114,10 @@ class _Request:
     # ``generated`` — preemption recompute does exactly that).
     constraint: Optional[object] = None
     fsm_state: int = 0
+    # Cached static (vocab,) bias row (logit_bias/allowed_token_ids are
+    # immutable per request; rebuilding per emitted token is wasted
+    # host work on the constrained hot loop).
+    static_bias: Optional[object] = None
     # Tokens already cleared of stop matches (resume point for the
     # sweep's scan — keeps per-step stop checking incremental).
     stop_scanned: int = 0
@@ -710,11 +714,7 @@ class Engine:
                         req.fsm_state, token
                     )
                     allow = req.constraint.allowed(req.fsm_state)
-                    row = bias_row(
-                        self.model.cfg.vocab_size,
-                        req.logit_bias,
-                        req.allowed_token_ids,
-                    )
+                    row = self._static_row(req)
                     self._bias_dev = self._bias_dev.at[slot].set(
                         jnp.asarray(
                             np.where(allow, row, NEG_INF).astype(
@@ -867,11 +867,7 @@ class Engine:
         ``generated`` to set the state when it is stale (fresh
         admissions and preemption-recompute re-admissions both land
         here with fsm_state reset)."""
-        row = bias_row(
-            self.model.cfg.vocab_size,
-            req.logit_bias,
-            req.allowed_token_ids,
-        )
+        row = self._static_row(req)
         if req.constraint is None:
             return row
         st = req.constraint.initial_state
@@ -887,6 +883,17 @@ class Engine:
             return ()
         return (jnp.asarray(self._slot_bias_row(req)[None, :]),)
 
+    def _static_row(self, req: _Request) -> np.ndarray:
+        """The request's static (vocab,) bias row, built once (the
+        fields are immutable for the request's lifetime)."""
+        if req.static_bias is None:
+            req.static_bias = bias_row(
+                self.model.cfg.vocab_size,
+                req.logit_bias,
+                req.allowed_token_ids,
+            )
+        return req.static_bias
+
     def _effective_allow(self, req: _Request) -> np.ndarray:
         """The tokens a constrained request can actually emit next: the
         FSM's allow-mask INTERSECTED with the static hard bans
@@ -894,12 +901,7 @@ class Engine:
         NEG_INF outside this set."""
         allow = req.constraint.allowed(req.fsm_state).copy()
         if req.logit_bias or req.allowed_token_ids is not None:
-            static = bias_row(
-                self.model.cfg.vocab_size,
-                req.logit_bias,
-                req.allowed_token_ids,
-            )
-            allow &= static > -1e37
+            allow &= self._static_row(req) > -1e37
         return allow
 
     def _check_fsm_exhausted(self, req: _Request) -> None:

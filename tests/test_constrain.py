@@ -356,3 +356,65 @@ def test_dfa_state_cap():
     with pytest.raises(ValueError, match="DFA"):
         # Classic subset-construction blowup: (a|b)*a(a|b){N}.
         compile_regex("(a|b)*a" + "(a|b)" * 16)
+
+
+def test_non_ascii_literals_match_as_byte_sequences():
+    """A multi-byte character must compile to its byte SEQUENCE — a
+    byte SET would accept any single byte of it (invalid UTF-8) and
+    never the character itself."""
+    dfa = compile_regex("é+")
+    assert dfa.matches("é".encode())
+    assert dfa.matches("éé".encode())
+    assert not dfa.matches(b"\xc3")  # half the character
+    assert not dfa.matches(b"\xa9")
+    # ...and through the token FSM with exact raw token bytes, the
+    # byte-level tokenizer can emit it (two tokens = two bytes).
+    tok = ByteTokenizer()
+    fsm = TokenFSM(
+        compile_regex("é"),
+        [tok.token_bytes(t) for t in range(tok.vocab_size)],
+        eos_id=tok.eos_id,
+    )
+    b0, b1 = "é".encode()
+    st = fsm.advance(fsm.initial_state, b0 + 3)  # byte ids sit at +3
+    assert not fsm.is_accepting(st)
+    st = fsm.advance(st, b1 + 3)
+    assert fsm.is_accepting(st)
+
+
+def test_non_ascii_in_character_class_rejected():
+    with pytest.raises(ValueError, match="byte-level"):
+        compile_regex("[éa]")
+
+
+def test_nfa_budget_caps_hostile_patterns():
+    """Nested counted repetition expands multiplicatively at NFA
+    construction — it must fail fast (bounded work), not wedge the
+    engine thread."""
+    import time
+
+    t0 = time.monotonic()
+    with pytest.raises(ValueError, match="NFA"):
+        compile_regex("(((a{60}){60}){60}){60}")
+    assert time.monotonic() - t0 < 5.0
+
+
+def test_token_bytes_hooks_are_raw():
+    """Byte + BPE tokenizers expose exact raw token bytes — including
+    tokens that are NOT standalone valid UTF-8 (decode() smears those
+    into U+FFFD, which would corrupt the FSM alphabet)."""
+    from shifu_tpu.data.bpe import BPETokenizer
+    from shifu_tpu.infer.constrain import token_byte_table
+
+    tok = ByteTokenizer()
+    b0 = "é".encode()[0]
+    assert tok.token_bytes(b0 + 3) == bytes([b0])
+    assert tok.token_bytes(tok.eos_id) == b""
+    table = token_byte_table(tok, tok.vocab_size)
+    assert table[b0 + 3] == bytes([b0])
+
+    bpe = BPETokenizer.train(["ééé abc abc abc"], vocab_size=280)
+    for t in range(bpe.vocab_size):
+        got = bpe.token_bytes(t)
+        if t >= bpe._OFFSET:
+            assert got == bpe._bytes_of[t - bpe._OFFSET]
