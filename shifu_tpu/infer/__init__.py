@@ -20,7 +20,12 @@ from shifu_tpu.infer.spec_engine import (
     SpeculativePagedEngine,
     prompt_lookup_propose,
 )
-from shifu_tpu.infer.constrain import ByteDFA, TokenFSM, compile_regex
+from shifu_tpu.infer.constrain import (
+    ByteDFA,
+    TokenFSM,
+    compile_regex,
+    schema_to_regex,
+)
 from shifu_tpu.infer.server import EngineRunner, make_server
 from shifu_tpu.infer.speculative import (
     SpecResult,
@@ -45,6 +50,7 @@ __all__ = [
     "ByteDFA",
     "TokenFSM",
     "compile_regex",
+    "schema_to_regex",
     "SpecResult",
     "make_speculative_batch_fns",
     "speculative_generate",

@@ -246,7 +246,7 @@ class _Parser:
                     "are byte-level — write it as a literal or "
                     "alternation instead"
                 )
-            if len(start) == 1 and self.peek() == "-":
+            if self.peek() == "-":  # start is single-byte (checked above)
                 nxt = self.p[self.i + 1] if self.i + 1 < len(self.p) else None
                 if nxt is not None and nxt != "]":
                     self.next()  # consume '-'
@@ -518,3 +518,127 @@ class TokenFSM:
 
     def is_accepting(self, state: int) -> bool:
         return self.dfa.accepting[state]
+
+
+# ---------------------------------------------------- JSON-schema layer
+
+
+def _regex_escape(text: str) -> str:
+    out = []
+    for ch in text:
+        if ch in r"\.[]{}()|*+?":
+            out.append("\\" + ch)
+        else:
+            out.append(ch)
+    return "".join(out)
+
+
+# String CONTENTS: printable ASCII minus '"' and backslash — the class
+# [ !#-[\]^-~] spans 0x20-0x7E skipping 0x22 and 0x5C (']' escaped,
+# then the '^'-'~' range — mid-class '^' is literal). Stricter than
+# JSON (no escapes, no non-ASCII, no control characters) on purpose:
+# anything this grammar lets the model emit must PARSE as JSON, and
+# control bytes / lone UTF-8 fragments inside a byte-level class would
+# not. Non-ASCII output needs \uXXXX escapes, which are out of this
+# regular subset — documented in schema_to_regex.
+_STR_CHAR = r"[ !#-[\]^-~]"
+_JSON_STRING = '"' + _STR_CHAR + '*"'
+# Leading zeros are invalid JSON (json.loads rejects 007): integers
+# are 0 or [1-9] followed by digits.
+_JSON_INT = r"-?(0|[1-9]\d*)"
+_JSON_NUMBER = _JSON_INT + r"(\.\d+)?([eE][+-]?\d+)?"
+_WS = r"\s*"
+
+
+def schema_to_regex(schema: dict) -> str:
+    """A PRACTICAL JSON-Schema subset -> constraint pattern for
+    :func:`compile_regex` — "give me an object with exactly these
+    typed fields", which is what structured-output traffic almost
+    always wants.
+
+    Supported: {"type": "object", "properties": {...}} (all properties
+    required, emitted in property order — deterministic output is the
+    point of constraining), {"type": "string"} (no embedded quotes or
+    backslash escapes — a regular approximation; full JSON string
+    escaping needs states the byte DFA happily supports but the payoff
+    is marginal for constrained OUTPUT), "integer", "number",
+    "boolean", "null", {"enum": [...]} of scalars, {"type": "array",
+    "items": ...} (any length, incl. empty; "items" is REQUIRED), and
+    nested objects. Strings are PRINTABLE-ASCII-only (no escapes,
+    control characters, or raw non-ASCII — each would let the FSM
+    accept output json.loads rejects; non-ASCII needs \\uXXXX escapes,
+    outside this regular subset).
+    ``minLength``/``maxLength`` on strings bound the CHARACTER count
+    for single-byte text. Anything else raises ValueError — an
+    unsupported keyword must not silently weaken a constraint.
+    """
+    if not isinstance(schema, dict):
+        raise ValueError("schema must be an object")
+
+    def emit(s) -> str:
+        if not isinstance(s, dict):
+            raise ValueError(f"schema node must be an object, got {s!r}")
+        if "enum" in s:
+            opts = []
+            for v in s["enum"]:
+                if isinstance(v, bool):
+                    opts.append("true" if v else "false")
+                elif v is None:
+                    opts.append("null")
+                elif isinstance(v, (int, float)):
+                    opts.append(_regex_escape(repr(v)))
+                elif isinstance(v, str):
+                    opts.append('"' + _regex_escape(v) + '"')
+                else:
+                    raise ValueError(f"enum value {v!r} not a scalar")
+            return "(" + "|".join(opts) + ")"
+        t = s.get("type")
+        if t == "string":
+            lo = s.get("minLength")
+            hi = s.get("maxLength")
+            if lo is None and hi is None:
+                return _JSON_STRING
+            lo = 0 if lo is None else int(lo)
+            body = _STR_CHAR + f'{{{lo},{"" if hi is None else int(hi)}}}'
+            return '"' + body + '"'
+        if t == "integer":
+            return _JSON_INT
+        if t == "number":
+            return _JSON_NUMBER
+        if t == "boolean":
+            return "(true|false)"
+        if t == "null":
+            return "null"
+        if t == "array":
+            if "items" not in s:
+                raise ValueError(
+                    "array schema needs 'items' (a silently-defaulted "
+                    "element type would weaken the constraint)"
+                )
+            item = emit(s["items"])
+            return (
+                r"\[" + _WS + "(" + item
+                + "(" + _WS + "," + _WS + item + ")*" + ")?"
+                + _WS + r"\]"
+            )
+        if t == "object":
+            props = s.get("properties")
+            if not props:
+                raise ValueError(
+                    "object schema needs non-empty 'properties' (all "
+                    "are required; free-form objects are not regular)"
+                )
+            parts = []
+            for name, sub in props.items():
+                parts.append(
+                    '"' + _regex_escape(str(name)) + '":' + _WS
+                    + emit(sub)
+                )
+            inner = ("," + _WS).join(parts)
+            return r"\{" + _WS + inner + _WS + r"\}"
+        raise ValueError(
+            f"unsupported schema node {s!r} (see schema_to_regex "
+            "docstring for the supported subset)"
+        )
+
+    return emit(schema)

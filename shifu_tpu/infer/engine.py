@@ -359,6 +359,7 @@ class Engine:
         allowed_token_ids=None,
         adapter: Optional[int] = None,
         regex: Optional[str] = None,
+        json_schema: Optional[dict] = None,
         constraint=None,
     ) -> int:
         """Queue one request; returns its rid.
@@ -386,9 +387,14 @@ class Engine:
         dispatch (``decode_chunk == 1``; speculative engines refuse —
         the host advances the FSM between steps). When a state has no
         continuation and no eos is configured, the request finishes at
-        that boundary (reported as "length"). ``constraint``: a
-        prebuilt ``TokenFSM`` instead of a pattern (reusable across
-        requests — the per-state tables cache inside it)."""
+        that boundary (reported as "length"). ``json_schema``: a
+        practical JSON-Schema subset (typed object with required
+        properties; string/integer/number/boolean/null/enum/array/
+        nested object — constrain.schema_to_regex) compiled onto the
+        same FSM machinery: the output is schema-valid JSON whenever
+        it finishes by eos. ``constraint``: a prebuilt ``TokenFSM``
+        instead of a pattern (reusable across requests — the
+        per-state tables cache inside it)."""
         if sampling is not None and not self.per_request_sampling:
             raise ValueError(
                 "per-request sampling requires "
@@ -422,6 +428,12 @@ class Engine:
                 logit_bias = {int(t): float(v) for t, v in logit_bias.items()}
             if allowed_token_ids is not None:
                 allowed_token_ids = [int(t) for t in allowed_token_ids]
+        if json_schema is not None:
+            if regex is not None:
+                raise ValueError("pass regex OR json_schema, not both")
+            from shifu_tpu.infer.constrain import schema_to_regex
+
+            regex = schema_to_regex(json_schema)
         if regex is not None and constraint is not None:
             raise ValueError("pass regex OR constraint, not both")
         if regex is not None or constraint is not None:

@@ -222,6 +222,7 @@ class _Submission:
     allowed_token_ids: Optional[list] = None
     adapter: Optional[int] = None
     regex: Optional[str] = None
+    json_schema: Optional[dict] = None
 
 
 @dataclasses.dataclass
@@ -278,13 +279,13 @@ class EngineRunner:
         sampling: Optional[SampleConfig] = None,
         stop_token_ids=None, stop_strings=None,
         logit_bias=None, allowed_token_ids=None, adapter=None,
-        regex=None,
+        regex=None, json_schema=None,
     ) -> Completion:
         return self.complete_n(
             tokens, max_new_tokens, 1, timeout=timeout, sampling=sampling,
             stop_token_ids=stop_token_ids, stop_strings=stop_strings,
             logit_bias=logit_bias, allowed_token_ids=allowed_token_ids,
-            adapter=adapter, regex=regex,
+            adapter=adapter, regex=regex, json_schema=json_schema,
         )[0]
 
     def complete_n(
@@ -293,7 +294,7 @@ class EngineRunner:
         sampling: Optional[SampleConfig] = None,
         stop_token_ids=None, stop_strings=None,
         logit_bias=None, allowed_token_ids=None, adapter=None,
-        regex=None,
+        regex=None, json_schema=None,
     ):
         """N independent completions of one prompt (the API's ``n``).
 
@@ -326,6 +327,7 @@ class EngineRunner:
                         logit_bias=logit_bias,
                         allowed_token_ids=allowed_token_ids,
                         adapter=adapter, regex=regex,
+                        json_schema=json_schema,
                     )
                 )
         self._wake.set()
@@ -387,7 +389,7 @@ class EngineRunner:
                sampling: Optional[SampleConfig] = None,
                stop_token_ids=None, stop_strings=None,
                logit_bias=None, allowed_token_ids=None, adapter=None,
-               regex=None):
+               regex=None, json_schema=None):
         """Returns a generator of ("delta", (ids, logprobs)) items
         ending with ("done", Completion); tokens arrive as the engine
         emits them (per decode chunk). The submission (and the
@@ -412,6 +414,7 @@ class EngineRunner:
                     logit_bias=logit_bias,
                     allowed_token_ids=allowed_token_ids,
                     adapter=adapter, regex=regex,
+                    json_schema=json_schema,
                 )
             )
         self._wake.set()
@@ -578,6 +581,7 @@ class EngineRunner:
                     logit_bias=sub.logit_bias,
                     allowed_token_ids=sub.allowed_token_ids,
                     adapter=sub.adapter, regex=sub.regex,
+                    json_schema=sub.json_schema,
                 )
             except Exception as e:  # validation error -> the caller
                 with self._lock:
@@ -807,6 +811,11 @@ class _Handler(BaseHTTPRequestHandler):
             regex = req.get("regex")
             if regex is not None and not isinstance(regex, str):
                 raise ValueError("regex must be a string pattern")
+            json_schema = req.get("json_schema")
+            if json_schema is not None and not isinstance(
+                json_schema, dict
+            ):
+                raise ValueError("json_schema must be an object")
             want_logprobs = bool(req.get("logprobs"))
             n = int(req.get("n", 1))
             best_of = req.get("best_of")
@@ -824,6 +833,7 @@ class _Handler(BaseHTTPRequestHandler):
                     stop_strings, want_logprobs, chat=chat,
                     logit_bias=logit_bias, allowed_token_ids=allowed_ids,
                     adapter=adapter, regex=regex,
+                    json_schema=json_schema,
                 )
                 return
             if best_of is not None:
@@ -862,13 +872,15 @@ class _Handler(BaseHTTPRequestHandler):
                     or allowed_ids is not None
                     or adapter is not None
                     or regex is not None
+                    or json_schema is not None
                 ):
                     # Beam is deterministic max-logprob search; these
                     # fields would be silently dropped — refuse instead.
                     raise ValueError(
                         "best_of composes with none of temperature/"
                         "top_k/top_p/stop/stop_token_ids/logprobs/"
-                        "logit_bias/allowed_token_ids/adapter/regex"
+                        "logit_bias/allowed_token_ids/adapter/regex/"
+                        "json_schema"
                     )
                 out = self.runner.beam(
                     tokens, max_new, best_of,
@@ -899,7 +911,7 @@ class _Handler(BaseHTTPRequestHandler):
                     sampling=sampling, stop_token_ids=stop_token_ids,
                     stop_strings=stop_strings, logit_bias=logit_bias,
                     allowed_token_ids=allowed_ids, adapter=adapter,
-                    regex=regex,
+                    regex=regex, json_schema=json_schema,
                 )
                 choices = [
                     _build_choice(
@@ -916,7 +928,7 @@ class _Handler(BaseHTTPRequestHandler):
                 sampling=sampling, stop_token_ids=stop_token_ids,
                 stop_strings=stop_strings, logit_bias=logit_bias,
                 allowed_token_ids=allowed_ids, adapter=adapter,
-                regex=regex,
+                regex=regex, json_schema=json_schema,
             )
         except (ValueError, TypeError) as e:
             self._send(400, {"error": str(e)})
@@ -936,7 +948,7 @@ class _Handler(BaseHTTPRequestHandler):
         self, tokens, max_new: int, sampling=None,
         stop_token_ids=None, stop_strings=None, want_logprobs=False,
         chat: bool = False, logit_bias=None, allowed_token_ids=None,
-        adapter=None, regex=None,
+        adapter=None, regex=None, json_schema=None,
     ) -> None:
         """Server-sent events: one ``data:`` line per token delta, a
         final one with finished_by (and the definitive token count —
@@ -951,7 +963,7 @@ class _Handler(BaseHTTPRequestHandler):
             sampling=sampling, stop_token_ids=stop_token_ids,
             stop_strings=stop_strings, logit_bias=logit_bias,
             allowed_token_ids=allowed_token_ids, adapter=adapter,
-            regex=regex,
+            regex=regex, json_schema=json_schema,
         )
         self.send_response(200)
         self.send_header("Content-Type", "text/event-stream")

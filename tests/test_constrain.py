@@ -418,3 +418,115 @@ def test_token_bytes_hooks_are_raw():
         got = bpe.token_bytes(t)
         if t >= bpe._OFFSET:
             assert got == bpe._bytes_of[t - bpe._OFFSET]
+
+
+# ----------------------------------------------------- json-schema layer
+
+
+def test_schema_to_regex_validates_real_json():
+    """Strings matching the schema-derived pattern parse as JSON and
+    satisfy the schema's shape; violators don't match."""
+    from shifu_tpu.infer import schema_to_regex
+
+    sch = {
+        "type": "object",
+        "properties": {
+            "name": {"type": "string"},
+            "age": {"type": "integer"},
+            "score": {"type": "number"},
+            "ok": {"type": "boolean"},
+            "kind": {"enum": ["cat", "dog"]},
+            "tags": {"type": "array", "items": {"type": "string"}},
+            "meta": {
+                "type": "object",
+                "properties": {"v": {"type": "integer"}},
+            },
+        },
+    }
+    dfa = compile_regex(schema_to_regex(sch))
+    good = (
+        '{"name": "bo","age": -7,"score": 3.5,"ok": true,'
+        '"kind": "dog","tags": ["a", "b"],"meta": {"v": 1}}'
+    )
+    assert dfa.matches(good.encode())
+    parsed = json.loads(good)
+    assert parsed["kind"] == "dog" and parsed["meta"]["v"] == 1
+    for bad in (
+        '{"name": 3}',                      # wrong type, missing rest
+        good.replace('"dog"', '"fox"'),     # outside the enum
+        good.replace("-7", '"x"'),          # string where integer
+        good[:-1],                          # truncated
+    ):
+        assert not dfa.matches(bad.encode()), bad
+
+
+def test_schema_to_regex_rejects_unsupported():
+    from shifu_tpu.infer import schema_to_regex
+
+    with pytest.raises(ValueError, match="unsupported|properties"):
+        schema_to_regex({"type": "object"})
+    with pytest.raises(ValueError, match="unsupported"):
+        schema_to_regex({"type": "object", "properties": {
+            "x": {"type": "widget"},
+        }})
+
+
+def test_engine_json_schema_end_to_end(tiny):
+    """submit(json_schema=...) produces schema-valid JSON when it
+    finishes by eos (and a viable prefix otherwise); the server field
+    rides the same path."""
+    model, params = tiny
+    tok = ByteTokenizer()
+    sch = {"type": "object", "properties": {
+        "a": {"type": "integer"},
+        "b": {"enum": ["x", "y"]},
+    }}
+    done = _serve(
+        model, params,
+        [(tok.encode("give json: "), {"json_schema": sch})],
+        max_new=24, eos_id=tok.eos_id,
+    )[0]
+    text = tok.decode(done.tokens)
+    if done.finished_by == "eos":
+        parsed = json.loads(text)
+        assert isinstance(parsed["a"], int) and parsed["b"] in ("x", "y")
+    else:
+        from shifu_tpu.infer import schema_to_regex
+
+        dfa = compile_regex(schema_to_regex(sch))
+        s = 0
+        for byte in text.encode():
+            s = dfa.step(s, byte)
+            assert s != dfa.dead, text
+    with pytest.raises(ValueError, match="not both"):
+        eng = Engine(
+            model, params, max_slots=1, max_len=32,
+            prefill_buckets=(16, 32), enable_logit_bias=True,
+            tokenizer=tok,
+        )
+        eng.submit([1, 2], max_new_tokens=2, regex=r"\d",
+                   json_schema=sch)
+
+
+def test_schema_json_strictness():
+    """Everything the schema grammar accepts must PARSE as JSON:
+    leading-zero numbers, control characters, and raw non-ASCII bytes
+    in strings are all rejected (each is a string json.loads refuses,
+    so admitting it would break the schema-valid-at-eos guarantee)."""
+    from shifu_tpu.infer import schema_to_regex
+
+    sch = {"type": "object", "properties": {
+        "a": {"type": "integer"}, "s": {"type": "string"},
+    }}
+    dfa = compile_regex(schema_to_regex(sch))
+    for bad in (b'{"a": 007,"s": "x"}', b'{"a": 7,"s": "a\nb"}',
+                b'{"a": 7,"s": "\xff"}'):
+        assert not dfa.matches(bad), bad
+    for good in ('{"a": 0,"s": "ok!"}',
+                 '{"a": 3,"s": "CASE ^ ~ [x] ]"}'):
+        assert dfa.matches(good.encode())
+        json.loads(good)
+    with pytest.raises(ValueError, match="items"):
+        schema_to_regex({"type": "object", "properties": {
+            "x": {"type": "array"},
+        }})
