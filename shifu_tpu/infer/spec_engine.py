@@ -139,6 +139,13 @@ class _SpeculativeBase(PagedEngine):
                 "the verifier's per-position acceptance probabilities; "
                 "serve constrained requests with PagedEngine"
             )
+        if kw.get("lora") is not None:
+            raise NotImplementedError(
+                "multi-LoRA serving inside the speculative round "
+                "program is unwired (the verify/draft forwards do not "
+                "thread the adapter args); serve adapter requests "
+                "with PagedEngine"
+            )
         self.k = int(k)
         self.rounds_per_step = int(rounds_per_step)
         self.spec_proposed = 0
