@@ -57,6 +57,16 @@ from shifu_tpu.infer.engine import Completion, Engine
 from shifu_tpu.infer.sampling import SampleConfig
 
 
+def _usage(prompt_tokens: int, completions) -> dict:
+    """OpenAI-shaped usage block (token counts clients meter on)."""
+    gen = sum(len(c.tokens) for c in completions)
+    return {
+        "prompt_tokens": int(prompt_tokens),
+        "completion_tokens": int(gen),
+        "total_tokens": int(prompt_tokens) + int(gen),
+    }
+
+
 def _build_choice(done, tokenizer, want_logprobs, stop_strings) -> dict:
     """One completion's response dict — the SINGLE assembly point for
     tokens/finished_by/logprobs/decoded-and-trimmed text (n=1, n>1 and
@@ -665,6 +675,26 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):
         if self.path == "/healthz":
             self._send(200, self.runner.stats())
+        elif self.path == "/v1/models":
+            eng = self.runner.engine
+            cfg = getattr(eng.model, "cfg", None)
+            base = {
+                "id": type(eng.model).__name__.lower(),
+                "object": "model",
+                "engine": type(eng).__name__,
+                "vocab_size": getattr(cfg, "vocab_size", None),
+                "max_len": eng.max_len,
+            }
+            data = [base]
+            # Registered LoRA adapters serve as addressable "models"
+            # (picked per request via the "adapter" field).
+            for i in range(1, getattr(eng, "_n_adapters", 0) + 1):
+                data.append({
+                    "id": f"{base['id']}:adapter-{i}",
+                    "object": "model",
+                    "adapter": i,
+                })
+            self._send(200, {"object": "list", "data": data})
         else:
             self._send(404, {"error": f"no route {self.path}"})
 
@@ -903,7 +933,15 @@ class _Handler(BaseHTTPRequestHandler):
                     choices.append(c)
                 if chat:
                     choices = [self._as_chat_choice(c) for c in choices]
-                self._send(200, {"choices": choices})
+                gen = sum(len(c["tokens"]) for c in choices)
+                self._send(200, {
+                    "choices": choices,
+                    "usage": {
+                        "prompt_tokens": len(tokens),
+                        "completion_tokens": gen,
+                        "total_tokens": len(tokens) + gen,
+                    },
+                })
                 return
             if n > 1:
                 dones = self.runner.complete_n(
@@ -921,7 +959,10 @@ class _Handler(BaseHTTPRequestHandler):
                 ]
                 if chat:
                     choices = [self._as_chat_choice(c) for c in choices]
-                self._send(200, {"choices": choices})
+                self._send(200, {
+                    "choices": choices,
+                    "usage": _usage(len(tokens), dones),
+                })
                 return
             done = self.runner.complete(
                 tokens, max_new, timeout=self.request_timeout_s,
@@ -942,7 +983,9 @@ class _Handler(BaseHTTPRequestHandler):
         choice = _build_choice(
             done, self.tokenizer, want_logprobs, stop_strings
         )
-        self._send(200, self._as_chat_choice(choice) if chat else choice)
+        out = self._as_chat_choice(choice) if chat else choice
+        out["usage"] = _usage(len(tokens), [done])
+        self._send(200, out)
 
     def _stream_response(
         self, tokens, max_new: int, sampling=None,
@@ -998,6 +1041,7 @@ class _Handler(BaseHTTPRequestHandler):
                     final = {
                         "finished_by": payload.finished_by,
                         "n_tokens": len(payload.tokens),
+                        "usage": _usage(len(tokens), [payload]),
                     }
                     if want_logprobs:
                         final["logprobs"] = payload.logprobs

@@ -569,3 +569,70 @@ def test_min_p_field_through_server(tiny):
         server.shutdown()
         server.runner.shutdown()
         t.join(5)
+
+
+def test_usage_and_models_route(tiny):
+    """Responses carry OpenAI-shaped usage counts; /v1/models lists the
+    base model and registered adapters."""
+    from shifu_tpu.infer import LoraServingConfig
+    from shifu_tpu.train import LoraConfig, LoraModel
+
+    model, params = tiny
+    lm = LoraModel(model, params, LoraConfig(rank=4))
+    eng = PagedEngine(
+        model, params, page_size=8, max_slots=2, max_len=64,
+        prefill_buckets=(32, 64), sample_cfg=SampleConfig(temperature=0.0),
+        lora=LoraServingConfig(rank=4),
+    )
+    aid = eng.add_adapter(lm.init(jax.random.key(5)))
+    server, t, base = _serve(eng)
+    try:
+        status, out = _post(base, "/v1/completions", {
+            "tokens": [1, 2, 3, 4, 5], "max_new_tokens": 6,
+        })
+        assert status == 200
+        u = out["usage"]
+        assert u["prompt_tokens"] == 5
+        assert u["completion_tokens"] == len(out["tokens"]) == 6
+        assert u["total_tokens"] == 11
+
+        status, out = _post(base, "/v1/completions", {
+            "tokens": [1, 2, 3], "max_new_tokens": 4, "n": 2,
+        })
+        assert status == 200
+        u = out["usage"]
+        assert u["prompt_tokens"] == 3 and u["completion_tokens"] == 8
+
+        # best_of (beam) and streaming responses meter too.
+        status, out = _post(base, "/v1/completions", {
+            "tokens": [1, 2, 3], "max_new_tokens": 4, "best_of": 2,
+        })
+        assert status == 200 and out["usage"]["prompt_tokens"] == 3
+
+        import urllib.request
+
+        sreq = urllib.request.Request(
+            base + "/v1/completions",
+            json.dumps({"tokens": [1, 2, 3], "max_new_tokens": 3,
+                        "stream": True}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(sreq, timeout=120) as r:
+            events = [
+                json.loads(line[len(b"data: "):])
+                for line in r.read().splitlines()
+                if line.startswith(b"data: ") and line != b"data: [DONE]"
+            ]
+        assert events[-1]["usage"]["completion_tokens"] == 3
+        assert events[-1]["usage"]["prompt_tokens"] == 3
+
+        with urllib.request.urlopen(base + "/v1/models", timeout=30) as r:
+            models = json.loads(r.read())
+        assert models["object"] == "list"
+        ids = [m["id"] for m in models["data"]]
+        assert any(m.get("adapter") == aid for m in models["data"])
+        assert len(ids) == 2
+    finally:
+        server.shutdown()
+        server.runner.shutdown()
+        t.join(5)
