@@ -22,6 +22,7 @@ from __future__ import annotations
 import collections
 import dataclasses
 import itertools
+import time
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -118,6 +119,12 @@ class _Request:
     # immutable per request; rebuilding per emitted token is wasted
     # host work on the constrained hot loop).
     static_bias: Optional[object] = None
+    # Per-request trace (time.monotonic stamps; see Completion.timing).
+    created_ts: float = 0.0
+    admitted_ts: float = 0.0  # FIRST admission start (queue_ms's end)
+    first_token_ts: float = 0.0
+    prefill_ms: float = 0.0
+    preempts: int = 0
     # Tokens already cleared of stop matches (resume point for the
     # sweep's scan — keeps per-step stop checking incremental).
     stop_scanned: int = 0
@@ -131,6 +138,14 @@ class Completion:
     # Raw-model logprob (pre-temperature/filter distribution) of each
     # returned token — the conventional per-token logprobs surface.
     logprobs: Optional[List[float]] = None
+    # Per-request TRACE (milliseconds, host wall clock): queue_ms
+    # (submit -> admission), prefill_ms (the admission dispatch, incl.
+    # every chunk for chunked prefill and every re-prefill after a
+    # preemption), ttft_ms (submit -> first token), decode_ms (first
+    # token -> finish), total_ms, preemptions, decode_tokens_per_s.
+    # The serving front-end returns this as "timing" and aggregates
+    # p50/p95 ttft/throughput into /healthz.
+    timing: Optional[dict] = None
 
 
 class Engine:
@@ -228,6 +243,8 @@ class Engine:
         self.sharding_rules = sharding_rules
         self.tokenizer = tokenizer
         self.cancellations = 0  # observability: cancel() calls that hit
+        # Last-N completion traces for latency_stats() (p50/p95 ttft).
+        self._trace_window = collections.deque(maxlen=256)
         if decode_chunk < 1:
             raise ValueError(f"decode_chunk must be >= 1, got {decode_chunk}")
         self.decode_chunk = int(decode_chunk)
@@ -556,6 +573,7 @@ class Engine:
                 logit_bias=logit_bias, allowed_token_ids=allowed_token_ids,
                 adapter=int(adapter) if adapter else 0,
                 constraint=constraint,
+                created_ts=time.monotonic(),
             )
         )
         return rid
@@ -1172,6 +1190,38 @@ class Engine:
             req.stop_scanned = len(gen)
         return best
 
+    def _timing(self, req: _Request, n_tokens: int) -> dict:
+        """Close out one request's trace (Completion.timing)."""
+        now = time.monotonic()
+        ft = req.first_token_ts or now
+        ttft = 1000 * (ft - req.created_ts) if req.created_ts else 0.0
+        decode_ms = 1000 * (now - ft)
+        # queue_ms is STAMPED (submit -> first admission start), not
+        # derived by subtracting prefill from ttft: prefill_ms also
+        # accumulates post-first-token re-prefills (preemption
+        # recompute, chunked prefill), which would falsely zero the
+        # queue of any preempted request.
+        queued = (
+            1000 * (req.admitted_ts - req.created_ts)
+            if req.admitted_ts and req.created_ts
+            else 0.0
+        )
+        t = {
+            "queue_ms": round(max(queued, 0.0), 2),
+            "prefill_ms": round(req.prefill_ms, 2),
+            "ttft_ms": round(ttft, 2),
+            "decode_ms": round(decode_ms, 2),
+            "total_ms": round(ttft + decode_ms, 2),
+            "preemptions": req.preempts,
+        }
+        if n_tokens > 1 and decode_ms > 0:
+            # First token lands at prefill; the rest amortise decode.
+            t["decode_tokens_per_s"] = round(
+                (n_tokens - 1) / (decode_ms / 1000), 1
+            )
+        self._trace_window.append(t)
+        return t
+
     def _sweep(self) -> List[Completion]:
         out: List[Completion] = []
         for slot, req in list(self._active.items()):
@@ -1185,6 +1235,7 @@ class Engine:
                     Completion(
                         req.rid, req.generated[:cut], "stop",
                         logprobs=req.logprobs[:cut],
+                        timing=self._timing(req, cut),
                     )
                 )
                 del self._active[slot]
@@ -1201,12 +1252,41 @@ class Engine:
                         list(req.generated),
                         "eos" if hit_eos else "length",
                         logprobs=list(req.logprobs),
+                        timing=self._timing(req, len(req.generated)),
                     )
                 )
                 del self._active[slot]
                 self._release(slot)
                 self._free.append(slot)
         return out
+
+    def latency_stats(self) -> dict:
+        """Aggregates over the last 256 completions' traces — the
+        serving /healthz surface. ttft reports p50/p95 (latency: the
+        TAIL is the high percentile); per-request decode throughput
+        reports p50/p05 (throughput: the tail is the LOW percentile —
+        `decode_tokens_per_s_p05` is the slow-request floor SLOs are
+        written against)."""
+        win = list(self._trace_window)
+        if not win:
+            return {"completions": 0}
+
+        def pct(key, q):
+            vals = sorted(t[key] for t in win if key in t)
+            if not vals:
+                return None
+            return vals[min(int(q * len(vals)), len(vals) - 1)]
+
+        return {
+            "completions": len(win),
+            "ttft_ms_p50": pct("ttft_ms", 0.50),
+            "ttft_ms_p95": pct("ttft_ms", 0.95),
+            "decode_tokens_per_s_p50": pct("decode_tokens_per_s", 0.50),
+            "decode_tokens_per_s_p05": pct("decode_tokens_per_s", 0.05),
+            "preempted_fraction": round(
+                sum(1 for t in win if t["preemptions"]) / len(win), 4
+            ),
+        }
 
     def run(self) -> List[Completion]:
         """Drain everything; completions in finish order."""
@@ -1227,6 +1307,9 @@ class Engine:
         padded = np.zeros((bucket,), np.int32)
         padded[:p] = req.tokens
         self._rng, sub = jax.random.split(self._rng)
+        t0 = time.monotonic()
+        if not req.admitted_ts:
+            req.admitted_ts = t0
         first, lp = self._dispatch_prefill(
             slot, padded, p, bucket, sub,
             self._req_sampling_args(req)
@@ -1234,6 +1317,7 @@ class Engine:
             + self._req_bias_args(req)
             + self._req_lora_args(req),
         )
+        req.prefill_ms += 1000 * (time.monotonic() - t0)
         self._finish_admission(req, slot, p, first, lp)
 
     def _dispatch_prefill(self, slot, padded, p, bucket, rng, samp=()):
@@ -1263,6 +1347,8 @@ class Engine:
             self._row_minp[slot] = mp
         self._lengths[slot] = p
         self._cur[slot] = int(first)
+        if not req.first_token_ts:
+            req.first_token_ts = time.monotonic()
         req.generated.append(int(first))
         req.logprobs.append(float(lp))
         if self.enable_penalties:
@@ -1682,6 +1768,7 @@ class PagedEngine(Engine):
         self._free.append(slot)
         req.slot = None
         self._queue.appendleft(req)
+        req.preempts += 1
         self.preemptions += 1
 
     @staticmethod
@@ -1796,6 +1883,9 @@ class PagedEngine(Engine):
             + self._req_bias_args(req)
             + self._req_lora_args(req)
         )
+        t0 = time.monotonic()
+        if not req.admitted_ts:
+            req.admitted_ts = t0
         if hit:
             first, lp = self._dispatch_prefill_at(
                 slot, padded, len(suffix), hit, bucket, sub, samp=samp,
@@ -1806,6 +1896,7 @@ class PagedEngine(Engine):
             first, lp = self._dispatch_prefill(
                 slot, padded, p, bucket, sub, samp
             )
+        req.prefill_ms += 1000 * (time.monotonic() - t0)
         # Keep only the pages that hold real tokens; the bucket's tail
         # pages hold masked garbage and go straight back to the pool.
         keep = -(-len(suffix) // ps)
@@ -1899,6 +1990,9 @@ class PagedEngine(Engine):
             # whose bucket rounds past max_len needs the slack-widened
             # row (a distinct compiled program per table width).
             narrow = off // ps + need <= self.pages_per_slot
+            t0 = time.monotonic()
+            if not req.admitted_ts:
+                req.admitted_ts = t0
             first, lp = self._dispatch_prefill_at(
                 slot, padded, this_chunk, off, bucket, sub,
                 row=row[: self.pages_per_slot] if narrow else row,
@@ -1910,6 +2004,7 @@ class PagedEngine(Engine):
                 ),
                 final_len=len(prompt),
             )
+            req.prefill_ms += 1000 * (time.monotonic() - t0)
             # Bucket-tail pages hold only masked garbage; return them.
             keep = -(-this_chunk // ps)
             self._free_pages.extend(own[keep:])

@@ -72,6 +72,8 @@ def _build_choice(done, tokenizer, want_logprobs, stop_strings) -> dict:
     tokens/finished_by/logprobs/decoded-and-trimmed text (n=1, n>1 and
     SSE final events must not drift apart)."""
     c = {"tokens": done.tokens, "finished_by": done.finished_by}
+    if done.timing is not None:
+        c["timing"] = done.timing
     if want_logprobs:
         c["logprobs"] = done.logprobs
     if tokenizer is not None:
@@ -491,6 +493,8 @@ class EngineRunner:
         ):
             if hasattr(eng, attr):
                 out[attr] = getattr(eng, attr)
+        if hasattr(eng, "latency_stats"):
+            out["latency"] = eng.latency_stats()
         return out
 
     def shutdown(self, timeout: float = 10.0) -> None:

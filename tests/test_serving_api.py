@@ -379,3 +379,46 @@ def test_http_stream_rejects_n_and_best_of(tiny, served):
             raise AssertionError("expected 400")
         except urllib.error.HTTPError as e:
             assert e.code == 400
+
+
+def test_request_traces_and_latency_stats(tiny):
+    """Every completion carries a coherent timing trace (queue +
+    prefill <= ttft <= total; preemption counts recorded), and the
+    engine aggregates a latency window for /healthz."""
+    import jax as _jax
+
+    model, params = tiny
+    prompts = [
+        np.random.RandomState(31).randint(1, 256, size=n).tolist()
+        for n in (5, 9, 7)
+    ]
+    eng = PagedEngine(
+        model, params, page_size=8, max_slots=2, max_len=48,
+        prefill_buckets=(16, 48), sample_cfg=SampleConfig(temperature=0.0),
+    )
+    rids = [eng.submit(p, max_new_tokens=8) for p in prompts]
+    done = {c.rid: c for c in eng.run()}
+    for r in rids:
+        t = done[r].timing
+        assert t is not None
+        assert t["prefill_ms"] > 0
+        assert t["ttft_ms"] >= t["prefill_ms"] * 0.5  # same clock, sane
+        assert t["total_ms"] >= t["ttft_ms"]
+        assert t["preemptions"] == 0
+        assert t["decode_tokens_per_s"] > 0
+    stats = eng.latency_stats()
+    assert stats["completions"] == 3
+    assert stats["ttft_ms_p50"] > 0
+    assert stats["preempted_fraction"] == 0.0
+
+    # Preemptions are traced: a tight pool forces at least one.
+    tight = PagedEngine(
+        model, params, page_size=4, n_pages=6, max_slots=2, max_len=16,
+        prefill_buckets=(8, 16), sample_cfg=SampleConfig(temperature=0.0),
+    )
+    trids = [
+        tight.submit(p[:5], max_new_tokens=8) for p in prompts[:2]
+    ]
+    tdone = {c.rid: c for c in tight.run()}
+    assert tight.preemptions >= 1
+    assert sum(tdone[r].timing["preemptions"] for r in trids) >= 1
