@@ -748,6 +748,7 @@ def cmd_serve(args) -> int:
         port=args.port,
         tokenizer=tok,
         default_max_new=args.max_new_tokens,
+        trace_log=args.trace_log,
     )
     print(
         json.dumps(
@@ -962,6 +963,9 @@ def main(argv=None) -> int:
                    help="honour logit_bias / allowed_token_ids fields "
                         "(slots x vocab f32 bias buffer; implies "
                         "--per-request-sampling)")
+    s.add_argument("--trace-log",
+                   help="append one JSON line per completed request "
+                        "(timing spans) to this file")
     s.add_argument("--lora-ckpt-dir", action="append",
                    help="LoRA adapter checkpoint dir (repeatable; "
                         "adapter ids are assigned 1..n in flag order; "

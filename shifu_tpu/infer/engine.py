@@ -20,8 +20,10 @@ repository (SURVEY.md); there is no reference serving engine to match.
 from __future__ import annotations
 
 import collections
+import contextlib
 import dataclasses
 import itertools
+import threading
 import time
 from typing import Dict, List, Optional
 
@@ -244,7 +246,11 @@ class Engine:
         self.tokenizer = tokenizer
         self.cancellations = 0  # observability: cancel() calls that hit
         # Last-N completion traces for latency_stats() (p50/p95 ttft).
+        # The lock covers append (engine thread) vs snapshot (any HTTP
+        # handler thread hitting /healthz) — an unguarded list() over a
+        # deque being appended raises "mutated during iteration".
         self._trace_window = collections.deque(maxlen=256)
+        self._trace_lock = threading.Lock()
         if decode_chunk < 1:
             raise ValueError(f"decode_chunk must be >= 1, got {decode_chunk}")
         self.decode_chunk = int(decode_chunk)
@@ -1190,6 +1196,20 @@ class Engine:
             req.stop_scanned = len(gen)
         return best
 
+    @contextlib.contextmanager
+    def _timed_prefill(self, req: _Request):
+        """Wrap ONE prefill dispatch: stamps the first admission start
+        (queue_ms's end) and accumulates the dispatch into prefill_ms.
+        Every admission path must use this — a path that forgets it
+        reports queue_ms covering its prefill and prefill_ms 0."""
+        t0 = time.monotonic()
+        if not req.admitted_ts:
+            req.admitted_ts = t0
+        try:
+            yield
+        finally:
+            req.prefill_ms += 1000 * (time.monotonic() - t0)
+
     def _timing(self, req: _Request, n_tokens: int) -> dict:
         """Close out one request's trace (Completion.timing)."""
         now = time.monotonic()
@@ -1219,7 +1239,8 @@ class Engine:
             t["decode_tokens_per_s"] = round(
                 (n_tokens - 1) / (decode_ms / 1000), 1
             )
-        self._trace_window.append(t)
+        with self._trace_lock:
+            self._trace_window.append(t)
         return t
 
     def _sweep(self) -> List[Completion]:
@@ -1267,7 +1288,8 @@ class Engine:
         reports p50/p05 (throughput: the tail is the LOW percentile —
         `decode_tokens_per_s_p05` is the slow-request floor SLOs are
         written against)."""
-        win = list(self._trace_window)
+        with self._trace_lock:
+            win = list(self._trace_window)
         if not win:
             return {"completions": 0}
 
@@ -1307,17 +1329,14 @@ class Engine:
         padded = np.zeros((bucket,), np.int32)
         padded[:p] = req.tokens
         self._rng, sub = jax.random.split(self._rng)
-        t0 = time.monotonic()
-        if not req.admitted_ts:
-            req.admitted_ts = t0
-        first, lp = self._dispatch_prefill(
-            slot, padded, p, bucket, sub,
-            self._req_sampling_args(req)
-            + self._req_penalty_args(req)
-            + self._req_bias_args(req)
-            + self._req_lora_args(req),
-        )
-        req.prefill_ms += 1000 * (time.monotonic() - t0)
+        with self._timed_prefill(req):
+            first, lp = self._dispatch_prefill(
+                slot, padded, p, bucket, sub,
+                self._req_sampling_args(req)
+                + self._req_penalty_args(req)
+                + self._req_bias_args(req)
+                + self._req_lora_args(req),
+            )
         self._finish_admission(req, slot, p, first, lp)
 
     def _dispatch_prefill(self, slot, padded, p, bucket, rng, samp=()):
@@ -1883,20 +1902,17 @@ class PagedEngine(Engine):
             + self._req_bias_args(req)
             + self._req_lora_args(req)
         )
-        t0 = time.monotonic()
-        if not req.admitted_ts:
-            req.admitted_ts = t0
-        if hit:
-            first, lp = self._dispatch_prefill_at(
-                slot, padded, len(suffix), hit, bucket, sub, samp=samp,
-                final_len=p,
-            )
-            self.prefix_hits_tokens += hit
-        else:
-            first, lp = self._dispatch_prefill(
-                slot, padded, p, bucket, sub, samp
-            )
-        req.prefill_ms += 1000 * (time.monotonic() - t0)
+        with self._timed_prefill(req):
+            if hit:
+                first, lp = self._dispatch_prefill_at(
+                    slot, padded, len(suffix), hit, bucket, sub,
+                    samp=samp, final_len=p,
+                )
+                self.prefix_hits_tokens += hit
+            else:
+                first, lp = self._dispatch_prefill(
+                    slot, padded, p, bucket, sub, samp
+                )
         # Keep only the pages that hold real tokens; the bucket's tail
         # pages hold masked garbage and go straight back to the pool.
         keep = -(-len(suffix) // ps)
@@ -1990,21 +2006,18 @@ class PagedEngine(Engine):
             # whose bucket rounds past max_len needs the slack-widened
             # row (a distinct compiled program per table width).
             narrow = off // ps + need <= self.pages_per_slot
-            t0 = time.monotonic()
-            if not req.admitted_ts:
-                req.admitted_ts = t0
-            first, lp = self._dispatch_prefill_at(
-                slot, padded, this_chunk, off, bucket, sub,
-                row=row[: self.pages_per_slot] if narrow else row,
-                samp=(
-                    self._req_sampling_args(req)
-                    + self._req_penalty_args(req)
-                    + self._req_bias_args(req)
-                    + self._req_lora_args(req)
-                ),
-                final_len=len(prompt),
-            )
-            req.prefill_ms += 1000 * (time.monotonic() - t0)
+            with self._timed_prefill(req):
+                first, lp = self._dispatch_prefill_at(
+                    slot, padded, this_chunk, off, bucket, sub,
+                    row=row[: self.pages_per_slot] if narrow else row,
+                    samp=(
+                        self._req_sampling_args(req)
+                        + self._req_penalty_args(req)
+                        + self._req_bias_args(req)
+                        + self._req_lora_args(req)
+                    ),
+                    final_len=len(prompt),
+                )
             # Bucket-tail pages hold only masked garbage; return them.
             keep = -(-this_chunk // ps)
             self._free_pages.extend(own[keep:])

@@ -260,9 +260,15 @@ class EngineRunner:
     the engine from the caller's thread.
     """
 
-    def __init__(self, engine: Engine, *, poll_idle_s: float = 0.005):
+    def __init__(self, engine: Engine, *, poll_idle_s: float = 0.005,
+                 trace_log: Optional[str] = None):
         self.engine = engine
         self._poll_idle_s = poll_idle_s
+        # Optional per-request trace log: one JSON line per completion
+        # (rid, finished_by, n_tokens + the Completion.timing spans) —
+        # the persistent record operators join against client logs.
+        # Line-buffered; written only from the engine thread.
+        self._trace_f = open(trace_log, "a", buffering=1) if trace_log else None
         self._lock = threading.Lock()
         self._inbox: collections.deque = collections.deque()
         self._cancels: collections.deque = collections.deque()  # rids
@@ -501,6 +507,11 @@ class EngineRunner:
         self._stop.set()
         self._wake.set()
         self._thread.join(timeout)
+        if self._trace_f is not None:
+            try:
+                self._trace_f.close()
+            finally:
+                self._trace_f = None
         # Unblock anyone still waiting: their work died with the loop.
         with self._lock:
             pending = list(self._inbox)
@@ -636,6 +647,32 @@ class EngineRunner:
                         w.push(gen[w.sent :], lps[w.sent :])
                         w.sent = len(gen)
                 for done in done_now:
+                    if self._trace_f is not None:
+                        rec = {
+                            "rid": done.rid,
+                            "finished_by": done.finished_by,
+                            "n_tokens": len(done.tokens),
+                            **(done.timing or {}),
+                        }
+                        try:
+                            self._trace_f.write(json.dumps(rec) + "\n")
+                        except Exception as e:
+                            # A full disk must not take down serving —
+                            # but going silent would strand operators
+                            # joining traces hours later: close the
+                            # handle and say so once.
+                            import sys as _sys
+
+                            print(
+                                f"trace_log disabled after write "
+                                f"failure: {e!r}",
+                                file=_sys.stderr,
+                            )
+                            try:
+                                self._trace_f.close()
+                            except Exception:
+                                pass
+                            self._trace_f = None
                     with self._lock:
                         w = self._waiters.pop(done.rid, None)
                     if w is not None:
@@ -1094,6 +1131,7 @@ def make_server(
     tokenizer=None,
     default_max_new: int = 128,
     request_timeout_s: Optional[float] = None,
+    trace_log: Optional[str] = None,
 ) -> ThreadingHTTPServer:
     """Build (not start) the HTTP server; ``.runner`` holds the engine
     thread. Serve with ``serve_forever()``; stop with ``shutdown()``
@@ -1103,7 +1141,7 @@ def make_server(
     # own.
     if tokenizer is not None and getattr(engine, "tokenizer", None) is None:
         engine.tokenizer = tokenizer
-    runner = EngineRunner(engine)
+    runner = EngineRunner(engine, trace_log=trace_log)
     handler = type(
         "BoundHandler",
         (_Handler,),

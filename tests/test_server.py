@@ -636,3 +636,33 @@ def test_usage_and_models_route(tiny):
         server.shutdown()
         server.runner.shutdown()
         t.join(5)
+
+
+def test_trace_log_jsonl(tiny, tmp_path):
+    """--trace-log appends one JSON line per completion with the
+    timing spans (the operator-side record)."""
+    model, params = tiny
+    eng = PagedEngine(
+        model, params, page_size=8, max_slots=2, max_len=32,
+        prefill_buckets=(16, 32), sample_cfg=SampleConfig(temperature=0.0),
+    )
+    path = str(tmp_path / "trace.jsonl")
+    server = make_server(eng, port=0, trace_log=path)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    base = f"http://127.0.0.1:{server.server_port}"
+    try:
+        for n in (3, 5):
+            status, _ = _post(base, "/v1/completions", {
+                "tokens": list(range(1, n + 1)), "max_new_tokens": 4,
+            })
+            assert status == 200
+    finally:
+        server.shutdown()
+        server.runner.shutdown()
+        t.join(5)
+    lines = [json.loads(x) for x in open(path) if x.strip()]
+    assert len(lines) == 2
+    for rec in lines:
+        assert rec["n_tokens"] == 4
+        assert rec["ttft_ms"] > 0 and rec["finished_by"] == "length"
