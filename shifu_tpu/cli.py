@@ -366,7 +366,21 @@ def cmd_grpo(args) -> int:
     optimizer = _build_optimizer(args, args.steps)
     mesh = _build_mesh(args.mesh) if args.mesh else None
 
-    targets = {tuple(ids): t for ids, t in rows}
+    # Rewards key off the prompt's token sequence; two examples with
+    # the same tokens but DIFFERENT targets would silently score every
+    # earlier duplicate against the last-seen answer — refuse loudly.
+    targets = {}
+    for ids, t in rows:
+        key = tuple(ids)
+        if key in targets and targets[key] != t:
+            print(
+                f"duplicate prompt with conflicting targets "
+                f"({targets[key]!r} vs {t!r}): rewards are keyed by "
+                "prompt tokens — dedupe the data or merge the targets",
+                file=sys.stderr,
+            )
+            return 2
+        targets[key] = t
 
     def reward(prompt_ids, gen_ids):
         want = targets[tuple(prompt_ids)]

@@ -320,6 +320,15 @@ class Engine:
             self._bias_dev = jnp.zeros(
                 (max_slots, self.model.cfg.vocab_size), jnp.float32
             )
+            # Donated row-scatter for the constrained hot loop: all
+            # constrained slots' new masks land in ONE in-place update
+            # per dispatch (the naive per-slot .at[].set rebuilt the
+            # full (slots, vocab) buffer once per constrained slot per
+            # token — O(slots * vocab) copies on the hot path).
+            self._bias_update_jit = jax.jit(
+                lambda buf, idx, rows: buf.at[idx].set(rows),
+                donate_argnums=(0,),
+            )
 
         # Multi-LoRA serving: stacked per-target factor tables, device-
         # resident (index 0 = all-zero no-adapter row; registration is
@@ -459,6 +468,30 @@ class Engine:
             regex = schema_to_regex(json_schema)
         if regex is not None and constraint is not None:
             raise ValueError("pass regex OR constraint, not both")
+        if constraint is not None:
+            # Validate the prebuilt FSM NOW: a vocab mismatch would
+            # otherwise surface as an opaque shape/broadcast error on
+            # the engine thread at admission (the server maps a
+            # submit-time ValueError to 400; an engine-thread fault
+            # kills serving for every client).
+            cv = getattr(constraint, "vocab", None)
+            if cv != self.model.cfg.vocab_size:
+                raise ValueError(
+                    f"constraint.vocab {cv} != model vocab_size "
+                    f"{self.model.cfg.vocab_size} — the TokenFSM was "
+                    "built for a different tokenizer/model"
+                )
+            ce = getattr(constraint, "eos_id", None)
+            if ce != self.eos_id:
+                import warnings
+
+                warnings.warn(
+                    f"constraint.eos_id {ce} != engine eos_id "
+                    f"{self.eos_id}: the FSM will not allow the "
+                    "engine's eos at accepting states (the request can "
+                    "only finish by budget)",
+                    stacklevel=2,
+                )
         if regex is not None or constraint is not None:
             if not self.enable_logit_bias:
                 raise ValueError(
@@ -726,6 +759,7 @@ class Engine:
             if cts:
                 self._counts_dev = cts[0]
             nxt, lps = np.asarray(nxt), np.asarray(lps)
+            bias_updates: List[tuple] = []
             for slot, req in self._active.items():
                 token = int(nxt[slot])
                 req.generated.append(token)
@@ -743,22 +777,28 @@ class Engine:
                         req.logprobs.pop()
                         req.max_new_tokens = max(len(req.generated), 1)
                         continue
-                    # Advance the FSM with the emitted token and put
-                    # the NEXT state's mask on the slot's bias row —
-                    # one (vocab,) device write per constrained token.
+                    # Advance the FSM with the emitted token; the NEXT
+                    # state's mask joins this dispatch's batched row
+                    # scatter below.
                     req.fsm_state = req.constraint.advance(
                         req.fsm_state, token
                     )
                     allow = req.constraint.allowed(req.fsm_state)
                     row = self._static_row(req)
-                    self._bias_dev = self._bias_dev.at[slot].set(
-                        jnp.asarray(
-                            np.where(allow, row, NEG_INF).astype(
-                                np.float32
-                            )
-                        )
+                    bias_updates.append(
+                        (slot, np.where(allow, row, NEG_INF).astype(
+                            np.float32
+                        ))
                     )
                     self._check_fsm_exhausted(req)
+            if bias_updates:
+                self._bias_dev = self._bias_update_jit(
+                    self._bias_dev,
+                    jnp.asarray(
+                        np.array([s for s, _ in bias_updates], np.int32)
+                    ),
+                    jnp.asarray(np.stack([r for _, r in bias_updates])),
+                )
         else:
             remaining = np.zeros((self.max_slots,), np.int32)
             for slot, req in self._active.items():

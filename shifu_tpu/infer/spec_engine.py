@@ -86,6 +86,15 @@ def prompt_lookup_propose(buf, n, k: int, g: int):
     """
     b, L = buf.shape
     jmax = L - g - k
+    if jmax < 1:
+        # Zero-width window grid: ``eq``/``valid`` would be empty and
+        # the masked max below would error opaquely. The engine sizes
+        # its buffer past this (_buf_len check); standalone callers get
+        # the explicit contract instead.
+        raise ValueError(
+            f"history buffer too short: need L - g - k >= 1, got "
+            f"L={L}, g={g}, k={k}"
+        )
     # The trailing g-gram, gathered at n-g .. n-1 (clamped; short rows
     # are handled by the validity mask below — with n <= g no window
     # start is valid, so they take the fallback).

@@ -53,7 +53,7 @@ objective follows the published GRPO formulation.
 from __future__ import annotations
 
 import dataclasses
-from typing import Callable, List, Optional, Sequence
+from typing import Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -212,7 +212,7 @@ def grpo_rollout(
     max_new_tokens: int,
     seq_len: int,
     pad_id: int = 0,
-) -> dict:
+) -> Tuple[dict, dict]:
     """Sample G completions per prompt through ``engine`` and build the
     GRPO train batch.
 
