@@ -396,7 +396,10 @@ def test_nfa_budget_caps_hostile_patterns():
     t0 = time.monotonic()
     with pytest.raises(ValueError, match="NFA"):
         compile_regex("(((a{60}){60}){60}){60}")
-    assert time.monotonic() - t0 < 5.0
+    # Loose wall bound: the uncapped expansion would run for HOURS, so
+    # any same-order-of-seconds finish proves the cap fired; a tight
+    # bound just flakes under CI load.
+    assert time.monotonic() - t0 < 60.0
 
 
 def test_token_bytes_hooks_are_raw():
