@@ -182,6 +182,7 @@ class Engine:
         enable_logit_bias: bool = False,
         lora: Optional[LoraServingConfig] = None,
         tokenizer=None,
+        fsm_device_states: int = 1024,
     ):
         """``per_request_sampling``: temperature/top-k/top-p become
         per-slot TRACED arrays in the decode/prefill programs, so one
@@ -329,6 +330,32 @@ class Engine:
                 lambda buf, idx, rows: buf.at[idx].set(rows),
                 donate_argnums=(0,),
             )
+
+        # Device-resident FSM transition tables (constrained decoding
+        # on engines that advance >1 token per dispatch: chunked decode
+        # and the speculative round programs — the host cannot mask
+        # token N+1 before seeing token N, so the DFA advance must ride
+        # the device program). The pool is one (fsm_device_states,
+        # vocab) int16 array of ABSOLUTE next-state rows (-1 = token
+        # not allowed): device advance is a single
+        # ``pool[state, token]`` gather, no per-slot base arithmetic.
+        # Allocated lazily at the first constrained submit; per-token
+        # engines (decode_chunk == 1, non-speculative) never allocate
+        # it and keep the host-side advance.
+        if fsm_device_states < 1 or fsm_device_states > 32000:
+            raise ValueError(
+                "fsm_device_states must be in [1, 32000] (absolute "
+                f"states are int16), got {fsm_device_states}"
+            )
+        self.fsm_device_states = int(fsm_device_states)
+        self._fsm_pool_np: Optional[np.ndarray] = None
+        self._fsm_pool_dev = None
+        self._fsm_base: Dict[object, tuple] = {}  # TokenFSM -> (base, S)
+        self._fsm_used = 0
+        self._fsm_lock = threading.Lock()
+        # Device-FSM mode: any engine whose dispatch can emit more than
+        # one token per row (chunked decode, speculative rounds).
+        self._device_fsm = self._decode_reach() > 1
 
         # Multi-LoRA serving: stacked per-target factor tables, device-
         # resident (index 0 = all-zero no-adapter row; registration is
@@ -499,13 +526,6 @@ class Engine:
                     "Engine(enable_logit_bias=True) — the FSM mask "
                     "rides the bias buffer"
                 )
-            if self._decode_reach() > 1:
-                raise ValueError(
-                    "regex/constraint needs per-token dispatch: the "
-                    "host advances the FSM between steps "
-                    "(decode_chunk must be 1; speculative engines "
-                    "cannot serve constrained requests)"
-                )
             if regex is not None:
                 if self.tokenizer is None:
                     raise ValueError(
@@ -539,6 +559,12 @@ class Engine:
                     cache[regex] = constraint
                     while len(cache) > 64:
                         cache.popitem(last=False)
+            if self._device_fsm:
+                # Chunked/speculative engines advance the DFA on
+                # device: the pattern's dense next-state table must fit
+                # the pool. Raises ValueError (submit-time, maps to a
+                # clean 400 on the server) when it cannot.
+                self._register_fsm(constraint)
             first_allow = constraint.allowed(
                 constraint.initial_state
             ).copy()
@@ -820,6 +846,10 @@ class Engine:
                 req.logprobs.extend(float(x) for x in lps[slot, :n])
                 self._lengths[slot] = int(lengths2[slot])
                 self._cur[slot] = int(cur2[slot])
+                # Device-FSM engines advanced the DFA on device; the
+                # host mirror replays the emitted tokens (and clamps
+                # the budget when the constraint is exhausted).
+                self._replay_fsm(req, n)
 
     def _try_admit(self, req: "_Request") -> bool:
         """Admit ``req`` (a free slot is guaranteed by the caller).
@@ -834,11 +864,11 @@ class Engine:
     def _decode_extra_args(self) -> tuple:
         """Extra positional args for _decode_impl, before rng:
         per-slot sampling arrays, then penalty arrays, then the bias
-        buffer, then the lora tables + row ids (flat; impls re-split
-        with _split_extra)."""
+        buffer, then the FSM pool + states, then the lora tables + row
+        ids (flat; impls re-split with _split_extra)."""
         return (
             self._sampling_args() + self._penalty_args()
-            + self._bias_args() + self._lora_args()
+            + self._bias_args() + self._fsm_args() + self._lora_args()
         )
 
     def _lora_args(self) -> tuple:
@@ -923,6 +953,157 @@ class Engine:
             return ()
         return (self._bias_dev,)
 
+    # ------------------------------------------ device-resident FSMs
+    def _register_fsm(self, fsm) -> None:
+        """Ensure ``fsm`` has rows in the device pool (device-FSM
+        engines only). The pool holds ABSOLUTE next-state rows: for an
+        FSM at base b, ``pool[b + s, t] = b + dense[s, t]`` (-1 where
+        the token is banned), so the device advance is one gather with
+        no per-slot base bookkeeping. One upload per distinct pattern;
+        requests sharing a TokenFSM (the submit-side pattern cache)
+        share the rows. When the pool fills, FSMs no live request
+        references are evicted (repack); a pattern that still cannot
+        fit raises ValueError at submit."""
+        with self._fsm_lock:
+            if fsm in self._fsm_base:
+                return
+            dense = fsm.dense_next()
+            if dense is None:
+                raise ValueError(
+                    f"pattern compiles to {fsm.n_states} DFA states x "
+                    f"{fsm.vocab} vocab — past the dense-table budget "
+                    "for device-resident constrained decoding; serve "
+                    "it on a per-token engine (decode_chunk=1, "
+                    "non-speculative)"
+                )
+            S = dense.shape[0]
+            cap = self.fsm_device_states
+            if S > cap:
+                raise ValueError(
+                    f"pattern needs {S} DFA states; the device FSM "
+                    f"pool holds {cap} (Engine fsm_device_states)"
+                )
+            if self._fsm_used + S > cap:
+                self._fsm_repack()
+            if self._fsm_used + S > cap:
+                raise ValueError(
+                    f"device FSM pool full ({self._fsm_used}/{cap} "
+                    "states held by live constrained requests); raise "
+                    "fsm_device_states or retry after they finish"
+                )
+            if self._fsm_pool_np is None:
+                self._fsm_pool_np = np.full(
+                    (cap, self.model.cfg.vocab_size), -1, np.int16
+                )
+            base = self._fsm_used
+            d32 = dense.astype(np.int32)
+            self._fsm_pool_np[base : base + S] = np.where(
+                d32 >= 0, d32 + base, -1
+            ).astype(np.int16)
+            self._fsm_base[fsm] = (base, S)
+            self._fsm_used = base + S
+            self._fsm_pool_dev = jnp.asarray(self._fsm_pool_np)
+
+    def _fsm_repack(self) -> None:
+        """Drop pool rows of FSMs no queued/active request references
+        and compact the rest (absolute states rebased; per-dispatch
+        state uploads recompute bases so nothing else moves). Caller
+        holds _fsm_lock."""
+        live = set()
+        for req in itertools.chain(
+            self._queue, self._active.values(), self._prefilling.values()
+        ):
+            if req.constraint is not None:
+                live.add(id(req.constraint))
+        old = self._fsm_pool_np
+        entries = [
+            (f, b, S) for f, (b, S) in self._fsm_base.items()
+            if id(f) in live
+        ]
+        self._fsm_base = {}
+        self._fsm_used = 0
+        if old is None:
+            return
+        new = np.full_like(old, -1)
+        for f, ob, S in entries:
+            nb = self._fsm_used
+            block = old[ob : ob + S].astype(np.int32)
+            new[nb : nb + S] = np.where(
+                block >= 0, block - ob + nb, -1
+            ).astype(np.int16)
+            self._fsm_base[f] = (nb, S)
+            self._fsm_used = nb + S
+        self._fsm_pool_np = new
+        self._fsm_pool_dev = jnp.asarray(new)
+
+    def _fsm_args(self) -> tuple:
+        """(pool, (slots,) absolute DFA state) — () until the pool
+        exists. The pool is a persistent device array; the state vector
+        is a (slots,) int32 upload per dispatch (noise). -1 marks
+        unconstrained slots."""
+        if self._fsm_pool_dev is None:
+            return ()
+        st = np.full((self.max_slots,), -1, np.int32)
+        with self._fsm_lock:
+            for slot, req in self._active.items():
+                if req.constraint is not None:
+                    base, _ = self._fsm_base[req.constraint]
+                    st[slot] = base + req.fsm_state
+        return (self._fsm_pool_dev, jnp.asarray(st))
+
+    def _fsm_pre(self, fsm: tuple, bias: tuple):
+        """Compose each constrained slot's allow-mask into the bias
+        buffer for ONE device step. Returns (bias', aux) where aux
+        carries (nextrow, fsm_on, ok): ``nextrow`` the gathered
+        (slots, vocab) absolute next-state rows, ``ok`` False for a
+        constrained row with NO allowed token (the caller freezes it —
+        an all-banned row would sample junk)."""
+        if not fsm:
+            return bias, None
+        pool, st = fsm
+        nextrow = pool[jnp.maximum(st, 0)]
+        fsm_on = st >= 0
+        allow = jnp.where(fsm_on[:, None], nextrow >= 0, True)
+        ok = jnp.any(allow, axis=-1)
+        masked = jnp.maximum(
+            bias[0] + jnp.where(allow, 0.0, NEG_INF), NEG_INF
+        )
+        return (masked,), (nextrow, fsm_on, ok)
+
+    def _fsm_post(self, aux, st, nxt, active):
+        """Advance constrained rows' absolute state with the sampled
+        token; frozen/starved/unconstrained rows keep their state."""
+        nextrow, fsm_on, ok = aux
+        adv = nextrow[
+            jnp.arange(self.max_slots), nxt
+        ].astype(jnp.int32)
+        return jnp.where(fsm_on & ok & active, adv, st)
+
+    def _replay_fsm(self, req: _Request, n_new: int) -> None:
+        """Advance ``req.fsm_state`` through the last ``n_new`` emitted
+        tokens (device-FSM dispatches advance on device; the host
+        mirror replays to stay authoritative for admission rebuilds and
+        exhaustion checks). A token outside the constraint (a starved
+        row's junk that slipped a freeze) truncates the generation
+        there and clamps the budget rather than faulting the engine
+        thread."""
+        if req.constraint is None or n_new <= 0:
+            return
+        start = len(req.generated) - n_new
+        okay = 0
+        for t in req.generated[start:]:
+            allow, nxt = req.constraint.tables(req.fsm_state)
+            if not allow[int(t)]:
+                break
+            req.fsm_state = int(nxt[int(t)])
+            okay += 1
+        if okay < n_new:
+            del req.generated[start + okay :]
+            del req.logprobs[start + okay :]
+            req.max_new_tokens = max(len(req.generated), 1)
+        else:
+            self._check_fsm_exhausted(req)
+
     def _token_byte_table(self):
         """Each token id's byte string (cached per engine) — the
         TokenFSM alphabet, built by constrain.token_byte_table (the one
@@ -994,16 +1175,23 @@ class Engine:
         if not np.any(self._effective_allow(req)):
             req.max_new_tokens = max(len(req.generated), 1)
 
-    def _split_extra(self, rest: tuple):
+    def _split_extra(self, rest: tuple, *, with_fsm: bool = True):
         """Parse a program's trailing args into (lead, samp, pen, bias,
-        lora, rng) — the flat layout _decode_extra_args produced,
+        fsm, lora, rng) — the flat layout _decode_extra_args produced,
         parsed from the END so subclass-specific leading extras (the
-        paged engine's page table) pass through untouched."""
+        paged engine's page table) pass through untouched.
+        ``with_fsm=False``: prefill-path programs, whose per-request
+        arg builders never include the FSM pool (prefill samples ONE
+        token with a host-composed mask row)."""
         rng = rest[-1]
         rest = rest[:-1]
         lora = None
         if self.lora is not None:
             lora = (rest[-2], rest[-1])
+            rest = rest[:-2]
+        fsm = ()
+        if with_fsm and self._fsm_pool_dev is not None:
+            fsm = tuple(rest[-2:])
             rest = rest[:-2]
         bias = ()
         if self.enable_logit_bias:
@@ -1017,7 +1205,7 @@ class Engine:
         if self.per_request_sampling:
             samp = tuple(rest[-4:])
             rest = rest[:-4]
-        return tuple(rest), samp, pen, bias, lora, rng
+        return tuple(rest), samp, pen, bias, fsm, lora, rng
 
     def _sample_rows(self, logits, rng, samp: tuple, pen: tuple = (),
                      bias: tuple = ()):
@@ -1048,23 +1236,39 @@ class Engine:
         (slots, K), logprobs (slots, K), n_emitted (slots,), cur,
         lengths, cache).
         """
-        lead, samp, pen, bias, lora, rng = self._split_extra(rest)
+        lead, samp, pen, bias, fsm, lora, rng = self._split_extra(rest)
         k = self.decode_chunk
         eos = self.eos_id
         counts0 = pen[0] if pen else None
+        # FSM-constrained rows: their absolute DFA state rides the scan
+        # carry and _decode_impl advances it on device each step (the
+        # whole point of the device-resident pool — the host never sees
+        # mid-chunk tokens). A row whose state has NO allowed token
+        # (constraint exhausted mid-chunk) is frozen — its junk sample
+        # is excluded from the emitted count and the row marked done;
+        # the host's replay + exhaustion check then clamps its budget.
+        pool = fsm[0] if fsm else None
+        st0 = fsm[1] if fsm else None
 
         def body(carry, t):
-            cache, cur, lengths, done, counts = carry
+            cache, cur, lengths, done, counts, st = carry
             live = active & ~done & (t < remaining)
             pen_t = (counts, *pen[1:]) if pen else ()
+            fsm_t = (pool, st) if fsm else ()
             # ``bias`` is chunk-constant (admission writes it; nothing
             # mid-chunk changes a slot's constraints) — passed through
-            # each step unchanged, unlike the counts carry.
+            # each step unchanged, unlike the counts carry. The FSM
+            # mask composes onto it inside _decode_impl per step.
             res = self._decode_impl(
                 params, cache, cur, lengths, live, *lead, *samp, *pen_t,
-                *bias, *(lora or ()),
+                *bias, *fsm_t, *(lora or ()),
                 jax.random.fold_in(rng, t),
             )
+            if fsm:
+                *res, st, ok = res
+                starved = live & ~ok
+                live = live & ok
+                done = done | starved
             if pen:
                 # _decode_impl already folded this step's emission into
                 # the counts (mid-chunk emissions penalise the very
@@ -1077,12 +1281,14 @@ class Engine:
             lengths = jnp.where(live, lengths + 1, lengths)
             if eos is not None:
                 done = done | (live & (nxt == eos))
-            return (cache, nxt, lengths, done, counts), (nxt, lp, live)
+            return (
+                (cache, nxt, lengths, done, counts, st), (nxt, lp, live)
+            )
 
         done0 = jnp.zeros((self.max_slots,), bool)
-        (cache, cur, lengths, _, counts), (toks, lps, lives) = (
+        (cache, cur, lengths, _, counts, _), (toks, lps, lives) = (
             jax.lax.scan(
-                body, (cache, cur, lengths, done0, counts0),
+                body, (cache, cur, lengths, done0, counts0, st0),
                 jnp.arange(k),
             )
         )
@@ -1434,8 +1640,14 @@ class Engine:
             # constraint lands in the state AFTER the prefill-sampled
             # token (and after the whole resumed generation on a
             # preemption recompute).
+            row = self._slot_bias_row(req)
+            if self._device_fsm and req.constraint is not None:
+                # Device-FSM engines compose the per-state mask on
+                # device each step; the resident row holds only the
+                # STATIC bias (the replay above still set fsm_state).
+                row = self._static_row(req)
             self._bias_dev = self._bias_dev.at[slot].set(
-                jnp.asarray(self._slot_bias_row(req))
+                jnp.asarray(row)
             )
             self._check_fsm_exhausted(req)
         self._active[slot] = req
@@ -1448,7 +1660,9 @@ class Engine:
         ``rest`` = optional per-request sampling arrays, optional
         penalty arrays, optional bias row, optional lora args, then
         rng."""
-        _, samp, pen, bias, lora, rng = self._split_extra(rest)
+        _, samp, pen, bias, _fsm, lora, rng = self._split_extra(
+            rest, with_fsm=False
+        )
         row = jax.tree_util.tree_map(
             lambda c: jax.lax.dynamic_slice_in_dim(c, slot, 1, axis=1),
             cache,
@@ -1494,9 +1708,12 @@ class Engine:
         """One (token, logprob) for every slot (inactive slots compute
         but are ignored — static shapes beat host-side gather/scatter
         here). ``rest`` = optional per-slot sampling arrays, optional
-        penalty arrays, optional bias buffer, optional lora args,
-        then rng (_split_extra's layout)."""
-        _, samp, pen, bias, lora, rng = self._split_extra(rest)
+        penalty arrays, optional bias buffer, optional FSM pool +
+        states, optional lora args, then rng (_split_extra's layout).
+        With FSM args the return gains (next_state, ok) — see
+        _fsm_pre/_fsm_post."""
+        _, samp, pen, bias, fsm, lora, rng = self._split_extra(rest)
+        bias, fsm_aux = self._fsm_pre(fsm, bias)
         kv_mask = (
             jnp.arange(self.max_len)[None, :] <= lengths[:, None]
         )
@@ -1515,12 +1732,18 @@ class Engine:
         out = jnp.where(active, nxt, cur), lp, cache
         if pen:
             # Fold this step's emission into the device counts (active
-            # rows only) and return the updated buffer — the engine
+            # rows only; a starved constrained row's junk sample is
+            # excluded) and return the updated buffer — the engine
             # keeps it resident across dispatches.
+            eff = active if fsm_aux is None else active & fsm_aux[2]
             counts = pen[0].at[
                 jnp.arange(self.max_slots), nxt
-            ].add(active.astype(jnp.int32))
-            return out + (counts,)
+            ].add(eff.astype(jnp.int32))
+            out = out + (counts,)
+        if fsm:
+            out = out + (
+                self._fsm_post(fsm_aux, fsm[1], nxt, active), fsm_aux[2]
+            )
         return out
 
 
@@ -2124,7 +2347,9 @@ class PagedEngine(Engine):
         regime). ``rest`` = optional per-request sampling arrays,
         optional penalty arrays, optional bias row, optional lora args,
         then rng."""
-        _, samp, pen, bias, lora, rng = self._split_extra(rest)
+        _, samp, pen, bias, _fsm, lora, rng = self._split_extra(
+            rest, with_fsm=False
+        )
         pos = jnp.minimum(
             offset + jnp.arange(bucket), offset + length - 1
         )
@@ -2175,6 +2400,7 @@ class PagedEngine(Engine):
             + self._sampling_args()
             + self._penalty_args()
             + self._bias_args()
+            + self._fsm_args()
             + self._lora_args()
         )
 
@@ -2185,7 +2411,9 @@ class PagedEngine(Engine):
         ``rest`` = optional per-request sampling arrays, optional
         penalty arrays, optional bias row, optional lora args, then
         rng."""
-        _, samp, pen, bias, lora, rng = self._split_extra(rest)
+        _, samp, pen, bias, _fsm, lora, rng = self._split_extra(
+            rest, with_fsm=False
+        )
         logits, cache = self.model(
             params,
             tokens[None, :],
@@ -2205,9 +2433,10 @@ class PagedEngine(Engine):
     def _decode_impl(self, params, cache, cur, lengths, active, table,
                      *rest):
         # ``rest`` = optional per-slot sampling arrays, optional penalty
-        # arrays, optional bias buffer, optional lora args, then rng
-        # (_split_extra's layout).
-        _, samp, pen, bias, lora, rng = self._split_extra(rest)
+        # arrays, optional bias buffer, optional FSM pool + states,
+        # optional lora args, then rng (_split_extra's layout).
+        _, samp, pen, bias, fsm, lora, rng = self._split_extra(rest)
+        bias, fsm_aux = self._fsm_pre(fsm, bias)
         # No kv_mask: on the paged path it would be ``pos <= lengths`` —
         # exactly the slot-space causality the decode attention already
         # enforces from ``cache_index`` (both the Pallas kernel and the
@@ -2227,8 +2456,13 @@ class PagedEngine(Engine):
         lp = _token_logprob(logits[:, -1], nxt)
         out = jnp.where(active, nxt, cur), lp, cache
         if pen:
+            eff = active if fsm_aux is None else active & fsm_aux[2]
             counts = pen[0].at[
                 jnp.arange(self.max_slots), nxt
-            ].add(active.astype(jnp.int32))
-            return out + (counts,)
+            ].add(eff.astype(jnp.int32))
+            out = out + (counts,)
+        if fsm:
+            out = out + (
+                self._fsm_post(fsm_aux, fsm[1], nxt, active), fsm_aux[2]
+            )
         return out

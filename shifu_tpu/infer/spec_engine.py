@@ -38,7 +38,17 @@ Shared engine mechanics:
     accepts against each row's CONFIGURED distribution
     (sampling.probs_per_row); engine-level greedy degrades to exact
     token matching, so greedy speculative output == the
-    non-speculative engine token for token (tested, both drafters).
+    non-speculative engine token for token (tested, both drafters);
+  * constrained decoding composes: ``logit_bias``/``allowed_token_ids``
+    and regex/json_schema FSM constraints mask the verify distribution
+    position-wise (device-resident transition tables,
+    Engine._register_fsm) before the accept test and the bonus draw —
+    and the draft's propose distribution too — so constrained
+    speculative output obeys the constraint exactly and greedy
+    constrained speculative == greedy constrained plain. Multi-LoRA
+    adapters thread through the verify forward. Penalties remain
+    unsupported (per-position counts depend on the same round's
+    accepted prefix); serve penalised requests with PagedEngine.
 
 Acceptance statistics (``spec_proposed`` / ``spec_accepted``) feed the
 server's /healthz.
@@ -62,6 +72,7 @@ import jax.numpy as jnp
 from shifu_tpu.infer.engine import PagedEngine, _token_logprob
 from shifu_tpu.infer.sampling import SampleConfig, probs_per_row
 from shifu_tpu.infer.speculative import _probs
+from shifu_tpu.ops.attention import NEG_INF
 
 
 def prompt_lookup_propose(buf, n, k: int, g: int):
@@ -141,20 +152,6 @@ class _SpeculativeBase(PagedEngine):
                 "depend on the SAME round's accepted prefix; serve "
                 "penalised requests with PagedEngine"
             )
-        if kw.get("enable_logit_bias"):
-            raise NotImplementedError(
-                "logit_bias inside the speculative verifier needs the "
-                "bias composed into BOTH the proposal distribution and "
-                "the verifier's per-position acceptance probabilities; "
-                "serve constrained requests with PagedEngine"
-            )
-        if kw.get("lora") is not None:
-            raise NotImplementedError(
-                "multi-LoRA serving inside the speculative round "
-                "program is unwired (the verify/draft forwards do not "
-                "thread the adapter args); serve adapter requests "
-                "with PagedEngine"
-            )
         self.k = int(k)
         self.rounds_per_step = int(rounds_per_step)
         self.spec_proposed = 0
@@ -173,6 +170,82 @@ class _SpeculativeBase(PagedEngine):
             else 0.0
         )
 
+    # --------------------------------------- constrained verification
+    # Device-side DFA plumbing for FSM-constrained rows inside a
+    # speculative round (the engine's device-resident pool,
+    # Engine._register_fsm). State encoding per row: >= 0 constrained
+    # (absolute pool row), -1 unconstrained, -2 DEAD (a banned token
+    # was hypothesised past this point — every subsequent mask is
+    # all-False, so verification must reject before reaching it).
+    def _fsm_allow(self, pool, s):
+        """(nextrow (b, V) int16, allow (b, V) bool) for per-row
+        absolute states ``s``."""
+        nr = pool[jnp.maximum(s, 0)]
+        allow = jnp.where((s >= 0)[:, None], nr >= 0, (s == -1)[:, None])
+        return nr, allow
+
+    def _fsm_step(self, nr, s, tok):
+        """Advance: constrained rows follow the pool row (-1 entries →
+        DEAD); unconstrained/dead rows keep their sentinel."""
+        ns = nr[jnp.arange(tok.shape[0]), tok].astype(jnp.int32)
+        return jnp.where(
+            s >= 0, jnp.where(ns >= 0, ns, jnp.int32(-2)), s
+        )
+
+    def _fsm_masks(self, pool, st, toks):
+        """Masks/states along one round's PROPOSAL path.
+
+        Verify position i's distribution is only ever consumed when
+        proposals 0..i-1 were all accepted, so its FSM state is
+        exactly ``advance(st, toks[:, :i])``. Returns
+        (mask3 (b, k+1, V) bool — position-wise allow masks,
+        s_all (b, k+1) int32 — s_all[:, i] is the state BEFORE
+        position i's token)."""
+
+        def sadv(s, tok):
+            nr, allow = self._fsm_allow(pool, s)
+            return self._fsm_step(nr, s, tok), (allow, s)
+
+        s_k, (allows, ss) = jax.lax.scan(sadv, st, toks.T)
+        _, allow_k = self._fsm_allow(pool, s_k)
+        mask3 = jnp.concatenate(
+            [jnp.moveaxis(allows, 0, 1), allow_k[:, None, :]], axis=1
+        )
+        s_all = jnp.concatenate([ss.T, s_k[:, None]], axis=1)
+        return mask3, s_all
+
+    def _fsm_round_end(self, pool, s_all, m, bonus, n_acc, live, st):
+        """The carried state after this round's EMISSION: the state
+        before position n_acc when the bonus was not drawn (emitted
+        tokens are proposals 0..n_acc-1 — eos/budget clipping included)
+        or advance(s_m, bonus) when it was. Frozen rows keep st."""
+        s_m = jnp.take_along_axis(s_all, m[:, None], axis=1)[:, 0]
+        nr_m, _ = self._fsm_allow(pool, s_m)
+        s_bonus = self._fsm_step(nr_m, s_m, bonus)
+        s_keep = jnp.take_along_axis(
+            s_all, jnp.minimum(n_acc, self.k)[:, None], axis=1
+        )[:, 0]
+        s_new = jnp.where(n_acc == m + 1, s_bonus, s_keep)
+        return jnp.where(live, s_new, st)
+
+    def _mask_verify_logits(self, lg, bias, fsm, st, d_toks_bt):
+        """Compose the static per-slot bias and (when constrained) the
+        position-wise FSM masks into the verify logits, BEFORE the
+        sampling-distribution transform — matching the non-speculative
+        sampler's ordering (bias lands on raw logits; a hard ban
+        survives every downstream filter). Returns
+        (lg', mask3 | None, s_all | None)."""
+        if bias:
+            lg = jnp.maximum(lg + bias[0][:, None, :], NEG_INF)
+        if not fsm:
+            return lg, None, None
+        pool = fsm[0]
+        mask3, s_all = self._fsm_masks(pool, st, d_toks_bt)
+        lg = jnp.maximum(
+            lg + jnp.where(mask3, 0.0, NEG_INF), NEG_INF
+        )
+        return lg, mask3, s_all
+
     def _probs2(self, samp, logits2d):
         """(rows, V) -> each row's configured sampling distribution
         (the EXACT one the non-speculative engine draws from)."""
@@ -188,13 +261,21 @@ class _SpeculativeBase(PagedEngine):
             )
         return _probs(logits2d, self.sample_cfg)
 
-    def _advance(self, out, m, live, rem, done, cur, n):
+    def _advance(self, out, m, live, rem, done, cur, n, bonus_ok=None):
         """Post-rejection per-row bookkeeping, identical for every
         drafter: clip the emitted count at eos and budget, freeze
         finished rows, advance cur/n/rem. Returns
-        (n_acc, done, cur, n, rem)."""
+        (n_acc, done, cur, n, rem).
+
+        ``bonus_ok`` (constrained rounds): False for a row whose FSM
+        state at the bonus position allows NO token — the bonus draw
+        there is junk, so only the m accepted proposals are emitted and
+        the row freezes (the host's exhaustion check clamps its
+        budget)."""
         k, eos = self.k, self.eos_id
         n_acc = m + 1
+        if bonus_ok is not None:
+            n_acc = jnp.where(bonus_ok, n_acc, m)
         if eos is not None:
             iseos = out == eos
             first_eos = jnp.min(
@@ -208,6 +289,8 @@ class _SpeculativeBase(PagedEngine):
         n_acc = jnp.minimum(n_acc, rem)
         n_acc = jnp.where(live, n_acc, 0)
         done = done | (live & (hit_eos | (rem - n_acc <= 0)))
+        if bonus_ok is not None:
+            done = done | (live & ~bonus_ok)
         new_cur = jnp.take_along_axis(
             out, jnp.maximum(n_acc - 1, 0)[:, None], axis=1
         )[:, 0]
@@ -222,6 +305,7 @@ class _SpeculativeBase(PagedEngine):
         lives = np.asarray(lives)
         cur2, lengths2 = np.asarray(cur2), np.asarray(lengths2)
         for slot, req in self._active.items():
+            len0 = len(req.generated)
             for r in range(self.rounds_per_step):
                 n = int(n_accs[r, slot])
                 req.generated.extend(int(t) for t in outs[r, slot, :n])
@@ -231,6 +315,10 @@ class _SpeculativeBase(PagedEngine):
                     self.spec_accepted += int(ms[r, slot])
             self._lengths[slot] = int(lengths2[slot])
             self._cur[slot] = int(cur2[slot])
+            # Constrained rows: the round program advanced the DFA on
+            # device; replay the emitted tokens so the host mirror
+            # stays authoritative (and clamp at exhaustion).
+            self._replay_fsm(req, len(req.generated) - len0)
 
 
 class SpeculativePagedEngine(_SpeculativeBase):
@@ -370,7 +458,8 @@ class SpeculativePagedEngine(_SpeculativeBase):
             self.params, self.cache, self.d_cache, self.draft_params,
             cur, lengths, active,
             jnp.asarray(remaining), jnp.asarray(self._table),
-            *self._sampling_args(), sub,
+            *self._sampling_args(), *self._bias_args(),
+            *self._fsm_args(), *self._lora_args(), sub,
         )
         self._fold_rounds(outs, lps, n_accs, ms, lives, cur2, lengths2)
 
@@ -388,40 +477,65 @@ class SpeculativePagedEngine(_SpeculativeBase):
         weights embed as program constants, and shipping hundreds of MB
         of constants breaks the remote-compile path (HTTP 413) besides
         duplicating the params in HBM.
+
+        Constrained/biased rows: the static bias row and the FSM
+        allow-mask land on the DRAFT's logits at every propose step
+        (so q is the actual — masked — proposal distribution) and on
+        the verify logits position-wise (so p is masked the same way);
+        the rejection rule then runs over matching supports and the
+        emitted prefix stays inside the constraint. Multi-LoRA
+        adapters apply to the TARGET verify forward only — the draft
+        proposes from its base weights (a draft adapter would need its
+        own registration; acceptance, not correctness, is all it could
+        change).
         """
-        *samp, rng = rest
+        _, samp, _pen, bias, fsm, lora, rng = self._split_extra(rest)
         k, rounds = self.k, self.rounds_per_step
+        st0 = fsm[1] if fsm else None
 
         def round_body(carry, rsub):
-            cache, d_cache, cur, n, rem, done = carry
+            cache, d_cache, cur, n, rem, done, st = carry
             live = active & ~done & (rem > 0)
             r_d, r_a, r_b = jax.random.split(rsub, 3)
 
             # ---- draft: K cheap autoregressive steps ----------------
             def dbody(c, sub):
-                d_cache, tok, idx = c
+                d_cache, tok, idx, s = c
                 lg, d_cache = self.draft(
                     d_params, tok[:, None], cache=d_cache, cache_index=idx
                 )
-                p = self._probs2(samp, lg[:, -1])
+                lg1 = lg[:, -1]
+                if bias:
+                    lg1 = jnp.maximum(lg1 + bias[0], NEG_INF)
+                if fsm:
+                    nr, allow = self._fsm_allow(fsm[0], s)
+                    lg1 = jnp.maximum(
+                        lg1 + jnp.where(allow, 0.0, NEG_INF), NEG_INF
+                    )
+                p = self._probs2(samp, lg1)
                 nxt = jax.random.categorical(
                     sub, jnp.log(jnp.maximum(p, 1e-38))
                 ).astype(jnp.int32)
-                return (d_cache, nxt, idx + 1), (nxt, p)
+                if fsm:
+                    s = self._fsm_step(nr, s, nxt)
+                return (d_cache, nxt, idx + 1, s), (nxt, p)
 
-            (d_cache, _, _), (d_toks, d_probs) = jax.lax.scan(
-                dbody, (d_cache, cur, n), jax.random.split(r_d, k)
+            (d_cache, _, _, _), (d_toks, d_probs) = jax.lax.scan(
+                dbody, (d_cache, cur, n, st), jax.random.split(r_d, k)
             )
 
             # ---- target: verify the whole chunk in one forward ------
-            chunk = jnp.concatenate(
-                [cur[:, None], d_toks.T.astype(jnp.int32)], axis=1
-            )
+            d_toks_bt0 = d_toks.T.astype(jnp.int32)  # (b, k)
+            chunk = jnp.concatenate([cur[:, None], d_toks_bt0], axis=1)
             lg, cache = self.model(
                 params, chunk, cache=cache, cache_index=n,
                 page_table=table,
+                **({"lora": lora} if lora is not None else {}),
             )
             b, width, V = lg.shape
+            lg, mask3, s_all = self._mask_verify_logits(
+                lg, bias, fsm, st, d_toks_bt0
+            )
             probs = self._probs2(samp, lg.reshape(b * width, V)).reshape(
                 b, width, V
             )
@@ -480,21 +594,32 @@ class SpeculativePagedEngine(_SpeculativeBase):
             )
 
             # ---- per-row emitted count: eos + budget ----------------
-            n_acc, done, cur, n, rem = self._advance(
-                out, m, live, rem, done, cur, n
+            bonus_ok = (
+                jnp.take_along_axis(
+                    jnp.any(mask3, axis=-1), m[:, None], axis=1
+                )[:, 0]
+                if mask3 is not None
+                else None
             )
+            n_acc, done, cur, n, rem = self._advance(
+                out, m, live, rem, done, cur, n, bonus_ok=bonus_ok
+            )
+            if fsm:
+                st = self._fsm_round_end(
+                    fsm[0], s_all, m, bonus, n_acc, live, st
+                )
             return (
-                (cache, d_cache, cur, n, rem, done),
+                (cache, d_cache, cur, n, rem, done, st),
                 (out, raw_lp, n_acc, m, live),
             )
 
         done0 = jnp.zeros((self.max_slots,), bool)
-        (cache, d_cache, cur, n, _, _), (outs, lps, n_accs, ms, lives) = (
-            jax.lax.scan(
-                round_body,
-                (cache, d_cache, cur, lengths, remaining, done0),
-                jax.random.split(rng, rounds),
-            )
+        (cache, d_cache, cur, n, _, _, _), (
+            outs, lps, n_accs, ms, lives,
+        ) = jax.lax.scan(
+            round_body,
+            (cache, d_cache, cur, lengths, remaining, done0, st0),
+            jax.random.split(rng, rounds),
         )
         return outs, lps, n_accs, ms, lives, cur, n, cache, d_cache
 
@@ -566,7 +691,9 @@ class PromptLookupPagedEngine(_SpeculativeBase):
         ) = self._spec_jit(
             self.params, self.cache, cur, lengths, active,
             jnp.asarray(remaining), jnp.asarray(self._table),
-            jnp.asarray(buf), *self._sampling_args(), sub,
+            jnp.asarray(buf), *self._sampling_args(),
+            *self._bias_args(), *self._fsm_args(),
+            *self._lora_args(), sub,
         )
         self._fold_rounds(outs, lps, n_accs, ms, lives, cur2, lengths2)
 
@@ -581,12 +708,24 @@ class PromptLookupPagedEngine(_SpeculativeBase):
         multi-query paged path), accept with the q = one-hot rule,
         scatter the emitted tokens into the buffer so the NEXT round's
         lookup sees them. Returns the same per-round stack as the
-        draft-model engine, minus the draft cache."""
-        *samp, rng = rest
+        draft-model engine, minus the draft cache.
+
+        Constrained/biased rows compose exactly like the plain engine:
+        the static bias row and the position-wise FSM allow-masks land
+        on the verify logits BEFORE the sampling transform, so the
+        accept test (q = one-hot: accept with probability p_t) and the
+        bonus draw both act on the MASKED distribution — a banned
+        proposal has p_t = 0 and is always rejected, and the emitted
+        prefix provably stays inside the constraint. Proposals are NOT
+        pre-filtered by the FSM (correctness never needs it; on the
+        quoting-heavy text where lookup pays, proposals mostly satisfy
+        the constraint anyway)."""
+        _, samp, _pen, bias, fsm, lora, rng = self._split_extra(rest)
         k, rounds, g = self.k, self.rounds_per_step, self.ngram
+        st0 = fsm[1] if fsm else None
 
         def round_body(carry, rsub):
-            cache, buf, cur, n, rem, done = carry
+            cache, buf, cur, n, rem, done, st = carry
             live = active & ~done & (rem > 0)
             r_a, r_b = jax.random.split(rsub)
 
@@ -602,8 +741,12 @@ class PromptLookupPagedEngine(_SpeculativeBase):
             lg, cache = self.model(
                 params, chunk, cache=cache, cache_index=n,
                 page_table=table,
+                **({"lora": lora} if lora is not None else {}),
             )
             b, width, V = lg.shape
+            lg, mask3, s_all = self._mask_verify_logits(
+                lg, bias, fsm, st, d_toks
+            )
             probs = self._probs2(samp, lg.reshape(b * width, V)).reshape(
                 b, width, V
             )
@@ -660,19 +803,33 @@ class PromptLookupPagedEngine(_SpeculativeBase):
             widx = n[:, None] + 1 + jnp.arange(k + 1)[None, :]
             buf = buf.at[rowix, widx].set(out)
 
-            n_acc, done, cur, n, rem = self._advance(
-                out, m, live, rem, done, cur, n
+            # Constrained rows whose FSM state at the bonus position
+            # allows nothing (exhausted mid-chunk, no eos) must not
+            # emit the junk bonus draw.
+            bonus_ok = (
+                jnp.take_along_axis(
+                    jnp.any(mask3, axis=-1), m[:, None], axis=1
+                )[:, 0]
+                if mask3 is not None
+                else None
             )
+            n_acc, done, cur, n, rem = self._advance(
+                out, m, live, rem, done, cur, n, bonus_ok=bonus_ok
+            )
+            if fsm:
+                st = self._fsm_round_end(
+                    fsm[0], s_all, m, bonus, n_acc, live, st
+                )
             return (
-                (cache, buf, cur, n, rem, done),
+                (cache, buf, cur, n, rem, done, st),
                 (out, raw_lp, n_acc, m, live),
             )
 
         done0 = jnp.zeros((self.max_slots,), bool)
-        (cache, buf, cur, n, _, _), (outs, lps, n_accs, ms, lives) = (
+        (cache, buf, cur, n, _, _, _), (outs, lps, n_accs, ms, lives) = (
             jax.lax.scan(
                 round_body,
-                (cache, buf, cur, lengths, remaining, done0),
+                (cache, buf, cur, lengths, remaining, done0, st0),
                 jax.random.split(rng, rounds),
             )
         )

@@ -15,8 +15,9 @@ Pinned properties:
     recompute replays the FSM state;
   * a completed match with no extension and no eos finishes the
     request at the boundary;
-  * validation: needs enable_logit_bias, per-token dispatch, a
-    tokenizer (or prebuilt constraint); speculative engines refuse;
+  * validation: needs enable_logit_bias and a tokenizer (or prebuilt
+    constraint); chunked/speculative engines need the pattern to fit
+    the device FSM pool (tests/test_fsm_device.py covers those paths);
   * SERVER: the "regex" field produces matching text end to end; bad
     patterns 400.
 """
@@ -229,12 +230,15 @@ def test_validation(tiny):
     )
     with pytest.raises(ValueError, match="enable_logit_bias"):
         no_bias.submit([1, 2], max_new_tokens=2, regex=r"\d+")
-    chunked = Engine(
+    # Chunked engines serve constraints via device-resident transition
+    # tables since round 5 — but the pattern must FIT the pool.
+    small_pool = Engine(
         model, params, max_slots=1, max_len=32, prefill_buckets=(16, 32),
         decode_chunk=4, enable_logit_bias=True, tokenizer=tok,
+        fsm_device_states=2,
     )
-    with pytest.raises(ValueError, match="per-token"):
-        chunked.submit([1, 2], max_new_tokens=2, regex=r"\d+")
+    with pytest.raises(ValueError, match="fsm_device_states"):
+        small_pool.submit([1, 2], max_new_tokens=2, regex=r"\d{4}")
     no_tok = Engine(
         model, params, max_slots=1, max_len=32, prefill_buckets=(16, 32),
         enable_logit_bias=True,
@@ -259,9 +263,8 @@ def test_validation(tiny):
         model, params, page_size=8, max_slots=1, max_len=32,
         prefill_buckets=(16, 32), tokenizer=tok,
     )
-    # Speculative engines cannot even enable the bias buffer (their
-    # constructor refuses it), so a constrained submit fails at that
-    # earlier gate — refused either way.
+    # Speculative engines serve constraints (round 5) but still need
+    # the bias buffer enabled — this one was built without it.
     with pytest.raises(ValueError, match="enable_logit_bias"):
         spec.submit([1, 2], max_new_tokens=2, constraint=_byte_fsm(r"a+"))
 

@@ -203,16 +203,25 @@ def test_bias_validation(tiny):
         )
 
 
-def test_spec_engine_rejects_logit_bias(tiny):
+def test_spec_engine_accepts_logit_bias(tiny):
+    """Round 5: the speculative engines compose with the bias buffer
+    (the verify distribution is masked before the accept test), so the
+    constructor accepts the flag and a hard ban holds through a
+    speculative round. Full parity tests: tests/test_fsm_device.py."""
     from shifu_tpu.infer import SpeculativePagedEngine
 
     model, params = tiny
-    with pytest.raises(NotImplementedError, match="logit_bias"):
-        SpeculativePagedEngine(
-            model, params, model, params,
-            max_slots=1, max_len=32, prefill_buckets=(16, 32),
-            enable_logit_bias=True,
-        )
+    eng = SpeculativePagedEngine(
+        model, params, model, params,
+        max_slots=1, max_len=32, prefill_buckets=(16, 32),
+        page_size=16, enable_logit_bias=True,
+    )
+    free = [t for t in range(4, 10)]
+    rid = eng.submit(
+        [1, 2, 3], max_new_tokens=6, allowed_token_ids=free,
+    )
+    done = {c.rid: c for c in eng.run()}[rid]
+    assert all(t in free for t in done.tokens)
 
 
 # ---------------------------------------------------------------- server

@@ -13,7 +13,8 @@ Pinned properties:
   * FFN targets (w_gate/w_up/w_down) compose on dense-FFN configs and
     are refused on MoE configs;
   * validation: unknown adapter ids, capacity, shape/rank mismatches,
-    adapter without lora config, speculative engines refuse the flag.
+    adapter without lora config; speculative engines ACCEPT the flag
+    since round 5 (composition parity: tests/test_fsm_device.py).
 """
 
 import numpy as np
@@ -215,14 +216,16 @@ def test_validation(tiny):
     with pytest.raises(ValueError, match="unknown lora targets"):
         LoraServingConfig(targets=("wq", "nope"))
 
+    # Round 5: speculative engines thread the adapter args through the
+    # verify forward, so lora configs construct (composition parity:
+    # tests/test_fsm_device.py).
     from shifu_tpu.infer import PromptLookupPagedEngine
 
-    with pytest.raises(NotImplementedError, match="LoRA"):
-        PromptLookupPagedEngine(
-            model, params, page_size=8,
-            lora=LoraServingConfig(), max_slots=1, max_len=32,
-            prefill_buckets=(16, 32),
-        )
+    PromptLookupPagedEngine(
+        model, params, page_size=8,
+        lora=LoraServingConfig(), max_slots=1, max_len=32,
+        prefill_buckets=(16, 32),
+    )
 
 
 def test_server_adapter_field(tiny):

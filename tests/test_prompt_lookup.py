@@ -15,8 +15,9 @@ Pinned properties:
     runs; greedy rows stay exact);
   * stats: proposed/accepted counters and /healthz-visible
     acceptance_rate move;
-  * validation: ngram >= 1, decode_chunk refused, penalties and
-    logit_bias refused (shared speculative guards).
+  * validation: ngram >= 1, decode_chunk refused, penalties refused;
+    logit_bias/constraints/lora COMPOSE since round 5
+    (tests/test_fsm_device.py).
 """
 
 import numpy as np
@@ -215,10 +216,9 @@ def test_validation(tiny):
             sample_cfg=SampleConfig(temperature=0.0, presence_penalty=1.0),
             **kw,
         )
-    with pytest.raises(NotImplementedError, match="logit_bias"):
-        PromptLookupPagedEngine(
-            model, params, enable_logit_bias=True, **kw
-        )
+    # logit_bias/constraints compose since round 5 (the verify
+    # distribution is masked): the flag constructs.
+    PromptLookupPagedEngine(model, params, enable_logit_bias=True, **kw)
 
 
 # ------------------------------------------------ CLI-built engine + server
