@@ -2,6 +2,15 @@
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
 
+Output discipline (round 5): the driver that records the bench keeps
+only the LAST ~2000 characters of stdout and parses the final line —
+rounds 3 and 4 lost their own headline numbers to a fat nested ledger
+(BENCH_r04.json: ``parsed: null``, tail starting mid-sentence). So the
+final stdout line is now a COMPACT summary (short keys, no prose,
+budgeted under 1800 chars, every leg's headline number present) and the
+FULL ledger goes to ``bench_full.json`` next to this script and to
+stderr.
+
 The reference (klyan/shifu) publishes no benchmark numbers (see BASELINE.md:
 its repository is empty), so ``vs_baseline`` is reported as 1.0 by
 convention — there is nothing to normalise against. The extras document the
@@ -12,6 +21,8 @@ absolute numbers that matter on TPU: tokens/s and model-FLOPs utilisation
 from __future__ import annotations
 
 import json
+import os
+import sys
 import time
 
 import jax
@@ -64,7 +75,107 @@ def main():
             out["serving_spec_lookup"] = {
                 "error": f"{type(e).__name__}: {e}"
             }
-    print(json.dumps(out))
+        try:
+            out["serving_lookup_text"] = bench_serving_lookup_text()
+        except Exception as e:
+            out["serving_lookup_text"] = {
+                "error": f"{type(e).__name__}: {e}"
+            }
+    full = json.dumps(out)
+    sidecar = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "bench_full.json")
+    try:
+        with open(sidecar, "w") as f:
+            f.write(full + "\n")
+    except OSError:
+        pass  # read-only checkout: stderr still carries the ledger
+    print(full, file=sys.stderr)
+    print(json.dumps(_compact(out)))
+
+
+def _compact(out: dict) -> dict:
+    """The final stdout line: every leg's headline number under short
+    keys, added in PRIORITY order with a hard character budget — the
+    driver's tail capture (~2000 chars) and JSON parse must both
+    survive no matter how many legs the ledger grows (see module
+    docstring; full ledger: bench_full.json + stderr)."""
+
+    def g(*path):
+        cur = out
+        for p in path:
+            if not isinstance(cur, dict):
+                return None
+            cur = cur.get(p)
+        return None if isinstance(cur, dict) else cur
+
+    sv = ("serving",)
+    lkp = ("serving_spec_lookup", "model_1b_round_cost")
+    ind = ("serving_spec_lookup", "induction_demo")
+    entries = [
+        ("metric", out.get("metric")),
+        ("value", out.get("value")),
+        ("unit", out.get("unit")),
+        ("vs_baseline", out.get("vs_baseline")),
+        ("mfu", out.get("mfu")),
+        ("step_ms", out.get("step_ms")),
+        # chip-true serving decode per leg (the int8-vs-kv verdict)
+        ("sv_bf16_dev_ms", g(*sv, "bf16", "decode_step_device_ms")),
+        ("sv_int8_dev_ms", g(*sv, "int8", "decode_step_device_ms")),
+        ("sv_kv8_dev_ms", g(*sv, "int8_kv", "decode_step_device_ms")),
+        ("sv_bf16_bw", g(*sv, "bf16", "bandwidth_util_device")),
+        ("sv_int8_bw", g(*sv, "int8", "bandwidth_util_device")),
+        ("sv_kv8_bw", g(*sv, "int8_kv", "bandwidth_util_device")),
+        ("sv_bf16_tps", g(*sv, "bf16", "decode_tokens_per_s")),
+        ("sv_prefill_ms", g(*sv, "bf16", "prefill_ms")),
+        # induction demo: speculation beating plain, chip-true
+        ("ind_x_plain", g(*ind, "vs_plain_same_model_device")),
+        ("ind_tps_dev", g(*ind, "decode_tokens_per_s_device")),
+        ("ind_plain_tps_dev",
+         g(*ind, "plain_same_model_device_tokens_per_s")),
+        ("ind_acc", g(*ind, "acceptance_rate")),
+        ("ind_tpr", g(*ind, "tokens_per_round")),
+        # constrained speculation (round 5): FSM-masked lookup vs
+        # FSM-masked plain on the same trained model
+        ("cst_x_plain",
+         g("serving_lookup_text", "constrained",
+           "vs_constrained_plain_device")),
+        ("cst_tps_dev",
+         g("serving_lookup_text", "constrained",
+           "decode_tokens_per_s_device")),
+        ("cst_acc",
+         g("serving_lookup_text", "constrained", "acceptance_rate")),
+        # realistic-text lookup leg (round 5)
+        ("txt_x_plain",
+         g("serving_lookup_text", "vs_plain_same_model_device")),
+        ("txt_acc", g("serving_lookup_text", "acceptance_rate")),
+        ("txt_tpr", g("serving_lookup_text", "tokens_per_round")),
+        ("txt_tps_dev",
+         g("serving_lookup_text", "decode_tokens_per_s_device")),
+        # 1.2B lookup round-cost + break-even
+        ("lkp_round_dev_ms", g(*lkp, "round_device_ms")),
+        ("lkp_breakeven", g(*lkp, "break_even_tokens_per_round")),
+        # draft-model spec round cost
+        ("spec_round_dev_ms", g("serving_spec", "round_device_ms")),
+        ("spec_acc", g("serving_spec", "acceptance_rate")),
+        # secondary train legs
+        ("lc_mfu", g("train_legs", "long_context", "mfu")),
+        ("lcw_mfu", g("train_legs", "long_context_windowed", "mfu")),
+        ("moe_mfu", g("train_legs", "moe", "mfu")),
+        ("fit_unstable", any(
+            g(*sv, leg, "fit_unstable") for leg in
+            ("bf16", "int8", "int8_kv")
+        ) or None),
+        ("full", "bench_full.json+stderr"),
+    ]
+    compact: dict = {}
+    budget = 1750
+    for key, val in entries:
+        if val is None:
+            continue
+        if len(json.dumps({**compact, key: val})) > budget:
+            break
+        compact[key] = val
+    return compact
 
 
 def bench_train(on_tpu, dev):
@@ -730,6 +841,241 @@ def _lookup_induction_demo(fit):
         "decode chip-true, no draft model anywhere"
     )
     return leg
+
+
+def _license_corpus(max_bytes=600_000) -> bytes:
+    """Real English prose available OFFLINE (this environment has zero
+    egress, so no pretrained checkpoint or public corpus can be
+    fetched — documented in the leg's note): the system license texts
+    plus Python's own LICENSE. ASCII-filtered (the byte model and the
+    constrained sub-leg's printable-text pattern both want it)."""
+    import glob
+
+    paths = sorted(glob.glob("/usr/share/common-licenses/*"))
+    for extra in ("/usr/lib/python3.11/LICENSE.txt",):
+        paths.append(extra)
+    blobs = []
+    total = 0
+    for p in paths:
+        try:
+            with open(p, "rb") as f:
+                data = f.read()
+        except OSError:
+            continue
+        data = bytes(
+            b for b in data if b in (9, 10, 13) or 32 <= b <= 126
+        )
+        blobs.append(data)
+        total += len(data)
+        if total >= max_bytes:
+            break
+    corpus = b"\n\n".join(blobs)
+    if len(corpus) < 50_000:
+        raise RuntimeError(
+            f"offline text corpus too small ({len(corpus)} bytes)"
+        )
+    return corpus
+
+
+def bench_serving_lookup_text(
+    *, train_steps=3000, dim=384, n_layers=6, slots=16, k=8, g=3,
+    rounds_big=16, rounds_small=4, split=4, seq=1024,
+    attn_impl="flash",
+):
+    """REALISTIC prompt-lookup leg (round 5).
+
+    The round-4 induction demo proved the machine on an engineered
+    best case (a model trained to quote synthetic token sequences,
+    acceptance 1.0). This leg measures the market: REAL ENGLISH TEXT.
+    No pretrained checkpoint is fetchable here (zero egress), so a
+    byte-level model is trained IN-LEG (~90 s, fixed seeds) on the
+    system's license corpus with a doc-tiled structure that teaches
+    context quoting — the behaviour real assistants exhibit on
+    document-QA/extraction/summarise-with-quotes traffic — then served
+    on HELD-OUT documents it has never seen. Reports acceptance,
+    tokens/round, and chip-true tok/s lookup vs plain on identical
+    model + prompts (two-point tunnel fits throughout).
+
+    ``constrained`` sub-leg — the round-5 composition measured: the
+    SAME workload FSM-masked to a printable-text regex through BOTH
+    engines (device-resident transition tables; chunked plain decode
+    vs masked speculative verify). vs_constrained_plain_device > 1
+    means JSON/regex-constrained traffic — exactly where lookup
+    acceptance is highest — still speculates profitably.
+    """
+    import numpy as np
+
+    from shifu_tpu.data.tokenizer import ByteTokenizer
+    from shifu_tpu.infer import PromptLookupPagedEngine, SampleConfig
+    from shifu_tpu.infer.engine import PagedEngine
+    from shifu_tpu.models.transformer import Transformer, TransformerConfig
+    from shifu_tpu.train import AdamW, make_train_step, warmup_cosine
+    from shifu_tpu.train.step import TrainState
+
+    tok = ByteTokenizer()
+    corpus = _license_corpus()
+    ids = np.frombuffer(corpus, np.uint8).astype(np.int32) + 3  # byte ids
+    heldout_at = int(len(ids) * 0.85)
+    train_ids, held_ids = ids[:heldout_at], ids[heldout_at:]
+
+    cfg = TransformerConfig(
+        vocab_size=tok.vocab_size, dim=dim, n_layers=n_layers,
+        n_heads=6, n_kv_heads=6, mlp_dim=4 * dim, attn_impl=attn_impl,
+    )
+    model = Transformer(cfg)
+    opt = AdamW(warmup_cosine(1e-3, train_steps, warmup_steps=100))
+    state = TrainState.create(model.init(jax.random.key(0)), opt)
+    step = make_train_step(model, opt)
+    rng = np.random.RandomState(0)
+    B, PER = 8, 256  # 256-byte real-text windows, tiled to seq
+
+    def batch():
+        rows = []
+        for _ in range(B):
+            at = rng.randint(0, len(train_ids) - PER)
+            rows.append(np.tile(train_ids[at : at + PER],
+                                seq // PER + 1)[:seq])
+        return {"tokens": jnp.asarray(np.stack(rows), jnp.int32)}
+
+    t0 = time.perf_counter()
+    for _ in range(train_steps):
+        state, m = step(state, batch())
+    final_loss = float(m["loss"])
+    train_s = time.perf_counter() - t0
+    params = jax.tree_util.tree_map(
+        lambda x: x.astype(jnp.bfloat16), state.params
+    )
+    del state
+
+    # Held-out document prompts: 256 fresh bytes + the first 128
+    # repeated — the "quote the document" shape. One prompt per slot,
+    # all from text the model never trained on.
+    prompts = []
+    for i in range(slots):
+        at = (i * 331) % max(len(held_ids) - PER, 1)
+        doc = held_ids[at : at + PER].tolist()
+        prompts.append(doc + doc[: PER // 2])
+
+    max_len = seq
+    page_size = 64
+    buckets = (512, 1024)
+    pattern = r"[ -~\n\t\r]{1,}"  # printable text (ASCII corpus)
+
+    def drive(eng, prompt_list, budget, warm, timed, submit_kw):
+        times, emitted = [], 0
+        for _ in range(2):
+            rids = [
+                eng.submit(p, max_new_tokens=budget, **submit_kw)
+                for p in prompt_list
+            ]
+            for _ in range(warm):
+                eng.step()
+            before = sum(
+                len(q) for q in eng.live_generated().values()
+            )
+            t1 = time.perf_counter()
+            for _ in range(timed):
+                eng.step()
+            times.append(time.perf_counter() - t1)
+            emitted = (
+                sum(len(q) for q in eng.live_generated().values())
+                - before
+            )
+            for r in rids:
+                eng.cancel(r)
+        return min(times), emitted
+
+    def lookup_fit(submit_kw):
+        def mk(rounds):
+            eng = PromptLookupPagedEngine(
+                model, params, k=k, ngram=g, rounds_per_step=rounds,
+                max_slots=slots, max_len=max_len, page_size=page_size,
+                prefill_buckets=buckets,
+                sample_cfg=SampleConfig(temperature=0.0),
+                enable_logit_bias=bool(submit_kw), tokenizer=tok,
+            )
+            eng.submit(
+                prompts[0], max_new_tokens=rounds * (k + 1), **submit_kw
+            )
+            for _ in eng.run():
+                pass
+            return eng
+
+        budget = 2 * (1 + 1) * rounds_big * (k + 1)
+        eng = mk(rounds_big)
+        dt, emitted = drive(eng, prompts, budget, 1, 1, submit_kw)
+        acc = eng.acceptance_rate
+        dt_small, _ = drive(
+            mk(rounds_small), prompts, budget, split, split, submit_kw
+        )
+        disp = (dt_small - dt) / (split - 1)
+        rps = (dt - disp) / rounds_big
+        dev_tps = emitted / (rounds_big * rps) if rps > 0 else 0.0
+        return {
+            "decode_tokens_per_s": round(emitted / dt, 1),
+            "decode_tokens_per_s_device": round(dev_tps, 1),
+            "tokens_per_round": round(emitted / (rounds_big * slots), 3),
+            "acceptance_rate": round(acc, 4),
+            "round_device_ms": round(1000 * rps, 2),
+            "tunnel_dispatch_ms": round(1000 * disp, 1),
+        }
+
+    def plain_fit(submit_kw):
+        def mk(chunk):
+            eng = PagedEngine(
+                model, params, max_slots=slots, max_len=max_len,
+                page_size=page_size, prefill_buckets=buckets,
+                decode_chunk=chunk,
+                sample_cfg=SampleConfig(temperature=0.0),
+                enable_logit_bias=bool(submit_kw), tokenizer=tok,
+            )
+            eng.submit(prompts[0], max_new_tokens=chunk + 1, **submit_kw)
+            for _ in eng.run():
+                pass
+            return eng
+
+        dt_big, _ = drive(
+            mk(256), prompts, 2 * 256 + 1, 1, 1, submit_kw
+        )
+        dt_small, _ = drive(
+            mk(64), prompts, 8 * 64 + 1, 4, 4, submit_kw
+        )
+        disp = (dt_small - dt_big) / 3
+        dev_ms = 1000 * (dt_big - disp) / 256
+        return dev_ms, slots / (dev_ms / 1000.0) if dev_ms > 0 else 0.0
+
+    out = lookup_fit({})
+    plain_ms, plain_tps = plain_fit({})
+    out["plain_same_model_device_ms_per_step"] = round(plain_ms, 2)
+    out["plain_same_model_device_tokens_per_s"] = round(plain_tps, 1)
+    if plain_tps > 0:
+        out["vs_plain_same_model_device"] = round(
+            out["decode_tokens_per_s_device"] / plain_tps, 3
+        )
+    out["train_seconds"] = round(train_s, 1)
+    out["train_final_loss"] = round(final_loss, 3)
+    out["corpus"] = "system license texts (offline; zero-egress env)"
+    out["k"], out["ngram"] = k, g
+
+    ckw = {"regex": pattern}
+    cst = lookup_fit(ckw)
+    cplain_ms, cplain_tps = plain_fit(ckw)
+    cst["plain_constrained_device_ms_per_step"] = round(cplain_ms, 2)
+    cst["plain_constrained_device_tokens_per_s"] = round(cplain_tps, 1)
+    if cplain_tps > 0:
+        cst["vs_constrained_plain_device"] = round(
+            cst["decode_tokens_per_s_device"] / cplain_tps, 3
+        )
+    cst["pattern"] = pattern
+    out["constrained"] = cst
+    out["note"] = (
+        "byte-level model TRAINED IN-LEG on real English text (no "
+        "checkpoint fetchable: zero-egress environment), served on "
+        "HELD-OUT documents in the quote-the-document shape; "
+        "constrained sub-leg = same workload FSM-masked through both "
+        "engines (device-resident tables, round-5 composition)"
+    )
+    return out
 
 
 if __name__ == "__main__":
