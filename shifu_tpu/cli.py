@@ -609,7 +609,12 @@ def build_serve_engine(args, model, params, tok):
     """Flags -> constructed serving engine — the single seam between
     the CLI surface and the engine classes (unit-tested directly; a
     feature cmd_serve cannot construct is a feature the binary does
-    not ship). Raises ValueError on incoherent flag combinations."""
+    not ship). Raises ValueError on incoherent flag combinations.
+
+    ``--mesh dp=D,tp=T`` (serving axes only): T-device tensor-parallel
+    sub-meshes, D model REPLICAS behind one router (ReplicatedEngine)
+    — D x T devices total. dp=1 serves one mesh engine; no flag serves
+    single-device."""
     from shifu_tpu.infer import (
         Engine,
         PagedEngine,
@@ -617,6 +622,23 @@ def build_serve_engine(args, model, params, tok):
         SampleConfig,
         SpeculativePagedEngine,
     )
+
+    mesh_spec = getattr(args, "mesh", None)
+    dp = tp = 1
+    if mesh_spec:
+        parts = {}
+        for part in mesh_spec.split(","):
+            name, _, val = part.partition("=")
+            parts[name.strip()] = int(val)
+        unknown = set(parts) - {"dp", "tp"}
+        if unknown:
+            raise ValueError(
+                f"serving mesh axes are dp/tp, got {sorted(unknown)} "
+                "(training meshes take the full MeshPlan axes)"
+            )
+        dp, tp = parts.get("dp", 1), parts.get("tp", 1)
+        if dp < 1 or tp < 1:
+            raise ValueError("serving mesh sizes must be >= 1")
 
     kw = dict(
         max_slots=args.max_slots,
@@ -682,59 +704,91 @@ def build_serve_engine(args, model, params, tok):
                 ckpt.close()
         return engine
 
+    draft = draft_params = None
     if args.spec != "off":
-        if lora_dirs:
+        # Round 5: logit_bias/constraints and multi-LoRA COMPOSE with
+        # the speculative engines (masked verify distribution; adapter
+        # args threaded through the verify forward). Penalties remain
+        # the one guarded feature (per-position counts depend on the
+        # same round's accepted prefix).
+        if args.penalties:
             raise ValueError(
-                "--lora-ckpt-dir does not compose with --spec (the "
-                "speculative round programs do not thread adapters)"
+                "--spec does not compose with --penalties (the "
+                "verifier cannot honour per-position counts); serve "
+                "penalised traffic with a plain engine"
             )
-        # Speculative engines are paged by construction; the spec
-        # guards refuse penalties/logit_bias, so surface that here
-        # instead of at the first request.
-        if args.penalties or args.logit_bias:
-            raise ValueError(
-                "--spec does not compose with --penalties/--logit-bias "
-                "(the verifier cannot honour them); serve those with a "
-                "plain engine"
-            )
-        kw.pop("enable_penalties"), kw.pop("enable_logit_bias")
+        kw.pop("enable_penalties")
         kw.pop("decode_chunk")  # spec rounds replace the chunk scan
+        if args.spec == "draft":
+            if lora_dirs:
+                raise ValueError(
+                    "--lora-ckpt-dir does not compose with --spec "
+                    "draft (adapters apply to the target; the draft "
+                    "would propose from mismatched weights — use "
+                    "--spec prompt-lookup for adapter traffic)"
+                )
+            if not args.draft_preset:
+                raise ValueError(
+                    "--spec draft needs --draft-preset (and usually "
+                    "--draft-ckpt-dir with trained weights — an "
+                    "untrained draft accepts ~nothing)"
+                )
+            import argparse as _argparse
+
+            dargs = _argparse.Namespace(**vars(args))
+            dargs.preset = args.draft_preset
+            dargs.ckpt_dir = args.draft_ckpt_dir
+            dargs.moe_experts = 0
+            draft = _build_model(dargs)
+            draft_params = _restore_params(dargs, draft)
+
+    def construct(params_r, mesh=None, draft_params_r=None):
+        mkw = dict(kw, mesh=mesh) if mesh is not None else kw
         paged_kw = dict(
             page_size=args.page_size, n_pages=args.n_pages,
             enable_prefix_cache=args.prefix_cache,
         )
         if args.spec == "prompt-lookup":
-            return PromptLookupPagedEngine(
-                model, params, k=args.spec_k, ngram=args.spec_ngram,
-                rounds_per_step=args.spec_rounds, **paged_kw, **kw,
+            return load_adapters(PromptLookupPagedEngine(
+                model, params_r, k=args.spec_k, ngram=args.spec_ngram,
+                rounds_per_step=args.spec_rounds, **paged_kw, **mkw,
+            ))
+        if args.spec == "draft":
+            return SpeculativePagedEngine(
+                model, params_r, draft, draft_params_r,
+                k=args.spec_k, rounds_per_step=args.spec_rounds,
+                **paged_kw, **mkw,
             )
-        # draft-model speculation
-        if not args.draft_preset:
-            raise ValueError(
-                "--spec draft needs --draft-preset (and usually "
-                "--draft-ckpt-dir with trained weights — an untrained "
-                "draft accepts ~nothing)"
-            )
-        import argparse as _argparse
+        if args.paged:
+            return load_adapters(PagedEngine(
+                model, params_r, **paged_kw, **mkw,
+            ))
+        return load_adapters(Engine(model, params_r, **mkw))
 
-        dargs = _argparse.Namespace(**vars(args))
-        dargs.preset = args.draft_preset
-        dargs.ckpt_dir = args.draft_ckpt_dir
-        dargs.moe_experts = 0
-        draft = _build_model(dargs)
-        draft_params = _restore_params(dargs, draft)
-        return SpeculativePagedEngine(
-            model, params, draft, draft_params,
-            k=args.spec_k, rounds_per_step=args.spec_rounds,
-            **paged_kw, **kw,
+    if dp == 1 and tp == 1:
+        return construct(params, None, draft_params)
+
+    import jax as _jax
+
+    from shifu_tpu.parallel import MeshPlan, shard_params
+
+    if dp == 1:
+        mesh = MeshPlan(tp=tp).build(_jax.devices()[:tp])
+        return construct(
+            shard_params(model, params, mesh), mesh,
+            shard_params(draft, draft_params, mesh)
+            if draft is not None else None,
         )
-    if args.paged:
-        return load_adapters(PagedEngine(
-            model, params, page_size=args.page_size,
-            n_pages=args.n_pages,
-            enable_prefix_cache=args.prefix_cache, **kw,
-        ))
-    return load_adapters(Engine(model, params, **kw))
+    from shifu_tpu.infer import build_replicated
+
+    return build_replicated(
+        lambda mesh: construct(
+            shard_params(model, params, mesh), mesh,
+            shard_params(draft, draft_params, mesh)
+            if draft is not None else None,
+        ),
+        dp=dp, tp=tp,
+    )
 
 
 def cmd_serve(args) -> int:
@@ -980,6 +1034,10 @@ def main(argv=None) -> int:
     s.add_argument("--trace-log",
                    help="append one JSON line per completed request "
                         "(timing spans) to this file")
+    s.add_argument("--mesh",
+                   help="serving mesh, e.g. dp=2,tp=2: tp-device "
+                        "tensor-parallel sub-meshes, dp model replicas "
+                        "behind one router (dp x tp devices total)")
     s.add_argument("--lora-ckpt-dir", action="append",
                    help="LoRA adapter checkpoint dir (repeatable; "
                         "adapter ids are assigned 1..n in flag order; "

@@ -26,6 +26,7 @@ from shifu_tpu.infer.constrain import (
     compile_regex,
     schema_to_regex,
 )
+from shifu_tpu.infer.replica import ReplicatedEngine, build_replicated
 from shifu_tpu.infer.server import EngineRunner, make_server
 from shifu_tpu.infer.speculative import (
     SpecResult,
@@ -59,6 +60,8 @@ __all__ = [
     "LoraServingConfig",
     "EngineRunner",
     "PagedEngine",
+    "ReplicatedEngine",
+    "build_replicated",
     "PromptLookupPagedEngine",
     "SpeculativePagedEngine",
     "prompt_lookup_propose",

@@ -1890,6 +1890,12 @@ class PagedEngine(Engine):
         self._admit_seq = itertools.count()
         self._admit_order: Dict[int, int] = {}
         self.preemptions = 0  # observability: recompute events
+        # Sliding-window page reclamation (models with window_size):
+        # pages wholly behind the window are freed as the row advances
+        # (see _reclaim_window_pages). Per-slot low-water mark so each
+        # sweep is O(newly dead), not O(pages).
+        self._win_freed: Dict[int, int] = {}
+        self.window_pages_reclaimed = 0  # observability
 
         # ---- prefix caching (see class docstring) --------------------
         # Full pages are immutable (prefill writes whole pages; decode
@@ -2030,11 +2036,13 @@ class PagedEngine(Engine):
 
     def _release(self, slot: int) -> None:
         for pg in self._slot_pages.pop(slot, ()):
-            self._unref(pg)
+            if pg:  # 0 = already window-reclaimed (scratch marker)
+                self._unref(pg)
         self._table[slot] = 0
         self._lengths[slot] = 0
         self._cur[slot] = 0
         self._admit_order.pop(slot, None)
+        self._win_freed.pop(slot, None)
         self._pending_rows.pop(slot, None)
         self._pending_prompt.pop(slot, None)
 
@@ -2215,7 +2223,9 @@ class PagedEngine(Engine):
             keys.append(key)
             if key not in self._prefix_pages and i < len(pages_used):
                 pg = pages_used[i]
-                if pg not in self._page_key:
+                # pg == 0: window-reclaimed during a chunked prefill —
+                # the scratch page must never register as a prefix.
+                if pg and pg not in self._page_key:
                     self._prefix_pages[key] = pg
                     self._page_key[pg] = key
         # ...then bump touched prefixes to MRU, LONGEST first so
@@ -2289,6 +2299,12 @@ class PagedEngine(Engine):
                 self._page_rc[pg] = self._page_rc.get(pg, 0) + 1
             self._slot_pages[slot].extend(own[:keep])
             req.prefilled = off + this_chunk
+            # Windowed models: pages the NEXT chunk's attention can no
+            # longer reach free up mid-prefill (a 32k windowed prompt
+            # never holds more than O(window + chunk) pages). The
+            # pending row mirrors the zeroing so finalize installs the
+            # reclaimed layout.
+            self._reclaim_window_pages(slot, req.prefilled, row=row)
             if req.prefilled >= len(prompt):
                 self._finalize_chunked(slot, req, first, lp)
 
@@ -2368,14 +2384,54 @@ class PagedEngine(Engine):
         lp = _token_logprob(logits[:, 0], tok[None])[0]
         return tok, lp, cache
 
+    def _reclaim_window_pages(self, slot: int, length: int,
+                              row=None) -> None:
+        """Free pages wholly behind the attention window — the memory
+        win windows exist for. The kernel provably never reads them:
+        a query at position q sees keys with pos > q - window and
+        BLOCK-SKIPS to max(len - (window-1), 0) // page_size
+        (ops/pallas/paged_attention.py:187,369; the XLA fallback masks
+        identically), and every future query sits at q >= length. A
+        page covering [j*ps, (j+1)*ps) is dead once
+        (j+1)*ps <= length - window. Freed entries become 0 (scratch)
+        in both the slot's page list and its table row — gathers of
+        the scratch page land on masked positions. Refcounts are
+        respected: a shared prefix-cache page merely drops this slot's
+        pin and stays resident for future prefix hits. Without this, a
+        Mistral-style w=4096 model at 32k context holds 8x the KV it
+        can ever read."""
+        w = getattr(self.model.cfg, "window_size", None)
+        if not w:
+            return
+        pages = self._slot_pages.get(slot)
+        if not pages:
+            return
+        dead_end = min((length - w) // self.page_size, len(pages))
+        start = self._win_freed.get(slot, 0)
+        for j in range(start, dead_end):
+            pg = pages[j]
+            if pg:
+                self._unref(pg)
+                pages[j] = 0
+                if row is not None:
+                    row[j] = 0
+                else:
+                    self._table[slot, j] = 0
+                self.window_pages_reclaimed += 1
+        if dead_end > start:
+            self._win_freed[slot] = dead_end
+
     def _ensure_decode_pages(self, k: int = 1) -> None:
         """Every active slot gets pages covering its next (up to) ``k``
         write positions — capped at its remaining budget — preempting
-        youngest-first when the pool is dry."""
+        youngest-first when the pool is dry. Windowed models first
+        return dead pages to the pool (often covering the allocation
+        out of the slot's own tail)."""
         for slot in sorted(self._active, key=self._admit_order.__getitem__):
             if slot not in self._active:
                 continue  # preempted as a victim earlier in this loop
             req = self._active[slot]
+            self._reclaim_window_pages(slot, int(self._lengths[slot]))
             steps = min(k, req.max_new_tokens - len(req.generated))
             if steps < 1:
                 continue  # budget exhausted; sweep picks it up

@@ -1,0 +1,268 @@
+"""dp-replica serving: N engine replicas behind one submit/step surface.
+
+Serving parallelism beyond tensor parallelism: tensor-parallel meshes
+scale a SINGLE model copy's latency, but for models that fit a few
+chips the better use of a pod slice is usually REPLICATION — dp model
+copies, each on its own tp-device sub-mesh, behind one router. A 1.2B
+model on 8 chips serves ~4x the throughput as 4 dp replicas of tp=2
+than as one tp=8 copy (the tp=8 copy's per-chip weight shard is tiny
+and collective-bound; the replicas stream their full weights locally).
+
+:class:`ReplicatedEngine` is that router. It is DUCK-TYPED like
+:class:`~shifu_tpu.infer.engine.Engine` — submit/step/run/cancel/idle/
+live_generated/latency_stats and the observability attributes — so the
+HTTP server (infer/server.py) and the CLI drive it unchanged. Requests
+are routed at submit time to the replica with the most free capacity
+(free slots first, then shortest queue); completions are re-keyed onto
+router-global rids. Each replica is an ordinary engine on its own
+``jax.sharding.Mesh`` whose dispatches are ASYNC — the router's
+round-robin step() keeps every replica's device busy from one host
+thread (dispatch N runs while dispatch N-1 executes), so one engine
+thread drives the whole group.
+
+Determinism: routing never changes results — engines are deterministic
+given (prompt, sampling, seed), and each replica holds identical
+params, so greedy output through the router equals any single engine's
+(tested on a dp=2 x tp=2 virtual mesh in tests/test_replica.py).
+
+Reference parity note: the upstream reference (klyan/shifu) is an empty
+repository (SURVEY.md); there is no reference router to match. The
+shape follows common practice (replica groups behind a shared queue).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+class ReplicatedEngine:
+    """Route requests over ``engines`` (identical model/params).
+
+    Build replicas yourself (any Engine subclass, one per sub-mesh) or
+    use :func:`build_replicated`. All replicas must serve the same
+    model with the same sampling surface — the router validates the
+    obvious invariants (max_len, eos) and trusts the rest.
+    """
+
+    def __init__(self, engines: List):
+        if not engines:
+            raise ValueError("need at least one engine replica")
+        lens = {e.max_len for e in engines}
+        if len(lens) != 1:
+            raise ValueError(f"replicas disagree on max_len: {lens}")
+        eos = {e.eos_id for e in engines}
+        if len(eos) != 1:
+            raise ValueError(f"replicas disagree on eos_id: {eos}")
+        self.engines = list(engines)
+        self._rid = itertools.count()
+        # global rid -> (replica index, local rid); and the reverse,
+        # per replica, for re-keying completions.
+        self._route: Dict[int, Tuple[int, int]] = {}
+        self._back: List[Dict[int, int]] = [{} for _ in engines]
+        # Observability: requests routed to each replica.
+        self.routed: List[int] = [0 for _ in engines]
+        first = engines[0]
+        # The surfaces the server/CLI read through the engine.
+        self.model = first.model
+        self.params = first.params
+        self.max_len = first.max_len
+        self.tokenizer = first.tokenizer
+        self.sample_cfg = first.sample_cfg
+        self.eos_id = first.eos_id
+        self.per_request_sampling = first.per_request_sampling
+        self.enable_penalties = first.enable_penalties
+        self.enable_logit_bias = first.enable_logit_bias
+        self.lora = first.lora
+
+    # ------------------------------------------------------------ routing
+    def _pick(self) -> int:
+        """Most free slots; ties -> shortest queue, then lowest index
+        (deterministic)."""
+        best, best_key = 0, None
+        for i, e in enumerate(self.engines):
+            key = (
+                e.max_slots - e.active_slots,  # free capacity
+                -len(e._queue),
+            )
+            if best_key is None or key > best_key:
+                best, best_key = i, key
+        return best
+
+    def submit(self, prompt_tokens, max_new_tokens: int, **kw) -> int:
+        idx = self._pick()
+        lrid = self.engines[idx].submit(
+            prompt_tokens, max_new_tokens, **kw
+        )
+        rid = next(self._rid)
+        self._route[rid] = (idx, lrid)
+        self._back[idx][lrid] = rid
+        self.routed[idx] += 1
+        return rid
+
+    def add_adapter(self, lora_params) -> int:
+        """Register the adapter on EVERY replica (ids must agree so a
+        routed request means the same adapter everywhere)."""
+        ids = {e.add_adapter(lora_params) for e in self.engines}
+        if len(ids) != 1:
+            raise RuntimeError(
+                f"replicas assigned different adapter ids: {ids}"
+            )
+        return ids.pop()
+
+    def cancel(self, rid: int) -> bool:
+        ent = self._route.get(rid)
+        if ent is None:
+            return False
+        idx, lrid = ent
+        hit = self.engines[idx].cancel(lrid)
+        if hit:
+            self._route.pop(rid, None)
+            self._back[idx].pop(lrid, None)
+        return hit
+
+    # ------------------------------------------------------------ driving
+    def step(self):
+        """One step on every replica. Dispatches are async per device
+        sub-mesh, so replica i+1's dispatch overlaps replica i's device
+        execution; the host sync happens inside each engine's fold."""
+        out = []
+        for idx, eng in enumerate(self.engines):
+            for c in eng.step():
+                out.append(self._rekey(idx, c))
+        return out
+
+    def run(self):
+        out = []
+        while not self.idle:
+            out.extend(self.step())
+        return out
+
+    def _rekey(self, idx: int, c):
+        rid = self._back[idx].pop(c.rid, None)
+        if rid is None:  # direct submit to a replica (not via router)
+            return c
+        self._route.pop(rid, None)
+        return dataclasses.replace(c, rid=rid)
+
+    # ------------------------------------------------------- aggregation
+    @property
+    def idle(self) -> bool:
+        return all(e.idle for e in self.engines)
+
+    @property
+    def active_slots(self) -> int:
+        return sum(e.active_slots for e in self.engines)
+
+    @property
+    def max_slots(self) -> int:
+        return sum(e.max_slots for e in self.engines)
+
+    @property
+    def _queue(self):  # the server reads len(engine._queue)
+        return tuple(
+            req for e in self.engines for req in e._queue
+        )
+
+    def live_generated(self) -> Dict[int, List[int]]:
+        live: Dict[int, List[int]] = {}
+        for idx, eng in enumerate(self.engines):
+            for lrid, toks in eng.live_generated().items():
+                rid = self._back[idx].get(lrid)
+                live[rid if rid is not None else lrid] = toks
+        return live
+
+    def _sum(self, attr: str) -> Optional[int]:
+        vals = [getattr(e, attr) for e in self.engines
+                if hasattr(e, attr)]
+        return sum(vals) if vals else None
+
+    @property
+    def cancellations(self):
+        return self._sum("cancellations") or 0
+
+    @property
+    def preemptions(self):
+        return self._sum("preemptions")
+
+    @property
+    def free_pages(self):
+        return self._sum("free_pages")
+
+    @property
+    def n_pages(self):
+        return self._sum("n_pages")
+
+    @property
+    def prefix_hits_tokens(self):
+        return self._sum("prefix_hits_tokens")
+
+    def latency_stats(self) -> dict:
+        """Pooled percentiles over every replica's trace window, plus
+        per-replica breakdowns (the load-balance surface operators
+        watch) — the /healthz "latency" block."""
+        wins = []
+        per = []
+        for i, e in enumerate(self.engines):
+            with e._trace_lock:
+                win = list(e._trace_window)
+            wins.extend(win)
+            per.append(
+                {"replica": i, "completions": len(win),
+                 "routed": self.routed[i]}
+            )
+        if not wins:
+            return {"completions": 0, "replicas": per}
+
+        def pct(key, q):
+            vals = sorted(t[key] for t in wins if key in t)
+            if not vals:
+                return None
+            return vals[min(int(q * len(vals)), len(vals) - 1)]
+
+        return {
+            "completions": len(wins),
+            "ttft_ms_p50": pct("ttft_ms", 0.50),
+            "ttft_ms_p95": pct("ttft_ms", 0.95),
+            "decode_tokens_per_s_p50": pct("decode_tokens_per_s", 0.50),
+            "decode_tokens_per_s_p05": pct("decode_tokens_per_s", 0.05),
+            "preempted_fraction": round(
+                sum(1 for t in wins if t["preemptions"]) / len(wins), 4
+            ),
+            "replicas": per,
+        }
+
+
+def build_replicated(make_engine, *, dp: int, tp: int = 1,
+                     devices=None, axis_name: str = "tp"):
+    """``dp`` replicas, each on its own ``tp``-device mesh.
+
+    ``make_engine(mesh)`` builds one replica ON that mesh — it must
+    shard/place the params itself (``parallel.sharding.shard_params``
+    for tp > 1; a 1-device mesh still places arrays on the replica's
+    own device, which is what isolates replicas on a multi-chip host).
+    Each sub-mesh is a full MeshPlan mesh (tp-sized, every other axis
+    1) so the standard sharding rules apply unchanged. Device order:
+    replica i takes devices [i*tp, (i+1)*tp) of ``devices`` (default
+    ``jax.devices()``) — contiguous blocks keep a replica's tp
+    collectives on neighbouring chips (ICI) on real TPU topologies.
+    """
+    import jax
+
+    from shifu_tpu.parallel import MeshPlan
+
+    if dp < 1 or tp < 1:
+        raise ValueError(f"dp and tp must be >= 1, got dp={dp} tp={tp}")
+    devs = list(devices if devices is not None else jax.devices())
+    if len(devs) < dp * tp:
+        raise ValueError(
+            f"dp={dp} x tp={tp} needs {dp * tp} devices, have {len(devs)}"
+        )
+    engines = []
+    for i in range(dp):
+        sub = devs[i * tp : (i + 1) * tp]
+        engines.append(make_engine(MeshPlan(tp=tp).build(sub)))
+    return ReplicatedEngine(engines)

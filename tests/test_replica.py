@@ -1,0 +1,214 @@
+"""dp-replica serving: the ReplicatedEngine router.
+
+Pinned properties:
+  * dp=2 x tp=2 on the 4-device virtual mesh: greedy outputs through
+    the router == the single no-mesh engine, request for request (f32
+    so reduction order cannot flip argmaxes);
+  * LOAD BALANCE: both replicas receive work and complete it;
+  * cancel routes to the owning replica; live_generated re-keys onto
+    router rids; stats aggregate (active/max slots, pages);
+  * duck-typing: the HTTP server drives the router unchanged (live
+    request end to end; /healthz carries per-replica latency stats);
+  * the CLI seam builds a router from --mesh dp=2,tp=2 and a single
+    mesh engine from --mesh tp=2;
+  * validation: axis names, device budget, replica invariants.
+"""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from shifu_tpu.core.dtypes import FULL_F32
+from shifu_tpu.infer import (
+    ReplicatedEngine,
+    SampleConfig,
+    build_replicated,
+)
+from shifu_tpu.infer.engine import Engine, PagedEngine
+from shifu_tpu.models import Transformer, TransformerConfig
+from shifu_tpu.parallel import shard_params
+
+
+@pytest.fixture(scope="module")
+def tiny_f32():
+    model = Transformer(TransformerConfig.tiny(), policy=FULL_F32)
+    return model, model.init(jax.random.key(0))
+
+
+_KW = dict(
+    max_slots=2, max_len=32, cache_dtype=jnp.float32,
+    sample_cfg=SampleConfig(temperature=0.0), prefill_buckets=(16, 32),
+)
+
+
+def _group(model, params, dp=2, tp=2, cls=PagedEngine, **ekw):
+    def mk(mesh):
+        kw = dict(_KW, **ekw)
+        if cls is PagedEngine:
+            kw.setdefault("page_size", 8)
+        return cls(
+            model, shard_params(model, params, mesh), mesh=mesh, **kw
+        )
+
+    return build_replicated(mk, dp=dp, tp=tp,
+                            devices=jax.devices()[: dp * tp])
+
+
+def test_router_parity_and_balance(tiny_f32):
+    model, params = tiny_f32
+    rng = np.random.RandomState(15)
+    prompts = [
+        rng.randint(1, 256, size=n).tolist()
+        for n in (5, 9, 3, 7, 4, 11)
+    ]
+    ref = Engine(model, params, **_KW)
+    rids = [ref.submit(p, max_new_tokens=5) for p in prompts]
+    want = {rids.index(c.rid): c.tokens for c in ref.run()}
+
+    grp = _group(model, params)
+    rids = [grp.submit(p, max_new_tokens=5) for p in prompts]
+    got = {rids.index(c.rid): c.tokens for c in grp.run()}
+    for i in range(len(prompts)):
+        np.testing.assert_array_equal(want[i], got[i], err_msg=str(i))
+    # Both replicas worked.
+    assert all(r > 0 for r in grp.routed), grp.routed
+    stats = grp.latency_stats()
+    assert stats["completions"] == len(prompts)
+    assert [r["routed"] for r in stats["replicas"]] == grp.routed
+    assert grp.max_slots == 4
+    assert grp.idle
+
+
+def test_router_dp_only_single_device_replicas(tiny_f32):
+    """dp=2, tp=1: two single-device replicas (each on its own device
+    via a 1-device mesh) still match the reference."""
+    model, params = tiny_f32
+    rng = np.random.RandomState(3)
+    prompts = [rng.randint(1, 256, size=6).tolist() for _ in range(4)]
+    ref = Engine(model, params, **_KW)
+    rids = [ref.submit(p, max_new_tokens=4) for p in prompts]
+    want = {rids.index(c.rid): c.tokens for c in ref.run()}
+    grp = _group(model, params, dp=2, tp=1, cls=Engine)
+    rids = [grp.submit(p, max_new_tokens=4) for p in prompts]
+    got = {rids.index(c.rid): c.tokens for c in grp.run()}
+    for i in range(len(prompts)):
+        np.testing.assert_array_equal(want[i], got[i])
+    assert all(r > 0 for r in grp.routed)
+
+
+def test_router_cancel_and_live(tiny_f32):
+    model, params = tiny_f32
+    grp = _group(model, params)
+    r1 = grp.submit([1, 2, 3], max_new_tokens=8)
+    r2 = grp.submit([4, 5], max_new_tokens=8)
+    grp.step()
+    live = grp.live_generated()
+    assert set(live) == {r1, r2}
+    assert grp.cancel(r1)
+    assert not grp.cancel(r1)  # already gone
+    done = {c.rid for c in grp.run()}
+    assert done == {r2}
+    # Paged aggregation surfaces exist and sum across replicas.
+    assert grp.free_pages is not None and grp.n_pages is not None
+    assert grp.preemptions == 0
+
+
+def test_router_through_http_server(tiny_f32):
+    model, params = tiny_f32
+    grp = _group(model, params)
+    server = __import__(
+        "shifu_tpu.infer.server", fromlist=["make_server"]
+    ).make_server(grp, port=0, default_max_new=8)
+    base = f"http://127.0.0.1:{server.server_port}"
+    try:
+        body = json.dumps(
+            {"prompt_tokens": [1, 2, 3], "max_new_tokens": 4}
+        ).encode()
+        req = urllib.request.Request(
+            base + "/v1/completions", body,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=60) as r:
+            out = json.loads(r.read())
+        assert len(out["tokens"]) >= 1
+        with urllib.request.urlopen(base + "/healthz", timeout=30) as r:
+            h = json.loads(r.read())
+        assert h["max_slots"] == 4
+        assert "replicas" in h["latency"]
+    finally:
+        server.shutdown()
+        server.runner.shutdown()
+
+
+def test_cli_builds_router(tiny_f32):
+    """The CLI seam: --mesh dp=2,tp=2 -> router; --mesh tp=2 -> one
+    mesh engine; bad axes refuse."""
+    import argparse
+
+    from shifu_tpu.cli import build_serve_engine
+    from shifu_tpu.data.tokenizer import ByteTokenizer
+
+    model, params = tiny_f32
+    base = dict(
+        max_slots=2, max_len=32, temperature=0.0, top_p=1.0,
+        decode_chunk=1, eos_id=-1, paged=True, page_size=8,
+        n_pages=None, prefix_cache=False, per_request_sampling=False,
+        penalties=False, logit_bias=False, lora_ckpt_dir=None,
+        lora_rank=8, lora_alpha=16.0, lora_targets="wq,wk,wv,wo",
+        spec="off", spec_k=4, spec_ngram=3, spec_rounds=2,
+        draft_preset=None, draft_ckpt_dir=None,
+    )
+    tok = ByteTokenizer()
+
+    def mk(**over):
+        return build_serve_engine(
+            argparse.Namespace(**{**base, **over}), model, params, tok
+        )
+
+    grp = mk(mesh="dp=2,tp=2")
+    assert isinstance(grp, ReplicatedEngine)
+    assert len(grp.engines) == 2
+    rid = grp.submit([1, 2, 3], max_new_tokens=3)
+    assert {c.rid for c in grp.run()} == {rid}
+
+    one = mk(mesh="tp=2")
+    assert isinstance(one, PagedEngine)
+    assert one.mesh is not None
+
+    with pytest.raises(ValueError, match="dp/tp"):
+        mk(mesh="fsdp=2")
+
+    # Round 5: --spec prompt-lookup composes with --logit-bias and
+    # with dp replicas.
+    spec_grp = mk(
+        mesh="dp=2,tp=1", spec="prompt-lookup", logit_bias=True,
+        per_request_sampling=True,
+    )
+    assert isinstance(spec_grp, ReplicatedEngine)
+    rid = spec_grp.submit(
+        [1, 2, 3], max_new_tokens=4, logit_bias={5: -100}
+    )
+    done = {c.rid: c for c in spec_grp.run()}[rid]
+    assert 5 not in done.tokens
+
+    # Penalties remain refused with --spec.
+    with pytest.raises(ValueError, match="penalties"):
+        mk(spec="prompt-lookup", penalties=True)
+
+
+def test_router_validation(tiny_f32):
+    model, params = tiny_f32
+    with pytest.raises(ValueError, match="at least one"):
+        ReplicatedEngine([])
+    e1 = Engine(model, params, **_KW)
+    e2 = Engine(model, params, **{**_KW, "max_len": 16,
+                                  "prefill_buckets": (16,)})
+    with pytest.raises(ValueError, match="max_len"):
+        ReplicatedEngine([e1, e2])
+    with pytest.raises(ValueError, match="devices"):
+        build_replicated(lambda m: e1, dp=8, tp=2)
