@@ -9,11 +9,14 @@ Pipeline:
   1. :func:`compile_regex` — a self-contained regex compiler (no
      dependency on ``re``'s internals): pattern -> Thompson NFA ->
      subset-construction DFA over BYTES. Supported syntax: literals,
-     escapes (``\\d \\w \\s \\. ...``), ``.``, character classes
-     ``[a-z0-9_]`` / ``[^...]``, grouping ``( )``, alternation ``|``,
-     quantifiers ``* + ? {m} {m,} {m,n}``. Anchoring is implicit: the
-     WHOLE generation must match (the serving semantics people expect
-     from "constrain the output to this pattern").
+     escapes (``\\d \\w \\s \\. ...``), raw byte escapes ``\\xHH``
+     (usable as class range endpoints — the byte-level automaton's
+     native literal, e.g. ``[\\x80-\\xBF]`` for UTF-8 continuation
+     bytes), ``.``, character classes ``[a-z0-9_]`` / ``[^...]``,
+     grouping ``( )``, alternation ``|``, quantifiers
+     ``* + ? {m} {m,} {m,n}``. Anchoring is implicit: the WHOLE
+     generation must match (the serving semantics people expect from
+     "constrain the output to this pattern").
   2. :class:`TokenFSM` — lifts the byte DFA to the TOKENIZER's
      alphabet: in DFA state s, token t is allowed iff feeding t's
      UTF-8 bytes keeps the DFA out of the dead state; the per-state
@@ -196,10 +199,22 @@ class _Parser:
             self.error(f"nothing to repeat before {c!r}")
         return _char_node(c)
 
+    def hex_byte(self) -> int:
+        """Two hex digits after ``\\x`` -> one raw byte value."""
+        digits = ""
+        for _ in range(2):
+            c = self.peek()
+            if c is None or c not in "0123456789abcdefABCDEF":
+                self.error(r"\x needs two hex digits")
+            digits += self.next()
+        return int(digits, 16)
+
     def escape_node(self):
         """An escape in NODE position: classes stay byte-sets; a
         multi-byte escaped literal becomes a byte SEQUENCE."""
         c = self.next()
+        if c == "x":
+            return ("lit", frozenset([self.hex_byte()]))
         if c in _ESCAPES:
             return ("lit", _ESCAPES[c])
         return _char_node(c)
@@ -209,6 +224,8 @@ class _Parser:
         multi-byte characters cannot be one alternative byte, so they
         are rejected with a clear error (classes are byte-level)."""
         c = self.next()
+        if c == "x":
+            return frozenset([self.hex_byte()])
         if c in _ESCAPES:
             return _ESCAPES[c]
         b = c.encode("utf-8")
@@ -216,7 +233,23 @@ class _Parser:
             self.error(
                 f"non-ASCII {c!r} in a character class: classes are "
                 "byte-level — write it as a literal or alternation "
-                "instead"
+                "instead (or raw \\xHH byte escapes)"
+            )
+        return frozenset(b)
+
+    def class_item(self) -> FrozenSet[int]:
+        """One class member: a literal single-byte char, an escape
+        (``\\xHH`` raw byte, ``\\n`` style single byte, or a multi-byte
+        set like ``\\d``)."""
+        c = self.next()
+        if c == "\\":
+            return self.escape()
+        b = c.encode("utf-8")
+        if len(b) != 1:
+            self.error(
+                f"non-ASCII {c!r} in a character class: classes are "
+                "byte-level — write it as a literal or alternation "
+                "instead (or raw \\xHH byte escapes)"
             )
         return frozenset(b)
 
@@ -235,28 +268,20 @@ class _Parser:
                 self.next()
                 break
             first = False
-            self.next()
-            if c == "\\":
-                chars |= self.escape()
-                continue
-            start = c.encode("utf-8")
-            if len(start) != 1:
-                self.error(
-                    f"non-ASCII {c!r} in a character class: classes "
-                    "are byte-level — write it as a literal or "
-                    "alternation instead"
-                )
-            if self.peek() == "-":  # start is single-byte (checked above)
+            item = self.class_item()
+            # A range needs single-byte endpoints; \xHH escapes are
+            # valid endpoints (the byte automaton's native literal).
+            if len(item) == 1 and self.peek() == "-":
                 nxt = self.p[self.i + 1] if self.i + 1 < len(self.p) else None
                 if nxt is not None and nxt != "]":
                     self.next()  # consume '-'
-                    end = self.next()
-                    eb = end.encode("utf-8")
-                    if len(eb) != 1 or eb[0] < start[0]:
-                        self.error(f"bad range {c}-{end}")
-                    chars |= set(range(start[0], eb[0] + 1))
+                    end = self.class_item()
+                    lo = next(iter(item))
+                    if len(end) != 1 or min(end) < lo:
+                        self.error(f"bad range in class at {self.i}")
+                    chars |= set(range(lo, min(end) + 1))
                     continue
-            chars |= set(start)
+            chars |= item
         return frozenset(_ANY - chars) if negate else frozenset(chars)
 
 
@@ -598,15 +623,37 @@ def _regex_escape(text: str) -> str:
     return "".join(out)
 
 
-# String CONTENTS: printable ASCII minus '"' and backslash — the class
-# [ !#-[\]^-~] spans 0x20-0x7E skipping 0x22 and 0x5C (']' escaped,
-# then the '^'-'~' range — mid-class '^' is literal). Stricter than
-# JSON (no escapes, no non-ASCII, no control characters) on purpose:
-# anything this grammar lets the model emit must PARSE as JSON, and
-# control bytes / lone UTF-8 fragments inside a byte-level class would
-# not. Non-ASCII output needs \uXXXX escapes, which are out of this
-# regular subset — documented in schema_to_regex.
-_STR_CHAR = r"[ !#-[\]^-~]"
+# String CONTENTS — the FULL JSON string grammar (round 5; the old
+# printable-ASCII-only approximation could never emit a quote, newline
+# or non-ASCII character):
+#   * unescaped chars: printable ASCII minus '"' and backslash — the
+#     class [ !#-[\]^-~] spans 0x20-0x7E skipping 0x22/0x5C (']'
+#     escaped, then '^'-'~'; mid-class '^' is literal) — plus WELL-
+#     FORMED multi-byte UTF-8 via byte-sequence alternatives (the
+#     RFC 3629 table: C2-DF + cont; E0 A0-BF + cont / E1-EC + 2cont /
+#     ED 80-9F + cont (no surrogates) / EE-EF + 2cont; F0 90-BF +
+#     2cont / F1-F3 + 3cont / F4 80-8F + 2cont). Truncated or
+#     overlong sequences never match, so constrained output always
+#     DECODES as UTF-8;
+#   * escapes: \" \\ \/ \b \f \n \r \t and \uXXXX.
+# Anything this grammar lets the model emit parses with json.loads
+# (lone \uD800-style surrogate escapes included — json.loads accepts
+# them, matching the RFC 8259 "may" clause).
+_STR_ASCII = r"[ !#-[\]^-~]"
+_STR_UTF8 = (
+    r"([\xC2-\xDF][\x80-\xBF]"
+    r"|\xE0[\xA0-\xBF][\x80-\xBF]"
+    r"|[\xE1-\xEC][\x80-\xBF][\x80-\xBF]"
+    r"|\xED[\x80-\x9F][\x80-\xBF]"
+    r"|[\xEE-\xEF][\x80-\xBF][\x80-\xBF]"
+    r"|\xF0[\x90-\xBF][\x80-\xBF][\x80-\xBF]"
+    r"|[\xF1-\xF3][\x80-\xBF][\x80-\xBF][\x80-\xBF]"
+    r"|\xF4[\x80-\x8F][\x80-\xBF][\x80-\xBF])"
+)
+_STR_ESCAPE = r'\\(["\\/bfnrt]|u[0-9a-fA-F]{4})'
+_STR_CHAR = (
+    "(" + _STR_ASCII + "|" + _STR_UTF8 + "|" + _STR_ESCAPE + ")"
+)
 _JSON_STRING = '"' + _STR_CHAR + '*"'
 # Leading zeros are invalid JSON (json.loads rejects 007): integers
 # are 0 or [1-9] followed by digits.
@@ -623,19 +670,17 @@ def schema_to_regex(schema: dict) -> str:
 
     Supported: {"type": "object", "properties": {...}} (all properties
     required, emitted in property order — deterministic output is the
-    point of constraining), {"type": "string"} (no embedded quotes or
-    backslash escapes — a regular approximation; full JSON string
-    escaping needs states the byte DFA happily supports but the payoff
-    is marginal for constrained OUTPUT), "integer", "number",
-    "boolean", "null", {"enum": [...]} of scalars, {"type": "array",
-    "items": ...} (any length, incl. empty; "items" is REQUIRED), and
-    nested objects. Strings are PRINTABLE-ASCII-only (no escapes,
-    control characters, or raw non-ASCII — each would let the FSM
-    accept output json.loads rejects; non-ASCII needs \\uXXXX escapes,
-    outside this regular subset).
+    point of constraining), {"type": "string"} with the FULL JSON
+    string grammar (escapes ``\\" \\\\ \\/ \\b \\f \\n \\r \\t``,
+    ``\\uXXXX``, and well-formed multi-byte UTF-8 — see ``_STR_CHAR``;
+    everything the FSM admits parses with ``json.loads``), "integer",
+    "number", "boolean", "null", {"enum": [...]} of scalars,
+    {"type": "array", "items": ...} (any length, incl. empty; "items"
+    is REQUIRED), and nested objects.
     ``minLength``/``maxLength`` on strings bound the CHARACTER count
-    for single-byte text. Anything else raises ValueError — an
-    unsupported keyword must not silently weaken a constraint.
+    (an escape or a multi-byte UTF-8 sequence counts as ONE
+    character). Anything else raises ValueError — an unsupported
+    keyword must not silently weaken a constraint.
     """
     if not isinstance(schema, dict):
         raise ValueError("schema must be an object")

@@ -516,9 +516,11 @@ def test_engine_json_schema_end_to_end(tiny):
 
 def test_schema_json_strictness():
     """Everything the schema grammar accepts must PARSE as JSON:
-    leading-zero numbers, control characters, and raw non-ASCII bytes
-    in strings are all rejected (each is a string json.loads refuses,
-    so admitting it would break the schema-valid-at-eos guarantee)."""
+    leading-zero numbers, raw control characters, and ILL-FORMED UTF-8
+    bytes in strings are all rejected (each is a string json.loads
+    refuses, so admitting it would break the schema-valid-at-eos
+    guarantee). Well-formed non-ASCII and escapes are accepted —
+    test_schema_full_string_grammar."""
     from shifu_tpu.infer import schema_to_regex
 
     sch = {"type": "object", "properties": {
@@ -536,3 +538,84 @@ def test_schema_json_strictness():
         schema_to_regex({"type": "object", "properties": {
             "x": {"type": "array"},
         }})
+
+
+def test_hex_byte_escapes():
+    r"""\xHH raw-byte escapes: literals, class members, and class
+    RANGE endpoints — the byte automaton's native literal."""
+    dfa = compile_regex(r"[\x41-\x43]+")
+    assert dfa.matches(b"ABCB") and not dfa.matches(b"AD")
+    dfa = compile_regex(r"\x00\xff")
+    assert dfa.matches(bytes([0, 255]))
+    assert not dfa.matches(bytes([0, 254]))
+    dfa = compile_regex(r"[^\x00-\x7f]")
+    assert dfa.matches(b"\x80") and not dfa.matches(b"a")
+    with pytest.raises(ValueError, match="hex"):
+        compile_regex(r"\xg1")
+
+
+def test_schema_full_string_grammar():
+    """Round 5: schema strings carry the FULL JSON string grammar —
+    escapes (\\" \\\\ \\/ \\b \\f \\n \\r \\t, \\uXXXX) and well-formed
+    multi-byte UTF-8 — and everything admitted round-trips through
+    json.loads. Ill-formed byte sequences (truncated, overlong, raw
+    surrogates) never match, so constrained output always decodes."""
+    from shifu_tpu.infer import schema_to_regex
+    from shifu_tpu.infer.constrain import _JSON_STRING
+
+    sdfa = compile_regex(_JSON_STRING)
+    for s in ('""', '"he said \\"hi\\""', '"tab\\there"', '"snow☃man"',
+              '"emoji\U0001F600!"', '"\\u00e9\\uD83D\\uDE00"',
+              '"slash\\/ok"', '"café"'):
+        assert sdfa.matches(s.encode()), s
+        json.loads(s)
+    for s in ('"', '"bad\\q"', '"ctrl\x01"', '"\\u12g4"'):
+        assert not sdfa.matches(s.encode()), s
+    assert not sdfa.matches(b'"\xc3"')          # truncated 2-byte
+    assert not sdfa.matches(b'"\xc0\xaf"')      # overlong
+    assert not sdfa.matches(b'"\xed\xa0\x80"')  # raw surrogate
+    assert sdfa.matches(b'"\xc3\xa9"')          # e-acute
+    assert sdfa.matches(b'"\xf0\x9f\x98\x80"')  # 4-byte emoji
+
+    sch = {"type": "object", "properties": {
+        "name": {"type": "string"}, "n": {"type": "integer"}}}
+    odfa = compile_regex(schema_to_regex(sch))
+    for obj in ({"name": 'he said "hi"\nsnow: ☃', "n": -42},
+                {"name": "café 😀 \\ / tab\t", "n": 7}):
+        for ascii_only in (True, False):
+            enc = json.dumps(
+                obj, ensure_ascii=ascii_only, separators=(",", ":")
+            ).encode()
+            assert odfa.matches(enc), enc
+            assert json.loads(enc) == obj
+
+    # Bounded length counts CHARACTERS: one escape or one multi-byte
+    # sequence is one character.
+    b = compile_regex(schema_to_regex({"type": "string", "maxLength": 3}))
+    assert b.matches('"ab\\n"'.encode())
+    assert b.matches('"☃☃☃"'.encode())
+    assert not b.matches('"abcd"'.encode())
+
+
+def test_constrained_engine_emits_escaped_string(tiny):
+    """End to end: a schema-constrained generation whose sampler is
+    BIASED toward quote/backslash bytes still finishes with VALID
+    escaped JSON (the grammar forces the escape states)."""
+    model, params = tiny
+    tok = ByteTokenizer()
+    sch = {"type": "object", "properties": {"s": {"type": "string"}}}
+    # Bias the raw-quote and backslash byte tokens UP so the model
+    # wants to emit them constantly; the FSM must still deliver JSON.
+    q = tok.encode('"')[0]
+    bs = tok.encode("\\")[0]
+    res = _serve(
+        model, params,
+        [(tok.encode("j: "), dict(
+            json_schema=sch, logit_bias={q: 4.0, bs: 4.0},
+        ))],
+        max_new=48, eos_id=tok.eos_id,
+    )[0]
+    text = tok.decode([t for t in res.tokens if t != tok.eos_id])
+    if res.finished_by == "eos":
+        parsed = json.loads(text)
+        assert set(parsed) == {"s"}
