@@ -12,6 +12,14 @@ tests/test_convert.py against the torch forward):
     (1 + scale) — so ``scale = g - 1``.
   * Linear layers: torch keeps (out, in); einsum weights here are
     (in, out[, ...]) — transpose + reshape, heads-major.
+  * MoE (Mixtral layout, round 5): ``block_sparse_moe.gate`` is the
+    router ((E, d) -> (d, E)); expert e's ``w1/w3/w2`` are SwiGLU
+    gate/up/down, stacked over experts into the (L, E, ...) leaves.
+    Routing semantics already agree (softmax over all experts, top-k,
+    renormalise — ops.moe route_top_k's Mixtral convention); HF never
+    drops tokens, so conversion sets moe_capacity_factor = n_experts
+    (provably dropless: capacity >= s*k even if every token picks one
+    expert) — override it to serve with real capacity limits.
 
 Everything is stacked across layers into the (layers, ...) leaves the
 scan-based forward expects.
@@ -123,9 +131,19 @@ def config_from_hf_llama(hf_config, **overrides) -> TransformerConfig:
                 "(implemented: default, linear, dynamic, yarn, llama3, "
                 "longrope)"
             )
+    moe_kw = {}
+    n_experts = getattr(hf_config, "num_local_experts", 0) or 0
+    if n_experts:
+        moe_kw = dict(
+            n_experts=int(n_experts),
+            moe_top_k=int(hf_config.num_experts_per_tok),
+            # Dropless parity with the HF forward (module docstring).
+            moe_capacity_factor=float(n_experts),
+        )
     kw = dict(
         vocab_size=hf_config.vocab_size,
         dim=hf_config.hidden_size,
+        **moe_kw,
         n_layers=hf_config.num_hidden_layers,
         n_heads=hf_config.num_attention_heads,
         n_kv_heads=getattr(hf_config, "num_key_value_heads", None)
@@ -208,12 +226,43 @@ def params_from_hf_llama(
             "layers.{}.self_attn.o_proj.weight",
             lambda w: w.T.reshape(h, hd, d),
         ),
-        "w_gate": stack(
-            "layers.{}.mlp.gate_proj.weight", lambda w: w.T
-        ),
-        "w_up": stack("layers.{}.mlp.up_proj.weight", lambda w: w.T),
-        "w_down": stack("layers.{}.mlp.down_proj.weight", lambda w: w.T),
     }
+    if cfg.n_experts:
+        E = cfg.n_experts
+
+        def estack(fmt):
+            # (L, E, ...) leaves: experts inner, layers outer.
+            return jnp.asarray(
+                np.stack([
+                    np.stack([
+                        get(fmt.format(l, e)).T for e in range(E)
+                    ])
+                    for l in range(L)
+                ]),
+                dtype,
+            )
+
+        blocks["router"] = stack(
+            "layers.{}.block_sparse_moe.gate.weight", lambda w: w.T
+        )
+        # Mixtral expert naming: w1 = SwiGLU gate, w3 = up, w2 = down.
+        blocks["w_gate"] = estack(
+            "layers.{}.block_sparse_moe.experts.{}.w1.weight"
+        )
+        blocks["w_up"] = estack(
+            "layers.{}.block_sparse_moe.experts.{}.w3.weight"
+        )
+        blocks["w_down"] = estack(
+            "layers.{}.block_sparse_moe.experts.{}.w2.weight"
+        )
+    else:
+        blocks["w_gate"] = stack(
+            "layers.{}.mlp.gate_proj.weight", lambda w: w.T
+        )
+        blocks["w_up"] = stack("layers.{}.mlp.up_proj.weight", lambda w: w.T)
+        blocks["w_down"] = stack(
+            "layers.{}.mlp.down_proj.weight", lambda w: w.T
+        )
     if cfg.qkv_bias:
         blocks["bq"] = stack(
             "layers.{}.self_attn.q_proj.bias", lambda b: b.reshape(h, hd)
@@ -293,9 +342,18 @@ def to_hf_llama_state_dict(params, cfg: TransformerConfig):
         sd[p + "self_attn.o_proj.weight"] = (
             np_(blocks["wo"][l]).reshape(h * hd, d).T
         )
-        sd[p + "mlp.gate_proj.weight"] = np_(blocks["w_gate"][l]).T
-        sd[p + "mlp.up_proj.weight"] = np_(blocks["w_up"][l]).T
-        sd[p + "mlp.down_proj.weight"] = np_(blocks["w_down"][l]).T
+        if cfg.n_experts:
+            moe = p + "block_sparse_moe."
+            sd[moe + "gate.weight"] = np_(blocks["router"][l]).T
+            for e in range(cfg.n_experts):
+                ex = moe + f"experts.{e}."
+                sd[ex + "w1.weight"] = np_(blocks["w_gate"][l, e]).T
+                sd[ex + "w3.weight"] = np_(blocks["w_up"][l, e]).T
+                sd[ex + "w2.weight"] = np_(blocks["w_down"][l, e]).T
+        else:
+            sd[p + "mlp.gate_proj.weight"] = np_(blocks["w_gate"][l]).T
+            sd[p + "mlp.up_proj.weight"] = np_(blocks["w_up"][l]).T
+            sd[p + "mlp.down_proj.weight"] = np_(blocks["w_down"][l]).T
         if cfg.qkv_bias:
             sd[p + "self_attn.q_proj.bias"] = np_(blocks["bq"][l]).reshape(
                 h * hd
