@@ -167,6 +167,24 @@ class ReplicatedEngine:
             req for e in self.engines for req in e._queue
         )
 
+    @property
+    def _active(self):
+        """Router-rid view of every replica's in-flight requests — the
+        server's streaming loop reads ``.values()`` for rid/generated/
+        logprobs. Proxies share the underlying token lists (zero
+        copies); local rids re-key to router rids."""
+        import types
+
+        out = {}
+        for idx, eng in enumerate(self.engines):
+            for slot, req in eng._active.items():
+                rid = self._back[idx].get(req.rid, req.rid)
+                out[(idx, slot)] = types.SimpleNamespace(
+                    rid=rid, generated=req.generated,
+                    logprobs=req.logprobs,
+                )
+        return out
+
     def live_generated(self) -> Dict[int, List[int]]:
         live: Dict[int, List[int]] = {}
         for idx, eng in enumerate(self.engines):

@@ -121,13 +121,16 @@ def test_router_cancel_and_live(tiny_f32):
 def test_router_through_http_server(tiny_f32):
     model, params = tiny_f32
     grp = _group(model, params)
+    import threading
+
     server = __import__(
         "shifu_tpu.infer.server", fromlist=["make_server"]
     ).make_server(grp, port=0, default_max_new=8)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
     base = f"http://127.0.0.1:{server.server_port}"
     try:
         body = json.dumps(
-            {"prompt_tokens": [1, 2, 3], "max_new_tokens": 4}
+            {"tokens": [1, 2, 3], "max_new_tokens": 4}
         ).encode()
         req = urllib.request.Request(
             base + "/v1/completions", body,
@@ -155,7 +158,7 @@ def test_cli_builds_router(tiny_f32):
 
     model, params = tiny_f32
     base = dict(
-        max_slots=2, max_len=32, temperature=0.0, top_p=1.0,
+        max_slots=2, max_len=64, temperature=0.0, top_p=1.0,
         decode_chunk=1, eos_id=-1, paged=True, page_size=8,
         n_pages=None, prefix_cache=False, per_request_sampling=False,
         penalties=False, logit_bias=False, lora_ckpt_dir=None,
