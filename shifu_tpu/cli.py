@@ -386,8 +386,7 @@ def cmd_grpo(args) -> int:
         want = targets[tuple(prompt_ids)]
         return float(want in tok.decode(gen_ids))
 
-    engine = Engine(
-        model, params,
+    engine_kw = dict(
         max_slots=args.max_slots,
         max_len=args.seq_len,
         sample_cfg=SampleConfig(temperature=args.temperature),
@@ -396,6 +395,21 @@ def cmd_grpo(args) -> int:
         ) + (args.seq_len,),
         rng=jax.random.key(args.seed),
     )
+    if args.seq_len % 64 == 0:
+        # Paged + prefix-cached rollouts: a group of G completions
+        # shares ONE prompt prefill (the page-aligned prompt prefix is
+        # registered by the first member and hit by the other G-1), and
+        # successive rounds re-hit it until the params swap flushes.
+        from shifu_tpu.infer.engine import PagedEngine
+
+        engine = PagedEngine(
+            model, params, page_size=64, enable_prefix_cache=True,
+            **engine_kw,
+        )
+    else:
+        # Page-unaligned seq_len (e.g. the 513 of packed-LM configs):
+        # the dense engine has no alignment constraint.
+        engine = Engine(model, params, **engine_kw)
     prompt_cycle = itertools.cycle([ids for ids, _ in rows])
 
     with contextlib.ExitStack() as ctx:
@@ -432,6 +446,10 @@ def cmd_grpo(args) -> int:
                 engine.params = jax.device_put(
                     jax.device_get(state.params), rollout_dev
                 )
+            # Cached prefix K/V was computed under the PREVIOUS round's
+            # params — matching it now would mix policies silently.
+            if hasattr(engine, "flush_prefix_cache"):
+                engine.flush_prefix_cache()
             prompts = [
                 next(prompt_cycle) for _ in range(args.prompts_per_step)
             ]

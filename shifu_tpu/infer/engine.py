@@ -2237,6 +2237,22 @@ class PagedEngine(Engine):
                 self._prefix_lru.pop(key, None)
                 self._prefix_lru[key] = None
 
+    def flush_prefix_cache(self) -> None:
+        """Invalidate every registered prefix page.
+
+        REQUIRED whenever ``engine.params`` is swapped (online RL
+        rollouts, adapter hot-reloads): cached pages hold K/V computed
+        under the OLD weights, and matching them for a new prompt would
+        silently score mixed-parameter rollouts. Pages still pinned by
+        active slots stay alive until those slots release; unreferenced
+        residents return to the pool immediately."""
+        for key, pg in list(self._prefix_pages.items()):
+            self._page_key.pop(pg, None)
+            if self._page_rc.get(pg, 0) == 0:
+                self._free_pages.append(pg)
+        self._prefix_pages.clear()
+        self._prefix_lru.clear()
+
     def _advance_prefills(self) -> None:
         """One chunk per prefilling slot: allocate the chunk's pages
         (preempting youngest-first when the pool is dry, like decode
