@@ -1797,6 +1797,7 @@ class PagedEngine(Engine):
         n_pages: Optional[int] = None,
         enable_prefix_cache: bool = False,
         prefill_chunk: Optional[int] = None,
+        kv_scale_dtype=jnp.float32,
         **kw,
     ):
         """``prefill_chunk``: when set, prompts longer than this many
@@ -1843,6 +1844,10 @@ class PagedEngine(Engine):
                     f"{max_len}"
                 )
         self.prefill_chunk = prefill_chunk
+        # int8 pools only: dtype of the per-(pos, kv-head) scale leaves
+        # (bfloat16 halves the scale pool + kernel scale streams —
+        # quantize_kv docstring; ignored for non-quantized pools).
+        self.kv_scale_dtype = kv_scale_dtype
         if max_len % page_size:
             raise ValueError(
                 f"max_len {max_len} must be a multiple of page_size "
@@ -1970,7 +1975,8 @@ class PagedEngine(Engine):
     def _init_cache(self, cache_dtype):
         return self._make_cache(
             lambda: self.model.init_paged_cache(
-                self.n_pages, self.page_size, dtype=cache_dtype
+                self.n_pages, self.page_size, dtype=cache_dtype,
+                scale_dtype=self.kv_scale_dtype,
             )
         )
 

@@ -419,9 +419,14 @@ def paged_decode_attention(
         # is tiny, so the DMA issue count is the cost that matters. The
         # gather itself is ~3% of the pool's bytes (f32 per (pos, kv)).
         def gather_scales(s_pool):
+            # Keep the pool's scale dtype through the gather AND the
+            # streamed blocks: bf16 scale pools (round 5) halve both
+            # the per-layer gather bytes and the two per-grid-step
+            # scale DMAs — the measured cost of the int8-KV format.
+            # The kernel's multiplies promote to f32 on use.
             s5 = s_pool.reshape(n_layers_, n_pages, ps, n_kv)
             g = s5[li_arr[0], table]  # (b, pages_per_row, ps, n_kv)
-            flat = g.astype(jnp.float32).reshape(b, -1)
+            flat = g.reshape(b, -1)
             pad = n_steps * unroll * ps * n_kv - flat.shape[1]
             if pad:
                 flat = jnp.pad(flat, ((0, 0), (0, pad)))

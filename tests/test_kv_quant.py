@@ -324,3 +324,70 @@ def test_paged_engine_int8_qk_top1_tracks_bf16():
         model, params, prompts, 4, cache_dtype=jnp.int8, **kw
     )
     np.testing.assert_array_equal(bf[0], q8[0])
+
+
+# ---------------------------------------------------- bf16 scale pools
+
+
+def test_quantize_kv_bf16_scale_roundtrip_bound():
+    """bf16 scales (round 5 — halves the scale pool + kernel streams):
+    quantization divides by the ROUNDED scale, so the only extra error
+    vs f32 scales is the max-lane clip; per-lane bound ~0.6% of amax
+    (vs 0.4%)."""
+    rng = np.random.default_rng(5)
+    x = jnp.asarray(rng.standard_normal((5, 7, 3, 64)) * 3.0, jnp.float32)
+    q, s = quantize_kv(x, scale_dtype=jnp.bfloat16)
+    assert q.dtype == jnp.int8 and s.dtype == jnp.bfloat16
+    back = dequantize_kv(q, s)
+    amax = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
+    bound = amax * 0.0065 + 1e-6
+    assert bool(jnp.all(jnp.abs(back - x) <= bound))
+    # Zero vectors stay exact.
+    z = jnp.zeros((2, 8), jnp.float32)
+    qz, sz = quantize_kv(z, scale_dtype=jnp.bfloat16)
+    assert bool(jnp.all(dequantize_kv(qz, sz) == 0.0))
+
+
+def test_paged_cache_bf16_scale_leaves():
+    model = Transformer(TransformerConfig.tiny())
+    pool = model.init_paged_cache(
+        4, 8, dtype=jnp.int8, scale_dtype=jnp.bfloat16
+    )
+    assert pool["k_scale"].dtype == jnp.bfloat16
+    assert pool["v_scale"].dtype == jnp.bfloat16
+    with pytest.raises(ValueError, match="scale_dtype"):
+        model.init_paged_cache(4, 8, dtype=jnp.int8, scale_dtype=jnp.int8)
+
+
+def test_paged_engine_bf16_scales_flash_matches_xla():
+    """Kernel vs XLA gather on the SAME bf16-scale int8 pool: greedy
+    tokens match exactly (both consume the identical representation)."""
+    cfg_x = TransformerConfig.tiny()
+    cfg_f = TransformerConfig.tiny(attn_impl="flash")
+    model_x, model_f = Transformer(cfg_x), Transformer(cfg_f)
+    params = model_x.init(jax.random.key(0))
+    rng = np.random.RandomState(21)
+    prompts = [rng.randint(1, 256, size=n).tolist() for n in (5, 11, 3)]
+    kw = dict(
+        max_slots=2, max_len=32, page_size=8, prefill_buckets=(16, 32),
+        cache_dtype=jnp.int8, kv_scale_dtype=jnp.bfloat16,
+    )
+    ref = _engine_tokens(model_x, params, prompts, 6, **kw)
+    got = _engine_tokens(model_f, params, prompts, 6, **kw)
+    for i, (a, b) in enumerate(zip(ref, got)):
+        np.testing.assert_array_equal(a, b, err_msg=f"request {i}")
+
+
+def test_paged_engine_bf16_scales_top1_tracks_bf16():
+    cfg = TransformerConfig.tiny()
+    model = Transformer(cfg)
+    params = model.init(jax.random.key(3))
+    rng = np.random.RandomState(12)
+    prompts = [rng.randint(1, 256, size=9).tolist()]
+    kw = dict(max_slots=1, max_len=32, page_size=8, prefill_buckets=(16, 32))
+    bf = _engine_tokens(model, params, prompts, 4, **kw)
+    q8 = _engine_tokens(
+        model, params, prompts, 4, cache_dtype=jnp.int8,
+        kv_scale_dtype=jnp.bfloat16, **kw
+    )
+    np.testing.assert_array_equal(bf[0], q8[0])
