@@ -374,6 +374,169 @@ def to_hf_llama_state_dict(params, cfg: TransformerConfig):
     return sd
 
 
+# ---------------------------------------------------- Mamba (SSM) family
+
+
+def config_from_hf_mamba(hf_config, **overrides):
+    """MambaConfig mirroring a transformers MambaConfig (round 5 — the
+    SSM family stops being synthetic-weights-only). ``time_step_rank``
+    "auto" resolves to ceil(hidden/16), matching both sides' default.
+    Projection biases (``use_bias``) and conv-without-bias
+    (``use_conv_bias=False``) have no native layout here — refused
+    loudly rather than silently dropped."""
+    from shifu_tpu.models.mamba import MambaConfig
+
+    if getattr(hf_config, "use_bias", False):
+        raise NotImplementedError(
+            "use_bias=True (in/out projection biases) has no native "
+            "Mamba layout here"
+        )
+    if not getattr(hf_config, "use_conv_bias", True):
+        raise NotImplementedError(
+            "use_conv_bias=False checkpoints are unsupported (the "
+            "native layout always carries conv_b; a zero bias would "
+            "load, but refusing is safer than guessing)"
+        )
+    tsr = getattr(hf_config, "time_step_rank", "auto")
+    kw = dict(
+        vocab_size=hf_config.vocab_size,
+        dim=hf_config.hidden_size,
+        n_layers=hf_config.num_hidden_layers,
+        d_state=hf_config.state_size,
+        d_conv=hf_config.conv_kernel,
+        expand=hf_config.expand,
+        dt_rank=None if tsr == "auto" else int(tsr),
+        norm_eps=hf_config.layer_norm_epsilon,
+    )
+    kw.update(overrides)
+    return MambaConfig(**kw)
+
+
+def params_from_hf_mamba(state_dict, cfg, dtype=jnp.float32):
+    """shifu_tpu Mamba param tree from a HF Mamba state_dict.
+
+    Numerics line up exactly (tests/test_convert.py parity vs the
+    torch slow path): both sides split in_proj [x | gate], compute
+    dt = softplus(x_proj_dt @ dt_proj + bias), discretise
+    dA = exp(dt·(-exp(A_log))), dB = dt·B, and gate y·silu(z). HF's
+    fused ``x_proj`` (dt_rank + 2·state rows) splits into the native
+    dt_down / x_B / x_C leaves; conv1d (di, 1, k) transposes to the
+    (k, di) depthwise layout; RMSNorm gains convert full-g -> g-1."""
+    import numpy as np  # noqa: F811 (local alias for stacking)
+
+    sd = dict(state_dict)
+    L = cfg.n_layers
+    r, n = cfg.resolved_dt_rank, cfg.d_state
+    consumed = set()
+
+    def get(name):
+        for prefix in ("backbone.", ""):
+            key = prefix + name
+            if key in sd:
+                consumed.add(key)
+                return _to_np(sd[key])
+        raise KeyError(f"missing weight {name!r} in state_dict")
+
+    def stack(fmt, transform):
+        return jnp.asarray(
+            np.stack([transform(get(fmt.format(l))) for l in range(L)]),
+            dtype,
+        )
+
+    mixer = "layers.{}.mixer."
+    blocks = {
+        "norm": stack("layers.{}.norm.weight", lambda w: w - 1.0),
+        "in_proj": stack(mixer + "in_proj.weight", lambda w: w.T),
+        "conv_w": stack(
+            mixer + "conv1d.weight", lambda w: w[:, 0, :].T
+        ),  # (di, 1, k) -> (k, di)
+        "conv_b": stack(mixer + "conv1d.bias", lambda b: b),
+        # x_proj rows: [dt_rank | state (B) | state (C)].
+        "dt_down": stack(
+            mixer + "x_proj.weight", lambda w: w[:r].T
+        ),
+        "x_B": stack(
+            mixer + "x_proj.weight", lambda w: w[r : r + n].T
+        ),
+        "x_C": stack(
+            mixer + "x_proj.weight", lambda w: w[r + n :].T
+        ),
+        "dt_up": stack(mixer + "dt_proj.weight", lambda w: w.T),
+        "dt_bias": stack(mixer + "dt_proj.bias", lambda b: b),
+        "A_log": stack(mixer + "A_log", lambda a: a),
+        "D": stack(mixer + "D", lambda d_: d_),
+        "out_proj": stack(mixer + "out_proj.weight", lambda w: w.T),
+    }
+    params = {
+        "embed": jnp.asarray(get("embeddings.weight"), dtype),
+        "blocks": blocks,
+        "final_norm": jnp.asarray(get("norm_f.weight") - 1.0, dtype),
+    }
+    if "lm_head.weight" in sd:
+        consumed.add("lm_head.weight")
+        params["unembed"] = jnp.asarray(
+            _to_np(sd["lm_head.weight"]).T, dtype
+        )
+    else:  # tied (the state-spaces convention)
+        params["unembed"] = jnp.asarray(
+            params["embed"].T, dtype
+        )
+    leftover = sorted(k for k in sd if k not in consumed)
+    if leftover:
+        raise ValueError(
+            f"{len(leftover)} state_dict tensors were not consumed by "
+            f"the Mamba layout (first few: {leftover[:4]})"
+        )
+    return params
+
+
+def to_hf_mamba_state_dict(params, cfg):
+    """shifu_tpu Mamba params -> HF Mamba-layout state_dict (exact
+    inverse of :func:`params_from_hf_mamba`, round-trip tested)."""
+    import numpy as np  # noqa: F811
+
+    L, r, n = cfg.n_layers, cfg.resolved_dt_rank, cfg.d_state
+    blocks = params["blocks"]
+
+    def np_(x):
+        return np.asarray(x, np.float32)
+
+    sd = {"backbone.embeddings.weight": np_(params["embed"])}
+    for l in range(L):
+        p = f"backbone.layers.{l}."
+        m = p + "mixer."
+        sd[p + "norm.weight"] = np_(blocks["norm"][l]) + 1.0
+        sd[m + "in_proj.weight"] = np_(blocks["in_proj"][l]).T
+        sd[m + "conv1d.weight"] = np_(blocks["conv_w"][l]).T[:, None, :]
+        sd[m + "conv1d.bias"] = np_(blocks["conv_b"][l])
+        sd[m + "x_proj.weight"] = np.concatenate(
+            [
+                np_(blocks["dt_down"][l]).T,
+                np_(blocks["x_B"][l]).T,
+                np_(blocks["x_C"][l]).T,
+            ],
+            axis=0,
+        )
+        sd[m + "dt_proj.weight"] = np_(blocks["dt_up"][l]).T
+        sd[m + "dt_proj.bias"] = np_(blocks["dt_bias"][l])
+        sd[m + "A_log"] = np_(blocks["A_log"][l])
+        sd[m + "D"] = np_(blocks["D"][l])
+        sd[m + "out_proj.weight"] = np_(blocks["out_proj"][l]).T
+    sd["backbone.norm_f.weight"] = np_(params["final_norm"]) + 1.0
+    sd["lm_head.weight"] = np_(params["unembed"]).T
+    return sd
+
+
+def from_hf_mamba(hf_model, dtype=jnp.float32, **config_overrides):
+    """(Mamba, params) from a transformers MambaForCausalLM (or any
+    module exposing ``.config`` / ``.state_dict()`` in that layout)."""
+    from shifu_tpu.models.mamba import Mamba
+
+    cfg = config_from_hf_mamba(hf_model.config, **config_overrides)
+    params = params_from_hf_mamba(hf_model.state_dict(), cfg, dtype)
+    return Mamba(cfg), params
+
+
 def from_hf_llama(
     hf_model, dtype=jnp.float32, **config_overrides
 ) -> Tuple[Transformer, Any]:
