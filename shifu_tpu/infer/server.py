@@ -31,6 +31,14 @@ engine built with ``per_request_sampling=True``, requests may carry
 values in the SAME compiled program, so mixed greedy/sampled traffic
 never recompiles.
 
+Speculative engines serve the full constrained surface (logit_bias /
+allowed_token_ids / regex / json_schema — the verify distribution is
+masked position-wise) and multi-LoRA adapters, but NOT the
+presence/frequency/repetition penalty fields: per-position counts
+depend on the same round's accepted prefix, so penalised requests need
+a non-speculative engine (the penalty-enabled constructor refuses on
+speculative engines and the CLI refuses --spec with --penalties).
+
 Stop sequences truncate in the ENGINE host loop (finished_by="stop");
 string stops additionally trim the trailing text in the response here.
 Client disconnects CANCEL the in-flight request: the streaming

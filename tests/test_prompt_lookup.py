@@ -319,3 +319,48 @@ def test_server_on_cli_built_lookup_engine(tiny):
         server.shutdown()
         server.runner.shutdown()
         t.join(5)
+
+
+def test_server_constrained_on_lookup_engine(tiny):
+    """Round 5 end to end through HTTP: a regex-constrained request
+    served by the SPECULATIVE lookup engine — the response fullmatches
+    the pattern (FSM-masked verify, device-resident tables)."""
+    import json
+    import re as pyre
+    import threading
+    import urllib.request
+
+    from shifu_tpu.cli import build_serve_engine
+    from shifu_tpu.data.tokenizer import ByteTokenizer
+    from shifu_tpu.infer.server import make_server
+
+    model, params = tiny
+    tok = ByteTokenizer()
+    engine = build_serve_engine(
+        _serve_args(
+            spec="prompt-lookup", logit_bias=True,
+            per_request_sampling=True, eos_id=tok.eos_id,
+        ),
+        model, params, tok,
+    )
+    server = make_server(engine, host="127.0.0.1", port=0, tokenizer=tok)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    base = f"http://127.0.0.1:{server.server_port}"
+    try:
+        pat = r"[a-z]{2,8}=[0-9]{1,3}"
+        body = json.dumps({
+            "prompt": "cfg: ", "max_new_tokens": 20, "regex": pat,
+        }).encode()
+        req = urllib.request.Request(
+            base + "/v1/completions", body,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=120) as r:
+            out = json.loads(r.read())
+        if out["finished_by"] == "eos":
+            assert pyre.fullmatch(pat, out["text"]), out["text"]
+    finally:
+        server.shutdown()
+        server.runner.shutdown()
+        t.join(5)
