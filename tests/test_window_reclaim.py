@@ -174,3 +174,29 @@ def test_no_window_no_reclamation():
     for _ in eng.run():
         pass
     assert eng.window_pages_reclaimed == 0
+
+
+def test_windowed_lookup_spec_parity(windowed):
+    """Sliding windows compose with SPECULATIVE serving: the lookup
+    engine's multi-query verify masks to the window and reclamation
+    frees behind it — greedy output == the per-token dense engine."""
+    from shifu_tpu.infer.spec_engine import PromptLookupPagedEngine
+
+    model, params = windowed
+    prompt = _prompt(10, 7)
+    ref_eng = Engine(
+        model, params, max_slots=1, max_len=64,
+        prefill_buckets=(16, 64), **_KW,
+    )
+    rid = ref_eng.submit(prompt, max_new_tokens=30)
+    want = {c.rid: c for c in ref_eng.run()}[rid].tokens
+
+    eng = PromptLookupPagedEngine(
+        model, params, k=4, ngram=2, rounds_per_step=2,
+        max_slots=1, max_len=64, page_size=4,
+        prefill_buckets=(16, 64), **_KW,
+    )
+    rid = eng.submit(prompt, max_new_tokens=30)
+    got = {c.rid: c for c in eng.run()}[rid].tokens
+    assert got == want
+    assert eng.window_pages_reclaimed > 0
