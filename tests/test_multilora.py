@@ -294,7 +294,8 @@ def test_server_adapter_field(tiny):
 
 def test_cli_lora_flags(tiny, tmp_path):
     """build_serve_engine loads --lora-ckpt-dir checkpoints (ids in
-    flag order) and refuses the flag with --spec."""
+    flag order); adapters compose with --spec prompt-lookup (round 5)
+    and refuse with --spec draft."""
     import argparse
 
     from shifu_tpu.checkpoint import Checkpointer
@@ -340,9 +341,24 @@ def test_cli_lora_flags(tiny, tmp_path):
     got = {c.rid: c for c in eng.run()}[rid].tokens
     assert got == want
 
-    with pytest.raises(ValueError, match="compose"):
+    # Round 5: adapters COMPOSE with prompt-lookup speculation (the
+    # verify forward threads the adapter args) — same merged-weights
+    # answer through the speculative engine.
+    spec_eng = build_serve_engine(
+        argparse.Namespace(**{**base, "spec": "prompt-lookup"}),
+        model, params, ByteTokenizer(),
+    )
+    assert spec_eng._n_adapters == 1
+    rid = spec_eng.submit(prompt, max_new_tokens=6, adapter=1)
+    got = {c.rid: c for c in spec_eng.run()}[rid].tokens
+    assert got == want
+    # --spec draft still refuses (the draft would propose from
+    # mismatched weights).
+    with pytest.raises(ValueError, match="draft"):
         build_serve_engine(
-            argparse.Namespace(**{**base, "spec": "prompt-lookup"}),
+            argparse.Namespace(**{
+                **base, "spec": "draft", "draft_preset": "tiny",
+            }),
             model, params, ByteTokenizer(),
         )
 
