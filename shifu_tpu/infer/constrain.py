@@ -668,13 +668,17 @@ def schema_to_regex(schema: dict) -> str:
     typed fields", which is what structured-output traffic almost
     always wants.
 
-    Supported: {"type": "object", "properties": {...}} (all properties
-    required, emitted in property order — deterministic output is the
-    point of constraining), {"type": "string"} with the FULL JSON
-    string grammar (escapes ``\\" \\\\ \\/ \\b \\f \\n \\r \\t``,
+    Supported: {"type": "object", "properties": {...}} — properties
+    emit in declaration order (deterministic output is the point of
+    constraining); with a "required" list, properties NOT in it are
+    OPTIONAL (any in-order subset containing the required ones is
+    valid, commas handled; without "required" every property is
+    required, the safe default) — {"type": "string"} with the FULL
+    JSON string grammar (escapes ``\\" \\\\ \\/ \\b \\f \\n \\r \\t``,
     ``\\uXXXX``, and well-formed multi-byte UTF-8 — see ``_STR_CHAR``;
     everything the FSM admits parses with ``json.loads``), "integer",
-    "number", "boolean", "null", {"enum": [...]} of scalars,
+    "number", "boolean", "null", UNION types ({"type": ["string",
+    "null"]} — the nullable idiom), {"enum": [...]} of scalars,
     {"type": "array", "items": ...} (any length, incl. empty; "items"
     is REQUIRED), and nested objects.
     ``minLength``/``maxLength`` on strings bound the CHARACTER count
@@ -703,6 +707,16 @@ def schema_to_regex(schema: dict) -> str:
                     raise ValueError(f"enum value {v!r} not a scalar")
             return "(" + "|".join(opts) + ")"
         t = s.get("type")
+        if isinstance(t, (list, tuple)):
+            # Union types ({"type": ["string", "null"]}): alternation
+            # of each member emitted alone.
+            if not t:
+                raise ValueError("empty type union")
+            return (
+                "("
+                + "|".join(emit({**s, "type": m}) for m in t)
+                + ")"
+            )
         if t == "string":
             lo = s.get("minLength")
             hi = s.get("maxLength")
@@ -735,16 +749,57 @@ def schema_to_regex(schema: dict) -> str:
             props = s.get("properties")
             if not props:
                 raise ValueError(
-                    "object schema needs non-empty 'properties' (all "
-                    "are required; free-form objects are not regular)"
+                    "object schema needs non-empty 'properties' "
+                    "(free-form objects are not regular)"
                 )
-            parts = []
-            for name, sub in props.items():
-                parts.append(
-                    '"' + _regex_escape(str(name)) + '":' + _WS
-                    + emit(sub)
-                )
-            inner = ("," + _WS).join(parts)
+            req = s.get("required")
+            if req is None:
+                required = set(props)  # the safe default: everything
+            else:
+                required = set(map(str, req))
+                unknown = required - set(props)
+                if unknown:
+                    raise ValueError(
+                        f"'required' names unknown properties "
+                        f"{sorted(unknown)}"
+                    )
+            fields = [
+                ('"' + _regex_escape(str(name)) + '":' + _WS
+                 + emit(sub), str(name) in required)
+                for name, sub in props.items()
+            ]
+
+            # In-order subsets containing every required field, commas
+            # between PRINTED fields only. rec(i): valid (possibly
+            # empty) tail starting at field i, no leading comma;
+            # alternatives start with field j for j up to the first
+            # required index (a required field can never be skipped).
+            # O(n^2) pattern size; the DFA stays small because
+            # alternatives share suffixes after subset construction.
+            n = len(fields)
+
+            def first_required(i):
+                for j in range(i, n):
+                    if fields[j][1]:
+                        return j
+                return n
+
+            def rec(i, lead_comma):
+                if i >= n:
+                    return ""
+                stop = first_required(i)
+                alts = []
+                for j in range(i, min(stop, n - 1) + 1):
+                    pat, _ = fields[j]
+                    head = ("," + _WS if lead_comma else "") + pat
+                    alts.append(head + rec(j + 1, True))
+                if stop == n:  # nothing mandatory left: may stop here
+                    alts.append("")
+                if len(alts) == 1 and alts[0]:
+                    return alts[0]
+                return "(" + "|".join(alts) + ")"
+
+            inner = rec(0, False)
             return r"\{" + _WS + inner + _WS + r"\}"
         raise ValueError(
             f"unsupported schema node {s!r} (see schema_to_regex "

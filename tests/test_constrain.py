@@ -619,3 +619,69 @@ def test_constrained_engine_emits_escaped_string(tiny):
     if res.finished_by == "eos":
         parsed = json.loads(text)
         assert set(parsed) == {"s"}
+
+
+def test_schema_optional_properties_and_unions():
+    """Round 5: "required" marks a subset — properties outside it are
+    OPTIONAL (any in-order subset containing the required ones, commas
+    correct); union types express the nullable idiom. Everything
+    admitted still parses."""
+    import itertools
+
+    from shifu_tpu.infer import schema_to_regex
+
+    sch = {
+        "type": "object",
+        "properties": {
+            "a": {"type": "integer"},
+            "b": {"type": "boolean"},
+            "c": {"type": "string", "maxLength": 3},
+        },
+        "required": ["b"],
+    }
+    dfa = compile_regex(schema_to_regex(sch))
+    vals = {"a": "7", "b": "true", "c": '"x"'}
+    for r in range(0, 4):
+        for subset in itertools.combinations(("a", "b", "c"), r):
+            s = "{" + ",".join(
+                f'"{k}":{vals[k]}' for k in subset
+            ) + "}"
+            want = "b" in subset
+            assert dfa.matches(s.encode()) == want, s
+            if want:
+                json.loads(s)
+    assert not dfa.matches(b'{"b":true,"a":7}')  # order is fixed
+
+    # No "required" key -> everything required (the safe default).
+    strict = compile_regex(schema_to_regex({
+        "type": "object",
+        "properties": {"a": {"type": "integer"},
+                       "b": {"type": "boolean"}},
+    }))
+    assert strict.matches(b'{"a":1,"b":false}')
+    assert not strict.matches(b'{"a":1}')
+
+    # required: [] -> the empty object is valid.
+    empty_ok = compile_regex(schema_to_regex({
+        "type": "object", "properties": {"a": {"type": "integer"}},
+        "required": [],
+    }))
+    assert empty_ok.matches(b"{}") and empty_ok.matches(b'{"a":3}')
+
+    # Nullable union.
+    nul = compile_regex(schema_to_regex({
+        "type": "object",
+        "properties": {"x": {"type": ["string", "null"],
+                             "maxLength": 2}},
+    }))
+    for s in ('{"x":null}', '{"x":"ab"}'):
+        assert nul.matches(s.encode())
+        json.loads(s)
+    assert not nul.matches(b'{"x":"abc"}')
+
+    with pytest.raises(ValueError, match="unknown"):
+        schema_to_regex({
+            "type": "object",
+            "properties": {"a": {"type": "null"}},
+            "required": ["z"],
+        })
