@@ -47,6 +47,7 @@ def main():
         for name, fn in (
             ("long_context", bench_train_long),
             ("long_context_windowed", bench_train_long_windowed),
+            ("long_context_windowed_w2k", bench_train_long_windowed_w2k),
             ("moe", bench_train_moe),
         ):
             try:
@@ -171,6 +172,11 @@ def _compact(out: dict) -> dict:
         # secondary train legs
         ("lc_mfu", g("train_legs", "long_context", "mfu")),
         ("lcw_mfu", g("train_legs", "long_context_windowed", "mfu")),
+        ("lcw_ms", g("train_legs", "long_context_windowed", "step_ms")),
+        ("lcw2_mfu",
+         g("train_legs", "long_context_windowed_w2k", "mfu")),
+        ("lcw2_ms",
+         g("train_legs", "long_context_windowed_w2k", "step_ms")),
         ("moe_mfu", g("train_legs", "moe", "mfu")),
         ("fit_unstable", any(
             g(*sv, leg, "fit_unstable") for leg in
@@ -310,6 +316,23 @@ def bench_train_long_windowed(dev):
 
     cfg = TransformerConfig.base_1b(
         attn_impl="flash", remat_policy="full", window_size=1024
+    )
+    return _train_leg(cfg, dev, batch=2, seq=8192)
+
+
+def bench_train_long_windowed_w2k(dev):
+    """w=2048 companion point for the windowed-MFU question (round-4
+    verdict weak #4: is the w=1024 leg's MFU gap real kernel block-skip
+    overhead or an accounting artifact?). Doubling the window doubles
+    the attention FLOPs while every fixed cost stays put: if step time
+    rises by LESS than the attention-FLOPs delta implies, the w=1024
+    gap is fixed overhead (grid/skip costs at small windows); if it
+    rises proportionally, the window accounting is simply honest about
+    a real cost."""
+    from shifu_tpu.models.transformer import TransformerConfig
+
+    cfg = TransformerConfig.base_1b(
+        attn_impl="flash", remat_policy="full", window_size=2048
     )
     return _train_leg(cfg, dev, batch=2, seq=8192)
 
