@@ -724,18 +724,11 @@ def build_serve_engine(args, model, params, tok):
 
     draft = draft_params = None
     if args.spec != "off":
-        # Round 5: logit_bias/constraints and multi-LoRA COMPOSE with
-        # the speculative engines (masked verify distribution; adapter
-        # args threaded through the verify forward). Penalties remain
-        # the one guarded feature (per-position counts depend on the
-        # same round's accepted prefix).
-        if args.penalties:
-            raise ValueError(
-                "--spec does not compose with --penalties (the "
-                "verifier cannot honour per-position counts); serve "
-                "penalised traffic with a plain engine"
-            )
-        kw.pop("enable_penalties")
+        # Round 5: the whole serving feature set COMPOSES with the
+        # speculative engines — logit_bias/constraints (masked verify
+        # distribution), multi-LoRA (adapter args through the verify
+        # forward), and penalties (position-wise prospective counts
+        # along the proposal prefix).
         kw.pop("decode_chunk")  # spec rounds replace the chunk scan
         if args.spec == "draft":
             if lora_dirs:

@@ -46,9 +46,18 @@ Shared engine mechanics:
     and the draft's propose distribution too — so constrained
     speculative output obeys the constraint exactly and greedy
     constrained speculative == greedy constrained plain. Multi-LoRA
-    adapters thread through the verify forward. Penalties remain
-    unsupported (per-position counts depend on the same round's
-    accepted prefix); serve penalised requests with PagedEngine.
+    adapters thread through the verify forward;
+  * penalties compose the same position-wise way (new r5): verify
+    position i's distribution is only consumed when proposals 0..i-1
+    were all accepted — and accepted proposals are EMITTED tokens — so
+    position i is penalised with PROSPECTIVE counts
+    ``counts + sum_{j<i} onehot(proposal_j)``, exactly the counts the
+    plain engine would hold there; the draft's propose distribution is
+    penalised with the same running counts (that buys acceptance —
+    correctness never needs q penalised); and the per-slot count
+    buffer rides the round scan, folds in each round's accepted
+    emissions, and returns updated — device-resident, like the plain
+    chunked path.
 
 Acceptance statistics (``spec_proposed`` / ``spec_accepted``) feed the
 server's /healthz.
@@ -70,7 +79,11 @@ import jax
 import jax.numpy as jnp
 
 from shifu_tpu.infer.engine import PagedEngine, _token_logprob
-from shifu_tpu.infer.sampling import SampleConfig, probs_per_row
+from shifu_tpu.infer.sampling import (
+    SampleConfig,
+    apply_penalties,
+    probs_per_row,
+)
 from shifu_tpu.infer.speculative import _probs
 from shifu_tpu.ops.attention import NEG_INF
 
@@ -143,15 +156,6 @@ class _SpeculativeBase(PagedEngine):
             )
         if k < 1 or rounds_per_step < 1:
             raise ValueError("k and rounds_per_step must be >= 1")
-        if kw.get("enable_penalties") or kw.get(
-            "sample_cfg", SampleConfig(temperature=0.0)
-        ).has_penalties:
-            raise NotImplementedError(
-                "repetition/presence/frequency penalties inside the "
-                "speculative verifier need per-position counts that "
-                "depend on the SAME round's accepted prefix; serve "
-                "penalised requests with PagedEngine"
-            )
         self.k = int(k)
         self.rounds_per_step = int(rounds_per_step)
         self.spec_proposed = 0
@@ -228,13 +232,46 @@ class _SpeculativeBase(PagedEngine):
         s_new = jnp.where(n_acc == m + 1, s_bonus, s_keep)
         return jnp.where(live, s_new, st)
 
-    def _mask_verify_logits(self, lg, bias, fsm, st, d_toks_bt):
-        """Compose the static per-slot bias and (when constrained) the
-        position-wise FSM masks into the verify logits, BEFORE the
-        sampling-distribution transform — matching the non-speculative
-        sampler's ordering (bias lands on raw logits; a hard ban
-        survives every downstream filter). Returns
+    def _pen_verify_logits(self, lg, pen, counts, d_toks_bt):
+        """Position-wise penalties on the (b, k+1, V) verify logits.
+
+        Position i's distribution is only ever consumed when proposals
+        0..i-1 were all accepted — and accepted proposals are EMITTED
+        tokens — so its counts are exactly the carried buffer plus a
+        one-hot per preceding proposal (position 0 sees the carry
+        unchanged: ``cur`` was counted when it was emitted last
+        round). A (k+1)-step scan keeps the working set at (b, V)
+        instead of materialising (b, k+1, V) count planes."""
+        _, pres, freq, rep = pen
+        b = lg.shape[0]
+        rows = jnp.arange(b)
+
+        def body(c, xs):
+            lgi, tok = xs
+            out = apply_penalties(lgi, c, pres, freq, rep)
+            return c.at[rows, tok].add(1), out
+
+        # Position k proposes nothing after it; the padded token's
+        # count update feeds a discarded final carry.
+        toks_pad = jnp.concatenate(
+            [d_toks_bt, jnp.zeros((b, 1), jnp.int32)], axis=1
+        )
+        _, outs = jax.lax.scan(
+            body, counts, (jnp.moveaxis(lg, 1, 0), toks_pad.T)
+        )
+        return jnp.moveaxis(outs, 0, 1)
+
+    def _mask_verify_logits(self, lg, bias, fsm, st, d_toks_bt,
+                            pen=(), counts=None):
+        """Compose position-wise penalties, the static per-slot bias,
+        and (when constrained) the position-wise FSM masks into the
+        verify logits, BEFORE the sampling-distribution transform —
+        matching the non-speculative sampler's ordering (penalties
+        transform the raw logits first, bias lands after so a hard ban
+        is the final word, the FSM mask composes onto it). Returns
         (lg', mask3 | None, s_all | None)."""
+        if pen:
+            lg = self._pen_verify_logits(lg, pen, counts, d_toks_bt)
         if bias:
             lg = jnp.maximum(lg + bias[0][:, None, :], NEG_INF)
         if not fsm:
@@ -245,6 +282,21 @@ class _SpeculativeBase(PagedEngine):
             lg + jnp.where(mask3, 0.0, NEG_INF), NEG_INF
         )
         return lg, mask3, s_all
+
+    def _fold_counts(self, counts, out, n_acc, live):
+        """Fold one round's EMITTED tokens (the accepted prefix +
+        bonus, post eos/budget clipping) into the per-slot penalty
+        count buffer — the next round (and the next dispatch) penalise
+        against them. ``.add`` accumulates duplicates within a chunk
+        correctly; positions past ``n_acc`` and frozen rows get weight
+        zero."""
+        w = (
+            (jnp.arange(out.shape[1])[None, :] < n_acc[:, None])
+            & live[:, None]
+        )
+        return counts.at[
+            jnp.arange(out.shape[0])[:, None], out
+        ].add(w.astype(jnp.int32))
 
     def _probs2(self, samp, logits2d):
         """(rows, V) -> each row's configured sampling distribution
@@ -453,14 +505,16 @@ class SpeculativePagedEngine(_SpeculativeBase):
             remaining[slot] = req.max_new_tokens - len(req.generated)
         (
             outs, lps, n_accs, ms, lives,
-            cur2, lengths2, self.cache, self.d_cache,
+            cur2, lengths2, self.cache, self.d_cache, *cts,
         ) = self._spec_jit(
             self.params, self.cache, self.d_cache, self.draft_params,
-            cur, lengths, active,
-            jnp.asarray(remaining), jnp.asarray(self._table),
-            *self._sampling_args(), *self._bias_args(),
-            *self._fsm_args(), *self._lora_args(), sub,
+            cur, lengths, active, jnp.asarray(remaining),
+            # _decode_extra_args leads with the page table (the paged
+            # engine prepends it), binding the named ``table`` param.
+            *self._decode_extra_args(), sub,
         )
+        if cts:
+            self._counts_dev = cts[0]
         self._fold_rounds(outs, lps, n_accs, ms, lives, cur2, lengths2)
 
     def _spec_impl(
@@ -487,24 +541,32 @@ class SpeculativePagedEngine(_SpeculativeBase):
         adapters apply to the TARGET verify forward only — the draft
         proposes from its base weights (a draft adapter would need its
         own registration; acceptance, not correctness, is all it could
-        change).
+        change). Penalised rows: the draft penalises each propose step
+        with the running prospective counts, the verify logits are
+        penalised position-wise (_pen_verify_logits), and the count
+        buffer folds in each round's accepted emissions before the
+        next round reads it.
         """
-        _, samp, _pen, bias, fsm, lora, rng = self._split_extra(rest)
+        _, samp, pen, bias, fsm, lora, rng = self._split_extra(rest)
         k, rounds = self.k, self.rounds_per_step
         st0 = fsm[1] if fsm else None
+        cts0 = pen[0] if pen else None
+        rows = jnp.arange(self.max_slots)
 
         def round_body(carry, rsub):
-            cache, d_cache, cur, n, rem, done, st = carry
+            cache, d_cache, cur, n, rem, done, st, counts = carry
             live = active & ~done & (rem > 0)
             r_d, r_a, r_b = jax.random.split(rsub, 3)
 
             # ---- draft: K cheap autoregressive steps ----------------
             def dbody(c, sub):
-                d_cache, tok, idx, s = c
+                d_cache, tok, idx, s, dcts = c
                 lg, d_cache = self.draft(
                     d_params, tok[:, None], cache=d_cache, cache_index=idx
                 )
                 lg1 = lg[:, -1]
+                if pen:
+                    lg1 = apply_penalties(lg1, dcts, *pen[1:])
                 if bias:
                     lg1 = jnp.maximum(lg1 + bias[0], NEG_INF)
                 if fsm:
@@ -518,10 +580,13 @@ class SpeculativePagedEngine(_SpeculativeBase):
                 ).astype(jnp.int32)
                 if fsm:
                     s = self._fsm_step(nr, s, nxt)
-                return (d_cache, nxt, idx + 1, s), (nxt, p)
+                if pen:
+                    dcts = dcts.at[rows, nxt].add(1)
+                return (d_cache, nxt, idx + 1, s, dcts), (nxt, p)
 
-            (d_cache, _, _, _), (d_toks, d_probs) = jax.lax.scan(
-                dbody, (d_cache, cur, n, st), jax.random.split(r_d, k)
+            (d_cache, _, _, _, _), (d_toks, d_probs) = jax.lax.scan(
+                dbody, (d_cache, cur, n, st, counts),
+                jax.random.split(r_d, k),
             )
 
             # ---- target: verify the whole chunk in one forward ------
@@ -533,8 +598,9 @@ class SpeculativePagedEngine(_SpeculativeBase):
                 **({"lora": lora} if lora is not None else {}),
             )
             b, width, V = lg.shape
+            lg_raw = lg.astype(jnp.float32)
             lg, mask3, s_all = self._mask_verify_logits(
-                lg, bias, fsm, st, d_toks_bt0
+                lg, bias, fsm, st, d_toks_bt0, pen=pen, counts=counts
             )
             probs = self._probs2(samp, lg.reshape(b * width, V)).reshape(
                 b, width, V
@@ -580,9 +646,12 @@ class SpeculativePagedEngine(_SpeculativeBase):
                 out,
             )
             # Raw-model logprob of each emitted token (the engine's
-            # logprobs surface), from the verify logits we already have.
+            # logprobs surface) from the UNTRANSFORMED verify logits —
+            # the plain decode path reports raw-model scores whatever
+            # penalties/bias/constraints shaped the sampling
+            # distribution, and the speculative surface must match it.
             raw_lp = _token_logprob(
-                lg.reshape(b * width, V), out.reshape(b * width)
+                lg_raw.reshape(b * width, V), out.reshape(b * width)
             ).reshape(b, width)
 
             # ---- draft ingests its own d_k (slot n + k) -------------
@@ -608,20 +677,23 @@ class SpeculativePagedEngine(_SpeculativeBase):
                 st = self._fsm_round_end(
                     fsm[0], s_all, m, bonus, n_acc, live, st
                 )
+            if pen:
+                counts = self._fold_counts(counts, out, n_acc, live)
             return (
-                (cache, d_cache, cur, n, rem, done, st),
+                (cache, d_cache, cur, n, rem, done, st, counts),
                 (out, raw_lp, n_acc, m, live),
             )
 
         done0 = jnp.zeros((self.max_slots,), bool)
-        (cache, d_cache, cur, n, _, _, _), (
+        (cache, d_cache, cur, n, _, _, _, counts), (
             outs, lps, n_accs, ms, lives,
         ) = jax.lax.scan(
             round_body,
-            (cache, d_cache, cur, lengths, remaining, done0, st0),
+            (cache, d_cache, cur, lengths, remaining, done0, st0, cts0),
             jax.random.split(rng, rounds),
         )
-        return outs, lps, n_accs, ms, lives, cur, n, cache, d_cache
+        out = (outs, lps, n_accs, ms, lives, cur, n, cache, d_cache)
+        return out + ((counts,) if pen else ())
 
 
 class PromptLookupPagedEngine(_SpeculativeBase):
@@ -688,18 +760,21 @@ class PromptLookupPagedEngine(_SpeculativeBase):
             buf[slot, : len(seq)] = seq
         (
             outs, lps, n_accs, ms, lives, cur2, lengths2, self.cache,
+            *cts,
         ) = self._spec_jit(
             self.params, self.cache, cur, lengths, active,
-            jnp.asarray(remaining), jnp.asarray(self._table),
-            jnp.asarray(buf), *self._sampling_args(),
-            *self._bias_args(), *self._fsm_args(),
-            *self._lora_args(), sub,
+            jnp.asarray(remaining), jnp.asarray(buf),
+            # _decode_extra_args leads with the page table (the paged
+            # engine prepends it), binding the named ``table`` param.
+            *self._decode_extra_args(), sub,
         )
+        if cts:
+            self._counts_dev = cts[0]
         self._fold_rounds(outs, lps, n_accs, ms, lives, cur2, lengths2)
 
     def _spec_impl(
-        self, params, cache, cur, lengths, active, remaining, table,
-        buf, *rest,
+        self, params, cache, cur, lengths, active, remaining, buf,
+        table, *rest,
     ):
         """``rounds_per_step`` lookup/verify rounds, one program.
 
@@ -719,13 +794,18 @@ class PromptLookupPagedEngine(_SpeculativeBase):
         prefix provably stays inside the constraint. Proposals are NOT
         pre-filtered by the FSM (correctness never needs it; on the
         quoting-heavy text where lookup pays, proposals mostly satisfy
-        the constraint anyway)."""
-        _, samp, _pen, bias, fsm, lora, rng = self._split_extra(rest)
+        the constraint anyway). Penalised rows compose position-wise
+        exactly like the FSM masks: prospective counts along the
+        proposal prefix penalise the verify distribution, the buffer
+        folds in each round's accepted emissions
+        (_pen_verify_logits/_fold_counts)."""
+        _, samp, pen, bias, fsm, lora, rng = self._split_extra(rest)
         k, rounds, g = self.k, self.rounds_per_step, self.ngram
         st0 = fsm[1] if fsm else None
+        cts0 = pen[0] if pen else None
 
         def round_body(carry, rsub):
-            cache, buf, cur, n, rem, done, st = carry
+            cache, buf, cur, n, rem, done, st, counts = carry
             live = active & ~done & (rem > 0)
             r_a, r_b = jax.random.split(rsub)
 
@@ -744,8 +824,9 @@ class PromptLookupPagedEngine(_SpeculativeBase):
                 **({"lora": lora} if lora is not None else {}),
             )
             b, width, V = lg.shape
+            lg_raw = lg.astype(jnp.float32)
             lg, mask3, s_all = self._mask_verify_logits(
-                lg, bias, fsm, st, d_toks
+                lg, bias, fsm, st, d_toks, pen=pen, counts=counts
             )
             probs = self._probs2(samp, lg.reshape(b * width, V)).reshape(
                 b, width, V
@@ -789,8 +870,10 @@ class PromptLookupPagedEngine(_SpeculativeBase):
                 bonus[:, None],
                 out,
             )
+            # Raw-model logprobs from the untransformed verify logits
+            # (matches the plain decode path's logprobs surface).
             raw_lp = _token_logprob(
-                lg.reshape(b * width, V), out.reshape(b * width)
+                lg_raw.reshape(b * width, V), out.reshape(b * width)
             ).reshape(b, width)
 
             # ---- history buffer ingests the emitted chunk -----------
@@ -820,17 +903,20 @@ class PromptLookupPagedEngine(_SpeculativeBase):
                 st = self._fsm_round_end(
                     fsm[0], s_all, m, bonus, n_acc, live, st
                 )
+            if pen:
+                counts = self._fold_counts(counts, out, n_acc, live)
             return (
-                (cache, buf, cur, n, rem, done, st),
+                (cache, buf, cur, n, rem, done, st, counts),
                 (out, raw_lp, n_acc, m, live),
             )
 
         done0 = jnp.zeros((self.max_slots,), bool)
-        (cache, buf, cur, n, _, _, _), (outs, lps, n_accs, ms, lives) = (
-            jax.lax.scan(
-                round_body,
-                (cache, buf, cur, lengths, remaining, done0, st0),
-                jax.random.split(rng, rounds),
-            )
+        (cache, buf, cur, n, _, _, _, counts), (
+            outs, lps, n_accs, ms, lives,
+        ) = jax.lax.scan(
+            round_body,
+            (cache, buf, cur, lengths, remaining, done0, st0, cts0),
+            jax.random.split(rng, rounds),
         )
-        return outs, lps, n_accs, ms, lives, cur, n, cache
+        out = (outs, lps, n_accs, ms, lives, cur, n, cache)
+        return out + ((counts,) if pen else ())

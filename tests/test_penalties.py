@@ -15,7 +15,8 @@ Pinned properties:
   * paged preemption-recompute replays the SAME penalised tokens (the
     re-prefill's sample sees the resumed generation's counts);
   * validation: per-request penalties need enable_penalties; the
-    speculative engine refuses penalties outright.
+    speculative engines COMPOSE with penalties since round 5
+    (tests/test_spec_penalties.py pins the parity).
 """
 
 import numpy as np
@@ -230,16 +231,19 @@ def test_penalty_validation(tiny):
         eng.submit([1, 2, 3], max_new_tokens=2, sampling=_NO_REPEAT)
 
 
-def test_spec_engine_rejects_penalties(tiny):
+def test_spec_engine_accepts_penalties(tiny):
+    """Round 5: the speculative engines serve penalised traffic
+    (position-wise prospective counts — parity pinned in
+    tests/test_spec_penalties.py); the constructor composes."""
     from shifu_tpu.infer import SpeculativePagedEngine
 
     model, params = tiny
-    with pytest.raises(NotImplementedError, match="penalties"):
-        SpeculativePagedEngine(
-            model, params, model, params,
-            max_slots=1, max_len=32, prefill_buckets=(16, 32),
-            sample_cfg=SampleConfig(temperature=0.0, presence_penalty=1.0),
-        )
+    eng = SpeculativePagedEngine(
+        model, params, model, params,
+        max_slots=1, max_len=32, page_size=8, prefill_buckets=(16, 32),
+        sample_cfg=SampleConfig(temperature=0.0, presence_penalty=1.0),
+    )
+    assert eng.enable_penalties
 
 def test_stateless_paths_reject_penalties(tiny):
     """make_generate_fn and the standalone speculative drivers keep no

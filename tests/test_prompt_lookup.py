@@ -15,9 +15,9 @@ Pinned properties:
     runs; greedy rows stay exact);
   * stats: proposed/accepted counters and /healthz-visible
     acceptance_rate move;
-  * validation: ngram >= 1, decode_chunk refused, penalties refused;
-    logit_bias/constraints/lora COMPOSE since round 5
-    (tests/test_fsm_device.py).
+  * validation: ngram >= 1, decode_chunk refused;
+    logit_bias/constraints/lora/penalties COMPOSE since round 5
+    (tests/test_fsm_device.py, tests/test_spec_penalties.py).
 """
 
 import numpy as np
@@ -210,15 +210,16 @@ def test_validation(tiny):
         PromptLookupPagedEngine(model, params, ngram=0, **kw)
     with pytest.raises(ValueError, match="rounds_per_step"):
         PromptLookupPagedEngine(model, params, decode_chunk=4, **kw)
-    with pytest.raises(NotImplementedError, match="penalties"):
-        PromptLookupPagedEngine(
-            model, params,
-            sample_cfg=SampleConfig(temperature=0.0, presence_penalty=1.0),
-            **kw,
-        )
     # logit_bias/constraints compose since round 5 (the verify
-    # distribution is masked): the flag constructs.
+    # distribution is masked), and penalties too (position-wise
+    # prospective counts): both flags construct.
     PromptLookupPagedEngine(model, params, enable_logit_bias=True, **kw)
+    eng = PromptLookupPagedEngine(
+        model, params,
+        sample_cfg=SampleConfig(temperature=0.0, presence_penalty=1.0),
+        **kw,
+    )
+    assert eng.enable_penalties
 
 
 # ------------------------------------------------ CLI-built engine + server
@@ -270,11 +271,12 @@ def test_cli_builds_every_engine_kind(tiny):
 
     with pytest.raises(ValueError, match="draft-preset"):
         build_serve_engine(_serve_args(spec="draft"), model, params, tok)
-    with pytest.raises(ValueError, match="compose"):
-        build_serve_engine(
-            _serve_args(spec="prompt-lookup", penalties=True),
-            model, params, tok,
-        )
+    # --spec + --penalties composes since r5 (position-wise counts).
+    eng = build_serve_engine(
+        _serve_args(spec="prompt-lookup", penalties=True),
+        model, params, tok,
+    )
+    assert type(eng) is PromptLookupPagedEngine and eng.enable_penalties
 
 
 def test_server_on_cli_built_lookup_engine(tiny):

@@ -199,9 +199,19 @@ def test_cli_builds_router(tiny_f32):
     done = {c.rid: c for c in spec_grp.run()}[rid]
     assert 5 not in done.tokens
 
-    # Penalties remain refused with --spec.
-    with pytest.raises(ValueError, match="penalties"):
-        mk(spec="prompt-lookup", penalties=True)
+    # Penalties compose with --spec since r5 (position-wise
+    # prospective counts in the verifier) — including over replicas.
+    pen_grp = mk(
+        mesh="dp=2,tp=1", spec="prompt-lookup", penalties=True,
+        per_request_sampling=True,
+    )
+    assert isinstance(pen_grp, ReplicatedEngine)
+    rid = pen_grp.submit(
+        [1, 2, 3], max_new_tokens=6,
+        sampling=SampleConfig(temperature=0.0, presence_penalty=1e9),
+    )
+    done = {c.rid: c for c in pen_grp.run()}[rid]
+    assert len(done.tokens) == len(set(done.tokens))
 
 
 def test_router_validation(tiny_f32):

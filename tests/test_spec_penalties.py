@@ -1,0 +1,228 @@
+"""Penalties through the SPECULATIVE engines — the last serving
+feature joins the composition (round 5).
+
+The mechanism under test (infer/spec_engine.py): verify position i's
+distribution is only consumed when proposals 0..i-1 were all accepted,
+and accepted proposals are EMITTED tokens — so position i is penalised
+with PROSPECTIVE counts ``counts + sum_{j<i} onehot(proposal_j)``,
+exactly the counts the plain engine would hold there. The per-slot
+count buffer rides the round scan (multi-round dispatches penalise
+across rounds) and folds in each round's accepted emissions.
+
+Pinned properties:
+  * greedy lookup+penalties == greedy plain+penalties token for token
+    (and the draft engine likewise, draft == target);
+  * an effectively-infinite presence penalty never repeats a token
+    even though lookup PROPOSES repeats by construction — the
+    position-wise penalised verifier must reject them;
+  * per-request isolation: a penalised row beside a plain row, each
+    exactly as it is alone;
+  * rounds_per_step > 1 == rounds_per_step 1 (counts carried across
+    rounds inside one dispatch);
+  * penalties + logit_bias + regex constraints in ONE request through
+    the lookup engine == the plain engine with the same features;
+  * preemption recompute replays the same penalised stream (admission
+    rebuilds the slot's counts from the resumed generation);
+  * the draft's propose distribution is penalised too: with
+    draft == target and greedy sampling, every proposal matches the
+    penalised argmax, so acceptance is 100%.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from shifu_tpu.data.tokenizer import ByteTokenizer
+from shifu_tpu.infer import SampleConfig
+from shifu_tpu.infer.engine import PagedEngine
+from shifu_tpu.infer.spec_engine import (
+    PromptLookupPagedEngine,
+    SpeculativePagedEngine,
+)
+from shifu_tpu.models import Transformer, TransformerConfig
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    model = Transformer(TransformerConfig.tiny())
+    return model, model.init(jax.random.key(0))
+
+
+_TOK = ByteTokenizer()
+
+_PEN = SampleConfig(
+    temperature=0.0, presence_penalty=0.7, frequency_penalty=0.2,
+    repetition_penalty=1.3,
+)
+_NO_REPEAT = SampleConfig(temperature=0.0, presence_penalty=1e9)
+
+
+def _prompts(seed, sizes):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(1, 256, size=n).tolist() for n in sizes]
+
+
+def _run(eng, prompts, max_new, **skw):
+    rids = [eng.submit(p, max_new_tokens=max_new, **skw) for p in prompts]
+    out = {c.rid: c for c in eng.run()}
+    return [out[r].tokens for r in rids]
+
+
+def _kw(**over):
+    base = dict(max_slots=2, max_len=64, prefill_buckets=(16, 32, 64),
+                page_size=8, sample_cfg=_PEN)
+    base.update(over)
+    return base
+
+
+# -------------------------------------------------------------- parity
+
+
+def test_lookup_penalties_parity(tiny):
+    """Greedy + penalties: the lookup engine emits the plain paged
+    engine's exact stream (the verify distribution at each position is
+    penalised with the counts the plain engine holds there)."""
+    model, params = tiny
+    prompts = _prompts(0, (7, 12))
+    ref = _run(PagedEngine(model, params, **_kw()), prompts, 14)
+    for rounds in (1, 3):
+        got = _run(
+            PromptLookupPagedEngine(
+                model, params, k=3, ngram=2, rounds_per_step=rounds,
+                **_kw(),
+            ),
+            prompts, 14,
+        )
+        assert got == ref, rounds
+
+
+def test_draft_penalties_parity_and_full_acceptance(tiny):
+    """Draft == target, greedy: the draft's penalised propose step
+    picks the same penalised argmax the verifier checks, so every
+    proposal is accepted AND the stream equals the plain engine's."""
+    model, params = tiny
+    prompts = _prompts(1, (6, 9))
+    ref = _run(PagedEngine(model, params, **_kw()), prompts, 12)
+    eng = SpeculativePagedEngine(
+        model, params, model, params, k=3, **_kw(),
+    )
+    got = _run(eng, prompts, 12)
+    assert got == ref
+    assert eng.spec_proposed > 0
+    assert eng.spec_accepted == eng.spec_proposed
+
+
+def test_lookup_never_repeats_despite_repeating_proposals(tiny):
+    """The acid test: lookup PROPOSES continuations of earlier n-grams
+    (repeats by construction), while an effectively-infinite presence
+    penalty bans every generated token — the penalised verifier must
+    reject each repeat proposal, so output tokens are all distinct."""
+    model, params = tiny
+    eng = PromptLookupPagedEngine(
+        model, params, k=4, ngram=2, rounds_per_step=2,
+        **_kw(sample_cfg=_NO_REPEAT),
+    )
+    for toks in _run(eng, _prompts(2, (5, 9)), 14):
+        assert len(toks) == len(set(toks)), toks
+
+
+def test_per_request_isolation(tiny):
+    """A penalised row and a plain greedy row in one speculative
+    batch: the plain row matches the penalty-free engine exactly; the
+    penalised row never repeats."""
+    model, params = tiny
+    prompts = _prompts(3, (7, 7))
+    plain = _run(
+        PromptLookupPagedEngine(
+            model, params, k=3, ngram=2,
+            **_kw(sample_cfg=SampleConfig(temperature=0.0)),
+        ),
+        prompts, 10,
+    )
+    eng = PromptLookupPagedEngine(
+        model, params, k=3, ngram=2,
+        **_kw(sample_cfg=SampleConfig(temperature=0.0),
+              per_request_sampling=True, enable_penalties=True),
+    )
+    r0 = eng.submit(prompts[0], max_new_tokens=10, sampling=_NO_REPEAT)
+    r1 = eng.submit(prompts[1], max_new_tokens=10)
+    out = {c.rid: c.tokens for c in eng.run()}
+    assert len(out[r0]) == len(set(out[r0]))
+    assert out[r1] == plain[1]
+
+
+# -------------------------------------------------- feature composition
+
+
+def test_penalties_bias_regex_all_in_one(tiny):
+    """One request carrying penalties AND a logit_bias ban AND a regex
+    constraint through the lookup engine == the plain engine serving
+    the identical request (every feature lands on the verify
+    distribution in the plain sampler's order)."""
+    model, params = tiny
+    prompt = _TOK.encode("id: ")
+    skw = dict(
+        max_new_tokens=16, regex=r"[a-z]{2,10}",
+        logit_bias={ord("e"): -100}, sampling=_PEN,
+    )
+    ekw = _kw(
+        sample_cfg=SampleConfig(temperature=0.0),
+        per_request_sampling=True, enable_penalties=True,
+        enable_logit_bias=True, tokenizer=_TOK, eos_id=_TOK.eos_id,
+    )
+    ref = PagedEngine(model, params, **ekw)
+    r = ref.submit(prompt, **skw)
+    want = {c.rid: c for c in ref.run()}[r]
+    eng = PromptLookupPagedEngine(
+        model, params, k=3, ngram=2, rounds_per_step=2, **ekw
+    )
+    r = eng.submit(prompt, **skw)
+    got = {c.rid: c for c in eng.run()}[r]
+    assert got.tokens == want.tokens
+    body = [t for t in got.tokens if t != _TOK.eos_id]
+    assert ord("e") not in body  # the ban held through speculation
+
+
+def test_logprobs_are_raw_model_scores(tiny):
+    """Completion.logprobs reports RAW-model scores on every engine —
+    whatever penalties/bias shaped the sampling distribution, the
+    speculative verifier's logprob surface must match the plain
+    engine's bit-for-token (the verify logits are scored BEFORE the
+    penalty/bias transform)."""
+    model, params = tiny
+    prompt = _prompts(5, (8,))[0]
+    skw = dict(max_new_tokens=10, logit_bias={7: -100}, sampling=_PEN)
+    ekw = _kw(
+        sample_cfg=SampleConfig(temperature=0.0),
+        per_request_sampling=True, enable_penalties=True,
+        enable_logit_bias=True,
+    )
+    ref_eng = PagedEngine(model, params, **ekw)
+    r = ref_eng.submit(prompt, **skw)
+    ref = {c.rid: c for c in ref_eng.run()}[r]
+    eng = PromptLookupPagedEngine(model, params, k=3, ngram=2, **ekw)
+    r = eng.submit(prompt, **skw)
+    got = {c.rid: c for c in eng.run()}[r]
+    assert got.tokens == ref.tokens
+    np.testing.assert_allclose(
+        got.logprobs, ref.logprobs, rtol=1e-4, atol=1e-4
+    )
+
+
+def test_preemption_recompute_with_penalties(tiny):
+    """A pool tight enough to force preemption: the penalised
+    speculative stream equals the roomy pool's (the recompute
+    re-prefill rebuilds the slot's counts from the resumed
+    generation, and the round program carries on from them)."""
+    model, params = tiny
+    prompts = _prompts(4, (5, 5, 5))
+    kw = dict(max_slots=2, max_len=24, prefill_buckets=(8, 16, 24),
+              page_size=4, sample_cfg=_PEN, k=2, ngram=2)
+    roomy = _run(
+        PromptLookupPagedEngine(model, params, **kw), prompts, 8
+    )
+    tight = PromptLookupPagedEngine(model, params, n_pages=6, **kw)
+    got = _run(tight, prompts, 8)
+    assert tight.preemptions >= 1
+    assert got == roomy
