@@ -123,15 +123,22 @@ class HFTokenizer:
         ``_tok``)."""
         return getattr(self._tok, "chat_template", None)
 
-    def apply_chat_template(self, messages, *, add_generation_prompt=True):
+    def apply_chat_template(self, messages, *, add_generation_prompt=True,
+                            tools=None):
         """Render a chat message list to token ids via the underlying
         HF tokenizer's chat template (raises when the tokenizer has
         none configured — callers fall back to a generic rendering;
-        see infer/server.py ``_chat_tokens``)."""
+        see infer/server.py ``_chat_tokens``). ``tools``: OpenAI-shaped
+        function specs, forwarded to tool-aware templates (Llama-3.1
+        style); templates that do not reference tools simply ignore
+        them — the server detects that by comparing renders and falls
+        back to its generic system block."""
+        kw = {} if tools is None else {"tools": tools}
         return self._tok.apply_chat_template(
             messages,
             add_generation_prompt=add_generation_prompt,
             tokenize=True,
+            **kw,
         )
 
 

@@ -662,7 +662,7 @@ _JSON_NUMBER = _JSON_INT + r"(\.\d+)?([eE][+-]?\d+)?"
 _WS = r"\s*"
 
 
-def schema_to_regex(schema: dict) -> str:
+def schema_to_regex(schema: dict, *, compact: bool = False) -> str:
     """A PRACTICAL JSON-Schema subset -> constraint pattern for
     :func:`compile_regex` — "give me an object with exactly these
     typed fields", which is what structured-output traffic almost
@@ -685,9 +685,17 @@ def schema_to_regex(schema: dict) -> str:
     (an escape or a multi-byte UTF-8 sequence counts as ONE
     character). Anything else raises ValueError — an unsupported
     keyword must not silently weaken a constraint.
+
+    ``compact=True`` admits NO optional whitespace (the single
+    canonical ``json.dumps(..., separators=(",", ":"))`` form). The
+    default grammar's ``\\s*`` freedom lets a model that favours
+    whitespace tokens under the mask pad forever and exhaust its
+    budget mid-object; compact constraints make greedy structured
+    output terminate — tool calling uses this.
     """
     if not isinstance(schema, dict):
         raise ValueError("schema must be an object")
+    ws = "" if compact else _WS
 
     def emit(s) -> str:
         if not isinstance(s, dict):
@@ -741,9 +749,9 @@ def schema_to_regex(schema: dict) -> str:
                 )
             item = emit(s["items"])
             return (
-                r"\[" + _WS + "(" + item
-                + "(" + _WS + "," + _WS + item + ")*" + ")?"
-                + _WS + r"\]"
+                r"\[" + ws + "(" + item
+                + "(" + ws + "," + ws + item + ")*" + ")?"
+                + ws + r"\]"
             )
         if t == "object":
             props = s.get("properties")
@@ -764,7 +772,7 @@ def schema_to_regex(schema: dict) -> str:
                         f"{sorted(unknown)}"
                     )
             fields = [
-                ('"' + _regex_escape(str(name)) + '":' + _WS
+                ('"' + _regex_escape(str(name)) + '":' + ws
                  + emit(sub), str(name) in required)
                 for name, sub in props.items()
             ]
@@ -791,7 +799,7 @@ def schema_to_regex(schema: dict) -> str:
                 alts = []
                 for j in range(i, min(stop, n - 1) + 1):
                     pat, _ = fields[j]
-                    head = ("," + _WS if lead_comma else "") + pat
+                    head = ("," + ws if lead_comma else "") + pat
                     alts.append(head + rec(j + 1, True))
                 if stop == n:  # nothing mandatory left: may stop here
                     alts.append("")
@@ -800,7 +808,7 @@ def schema_to_regex(schema: dict) -> str:
                 return "(" + "|".join(alts) + ")"
 
             inner = rec(0, False)
-            return r"\{" + _WS + inner + _WS + r"\}"
+            return r"\{" + ws + inner + ws + r"\}"
         raise ValueError(
             f"unsupported schema node {s!r} (see schema_to_regex "
             "docstring for the supported subset)"

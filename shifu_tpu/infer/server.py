@@ -31,13 +31,28 @@ engine built with ``per_request_sampling=True``, requests may carry
 values in the SAME compiled program, so mixed greedy/sampled traffic
 never recompiles.
 
-Speculative engines serve the full constrained surface (logit_bias /
-allowed_token_ids / regex / json_schema — the verify distribution is
-masked position-wise) and multi-LoRA adapters, but NOT the
-presence/frequency/repetition penalty fields: per-position counts
-depend on the same round's accepted prefix, so penalised requests need
-a non-speculative engine (the penalty-enabled constructor refuses on
-speculative engines and the CLI refuses --spec with --penalties).
+Speculative engines serve the FULL feature surface: the constrained
+fields (logit_bias / allowed_token_ids / regex / json_schema — the
+verify distribution is masked position-wise), multi-LoRA adapters, and
+the presence/frequency/repetition penalty fields (position-wise
+prospective counts along the proposal prefix — verify position i is
+penalised with the counts the plain engine would hold after emitting
+proposals 0..i-1).
+
+TOOL / FUNCTION CALLING (/v1/chat/completions): OpenAI-shaped
+``tools`` + ``tool_choice``. A forced choice (a named function or
+"required") COMPILES the tool envelope into an FSM constraint —
+``{"name": "<tool>", "arguments": {...}}`` with the name pinned by an
+enum and the arguments by the tool's parameter schema (alternation
+over envelopes for "required" with several tools) — so forced tool
+calls are schema-valid by construction, not by prompting luck.
+"auto" renders the schemas into the prompt (chat-template ``tools``
+kwarg when the template supports it, a generic system block
+otherwise) and parses an envelope out of the reply when the model
+emits one. Responses carry ``message.tool_calls`` (arguments as a
+JSON string, per the OpenAI wire shape) and ``finish_reason:
+"tool_calls"``. ``max_tokens`` is accepted as an alias for
+``max_new_tokens`` on both endpoints.
 
 Stop sequences truncate in the ENGINE host loop (finished_by="stop");
 string stops additionally trim the trailing text in the response here.
@@ -58,6 +73,7 @@ import dataclasses
 import json
 import queue
 import threading
+import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
@@ -182,6 +198,139 @@ def _parse_bias(req: dict):
         ):
             raise ValueError("allowed_token_ids entries must be ints")
     return lb, allowed
+
+
+def _parse_tools(req: dict):
+    """OpenAI ``tools`` / ``tool_choice`` fields -> (ordered
+    {name: tool_dict}, choice) where choice is "auto" | "none" |
+    "required" | a tool NAME (the forced function). Shape validation
+    only — whether a tool's parameter schema is CONSTRAINABLE is
+    decided by schema_to_regex at constraint-build time (unsupported
+    keywords 400 there with the schema layer's own message)."""
+    tools = req.get("tools")
+    choice = req.get("tool_choice", "auto")
+    if tools is None:
+        if choice not in (None, "auto", "none"):
+            raise ValueError("tool_choice without tools")
+        return None, "none"
+    if not isinstance(tools, list) or not tools:
+        raise ValueError("tools must be a non-empty list")
+    out = {}
+    for t in tools:
+        if not isinstance(t, dict) or t.get("type") != "function":
+            raise ValueError(
+                'each tool must be {"type": "function", "function": '
+                "{...}}"
+            )
+        fn = t.get("function")
+        if not isinstance(fn, dict) or not isinstance(
+            fn.get("name"), str
+        ) or not fn["name"]:
+            raise ValueError("tool.function needs a string 'name'")
+        if fn["name"] in out:
+            raise ValueError(f"duplicate tool name {fn['name']!r}")
+        params = fn.get("parameters")
+        if params is not None and not isinstance(params, dict):
+            raise ValueError("tool.function.parameters must be an object")
+        out[fn["name"]] = fn
+    if isinstance(choice, dict):
+        name = (choice.get("function") or {}).get("name")
+        if choice.get("type") != "function" or not isinstance(name, str):
+            raise ValueError(
+                'tool_choice object must be {"type": "function", '
+                '"function": {"name": ...}}'
+            )
+        if name not in out:
+            raise ValueError(f"tool_choice names unknown tool {name!r}")
+        return out, name
+    if choice in (None, "auto"):
+        return out, "auto"
+    if choice in ("none", "required"):
+        return out, choice
+    raise ValueError(
+        'tool_choice must be "auto", "none", "required" or a '
+        '{"type": "function", ...} object'
+    )
+
+
+def _tool_constraint(tools: dict, choice: str):
+    """The regex constraining a FORCED tool call (choice == a name or
+    "required"), or None for "auto"/"none" (free generation). Each
+    tool's envelope is ``{"name": "<tool>", "arguments": {...}}`` —
+    the name pinned by an enum, the arguments by the tool's own
+    parameter schema; zero-argument tools take a literal empty
+    object. Regular alternation across envelopes makes "required"
+    with several tools ONE DFA — the engine compiles it like any
+    other pattern. Tools whose parameter schemas use keywords outside
+    the schema_to_regex subset raise ValueError (surfaced as a 400 —
+    an unconstrainable tool must not silently weaken to free text)."""
+    from shifu_tpu.infer.constrain import _regex_escape, schema_to_regex
+
+    if choice in ("auto", "none"):
+        return None
+    alts = []
+    for name in [choice] if choice != "required" else list(tools):
+        params = tools[name].get("parameters")
+        if not params or not params.get("properties"):
+            alts.append(
+                r'\{"name":"' + _regex_escape(name)
+                + r'","arguments":\{\}\}'
+            )
+        else:
+            # compact: the canonical no-whitespace form — optional
+            # \s* freedom lets a model that favours whitespace under
+            # the mask pad forever instead of completing the call.
+            alts.append(schema_to_regex({
+                "type": "object",
+                "properties": {
+                    "name": {"enum": [name]},
+                    "arguments": params,
+                },
+            }, compact=True))
+    return "(" + "|".join(alts) + ")" if len(alts) > 1 else alts[0]
+
+
+def _tool_system_text(tools) -> str:
+    """The generic tool-instruction block (template-less tokenizers
+    and templates without a ``tools`` parameter): the function schemas
+    plus the envelope convention _parse_tool_calls recognises."""
+    lines = ["You have access to these tools (JSON function schemas):"]
+    for t in tools:
+        lines.append(json.dumps(t.get("function", t), sort_keys=True))
+    lines.append(
+        'To call a tool, reply with ONLY a JSON object '
+        '{"name": <tool name>, "arguments": <arguments object>}.'
+    )
+    return "\n".join(lines)
+
+
+def _parse_tool_calls(text: str, tools: dict):
+    """Recognise a tool-call envelope in the completion text ->
+    OpenAI-shaped ``tool_calls`` list, or None when the text is not a
+    (known) tool call. Forced-choice output always parses (the FSM
+    admitted nothing else); "auto" output parses only when the model
+    actually emitted the envelope."""
+    try:
+        obj = json.loads(text)
+    except (ValueError, TypeError):
+        return None
+    if (
+        not isinstance(obj, dict)
+        or not isinstance(obj.get("name"), str)
+        or obj["name"] not in tools
+        or "arguments" not in obj
+        or not isinstance(obj["arguments"], dict)
+    ):
+        return None
+    return [{
+        "id": "call_" + uuid.uuid4().hex[:24],
+        "type": "function",
+        "function": {
+            "name": obj["name"],
+            # OpenAI wire shape: arguments is a JSON STRING.
+            "arguments": json.dumps(obj["arguments"]),
+        },
+    }]
 
 
 @dataclasses.dataclass
@@ -755,25 +904,36 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             self._send(404, {"error": f"no route {self.path}"})
 
-    def _chat_tokens(self, messages):
+    def _chat_tokens(self, messages, tools=None):
         """Render a chat message list to prompt token ids.
 
         Uses the tokenizer's chat template when it has one (the HF
         adapter delegates to ``apply_chat_template`` with
-        add_generation_prompt=True); otherwise a plain generic
-        rendering (``<|role|>\\ncontent`` blocks + assistant header) so
-        template-less tokenizers still serve chat traffic."""
+        add_generation_prompt=True, forwarding ``tools`` when given —
+        templates without a tools parameter fall back to a system
+        block); otherwise a plain generic rendering
+        (``<|role|>\\ncontent`` blocks + assistant header) so
+        template-less tokenizers still serve chat traffic. ``tools``
+        is the raw OpenAI-shaped list; with tools in play, assistant
+        turns may carry ``tool_calls`` instead of content and ``tool``
+        -role result messages render as their own blocks."""
         if not isinstance(messages, list) or not messages:
             raise ValueError("'messages' must be a non-empty list")
         for m in messages:
-            if (
-                not isinstance(m, dict)
-                or not isinstance(m.get("role"), str)
-                or not isinstance(m.get("content"), str)
+            if not isinstance(m, dict) or not isinstance(
+                m.get("role"), str
             ):
-                raise ValueError(
-                    "each message needs string 'role' and 'content'"
-                )
+                raise ValueError("each message needs a string 'role'")
+            if isinstance(m.get("content"), str):
+                continue
+            if m["role"] == "assistant" and isinstance(
+                m.get("tool_calls"), list
+            ):
+                continue  # tool-call turns carry no content
+            raise ValueError(
+                "each message needs string 'content' (assistant "
+                "turns may carry 'tool_calls' instead)"
+            )
         if self.tokenizer is None:
             raise ValueError(
                 "chat completions need a server tokenizer (messages "
@@ -815,23 +975,83 @@ class _Handler(BaseHTTPRequestHandler):
             # default it to False (the adapter defaults True) —
             # without it the model would continue the user turn
             # instead of answering it.
+            if tools:
+                try:
+                    with_tools = [
+                        int(t) for t in apply(
+                            messages, add_generation_prompt=True,
+                            tools=tools,
+                        )
+                    ]
+                    without = [
+                        int(t)
+                        for t in apply(
+                            messages, add_generation_prompt=True
+                        )
+                    ]
+                    # A template that never references tools renders
+                    # IDENTICAL ids with and without them (transformers
+                    # does not error — the schemas would silently reach
+                    # the model nowhere). Only a differing render
+                    # proves native tool templating.
+                    if with_tools != without:
+                        return with_tools
+                except TypeError:
+                    pass  # adapter predates the tools kwarg
+                # Fall back to a plain system block carrying the
+                # schemas.
+                messages = (
+                    [{"role": "system",
+                      "content": _tool_system_text(tools)}]
+                    + list(messages)
+                )
             return [
                 int(t)
                 for t in apply(messages, add_generation_prompt=True)
             ]
-        text = "".join(
-            f"<|{m['role']}|>\n{m['content']}\n" for m in messages
-        ) + "<|assistant|>\n"
-        return self.tokenizer.encode(text)
+        parts = []
+        if tools:
+            parts.append(
+                f"<|system|>\n{_tool_system_text(tools)}\n"
+            )
+        for m in messages:
+            if isinstance(m.get("content"), str):
+                parts.append(f"<|{m['role']}|>\n{m['content']}\n")
+            else:  # assistant tool-call turn: render the envelopes
+                calls = "\n".join(
+                    json.dumps({
+                        "name": c.get("function", {}).get("name"),
+                        "arguments": json.loads(
+                            c.get("function", {}).get("arguments", "{}")
+                        ),
+                    })
+                    for c in m["tool_calls"]
+                )
+                parts.append(f"<|assistant|>\n{calls}\n")
+        parts.append("<|assistant|>\n")
+        return self.tokenizer.encode("".join(parts))
 
     @staticmethod
-    def _as_chat_choice(choice: dict) -> dict:
-        """Completion choice -> chat shape (text moves into message)."""
+    def _as_chat_choice(choice: dict, tools=None) -> dict:
+        """Completion choice -> chat shape (text moves into message).
+
+        With ``tools`` active, text recognised as a tool-call envelope
+        becomes ``message.tool_calls`` (OpenAI shape: arguments as a
+        JSON string) with ``finish_reason: "tool_calls"`` and null
+        content — forced-choice output always parses (the FSM admitted
+        nothing else); "auto" output parses only when the model
+        actually emitted the envelope."""
         out = dict(choice)
         content = out.pop("text", None)
         msg = {"role": "assistant"}
         if content is not None:
             msg["content"] = content
+        if tools and content is not None:
+            calls = _parse_tool_calls(content, tools)
+            if calls:
+                msg["tool_calls"] = calls
+                msg["content"] = None
+                out["finish_reason"] = "tool_calls"
         out["message"] = msg
         return out
 
@@ -842,9 +1062,19 @@ class _Handler(BaseHTTPRequestHandler):
         except (ValueError, json.JSONDecodeError):
             self._send(400, {"error": "body must be JSON"})
             return
+        tools, tool_choice = None, "none"
         if chat:
             try:
-                tokens = self._chat_tokens(req.get("messages"))
+                tools, tool_choice = _parse_tools(req)
+                if tool_choice == "none":
+                    # The model must not call tools: the schemas stay
+                    # out of the prompt and responses are never parsed
+                    # as envelopes.
+                    tools = None
+                tokens = self._chat_tokens(
+                    req.get("messages"),
+                    tools=req.get("tools") if tools else None,
+                )
             except ValueError as e:
                 self._send(400, {"error": str(e)})
                 return
@@ -852,6 +1082,12 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(400, {"error": f"cannot render messages: {e!r}"})
                 return
         else:
+            if req.get("tools") is not None:
+                self._send(
+                    400,
+                    {"error": "tools are a chat-completions feature"},
+                )
+                return
             tokens = req.get("tokens")
             prompt = req.get("prompt")
             if (tokens is None) == (prompt is None):
@@ -875,7 +1111,14 @@ class _Handler(BaseHTTPRequestHandler):
                     )
                     return
         try:
-            max_new = int(req.get("max_new_tokens", self.default_max_new))
+            # "max_tokens" is the OpenAI wire name; "max_new_tokens"
+            # (the engine's own) wins when both are present. Explicit
+            # null means "unset" on the OpenAI wire — fall through to
+            # the default rather than 400ing on int(None).
+            mn = req.get("max_new_tokens")
+            if mn is None:
+                mn = req.get("max_tokens")
+            max_new = int(self.default_max_new if mn is None else mn)
             sampling = _parse_sampling(req, self.runner.engine.sample_cfg)
             stop_strings = req.get("stop")
             if isinstance(stop_strings, str):
@@ -895,6 +1138,17 @@ class _Handler(BaseHTTPRequestHandler):
                 json_schema, dict
             ):
                 raise ValueError("json_schema must be an object")
+            if tools and tool_choice not in ("none", "auto"):
+                # Forced tool call: the response IS the envelope —
+                # constrain generation to it (FSM-constrained decode,
+                # so the arguments are schema-valid by construction).
+                if regex is not None or json_schema is not None:
+                    raise ValueError(
+                        "forced tool_choice does not compose with "
+                        "regex/json_schema (the tool envelope is the "
+                        "constraint)"
+                    )
+                regex = _tool_constraint(tools, tool_choice)
             want_logprobs = bool(req.get("logprobs"))
             n = int(req.get("n", 1))
             best_of = req.get("best_of")
@@ -912,7 +1166,7 @@ class _Handler(BaseHTTPRequestHandler):
                     stop_strings, want_logprobs, chat=chat,
                     logit_bias=logit_bias, allowed_token_ids=allowed_ids,
                     adapter=adapter, regex=regex,
-                    json_schema=json_schema,
+                    json_schema=json_schema, tools=tools,
                 )
                 return
             if best_of is not None:
@@ -952,6 +1206,7 @@ class _Handler(BaseHTTPRequestHandler):
                     or adapter is not None
                     or regex is not None
                     or json_schema is not None
+                    or tools is not None
                 ):
                     # Beam is deterministic max-logprob search; these
                     # fields would be silently dropped — refuse instead.
@@ -959,7 +1214,7 @@ class _Handler(BaseHTTPRequestHandler):
                         "best_of composes with none of temperature/"
                         "top_k/top_p/stop/stop_token_ids/logprobs/"
                         "logit_bias/allowed_token_ids/adapter/regex/"
-                        "json_schema"
+                        "json_schema/tools"
                     )
                 out = self.runner.beam(
                     tokens, max_new, best_of,
@@ -1007,7 +1262,10 @@ class _Handler(BaseHTTPRequestHandler):
                     for d in dones
                 ]
                 if chat:
-                    choices = [self._as_chat_choice(c) for c in choices]
+                    choices = [
+                        self._as_chat_choice(c, tools=tools)
+                        for c in choices
+                    ]
                 self._send(200, {
                     "choices": choices,
                     "usage": _usage(len(tokens), dones),
@@ -1032,7 +1290,9 @@ class _Handler(BaseHTTPRequestHandler):
         choice = _build_choice(
             done, self.tokenizer, want_logprobs, stop_strings
         )
-        out = self._as_chat_choice(choice) if chat else choice
+        out = (
+            self._as_chat_choice(choice, tools=tools) if chat else choice
+        )
         out["usage"] = _usage(len(tokens), [done])
         self._send(200, out)
 
@@ -1040,7 +1300,7 @@ class _Handler(BaseHTTPRequestHandler):
         self, tokens, max_new: int, sampling=None,
         stop_token_ids=None, stop_strings=None, want_logprobs=False,
         chat: bool = False, logit_bias=None, allowed_token_ids=None,
-        adapter=None, regex=None, json_schema=None,
+        adapter=None, regex=None, json_schema=None, tools=None,
     ) -> None:
         """Server-sent events: one ``data:`` line per token delta, a
         final one with finished_by (and the definitive token count —
@@ -1106,9 +1366,18 @@ class _Handler(BaseHTTPRequestHandler):
                             ):
                                 text = _trim_stop(text, stop_strings)
                             if chat:
-                                final["message"] = {
-                                    "role": "assistant", "content": text,
-                                }
+                                # The definitive event carries the
+                                # parsed tool call (deltas streamed the
+                                # raw envelope text); one assembly
+                                # point with the non-streaming path.
+                                ch = self._as_chat_choice(
+                                    {"text": text}, tools=tools
+                                )
+                                final["message"] = ch["message"]
+                                if "finish_reason" in ch:
+                                    final["finish_reason"] = (
+                                        ch["finish_reason"]
+                                    )
                             else:
                                 final["text"] = text
                         except Exception:

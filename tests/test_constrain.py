@@ -685,3 +685,32 @@ def test_schema_optional_properties_and_unions():
             "properties": {"a": {"type": "null"}},
             "required": ["z"],
         })
+
+
+def test_schema_compact_form():
+    """compact=True admits exactly the canonical json.dumps
+    separators=(',', ':') form — no optional whitespace anywhere (the
+    \\s* freedom lets a whitespace-favouring model pad forever; tool
+    calling relies on compact constraints terminating)."""
+    from shifu_tpu.infer import schema_to_regex
+
+    schema = {
+        "type": "object",
+        "properties": {
+            "name": {"enum": ["get_weather"]},
+            "arguments": {
+                "type": "object",
+                "properties": {"city": {"type": "string",
+                                        "maxLength": 4},
+                               "ok": {"type": "boolean"}},
+            },
+        },
+    }
+    compact = compile_regex(schema_to_regex(schema, compact=True))
+    loose = compile_regex(schema_to_regex(schema))
+    obj = {"name": "get_weather", "arguments": {"city": "ab", "ok": True}}
+    canon = json.dumps(obj, separators=(",", ":")).encode()
+    spaced = json.dumps(obj, indent=1).encode()
+    assert compact.matches(canon)
+    assert not compact.matches(spaced)  # no whitespace admitted
+    assert loose.matches(canon) and loose.matches(spaced)
