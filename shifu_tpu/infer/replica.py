@@ -70,6 +70,7 @@ class ReplicatedEngine:
         self.model = first.model
         self.params = first.params
         self.max_len = first.max_len
+        self.buckets = first.buckets  # beam / embeddings prefill shapes
         self.tokenizer = first.tokenizer
         self.sample_cfg = first.sample_cfg
         self.eos_id = first.eos_id

@@ -23,6 +23,12 @@ one step.
                           {"choices": [{"tokens", "score", "text"?}]}.
                           Beam occupies the device for its search, so
                           active slots pause — a quality-first mode.
+    POST /v1/embeddings   {"input": str | [str] | [ids] | [[ids]]}
+                          + optional {"pooling": "mean" | "last"} ->
+                          pooled post-final-norm hidden states (one
+                          bucketed forward on the engine thread),
+                          OpenAI-shaped {"object": "list", "data":
+                          [{"embedding": [...], "index": i}]}
     GET  /healthz         -> engine stats (slots, queue, pages, ...)
 
 Sampling: engine-level by default (one compiled decode program). On an
@@ -72,6 +78,7 @@ import collections
 import dataclasses
 import json
 import queue
+import re
 import threading
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -200,6 +207,9 @@ def _parse_bias(req: dict):
     return lb, allowed
 
 
+_TOOL_NAME_RE = re.compile(r"[A-Za-z0-9_.-]{1,64}")
+
+
 def _parse_tools(req: dict):
     """OpenAI ``tools`` / ``tool_choice`` fields -> (ordered
     {name: tool_dict}, choice) where choice is "auto" | "none" |
@@ -227,6 +237,14 @@ def _parse_tools(req: dict):
             fn.get("name"), str
         ) or not fn["name"]:
             raise ValueError("tool.function needs a string 'name'")
+        if not _TOOL_NAME_RE.fullmatch(fn["name"]):
+            # The name is spliced into the forced-call regex AND into
+            # JSON output; outside this set a forced FSM could only
+            # emit an unparseable envelope.
+            raise ValueError(
+                f"tool name {fn['name']!r} must match "
+                "[A-Za-z0-9_.-]{1,64}"
+            )
         if fn["name"] in out:
             raise ValueError(f"duplicate tool name {fn['name']!r}")
         params = fn.get("parameters")
@@ -394,6 +412,47 @@ class _Submission:
     json_schema: Optional[dict] = None
 
 
+def _make_embed_fn(model, pooling: str):
+    """A jitted pooled-embedding forward: (params, (b, bucket) ids,
+    (b,) lengths) -> (b, dim) pooled post-final-norm hidden states
+    (shapes specialise at trace time; the call site buckets both
+    dimensions). "mean" pools mask-aware over real positions; "last"
+    takes the final real position (decoder-style sentence embedding).
+    Models without a ``return_hidden`` forward flag (the SSM family)
+    raise at trace time -> a 400."""
+    import jax
+    import jax.numpy as jnp
+
+    def fn(params, tokens, lengths):
+        h = model(params, tokens, return_hidden=True)  # (b, s, d)
+        if pooling == "last":
+            idx = jnp.maximum(lengths - 1, 0)
+            out = h[jnp.arange(h.shape[0]), idx]
+        else:
+            mask = (
+                jnp.arange(h.shape[1])[None, :] < lengths[:, None]
+            ).astype(h.dtype)
+            out = (h * mask[:, :, None]).sum(axis=1) / jnp.maximum(
+                lengths[:, None].astype(h.dtype), 1
+            )
+        return out.astype(jnp.float32)
+
+    return jax.jit(fn)
+
+
+@dataclasses.dataclass
+class _EmbedJob:
+    """An embeddings request: pooled final-hidden-state forwards for a
+    batch of prompts. Runs on the engine thread between steps (one
+    bucketed jitted forward for the whole batch) — like beam, it
+    occupies the device briefly; unlike beam, a single memory-bound
+    forward."""
+
+    rows: list  # list of token-id lists
+    pooling: str  # "mean" | "last"
+    waiter: _Waiter
+
+
 @dataclasses.dataclass
 class _BeamJob:
     """A beam-search request. Runs on the engine thread between steps
@@ -433,6 +492,7 @@ class EngineRunner:
         # Compiled beam searchers, keyed (num_beams, max_new, penalty,
         # prompt bucket) — each key compiles once, like prefill buckets.
         self._beam_fns: dict = {}
+        self._embed_fns: dict = {}
         # The ONE submission currently between inbox-pop and waiter
         # registration on the engine thread, and whether its caller
         # abandoned it meanwhile. Registration checks the flag and
@@ -555,6 +615,29 @@ class EngineRunner:
         if not w.event.wait(timeout):
             self._abandon(w)
             raise TimeoutError(f"no beam result within {timeout}s")
+        if w.error is not None:
+            raise w.error
+        return w.completion
+
+    def embed(self, rows, pooling: str = "mean",
+              timeout: Optional[float] = None):
+        """Pooled final-hidden-state embeddings for a batch of prompts
+        on the engine thread. Returns (len(rows), dim) float32."""
+        w = _Waiter(threading.Event())
+        with self._lock:
+            if self.fatal is not None:
+                raise RuntimeError(
+                    f"engine thread died: {self.fatal!r}"
+                ) from self.fatal
+            if self._stop.is_set():
+                raise RuntimeError("engine runner is shut down")
+            self._inbox.append(
+                _EmbedJob([list(r) for r in rows], pooling, w)
+            )
+        self._wake.set()
+        if not w.event.wait(timeout):
+            self._abandon(w)
+            raise TimeoutError(f"no embeddings within {timeout}s")
         if w.error is not None:
             raise w.error
         return w.completion
@@ -695,6 +778,9 @@ class EngineRunner:
     # executables without limit. Each miss still stalls the engine loop
     # for its compile; the beam API is a quality-first mode, documented.
     _BEAM_CACHE_MAX = 8
+    # Bounded by construction: #seq-buckets x log2(64) batch shapes x
+    # 2 poolings — a roomier cap than beam's since keys are cheap.
+    _EMBED_CACHE_MAX = 32
 
     def _run_beam(self, job: _BeamJob) -> None:
         import numpy as np
@@ -740,15 +826,60 @@ class EngineRunner:
         except Exception as e:
             job.waiter.fail(e)
 
+    def _run_embed(self, job: _EmbedJob) -> None:
+        import numpy as np
+
+        eng = self.engine
+        try:
+            if not job.rows or any(not r for r in job.rows):
+                raise ValueError("input must be non-empty prompts")
+            longest = max(len(r) for r in job.rows)
+            bucket = next(
+                (b for b in eng.buckets if b >= longest), None
+            )
+            if bucket is None:
+                raise ValueError(
+                    f"input of {longest} tokens exceeds the largest "
+                    f"prefill bucket {eng.buckets[-1]}"
+                )
+            # Pad the BATCH dimension to a power of two as well: an
+            # exact-size key would compile a fresh program per novel
+            # input count (up to 64, each stalling decode traffic on
+            # the engine thread). Padded rows have length 0 and are
+            # sliced off the result.
+            b = len(job.rows)
+            bpad = 1
+            while bpad < b:
+                bpad *= 2
+            key = (bucket, bpad, job.pooling)
+            fn = self._embed_fns.get(key)
+            if fn is None:
+                fn = _make_embed_fn(eng.model, job.pooling)
+                while len(self._embed_fns) >= self._EMBED_CACHE_MAX:
+                    self._embed_fns.pop(next(iter(self._embed_fns)))
+                self._embed_fns[key] = fn
+            padded = np.zeros((bpad, bucket), np.int32)
+            lengths = np.zeros((bpad,), np.int32)
+            for i, r in enumerate(job.rows):
+                padded[i, : len(r)] = r
+                lengths[i] = len(r)
+            out = np.asarray(fn(eng.params, padded, lengths), np.float32)
+            job.waiter.complete(out[:b])
+        except Exception as e:
+            job.waiter.fail(e)
+
     def _drain_inbox(self) -> None:
         while True:
             with self._lock:
                 if not self._inbox:
                     return
                 sub = self._inbox.popleft()
-                if not isinstance(sub, _BeamJob):
+                if not isinstance(sub, (_BeamJob, _EmbedJob)):
                     self._inflight = sub.waiter
                     self._inflight_abandoned = False
+            if isinstance(sub, _EmbedJob):
+                self._run_embed(sub)
+                continue
             if isinstance(sub, _BeamJob):
                 # Outside the lock: the search occupies the device but
                 # must not block submitters.
@@ -858,6 +989,13 @@ class _Handler(BaseHTTPRequestHandler):
     tokenizer = None
     default_max_new: int = 128
     request_timeout_s: Optional[float] = None
+    # Probed once per server (set on the per-server BoundHandler
+    # subclass; a benign race — concurrent probes compute the same
+    # value): does apply_chat_template accept a tools kwarg, and does
+    # the template actually RENDER tools (identical with/without ids
+    # mean it ignores them).
+    _tools_kwarg_ok: Optional[bool] = None
+    _template_uses_tools: Optional[bool] = None
 
     def log_message(self, fmt, *args):  # quiet by default
         pass
@@ -901,8 +1039,87 @@ class _Handler(BaseHTTPRequestHandler):
             self._handle_completions(chat=False)
         elif self.path == "/v1/chat/completions":
             self._handle_completions(chat=True)
+        elif self.path == "/v1/embeddings":
+            self._handle_embeddings()
         else:
             self._send(404, {"error": f"no route {self.path}"})
+
+    _EMBED_MAX_INPUTS = 64
+
+    def _handle_embeddings(self):
+        """POST /v1/embeddings: {"input": str | [str] | [int] | [[int]]}
+        + optional {"pooling": "mean" | "last"} -> OpenAI-shaped
+        {"object": "list", "data": [{"embedding": [...], "index": i}]}.
+        Pooled post-final-norm hidden states from ONE bucketed forward
+        on the engine thread ("mean" mask-aware by default)."""
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            req = json.loads(self.rfile.read(length) or b"{}")
+        except (ValueError, json.JSONDecodeError):
+            self._send(400, {"error": "body must be JSON"})
+            return
+        try:
+            inp = req.get("input")
+            if isinstance(inp, str):
+                inp = [inp]
+            if isinstance(inp, list) and inp and all(
+                isinstance(t, int) and not isinstance(t, bool)
+                for t in inp
+            ):
+                inp = [inp]  # a single token-id row
+            if not isinstance(inp, list) or not inp:
+                raise ValueError(
+                    "'input' must be a string, a list of strings, a "
+                    "token-id list, or a list of token-id lists"
+                )
+            if len(inp) > self._EMBED_MAX_INPUTS:
+                raise ValueError(
+                    f"at most {self._EMBED_MAX_INPUTS} inputs per "
+                    "request"
+                )
+            pooling = req.get("pooling", "mean")
+            if pooling not in ("mean", "last"):
+                raise ValueError('pooling must be "mean" or "last"')
+            rows = []
+            for item in inp:
+                if isinstance(item, str):
+                    if self.tokenizer is None:
+                        raise ValueError(
+                            "no tokenizer configured; send token ids"
+                        )
+                    rows.append(self.tokenizer.encode(item))
+                elif isinstance(item, list) and item and all(
+                    isinstance(t, int) and not isinstance(t, bool)
+                    for t in item
+                ):
+                    rows.append(item)
+                else:
+                    raise ValueError(
+                        f"input item {item!r} is neither a string nor "
+                        "a token-id list"
+                    )
+            out = self.runner.embed(
+                rows, pooling, timeout=self.request_timeout_s
+            )
+        except (ValueError, TypeError) as e:
+            self._send(400, {"error": str(e)})
+            return
+        except TimeoutError as e:
+            self._send(504, {"error": str(e)})
+            return
+        except RuntimeError as e:
+            self._send(503, {"error": str(e)})
+            return
+        n_tok = sum(len(r) for r in rows)
+        self._send(200, {
+            "object": "list",
+            "data": [
+                {"object": "embedding", "index": i,
+                 "embedding": [float(x) for x in out[i]]}
+                for i in range(len(rows))
+            ],
+            "usage": {"prompt_tokens": n_tok, "total_tokens": n_tok},
+        })
 
     def _chat_tokens(self, messages, tools=None):
         """Render a chat message list to prompt token ids.
@@ -976,28 +1193,45 @@ class _Handler(BaseHTTPRequestHandler):
             # without it the model would continue the user turn
             # instead of answering it.
             if tools:
-                try:
+                cls = type(self)
+                if cls._tools_kwarg_ok is None:
+                    # One-time SIGNATURE probe — catching TypeError
+                    # around the render itself would misread template-
+                    # execution failures (which must 400) as "no tools
+                    # kwarg".
+                    import inspect
+
+                    try:
+                        sig = inspect.signature(apply)
+                        cls._tools_kwarg_ok = (
+                            "tools" in sig.parameters
+                            or any(
+                                p.kind is inspect.Parameter.VAR_KEYWORD
+                                for p in sig.parameters.values()
+                            )
+                        )
+                    except (TypeError, ValueError):
+                        cls._tools_kwarg_ok = True  # uninspectable: try
+                if cls._tools_kwarg_ok:
                     with_tools = [
                         int(t) for t in apply(
                             messages, add_generation_prompt=True,
                             tools=tools,
                         )
                     ]
-                    without = [
-                        int(t)
-                        for t in apply(
-                            messages, add_generation_prompt=True
-                        )
-                    ]
-                    # A template that never references tools renders
-                    # IDENTICAL ids with and without them (transformers
-                    # does not error — the schemas would silently reach
-                    # the model nowhere). Only a differing render
-                    # proves native tool templating.
-                    if with_tools != without:
+                    if cls._template_uses_tools is None:
+                        # A template that never references tools
+                        # renders IDENTICAL ids with and without them
+                        # (transformers does not error — the schemas
+                        # would silently reach the model nowhere).
+                        # Template-property, probed once per server.
+                        cls._template_uses_tools = with_tools != [
+                            int(t) for t in apply(
+                                messages, add_generation_prompt=True
+                            )
+                        ]
+                    if cls._template_uses_tools:
                         return with_tools
-                except TypeError:
-                    pass  # adapter predates the tools kwarg
                 # Fall back to a plain system block carrying the
                 # schemas.
                 messages = (

@@ -1,0 +1,175 @@
+"""/v1/embeddings: pooled final-hidden-state embeddings.
+
+One bucketed jitted forward per request batch on the engine thread
+(infer/server.py _run_embed / _make_embed_fn): "mean" pools mask-aware
+over real positions, "last" takes the final real position. Pinned:
+
+  * values match a direct ``model(..., return_hidden=True)`` numpy
+    pool for both poolings;
+  * ragged batches: each row equals its solo embedding (padding never
+    leaks into the pool);
+  * string inputs tokenize through the server tokenizer; token-id
+    rows pass through; the single-row shorthand works;
+  * embeddings answer while decode traffic is in flight (the job
+    interleaves between engine steps);
+  * validation 400s: empty/oversize/unknown pooling/bad items, and
+    the SSM family (no return_hidden flag) refuses cleanly.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+
+from shifu_tpu.data.tokenizer import ByteTokenizer
+from shifu_tpu.infer import PagedEngine, SampleConfig, make_server
+from shifu_tpu.models import Transformer, TransformerConfig
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    model = Transformer(TransformerConfig.tiny())
+    return model, model.init(jax.random.key(0))
+
+
+_TOK = ByteTokenizer()
+
+
+@pytest.fixture()
+def served(tiny):
+    model, params = tiny
+    engine = PagedEngine(
+        model, params, max_slots=2, max_len=64, page_size=8,
+        sample_cfg=SampleConfig(temperature=0.0), tokenizer=_TOK,
+        prefill_buckets=(16, 32, 64),
+    )
+    server = make_server(engine, port=0, tokenizer=_TOK)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    try:
+        yield f"http://127.0.0.1:{server.server_port}"
+    finally:
+        server.shutdown()
+        server.runner.shutdown()
+        t.join(5)
+
+
+def _post(base, path, obj, timeout=300):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(obj).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _ref(model, params, rows, pooling):
+    """Direct full-forward reference pool (numpy, per row)."""
+    out = []
+    for r in rows:
+        h = np.asarray(
+            model(params, np.asarray([r], np.int32), return_hidden=True),
+            np.float32,
+        )[0]
+        out.append(h[-1] if pooling == "last" else h.mean(axis=0))
+    return np.stack(out)
+
+
+def test_matches_direct_forward(served, tiny):
+    model, params = tiny
+    rows = [[5, 6, 7, 8], [200, 100, 50]]
+    for pooling in ("mean", "last"):
+        status, out = _post(served, "/v1/embeddings",
+                            {"input": rows, "pooling": pooling})
+        assert status == 200
+        got = np.asarray([d["embedding"] for d in out["data"]])
+        want = _ref(model, params, rows, pooling)
+        np.testing.assert_allclose(got, want, rtol=2e-2, atol=2e-2)
+        assert [d["index"] for d in out["data"]] == [0, 1]
+    assert out["usage"]["prompt_tokens"] == 7
+
+
+def test_ragged_batch_equals_solo(served):
+    rows = [[9, 8, 7, 6, 5, 4, 3, 2], [11, 12]]
+    _, batch = _post(served, "/v1/embeddings", {"input": rows})
+    for i, r in enumerate(rows):
+        _, solo = _post(served, "/v1/embeddings", {"input": r})
+        np.testing.assert_allclose(
+            batch["data"][i]["embedding"], solo["data"][0]["embedding"],
+            rtol=1e-5, atol=1e-5,
+        )
+
+
+def test_string_input(served):
+    status, out = _post(served, "/v1/embeddings", {"input": "hello"})
+    assert status == 200
+    status2, ref = _post(served, "/v1/embeddings",
+                         {"input": _TOK.encode("hello")})
+    np.testing.assert_allclose(
+        out["data"][0]["embedding"], ref["data"][0]["embedding"]
+    )
+
+
+def test_embeddings_interleave_with_decode(served):
+    # Submit a long-ish completion, then embeddings mid-flight.
+    done = {}
+
+    def completion():
+        _, done["c"] = _post(served, "/v1/completions",
+                             {"tokens": [1, 2, 3], "max_new_tokens": 40})
+
+    t = threading.Thread(target=completion)
+    t.start()
+    status, out = _post(served, "/v1/embeddings", {"input": [[4, 5, 6]]})
+    assert status == 200 and len(out["data"]) == 1
+    t.join(60)
+    assert done["c"]["usage"]["completion_tokens"] == 40
+
+
+def test_validation(served):
+    for body, needle in [
+        ({}, "input"),
+        ({"input": []}, "input"),
+        ({"input": [[1, 2], "x", 3]}, "neither"),
+        ({"input": [1, 2], "pooling": "max"}, "pooling"),
+        ({"input": [[1] * 200]}, "bucket"),
+        ({"input": [[1, 2]] * 65}, "at most"),
+    ]:
+        status, out = _post(served, "/v1/embeddings", body)
+        assert status == 400, (body, out)
+        assert needle in out["error"], (needle, out["error"])
+
+
+def test_ssm_family_refuses(tiny):
+    from shifu_tpu.models.mamba import Mamba, MambaConfig
+
+    model = Mamba(MambaConfig.tiny())
+    params = model.init(jax.random.key(0))
+    from shifu_tpu.infer.engine import Engine
+
+    engine = Engine(
+        model, params, max_slots=1, max_len=32,
+        sample_cfg=SampleConfig(temperature=0.0),
+        prefill_buckets=(16, 32),
+    )
+    server = make_server(engine, port=0, tokenizer=_TOK)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    try:
+        status, out = _post(
+            f"http://127.0.0.1:{server.server_port}", "/v1/embeddings",
+            {"input": [[1, 2, 3]]},
+        )
+        assert status == 400
+    finally:
+        server.shutdown()
+        server.runner.shutdown()
+        t.join(5)

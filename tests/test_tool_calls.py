@@ -230,6 +230,9 @@ def test_validation_400s(served):
         ({"messages": _MSGS, "tools": []}, "tools"),
         ({"messages": _MSGS, "tools": [{"type": "function",
                                         "function": {}}]}, "name"),
+        ({"messages": _MSGS, "tools": [{"type": "function",
+                                        "function": {"name": 'a"b'}}]},
+         "must match"),
         ({"messages": _MSGS, "tools": [_WEATHER],
           "tool_choice": {"type": "function",
                           "function": {"name": "nope"}}}, "unknown"),
