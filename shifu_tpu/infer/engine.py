@@ -2425,6 +2425,10 @@ class PagedEngine(Engine):
         w = getattr(self.model.cfg, "window_size", None)
         if not w:
             return
+        if getattr(self.model.cfg, "window_pattern", None) is not None:
+            # Alternating windows (Gemma-2): the full-attention layers
+            # read EVERY page — nothing behind the window is dead.
+            return
         pages = self._slot_pages.get(slot)
         if not pages:
             return

@@ -172,6 +172,51 @@ def config_from_hf_llama(hf_config, **overrides) -> TransformerConfig:
             else None
         ),
     )
+    model_type = getattr(hf_config, "model_type", "")
+    if model_type == "qwen3":
+        # Qwen3 = the Llama layout + per-head q/k RMS norms, no qkv
+        # biases (attention_bias False is the config default — handled
+        # by the generic qkv_bias line above).
+        kw["qk_norm"] = True
+    if model_type == "gemma2":
+        act = getattr(hf_config, "hidden_activation", "gelu_pytorch_tanh")
+        if act not in ("gelu_pytorch_tanh", "gelu_tanh"):
+            raise NotImplementedError(
+                f"gemma2 hidden_activation {act!r} (expected "
+                "gelu_pytorch_tanh)"
+            )
+        kw.update(
+            attn_softcap=(
+                None
+                if hf_config.attn_logit_softcapping is None
+                else float(hf_config.attn_logit_softcapping)
+            ),
+            final_softcap=(
+                None
+                if hf_config.final_logit_softcapping is None
+                else float(hf_config.final_logit_softcapping)
+            ),
+            attn_scale=float(hf_config.query_pre_attn_scalar),
+            mlp_act="gelu_tanh",
+            post_norms=True,
+            embed_scale=True,
+            # Sliding attention on EVEN layers, full on odd
+            # (layer_types in the HF config; the alternation is the
+            # architecture, pattern 2 with offset 0).
+            window_pattern=2 if hf_config.sliding_window else None,
+        )
+        lt = getattr(hf_config, "layer_types", None)
+        if lt is not None and hf_config.sliding_window:
+            want = [
+                "sliding_attention" if i % 2 == 0 else "full_attention"
+                for i in range(len(lt))
+            ]
+            if list(lt) != want:
+                raise NotImplementedError(
+                    "gemma2 layer_types deviates from the alternating "
+                    "even-sliding pattern window_pattern=2 encodes: "
+                    f"{list(lt)[:6]}..."
+                )
     kw.update(overrides)
     return TransformerConfig(**kw)
 
@@ -201,12 +246,22 @@ def params_from_hf_llama(
             dtype,
         )
 
+    # Norm-gain convention: Llama-family HF norms store the FULL gain
+    # (our zero-centred storage subtracts 1); Gemma-family norms
+    # already store 1+w zero-centred (Gemma2RMSNorm) — no shift. The
+    # post_norms flag marks the Gemma block shape, which also renames
+    # the FFN norms (post_attention_layernorm is the attention
+    # SANDWICH norm there, not the pre-FFN norm).
+    nsub = 0.0 if cfg.post_norms else 1.0
     blocks = {
         "attn_norm": stack(
-            "layers.{}.input_layernorm.weight", lambda w: w - 1.0
+            "layers.{}.input_layernorm.weight", lambda w: w - nsub
         ),
         "mlp_norm": stack(
-            "layers.{}.post_attention_layernorm.weight", lambda w: w - 1.0
+            "layers.{}.pre_feedforward_layernorm.weight"
+            if cfg.post_norms
+            else "layers.{}.post_attention_layernorm.weight",
+            lambda w: w - nsub,
         ),
         # torch Linear weight (out, in): transpose, then split the out dim
         # heads-major.
@@ -263,6 +318,22 @@ def params_from_hf_llama(
         blocks["w_down"] = stack(
             "layers.{}.mlp.down_proj.weight", lambda w: w.T
         )
+    if cfg.post_norms:
+        blocks["post_attn_norm"] = stack(
+            "layers.{}.post_attention_layernorm.weight",
+            lambda w: w - nsub,
+        )
+        blocks["post_mlp_norm"] = stack(
+            "layers.{}.post_feedforward_layernorm.weight",
+            lambda w: w - nsub,
+        )
+    if cfg.qk_norm:
+        blocks["q_norm"] = stack(
+            "layers.{}.self_attn.q_norm.weight", lambda w: w - 1.0
+        )
+        blocks["k_norm"] = stack(
+            "layers.{}.self_attn.k_norm.weight", lambda w: w - 1.0
+        )
     if cfg.qkv_bias:
         blocks["bq"] = stack(
             "layers.{}.self_attn.q_proj.bias", lambda b: b.reshape(h, hd)
@@ -276,7 +347,7 @@ def params_from_hf_llama(
     params = {
         "embed": jnp.asarray(get("embed_tokens.weight"), dtype),
         "blocks": blocks,
-        "final_norm": jnp.asarray(get("norm.weight") - 1.0, dtype),
+        "final_norm": jnp.asarray(get("norm.weight") - nsub, dtype),
     }
     if not cfg.tie_embeddings:
         params["unembed"] = jnp.asarray(get("lm_head.weight").T, dtype)
@@ -323,13 +394,32 @@ def to_hf_llama_state_dict(params, cfg: TransformerConfig):
     def np_(x):
         return np.asarray(x, np.float32)
 
+    nsub = 0.0 if cfg.post_norms else 1.0  # params_from_hf_llama note
     sd = {"model.embed_tokens.weight": np_(params["embed"])}
     for l in range(L):
         p = f"model.layers.{l}."
-        sd[p + "input_layernorm.weight"] = np_(blocks["attn_norm"][l]) + 1.0
-        sd[p + "post_attention_layernorm.weight"] = (
-            np_(blocks["mlp_norm"][l]) + 1.0
-        )
+        sd[p + "input_layernorm.weight"] = np_(blocks["attn_norm"][l]) + nsub
+        if cfg.post_norms:
+            sd[p + "pre_feedforward_layernorm.weight"] = (
+                np_(blocks["mlp_norm"][l]) + nsub
+            )
+            sd[p + "post_attention_layernorm.weight"] = (
+                np_(blocks["post_attn_norm"][l]) + nsub
+            )
+            sd[p + "post_feedforward_layernorm.weight"] = (
+                np_(blocks["post_mlp_norm"][l]) + nsub
+            )
+        else:
+            sd[p + "post_attention_layernorm.weight"] = (
+                np_(blocks["mlp_norm"][l]) + nsub
+            )
+        if cfg.qk_norm:
+            sd[p + "self_attn.q_norm.weight"] = (
+                np_(blocks["q_norm"][l]) + 1.0
+            )
+            sd[p + "self_attn.k_norm.weight"] = (
+                np_(blocks["k_norm"][l]) + 1.0
+            )
         sd[p + "self_attn.q_proj.weight"] = (
             np_(blocks["wq"][l]).reshape(d, h * hd).T
         )
@@ -364,7 +454,7 @@ def to_hf_llama_state_dict(params, cfg: TransformerConfig):
             sd[p + "self_attn.v_proj.bias"] = np_(blocks["bv"][l]).reshape(
                 kv * hd
             )
-    sd["model.norm.weight"] = np_(params["final_norm"]) + 1.0
+    sd["model.norm.weight"] = np_(params["final_norm"]) + nsub
     if cfg.tie_embeddings:
         # torch state_dicts list tied params under BOTH names; omitting
         # lm_head.weight would fail the documented load_state_dict call.

@@ -104,6 +104,32 @@ class TransformerConfig:
     window_size: Optional[int] = None
     # Biases on the q/k/v projections (Qwen2 convention: qkv yes, o no).
     qkv_bias: bool = False
+    # Per-head RMS norm on q and k before rope (Qwen3 convention).
+    qk_norm: bool = False
+    # -- Gemma-2 family conventions ------------------------------------------
+    # tanh soft-capping: scores -> cap * tanh(scores / cap), applied to
+    # the attention logits BEFORE the causal mask (attn_softcap) and to
+    # the output logits (final_softcap). None = off.
+    attn_softcap: Optional[float] = None
+    final_softcap: Optional[float] = None
+    # Attention score scale DIVISOR override: scores scale by
+    # attn_scale**-0.5 instead of head_dim**-0.5 (Gemma-2's
+    # query_pre_attn_scalar, which its 9b sets != head_dim).
+    attn_scale: Optional[float] = None
+    # FFN activation: "silu" (Llama) or "gelu_tanh" (Gemma's
+    # gelu_pytorch_tanh = jax.nn.gelu(approximate=True)).
+    mlp_act: str = "silu"
+    # Sandwich norms (Gemma-2): extra RMS norms on the attention and
+    # FFN OUTPUTS before their residual adds.
+    post_norms: bool = False
+    # Scale token embeddings by sqrt(dim) (Gemma convention; the
+    # normalizer is computed in the activation dtype, matching HF).
+    embed_scale: bool = False
+    # Alternating sliding-window attention: layer i is windowed iff
+    # i % window_pattern == 0 (Gemma-2: pattern 2 — sliding on even
+    # layers, full attention on odd). None = window_size (if any)
+    # applies to every layer.
+    window_pattern: Optional[int] = None
 
     @property
     def resolved_head_dim(self) -> int:
@@ -126,6 +152,44 @@ class TransformerConfig:
             )
         if self.window_size is not None and self.window_size < 1:
             raise ValueError(f"window_size={self.window_size} must be >= 1")
+        if self.mlp_act not in ("silu", "gelu_tanh"):
+            raise ValueError(
+                f"mlp_act={self.mlp_act!r} (want 'silu' or 'gelu_tanh')"
+            )
+        if self.window_pattern is not None:
+            if self.window_size is None:
+                raise ValueError(
+                    "window_pattern needs window_size (which layers "
+                    "would it alternate?)"
+                )
+            if self.window_pattern < 2:
+                raise ValueError(
+                    f"window_pattern={self.window_pattern} must be >= 2 "
+                    "(1 means every layer — use plain window_size)"
+                )
+            if self.attn_impl != "xla":
+                # The flash/ring kernels pick their block-skip grids
+                # from a STATIC window; per-layer alternation rides a
+                # traced layer index through lax.cond'd XLA attention.
+                raise ValueError(
+                    "window_pattern requires attn_impl='xla'"
+                )
+        if self.attn_softcap is not None and self.attn_impl == "flash":
+            raise ValueError(
+                "attn_softcap is not implemented in the flash kernel; "
+                "use attn_impl='xla'"
+            )
+        if self.final_softcap is not None and self.fused_ce:
+            raise ValueError(
+                "final_softcap does not compose with fused_ce (the "
+                "fused kernel never materialises the logits the cap "
+                "transforms)"
+            )
+        if self.mlp_act != "silu" and self.n_experts:
+            raise ValueError(
+                "mlp_act applies to the dense FFN only; the expert "
+                "path is SwiGLU"
+            )
 
     # -- presets --------------------------------------------------------------
     @classmethod
@@ -200,6 +264,23 @@ def _block_specs(cfg: TransformerConfig):
         ),
         "mlp_norm": ParamSpec((L, d), ("layers", "embed"), initializers.zeros),
     }
+    if cfg.qk_norm:
+        # Per-head RMS gains over head_dim, shared across heads'
+        # positions (Qwen3: one (head_dim,) gain per layer for q, one
+        # for k).
+        specs["q_norm"] = ParamSpec(
+            (L, hd), ("layers", "head_dim"), initializers.zeros
+        )
+        specs["k_norm"] = ParamSpec(
+            (L, hd), ("layers", "head_dim"), initializers.zeros
+        )
+    if cfg.post_norms:
+        specs["post_attn_norm"] = ParamSpec(
+            (L, d), ("layers", "embed"), initializers.zeros
+        )
+        specs["post_mlp_norm"] = ParamSpec(
+            (L, d), ("layers", "embed"), initializers.zeros
+        )
     if cfg.qkv_bias:
         specs["bq"] = ParamSpec(
             (L, h, hd), ("layers", "heads", "head_dim"), initializers.zeros
@@ -274,6 +355,36 @@ class Transformer(Module):
         return s
 
     # ------------------------------------------------------------- one block
+    def _layer_window(self, layer_idx):
+        """This layer's effective sliding window: None (no window),
+        the static config window, or — with ``window_pattern`` — a
+        TRACED scalar that disables the window on non-pattern layers
+        (a huge width; the mask comparisons it feeds broadcast traced
+        values fine, which is what lets alternation ride the layer
+        scan without lax.cond'ing whole attention calls)."""
+        cfg = self.cfg
+        if cfg.window_size is None:
+            return None
+        if cfg.window_pattern is None:
+            return cfg.window_size
+        if layer_idx is None:
+            raise ValueError(
+                "window_pattern needs a per-layer index; this call "
+                "path (pipeline blocks_fn) does not thread one"
+            )
+        return jnp.where(
+            layer_idx % cfg.window_pattern == 0,
+            jnp.int32(cfg.window_size),
+            jnp.int32(1 << 30),
+        )
+
+    @property
+    def _attn_scale(self):
+        cfg = self.cfg
+        return (
+            None if cfg.attn_scale is None else cfg.attn_scale ** -0.5
+        )
+
     def _block(
         self, p, h, sin, cos, segment_ids, cache_slice, cache_index,
         kv_mask=None, page_table=None, layer_idx=None, lora_slice=None,
@@ -337,13 +448,19 @@ class Transformer(Module):
             q = q + p["bq"]
             k = k + p["bk"]
             v = v + p["bv"]
+        if cfg.qk_norm:
+            # Per-head RMS over head_dim BEFORE rope (Qwen3 order).
+            q = rms_norm(q, p["q_norm"], eps=cfg.norm_eps)
+            k = rms_norm(k, p["k_norm"], eps=cfg.norm_eps)
         q = apply_rope(q, sin, cos)
         k = apply_rope(k, sin, cos)
 
+        win = self._layer_window(layer_idx)
         if cache_slice is None:
             attn = dot_product_attention(
                 q, k, v, causal=True, segment_ids=segment_ids,
-                impl=cfg.attn_impl, window=cfg.window_size,
+                impl=cfg.attn_impl, window=win,
+                scale=self._attn_scale, softcap=cfg.attn_softcap,
             )
             # Named for the selective remat policies ("flash" /
             # "dots_flash"): saving this one (b, s, h, hd) tensor per
@@ -413,7 +530,8 @@ class Transformer(Module):
                 # masked cache path below.
                 attn = dot_product_attention(
                     q, k, v, causal=True, impl=cfg.attn_impl,
-                    window=cfg.window_size,
+                    window=win,
+                    scale=self._attn_scale, softcap=cfg.attn_softcap,
                 )
             else:
                 # Single-token decode (or chunked prefill at a traced
@@ -423,7 +541,8 @@ class Transformer(Module):
                 # the mask is built in slot space with a query offset.
                 attn = _decode_attention(
                     q, ck, cv, cache_index, cfg.attn_impl, kv_mask=kv_mask,
-                    window=cfg.window_size,
+                    window=win,
+                    scale=self._attn_scale, softcap=cfg.attn_softcap,
                 )
             new_cache = {"k": ck, "v": cv}
 
@@ -431,6 +550,10 @@ class Transformer(Module):
         do = lora_delta("wo", attn.reshape(*attn.shape[:2], -1))
         if do is not None:
             o = o + do
+        if cfg.post_norms:
+            # Sandwich norm (Gemma-2): normalise the attention OUTPUT
+            # before its residual add.
+            o = rms_norm(o, p["post_attn_norm"], eps=cfg.norm_eps)
         h = h + o
 
         x = rms_norm(h, p["mlp_norm"], eps=cfg.norm_eps)
@@ -458,12 +581,18 @@ class Transformer(Module):
                         gate = gate + d
                     else:
                         up = up + d
-            act = jax.nn.silu(gate) * up
+            act = (
+                jax.nn.gelu(gate, approximate=True)
+                if cfg.mlp_act == "gelu_tanh"
+                else jax.nn.silu(gate)
+            ) * up
             down = jnp.einsum("bsm,md->bsd", act, p["w_down"])
             dd = lora_delta("w_down", act)
             if dd is not None:
                 down = down + dd
             moe_aux = None
+        if cfg.post_norms:
+            down = rms_norm(down, p["post_mlp_norm"], eps=cfg.norm_eps)
         h = h + down
         h = constrain(h, ("batch", "seq", "act_embed"))
         return h, new_cache, moe_aux
@@ -581,6 +710,7 @@ class Transformer(Module):
                 attn = paged_decode_attention(
                     q, ck, cv, page_table, cache_index, layer=li,
                     window=self.cfg.window_size, kv_mask=kv_mask,
+                    scale=self._attn_scale,
                     k_scale=csk if quantized else None,
                     v_scale=csv if quantized else None,
                     int8_qk=quantized and self.cfg.int8_qk_dot,
@@ -595,7 +725,9 @@ class Transformer(Module):
                 gv = gv.reshape(b, pages_per_row * ps, n_kv, hd)
                 attn = _decode_attention(
                     q, gk, gv, cache_index, self.cfg.attn_impl,
-                    kv_mask=kv_mask, window=self.cfg.window_size,
+                    kv_mask=kv_mask, window=self._layer_window(li),
+                    scale=self._attn_scale,
+                    softcap=self.cfg.attn_softcap,
                 )
             new_pool = {"k": ck, "v": cv}
             if quantized:
@@ -639,7 +771,9 @@ class Transformer(Module):
                     csv = csv.at[li, phys].set(vs_block)
                 attn = dot_product_attention(
                     q, k, v, causal=True, impl=self.cfg.attn_impl,
-                    window=self.cfg.window_size,
+                    window=self._layer_window(li),
+                    scale=self._attn_scale,
+                    softcap=self.cfg.attn_softcap,
                 )
             else:
                 # Page-aligned suffix prefill at a traced offset: the
@@ -665,7 +799,9 @@ class Transformer(Module):
                 gv = gv.reshape(b, page_table.shape[1] * ps, n_kv, hd)
                 attn = _decode_attention(
                     q, gk, gv, cache_index, self.cfg.attn_impl,
-                    window=self.cfg.window_size,
+                    window=self._layer_window(li),
+                    scale=self._attn_scale,
+                    softcap=self.cfg.attn_softcap,
                 )
         else:
             if getattr(cache_index, "ndim", 0) != 1:
@@ -701,6 +837,7 @@ class Transformer(Module):
                 attn = paged_decode_attention(
                     q[:, 0], ck, cv, page_table, cache_index, layer=li,
                     window=self.cfg.window_size, kv_mask=kv_mask,
+                    scale=self._attn_scale,
                     k_scale=csk if quantized else None,
                     v_scale=csv if quantized else None,
                     int8_qk=quantized and self.cfg.int8_qk_dot,
@@ -720,7 +857,9 @@ class Transformer(Module):
                 gv = gv.reshape(b, pages_per_row * ps, n_kv, hd)
                 attn = _decode_attention(
                     q, gk, gv, cache_index, self.cfg.attn_impl,
-                    kv_mask=kv_mask, window=self.cfg.window_size,
+                    kv_mask=kv_mask, window=self._layer_window(li),
+                    scale=self._attn_scale,
+                    softcap=self.cfg.attn_softcap,
                 )
         new_pool = {"k": ck, "v": cv}
         if quantized:
@@ -864,6 +1003,12 @@ class Transformer(Module):
             else p["embed"]
         )
         h = jnp.take(w_embed, tokens, axis=0)
+        if cfg.embed_scale:
+            # Gemma convention: normalizer computed in the activation
+            # dtype (HF casts the sqrt(dim) tensor to hidden dtype).
+            h = h * jnp.asarray(cfg.dim, h.dtype) ** jnp.asarray(
+                0.5, h.dtype
+            )
         h = constrain(h, ("batch", "seq", "act_embed"))
 
         if positions is None:
@@ -921,16 +1066,19 @@ class Transformer(Module):
                     h, auxes = out, None
             else:
                 def body(carry, xs):
-                    layer_p, tab = xs
+                    layer_p, li, tab = xs
                     out, _, aux = block(
                         layer_p, carry, sin, cos, segment_ids, None,
-                        None, lora_slice=(
+                        None, layer_idx=li, lora_slice=(
                             (tab, lora_rows) if tab is not None else None
                         ),
                     )
                     return out, aux
 
-                h, auxes = jax.lax.scan(body, h, (p["blocks"], lora_tabs))
+                h, auxes = jax.lax.scan(
+                    body, h,
+                    (p["blocks"], jnp.arange(cfg.n_layers), lora_tabs),
+                )
             new_cache = None
         else:
             if return_aux:
@@ -965,17 +1113,20 @@ class Transformer(Module):
                 )
             else:
                 def body(carry, xs):
-                    layer_p, cache_slice, tab = xs
+                    layer_p, cache_slice, li, tab = xs
                     out, new_slice, aux = block(
                         layer_p, carry, sin, cos, None, cache_slice,
-                        cache_index, kv_mask, page_table, lora_slice=(
+                        cache_index, kv_mask, page_table,
+                        layer_idx=li, lora_slice=(
                             (tab, lora_rows) if tab is not None else None
                         ),
                     )
                     return out, (new_slice, aux)
 
                 h, (new_cache, auxes) = jax.lax.scan(
-                    body, h, (p["blocks"], cache, lora_tabs)
+                    body, h,
+                    (p["blocks"], cache, jnp.arange(cfg.n_layers),
+                     lora_tabs),
                 )
 
         h = rms_norm(h, p["final_norm"], eps=cfg.norm_eps)
@@ -1001,6 +1152,13 @@ class Transformer(Module):
         else:
             w_un = dequantize_tree(p["unembed"], h.dtype)
             logits = jnp.einsum("bsd,dv->bsv", h, w_un)
+        if cfg.final_softcap is not None:
+            # Gemma-2 final logit soft-capping, tanh in f32 (bf16 tanh
+            # near the cap loses the top-1 ordering the cap preserves).
+            c = jnp.float32(cfg.final_softcap)
+            logits = (
+                jnp.tanh(logits.astype(jnp.float32) / c) * c
+            ).astype(logits.dtype)
         logits = constrain(logits, ("batch", "seq", "act_vocab"))
         logits = self.policy.cast_to_output(logits)
         if return_aux:
@@ -1024,6 +1182,14 @@ class Transformer(Module):
         cfg = self.cfg
         if fused_ce is None:
             fused_ce = cfg.fused_ce
+        if fused_ce and cfg.final_softcap is not None:
+            # Config validation catches cfg.fused_ce; the per-call
+            # override must not silently skip the Gemma-2 logit cap
+            # (the fused kernel never materialises the logits it
+            # transforms).
+            raise ValueError(
+                "final_softcap does not compose with fused_ce"
+            )
         tokens = batch["tokens"]
         out = self(
             params,
@@ -1206,14 +1372,17 @@ def _pallas_paged_ok() -> bool:
 
 
 def _decode_attention(q, ck, cv, cache_index, impl, kv_mask=None,
-                      window=None):
+                      window=None, scale=None, softcap=None):
     """Attention over a preallocated cache: valid keys are [0, index + q_len).
 
     Queries sit at cache slots index .. index + q_len - 1 (slot-space
     causality). ``cache_index`` may be a scalar (whole batch at one
     offset) or a (batch,) vector (continuous batching: per-slot offsets).
     ``kv_mask`` (batch, s_max) additionally hides slots that hold no real
-    token (right-padding of ragged prompts).
+    token (right-padding of ragged prompts). ``window`` may be a TRACED
+    scalar (per-layer alternation rides the layer scan); ``scale``
+    overrides head_dim**-0.5; ``softcap`` tanh-caps the scores before
+    the mask (Gemma-2).
     """
     del impl  # decode is tiny; XLA path is optimal (no S×S materialisation)
     b, q_len, n_heads, head_dim = q.shape
@@ -1222,7 +1391,9 @@ def _decode_attention(q, ck, cv, cache_index, impl, kv_mask=None,
     qg = q.reshape(b, q_len, n_kv, group, head_dim)
     scores = jnp.einsum(
         "bqhgd,bkhd->bhgqk", qg, ck, preferred_element_type=jnp.float32
-    ) * (head_dim**-0.5)
+    ) * (head_dim**-0.5 if scale is None else scale)
+    if softcap is not None:
+        scores = jnp.tanh(scores / softcap) * softcap
     kj = jnp.arange(s_max)
     if getattr(cache_index, "ndim", 0) == 1:
         qi = cache_index[:, None] + jnp.arange(q_len)[None, :]  # (b, q)

@@ -44,6 +44,7 @@ def dot_product_attention(
     segment_ids: Optional[jax.Array] = None,
     impl: str = "xla",
     window: Optional[int] = None,
+    softcap: Optional[float] = None,
 ):
     """Grouped-query attention.
 
@@ -72,6 +73,14 @@ def dot_product_attention(
     """
     if window is not None and not causal:
         raise ValueError("window requires causal attention")
+    if softcap is not None and impl != "xla":
+        # tanh capping sits between the scale and the mask; the
+        # flash/ring kernels' online-softmax inner loops do not apply
+        # it — refusing beats silently mis-scoring a Gemma-2 model.
+        raise ValueError(
+            f"attn softcap is only implemented for impl='xla', "
+            f"got {impl!r}"
+        )
     if impl == "flash":
         from shifu_tpu.ops.pallas.flash_attention import flash_attention
 
@@ -114,6 +123,10 @@ def dot_product_attention(
         "bqhgd,bkhd->bhgqk", qg, k, preferred_element_type=jnp.float32
     )
     scores = scores * scale
+    if softcap is not None:
+        # Gemma-2 tanh soft-capping: bounds the logits to (-cap, cap)
+        # BEFORE the additive mask (the -inf mask must stay -inf).
+        scores = jnp.tanh(scores / softcap) * softcap
 
     if causal:
         scores = scores + _causal_mask(q_len, kv_len, window=window)
