@@ -310,6 +310,170 @@ def cmd_dpo(args) -> int:
     return 0
 
 
+def cmd_distill(args) -> int:
+    """Knowledge distillation from a larger teacher checkpoint: the
+    teacher annotates each batch with its top-k next-token
+    log-probabilities (a separate jitted inference forward), the
+    student trains on alpha*CE + (1-alpha)*T^2*KL through the ordinary
+    sharded train stack (train/distill.py)."""
+    import contextlib
+    import itertools
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from shifu_tpu.train import (
+        DistillConfig,
+        DistillModel,
+        TrainState,
+        make_teacher_annotate_fn,
+        make_train_step,
+    )
+
+    model = _build_model(args)
+    targs = argparse.Namespace(**vars(args))
+    targs.preset = args.teacher_preset
+    targs.ckpt_dir = args.teacher_ckpt_dir
+    # Student-architecture flags must NOT leak into the teacher build —
+    # an --moe-experts student from a dense teacher checkpoint would
+    # otherwise construct an MoE teacher that cannot restore it.
+    targs.moe_experts = 0
+    teacher = _build_model(targs)
+    if teacher.cfg.vocab_size != model.cfg.vocab_size:
+        print(
+            f"teacher vocab {teacher.cfg.vocab_size} != student vocab "
+            f"{model.cfg.vocab_size}: kd indices would be silently "
+            "clamped — distillation needs a shared vocabulary",
+            file=sys.stderr,
+        )
+        return 2
+    tok = _build_tokenizer(args) if args.tokenizer else None
+    if tok is not None and tok.vocab_size > model.cfg.vocab_size:
+        print(
+            f"warning: tokenizer vocab {tok.vocab_size} exceeds model "
+            f"vocab {model.cfg.vocab_size}; ids are clipped",
+            file=sys.stderr,
+        )
+
+    rows = []
+    with open(args.data, encoding="utf-8") as f:
+        for line in f:
+            if not line.strip():
+                continue
+            obj = json.loads(line)
+            v = obj.get("tokens", obj.get("text"))
+            if isinstance(v, str):
+                if tok is None:
+                    print("string 'text' needs --tokenizer",
+                          file=sys.stderr)
+                    return 2
+                v = tok.encode(v)
+            if v:
+                rows.append([
+                    min(int(t), model.cfg.vocab_size - 1) for t in v
+                ])
+    if not rows:
+        print("no rows in --data", file=sys.stderr)
+        return 2
+
+    s = args.seq_len
+    packed, masks = [], []
+    for r in rows:
+        r = r[:s]
+        m = [1.0] * len(r) + [0.0] * (s - len(r))
+        packed.append(r + [0] * (s - len(r)))
+        masks.append(m)
+    nb = len(packed) // args.batch_size
+    if not nb:
+        print(
+            f"{len(packed)} rows cannot fill one batch of "
+            f"{args.batch_size}; lower --batch-size",
+            file=sys.stderr,
+        )
+        return 2
+
+    params = _restore_params(args, model)
+    teacher_params = _restore_params(targs, teacher)
+    dcfg = DistillConfig(
+        alpha=args.alpha, temperature=args.kd_temperature,
+        top_k=args.kd_top_k,
+    )
+    dm = DistillModel(model, dcfg)
+    optimizer = _build_optimizer(args, args.steps)
+    mesh = _build_mesh(args.mesh) if args.mesh else None
+    annotate = make_teacher_annotate_fn(teacher, dcfg)
+    with contextlib.ExitStack() as ctx:
+        if mesh is not None:
+            from shifu_tpu.parallel import shard_batch, shard_params
+            from shifu_tpu.train import state_shardings
+
+            ctx.enter_context(mesh)
+            teacher_params = shard_params(teacher, teacher_params, mesh)
+            st_shard = state_shardings(dm, mesh, optimizer=optimizer)
+            state = jax.jit(
+                lambda p: TrainState.create(p, optimizer),
+                out_shardings=st_shard,
+            )(shard_params(model, params, mesh))
+        else:
+            state = TrainState.create(
+                jax.tree_util.tree_map(lambda x: x.copy(), params),
+                optimizer,
+            )
+        step = make_train_step(dm, optimizer, mesh)
+
+        def prep(i):
+            sl = slice(i * args.batch_size, (i + 1) * args.batch_size)
+            b = {
+                "tokens": jnp.asarray(np.asarray(packed[sl], np.int32)),
+                "mask": jnp.asarray(np.asarray(masks[sl], np.float32)),
+            }
+            if mesh is not None:
+                from shifu_tpu.parallel import shard_batch
+
+                b = shard_batch(b, mesh)
+            return annotate(teacher_params, b)
+
+        # Annotate LAZILY: eagerly prepping the whole dataset would run
+        # a teacher forward per batch and hold every (b, s, k)
+        # annotation on device before step 0 — at a corpus scale where
+        # only --steps batches are ever consumed, that is unbounded
+        # wasted teacher compute + HBM. A small memo keeps the common
+        # cycle-a-tiny-dataset case to one annotation per batch.
+        memo: dict = {}
+        idxs = itertools.cycle(range(nb))
+
+        def next_batch():
+            i = next(idxs)
+            if i in memo:
+                return memo[i]
+            b = prep(i)
+            if len(memo) < 64:
+                memo[i] = b
+            return b
+
+        for i in range(args.steps):
+            state, m = step(state, next_batch())
+            if args.log_every and (i % args.log_every == 0):
+                print(json.dumps({
+                    "step": i,
+                    "loss": round(float(m["loss"]), 5),
+                    "ce": round(float(m["ce"]), 5),
+                    "kd_kl": round(float(m["kd_kl"]), 5),
+                }), flush=True)
+    if args.out_ckpt_dir:
+        from shifu_tpu.checkpoint import Checkpointer
+
+        ckpt = Checkpointer(args.out_ckpt_dir)
+        try:
+            ckpt.save(args.steps, state, force=True)
+            ckpt.wait()
+        finally:
+            ckpt.close()
+    print(json.dumps({"done": args.steps, "rows": len(rows)}))
+    return 0
+
+
 def cmd_grpo(args) -> int:
     """Online RL (GRPO) with a verifiable reward: sample a group per
     prompt through the serving engine, score completions by whether
@@ -950,6 +1114,32 @@ def main(argv=None) -> int:
     d.add_argument("--out-ckpt-dir", help="save the tuned state here")
     d.add_argument("--log-every", type=int, default=10)
     d.set_defaults(fn=cmd_dpo)
+
+    kd = sub.add_parser(
+        "distill",
+        help="knowledge distillation from a teacher checkpoint "
+             "(teacher top-k annotations + sharded student training)",
+    )
+    model_flags(kd, schedule_default="constant")
+    kd.add_argument("--data", required=True,
+                    help='JSONL: {"text": str} or {"tokens": [ids]}')
+    kd.add_argument("--tokenizer", help="bpe-train artifact (bpe.json)")
+    kd.add_argument("--teacher-preset", required=True,
+                    choices=["tiny", "small", "1b", "7b"])
+    kd.add_argument("--teacher-ckpt-dir",
+                    help="teacher weights (omit for a random teacher — "
+                         "only useful in tests)")
+    kd.add_argument("--steps", type=int, default=100)
+    kd.add_argument("--batch-size", type=int, default=8)
+    kd.add_argument("--seq-len", type=int, default=512)
+    kd.add_argument("--alpha", type=float, default=0.5,
+                    help="CE weight; (1-alpha) weights the KD term")
+    kd.add_argument("--kd-temperature", type=float, default=2.0)
+    kd.add_argument("--kd-top-k", type=int, default=32)
+    kd.add_argument("--mesh", help="e.g. fsdp=4,tp=2 (axes of MeshPlan)")
+    kd.add_argument("--out-ckpt-dir", help="save the distilled state")
+    kd.add_argument("--log-every", type=int, default=10)
+    kd.set_defaults(fn=cmd_distill)
 
     r = sub.add_parser(
         "grpo",

@@ -18,6 +18,12 @@ from shifu_tpu.train.dpo import (
     reference_logprobs,
     sequence_logprobs,
 )
+from shifu_tpu.train.distill import (
+    DistillConfig,
+    DistillModel,
+    distill_loss,
+    make_teacher_annotate_fn,
+)
 from shifu_tpu.train.grpo import (
     GRPOConfig,
     GRPOModel,
@@ -57,6 +63,10 @@ __all__ = [
     "evaluate",
     "DPOConfig",
     "DPOModel",
+    "DistillConfig",
+    "DistillModel",
+    "distill_loss",
+    "make_teacher_annotate_fn",
     "dpo_loss",
     "reference_logprobs",
     "sequence_logprobs",

@@ -1,0 +1,213 @@
+"""Knowledge distillation (train/distill.py).
+
+Pinned properties:
+  * the annotator's top-k teacher log-probs match a numpy
+    softmax/top-k reference (renormalised over the kept set);
+  * distill_loss against a hand-rolled numpy objective
+    (alpha * CE + (1-alpha) * T^2 * truncated KL);
+  * alpha = 1 is plain CE exactly (the KD term vanishes);
+  * a teacher's own params as student give kd_kl == 0 at top_k = vocab
+    (self-distillation sanity);
+  * LEARNS: a student trained against a fixed teacher on random
+    prompts moves its predictions toward the teacher's (held-out KL
+    drops, top-1 agreement rises) — on an fsdp mesh through the real
+    sharded train stack, annotator included;
+  * the masked positions contribute nothing.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from shifu_tpu.models import Transformer, TransformerConfig
+from shifu_tpu.train import (
+    AdamW,
+    constant,
+    DistillConfig,
+    DistillModel,
+    create_sharded_state,
+    distill_loss,
+    make_teacher_annotate_fn,
+    make_train_step,
+)
+
+
+@pytest.fixture(scope="module")
+def tiny_pair():
+    student = Transformer(TransformerConfig.tiny())
+    teacher = Transformer(TransformerConfig.tiny(dim=96, n_layers=3))
+    return (
+        student, student.init(jax.random.key(0)),
+        teacher, teacher.init(jax.random.key(1)),
+    )
+
+
+def _batch(seed, b=2, s=10, vocab=256):
+    rng = np.random.RandomState(seed)
+    return {"tokens": jnp.asarray(rng.randint(1, vocab, (b, s)))}
+
+
+def test_annotator_matches_numpy(tiny_pair):
+    _, _, teacher, t_params = tiny_pair
+    cfg = DistillConfig(top_k=8, temperature=2.0)
+    annotate = make_teacher_annotate_fn(teacher, cfg)
+    batch = _batch(0)
+    out = annotate(t_params, batch)
+    lg = np.asarray(
+        teacher(t_params, batch["tokens"][:, :-1]), np.float32
+    ) / 2.0
+    for bi in range(lg.shape[0]):
+        for si in range(lg.shape[1]):
+            row = lg[bi, si]
+            top = np.sort(row)[-8:][::-1]
+            got_idx = np.asarray(out["kd_indices"][bi, si])
+            np.testing.assert_allclose(
+                np.sort(row[got_idx])[::-1], top, rtol=1e-5
+            )
+            lp = row[got_idx] - np.log(np.exp(row[got_idx]).sum())
+            np.testing.assert_allclose(
+                np.asarray(out["kd_logprobs"][bi, si]), lp,
+                rtol=1e-4, atol=1e-5,
+            )
+
+
+def test_loss_matches_numpy(tiny_pair):
+    student, s_params, teacher, t_params = tiny_pair
+    cfg = DistillConfig(alpha=0.3, temperature=2.0, top_k=8)
+    batch = make_teacher_annotate_fn(teacher, cfg)(t_params, _batch(1))
+    loss, aux = distill_loss(student, cfg, s_params, batch)
+
+    lg = np.asarray(
+        student(s_params, batch["tokens"][:, :-1]), np.float32
+    )
+    tgt = np.asarray(batch["tokens"][:, 1:])
+    T = 2.0
+    ce_terms, kl_terms = [], []
+    for bi in range(lg.shape[0]):
+        for si in range(lg.shape[1]):
+            row = lg[bi, si]
+            ce_terms.append(
+                np.log(np.exp(row - row.max()).sum()) + row.max()
+                - row[tgt[bi, si]]
+            )
+            idx = np.asarray(batch["kd_indices"][bi, si])
+            s_soft = row / T
+            s_lp = s_soft[idx] - (
+                np.log(np.exp(s_soft - s_soft.max()).sum())
+                + s_soft.max()
+            )
+            s_lp = s_lp - np.log(np.exp(s_lp).sum())
+            t_lp = np.asarray(batch["kd_logprobs"][bi, si])
+            kl_terms.append((np.exp(t_lp) * (t_lp - s_lp)).sum())
+    want = 0.3 * np.mean(ce_terms) + 0.7 * T * T * np.mean(kl_terms)
+    np.testing.assert_allclose(float(loss), want, rtol=1e-4)
+    np.testing.assert_allclose(float(aux["ce"]), np.mean(ce_terms),
+                               rtol=1e-4)
+
+
+def test_alpha_one_is_plain_ce(tiny_pair):
+    student, s_params, teacher, t_params = tiny_pair
+    cfg = DistillConfig(alpha=1.0, top_k=4)
+    batch = make_teacher_annotate_fn(teacher, cfg)(t_params, _batch(2))
+    loss, aux = distill_loss(student, cfg, s_params, batch)
+    np.testing.assert_allclose(float(loss), float(aux["ce"]), rtol=1e-6)
+
+
+def test_self_distillation_zero_kl(tiny_pair):
+    student, s_params, *_ = tiny_pair
+    cfg = DistillConfig(alpha=0.0, top_k=student.cfg.vocab_size)
+    batch = make_teacher_annotate_fn(student, cfg)(s_params, _batch(3))
+    _, aux = distill_loss(student, cfg, s_params, batch)
+    assert float(aux["kd_kl"]) < 1e-9
+
+
+def test_mask_excludes_positions(tiny_pair):
+    student, s_params, teacher, t_params = tiny_pair
+    cfg = DistillConfig(alpha=0.5, top_k=8)
+    annotate = make_teacher_annotate_fn(teacher, cfg)
+    b1 = annotate(t_params, _batch(4))
+    mask = np.ones(np.asarray(b1["tokens"]).shape, np.float32)
+    mask[:, 5:] = 0.0
+    b1["mask"] = jnp.asarray(mask)
+    l1, _ = distill_loss(student, cfg, s_params, b1)
+    # Corrupt the masked-out tail: loss must not move.
+    toks = np.asarray(b1["tokens"]).copy()
+    toks[:, 6:] = 7
+    b2 = annotate(t_params, {"tokens": jnp.asarray(toks)})
+    b2["mask"] = jnp.asarray(mask)
+    # kd annotations for positions < 5 depend only on tokens < 5 (the
+    # teacher is causal), so the scored prefix is identical.
+    l2, _ = distill_loss(student, cfg, s_params, b2)
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
+
+
+def test_learns_toward_teacher_on_mesh(tiny_pair):
+    """The product path: annotate + sharded train step on an fsdp
+    mesh. Held-out KL to the teacher drops and top-1 agreement rises."""
+    from shifu_tpu.parallel import MeshPlan
+
+    student, _, teacher, t_params = tiny_pair
+    cfg = DistillConfig(alpha=0.0, temperature=1.0, top_k=32)
+    dm = DistillModel(student, cfg)
+    mesh = MeshPlan(fsdp=2).build(jax.devices()[:2])
+    opt = AdamW(schedule=constant(3e-3))
+    state = create_sharded_state(dm, opt, jax.random.key(5), mesh)
+    step = make_train_step(dm, opt, mesh)
+    annotate = make_teacher_annotate_fn(teacher, cfg)
+
+    held = annotate(t_params, _batch(99, b=4, s=12))
+
+    def held_metrics(params):
+        _, aux = distill_loss(student, cfg, params, held)
+        s_lg = student(params, held["tokens"][:, :-1])
+        t_lg = teacher(t_params, held["tokens"][:, :-1])
+        agree = float(
+            (jnp.argmax(s_lg, -1) == jnp.argmax(t_lg, -1)).mean()
+        )
+        return float(aux["kd_kl"]), agree
+
+    kl0, agree0 = held_metrics(state.params)
+    for i in range(30):
+        batch = annotate(t_params, _batch(100 + i, b=4, s=12))
+        state, metrics = step(state, batch)
+    kl1, agree1 = held_metrics(state.params)
+    # The KL to the teacher is the trained objective — it must drop
+    # hard; top-1 agreement over a 256-way vocab is a slow secondary
+    # signal, pinned only against regression at this step count.
+    assert kl1 < kl0 * 0.7, (kl0, kl1)
+    assert agree1 >= agree0, (agree0, agree1)
+
+
+def test_cli_distill_e2e(tmp_path, capsys):
+    """The product path end to end: JSONL rows -> teacher annotations
+    -> student steps -> saved checkpoint; the logged KD KL is finite
+    and the loss moves."""
+    import json
+
+    from shifu_tpu.cli import main
+
+    data = tmp_path / "kd.jsonl"
+    rng = np.random.RandomState(0)
+    with open(data, "w") as f:
+        for _ in range(8):
+            f.write(json.dumps(
+                {"tokens": rng.randint(1, 250, size=12).tolist()}
+            ) + "\n")
+    out_dir = str(tmp_path / "out")
+    rc = main([
+        "distill", "--data", str(data), "--preset", "tiny",
+        "--teacher-preset", "tiny", "--steps", "6",
+        "--batch-size", "4", "--seq-len", "12", "--alpha", "0.5",
+        "--kd-top-k", "16", "--log-every", "2",
+        "--out-ckpt-dir", out_dir,
+    ])
+    assert rc == 0
+    lines = [json.loads(x) for x in capsys.readouterr().out.strip().splitlines()]
+    assert lines[-1]["done"] == 6
+    logged = [x for x in lines if "kd_kl" in x]
+    assert logged and all(np.isfinite(x["kd_kl"]) for x in logged)
+    import os
+
+    assert os.path.isdir(out_dir)
