@@ -172,6 +172,19 @@ def cmd_bpe_train(args) -> int:
     return 0
 
 
+def _save_state(out_dir: str, step: int, state) -> None:
+    """Save a tuned TrainState to ``out_dir`` (the shared tail of the
+    dpo/grpo/distill commands — one place for save semantics)."""
+    from shifu_tpu.checkpoint import Checkpointer
+
+    ckpt = Checkpointer(out_dir)
+    try:
+        ckpt.save(step, state, force=True)
+        ckpt.wait()
+    finally:
+        ckpt.close()
+
+
 def cmd_dpo(args) -> int:
     """DPO from a JSONL of {"prompt", "chosen", "rejected"} — token-id
     lists, or strings when a tokenizer is given. The restored
@@ -298,14 +311,7 @@ def cmd_dpo(args) -> int:
                     "accuracy": round(float(m["accuracy"]), 4),
                 }), flush=True)
     if args.out_ckpt_dir:
-        from shifu_tpu.checkpoint import Checkpointer
-
-        ckpt = Checkpointer(args.out_ckpt_dir)
-        try:
-            ckpt.save(args.steps, state, force=True)
-            ckpt.wait()
-        finally:
-            ckpt.close()
+        _save_state(args.out_ckpt_dir, args.steps, state)
     print(json.dumps({"done": args.steps, "pairs": len(pairs)}))
     return 0
 
@@ -337,8 +343,12 @@ def cmd_distill(args) -> int:
     targs.ckpt_dir = args.teacher_ckpt_dir
     # Student-architecture flags must NOT leak into the teacher build —
     # an --moe-experts student from a dense teacher checkpoint would
-    # otherwise construct an MoE teacher that cannot restore it.
+    # otherwise construct an MoE teacher that cannot restore it. A
+    # DIFFERENT seed keeps the no-checkpoint random-teacher mode
+    # meaningful (same preset + same seed would clone the student:
+    # kd_kl identically zero).
     targs.moe_experts = 0
+    targs.seed = args.seed + 1
     teacher = _build_model(targs)
     if teacher.cfg.vocab_size != model.cfg.vocab_size:
         print(
@@ -405,7 +415,7 @@ def cmd_distill(args) -> int:
     annotate = make_teacher_annotate_fn(teacher, dcfg)
     with contextlib.ExitStack() as ctx:
         if mesh is not None:
-            from shifu_tpu.parallel import shard_batch, shard_params
+            from shifu_tpu.parallel import shard_params
             from shifu_tpu.train import state_shardings
 
             ctx.enter_context(mesh)
@@ -462,14 +472,7 @@ def cmd_distill(args) -> int:
                     "kd_kl": round(float(m["kd_kl"]), 5),
                 }), flush=True)
     if args.out_ckpt_dir:
-        from shifu_tpu.checkpoint import Checkpointer
-
-        ckpt = Checkpointer(args.out_ckpt_dir)
-        try:
-            ckpt.save(args.steps, state, force=True)
-            ckpt.wait()
-        finally:
-            ckpt.close()
+        _save_state(args.out_ckpt_dir, args.steps, state)
     print(json.dumps({"done": args.steps, "rows": len(rows)}))
     return 0
 
@@ -638,14 +641,7 @@ def cmd_grpo(args) -> int:
                     "kl": round(float(m["kl"]), 6),
                 }), flush=True)
     if args.out_ckpt_dir:
-        from shifu_tpu.checkpoint import Checkpointer
-
-        ckpt = Checkpointer(args.out_ckpt_dir)
-        try:
-            ckpt.save(args.steps, state, force=True)
-            ckpt.wait()
-        finally:
-            ckpt.close()
+        _save_state(args.out_ckpt_dir, args.steps, state)
     print(json.dumps({"done": args.steps, "examples": len(rows)}))
     return 0
 

@@ -58,7 +58,9 @@ otherwise) and parses an envelope out of the reply when the model
 emits one. Responses carry ``message.tool_calls`` (arguments as a
 JSON string, per the OpenAI wire shape) and ``finish_reason:
 "tool_calls"``. ``max_tokens`` is accepted as an alias for
-``max_new_tokens`` on both endpoints.
+``max_new_tokens`` on both endpoints, and OpenAI ``response_format``
+(the json_schema form) maps onto the ``json_schema`` constraint
+("json_object" is refused: ANY-valid-JSON is not a regular language).
 
 Stop sequences truncate in the ENGINE host loop (finished_by="stop");
 string stops additionally trim the trailing text in the response here.
@@ -1372,6 +1374,41 @@ class _Handler(BaseHTTPRequestHandler):
                 json_schema, dict
             ):
                 raise ValueError("json_schema must be an object")
+            rf = req.get("response_format")
+            if rf is not None:
+                # OpenAI wire alias. Only the json_schema form maps:
+                # "json_object" means ANY valid JSON, which is not a
+                # regular language (unbounded nesting) — the FSM layer
+                # cannot honour it and must not pretend to.
+                if not isinstance(rf, dict):
+                    raise ValueError("response_format must be an object")
+                if rf.get("type") == "text":
+                    pass
+                elif rf.get("type") == "json_schema":
+                    if json_schema is not None:
+                        raise ValueError(
+                            "pass response_format OR json_schema, "
+                            "not both"
+                        )
+                    inner = rf.get("json_schema")
+                    schema = (
+                        inner.get("schema")
+                        if isinstance(inner, dict)
+                        else None
+                    )
+                    if not isinstance(schema, dict):
+                        raise ValueError(
+                            'response_format json_schema needs '
+                            '{"json_schema": {"schema": {...}}}'
+                        )
+                    json_schema = schema
+                else:
+                    raise ValueError(
+                        f"response_format type {rf.get('type')!r} is "
+                        "not supported (json_schema constrains to the "
+                        "schema; bare json_object is not a regular "
+                        "language)"
+                    )
             if tools and tool_choice not in ("none", "auto"):
                 # Forced tool call: the response IS the envelope —
                 # constrain generation to it (FSM-constrained decode,

@@ -332,3 +332,37 @@ def test_template_tool_support_detection(tiny):
             server.shutdown()
             server.runner.shutdown()
             t.join(5)
+
+
+def test_response_format_alias(served):
+    """OpenAI response_format {"type": "json_schema"} maps onto the
+    engine's json_schema constraint; "json_object" (any JSON — not a
+    regular language) and unknown types 400; "text" is a no-op."""
+    schema = {"type": "object",
+              "properties": {"ok": {"type": "boolean"}}}
+    status, out = _post(served, "/v1/chat/completions", {
+        "messages": _MSGS, "max_new_tokens": 48,
+        "response_format": {"type": "json_schema",
+                            "json_schema": {"schema": schema}},
+    })
+    assert status == 200
+    if out["finished_by"] == "eos":
+        obj = json.loads(out["message"]["content"])
+        assert set(obj) == {"ok"}
+    status, out = _post(served, "/v1/chat/completions", {
+        "messages": _MSGS, "max_new_tokens": 8,
+        "response_format": {"type": "json_object"},
+    })
+    assert status == 400 and "regular language" in out["error"]
+    status, _ = _post(served, "/v1/chat/completions", {
+        "messages": _MSGS, "max_new_tokens": 4,
+        "response_format": {"type": "text"},
+    })
+    assert status == 200
+    status, out = _post(served, "/v1/chat/completions", {
+        "messages": _MSGS, "max_new_tokens": 4,
+        "json_schema": schema,
+        "response_format": {"type": "json_schema",
+                            "json_schema": {"schema": schema}},
+    })
+    assert status == 400 and "not both" in out["error"]
