@@ -203,3 +203,32 @@ def test_qwen3_serves_through_engine():
         lg = model(params, jnp.asarray([seq], jnp.int32))
         seq.append(int(jnp.argmax(lg[0, -1])))
     assert got == seq[len(prompt):]
+
+
+def test_gemma2_through_lookup_speculation():
+    """The family x engine matrix holds: a converted Gemma-2 (softcaps
+    + alternating windows, attn_impl='xla' so the spec verify rides
+    the paged XLA path) decodes greedily through the prompt-lookup
+    speculative engine EXACTLY like the plain paged engine."""
+    from shifu_tpu.infer import (
+        PagedEngine,
+        PromptLookupPagedEngine,
+        SampleConfig,
+    )
+
+    hf = tiny_hf_gemma2()
+    model, params = from_hf_llama(hf)
+    model = Transformer(model.cfg, policy=FULL_F32)
+    prompt = np.random.RandomState(5).randint(1, 128, (9,)).tolist()
+    kw = dict(max_slots=1, max_len=64, page_size=4,
+              sample_cfg=SampleConfig(temperature=0.0),
+              prefill_buckets=(16, 32, 64))
+    ref_eng = PagedEngine(model, params, **kw)
+    rid = ref_eng.submit(prompt, max_new_tokens=10)
+    ref = {c.rid: c for c in ref_eng.run()}[rid].tokens
+    eng = PromptLookupPagedEngine(
+        model, params, k=3, ngram=2, rounds_per_step=2, **kw
+    )
+    rid = eng.submit(prompt, max_new_tokens=10)
+    got = {c.rid: c for c in eng.run()}[rid].tokens
+    assert got == ref

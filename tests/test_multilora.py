@@ -401,3 +401,43 @@ def test_prefix_cache_partitions_by_adapter(tiny):
     got_ad2 = _run(eng, [(prompt, {"adapter": aid})], 6)[0]
     assert got_ad2 == want_ad
     assert eng.prefix_hits_tokens > before
+
+
+def test_quantized_base_with_adapters(tiny):
+    """QLoRA-style serving: int8 weight-only BASE + per-request rank-r
+    adapters in one batch. The adapter delta applies to projection
+    OUTPUTS, orthogonal to how the base weights are stored — greedy
+    tokens must match the dequantize-first engine serving the same
+    adapters exactly (two lowerings of one model), and the no-adapter
+    row stays isolated."""
+    from shifu_tpu.infer import QuantizedModel
+    from shifu_tpu.infer.quant import dequantize_params, quantize_params
+
+    model, params = tiny
+    _, lcfg, (a1, a2) = _adapters(model, params, 40)
+    qp = quantize_params(model, params)
+    scfg = LoraServingConfig(
+        rank=lcfg.rank, alpha=lcfg.alpha, targets=lcfg.targets,
+        max_adapters=2,
+    )
+    kw = dict(max_slots=3, max_len=48, prefill_buckets=(16, 48),
+              sample_cfg=SampleConfig(temperature=0.0), lora=scfg)
+    # Rows share ONE prompt so differences are attributable to the
+    # adapters alone (a shared ignore-the-adapter bug would pass the
+    # two-lowering parity below but fail the bite check).
+    prompt = _prompts(41, (6,))[0]
+    jobs = [(prompt, {"adapter": 1}), (prompt, {"adapter": 2}),
+            (prompt, {})]
+
+    eng_q = Engine(QuantizedModel(model), qp, **kw)
+    eng_q.add_adapter(a1)
+    eng_q.add_adapter(a2)
+    got = _run(eng_q, jobs)
+
+    eng_d = Engine(model, dequantize_params(qp), **kw)
+    eng_d.add_adapter(a1)
+    eng_d.add_adapter(a2)
+    want = _run(eng_d, jobs)
+    assert got == want
+    # The adapters genuinely bit: same prompt, different rows.
+    assert got[0] != got[2] or got[1] != got[2]
