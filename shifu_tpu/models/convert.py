@@ -173,6 +173,28 @@ def config_from_hf_llama(hf_config, **overrides) -> TransformerConfig:
         ),
     )
     model_type = getattr(hf_config, "model_type", "")
+    if model_type == "gemma":
+        # Gemma-1: the Llama block shape with the Gemma conventions —
+        # GeGLU, sqrt(dim) embedding scale, zero-centred norm gains,
+        # explicit head_dim, tied embeddings (from the config). The HF
+        # forward keys the activation off hidden_act (GemmaMLP uses
+        # ACT2FN[config.hidden_act]) — and the ORIGINAL Hub configs
+        # carry "gelu", which is the exact erf gelu, not the tanh
+        # approximation; mapping it to gelu_tanh would silently break
+        # logits parity.
+        act = getattr(hf_config, "hidden_act", "gelu_pytorch_tanh")
+        if act in ("gelu_pytorch_tanh", "gelu_tanh"):
+            mlp_act = "gelu_tanh"
+        elif act == "gelu":
+            mlp_act = "gelu_erf"
+        else:
+            raise NotImplementedError(
+                f"gemma hidden_act {act!r} (expected a gelu variant)"
+            )
+        kw.update(
+            mlp_act=mlp_act, embed_scale=True,
+            zero_centered_hf_norms=True,
+        )
     if model_type == "qwen3":
         # Qwen3 = the Llama layout + per-head q/k RMS norms, no qkv
         # biases (attention_bias False is the config default — handled
@@ -186,6 +208,7 @@ def config_from_hf_llama(hf_config, **overrides) -> TransformerConfig:
                 "gelu_pytorch_tanh)"
             )
         kw.update(
+            zero_centered_hf_norms=True,
             attn_softcap=(
                 None
                 if hf_config.attn_logit_softcapping is None
@@ -222,9 +245,18 @@ def config_from_hf_llama(hf_config, **overrides) -> TransformerConfig:
 
 
 def params_from_hf_llama(
-    state_dict: Mapping[str, Any], cfg: TransformerConfig, dtype=jnp.float32
+    state_dict: Mapping[str, Any], cfg: TransformerConfig, dtype=jnp.float32,
+    *, zero_centered_norms: Optional[bool] = None,
 ):
-    """shifu_tpu param tree from a HF Llama state_dict."""
+    """shifu_tpu param tree from a HF Llama state_dict.
+
+    ``zero_centered_norms``: the checkpoint stores RMS gains as 1+w
+    (the Gemma convention) rather than the full gain (Llama). Defaults
+    to ``cfg.zero_centered_hf_norms or cfg.post_norms`` — configs from
+    config_from_hf_llama carry the convention flag, and hand-built
+    Gemma-2-shaped configs (post_norms) still default right; the
+    kwarg remains for callers converting checkpoints whose convention
+    deviates from their config."""
     sd = {k: v for k, v in state_dict.items()}
     L = cfg.n_layers
     d, h, kv, hd = (
@@ -248,11 +280,13 @@ def params_from_hf_llama(
 
     # Norm-gain convention: Llama-family HF norms store the FULL gain
     # (our zero-centred storage subtracts 1); Gemma-family norms
-    # already store 1+w zero-centred (Gemma2RMSNorm) — no shift. The
-    # post_norms flag marks the Gemma block shape, which also renames
-    # the FFN norms (post_attention_layernorm is the attention
-    # SANDWICH norm there, not the pre-FFN norm).
-    nsub = 0.0 if cfg.post_norms else 1.0
+    # already store 1+w zero-centred — no shift (docstring). The
+    # post_norms flag additionally renames the FFN norms
+    # (post_attention_layernorm is the attention SANDWICH norm in the
+    # Gemma-2 block, not the pre-FFN norm).
+    if zero_centered_norms is None:
+        zero_centered_norms = cfg.zero_centered_hf_norms or cfg.post_norms
+    nsub = 0.0 if zero_centered_norms else 1.0
     blocks = {
         "attn_norm": stack(
             "layers.{}.input_layernorm.weight", lambda w: w - nsub
@@ -373,7 +407,8 @@ def params_from_hf_llama(
     return params
 
 
-def to_hf_llama_state_dict(params, cfg: TransformerConfig):
+def to_hf_llama_state_dict(params, cfg: TransformerConfig,
+                           *, zero_centered_norms: Optional[bool] = None):
     """shifu_tpu params -> HF Llama-layout state_dict (numpy tensors).
 
     Exact inverse of :func:`params_from_hf_llama` (round-trip tested), so
@@ -394,7 +429,9 @@ def to_hf_llama_state_dict(params, cfg: TransformerConfig):
     def np_(x):
         return np.asarray(x, np.float32)
 
-    nsub = 0.0 if cfg.post_norms else 1.0  # params_from_hf_llama note
+    if zero_centered_norms is None:  # params_from_hf_llama docstring
+        zero_centered_norms = cfg.zero_centered_hf_norms or cfg.post_norms
+    nsub = 0.0 if zero_centered_norms else 1.0
     sd = {"model.embed_tokens.weight": np_(params["embed"])}
     for l in range(L):
         p = f"model.layers.{l}."
@@ -637,5 +674,7 @@ def from_hf_llama(
     MistralForCausalLM, and friends with the same layout).
     """
     cfg = config_from_hf_llama(hf_model.config, **config_overrides)
+    # The norm-storage convention rides cfg.zero_centered_hf_norms
+    # (set by config_from_hf_llama for the Gemma family).
     params = params_from_hf_llama(hf_model.state_dict(), cfg, dtype)
     return Transformer(cfg), params

@@ -116,9 +116,17 @@ class TransformerConfig:
     # attn_scale**-0.5 instead of head_dim**-0.5 (Gemma-2's
     # query_pre_attn_scalar, which its 9b sets != head_dim).
     attn_scale: Optional[float] = None
-    # FFN activation: "silu" (Llama) or "gelu_tanh" (Gemma's
-    # gelu_pytorch_tanh = jax.nn.gelu(approximate=True)).
+    # FFN activation: "silu" (Llama), "gelu_tanh" (Gemma's
+    # gelu_pytorch_tanh = jax.nn.gelu(approximate=True)), or
+    # "gelu_erf" (exact gelu — original Gemma-1 Hub configs carry
+    # hidden_act="gelu", which HF computes UNapproximated).
     mlp_act: str = "silu"
+    # INTEROP-ONLY convention marker (no effect on the forward): the
+    # HF counterpart of this model stores RMS gains zero-centred
+    # (1 + w, the Gemma family) rather than as the full gain (Llama).
+    # models/convert keys the ±1 norm shift off it in BOTH directions,
+    # so hand-built configs round-trip without remembering a kwarg.
+    zero_centered_hf_norms: bool = False
     # Sandwich norms (Gemma-2): extra RMS norms on the attention and
     # FFN OUTPUTS before their residual adds.
     post_norms: bool = False
@@ -152,9 +160,10 @@ class TransformerConfig:
             )
         if self.window_size is not None and self.window_size < 1:
             raise ValueError(f"window_size={self.window_size} must be >= 1")
-        if self.mlp_act not in ("silu", "gelu_tanh"):
+        if self.mlp_act not in ("silu", "gelu_tanh", "gelu_erf"):
             raise ValueError(
-                f"mlp_act={self.mlp_act!r} (want 'silu' or 'gelu_tanh')"
+                f"mlp_act={self.mlp_act!r} (want 'silu', 'gelu_tanh' "
+                "or 'gelu_erf')"
             )
         if self.window_pattern is not None:
             if self.window_size is None:
@@ -581,11 +590,11 @@ class Transformer(Module):
                         gate = gate + d
                     else:
                         up = up + d
-            act = (
-                jax.nn.gelu(gate, approximate=True)
-                if cfg.mlp_act == "gelu_tanh"
-                else jax.nn.silu(gate)
-            ) * up
+            act = {
+                "silu": jax.nn.silu,
+                "gelu_tanh": lambda x: jax.nn.gelu(x, approximate=True),
+                "gelu_erf": lambda x: jax.nn.gelu(x, approximate=False),
+            }[cfg.mlp_act](gate) * up
             down = jnp.einsum("bsm,md->bsd", act, p["w_down"])
             dd = lora_delta("w_down", act)
             if dd is not None:

@@ -232,3 +232,74 @@ def test_gemma2_through_lookup_speculation():
     rid = eng.submit(prompt, max_new_tokens=10)
     got = {c.rid: c for c in eng.run()}[rid].tokens
     assert got == ref
+
+
+# ----------------------------------------------------------------- Gemma-1
+
+
+def tiny_hf_gemma1(**kw):
+    from transformers import GemmaConfig, GemmaForCausalLM
+
+    defaults = dict(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, head_dim=8, rms_norm_eps=1e-6,
+        rope_theta=10_000.0, attn_implementation="eager",
+    )
+    defaults.update(kw)
+    torch.manual_seed(2)
+    return GemmaForCausalLM(GemmaConfig(**defaults)).eval()
+
+
+def test_gemma1_logits_match_torch():
+    """Gemma-1 = the Llama block shape WITH the Gemma conventions
+    (GeGLU, embed scaling, zero-centred norm gains) and none of
+    Gemma-2's (no softcaps/sandwich norms/alternation) — pinning that
+    the norm-shift convention is keyed correctly for this mix."""
+    hf = tiny_hf_gemma1()
+    model, params = from_hf_llama(hf)
+    cfg = model.cfg
+    assert cfg.mlp_act == "gelu_tanh" and cfg.embed_scale
+    assert not cfg.post_norms and cfg.attn_softcap is None
+    assert cfg.tie_embeddings
+    model = Transformer(cfg, policy=FULL_F32)
+    tokens = np.random.RandomState(4).randint(0, 128, (2, 11))
+    with torch.no_grad():
+        want = hf(torch.tensor(tokens)).logits.float().numpy()
+    got = np.asarray(model(params, jnp.asarray(tokens, jnp.int32)))
+    np.testing.assert_allclose(got, want, rtol=3e-4, atol=3e-4)
+
+
+def test_gemma1_roundtrip():
+    hf = tiny_hf_gemma1()
+    model, params = from_hf_llama(hf)
+    # The convention rides cfg.zero_centered_hf_norms — no kwarg.
+    assert model.cfg.zero_centered_hf_norms
+    sd = to_hf_llama_state_dict(params, model.cfg)
+    orig = hf.state_dict()
+    assert set(sd) == set(orig)
+    for k, v in sd.items():
+        np.testing.assert_allclose(
+            v, orig[k].float().numpy(), rtol=1e-6, atol=1e-7, err_msg=k
+        )
+    from transformers import GemmaForCausalLM
+
+    fresh = GemmaForCausalLM(hf.config)
+    fresh.load_state_dict({k: torch.from_numpy(v) for k, v in sd.items()})
+
+
+def test_gemma1_erf_gelu_configs_match_torch():
+    """The ORIGINAL Gemma-1 Hub configs carry hidden_act="gelu" — the
+    EXACT erf gelu, which HF's forward uses (ACT2FN[hidden_act]).
+    Mapping it to the tanh approximation would silently break parity;
+    the conversion maps it to mlp_act="gelu_erf" instead and the
+    logits match exactly."""
+    hf = tiny_hf_gemma1(hidden_act="gelu")
+    model, params = from_hf_llama(hf)
+    assert model.cfg.mlp_act == "gelu_erf"
+    model = Transformer(model.cfg, policy=FULL_F32)
+    tokens = np.random.RandomState(6).randint(0, 128, (2, 11))
+    with torch.no_grad():
+        want = hf(torch.tensor(tokens)).logits.float().numpy()
+    got = np.asarray(model(params, jnp.asarray(tokens, jnp.int32)))
+    np.testing.assert_allclose(got, want, rtol=3e-4, atol=3e-4)
