@@ -173,3 +173,44 @@ def test_ssm_family_refuses(tiny):
         server.shutdown()
         server.runner.shutdown()
         t.join(5)
+
+
+def test_embeddings_over_replica_router(tiny):
+    """/v1/embeddings served by a ReplicatedEngine: the runner reads
+    model/params/buckets through the router facade (the embed forward
+    runs on the first replica's weights — replicas are identical)."""
+    from shifu_tpu.infer import build_replicated
+
+    model, params = tiny
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 devices")
+
+    def mk(mesh):
+        from shifu_tpu.parallel import shard_params
+
+        return PagedEngine(
+            model, shard_params(model, params, mesh), mesh=mesh,
+            max_slots=2, max_len=64, page_size=8,
+            sample_cfg=SampleConfig(temperature=0.0),
+            prefill_buckets=(16, 32, 64),
+        )
+
+    router = build_replicated(
+        mk, dp=2, tp=1, devices=jax.devices()[:2]
+    )
+    server = make_server(router, port=0, tokenizer=_TOK)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    try:
+        base = f"http://127.0.0.1:{server.server_port}"
+        status, out = _post(base, "/v1/embeddings",
+                            {"input": [[3, 4, 5]]})
+        assert status == 200
+        _, solo = _post(base, "/v1/embeddings", {"input": [3, 4, 5]})
+        np.testing.assert_allclose(
+            out["data"][0]["embedding"], solo["data"][0]["embedding"]
+        )
+    finally:
+        server.shutdown()
+        server.runner.shutdown()
+        t.join(5)

@@ -397,3 +397,24 @@ def test_cross_entropy_grad_is_finite_bf16():
     labels = jnp.zeros((2, 3), jnp.int32)
     g = jax.grad(lambda l: softmax_cross_entropy(l, labels)[0])(logits)
     assert np.isfinite(np.asarray(g, np.float32)).all()
+
+
+def test_softcap_refused_outside_xla_impl():
+    """attn softcap sits between scale and mask; the flash/ring
+    kernels' inner loops do not apply it — the op must refuse rather
+    than silently mis-score (ops/attention.py guard)."""
+    import jax
+    import jax.numpy as jnp
+    import pytest
+
+    from shifu_tpu.ops import dot_product_attention
+
+    q = jnp.zeros((1, 8, 4, 8), jnp.float32)
+    k = v = jnp.zeros((1, 8, 2, 8), jnp.float32)
+    out = dot_product_attention(q, k, v, causal=True, softcap=30.0)
+    assert out.shape == q.shape
+    for impl in ("flash", "ring"):
+        with pytest.raises(ValueError, match="softcap"):
+            dot_product_attention(
+                q, k, v, causal=True, softcap=30.0, impl=impl
+            )
