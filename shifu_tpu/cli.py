@@ -6,6 +6,7 @@
     generate   text completion from a checkpoint
     serve      HTTP completions server (continuous batching, paged KV)
     bpe-train  train a byte-level BPE tokenizer (native C++ core)
+    trace      export serving request traces as Chrome trace-event JSON
     info       devices, native-extension status, version
 
 The CLI builds everything from flags — model preset (optionally MoE),
@@ -1010,6 +1011,31 @@ def cmd_serve(args) -> int:
     return 0
 
 
+def cmd_trace(args) -> int:
+    """``shifu_tpu trace export``: turn a serving trace log (the JSONL
+    the server appends under ``serve --trace-log``) into Chrome
+    trace-event JSON — one track per request, non-overlapping
+    queue -> prefill -> decode spans, loadable in chrome://tracing or
+    Perfetto. The host-side complement to the device-side
+    ``jax.profiler`` traces (docs/observability.md)."""
+    from shifu_tpu.obs.trace import export_trace_log
+
+    try:
+        trace = export_trace_log(args.infile, args.out)
+    except OSError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    if args.out:
+        print(json.dumps({
+            "out": args.out,
+            "events": len(trace["traceEvents"]),
+            "requests": len({e["tid"] for e in trace["traceEvents"]}),
+        }))
+    else:
+        print(json.dumps(trace))
+    return 0
+
+
 def cmd_info(args) -> int:
     import jax
 
@@ -1261,6 +1287,19 @@ def main(argv=None) -> int:
     s.add_argument("--draft-ckpt-dir",
                    help="draft checkpoint (--spec draft)")
     s.set_defaults(fn=cmd_serve)
+
+    tr = sub.add_parser(
+        "trace",
+        help="serving request traces: export a serve --trace-log JSONL "
+             "as Chrome trace-event JSON (chrome://tracing / Perfetto)",
+    )
+    tr.add_argument("action", choices=["export"])
+    tr.add_argument("--in", dest="infile", required=True,
+                    help="trace-log JSONL path (serve --trace-log)")
+    tr.add_argument("--out",
+                    help="write the Chrome trace JSON here "
+                         "(default: print to stdout)")
+    tr.set_defaults(fn=cmd_trace)
 
     i = sub.add_parser("info", help="environment / device info")
     i.set_defaults(fn=cmd_info)

@@ -32,6 +32,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from shifu_tpu import obs as _obs
 from shifu_tpu.ops.attention import NEG_INF
 from shifu_tpu.infer.sampling import (
     SampleConfig,
@@ -183,6 +184,7 @@ class Engine:
         lora: Optional[LoraServingConfig] = None,
         tokenizer=None,
         fsm_device_states: int = 1024,
+        metrics=None,
     ):
         """``per_request_sampling``: temperature/top-k/top-p become
         per-slot TRACED arrays in the decode/prefill programs, so one
@@ -235,7 +237,15 @@ class Engine:
         and for ``submit(regex=...)`` constraints (token byte strings)
         (``submit(..., stop_strings=...)`` — the sweep decodes the
         generated tokens to find the stop text). Token-id stop
-        sequences need no tokenizer."""
+        sequences need no tokenizer.
+
+        ``metrics``: an ``obs.MetricsRegistry`` to record serving
+        metrics into (default: the process-global ``obs.REGISTRY``).
+        The engine records TTFT/TPOT/ITL histograms, per-step
+        dispatch/fold phase histograms, and queue/slot gauges, all
+        labelled by ``replica`` (``set_replica`` rebinds — the dp
+        router labels each replica at construction). See
+        docs/observability.md."""
         self.model = model
         self.params = params
         self.max_slots = max_slots
@@ -252,6 +262,16 @@ class Engine:
         # deque being appended raises "mutated during iteration".
         self._trace_window = collections.deque(maxlen=256)
         self._trace_lock = threading.Lock()
+        # Completion/token running totals for counters() (plain ints:
+        # the registry counters are the scrapeable mirror).
+        self.requests_completed = 0
+        self.tokens_generated = 0
+        # Metrics registry + per-replica label (the dp router re-labels
+        # replicas via set_replica; children are pre-bound so the step
+        # loop's hot path is a couple of float ops per update).
+        self.metrics = metrics if metrics is not None else _obs.REGISTRY
+        self.replica_label = "0"
+        self._obs_bind()
         if decode_chunk < 1:
             raise ValueError(f"decode_chunk must be >= 1, got {decode_chunk}")
         self.decode_chunk = int(decode_chunk)
@@ -641,6 +661,7 @@ class Engine:
                 created_ts=time.monotonic(),
             )
         )
+        self._g_queue.set(len(self._queue))
         return rid
 
     def add_adapter(self, lora_params) -> int:
@@ -696,6 +717,8 @@ class Engine:
             if req.rid == rid:
                 self._queue.remove(req)
                 self.cancellations += 1
+                self._c_cancel.inc()
+                self._g_queue.set(len(self._queue))
                 return True
         for pool in (self._active, self._prefilling):
             for slot, req in list(pool.items()):
@@ -704,6 +727,7 @@ class Engine:
                     self._release(slot)
                     self._free.append(slot)
                     self.cancellations += 1
+                    self._c_cancel.inc()
                     return True
         return False
 
@@ -735,22 +759,121 @@ class Engine:
         """Occupied slots: decoding + mid-chunked-prefill."""
         return len(self._active) + len(self._prefilling)
 
+    # -------------------------------------------------- observability
+    def _obs_bind(self) -> None:
+        """Pre-bind this engine's labelled metric children (called at
+        construction and again by set_replica). Families are shared
+        process-wide per registry; children are per replica label."""
+        m, r = self.metrics, self.replica_label
+        phase = m.histogram(
+            "shifu_step_phase_seconds",
+            "Engine step phase wall time (admit = admission loop incl. "
+            "prefill dispatches; dispatch = decode program dispatch; "
+            "fold = host sync + bookkeeping)",
+            labelnames=("replica", "phase"),
+        )
+        self._h_phase = {
+            p: phase.labels(replica=r, phase=p)
+            for p in ("admit", "dispatch", "fold")
+        }
+        self._h_ttft = m.histogram(
+            "shifu_request_ttft_seconds",
+            "Submit -> first token (per completed request)",
+            labelnames=("replica",),
+        ).labels(replica=r)
+        self._h_tpot = m.histogram(
+            "shifu_request_tpot_seconds",
+            "Per-token decode time (decode span / decode tokens, one "
+            "observation per decode token of a completed request)",
+            labelnames=("replica",),
+        ).labels(replica=r)
+        self._h_itl = m.histogram(
+            "shifu_request_itl_seconds",
+            "Inter-token latency measured per decode dispatch "
+            "(dispatch+fold wall time / tokens a slot emitted in it)",
+            labelnames=("replica",),
+        ).labels(replica=r)
+        reqs = m.counter(
+            "shifu_requests_completed_total",
+            "Completed requests by finish reason",
+            labelnames=("replica", "finished_by"),
+        )
+        self._c_requests = {
+            fb: reqs.labels(replica=r, finished_by=fb)
+            for fb in ("eos", "length", "stop")
+        }
+        self._c_tokens = m.counter(
+            "shifu_generated_tokens_total",
+            "Generated tokens returned by completed requests",
+            labelnames=("replica",),
+        ).labels(replica=r)
+        self._c_cancel = m.counter(
+            "shifu_cancellations_total",
+            "cancel() calls that dropped a live request",
+            labelnames=("replica",),
+        ).labels(replica=r)
+        self._g_queue = m.gauge(
+            "shifu_queue_depth",
+            "Engine-side request queue depth (updated on every "
+            "enqueue/dequeue)",
+            labelnames=("replica", "component"),
+        ).labels(replica=r, component="engine")
+        self._g_active = m.gauge(
+            "shifu_active_slots",
+            "Occupied slots (decoding + mid-chunked-prefill)",
+            labelnames=("replica",),
+        ).labels(replica=r)
+
+    def set_replica(self, label) -> None:
+        """Re-label this engine's metric series (the dp router calls
+        this so per-replica dispatch/fold phases stay distinguishable)."""
+        self.replica_label = str(label)
+        self._obs_bind()
+
+    def _obs_step_gauges(self) -> None:
+        """Per-step gauge refresh (paged subclass adds pool gauges)."""
+        self._g_active.set(self.active_slots)
+
+    def counters(self) -> dict:
+        """Uniform observability counters — the /healthz//statz
+        protocol (no more hasattr probing; every engine class answers
+        the same way; the dp router aggregates with a per-replica
+        breakdown)."""
+        return {
+            "active_slots": self.active_slots,
+            "max_slots": self.max_slots,
+            "queued": len(self._queue),
+            "cancellations": self.cancellations,
+            "requests_completed": self.requests_completed,
+            "tokens_generated": self.tokens_generated,
+        }
+
     def step(self) -> List[Completion]:
         """Admit queued requests into free slots, advance any chunked
         prefills by one chunk, then decode one token for every active
         slot. Returns requests that completed this step."""
+        t_admit = time.monotonic()
+        admitted = 0
         while self._free and self._queue:
             if not self._try_admit(self._queue[0]):
                 break  # admission blocked (e.g. paged pool dry): wait
             self._queue.popleft()
+            admitted += 1
         # One prompt chunk per prefilling slot per step, so a long
         # admission never stalls active decodes (paged engines with
         # prefill_chunk; no-op otherwise).
         self._advance_prefills()
+        if admitted or self._prefilling:
+            # Only steps that did admission work observe the phase — an
+            # every-step zero would drown the histogram.
+            self._h_phase["admit"].observe(time.monotonic() - t_admit)
+        if admitted:
+            self._g_queue.set(len(self._queue))
         # Requests can finish AT admission (prefill sampled eos, or a
         # 1-token budget) — sweep before decoding would append an extra
         # token past eos/budget.
         done = self._sweep()
+        self._obs_step_gauges()
         if not self._active:
             return done
         self._pre_decode(self._decode_reach())
@@ -776,18 +899,28 @@ class Engine:
     def _dispatch_decode(self, cur, lengths, active, sub) -> None:
         """Run one decode dispatch for all active slots and fold the
         results into host state. Speculative engines override with the
-        propose/verify round program."""
+        propose/verify round program.
+
+        Instrumented: the program-dispatch and host-fold wall times go
+        to the per-replica ``shifu_step_phase_seconds`` histograms, and
+        each slot's emitted tokens observe ``shifu_request_itl_seconds``
+        (window wall time / tokens emitted in it — every slot advances
+        together, so the dispatch window IS the per-slot gap)."""
+        t0 = time.monotonic()
+        emitted: Dict[int, int] = {}
         if self.decode_chunk == 1:
             nxt, lps, self.cache, *cts = self._decode_jit(
                 self.params, self.cache, cur, lengths, active,
                 *self._decode_extra_args(), sub,
             )
+            t1 = time.monotonic()
             if cts:
                 self._counts_dev = cts[0]
             nxt, lps = np.asarray(nxt), np.asarray(lps)
             bias_updates: List[tuple] = []
             for slot, req in self._active.items():
                 token = int(nxt[slot])
+                emitted[slot] = 1
                 req.generated.append(token)
                 req.logprobs.append(float(lps[slot]))
                 self._lengths[slot] += 1
@@ -802,6 +935,7 @@ class Engine:
                         req.generated.pop()
                         req.logprobs.pop()
                         req.max_new_tokens = max(len(req.generated), 1)
+                        emitted[slot] = 0
                         continue
                     # Advance the FSM with the emitted token; the NEXT
                     # state's mask joins this dispatch's batched row
@@ -835,6 +969,7 @@ class Engine:
                     jnp.asarray(remaining), *self._decode_extra_args(), sub,
                 )
             )
+            t1 = time.monotonic()
             if cts:
                 self._counts_dev = cts[0]
             toks, n_emit = np.asarray(toks), np.asarray(n_emit)
@@ -842,6 +977,7 @@ class Engine:
             cur2, lengths2 = np.asarray(cur2), np.asarray(lengths2)
             for slot, req in self._active.items():
                 n = int(n_emit[slot])
+                emitted[slot] = n
                 req.generated.extend(int(t) for t in toks[slot, :n])
                 req.logprobs.extend(float(x) for x in lps[slot, :n])
                 self._lengths[slot] = int(lengths2[slot])
@@ -850,6 +986,19 @@ class Engine:
                 # host mirror replays the emitted tokens (and clamps
                 # the budget when the constraint is exhausted).
                 self._replay_fsm(req, n)
+        self._obs_dispatch(t0, t1, emitted)
+
+    def _obs_dispatch(self, t0: float, t1: float, emitted) -> None:
+        """Record one decode window's phase + ITL observations
+        (``emitted``: slot -> tokens this window). Shared with the
+        speculative engines' round dispatch."""
+        t2 = time.monotonic()
+        self._h_phase["dispatch"].observe(t1 - t0)
+        self._h_phase["fold"].observe(t2 - t1)
+        dt = t2 - t0
+        for n in emitted.values():
+            if n > 0:
+                self._h_itl.observe(dt / n, n=n)
 
     def _try_admit(self, req: "_Request") -> bool:
         """Admit ``req`` (a free slot is guaranteed by the caller).
@@ -1456,8 +1605,11 @@ class Engine:
         finally:
             req.prefill_ms += 1000 * (time.monotonic() - t0)
 
-    def _timing(self, req: _Request, n_tokens: int) -> dict:
-        """Close out one request's trace (Completion.timing)."""
+    def _timing(self, req: _Request, n_tokens: int,
+                finished_by: str = "length") -> dict:
+        """Close out one request's trace (Completion.timing): the span
+        record, the rolling latency window, and the registry mirrors
+        (ttft/tpot histograms + request/token counters)."""
         now = time.monotonic()
         ft = req.first_token_ts or now
         ttft = 1000 * (ft - req.created_ts) if req.created_ts else 0.0
@@ -1473,6 +1625,9 @@ class Engine:
             else 0.0
         )
         t = {
+            # Submit stamp on the engine's monotonic clock: the anchor
+            # the Chrome trace export places spans with (obs/trace.py).
+            "t0_ms": round(req.created_ts * 1000.0, 3),
             "queue_ms": round(max(queued, 0.0), 2),
             "prefill_ms": round(req.prefill_ms, 2),
             "ttft_ms": round(ttft, 2),
@@ -1487,6 +1642,20 @@ class Engine:
             )
         with self._trace_lock:
             self._trace_window.append(t)
+        # Registry mirrors: one ttft observation per request, one
+        # tpot observation per DECODE token (so histogram counts line
+        # up with request/token totals on the scrape side).
+        self.requests_completed += 1
+        self.tokens_generated += n_tokens
+        self._h_ttft.observe(ttft / 1000.0)
+        if n_tokens > 1 and decode_ms > 0:
+            self._h_tpot.observe(
+                decode_ms / 1000.0 / (n_tokens - 1), n=n_tokens - 1
+            )
+        self._c_requests.get(
+            finished_by, self._c_requests["length"]
+        ).inc()
+        self._c_tokens.inc(n_tokens)
         return t
 
     def _sweep(self) -> List[Completion]:
@@ -1502,7 +1671,7 @@ class Engine:
                     Completion(
                         req.rid, req.generated[:cut], "stop",
                         logprobs=req.logprobs[:cut],
-                        timing=self._timing(req, cut),
+                        timing=self._timing(req, cut, "stop"),
                     )
                 )
                 del self._active[slot]
@@ -1519,7 +1688,10 @@ class Engine:
                         list(req.generated),
                         "eos" if hit_eos else "length",
                         logprobs=list(req.logprobs),
-                        timing=self._timing(req, len(req.generated)),
+                        timing=self._timing(
+                            req, len(req.generated),
+                            "eos" if hit_eos else "length",
+                        ),
                     )
                 )
                 del self._active[slot]
@@ -1545,7 +1717,7 @@ class Engine:
                 return None
             return vals[min(int(q * len(vals)), len(vals) - 1)]
 
-        return {
+        out = {
             "completions": len(win),
             "ttft_ms_p50": pct("ttft_ms", 0.50),
             "ttft_ms_p95": pct("ttft_ms", 0.95),
@@ -1555,6 +1727,19 @@ class Engine:
                 sum(1 for t in win if t["preemptions"]) / len(win), 4
             ),
         }
+        # Token-level distributions come from the registry histograms
+        # (the trace window is per-request; ITL/TPOT are per-token).
+        lab = {"replica": self.replica_label}
+        for key, name, q in (
+            ("itl_ms_p50", "shifu_request_itl_seconds", 0.50),
+            ("itl_ms_p99", "shifu_request_itl_seconds", 0.99),
+            ("tpot_ms_p50", "shifu_request_tpot_seconds", 0.50),
+            ("tpot_ms_p99", "shifu_request_tpot_seconds", 0.99),
+        ):
+            v = self.metrics.quantile(name, q, lab)
+            if v is not None:
+                out[key] = round(v * 1000.0, 3)
+        return out
 
     def run(self) -> List[Completion]:
         """Drain everything; completions in finish order."""
@@ -1930,6 +2115,41 @@ class PagedEngine(Engine):
     def free_pages(self) -> int:
         return len(self._free_pages)
 
+    # -------------------------------------------------- observability
+    def _obs_bind(self) -> None:
+        super()._obs_bind()
+        m, r = self.metrics, self.replica_label
+        self._c_preempt = m.counter(
+            "shifu_preemptions_total",
+            "Recompute preemptions (paged pool ran dry)",
+            labelnames=("replica",),
+        ).labels(replica=r)
+        self._c_prefix_hits = m.counter(
+            "shifu_prefix_hit_tokens_total",
+            "Prompt tokens served from the prefix cache",
+            labelnames=("replica",),
+        ).labels(replica=r)
+        self._g_free_pages = m.gauge(
+            "shifu_free_pages",
+            "Free pages in the paged KV pool",
+            labelnames=("replica",),
+        ).labels(replica=r)
+
+    def _obs_step_gauges(self) -> None:
+        super()._obs_step_gauges()
+        self._g_free_pages.set(len(self._free_pages))
+
+    def counters(self) -> dict:
+        out = super().counters()
+        out.update(
+            preemptions=self.preemptions,
+            free_pages=self.free_pages,
+            n_pages=self.n_pages,
+            prefix_hits_tokens=self.prefix_hits_tokens,
+            window_pages_reclaimed=self.window_pages_reclaimed,
+        )
+        return out
+
     def submit(
         self,
         prompt_tokens,
@@ -2066,6 +2286,8 @@ class PagedEngine(Engine):
         self._queue.appendleft(req)
         req.preempts += 1
         self.preemptions += 1
+        self._c_preempt.inc()
+        self._g_queue.set(len(self._queue))
 
     @staticmethod
     def _chain_key(parent: bytes, page_tokens) -> bytes:
@@ -2156,6 +2378,7 @@ class PagedEngine(Engine):
             self._prefilling[slot] = req
             if hit:
                 self.prefix_hits_tokens += hit
+                self._c_prefix_hits.inc(hit)
             return True
         bucket = self._bucket_for(len(suffix))
         need = bucket // ps  # prefill scatters whole buckets of pages
@@ -2186,6 +2409,7 @@ class PagedEngine(Engine):
                     samp=samp, final_len=p,
                 )
                 self.prefix_hits_tokens += hit
+                self._c_prefix_hits.inc(hit)
             else:
                 first, lp = self._dispatch_prefill(
                     slot, padded, p, bucket, sub, samp

@@ -10,15 +10,23 @@ and collective-bound; the replicas stream their full weights locally).
 
 :class:`ReplicatedEngine` is that router. It is DUCK-TYPED like
 :class:`~shifu_tpu.infer.engine.Engine` — submit/step/run/cancel/idle/
-live_generated/latency_stats and the observability attributes — so the
-HTTP server (infer/server.py) and the CLI drive it unchanged. Requests
-are routed at submit time to the replica with the most free capacity
-(free slots first, then shortest queue); completions are re-keyed onto
+live_generated/counters/latency_stats — so the HTTP server
+(infer/server.py) and the CLI drive it unchanged. Requests are routed
+at submit time to the replica with the most free capacity (free slots
+first, then shortest queue); completions are re-keyed onto
 router-global rids. Each replica is an ordinary engine on its own
-``jax.sharding.Mesh`` whose dispatches are ASYNC — the router's
-round-robin step() keeps every replica's device busy from one host
-thread (dispatch N runs while dispatch N-1 executes), so one engine
-thread drives the whole group.
+``jax.sharding.Mesh``.
+
+SERIALIZATION CAVEAT (VERDICT row 79): the router's step() loop is
+serialized today — each replica's ``step()`` host-syncs (folds) its
+dispatch before the next replica dispatches, so replica i+1's device
+sits idle during replica i's fold. True cross-replica overlap (dispatch
+every replica, then fold every replica) is future work; the per-replica
+``shifu_step_phase_seconds{phase="dispatch"|"fold"}`` histograms on
+``GET /metrics`` are the measurement of record for it — the fold
+fraction of the step bounds the throughput the overlap fix can
+recover. Each replica's metric series is labelled ``replica="<i>"``
+(the router calls ``set_replica`` at construction).
 
 Determinism: routing never changes results — engines are deterministic
 given (prompt, sampling, seed), and each replica holds identical
@@ -78,6 +86,13 @@ class ReplicatedEngine:
         self.enable_penalties = first.enable_penalties
         self.enable_logit_bias = first.enable_logit_bias
         self.lora = first.lora
+        # Observability: label each replica's metric series so the
+        # per-replica dispatch/fold phases stay distinguishable on
+        # /metrics; the router exposes the first engine's registry.
+        self.metrics = getattr(first, "metrics", None)
+        for i, e in enumerate(self.engines):
+            if hasattr(e, "set_replica"):
+                e.set_replica(str(i))
 
     # ------------------------------------------------------------ routing
     def _pick(self) -> int:
@@ -127,9 +142,12 @@ class ReplicatedEngine:
 
     # ------------------------------------------------------------ driving
     def step(self):
-        """One step on every replica. Dispatches are async per device
-        sub-mesh, so replica i+1's dispatch overlaps replica i's device
-        execution; the host sync happens inside each engine's fold."""
+        """One step on every replica, SERIALIZED (VERDICT row 79):
+        replica i's step() folds — host-syncs — before replica i+1
+        dispatches, so replicas do not overlap device execution yet.
+        The per-replica ``shifu_step_phase_seconds`` dispatch/fold
+        histograms quantify exactly what an overlapped loop would
+        recover."""
         out = []
         for idx, eng in enumerate(self.engines):
             for c in eng.step():
@@ -219,6 +237,30 @@ class ReplicatedEngine:
     def prefix_hits_tokens(self):
         return self._sum("prefix_hits_tokens")
 
+    def counters(self) -> dict:
+        """Uniform counters protocol: every numeric counter summed over
+        replicas, plus the per-replica breakdown (the load-balance
+        surface). ``acceptance_rate`` is re-derived from the summed
+        spec counters rather than summed."""
+        per = []
+        totals: dict = {}
+        for i, e in enumerate(self.engines):
+            c = e.counters()
+            for k, v in c.items():
+                if isinstance(v, bool) or not isinstance(v, (int, float)):
+                    continue
+                if k == "acceptance_rate":
+                    continue
+                totals[k] = totals.get(k, 0) + v
+            per.append({"replica": i, "routed": self.routed[i], **c})
+        if totals.get("spec_proposed"):
+            totals["acceptance_rate"] = round(
+                totals.get("spec_accepted", 0) / totals["spec_proposed"],
+                4,
+            )
+        totals["replicas"] = per
+        return totals
+
     def latency_stats(self) -> dict:
         """Pooled percentiles over every replica's trace window, plus
         per-replica breakdowns (the load-balance surface operators
@@ -242,7 +284,7 @@ class ReplicatedEngine:
                 return None
             return vals[min(int(q * len(vals)), len(vals) - 1)]
 
-        return {
+        out = {
             "completions": len(wins),
             "ttft_ms_p50": pct("ttft_ms", 0.50),
             "ttft_ms_p95": pct("ttft_ms", 0.95),
@@ -253,6 +295,19 @@ class ReplicatedEngine:
             ),
             "replicas": per,
         }
+        # Token-level ITL/TPOT pooled over every replica's histogram
+        # (registry-derived; per-replica splits live on /metrics).
+        if self.metrics is not None:
+            for key, name, q in (
+                ("itl_ms_p50", "shifu_request_itl_seconds", 0.50),
+                ("itl_ms_p99", "shifu_request_itl_seconds", 0.99),
+                ("tpot_ms_p50", "shifu_request_tpot_seconds", 0.50),
+                ("tpot_ms_p99", "shifu_request_tpot_seconds", 0.99),
+            ):
+                v = self.metrics.quantile(name, q)
+                if v is not None:
+                    out[key] = round(v * 1000.0, 3)
+        return out
 
 
 def build_replicated(make_engine, *, dp: int, tp: int = 1,

@@ -30,6 +30,17 @@ one step.
                           OpenAI-shaped {"object": "list", "data":
                           [{"embedding": [...], "index": i}]}
     GET  /healthz         -> engine stats (slots, queue, pages, ...)
+                          via the uniform Engine.counters() /
+                          latency_stats() protocol (no hasattr probing)
+    GET  /statz           -> machine-readable twin: {"engine":
+                          counters, "latency": latency_stats,
+                          "runner": {...}, "metrics": registry
+                          snapshot}
+    GET  /metrics         -> Prometheus text exposition of the
+                          engine's metrics registry (TTFT/TPOT/ITL
+                          histograms, per-replica step phases, queue
+                          gauges, train metrics when co-resident —
+                          see docs/observability.md)
 
 Sampling: engine-level by default (one compiled decode program). On an
 engine built with ``per_request_sampling=True``, requests may carry
@@ -82,10 +93,12 @@ import json
 import queue
 import re
 import threading
+import time
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from shifu_tpu import obs as _obs
 from shifu_tpu.infer.engine import Completion, Engine
 from shifu_tpu.infer.sampling import SampleConfig
 
@@ -489,6 +502,20 @@ class EngineRunner:
         self._trace_f = open(trace_log, "a", buffering=1) if trace_log else None
         self._lock = threading.Lock()
         self._inbox: collections.deque = collections.deque()
+        # Observability: the engine's registry (process-global unless
+        # the engine was built with its own). The inbox gauge is
+        # updated on EVERY enqueue/dequeue so queue depth over time is
+        # scrapeable, not sample-on-request only.
+        self.metrics = getattr(engine, "metrics", None) or _obs.REGISTRY
+        self._g_inbox = self.metrics.gauge(
+            "shifu_runner_inbox_depth",
+            "Submissions handed to the runner, not yet drained by the "
+            "engine thread",
+        ).labels()
+        self._h_detok = self.metrics.histogram(
+            "shifu_detokenize_seconds",
+            "Response assembly (detokenize + trim) per completion",
+        ).labels()
         self._cancels: collections.deque = collections.deque()  # rids
         self._waiters: dict = {}  # rid -> _Waiter
         # Compiled beam searchers, keyed (num_beams, max_new, penalty,
@@ -567,6 +594,7 @@ class EngineRunner:
                         json_schema=json_schema,
                     )
                 )
+        self._g_inbox.set(len(self._inbox))
         self._wake.set()
         deadline = (
             _time.monotonic() + timeout if timeout is not None else None
@@ -613,6 +641,7 @@ class EngineRunner:
                     float(length_penalty), w,
                 )
             )
+        self._g_inbox.set(len(self._inbox))
         self._wake.set()
         if not w.event.wait(timeout):
             self._abandon(w)
@@ -636,6 +665,7 @@ class EngineRunner:
             self._inbox.append(
                 _EmbedJob([list(r) for r in rows], pooling, w)
             )
+        self._g_inbox.set(len(self._inbox))
         self._wake.set()
         if not w.event.wait(timeout):
             self._abandon(w)
@@ -677,6 +707,7 @@ class EngineRunner:
                     json_schema=json_schema,
                 )
             )
+        self._g_inbox.set(len(self._inbox))
         self._wake.set()
 
         def events():
@@ -721,28 +752,24 @@ class EngineRunner:
                 # engine thread is inside submit): flag it so the
                 # registration step cancels instead.
                 self._inflight_abandoned = True
+        self._g_inbox.set(len(self._inbox))
         self._wake.set()
 
     def stats(self) -> dict:
+        """The /healthz dict, via the uniform ``Engine.counters()`` /
+        ``latency_stats()`` protocol every engine class implements
+        (plain, paged, both speculative, the dp router) — no more
+        hasattr probing. ``queued`` = engine queue + runner inbox (both
+        are also live registry gauges; see docs/observability.md)."""
         eng = self.engine
-        out = {
-            "active_slots": eng.active_slots,
-            "max_slots": eng.max_slots,
-            "queued": len(eng._queue) + len(self._inbox),
-            "idle": eng.idle,
-            "healthy": self.fatal is None and not self._stop.is_set(),
-        }
+        out = dict(eng.counters())
+        out["queued"] = out.get("queued", 0) + len(self._inbox)
+        out["runner_inbox"] = len(self._inbox)
+        out["idle"] = eng.idle
+        out["healthy"] = self.fatal is None and not self._stop.is_set()
         if self.fatal is not None:
             out["fatal"] = repr(self.fatal)
-        for attr in (
-            "free_pages", "n_pages", "preemptions", "prefix_hits_tokens",
-            "cancellations", "spec_proposed", "spec_accepted",
-            "acceptance_rate",
-        ):
-            if hasattr(eng, attr):
-                out[attr] = getattr(eng, attr)
-        if hasattr(eng, "latency_stats"):
-            out["latency"] = eng.latency_stats()
+        out["latency"] = eng.latency_stats()
         return out
 
     def shutdown(self, timeout: float = 10.0) -> None:
@@ -760,6 +787,7 @@ class EngineRunner:
             self._inbox.clear()
             waiters = list(self._waiters.values())
             self._waiters.clear()
+        self._g_inbox.set(0)
         for item in pending:
             item.waiter.fail(RuntimeError("engine runner shut down"))
         for w in waiters:
@@ -879,6 +907,7 @@ class EngineRunner:
                 if not isinstance(sub, (_BeamJob, _EmbedJob)):
                     self._inflight = sub.waiter
                     self._inflight_abandoned = False
+            self._g_inbox.set(len(self._inbox))
             if isinstance(sub, _EmbedJob):
                 self._run_embed(sub)
                 continue
@@ -1013,6 +1042,33 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):
         if self.path == "/healthz":
             self._send(200, self.runner.stats())
+        elif self.path == "/metrics":
+            # Prometheus text exposition of the engine's registry
+            # (the process-global one unless the engine was built with
+            # its own) — scrape this.
+            body = self.runner.metrics.render().encode()
+            self.send_response(200)
+            self.send_header(
+                "Content-Type",
+                "text/plain; version=0.0.4; charset=utf-8",
+            )
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        elif self.path == "/statz":
+            # The machine-readable twin: uniform counters/latency plus
+            # a JSON snapshot of every registry series.
+            eng = self.runner.engine
+            self._send(200, {
+                "engine": eng.counters(),
+                "latency": eng.latency_stats(),
+                "runner": {
+                    "inbox": len(self.runner._inbox),
+                    "healthy": self.runner.fatal is None
+                    and not self.runner._stop.is_set(),
+                },
+                "metrics": self.runner.metrics.snapshot(),
+            })
         elif self.path == "/v1/models":
             eng = self.runner.engine
             cfg = getattr(eng.model, "cfg", None)
@@ -1266,6 +1322,14 @@ class _Handler(BaseHTTPRequestHandler):
                 parts.append(f"<|assistant|>\n{calls}\n")
         parts.append("<|assistant|>\n")
         return self.tokenizer.encode("".join(parts))
+
+    def _timed_choice(self, done, want_logprobs, stop_strings) -> dict:
+        """_build_choice + the detokenize-phase histogram (response
+        assembly is the one request phase the engine cannot time)."""
+        t0 = time.monotonic()
+        c = _build_choice(done, self.tokenizer, want_logprobs, stop_strings)
+        self.runner._h_detok.observe(time.monotonic() - t0)
+        return c
 
     @staticmethod
     def _as_chat_choice(choice: dict, tools=None) -> dict:
@@ -1527,9 +1591,7 @@ class _Handler(BaseHTTPRequestHandler):
                     regex=regex, json_schema=json_schema,
                 )
                 choices = [
-                    _build_choice(
-                        d, self.tokenizer, want_logprobs, stop_strings
-                    )
+                    self._timed_choice(d, want_logprobs, stop_strings)
                     for d in dones
                 ]
                 if chat:
@@ -1558,9 +1620,7 @@ class _Handler(BaseHTTPRequestHandler):
         except RuntimeError as e:
             self._send(503, {"error": str(e)})
             return
-        choice = _build_choice(
-            done, self.tokenizer, want_logprobs, stop_strings
-        )
+        choice = self._timed_choice(done, want_logprobs, stop_strings)
         out = (
             self._as_chat_choice(choice, tools=tools) if chat else choice
         )

@@ -174,6 +174,29 @@ class _SpeculativeBase(PagedEngine):
             else 0.0
         )
 
+    def _obs_bind(self) -> None:
+        super()._obs_bind()
+        m, r = self.metrics, self.replica_label
+        self._c_spec_prop = m.counter(
+            "shifu_spec_proposed_total",
+            "Speculative tokens proposed (draft or lookup)",
+            labelnames=("replica",),
+        ).labels(replica=r)
+        self._c_spec_acc = m.counter(
+            "shifu_spec_accepted_total",
+            "Speculative proposals accepted by the verify step",
+            labelnames=("replica",),
+        ).labels(replica=r)
+
+    def counters(self) -> dict:
+        out = super().counters()
+        out.update(
+            spec_proposed=self.spec_proposed,
+            spec_accepted=self.spec_accepted,
+            acceptance_rate=round(self.acceptance_rate, 4),
+        )
+        return out
+
     # --------------------------------------- constrained verification
     # Device-side DFA plumbing for FSM-constrained rows inside a
     # speculative round (the engine's device-resident pool,
@@ -351,11 +374,15 @@ class _SpeculativeBase(PagedEngine):
 
     def _fold_rounds(self, outs, lps, n_accs, ms, lives, cur2, lengths2):
         """Host-side: extend each active request by its per-round
-        accepted tokens and update acceptance stats."""
+        accepted tokens and update acceptance stats. Returns
+        {slot: tokens emitted this dispatch} for the ITL observations
+        (_obs_dispatch)."""
         outs, lps = np.asarray(outs), np.asarray(lps)
         n_accs, ms = np.asarray(n_accs), np.asarray(ms)
         lives = np.asarray(lives)
         cur2, lengths2 = np.asarray(cur2), np.asarray(lengths2)
+        prop0, acc0 = self.spec_proposed, self.spec_accepted
+        emitted = {}
         for slot, req in self._active.items():
             len0 = len(req.generated)
             for r in range(self.rounds_per_step):
@@ -371,6 +398,10 @@ class _SpeculativeBase(PagedEngine):
             # device; replay the emitted tokens so the host mirror
             # stays authoritative (and clamp at exhaustion).
             self._replay_fsm(req, len(req.generated) - len0)
+            emitted[slot] = len(req.generated) - len0
+        self._c_spec_prop.inc(self.spec_proposed - prop0)
+        self._c_spec_acc.inc(self.spec_accepted - acc0)
+        return emitted
 
 
 class SpeculativePagedEngine(_SpeculativeBase):
@@ -500,6 +531,9 @@ class SpeculativePagedEngine(_SpeculativeBase):
 
     # -------------------------------------------------------------- decode
     def _dispatch_decode(self, cur, lengths, active, sub) -> None:
+        import time as _time
+
+        t0 = _time.monotonic()
         remaining = np.zeros((self.max_slots,), np.int32)
         for slot, req in self._active.items():
             remaining[slot] = req.max_new_tokens - len(req.generated)
@@ -513,9 +547,13 @@ class SpeculativePagedEngine(_SpeculativeBase):
             # engine prepends it), binding the named ``table`` param.
             *self._decode_extra_args(), sub,
         )
+        t1 = _time.monotonic()
         if cts:
             self._counts_dev = cts[0]
-        self._fold_rounds(outs, lps, n_accs, ms, lives, cur2, lengths2)
+        emitted = self._fold_rounds(
+            outs, lps, n_accs, ms, lives, cur2, lengths2
+        )
+        self._obs_dispatch(t0, t1, emitted)
 
     def _spec_impl(
         self, params, cache, d_cache, d_params, cur, lengths, active,
@@ -748,6 +786,9 @@ class PromptLookupPagedEngine(_SpeculativeBase):
         )
 
     def _dispatch_decode(self, cur, lengths, active, sub) -> None:
+        import time as _time
+
+        t0 = _time.monotonic()
         remaining = np.zeros((self.max_slots,), np.int32)
         buf = np.zeros((self.max_slots, self._buf_len), np.int32)
         for slot, req in self._active.items():
@@ -768,9 +809,13 @@ class PromptLookupPagedEngine(_SpeculativeBase):
             # engine prepends it), binding the named ``table`` param.
             *self._decode_extra_args(), sub,
         )
+        t1 = _time.monotonic()
         if cts:
             self._counts_dev = cts[0]
-        self._fold_rounds(outs, lps, n_accs, ms, lives, cur2, lengths2)
+        emitted = self._fold_rounds(
+            outs, lps, n_accs, ms, lives, cur2, lengths2
+        )
+        self._obs_dispatch(t0, t1, emitted)
 
     def _spec_impl(
         self, params, cache, cur, lengths, active, remaining, buf,

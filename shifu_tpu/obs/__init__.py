@@ -1,0 +1,40 @@
+"""Observability: metrics registry, Prometheus exposition, request tracing.
+
+Dependency-free (stdlib only) and cheap enough to update on the engine
+thread per step. One process-global :data:`REGISTRY` is the default sink
+for every subsystem — the serving engines, the HTTP server, the training
+loop, and the bench all write to it, so ``GET /metrics`` and the train
+JSONL log are two views of one source of truth. Tests (or embedders that
+want isolation) construct their own :class:`MetricsRegistry` and pass it
+via ``Engine(metrics=...)`` / ``MetricsLogger(registry=...)``.
+
+Modules:
+
+``registry``  counters / gauges / fixed-bucket histograms with labels,
+              the Prometheus text-exposition renderer, a JSON snapshot,
+              histogram quantile estimation, and a text-format parser
+              (used by tests and the driver's dryrun scrape).
+``trace``     per-request span records -> Chrome trace-event JSON
+              (``shifu_tpu trace export``), complementing the
+              device-side ``jax.profiler`` traces with host wall-clock
+              queue -> prefill -> decode spans.
+"""
+
+from shifu_tpu.obs.registry import (
+    DEFAULT_BUCKETS,
+    MetricsRegistry,
+    parse_exposition,
+)
+from shifu_tpu.obs.trace import chrome_trace, export_trace_log
+
+# The process-global default registry (see module docstring).
+REGISTRY = MetricsRegistry()
+
+__all__ = [
+    "DEFAULT_BUCKETS",
+    "MetricsRegistry",
+    "REGISTRY",
+    "chrome_trace",
+    "export_trace_log",
+    "parse_exposition",
+]

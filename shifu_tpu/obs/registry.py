@@ -1,0 +1,402 @@
+"""Metrics registry: counters, gauges, fixed-bucket histograms, labels.
+
+Design constraints (the serving engine thread is the hot writer):
+
+  * ``observe``/``inc``/``set`` are a dict hit away from a couple of
+    float ops — no locks on the write path. Python's GIL makes each
+    individual ``+=`` effectively atomic, and every engine metric has a
+    single writer (the engine thread) anyway; scrape threads only read.
+    A torn read across two fields of one histogram can at worst skew a
+    rate by one sample — acceptable for monitoring data.
+  * Label children are pre-bound by callers (``family.labels(...)``
+    once, then the child is a plain object held in a slot) so the hot
+    path never touches the registry dict or builds label tuples.
+  * Histograms use FIXED buckets chosen at family creation: observe is
+    one bisect over a small tuple plus three adds. Quantiles are
+    estimated by linear interpolation inside the containing bucket —
+    the estimation error is bounded by that bucket's width (tested
+    against numpy percentiles in tests/test_obs.py).
+
+Exposition follows the Prometheus text format 0.0.4: one ``# HELP`` and
+``# TYPE`` line per family, samples as ``name{label="value"} value``,
+histograms as cumulative ``_bucket{le="..."}`` series plus ``_sum`` /
+``_count``. Label values escape ``\\``, ``"`` and newlines per the spec.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+import threading
+from bisect import bisect_left
+from typing import Dict, Optional, Sequence, Tuple
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+# Latency-shaped default (seconds): sub-millisecond dispatch costs up to
+# multi-second tail prefills all land in a finite bucket.
+DEFAULT_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+    0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+
+
+def escape_label_value(v: str) -> str:
+    return (
+        str(v)
+        .replace("\\", "\\\\")
+        .replace("\n", "\\n")
+        .replace('"', '\\"')
+    )
+
+
+def _escape_help(h: str) -> str:
+    return str(h).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _fmt(v) -> str:
+    """Sample-value formatting: integral floats print as ints (half the
+    bytes on count-heavy scrapes), +Inf per the exposition spec."""
+    if v == math.inf:
+        return "+Inf"
+    f = float(v)
+    if f.is_integer() and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+class Counter:
+    """Monotone accumulator. Single-writer hot path; see module notes."""
+
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0.0
+
+    def inc(self, n: float = 1.0) -> None:
+        self.value += n
+
+
+class Gauge:
+    """Point-in-time value (queue depth, active slots)."""
+
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0.0
+
+    def set(self, v: float) -> None:
+        self.value = float(v)
+
+    def inc(self, n: float = 1.0) -> None:
+        self.value += n
+
+    def dec(self, n: float = 1.0) -> None:
+        self.value -= n
+
+
+class Histogram:
+    """Fixed-bucket histogram. ``buckets`` are inclusive upper edges
+    (Prometheus ``le`` semantics); a final +Inf bucket is implicit."""
+
+    __slots__ = ("buckets", "counts", "sum", "count")
+
+    def __init__(self, buckets: Tuple[float, ...]):
+        self.buckets = buckets
+        self.counts = [0] * (len(buckets) + 1)  # +Inf last
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, value: float, n: int = 1) -> None:
+        # bisect_left over the edge tuple: value <= edge -> that bucket
+        # (inclusive upper bound, so an exact edge value counts IN its
+        # edge's bucket — tested in tests/test_obs.py).
+        self.counts[bisect_left(self.buckets, value)] += n
+        self.sum += value * n
+        self.count += n
+
+    def quantile(self, q: float) -> Optional[float]:
+        return _bucket_quantile(self.buckets, self.counts, self.count, q)
+
+
+def _bucket_quantile(buckets, counts, total, q: float) -> Optional[float]:
+    """Linear interpolation inside the containing bucket (error bounded
+    by that bucket's width). The +Inf bucket clamps to the last finite
+    edge — the honest answer when the tail escaped the chosen buckets."""
+    if total <= 0:
+        return None
+    rank = q * total
+    cum = 0.0
+    for i, c in enumerate(counts):
+        if not c:
+            continue
+        lo = buckets[i - 1] if i else 0.0
+        hi = buckets[i] if i < len(buckets) else None
+        if cum + c >= rank:
+            if hi is None:  # +Inf bucket
+                return buckets[-1] if buckets else None
+            frac = (rank - cum) / c
+            return lo + (hi - lo) * min(max(frac, 0.0), 1.0)
+        cum += c
+    return buckets[-1] if buckets else None
+
+
+class _Family:
+    """One named metric family: kind + help + label schema + children."""
+
+    def __init__(self, name: str, kind: str, help: str,
+                 labelnames: Tuple[str, ...],
+                 buckets: Optional[Tuple[float, ...]] = None):
+        self.name = name
+        self.kind = kind
+        self.help = help
+        self.labelnames = labelnames
+        self.buckets = buckets
+        self._children: Dict[Tuple[str, ...], object] = {}
+        self._lock = threading.Lock()
+
+    def labels(self, **labels):
+        """The child for this label combination (created on first use).
+        Callers bind once and hold the child — the hot path never comes
+        back here."""
+        if set(labels) != set(self.labelnames):
+            raise ValueError(
+                f"{self.name}: labels {sorted(labels)} != declared "
+                f"{sorted(self.labelnames)}"
+            )
+        key = tuple(str(labels[k]) for k in self.labelnames)
+        child = self._children.get(key)
+        if child is None:
+            with self._lock:
+                child = self._children.get(key)
+                if child is None:
+                    if self.kind == "counter":
+                        child = Counter()
+                    elif self.kind == "gauge":
+                        child = Gauge()
+                    else:
+                        child = Histogram(self.buckets)
+                    self._children[key] = child
+        return child
+
+    # Zero-label convenience: family proxies to its () child.
+    def inc(self, n: float = 1.0) -> None:
+        self.labels().inc(n)
+
+    def set(self, v: float) -> None:
+        self.labels().set(v)
+
+    def dec(self, n: float = 1.0) -> None:
+        self.labels().dec(n)
+
+    def observe(self, value: float, n: int = 1) -> None:
+        self.labels().observe(value, n)
+
+    def _label_str(self, key: Tuple[str, ...], extra: str = "") -> str:
+        parts = [
+            f'{ln}="{escape_label_value(lv)}"'
+            for ln, lv in zip(self.labelnames, key)
+        ]
+        if extra:
+            parts.append(extra)
+        return "{" + ",".join(parts) + "}" if parts else ""
+
+    def render(self) -> str:
+        lines = [
+            f"# HELP {self.name} {_escape_help(self.help)}",
+            f"# TYPE {self.name} {self.kind}",
+        ]
+        for key in sorted(self._children):
+            child = self._children[key]
+            if self.kind in ("counter", "gauge"):
+                lines.append(
+                    f"{self.name}{self._label_str(key)} "
+                    f"{_fmt(child.value)}"
+                )
+                continue
+            cum = 0
+            for edge, c in zip(
+                (*child.buckets, math.inf), child.counts
+            ):
+                cum += c
+                le = 'le="' + _fmt(edge) + '"'
+                lines.append(
+                    f"{self.name}_bucket{self._label_str(key, le)} "
+                    f"{_fmt(cum)}"
+                )
+            lines.append(
+                f"{self.name}_sum{self._label_str(key)} {_fmt(child.sum)}"
+            )
+            lines.append(
+                f"{self.name}_count{self._label_str(key)} "
+                f"{_fmt(child.count)}"
+            )
+        return "\n".join(lines)
+
+    def snapshot(self) -> dict:
+        out: dict = {"kind": self.kind, "help": self.help}
+        series = []
+        for key in sorted(self._children):
+            child = self._children[key]
+            entry: dict = {"labels": dict(zip(self.labelnames, key))}
+            if self.kind in ("counter", "gauge"):
+                entry["value"] = child.value
+            else:
+                entry.update(
+                    sum=child.sum, count=child.count,
+                    buckets=list(child.buckets),
+                    counts=list(child.counts),
+                )
+                for q, name in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
+                    v = child.quantile(q)
+                    if v is not None:
+                        entry[name] = v
+            series.append(entry)
+        out["series"] = series
+        return out
+
+
+class MetricsRegistry:
+    """Get-or-create families by name; render the whole set.
+
+    Re-declaring an existing name is the COMMON path (every engine in
+    the process declares the same serving families) and must return the
+    same family; a kind/label/bucket mismatch is a programming error
+    and raises."""
+
+    def __init__(self):
+        self._families: Dict[str, _Family] = {}
+        self._lock = threading.Lock()
+
+    def _get(self, name, kind, help, labelnames, buckets=None) -> _Family:
+        if not _NAME_RE.match(name):
+            raise ValueError(f"invalid metric name {name!r}")
+        labelnames = tuple(labelnames)
+        for ln in labelnames:
+            if not _LABEL_RE.match(ln) or ln == "le":
+                raise ValueError(f"invalid label name {ln!r}")
+        fam = self._families.get(name)
+        if fam is None:
+            with self._lock:
+                fam = self._families.get(name)
+                if fam is None:
+                    fam = _Family(name, kind, help, labelnames, buckets)
+                    self._families[name] = fam
+                    return fam
+        if (
+            fam.kind != kind
+            or fam.labelnames != labelnames
+            or (buckets is not None and fam.buckets != buckets)
+        ):
+            raise ValueError(
+                f"metric {name!r} re-declared with a different "
+                f"kind/labels/buckets (have {fam.kind}/{fam.labelnames})"
+            )
+        return fam
+
+    def counter(self, name: str, help: str = "",
+                labelnames: Sequence[str] = ()) -> _Family:
+        return self._get(name, "counter", help, labelnames)
+
+    def gauge(self, name: str, help: str = "",
+              labelnames: Sequence[str] = ()) -> _Family:
+        return self._get(name, "gauge", help, labelnames)
+
+    def histogram(self, name: str, help: str = "",
+                  labelnames: Sequence[str] = (),
+                  buckets: Sequence[float] = DEFAULT_BUCKETS) -> _Family:
+        buckets = tuple(sorted(float(b) for b in buckets))
+        if not buckets:
+            raise ValueError("histogram needs at least one bucket edge")
+        return self._get(name, "histogram", help, labelnames, buckets)
+
+    def render(self) -> str:
+        """The full Prometheus text exposition (``GET /metrics``)."""
+        with self._lock:
+            fams = [self._families[n] for n in sorted(self._families)]
+        return "\n".join(f.render() for f in fams) + "\n"
+
+    def snapshot(self) -> dict:
+        """JSON-able view of every family (``GET /statz``)."""
+        with self._lock:
+            fams = dict(self._families)
+        return {name: fams[name].snapshot() for name in sorted(fams)}
+
+    def quantile(self, name: str, q: float,
+                 labels: Optional[dict] = None) -> Optional[float]:
+        """Estimated quantile over a histogram family, pooling every
+        child whose labels are a superset of ``labels`` (None = all
+        children — e.g. ttft across every replica)."""
+        fam = self._families.get(name)
+        if fam is None or fam.kind != "histogram":
+            return None
+        want = {k: str(v) for k, v in (labels or {}).items()}
+        counts = [0] * (len(fam.buckets) + 1)
+        total = 0
+        for key, child in list(fam._children.items()):
+            kv = dict(zip(fam.labelnames, key))
+            if any(kv.get(k) != v for k, v in want.items()):
+                continue
+            for i, c in enumerate(child.counts):
+                counts[i] += c
+            total += child.count
+        return _bucket_quantile(fam.buckets, counts, total, q)
+
+    def value(self, name: str, labels: Optional[dict] = None) -> float:
+        """Summed counter/gauge value over matching children (0 when the
+        family or combination does not exist — convenient for tests)."""
+        fam = self._families.get(name)
+        if fam is None or fam.kind == "histogram":
+            return 0.0
+        want = {k: str(v) for k, v in (labels or {}).items()}
+        total = 0.0
+        for key, child in list(fam._children.items()):
+            kv = dict(zip(fam.labelnames, key))
+            if any(kv.get(k) != v for k, v in want.items()):
+                continue
+            total += child.value
+        return total
+
+
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+(\S+)(?:\s+-?\d+)?$"
+)
+_LABEL_PAIR_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def _unescape(v: str) -> str:
+    return (
+        v.replace("\\n", "\n").replace('\\"', '"').replace("\\\\", "\\")
+    )
+
+
+def parse_exposition(text: str) -> Dict[tuple, float]:
+    """Parse Prometheus text exposition into
+    ``{(name, frozenset(label_items)): value}`` — the assertion surface
+    for tests and the driver's dryrun scrape. Raises ValueError on a
+    line that matches neither a comment nor the sample grammar, so the
+    parse doubles as a conformance check."""
+    out: Dict[tuple, float] = {}
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            raise ValueError(f"unparseable exposition line: {raw!r}")
+        name, labelblob, value = m.groups()
+        labels = {}
+        if labelblob:
+            consumed = 0
+            for lm in _LABEL_PAIR_RE.finditer(labelblob):
+                labels[lm.group(1)] = _unescape(lm.group(2))
+                consumed = lm.end()
+            rest = labelblob[consumed:].strip(", ")
+            if rest:
+                raise ValueError(
+                    f"unparseable label block in line: {raw!r}"
+                )
+        v = math.inf if value == "+Inf" else float(value)
+        out[(name, frozenset(labels.items()))] = v
+    return out

@@ -1,0 +1,108 @@
+"""Per-request span records -> Chrome trace-event JSON.
+
+The serving engines stamp each request's host wall-clock phases —
+submit, admission, first token, finish — and the runner's ``trace_log``
+persists one JSON line per completion (rid, finished_by, n_tokens plus
+the ``Completion.timing`` spans, including ``t0_ms``, the submit stamp
+on the engine's monotonic clock). This module turns those records into
+the Chrome trace-event format (``chrome://tracing`` / Perfetto), one
+track per request with non-overlapping queue -> prefill -> decode
+spans — the host-side complement to the device-side ``jax.profiler``
+traces.
+
+Span layout per request (all on the engine's monotonic clock):
+
+  queue    [t0, t0 + queue_ms)                submit -> first admission
+  prefill  [t0 + queue_ms, .. + prefill_ms)   admission dispatch(es)
+  decode   [t0 + ttft_ms, .. + decode_ms)     first token -> finish
+
+``prefill_ms`` also accumulates post-first-token re-prefills (chunked
+prefill, preemption recompute), which could overlap the decode span;
+the exporter clamps the prefill span at the decode start so tracks stay
+well-formed, and carries the raw value in ``args`` for the curious.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Iterable, List, Optional
+
+PHASES = ("queue", "prefill", "decode")
+
+# Extra keys carried verbatim into each event's args block.
+_ARG_KEYS = (
+    "rid", "finished_by", "n_tokens", "preemptions", "prefill_ms",
+    "decode_tokens_per_s",
+)
+
+
+def spans_from_record(rec: dict) -> List[dict]:
+    """One trace-log record -> its Chrome trace events (may be empty
+    for a record without timing spans)."""
+    t0 = float(rec.get("t0_ms", 0.0))
+    queue = max(float(rec.get("queue_ms", 0.0)), 0.0)
+    prefill = max(float(rec.get("prefill_ms", 0.0)), 0.0)
+    ttft = max(float(rec.get("ttft_ms", 0.0)), queue)
+    decode = max(float(rec.get("decode_ms", 0.0)), 0.0)
+    rid = rec.get("rid", 0)
+    args = {k: rec[k] for k in _ARG_KEYS if k in rec}
+
+    # Non-overlap invariants: queue ends where prefill starts; prefill
+    # is clamped into [queue end, decode start]; decode starts at ttft
+    # (>= queue + clamped prefill by construction).
+    pre_end = min(queue + prefill, ttft)
+    spans = (
+        ("queue", t0, queue),
+        ("prefill", t0 + queue, max(pre_end - queue, 0.0)),
+        ("decode", t0 + ttft, decode),
+    )
+    events = []
+    for name, start_ms, dur_ms in spans:
+        events.append({
+            "name": name,
+            "cat": "request",
+            "ph": "X",  # complete event: ts + dur
+            "pid": 0,
+            "tid": int(rid),
+            "ts": round(start_ms * 1000.0, 1),   # microseconds
+            "dur": round(dur_ms * 1000.0, 1),
+            "args": args,
+        })
+    return events
+
+
+def chrome_trace(records: Iterable[dict]) -> dict:
+    """Trace-log records -> a Chrome trace-event JSON object."""
+    events: List[dict] = []
+    for rec in records:
+        events.extend(spans_from_record(rec))
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {"source": "shifu_tpu trace export"},
+    }
+
+
+def export_trace_log(in_path: str, out_path: Optional[str] = None) -> dict:
+    """Read a runner ``trace_log`` JSONL file and emit Chrome trace
+    JSON — the ``shifu_tpu trace export`` implementation. Returns the
+    trace object; when ``out_path`` is given the JSON is also written
+    there. Unparseable lines are skipped (a crash mid-write leaves a
+    torn last line; the rest of the log is still good)."""
+    records = []
+    with open(in_path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict):
+                records.append(rec)
+    trace = chrome_trace(records)
+    if out_path:
+        with open(out_path, "w", encoding="utf-8") as f:
+            json.dump(trace, f)
+    return trace

@@ -100,6 +100,21 @@ class Trainer:
             self.state = TrainState.create(model.init(rng), optimizer)
             self.step_fn = make_train_step(model, optimizer, **step_kw)
 
+        # Observability: the train loop shares the serving registry
+        # (docs/observability.md) — step durations as a histogram, the
+        # step counter as a monotone counter; MetricsLogger mirrors the
+        # per-log scalar values as gauges.
+        from shifu_tpu import obs
+
+        self._h_step_s = obs.REGISTRY.histogram(
+            "shifu_train_step_seconds",
+            "Train-loop step wall time (dispatch-to-dispatch; excludes "
+            "the compile step)",
+        ).labels()
+        self._c_steps = obs.REGISTRY.counter(
+            "shifu_train_steps_total", "Train-loop steps dispatched"
+        ).labels()
+
         self.ckpt = None
         if cfg.ckpt_dir:
             from shifu_tpu.checkpoint import Checkpointer
@@ -212,6 +227,9 @@ class Trainer:
         loop_at_last_log = start
         metrics = {}
         batch, batch_state = first, first_state
+        import time as _time
+
+        prev_t = None
         try:
             for n in range(start, cfg.total_steps):
                 self.state, metrics = self.step_fn(self.state, batch)
@@ -222,6 +240,11 @@ class Trainer:
                     self._loader_state = batch_state
                 self._loop_step = n + 1
                 thr.tick()
+                now = _time.perf_counter()
+                if prev_t is not None:  # first gap includes the compile
+                    self._h_step_s.observe(now - prev_t)
+                prev_t = now
+                self._c_steps.inc()
 
                 if (n + 1) % cfg.log_every == 0 or n + 1 == cfg.total_steps:
                     rec = {k: float(v) for k, v in metrics.items()}

@@ -103,15 +103,37 @@ class MetricsLogger:
     Each ``log`` call writes ``{"step": n, ...scalars}``; values are
     coerced to python floats (device scalars sync here — call it at the
     logging cadence, not every step, if host round-trips matter).
+
+    Every numeric value is ALSO mirrored into the observability
+    registry (``registry``, default the process-global
+    ``obs.REGISTRY``) as ``shifu_train_last{metric="<key>"}`` gauges
+    plus a ``shifu_train_log_lines_total`` counter and a
+    ``shifu_train_step`` gauge — so the JSONL file and ``GET /metrics``
+    are two views of one source of truth (docs/observability.md).
     """
 
-    def __init__(self, path: Optional[str] = None, echo: bool = True):
+    def __init__(self, path: Optional[str] = None, echo: bool = True,
+                 registry=None):
+        from shifu_tpu import obs
+
         self.path = path
         self.echo = echo
         self._f = None
         if path:
             os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
             self._f = open(path, "a", buffering=1)
+        self.registry = registry if registry is not None else obs.REGISTRY
+        self._g_last = self.registry.gauge(
+            "shifu_train_last",
+            "Most recent value of each train-loop metric key",
+            labelnames=("metric",),
+        )
+        self._g_step = self.registry.gauge(
+            "shifu_train_step", "Most recent logged train step"
+        ).labels()
+        self._c_lines = self.registry.counter(
+            "shifu_train_log_lines_total", "MetricsLogger.log calls"
+        ).labels()
 
     def log(self, step: int, metrics: Mapping[str, Any]) -> None:
         rec = {"step": int(step)}
@@ -122,6 +144,11 @@ class MetricsLogger:
                 rec[k] = v
         if self._f:
             self._f.write(json.dumps(rec) + "\n")
+        self._g_step.set(rec["step"])
+        self._c_lines.inc()
+        for k, v in rec.items():
+            if k != "step" and isinstance(v, float):
+                self._g_last.labels(metric=k).set(v)
         if self.echo:
             body = " ".join(
                 f"{k}={v:.4g}" if isinstance(v, float) else f"{k}={v}"

@@ -1,0 +1,297 @@
+"""obs registry unit surface: counters/gauges/histograms, bucket-edge
+semantics, quantile error bounds vs numpy, Prometheus text-exposition
+conformance (HELP/TYPE lines, label escaping), the trace exporter, the
+MetricsLogger registry mirror, and the observe-cost budget."""
+
+import json
+import math
+import time
+
+import numpy as np
+import pytest
+
+from shifu_tpu.obs import MetricsRegistry, chrome_trace, parse_exposition
+
+
+# ------------------------------------------------------------- basics
+
+
+def test_counter_gauge_basic():
+    reg = MetricsRegistry()
+    c = reg.counter("t_reqs_total", "requests", ("route",))
+    c.labels(route="a").inc()
+    c.labels(route="a").inc(2)
+    c.labels(route="b").inc(5)
+    g = reg.gauge("t_depth", "queue depth")
+    g.set(3)
+    g.inc()
+    g.dec(2)
+    assert reg.value("t_reqs_total", {"route": "a"}) == 3
+    assert reg.value("t_reqs_total") == 8  # summed over children
+    assert reg.value("t_depth") == 2
+
+
+def test_family_get_or_create_and_mismatch():
+    reg = MetricsRegistry()
+    a = reg.counter("t_same_total", "x", ("l",))
+    b = reg.counter("t_same_total", "x", ("l",))
+    assert a is b
+    with pytest.raises(ValueError, match="re-declared"):
+        reg.gauge("t_same_total", "x", ("l",))
+    with pytest.raises(ValueError, match="re-declared"):
+        reg.counter("t_same_total", "x", ("other",))
+    with pytest.raises(ValueError, match="invalid metric name"):
+        reg.counter("0bad", "x")
+    with pytest.raises(ValueError, match="invalid label name"):
+        reg.counter("t_ok_total", "x", ("le",))  # reserved
+
+
+def test_labels_must_match_schema():
+    reg = MetricsRegistry()
+    f = reg.counter("t_lbl_total", "x", ("a", "b"))
+    with pytest.raises(ValueError, match="labels"):
+        f.labels(a="1")  # missing b
+
+
+# --------------------------------------------------------- histograms
+
+
+def test_histogram_bucket_edges_inclusive():
+    """``le`` is an INCLUSIVE upper bound: a value exactly on an edge
+    counts in that edge's bucket, one ulp above rolls over."""
+    reg = MetricsRegistry()
+    h = reg.histogram("t_h_seconds", "x", buckets=(1.0, 2.0, 4.0)).labels()
+    h.observe(1.0)       # -> le=1 bucket
+    h.observe(2.0)       # -> le=2
+    h.observe(2.0000001)  # -> le=4
+    h.observe(4.0)       # -> le=4
+    h.observe(99.0)      # -> +Inf
+    assert h.counts == [1, 1, 2, 1]
+    assert h.count == 5
+    samples = parse_exposition(reg.render())
+    # Cumulative bucket series.
+    def bucket(le):
+        return samples[("t_h_seconds_bucket", frozenset({("le", le)}))]
+
+    assert bucket("1") == 1
+    assert bucket("2") == 2
+    assert bucket("4") == 4
+    assert bucket("+Inf") == 5
+    assert samples[("t_h_seconds_count", frozenset())] == 5
+
+
+def test_histogram_observe_n_weights():
+    reg = MetricsRegistry()
+    h = reg.histogram("t_hn_seconds", "x", buckets=(1.0, 10.0)).labels()
+    h.observe(0.5, n=7)
+    assert h.count == 7
+    assert h.counts[0] == 7
+    assert h.sum == pytest.approx(3.5)
+
+
+@pytest.mark.parametrize("dist", ["uniform", "lognormal", "bimodal"])
+def test_histogram_quantile_error_bound(dist):
+    """Bucket-interpolated quantiles vs numpy percentiles: the error is
+    bounded by the width of the bucket containing the quantile."""
+    rng = np.random.RandomState(0)
+    if dist == "uniform":
+        xs = rng.uniform(0.0, 1.0, size=5000)
+    elif dist == "lognormal":
+        xs = np.clip(rng.lognormal(-2.0, 1.0, size=5000), 0, 10.0)
+    else:
+        # 40/60 split: no quantile under test lands exactly on the
+        # empty inter-mode gap (where EVERY value is a valid quantile
+        # and the bound is meaningless).
+        xs = np.concatenate([
+            rng.uniform(0.01, 0.05, size=2000),
+            rng.uniform(0.5, 0.9, size=3000),
+        ])
+    buckets = tuple(float(b) for b in np.geomspace(1e-3, 10.0, 40))
+    reg = MetricsRegistry()
+    h = reg.histogram("t_q_seconds", "x", buckets=buckets).labels()
+    for x in xs:
+        h.observe(float(x))
+    for q in (0.5, 0.9, 0.95, 0.99):
+        est = h.quantile(q)
+        true = float(np.percentile(xs, q * 100))
+        # Width of the bucket containing the true value.
+        import bisect
+
+        i = bisect.bisect_left(buckets, true)
+        lo = buckets[i - 1] if i else 0.0
+        hi = buckets[min(i, len(buckets) - 1)]
+        assert abs(est - true) <= (hi - lo) + 1e-12, (
+            f"{dist} q={q}: est {est} vs true {true} "
+            f"(bucket width {hi - lo})"
+        )
+
+
+def test_registry_quantile_pools_label_subsets():
+    reg = MetricsRegistry()
+    fam = reg.histogram("t_p_seconds", "x", ("replica",),
+                        buckets=(1.0, 2.0, 4.0))
+    fam.labels(replica="0").observe(1.0, n=100)
+    fam.labels(replica="1").observe(4.0, n=100)
+    # Per-replica medians sit in their own buckets...
+    assert reg.quantile("t_p_seconds", 0.5, {"replica": "0"}) <= 1.0
+    assert reg.quantile("t_p_seconds", 0.5, {"replica": "1"}) > 2.0
+    # ...the pooled p75 reaches the upper mass.
+    assert reg.quantile("t_p_seconds", 0.75) > 2.0
+    assert reg.quantile("t_missing_seconds", 0.5) is None
+
+
+# --------------------------------------------------------- exposition
+
+
+def test_exposition_conformance_and_escaping():
+    reg = MetricsRegistry()
+    c = reg.counter("t_esc_total", 'help with "quotes"\nand newline',
+                    ("path",))
+    c.labels(path='va"l\\ue\nx').inc(2)
+    reg.gauge("t_g", "g").set(1.5)
+    reg.histogram("t_eh_seconds", "h", buckets=(0.1,)).labels().observe(0.05)
+    text = reg.render()
+    lines = text.strip().splitlines()
+    # Every family renders exactly one HELP and one TYPE line, HELP
+    # first, before any of its samples.
+    for name, kind in (
+        ("t_esc_total", "counter"), ("t_g", "gauge"),
+        ("t_eh_seconds", "histogram"),
+    ):
+        help_i = lines.index(next(
+            ln for ln in lines if ln.startswith(f"# HELP {name} ")
+        ))
+        type_i = lines.index(f"# TYPE {name} {kind}")
+        assert type_i == help_i + 1
+        sample_i = next(
+            i for i, ln in enumerate(lines)
+            if ln.startswith(name) and not ln.startswith("#")
+        )
+        assert sample_i > type_i
+    # HELP newline is escaped into one physical line.
+    help_line = next(ln for ln in lines if ln.startswith("# HELP t_esc"))
+    assert "\\n" in help_line
+    # Label-value escaping round-trips through the parser.
+    samples = parse_exposition(text)
+    assert samples[
+        ("t_esc_total", frozenset({("path", 'va"l\\ue\nx')}))
+    ] == 2
+    # Histogram renders _bucket/_sum/_count with a final +Inf bucket.
+    assert ("t_eh_seconds_sum", frozenset()) in samples
+    assert samples[("t_eh_seconds_count", frozenset())] == 1
+    assert samples[
+        ("t_eh_seconds_bucket", frozenset({("le", "+Inf")}))
+    ] == 1
+
+
+def test_parse_exposition_rejects_garbage():
+    with pytest.raises(ValueError):
+        parse_exposition("this is { not a sample")
+
+
+def test_snapshot_is_json_able():
+    reg = MetricsRegistry()
+    reg.counter("t_s_total", "x", ("a",)).labels(a="1").inc()
+    reg.histogram("t_sh_seconds", "x").labels().observe(0.01)
+    snap = reg.snapshot()
+    json.dumps(snap)  # must not raise
+    assert snap["t_s_total"]["kind"] == "counter"
+    assert snap["t_sh_seconds"]["series"][0]["count"] == 1
+    assert "p50" in snap["t_sh_seconds"]["series"][0]
+
+
+# ------------------------------------------------------------ tracing
+
+
+def _rec(rid, t0, queue, prefill, ttft, decode, n=5):
+    return {
+        "rid": rid, "finished_by": "length", "n_tokens": n,
+        "t0_ms": t0, "queue_ms": queue, "prefill_ms": prefill,
+        "ttft_ms": ttft, "decode_ms": decode, "preemptions": 0,
+    }
+
+
+def test_chrome_trace_spans_cover_and_do_not_overlap():
+    trace = chrome_trace([
+        _rec(1, 1000.0, 2.0, 5.0, 8.0, 20.0),
+        # Preempted-style record: prefill_ms exceeds ttft - queue; the
+        # exporter must clamp so spans stay non-overlapping.
+        _rec(2, 1010.0, 1.0, 50.0, 9.0, 30.0),
+    ])
+    events = trace["traceEvents"]
+    by_rid = {}
+    for e in events:
+        assert e["ph"] == "X"
+        by_rid.setdefault(e["tid"], {})[e["name"]] = e
+    for rid, spans in by_rid.items():
+        assert set(spans) == {"queue", "prefill", "decode"}
+        q, p, d = spans["queue"], spans["prefill"], spans["decode"]
+        assert q["ts"] + q["dur"] <= p["ts"] + 1e-6
+        assert p["ts"] + p["dur"] <= d["ts"] + 1e-6
+        assert d["dur"] > 0
+
+
+def test_trace_export_cli(tmp_path):
+    from shifu_tpu.cli import main
+
+    log = tmp_path / "trace.jsonl"
+    with open(log, "w") as f:
+        for i in range(3):
+            f.write(json.dumps(_rec(i, 100.0 * i, 1.0, 2.0, 3.5, 10.0)))
+            f.write("\n")
+        f.write("{torn line\n")  # crash mid-write: must be skipped
+    out = tmp_path / "trace.json"
+    rc = main(["trace", "export", "--in", str(log), "--out", str(out)])
+    assert rc == 0
+    trace = json.loads(out.read_text())
+    assert len(trace["traceEvents"]) == 9  # 3 requests x 3 phases
+    tids = {e["tid"] for e in trace["traceEvents"]}
+    assert tids == {0, 1, 2}
+
+
+# --------------------------------------------------- logger mirroring
+
+
+def test_metrics_logger_mirrors_registry(tmp_path):
+    from shifu_tpu.utils.metrics import MetricsLogger
+
+    reg = MetricsRegistry()
+    log = MetricsLogger(
+        str(tmp_path / "m.jsonl"), echo=False, registry=reg
+    )
+    log.log(10, {"loss": 1.25, "tokens_per_s": 5000.0, "note": "x"})
+    log.log(20, {"loss": 1.0})
+    log.close()
+    assert reg.value("shifu_train_step") == 20
+    assert reg.value("shifu_train_log_lines_total") == 2
+    assert reg.value("shifu_train_last", {"metric": "loss"}) == 1.0
+    assert reg.value(
+        "shifu_train_last", {"metric": "tokens_per_s"}
+    ) == 5000.0
+    # The JSONL file carries the same values (two views, one truth).
+    lines = [
+        json.loads(ln)
+        for ln in (tmp_path / "m.jsonl").read_text().splitlines()
+    ]
+    assert lines[0]["loss"] == 1.25 and lines[1]["step"] == 20
+
+
+# ------------------------------------------------------------ budget
+
+
+def test_observe_overhead_budget():
+    """The engine thread observes histograms per step; the docs state
+    the measured cost (docs/observability.md Overhead). Budget here is
+    deliberately loose for noisy CI hosts — the claim being pinned is
+    the ORDER of magnitude (micro-, not milliseconds)."""
+    reg = MetricsRegistry()
+    h = reg.histogram("t_cost_seconds", "x").labels()
+    n = 20_000
+    t0 = time.perf_counter()
+    for i in range(n):
+        h.observe(0.001 * (i % 50))
+    per_op = (time.perf_counter() - t0) / n
+    assert per_op < 20e-6, f"observe cost {per_op * 1e6:.2f} us/op"
+    assert h.count == n
+    assert h.quantile(0.5) is not None
+    assert math.isfinite(h.sum)

@@ -1,0 +1,347 @@
+"""Serving observability end to end.
+
+The acceptance surface of the obs subsystem: a live HTTP server over a
+dp=2,tp=1 ReplicatedEngine serves >= 20 requests, then
+
+  * ``GET /metrics`` parses as valid Prometheus text exposition,
+  * the TTFT/TPOT/ITL histogram counts equal the request/token totals,
+  * per-replica ``shifu_step_phase_seconds`` series exist for BOTH
+    replicas (the VERDICT row-79 dispatch-vs-fold visibility),
+  * ``shifu_tpu trace export`` turns the server's trace log into
+    Chrome trace-event JSON whose spans are non-overlapping per request
+    and cover queue -> prefill -> decode.
+
+Plus the uniform counters() protocol across engine classes, the
+enqueue/dequeue-updated queue gauges, and the trace_log write-failure
+regression (ISSUE 1 satellite: disable tracing, close the file once,
+keep serving).
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import jax
+import pytest
+
+from shifu_tpu.infer import (
+    Engine,
+    PagedEngine,
+    PromptLookupPagedEngine,
+    SampleConfig,
+    make_server,
+)
+from shifu_tpu.infer.replica import ReplicatedEngine
+from shifu_tpu.infer.server import EngineRunner
+from shifu_tpu.models import Transformer, TransformerConfig
+from shifu_tpu.obs import MetricsRegistry, parse_exposition
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = TransformerConfig.tiny()
+    model = Transformer(cfg)
+    return model, model.init(jax.random.key(0))
+
+
+def _post(base, obj, timeout=300):
+    req = urllib.request.Request(
+        base + "/v1/completions",
+        data=json.dumps(obj).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def _get(base, path, timeout=60):
+    with urllib.request.urlopen(base + path, timeout=timeout) as r:
+        return r.status, r.headers, r.read()
+
+
+def _total(samples, name, **labels):
+    want = set(labels.items())
+    return sum(
+        v for (n, ls), v in samples.items()
+        if n == name and want <= set(ls)
+    )
+
+
+# ------------------------------------------------- the acceptance test
+
+
+def test_live_dp2_server_metrics_and_trace(tiny, tmp_path):
+    model, params = tiny
+    reg = MetricsRegistry()
+
+    # dp=2, tp=1: two single-device replicas behind the router. Built
+    # directly (not via build_replicated's per-replica meshes) so the
+    # test exercises the router/observability path even where this
+    # jax build lacks the mesh activation-sharding imports — the mesh
+    # variant is covered by the driver's dryrun leg.
+    grp = ReplicatedEngine([
+        PagedEngine(
+            model, params,
+            max_slots=2, max_len=32, page_size=8,
+            prefill_buckets=(16, 32),
+            sample_cfg=SampleConfig(temperature=0.0),
+            metrics=reg,
+        )
+        for _ in range(2)
+    ])
+    trace_log = tmp_path / "trace.jsonl"
+    server = make_server(grp, port=0, trace_log=str(trace_log))
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    base = f"http://127.0.0.1:{server.server_port}"
+    try:
+        # >= 20 requests: 4 posts x n=5 engine submissions each. n>1
+        # submissions land back to back, so the router spreads them
+        # over both replicas (most-free-capacity routing).
+        n_req, total_tokens = 0, 0
+        for i in range(4):
+            status, out = _post(base, {
+                "tokens": [3 + i, 5, 7, 2], "max_new_tokens": 3, "n": 5,
+            })
+            assert status == 200
+            for c in out["choices"]:
+                n_req += 1
+                total_tokens += len(c["tokens"])
+        assert n_req == 20
+        assert total_tokens == 20 * 3  # no eos configured: all length
+
+        status, headers, body = _get(base, "/metrics")
+        assert status == 200
+        assert headers["Content-Type"].startswith("text/plain")
+        samples = parse_exposition(body.decode())  # raises if malformed
+
+        # Histogram counts == request/token totals.
+        assert _total(
+            samples, "shifu_request_ttft_seconds_count"
+        ) == n_req
+        assert _total(
+            samples, "shifu_request_tpot_seconds_count"
+        ) == total_tokens - n_req
+        assert _total(
+            samples, "shifu_request_itl_seconds_count"
+        ) == total_tokens - n_req
+        assert _total(
+            samples, "shifu_generated_tokens_total"
+        ) == total_tokens
+        assert _total(
+            samples, "shifu_requests_completed_total"
+        ) == n_req
+
+        # Per-replica step phases exist for BOTH replicas — the
+        # dispatch-vs-fold serialization (VERDICT row 79) is visible.
+        for rep in ("0", "1"):
+            for phase in ("dispatch", "fold"):
+                assert _total(
+                    samples, "shifu_step_phase_seconds_count",
+                    replica=rep, phase=phase,
+                ) > 0, f"replica {rep} phase {phase} missing"
+            assert _total(
+                samples, "shifu_requests_completed_total", replica=rep
+            ) > 0, f"replica {rep} served nothing"
+
+        # /statz: the machine-readable twin over the uniform protocol.
+        status, _, body = _get(base, "/statz")
+        assert status == 200
+        statz = json.loads(body)
+        assert statz["engine"]["requests_completed"] == n_req
+        assert len(statz["engine"]["replicas"]) == 2
+        assert statz["latency"]["completions"] == n_req
+        assert "itl_ms_p50" in statz["latency"]
+        assert "shifu_request_ttft_seconds" in statz["metrics"]
+
+        # /healthz still answers through the same protocol.
+        status, _, body = _get(base, "/healthz")
+        health = json.loads(body)
+        assert health["healthy"] is True
+        assert health["max_slots"] == 4  # summed over replicas
+        assert "free_pages" in health
+    finally:
+        server.shutdown()
+        server.runner.shutdown()
+        t.join(5)
+
+    # ---- shifu_tpu trace export on the server's trace log ----------
+    from shifu_tpu.cli import main
+
+    out_json = tmp_path / "trace.json"
+    rc = main([
+        "trace", "export", "--in", str(trace_log), "--out", str(out_json),
+    ])
+    assert rc == 0
+    trace = json.loads(out_json.read_text())
+    events = trace["traceEvents"]
+    by_rid = {}
+    for e in events:
+        by_rid.setdefault(e["tid"], {})[e["name"]] = e
+    assert len(by_rid) == n_req  # one track per request
+    for rid, spans in by_rid.items():
+        # Cover queue -> prefill -> decode, non-overlapping, in order.
+        assert set(spans) == {"queue", "prefill", "decode"}
+        q, p, d = spans["queue"], spans["prefill"], spans["decode"]
+        assert q["ts"] + q["dur"] <= p["ts"] + 1e-6
+        assert p["ts"] + p["dur"] <= d["ts"] + 1e-6
+        assert d["dur"] > 0
+        assert spans["decode"]["args"]["n_tokens"] == 3
+
+
+# -------------------------------------- trace_log write-failure path
+
+
+class _BoomFile:
+    """File stand-in whose write always fails (full disk)."""
+
+    def __init__(self):
+        self.closes = 0
+
+    def write(self, s):
+        raise OSError("disk full")
+
+    def close(self):
+        self.closes += 1
+
+
+def test_trace_log_write_failure_disables_and_keeps_serving(tiny, capsys):
+    model, params = tiny
+    engine = PagedEngine(
+        model, params, max_slots=2, max_len=32, page_size=8,
+        prefill_buckets=(16, 32), sample_cfg=SampleConfig(temperature=0.0),
+        metrics=MetricsRegistry(),
+    )
+    runner = EngineRunner(engine)
+    boom = _BoomFile()
+    runner._trace_f = boom  # tracing "enabled" onto a failing disk
+    try:
+        done = runner.complete([1, 2, 3], 4, timeout=120)
+        assert len(done.tokens) == 4  # the completion still returned
+        # Tracing disabled, the handle closed EXACTLY once, loudly.
+        assert runner._trace_f is None
+        assert boom.closes == 1
+        err = capsys.readouterr().err
+        assert "trace_log disabled" in err
+        # Serving continues (and does not try to write again).
+        done2 = runner.complete([4, 5], 3, timeout=120)
+        assert len(done2.tokens) == 3
+        assert boom.closes == 1
+        assert runner.stats()["healthy"] is True
+    finally:
+        runner.shutdown()
+
+
+# --------------------------------------------- counters() protocol
+
+
+def test_counters_protocol_across_engine_classes(tiny):
+    model, params = tiny
+    reg = MetricsRegistry()
+    base_keys = {
+        "active_slots", "max_slots", "queued", "cancellations",
+        "requests_completed", "tokens_generated",
+    }
+
+    eng = Engine(
+        model, params, max_slots=2, max_len=32,
+        prefill_buckets=(16, 32), sample_cfg=SampleConfig(temperature=0.0),
+        metrics=reg,
+    )
+    eng.submit([1, 2, 3], max_new_tokens=2)
+    eng.run()
+    c = eng.counters()
+    assert base_keys <= set(c)
+    assert c["requests_completed"] == 1 and c["tokens_generated"] == 2
+
+    paged = PagedEngine(
+        model, params, max_slots=2, max_len=32, page_size=8,
+        prefill_buckets=(16, 32), sample_cfg=SampleConfig(temperature=0.0),
+        metrics=reg,
+    )
+    c = paged.counters()
+    assert base_keys | {
+        "preemptions", "free_pages", "n_pages", "prefix_hits_tokens",
+        "window_pages_reclaimed",
+    } <= set(c)
+
+    spec = PromptLookupPagedEngine(
+        model, params, k=2, ngram=2, max_slots=2, max_len=32,
+        page_size=8, prefill_buckets=(16, 32),
+        sample_cfg=SampleConfig(temperature=0.0), metrics=reg,
+    )
+    spec.submit([7, 7, 7, 7], max_new_tokens=4)
+    spec.run()
+    c = spec.counters()
+    assert {"spec_proposed", "spec_accepted", "acceptance_rate"} <= set(c)
+    assert c["spec_proposed"] > 0
+    # Registry mirrors agree with the attribute counters.
+    assert reg.value("shifu_spec_proposed_total") == c["spec_proposed"]
+
+    grp = ReplicatedEngine([
+        Engine(
+            model, params, max_slots=2, max_len=32,
+            prefill_buckets=(16, 32),
+            sample_cfg=SampleConfig(temperature=0.0), metrics=reg,
+        )
+        for _ in range(2)
+    ])
+    # The router re-labelled its replicas' series.
+    assert [e.replica_label for e in grp.engines] == ["0", "1"]
+    rids = [grp.submit([1, 2, i + 1], max_new_tokens=2) for i in range(4)]
+    done = {x.rid for x in grp.run()}
+    assert done == set(rids)
+    c = grp.counters()
+    assert c["requests_completed"] == 4
+    assert len(c["replicas"]) == 2
+    assert sum(r["requests_completed"] for r in c["replicas"]) == 4
+
+
+# ----------------------------------------------------- queue gauges
+
+
+def test_queue_depth_gauge_tracks_enqueue_dequeue(tiny):
+    model, params = tiny
+    reg = MetricsRegistry()
+    eng = Engine(
+        model, params, max_slots=1, max_len=32,
+        prefill_buckets=(16, 32), sample_cfg=SampleConfig(temperature=0.0),
+        metrics=reg,
+    )
+
+    def depth():
+        return reg.value("shifu_queue_depth", {"component": "engine"})
+
+    rids = [eng.submit([1, 2, i + 1], max_new_tokens=2) for i in range(3)]
+    assert depth() == 3  # enqueue updated the gauge immediately
+    eng.step()  # one slot: one admitted
+    assert depth() == 2
+    assert eng.cancel(rids[2])  # dequeue via cancel updates it too
+    assert depth() == 1
+    eng.run()
+    assert depth() == 0
+
+
+def test_runner_inbox_gauge(tiny):
+    model, params = tiny
+    reg = MetricsRegistry()
+    engine = Engine(
+        model, params, max_slots=2, max_len=32,
+        prefill_buckets=(16, 32), sample_cfg=SampleConfig(temperature=0.0),
+        metrics=reg,
+    )
+    runner = EngineRunner(engine)
+    try:
+        runner.complete([1, 2, 3], 2, timeout=120)
+        # Drained by the engine thread: back to zero (the transient
+        # nonzero value is what a scrape mid-flight would see).
+        deadline = time.time() + 10
+        while time.time() < deadline and reg.value(
+            "shifu_runner_inbox_depth"
+        ):
+            time.sleep(0.01)
+        assert reg.value("shifu_runner_inbox_depth") == 0
+    finally:
+        runner.shutdown()
