@@ -31,7 +31,30 @@ import jax.numpy as jnp
 from shifu_tpu.utils.metrics import peak_flops as _peak_flops
 
 
-def main():
+def main(argv=None):
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="bench.py")
+    ap.add_argument(
+        "--baseline",
+        help="gate the compact line against this recorded round "
+             "(BENCH_rNN.json driver shape or a raw compact line); "
+             "exit 1 when any headline metric regresses past its "
+             "declared tolerance (obs/benchgate.py)",
+    )
+    ap.add_argument(
+        "--scale-tolerance", type=float, default=1.0,
+        help="multiply every declared gate tolerance",
+    )
+    args = ap.parse_args(argv)
+
+    # Compile telemetry for the whole run: the ledger ends with how
+    # many compiles the bench's engines paid (obs/compilemon.py).
+    from shifu_tpu.obs import REGISTRY as _REG
+    from shifu_tpu.obs import compilemon as _cmon
+
+    _cmon.install_jax_monitoring()
+
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu"
 
@@ -82,6 +105,20 @@ def main():
             out["serving_lookup_text"] = {
                 "error": f"{type(e).__name__}: {e}"
             }
+    # Runtime self-telemetry in the full ledger: device-memory rollup
+    # + how many compiles the bench's engines paid (the obs registry
+    # counted them via the engines' tracked programs).
+    try:
+        from shifu_tpu.utils.profiling import summarize_memory
+
+        _cmon.update_memory_gauges(_REG)
+        out["memory"] = summarize_memory()
+    except Exception:
+        pass
+    n_compiles = _REG.value("shifu_compile_total")
+    if n_compiles:
+        out["compile_total"] = int(n_compiles)
+
     full = json.dumps(out)
     sidecar = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                            "bench_full.json")
@@ -103,6 +140,29 @@ def main():
             "budget"
         )
     print(json.dumps(compact))
+
+    if args.baseline:
+        # REGRESSION GATE (runs after the compact line prints — the
+        # driver's tail capture must carry this round's numbers even
+        # when the gate fails): compare within declared per-metric
+        # tolerances and exit non-zero on regression, making the
+        # BENCH trajectory an enforced contract.
+        from shifu_tpu.obs.benchgate import check_bench, load_record
+
+        baseline = load_record(args.baseline)
+        ok, report = check_bench(
+            compact, baseline, scale_tol=args.scale_tolerance
+        )
+        print(json.dumps({"bench_gate": report}), file=sys.stderr)
+        if not ok:
+            bad = ", ".join(
+                r["key"] for r in report["regressions"]
+            )
+            print(
+                f"bench gate FAILED vs {args.baseline}: {bad}",
+                file=sys.stderr,
+            )
+            sys.exit(1)
 
 
 def _compact(out: dict) -> dict:
@@ -180,9 +240,12 @@ def _compact(out: dict) -> dict:
          g("serving_lookup_text", "draft_spec", "acceptance_rate")),
         ("dft_round_dev_ms",
          g("serving_lookup_text", "draft_spec", "round_device_ms")),
-        # draft-model spec round cost (1.2B untrained-draft leg)
-        ("spec_round_dev_ms", g("serving_spec", "round_device_ms")),
-        ("spec_acc", g("serving_spec", "acceptance_rate")),
+        # draft-model spec ROUND-COST decomposition (1.2B leg whose
+        # draft is untrained by construction — acceptance ~0 is the
+        # expected reading, not a broken headline; renamed from
+        # spec_round_dev_ms/spec_acc, VERDICT weak #5)
+        ("spec_round_cost_only_ms", g("serving_spec", "round_device_ms")),
+        ("spec_round_cost_only_acc", g("serving_spec", "acceptance_rate")),
         # secondary train legs
         ("lc_mfu", g("train_legs", "long_context", "mfu")),
         ("lcw_mfu", g("train_legs", "long_context_windowed", "mfu")),
@@ -677,6 +740,11 @@ def bench_serving_spec():
     disp = (dt_small - dt) / (SPLIT - 1)
     rps = (dt - disp) / R_BIG
     return {
+        # What this leg IS (VERDICT weak #5): a round-cost
+        # decomposition with an untrained draft — acceptance ~0 by
+        # construction, so the acceptance number is a property of the
+        # setup, not a headline.
+        "label": "round_cost_decomposition",
         "decode_tokens_per_s": round(emitted / dt, 1),
         "tokens_per_round": round(emitted / (R_BIG * slots), 3),
         "acceptance_rate": round(acc, 4),

@@ -7,6 +7,10 @@
     serve      HTTP completions server (continuous batching, paged KV)
     bpe-train  train a byte-level BPE tokenizer (native C++ core)
     trace      export serving request traces as Chrome trace-event JSON
+    debug      dump the flight-recorder ring (live server's /debugz or
+               the in-process ring)
+    obs        check-bench: gate a compact bench line against a
+               recorded baseline (exit 1 on regression)
     info       devices, native-extension status, version
 
 The CLI builds everything from flags — model preset (optionally MoE),
@@ -914,11 +918,31 @@ def build_serve_engine(args, model, params, tok):
             draft = _build_model(dargs)
             draft_params = _restore_params(dargs, draft)
 
+    # --kv: KV-cache quantization for the paged pool. int8 halves KV
+    # bytes (capacity/long-context lever) at a measured decode-latency
+    # cost; int8-b16s narrows the scale leaves to bfloat16, recovering
+    # most of that cost (~0.2% extra relative error, error-bound
+    # tested). See the decision table in docs/observability.md.
+    kv = getattr(args, "kv", "bf16") or "bf16"
+    kv_kw = {}
+    if kv != "bf16":
+        if not (args.paged or args.spec != "off"):
+            raise ValueError(
+                "--kv int8/int8-b16s needs --paged (or a --spec "
+                "engine): the int8 KV path is a paged-pool feature"
+            )
+        import jax.numpy as _jnp
+
+        kv_kw["cache_dtype"] = _jnp.int8
+        if kv == "int8-b16s":
+            kv_kw["kv_scale_dtype"] = _jnp.bfloat16
+
     def construct(params_r, mesh=None, draft_params_r=None):
         mkw = dict(kw, mesh=mesh) if mesh is not None else kw
         paged_kw = dict(
             page_size=args.page_size, n_pages=args.n_pages,
             enable_prefix_cache=args.prefix_cache,
+            **kv_kw,
         )
         if args.spec == "prompt-lookup":
             return load_adapters(PromptLookupPagedEngine(
@@ -982,6 +1006,29 @@ def cmd_serve(args) -> int:
     except ValueError as e:
         print(str(e), file=sys.stderr)
         return 2
+    if args.kv == "int8":
+        # Operator hint (VERDICT next-round #8): the capacity-vs-
+        # latency trade has a measured middle ground — see the
+        # decision table in docs/observability.md.
+        print(
+            "hint: --kv int8 halves KV bytes (capacity) but costs "
+            "decode latency (1.2B measured: bf16 4.72 ms/step, "
+            "int8-KV 5.21, int8-KV+bf16-scales 4.23); consider "
+            "--kv int8-b16s — docs/observability.md, 'KV-quant "
+            "decision table'",
+            file=sys.stderr,
+        )
+    watchdog = None
+    from shifu_tpu.obs import SLOConfig, SLOWatchdog
+
+    slo_cfg = SLOConfig(
+        p99_ttft_ms=args.slo_p99_ttft_ms,
+        p99_itl_ms=args.slo_p99_itl_ms,
+        max_step_ms=args.slo_max_step_ms,
+        max_queue_depth=args.slo_max_queue,
+    )
+    if slo_cfg.active():
+        watchdog = SLOWatchdog(slo_cfg)
     server = make_server(
         engine,
         host=args.host,
@@ -989,6 +1036,8 @@ def cmd_serve(args) -> int:
         tokenizer=tok,
         default_max_new=args.max_new_tokens,
         trace_log=args.trace_log,
+        watchdog=watchdog,
+        flight_dump=args.flight_dump,
     )
     print(
         json.dumps(
@@ -1034,6 +1083,65 @@ def cmd_trace(args) -> int:
     else:
         print(json.dumps(trace))
     return 0
+
+
+def cmd_debug(args) -> int:
+    """``shifu_tpu debug dump``: the flight-recorder ring as JSON —
+    fetched from a live server's ``GET /debugz`` (``--url``), or the
+    in-process global ring when embedding (no url). ``--out`` writes a
+    file (the same shape the runner's crash auto-dump produces);
+    otherwise the document prints to stdout."""
+    if args.url:
+        import urllib.error
+        import urllib.request
+
+        url = args.url.rstrip("/") + "/debugz"
+        if args.last:
+            url += f"?n={int(args.last)}"
+        try:
+            with urllib.request.urlopen(url, timeout=30) as r:
+                data = json.loads(r.read())
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            print(f"cannot fetch {url}: {e}", file=sys.stderr)
+            return 2
+    else:
+        from shifu_tpu import obs
+
+        data = {
+            "capacity": obs.FLIGHT.capacity,
+            "dropped": obs.FLIGHT.dropped,
+            "events": obs.FLIGHT.snapshot(last=args.last),
+        }
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(data, f)
+            f.write("\n")
+        print(json.dumps({
+            "out": args.out, "events": len(data.get("events", [])),
+        }))
+    else:
+        print(json.dumps(data))
+    return 0
+
+
+def cmd_obs(args) -> int:
+    """``shifu_tpu obs check-bench``: gate a compact bench line against
+    a recorded baseline (obs/benchgate.py). Exit 0 = within tolerance,
+    1 = regression, 2 = unusable inputs. ``bench.py --baseline`` runs
+    the same gate after a live bench."""
+    from shifu_tpu.obs.benchgate import check_bench, load_record
+
+    try:
+        baseline = load_record(args.baseline)
+        current = load_record(args.current)
+    except (OSError, ValueError) as e:
+        print(f"cannot load bench records: {e}", file=sys.stderr)
+        return 2
+    ok, report = check_bench(
+        current, baseline, scale_tol=args.scale_tolerance
+    )
+    print(json.dumps(report, indent=2))
+    return 0 if ok else 1
 
 
 def cmd_info(args) -> int:
@@ -1257,6 +1365,29 @@ def main(argv=None) -> int:
     s.add_argument("--trace-log",
                    help="append one JSON line per completed request "
                         "(timing spans) to this file")
+    s.add_argument("--kv", default="bf16",
+                   choices=["bf16", "int8", "int8-b16s"],
+                   help="KV-cache dtype for the paged pool: int8 "
+                        "halves KV bytes (capacity) at a decode-"
+                        "latency cost; int8-b16s narrows the scales "
+                        "to bf16 and recovers most of it (decision "
+                        "table: docs/observability.md)")
+    s.add_argument("--slo-p99-ttft-ms", type=float, default=None,
+                   help="SLO budget: p99 TTFT over the rolling "
+                        "completion window; breach flips /healthz to "
+                        "degraded with a reason")
+    s.add_argument("--slo-p99-itl-ms", type=float, default=None,
+                   help="SLO budget: p99 per-request mean inter-token "
+                        "latency (windowed)")
+    s.add_argument("--slo-max-step-ms", type=float, default=None,
+                   help="SLO budget: p99 engine-step wall time over "
+                        "the flight ring's recent steps")
+    s.add_argument("--slo-max-queue", type=int, default=None,
+                   help="SLO budget: engine queue + runner inbox depth")
+    s.add_argument("--flight-dump",
+                   help="write the flight-recorder ring here if the "
+                        "engine thread dies (default: a pid-stamped "
+                        "file in the temp dir)")
     s.add_argument("--mesh",
                    help="serving mesh, e.g. dp=2,tp=2: tp-device "
                         "tensor-parallel sub-meshes, dp model replicas "
@@ -1300,6 +1431,41 @@ def main(argv=None) -> int:
                     help="write the Chrome trace JSON here "
                          "(default: print to stdout)")
     tr.set_defaults(fn=cmd_trace)
+
+    dbg = sub.add_parser(
+        "debug",
+        help="runtime forensics: dump the flight-recorder ring "
+             "(last-K step/compile/preempt events) from a live server "
+             "or the in-process ring",
+    )
+    dbg.add_argument("action", choices=["dump"])
+    dbg.add_argument("--url",
+                     help="server base URL (e.g. http://127.0.0.1:8000) "
+                          "— fetches GET /debugz; omit to dump the "
+                          "in-process ring")
+    dbg.add_argument("--last", type=int, default=None,
+                     help="only the last K events")
+    dbg.add_argument("--out",
+                     help="write the JSON document here "
+                          "(default: print to stdout)")
+    dbg.set_defaults(fn=cmd_debug)
+
+    ob = sub.add_parser(
+        "obs",
+        help="observability tooling: check-bench gates a compact bench "
+             "line against a recorded baseline within declared "
+             "tolerances (exit 1 on regression)",
+    )
+    ob.add_argument("action", choices=["check-bench"])
+    ob.add_argument("--baseline", required=True,
+                    help="baseline record (BENCH_rNN.json driver shape "
+                         "or a raw compact line)")
+    ob.add_argument("--current", required=True,
+                    help="current record to gate (same shapes accepted)")
+    ob.add_argument("--scale-tolerance", type=float, default=1.0,
+                    help="multiply every declared tolerance (loosen "
+                         "the whole gate without editing specs)")
+    ob.set_defaults(fn=cmd_obs)
 
     i = sub.add_parser("info", help="environment / device info")
     i.set_defaults(fn=cmd_info)

@@ -185,6 +185,7 @@ class Engine:
         tokenizer=None,
         fsm_device_states: int = 1024,
         metrics=None,
+        flight=None,
     ):
         """``per_request_sampling``: temperature/top-k/top-p become
         per-slot TRACED arrays in the decode/prefill programs, so one
@@ -245,7 +246,11 @@ class Engine:
         dispatch/fold phase histograms, and queue/slot gauges, all
         labelled by ``replica`` (``set_replica`` rebinds — the dp
         router labels each replica at construction). See
-        docs/observability.md."""
+        docs/observability.md.
+
+        ``flight``: an ``obs.FlightRecorder`` ring for structured
+        step/compile/preemption events (default: the process-global
+        ``obs.FLIGHT``) — the ``GET /debugz`` / crash-dump surface."""
         self.model = model
         self.params = params
         self.max_slots = max_slots
@@ -270,6 +275,7 @@ class Engine:
         # replicas via set_replica; children are pre-bound so the step
         # loop's hot path is a couple of float ops per update).
         self.metrics = metrics if metrics is not None else _obs.REGISTRY
+        self.flight = flight if flight is not None else _obs.FLIGHT
         self.replica_label = "0"
         self._obs_bind()
         if decode_chunk < 1:
@@ -414,17 +420,22 @@ class Engine:
             self._n_adapters = 0
             self._row_adapter = np.zeros((max_slots,), np.int32)
 
-        self._prefill_jit = jax.jit(
+        # Compile tracking (obs/compilemon.py): cache-size growth on a
+        # call => that call compiled; the stall and count land in
+        # shifu_compile_seconds/_total{fn=...} and the flight ring, so
+        # a recompile storm in the shape-bucketed engine is visible on
+        # /metrics instead of masquerading as random slow requests.
+        self._prefill_jit = self._track_jit(jax.jit(
             self._in_act_ctx(self._prefill_impl),
             static_argnames=("bucket",),
             donate_argnums=(1,),
-        )
-        self._decode_jit = jax.jit(
+        ), "prefill")
+        self._decode_jit = self._track_jit(jax.jit(
             self._in_act_ctx(self._decode_impl), donate_argnums=(1,)
-        )
-        self._decode_chunk_jit = jax.jit(
+        ), "decode")
+        self._decode_chunk_jit = self._track_jit(jax.jit(
             self._in_act_ctx(self._decode_chunk_impl), donate_argnums=(1,)
-        )
+        ), "decode_chunk")
 
     # ------------------------------------------------------------ public
     def submit(
@@ -760,6 +771,16 @@ class Engine:
         return len(self._active) + len(self._prefilling)
 
     # -------------------------------------------------- observability
+    def _track_jit(self, fn, name: str):
+        """Wrap one of this engine's compiled programs with compile
+        telemetry, labelled ``<EngineClass>.<name>`` (obs/compilemon)."""
+        from shifu_tpu.obs import compilemon
+
+        return compilemon.tracked(
+            fn, f"{type(self).__name__}.{name}",
+            registry=self.metrics, flight=self.flight,
+        )
+
     def _obs_bind(self) -> None:
         """Pre-bind this engine's labelled metric children (called at
         construction and again by set_replica). Families are shared
@@ -851,7 +872,29 @@ class Engine:
     def step(self) -> List[Completion]:
         """Admit queued requests into free slots, advance any chunked
         prefills by one chunk, then decode one token for every active
-        slot. Returns requests that completed this step."""
+        slot. Returns requests that completed this step.
+
+        Every step leaves one ``step`` event in the flight ring
+        (duration, slot occupancy, queue depth, completions) — the
+        /debugz timeline and the watchdog's step-time window. Idle
+        polls (nothing queued or active) are not recorded: they would
+        flood the ring with noise and skew the step-time percentiles
+        the watchdog budgets against."""
+        if self.idle:
+            return self._step_impl()
+        t0 = time.monotonic()
+        done = self._step_impl()
+        self.flight.record(
+            "step",
+            replica=self.replica_label,
+            dur_ms=round((time.monotonic() - t0) * 1000.0, 3),
+            active=self.active_slots,
+            queued=len(self._queue),
+            completed=len(done),
+        )
+        return done
+
+    def _step_impl(self) -> List[Completion]:
         t_admit = time.monotonic()
         admitted = 0
         while self._free and self._queue:
@@ -1721,12 +1764,22 @@ class Engine:
             "completions": len(win),
             "ttft_ms_p50": pct("ttft_ms", 0.50),
             "ttft_ms_p95": pct("ttft_ms", 0.95),
+            # p99 over the same window: the SLO watchdog's TTFT budget
+            # reads this (a sliding view, unlike the registry
+            # histogram's run-to-date quantile).
+            "ttft_ms_p99": pct("ttft_ms", 0.99),
             "decode_tokens_per_s_p50": pct("decode_tokens_per_s", 0.50),
             "decode_tokens_per_s_p05": pct("decode_tokens_per_s", 0.05),
             "preempted_fraction": round(
                 sum(1 for t in win if t["preemptions"]) / len(win), 4
             ),
         }
+        # Windowed per-request mean inter-token gap (1000 / per-request
+        # decode tokens/s); its p99 is the gap of the window's slowest
+        # requests — the watchdog's ITL budget.
+        slow = pct("decode_tokens_per_s", 0.01)
+        if slow:
+            out["req_itl_ms_p99"] = round(1000.0 / slow, 3)
         # Token-level distributions come from the registry histograms
         # (the trace window is per-request; ITL/TPOT are per-token).
         lab = {"replica": self.replica_label}
@@ -2104,11 +2157,11 @@ class PagedEngine(Engine):
         self._pending_rows: Dict[int, np.ndarray] = {}
         self._pending_prompt: Dict[int, List[int]] = {}
         if enable_prefix_cache or prefill_chunk is not None:
-            self._prefill_at_jit = jax.jit(
+            self._prefill_at_jit = self._track_jit(jax.jit(
                 self._in_act_ctx(self._prefill_at_impl),
                 static_argnames=("bucket",),
                 donate_argnums=(1,),
-            )
+            ), "prefill_at")
 
     # ------------------------------------------------------------- sizing
     @property
@@ -2288,6 +2341,11 @@ class PagedEngine(Engine):
         self.preemptions += 1
         self._c_preempt.inc()
         self._g_queue.set(len(self._queue))
+        self.flight.record(
+            "preempt", replica=self.replica_label, rid=req.rid,
+            slot=slot, generated=len(req.generated),
+            free_pages=len(self._free_pages),
+        )
 
     @staticmethod
     def _chain_key(parent: bytes, page_tokens) -> bytes:

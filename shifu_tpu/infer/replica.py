@@ -88,8 +88,12 @@ class ReplicatedEngine:
         self.lora = first.lora
         # Observability: label each replica's metric series so the
         # per-replica dispatch/fold phases stay distinguishable on
-        # /metrics; the router exposes the first engine's registry.
+        # /metrics; the router exposes the first engine's registry and
+        # flight ring (replicas share the process-global ring unless
+        # built otherwise, so /debugz shows all replicas' step events
+        # interleaved, distinguished by their replica label).
         self.metrics = getattr(first, "metrics", None)
+        self.flight = getattr(first, "flight", None)
         for i, e in enumerate(self.engines):
             if hasattr(e, "set_replica"):
                 e.set_replica(str(i))
@@ -288,6 +292,9 @@ class ReplicatedEngine:
             "completions": len(wins),
             "ttft_ms_p50": pct("ttft_ms", 0.50),
             "ttft_ms_p95": pct("ttft_ms", 0.95),
+            # Pooled sliding-window p99 — the SLO watchdog's TTFT
+            # budget covers ALL replicas through this.
+            "ttft_ms_p99": pct("ttft_ms", 0.99),
             "decode_tokens_per_s_p50": pct("decode_tokens_per_s", 0.50),
             "decode_tokens_per_s_p05": pct("decode_tokens_per_s", 0.05),
             "preempted_fraction": round(
@@ -295,6 +302,11 @@ class ReplicatedEngine:
             ),
             "replicas": per,
         }
+        # Windowed per-request mean inter-token gap p99 (same estimator
+        # as Engine.latency_stats — the watchdog's ITL budget).
+        slow = pct("decode_tokens_per_s", 0.01)
+        if slow:
+            out["req_itl_ms_p99"] = round(1000.0 / slow, 3)
         # Token-level ITL/TPOT pooled over every replica's histogram
         # (registry-derived; per-replica splits live on /metrics).
         if self.metrics is not None:

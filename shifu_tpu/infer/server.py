@@ -39,8 +39,16 @@ one step.
     GET  /metrics         -> Prometheus text exposition of the
                           engine's metrics registry (TTFT/TPOT/ITL
                           histograms, per-replica step phases, queue
+                          gauges, compile counters, sampled HBM
                           gauges, train metrics when co-resident —
                           see docs/observability.md)
+    GET  /debugz          -> the flight-recorder ring (last-K
+                          structured step/compile/preempt events per
+                          replica) + the SLO watchdog's verdict;
+                          ?n=K limits to the tail. /healthz leads
+                          with the same verdict ("ok" | "degraded"
+                          with reasons | "dead"), and an engine-thread
+                          death auto-dumps the ring to disk.
 
 Sampling: engine-level by default (one compiled decode program). On an
 engine built with ``per_request_sampling=True``, requests may carry
@@ -492,7 +500,8 @@ class EngineRunner:
     """
 
     def __init__(self, engine: Engine, *, poll_idle_s: float = 0.005,
-                 trace_log: Optional[str] = None):
+                 trace_log: Optional[str] = None,
+                 watchdog=None, flight_dump: Optional[str] = None):
         self.engine = engine
         self._poll_idle_s = poll_idle_s
         # Optional per-request trace log: one JSON line per completion
@@ -507,6 +516,30 @@ class EngineRunner:
         # updated on EVERY enqueue/dequeue so queue depth over time is
         # scrapeable, not sample-on-request only.
         self.metrics = getattr(engine, "metrics", None) or _obs.REGISTRY
+        # Flight recorder (the engine's ring — process-global unless
+        # the engine was built with its own), SLO watchdog, and the
+        # crash-dump path: if the engine thread dies, the ring is
+        # written there so the crash leaves forensics (docs/
+        # observability.md). ``watchdog=None`` gets a budget-less
+        # watchdog: /healthz then reports "ok"/"dead" but never
+        # "degraded".
+        self.flight = getattr(engine, "flight", None) or _obs.FLIGHT
+        self.watchdog = (
+            watchdog if watchdog is not None
+            else _obs.SLOWatchdog(
+                _obs.SLOConfig(), registry=self.metrics,
+                flight=self.flight,
+            )
+        )
+        if flight_dump is None:
+            import os as _os
+            import tempfile as _tempfile
+
+            flight_dump = _os.path.join(
+                _tempfile.gettempdir(),
+                f"shifu_flight_crash_{_os.getpid()}.json",
+            )
+        self._flight_dump = flight_dump
         self._g_inbox = self.metrics.gauge(
             "shifu_runner_inbox_depth",
             "Submissions handed to the runner, not yet drained by the "
@@ -770,7 +803,22 @@ class EngineRunner:
         if self.fatal is not None:
             out["fatal"] = repr(self.fatal)
         out["latency"] = eng.latency_stats()
+        # SLO watchdog: "ok" | "degraded" (+ reasons) | "dead" — the
+        # self-diagnosis verdict /healthz leads with (sliding-window
+        # budgets; obs/watchdog.py).
+        slo = self.slo_status()
+        out["status"] = slo["status"]
+        if slo["reasons"]:
+            out["degraded_reasons"] = slo["reasons"]
         return out
+
+    def slo_status(self) -> dict:
+        """One watchdog evaluation over the live engine (called per
+        /healthz and /debugz request — pull-based, nothing on the
+        engine hot path)."""
+        return self.watchdog.evaluate(
+            self.engine, inbox_depth=len(self._inbox), fatal=self.fatal
+        )
 
     def shutdown(self, timeout: float = 10.0) -> None:
         self._stop.set()
@@ -1001,6 +1049,29 @@ class EngineRunner:
             # (healthz flips, complete() refuses new work).
             self.fatal = e
             self._stop.set()
+            # Crash forensics: the flight ring — the last-K step/
+            # compile/preempt events leading up to the death — is
+            # dumped to disk so the crash leaves evidence even when
+            # nobody was scraping /debugz. Dump failures (full disk)
+            # must not mask the original error.
+            import sys as _sys
+
+            try:
+                self.flight.record("engine_crash", error=repr(e))
+                path = self.flight.dump(
+                    self._flight_dump, extra={"error": repr(e)}
+                )
+                print(
+                    f"engine thread died: {e!r}; flight ring dumped "
+                    f"to {path}",
+                    file=_sys.stderr,
+                )
+            except Exception as dump_err:
+                print(
+                    f"engine thread died: {e!r}; flight dump failed: "
+                    f"{dump_err!r}",
+                    file=_sys.stderr,
+                )
             err = RuntimeError(f"engine thread died: {e!r}")
             err.__cause__ = e
             with self._lock:
@@ -1042,10 +1113,35 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):
         if self.path == "/healthz":
             self._send(200, self.runner.stats())
+        elif self.path.split("?", 1)[0] == "/debugz":
+            # Flight recorder: the last-K structured runtime events
+            # (engine steps per replica, compiles, preemptions,
+            # NaN-skips, crashes) plus the watchdog's verdict —
+            # ?n=K limits to the tail. Same ring a crash auto-dumps.
+            from urllib.parse import parse_qs, urlparse
+
+            q = parse_qs(urlparse(self.path).query)
+            try:
+                last = int(q["n"][0]) if "n" in q else None
+            except ValueError:
+                self._send(400, {"error": "n must be an integer"})
+                return
+            fl = self.runner.flight
+            self._send(200, {
+                "capacity": fl.capacity,
+                "dropped": fl.dropped,
+                "watchdog": self.runner.slo_status(),
+                "events": fl.snapshot(last=last),
+            })
         elif self.path == "/metrics":
             # Prometheus text exposition of the engine's registry
             # (the process-global one unless the engine was built with
-            # its own) — scrape this.
+            # its own) — scrape this. Device-memory gauges are sampled
+            # per scrape (memory_stats can RPC on tunnelled backends —
+            # too hot for the step loop).
+            from shifu_tpu.obs import compilemon
+
+            compilemon.update_memory_gauges(self.runner.metrics)
             body = self.runner.metrics.render().encode()
             self.send_response(200)
             self.send_header(
@@ -1057,7 +1153,12 @@ class _Handler(BaseHTTPRequestHandler):
             self.wfile.write(body)
         elif self.path == "/statz":
             # The machine-readable twin: uniform counters/latency plus
-            # a JSON snapshot of every registry series.
+            # a JSON snapshot of every registry series, the watchdog
+            # verdict, and a per-device memory summary.
+            from shifu_tpu.obs import compilemon
+            from shifu_tpu.utils.profiling import device_memory_stats
+
+            compilemon.update_memory_gauges(self.runner.metrics)
             eng = self.runner.engine
             self._send(200, {
                 "engine": eng.counters(),
@@ -1067,6 +1168,8 @@ class _Handler(BaseHTTPRequestHandler):
                     "healthy": self.runner.fatal is None
                     and not self.runner._stop.is_set(),
                 },
+                "watchdog": self.runner.slo_status(),
+                "memory": device_memory_stats(),
                 "metrics": self.runner.metrics.snapshot(),
             })
         elif self.path == "/v1/models":
@@ -1740,16 +1843,33 @@ def make_server(
     default_max_new: int = 128,
     request_timeout_s: Optional[float] = None,
     trace_log: Optional[str] = None,
+    watchdog=None,
+    flight_dump: Optional[str] = None,
 ) -> ThreadingHTTPServer:
     """Build (not start) the HTTP server; ``.runner`` holds the engine
     thread. Serve with ``serve_forever()``; stop with ``shutdown()``
-    then ``server.runner.shutdown()``."""
+    then ``server.runner.shutdown()``.
+
+    ``watchdog``: an ``obs.SLOWatchdog`` whose budgets /healthz reports
+    against (default: a budget-less one — never "degraded").
+    ``flight_dump``: where the flight ring is written if the engine
+    thread dies (default: a pid-stamped file in the temp dir). jax
+    compile-duration monitoring is installed process-wide here (see
+    obs/compilemon.py)."""
+    from shifu_tpu.obs import compilemon
+
+    compilemon.install_jax_monitoring(
+        getattr(engine, "metrics", None) or _obs.REGISTRY
+    )
     # String stop sequences are truncated by the ENGINE host loop, which
     # needs the tokenizer; share the server's unless the engine has its
     # own.
     if tokenizer is not None and getattr(engine, "tokenizer", None) is None:
         engine.tokenizer = tokenizer
-    runner = EngineRunner(engine, trace_log=trace_log)
+    runner = EngineRunner(
+        engine, trace_log=trace_log, watchdog=watchdog,
+        flight_dump=flight_dump,
+    )
     handler = type(
         "BoundHandler",
         (_Handler,),

@@ -160,6 +160,9 @@ class _SpeculativeBase(PagedEngine):
         self.rounds_per_step = int(rounds_per_step)
         self.spec_proposed = 0
         self.spec_accepted = 0
+        # Last (proposed, accepted) totals seen by the flight hook —
+        # per-dispatch deltas are what the /debugz timeline shows.
+        self._flight_spec_mark = (0, 0)
         super().__init__(model, params, **kw)
 
     # ------------------------------------------------------------ shared
@@ -196,6 +199,23 @@ class _SpeculativeBase(PagedEngine):
             acceptance_rate=round(self.acceptance_rate, 4),
         )
         return out
+
+    def _obs_dispatch(self, t0, t1, emitted) -> None:
+        """The shared phase/ITL recording plus one ``spec_round``
+        flight event per dispatch carrying this window's propose/accept
+        delta — an acceptance collapse shows up on the /debugz timeline
+        next to the step it happened in."""
+        super()._obs_dispatch(t0, t1, emitted)
+        prop, acc = self.spec_proposed, self.spec_accepted
+        d_prop = prop - self._flight_spec_mark[0]
+        d_acc = acc - self._flight_spec_mark[1]
+        self._flight_spec_mark = (prop, acc)
+        if d_prop:
+            self.flight.record(
+                "spec_round", replica=self.replica_label,
+                proposed=d_prop, accepted=d_acc,
+                emitted=sum(emitted.values()),
+            )
 
     # --------------------------------------- constrained verification
     # Device-side DFA plumbing for FSM-constrained rows inside a
@@ -459,14 +479,14 @@ class SpeculativePagedEngine(_SpeculativeBase):
             ),
             axes_model=draft,
         )
-        self._draft_prefill_jit = jax.jit(
+        self._draft_prefill_jit = self._track_jit(jax.jit(
             self._in_act_ctx(self._draft_prefill_impl),
             static_argnames=("bucket",),
             donate_argnums=(1,),
-        )
-        self._spec_jit = jax.jit(
+        ), "draft_prefill")
+        self._spec_jit = self._track_jit(jax.jit(
             self._in_act_ctx(self._spec_impl), donate_argnums=(1, 2)
-        )
+        ), "spec_round")
 
     # ------------------------------------------------------------ admission
     def _finish_admission(self, req, slot, p, first, lp) -> None:
@@ -781,9 +801,9 @@ class PromptLookupPagedEngine(_SpeculativeBase):
                 f"max_len {self.max_len} too small for ngram "
                 f"{self.ngram} + k {self.k}"
             )
-        self._spec_jit = jax.jit(
+        self._spec_jit = self._track_jit(jax.jit(
             self._in_act_ctx(self._spec_impl), donate_argnums=(1,)
-        )
+        ), "spec_round")
 
     def _dispatch_decode(self, cur, lengths, active, sub) -> None:
         import time as _time

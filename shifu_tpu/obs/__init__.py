@@ -1,23 +1,39 @@
-"""Observability: metrics registry, Prometheus exposition, request tracing.
+"""Observability: metrics, tracing, flight recorder, SLO watchdog.
 
-Dependency-free (stdlib only) and cheap enough to update on the engine
-thread per step. One process-global :data:`REGISTRY` is the default sink
-for every subsystem — the serving engines, the HTTP server, the training
-loop, and the bench all write to it, so ``GET /metrics`` and the train
-JSONL log are two views of one source of truth. Tests (or embedders that
-want isolation) construct their own :class:`MetricsRegistry` and pass it
-via ``Engine(metrics=...)`` / ``MetricsLogger(registry=...)``.
+Dependency-free (stdlib only; the compile/HBM telemetry imports jax
+lazily inside its functions) and cheap enough to update on the engine
+thread per step. One process-global :data:`REGISTRY` is the default
+metrics sink and one process-global :data:`FLIGHT` ring the default
+event sink for every subsystem — the serving engines, the HTTP server,
+the training loop, and the bench all write to them, so ``GET
+/metrics``, ``GET /debugz``, and the train JSONL log are views of one
+source of truth. Tests (or embedders that want isolation) construct
+their own :class:`MetricsRegistry` / :class:`FlightRecorder` and pass
+them via ``Engine(metrics=..., flight=...)``.
 
 Modules:
 
-``registry``  counters / gauges / fixed-bucket histograms with labels,
-              the Prometheus text-exposition renderer, a JSON snapshot,
-              histogram quantile estimation, and a text-format parser
-              (used by tests and the driver's dryrun scrape).
-``trace``     per-request span records -> Chrome trace-event JSON
-              (``shifu_tpu trace export``), complementing the
-              device-side ``jax.profiler`` traces with host wall-clock
-              queue -> prefill -> decode spans.
+``registry``   counters / gauges / fixed-bucket histograms with labels,
+               the Prometheus text-exposition renderer, a JSON snapshot,
+               histogram quantile estimation, and a text-format parser
+               (used by tests and the driver's dryrun scrape).
+``trace``      per-request span records -> Chrome trace-event JSON
+               (``shifu_tpu trace export``), complementing the
+               device-side ``jax.profiler`` traces with host wall-clock
+               queue -> prefill -> decode spans.
+``flight``     fixed-size ring of structured runtime events (engine
+               steps, compiles, preemptions, NaN-skips, crashes) —
+               ``GET /debugz``, ``shifu_tpu debug dump``, and the
+               runner's crash auto-dump read it.
+``watchdog``   declared SLO budgets (p99 TTFT/ITL, step time, queue
+               depth) evaluated over sliding windows; flips ``/healthz``
+               to "degraded" with reason strings.
+``compilemon`` compile telemetry (per-jitted-function recompile
+               counters/latencies + the jax.monitoring mirror) and
+               sampled HBM gauges.
+``benchgate``  bench regression gate: compact-line vs recorded baseline
+               within declared per-metric tolerances (``bench.py
+               --baseline`` / ``shifu_tpu obs check-bench``).
 """
 
 from shifu_tpu.obs.registry import (
@@ -26,14 +42,20 @@ from shifu_tpu.obs.registry import (
     parse_exposition,
 )
 from shifu_tpu.obs.trace import chrome_trace, export_trace_log
+from shifu_tpu.obs.flight import FLIGHT, FlightRecorder
+from shifu_tpu.obs.watchdog import SLOConfig, SLOWatchdog
 
 # The process-global default registry (see module docstring).
 REGISTRY = MetricsRegistry()
 
 __all__ = [
     "DEFAULT_BUCKETS",
+    "FLIGHT",
+    "FlightRecorder",
     "MetricsRegistry",
     "REGISTRY",
+    "SLOConfig",
+    "SLOWatchdog",
     "chrome_trace",
     "export_trace_log",
     "parse_exposition",

@@ -1,0 +1,164 @@
+"""Bench regression gate: compare a compact bench line against a
+recorded baseline within declared tolerances.
+
+Five rounds of BENCH_rNN.json exist and none was ever CHECKED — a perf
+regression only surfaced if a human compared JSON by eye. The gate
+turns the trajectory into an enforced contract:
+
+    python bench.py --baseline BENCH_r05.json        # gate after the run
+    python -m shifu_tpu obs check-bench \
+        --baseline BENCH_r05.json --current line.json  # offline compare
+
+Each headline metric declares a DIRECTION (is higher or lower better?)
+and a RELATIVE tolerance sized to its measured round-to-round noise
+(tunnel-fitted device times wobble a few percent; acceptance rates and
+speedup ratios more). A metric regresses when it moves PAST tolerance
+in the bad direction; improvements of any size pass. Metrics missing
+from either side are skipped (legs grow and shrink across rounds) —
+the gate checks what both rounds measured, and reports what it
+skipped so silent coverage loss is visible.
+
+Key renames are aliased (``spec_round_cost_only_ms`` reads old
+baselines' ``spec_round_dev_ms``), so the gate works against the
+pre-rename BENCH_r05.json unchanged.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, Optional, Tuple
+
+HIGHER = "higher"  # bigger is better (throughput, MFU, speedup ratios)
+LOWER = "lower"    # smaller is better (latencies, step/round times)
+
+# metric key -> (direction, relative tolerance). Tolerances encode each
+# metric's observed round-to-round noise (see module docstring).
+METRIC_SPECS: Dict[str, Tuple[str, float]] = {
+    # train headline
+    "value": (HIGHER, 0.10),            # train tokens/s
+    "mfu": (HIGHER, 0.08),
+    "step_ms": (LOWER, 0.10),
+    # serving decode, chip-true (two-point tunnel fit: a few % noise)
+    "sv_bf16_dev_ms": (LOWER, 0.15),
+    "sv_int8_dev_ms": (LOWER, 0.15),
+    "sv_kv8_dev_ms": (LOWER, 0.15),
+    "sv_kv8b_dev_ms": (LOWER, 0.15),
+    "sv_bf16_bw": (HIGHER, 0.15),
+    "sv_int8_bw": (HIGHER, 0.15),
+    "sv_kv8_bw": (HIGHER, 0.15),
+    "sv_kv8b_bw": (HIGHER, 0.15),
+    "sv_bf16_tps": (HIGHER, 0.15),
+    "sv_prefill_ms": (LOWER, 0.25),
+    # serving latency distributions (registry histograms; wall-clock
+    # through the tunnel — widest tolerance)
+    "p50_ttft_ms": (LOWER, 0.35),
+    "p99_itl_ms": (LOWER, 0.35),
+    # induction / lookup / constrained speculation
+    "ind_x_plain": (HIGHER, 0.15),
+    "ind_tps_dev": (HIGHER, 0.15),
+    "ind_plain_tps_dev": (HIGHER, 0.15),
+    "cst_x_plain": (HIGHER, 0.20),
+    "cst_tps_dev": (HIGHER, 0.20),
+    "txt_x_plain": (HIGHER, 0.20),
+    "txt_tps_dev": (HIGHER, 0.20),
+    "txt_acc": (HIGHER, 0.20),
+    "txt_tpr": (HIGHER, 0.20),
+    "lkp_round_dev_ms": (LOWER, 0.20),
+    "dft_x_plain": (HIGHER, 0.20),
+    "dft_acc": (HIGHER, 0.20),
+    "dft_round_dev_ms": (LOWER, 0.20),
+    # draft-spec round-cost decomposition (renamed keys; aliased below)
+    "spec_round_cost_only_ms": (LOWER, 0.20),
+    # secondary train legs
+    "lc_mfu": (HIGHER, 0.08),
+    "lcw_mfu": (HIGHER, 0.08),
+    "lcw_ms": (LOWER, 0.10),
+    "lcw2_mfu": (HIGHER, 0.08),
+    "lcw2_ms": (LOWER, 0.10),
+    "moe_mfu": (HIGHER, 0.10),
+}
+
+# current-key -> acceptable baseline keys (oldest last): lets a renamed
+# compact line gate against pre-rename baselines.
+BASELINE_ALIASES: Dict[str, Tuple[str, ...]] = {
+    "spec_round_cost_only_ms": ("spec_round_dev_ms",),
+    "spec_round_cost_only_acc": ("spec_acc",),
+}
+
+
+def load_record(path: str) -> dict:
+    """A compact bench line from ``path``: accepts the driver's
+    BENCH_rNN.json shape ({"parsed": {...}}), a raw compact line, or a
+    full ledger (which carries the same top-level headline keys)."""
+    with open(path) as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict):
+        raise ValueError(f"{path}: expected a JSON object")
+    if isinstance(doc.get("parsed"), dict):
+        return doc["parsed"]
+    return doc
+
+
+def _baseline_value(baseline: dict, key: str):
+    if key in baseline:
+        return baseline[key]
+    for alias in BASELINE_ALIASES.get(key, ()):
+        if alias in baseline:
+            return baseline[alias]
+    return None
+
+
+def check_bench(current: dict, baseline: dict,
+                specs: Optional[Dict[str, Tuple[str, float]]] = None,
+                scale_tol: float = 1.0) -> Tuple[bool, dict]:
+    """Gate ``current`` against ``baseline``; returns (ok, report).
+
+    ``scale_tol`` multiplies every declared tolerance (a hurried
+    operator can loosen the whole gate without editing specs). The
+    report lists every checked metric with its ratio and verdict,
+    plus the keys skipped on each side.
+    """
+    specs = specs if specs is not None else METRIC_SPECS
+    rows = []
+    regressions = []
+    skipped = []
+    for key, (direction, tol) in specs.items():
+        cur = current.get(key)
+        base = _baseline_value(baseline, key)
+        if not isinstance(cur, (int, float)) or isinstance(cur, bool):
+            if isinstance(base, (int, float)):
+                skipped.append({"key": key, "why": "missing in current"})
+            continue
+        if not isinstance(base, (int, float)) or isinstance(base, bool):
+            skipped.append({"key": key, "why": "missing in baseline"})
+            continue
+        if base == 0:
+            skipped.append({"key": key, "why": "baseline is 0"})
+            continue
+        ratio = cur / base
+        tol = tol * scale_tol
+        if direction == HIGHER:
+            bad = ratio < 1.0 - tol
+        else:
+            bad = ratio > 1.0 + tol
+        row = {
+            "key": key,
+            "baseline": base,
+            "current": cur,
+            "ratio": round(ratio, 4),
+            "direction": direction,
+            "tolerance": round(tol, 4),
+            "verdict": "REGRESSED" if bad else "ok",
+        }
+        rows.append(row)
+        if bad:
+            regressions.append(row)
+    ok = not regressions
+    report = {
+        "status": "pass" if ok else "fail",
+        "checked": len(rows),
+        "regressions": regressions,
+        "skipped": skipped,
+        "rows": rows,
+    }
+    return ok, report

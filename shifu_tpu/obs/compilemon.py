@@ -1,0 +1,189 @@
+"""Compile + device-memory telemetry.
+
+A recompile storm is the classic silent TPU serving failure: a shape
+the bucketing missed sends every Nth request through a multi-second
+XLA compile, and from outside the server just looks slow. This module
+makes compiles first-class metrics:
+
+``tracked(fn, name)``
+    Wrap a ``jax.jit``-ed callable. Each call compares the function's
+    compile-cache size before/after; growth means THIS call compiled,
+    so the call's wall time (compile + first execution — the stall a
+    client actually experiences) lands in
+    ``shifu_compile_seconds{fn=...}`` and bumps
+    ``shifu_compile_total{fn=...}``. A ``compile`` event also goes to
+    the flight ring, so /debugz shows compiles interleaved with the
+    step timeline. The per-call overhead is one ``_cache_size()``
+    C++ call (~1 µs) — the serving engines wrap their prefill/decode/
+    round programs with this (infer/engine.py, infer/spec_engine.py).
+
+``install_jax_monitoring()``
+    Register a ``jax.monitoring`` duration listener mirroring every
+    backend event whose name mentions "compile" into
+    ``shifu_jax_compile_seconds{event=...}`` — the global, no-wrapper
+    view (tracing + lowering + backend compile), complementing the
+    per-function wrappers. Idempotent; a JAX build without the hook
+    degrades to a no-op.
+
+``update_memory_gauges()``
+    Sample ``utils.profiling.device_memory_stats()`` into
+    ``shifu_hbm_bytes_in_use / shifu_hbm_peak_bytes_in_use /
+    shifu_hbm_bytes_limit{device=...}`` gauges. Sample-on-scrape: the
+    /metrics and /statz handlers call it per request (memory_stats can
+    RPC on tunnelled backends — too hot for the step loop). Backends
+    that return no stats (CPU) simply contribute no series.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+# Compile times are seconds-scale (bucketed separately from the
+# latency-shaped default buckets).
+COMPILE_BUCKETS = (
+    0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+    60.0, 120.0, 300.0,
+)
+
+
+class _TrackedJit:
+    """Callable proxy over one jitted function; see module docstring.
+    Proxies only ``__call__`` — the engines never touch other
+    attributes of their compiled programs on the hot path."""
+
+    __slots__ = ("_fn", "name", "_c", "_h", "_flight", "_sizable")
+
+    def __init__(self, fn, name: str, registry, flight):
+        self._fn = fn
+        self.name = name
+        self._c = registry.counter(
+            "shifu_compile_total",
+            "Compiles observed per tracked jitted function (cache-size "
+            "growth on a call)",
+            labelnames=("fn",),
+        ).labels(fn=name)
+        self._h = registry.histogram(
+            "shifu_compile_seconds",
+            "Wall time of calls that compiled (compile + first "
+            "execution — the stall a caller experiences)",
+            labelnames=("fn",),
+            buckets=COMPILE_BUCKETS,
+        ).labels(fn=name)
+        self._flight = flight
+        # Not every callable exposes _cache_size (plain functions in
+        # tests, future jax versions): degrade to pass-through.
+        self._sizable = hasattr(fn, "_cache_size")
+
+    def _size(self) -> Optional[int]:
+        if not self._sizable:
+            return None
+        try:
+            return self._fn._cache_size()
+        except Exception:
+            self._sizable = False
+            return None
+
+    def __call__(self, *args, **kwargs):
+        before = self._size()
+        t0 = time.perf_counter()
+        out = self._fn(*args, **kwargs)
+        if before is not None:
+            after = self._size()
+            if after is not None and after > before:
+                dt = time.perf_counter() - t0
+                self._c.inc()
+                self._h.observe(dt)
+                if self._flight is not None:
+                    self._flight.record(
+                        "compile", fn=self.name,
+                        dur_ms=round(dt * 1000.0, 2),
+                        cache_size=after,
+                    )
+        return out
+
+
+def tracked(fn, name: str, registry=None, flight=None) -> _TrackedJit:
+    """Wrap a jitted callable with compile tracking (see _TrackedJit)."""
+    from shifu_tpu import obs
+
+    return _TrackedJit(
+        fn, name,
+        registry if registry is not None else obs.REGISTRY,
+        flight if flight is not None else obs.FLIGHT,
+    )
+
+
+_monitoring_installed = False
+
+
+def install_jax_monitoring(registry=None) -> bool:
+    """Mirror jax.monitoring compile-duration events into the registry
+    (idempotent; returns whether the listener is installed)."""
+    global _monitoring_installed
+    if _monitoring_installed:
+        return True
+    from shifu_tpu import obs
+
+    reg = registry if registry is not None else obs.REGISTRY
+    try:
+        import jax.monitoring as _mon
+
+        register = _mon.register_event_duration_secs_listener
+    except (ImportError, AttributeError):
+        return False
+    fam = reg.histogram(
+        "shifu_jax_compile_seconds",
+        "jax.monitoring duration events mentioning 'compile' "
+        "(tracing/lowering/backend compile)",
+        labelnames=("event",),
+        buckets=COMPILE_BUCKETS,
+    )
+
+    def _listener(event, duration, **kw):
+        # Listener runs inside jax dispatch — never raise out of it.
+        try:
+            if "compile" in event:
+                fam.labels(event=event).observe(float(duration))
+        except Exception:
+            pass
+
+    register(_listener)
+    _monitoring_installed = True
+    return True
+
+
+_HBM_GAUGES = (
+    ("bytes_in_use", "shifu_hbm_bytes_in_use",
+     "Device memory currently allocated (bytes)"),
+    ("peak_bytes_in_use", "shifu_hbm_peak_bytes_in_use",
+     "High-water device memory (bytes)"),
+    ("bytes_limit", "shifu_hbm_bytes_limit",
+     "Device memory capacity visible to the allocator (bytes)"),
+)
+
+
+def update_memory_gauges(registry=None) -> int:
+    """Sample per-device memory stats into gauges; returns how many
+    series were updated (0 on backends that expose no stats — the CPU
+    path, tested in tests/test_selfdiag.py)."""
+    from shifu_tpu import obs
+    from shifu_tpu.utils.profiling import device_memory_stats
+
+    reg = registry if registry is not None else obs.REGISTRY
+    updated = 0
+    try:
+        stats = device_memory_stats()
+    except Exception:
+        return 0
+    for d in stats:
+        dev = d.get("device", "?")
+        for key, gname, ghelp in _HBM_GAUGES:
+            v = d.get(key)
+            if v is None:
+                continue
+            reg.gauge(gname, ghelp, labelnames=("device",)).labels(
+                device=dev
+            ).set(float(v))
+            updated += 1
+    return updated

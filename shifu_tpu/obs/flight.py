@@ -1,0 +1,97 @@
+"""Flight recorder: a fixed-size ring of structured runtime events.
+
+The black box the serving/train runtimes write their last-K step-level
+events into — engine step phases, queue depth, slot occupancy, compile
+events, NaN-skips, preemptions, crashes. Appends are O(1) and allocate
+one small dict, cheap enough for the engine thread per step; the ring
+is bounded so a long-lived server's forensics cost is constant.
+
+Read surfaces:
+
+  * ``GET /debugz`` on the serving front-end returns the ring
+    (infer/server.py);
+  * ``shifu_tpu debug dump`` fetches it from a live server or dumps the
+    in-process ring (cli.py);
+  * on engine-thread death the runner auto-dumps the ring to disk
+    (``EngineRunner(flight_dump=...)``) so a crash leaves forensics
+    instead of nothing;
+  * the SLO watchdog reads the recent ``step`` events' durations for
+    its step-time budget (obs/watchdog.py).
+
+One process-global :data:`FLIGHT` ring is the default sink (mirroring
+``obs.REGISTRY``); engines accept ``flight=`` for isolation in tests.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import threading
+import time
+from typing import List, Optional
+
+
+class FlightRecorder:
+    """Bounded ring of event dicts. Thread-safe: the engine thread
+    appends; HTTP scrape threads snapshot. ``deque.append`` is atomic
+    under the GIL, but ``snapshot`` still locks against a concurrent
+    append mutating the deque mid-``list()``."""
+
+    def __init__(self, capacity: int = 1024):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = int(capacity)
+        self._ring: collections.deque = collections.deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        # Events pushed out of the ring (how much history was lost) —
+        # lets a reader tell "quiet server" from "ring wrapped".
+        self.dropped = 0
+
+    def record(self, kind: str, **fields) -> None:
+        """Append one event. ``fields`` must be JSON-serializable
+        scalars (the ring feeds /debugz and crash dumps verbatim)."""
+        ev = {"ts": time.time(), "kind": kind}
+        ev.update(fields)
+        with self._lock:
+            if len(self._ring) == self.capacity:
+                self.dropped += 1
+            self._ring.append(ev)
+
+    def snapshot(self, last: Optional[int] = None,
+                 kind: Optional[str] = None) -> List[dict]:
+        """The ring's events, oldest first; optionally only the
+        ``last`` N, optionally filtered to one ``kind`` (the filter
+        applies BEFORE the tail cut, so ``last`` counts matching
+        events)."""
+        with self._lock:
+            events = list(self._ring)
+        if kind is not None:
+            events = [e for e in events if e.get("kind") == kind]
+        if last is not None and last >= 0:
+            events = events[-last:]
+        return events
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self.dropped = 0
+
+    def dump(self, path: str, extra: Optional[dict] = None) -> str:
+        """Write the ring (plus optional context, e.g. the crash error)
+        to ``path`` as one JSON document. Returns the path."""
+        doc = {
+            "dumped_at": time.time(),
+            "capacity": self.capacity,
+            "dropped": self.dropped,
+            "events": self.snapshot(),
+        }
+        if extra:
+            doc["extra"] = extra
+        with open(path, "w") as f:
+            json.dump(doc, f)
+            f.write("\n")
+        return path
+
+
+# The process-global default ring (see module docstring).
+FLIGHT = FlightRecorder()

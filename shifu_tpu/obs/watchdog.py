@@ -1,0 +1,181 @@
+"""SLO watchdog: declared budgets evaluated over sliding windows.
+
+A server that is *degraded* — p99 TTFT past budget, step times
+ballooning (recompile storm, HBM paging), a queue that never drains, a
+training run stuck skipping NaN gradients — looks identical to a
+healthy-but-busy one from outside. The watchdog turns declared budgets
+into a ``status`` ("ok" | "degraded" | "dead") with concrete reason
+strings, surfaced on ``/healthz`` and ``/debugz``.
+
+Every budget is evaluated over a SLIDING window, not run-to-date
+aggregates (a bad first minute must not condemn a recovered server):
+
+  * ``p99_ttft_ms`` / ``p99_itl_ms`` — from the engine's rolling
+    last-256-completions trace window (``Engine.latency_stats()``:
+    ``ttft_ms_p99`` and ``req_itl_ms_p99``, the per-request mean
+    inter-token gap's window p99).
+  * ``max_step_ms`` — p99 of the last ``window_steps`` engine-step
+    durations recorded in the flight ring (obs/flight.py), which is
+    itself a sliding window.
+  * ``max_queue_depth`` — the CURRENT engine queue + runner inbox.
+
+The evaluate() consumer is pull-based (the /healthz handler), so the
+watchdog costs nothing on the engine hot path. It covers every engine
+class through the uniform ``counters()``/``latency_stats()`` protocol —
+``Engine``, ``PagedEngine``, both speculative engines, and
+``ReplicatedEngine`` (whose pooled windows span all replicas) — and the
+train loop's sick-run detector via :meth:`note_sick` /
+:meth:`clear_sick` (train/loop.py calls them at the log cadence).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class SLOConfig:
+    """Budgets; ``None`` disables that check (the default watchdog with
+    no budgets only ever reports "ok"/"dead")."""
+
+    p99_ttft_ms: Optional[float] = None
+    p99_itl_ms: Optional[float] = None
+    max_step_ms: Optional[float] = None
+    max_queue_depth: Optional[int] = None
+    # Sliding-window sizing / flap guards: a budget only trips once its
+    # window holds enough samples to mean something.
+    window_steps: int = 128
+    min_completions: int = 4
+    min_steps: int = 8
+
+    def active(self) -> bool:
+        return any(
+            v is not None
+            for v in (self.p99_ttft_ms, self.p99_itl_ms,
+                      self.max_step_ms, self.max_queue_depth)
+        )
+
+
+def _window_p99(vals: List[float]) -> Optional[float]:
+    if not vals:
+        return None
+    vals = sorted(vals)
+    return vals[min(int(0.99 * len(vals)), len(vals) - 1)]
+
+
+class SLOWatchdog:
+    """Evaluate ``cfg`` against a live engine; see module docstring.
+
+    ``registry``/``flight`` default to the process-global sinks. The
+    result of the last :meth:`evaluate` stays on :attr:`last` (the
+    /debugz payload reads it without re-evaluating mid-render), a
+    ``shifu_slo_degraded`` gauge mirrors it for scrapes, and each
+    breach bumps ``shifu_slo_breaches_total{budget=...}``.
+    """
+
+    def __init__(self, cfg: Optional[SLOConfig] = None, *,
+                 registry=None, flight=None):
+        from shifu_tpu import obs
+
+        self.cfg = cfg if cfg is not None else SLOConfig()
+        self.registry = registry if registry is not None else obs.REGISTRY
+        self.flight = flight if flight is not None else obs.FLIGHT
+        self._g_degraded = self.registry.gauge(
+            "shifu_slo_degraded",
+            "1 while any SLO budget is breached (or a sick run is "
+            "flagged), else 0",
+        ).labels()
+        self._c_breach = self.registry.counter(
+            "shifu_slo_breaches_total",
+            "SLO budget breaches observed at evaluation time",
+            labelnames=("budget",),
+        )
+        self._sick: Optional[str] = None
+        self.last = {"status": "ok", "reasons": []}
+
+    # ------------------------------------------------ sick-run signal
+    def note_sick(self, reason: str) -> None:
+        """Force 'degraded' with ``reason`` until :meth:`clear_sick`
+        (the train loop's NaN-skip detector pushes here — its signal is
+        push-shaped, unlike the pull-evaluated serving budgets)."""
+        self._sick = str(reason)
+
+    def clear_sick(self) -> None:
+        self._sick = None
+
+    # ------------------------------------------------------- evaluate
+    def evaluate(self, engine=None, *, inbox_depth: int = 0,
+                 fatal=None) -> dict:
+        """One evaluation pass -> ``{"status", "reasons"}``.
+
+        ``engine`` is anything speaking the uniform protocol
+        (``latency_stats()`` + ``counters()``); ``inbox_depth`` adds the
+        runner's not-yet-drained submissions to the queue budget;
+        ``fatal`` (an exception) short-circuits to "dead"."""
+        if fatal is not None:
+            res = {
+                "status": "dead",
+                "reasons": [f"engine thread died: {fatal!r}"],
+            }
+            self._g_degraded.set(1.0)
+            self.last = res
+            return res
+        cfg = self.cfg
+        reasons: List[str] = []
+        if self._sick:
+            reasons.append(self._sick)
+            self._c_breach.labels(budget="sick_run").inc()
+        if engine is not None and (
+            cfg.p99_ttft_ms is not None or cfg.p99_itl_ms is not None
+        ):
+            lat = engine.latency_stats()
+            if lat.get("completions", 0) >= cfg.min_completions:
+                v = lat.get("ttft_ms_p99")
+                if cfg.p99_ttft_ms is not None and v is not None \
+                        and v > cfg.p99_ttft_ms:
+                    reasons.append(
+                        f"p99 TTFT {v:.1f} ms > budget "
+                        f"{cfg.p99_ttft_ms:g} ms (window of "
+                        f"{lat['completions']} completions)"
+                    )
+                    self._c_breach.labels(budget="p99_ttft_ms").inc()
+                v = lat.get("req_itl_ms_p99")
+                if cfg.p99_itl_ms is not None and v is not None \
+                        and v > cfg.p99_itl_ms:
+                    reasons.append(
+                        f"p99 inter-token latency {v:.2f} ms > budget "
+                        f"{cfg.p99_itl_ms:g} ms (window of "
+                        f"{lat['completions']} completions)"
+                    )
+                    self._c_breach.labels(budget="p99_itl_ms").inc()
+        if engine is not None and cfg.max_queue_depth is not None:
+            q = int(engine.counters().get("queued", 0)) + int(inbox_depth)
+            if q > cfg.max_queue_depth:
+                reasons.append(
+                    f"queue depth {q} > budget {cfg.max_queue_depth}"
+                )
+                self._c_breach.labels(budget="max_queue_depth").inc()
+        if cfg.max_step_ms is not None:
+            durs = [
+                e["dur_ms"]
+                for e in self.flight.snapshot(
+                    last=cfg.window_steps, kind="step"
+                )
+                if isinstance(e.get("dur_ms"), (int, float))
+            ]
+            if len(durs) >= cfg.min_steps:
+                v = _window_p99(durs)
+                if v is not None and v > cfg.max_step_ms:
+                    reasons.append(
+                        f"p99 engine step {v:.1f} ms > budget "
+                        f"{cfg.max_step_ms:g} ms (last {len(durs)} steps)"
+                    )
+                    self._c_breach.labels(budget="max_step_ms").inc()
+        res = {
+            "status": "degraded" if reasons else "ok",
+            "reasons": reasons,
+        }
+        self._g_degraded.set(1.0 if reasons else 0.0)
+        self.last = res
+        return res

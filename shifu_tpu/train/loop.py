@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools as _functools
+import os
 from typing import Any, Iterator, Mapping, Optional
 
 import jax
@@ -74,6 +75,7 @@ class Trainer:
         rules=None,
         eval_loader=None,
         rng: Optional[jax.Array] = None,
+        watchdog=None,
     ):
         from shifu_tpu.parallel import sharding as shd
 
@@ -114,6 +116,16 @@ class Trainer:
         self._c_steps = obs.REGISTRY.counter(
             "shifu_train_steps_total", "Train-loop steps dispatched"
         ).labels()
+        self._c_skipped = obs.REGISTRY.counter(
+            "shifu_train_skipped_steps_total",
+            "Steps whose update was skipped (non-finite gradients)",
+        ).labels()
+        # Flight recorder + optional SLO watchdog: NaN-skip windows
+        # land in the ring (and flip the watchdog to degraded while
+        # the run is sick); a sick-run abort dumps the ring to disk so
+        # the dead run leaves forensics (docs/observability.md).
+        self.flight = obs.FLIGHT
+        self.watchdog = watchdog
 
         self.ckpt = None
         if cfg.ckpt_dir:
@@ -262,15 +274,34 @@ class Trainer:
                     opt_step_at_last_log, loop_at_last_log = opt_now, n + 1
                     rec["skipped_in_window"] = skipped_in_window
                     self.logger.log(n + 1, rec)
+                    if skipped_in_window:
+                        self._c_skipped.inc(skipped_in_window)
+                        self.flight.record(
+                            "nan_skip", step=n + 1,
+                            skipped=skipped_in_window, window=window,
+                        )
                     if skipped_in_window == window:  # fully sick window
                         consecutive_skipped += window
+                        if self.watchdog is not None:
+                            self.watchdog.note_sick(
+                                f"train run sick: every step of the "
+                                f"last {consecutive_skipped} skipped "
+                                "on non-finite gradients"
+                            )
                         if consecutive_skipped > cfg.max_consecutive_skipped:
+                            self.flight.record(
+                                "sick_abort", step=n + 1,
+                                consecutive_skipped=consecutive_skipped,
+                            )
+                            self._dump_flight(n + 1)
                             raise RuntimeError(
                                 f"aborting: gradient non-finite for "
                                 f"{consecutive_skipped} consecutive steps"
                             )
                     else:
                         consecutive_skipped = 0
+                        if self.watchdog is not None:
+                            self.watchdog.clear_sick()
 
                 if (
                     cfg.eval_every
@@ -305,6 +336,27 @@ class Trainer:
                 self.ckpt.wait()
             self.close()
         return self.state
+
+    def _dump_flight(self, step: int) -> None:
+        """Write the flight ring next to the metrics file (or the temp
+        dir) before a sick-run abort — the dead run's forensics. Dump
+        failures must not mask the abort itself."""
+        import tempfile
+
+        base = self.cfg.metrics_path
+        path = (
+            base + ".flight.json"
+            if base
+            else os.path.join(
+                tempfile.gettempdir(),
+                f"shifu_train_flight_{os.getpid()}.json",
+            )
+        )
+        try:
+            self.flight.dump(path, extra={"abort_step": int(step)})
+            print(f"sick-run abort: flight ring dumped to {path}")
+        except Exception as e:
+            print(f"sick-run abort: flight dump failed: {e!r}")
 
     def _flops_per_token(self, seq: int) -> float:
         from shifu_tpu.core.module import param_count

@@ -66,3 +66,33 @@ def live_array_bytes() -> int:
     return sum(
         x.nbytes for x in jax.live_arrays() if hasattr(x, "nbytes")
     )
+
+
+def summarize_memory(
+    stats: Optional[List[Dict[str, Any]]] = None,
+) -> Dict[str, Any]:
+    """Cross-device rollup of :func:`device_memory_stats`:
+    ``{"devices", "reporting", "bytes_in_use", "peak_bytes_in_use",
+    "bytes_limit", "utilization"}``.
+
+    Totals sum only devices that REPORT the field; ``reporting`` counts
+    them, so a backend with no stats at all (CPU: ``memory_stats()``
+    is None) yields zero totals with ``reporting == 0`` rather than
+    raising — the bench ledger and HBM gauges both key off this.
+    ``utilization`` (in-use over limit) appears only when both totals
+    are real."""
+    if stats is None:
+        stats = device_memory_stats()
+    out: Dict[str, Any] = {"devices": len(stats), "reporting": 0}
+    for key in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
+        out[key] = sum(
+            d[key] for d in stats if d.get(key) is not None
+        )
+    out["reporting"] = sum(
+        1 for d in stats if d.get("bytes_in_use") is not None
+    )
+    if out["bytes_limit"]:
+        out["utilization"] = round(
+            out["bytes_in_use"] / out["bytes_limit"], 4
+        )
+    return out

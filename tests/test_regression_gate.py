@@ -1,0 +1,167 @@
+"""Bench regression gate (obs/benchgate.py + the check-bench CLI).
+
+The gate turns the BENCH_rNN.json trajectory into an enforced
+contract: the real recorded round 5 must gate cleanly against itself,
+a synthetically regressed line must fail with the offending key named,
+improvements of any size must pass, and the compact-key renames
+(VERDICT weak #5) must still compare against pre-rename baselines via
+the alias table.
+"""
+
+import json
+import os
+
+import pytest
+
+from shifu_tpu.obs.benchgate import (
+    BASELINE_ALIASES,
+    METRIC_SPECS,
+    check_bench,
+    load_record,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+R05 = os.path.join(REPO, "BENCH_r05.json")
+
+
+@pytest.fixture(scope="module")
+def baseline():
+    return load_record(R05)
+
+
+def test_load_record_unwraps_driver_shape(baseline):
+    # BENCH_r05.json is the driver's {"parsed": {...}} shape.
+    assert baseline["metric"] == "train_tokens_per_s"
+    assert "sv_bf16_dev_ms" in baseline
+
+
+def test_real_baseline_gates_clean_against_itself(baseline):
+    ok, report = check_bench(dict(baseline), baseline)
+    assert ok, report["regressions"]
+    assert report["status"] == "pass"
+    # The gate actually checked the headline surface, not two keys.
+    assert report["checked"] >= 15
+
+
+def test_synthetic_regression_fails_with_key_named(baseline):
+    cur = dict(baseline)
+    cur["sv_bf16_dev_ms"] = baseline["sv_bf16_dev_ms"] * 2.0  # 2x slower
+    cur["mfu"] = baseline["mfu"] * 0.5  # half the MFU
+    ok, report = check_bench(cur, baseline)
+    assert not ok
+    bad = {r["key"] for r in report["regressions"]}
+    assert bad == {"sv_bf16_dev_ms", "mfu"}
+    for r in report["regressions"]:
+        assert r["verdict"] == "REGRESSED"
+
+
+def test_improvements_of_any_size_pass(baseline):
+    cur = dict(baseline)
+    cur["sv_bf16_dev_ms"] = baseline["sv_bf16_dev_ms"] * 0.3  # 3x faster
+    cur["value"] = baseline["value"] * 4.0
+    ok, report = check_bench(cur, baseline)
+    assert ok, report["regressions"]
+
+
+def test_within_tolerance_noise_passes(baseline):
+    cur = {
+        k: (v * 1.02 if isinstance(v, (int, float))
+            and not isinstance(v, bool) else v)
+        for k, v in baseline.items()
+    }
+    ok, report = check_bench(cur, baseline)
+    # 2% wobble is inside every declared tolerance (the smallest is 8%).
+    assert min(tol for _, tol in METRIC_SPECS.values()) > 0.02
+    assert ok, report["regressions"]
+
+
+def test_scale_tolerance_loosens_the_gate(baseline):
+    cur = dict(baseline)
+    cur["step_ms"] = baseline["step_ms"] * 1.15  # past the 10% budget
+    ok, _ = check_bench(cur, baseline)
+    assert not ok
+    ok, _ = check_bench(cur, baseline, scale_tol=2.0)  # 20% allowed
+    assert ok
+
+
+def test_renamed_keys_alias_to_old_baseline(baseline):
+    # The pre-rename baseline carries spec_round_dev_ms; a current line
+    # with the renamed key must still be compared against it.
+    assert "spec_round_dev_ms" in baseline
+    assert "spec_round_cost_only_ms" not in baseline
+    assert BASELINE_ALIASES["spec_round_cost_only_ms"] == (
+        "spec_round_dev_ms",
+    )
+    cur = dict(baseline)
+    del cur["spec_round_dev_ms"]
+    cur["spec_round_cost_only_ms"] = baseline["spec_round_dev_ms"] * 3.0
+    ok, report = check_bench(cur, baseline)
+    assert not ok
+    assert {r["key"] for r in report["regressions"]} == {
+        "spec_round_cost_only_ms"
+    }
+
+
+def test_missing_keys_skip_but_are_reported(baseline):
+    cur = {"metric": "train_tokens_per_s", "value": baseline["value"]}
+    ok, report = check_bench(cur, baseline)
+    assert ok  # nothing checked regressed
+    assert report["checked"] == 1
+    skipped = {s["key"] for s in report["skipped"]}
+    assert "mfu" in skipped and "sv_bf16_dev_ms" in skipped
+
+
+# ----------------------------------------------------- compact renames
+
+
+def test_compact_line_uses_renamed_spec_keys():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_mod", os.path.join(REPO, "bench.py")
+    )
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    out = {
+        "metric": "train_tokens_per_s", "value": 1.0, "unit": "tokens/s",
+        "vs_baseline": 1.0,
+        "serving_spec": {
+            "label": "round_cost_decomposition",
+            "round_device_ms": 18.75, "acceptance_rate": 0.0019,
+        },
+    }
+    compact = bench._compact(out)
+    assert compact["spec_round_cost_only_ms"] == 18.75
+    assert compact["spec_round_cost_only_acc"] == 0.0019
+    assert "spec_round_dev_ms" not in compact
+    assert "spec_acc" not in compact
+
+
+# -------------------------------------------------- check-bench CLI
+
+
+def test_check_bench_cli_roundtrip(tmp_path):
+    from shifu_tpu.cli import main
+
+    base = load_record(R05)
+    good = tmp_path / "good.json"
+    good.write_text(json.dumps(base))
+    rc = main([
+        "obs", "check-bench", "--baseline", R05, "--current", str(good),
+    ])
+    assert rc == 0
+
+    bad = dict(base)
+    bad["sv_bf16_dev_ms"] = base["sv_bf16_dev_ms"] * 2.0
+    bad_p = tmp_path / "bad.json"
+    bad_p.write_text(json.dumps(bad))
+    rc = main([
+        "obs", "check-bench", "--baseline", R05, "--current", str(bad_p),
+    ])
+    assert rc == 1
+
+    rc = main([
+        "obs", "check-bench", "--baseline", R05,
+        "--current", str(tmp_path / "missing.json"),
+    ])
+    assert rc == 2
