@@ -255,6 +255,10 @@ def _compact(out: dict) -> dict:
         ("lcw2_ms",
          g("train_legs", "long_context_windowed_w2k", "step_ms")),
         ("moe_mfu", g("train_legs", "moe", "mfu")),
+        # grouped-vs-dense MoE dispatch (round 6): the measured ratio
+        # and the einsum oracle's own MFU (the "before" number)
+        ("moe_x_dense", g("train_legs", "moe", "grouped_vs_einsum")),
+        ("moe_ein_mfu", g("train_legs", "moe", "einsum_oracle", "mfu")),
         ("fit_unstable", any(
             g(*sv, leg, "fit_unstable") for leg in
             ("bf16", "int8", "int8_kv", "int8_kv_b16s")
@@ -387,8 +391,11 @@ def bench_train_long(dev):
 
 
 def bench_train_long_windowed(dev):
-    """Sliding-window variant: the kernel's chunk-skip at w=1024 over
-    s=8192 should beat full causal by a wide margin."""
+    """Sliding-window variant at w=1024 over s=8192 — w << s, so the
+    kernel auto-engages the FORCED restricted grid with a 2048-wide KV
+    block (flash_attention ``window_block_k``, round 6): grid steps and
+    K/V DMA drop to O(S*window) where the old full grid fetched O(S^2)
+    bytes and paid a grid step per skipped block."""
     from shifu_tpu.models.transformer import TransformerConfig
 
     cfg = TransformerConfig.base_1b(
@@ -415,16 +422,37 @@ def bench_train_long_windowed_w2k(dev):
 
 
 def bench_train_moe(dev):
-    """MoE leg: top-2 of 8 experts, dispatch/combine einsums + aux
-    losses on-chip (routing overhead is what this re-measures)."""
+    """MoE leg: top-2 of 8 experts with the GROUPED sorted dispatch
+    (the round-6 default — inverse-permutation gathers instead of the
+    dense (b, s, E, C) one-hot einsums) + aux losses on-chip.
+
+    The ``einsum_oracle`` sub-leg re-times the SAME config through the
+    dense dispatch/combine path (``moe_impl="einsum"``), so the grouped
+    win lands in the ledger as a measured grouped-vs-dense ratio
+    (``grouped_vs_einsum``; compact key ``moe_x_dense``) rather than an
+    assumption — and a regression that silently flips the default back
+    would show up as the ratio collapsing to ~1."""
     from shifu_tpu.models.transformer import TransformerConfig
 
-    cfg = TransformerConfig(
+    kw = dict(
         vocab_size=32_000, dim=1024, n_layers=12, n_heads=16,
         n_kv_heads=4, mlp_dim=2816, n_experts=8, moe_top_k=2,
         attn_impl="flash", remat_policy="full",
     )
-    return _train_leg(cfg, dev, batch=8, seq=2048)
+    leg = _train_leg(TransformerConfig(**kw), dev, batch=8, seq=2048)
+    try:
+        ein = _train_leg(
+            TransformerConfig(moe_impl="einsum", **kw), dev,
+            batch=8, seq=2048, steps=3,
+        )
+        leg["einsum_oracle"] = ein
+        if ein.get("mfu"):
+            leg["grouped_vs_einsum"] = round(
+                leg["mfu"] / ein["mfu"], 3
+            )
+    except Exception as e:  # the oracle sub-leg must not sink the leg
+        leg["einsum_oracle"] = {"error": f"{type(e).__name__}: {e}"}
+    return leg
 
 
 def bench_serving():

@@ -794,10 +794,13 @@ def build_serve_engine(args, model, params, tok):
     feature cmd_serve cannot construct is a feature the binary does
     not ship). Raises ValueError on incoherent flag combinations.
 
-    ``--mesh dp=D,tp=T`` (serving axes only): T-device tensor-parallel
-    sub-meshes, D model REPLICAS behind one router (ReplicatedEngine)
-    — D x T devices total. dp=1 serves one mesh engine; no flag serves
-    single-device."""
+    ``--mesh dp=D,tp=T,ep=E`` (serving axes only): T×E-device
+    sub-meshes (tp shards heads/mlp/vocab, ep shards MoE EXPERT
+    weights/buffers instead of replicating them — MoE decode memory
+    scales with the mesh), D model REPLICAS behind one router
+    (ReplicatedEngine) — D x T x E devices total. dp=1 serves one mesh
+    engine; no flag serves single-device. ``ep>1`` requires an MoE
+    model (a dense model has no experts axis to shard)."""
     from shifu_tpu.infer import (
         Engine,
         PagedEngine,
@@ -807,21 +810,33 @@ def build_serve_engine(args, model, params, tok):
     )
 
     mesh_spec = getattr(args, "mesh", None)
-    dp = tp = 1
+    dp = tp = ep = 1
     if mesh_spec:
         parts = {}
         for part in mesh_spec.split(","):
             name, _, val = part.partition("=")
             parts[name.strip()] = int(val)
-        unknown = set(parts) - {"dp", "tp"}
+        unknown = set(parts) - {"dp", "tp", "ep"}
         if unknown:
             raise ValueError(
-                f"serving mesh axes are dp/tp, got {sorted(unknown)} "
+                f"serving mesh axes are dp/tp/ep, got {sorted(unknown)} "
                 "(training meshes take the full MeshPlan axes)"
             )
         dp, tp = parts.get("dp", 1), parts.get("tp", 1)
-        if dp < 1 or tp < 1:
+        ep = parts.get("ep", 1)
+        if dp < 1 or tp < 1 or ep < 1:
             raise ValueError("serving mesh sizes must be >= 1")
+        if ep > 1 and not getattr(model.cfg, "n_experts", 0):
+            raise ValueError(
+                "--mesh ep= shards MoE expert weights; this model has "
+                "no experts (n_experts=0) — use tp/dp"
+            )
+        if ep > 1 and getattr(model.cfg, "n_experts", 0) % ep:
+            raise ValueError(
+                f"ep={ep} does not divide n_experts="
+                f"{model.cfg.n_experts}; expert weights would be "
+                "replicated silently"
+            )
 
     kw = dict(
         max_slots=args.max_slots,
@@ -961,7 +976,7 @@ def build_serve_engine(args, model, params, tok):
             ))
         return load_adapters(Engine(model, params_r, **mkw))
 
-    if dp == 1 and tp == 1:
+    if dp == 1 and tp == 1 and ep == 1:
         return construct(params, None, draft_params)
 
     import jax as _jax
@@ -969,7 +984,9 @@ def build_serve_engine(args, model, params, tok):
     from shifu_tpu.parallel import MeshPlan, shard_params
 
     if dp == 1:
-        mesh = MeshPlan(tp=tp).build(_jax.devices()[:tp])
+        mesh = MeshPlan.serving(tp=tp, ep=ep).build(
+            _jax.devices()[: tp * ep]
+        )
         return construct(
             shard_params(model, params, mesh), mesh,
             shard_params(draft, draft_params, mesh)
@@ -983,7 +1000,7 @@ def build_serve_engine(args, model, params, tok):
             shard_params(draft, draft_params, mesh)
             if draft is not None else None,
         ),
-        dp=dp, tp=tp,
+        dp=dp, tp=tp, ep=ep,
     )
 
 
@@ -1389,9 +1406,11 @@ def main(argv=None) -> int:
                         "engine thread dies (default: a pid-stamped "
                         "file in the temp dir)")
     s.add_argument("--mesh",
-                   help="serving mesh, e.g. dp=2,tp=2: tp-device "
-                        "tensor-parallel sub-meshes, dp model replicas "
-                        "behind one router (dp x tp devices total)")
+                   help="serving mesh, e.g. dp=2,tp=2 or tp=2,ep=2: "
+                        "tp shards heads/mlp, ep shards MoE expert "
+                        "weights (instead of replicating them), dp "
+                        "model replicas behind one router "
+                        "(dp x tp x ep devices total)")
     s.add_argument("--lora-ckpt-dir", action="append",
                    help="LoRA adapter checkpoint dir (repeatable; "
                         "adapter ids are assigned 1..n in flag order; "

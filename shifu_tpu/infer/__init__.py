@@ -10,8 +10,10 @@ from shifu_tpu.infer.sampling import SampleConfig, sample_logits
 from shifu_tpu.infer.generate import generate, make_generate_fn
 from shifu_tpu.infer.beam import make_beam_search_fn
 from shifu_tpu.infer.engine import (
+    ENGINE_INTERFACE,
     Completion,
     Engine,
+    LiveRequest,
     LoraServingConfig,
     PagedEngine,
 )
@@ -57,6 +59,8 @@ __all__ = [
     "speculative_generate",
     "speculative_generate_batch",
     "Engine",
+    "ENGINE_INTERFACE",
+    "LiveRequest",
     "LoraServingConfig",
     "EngineRunner",
     "PagedEngine",

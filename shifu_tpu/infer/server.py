@@ -1000,9 +1000,11 @@ class EngineRunner:
                     self._wake.clear()
                     continue
                 done_now = self.engine.step()
-                # Stream incremental tokens for in-flight requests.
+                # Stream incremental tokens for in-flight requests
+                # (live_requests: the explicit ENGINE_INTERFACE
+                # streaming surface — no engine internals).
                 live = {
-                    req.rid: req for req in self.engine._active.values()
+                    req.rid: req for req in self.engine.live_requests()
                 }
                 with self._lock:
                     watched = list(self._waiters.items())
@@ -1185,7 +1187,7 @@ class _Handler(BaseHTTPRequestHandler):
             data = [base]
             # Registered LoRA adapters serve as addressable "models"
             # (picked per request via the "adapter" field).
-            for i in range(1, getattr(eng, "_n_adapters", 0) + 1):
+            for i in range(1, getattr(eng, "n_adapters", 0) + 1):
                 data.append({
                     "id": f"{base['id']}:adapter-{i}",
                     "object": "model",

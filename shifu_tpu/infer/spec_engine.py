@@ -145,7 +145,7 @@ class _SpeculativeBase(PagedEngine):
     """Shared skeleton: guards, acceptance stats, the per-round
     emission bookkeeping (eos/budget/ragged advance), and the host-side
     fold of round results — everything except HOW proposals are made
-    and scored (subclass ``_spec_impl`` + ``_dispatch_decode``)."""
+    and scored (subclass ``_spec_impl`` + ``_decode_dispatch``)."""
 
     def __init__(self, model, params, *, k: int = 4,
                  rounds_per_step: int = 1, **kw):
@@ -392,6 +392,18 @@ class _SpeculativeBase(PagedEngine):
         cur = jnp.where(n_acc > 0, new_cur, cur)
         return n_acc, done, cur, n + n_acc, rem - n_acc
 
+    def _decode_fold(self, pending) -> None:
+        """Host-sync one pending round dispatch (both speculative
+        engines' ``_decode_dispatch`` return the same per-round stack)
+        and fold it — the fold half of Engine's dispatch/fold split,
+        which is what lets the dp router overlap replicas' round
+        programs."""
+        t0, t1, (outs, lps, n_accs, ms, lives, cur2, lengths2) = pending
+        emitted = self._fold_rounds(
+            outs, lps, n_accs, ms, lives, cur2, lengths2
+        )
+        self._obs_dispatch(t0, t1, emitted)
+
     def _fold_rounds(self, outs, lps, n_accs, ms, lives, cur2, lengths2):
         """Host-side: extend each active request by its per-round
         accepted tokens and update acceptance stats. Returns
@@ -550,7 +562,9 @@ class SpeculativePagedEngine(_SpeculativeBase):
         )
 
     # -------------------------------------------------------------- decode
-    def _dispatch_decode(self, cur, lengths, active, sub) -> None:
+    def _decode_dispatch(self, cur, lengths, active, sub):
+        """LAUNCH the propose/verify round program (async; the fold
+        half lives on _SpeculativeBase._decode_fold)."""
         import time as _time
 
         t0 = _time.monotonic()
@@ -570,10 +584,7 @@ class SpeculativePagedEngine(_SpeculativeBase):
         t1 = _time.monotonic()
         if cts:
             self._counts_dev = cts[0]
-        emitted = self._fold_rounds(
-            outs, lps, n_accs, ms, lives, cur2, lengths2
-        )
-        self._obs_dispatch(t0, t1, emitted)
+        return (t0, t1, (outs, lps, n_accs, ms, lives, cur2, lengths2))
 
     def _spec_impl(
         self, params, cache, d_cache, d_params, cur, lengths, active,
@@ -805,7 +816,9 @@ class PromptLookupPagedEngine(_SpeculativeBase):
             self._in_act_ctx(self._spec_impl), donate_argnums=(1,)
         ), "spec_round")
 
-    def _dispatch_decode(self, cur, lengths, active, sub) -> None:
+    def _decode_dispatch(self, cur, lengths, active, sub):
+        """LAUNCH the lookup/verify round program (async; the fold
+        half lives on _SpeculativeBase._decode_fold)."""
         import time as _time
 
         t0 = _time.monotonic()
@@ -832,10 +845,7 @@ class PromptLookupPagedEngine(_SpeculativeBase):
         t1 = _time.monotonic()
         if cts:
             self._counts_dev = cts[0]
-        emitted = self._fold_rounds(
-            outs, lps, n_accs, ms, lives, cur2, lengths2
-        )
-        self._obs_dispatch(t0, t1, emitted)
+        return (t0, t1, (outs, lps, n_accs, ms, lives, cur2, lengths2))
 
     def _spec_impl(
         self, params, cache, cur, lengths, active, remaining, buf,

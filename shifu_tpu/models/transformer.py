@@ -41,6 +41,7 @@ from shifu_tpu.ops import (
     rms_norm,
     rope_frequencies,
     route_top_k,
+    route_top_k_grouped,
     softmax_cross_entropy,
 )
 from shifu_tpu.ops.attention import NEG_INF
@@ -96,6 +97,13 @@ class TransformerConfig:
     moe_capacity_factor: float = 1.25
     moe_lb_coef: float = 0.01  # load-balance aux-loss coefficient
     moe_rz_coef: float = 1e-3  # router z-loss coefficient
+    # Expert dispatch implementation: "grouped" (default — sorted
+    # inverse-permutation gather into the expert buffers, no dense
+    # one-hot einsums; ops.moe module docstring) or "einsum" (the
+    # GShard-style (b, s, E, C) dispatch/combine contractions — kept as
+    # the bit-auditable correctness oracle; tests pin grouped == einsum
+    # across top-k/capacity/drop configs).
+    moe_impl: str = "grouped"
     # "xla" | "flash" (pallas TPU kernel) | "ring" (sp sequence
     # parallelism; falls back to xla off-mesh — ops.attention docstring)
     attn_impl: str = "xla"
@@ -152,6 +160,10 @@ class TransformerConfig:
         if self.n_experts and self.moe_top_k > self.n_experts:
             raise ValueError(
                 f"moe_top_k={self.moe_top_k} exceeds n_experts={self.n_experts}"
+            )
+        if self.moe_impl not in ("grouped", "einsum"):
+            raise ValueError(
+                f"moe_impl={self.moe_impl!r} (want 'grouped' or 'einsum')"
             )
         if self.remat_policy not in ("dots", "full", "flash", "dots_flash"):
             raise ValueError(
@@ -878,12 +890,30 @@ class Transformer(Module):
 
     # ------------------------------------------------------------- moe ffn
     def _moe_ffn(self, p, x):
-        """Expert-parallel SwiGLU FFN via dispatch/combine einsums.
+        """Expert-parallel SwiGLU FFN: grouped dispatch by default, the
+        dense dispatch/combine-einsum oracle under
+        ``moe_impl="einsum"``. Both build the same (E, b, C, d) expert
+        buffers (identical grouped expert matmuls and ep-sharding
+        pattern); they differ only in how tokens move in and out —
+        see ops.moe module docstring."""
+        if self.cfg.moe_impl == "einsum":
+            return self._moe_ffn_einsum(p, x)
+        return self._moe_ffn_grouped(p, x)
 
-        Expert buffers carry a leading E axis constrained onto the ``ep``
-        mesh axis; XLA inserts the token↔expert all-to-all between the
-        batch-sharded and expert-sharded layouts (ops.moe module docstring).
-        """
+    def _expert_mlps(self, p, xe):
+        """The grouped expert SwiGLU matmuls over (E, b, C, d) buffers —
+        shared verbatim by both dispatch implementations (the parity
+        tests compare everything AROUND this)."""
+        xe = constrain(xe, ("act_experts", "batch", None, "act_embed"))
+        gate = jnp.einsum("ebcd,edm->ebcm", xe, p["w_gate"])
+        up = jnp.einsum("ebcd,edm->ebcm", xe, p["w_up"])
+        dn = jnp.einsum("ebcm,emd->ebcd", jax.nn.silu(gate) * up, p["w_down"])
+        return constrain(dn, ("act_experts", "batch", None, "act_embed"))
+
+    def _moe_ffn_einsum(self, p, x):
+        """Dense dispatch/combine einsums (GShard form) — the
+        correctness oracle. O(b·s·E·C·d) MACs of data movement per
+        contraction on top of the expert FFN flops."""
         cfg = self.cfg
         b, s, d = x.shape
         cap = moe_capacity(s, cfg.moe_top_k, cfg.n_experts, cfg.moe_capacity_factor)
@@ -893,15 +923,87 @@ class Transformer(Module):
         # (E, b, C, d) expert input buffers — E leads so one constraint pins
         # the ep sharding for the whole expert-compute segment.
         xe = jnp.einsum("bsec,bsd->ebcd", dispatch.astype(x.dtype), x)
-        xe = constrain(xe, ("act_experts", "batch", None, "act_embed"))
-        gate = jnp.einsum("ebcd,edm->ebcm", xe, p["w_gate"])
-        up = jnp.einsum("ebcd,edm->ebcm", xe, p["w_up"])
-        dn = jnp.einsum("ebcm,emd->ebcd", jax.nn.silu(gate) * up, p["w_down"])
-        dn = constrain(dn, ("act_experts", "batch", None, "act_embed"))
+        dn = self._expert_mlps(p, xe)
         # Combine in f32 (gate weights are f32), cast back to the residual
         # stream dtype.
         out = jnp.einsum(
             "bsec,ebcd->bsd", combine, dn.astype(jnp.float32)
+        ).astype(x.dtype)
+        return out, aux
+
+    def _moe_ffn_grouped(self, p, x):
+        """Sorted/grouped dispatch (the default fast path).
+
+        The routing op returns each assignment's (expert, slot) cell;
+        this method materialises the INVERSE permutation — for every
+        buffer cell, which token (if any) fills it — as one static
+        int32 scatter, builds the (E, b, C, d) expert buffers with one
+        gather (so the dense one-hot dispatch einsum never exists),
+        runs the identical grouped expert matmuls, and combines by
+        gathering each assignment's expert output back through the
+        forward permutation with its gate weight. Dispatch/combine
+        traffic is O((E·C + s·k)·d) ELEMENTS, not O(b·s·E·C·d) MACs.
+
+        Fixed shapes throughout (scatter/gather sizes depend only on
+        (b, s, E, C, k)), so it jits once; the ep-sharding constraint
+        sits on the same (E, b, C, d) buffers as the einsum path, so
+        XLA inserts the identical token↔expert all-to-all under a mesh.
+        Dropped assignments route to a sentinel overflow cell that is
+        sliced off (dispatch) or weight-masked to zero (combine) —
+        exactly the einsum path's zero-weight drop semantics.
+        """
+        cfg = self.cfg
+        b, s, d = x.shape
+        k = cfg.moe_top_k
+        E = cfg.n_experts
+        cap = moe_capacity(s, k, E, cfg.moe_capacity_factor)
+        logits = jnp.einsum("bsd,de->bse", x, p["router"])
+        e_idx, slot, w, keep, aux = route_top_k_grouped(logits, k, cap)
+
+        # Flatten assignments (token-major: assignment a ↔ token a // k).
+        n_a = s * k
+        e_f = e_idx.reshape(b, n_a)
+        slot_f = slot.reshape(b, n_a)
+        keep_f = keep.reshape(b, n_a)
+        # Combined buffer cell id; dropped assignments go to the E*cap
+        # overflow cell (written then sliced off below).
+        cell = jnp.where(keep_f, e_f * cap + slot_f, E * cap)
+        rows = jnp.arange(b)[:, None]
+
+        # Inverse permutation: cell -> flat assignment index (sentinel
+        # n_a = empty). Kept cells are unique by the cumsum slot
+        # construction; only the overflow cell takes collisions.
+        inv = (
+            jnp.full((b, E * cap + 1), n_a, jnp.int32)
+            .at[rows, cell]
+            .set(jnp.broadcast_to(jnp.arange(n_a, dtype=jnp.int32), (b, n_a)))
+        )[:, : E * cap]
+
+        # Dispatch: gather token rows into the expert buffers. Row s of
+        # the padded stream is zero, so empty cells hold exact zeros —
+        # bit-identical to the one-hot einsum's untouched cells.
+        x_pad = jnp.concatenate(
+            [x, jnp.zeros((b, 1, d), x.dtype)], axis=1
+        )
+        tok = jnp.where(inv < n_a, inv // k, s)  # (b, E*cap)
+        xe = jnp.take_along_axis(x_pad, tok[..., None], axis=1)
+        xe = xe.reshape(b, E, cap, d).transpose(1, 0, 2, 3)  # (E, b, C, d)
+
+        dn = self._expert_mlps(p, xe)
+
+        # Combine: gather each assignment's expert output through the
+        # forward permutation; weight-sum the k choices per token in
+        # f32 (gate weights are f32 — matches the einsum combine).
+        dn_f = (
+            dn.transpose(1, 0, 2, 3)
+            .reshape(b, E * cap, d)
+            .astype(jnp.float32)
+        )
+        cell_c = jnp.minimum(cell, E * cap - 1)  # clamp drops (weight 0)
+        y = jnp.take_along_axis(dn_f, cell_c[..., None], axis=1)
+        wgt = jnp.where(keep_f, w.reshape(b, n_a), 0.0)
+        out = (
+            (y * wgt[..., None]).reshape(b, s, k, d).sum(axis=2)
         ).astype(x.dtype)
         return out, aux
 

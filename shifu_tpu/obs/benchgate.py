@@ -76,6 +76,21 @@ METRIC_SPECS: Dict[str, Tuple[str, float]] = {
     "lcw2_mfu": (HIGHER, 0.08),
     "lcw2_ms": (LOWER, 0.10),
     "moe_mfu": (HIGHER, 0.10),
+    # grouped-vs-dense MoE dispatch ratio (round 6): collapsing to ~1
+    # means the grouped default silently regressed to einsum cost.
+    "moe_x_dense": (HIGHER, 0.10),
+}
+
+# Absolute floors for landed improve-direction wins (round 6): relative
+# tolerance alone lets a landed optimisation erode a few percent per
+# round, forever. Once a recorded BASELINE meets the floor, every later
+# round must stay at or above it. DORMANT while the baseline itself is
+# below the floor, so pre-win baselines (BENCH_r05 and earlier) gate
+# unchanged — the floor arms the first time a round records the win
+# (BENCH_r06 onward).
+METRIC_FLOORS: Dict[str, float] = {
+    "moe_mfu": 0.45,   # grouped MoE dispatch (from 0.2877 einsum)
+    "lcw_mfu": 0.58,   # windowed forced-grid KV-block lever (from 0.5104)
 }
 
 # current-key -> acceptable baseline keys (oldest last): lets a renamed
@@ -141,6 +156,14 @@ def check_bench(current: dict, baseline: dict,
             bad = ratio < 1.0 - tol
         else:
             bad = ratio > 1.0 + tol
+        # Armed absolute floor: the baseline reached this win, so the
+        # current round may not fall below it even inside relative
+        # tolerance (see METRIC_FLOORS).
+        floor = METRIC_FLOORS.get(key)
+        floored = (
+            floor is not None and direction == HIGHER
+            and base >= floor and cur < floor
+        )
         row = {
             "key": key,
             "baseline": base,
@@ -148,8 +171,14 @@ def check_bench(current: dict, baseline: dict,
             "ratio": round(ratio, 4),
             "direction": direction,
             "tolerance": round(tol, 4),
-            "verdict": "REGRESSED" if bad else "ok",
+            "verdict": (
+                "BELOW_FLOOR" if (floored and not bad)
+                else ("REGRESSED" if bad else "ok")
+            ),
         }
+        if floor is not None and base >= floor:
+            row["floor"] = floor
+        bad = bad or floored
         rows.append(row)
         if bad:
             regressions.append(row)

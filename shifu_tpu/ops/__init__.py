@@ -5,7 +5,11 @@ from shifu_tpu.ops.losses import (
     fused_softmax_cross_entropy,
     softmax_cross_entropy,
 )
-from shifu_tpu.ops.moe import moe_capacity, route_top_k
+from shifu_tpu.ops.moe import (
+    moe_capacity,
+    route_top_k,
+    route_top_k_grouped,
+)
 
 __all__ = [
     "rms_norm",
@@ -16,4 +20,5 @@ __all__ = [
     "softmax_cross_entropy",
     "moe_capacity",
     "route_top_k",
+    "route_top_k_grouped",
 ]

@@ -1,21 +1,42 @@
 """Mixture-of-Experts routing: top-k capacity-based dispatch.
 
-TPU-first design — the classic dispatch/combine-einsum formulation (as in
-GShard / Switch on TPU) rather than gather/scatter:
+Two formulations over ONE set of routing decisions:
 
-  * Routing produces two dense (b, s, E, C) tensors — ``dispatch`` (0/1
-    token→slot assignment) and ``combine`` (dispatch × gate weight). Expert
-    input buffers are then a single einsum, expert FFNs run batched over a
-    leading E axis (one big MXU matmul per projection), and outputs come
-    back with a second einsum. Everything is static-shaped, so it jits once.
+  * :func:`route_top_k` — the classic dense dispatch/combine-einsum
+    formulation (GShard / Switch on TPU): routing produces two dense
+    (b, s, E, C) tensors — ``dispatch`` (0/1 token→slot assignment) and
+    ``combine`` (dispatch × gate weight) — and the model contracts them
+    against the token stream. Simple and exactly auditable, but the two
+    contractions burn O(b·s·E·C·d) MACs of pure data movement ON TOP of
+    the expert FFN flops; at top-2-of-8 that overhead is comparable to
+    the expert compute itself (the measured moe_mfu gap). Kept as the
+    CORRECTNESS ORACLE behind ``TransformerConfig(moe_impl="einsum")``.
+  * :func:`route_top_k_grouped` — the sorted/grouped formulation (the
+    default fast path): the SAME routing decisions are returned in
+    index/weight form ((expert, slot) per assignment), the model builds
+    the (E, b, C, d) expert buffers through ONE inverse-permutation
+    gather (equivalent to a stable sort of assignments by (expert,
+    slot), computed without an argsort), runs the identical grouped
+    expert matmuls, and scatters results back through the forward
+    permutation. Dispatch/combine cost drops from two O(b·s·E·C·d)
+    einsums to two O((E·C + s·k)·d)-element gathers — no MXU flops at
+    all. Everything stays fixed-shape, so it jits once and shards
+    exactly like the einsum path.
+
+Shared properties:
+
   * Under a mesh, the E axis of the expert buffers is sharded over the
     ``ep`` mesh axis by an activation constraint; XLA inserts the
     all-to-all between the (batch-sharded) token layout and the
-    (expert-sharded) buffer layout on its own.
+    (expert-sharded) buffer layout on its own (both formulations pin
+    the same (E, b, C, d) buffer layout, so the collective pattern is
+    identical).
   * Capacity C = ceil(capacity_factor * s * k / E) bounds per-expert work;
     overflow tokens are dropped (their combine weight is 0, so the residual
     stream passes them through untouched). Priority is choice-major: every
-    token's 1st choice beats any token's 2nd choice (GShard order).
+    token's 1st choice beats any token's 2nd choice (GShard order) — the
+    grouped path reuses the einsum path's cumsum slot assignment verbatim,
+    so the two paths drop EXACTLY the same assignments.
 
 Reference parity note: the upstream reference (klyan/shifu) is an empty
 repository (SURVEY.md) — there is no reference MoE implementation to match.
@@ -32,6 +53,48 @@ def moe_capacity(seq_len: int, top_k: int, n_experts: int, factor: float) -> int
     return max(1, int(-(-seq_len * top_k * factor // n_experts)))
 
 
+def _routing_decisions(router_logits, top_k: int, capacity: int,
+                       normalize_weights: bool):
+    """Shared routing core for both dispatch formulations.
+
+    Returns ``(gate_vals, gate_idx, expert_mask, mask_ks, pos, aux)``:
+    gate_vals/gate_idx (b, s, k) f32/int32; expert_mask (b, s, k, E)
+    one-hot; mask_ks its choice-major (b, k·s, E) flattening (k
+    outermost, so every token's 1st choice occupies slots before any
+    2nd choice — GShard priority); ``pos`` (b, k·s, E) the cumsum slot
+    index each assignment takes within its expert; ``aux`` the loss
+    dict. Keeping this in ONE place is what makes the grouped path a
+    provably identical routing to the einsum oracle.
+    """
+    b, s, n_experts = router_logits.shape
+    logits = router_logits.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+
+    gate_vals, gate_idx = jax.lax.top_k(probs, top_k)  # (b, s, k)
+    if normalize_weights:
+        gate_vals = gate_vals / jnp.sum(gate_vals, axis=-1, keepdims=True)
+
+    # (b, s, k, E) one-hot of each token's k choices.
+    expert_mask = jax.nn.one_hot(gate_idx, n_experts, dtype=jnp.float32)
+
+    # Choice-major priority: flatten (k, s) with k outermost so all 1st
+    # choices occupy slots before any 2nd choice.
+    mask_ks = expert_mask.transpose(0, 2, 1, 3).reshape(b, top_k * s, n_experts)
+    pos = jnp.cumsum(mask_ks, axis=1) - mask_ks  # slot index within expert
+
+    # Load balance (Switch eq. 4, computed over all k assignments): with
+    # f_e the fraction of assignments routed to e and p_e the mean router
+    # prob, E·Σ f_e p_e is 1.0 at perfectly uniform routing.
+    f = jnp.mean(expert_mask, axis=(0, 1, 2))  # fraction per expert, Σ=1
+    p = jnp.mean(probs, axis=(0, 1))
+    lb = n_experts * jnp.sum(f * p)
+    rz = jnp.mean(jnp.square(jax.nn.logsumexp(logits, axis=-1)))
+    keep = (pos < capacity).astype(jnp.float32) * mask_ks
+    routed = jnp.sum(keep) / jnp.maximum(jnp.sum(mask_ks), 1.0)
+    aux = {"lb": lb, "rz": rz, "dropped": 1.0 - routed}
+    return gate_vals, gate_idx, expert_mask, mask_ks, pos, aux
+
+
 def route_top_k(
     router_logits: jax.Array,
     top_k: int,
@@ -39,7 +102,7 @@ def route_top_k(
     *,
     normalize_weights: bool = True,
 ):
-    """Top-k routing with per-row expert capacity.
+    """Top-k routing with per-row expert capacity (dense-einsum form).
 
     Args:
       router_logits: (b, s, E), any float dtype (softmax runs in f32).
@@ -57,20 +120,9 @@ def route_top_k(
               "dropped": fraction of assignments dropped for capacity}.
     """
     b, s, n_experts = router_logits.shape
-    logits = router_logits.astype(jnp.float32)
-    probs = jax.nn.softmax(logits, axis=-1)
-
-    gate_vals, gate_idx = jax.lax.top_k(probs, top_k)  # (b, s, k)
-    if normalize_weights:
-        gate_vals = gate_vals / jnp.sum(gate_vals, axis=-1, keepdims=True)
-
-    # (b, s, k, E) one-hot of each token's k choices.
-    expert_mask = jax.nn.one_hot(gate_idx, n_experts, dtype=jnp.float32)
-
-    # Choice-major priority: flatten (k, s) with k outermost so all 1st
-    # choices occupy slots before any 2nd choice.
-    mask_ks = expert_mask.transpose(0, 2, 1, 3).reshape(b, top_k * s, n_experts)
-    pos = jnp.cumsum(mask_ks, axis=1) - mask_ks  # slot index within expert
+    gate_vals, _, _, mask_ks, pos, aux = _routing_decisions(
+        router_logits, top_k, capacity, normalize_weights
+    )
     keep = (pos < capacity).astype(jnp.float32) * mask_ks
 
     slot_hot = jax.nn.one_hot(pos.astype(jnp.int32), capacity, dtype=jnp.float32)
@@ -81,14 +133,47 @@ def route_top_k(
     )  # (b, s, k, E, C)
     combine = jnp.sum(dispatch * gate_vals[..., None, None], axis=2)
     dispatch = jnp.sum(dispatch, axis=2)
-
-    # Load balance (Switch eq. 4, computed over all k assignments): with
-    # f_e the fraction of assignments routed to e and p_e the mean router
-    # prob, E·Σ f_e p_e is 1.0 at perfectly uniform routing.
-    f = jnp.mean(expert_mask, axis=(0, 1, 2))  # fraction per expert, Σ=1
-    p = jnp.mean(probs, axis=(0, 1))
-    lb = n_experts * jnp.sum(f * p)
-    rz = jnp.mean(jnp.square(jax.nn.logsumexp(logits, axis=-1)))
-    routed = jnp.sum(keep) / jnp.maximum(jnp.sum(mask_ks), 1.0)
-    aux = {"lb": lb, "rz": rz, "dropped": 1.0 - routed}
     return dispatch, combine, aux
+
+
+def route_top_k_grouped(
+    router_logits: jax.Array,
+    top_k: int,
+    capacity: int,
+    *,
+    normalize_weights: bool = True,
+):
+    """Top-k routing in SORTED/GROUPED index form (the fast path).
+
+    Identical routing decisions to :func:`route_top_k` (shared core:
+    same softmax/top-k, same choice-major cumsum slot assignment, same
+    aux losses) — but instead of materialising (b, s, E, C) one-hot
+    tensors, each of the b·s·k assignments is described by the
+    (expert, slot) cell it occupies. The model then builds expert
+    buffers with a gather through the inverse permutation and combines
+    through the forward permutation (``Transformer._moe_ffn_grouped``),
+    touching O((E·C + s·k)·d) elements instead of O(b·s·E·C·d) MACs.
+
+    Returns:
+      (expert_idx, slot_idx, weights, keep, aux):
+        expert_idx: (b, s, k) int32 — each assignment's expert.
+        slot_idx:   (b, s, k) int32 — its slot within that expert's
+          per-row capacity-C buffer (valid only where ``keep``).
+        weights:    (b, s, k) f32 — gate weights (NOT zeroed for
+          dropped assignments; mask with ``keep`` at the combine).
+        keep:       (b, s, k) bool — assignment fit under capacity.
+        aux: same dict as :func:`route_top_k`.
+    """
+    b, s, _ = router_logits.shape
+    gate_vals, gate_idx, _, mask_ks, pos, aux = _routing_decisions(
+        router_logits, top_k, capacity, normalize_weights
+    )
+    # Reduce the (b, k*s, E) slot grid to per-assignment scalars (each
+    # assignment has exactly one expert, so the sum picks its column),
+    # then undo the choice-major flattening back to (b, s, k).
+    pos_a = jnp.sum(pos * mask_ks, axis=-1)  # (b, k*s)
+    slot = (
+        pos_a.reshape(b, top_k, s).transpose(0, 2, 1).astype(jnp.int32)
+    )
+    keep = (pos_a < capacity).reshape(b, top_k, s).transpose(0, 2, 1)
+    return gate_idx.astype(jnp.int32), slot, gate_vals, keep, aux

@@ -53,6 +53,14 @@ class FlashConfig:
     block_k: int
     interpret: bool
     window: "Optional[int]" = None  # sliding window (causal only)
+    # Force the restricted (windowed) grid even when the span heuristic
+    # would keep the full grid — the w << s lever: with a LARGER KV
+    # block each query tile visits a short contiguous span of big
+    # blocks, so both the grid-step count and the DMA volume drop to
+    # O(S * window) where the full grid still fetched O(S^2) bytes and
+    # burned a grid step per skipped block (pl.when skips FLOPs, not
+    # the BlockSpec's DMA). See flash_attention(window_block_k=...).
+    force_window_grid: bool = False
 
 
 def _pad_to(x, multiple: int, axis: int):
@@ -65,7 +73,8 @@ def _pad_to(x, multiple: int, axis: int):
     return jnp.pad(x, widths)
 
 
-def _restricted_grid(window, b_self, b_other, n_blocks, shift):
+def _restricted_grid(window, b_self, b_other, n_blocks, shift,
+                     force=False):
     """(n_grid, base_fn) for a windowed-causal restricted grid.
 
     A tile of ``b_self`` rows visits a contiguous span of ``b_other``-sized
@@ -74,9 +83,15 @@ def _restricted_grid(window, b_self, b_other, n_blocks, shift):
     base_fn=None when the span isn't a clear win (the iq-dependent index
     maps break Mosaic's affine prefetching, costing ~2x per grid step on
     v5e) — callers then keep the full grid with in-kernel skipping.
+
+    ``force`` (the w << s lever, ``flash_attention(window_block_k=...)``):
+    take the restricted grid whenever it shrinks the grid at all — the
+    caller has already sized ``b_other`` LARGE so the prefetch penalty
+    amortises over few, fat grid steps while the DMA volume drops from
+    O(S^2) to O(S * window).
     """
     span = (window + b_self - 2) // b_other + 2
-    if span > n_blocks // 4:
+    if span >= n_blocks or (not force and span > n_blocks // 4):
         return n_blocks, None
 
     def base(i, _bs=b_self, _bo=b_other, _shift=shift):
@@ -210,7 +225,8 @@ def _flash_forward(q, k, v, segment_ids, cfg: FlashConfig):
     n_k_grid = n_k
     if cfg.causal and cfg.window is not None:
         n_k_grid, kv_base = _restricted_grid(
-            cfg.window, bq, bk, n_k, offset - cfg.window + 1
+            cfg.window, bq, bk, n_k, offset - cfg.window + 1,
+            force=cfg.force_window_grid,
         )
 
     def kv_block(iq, jk):
@@ -431,12 +447,14 @@ def _flash_backward(q, k, v, segment_ids, o, lse, do, cfg: FlashConfig):
     n_k_grid, n_q_grid = n_k, n_q
     if cfg.causal and cfg.window is not None:
         n_k_grid, kv_base = _restricted_grid(
-            cfg.window, bq, bk, n_k, offset - cfg.window + 1
+            cfg.window, bq, bk, n_k, offset - cfg.window + 1,
+            force=cfg.force_window_grid,
         )
         # dkv iterates query tiles per KV block; first visible query row
         # for block jk is jk*bk - offset.
         n_q_grid, q_base = _restricted_grid(
-            cfg.window, bk, bq, n_q, -offset
+            cfg.window, bk, bq, n_q, -offset,
+            force=cfg.force_window_grid,
         )
 
     def kv_block(iq, jk):
@@ -581,6 +599,7 @@ def flash_attention(
     block_k: int = 1024,
     interpret: Optional[bool] = None,
     window: Optional[int] = None,
+    window_block_k: Optional[int] = None,
 ):
     """Flash attention with the dot_product_attention layout/semantics.
 
@@ -597,6 +616,17 @@ def flash_attention(
         over 512/1024; smaller tiles lose up to 15%).
       interpret: force pallas interpret mode; default: interpret unless
         running on TPU (so CPU tests exercise the same kernel code).
+      window_block_k: the small-window (w << s) grid lever. A KV block
+        size used TOGETHER with the FORCED restricted grid: each query
+        tile visits only the short contiguous span of (large) KV blocks
+        its window can touch, so grid steps and K/V DMA drop to
+        O(S * window) — the full grid fetches O(S^2) bytes even when
+        ``pl.when`` skips the masked blocks' FLOPs, which is what held
+        the windowed long-context legs ~12 MFU points under full
+        causal. Default (None) auto-engages at 2x the window (power-of-
+        two-rounded) whenever ``window`` is set and the KV length is
+        >= 4x the window; pass a block size to override, or 0 to
+        disable and keep the full grid with in-kernel skipping.
 
     Returns:
       (batch, q_len, num_heads, head_dim) in q.dtype.
@@ -609,6 +639,25 @@ def flash_attention(
         raise ValueError("segment_ids requires q_len == kv_len")
     if window is not None and not causal:
         raise ValueError("window requires causal attention")
+    force_window_grid = False
+    if window is not None:
+        if window_block_k is None and skv >= 4 * window:
+            # Auto: one window spans at most 2 blocks of size >= w; 2x
+            # rounds the span's waste down while keeping blocks fat
+            # enough that the non-affine index maps' per-step cost
+            # amortises. Engage ONLY when the 2-block span covers at
+            # most half the KV axis — otherwise the restricted grid
+            # degenerates to the full grid and the override would just
+            # coarsen block_k (worse in-kernel skip granularity) for
+            # nothing.
+            wbk = 1
+            while wbk < 2 * window:
+                wbk *= 2
+            if 2 * wbk <= skv // 2:
+                window_block_k = wbk
+        if window_block_k:
+            block_k = int(window_block_k)
+            force_window_grid = True
     cfg = FlashConfig(
         causal=causal,
         scale=float(scale) if scale is not None else d**-0.5,
@@ -620,6 +669,7 @@ def flash_attention(
             else jax.default_backend() != "tpu"
         ),
         window=int(window) if window is not None else None,
+        force_window_grid=force_window_grid,
     )
     # Kernel-native layout: heads outside the sequence axis so each grid
     # step addresses one contiguous (seq_block, head_dim) tile.

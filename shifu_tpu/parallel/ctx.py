@@ -75,6 +75,34 @@ def current_env() -> Optional[_ActEnv]:
     return _env.get()
 
 
+def shard_map_compat(f, *, mesh, in_specs, out_specs, axis_names, check_vma=False):
+    """``jax.shard_map`` across the jax versions this repo meets.
+
+    jax >= 0.6 spells partial-manual as ``axis_names=`` + ``check_vma=``;
+    older jax (0.4.x) spells the same program ``auto=`` (the complement
+    set) + ``check_rep=`` on ``jax.experimental.shard_map.shard_map``.
+    """
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f,
+            mesh=mesh,
+            in_specs=in_specs,
+            out_specs=out_specs,
+            axis_names=axis_names,
+            check_vma=check_vma,
+        )
+    from jax.experimental.shard_map import shard_map as _legacy_shard_map
+
+    return _legacy_shard_map(
+        f,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        check_rep=check_vma,
+        auto=frozenset(mesh.axis_names) - set(axis_names),
+    )
+
+
 def constrain(x: jax.Array, logical: Sequence[Optional[str]]) -> jax.Array:
     """Pin ``x``'s sharding by logical axis names; identity without context.
 
@@ -95,7 +123,17 @@ def constrain(x: jax.Array, logical: Sequence[Optional[str]]) -> jax.Array:
     # from the outer all-Auto mesh. Drop the manual axes (they're already
     # fixed by the shard_map) and constrain with a bare PartitionSpec,
     # which binds to the context mesh.
-    from jax.sharding import AxisType, get_abstract_mesh
+    try:
+        from jax.sharding import AxisType, get_abstract_mesh
+    except ImportError:
+        # Older jax (< 0.5: no AxisType / abstract-mesh axis types) has
+        # no partial-manual trace state to consult — constrain with the
+        # context mesh directly (plain-mesh paths are unaffected; the
+        # shard_map pipelines manage their own sharding end-to-end and
+        # suppress ambient constraints via no_activation_sharding).
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(env.mesh, spec)
+        )
 
     cur = get_abstract_mesh()
     if not cur.empty and any(t == AxisType.Manual for t in cur.axis_types):

@@ -76,3 +76,14 @@ class MeshPlan:
     @classmethod
     def fsdp_only(cls, n: int) -> "MeshPlan":
         return cls(fsdp=n)
+
+    @classmethod
+    def serving(cls, tp: int = 1, ep: int = 1) -> "MeshPlan":
+        """One serving replica's sub-mesh: tp shards heads/mlp/vocab
+        (and the KV cache's kv-heads axis), ep shards MoE expert
+        weights and the (E, b, C, d) dispatch buffers so MoE decode
+        holds 1/ep of the expert weights per chip instead of a full
+        replica. dp replication happens ABOVE this (one such mesh per
+        replica — infer.replica.build_replicated); every other axis is
+        1 so the standard sharding rules apply unchanged."""
+        return cls(ep=ep, tp=tp)

@@ -21,6 +21,12 @@ Cost model: bubble fraction = (P-1)/(M+P-1) — use M >= 4P microbatches.
 Activation traffic per tick is one (mb, s, d) block over ICI, overlapped
 with the next tick's compute by XLA's async collectives.
 
+MoE legs (round 6): the block's expert FFN now defaults to the GROUPED
+sorted dispatch (ops/moe.py) — the stage body's layer_fn carries it
+unchanged, since the grouped path keeps the same (E, b, C, d) buffer
+layout and ep activation constraints as the einsum oracle; the router
+aux losses ride the existing ``has_aux`` plumbing untouched.
+
 Reference parity note: the upstream reference (klyan/shifu) is an empty
 repository (SURVEY.md); there is no reference pipeline engine to match.
 """
@@ -32,6 +38,8 @@ from typing import Any, Callable, Optional
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
+
+from shifu_tpu.parallel.ctx import shard_map_compat
 
 
 def pipeline_apply(
@@ -287,7 +295,7 @@ def _build_pipeline_fn(
 
     # Specs are pytree prefixes: one spec covers each whole argument tree.
     return jax.jit(
-        jax.shard_map(
+        shard_map_compat(
             shard_body,
             mesh=mesh,
             in_specs=(P(axis), P(), P(), P()),

@@ -31,8 +31,12 @@ forward), so this module computes the GRADIENTS ITSELF inside one
     — the denominator is just the mask sum, known BEFORE the scan, so
     the head VJP seeds with 1/den and every cotangent in the scan is
     already d(final loss)/d(·) (this is also what lets MoE aux
-    cotangents, constants, ride the same backward). The custom_vjp
-    backward is then one multiply by the incoming loss cotangent;
+    cotangents, constants, ride the same backward; the round-6 grouped
+    MoE dispatch changes nothing here — its stage body differentiates
+    through gathers instead of one-hot einsums, with the identical
+    (E, b, C, d) buffers, ep constraints and aux plumbing). The
+    custom_vjp backward is then one multiply by the incoming loss
+    cotangent;
   * the custom_vjp's residuals ARE the gradients ("self-grad" pattern):
     the forward computes them; the backward is one multiply.
 
@@ -100,6 +104,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from shifu_tpu.parallel.ctx import shard_map_compat
 from shifu_tpu.ops import rms_norm, rope_frequencies
 
 
@@ -345,7 +350,7 @@ def _build_1f1b(layer_fn, head_fn, mesh: Mesh, axis: str,
         return lead(pg), lead(hg), lead(dx), lead(sums), lead(aux_acc)
 
     return jax.jit(
-        jax.shard_map(
+        shard_map_compat(
             shard_body,
             mesh=mesh,
             in_specs=(P(axis), P(), P(), P(), P(), P(), P(), P()),

@@ -28,7 +28,11 @@ MeshAxes = Union[None, str, tuple]
 
 # Default rules for the transformer family. fsdp shards the embed dimension
 # of weights (ZeRO-3); tp shards heads/mlp/vocab; pp shards the stacked
-# layers axis; experts ride ep.
+# layers axis; experts ride ep. "act_experts" pins the leading E axis of
+# the (E, b, C, d) MoE dispatch buffers onto ep — BOTH dispatch
+# implementations (grouped and einsum-oracle, ops/moe.py) constrain that
+# same layout, so training and ep-sharded serving (MeshPlan.serving /
+# `serve --mesh ep=`) get the identical token<->expert all-to-all.
 DEFAULT_RULES: dict = {
     "layers": "pp",
     "embed": "fsdp",

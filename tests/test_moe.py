@@ -199,3 +199,206 @@ def test_moe_sharded_train_step_matches_single_device(devices):
     # Expert weights really are sharded over ep.
     wg = state.params["blocks"]["w_gate"]
     assert wg.addressable_shards[0].data.shape[1] == cfg.n_experts // 4
+
+
+# ------------------------------------------- grouped dispatch (round 6)
+def _dense_from_grouped(e_idx, slot, w, keep, n_experts, cap):
+    """Reconstruct the (b, s, E, C) dispatch/combine tensors from the
+    grouped index form — the exactness bridge between the two routing
+    surfaces."""
+    b, s, k = e_idx.shape
+    dispatch = np.zeros((b, s, n_experts, cap), np.float32)
+    combine = np.zeros((b, s, n_experts, cap), np.float32)
+    e_idx, slot = np.asarray(e_idx), np.asarray(slot)
+    w, keep = np.asarray(w), np.asarray(keep)
+    for bi in range(b):
+        for si in range(s):
+            for j in range(k):
+                if keep[bi, si, j]:
+                    e, c = e_idx[bi, si, j], slot[bi, si, j]
+                    dispatch[bi, si, e, c] += 1.0
+                    combine[bi, si, e, c] += w[bi, si, j]
+    return dispatch, combine
+
+
+@pytest.mark.parametrize("top_k,factor", [(1, 1.0), (2, 0.5), (2, 2.0), (3, 1.25)])
+def test_route_grouped_matches_dense_exactly(top_k, factor):
+    # The grouped routing op describes EXACTLY the same token->(expert,
+    # slot) assignment (and drops) as the dense oracle, config by config.
+    rng = np.random.RandomState(3)
+    logits = jnp.asarray(rng.randn(2, 8, 4), jnp.float32)
+    cap = moe_capacity(8, top_k, 4, factor)
+    dispatch, combine, aux_d = route_top_k(logits, top_k, cap)
+    from shifu_tpu.ops.moe import route_top_k_grouped
+
+    e_idx, slot, w, keep, aux_g = route_top_k_grouped(logits, top_k, cap)
+    gd, gc = _dense_from_grouped(e_idx, slot, w, keep, 4, cap)
+    np.testing.assert_array_equal(np.asarray(dispatch), gd)
+    np.testing.assert_allclose(np.asarray(combine), gc, rtol=1e-6, atol=1e-7)
+    for key in ("lb", "rz", "dropped"):
+        assert float(aux_d[key]) == pytest.approx(float(aux_g[key]), abs=1e-7)
+
+
+@pytest.mark.parametrize(
+    "kw",
+    [
+        {},  # top-2 of 4, factor 1.25 (drops happen)
+        {"moe_top_k": 1},
+        {"moe_top_k": 3},
+        {"moe_capacity_factor": 0.5},  # heavy drop
+        {"moe_capacity_factor": 4.0},  # no drop
+    ],
+    ids=["top2", "top1", "top3", "drop-heavy", "ample"],
+)
+def test_grouped_ffn_matches_einsum_oracle(kw):
+    # Forward parity grouped == einsum (the tentpole's correctness
+    # contract): identical routing + identical grouped expert matmuls,
+    # only the data movement differs — logits must agree to tight
+    # tolerance (bit-level on CPU f32).
+    import dataclasses
+
+    cfg_g = TransformerConfig.tiny_moe(**kw)
+    cfg_e = dataclasses.replace(cfg_g, moe_impl="einsum")
+    mg, me = Transformer(cfg_g), Transformer(cfg_e)
+    params = mg.init(jax.random.key(0))
+    tokens = jnp.asarray(
+        np.random.RandomState(6).randint(0, 256, (2, 16)), jnp.int32
+    )
+    lg, aux_g = jax.jit(lambda p, t: mg(p, t, return_aux=True))(params, tokens)
+    le, aux_e = jax.jit(lambda p, t: me(p, t, return_aux=True))(params, tokens)
+    np.testing.assert_allclose(
+        np.asarray(lg, np.float32), np.asarray(le, np.float32),
+        rtol=1e-5, atol=1e-6,
+    )
+    for key in ("lb", "rz", "dropped"):
+        assert float(aux_g[key]) == pytest.approx(
+            float(aux_e[key]), abs=1e-6
+        ), key
+
+
+def test_grouped_ffn_grad_matches_einsum_oracle():
+    # Grad parity through the custom (gather/scatter) path: the loss
+    # gradient w.r.t. EVERY parameter — router and experts included —
+    # must match the einsum oracle's.
+    import dataclasses
+
+    cfg_g = TransformerConfig.tiny_moe(moe_capacity_factor=1.25)
+    cfg_e = dataclasses.replace(cfg_g, moe_impl="einsum")
+    mg, me = Transformer(cfg_g), Transformer(cfg_e)
+    params = mg.init(jax.random.key(0))
+    batch = {
+        "tokens": jnp.asarray(
+            np.random.RandomState(8).randint(0, 256, (2, 16)), jnp.int32
+        )
+    }
+    (lg, _), gg = jax.jit(
+        jax.value_and_grad(mg.loss, has_aux=True)
+    )(params, batch)
+    (le, _), ge = jax.jit(
+        jax.value_and_grad(me.loss, has_aux=True)
+    )(params, batch)
+    assert float(lg) == pytest.approx(float(le), rel=1e-6)
+    flat_g = jax.tree_util.tree_leaves_with_path(gg)
+    flat_e = dict(
+        (jax.tree_util.keystr(p), v)
+        for p, v in jax.tree_util.tree_leaves_with_path(ge)
+    )
+    for path, vg in flat_g:
+        ve = flat_e[jax.tree_util.keystr(path)]
+        np.testing.assert_allclose(
+            np.asarray(vg, np.float32), np.asarray(ve, np.float32),
+            rtol=2e-5, atol=2e-6, err_msg=jax.tree_util.keystr(path),
+        )
+
+
+def test_single_expert_matches_dense_einsum_oracle():
+    # The 1-expert == dense-FFN identity must hold for the ORACLE too
+    # (the grouped-default variant is test_single_expert_matches_dense).
+    dense_cfg = TransformerConfig.tiny()
+    moe_cfg = TransformerConfig.tiny(
+        n_experts=1, moe_top_k=1, moe_capacity_factor=1.0,
+        moe_impl="einsum",
+    )
+    dense, moe = Transformer(dense_cfg), Transformer(moe_cfg)
+    mp = moe.init(jax.random.key(0))
+    dp = dense.init(jax.random.key(0))
+    for w in ("w_gate", "w_up", "w_down"):
+        dp["blocks"][w] = mp["blocks"][w][:, 0]
+    tokens = jnp.asarray(
+        np.random.RandomState(0).randint(0, 256, (2, 12)), jnp.int32
+    )
+    np.testing.assert_allclose(
+        dense(dp, tokens), moe(mp, tokens), rtol=2e-4, atol=2e-5
+    )
+
+
+def test_moe_impl_validation():
+    with pytest.raises(ValueError, match="moe_impl"):
+        TransformerConfig.tiny_moe(moe_impl="sorted")
+
+
+def test_grouped_decode_matches_einsum_decode():
+    # The decode path (s=1 MoE dispatch per step) agrees between
+    # implementations token for token — greedy argmax over logits that
+    # are equal to tight tolerance.
+    import dataclasses
+
+    cfg_g = TransformerConfig.tiny_moe(moe_capacity_factor=4.0)
+    cfg_e = dataclasses.replace(cfg_g, moe_impl="einsum")
+    mg, me = Transformer(cfg_g), Transformer(cfg_e)
+    params = mg.init(jax.random.key(0))
+    tokens = jnp.asarray(
+        np.random.RandomState(9).randint(0, 256, (2, 8)), jnp.int32
+    )
+    out = {}
+    for name, model in (("g", mg), ("e", me)):
+        cache = model.init_cache(batch_size=2, max_seq_len=16)
+        logits, cache = model(
+            params, tokens, cache=cache, cache_index=jnp.int32(0)
+        )
+        steps = [logits[:, -1]]
+        cur = jnp.argmax(logits[:, -1], axis=-1)[:, None]
+        for i in range(8, 12):
+            logits, cache = model(
+                params, cur, cache=cache, cache_index=jnp.int32(i)
+            )
+            steps.append(logits[:, 0])
+            cur = jnp.argmax(logits[:, 0], axis=-1)[:, None]
+        out[name] = steps
+    for a, b in zip(out["g"], out["e"]):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            rtol=1e-4, atol=1e-5,
+        )
+
+
+def test_moe_grouped_ep_serving(devices):
+    # The ep-mesh serving leg (`serve --mesh tp=2,ep=2`): expert
+    # weights sharded over ep at decode, grouped dispatch in the
+    # decode programs, requests complete. Mirrors the
+    # __graft_entry__.py dryrun leg.
+    import dataclasses
+
+    from shifu_tpu.infer import SampleConfig, build_replicated
+    from shifu_tpu.infer.engine import PagedEngine
+    from shifu_tpu.parallel import shard_params
+
+    cfg = TransformerConfig.tiny(
+        vocab_size=64, dim=16, n_layers=2, n_heads=4, n_kv_heads=2,
+        mlp_dim=32, n_experts=4, moe_top_k=2,
+    )
+    model = Transformer(cfg)
+    params = model.init(jax.random.key(2))
+    grp = build_replicated(
+        lambda m: PagedEngine(
+            model, shard_params(model, params, m), mesh=m,
+            max_slots=2, max_len=32, page_size=8,
+            prefill_buckets=(16, 32),
+            sample_cfg=SampleConfig(temperature=0.0),
+        ),
+        dp=1, tp=2, ep=2, devices=devices[:4],
+    )
+    wg = grp.engines[0].params["blocks"]["w_gate"]
+    assert wg.addressable_shards[0].data.shape[1] == cfg.n_experts // 2
+    rids = [grp.submit([1, 2, 3 + i], max_new_tokens=4) for i in range(3)]
+    assert {c.rid for c in grp.run()} == set(rids)

@@ -165,3 +165,44 @@ def test_check_bench_cli_roundtrip(tmp_path):
         "--current", str(tmp_path / "missing.json"),
     ])
     assert rc == 2
+
+
+def test_metric_floors_dormant_below_and_armed_above(baseline):
+    from shifu_tpu.obs.benchgate import METRIC_FLOORS
+
+    # DORMANT: r05's moe_mfu (0.2877) is below the 0.45 floor, so the
+    # floor must not fire against pre-win baselines — r05 vs itself is
+    # covered by test_real_baseline_gates_clean_against_itself; here a
+    # small in-tolerance dip must also still pass.
+    assert baseline["moe_mfu"] < METRIC_FLOORS["moe_mfu"]
+    cur = dict(baseline)
+    cur["moe_mfu"] = round(baseline["moe_mfu"] * 0.95, 4)
+    ok, report = check_bench(cur, baseline)
+    assert ok, report["regressions"]
+
+    # ARMED: once a baseline records the win (r06 shape), a later round
+    # may not fall below the floor even inside relative tolerance.
+    b6 = dict(baseline)
+    b6["moe_mfu"] = 0.47
+    cur = dict(b6)
+    cur["moe_mfu"] = 0.44  # within 10% relative, but below the floor
+    ok, report = check_bench(cur, b6)
+    assert not ok
+    (row,) = [r for r in report["regressions"] if r["key"] == "moe_mfu"]
+    assert row["verdict"] == "BELOW_FLOOR"
+    assert row["floor"] == METRIC_FLOORS["moe_mfu"]
+    # At or above the floor (and inside tolerance) passes.
+    cur["moe_mfu"] = 0.46
+    ok, report = check_bench(cur, b6)
+    assert ok, report["regressions"]
+
+
+def test_moe_grouped_ratio_gated():
+    # The grouped-vs-dense ratio is a first-class gated metric: it
+    # collapsing to ~1 (grouped default silently lost) must fail.
+    assert METRIC_SPECS["moe_x_dense"][0] == "higher"
+    base = {"moe_x_dense": 1.6}
+    ok, report = check_bench({"moe_x_dense": 1.02}, base)
+    assert not ok
+    ok, _ = check_bench({"moe_x_dense": 1.55}, base)
+    assert ok

@@ -225,3 +225,192 @@ def test_router_validation(tiny_f32):
         ReplicatedEngine([e1, e2])
     with pytest.raises(ValueError, match="devices"):
         build_replicated(lambda m: e1, dp=8, tp=2)
+
+
+# --------------------------------------- dispatch/fold overlap contract
+class _RecordingStub:
+    """Minimal ENGINE_INTERFACE stand-in that records the order the
+    router drives its step phases in. No jax anywhere — this pins the
+    ROUTER's ordering contract (all dispatches strictly precede any
+    fold), not device behaviour."""
+
+    max_len = 32
+    eos_id = None
+    model = None
+    params = None
+    buckets = (16, 32)
+    tokenizer = None
+    sample_cfg = SampleConfig(temperature=0.0)
+    per_request_sampling = False
+    enable_penalties = False
+    enable_logit_bias = False
+    lora = None
+    max_slots = 2
+
+    def __init__(self, i, log):
+        self.i = i
+        self.log = log
+        self._queue = []
+        self.active_slots = 0
+
+    def set_replica(self, label):
+        self.replica_label = label
+
+    def step_dispatch(self):
+        self.log.append(("dispatch", self.i))
+        return ("handle", self.i)
+
+    def step_fold(self, handle):
+        assert handle == ("handle", self.i), handle
+        self.log.append(("fold", self.i))
+        return []
+
+    @property
+    def idle(self):
+        return True
+
+
+def test_router_dispatches_all_replicas_before_folding():
+    # VERDICT row 79 / missing #3: the router's step must LAUNCH every
+    # replica's decode program before host-syncing (folding) any of
+    # them — fold of replica 0 overlapping replicas 1..n-1's device
+    # execution is the whole point of the dispatch/fold split.
+    log = []
+    grp = ReplicatedEngine([_RecordingStub(i, log) for i in range(3)])
+    assert grp.step() == []
+    kinds = [k for k, _ in log]
+    assert kinds == ["dispatch"] * 3 + ["fold"] * 3, log
+    # Deterministic replica order within each phase.
+    assert [i for k, i in log if k == "dispatch"] == [0, 1, 2]
+    assert [i for k, i in log if k == "fold"] == [0, 1, 2]
+
+
+def test_engine_step_equals_dispatch_then_fold(tiny_f32):
+    # The split is the step: driving an engine via the two-phase
+    # surface produces the same completions as step()/run().
+    model, params = tiny_f32
+    rng = np.random.RandomState(7)
+    prompts = [rng.randint(1, 256, size=n).tolist() for n in (5, 9, 3)]
+    ref = Engine(model, params, **_KW)
+    rids = [ref.submit(p, max_new_tokens=4) for p in prompts]
+    want = {rids.index(c.rid): c.tokens for c in ref.run()}
+
+    eng = Engine(model, params, **_KW)
+    rids = [eng.submit(p, max_new_tokens=4) for p in prompts]
+    got = {}
+    while not eng.idle:
+        for c in eng.step_fold(eng.step_dispatch()):
+            got[rids.index(c.rid)] = c.tokens
+    for i, toks in want.items():
+        np.testing.assert_array_equal(toks, got[i], err_msg=str(i))
+
+
+# ----------------------------------------------- explicit engine interface
+def test_server_touches_only_engine_interface():
+    """The HTTP server may only reach the engine through
+    ENGINE_INTERFACE (the explicit contract Engine and ReplicatedEngine
+    share) — no more ``engine._active``-style internals (VERDICT weak
+    #6). Source-level: every ``engine.<attr>`` / ``eng.<attr>`` /
+    ``getattr(engine, "<attr>")`` in infer/server.py must name an
+    interface member."""
+    import inspect
+    import re
+
+    from shifu_tpu.infer import server as server_mod
+    from shifu_tpu.infer.engine import ENGINE_INTERFACE
+
+    src = inspect.getsource(server_mod)
+    touched = set(
+        re.findall(
+            r"(?:self\.(?:runner\.)?engine|\beng)\."
+            r"([A-Za-z_][A-Za-z0-9_]*)",
+            src,
+        )
+    )
+    touched |= set(
+        re.findall(
+            r"getattr\((?:self\.)?(?:runner\.)?(?:engine|eng),\s*"
+            r"[\"']([A-Za-z_][A-Za-z0-9_]*)[\"']",
+            src,
+        )
+    )
+    unknown = touched - ENGINE_INTERFACE
+    assert not unknown, (
+        f"server touches engine attributes outside ENGINE_INTERFACE: "
+        f"{sorted(unknown)} — extend the interface (engine.py) "
+        f"deliberately or stop reaching into internals"
+    )
+
+
+def test_engine_and_router_provide_full_interface(tiny_f32):
+    from shifu_tpu.infer.engine import ENGINE_INTERFACE
+
+    model, params = tiny_f32
+    eng = Engine(model, params, **_KW)
+    grp = ReplicatedEngine([Engine(model, params, **_KW)])
+    for name in sorted(ENGINE_INTERFACE):
+        assert hasattr(eng, name), f"Engine lacks {name}"
+        assert hasattr(grp, name), f"ReplicatedEngine lacks {name}"
+
+
+def test_live_requests_rekey_and_alias(tiny_f32):
+    # live_requests: rids in the router namespace; token lists alias
+    # the engine's live state (streaming reads fresh tokens without
+    # copies).
+    model, params = tiny_f32
+    grp = ReplicatedEngine([Engine(model, params, **_KW)])
+    rid = grp.submit([1, 2, 3], max_new_tokens=4)
+    h = grp.step_dispatch()
+    grp.step_fold(h)
+    live = grp.live_requests()
+    assert [lr.rid for lr in live] == [rid]
+    before = len(live[0].generated)
+    assert before >= 1
+    grp.step()
+    assert len(live[0].generated) == before + 1  # aliased, not copied
+
+
+def test_cli_builds_ep_mesh_engine(tiny_f32):
+    """`serve --mesh tp=2,ep=2` on an MoE model: one mesh engine whose
+    expert weights are ep-sharded; ep on a dense model (or an ep that
+    does not divide n_experts) refuses at flag-validation time."""
+    import argparse
+
+    from shifu_tpu.cli import build_serve_engine
+    from shifu_tpu.data.tokenizer import ByteTokenizer
+    from shifu_tpu.models import TransformerConfig
+
+    model, params = tiny_f32
+    base = dict(
+        max_slots=2, max_len=64, temperature=0.0, top_p=1.0,
+        decode_chunk=1, eos_id=-1, paged=True, page_size=8,
+        n_pages=None, prefix_cache=False, per_request_sampling=False,
+        penalties=False, logit_bias=False, lora_ckpt_dir=None,
+        lora_rank=8, lora_alpha=16.0, lora_targets="wq,wk,wv,wo",
+        spec="off", spec_k=4, spec_ngram=3, spec_rounds=2,
+        draft_preset=None, draft_ckpt_dir=None,
+    )
+    tok = ByteTokenizer()
+
+    def mk(m, p, **over):
+        return build_serve_engine(
+            argparse.Namespace(**{**base, **over}), m, p, tok
+        )
+
+    with pytest.raises(ValueError, match="no experts"):
+        mk(model, params, mesh="tp=1,ep=2")
+
+    moe_model = Transformer(
+        TransformerConfig.tiny(n_experts=4, moe_top_k=2, mlp_dim=64),
+        policy=FULL_F32,
+    )
+    moe_params = moe_model.init(jax.random.key(0))
+    with pytest.raises(ValueError, match="divide"):
+        mk(moe_model, moe_params, mesh="ep=3")
+
+    eng = mk(moe_model, moe_params, mesh="tp=2,ep=2")
+    assert eng.mesh is not None and eng.mesh.shape["ep"] == 2
+    wg = eng.params["blocks"]["w_gate"]
+    assert wg.addressable_shards[0].data.shape[1] == 2  # E=4 over ep=2
+    rid = eng.submit([1, 2, 3], max_new_tokens=3)
+    assert {c.rid for c in eng.run()} == {rid}

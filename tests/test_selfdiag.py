@@ -391,7 +391,7 @@ def test_instrumentation_overhead_budget(tiny):
     assert not eng.idle  # budget untouched: every timed step decoded
 
     # The bundle a non-idle step actually executes (engine.step +
-    # _dispatch_decode + _obs_step_gauges), measured in isolation.
+    # _decode_dispatch/_decode_fold + _obs_step_gauges), measured in isolation.
     h = reg.histogram("t_ovh_seconds", "x").labels()
     g = reg.gauge("t_ovh_gauge", "x").labels()
     n = 2000

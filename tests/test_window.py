@@ -7,6 +7,7 @@ import pytest
 
 from shifu_tpu.models import Transformer, TransformerConfig
 from shifu_tpu.ops import dot_product_attention
+from shifu_tpu.ops.pallas.flash_attention import flash_attention
 
 
 def test_window_ge_seq_equals_full():
@@ -253,3 +254,68 @@ def test_mistral_conversion_parity():
         want = hf(torch.tensor(tokens)).logits.float().numpy()
     got = np.asarray(model(params, jnp.asarray(tokens, jnp.int32)))
     np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_flash_forced_window_grid_matches_xla():
+    # The w << s lever (round 6): window_block_k FORCES the restricted
+    # grid with a larger KV block. Forward + grads must match the XLA
+    # reference exactly like the default grid does.
+    rng = jax.random.key(11)
+    b, s, h, d, w = 1, 512, 2, 16, 64
+    q = jax.random.normal(jax.random.fold_in(rng, 0), (b, s, h, d))
+    k = jax.random.normal(jax.random.fold_in(rng, 1), (b, s, h, d))
+    v = jax.random.normal(jax.random.fold_in(rng, 2), (b, s, h, d))
+    want = dot_product_attention(q, k, v, causal=True, window=w)
+    got = flash_attention(
+        q, k, v, causal=True, window=w, block_q=64, block_k=64,
+        window_block_k=128,
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5
+    )
+
+    def loss(fn):
+        def f(q, k, v):
+            return jnp.sum(jnp.square(fn(q, k, v)))
+        return f
+
+    gw = jax.grad(loss(
+        lambda q, k, v: dot_product_attention(
+            q, k, v, causal=True, window=w
+        )
+    ), argnums=(0, 1, 2))(q, k, v)
+    gg = jax.grad(loss(
+        lambda q, k, v: flash_attention(
+            q, k, v, causal=True, window=w, block_q=64, block_k=64,
+            window_block_k=128,
+        )
+    ), argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gw, gg):
+        np.testing.assert_allclose(
+            np.asarray(b_), np.asarray(a), rtol=2e-4, atol=2e-4
+        )
+
+
+def test_flash_window_block_k_auto_and_optout_match():
+    # Auto mode engages at skv >= 4 * window (the bench's w << s legs);
+    # window_block_k=0 opts out back to the full grid with in-kernel
+    # skipping. All three agree with the reference.
+    rng = jax.random.key(12)
+    b, s, h, d, w = 1, 1024, 2, 16, 128
+    q = jax.random.normal(jax.random.fold_in(rng, 0), (b, s, h, d))
+    k = jax.random.normal(jax.random.fold_in(rng, 1), (b, s, h, d))
+    v = jax.random.normal(jax.random.fold_in(rng, 2), (b, s, h, d))
+    want = dot_product_attention(q, k, v, causal=True, window=w)
+    auto = flash_attention(
+        q, k, v, causal=True, window=w, block_q=128, block_k=128
+    )
+    off = flash_attention(
+        q, k, v, causal=True, window=w, block_q=128, block_k=128,
+        window_block_k=0,
+    )
+    np.testing.assert_allclose(
+        np.asarray(auto), np.asarray(want), rtol=2e-5, atol=2e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(off), np.asarray(want), rtol=2e-5, atol=2e-5
+    )
