@@ -71,6 +71,7 @@ def main(argv=None):
             ("long_context", bench_train_long),
             ("long_context_windowed", bench_train_long_windowed),
             ("long_context_windowed_w2k", bench_train_long_windowed_w2k),
+            ("gemma2", bench_train_g2),
             ("moe", bench_train_moe),
         ):
             try:
@@ -254,6 +255,12 @@ def _compact(out: dict) -> dict:
          g("train_legs", "long_context_windowed_w2k", "mfu")),
         ("lcw2_ms",
          g("train_legs", "long_context_windowed_w2k", "step_ms")),
+        # Gemma-2-shaped leg (softcap + alternating windows): flash
+        # headline + the measured flash-vs-XLA-oracle ratio
+        ("g2_mfu", g("train_legs", "gemma2", "mfu")),
+        ("g2_ms", g("train_legs", "gemma2", "step_ms")),
+        ("g2_x_xla", g("train_legs", "gemma2", "flash_vs_xla")),
+        ("g2_xla_mfu", g("train_legs", "gemma2", "xla_oracle", "mfu")),
         ("moe_mfu", g("train_legs", "moe", "mfu")),
         # grouped-vs-dense MoE dispatch (round 6): the measured ratio
         # and the einsum oracle's own MFU (the "before" number)
@@ -371,9 +378,18 @@ def _train_leg(cfg, dev, *, batch, seq, steps=3, opt=None):
             )
             out["active_params"] = n_active
         span = min(seq, cfg.window_size or seq)
+        # Alternating-window stacks (window_pattern): credit each
+        # layer its OWN span — windowed layers the window, the others
+        # the full sequence (metrics.transformer_flops_per_token).
+        layer_spans = None
+        if cfg.window_pattern is not None:
+            layer_spans = [
+                span if i % cfg.window_pattern == 0 else seq
+                for i in range(cfg.n_layers)
+            ]
         fpt = transformer_flops_per_token(
             n_active, span, cfg.resolved_head_dim, cfg.n_heads,
-            cfg.n_layers,
+            cfg.n_layers, layer_spans=layer_spans,
         )
         out["mfu"] = round(tokens_per_s * fpt / peak, 4)
     return out
@@ -419,6 +435,44 @@ def bench_train_long_windowed_w2k(dev):
         attn_impl="flash", remat_policy="full", window_size=2048
     )
     return _train_leg(cfg, dev, batch=2, seq=8192)
+
+
+def bench_train_g2(dev):
+    """Gemma-2-shaped leg (ISSUE 4): attention-logit softcap +
+    alternating sliding windows (+ sandwich norms, gelu FFN, final
+    logit cap) on the FLASH path — the configuration the softcap/
+    window refusals used to route to XLA wholesale. The ``xla_oracle``
+    sub-leg re-times the SAME config through the XLA parity path, so
+    the fast-path win lands as a measured ratio (``flash_vs_xla``;
+    compact ``g2_x_xla``) — a regression that re-routes the family off
+    the kernel collapses it toward 1. s=4096 keeps the oracle's
+    materialised (S, S) scores inside single-chip HBM; w=512 on even
+    layers keeps w << s far enough that the forced-window-grid lever
+    (window_block_k auto) engages on the windowed half of the stack."""
+    from shifu_tpu.models.transformer import TransformerConfig
+
+    kw = dict(
+        vocab_size=32_000, dim=2048, n_layers=16, n_heads=16,
+        n_kv_heads=4, mlp_dim=8192, remat_policy="full",
+        window_size=512, window_pattern=2, attn_softcap=50.0,
+        final_softcap=30.0, post_norms=True, embed_scale=True,
+        mlp_act="gelu_tanh",
+    )
+    leg = _train_leg(
+        TransformerConfig(attn_impl="flash", **kw), dev,
+        batch=2, seq=4096,
+    )
+    try:
+        xla = _train_leg(
+            TransformerConfig(attn_impl="xla", **kw), dev,
+            batch=2, seq=4096, steps=3,
+        )
+        leg["xla_oracle"] = xla
+        if xla.get("mfu"):
+            leg["flash_vs_xla"] = round(leg["mfu"] / xla["mfu"], 3)
+    except Exception as e:  # the oracle sub-leg must not sink the leg
+        leg["xla_oracle"] = {"error": f"{type(e).__name__}: {e}"}
+    return leg
 
 
 def bench_train_moe(dev):

@@ -8,12 +8,37 @@ tokenizers (the `transformers` package) the same minimal protocol, and
 shard format in one call.
 
 Protocol (duck-typed): ``vocab_size``, ``pad_id``, ``bos_id``, ``eos_id``,
-``encode(text) -> list[int]``, ``decode(ids) -> str``.
+``encode(text) -> list[int]``, ``decode(ids) -> str``; tokenizers that
+define each id's exact raw bytes also expose ``token_bytes(id) ->
+bytes`` (the FSM-constrained-decoding alphabet — every tokenizer in
+this module does: byte, BPE, and the HF adapter's byte-level-BPE /
+sentencepiece table with loud refusal for uncovered vocab types).
 """
 
 from __future__ import annotations
 
-from typing import Iterable, Optional, Sequence
+from typing import Iterable, List, Optional, Sequence
+
+
+def _gpt2_bytes_to_unicode() -> dict:
+    """The GPT-2 byte<->unicode-char table (Radford et al.'s
+    bytes_to_unicode, re-derived): printable/latin bytes map to
+    themselves, the rest to U+0100.. — every byte-level-BPE vocab
+    entry is a string of THESE characters, so inverting the table
+    recovers each token's raw bytes exactly."""
+    bs = (
+        list(range(ord("!"), ord("~") + 1))
+        + list(range(ord("\xa1"), ord("\xac") + 1))
+        + list(range(ord("\xae"), ord("\xff") + 1))
+    )
+    cs = bs[:]
+    n = 0
+    for b in range(256):
+        if b not in bs:
+            bs.append(b)
+            cs.append(256 + n)
+            n += 1
+    return dict(zip(bs, map(chr, cs)))
 
 
 class ByteTokenizer:
@@ -114,6 +139,132 @@ class HFTokenizer:
 
     def decode(self, ids: Sequence[int]) -> str:
         return self._tok.decode(list(ids), skip_special_tokens=True)
+
+    # ------------------------------------------------ exact token bytes
+    def _vocab_kind(self) -> str:
+        """Classify the wrapped vocab's surface encoding — the two
+        families that cover ~every causal-LM tokenizer in the wild:
+
+        * ``"bytelevel"`` — GPT-2-style byte-level BPE: vocab entries
+          are strings over the bytes_to_unicode alphabet (detected via
+          the slow tokenizer's ``byte_decoder`` or a ByteLevel
+          pre-tokenizer/decoder in the fast backend's serialization).
+        * ``"sentencepiece"`` — SP-style pieces: ``▁`` marks word
+          starts and ``<0xHH>`` pieces carry byte fallback (detected
+          via ``sp_model`` or a Metaspace/ByteFallback component).
+
+        Anything else (WordPiece/BERT & co) raises NotImplementedError
+        LOUDLY: their vocabs do not define exact raw bytes per token,
+        and guessing would corrupt the constrained-decoding alphabet.
+        """
+        t = self._tok
+        if hasattr(t, "byte_decoder"):
+            return "bytelevel"
+        if hasattr(t, "sp_model"):
+            return "sentencepiece"
+        bt = getattr(t, "backend_tokenizer", None)
+        if bt is not None:
+            import json
+
+            spec = json.loads(bt.to_str())
+
+            def kinds(node, out):
+                if isinstance(node, dict):
+                    if isinstance(node.get("type"), str):
+                        out.add(node["type"])
+                    for v in node.values():
+                        kinds(v, out)
+                elif isinstance(node, list):
+                    for v in node:
+                        kinds(v, out)
+                return out
+
+            comp = set()
+            for part in ("pre_tokenizer", "decoder", "normalizer"):
+                kinds(spec.get(part), comp)
+            if "ByteLevel" in comp:
+                return "bytelevel"
+            if "ByteFallback" in comp or "Metaspace" in comp:
+                return "sentencepiece"
+            comp_s = sorted(comp)
+        else:
+            comp_s = ["<no fast backend>"]
+        raise NotImplementedError(
+            f"token_bytes: unsupported vocab type for "
+            f"{type(t).__name__} (components {comp_s}); exact raw "
+            "bytes are defined for byte-level-BPE (GPT-2 family) and "
+            "sentencepiece-style vocabs only"
+        )
+
+    def _token_bytes_table(self) -> List[bytes]:
+        """id -> raw bytes for the WHOLE vocab, built once and cached.
+        Specials map to b'' (the FSM never allows them; eos is handled
+        separately); non-special added tokens contribute their literal
+        text's UTF-8 (they bypass the surface encoding on encode)."""
+        table = getattr(self, "_tb_table", None)
+        if table is not None:
+            return table
+        kind = self._vocab_kind()
+        t = self._tok
+        n = len(t)
+        specials = set(getattr(t, "all_special_ids", None) or [])
+        added = dict(getattr(t, "added_tokens_decoder", None) or {})
+        inv = None
+        if kind == "bytelevel":
+            inv = getattr(t, "byte_decoder", None) or {
+                c: b for b, c in _gpt2_bytes_to_unicode().items()
+            }
+        table = []
+        for i in range(n):
+            if i in specials:
+                table.append(b"")
+                continue
+            if i in added:
+                at = added[i]
+                if getattr(at, "special", False):
+                    table.append(b"")
+                else:
+                    table.append(str(at).encode("utf-8"))
+                continue
+            piece = t.convert_ids_to_tokens(i)
+            if piece is None:
+                table.append(b"")
+            elif kind == "bytelevel":
+                try:
+                    table.append(bytes(inv[ch] for ch in piece))
+                except KeyError as e:
+                    raise ValueError(
+                        f"token_bytes: vocab entry {i} ({piece!r}) "
+                        f"holds a character outside the byte-level "
+                        f"alphabet ({e})"
+                    ) from None
+            else:  # sentencepiece pieces
+                if (
+                    len(piece) == 6
+                    and piece.startswith("<0x")
+                    and piece.endswith(">")
+                ):
+                    table.append(bytes([int(piece[3:5], 16)]))
+                else:
+                    table.append(
+                        piece.replace("▁", " ").encode("utf-8")
+                    )
+        self._tb_table = table
+        return table
+
+    def token_bytes(self, token_id: int) -> bytes:
+        """One token's RAW bytes (b"" for specials/out-of-range) —
+        exact even for tokens that are not standalone valid UTF-8
+        (one byte of a multi-byte character, a lone ``<0xHH>``
+        fallback piece), where ``decode()`` smears into U+FFFD. The
+        FSM-constrained-decoding alphabet
+        (infer/constrain.token_byte_table); raises NotImplementedError
+        for vocab types without well-defined raw bytes
+        (:meth:`_vocab_kind`)."""
+        table = self._token_bytes_table()
+        if not 0 <= token_id < len(table):
+            return b""
+        return table[token_id]
 
     @property
     def chat_template(self):

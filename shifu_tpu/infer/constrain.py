@@ -47,6 +47,7 @@ The approach is the published FSM-constrained-decoding idea
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Dict, FrozenSet, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -453,13 +454,25 @@ def token_byte_table(tokenizer, vocab_size: int) -> List[bytes]:
     implementation behind TokenFSM.from_tokenizer and the engines'
     cached table.
 
-    Prefers the tokenizer's ``token_bytes(id)`` hook (the framework's
-    byte + BPE tokenizers implement it — EXACT even for tokens that
-    are not standalone valid UTF-8, e.g. one byte of a multi-byte
-    character, which ``decode()`` would smear into U+FFFD); falls back
-    to decode-in-isolation for adapters without the hook, which is
-    only exact for tokens that round-trip through text."""
+    Uses the tokenizer's ``token_bytes(id)`` hook — every framework
+    tokenizer implements it EXACTLY, including tokens that are not
+    standalone valid UTF-8 (one byte of a multi-byte character, a
+    sentencepiece ``<0xHH>`` fallback piece), which ``decode()`` would
+    smear into U+FFFD: byte + BPE natively, and ``HFTokenizer`` via
+    its byte-level-BPE inverse table / sentencepiece piece decoding
+    (data/tokenizer.py). A hook that refuses its vocab type
+    (NotImplementedError — e.g. WordPiece, whose vocab defines no raw
+    bytes) degrades to decode-in-isolation for the whole table, as do
+    duck-typed adapters without the hook; both are exact only for
+    tokens that round-trip through text."""
     hook = getattr(tokenizer, "token_bytes", None)
+    if hook is not None:
+        try:
+            hook(0)
+        except NotImplementedError:
+            hook = None  # uncovered vocab type: whole-table fallback
+        except Exception:
+            pass  # per-id failure: handled (as b"") in the loop below
     out = []
     for t in range(vocab_size):
         try:
@@ -659,7 +672,11 @@ _JSON_STRING = '"' + _STR_CHAR + '*"'
 # are 0 or [1-9] followed by digits.
 _JSON_INT = r"-?(0|[1-9]\d*)"
 _JSON_NUMBER = _JSON_INT + r"(\.\d+)?([eE][+-]?\d+)?"
-_WS = r"\s*"
+# JSON insignificant whitespace is EXACTLY space/tab/LF/CR (RFC 8259
+# §2) — regex \s also admits \f and \v, which json.loads rejects, so a
+# grammar built on \s* could emit unparseable output (a model that
+# favours whitespace under the mask found this in practice).
+_WS = r"[ \t\n\r]*"
 
 
 def schema_to_regex(schema: dict, *, compact: bool = False) -> str:
@@ -815,3 +832,163 @@ def schema_to_regex(schema: dict, *, compact: bool = False) -> str:
         )
 
     return emit(schema)
+
+
+# ------------------------------------------- OpenAI json mode (json_object)
+
+# The engine-level sentinel for ``response_format: {"type":
+# "json_object"}`` — free-form JSON is not a json-schema, so it rides
+# the json_schema channel as this exact marker and dispatches onto
+# :func:`json_mode_dfa` instead of :func:`schema_to_regex`.
+JSON_MODE_SCHEMA = {"type": "json_object"}
+
+JSON_MODE_DEPTH = 8
+
+
+@functools.lru_cache(maxsize=4)
+def json_mode_dfa(max_depth: int = JSON_MODE_DEPTH) -> ByteDFA:
+    """Whole-match ByteDFA for ANY JSON **object** nested at most
+    ``max_depth`` containers deep — the OpenAI ``json_object``
+    response format, which "any valid JSON" being non-regular
+    (unbounded nesting needs a stack) previously forced this server to
+    refuse.
+
+    Bounded depth makes the language regular, but NOT via a regex:
+    expanding the value grammar textually multiplies it 4x per level
+    (array and object each mention the value twice), i.e. 4^D copies
+    of the scalar alternation — ~50 MB of pattern at D=8, far past any
+    NFA budget. Instead the automaton is built DIRECTLY by product
+    construction: the existing regex pieces (:data:`_JSON_STRING` with
+    its full escape + well-formed-UTF-8 grammar, :data:`_JSON_NUMBER`,
+    the true/false/null literals) each compile ONCE via
+    :func:`compile_regex`, and one copy of each piece is spliced in
+    per *context* — a context being the stack of open containers, of
+    which a depth-D grammar has 2^0 + ... + 2^(D-1) — with the
+    pieces' accepting states additionally carrying the context's
+    continuation bytes (JSON ws, ``,``, the matching closer, ``:``
+    after an object key). D=8 yields ~21k states, built in ~0.4 s and
+    cached; the TokenFSM lift stays lazy per visited state, so the
+    states x vocab product never materialises (device-FSM engines that
+    need the dense table refuse at submit via their existing budget
+    check).
+
+    Everything the DFA admits ``json.loads``-parses: string/number
+    syntax is exactly the pieces', whitespace is RFC 8259's four
+    bytes, container/comma/colon structure is tracked per context,
+    and a depth-(D+1) opening bracket simply has no transition — the
+    mask bans it, so depth past D is UNREACHABLE rather than invalid.
+    """
+    pieces = {
+        "str": compile_regex(_JSON_STRING),
+        "num": compile_regex(_JSON_NUMBER),
+        "lit": compile_regex("(true|false|null)"),
+    }
+    pieces["key"] = pieces["str"]
+    ws_bytes = (0x20, 0x09, 0x0A, 0x0D)  # RFC 8259 ws (NOT \f/\v)
+
+    ids: Dict[tuple, int] = {}
+    table: List[Dict[int, int]] = []
+    acc: List[bool] = []
+    todo: List[tuple] = []
+
+    def sid(key: tuple) -> int:
+        if key not in ids:
+            ids[key] = len(table)
+            table.append({})
+            acc.append(False)
+            todo.append(key)
+        return ids[key]
+
+    def cont_trans(which: str, stack: tuple) -> Dict[int, int]:
+        """Continuation bytes for a finished piece in ``stack`` —
+        merged into the piece's embedded accepting states (disjoint
+        from the pieces' own outgoing bytes: digits/./e/sign for
+        numbers vs ws/,/closer here)."""
+        out: Dict[int, int] = {}
+        if which == "key":
+            c = sid(("colon", stack))
+            for b in ws_bytes:
+                out[b] = c
+            out[ord(":")] = sid(("value", stack))
+            return out
+        a = sid(("after", stack))
+        for b in ws_bytes:
+            out[b] = a
+        if stack:
+            top, rest = stack[-1], stack[:-1]
+            if top == "obj":
+                out[ord(",")] = sid(("key", stack))
+                out[ord("}")] = sid(("after", rest))
+            else:
+                out[ord(",")] = sid(("value", stack))
+                out[ord("]")] = sid(("after", rest))
+        return out
+
+    sid(("start",))
+    while todo:
+        key = todo.pop()
+        i = ids[key]
+        row = table[i]
+        kind = key[0]
+        if kind == "start":
+            # Leading ws, then the mandatory top-level object.
+            for b in ws_bytes:
+                row[b] = i
+            row[ord("{")] = sid(("key_or_close", ("obj",)))
+        elif kind == "after":
+            # A value just closed in context ``stack``; empty stack is
+            # the accepting end state (trailing ws only).
+            stack = key[1]
+            for b in ws_bytes:
+                row[b] = i
+            if not stack:
+                acc[i] = True
+            else:
+                top, rest = stack[-1], stack[:-1]
+                if top == "obj":
+                    row[ord(",")] = sid(("key", stack))
+                    row[ord("}")] = sid(("after", rest))
+                else:
+                    row[ord(",")] = sid(("value", stack))
+                    row[ord("]")] = sid(("after", rest))
+        elif kind in ("value", "elem_or_close"):
+            stack = key[1]
+            for b in ws_bytes:
+                row[b] = i
+            for which in ("str", "num", "lit"):
+                for b, t in pieces[which].table[0].items():
+                    row[b] = sid(("piece", which, stack, t))
+            if len(stack) < max_depth:
+                row[ord("[")] = sid(("elem_or_close", stack + ("arr",)))
+                row[ord("{")] = sid(("key_or_close", stack + ("obj",)))
+            if kind == "elem_or_close":  # [] — empty array
+                row[ord("]")] = sid(("after", key[1][:-1]))
+        elif kind == "key_or_close":  # {} or first key
+            stack = key[1]
+            for b in ws_bytes:
+                row[b] = i
+            row[ord("}")] = sid(("after", stack[:-1]))
+            for b, t in pieces["key"].table[0].items():
+                row[b] = sid(("piece", "key", stack, t))
+        elif kind == "key":  # after a comma: a key is mandatory
+            stack = key[1]
+            for b in ws_bytes:
+                row[b] = i
+            for b, t in pieces["key"].table[0].items():
+                row[b] = sid(("piece", "key", stack, t))
+        elif kind == "colon":
+            stack = key[1]
+            for b in ws_bytes:
+                row[b] = i
+            row[ord(":")] = sid(("value", stack))
+        elif kind == "piece":
+            _, which, stack, ps = key
+            d = pieces[which]
+            for b, t in d.table[ps].items():
+                row[b] = sid(("piece", which, stack, t))
+            if d.accepting[ps]:
+                for b, t in cont_trans(which, stack).items():
+                    row[b] = t
+        else:  # pragma: no cover
+            raise AssertionError(kind)
+    return ByteDFA(tuple(table), tuple(acc))

@@ -521,7 +521,11 @@ class Engine:
         properties; string/integer/number/boolean/null/enum/array/
         nested object — constrain.schema_to_regex) compiled onto the
         same FSM machinery: the output is schema-valid JSON whenever
-        it finishes by eos. ``constraint``: a prebuilt ``TokenFSM``
+        it finishes by eos. The exact sentinel ``{"type":
+        "json_object"}`` (constrain.JSON_MODE_SCHEMA — the OpenAI
+        json mode) instead admits ANY JSON object up to the bounded
+        nesting depth via the precompiled whole-JSON grammar
+        (constrain.json_mode_dfa). ``constraint``: a prebuilt ``TokenFSM``
         instead of a pattern (reusable across requests — the
         per-state tables cache inside it)."""
         if sampling is not None and not self.per_request_sampling:
@@ -560,9 +564,24 @@ class Engine:
         if json_schema is not None:
             if regex is not None:
                 raise ValueError("pass regex OR json_schema, not both")
-            from shifu_tpu.infer.constrain import schema_to_regex
+            from shifu_tpu.infer.constrain import (
+                JSON_MODE_SCHEMA,
+                schema_to_regex,
+            )
 
-            regex = schema_to_regex(json_schema)
+            if json_schema == JSON_MODE_SCHEMA:
+                # OpenAI ``response_format: {"type": "json_object"}``:
+                # ANY JSON object, admitted via the bounded-depth JSON
+                # grammar (constrain.json_mode_dfa) — not a schema, so
+                # it bypasses schema_to_regex and lands as a prebuilt
+                # per-engine constraint.
+                if constraint is not None:
+                    raise ValueError(
+                        "pass json_schema OR constraint, not both"
+                    )
+                constraint = self._json_mode_fsm()
+            else:
+                regex = schema_to_regex(json_schema)
         if regex is not None and constraint is not None:
             raise ValueError("pass regex OR constraint, not both")
         if constraint is not None:
@@ -1401,6 +1420,29 @@ class Engine:
                 self.tokenizer, self.model.cfg.vocab_size
             )
         return tbl
+
+    def _json_mode_fsm(self):
+        """The OpenAI json-mode constraint — ANY JSON object up to the
+        bounded nesting depth (constrain.json_mode_dfa) — lifted onto
+        this engine's tokenizer. ONE TokenFSM per engine: every
+        json_object request shares it, so the lazily-built per-state
+        token tables amortise across requests exactly like the
+        regex-pattern cache."""
+        fsm = getattr(self, "_json_mode_cache", None)
+        if fsm is None:
+            if self.tokenizer is None:
+                raise ValueError(
+                    "json_object needs Engine(tokenizer=...) to lift "
+                    "the JSON byte grammar onto token ids"
+                )
+            from shifu_tpu.infer.constrain import TokenFSM, json_mode_dfa
+
+            fsm = self._json_mode_cache = TokenFSM(
+                json_mode_dfa(),
+                self._token_byte_table(),
+                eos_id=self.eos_id,
+            )
+        return fsm
 
     def _slot_bias_row(self, req: _Request) -> np.ndarray:
         """One request's CURRENT (vocab,) bias row: the static

@@ -78,8 +78,11 @@ emits one. Responses carry ``message.tool_calls`` (arguments as a
 JSON string, per the OpenAI wire shape) and ``finish_reason:
 "tool_calls"``. ``max_tokens`` is accepted as an alias for
 ``max_new_tokens`` on both endpoints, and OpenAI ``response_format``
-(the json_schema form) maps onto the ``json_schema`` constraint
-("json_object" is refused: ANY-valid-JSON is not a regular language).
+maps onto the constraint layer: the json_schema form onto the
+``json_schema`` constraint, and ``{"type": "json_object"}`` (json
+mode) onto the bounded-depth whole-JSON grammar — ANY-valid-JSON is
+not regular, but depth-bounded JSON is, and depth-9 nesting is simply
+unreachable under the mask (constrain.json_mode_dfa).
 
 Stop sequences truncate in the ENGINE host loop (finished_by="stop");
 string stops additionally trim the trailing text in the response here.
@@ -1545,10 +1548,12 @@ class _Handler(BaseHTTPRequestHandler):
                 raise ValueError("json_schema must be an object")
             rf = req.get("response_format")
             if rf is not None:
-                # OpenAI wire alias. Only the json_schema form maps:
-                # "json_object" means ANY valid JSON, which is not a
-                # regular language (unbounded nesting) — the FSM layer
-                # cannot honour it and must not pretend to.
+                # OpenAI wire alias: "json_schema" constrains to the
+                # schema; "json_object" (json mode) constrains to ANY
+                # JSON object via the bounded-depth (D=8) JSON grammar
+                # — unbounded nesting is not regular, but depth-9
+                # opens are simply masked, so everything emitted
+                # json.loads-parses (constrain.json_mode_dfa).
                 if not isinstance(rf, dict):
                     raise ValueError("response_format must be an object")
                 if rf.get("type") == "text":
@@ -1571,12 +1576,22 @@ class _Handler(BaseHTTPRequestHandler):
                             '{"json_schema": {"schema": {...}}}'
                         )
                     json_schema = schema
+                elif rf.get("type") == "json_object":
+                    if json_schema is not None:
+                        raise ValueError(
+                            "pass response_format OR json_schema, "
+                            "not both"
+                        )
+                    from shifu_tpu.infer.constrain import (
+                        JSON_MODE_SCHEMA,
+                    )
+
+                    json_schema = JSON_MODE_SCHEMA
                 else:
                     raise ValueError(
                         f"response_format type {rf.get('type')!r} is "
-                        "not supported (json_schema constrains to the "
-                        "schema; bare json_object is not a regular "
-                        "language)"
+                        "not supported (want text, json_schema or "
+                        "json_object)"
                     )
             if tools and tool_choice not in ("none", "auto"):
                 # Forced tool call: the response IS the envelope —

@@ -223,6 +223,12 @@ def config_from_hf_llama(hf_config, **overrides) -> TransformerConfig:
             mlp_act="gelu_tanh",
             post_norms=True,
             embed_scale=True,
+            # The flash kernel handles both Gemma-2 attention quirks
+            # natively (tanh softcap inside the online softmax,
+            # per-layer windows via static-window branches), so the
+            # family converts straight onto the fast path; pass
+            # attn_impl="xla" in overrides for the parity oracle.
+            attn_impl="flash",
             # Sliding attention on EVEN layers, full on odd
             # (layer_types in the HF config; the alternation is the
             # architecture, pattern 2 with offset 0).

@@ -188,18 +188,6 @@ class TransformerConfig:
                     f"window_pattern={self.window_pattern} must be >= 2 "
                     "(1 means every layer — use plain window_size)"
                 )
-            if self.attn_impl != "xla":
-                # The flash/ring kernels pick their block-skip grids
-                # from a STATIC window; per-layer alternation rides a
-                # traced layer index through lax.cond'd XLA attention.
-                raise ValueError(
-                    "window_pattern requires attn_impl='xla'"
-                )
-        if self.attn_softcap is not None and self.attn_impl == "flash":
-            raise ValueError(
-                "attn_softcap is not implemented in the flash kernel; "
-                "use attn_impl='xla'"
-            )
         if self.final_softcap is not None and self.fused_ce:
             raise ValueError(
                 "final_softcap does not compose with fused_ce (the "
@@ -382,7 +370,9 @@ class Transformer(Module):
         TRACED scalar that disables the window on non-pattern layers
         (a huge width; the mask comparisons it feeds broadcast traced
         values fine, which is what lets alternation ride the layer
-        scan without lax.cond'ing whole attention calls)."""
+        scan on the XLA/ring/decode paths). The flash kernel cannot
+        consume a traced width — ``_self_attention`` branches between
+        two static-window kernel calls there instead."""
         cfg = self.cfg
         if cfg.window_size is None:
             return None
@@ -404,6 +394,46 @@ class Transformer(Module):
         cfg = self.cfg
         return (
             None if cfg.attn_scale is None else cfg.attn_scale ** -0.5
+        )
+
+    def _self_attention(self, q, k, v, *, segment_ids=None, layer_idx=None):
+        """Causal self-attention over THIS call's q/k/v with the
+        layer's effective window — the one dispatch point for every
+        full-sequence attention in the model (training forward, dense
+        prefill-from-empty, paged fresh prefill).
+
+        With ``window_pattern`` + ``attn_impl="flash"`` the per-layer
+        window cannot ride the scan as a traced scalar (the flash
+        kernel prunes its KV grid — incl. the forced-window-grid
+        ``window_block_k`` lever — from a STATIC window). Instead the
+        layer index drives a ``lax.cond`` between two static-window
+        kernel calls: the windowed branch compiles once on its pruned
+        O(S*window) grid, the full branch once on the causal grid, and
+        each scan step executes exactly one of them. XLA/ring keep the
+        traced-scalar route (their masks broadcast traced widths
+        fine)."""
+        cfg = self.cfg
+        kw = dict(
+            causal=True, segment_ids=segment_ids, impl=cfg.attn_impl,
+            scale=self._attn_scale, softcap=cfg.attn_softcap,
+        )
+        if (
+            cfg.window_pattern is not None
+            and cfg.attn_impl == "flash"
+            and layer_idx is not None
+        ):
+            return jax.lax.cond(
+                layer_idx % cfg.window_pattern == 0,
+                lambda q, k, v: dot_product_attention(
+                    q, k, v, window=cfg.window_size, **kw
+                ),
+                lambda q, k, v: dot_product_attention(
+                    q, k, v, window=None, **kw
+                ),
+                q, k, v,
+            )
+        return dot_product_attention(
+            q, k, v, window=self._layer_window(layer_idx), **kw
         )
 
     def _block(
@@ -476,12 +506,9 @@ class Transformer(Module):
         q = apply_rope(q, sin, cos)
         k = apply_rope(k, sin, cos)
 
-        win = self._layer_window(layer_idx)
         if cache_slice is None:
-            attn = dot_product_attention(
-                q, k, v, causal=True, segment_ids=segment_ids,
-                impl=cfg.attn_impl, window=win,
-                scale=self._attn_scale, softcap=cfg.attn_softcap,
+            attn = self._self_attention(
+                q, k, v, segment_ids=segment_ids, layer_idx=layer_idx
             )
             # Named for the selective remat policies ("flash" /
             # "dots_flash"): saving this one (b, s, h, hd) tensor per
@@ -549,11 +576,7 @@ class Transformer(Module):
                 # causality already hides the tail from every real query;
                 # with a mask (left-padding/holes) fall through to the
                 # masked cache path below.
-                attn = dot_product_attention(
-                    q, k, v, causal=True, impl=cfg.attn_impl,
-                    window=win,
-                    scale=self._attn_scale, softcap=cfg.attn_softcap,
-                )
+                attn = self._self_attention(q, k, v, layer_idx=layer_idx)
             else:
                 # Single-token decode (or chunked prefill at a traced
                 # offset): score against the cache. Positions > index hold
@@ -562,7 +585,7 @@ class Transformer(Module):
                 # the mask is built in slot space with a query offset.
                 attn = _decode_attention(
                     q, ck, cv, cache_index, cfg.attn_impl, kv_mask=kv_mask,
-                    window=win,
+                    window=self._layer_window(layer_idx),
                     scale=self._attn_scale, softcap=cfg.attn_softcap,
                 )
             new_cache = {"k": ck, "v": cv}
@@ -617,6 +640,23 @@ class Transformer(Module):
         h = h + down
         h = constrain(h, ("batch", "seq", "act_embed"))
         return h, new_cache, moe_aux
+
+    def _paged_kernel_ok(self) -> bool:
+        """Whether the Pallas paged-decode kernel may serve this
+        config's decode/verify steps. Beyond the mesh condition
+        (_pallas_paged_ok), the kernel applies ONE static window to
+        every layer and no logit capping — an alternating-window or
+        softcapped stack (Gemma-2) must take the XLA gather fallback,
+        which handles the traced per-layer window and the tanh cap
+        exactly (decode is memory-bound; the flash win lives in the
+        prefill/training kernels, which DO support both)."""
+        cfg = self.cfg
+        return (
+            cfg.attn_impl == "flash"
+            and cfg.attn_softcap is None
+            and cfg.window_pattern is None
+            and _pallas_paged_ok()
+        )
 
     # ------------------------------------------------------------ paged kv
     def _paged_block_attention(
@@ -716,7 +756,7 @@ class Transformer(Module):
                 csv = csv.at[li, phys, off].set(vsw_)
             ck = pool["k"].at[li, phys, off].set(kw_)
             cv = pool["v"].at[li, phys, off].set(vw_)
-            if self.cfg.attn_impl == "flash" and _pallas_paged_ok():
+            if self._paged_kernel_ok():
                 # Multi-query paged kernel: the whole chunk scores in
                 # ONE pass over the pool (queries fold into the row
                 # axis) — the (b, pages_per_row * ps, kv, hd) gathered
@@ -790,12 +830,7 @@ class Transformer(Module):
                 if quantized:
                     csk = csk.at[li, phys].set(ks_block)
                     csv = csv.at[li, phys].set(vs_block)
-                attn = dot_product_attention(
-                    q, k, v, causal=True, impl=self.cfg.attn_impl,
-                    window=self._layer_window(li),
-                    scale=self._attn_scale,
-                    softcap=self.cfg.attn_softcap,
-                )
+                attn = self._self_attention(q, k, v, layer_idx=li)
             else:
                 # Page-aligned suffix prefill at a traced offset: the
                 # caller guarantees cache_index % ps == 0 and that the
@@ -844,7 +879,7 @@ class Transformer(Module):
             if quantized:
                 csk = csk.at[li, phys, off].set(ksw)
                 csv = csv.at[li, phys, off].set(vsw)
-            if self.cfg.attn_impl == "flash" and _pallas_paged_ok():
+            if self._paged_kernel_ok():
                 # Pallas paged-decode kernel: reads each live page once,
                 # straight from the stacked pool via the scalar-prefetched
                 # page table and layer index — neither the per-layer

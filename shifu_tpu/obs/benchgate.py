@@ -75,6 +75,13 @@ METRIC_SPECS: Dict[str, Tuple[str, float]] = {
     "lcw_ms": (LOWER, 0.10),
     "lcw2_mfu": (HIGHER, 0.08),
     "lcw2_ms": (LOWER, 0.10),
+    # Gemma-2-shaped leg (ISSUE 4): softcap + alternating windows on
+    # the flash path, plus the flash-vs-XLA-oracle ratio — the ratio
+    # collapsing toward 1 means the family silently fell back to the
+    # O(S^2) XLA path.
+    "g2_mfu": (HIGHER, 0.08),
+    "g2_ms": (LOWER, 0.10),
+    "g2_x_xla": (HIGHER, 0.10),
     "moe_mfu": (HIGHER, 0.10),
     # grouped-vs-dense MoE dispatch ratio (round 6): collapsing to ~1
     # means the grouped default silently regressed to einsum cost.
@@ -91,6 +98,11 @@ METRIC_SPECS: Dict[str, Tuple[str, float]] = {
 METRIC_FLOORS: Dict[str, float] = {
     "moe_mfu": 0.45,   # grouped MoE dispatch (from 0.2877 einsum)
     "lcw_mfu": 0.58,   # windowed forced-grid KV-block lever (from 0.5104)
+    # Gemma-2 softcap+alternating-window flash path (ISSUE 4): arms
+    # the first time a round records the win (windowed-config MFU sat
+    # at 0.51 on the refused-to-XLA route; half the stack is full
+    # attention at s=4096, so the dense-leg ~0.63 is the ceiling).
+    "g2_mfu": 0.55,
 }
 
 # current-key -> acceptable baseline keys (oldest last): lets a renamed

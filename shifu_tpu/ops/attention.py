@@ -67,26 +67,35 @@ def dot_product_attention(
         SKIPS out-of-window KV blocks (O(S·window) compute); ring skips
         fully-out-of-window ring chunks the same way (lax.cond per
         visiting chunk).
+      softcap: Gemma-2 tanh attention-logit capping — scores become
+        ``softcap * tanh(scores / softcap)`` after the scale and
+        BEFORE the mask. Supported by every impl (the flash kernel
+        caps each block tile inside its online softmax and carries the
+        sech^2 term in the backward; ring caps inside each fold) —
+        see docs/attention_kernels.md.
 
     Returns:
       (batch, q_len, num_heads, head_dim) in q.dtype.
     """
     if window is not None and not causal:
         raise ValueError("window requires causal attention")
-    if softcap is not None and impl != "xla":
-        # tanh capping sits between the scale and the mask; the
-        # flash/ring kernels' online-softmax inner loops do not apply
-        # it — refusing beats silently mis-scoring a Gemma-2 model.
-        raise ValueError(
-            f"attn softcap is only implemented for impl='xla', "
-            f"got {impl!r}"
-        )
     if impl == "flash":
+        if isinstance(window, jax.Array):
+            # The flash kernel prunes its grid from a STATIC window; a
+            # traced width (the per-layer alternation scalar) cannot
+            # reach it. models.Transformer routes alternating stacks
+            # through a lax.cond between two STATIC-window kernel
+            # calls instead — anything else landing here is a bug.
+            raise ValueError(
+                "impl='flash' needs a static window; per-layer traced "
+                "windows must dispatch via static-window branches "
+                "(Transformer._self_attention)"
+            )
         from shifu_tpu.ops.pallas.flash_attention import flash_attention
 
         return flash_attention(
             q, k, v, causal=causal, scale=scale, segment_ids=segment_ids,
-            window=window,
+            window=window, softcap=softcap,
         )
     if impl == "ring":
         # Sequence-parallel ring attention over the sp mesh axis. Needs an
@@ -103,7 +112,7 @@ def dot_product_attention(
         if env is not None and ring_shardable(env.mesh, q.shape, k.shape):
             return ring_attention_sharded(
                 q, k, v, env.mesh, causal=causal, scale=scale,
-                segment_ids=segment_ids, window=window,
+                segment_ids=segment_ids, window=window, softcap=softcap,
             )
         impl = "xla"
     if impl != "xla":

@@ -17,7 +17,12 @@ storing them — the flash-attention recurrence, laid out for the TPU:
     dK/dV kernel accumulates over the group with an extra inner grid
     dimension instead of an HBM-sized intermediate;
   * causal masking skips fully-masked KV blocks via ``pl.when`` on the
-    block-level predicate, so the skipped grid steps do no FLOPs.
+    block-level predicate, so the skipped grid steps do no FLOPs;
+  * Gemma-2 tanh logit soft-capping is a per-tile VPU elementwise on
+    the block scores BEFORE the mask and the (m, l, acc) fold — the
+    recurrence is unchanged, the saved logsumexp is over capped
+    scores, and the backward multiplies ds by the sech^2 term
+    (docs/attention_kernels.md).
 
 Layout contract matches ops.attention.dot_product_attention:
 q (b, sq, h, d); k/v (b, skv, h_kv, d); queries end-aligned when
@@ -53,6 +58,13 @@ class FlashConfig:
     block_k: int
     interpret: bool
     window: "Optional[int]" = None  # sliding window (causal only)
+    # Gemma-2 attention-logit soft-capping: block scores become
+    # cap * tanh(scores / cap) BEFORE the mask and the online-softmax
+    # accumulation — a pure per-tile VPU elementwise, so the recurrence
+    # (m, l, acc) is untouched and the saved logsumexp is over CAPPED
+    # scores. The backward recomputes the cap and multiplies ds by the
+    # sech^2 term 1 - tanh^2 (see _recompute_p).
+    softcap: "Optional[float]" = None
     # Force the restricted (windowed) grid even when the span heuristic
     # would keep the full grid — the w << s lever: with a LARGER KV
     # block each query tile visits a short contiguous span of big
@@ -175,6 +187,10 @@ def _fwd_kernel(cfg: FlashConfig, kv_len, offset, n_k_grid, n_k, has_segs,
         k = k_ref[0, 0]  # (bk, d)
         v = v_ref[0, 0]
         s = _dot(q, k, trans_b=True) * cfg.scale
+        if cfg.softcap is not None:
+            # Cap BEFORE the mask (the masked NEG_INF must stay
+            # un-capped so masked columns still vanish under exp).
+            s = jnp.tanh(s * (1.0 / cfg.softcap)) * cfg.softcap
         mask = _mask_for(
             iq * bq, jkb * bk, bq, bk, kv_len, offset, cfg.causal,
             qs_ref[0] if has_segs else None,
@@ -296,10 +312,20 @@ def _flash_forward(q, k, v, segment_ids, cfg: FlashConfig):
 
 
 def _recompute_p(cfg, q, k, lse_row, mask):
-    """Rebuild the probability tile from saved logsumexp. (bq, bk) f32."""
+    """Rebuild the probability tile from saved logsumexp. Returns
+    (p, dcap): p the (bq, bk) f32 probabilities and dcap the softcap
+    chain-rule factor d(capped)/d(raw) = 1 - tanh^2 (None when no
+    softcap) — ``ds_raw = ds_capped * dcap`` is the only extra term
+    the capped backward needs (the lse was saved over CAPPED scores,
+    so p itself rebuilds through the same cap as the forward)."""
     s = _dot(q, k, trans_b=True) * cfg.scale
+    dcap = None
+    if cfg.softcap is not None:
+        t = jnp.tanh(s * (1.0 / cfg.softcap))
+        s = t * cfg.softcap
+        dcap = 1.0 - t * t
     s = jnp.where(mask, s, NEG_INF)
-    return jnp.exp(s - lse_row)
+    return jnp.exp(s - lse_row), dcap
 
 
 def _dq_kernel(cfg, kv_len, offset, n_k_grid, n_k, has_segs, kv_base, *refs):
@@ -343,9 +369,11 @@ def _dq_kernel(cfg, kv_len, offset, n_k_grid, n_k, has_segs, kv_base, *refs):
             window=cfg.window,
         )
         lse_row = lse_ref[0, 0]                 # (bq, 1)
-        p = _recompute_p(cfg, q, k, lse_row, mask)
+        p, dcap = _recompute_p(cfg, q, k, lse_row, mask)
         dp = _dot(do, v, trans_b=True)          # (bq, bk) f32
         ds = p * (dp - delta_ref[0, 0])
+        if dcap is not None:
+            ds = ds * dcap
         dq_sc[...] += _dot(ds.astype(k.dtype), k) * cfg.scale
 
     @pl.when(jk == n_k_grid - 1)
@@ -404,12 +432,14 @@ def _dkv_kernel(cfg, kv_len, offset, group, n_q_grid, n_q, has_segs,
             window=cfg.window,
         )
         lse_row = lse_ref[0, 0]
-        p = _recompute_p(cfg, q, k, lse_row, mask)
+        p, dcap = _recompute_p(cfg, q, k, lse_row, mask)
         # Padded query rows carry do == 0 (the wrapper zero-pads the
         # cotangent), so their p rows contribute nothing below.
         dv_sc[...] += _dot(p.astype(do.dtype), do, trans_a=True)
         dp = _dot(do, v, trans_b=True)
         ds = p * (dp - delta_ref[0, 0])
+        if dcap is not None:
+            ds = ds * dcap
         dk_sc[...] += _dot(ds.astype(q.dtype), q, trans_a=True) * cfg.scale
 
     @pl.when(jnp.logical_and(g == group - 1, iq == n_q_grid - 1))
@@ -600,6 +630,7 @@ def flash_attention(
     interpret: Optional[bool] = None,
     window: Optional[int] = None,
     window_block_k: Optional[int] = None,
+    softcap: Optional[float] = None,
 ):
     """Flash attention with the dot_product_attention layout/semantics.
 
@@ -627,6 +658,12 @@ def flash_attention(
         two-rounded) whenever ``window`` is set and the KV length is
         >= 4x the window; pass a block size to override, or 0 to
         disable and keep the full grid with in-kernel skipping.
+      softcap: Gemma-2 attention-logit soft-capping — block scores
+        become ``softcap * tanh(scores / softcap)`` before the mask
+        and the online-softmax fold (per-tile VPU elementwise; the
+        saved logsumexp is over capped scores and the backward carries
+        the matching ``1 - tanh^2`` term). Composes with ``window``,
+        GQA and ``segment_ids``; matches the XLA path's capping.
 
     Returns:
       (batch, q_len, num_heads, head_dim) in q.dtype.
@@ -670,6 +707,7 @@ def flash_attention(
         ),
         window=int(window) if window is not None else None,
         force_window_grid=force_window_grid,
+        softcap=float(softcap) if softcap is not None else None,
     )
     # Kernel-native layout: heads outside the sequence axis so each grid
     # step addresses one contiguous (seq_block, head_dim) tile.

@@ -44,6 +44,11 @@ inverts it on exit, so callers keep contiguous semantics.
 Sliding windows extend the same relevance rule: half-pairs entirely
 below ``q_pos - window`` skip, keeping long-context windowed ring
 attention O(S * window / P) compute per device in either layout.
+
+Gemma-2 tanh logit soft-capping (``softcap=``) hooks into every fold's
+partial attention (scores capped before the mask bias, exactly where
+the XLA and flash paths cap); the cross-chunk (m, l, acc) merge is
+cap-agnostic, and the backward is plain autodiff through the tanh.
 """
 
 from __future__ import annotations
@@ -58,10 +63,13 @@ from jax.sharding import Mesh, PartitionSpec as P
 from shifu_tpu.ops.attention import NEG_INF
 
 
-def _partial_attention(q, k, v, bias, scale):
+def _partial_attention(q, k, v, bias, scale, softcap=None):
     """Unnormalised blockwise attention with GQA.
 
     q: (b, sq, h, d); k/v: (b, sk, h_kv, d); bias: (b, sq, sk) additive.
+    ``softcap``: Gemma-2 tanh logit capping, applied to the scaled
+    scores BEFORE the additive mask bias (same placement as the XLA
+    and flash paths — the NEG_INF bias must stay un-capped).
     Returns (acc, m, l): acc (b, sq, h, d) f32 = sum_j exp(s - m) v;
     m, l (b, sq, h) f32 row max / normaliser.
     """
@@ -72,6 +80,8 @@ def _partial_attention(q, k, v, bias, scale):
     s = jnp.einsum(
         "bqhgd,bkhd->bqhgk", qg, k, preferred_element_type=jnp.float32
     ) * scale
+    if softcap is not None:
+        s = jnp.tanh(s / softcap) * softcap
     s = s + bias[:, :, None, None, :]
     m = jnp.max(s, axis=-1)                          # (b, sq, h_kv, g)
     p = jnp.exp(s - m[..., None])
@@ -173,6 +183,7 @@ def ring_attention(
     scale: Optional[float] = None,
     segment_ids: Optional[jax.Array] = None,
     window: Optional[int] = None,
+    softcap: Optional[float] = None,
     layout: str = "contiguous",
 ):
     """Per-shard ring attention; call inside shard_map over ``axis_name``.
@@ -188,6 +199,11 @@ def ring_attention(
         (i - window, i] in GLOBAL positions. Requires ``causal``.
         Blocks entirely out of window skip their fold (module
         docstring), so compute scales with the window, not S.
+      softcap: Gemma-2 tanh attention-logit capping, applied inside
+        every fold's partial attention before its mask bias — the
+        per-chunk (m, l, acc) merge is cap-agnostic, so the hook costs
+        one elementwise per visiting chunk and composes with
+        window/zigzag/segments.
       layout: "contiguous" (device i holds positions
         [i*s_local, (i+1)*s_local)) or "zigzag" (device i holds global
         half-chunks i and 2P-1-i — causal time balance; the caller owns
@@ -197,7 +213,13 @@ def ring_attention(
     """
     if window is not None and not causal:
         raise ValueError("window requires causal attention")
-    axis_size = jax.lax.axis_size(axis_name)
+    # jax.lax.axis_size landed in 0.6; psum(1, axis) is the old spelling
+    # (a compile-time constant either way).
+    axis_size = (
+        jax.lax.axis_size(axis_name)
+        if hasattr(jax.lax, "axis_size")
+        else int(jax.lax.psum(1, axis_name))
+    )
     my = jax.lax.axis_index(axis_name)
     b, s_local, h, d = q.shape
     if layout == "zigzag" and s_local % 2:
@@ -239,7 +261,9 @@ def ring_attention(
         # Partially-masked rows inside a relevant pair contribute
         # m_t == NEG_INF; the exp() terms below zero them out. Pairs
         # masked ENTIRELY never reach here (the relevance cond skips).
-        acc_t, m_t, l_t = _partial_attention(qb, kb, vb, bias, scale)
+        acc_t, m_t, l_t = _partial_attention(
+            qb, kb, vb, bias, scale, softcap=softcap
+        )
         m_new = jnp.maximum(m_b, m_t)
         a_old = jnp.exp(m_b - m_new)
         a_new = jnp.exp(m_t - m_new)
@@ -399,6 +423,7 @@ def ring_attention_sharded(
     scale: Optional[float] = None,
     segment_ids: Optional[jax.Array] = None,
     window: Optional[int] = None,
+    softcap: Optional[float] = None,
     batch_axes=("dp", "fsdp"),
     seq_axis: str = "sp",
     head_axis: str = "tp",
@@ -442,18 +467,25 @@ def ring_attention_sharded(
         in_specs += (sspec,)
         args += (segment_ids,)
 
+    # shard_map_compat: jax >= 0.6 spells this jax.shard_map; older jax
+    # needs jax.experimental.shard_map (the compat shim maps the kwargs)
+    # — full-manual over every mesh axis either way.
+    from shifu_tpu.parallel.ctx import shard_map_compat
+
     @functools.partial(
-        jax.shard_map,
+        shard_map_compat,
         mesh=mesh,
         in_specs=in_specs,
         out_specs=qspec,
+        axis_names=tuple(mesh.axis_names),
         check_vma=False,
     )
     def mapped(q, k, v, *rest):
         segs = rest[0] if rest else None
         return ring_attention(
             q, k, v, axis_name=seq_axis, causal=causal, scale=scale,
-            segment_ids=segs, window=window, layout=layout,
+            segment_ids=segs, window=window, softcap=softcap,
+            layout=layout,
         )
 
     out = mapped(*args)

@@ -58,12 +58,26 @@ def attention_flops_per_token(seq: int, head_dim: int, n_heads: int,
 
 
 def transformer_flops_per_token(
-    n_params: int, seq: int, head_dim: int, n_heads: int, n_layers: int
+    n_params: int, seq: int, head_dim: int, n_heads: int, n_layers: int,
+    *, layer_spans=None,
 ) -> float:
-    """6N + attention quadratic term — the standard MFU numerator (fwd+bwd)."""
-    return 6.0 * n_params + attention_flops_per_token(
-        seq, head_dim, n_heads, n_layers
-    )
+    """6N + attention quadratic term — the standard MFU numerator (fwd+bwd).
+
+    ``layer_spans``: optional per-layer attention spans for stacks
+    whose layers attend over DIFFERENT widths (alternating sliding
+    windows, Gemma-2): the attention term sums each layer's own span
+    instead of ``seq * n_layers``, so a windowed run can neither claim
+    full-causal FLOPs nor be under-credited for its full-attention
+    layers. Overrides ``seq``/``n_layers`` for the attention term only.
+    """
+    if layer_spans is not None:
+        att = sum(
+            attention_flops_per_token(s, head_dim, n_heads, 1)
+            for s in layer_spans
+        )
+    else:
+        att = attention_flops_per_token(seq, head_dim, n_heads, n_layers)
+    return 6.0 * n_params + att
 
 
 class Throughput:

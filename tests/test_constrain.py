@@ -514,6 +514,150 @@ def test_engine_json_schema_end_to_end(tiny):
                    json_schema=sch)
 
 
+# ---------------------------------------------- json mode (json_object)
+
+
+def test_json_mode_dfa_accepts_valid_json_objects():
+    from shifu_tpu.infer.constrain import json_mode_dfa
+
+    dfa = json_mode_dfa()
+    good = [
+        "{}",
+        '{ }',
+        '{"a": 1}',
+        '{"a": -2.5e3, "b": [1, "x", null, true, false, {}]}',
+        '{"nested": {"deep": {"arr": [[1], [2, 3]]}}}',
+        '{"unicode": "héllo \\n \\u00e9 漢 🙂"}',
+        '  {"ws": [ 1 ,\t2 ,\n3 ]}  ',
+        '{"empty_arr": [], "empty_obj": {}}',
+    ]
+    for g in good:
+        assert dfa.matches(g.encode()), g
+        json.loads(g)  # the soundness contract
+
+
+def test_json_mode_dfa_rejects_invalid():
+    from shifu_tpu.infer.constrain import json_mode_dfa
+
+    dfa = json_mode_dfa()
+    bad = [
+        "",
+        "[1]",            # top level must be an object (json mode)
+        '"str"',
+        "{",              # truncated
+        '{"a": }',
+        '{"a": 1,}',      # trailing comma
+        '{"a" 1}',        # missing colon
+        "{'a': 1}",       # single quotes
+        '{"a": 01}',      # leading zero
+        '{"a": +1}',
+        '{"a": 1} tail',
+        '{"a": 1}{"b": 2}',
+        '{"a": 1]',       # mismatched closer
+        '{"a": [1}}',
+        '{"a":\x0c1}',    # \f is not JSON whitespace
+        b'{"a": "\xff"}'.decode("latin1"),  # ill-formed UTF-8 string
+    ]
+    for s in bad:
+        data = s.encode("latin1") if isinstance(s, str) else s
+        assert not dfa.matches(data), s
+
+
+def test_json_mode_depth_bound():
+    """Depth-8 nesting is reachable; depth-9 is UNREACHABLE — the
+    opening bracket has no transition, so a masked decode can never
+    start what it could not finish."""
+    from shifu_tpu.infer.constrain import json_mode_dfa
+
+    dfa = json_mode_dfa()
+    # Top-level object is depth 1: 7 more array levels reach D=8.
+    d8 = '{"d":' + "[" * 7 + "1" + "]" * 7 + "}"
+    d9 = '{"d":' + "[" * 8 + "1" + "]" * 8 + "}"
+    assert dfa.matches(d8.encode()) and json.loads(d8)
+    assert not dfa.matches(d9.encode())
+    # The 9th opener is dead at the OPEN, not at the close.
+    s = 0
+    for b in ('{"d":' + "[" * 7).encode():
+        s = dfa.step(s, b)
+        assert s != dfa.dead
+    assert dfa.step(s, ord("[")) == dfa.dead
+    # Mixed container types count against the same bound.
+    mixed = '{"a": [{"b": [{"c": [1]}]}]}'  # depth 7: parses + matches
+    assert dfa.matches(mixed.encode()) and json.loads(mixed)
+
+
+def test_json_mode_random_walks_parse():
+    """Property check: ANY byte string the DFA accepts must
+    json.loads-parse — random walks over the live transitions, biased
+    toward closing so they terminate, all land on parseable output."""
+    import random
+
+    from shifu_tpu.infer.constrain import json_mode_dfa
+
+    dfa = json_mode_dfa()
+    rng = random.Random(0)
+    closers = {ord("}"), ord("]"), ord('"')}
+    done = 0
+    for _ in range(60):
+        s, out = 0, bytearray()
+        for _ in range(300):
+            if dfa.accepting[s] and out:
+                break
+            row = dfa.table[s]
+            if not row:
+                break
+            keys = list(row)
+            prefer = [b for b in keys if b in closers]
+            b = rng.choice(prefer if prefer and rng.random() < 0.7
+                           else keys)
+            out.append(b)
+            s = row[b]
+        if dfa.accepting[s]:
+            done += 1
+            json.loads(bytes(out).decode("utf-8"))
+    assert done >= 30  # most walks terminate; all that do must parse
+
+
+def test_engine_json_object_end_to_end(tiny):
+    """submit(json_schema={"type": "json_object"}) — the server's
+    response_format json mode — emits parseable JSON at eos and a
+    viable prefix otherwise; the sentinel conflicts loudly with a
+    prebuilt constraint."""
+    from shifu_tpu.infer.constrain import JSON_MODE_SCHEMA, json_mode_dfa
+
+    model, params = tiny
+    tok = ByteTokenizer()
+    done = _serve(
+        model, params,
+        [(tok.encode("json: "), {"json_schema": JSON_MODE_SCHEMA})],
+        max_new=48, eos_id=tok.eos_id,
+    )[0]
+    text = tok.decode(done.tokens)
+    if done.finished_by == "eos":
+        assert isinstance(json.loads(text), dict)
+    else:
+        dfa = json_mode_dfa()
+        s = 0
+        for byte in text.encode():
+            s = dfa.step(s, byte)
+            assert s != dfa.dead, text
+    eng = Engine(
+        model, params, max_slots=1, max_len=32,
+        prefill_buckets=(16, 32), enable_logit_bias=True,
+        tokenizer=tok,
+    )
+    with pytest.raises(ValueError, match="not both"):
+        from shifu_tpu.infer.constrain import TokenFSM, compile_regex
+        from shifu_tpu.infer.constrain import token_byte_table
+
+        fsm = TokenFSM(
+            compile_regex(r"\d+"),
+            token_byte_table(tok, tok.vocab_size),
+        )
+        eng.submit([1, 2], max_new_tokens=2,
+                   json_schema=dict(JSON_MODE_SCHEMA), constraint=fsm)
+
+
 def test_schema_json_strictness():
     """Everything the schema grammar accepts must PARSE as JSON:
     leading-zero numbers, raw control characters, and ILL-FORMED UTF-8

@@ -115,6 +115,15 @@ def test_gemma2_config_mapping():
     assert cfg.mlp_act == "gelu_tanh"
     assert cfg.post_norms and cfg.embed_scale and cfg.tie_embeddings
     assert cfg.window_size == 4 and cfg.window_pattern == 2
+    # ISSUE 4: softcap + alternating windows no longer force the XLA
+    # path — converted Gemma-2 selects the flash kernel by default
+    # (the kernel caps in its online softmax and lax.cond's the
+    # per-layer window), with attn_impl="xla" available via overrides
+    # as the parity oracle.
+    assert cfg.attn_impl == "flash"
+    assert config_from_hf_llama(
+        tiny_hf_gemma2().config, attn_impl="xla"
+    ).attn_impl == "xla"
 
 
 def test_gemma2_logits_match_torch():
@@ -162,8 +171,10 @@ def test_gemma2_roundtrip():
 def test_gemma2_serves_through_paged_engine():
     """A converted Gemma-2 decodes greedily through the paged engine ==
     its own full-forward argmax walk (per-layer windows + softcaps
-    through the decode/cache path; attn_impl='xla' is forced by the
-    window_pattern validation, so CPU and TPU run the same path)."""
+    through the decode/cache path; the config now selects
+    attn_impl='flash' — prefill rides the static-window flash
+    branches, decode the XLA gather fallback that handles the traced
+    per-layer window + softcap)."""
     from shifu_tpu.infer import PagedEngine, SampleConfig
 
     hf = tiny_hf_gemma2()
@@ -207,9 +218,11 @@ def test_qwen3_serves_through_engine():
 
 def test_gemma2_through_lookup_speculation():
     """The family x engine matrix holds: a converted Gemma-2 (softcaps
-    + alternating windows, attn_impl='xla' so the spec verify rides
-    the paged XLA path) decodes greedily through the prompt-lookup
-    speculative engine EXACTLY like the plain paged engine."""
+    + alternating windows, the flash-by-default config — spec verify
+    rides the paged XLA gather fallback, which handles the traced
+    per-layer window + softcap) decodes greedily through the
+    prompt-lookup speculative engine EXACTLY like the plain paged
+    engine."""
     from shifu_tpu.infer import (
         PagedEngine,
         PromptLookupPagedEngine,

@@ -197,6 +197,39 @@ def test_metric_floors_dormant_below_and_armed_above(baseline):
     assert ok, report["regressions"]
 
 
+def test_g2_leg_floor_and_ratio_gated(baseline):
+    """The Gemma-2 flash-path keys (ISSUE 4): absent from r05 (the leg
+    is new) so they gate as skips there; once a round records them,
+    the armable g2_mfu floor and the g2_x_xla ratio both enforce."""
+    from shifu_tpu.obs.benchgate import METRIC_FLOORS, METRIC_SPECS
+
+    assert "g2_mfu" in METRIC_SPECS and "g2_x_xla" in METRIC_SPECS
+    assert "g2_mfu" not in baseline  # new leg: r05 must gate unchanged
+    cur = dict(baseline)
+    cur.update({"g2_mfu": 0.57, "g2_x_xla": 1.21})
+    ok, report = check_bench(cur, baseline)
+    assert ok  # first round to record the leg: skipped, not gated
+    skipped = {s["key"] for s in report["skipped"]}
+    assert "g2_mfu" in skipped and "g2_x_xla" in skipped
+
+    b = dict(baseline)
+    b.update({"g2_mfu": 0.57, "g2_x_xla": 1.21})
+    cur = dict(b)
+    cur["g2_mfu"] = 0.54  # inside 8% relative, below the armed floor
+    ok, report = check_bench(cur, b)
+    assert not ok
+    (row,) = [r for r in report["regressions"] if r["key"] == "g2_mfu"]
+    assert row["verdict"] == "BELOW_FLOOR"
+    assert row["floor"] == METRIC_FLOORS["g2_mfu"]
+
+    cur = dict(b)
+    cur["g2_x_xla"] = 1.0  # the family fell back to the XLA path
+    ok, report = check_bench(cur, b)
+    assert not ok
+    (row,) = [r for r in report["regressions"] if r["key"] == "g2_x_xla"]
+    assert row["verdict"] == "REGRESSED"
+
+
 def test_moe_grouped_ratio_gated():
     # The grouped-vs-dense ratio is a first-class gated metric: it
     # collapsing to ~1 (grouped default silently lost) must fail.

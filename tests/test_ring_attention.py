@@ -158,7 +158,11 @@ def test_train_step_with_ring_attention():
         state, metrics = step(state, batch)
         losses[impl] = float(metrics["loss"])
         assert np.isfinite(losses[impl])
-    np.testing.assert_allclose(losses["ring"], losses["xla"], rtol=1e-4)
+    # 5e-4: the bf16-compute policy's fold-order difference lands at
+    # ~2e-4 relative on the legacy shard_map path (old-jax containers,
+    # where this suite first became runnable); both paths agree to
+    # ~1e-5 in f32 (the op-level tests above).
+    np.testing.assert_allclose(losses["ring"], losses["xla"], rtol=5e-4)
 
 
 # ------------------------------------------------------------- zigzag

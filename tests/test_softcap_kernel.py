@@ -1,0 +1,279 @@
+"""Attention-logit softcap parity: flash/ring kernels vs the XLA oracle.
+
+The Gemma-2 fast path (ISSUE 4): tanh soft-capping must land inside the
+flash kernel's online softmax (fwd) with the matching sech^2 term in the
+custom-vjp backward, and inside every ring fold — across the window,
+GQA, packed-segment and forced-window-grid combinations the dispatch can
+route there. The XLA path (ops.attention.dot_product_attention) is the
+parity oracle throughout; everything here runs in f32 with the
+conftest-forced "highest" matmul precision so the comparison isolates
+the math, not dtype rounding.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from shifu_tpu.ops.attention import dot_product_attention
+from shifu_tpu.ops.pallas.flash_attention import flash_attention
+from shifu_tpu.parallel import MeshPlan
+from shifu_tpu.parallel.ring import ring_attention_sharded
+
+CAP = 30.0
+
+
+def _qkv(seed, b, s, h, h_kv, d):
+    rng = np.random.RandomState(seed)
+    return (
+        jnp.asarray(rng.randn(b, s, h, d), jnp.float32),
+        jnp.asarray(rng.randn(b, s, h_kv, d), jnp.float32),
+        jnp.asarray(rng.randn(b, s, h_kv, d), jnp.float32),
+    )
+
+
+def _sq_loss(fn):
+    return lambda q, k, v: jnp.sum(jnp.square(fn(q, k, v)))
+
+
+# ------------------------------------------------------------ flash fwd
+
+
+@pytest.mark.parametrize("window", [None, 7, 20])
+def test_flash_softcap_matches_xla(window):
+    # GQA (4 q heads on 2 kv heads), multi-block so block skipping and
+    # the per-block cap interact.
+    q, k, v = _qkv(0, 2, 64, 4, 2, 16)
+    want = dot_product_attention(
+        q, k, v, causal=True, window=window, softcap=CAP
+    )
+    got = flash_attention(
+        q, k, v, causal=True, window=window, softcap=CAP,
+        block_q=16, block_k=16,
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-6
+    )
+
+
+def test_flash_softcap_small_cap_saturates_consistently():
+    # A small cap drives many scores into tanh saturation — the regime
+    # where a wrong cap placement (after the mask, or on the lse) shows
+    # up immediately.
+    q, k, v = _qkv(1, 1, 32, 2, 1, 8)
+    q = q * 4.0
+    want = dot_product_attention(q, k, v, causal=True, softcap=2.0)
+    got = flash_attention(
+        q, k, v, causal=True, softcap=2.0, block_q=8, block_k=8
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-6
+    )
+
+
+# ----------------------------------------------------------- flash grad
+
+
+@pytest.mark.parametrize("window", [None, 5])
+def test_flash_softcap_grads_match_xla(window):
+    q, k, v = _qkv(2, 1, 32, 4, 2, 8)
+
+    g_ref = jax.grad(_sq_loss(
+        lambda q, k, v: dot_product_attention(
+            q, k, v, causal=True, window=window, softcap=CAP
+        )
+    ), argnums=(0, 1, 2))(q, k, v)
+    g_fl = jax.grad(_sq_loss(
+        lambda q, k, v: flash_attention(
+            q, k, v, causal=True, window=window, softcap=CAP,
+            block_q=8, block_k=8,
+        )
+    ), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ref, g_fl):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5
+        )
+
+
+def test_flash_softcap_packed_segments_fwd_and_grad():
+    # Packed sequences: the segment mask must compose with the cap
+    # (cap BEFORE mask — a capped NEG_INF would stop masking).
+    q, k, v = _qkv(3, 2, 32, 4, 2, 8)
+    seg = jnp.where(jnp.arange(32) < 13, 0, 1)[None, :].repeat(2, 0)
+    want = dot_product_attention(
+        q, k, v, causal=True, segment_ids=seg, softcap=CAP
+    )
+    got = flash_attention(
+        q, k, v, causal=True, segment_ids=seg, softcap=CAP,
+        block_q=8, block_k=8,
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-6
+    )
+
+    g_ref = jax.grad(_sq_loss(
+        lambda q, k, v: dot_product_attention(
+            q, k, v, causal=True, segment_ids=seg, softcap=CAP
+        )
+    ), argnums=(0, 1, 2))(q, k, v)
+    g_fl = jax.grad(_sq_loss(
+        lambda q, k, v: flash_attention(
+            q, k, v, causal=True, segment_ids=seg, softcap=CAP,
+            block_q=8, block_k=8,
+        )
+    ), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ref, g_fl):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5
+        )
+
+
+def test_flash_softcap_forced_window_grid():
+    # The PR-3 w << s lever (window_block_k forces the restricted grid
+    # with a fat KV block) must compose with the cap — this is the
+    # exact configuration the windowed Gemma-2 train legs run.
+    q, k, v = _qkv(4, 1, 256, 2, 1, 8)
+    w = 8
+    want = dot_product_attention(
+        q, k, v, causal=True, window=w, softcap=CAP
+    )
+    got = flash_attention(
+        q, k, v, causal=True, window=w, softcap=CAP,
+        block_q=8, block_k=8, window_block_k=16,
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-6
+    )
+
+    g_ref = jax.grad(_sq_loss(
+        lambda q, k, v: dot_product_attention(
+            q, k, v, causal=True, window=w, softcap=CAP
+        )
+    ), argnums=(0, 1, 2))(q, k, v)
+    g_fl = jax.grad(_sq_loss(
+        lambda q, k, v: flash_attention(
+            q, k, v, causal=True, window=w, softcap=CAP,
+            block_q=8, block_k=8, window_block_k=16,
+        )
+    ), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ref, g_fl):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5
+        )
+
+
+# ------------------------------------------------------------- dispatch
+
+
+def test_dispatch_flash_softcap_no_refusal():
+    # The old dispatch refused softcap off the XLA path; now it must
+    # route to the kernel and agree with the oracle.
+    q, k, v = _qkv(5, 1, 32, 2, 2, 8)
+    want = dot_product_attention(q, k, v, causal=True, softcap=CAP)
+    got = dot_product_attention(
+        q, k, v, causal=True, softcap=CAP, impl="flash"
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-6
+    )
+
+
+def test_dispatch_flash_rejects_traced_window():
+    # A traced per-layer window must never silently reach the flash
+    # kernel (its grids are static) — the model's static-window cond
+    # dispatch is the supported route.
+    q, k, v = _qkv(6, 1, 16, 2, 2, 8)
+
+    def f(w):
+        return dot_product_attention(
+            q, k, v, causal=True, window=w, impl="flash"
+        )
+
+    with pytest.raises(ValueError, match="static window"):
+        jax.jit(f)(jnp.int32(4))
+
+
+# ----------------------------------------------------------------- ring
+
+
+@pytest.mark.parametrize("window", [None, 24])
+def test_ring_softcap_matches_xla(window):
+    # sp=4 ring with GQA + tp head split; cap applied inside each
+    # visiting chunk's fold must reproduce the global capped softmax.
+    mesh = MeshPlan(sp=4, tp=2).build(jax.devices())
+    q, k, v = _qkv(7, 2, 64, 4, 2, 16)
+    ref = dot_product_attention(
+        q, k, v, causal=True, window=window, softcap=CAP
+    )
+    out = jax.jit(
+        lambda q, k, v: ring_attention_sharded(
+            q, k, v, mesh, causal=True, window=window, softcap=CAP
+        )
+    )(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=1e-5, rtol=1e-5
+    )
+
+
+def test_ring_softcap_gradients_match_xla():
+    mesh = MeshPlan(sp=4, tp=2).build(jax.devices())
+    q, k, v = _qkv(8, 1, 32, 2, 2, 8)
+    g_ref = jax.grad(_sq_loss(
+        lambda q, k, v: dot_product_attention(
+            q, k, v, causal=True, softcap=CAP
+        )
+    ), argnums=(0, 1, 2))(q, k, v)
+    g_ring = jax.jit(jax.grad(_sq_loss(
+        lambda q, k, v: ring_attention_sharded(
+            q, k, v, mesh, causal=True, softcap=CAP
+        )
+    ), argnums=(0, 1, 2)))(q, k, v)
+    for a, b in zip(g_ref, g_ring):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-5
+        )
+
+
+# ---------------------------------------------------------- model level
+
+
+def test_gemma2_shaped_model_flash_matches_xla():
+    """The full Gemma-2 feature stack — attn softcap + attn_scale +
+    alternating windows + sandwich norms + embed scale — through the
+    flash path equals the XLA-path model bit-for-bit in structure
+    (same params), to f32 tolerance in value: fwd logits AND loss
+    grads."""
+    import dataclasses
+
+    from shifu_tpu.core.dtypes import FULL_F32
+    from shifu_tpu.models import Transformer, TransformerConfig
+
+    cfg_x = TransformerConfig.tiny(
+        window_size=4, window_pattern=2, attn_softcap=20.0,
+        attn_scale=32.0, post_norms=True, embed_scale=True,
+        n_layers=4,
+    )
+    cfg_f = dataclasses.replace(cfg_x, attn_impl="flash")
+    params = Transformer(cfg_x).init(jax.random.key(0))
+    tokens = jnp.asarray(
+        np.random.RandomState(9).randint(0, 256, (2, 24)), jnp.int32
+    )
+    ref = Transformer(cfg_x, policy=FULL_F32)(params, tokens)
+    got = Transformer(cfg_f, policy=FULL_F32)(params, tokens)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), rtol=1e-4, atol=1e-5
+    )
+
+    batch = {"tokens": tokens}
+    g_ref = jax.grad(
+        lambda p: Transformer(cfg_x, policy=FULL_F32).loss(p, batch)[0]
+    )(params)
+    g_fl = jax.grad(
+        lambda p: Transformer(cfg_f, policy=FULL_F32).loss(p, batch)[0]
+    )(params)
+    flat_r, _ = jax.tree_util.tree_flatten(g_ref)
+    flat_f, _ = jax.tree_util.tree_flatten(g_fl)
+    for a, b in zip(flat_r, flat_f):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-4, atol=5e-5
+        )

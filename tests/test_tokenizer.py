@@ -58,6 +58,127 @@ def test_tokenize_corpus_feeds_loader(tmp_path):
     assert batch["tokens"].max() < tok.vocab_size
 
 
+# --------------------------------------------------- exact token bytes
+
+# Non-ASCII, emoji, mixed whitespace, CJK, combining marks — the byte
+# coverage the round-trip property must survive.
+_ROUNDTRIP_STRINGS = [
+    "hello world",
+    "héllo — ünïcode 漢字 🙂",
+    "tabs\tand\nnewlines  and   runs of spaces",
+    "emoji soup 🙂🙃🤖 🏳️‍🌈 done",
+    "mixé: café naïve Zürich",
+    "𝔘𝔫𝔦𝔠𝔬𝔡𝔢 math and ₿ signs",
+]
+
+
+def _byte_level_hf():
+    """A GPT-2-style byte-level BPE fast tokenizer trained in-process
+    (no hub access): ByteLevel pre-tokenizer/decoder over a tiny merge
+    table — the same surface encoding as the real GPT-2 vocab."""
+    from tokenizers import Tokenizer, decoders, models, pre_tokenizers
+    from tokenizers.trainers import BpeTrainer
+    from transformers import PreTrainedTokenizerFast
+
+    t = Tokenizer(models.BPE(unk_token=None))
+    t.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+    t.decoder = decoders.ByteLevel()
+    t.train_from_iterator(
+        _ROUNDTRIP_STRINGS * 3,
+        BpeTrainer(
+            vocab_size=512,
+            special_tokens=["<|endoftext|>"],
+            initial_alphabet=pre_tokenizers.ByteLevel.alphabet(),
+        ),
+    )
+    return PreTrainedTokenizerFast(
+        tokenizer_object=t, eos_token="<|endoftext|>"
+    )
+
+
+def _sentencepiece_hf():
+    """A sentencepiece-style fast tokenizer (Unigram + Metaspace +
+    byte fallback — the Llama surface encoding) built locally: ▁ marks
+    word starts, uncovered characters fall back to <0xHH> pieces."""
+    from tokenizers import Tokenizer, decoders, models, pre_tokenizers
+    from transformers import PreTrainedTokenizerFast
+
+    vocab = [("<unk>", 0.0), ("▁", -2.0), ("▁hello", -1.0),
+             ("▁world", -1.0), ("hello", -1.5), ("he", -3.5),
+             ("lo", -3.0), ("l", -4.0), ("o", -4.0), ("w", -4.0)]
+    vocab += [(f"<0x{b:02X}>", -10.0) for b in range(256)]
+    t = Tokenizer(models.Unigram(vocab, unk_id=0, byte_fallback=True))
+    t.pre_tokenizer = pre_tokenizers.Metaspace(
+        replacement="▁", prepend_scheme="never"
+    )
+    t.decoder = decoders.Sequence([
+        decoders.Replace("▁", " "), decoders.ByteFallback(),
+        decoders.Fuse(),
+    ])
+    return PreTrainedTokenizerFast(
+        tokenizer_object=t, unk_token="<unk>"
+    )
+
+
+@pytest.mark.parametrize("build", [_byte_level_hf, _sentencepiece_hf],
+                         ids=["bytelevel-bpe", "sentencepiece"])
+def test_hf_token_bytes_roundtrip_property(build):
+    """THE token_bytes contract (ISSUE 4 satellite): concatenating
+    each encoded id's raw bytes reproduces the input's UTF-8 exactly —
+    including ids that are NOT standalone valid UTF-8 (a lone byte of
+    a multi-byte character), which decode-in-isolation smears into
+    U+FFFD."""
+    pytest.importorskip("tokenizers")
+    tok = HFTokenizer(build())
+    for s in _ROUNDTRIP_STRINGS:
+        ids = tok.encode(s)
+        got = b"".join(tok.token_bytes(t) for t in ids)
+        assert got == s.encode("utf-8"), s
+
+
+def test_hf_token_bytes_exact_where_decode_smears():
+    pytest.importorskip("tokenizers")
+    tok = HFTokenizer(_sentencepiece_hf())
+    ids = tok.encode("é")  # no é piece -> <0xC3><0xA9> byte fallback
+    assert len(ids) == 2
+    assert [tok.token_bytes(t) for t in ids] == [b"\xc3", b"\xa9"]
+    # decode-in-isolation of either half smears to U+FFFD — the exact
+    # failure the hook exists to fix.
+    assert b"".join(tok.token_bytes(t) for t in ids) == "é".encode()
+
+
+def test_hf_token_bytes_specials_and_range():
+    pytest.importorskip("tokenizers")
+    tok = HFTokenizer(_byte_level_hf())
+    eos = tok.eos_id
+    assert tok.token_bytes(eos) == b""  # specials: never in the FSM
+    assert tok.token_bytes(10**6) == b""  # out of range
+    # The constrain-layer table prefers the hook and matches it.
+    from shifu_tpu.infer.constrain import token_byte_table
+
+    table = token_byte_table(tok, tok.vocab_size)
+    assert table == [tok.token_bytes(t) for t in range(tok.vocab_size)]
+
+
+def test_hf_token_bytes_refuses_wordpiece(tmp_path):
+    """Uncovered vocab types refuse LOUDLY (BERT WordPiece defines no
+    raw bytes per token) — and the constrain-layer table degrades to
+    the decode fallback instead of a silent all-b'' alphabet."""
+    from transformers import BertTokenizer
+
+    vf = tmp_path / "vocab.txt"
+    vf.write_text("\n".join(
+        ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "hello", "world", "##!"]
+    ))
+    tok = HFTokenizer(BertTokenizer(str(vf), do_lower_case=True))
+    with pytest.raises(NotImplementedError, match="vocab type"):
+        tok.token_bytes(4)
+    from shifu_tpu.infer.constrain import token_byte_table
+
+    table = token_byte_table(tok, 7)
+    assert table[4] == b"hello"  # decode fallback, not b""
+
+
 def test_tokenize_corpus_dtype_autoselect(tmp_path):
     class BigVocab(ByteTokenizer):
         @property

@@ -336,8 +336,9 @@ def test_template_tool_support_detection(tiny):
 
 def test_response_format_alias(served):
     """OpenAI response_format {"type": "json_schema"} maps onto the
-    engine's json_schema constraint; "json_object" (any JSON — not a
-    regular language) and unknown types 400; "text" is a no-op."""
+    engine's json_schema constraint; "json_object" (json mode) onto
+    the bounded-depth JSON grammar (ISSUE 4 satellite — previously a
+    400); unknown types 400; "text" is a no-op."""
     schema = {"type": "object",
               "properties": {"ok": {"type": "boolean"}}}
     status, out = _post(served, "/v1/chat/completions", {
@@ -350,10 +351,18 @@ def test_response_format_alias(served):
         obj = json.loads(out["message"]["content"])
         assert set(obj) == {"ok"}
     status, out = _post(served, "/v1/chat/completions", {
-        "messages": _MSGS, "max_new_tokens": 8,
+        "messages": _MSGS, "max_new_tokens": 64,
         "response_format": {"type": "json_object"},
     })
-    assert status == 400 and "regular language" in out["error"]
+    assert status == 200
+    if out["finished_by"] == "eos":
+        json.loads(out["message"]["content"])
+    status, out = _post(served, "/v1/chat/completions", {
+        "messages": _MSGS, "max_new_tokens": 8,
+        "response_format": {"type": "json_object"},
+        "json_schema": schema,
+    })
+    assert status == 400 and "not both" in out["error"]
     status, _ = _post(served, "/v1/chat/completions", {
         "messages": _MSGS, "max_new_tokens": 4,
         "response_format": {"type": "text"},
