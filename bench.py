@@ -106,6 +106,10 @@ def main(argv=None):
             out["serving_lookup_text"] = {
                 "error": f"{type(e).__name__}: {e}"
             }
+        try:
+            out["fleet_routed"] = bench_fleet_routed()
+        except Exception as e:
+            out["fleet_routed"] = {"error": f"{type(e).__name__}: {e}"}
     # Runtime self-telemetry in the full ledger: device-memory rollup
     # + how many compiles the bench's engines paid (the obs registry
     # counted them via the engines' tracked programs).
@@ -261,6 +265,11 @@ def _compact(out: dict) -> dict:
         ("g2_ms", g("train_legs", "gemma2", "step_ms")),
         ("g2_x_xla", g("train_legs", "gemma2", "flash_vs_xla")),
         ("g2_xla_mfu", g("train_legs", "gemma2", "xla_oracle", "mfu")),
+        # fleet-routed overhead (round 7): one extra HTTP hop through
+        # the FleetRouter vs hitting the backend server directly —
+        # the ratio creeping up means the router grew a hot-path cost
+        ("fleet_x_direct", g("fleet_routed", "routed_vs_direct")),
+        ("fleet_rt_ms", g("fleet_routed", "routed_ms")),
         ("moe_mfu", g("train_legs", "moe", "mfu")),
         # grouped-vs-dense MoE dispatch (round 6): the measured ratio
         # and the einsum oracle's own MFU (the "before" number)
@@ -507,6 +516,87 @@ def bench_train_moe(dev):
     except Exception as e:  # the oracle sub-leg must not sink the leg
         leg["einsum_oracle"] = {"error": f"{type(e).__name__}: {e}"}
     return leg
+
+
+def bench_fleet_routed():
+    """Fleet-routed vs direct single-backend request overhead.
+
+    One small engine served two ways from this process: clients hit
+    the backend server directly, then the same requests route through
+    a FleetRouter's front-end (client -> router HTTP -> backend HTTP
+    -> engine). The ratio is the fleet hop's whole cost — SSE
+    re-streaming, the worker thread, breaker/metrics bookkeeping — and
+    it regressing toward 2x would mean the router grew a per-token
+    hot-path cost. Sequential requests (no slot contention) so the
+    ratio measures the hop, not queueing."""
+    import threading
+    import urllib.request
+
+    from shifu_tpu.fleet import BackendClient, FleetRouter
+    from shifu_tpu.infer import SampleConfig, make_server
+    from shifu_tpu.infer.engine import PagedEngine
+    from shifu_tpu.models.transformer import Transformer, TransformerConfig
+    from shifu_tpu.obs import FlightRecorder, MetricsRegistry
+
+    cfg = TransformerConfig.small()
+    model = Transformer(cfg)
+    params = model.init(jax.random.key(0))
+    engine = PagedEngine(
+        model, params, max_slots=4, max_len=256, page_size=16,
+        prefill_buckets=(32, 256),
+        sample_cfg=SampleConfig(temperature=0.0),
+    )
+    bsrv = make_server(engine, port=0)
+    threading.Thread(target=bsrv.serve_forever, daemon=True).start()
+    rsrv = None
+    n_requests, max_new = 8, 32
+    try:
+        client = BackendClient(f"127.0.0.1:{bsrv.server_port}")
+        client.probe()
+        client.models()
+        router = FleetRouter(
+            [client], metrics=MetricsRegistry(), flight=FlightRecorder()
+        )
+        rsrv = make_server(router, port=0)
+        threading.Thread(target=rsrv.serve_forever, daemon=True).start()
+
+        def one(base, i):
+            req = urllib.request.Request(
+                base + "/v1/completions",
+                data=json.dumps({
+                    "tokens": [1, 2, 3 + i], "max_new_tokens": max_new,
+                }).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            t0 = time.monotonic()
+            with urllib.request.urlopen(req, timeout=300) as r:
+                out = json.loads(r.read())
+            assert len(out["tokens"]) == max_new
+            return (time.monotonic() - t0) * 1000.0
+
+        direct = f"http://127.0.0.1:{bsrv.server_port}"
+        routed = f"http://127.0.0.1:{rsrv.server_port}"
+        one(direct, 0)  # warm compiles (prefill bucket + decode)
+        one(routed, 0)  # warm the router path (threads, SSE plumbing)
+        direct_ms = [one(direct, i) for i in range(n_requests)]
+        routed_ms = [one(routed, i) for i in range(n_requests)]
+        d = sum(direct_ms) / len(direct_ms)
+        r = sum(routed_ms) / len(routed_ms)
+        return {
+            "requests": n_requests,
+            "max_new_tokens": max_new,
+            "direct_ms": round(d, 3),
+            "routed_ms": round(r, 3),
+            "routed_vs_direct": round(r / d, 4),
+            "hop_overhead_ms": round(r - d, 3),
+        }
+    finally:
+        if rsrv is not None:
+            rsrv.shutdown()
+            rsrv.runner.shutdown()
+        bsrv.shutdown()
+        bsrv.runner.shutdown()
 
 
 def bench_serving():

@@ -4,7 +4,9 @@
     dpo        DPO preference tuning from a JSONL of pairs
     eval       perplexity over a dataset (params-only checkpoint read)
     generate   text completion from a checkpoint
-    serve      HTTP completions server (continuous batching, paged KV)
+    serve      HTTP completions server (continuous batching, paged KV);
+               with --fleet host:port,... it becomes the FLEET ROUTER
+               federating remote serve hosts (shifu_tpu/fleet)
     bpe-train  train a byte-level BPE tokenizer (native C++ core)
     trace      export serving request traces as Chrome trace-event JSON
     debug      dump the flight-recorder ring (live server's /debugz or
@@ -1004,9 +1006,75 @@ def build_serve_engine(args, model, params, tok):
     )
 
 
+def _serve_fleet(args, spec: str) -> int:
+    """``serve --fleet host:port,...``: this process is the ROUTER —
+    no model, no device; it federates remote engine servers (each an
+    ordinary ``serve`` on its host) behind the same HTTP front-end.
+    The serving analogue of a multi-host training job's coordinator
+    (fleet/bootstrap.py mirrors parallel/distributed.py)."""
+    from shifu_tpu.fleet import build_fleet
+    from shifu_tpu.infer import make_server
+    from shifu_tpu.obs import SLOConfig, SLOWatchdog
+
+    tok = _build_tokenizer(args)
+    try:
+        router = build_fleet(
+            spec,
+            ready_timeout_s=args.fleet_ready_timeout,
+            require_all=args.fleet_require_all,
+            probe_interval_s=args.fleet_probe_interval,
+        )
+    except (ValueError, RuntimeError) as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    watchdog = None
+    slo_cfg = SLOConfig(
+        p99_ttft_ms=args.slo_p99_ttft_ms,
+        p99_itl_ms=args.slo_p99_itl_ms,
+        max_step_ms=args.slo_max_step_ms,
+        max_queue_depth=args.slo_max_queue,
+    )
+    if slo_cfg.active():
+        watchdog = SLOWatchdog(slo_cfg)
+    server = make_server(
+        router,
+        host=args.host,
+        port=args.port,
+        tokenizer=tok,
+        default_max_new=args.max_new_tokens,
+        trace_log=args.trace_log,
+        watchdog=watchdog,
+        flight_dump=args.flight_dump,
+    )
+    print(
+        json.dumps(
+            {
+                "serving": f"http://{args.host}:{server.server_port}",
+                "engine": "FleetRouter",
+                "backends": [b.addr for b in router.backends],
+            }
+        ),
+        flush=True,
+    )
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.shutdown()
+        server.runner.shutdown()
+        router.prober.stop()
+    return 0
+
+
 def cmd_serve(args) -> int:
+    import os
+
     from shifu_tpu.infer import make_server
 
+    fleet_spec = args.fleet or os.environ.get("SHIFU_FLEET")
+    if fleet_spec:
+        return _serve_fleet(args, fleet_spec)
     model = _build_model(args)
     params = _restore_params(args, model)
     tok = _build_tokenizer(args)
@@ -1411,6 +1479,27 @@ def main(argv=None) -> int:
                         "weights (instead of replicating them), dp "
                         "model replicas behind one router "
                         "(dp x tp x ep devices total)")
+    s.add_argument("--fleet",
+                   help="ROUTER mode: comma-separated backend roster "
+                        "host:port,... (or SHIFU_FLEET env var). This "
+                        "process builds no model/engine — it federates "
+                        "remote `serve` hosts behind one HTTP surface "
+                        "with health-aware least-loaded routing, "
+                        "retries with a budget, circuit breakers, and "
+                        "POST /drainz graceful draining (shifu_tpu/"
+                        "fleet; docs/architecture.md)")
+    s.add_argument("--fleet-probe-interval", type=float, default=2.0,
+                   help="seconds between backend /healthz re-probes "
+                        "(dead backends rejoin within one interval of "
+                        "recovering)")
+    s.add_argument("--fleet-ready-timeout", type=float, default=60.0,
+                   help="startup readiness gate: how long to wait for "
+                        "backends' /healthz before serving (default: "
+                        "start when ANY backend is ready)")
+    s.add_argument("--fleet-require-all", action="store_true",
+                   help="readiness gate requires EVERY roster entry "
+                        "(default: any one backend suffices; the "
+                        "prober brings stragglers in later)")
     s.add_argument("--lora-ckpt-dir", action="append",
                    help="LoRA adapter checkpoint dir (repeatable; "
                         "adapter ids are assigned 1..n in flag order; "

@@ -1,0 +1,62 @@
+"""Multi-host serving fleet: HTTP-federated router over engine servers.
+
+Training crosses hosts through ``parallel/distributed.py`` (JAX's GRPC
+coordination service + DCN collectives); serving crosses hosts HERE,
+through the engine HTTP/SSE protocol — the already-hardened,
+hardware-agnostic surface every per-host server speaks (infer/server.py).
+One router process federates N backend hosts behind the SAME server
+front-end, so clients, the obs stack, and the CLI see one engine:
+
+``backend``    a client for ONE remote engine host: submit + SSE
+               stream pass-through, /healthz + /metrics scrape,
+               per-call timeouts, capped exponential backoff with
+               jitter, a shared retry budget, and a circuit breaker
+               (trips on consecutive failures, half-opens on probe).
+``router``     :class:`FleetRouter` — speaks the explicit
+               ``ENGINE_INTERFACE`` contract (plus pooled
+               ``counters()``/``latency_stats()``), so
+               ``infer/server.py`` fronts a fleet unchanged:
+               least-loaded routing, automatic resubmission of queued
+               (not-yet-streamed) requests when a backend dies, and
+               graceful draining via ``POST /drainz``.
+``bootstrap``  the serving analogue of ``parallel/distributed.py``:
+               host roster from ``--fleet host:port,...`` / the
+               ``SHIFU_FLEET`` env var, readiness gating on each
+               backend's ``/healthz``, and a periodic re-probe loop
+               that brings dead backends back (``backend_up`` /
+               ``backend_down`` flight events).
+
+See docs/architecture.md ("The serving fleet") for the design and the
+failure model, and README.md for the serving-topology ladder
+(``tp`` -> ``dp x tp`` -> fleet of hosts).
+"""
+
+from shifu_tpu.fleet.backend import (
+    BackendClient,
+    BackendConfig,
+    BackendError,
+    CircuitBreaker,
+    FleetUnavailable,
+    RetryPolicy,
+)
+from shifu_tpu.fleet.router import FleetRouter
+from shifu_tpu.fleet.bootstrap import (
+    FleetProber,
+    build_fleet,
+    parse_fleet,
+    wait_ready,
+)
+
+__all__ = [
+    "BackendClient",
+    "BackendConfig",
+    "BackendError",
+    "CircuitBreaker",
+    "FleetProber",
+    "FleetRouter",
+    "FleetUnavailable",
+    "RetryPolicy",
+    "build_fleet",
+    "parse_fleet",
+    "wait_ready",
+]

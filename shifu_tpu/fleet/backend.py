@@ -1,0 +1,496 @@
+"""Client for ONE remote engine host, speaking the engine HTTP surface.
+
+The inter-host protocol is deliberately the protocol that already
+exists: ``POST /v1/completions`` with ``stream: true`` (SSE deltas +
+one definitive final event), ``GET /healthz`` (the uniform
+counters/latency/status document), ``GET /metrics`` (Prometheus text).
+Cancellation is connection close — the backend server already treats a
+dropped SSE client as a cancel and frees the slot (infer/server.py), so
+the fleet needs no new cancel verb on the wire.
+
+Failure machinery, all deterministic-clock injectable for tests
+(tests/test_fleet_retry.py drives every transition without a sleep):
+
+  * per-call timeouts — connect/submit and stream-read are separate
+    budgets (a slow decode is not a dead host);
+  * :class:`RetryPolicy` — capped exponential backoff with jitter and
+    a token-bucket RETRY BUDGET shared across the fleet: each retry
+    spends a token, each success refills a fraction, and an empty
+    bucket fails fast (:class:`FleetUnavailable`, surfaced by the
+    router's server as a 503 with ``Retry-After``) instead of letting
+    a dying fleet drown in retry storms;
+  * :class:`CircuitBreaker` — trips OPEN on N consecutive failures
+    (stops routing instantly instead of timing out per request),
+    half-opens after a cooldown to admit one probe, and closes again
+    on probe success. Transitions invoke an ``on_transition`` hook the
+    router wires to ``backend_down``/``backend_up`` flight events and
+    the ``shifu_fleet_breaker_state`` gauge.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import random
+import socket
+import threading
+import time
+from typing import Callable, Iterator, Optional, Tuple
+
+
+class BackendError(RuntimeError):
+    """A backend call failed. ``retryable`` says whether another
+    backend (or another attempt) could still serve the request —
+    transport faults and engine deaths are retryable, validation
+    rejections (HTTP 4xx, non-retryable error events) are not."""
+
+    def __init__(self, msg: str, *, retryable: bool, status: Optional[int] = None):
+        super().__init__(msg)
+        self.retryable = retryable
+        self.status = status
+
+
+class FleetUnavailable(RuntimeError):
+    """No backend can take the request (all breakers open / roster
+    drained / retry budget exhausted). The serving front-end maps this
+    onto ``503`` with a ``Retry-After`` header (infer/server.py)."""
+
+    def __init__(self, msg: str, retry_after_s: float = 1.0):
+        super().__init__(msg)
+        self.retry_after = max(1, int(round(retry_after_s)))
+
+
+class RetryPolicy:
+    """Capped exponential backoff with jitter + a token-bucket budget.
+
+    ``delay(attempt)`` for attempt k (0-based) draws uniformly from
+    ``[(1 - jitter) * d, d]`` with ``d = min(cap_s, base_s * 2**k)`` —
+    capped growth, and jitter so a fleet of retriers does not
+    synchronise. ``spend()`` takes one token from the budget (False
+    when empty — the caller must fail fast); ``refund()`` credits
+    ``refill`` of a token, called per SUCCESSFUL request, so a healthy
+    fleet regains headroom but a permanently failing one cannot retry
+    forever. Thread-safe; ``rng`` is injectable for deterministic
+    tests.
+    """
+
+    def __init__(self, *, base_s: float = 0.05, cap_s: float = 2.0,
+                 jitter: float = 0.5, budget: float = 8.0,
+                 refill: float = 0.1, rng: Optional[Callable[[], float]] = None):
+        if not (0.0 <= jitter <= 1.0):
+            raise ValueError(f"jitter must be in [0, 1], got {jitter}")
+        if base_s <= 0 or cap_s < base_s:
+            raise ValueError(f"need 0 < base_s <= cap_s, got {base_s}/{cap_s}")
+        self.base_s = float(base_s)
+        self.cap_s = float(cap_s)
+        self.jitter = float(jitter)
+        self.budget_max = float(budget)
+        self.refill = float(refill)
+        self._budget = float(budget)
+        self._rng = rng if rng is not None else random.random
+        self._lock = threading.Lock()
+
+    @property
+    def budget(self) -> float:
+        return self._budget
+
+    def delay(self, attempt: int) -> float:
+        d = min(self.cap_s, self.base_s * (2.0 ** max(0, int(attempt))))
+        return d * (1.0 - self.jitter * self._rng())
+
+    def spend(self) -> bool:
+        with self._lock:
+            if self._budget < 1.0:
+                return False
+            self._budget -= 1.0
+            return True
+
+    def refund(self) -> None:
+        with self._lock:
+            self._budget = min(self.budget_max, self._budget + self.refill)
+
+
+class CircuitBreaker:
+    """closed -> (N consecutive failures) -> open -> (cooldown)
+    -> half_open -> (probe success) -> closed | (probe failure) -> open.
+
+    ``allow()`` is the routing gate: always True closed, False while
+    open and cooling, and True exactly ONCE per cooldown expiry (the
+    half-open probe) — concurrent callers see False until that probe
+    resolves. ``clock`` is injectable (monotonic seconds) so the
+    trip/half-open/close walk is testable without sleeping.
+    """
+
+    CLOSED, HALF_OPEN, OPEN = "closed", "half_open", "open"
+    # Gauge encoding for shifu_fleet_breaker_state.
+    STATE_CODES = {CLOSED: 0, HALF_OPEN: 1, OPEN: 2}
+
+    def __init__(self, *, fail_threshold: int = 3, reset_s: float = 5.0,
+                 clock: Optional[Callable[[], float]] = None,
+                 on_transition: Optional[Callable[[str, str], None]] = None):
+        if fail_threshold < 1:
+            raise ValueError(f"fail_threshold must be >= 1, got {fail_threshold}")
+        self.fail_threshold = int(fail_threshold)
+        self.reset_s = float(reset_s)
+        self._clock = clock if clock is not None else time.monotonic
+        self.on_transition = on_transition
+        self._lock = threading.Lock()
+        self._state = self.CLOSED
+        self._fails = 0
+        self._opened_at = 0.0
+        self._probing = False
+
+    @property
+    def state(self) -> str:
+        # Surface "open past cooldown" as open still — the state only
+        # advances through allow() (the probe admission point).
+        return self._state
+
+    def _move(self, new: str) -> None:
+        old, self._state = self._state, new
+        if old != new and self.on_transition is not None:
+            self.on_transition(old, new)
+
+    def allow(self) -> bool:
+        with self._lock:
+            if self._state == self.CLOSED:
+                return True
+            if self._state == self.OPEN:
+                if self._clock() - self._opened_at >= self.reset_s:
+                    self._move(self.HALF_OPEN)
+                    self._probing = True
+                    return True
+                return False
+            # half_open: one outstanding probe at a time.
+            if not self._probing:
+                self._probing = True
+                return True
+            return False
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._fails = 0
+            self._probing = False
+            self._move(self.CLOSED)
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._probing = False
+            if self._state == self.HALF_OPEN:
+                self._opened_at = self._clock()
+                self._move(self.OPEN)
+                return
+            self._fails += 1
+            if self._fails >= self.fail_threshold:
+                self._opened_at = self._clock()
+                self._fails = 0
+                self._move(self.OPEN)
+
+
+class BackendConfig:
+    """Per-backend call budgets + failure thresholds (one config object
+    shared by the roster; plain attributes, no dataclass magic so tests
+    can tweak freely)."""
+
+    def __init__(self, *, connect_timeout_s: float = 5.0,
+                 probe_timeout_s: float = 3.0,
+                 read_timeout_s: float = 300.0,
+                 fail_threshold: int = 3, reset_s: float = 5.0,
+                 ewma_alpha: float = 0.2):
+        self.connect_timeout_s = float(connect_timeout_s)
+        self.probe_timeout_s = float(probe_timeout_s)
+        self.read_timeout_s = float(read_timeout_s)
+        self.fail_threshold = int(fail_threshold)
+        self.reset_s = float(reset_s)
+        self.ewma_alpha = float(ewma_alpha)
+
+
+def _parse_addr(addr: str) -> Tuple[str, int]:
+    host, sep, port = addr.rpartition(":")
+    if not sep or not host or not port.isdigit():
+        raise ValueError(f"backend address {addr!r} is not host:port")
+    return host, int(port)
+
+
+class _SSEStream:
+    """One open streaming completion on a backend: iterate events,
+    ``close()`` from any thread to cancel (the backend server frees
+    the slot on disconnect). Yields parsed ``data:`` JSON objects and
+    stops at ``[DONE]``."""
+
+    def __init__(self, conn: http.client.HTTPConnection, resp, sock):
+        self._conn = conn
+        self._resp = resp
+        # The socket is captured BEFORE getresponse(): the server's
+        # ``Connection: close`` makes http.client detach ``conn.sock``
+        # there, while the response keeps its own fd reference.
+        self._sock = sock
+        self._closed = False
+
+    def close(self) -> None:
+        self._closed = True
+        # shutdown(), not just close(): the response object holds its
+        # own reference to the fd (sock.makefile), so close() alone
+        # would leave the TCP connection fully open — the backend
+        # would never see the disconnect-cancel, and a reader thread
+        # blocked in recv() would not wake. SHUT_RDWR sends the FIN
+        # (the backend's cancel signal) AND unblocks the reader.
+        try:
+            if self._sock is not None:
+                self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._resp.close()
+        except Exception:
+            pass
+        try:
+            self._conn.close()
+        except Exception:
+            pass
+
+    def __iter__(self) -> Iterator[dict]:
+        buf = b""
+        try:
+            while True:
+                chunk = self._resp.readline()
+                if not chunk:
+                    raise BackendError(
+                        "backend connection closed mid-stream",
+                        retryable=True,
+                    )
+                line = chunk.strip()
+                if not line:
+                    continue
+                if not line.startswith(b"data:"):
+                    continue
+                buf = line[len(b"data:"):].strip()
+                if buf == b"[DONE]":
+                    return
+                try:
+                    yield json.loads(buf)
+                except ValueError:
+                    raise BackendError(
+                        f"unparseable SSE event: {buf[:200]!r}",
+                        retryable=True,
+                    ) from None
+        except (OSError, http.client.HTTPException) as e:
+            if self._closed:
+                return  # deliberate cancel, not a backend fault
+            raise BackendError(
+                f"backend stream failed: {e!r}", retryable=True
+            ) from e
+        finally:
+            self.close()
+
+
+class BackendClient:
+    """One remote engine host: typed calls over its HTTP surface plus
+    the local failure state (breaker, EWMA latency, cached /healthz).
+
+    The router owns routing policy; this class owns the wire. All
+    mutable fields that routing reads (``in_flight``, ``health``,
+    ``ewma_ms``) are plain attributes updated under the GIL — the same
+    single-writer tolerance the metrics registry documents.
+    """
+
+    def __init__(self, addr: str, cfg: Optional[BackendConfig] = None, *,
+                 clock: Optional[Callable[[], float]] = None,
+                 on_transition: Optional[Callable[[str, str], None]] = None):
+        self.addr = addr
+        self.host, self.port = _parse_addr(addr)
+        self.cfg = cfg if cfg is not None else BackendConfig()
+        self.breaker = CircuitBreaker(
+            fail_threshold=self.cfg.fail_threshold,
+            reset_s=self.cfg.reset_s, clock=clock,
+            on_transition=on_transition,
+        )
+        # Router-visible state.
+        self.in_flight = 0          # requests this router is running here
+        self.routed = 0             # requests ever routed here
+        self.retries = 0            # failures here that caused a retry
+        self.draining = False       # no NEW work; in-flight finishes
+        self.detached = False       # drained to zero and released
+        self.health: Optional[dict] = None   # last /healthz document
+        self.health_ts: Optional[float] = None
+        self.ewma_ms: Optional[float] = None  # EWMA routed-request wall ms
+        self.max_len: Optional[int] = None    # from /v1/models at attach
+
+    # ------------------------------------------------------------- wire
+    def _request(self, method: str, path: str, body: Optional[dict],
+                 timeout: float):
+        conn = http.client.HTTPConnection(
+            self.host, self.port, timeout=timeout
+        )
+        payload = json.dumps(body).encode() if body is not None else None
+        headers = {"Content-Type": "application/json"} if payload else {}
+        try:
+            conn.request(method, path, payload, headers)
+            resp = conn.getresponse()
+        except (OSError, http.client.HTTPException) as e:
+            conn.close()
+            raise BackendError(
+                f"backend {self.addr} unreachable: {e!r}", retryable=True
+            ) from e
+        return conn, resp
+
+    def _call_json(self, method: str, path: str, body: Optional[dict],
+                   timeout: float) -> dict:
+        conn, resp = self._request(method, path, body, timeout)
+        try:
+            data = resp.read()
+            if resp.status >= 500:
+                raise BackendError(
+                    f"backend {self.addr} {path} -> {resp.status}: "
+                    f"{data[:200]!r}", retryable=True, status=resp.status,
+                )
+            if resp.status >= 400:
+                msg = data.decode("utf-8", "replace")
+                try:
+                    msg = json.loads(msg).get("error", msg)
+                except ValueError:
+                    pass
+                raise BackendError(msg, retryable=False, status=resp.status)
+            return json.loads(data)
+        except (OSError, ValueError, http.client.HTTPException) as e:
+            raise BackendError(
+                f"backend {self.addr} {path} failed: {e!r}", retryable=True
+            ) from e
+        finally:
+            conn.close()
+
+    def probe(self) -> dict:
+        """GET /healthz with the probe timeout; caches the document and
+        drives the breaker (success closes a half-open breaker — this
+        IS the half-open probe when the prober calls it). Raises
+        :class:`BackendError` on failure."""
+        try:
+            doc = self._call_json(
+                "GET", "/healthz", None, self.cfg.probe_timeout_s
+            )
+        except BackendError:
+            self.breaker.record_failure()
+            raise
+        self.health = doc
+        self.health_ts = time.time()
+        self.breaker.record_success()
+        return doc
+
+    def models(self) -> dict:
+        """GET /v1/models (bootstrap reads ``max_len`` from it — the
+        one config field the router must know for request bounds)."""
+        doc = self._call_json(
+            "GET", "/v1/models", None, self.cfg.probe_timeout_s
+        )
+        for m in doc.get("data", ()):
+            if m.get("max_len"):
+                self.max_len = int(m["max_len"])
+                break
+        return doc
+
+    def metrics_text(self) -> str:
+        """GET /metrics — raw Prometheus text pass-through (operators
+        can scrape a backend THROUGH the router's statz links; the
+        router's own /metrics stays its own registry)."""
+        conn, resp = self._request(
+            "GET", "/metrics", None, self.cfg.probe_timeout_s
+        )
+        try:
+            if resp.status != 200:
+                raise BackendError(
+                    f"backend {self.addr} /metrics -> {resp.status}",
+                    retryable=True, status=resp.status,
+                )
+            return resp.read().decode("utf-8", "replace")
+        except (OSError, http.client.HTTPException) as e:
+            raise BackendError(
+                f"backend {self.addr} /metrics failed: {e!r}",
+                retryable=True,
+            ) from e
+        finally:
+            conn.close()
+
+    def open_stream(self, body: dict) -> _SSEStream:
+        """POST /v1/completions with ``stream: true``; returns the SSE
+        event iterator. The HTTP status is resolved HERE (connect +
+        submit under ``connect_timeout_s``); event reads then run under
+        ``read_timeout_s`` per read (a slow decode is budgeted
+        separately from a dead host)."""
+        conn = http.client.HTTPConnection(
+            self.host, self.port, timeout=self.cfg.connect_timeout_s
+        )
+        try:
+            conn.request(
+                "POST", "/v1/completions", json.dumps(body).encode(),
+                {"Content-Type": "application/json"},
+            )
+            # Capture the socket NOW: the SSE response carries
+            # ``Connection: close``, so getresponse() detaches
+            # ``conn.sock`` (the response keeps its own fd reference).
+            sock = conn.sock
+            resp = conn.getresponse()
+        except (OSError, http.client.HTTPException) as e:
+            conn.close()
+            raise BackendError(
+                f"backend {self.addr} unreachable: {e!r}", retryable=True
+            ) from e
+        if resp.status != 200:
+            try:
+                data = resp.read()
+            except (OSError, http.client.HTTPException):
+                data = b""
+            finally:
+                conn.close()
+            msg = data.decode("utf-8", "replace")
+            try:
+                msg = json.loads(msg).get("error", msg)
+            except ValueError:
+                pass
+            raise BackendError(
+                msg or f"backend {self.addr} -> {resp.status}",
+                retryable=resp.status >= 500, status=resp.status,
+            )
+        # Widen the socket budget for the stream phase.
+        if sock is not None:
+            sock.settimeout(self.cfg.read_timeout_s)
+        return _SSEStream(conn, resp, sock)
+
+    # ------------------------------------------------------ router hooks
+    def routable(self) -> bool:
+        """May NEW work land here? (Breaker consultation is separate —
+        ``allow()`` consumes the half-open probe slot, so the router
+        only calls it for a backend it is about to use.)"""
+        return not self.draining and not self.detached
+
+    def note_latency(self, ms: float) -> None:
+        a = self.cfg.ewma_alpha
+        self.ewma_ms = (
+            ms if self.ewma_ms is None else (1 - a) * self.ewma_ms + a * ms
+        )
+
+    def queue_depth(self) -> int:
+        """Remote queue depth from the last probe (stale between
+        probes; the router's primary load signal is its own live
+        ``in_flight``)."""
+        if not self.health:
+            return 0
+        try:
+            return int(self.health.get("queued", 0))
+        except (TypeError, ValueError):
+            return 0
+
+    def status(self) -> str:
+        if self.detached:
+            return "detached"
+        if self.draining:
+            return "draining"
+        if self.breaker.state == CircuitBreaker.OPEN:
+            return "down"
+        return "up"
+
+
+def _jitter_check(policy: RetryPolicy, attempt: int) -> Tuple[float, float]:
+    """The [lo, hi] envelope ``delay(attempt)`` must land in — shared
+    with tests so the bound and the implementation cannot drift."""
+    hi = min(policy.cap_s, policy.base_s * (2.0 ** attempt))
+    return hi * (1.0 - policy.jitter), hi

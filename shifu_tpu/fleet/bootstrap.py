@@ -1,0 +1,167 @@
+"""Fleet bootstrap: the serving analogue of ``parallel/distributed.py``.
+
+Training brings hosts together through JAX's GRPC coordination service
+and then speaks XLA collectives over DCN; serving brings hosts together
+HERE and then speaks the engine HTTP protocol over the same network.
+The pieces mirror ``distributed.initialize``'s job:
+
+  * :func:`parse_fleet` — the host roster, from ``--fleet
+    host:port,...`` or the ``SHIFU_FLEET`` environment variable (flag
+    wins; the env var is the k8s-style deployment path where every
+    router pod gets the roster injected).
+  * :func:`wait_ready` — readiness gating: poll each backend's
+    ``/healthz`` until it answers (and fetch ``max_len`` from
+    ``/v1/models``), with a deadline. By default the fleet starts when
+    ANY backend is ready — the prober brings stragglers in later —
+    mirroring how a pod job tolerates a slow host at startup.
+  * :class:`FleetProber` — the periodic re-probe loop: backends that
+    are dead (breaker open) or never answered get re-probed every
+    ``interval_s``; a success closes the breaker (``backend_up``
+    flight event via the breaker's transition hook) and refreshes the
+    cached health document the router's load balancing reads.
+  * :func:`build_fleet` — roster -> gated -> probed
+    :class:`~shifu_tpu.fleet.router.FleetRouter` with the prober
+    running, the one-call path ``serve --fleet`` uses.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import List, Optional, Sequence, Tuple
+
+from shifu_tpu.fleet.backend import BackendClient, BackendConfig, BackendError
+from shifu_tpu.fleet.router import FleetRouter
+
+FLEET_ENV = "SHIFU_FLEET"
+
+
+def parse_fleet(spec: Optional[str] = None, *, env=None) -> List[str]:
+    """``"host:port,host:port"`` -> validated address list. ``spec``
+    (the ``--fleet`` flag) wins; otherwise the ``SHIFU_FLEET`` env var.
+    Raises ValueError on an empty/absent roster or malformed entries —
+    a fleet router with no roster is a misconfiguration, not a
+    default."""
+    if spec is None:
+        spec = (env if env is not None else os.environ).get(FLEET_ENV)
+    if not spec or not str(spec).strip():
+        raise ValueError(
+            "no fleet roster: pass --fleet host:port,... or set "
+            f"{FLEET_ENV}"
+        )
+    addrs = [a.strip() for a in str(spec).split(",") if a.strip()]
+    if not addrs:
+        raise ValueError(f"fleet roster {spec!r} parsed to no backends")
+    seen = set()
+    for a in addrs:
+        host, sep, port = a.rpartition(":")
+        if not sep or not host or not port.isdigit():
+            raise ValueError(f"fleet entry {a!r} is not host:port")
+        if a in seen:
+            raise ValueError(f"duplicate fleet entry {a!r}")
+        seen.add(a)
+    return addrs
+
+
+def wait_ready(
+    backends: Sequence[BackendClient], *, timeout_s: float = 60.0,
+    poll_s: float = 0.5, require_all: bool = False,
+    sleep=time.sleep, clock=time.monotonic,
+) -> Tuple[List[BackendClient], List[BackendClient]]:
+    """Gate on each backend's ``/healthz`` answering; fetch its
+    ``/v1/models`` (for ``max_len``) on first success. Returns
+    ``(ready, not_ready)``; raises RuntimeError when the deadline
+    passes with nothing ready (or, under ``require_all``, with anyone
+    missing). Clock/sleep injectable for tests."""
+    ready: List[BackendClient] = []
+    pending = list(backends)
+    deadline = clock() + timeout_s
+    while pending:
+        still = []
+        for b in pending:
+            try:
+                b.probe()
+                try:
+                    b.models()
+                except BackendError:
+                    pass  # healthz answered; max_len stays unknown
+                ready.append(b)
+            except BackendError:
+                still.append(b)
+        pending = still
+        if not pending:
+            break
+        if clock() >= deadline:
+            missing = [b.addr for b in pending]
+            if require_all or not ready:
+                raise RuntimeError(
+                    f"fleet readiness gate failed after {timeout_s:g}s: "
+                    f"not ready: {missing}"
+                    + ("" if ready else " (no backend ready at all)")
+                )
+            break
+        sleep(poll_s)
+    return ready, pending
+
+
+class FleetProber(threading.Thread):
+    """Periodic re-probe of dead/unknown backends (daemon thread).
+
+    Healthy backends are probed too — at the same cadence — so the
+    cached queue-depth/health the router balances on stays fresh; but
+    the loop's REASON to exist is the dead ones: a breaker-open
+    backend's probe is exactly the breaker's half-open trial, so a
+    recovered host rejoins the rotation within ``interval_s`` without
+    operator action (``backend_up`` flight event)."""
+
+    def __init__(self, router: FleetRouter, *, interval_s: float = 2.0):
+        super().__init__(name="shifu-fleet-prober", daemon=True)
+        self.router = router
+        self.interval_s = float(interval_s)
+        self._stop_ev = threading.Event()
+
+    def stop(self, join_timeout_s: float = 5.0) -> None:
+        self._stop_ev.set()
+        if self.is_alive():
+            self.join(join_timeout_s)
+
+    def run(self) -> None:
+        while not self._stop_ev.wait(self.interval_s):
+            for b in self.router.backends:
+                if self._stop_ev.is_set():
+                    return
+                if b.detached:
+                    continue
+                try:
+                    self.router.probe_backend(b)
+                    if b.max_len is None:
+                        b.models()
+                except BackendError:
+                    continue
+
+
+def build_fleet(
+    spec: Optional[str] = None, *,
+    cfg: Optional[BackendConfig] = None,
+    metrics=None, flight=None,
+    ready_timeout_s: float = 60.0, require_all: bool = False,
+    probe_interval_s: float = 2.0, start_prober: bool = True,
+    **router_kw,
+) -> FleetRouter:
+    """Roster -> readiness-gated :class:`FleetRouter` with the re-probe
+    loop running (``router.prober``; ``prober.stop()`` on shutdown).
+    The one-call construction path ``serve --fleet`` uses."""
+    addrs = parse_fleet(spec)
+    backends = [BackendClient(a, cfg) for a in addrs]
+    wait_ready(
+        backends, timeout_s=ready_timeout_s, require_all=require_all
+    )
+    router = FleetRouter(
+        backends, metrics=metrics, flight=flight, **router_kw
+    )
+    prober = FleetProber(router, interval_s=probe_interval_s)
+    router.prober = prober
+    if start_prober:
+        prober.start()
+    return router

@@ -1,0 +1,745 @@
+"""FleetRouter: N remote engine hosts behind one ENGINE_INTERFACE.
+
+The router IS an "engine" to the serving front-end — it provides every
+``ENGINE_INTERFACE`` name (infer/engine.py), so ``infer/server.py``
+fronts a fleet unchanged: the same ``EngineRunner`` thread drives it,
+the same /healthz//statz//metrics//debugz endpoints serve it, and the
+same SLO watchdog budgets apply (fed by the router's POOLED latency
+window). Where ``ReplicatedEngine`` routes over in-process engines
+sharing one device pool, ``FleetRouter`` routes over HTTP backends —
+the submit/stream/cancel surface is identical by construction.
+
+Mechanics:
+
+  * ``submit()`` (engine thread) picks the least-loaded routable
+    backend — live router-local ``in_flight`` first, then the remote
+    queue depth from the last probe, then lowest index — and hands the
+    request to a per-request worker thread. No HTTP happens on the
+    engine thread.
+  * The worker POSTs ``stream: true`` to the backend and feeds the
+    request's ``generated``/``logprobs`` lists as SSE deltas arrive
+    (the server's ``live_requests()`` diffing streams them onward).
+    On failure BEFORE the first delta the request is still invisible
+    to the client, so the worker resubmits it to another backend
+    (breaker bookkeeping + retry budget + capped jittered backoff);
+    after first delta a failure is surfaced — the client already holds
+    tokens the fleet cannot un-send.
+  * ``cancel()`` closes the worker's backend connection; the backend
+    server frees the remote slot on disconnect (its documented
+    disconnect-cancel path), so a client disconnect at the ROUTER
+    propagates all the way to the remote engine.
+  * ``drain(addr)`` (the ``POST /drainz`` admin verb) stops routing
+    new work to a backend, lets in-flight streams finish, then
+    detaches it (``backend_draining``/``backend_detached`` flight
+    events; re-attach by restarting the router with it in the roster).
+
+Observability: ``shifu_fleet_*`` registry families (per-backend
+requests/retries/failures counters, breaker-state/up/in-flight gauges,
+request + probe latency histograms), ``backend_down``/``backend_up``
+flight events, a per-backend block on ``/statz`` (via
+``fleet_stats()``), and ``health_reasons()`` naming dead backends so
+the router's ``/healthz`` reports ``degraded`` while part of the fleet
+is down.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Dict, List, Optional
+
+from shifu_tpu.fleet.backend import (
+    BackendClient,
+    BackendConfig,
+    BackendError,
+    CircuitBreaker,
+    FleetUnavailable,
+    RetryPolicy,
+)
+from shifu_tpu.infer.engine import Completion, LiveRequest
+from shifu_tpu.infer.sampling import SampleConfig
+
+_SAMPLING_FIELDS = (
+    "temperature", "top_k", "top_p", "min_p",
+    "presence_penalty", "frequency_penalty", "repetition_penalty",
+)
+
+
+class _FleetRequest:
+    """One routed request's life: wire body, live token lists (the
+    streaming surface aliases these), cancel flag, and the stream the
+    worker currently holds (closed to cancel remotely)."""
+
+    def __init__(self, rid: int, body: dict):
+        self.rid = rid
+        self.body = body
+        self.generated: List[int] = []
+        self.logprobs: List[float] = []
+        self.streamed = False          # first delta arrived
+        self.cancelled = False
+        self.stream = None             # the live _SSEStream, if any
+        self.backend: Optional[BackendClient] = None
+        self.submitted = time.monotonic()
+        self.first_tok_at: Optional[float] = None
+
+
+class FleetRouter:
+    """Route requests over remote engine-server ``backends``.
+
+    ``backends`` — :class:`BackendClient` list (build via
+    ``fleet.bootstrap.build_fleet`` for roster parsing + readiness
+    gating + the re-probe loop). ``metrics``/``flight`` default to the
+    process-global sinks like every engine. ``policy`` is the shared
+    retry budget/backoff; ``sleep`` is injectable so retry tests run
+    without wall-clock waits.
+
+    Sampling note: per-request sampling fields resolve against
+    :attr:`sample_cfg` (a default :class:`SampleConfig`) at the
+    router's front-end before they reach the wire — a request that
+    sets ANY sampling field therefore sends the full resolved set to
+    the backend. Requests with no sampling fields inherit the
+    BACKEND's configured sampling, exactly like a direct client.
+    """
+
+    def __init__(self, backends: List[BackendClient], *,
+                 policy: Optional[RetryPolicy] = None,
+                 metrics=None, flight=None,
+                 step_wait_s: float = 0.02,
+                 drain_poll_s: float = 0.05,
+                 sleep=time.sleep):
+        if not backends:
+            raise ValueError("need at least one fleet backend")
+        from shifu_tpu import obs as _obs
+
+        self.backends = list(backends)
+        addrs = [b.addr for b in self.backends]
+        if len(set(addrs)) != len(addrs):
+            raise ValueError(f"duplicate backend addresses: {addrs}")
+        self.policy = policy if policy is not None else RetryPolicy()
+        self.metrics = metrics if metrics is not None else _obs.REGISTRY
+        self.flight = flight if flight is not None else _obs.FLIGHT
+        self._sleep = sleep
+        self._step_wait_s = float(step_wait_s)
+        self._drain_poll_s = float(drain_poll_s)
+        self._lock = threading.Lock()
+        self._rid = 0
+        self._reqs: Dict[int, _FleetRequest] = {}
+        self._done: collections.deque = collections.deque()
+        self._failures: Dict[int, Exception] = {}
+        self._progress = threading.Event()
+        self._trace_window: collections.deque = collections.deque(maxlen=256)
+        self._trace_lock = threading.Lock()
+        self.resubmissions = 0
+        self.requests_completed = 0
+        self.tokens_generated = 0
+        self.cancellations = 0
+
+        # ENGINE_INTERFACE identity/config surface. The router has no
+        # local model — beam/embeddings need device access and 400
+        # cleanly through the empty ``buckets`` tuple.
+        self.model = None
+        self.params = None
+        self.tokenizer = None
+        self.buckets = ()
+        self.max_len = min(
+            (b.max_len for b in self.backends if b.max_len), default=2048
+        )
+        self.eos_id = None
+        self.sample_cfg = SampleConfig()
+        self.per_request_sampling = True
+        self.enable_penalties = True
+        self.enable_logit_bias = True
+        self.lora = None
+
+        # shifu_fleet_* families (docs/observability.md).
+        reg = self.metrics
+        self._c_requests = reg.counter(
+            "shifu_fleet_requests_total",
+            "Requests routed to each backend (attempts, incl. retries "
+            "that reached the wire)", labelnames=("backend",),
+        )
+        self._c_retries = reg.counter(
+            "shifu_fleet_retries_total",
+            "Failures at a backend that caused the request to retry",
+            labelnames=("backend",),
+        )
+        self._c_failures = reg.counter(
+            "shifu_fleet_failures_total",
+            "Requests that FAILED at a backend (retried or not)",
+            labelnames=("backend",),
+        )
+        self._g_breaker = reg.gauge(
+            "shifu_fleet_breaker_state",
+            "Circuit breaker per backend: 0 closed, 1 half-open, 2 open",
+            labelnames=("backend",),
+        )
+        self._g_up = reg.gauge(
+            "shifu_fleet_backend_up",
+            "1 while the backend is routable (not down/draining/"
+            "detached)", labelnames=("backend",),
+        )
+        self._g_inflight = reg.gauge(
+            "shifu_fleet_in_flight",
+            "Requests this router currently has running on the backend",
+            labelnames=("backend",),
+        )
+        self._g_budget = reg.gauge(
+            "shifu_fleet_retry_budget",
+            "Remaining shared retry-budget tokens",
+        ).labels()
+        self._h_request = reg.histogram(
+            "shifu_fleet_request_seconds",
+            "Routed request wall time at the router (submit to final "
+            "event)", labelnames=("backend",),
+        )
+        self._h_probe = reg.histogram(
+            "shifu_fleet_probe_seconds",
+            "Backend /healthz scrape latency", labelnames=("backend",),
+        )
+        self._g_budget.set(self.policy.budget)
+        for b in self.backends:
+            self._wire_backend(b)
+
+    # ------------------------------------------------------- obs wiring
+    def _wire_backend(self, b: BackendClient) -> None:
+        lab = {"backend": b.addr}
+        gauges = (
+            self._g_breaker.labels(**lab), self._g_up.labels(**lab),
+            self._g_inflight.labels(**lab),
+        )
+        gauges[0].set(CircuitBreaker.STATE_CODES[b.breaker.state])
+        gauges[1].set(1.0 if b.routable() else 0.0)
+        gauges[2].set(0.0)
+
+        def on_transition(old: str, new: str, _b=b, _g=gauges):
+            _g[0].set(CircuitBreaker.STATE_CODES[new])
+            if new == CircuitBreaker.OPEN:
+                _g[1].set(0.0)
+                self.flight.record(
+                    "backend_down", backend=_b.addr, was=old
+                )
+            elif new == CircuitBreaker.CLOSED and old != new:
+                _g[1].set(1.0 if _b.routable() else 0.0)
+                self.flight.record(
+                    "backend_up", backend=_b.addr, was=old
+                )
+
+        b.breaker.on_transition = on_transition
+
+    def probe_backend(self, b: BackendClient) -> dict:
+        """One timed /healthz probe (the bootstrap prober's unit of
+        work) — records the scrape-latency histogram alongside the
+        breaker bookkeeping ``b.probe()`` already does."""
+        t0 = time.monotonic()
+        try:
+            return b.probe()
+        finally:
+            self._h_probe.labels(backend=b.addr).observe(
+                time.monotonic() - t0
+            )
+
+    # ---------------------------------------------------------- routing
+    def _pick(self, exclude=()) -> Optional[BackendClient]:
+        """Least-loaded routable backend: fewest router-local in-flight
+        requests, then shallowest remote queue (last probe), then
+        lowest index (deterministic). Consults ``breaker.allow()`` LAST
+        and only on the winner-candidates, since allow() consumes the
+        half-open probe slot."""
+        order = sorted(
+            (b for b in self.backends
+             if b.routable() and b.addr not in exclude),
+            key=lambda b: (b.in_flight, b.queue_depth(),
+                           self.backends.index(b)),
+        )
+        for b in order:
+            if b.breaker.allow():
+                return b
+        return None
+
+    def submit(self, prompt_tokens, max_new_tokens: int, *,
+               sampling: Optional[SampleConfig] = None,
+               stop_token_ids=None, stop_strings=None,
+               logit_bias=None, allowed_token_ids=None, adapter=None,
+               regex=None, json_schema=None, **kw) -> int:
+        """Route one request (engine-thread call — no HTTP here).
+        Raises :class:`FleetUnavailable` when no backend is routable,
+        so a fully-down fleet fails fast instead of queueing forever."""
+        if kw:
+            raise ValueError(f"unsupported submit fields: {sorted(kw)}")
+        toks = [int(t) for t in prompt_tokens]
+        if not toks:
+            raise ValueError("empty prompt")
+        body: dict = {
+            "tokens": toks,
+            "max_new_tokens": int(max_new_tokens),
+            "stream": True,
+            "logprobs": True,
+        }
+        if sampling is not None:
+            for f in _SAMPLING_FIELDS:
+                v = getattr(sampling, f)
+                if v is not None:
+                    body[f] = v
+        if stop_token_ids:
+            body["stop_token_ids"] = list(stop_token_ids)
+        if stop_strings:
+            body["stop"] = list(stop_strings)
+        if logit_bias:
+            body["logit_bias"] = {str(k): v for k, v in logit_bias.items()}
+        if allowed_token_ids:
+            body["allowed_token_ids"] = list(allowed_token_ids)
+        if adapter is not None:
+            body["adapter"] = int(adapter)
+        if regex is not None:
+            body["regex"] = regex
+        if json_schema is not None:
+            body["json_schema"] = json_schema
+
+        if self._pick() is None:
+            raise FleetUnavailable(
+                "no routable fleet backend (all down/draining)",
+                retry_after_s=max(1.0, self.policy.cap_s),
+            )
+        with self._lock:
+            rid = self._rid
+            self._rid += 1
+            req = _FleetRequest(rid, body)
+            self._reqs[rid] = req
+        threading.Thread(
+            target=self._route_one, args=(req,),
+            name=f"shifu-fleet-req-{rid}", daemon=True,
+        ).start()
+        return rid
+
+    # ----------------------------------------------------- the worker
+    def _attach(self, req: _FleetRequest, b: BackendClient) -> None:
+        with self._lock:
+            req.backend = b
+            b.in_flight += 1
+            b.routed += 1
+        self._g_inflight.labels(backend=b.addr).set(b.in_flight)
+        self._c_requests.labels(backend=b.addr).inc()
+
+    def _detach(self, req: _FleetRequest, b: BackendClient) -> None:
+        with self._lock:
+            req.backend = None
+            b.in_flight = max(0, b.in_flight - 1)
+        self._g_inflight.labels(backend=b.addr).set(b.in_flight)
+
+    def _route_one(self, req: _FleetRequest) -> None:
+        try:
+            self._route_one_inner(req)
+        except Exception as e:  # worker bug must not strand the waiter
+            self._finish(req, None, RuntimeError(
+                f"fleet worker failed: {e!r}"
+            ))
+
+    def _route_one_inner(self, req: _FleetRequest) -> None:
+        attempt = 0
+        while True:
+            if req.cancelled:
+                self._finish(req, None, None)
+                return
+            b = self._pick()
+            if b is None:
+                self._finish(req, None, FleetUnavailable(
+                    "no routable fleet backend (all down/draining)",
+                    retry_after_s=max(1.0, self.policy.cap_s),
+                ))
+                return
+            self._attach(req, b)
+            try:
+                err = self._run_stream(req, b)
+            finally:
+                self._detach(req, b)
+            if err is None:
+                return  # completed (or cancelled mid-stream)
+            self._c_failures.labels(backend=b.addr).inc()
+            if not err.retryable or req.streamed:
+                # Validation rejection, or tokens already left the
+                # router — the failure is the client's to see.
+                self._finish(req, None, ValueError(str(err))
+                             if not err.retryable else err)
+                return
+            if not self.policy.spend():
+                self._g_budget.set(self.policy.budget)
+                self._finish(req, None, FleetUnavailable(
+                    f"retry budget exhausted after backend failure: {err}",
+                    retry_after_s=max(1.0, self.policy.cap_s),
+                ))
+                return
+            self._g_budget.set(self.policy.budget)
+            b.retries += 1
+            self._c_retries.labels(backend=b.addr).inc()
+            with self._lock:
+                self.resubmissions += 1
+            self._sleep(self.policy.delay(attempt))
+            attempt += 1
+
+    def _run_stream(self, req: _FleetRequest,
+                    b: BackendClient) -> Optional[BackendError]:
+        """One attempt on one backend. Returns None on success (or
+        deliberate cancel), else the failure. Breaker bookkeeping
+        happens here — success closes, failure counts toward a trip."""
+        try:
+            stream = b.open_stream(req.body)
+        except BackendError as e:
+            if e.retryable:
+                b.breaker.record_failure()
+            return e
+        if req.cancelled:
+            stream.close()
+            b.breaker.record_success()
+            self._finish(req, None, None)
+            return None
+        req.stream = stream
+        final: Optional[dict] = None
+        try:
+            for ev in stream:
+                if "error" in ev:
+                    # The backend's post-200 failure surface. The
+                    # ``retryable`` field is authoritative (the backend
+                    # marks engine deaths retryable, validation errors
+                    # not); absent (older backend) fall back to the
+                    # engine-death message shape.
+                    msg = str(ev["error"])
+                    retryable = bool(ev.get(
+                        "retryable",
+                        "engine thread died" in msg
+                        or "shut down" in msg,
+                    ))
+                    return BackendError(msg, retryable=retryable)
+                if "finished_by" in ev:
+                    final = ev
+                    continue
+                ids = ev.get("tokens")
+                if ids:
+                    if not req.streamed:
+                        req.first_tok_at = time.monotonic()
+                    req.streamed = True
+                    req.generated.extend(int(t) for t in ids)
+                    lps = ev.get("logprobs")
+                    if lps:
+                        req.logprobs.extend(float(x) for x in lps)
+                    self._progress.set()
+        except BackendError as e:
+            if req.cancelled:
+                b.breaker.record_success()
+                self._finish(req, None, None)
+                return None
+            b.breaker.record_failure()
+            return e
+        finally:
+            req.stream = None
+        if req.cancelled:
+            b.breaker.record_success()
+            self._finish(req, None, None)
+            return None
+        if final is None:
+            b.breaker.record_failure()
+            return BackendError(
+                f"backend {b.addr} stream ended without a final event",
+                retryable=True,
+            )
+        b.breaker.record_success()
+        self.policy.refund()
+        self._g_budget.set(self.policy.budget)
+        n = int(final.get("n_tokens", len(req.generated)))
+        toks = list(req.generated[:n])
+        lps = list(req.logprobs[:n]) if req.logprobs else None
+        now = time.monotonic()
+        total_ms = (now - req.submitted) * 1000.0
+        ttft_ms = (
+            (req.first_tok_at - req.submitted) * 1000.0
+            if req.first_tok_at is not None else total_ms
+        )
+        decode_s = max(now - (req.first_tok_at or now), 1e-9)
+        timing = {
+            "backend": b.addr,
+            "ttft_ms": round(ttft_ms, 3),
+            "total_ms": round(total_ms, 3),
+            "decode_tokens_per_s": round(max(n - 1, 0) / decode_s, 3)
+            if n > 1 else None,
+            "preemptions": 0,
+        }
+        b.note_latency(total_ms)
+        self._h_request.labels(backend=b.addr).observe(total_ms / 1000.0)
+        trace = {
+            "ttft_ms": timing["ttft_ms"], "total_ms": timing["total_ms"],
+            "preemptions": 0,
+        }
+        if timing["decode_tokens_per_s"]:
+            trace["decode_tokens_per_s"] = timing["decode_tokens_per_s"]
+        with self._trace_lock:
+            self._trace_window.append(trace)
+        self._finish(req, Completion(
+            rid=req.rid, tokens=toks,
+            finished_by=str(final.get("finished_by", "length")),
+            logprobs=lps, timing=timing,
+        ), None)
+        return None
+
+    def _finish(self, req: _FleetRequest, completion, error) -> None:
+        with self._lock:
+            if self._reqs.pop(req.rid, None) is None:
+                return  # cancelled and reaped already
+            if completion is not None:
+                self.requests_completed += 1
+                self.tokens_generated += len(completion.tokens)
+                self._done.append(completion)
+            elif error is not None:
+                self._done.append(("error", req.rid, error))
+        self._progress.set()
+
+    # ------------------------------------------------------ driving
+    def cancel(self, rid: int) -> bool:
+        """Cancel wherever the request is: not-yet-attached workers see
+        the flag before opening a stream; attached ones have their
+        backend connection CLOSED, which frees the remote slot (the
+        backend server's documented disconnect-cancel path)."""
+        with self._lock:
+            req = self._reqs.pop(rid, None)
+            if req is None:
+                return False
+            req.cancelled = True
+            self.cancellations += 1
+            stream = req.stream
+        if stream is not None:
+            stream.close()
+        return True
+
+    def step(self) -> List[Completion]:
+        """Wait briefly for worker progress, then return completions.
+        Per-request FAILURES do not raise here (that would trip the
+        runner's fatal path and kill the whole router for one lost
+        backend); they queue for :meth:`failures`, the per-request
+        failure surface the runner drains after each step to fail
+        exactly the affected waiter (503/400 for that caller only)."""
+        if not self._done:
+            self._progress.wait(self._step_wait_s)
+            self._progress.clear()
+        done: List[Completion] = []
+        with self._lock:
+            while self._done:
+                item = self._done.popleft()
+                if isinstance(item, Completion):
+                    done.append(item)
+                else:
+                    self._failures[item[1]] = item[2]
+        return done
+
+    def failures(self) -> Dict[int, Exception]:
+        """Per-request failures since the last call (rid -> exception).
+        Part of ``ENGINE_INTERFACE``: in-process engines return ``{}``
+        (they complete or die whole), the fleet fails requests
+        INDIVIDUALLY when a backend dies with their tokens streamed or
+        the retry budget runs out."""
+        with self._lock:
+            out, self._failures = self._failures, {}
+        return out
+
+    def step_dispatch(self):
+        return None
+
+    def step_fold(self, _handle) -> List[Completion]:
+        return self.step()
+
+    def run(self) -> List[Completion]:
+        out: List[Completion] = []
+        while not self.idle:
+            out.extend(self.step())
+        return out
+
+    @property
+    def idle(self) -> bool:
+        return not self._reqs and not self._done and not self._failures
+
+    # -------------------------------------------- streaming surface
+    def live_requests(self) -> List[LiveRequest]:
+        with self._lock:
+            return [
+                LiveRequest(
+                    rid=r.rid, generated=r.generated, logprobs=r.logprobs
+                )
+                for r in self._reqs.values()
+            ]
+
+    def live_generated(self) -> Dict[int, List[int]]:
+        with self._lock:
+            return {r.rid: r.generated for r in self._reqs.values()}
+
+    @property
+    def active_slots(self) -> int:
+        with self._lock:
+            return len(self._reqs)
+
+    @property
+    def max_slots(self) -> int:
+        tot = 0
+        for b in self.backends:
+            h = b.health or {}
+            try:
+                tot += int(h.get("max_slots", 0))
+            except (TypeError, ValueError):
+                pass
+        return tot
+
+    # ------------------------------------------------------- adapters
+    def add_adapter(self, lora_params) -> int:
+        raise ValueError(
+            "register LoRA adapters on the backend hosts; the fleet "
+            "router holds no params"
+        )
+
+    @property
+    def n_adapters(self) -> int:
+        vals = []
+        for b in self.backends:
+            h = b.health or {}
+            if isinstance(h.get("n_adapters"), int):
+                vals.append(h["n_adapters"])
+        return min(vals) if vals else 0
+
+    # ---------------------------------------------------- aggregation
+    def counters(self) -> dict:
+        """Pooled counters: the router's own lifecycle counts plus the
+        sum of each backend's last-probed numeric counters, and the
+        per-backend breakdown (the fleet's load-balance surface)."""
+        out = {
+            "active_slots": self.active_slots,
+            "max_slots": self.max_slots,
+            "queued": sum(
+                1 for r in list(self._reqs.values()) if not r.streamed
+            ) + sum(b.queue_depth() for b in self.backends),
+            "cancellations": self.cancellations,
+            "requests_completed": self.requests_completed,
+            "tokens_generated": self.tokens_generated,
+            "resubmissions": self.resubmissions,
+            "retry_budget": round(self.policy.budget, 2),
+        }
+        per = []
+        for b in self.backends:
+            ent = {
+                "backend": b.addr, "status": b.status(),
+                "breaker": b.breaker.state, "routed": b.routed,
+                "retries": b.retries, "in_flight": b.in_flight,
+                "queued_remote": b.queue_depth(),
+            }
+            if b.ewma_ms is not None:
+                ent["ewma_ms"] = round(b.ewma_ms, 3)
+            per.append(ent)
+        out["backends"] = per
+        return out
+
+    def latency_stats(self) -> dict:
+        """Router-measured pooled latency window (same keys as
+        ``Engine.latency_stats`` so the SLO watchdog's TTFT/ITL budgets
+        read it unchanged). TTFT here includes the hop to the backend —
+        the fleet's honest client-visible number."""
+        with self._trace_lock:
+            win = list(self._trace_window)
+        if not win:
+            return {"completions": 0}
+
+        def pct(key, q):
+            vals = sorted(t[key] for t in win if key in t)
+            if not vals:
+                return None
+            return vals[min(int(q * len(vals)), len(vals) - 1)]
+
+        out = {
+            "completions": len(win),
+            "ttft_ms_p50": pct("ttft_ms", 0.50),
+            "ttft_ms_p95": pct("ttft_ms", 0.95),
+            "ttft_ms_p99": pct("ttft_ms", 0.99),
+            "decode_tokens_per_s_p50": pct("decode_tokens_per_s", 0.50),
+            "decode_tokens_per_s_p05": pct("decode_tokens_per_s", 0.05),
+            "preempted_fraction": 0.0,
+        }
+        slow = pct("decode_tokens_per_s", 0.01)
+        if slow:
+            out["req_itl_ms_p99"] = round(1000.0 / slow, 3)
+        return out
+
+    # ----------------------------------------------------- fleet admin
+    def health_reasons(self) -> List[str]:
+        """Non-SLO health findings for /healthz: every tripped backend
+        is NAMED (a degraded fleet must say which host is gone)."""
+        out = []
+        for b in self.backends:
+            if b.detached:
+                continue
+            if b.breaker.state == CircuitBreaker.OPEN:
+                out.append(f"backend {b.addr} down (circuit breaker open)")
+        if not any(
+            b.routable() and b.breaker.state != CircuitBreaker.OPEN
+            for b in self.backends
+        ):
+            out.append("no routable backend remains")
+        return out
+
+    def fleet_stats(self) -> dict:
+        """The /statz fleet block: one row per backend (healthz status,
+        remote queue depth, breaker state, EWMA latency) + the shared
+        retry budget."""
+        rows = []
+        for b in self.backends:
+            h = b.health or {}
+            rows.append({
+                "backend": b.addr,
+                "status": b.status(),
+                "breaker": b.breaker.state,
+                "healthz": h.get("status"),
+                "queue_depth": b.queue_depth(),
+                "in_flight": b.in_flight,
+                "routed": b.routed,
+                "retries": b.retries,
+                "ewma_ms": round(b.ewma_ms, 3)
+                if b.ewma_ms is not None else None,
+                "last_probe_ts": b.health_ts,
+                "max_len": b.max_len,
+            })
+        return {
+            "backends": rows,
+            "retry_budget": round(self.policy.budget, 2),
+            "resubmissions": self.resubmissions,
+        }
+
+    def drain(self, target: str) -> dict:
+        """``POST /drainz``: stop routing NEW work to ``target``
+        (``host:port``), let its in-flight streams finish, then detach
+        it. Returns immediately with the in-flight count; a daemon
+        thread performs the wait-and-detach (poll, no backend calls)."""
+        b = next(
+            (x for x in self.backends if x.addr == str(target)), None
+        )
+        if b is None:
+            raise ValueError(
+                f"unknown backend {target!r} (roster: "
+                f"{[x.addr for x in self.backends]})"
+            )
+        if b.detached:
+            raise ValueError(f"backend {target!r} is already detached")
+        already = b.draining
+        b.draining = True
+        self._g_up.labels(backend=b.addr).set(0.0)
+        if not already:
+            self.flight.record(
+                "backend_draining", backend=b.addr, in_flight=b.in_flight
+            )
+            threading.Thread(
+                target=self._drain_watch, args=(b,),
+                name=f"shifu-fleet-drain-{b.addr}", daemon=True,
+            ).start()
+        return {
+            "draining": b.addr,
+            "in_flight": b.in_flight,
+            "already_draining": already,
+        }
+
+    def _drain_watch(self, b: BackendClient) -> None:
+        while b.in_flight > 0:
+            self._sleep(self._drain_poll_s)
+        b.detached = True
+        self.flight.record("backend_detached", backend=b.addr)

@@ -169,6 +169,11 @@ ENGINE_INTERFACE = frozenset({
     # streaming / observability
     "live_requests", "live_generated", "active_slots", "counters",
     "latency_stats", "metrics", "flight",
+    # fleet surface (shifu_tpu/fleet): per-request failure delivery,
+    # non-SLO health findings, the /statz fleet block, and the /drainz
+    # admin verb. In-process engines answer trivially ({} / [] / None /
+    # refuse) — the FleetRouter implements them for real.
+    "failures", "health_reasons", "fleet_stats", "drain",
 })
 
 
@@ -944,6 +949,33 @@ class Engine:
             "requests_completed": self.requests_completed,
             "tokens_generated": self.tokens_generated,
         }
+
+    # ----------------------------------------------- fleet surface
+    # (ENGINE_INTERFACE members a multi-host router implements for
+    # real — shifu_tpu/fleet/router.py; in-process engines answer
+    # trivially so the serving front-end probes nothing.)
+    def failures(self) -> dict:
+        """Per-request failures since the last call (rid -> exception).
+        In-process engines have none: a request either completes or
+        the whole engine dies (the runner's fatal path)."""
+        return {}
+
+    def health_reasons(self) -> list:
+        """Non-SLO health findings for /healthz (the fleet router
+        names dead backends here); none for an in-process engine."""
+        return []
+
+    def fleet_stats(self):
+        """The /statz fleet block, or None when there is no fleet."""
+        return None
+
+    def drain(self, target):
+        """``POST /drainz`` lands here; only a fleet router has
+        drainable backends."""
+        raise ValueError(
+            "no drainable backends: this server fronts an in-process "
+            "engine, not a fleet"
+        )
 
     def step(self) -> List[Completion]:
         """Admit queued requests into free slots, advance any chunked

@@ -192,6 +192,30 @@ class ReplicatedEngine:
         return dataclasses.replace(c, rid=rid)
 
     # ------------------------------------------------------- aggregation
+    def failures(self) -> dict:
+        """Fleet-surface protocol (ENGINE_INTERFACE): in-process
+        replicas never fail per-request — they complete or the engine
+        thread dies whole."""
+        out: dict = {}
+        for e in self.engines:
+            out.update(e.failures())
+        return out
+
+    def health_reasons(self) -> list:
+        out: list = []
+        for e in self.engines:
+            out.extend(e.health_reasons())
+        return out
+
+    def fleet_stats(self):
+        return None
+
+    def drain(self, target):
+        raise ValueError(
+            "no drainable backends: this server fronts in-process "
+            "dp replicas, not a fleet"
+        )
+
     @property
     def idle(self) -> bool:
         return all(e.idle for e in self.engines)

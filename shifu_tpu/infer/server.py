@@ -49,6 +49,15 @@ one step.
                           with the same verdict ("ok" | "degraded"
                           with reasons | "dead"), and an engine-thread
                           death auto-dumps the ring to disk.
+    POST /drainz          {"backend": "host:port"} — fleet admin verb:
+                          stop routing new work to that backend, let
+                          its in-flight streams finish, then detach it.
+                          Only meaningful when this server fronts a
+                          FleetRouter (shifu_tpu/fleet); an in-process
+                          engine 400s. A fleet server's /statz also
+                          carries a per-backend "fleet" block and its
+                          /healthz names dead backends in
+                          degraded_reasons.
 
 Sampling: engine-level by default (one compiled decode program). On an
 engine built with ``per_request_sampling=True``, requests may carry
@@ -813,6 +822,16 @@ class EngineRunner:
         out["status"] = slo["status"]
         if slo["reasons"]:
             out["degraded_reasons"] = slo["reasons"]
+        # Non-SLO health findings (ENGINE_INTERFACE "health_reasons"):
+        # the fleet router NAMES its dead backends here, so a degraded
+        # fleet's /healthz says which host is gone. "dead" stays dead.
+        extra = list(eng.health_reasons())
+        if extra:
+            if out["status"] == "ok":
+                out["status"] = "degraded"
+            out["degraded_reasons"] = (
+                out.get("degraded_reasons", []) + extra
+            )
         return out
 
     def slo_status(self) -> dict:
@@ -1049,6 +1068,16 @@ class EngineRunner:
                         w = self._waiters.pop(done.rid, None)
                     if w is not None:
                         w.complete(done)
+                # Per-request failures (ENGINE_INTERFACE "failures"):
+                # a fleet backend dying with a request's tokens
+                # streamed, or an exhausted retry budget, fails THAT
+                # caller (503/400) — not the whole runner. In-process
+                # engines return {} here.
+                for rid, err in self.engine.failures().items():
+                    with self._lock:
+                        w = self._waiters.pop(rid, None)
+                    if w is not None:
+                        w.fail(err)
         except Exception as e:  # device/engine failure: fail loudly,
             # unblock EVERY current and queued waiter, mark unhealthy
             # (healthz flips, complete() refuses new work).
@@ -1107,13 +1136,23 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # quiet by default
         pass
 
-    def _send(self, code: int, obj: dict) -> None:
+    def _send(self, code: int, obj: dict, headers=None) -> None:
         body = json.dumps(obj).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, str(v))
         self.end_headers()
         self.wfile.write(body)
+
+    @staticmethod
+    def _unavailable_headers(e: Exception):
+        """503 responses carry ``Retry-After`` when the failure knows
+        its horizon (the fleet's exhausted retry budget does — clients
+        and load balancers back off instead of hammering)."""
+        ra = getattr(e, "retry_after", None)
+        return {"Retry-After": str(int(ra))} if ra else None
 
     def do_GET(self):
         if self.path == "/healthz":
@@ -1165,7 +1204,7 @@ class _Handler(BaseHTTPRequestHandler):
 
             compilemon.update_memory_gauges(self.runner.metrics)
             eng = self.runner.engine
-            self._send(200, {
+            out = {
                 "engine": eng.counters(),
                 "latency": eng.latency_stats(),
                 "runner": {
@@ -1176,7 +1215,15 @@ class _Handler(BaseHTTPRequestHandler):
                 "watchdog": self.runner.slo_status(),
                 "memory": device_memory_stats(),
                 "metrics": self.runner.metrics.snapshot(),
-            })
+            }
+            # Fleet block (ENGINE_INTERFACE "fleet_stats"): one row per
+            # backend — healthz status, queue depth, breaker state,
+            # EWMA latency — so an operator sees the whole fleet from
+            # this one page. None (no fleet) omits the block.
+            fleet = eng.fleet_stats()
+            if fleet is not None:
+                out["fleet"] = fleet
+            self._send(200, out)
         elif self.path == "/v1/models":
             eng = self.runner.engine
             cfg = getattr(eng.model, "cfg", None)
@@ -1207,8 +1254,34 @@ class _Handler(BaseHTTPRequestHandler):
             self._handle_completions(chat=True)
         elif self.path == "/v1/embeddings":
             self._handle_embeddings()
+        elif self.path == "/drainz":
+            self._handle_drain()
         else:
             self._send(404, {"error": f"no route {self.path}"})
+
+    def _handle_drain(self):
+        """POST /drainz {"backend": "host:port"} — the fleet admin
+        verb: stop routing new work to that backend, let in-flight
+        streams finish, then detach it (ENGINE_INTERFACE "drain"; a
+        non-fleet server 400s with its refusal)."""
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            req = json.loads(self.rfile.read(length) or b"{}")
+        except (ValueError, json.JSONDecodeError):
+            self._send(400, {"error": "body must be JSON"})
+            return
+        target = req.get("backend")
+        if not isinstance(target, str) or not target:
+            self._send(
+                400, {"error": 'drainz needs {"backend": "host:port"}'}
+            )
+            return
+        try:
+            out = self.runner.engine.drain(target)
+        except ValueError as e:
+            self._send(400, {"error": str(e)})
+            return
+        self._send(200, out)
 
     _EMBED_MAX_INPUTS = 64
 
@@ -1274,7 +1347,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(504, {"error": str(e)})
             return
         except RuntimeError as e:
-            self._send(503, {"error": str(e)})
+            self._send(503, {"error": str(e)},
+                       headers=self._unavailable_headers(e))
             return
         n_tok = sum(len(r) for r in rows)
         self._send(200, {
@@ -1738,7 +1812,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(504, {"error": str(e)})
             return
         except RuntimeError as e:
-            self._send(503, {"error": str(e)})
+            self._send(503, {"error": str(e)},
+                       headers=self._unavailable_headers(e))
             return
         choice = self._timed_choice(done, want_logprobs, stop_strings)
         out = (
@@ -1840,7 +1915,16 @@ class _Handler(BaseHTTPRequestHandler):
             return
         except Exception as e:
             try:
-                emit({"error": str(e)})
+                # "retryable" tells a FEDERATING client (the fleet
+                # router) whether another backend could still serve
+                # this request: engine deaths and timeouts yes (the
+                # abandoned request's slot frees), validation nos no.
+                emit({
+                    "error": str(e),
+                    "retryable": isinstance(
+                        e, (RuntimeError, TimeoutError)
+                    ) and not isinstance(e, ValueError),
+                })
             except OSError:
                 return
         finally:

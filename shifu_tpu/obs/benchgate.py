@@ -86,6 +86,13 @@ METRIC_SPECS: Dict[str, Tuple[str, float]] = {
     # grouped-vs-dense MoE dispatch ratio (round 6): collapsing to ~1
     # means the grouped default silently regressed to einsum cost.
     "moe_x_dense": (HIGHER, 0.10),
+    # fleet-routed overhead (round 7): routed-vs-direct wall ratio and
+    # routed request time through the FleetRouter hop. Armable —
+    # dormant until a baseline round records the leg (missing keys are
+    # skipped); once recorded, the ratio drifting UP past tolerance
+    # means the router grew a per-request/per-token hot-path cost.
+    "fleet_x_direct": (LOWER, 0.35),
+    "fleet_rt_ms": (LOWER, 0.35),
 }
 
 # Absolute floors for landed improve-direction wins (round 6): relative

@@ -1,0 +1,75 @@
+"""Spawnable backend for the two-process fleet tests.
+
+Run as ``python tests/_fleet_backend.py``: builds a tiny CPU
+PagedEngine, serves it with the real HTTP front-end on an ephemeral
+port, prints ``{"port": N}`` on stdout (the parent reads it), then
+serves until killed. This IS the per-host process a real fleet runs —
+the tests federate two of these and kill one mid-stream.
+
+Env knobs: ``FLEET_BACKEND_MAX_SLOTS`` (default 2),
+``FLEET_BACKEND_MAX_LEN`` (default 256), ``FLEET_BACKEND_SEED``
+(default 0 — identical params across backends, like a real fleet).
+Not collected by pytest (leading underscore).
+"""
+
+import json
+import os
+import sys
+
+# Run as a script (python tests/_fleet_backend.py): the repo root is
+# the parent of this file's directory, not the script dir.
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+os.environ.setdefault("PALLAS_AXON_POOL_IPS", "")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def main() -> int:
+    from shifu_tpu.infer import PagedEngine, SampleConfig, make_server
+    from shifu_tpu.models import Transformer, TransformerConfig
+
+    max_slots = int(os.environ.get("FLEET_BACKEND_MAX_SLOTS", "2"))
+    max_len = int(os.environ.get("FLEET_BACKEND_MAX_LEN", "256"))
+    seed = int(os.environ.get("FLEET_BACKEND_SEED", "0"))
+
+    cfg = TransformerConfig.tiny()
+    model = Transformer(cfg)
+    params = model.init(jax.random.key(seed))
+    engine = PagedEngine(
+        model, params, max_slots=max_slots, max_len=max_len,
+        page_size=16, prefill_buckets=(16, max_len),
+        sample_cfg=SampleConfig(temperature=0.0),
+    )
+    # Optional per-step brake: the tiny CPU model decodes hundreds of
+    # tokens in milliseconds, far too fast to exercise mid-stream
+    # kill/cancel/drain races — a small sleep per fold makes stream
+    # lifetimes realistic without touching engine code.
+    delay = float(os.environ.get("FLEET_BACKEND_STEP_DELAY", "0"))
+    if delay > 0:
+        import time
+
+        orig_fold = engine.step_fold
+
+        def slow_fold(handle):
+            time.sleep(delay)
+            return orig_fold(handle)
+
+        engine.step_fold = slow_fold
+    server = make_server(engine, port=0)
+    print(json.dumps({"port": server.server_port}), flush=True)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.shutdown()
+        server.runner.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

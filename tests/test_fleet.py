@@ -1,0 +1,389 @@
+"""Two-process fleet serving: REAL backend engine servers in child
+processes (tests/_fleet_backend.py), a FleetRouter + HTTP front-end in
+this one. Covers the acceptance walk: routed completions + fleet
+metrics, client-disconnect cancel propagation to the remote slot,
+graceful draining via POST /drainz, and the kill-a-backend-mid-run
+fault injection (breaker trips, queued requests resubmit to the
+survivor, nothing hangs, /healthz names the dead host)."""
+
+import http.client
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from shifu_tpu.fleet import (
+    BackendClient,
+    BackendConfig,
+    FleetProber,
+    FleetRouter,
+    RetryPolicy,
+    wait_ready,
+)
+from shifu_tpu.infer import make_server
+from shifu_tpu.obs import FlightRecorder, MetricsRegistry, parse_exposition
+
+_HELPER = os.path.join(os.path.dirname(__file__), "_fleet_backend.py")
+
+
+def _spawn_backend(max_slots=2, step_delay=0.05):
+    env = dict(
+        os.environ,
+        PALLAS_AXON_POOL_IPS="",
+        JAX_PLATFORMS="cpu",
+        FLEET_BACKEND_MAX_SLOTS=str(max_slots),
+        # Slow each engine step slightly: streams must outlive the
+        # kill/cancel/drain races these tests stage (the tiny model
+        # would otherwise finish whole requests in milliseconds).
+        FLEET_BACKEND_STEP_DELAY=str(step_delay),
+    )
+    proc = subprocess.Popen(
+        [sys.executable, _HELPER],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        env=env, text=True,
+    )
+    line = proc.stdout.readline()
+    if not line:
+        proc.kill()
+        raise RuntimeError("backend process died before printing its port")
+    port = json.loads(line)["port"]
+    return proc, f"127.0.0.1:{port}"
+
+
+@pytest.fixture(scope="module")
+def backends():
+    """Two real engine-server processes. The LAST test kills procs[0];
+    everything before must leave both alive."""
+    procs, addrs = [], []
+    try:
+        for _ in range(2):
+            p, a = _spawn_backend(max_slots=2)
+            procs.append(p)
+            addrs.append(a)
+        yield procs, addrs
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGKILL)
+        for p in procs:
+            p.wait(timeout=10)
+
+
+def _make_router(addrs, **kw):
+    clients = [
+        BackendClient(
+            a,
+            BackendConfig(
+                connect_timeout_s=10.0, probe_timeout_s=5.0,
+                read_timeout_s=60.0,
+                fail_threshold=kw.pop("fail_threshold", 2),
+                reset_s=kw.pop("reset_s", 30.0),
+            ),
+        )
+        for a in addrs
+    ]
+    ready, pending = wait_ready(clients, timeout_s=60.0, require_all=True)
+    assert not pending
+    return FleetRouter(
+        clients, metrics=MetricsRegistry(), flight=FlightRecorder(),
+        policy=RetryPolicy(base_s=0.01, cap_s=0.1, budget=16.0), **kw
+    )
+
+
+@pytest.fixture()
+def routed(backends):
+    """A fresh router + front-end per test (drain/breaker state is
+    router-local; the backend processes are shared)."""
+    _, addrs = backends
+    router = _make_router(addrs)
+    server = make_server(router, port=0)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    try:
+        yield f"http://127.0.0.1:{server.server_port}", router
+    finally:
+        server.shutdown()
+        server.runner.shutdown()
+        t.join(5)
+
+
+def _post(base, path, obj, timeout=120):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(obj).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def _get(base, path, timeout=30):
+    with urllib.request.urlopen(base + path, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _backend_health(addr):
+    with urllib.request.urlopen(f"http://{addr}/healthz", timeout=30) as r:
+        return json.loads(r.read())
+
+
+def test_routed_completions_and_fleet_metrics(routed):
+    base, router = routed
+    results = [None] * 4
+
+    def worker(i):
+        results[i] = _post(
+            base, "/v1/completions",
+            {"tokens": [1, 2, 3 + i], "max_new_tokens": 4},
+        )
+
+    threads = [
+        threading.Thread(target=worker, args=(i,)) for i in range(4)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(120)
+    for i, r in enumerate(results):
+        assert r is not None, f"request {i} hung"
+        status, out = r
+        assert status == 200
+        assert len(out["tokens"]) == 4
+        assert out["timing"]["backend"] in (
+            b.addr for b in router.backends
+        )
+    # The fleet counters went through the router's own registry.
+    with urllib.request.urlopen(base + "/metrics", timeout=30) as r:
+        samples = parse_exposition(r.read().decode())
+
+    def total(name):
+        return sum(v for (n, _), v in samples.items() if n == name)
+
+    assert total("shifu_fleet_requests_total") >= 4
+    assert total("shifu_fleet_request_seconds_count") >= 4
+    assert total("shifu_fleet_backend_up") == 2
+    assert total("shifu_fleet_breaker_state") == 0  # both closed
+    # /statz carries the per-backend fleet block.
+    statz = _get(base, "/statz")
+    rows = statz["fleet"]["backends"]
+    assert {r["backend"] for r in rows} == {
+        b.addr for b in router.backends
+    }
+    for row in rows:
+        assert row["breaker"] == "closed"
+        assert row["status"] == "up"
+        assert "queue_depth" in row
+    assert sum(r["routed"] for r in rows) >= 4
+    # pooled latency feeds the watchdog surface
+    health = _get(base, "/healthz")
+    assert health["status"] == "ok"
+    assert health["latency"]["completions"] >= 4
+    assert health["latency"]["ttft_ms_p50"] is not None
+
+
+def test_client_disconnect_propagates_cancel_to_backend(routed):
+    base, router = routed
+    host, port = base[len("http://"):].rsplit(":", 1)
+    before = {
+        b.addr: _backend_health(b.addr).get("cancellations", 0)
+        for b in router.backends
+    }
+    conn = http.client.HTTPConnection(host, int(port), timeout=30)
+    conn.request(
+        "POST", "/v1/completions",
+        json.dumps({
+            "tokens": [5, 6, 7], "max_new_tokens": 200, "stream": True,
+        }),
+        {"Content-Type": "application/json"},
+    )
+    sock = conn.sock  # getresponse() detaches it (Connection: close)
+    resp = conn.getresponse()
+    assert resp.status == 200
+    # read until the first delta so the request is live on a backend
+    while True:
+        line = resp.readline()
+        assert line, "stream ended before first delta"
+        if line.startswith(b"data:") and b"tokens" in line:
+            break
+    # Client walks away mid-stream. shutdown(), not just close():
+    # the response object pins the fd, so close() alone would leave
+    # the TCP connection open and the router would never notice.
+    import socket as _socket
+
+    sock.shutdown(_socket.SHUT_RDWR)
+    conn.close()
+    # The router cancels its backend connection; the backend frees the
+    # slot (engine-side cancel). Poll until every backend is idle with
+    # a cancellation recorded.
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        healths = {
+            b.addr: _backend_health(b.addr) for b in router.backends
+        }
+        if all(h["active_slots"] == 0 for h in healths.values()) and any(
+            h.get("cancellations", 0) > before[a]
+            for a, h in healths.items()
+        ):
+            break
+        time.sleep(0.1)
+    else:
+        pytest.fail(
+            "backend never saw the cancel: "
+            f"{ {a: (h['active_slots'], h.get('cancellations')) for a, h in healths.items()} }"
+        )
+    assert router.idle or router.active_slots == 0
+
+
+def test_drainz_finishes_inflight_and_routes_no_new_work(routed):
+    base, router = routed
+    a0 = router.backends[0].addr
+    a1 = router.backends[1].addr
+    host, port = base[len("http://"):].rsplit(":", 1)
+    # A live stream lands on backend 0 (both idle -> lowest index).
+    conn = http.client.HTTPConnection(host, int(port), timeout=60)
+    conn.request(
+        "POST", "/v1/completions",
+        json.dumps({
+            "tokens": [9, 9, 9], "max_new_tokens": 64, "stream": True,
+        }),
+        {"Content-Type": "application/json"},
+    )
+    resp = conn.getresponse()
+    assert resp.status == 200
+    while True:  # wait for it to be streaming
+        line = resp.readline()
+        assert line
+        if line.startswith(b"data:") and b"tokens" in line:
+            break
+    assert router.backends[0].in_flight == 1
+    status, out = _post(base, "/drainz", {"backend": a0})
+    assert status == 200
+    assert out["draining"] == a0 and out["in_flight"] == 1
+    routed_before = router.backends[0].routed
+    # New work routes ONLY to the survivor while the drain is open.
+    for i in range(3):
+        status, done = _post(
+            base, "/v1/completions",
+            {"tokens": [1, 2, 3 + i], "max_new_tokens": 4},
+        )
+        assert status == 200
+        assert done["timing"]["backend"] == a1
+    assert router.backends[0].routed == routed_before
+    # The in-flight stream finishes CLEANLY (drain does not cut it).
+    final = None
+    while True:
+        line = resp.readline()
+        if not line:
+            break
+        if line.startswith(b"data:"):
+            payload = line[5:].strip()
+            if payload == b"[DONE]":
+                break
+            ev = json.loads(payload)
+            assert "error" not in ev, ev
+            if "finished_by" in ev:
+                final = ev
+    conn.close()
+    assert final is not None and final["n_tokens"] == 64
+    # ... after which the backend detaches.
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        if router.backends[0].detached:
+            break
+        time.sleep(0.05)
+    assert router.backends[0].detached
+    events = [e["kind"] for e in router.flight.snapshot()]
+    assert "backend_draining" in events and "backend_detached" in events
+    # statz reflects the detachment; /healthz stays ok (a drained
+    # backend is an operator action, not a fault).
+    row0 = next(
+        r for r in _get(base, "/statz")["fleet"]["backends"]
+        if r["backend"] == a0
+    )
+    assert row0["status"] == "detached"
+    assert _get(base, "/healthz")["status"] == "ok"
+
+
+def test_kill_backend_mid_run_resubmits_and_degrades(backends):
+    """THE fault-injection walk (run LAST: it kills backend process 0):
+    with requests in flight and queued on both backends, SIGKILL one.
+    Every accepted request completes (resubmitted to the survivor) or
+    returns a clean 503 — none hang; the dead backend's breaker trips;
+    the router's /healthz goes degraded NAMING the dead backend; flight
+    records backend_down."""
+    procs, addrs = backends
+    router = _make_router(addrs, fail_threshold=2)
+    prober = FleetProber(router, interval_s=0.25)
+    prober.start()
+    server = make_server(router, port=0)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    base = f"http://127.0.0.1:{server.server_port}"
+    results = [None] * 6
+    try:
+        def worker(i):
+            try:
+                results[i] = _post(
+                    base, "/v1/completions",
+                    {"tokens": [2, 3, 5 + i], "max_new_tokens": 96},
+                    timeout=120,
+                )
+            except urllib.error.HTTPError as e:
+                results[i] = (e.code, json.loads(e.read()))
+
+        threads = [
+            threading.Thread(target=worker, args=(i,)) for i in range(6)
+        ]
+        for th in threads:
+            th.start()
+        # Let the fleet admit/queue them (2 slots per backend -> some
+        # requests are remote-queued, not yet streamed), then kill A.
+        time.sleep(0.6)
+        procs[0].send_signal(signal.SIGKILL)
+        procs[0].wait(timeout=10)
+        for th in threads:
+            th.join(120)
+        assert all(r is not None for r in results), (
+            f"requests hung: {[i for i, r in enumerate(results) if r is None]}"
+        )
+        codes = sorted(c for c, _ in results)
+        assert set(codes) <= {200, 503}, codes
+        # the survivor kept the fleet serving: most requests completed
+        assert codes.count(200) >= 3, codes
+        # the dead backend's breaker tripped (worker failures and/or
+        # the prober's failed probes) and /healthz names it
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            health = _get(base, "/healthz")
+            if health["status"] == "degraded":
+                break
+            time.sleep(0.2)
+        assert health["status"] == "degraded", health
+        assert any(
+            addrs[0] in r for r in health["degraded_reasons"]
+        ), health
+        b0 = router.backends[0]
+        assert b0.breaker.state == "open"
+        downs = router.flight.snapshot(kind="backend_down")
+        assert downs and downs[-1]["backend"] == addrs[0]
+        # queued->resubmitted work reached the survivor
+        stats = router.fleet_stats()
+        assert stats["resubmissions"] >= 1, stats
+        # and NEW requests still serve (degraded, not dead)
+        status, out = _post(
+            base, "/v1/completions",
+            {"tokens": [1, 2, 3], "max_new_tokens": 4},
+        )
+        assert status == 200
+        assert out["timing"]["backend"] == addrs[1]
+    finally:
+        prober.stop()
+        server.shutdown()
+        server.runner.shutdown()
+        t.join(5)

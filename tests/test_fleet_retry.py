@@ -1,0 +1,279 @@
+"""Fleet retry machinery in isolation: backoff/jitter bounds, the
+retry budget (exhaustion -> 503 + Retry-After), and the circuit
+breaker's trip/half-open/close walk — all on deterministic fake
+clocks/rngs, no sleeps, no backends (the one "live" test points the
+router at a connection-refused port, which fails instantly)."""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from shifu_tpu.fleet import (
+    BackendClient,
+    BackendConfig,
+    CircuitBreaker,
+    FleetRouter,
+    FleetUnavailable,
+    RetryPolicy,
+    parse_fleet,
+)
+from shifu_tpu.fleet.backend import _jitter_check
+
+
+# ------------------------------------------------------------- backoff
+def test_backoff_schedule_bounds():
+    # Deterministic rng sweep: every attempt's delay lands inside the
+    # declared [(1-jitter)*d, d] envelope with d = min(cap, base*2^k).
+    for r in (0.0, 0.25, 0.5, 0.99):
+        p = RetryPolicy(base_s=0.05, cap_s=2.0, jitter=0.5,
+                        rng=lambda r=r: r)
+        for k in range(12):
+            lo, hi = _jitter_check(p, k)
+            d = p.delay(k)
+            assert lo - 1e-12 <= d <= hi + 1e-12, (k, r, d, lo, hi)
+    # The cap really caps: far attempts stop growing.
+    p = RetryPolicy(base_s=0.05, cap_s=2.0, jitter=0.0)
+    assert p.delay(50) == 2.0
+    assert p.delay(0) == 0.05
+    assert p.delay(3) == pytest.approx(0.4)
+
+
+def test_backoff_jitter_never_negative_and_randomised():
+    seen = set()
+    p = RetryPolicy(base_s=0.1, cap_s=1.0, jitter=1.0)
+    for _ in range(64):
+        d = p.delay(2)
+        assert 0.0 <= d <= 0.4
+        seen.add(round(d, 6))
+    assert len(seen) > 8  # actual jitter, not a constant
+
+
+def test_policy_validation():
+    with pytest.raises(ValueError, match="jitter"):
+        RetryPolicy(jitter=1.5)
+    with pytest.raises(ValueError, match="base_s"):
+        RetryPolicy(base_s=0.5, cap_s=0.1)
+
+
+# -------------------------------------------------------- retry budget
+def test_retry_budget_spend_and_refund():
+    p = RetryPolicy(budget=2.0, refill=0.5)
+    assert p.spend() and p.spend()
+    assert not p.spend()  # empty: fail fast
+    p.refund()  # +0.5 -> still < 1 token
+    assert not p.spend()
+    p.refund()  # 1.0 -> one retry available again
+    assert p.spend()
+    # refund never exceeds the cap
+    for _ in range(50):
+        p.refund()
+    assert p.budget == 2.0
+
+
+def test_budget_exhaustion_surfaces_503_with_retry_after(tiny_port):
+    """A fleet whose only backend refuses connections: the worker
+    retries until the budget empties, then the request fails
+    :class:`FleetUnavailable` — and the SERVER maps it to 503 with a
+    ``Retry-After`` header."""
+    from shifu_tpu.infer import make_server
+    from shifu_tpu.obs import FlightRecorder, MetricsRegistry
+
+    dead = BackendClient(
+        f"127.0.0.1:{tiny_port}",
+        BackendConfig(connect_timeout_s=0.5, fail_threshold=100),
+    )
+    router = FleetRouter(
+        [dead],
+        policy=RetryPolicy(base_s=0.001, cap_s=0.002, budget=2.0),
+        metrics=MetricsRegistry(), flight=FlightRecorder(),
+    )
+    server = make_server(router, port=0)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.server_port}/v1/completions",
+            data=json.dumps(
+                {"tokens": [1, 2, 3], "max_new_tokens": 4}
+            ).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=30)
+        assert e.value.code == 503
+        assert e.value.headers.get("Retry-After") is not None
+        assert int(e.value.headers["Retry-After"]) >= 1
+        body = json.loads(e.value.read())
+        assert "retry budget exhausted" in body["error"]
+    finally:
+        server.shutdown()
+        server.runner.shutdown()
+        t.join(5)
+
+
+@pytest.fixture()
+def tiny_port():
+    """A port with nothing listening (bound then released — racy in
+    principle, deterministic enough in a test container)."""
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# ------------------------------------------------------ circuit breaker
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_breaker_trips_on_consecutive_failures():
+    clk = _Clock()
+    moves = []
+    cb = CircuitBreaker(fail_threshold=3, reset_s=5.0, clock=clk,
+                        on_transition=lambda o, n: moves.append((o, n)))
+    assert cb.state == "closed" and cb.allow()
+    cb.record_failure()
+    cb.record_failure()
+    assert cb.state == "closed"  # not yet
+    cb.record_success()  # success resets the consecutive count
+    cb.record_failure()
+    cb.record_failure()
+    assert cb.state == "closed"
+    cb.record_failure()
+    assert cb.state == "open"
+    assert not cb.allow()
+    assert moves == [("closed", "open")]
+
+
+def test_breaker_half_open_probe_and_close():
+    clk = _Clock()
+    cb = CircuitBreaker(fail_threshold=1, reset_s=5.0, clock=clk)
+    cb.record_failure()
+    assert cb.state == "open"
+    clk.t = 4.9
+    assert not cb.allow()
+    clk.t = 5.0
+    assert cb.allow()  # THE half-open probe
+    assert cb.state == "half_open"
+    assert not cb.allow()  # one probe at a time
+    cb.record_success()
+    assert cb.state == "closed"
+    assert cb.allow()
+
+
+def test_breaker_half_open_failure_reopens():
+    clk = _Clock()
+    cb = CircuitBreaker(fail_threshold=1, reset_s=5.0, clock=clk)
+    cb.record_failure()
+    clk.t = 5.0
+    assert cb.allow()
+    cb.record_failure()  # probe failed
+    assert cb.state == "open"
+    clk.t = 9.9
+    assert not cb.allow()  # cooldown restarted at the probe failure
+    clk.t = 10.0
+    assert cb.allow()
+    cb.record_success()
+    assert cb.state == "closed"
+
+
+# ------------------------------------------------------------- roster
+def test_parse_fleet():
+    assert parse_fleet("a:1, b:2") == ["a:1", "b:2"]
+    assert parse_fleet(None, env={"SHIFU_FLEET": "h:9"}) == ["h:9"]
+    with pytest.raises(ValueError, match="no fleet roster"):
+        parse_fleet(None, env={})
+    with pytest.raises(ValueError, match="not host:port"):
+        parse_fleet("nota_port")
+    with pytest.raises(ValueError, match="duplicate"):
+        parse_fleet("a:1,a:1")
+
+
+# --------------------------------------------- router interface/admin
+def _stub_router(**kw):
+    from shifu_tpu.obs import FlightRecorder, MetricsRegistry
+
+    b = BackendClient("127.0.0.1:1", BackendConfig(connect_timeout_s=0.2))
+    return FleetRouter(
+        [b], metrics=MetricsRegistry(), flight=FlightRecorder(), **kw
+    )
+
+
+def test_router_provides_full_engine_interface():
+    from shifu_tpu.infer.engine import ENGINE_INTERFACE
+
+    router = _stub_router()
+    for name in sorted(ENGINE_INTERFACE):
+        assert hasattr(router, name), f"FleetRouter lacks {name}"
+
+
+def test_engines_provide_fleet_surface_trivially():
+    # The in-process engines answer the fleet ENGINE_INTERFACE members
+    # trivially (the server probes nothing).
+    from shifu_tpu.infer.engine import Engine
+
+    assert Engine.failures(object.__new__(Engine)) == {}
+    assert Engine.health_reasons(object.__new__(Engine)) == []
+    assert Engine.fleet_stats(object.__new__(Engine)) is None
+    with pytest.raises(ValueError, match="fleet"):
+        Engine.drain(object.__new__(Engine), "x:1")
+
+
+def test_drain_validates_and_submit_fails_when_drained():
+    router = _stub_router()
+    with pytest.raises(ValueError, match="unknown backend"):
+        router.drain("nope:9")
+    b = router.backends[0]
+    b.in_flight = 1  # hold the drain open so the walk is observable
+    out = router.drain("127.0.0.1:1")
+    assert out["draining"] == "127.0.0.1:1"
+    assert out["in_flight"] == 1
+    # The only backend is draining: submit fails FAST, not by timeout.
+    with pytest.raises(FleetUnavailable) as e:
+        router.submit([1, 2], max_new_tokens=4)
+    assert e.value.retry_after >= 1
+    # double-drain reports rather than spawning a second watcher
+    out2 = router.drain("127.0.0.1:1")
+    assert out2["already_draining"]
+    assert not b.detached  # in-flight work still pins it
+    b.in_flight = 0  # "the stream finished"
+    deadline = 100
+    import time as _t
+
+    while not b.detached and deadline:
+        _t.sleep(0.02)
+        deadline -= 1
+    assert b.detached
+    with pytest.raises(ValueError, match="already detached"):
+        router.drain("127.0.0.1:1")
+
+
+def test_fleet_stats_and_health_reasons_name_dead_backends():
+    router = _stub_router()
+    b = router.backends[0]
+    for _ in range(b.breaker.fail_threshold):
+        b.breaker.record_failure()
+    assert b.breaker.state == "open"
+    reasons = router.health_reasons()
+    assert any("127.0.0.1:1" in r for r in reasons)
+    assert any("no routable backend" in r for r in reasons)
+    stats = router.fleet_stats()
+    (row,) = stats["backends"]
+    assert row["backend"] == "127.0.0.1:1"
+    assert row["breaker"] == "open"
+    assert row["status"] == "down"
+    assert "queue_depth" in row and "ewma_ms" in row
+    # flight events recorded the transition
+    downs = router.flight.snapshot(kind="backend_down")
+    assert downs and downs[-1]["backend"] == "127.0.0.1:1"
