@@ -110,6 +110,10 @@ def main(argv=None):
             out["fleet_routed"] = bench_fleet_routed()
         except Exception as e:
             out["fleet_routed"] = {"error": f"{type(e).__name__}: {e}"}
+        try:
+            out["rollout"] = bench_rollout()
+        except Exception as e:
+            out["rollout"] = {"error": f"{type(e).__name__}: {e}"}
     # Runtime self-telemetry in the full ledger: device-memory rollup
     # + how many compiles the bench's engines paid (the obs registry
     # counted them via the engines' tracked programs).
@@ -270,6 +274,11 @@ def _compact(out: dict) -> dict:
         # the ratio creeping up means the router grew a hot-path cost
         ("fleet_x_direct", g("fleet_routed", "routed_vs_direct")),
         ("fleet_rt_ms", g("fleet_routed", "routed_ms")),
+        # zero-downtime rollout leg (round 8): client-visible p99 TTFT
+        # and error rate DURING a synthetic rolling weight update —
+        # the "nobody noticed the deploy" numbers
+        ("rollout_p99_ttft_ms", g("rollout", "rollout_p99_ttft_ms")),
+        ("rollout_err_rate", g("rollout", "rollout_err_rate")),
         ("moe_mfu", g("train_legs", "moe", "mfu")),
         # grouped-vs-dense MoE dispatch (round 6): the measured ratio
         # and the einsum oracle's own MFU (the "before" number)
@@ -597,6 +606,160 @@ def bench_fleet_routed():
             rsrv.runner.shutdown()
         bsrv.shutdown()
         bsrv.runner.shutdown()
+
+
+def bench_rollout():
+    """Served p99 TTFT + error rate DURING a rolling weight rollout vs
+    steady state (round 7's zero-downtime claim, measured).
+
+    Two small engines behind a FleetRouter in this process; a client
+    loop issues sequential completions and records per-request TTFT
+    (the router-measured hop-inclusive number the SLO watchdog
+    budgets). Phase 1 is steady state; phase 2 runs the same load
+    while a RolloutController walks both backends through
+    drain -> /reloadz -> gate -> resume onto a freshly-written
+    manifest checkpoint. ``rollout_p99_ttft_ms`` creeping far above
+    ``steady_p99_ttft_ms``, or ``rollout_err_rate`` above 0, means the
+    rollout machinery stopped being invisible to clients."""
+    import tempfile
+    import threading
+    import urllib.error
+    import urllib.request
+
+    from shifu_tpu.checkpoint import save_params_dir
+    from shifu_tpu.fleet import (
+        BackendClient,
+        FleetProber,
+        FleetRouter,
+        RolloutController,
+        RouterAdmin,
+    )
+    from shifu_tpu.infer import SampleConfig, make_server
+    from shifu_tpu.infer.engine import PagedEngine
+    from shifu_tpu.models.transformer import Transformer, TransformerConfig
+    from shifu_tpu.obs import FlightRecorder, MetricsRegistry
+
+    cfg = TransformerConfig.small()
+    model = Transformer(cfg)
+    tmp = tempfile.mkdtemp(prefix="shifu_bench_rollout_")
+    ck_v0 = save_params_dir(
+        os.path.join(tmp, "v0"), model.init(jax.random.key(0))
+    )
+    ck_v1 = save_params_dir(
+        os.path.join(tmp, "v1"), model.init(jax.random.key(1))
+    )
+    from shifu_tpu.checkpoint import load_params_dir
+
+    params = load_params_dir(ck_v0)
+    bsrvs, prober, rsrv = [], None, None
+    try:
+        for _ in range(2):
+            eng = PagedEngine(
+                model, params, max_slots=4, max_len=128, page_size=16,
+                prefill_buckets=(32, 128),
+                sample_cfg=SampleConfig(temperature=0.0),
+            )
+            srv = make_server(eng, port=0, ckpt_path=ck_v0)
+            threading.Thread(
+                target=srv.serve_forever, daemon=True
+            ).start()
+            bsrvs.append(srv)
+        clients = [
+            BackendClient(f"127.0.0.1:{s.server_port}") for s in bsrvs
+        ]
+        for c in clients:
+            c.probe()
+            c.models()
+        router = FleetRouter(
+            clients, metrics=MetricsRegistry(), flight=FlightRecorder()
+        )
+        prober = FleetProber(router, interval_s=0.1)
+        prober.start()
+        rsrv = make_server(router, port=0)
+        threading.Thread(target=rsrv.serve_forever, daemon=True).start()
+        base = f"http://127.0.0.1:{rsrv.server_port}"
+        max_new = 16
+
+        def one(i):
+            """-> (ttft_ms or None, ok)"""
+            req = urllib.request.Request(
+                base + "/v1/completions",
+                data=json.dumps({
+                    "tokens": [1, 2, 3 + (i % 5)],
+                    "max_new_tokens": max_new,
+                }).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=120) as r:
+                    out = json.loads(r.read())
+                return out.get("timing", {}).get("ttft_ms"), True
+            except urllib.error.HTTPError:
+                return None, False
+
+        def phase(stop_check, min_requests):
+            ttfts, errs, n = [], 0, 0
+            while n < min_requests or not stop_check():
+                ttft, ok = one(n)
+                n += 1
+                if not ok:
+                    errs += 1
+                elif ttft is not None:
+                    ttfts.append(ttft)
+            return ttfts, errs, n
+
+        one(0)  # warm compiles on both hop paths
+        steady_ttfts, steady_errs, steady_n = phase(
+            lambda: True, min_requests=24
+        )
+        report = {}
+
+        def roll():
+            report["rollout"] = RolloutController(
+                RouterAdmin(base), ck_v1,
+                drain_timeout_s=120.0, ready_timeout_s=60.0,
+            ).run()
+
+        t = threading.Thread(target=roll, daemon=True)
+        t.start()
+        roll_ttfts, roll_errs, roll_n = phase(
+            lambda: not t.is_alive(), min_requests=8
+        )
+        t.join(300)
+        assert report.get("rollout", {}).get("status") == "complete", (
+            report
+        )
+
+        def p99(vals):
+            if not vals:
+                return None
+            vals = sorted(vals)
+            return round(vals[min(int(0.99 * len(vals)),
+                                  len(vals) - 1)], 3)
+
+        return {
+            "requests_steady": steady_n,
+            "requests_during_rollout": roll_n,
+            "max_new_tokens": max_new,
+            "steady_p99_ttft_ms": p99(steady_ttfts),
+            "steady_err_rate": round(steady_errs / max(steady_n, 1), 4),
+            "rollout_p99_ttft_ms": p99(roll_ttfts),
+            "rollout_err_rate": round(roll_errs / max(roll_n, 1), 4),
+            "rollout_report": {
+                "status": report["rollout"]["status"],
+                "updated": len(report["rollout"]["updated"]),
+            },
+        }
+    finally:
+        if prober is not None:
+            prober.stop()
+        if rsrv is not None:
+            rsrv.shutdown()
+            rsrv.runner.shutdown()
+        for srv in bsrvs:
+            srv.shutdown()
+            srv.runner.shutdown()
 
 
 def bench_serving():

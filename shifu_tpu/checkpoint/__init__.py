@@ -13,7 +13,20 @@ The format here is orbax's standard OCDBT + zarr3 layout.
 
 from shifu_tpu.checkpoint.checkpointer import (
     Checkpointer,
+    CheckpointCorruptError,
     abstract_train_state,
+    load_params_dir,
+    load_serving_params,
+    save_params_dir,
+    verify_params_dir,
 )
 
-__all__ = ["Checkpointer", "abstract_train_state"]
+__all__ = [
+    "Checkpointer",
+    "CheckpointCorruptError",
+    "abstract_train_state",
+    "load_params_dir",
+    "load_serving_params",
+    "save_params_dir",
+    "verify_params_dir",
+]

@@ -1,6 +1,6 @@
-"""Sharding-aware checkpointing on orbax.
+"""Sharding-aware checkpointing on orbax + the serving params format.
 
-Two pieces:
+Three pieces:
 
   * :func:`abstract_train_state` — builds the restore *template*: a
     TrainState-shaped tree of ``jax.ShapeDtypeStruct`` leaves carrying
@@ -11,6 +11,16 @@ Two pieces:
     ``orbax.checkpoint.CheckpointManager``: async saves, retention,
     save-interval gating, and a JSON side-channel for host state (data
     iterator position, python RNG, config fingerprints, ...).
+  * the MANIFEST params format (:func:`save_params_dir` /
+    :func:`load_params_dir`) — a params-only serving checkpoint with
+    per-array sha256 checksums, written all-or-nothing (files land in a
+    temp dir, the manifest is fsynced + atomically renamed into place
+    LAST, then the whole dir renames to its final name). A torn,
+    truncated, or bit-flipped checkpoint fails :func:`load_params_dir`
+    with :class:`CheckpointCorruptError` BEFORE any weight reaches an
+    engine — the hot-reload path (``POST /reloadz``, ``shifu_tpu fleet
+    rollout``) turns that into a loud 503 with the backend still
+    serving its old weights, never a half-swapped model.
 
 Design choices (TPU-first):
   * Saves are async by default: the save() call snapshots device buffers to
@@ -19,19 +29,39 @@ Design choices (TPU-first):
   * The train step counter lives *inside* the state (TrainState.opt["step"]),
     so "which step is this checkpoint" is read off the state itself; the
     manager's step index is only a directory label.
+  * :func:`load_serving_params` is the ONE loader the reload path uses:
+    a manifest dir (``manifest.json`` present) loads checksum-verified;
+    anything else is treated as an orbax checkpoint dir and read via
+    :meth:`Checkpointer.restore_params` (orbax's own atomic-commit
+    markers gate completeness there; produce manifest dirs with
+    ``shifu_tpu fleet snapshot`` for the verified path).
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
 import os
+import tempfile
 from typing import Any, Mapping, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import orbax.checkpoint as ocp
 
 from shifu_tpu.parallel import sharding as shd
 from shifu_tpu.train.step import TrainState
+
+MANIFEST_NAME = "manifest.json"
+_MANIFEST_FORMAT = "shifu-params-v1"
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A manifest params checkpoint failed integrity verification
+    (missing/unparseable manifest, missing array file, byte-count or
+    sha256 mismatch). The loader raises BEFORE returning any array —
+    callers keep whatever weights they already serve."""
 
 
 def abstract_train_state(model, mesh=None, rules=shd.DEFAULT_RULES, optimizer=None):
@@ -182,3 +212,197 @@ class Checkpointer:
     def __exit__(self, *exc):
         self.close()
         return False
+
+
+# --------------------------------------------------------------------------
+# Manifest params format: the serving/rollout checkpoint artifact.
+# --------------------------------------------------------------------------
+def _leaf_key(path) -> str:
+    """jax key-path -> "/"-joined string key (params are nested dicts of
+    arrays, so every entry is a DictKey; anything else is refused — the
+    format round-trips plain dict trees only)."""
+    parts = []
+    for p in path:
+        key = getattr(p, "key", None)
+        if not isinstance(key, str) or "/" in key:
+            raise ValueError(
+                f"params tree key {p!r} is not a plain string dict key; "
+                "the manifest format stores nested-dict param trees only"
+            )
+        parts.append(key)
+    return "/".join(parts)
+
+
+def _np_dtype(name: str) -> np.dtype:
+    """Dtype string -> numpy dtype, covering the ml_dtypes extras
+    (bfloat16 etc.) jax params commonly carry."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes  # jax dependency, always importable with jax
+
+        try:
+            return np.dtype(getattr(ml_dtypes, name))
+        except (AttributeError, TypeError):
+            raise ValueError(f"unknown array dtype {name!r}") from None
+
+
+def _fsync_write(path: str, data: bytes) -> None:
+    with open(path, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def save_params_dir(directory: str, params) -> str:
+    """Write ``params`` (a nested dict tree of arrays) as a manifest
+    params checkpoint at ``directory``. All-or-nothing: arrays land in
+    a same-filesystem temp dir, the manifest (per-array file name,
+    shape, dtype, byte count, sha256) is fsynced and atomically renamed
+    into place last, then the temp dir renames to ``directory`` — a
+    crash at any point leaves either no checkpoint or a complete one,
+    never a torn dir that looks loadable. Refuses an existing target
+    (checkpoints are immutable artifacts; write a new path per
+    rollout)."""
+    directory = os.path.abspath(directory)
+    if os.path.exists(directory):
+        raise FileExistsError(
+            f"{directory} already exists; manifest checkpoints are "
+            "immutable — write each rollout to a fresh path"
+        )
+    parent = os.path.dirname(directory) or "."
+    os.makedirs(parent, exist_ok=True)
+    leaves = jax.tree_util.tree_flatten_with_path(params)[0]
+    if not leaves:
+        raise ValueError("params tree has no arrays")
+    tmp = tempfile.mkdtemp(
+        prefix=os.path.basename(directory) + ".tmp.", dir=parent
+    )
+    try:
+        arrays = {}
+        for i, (path, leaf) in enumerate(leaves):
+            key = _leaf_key(path)
+            arr = np.asarray(jax.device_get(leaf))
+            data = arr.tobytes()
+            fname = f"{i:05d}.bin"
+            _fsync_write(os.path.join(tmp, fname), data)
+            arrays[key] = {
+                "file": fname,
+                "shape": list(arr.shape),
+                "dtype": str(arr.dtype),
+                "nbytes": len(data),
+                "sha256": hashlib.sha256(data).hexdigest(),
+            }
+        manifest = {"format": _MANIFEST_FORMAT, "arrays": arrays}
+        # Manifest last, via temp-file + atomic rename: its presence is
+        # the commit marker for the files around it.
+        mtmp = os.path.join(tmp, MANIFEST_NAME + ".tmp")
+        _fsync_write(
+            mtmp, json.dumps(manifest, sort_keys=True).encode()
+        )
+        os.replace(mtmp, os.path.join(tmp, MANIFEST_NAME))
+        os.rename(tmp, directory)
+    except BaseException:
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    return directory
+
+
+def verify_params_dir(directory: str) -> dict:
+    """Integrity-check a manifest params checkpoint; returns the parsed
+    manifest. Raises :class:`CheckpointCorruptError` on a missing or
+    unparseable manifest, a missing array file, or any byte-count /
+    sha256 mismatch — the torn-write and bit-rot detector the reload
+    path trusts."""
+    mpath = os.path.join(directory, MANIFEST_NAME)
+    try:
+        with open(mpath, "rb") as f:
+            manifest = json.loads(f.read())
+    except FileNotFoundError:
+        raise CheckpointCorruptError(
+            f"{directory}: no {MANIFEST_NAME} — torn write or not a "
+            "manifest params checkpoint"
+        ) from None
+    except (OSError, ValueError) as e:
+        raise CheckpointCorruptError(
+            f"{directory}: unreadable manifest: {e}"
+        ) from e
+    if manifest.get("format") != _MANIFEST_FORMAT:
+        raise CheckpointCorruptError(
+            f"{directory}: manifest format {manifest.get('format')!r} "
+            f"!= {_MANIFEST_FORMAT!r}"
+        )
+    arrays = manifest.get("arrays")
+    if not isinstance(arrays, dict) or not arrays:
+        raise CheckpointCorruptError(f"{directory}: manifest lists no arrays")
+    for key, meta in arrays.items():
+        fpath = os.path.join(directory, meta["file"])
+        try:
+            with open(fpath, "rb") as f:
+                data = f.read()
+        except OSError as e:
+            raise CheckpointCorruptError(
+                f"{directory}: array {key!r} unreadable: {e}"
+            ) from e
+        if len(data) != int(meta["nbytes"]):
+            raise CheckpointCorruptError(
+                f"{directory}: array {key!r} truncated "
+                f"({len(data)} bytes != {meta['nbytes']})"
+            )
+        digest = hashlib.sha256(data).hexdigest()
+        if digest != meta["sha256"]:
+            raise CheckpointCorruptError(
+                f"{directory}: array {key!r} checksum mismatch "
+                f"({digest[:12]}… != {meta['sha256'][:12]}…)"
+            )
+    return manifest
+
+
+def load_params_dir(directory: str):
+    """Load a manifest params checkpoint, verifying EVERY array's byte
+    count and sha256 first (:func:`verify_params_dir`) — corruption
+    raises before a single weight is materialised. Returns the nested
+    params dict (host numpy arrays; engines place/cast on swap)."""
+    manifest = verify_params_dir(directory)
+    out: dict = {}
+    for key, meta in manifest["arrays"].items():
+        with open(os.path.join(directory, meta["file"]), "rb") as f:
+            data = f.read()
+        arr = np.frombuffer(
+            data, dtype=_np_dtype(meta["dtype"])
+        ).reshape(meta["shape"])
+        node = out
+        parts = key.split("/")
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = arr
+    return out
+
+
+def load_serving_params(path: str, model=None):
+    """Params for serving/hot-reload from ``path`` — the ONE loader
+    behind ``POST /reloadz`` and ``shifu_tpu fleet rollout``.
+
+    A manifest params dir (``manifest.json`` present) loads checksum-
+    verified; any other existing directory is treated as an orbax
+    checkpoint dir and read through :meth:`Checkpointer.restore_params`
+    (``model`` supplies the params template — required on that path).
+    Missing paths raise FileNotFoundError; corruption raises
+    :class:`CheckpointCorruptError`."""
+    path = os.path.abspath(path)
+    if not os.path.isdir(path):
+        raise FileNotFoundError(f"checkpoint path {path} does not exist")
+    if os.path.exists(os.path.join(path, MANIFEST_NAME)):
+        return load_params_dir(path)
+    if model is None:
+        raise ValueError(
+            f"{path} is an orbax checkpoint dir; restoring needs the "
+            "model template (manifest params dirs do not)"
+        )
+    ckpt = Checkpointer(path)
+    try:
+        return ckpt.restore_params(model)
+    finally:
+        ckpt.close()

@@ -7,6 +7,11 @@
     serve      HTTP completions server (continuous batching, paged KV);
                with --fleet host:port,... it becomes the FLEET ROUTER
                federating remote serve hosts (shifu_tpu/fleet)
+    fleet      fleet administration: `rollout` = zero-downtime rolling
+               weight rollout across a live router (drain -> /reloadz
+               hot-swap -> readiness gate -> resume, SLO-braked);
+               `snapshot` = training ckpt -> checksum-manifest params
+               dir (the artifact rollout verifies)
     bpe-train  train a byte-level BPE tokenizer (native C++ core)
     trace      export serving request traces as Chrome trace-event JSON
     debug      dump the flight-recorder ring (live server's /debugz or
@@ -1123,6 +1128,8 @@ def cmd_serve(args) -> int:
         trace_log=args.trace_log,
         watchdog=watchdog,
         flight_dump=args.flight_dump,
+        model_id=args.model_id,
+        ckpt_path=args.ckpt_dir,
     )
     print(
         json.dumps(
@@ -1143,6 +1150,79 @@ def cmd_serve(args) -> int:
         server.shutdown()
         server.runner.shutdown()
     return 0
+
+
+def cmd_fleet(args) -> int:
+    """``shifu_tpu fleet rollout|snapshot`` — fleet administration.
+
+    ``rollout --ckpt PATH --router URL [--max-unavailable N]
+    [--abort-on-slo]``: zero-downtime rolling weight rollout across the
+    live router's roster — drain one wave at a time (``POST /drainz``
+    with ``detach:false``), hot-swap each backend's weights (``POST
+    /reloadz`` — manifest checkpoints are checksum-verified; a torn
+    artifact 503s and halts the rollout with the old weights still
+    serving), readiness-gate (``/healthz`` + ``/v1/models`` reporting
+    the target ckpt), resume — with the router's SLO watchdog verdict
+    as the automatic brake (a p99 budget breach pauses the wave;
+    ``--abort-on-slo`` rolls updated backends back instead). Exit 0 on
+    a complete rollout, 1 on failed/aborted (the printed report names
+    which backends serve what), 2 on unusable configuration.
+
+    ``snapshot --ckpt-dir ORBAX_DIR --out PARAMS_DIR``: convert a
+    training checkpoint into the manifest params format
+    (params-only, per-array sha256, atomically committed) — the
+    artifact ``rollout``/``/reloadz`` verifies before swapping."""
+    if args.action == "snapshot":
+        from shifu_tpu.checkpoint import save_params_dir
+
+        if not args.ckpt_dir or not args.out:
+            print("snapshot needs --ckpt-dir and --out", file=sys.stderr)
+            return 2
+        model = _build_model(args)
+        params = _restore_params(args, model)
+        try:
+            out = save_params_dir(args.out, params)
+        except (OSError, ValueError) as e:
+            print(str(e), file=sys.stderr)
+            return 2
+        import jax as _jax
+
+        n = sum(
+            x.size for x in _jax.tree_util.tree_leaves(params)
+        )
+        print(json.dumps({"snapshot": out, "params": int(n)}))
+        return 0
+
+    # rollout
+    from shifu_tpu.fleet import (
+        RolloutController,
+        RolloutError,
+        RouterAdmin,
+    )
+
+    if not args.ckpt:
+        print("rollout needs --ckpt PATH", file=sys.stderr)
+        return 2
+    admin = RouterAdmin(args.router)
+    try:
+        ctl = RolloutController(
+            admin, args.ckpt,
+            max_unavailable=args.max_unavailable,
+            abort_on_slo=args.abort_on_slo,
+            drain_timeout_s=args.drain_timeout,
+            ready_timeout_s=args.ready_timeout,
+            pause_timeout_s=args.pause_timeout,
+        )
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    try:
+        report = ctl.run()
+    except RolloutError as e:
+        print(json.dumps({"status": "failed", "error": str(e)}))
+        return 1
+    print(json.dumps(report))
+    return 0 if report.get("status") == "complete" else 1
 
 
 def cmd_trace(args) -> int:
@@ -1473,6 +1553,13 @@ def main(argv=None) -> int:
                    help="write the flight-recorder ring here if the "
                         "engine thread dies (default: a pid-stamped "
                         "file in the temp dir)")
+    s.add_argument("--model-id",
+                   help="the id /v1/models advertises (default: the "
+                        "model class name, e.g. 'transformer'). A "
+                        "multi-model fleet routes requests by it — "
+                        "give each backend tier a distinct name "
+                        "(gemma2-flash, mixtral-ep, mamba) and the "
+                        "router 404s unknown ids")
     s.add_argument("--mesh",
                    help="serving mesh, e.g. dp=2,tp=2 or tp=2,ep=2: "
                         "tp shards heads/mlp, ep shards MoE expert "
@@ -1526,6 +1613,46 @@ def main(argv=None) -> int:
     s.add_argument("--draft-ckpt-dir",
                    help="draft checkpoint (--spec draft)")
     s.set_defaults(fn=cmd_serve)
+
+    fl = sub.add_parser(
+        "fleet",
+        help="fleet administration: `rollout` walks a zero-downtime "
+             "rolling weight rollout across a live router's roster "
+             "(drain -> POST /reloadz hot-swap -> readiness gate -> "
+             "resume, SLO watchdog as the brake); `snapshot` converts "
+             "a training checkpoint into the checksum-manifest params "
+             "format the rollout verifies",
+    )
+    fl.add_argument("action", choices=["rollout", "snapshot"])
+    model_flags(fl, schedule_default="constant")  # snapshot model build
+    fl.add_argument("--router", default="http://127.0.0.1:8000",
+                    help="the live fleet router's base URL (rollout "
+                         "drives it through /statz, /drainz, and "
+                         "/rolloutz)")
+    fl.add_argument("--ckpt",
+                    help="rollout target checkpoint PATH as seen by "
+                         "the BACKEND hosts: a manifest params dir "
+                         "(fleet snapshot; checksum-verified on "
+                         "reload) or an orbax checkpoint dir")
+    fl.add_argument("--max-unavailable", type=int, default=1,
+                    help="backends drained+reloading at once (the "
+                         "wave size); the rest keep serving")
+    fl.add_argument("--abort-on-slo", action="store_true",
+                    help="on an SLO budget breach, roll already-"
+                         "updated backends back to their previous "
+                         "checkpoint (default: pause the wave until "
+                         "the verdict clears or --pause-timeout)")
+    fl.add_argument("--drain-timeout", type=float, default=120.0,
+                    help="seconds to wait for a draining backend's "
+                         "in-flight streams")
+    fl.add_argument("--ready-timeout", type=float, default=60.0,
+                    help="post-reload readiness gate (healthz + "
+                         "/v1/models reporting the target ckpt)")
+    fl.add_argument("--pause-timeout", type=float, default=300.0,
+                    help="how long a paused wave waits for the SLO "
+                         "verdict to clear before the rollout fails")
+    fl.add_argument("--out", help="snapshot: output params-dir path")
+    fl.set_defaults(fn=cmd_fleet)
 
     tr = sub.add_parser(
         "trace",

@@ -23,8 +23,17 @@ front-end, so clients, the obs stack, and the CLI see one engine:
                host roster from ``--fleet host:port,...`` / the
                ``SHIFU_FLEET`` env var, readiness gating on each
                backend's ``/healthz``, and a periodic re-probe loop
-               that brings dead backends back (``backend_up`` /
-               ``backend_down`` flight events).
+               (failure-backoff per host, half-open trials on
+               schedule) that brings dead backends back
+               (``backend_up`` / ``backend_down`` flight events).
+``rollout``    zero-downtime rolling weight rollout
+               (``shifu_tpu fleet rollout --ckpt ...``): drain one
+               ``--max-unavailable`` wave at a time, hot-swap weights
+               via ``POST /reloadz`` (manifest-verified checkpoints —
+               a torn artifact is refused with the old weights still
+               serving), readiness-gate, resume — with the SLO
+               watchdog's pooled p99 budgets as an automatic brake
+               and ``--abort-on-slo`` rollback.
 
 See docs/architecture.md ("The serving fleet") for the design and the
 failure model, and README.md for the serving-topology ladder
@@ -46,6 +55,11 @@ from shifu_tpu.fleet.bootstrap import (
     parse_fleet,
     wait_ready,
 )
+from shifu_tpu.fleet.rollout import (
+    RolloutController,
+    RolloutError,
+    RouterAdmin,
+)
 
 __all__ = [
     "BackendClient",
@@ -56,6 +70,9 @@ __all__ = [
     "FleetRouter",
     "FleetUnavailable",
     "RetryPolicy",
+    "RolloutController",
+    "RolloutError",
+    "RouterAdmin",
     "build_fleet",
     "parse_fleet",
     "wait_ready",

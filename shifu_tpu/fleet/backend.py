@@ -167,6 +167,19 @@ class CircuitBreaker:
                 return True
             return False
 
+    def cooldown_remaining(self) -> float:
+        """Seconds until an OPEN breaker would admit its half-open
+        probe (0.0 when it is due now, or when not open). The prober's
+        backoff consults this so a backed-off dead host still gets its
+        half-open trial ON SCHEDULE — backoff must never delay the
+        breaker walk (fleet/bootstrap.py)."""
+        with self._lock:
+            if self._state != self.OPEN:
+                return 0.0
+            return max(
+                0.0, self.reset_s - (self._clock() - self._opened_at)
+            )
+
     def record_success(self) -> None:
         with self._lock:
             self._fails = 0
@@ -311,10 +324,17 @@ class BackendClient:
         self.retries = 0            # failures here that caused a retry
         self.draining = False       # no NEW work; in-flight finishes
         self.detached = False       # drained to zero and released
+        self._detach_watch = False  # a drain-detach watcher is running
         self.health: Optional[dict] = None   # last /healthz document
         self.health_ts: Optional[float] = None
         self.ewma_ms: Optional[float] = None  # EWMA routed-request wall ms
         self.max_len: Optional[int] = None    # from /v1/models at attach
+        # Model-aware routing surface (both from /v1/models): the model
+        # ids this backend serves (requests naming one route only to
+        # backends listing it) and the checkpoint path it reports
+        # serving (the rollout controller's rollback anchor).
+        self.model_ids: Optional[list] = None
+        self.ckpt: Optional[str] = None
 
     # ------------------------------------------------------------- wire
     def _request(self, method: str, path: str, body: Optional[dict],
@@ -377,16 +397,39 @@ class BackendClient:
         return doc
 
     def models(self) -> dict:
-        """GET /v1/models (bootstrap reads ``max_len`` from it — the
-        one config field the router must know for request bounds)."""
+        """GET /v1/models — caches ``max_len`` (request bounds),
+        ``model_ids`` (model-aware routing: the ids this host serves,
+        adapters included), and ``ckpt`` (the checkpoint the host
+        reports serving — the rollout controller's rollback anchor)."""
         doc = self._call_json(
             "GET", "/v1/models", None, self.cfg.probe_timeout_s
         )
+        ids = []
         for m in doc.get("data", ()):
+            if isinstance(m.get("id"), str) and m["id"]:
+                ids.append(m["id"])
             if m.get("max_len"):
                 self.max_len = int(m["max_len"])
-                break
+            if m.get("ckpt"):
+                self.ckpt = str(m["ckpt"])
+        if ids:
+            self.model_ids = ids
         return doc
+
+    def reload(self, ckpt: str,
+               timeout_s: Optional[float] = None) -> dict:
+        """POST /reloadz {"ckpt": ...} — hot-swap this backend's
+        serving weights from a checkpoint path visible to the BACKEND
+        host. Uses the stream read budget by default (a whole
+        checkpoint loads inside this call). A 5xx means the backend
+        REFUSED the swap (torn/corrupt checkpoint, structure mismatch)
+        and still serves its old weights — the rollout controller stops
+        there instead of marching a bad artifact across the fleet."""
+        return self._call_json(
+            "POST", "/reloadz", {"ckpt": str(ckpt)},
+            timeout_s if timeout_s is not None
+            else self.cfg.read_timeout_s,
+        )
 
     def metrics_text(self) -> str:
         """GET /metrics — raw Prometheus text pass-through (operators

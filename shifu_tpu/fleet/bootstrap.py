@@ -108,17 +108,34 @@ def wait_ready(
 class FleetProber(threading.Thread):
     """Periodic re-probe of dead/unknown backends (daemon thread).
 
-    Healthy backends are probed too — at the same cadence — so the
-    cached queue-depth/health the router balances on stays fresh; but
-    the loop's REASON to exist is the dead ones: a breaker-open
-    backend's probe is exactly the breaker's half-open trial, so a
-    recovered host rejoins the rotation within ``interval_s`` without
-    operator action (``backend_up`` flight event)."""
+    Healthy backends are probed every ``interval_s`` so the cached
+    queue-depth/health the router balances on stays fresh. A backend
+    whose probes keep FAILING backs off instead of being hammered every
+    interval — consecutive failures double its personal probe interval
+    up to ``backoff_max_mult``× (a long-dead host in a 2 s-interval
+    fleet costs one timed-out connect every 16 s, not every 2) — with
+    one deliberate exception: when the backend's circuit breaker has
+    finished its cooldown, the probe fires ON SCHEDULE regardless of
+    backoff, because that probe IS the breaker's half-open trial and
+    delaying it would delay the host's re-admission
+    (``CircuitBreaker.cooldown_remaining``; fake-clock-tested in
+    tests/test_rollout.py). A success resets the backoff, and the
+    recovered host rejoins the rotation within one interval
+    (``backend_up`` flight event).
 
-    def __init__(self, router: FleetRouter, *, interval_s: float = 2.0):
+    ``tick()`` is one synchronous pass (clock-injectable — tests drive
+    the whole backoff walk without a thread or a sleep); ``run()`` just
+    calls it every ``interval_s``."""
+
+    def __init__(self, router: FleetRouter, *, interval_s: float = 2.0,
+                 backoff_max_mult: int = 8, clock=time.monotonic):
         super().__init__(name="shifu-fleet-prober", daemon=True)
         self.router = router
         self.interval_s = float(interval_s)
+        self.backoff_max_mult = max(1, int(backoff_max_mult))
+        self._clock = clock
+        self._fails: dict = {}      # addr -> consecutive probe failures
+        self._next_due: dict = {}   # addr -> earliest next probe time
         self._stop_ev = threading.Event()
 
     def stop(self, join_timeout_s: float = 5.0) -> None:
@@ -126,19 +143,56 @@ class FleetProber(threading.Thread):
         if self.is_alive():
             self.join(join_timeout_s)
 
+    def backoff_mult(self, addr: str) -> int:
+        """The current interval multiplier for ``addr`` (1 = healthy
+        cadence; doubles per consecutive failure, capped)."""
+        return min(
+            2 ** self._fails.get(addr, 0), self.backoff_max_mult
+        )
+
+    def _due(self, b, now: float) -> bool:
+        if now >= self._next_due.get(b.addr, 0.0):
+            return True
+        # Backed off, but the breaker's half-open trial is due: probe
+        # anyway — backoff must never postpone re-admission.
+        from shifu_tpu.fleet.backend import CircuitBreaker
+
+        return (
+            b.breaker.state == CircuitBreaker.OPEN
+            and b.breaker.cooldown_remaining() <= 0.0
+        )
+
+    def tick(self) -> None:
+        """One probe pass over the roster (skips detached backends and
+        ones still inside their personal backoff window)."""
+        now = self._clock()
+        for b in self.router.backends:
+            if self._stop_ev.is_set():
+                return
+            if b.detached or not self._due(b, now):
+                continue
+            try:
+                self.router.probe_backend(b)
+            except BackendError:
+                self._fails[b.addr] = self._fails.get(b.addr, 0) + 1
+                self._next_due[b.addr] = (
+                    now + self.interval_s * self.backoff_mult(b.addr)
+                )
+                continue
+            self._fails[b.addr] = 0
+            self._next_due[b.addr] = now + self.interval_s
+            # Refresh /v1/models alongside /healthz: model ids and the
+            # served-ckpt field change underneath a live router (weight
+            # rollouts, operators repointing a host), and model-aware
+            # routing + the /statz roster must track them.
+            try:
+                b.models()
+            except BackendError:
+                pass  # healthz answered; models stay stale
+
     def run(self) -> None:
         while not self._stop_ev.wait(self.interval_s):
-            for b in self.router.backends:
-                if self._stop_ev.is_set():
-                    return
-                if b.detached:
-                    continue
-                try:
-                    self.router.probe_backend(b)
-                    if b.max_len is None:
-                        b.models()
-                except BackendError:
-                    continue
+            self.tick()
 
 
 def build_fleet(

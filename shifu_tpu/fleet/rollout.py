@@ -1,0 +1,393 @@
+"""Zero-downtime rolling weight rollout over a live serving fleet.
+
+The composition ROADMAP item 4 asked for: ``POST /drainz`` draining
+(PR 5), the engine server's new ``POST /reloadz`` hot-swap, the
+readiness gating ``fleet/bootstrap.py`` already does at startup, and
+the SLO watchdog's pooled p99 budgets — walked across the roster one
+``--max-unavailable`` wave at a time, while live traffic keeps flowing
+through the backends that are NOT in the current wave.
+
+Per backend the walk is::
+
+    drain (router stops routing new work; in-flight streams finish)
+      -> POST /reloadz {ckpt} (backend loads + verifies + swaps;
+         a torn/corrupt checkpoint 503s and the backend KEEPS its old
+         weights — the rollout halts instead of marching a bad
+         artifact across the fleet)
+      -> readiness gate (/healthz healthy + /v1/models reporting the
+         target checkpoint, exactly like bootstrap's startup gate)
+      -> resume (router routes to it again)
+
+Between waves the controller reads the router's SLO watchdog verdict
+(the same pooled p99 TTFT/ITL budgets that guard normal traffic). A
+budget breach PAUSES the wave — the fleet keeps serving on however
+many backends are already updated — until the verdict clears or
+``pause_timeout_s`` expires; with ``abort_on_slo`` a breach instead
+rolls every already-updated backend back to the checkpoint it reported
+before its swap (drain -> reload(prev) -> gate -> resume, newest
+first).
+
+The controller talks to the LIVE router through its HTTP admin surface
+(:class:`RouterAdmin`: ``/statz`` for the roster + watchdog verdict,
+``/drainz`` with ``detach:false``/``resume:true``, ``/rolloutz`` to
+record progress on the router's metrics/flight/statz) and to each
+backend directly (``/reloadz``, ``/healthz``, ``/v1/models`` via
+:class:`~shifu_tpu.fleet.backend.BackendClient`) — the same split a
+human operator would drive with curl. ``admin`` and ``make_backend``
+are injectable, so tests walk every pause/abort/rollback path with
+fakes and no sockets (tests/test_rollout.py) and the two-process
+harness drives the real wire (tests/test_fleet_rollout.py).
+
+CLI: ``shifu_tpu fleet rollout --ckpt PATH --router URL
+[--max-unavailable 1] [--abort-on-slo]``.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.request
+from typing import Callable, List, Optional, Tuple
+
+from shifu_tpu.fleet.backend import BackendClient, BackendError
+
+
+class RolloutError(RuntimeError):
+    """The rollout could not proceed (drain stuck, reload refused,
+    readiness gate timed out, SLO paused past its budget...). The
+    fleet is left SERVING — every backend the controller touched was
+    resumed on whatever weights it holds — but possibly mixed-version;
+    the report names which backends run what."""
+
+
+class RouterAdmin:
+    """The live router's HTTP admin surface, as the rollout controller
+    consumes it. One instance per rollout; stateless between calls."""
+
+    def __init__(self, url: str, *, timeout_s: float = 30.0):
+        self.url = url.rstrip("/")
+        self.timeout_s = float(timeout_s)
+
+    # ------------------------------------------------------------ wire
+    def _call(self, method: str, path: str, body: Optional[dict] = None):
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            self.url + path, data=data, method=method,
+            headers={"Content-Type": "application/json"} if data else {},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+                return json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            try:
+                msg = json.loads(e.read()).get("error", str(e))
+            except Exception:
+                msg = str(e)
+            raise RolloutError(
+                f"router {method} {path} -> {e.code}: {msg}"
+            ) from e
+        except (OSError, ValueError) as e:
+            raise RolloutError(
+                f"router {method} {path} unreachable: {e!r}"
+            ) from e
+
+    # --------------------------------------------------------- surface
+    def statz(self) -> dict:
+        return self._call("GET", "/statz")
+
+    def backends(self) -> List[dict]:
+        """The roster rows from the router's /statz fleet block."""
+        fleet = self.statz().get("fleet")
+        if not fleet or "backends" not in fleet:
+            raise RolloutError(
+                f"{self.url} serves no fleet block on /statz — is it a "
+                "fleet router (`serve --fleet`)?"
+            )
+        return fleet["backends"]
+
+    def fleet_row(self, addr: str) -> dict:
+        row = next(
+            (r for r in self.backends() if r.get("backend") == addr),
+            None,
+        )
+        if row is None:
+            raise RolloutError(f"backend {addr} left the router roster")
+        return row
+
+    def slo(self) -> dict:
+        """The watchdog verdict ({"status", "reasons"}) — the rollout's
+        automatic brake."""
+        return self.statz().get(
+            "watchdog", {"status": "ok", "reasons": []}
+        )
+
+    def drain(self, addr: str) -> dict:
+        return self._call(
+            "POST", "/drainz", {"backend": addr, "detach": False}
+        )
+
+    def resume(self, addr: str) -> dict:
+        return self._call(
+            "POST", "/drainz", {"backend": addr, "resume": True}
+        )
+
+    def note(self, event: str, **fields) -> None:
+        self._call("POST", "/rolloutz", {"event": event, **fields})
+
+
+class RolloutController:
+    """Walk a roster through a rolling weight swap; see module
+    docstring. ``run()`` returns the report dict (status complete /
+    failed / aborted, the per-backend outcomes) and raises
+    :class:`RolloutError` only for errors the report cannot express
+    (e.g. an unreachable router before anything started)."""
+
+    def __init__(
+        self,
+        admin: RouterAdmin,
+        ckpt: str,
+        *,
+        max_unavailable: int = 1,
+        abort_on_slo: bool = False,
+        make_backend: Callable[[str], BackendClient] = BackendClient,
+        drain_timeout_s: float = 120.0,
+        ready_timeout_s: float = 60.0,
+        pause_timeout_s: float = 300.0,
+        poll_s: float = 0.1,
+        clock=time.monotonic,
+        sleep=time.sleep,
+    ):
+        if max_unavailable < 1:
+            raise ValueError(
+                f"max_unavailable must be >= 1, got {max_unavailable}"
+            )
+        self.admin = admin
+        self.ckpt = str(ckpt)
+        self.max_unavailable = int(max_unavailable)
+        self.abort_on_slo = bool(abort_on_slo)
+        self.make_backend = make_backend
+        self.drain_timeout_s = float(drain_timeout_s)
+        self.ready_timeout_s = float(ready_timeout_s)
+        self.pause_timeout_s = float(pause_timeout_s)
+        self.poll_s = float(poll_s)
+        self._clock = clock
+        self._sleep = sleep
+        # (addr, previous-ckpt-or-None), in update order — the
+        # rollback ledger.
+        self.updated: List[Tuple[str, Optional[str]]] = []
+        self.paused = 0
+
+    # ------------------------------------------------------------- run
+    def run(self) -> dict:
+        rows = [
+            r for r in self.admin.backends()
+            if r.get("status") != "detached"
+        ]
+        addrs = [r["backend"] for r in rows]
+        if not addrs:
+            raise RolloutError("roster has no attached backends")
+        self.admin.note("begin", ckpt=self.ckpt, backends=len(addrs))
+        waves = [
+            addrs[i:i + self.max_unavailable]
+            for i in range(0, len(addrs), self.max_unavailable)
+        ]
+        try:
+            for wave in waves:
+                brake = self._slo_brake()
+                if brake is not None:
+                    return self._abort(brake)
+                self.admin.note("wave_start", backends=wave)
+                drained: List[str] = []
+                try:
+                    for addr in wave:
+                        self.admin.drain(addr)
+                        drained.append(addr)
+                    for addr in wave:
+                        self._update_one(addr)
+                finally:
+                    # Whatever happened, nothing in this wave stays
+                    # silently drained: _update_one resumes on its own
+                    # paths; this catches drain-phase failures.
+                    for addr in drained:
+                        self._resume_quietly(addr)
+        except RolloutError as e:
+            self.admin.note("failed", error=str(e))
+            return self._report("failed", error=str(e))
+        self.admin.note("end", updated=len(self.updated))
+        return self._report("complete")
+
+    # ---------------------------------------------------- wave pieces
+    def _update_one(self, addr: str) -> None:
+        """drain already done; wait idle -> reload -> gate -> resume.
+        Raises RolloutError with the backend resumed (old weights) on
+        any failure."""
+        self._wait_drained(addr)
+        b = self.make_backend(addr)
+        prev = self._backend_ckpt(b)
+        try:
+            b.reload(self.ckpt)
+        except BackendError as e:
+            self._resume_quietly(addr)
+            self.admin.note(
+                "reload_failed", backend=addr, error=str(e),
+                status=e.status,
+            )
+            raise RolloutError(
+                f"backend {addr} refused the reload "
+                f"(status {e.status}): {e} — it still serves its old "
+                "weights; rollout halted"
+            ) from e
+        try:
+            self._gate_ready(addr, b)
+        except RolloutError:
+            self._resume_quietly(addr)
+            raise
+        self.admin.resume(addr)
+        self.updated.append((addr, prev))
+        self.admin.note("backend_updated", backend=addr, prev=prev)
+
+    def _wait_drained(self, addr: str) -> None:
+        deadline = self._clock() + self.drain_timeout_s
+        while True:
+            row = self.admin.fleet_row(addr)
+            if int(row.get("in_flight", 0)) == 0:
+                return
+            if self._clock() >= deadline:
+                self._resume_quietly(addr)
+                raise RolloutError(
+                    f"backend {addr} still has {row['in_flight']} "
+                    f"in-flight streams after {self.drain_timeout_s:g}s "
+                    "drain; resumed on old weights"
+                )
+            self._sleep(self.poll_s)
+
+    def _backend_ckpt(self, b: BackendClient) -> Optional[str]:
+        """The checkpoint the backend reports serving (rollback
+        anchor); None when the backend predates ckpt reporting or was
+        started without --ckpt-dir (rollback then skips it, loudly)."""
+        try:
+            b.models()
+        except BackendError:
+            return None
+        return b.ckpt
+
+    def _gate_ready(self, addr: str, b: BackendClient) -> None:
+        """bootstrap-style readiness gate: /healthz healthy AND
+        /v1/models reporting the target checkpoint (when the backend
+        reports ckpts at all)."""
+        deadline = self._clock() + self.ready_timeout_s
+        last_err = "no probe yet"
+        while self._clock() < deadline:
+            try:
+                doc = b.probe()
+                b.models()
+            except BackendError as e:
+                last_err = str(e)
+                self._sleep(self.poll_s)
+                continue
+            if not doc.get("healthy", False):
+                last_err = f"unhealthy: {doc.get('status')}"
+            elif b.ckpt is not None and b.ckpt != self.ckpt:
+                last_err = (
+                    f"still reports ckpt {b.ckpt!r} != {self.ckpt!r}"
+                )
+            else:
+                return
+            self._sleep(self.poll_s)
+        raise RolloutError(
+            f"backend {addr} failed the post-reload readiness gate "
+            f"after {self.ready_timeout_s:g}s ({last_err})"
+        )
+
+    def _resume_quietly(self, addr: str) -> None:
+        """Resume without letting a resume failure mask the original
+        error (the router may have detached it meanwhile)."""
+        try:
+            self.admin.resume(addr)
+        except RolloutError:
+            pass
+
+    # -------------------------------------------------------- braking
+    def _slo_brake(self) -> Optional[List[str]]:
+        """None when the wave may proceed. On a breach: pause until the
+        verdict clears (returns None) or ``pause_timeout_s`` expires /
+        ``abort_on_slo`` is set (returns the breach reasons — the
+        caller aborts/rolls back)."""
+        verdict = self.admin.slo()
+        if verdict.get("status") != "degraded":
+            return None
+        reasons = list(verdict.get("reasons", ()))
+        self.paused += 1
+        self.admin.note("pause", reasons=reasons)
+        if self.abort_on_slo:
+            return reasons or ["SLO degraded"]
+        deadline = self._clock() + self.pause_timeout_s
+        while self._clock() < deadline:
+            self._sleep(self.poll_s)
+            verdict = self.admin.slo()
+            if verdict.get("status") != "degraded":
+                self.admin.note("unpause")
+                return None
+            reasons = list(verdict.get("reasons", ())) or reasons
+        raise RolloutError(
+            "SLO budgets still breached after "
+            f"{self.pause_timeout_s:g}s pause: {reasons}"
+        )
+
+    def _abort(self, reasons: List[str]) -> dict:
+        """Roll every already-updated backend back to its previous
+        checkpoint (newest first), then report aborted."""
+        self.admin.note(
+            "rollback_started", reasons=reasons,
+            backends=[a for a, _ in self.updated],
+        )
+        rolled, skipped = [], []
+        for addr, prev in reversed(self.updated):
+            if prev is None:
+                skipped.append(addr)
+                continue
+            try:
+                self.admin.drain(addr)
+                self._wait_drained(addr)
+                b = self.make_backend(addr)
+                b.reload(prev)
+                self._gate_ready_prev(addr, b, prev)
+                rolled.append(addr)
+                self.admin.note("rollback_backend", backend=addr,
+                                ckpt=prev)
+            except (RolloutError, BackendError) as e:
+                skipped.append(addr)
+                self.admin.note(
+                    "reload_failed", backend=addr, error=str(e)
+                )
+            finally:
+                self._resume_quietly(addr)
+        self.admin.note("abort", reasons=reasons, rolled_back=rolled)
+        return self._report(
+            "aborted", reasons=reasons, rolled_back=rolled,
+            rollback_skipped=skipped,
+        )
+
+    def _gate_ready_prev(self, addr: str, b: BackendClient,
+                         prev: str) -> None:
+        """Readiness gate against the ROLLBACK target."""
+        save = self.ckpt
+        self.ckpt = prev
+        try:
+            self._gate_ready(addr, b)
+        finally:
+            self.ckpt = save
+
+    # --------------------------------------------------------- report
+    def _report(self, status: str, **extra) -> dict:
+        out = {
+            "status": status,
+            "ckpt": self.ckpt,
+            "updated": [a for a, _ in self.updated],
+            "previous": {a: p for a, p in self.updated},
+            "max_unavailable": self.max_unavailable,
+            "paused": self.paused,
+        }
+        out.update(extra)
+        return out

@@ -57,7 +57,11 @@ from shifu_tpu.fleet.backend import (
     FleetUnavailable,
     RetryPolicy,
 )
-from shifu_tpu.infer.engine import Completion, LiveRequest
+from shifu_tpu.infer.engine import (
+    Completion,
+    LiveRequest,
+    UnknownModelError,
+)
 from shifu_tpu.infer.sampling import SampleConfig
 
 _SAMPLING_FIELDS = (
@@ -71,9 +75,10 @@ class _FleetRequest:
     streaming surface aliases these), cancel flag, and the stream the
     worker currently holds (closed to cancel remotely)."""
 
-    def __init__(self, rid: int, body: dict):
+    def __init__(self, rid: int, body: dict, model: Optional[str] = None):
         self.rid = rid
         self.body = body
+        self.model = model             # route only to backends serving it
         self.generated: List[int] = []
         self.logprobs: List[float] = []
         self.streamed = False          # first delta arrived
@@ -197,6 +202,30 @@ class FleetRouter:
             "shifu_fleet_probe_seconds",
             "Backend /healthz scrape latency", labelnames=("backend",),
         )
+        # shifu_rollout_* families: rolling-weight-rollout progress as
+        # reported by the rollout controller via POST /rolloutz
+        # (rollout_note). The controller may be a separate process —
+        # these series live HERE so one /metrics scrape shows traffic
+        # AND the rollout moving through it.
+        self._c_rollout_events = reg.counter(
+            "shifu_rollout_events_total",
+            "Rollout lifecycle events recorded via /rolloutz",
+            labelnames=("event",),
+        )
+        self._g_rollout_active = reg.gauge(
+            "shifu_rollout_active",
+            "1 while a rolling weight rollout is in progress "
+            "(paused counts as in progress)",
+        ).labels()
+        self._g_rollout_updated = reg.gauge(
+            "shifu_rollout_backends_updated",
+            "Backends already serving the rollout's target checkpoint",
+        ).labels()
+        self._g_rollout_paused = reg.gauge(
+            "shifu_rollout_paused",
+            "1 while the rollout wave is paused on an SLO breach",
+        ).labels()
+        self._rollout: Optional[dict] = None  # /statz rollout block
         self._g_budget.set(self.policy.budget)
         for b in self.backends:
             self._wire_backend(b)
@@ -240,15 +269,21 @@ class FleetRouter:
             )
 
     # ---------------------------------------------------------- routing
-    def _pick(self, exclude=()) -> Optional[BackendClient]:
+    def _pick(self, exclude=(),
+              model: Optional[str] = None) -> Optional[BackendClient]:
         """Least-loaded routable backend: fewest router-local in-flight
         requests, then shallowest remote queue (last probe), then
-        lowest index (deterministic). Consults ``breaker.allow()`` LAST
+        lowest index (deterministic). ``model`` restricts to backends
+        whose ``/v1/models`` listed that id (model-aware routing — the
+        multi-tenant tier); unknown-model rejection happens at
+        :meth:`submit`, so None here means "serving subset currently
+        unavailable" (503), not 404. Consults ``breaker.allow()`` LAST
         and only on the winner-candidates, since allow() consumes the
         half-open probe slot."""
         order = sorted(
             (b for b in self.backends
-             if b.routable() and b.addr not in exclude),
+             if b.routable() and b.addr not in exclude
+             and (model is None or model in (b.model_ids or ()))),
             key=lambda b: (b.in_flight, b.queue_depth(),
                            self.backends.index(b)),
         )
@@ -261,12 +296,34 @@ class FleetRouter:
                sampling: Optional[SampleConfig] = None,
                stop_token_ids=None, stop_strings=None,
                logit_bias=None, allowed_token_ids=None, adapter=None,
-               regex=None, json_schema=None, **kw) -> int:
+               regex=None, json_schema=None, model=None, **kw) -> int:
         """Route one request (engine-thread call — no HTTP here).
         Raises :class:`FleetUnavailable` when no backend is routable,
-        so a fully-down fleet fails fast instead of queueing forever."""
+        so a fully-down fleet fails fast instead of queueing forever.
+
+        ``model``: model-aware routing. A named model routes
+        least-loaded among the backends whose ``/v1/models`` listed it;
+        an id NO roster backend (up, down, or draining) serves raises
+        :class:`UnknownModelError` (-> 404 — the fleet is a multi-model
+        tier and a typo'd id must not queue forever). None routes
+        fleet-wide, and when no backend has reported its models yet the
+        name is ignored rather than 404ing the whole fleet on a stale
+        roster."""
         if kw:
             raise ValueError(f"unsupported submit fields: {sorted(kw)}")
+        if model is not None:
+            model = str(model)
+            known = {
+                m for b in self.backends
+                for m in (b.model_ids or ())
+            }
+            if known and model not in known:
+                raise UnknownModelError(
+                    f"model {model!r} is not served by this fleet "
+                    f"(served: {sorted(known)})"
+                )
+            if not known:
+                model = None  # roster models unknown: route fleet-wide
         toks = [int(t) for t in prompt_tokens]
         if not toks:
             raise ValueError("empty prompt")
@@ -296,15 +353,16 @@ class FleetRouter:
         if json_schema is not None:
             body["json_schema"] = json_schema
 
-        if self._pick() is None:
+        if self._pick(model=model) is None:
             raise FleetUnavailable(
-                "no routable fleet backend (all down/draining)",
+                "no routable fleet backend (all down/draining)"
+                + (f" for model {model!r}" if model is not None else ""),
                 retry_after_s=max(1.0, self.policy.cap_s),
             )
         with self._lock:
             rid = self._rid
             self._rid += 1
-            req = _FleetRequest(rid, body)
+            req = _FleetRequest(rid, body, model=model)
             self._reqs[rid] = req
         threading.Thread(
             target=self._route_one, args=(req,),
@@ -341,10 +399,12 @@ class FleetRouter:
             if req.cancelled:
                 self._finish(req, None, None)
                 return
-            b = self._pick()
+            b = self._pick(model=req.model)
             if b is None:
                 self._finish(req, None, FleetUnavailable(
-                    "no routable fleet backend (all down/draining)",
+                    "no routable fleet backend (all down/draining)"
+                    + (f" for model {req.model!r}"
+                       if req.model is not None else ""),
                     retry_after_s=max(1.0, self.policy.cap_s),
                 ))
                 return
@@ -592,6 +652,43 @@ class FleetRouter:
             "router holds no params"
         )
 
+    def reload_params(self, params) -> None:
+        raise ValueError(
+            "the fleet router holds no params; hot-swap weights on the "
+            "backend hosts (POST /reloadz per host, or drive the whole "
+            "fleet with `shifu_tpu fleet rollout`)"
+        )
+
+    # ------------------------------------------------- model routing
+    def served_models(self) -> dict:
+        """The multi-tenant roster: {model_id: {"backends": [...],
+        "max_len": min-across-them, "ckpts": [...]}} aggregated from
+        each attached backend's last ``/v1/models``. The serving
+        front-end renders this as the router's own ``/v1/models`` and
+        404s requests naming an id absent here. Mixed ``ckpts`` mid-
+        rollout is the expected transient — the /statz reader SEES the
+        fleet straddling two versions."""
+        out: dict = {}
+        for b in self.backends:
+            if b.detached or not b.model_ids:
+                continue
+            for mid in b.model_ids:
+                ent = out.setdefault(
+                    mid, {"backends": [], "max_len": None, "ckpts": []}
+                )
+                ent["backends"].append(b.addr)
+                if b.max_len is not None:
+                    ent["max_len"] = (
+                        b.max_len if ent["max_len"] is None
+                        else min(ent["max_len"], b.max_len)
+                    )
+                if b.ckpt and b.ckpt not in ent["ckpts"]:
+                    ent["ckpts"].append(b.ckpt)
+        for ent in out.values():
+            ent["backends"].sort()
+            ent["ckpts"].sort()
+        return out
+
     @property
     def n_adapters(self) -> int:
         vals = []
@@ -706,11 +803,7 @@ class FleetRouter:
             "resubmissions": self.resubmissions,
         }
 
-    def drain(self, target: str) -> dict:
-        """``POST /drainz``: stop routing NEW work to ``target``
-        (``host:port``), let its in-flight streams finish, then detach
-        it. Returns immediately with the in-flight count; a daemon
-        thread performs the wait-and-detach (poll, no backend calls)."""
+    def _backend(self, target: str) -> BackendClient:
         b = next(
             (x for x in self.backends if x.addr == str(target)), None
         )
@@ -719,6 +812,18 @@ class FleetRouter:
                 f"unknown backend {target!r} (roster: "
                 f"{[x.addr for x in self.backends]})"
             )
+        return b
+
+    def drain(self, target: str, detach: bool = True) -> dict:
+        """``POST /drainz``: stop routing NEW work to ``target``
+        (``host:port``) and let its in-flight streams finish. With
+        ``detach=True`` (the operator-removal default) a daemon thread
+        then detaches it permanently; ``detach=False`` is the ROLLING-
+        UPDATE form — the backend stays in the roster, drained, until
+        :meth:`resume` re-admits it (the rollout controller's
+        drain -> reload -> readiness-gate -> resume walk). Returns
+        immediately with the in-flight count."""
+        b = self._backend(target)
         if b.detached:
             raise ValueError(f"backend {target!r} is already detached")
         already = b.draining
@@ -726,8 +831,11 @@ class FleetRouter:
         self._g_up.labels(backend=b.addr).set(0.0)
         if not already:
             self.flight.record(
-                "backend_draining", backend=b.addr, in_flight=b.in_flight
+                "backend_draining", backend=b.addr,
+                in_flight=b.in_flight, detach=bool(detach),
             )
+        if detach and not getattr(b, "_detach_watch", False):
+            b._detach_watch = True
             threading.Thread(
                 target=self._drain_watch, args=(b,),
                 name=f"shifu-fleet-drain-{b.addr}", daemon=True,
@@ -736,10 +844,101 @@ class FleetRouter:
             "draining": b.addr,
             "in_flight": b.in_flight,
             "already_draining": already,
+            "detach": bool(detach),
         }
 
+    def resume(self, target: str) -> dict:
+        """Un-drain ``target`` (the ``POST /drainz {"resume": true}``
+        admin verb): new work routes there again. The inverse of
+        ``drain(detach=False)``; a DETACHED backend cannot resume —
+        re-attach by restarting the router with it in the roster."""
+        b = self._backend(target)
+        if b.detached:
+            raise ValueError(
+                f"backend {target!r} is detached; resume only undoes a "
+                "non-detaching drain (restart the router to re-attach)"
+            )
+        was_draining = b.draining
+        b.draining = False
+        if b.routable() and b.breaker.state != CircuitBreaker.OPEN:
+            self._g_up.labels(backend=b.addr).set(1.0)
+        if was_draining:
+            self.flight.record("backend_resumed", backend=b.addr)
+        return {"resumed": b.addr, "was_draining": was_draining}
+
     def _drain_watch(self, b: BackendClient) -> None:
-        while b.in_flight > 0:
+        while b.draining and b.in_flight > 0:
             self._sleep(self._drain_poll_s)
+        b._detach_watch = False
+        if not b.draining:
+            return  # resumed mid-watch: stay attached
         b.detached = True
         self.flight.record("backend_detached", backend=b.addr)
+
+    # ------------------------------------------------- rollout state
+    _ROLLOUT_EVENTS = frozenset({
+        "begin", "wave_start", "backend_updated", "pause", "unpause",
+        "reload_failed", "rollback_started", "rollback_backend",
+        "abort", "end", "failed",
+    })
+
+    def rollout_note(self, event: str, **fields) -> dict:
+        """Record one rollout lifecycle event (the ``POST /rolloutz``
+        admin verb — the rollout controller, possibly a separate
+        process, reports its walk here so the router's /metrics,
+        /statz, and flight ring carry the rollout's progress alongside
+        the traffic it is steering around)."""
+        event = str(event)
+        if event not in self._ROLLOUT_EVENTS:
+            raise ValueError(
+                f"unknown rollout event {event!r} "
+                f"(known: {sorted(self._ROLLOUT_EVENTS)})"
+            )
+        with self._lock:
+            if event == "begin":
+                self._rollout = {
+                    "status": "running",
+                    "ckpt": fields.get("ckpt"),
+                    "backends": fields.get("backends"),
+                    "updated": [],
+                    "rolled_back": [],
+                    "paused_reasons": [],
+                    "events": 0,
+                }
+            r = self._rollout
+            if r is None:
+                raise ValueError(
+                    f"rollout event {event!r} before 'begin'"
+                )
+            r["events"] += 1
+            if event == "backend_updated" and fields.get("backend"):
+                r["updated"].append(fields["backend"])
+            elif event == "rollback_backend" and fields.get("backend"):
+                r["rolled_back"].append(fields["backend"])
+            elif event == "pause":
+                r["status"] = "paused"
+                r["paused_reasons"] = list(fields.get("reasons", ()))
+            elif event == "unpause":
+                r["status"] = "running"
+            elif event == "abort":
+                r["status"] = "aborted"
+            elif event == "failed":
+                r["status"] = "failed"
+                r["error"] = fields.get("error")
+            elif event == "end":
+                r["status"] = "complete"
+            active = r["status"] in ("running", "paused")
+            n_updated = len(r["updated"])
+            paused = r["status"] == "paused"
+        self._c_rollout_events.labels(event=event).inc()
+        self._g_rollout_active.set(1.0 if active else 0.0)
+        self._g_rollout_updated.set(float(n_updated))
+        self._g_rollout_paused.set(1.0 if paused else 0.0)
+        self.flight.record("rollout_" + event, **fields)
+        return {"recorded": event}
+
+    def rollout_stats(self) -> Optional[dict]:
+        """The /statz rollout block: the current/last rollout's state
+        document, or None before any rollout touched this router."""
+        with self._lock:
+            return dict(self._rollout) if self._rollout else None

@@ -174,7 +174,22 @@ ENGINE_INTERFACE = frozenset({
     # admin verb. In-process engines answer trivially ({} / [] / None /
     # refuse) — the FleetRouter implements them for real.
     "failures", "health_reasons", "fleet_stats", "drain",
+    # rolling-rollout surface (shifu_tpu/fleet/rollout.py):
+    # ``reload_params`` is the in-process hot-swap behind POST /reloadz
+    # (real on every engine class); ``resume`` un-drains a backend
+    # mid-rollout; ``served_models`` is the model-aware routing roster
+    # (None for single-model in-process engines); ``rollout_note`` /
+    # ``rollout_stats`` record a live rollout's state for /rolloutz and
+    # the /statz rollout block.
+    "reload_params", "resume", "served_models", "rollout_note",
+    "rollout_stats",
 })
+
+
+class UnknownModelError(ValueError):
+    """A request named a model no roster backend serves. The serving
+    front-end maps this onto ``404`` (model-aware fleet routing —
+    shifu_tpu/fleet/router.py); plain validation errors stay 400."""
 
 
 @dataclasses.dataclass(frozen=True)
@@ -495,8 +510,15 @@ class Engine:
         regex: Optional[str] = None,
         json_schema: Optional[dict] = None,
         constraint=None,
+        model: Optional[str] = None,
     ) -> int:
         """Queue one request; returns its rid.
+
+        ``model``: the OpenAI wire field, accepted for interface parity
+        with the fleet router (which routes by it and 404s unknown
+        ids); a single-model in-process engine serves whatever it
+        loaded and ignores the name, like every local OpenAI-compatible
+        server.
 
         ``stop_token_ids``: iterable of stop sequences — each entry an
         int (single-token stop) or a sequence of ints. On a match the
@@ -969,13 +991,81 @@ class Engine:
         """The /statz fleet block, or None when there is no fleet."""
         return None
 
-    def drain(self, target):
+    def drain(self, target, detach: bool = True):
         """``POST /drainz`` lands here; only a fleet router has
         drainable backends."""
         raise ValueError(
             "no drainable backends: this server fronts an in-process "
             "engine, not a fleet"
         )
+
+    def resume(self, target):
+        """``POST /drainz {"resume": true}`` — un-drain a backend
+        mid-rollout; only a fleet router has drainable backends."""
+        raise ValueError(
+            "no drainable backends: this server fronts an in-process "
+            "engine, not a fleet"
+        )
+
+    def served_models(self):
+        """Model-aware routing roster ({model_id: {...}}), or None for
+        a single-model in-process engine (requests' ``model`` field is
+        then accepted and ignored, the local-server convention)."""
+        return None
+
+    def rollout_note(self, event: str, **fields):
+        """``POST /rolloutz`` — a rollout controller reporting wave
+        progress; only a fleet router tracks rollouts."""
+        raise ValueError(
+            "no fleet: rollout state is tracked by the fleet router"
+        )
+
+    def rollout_stats(self):
+        """The /statz rollout block, or None when no rollout state
+        exists (in-process engines, routers with no rollout yet)."""
+        return None
+
+    def reload_params(self, params) -> None:
+        """Hot-swap the serving weights IN PLACE (``POST /reloadz``,
+        the rolling-rollout path). Must run on the engine thread
+        between steps — the runner's reload job does (infer/server.py).
+
+        ``params`` is a host (or device) tree with the SAME structure
+        as the current params; every leaf is cast to the live leaf's
+        dtype and placed onto its sharding, so the compiled programs
+        stay valid (no recompile, mesh engines re-shard in place). A
+        structure/shape mismatch raises ValueError and the engine keeps
+        the old weights — the caller surfaces it as a loud 503, never a
+        torn half-swap. Quantized engines refuse via the structure
+        check (their params are qtensor trees). Prefix caches are
+        flushed (cached pages hold K/V from the OLD weights); LoRA
+        adapters and a speculative engine's draft params are untouched
+        (draft/target drift only lowers acceptance — verify stays
+        authoritative)."""
+        old_struct = jax.tree_util.tree_structure(self.params)
+        new_struct = jax.tree_util.tree_structure(params)
+        if old_struct != new_struct:
+            raise ValueError(
+                "checkpoint params tree does not match the serving "
+                f"params (serving {old_struct}, checkpoint {new_struct})"
+                " — wrong model config, or a quantized engine (reload "
+                "unquantized hosts and re-quantize offline)"
+            )
+
+        def place(new, old):
+            arr = jnp.asarray(new, dtype=old.dtype)
+            if arr.shape != old.shape:
+                raise ValueError(
+                    f"checkpoint leaf shape {arr.shape} != serving "
+                    f"shape {old.shape}"
+                )
+            sh = getattr(old, "sharding", None)
+            return jax.device_put(arr, sh) if sh is not None else arr
+
+        self.params = jax.tree_util.tree_map(place, params, self.params)
+        flush = getattr(self, "flush_prefix_cache", None)
+        if flush is not None:
+            flush()
 
     def step(self) -> List[Completion]:
         """Admit queued requests into free slots, advance any chunked

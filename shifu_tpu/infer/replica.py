@@ -210,11 +210,39 @@ class ReplicatedEngine:
     def fleet_stats(self):
         return None
 
-    def drain(self, target):
+    def drain(self, target, detach: bool = True):
         raise ValueError(
             "no drainable backends: this server fronts in-process "
             "dp replicas, not a fleet"
         )
+
+    def resume(self, target):
+        raise ValueError(
+            "no drainable backends: this server fronts in-process "
+            "dp replicas, not a fleet"
+        )
+
+    def served_models(self):
+        """All replicas serve the same model — single-model surface
+        (requests' ``model`` field is accepted and ignored)."""
+        return None
+
+    def rollout_note(self, event: str, **fields):
+        raise ValueError(
+            "no fleet: rollout state is tracked by the fleet router"
+        )
+
+    def rollout_stats(self):
+        return None
+
+    def reload_params(self, params) -> None:
+        """Hot-swap serving weights on EVERY replica (each re-places
+        the tree onto its own sub-mesh via its live leaf shardings).
+        All-or-nothing per replica; replica 0's validation failure
+        aborts before any replica swapped."""
+        for e in self.engines:
+            e.reload_params(params)
+        self.params = self.engines[0].params
 
     @property
     def idle(self) -> bool:

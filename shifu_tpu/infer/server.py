@@ -52,12 +52,38 @@ one step.
     POST /drainz          {"backend": "host:port"} — fleet admin verb:
                           stop routing new work to that backend, let
                           its in-flight streams finish, then detach it.
-                          Only meaningful when this server fronts a
-                          FleetRouter (shifu_tpu/fleet); an in-process
-                          engine 400s. A fleet server's /statz also
-                          carries a per-backend "fleet" block and its
-                          /healthz names dead backends in
-                          degraded_reasons.
+                          {"detach": false} drains WITHOUT detaching
+                          (the rolling-update form) and
+                          {"resume": true} un-drains — the
+                          drain/reload/gate/resume walk `shifu_tpu
+                          fleet rollout` drives. Only meaningful when
+                          this server fronts a FleetRouter
+                          (shifu_tpu/fleet); an in-process engine
+                          400s. A fleet server's /statz also carries a
+                          per-backend "fleet" block and its /healthz
+                          names dead backends in degraded_reasons.
+    POST /reloadz         {"ckpt": PATH} — hot-swap this host's
+                          serving weights on the engine thread.
+                          Manifest checkpoints (checkpoint/
+                          checkpointer.py) are checksum-verified
+                          FIRST; a torn/corrupt artifact, missing
+                          path, or params-structure mismatch returns
+                          503 with the OLD weights still serving —
+                          never a half-swapped model. Success flushes
+                          the prefix cache and updates the "ckpt"
+                          /v1/models reports.
+    POST /rolloutz        {"event": ...} — the rollout controller
+                          recording wave progress on the ROUTER's
+                          metrics (shifu_rollout_*), flight ring
+                          (rollout_* events), and /statz "rollout"
+                          block. Fleet servers only.
+
+Model-aware routing: requests may carry the OpenAI "model" field. A
+fleet router routes them least-loaded among the backends whose
+/v1/models listed that id (the fleet as a multi-tenant tier — Gemma-2
+flash, MoE ep shards, Mamba behind one endpoint) and 404s ids no
+roster backend serves; single-model in-process engines accept and
+ignore the field, like any local OpenAI-compatible server.
 
 Sampling: engine-level by default (one compiled decode program). On an
 engine built with ``per_request_sampling=True``, requests may carry
@@ -119,7 +145,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from shifu_tpu import obs as _obs
-from shifu_tpu.infer.engine import Completion, Engine
+from shifu_tpu.infer.engine import Completion, Engine, UnknownModelError
 from shifu_tpu.infer.sampling import SampleConfig
 
 
@@ -445,6 +471,22 @@ class _Submission:
     adapter: Optional[int] = None
     regex: Optional[str] = None
     json_schema: Optional[dict] = None
+    model: Optional[str] = None
+
+
+@dataclasses.dataclass
+class _ReloadJob:
+    """A ``POST /reloadz`` weight hot-swap. Runs on the ENGINE thread
+    between steps (params swap while a decode program is in flight
+    would race the dispatch): load + verify the checkpoint, then
+    ``engine.reload_params`` — all-or-nothing, so a torn checkpoint or
+    a structure mismatch leaves the old weights serving and the caller
+    holding a loud error (503). The load blocks the engine loop for
+    its duration; a rolling rollout drains the backend first, so
+    nothing is decoding here anyway."""
+
+    ckpt: str
+    waiter: _Waiter
 
 
 def _make_embed_fn(model, pooling: str):
@@ -561,6 +603,17 @@ class EngineRunner:
             "shifu_detokenize_seconds",
             "Response assembly (detokenize + trim) per completion",
         ).labels()
+        self._c_reloads = self.metrics.counter(
+            "shifu_weight_reloads_total",
+            "POST /reloadz weight hot-swaps by outcome (a 'failed' "
+            "swap left the old weights serving)",
+            labelnames=("outcome",),
+        )
+        # The checkpoint this server reports serving (/v1/models
+        # "ckpt"): seeded by make_server(ckpt_path=...), updated on
+        # every successful /reloadz — the rollout controller's
+        # readiness gate and rollback anchor read it.
+        self.ckpt_path: Optional[str] = None
         self._cancels: collections.deque = collections.deque()  # rids
         self._waiters: dict = {}  # rid -> _Waiter
         # Compiled beam searchers, keyed (num_beams, max_new, penalty,
@@ -588,13 +641,14 @@ class EngineRunner:
         sampling: Optional[SampleConfig] = None,
         stop_token_ids=None, stop_strings=None,
         logit_bias=None, allowed_token_ids=None, adapter=None,
-        regex=None, json_schema=None,
+        regex=None, json_schema=None, model=None,
     ) -> Completion:
         return self.complete_n(
             tokens, max_new_tokens, 1, timeout=timeout, sampling=sampling,
             stop_token_ids=stop_token_ids, stop_strings=stop_strings,
             logit_bias=logit_bias, allowed_token_ids=allowed_token_ids,
             adapter=adapter, regex=regex, json_schema=json_schema,
+            model=model,
         )[0]
 
     def complete_n(
@@ -603,7 +657,7 @@ class EngineRunner:
         sampling: Optional[SampleConfig] = None,
         stop_token_ids=None, stop_strings=None,
         logit_bias=None, allowed_token_ids=None, adapter=None,
-        regex=None, json_schema=None,
+        regex=None, json_schema=None, model=None,
     ):
         """N independent completions of one prompt (the API's ``n``).
 
@@ -636,7 +690,7 @@ class EngineRunner:
                         logit_bias=logit_bias,
                         allowed_token_ids=allowed_token_ids,
                         adapter=adapter, regex=regex,
-                        json_schema=json_schema,
+                        json_schema=json_schema, model=model,
                     )
                 )
         self._g_inbox.set(len(self._inbox))
@@ -719,12 +773,35 @@ class EngineRunner:
             raise w.error
         return w.completion
 
+    def reload(self, ckpt: str, timeout: Optional[float] = None) -> dict:
+        """Hot-swap the engine's weights from ``ckpt`` (the POST
+        /reloadz verb). Blocks until the engine thread performed the
+        swap (or refused it — checkpoint corruption and structure
+        mismatches raise here with the OLD weights still serving)."""
+        w = _Waiter(threading.Event())
+        with self._lock:
+            if self.fatal is not None:
+                raise RuntimeError(
+                    f"engine thread died: {self.fatal!r}"
+                ) from self.fatal
+            if self._stop.is_set():
+                raise RuntimeError("engine runner is shut down")
+            self._inbox.append(_ReloadJob(str(ckpt), w))
+        self._g_inbox.set(len(self._inbox))
+        self._wake.set()
+        if not w.event.wait(timeout):
+            self._abandon(w)
+            raise TimeoutError(f"weight reload not done within {timeout}s")
+        if w.error is not None:
+            raise w.error
+        return w.completion
+
     def stream(self, tokens, max_new_tokens: int,
                timeout: Optional[float] = None,
                sampling: Optional[SampleConfig] = None,
                stop_token_ids=None, stop_strings=None,
                logit_bias=None, allowed_token_ids=None, adapter=None,
-               regex=None, json_schema=None):
+               regex=None, json_schema=None, model=None):
         """Returns a generator of ("delta", (ids, logprobs)) items
         ending with ("done", Completion); tokens arrive as the engine
         emits them (per decode chunk). The submission (and the
@@ -749,7 +826,7 @@ class EngineRunner:
                     logit_bias=logit_bias,
                     allowed_token_ids=allowed_token_ids,
                     adapter=adapter, regex=regex,
-                    json_schema=json_schema,
+                    json_schema=json_schema, model=model,
                 )
             )
         self._g_inbox.set(len(self._inbox))
@@ -968,16 +1045,50 @@ class EngineRunner:
         except Exception as e:
             job.waiter.fail(e)
 
+    def _run_reload(self, job: _ReloadJob) -> None:
+        """Load + verify + swap weights on the engine thread (see
+        _ReloadJob). Failures leave the old weights serving and reach
+        the caller via the waiter (the /reloadz handler maps corruption
+        onto a 503)."""
+        from shifu_tpu.checkpoint import load_serving_params
+
+        t0 = time.monotonic()
+        eng = self.engine
+        try:
+            params = load_serving_params(job.ckpt, eng.model)
+            eng.reload_params(params)
+        except Exception as e:
+            self._c_reloads.labels(outcome="failed").inc()
+            self.flight.record(
+                "reload_failed", ckpt=job.ckpt, error=repr(e),
+            )
+            job.waiter.fail(e)
+            return
+        dur_ms = (time.monotonic() - t0) * 1000.0
+        self.ckpt_path = job.ckpt
+        self._c_reloads.labels(outcome="ok").inc()
+        self.flight.record(
+            "weights_reloaded", ckpt=job.ckpt, dur_ms=round(dur_ms, 3),
+        )
+        job.waiter.complete({
+            "reloaded": job.ckpt, "dur_ms": round(dur_ms, 3),
+        })
+
     def _drain_inbox(self) -> None:
         while True:
             with self._lock:
                 if not self._inbox:
                     return
                 sub = self._inbox.popleft()
-                if not isinstance(sub, (_BeamJob, _EmbedJob)):
+                if not isinstance(
+                    sub, (_BeamJob, _EmbedJob, _ReloadJob)
+                ):
                     self._inflight = sub.waiter
                     self._inflight_abandoned = False
             self._g_inbox.set(len(self._inbox))
+            if isinstance(sub, _ReloadJob):
+                self._run_reload(sub)
+                continue
             if isinstance(sub, _EmbedJob):
                 self._run_embed(sub)
                 continue
@@ -995,7 +1106,7 @@ class EngineRunner:
                     logit_bias=sub.logit_bias,
                     allowed_token_ids=sub.allowed_token_ids,
                     adapter=sub.adapter, regex=sub.regex,
-                    json_schema=sub.json_schema,
+                    json_schema=sub.json_schema, model=sub.model,
                 )
             except Exception as e:  # validation error -> the caller
                 with self._lock:
@@ -1125,6 +1236,9 @@ class _Handler(BaseHTTPRequestHandler):
     tokenizer = None
     default_max_new: int = 128
     request_timeout_s: Optional[float] = None
+    # Operator-chosen model id for /v1/models (multi-model fleets route
+    # by it); None falls back to the model class name.
+    model_id: Optional[str] = None
     # Probed once per server (set on the per-server BoundHandler
     # subclass; a benign race — concurrent probes compute the same
     # value): does apply_chat_template accept a tools kwarg, and does
@@ -1223,17 +1337,49 @@ class _Handler(BaseHTTPRequestHandler):
             fleet = eng.fleet_stats()
             if fleet is not None:
                 out["fleet"] = fleet
+            # Rollout block (ENGINE_INTERFACE "rollout_stats"): the
+            # current/last rolling weight rollout's state as recorded
+            # via POST /rolloutz — status, target ckpt, backends
+            # updated so far, pause reasons. None (no rollout ever)
+            # omits the block.
+            roll = eng.rollout_stats()
+            if roll is not None:
+                out["rollout"] = roll
             self._send(200, out)
         elif self.path == "/v1/models":
             eng = self.runner.engine
+            served = eng.served_models()
+            if served is not None:
+                # Fleet router: the multi-tenant roster — one row per
+                # model id, naming the backends serving it and the
+                # checkpoint version(s) they report (mixed mid-rollout
+                # is the expected transient).
+                data = [
+                    {
+                        "id": mid,
+                        "object": "model",
+                        "backends": info.get("backends"),
+                        "max_len": info.get("max_len"),
+                        "ckpts": info.get("ckpts"),
+                    }
+                    for mid, info in sorted(served.items())
+                ]
+                self._send(200, {"object": "list", "data": data})
+                return
             cfg = getattr(eng.model, "cfg", None)
             base = {
-                "id": type(eng.model).__name__.lower(),
+                "id": self.model_id
+                or type(eng.model).__name__.lower(),
                 "object": "model",
                 "engine": type(eng).__name__,
                 "vocab_size": getattr(cfg, "vocab_size", None),
                 "max_len": eng.max_len,
             }
+            if self.runner.ckpt_path:
+                # The checkpoint this host serves (seeded by the CLI's
+                # --ckpt-dir, updated by /reloadz) — the rollout
+                # controller's readiness gate and rollback anchor.
+                base["ckpt"] = self.runner.ckpt_path
             data = [base]
             # Registered LoRA adapters serve as addressable "models"
             # (picked per request via the "adapter" field).
@@ -1256,6 +1402,10 @@ class _Handler(BaseHTTPRequestHandler):
             self._handle_embeddings()
         elif self.path == "/drainz":
             self._handle_drain()
+        elif self.path == "/reloadz":
+            self._handle_reload()
+        elif self.path == "/rolloutz":
+            self._handle_rollout_note()
         else:
             self._send(404, {"error": f"no route {self.path}"})
 
@@ -1263,7 +1413,10 @@ class _Handler(BaseHTTPRequestHandler):
         """POST /drainz {"backend": "host:port"} — the fleet admin
         verb: stop routing new work to that backend, let in-flight
         streams finish, then detach it (ENGINE_INTERFACE "drain"; a
-        non-fleet server 400s with its refusal)."""
+        non-fleet server 400s with its refusal). Rolling-update forms:
+        ``"detach": false`` drains WITHOUT detaching (the backend stays
+        in the roster for the reload + re-admit walk) and
+        ``"resume": true`` un-drains it (ENGINE_INTERFACE "resume")."""
         try:
             length = int(self.headers.get("Content-Length", 0))
             req = json.loads(self.rfile.read(length) or b"{}")
@@ -1277,8 +1430,82 @@ class _Handler(BaseHTTPRequestHandler):
             )
             return
         try:
-            out = self.runner.engine.drain(target)
+            if req.get("resume"):
+                out = self.runner.engine.resume(target)
+            else:
+                out = self.runner.engine.drain(
+                    target, detach=bool(req.get("detach", True))
+                )
         except ValueError as e:
+            self._send(400, {"error": str(e)})
+            return
+        self._send(200, out)
+
+    def _handle_reload(self):
+        """POST /reloadz {"ckpt": PATH} — hot-swap the serving weights
+        from a checkpoint path visible to THIS host. The swap happens
+        on the engine thread (EngineRunner.reload); manifest
+        checkpoints are checksum-verified first, and ANY failure —
+        torn/truncated/corrupt artifact, missing path, params-structure
+        mismatch — returns 503 with the engine still serving its OLD
+        weights (the rollout controller's signal to halt). Success
+        flushes the prefix cache (cached K/V belongs to the old
+        weights) and updates the ckpt this server reports on
+        /v1/models."""
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            req = json.loads(self.rfile.read(length) or b"{}")
+        except (ValueError, json.JSONDecodeError):
+            self._send(400, {"error": "body must be JSON"})
+            return
+        ckpt = req.get("ckpt")
+        if not isinstance(ckpt, str) or not ckpt:
+            self._send(400, {"error": 'reloadz needs {"ckpt": PATH}'})
+            return
+        from shifu_tpu.checkpoint import CheckpointCorruptError
+
+        try:
+            out = self.runner.reload(ckpt, timeout=self.request_timeout_s)
+        except CheckpointCorruptError as e:
+            self._send(503, {
+                "error": f"checkpoint rejected: {e}",
+                "reloaded": False,
+            })
+            return
+        except (FileNotFoundError, OSError, ValueError) as e:
+            # Missing path / unreadable dir / structure mismatch: the
+            # backend keeps its weights; 503 tells the controller this
+            # host did NOT take the new version (a 400 would read as
+            # "request malformed, maybe retry elsewhere").
+            self._send(503, {"error": str(e), "reloaded": False})
+            return
+        except TimeoutError as e:
+            self._send(504, {"error": str(e)})
+            return
+        except RuntimeError as e:
+            self._send(503, {"error": str(e)},
+                       headers=self._unavailable_headers(e))
+            return
+        self._send(200, out)
+
+    def _handle_rollout_note(self):
+        """POST /rolloutz {"event": ..., ...} — the rollout controller
+        (possibly another process) recording wave progress on THIS
+        router's metrics/flight/statz (ENGINE_INTERFACE
+        "rollout_note"; a non-fleet server 400s)."""
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            req = json.loads(self.rfile.read(length) or b"{}")
+        except (ValueError, json.JSONDecodeError):
+            self._send(400, {"error": "body must be JSON"})
+            return
+        event = req.pop("event", None)
+        if not isinstance(event, str) or not event:
+            self._send(400, {"error": 'rolloutz needs {"event": ...}'})
+            return
+        try:
+            out = self.runner.engine.rollout_note(event, **req)
+        except (ValueError, TypeError) as e:
             self._send(400, {"error": str(e)})
             return
         self._send(200, out)
@@ -1544,6 +1771,25 @@ class _Handler(BaseHTTPRequestHandler):
         except (ValueError, json.JSONDecodeError):
             self._send(400, {"error": "body must be JSON"})
             return
+        # Model-aware routing (the OpenAI "model" field). A fleet
+        # router exposes its multi-tenant roster via served_models():
+        # requests naming a model route only to backends serving it,
+        # and an id NO roster backend serves 404s HERE — before the
+        # streaming path commits a 200 it cannot take back. Single-
+        # model in-process engines return None and ignore the name
+        # (the local-server convention).
+        model = req.get("model")
+        if model is not None and not isinstance(model, str):
+            self._send(400, {"error": "model must be a string id"})
+            return
+        served = self.runner.engine.served_models()
+        if served and model is not None and model not in served:
+            self._send(404, {
+                "error": f"model {model!r} is not served by this "
+                "fleet",
+                "served": sorted(served),
+            })
+            return
         tools, tool_choice = None, "none"
         if chat:
             try:
@@ -1695,7 +1941,7 @@ class _Handler(BaseHTTPRequestHandler):
                     stop_strings, want_logprobs, chat=chat,
                     logit_bias=logit_bias, allowed_token_ids=allowed_ids,
                     adapter=adapter, regex=regex,
-                    json_schema=json_schema, tools=tools,
+                    json_schema=json_schema, tools=tools, model=model,
                 )
                 return
             if best_of is not None:
@@ -1782,7 +2028,7 @@ class _Handler(BaseHTTPRequestHandler):
                     sampling=sampling, stop_token_ids=stop_token_ids,
                     stop_strings=stop_strings, logit_bias=logit_bias,
                     allowed_token_ids=allowed_ids, adapter=adapter,
-                    regex=regex, json_schema=json_schema,
+                    regex=regex, json_schema=json_schema, model=model,
                 )
                 choices = [
                     self._timed_choice(d, want_logprobs, stop_strings)
@@ -1803,8 +2049,14 @@ class _Handler(BaseHTTPRequestHandler):
                 sampling=sampling, stop_token_ids=stop_token_ids,
                 stop_strings=stop_strings, logit_bias=logit_bias,
                 allowed_token_ids=allowed_ids, adapter=adapter,
-                regex=regex, json_schema=json_schema,
+                regex=regex, json_schema=json_schema, model=model,
             )
+        except UnknownModelError as e:
+            # The fleet's 404 backstop (the handler pre-check above
+            # covers the common path; this catches a roster that
+            # learned its models between the check and the submit).
+            self._send(404, {"error": str(e)})
+            return
         except (ValueError, TypeError) as e:
             self._send(400, {"error": str(e)})
             return
@@ -1827,6 +2079,7 @@ class _Handler(BaseHTTPRequestHandler):
         stop_token_ids=None, stop_strings=None, want_logprobs=False,
         chat: bool = False, logit_bias=None, allowed_token_ids=None,
         adapter=None, regex=None, json_schema=None, tools=None,
+        model=None,
     ) -> None:
         """Server-sent events: one ``data:`` line per token delta, a
         final one with finished_by (and the definitive token count —
@@ -1841,7 +2094,7 @@ class _Handler(BaseHTTPRequestHandler):
             sampling=sampling, stop_token_ids=stop_token_ids,
             stop_strings=stop_strings, logit_bias=logit_bias,
             allowed_token_ids=allowed_token_ids, adapter=adapter,
-            regex=regex, json_schema=json_schema,
+            regex=regex, json_schema=json_schema, model=model,
         )
         self.send_response(200)
         self.send_header("Content-Type", "text/event-stream")
@@ -1946,6 +2199,8 @@ def make_server(
     trace_log: Optional[str] = None,
     watchdog=None,
     flight_dump: Optional[str] = None,
+    model_id: Optional[str] = None,
+    ckpt_path: Optional[str] = None,
 ) -> ThreadingHTTPServer:
     """Build (not start) the HTTP server; ``.runner`` holds the engine
     thread. Serve with ``serve_forever()``; stop with ``shutdown()``
@@ -1956,7 +2211,12 @@ def make_server(
     ``flight_dump``: where the flight ring is written if the engine
     thread dies (default: a pid-stamped file in the temp dir). jax
     compile-duration monitoring is installed process-wide here (see
-    obs/compilemon.py)."""
+    obs/compilemon.py).
+    ``model_id``: the id /v1/models advertises (multi-model fleets
+    route by it; default: the model class name). ``ckpt_path``: the
+    checkpoint this server initially serves — /v1/models reports it
+    and POST /reloadz updates it (the rollout controller's readiness
+    gate / rollback anchor)."""
     from shifu_tpu.obs import compilemon
 
     compilemon.install_jax_monitoring(
@@ -1971,6 +2231,8 @@ def make_server(
         engine, trace_log=trace_log, watchdog=watchdog,
         flight_dump=flight_dump,
     )
+    if ckpt_path:
+        runner.ckpt_path = str(ckpt_path)
     handler = type(
         "BoundHandler",
         (_Handler,),
@@ -1979,6 +2241,7 @@ def make_server(
             "tokenizer": tokenizer,
             "default_max_new": default_max_new,
             "request_timeout_s": request_timeout_s,
+            "model_id": model_id,
         },
     )
     server = ThreadingHTTPServer((host, port), handler)

@@ -93,6 +93,16 @@ METRIC_SPECS: Dict[str, Tuple[str, float]] = {
     # means the router grew a per-request/per-token hot-path cost.
     "fleet_x_direct": (LOWER, 0.35),
     "fleet_rt_ms": (LOWER, 0.35),
+    # zero-downtime rollout leg (round 8): client-visible p99 TTFT
+    # during a synthetic rolling weight update, and the error rate
+    # clients saw while it ran. Armable — dormant until a baseline
+    # round records the leg; rollout_err_rate additionally stays
+    # dormant while the recorded baseline is 0 (ratio gates need a
+    # nonzero anchor — check_bench skips zero baselines), so the p99
+    # row is the live guard against the rollout machinery growing a
+    # client-visible cost.
+    "rollout_p99_ttft_ms": (LOWER, 0.35),
+    "rollout_err_rate": (LOWER, 0.50),
 }
 
 # Absolute floors for landed improve-direction wins (round 6): relative

@@ -4,11 +4,31 @@ Run as ``python tests/_fleet_backend.py``: builds a tiny CPU
 PagedEngine, serves it with the real HTTP front-end on an ephemeral
 port, prints ``{"port": N}`` on stdout (the parent reads it), then
 serves until killed. This IS the per-host process a real fleet runs —
-the tests federate two of these and kill one mid-stream.
+the tests federate two of these, kill one mid-stream, and roll new
+weights across them.
 
 Env knobs: ``FLEET_BACKEND_MAX_SLOTS`` (default 2),
 ``FLEET_BACKEND_MAX_LEN`` (default 256), ``FLEET_BACKEND_SEED``
-(default 0 — identical params across backends, like a real fleet).
+(default 0 — identical params across backends, like a real fleet),
+``FLEET_BACKEND_MODEL_ID`` (the /v1/models id — multi-model routing
+tests give each backend a distinct name), ``FLEET_BACKEND_CKPT``
+(initial weights: a manifest params dir loaded at startup and
+reported as the serving ckpt — the rollout tests' rollback anchor).
+
+CHAOS HOOKS (the ``chaos`` pytest marker's fault injectors — each
+makes one failure path deterministic instead of waiting for the
+network to misbehave):
+
+  * ``FLEET_BACKEND_FAULT_DROP_NTH=N`` — the Nth ``/v1/completions``
+    request has its connection severed before any response bytes
+    (exercises the router's failed-before-first-delta resubmission).
+  * ``FLEET_BACKEND_FAULT_SLOW_PROBE=S`` — every ``/healthz`` answer
+    is delayed S seconds (exercises probe timeouts and the prober's
+    failure backoff).
+  * ``FLEET_BACKEND_FAULT_RELOAD_FAIL=1`` — every ``POST /reloadz``
+    503s without touching the weights (exercises the rollout
+    controller's halt-and-resume-on-old-weights path).
+
 Not collected by pytest (leading underscore).
 """
 
@@ -28,6 +48,57 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 
 
+def _install_faults(server) -> None:
+    """Wrap the server's handler class with the env-selected chaos
+    hooks (subclass + swap — make_server's handler stays untouched)."""
+    drop_nth = int(os.environ.get("FLEET_BACKEND_FAULT_DROP_NTH", "0"))
+    slow_probe = float(
+        os.environ.get("FLEET_BACKEND_FAULT_SLOW_PROBE", "0")
+    )
+    reload_fail = bool(
+        int(os.environ.get("FLEET_BACKEND_FAULT_RELOAD_FAIL", "0"))
+    )
+    if not (drop_nth or slow_probe or reload_fail):
+        return
+    import itertools
+    import socket
+    import time
+
+    base = server.RequestHandlerClass
+    counter = itertools.count(1)
+
+    class FaultyHandler(base):
+        def _handle_completions(self, chat):
+            if drop_nth and next(counter) == drop_nth:
+                # Sever before any response bytes: the client (the
+                # fleet router) sees a clean transport failure with
+                # the request still invisible to ITS caller, so it
+                # must resubmit.
+                try:
+                    self.connection.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                self.close_connection = True
+                return
+            return super()._handle_completions(chat)
+
+        def do_GET(self):
+            if slow_probe and self.path == "/healthz":
+                time.sleep(slow_probe)
+            return super().do_GET()
+
+        def _handle_reload(self):
+            if reload_fail:
+                self._send(503, {
+                    "error": "injected reload failure (chaos hook)",
+                    "reloaded": False,
+                })
+                return
+            return super()._handle_reload()
+
+    server.RequestHandlerClass = FaultyHandler
+
+
 def main() -> int:
     from shifu_tpu.infer import PagedEngine, SampleConfig, make_server
     from shifu_tpu.models import Transformer, TransformerConfig
@@ -35,10 +106,16 @@ def main() -> int:
     max_slots = int(os.environ.get("FLEET_BACKEND_MAX_SLOTS", "2"))
     max_len = int(os.environ.get("FLEET_BACKEND_MAX_LEN", "256"))
     seed = int(os.environ.get("FLEET_BACKEND_SEED", "0"))
+    model_id = os.environ.get("FLEET_BACKEND_MODEL_ID") or None
+    ckpt = os.environ.get("FLEET_BACKEND_CKPT") or None
 
     cfg = TransformerConfig.tiny()
     model = Transformer(cfg)
     params = model.init(jax.random.key(seed))
+    if ckpt:
+        from shifu_tpu.checkpoint import load_serving_params
+
+        params = load_serving_params(ckpt, model)
     engine = PagedEngine(
         model, params, max_slots=max_slots, max_len=max_len,
         page_size=16, prefill_buckets=(16, max_len),
@@ -59,7 +136,9 @@ def main() -> int:
             return orig_fold(handle)
 
         engine.step_fold = slow_fold
-    server = make_server(engine, port=0)
+    server = make_server(engine, port=0, model_id=model_id,
+                         ckpt_path=ckpt)
+    _install_faults(server)
     print(json.dumps({"port": server.server_port}), flush=True)
     try:
         server.serve_forever()

@@ -1,0 +1,522 @@
+"""Two-process rolling weight rollout + multi-model fleet routing:
+REAL backend engine servers in child processes (tests/_fleet_backend.py,
+started from a manifest params checkpoint), a FleetRouter + HTTP
+front-end + RolloutController in this one. Covers the acceptance walk:
+
+  * a full rolling update with live traffic — every client request is
+    200 or 503-with-Retry-After (none hang), the fleet ends healthy on
+    the new weights, and the router's /statz carries the rollout block;
+  * an injected SLO breach pausing the wave, and --abort-on-slo rolling
+    the already-swapped backend back to its previous checkpoint;
+  * a corrupted checkpoint rejected by manifest verification (503; the
+    backend keeps serving its old weights);
+  * model-aware routing: two backends serving two model names behind
+    one endpoint — cross-routing by the "model" field, 404 on unknown;
+  * chaos hooks (the ``chaos`` marker): a backend deterministically
+    dropping a request's connection (the router resubmits, the client
+    sees 200) and a backend whose /reloadz always fails (the rollout
+    halts with that host resumed on old weights).
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import pytest
+
+from shifu_tpu.fleet import (
+    BackendClient,
+    BackendConfig,
+    FleetProber,
+    FleetRouter,
+    RetryPolicy,
+    RolloutController,
+    RouterAdmin,
+    wait_ready,
+)
+from shifu_tpu.infer import make_server
+from shifu_tpu.obs import FlightRecorder, MetricsRegistry
+
+_HELPER = os.path.join(os.path.dirname(__file__), "_fleet_backend.py")
+
+
+def _make_ckpt(tmp, name, seed):
+    """A manifest params checkpoint matching the spawned backends'
+    model (TransformerConfig.tiny) — seed picks the weights."""
+    from shifu_tpu.checkpoint import save_params_dir
+    from shifu_tpu.models import Transformer, TransformerConfig
+
+    model = Transformer(TransformerConfig.tiny())
+    params = model.init(jax.random.key(seed))
+    return save_params_dir(os.path.join(str(tmp), name), params)
+
+
+def _spawn_backend(step_delay=0.02, **env_extra):
+    env = dict(
+        os.environ,
+        PALLAS_AXON_POOL_IPS="",
+        JAX_PLATFORMS="cpu",
+        FLEET_BACKEND_MAX_SLOTS="2",
+        FLEET_BACKEND_STEP_DELAY=str(step_delay),
+        **{k: str(v) for k, v in env_extra.items()},
+    )
+    proc = subprocess.Popen(
+        [sys.executable, _HELPER],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        env=env, text=True,
+    )
+    line = proc.stdout.readline()
+    if not line:
+        proc.kill()
+        raise RuntimeError("backend process died before printing its port")
+    port = json.loads(line)["port"]
+    return proc, f"127.0.0.1:{port}"
+
+
+def _kill_all(procs):
+    for p in procs:
+        if p.poll() is None:
+            p.send_signal(signal.SIGKILL)
+    for p in procs:
+        p.wait(timeout=10)
+
+
+def _make_router(addrs, with_prober=True):
+    clients = [
+        BackendClient(
+            a,
+            BackendConfig(
+                connect_timeout_s=10.0, probe_timeout_s=5.0,
+                read_timeout_s=60.0, fail_threshold=3, reset_s=1.0,
+            ),
+        )
+        for a in addrs
+    ]
+    ready, pending = wait_ready(clients, timeout_s=60.0, require_all=True)
+    assert not pending
+    router = FleetRouter(
+        clients, metrics=MetricsRegistry(), flight=FlightRecorder(),
+        policy=RetryPolicy(base_s=0.01, cap_s=0.1, budget=16.0),
+    )
+    prober = None
+    if with_prober:
+        prober = FleetProber(router, interval_s=0.2)
+        prober.start()
+    server = make_server(router, port=0)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    base = f"http://127.0.0.1:{server.server_port}"
+
+    def teardown():
+        if prober is not None:
+            prober.stop()
+        server.shutdown()
+        server.runner.shutdown()
+        t.join(5)
+
+    return base, router, teardown
+
+
+def _post(base, path, obj, timeout=120):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(obj).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def _get(base, path, timeout=30):
+    with urllib.request.urlopen(base + path, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+@pytest.fixture(scope="module")
+def ckpts(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("rollout_ckpts")
+    return {
+        "v0": _make_ckpt(tmp, "v0", seed=10),
+        "v1": _make_ckpt(tmp, "v1", seed=11),
+        "v2": _make_ckpt(tmp, "v2", seed=12),
+    }
+
+
+@pytest.fixture(scope="module")
+def backends(ckpts):
+    """Two real engine-server processes, both starting on ckpt v0
+    (identical weights, like a freshly deployed fleet). Tests in this
+    module roll them forward/back; the file's tests are ordered to
+    leave both alive."""
+    procs, addrs = [], []
+    try:
+        for _ in range(2):
+            p, a = _spawn_backend(
+                FLEET_BACKEND_CKPT=ckpts["v0"],
+                FLEET_BACKEND_MODEL_ID="tinylm",
+            )
+            procs.append(p)
+            addrs.append(a)
+        yield procs, addrs
+    finally:
+        _kill_all(procs)
+
+
+class _Traffic:
+    """Background request load through the router during a rollout.
+    Records every outcome; nothing may hang and nothing may fail with
+    anything but a Retry-After-carrying 503."""
+
+    def __init__(self, base, n_threads=3):
+        self.base = base
+        self.results = []  # (status, retry_after_or_None)
+        self._stop = threading.Event()
+        self._threads = [
+            threading.Thread(target=self._loop, args=(i,), daemon=True)
+            for i in range(n_threads)
+        ]
+
+    def _loop(self, i):
+        j = 0
+        while not self._stop.is_set():
+            j += 1
+            req = urllib.request.Request(
+                self.base + "/v1/completions",
+                data=json.dumps({
+                    "tokens": [1 + i, 2, 3 + (j % 7)],
+                    "max_new_tokens": 16,
+                }).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=60) as r:
+                    json.loads(r.read())
+                    self.results.append((r.status, None))
+            except urllib.error.HTTPError as e:
+                self.results.append(
+                    (e.code, e.headers.get("Retry-After"))
+                )
+                time.sleep(0.05)
+            except Exception as e:  # transport failure = a hang-class bug
+                self.results.append((repr(e), None))
+
+    def __enter__(self):
+        for t in self._threads:
+            t.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._stop.set()
+        for t in self._threads:
+            t.join(60)
+        assert all(not t.is_alive() for t in self._threads), (
+            "traffic threads hung"
+        )
+
+
+def _backend_ckpt(addr):
+    doc = _get(f"http://{addr}", "/v1/models")
+    return doc["data"][0].get("ckpt")
+
+
+def test_rolling_update_zero_downtime(backends, ckpts):
+    """THE acceptance walk: live traffic + a full rolling update
+    v0 -> v1. Every request 200 or 503-with-Retry-After, fleet ends
+    healthy on the new weights, router carries the rollout state."""
+    _, addrs = backends
+    base, router, teardown = _make_router(addrs)
+    try:
+        with _Traffic(base) as traffic:
+            # let some steady-state traffic land first
+            time.sleep(0.7)
+            ctl = RolloutController(
+                RouterAdmin(base), ckpts["v1"],
+                drain_timeout_s=60.0, ready_timeout_s=30.0,
+            )
+            report = ctl.run()
+            time.sleep(0.5)  # post-rollout traffic on the new weights
+        assert report["status"] == "complete", report
+        assert sorted(report["updated"]) == sorted(addrs)
+        assert report["previous"] == {a: ckpts["v0"] for a in addrs}
+        # zero downtime: every request 200, or 503 carrying Retry-After
+        assert traffic.results, "no traffic flowed"
+        bad = [r for r in traffic.results
+               if r[0] != 200 and not (r[0] == 503 and r[1])]
+        assert not bad, f"non-retryable outcomes: {bad[:5]}"
+        assert any(s == 200 for s, _ in traffic.results)
+        # both backends now SERVE v1 and say so
+        for a in addrs:
+            assert _backend_ckpt(a) == ckpts["v1"]
+        # fleet healthy, fully routable
+        health = _get(base, "/healthz")
+        assert health["status"] == "ok", health
+        assert all(
+            b.routable() and not b.draining for b in router.backends
+        )
+        # the router recorded the rollout: /statz block + metrics
+        statz = _get(base, "/statz")
+        roll = statz["rollout"]
+        assert roll["status"] == "complete"
+        assert sorted(roll["updated"]) == sorted(addrs)
+        assert "shifu_rollout_events_total" in statz["metrics"]
+        assert router.metrics.value(
+            "shifu_rollout_events_total", {"event": "backend_updated"}
+        ) == 2.0
+        assert router.metrics.value("shifu_rollout_active") == 0.0
+        # served_models reflects the new single version
+        models = _get(base, "/v1/models")["data"]
+        row = next(r for r in models if r["id"] == "tinylm")
+        assert row["ckpts"] == [ckpts["v1"]]
+        # flight ring carries the walk
+        kinds = [e["kind"] for e in router.flight.snapshot()]
+        assert "rollout_begin" in kinds and "rollout_end" in kinds
+        assert "weights_reloaded" not in kinds  # backend-side event
+    finally:
+        teardown()
+
+
+def test_slo_breach_pauses_and_abort_rolls_back(backends, ckpts):
+    """Injected SLO breach: the admin's watchdog verdict is scripted
+    to degrade after the first backend updates. Default mode pauses
+    (then clears); --abort-on-slo instead restores the previous
+    checkpoint on the already-swapped backend — over the real wire."""
+    _, addrs = backends
+    base, router, teardown = _make_router(addrs)
+
+    class ScriptedAdmin(RouterAdmin):
+        def __init__(self, url, verdicts):
+            super().__init__(url)
+            self.verdicts = list(verdicts)
+
+        def slo(self):
+            if self.verdicts:
+                return self.verdicts.pop(0)
+            return super().slo()
+
+    try:
+        start = {a: _backend_ckpt(a) for a in addrs}  # v1 from prior test
+        target = ckpts["v2"]
+        # ---- pause-then-clear: rollout completes
+        admin = ScriptedAdmin(base, [
+            {"status": "ok", "reasons": []},
+            {"status": "degraded", "reasons": ["p99 TTFT over budget"]},
+            {"status": "ok", "reasons": []},
+        ])
+        ctl = RolloutController(
+            admin, target, drain_timeout_s=60.0, ready_timeout_s=30.0,
+            pause_timeout_s=30.0, poll_s=0.05,
+        )
+        report = ctl.run()
+        assert report["status"] == "complete", report
+        assert report["paused"] == 1
+        for a in addrs:
+            assert _backend_ckpt(a) == target
+        # ---- abort-on-slo: first backend swaps back to its prev
+        admin = ScriptedAdmin(base, [
+            {"status": "ok", "reasons": []},
+            {"status": "degraded", "reasons": ["p99 ITL over budget"]},
+        ])
+        ctl = RolloutController(
+            admin, ckpts["v0"], abort_on_slo=True,
+            drain_timeout_s=60.0, ready_timeout_s=30.0, poll_s=0.05,
+        )
+        report = ctl.run()
+        assert report["status"] == "aborted", report
+        assert len(report["updated"]) == 1
+        rolled = report["rolled_back"]
+        assert rolled == report["updated"]
+        # the aborted rollout left EVERY backend on the pre-rollout
+        # version (v2): the swapped one was rolled back to it
+        for a in addrs:
+            assert _backend_ckpt(a) == target, a
+        assert all(
+            b.routable() and not b.draining for b in router.backends
+        )
+        statz = _get(base, "/statz")
+        assert statz["rollout"]["status"] == "aborted"
+        del start
+    finally:
+        teardown()
+
+
+def test_corrupt_checkpoint_rejected_backend_keeps_weights(
+    backends, ckpts, tmp_path
+):
+    """Manifest verification is the /reloadz gate: a bit-flipped
+    checkpoint 503s and the backend keeps serving its old weights."""
+    import glob
+    import shutil
+
+    _, addrs = backends
+    bad = os.path.join(str(tmp_path), "bad_ckpt")
+    shutil.copytree(ckpts["v1"], bad)
+    victim = sorted(glob.glob(os.path.join(bad, "*.bin")))[0]
+    data = bytearray(open(victim, "rb").read())
+    data[11] ^= 0x40
+    with open(victim, "wb") as f:
+        f.write(bytes(data))
+    addr = addrs[0]
+    before = _backend_ckpt(addr)
+    client = BackendClient(addr)
+    from shifu_tpu.fleet.backend import BackendError
+
+    with pytest.raises(BackendError) as ei:
+        client.reload(bad)
+    assert ei.value.status == 503
+    assert "checksum" in str(ei.value) or "rejected" in str(ei.value)
+    # old weights still serving, ckpt report unchanged, host healthy
+    assert _backend_ckpt(addr) == before
+    s, out = _post(f"http://{addr}", "/v1/completions",
+                   {"tokens": [1, 2, 3], "max_new_tokens": 4})
+    assert s == 200 and len(out["tokens"]) == 4
+
+
+@pytest.fixture(scope="module")
+def multimodel_backends():
+    """Two backends serving DIFFERENT model names — the multi-tenant
+    fleet shape (e.g. a Gemma-2 flash tier and a Mamba tier behind one
+    endpoint)."""
+    procs, addrs = [], []
+    try:
+        for mid in ("alpha-lm", "beta-lm"):
+            p, a = _spawn_backend(
+                step_delay=0.0, FLEET_BACKEND_MODEL_ID=mid
+            )
+            procs.append(p)
+            addrs.append(a)
+        yield procs, addrs
+    finally:
+        _kill_all(procs)
+
+
+def test_multi_model_routing_and_unknown_404(multimodel_backends):
+    _, addrs = multimodel_backends
+    base, router, teardown = _make_router(addrs, with_prober=False)
+    try:
+        # the router's /v1/models is the union roster
+        data = _get(base, "/v1/models")["data"]
+        assert [r["id"] for r in data] == ["alpha-lm", "beta-lm"]
+        assert data[0]["backends"] == [addrs[0]]
+        assert data[1]["backends"] == [addrs[1]]
+        # cross-routing: the model field pins the backend, regardless
+        # of load order
+        for _ in range(3):
+            s, out = _post(base, "/v1/completions", {
+                "tokens": [1, 2, 3], "max_new_tokens": 4,
+                "model": "beta-lm",
+            })
+            assert s == 200
+            assert out["timing"]["backend"] == addrs[1]
+        s, out = _post(base, "/v1/completions", {
+            "tokens": [1, 2, 3], "max_new_tokens": 4,
+            "model": "alpha-lm",
+        })
+        assert s == 200 and out["timing"]["backend"] == addrs[0]
+        # no model field: least-loaded fleet-wide (any backend)
+        s, out = _post(base, "/v1/completions",
+                       {"tokens": [1, 2, 3], "max_new_tokens": 4})
+        assert s == 200
+        # unknown model: 404 naming the served set, blocking AND stream
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(base, "/v1/completions", {
+                "tokens": [1, 2, 3], "max_new_tokens": 4,
+                "model": "gamma-lm",
+            })
+        assert ei.value.code == 404
+        body = json.loads(ei.value.read())
+        assert body["served"] == ["alpha-lm", "beta-lm"]
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(base, "/v1/completions", {
+                "tokens": [1, 2, 3], "max_new_tokens": 4,
+                "model": "gamma-lm", "stream": True,
+            })
+        assert ei.value.code == 404
+        # draining the only backend serving a model -> 503 (known but
+        # unavailable), NOT 404
+        _post(base, "/drainz", {"backend": addrs[1], "detach": False})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(base, "/v1/completions", {
+                "tokens": [1, 2, 3], "max_new_tokens": 4,
+                "model": "beta-lm",
+            })
+        assert ei.value.code == 503
+        assert ei.value.headers.get("Retry-After")
+        _post(base, "/drainz", {"backend": addrs[1], "resume": True})
+        s, _ = _post(base, "/v1/completions", {
+            "tokens": [1, 2, 3], "max_new_tokens": 4,
+            "model": "beta-lm",
+        })
+        assert s == 200
+    finally:
+        teardown()
+
+
+@pytest.mark.chaos
+def test_chaos_dropped_request_resubmits_to_survivor():
+    """Fault hook drop-Nth: one backend severs the FIRST completions
+    connection it receives. The router must resubmit (failure before
+    first delta) and the client still sees a 200."""
+    procs, addrs = [], []
+    try:
+        p, a = _spawn_backend(
+            step_delay=0.0, FLEET_BACKEND_FAULT_DROP_NTH=1
+        )
+        procs.append(p)
+        addrs.append(a)
+        p, a = _spawn_backend(step_delay=0.0)
+        procs.append(p)
+        addrs.append(a)
+        base, router, teardown = _make_router(addrs, with_prober=False)
+        try:
+            s, out = _post(base, "/v1/completions",
+                           {"tokens": [1, 2, 3], "max_new_tokens": 4})
+            assert s == 200 and len(out["tokens"]) == 4
+            assert router.fleet_stats()["resubmissions"] >= 1
+        finally:
+            teardown()
+    finally:
+        _kill_all(procs)
+
+
+@pytest.mark.chaos
+def test_chaos_reload_failure_halts_rollout_host_stays_up(ckpts):
+    """Fault hook reload-fail: every /reloadz 503s. The rollout halts
+    with a failed report, the backend is resumed (still routable) on
+    its old weights, and traffic keeps serving."""
+    procs, addrs = [], []
+    try:
+        p, a = _spawn_backend(
+            step_delay=0.0,
+            FLEET_BACKEND_CKPT=ckpts["v0"],
+            FLEET_BACKEND_FAULT_RELOAD_FAIL=1,
+        )
+        procs.append(p)
+        addrs.append(a)
+        base, router, teardown = _make_router(addrs, with_prober=False)
+        try:
+            ctl = RolloutController(
+                RouterAdmin(base), ckpts["v1"],
+                drain_timeout_s=30.0, ready_timeout_s=10.0,
+            )
+            report = ctl.run()
+            assert report["status"] == "failed"
+            assert "refused the reload" in report["error"]
+            assert report["updated"] == []
+            assert _backend_ckpt(addrs[0]) == ckpts["v0"]
+            b = router.backends[0]
+            assert b.routable() and not b.draining
+            s, _ = _post(base, "/v1/completions",
+                         {"tokens": [1, 2, 3], "max_new_tokens": 4})
+            assert s == 200
+            assert _get(base, "/statz")["rollout"]["status"] == "failed"
+        finally:
+            teardown()
+    finally:
+        _kill_all(procs)

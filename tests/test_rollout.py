@@ -1,0 +1,455 @@
+"""Rolling-rollout machinery, without sockets: the RolloutController's
+wave/pause/abort/rollback walk against fake admin+backend objects, the
+FleetProber's failure backoff on a fake clock (half-open trials still
+on schedule), and the FleetRouter's model-aware pick/404 logic. The
+wire versions of these walks live in tests/test_fleet_rollout.py
+(two real backend processes)."""
+
+import pytest
+
+from shifu_tpu.fleet import FleetProber, FleetRouter
+from shifu_tpu.fleet.backend import (
+    BackendClient,
+    BackendError,
+    CircuitBreaker,
+)
+from shifu_tpu.fleet.rollout import RolloutController, RolloutError
+from shifu_tpu.infer.engine import UnknownModelError
+from shifu_tpu.obs import FlightRecorder, MetricsRegistry
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def sleep(self, s):
+        self.t += s
+
+
+class FakeBackend:
+    """Stands in for BackendClient on the controller's direct-to-host
+    calls: reload / probe / models. ``ckpt`` mimics the /v1/models
+    ckpt field."""
+
+    def __init__(self, addr, ckpt="ck/v0", reload_error=None):
+        self.addr = addr
+        self.ckpt = ckpt
+        self.reload_error = reload_error
+        self.reloads = []
+
+    def reload(self, ckpt, timeout_s=None):
+        if self.reload_error is not None:
+            raise self.reload_error
+        self.reloads.append(ckpt)
+        self.ckpt = ckpt
+        return {"reloaded": ckpt}
+
+    def probe(self):
+        return {"healthy": True, "status": "ok"}
+
+    def models(self):
+        return {"data": [{"id": "m", "ckpt": self.ckpt}]}
+
+
+class FakeAdmin:
+    """Stands in for RouterAdmin: roster, drain/resume bookkeeping,
+    scripted SLO verdicts, recorded /rolloutz notes."""
+
+    def __init__(self, addrs, slo_script=None):
+        self.addrs = list(addrs)
+        self.drained = {}
+        self.calls = []
+        self.notes = []
+        # slo(): pops the next scripted verdict; empty -> ok.
+        self.slo_script = list(slo_script or [])
+
+    def backends(self):
+        return [
+            {"backend": a, "status": "up", "in_flight": 0}
+            for a in self.addrs
+        ]
+
+    def fleet_row(self, addr):
+        return {"backend": addr, "in_flight": 0}
+
+    def slo(self):
+        if self.slo_script:
+            return self.slo_script.pop(0)
+        return {"status": "ok", "reasons": []}
+
+    def drain(self, addr):
+        self.drained[addr] = self.drained.get(addr, 0) + 1
+        self.calls.append(("drain", addr))
+
+    def resume(self, addr):
+        self.calls.append(("resume", addr))
+
+    def note(self, event, **fields):
+        self.notes.append((event, fields))
+
+
+def _controller(admin, backends, **kw):
+    clock = FakeClock()
+    kw.setdefault("clock", clock)
+    kw.setdefault("sleep", clock.sleep)
+    kw.setdefault("poll_s", 1.0)
+    return RolloutController(
+        admin, "ck/v1",
+        make_backend=lambda a: backends[a], **kw,
+    ), clock
+
+
+# ------------------------------------------------------- happy walk
+def test_rollout_walks_roster_in_waves_and_completes():
+    admin = FakeAdmin(["a:1", "b:2", "c:3"])
+    backends = {a: FakeBackend(a) for a in admin.addrs}
+    ctl, _ = _controller(admin, backends)
+    report = ctl.run()
+    assert report["status"] == "complete"
+    assert report["updated"] == ["a:1", "b:2", "c:3"]
+    # drain -> (reload) -> resume per backend, one at a time
+    for a in admin.addrs:
+        assert ("drain", a) in admin.calls
+        assert ("resume", a) in admin.calls
+        assert backends[a].reloads == ["ck/v1"]
+    # previous ckpts recorded as the rollback ledger
+    assert report["previous"] == {a: "ck/v0" for a in admin.addrs}
+    events = [e for e, _ in admin.notes]
+    assert events[0] == "begin" and events[-1] == "end"
+    assert events.count("wave_start") == 3  # max_unavailable=1
+    assert events.count("backend_updated") == 3
+
+
+def test_max_unavailable_groups_waves():
+    admin = FakeAdmin(["a:1", "b:2", "c:3", "d:4", "e:5"])
+    backends = {a: FakeBackend(a) for a in admin.addrs}
+    ctl, _ = _controller(admin, backends, max_unavailable=2)
+    report = ctl.run()
+    assert report["status"] == "complete"
+    waves = [f["backends"] for e, f in admin.notes if e == "wave_start"]
+    assert waves == [["a:1", "b:2"], ["c:3", "d:4"], ["e:5"]]
+    # Within a wave both drain BEFORE either reloads (the wave is the
+    # unavailability unit).
+    drain_b = admin.calls.index(("drain", "b:2"))
+    resume_a = admin.calls.index(("resume", "a:1"))
+    assert drain_b < resume_a
+
+
+# ------------------------------------------------------- SLO brake
+def test_slo_breach_pauses_then_proceeds_when_clear():
+    admin = FakeAdmin(
+        ["a:1", "b:2"],
+        slo_script=[
+            {"status": "ok", "reasons": []},            # wave 1 gate
+            {"status": "degraded", "reasons": ["p99 TTFT over"]},
+            {"status": "degraded", "reasons": ["p99 TTFT over"]},
+            {"status": "ok", "reasons": []},            # clears
+        ],
+    )
+    backends = {a: FakeBackend(a) for a in admin.addrs}
+    ctl, _ = _controller(admin, backends, pause_timeout_s=60.0)
+    report = ctl.run()
+    assert report["status"] == "complete"
+    assert report["paused"] == 1
+    events = [e for e, _ in admin.notes]
+    assert "pause" in events and "unpause" in events
+    # the pause happened BETWEEN waves: backend a updated before it,
+    # b after
+    assert events.index("pause") > events.index("backend_updated")
+
+
+def test_slo_pause_timeout_fails_rollout():
+    admin = FakeAdmin(
+        ["a:1", "b:2"],
+        slo_script=[{"status": "ok", "reasons": []}] + [
+            {"status": "degraded", "reasons": ["stuck"]}
+        ] * 1000,
+    )
+    backends = {a: FakeBackend(a) for a in admin.addrs}
+    ctl, _ = _controller(admin, backends, pause_timeout_s=5.0)
+    report = ctl.run()
+    assert report["status"] == "failed"
+    assert "still breached" in report["error"]
+    # the fleet keeps serving: backend a updated, b untouched, nothing
+    # left drained (every drain has a later resume)
+    assert report["updated"] == ["a:1"]
+    assert backends["b:2"].reloads == []
+
+
+def test_abort_on_slo_rolls_back_updated_backends_newest_first():
+    admin = FakeAdmin(
+        ["a:1", "b:2", "c:3"],
+        slo_script=[
+            {"status": "ok", "reasons": []},   # wave 1 (a)
+            {"status": "ok", "reasons": []},   # wave 2 (b)
+            {"status": "degraded", "reasons": ["p99 ITL over"]},
+        ],
+    )
+    backends = {a: FakeBackend(a, ckpt=f"ck/old-{a}") for a in admin.addrs}
+    ctl, _ = _controller(admin, backends, abort_on_slo=True)
+    report = ctl.run()
+    assert report["status"] == "aborted"
+    assert report["updated"] == ["a:1", "b:2"]
+    # rolled back newest-first, each to ITS OWN previous ckpt
+    assert report["rolled_back"] == ["b:2", "a:1"]
+    assert backends["a:1"].reloads == ["ck/v1", "ck/old-a:1"]
+    assert backends["b:2"].reloads == ["ck/v1", "ck/old-b:2"]
+    assert backends["c:3"].reloads == []
+    events = [e for e, _ in admin.notes]
+    assert "rollback_started" in events and "abort" in events
+    assert events.count("rollback_backend") == 2
+
+
+def test_abort_skips_rollback_without_prev_ckpt():
+    admin = FakeAdmin(
+        ["a:1", "b:2"],
+        slo_script=[
+            {"status": "ok", "reasons": []},
+            {"status": "degraded", "reasons": ["x"]},
+        ],
+    )
+    backends = {
+        "a:1": FakeBackend("a:1", ckpt=None),  # no ckpt reported
+        "b:2": FakeBackend("b:2"),
+    }
+    ctl, _ = _controller(admin, backends, abort_on_slo=True)
+    report = ctl.run()
+    assert report["status"] == "aborted"
+    assert report["rolled_back"] == []
+    assert report["rollback_skipped"] == ["a:1"]
+    assert backends["a:1"].reloads == ["ck/v1"]  # still on the target
+
+
+# -------------------------------------------------- failure halting
+def test_reload_refusal_halts_rollout_and_resumes_backend():
+    admin = FakeAdmin(["a:1", "b:2"])
+    backends = {
+        "a:1": FakeBackend("a:1", reload_error=BackendError(
+            "checkpoint rejected: checksum mismatch",
+            retryable=True, status=503,
+        )),
+        "b:2": FakeBackend("b:2"),
+    }
+    ctl, _ = _controller(admin, backends)
+    report = ctl.run()
+    assert report["status"] == "failed"
+    assert "refused the reload" in report["error"]
+    # the refusing backend was resumed (old weights keep serving) and
+    # the walk never reached b
+    assert ("resume", "a:1") in admin.calls
+    assert backends["b:2"].reloads == []
+    assert any(e == "reload_failed" for e, _ in admin.notes)
+
+
+def test_drain_timeout_resumes_and_fails():
+    class StuckAdmin(FakeAdmin):
+        def fleet_row(self, addr):
+            return {"backend": addr, "in_flight": 1}  # never drains
+
+    admin = StuckAdmin(["a:1"])
+    backends = {"a:1": FakeBackend("a:1")}
+    ctl, _ = _controller(admin, backends, drain_timeout_s=3.0)
+    report = ctl.run()
+    assert report["status"] == "failed"
+    assert "in-flight" in report["error"]
+    assert ("resume", "a:1") in admin.calls
+    assert backends["a:1"].reloads == []
+
+
+# ----------------------------------------------- prober backoff walk
+class _Probes:
+    """Scriptable probe outcomes per backend addr."""
+
+    def __init__(self):
+        self.fail = set()
+        self.count = {}
+
+    def __call__(self, b):
+        self.count[b.addr] = self.count.get(b.addr, 0) + 1
+        if b.addr in self.fail:
+            b.breaker.record_failure()
+            raise BackendError(f"{b.addr} down", retryable=True)
+        b.breaker.record_success()
+        return {"status": "ok"}
+
+
+def _prober_fixture(clock, interval=2.0, reset_s=100.0):
+    from shifu_tpu.fleet.backend import BackendConfig
+
+    cfg = BackendConfig(fail_threshold=1, reset_s=reset_s)
+    backends = [
+        BackendClient("127.0.0.1:1", cfg, clock=clock),
+        BackendClient("127.0.0.1:2", cfg, clock=clock),
+    ]
+    router = FleetRouter(
+        backends, metrics=MetricsRegistry(), flight=FlightRecorder()
+    )
+    probes = _Probes()
+    router.probe_backend = probes  # bypass HTTP; breaker walk kept
+    prober = FleetProber(
+        router, interval_s=interval, backoff_max_mult=8, clock=clock
+    )
+    # models() would hit the wire; the units only exercise probing
+    for b in backends:
+        b.max_len = 128
+        b.model_ids = ["m"]
+        b.models = lambda: {"data": []}
+    return router, prober, probes
+
+
+def test_prober_backoff_grows_capped_and_resets_on_success():
+    clock = FakeClock()
+    router, prober, probes = _prober_fixture(clock, interval=2.0)
+    dead = router.backends[0].addr
+    probes.fail.add(dead)
+    # t=0: both probed; dead host fails -> next due at +2*2=4
+    prober.tick()
+    assert probes.count == {dead: 1, router.backends[1].addr: 1}
+    assert prober.backoff_mult(dead) == 2
+    clock.t = 2.0
+    prober.tick()  # healthy host probed again; dead one backed off
+    assert probes.count[dead] == 1
+    assert probes.count[router.backends[1].addr] == 2
+    clock.t = 4.0
+    prober.tick()  # dead due again -> fail #2 -> mult 4 (due t=12)
+    assert probes.count[dead] == 2
+    assert prober.backoff_mult(dead) == 4
+    clock.t = 11.9
+    prober.tick()
+    assert probes.count[dead] == 2
+    clock.t = 12.0
+    prober.tick()  # fail #3 -> mult 8 (cap)
+    assert probes.count[dead] == 3
+    assert prober.backoff_mult(dead) == 8
+    clock.t = 20.0
+    prober.tick()  # 12+2*8=28 not reached; still backed off
+    assert probes.count[dead] == 3
+    # host recovers: when its probe finally fires, backoff resets
+    clock.t = 28.0
+    probes.fail.discard(dead)
+    prober.tick()
+    assert probes.count[dead] == 4
+    assert prober.backoff_mult(dead) == 1
+    clock.t = 30.0
+    prober.tick()  # healthy cadence again
+    assert probes.count[dead] == 5
+
+
+def test_prober_half_open_trial_fires_despite_backoff():
+    clock = FakeClock()
+    # breaker reset_s = 5 << the backoff the host will accumulate
+    router, prober, probes = _prober_fixture(
+        clock, interval=2.0, reset_s=5.0
+    )
+    dead = router.backends[0].addr
+    b0 = router.backends[0]
+    probes.fail.add(dead)
+    # fail_threshold=1: first failed probe trips the breaker OPEN
+    prober.tick()
+    assert b0.breaker.state == CircuitBreaker.OPEN
+    clock.t = 2.0
+    prober.tick()   # backed off (due t=4) and cooldown not expired
+    assert probes.count[dead] == 1
+    clock.t = 4.0
+    prober.tick()   # fail #2 -> backoff mult 4, next due t=12
+    assert probes.count[dead] == 2
+    # t=9: inside the backoff window, but the breaker re-opened at
+    # t=4 and its 5 s cooldown expired at t=9 — the half-open trial
+    # fires ON SCHEDULE, backoff notwithstanding.
+    clock.t = 9.0
+    assert b0.breaker.cooldown_remaining() == 0.0
+    probes.fail.discard(dead)  # host is back
+    prober.tick()
+    assert probes.count[dead] == 3
+    assert b0.breaker.state == CircuitBreaker.CLOSED
+
+
+# ------------------------------------------- model-aware pick units
+def _router_two(model_a="alpha", model_b="beta"):
+    b0 = BackendClient("127.0.0.1:1")
+    b1 = BackendClient("127.0.0.1:2")
+    b0.model_ids, b1.model_ids = [model_a], [model_b]
+    return FleetRouter(
+        [b0, b1], metrics=MetricsRegistry(), flight=FlightRecorder()
+    )
+
+
+def test_pick_filters_by_model():
+    r = _router_two()
+    assert r._pick(model="alpha") is r.backends[0]
+    assert r._pick(model="beta") is r.backends[1]
+    assert r._pick(model=None) is r.backends[0]  # least-loaded tie
+    r.backends[1].draining = True
+    assert r._pick(model="beta") is None  # serving subset unavailable
+
+
+def test_submit_unknown_model_raises_404_error():
+    r = _router_two()
+    with pytest.raises(UnknownModelError) as ei:
+        r.submit([1, 2, 3], max_new_tokens=4, model="gamma")
+    assert "gamma" in str(ei.value) and "alpha" in str(ei.value)
+
+
+def test_submit_with_unreported_roster_routes_fleetwide():
+    from shifu_tpu.fleet.backend import RetryPolicy
+
+    b = BackendClient("127.0.0.1:1")
+    b.model_ids = None  # nobody reported models yet
+    r = FleetRouter(
+        [b], metrics=MetricsRegistry(), flight=FlightRecorder(),
+        policy=RetryPolicy(base_s=0.001, cap_s=0.002, budget=1.0),
+        sleep=lambda s: None,
+    )
+    # must NOT 404: the name is ignored until the roster learns models
+    rid = r.submit([1, 2, 3], max_new_tokens=1, model="anything")
+    assert isinstance(rid, int)
+    r.cancel(rid)
+
+
+def test_served_models_aggregates_backends_and_ckpts():
+    r = _router_two()
+    r.backends[0].max_len = 256
+    r.backends[0].ckpt = "ck/v0"
+    r.backends[1].max_len = 128
+    r.backends[1].ckpt = "ck/v1"
+    r.backends[1].model_ids = ["alpha", "beta"]
+    out = r.served_models()
+    assert sorted(out) == ["alpha", "beta"]
+    assert out["alpha"]["backends"] == ["127.0.0.1:1", "127.0.0.1:2"]
+    assert out["alpha"]["max_len"] == 128  # min across the subset
+    assert out["alpha"]["ckpts"] == ["ck/v0", "ck/v1"]  # mid-rollout mix
+    assert out["beta"]["backends"] == ["127.0.0.1:2"]
+
+
+# -------------------------------------------------- rollout_note walk
+def test_router_rollout_note_state_and_metrics():
+    reg = MetricsRegistry()
+    fl = FlightRecorder()
+    r = FleetRouter(
+        [BackendClient("127.0.0.1:1")], metrics=reg, flight=fl
+    )
+    with pytest.raises(ValueError):
+        r.rollout_note("backend_updated", backend="x")  # before begin
+    with pytest.raises(ValueError):
+        r.rollout_note("not_an_event")
+    assert r.rollout_stats() is None
+    r.rollout_note("begin", ckpt="ck/v1", backends=2)
+    r.rollout_note("wave_start", backends=["127.0.0.1:1"])
+    r.rollout_note("backend_updated", backend="127.0.0.1:1")
+    st = r.rollout_stats()
+    assert st["status"] == "running" and st["updated"] == ["127.0.0.1:1"]
+    assert reg.value("shifu_rollout_active") == 1.0
+    assert reg.value("shifu_rollout_backends_updated") == 1.0
+    r.rollout_note("pause", reasons=["p99 over"])
+    assert r.rollout_stats()["status"] == "paused"
+    assert reg.value("shifu_rollout_paused") == 1.0
+    r.rollout_note("unpause")
+    r.rollout_note("end")
+    st = r.rollout_stats()
+    assert st["status"] == "complete"
+    assert reg.value("shifu_rollout_active") == 0.0
+    kinds = [e["kind"] for e in fl.snapshot()]
+    assert "rollout_begin" in kinds and "rollout_end" in kinds
