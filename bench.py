@@ -114,6 +114,10 @@ def main(argv=None):
             out["rollout"] = bench_rollout()
         except Exception as e:
             out["rollout"] = {"error": f"{type(e).__name__}: {e}"}
+        try:
+            out["batch_sustained"] = bench_batch_sustained()
+        except Exception as e:
+            out["batch_sustained"] = {"error": f"{type(e).__name__}: {e}"}
     # Runtime self-telemetry in the full ledger: device-memory rollup
     # + how many compiles the bench's engines paid (the obs registry
     # counted them via the engines' tracked programs).
@@ -279,6 +283,11 @@ def _compact(out: dict) -> dict:
         # the "nobody noticed the deploy" numbers
         ("rollout_p99_ttft_ms", g("rollout", "rollout_p99_ttft_ms")),
         ("rollout_err_rate", g("rollout", "rollout_err_rate")),
+        # offline batch tier (round 9): sustained tokens/s over the
+        # 10^4-request soak, and the interactive p99-TTFT tax of
+        # backfilling underneath live traffic
+        ("batch_tok_s", g("batch_sustained", "batch_tok_s")),
+        ("batch_ttft_tax_ms", g("batch_sustained", "batch_ttft_tax_ms")),
         ("moe_mfu", g("train_legs", "moe", "mfu")),
         # grouped-vs-dense MoE dispatch (round 6): the measured ratio
         # and the einsum oracle's own MFU (the "before" number)
@@ -760,6 +769,118 @@ def bench_rollout():
         for srv in bsrvs:
             srv.shutdown()
             srv.runner.shutdown()
+
+
+def bench_batch_sustained(n_lines=10_000):
+    """Offline batch tier: sustained tokens/s over >=10^4 requests and
+    the interactive-TTFT tax of backfilling underneath live traffic.
+
+    One small engine behind the real HTTP front-end. Phase 1 measures
+    interactive p99 TTFT alone (the baseline). Phase 2 runs a
+    ``BatchRunner`` job of ``n_lines`` OpenAI-Batch lines at
+    tier="batch" (the two-tier queue backfills them) WHILE the same
+    interactive probe loop runs. Headline numbers:
+
+      * ``batch_tok_s`` — completion tokens / job wall seconds, the
+        long-horizon throughput number ROADMAP item 5 asked for
+        (bursty serving benches cannot see sustained HBM/compile
+        behaviour; a multi-minute soak can);
+      * ``batch_ttft_tax_ms`` — interactive p99 TTFT with backfill
+        minus without. The two-tier admission contract says this stays
+        small (preemption bounds it at ~one decode step + one
+        recompute prefill); it growing means batch traffic is holding
+        slots against live arrivals."""
+    import tempfile
+    import threading
+    import urllib.error
+    import urllib.request
+
+    from shifu_tpu.batch import BatchRunner
+    from shifu_tpu.infer import SampleConfig, make_server
+    from shifu_tpu.infer.engine import PagedEngine
+    from shifu_tpu.models.transformer import Transformer, TransformerConfig
+    from shifu_tpu.obs import FlightRecorder, MetricsRegistry
+
+    cfg = TransformerConfig.small()
+    model = Transformer(cfg)
+    params = model.init(jax.random.key(0))
+    engine = PagedEngine(
+        model, params, max_slots=16, max_len=256, page_size=16,
+        prefill_buckets=(32, 256), decode_chunk=4,
+        sample_cfg=SampleConfig(temperature=0.0),
+    )
+    srv = make_server(engine, port=0, batch_backlog=4096,
+                      enable_batch_api=False)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{srv.server_port}"
+    max_new = 32
+    tmp = tempfile.mkdtemp(prefix="shifu_bench_batch_")
+    inp = os.path.join(tmp, "job.jsonl")
+    out = os.path.join(tmp, "job.out.jsonl")
+    with open(inp, "w") as f:
+        for i in range(n_lines):
+            f.write(json.dumps({
+                "custom_id": f"req-{i}", "method": "POST",
+                "url": "/v1/completions",
+                "body": {"tokens": [1, 2, 3 + i % 17],
+                         "max_new_tokens": max_new},
+            }) + "\n")
+
+    def probe(i):
+        req = urllib.request.Request(
+            base + "/v1/completions",
+            data=json.dumps({
+                "tokens": [7, 8, 9 + i % 5], "max_new_tokens": 8,
+            }).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=300) as r:
+            return json.loads(r.read())["timing"]["ttft_ms"]
+
+    def p99(vals):
+        vals = sorted(vals)
+        return round(vals[min(int(0.99 * len(vals)), len(vals) - 1)], 3)
+
+    try:
+        probe(0)  # warm compiles (both prefill buckets + decode)
+        base_ttfts = [probe(i) for i in range(32)]
+
+        runner = BatchRunner(
+            inp, out, base_url=base, max_in_flight=64,
+            fsync_every=64,  # throughput leg; strict fsync is the
+            # two-process tests' job, not the bench's
+            metrics=MetricsRegistry(), flight=FlightRecorder(),
+        )
+        report = {}
+        t = threading.Thread(
+            target=lambda: report.update(runner.run()), daemon=True
+        )
+        t.start()
+        loaded_ttfts = []
+        while t.is_alive():
+            loaded_ttfts.append(probe(len(loaded_ttfts)))
+            time.sleep(0.05)
+        t.join(60)
+        assert report.get("status") == "completed", report
+        assert report["failed"] == 0, report
+        tok_s = report["tokens"] / max(report["wall_s"], 1e-9)
+        base_p99, loaded_p99 = p99(base_ttfts), p99(loaded_ttfts)
+        return {
+            "lines": n_lines,
+            "max_new_tokens": max_new,
+            "wall_s": report["wall_s"],
+            "tokens": report["tokens"],
+            "batch_tok_s": round(tok_s, 1),
+            "interactive_probes": len(loaded_ttfts),
+            "interactive_p99_ttft_ms_alone": base_p99,
+            "interactive_p99_ttft_ms_loaded": loaded_p99,
+            "batch_ttft_tax_ms": round(loaded_p99 - base_p99, 3),
+            "batch_preemptions": engine.batch_preemptions,
+        }
+    finally:
+        srv.shutdown()
+        srv.runner.shutdown()
 
 
 def bench_serving():

@@ -1,0 +1,61 @@
+"""Offline batch inference: file-in/file-out jobs on the serving stack.
+
+The interactive stack (continuous batching, fleet routing, rolling
+rollouts) leaves decode slots idle whenever live traffic dips; this
+package soaks them with DEADLINE-FREE work. An OpenAI-Batch-shaped
+JSONL goes in, an OpenAI-compatible output JSONL (plus a per-line
+error file) comes out, and everything in between backfills around
+live traffic through the engine's two-tier admission queue
+(``Engine.submit(tier="batch")`` — interactive always admits first,
+batch-tier slots are preempted-and-requeued when interactive arrivals
+need them; infer/engine.py).
+
+``jobfile``   the OpenAI Batch FILE format: per-line parse +
+              output/error record shapes, with per-line fault
+              isolation (a bad line errors, the job continues).
+``journal``   durable progress: an append-only fsynced results journal
+              + atomic-rename outputs (the checkpoint manifest's
+              discipline), so a SIGKILLed run RESUMES with exactly-once
+              output per ``custom_id``.
+``runner``    :class:`BatchRunner` — streams the input under a bounded
+              in-flight window into any completions endpoint (single
+              server or a fleet router, which shards lines across
+              backends), honouring the admission cap's 429/Retry-After
+              as backpressure.
+``service``   :class:`BatchManager` — the server-hosted job table
+              behind ``POST/GET /v1/batches`` (create/status/cancel).
+
+Surfaces: ``shifu_tpu batch run --input X.jsonl --output Y.jsonl
+[--router URL]`` (cli.py), the ``/v1/batches`` routes
+(infer/server.py), ``shifu_batch_*`` metrics (docs/observability.md),
+and the ``bench_batch_sustained`` bench leg.
+"""
+
+from shifu_tpu.batch.jobfile import (
+    BATCH_URLS,
+    BatchLineError,
+    error_record,
+    output_record,
+    parse_batch_line,
+)
+from shifu_tpu.batch.journal import (
+    BatchJournal,
+    JournalError,
+    file_fingerprint,
+)
+from shifu_tpu.batch.runner import BatchRunner, default_error_path
+from shifu_tpu.batch.service import BatchManager
+
+__all__ = [
+    "BATCH_URLS",
+    "BatchJournal",
+    "BatchLineError",
+    "BatchManager",
+    "BatchRunner",
+    "JournalError",
+    "default_error_path",
+    "error_record",
+    "file_fingerprint",
+    "output_record",
+    "parse_batch_line",
+]

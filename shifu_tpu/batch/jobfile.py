@@ -1,0 +1,118 @@
+"""OpenAI-Batch-shaped JSONL job files: per-line parsing + records.
+
+The external compatibility contract of the offline batch tier is the
+OpenAI Batch API FILE format (the reference mount is empty — the wire
+shape is the spec):
+
+input line::
+
+    {"custom_id": "req-1", "method": "POST",
+     "url": "/v1/completions" | "/v1/chat/completions",
+     "body": {...the ordinary request body...}}
+
+output line::
+
+    {"id": "batch_req_...", "custom_id": "req-1",
+     "response": {"status_code": 200, "body": {...}}, "error": null}
+
+error line::
+
+    {"id": "batch_req_...", "custom_id": "req-1", "response": null,
+     "error": {"message": "...", "code": "..."}}
+
+PER-LINE FAULT ISOLATION is the design rule everything here serves: a
+malformed line, an unknown url, or a body the server rejects produces
+ONE error record keyed by its ``custom_id`` (or the line number when
+even that is unreadable) and processing continues — a single bad line
+among a million must never abort the job (pinned by
+tests/test_batch.py).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional, Tuple
+
+# The endpoints a batch line may target — both resolve to the engine's
+# completions path; chat bodies go through the server's full message
+# rendering, exactly like live traffic.
+BATCH_URLS = ("/v1/completions", "/v1/chat/completions")
+
+
+class BatchLineError(ValueError):
+    """One input line is unusable. Carries the custom_id when the line
+    got far enough to have one — the error record stays joinable."""
+
+    def __init__(self, msg: str, custom_id: Optional[str] = None):
+        super().__init__(msg)
+        self.custom_id = custom_id
+
+
+def parse_batch_line(line: str, lineno: int) -> Tuple[str, str, dict]:
+    """Parse one input JSONL line -> ``(custom_id, url, body)``.
+
+    Raises :class:`BatchLineError` (never anything else) on any defect;
+    the message names the line number so operators can fix the file.
+    """
+    try:
+        doc = json.loads(line)
+    except ValueError as e:
+        raise BatchLineError(
+            f"line {lineno}: unparseable JSON: {e}"
+        ) from None
+    if not isinstance(doc, dict):
+        raise BatchLineError(f"line {lineno}: expected a JSON object")
+    cid = doc.get("custom_id")
+    if not isinstance(cid, str) or not cid:
+        raise BatchLineError(
+            f"line {lineno}: 'custom_id' must be a non-empty string"
+        )
+    method = doc.get("method", "POST")
+    if method != "POST":
+        raise BatchLineError(
+            f"line {lineno}: method {method!r} is not POST", custom_id=cid
+        )
+    url = doc.get("url")
+    if url not in BATCH_URLS:
+        raise BatchLineError(
+            f"line {lineno}: url {url!r} not in {BATCH_URLS}",
+            custom_id=cid,
+        )
+    body = doc.get("body")
+    if not isinstance(body, dict):
+        raise BatchLineError(
+            f"line {lineno}: 'body' must be an object", custom_id=cid
+        )
+    if body.get("stream"):
+        raise BatchLineError(
+            f"line {lineno}: batch bodies cannot stream", custom_id=cid
+        )
+    return cid, url, body
+
+
+def output_record(custom_id: str, status_code: int, body: dict) -> dict:
+    """One SUCCESS line of the output file (OpenAI batch shape)."""
+    return {
+        "id": f"batch_req_{custom_id}",
+        "custom_id": custom_id,
+        "response": {"status_code": int(status_code), "body": body},
+        "error": None,
+    }
+
+
+def error_record(custom_id: str, message: str,
+                 status_code: Optional[int] = None,
+                 code: str = "request_failed") -> dict:
+    """One FAILURE line of the error file. ``custom_id`` may be a
+    synthetic ``line-N`` handle when the line never yielded a real one
+    (unparseable JSON) — the record still lands, keyed as best we
+    can."""
+    err = {"message": str(message), "code": str(code)}
+    if status_code is not None:
+        err["status_code"] = int(status_code)
+    return {
+        "id": f"batch_req_{custom_id}",
+        "custom_id": custom_id,
+        "response": None,
+        "error": err,
+    }

@@ -1,0 +1,305 @@
+"""Durable batch-job progress: crash-safe journal + atomic outputs.
+
+A batch job over millions of lines WILL be interrupted — the runner
+SIGKILLed, the host preempted, the disk briefly full. The journal makes
+a rerun RESUME instead of redo, with exactly-once output per
+``custom_id``, using the same temp-file + fsync + atomic-rename
+discipline as the checkpoint manifest format
+(checkpoint/checkpointer.py — the one other place this repo promises
+"either the old artifact or the new one, never a torn one"):
+
+  * ``state.json`` — job identity: the input file's fingerprint
+    (size + sha256) and paths. Written via fsync + ``os.replace``. A
+    resume against a DIFFERENT input file is refused loudly — silently
+    merging journals of two inputs would interleave their outputs.
+  * ``results.jsonl`` — the append-only record of truth: one fsynced
+    JSON line per finished ``custom_id`` (ok or error). A SIGKILL can
+    tear at most the final line; the loader tolerates exactly that
+    (an unparseable TRAILING line is dropped — its request simply
+    reruns; an unparseable line in the middle is corruption and
+    raises).
+  * ``finalize()`` — composes the OpenAI-shaped output and error files
+    from the journal, first record per ``custom_id`` wins (a retry
+    that double-journaled cannot double-emit), written to temp files
+    and atomically renamed into place. The output file therefore
+    either does not exist or is complete.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import tempfile
+from typing import Dict, Optional
+
+STATE_NAME = "state.json"
+RESULTS_NAME = "results.jsonl"
+_FORMAT = "shifu-batch-journal-v1"
+
+
+class JournalError(RuntimeError):
+    """The journal is unusable for this job (fingerprint mismatch,
+    mid-file corruption, unwritable directory)."""
+
+
+def file_fingerprint(path: str) -> dict:
+    """Identity of an input file: byte count + sha256. One linear read
+    per run start — the price of refusing to resume a journal against
+    a different (edited, regenerated) input file."""
+    h = hashlib.sha256()
+    n = 0
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+            n += len(chunk)
+    return {"nbytes": n, "sha256": h.hexdigest()}
+
+
+def _fsync_write(path: str, data: bytes) -> None:
+    with open(path, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def _atomic_json(path: str, doc: dict) -> None:
+    tmp = path + ".tmp"
+    _fsync_write(tmp, json.dumps(doc, sort_keys=True).encode())
+    os.replace(tmp, path)
+
+
+class BatchJournal:
+    """Progress journal for ONE job, rooted at ``directory``.
+
+    Usage::
+
+        j = BatchJournal(dir)
+        done = j.begin(input_path)        # {} fresh, else resume set
+        ...
+        j.record(cid, "ok", output_record(...))   # per finished line
+        ...
+        j.finalize(output_path, error_path)
+
+    ``fsync_every``: fsync the results file every N records (1 = every
+    record, the strict default). A record that missed its fsync at a
+    SIGKILL is simply not journaled — the rerun redoes that request;
+    durability bounds duplicates at zero, not retries.
+    """
+
+    def __init__(self, directory: str, *, fsync_every: int = 1):
+        if fsync_every < 1:
+            raise ValueError("fsync_every must be >= 1")
+        self.dir = os.path.abspath(directory)
+        self.fsync_every = int(fsync_every)
+        self._f = None
+        self._since_sync = 0
+        self._done: Dict[str, str] = {}  # custom_id -> kind
+
+    # ------------------------------------------------------------ open
+    def begin(self, input_path: str,
+              fingerprint: Optional[dict] = None) -> Dict[str, str]:
+        """Create or resume the journal; returns {custom_id: kind} of
+        already-journaled lines (empty for a fresh job). Raises
+        :class:`JournalError` when an existing journal belongs to a
+        different input file."""
+        fp = fingerprint or file_fingerprint(input_path)
+        state_path = os.path.join(self.dir, STATE_NAME)
+        if os.path.exists(state_path):
+            try:
+                with open(state_path, "rb") as f:
+                    state = json.loads(f.read())
+            except (OSError, ValueError) as e:
+                raise JournalError(
+                    f"{self.dir}: unreadable {STATE_NAME}: {e}"
+                ) from e
+            if state.get("format") != _FORMAT:
+                raise JournalError(
+                    f"{self.dir}: journal format "
+                    f"{state.get('format')!r} != {_FORMAT!r}"
+                )
+            old = state.get("input", {})
+            if (old.get("sha256"), old.get("nbytes")) != (
+                fp["sha256"], fp["nbytes"]
+            ):
+                raise JournalError(
+                    f"{self.dir}: journal belongs to a different input "
+                    f"file (recorded sha256 {str(old.get('sha256'))[:12]}"
+                    f"… != {fp['sha256'][:12]}…); point --journal at a "
+                    "fresh directory or restore the original input"
+                )
+            self._done, valid_end = self._load_results()
+            # TRUNCATE the torn tail (a SIGKILL mid-append leaves no
+            # trailing newline): appending after it would concatenate
+            # the next record onto the fragment, corrupting BOTH.
+            rpath = os.path.join(self.dir, RESULTS_NAME)
+            if os.path.exists(rpath) and (
+                os.path.getsize(rpath) != valid_end
+            ):
+                with open(rpath, "r+b") as f:
+                    f.truncate(valid_end)
+                    f.flush()
+                    os.fsync(f.fileno())
+        else:
+            os.makedirs(self.dir, exist_ok=True)
+            _atomic_json(state_path, {
+                "format": _FORMAT,
+                "input": {
+                    "path": os.path.abspath(input_path), **fp,
+                },
+                "status": "in_progress",
+            })
+            self._done = {}
+        self._f = open(
+            os.path.join(self.dir, RESULTS_NAME), "ab", buffering=0
+        )
+        return dict(self._done)
+
+    def _load_results(self):
+        """-> (done, valid_end): journaled ids and the byte offset of
+        the end of the last VALID line (begin() truncates anything
+        past it — the torn tail of a SIGKILL mid-append)."""
+        path = os.path.join(self.dir, RESULTS_NAME)
+        done: Dict[str, str] = {}
+        if not os.path.exists(path):
+            return done, 0
+        with open(path, "rb") as f:
+            data = f.read()
+        lines = data.split(b"\n")
+        valid_end = 0
+        offset = 0
+        for i, raw in enumerate(lines):
+            end = offset + len(raw) + 1  # +1: the split newline
+            if not raw.strip():
+                offset = end
+                continue
+            try:
+                doc = json.loads(raw)
+                cid = doc["custom_id"]
+                kind = doc["kind"]
+            except (ValueError, KeyError, TypeError):
+                # A torn line is only legitimate at the very END
+                # (SIGKILL mid-append); anything unparseable earlier is
+                # corruption the operator must see.
+                tail = all(not r.strip() for r in lines[i + 1:])
+                if tail:
+                    break
+                raise JournalError(
+                    f"{path}: unparseable journal line {i + 1} with "
+                    "later lines present — journal corrupt"
+                ) from None
+            done.setdefault(str(cid), str(kind))
+            valid_end = min(end, len(data))
+            offset = end
+        return done, valid_end
+
+    # ---------------------------------------------------------- append
+    def record(self, custom_id: str, kind: str, record: dict) -> None:
+        """Journal one finished line (``kind``: "ok" | "error"). The
+        line is the record of truth — finalize() emits from here."""
+        if self._f is None:
+            raise JournalError("journal not begun")
+        if custom_id in self._done:
+            return  # exactly-once: first journaled result wins
+        line = json.dumps({
+            "custom_id": custom_id, "kind": kind, "record": record,
+        }) + "\n"
+        self._f.write(line.encode())
+        self._since_sync += 1
+        if self._since_sync >= self.fsync_every:
+            os.fsync(self._f.fileno())
+            self._since_sync = 0
+        self._done[custom_id] = kind
+
+    def done_ids(self) -> Dict[str, str]:
+        return dict(self._done)
+
+    # -------------------------------------------------------- finalize
+    def _entries(self):
+        """Every journaled (custom_id, kind, record), first per
+        custom_id wins, journal order preserved."""
+        path = os.path.join(self.dir, RESULTS_NAME)
+        seen = set()
+        out = []
+        if not os.path.exists(path):
+            return out
+        with open(path, "rb") as f:
+            for raw in f.read().split(b"\n"):
+                if not raw.strip():
+                    continue
+                try:
+                    doc = json.loads(raw)
+                    cid = str(doc["custom_id"])
+                except (ValueError, KeyError, TypeError):
+                    break  # torn tail (begin() vetted the middle)
+                if cid in seen:
+                    continue
+                seen.add(cid)
+                out.append((cid, str(doc.get("kind")), doc.get("record")))
+        return out
+
+    def finalize(self, output_path: str,
+                 error_path: Optional[str] = None) -> dict:
+        """Compose the output (and error) JSONL files from the journal
+        — one record per ``custom_id``, ok lines to ``output_path``,
+        error lines to ``error_path`` (skipped when None and no errors
+        exist; created empty when None-not-given but path provided).
+        Both files are written to temp files in the target directory,
+        fsynced, and atomically renamed — a crash mid-finalize leaves
+        the previous state, never a half-written output. Returns
+        counts."""
+        if self._f is not None:
+            os.fsync(self._f.fileno())
+        oks, errs = [], []
+        for cid, kind, record in self._entries():
+            (oks if kind == "ok" else errs).append(record)
+
+        def write_atomic(path, records):
+            path = os.path.abspath(path)
+            d = os.path.dirname(path) or "."
+            os.makedirs(d, exist_ok=True)
+            fd, tmp = tempfile.mkstemp(
+                prefix=os.path.basename(path) + ".tmp.", dir=d
+            )
+            try:
+                with os.fdopen(fd, "wb") as f:
+                    for r in records:
+                        f.write(json.dumps(r).encode() + b"\n")
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, path)
+            except BaseException:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise
+
+        write_atomic(output_path, oks)
+        if error_path is not None:
+            write_atomic(error_path, errs)
+        _atomic_json(os.path.join(self.dir, STATE_NAME), {
+            **json.loads(
+                open(os.path.join(self.dir, STATE_NAME), "rb").read()
+            ),
+            "status": "completed",
+            "completed": len(oks),
+            "failed": len(errs),
+        })
+        return {"completed": len(oks), "failed": len(errs)}
+
+    def close(self) -> None:
+        if self._f is not None:
+            try:
+                os.fsync(self._f.fileno())
+            except OSError:
+                pass
+            self._f.close()
+            self._f = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

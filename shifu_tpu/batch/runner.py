@@ -1,0 +1,355 @@
+"""BatchRunner: drive one file-in/file-out job through a serving stack.
+
+Reads an OpenAI-Batch-shaped JSONL (jobfile.py), POSTs each line's body
+— tagged ``tier: "batch"`` so the engine's two-tier queue backfills it
+around live traffic — to a completions endpoint over plain HTTP, and
+journals every finished line durably (journal.py). The endpoint may be
+a single engine server, a ``ReplicatedEngine`` server, or a
+``FleetRouter`` front-end (which shards the lines across its backends
+via its ordinary least-loaded routing); the runner neither knows nor
+cares — the HTTP surface IS the abstraction, exactly like the fleet.
+
+Flow control:
+
+  * a bounded in-flight window (``max_in_flight`` worker threads over a
+    bounded queue) — the runner never holds more than the window in
+    memory, so million-line inputs stream;
+  * ``429`` (the server's batch admission cap) honours ``Retry-After``
+    and retries FOREVER — a throttle is backpressure, not failure;
+  * ``503``/transport faults retry with capped exponential backoff up
+    to ``max_attempts`` (a fleet router already resubmits internally;
+    these retries cover a dead/restarting single server), then land in
+    the error file;
+  * other 4xx are the request's own fault: one error record, job
+    continues (per-line fault isolation).
+
+Exactly-once: a line is journaled once per ``custom_id`` (resume skips
+journaled ids; finalize dedups first-wins), so a SIGKILLed and resumed
+run emits exactly one output record per ``custom_id`` — retries can
+re-EXECUTE a request whose response was lost, never re-EMIT it.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import queue
+import threading
+import time
+from typing import Optional
+from urllib.parse import urlsplit
+
+from shifu_tpu.batch.jobfile import (
+    BatchLineError,
+    error_record,
+    output_record,
+    parse_batch_line,
+)
+from shifu_tpu.batch.journal import BatchJournal
+
+
+def default_error_path(output_path: str) -> str:
+    """`out.jsonl` -> `out.errors.jsonl` (else append `.errors.jsonl`)."""
+    if output_path.endswith(".jsonl"):
+        return output_path[: -len(".jsonl")] + ".errors.jsonl"
+    return output_path + ".errors.jsonl"
+
+
+class _HTTPClient:
+    """Minimal JSON POST client for one base URL (stdlib-only, like
+    fleet/backend.py). Returns (status, retry_after_s, parsed body)."""
+
+    def __init__(self, base_url: str, timeout_s: float):
+        u = urlsplit(base_url if "//" in base_url else "//" + base_url)
+        if u.scheme not in ("", "http"):
+            raise ValueError(f"unsupported scheme {u.scheme!r} (http only)")
+        self.host = u.hostname or "127.0.0.1"
+        self.port = u.port or 80
+        self.timeout_s = float(timeout_s)
+
+    def post(self, path: str, body: dict):
+        conn = http.client.HTTPConnection(
+            self.host, self.port, timeout=self.timeout_s
+        )
+        try:
+            conn.request(
+                "POST", path, json.dumps(body).encode(),
+                {"Content-Type": "application/json"},
+            )
+            resp = conn.getresponse()
+            ra = resp.getheader("Retry-After")
+            data = resp.read()
+            try:
+                doc = json.loads(data) if data else {}
+            except ValueError:
+                doc = {"error": data[:200].decode("utf-8", "replace")}
+            try:
+                retry_after = float(ra) if ra else None
+            except ValueError:
+                retry_after = None
+            return resp.status, retry_after, doc
+        finally:
+            conn.close()
+
+
+class BatchRunner:
+    """Run one batch job to completion (or until ``stop`` fires).
+
+    ``base_url`` — the serving endpoint ("http://host:port"); lines POST
+    to their own ``url`` under it. ``journal_dir`` defaults to
+    ``<output>.journal`` — point a rerun at the same paths and it
+    RESUMES. ``stop`` (a ``threading.Event``) requests a graceful halt:
+    in-flight requests finish and journal, nothing new is submitted,
+    and the job reports "cancelled" without finalizing (a later rerun
+    picks up where it stopped).
+    """
+
+    def __init__(
+        self, input_path: str, output_path: str, *, base_url: str,
+        error_path: Optional[str] = None,
+        journal_dir: Optional[str] = None,
+        tier: str = "batch",
+        max_in_flight: int = 32,
+        request_timeout_s: float = 300.0,
+        max_attempts: int = 6,
+        backoff_s: float = 0.25,
+        backoff_cap_s: float = 10.0,
+        fsync_every: int = 1,
+        metrics=None, flight=None,
+        stop: Optional[threading.Event] = None,
+    ):
+        if max_in_flight < 1:
+            raise ValueError("max_in_flight must be >= 1")
+        if max_attempts < 1:
+            raise ValueError("max_attempts must be >= 1")
+        from shifu_tpu import obs as _obs
+
+        self.input_path = input_path
+        self.output_path = output_path
+        self.error_path = (
+            error_path if error_path is not None
+            else default_error_path(output_path)
+        )
+        self.journal_dir = (
+            journal_dir if journal_dir is not None
+            else output_path + ".journal"
+        )
+        self.tier = str(tier)
+        self.max_in_flight = int(max_in_flight)
+        self.max_attempts = int(max_attempts)
+        self.backoff_s = float(backoff_s)
+        self.backoff_cap_s = float(backoff_cap_s)
+        self.client = _HTTPClient(base_url, request_timeout_s)
+        self.metrics = metrics if metrics is not None else _obs.REGISTRY
+        self.flight = flight if flight is not None else _obs.FLIGHT
+        self.stop = stop if stop is not None else threading.Event()
+        self._journal = BatchJournal(
+            self.journal_dir, fsync_every=fsync_every
+        )
+        self._jlock = threading.Lock()  # journal appends + progress
+        # Live progress (the /v1/batches status surface — service.py
+        # polls this dict; plain ints under _jlock).
+        self.progress = {
+            "total": 0, "completed": 0, "failed": 0,
+            "skipped_resume": 0, "retries": 0, "tokens": 0,
+            "in_flight": 0,
+        }
+
+        m = self.metrics
+        self._c_requests = m.counter(
+            "shifu_batch_requests_total",
+            "Batch job lines finished, by outcome",
+            labelnames=("outcome",),
+        )
+        self._c_retries = m.counter(
+            "shifu_batch_retries_total",
+            "Batch request retries, by reason (throttled = the "
+            "admission cap's 429; unavailable = 503/transport)",
+            labelnames=("reason",),
+        )
+        self._c_skipped = m.counter(
+            "shifu_batch_skipped_resume_total",
+            "Input lines skipped on resume (already journaled)",
+        ).labels()
+        self._c_tokens = m.counter(
+            "shifu_batch_tokens_total",
+            "Completion tokens returned to batch jobs",
+        ).labels()
+        self._g_inflight = m.gauge(
+            "shifu_batch_in_flight",
+            "Batch requests currently in flight at the runner",
+        ).labels()
+
+    # ------------------------------------------------------------- core
+    def _bump(self, key: str, n: int = 1) -> None:
+        with self._jlock:
+            self.progress[key] += n
+
+    def _journal_done(self, cid: str, kind: str, record: dict) -> None:
+        with self._jlock:
+            self._journal.record(cid, kind, record)
+            self.progress["completed" if kind == "ok" else "failed"] += 1
+        self._c_requests.labels(outcome=kind).inc()
+
+    def _sleep(self, s: float) -> None:
+        # Interruptible by stop — a cancelled job must not sit out a
+        # long Retry-After before noticing.
+        self.stop.wait(min(max(s, 0.05), 60.0))
+
+    def _run_one(self, cid: str, url: str, body: dict) -> None:
+        body = dict(body)
+        body["tier"] = self.tier
+        body.pop("stream", None)
+        attempt = 0
+        while True:
+            if self.stop.is_set():
+                return  # not journaled: the resume re-runs it
+            try:
+                status, retry_after, doc = self.client.post(url, body)
+            except OSError as e:
+                status, retry_after, doc = None, None, {"error": repr(e)}
+            if status == 200:
+                usage = doc.get("usage") or {}
+                n_tok = usage.get("completion_tokens")
+                if isinstance(n_tok, int):
+                    self._bump("tokens", n_tok)
+                    self._c_tokens.inc(n_tok)
+                self._journal_done(cid, "ok", output_record(cid, 200, doc))
+                return
+            if status == 429:
+                # The admission cap's backpressure: wait as told and
+                # try again, forever — a throttle is not a failure.
+                self._c_retries.labels(reason="throttled").inc()
+                self._bump("retries")
+                self._sleep(retry_after or self.backoff_s)
+                continue
+            retryable = status is None or status in (502, 503, 504)
+            if retryable and attempt + 1 < self.max_attempts:
+                self._c_retries.labels(reason="unavailable").inc()
+                self._bump("retries")
+                delay = min(
+                    self.backoff_cap_s, self.backoff_s * (2.0 ** attempt)
+                )
+                self._sleep(retry_after or delay)
+                attempt += 1
+                continue
+            msg = doc.get("error") if isinstance(doc, dict) else None
+            self._journal_done(cid, "error", error_record(
+                cid, str(msg or f"request failed (HTTP {status})"),
+                status_code=status,
+                code="unavailable" if retryable else "bad_request",
+            ))
+            return
+
+    def _worker(self, q: "queue.Queue") -> None:
+        while True:
+            item = q.get()
+            if item is None:
+                return
+            cid, url, body = item
+            self._bump("in_flight")
+            self._g_inflight.set(self.progress["in_flight"])
+            try:
+                self._run_one(cid, url, body)
+            except Exception as e:  # a worker bug fails ITS line only
+                self._journal_done(cid, "error", error_record(
+                    cid, f"runner internal error: {e!r}",
+                    code="runner_error",
+                ))
+            finally:
+                self._bump("in_flight", -1)
+                self._g_inflight.set(self.progress["in_flight"])
+                q.task_done()
+
+    def run(self) -> dict:
+        """Process the whole input; returns the job report. Raises
+        :class:`~shifu_tpu.batch.journal.JournalError` when the journal
+        refuses (different input file)."""
+        t0 = time.monotonic()
+        done = self._journal.begin(self.input_path)
+        self.flight.record(
+            "batch_job_start", input=self.input_path,
+            output=self.output_path, resumed=len(done),
+        )
+        q: "queue.Queue" = queue.Queue(maxsize=self.max_in_flight * 2)
+        workers = [
+            threading.Thread(
+                target=self._worker, args=(q,),
+                name=f"shifu-batch-{i}", daemon=True,
+            )
+            for i in range(self.max_in_flight)
+        ]
+        for w in workers:
+            w.start()
+        seen_ids = set(done)
+        try:
+            with open(self.input_path, "r", encoding="utf-8") as f:
+                for lineno, line in enumerate(f, start=1):
+                    if self.stop.is_set():
+                        break
+                    if not line.strip():
+                        continue
+                    self._bump("total")
+                    try:
+                        cid, url, body = parse_batch_line(line, lineno)
+                    except BatchLineError as e:
+                        # Per-line fault isolation: the defect lands in
+                        # the error file (keyed by custom_id when the
+                        # line had one) and the job continues.
+                        cid = e.custom_id or f"line-{lineno}"
+                        if cid in seen_ids:
+                            cid = f"line-{lineno}"
+                        seen_ids.add(cid)
+                        self._journal_done(cid, "error", error_record(
+                            cid, str(e), code="invalid_line",
+                        ))
+                        continue
+                    if cid in done:
+                        self._bump("skipped_resume")
+                        self._c_skipped.inc()
+                        continue
+                    if cid in seen_ids:
+                        dup = f"line-{lineno}"
+                        self._journal_done(dup, "error", error_record(
+                            dup,
+                            f"line {lineno}: duplicate custom_id "
+                            f"{cid!r} (first occurrence wins)",
+                            code="duplicate_custom_id",
+                        ))
+                        continue
+                    seen_ids.add(cid)
+                    while True:  # bounded window, stop-aware
+                        try:
+                            q.put((cid, url, body), timeout=0.2)
+                            break
+                        except queue.Full:
+                            if self.stop.is_set():
+                                break
+            q.join()  # drain in-flight (stop: workers finish current)
+        finally:
+            for _ in workers:
+                q.put(None)
+            for w in workers:
+                w.join(timeout=10)
+        cancelled = self.stop.is_set()
+        report = {
+            "status": "cancelled" if cancelled else "completed",
+            **{k: v for k, v in self.progress.items() if k != "in_flight"},
+            "wall_s": round(time.monotonic() - t0, 3),
+        }
+        if not cancelled:
+            counts = self._journal.finalize(
+                self.output_path, self.error_path
+            )
+            report.update(
+                output=self.output_path, error_file=self.error_path,
+                **{f"journal_{k}": v for k, v in counts.items()},
+            )
+        self._journal.close()
+        self.flight.record(
+            "batch_job_done", status=report["status"],
+            completed=report["completed"], failed=report["failed"],
+            wall_s=report["wall_s"],
+        )
+        return report

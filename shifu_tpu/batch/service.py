@@ -1,0 +1,177 @@
+"""BatchManager: server-hosted batch jobs behind ``/v1/batches``.
+
+The HTTP server (infer/server.py) exposes the OpenAI-ish management
+surface — create / status / cancel — and delegates the actual work to
+one :class:`~shifu_tpu.batch.runner.BatchRunner` thread per job. Each
+job POSTs its lines BACK through the server's own loopback address, so
+batch traffic takes the identical path live traffic takes (body
+parsing, tier admission, the 429 cap, metrics) instead of a privileged
+side door; when the server fronts a FleetRouter the lines fan out
+across the fleet for free.
+
+This is FILE-in/FILE-out on the server's filesystem (the operator's
+contract, like ``--ckpt-dir``): the create body names an
+``input_file`` path visible to the server and gets back where the
+output will land. There is no upload endpoint — move files with your
+own tooling, point the job at them.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+import time
+from typing import Dict, Optional
+
+from shifu_tpu.batch.runner import BatchRunner, default_error_path
+
+
+class _Job:
+    def __init__(self, jid: str, runner: BatchRunner, spec: dict):
+        self.id = jid
+        self.runner = runner
+        self.spec = spec
+        self.status = "in_progress"
+        self.report: Optional[dict] = None
+        self.error: Optional[str] = None
+        self.created_at = time.time()
+        self.thread: Optional[threading.Thread] = None
+
+
+class BatchManager:
+    """Track the server's batch jobs (create/get/list/cancel).
+
+    ``base_url_fn`` is called lazily per job to learn the server's own
+    loopback address (the port is only known after bind). Finished jobs
+    stay listed for the process lifetime — the status surface IS the
+    operator's receipt."""
+
+    MAX_JOBS = 64  # a server is not a job database; refuse past this
+
+    def __init__(self, base_url_fn, *, metrics=None, flight=None):
+        self._base_url_fn = base_url_fn
+        self.metrics = metrics
+        self.flight = flight
+        self._jobs: Dict[str, _Job] = {}
+        self._seq = itertools.count(1)
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------ create
+    def create(self, spec: dict) -> dict:
+        """Start one job from the POST /v1/batches body:
+        ``{"input_file": PATH, "output_file"?: PATH,
+        "error_file"?: PATH, "max_in_flight"?: N}``. Returns the
+        status document (OpenAI-"batch"-shaped). Raises ValueError on
+        a bad spec (the handler's 400)."""
+        inp = spec.get("input_file")
+        if not isinstance(inp, str) or not inp:
+            raise ValueError('batches need {"input_file": PATH}')
+        inp = os.path.abspath(inp)
+        if not os.path.isfile(inp):
+            raise ValueError(f"input_file {inp} does not exist")
+        out = spec.get("output_file") or (
+            (inp[:-len(".jsonl")] if inp.endswith(".jsonl") else inp)
+            + ".output.jsonl"
+        )
+        errf = spec.get("error_file") or default_error_path(out)
+        mif = spec.get("max_in_flight", 16)
+        if not isinstance(mif, int) or not (1 <= mif <= 256):
+            raise ValueError("max_in_flight must be an int in [1, 256]")
+        with self._lock:
+            active = sum(
+                1 for j in self._jobs.values()
+                if j.status == "in_progress"
+            )
+            if active >= 4:
+                raise ValueError(
+                    "too many active batch jobs (4); wait or cancel one"
+                )
+            if len(self._jobs) >= self.MAX_JOBS:
+                raise ValueError(
+                    f"job table full ({self.MAX_JOBS}); restart the "
+                    "server to clear finished jobs"
+                )
+            jid = f"batch_{next(self._seq):06d}"
+        runner = BatchRunner(
+            inp, out, base_url=self._base_url_fn(),
+            error_path=errf, max_in_flight=mif,
+            metrics=self.metrics, flight=self.flight,
+        )
+        job = _Job(jid, runner, {
+            "input_file": inp, "output_file": out, "error_file": errf,
+            "max_in_flight": mif,
+        })
+
+        def drive():
+            try:
+                job.report = runner.run()
+                job.status = (
+                    "cancelled" if job.report["status"] == "cancelled"
+                    else "completed"
+                )
+            except Exception as e:
+                job.status = "failed"
+                job.error = repr(e)
+
+        job.thread = threading.Thread(
+            target=drive, name=f"shifu-batch-job-{jid}", daemon=True
+        )
+        with self._lock:
+            self._jobs[jid] = job
+        job.thread.start()
+        return self.describe(jid)
+
+    # ------------------------------------------------------------ status
+    def _get(self, jid: str) -> _Job:
+        with self._lock:
+            job = self._jobs.get(jid)
+        if job is None:
+            raise KeyError(jid)
+        return job
+
+    def describe(self, jid: str) -> dict:
+        job = self._get(jid)
+        prog = dict(job.runner.progress)
+        doc = {
+            "id": job.id,
+            "object": "batch",
+            "status": job.status,
+            "created_at": int(job.created_at),
+            **job.spec,
+            "request_counts": {
+                "total": prog["total"],
+                "completed": prog["completed"],
+                "failed": prog["failed"],
+            },
+            "skipped_resume": prog["skipped_resume"],
+            "retries": prog["retries"],
+            "in_flight": prog["in_flight"],
+            "tokens": prog["tokens"],
+        }
+        if job.report is not None:
+            doc["report"] = job.report
+        if job.error is not None:
+            doc["error"] = job.error
+        return doc
+
+    def list(self) -> list:
+        with self._lock:
+            ids = list(self._jobs)
+        return [self.describe(j) for j in ids]
+
+    def cancel(self, jid: str) -> dict:
+        """Graceful cancel: nothing new submits, in-flight lines finish
+        and journal, the job reports "cancelled". A later POST
+        /v1/batches with the same files RESUMES from the journal."""
+        job = self._get(jid)
+        job.runner.stop.set()
+        return self.describe(jid)
+
+    def stats(self) -> Optional[dict]:
+        """The /statz "batch" block, or None when no job ever ran."""
+        with self._lock:
+            if not self._jobs:
+                return None
+            ids = list(self._jobs)
+        return {"jobs": [self.describe(j) for j in ids]}

@@ -1050,6 +1050,7 @@ def _serve_fleet(args, spec: str) -> int:
         trace_log=args.trace_log,
         watchdog=watchdog,
         flight_dump=args.flight_dump,
+        batch_backlog=args.batch_backlog,
     )
     print(
         json.dumps(
@@ -1130,6 +1131,7 @@ def cmd_serve(args) -> int:
         flight_dump=args.flight_dump,
         model_id=args.model_id,
         ckpt_path=args.ckpt_dir,
+        batch_backlog=args.batch_backlog,
     )
     print(
         json.dumps(
@@ -1150,6 +1152,80 @@ def cmd_serve(args) -> int:
         server.shutdown()
         server.runner.shutdown()
     return 0
+
+
+def cmd_batch(args) -> int:
+    """``shifu_tpu batch run --input X.jsonl --output Y.jsonl
+    [--router URL]`` — offline batch inference (shifu_tpu/batch).
+
+    Reads an OpenAI-Batch-shaped JSONL, runs every line at
+    ``tier="batch"`` (backfilling around interactive traffic through
+    the engine's two-tier queue), and writes an OpenAI-compatible
+    output JSONL plus a per-line error file. Progress journals durably
+    (fsync + atomic rename): a SIGKILLed run rerun with the same paths
+    RESUMES, emitting exactly one output record per ``custom_id``.
+    With ``--router`` the lines go to a live server or fleet-router
+    front-end (which shards them across its backends); without it an
+    in-process engine is built from the same flags ``serve`` takes.
+    SIGINT/SIGTERM stop gracefully (in-flight lines finish and
+    journal; exit 1 with status "cancelled"). Exit 0 only on a
+    completed job."""
+    import signal
+    import threading
+
+    from shifu_tpu.batch import BatchRunner, JournalError
+
+    stop = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            signal.signal(sig, lambda *_: stop.set())
+        except ValueError:
+            pass  # not the main thread (embedded use)
+
+    server = None
+    if args.router:
+        base_url = args.router
+    else:
+        from shifu_tpu.infer import make_server
+
+        model = _build_model(args)
+        params = _restore_params(args, model)
+        tok = _build_tokenizer(args)
+        try:
+            engine = build_serve_engine(args, model, params, tok)
+        except ValueError as e:
+            print(str(e), file=sys.stderr)
+            return 2
+        server = make_server(
+            engine, port=0, tokenizer=tok,
+            default_max_new=args.max_new_tokens,
+            batch_backlog=args.batch_backlog,
+            enable_batch_api=False,  # this process IS the job
+        )
+        threading.Thread(
+            target=server.serve_forever, daemon=True
+        ).start()
+        base_url = f"http://127.0.0.1:{server.server_port}"
+
+    try:
+        runner = BatchRunner(
+            args.input, args.output, base_url=base_url,
+            error_path=args.error_file, journal_dir=args.journal,
+            tier=args.tier, max_in_flight=args.max_in_flight,
+            request_timeout_s=args.request_timeout,
+            fsync_every=args.fsync_every, stop=stop,
+        )
+        try:
+            report = runner.run()
+        except (JournalError, OSError) as e:
+            print(str(e), file=sys.stderr)
+            return 2
+        print(json.dumps(report))
+        return 0 if report.get("status") == "completed" else 1
+    finally:
+        if server is not None:
+            server.shutdown()
+            server.runner.shutdown()
 
 
 def cmd_fleet(args) -> int:
@@ -1489,54 +1565,104 @@ def main(argv=None) -> int:
     b.add_argument("--out", required=True, help="output bpe.json path")
     b.set_defaults(fn=cmd_bpe_train)
 
+    def engine_flags(sp):
+        """The serving-ENGINE flag surface, shared by `serve` and
+        `batch` (batch's in-process mode builds the same engine via
+        build_serve_engine — one seam, one flag set)."""
+        sp.add_argument("--tokenizer",
+                        help="bpe-train artifact (bpe.json); "
+                             "default: byte tokenizer")
+        sp.add_argument("--max-slots", type=int, default=8)
+        sp.add_argument("--max-len", type=int, default=2048)
+        sp.add_argument("--max-new-tokens", type=int, default=128)
+        sp.add_argument("--temperature", type=float, default=0.8)
+        sp.add_argument("--top-p", type=float, default=0.95)
+        sp.add_argument("--decode-chunk", type=int, default=8,
+                        help="tokens decoded per host round-trip (1 = "
+                             "sync every token; higher amortises "
+                             "dispatch latency at the cost of "
+                             "chunk-granular admission)")
+        sp.add_argument("--eos-id", type=int, default=None,
+                        help="stop token id (default: byte-tokenizer "
+                             "eos; -1 disables eos stopping)")
+        sp.add_argument("--paged", action="store_true",
+                        help="paged KV pool instead of dense per-slot "
+                             "cache")
+        sp.add_argument("--page-size", type=int, default=64)
+        sp.add_argument("--n-pages", type=int, default=None,
+                        help="pool size (default: dense-equivalent)")
+        sp.add_argument("--prefix-cache", action="store_true",
+                        help="share page-aligned prompt prefixes "
+                             "across requests (paged only)")
+        sp.add_argument("--per-request-sampling", action="store_true",
+                        help="honour per-request temperature/top_k/"
+                             "top_p/min_p fields (traced per-slot "
+                             "sampler; costs one vocab partial-sort "
+                             "per row per step)")
+        sp.add_argument("--penalties", action="store_true",
+                        help="honour presence/frequency/repetition "
+                             "penalty fields (slots x vocab count "
+                             "buffer; implies --per-request-sampling)")
+        sp.add_argument("--logit-bias", action="store_true",
+                        help="honour logit_bias / allowed_token_ids "
+                             "fields (slots x vocab f32 bias buffer; "
+                             "implies --per-request-sampling)")
+        sp.add_argument("--kv", default="bf16",
+                        choices=["bf16", "int8", "int8-b16s"],
+                        help="KV-cache dtype for the paged pool: int8 "
+                             "halves KV bytes (capacity) at a decode-"
+                             "latency cost; int8-b16s narrows the "
+                             "scales to bf16 and recovers most of it "
+                             "(decision table: docs/observability.md)")
+        sp.add_argument("--mesh",
+                        help="serving mesh, e.g. dp=2,tp=2 or "
+                             "tp=2,ep=2: tp shards heads/mlp, ep "
+                             "shards MoE expert weights (instead of "
+                             "replicating them), dp model replicas "
+                             "behind one router (dp x tp x ep devices "
+                             "total)")
+        sp.add_argument("--lora-ckpt-dir", action="append",
+                        help="LoRA adapter checkpoint dir (repeatable; "
+                             "adapter ids are assigned 1..n in flag "
+                             'order; requests pick one via the '
+                             '"adapter" field)')
+        sp.add_argument("--lora-rank", type=int, default=8)
+        sp.add_argument("--lora-alpha", type=float, default=16.0)
+        sp.add_argument("--lora-targets", default="wq,wk,wv,wo")
+        sp.add_argument("--spec", default="off",
+                        choices=["off", "prompt-lookup", "draft"],
+                        help="speculative decoding: prompt-lookup "
+                             "proposes each request's own n-gram "
+                             "continuations (no draft model — wins on "
+                             "repetitive/structured text); draft uses "
+                             "a trained draft model")
+        sp.add_argument("--spec-k", type=int, default=8,
+                        help="proposed tokens per round")
+        sp.add_argument("--spec-ngram", type=int, default=3,
+                        help="prompt-lookup match length")
+        sp.add_argument("--spec-rounds", type=int, default=8,
+                        help="rounds per dispatch (the speculative "
+                             "analogue of --decode-chunk)")
+        sp.add_argument("--draft-preset",
+                        choices=["tiny", "small", "1b", "7b"],
+                        help="draft model preset (--spec draft)")
+        sp.add_argument("--draft-ckpt-dir",
+                        help="draft checkpoint (--spec draft)")
+
     s = sub.add_parser("serve", help="HTTP completions server")
     model_flags(s, schedule_default="constant")
-    s.add_argument("--tokenizer", help="bpe-train artifact (bpe.json); "
-                                       "default: byte tokenizer")
+    engine_flags(s)
     s.add_argument("--host", default="127.0.0.1")
     s.add_argument("--port", type=int, default=8000)
-    s.add_argument("--max-slots", type=int, default=8)
-    s.add_argument("--max-len", type=int, default=2048)
-    s.add_argument("--max-new-tokens", type=int, default=128)
-    s.add_argument("--temperature", type=float, default=0.8)
-    s.add_argument("--top-p", type=float, default=0.95)
-    s.add_argument("--decode-chunk", type=int, default=8,
-                   help="tokens decoded per host round-trip (1 = sync "
-                        "every token; higher amortises dispatch latency "
-                        "at the cost of chunk-granular admission)")
-    s.add_argument("--eos-id", type=int, default=None,
-                   help="stop token id (default: byte-tokenizer eos; "
-                        "-1 disables eos stopping)")
-    s.add_argument("--paged", action="store_true",
-                   help="paged KV pool instead of dense per-slot cache")
-    s.add_argument("--page-size", type=int, default=64)
-    s.add_argument("--n-pages", type=int, default=None,
-                   help="pool size (default: dense-equivalent)")
-    s.add_argument("--prefix-cache", action="store_true",
-                   help="share page-aligned prompt prefixes across "
-                        "requests (paged only)")
-    s.add_argument("--per-request-sampling", action="store_true",
-                   help="honour per-request temperature/top_k/top_p/"
-                        "min_p fields (traced per-slot sampler; costs "
-                        "one vocab partial-sort per row per step)")
-    s.add_argument("--penalties", action="store_true",
-                   help="honour presence/frequency/repetition penalty "
-                        "fields (slots x vocab count buffer; implies "
-                        "--per-request-sampling)")
-    s.add_argument("--logit-bias", action="store_true",
-                   help="honour logit_bias / allowed_token_ids fields "
-                        "(slots x vocab f32 bias buffer; implies "
-                        "--per-request-sampling)")
+    s.add_argument("--batch-backlog", type=int, default=None,
+                   help="admission cap for tier=\"batch\" requests: "
+                        "arrivals while the engine's batch backlog is "
+                        "at/over this depth get 429 + Retry-After "
+                        "(default: uncapped). The offline batch tier's "
+                        "OOM guard — shifu_tpu/batch")
     s.add_argument("--trace-log",
                    help="append one JSON line per completed request "
                         "(timing spans) to this file")
-    s.add_argument("--kv", default="bf16",
-                   choices=["bf16", "int8", "int8-b16s"],
-                   help="KV-cache dtype for the paged pool: int8 "
-                        "halves KV bytes (capacity) at a decode-"
-                        "latency cost; int8-b16s narrows the scales "
-                        "to bf16 and recovers most of it (decision "
-                        "table: docs/observability.md)")
     s.add_argument("--slo-p99-ttft-ms", type=float, default=None,
                    help="SLO budget: p99 TTFT over the rolling "
                         "completion window; breach flips /healthz to "
@@ -1560,12 +1686,6 @@ def main(argv=None) -> int:
                         "give each backend tier a distinct name "
                         "(gemma2-flash, mixtral-ep, mamba) and the "
                         "router 404s unknown ids")
-    s.add_argument("--mesh",
-                   help="serving mesh, e.g. dp=2,tp=2 or tp=2,ep=2: "
-                        "tp shards heads/mlp, ep shards MoE expert "
-                        "weights (instead of replicating them), dp "
-                        "model replicas behind one router "
-                        "(dp x tp x ep devices total)")
     s.add_argument("--fleet",
                    help="ROUTER mode: comma-separated backend roster "
                         "host:port,... (or SHIFU_FLEET env var). This "
@@ -1587,32 +1707,54 @@ def main(argv=None) -> int:
                    help="readiness gate requires EVERY roster entry "
                         "(default: any one backend suffices; the "
                         "prober brings stragglers in later)")
-    s.add_argument("--lora-ckpt-dir", action="append",
-                   help="LoRA adapter checkpoint dir (repeatable; "
-                        "adapter ids are assigned 1..n in flag order; "
-                        'requests pick one via the "adapter" field)')
-    s.add_argument("--lora-rank", type=int, default=8)
-    s.add_argument("--lora-alpha", type=float, default=16.0)
-    s.add_argument("--lora-targets", default="wq,wk,wv,wo")
-    s.add_argument("--spec", default="off",
-                   choices=["off", "prompt-lookup", "draft"],
-                   help="speculative decoding: prompt-lookup proposes "
-                        "each request's own n-gram continuations (no "
-                        "draft model — wins on repetitive/structured "
-                        "text); draft uses a trained draft model")
-    s.add_argument("--spec-k", type=int, default=8,
-                   help="proposed tokens per round")
-    s.add_argument("--spec-ngram", type=int, default=3,
-                   help="prompt-lookup match length")
-    s.add_argument("--spec-rounds", type=int, default=8,
-                   help="rounds per dispatch (the speculative analogue "
-                        "of --decode-chunk)")
-    s.add_argument("--draft-preset",
-                   choices=["tiny", "small", "1b", "7b"],
-                   help="draft model preset (--spec draft)")
-    s.add_argument("--draft-ckpt-dir",
-                   help="draft checkpoint (--spec draft)")
     s.set_defaults(fn=cmd_serve)
+
+    bt = sub.add_parser(
+        "batch",
+        help="offline batch inference (shifu_tpu/batch): run an "
+             "OpenAI-Batch-shaped JSONL through a serving endpoint — "
+             "file in, file out, resumable. `--router URL` sends the "
+             "lines to a live server/fleet router at tier=\"batch\" "
+             "(backfilling around its interactive traffic); without "
+             "it an in-process engine is built from the same flags "
+             "`serve` takes. SIGKILL-safe: progress journals durably "
+             "and a rerun with the same paths resumes with exactly "
+             "one output record per custom_id",
+    )
+    bt.add_argument("action", choices=["run"])
+    model_flags(bt, schedule_default="constant")
+    engine_flags(bt)
+    bt.add_argument("--input", required=True,
+                    help="input JSONL: one OpenAI-Batch line per "
+                         "request ({custom_id, method, url, body})")
+    bt.add_argument("--output", required=True,
+                    help="output JSONL path (written atomically at "
+                         "the end; exactly one record per custom_id)")
+    bt.add_argument("--error-file",
+                    help="per-line failure records (default: "
+                         "<output>.errors.jsonl)")
+    bt.add_argument("--journal",
+                    help="progress journal directory (default: "
+                         "<output>.journal). Reruns resume from it; "
+                         "it refuses a different input file")
+    bt.add_argument("--router",
+                    help="live serving endpoint URL (a single server "
+                         "or a fleet router front-end); omit to build "
+                         "an in-process engine from the model flags")
+    bt.add_argument("--max-in-flight", type=int, default=32,
+                    help="bounded in-flight request window")
+    bt.add_argument("--request-timeout", type=float, default=300.0)
+    bt.add_argument("--fsync-every", type=int, default=1,
+                    help="fsync the journal every N records (1 = "
+                         "strict, every record)")
+    bt.add_argument("--tier", default="batch",
+                    choices=["batch", "interactive"],
+                    help="admission tier the lines ride (batch "
+                         "backfills around live traffic)")
+    bt.add_argument("--batch-backlog", type=int, default=None,
+                    help="in-process mode: the local server's batch "
+                         "admission cap (429 + Retry-After past it)")
+    bt.set_defaults(fn=cmd_batch)
 
     fl = sub.add_parser(
         "fleet",

@@ -75,10 +75,12 @@ class _FleetRequest:
     streaming surface aliases these), cancel flag, and the stream the
     worker currently holds (closed to cancel remotely)."""
 
-    def __init__(self, rid: int, body: dict, model: Optional[str] = None):
+    def __init__(self, rid: int, body: dict, model: Optional[str] = None,
+                 tier: str = "interactive"):
         self.rid = rid
         self.body = body
         self.model = model             # route only to backends serving it
+        self.tier = tier               # admission tier (batch backfill)
         self.generated: List[int] = []
         self.logprobs: List[float] = []
         self.streamed = False          # first delta arrived
@@ -139,6 +141,7 @@ class FleetRouter:
         self.requests_completed = 0
         self.tokens_generated = 0
         self.cancellations = 0
+        self.batch_completed = 0  # batch-tier completions (SLO-exempt)
 
         # ENGINE_INTERFACE identity/config surface. The router has no
         # local model — beam/embeddings need device access and 400
@@ -296,7 +299,8 @@ class FleetRouter:
                sampling: Optional[SampleConfig] = None,
                stop_token_ids=None, stop_strings=None,
                logit_bias=None, allowed_token_ids=None, adapter=None,
-               regex=None, json_schema=None, model=None, **kw) -> int:
+               regex=None, json_schema=None, model=None,
+               tier: str = "interactive", **kw) -> int:
         """Route one request (engine-thread call — no HTTP here).
         Raises :class:`FleetUnavailable` when no backend is routable,
         so a fully-down fleet fails fast instead of queueing forever.
@@ -352,6 +356,13 @@ class FleetRouter:
             body["regex"] = regex
         if json_schema is not None:
             body["json_schema"] = json_schema
+        tier = str(tier)
+        if tier != "interactive":
+            # The tier rides the wire so the BACKEND's engine admits it
+            # through its own two-tier queue (interactive first, batch
+            # backfills, preempt-not-drop) — the router adds no policy
+            # of its own beyond SLO-window exemption.
+            body["tier"] = tier
 
         if self._pick(model=model) is None:
             raise FleetUnavailable(
@@ -362,7 +373,7 @@ class FleetRouter:
         with self._lock:
             rid = self._rid
             self._rid += 1
-            req = _FleetRequest(rid, body, model=model)
+            req = _FleetRequest(rid, body, model=model, tier=tier)
             self._reqs[rid] = req
         threading.Thread(
             target=self._route_one, args=(req,),
@@ -531,8 +542,16 @@ class FleetRouter:
         }
         if timing["decode_tokens_per_s"]:
             trace["decode_tokens_per_s"] = timing["decode_tokens_per_s"]
-        with self._trace_lock:
-            self._trace_window.append(trace)
+        if req.tier == "batch":
+            # Batch-tier completions stay out of the router's SLO
+            # window (same contract as Engine.latency_stats): backfill
+            # latency must not trip the watchdog's interactive p99
+            # budgets or brake a rollout.
+            with self._trace_lock:
+                self.batch_completed += 1
+        else:
+            with self._trace_lock:
+                self._trace_window.append(trace)
         self._finish(req, Completion(
             rid=req.rid, tokens=toks,
             finished_by=str(final.get("finished_by", "length")),
@@ -698,6 +717,26 @@ class FleetRouter:
                 vals.append(h["n_adapters"])
         return min(vals) if vals else 0
 
+    def queue_depths(self) -> Dict[str, int]:
+        """Per-tier backlog at THIS router: accepted requests whose
+        first token has not streamed yet, plus the backends' last-
+        probed batch queue depths (each backend's /healthz carries its
+        engine's ``queued_batch``). The server's batch admission cap
+        (429 + Retry-After) reads the "batch" entry — it bounds what a
+        runaway job can pile onto the fleet through this router."""
+        out = {"interactive": 0, "batch": 0}
+        with self._lock:
+            for r in self._reqs.values():
+                if not r.streamed:
+                    out[r.tier] = out.get(r.tier, 0) + 1
+        for b in self.backends:
+            h = b.health or {}
+            try:
+                out["batch"] += int(h.get("queued_batch", 0))
+            except (TypeError, ValueError):
+                pass
+        return out
+
     # ---------------------------------------------------- aggregation
     def counters(self) -> dict:
         """Pooled counters: the router's own lifecycle counts plus the
@@ -712,6 +751,7 @@ class FleetRouter:
             "cancellations": self.cancellations,
             "requests_completed": self.requests_completed,
             "tokens_generated": self.tokens_generated,
+            "batch_completed": self.batch_completed,
             "resubmissions": self.resubmissions,
             "retry_budget": round(self.policy.budget, 2),
         }
@@ -736,8 +776,10 @@ class FleetRouter:
         the fleet's honest client-visible number."""
         with self._trace_lock:
             win = list(self._trace_window)
+            batch = self.batch_completed
+        extra = {"batch_completions": batch} if batch else {}
         if not win:
-            return {"completions": 0}
+            return {"completions": 0, **extra}
 
         def pct(key, q):
             vals = sorted(t[key] for t in win if key in t)
@@ -746,6 +788,7 @@ class FleetRouter:
             return vals[min(int(q * len(vals)), len(vals) - 1)]
 
         out = {
+            **extra,
             "completions": len(win),
             "ttft_ms_p50": pct("ttft_ms", 0.50),
             "ttft_ms_p95": pct("ttft_ms", 0.95),

@@ -131,6 +131,11 @@ class _Request:
     # Tokens already cleared of stop matches (resume point for the
     # sweep's scan — keeps per-step stop checking incremental).
     stop_scanned: int = 0
+    # Admission tier (two-tier scheduling): "interactive" requests
+    # always admit first; "batch" requests backfill free decode slots
+    # and are PREEMPTED (re-queued, never dropped) when interactive
+    # arrivals need the capacity (shifu_tpu/batch).
+    tier: str = "interactive"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -183,6 +188,10 @@ ENGINE_INTERFACE = frozenset({
     # the /statz rollout block.
     "reload_params", "resume", "served_models", "rollout_note",
     "rollout_stats",
+    # two-tier admission surface (shifu_tpu/batch): per-tier queue
+    # depths — the server's batch admission cap (429 + Retry-After)
+    # reads the batch backlog here.
+    "queue_depths",
 })
 
 
@@ -190,6 +199,67 @@ class UnknownModelError(ValueError):
     """A request named a model no roster backend serves. The serving
     front-end maps this onto ``404`` (model-aware fleet routing —
     shifu_tpu/fleet/router.py); plain validation errors stay 400."""
+
+
+# Admission tiers, best first. Interactive traffic (the default) always
+# admits ahead of batch; batch work (shifu_tpu/batch — deadline-free
+# file-in/file-out jobs) backfills whatever decode capacity is left.
+TIERS = ("interactive", "batch")
+
+
+class TierQueue:
+    """The engine's request queue, split by admission tier.
+
+    Deque-shaped on purpose: ``append`` / ``appendleft`` / ``popleft``
+    / ``[0]`` / ``remove`` / iteration all behave like the single
+    ``collections.deque`` this replaces, except that every read-side
+    operation serves the INTERACTIVE tier first — ``[0]`` peeks the
+    interactive head while one exists, ``popleft`` pops it, iteration
+    yields interactive entries before batch entries. ``appendleft``
+    re-queues at the front of the request's OWN tier (the preemption
+    path: a preempted batch request must not jump ahead of interactive
+    arrivals, but must stay ahead of younger batch work)."""
+
+    def __init__(self):
+        self._q = {t: collections.deque() for t in TIERS}
+
+    def append(self, req) -> None:
+        self._q[req.tier].append(req)
+
+    def appendleft(self, req) -> None:
+        self._q[req.tier].appendleft(req)
+
+    def popleft(self):
+        for t in TIERS:
+            if self._q[t]:
+                return self._q[t].popleft()
+        raise IndexError("pop from an empty TierQueue")
+
+    def remove(self, req) -> None:
+        self._q[req.tier].remove(req)
+
+    def depth(self, tier: str) -> int:
+        return len(self._q[tier])
+
+    def depths(self) -> Dict[str, int]:
+        return {t: len(q) for t, q in self._q.items()}
+
+    def __getitem__(self, idx):
+        if idx != 0:
+            raise IndexError("TierQueue only exposes the head ([0])")
+        for t in TIERS:
+            if self._q[t]:
+                return self._q[t][0]
+        raise IndexError("peek into an empty TierQueue")
+
+    def __len__(self) -> int:
+        return sum(len(q) for q in self._q.values())
+
+    def __bool__(self) -> bool:
+        return any(self._q.values())
+
+    def __iter__(self):
+        return itertools.chain(*(self._q[t] for t in TIERS))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -326,6 +396,14 @@ class Engine:
         # deque being appended raises "mutated during iteration".
         self._trace_window = collections.deque(maxlen=256)
         self._trace_lock = threading.Lock()
+        # Batch-tier completions keep their OWN window: the SLO
+        # watchdog's interactive p99 budgets read latency_stats(),
+        # whose percentile keys come from _trace_window — deadline-free
+        # backfill work finishing slowly must not flip /healthz to
+        # degraded (shifu_tpu/batch; docs/architecture.md).
+        self._batch_window = collections.deque(maxlen=256)
+        self.batch_completed = 0
+        self.batch_preemptions = 0  # batch slots preempted for interactive
         # Completion/token running totals for counters() (plain ints:
         # the registry counters are the scrapeable mirror).
         self.requests_completed = 0
@@ -349,7 +427,7 @@ class Engine:
 
         self.cache = self._init_cache(cache_dtype)
         self._free = list(range(max_slots))[::-1]
-        self._queue: collections.deque = collections.deque()
+        self._queue = TierQueue()
         self._active: Dict[int, _Request] = {}  # slot -> request
         # Slots mid-way through a CHUNKED prefill (paged engines with
         # prefill_chunk set): they hold a slot + pages but do not decode
@@ -511,8 +589,15 @@ class Engine:
         json_schema: Optional[dict] = None,
         constraint=None,
         model: Optional[str] = None,
+        tier: str = "interactive",
     ) -> int:
         """Queue one request; returns its rid.
+
+        ``tier``: admission tier. "interactive" (the default) always
+        admits first; "batch" (the offline file-in/file-out workload —
+        shifu_tpu/batch) backfills free decode slots only and is
+        preempted back onto the queue (never dropped) when interactive
+        arrivals need its slot.
 
         ``model``: the OpenAI wire field, accepted for interface parity
         with the fleet router (which routes by it and 404s unknown
@@ -555,6 +640,10 @@ class Engine:
         (constrain.json_mode_dfa). ``constraint``: a prebuilt ``TokenFSM``
         instead of a pattern (reusable across requests — the
         per-state tables cache inside it)."""
+        if tier not in TIERS:
+            raise ValueError(
+                f"unknown admission tier {tier!r} (want one of {TIERS})"
+            )
         if sampling is not None and not self.per_request_sampling:
             raise ValueError(
                 "per-request sampling requires "
@@ -755,9 +844,10 @@ class Engine:
                 adapter=int(adapter) if adapter else 0,
                 constraint=constraint,
                 created_ts=time.monotonic(),
+                tier=tier,
             )
         )
-        self._g_queue.set(len(self._queue))
+        self._set_queue_gauges()
         return rid
 
     def add_adapter(self, lora_params) -> int:
@@ -820,7 +910,7 @@ class Engine:
                 self._queue.remove(req)
                 self.cancellations += 1
                 self._c_cancel.inc()
-                self._g_queue.set(len(self._queue))
+                self._set_queue_gauges()
                 return True
         for pool in (self._active, self._prefilling):
             for slot, req in list(pool.items()):
@@ -900,23 +990,35 @@ class Engine:
             p: phase.labels(replica=r, phase=p)
             for p in ("admit", "dispatch", "fold")
         }
-        self._h_ttft = m.histogram(
+        # Latency histograms labelled by admission tier: backfill batch
+        # traffic and interactive traffic must stay distinguishable on
+        # /metrics (the per-tier SLO surface — docs/observability.md).
+        ttft = m.histogram(
             "shifu_request_ttft_seconds",
             "Submit -> first token (per completed request)",
-            labelnames=("replica",),
-        ).labels(replica=r)
-        self._h_tpot = m.histogram(
+            labelnames=("replica", "tier"),
+        )
+        self._h_ttft = {
+            t: ttft.labels(replica=r, tier=t) for t in TIERS
+        }
+        tpot = m.histogram(
             "shifu_request_tpot_seconds",
             "Per-token decode time (decode span / decode tokens, one "
             "observation per decode token of a completed request)",
-            labelnames=("replica",),
-        ).labels(replica=r)
-        self._h_itl = m.histogram(
+            labelnames=("replica", "tier"),
+        )
+        self._h_tpot = {
+            t: tpot.labels(replica=r, tier=t) for t in TIERS
+        }
+        itl = m.histogram(
             "shifu_request_itl_seconds",
             "Inter-token latency measured per decode dispatch "
             "(dispatch+fold wall time / tokens a slot emitted in it)",
-            labelnames=("replica",),
-        ).labels(replica=r)
+            labelnames=("replica", "tier"),
+        )
+        self._h_itl = {
+            t: itl.labels(replica=r, tier=t) for t in TIERS
+        }
         reqs = m.counter(
             "shifu_requests_completed_total",
             "Completed requests by finish reason",
@@ -936,12 +1038,22 @@ class Engine:
             "cancel() calls that dropped a live request",
             labelnames=("replica",),
         ).labels(replica=r)
-        self._g_queue = m.gauge(
+        queue_g = m.gauge(
             "shifu_queue_depth",
-            "Engine-side request queue depth (updated on every "
-            "enqueue/dequeue)",
-            labelnames=("replica", "component"),
-        ).labels(replica=r, component="engine")
+            "Engine-side request queue depth by admission tier "
+            "(updated on every enqueue/dequeue)",
+            labelnames=("replica", "component", "tier"),
+        )
+        self._g_queue = {
+            t: queue_g.labels(replica=r, component="engine", tier=t)
+            for t in TIERS
+        }
+        self._c_tier_preempt = m.counter(
+            "shifu_batch_preemptions_total",
+            "Batch-tier slots preempted (re-queued) so an interactive "
+            "arrival could admit",
+            labelnames=("replica",),
+        ).labels(replica=r)
         self._g_active = m.gauge(
             "shifu_active_slots",
             "Occupied slots (decoding + mid-chunked-prefill)",
@@ -958,15 +1070,32 @@ class Engine:
         """Per-step gauge refresh (paged subclass adds pool gauges)."""
         self._g_active.set(self.active_slots)
 
+    def _set_queue_gauges(self) -> None:
+        """Refresh the per-tier queue-depth gauges (every enqueue /
+        dequeue path calls this, so depth over time is scrapeable)."""
+        for t, d in self._queue.depths().items():
+            self._g_queue[t].set(d)
+
+    def queue_depths(self) -> Dict[str, int]:
+        """Queued (not yet admitted) requests per admission tier — the
+        ENGINE_INTERFACE surface behind the server's batch admission
+        cap (backlog past the cap -> 429 + Retry-After)."""
+        return self._queue.depths()
+
     def counters(self) -> dict:
         """Uniform observability counters — the /healthz//statz
         protocol (no more hasattr probing; every engine class answers
         the same way; the dp router aggregates with a per-replica
         breakdown)."""
+        depths = self._queue.depths()
         return {
             "active_slots": self.active_slots,
             "max_slots": self.max_slots,
             "queued": len(self._queue),
+            "queued_interactive": depths["interactive"],
+            "queued_batch": depths["batch"],
+            "batch_completed": self.batch_completed,
+            "batch_preemptions": self.batch_preemptions,
             "cancellations": self.cancellations,
             "requests_completed": self.requests_completed,
             "tokens_generated": self.tokens_generated,
@@ -1099,9 +1228,24 @@ class Engine:
         t_step = None if self.idle else time.monotonic()
         t_admit = time.monotonic()
         admitted = 0
-        while self._free and self._queue:
-            if not self._try_admit(self._queue[0]):
-                break  # admission blocked (e.g. paged pool dry): wait
+        while self._queue:
+            head = self._queue[0]  # interactive tier first (TierQueue)
+            if not self._free:
+                # Every slot is occupied. An INTERACTIVE head may
+                # preempt a batch-tier slot (the request re-queues with
+                # its generated tokens and recomputes later — batch
+                # work backfills capacity, it never holds it against
+                # live traffic). A batch head just waits.
+                if head.tier == "interactive" and self._preempt_batch_slot():
+                    continue
+                break
+            if not self._try_admit(head):
+                # Admission blocked with a free slot (e.g. paged pool
+                # dry): batch-held pages are fair game for an
+                # interactive head too.
+                if head.tier == "interactive" and self._preempt_batch_slot():
+                    continue
+                break
             self._queue.popleft()
             admitted += 1
         # One prompt chunk per prefilling slot per step, so a long
@@ -1113,7 +1257,7 @@ class Engine:
             # every-step zero would drown the histogram.
             self._h_phase["admit"].observe(time.monotonic() - t_admit)
         if admitted:
-            self._g_queue.set(len(self._queue))
+            self._set_queue_gauges()
         # Requests can finish AT admission (prefill sampled eos, or a
         # 1-token budget) — sweep before decoding would append an extra
         # token past eos/budget.
@@ -1273,15 +1417,66 @@ class Engine:
         self._h_phase["dispatch"].observe(t1 - t0)
         self._h_phase["fold"].observe(t2 - t1)
         dt = t2 - t0
-        for n in emitted.values():
+        for slot, n in emitted.items():
             if n > 0:
-                self._h_itl.observe(dt / n, n=n)
+                req = self._active.get(slot)
+                tier = req.tier if req is not None else "interactive"
+                self._h_itl[tier].observe(dt / n, n=n)
 
     def _try_admit(self, req: "_Request") -> bool:
         """Admit ``req`` (a free slot is guaranteed by the caller).
         Subclasses may refuse (return False) to leave it queued."""
         self._admit(req)
         return True
+
+    # ------------------------------------------ two-tier preemption
+    def _preemptable(self, req: "_Request") -> bool:
+        """Can this in-flight request be preempted and LATER re-admitted?
+        Base engines re-prefill prompt+generated in one bucket, so the
+        recompute prompt must fit the largest bucket; the paged engine
+        overrides to True (its submit() already bounds the worst-case
+        recompute)."""
+        return len(req.tokens) + len(req.generated) <= self.buckets[-1]
+
+    def _preempt_batch_slot(self) -> bool:
+        """Preempt the YOUNGEST preemptable batch-tier slot (decoding
+        or mid-chunked-prefill) so an interactive arrival can admit;
+        False when no batch slot is held. The victim re-enters its own
+        tier's queue HEAD with its generated tokens intact and
+        recomputes on re-admission — re-queued, never dropped (the
+        two-tier contract; docs/architecture.md "Offline batch
+        tier")."""
+        pools = list(self._active.items()) + list(self._prefilling.items())
+        order = getattr(self, "_admit_order", None)
+        if order is not None:
+            pools.sort(key=lambda kv: order.get(kv[0], 0))
+        for slot, req in reversed(pools):
+            if req.tier == "batch" and self._preemptable(req):
+                self._preempt(slot)
+                self.batch_preemptions += 1
+                self._c_tier_preempt.inc()
+                return True
+        return False
+
+    def _preempt(self, slot: int) -> None:
+        """Free a slot mid-flight; the request re-enters its tier's
+        queue head and re-prefills from prompt + generated-so-far at
+        its next admission (recompute). The paged engine overrides
+        with page-pool bookkeeping."""
+        req = self._active.pop(slot, None)
+        if req is None:
+            req = self._prefilling.pop(slot)
+        req.prefilled = 0
+        self._release(slot)
+        self._free.append(slot)
+        req.slot = None
+        self._queue.appendleft(req)
+        req.preempts += 1
+        self._set_queue_gauges()
+        self.flight.record(
+            "preempt", replica=self.replica_label, rid=req.rid,
+            slot=slot, generated=len(req.generated),
+        )
 
     def _pre_decode(self, k: int) -> None:
         """Hook before each decode dispatch of up to ``k`` tokens per
@@ -1940,16 +2135,24 @@ class Engine:
             t["decode_tokens_per_s"] = round(
                 (n_tokens - 1) / (decode_ms / 1000), 1
             )
+        # Batch-tier completions land in their OWN window: the SLO
+        # watchdog's interactive p99 budgets read the percentile keys
+        # latency_stats() derives from _trace_window, and deadline-free
+        # backfill must not flip /healthz to degraded.
         with self._trace_lock:
-            self._trace_window.append(t)
+            if req.tier == "batch":
+                self._batch_window.append(t)
+                self.batch_completed += 1
+            else:
+                self._trace_window.append(t)
         # Registry mirrors: one ttft observation per request, one
         # tpot observation per DECODE token (so histogram counts line
         # up with request/token totals on the scrape side).
         self.requests_completed += 1
         self.tokens_generated += n_tokens
-        self._h_ttft.observe(ttft / 1000.0)
+        self._h_ttft[req.tier].observe(ttft / 1000.0)
         if n_tokens > 1 and decode_ms > 0:
-            self._h_tpot.observe(
+            self._h_tpot[req.tier].observe(
                 decode_ms / 1000.0 / (n_tokens - 1), n=n_tokens - 1
             )
         self._c_requests.get(
@@ -2005,11 +2208,29 @@ class Engine:
         TAIL is the high percentile); per-request decode throughput
         reports p50/p05 (throughput: the tail is the LOW percentile —
         `decode_tokens_per_s_p05` is the slow-request floor SLOs are
-        written against)."""
+        written against).
+
+        INTERACTIVE-tier only: the percentile keys here feed the SLO
+        watchdog's p99 budgets, and batch-tier backfill (deadline-free
+        by definition) must not flip /healthz to degraded. Batch
+        completions are counted separately (``batch_completions`` +
+        ``batch_decode_tokens_per_s_p50``)."""
         with self._trace_lock:
             win = list(self._trace_window)
+            bwin = list(self._batch_window)
+        base = {"completions": 0}
+        if bwin:
+            base["batch_completions"] = self.batch_completed
+            vals = sorted(
+                t["decode_tokens_per_s"] for t in bwin
+                if "decode_tokens_per_s" in t
+            )
+            if vals:
+                base["batch_decode_tokens_per_s_p50"] = vals[
+                    min(len(vals) // 2, len(vals) - 1)
+                ]
         if not win:
-            return {"completions": 0}
+            return base
 
         def pct(key, q):
             vals = sorted(t[key] for t in win if key in t)
@@ -2018,6 +2239,7 @@ class Engine:
             return vals[min(int(q * len(vals)), len(vals) - 1)]
 
         out = {
+            **base,
             "completions": len(win),
             "ttft_ms_p50": pct("ttft_ms", 0.50),
             "ttft_ms_p95": pct("ttft_ms", 0.95),
@@ -2039,7 +2261,8 @@ class Engine:
             out["req_itl_ms_p99"] = round(1000.0 / slow, 3)
         # Token-level distributions come from the registry histograms
         # (the trace window is per-request; ITL/TPOT are per-token).
-        lab = {"replica": self.replica_label}
+        # Interactive tier only, like the window percentiles above.
+        lab = {"replica": self.replica_label, "tier": "interactive"}
         for key, name, q in (
             ("itl_ms_p50", "shifu_request_itl_seconds", 0.50),
             ("itl_ms_p99", "shifu_request_itl_seconds", 0.99),
@@ -2065,10 +2288,15 @@ class Engine:
     def _admit(self, req: _Request) -> None:
         slot = self._free.pop()
         req.slot = slot
-        p = len(req.tokens)
+        # Recompute path (re-admission after a batch-tier preemption):
+        # generated-so-far becomes part of the prompt, exactly like the
+        # paged engine's recompute — the re-prefill replays the whole
+        # context and samples the NEXT token.
+        prompt = req.tokens + req.generated
+        p = len(prompt)
         bucket = self._bucket_for(p)
         padded = np.zeros((bucket,), np.int32)
-        padded[:p] = req.tokens
+        padded[:p] = prompt
         self._rng, sub = jax.random.split(self._rng)
         with self._timed_prefill(req):
             first, lp = self._dispatch_prefill(
@@ -2597,12 +2825,17 @@ class PagedEngine(Engine):
         req.preempts += 1
         self.preemptions += 1
         self._c_preempt.inc()
-        self._g_queue.set(len(self._queue))
+        self._set_queue_gauges()
         self.flight.record(
             "preempt", replica=self.replica_label, rid=req.rid,
             slot=slot, generated=len(req.generated),
             free_pages=len(self._free_pages),
         )
+
+    def _preemptable(self, req: "_Request") -> bool:
+        """Always: submit() already refused any request whose worst-case
+        recompute prefill could not be re-admitted."""
+        return True
 
     @staticmethod
     def _chain_key(parent: bytes, page_tokens) -> bytes:

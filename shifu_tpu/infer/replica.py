@@ -235,6 +235,15 @@ class ReplicatedEngine:
     def rollout_stats(self):
         return None
 
+    def queue_depths(self) -> Dict[str, int]:
+        """Per-tier queued totals summed over replicas (the batch
+        admission cap's backlog surface — ENGINE_INTERFACE)."""
+        out: Dict[str, int] = {}
+        for e in self.engines:
+            for t, d in e.queue_depths().items():
+                out[t] = out.get(t, 0) + d
+        return out
+
     def reload_params(self, params) -> None:
         """Hot-swap serving weights on EVERY replica (each re-places
         the tree onto its own sub-mesh via its live leaf shardings).
@@ -345,8 +354,13 @@ class ReplicatedEngine:
                 {"replica": i, "completions": len(win),
                  "routed": self.routed[i]}
             )
+        # Pooled batch-tier completion count (the interactive-only
+        # percentile contract matches Engine.latency_stats: batch
+        # backfill must not move the watchdog's p99 keys).
+        batch = sum(getattr(e, "batch_completed", 0) for e in self.engines)
+        extra = {"batch_completions": batch} if batch else {}
         if not wins:
-            return {"completions": 0, "replicas": per}
+            return {"completions": 0, "replicas": per, **extra}
 
         def pct(key, q):
             vals = sorted(t[key] for t in wins if key in t)
@@ -355,6 +369,7 @@ class ReplicatedEngine:
             return vals[min(int(q * len(vals)), len(vals) - 1)]
 
         out = {
+            **extra,
             "completions": len(wins),
             "ttft_ms_p50": pct("ttft_ms", 0.50),
             "ttft_ms_p95": pct("ttft_ms", 0.95),
@@ -382,7 +397,7 @@ class ReplicatedEngine:
                 ("tpot_ms_p50", "shifu_request_tpot_seconds", 0.50),
                 ("tpot_ms_p99", "shifu_request_tpot_seconds", 0.99),
             ):
-                v = self.metrics.quantile(name, q)
+                v = self.metrics.quantile(name, q, {"tier": "interactive"})
                 if v is not None:
                     out[key] = round(v * 1000.0, 3)
         return out

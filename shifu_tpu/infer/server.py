@@ -77,6 +77,28 @@ one step.
                           metrics (shifu_rollout_*), flight ring
                           (rollout_* events), and /statz "rollout"
                           block. Fleet servers only.
+    POST /v1/batches      {"input_file": PATH, "output_file"?: PATH,
+                          "error_file"?: PATH, "max_in_flight"?: N}
+                          — start an offline batch job over an
+                          OpenAI-Batch-shaped JSONL on the server's
+                          filesystem (shifu_tpu/batch). Lines loop
+                          back through this server's completions
+                          endpoint at tier="batch", backfilling free
+                          decode slots around live traffic (a fleet
+                          front-end shards them across backends).
+                          GET /v1/batches[/ID] lists/describes jobs;
+                          POST /v1/batches/ID/cancel stops one
+                          gracefully (a later create with the same
+                          files RESUMES from the job's journal).
+
+Two-tier admission: request bodies may carry ``"tier": "batch"`` — the
+engine admits interactive work first and batch work backfills whatever
+decode capacity is left (preempted-and-requeued, never dropped, when
+interactive arrivals need the slot). ``serve --batch-backlog N`` caps
+the batch backlog: arrivals past the cap get ``429`` with
+``Retry-After`` (backpressure the BatchRunner honours), so a mis-sized
+job cannot OOM the queue. Batch completions are EXCLUDED from the SLO
+watchdog's interactive p99 windows (Engine.latency_stats).
 
 Model-aware routing: requests may carry the OpenAI "model" field. A
 fleet router routes them least-loaded among the backends whose
@@ -472,6 +494,7 @@ class _Submission:
     regex: Optional[str] = None
     json_schema: Optional[dict] = None
     model: Optional[str] = None
+    tier: str = "interactive"
 
 
 @dataclasses.dataclass
@@ -641,14 +664,14 @@ class EngineRunner:
         sampling: Optional[SampleConfig] = None,
         stop_token_ids=None, stop_strings=None,
         logit_bias=None, allowed_token_ids=None, adapter=None,
-        regex=None, json_schema=None, model=None,
+        regex=None, json_schema=None, model=None, tier="interactive",
     ) -> Completion:
         return self.complete_n(
             tokens, max_new_tokens, 1, timeout=timeout, sampling=sampling,
             stop_token_ids=stop_token_ids, stop_strings=stop_strings,
             logit_bias=logit_bias, allowed_token_ids=allowed_token_ids,
             adapter=adapter, regex=regex, json_schema=json_schema,
-            model=model,
+            model=model, tier=tier,
         )[0]
 
     def complete_n(
@@ -657,7 +680,7 @@ class EngineRunner:
         sampling: Optional[SampleConfig] = None,
         stop_token_ids=None, stop_strings=None,
         logit_bias=None, allowed_token_ids=None, adapter=None,
-        regex=None, json_schema=None, model=None,
+        regex=None, json_schema=None, model=None, tier="interactive",
     ):
         """N independent completions of one prompt (the API's ``n``).
 
@@ -690,7 +713,7 @@ class EngineRunner:
                         logit_bias=logit_bias,
                         allowed_token_ids=allowed_token_ids,
                         adapter=adapter, regex=regex,
-                        json_schema=json_schema, model=model,
+                        json_schema=json_schema, model=model, tier=tier,
                     )
                 )
         self._g_inbox.set(len(self._inbox))
@@ -801,7 +824,8 @@ class EngineRunner:
                sampling: Optional[SampleConfig] = None,
                stop_token_ids=None, stop_strings=None,
                logit_bias=None, allowed_token_ids=None, adapter=None,
-               regex=None, json_schema=None, model=None):
+               regex=None, json_schema=None, model=None,
+               tier="interactive"):
         """Returns a generator of ("delta", (ids, logprobs)) items
         ending with ("done", Completion); tokens arrive as the engine
         emits them (per decode chunk). The submission (and the
@@ -826,7 +850,7 @@ class EngineRunner:
                     logit_bias=logit_bias,
                     allowed_token_ids=allowed_token_ids,
                     adapter=adapter, regex=regex,
-                    json_schema=json_schema, model=model,
+                    json_schema=json_schema, model=model, tier=tier,
                 )
             )
         self._g_inbox.set(len(self._inbox))
@@ -1107,6 +1131,7 @@ class EngineRunner:
                     allowed_token_ids=sub.allowed_token_ids,
                     adapter=sub.adapter, regex=sub.regex,
                     json_schema=sub.json_schema, model=sub.model,
+                    tier=sub.tier,
                 )
             except Exception as e:  # validation error -> the caller
                 with self._lock:
@@ -1239,6 +1264,14 @@ class _Handler(BaseHTTPRequestHandler):
     # Operator-chosen model id for /v1/models (multi-model fleets route
     # by it); None falls back to the model class name.
     model_id: Optional[str] = None
+    # Batch admission cap (serve --batch-backlog): a batch-tier request
+    # arriving while the engine's batch backlog is at/over this depth
+    # gets 429 + Retry-After — a mis-sized job cannot OOM the queue.
+    # None = uncapped.
+    batch_backlog_max: Optional[int] = None
+    # The server-hosted batch-job table behind /v1/batches
+    # (shifu_tpu/batch/service.py); wired by make_server.
+    batches = None
     # Probed once per server (set on the per-server BoundHandler
     # subclass; a benign race — concurrent probes compute the same
     # value): does apply_chat_template accept a tools kwarg, and does
@@ -1345,6 +1378,13 @@ class _Handler(BaseHTTPRequestHandler):
             roll = eng.rollout_stats()
             if roll is not None:
                 out["rollout"] = roll
+            # Batch block: the server-hosted /v1/batches job table
+            # (None before any job — the block only appears once the
+            # offline tier has been used).
+            if self.batches is not None:
+                batch = self.batches.stats()
+                if batch is not None:
+                    out["batch"] = batch
             self._send(200, out)
         elif self.path == "/v1/models":
             eng = self.runner.engine
@@ -1390,6 +1430,26 @@ class _Handler(BaseHTTPRequestHandler):
                     "adapter": i,
                 })
             self._send(200, {"object": "list", "data": data})
+        elif self.path == "/v1/batches":
+            if self.batches is None:
+                self._send(400, {
+                    "error": "batch jobs are disabled on this server",
+                })
+                return
+            self._send(200, {
+                "object": "list", "data": self.batches.list(),
+            })
+        elif self.path.startswith("/v1/batches/"):
+            if self.batches is None:
+                self._send(400, {
+                    "error": "batch jobs are disabled on this server",
+                })
+                return
+            jid = self.path[len("/v1/batches/"):]
+            try:
+                self._send(200, self.batches.describe(jid))
+            except KeyError:
+                self._send(404, {"error": f"no batch job {jid!r}"})
         else:
             self._send(404, {"error": f"no route {self.path}"})
 
@@ -1400,6 +1460,15 @@ class _Handler(BaseHTTPRequestHandler):
             self._handle_completions(chat=True)
         elif self.path == "/v1/embeddings":
             self._handle_embeddings()
+        elif self.path == "/v1/batches":
+            self._handle_batch_create()
+        elif (
+            self.path.startswith("/v1/batches/")
+            and self.path.endswith("/cancel")
+        ):
+            self._handle_batch_cancel(
+                self.path[len("/v1/batches/"):-len("/cancel")]
+            )
         elif self.path == "/drainz":
             self._handle_drain()
         elif self.path == "/reloadz":
@@ -1408,6 +1477,45 @@ class _Handler(BaseHTTPRequestHandler):
             self._handle_rollout_note()
         else:
             self._send(404, {"error": f"no route {self.path}"})
+
+    # ------------------------------------------ offline batch jobs
+    # (shifu_tpu/batch: OpenAI-Batch-shaped file-in/file-out jobs on
+    # the server's filesystem; the job's lines loop back through THIS
+    # server's completions endpoint at tier="batch", so they ride the
+    # two-tier queue — and a fleet front-end shards them across its
+    # backends — exactly like external traffic.)
+    def _handle_batch_create(self):
+        if self.batches is None:
+            self._send(400, {
+                "error": "batch jobs are disabled on this server",
+            })
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            req = json.loads(self.rfile.read(length) or b"{}")
+        except (ValueError, json.JSONDecodeError):
+            self._send(400, {"error": "body must be JSON"})
+            return
+        if not isinstance(req, dict):
+            self._send(400, {"error": "body must be a JSON object"})
+            return
+        try:
+            doc = self.batches.create(req)
+        except ValueError as e:
+            self._send(400, {"error": str(e)})
+            return
+        self._send(200, doc)
+
+    def _handle_batch_cancel(self, jid: str):
+        if self.batches is None:
+            self._send(400, {
+                "error": "batch jobs are disabled on this server",
+            })
+            return
+        try:
+            self._send(200, self.batches.cancel(jid))
+        except KeyError:
+            self._send(404, {"error": f"no batch job {jid!r}"})
 
     def _handle_drain(self):
         """POST /drainz {"backend": "host:port"} — the fleet admin
@@ -1847,6 +1955,35 @@ class _Handler(BaseHTTPRequestHandler):
             if mn is None:
                 mn = req.get("max_tokens")
             max_new = int(self.default_max_new if mn is None else mn)
+            # Admission tier (two-tier scheduling, shifu_tpu/batch):
+            # "batch" bodies backfill free decode slots only and are
+            # subject to the backlog cap below.
+            tier = req.get("tier", "interactive")
+            if tier not in ("interactive", "batch"):
+                raise ValueError(
+                    f'tier must be "interactive" or "batch", got {tier!r}'
+                )
+            if tier == "batch" and self.batch_backlog_max is not None:
+                backlog = int(
+                    self.runner.engine.queue_depths().get("batch", 0)
+                )
+                if backlog >= self.batch_backlog_max:
+                    # 429, not 503: the server is healthy, THIS tier is
+                    # full. Retry-After scales with how many backlog
+                    # entries each slot must clear (a blunt but honest
+                    # horizon); BatchRunner sleeps it and retries.
+                    slots = max(1, int(self.runner.engine.max_slots))
+                    self._send(
+                        429,
+                        {"error": (
+                            f"batch backlog {backlog} at cap "
+                            f"{self.batch_backlog_max}; retry later"
+                        )},
+                        headers={"Retry-After": str(
+                            min(30, max(1, backlog // slots))
+                        )},
+                    )
+                    return
             sampling = _parse_sampling(req, self.runner.engine.sample_cfg)
             stop_strings = req.get("stop")
             if isinstance(stop_strings, str):
@@ -1942,6 +2079,7 @@ class _Handler(BaseHTTPRequestHandler):
                     logit_bias=logit_bias, allowed_token_ids=allowed_ids,
                     adapter=adapter, regex=regex,
                     json_schema=json_schema, tools=tools, model=model,
+                    tier=tier,
                 )
                 return
             if best_of is not None:
@@ -2029,6 +2167,7 @@ class _Handler(BaseHTTPRequestHandler):
                     stop_strings=stop_strings, logit_bias=logit_bias,
                     allowed_token_ids=allowed_ids, adapter=adapter,
                     regex=regex, json_schema=json_schema, model=model,
+                    tier=tier,
                 )
                 choices = [
                     self._timed_choice(d, want_logprobs, stop_strings)
@@ -2050,6 +2189,7 @@ class _Handler(BaseHTTPRequestHandler):
                 stop_strings=stop_strings, logit_bias=logit_bias,
                 allowed_token_ids=allowed_ids, adapter=adapter,
                 regex=regex, json_schema=json_schema, model=model,
+                tier=tier,
             )
         except UnknownModelError as e:
             # The fleet's 404 backstop (the handler pre-check above
@@ -2079,7 +2219,7 @@ class _Handler(BaseHTTPRequestHandler):
         stop_token_ids=None, stop_strings=None, want_logprobs=False,
         chat: bool = False, logit_bias=None, allowed_token_ids=None,
         adapter=None, regex=None, json_schema=None, tools=None,
-        model=None,
+        model=None, tier="interactive",
     ) -> None:
         """Server-sent events: one ``data:`` line per token delta, a
         final one with finished_by (and the definitive token count —
@@ -2095,6 +2235,7 @@ class _Handler(BaseHTTPRequestHandler):
             stop_strings=stop_strings, logit_bias=logit_bias,
             allowed_token_ids=allowed_token_ids, adapter=adapter,
             regex=regex, json_schema=json_schema, model=model,
+            tier=tier,
         )
         self.send_response(200)
         self.send_header("Content-Type", "text/event-stream")
@@ -2201,6 +2342,8 @@ def make_server(
     flight_dump: Optional[str] = None,
     model_id: Optional[str] = None,
     ckpt_path: Optional[str] = None,
+    batch_backlog: Optional[int] = None,
+    enable_batch_api: bool = True,
 ) -> ThreadingHTTPServer:
     """Build (not start) the HTTP server; ``.runner`` holds the engine
     thread. Serve with ``serve_forever()``; stop with ``shutdown()``
@@ -2216,7 +2359,11 @@ def make_server(
     route by it; default: the model class name). ``ckpt_path``: the
     checkpoint this server initially serves — /v1/models reports it
     and POST /reloadz updates it (the rollout controller's readiness
-    gate / rollback anchor)."""
+    gate / rollback anchor).
+    ``batch_backlog``: admission cap for tier="batch" requests —
+    arrivals while the engine's batch queue is at/over this depth get
+    429 + Retry-After (None = uncapped). ``enable_batch_api``: serve
+    the POST/GET /v1/batches job routes (shifu_tpu/batch)."""
     from shifu_tpu.obs import compilemon
 
     compilemon.install_jax_monitoring(
@@ -2242,8 +2389,19 @@ def make_server(
             "default_max_new": default_max_new,
             "request_timeout_s": request_timeout_s,
             "model_id": model_id,
+            "batch_backlog_max": batch_backlog,
         },
     )
     server = ThreadingHTTPServer((host, port), handler)
     server.runner = runner
+    if enable_batch_api:
+        # The job table behind POST/GET /v1/batches. Jobs loop their
+        # lines back through THIS server's own address (known only
+        # after bind, hence the lazy callable) at tier="batch".
+        from shifu_tpu.batch import BatchManager
+
+        server.batches = handler.batches = BatchManager(
+            lambda: f"http://127.0.0.1:{server.server_port}",
+            metrics=runner.metrics, flight=runner.flight,
+        )
     return server

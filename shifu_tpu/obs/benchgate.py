@@ -103,6 +103,15 @@ METRIC_SPECS: Dict[str, Tuple[str, float]] = {
     # client-visible cost.
     "rollout_p99_ttft_ms": (LOWER, 0.35),
     "rollout_err_rate": (LOWER, 0.50),
+    # offline batch tier (round 9): sustained job throughput over the
+    # 10^4-request soak and the interactive p99-TTFT tax of backfill.
+    # Armable — dormant until a baseline round records the leg; the
+    # tax row additionally stays dormant while the recorded baseline
+    # is 0 (check_bench skips zero baselines), so batch_tok_s is the
+    # live guard against the batch path losing throughput, and the
+    # tax row arms the first time a round measures a nonzero tax.
+    "batch_tok_s": (HIGHER, 0.20),
+    "batch_ttft_tax_ms": (LOWER, 0.50),
 }
 
 # Absolute floors for landed improve-direction wins (round 6): relative

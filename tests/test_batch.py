@@ -1,0 +1,517 @@
+"""Offline batch tier (shifu_tpu/batch) — in-process coverage.
+
+Four layers, bottom up:
+
+  * jobfile: OpenAI-Batch line parsing with per-line fault isolation
+    (a malformed line errors, never aborts),
+  * journal: durable resume — torn trailing line tolerated, a
+    different input file refused, finalize exactly-once per custom_id,
+  * engine two-tier admission: interactive always admits first, batch
+    backfills, preemption re-queues (never drops) on both the dense
+    and paged engines, batch completions excluded from the SLO window,
+  * server: the "tier" body field, the --batch-backlog 429 +
+    Retry-After admission cap, and the /v1/batches job routes
+    (create/status/cancel + resume).
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import pytest
+
+from shifu_tpu.batch import (
+    BatchJournal,
+    BatchLineError,
+    BatchRunner,
+    JournalError,
+    error_record,
+    output_record,
+    parse_batch_line,
+)
+from shifu_tpu.infer import Engine, PagedEngine, SampleConfig, make_server
+from shifu_tpu.models import Transformer, TransformerConfig
+from shifu_tpu.obs import (
+    FlightRecorder,
+    MetricsRegistry,
+    SLOConfig,
+    SLOWatchdog,
+)
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = TransformerConfig.tiny()
+    model = Transformer(cfg)
+    return model, model.init(jax.random.key(0))
+
+
+def _sinks():
+    return dict(metrics=MetricsRegistry(), flight=FlightRecorder())
+
+
+# ------------------------------------------------------------- jobfile
+
+
+def test_parse_batch_line_valid():
+    cid, url, body = parse_batch_line(json.dumps({
+        "custom_id": "a", "method": "POST", "url": "/v1/completions",
+        "body": {"tokens": [1, 2], "max_new_tokens": 3},
+    }), 1)
+    assert (cid, url) == ("a", "/v1/completions")
+    assert body["max_new_tokens"] == 3
+    # method defaults to POST; chat url accepted
+    cid, url, _ = parse_batch_line(json.dumps({
+        "custom_id": "b", "url": "/v1/chat/completions",
+        "body": {"messages": [{"role": "user", "content": "hi"}]},
+    }), 2)
+    assert url == "/v1/chat/completions"
+
+
+@pytest.mark.parametrize("line,frag", [
+    ("not json at all", "unparseable"),
+    (json.dumps([1, 2]), "object"),
+    (json.dumps({"url": "/v1/completions", "body": {}}), "custom_id"),
+    (json.dumps({"custom_id": "x", "method": "GET",
+                 "url": "/v1/completions", "body": {}}), "POST"),
+    (json.dumps({"custom_id": "x", "url": "/v1/embeddings",
+                 "body": {}}), "url"),
+    (json.dumps({"custom_id": "x", "url": "/v1/completions",
+                 "body": 7}), "body"),
+    (json.dumps({"custom_id": "x", "url": "/v1/completions",
+                 "body": {"stream": True}}), "stream"),
+])
+def test_parse_batch_line_rejects(line, frag):
+    with pytest.raises(BatchLineError, match=frag):
+        parse_batch_line(line, 9)
+
+
+def test_parse_error_carries_custom_id_when_known():
+    try:
+        parse_batch_line(json.dumps({
+            "custom_id": "known", "url": "/v1/nope", "body": {},
+        }), 3)
+    except BatchLineError as e:
+        assert e.custom_id == "known"
+    else:
+        pytest.fail("expected BatchLineError")
+
+
+# ------------------------------------------------------------- journal
+
+
+def test_journal_resume_torn_tail_and_exactly_once(tmp_path):
+    jdir = tmp_path / "j"
+    inp = tmp_path / "in.jsonl"
+    inp.write_text("line1\nline2\n")
+    j = BatchJournal(str(jdir))
+    assert j.begin(str(inp)) == {}
+    j.record("a", "ok", output_record("a", 200, {"tokens": [1]}))
+    j.record("b", "error", error_record("b", "boom"))
+    # duplicate record for an already-journaled id is a no-op
+    j.record("a", "ok", output_record("a", 200, {"tokens": [9, 9]}))
+    j.close()
+    # SIGKILL tears the trailing line mid-append: tolerated on reopen.
+    with open(jdir / "results.jsonl", "ab") as f:
+        f.write(b'{"custom_id": "c", "ki')
+    j2 = BatchJournal(str(jdir))
+    done = j2.begin(str(inp))
+    assert done == {"a": "ok", "b": "error"}
+    j2.record("c", "ok", output_record("c", 200, {"tokens": [2]}))
+    counts = j2.finalize(str(tmp_path / "out.jsonl"),
+                         str(tmp_path / "err.jsonl"))
+    j2.close()
+    assert counts == {"completed": 2, "failed": 1}
+    outs = [json.loads(x) for x in
+            (tmp_path / "out.jsonl").read_text().splitlines()]
+    # Exactly one record per custom_id, FIRST journaled result wins.
+    assert [o["custom_id"] for o in outs] == ["a", "c"]
+    assert outs[0]["response"]["body"] == {"tokens": [1]}
+    errs = [json.loads(x) for x in
+            (tmp_path / "err.jsonl").read_text().splitlines()]
+    assert [e["custom_id"] for e in errs] == ["b"]
+
+
+def test_journal_mid_file_corruption_raises(tmp_path):
+    jdir = tmp_path / "j"
+    inp = tmp_path / "in.jsonl"
+    inp.write_text("x\n")
+    j = BatchJournal(str(jdir))
+    j.begin(str(inp))
+    j.record("a", "ok", output_record("a", 200, {}))
+    j.record("b", "ok", output_record("b", 200, {}))
+    j.close()
+    lines = (jdir / "results.jsonl").read_bytes().split(b"\n")
+    lines[0] = b'{"torn'  # corruption BEFORE later valid lines
+    (jdir / "results.jsonl").write_bytes(b"\n".join(lines))
+    with pytest.raises(JournalError, match="corrupt"):
+        BatchJournal(str(jdir)).begin(str(inp))
+
+
+def test_journal_refuses_different_input(tmp_path):
+    jdir = tmp_path / "j"
+    a = tmp_path / "a.jsonl"
+    b = tmp_path / "b.jsonl"
+    a.write_text("aaa\n")
+    b.write_text("bbb\n")
+    j = BatchJournal(str(jdir))
+    j.begin(str(a))
+    j.close()
+    with pytest.raises(JournalError, match="different input"):
+        BatchJournal(str(jdir)).begin(str(b))
+
+
+# ------------------------------------- engine: two-tier admission
+
+
+_KW = dict(
+    max_len=32, prefill_buckets=(16, 32),
+    sample_cfg=SampleConfig(temperature=0.0),
+)
+
+
+def test_interactive_admits_before_batch(tiny):
+    model, params = tiny
+    eng = Engine(model, params, max_slots=1, **_KW, **_sinks())
+    b1 = eng.submit([1, 2, 3], max_new_tokens=2, tier="batch")
+    b2 = eng.submit([1, 2, 4], max_new_tokens=2, tier="batch")
+    i1 = eng.submit([1, 2, 5], max_new_tokens=2)
+    assert eng.queue_depths() == {"interactive": 1, "batch": 2}
+    order = [c.rid for c in eng.run()]
+    # One slot: completion order IS admission order — the interactive
+    # request submitted LAST still admits first.
+    assert order == [i1, b1, b2]
+
+
+def test_bad_tier_rejected(tiny):
+    model, params = tiny
+    eng = Engine(model, params, max_slots=1, **_KW, **_sinks())
+    with pytest.raises(ValueError, match="tier"):
+        eng.submit([1, 2], max_new_tokens=1, tier="bulk")
+
+
+def test_batch_preemption_base_engine(tiny):
+    """Dense engine: a decoding batch request is preempted (re-queued,
+    never dropped) when an interactive arrival needs its slot, and
+    completes with its FULL token budget after recompute."""
+    model, params = tiny
+    eng = Engine(model, params, max_slots=1, **_KW, **_sinks())
+    b = eng.submit([1, 2, 3], max_new_tokens=10, tier="batch")
+    eng.step()
+    eng.step()  # batch is decoding
+    i = eng.submit([7, 8, 9], max_new_tokens=3)
+    done = {c.rid: c for c in eng.run()}
+    assert len(done[i].tokens) == 3
+    assert len(done[b].tokens) == 10  # nothing dropped
+    assert eng.batch_preemptions == 1
+    assert done[b].timing["preemptions"] == 1
+    assert eng.counters()["batch_completed"] == 1
+
+
+def test_batch_preemption_paged_engine(tiny):
+    model, params = tiny
+    eng = PagedEngine(
+        model, params, max_slots=2, page_size=8, **_KW, **_sinks()
+    )
+    bs = [
+        eng.submit([1, 2, 3 + k], max_new_tokens=12, tier="batch")
+        for k in range(2)
+    ]
+    eng.step()
+    eng.step()
+    i = eng.submit([9, 9, 9], max_new_tokens=4)
+    done = {c.rid: c for c in eng.run()}
+    assert len(done[i].tokens) == 4
+    assert all(len(done[r].tokens) == 12 for r in bs)
+    assert eng.batch_preemptions >= 1
+    # The preempt flight event fired.
+    assert eng.flight.snapshot(kind="preempt")
+
+
+def test_batch_head_never_preempts_interactive(tiny):
+    """The preemption path is one-directional: a queued BATCH request
+    waits for capacity, it never evicts anyone."""
+    model, params = tiny
+    eng = Engine(model, params, max_slots=1, **_KW, **_sinks())
+    i = eng.submit([1, 2, 3], max_new_tokens=8)
+    eng.step()
+    b = eng.submit([4, 5, 6], max_new_tokens=2, tier="batch")
+    done = {c.rid: c for c in eng.run()}
+    assert eng.batch_preemptions == 0
+    assert len(done[i].tokens) == 8 and len(done[b].tokens) == 2
+
+
+def test_batch_excluded_from_slo_window(tiny):
+    """Batch completions count separately and do NOT move the
+    interactive latency window the SLO watchdog reads — backfill load
+    cannot flip /healthz to degraded."""
+    model, params = tiny
+    sinks = _sinks()
+    eng = Engine(model, params, max_slots=2, **_KW, **sinks)
+    for k in range(3):
+        eng.submit([1, 2, 3 + k], max_new_tokens=2, tier="batch")
+    eng.run()
+    stats = eng.latency_stats()
+    assert stats["completions"] == 0
+    assert stats["batch_completions"] == 3
+    # A watchdog with an absurdly tight TTFT budget still reports ok:
+    # there are no interactive completions to judge.
+    dog = SLOWatchdog(
+        SLOConfig(p99_ttft_ms=0.0001, min_completions=1),
+        registry=sinks["metrics"], flight=sinks["flight"],
+    )
+    assert dog.evaluate(eng)["status"] == "ok"
+    # Interactive traffic DOES feed the window.
+    eng.submit([1, 2, 9], max_new_tokens=2)
+    eng.run()
+    assert eng.latency_stats()["completions"] == 1
+    assert dog.evaluate(eng)["status"] == "degraded"
+    # Tier-labelled series exist on the registry.
+    reg = sinks["metrics"]
+    assert reg.value(
+        "shifu_queue_depth", {"component": "engine", "tier": "batch"}
+    ) == 0.0
+    snap = reg.snapshot()
+    assert any(
+        "tier" in str(k) for k in snap.get("shifu_request_ttft_seconds",
+                                           {})
+    ) or "shifu_request_ttft_seconds" in snap
+
+
+# ----------------------------------------------- server: tier + cap
+
+
+@pytest.fixture()
+def served(tiny, tmp_path):
+    model, params = tiny
+    sinks = _sinks()
+    eng = PagedEngine(
+        model, params, max_slots=2, page_size=8, **_KW, **sinks
+    )
+    server = make_server(eng, port=0, batch_backlog=2)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    try:
+        yield f"http://127.0.0.1:{server.server_port}", eng
+    finally:
+        server.shutdown()
+        server.runner.shutdown()
+        t.join(5)
+
+
+def _post(base, path, obj, timeout=120):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(obj).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, dict(r.headers), json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), json.loads(e.read())
+
+
+def test_server_tier_field_and_validation(served):
+    base, eng = served
+    status, _, out = _post(base, "/v1/completions", {
+        "tokens": [1, 2, 3], "max_new_tokens": 2, "tier": "batch",
+    })
+    assert status == 200 and len(out["tokens"]) == 2
+    assert eng.batch_completed == 1
+    status, _, out = _post(base, "/v1/completions", {
+        "tokens": [1, 2, 3], "max_new_tokens": 2, "tier": "bulk",
+    })
+    assert status == 400 and "tier" in out["error"]
+
+
+def test_batch_backlog_cap_429_retry_after(tiny):
+    model, params = tiny
+    eng = Engine(model, params, max_slots=1, **_KW, **_sinks())
+    server = make_server(eng, port=0, batch_backlog=0)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    base = f"http://127.0.0.1:{server.server_port}"
+    try:
+        # Cap 0: every batch submission is over the cap — 429 with a
+        # Retry-After horizon; interactive is NEVER capped.
+        status, headers, out = _post(base, "/v1/completions", {
+            "tokens": [1, 2], "max_new_tokens": 1, "tier": "batch",
+        })
+        assert status == 429
+        assert int(headers.get("Retry-After")) >= 1
+        assert "backlog" in out["error"]
+        status, _, _ = _post(base, "/v1/completions", {
+            "tokens": [1, 2], "max_new_tokens": 1,
+        })
+        assert status == 200
+    finally:
+        server.shutdown()
+        server.runner.shutdown()
+        t.join(5)
+
+
+# --------------------------------------------- /v1/batches job routes
+
+
+def _write_job(path, n, bad_lines=True):
+    with open(path, "w") as f:
+        for i in range(n):
+            f.write(json.dumps({
+                "custom_id": f"req-{i}", "method": "POST",
+                "url": "/v1/completions",
+                "body": {"tokens": [1, 2, 3 + i % 5],
+                         "max_new_tokens": 3},
+            }) + "\n")
+        if bad_lines:
+            f.write("not json\n")
+            f.write(json.dumps({
+                "custom_id": "bad-body", "url": "/v1/completions",
+                "body": {"tokens": [], "max_new_tokens": 3},
+            }) + "\n")
+
+
+def _wait_job(base, jid, timeout=120):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        with urllib.request.urlopen(
+            f"{base}/v1/batches/{jid}", timeout=30
+        ) as r:
+            doc = json.loads(r.read())
+        if doc["status"] != "in_progress":
+            return doc
+        time.sleep(0.05)
+    pytest.fail(f"job {jid} never finished: {doc}")
+
+
+def test_v1_batches_lifecycle_and_fault_isolation(served, tmp_path):
+    base, eng = served
+    inp = tmp_path / "job.jsonl"
+    out = tmp_path / "job.out.jsonl"
+    _write_job(str(inp), 12)
+    status, _, doc = _post(base, "/v1/batches", {
+        "input_file": str(inp), "output_file": str(out),
+        "max_in_flight": 4,
+    })
+    assert status == 200 and doc["object"] == "batch"
+    final = _wait_job(base, doc["id"])
+    assert final["status"] == "completed"
+    # 12 good lines + 2 bad: the bad ones land in the error file with
+    # their custom_id (or a line handle) and the job COMPLETED.
+    assert final["request_counts"]["completed"] == 12
+    assert final["request_counts"]["failed"] == 2
+    outs = [json.loads(x) for x in out.read_text().splitlines()]
+    assert {o["custom_id"] for o in outs} == {
+        f"req-{i}" for i in range(12)
+    }
+    assert all(
+        o["response"]["status_code"] == 200
+        and len(o["response"]["body"]["tokens"]) == 3
+        for o in outs
+    )
+    errs = [
+        json.loads(x)
+        for x in open(final["error_file"]).read().splitlines()
+    ]
+    codes = {e["custom_id"]: e["error"] for e in errs}
+    assert "bad-body" in codes
+    assert codes["bad-body"]["status_code"] == 400
+    # Status surfaces: list + statz block + 404 on unknown id.
+    with urllib.request.urlopen(base + "/v1/batches", timeout=30) as r:
+        listing = json.loads(r.read())
+    assert any(j["id"] == doc["id"] for j in listing["data"])
+    with urllib.request.urlopen(base + "/statz", timeout=30) as r:
+        statz = json.loads(r.read())
+    assert statz["batch"]["jobs"]
+    status, _, _ = _post(base, "/v1/batches/nope/cancel", {})
+    assert status == 404
+
+
+def test_batch_runner_stop_and_resume_exactly_once(served, tmp_path):
+    """Cancel mid-job (the graceful SIGTERM path), rerun with the same
+    paths: the journal resumes, and the final output holds exactly one
+    record per custom_id — none missing, none duplicated."""
+    base, eng = served
+    inp = tmp_path / "big.jsonl"
+    out = tmp_path / "big.out.jsonl"
+    _write_job(str(inp), 40, bad_lines=False)
+    stop = threading.Event()
+    r1 = BatchRunner(
+        str(inp), str(out), base_url=base, max_in_flight=2,
+        **_sinks(), stop=stop,
+    )
+    seen = threading.Event()
+
+    def watch():
+        while not seen.is_set():
+            if r1.progress["completed"] >= 5:
+                stop.set()
+                return
+            time.sleep(0.01)
+
+    w = threading.Thread(target=watch, daemon=True)
+    w.start()
+    rep1 = r1.run()
+    seen.set()
+    assert rep1["status"] == "cancelled"
+    assert 0 < rep1["completed"] < 40
+    assert not out.exists()  # no torn output: finalize never ran
+    # Rerun: resumes, completes, exactly-once.
+    r2 = BatchRunner(
+        str(inp), str(out), base_url=base, max_in_flight=4, **_sinks(),
+    )
+    rep2 = r2.run()
+    assert rep2["status"] == "completed"
+    assert rep2["skipped_resume"] == rep1["completed"]
+    outs = [json.loads(x) for x in out.read_text().splitlines()]
+    ids = [o["custom_id"] for o in outs]
+    assert len(ids) == len(set(ids)) == 40
+
+
+def test_batch_runner_duplicate_custom_id(served, tmp_path):
+    base, _ = served
+    inp = tmp_path / "dup.jsonl"
+    out = tmp_path / "dup.out.jsonl"
+    with open(inp, "w") as f:
+        for _ in range(2):  # same custom_id twice
+            f.write(json.dumps({
+                "custom_id": "same", "url": "/v1/completions",
+                "body": {"tokens": [1, 2], "max_new_tokens": 2},
+            }) + "\n")
+    rep = BatchRunner(
+        str(inp), str(out), base_url=base, max_in_flight=2, **_sinks(),
+    ).run()
+    assert rep["completed"] == 1 and rep["failed"] == 1
+    outs = [json.loads(x) for x in out.read_text().splitlines()]
+    assert [o["custom_id"] for o in outs] == ["same"]
+
+
+def test_batch_runner_honours_429_backpressure(tiny, tmp_path):
+    """A capped server throttles; the runner sleeps Retry-After and
+    retries forever — every line still completes."""
+    model, params = tiny
+    eng = PagedEngine(
+        model, params, max_slots=2, page_size=8, **_KW, **_sinks()
+    )
+    server = make_server(eng, port=0, batch_backlog=1)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    base = f"http://127.0.0.1:{server.server_port}"
+    inp = tmp_path / "cap.jsonl"
+    out = tmp_path / "cap.out.jsonl"
+    _write_job(str(inp), 8, bad_lines=False)
+    try:
+        runner = BatchRunner(
+            str(inp), str(out), base_url=base, max_in_flight=8,
+            **_sinks(),
+        )
+        rep = runner.run()
+        assert rep["status"] == "completed"
+        assert rep["completed"] == 8 and rep["failed"] == 0
+    finally:
+        server.shutdown()
+        server.runner.shutdown()
+        t.join(5)

@@ -1,0 +1,397 @@
+"""Offline batch tier over a REAL two-process fleet.
+
+Real backend engine servers in child processes (tests/_fleet_backend.py),
+a FleetRouter + HTTP front-end in this one, and batch jobs driven by
+the actual ``shifu_tpu batch run`` CLI in a THIRD process — the full
+production topology. Covers:
+
+  * the SIGKILL-the-runner walk (chaos): kill ``batch run`` mid-job,
+    rerun with the same paths, the journal resumes and the output holds
+    exactly one record per custom_id;
+  * the SIGKILL-a-backend walk (chaos): one fleet backend dies
+    mid-batch; the router resubmits / the runner retries and the job
+    still completes exactly-once on the survivor;
+  * the full acceptance walk (slow): a >=1k-line JSONL through the
+    2-backend fleet WHILE live interactive traffic flows — every
+    interactive request 200 (or 503 with Retry-After), interactive
+    p99 TTFT within the configured SLO budget and /healthz never
+    degraded by backfill, the job SIGKILLed and resumed mid-run, and
+    the final output exactly one record per custom_id.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from shifu_tpu.batch import BatchRunner
+from shifu_tpu.fleet import (
+    BackendClient,
+    BackendConfig,
+    FleetProber,
+    FleetRouter,
+    RetryPolicy,
+    wait_ready,
+)
+from shifu_tpu.infer import make_server
+from shifu_tpu.obs import (
+    FlightRecorder,
+    MetricsRegistry,
+    SLOConfig,
+    SLOWatchdog,
+)
+
+_HELPER = os.path.join(os.path.dirname(__file__), "_fleet_backend.py")
+# Interactive p99 TTFT budget for the acceptance walk. Generous for a
+# tiny CPU model (each decode step is braked ~10 ms below), but small
+# enough that batch traffic HOLDING slots against interactive arrivals
+# (i.e. a broken preemption path) would blow straight through it.
+_SLO_TTFT_MS = 5000.0
+
+
+def _spawn_backend(step_delay=0.01, max_slots=2):
+    env = dict(
+        os.environ,
+        PALLAS_AXON_POOL_IPS="",
+        JAX_PLATFORMS="cpu",
+        FLEET_BACKEND_MAX_SLOTS=str(max_slots),
+        FLEET_BACKEND_STEP_DELAY=str(step_delay),
+    )
+    proc = subprocess.Popen(
+        [sys.executable, _HELPER],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        env=env, text=True,
+    )
+    line = proc.stdout.readline()
+    if not line:
+        proc.kill()
+        raise RuntimeError("backend died before printing its port")
+    return proc, f"127.0.0.1:{json.loads(line)['port']}"
+
+
+def _spawn_fleet(n=2, **kw):
+    procs, addrs = [], []
+    for _ in range(n):
+        p, a = _spawn_backend(**kw)
+        procs.append(p)
+        addrs.append(a)
+    return procs, addrs
+
+
+def _kill_all(procs):
+    for p in procs:
+        if p.poll() is None:
+            p.send_signal(signal.SIGKILL)
+    for p in procs:
+        p.wait(timeout=10)
+
+
+def _make_router(addrs):
+    clients = [
+        BackendClient(a, BackendConfig(
+            connect_timeout_s=10.0, probe_timeout_s=5.0,
+            read_timeout_s=60.0, fail_threshold=2, reset_s=1.0,
+        ))
+        for a in addrs
+    ]
+    ready, pending = wait_ready(clients, timeout_s=60.0, require_all=True)
+    assert not pending
+    return FleetRouter(
+        clients, metrics=MetricsRegistry(), flight=FlightRecorder(),
+        policy=RetryPolicy(base_s=0.01, cap_s=0.2, budget=64.0),
+    )
+
+
+def _serve_router(router, batch_backlog=None):
+    server = make_server(
+        router, port=0, batch_backlog=batch_backlog,
+        watchdog=SLOWatchdog(
+            SLOConfig(p99_ttft_ms=_SLO_TTFT_MS),
+            registry=router.metrics, flight=router.flight,
+        ),
+    )
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    return server, t, f"http://127.0.0.1:{server.server_port}"
+
+
+def _write_job(path, n, max_new=6):
+    with open(path, "w") as f:
+        for i in range(n):
+            f.write(json.dumps({
+                "custom_id": f"req-{i}", "method": "POST",
+                "url": "/v1/completions",
+                "body": {"tokens": [1, 2, 3 + i % 7],
+                         "max_new_tokens": max_new},
+            }) + "\n")
+
+
+def _runner_cmd(inp, out, base, max_in_flight=8):
+    return [
+        sys.executable, "-m", "shifu_tpu", "batch", "run",
+        "--input", str(inp), "--output", str(out),
+        "--router", base, "--max-in-flight", str(max_in_flight),
+        "--request-timeout", "120",
+    ]
+
+
+def _journal_lines(out):
+    path = str(out) + ".journal/results.jsonl"
+    if not os.path.exists(path):
+        return 0
+    with open(path, "rb") as f:
+        return sum(1 for line in f if line.strip())
+
+
+def _assert_exactly_once(out, n):
+    outs = [json.loads(x) for x in open(out).read().splitlines()]
+    ids = [o["custom_id"] for o in outs]
+    assert len(ids) == len(set(ids)) == n, (
+        f"{len(ids)} records / {len(set(ids))} unique, want {n}"
+    )
+    assert {o["response"]["status_code"] for o in outs} == {200}
+
+
+def _post(base, obj, timeout=120):
+    req = urllib.request.Request(
+        base + "/v1/completions", data=json.dumps(obj).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, dict(r.headers), json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), json.loads(e.read())
+
+
+# ------------------------------------------------- runner SIGKILL
+
+
+@pytest.mark.chaos
+def test_sigkill_batch_runner_resumes_exactly_once(tmp_path):
+    """SIGKILL the ``batch run`` process mid-job; the rerun resumes
+    from the fsynced journal and the output holds exactly one record
+    per custom_id — none lost, none duplicated."""
+    procs, addrs = _spawn_fleet(2, step_delay=0.005)
+    router = _make_router(addrs)
+    server, t, base = _serve_router(router)
+    inp = tmp_path / "job.jsonl"
+    out = tmp_path / "job.out.jsonl"
+    n = 160
+    _write_job(str(inp), n)
+    env = dict(os.environ, PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu")
+    try:
+        p1 = subprocess.Popen(
+            _runner_cmd(inp, out, base),
+            env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if _journal_lines(out) >= 25:
+                break
+            if p1.poll() is not None:
+                pytest.fail("runner finished before the kill window")
+            time.sleep(0.05)
+        else:
+            pytest.fail("job made no observable progress")
+        p1.send_signal(signal.SIGKILL)  # no goodbye, no fsync window
+        p1.wait(timeout=10)
+        assert not out.exists(), "output must not exist pre-finalize"
+        done_before = _journal_lines(out)
+        assert done_before >= 25
+        r2 = subprocess.run(
+            _runner_cmd(inp, out, base), env=env,
+            capture_output=True, text=True, timeout=300,
+        )
+        assert r2.returncode == 0, r2.stdout + r2.stderr
+        report = json.loads(r2.stdout.strip().splitlines()[-1])
+        assert report["status"] == "completed"
+        # The rerun actually RESUMED (skipped journaled ids) rather
+        # than redoing the whole file.
+        assert report["skipped_resume"] >= 25
+        _assert_exactly_once(out, n)
+    finally:
+        server.shutdown()
+        server.runner.shutdown()
+        t.join(5)
+        _kill_all(procs)
+
+
+# ------------------------------------------------ backend SIGKILL
+
+
+@pytest.mark.chaos
+def test_sigkill_backend_mid_batch_completes_on_survivor(tmp_path):
+    """One fleet backend SIGKILLed mid-batch: the router resubmits
+    queued work / the runner retries failed lines, and the job
+    completes exactly-once on the survivor."""
+    procs, addrs = _spawn_fleet(2, step_delay=0.005)
+    router = _make_router(addrs)
+    prober = FleetProber(router, interval_s=0.25)
+    prober.start()
+    server, t, base = _serve_router(router)
+    inp = tmp_path / "job.jsonl"
+    out = tmp_path / "job.out.jsonl"
+    n = 120
+    _write_job(str(inp), n)
+    try:
+        runner = BatchRunner(
+            str(inp), str(out), base_url=base, max_in_flight=6,
+            max_attempts=10, backoff_s=0.1,
+            metrics=MetricsRegistry(), flight=FlightRecorder(),
+        )
+        killed = threading.Event()
+
+        def assassin():
+            while not killed.is_set():
+                if runner.progress["completed"] >= 15:
+                    procs[0].send_signal(signal.SIGKILL)
+                    procs[0].wait(timeout=10)
+                    return
+                time.sleep(0.02)
+
+        a = threading.Thread(target=assassin, daemon=True)
+        a.start()
+        report = runner.run()
+        killed.set()
+        a.join(5)
+        assert procs[0].poll() is not None, "victim survived?"
+        assert report["status"] == "completed"
+        assert report["failed"] == 0, report
+        _assert_exactly_once(out, n)
+        # The fleet noticed: breaker open on the corpse, survivor up.
+        assert router.backends[0].breaker.state == "open"
+        assert router.backends[1].routable()
+    finally:
+        prober.stop()
+        server.shutdown()
+        server.runner.shutdown()
+        t.join(5)
+        _kill_all(procs)
+
+
+# ------------------------------------------- the acceptance walk
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_thousand_line_job_with_live_traffic_kill_and_resume(tmp_path):
+    """The ISSUE acceptance walk: a >=1k-line JSONL through a
+    2-backend fleet while live interactive traffic flows; interactive
+    requests all 200-or-503-with-Retry-After and their p99 TTFT within
+    the SLO budget (batch backfill exempt from the watchdog); the job
+    SIGKILLed and resumed mid-run; final output exactly one record per
+    custom_id."""
+    procs, addrs = _spawn_fleet(2, step_delay=0.003)
+    router = _make_router(addrs)
+    prober = FleetProber(router, interval_s=0.5)
+    prober.start()
+    server, t, base = _serve_router(router, batch_backlog=512)
+    inp = tmp_path / "big.jsonl"
+    out = tmp_path / "big.out.jsonl"
+    n = 1000
+    _write_job(str(inp), n, max_new=4)
+    env = dict(os.environ, PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu")
+
+    stop_traffic = threading.Event()
+    statuses, durations = [], []
+    lock = threading.Lock()
+
+    def interactive_client(seed):
+        k = 0
+        while not stop_traffic.is_set():
+            k += 1
+            t0 = time.monotonic()
+            try:
+                code, headers, _ = _post(base, {
+                    "tokens": [5, 6, 7 + (seed + k) % 5],
+                    "max_new_tokens": 4,
+                }, timeout=60)
+            except Exception as e:  # transport faults fail the test
+                code, headers = ("exc", {"err": repr(e)})
+            dt = (time.monotonic() - t0) * 1000.0
+            with lock:
+                statuses.append((code, headers))
+                durations.append(dt)
+            time.sleep(0.15)
+
+    clients = [
+        threading.Thread(target=interactive_client, args=(i,),
+                         daemon=True)
+        for i in range(2)
+    ]
+    try:
+        for c in clients:
+            c.start()
+        p1 = subprocess.Popen(
+            _runner_cmd(inp, out, base, max_in_flight=8),
+            env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline:
+            if _journal_lines(out) >= 150:
+                break
+            if p1.poll() is not None:
+                pytest.fail("runner finished before the kill window")
+            time.sleep(0.1)
+        else:
+            pytest.fail("job made no observable progress")
+        p1.send_signal(signal.SIGKILL)
+        p1.wait(timeout=10)
+        r2 = subprocess.run(
+            _runner_cmd(inp, out, base, max_in_flight=8), env=env,
+            capture_output=True, text=True, timeout=600,
+        )
+        assert r2.returncode == 0, r2.stdout[-2000:] + r2.stderr[-2000:]
+        report = json.loads(r2.stdout.strip().splitlines()[-1])
+        assert report["status"] == "completed"
+        assert report["skipped_resume"] >= 150
+        _assert_exactly_once(out, n)
+    finally:
+        stop_traffic.set()
+        for c in clients:
+            c.join(90)
+        try:
+            if p1.poll() is None:
+                p1.kill()
+        except Exception:
+            pass
+
+        # ---- interactive traffic verdicts (collected BEFORE teardown)
+        with lock:
+            got = list(statuses)
+        try:
+            assert got, "no interactive traffic observed"
+            bad = [
+                (c, h) for c, h in got
+                if c != 200 and not (
+                    c == 503 and h.get("Retry-After")
+                )
+            ]
+            assert not bad, f"non-200/503+Retry-After responses: {bad[:5]}"
+            assert any(c == 200 for c, _ in got)
+            # p99 TTFT within budget, measured where the watchdog
+            # measures it (router-side window — batch-exempt), and the
+            # watchdog itself never condemned the backfill.
+            lat = router.latency_stats()
+            assert lat["completions"] >= 10
+            assert lat["ttft_ms_p99"] is not None
+            assert lat["ttft_ms_p99"] <= _SLO_TTFT_MS, lat
+            assert lat.get("batch_completions", 0) >= n
+            verdict = server.runner.slo_status()
+            assert verdict["status"] == "ok", verdict
+        finally:
+            prober.stop()
+            server.shutdown()
+            server.runner.shutdown()
+            t.join(5)
+            _kill_all(procs)
