@@ -46,6 +46,13 @@ def main(argv=None):
         "--scale-tolerance", type=float, default=1.0,
         help="multiply every declared gate tolerance",
     )
+    ap.add_argument(
+        "--tune-table",
+        help="kernel tune-table artifact (shifu_tpu tune output): "
+             "activate per-shape-class kernel variants for every leg "
+             "AND add tuned-vs-default sub-legs to the soft-spot legs "
+             "(compact *_tune_x_default ratios)",
+    )
     args = ap.parse_args(argv)
 
     # Compile telemetry for the whole run: the ledger ends with how
@@ -54,6 +61,11 @@ def main(argv=None):
     from shifu_tpu.obs import compilemon as _cmon
 
     _cmon.install_jax_monitoring()
+
+    if args.tune_table:
+        from shifu_tpu.ops.pallas import registry as _preg
+
+        _preg.use_table(args.tune_table)  # warns + v0 on junk
 
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu"
@@ -131,6 +143,10 @@ def main(argv=None):
     n_compiles = _REG.value("shifu_compile_total")
     if n_compiles:
         out["compile_total"] = int(n_compiles)
+    if args.tune_table:
+        from shifu_tpu.ops.pallas import registry as _preg
+
+        out["tune_table"] = _preg.kernels_status()["table"]
 
     full = json.dumps(out)
     sidecar = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -293,6 +309,15 @@ def _compact(out: dict) -> dict:
         # and the einsum oracle's own MFU (the "before" number)
         ("moe_x_dense", g("train_legs", "moe", "grouped_vs_einsum")),
         ("moe_ein_mfu", g("train_legs", "moe", "einsum_oracle", "mfu")),
+        # kernel autotuner (round 10): tuned-vs-default step-time
+        # ratios per soft-spot leg — present only when the bench ran
+        # with --tune-table (dormant benchgate rows otherwise)
+        ("lcw_tune_x_default",
+         g("train_legs", "long_context_windowed", "tuned_vs_default")),
+        ("g2_tune_x_default",
+         g("train_legs", "gemma2", "tuned_vs_default")),
+        ("moe_tune_x_default",
+         g("train_legs", "moe", "tuned_vs_default")),
         ("fit_unstable", any(
             g(*sv, leg, "fit_unstable") for leg in
             ("bf16", "int8", "int8_kv", "int8_kv_b16s")
@@ -422,6 +447,33 @@ def _train_leg(cfg, dev, *, batch, seq, steps=3, opt=None):
     return out
 
 
+def _tuned_vs_default(leg, cfg, dev, **leg_kw):
+    """Tuned-vs-default sub-leg (round 10): when a tune table is
+    active (bench.py --tune-table), the leg's own numbers are the
+    TUNED run — re-time the SAME config with the registry pinned back
+    to v0 and record ``tuned_vs_default`` = default_ms / tuned_ms
+    (> 1: the table's winners pay off; < 1: the table is stale and
+    hurting). No table active: the sub-leg is silently absent, so the
+    compact ``*_tune_x_default`` benchgate rows stay dormant until a
+    TPU baseline round records them."""
+    from shifu_tpu.ops.pallas import registry as _preg
+
+    table = _preg.active_table()
+    if table is None:
+        return
+    path = _preg.kernels_status()["table"]
+    _preg.set_active_table(None)
+    try:
+        default = _train_leg(cfg, dev, **leg_kw)
+    finally:
+        _preg.set_active_table(table, path)
+    leg["v0_default"] = default
+    if default.get("step_ms") and leg.get("step_ms"):
+        leg["tuned_vs_default"] = round(
+            default["step_ms"] / leg["step_ms"], 3
+        )
+
+
 def bench_train_long(dev):
     """Long-context leg: the flash-attention kernel at s=8192 (the
     attention quadratic dominates — re-measures the kernel claim)."""
@@ -444,7 +496,9 @@ def bench_train_long_windowed(dev):
     cfg = TransformerConfig.base_1b(
         attn_impl="flash", remat_policy="full", window_size=1024
     )
-    return _train_leg(cfg, dev, batch=2, seq=8192)
+    leg = _train_leg(cfg, dev, batch=2, seq=8192)
+    _tuned_vs_default(leg, cfg, dev, batch=2, seq=8192)
+    return leg
 
 
 def bench_train_long_windowed_w2k(dev):
@@ -489,6 +543,10 @@ def bench_train_g2(dev):
         TransformerConfig(attn_impl="flash", **kw), dev,
         batch=2, seq=4096,
     )
+    _tuned_vs_default(
+        leg, TransformerConfig(attn_impl="flash", **kw), dev,
+        batch=2, seq=4096,
+    )
     try:
         xla = _train_leg(
             TransformerConfig(attn_impl="xla", **kw), dev,
@@ -521,6 +579,9 @@ def bench_train_moe(dev):
         attn_impl="flash", remat_policy="full",
     )
     leg = _train_leg(TransformerConfig(**kw), dev, batch=8, seq=2048)
+    _tuned_vs_default(
+        leg, TransformerConfig(**kw), dev, batch=8, seq=2048
+    )
     try:
         ein = _train_leg(
             TransformerConfig(moe_impl="einsum", **kw), dev,

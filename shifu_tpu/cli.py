@@ -16,8 +16,15 @@
     trace      export serving request traces as Chrome trace-event JSON
     debug      dump the flight-recorder ring (live server's /debugz or
                the in-process ring)
+    tune       persistent kernel autotuner: time every registered
+               kernel variant per shape class (legs moe/lcw/g2) and
+               write the winner table as a versioned artifact that
+               serve/train/bench activate via --tune-table; --check
+               validates registry + artifact schema without timing
     obs        check-bench: gate a compact bench line against a
-               recorded baseline (exit 1 on regression)
+               recorded baseline (exit 1 on regression);
+               check-tune: diff two tune-table artifacts (exit 1 when
+               winners changed — a reviewable, gated fact)
     info       devices, native-extension status, version
 
 The CLI builds everything from flags — model preset (optionally MoE),
@@ -70,6 +77,13 @@ def _build_model(args):
 
     from shifu_tpu.models import Mamba, MambaConfig, Transformer, TransformerConfig
 
+    tune_table = getattr(args, "tune_table", None)
+    if tune_table:
+        # Activate eagerly so a junk artifact warns at STARTUP (and
+        # /statz's kernels block reflects it), not at first trace.
+        from shifu_tpu.ops.pallas import registry as _preg
+
+        _preg.use_table(tune_table)
     if args.family == "mamba":
         if args.moe_experts or args.attn:
             raise SystemExit(
@@ -91,6 +105,8 @@ def _build_model(args):
         cfg = dataclasses.replace(cfg, n_experts=args.moe_experts)
     if args.attn:
         cfg = dataclasses.replace(cfg, attn_impl=args.attn)
+    if tune_table:
+        cfg = dataclasses.replace(cfg, tune_table=tune_table)
     return Transformer(cfg)
 
 
@@ -1132,6 +1148,7 @@ def cmd_serve(args) -> int:
         model_id=args.model_id,
         ckpt_path=args.ckpt_dir,
         batch_backlog=args.batch_backlog,
+        tune_table=args.tune_table,
     )
     print(
         json.dumps(
@@ -1365,11 +1382,98 @@ def cmd_debug(args) -> int:
     return 0
 
 
+def cmd_tune(args) -> int:
+    """``shifu_tpu tune``: the persistent kernel autotuner.
+
+    Times every applicable kernel variant per shape class for the
+    requested legs (fwd+grad, best-of-N) and writes the winner table
+    as a versioned artifact (``--out``, default kernels.tune.json)
+    that serve/train/bench activate via ``--tune-table`` and ``obs
+    check-tune`` diffs. ``--check`` skips all timing: validate the
+    variant registry's completeness (and, with ``--table``, an
+    existing artifact's schema + winners) — fast enough for tier-1."""
+    from shifu_tpu.tune import (
+        autotune,
+        check_registry,
+        check_table,
+        load_table,
+        save_table,
+    )
+    from shifu_tpu.tune.table import TuneTableError
+
+    legs = tuple(
+        s.strip() for s in args.legs.split(",") if s.strip()
+    )
+    try:
+        from shifu_tpu.tune.autotune import tune_cases
+
+        tune_cases(legs, preset=args.preset)  # validate before work
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    if args.check:
+        report = check_registry(legs, preset=args.preset)
+        if args.table:
+            try:
+                table = load_table(args.table)
+            except (OSError, TuneTableError) as e:
+                report["problems"].append(f"{args.table}: {e}")
+                report["status"] = "fail"
+            else:
+                import jax
+
+                dev = jax.devices()[0]
+                probs = check_table(
+                    table,
+                    device_kind=getattr(
+                        dev, "device_kind", dev.platform
+                    ),
+                )
+                report["table"] = {
+                    "path": args.table,
+                    "device_kind": table.device_kind,
+                    "entries": len(table.entries),
+                    "content_hash": table.content_hash(),
+                }
+                if probs:
+                    report["problems"].extend(probs)
+                    report["status"] = "fail"
+        print(json.dumps(report, indent=2))
+        return 0 if report["status"] == "ok" else 1
+    table = autotune(legs, preset=args.preset, repeats=args.repeats)
+    save_table(table, args.out)
+    print(json.dumps({
+        "out": args.out,
+        "device_kind": table.device_kind,
+        "legs": list(table.legs),
+        "content_hash": table.content_hash(),
+        "winners": {
+            tok: e["variant"] for tok, e in sorted(table.entries.items())
+        },
+    }, indent=2))
+    return 0
+
+
 def cmd_obs(args) -> int:
     """``shifu_tpu obs check-bench``: gate a compact bench line against
     a recorded baseline (obs/benchgate.py). Exit 0 = within tolerance,
     1 = regression, 2 = unusable inputs. ``bench.py --baseline`` runs
-    the same gate after a live bench."""
+    the same gate after a live bench.
+
+    ``shifu_tpu obs check-tune``: diff two tune-table artifacts
+    (--baseline old, --current new). Exit 0 = winners identical, 1 =
+    winners changed / classes added or removed (reviewable fact), 2 =
+    unusable artifacts."""
+    if args.action == "check-tune":
+        from shifu_tpu.obs.benchgate import check_tune
+
+        try:
+            ok, report = check_tune(args.baseline, args.current)
+        except (OSError, ValueError) as e:
+            print(f"cannot load tune tables: {e}", file=sys.stderr)
+            return 2
+        print(json.dumps(report, indent=2))
+        return 0 if ok else 1
     from shifu_tpu.obs.benchgate import check_bench, load_record
 
     try:
@@ -1422,6 +1526,12 @@ def main(argv=None) -> int:
         sp.add_argument("--warmup", type=int, default=0)
         sp.add_argument("--ckpt-dir")
         sp.add_argument("--seed", type=int, default=0)
+        sp.add_argument("--tune-table",
+                        help="kernel tune-table artifact (shifu_tpu "
+                             "tune output): per-shape-class kernel "
+                             "variants chosen by measurement; schema/"
+                             "device mismatch warns and runs v0 "
+                             "defaults")
 
     t = sub.add_parser("train", help="run the training loop")
     model_flags(t, schedule_default="cosine")
@@ -1827,13 +1937,43 @@ def main(argv=None) -> int:
                           "(default: print to stdout)")
     dbg.set_defaults(fn=cmd_debug)
 
+    tu = sub.add_parser(
+        "tune",
+        help="persistent kernel autotuner: time every registered "
+             "kernel variant per shape class (legs moe/lcw/g2, "
+             "fwd+grad) and write the winner table as a versioned "
+             "artifact for --tune-table; --check validates the "
+             "registry + an artifact without timing",
+    )
+    tu.add_argument("--legs", default="moe,lcw,g2",
+                    help="comma-separated tune legs (moe, lcw, g2)")
+    tu.add_argument("--out", default="kernels.tune.json",
+                    help="winner-table artifact path (atomic write)")
+    tu.add_argument("--check", action="store_true",
+                    help="no timing: validate registry completeness "
+                         "(+ --table artifact schema/winners); exit 1 "
+                         "on problems")
+    tu.add_argument("--table",
+                    help="with --check: an existing artifact to "
+                         "validate against the live registry and "
+                         "device kind")
+    tu.add_argument("--preset", default="full",
+                    choices=["full", "smoke"],
+                    help="workload shapes: full = bench-leg sized "
+                         "(TPU); smoke = tiny CPU-feasible shapes "
+                         "(try the flow end to end without a TPU)")
+    tu.add_argument("--repeats", type=int, default=3,
+                    help="best-of-N timing repeats per candidate")
+    tu.set_defaults(fn=cmd_tune)
+
     ob = sub.add_parser(
         "obs",
         help="observability tooling: check-bench gates a compact bench "
              "line against a recorded baseline within declared "
-             "tolerances (exit 1 on regression)",
+             "tolerances (exit 1 on regression); check-tune diffs two "
+             "tune-table artifacts (exit 1 when winners changed)",
     )
-    ob.add_argument("action", choices=["check-bench"])
+    ob.add_argument("action", choices=["check-bench", "check-tune"])
     ob.add_argument("--baseline", required=True,
                     help="baseline record (BENCH_rNN.json driver shape "
                          "or a raw compact line)")

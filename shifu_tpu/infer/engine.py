@@ -415,6 +415,24 @@ class Engine:
         self.flight = flight if flight is not None else _obs.FLIGHT
         self.replica_label = "0"
         self._obs_bind()
+        # Kernel tune table (ops.pallas.registry): when one is active,
+        # every prefill this engine compiles resolves its flash/MoE
+        # variants through it. Record WHICH table (path + content
+        # hash) in the flight ring so a post-mortem can tie a perf or
+        # numerics question to the exact winner set that was serving.
+        try:
+            from shifu_tpu.ops.pallas import registry as _kreg
+
+            _kstat = _kreg.kernels_status()
+            if _kstat["table"] is not None:
+                self.flight.record(
+                    "tune_table",
+                    path=_kstat["table"],
+                    content_hash=_kstat["content_hash"],
+                    device_kind=_kstat["device_kind"],
+                )
+        except Exception:
+            pass  # forensics must never block engine construction
         if decode_chunk < 1:
             raise ValueError(f"decode_chunk must be >= 1, got {decode_chunk}")
         self.decode_chunk = int(decode_chunk)

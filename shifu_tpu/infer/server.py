@@ -1385,6 +1385,14 @@ class _Handler(BaseHTTPRequestHandler):
                 batch = self.batches.stats()
                 if batch is not None:
                     out["batch"] = batch
+            # Kernels block: the active tune-table identity (path,
+            # schema, content hash) + which kernel variant each shape
+            # class actually resolved to in THIS process — production
+            # traffic's answer to "is the tuned variant really
+            # running?" (mirrors shifu_kernel_variant_selected_total).
+            from shifu_tpu.ops.pallas import registry as _kreg
+
+            out["kernels"] = _kreg.kernels_status()
             self._send(200, out)
         elif self.path == "/v1/models":
             eng = self.runner.engine
@@ -2344,6 +2352,7 @@ def make_server(
     ckpt_path: Optional[str] = None,
     batch_backlog: Optional[int] = None,
     enable_batch_api: bool = True,
+    tune_table: Optional[str] = None,
 ) -> ThreadingHTTPServer:
     """Build (not start) the HTTP server; ``.runner`` holds the engine
     thread. Serve with ``serve_forever()``; stop with ``shutdown()``
@@ -2363,8 +2372,17 @@ def make_server(
     ``batch_backlog``: admission cap for tier="batch" requests —
     arrivals while the engine's batch queue is at/over this depth get
     429 + Retry-After (None = uncapped). ``enable_batch_api``: serve
-    the POST/GET /v1/batches job routes (shifu_tpu/batch)."""
+    the POST/GET /v1/batches job routes (shifu_tpu/batch).
+    ``tune_table``: kernel tune-table artifact to activate for this
+    process's kernel dispatch (ops.pallas.registry.use_table —
+    warn-and-run-v0 on schema/device mismatch); /statz's ``kernels``
+    block reports the active table + per-shape-class selections."""
     from shifu_tpu.obs import compilemon
+
+    if tune_table:
+        from shifu_tpu.ops.pallas import registry as _kreg
+
+        _kreg.use_table(tune_table)
 
     compilemon.install_jax_monitoring(
         getattr(engine, "metrics", None) or _obs.REGISTRY

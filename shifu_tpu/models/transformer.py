@@ -146,6 +146,17 @@ class TransformerConfig:
     # layers, full attention on odd). None = window_size (if any)
     # applies to every layer.
     window_pattern: Optional[int] = None
+    # Kernel tune-table artifact path (``shifu_tpu tune`` output): when
+    # set, the model activates it (ops.pallas.registry.use_table —
+    # cached, warn-and-fallback-to-v0 on schema/device mismatch) before
+    # every kernel dispatch, so flash-attention block shapes / grid
+    # layouts and the MoE dispatch implementation are chosen per shape
+    # class by MEASUREMENT instead of the hardcoded defaults. Because
+    # resolution is per shape class, an alternating-window stack's two
+    # lax.cond branches tune independently — per-layer heterogeneous
+    # variants. None = v0 defaults (identical numerics either way; the
+    # parity suite pins every variant against v0).
+    tune_table: Optional[str] = None
 
     @property
     def resolved_head_dim(self) -> int:
@@ -411,8 +422,17 @@ class Transformer(Module):
         O(S*window) grid, the full branch once on the causal grid, and
         each scan step executes exactly one of them. XLA/ring keep the
         traced-scalar route (their masks broadcast traced widths
-        fine)."""
+        fine).
+
+        With ``cfg.tune_table`` the two branches ALSO resolve their
+        kernel variants independently (windowed and full-causal are
+        different shape classes), so a tuned alternating stack runs
+        per-layer heterogeneous block shapes."""
         cfg = self.cfg
+        if cfg.tune_table:
+            from shifu_tpu.ops.pallas import registry as _preg
+
+            _preg.use_table(cfg.tune_table)  # cached; warns+v0 on junk
         kw = dict(
             causal=True, segment_ids=segment_ids, impl=cfg.attn_impl,
             scale=self._attn_scale, softcap=cfg.attn_softcap,
@@ -930,8 +950,28 @@ class Transformer(Module):
         ``moe_impl="einsum"``. Both build the same (E, b, C, d) expert
         buffers (identical grouped expert matmuls and ep-sharding
         pattern); they differ only in how tokens move in and out —
-        see ops.moe module docstring."""
-        if self.cfg.moe_impl == "einsum":
+        see ops.moe module docstring.
+
+        The default ("grouped") additionally consults the kernel
+        variant registry: an active tune table may route THIS shape
+        class (seq bucket, dim, experts, top_k, dtype) to the einsum
+        formulation where it measured faster (tiny E·C — the two are
+        bit-identical routings, so the swap is numerics-free).
+        Explicit ``moe_impl="einsum"`` stays an unconditional oracle
+        switch for parity tests and the bench sub-leg."""
+        impl = self.cfg.moe_impl
+        if impl == "grouped":
+            from shifu_tpu.ops.pallas import registry as _preg
+
+            if self.cfg.tune_table:
+                _preg.use_table(self.cfg.tune_table)
+            variant = _preg.resolve(_preg.ShapeClass.moe(
+                seq_len=x.shape[1], dim=x.shape[2],
+                experts=self.cfg.n_experts, top_k=self.cfg.moe_top_k,
+                dtype=x.dtype,
+            ))
+            impl = str(variant.p.get("impl", "grouped"))
+        if impl == "einsum":
             return self._moe_ffn_einsum(p, x)
         return self._moe_ffn_grouped(p, x)
 

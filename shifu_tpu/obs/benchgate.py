@@ -112,6 +112,16 @@ METRIC_SPECS: Dict[str, Tuple[str, float]] = {
     # tax row arms the first time a round measures a nonzero tax.
     "batch_tok_s": (HIGHER, 0.20),
     "batch_ttft_tax_ms": (LOWER, 0.50),
+    # kernel autotuner (round 10): tuned-vs-default (v0) step-time
+    # ratios per soft-spot leg, measured by bench.py --tune-table
+    # re-running each leg with the winner table active vs disabled.
+    # >= 1 means the table's winners actually pay off on this device;
+    # collapsing below 1 - tol means a stale table is now HURTING and
+    # needs a re-tune. Armable — dormant until a TPU baseline round
+    # records them (bench without --tune-table emits no ratio).
+    "lcw_tune_x_default": (HIGHER, 0.10),
+    "g2_tune_x_default": (HIGHER, 0.10),
+    "moe_tune_x_default": (HIGHER, 0.10),
 }
 
 # Absolute floors for landed improve-direction wins (round 6): relative
@@ -178,15 +188,27 @@ def check_bench(current: dict, baseline: dict,
     for key, (direction, tol) in specs.items():
         cur = current.get(key)
         base = _baseline_value(baseline, key)
+        # Machine-readable skip reasons ("reason" codes; "why" stays
+        # the human prose): the TPU driver reads these to see which
+        # gate rows — and which METRIC_FLOORS — are still dormant.
         if not isinstance(cur, (int, float)) or isinstance(cur, bool):
             if isinstance(base, (int, float)):
-                skipped.append({"key": key, "why": "missing in current"})
+                skipped.append({
+                    "key": key, "why": "missing in current",
+                    "reason": "missing_current",
+                })
             continue
         if not isinstance(base, (int, float)) or isinstance(base, bool):
-            skipped.append({"key": key, "why": "missing in baseline"})
+            skipped.append({
+                "key": key, "why": "missing in baseline",
+                "reason": "missing_baseline",
+            })
             continue
         if base == 0:
-            skipped.append({"key": key, "why": "baseline is 0"})
+            skipped.append({
+                "key": key, "why": "baseline is 0",
+                "reason": "zero_baseline",
+            })
             continue
         ratio = cur / base
         tol = tol * scale_tol
@@ -221,11 +243,57 @@ def check_bench(current: dict, baseline: dict,
         if bad:
             regressions.append(row)
     ok = not regressions
+    # Floor ledger: every declared METRIC_FLOORS row with its armed/
+    # dormant state and a machine-readable reason — the driver's view
+    # of which wins have landed and which are still awaited.
+    checked_by_key = {r["key"]: r for r in rows}
+    floors = []
+    for key, floor in METRIC_FLOORS.items():
+        row = checked_by_key.get(key)
+        base = _baseline_value(baseline, key)
+        if row is not None:
+            armed = row["baseline"] >= floor
+            state = {
+                "key": key, "floor": floor,
+                "baseline": row["baseline"], "current": row["current"],
+                "state": "armed" if armed else "dormant",
+            }
+            if not armed:
+                state["reason"] = "baseline_below_floor"
+        else:
+            state = {
+                "key": key, "floor": floor, "state": "dormant",
+                "reason": (
+                    "zero_baseline" if base == 0 else "not_measured"
+                ),
+            }
+        floors.append(state)
     report = {
         "status": "pass" if ok else "fail",
         "checked": len(rows),
         "regressions": regressions,
         "skipped": skipped,
+        "floors": floors,
+        "dormant_floors": [
+            f["key"] for f in floors if f["state"] == "dormant"
+        ],
         "rows": rows,
     }
     return ok, report
+
+
+def check_tune(old_path: str, new_path: str) -> Tuple[bool, dict]:
+    """Diff two kernel tune-table artifacts (``shifu_tpu obs
+    check-tune``): the winner table is a reviewable, gated fact like a
+    BENCH row, so a winner changing between tunes must surface as a
+    non-zero exit a human signs off on, never a silent behavioral
+    drift. Returns (identical, report); raises OSError /
+    tune.table.TuneTableError on unusable artifacts (CLI exit 2)."""
+    from shifu_tpu.tune.table import diff_tables, load_table
+
+    old = load_table(old_path)
+    new = load_table(new_path)
+    report = diff_tables(old, new)
+    report["baseline"] = old_path
+    report["current"] = new_path
+    return report["status"] == "identical", report

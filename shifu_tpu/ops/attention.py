@@ -60,7 +60,11 @@ def dot_product_attention(
         (sequence-parallel ring over the sp mesh axis; needs an active
         activation_sharding context with sp > 1 and mesh-divisible
         shapes — see parallel.ring.ring_shardable — else it silently
-        falls back to the O(S^2)-memory XLA path).
+        falls back to the O(S^2)-memory XLA path). "flash" resolves a
+        KERNEL VARIANT per shape class through ops.pallas.registry —
+        v0 (the measured defaults) without a tune table, the table's
+        winner with one; a softcap class whose winner is the split
+        "xla_split" variant re-routes here to the XLA path.
       window: sliding-window attention — query i sees only keys in
         (i - window, i], i.e. the last ``window`` positions INCLUDING
         itself. Requires ``causal=True``. All impls support it: flash
@@ -91,12 +95,28 @@ def dot_product_attention(
                 "windows must dispatch via static-window branches "
                 "(Transformer._self_attention)"
             )
+        from shifu_tpu.ops.pallas import registry as _reg
         from shifu_tpu.ops.pallas.flash_attention import flash_attention
 
-        return flash_attention(
-            q, k, v, causal=causal, scale=scale, segment_ids=segment_ids,
-            window=window, softcap=softcap,
-        )
+        # Kernel-variant resolution (ops/pallas/registry.py): this
+        # dispatch is where a tune table's winner takes effect — v0
+        # (= the pre-registry defaults) without one. Resolving HERE
+        # rather than inside the kernel lets a winner route a softcap
+        # class to the split/XLA path ("xla_split"), the one variant
+        # the kernel cannot apply to itself.
+        h, hkv = q.shape[2], k.shape[2]
+        variant = _reg.resolve(_reg.ShapeClass.flash(
+            kv_len=k.shape[1], head_dim=q.shape[3],
+            gqa=h // max(1, hkv), window=window, softcap=softcap,
+            dtype=q.dtype,
+        ))
+        if variant.p.get("impl") != "xla":
+            return flash_attention(
+                q, k, v, causal=causal, scale=scale,
+                segment_ids=segment_ids, window=window,
+                softcap=softcap, variant=variant,
+            )
+        impl = "xla"  # split-softcap winner: fall through
     if impl == "ring":
         # Sequence-parallel ring attention over the sp mesh axis. Needs an
         # active activation_sharding context to discover the mesh; falls

@@ -38,6 +38,15 @@ Shared properties:
     grouped path reuses the einsum path's cumsum slot assignment verbatim,
     so the two paths drop EXACTLY the same assignments.
 
+Which formulation the model actually runs is a KERNEL VARIANT (round
+10): ``Transformer._moe_ffn`` resolves the "moe" shape class (seq
+bucket, dim, experts, top_k, dtype) through ops.pallas.registry — v0
+is the grouped path, and a tune table (``shifu_tpu tune --legs moe``)
+may route a class where the dense form measured faster (tiny E·C) to
+the einsum variant. The two are bit-identical routings (shared
+``_routing_decisions``), so the swap is numerics-free by construction;
+explicit ``moe_impl="einsum"`` remains the unconditional oracle switch.
+
 Reference parity note: the upstream reference (klyan/shifu) is an empty
 repository (SURVEY.md) — there is no reference MoE implementation to match.
 """

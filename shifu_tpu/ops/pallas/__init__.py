@@ -1,0 +1,34 @@
+"""Pallas TPU kernels + the kernel-variant registry.
+
+``registry`` makes variant selection a first-class config axis: every
+kernel family (flash attention, MoE dispatch) registers a small family
+of :class:`~shifu_tpu.ops.pallas.registry.KernelVariant`'s keyed by a
+canonical :class:`~shifu_tpu.ops.pallas.registry.ShapeClass`, and the
+persistent autotuner (``shifu_tpu tune`` — :mod:`shifu_tpu.tune`)
+picks winners by measurement into a versioned table artifact that
+``--tune-table`` activates at serve/train/bench time.
+"""
+
+from shifu_tpu.ops.pallas.registry import (
+    KernelVariant,
+    ShapeClass,
+    active_table,
+    get_variant,
+    kernels_status,
+    resolve,
+    set_active_table,
+    use_table,
+    variants_for,
+)
+
+__all__ = [
+    "KernelVariant",
+    "ShapeClass",
+    "active_table",
+    "get_variant",
+    "kernels_status",
+    "resolve",
+    "set_active_table",
+    "use_table",
+    "variants_for",
+]

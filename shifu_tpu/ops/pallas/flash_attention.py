@@ -625,12 +625,13 @@ def flash_attention(
     causal: bool = True,
     scale: Optional[float] = None,
     segment_ids: Optional[jax.Array] = None,
-    block_q: int = 1024,
-    block_k: int = 1024,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
     interpret: Optional[bool] = None,
     window: Optional[int] = None,
     window_block_k: Optional[int] = None,
     softcap: Optional[float] = None,
+    variant=None,
 ):
     """Flash attention with the dot_product_attention layout/semantics.
 
@@ -642,9 +643,14 @@ def flash_attention(
       scale: score scale; defaults to head_dim ** -0.5.
       segment_ids: optional (batch, seq) int segments for packed sequences;
         requires q_len == kv_len (same contract as the XLA path).
-      block_q, block_k: tile sizes (clamped to the sequence lengths).
-        1024/1024 measured best for the training shapes on v5e (~4%
-        over 512/1024; smaller tiles lose up to 15%).
+      block_q, block_k: EXPLICIT tile-size overrides (clamped to the
+        sequence lengths) — the manual lever for tests and shape
+        experiments. Default (None): the kernel-variant registry
+        resolves them (ops.pallas.registry) — ``v0`` keeps the
+        measured-best 1024/1024 (v5e: ~4% over 512/1024; smaller
+        tiles lose up to 15%), and an active tune table
+        (``shifu_tpu tune`` / ``--tune-table``) may pick a measured
+        per-shape-class variant instead.
       interpret: force pallas interpret mode; default: interpret unless
         running on TPU (so CPU tests exercise the same kernel code).
       window_block_k: the small-window (w << s) grid lever. A KV block
@@ -654,20 +660,31 @@ def flash_attention(
         O(S * window) — the full grid fetches O(S^2) bytes even when
         ``pl.when`` skips the masked blocks' FLOPs, which is what held
         the windowed long-context legs ~12 MFU points under full
-        causal. Default (None) auto-engages at 2x the window (power-of-
-        two-rounded) whenever ``window`` is set and the KV length is
-        >= 4x the window; pass a block size to override, or 0 to
-        disable and keep the full grid with in-kernel skipping.
+        causal. Default (None): the resolved variant decides — ``v0``
+        auto-engages at 2x the window (power-of-two-rounded) whenever
+        ``window`` is set and the KV length is >= 4x the window (the
+        PR-3 heuristic, now the registry's ``wgrid_x2`` as an
+        explicit, measurable choice); pass a block size to override,
+        or 0 to disable and keep the full grid with in-kernel
+        skipping.
       softcap: Gemma-2 attention-logit soft-capping — block scores
         become ``softcap * tanh(scores / softcap)`` before the mask
         and the online-softmax fold (per-tile VPU elementwise; the
         saved logsumexp is over capped scores and the backward carries
         the matching ``1 - tanh^2`` term). Composes with ``window``,
         GQA and ``segment_ids``; matches the XLA path's capping.
+      variant: kernel-variant override — a registry name ("v0",
+        "wgrid_x2", ...) or a KernelVariant. Default (None): resolve
+        via ops.pallas.registry — the active tune table's winner for
+        this call's shape class, else v0. Explicit block_q / block_k /
+        window_block_k kwargs override the variant's knobs field by
+        field (the manual lever for tests and experiments).
 
     Returns:
       (batch, q_len, num_heads, head_dim) in q.dtype.
     """
+    from shifu_tpu.ops.pallas import registry as _reg
+
     b, sq, h, d = q.shape
     _, skv, h_kv, _ = k.shape
     if h % h_kv:
@@ -676,25 +693,38 @@ def flash_attention(
         raise ValueError("segment_ids requires q_len == kv_len")
     if window is not None and not causal:
         raise ValueError("window requires causal attention")
+    # Variant resolution (ops/pallas/registry.py): the registry owns
+    # the block-shape defaults AND the PR-3 auto-window_block_k
+    # heuristic (v0 reproduces both verbatim, so numerics cannot
+    # drift); an active tune table swaps in the measured winner for
+    # this call's shape class.
+    if isinstance(variant, str):
+        named = _reg.get_variant("flash", variant)
+        if named is None:
+            raise ValueError(f"unknown flash variant {variant!r}")
+        variant = named
+    if variant is None:
+        variant = _reg.resolve(_reg.ShapeClass.flash(
+            kv_len=skv, head_dim=d, gqa=h // h_kv, window=window,
+            softcap=softcap, dtype=q.dtype,
+        ))
+    knobs = variant.flash_knobs(sq, skv, window)
+    if knobs.get("impl") == "xla":
+        # A table may route a (softcap) class to the split/XLA path,
+        # but only the dot_product_attention dispatch can honor that —
+        # a direct call here has already committed to the pallas
+        # kernel, so run it on v0 knobs.
+        knobs = _reg.get_variant("flash", "v0").flash_knobs(
+            sq, skv, window
+        )
+    block_q = int(block_q) if block_q is not None else knobs["block_q"]
+    block_k = int(block_k) if block_k is not None else knobs["block_k"]
+    if window_block_k is None:
+        window_block_k = knobs["window_block_k"]
     force_window_grid = False
-    if window is not None:
-        if window_block_k is None and skv >= 4 * window:
-            # Auto: one window spans at most 2 blocks of size >= w; 2x
-            # rounds the span's waste down while keeping blocks fat
-            # enough that the non-affine index maps' per-step cost
-            # amortises. Engage ONLY when the 2-block span covers at
-            # most half the KV axis — otherwise the restricted grid
-            # degenerates to the full grid and the override would just
-            # coarsen block_k (worse in-kernel skip granularity) for
-            # nothing.
-            wbk = 1
-            while wbk < 2 * window:
-                wbk *= 2
-            if 2 * wbk <= skv // 2:
-                window_block_k = wbk
-        if window_block_k:
-            block_k = int(window_block_k)
-            force_window_grid = True
+    if window is not None and window_block_k:
+        block_k = int(window_block_k)
+        force_window_grid = True
     cfg = FlashConfig(
         causal=causal,
         scale=float(scale) if scale is not None else d**-0.5,

@@ -1,0 +1,476 @@
+"""Kernel multi-versioning: variant registry + shape-class keys.
+
+The Pallas fast path used to carry its tuning knobs as scattered
+kwargs and inline heuristics (``block_q=1024`` measured by eye,
+the PR-3 ``window_block_k`` auto-rule buried in ``flash_attention``).
+Following *Autocomp* (arXiv:2505.18574) and *A Few Fit Most:
+multi-versioning SGEMM* (arXiv:2507.15277), variant selection is a
+first-class axis instead:
+
+  * a :class:`ShapeClass` canonically keys the shapes a kernel is
+    launched with — (seq bucket, head_dim, GQA ratio, window, softcap,
+    dtype) for flash attention, (seq bucket, dim, experts, top_k,
+    dtype) for MoE dispatch;
+  * a :class:`KernelVariant` names one concrete configuration of a
+    kernel family (block shapes, grid layout incl. the forced-window
+    grid, fused-vs-split softcap, grouped-vs-einsum MoE dispatch);
+    ``v0`` of each family IS the pre-registry default, resolved
+    bit-identically, so introducing the registry cannot drift
+    numerics;
+  * :func:`resolve` maps a shape class to the variant to run: the
+    ACTIVE TUNE TABLE's winner when one is loaded (``use_table`` /
+    ``--tune-table``; a versioned artifact written by ``shifu_tpu
+    tune`` — shifu_tpu.tune), else ``v0``. Every resolution is
+    recorded (``shifu_kernel_variant_selected_total{shape_class,
+    variant}`` on the global obs registry + an in-module tally served
+    by ``/statz``'s ``kernels`` block), so production traffic shows
+    which variants actually run.
+
+Parity contract (pinned by tests/test_kernel_variants.py): every
+registered variant computes the same attention/MoE function as ``v0``.
+How exact "same" is follows from what the variant changes —
+
+  * same effective ``block_k`` (grid layout / ``block_q`` changes
+    only): the per-row online-softmax fold partition is untouched, so
+    the FORWARD is bit-identical (skipped fully-masked blocks
+    contribute exact zeros and identity rescales);
+  * same ``block_q`` AND ``block_k``: gradients are bit-identical too
+    (the dk/dv accumulation partition is per-query-block);
+  * a different block partition (or the split-softcap XLA route)
+    reorders f32 accumulation — parity holds to ULP-level tolerance,
+    same as the repo's established flash-vs-XLA oracle contract.
+
+``resolve`` runs at TRACE time (inside jit), so selection is free on
+the hot path — a chosen variant is baked into the compiled program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import sys
+import threading
+from typing import Dict, Optional, Tuple
+
+# -------------------------------------------------------------------------
+# shape classes
+# -------------------------------------------------------------------------
+
+_DTYPE_SHORT = {
+    "float32": "f32",
+    "bfloat16": "bf16",
+    "float16": "f16",
+    "float64": "f64",
+}
+
+
+def _pow2_ge(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+def seq_bucket(seq_len: int) -> int:
+    """Canonical sequence bucket: next power of two, floored at 128."""
+    return _pow2_ge(max(int(seq_len), 128))
+
+
+def canonical_dtype(dtype) -> str:
+    try:
+        import numpy as np
+
+        name = np.dtype(dtype).name
+    except TypeError:
+        name = getattr(dtype, "name", str(dtype))
+    return _DTYPE_SHORT.get(name, name)
+
+
+@dataclasses.dataclass(frozen=True)
+class ShapeClass:
+    """Canonical key for "shapes that should share a tuning decision".
+
+    ``fields`` is an ordered tuple of (name, value) pairs; ``token`` is
+    the canonical string form used as the tune-table key and the
+    ``shape_class`` metric label. Exact sequence lengths are bucketed
+    to powers of two (a winner for s=8192 serves s=7000 too); window
+    widths and head dims are config constants and stay exact.
+    """
+
+    kind: str  # kernel family: "flash" | "moe"
+    fields: Tuple[Tuple[str, object], ...]
+
+    @classmethod
+    def flash(cls, *, kv_len: int, head_dim: int, gqa: int,
+              window: Optional[int], softcap: Optional[float], dtype):
+        return cls("flash", (
+            ("sb", seq_bucket(kv_len)),
+            ("d", int(head_dim)),
+            ("g", int(gqa)),
+            ("w", int(window) if window else 0),
+            ("c", 1 if softcap else 0),
+            ("dt", canonical_dtype(dtype)),
+        ))
+
+    @classmethod
+    def moe(cls, *, seq_len: int, dim: int, experts: int, top_k: int,
+            dtype):
+        return cls("moe", (
+            ("sb", seq_bucket(seq_len)),
+            ("d", int(dim)),
+            ("e", int(experts)),
+            ("k", int(top_k)),
+            ("dt", canonical_dtype(dtype)),
+        ))
+
+    def get(self, name: str):
+        for n, v in self.fields:
+            if n == name:
+                return v
+        return None
+
+    @property
+    def token(self) -> str:
+        return self.kind + ":" + ":".join(
+            f"{n}{v}" for n, v in self.fields
+        )
+
+    @classmethod
+    def parse(cls, token: str) -> "ShapeClass":
+        """Inverse of ``token`` (used to validate tune-table keys)."""
+        parts = token.split(":")
+        kind = parts[0]
+        names = {
+            "flash": ("sb", "d", "g", "w", "c", "dt"),
+            "moe": ("sb", "d", "e", "k", "dt"),
+        }.get(kind)
+        if names is None or len(parts) != len(names) + 1:
+            raise ValueError(f"unparsable shape-class token: {token!r}")
+        fields = []
+        for name, part in zip(names, parts[1:]):
+            if not part.startswith(name):
+                raise ValueError(
+                    f"shape-class token {token!r}: expected field "
+                    f"{name!r}, got {part!r}"
+                )
+            raw = part[len(name):]
+            try:
+                fields.append((name, raw if name == "dt" else int(raw)))
+            except ValueError:
+                raise ValueError(
+                    f"unparsable shape-class token: {token!r} "
+                    f"(field {name!r} = {raw!r})"
+                ) from None
+        return cls(kind, tuple(fields))
+
+
+# -------------------------------------------------------------------------
+# variants
+# -------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class KernelVariant:
+    """One named configuration of a kernel family.
+
+    ``params`` (ordered (name, value) pairs; dict view via :attr:`p`)
+    are family-specific knobs — flash: ``block_q``/``block_k`` (absent
+    = the v0 default), ``window_block_k`` ("auto" = the PR-3
+    heuristic, 0 = full grid with in-kernel skipping, ("mult", m) =
+    FORCE the restricted window grid at m x pow2(window) KV blocks),
+    ``impl`` ("xla" = the split-softcap route through the XLA oracle
+    path); moe: ``impl`` ("grouped" | "einsum").
+    """
+
+    kind: str
+    name: str
+    params: Tuple[Tuple[str, object], ...] = ()
+    doc: str = ""
+
+    @property
+    def p(self) -> Dict[str, object]:
+        return dict(self.params)
+
+    # -- applicability ----------------------------------------------------
+    def applies(self, sc: ShapeClass) -> bool:
+        if sc.kind != self.kind:
+            return False
+        p = self.p
+        if self.kind == "flash":
+            window = sc.get("w") or 0
+            sb = sc.get("sb")
+            wbk = p.get("window_block_k")
+            if isinstance(wbk, tuple):  # forced window grid
+                if not window:
+                    return False
+                # A forced span must actually shrink the grid: the
+                # 2-block window span has to cover at most half the
+                # (bucketed) KV axis or the restricted grid degenerates
+                # into a coarser full grid.
+                if 2 * wbk[1] * _pow2_ge(window) > sb // 2:
+                    return False
+            elif wbk == 0 and not window:
+                return False  # full-grid opt-out is a no-op w/o window
+            if p.get("impl") == "xla":
+                # The split route materialises (S, S) scores — keep it
+                # off classes where that matrix stops fitting.
+                return bool(sc.get("c")) and sb <= 4096
+            # Block-shape deltas are no-ops when the bucket already
+            # clamps every candidate to the same size.
+            for knob, dflt in (("block_q", 1024), ("block_k", 1024)):
+                if knob in p and min(p[knob], sb) == min(dflt, sb):
+                    return False
+        return True
+
+    # -- flash knob resolution -------------------------------------------
+    def flash_knobs(self, sq: int, skv: int,
+                    window: Optional[int]) -> Dict[str, object]:
+        """Resolve this variant's concrete kernel knobs for REAL call
+        shapes (not the bucketed class — v0's auto heuristic keys off
+        the exact kv length, and resolution must reproduce the
+        pre-registry behavior bit-for-bit)."""
+        p = self.p
+        if p.get("impl") == "xla":
+            return {"impl": "xla"}
+        out: Dict[str, object] = {
+            "impl": "flash",
+            "block_q": int(p.get("block_q", 1024)),
+            "block_k": int(p.get("block_k", 1024)),
+            "window_block_k": None,
+        }
+        wbk = p.get("window_block_k", "auto")
+        if window:
+            if wbk == "auto":
+                # The PR-3 heuristic, verbatim: 2x-window pow2 KV
+                # blocks whenever w << s (skv >= 4*window) and the
+                # 2-block span still covers at most half the KV axis.
+                if skv >= 4 * window:
+                    cand = _pow2_ge(2 * window)
+                    if 2 * cand <= skv // 2:
+                        out["window_block_k"] = cand
+            elif wbk == 0:
+                out["window_block_k"] = 0
+            elif isinstance(wbk, tuple) and wbk[0] == "mult":
+                out["window_block_k"] = wbk[1] * _pow2_ge(window)
+        return out
+
+
+def _v(kind, name, doc, **params):
+    return KernelVariant(kind, name, tuple(sorted(params.items())), doc)
+
+
+# v0 of each family IS the pre-registry default — resolution reproduces
+# the old inline behavior exactly, so numerics cannot drift.
+FLASH_VARIANTS = (
+    _v("flash", "v0",
+       "default: bq=bk=1024, PR-3 auto window_block_k heuristic"),
+    _v("flash", "bq_half", "half-height query tiles (fwd bit-exact)",
+       block_q=512),
+    _v("flash", "bk_half", "half-width KV blocks", block_k=512),
+    _v("flash", "bqk_half", "both tiles halved", block_q=512,
+       block_k=512),
+    _v("flash", "full_grid",
+       "full causal grid with in-kernel window skipping (the PR-3 "
+       "lever disabled)", window_block_k=0),
+    _v("flash", "wgrid_x1",
+       "forced restricted grid, KV block = pow2(window)",
+       window_block_k=("mult", 1)),
+    _v("flash", "wgrid_x2",
+       "forced restricted grid, KV block = 2*pow2(window) (the PR-3 "
+       "auto heuristic as an explicit, measured choice)",
+       window_block_k=("mult", 2)),
+    _v("flash", "wgrid_x4",
+       "forced restricted grid, KV block = 4*pow2(window)",
+       window_block_k=("mult", 4)),
+    _v("flash", "xla_split",
+       "split softcap: route to the XLA path (cap on materialised "
+       "scores) — can win at short sequences", impl="xla"),
+)
+
+MOE_VARIANTS = (
+    _v("moe", "v0", "grouped sorted dispatch (PR-3 default)",
+       impl="grouped"),
+    _v("moe", "einsum",
+       "dense one-hot dispatch/combine einsums (the GShard oracle — "
+       "bit-identical routing; can win when E*C is tiny)",
+       impl="einsum"),
+)
+
+VARIANTS: Dict[str, Tuple[KernelVariant, ...]] = {
+    "flash": FLASH_VARIANTS,
+    "moe": MOE_VARIANTS,
+}
+
+
+def get_variant(kind: str, name: str) -> Optional[KernelVariant]:
+    for v in VARIANTS.get(kind, ()):
+        if v.name == name:
+            return v
+    return None
+
+
+def variants_for(sc: ShapeClass) -> Tuple[KernelVariant, ...]:
+    """Applicable variants for a shape class, v0 first."""
+    return tuple(v for v in VARIANTS.get(sc.kind, ()) if v.applies(sc))
+
+
+# -------------------------------------------------------------------------
+# active tune table + resolution
+# -------------------------------------------------------------------------
+
+_lock = threading.Lock()
+_active_table = None  # shifu_tpu.tune.table.TuneTable | None
+_active_path: Optional[str] = None
+_table_cache: Dict[str, object] = {}  # path -> table | None (failed)
+_selections: Dict[str, Dict[str, int]] = {}  # token -> {variant: n}
+_warned: set = set()
+
+
+def _warn_once(key: str, msg: str) -> None:
+    with _lock:
+        if key in _warned:
+            return
+        _warned.add(key)
+    print(f"[shifu_tpu.tune] {msg}", file=sys.stderr)
+
+
+def set_active_table(table, path: Optional[str] = None) -> None:
+    """Install ``table`` (a tune.table.TuneTable or None) as the
+    process-wide winner source for :func:`resolve`."""
+    global _active_table, _active_path
+    with _lock:
+        _active_table = table
+        _active_path = path
+
+
+def active_table():
+    return _active_table
+
+
+def use_table(path: Optional[str]):
+    """Load the tune-table artifact at ``path`` and make it active.
+
+    Invalid artifacts NEVER break the caller: schema mismatch, corrupt
+    content, or a device-kind mismatch each fall back to ``v0`` with a
+    one-line warning. Loads are cached per path (the config-level
+    plumbing calls this at every trace). Returns the active table (or
+    None on fallback).
+    """
+    if not path:
+        set_active_table(None, None)
+        return None
+    if path in _table_cache:
+        table = _table_cache[path]
+        if _active_path != path:
+            set_active_table(table, path if table is not None else None)
+        return table
+    from shifu_tpu.tune.table import TuneTableError, load_table
+
+    table = None
+    try:
+        table = load_table(path)
+    except (OSError, TuneTableError) as e:
+        _warn_once(
+            f"load:{path}",
+            f"tune table {path!r} unusable ({e}); running v0 defaults",
+        )
+    if table is not None:
+        kind = _device_kind()
+        if table.device_kind != kind:
+            _warn_once(
+                f"dev:{path}",
+                f"tune table {path!r} was tuned for "
+                f"{table.device_kind!r} but this process runs on "
+                f"{kind!r}; running v0 defaults",
+            )
+            table = None
+    _table_cache[path] = table
+    set_active_table(table, path if table is not None else None)
+    return table
+
+
+def _device_kind() -> str:
+    import jax
+
+    dev = jax.devices()[0]
+    return getattr(dev, "device_kind", dev.platform)
+
+
+def _record_selection(sc: ShapeClass, variant: KernelVariant) -> None:
+    with _lock:
+        per = _selections.setdefault(sc.token, {})
+        per[variant.name] = per.get(variant.name, 0) + 1
+    try:
+        from shifu_tpu.obs import REGISTRY
+
+        REGISTRY.counter(
+            "shifu_kernel_variant_selected_total",
+            "kernel variant resolutions (trace-time) by shape class",
+            ("shape_class", "variant"),
+        ).labels(shape_class=sc.token, variant=variant.name).inc()
+    except Exception:
+        pass  # observability must never sink a kernel launch
+
+
+def resolve(sc: ShapeClass, *, record: bool = True) -> KernelVariant:
+    """Shape class -> the variant to run.
+
+    The active tune table's winner when it names a registered,
+    applicable variant; ``v0`` otherwise (unknown winners warn once —
+    a stale table must degrade loudly-but-safely, not crash serving).
+    """
+    v0 = VARIANTS[sc.kind][0]
+    chosen = v0
+    table = _active_table
+    if table is not None:
+        name = table.winner(sc.token)
+        if name is not None and name != v0.name:
+            cand = get_variant(sc.kind, name)
+            if cand is None or not cand.applies(sc):
+                _warn_once(
+                    f"win:{sc.token}:{name}",
+                    f"tune table winner {name!r} for {sc.token} is "
+                    "not a registered applicable variant; using v0",
+                )
+            else:
+                chosen = cand
+    if record:
+        _record_selection(sc, chosen)
+    return chosen
+
+
+def selection_counts() -> Dict[str, Dict[str, int]]:
+    with _lock:
+        return {t: dict(c) for t, c in _selections.items()}
+
+
+def kernels_status() -> dict:
+    """The ``/statz`` ``kernels`` block: active table identity + the
+    per-shape-class variants this process has actually selected."""
+    table = _active_table
+    out: dict = {
+        "table": _active_path,
+        "schema": None,
+        "device_kind": None,
+        "content_hash": None,
+        "entries": {},
+        "selected": selection_counts(),
+    }
+    if table is not None:
+        out["schema"] = table.schema
+        out["device_kind"] = table.device_kind
+        out["content_hash"] = table.content_hash()
+        out["entries"] = {
+            tok: e.get("variant") for tok, e in table.entries.items()
+        }
+    return out
+
+
+def _reset_for_tests() -> None:
+    """Drop all registry state (active table, caches, tallies)."""
+    global _active_table, _active_path
+    with _lock:
+        _active_table = None
+        _active_path = None
+        _table_cache.clear()
+        _selections.clear()
+        _warned.clear()

@@ -1,0 +1,38 @@
+"""Persistent kernel autotuning (``shifu_tpu tune``).
+
+Pairs with the variant registry (shifu_tpu.ops.pallas.registry): the
+registry names WHAT can run per shape class; this package measures
+WHICH to run on a given device and persists the winners as a
+versioned, content-hashed artifact that ``--tune-table`` activates and
+``shifu_tpu obs check-tune`` diffs.
+"""
+
+from shifu_tpu.tune.autotune import (
+    TUNE_LEGS,
+    autotune,
+    check_registry,
+    make_wall_timer,
+    tune_cases,
+)
+from shifu_tpu.tune.table import (
+    TuneTable,
+    TuneTableError,
+    check_table,
+    diff_tables,
+    load_table,
+    save_table,
+)
+
+__all__ = [
+    "TUNE_LEGS",
+    "TuneTable",
+    "TuneTableError",
+    "autotune",
+    "check_registry",
+    "check_table",
+    "diff_tables",
+    "load_table",
+    "make_wall_timer",
+    "save_table",
+    "tune_cases",
+]

@@ -1,0 +1,296 @@
+"""The persistent autotuner: ``shifu_tpu tune``.
+
+Times every applicable kernel variant for each benchmark leg's shape
+classes ONCE and writes the winners as a versioned table artifact
+(tune.table). Legs mirror the soft spots the benchgate floors watch:
+
+  ``lcw``  windowed long-context flash attention (s=8192, w=1024 —
+           the lcw_mfu 0.58 floor's configuration),
+  ``g2``   the Gemma-2 stack's TWO per-layer shape classes (softcap +
+           window on even layers, softcap + full causal on odd — the
+           g2_mfu 0.55 floor; tuning them independently is the
+           per-layer heterogeneous lever the PR-4 lax.cond dispatch
+           enables),
+  ``moe``  grouped-vs-einsum MoE dispatch at the bench leg's shape
+           (the moe_mfu 0.45 floor).
+
+Each candidate is timed fwd+grad (the floors are TRAINING MFU floors)
+with a best-of-N wall timer. The timer is INJECTABLE — tests drive a
+deterministic walk on CPU with a fake timer and never build the
+workloads at all (the workload thunk is lazy).
+
+``--preset smoke`` shrinks every leg to CPU-interpret-feasible shapes:
+a real end-to-end tune (resolve -> time -> write -> load -> serve)
+that finishes in seconds, for CI and for trying the flow without a
+TPU. Winners from a smoke tune are keyed by the smoke shape classes
+and device kind, so they can never leak into production selection.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from datetime import datetime, timezone
+from typing import Callable, Dict, List, Optional, Sequence
+
+from shifu_tpu.ops.pallas import registry as reg
+from shifu_tpu.tune.table import TuneTable
+
+TUNE_LEGS = ("moe", "lcw", "g2")
+
+
+@dataclasses.dataclass(frozen=True)
+class TuneCase:
+    """One shape class to tune: ``make_fn(variant)`` builds a zero-arg
+    timed closure (jitted fwd+grad, block_until_ready inside)."""
+
+    leg: str
+    sc: reg.ShapeClass
+    make_fn: Callable[[reg.KernelVariant], Callable[[], None]]
+
+
+# -------------------------------------------------------------------------
+# workloads
+# -------------------------------------------------------------------------
+
+
+def _flash_case(leg: str, *, seq: int, heads: int, kv_heads: int,
+                head_dim: int, window: Optional[int],
+                softcap: Optional[float], dtype) -> TuneCase:
+    sc = reg.ShapeClass.flash(
+        kv_len=seq, head_dim=head_dim, gqa=heads // kv_heads,
+        window=window, softcap=softcap, dtype=dtype,
+    )
+
+    def make(variant: reg.KernelVariant) -> Callable[[], None]:
+        import jax
+        import jax.numpy as jnp
+
+        from shifu_tpu.ops.attention import dot_product_attention
+        from shifu_tpu.ops.pallas.flash_attention import flash_attention
+
+        kq, kk, kv = jax.random.split(jax.random.key(0), 3)
+        q = jax.random.normal(kq, (1, seq, heads, head_dim), dtype)
+        k = jax.random.normal(kk, (1, seq, kv_heads, head_dim), dtype)
+        v = jax.random.normal(kv, (1, seq, kv_heads, head_dim), dtype)
+
+        if variant.p.get("impl") == "xla":
+            def attn(q, k, v):
+                return dot_product_attention(
+                    q, k, v, causal=True, window=window,
+                    softcap=softcap, impl="xla",
+                )
+        else:
+            def attn(q, k, v):
+                return flash_attention(
+                    q, k, v, window=window, softcap=softcap,
+                    variant=variant,
+                )
+
+        def loss(q, k, v):
+            return attn(q, k, v).astype(jnp.float32).sum()
+
+        step = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+
+        def run():
+            jax.block_until_ready(step(q, k, v))
+
+        return run
+
+    return TuneCase(leg, sc, make)
+
+
+def _moe_case(leg: str, *, seq: int, dim: int, experts: int, top_k: int,
+              mlp_dim: int, batch: int, dtype) -> TuneCase:
+    sc = reg.ShapeClass.moe(
+        seq_len=seq, dim=dim, experts=experts, top_k=top_k, dtype=dtype,
+    )
+
+    def make(variant: reg.KernelVariant) -> Callable[[], None]:
+        import jax
+        import jax.numpy as jnp
+
+        from shifu_tpu.models.transformer import (
+            Transformer,
+            TransformerConfig,
+        )
+
+        cfg = TransformerConfig.tiny(
+            dim=dim, mlp_dim=mlp_dim, n_experts=experts,
+            moe_top_k=top_k, n_layers=1, n_heads=4, n_kv_heads=2,
+            moe_impl=str(variant.p.get("impl", "grouped")),
+        )
+        model = Transformer(cfg)
+        params = model.init(jax.random.key(0))
+        blocks = {kk: vv[0] for kk, vv in params["blocks"].items()}
+        x = jax.random.normal(jax.random.key(1), (batch, seq, dim), dtype)
+
+        def loss(blocks, x):
+            out, _aux = model._moe_ffn(blocks, x)
+            return out.astype(jnp.float32).sum()
+
+        step = jax.jit(jax.grad(loss))
+
+        def run():
+            jax.block_until_ready(step(blocks, x))
+
+        return run
+
+    return TuneCase(leg, sc, make)
+
+
+def tune_cases(legs: Sequence[str] = TUNE_LEGS,
+               preset: str = "full") -> List[TuneCase]:
+    """The shape classes each leg tunes. ``full`` mirrors the bench
+    legs (TPU-sized); ``smoke`` is CPU-interpret feasible."""
+    if preset not in ("full", "smoke"):
+        raise ValueError(f"preset={preset!r} (want 'full' or 'smoke')")
+    import jax.numpy as jnp
+
+    full = preset == "full"
+    dt = jnp.bfloat16 if full else jnp.float32
+    cases: List[TuneCase] = []
+    for leg in legs:
+        if leg == "lcw":
+            cases.append(_flash_case(
+                "lcw",
+                seq=8192 if full else 512, heads=16 if full else 4,
+                kv_heads=4 if full else 2,
+                head_dim=128 if full else 16,
+                window=1024 if full else 64, softcap=None, dtype=dt,
+            ))
+        elif leg == "g2":
+            kw = dict(
+                seq=4096 if full else 256, heads=16 if full else 4,
+                kv_heads=4 if full else 2,
+                head_dim=128 if full else 16,
+                softcap=50.0 if full else 30.0, dtype=dt,
+            )
+            # The alternating stack's two per-layer classes, tuned
+            # independently (per-layer heterogeneous variants).
+            cases.append(_flash_case(
+                "g2", window=512 if full else 64, **kw
+            ))
+            cases.append(_flash_case("g2", window=None, **kw))
+        elif leg == "moe":
+            cases.append(_moe_case(
+                "moe",
+                seq=2048 if full else 64, dim=1024 if full else 32,
+                experts=8 if full else 4, top_k=2,
+                mlp_dim=2816 if full else 32, batch=8 if full else 2,
+                dtype=dt,
+            ))
+        else:
+            raise ValueError(
+                f"unknown tune leg {leg!r} (want one of {TUNE_LEGS})"
+            )
+    return cases
+
+
+# -------------------------------------------------------------------------
+# timing + the walk
+# -------------------------------------------------------------------------
+
+
+def make_wall_timer(repeats: int = 3,
+                    warmup: int = 1) -> Callable:
+    """Best-of-N wall timer: ``timer(case, variant, make_fn) -> s``.
+
+    ``make_fn`` is a LAZY thunk returning the timed closure — an
+    injected fake timer (tests) never calls it, so a deterministic
+    autotune walk builds no workloads at all."""
+
+    def timer(case: TuneCase, variant: reg.KernelVariant,
+              make_fn: Callable[[], Callable[[], None]]) -> float:
+        run = make_fn()
+        for _ in range(max(0, warmup)):
+            run()
+        best = float("inf")
+        for _ in range(max(1, repeats)):
+            t0 = time.perf_counter()
+            run()
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    return timer
+
+
+def autotune(legs: Sequence[str] = TUNE_LEGS, *, preset: str = "full",
+             timer: Optional[Callable] = None,
+             repeats: int = 3) -> TuneTable:
+    """Time every applicable variant per shape class; return the
+    winner table. Ties (and anything within measurement identity)
+    resolve to the EARLIER registration — v0 wins unless a challenger
+    strictly beats it, so a noisy tie can never flip the default."""
+    timer = timer if timer is not None else make_wall_timer(repeats)
+    # Tuning must measure each candidate AS ASKED — a previously
+    # activated table must not redirect the grouped-MoE or flash
+    # workloads mid-measurement.
+    prev = reg.active_table()
+    reg.set_active_table(None)
+    try:
+        entries: Dict[str, dict] = {}
+        for case in tune_cases(legs, preset):
+            cands: Dict[str, float] = {}
+            best_name, best_t = None, float("inf")
+            for v in reg.variants_for(case.sc):
+                t = float(timer(case, v, lambda v=v: case.make_fn(v)))
+                cands[v.name] = round(t * 1000, 4)
+                if t < best_t:
+                    best_name, best_t = v.name, t
+            if best_name is None:
+                continue  # no applicable variants (cannot happen: v0)
+            entries[case.sc.token] = {
+                "leg": case.leg,
+                "variant": best_name,
+                "ms": cands[best_name],
+                "candidates_ms": cands,
+            }
+    finally:
+        reg.set_active_table(prev)
+    return TuneTable(
+        device_kind=reg._device_kind(),
+        entries=entries,
+        created=datetime.now(timezone.utc).isoformat(
+            timespec="seconds"
+        ),
+        legs=tuple(dict.fromkeys(c for c in legs)),
+    )
+
+
+def check_registry(legs: Sequence[str] = TUNE_LEGS,
+                   preset: str = "full") -> dict:
+    """``shifu_tpu tune --check``: no timing — validate that every
+    leg's shape classes resolve (v0 applies everywhere, candidate
+    names unique, at least one challenger to measure). Fast enough
+    for the tier-1 path."""
+    problems: List[str] = []
+    rows = []
+    for case in tune_cases(legs, preset):
+        cands = reg.variants_for(case.sc)
+        names = [v.name for v in cands]
+        if len(set(names)) != len(names):
+            problems.append(f"{case.sc.token}: duplicate variant names")
+        if not cands or cands[0].name != "v0":
+            problems.append(
+                f"{case.sc.token}: v0 missing or not first"
+            )
+        if len(cands) < 2:
+            problems.append(
+                f"{case.sc.token}: nothing to tune (only "
+                f"{names or 'no variants'})"
+            )
+        rows.append({
+            "leg": case.leg,
+            "shape_class": case.sc.token,
+            "candidates": names,
+        })
+    # Round-trip an empty artifact through the validating constructor:
+    # a schema drift between writer and reader fails here, not in prod.
+    t = TuneTable(device_kind=reg._device_kind(), entries={})
+    TuneTable.from_doc(t.to_doc())
+    return {
+        "status": "ok" if not problems else "fail",
+        "cases": rows,
+        "problems": problems,
+    }

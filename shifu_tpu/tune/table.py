@@ -1,0 +1,241 @@
+"""The persistent winner-table artifact (``kernels.tune.json``).
+
+``shifu_tpu tune`` benchmarks every applicable kernel variant per
+shape class ONCE and persists the winners here — a versioned,
+schema-checked, content-hashed JSON artifact that engine/bench/train
+activate via ``--tune-table`` and the benchgate diffs via ``shifu_tpu
+obs check-tune``. The table is a reviewable fact, like a BENCH row:
+a winner changing between two tunes is a diff a human signs off on,
+not a silent behavioral drift.
+
+Failure posture (enforced by ops.pallas.registry.use_table and pinned
+in tests/test_tune.py): a missing, corrupt (content-hash mismatch),
+schema-incompatible, or wrong-device artifact NEVER breaks the caller
+— it falls back to ``v0`` with a one-line warning.
+
+Artifact shape (schema 1)::
+
+    {
+      "kind": "shifu_tpu.kernel_tune_table",
+      "schema": 1,
+      "device_kind": "TPU v5 lite",
+      "created": "2026-08-04T12:00:00Z",
+      "legs": ["moe", "lcw", "g2"],
+      "entries": {
+        "flash:sb8192:d128:g4:w1024:c0:bf16": {
+          "variant": "wgrid_x2",
+          "ms": 41.2,
+          "candidates_ms": {"v0": 41.2, "full_grid": 58.0, ...}
+        },
+        ...
+      },
+      "content_hash": "sha256:..."   // over (schema, device_kind, entries)
+    }
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import tempfile
+from typing import Dict, Optional, Tuple
+
+SCHEMA_VERSION = 1
+ARTIFACT_KIND = "shifu_tpu.kernel_tune_table"
+
+
+class TuneTableError(ValueError):
+    """The artifact is not a usable tune table (corrupt / wrong kind /
+    incompatible schema / malformed entries)."""
+
+
+def _canonical_hash(schema: int, device_kind: str,
+                    entries: Dict[str, dict]) -> str:
+    blob = json.dumps(
+        {"schema": schema, "device_kind": device_kind,
+         "entries": entries},
+        sort_keys=True, separators=(",", ":"),
+    ).encode()
+    return "sha256:" + hashlib.sha256(blob).hexdigest()
+
+
+@dataclasses.dataclass
+class TuneTable:
+    device_kind: str
+    entries: Dict[str, dict]  # shape-class token -> {"variant", "ms", ...}
+    schema: int = SCHEMA_VERSION
+    created: str = ""
+    legs: Tuple[str, ...] = ()
+
+    def winner(self, token: str) -> Optional[str]:
+        e = self.entries.get(token)
+        return e.get("variant") if isinstance(e, dict) else None
+
+    def content_hash(self) -> str:
+        return _canonical_hash(self.schema, self.device_kind, self.entries)
+
+    def to_doc(self) -> dict:
+        return {
+            "kind": ARTIFACT_KIND,
+            "schema": self.schema,
+            "device_kind": self.device_kind,
+            "created": self.created,
+            "legs": list(self.legs),
+            "entries": self.entries,
+            "content_hash": self.content_hash(),
+        }
+
+    @classmethod
+    def from_doc(cls, doc: object) -> "TuneTable":
+        """Validating constructor — every way an artifact can be wrong
+        raises :class:`TuneTableError` with a one-line reason."""
+        if not isinstance(doc, dict):
+            raise TuneTableError("artifact is not a JSON object")
+        if doc.get("kind") != ARTIFACT_KIND:
+            raise TuneTableError(
+                f"kind={doc.get('kind')!r} (want {ARTIFACT_KIND!r})"
+            )
+        schema = doc.get("schema")
+        if schema != SCHEMA_VERSION:
+            raise TuneTableError(
+                f"schema {schema!r} incompatible with reader "
+                f"{SCHEMA_VERSION}"
+            )
+        device_kind = doc.get("device_kind")
+        if not isinstance(device_kind, str) or not device_kind:
+            raise TuneTableError("missing device_kind")
+        entries = doc.get("entries")
+        if not isinstance(entries, dict):
+            raise TuneTableError("missing entries object")
+        for token, e in entries.items():
+            if not isinstance(e, dict) or not isinstance(
+                e.get("variant"), str
+            ):
+                raise TuneTableError(
+                    f"entry {token!r} lacks a variant name"
+                )
+        want = doc.get("content_hash")
+        got = _canonical_hash(schema, device_kind, entries)
+        if want != got:
+            raise TuneTableError(
+                "content hash mismatch (artifact corrupt or "
+                "hand-edited without rehashing)"
+            )
+        return cls(
+            device_kind=device_kind,
+            entries=dict(entries),
+            schema=schema,
+            created=str(doc.get("created", "")),
+            legs=tuple(doc.get("legs", ())),
+        )
+
+
+def save_table(table: TuneTable, path: str) -> None:
+    """Atomic write (tmp + rename) so a crashed tune never leaves a
+    torn artifact where ``--tune-table`` will find it."""
+    doc = table.to_doc()
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=".tune.", suffix=".json")
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(doc, f, indent=2, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def load_table(path: str) -> TuneTable:
+    """Load + validate; raises OSError / TuneTableError on anything
+    short of a well-formed, hash-verified artifact."""
+    with open(path) as f:
+        try:
+            doc = json.load(f)
+        except ValueError as e:
+            raise TuneTableError(f"not JSON: {e}") from e
+    return TuneTable.from_doc(doc)
+
+
+def check_table(table: TuneTable,
+                device_kind: Optional[str] = None) -> list:
+    """Semantic validation against the LIVE registry: every entry's
+    token must parse, its winner must be a registered variant that
+    applies to the class. Returns a list of problem strings (empty =
+    clean). ``device_kind``: also flag a device mismatch."""
+    from shifu_tpu.ops.pallas import registry as reg
+
+    problems = []
+    if device_kind is not None and table.device_kind != device_kind:
+        problems.append(
+            f"device_kind {table.device_kind!r} != running "
+            f"{device_kind!r}"
+        )
+    for token, e in sorted(table.entries.items()):
+        try:
+            sc = reg.ShapeClass.parse(token)
+        except ValueError as err:
+            problems.append(str(err))
+            continue
+        name = e.get("variant")
+        v = reg.get_variant(sc.kind, name)
+        if v is None:
+            problems.append(
+                f"{token}: winner {name!r} is not a registered "
+                f"{sc.kind} variant"
+            )
+        elif not v.applies(sc):
+            problems.append(
+                f"{token}: winner {name!r} does not apply to this "
+                "shape class"
+            )
+        ms = e.get("ms")
+        if ms is not None and not isinstance(ms, (int, float)):
+            problems.append(f"{token}: ms is not a number")
+    return problems
+
+
+def diff_tables(old: TuneTable, new: TuneTable) -> dict:
+    """A reviewable winner-table diff (``shifu_tpu obs check-tune``).
+
+    Winners are the gated fact; per-candidate timings are recorded
+    context (they wobble run to run and do not make two tables
+    "different"). ``status`` is "identical" when device kind and every
+    winner agree, else "changed"."""
+    changed = []
+    for token in sorted(set(old.entries) & set(new.entries)):
+        o, n = old.winner(token), new.winner(token)
+        if o != n:
+            changed.append({
+                "shape_class": token,
+                "old": o,
+                "new": n,
+                "old_ms": old.entries[token].get("ms"),
+                "new_ms": new.entries[token].get("ms"),
+            })
+    added = sorted(set(new.entries) - set(old.entries))
+    removed = sorted(set(old.entries) - set(new.entries))
+    identical = (
+        not changed and not added and not removed
+        and old.device_kind == new.device_kind
+    )
+    return {
+        "status": "identical" if identical else "changed",
+        "device_kind": {"old": old.device_kind, "new": new.device_kind},
+        "schema": {"old": old.schema, "new": new.schema},
+        "content_hash": {
+            "old": old.content_hash(), "new": new.content_hash(),
+        },
+        "changed": changed,
+        "added": [
+            {"shape_class": t, "variant": new.winner(t)} for t in added
+        ],
+        "removed": [
+            {"shape_class": t, "variant": old.winner(t)} for t in removed
+        ],
+    }

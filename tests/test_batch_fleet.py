@@ -267,8 +267,13 @@ def test_sigkill_backend_mid_batch_completes_on_survivor(tmp_path):
         assert report["status"] == "completed"
         assert report["failed"] == 0, report
         _assert_exactly_once(out, n)
-        # The fleet noticed: breaker open on the corpse, survivor up.
-        assert router.backends[0].breaker.state == "open"
+        # The fleet noticed: breaker tripped on the corpse, survivor
+        # up. The job tail outlives the breaker's cooldown, so the
+        # corpse's breaker legitimately cycles open -> half_open
+        # (probe admitted) -> open for the rest of the run — "open" at
+        # the instant of this assert is a race against that probe.
+        # Closed is the failure; either tripped state proves the walk.
+        assert router.backends[0].breaker.state in ("open", "half_open")
         assert router.backends[1].routable()
     finally:
         prober.stop()

@@ -1,0 +1,389 @@
+"""Kernel-variant registry: shape classes, applicability, resolution,
+and the PARITY SWEEP — every registered variant against the v0 oracle.
+
+Parity contract (ops/pallas/registry.py module docstring):
+
+  * same effective block_k as v0  -> FORWARD bit-identical;
+  * same block_q AND block_k      -> gradients bit-identical too;
+  * different block partition (or the split/XLA route) -> ULP-level
+    f32 tolerance, the repo's established oracle contract.
+
+The sweep runs the flash kernel in CPU interpret mode over
+softcap x window x GQA x packed-segments, fwd + grad, at a sequence
+length (256, blocks floored well below it by the half-size variants'
+own knobs) where different blockings genuinely take different code
+paths. MoE variants sweep grouped-vs-einsum at the model level.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from shifu_tpu.models import Transformer, TransformerConfig
+from shifu_tpu.ops.pallas import registry as reg
+from shifu_tpu.ops.pallas.flash_attention import flash_attention
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    reg._reset_for_tests()
+    yield
+    reg._reset_for_tests()
+
+
+# -------------------------------------------------------------------------
+# shape classes
+# -------------------------------------------------------------------------
+
+
+def test_shape_class_token_roundtrip():
+    sc = reg.ShapeClass.flash(
+        kv_len=7000, head_dim=128, gqa=4, window=1024, softcap=50.0,
+        dtype=jnp.bfloat16,
+    )
+    assert sc.token == "flash:sb8192:d128:g4:w1024:c1:dtbf16"
+    assert reg.ShapeClass.parse(sc.token) == sc
+    mc = reg.ShapeClass.moe(
+        seq_len=2048, dim=1024, experts=8, top_k=2, dtype=jnp.bfloat16
+    )
+    assert mc.token == "moe:sb2048:d1024:e8:k2:dtbf16"
+    assert reg.ShapeClass.parse(mc.token) == mc
+
+
+def test_shape_class_buckets_and_canonicalisation():
+    a = reg.ShapeClass.flash(
+        kv_len=5000, head_dim=64, gqa=2, window=None, softcap=None,
+        dtype=jnp.float32,
+    )
+    b = reg.ShapeClass.flash(
+        kv_len=8192, head_dim=64, gqa=2, window=None, softcap=None,
+        dtype=np.float32,
+    )
+    assert a == b  # same bucket, window 0, dtype canonical
+    assert a.get("w") == 0 and a.get("c") == 0
+
+
+def test_shape_class_parse_rejects_junk():
+    for bad in ("flash:sb8192", "nope:sb1:d1", "flash:xx1:d1:g1:w0:c0:dtf32"):
+        with pytest.raises(ValueError):
+            reg.ShapeClass.parse(bad)
+
+
+def test_variant_applicability_filters_noops():
+    small = reg.ShapeClass.flash(
+        kv_len=256, head_dim=16, gqa=2, window=64, softcap=None,
+        dtype=jnp.float32,
+    )
+    names = [v.name for v in reg.variants_for(small)]
+    # Block-halving is a no-op at sb256 (both clamp to 256); wgrid_x4
+    # would cover more than half the KV axis.
+    assert "v0" in names and names[0] == "v0"
+    assert "bk_half" not in names and "wgrid_x4" not in names
+    big = reg.ShapeClass.flash(
+        kv_len=8192, head_dim=128, gqa=4, window=1024, softcap=None,
+        dtype=jnp.bfloat16,
+    )
+    big_names = [v.name for v in reg.variants_for(big)]
+    for want in ("v0", "bq_half", "bk_half", "full_grid", "wgrid_x2"):
+        assert want in big_names
+    assert "xla_split" not in big_names  # softcap-only variant
+    capped = reg.ShapeClass.flash(
+        kv_len=4096, head_dim=128, gqa=4, window=None, softcap=50.0,
+        dtype=jnp.bfloat16,
+    )
+    assert "xla_split" in [v.name for v in reg.variants_for(capped)]
+
+
+def test_v0_knobs_reproduce_pr3_heuristic():
+    v0 = reg.get_variant("flash", "v0")
+    # w << s: auto-engages at 2x-window pow2.
+    k = v0.flash_knobs(8192, 8192, 1024)
+    assert k["window_block_k"] == 2048 and k["block_q"] == 1024
+    # Guard: the 2-block span may not cover more than half the KV axis.
+    assert v0.flash_knobs(256, 256, 64)["window_block_k"] is None
+    # No window: plain defaults.
+    assert v0.flash_knobs(2048, 2048, None)["window_block_k"] is None
+
+
+def test_resolve_falls_back_to_v0_and_tallies():
+    sc = reg.ShapeClass.flash(
+        kv_len=512, head_dim=16, gqa=2, window=64, softcap=None,
+        dtype=jnp.float32,
+    )
+    assert reg.resolve(sc).name == "v0"  # no table
+    from shifu_tpu.tune.table import TuneTable
+
+    reg.set_active_table(TuneTable(
+        device_kind="x", entries={sc.token: {"variant": "wgrid_x1"}},
+    ), "mem")
+    assert reg.resolve(sc).name == "wgrid_x1"
+    # Unknown winner: warn once, run v0.
+    reg.set_active_table(TuneTable(
+        device_kind="x", entries={sc.token: {"variant": "nope"}},
+    ), "mem")
+    assert reg.resolve(sc).name == "v0"
+    counts = reg.selection_counts()[sc.token]
+    assert counts["v0"] == 2 and counts["wgrid_x1"] == 1
+    # The scrapeable mirror: shifu_kernel_variant_selected_total on
+    # the global obs registry carries the same tallies per label pair.
+    from shifu_tpu.obs import REGISTRY
+
+    assert REGISTRY.value(
+        "shifu_kernel_variant_selected_total",
+        {"shape_class": sc.token, "variant": "wgrid_x1"},
+    ) >= 1.0
+
+
+# -------------------------------------------------------------------------
+# the parity sweep
+# -------------------------------------------------------------------------
+
+_S = 256  # big enough that half-size blocks genuinely re-partition
+
+
+def _qkv(gqa, seed=0, s=_S, d=16, h=4):
+    rng = np.random.RandomState(seed)
+    kv = h // gqa
+    q = jnp.asarray(rng.randn(1, s, h, d), jnp.float32)
+    k = jnp.asarray(rng.randn(1, s, kv, d), jnp.float32)
+    v = jnp.asarray(rng.randn(1, s, kv, d), jnp.float32)
+    return q, k, v
+
+
+def _segs(s=_S):
+    # Two packed sequences per row.
+    return jnp.asarray(
+        np.repeat([[0, 1]], s // 2, axis=1).reshape(1, s), jnp.int32
+    )
+
+
+def _run(variant, q, k, v, *, window, softcap, segs):
+    """fwd + grads through one variant; returns (out, grads, eff)
+    where ``eff`` is the effective (block_q, block_k) actually run —
+    or ("xla",) for the split route. Block knobs are scaled DOWN
+    uniformly (1024 -> 128, floor 32) so the relative block-shape
+    deltas the variants encode show up at a CPU-interpret-feasible
+    sequence length; the scaling preserves which variants share a KV
+    fold partition, which is what the parity tiers key on."""
+    skv = k.shape[1]
+    knobs = variant.flash_knobs(q.shape[1], skv, window)
+    if knobs.get("impl") == "xla":
+        from shifu_tpu.ops import dot_product_attention
+
+        eff = ("xla",)
+
+        def f(q, k, v):
+            return dot_product_attention(
+                q, k, v, causal=True, window=window, softcap=softcap,
+                segment_ids=segs, impl="xla",
+            )
+    else:
+        bq = max(32, knobs["block_q"] // 8)
+        bk = max(32, knobs["block_k"] // 8)
+        wbk = knobs["window_block_k"]
+        if wbk:  # forced-window-grid blocks scale with the rest
+            wbk = max(32, wbk // 8)
+        eff = (min(bq, skv), min(wbk or bk, skv))
+
+        def f(q, k, v):
+            return flash_attention(
+                q, k, v, window=window, softcap=softcap,
+                segment_ids=segs, interpret=True, block_q=bq,
+                block_k=bk, window_block_k=wbk, variant="v0",
+            )
+
+    out = f(q, k, v)
+    grads = jax.grad(
+        lambda q, k, v: jnp.sum(f(q, k, v).astype(jnp.float32) ** 2),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    return out, grads, eff
+
+
+@pytest.mark.parametrize("window", [None, 64])
+@pytest.mark.parametrize("softcap", [None, 30.0])
+@pytest.mark.parametrize("gqa", [1, 2])
+@pytest.mark.parametrize("packed", [False, True])
+def test_every_variant_matches_v0(window, softcap, gqa, packed):
+    if packed and window is not None:
+        pytest.skip("packed segments ride the full-causal classes")
+    q, k, v = _qkv(gqa)
+    segs = _segs() if packed else None
+    sc = reg.ShapeClass.flash(
+        kv_len=_S, head_dim=16, gqa=gqa, window=window, softcap=softcap,
+        dtype=jnp.float32,
+    )
+    variants = reg.variants_for(sc)
+    assert variants[0].name == "v0"
+    # The scaled-down sweep re-admits block variants that sb-level
+    # applicability filtered as production no-ops: at /8 scale they DO
+    # re-partition, which is exactly what parity must cover.
+    extra = [
+        reg.get_variant("flash", n)
+        for n in ("bq_half", "bk_half", "bqk_half")
+    ]
+    sweep = list(variants) + [
+        e for e in extra if e not in variants
+    ]
+    o0, g0, e0 = _run(variants[0], q, k, v, window=window,
+                      softcap=softcap, segs=segs)
+    checked = 0
+    for var in sweep[1:]:
+        if var.p.get("impl") == "xla" and not softcap:
+            continue  # registered for softcap classes only
+        o, g, e = _run(var, q, k, v, window=window, softcap=softcap,
+                       segs=segs)
+        # Contract tiers (module docstring), keyed on the effective
+        # blocks actually run: same (bq, bk) -> fwd AND grads bitwise;
+        # same bk only -> fwd bitwise, grads ULP-close (the dk/dv
+        # accumulation partitions by block_q); different partition or
+        # the XLA route -> ULP tolerance throughout.
+        same_bk = "xla" not in (e0[0], e[0]) and e[1] == e0[1]
+        if same_bk:
+            np.testing.assert_array_equal(
+                np.asarray(o), np.asarray(o0),
+                err_msg=f"{var.name}: same-bk fwd must be bitwise",
+            )
+        else:
+            np.testing.assert_allclose(
+                np.asarray(o), np.asarray(o0), rtol=2e-5, atol=2e-6,
+                err_msg=f"{var.name}: fwd parity vs v0",
+            )
+        if e == e0:
+            for ga, gb in zip(g, g0):
+                np.testing.assert_array_equal(
+                    np.asarray(ga), np.asarray(gb),
+                    err_msg=f"{var.name}: grad not bit-identical",
+                )
+        else:
+            for ga, gb in zip(g, g0):
+                np.testing.assert_allclose(
+                    np.asarray(ga), np.asarray(gb), rtol=5e-4,
+                    atol=5e-5, err_msg=f"{var.name}: grad parity",
+                )
+        checked += 1
+    assert checked >= 2, "sweep degenerated: almost nothing ran"
+
+
+def test_forced_window_grid_variant_is_bitwise_at_same_bk():
+    # Grid layout alone (restricted span vs full grid with in-kernel
+    # skipping) must not change a single bit: skipped fully-masked
+    # blocks contribute exact zeros and identity rescales.
+    q, k, v = _qkv(2)
+    a = flash_attention(q, k, v, window=64, block_q=64, block_k=64,
+                        window_block_k=0, interpret=True)
+    b = flash_attention(q, k, v, window=64, block_q=64, block_k=64,
+                        window_block_k=64, interpret=True)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_moe_variants_match_v0_fwd_and_grad():
+    # The "moe" family: v0 (grouped) vs einsum — identical routing
+    # decisions by construction; model-level fwd is bit-level on CPU
+    # f32, grads ULP-close (different contraction order).
+    cfg_g = TransformerConfig.tiny_moe(moe_impl="grouped")
+    cfg_e = TransformerConfig.tiny_moe(moe_impl="einsum")
+    model_g, model_e = Transformer(cfg_g), Transformer(cfg_e)
+    params = model_g.init(jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (2, 32), 0, 255)
+
+    (lg, _), gg = jax.value_and_grad(
+        model_g.loss, has_aux=True
+    )(params, {"tokens": tokens})
+    (le, _), ge = jax.value_and_grad(
+        model_e.loss, has_aux=True
+    )(params, {"tokens": tokens})
+    np.testing.assert_allclose(
+        float(lg), float(le), rtol=1e-6, atol=1e-7
+    )
+    flat_g = jax.tree_util.tree_leaves(gg)
+    flat_e = jax.tree_util.tree_leaves(ge)
+    for a, b in zip(flat_g, flat_e):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-5, atol=5e-6
+        )
+
+
+def test_moe_table_reroutes_grouped_default_to_einsum():
+    # A tune-table winner flips the DEFAULT (grouped) moe dispatch to
+    # the einsum variant for its shape class — and only for it.
+    from shifu_tpu.tune.table import TuneTable
+
+    cfg = TransformerConfig.tiny_moe()  # moe_impl="grouped" default
+    model = Transformer(cfg)
+    params = model.init(jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (2, 32), 0, 255)
+    base = model(params, tokens)
+
+    # The Policy computes in bf16, so that's the dtype the dispatch
+    # resolves with.
+    sc = reg.ShapeClass.moe(
+        seq_len=32, dim=cfg.dim, experts=cfg.n_experts,
+        top_k=cfg.moe_top_k, dtype=jnp.bfloat16,
+    )
+    reg.set_active_table(TuneTable(
+        device_kind="x", entries={sc.token: {"variant": "einsum"}},
+    ), "mem")
+    rerouted = model(params, tokens)
+    # bf16 activations: the two dispatch forms round combine order
+    # differently (same tolerance test_moe pins for this pair).
+    np.testing.assert_allclose(
+        np.asarray(base), np.asarray(rerouted), rtol=3e-2, atol=3e-3
+    )
+    assert reg.selection_counts()[sc.token].get("einsum", 0) >= 1
+
+
+def test_alternating_window_stack_resolves_two_classes():
+    # Per-layer heterogeneous variants: a window_pattern flash stack
+    # resolves BOTH the windowed and the full-causal class; a table
+    # may tune them independently without changing the output beyond
+    # the variant parity contract.
+    from shifu_tpu.tune.table import TuneTable
+
+    cfg = TransformerConfig.tiny(
+        attn_impl="flash", window_size=64, window_pattern=2,
+        n_layers=2,
+    )
+    model = Transformer(cfg)
+    params = model.init(jax.random.key(0))
+    # s=256 so the forced-window-grid variants are applicable
+    # (wgrid_x1's 2-block span must fit in half the KV bucket).
+    tokens = jax.random.randint(jax.random.key(1), (1, 256), 0, 255)
+    base = model(params, tokens)
+    tokens_per_class = reg.selection_counts()
+    assert any(":w64:" in t for t in tokens_per_class)
+    assert any(":w0:" in t for t in tokens_per_class)
+
+    w_sc = reg.ShapeClass.flash(
+        kv_len=256, head_dim=cfg.resolved_head_dim,
+        gqa=cfg.n_heads // cfg.n_kv_heads, window=64, softcap=None,
+        dtype=jnp.bfloat16,  # the Policy's compute dtype
+    )
+    reg.set_active_table(TuneTable(
+        device_kind="x",
+        entries={w_sc.token: {"variant": "wgrid_x1"}},
+    ), "mem")
+    tuned = model(params, tokens)
+    # bf16 activations + a different KV fold partition: bf16-level
+    # agreement on the logits (near-zero entries make pure relative
+    # checks meaningless; the f32 op-level contract is the parity
+    # sweep above).
+    np.testing.assert_allclose(
+        np.asarray(base), np.asarray(tuned), rtol=3e-2, atol=5e-2
+    )
+    assert reg.selection_counts()[w_sc.token].get("wgrid_x1", 0) >= 1
+
+
+def test_explicit_kwargs_override_variant_knobs():
+    q, k, v = _qkv(2)
+    a = flash_attention(q, k, v, window=64, block_q=32, block_k=32,
+                        window_block_k=0, interpret=True)
+    b = flash_attention(q, k, v, window=64, variant="full_grid",
+                        block_q=32, block_k=32, interpret=True)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    with pytest.raises(ValueError, match="unknown flash variant"):
+        flash_attention(q, k, v, variant="not_a_variant",
+                        interpret=True)

@@ -155,6 +155,13 @@ def test_live_dp2_server_metrics_and_trace(tiny, tmp_path):
         assert statz["latency"]["completions"] == n_req
         assert "itl_ms_p50" in statz["latency"]
         assert "shifu_request_ttft_seconds" in statz["metrics"]
+        # Kernels block (round 10): tune-table identity + per-shape-
+        # class variant selections; no table active -> null identity
+        # but the block (and tallies, if any flash/moe dispatch ran)
+        # is always served.
+        assert "kernels" in statz
+        assert statz["kernels"]["table"] is None
+        assert "selected" in statz["kernels"]
 
         # /healthz still answers through the same protocol.
         status, _, body = _get(base, "/healthz")

@@ -1,0 +1,352 @@
+"""Persistent autotuner: artifact round-trip, corrupt/mismatch
+fallbacks, the deterministic fake-timer walk, table diffing, and the
+CLI surfaces (tune / tune --check / obs check-tune)."""
+
+import json
+
+import jax.numpy as jnp
+import pytest
+
+from shifu_tpu.cli import main as cli_main
+from shifu_tpu.ops.pallas import registry as reg
+from shifu_tpu.tune import (
+    TuneTable,
+    TuneTableError,
+    autotune,
+    check_registry,
+    check_table,
+    diff_tables,
+    load_table,
+    make_wall_timer,
+    save_table,
+    tune_cases,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    reg._reset_for_tests()
+    yield
+    reg._reset_for_tests()
+
+
+def _table(entries=None, device_kind=None, **kw):
+    return TuneTable(
+        device_kind=device_kind or reg._device_kind(),
+        entries=entries or {},
+        **kw,
+    )
+
+
+def _fake_timer(prefer):
+    """Deterministic injected timer: ``prefer`` maps variant name ->
+    seconds (default 1.0). Never builds the workload."""
+
+    def timer(case, variant, make_fn):
+        return prefer.get(variant.name, 1.0)
+
+    return timer
+
+
+# -------------------------------------------------------------------------
+# artifact round trip + corruption
+# -------------------------------------------------------------------------
+
+
+def test_table_round_trip(tmp_path):
+    t = _table({"flash:sb512:d16:g2:w64:c0:dtf32": {
+        "variant": "wgrid_x2", "ms": 1.5,
+        "candidates_ms": {"v0": 2.0, "wgrid_x2": 1.5},
+    }}, created="2026-08-04T00:00:00+00:00", legs=("lcw",))
+    p = tmp_path / "k.tune.json"
+    save_table(t, str(p))
+    t2 = load_table(str(p))
+    assert t2.entries == t.entries
+    assert t2.device_kind == t.device_kind
+    assert t2.content_hash() == t.content_hash()
+    assert t2.legs == ("lcw",)
+    assert check_table(t2, device_kind=t.device_kind) == []
+
+
+def test_load_rejects_garbage_and_truncation(tmp_path):
+    p = tmp_path / "junk.json"
+    p.write_text("{not json")
+    with pytest.raises(TuneTableError, match="not JSON"):
+        load_table(str(p))
+    good = tmp_path / "good.json"
+    save_table(_table(), str(good))
+    torn = tmp_path / "torn.json"
+    torn.write_text(good.read_text()[:40])
+    with pytest.raises(TuneTableError):
+        load_table(str(torn))
+
+
+def test_load_rejects_bit_flip_via_content_hash(tmp_path):
+    p = tmp_path / "k.json"
+    save_table(_table({"flash:sb512:d16:g2:w64:c0:dtf32": {
+        "variant": "v0", "ms": 2.0,
+    }}), str(p))
+    doc = json.loads(p.read_text())
+    doc["entries"]["flash:sb512:d16:g2:w64:c0:dtf32"]["variant"] = (
+        "wgrid_x2"  # hand-edit without rehashing
+    )
+    p.write_text(json.dumps(doc))
+    with pytest.raises(TuneTableError, match="hash mismatch"):
+        load_table(str(p))
+
+
+def test_load_rejects_wrong_kind_and_schema(tmp_path):
+    p = tmp_path / "k.json"
+    doc = _table().to_doc()
+    doc["kind"] = "something_else"
+    p.write_text(json.dumps(doc))
+    with pytest.raises(TuneTableError, match="kind"):
+        load_table(str(p))
+    doc = _table().to_doc()
+    doc["schema"] = 999
+    doc["content_hash"] = None
+    p.write_text(json.dumps(doc))
+    with pytest.raises(TuneTableError, match="schema"):
+        load_table(str(p))
+
+
+def test_check_table_flags_unknown_winner_and_bad_token():
+    t = _table({
+        "flash:sb512:d16:g2:w64:c0:dtf32": {"variant": "nope"},
+        "garbage token": {"variant": "v0"},
+        # applicable winner on a class it does NOT apply to (xla_split
+        # needs softcap):
+        "flash:sb512:d16:g2:w64:c0:dtbf16": {"variant": "xla_split"},
+    })
+    probs = check_table(t)
+    assert len(probs) == 3
+    assert any("not a registered" in s for s in probs)
+    assert any("unparsable" in s for s in probs)
+    assert any("does not apply" in s for s in probs)
+    assert check_table(t, device_kind="other-device")  # +1 mismatch
+
+
+# -------------------------------------------------------------------------
+# use_table fallback posture
+# -------------------------------------------------------------------------
+
+
+def test_use_table_missing_file_warns_and_runs_v0(tmp_path, capsys):
+    assert reg.use_table(str(tmp_path / "absent.json")) is None
+    assert reg.active_table() is None
+    assert "unusable" in capsys.readouterr().err
+    sc = reg.ShapeClass.flash(
+        kv_len=512, head_dim=16, gqa=2, window=64, softcap=None,
+        dtype=jnp.float32,
+    )
+    assert reg.resolve(sc).name == "v0"
+
+
+def test_use_table_device_mismatch_warns_and_runs_v0(tmp_path, capsys):
+    p = tmp_path / "k.json"
+    save_table(_table(device_kind="TPU v9 imaginary"), str(p))
+    assert reg.use_table(str(p)) is None
+    err = capsys.readouterr().err
+    assert "TPU v9 imaginary" in err and "v0 defaults" in err
+    # Warn ONCE per path, even across repeated (per-trace) calls.
+    assert reg.use_table(str(p)) is None
+    assert "v9" not in capsys.readouterr().err
+
+
+def test_use_table_good_artifact_activates_and_caches(tmp_path):
+    sc_tok = "flash:sb512:d16:g2:w64:c0:dtf32"
+    p = tmp_path / "k.json"
+    save_table(_table({sc_tok: {"variant": "wgrid_x1"}}), str(p))
+    t1 = reg.use_table(str(p))
+    assert t1 is not None and reg.active_table() is t1
+    assert reg.use_table(str(p)) is t1  # cached, same object
+    sc = reg.ShapeClass.parse(sc_tok)
+    assert reg.resolve(sc).name == "wgrid_x1"
+    status = reg.kernels_status()
+    assert status["table"] == str(p)
+    assert status["entries"] == {sc_tok: "wgrid_x1"}
+    assert status["content_hash"] == t1.content_hash()
+    assert status["selected"][sc_tok]["wgrid_x1"] == 1
+
+
+# -------------------------------------------------------------------------
+# the deterministic autotune walk
+# -------------------------------------------------------------------------
+
+
+def test_autotune_walk_picks_winners_deterministically():
+    t = autotune(
+        ("lcw", "moe"), preset="smoke",
+        timer=_fake_timer({"wgrid_x2": 0.5, "einsum": 0.25}),
+    )
+    lcw_tok = [k for k in t.entries if k.startswith("flash:")][0]
+    moe_tok = [k for k in t.entries if k.startswith("moe:")][0]
+    assert t.entries[lcw_tok]["variant"] == "wgrid_x2"
+    assert t.entries[moe_tok]["variant"] == "einsum"
+    assert t.entries[lcw_tok]["candidates_ms"]["v0"] == 1000.0
+    assert t.entries[lcw_tok]["ms"] == 500.0
+    assert t.entries[lcw_tok]["leg"] == "lcw"
+    assert t.legs == ("lcw", "moe")
+    # Ties resolve to the EARLIER registration: v0 unless strictly
+    # beaten.
+    t2 = autotune(("lcw",), preset="smoke", timer=_fake_timer({}))
+    for e in t2.entries.values():
+        assert e["variant"] == "v0"
+
+
+def test_autotune_g2_emits_two_per_layer_classes():
+    t = autotune(("g2",), preset="smoke", timer=_fake_timer({}))
+    toks = sorted(t.entries)
+    assert len(toks) == 2
+    assert any(":w64:" in tok for tok in toks)  # windowed layers
+    assert any(":w0:" in tok for tok in toks)   # full-causal layers
+    assert all(":c1:" in tok for tok in toks)   # both softcapped
+
+
+def test_autotune_suspends_active_table_while_timing(tmp_path):
+    # A previously-activated table must not redirect the measured
+    # workloads; it is restored afterwards.
+    marker = _table({"x": {"variant": "v0"}})
+    reg.set_active_table(marker, "mem")
+    seen = []
+
+    def timer(case, variant, make_fn):
+        seen.append(reg.active_table())
+        return 1.0
+
+    autotune(("moe",), preset="smoke", timer=timer)
+    assert seen and all(t is None for t in seen)
+    assert reg.active_table() is marker
+
+
+def test_autotune_unknown_leg_raises():
+    with pytest.raises(ValueError, match="unknown tune leg"):
+        autotune(("nope",), preset="smoke", timer=_fake_timer({}))
+
+
+def test_wall_timer_returns_best_of_n():
+    calls = []
+
+    def make_fn():
+        def run():
+            calls.append(1)
+
+        return run
+
+    t = make_wall_timer(repeats=3, warmup=1)
+    case = tune_cases(("moe",), "smoke")[0]
+    v = reg.get_variant("moe", "v0")
+    dt = t(case, v, make_fn)
+    assert dt >= 0.0 and len(calls) == 4  # 1 warmup + 3 timed
+
+
+def test_check_registry_is_clean():
+    rep = check_registry(("moe", "lcw", "g2"), preset="smoke")
+    assert rep["status"] == "ok" and rep["problems"] == []
+    assert {r["leg"] for r in rep["cases"]} == {"moe", "lcw", "g2"}
+    for row in rep["cases"]:
+        assert row["candidates"][0] == "v0"
+        assert len(row["candidates"]) >= 2
+
+
+# -------------------------------------------------------------------------
+# diffing + CLI
+# -------------------------------------------------------------------------
+
+
+def test_diff_tables_identical_changed_added_removed():
+    a = _table({
+        "flash:sb512:d16:g2:w64:c0:dtf32": {"variant": "v0", "ms": 2.0},
+        "moe:sb128:d32:e4:k2:dtf32": {"variant": "v0", "ms": 1.0},
+    })
+    assert diff_tables(a, a)["status"] == "identical"
+    b = _table({
+        "flash:sb512:d16:g2:w64:c0:dtf32": {
+            "variant": "wgrid_x2", "ms": 1.0,
+        },
+        "moe:sb256:d32:e4:k2:dtf32": {"variant": "einsum", "ms": 0.5},
+    })
+    rep = diff_tables(a, b)
+    assert rep["status"] == "changed"
+    assert rep["changed"][0]["old"] == "v0"
+    assert rep["changed"][0]["new"] == "wgrid_x2"
+    assert rep["added"][0]["shape_class"].startswith("moe:sb256")
+    assert rep["removed"][0]["shape_class"].startswith("moe:sb128")
+    # Timing wobble alone is NOT a change.
+    c = _table({
+        "flash:sb512:d16:g2:w64:c0:dtf32": {"variant": "v0", "ms": 2.2},
+        "moe:sb128:d32:e4:k2:dtf32": {"variant": "v0", "ms": 0.9},
+    })
+    assert diff_tables(a, c)["status"] == "identical"
+
+
+def test_cli_tune_check_is_fast_and_green(capsys):
+    # The tier-1 registry/schema validation path: no timing, rc 0.
+    rc = cli_main(["tune", "--check", "--preset", "smoke"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0 and out["status"] == "ok"
+
+
+def test_cli_tune_check_flags_bad_artifact(tmp_path, capsys):
+    p = tmp_path / "k.json"
+    save_table(_table({"flash:sb512:d16:g2:w64:c0:dtf32": {
+        "variant": "nope",
+    }}), str(p))
+    rc = cli_main([
+        "tune", "--check", "--preset", "smoke", "--table", str(p),
+    ])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1 and out["status"] == "fail"
+    assert any("not a registered" in s for s in out["problems"])
+
+
+def test_cli_obs_check_tune_rcs(tmp_path, capsys):
+    a, b = str(tmp_path / "a.json"), str(tmp_path / "b.json")
+    tok = "flash:sb512:d16:g2:w64:c0:dtf32"
+    save_table(_table({tok: {"variant": "v0", "ms": 2.0}}), a)
+    save_table(_table({tok: {"variant": "wgrid_x2", "ms": 1.0}}), b)
+    assert cli_main([
+        "obs", "check-tune", "--baseline", a, "--current", a,
+    ]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["status"] == "identical"
+    assert cli_main([
+        "obs", "check-tune", "--baseline", a, "--current", b,
+    ]) == 1
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["status"] == "changed" and rep["changed"]
+    assert cli_main([
+        "obs", "check-tune", "--baseline", a,
+        "--current", str(tmp_path / "absent.json"),
+    ]) == 2
+
+
+def test_benchgate_reports_machine_readable_skips_and_floors():
+    from shifu_tpu.obs.benchgate import check_bench
+
+    ok, report = check_bench(
+        {"mfu": 0.6, "rollout_err_rate": 0.0, "moe_mfu": 0.30},
+        {"mfu": 0.6, "rollout_err_rate": 0.0, "moe_mfu": 0.29,
+         "lcw_mfu": 0.51},
+    )
+    assert ok
+    reasons = {s["key"]: s["reason"] for s in report["skipped"]}
+    assert reasons["rollout_err_rate"] == "zero_baseline"
+    assert reasons["lcw_mfu"] == "missing_current"
+    floors = {f["key"]: f for f in report["floors"]}
+    # moe_mfu measured but baseline below floor -> dormant with reason.
+    assert floors["moe_mfu"]["state"] == "dormant"
+    assert floors["moe_mfu"]["reason"] == "baseline_below_floor"
+    assert floors["g2_mfu"]["state"] == "dormant"
+    assert floors["g2_mfu"]["reason"] == "not_measured"
+    assert set(report["dormant_floors"]) == {
+        "moe_mfu", "lcw_mfu", "g2_mfu",
+    }
+    # An armed floor leaves the dormant list and still gates.
+    ok2, rep2 = check_bench(
+        {"moe_mfu": 0.44}, {"moe_mfu": 0.46},
+    )
+    assert not ok2
+    floors2 = {f["key"]: f for f in rep2["floors"]}
+    assert floors2["moe_mfu"]["state"] == "armed"
+    assert "moe_mfu" not in rep2["dormant_floors"]
