@@ -130,6 +130,10 @@ def main(argv=None):
             out["batch_sustained"] = bench_batch_sustained()
         except Exception as e:
             out["batch_sustained"] = {"error": f"{type(e).__name__}: {e}"}
+        try:
+            out["kv_tier"] = bench_kv_tier()
+        except Exception as e:
+            out["kv_tier"] = {"error": f"{type(e).__name__}: {e}"}
     # Runtime self-telemetry in the full ledger: device-memory rollup
     # + how many compiles the bench's engines paid (the obs registry
     # counted them via the engines' tracked programs).
@@ -318,6 +322,13 @@ def _compact(out: dict) -> dict:
          g("train_legs", "gemma2", "tuned_vs_default")),
         ("moe_tune_x_default",
          g("train_legs", "moe", "tuned_vs_default")),
+        # tiered KV cache (round 11): measured restore-vs-recompute
+        # ratio (>1 = restoring spilled pages beats re-prefilling on
+        # this chip) and cache-served share of prompt tokens under the
+        # eviction-pressure multi-turn trace
+        ("kv_restore_x_recompute",
+         g("kv_tier", "kv_restore_x_recompute")),
+        ("kv_hit_rate", g("kv_tier", "kv_hit_rate")),
         ("fit_unstable", any(
             g(*sv, leg, "fit_unstable") for leg in
             ("bf16", "int8", "int8_kv", "int8_kv_b16s")
@@ -942,6 +953,101 @@ def bench_batch_sustained(n_lines=10_000):
     finally:
         srv.shutdown()
         srv.runner.shutdown()
+
+
+def bench_kv_tier():
+    """Tiered KV/prefix cache under an eviction-pressure multi-turn
+    trace (docs/kv_tiering.md).
+
+    Eight simulated chat sessions take turns on a paged engine whose
+    pool holds only ~2 sessions' pages, so every turn's return visit
+    finds its prefix evicted — spilled to the host tier — and the
+    engine must choose restore (device_put the spilled pages) or
+    recompute (re-prefill) using its MEASURED breakeven. Reports the
+    two headline numbers the gate watches:
+
+    - ``kv_restore_x_recompute``: tokens-of-prefill-avoided per ms of
+      transfer over tokens-recomputed per ms of prefill — the measured
+      restore-vs-recompute ratio (>1 = the tier pays on this chip).
+    - ``kv_hit_rate``: prompt tokens served from cache (device hits,
+      restored pages included) over all prompt tokens in the trace.
+    """
+    import numpy as np
+
+    from shifu_tpu.infer import SampleConfig
+    from shifu_tpu.infer.engine import PagedEngine
+    from shifu_tpu.models.transformer import Transformer, TransformerConfig
+
+    rng = np.random.RandomState(7)
+    cfg = TransformerConfig.small()
+    model = Transformer(cfg)
+    params = jax.tree_util.tree_map(
+        lambda x: x.astype(jnp.bfloat16), model.init(jax.random.key(0))
+    )
+    ps, base, grow, turns, sessions = 64, 512, 128, 3, 8
+    max_len = base + turns * grow + ps
+    # Pool sized for ~2 sessions of the 8 → every return visit is an
+    # eviction-pressure case.
+    n_pages = 2 * (max_len // ps) + 1
+    eng = PagedEngine(
+        model, params, max_slots=2, max_len=max_len, page_size=ps,
+        n_pages=n_pages, enable_prefix_cache=True,
+        kv_host_bytes=1 << 30,
+        sample_cfg=SampleConfig(temperature=0.0),
+        prefill_chunk=512,
+    )
+    hist = [
+        rng.randint(1, cfg.vocab_size, size=base).tolist()
+        for _ in range(sessions)
+    ]
+
+    def drain():
+        t0 = time.time()
+        while not eng.idle:
+            eng.step()
+            assert time.time() - t0 < 600, "kv-tier trace stuck"
+
+    t0 = time.time()
+    for turn in range(turns):
+        for s in range(sessions):
+            eng.submit(hist[s], 8)
+            drain()  # one live session at a time: max churn
+            eng.kv_tier_sync()
+            hist[s] = hist[s] + rng.randint(
+                1, cfg.vocab_size, size=grow - 8
+            ).tolist()
+    wall_s = time.time() - t0
+    stats = eng._kv_store.stats()
+    c = eng.counters()
+    out = {
+        "wall_s": round(wall_s, 1),
+        "prompt_tokens": c["prompt_tokens_total"],
+        "prefix_hit_tokens": c["prefix_hits_tokens"],
+        "restored_tokens": stats["restored_tokens"],
+        "restore_ms": stats["restore_ms"],
+        "spilled_pages": stats["spilled_pages"],
+        "tier_hits": stats["hits"],
+        "tier_recomputes": stats["recomputes"],
+        "host_bytes": stats["bytes_used"],
+    }
+    out["kv_hit_rate"] = round(
+        c["prefix_hits_tokens"] / max(1, c["prompt_tokens_total"]), 4
+    )
+    # tokens of prefill avoided per ms of transfer...
+    if stats["restored_tokens"] and stats["restore_ms"]:
+        out["restore_tok_per_ms"] = round(
+            stats["restored_tokens"] / stats["restore_ms"], 2
+        )
+    # ...over tokens recomputed per ms of prefill (the engine's own
+    # breakeven inputs — both measured this run, nothing assumed).
+    rate = eng._prefill_tok_per_ms
+    if rate:
+        out["prefill_tok_per_ms"] = round(rate, 2)
+    if out.get("restore_tok_per_ms") and rate:
+        out["kv_restore_x_recompute"] = round(
+            out["restore_tok_per_ms"] / rate, 3
+        )
+    return out
 
 
 def bench_serving():

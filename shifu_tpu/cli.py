@@ -41,6 +41,22 @@ import json
 import sys
 
 
+def _size_bytes(text: str) -> int:
+    """Parse a byte-size flag value: plain int, or k/m/g/t-suffixed
+    (binary units: "4g" = 4 GiB)."""
+    s = str(text).strip().lower()
+    mult = 1
+    if s and s[-1] in "kmgt":
+        mult = 1 << (10 * ("kmgt".index(s[-1]) + 1))
+        s = s[:-1]
+    try:
+        return int(float(s) * mult)
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            f"not a byte size: {text!r} (want e.g. 1073741824, 512m, 4g)"
+        ) from None
+
+
 def _build_mesh(spec: str):
     """'fsdp=2,tp=2' -> built Mesh (axes validated by MeshPlan)."""
     from shifu_tpu.parallel import MeshPlan
@@ -974,6 +990,19 @@ def build_serve_engine(args, model, params, tok):
         kv_kw["cache_dtype"] = _jnp.int8
         if kv == "int8-b16s":
             kv_kw["kv_scale_dtype"] = _jnp.bfloat16
+    # Host-RAM KV tier (docs/kv_tiering.md): spilled prefix pages live
+    # in host memory under --kv-host-bytes and restore asynchronously
+    # on a later hit when the measured breakeven says they should.
+    if getattr(args, "kv_tier", "off") == "host":
+        if not getattr(args, "prefix_cache", False) or not (
+            args.paged or args.spec != "off"
+        ):
+            raise ValueError(
+                "--kv-tier host needs --prefix-cache and --paged (or "
+                "a --spec engine): the host tier is keyed by "
+                "prefix-chain digests over the paged pool"
+            )
+        kv_kw["kv_host_bytes"] = args.kv_host_bytes
 
     def construct(params_r, mesh=None, draft_params_r=None):
         mkw = dict(kw, mesh=mesh) if mesh is not None else kw
@@ -1724,6 +1753,20 @@ def main(argv=None) -> int:
                              "latency cost; int8-b16s narrows the "
                              "scales to bf16 and recovers most of it "
                              "(decision table: docs/observability.md)")
+        sp.add_argument("--kv-tier", default="off",
+                        choices=["off", "host"],
+                        help="host-RAM tier for the prefix cache: "
+                             "evicted prefix pages spill to pinned "
+                             "host memory and restore asynchronously "
+                             "on a later hit — when the measured "
+                             "restore beats recomputing the prefill "
+                             "(needs --prefix-cache; "
+                             "docs/kv_tiering.md)")
+        sp.add_argument("--kv-host-bytes", type=_size_bytes,
+                        default="4g",
+                        help="host-tier byte budget (LRU beyond it); "
+                             "accepts 512m/4g/… suffixes "
+                             "(--kv-tier host only)")
         sp.add_argument("--mesh",
                         help="serving mesh, e.g. dp=2,tp=2 or "
                              "tp=2,ep=2: tp shards heads/mlp, ep "

@@ -416,6 +416,15 @@ class BackendClient:
             self.model_ids = ids
         return doc
 
+    def cachez(self) -> dict:
+        """GET /cachez — the backend's prefix-cache + host-KV-tier
+        occupancy/hit-rate block (the per-backend scrape prefix-aware
+        sticky routing reads; the router's own ``cache_stats`` renders
+        one block per backend from this)."""
+        return self._call_json(
+            "GET", "/cachez", None, self.cfg.probe_timeout_s
+        )
+
     def reload(self, ckpt: str,
                timeout_s: Optional[float] = None) -> dict:
         """POST /reloadz {"ckpt": ...} — hot-swap this backend's

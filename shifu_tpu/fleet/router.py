@@ -717,6 +717,24 @@ class FleetRouter:
                 vals.append(h["n_adapters"])
         return min(vals) if vals else 0
 
+    def cache_stats(self):
+        """``GET /cachez`` pass-through: one prefix-cache/host-tier
+        block per attached backend (live scrape, probe timeout each) —
+        the per-backend occupancy + hit-rate surface prefix-aware
+        sticky routing scores with (ROADMAP item 2). A backend that
+        cannot answer reports its error in place of a block; detached
+        (draining) backends are skipped — their caches are about to be
+        irrelevant to placement."""
+        out = {}
+        for b in self.backends:
+            if b.detached:
+                continue
+            try:
+                out[b.addr] = b.cachez()
+            except Exception as e:  # noqa: BLE001 — per-backend fault
+                out[b.addr] = {"error": str(e)}
+        return {"backends": out}
+
     def queue_depths(self) -> Dict[str, int]:
         """Per-tier backlog at THIS router: accepted requests whose
         first token has not streamed yet, plus the backends' last-

@@ -25,6 +25,7 @@ import dataclasses
 import itertools
 import threading
 import time
+from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -192,6 +193,10 @@ ENGINE_INTERFACE = frozenset({
     # depths — the server's batch admission cap (429 + Retry-After)
     # reads the batch backlog here.
     "queue_depths",
+    # cache surface (GET /cachez): prefix-cache + host-tier occupancy
+    # and hit rates — the scrape prefix-aware sticky routing reads
+    # (ROADMAP item 2). None for engines without a prefix cache.
+    "cache_stats",
 })
 
 
@@ -1170,6 +1175,13 @@ class Engine:
     def rollout_stats(self):
         """The /statz rollout block, or None when no rollout state
         exists (in-process engines, routers with no rollout yet)."""
+        return None
+
+    def cache_stats(self):
+        """The ``GET /cachez`` block: prefix-cache + host-tier
+        occupancy and hit rates. None for engines without a prefix
+        cache (dense engines; PagedEngine answers for real, the fleet
+        router scrapes per-backend)."""
         return None
 
     def reload_params(self, params) -> None:
@@ -2488,6 +2500,26 @@ class Engine:
         return out
 
 
+@dataclasses.dataclass
+class _RestoreJob:
+    """An in-flight host→device page restore (PagedEngine KV tier).
+
+    The background worker fills ``device_pages`` (one cache-structured
+    tree per chain link, page axis removed) and resolves ``future``;
+    the engine thread adopts finished pages into the pool between
+    steps (``_kv_tier_poll``). ``gen`` pins the flush generation at
+    launch — a weight swap mid-restore makes the job stale and it is
+    dropped unadopted."""
+
+    keys: List[bytes]
+    gen: int
+    tokens: int
+    link_bytes: List[int]
+    future: object = None
+    device_pages: Optional[List] = None
+    ms: float = 0.0
+
+
 class PagedEngine(Engine):
     """Continuous batching over a PAGED KV pool (vLLM-style on TPU).
 
@@ -2539,6 +2571,7 @@ class PagedEngine(Engine):
         enable_prefix_cache: bool = False,
         prefill_chunk: Optional[int] = None,
         kv_scale_dtype=jnp.float32,
+        kv_host_bytes: int = 0,
         **kw,
     ):
         """``prefill_chunk``: when set, prompts longer than this many
@@ -2549,7 +2582,16 @@ class PagedEngine(Engine):
         prompt + max_new <= max_len is admittable, the largest bucket
         only needs to cover one chunk. The prefilling slot's table row
         stays pending (all scratch) until its last chunk lands, so
-        interleaved decode dispatches touch only the scratch page."""
+        interleaved decode dispatches touch only the scratch page.
+
+        ``kv_host_bytes``: when > 0 (requires ``enable_prefix_cache``),
+        prefix pages evicted from the device pool spill to a host-RAM
+        :class:`~shifu_tpu.infer.kvtier.HostKVStore` capped at this
+        many bytes, and a later prefix hit against a spilled page
+        restores it with an async ``device_put`` overlapped with decode
+        — unless the measured restore estimate loses the
+        restore-vs-recompute breakeven, in which case the prompt
+        recomputes as before (docs/kv_tiering.md)."""
         if getattr(model, "prefill_needs_mask", False):
             raise ValueError(
                 "recurrent models carry O(1) state per slot — a paged KV "
@@ -2666,6 +2708,56 @@ class PagedEngine(Engine):
                 donate_argnums=(1,),
             ), "prefill_at")
 
+        # ---- host-RAM KV tier (shifu_tpu/infer/kvtier.py) ------------
+        # Spill-on-eviction / restore-on-hit under a byte budget; all
+        # transfers run on a single background worker so the engine
+        # thread never blocks on PCIe (docs/kv_tiering.md).
+        self.kv_host_bytes = int(kv_host_bytes or 0)
+        self._kv_store = None
+        if self.kv_host_bytes:
+            if not enable_prefix_cache:
+                raise ValueError(
+                    "kv_host_bytes needs enable_prefix_cache: the host "
+                    "tier is keyed by prefix-chain digests"
+                )
+            from shifu_tpu.infer.kvtier import HostKVStore
+
+            self._kv_store = HostKVStore(self.kv_host_bytes)
+            self._kv_worker = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="kvtier"
+            )
+            self._kv_pending: Dict[bytes, "_RestoreJob"] = {}
+            self._kv_spill_futs: List = []
+            self._kv_flush_gen = 0
+            self._kv_wait_flag = False
+            # rids whose lost breakeven was already counted (an
+            # admission can be retried several steps in a row).
+            self._kv_recompute_rids: set = set()
+            # Measured prefill throughput (tokens/ms EMA) — the
+            # recompute side of the restore-vs-recompute breakeven.
+            self._prefill_tok_per_ms: Optional[float] = None
+            # Copy one page out of / into the pool. The gather does NOT
+            # donate (the pool stays live); the scatter donates the pool
+            # so restore writes are in-place like prefill scatters.
+            self._kv_gather_jit = self._track_jit(jax.jit(
+                lambda cache, pg: jax.tree_util.tree_map(
+                    lambda c: jax.lax.dynamic_index_in_dim(
+                        c, pg, axis=1, keepdims=False
+                    ),
+                    cache,
+                ),
+            ), "kv_gather")
+            self._kv_scatter_jit = self._track_jit(jax.jit(
+                lambda cache, page, pg: jax.tree_util.tree_map(
+                    lambda c, d: jax.lax.dynamic_update_index_in_dim(
+                        c, d.astype(c.dtype), pg, axis=1
+                    ),
+                    cache, page,
+                ),
+                donate_argnums=(0,),
+            ), "kv_scatter")
+        self.prompt_tokens_total = 0  # all admitted prompt tokens
+
     # ------------------------------------------------------------- sizing
     @property
     def free_pages(self) -> int:
@@ -2690,10 +2782,47 @@ class PagedEngine(Engine):
             "Free pages in the paged KV pool",
             labelnames=("replica",),
         ).labels(replica=r)
+        # Host-RAM KV tier (zero-valued series when the tier is off —
+        # same convention as the prefix-hit counter). Registry writes
+        # happen only on the engine thread: _obs_step_gauges mirrors
+        # the store's worker-thread counters by delta.
+        self._c_kv = {
+            k: m.counter(
+                f"shifu_kv_tier_{k}_total", desc, labelnames=("replica",)
+            ).labels(replica=r)
+            for k, desc in (
+                ("spills", "Prefix pages spilled to the host KV tier"),
+                ("restores", "Prefix pages restored from the host tier"),
+                ("hits", "Admissions that chose a host-tier restore"),
+                ("recomputes",
+                 "Admissions that found host-tier pages but lost the "
+                 "restore-vs-recompute breakeven"),
+            )
+        }
+        self._g_kv_host_bytes = m.gauge(
+            "shifu_kv_host_bytes",
+            "Bytes of spilled KV pages resident in the host tier",
+            labelnames=("replica",),
+        ).labels(replica=r)
+        self._kv_metric_mark = {
+            "spills": 0, "restores": 0, "hits": 0, "recomputes": 0,
+        }
 
     def _obs_step_gauges(self) -> None:
         super()._obs_step_gauges()
         self._g_free_pages.set(len(self._free_pages))
+        store = getattr(self, "_kv_store", None)
+        if store is not None:
+            s = store.stats()
+            self._g_kv_host_bytes.set(s["bytes_used"])
+            for k, stat in (
+                ("spills", "spilled_pages"), ("restores", "restored_pages"),
+                ("hits", "hits"), ("recomputes", "recomputes"),
+            ):
+                delta = s[stat] - self._kv_metric_mark[k]
+                if delta:
+                    self._c_kv[k].inc(delta)
+                    self._kv_metric_mark[k] = s[stat]
 
     def counters(self) -> dict:
         out = super().counters()
@@ -2702,8 +2831,22 @@ class PagedEngine(Engine):
             free_pages=self.free_pages,
             n_pages=self.n_pages,
             prefix_hits_tokens=self.prefix_hits_tokens,
+            prompt_tokens_total=self.prompt_tokens_total,
             window_pages_reclaimed=self.window_pages_reclaimed,
         )
+        store = getattr(self, "_kv_store", None)
+        if store is not None:
+            s = store.stats()
+            out.update(
+                kv_host_entries=s["entries"],
+                kv_host_bytes=s["bytes_used"],
+                kv_spilled_pages=s["spilled_pages"],
+                kv_restored_pages=s["restored_pages"],
+                kv_restored_tokens=s["restored_tokens"],
+                kv_tier_hits=s["hits"],
+                kv_tier_recomputes=s["recomputes"],
+                kv_tier_evictions=s["evictions"],
+            )
         return out
 
     def submit(
@@ -2768,8 +2911,231 @@ class PagedEngine(Engine):
                 del self._prefix_pages[key]
                 del self._prefix_lru[key]
                 self._page_key.pop(pg, None)
+                self._kv_spill(key, pg)
                 return pg
         return None
+
+    # --------------------------------------------------- host KV tier
+    def _kv_spill(self, key: bytes, pg: int) -> None:
+        """Spill an evicted prefix page to the host tier (no-op when
+        the tier is off or the page is already spilled). The compiled
+        gather runs NOW on the engine thread — device-ordered before
+        any later overwrite of ``pg`` — producing an independent device
+        copy; the background worker then ``device_get``s it and files
+        it without blocking the engine."""
+        store = self._kv_store
+        if store is None or store.contains(key):
+            return
+        dev = self._kv_gather_jit(self.cache, np.int32(pg))
+        gen = store.generation
+        ps = self.page_size
+
+        def work():
+            t0 = time.monotonic()
+            host = jax.tree_util.tree_map(
+                lambda a: np.asarray(jax.device_get(a)), dev
+            )
+            ms = (time.monotonic() - t0) * 1e3
+            nbytes = sum(
+                a.nbytes for a in jax.tree_util.tree_leaves(host)
+            )
+            if store.put(key, host, tokens=ps, generation=gen):
+                store.note_spill(nbytes, ms)
+                self.flight.record(
+                    "kv_spill", replica=self.replica_label, page=pg,
+                    bytes=nbytes, ms=round(ms, 3),
+                    host_bytes=store.bytes_used,
+                )
+
+        self._kv_spill_futs.append(self._kv_worker.submit(work))
+        if len(self._kv_spill_futs) > 64:
+            self._kv_spill_futs = [
+                f for f in self._kv_spill_futs if not f.done()
+            ]
+
+    def _kv_probe(self, req: "_Request", prompt, p: int) -> bool:
+        """Host-tier admission gate, called before the device-chain
+        walk. True = admit now (no host pages involved, or breakeven
+        chose recompute). False = a restore is pending for this
+        prefix — leave the request queued; the transfer overlaps the
+        current decode steps and ``_kv_tier_poll`` adopts the pages
+        into the pool before the next admission attempt."""
+        store = self._kv_store
+        if store is None or not self.enable_prefix_cache:
+            return True
+        ps = self.page_size
+        # Walk the device chain to its break point: the first missing
+        # link's digest is exactly the key a spilled continuation of
+        # this prefix would be filed under.
+        key = self._prefix_salt(req.adapter)
+        hit = 0
+        while hit + ps <= p - 1:
+            nxt = self._chain_key(key, prompt[hit : hit + ps])
+            if nxt not in self._prefix_pages:
+                key = nxt
+                break
+            key = nxt
+            hit += ps
+        else:
+            return True  # whole usable prefix already on device
+        if key in self._kv_pending:
+            self._kv_wait_flag = True
+            return False  # restore already in flight for this prefix
+        # Collect the consecutive chain segment the store holds.
+        links: List[bytes] = []
+        lhit = hit
+        lkey = key
+        while lhit + ps <= p - 1 and store.contains(lkey):
+            links.append(lkey)
+            lhit += ps
+            if lhit + ps <= p - 1:
+                lkey = self._chain_key(lkey, prompt[lhit : lhit + ps])
+        if not links:
+            return True  # plain miss: prefill as before
+        tokens = len(links) * ps
+        nbytes = sum(store.entry_bytes(k) for k in links)
+        if not self._kv_restore_wins(tokens, nbytes):
+            if req.rid not in self._kv_recompute_rids:
+                self._kv_recompute_rids.add(req.rid)
+                store.note_recompute()
+            return True  # measured breakeven says recompute
+        store.note_hit()
+        self._kv_launch_restore(links, tokens, nbytes)
+        self._kv_wait_flag = True
+        return False
+
+    def _kv_restore_wins(self, tokens: int, nbytes: int) -> bool:
+        """MEASURED restore-vs-recompute breakeven: estimated transfer
+        time (store restore-bandwidth EMA) vs estimated prefill time
+        (this engine's tokens/ms EMA). With no samples yet on either
+        side the restore is taken — exploring is what produces the
+        first measurement."""
+        bw = self._kv_store.restore_bytes_per_ms()
+        rate = self._prefill_tok_per_ms
+        if bw is None or rate is None or bw <= 0 or rate <= 0:
+            return True
+        return (nbytes / bw) < (tokens / rate)
+
+    def _kv_launch_restore(
+        self, links: List[bytes], tokens: int, nbytes: int
+    ) -> None:
+        """Start the async host→device transfer for a chain segment.
+        Snapshot the entries NOW (engine thread) so a concurrent
+        budget eviction cannot pull them out from under the worker."""
+        store = self._kv_store
+        entries = [store.get(k) for k in links]
+        job = _RestoreJob(
+            keys=list(links), gen=self._kv_flush_gen, tokens=tokens,
+            link_bytes=[e.nbytes for e in entries],
+        )
+
+        def work():
+            t0 = time.monotonic()
+            pages = [
+                jax.tree_util.tree_map(jax.device_put, e.arrays)
+                for e in entries
+            ]
+            for tree in pages:
+                for a in jax.tree_util.tree_leaves(tree):
+                    a.block_until_ready()
+            job.device_pages = pages
+            job.ms = (time.monotonic() - t0) * 1e3
+
+        job.future = self._kv_worker.submit(work)
+        self._kv_pending[links[0]] = job
+
+    def _kv_tier_poll(self) -> None:
+        """Adopt finished restores into the device pool (engine thread,
+        start of every step). Partially adoptable jobs (pool dry) keep
+        their remaining links pending — a chain prefix is still a valid
+        prefix. Stale jobs (weight swap bumped the flush generation)
+        are dropped unadopted."""
+        if self._kv_store is None or not self._kv_pending:
+            return
+        if not self._active and not self._prefilling:
+            # Nothing to decode while we wait — blocking briefly beats
+            # a hot admission-poll spin in run().
+            for job in list(self._kv_pending.values()):
+                with contextlib.suppress(Exception):
+                    job.future.result(timeout=0.05)
+        for key, job in list(self._kv_pending.items()):
+            if not job.future.done():
+                continue
+            del self._kv_pending[key]
+            if job.gen != self._kv_flush_gen or job.future.exception():
+                continue
+            adopted = 0
+            nbytes = 0
+            t0 = time.monotonic()
+            while job.keys:
+                k = job.keys[0]
+                if k not in self._prefix_pages:
+                    pg = self._alloc_page()
+                    if pg is None:
+                        break  # pool dry: keep the rest pending
+                    self.cache = self._kv_scatter_jit(
+                        self.cache, job.device_pages[0], np.int32(pg)
+                    )
+                    self._prefix_pages[k] = pg
+                    self._page_key[pg] = k
+                    self._prefix_lru.pop(k, None)
+                    self._prefix_lru[k] = None
+                    adopted += 1
+                    nbytes += job.link_bytes[0]
+                job.keys.pop(0)
+                job.device_pages.pop(0)
+                job.link_bytes.pop(0)
+            if adopted:
+                ps = self.page_size
+                self._kv_store.note_restore(
+                    adopted, nbytes, adopted * ps,
+                    job.ms + (time.monotonic() - t0) * 1e3,
+                )
+                self.flight.record(
+                    "kv_restore", replica=self.replica_label,
+                    pages=adopted, tokens=adopted * ps, bytes=nbytes,
+                    transfer_ms=round(job.ms, 3),
+                )
+            if job.keys:  # re-key the remainder under its new head
+                job.ms = 0.0
+                self._kv_pending[job.keys[0]] = job
+
+    def _kv_note_prefill(self, tokens: int, ms: float) -> None:
+        """Fold one measured prefill into the tokens/ms EMA (the
+        recompute side of the breakeven)."""
+        if ms <= 0:
+            return
+        rate = tokens / ms
+        cur = self._prefill_tok_per_ms
+        self._prefill_tok_per_ms = (
+            rate if cur is None else 0.8 * cur + 0.2 * rate
+        )
+
+    def kv_tier_sync(self, timeout: float = 30.0) -> None:
+        """Block until every queued spill/restore transfer has landed
+        (tests and bench determinism; the serving path never calls
+        this). Restores still need a subsequent step to be ADOPTED."""
+        if self._kv_store is None:
+            return
+        for fut in list(self._kv_spill_futs):
+            with contextlib.suppress(Exception):
+                fut.result(timeout=timeout)
+        for job in list(self._kv_pending.values()):
+            with contextlib.suppress(Exception):
+                job.future.result(timeout=timeout)
+
+    def step_dispatch(self):
+        self._kv_wait_flag = False
+        self._kv_tier_poll()
+        return super().step_dispatch()
+
+    def _preempt_batch_slot(self) -> bool:
+        # An admission deferred on an in-flight restore is waiting on
+        # PCIe, not pages — preempting batch slots would not unblock
+        # it, so don't let the admission loop drain the batch tier.
+        if getattr(self, "_kv_wait_flag", False):
+            return False
+        return super()._preempt_batch_slot()
 
     def _alloc_page_preempting(self, slot: int) -> Optional[int]:
         """Allocate a page, preempting the youngest occupied slot
@@ -2876,6 +3242,12 @@ class PagedEngine(Engine):
         # Recompute path: generated-so-far becomes part of the prompt.
         prompt = req.tokens + req.generated
         p = len(prompt)
+        # Host-tier gate: spilled continuation of this prefix → either
+        # an async restore is (now) in flight (stay queued; the pages
+        # arrive via _kv_tier_poll) or the measured breakeven said
+        # recompute (fall through to the normal paths).
+        if not self._kv_probe(req, prompt, p):
+            return False
         # Longest cached page-aligned prefix, capped at p-1 so at least
         # one token remains to prefill (its logits feed the sampler).
         shared: List[int] = []
@@ -2968,6 +3340,7 @@ class PagedEngine(Engine):
             + self._req_bias_args(req)
             + self._req_lora_args(req)
         )
+        t0 = time.monotonic() if self._kv_store is not None else None
         with self._timed_prefill(req):
             if hit:
                 first, lp = self._dispatch_prefill_at(
@@ -2980,6 +3353,15 @@ class PagedEngine(Engine):
                 first, lp = self._dispatch_prefill(
                     slot, padded, p, bucket, sub, samp
                 )
+        if t0 is not None:
+            # Sync so the sample is real compute time, not dispatch
+            # time — the recompute side of the restore breakeven.
+            # _finish_admission int()s `first` right after anyway, so
+            # no extra wait is introduced.
+            jax.block_until_ready(first)
+            self._kv_note_prefill(
+                len(suffix), (time.monotonic() - t0) * 1e3
+            )
         # Keep only the pages that hold real tokens; the bucket's tail
         # pages hold masked garbage and go straight back to the pool.
         keep = -(-len(suffix) // ps)
@@ -3034,20 +3416,60 @@ class PagedEngine(Engine):
                 self._prefix_lru[key] = None
 
     def flush_prefix_cache(self) -> None:
-        """Invalidate every registered prefix page.
+        """Invalidate every registered prefix page — BOTH tiers.
 
         REQUIRED whenever ``engine.params`` is swapped (online RL
         rollouts, adapter hot-reloads): cached pages hold K/V computed
         under the OLD weights, and matching them for a new prompt would
         silently score mixed-parameter rollouts. Pages still pinned by
         active slots stay alive until those slots release; unreferenced
-        residents return to the pool immediately."""
+        residents return to the pool immediately. The host tier is
+        cleared under its generation lock (an in-flight spill stamped
+        pre-flush is refused on landing) and pending restores become
+        stale (dropped unadopted at the next poll)."""
+        # Flush BEFORE _alloc_page can run again so no page spills
+        # between the clear and the generation bump.
+        if self._kv_store is not None:
+            self._kv_flush_gen += 1
+            self._kv_store.clear()  # bumps the store generation too
+            self._kv_pending.clear()
+            self._kv_recompute_rids.clear()
         for key, pg in list(self._prefix_pages.items()):
             self._page_key.pop(pg, None)
             if self._page_rc.get(pg, 0) == 0:
                 self._free_pages.append(pg)
         self._prefix_pages.clear()
         self._prefix_lru.clear()
+
+    def _finish_admission(self, req: _Request, slot, p, first, lp) -> None:
+        self.prompt_tokens_total += p
+        if self._kv_store is not None:
+            self._kv_recompute_rids.discard(req.rid)
+        super()._finish_admission(req, slot, p, first, lp)
+
+    def cache_stats(self):
+        """``GET /cachez``: prefix-cache + host-tier occupancy and hit
+        rates (the per-backend scrape sticky routing reads)."""
+        hit_rate = (
+            self.prefix_hits_tokens / self.prompt_tokens_total
+            if self.prompt_tokens_total
+            else 0.0
+        )
+        out = {
+            "prefix_cache": {
+                "enabled": self.enable_prefix_cache,
+                "n_pages": self.n_pages,
+                "free_pages": self.free_pages,
+                "registered_pages": len(self._prefix_pages),
+                "hit_tokens": self.prefix_hits_tokens,
+                "prompt_tokens": self.prompt_tokens_total,
+                "hit_rate": round(hit_rate, 4),
+            },
+            "host_tier": None,
+        }
+        if self._kv_store is not None:
+            out["host_tier"] = self._kv_store.stats()
+        return out
 
     def _advance_prefills(self) -> None:
         """One chunk per prefilling slot: allocate the chunk's pages
@@ -3091,6 +3513,7 @@ class PagedEngine(Engine):
             # whose bucket rounds past max_len needs the slack-widened
             # row (a distinct compiled program per table width).
             narrow = off // ps + need <= self.pages_per_slot
+            t0 = time.monotonic() if self._kv_store is not None else None
             with self._timed_prefill(req):
                 first, lp = self._dispatch_prefill_at(
                     slot, padded, this_chunk, off, bucket, sub,
@@ -3102,6 +3525,11 @@ class PagedEngine(Engine):
                         + self._req_lora_args(req)
                     ),
                     final_len=len(prompt),
+                )
+            if t0 is not None:
+                jax.block_until_ready(first)
+                self._kv_note_prefill(
+                    this_chunk, (time.monotonic() - t0) * 1e3
                 )
             # Bucket-tail pages hold only masked garbage; return them.
             keep = -(-this_chunk // ps)

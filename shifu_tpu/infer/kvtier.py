@@ -1,0 +1,406 @@
+"""Host-RAM tier of the paged KV/prefix cache (ROADMAP item 3).
+
+The paged engine's prefix cache keeps full prompt pages resident in the
+device pool until allocation pressure evicts them LRU — and an evicted
+prefix is recomputed from scratch on the next hit. At
+millions-of-sessions scale most warm state cannot live on-chip, so this
+module adds the classic next rung of the memory hierarchy:
+
+* :class:`HostKVStore` — a byte-budgeted, thread-safe LRU of spilled
+  pages in host RAM. The engine copies a page out of the pool with a
+  compiled gather *before* reusing it, then a background worker
+  ``device_get``s the copy and files it here keyed by the same sha256
+  chain digest the device prefix table uses. A later probe against the
+  digest restores the page with an async ``device_put`` overlapped with
+  decode — IF the measured restore estimate beats recomputing the
+  prefill (the store keeps transfer-bandwidth EMAs so the breakeven is
+  measured, never assumed).
+
+* :func:`serialize_pages` / :func:`deserialize_pages` — a versioned,
+  checksummed wire format for page payloads (dtype/shape/layer-span
+  header + raw bytes). Stage 2 of the tiering plan ships these frames
+  to peer hosts over the fleet wire (prefill/decode disaggregation,
+  ROADMAP item 1, uses the same format); this PR pins the round-trip
+  and corruption rejection in unit tests.
+
+Engine-side integration (spill hook, restore probe, breakeven policy,
+flush rules) lives in ``PagedEngine`` — see docs/kv_tiering.md.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+import threading
+import zlib
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = [
+    "HostKVStore",
+    "WireFormatError",
+    "serialize_pages",
+    "deserialize_pages",
+]
+
+
+# --------------------------------------------------------------- wire format
+#
+# Frame layout (little-endian):
+#
+#   offset  size  field
+#   ------  ----  -----
+#   0       4     magic  b"SKVP"
+#   4       2     format version (uint16) — currently 1
+#   6       4     header length H (uint32)
+#   10      H     header: UTF-8 JSON (see below)
+#   10+H    N     payload: each leaf's raw C-order bytes, concatenated
+#                 in header["leaves"] order
+#   10+H+N  4     crc32 (uint32) over bytes [0, 10+H+N)
+#
+# Header JSON:
+#   {"page_size": int,          # tokens per page
+#    "layer_span": [lo, hi),    # which model layers the leaves cover
+#    "leaves": [{"name": str, "dtype": str, "shape": [int, ...]}, ...],
+#    "meta": {...}}             # free-form (model id, chain digest hex)
+#
+# dtype strings are numpy names ("bfloat16" resolves via ml_dtypes).
+# The header is authenticated by the same trailing crc32 as the
+# payload, so a flipped bit anywhere in the frame is rejected.
+
+WIRE_MAGIC = b"SKVP"
+WIRE_VERSION = 1
+_HDR = struct.Struct("<4sHI")  # magic, version, header length
+
+
+class WireFormatError(ValueError):
+    """A serialized page frame failed validation (bad magic, unknown
+    version, truncation, or checksum mismatch). Callers treat the frame
+    as a cache MISS — corrupt KV must never be restored."""
+
+
+def _resolve_dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        # bfloat16 et al. are ml_dtypes extension types; numpy only
+        # learns them once the extension dtype object is used directly.
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def serialize_pages(
+    leaves: Dict[str, np.ndarray],
+    *,
+    page_size: int,
+    layer_span: Optional[Tuple[int, int]] = None,
+    meta: Optional[Dict[str, Any]] = None,
+) -> bytes:
+    """Pack named page leaves into one self-describing checksummed
+    frame. ``leaves`` maps cache leaf names ("k", "v", "k_scale", ...)
+    to host arrays; any dtype numpy/ml_dtypes can name round-trips
+    bitwise. ``layer_span`` declares which model layers the leading
+    axis covers — (0, n_layers) for whole-model pages, a sub-span once
+    disaggregation ships per-stage slices."""
+    order = sorted(leaves)
+    arrs = {n: np.ascontiguousarray(leaves[n]) for n in order}
+    if layer_span is None:
+        first = arrs[order[0]]
+        layer_span = (0, int(first.shape[0]) if first.ndim else 0)
+    header = {
+        "page_size": int(page_size),
+        "layer_span": [int(layer_span[0]), int(layer_span[1])],
+        "leaves": [
+            {
+                "name": n,
+                "dtype": arrs[n].dtype.name,
+                "shape": list(arrs[n].shape),
+            }
+            for n in order
+        ],
+        "meta": meta or {},
+    }
+    hdr_json = json.dumps(header, sort_keys=True).encode()
+    parts = [_HDR.pack(WIRE_MAGIC, WIRE_VERSION, len(hdr_json)), hdr_json]
+    parts += [arrs[n].tobytes() for n in order]
+    body = b"".join(parts)
+    return body + struct.pack("<I", zlib.crc32(body) & 0xFFFFFFFF)
+
+
+def deserialize_pages(buf: bytes) -> Tuple[Dict[str, Any], Dict[str, np.ndarray]]:
+    """Unpack a :func:`serialize_pages` frame → (header, leaves).
+
+    Raises :class:`WireFormatError` on bad magic, unknown version,
+    truncation anywhere (header, payload, or checksum), or crc32
+    mismatch. Returned arrays are fresh copies (the frame may be a
+    reused network buffer)."""
+    if len(buf) < _HDR.size + 4:
+        raise WireFormatError(
+            f"truncated frame: {len(buf)} bytes < minimum "
+            f"{_HDR.size + 4}"
+        )
+    magic, version, hdr_len = _HDR.unpack_from(buf, 0)
+    if magic != WIRE_MAGIC:
+        raise WireFormatError(f"bad magic {magic!r} (want {WIRE_MAGIC!r})")
+    if version != WIRE_VERSION:
+        raise WireFormatError(
+            f"unsupported wire version {version} (this build speaks "
+            f"{WIRE_VERSION})"
+        )
+    body_end = len(buf) - 4
+    if _HDR.size + hdr_len > body_end:
+        raise WireFormatError("truncated frame: header extends past payload")
+    (crc_stored,) = struct.unpack_from("<I", buf, body_end)
+    if zlib.crc32(buf[:body_end]) & 0xFFFFFFFF != crc_stored:
+        raise WireFormatError("crc32 mismatch: frame corrupt")
+    try:
+        header = json.loads(buf[_HDR.size : _HDR.size + hdr_len].decode())
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise WireFormatError(f"unreadable header: {e}") from None
+    leaves: Dict[str, np.ndarray] = {}
+    off = _HDR.size + hdr_len
+    for spec in header["leaves"]:
+        dt = _resolve_dtype(spec["dtype"])
+        shape = tuple(spec["shape"])
+        nbytes = int(dt.itemsize * int(np.prod(shape, dtype=np.int64)))
+        if off + nbytes > body_end:
+            raise WireFormatError(
+                f"truncated frame: leaf {spec['name']!r} wants {nbytes} "
+                f"bytes past offset {off}, frame payload ends at "
+                f"{body_end}"
+            )
+        leaves[spec["name"]] = (
+            np.frombuffer(buf, dtype=dt, count=nbytes // dt.itemsize,
+                          offset=off).reshape(shape).copy()
+        )
+        off += nbytes
+    if off != body_end:
+        raise WireFormatError(
+            f"frame has {body_end - off} trailing payload bytes the "
+            "header does not describe"
+        )
+    return header, leaves
+
+
+# ----------------------------------------------------------------- host tier
+def _tree_nbytes(tree) -> int:
+    import jax
+
+    return int(sum(a.nbytes for a in jax.tree_util.tree_leaves(tree)))
+
+
+@dataclass
+class _Entry:
+    """One spilled page: the cache pytree minus the page axis, on host."""
+
+    key: bytes
+    arrays: Any  # pytree of np.ndarray, cache structure minus page axis
+    nbytes: int
+    tokens: int
+
+
+@dataclass
+class _Ema:
+    """Exponential moving average of a rate (bytes/ms or tokens/ms)."""
+
+    alpha: float = 0.2
+    value: Optional[float] = None
+
+    def note(self, sample: float) -> None:
+        self.value = (
+            sample
+            if self.value is None
+            else (1 - self.alpha) * self.value + self.alpha * sample
+        )
+
+
+class HostKVStore:
+    """Byte-budgeted LRU of spilled KV pages in host RAM.
+
+    Thread-safety: the engine thread probes/launches, a single spill
+    worker puts, a single restore worker gets — every public method
+    takes the store lock. ``generation`` makes clear() linearizable
+    against in-flight spills: a put stamped with a pre-flush generation
+    is refused atomically, so a weight swap can never leave stale-weight
+    KV in the tier (docs/kv_tiering.md, flush rules).
+
+    The store also owns the tier's measured-rate state: restore/spill
+    bandwidth EMAs (bytes per ms of transfer) that the engine's
+    restore-vs-recompute breakeven reads, plus the raw counters behind
+    ``shifu_kv_tier_*`` metrics.
+    """
+
+    def __init__(self, capacity_bytes: int):
+        if capacity_bytes <= 0:
+            raise ValueError(
+                f"host tier needs a positive byte budget, got "
+                f"{capacity_bytes}"
+            )
+        self.capacity_bytes = int(capacity_bytes)
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[bytes, _Entry]" = OrderedDict()
+        self._bytes = 0
+        self.generation = 0
+        # -- counters (read under lock via stats()/snapshot) ----------
+        self.spilled_pages = 0
+        self.spilled_bytes = 0
+        self.restored_pages = 0
+        self.restored_bytes = 0
+        self.restored_tokens = 0
+        self.hits = 0  # admissions that found entries AND chose restore
+        self.recomputes = 0  # admissions that found entries, recomputed
+        self.evictions = 0  # budget-pressure LRU drops
+        self.rejects = 0  # puts refused (oversized or stale generation)
+        self.spill_ms = 0.0
+        self.restore_ms = 0.0
+        self._restore_bw = _Ema()
+        self._spill_bw = _Ema()
+
+    # ------------------------------------------------------------ data
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    @property
+    def bytes_used(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def contains(self, key: bytes) -> bool:
+        with self._lock:
+            return key in self._entries
+
+    __contains__ = contains
+
+    def entry_bytes(self, key: bytes) -> int:
+        with self._lock:
+            e = self._entries.get(key)
+            return e.nbytes if e is not None else 0
+
+    def get(self, key: bytes, *, bump: bool = True) -> Optional[_Entry]:
+        with self._lock:
+            e = self._entries.get(key)
+            if e is not None and bump:
+                self._entries.move_to_end(key)
+            return e
+
+    def put(
+        self, key: bytes, arrays, *, tokens: int,
+        generation: Optional[int] = None,
+    ) -> bool:
+        """File a spilled page. False = refused (stale generation after
+        a flush raced the spill, or the entry alone exceeds the
+        budget). Evicts LRU entries until the budget holds."""
+        nbytes = _tree_nbytes(arrays)
+        with self._lock:
+            if generation is not None and generation != self.generation:
+                self.rejects += 1
+                return False
+            if nbytes > self.capacity_bytes:
+                self.rejects += 1
+                return False
+            if key in self._entries:
+                return True  # already spilled (idempotent)
+            while self._bytes + nbytes > self.capacity_bytes:
+                _, old = self._entries.popitem(last=False)
+                self._bytes -= old.nbytes
+                self.evictions += 1
+            self._entries[key] = _Entry(key, arrays, nbytes, int(tokens))
+            self._bytes += nbytes
+            self.spilled_pages += 1
+            self.spilled_bytes += nbytes
+            return True
+
+    def pop(self, key: bytes) -> None:
+        with self._lock:
+            e = self._entries.pop(key, None)
+            if e is not None:
+                self._bytes -= e.nbytes
+
+    def clear(self) -> None:
+        """Drop everything and bump the generation — in-flight spills
+        stamped with the old generation land as rejected puts."""
+        with self._lock:
+            self._entries.clear()
+            self._bytes = 0
+            self.generation += 1
+
+    def chain(self, keys: List[bytes]) -> List[bytes]:
+        """The longest prefix of ``keys`` fully present in the store —
+        a restorable chain segment (a chain missing its head cannot be
+        matched by the device prefix walk)."""
+        out: List[bytes] = []
+        with self._lock:
+            for k in keys:
+                if k not in self._entries:
+                    break
+                out.append(k)
+        return out
+
+    # ----------------------------------------------------- measurement
+    def note_spill(self, nbytes: int, ms: float) -> None:
+        with self._lock:
+            self.spill_ms += ms
+            if ms > 0:
+                self._spill_bw.note(nbytes / ms)
+
+    def note_restore(
+        self, pages: int, nbytes: int, tokens: int, ms: float
+    ) -> None:
+        with self._lock:
+            self.restored_pages += pages
+            self.restored_bytes += nbytes
+            self.restored_tokens += tokens
+            self.restore_ms += ms
+            if ms > 0:
+                self._restore_bw.note(nbytes / ms)
+
+    def note_hit(self) -> None:
+        with self._lock:
+            self.hits += 1
+
+    def note_recompute(self) -> None:
+        with self._lock:
+            self.recomputes += 1
+
+    def restore_bytes_per_ms(self) -> Optional[float]:
+        """Measured restore bandwidth EMA; None until the first restore
+        lands (the breakeven policy treats no-data as 'explore': take
+        the restore, which produces the first sample)."""
+        with self._lock:
+            return self._restore_bw.value
+
+    def stats(self) -> Dict[str, Any]:
+        """Snapshot for counters()/cache_stats()/ /cachez — plain
+        numbers only so replica/fleet aggregation can sum them."""
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "bytes_used": self._bytes,
+                "capacity_bytes": self.capacity_bytes,
+                "spilled_pages": self.spilled_pages,
+                "spilled_bytes": self.spilled_bytes,
+                "restored_pages": self.restored_pages,
+                "restored_bytes": self.restored_bytes,
+                "restored_tokens": self.restored_tokens,
+                "hits": self.hits,
+                "recomputes": self.recomputes,
+                "evictions": self.evictions,
+                "rejects": self.rejects,
+                "spill_ms": round(self.spill_ms, 3),
+                "restore_ms": round(self.restore_ms, 3),
+                "restore_bytes_per_ms": (
+                    round(self._restore_bw.value, 1)
+                    if self._restore_bw.value is not None
+                    else None
+                ),
+                "spill_bytes_per_ms": (
+                    round(self._spill_bw.value, 1)
+                    if self._spill_bw.value is not None
+                    else None
+                ),
+            }

@@ -235,6 +235,46 @@ class ReplicatedEngine:
     def rollout_stats(self):
         return None
 
+    def cache_stats(self):
+        """Pooled /cachez block: numeric prefix-cache and host-tier
+        fields summed over replicas (hit rates re-derived from the
+        pooled sums), plus the per-replica breakdown. None when no
+        replica has a cache surface (dense engines)."""
+        per = [e.cache_stats() for e in self.engines]
+        if not any(per):
+            return None
+
+        def pool(blocks):
+            out: dict = {}
+            for b in blocks:
+                for k, v in b.items():
+                    if isinstance(v, bool):
+                        out.setdefault(k, v)
+                    elif isinstance(v, (int, float)):
+                        out[k] = out.get(k, 0) + v
+            return out
+
+        pc = pool([s["prefix_cache"] for s in per if s])
+        if pc.get("prompt_tokens"):
+            pc["hit_rate"] = round(
+                pc.get("hit_tokens", 0) / pc["prompt_tokens"], 4
+            )
+        tiers = [s["host_tier"] for s in per if s and s["host_tier"]]
+        host = pool(tiers) if tiers else None
+        if host:
+            # EMAs don't sum; keep the pooled block to additive fields.
+            host.pop("restore_bytes_per_ms", None)
+            host.pop("spill_bytes_per_ms", None)
+        return {
+            "prefix_cache": pc or None,
+            "host_tier": host,
+            "replicas": [
+                {"replica": i, **(s or {"prefix_cache": None,
+                                        "host_tier": None})}
+                for i, s in enumerate(per)
+            ],
+        }
+
     def queue_depths(self) -> Dict[str, int]:
         """Per-tier queued totals summed over replicas (the batch
         admission cap's backlog surface — ENGINE_INTERFACE)."""

@@ -1378,6 +1378,13 @@ class _Handler(BaseHTTPRequestHandler):
             roll = eng.rollout_stats()
             if roll is not None:
                 out["rollout"] = roll
+            # Cache block (ENGINE_INTERFACE "cache_stats"): prefix
+            # cache + host KV tier occupancy/hit rates — the same
+            # payload GET /cachez serves standalone. None (dense
+            # engine, no prefix cache) omits the block.
+            cache = eng.cache_stats()
+            if cache is not None:
+                out["cache"] = cache
             # Batch block: the server-hosted /v1/batches job table
             # (None before any job — the block only appears once the
             # offline tier has been used).
@@ -1394,6 +1401,17 @@ class _Handler(BaseHTTPRequestHandler):
 
             out["kernels"] = _kreg.kernels_status()
             self._send(200, out)
+        elif self.path == "/cachez":
+            # Prefix-cache + host-KV-tier occupancy and hit rates
+            # (ENGINE_INTERFACE "cache_stats") — the per-backend scrape
+            # prefix-aware sticky fleet routing reads (ROADMAP item 2).
+            # A fleet router answers with one block per backend; dense
+            # engines (no cache surface) answer with explicit nulls so
+            # scrapers need no status special-casing.
+            cache = self.runner.engine.cache_stats()
+            if cache is None:
+                cache = {"prefix_cache": None, "host_tier": None}
+            self._send(200, cache)
         elif self.path == "/v1/models":
             eng = self.runner.engine
             served = eng.served_models()

@@ -122,6 +122,16 @@ METRIC_SPECS: Dict[str, Tuple[str, float]] = {
     "lcw_tune_x_default": (HIGHER, 0.10),
     "g2_tune_x_default": (HIGHER, 0.10),
     "moe_tune_x_default": (HIGHER, 0.10),
+    # tiered KV cache (round 11): measured restore-vs-recompute ratio
+    # (restored tokens per ms of transfer over prefilled tokens per ms
+    # of compute — >= 1 means restoring spilled pages beats paying the
+    # prefill again on this chip) and the cache-served share of prompt
+    # tokens under bench_kv_tier's eviction-pressure multi-turn trace.
+    # Armable — dormant until a TPU baseline round records the leg
+    # (missing keys are skipped with a machine-readable reason, like
+    # the *_tune_x_default rows).
+    "kv_restore_x_recompute": (HIGHER, 0.20),
+    "kv_hit_rate": (HIGHER, 0.15),
 }
 
 # Absolute floors for landed improve-direction wins (round 6): relative
@@ -139,6 +149,11 @@ METRIC_FLOORS: Dict[str, float] = {
     # at 0.51 on the refused-to-XLA route; half the stack is full
     # attention at s=4096, so the dense-leg ~0.63 is the ceiling).
     "g2_mfu": 0.55,
+    # Tiered KV cache (ISSUE 11): the tier only earns its keep while
+    # restore actually beats recompute — arms the first time a TPU
+    # baseline records the ratio at or above 1.0, then never lets it
+    # sink below breakeven unnoticed.
+    "kv_restore_x_recompute": 1.0,
 }
 
 # current-key -> acceptable baseline keys (oldest last): lets a renamed

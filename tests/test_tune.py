@@ -340,7 +340,7 @@ def test_benchgate_reports_machine_readable_skips_and_floors():
     assert floors["g2_mfu"]["state"] == "dormant"
     assert floors["g2_mfu"]["reason"] == "not_measured"
     assert set(report["dormant_floors"]) == {
-        "moe_mfu", "lcw_mfu", "g2_mfu",
+        "moe_mfu", "lcw_mfu", "g2_mfu", "kv_restore_x_recompute",
     }
     # An armed floor leaves the dormant list and still gates.
     ok2, rep2 = check_bench(
