@@ -68,14 +68,14 @@ class _HTTPClient:
         self.port = u.port or 80
         self.timeout_s = float(timeout_s)
 
-    def post(self, path: str, body: dict):
+    def post(self, path: str, body: dict, headers: Optional[dict] = None):
         conn = http.client.HTTPConnection(
             self.host, self.port, timeout=self.timeout_s
         )
         try:
             conn.request(
                 "POST", path, json.dumps(body).encode(),
-                {"Content-Type": "application/json"},
+                {"Content-Type": "application/json", **(headers or {})},
             )
             resp = conn.getresponse()
             ra = resp.getheader("Retry-After")
@@ -201,12 +201,21 @@ class BatchRunner:
         body = dict(body)
         body["tier"] = self.tier
         body.pop("stream", None)
+        # One distributed-trace context per input LINE, held across
+        # batch-layer retries — every attempt of this line shares a
+        # trace_id, so `shifu_tpu trace export` reconstructs the line's
+        # whole history including 429 waits and resubmits downstream.
+        from shifu_tpu.obs import disttrace as _dtrace
+
+        trace_hdr = {_dtrace.HEADER: _dtrace.mint().to_header()}
         attempt = 0
         while True:
             if self.stop.is_set():
                 return  # not journaled: the resume re-runs it
             try:
-                status, retry_after, doc = self.client.post(url, body)
+                status, retry_after, doc = self.client.post(
+                    url, body, headers=trace_hdr
+                )
             except OSError as e:
                 status, retry_after, doc = None, None, {"error": repr(e)}
             if status == 200:

@@ -38,6 +38,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 
@@ -1348,24 +1349,48 @@ def cmd_fleet(args) -> int:
 
 
 def cmd_trace(args) -> int:
-    """``shifu_tpu trace export``: turn a serving trace log (the JSONL
-    the server appends under ``serve --trace-log``) into Chrome
-    trace-event JSON — one track per request, non-overlapping
-    queue -> prefill -> decode spans, loadable in chrome://tracing or
-    Perfetto. The host-side complement to the device-side
+    """``shifu_tpu trace export``: Chrome trace-event JSON from either
+    source — a local ``serve --trace-log`` JSONL (``--in``), or a LIVE
+    router/server's ``GET /tracez`` (``--url`` + ``--trace-id``), which
+    merges every host's span log for one distributed trace into a
+    single timeline with a process lane per (host, replica) and the
+    probe-estimated clock offsets applied. Loadable in chrome://tracing
+    or Perfetto; the host-side complement to the device-side
     ``jax.profiler`` traces (docs/observability.md)."""
-    from shifu_tpu.obs.trace import export_trace_log
+    if args.url:
+        if not args.trace_id:
+            print("--url requires --trace-id", file=sys.stderr)
+            return 2
+        import urllib.error
 
-    try:
-        trace = export_trace_log(args.infile, args.out)
-    except OSError as e:
-        print(str(e), file=sys.stderr)
+        from shifu_tpu.obs.disttrace import fetch_and_merge
+
+        try:
+            trace = fetch_and_merge(args.url, args.trace_id)
+        except (OSError, ValueError, urllib.error.URLError) as e:
+            print(str(e), file=sys.stderr)
+            return 2
+        if args.out:
+            with open(args.out, "w", encoding="utf-8") as f:
+                json.dump(trace, f)
+    elif args.infile:
+        from shifu_tpu.obs.trace import export_trace_log
+
+        try:
+            trace = export_trace_log(args.infile, args.out)
+        except OSError as e:
+            print(str(e), file=sys.stderr)
+            return 2
+    else:
+        print("trace export needs --in PATH or --url URL --trace-id ID",
+              file=sys.stderr)
         return 2
     if args.out:
+        events = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
         print(json.dumps({
             "out": args.out,
-            "events": len(trace["traceEvents"]),
-            "requests": len({e["tid"] for e in trace["traceEvents"]}),
+            "events": len(events),
+            "requests": len({(e["pid"], e["tid"]) for e in events}),
         }))
     else:
         print(json.dumps(trace))
@@ -1492,7 +1517,32 @@ def cmd_obs(args) -> int:
     ``shifu_tpu obs check-tune``: diff two tune-table artifacts
     (--baseline old, --current new). Exit 0 = winners identical, 1 =
     winners changed / classes added or removed (reviewable fact), 2 =
-    unusable artifacts."""
+    unusable artifacts.
+
+    ``shifu_tpu obs check-docs``: drift gate between the registered
+    ``shifu_*`` metric families (source scan of the package) and
+    docs/observability.md — exit 1 when telemetry shipped undocumented
+    or the doc names families no code registers."""
+    if args.action == "check-docs":
+        import shifu_tpu
+        from shifu_tpu.obs.docscheck import check_docs
+
+        pkg = os.path.dirname(os.path.abspath(shifu_tpu.__file__))
+        doc = args.doc
+        if doc is None:
+            doc = os.path.join(os.path.dirname(pkg),
+                               "docs", "observability.md")
+        try:
+            ok, report = check_docs(pkg, doc)
+        except OSError as e:
+            print(f"cannot scan: {e}", file=sys.stderr)
+            return 2
+        print(json.dumps(report, indent=2))
+        return 0 if ok else 1
+    if args.baseline is None or args.current is None:
+        print(f"{args.action} requires --baseline and --current",
+              file=sys.stderr)
+        return 2
     if args.action == "check-tune":
         from shifu_tpu.obs.benchgate import check_tune
 
@@ -1952,11 +2002,21 @@ def main(argv=None) -> int:
     tr = sub.add_parser(
         "trace",
         help="serving request traces: export a serve --trace-log JSONL "
-             "as Chrome trace-event JSON (chrome://tracing / Perfetto)",
+             "— or one distributed trace from a live router's /tracez "
+             "(--url + --trace-id) — as Chrome trace-event JSON "
+             "(chrome://tracing / Perfetto)",
     )
     tr.add_argument("action", choices=["export"])
-    tr.add_argument("--in", dest="infile", required=True,
+    tr.add_argument("--in", dest="infile",
                     help="trace-log JSONL path (serve --trace-log)")
+    tr.add_argument("--url",
+                    help="router/server base URL — fetch GET /tracez "
+                         "and merge every host's spans for --trace-id "
+                         "into one timeline (clock offsets applied)")
+    tr.add_argument("--trace-id",
+                    help="the distributed trace id (from the "
+                         "x-shifu-trace response header or a "
+                         "completion's timing block)")
     tr.add_argument("--out",
                     help="write the Chrome trace JSON here "
                          "(default: print to stdout)")
@@ -2014,14 +2074,24 @@ def main(argv=None) -> int:
         help="observability tooling: check-bench gates a compact bench "
              "line against a recorded baseline within declared "
              "tolerances (exit 1 on regression); check-tune diffs two "
-             "tune-table artifacts (exit 1 when winners changed)",
+             "tune-table artifacts (exit 1 when winners changed); "
+             "check-docs gates registered shifu_* metric families "
+             "against docs/observability.md (exit 1 on drift)",
     )
-    ob.add_argument("action", choices=["check-bench", "check-tune"])
-    ob.add_argument("--baseline", required=True,
+    ob.add_argument("action",
+                    choices=["check-bench", "check-tune", "check-docs"])
+    ob.add_argument("--baseline",
                     help="baseline record (BENCH_rNN.json driver shape "
-                         "or a raw compact line)")
-    ob.add_argument("--current", required=True,
-                    help="current record to gate (same shapes accepted)")
+                         "or a raw compact line); required for "
+                         "check-bench/check-tune")
+    ob.add_argument("--current",
+                    help="current record to gate (same shapes "
+                         "accepted); required for check-bench/"
+                         "check-tune")
+    ob.add_argument("--doc",
+                    help="check-docs: the observability doc to gate "
+                         "against (default: docs/observability.md "
+                         "next to the package)")
     ob.add_argument("--scale-tolerance", type=float, default=1.0,
                     help="multiply every declared tolerance (loosen "
                          "the whole gate without editing specs)")

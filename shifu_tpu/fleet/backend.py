@@ -462,19 +462,34 @@ class BackendClient:
         finally:
             conn.close()
 
-    def open_stream(self, body: dict) -> _SSEStream:
+    def tracez(self, trace_id: str) -> dict:
+        """GET /tracez?trace_id=... — the backend's span-store slice
+        for one distributed trace (host documents with paired
+        monotonic/wall stamps; ``obs.disttrace.merge_host_docs`` aligns
+        them onto the collector's clock)."""
+        from urllib.parse import quote
+
+        return self._call_json(
+            "GET", f"/tracez?trace_id={quote(str(trace_id))}", None,
+            self.cfg.probe_timeout_s,
+        )
+
+    def open_stream(self, body: dict,
+                    headers: Optional[dict] = None) -> _SSEStream:
         """POST /v1/completions with ``stream: true``; returns the SSE
-        event iterator. The HTTP status is resolved HERE (connect +
-        submit under ``connect_timeout_s``); event reads then run under
-        ``read_timeout_s`` per read (a slow decode is budgeted
-        separately from a dead host)."""
+        event iterator. ``headers`` extends the defaults (the router
+        forwards ``x-shifu-trace`` here so the backend's spans join the
+        request's distributed trace). The HTTP status is resolved HERE
+        (connect + submit under ``connect_timeout_s``); event reads
+        then run under ``read_timeout_s`` per read (a slow decode is
+        budgeted separately from a dead host)."""
         conn = http.client.HTTPConnection(
             self.host, self.port, timeout=self.cfg.connect_timeout_s
         )
         try:
             conn.request(
                 "POST", "/v1/completions", json.dumps(body).encode(),
-                {"Content-Type": "application/json"},
+                {"Content-Type": "application/json", **(headers or {})},
             )
             # Capture the socket NOW: the SSE response carries
             # ``Connection: close``, so getresponse() detaches

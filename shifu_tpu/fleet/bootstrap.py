@@ -214,6 +214,15 @@ def build_fleet(
     router = FleetRouter(
         backends, metrics=metrics, flight=flight, **router_kw
     )
+    # One probe pass THROUGH the router before the prober's first
+    # interval: wait_ready probed via the raw clients, so the router's
+    # clock-offset estimator (trace alignment) and probe-latency
+    # histogram would otherwise stay empty until probe_interval_s in.
+    for b in backends:
+        try:
+            router.probe_backend(b)
+        except BackendError:
+            pass  # the prober keeps retrying dead hosts
     prober = FleetProber(router, interval_s=probe_interval_s)
     router.prober = prober
     if start_prober:

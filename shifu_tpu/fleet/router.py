@@ -45,9 +45,14 @@ is down.
 from __future__ import annotations
 
 import collections
+import math
+import os
+import socket
 import threading
 import time
 from typing import Dict, List, Optional
+
+from shifu_tpu.obs import disttrace as _dtrace
 
 from shifu_tpu.fleet.backend import (
     BackendClient,
@@ -76,11 +81,12 @@ class _FleetRequest:
     worker currently holds (closed to cancel remotely)."""
 
     def __init__(self, rid: int, body: dict, model: Optional[str] = None,
-                 tier: str = "interactive"):
+                 tier: str = "interactive", trace=None):
         self.rid = rid
         self.body = body
         self.model = model             # route only to backends serving it
         self.tier = tier               # admission tier (batch backfill)
+        self.trace = trace             # TraceContext for this hop, if any
         self.generated: List[int] = []
         self.logprobs: List[float] = []
         self.streamed = False          # first delta arrived
@@ -142,6 +148,18 @@ class FleetRouter:
         self.tokens_generated = 0
         self.cancellations = 0
         self.batch_completed = 0  # batch-tier completions (SLO-exempt)
+
+        # Distributed tracing (obs/disttrace.py): the router is a hop —
+        # it records router_hop/resubmit spans in its own store, keyed
+        # by a host label naming this process, and assembles fleet-wide
+        # traces by pulling each backend's /tracez slice through the
+        # per-backend clock offsets the prober measures.
+        self.host_label = f"{socket.gethostname()}:{os.getpid()}"
+        self.replica_label = "router"
+        self._span_store = _dtrace.SpanStore()
+        self._clock = _dtrace.ClockSync()
+        self._fed_lock = threading.Lock()
+        self._fed_pooled: Dict[tuple, float] = {}
 
         # ENGINE_INTERFACE identity/config surface. The router has no
         # local model — beam/embeddings need device access and 400
@@ -262,10 +280,22 @@ class FleetRouter:
     def probe_backend(self, b: BackendClient) -> dict:
         """One timed /healthz probe (the bootstrap prober's unit of
         work) — records the scrape-latency histogram alongside the
-        breaker bookkeeping ``b.probe()`` already does."""
+        breaker bookkeeping ``b.probe()`` already does, and feeds the
+        NTP-style clock-offset estimator: the probe's send/receive wall
+        stamps bracket the backend's ``wall_ms`` reading, giving one
+        offset sample with error bound rtt/2 (min-RTT sample wins)."""
         t0 = time.monotonic()
+        w0 = time.time() * 1000.0
         try:
-            return b.probe()
+            doc = b.probe()
+            w1 = time.time() * 1000.0
+            wall = doc.get("wall_ms") if isinstance(doc, dict) else None
+            if wall is not None:
+                try:
+                    self._clock.note(b.addr, w0, w1, float(wall))
+                except (TypeError, ValueError):
+                    pass
+            return doc
         finally:
             self._h_probe.labels(backend=b.addr).observe(
                 time.monotonic() - t0
@@ -300,7 +330,8 @@ class FleetRouter:
                stop_token_ids=None, stop_strings=None,
                logit_bias=None, allowed_token_ids=None, adapter=None,
                regex=None, json_schema=None, model=None,
-               tier: str = "interactive", **kw) -> int:
+               tier: str = "interactive",
+               trace: Optional[dict] = None, **kw) -> int:
         """Route one request (engine-thread call — no HTTP here).
         Raises :class:`FleetUnavailable` when no backend is routable,
         so a fully-down fleet fails fast instead of queueing forever.
@@ -312,7 +343,13 @@ class FleetRouter:
         tier and a typo'd id must not queue forever). None routes
         fleet-wide, and when no backend has reported its models yet the
         name is ignored rather than 404ing the whole fleet on a stale
-        roster."""
+        roster.
+
+        ``trace``: distributed-trace context for this hop (dict with
+        trace_id/span_id/[parent_id], usually the serving front-end's
+        parsed ``x-shifu-trace`` header). None mints a fresh root — a
+        routed request ALWAYS has a trace, so the fleet test can pull
+        its merged timeline without opting in."""
         if kw:
             raise ValueError(f"unsupported submit fields: {sorted(kw)}")
         if model is not None:
@@ -370,10 +407,19 @@ class FleetRouter:
                 + (f" for model {model!r}" if model is not None else ""),
                 retry_after_s=max(1.0, self.policy.cap_s),
             )
+        if trace:
+            ctx = _dtrace.TraceContext(
+                str(trace.get("trace_id", "")) or _dtrace.mint().trace_id,
+                str(trace.get("span_id", "")) or _dtrace.mint().span_id,
+                str(trace.get("parent_id", "") or ""),
+            )
+        else:
+            ctx = _dtrace.mint()
         with self._lock:
             rid = self._rid
             self._rid += 1
-            req = _FleetRequest(rid, body, model=model, tier=tier)
+            req = _FleetRequest(rid, body, model=model, tier=tier,
+                                trace=ctx)
             self._reqs[rid] = req
         threading.Thread(
             target=self._route_one, args=(req,),
@@ -410,6 +456,7 @@ class FleetRouter:
             if req.cancelled:
                 self._finish(req, None, None)
                 return
+            att0 = time.monotonic()
             b = self._pick(model=req.model)
             if b is None:
                 self._finish(req, None, FleetUnavailable(
@@ -445,6 +492,16 @@ class FleetRouter:
             self._c_retries.labels(backend=b.addr).inc()
             with self._lock:
                 self.resubmissions += 1
+            if req.trace is not None:
+                # The resubmit keeps its trace_id — the merged timeline
+                # shows the failed attempt as a span, then the retried
+                # hop, under ONE request.
+                now = time.monotonic()
+                self._span_store.add(req.trace.trace_id, _dtrace.span_record(
+                    "resubmit", req.trace, att0 * 1000.0,
+                    (now - att0) * 1000.0, rid=req.rid, backend=b.addr,
+                    error=str(err), attempt=attempt,
+                ))
             self._sleep(self.policy.delay(attempt))
             attempt += 1
 
@@ -454,7 +511,11 @@ class FleetRouter:
         deliberate cancel), else the failure. Breaker bookkeeping
         happens here — success closes, failure counts toward a trip."""
         try:
-            stream = b.open_stream(req.body)
+            headers = (
+                {_dtrace.HEADER: req.trace.child().to_header()}
+                if req.trace is not None else None
+            )
+            stream = b.open_stream(req.body, headers=headers)
         except BackendError as e:
             if e.retryable:
                 b.breaker.record_failure()
@@ -534,6 +595,17 @@ class FleetRouter:
             if n > 1 else None,
             "preemptions": 0,
         }
+        if req.trace is not None:
+            timing.update(req.trace.to_dict())
+            timing["replica"] = self.replica_label
+            self._span_store.add(
+                req.trace.trace_id,
+                _dtrace.span_record(
+                    "router_hop", req.trace,
+                    req.submitted * 1000.0, total_ms,
+                    rid=req.rid, backend=b.addr, n_tokens=n,
+                ),
+            )
         b.note_latency(total_ms)
         self._h_request.labels(backend=b.addr).observe(total_ms / 1000.0)
         trace = {
@@ -819,6 +891,74 @@ class FleetRouter:
         if slow:
             out["req_itl_ms_p99"] = round(1000.0 / slow, 3)
         return out
+
+    # ----------------------------------------------- distributed traces
+    def trace_spans(self, trace_id) -> List[dict]:
+        """The fleet's /tracez collector: the router's own span-store
+        slice plus every attached backend's, each backend doc stamped
+        with the prober's clock offset (= backend_wall - router_wall)
+        so ``merge_host_docs`` lands all spans on THIS process's wall
+        clock. A backend that cannot answer is skipped — a partial
+        trace beats none while a host is down."""
+        docs = [_dtrace.host_doc(
+            self.host_label, self._span_store.get(trace_id),
+            replica=self.replica_label,
+        )]
+        for b in self.backends:
+            if b.detached:
+                continue
+            try:
+                remote = b.tracez(trace_id)
+            except Exception:  # noqa: BLE001 — per-backend fault
+                continue
+            off, err = self._clock.offset(b.addr)
+            if not math.isfinite(err):
+                off, err = 0.0, 0.0  # never probed: assume shared clock
+            for h in remote.get("hosts", ()):
+                if not isinstance(h, dict):
+                    continue
+                h = dict(h)
+                h["offset_ms"] = float(h.get("offset_ms", 0.0)) + off
+                h["err_ms"] = float(h.get("err_ms", 0.0)) + err
+                docs.append(h)
+        return docs
+
+    # ------------------------------------------------------- federation
+    def federated_metrics(self) -> str:
+        """Scrape every attached backend's /metrics, re-emit each
+        ``shifu_*`` sample under ``shifu_fleet_agg_*`` — pooled (summed
+        across backends; histogram buckets are cumulative so the
+        per-``le`` sum is exact) and per-backend (``backend`` label).
+        The server appends this text to the router's own /metrics, so
+        one scrape of the router shows the whole fleet. Unreachable
+        backends are skipped (federation must not take /metrics down
+        with a host)."""
+        from shifu_tpu.obs.registry import parse_exposition
+
+        parsed: Dict[str, Dict[tuple, float]] = {}
+        for b in self.backends:
+            if b.detached:
+                continue
+            try:
+                parsed[b.addr] = parse_exposition(b.metrics_text())
+            except Exception:  # noqa: BLE001 — per-backend fault
+                continue
+        text, pooled = _dtrace.federate(parsed)
+        with self._fed_lock:
+            self._fed_pooled = pooled
+        return text
+
+    def federated_quantile(self, family: str, q: float,
+                           labels=None) -> Optional[float]:
+        """Estimated quantile over the POOLED federated histogram from
+        the last ``federated_metrics`` scrape (the SLO watchdog's
+        fleet-wide budget view). None before any scrape or when the
+        family has no pooled buckets."""
+        with self._fed_lock:
+            pooled = self._fed_pooled
+        if not pooled:
+            return None
+        return _dtrace.quantile_from_pooled(pooled, family, q, labels)
 
     # ----------------------------------------------------- fleet admin
     def health_reasons(self) -> List[str]:

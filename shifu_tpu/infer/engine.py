@@ -23,6 +23,8 @@ import collections
 import contextlib
 import dataclasses
 import itertools
+import os
+import socket
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
@@ -34,6 +36,7 @@ import jax
 import jax.numpy as jnp
 
 from shifu_tpu import obs as _obs
+from shifu_tpu.obs import disttrace as _dtrace
 from shifu_tpu.ops.attention import NEG_INF
 from shifu_tpu.infer.sampling import (
     SampleConfig,
@@ -137,6 +140,10 @@ class _Request:
     # and are PREEMPTED (re-queued, never dropped) when interactive
     # arrivals need the capacity (shifu_tpu/batch).
     tier: str = "interactive"
+    # Distributed-trace context ({trace_id, span_id[, parent_id]} from
+    # obs.disttrace.TraceContext.to_dict()) — echoed into the
+    # completion's timing and the engine's /tracez span store.
+    trace: Optional[dict] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -197,6 +204,14 @@ ENGINE_INTERFACE = frozenset({
     # and hit rates — the scrape prefix-aware sticky routing reads
     # (ROADMAP item 2). None for engines without a prefix cache.
     "cache_stats",
+    # distributed tracing (obs/disttrace.py): ``trace_spans`` answers
+    # ``GET /tracez?trace_id=`` with per-host span documents (the
+    # fleet router fans out to backends and applies probe-estimated
+    # clock offsets); ``host_label`` is the host/process lane label on
+    # every span this process emits; ``federated_metrics`` is the
+    # router's ``shifu_fleet_agg_*`` exposition block appended to
+    # /metrics ("" for in-process engines — no fleet to aggregate).
+    "trace_spans", "host_label", "federated_metrics",
 })
 
 
@@ -419,6 +434,11 @@ class Engine:
         self.metrics = metrics if metrics is not None else _obs.REGISTRY
         self.flight = flight if flight is not None else _obs.FLIGHT
         self.replica_label = "0"
+        # Distributed tracing (obs/disttrace.py): the host/process lane
+        # label on every span this engine emits, and the bounded
+        # per-trace span index behind ``GET /tracez?trace_id=``.
+        self.host_label = f"{socket.gethostname()}:{os.getpid()}"
+        self._span_store = _dtrace.SpanStore()
         self._obs_bind()
         # Kernel tune table (ops.pallas.registry): when one is active,
         # every prefill this engine compiles resolves its flash/MoE
@@ -613,8 +633,14 @@ class Engine:
         constraint=None,
         model: Optional[str] = None,
         tier: str = "interactive",
+        trace: Optional[dict] = None,
     ) -> int:
         """Queue one request; returns its rid.
+
+        ``trace``: optional distributed-trace context dict
+        ({trace_id, span_id[, parent_id]} — obs.disttrace), echoed
+        into ``Completion.timing`` and the /tracez span store so a
+        fleet-wide trace can follow the request through this engine.
 
         ``tier``: admission tier. "interactive" (the default) always
         admits first; "batch" (the offline file-in/file-out workload —
@@ -868,6 +894,7 @@ class Engine:
                 constraint=constraint,
                 created_ts=time.monotonic(),
                 tier=tier,
+                trace=dict(trace) if trace else None,
             )
         )
         self._set_queue_gauges()
@@ -1183,6 +1210,23 @@ class Engine:
         cache (dense engines; PagedEngine answers for real, the fleet
         router scrapes per-backend)."""
         return None
+
+    def trace_spans(self, trace_id) -> list:
+        """Per-host span documents for one trace — the ``GET
+        /tracez?trace_id=`` surface (obs/disttrace.py). An in-process
+        engine answers with its own single host document; the fleet
+        router fans out to every backend and attaches probe-estimated
+        clock offsets."""
+        return [_dtrace.host_doc(
+            self.host_label, self._span_store.get(trace_id),
+            replica=self.replica_label,
+        )]
+
+    def federated_metrics(self) -> str:
+        """The ``shifu_fleet_agg_*`` exposition block the /metrics
+        handler appends to the local scrape — empty for in-process
+        engines (only the fleet router has backends to aggregate)."""
+        return ""
 
     def reload_params(self, params) -> None:
         """Hot-swap the serving weights IN PLACE (``POST /reloadz``,
@@ -2159,11 +2203,30 @@ class Engine:
             "decode_ms": round(decode_ms, 2),
             "total_ms": round(ttft + decode_ms, 2),
             "preemptions": req.preempts,
+            # Lane key for the Chrome export: two replicas sharing a
+            # rid must not interleave into one track (obs/trace.py).
+            "replica": self.replica_label,
         }
         if n_tokens > 1 and decode_ms > 0:
             # First token lands at prefill; the rest amortise decode.
             t["decode_tokens_per_s"] = round(
                 (n_tokens - 1) / (decode_ms / 1000), 1
+            )
+        if req.trace:
+            # Distributed-trace echo: the context rides the timing dict
+            # into the API response, the runner's trace-log JSONL, and
+            # this engine's /tracez span store; the flight ring gets a
+            # request event carrying the same trace_id.
+            t.update(req.trace)
+            self._span_store.add(req.trace.get("trace_id"), {
+                "rid": req.rid, "finished_by": finished_by,
+                "n_tokens": n_tokens, "tier": req.tier, **t,
+            })
+            self.flight.record(
+                "request", rid=req.rid, finished_by=finished_by,
+                n_tokens=n_tokens,
+                trace_id=req.trace.get("trace_id", ""),
+                span_id=req.trace.get("span_id", ""),
             )
         # Batch-tier completions land in their OWN window: the SLO
         # watchdog's interactive p99 budgets read the percentile keys

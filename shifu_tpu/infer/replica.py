@@ -284,6 +284,27 @@ class ReplicatedEngine:
                 out[t] = out.get(t, 0) + d
         return out
 
+    @property
+    def host_label(self) -> str:
+        """One process, one lane label (ENGINE_INTERFACE): replicas
+        are lane-split by their replica label, not the host."""
+        return getattr(self.engines[0], "host_label", "local")
+
+    def trace_spans(self, trace_id) -> list:
+        """``GET /tracez`` surface: every replica's host documents
+        concatenated. Replicas share the process (one clock), but each
+        doc keeps its replica label so the Chrome export lanes them
+        apart (obs/trace.py keys lanes by (host, replica))."""
+        out: list = []
+        for e in self.engines:
+            out.extend(e.trace_spans(trace_id))
+        return out
+
+    def federated_metrics(self) -> str:
+        """No fleet to aggregate — in-process replicas all scrape
+        through this process's own registry already."""
+        return ""
+
     def reload_params(self, params) -> None:
         """Hot-swap serving weights on EVERY replica (each re-places
         the tree onto its own sub-mesh via its live leaf shardings).

@@ -158,6 +158,7 @@ from __future__ import annotations
 import collections
 import dataclasses
 import json
+import os
 import queue
 import re
 import threading
@@ -167,6 +168,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from shifu_tpu import obs as _obs
+from shifu_tpu.obs import disttrace as _dtrace
 from shifu_tpu.infer.engine import Completion, Engine, UnknownModelError
 from shifu_tpu.infer.sampling import SampleConfig
 
@@ -495,6 +497,9 @@ class _Submission:
     json_schema: Optional[dict] = None
     model: Optional[str] = None
     tier: str = "interactive"
+    # Distributed-trace context dict (obs.disttrace) — rides through
+    # Engine.submit into Completion.timing and the /tracez span store.
+    trace: Optional[dict] = None
 
 
 @dataclasses.dataclass
@@ -665,13 +670,14 @@ class EngineRunner:
         stop_token_ids=None, stop_strings=None,
         logit_bias=None, allowed_token_ids=None, adapter=None,
         regex=None, json_schema=None, model=None, tier="interactive",
+        trace=None,
     ) -> Completion:
         return self.complete_n(
             tokens, max_new_tokens, 1, timeout=timeout, sampling=sampling,
             stop_token_ids=stop_token_ids, stop_strings=stop_strings,
             logit_bias=logit_bias, allowed_token_ids=allowed_token_ids,
             adapter=adapter, regex=regex, json_schema=json_schema,
-            model=model, tier=tier,
+            model=model, tier=tier, trace=trace,
         )[0]
 
     def complete_n(
@@ -681,6 +687,7 @@ class EngineRunner:
         stop_token_ids=None, stop_strings=None,
         logit_bias=None, allowed_token_ids=None, adapter=None,
         regex=None, json_schema=None, model=None, tier="interactive",
+        trace=None,
     ):
         """N independent completions of one prompt (the API's ``n``).
 
@@ -714,6 +721,7 @@ class EngineRunner:
                         allowed_token_ids=allowed_token_ids,
                         adapter=adapter, regex=regex,
                         json_schema=json_schema, model=model, tier=tier,
+                        trace=trace,
                     )
                 )
         self._g_inbox.set(len(self._inbox))
@@ -825,7 +833,7 @@ class EngineRunner:
                stop_token_ids=None, stop_strings=None,
                logit_bias=None, allowed_token_ids=None, adapter=None,
                regex=None, json_schema=None, model=None,
-               tier="interactive"):
+               tier="interactive", trace=None):
         """Returns a generator of ("delta", (ids, logprobs)) items
         ending with ("done", Completion); tokens arrive as the engine
         emits them (per decode chunk). The submission (and the
@@ -851,6 +859,7 @@ class EngineRunner:
                     allowed_token_ids=allowed_token_ids,
                     adapter=adapter, regex=regex,
                     json_schema=json_schema, model=model, tier=tier,
+                    trace=trace,
                 )
             )
         self._g_inbox.set(len(self._inbox))
@@ -912,6 +921,10 @@ class EngineRunner:
         out["queued"] = out.get("queued", 0) + len(self._inbox)
         out["runner_inbox"] = len(self._inbox)
         out["idle"] = eng.idle
+        # Wall-clock stamp: the fleet prober's NTP-style clock-offset
+        # estimate reads this from the probe response (the stamp lies
+        # inside the probe's [t0, t1] round trip — obs/disttrace.py).
+        out["wall_ms"] = time.time() * 1000.0
         out["healthy"] = self.fatal is None and not self._stop.is_set()
         if self.fatal is not None:
             out["fatal"] = repr(self.fatal)
@@ -1131,7 +1144,7 @@ class EngineRunner:
                     allowed_token_ids=sub.allowed_token_ids,
                     adapter=sub.adapter, regex=sub.regex,
                     json_schema=sub.json_schema, model=sub.model,
-                    tier=sub.tier,
+                    tier=sub.tier, trace=sub.trace,
                 )
             except Exception as e:  # validation error -> the caller
                 with self._lock:
@@ -1179,6 +1192,12 @@ class EngineRunner:
                             "rid": done.rid,
                             "finished_by": done.finished_by,
                             "n_tokens": len(done.tokens),
+                            # Host/process lane label: merged fleet
+                            # traces key Chrome lanes by (host,
+                            # replica) — obs/trace.py.
+                            "host": getattr(
+                                self.engine, "host_label", None
+                            ) or f"pid:{os.getpid()}",
                             **(done.timing or {}),
                         }
                         try:
@@ -1333,7 +1352,16 @@ class _Handler(BaseHTTPRequestHandler):
             from shifu_tpu.obs import compilemon
 
             compilemon.update_memory_gauges(self.runner.metrics)
-            body = self.runner.metrics.render().encode()
+            text = self.runner.metrics.render()
+            # Fleet federation (ENGINE_INTERFACE "federated_metrics"):
+            # a router appends the whole fleet's aggregate as
+            # shifu_fleet_agg_* families — one scrape target sees
+            # every backend; in-process engines answer "".
+            eng = self.runner.engine
+            fed = eng.federated_metrics()
+            if fed:
+                text = text + fed
+            body = text.encode()
             self.send_response(200)
             self.send_header(
                 "Content-Type",
@@ -1385,6 +1413,20 @@ class _Handler(BaseHTTPRequestHandler):
             cache = eng.cache_stats()
             if cache is not None:
                 out["cache"] = cache
+            # Speculative-decoding block: per-engine propose/accept
+            # totals + the rolling acceptance rate (the spec engines'
+            # counters carry them; non-spec engines omit the block).
+            # The fleet will later route spec-friendly traffic by this.
+            counters = out["engine"]
+            if counters.get("spec_proposed") is not None:
+                out["spec"] = {
+                    "proposed": counters.get("spec_proposed", 0),
+                    "accepted": counters.get("spec_accepted", 0),
+                    "acceptance_rate": counters.get("acceptance_rate"),
+                    "rolling_acceptance_rate": counters.get(
+                        "rolling_acceptance_rate"
+                    ),
+                }
             # Batch block: the server-hosted /v1/batches job table
             # (None before any job — the block only appears once the
             # offline tier has been used).
@@ -1412,6 +1454,27 @@ class _Handler(BaseHTTPRequestHandler):
             if cache is None:
                 cache = {"prefix_cache": None, "host_tier": None}
             self._send(200, cache)
+        elif self.path.split("?", 1)[0] == "/tracez":
+            # Distributed-trace span documents for one trace_id
+            # (ENGINE_INTERFACE "trace_spans" — obs/disttrace.py). An
+            # in-process engine answers with its own host document(s);
+            # a fleet router fans out to every backend's /tracez and
+            # attaches probe-estimated clock offsets, so `shifu_tpu
+            # trace export --url --trace-id` merges ONE Chrome trace
+            # with a lane per host.
+            from urllib.parse import parse_qs, urlparse
+
+            q = parse_qs(urlparse(self.path).query)
+            tid = (q.get("trace_id") or [""])[0].strip()
+            if not tid:
+                self._send(400, {
+                    "error": "trace_id query parameter required",
+                })
+                return
+            eng = self.runner.engine
+            self._send(200, {
+                "trace_id": tid, "hosts": eng.trace_spans(tid),
+            })
         elif self.path == "/v1/models":
             eng = self.runner.engine
             served = eng.served_models()
@@ -2088,6 +2151,16 @@ class _Handler(BaseHTTPRequestHandler):
                     )
                 regex = _tool_constraint(tools, tool_choice)
             want_logprobs = bool(req.get("logprobs"))
+            # Distributed-trace context (obs/disttrace.py): adopt the
+            # inbound x-shifu-trace header (an upstream router hop
+            # minted it and forwarded a child) or mint a fresh root
+            # when hit directly. Echoed on the response and carried
+            # through the engine into Completion.timing + /tracez.
+            trace_ctx = _dtrace.ensure_context(
+                self.headers.get(_dtrace.HEADER)
+            )
+            trace = trace_ctx.to_dict()
+            trace_hdr = {_dtrace.HEADER: trace_ctx.to_header()}
             n = int(req.get("n", 1))
             best_of = req.get("best_of")
             if not (1 <= n <= 16):
@@ -2105,7 +2178,7 @@ class _Handler(BaseHTTPRequestHandler):
                     logit_bias=logit_bias, allowed_token_ids=allowed_ids,
                     adapter=adapter, regex=regex,
                     json_schema=json_schema, tools=tools, model=model,
-                    tier=tier,
+                    tier=tier, trace_ctx=trace_ctx,
                 )
                 return
             if best_of is not None:
@@ -2184,7 +2257,7 @@ class _Handler(BaseHTTPRequestHandler):
                         "completion_tokens": gen,
                         "total_tokens": len(tokens) + gen,
                     },
-                })
+                }, headers=trace_hdr)
                 return
             if n > 1:
                 dones = self.runner.complete_n(
@@ -2193,7 +2266,7 @@ class _Handler(BaseHTTPRequestHandler):
                     stop_strings=stop_strings, logit_bias=logit_bias,
                     allowed_token_ids=allowed_ids, adapter=adapter,
                     regex=regex, json_schema=json_schema, model=model,
-                    tier=tier,
+                    tier=tier, trace=trace,
                 )
                 choices = [
                     self._timed_choice(d, want_logprobs, stop_strings)
@@ -2207,7 +2280,7 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(200, {
                     "choices": choices,
                     "usage": _usage(len(tokens), dones),
-                })
+                }, headers=trace_hdr)
                 return
             done = self.runner.complete(
                 tokens, max_new, timeout=self.request_timeout_s,
@@ -2215,7 +2288,7 @@ class _Handler(BaseHTTPRequestHandler):
                 stop_strings=stop_strings, logit_bias=logit_bias,
                 allowed_token_ids=allowed_ids, adapter=adapter,
                 regex=regex, json_schema=json_schema, model=model,
-                tier=tier,
+                tier=tier, trace=trace,
             )
         except UnknownModelError as e:
             # The fleet's 404 backstop (the handler pre-check above
@@ -2238,14 +2311,14 @@ class _Handler(BaseHTTPRequestHandler):
             self._as_chat_choice(choice, tools=tools) if chat else choice
         )
         out["usage"] = _usage(len(tokens), [done])
-        self._send(200, out)
+        self._send(200, out, headers=trace_hdr)
 
     def _stream_response(
         self, tokens, max_new: int, sampling=None,
         stop_token_ids=None, stop_strings=None, want_logprobs=False,
         chat: bool = False, logit_bias=None, allowed_token_ids=None,
         adapter=None, regex=None, json_schema=None, tools=None,
-        model=None, tier="interactive",
+        model=None, tier="interactive", trace_ctx=None,
     ) -> None:
         """Server-sent events: one ``data:`` line per token delta, a
         final one with finished_by (and the definitive token count —
@@ -2262,11 +2335,14 @@ class _Handler(BaseHTTPRequestHandler):
             allowed_token_ids=allowed_token_ids, adapter=adapter,
             regex=regex, json_schema=json_schema, model=model,
             tier=tier,
+            trace=trace_ctx.to_dict() if trace_ctx else None,
         )
         self.send_response(200)
         self.send_header("Content-Type", "text/event-stream")
         self.send_header("Cache-Control", "no-cache")
         self.send_header("Connection", "close")
+        if trace_ctx is not None:
+            self.send_header(_dtrace.HEADER, trace_ctx.to_header())
         self.end_headers()
 
         def emit(obj) -> None:

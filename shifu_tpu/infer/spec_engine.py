@@ -71,6 +71,7 @@ re-derived for static shapes and ragged rows.
 
 from __future__ import annotations
 
+import collections as _collections
 from typing import Optional
 
 import numpy as np
@@ -163,6 +164,11 @@ class _SpeculativeBase(PagedEngine):
         # Last (proposed, accepted) totals seen by the flight hook —
         # per-dispatch deltas are what the /debugz timeline shows.
         self._flight_spec_mark = (0, 0)
+        # Recent per-dispatch (proposed, accepted) deltas: the ROLLING
+        # acceptance window behind shifu_spec_acceptance_rate — the
+        # lifetime ratio hides an acceptance collapse under hours of
+        # healthy history; this gauge tracks the last ~64 dispatches.
+        self._spec_window = _collections.deque(maxlen=64)
         super().__init__(model, params, **kw)
 
     # ------------------------------------------------------------ shared
@@ -177,6 +183,15 @@ class _SpeculativeBase(PagedEngine):
             else 0.0
         )
 
+    @property
+    def rolling_acceptance_rate(self) -> float:
+        """Acceptance over the recent-dispatch window (0.0 before any
+        speculative round lands)."""
+        prop = sum(p for p, _a in self._spec_window)
+        if not prop:
+            return 0.0
+        return sum(a for _p, a in self._spec_window) / prop
+
     def _obs_bind(self) -> None:
         super()._obs_bind()
         m, r = self.metrics, self.replica_label
@@ -190,6 +205,12 @@ class _SpeculativeBase(PagedEngine):
             "Speculative proposals accepted by the verify step",
             labelnames=("replica",),
         ).labels(replica=r)
+        self._g_spec_rate = m.gauge(
+            "shifu_spec_acceptance_rate",
+            "Rolling speculative acceptance rate (recent dispatches; "
+            "the lifetime ratio is the counters' quotient)",
+            labelnames=("replica",),
+        ).labels(replica=r)
 
     def counters(self) -> dict:
         out = super().counters()
@@ -197,6 +218,7 @@ class _SpeculativeBase(PagedEngine):
             spec_proposed=self.spec_proposed,
             spec_accepted=self.spec_accepted,
             acceptance_rate=round(self.acceptance_rate, 4),
+            rolling_acceptance_rate=round(self.rolling_acceptance_rate, 4),
         )
         return out
 
@@ -211,6 +233,8 @@ class _SpeculativeBase(PagedEngine):
         d_acc = acc - self._flight_spec_mark[1]
         self._flight_spec_mark = (prop, acc)
         if d_prop:
+            self._spec_window.append((d_prop, d_acc))
+            self._g_spec_rate.set(round(self.rolling_acceptance_rate, 4))
             self.flight.record(
                 "spec_round", replica=self.replica_label,
                 proposed=d_prop, accepted=d_acc,

@@ -27,7 +27,15 @@ Modules:
                runner's crash auto-dump read it.
 ``watchdog``   declared SLO budgets (p99 TTFT/ITL, step time, queue
                depth) evaluated over sliding windows; flips ``/healthz``
-               to "degraded" with reason strings.
+               to "degraded" with reason strings. Budgets also apply to
+               a router's FEDERATED (fleet-pooled) histograms when the
+               engine exposes ``federated_quantile``.
+``disttrace``  fleet-wide distributed tracing: the ``x-shifu-trace``
+               context (mint/parse/propagate), bounded per-trace span
+               stores behind ``GET /tracez``, NTP-style clock-offset
+               estimation from prober round trips, cross-host trace
+               merging into one Chrome trace, and /metrics federation
+               (``shifu_fleet_agg_*``).
 ``compilemon`` compile telemetry (per-jitted-function recompile
                counters/latencies + the jax.monitoring mirror) and
                sampled HBM gauges.
@@ -44,11 +52,21 @@ from shifu_tpu.obs.registry import (
 from shifu_tpu.obs.trace import chrome_trace, export_trace_log
 from shifu_tpu.obs.flight import FLIGHT, FlightRecorder
 from shifu_tpu.obs.watchdog import SLOConfig, SLOWatchdog
+from shifu_tpu.obs.disttrace import (
+    ClockSync,
+    SpanStore,
+    TraceContext,
+    ensure_context,
+    fetch_and_merge,
+    merge_host_docs,
+    parse_header,
+)
 
 # The process-global default registry (see module docstring).
 REGISTRY = MetricsRegistry()
 
 __all__ = [
+    "ClockSync",
     "DEFAULT_BUCKETS",
     "FLIGHT",
     "FlightRecorder",
@@ -56,7 +74,13 @@ __all__ = [
     "REGISTRY",
     "SLOConfig",
     "SLOWatchdog",
+    "SpanStore",
+    "TraceContext",
     "chrome_trace",
+    "ensure_context",
     "export_trace_log",
+    "fetch_and_merge",
+    "merge_host_docs",
     "parse_exposition",
+    "parse_header",
 ]

@@ -1,0 +1,386 @@
+"""Fleet-wide distributed tracing: context propagation, clock
+alignment, cross-host trace assembly, and /metrics federation.
+
+A request that crosses the router, a backend queue, prefill, decode,
+and maybe a resubmit after a backend death can only be explained if
+every hop carries ONE identity. This module provides the pieces; the
+router, engine, server, and batch runner wire them in:
+
+``TraceContext``    trace_id / span_id / parent_id, minted at the edge
+                    (router, or the engine server when hit directly)
+                    and propagated over the existing HTTP surface via
+                    the ``x-shifu-trace`` header (``HEADER``), format
+                    ``<trace_id>-<span_id>[-<parent_id>]``, lowercase
+                    hex. Each hop forwards a ``child()`` so the parent
+                    chain survives the wire.
+
+``SpanStore``       bounded per-trace span records (engine completions,
+                    router hops, resubmits) backing ``GET
+                    /tracez?trace_id=``. Records are plain dicts in the
+                    trace-log JSONL shape; ``t0_ms`` is on the OWNING
+                    host's monotonic clock.
+
+``ClockSync``       NTP-style offset estimation from the probe round
+                    trips the FleetProber already makes: one sample is
+                    ``offset = remote_wall - (t0 + t1) / 2`` with error
+                    bound ``rtt / 2``; the minimum-RTT sample wins (a
+                    congested probe can only widen the bound, never
+                    flip its sign past rtt/2).
+
+``merge_host_docs`` per-host span documents -> ONE Chrome trace with a
+                    lane per (host, replica). Each doc carries paired
+                    ``mono_now_ms`` / ``wall_now_ms`` stamps so records
+                    move monotonic -> that host's wall clock, then the
+                    probe-estimated ``offset_ms`` moves them onto the
+                    collector's wall clock.
+
+``federate``        per-backend Prometheus scrapes -> one text block of
+                    ``shifu_fleet_agg_*`` families: counters and gauges
+                    summed, histograms pooled bucket-wise (the parsed
+                    samples are cumulative, so summing per ``le`` edge
+                    across backends is exact), per-backend series kept
+                    under a ``backend`` label next to the pooled ones.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import math
+import os
+import re
+import threading
+import time
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from shifu_tpu.obs.registry import _bucket_quantile, escape_label_value
+from shifu_tpu.obs.trace import chrome_trace
+
+# The one propagation header. Lowercase (http.client titlecases on the
+# wire; BaseHTTPRequestHandler matching is case-insensitive).
+HEADER = "x-shifu-trace"
+
+AGG_PREFIX = "shifu_fleet_agg_"
+
+_ID_RE = re.compile(r"^[0-9a-f]{2,32}$")
+
+
+def _gen_id(nbytes: int) -> str:
+    return os.urandom(nbytes).hex()
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceContext:
+    """One hop's identity within a trace. ``trace_id`` is constant for
+    the request's whole life (resubmits included); ``span_id`` names
+    this hop; ``parent_id`` names the hop that forwarded to us."""
+
+    trace_id: str
+    span_id: str
+    parent_id: str = ""
+
+    def child(self) -> "TraceContext":
+        """The context to forward downstream: same trace, fresh span,
+        this hop as the parent."""
+        return TraceContext(self.trace_id, _gen_id(8), self.span_id)
+
+    def to_header(self) -> str:
+        if self.parent_id:
+            return f"{self.trace_id}-{self.span_id}-{self.parent_id}"
+        return f"{self.trace_id}-{self.span_id}"
+
+    def to_dict(self) -> dict:
+        d = {"trace_id": self.trace_id, "span_id": self.span_id}
+        if self.parent_id:
+            d["parent_id"] = self.parent_id
+        return d
+
+
+def mint() -> TraceContext:
+    """A fresh root context (32-hex trace id, 16-hex span id)."""
+    return TraceContext(_gen_id(16), _gen_id(8))
+
+
+def parse_header(value) -> Optional[TraceContext]:
+    """``x-shifu-trace`` header value -> context, or None when absent
+    or malformed (a garbled header must not fail the request — the
+    caller mints a fresh root instead)."""
+    if not value or not isinstance(value, str):
+        return None
+    parts = value.strip().lower().split("-")
+    if len(parts) not in (2, 3):
+        return None
+    if not all(_ID_RE.match(p) for p in parts):
+        return None
+    return TraceContext(*parts)
+
+
+def ensure_context(header_value=None) -> TraceContext:
+    """Parse the inbound header or mint a root — the edge-of-process
+    entry point (HTTP handler, batch runner line, router submit)."""
+    ctx = parse_header(header_value)
+    return ctx if ctx is not None else mint()
+
+
+# --------------------------------------------------------------- spans
+class SpanStore:
+    """Bounded per-trace span records backing ``GET /tracez``.
+
+    One ``add`` is a lock + two dict/list ops — cheap enough for the
+    completion path (per request, not per token). Traces evict oldest-
+    inserted once ``max_traces`` is reached, records per trace are
+    capped at ``max_spans`` (a runaway retry loop must not grow without
+    bound)."""
+
+    def __init__(self, max_traces: int = 256, max_spans: int = 128):
+        self.max_traces = int(max_traces)
+        self.max_spans = int(max_spans)
+        self._traces: "collections.OrderedDict[str, List[dict]]" = \
+            collections.OrderedDict()
+        self._lock = threading.Lock()
+
+    def add(self, trace_id, rec: dict) -> None:
+        tid = str(trace_id or "")
+        if not tid:
+            return
+        with self._lock:
+            spans = self._traces.get(tid)
+            if spans is None:
+                while len(self._traces) >= self.max_traces:
+                    self._traces.popitem(last=False)
+                spans = self._traces[tid] = []
+            if len(spans) < self.max_spans:
+                spans.append(rec)
+
+    def get(self, trace_id) -> List[dict]:
+        with self._lock:
+            return list(self._traces.get(str(trace_id or ""), ()))
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._traces)
+
+
+def span_record(kind: str, ctx: Optional[TraceContext], t0_ms: float,
+                dur_ms: float, **fields) -> dict:
+    """A generic (non-engine-timing) span record in the trace-log
+    shape: ``t0_ms`` on the recording host's monotonic clock."""
+    rec = {
+        "kind": str(kind),
+        "t0_ms": float(t0_ms),
+        "dur_ms": max(float(dur_ms), 0.0),
+    }
+    if ctx is not None:
+        rec.update(ctx.to_dict())
+    rec.update(fields)
+    return rec
+
+
+def host_doc(host: str, records: Iterable[dict], *,
+             replica: Optional[str] = None,
+             offset_ms: float = 0.0, err_ms: float = 0.0) -> dict:
+    """One host's contribution to a /tracez response. The paired
+    monotonic/wall stamps are taken HERE, in the process that owns the
+    records' monotonic clock — that pairing is what lets the collector
+    convert ``t0_ms`` to this host's wall clock."""
+    doc = {
+        "host": str(host),
+        "mono_now_ms": time.monotonic() * 1000.0,
+        "wall_now_ms": time.time() * 1000.0,
+        "offset_ms": float(offset_ms),
+        "err_ms": float(err_ms),
+        "records": list(records),
+    }
+    if replica is not None:
+        doc["replica"] = str(replica)
+    return doc
+
+
+# ------------------------------------------------------ clock alignment
+def probe_offset(t0_ms: float, t1_ms: float,
+                 remote_wall_ms: float) -> Tuple[float, float]:
+    """One NTP-style sample from a probe round trip: the remote stamped
+    its wall clock somewhere inside [t0, t1] on our clock, so ``offset
+    = remote - midpoint`` is wrong by at most ``rtt / 2``."""
+    rtt = max(float(t1_ms) - float(t0_ms), 0.0)
+    offset = float(remote_wall_ms) - (float(t0_ms) + float(t1_ms)) / 2.0
+    return offset, rtt / 2.0
+
+
+class ClockSync:
+    """Best (minimum-RTT) offset sample per peer, refreshed when a
+    sample at least as tight arrives or the held one goes stale
+    (clocks drift; a tight sample from ten minutes ago can be worse
+    than a loose fresh one)."""
+
+    STALE_S = 120.0
+
+    def __init__(self):
+        self._best: Dict[str, Tuple[float, float, float]] = {}
+        self._lock = threading.Lock()
+
+    def note(self, peer: str, t0_ms: float, t1_ms: float,
+             remote_wall_ms) -> None:
+        if not isinstance(remote_wall_ms, (int, float)):
+            return
+        offset, err = probe_offset(t0_ms, t1_ms, remote_wall_ms)
+        now = time.monotonic()
+        with self._lock:
+            held = self._best.get(peer)
+            if (held is None or err <= held[1]
+                    or now - held[2] > self.STALE_S):
+                self._best[peer] = (offset, err, now)
+
+    def offset(self, peer: str) -> Tuple[float, float]:
+        """(offset_ms, err_ms); (0, inf) for a never-probed peer —
+        the merge still works, just without a cross-host guarantee."""
+        with self._lock:
+            held = self._best.get(peer)
+        if held is None:
+            return 0.0, math.inf
+        return held[0], held[1]
+
+
+# -------------------------------------------------------- trace merge
+def merge_host_docs(docs: Iterable[dict], *,
+                    trace_id: Optional[str] = None) -> dict:
+    """Per-host span documents -> one merged Chrome trace.
+
+    Each record's ``t0_ms`` is on its host's monotonic clock. The shift
+    to the collector's wall clock is ``(wall_now - mono_now) -
+    offset``: the paired stamps move monotonic -> that host's wall
+    clock, and ``offset_ms`` (= remote_wall - collector_wall from the
+    probe midpoint) moves that onto the collector's. Lane assignment —
+    one process lane per (host, replica) — is chrome_trace's job."""
+    merged: List[dict] = []
+    worst_err = 0.0
+    hosts = []
+    for doc in docs:
+        if not isinstance(doc, dict):
+            continue
+        host = str(doc.get("host") or "local")
+        if host not in hosts:
+            hosts.append(host)
+        shift = (
+            float(doc.get("wall_now_ms", 0.0))
+            - float(doc.get("mono_now_ms", 0.0))
+            - float(doc.get("offset_ms", 0.0))
+        )
+        err = doc.get("err_ms", 0.0)
+        if isinstance(err, (int, float)) and math.isfinite(err):
+            worst_err = max(worst_err, float(err))
+        for rec in doc.get("records", ()):
+            if not isinstance(rec, dict):
+                continue
+            if trace_id is not None and rec.get("trace_id") != trace_id:
+                continue
+            r = dict(rec)
+            r["t0_ms"] = float(r.get("t0_ms", 0.0)) + shift
+            r.setdefault("host", host)
+            if "replica" not in r and doc.get("replica") is not None:
+                r["replica"] = doc["replica"]
+            merged.append(r)
+    merged.sort(key=lambda r: r["t0_ms"])
+    trace = chrome_trace(merged)
+    trace["otherData"].update(
+        hosts=hosts,
+        align_err_ms=worst_err,
+        **({"trace_id": trace_id} if trace_id else {}),
+    )
+    return trace
+
+
+def fetch_and_merge(url: str, trace_id: str, *,
+                    timeout_s: float = 10.0) -> dict:
+    """``GET {url}/tracez?trace_id=`` on a router (or single backend)
+    and merge the returned host docs — the ``shifu_tpu trace export
+    --url --trace-id`` implementation."""
+    import json as _json
+    from urllib.parse import quote
+    from urllib.request import urlopen
+
+    base = url.rstrip("/")
+    full = f"{base}/tracez?trace_id={quote(str(trace_id))}"
+    with urlopen(full, timeout=timeout_s) as resp:
+        doc = _json.loads(resp.read().decode("utf-8"))
+    return merge_host_docs(doc.get("hosts", ()), trace_id=str(trace_id))
+
+
+# ---------------------------------------------------------- federation
+def federate(parsed_by_backend: Dict[str, Dict[tuple, float]],
+             ) -> Tuple[str, Dict[tuple, float]]:
+    """Per-backend parsed scrapes -> (federated exposition text, pooled
+    samples).
+
+    Input is ``{backend_addr: parse_exposition(text)}``. Every
+    ``shifu_*`` sample becomes TWO series under ``shifu_fleet_agg_`` +
+    the name minus its ``shifu_`` prefix: one per-backend (original
+    labels plus ``backend``) and one pooled (original labels, values
+    summed across backends). Histogram ``_bucket`` samples are
+    cumulative counts, so the per-``le`` sum across backends is the
+    exact pooled histogram. Already-federated families are skipped so a
+    router scraping a router does not double-count."""
+    pooled: Dict[tuple, float] = {}
+    per_backend: Dict[tuple, float] = {}
+    for addr in sorted(parsed_by_backend):
+        for (name, labels), val in parsed_by_backend[addr].items():
+            if not name.startswith("shifu_") or name.startswith(AGG_PREFIX):
+                continue
+            agg = AGG_PREFIX + name[len("shifu_"):]
+            if not math.isfinite(val):
+                continue
+            per_backend[(agg, labels | {("backend", addr)})] = val
+            key = (agg, labels)
+            pooled[key] = pooled.get(key, 0.0) + val
+    lines = []
+    for samples in (pooled, per_backend):
+        for (name, labels) in sorted(
+            samples, key=lambda k: (k[0], sorted(k[1]))
+        ):
+            lbl = ",".join(
+                f'{k}="{escape_label_value(v)}"'
+                for k, v in sorted(labels)
+            )
+            v = samples[(name, labels)]
+            sv = str(int(v)) if float(v).is_integer() else repr(float(v))
+            lines.append(f"{name}{{{lbl}}} {sv}" if lbl else f"{name} {sv}")
+    return ("\n".join(lines) + "\n" if lines else ""), pooled
+
+
+def quantile_from_pooled(pooled: Dict[tuple, float], family: str,
+                         q: float,
+                         labels: Optional[dict] = None) -> Optional[float]:
+    """Estimated quantile over a pooled federated histogram family
+    (``family`` WITHOUT the agg prefix, e.g. ``shifu_request_ttft_
+    seconds``), pooling every series whose labels are a superset of
+    ``labels`` — the fleet-wide view the SLO watchdog budgets on."""
+    name = family
+    if name.startswith("shifu_") and not name.startswith(AGG_PREFIX):
+        name = AGG_PREFIX + name[len("shifu_"):]
+    bucket_name = name + "_bucket"
+    want = {k: str(v) for k, v in (labels or {}).items()}
+    acc: Dict[float, float] = {}
+    for (sname, slabels), val in pooled.items():
+        if sname != bucket_name:
+            continue
+        ld = dict(slabels)
+        le = ld.pop("le", None)
+        if le is None:
+            continue
+        if any(ld.get(k) != v for k, v in want.items()):
+            continue
+        edge = math.inf if le in ("+Inf", "inf") else float(le)
+        acc[edge] = acc.get(edge, 0.0) + val
+    if not acc:
+        return None
+    edges = tuple(sorted(e for e in acc if e != math.inf))
+    # Cumulative-per-edge -> per-bucket counts (+Inf last).
+    cum = [acc[e] for e in edges]
+    inf_cum = acc.get(math.inf, cum[-1] if cum else 0.0)
+    counts, prev = [], 0.0
+    for c in cum:
+        counts.append(max(c - prev, 0.0))
+        prev = c
+    counts.append(max(inf_cum - prev, 0.0))
+    total = sum(counts)
+    return _bucket_quantile(edges, counts, total, q)

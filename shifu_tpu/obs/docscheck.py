@@ -1,0 +1,134 @@
+"""Metric-docs drift check: registered families vs observability docs.
+
+``shifu_tpu obs check-docs`` (a tier-1 gate) fails when the registry
+surface and ``docs/observability.md`` disagree in EITHER direction:
+
+  * a ``shifu_*`` family registered anywhere under ``shifu_tpu/`` that
+    the doc never mentions (new telemetry shipped undocumented), or
+  * a family the doc names that no code registers (stale docs after a
+    rename/removal).
+
+Families are found by scanning source string literals — the registry
+is built lazily per process (engines register their families in
+``_obs_bind`` on construction), so a source scan is the only view that
+covers every engine class without instantiating them. Dynamic names
+are handled structurally:
+
+  * an f-string family (``f"shifu_kv_tier_{k}_total"``) becomes a glob
+    pattern (``shifu_kv_tier_*_total``) — documented when any doc token
+    matches it, and every doc token matching it is known;
+  * a literal ending in ``_`` (the ``shifu_fleet_agg_`` federation
+    prefix) is a PREFIX — same matching rule;
+  * doc tokens ending in ``_`` are prose prefix-mentions ("the
+    ``shifu_fleet_*`` families") and are fine when any family starts
+    with them.
+
+``ALLOWLIST`` carries names exempt in both directions (bench-only
+families that never register inside the package, and non-family
+literals like the CLI prog name).
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import os
+import re
+from typing import Dict, List, Set, Tuple
+
+# Exempt in both directions: not families (CLI prog name, env-var key),
+# plus bench-only families registered outside shifu_tpu/ (none today —
+# add here when the bench grows one rather than documenting a family
+# operators can never scrape from a server).
+ALLOWLIST = frozenset({
+    "shifu_tpu",
+    "shifu_tpu_act_env",
+})
+
+# String literals (f-strings included) that look like metric families.
+_LIT_RE = re.compile(
+    r'["\'](shifu_[a-z0-9_]*(?:\{[^}"\']*\}[a-z0-9_]*)*)["\']'
+)
+_DOC_RE = re.compile(r"shifu_[a-z0-9_]+")
+
+
+def scan_source_families(root: str) -> Dict[str, Set[str]]:
+    """``shifu_*`` string literals under ``root`` (a package dir) ->
+    {family_or_pattern: {relative file paths}}. ``{expr}`` segments
+    become ``*``; a trailing ``_`` marks a prefix and also becomes a
+    trailing ``*``."""
+    out: Dict[str, Set[str]] = {}
+    for dirpath, _dirs, files in os.walk(root):
+        for fn in sorted(files):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            with open(path, encoding="utf-8") as f:
+                text = f.read()
+            rel = os.path.relpath(path, root)
+            for m in _LIT_RE.finditer(text):
+                name = re.sub(r"\{[^}]*\}", "*", m.group(1))
+                if name in ALLOWLIST:
+                    continue
+                if name.endswith("_"):
+                    name += "*"
+                out.setdefault(name, set()).add(rel)
+    return out
+
+
+def scan_doc_families(doc_text: str) -> Tuple[Set[str], Set[str]]:
+    """Doc ``shifu_*`` tokens -> (concrete mentions, prefix mentions).
+    A token ending in ``_`` is a prose prefix-mention."""
+    concrete: Set[str] = set()
+    prefixes: Set[str] = set()
+    for tok in _DOC_RE.findall(doc_text):
+        if tok in ALLOWLIST:
+            continue
+        (prefixes if tok.endswith("_") else concrete).add(tok)
+    return concrete, prefixes
+
+
+def check_docs(package_root: str, doc_path: str) -> Tuple[bool, dict]:
+    """(ok, report). ``report['undocumented']`` lists families the code
+    registers that the doc never mentions; ``report['unknown']`` lists
+    doc names no code registers."""
+    families = scan_source_families(package_root)
+    with open(doc_path, encoding="utf-8") as f:
+        doc_text = f.read()
+    doc_concrete, doc_prefixes = scan_doc_families(doc_text)
+
+    undocumented: List[dict] = []
+    for name in sorted(families):
+        if "*" in name:
+            hit = any(fnmatch.fnmatchcase(t, name) for t in doc_concrete)
+        else:
+            hit = name in doc_concrete or any(
+                name.startswith(p) for p in doc_prefixes
+            )
+        if not hit:
+            undocumented.append({
+                "family": name,
+                "registered_in": sorted(families[name]),
+            })
+
+    patterns = [n for n in families if "*" in n]
+    unknown: List[str] = []
+    for tok in sorted(doc_concrete):
+        if tok in families:
+            continue
+        if any(fnmatch.fnmatchcase(tok, pat) for pat in patterns):
+            continue
+        unknown.append(tok)
+    stale_prefixes = [
+        p for p in sorted(doc_prefixes)
+        if not any(f.startswith(p) for f in families)
+    ]
+
+    ok = not undocumented and not unknown and not stale_prefixes
+    return ok, {
+        "ok": ok,
+        "families_in_code": len(families),
+        "families_in_doc": len(doc_concrete),
+        "undocumented": undocumented,
+        "unknown": unknown + stale_prefixes,
+        "doc": doc_path,
+    }

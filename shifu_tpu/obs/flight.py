@@ -58,15 +58,19 @@ class FlightRecorder:
             self._ring.append(ev)
 
     def snapshot(self, last: Optional[int] = None,
-                 kind: Optional[str] = None) -> List[dict]:
+                 kind: Optional[str] = None,
+                 trace_id: Optional[str] = None) -> List[dict]:
         """The ring's events, oldest first; optionally only the
-        ``last`` N, optionally filtered to one ``kind`` (the filter
-        applies BEFORE the tail cut, so ``last`` counts matching
-        events)."""
+        ``last`` N, optionally filtered to one ``kind`` and/or one
+        distributed ``trace_id`` (request completions carry it when the
+        request had a trace context). Filters apply BEFORE the tail
+        cut, so ``last`` counts matching events."""
         with self._lock:
             events = list(self._ring)
         if kind is not None:
             events = [e for e in events if e.get("kind") == kind]
+        if trace_id is not None:
+            events = [e for e in events if e.get("trace_id") == trace_id]
         if last is not None and last >= 0:
             events = events[-last:]
         return events

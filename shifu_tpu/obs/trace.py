@@ -5,10 +5,8 @@ submit, admission, first token, finish — and the runner's ``trace_log``
 persists one JSON line per completion (rid, finished_by, n_tokens plus
 the ``Completion.timing`` spans, including ``t0_ms``, the submit stamp
 on the engine's monotonic clock). This module turns those records into
-the Chrome trace-event format (``chrome://tracing`` / Perfetto), one
-track per request with non-overlapping queue -> prefill -> decode
-spans — the host-side complement to the device-side ``jax.profiler``
-traces.
+the Chrome trace-event format (``chrome://tracing`` / Perfetto) — the
+host-side complement to the device-side ``jax.profiler`` traces.
 
 Span layout per request (all on the engine's monotonic clock):
 
@@ -20,32 +18,54 @@ Span layout per request (all on the engine's monotonic clock):
 prefill, preemption recompute), which could overlap the decode span;
 the exporter clamps the prefill span at the decode start so tracks stay
 well-formed, and carries the raw value in ``args`` for the curious.
+
+Records carrying an explicit ``kind`` + ``dur_ms`` are generic single
+spans (router hops, resubmits, backend hops recorded by the fleet
+layer) and pass through as one event.
+
+Lane assignment: one Chrome PROCESS lane per (host, replica) — two
+replicas (or two hosts, in a merged fleet trace) with the same rid
+must not interleave into one track — and one thread track per request
+within its lane, named by Chrome metadata events so the viewer shows
+``host · replica N`` / ``req R`` instead of bare integers.
 """
 
 from __future__ import annotations
 
 import json
-from typing import Iterable, List, Optional
+from typing import Dict, Iterable, List, Optional, Tuple
 
 PHASES = ("queue", "prefill", "decode")
 
 # Extra keys carried verbatim into each event's args block.
 _ARG_KEYS = (
     "rid", "finished_by", "n_tokens", "preemptions", "prefill_ms",
-    "decode_tokens_per_s",
+    "decode_tokens_per_s", "trace_id", "span_id", "parent_id",
+    "backend", "tier", "model",
 )
 
 
 def spans_from_record(rec: dict) -> List[dict]:
-    """One trace-log record -> its Chrome trace events (may be empty
-    for a record without timing spans)."""
+    """One trace-log record -> its Chrome trace events (without lane
+    assignment — ``chrome_trace`` keys pids/tids by (host, replica)).
+    May be empty for a record without timing spans."""
+    args = {k: rec[k] for k in _ARG_KEYS if k in rec}
+    if "kind" in rec:
+        # Generic single-span record (router hop, resubmit, ...).
+        return [{
+            "name": str(rec["kind"]),
+            "cat": "request",
+            "ph": "X",
+            "ts": round(float(rec.get("t0_ms", 0.0)) * 1000.0, 1),
+            "dur": round(max(float(rec.get("dur_ms", 0.0)), 0.0)
+                         * 1000.0, 1),
+            "args": args,
+        }]
     t0 = float(rec.get("t0_ms", 0.0))
     queue = max(float(rec.get("queue_ms", 0.0)), 0.0)
     prefill = max(float(rec.get("prefill_ms", 0.0)), 0.0)
     ttft = max(float(rec.get("ttft_ms", 0.0)), queue)
     decode = max(float(rec.get("decode_ms", 0.0)), 0.0)
-    rid = rec.get("rid", 0)
-    args = {k: rec[k] for k in _ARG_KEYS if k in rec}
 
     # Non-overlap invariants: queue ends where prefill starts; prefill
     # is clamped into [queue end, decode start]; decode starts at ttft
@@ -62,8 +82,6 @@ def spans_from_record(rec: dict) -> List[dict]:
             "name": name,
             "cat": "request",
             "ph": "X",  # complete event: ts + dur
-            "pid": 0,
-            "tid": int(rid),
             "ts": round(start_ms * 1000.0, 1),   # microseconds
             "dur": round(dur_ms * 1000.0, 1),
             "args": args,
@@ -71,13 +89,49 @@ def spans_from_record(rec: dict) -> List[dict]:
     return events
 
 
+def _lane_key(rec: dict) -> Tuple[str, str]:
+    host = str(rec.get("host") or "local")
+    return host, str(rec.get("replica", "0"))
+
+
 def chrome_trace(records: Iterable[dict]) -> dict:
-    """Trace-log records -> a Chrome trace-event JSON object."""
+    """Trace-log records -> a Chrome trace-event JSON object with one
+    process lane per (host, replica) and one named thread track per
+    request within its lane."""
     events: List[dict] = []
+    meta: List[dict] = []
+    pids: Dict[Tuple[str, str], int] = {}
+    tids: Dict[Tuple[int, object], int] = {}
     for rec in records:
-        events.extend(spans_from_record(rec))
+        evs = spans_from_record(rec)
+        if not evs:
+            continue
+        lane = _lane_key(rec)
+        pid = pids.get(lane)
+        if pid is None:
+            pid = pids[lane] = len(pids) + 1
+            host, replica = lane
+            meta.append({
+                "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+                "args": {"name": f"{host} · replica {replica}"},
+            })
+        track = rec.get("rid", rec.get("span_id", 0))
+        tkey = (pid, track)
+        tid = tids.get(tkey)
+        if tid is None:
+            tid = tids[tkey] = sum(
+                1 for (p, _t) in tids if p == pid
+            ) + 1
+            meta.append({
+                "name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
+                "args": {"name": f"req {track}"},
+            })
+        for e in evs:
+            e["pid"] = pid
+            e["tid"] = tid
+        events.extend(evs)
     return {
-        "traceEvents": events,
+        "traceEvents": meta + events,
         "displayTimeUnit": "ms",
         "otherData": {"source": "shifu_tpu trace export"},
     }

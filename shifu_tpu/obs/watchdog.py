@@ -156,6 +156,26 @@ class SLOWatchdog:
                     f"queue depth {q} > budget {cfg.max_queue_depth}"
                 )
                 self._c_breach.labels(budget="max_queue_depth").inc()
+        if engine is not None and cfg.p99_ttft_ms is not None:
+            # Fleet-pooled view: a router exposes federated_quantile
+            # (the pooled shifu_fleet_agg_* histogram from its last
+            # /metrics federation scrape). The router's OWN latency
+            # window only sees requests routed through THIS router;
+            # the pooled histogram sees each backend's whole history,
+            # so the same TTFT budget also guards the aggregate.
+            fed = getattr(engine, "federated_quantile", None)
+            if callable(fed):
+                try:
+                    q = fed("shifu_request_ttft_seconds", 0.99)
+                except Exception:  # noqa: BLE001 — scrape-shaped input
+                    q = None
+                if q is not None and q * 1000.0 > cfg.p99_ttft_ms:
+                    reasons.append(
+                        f"fleet pooled p99 TTFT {q * 1000.0:.1f} ms > "
+                        f"budget {cfg.p99_ttft_ms:g} ms (federated "
+                        "histogram)"
+                    )
+                    self._c_breach.labels(budget="fleet_ttft").inc()
         if cfg.max_step_ms is not None:
             durs = [
                 e["dur_ms"]

@@ -1,0 +1,311 @@
+"""disttrace unit surface: trace-context mint/parse/propagate, the
+NTP-style probe clock alignment, the bounded span store, cross-host
+trace merging (lane per (host, replica)), /metrics federation math,
+pooled-histogram quantiles, the check-docs drift gate, and the
+per-request tracing cost budget."""
+
+import json
+import math
+import time
+
+import pytest
+
+from shifu_tpu.obs import MetricsRegistry, parse_exposition
+from shifu_tpu.obs import disttrace as dt
+from shifu_tpu.obs.docscheck import check_docs
+from shifu_tpu.obs.trace import chrome_trace
+
+
+# ------------------------------------------------------------ context
+
+
+def test_mint_shapes_and_header_roundtrip():
+    ctx = dt.mint()
+    assert len(ctx.trace_id) == 32 and len(ctx.span_id) == 16
+    assert ctx.parent_id == ""
+    back = dt.parse_header(ctx.to_header())
+    assert back == ctx
+    child = ctx.child()
+    assert child.trace_id == ctx.trace_id
+    assert child.parent_id == ctx.span_id
+    assert child.span_id != ctx.span_id
+    back2 = dt.parse_header(child.to_header())
+    assert back2 == child
+    d = child.to_dict()
+    assert d["trace_id"] == ctx.trace_id
+    assert d["parent_id"] == ctx.span_id
+
+
+@pytest.mark.parametrize("bad", [
+    None, "", "zz-yy", "abc", "a-b-c-d", "ABCDEF-123456!",
+    "deadbeef-" + "f" * 40, 42,
+])
+def test_parse_header_rejects_malformed(bad):
+    assert dt.parse_header(bad) is None
+
+
+def test_ensure_context_adopts_or_mints():
+    ctx = dt.mint()
+    assert dt.ensure_context(ctx.to_header()) == ctx
+    minted = dt.ensure_context("not a header")
+    assert minted.trace_id != ctx.trace_id
+    assert dt.parse_header(minted.to_header()) == minted
+    # Uppercase wire form is normalised, not rejected.
+    up = dt.ensure_context(ctx.to_header().upper())
+    assert up == ctx
+
+
+# ----------------------------------------------------- clock alignment
+
+
+def test_probe_offset_midpoint_and_bound():
+    # Remote wall stamped at our 150ms midpoint of [100, 200] reads
+    # 5150 -> offset 5000ms, wrong by at most rtt/2 = 50ms.
+    off, err = dt.probe_offset(100.0, 200.0, 5150.0)
+    assert off == 5000.0
+    assert err == 50.0
+    off, err = dt.probe_offset(100.0, 100.0, 100.0)
+    assert (off, err) == (0.0, 0.0)
+
+
+def test_clocksync_min_rtt_sample_wins():
+    cs = dt.ClockSync()
+    assert cs.offset("b1") == (0.0, math.inf)  # never probed
+    cs.note("b1", 0.0, 100.0, 1050.0)          # err 50
+    cs.note("b1", 0.0, 400.0, 1400.0)          # err 200: looser, kept out
+    off, err = cs.offset("b1")
+    assert err == 50.0 and off == 1000.0
+    cs.note("b1", 0.0, 10.0, 2005.0)           # err 5: tighter, wins
+    off, err = cs.offset("b1")
+    assert err == 5.0 and off == 2000.0
+    cs.note("b1", 0.0, 0.0, "not-a-clock")     # junk wall: ignored
+    assert cs.offset("b1") == (2000.0, 5.0)
+    assert cs.offset("b2") == (0.0, math.inf)  # peers independent
+
+
+# ---------------------------------------------------------- span store
+
+
+def test_span_store_bounds_traces_and_spans():
+    store = dt.SpanStore(max_traces=3, max_spans=2)
+    for i in range(5):
+        store.add(f"t{i}", {"kind": "hop", "i": i})
+    assert len(store) == 3
+    assert store.get("t0") == [] and store.get("t1") == []
+    assert store.get("t4") == [{"kind": "hop", "i": 4}]
+    for j in range(10):
+        store.add("t4", {"kind": "retry", "j": j})
+    assert len(store.get("t4")) == 2  # span cap holds under retry storms
+    store.add("", {"kind": "orphan"})
+    store.add(None, {"kind": "orphan"})
+    assert len(store) == 3  # no empty-id trace created
+
+
+def test_span_record_shape():
+    ctx = dt.mint()
+    rec = dt.span_record("resubmit", ctx, 12.5, -3.0, backend="b:1")
+    assert rec["kind"] == "resubmit"
+    assert rec["dur_ms"] == 0.0  # negative durations clamp
+    assert rec["trace_id"] == ctx.trace_id
+    assert rec["backend"] == "b:1"
+    bare = dt.span_record("hop", None, 1.0, 2.0)
+    assert "trace_id" not in bare
+
+
+# --------------------------------------------------------- trace merge
+
+
+def test_merge_host_docs_aligns_clocks_and_lanes():
+    tid = "ab" * 16
+    # Router doc: mono 1000 pairs with wall 500_000, already on the
+    # collector's clock (offset 0). Its hop span starts at mono 100
+    # -> collector wall 499_100.
+    router_doc = {
+        "host": "router-host", "replica": "router",
+        "mono_now_ms": 1000.0, "wall_now_ms": 500_000.0,
+        "offset_ms": 0.0, "err_ms": 0.0,
+        "records": [
+            dt.span_record("router_hop",
+                           dt.TraceContext(tid, "aa" * 8),
+                           100.0, 500.0, rid=7),
+            dt.span_record("router_hop",
+                           dt.TraceContext("ff" * 16, "bb" * 8),
+                           300.0, 1.0, rid=8),  # other trace: filtered
+        ],
+    }
+    # Backend doc: its wall clock reads 100_000ms AHEAD of the
+    # collector's (offset_ms = remote - collector). Record at its mono
+    # 1500 -> its wall 599_500 -> collector wall 499_500.
+    backend_doc = {
+        "host": "b1", "replica": "0",
+        "mono_now_ms": 2000.0, "wall_now_ms": 600_000.0,
+        "offset_ms": 100_000.0, "err_ms": 4.0,
+        "records": [{
+            "rid": 7, "trace_id": tid, "span_id": "cc" * 8,
+            "t0_ms": 1500.0, "queue_ms": 10.0, "prefill_ms": 20.0,
+            "ttft_ms": 30.0, "decode_ms": 40.0,
+        }],
+    }
+    trace = dt.merge_host_docs(
+        [router_doc, backend_doc, "junk"], trace_id=tid)
+    assert trace["otherData"]["trace_id"] == tid
+    assert trace["otherData"]["hosts"] == ["router-host", "b1"]
+    assert trace["otherData"]["align_err_ms"] == 4.0
+    evs = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    by_name = {e["name"]: e for e in evs}
+    # One hop + the engine triple survive the trace filter.
+    assert set(by_name) == {"router_hop", "queue", "prefill", "decode"}
+    assert by_name["router_hop"]["ts"] == pytest.approx(499_100e3)
+    assert by_name["queue"]["ts"] == pytest.approx(499_500e3)
+    # Backend span sits inside the router hop once clocks align.
+    hop = by_name["router_hop"]
+    assert hop["ts"] < by_name["queue"]["ts"]
+    assert by_name["decode"]["ts"] + by_name["decode"]["dur"] \
+        <= hop["ts"] + hop["dur"]
+    # Two process lanes, one per (host, replica).
+    assert {e["pid"] for e in evs} == {1, 2}
+    names = [e["args"]["name"] for e in trace["traceEvents"]
+             if e["ph"] == "M" and e["name"] == "process_name"]
+    assert names == ["router-host · replica router", "b1 · replica 0"]
+
+
+def test_lane_per_host_replica_even_with_same_rid():
+    # Satellite: two records sharing rid=1 from different replicas /
+    # hosts must land in distinct process lanes, not one track.
+    recs = [
+        {"rid": 1, "host": "h1", "replica": "0", "kind": "hop",
+         "t0_ms": 0.0, "dur_ms": 1.0},
+        {"rid": 1, "host": "h1", "replica": "1", "kind": "hop",
+         "t0_ms": 0.0, "dur_ms": 1.0},
+        {"rid": 1, "host": "h2", "replica": "0", "kind": "hop",
+         "t0_ms": 0.0, "dur_ms": 1.0},
+    ]
+    trace = chrome_trace(recs)
+    evs = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    assert len({(e["pid"], e["tid"]) for e in evs}) == 3
+    assert len({e["pid"] for e in evs}) == 3
+
+
+# ----------------------------------------------------------- federation
+
+
+def _backend_registry(completed, ttft_values):
+    reg = MetricsRegistry()
+    c = reg.counter("shifu_requests_completed_total", "done", ("replica",))
+    c.labels(replica="0").inc(completed)
+    h = reg.histogram("shifu_request_ttft_seconds", "ttft",
+                      buckets=(0.01, 0.1, 1.0))
+    for v in ttft_values:
+        h.observe(v)
+    # A pre-federated family must NOT be re-aggregated.
+    reg.counter(dt.AGG_PREFIX + "requests_completed_total", "agg").inc(99)
+    return reg
+
+
+def test_federate_sums_counters_and_pools_histograms():
+    a = _backend_registry(3, [0.005, 0.05])
+    b = _backend_registry(7, [0.5, 0.5, 2.0])
+    parsed = {
+        "10.0.0.1:8000": parse_exposition(a.render()),
+        "10.0.0.2:8000": parse_exposition(b.render()),
+    }
+    text, pooled = dt.federate(parsed)
+    # Acceptance criterion: the federated text itself parses under the
+    # exposition parser, and pooled totals = sum of per-backend totals.
+    fed = parse_exposition(text)
+    agg = "shifu_fleet_agg_requests_completed_total"
+
+    def val(labels):
+        return fed[(agg, frozenset(labels.items()))]
+
+    assert val({"replica": "0"}) == 10
+    assert val({"replica": "0", "backend": "10.0.0.1:8000"}) == 3
+    assert val({"replica": "0", "backend": "10.0.0.2:8000"}) == 7
+    # Double-count guard: the backends' own agg families were skipped.
+    assert not any(n == dt.AGG_PREFIX + "fleet_agg_requests_completed_total"
+                   for (n, _l) in fed)
+    # Histogram buckets pooled per le edge (cumulative sums are exact).
+    hb = "shifu_fleet_agg_request_ttft_seconds_bucket"
+    assert pooled[(hb, frozenset([("le", "0.1")]))] == 2
+    assert pooled[(hb, frozenset([("le", "+Inf")]))] == 5
+    assert pooled[("shifu_fleet_agg_request_ttft_seconds_count",
+                   frozenset())] == 5
+
+
+def test_quantile_from_pooled():
+    a = _backend_registry(0, [0.005] * 50)
+    b = _backend_registry(0, [0.5] * 50)
+    parsed = {
+        "x:1": parse_exposition(a.render()),
+        "y:1": parse_exposition(b.render()),
+    }
+    _, pooled = dt.federate(parsed)
+    med = dt.quantile_from_pooled(pooled, "shifu_request_ttft_seconds", 0.5)
+    p99 = dt.quantile_from_pooled(pooled, "shifu_request_ttft_seconds", 0.99)
+    assert med is not None and med <= 0.1
+    assert p99 is not None and 0.1 < p99 <= 1.0
+    assert dt.quantile_from_pooled(pooled, "shifu_no_such", 0.5) is None
+
+
+# ----------------------------------------------------------- check-docs
+
+
+def test_check_docs_repo_is_clean():
+    import shifu_tpu
+    import os
+    pkg = os.path.dirname(os.path.abspath(shifu_tpu.__file__))
+    doc = os.path.join(os.path.dirname(pkg), "docs", "observability.md")
+    ok, report = check_docs(pkg, doc)
+    assert ok, json.dumps(report, indent=2)
+
+
+def test_check_docs_flags_drift_both_ways(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "m.py").write_text(
+        'FAM = "shifu_new_thing_total"\n'
+        'DYN = f"shifu_tier_{0}_total"\n'
+    )
+    doc = tmp_path / "doc.md"
+    doc.write_text("Only `shifu_ghost_total` and `shifu_tier_hot_total` "
+                   "are mentioned here.")
+    ok, report = check_docs(str(pkg), str(doc))
+    assert not ok
+    assert [u["family"] for u in report["undocumented"]] == \
+        ["shifu_new_thing_total"]
+    assert report["unknown"] == ["shifu_ghost_total"]
+    # Fix the doc -> clean.
+    doc.write_text("`shifu_new_thing_total` and the `shifu_tier_*_total` "
+                   "family, e.g. `shifu_tier_hot_total`.")
+    ok, report = check_docs(str(pkg), str(doc))
+    assert ok, json.dumps(report, indent=2)
+
+
+def test_check_docs_cli_gate(capsys):
+    from shifu_tpu.cli import main
+    assert main(["obs", "check-docs"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["ok"] is True
+
+
+# ------------------------------------------------------- cost budget
+
+
+def test_tracing_overhead_budget():
+    """The full per-request tracing bundle (parse/mint, child header,
+    span record, store add) must stay far inside the <2% instrumentation
+    budget — requests are ms-scale, so budget microseconds per op."""
+    store = dt.SpanStore()
+    hdr = dt.mint().to_header()
+    n = 2000
+    best = math.inf
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for i in range(n):
+            ctx = dt.ensure_context(hdr)
+            ctx.child().to_header()
+            store.add(ctx.trace_id,
+                      dt.span_record("router_hop", ctx, 0.0, 1.0, rid=i))
+        best = min(best, (time.perf_counter() - t0) / n)
+    # 50µs per request: <0.5% of even a 10ms request.
+    assert best < 50e-6, f"tracing bundle cost {best * 1e6:.1f}µs/req"

@@ -29,11 +29,12 @@ from shifu_tpu.fleet import (
 )
 from shifu_tpu.infer import make_server
 from shifu_tpu.obs import FlightRecorder, MetricsRegistry, parse_exposition
+from shifu_tpu.obs import disttrace as dt
 
 _HELPER = os.path.join(os.path.dirname(__file__), "_fleet_backend.py")
 
 
-def _spawn_backend(max_slots=2, step_delay=0.05):
+def _spawn_backend(max_slots=2, step_delay=0.05, extra_env=None):
     env = dict(
         os.environ,
         PALLAS_AXON_POOL_IPS="",
@@ -43,6 +44,7 @@ def _spawn_backend(max_slots=2, step_delay=0.05):
         # kill/cancel/drain races these tests stage (the tiny model
         # would otherwise finish whole requests in milliseconds).
         FLEET_BACKEND_STEP_DELAY=str(step_delay),
+        **(extra_env or {}),
     )
     proc = subprocess.Popen(
         [sys.executable, _HELPER],
@@ -308,6 +310,124 @@ def test_drainz_finishes_inflight_and_routes_no_new_work(routed):
     )
     assert row0["status"] == "detached"
     assert _get(base, "/healthz")["status"] == "ok"
+
+
+def _post_traced(base, obj, trace_header=None, timeout=120):
+    """POST /v1/completions returning (status, body, echoed trace
+    header) — the trace tests need the response headers, which _post
+    drops."""
+    headers = {"Content-Type": "application/json"}
+    if trace_header:
+        headers[dt.HEADER] = trace_header
+    req = urllib.request.Request(
+        base + "/v1/completions", data=json.dumps(obj).encode(),
+        headers=headers, method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read()), r.headers.get(dt.HEADER)
+
+
+def test_fleet_trace_merges_one_chrome_trace_across_processes(routed):
+    """The distributed-tracing acceptance walk: one request through the
+    live router front-end -> `/tracez` on the router -> ONE merged
+    Chrome trace with router and backend spans (router_hop + queue/
+    prefill/decode) in separate process lanes, all under the caller's
+    trace_id, with a finite clock-alignment bound."""
+    base, router = routed
+    # Seed clock offsets the way build_fleet does (the test router is
+    # hand-built, so the prober's first interval hasn't run yet).
+    for b in router.backends:
+        router.probe_backend(b)
+    ctx = dt.mint()
+    status, out, echoed = _post_traced(
+        base, {"tokens": [3, 1, 4], "max_new_tokens": 6},
+        trace_header=ctx.to_header(),
+    )
+    assert status == 200
+    # The caller's trace id survives into timing AND the echo header.
+    assert out["timing"]["trace_id"] == ctx.trace_id
+    assert echoed is not None
+    assert dt.parse_header(echoed).trace_id == ctx.trace_id
+    trace = dt.fetch_and_merge(base, ctx.trace_id)
+    evs = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    assert evs, "merged trace is empty"
+    # One trace id across every span.
+    assert {e["args"].get("trace_id") for e in evs} == {ctx.trace_id}
+    # >= 4 span kinds: the router hop plus the backend engine triple.
+    kinds = {e["name"] for e in evs}
+    assert {"router_hop", "queue", "prefill", "decode"} <= kinds
+    # >= 2 process lanes: the router process and the backend process
+    # are different hosts (host:pid labels).
+    assert len({e["pid"] for e in evs}) >= 2
+    lanes = [e["args"]["name"] for e in trace["traceEvents"]
+             if e["ph"] == "M" and e["name"] == "process_name"]
+    assert any("router" in n for n in lanes), lanes
+    assert trace["otherData"]["trace_id"] == ctx.trace_id
+    # The probe seeded a real (finite) alignment bound.
+    err = trace["otherData"]["align_err_ms"]
+    assert 0.0 <= err < 10_000.0
+    # Federation rides the same front-end: the router's /metrics
+    # carries pooled shifu_fleet_agg_* equal to the per-backend sum.
+    with urllib.request.urlopen(base + "/metrics", timeout=30) as r:
+        samples = parse_exposition(r.read().decode())
+    agg = "shifu_fleet_agg_requests_completed_total"
+    pooled = sum(
+        v for (n, ls), v in samples.items()
+        if n == agg and "backend" not in dict(ls)
+    )
+    per_backend = sum(
+        v for (n, ls), v in samples.items()
+        if n == agg and "backend" in dict(ls)
+    )
+    assert pooled >= 1
+    assert pooled == per_backend
+
+
+def test_fleet_resubmit_keeps_trace_id():
+    """A request whose first backend dies mid-dispatch is resubmitted
+    under the SAME trace_id, and the merged trace shows the resubmit
+    span next to the surviving backend's spans."""
+    faulty, faulty_addr = _spawn_backend(
+        extra_env={"FLEET_BACKEND_FAULT_DROP_NTH": "1"})
+    good, good_addr = _spawn_backend()
+    server = None
+    t = None
+    try:
+        # Faulty backend first: both idle -> the router picks the
+        # lowest index, so the first completion hits the drop hook.
+        router = _make_router([faulty_addr, good_addr])
+        server = make_server(router, port=0)
+        t = threading.Thread(target=server.serve_forever, daemon=True)
+        t.start()
+        base = f"http://127.0.0.1:{server.server_port}"
+        ctx = dt.mint()
+        status, out, _ = _post_traced(
+            base, {"tokens": [2, 7, 1], "max_new_tokens": 5},
+            trace_header=ctx.to_header(),
+        )
+        assert status == 200
+        assert out["timing"]["trace_id"] == ctx.trace_id
+        assert router.fleet_stats()["resubmissions"] >= 1
+        trace = dt.fetch_and_merge(base, ctx.trace_id)
+        evs = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+        kinds = {e["name"] for e in evs}
+        assert "resubmit" in kinds, kinds
+        assert {"router_hop", "queue", "prefill", "decode"} <= kinds
+        resub = [e for e in evs if e["name"] == "resubmit"]
+        assert all(
+            e["args"].get("trace_id") == ctx.trace_id for e in resub
+        )
+    finally:
+        if server is not None:
+            server.shutdown()
+            server.runner.shutdown()
+        if t is not None:
+            t.join(5)
+        for p in (faulty, good):
+            if p.poll() is None:
+                p.send_signal(signal.SIGKILL)
+        for p in (faulty, good):
+            p.wait(timeout=10)
 
 
 def test_kill_backend_mid_run_resubmits_and_degrades(backends):

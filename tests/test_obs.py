@@ -219,10 +219,13 @@ def test_chrome_trace_spans_cover_and_do_not_overlap():
         _rec(2, 1010.0, 1.0, 50.0, 9.0, 30.0),
     ])
     events = trace["traceEvents"]
+    # Span events are "X"; lane/track naming rides "M" metadata events.
+    spans_ev = [e for e in events if e["ph"] == "X"]
+    assert all(e["ph"] in ("X", "M") for e in events)
     by_rid = {}
-    for e in events:
-        assert e["ph"] == "X"
+    for e in spans_ev:
         by_rid.setdefault(e["tid"], {})[e["name"]] = e
+    assert len(by_rid) == 2
     for rid, spans in by_rid.items():
         assert set(spans) == {"queue", "prefill", "decode"}
         q, p, d = spans["queue"], spans["prefill"], spans["decode"]
@@ -244,9 +247,16 @@ def test_trace_export_cli(tmp_path):
     rc = main(["trace", "export", "--in", str(log), "--out", str(out)])
     assert rc == 0
     trace = json.loads(out.read_text())
-    assert len(trace["traceEvents"]) == 9  # 3 requests x 3 phases
-    tids = {e["tid"] for e in trace["traceEvents"]}
-    assert tids == {0, 1, 2}
+    spans = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    assert len(spans) == 9  # 3 requests x 3 phases
+    # One shared (host, replica) lane, one thread track per request.
+    assert {e["pid"] for e in spans} == {1}
+    assert {e["tid"] for e in spans} == {1, 2, 3}
+    names = {
+        e["args"]["name"]
+        for e in trace["traceEvents"] if e["ph"] == "M"
+    }
+    assert any(n.startswith("req ") for n in names)
 
 
 # --------------------------------------------------- logger mirroring

@@ -183,10 +183,12 @@ def test_live_dp2_server_metrics_and_trace(tiny, tmp_path):
     ])
     assert rc == 0
     trace = json.loads(out_json.read_text())
-    events = trace["traceEvents"]
+    events = [e for e in trace["traceEvents"] if e["ph"] == "X"]
     by_rid = {}
     for e in events:
-        by_rid.setdefault(e["tid"], {})[e["name"]] = e
+        # Lanes are per (host, replica) — a rid is only unique within
+        # its lane, so the track key is (pid, tid).
+        by_rid.setdefault((e["pid"], e["tid"]), {})[e["name"]] = e
     assert len(by_rid) == n_req  # one track per request
     for rid, spans in by_rid.items():
         # Cover queue -> prefill -> decode, non-overlapping, in order.
