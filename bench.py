@@ -134,6 +134,10 @@ def main(argv=None):
             out["kv_tier"] = bench_kv_tier()
         except Exception as e:
             out["kv_tier"] = {"error": f"{type(e).__name__}: {e}"}
+        try:
+            out["disagg"] = bench_disagg()
+        except Exception as e:
+            out["disagg"] = {"error": f"{type(e).__name__}: {e}"}
     # Runtime self-telemetry in the full ledger: device-memory rollup
     # + how many compiles the bench's engines paid (the obs registry
     # counted them via the engines' tracked programs).
@@ -329,6 +333,12 @@ def _compact(out: dict) -> dict:
         ("kv_restore_x_recompute",
          g("kv_tier", "kv_restore_x_recompute")),
         ("kv_hit_rate", g("kv_tier", "kv_hit_rate")),
+        # prefill/decode disaggregation (round 14): p99 ratios of the
+        # two-host handoff path over the same decode host colocated —
+        # TTFT carries the migration cost, ITL drifting up means the
+        # handoff leaked into steady-state decode
+        ("disagg_x_coloc_ttft", g("disagg", "disagg_x_coloc_ttft")),
+        ("disagg_x_coloc_itl", g("disagg", "disagg_x_coloc_itl")),
         ("fit_unstable", any(
             g(*sv, leg, "fit_unstable") for leg in
             ("bf16", "int8", "int8_kv", "int8_kv_b16s")
@@ -687,6 +697,121 @@ def bench_fleet_routed():
             rsrv.runner.shutdown()
         bsrv.shutdown()
         bsrv.runner.shutdown()
+
+
+def bench_disagg():
+    """Disaggregated vs colocated serving latency at the same load.
+
+    Two small engines with the host KV tier behind one FleetRouter —
+    one advertising ``--role prefill``, one ``--role decode`` — so
+    every eligible request takes the two-host handoff (chunked prefill
+    on the prefill host, SKVP page transfer over /kv/pages, decode on
+    the decode host). The control router drives the SAME decode
+    backend colocated (no prefill-role host in its roster, so the
+    handoff is never attempted). The headline ratios are disagg p99
+    over colocated p99 for TTFT and ITL: TTFT pays the migration
+    (prefill hop + page transfer), ITL should NOT — decode runs on one
+    host either way, so the ITL ratio drifting up means the handoff
+    started leaking cost into steady-state decode."""
+    import threading
+    import urllib.request
+
+    from shifu_tpu.fleet import BackendClient, FleetRouter
+    from shifu_tpu.infer import SampleConfig, make_server
+    from shifu_tpu.infer.engine import PagedEngine
+    from shifu_tpu.models.transformer import Transformer, TransformerConfig
+    from shifu_tpu.obs import FlightRecorder, MetricsRegistry
+
+    cfg = TransformerConfig.small()
+    model = Transformer(cfg)
+    params = model.init(jax.random.key(0))
+    bsrvs = []
+    n_requests, prompt_len, max_new = 8, 96, 16
+    try:
+        for role in ("prefill", "decode"):
+            eng = PagedEngine(
+                model, params, max_slots=4, max_len=256, page_size=16,
+                prefill_buckets=(32, 256), enable_prefix_cache=True,
+                kv_host_bytes=256 << 20,
+                sample_cfg=SampleConfig(temperature=0.0),
+            )
+            srv = make_server(eng, port=0, role=role)
+            threading.Thread(target=srv.serve_forever, daemon=True).start()
+            bsrvs.append(srv)
+
+        def mk_router(addrs, **kw):
+            clients = [BackendClient(a) for a in addrs]
+            for c in clients:
+                c.probe()
+                c.models()
+            router = FleetRouter(
+                clients, metrics=MetricsRegistry(),
+                flight=FlightRecorder(), **kw,
+            )
+            srv = make_server(router, port=0)
+            threading.Thread(target=srv.serve_forever, daemon=True).start()
+            return router, srv
+
+        addrs = [f"127.0.0.1:{s.server_port}" for s in bsrvs]
+        # Disagg roster: prefill + decode roles -> every eligible
+        # request handoffs. Colocated control: the decode backend
+        # alone -> the router never sees a prefill-role host.
+        drouter, dsrv = mk_router(addrs, disagg_min_prompt=32)
+        crouter, csrv = mk_router(addrs[1:])
+        bsrvs.extend([dsrv, csrv])
+
+        def one(srv, i):
+            """-> (ttft_ms, itl_ms) from the router's own timing."""
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.server_port}/v1/completions",
+                data=json.dumps({
+                    "tokens": [(i * 131 + j) % 251 + 1
+                               for j in range(prompt_len)],
+                    "max_new_tokens": max_new,
+                }).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=300) as r:
+                out = json.loads(r.read())
+            t = out["timing"]
+            itl = (t["total_ms"] - t["ttft_ms"]) / max(
+                len(out["tokens"]) - 1, 1
+            )
+            return t["ttft_ms"], itl
+
+        one(dsrv, 0)  # warm both prefill buckets + the handoff path
+        one(csrv, 0)
+        d_ttft, d_itl = zip(*[one(dsrv, 1 + i) for i in range(n_requests)])
+        c_ttft, c_itl = zip(*[one(csrv, 1 + i) for i in range(n_requests)])
+
+        def p99(vals):
+            vals = sorted(vals)
+            return round(vals[min(int(0.99 * len(vals)),
+                                  len(vals) - 1)], 3)
+
+        dc = drouter.counters()
+        assert dc["disagg_handoffs"] > 0, (
+            "disagg bench never took the handoff path", dc
+        )
+        return {
+            "requests": n_requests,
+            "prompt_tokens": prompt_len,
+            "max_new_tokens": max_new,
+            "disagg_handoffs": dc["disagg_handoffs"],
+            "disagg_fallbacks": dc["disagg_fallbacks"],
+            "kv_xfer_bytes_per_ms": dc.get("kv_xfer_bytes_per_ms"),
+            "disagg_p99_ttft_ms": p99(d_ttft),
+            "coloc_p99_ttft_ms": p99(c_ttft),
+            "disagg_p99_itl_ms": p99(d_itl),
+            "coloc_p99_itl_ms": p99(c_itl),
+            "disagg_x_coloc_ttft": round(p99(d_ttft) / p99(c_ttft), 4),
+            "disagg_x_coloc_itl": round(p99(d_itl) / p99(c_itl), 4),
+        }
+    finally:
+        for srv in bsrvs:
+            srv.shutdown()
+            srv.runner.shutdown()
 
 
 def bench_rollout():

@@ -1005,6 +1005,25 @@ def build_serve_engine(args, model, params, tok):
             )
         kv_kw["kv_host_bytes"] = args.kv_host_bytes
 
+    # Disaggregation roles (serve --role, docs/architecture.md). A
+    # prefill host spills each exported request's KV chain into the
+    # host tier for pickup over GET /kv/pages; a decode host ingests
+    # through the same tier. Refuse a role the engine cannot honour AT
+    # STARTUP — not as a failed handoff on the first real request.
+    role = getattr(args, "role", "both") or "both"
+    if role in ("prefill", "decode") and "kv_host_bytes" not in kv_kw:
+        raise ValueError(
+            f"--role {role} migrates KV pages through the host tier, "
+            "which this engine is not running; fix: add --paged "
+            "--prefix-cache --kv-tier host"
+        )
+    if role != "both" and dp > 1:
+        raise ValueError(
+            f"--role {role} needs a single paged engine (dp replicas "
+            "share no page pool); fix: drop dp= from --mesh or use "
+            "--role both"
+        )
+
     def construct(params_r, mesh=None, draft_params_r=None):
         mkw = dict(kw, mesh=mesh) if mesh is not None else kw
         paged_kw = dict(
@@ -1179,6 +1198,7 @@ def cmd_serve(args) -> int:
         ckpt_path=args.ckpt_dir,
         batch_backlog=args.batch_backlog,
         tune_table=args.tune_table,
+        role=getattr(args, "role", "both") or "both",
     )
     print(
         json.dumps(
@@ -1817,6 +1837,15 @@ def main(argv=None) -> int:
                         help="host-tier byte budget (LRU beyond it); "
                              "accepts 512m/4g/… suffixes "
                              "(--kv-tier host only)")
+        sp.add_argument("--role", default="both",
+                        choices=["prefill", "decode", "both"],
+                        help="disaggregation role advertised on "
+                             "/healthz + /v1/models: a fleet router "
+                             "sends prefill-heavy admissions to "
+                             "prefill hosts and migrates their paged "
+                             "KV to decode hosts over /kv/pages "
+                             "(prefill needs --paged --prefix-cache "
+                             "--kv-tier host; docs/architecture.md)")
         sp.add_argument("--mesh",
                         help="serving mesh, e.g. dp=2,tp=2 or "
                              "tp=2,ep=2: tp shards heads/mlp, ep "

@@ -335,17 +335,28 @@ class BackendClient:
         # serving (the rollout controller's rollback anchor).
         self.model_ids: Optional[list] = None
         self.ckpt: Optional[str] = None
+        # Disaggregation role ("prefill" | "decode" | "both"), learned
+        # from /healthz + /v1/models at probe time — the router's
+        # phase-aware scheduling key. "both" until the host says
+        # otherwise (every pre-disagg backend is colocated).
+        self.role: str = "both"
 
     # ------------------------------------------------------------- wire
-    def _request(self, method: str, path: str, body: Optional[dict],
-                 timeout: float):
+    def _request(self, method: str, path: str, body,
+                 timeout: float, headers: Optional[dict] = None):
         conn = http.client.HTTPConnection(
             self.host, self.port, timeout=timeout
         )
-        payload = json.dumps(body).encode() if body is not None else None
-        headers = {"Content-Type": "application/json"} if payload else {}
+        if isinstance(body, (bytes, bytearray)):
+            # Raw frame (the SKVP page payload POST) — not JSON.
+            payload = bytes(body)
+            hdrs = {"Content-Type": "application/octet-stream"}
+        else:
+            payload = json.dumps(body).encode() if body is not None else None
+            hdrs = {"Content-Type": "application/json"} if payload else {}
+        hdrs.update(headers or {})
         try:
-            conn.request(method, path, payload, headers)
+            conn.request(method, path, payload, hdrs)
             resp = conn.getresponse()
         except (OSError, http.client.HTTPException) as e:
             conn.close()
@@ -393,6 +404,8 @@ class BackendClient:
             raise
         self.health = doc
         self.health_ts = time.time()
+        if doc.get("role") in ("prefill", "decode", "both"):
+            self.role = doc["role"]
         self.breaker.record_success()
         return doc
 
@@ -412,6 +425,8 @@ class BackendClient:
                 self.max_len = int(m["max_len"])
             if m.get("ckpt"):
                 self.ckpt = str(m["ckpt"])
+            if m.get("role") in ("prefill", "decode", "both"):
+                self.role = m["role"]
         if ids:
             self.model_ids = ids
         return doc
@@ -473,6 +488,90 @@ class BackendClient:
             "GET", f"/tracez?trace_id={quote(str(trace_id))}", None,
             self.cfg.probe_timeout_s,
         )
+
+    def kv_pages(self, rid: int,
+                 trace_header: Optional[str] = None) -> bytes:
+        """GET /kv/pages?rid= — fetch the SKVP frame a prefill host
+        exported for one of ITS rids (prefill/decode disaggregation).
+        The frame is structurally validated HERE (magic/version/crc via
+        ``deserialize_pages``) so a truncated or bit-flipped transfer
+        surfaces at the fetch, not as a corrupt decode two hops later.
+
+        EVERY failure — unreachable host, 404 (rid expired), 5xx,
+        torn frame — raises a *retryable* :class:`BackendError`: a
+        failed handoff is never fatal to the request, the router just
+        serves it colocated (cold prefill, PR-5 behavior)."""
+        from shifu_tpu.infer.kvtier import (
+            WireFormatError, deserialize_pages,
+        )
+
+        hdrs = {"x-shifu-trace": trace_header} if trace_header else None
+        conn, resp = self._request(
+            "GET", f"/kv/pages?rid={int(rid)}", None,
+            self.cfg.read_timeout_s, headers=hdrs,
+        )
+        try:
+            data = resp.read()
+            if resp.status != 200:
+                msg = data.decode("utf-8", "replace")
+                try:
+                    msg = json.loads(msg).get("error", msg)
+                except ValueError:
+                    pass
+                raise BackendError(
+                    f"backend {self.addr} kv fetch -> {resp.status}: "
+                    f"{msg}", retryable=True, status=resp.status,
+                )
+        except (OSError, http.client.HTTPException) as e:
+            raise BackendError(
+                f"backend {self.addr} kv fetch failed: {e!r}",
+                retryable=True,
+            ) from e
+        finally:
+            conn.close()
+        try:
+            deserialize_pages(data)
+        except WireFormatError as e:
+            raise BackendError(
+                f"backend {self.addr} kv frame rejected: {e}",
+                retryable=True,
+            ) from e
+        return data
+
+    def kv_ingest(self, payload: bytes,
+                  trace_header: Optional[str] = None) -> dict:
+        """POST /kv/pages — hand a fetched SKVP frame to this (decode)
+        host, which deserializes it into its own page pool through the
+        prefix-registration path. The decode host re-verifies the crc
+        and every leaf shape; ANY refusal (400 included) raises a
+        retryable :class:`BackendError` — the router's answer to a
+        failed handoff is always a colocated fallback, never an
+        error."""
+        hdrs = {"x-shifu-trace": trace_header} if trace_header else None
+        conn, resp = self._request(
+            "POST", "/kv/pages", bytes(payload),
+            self.cfg.read_timeout_s, headers=hdrs,
+        )
+        try:
+            data = resp.read()
+            if resp.status != 200:
+                msg = data.decode("utf-8", "replace")
+                try:
+                    msg = json.loads(msg).get("error", msg)
+                except ValueError:
+                    pass
+                raise BackendError(
+                    f"backend {self.addr} kv ingest -> {resp.status}: "
+                    f"{msg}", retryable=True, status=resp.status,
+                )
+            return json.loads(data)
+        except (OSError, ValueError, http.client.HTTPException) as e:
+            raise BackendError(
+                f"backend {self.addr} kv ingest failed: {e!r}",
+                retryable=True,
+            ) from e
+        finally:
+            conn.close()
 
     def open_stream(self, body: dict,
                     headers: Optional[dict] = None) -> _SSEStream:

@@ -223,6 +223,14 @@ def build_fleet(
             router.probe_backend(b)
         except BackendError:
             pass  # the prober keeps retrying dead hosts
+    # The probes above also cached each backend's disaggregation role
+    # (the /healthz + /v1/models "role" field — serve --role). Record
+    # a disaggregated topology once so the flight ring says which
+    # hosts are prefill/decode; the prober keeps the roles fresh the
+    # same way it keeps model ids fresh.
+    roles = {b.addr: FleetRouter._role(b) for b in backends}
+    if any(r != "both" for r in roles.values()):
+        router.flight.record("fleet_roles", roles=roles)
     prober = FleetProber(router, interval_s=probe_interval_s)
     router.prober = prober
     if start_prober:

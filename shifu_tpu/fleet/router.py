@@ -120,6 +120,7 @@ class FleetRouter:
                  metrics=None, flight=None,
                  step_wait_s: float = 0.02,
                  drain_poll_s: float = 0.05,
+                 disagg_min_prompt: int = 64,
                  sleep=time.sleep):
         if not backends:
             raise ValueError("need at least one fleet backend")
@@ -148,6 +149,21 @@ class FleetRouter:
         self.tokens_generated = 0
         self.cancellations = 0
         self.batch_completed = 0  # batch-tier completions (SLO-exempt)
+
+        # Prefill/decode disaggregation. Prompts at/above
+        # ``disagg_min_prompt`` tokens are candidates for the two-host
+        # path (prefill host -> SKVP page transfer -> decode host) when
+        # the roster has a prefill-role backend. The migrate-vs-cold-
+        # prefill breakeven is MEASURED, not assumed: transfer
+        # bytes/ms + bytes/token EMAs (alpha 0.2, the kvtier.py
+        # pattern) against the decode host's own prefill tok/ms from
+        # its last /healthz probe — unmeasured sides explore.
+        self.disagg_min_prompt = int(disagg_min_prompt)
+        self._xfer_bytes_per_ms: Optional[float] = None
+        self._xfer_bytes_per_token: Optional[float] = None
+        self.disagg_handoffs = 0          # handoffs that completed
+        self.disagg_fallbacks = 0         # handoff failed -> colocated
+        self.disagg_breakeven_losses = 0  # wire lost -> never attempted
 
         # Distributed tracing (obs/disttrace.py): the router is a hop —
         # it records router_hop/resubmit spans in its own store, keyed
@@ -223,6 +239,18 @@ class FleetRouter:
             "shifu_fleet_probe_seconds",
             "Backend /healthz scrape latency", labelnames=("backend",),
         )
+        # shifu_disagg_* family: handoff outcomes. All three labels are
+        # pre-seeded so a scrape shows the zero rows before the first
+        # disaggregated request.
+        self._c_disagg = reg.counter(
+            "shifu_disagg_handoffs_total",
+            "Prefill->decode handoff attempts by outcome: ok "
+            "(completed disaggregated), failed (fell back colocated), "
+            "breakeven_loss (wire predicted slower than a cold "
+            "prefill — never attempted)", labelnames=("outcome",),
+        )
+        for oc in ("ok", "failed", "breakeven_loss"):
+            self._c_disagg.labels(outcome=oc)
         # shifu_rollout_* families: rolling-weight-rollout progress as
         # reported by the rollout controller via POST /rolloutz
         # (rollout_note). The controller may be a separate process —
@@ -302,6 +330,10 @@ class FleetRouter:
             )
 
     # ---------------------------------------------------------- routing
+    @staticmethod
+    def _role(b: BackendClient) -> str:
+        return getattr(b, "role", "both") or "both"
+
     def _pick(self, exclude=(),
               model: Optional[str] = None) -> Optional[BackendClient]:
         """Least-loaded routable backend: fewest router-local in-flight
@@ -312,10 +344,32 @@ class FleetRouter:
         :meth:`submit`, so None here means "serving subset currently
         unavailable" (503), not 404. Consults ``breaker.allow()`` LAST
         and only on the winner-candidates, since allow() consumes the
-        half-open probe slot."""
+        half-open probe slot.
+
+        Roles are advisory, not partitions: colocated work AVOIDS
+        prefill-role hosts (they sort last — their chip belongs to
+        TTFT) but may still land there when nothing else is routable,
+        so a decode-host outage degrades to slow instead of down."""
         order = sorted(
             (b for b in self.backends
              if b.routable() and b.addr not in exclude
+             and (model is None or model in (b.model_ids or ()))),
+            key=lambda b: (self._role(b) == "prefill", b.in_flight,
+                           b.queue_depth(), self.backends.index(b)),
+        )
+        for b in order:
+            if b.breaker.allow():
+                return b
+        return None
+
+    def _pick_role(self, roles, exclude=(),
+                   model: Optional[str] = None) -> Optional[BackendClient]:
+        """``_pick`` restricted to backends whose probed role is in
+        ``roles`` — the disaggregated path's phase-aware selection."""
+        order = sorted(
+            (b for b in self.backends
+             if b.routable() and b.addr not in exclude
+             and self._role(b) in roles
              and (model is None or model in (b.model_ids or ()))),
             key=lambda b: (b.in_flight, b.queue_depth(),
                            self.backends.index(b)),
@@ -331,7 +385,8 @@ class FleetRouter:
                logit_bias=None, allowed_token_ids=None, adapter=None,
                regex=None, json_schema=None, model=None,
                tier: str = "interactive",
-               trace: Optional[dict] = None, **kw) -> int:
+               trace: Optional[dict] = None,
+               kv_export: bool = False, **kw) -> int:
         """Route one request (engine-thread call — no HTTP here).
         Raises :class:`FleetUnavailable` when no backend is routable,
         so a fully-down fleet fails fast instead of queueing forever.
@@ -352,6 +407,15 @@ class FleetRouter:
         its merged timeline without opting in."""
         if kw:
             raise ValueError(f"unsupported submit fields: {sorted(kw)}")
+        if kv_export:
+            # The export verb belongs to a PREFILL HOST's engine (the
+            # router is the one doing the fetching); accepting it here
+            # would promise a /kv/pages payload this process cannot
+            # serve.
+            raise ValueError(
+                "kv_export is a backend-engine field — the fleet "
+                "router initiates handoffs itself, it does not export"
+            )
         if model is not None:
             model = str(model)
             known = {
@@ -451,6 +515,16 @@ class FleetRouter:
             ))
 
     def _route_one_inner(self, req: _FleetRequest) -> None:
+        # Disaggregated fast path first: a prefill-heavy admission with
+        # a prefill-role host available tries the two-host handoff.
+        # _try_disagg returning True means the request is FINISHED
+        # (completed disaggregated, or failed unretryably); False falls
+        # through to the ordinary colocated loop below — a dead
+        # prefill host or a losing breakeven degrades to exactly the
+        # pre-disagg behavior.
+        if self._disagg_eligible(req):
+            if self._try_disagg(req):
+                return
         attempt = 0
         while True:
             if req.cancelled:
@@ -505,17 +579,27 @@ class FleetRouter:
             self._sleep(self.policy.delay(attempt))
             attempt += 1
 
-    def _run_stream(self, req: _FleetRequest,
-                    b: BackendClient) -> Optional[BackendError]:
+    def _run_stream(self, req: _FleetRequest, b: BackendClient, *,
+                    body: Optional[dict] = None,
+                    prepend=None) -> Optional[BackendError]:
         """One attempt on one backend. Returns None on success (or
         deliberate cancel), else the failure. Breaker bookkeeping
-        happens here — success closes, failure counts toward a trip."""
+        happens here — success closes, failure counts toward a trip.
+
+        ``body`` overrides ``req.body`` on the wire (the disaggregated
+        decode leg sends prompt+t1 with one fewer token of budget);
+        ``prepend`` = ``(tokens, logprobs)`` already produced upstream
+        (the prefill host's t1) — spliced into ``req.generated`` at the
+        FIRST delta, not before, so a failure before any decode token
+        leaves the request pristine for the colocated retry."""
         try:
             headers = (
                 {_dtrace.HEADER: req.trace.child().to_header()}
                 if req.trace is not None else None
             )
-            stream = b.open_stream(req.body, headers=headers)
+            stream = b.open_stream(
+                body if body is not None else req.body, headers=headers
+            )
         except BackendError as e:
             if e.retryable:
                 b.breaker.record_failure()
@@ -549,6 +633,10 @@ class FleetRouter:
                 if ids:
                     if not req.streamed:
                         req.first_tok_at = time.monotonic()
+                        if prepend:
+                            req.generated.extend(prepend[0])
+                            if prepend[1]:
+                                req.logprobs.extend(prepend[1])
                     req.streamed = True
                     req.generated.extend(int(t) for t in ids)
                     lps = ev.get("logprobs")
@@ -575,9 +663,20 @@ class FleetRouter:
                 retryable=True,
             )
         b.breaker.record_success()
+        npre = len(prepend[0]) if prepend else 0
+        self._complete_from(req, b, final, npre=npre)
+        return None
+
+    def _complete_from(self, req: _FleetRequest, b: BackendClient,
+                       final: dict, npre: int = 0) -> None:
+        """Close out a successfully streamed request: refund the retry
+        budget, cut ``generated`` at the definitive token count, record
+        timing + the router_hop span, and finish. ``npre`` = tokens
+        spliced in from upstream (the disaggregated prefill host's t1)
+        that the backend's own ``n_tokens`` does not count."""
         self.policy.refund()
         self._g_budget.set(self.policy.budget)
-        n = int(final.get("n_tokens", len(req.generated)))
+        n = int(final.get("n_tokens", len(req.generated) - npre)) + npre
         toks = list(req.generated[:n])
         lps = list(req.logprobs[:n]) if req.logprobs else None
         now = time.monotonic()
@@ -629,7 +728,231 @@ class FleetRouter:
             finished_by=str(final.get("finished_by", "length")),
             logprobs=lps, timing=timing,
         ), None)
-        return None
+
+    # ------------------------------------- prefill/decode disaggregation
+    def _disagg_eligible(self, req: _FleetRequest) -> bool:
+        """Is this request worth a two-host handoff at all? Needs a
+        prefill-heavy prompt (>= disagg_min_prompt tokens), a decode
+        phase to migrate INTO (max_new >= 2), and a prefill-role host
+        in the roster. Constrained decoding (regex/json_schema) and
+        string stop sequences are excluded: their matcher state spans
+        the prefill/decode boundary, and splitting would change where
+        they fire relative to the colocated run — parity first."""
+        body = req.body
+        if body.get("regex") or body.get("json_schema") or body.get("stop"):
+            return False
+        if len(body.get("tokens") or ()) < self.disagg_min_prompt:
+            return False
+        if int(body.get("max_new_tokens", 0)) < 2:
+            return False
+        return any(
+            self._role(b) == "prefill" and b.routable()
+            for b in self.backends
+        )
+
+    def _disagg_wins(self, p_tokens: int,
+                     dec: BackendClient) -> bool:
+        """Measured migrate-vs-cold-prefill breakeven: predicted
+        transfer time (prompt tokens x bytes/token EMA / bytes/ms EMA)
+        against the decode host recomputing the prefill itself (its
+        ``prefill_tok_per_ms`` from the last /healthz probe). Any side
+        unmeasured -> True (explore — the EMAs need a sample before
+        the comparison means anything; same policy as the host-tier
+        restore-vs-recompute gate in infer/kvtier.py)."""
+        bpm, bpt = self._xfer_bytes_per_ms, self._xfer_bytes_per_token
+        rate = None
+        if dec.health:
+            try:
+                r = dec.health.get("prefill_tok_per_ms")
+                rate = float(r) if r else None
+            except (TypeError, ValueError):
+                rate = None
+        if not bpm or not bpt or not rate:
+            return True
+        xfer_ms = (p_tokens * bpt) / bpm
+        prefill_ms = p_tokens / rate
+        return xfer_ms < prefill_ms
+
+    def _note_xfer(self, nbytes: int, ms: float, tokens: int) -> None:
+        """Fold one measured KV transfer (fetch + ingest wall time)
+        into the breakeven EMAs (alpha 0.2, the kvtier.py pattern)."""
+        if ms <= 0.0 or tokens <= 0 or nbytes <= 0:
+            return
+        a = 0.2
+        bpm, bpt = nbytes / ms, nbytes / float(tokens)
+        self._xfer_bytes_per_ms = (
+            bpm if self._xfer_bytes_per_ms is None
+            else (1 - a) * self._xfer_bytes_per_ms + a * bpm
+        )
+        self._xfer_bytes_per_token = (
+            bpt if self._xfer_bytes_per_token is None
+            else (1 - a) * self._xfer_bytes_per_token + a * bpt
+        )
+
+    def _try_disagg(self, req: _FleetRequest) -> bool:
+        """One disaggregated attempt. True = the request is FINISHED
+        (completed, or failed in a way the client must see); False =
+        untouched (or cleanly rolled back) — the caller's colocated
+        loop takes over. Handoff failure before the first decode token
+        spends the ordinary retry budget and records a resubmit span,
+        so a dead prefill host degrades to PR-5 colocated behavior
+        with ``resubmissions`` counting the fallback."""
+        pre = self._pick_role(("prefill",), model=req.model)
+        if pre is None:
+            return False
+        dec = self._pick_role(("decode", "both"), exclude=(pre.addr,),
+                              model=req.model)
+        if dec is None:
+            return False
+        p_tokens = len(req.body.get("tokens") or ())
+        if not self._disagg_wins(p_tokens, dec):
+            with self._lock:
+                self.disagg_breakeven_losses += 1
+            self._c_disagg.labels(outcome="breakeven_loss").inc()
+            return False
+        att0 = time.monotonic()
+        err = self._run_disagg(req, pre, dec)
+        if err is None:
+            with self._lock:
+                self.disagg_handoffs += 1
+            self._c_disagg.labels(outcome="ok").inc()
+            return True
+        with self._lock:
+            self.disagg_fallbacks += 1
+        self._c_disagg.labels(outcome="failed").inc()
+        self._c_failures.labels(backend=pre.addr).inc()
+        if req.streamed or not err.retryable:
+            # Decode tokens already left the router, or a validation
+            # rejection — same terminal contract as the colocated path.
+            self._finish(req, None, ValueError(str(err))
+                         if not err.retryable else err)
+            return True
+        if not self.policy.spend():
+            self._g_budget.set(self.policy.budget)
+            self._finish(req, None, FleetUnavailable(
+                f"retry budget exhausted after handoff failure: {err}",
+                retry_after_s=max(1.0, self.policy.cap_s),
+            ))
+            return True
+        self._g_budget.set(self.policy.budget)
+        pre.retries += 1
+        self._c_retries.labels(backend=pre.addr).inc()
+        with self._lock:
+            self.resubmissions += 1
+        if req.trace is not None:
+            now = time.monotonic()
+            self._span_store.add(req.trace.trace_id, _dtrace.span_record(
+                "resubmit", req.trace, att0 * 1000.0,
+                (now - att0) * 1000.0, rid=req.rid, backend=pre.addr,
+                error=str(err), attempt=0, phase="disagg",
+            ))
+        self._sleep(self.policy.delay(0))
+        return False
+
+    def _run_disagg(self, req: _FleetRequest, pre: BackendClient,
+                    dec: BackendClient) -> Optional[BackendError]:
+        """The handoff itself: (1) prefill leg — the full body with
+        ``max_new_tokens: 1`` + ``kv_export: true`` on the prefill
+        host, buffering t1 WITHOUT touching ``req.generated``; (2) the
+        transfer — ``GET /kv/pages?rid=`` off the prefill host, relayed
+        into the decode host's ``POST /kv/pages`` (one timed unit, the
+        breakeven EMAs' sample); (3) decode leg — prompt+t1 with
+        max_new-1 on the decode host, whose admission finds the
+        ingested pages through the ordinary prefix-cache path (the PR 9
+        parity contract, extended over the wire). The x-shifu-trace
+        child rides every hop, so both hosts' kv_migrate spans land in
+        one merged trace."""
+        trace_hdr = (req.trace.child().to_header()
+                     if req.trace is not None else None)
+        headers = {_dtrace.HEADER: trace_hdr} if trace_hdr else None
+        pbody = dict(req.body)
+        pbody["max_new_tokens"] = 1
+        pbody["kv_export"] = True
+        toks: List[int] = []
+        lps: List[float] = []
+        pre_final: Optional[dict] = None
+        payload = None
+        x0 = None
+        self._attach(req, pre)
+        try:
+            try:
+                stream = pre.open_stream(pbody, headers=headers)
+            except BackendError as e:
+                if e.retryable:
+                    pre.breaker.record_failure()
+                return e
+            try:
+                for ev in stream:
+                    if "error" in ev:
+                        return BackendError(
+                            str(ev["error"]),
+                            retryable=bool(ev.get("retryable", False)),
+                        )
+                    if "finished_by" in ev:
+                        pre_final = ev
+                        continue
+                    ids = ev.get("tokens")
+                    if ids:
+                        toks.extend(int(t) for t in ids)
+                        l = ev.get("logprobs")
+                        if l:
+                            lps.extend(float(x) for x in l)
+            except BackendError as e:
+                pre.breaker.record_failure()
+                return e
+            if pre_final is None or not toks:
+                pre.breaker.record_failure()
+                return BackendError(
+                    f"prefill backend {pre.addr} stream ended without "
+                    "a final event", retryable=True,
+                )
+            pre.breaker.record_success()
+            if str(pre_final.get("finished_by", "length")) != "length":
+                # The request finished AT t1 (eos / stop id on the very
+                # first token): there is no decode phase to migrate —
+                # this IS the completion, bit-identical to colocated.
+                req.first_tok_at = time.monotonic()
+                req.streamed = True
+                req.generated.extend(toks)
+                req.logprobs.extend(lps)
+                self._complete_from(req, pre, pre_final, npre=0)
+                return None
+            rid_remote = pre_final.get("rid")
+            if rid_remote is None:
+                return BackendError(
+                    f"prefill backend {pre.addr} reported no rid — "
+                    "cannot address its exported pages", retryable=True,
+                )
+            x0 = time.monotonic()
+            try:
+                payload = pre.kv_pages(int(rid_remote),
+                                       trace_header=trace_hdr)
+            except BackendError as e:
+                pre.breaker.record_failure()
+                return e
+        finally:
+            self._detach(req, pre)
+        t1, lp1 = toks[0], (lps[0] if lps else None)
+        self._attach(req, dec)
+        try:
+            try:
+                dec.kv_ingest(payload, trace_header=trace_hdr)
+            except BackendError as e:
+                dec.breaker.record_failure()
+                return e
+            self._note_xfer(
+                len(payload), (time.monotonic() - x0) * 1000.0,
+                len(req.body.get("tokens") or ()),
+            )
+            dbody = dict(req.body)
+            dbody["tokens"] = list(req.body["tokens"]) + [t1]
+            dbody["max_new_tokens"] = int(req.body["max_new_tokens"]) - 1
+            return self._run_stream(
+                req, dec, body=dbody,
+                prepend=([t1], [lp1] if lp1 is not None else []),
+            )
+        finally:
+            self._detach(req, dec)
 
     def _finish(self, req: _FleetRequest, completion, error) -> None:
         with self._lock:
@@ -844,7 +1167,20 @@ class FleetRouter:
             "batch_completed": self.batch_completed,
             "resubmissions": self.resubmissions,
             "retry_budget": round(self.policy.budget, 2),
+            "disagg_handoffs": self.disagg_handoffs,
+            "disagg_fallbacks": self.disagg_fallbacks,
+            "disagg_breakeven_losses": self.disagg_breakeven_losses,
         }
+        if self._xfer_bytes_per_ms is not None:
+            # The breakeven's learned wire speed — operators read this
+            # next to each decode host's prefill_tok_per_ms to see WHY
+            # the router is (not) disaggregating.
+            out["kv_xfer_bytes_per_ms"] = round(
+                self._xfer_bytes_per_ms, 3
+            )
+            out["kv_xfer_bytes_per_token"] = round(
+                self._xfer_bytes_per_token, 3
+            )
         per = []
         for b in self.backends:
             ent = {
@@ -852,6 +1188,7 @@ class FleetRouter:
                 "breaker": b.breaker.state, "routed": b.routed,
                 "retries": b.retries, "in_flight": b.in_flight,
                 "queued_remote": b.queue_depth(),
+                "role": self._role(b),
             }
             if b.ewma_ms is not None:
                 ent["ewma_ms"] = round(b.ewma_ms, 3)
@@ -960,6 +1297,18 @@ class FleetRouter:
             return None
         return _dtrace.quantile_from_pooled(pooled, family, q, labels)
 
+    # ENGINE_INTERFACE KV-handoff surface: the router fronts no page
+    # pool — its /kv/pages routes answer 404 (no payload) and 400 (no
+    # pool); the real surfaces live on the prefill/decode hosts.
+    def kv_export_payload(self, rid, trace=None):
+        return None
+
+    def kv_ingest(self, payload, trace=None):
+        raise ValueError(
+            "the fleet router holds no page pool; POST /kv/pages to a "
+            "decode-role backend directly"
+        )
+
     # ----------------------------------------------------- fleet admin
     def health_reasons(self) -> List[str]:
         """Non-SLO health findings for /healthz: every tripped backend
@@ -997,6 +1346,7 @@ class FleetRouter:
                 if b.ewma_ms is not None else None,
                 "last_probe_ts": b.health_ts,
                 "max_len": b.max_len,
+                "role": self._role(b),
             })
         return {
             "backends": rows,

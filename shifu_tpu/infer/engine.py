@@ -144,6 +144,10 @@ class _Request:
     # obs.disttrace.TraceContext.to_dict()) — echoed into the
     # completion's timing and the engine's /tracez span store.
     trace: Optional[dict] = None
+    # Prefill/decode disaggregation: when True the admission files the
+    # prompt's full KV pages with the host tier for a peer host to
+    # fetch via GET /kv/pages?rid= (PagedEngine only).
+    kv_export: bool = False
 
 
 @dataclasses.dataclass(frozen=True)
@@ -212,6 +216,14 @@ ENGINE_INTERFACE = frozenset({
     # router's ``shifu_fleet_agg_*`` exposition block appended to
     # /metrics ("" for in-process engines — no fleet to aggregate).
     "trace_spans", "host_label", "federated_metrics",
+    # prefill/decode disaggregation (fleet/router.py): the KV-handoff
+    # wire surface. ``kv_export_payload`` answers ``GET /kv/pages?rid=``
+    # with the serialized page chain a ``kv_export`` admission filed
+    # (None = unknown rid → 404); ``kv_ingest`` is the ``POST
+    # /kv/pages`` side — deserialize, validate, and file a peer's chain
+    # into the local host tier. Engines without a host KV tier answer
+    # None / refuse.
+    "kv_export_payload", "kv_ingest",
 })
 
 
@@ -634,8 +646,15 @@ class Engine:
         model: Optional[str] = None,
         tier: str = "interactive",
         trace: Optional[dict] = None,
+        kv_export: bool = False,
     ) -> int:
         """Queue one request; returns its rid.
+
+        ``kv_export``: prefill/decode disaggregation — the admission
+        additionally files the prompt's full KV pages with the host
+        tier for a peer host to fetch (``GET /kv/pages?rid=``).
+        Requires a paged engine with a host KV tier; other engines
+        refuse at submit.
 
         ``trace``: optional distributed-trace context dict
         ({trace_id, span_id[, parent_id]} — obs.disttrace), echoed
@@ -692,6 +711,13 @@ class Engine:
         if tier not in TIERS:
             raise ValueError(
                 f"unknown admission tier {tier!r} (want one of {TIERS})"
+            )
+        if kv_export and not self._kv_export_ok():
+            raise ValueError(
+                "kv_export needs a paged engine with a host KV tier "
+                "(PagedEngine(enable_prefix_cache=True, "
+                "kv_host_bytes=...)) — there is nowhere to file the "
+                "exported pages otherwise"
             )
         if sampling is not None and not self.per_request_sampling:
             raise ValueError(
@@ -895,6 +921,7 @@ class Engine:
                 created_ts=time.monotonic(),
                 tier=tier,
                 trace=dict(trace) if trace else None,
+                kv_export=bool(kv_export),
             )
         )
         self._set_queue_gauges()
@@ -1227,6 +1254,27 @@ class Engine:
         handler appends to the local scrape — empty for in-process
         engines (only the fleet router has backends to aggregate)."""
         return ""
+
+    def _kv_export_ok(self) -> bool:
+        """May ``submit(kv_export=True)`` be honoured? Only a paged
+        engine with a host KV tier has somewhere to file the pages."""
+        return False
+
+    def kv_export_payload(self, rid: int, trace: Optional[dict] = None):
+        """Serialized KV page chain filed by a ``kv_export`` admission
+        — the ``GET /kv/pages?rid=`` surface (prefill/decode
+        disaggregation). None = no payload for that rid (the server
+        404s); only PagedEngine with a host tier produces payloads."""
+        return None
+
+    def kv_ingest(self, payload, trace: Optional[dict] = None) -> dict:
+        """Ingest a peer host's serialized KV page chain — the ``POST
+        /kv/pages`` surface. Engines without a host KV tier refuse
+        (ValueError → 400)."""
+        raise ValueError(
+            "kv ingest needs a paged engine with a host KV tier "
+            "(PagedEngine(enable_prefix_cache=True, kv_host_bytes=...))"
+        )
 
     def reload_params(self, params) -> None:
         """Hot-swap the serving weights IN PLACE (``POST /reloadz``,
@@ -2799,6 +2847,21 @@ class PagedEngine(Engine):
             # Measured prefill throughput (tokens/ms EMA) — the
             # recompute side of the restore-vs-recompute breakeven.
             self._prefill_tok_per_ms: Optional[float] = None
+            # Prefill/decode disaggregation: rid -> export record for
+            # /kv/pages pickup (bounded FIFO — a peer that never fetches
+            # must not leak records). Written on the engine thread at
+            # admission, read on HTTP handler threads.
+            self._kv_exports: "collections.OrderedDict" = (
+                collections.OrderedDict()
+            )
+            self._kv_exports_lock = threading.Lock()
+            # Wire-transfer lifecycle counts (mirrored into /healthz via
+            # counters(); the shifu_kv_xfer_* registry families are
+            # incremented at the same sites).
+            self._kv_xfer = {
+                "export_frames": 0, "export_pages": 0, "export_bytes": 0,
+                "ingest_frames": 0, "ingest_pages": 0, "ingest_bytes": 0,
+            }
             # Copy one page out of / into the pool. The gather does NOT
             # donate (the pool stays live); the scatter donates the pool
             # so restore writes are in-place like prefill scatters.
@@ -2870,6 +2933,30 @@ class PagedEngine(Engine):
         self._kv_metric_mark = {
             "spills": 0, "restores": 0, "hits": 0, "recomputes": 0,
         }
+        # KV-over-the-wire transfer families (prefill/decode
+        # disaggregation — docs/observability.md). Incremented directly
+        # from the /kv/pages handler threads (plain float adds under
+        # the registry lock, same single-writer tolerance as the
+        # breaker/health fields) so an idle engine's /metrics still
+        # shows a finished handoff.
+        self._c_kv_xfer = {
+            k: m.counter(
+                f"shifu_kv_xfer_{k}_total", desc, labelnames=("replica",)
+            ).labels(replica=r)
+            for k, desc in (
+                ("export_frames",
+                 "KV page-chain frames served to peer hosts"),
+                ("export_pages", "KV pages serialized for peer hosts"),
+                ("export_bytes",
+                 "Serialized KV bytes served to peer hosts"),
+                ("ingest_frames",
+                 "KV page-chain frames ingested from peer hosts"),
+                ("ingest_pages",
+                 "KV pages filed into the host tier from peer frames"),
+                ("ingest_bytes",
+                 "Serialized KV bytes ingested from peer hosts"),
+            )
+        }
 
     def _obs_step_gauges(self) -> None:
         super()._obs_step_gauges()
@@ -2910,6 +2997,17 @@ class PagedEngine(Engine):
                 kv_tier_recomputes=s["recomputes"],
                 kv_tier_evictions=s["evictions"],
             )
+            # Disaggregation surface: the wire-transfer lifecycle and
+            # the measured prefill rate ride /healthz so the fleet
+            # router's migrate-vs-cold-prefill breakeven can read the
+            # DECODE host's own recompute speed from its last probe.
+            out.update(
+                {f"kv_xfer_{k}": v for k, v in self._kv_xfer.items()}
+            )
+            if self._prefill_tok_per_ms is not None:
+                out["prefill_tok_per_ms"] = round(
+                    self._prefill_tok_per_ms, 4
+                )
         return out
 
     def submit(
@@ -2979,16 +3077,18 @@ class PagedEngine(Engine):
         return None
 
     # --------------------------------------------------- host KV tier
-    def _kv_spill(self, key: bytes, pg: int) -> None:
+    def _kv_spill(self, key: bytes, pg: int):
         """Spill an evicted prefix page to the host tier (no-op when
         the tier is off or the page is already spilled). The compiled
         gather runs NOW on the engine thread — device-ordered before
         any later overwrite of ``pg`` — producing an independent device
         copy; the background worker then ``device_get``s it and files
-        it without blocking the engine."""
+        it without blocking the engine. Returns the worker future (None
+        when nothing was queued) so a kv_export admission can gate the
+        /kv/pages pickup on its pages having landed."""
         store = self._kv_store
         if store is None or store.contains(key):
-            return
+            return None
         dev = self._kv_gather_jit(self.cache, np.int32(pg))
         gen = store.generation
         ps = self.page_size
@@ -3010,11 +3110,13 @@ class PagedEngine(Engine):
                     host_bytes=store.bytes_used,
                 )
 
-        self._kv_spill_futs.append(self._kv_worker.submit(work))
+        fut = self._kv_worker.submit(work)
+        self._kv_spill_futs.append(fut)
         if len(self._kv_spill_futs) > 64:
             self._kv_spill_futs = [
                 f for f in self._kv_spill_futs if not f.done()
             ]
+        return fut
 
     def _kv_probe(self, req: "_Request", prompt, p: int) -> bool:
         """Host-tier admission gate, called before the device-chain
@@ -3186,6 +3288,221 @@ class PagedEngine(Engine):
         for job in list(self._kv_pending.values()):
             with contextlib.suppress(Exception):
                 job.future.result(timeout=timeout)
+
+    # ------------------------------- KV handoff (disaggregated fleet)
+    def _kv_export_ok(self) -> bool:
+        return self._kv_store is not None
+
+    @staticmethod
+    def _kv_leaf_names(tree) -> List[str]:
+        """Deterministic wire names for a page pytree's leaves (jax
+        key-paths — identical across hosts running the same model
+        config, which is exactly the disaggregation deployment)."""
+        flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+        return [jax.tree_util.keystr(path) for path, _ in flat]
+
+    def _kv_export_spill(self, req: "_Request") -> None:
+        """File the admission's full prompt pages for peer pickup
+        (engine thread, called from ``_finish_admission`` when the
+        request was submitted with ``kv_export``). Pages still resident
+        in the pool are spilled through the normal ``_kv_spill`` path;
+        the export record keeps the spill futures so the /kv/pages
+        handler can wait for the transfers instead of 404ing a race."""
+        store = self._kv_store
+        ps = self.page_size
+        prompt = req.tokens
+        n = len(prompt) // ps
+        if store is None or n <= 0:
+            return
+        keys: List[bytes] = []
+        futs: List = []
+        key = self._prefix_salt(req.adapter)
+        for i in range(n):
+            key = self._chain_key(key, prompt[i * ps : (i + 1) * ps])
+            keys.append(key)
+            pg = self._prefix_pages.get(key)
+            if pg is not None:
+                fut = self._kv_spill(key, pg)
+                if fut is not None:
+                    futs.append(fut)
+            elif not store.contains(key):
+                # A page neither registered nor spilled (pool went dry
+                # mid-chain): the chain is not exportable — file
+                # nothing; the peer's fetch 404s and the router falls
+                # back to colocated serving.
+                return
+        with self._kv_exports_lock:
+            self._kv_exports[int(req.rid)] = {
+                "keys": keys,
+                "tokens": [int(t) for t in prompt[: n * ps]],
+                "adapter": int(req.adapter),
+                "futs": futs,
+            }
+            while len(self._kv_exports) > 64:
+                self._kv_exports.popitem(last=False)
+
+    def kv_export_payload(self, rid: int, trace: Optional[dict] = None):
+        """One SKVP frame holding the page chain a ``kv_export``
+        admission filed under ``rid`` (HTTP handler thread — the store
+        and the span store are thread-safe; the export record is read
+        under its lock). None = unknown rid (→ 404). RuntimeError = the
+        record exists but its pages are gone or the spill failed (→ 503
+        retryable: the peer's RetryPolicy decides)."""
+        store = self._kv_store
+        if store is None:
+            return None
+        with self._kv_exports_lock:
+            rec = self._kv_exports.get(int(rid))
+        if rec is None:
+            return None
+        t0 = time.monotonic()
+        for fut in list(rec["futs"]):
+            try:
+                fut.result(timeout=10.0)
+            except Exception as e:
+                raise RuntimeError(
+                    f"kv export spill for rid {rid} failed: {e!r}"
+                ) from e
+        pages: List[Dict[str, np.ndarray]] = []
+        for k in rec["keys"]:
+            ent = store.get(k, bump=False)
+            if ent is None:
+                raise RuntimeError(
+                    f"kv export page for rid {rid} left the host tier "
+                    "before pickup (budget eviction or flush — raise "
+                    "kv_host_bytes or fetch sooner)"
+                )
+            flat, _ = jax.tree_util.tree_flatten_with_path(ent.arrays)
+            pages.append({
+                jax.tree_util.keystr(path): np.asarray(leaf)
+                for path, leaf in flat
+            })
+        from shifu_tpu.infer.kvtier import pack_page_chain
+
+        payload = pack_page_chain(
+            pages, page_size=self.page_size, tokens=rec["tokens"],
+            meta={"rid": int(rid), "adapter": rec["adapter"]},
+        )
+        ms = (time.monotonic() - t0) * 1e3
+        self._kv_xfer["export_frames"] += 1
+        self._kv_xfer["export_pages"] += len(pages)
+        self._kv_xfer["export_bytes"] += len(payload)
+        xfer = getattr(self, "_c_kv_xfer", None)
+        if xfer is not None:
+            xfer["export_frames"].inc()
+            xfer["export_pages"].inc(len(pages))
+            xfer["export_bytes"].inc(len(payload))
+        self._kv_migrate_span(
+            trace, "export", t0, ms, rid=int(rid), pages=len(pages),
+            nbytes=len(payload),
+        )
+        self.flight.record(
+            "kv_export", replica=self.replica_label, rid=int(rid),
+            pages=len(pages), bytes=len(payload), ms=round(ms, 3),
+        )
+        return payload
+
+    def kv_ingest(self, payload, trace: Optional[dict] = None) -> dict:
+        """Validate and file a peer's page chain into the local host
+        tier (HTTP handler thread). The chain is keyed by recomputing
+        the sha256 chain digests from the frame's token run under the
+        LOCAL prefix salt, so the subsequent admission hits the normal
+        probe → restore → adopt → register path — decode after
+        migration is bitwise the colocated run (the PR 9 parity
+        contract, extended over the wire). Raises
+        :class:`~shifu_tpu.infer.kvtier.WireFormatError` (a ValueError)
+        on any frame fault and ValueError on a layout mismatch — both
+        → 400; nothing is filed unless the whole frame validates."""
+        store = self._kv_store
+        if store is None or not self.enable_prefix_cache:
+            return super().kv_ingest(payload, trace)
+        from shifu_tpu.infer.kvtier import unpack_page_chain
+
+        t0 = time.monotonic()
+        header, pages = unpack_page_chain(bytes(payload))
+        ps = int(header.get("page_size", 0))
+        if ps != self.page_size:
+            raise ValueError(
+                f"peer page_size {ps} != local page_size "
+                f"{self.page_size} — KV pages only migrate between "
+                "hosts running the same paged-cache geometry"
+            )
+        meta = header.get("meta") or {}
+        tokens = [int(t) for t in meta.get("tokens", ())]
+        adapter = int(meta.get("adapter", 0) or 0)
+        # Validate every page against OUR cache layout before filing
+        # anything: leaf names from the shared key-path naming, shapes
+        # = the cache leaf minus its page axis (axis 1).
+        flat, treedef = jax.tree_util.tree_flatten_with_path(self.cache)
+        names = [jax.tree_util.keystr(path) for path, _ in flat]
+        want = {
+            jax.tree_util.keystr(path): (leaf.shape[:1] + leaf.shape[2:])
+            for path, leaf in flat
+        }
+        trees = []
+        for i, page in enumerate(pages):
+            if sorted(page) != sorted(names):
+                raise ValueError(
+                    f"page {i} leaves {sorted(page)} do not match this "
+                    f"model's paged cache layout {sorted(names)}"
+                )
+            for nm in names:
+                if tuple(page[nm].shape) != tuple(want[nm]):
+                    raise ValueError(
+                        f"page {i} leaf {nm} shape {page[nm].shape} != "
+                        f"local page shape {tuple(want[nm])}"
+                    )
+            trees.append(
+                jax.tree_util.tree_unflatten(
+                    treedef, [page[nm] for nm in names]
+                )
+            )
+        stored = 0
+        nbytes = 0
+        key = self._prefix_salt(adapter)
+        for i, tree in enumerate(trees):
+            key = self._chain_key(key, tokens[i * ps : (i + 1) * ps])
+            if store.put(key, tree, tokens=ps):
+                stored += 1
+            nbytes += sum(
+                a.nbytes for a in jax.tree_util.tree_leaves(tree)
+            )
+        ms = (time.monotonic() - t0) * 1e3
+        self._kv_xfer["ingest_frames"] += 1
+        self._kv_xfer["ingest_pages"] += stored
+        self._kv_xfer["ingest_bytes"] += len(payload)
+        xfer = getattr(self, "_c_kv_xfer", None)
+        if xfer is not None:
+            xfer["ingest_frames"].inc()
+            xfer["ingest_pages"].inc(stored)
+            xfer["ingest_bytes"].inc(len(payload))
+        self._kv_migrate_span(
+            trace, "ingest", t0, ms, pages=len(trees), stored=stored,
+            nbytes=len(payload),
+        )
+        self.flight.record(
+            "kv_ingest", replica=self.replica_label, pages=len(trees),
+            stored=stored, bytes=len(payload), ms=round(ms, 3),
+        )
+        return {"pages": len(trees), "stored": stored,
+                "bytes": int(nbytes)}
+
+    def _kv_migrate_span(self, trace, direction: str, t0: float,
+                         ms: float, **fields) -> None:
+        """Record a ``kv_migrate`` span for one side of a KV handoff
+        (both hosts record one, so the merged Chrome trace shows the
+        transfer in both process lanes)."""
+        if not trace or not trace.get("trace_id"):
+            return
+        ctx = _dtrace.TraceContext(
+            str(trace["trace_id"]),
+            str(trace.get("span_id") or _dtrace.mint().span_id),
+            str(trace.get("parent_id") or ""),
+        )
+        self._span_store.add(ctx.trace_id, _dtrace.span_record(
+            "kv_migrate", ctx, t0 * 1000.0, ms, direction=direction,
+            **fields,
+        ))
 
     def step_dispatch(self):
         self._kv_wait_flag = False
@@ -3508,6 +3825,8 @@ class PagedEngine(Engine):
         self.prompt_tokens_total += p
         if self._kv_store is not None:
             self._kv_recompute_rids.discard(req.rid)
+            if req.kv_export:
+                self._kv_export_spill(req)
         super()._finish_admission(req, slot, p, first, lp)
 
     def cache_stats(self):

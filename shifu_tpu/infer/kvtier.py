@@ -44,6 +44,8 @@ __all__ = [
     "WireFormatError",
     "serialize_pages",
     "deserialize_pages",
+    "pack_page_chain",
+    "unpack_page_chain",
 ]
 
 
@@ -184,6 +186,91 @@ def deserialize_pages(buf: bytes) -> Tuple[Dict[str, Any], Dict[str, np.ndarray]
             "header does not describe"
         )
     return header, leaves
+
+
+def pack_page_chain(
+    pages: List[Dict[str, np.ndarray]],
+    *,
+    page_size: int,
+    tokens,
+    meta: Optional[Dict[str, Any]] = None,
+) -> bytes:
+    """Pack an ORDERED chain of pages into ONE checksummed frame.
+
+    The frame format rejects trailing payload bytes, so a multi-page
+    export cannot be a concatenation of per-page frames — instead each
+    page's leaves are prefixed ``p{i:05d}/`` and packed together, and
+    the header's meta carries the page count plus the full-page token
+    run (``tokens``, length ``len(pages) * page_size``) the receiver
+    needs to recompute chain digests under its OWN prefix salt. Every
+    page must carry the same leaf set (one cache layout per model)."""
+    if not pages:
+        raise ValueError("pack_page_chain needs at least one page")
+    toks = [int(t) for t in tokens]
+    if len(toks) != len(pages) * int(page_size):
+        raise ValueError(
+            f"token run of {len(toks)} does not cover {len(pages)} "
+            f"full pages of {page_size}"
+        )
+    names = sorted(pages[0])
+    flat: Dict[str, np.ndarray] = {}
+    for i, page in enumerate(pages):
+        if sorted(page) != names:
+            raise ValueError(
+                f"page {i} leaf set {sorted(page)} differs from page 0's "
+                f"{names} — a chain has one cache layout"
+            )
+        for n in names:
+            flat[f"p{i:05d}/{n}"] = page[n]
+    m = dict(meta or {})
+    m["n_pages"] = len(pages)
+    m["tokens"] = toks
+    return serialize_pages(flat, page_size=page_size, meta=m)
+
+
+def unpack_page_chain(
+    buf: bytes,
+) -> Tuple[Dict[str, Any], List[Dict[str, np.ndarray]]]:
+    """Unpack a :func:`pack_page_chain` frame → (header, ordered page
+    list). Raises :class:`WireFormatError` on any frame-level fault
+    (inherited from :func:`deserialize_pages`) or a chain-level
+    inconsistency (missing page, stray leaves, token run not covering
+    the pages) — a torn or corrupt chain must read as a transfer
+    failure, never as a shorter valid chain."""
+    header, leaves = deserialize_pages(buf)
+    meta = header.get("meta") or {}
+    try:
+        n = int(meta.get("n_pages", 0))
+    except (TypeError, ValueError):
+        n = 0
+    if n < 1:
+        raise WireFormatError(
+            "frame is not a page chain (meta lacks a positive n_pages)"
+        )
+    pages: List[Dict[str, np.ndarray]] = []
+    claimed = 0
+    for i in range(n):
+        pre = f"p{i:05d}/"
+        page = {
+            k[len(pre):]: v for k, v in leaves.items() if k.startswith(pre)
+        }
+        if not page:
+            raise WireFormatError(f"chain frame is missing page {i}")
+        claimed += len(page)
+        pages.append(page)
+    if claimed != len(leaves):
+        raise WireFormatError(
+            f"chain frame carries {len(leaves) - claimed} leaves outside "
+            "any declared page"
+        )
+    toks = meta.get("tokens")
+    ps = int(header.get("page_size", 0))
+    if not isinstance(toks, list) or len(toks) != n * ps:
+        raise WireFormatError(
+            f"chain token run ({len(toks) if isinstance(toks, list) else toks!r}"
+            f" tokens) does not cover {n} pages of {ps}"
+        )
+    return header, pages
 
 
 # ----------------------------------------------------------------- host tier

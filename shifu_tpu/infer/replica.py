@@ -117,6 +117,16 @@ class ReplicatedEngine:
         return best
 
     def submit(self, prompt_tokens, max_new_tokens: int, **kw) -> int:
+        if kw.get("kv_export"):
+            # The export rid would be replica-local while /kv/pages is
+            # answered by THIS router object, which holds no page pool
+            # — refuse rather than file pages nobody can fetch.
+            raise ValueError(
+                "kv_export is not supported over dp replicas — run the "
+                "prefill host as a single paged engine (serve --role "
+                "prefill without --dp)"
+            )
+        kw.pop("kv_export", None)
         idx = self._pick()
         lrid = self.engines[idx].submit(
             prompt_tokens, max_new_tokens, **kw
@@ -234,6 +244,19 @@ class ReplicatedEngine:
 
     def rollout_stats(self):
         return None
+
+    # ENGINE_INTERFACE KV-handoff surface (prefill/decode
+    # disaggregation): dp replicas share no single page pool, so this
+    # server neither exports nor ingests — GET /kv/pages 404s, POST
+    # 400s, and the router keeps such a host out of handoffs.
+    def kv_export_payload(self, rid, trace=None):
+        return None
+
+    def kv_ingest(self, payload, trace=None):
+        raise ValueError(
+            "kv ingest needs a single paged engine with a host KV "
+            "tier; dp replicas do not share one page pool"
+        )
 
     def cache_stats(self):
         """Pooled /cachez block: numeric prefix-cache and host-tier

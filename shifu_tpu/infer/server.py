@@ -500,6 +500,9 @@ class _Submission:
     # Distributed-trace context dict (obs.disttrace) — rides through
     # Engine.submit into Completion.timing and the /tracez span store.
     trace: Optional[dict] = None
+    # Prefill/decode disaggregation: file the prompt's KV pages for a
+    # peer host's GET /kv/pages pickup (paged engines with a host tier).
+    kv_export: bool = False
 
 
 @dataclasses.dataclass
@@ -670,14 +673,14 @@ class EngineRunner:
         stop_token_ids=None, stop_strings=None,
         logit_bias=None, allowed_token_ids=None, adapter=None,
         regex=None, json_schema=None, model=None, tier="interactive",
-        trace=None,
+        trace=None, kv_export=False,
     ) -> Completion:
         return self.complete_n(
             tokens, max_new_tokens, 1, timeout=timeout, sampling=sampling,
             stop_token_ids=stop_token_ids, stop_strings=stop_strings,
             logit_bias=logit_bias, allowed_token_ids=allowed_token_ids,
             adapter=adapter, regex=regex, json_schema=json_schema,
-            model=model, tier=tier, trace=trace,
+            model=model, tier=tier, trace=trace, kv_export=kv_export,
         )[0]
 
     def complete_n(
@@ -687,7 +690,7 @@ class EngineRunner:
         stop_token_ids=None, stop_strings=None,
         logit_bias=None, allowed_token_ids=None, adapter=None,
         regex=None, json_schema=None, model=None, tier="interactive",
-        trace=None,
+        trace=None, kv_export=False,
     ):
         """N independent completions of one prompt (the API's ``n``).
 
@@ -721,7 +724,7 @@ class EngineRunner:
                         allowed_token_ids=allowed_token_ids,
                         adapter=adapter, regex=regex,
                         json_schema=json_schema, model=model, tier=tier,
-                        trace=trace,
+                        trace=trace, kv_export=kv_export,
                     )
                 )
         self._g_inbox.set(len(self._inbox))
@@ -833,7 +836,7 @@ class EngineRunner:
                stop_token_ids=None, stop_strings=None,
                logit_bias=None, allowed_token_ids=None, adapter=None,
                regex=None, json_schema=None, model=None,
-               tier="interactive", trace=None):
+               tier="interactive", trace=None, kv_export=False):
         """Returns a generator of ("delta", (ids, logprobs)) items
         ending with ("done", Completion); tokens arrive as the engine
         emits them (per decode chunk). The submission (and the
@@ -859,7 +862,7 @@ class EngineRunner:
                     allowed_token_ids=allowed_token_ids,
                     adapter=adapter, regex=regex,
                     json_schema=json_schema, model=model, tier=tier,
-                    trace=trace,
+                    trace=trace, kv_export=kv_export,
                 )
             )
         self._g_inbox.set(len(self._inbox))
@@ -1145,6 +1148,7 @@ class EngineRunner:
                     adapter=sub.adapter, regex=sub.regex,
                     json_schema=sub.json_schema, model=sub.model,
                     tier=sub.tier, trace=sub.trace,
+                    kv_export=sub.kv_export,
                 )
             except Exception as e:  # validation error -> the caller
                 with self._lock:
@@ -1283,6 +1287,11 @@ class _Handler(BaseHTTPRequestHandler):
     # Operator-chosen model id for /v1/models (multi-model fleets route
     # by it); None falls back to the model class name.
     model_id: Optional[str] = None
+    # Disaggregation role (serve --role): "prefill" hosts run chunked
+    # prefill and export paged KV over GET /kv/pages; "decode" hosts
+    # ingest it; "both" (the default) serves colocated. Surfaced on
+    # /healthz + /v1/models so the fleet prober learns it for free.
+    role: str = "both"
     # Batch admission cap (serve --batch-backlog): a batch-tier request
     # arriving while the engine's batch backlog is at/over this depth
     # gets 429 + Retry-After — a mis-sized job cannot OOM the queue.
@@ -1322,7 +1331,11 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_GET(self):
         if self.path == "/healthz":
-            self._send(200, self.runner.stats())
+            st = self.runner.stats()
+            st["role"] = self.role
+            self._send(200, st)
+        elif self.path.split("?", 1)[0] == "/kv/pages":
+            self._handle_kv_export()
         elif self.path.split("?", 1)[0] == "/debugz":
             # Flight recorder: the last-K structured runtime events
             # (engine steps per replica, compiles, preemptions,
@@ -1503,6 +1516,9 @@ class _Handler(BaseHTTPRequestHandler):
                 "engine": type(eng).__name__,
                 "vocab_size": getattr(cfg, "vocab_size", None),
                 "max_len": eng.max_len,
+                # Disaggregation role — BackendClient.models() caches
+                # it so FleetRouter can schedule by phase.
+                "role": self.role,
             }
             if self.runner.ckpt_path:
                 # The checkpoint this host serves (seeded by the CLI's
@@ -1558,6 +1574,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._handle_batch_cancel(
                 self.path[len("/v1/batches/"):-len("/cancel")]
             )
+        elif self.path == "/kv/pages":
+            self._handle_kv_ingest()
         elif self.path == "/drainz":
             self._handle_drain()
         elif self.path == "/reloadz":
@@ -1566,6 +1584,79 @@ class _Handler(BaseHTTPRequestHandler):
             self._handle_rollout_note()
         else:
             self._send(404, {"error": f"no route {self.path}"})
+
+    # ------------------------------------- KV handoff (disaggregation)
+    # The prefill->decode migration surface. GET /kv/pages?rid= serves
+    # the SKVP frame a kv_export completion filed in the host tier
+    # (ENGINE_INTERFACE "kv_export_payload"); POST /kv/pages ingests it
+    # into this host's page pool through the prefix-registration path
+    # ("kv_ingest"). Both run on HTTP threads — the engine loop never
+    # blocks on the wire.
+    def _handle_kv_export(self):
+        from urllib.parse import parse_qs, urlparse
+
+        q = parse_qs(urlparse(self.path).query)
+        try:
+            rid = int((q.get("rid") or [""])[0])
+        except ValueError:
+            self._send(400, {"error": "rid must be an integer"})
+            return
+        trace_ctx = _dtrace.ensure_context(
+            self.headers.get(_dtrace.HEADER)
+        )
+        try:
+            payload = self.runner.engine.kv_export_payload(
+                rid, trace=trace_ctx.to_dict()
+            )
+        except RuntimeError as e:
+            # Export filed but unservable (spill failed, pages evicted
+            # before pickup): 503 so the fetching router retries or
+            # falls back colocated.
+            self._send(503, {"error": str(e)})
+            return
+        except ValueError as e:
+            self._send(400, {"error": str(e)})
+            return
+        if payload is None:
+            self._send(404, {
+                "error": f"no exported KV pages for rid {rid}",
+            })
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", "application/octet-stream")
+        self.send_header("Content-Length", str(len(payload)))
+        self.send_header(_dtrace.HEADER, trace_ctx.to_header())
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def _handle_kv_ingest(self):
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            payload = self.rfile.read(length)
+        except ValueError:
+            self._send(400, {"error": "Content-Length required"})
+            return
+        trace_ctx = _dtrace.ensure_context(
+            self.headers.get(_dtrace.HEADER)
+        )
+        from shifu_tpu.infer.kvtier import WireFormatError
+
+        try:
+            out = self.runner.engine.kv_ingest(
+                payload, trace=trace_ctx.to_dict()
+            )
+        except (WireFormatError, ValueError) as e:
+            # Torn/corrupt/mis-versioned frame, or an engine with no
+            # page pool: the frame is unusable here, nothing was
+            # stored — the router treats this as a transfer failure
+            # and serves colocated.
+            self._send(400, {"error": str(e)})
+            return
+        except RuntimeError as e:
+            self._send(503, {"error": str(e)})
+            return
+        self._send(200, out,
+                    headers={_dtrace.HEADER: trace_ctx.to_header()})
 
     # ------------------------------------------ offline batch jobs
     # (shifu_tpu/batch: OpenAI-Batch-shaped file-in/file-out jobs on
@@ -2151,6 +2242,12 @@ class _Handler(BaseHTTPRequestHandler):
                     )
                 regex = _tool_constraint(tools, tool_choice)
             want_logprobs = bool(req.get("logprobs"))
+            # Disaggregation (fleet router -> prefill host): spill this
+            # request's paged KV chain into the host tier at admission
+            # so GET /kv/pages?rid= can hand it to a decode host. The
+            # engine refuses it without a host tier (clean 400/error
+            # event rather than a silent no-op export).
+            kv_export = bool(req.get("kv_export"))
             # Distributed-trace context (obs/disttrace.py): adopt the
             # inbound x-shifu-trace header (an upstream router hop
             # minted it and forwarded a child) or mint a fresh root
@@ -2178,7 +2275,7 @@ class _Handler(BaseHTTPRequestHandler):
                     logit_bias=logit_bias, allowed_token_ids=allowed_ids,
                     adapter=adapter, regex=regex,
                     json_schema=json_schema, tools=tools, model=model,
-                    tier=tier, trace_ctx=trace_ctx,
+                    tier=tier, trace_ctx=trace_ctx, kv_export=kv_export,
                 )
                 return
             if best_of is not None:
@@ -2266,7 +2363,7 @@ class _Handler(BaseHTTPRequestHandler):
                     stop_strings=stop_strings, logit_bias=logit_bias,
                     allowed_token_ids=allowed_ids, adapter=adapter,
                     regex=regex, json_schema=json_schema, model=model,
-                    tier=tier, trace=trace,
+                    tier=tier, trace=trace, kv_export=kv_export,
                 )
                 choices = [
                     self._timed_choice(d, want_logprobs, stop_strings)
@@ -2288,7 +2385,7 @@ class _Handler(BaseHTTPRequestHandler):
                 stop_strings=stop_strings, logit_bias=logit_bias,
                 allowed_token_ids=allowed_ids, adapter=adapter,
                 regex=regex, json_schema=json_schema, model=model,
-                tier=tier, trace=trace,
+                tier=tier, trace=trace, kv_export=kv_export,
             )
         except UnknownModelError as e:
             # The fleet's 404 backstop (the handler pre-check above
@@ -2319,6 +2416,7 @@ class _Handler(BaseHTTPRequestHandler):
         chat: bool = False, logit_bias=None, allowed_token_ids=None,
         adapter=None, regex=None, json_schema=None, tools=None,
         model=None, tier="interactive", trace_ctx=None,
+        kv_export=False,
     ) -> None:
         """Server-sent events: one ``data:`` line per token delta, a
         final one with finished_by (and the definitive token count —
@@ -2336,6 +2434,7 @@ class _Handler(BaseHTTPRequestHandler):
             regex=regex, json_schema=json_schema, model=model,
             tier=tier,
             trace=trace_ctx.to_dict() if trace_ctx else None,
+            kv_export=kv_export,
         )
         self.send_response(200)
         self.send_header("Content-Type", "text/event-stream")
@@ -2373,6 +2472,11 @@ class _Handler(BaseHTTPRequestHandler):
                         "finished_by": payload.finished_by,
                         "n_tokens": len(payload.tokens),
                         "usage": _usage(len(tokens), [payload]),
+                        # Backend-local request id: a disaggregating
+                        # router fetches the exported KV pages with it
+                        # (GET /kv/pages?rid= — rids are per-host
+                        # namespaces, so the router must use OURS).
+                        "rid": payload.rid,
                     }
                     if want_logprobs:
                         final["logprobs"] = payload.logprobs
@@ -2447,6 +2551,7 @@ def make_server(
     batch_backlog: Optional[int] = None,
     enable_batch_api: bool = True,
     tune_table: Optional[str] = None,
+    role: str = "both",
 ) -> ThreadingHTTPServer:
     """Build (not start) the HTTP server; ``.runner`` holds the engine
     thread. Serve with ``serve_forever()``; stop with ``shutdown()``
@@ -2470,9 +2575,17 @@ def make_server(
     ``tune_table``: kernel tune-table artifact to activate for this
     process's kernel dispatch (ops.pallas.registry.use_table —
     warn-and-run-v0 on schema/device mismatch); /statz's ``kernels``
-    block reports the active table + per-shape-class selections."""
+    block reports the active table + per-shape-class selections.
+    ``role``: disaggregation role ("prefill" | "decode" | "both") —
+    advertised on /healthz + /v1/models so a fleet router schedules
+    prefill-heavy admissions to prefill hosts and hands their KV off
+    to decode hosts (serve --role)."""
     from shifu_tpu.obs import compilemon
 
+    if role not in ("prefill", "decode", "both"):
+        raise ValueError(
+            f'role must be "prefill", "decode" or "both", got {role!r}'
+        )
     if tune_table:
         from shifu_tpu.ops.pallas import registry as _kreg
 
@@ -2502,6 +2615,7 @@ def make_server(
             "request_timeout_s": request_timeout_s,
             "model_id": model_id,
             "batch_backlog_max": batch_backlog,
+            "role": role,
         },
     )
     server = ThreadingHTTPServer((host, port), handler)

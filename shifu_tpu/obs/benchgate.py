@@ -132,6 +132,16 @@ METRIC_SPECS: Dict[str, Tuple[str, float]] = {
     # the *_tune_x_default rows).
     "kv_restore_x_recompute": (HIGHER, 0.20),
     "kv_hit_rate": (HIGHER, 0.15),
+    # prefill/decode disaggregation (round 14): p99 TTFT/ITL of the
+    # two-host handoff path over the same decode host colocated
+    # (bench_disagg). Armable — dormant until a baseline round records
+    # the leg (missing keys are skipped). The TTFT ratio prices the
+    # migration (prefill hop + SKVP transfer) and drifting UP past
+    # tolerance means the handoff got more expensive; the ITL ratio
+    # should sit ~1 — decode runs on one host either way — so it
+    # creeping up means handoff cost leaked into steady-state decode.
+    "disagg_x_coloc_ttft": (LOWER, 0.50),
+    "disagg_x_coloc_itl": (LOWER, 0.35),
 }
 
 # Absolute floors for landed improve-direction wins (round 6): relative

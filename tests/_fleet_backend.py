@@ -13,7 +13,11 @@ Env knobs: ``FLEET_BACKEND_MAX_SLOTS`` (default 2),
 ``FLEET_BACKEND_MODEL_ID`` (the /v1/models id — multi-model routing
 tests give each backend a distinct name), ``FLEET_BACKEND_CKPT``
 (initial weights: a manifest params dir loaded at startup and
-reported as the serving ckpt — the rollout tests' rollback anchor).
+reported as the serving ckpt — the rollout tests' rollback anchor),
+``FLEET_BACKEND_ROLE`` (prefill|decode|both — the disaggregation role
+the server advertises), ``FLEET_BACKEND_KV_HOST_BYTES`` (nonzero
+enables the prefix cache + host KV tier, the /kv/pages handoff
+surface — the disagg tests set it on both hosts).
 
 CHAOS HOOKS (the ``chaos`` pytest marker's fault injectors — each
 makes one failure path deterministic instead of waiting for the
@@ -108,6 +112,8 @@ def main() -> int:
     seed = int(os.environ.get("FLEET_BACKEND_SEED", "0"))
     model_id = os.environ.get("FLEET_BACKEND_MODEL_ID") or None
     ckpt = os.environ.get("FLEET_BACKEND_CKPT") or None
+    role = os.environ.get("FLEET_BACKEND_ROLE") or "both"
+    kv_host = int(os.environ.get("FLEET_BACKEND_KV_HOST_BYTES", "0"))
 
     cfg = TransformerConfig.tiny()
     model = Transformer(cfg)
@@ -116,10 +122,17 @@ def main() -> int:
         from shifu_tpu.checkpoint import load_serving_params
 
         params = load_serving_params(ckpt, model)
+    extra = {}
+    if kv_host:
+        # The disaggregation surface: prefix cache + host KV tier is
+        # what a prefill host spills exports into (and a decode host
+        # ingests from) over /kv/pages.
+        extra.update(enable_prefix_cache=True, kv_host_bytes=kv_host)
     engine = PagedEngine(
         model, params, max_slots=max_slots, max_len=max_len,
         page_size=16, prefill_buckets=(16, max_len),
         sample_cfg=SampleConfig(temperature=0.0),
+        **extra,
     )
     # Optional per-step brake: the tiny CPU model decodes hundreds of
     # tokens in milliseconds, far too fast to exercise mid-stream
@@ -137,7 +150,7 @@ def main() -> int:
 
         engine.step_fold = slow_fold
     server = make_server(engine, port=0, model_id=model_id,
-                         ckpt_path=ckpt)
+                         ckpt_path=ckpt, role=role)
     _install_faults(server)
     print(json.dumps({"port": server.server_port}), flush=True)
     try:

@@ -1,0 +1,431 @@
+"""Prefill/decode disaggregation across real processes: a prefill-role
+host, a decode-role host, and a colocated control (tests/_fleet_backend.py
+with FLEET_BACKEND_ROLE + FLEET_BACKEND_KV_HOST_BYTES). Covers the
+acceptance walk: the two-host handoff produces a completion bitwise
+identical to the colocated control with ``shifu_kv_xfer_*`` counters
+nonzero on BOTH hosts and one merged trace spanning both lanes; SKVP
+corruption over the wire (truncation / bit-flip / version mismatch)
+surfaces as a retryable transfer error and never corrupts the decode
+host; SIGKILLing the prefill host degrades to colocated completion via
+the ordinary resubmission machinery; a forced breakeven loss routes
+colocated without attempting the handoff; and the CLI refuses a role
+the engine cannot honour."""
+
+import json
+import os
+import signal
+import struct
+import subprocess
+import sys
+import threading
+import urllib.error
+import urllib.request
+import zlib
+
+import pytest
+
+from shifu_tpu.fleet import (
+    BackendClient,
+    BackendConfig,
+    BackendError,
+    FleetRouter,
+    RetryPolicy,
+    wait_ready,
+)
+from shifu_tpu.infer import make_server
+from shifu_tpu.obs import FlightRecorder, MetricsRegistry, parse_exposition
+
+_HELPER = os.path.join(os.path.dirname(__file__), "_fleet_backend.py")
+
+
+def _spawn_backend(max_slots=2, step_delay=0.01, extra_env=None):
+    env = dict(
+        os.environ,
+        PALLAS_AXON_POOL_IPS="",
+        JAX_PLATFORMS="cpu",
+        FLEET_BACKEND_MAX_SLOTS=str(max_slots),
+        FLEET_BACKEND_STEP_DELAY=str(step_delay),
+        **(extra_env or {}),
+    )
+    proc = subprocess.Popen(
+        [sys.executable, _HELPER],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        env=env, text=True,
+    )
+    line = proc.stdout.readline()
+    if not line:
+        proc.kill()
+        raise RuntimeError("backend process died before printing its port")
+    port = json.loads(line)["port"]
+    return proc, f"127.0.0.1:{port}"
+
+
+def _post(base, path, obj, timeout=120):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(obj).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def _get(base, path, timeout=30):
+    with urllib.request.urlopen(base + path, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+_KV = str(64 << 20)
+_PROMPT = list(range(1, 49))  # 48 tokens = 3 full 16-token pages
+
+
+def _disagg_env(role):
+    return {
+        "FLEET_BACKEND_ROLE": role,
+        "FLEET_BACKEND_KV_HOST_BYTES": _KV,
+    }
+
+
+@pytest.fixture(scope="module")
+def trio():
+    """Three real engine-server processes: prefill-role + decode-role
+    (both with the host KV tier — the /kv/pages surface) and a plain
+    colocated control every parity assertion compares against."""
+    procs, addrs = [], []
+    try:
+        for env in (_disagg_env("prefill"), _disagg_env("decode"), None):
+            p, a = _spawn_backend(extra_env=env)
+            procs.append(p)
+            addrs.append(a)
+        yield addrs
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGKILL)
+        for p in procs:
+            p.wait(timeout=10)
+
+
+def _clients(addrs, **cfg_over):
+    cfg = BackendConfig(connect_timeout_s=10.0, probe_timeout_s=5.0,
+                        read_timeout_s=60.0, **cfg_over)
+    clients = [BackendClient(a, cfg) for a in addrs]
+    ready, pending = wait_ready(clients, timeout_s=60.0, require_all=True)
+    assert not pending
+    return clients
+
+
+def _disagg_router(clients, **kw):
+    return FleetRouter(
+        clients, metrics=MetricsRegistry(), flight=FlightRecorder(),
+        policy=RetryPolicy(base_s=0.01, cap_s=0.1, budget=16.0),
+        disagg_min_prompt=32, **kw,
+    )
+
+
+@pytest.fixture()
+def droute(trio):
+    """A fresh router + front-end over the prefill + decode pair."""
+    clients = _clients(trio[:2])
+    router = _disagg_router(clients)
+    server = make_server(router, port=0)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    try:
+        yield f"http://127.0.0.1:{server.server_port}", router
+    finally:
+        server.shutdown()
+        server.runner.shutdown()
+        t.join(5)
+
+
+def _metric_total(addr, name):
+    with urllib.request.urlopen(f"http://{addr}/metrics", timeout=30) as r:
+        samples = parse_exposition(r.read().decode())
+    return sum(v for (n, _), v in samples.items() if n == name)
+
+
+def test_disagg_parity_counters_and_merged_trace(trio, droute):
+    """The tentpole acceptance: routed completion over the role-split
+    pair is bitwise identical to the colocated control; kv_xfer
+    counters move on both hosts; one merged trace spans both lanes."""
+    base, router = droute
+    pre_addr, dec_addr, ctl_addr = trio
+    body = {"tokens": _PROMPT, "max_new_tokens": 24}
+
+    status, out = _post(base, "/v1/completions", body)
+    assert status == 200
+    _, ctl = _post(f"http://{ctl_addr}", "/v1/completions", body)
+    assert out["tokens"] == ctl["tokens"]  # bitwise, logits and all
+    if "logprobs" in out and "logprobs" in ctl:
+        assert out["logprobs"] == ctl["logprobs"]
+
+    c = router.counters()
+    assert c["disagg_handoffs"] == 1
+    assert c["disagg_fallbacks"] == 0
+    assert c["kv_xfer_bytes_per_ms"] is not None  # breakeven EMA seeded
+
+    # The exporter exported and the ingester ingested — same frame.
+    for fam in ("frames", "pages", "bytes"):
+        exp = _metric_total(pre_addr, f"shifu_kv_xfer_export_{fam}_total")
+        ing = _metric_total(dec_addr, f"shifu_kv_xfer_ingest_{fam}_total")
+        assert exp > 0, fam
+        assert exp == ing, fam
+
+    # One merged trace: the router lane plus a kv_migrate record from
+    # EACH backend process (export on one host, ingest on the other).
+    tid = out["timing"]["trace_id"]
+    doc = _get(base, f"/tracez?trace_id={tid}")
+    kinds_by_host = {
+        h["host"]: [r.get("kind") for r in h.get("records", [])]
+        for h in doc["hosts"]
+    }
+    migrate_lanes = [
+        h for h, kinds in kinds_by_host.items() if "kv_migrate" in kinds
+    ]
+    assert len(migrate_lanes) == 2, kinds_by_host
+    assert any("router_hop" in k for k in kinds_by_host.values())
+
+
+def _export_one(pre):
+    """Run a kv_export prefill leg against the prefill host directly
+    and fetch the SKVP frame it filed — the raw material the
+    corruption tests mangle."""
+    body = {"tokens": _PROMPT, "max_new_tokens": 1, "kv_export": True,
+            "stream": True}
+    final = None
+    for ev in pre.open_stream(body):
+        assert "error" not in ev, ev
+        if "finished_by" in ev:
+            final = ev
+    assert final is not None and final.get("rid") is not None
+    return pre.kv_pages(int(final["rid"]))
+
+
+def test_skvp_corruption_over_wire_is_retryable(trio):
+    """Truncation, a flipped bit, and a version bump each surface as a
+    RETRYABLE BackendError at the BackendClient seam (the router's cue
+    to fall back colocated) — and the decode host that rejected them
+    still serves bit-identical completions afterwards."""
+    pre_addr, dec_addr, ctl_addr = trio
+    pre, dec = _clients([pre_addr, dec_addr])
+    payload = _export_one(pre)
+
+    # A pristine frame ingests fine — the corruptions below are the
+    # only thing standing between these bytes and the page pool.
+    dec.kv_ingest(payload)
+
+    truncated = payload[:-9]
+    flipped = bytearray(payload)
+    flipped[len(flipped) // 2] ^= 0x40
+    vbump = bytearray(payload)
+    struct.pack_into("<H", vbump, 4, 2)  # future format version...
+    vbump[-4:] = struct.pack(            # ...with a VALID crc, so the
+        "<I", zlib.crc32(bytes(vbump[:-4])) & 0xFFFFFFFF
+    )                                    # rejection is version, not crc
+
+    for name, bad in (("truncation", truncated),
+                      ("bit-flip", bytes(flipped)),
+                      ("version-mismatch", bytes(vbump))):
+        with pytest.raises(BackendError) as ei:
+            dec.kv_ingest(bad)
+        assert ei.value.retryable, name
+
+    # Never corrupt decode: the host that rejected three mangled
+    # frames still matches the colocated control exactly.
+    body = {"tokens": _PROMPT, "max_new_tokens": 8}
+    _, out = _post(f"http://{dec_addr}", "/v1/completions", body)
+    _, ctl = _post(f"http://{ctl_addr}", "/v1/completions", body)
+    assert out["tokens"] == ctl["tokens"]
+
+
+def test_kv_pages_client_side_validation(trio):
+    """BackendClient.kv_pages validates the fetched frame CLIENT-side:
+    a host handing back junk (or a torn read) is a retryable transfer
+    error before a single byte is relayed to the decode host."""
+    import http.server
+
+    class Junk(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            blob = b"JUNKJUNK" + b"\x00" * 64
+            self.send_response(200)
+            self.send_header("Content-Type", "application/octet-stream")
+            self.send_header("Content-Length", str(len(blob)))
+            self.end_headers()
+            self.wfile.write(blob)
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.HTTPServer(("127.0.0.1", 0), Junk)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        b = BackendClient(
+            f"127.0.0.1:{srv.server_port}",
+            BackendConfig(connect_timeout_s=5.0, read_timeout_s=10.0),
+        )
+        with pytest.raises(BackendError) as ei:
+            b.kv_pages(0)
+        assert ei.value.retryable
+    finally:
+        srv.shutdown()
+        t.join(5)
+
+
+@pytest.mark.chaos
+def test_prefill_host_sigkill_falls_back_colocated(trio):
+    """Kill the prefill host AFTER the router has cached it healthy:
+    every disagg-eligible request must still complete — served
+    colocated on the surviving decode host through the ordinary
+    resubmission machinery — with nothing hung and every response
+    either 200 or 503-with-Retry-After."""
+    _, dec_addr, ctl_addr = trio
+    proc, pre_addr = _spawn_backend(extra_env=_disagg_env("prefill"))
+    try:
+        clients = _clients([pre_addr, dec_addr])
+        router = _disagg_router(clients)
+        server = make_server(router, port=0)
+        t = threading.Thread(target=server.serve_forever, daemon=True)
+        t.start()
+        try:
+            assert clients[0].role == "prefill"  # cached healthy...
+            proc.send_signal(signal.SIGKILL)     # ...then gone
+            proc.wait(timeout=10)
+
+            base = f"http://127.0.0.1:{server.server_port}"
+            body = {"tokens": _PROMPT, "max_new_tokens": 8}
+            results = [None] * 4
+
+            def worker(i):
+                try:
+                    results[i] = _post(base, "/v1/completions", body)
+                except urllib.error.HTTPError as e:
+                    assert e.code == 503
+                    assert e.headers.get("Retry-After")
+                    results[i] = (503, None)
+
+            threads = [threading.Thread(target=worker, args=(i,))
+                       for i in range(len(results))]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join(120)
+            _, ctl = _post(f"http://{ctl_addr}", "/v1/completions", body)
+            assert all(r is not None for r in results), "a request hung"
+            oks = [out for st, out in results if st == 200]
+            assert oks, results
+            for out in oks:
+                assert out["tokens"] == ctl["tokens"]
+            c = router.counters()
+            assert c["resubmissions"] >= 1
+            assert c["disagg_fallbacks"] >= 1
+            assert c["disagg_handoffs"] == 0
+        finally:
+            server.shutdown()
+            server.runner.shutdown()
+            t.join(5)
+    finally:
+        if proc.poll() is None:
+            proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=10)
+
+
+def test_breakeven_forced_loss_serves_colocated(trio):
+    """Seed the transfer EMAs with a hopeless link (and the decode
+    host's health with a fast prefill rate): the router must not even
+    attempt the handoff — colocated service, breakeven-loss counter."""
+    clients = _clients(trio[:2])
+    router = _disagg_router(clients)
+    dec = clients[1]
+    assert dec.health is not None
+    # A measured world where migration always loses: ~1 byte/ms link,
+    # huge pages, decode host prefilling 100 tok/ms.
+    router._xfer_bytes_per_ms = 1.0
+    router._xfer_bytes_per_token = 1e6
+    dec.health = dict(dec.health, prefill_tok_per_ms=100.0)
+    assert not router._disagg_wins(len(_PROMPT), dec)
+
+    server = make_server(router, port=0)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    try:
+        base = f"http://127.0.0.1:{server.server_port}"
+        body = {"tokens": _PROMPT, "max_new_tokens": 8}
+        status, out = _post(base, "/v1/completions", body)
+        assert status == 200
+        _, ctl = _post(f"http://{trio[2]}", "/v1/completions", body)
+        assert out["tokens"] == ctl["tokens"]
+        c = router.counters()
+        assert c["disagg_breakeven_losses"] >= 1
+        assert c["disagg_handoffs"] == 0
+    finally:
+        server.shutdown()
+        server.runner.shutdown()
+        t.join(5)
+
+
+def test_disagg_wins_explores_when_unmeasured(trio):
+    """Either side unmeasured -> attempt the handoff (the EMAs need a
+    sample before the comparison means anything)."""
+    clients = _clients(trio[:2])
+    router = _disagg_router(clients)
+    dec = clients[1]
+    router._xfer_bytes_per_ms = None
+    router._xfer_bytes_per_token = None
+    assert router._disagg_wins(48, dec)
+    router._xfer_bytes_per_ms = 1000.0
+    router._xfer_bytes_per_token = 100.0
+    dec.health = dict(dec.health or {}, prefill_tok_per_ms=None)
+    assert router._disagg_wins(48, dec)
+
+
+def test_cli_refuses_role_without_host_kv_tier():
+    """serve --role prefill without the host KV tier is a
+    misconfiguration the CLI refuses loudly, with the one-line fix."""
+    import argparse
+
+    import jax
+
+    from shifu_tpu.cli import build_serve_engine
+    from shifu_tpu.data.tokenizer import ByteTokenizer
+    from shifu_tpu.infer import PagedEngine
+    from shifu_tpu.models import Transformer, TransformerConfig
+
+    model = Transformer(TransformerConfig.tiny())
+    params = model.init(jax.random.key(0))
+    tok = ByteTokenizer()
+
+    def args(**over):
+        base = dict(
+            family="transformer", preset="tiny", moe_experts=0, attn=None,
+            optimizer="adamw", schedule="constant", lr=3e-4, warmup=0,
+            ckpt_dir=None, seed=0, tokenizer=None, host="127.0.0.1",
+            port=0, max_slots=2, max_len=64, max_new_tokens=16,
+            temperature=0.0, top_p=0.95, decode_chunk=1, eos_id=-1,
+            paged=False, page_size=8, n_pages=None, prefix_cache=False,
+            per_request_sampling=False, penalties=False, logit_bias=False,
+            spec="off", spec_k=3, spec_ngram=2, spec_rounds=2,
+            draft_preset=None, draft_ckpt_dir=None, kv_tier="off",
+            kv_host_bytes=64 << 20, role="both",
+        )
+        base.update(over)
+        return argparse.Namespace(**base)
+
+    for role in ("prefill", "decode"):
+        with pytest.raises(ValueError, match=f"--role {role}.*fix:"):
+            build_serve_engine(args(role=role, paged=True), model,
+                               params, tok)
+    # With the tier on, the role constructs — and flows to the server.
+    eng = build_serve_engine(
+        args(role="prefill", paged=True, prefix_cache=True,
+             kv_tier="host"),
+        model, params, tok,
+    )
+    assert type(eng) is PagedEngine
+    server = make_server(eng, port=0, role="prefill")
+    try:
+        assert server.RequestHandlerClass.role == "prefill"
+        with pytest.raises(ValueError, match="role"):
+            make_server(eng, port=0, role="bogus")
+    finally:
+        server.runner.shutdown()
