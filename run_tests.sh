@@ -20,6 +20,12 @@ fi
 # crash threshold. -x semantics hold per shard; later shards only run if
 # every earlier one is green (set -e).
 set -e
+# Cheap doc-conformance gate BEFORE the expensive sharded run: every
+# shifu_* metric family in the package must be documented in
+# docs/observability.md (obs/docscheck.py). Fails in ~a second instead
+# of minutes into the suite.
+env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+  python -m shifu_tpu obs check-docs > /dev/null
 MAX_TESTS_PER_SHARD=${MAX_TESTS_PER_SHARD:-220}
 
 mapfile -t SHARDS < <(

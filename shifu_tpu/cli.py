@@ -1106,6 +1106,39 @@ def _serve_fleet(args, spec: str) -> int:
     )
     if slo_cfg.active():
         watchdog = SLOWatchdog(slo_cfg)
+    # Fleet SLO engine (obs/slo.py): declared per-tier burn-rate
+    # budgets evaluated over the federated metrics pool, served on
+    # GET /sloz; breaches capture rate-limited cross-host incident
+    # bundles (obs/incident.py) under --incident-dir.
+    monitor = None
+    if args.slo_tier:
+        from shifu_tpu.obs import IncidentWriter, SLOEngine, SLOMonitor
+        from shifu_tpu.obs import parse_budget_spec
+
+        try:
+            budgets = [parse_budget_spec(s) for s in args.slo_tier]
+            slo = SLOEngine(
+                budgets,
+                fast_window_s=args.slo_fast_window,
+                slow_window_s=args.slo_slow_window,
+                sample_interval_s=args.slo_sample_interval,
+                metrics=router.metrics,
+                flight=router.flight,
+            )
+        except ValueError as e:
+            print(str(e), file=sys.stderr)
+            return 2
+        incident = IncidentWriter(
+            args.incident_dir,
+            min_interval_s=args.incident_min_interval,
+            metrics=router.metrics,
+            flight=router.flight,
+        )
+        router.set_slo(slo, incident)
+        monitor = SLOMonitor(
+            router.slo_report, interval_s=args.slo_sample_interval,
+        )
+        monitor.start()
     server = make_server(
         router,
         host=args.host,
@@ -1123,6 +1156,7 @@ def _serve_fleet(args, spec: str) -> int:
                 "serving": f"http://{args.host}:{server.server_port}",
                 "engine": "FleetRouter",
                 "backends": [b.addr for b in router.backends],
+                "slo_tiers": list(args.slo_tier or ()),
             }
         ),
         flush=True,
@@ -1135,6 +1169,8 @@ def _serve_fleet(args, spec: str) -> int:
         server.shutdown()
         server.runner.shutdown()
         router.prober.stop()
+        if monitor is not None:
+            monitor.stop()
     return 0
 
 
@@ -1146,6 +1182,12 @@ def cmd_serve(args) -> int:
     fleet_spec = args.fleet or os.environ.get("SHIFU_FLEET")
     if fleet_spec:
         return _serve_fleet(args, fleet_spec)
+    if args.slo_tier:
+        print(
+            "--slo-tier declares FLEET tier budgets and needs --fleet; "
+            "ignored here (the per-host watchdog uses --slo-p99-*)",
+            file=sys.stderr,
+        )
     model = _build_model(args)
     params = _restore_params(args, model)
     tok = _build_tokenizer(args)
@@ -1542,7 +1584,53 @@ def cmd_obs(args) -> int:
     ``shifu_tpu obs check-docs``: drift gate between the registered
     ``shifu_*`` metric families (source scan of the package) and
     docs/observability.md — exit 1 when telemetry shipped undocumented
-    or the doc names families no code registers."""
+    or the doc names families no code registers.
+
+    ``shifu_tpu obs incident list|show|export``: inspect the breach
+    incident bundles a fleet router captured (obs/incident.py) —
+    list summarises every bundle under ``--dir``, show prints one
+    manifest with per-file summaries (``--id``), export packs a bundle
+    into a ``.tar.gz`` (``--id`` + ``--out``).
+
+    ``shifu_tpu obs top``: live terminal dashboard polling a router's
+    /statz + /sloz (per-backend load/roles/health, tier burn rates);
+    ``--once`` renders a single frame and exits (scriptable)."""
+    if args.action == "incident":
+        from shifu_tpu.obs import incident as _inc
+
+        sub = args.sub or "list"
+        if sub not in ("list", "show", "export"):
+            print(f"unknown incident action {sub!r} "
+                  "(list | show | export)", file=sys.stderr)
+            return 2
+        root = args.dir
+        if sub == "list":
+            print(json.dumps(_inc.list_incidents(root), indent=2))
+            return 0
+        if not args.id:
+            print(f"obs incident {sub} requires --id", file=sys.stderr)
+            return 2
+        try:
+            if sub == "show":
+                print(json.dumps(
+                    _inc.show_incident(root, args.id), indent=2,
+                ))
+                return 0
+            out = args.out or f"{args.id}.tar.gz"
+            path = _inc.export_incident(root, args.id, out)
+            print(json.dumps({"exported": args.id, "out": path}))
+            return 0
+        except (OSError, ValueError) as e:
+            print(str(e), file=sys.stderr)
+            return 2
+    if args.action == "top":
+        from shifu_tpu.obs.top import run_top
+
+        return run_top(
+            args.url,
+            interval_s=args.interval,
+            iterations=1 if args.once else None,
+        )
     if args.action == "check-docs":
         import shifu_tpu
         from shifu_tpu.obs.docscheck import check_docs
@@ -1939,6 +2027,35 @@ def main(argv=None) -> int:
                    help="readiness gate requires EVERY roster entry "
                         "(default: any one backend suffices; the "
                         "prober brings stragglers in later)")
+    s.add_argument("--slo-tier", action="append", default=None,
+                   metavar="TIER:BUDGETS",
+                   help="ROUTER mode: declare one admission tier's SLO "
+                        "budget for the fleet SLO engine, e.g. "
+                        "'interactive:ttft=250,itl=40,err=0.01' "
+                        "(keys: ttft/itl p99 ms, err allowed error-"
+                        "rate, objective latency compliance target, "
+                        "default 0.99). Repeatable (one per tier). "
+                        "Serves GET /sloz with multi-window burn "
+                        "rates + headroom and captures incident "
+                        "bundles on breach")
+    s.add_argument("--slo-fast-window", type=float, default=60.0,
+                   help="fleet SLO fast burn window seconds (the "
+                        "'burning' early-warning window)")
+    s.add_argument("--slo-slow-window", type=float, default=900.0,
+                   help="fleet SLO slow burn window seconds (breached "
+                        "requires this window over budget with full "
+                        "coverage)")
+    s.add_argument("--slo-sample-interval", type=float, default=5.0,
+                   help="seconds between federated-pool snapshots / "
+                        "background SLO evaluations")
+    s.add_argument("--incident-dir", default="incidents",
+                   help="where breach incident bundles are written "
+                        "(timestamped directory + manifest each; "
+                        "inspect with `shifu_tpu obs incident`)")
+    s.add_argument("--incident-min-interval", type=float, default=900.0,
+                   help="rate limit: minimum seconds between incident "
+                        "bundles (a flapping budget produces one "
+                        "bundle per quiet period, not one per tick)")
     s.set_defaults(fn=cmd_serve)
 
     bt = sub.add_parser(
@@ -2105,10 +2222,32 @@ def main(argv=None) -> int:
              "tolerances (exit 1 on regression); check-tune diffs two "
              "tune-table artifacts (exit 1 when winners changed); "
              "check-docs gates registered shifu_* metric families "
-             "against docs/observability.md (exit 1 on drift)",
+             "against docs/observability.md (exit 1 on drift); "
+             "incident list/show/export inspects a fleet router's "
+             "breach bundles; top is a live /statz + /sloz dashboard",
     )
     ob.add_argument("action",
-                    choices=["check-bench", "check-tune", "check-docs"])
+                    choices=["check-bench", "check-tune", "check-docs",
+                             "incident", "top"])
+    ob.add_argument("sub", nargs="?", default=None,
+                    help="incident sub-action: list (default) | show "
+                         "| export")
+    ob.add_argument("--dir", default="incidents",
+                    help="incident: the bundle directory a router's "
+                         "--incident-dir wrote (default: incidents)")
+    ob.add_argument("--id",
+                    help="incident show/export: the bundle id (from "
+                         "`obs incident list`)")
+    ob.add_argument("--out",
+                    help="incident export: output .tar.gz path "
+                         "(default: <id>.tar.gz)")
+    ob.add_argument("--url", default="http://127.0.0.1:8000",
+                    help="top: the router/server base URL to poll")
+    ob.add_argument("--interval", type=float, default=2.0,
+                    help="top: seconds between dashboard refreshes")
+    ob.add_argument("--once", action="store_true",
+                    help="top: render one frame and exit (no screen "
+                         "clearing — scriptable)")
     ob.add_argument("--baseline",
                     help="baseline record (BENCH_rNN.json driver shape "
                          "or a raw compact line); required for "

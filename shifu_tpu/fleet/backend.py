@@ -477,6 +477,19 @@ class BackendClient:
         finally:
             conn.close()
 
+    def debugz(self, n: Optional[int] = None) -> dict:
+        """GET /debugz[?n=] — the backend's flight-recorder ring, tail-
+        limited to the last ``n`` events when given. Incident-bundle
+        captures (obs/incident.py) always pass ``n`` so a fleet-wide
+        forensics scrape is bounded per host instead of shipping every
+        full ring."""
+        path = "/debugz"
+        if n is not None:
+            path += f"?n={int(n)}"
+        return self._call_json(
+            "GET", path, None, self.cfg.probe_timeout_s
+        )
+
     def tracez(self, trace_id: str) -> dict:
         """GET /tracez?trace_id=... — the backend's span-store slice
         for one distributed trace (host documents with paired

@@ -275,6 +275,30 @@ class FleetRouter:
             "1 while the rollout wave is paused on an SLO breach",
         ).labels()
         self._rollout: Optional[dict] = None  # /statz rollout block
+        # shifu_slo_* per-tier traffic counters: the fleet SLO engine's
+        # error-rate budget differences these over its burn windows
+        # (obs/slo.py). Pre-seeded per tier so window deltas start at
+        # an existing zero row instead of a missing series.
+        self._c_slo_requests = reg.counter(
+            "shifu_slo_requests_total",
+            "Requests finished at this router by admission tier "
+            "(completions + failures) — the fleet SLO engine's "
+            "error-rate denominator", labelnames=("tier",),
+        )
+        self._c_slo_errors = reg.counter(
+            "shifu_slo_errors_total",
+            "Requests that FAILED at this router by admission tier "
+            "(retry budget exhausted / non-retryable backend error) — "
+            "the error-rate numerator", labelnames=("tier",),
+        )
+        for t in ("interactive", "batch"):
+            self._c_slo_requests.labels(tier=t)
+            self._c_slo_errors.labels(tier=t)
+        # Fleet SLO engine + incident capture (obs/slo.py,
+        # obs/incident.py) — attached via set_slo(); None until then
+        # (slo_report answers None and /sloz serves an empty doc).
+        self._slo = None
+        self._incident = None
         self._g_budget.set(self.policy.budget)
         for b in self.backends:
             self._wire_backend(b)
@@ -964,6 +988,9 @@ class FleetRouter:
                 self._done.append(completion)
             elif error is not None:
                 self._done.append(("error", req.rid, error))
+        self._c_slo_requests.labels(tier=req.tier).inc()
+        if error is not None:
+            self._c_slo_errors.labels(tier=req.tier).inc()
         self._progress.set()
 
     # ------------------------------------------------------ driving
@@ -1297,6 +1324,74 @@ class FleetRouter:
             return None
         return _dtrace.quantile_from_pooled(pooled, family, q, labels)
 
+    # --------------------------------------------------- fleet SLO engine
+    def set_slo(self, slo, incident=None) -> None:
+        """Attach the fleet SLO engine (obs/slo.py) and, optionally,
+        the incident-bundle writer (obs/incident.py). The engine's
+        breach transitions route through :meth:`_on_slo_breach` so a
+        burning tier captures cross-host forensics automatically."""
+        self._slo = slo
+        self._incident = incident
+        if slo is not None:
+            slo.on_breach = self._on_slo_breach
+
+    def recent_trace_ids(self, n: int = 3) -> List[str]:
+        """The router span store's newest trace ids — the incident
+        capture's merged-trace selection."""
+        return self._span_store.recent(n)
+
+    def _slo_sample(self) -> Dict[tuple, float]:
+        """One pooled sample for the SLO engine: a fresh federation
+        scrape (the backends' tier-labelled latency histograms, pooled
+        per ``le`` edge) merged with this router's OWN registry parse
+        (the per-tier request/error counters live here)."""
+        from shifu_tpu.obs.registry import parse_exposition
+
+        self.federated_metrics()
+        with self._fed_lock:
+            merged = dict(self._fed_pooled)
+        merged.update(parse_exposition(self.metrics.render()))
+        return merged
+
+    def slo_report(self) -> Optional[dict]:
+        """ENGINE_INTERFACE ``slo_report`` — the ``GET /sloz`` payload.
+        None when no SLO engine is attached (in-process engines, fleet
+        routers without declared budgets). Sampling is pull-driven with
+        a minimum interval: /sloz scrapes and the SLOMonitor thread
+        both land here, and the engine decides when a new federation
+        scrape is due."""
+        slo = self._slo
+        if slo is None:
+            return None
+        if slo.sample_due():
+            slo.note(self._slo_sample())
+        return slo.evaluate()
+
+    def _on_slo_breach(self, tier: str, info: dict) -> None:
+        """A tier left ``ok``: capture a cross-host incident bundle in
+        the background (the capture makes fleet-wide HTTP fetches — it
+        must not stall the evaluation path that detected the breach).
+        Rate limiting lives in the writer, checked atomically, so a
+        flapping budget produces one bundle per quiet period."""
+        inc = self._incident
+        if inc is None:
+            return
+        reason = (
+            f"tier {tier} {info.get('status')}: burn_rate "
+            f"{info.get('burn_rate')}, headroom {info.get('headroom')}"
+        )
+        slo_doc = {"tiers": {tier: info}}
+
+        def _capture():
+            try:
+                inc.capture(self, tier=tier, reason=reason, slo=slo_doc)
+            except Exception:  # noqa: BLE001 — forensics best-effort
+                pass
+
+        threading.Thread(
+            target=_capture, name=f"shifu-incident-{tier}", daemon=True,
+        ).start()
+
     # ENGINE_INTERFACE KV-handoff surface: the router fronts no page
     # pool — its /kv/pages routes answer 404 (no payload) and 400 (no
     # pool); the real surfaces live on the prefill/decode hosts.
@@ -1327,9 +1422,11 @@ class FleetRouter:
         return out
 
     def fleet_stats(self) -> dict:
-        """The /statz fleet block: one row per backend (healthz status,
-        remote queue depth, breaker state, EWMA latency) + the shared
-        retry budget."""
+        """The /statz fleet block: one row per backend (healthz status
+        + the backend watchdog's reason strings, remote queue depth,
+        breaker state, EWMA latency) + the shared retry budget. The
+        watchdog fields mirror each host's own /healthz so a degraded
+        backend is visible from the ROUTER's one pane of glass."""
         rows = []
         for b in self.backends:
             h = b.health or {}
@@ -1338,6 +1435,9 @@ class FleetRouter:
                 "status": b.status(),
                 "breaker": b.breaker.state,
                 "healthz": h.get("status"),
+                "healthz_reasons": list(
+                    h.get("degraded_reasons") or ()
+                ),
                 "queue_depth": b.queue_depth(),
                 "in_flight": b.in_flight,
                 "routed": b.routed,

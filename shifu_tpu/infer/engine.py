@@ -216,6 +216,11 @@ ENGINE_INTERFACE = frozenset({
     # router's ``shifu_fleet_agg_*`` exposition block appended to
     # /metrics ("" for in-process engines — no fleet to aggregate).
     "trace_spans", "host_label", "federated_metrics",
+    # fleet SLO engine (obs/slo.py): ``slo_report`` answers ``GET
+    # /sloz`` with per-tier burn-rate/headroom state — real on a
+    # fleet router with declared tier budgets, None everywhere else
+    # (the route then serves an empty tiers doc).
+    "slo_report",
     # prefill/decode disaggregation (fleet/router.py): the KV-handoff
     # wire surface. ``kv_export_payload`` answers ``GET /kv/pages?rid=``
     # with the serialized page chain a ``kv_export`` admission filed
@@ -1254,6 +1259,13 @@ class Engine:
         handler appends to the local scrape — empty for in-process
         engines (only the fleet router has backends to aggregate)."""
         return ""
+
+    def slo_report(self):
+        """The ``GET /sloz`` per-tier burn-rate document, or None —
+        only a fleet router with declared tier budgets evaluates one
+        (obs/slo.py); the per-host watchdog verdict stays on /healthz
+        and /statz."""
+        return None
 
     def _kv_export_ok(self) -> bool:
         """May ``submit(kv_export=True)`` be honoured? Only a paged

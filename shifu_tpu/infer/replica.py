@@ -328,6 +328,12 @@ class ReplicatedEngine:
         through this process's own registry already."""
         return ""
 
+    def slo_report(self):
+        """No fleet SLO engine — per-tier burn budgets are evaluated
+        at a fleet router (obs/slo.py); dp replicas answer None and
+        /sloz serves an empty tiers doc."""
+        return None
+
     def reload_params(self, params) -> None:
         """Hot-swap serving weights on EVERY replica (each re-places
         the tree onto its own sub-mesh via its live leaf shardings).

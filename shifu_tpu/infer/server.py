@@ -1456,6 +1456,19 @@ class _Handler(BaseHTTPRequestHandler):
 
             out["kernels"] = _kreg.kernels_status()
             self._send(200, out)
+        elif self.path == "/sloz":
+            # Fleet SLO engine (ENGINE_INTERFACE "slo_report" —
+            # obs/slo.py): per-tier multi-window burn rates, status
+            # (ok | burning | breached), and remaining error-budget
+            # headroom, evaluated at a fleet router over the federated
+            # metrics pool. Engines without one (in-process, or a
+            # router with no declared budgets) answer an empty tiers
+            # doc so scrapers need no status special-casing.
+            eng = self.runner.engine
+            doc = eng.slo_report()
+            if doc is None:
+                doc = {"tiers": {}, "enabled": False}
+            self._send(200, doc)
         elif self.path == "/cachez":
             # Prefix-cache + host-KV-tier occupancy and hit rates
             # (ENGINE_INTERFACE "cache_stats") — the per-backend scrape

@@ -36,6 +36,18 @@ Modules:
                estimation from prober round trips, cross-host trace
                merging into one Chrome trace, and /metrics federation
                (``shifu_fleet_agg_*``).
+``slo``        the FLEET SLO engine: per-tier (interactive/batch)
+               burn-rate budgets — p99 TTFT/ITL + error rate — over
+               fast/slow windows of the federated metrics pool,
+               serving ``GET /sloz`` (status / burn_rate / headroom)
+               and the ``shifu_slo_burn_rate{tier,window}`` gauges.
+``incident``   cross-host incident bundles: on an SLO breach the
+               router freezes every backend's /debugz ring, the merged
+               recent traces, and a federated metrics snapshot into a
+               timestamped directory with a manifest (rate-limited;
+               ``shifu_tpu obs incident list|show|export``).
+``top``        ``shifu_tpu obs top``: a live /statz + /sloz terminal
+               dashboard (pure-function frame rendering, curses-free).
 ``compilemon`` compile telemetry (per-jitted-function recompile
                counters/latencies + the jax.monitoring mirror) and
                sampled HBM gauges.
@@ -61,6 +73,13 @@ from shifu_tpu.obs.disttrace import (
     merge_host_docs,
     parse_header,
 )
+from shifu_tpu.obs.slo import (
+    SLOEngine,
+    SLOMonitor,
+    TierBudget,
+    parse_budget_spec,
+)
+from shifu_tpu.obs.incident import IncidentWriter
 
 # The process-global default registry (see module docstring).
 REGISTRY = MetricsRegistry()
@@ -70,17 +89,22 @@ __all__ = [
     "DEFAULT_BUCKETS",
     "FLIGHT",
     "FlightRecorder",
+    "IncidentWriter",
     "MetricsRegistry",
     "REGISTRY",
     "SLOConfig",
+    "SLOEngine",
+    "SLOMonitor",
     "SLOWatchdog",
     "SpanStore",
+    "TierBudget",
     "TraceContext",
     "chrome_trace",
     "ensure_context",
     "export_trace_log",
     "fetch_and_merge",
     "merge_host_docs",
+    "parse_budget_spec",
     "parse_exposition",
     "parse_header",
 ]

@@ -156,6 +156,14 @@ class SpanStore:
         with self._lock:
             return list(self._traces.get(str(trace_id or ""), ()))
 
+    def recent(self, n: int = 3) -> List[str]:
+        """The last ``n`` trace ids by insertion order, newest first —
+        the incident-bundle capture's "what just happened" selection
+        (obs/incident.py merges these across hosts)."""
+        with self._lock:
+            ids = list(self._traces)
+        return ids[::-1][:max(int(n), 0)]
+
     def __len__(self) -> int:
         with self._lock:
             return len(self._traces)

@@ -1,0 +1,302 @@
+"""Cross-host incident bundles: automatic forensics on an SLO breach.
+
+When a tier's error budget starts burning (obs/slo.py), the state that
+explains WHY is spread across the fleet and about to be overwritten:
+each backend's flight-recorder ring wraps, span stores evict, and the
+federated counters keep moving. An incident bundle freezes all of it
+into one timestamped on-disk directory the moment the breach is
+detected:
+
+  * ``flight_router.json`` — the router's own flight ring tail;
+  * ``flight_<backend>.json`` — every reachable backend's ``GET
+    /debugz?n=K`` document (the tail limit bounds the fleet-wide
+    scrape's payload — a 64-host fleet must not ship 64 full rings);
+  * ``trace_<id>.json`` — the most recent distributed traces, each
+    merged across hosts exactly like ``shifu_tpu trace export`` does
+    (clock offsets applied);
+  * ``metrics_federated.prom`` / ``metrics_router.prom`` — the pooled
+    ``shifu_fleet_agg_*`` exposition and the router's own registry;
+  * ``slo.json`` — the breaching tier's /sloz block;
+  * ``manifest.json`` — what was captured, from whom, what failed.
+
+Captures are RATE-LIMITED (``min_interval_s``): a flapping budget must
+produce one bundle per quiet period, not one per evaluation tick — the
+check-and-reserve is atomic so concurrent breach paths (the monitor
+thread racing a /sloz scrape) still write exactly one. Per-backend
+fetch failures are recorded in the manifest instead of failing the
+bundle — a dead host is usually the STORY, and its absence is itself
+evidence.
+
+Inspect with ``shifu_tpu obs incident list | show | export`` (cli.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import tarfile
+import threading
+import time
+from typing import Callable, List, Optional
+
+MANIFEST = "manifest.json"
+_ID_RE = re.compile(r"^[A-Za-z0-9._-]+$")
+
+
+def _safe_name(s: str) -> str:
+    return re.sub(r"[^A-Za-z0-9._-]", "_", str(s))
+
+
+class IncidentWriter:
+    """Rate-limited bundle capture into ``root``.
+
+    ``debug_tail`` bounds each backend ``/debugz`` fetch (the ``?n=``
+    tail limit); ``max_traces`` bounds how many recent distributed
+    traces are merged into the bundle. ``clock`` (monotonic-like) is
+    injectable for the rate-limit tests; directory names use the wall
+    clock."""
+
+    def __init__(self, root: str, *, min_interval_s: float = 900.0,
+                 debug_tail: int = 256, max_traces: int = 3,
+                 clock: Callable[[], float] = time.monotonic,
+                 metrics=None, flight=None):
+        from shifu_tpu import obs as _obs
+
+        self.root = str(root)
+        self.min_interval_s = float(min_interval_s)
+        self.debug_tail = int(debug_tail)
+        self.max_traces = int(max_traces)
+        self.clock = clock
+        self.flight = flight if flight is not None else _obs.FLIGHT
+        reg = metrics if metrics is not None else _obs.REGISTRY
+        self._c_incidents = reg.counter(
+            "shifu_slo_incidents_total",
+            "Incident bundles captured (rate-limited breach "
+            "forensics)", labelnames=("tier",),
+        )
+        self._lock = threading.Lock()
+        self._last: Optional[float] = None
+        self.captured = 0
+        self.suppressed = 0
+
+    # ----------------------------------------------------- capture
+    def capture(self, source, *, tier: str, reason: str,
+                slo: Optional[dict] = None) -> Optional[str]:
+        """Capture one bundle from ``source`` (a FleetRouter-shaped
+        object: ``flight`` / ``backends`` / ``trace_spans`` /
+        ``recent_trace_ids`` / ``federated_metrics`` / ``metrics`` —
+        every facet optional, missing ones are skipped). Returns the
+        bundle directory path, or None when rate-limited."""
+        with self._lock:
+            now = self.clock()
+            if self._last is not None and (
+                now - self._last < self.min_interval_s
+            ):
+                self.suppressed += 1
+                return None
+            self._last = now
+
+        wall = time.time()
+        stamp = time.strftime("%Y%m%d_%H%M%S", time.gmtime(wall))
+        base = f"incident_{stamp}_{_safe_name(tier)}"
+        path = os.path.join(self.root, base)
+        n = 2
+        while os.path.exists(path):
+            path = os.path.join(self.root, f"{base}_{n}")
+            n += 1
+        os.makedirs(path)
+        incident_id = os.path.basename(path)
+
+        files: List[dict] = []
+        backends_report: dict = {}
+        errors: List[str] = []
+
+        def write_json(name: str, doc) -> None:
+            p = os.path.join(path, name)
+            with open(p, "w", encoding="utf-8") as f:
+                json.dump(doc, f)
+                f.write("\n")
+            files.append({"name": name, "bytes": os.path.getsize(p)})
+
+        def write_text(name: str, text: str) -> None:
+            p = os.path.join(path, name)
+            with open(p, "w", encoding="utf-8") as f:
+                f.write(text)
+            files.append({"name": name, "bytes": os.path.getsize(p)})
+
+        # Router's own flight ring tail.
+        fl = getattr(source, "flight", None)
+        if fl is not None:
+            try:
+                write_json("flight_router.json", {
+                    "capacity": fl.capacity, "dropped": fl.dropped,
+                    "events": fl.snapshot(last=self.debug_tail),
+                })
+            except Exception as e:  # noqa: BLE001 — best-effort
+                errors.append(f"flight_router: {e}")
+
+        # Every backend's bounded /debugz ring.
+        for b in getattr(source, "backends", None) or ():
+            if getattr(b, "detached", False):
+                continue
+            try:
+                doc = b.debugz(n=self.debug_tail)
+            except Exception as e:  # noqa: BLE001 — dead host IS data
+                backends_report[b.addr] = f"error: {e}"
+                continue
+            write_json(f"flight_{_safe_name(b.addr)}.json", doc)
+            backends_report[b.addr] = "ok"
+
+        # Most recent distributed traces, merged across hosts.
+        trace_ids: List[str] = []
+        recent = getattr(source, "recent_trace_ids", None)
+        if callable(recent):
+            try:
+                trace_ids = list(recent(self.max_traces))
+            except Exception as e:  # noqa: BLE001
+                errors.append(f"recent_trace_ids: {e}")
+        spans = getattr(source, "trace_spans", None)
+        if callable(spans) and trace_ids:
+            from shifu_tpu.obs.disttrace import merge_host_docs
+
+            for tid in trace_ids:
+                try:
+                    merged = merge_host_docs(spans(tid), trace_id=tid)
+                    write_json(f"trace_{_safe_name(tid)}.json", merged)
+                except Exception as e:  # noqa: BLE001
+                    errors.append(f"trace {tid}: {e}")
+
+        # Federated + local metric snapshots.
+        fed = getattr(source, "federated_metrics", None)
+        if callable(fed):
+            try:
+                write_text("metrics_federated.prom", fed() or "")
+            except Exception as e:  # noqa: BLE001
+                errors.append(f"federated_metrics: {e}")
+        reg = getattr(source, "metrics", None)
+        if reg is not None:
+            try:
+                write_text("metrics_router.prom", reg.render())
+            except Exception as e:  # noqa: BLE001
+                errors.append(f"metrics_router: {e}")
+
+        if slo is not None:
+            write_json("slo.json", slo)
+
+        manifest = {
+            "id": incident_id,
+            "captured_at": wall,
+            "tier": str(tier),
+            "reason": str(reason),
+            "backends": backends_report,
+            "traces": trace_ids,
+            "errors": errors,
+            "files": files,
+        }
+        with open(os.path.join(path, MANIFEST), "w",
+                  encoding="utf-8") as f:
+            json.dump(manifest, f, indent=2)
+            f.write("\n")
+
+        self.captured += 1
+        self._c_incidents.labels(tier=str(tier)).inc()
+        self.flight.record(
+            "incident_captured", tier=str(tier), reason=str(reason),
+            path=path, backends=len(backends_report),
+        )
+        return path
+
+
+# -------------------------------------------------------- inspection
+def _check_id(incident_id: str) -> str:
+    iid = str(incident_id)
+    if not _ID_RE.match(iid):
+        raise ValueError(f"bad incident id {iid!r}")
+    return iid
+
+
+def list_incidents(root: str) -> List[dict]:
+    """Bundle summaries under ``root``, newest first (the ``obs
+    incident list`` payload). Directories without a readable manifest
+    are reported with an ``error`` field instead of being hidden."""
+    out: List[dict] = []
+    if not os.path.isdir(root):
+        return out
+    for name in sorted(os.listdir(root), reverse=True):
+        mpath = os.path.join(root, name, MANIFEST)
+        if not os.path.isfile(mpath):
+            continue
+        try:
+            with open(mpath, encoding="utf-8") as f:
+                m = json.load(f)
+            out.append({
+                "id": m.get("id", name),
+                "captured_at": m.get("captured_at"),
+                "tier": m.get("tier"),
+                "reason": m.get("reason"),
+                "files": len(m.get("files", ())),
+                "backends": m.get("backends", {}),
+            })
+        except (OSError, ValueError) as e:
+            out.append({"id": name, "error": str(e)})
+    out.sort(key=lambda r: r.get("captured_at") or 0, reverse=True)
+    return out
+
+
+def load_manifest(root: str, incident_id: str) -> dict:
+    iid = _check_id(incident_id)
+    mpath = os.path.join(root, iid, MANIFEST)
+    with open(mpath, encoding="utf-8") as f:
+        return json.load(f)
+
+
+def show_incident(root: str, incident_id: str) -> dict:
+    """Manifest plus a per-file summary (event/sample counts) — the
+    ``obs incident show`` payload."""
+    m = load_manifest(root, incident_id)
+    path = os.path.join(root, _check_id(incident_id))
+    summaries = {}
+    for ent in m.get("files", ()):
+        name = ent.get("name", "")
+        p = os.path.join(path, name)
+        try:
+            if name.endswith(".json"):
+                with open(p, encoding="utf-8") as f:
+                    doc = json.load(f)
+                if "events" in doc:
+                    summaries[name] = {"events": len(doc["events"])}
+                elif "traceEvents" in doc:
+                    summaries[name] = {
+                        "trace_events": len(doc["traceEvents"]),
+                        "hosts": doc.get("otherData", {}).get("hosts"),
+                    }
+                elif "tiers" in doc:
+                    summaries[name] = {
+                        t: d.get("status")
+                        for t, d in doc["tiers"].items()
+                    }
+                else:
+                    summaries[name] = {"keys": sorted(doc)[:8]}
+            else:
+                with open(p, encoding="utf-8") as f:
+                    summaries[name] = {
+                        "lines": sum(1 for _ in f),
+                    }
+        except (OSError, ValueError) as e:
+            summaries[name] = {"error": str(e)}
+    m["summaries"] = summaries
+    return m
+
+
+def export_incident(root: str, incident_id: str, out_path: str) -> str:
+    """Pack one bundle directory into a ``.tar.gz`` at ``out_path``
+    (the ``obs incident export`` payload — hand the whole incident to
+    another human in one file)."""
+    iid = _check_id(incident_id)
+    src = os.path.join(root, iid)
+    if not os.path.isfile(os.path.join(src, MANIFEST)):
+        raise FileNotFoundError(f"no incident {iid!r} under {root!r}")
+    with tarfile.open(out_path, "w:gz") as tar:
+        tar.add(src, arcname=iid)
+    return out_path

@@ -1,0 +1,511 @@
+"""Fleet SLO engine: per-tier burn-rate budgets over federated metrics.
+
+The per-host :class:`~shifu_tpu.obs.watchdog.SLOWatchdog` answers "is
+THIS host degraded right now"; this module answers the fleet question
+the ROADMAP's autoscaling and loadgen items consume: "how fast is each
+admission tier spending its error budget, and how much headroom is
+left". It is evaluated at the fleet router from the same pooled
+federated ``/metrics`` samples the ``shifu_fleet_agg_*`` families are
+rendered from, so the SLO verdict and the dashboards literally share
+one measurement.
+
+Mechanics (the multi-window burn-rate pattern):
+
+  * A :class:`TierBudget` declares, per admission tier (interactive /
+    batch), the latency thresholds (p99 TTFT / p99 ITL) and an allowed
+    error-rate, plus the ``objective`` — the fraction of requests that
+    must meet each latency threshold (default 0.99, i.e. a p99
+    budget: 1% of requests may exceed it).
+  * The engine keeps timestamped snapshots of the pooled sample dict.
+    For each evaluation window (fast ~1m, slow ~15m) it differences
+    the cumulative histogram buckets / counters between now and the
+    window start — histogram ``_bucket`` samples are cumulative, so
+    the delta is the exact event count for the window.
+  * ``burn_rate = bad_fraction / allowed_fraction``: 1.0 means the
+    tier is spending its error budget exactly as fast as the budget
+    allows; >1 means the budget is burning. A tier is ``burning`` when
+    the FAST window burns >= 1 (responsive early warning) and
+    ``breached`` when the SLOW window — with full coverage — burns
+    too (sustained, not a blip). ``headroom`` is ``1 - burn`` on the
+    longest window with data: the remaining budget fraction an
+    autoscaler can spend before the tier breaches.
+
+Burn rates re-export as ``shifu_slo_burn_rate{tier,window}`` gauges
+(plus ``shifu_slo_headroom{tier}`` / ``shifu_slo_tier_state{tier}``)
+and the full document serves on ``GET /sloz``. On an ok -> burning /
+breached transition the engine fires ``on_breach`` — the router hooks
+the cross-host incident-bundle capture (obs/incident.py) there.
+
+Everything takes an injectable ``clock`` so the window math is tested
+on a deterministic clock (tests/test_slo.py), the repo-wide pattern
+(CircuitBreaker, FleetProber).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from shifu_tpu.obs.disttrace import AGG_PREFIX
+
+# Canonical latency families the budgets measure (the engines' own
+# tier-labelled request histograms; the router pools them under the
+# federation prefix). Values are seconds on the wire.
+TTFT_FAMILY = "shifu_request_ttft_seconds"
+ITL_FAMILY = "shifu_request_itl_seconds"
+# Router-local per-tier traffic counters (fleet/router.py registers
+# them) — the error-rate budget's numerator/denominator.
+REQUESTS_FAMILY = "shifu_slo_requests_total"
+ERRORS_FAMILY = "shifu_slo_errors_total"
+
+STATUS_OK = "ok"
+STATUS_BURNING = "burning"
+STATUS_BREACHED = "breached"
+_STATE_CODES = {STATUS_OK: 0, STATUS_BURNING: 1, STATUS_BREACHED: 2}
+
+
+@dataclasses.dataclass(frozen=True)
+class TierBudget:
+    """One admission tier's declared SLO. ``None`` budgets are not
+    evaluated; ``objective`` is the fraction of requests that must meet
+    each latency threshold (0.99 = p99 budgets with a 1% error
+    budget)."""
+
+    tier: str
+    p99_ttft_ms: Optional[float] = None
+    p99_itl_ms: Optional[float] = None
+    max_error_rate: Optional[float] = None
+    objective: float = 0.99
+
+    def __post_init__(self):
+        if not (0.0 < self.objective < 1.0):
+            raise ValueError(
+                f"objective must be in (0, 1), got {self.objective}"
+            )
+        if self.max_error_rate is not None and not (
+            0.0 < self.max_error_rate <= 1.0
+        ):
+            raise ValueError(
+                f"max_error_rate must be in (0, 1], got "
+                f"{self.max_error_rate}"
+            )
+        if not self.active():
+            raise ValueError(
+                f"tier {self.tier!r} declares no budget (need at least "
+                "one of ttft / itl / err)"
+            )
+
+    def active(self) -> bool:
+        return any(
+            v is not None for v in (
+                self.p99_ttft_ms, self.p99_itl_ms, self.max_error_rate
+            )
+        )
+
+
+def parse_budget_spec(spec: str) -> TierBudget:
+    """CLI budget string -> :class:`TierBudget`.
+
+    Format: ``tier:key=value,...`` with keys ``ttft`` (p99 TTFT ms),
+    ``itl`` (p99 ITL ms), ``err`` (allowed error-rate fraction),
+    ``objective`` (latency compliance target, default 0.99). Example:
+    ``interactive:ttft=250,itl=40,err=0.01``."""
+    head, sep, rest = str(spec).partition(":")
+    tier = head.strip()
+    if not sep or not tier:
+        raise ValueError(
+            f"budget spec {spec!r} must look like "
+            "'tier:ttft=250,itl=40,err=0.01'"
+        )
+    kw: dict = {}
+    keys = {
+        "ttft": "p99_ttft_ms", "itl": "p99_itl_ms",
+        "err": "max_error_rate", "objective": "objective",
+    }
+    for part in rest.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        k, sep2, v = part.partition("=")
+        k = k.strip()
+        if not sep2 or k not in keys:
+            raise ValueError(
+                f"budget spec {spec!r}: unknown key {k!r} "
+                f"(known: {sorted(keys)})"
+            )
+        try:
+            kw[keys[k]] = float(v)
+        except ValueError:
+            raise ValueError(
+                f"budget spec {spec!r}: {k}={v!r} is not a number"
+            ) from None
+    return TierBudget(tier=tier, **kw)
+
+
+# ------------------------------------------------------- window math
+def _agg(name: str) -> str:
+    if name.startswith("shifu_") and not name.startswith(AGG_PREFIX):
+        return AGG_PREFIX + name[len("shifu_"):]
+    return name
+
+
+def _bucket_acc(samples: Dict[tuple, float], family: str,
+                labels: Dict[str, str]) -> Dict[float, float]:
+    """Pool a family's cumulative ``_bucket`` samples (every series
+    whose labels are a superset of ``labels``) -> {le_edge: count}."""
+    bucket_name = _agg(family) + "_bucket"
+    want = {k: str(v) for k, v in labels.items()}
+    acc: Dict[float, float] = {}
+    for (sname, slabels), val in samples.items():
+        if sname != bucket_name:
+            continue
+        ld = dict(slabels)
+        le = ld.pop("le", None)
+        if le is None:
+            continue
+        if any(ld.get(k) != v for k, v in want.items()):
+            continue
+        edge = math.inf if le in ("+Inf", "inf") else float(le)
+        acc[edge] = acc.get(edge, 0.0) + val
+    return acc
+
+
+def _counter_sum(samples: Dict[tuple, float], family: str,
+                 labels: Dict[str, str]) -> float:
+    """Sum a counter family's samples whose labels are a superset of
+    ``labels`` (both the local name and its federated twin count — the
+    router's own counters parse under their original names)."""
+    names = {family, _agg(family)}
+    want = {k: str(v) for k, v in labels.items()}
+    total = 0.0
+    for (sname, slabels), val in samples.items():
+        if sname not in names:
+            continue
+        ld = dict(slabels)
+        # Skip per-backend federated duplicates of a pooled series.
+        if sname != family and "backend" in ld:
+            continue
+        if any(ld.get(k) != v for k, v in want.items()):
+            continue
+        total += val
+    return total
+
+
+def _delta_acc(now_acc: Dict[float, float],
+               base_acc: Dict[float, float]) -> Dict[float, float]:
+    """Windowed bucket counts: cumulative-now minus cumulative-at-
+    window-start, clamped at 0 per edge (a backend restart resets its
+    counters; a negative delta must not poison the fraction)."""
+    out: Dict[float, float] = {}
+    for edge, val in now_acc.items():
+        out[edge] = max(val - base_acc.get(edge, 0.0), 0.0)
+    return out
+
+
+def fraction_over(acc: Dict[float, float],
+                  threshold_s: float) -> Tuple[float, float]:
+    """(events over ``threshold_s``, total events) from one windowed
+    cumulative-bucket delta. The count at the threshold interpolates
+    linearly inside the containing bucket (the same model the
+    registry's quantile estimator uses); past the last finite edge
+    only the ``+Inf`` remainder counts as over."""
+    if not acc:
+        return 0.0, 0.0
+    edges = sorted(e for e in acc if e != math.inf)
+    total = acc.get(math.inf, acc[edges[-1]] if edges else 0.0)
+    if total <= 0.0 or not edges:
+        return 0.0, max(total, 0.0)
+    thr = float(threshold_s)
+    prev_edge, prev_cum = 0.0, 0.0
+    under = None
+    for e in edges:
+        cum = acc[e]
+        if thr <= e:
+            width = e - prev_edge
+            frac = (thr - prev_edge) / width if width > 0 else 1.0
+            under = prev_cum + (cum - prev_cum) * min(max(frac, 0.0), 1.0)
+            break
+        prev_edge, prev_cum = e, cum
+    if under is None:
+        # Threshold beyond the last finite edge: everything up to that
+        # edge is under; only the +Inf remainder is (possibly) over.
+        under = acc[edges[-1]]
+    under = min(max(under, 0.0), total)
+    return total - under, total
+
+
+class SLOEngine:
+    """Multi-window burn-rate evaluation over pooled metric snapshots.
+
+    ``budgets`` — :class:`TierBudget` list. ``note(samples)`` records
+    one timestamped snapshot of the pooled sample dict (the router
+    feeds it from its federation scrape + its own registry);
+    ``evaluate()`` differences the fast/slow windows, updates the
+    ``shifu_slo_*`` gauges, fires ``on_breach(tier, info)`` on an
+    ok -> burning/breached transition, and returns the ``/sloz``
+    document. ``clock`` must be monotonic-like; tests inject a fake.
+    """
+
+    def __init__(self, budgets: List[TierBudget], *,
+                 fast_window_s: float = 60.0,
+                 slow_window_s: float = 900.0,
+                 sample_interval_s: float = 5.0,
+                 burn_threshold: float = 1.0,
+                 metrics=None, flight=None,
+                 clock: Callable[[], float] = time.monotonic,
+                 on_breach=None):
+        if not budgets:
+            raise ValueError("need at least one tier budget")
+        tiers = [b.tier for b in budgets]
+        if len(set(tiers)) != len(tiers):
+            raise ValueError(f"duplicate tier budgets: {tiers}")
+        if not (0.0 < fast_window_s < slow_window_s):
+            raise ValueError(
+                f"need 0 < fast_window_s ({fast_window_s}) < "
+                f"slow_window_s ({slow_window_s})"
+            )
+        from shifu_tpu import obs as _obs
+
+        self.budgets = {b.tier: b for b in budgets}
+        self.fast_window_s = float(fast_window_s)
+        self.slow_window_s = float(slow_window_s)
+        self.sample_interval_s = float(sample_interval_s)
+        self.burn_threshold = float(burn_threshold)
+        self.metrics = metrics if metrics is not None else _obs.REGISTRY
+        self.flight = flight if flight is not None else _obs.FLIGHT
+        self.clock = clock
+        self.on_breach = on_breach
+        self._lock = threading.Lock()
+        self._snaps: List[Tuple[float, Dict[tuple, float]]] = []
+        self._state: Dict[str, str] = {t: STATUS_OK for t in self.budgets}
+
+        reg = self.metrics
+        self._g_burn = reg.gauge(
+            "shifu_slo_burn_rate",
+            "Error-budget burn rate per admission tier and evaluation "
+            "window (1.0 = spending the budget exactly at the allowed "
+            "rate; >1 = burning)", labelnames=("tier", "window"),
+        )
+        self._g_headroom = reg.gauge(
+            "shifu_slo_headroom",
+            "Remaining error-budget fraction per tier on the longest "
+            "window with data (1 - burn_rate; negative = over budget)",
+            labelnames=("tier",),
+        )
+        self._g_state = reg.gauge(
+            "shifu_slo_tier_state",
+            "Tier SLO state: 0 ok, 1 burning (fast window over "
+            "threshold), 2 breached (slow window too, full coverage)",
+            labelnames=("tier",),
+        )
+        self._c_breaches = reg.counter(
+            "shifu_slo_tier_breaches_total",
+            "ok -> burning/breached transitions per tier (each one may "
+            "trigger a rate-limited incident bundle)",
+            labelnames=("tier",),
+        )
+        for t in self.budgets:
+            for w in ("fast", "slow"):
+                self._g_burn.labels(tier=t, window=w)
+            self._g_headroom.labels(tier=t).set(1.0)
+            self._g_state.labels(tier=t).set(0.0)
+            self._c_breaches.labels(tier=t)
+
+    # ----------------------------------------------------- sampling
+    def sample_due(self) -> bool:
+        """Is it time for the owner to feed another snapshot? (The
+        router samples lazily on /sloz and from the monitor thread.)"""
+        with self._lock:
+            if not self._snaps:
+                return True
+            return (
+                self.clock() - self._snaps[-1][0]
+                >= self.sample_interval_s
+            )
+
+    def note(self, samples: Dict[tuple, float]) -> None:
+        """Record one pooled-sample snapshot at ``clock()`` now. Old
+        snapshots prune past the slow window (one snapshot at/behind
+        the window start is kept as the differencing baseline)."""
+        now = self.clock()
+        with self._lock:
+            self._snaps.append((now, dict(samples)))
+            horizon = now - self.slow_window_s
+            while len(self._snaps) >= 2 and self._snaps[1][0] <= horizon:
+                self._snaps.pop(0)
+
+    @staticmethod
+    def _window_base(snaps, now: float, window_s: float):
+        """Newest snapshot at/behind ``now - window_s`` — or the oldest
+        snapshot when coverage is still partial (reported so breached
+        requires FULL slow coverage)."""
+        target = now - window_s
+        base = None
+        for t, samples in snaps:
+            if t <= target:
+                base = (t, samples)
+            else:
+                break
+        if base is None:
+            base = snaps[0]
+        return base
+
+    # --------------------------------------------------- evaluation
+    def _window_doc(self, budget: TierBudget, now_samples, base_samples,
+                    coverage_s: float) -> dict:
+        labels = {"tier": budget.tier}
+        per: Dict[str, dict] = {}
+        burn = 0.0
+        allowed = 1.0 - budget.objective
+        for key, family, thr_ms in (
+            ("ttft", TTFT_FAMILY, budget.p99_ttft_ms),
+            ("itl", ITL_FAMILY, budget.p99_itl_ms),
+        ):
+            if thr_ms is None:
+                continue
+            acc = _delta_acc(
+                _bucket_acc(now_samples, family, labels),
+                _bucket_acc(base_samples, family, labels),
+            )
+            bad, total = fraction_over(acc, thr_ms / 1000.0)
+            b = (bad / total) / allowed if total > 0 else 0.0
+            per[key] = {
+                "bad": round(bad, 3), "total": round(total, 3),
+                "burn_rate": round(b, 4),
+            }
+            burn = max(burn, b)
+        if budget.max_error_rate is not None:
+            total = (
+                _counter_sum(now_samples, REQUESTS_FAMILY, labels)
+                - _counter_sum(base_samples, REQUESTS_FAMILY, labels)
+            )
+            bad = (
+                _counter_sum(now_samples, ERRORS_FAMILY, labels)
+                - _counter_sum(base_samples, ERRORS_FAMILY, labels)
+            )
+            total, bad = max(total, 0.0), max(bad, 0.0)
+            b = (
+                (bad / total) / budget.max_error_rate
+                if total > 0 else 0.0
+            )
+            per["error_rate"] = {
+                "bad": round(bad, 3), "total": round(total, 3),
+                "burn_rate": round(b, 4),
+            }
+            burn = max(burn, b)
+        return {
+            "burn_rate": round(burn, 4),
+            "coverage_s": round(max(coverage_s, 0.0), 3),
+            "budgets": per,
+        }
+
+    def evaluate(self) -> dict:
+        """The ``GET /sloz`` document; updates gauges and fires
+        ``on_breach`` on ok -> burning/breached transitions."""
+        with self._lock:
+            snaps = list(self._snaps)
+        now = self.clock()
+        tiers: Dict[str, dict] = {}
+        transitions: List[Tuple[str, dict]] = []
+        for tier, budget in self.budgets.items():
+            if len(snaps) < 2:
+                fast = slow = {
+                    "burn_rate": 0.0, "coverage_s": 0.0, "budgets": {},
+                }
+            else:
+                ft, fs = self._window_base(snaps, now, self.fast_window_s)
+                st, ss = self._window_base(snaps, now, self.slow_window_s)
+                latest = snaps[-1][1]
+                fast = self._window_doc(budget, latest, fs, now - ft)
+                slow = self._window_doc(budget, latest, ss, now - st)
+            burning = fast["burn_rate"] >= self.burn_threshold
+            breached = (
+                burning
+                and slow["burn_rate"] >= self.burn_threshold
+                and slow["coverage_s"] >= self.slow_window_s
+            )
+            status = (
+                STATUS_BREACHED if breached
+                else STATUS_BURNING if burning
+                else STATUS_OK
+            )
+            # Headroom on the longest window with data: what is left of
+            # the budget before the tier breaches (negative = over).
+            ref = slow if slow["coverage_s"] > 0 else fast
+            headroom = round(1.0 - ref["burn_rate"], 4)
+            tiers[tier] = {
+                "status": status,
+                "burn_rate": fast["burn_rate"],
+                "headroom": headroom,
+                "windows": {"fast": fast, "slow": slow},
+                "budget": {
+                    k: v for k, v in (
+                        ("p99_ttft_ms", budget.p99_ttft_ms),
+                        ("p99_itl_ms", budget.p99_itl_ms),
+                        ("max_error_rate", budget.max_error_rate),
+                        ("objective", budget.objective),
+                    ) if v is not None
+                },
+            }
+            self._g_burn.labels(tier=tier, window="fast").set(
+                fast["burn_rate"]
+            )
+            self._g_burn.labels(tier=tier, window="slow").set(
+                slow["burn_rate"]
+            )
+            self._g_headroom.labels(tier=tier).set(headroom)
+            self._g_state.labels(tier=tier).set(
+                float(_STATE_CODES[status])
+            )
+            prev = self._state[tier]
+            self._state[tier] = status
+            if status != STATUS_OK and prev == STATUS_OK:
+                self._c_breaches.labels(tier=tier).inc()
+                self.flight.record(
+                    "slo_burning", tier=tier, status=status,
+                    burn_rate=fast["burn_rate"], headroom=headroom,
+                )
+                transitions.append((tier, tiers[tier]))
+            elif status == STATUS_OK and prev != STATUS_OK:
+                self.flight.record("slo_recovered", tier=tier)
+        doc = {
+            "tiers": tiers,
+            "fast_window_s": self.fast_window_s,
+            "slow_window_s": self.slow_window_s,
+            "samples": len(snaps),
+        }
+        if self.on_breach is not None:
+            for tier, info in transitions:
+                try:
+                    self.on_breach(tier, info)
+                except Exception:  # noqa: BLE001 — forensics best-effort
+                    pass
+        return doc
+
+
+class SLOMonitor(threading.Thread):
+    """Background evaluation pump: calls ``target()`` (the router's
+    ``slo_report``) every ``interval_s`` so breaches are detected — and
+    incident bundles captured — without anyone polling ``/sloz``.
+    Daemon thread; ``stop()`` joins it."""
+
+    def __init__(self, target: Callable[[], object],
+                 interval_s: float = 5.0):
+        super().__init__(name="shifu-slo-monitor", daemon=True)
+        self._target = target
+        self.interval_s = float(interval_s)
+        self._stop = threading.Event()
+
+    def run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self._target()
+            except Exception:  # noqa: BLE001 — monitoring must survive
+                pass
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.join(timeout=5.0)

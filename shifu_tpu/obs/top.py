@@ -1,0 +1,147 @@
+"""``shifu_tpu obs top``: one pane of glass over a live router.
+
+Polls ``GET /statz`` + ``GET /sloz`` and renders a plain-text frame —
+tier burn rates/headroom on top, one row per backend (role, health,
+watchdog reasons, load, cache occupancy) below. Deliberately
+curses-free: the frame is a pure function of the two JSON documents
+(``render_top``), so the chaos tests and a human terminal consume the
+exact same rendering, and ``--once`` mode pipes cleanly into files.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+import urllib.request
+from typing import Optional
+
+_CLEAR = "\x1b[H\x1b[2J"
+
+
+def _fmt(v, nd: int = 1) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.{nd}f}"
+    return str(v)
+
+
+def _row(cols, widths) -> str:
+    return "  ".join(
+        str(c)[:w].ljust(w) for c, w in zip(cols, widths)
+    ).rstrip()
+
+
+def render_top(statz: dict, sloz: Optional[dict] = None) -> str:
+    """The dashboard frame for one poll of /statz (+ optional /sloz).
+    Pure: no I/O, no clock — testable against canned documents."""
+    lines = []
+    eng = statz.get("engine", {}) or {}
+    lat = statz.get("latency", {}) or {}
+    lines.append(
+        "fleet: "
+        f"slots {eng.get('active_slots', 0)}/{eng.get('max_slots', 0)}"
+        f"  queued {eng.get('queued', 0)}"
+        f"  completed {eng.get('requests_completed', 0)}"
+        f"  batch {eng.get('batch_completed', 0)}"
+        f"  retry-budget {eng.get('retry_budget', '-')}"
+    )
+    if lat.get("completions"):
+        lines.append(
+            f"latency: ttft p50/p99 {_fmt(lat.get('ttft_ms_p50'))}/"
+            f"{_fmt(lat.get('ttft_ms_p99'))} ms"
+            f"  itl p99 {_fmt(lat.get('req_itl_ms_p99'))} ms"
+            f"  window {lat.get('completions')} reqs"
+        )
+
+    tiers = (sloz or {}).get("tiers") or {}
+    if tiers:
+        lines.append("")
+        widths = (12, 9, 10, 10, 9)
+        lines.append(_row(
+            ("TIER", "STATUS", "BURN-FAST", "BURN-SLOW", "HEADROOM"),
+            widths,
+        ))
+        for tier in sorted(tiers):
+            d = tiers[tier]
+            win = d.get("windows", {})
+            lines.append(_row((
+                tier,
+                d.get("status", "-"),
+                _fmt(win.get("fast", {}).get("burn_rate"), 2),
+                _fmt(win.get("slow", {}).get("burn_rate"), 2),
+                _fmt(d.get("headroom"), 2),
+            ), widths))
+
+    fleet = statz.get("fleet") or {}
+    rows = fleet.get("backends") or []
+    if rows:
+        lines.append("")
+        widths = (21, 7, 9, 9, 4, 6, 9, 8)
+        lines.append(_row(
+            ("BACKEND", "ROLE", "STATUS", "HEALTHZ", "INFL",
+             "QUEUE", "EWMA-MS", "BREAKER"),
+            widths,
+        ))
+        cache = (statz.get("cache") or {}).get("backends") or {}
+        for r in rows:
+            lines.append(_row((
+                r.get("backend", "-"),
+                r.get("role", "-"),
+                r.get("status", "-"),
+                r.get("healthz", "-"),
+                r.get("in_flight", 0),
+                r.get("queue_depth", 0),
+                _fmt(r.get("ewma_ms")),
+                r.get("breaker", "-"),
+            ), widths))
+            reasons = r.get("healthz_reasons") or ()
+            for reason in reasons:
+                lines.append(f"    ! {reason}")
+            blk = cache.get(r.get("backend"))
+            pc = (blk or {}).get("prefix_cache")
+            if pc:
+                lines.append(
+                    f"    cache: {pc.get('pages_used', 0)}/"
+                    f"{pc.get('pages_total', 0)} pages"
+                    f"  hit-rate {_fmt(pc.get('hit_rate'), 3)}"
+                )
+    return "\n".join(lines) + "\n"
+
+
+def _fetch(url: str, timeout_s: float) -> dict:
+    with urllib.request.urlopen(url, timeout=timeout_s) as r:
+        return json.loads(r.read())
+
+
+def run_top(url: str, *, interval_s: float = 2.0,
+            iterations: Optional[int] = None, out=None,
+            timeout_s: float = 10.0) -> int:
+    """Poll-and-render loop (``iterations=None`` = until ^C; ``1`` is
+    the ``--once`` mode). Returns a CLI exit code."""
+    out = out if out is not None else sys.stdout
+    base = url.rstrip("/")
+    n = 0
+    while iterations is None or n < iterations:
+        try:
+            statz = _fetch(base + "/statz", timeout_s)
+        except (OSError, ValueError) as e:
+            print(f"cannot fetch {base}/statz: {e}", file=sys.stderr)
+            return 2
+        try:
+            sloz = _fetch(base + "/sloz", timeout_s)
+        except (OSError, ValueError):
+            sloz = None  # pre-/sloz server: dashboard still works
+        frame = render_top(statz, sloz)
+        if iterations != 1:
+            out.write(_CLEAR)
+        out.write(frame)
+        out.flush()
+        n += 1
+        if iterations is None or n < iterations:
+            try:
+                time.sleep(interval_s)
+            except KeyboardInterrupt:
+                break
+    return 0

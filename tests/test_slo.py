@@ -1,0 +1,686 @@
+"""Fleet SLO engine (obs/slo.py + obs/incident.py).
+
+Three layers, mirroring the module split:
+
+  * burn-rate window math on a DETERMINISTIC clock — bucket pooling,
+    linear interpolation at the threshold, restart clamping, the
+    ok -> burning -> breached -> recovered ladder (breached requires
+    FULL slow-window coverage), error-rate budgets, gauge re-export;
+  * incident-bundle round-trip against a fake router-shaped source:
+    capture -> files on disk -> ``obs incident list|show|export`` CLI,
+    atomic rate limiting, dead backends recorded as evidence;
+  * a two-process fleet: one backend forced slow past the tier's TTFT
+    budget flips ``GET /sloz`` to "burning" with a nonzero burn rate
+    and produces EXACTLY ONE bundle holding both hosts' flight rings,
+    a merged trace, and the federated metrics snapshot.
+"""
+
+import json
+import math
+import os
+import signal
+import tarfile
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from shifu_tpu.obs import FlightRecorder, MetricsRegistry, parse_exposition
+from shifu_tpu.obs.incident import (
+    IncidentWriter,
+    list_incidents,
+    show_incident,
+)
+from shifu_tpu.obs.slo import (
+    SLOEngine,
+    STATUS_BREACHED,
+    STATUS_BURNING,
+    STATUS_OK,
+    TierBudget,
+    _delta_acc,
+    fraction_over,
+    parse_budget_spec,
+)
+from shifu_tpu.obs.top import render_top
+
+# ------------------------------------------------------------ helpers
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+# The pooled-federation name the router feeds the engine (the engines'
+# tier-labelled TTFT histogram under the shifu_fleet_agg_ prefix).
+_TTFT_BUCKET = "shifu_fleet_agg_request_ttft_seconds_bucket"
+
+
+def _ttft_snap(counts, tier="interactive"):
+    """{le_str: cumulative_count} -> pooled sample dict in the
+    parse_exposition key shape ``(name, frozenset(label_items))``."""
+    return {
+        (_TTFT_BUCKET, frozenset({("tier", tier), ("le", le)})): float(v)
+        for le, v in counts.items()
+    }
+
+
+def _counter_snap(requests, errors, tier="interactive"):
+    lbl = frozenset({("tier", tier)})
+    return {
+        ("shifu_slo_requests_total", lbl): float(requests),
+        ("shifu_slo_errors_total", lbl): float(errors),
+    }
+
+
+# ----------------------------------------------------- budget parsing
+
+
+def test_parse_budget_spec_roundtrip():
+    b = parse_budget_spec("interactive:ttft=250,itl=40,err=0.01")
+    assert b.tier == "interactive"
+    assert b.p99_ttft_ms == 250.0
+    assert b.p99_itl_ms == 40.0
+    assert b.max_error_rate == 0.01
+    assert b.objective == 0.99
+    b2 = parse_budget_spec("batch: err=0.05, objective=0.95")
+    assert b2.tier == "batch"
+    assert b2.p99_ttft_ms is None
+    assert b2.objective == 0.95
+
+
+@pytest.mark.parametrize("spec", [
+    "no-colon-here",
+    "tier:",                    # no budgets at all
+    "tier:frobnicate=1",        # unknown key
+    "tier:ttft=abc",            # not a number
+    "tier:ttft=100,objective=1.5",
+])
+def test_parse_budget_spec_rejects(spec):
+    with pytest.raises(ValueError):
+        parse_budget_spec(spec)
+
+
+def test_tier_budget_requires_some_budget():
+    with pytest.raises(ValueError):
+        TierBudget(tier="interactive")
+    with pytest.raises(ValueError):
+        TierBudget(tier="t", p99_ttft_ms=100.0, max_error_rate=0.0)
+
+
+# ----------------------------------------------------- window math
+
+
+def test_fraction_over_interpolates_inside_bucket():
+    # 100 events total: 40 under 0.05s, 80 under 0.1s, 20 in +Inf.
+    acc = {0.05: 40.0, 0.1: 80.0, math.inf: 100.0}
+    # Threshold at the midpoint of (0.05, 0.1]: half that bucket's 40
+    # events count as under -> 60 under, 40 over.
+    bad, total = fraction_over(acc, 0.075)
+    assert total == 100.0
+    assert bad == pytest.approx(40.0)
+    # Exactly on an edge: the cumulative count at that edge is under.
+    bad, total = fraction_over(acc, 0.1)
+    assert bad == pytest.approx(20.0)
+    # Past the last finite edge only the +Inf remainder is over.
+    bad, total = fraction_over(acc, 5.0)
+    assert bad == pytest.approx(20.0)
+    # Empty window.
+    assert fraction_over({}, 0.1) == (0.0, 0.0)
+
+
+def test_delta_clamped_on_counter_reset():
+    now = {0.05: 10.0, math.inf: 12.0}
+    base = {0.05: 40.0, math.inf: 50.0}  # backend restarted: reset
+    d = _delta_acc(now, base)
+    assert d == {0.05: 0.0, math.inf: 0.0}
+
+
+def _engine(clock, **kw):
+    kw.setdefault("budgets", [
+        TierBudget(tier="interactive", p99_ttft_ms=100.0),
+    ])
+    kw.setdefault("fast_window_s", 60.0)
+    kw.setdefault("slow_window_s", 600.0)
+    kw.setdefault("sample_interval_s", 5.0)
+    kw.setdefault("metrics", MetricsRegistry())
+    kw.setdefault("flight", FlightRecorder())
+    return SLOEngine(clock=clock, **kw)
+
+
+def test_burn_ladder_ok_burning_breached_recovered():
+    clock = FakeClock()
+    breaches = []
+    eng = _engine(clock, on_breach=lambda t, info: breaches.append((t, info)))
+
+    # No data yet: tier reports ok with zero burn.
+    doc = eng.evaluate()
+    tier = doc["tiers"]["interactive"]
+    assert tier["status"] == STATUS_OK
+    assert tier["burn_rate"] == 0.0
+    assert tier["headroom"] == 1.0
+
+    # Baseline + one healthy window: 100 requests, all under 100ms.
+    eng.note(_ttft_snap({"0.05": 0, "0.1": 0, "+Inf": 0}))
+    clock.advance(10.0)
+    eng.note(_ttft_snap({"0.05": 100, "0.1": 100, "+Inf": 100}))
+    tier = eng.evaluate()["tiers"]["interactive"]
+    assert tier["status"] == STATUS_OK
+    assert tier["burn_rate"] == 0.0
+    assert not breaches
+
+    # 100 more requests, half of them over the TTFT budget. The fast
+    # window still has partial coverage (20s < 60s) so its base is the
+    # pre-traffic baseline: 50 bad of 200 total = 25% against a 1%
+    # allowance -> burn 25.
+    clock.advance(10.0)
+    eng.note(_ttft_snap({"0.05": 150, "0.1": 150, "+Inf": 200}))
+    tier = eng.evaluate()["tiers"]["interactive"]
+    assert tier["status"] == STATUS_BURNING  # slow coverage only 20s
+    assert tier["burn_rate"] == pytest.approx(25.0, rel=1e-3)
+    assert tier["headroom"] == pytest.approx(-24.0, rel=1e-3)
+    assert tier["windows"]["slow"]["coverage_s"] < eng.slow_window_s
+    assert len(breaches) == 1 and breaches[0][0] == "interactive"
+
+    # Keep burning until the slow window has FULL coverage: only then
+    # may the tier report breached (sustained, not a blip).
+    bad = 200
+    for _ in range(7):
+        clock.advance(100.0)
+        bad += 50
+        eng.note(_ttft_snap({"0.05": 150, "0.1": 150, "+Inf": bad}))
+        tier = eng.evaluate()["tiers"]["interactive"]
+    assert tier["status"] == STATUS_BREACHED
+    assert tier["windows"]["slow"]["coverage_s"] >= eng.slow_window_s
+    # The ok -> non-ok transition already fired; breached is the same
+    # episode, not a second breach.
+    assert len(breaches) == 1
+
+    # Quiet traffic drains the windows -> recovered.
+    for _ in range(8):
+        clock.advance(100.0)
+        eng.note(_ttft_snap({"0.05": 150, "0.1": 150, "+Inf": bad}))
+    tier = eng.evaluate()["tiers"]["interactive"]
+    assert tier["status"] == STATUS_OK
+    events = [e["kind"] for e in eng.flight.snapshot()]
+    assert "slo_burning" in events
+    assert "slo_recovered" in events
+
+
+def test_burn_gauges_reexported():
+    clock = FakeClock()
+    eng = _engine(clock)
+    eng.note(_ttft_snap({"0.1": 0, "+Inf": 0}))
+    clock.advance(10.0)
+    eng.note(_ttft_snap({"0.1": 50, "+Inf": 100}))
+    eng.evaluate()
+    samples = parse_exposition(eng.metrics.render())
+    fast = samples[(
+        "shifu_slo_burn_rate",
+        frozenset({("tier", "interactive"), ("window", "fast")}),
+    )]
+    assert fast == pytest.approx(50.0, rel=1e-3)
+    state = samples[(
+        "shifu_slo_tier_state", frozenset({("tier", "interactive")}),
+    )]
+    assert state == 1.0  # burning
+    assert samples[(
+        "shifu_slo_tier_breaches_total",
+        frozenset({("tier", "interactive")}),
+    )] == 1.0
+
+
+def test_error_rate_budget_and_backend_dedup():
+    clock = FakeClock()
+    eng = _engine(clock, budgets=[
+        TierBudget(tier="interactive", max_error_rate=0.1),
+    ])
+    base = _counter_snap(100, 0)
+    eng.note(base)
+    clock.advance(10.0)
+    now = _counter_snap(200, 20)
+    # A per-backend federated duplicate of the pooled counter must NOT
+    # double-count (the router's own registry is the source of truth).
+    now[(
+        "shifu_fleet_agg_slo_requests_total",
+        frozenset({("tier", "interactive"), ("backend", "h:1")}),
+    )] = 999.0
+    eng.note(now)
+    tier = eng.evaluate()["tiers"]["interactive"]
+    # 20 errors / 100 requests = 0.2 against a 0.1 allowance -> burn 2.
+    assert tier["burn_rate"] == pytest.approx(2.0, rel=1e-3)
+    assert tier["status"] == STATUS_BURNING
+    per = tier["windows"]["fast"]["budgets"]["error_rate"]
+    assert per["total"] == 100.0 and per["bad"] == 20.0
+
+
+def test_sample_due_honours_interval():
+    clock = FakeClock()
+    eng = _engine(clock, sample_interval_s=5.0)
+    assert eng.sample_due()
+    eng.note({})
+    assert not eng.sample_due()
+    clock.advance(4.9)
+    assert not eng.sample_due()
+    clock.advance(0.2)
+    assert eng.sample_due()
+
+
+def test_snapshots_prune_to_slow_window():
+    clock = FakeClock()
+    eng = _engine(clock, slow_window_s=600.0)
+    for _ in range(100):
+        eng.note({})
+        clock.advance(30.0)
+    # 600s window at one snapshot per 30s: ~21 retained, one of them
+    # the at/behind-window-start baseline, the rest inside it.
+    assert len(eng._snaps) <= 22
+    assert eng._snaps[0][0] <= clock() - 600.0 + 30.0
+
+
+# ------------------------------------------------- incident bundles
+
+
+class _FakeBackend:
+    def __init__(self, addr, doc=None, fail=False):
+        self.addr = addr
+        self.detached = False
+        self._doc = doc or {"events": [], "capacity": 64, "dropped": 0}
+        self._fail = fail
+        self.last_n = None
+
+    def debugz(self, n=None):
+        self.last_n = n
+        if self._fail:
+            raise OSError("connection refused")
+        return self._doc
+
+
+class _FakeSource:
+    """FleetRouter-shaped: exactly the facets IncidentWriter reads."""
+
+    def __init__(self, backends):
+        self.metrics = MetricsRegistry()
+        self.flight = FlightRecorder()
+        self.flight.record("engine_start", step=0)
+        self.backends = backends
+
+    def recent_trace_ids(self, n=3):
+        return ["trace-abc"][:n]
+
+    def trace_spans(self, trace_id):
+        from shifu_tpu.obs import disttrace as dt
+
+        return [dt.host_doc("router", [
+            dt.span_record("route", None, 10.0, 5.0,
+                           trace_id=trace_id, backend="h:1"),
+        ])]
+
+    def federated_metrics(self):
+        return "# pooled\nshifu_fleet_agg_backend_up 1\n"
+
+
+def test_incident_capture_roundtrip_and_cli(tmp_path, capsys):
+    from shifu_tpu.cli import main
+
+    clock = FakeClock()
+    root = str(tmp_path / "incidents")
+    good = _FakeBackend("h:1")
+    dead = _FakeBackend("h:2", fail=True)
+    writer = IncidentWriter(
+        root, min_interval_s=900.0, debug_tail=32, clock=clock,
+        metrics=MetricsRegistry(), flight=FlightRecorder(),
+    )
+    src = _FakeSource([good, dead])
+    path = writer.capture(
+        src, tier="interactive", reason="burn_rate 50",
+        slo={"tiers": {"interactive": {"status": "burning"}}},
+    )
+    assert path is not None
+    names = sorted(os.listdir(path))
+    assert "manifest.json" in names
+    assert "flight_router.json" in names
+    assert "flight_h_1.json" in names     # reachable backend captured
+    assert "flight_h_2.json" not in names  # dead host is manifest data
+    assert "trace_trace-abc.json" in names
+    assert "metrics_federated.prom" in names
+    assert "metrics_router.prom" in names
+    assert "slo.json" in names
+    assert good.last_n == 32  # the ?n= tail limit rode the fetch
+
+    manifest = json.loads(
+        (tmp_path / "incidents" / os.path.basename(path) /
+         "manifest.json").read_text()
+    )
+    assert manifest["backends"]["h:1"] == "ok"
+    assert manifest["backends"]["h:2"].startswith("error:")
+    assert manifest["traces"] == ["trace-abc"]
+
+    # Rate limit: a second breach inside the quiet period is
+    # suppressed; after it expires, capture works again.
+    assert writer.capture(src, tier="interactive", reason="again") is None
+    assert writer.suppressed == 1
+    clock.advance(901.0)
+    second = writer.capture(src, tier="interactive", reason="later")
+    assert second is not None and second != path
+    assert writer.captured == 2
+
+    # list/show agree with the manifest through the CLI.
+    rows = list_incidents(root)
+    assert len(rows) == 2
+    shown = show_incident(root, os.path.basename(path))
+    assert shown["summaries"]["slo.json"] == {"interactive": "burning"}
+    assert shown["summaries"]["trace_trace-abc.json"]["trace_events"] >= 1
+
+    rc = main(["obs", "incident", "list", "--dir", root])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert {r["id"] for r in out} == {
+        os.path.basename(path), os.path.basename(second),
+    }
+    rc = main([
+        "obs", "incident", "show", "--dir", root,
+        "--id", os.path.basename(path),
+    ])
+    assert rc == 0
+    shown_cli = json.loads(capsys.readouterr().out)
+    assert shown_cli["reason"] == "burn_rate 50"
+    assert "summaries" in shown_cli
+
+    tar_out = str(tmp_path / "bundle.tar.gz")
+    rc = main([
+        "obs", "incident", "export", "--dir", root,
+        "--id", os.path.basename(path), "--out", tar_out,
+    ])
+    assert rc == 0
+    capsys.readouterr()
+    with tarfile.open(tar_out) as tar:
+        members = tar.getnames()
+    assert any(m.endswith("manifest.json") for m in members)
+
+    # Unknown id / missing --id are clean CLI errors, not tracebacks.
+    assert main([
+        "obs", "incident", "show", "--dir", root, "--id", "nope",
+    ]) == 2
+    capsys.readouterr()
+    assert main(["obs", "incident", "show", "--dir", root]) == 2
+    capsys.readouterr()
+
+
+def test_incident_rate_limit_atomic_under_races(tmp_path):
+    clock = FakeClock()
+    writer = IncidentWriter(
+        str(tmp_path), min_interval_s=900.0, clock=clock,
+        metrics=MetricsRegistry(), flight=FlightRecorder(),
+    )
+    src = _FakeSource([])
+    results = [None] * 8
+
+    def worker(i):
+        results[i] = writer.capture(src, tier="interactive", reason="race")
+
+    threads = [
+        threading.Thread(target=worker, args=(i,)) for i in range(8)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(10)
+    wrote = [r for r in results if r is not None]
+    assert len(wrote) == 1  # the check-and-reserve is atomic
+    assert writer.suppressed == 7
+
+
+# ------------------------------------------------------------ obs top
+
+
+def test_render_top_frame():
+    statz = {
+        "engine": {"active_slots": 1, "max_slots": 4, "queued": 2,
+                   "requests_completed": 7},
+        "latency": {"completions": 7, "ttft_ms_p50": 12.0,
+                    "ttft_ms_p99": 80.0},
+        "fleet": {"backends": [{
+            "backend": "127.0.0.1:9", "role": "both", "status": "up",
+            "healthz": "degraded",
+            "healthz_reasons": ["p99 TTFT 300ms over budget 100ms"],
+            "in_flight": 1, "queue_depth": 0, "ewma_ms": 55.0,
+            "breaker": "closed",
+        }]},
+    }
+    sloz = {"tiers": {"interactive": {
+        "status": "burning", "burn_rate": 12.5, "headroom": -11.5,
+        "windows": {"fast": {"burn_rate": 12.5},
+                    "slow": {"burn_rate": 2.0}},
+    }}}
+    frame = render_top(statz, sloz)
+    assert "interactive" in frame and "burning" in frame
+    assert "12.50" in frame and "-11.50" in frame
+    assert "127.0.0.1:9" in frame
+    assert "p99 TTFT 300ms over budget 100ms" in frame
+    # Without /sloz the frame still renders (router without budgets).
+    assert "127.0.0.1:9" in render_top(statz, None)
+
+
+# --------------------------------------- two-process fleet breach walk
+
+
+def _get(base, path, timeout=30):
+    with urllib.request.urlopen(base + path, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _post(base, path, obj, timeout=120):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(obj).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def test_fleet_sloz_breach_captures_one_bundle(tmp_path):
+    import subprocess
+    import sys
+
+    from shifu_tpu.fleet import (
+        BackendClient,
+        BackendConfig,
+        FleetRouter,
+        RetryPolicy,
+        wait_ready,
+    )
+    from shifu_tpu.infer import make_server
+
+    helper = os.path.join(os.path.dirname(__file__), "_fleet_backend.py")
+
+    def spawn(step_delay):
+        env = dict(
+            os.environ,
+            PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu",
+            FLEET_BACKEND_MAX_SLOTS="2",
+            FLEET_BACKEND_STEP_DELAY=str(step_delay),
+        )
+        proc = subprocess.Popen(
+            [sys.executable, helper], stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL, env=env, text=True,
+        )
+        line = proc.stdout.readline()
+        if not line:
+            proc.kill()
+            raise RuntimeError("backend died before printing its port")
+        return proc, f"127.0.0.1:{json.loads(line)['port']}"
+
+    procs, server, monitor = [], None, None
+    try:
+        # One SLOW backend (every engine fold sleeps 0.3s -> TTFT far
+        # over a 50ms budget) and one fast one: the pooled tier must
+        # burn because of the slow host's share of the traffic.
+        slow_proc, slow_addr = spawn(0.3)
+        procs.append(slow_proc)
+        fast_proc, fast_addr = spawn(0.0)
+        procs.append(fast_proc)
+
+        clients = [
+            BackendClient(a, BackendConfig(
+                connect_timeout_s=10.0, probe_timeout_s=5.0,
+                read_timeout_s=60.0, fail_threshold=3, reset_s=30.0,
+            ))
+            for a in (slow_addr, fast_addr)
+        ]
+        ready, pending = wait_ready(clients, timeout_s=60.0,
+                                    require_all=True)
+        assert not pending
+        router = FleetRouter(
+            clients, metrics=MetricsRegistry(), flight=FlightRecorder(),
+            policy=RetryPolicy(base_s=0.01, cap_s=0.1, budget=16.0),
+        )
+
+        incidents_root = str(tmp_path / "incidents")
+        slo = SLOEngine(
+            [TierBudget(tier="interactive", p99_ttft_ms=50.0)],
+            # Fast window longer than the whole test: its base stays
+            # the pre-traffic snapshot, so "burning" is sticky for the
+            # assertions. Slow window can never reach full coverage ->
+            # the status deterministically stops at burning.
+            fast_window_s=300.0, slow_window_s=3600.0,
+            sample_interval_s=0.2,
+            metrics=router.metrics, flight=router.flight,
+        )
+        incident = IncidentWriter(
+            incidents_root, min_interval_s=3600.0,
+            metrics=router.metrics, flight=router.flight,
+        )
+        router.set_slo(slo, incident)
+
+        server = make_server(router, port=0)
+        t = threading.Thread(target=server.serve_forever, daemon=True)
+        t.start()
+        base = f"http://127.0.0.1:{server.server_port}"
+
+        # Pre-traffic: budgets declared, tier healthy, zero burn.
+        doc = _get(base, "/sloz")
+        assert doc["tiers"]["interactive"]["status"] == STATUS_OK
+        assert doc["tiers"]["interactive"]["burn_rate"] == 0.0
+
+        # Saturate both backends (2 slots each, 6 concurrent): the
+        # slow host MUST take part of the tier's traffic.
+        results = [None] * 6
+
+        def worker(i):
+            results[i] = _post(
+                base, "/v1/completions",
+                {"tokens": [1, 2, 3 + i], "max_new_tokens": 3},
+            )
+
+        threads = [
+            threading.Thread(target=worker, args=(i,)) for i in range(6)
+        ]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(120)
+        assert all(r is not None and r[0] == 200 for r in results)
+
+        # Poll /sloz until the burn shows up (sampling is pull-driven
+        # with a minimum interval, so a couple of scrapes are needed:
+        # one for the fresh snapshot, one more if the first landed
+        # inside the sample interval).
+        tier = None
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            tier = _get(base, "/sloz")["tiers"]["interactive"]
+            if tier["status"] == STATUS_BURNING:
+                break
+            time.sleep(0.3)
+        assert tier is not None
+        assert tier["status"] == STATUS_BURNING, tier
+        assert tier["burn_rate"] > 0.0
+        assert tier["headroom"] < 1.0
+        # Slow window never has full coverage in-test: never breached.
+        assert tier["windows"]["slow"]["coverage_s"] < 3600.0
+
+        # Exactly one incident bundle, capturing BOTH hosts' flight
+        # rings, a merged trace, and the federated metrics snapshot.
+        bundle = None
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            dirs = [
+                d for d in (
+                    os.listdir(incidents_root)
+                    if os.path.isdir(incidents_root) else []
+                )
+                if os.path.isfile(
+                    os.path.join(incidents_root, d, "manifest.json")
+                )
+            ]
+            if dirs:
+                bundle = os.path.join(incidents_root, dirs[0])
+                break
+            time.sleep(0.2)
+        assert bundle is not None, "no incident bundle captured"
+        names = sorted(os.listdir(bundle))
+        for addr in (slow_addr, fast_addr):
+            assert f"flight_{addr.replace(':', '_')}.json" in names
+        assert any(n.startswith("trace_") for n in names), names
+        assert "metrics_federated.prom" in names
+        fed = open(os.path.join(bundle, "metrics_federated.prom")).read()
+        assert "shifu_fleet_agg_" in fed
+        merged = json.loads(open(os.path.join(
+            bundle, [n for n in names if n.startswith("trace_")][0]
+        )).read())
+        assert merged["traceEvents"]
+        slo_doc = json.loads(
+            open(os.path.join(bundle, "slo.json")).read()
+        )
+        assert slo_doc["tiers"]["interactive"]["status"] == STATUS_BURNING
+
+        # Further evaluations inside the same episode must not write a
+        # second bundle (transition-edge + rate limit).
+        for _ in range(4):
+            _get(base, "/sloz")
+            time.sleep(0.25)
+        dirs = [
+            d for d in os.listdir(incidents_root)
+            if os.path.isfile(
+                os.path.join(incidents_root, d, "manifest.json")
+            )
+        ]
+        assert len(dirs) == 1
+
+        # Satellite surfaces riding the same fleet: per-backend
+        # watchdog status in /statz rows, and the bounded /debugz
+        # client fetch.
+        rows = _get(base, "/statz")["fleet"]["backends"]
+        assert {r["backend"] for r in rows} == {slow_addr, fast_addr}
+        for row in rows:
+            assert "healthz_reasons" in row
+            assert isinstance(row["healthz_reasons"], list)
+        tail = router.backends[0].debugz(n=3)
+        assert len(tail["events"]) <= 3
+
+        # The SLO families export from the router's own registry.
+        samples = parse_exposition(router.metrics.render())
+        assert samples[(
+            "shifu_slo_tier_state", frozenset({("tier", "interactive")}),
+        )] == 1.0
+        assert samples[(
+            "shifu_slo_incidents_total",
+            frozenset({("tier", "interactive")}),
+        )] == 1.0
+    finally:
+        if server is not None:
+            server.shutdown()
+            server.runner.shutdown()
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGKILL)
+        for p in procs:
+            p.wait(timeout=10)
