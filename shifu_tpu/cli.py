@@ -21,6 +21,14 @@
                write the winner table as a versioned artifact that
                serve/train/bench activate via --tune-table; --check
                validates registry + artifact schema without timing
+    loadgen    measurement harness: replay a declarative scenario mix
+               at a fixed open-loop offered load against a live
+               router/server and exit with per-tier SLO verdicts
+               scored from the real /sloz + federated /metrics scrape
+               (exit 1 when a tier burns its budget); the scenario's
+               chaos track folds SIGKILL/drain/resume/mid-run rollout
+               into the timeline; --check validates a scenario with
+               no traffic
     obs        check-bench: gate a compact bench line against a
                recorded baseline (exit 1 on regression);
                check-tune: diff two tune-table artifacts (exit 1 when
@@ -1570,6 +1578,78 @@ def cmd_tune(args) -> int:
     return 0
 
 
+def cmd_loadgen(args) -> int:
+    """``shifu_tpu loadgen``: the measurement harness (ROADMAP item
+    6). Replays a declarative scenario mix at a fixed open-loop
+    offered load against a live router or engine server, scrapes
+    ``/sloz`` + ``/statz`` + the federated ``/metrics`` while
+    driving, and exits with per-tier SLO verdicts (exit 0 = every
+    tier held its budget, 1 = burning/breached, 2 = unusable
+    scenario/flags). ``--check`` validates the scenario file alone —
+    parse, mix weights, tier/budget sanity, chaos schedule — no
+    traffic, fast enough for tier-1 (the ``tune --check`` pattern)."""
+    from shifu_tpu.loadgen import (
+        LoadRunner,
+        ScenarioError,
+        check_scenario,
+        load_scenario,
+    )
+
+    if args.check:
+        ok, report = check_scenario(args.scenario)
+        print(json.dumps(report, indent=2))
+        return 0 if ok else 1
+    try:
+        sc = load_scenario(args.scenario)
+    except ScenarioError as e:
+        print(json.dumps({
+            "status": "fail", "problems": e.problems,
+        }, indent=2), file=sys.stderr)
+        return 2
+    except OSError as e:
+        print(f"cannot read scenario: {e}", file=sys.stderr)
+        return 2
+    if args.duration is not None:
+        sc.duration_s = float(args.duration)
+    if args.rate is not None:
+        sc.rate_rps = float(args.rate)
+    if args.seed is not None:
+        sc.seed = int(args.seed)
+
+    chaos = None
+    if sc.chaos and not args.no_chaos:
+        from shifu_tpu.fleet.chaos import ChaosTrack
+
+        pids = {}
+        for spec in args.chaos_pid or ():
+            addr, _, pid = spec.rpartition("=")
+            if not addr or not pid.isdigit():
+                print(f"--chaos-pid wants ADDR=PID, got {spec!r}",
+                      file=sys.stderr)
+                return 2
+            pids[addr] = int(pid)
+        chaos = ChaosTrack(sc.chaos, url=args.url, pids=pids)
+
+    runner = LoadRunner(
+        sc, args.url,
+        request_timeout_s=args.timeout,
+        scrape_interval_s=args.scrape_interval,
+        max_inflight=args.max_inflight,
+        chaos=chaos,
+    )
+    report = runner.run()
+    if args.report:
+        with open(args.report, "w", encoding="utf-8") as f:
+            json.dump(report, f, indent=2)
+    if args.compact_out:
+        # The flat lg_* row `obs check-bench --current` gates
+        # directly (load_record accepts a raw compact line).
+        with open(args.compact_out, "w", encoding="utf-8") as f:
+            json.dump(report["compact"], f, indent=2)
+    print(json.dumps(report, indent=2))
+    return 0 if report["verdict"] == "pass" else 1
+
+
 def cmd_obs(args) -> int:
     """``shifu_tpu obs check-bench``: gate a compact bench line against
     a recorded baseline (obs/benchgate.py). Exit 0 = within tolerance,
@@ -1630,6 +1710,7 @@ def cmd_obs(args) -> int:
             args.url,
             interval_s=args.interval,
             iterations=1 if args.once else None,
+            loadgen_path=args.loadgen,
         )
     if args.action == "check-docs":
         import shifu_tpu
@@ -2215,6 +2296,59 @@ def main(argv=None) -> int:
                     help="best-of-N timing repeats per candidate")
     tu.set_defaults(fn=cmd_tune)
 
+    lg = sub.add_parser(
+        "loadgen",
+        help="measurement harness: replay a declarative scenario mix "
+             "(chat sessions, RAG prefills, json-mode agents, tool "
+             "bursts, batch backfill) at a fixed open-loop offered "
+             "load against a live router/server, score per-tier SLO "
+             "verdicts from the real /sloz + /metrics scrape, and "
+             "optionally run the scenario's scheduled chaos track "
+             "(SIGKILL/drain/resume/mid-run rollout); exit 0 = every "
+             "tier held its budget, 1 = burning/breached; --check "
+             "validates the scenario with no traffic",
+    )
+    lg.add_argument("--scenario", required=True,
+                    help="scenario JSON file, or a built-in name "
+                         "(smoke, mixed_peak); docs/loadgen.md has "
+                         "the schema")
+    lg.add_argument("--url", default="http://127.0.0.1:8000",
+                    help="target base URL: a fleet router or a bare "
+                         "engine server")
+    lg.add_argument("--check", action="store_true",
+                    help="validate the scenario (parse, mix weights, "
+                         "tier budgets, chaos schedule) and exit — "
+                         "no traffic")
+    lg.add_argument("--report",
+                    help="write the full verdict report JSON here")
+    lg.add_argument("--compact-out",
+                    help="write the flat lg_* compact row here (the "
+                         "shape `obs check-bench --current` gates)")
+    lg.add_argument("--duration", type=float,
+                    help="override the scenario's duration_s")
+    lg.add_argument("--rate", type=float,
+                    help="override the scenario's rate_rps")
+    lg.add_argument("--seed", type=int,
+                    help="override the scenario's seed (same seed = "
+                         "same offered timeline + request trace)")
+    lg.add_argument("--timeout", type=float, default=30.0,
+                    help="per-request timeout (s); a request past it "
+                         "is recorded as a transport failure")
+    lg.add_argument("--scrape-interval", type=float, default=1.0,
+                    help="seconds between /metrics + /sloz + /statz "
+                         "snapshots while driving")
+    lg.add_argument("--max-inflight", type=int, default=256,
+                    help="in-flight cap; arrivals past it are "
+                         "recorded as shed (the open loop never "
+                         "blocks)")
+    lg.add_argument("--chaos-pid", action="append", metavar="ADDR=PID",
+                    help="backend address -> OS pid for the chaos "
+                         "track's kill action (repeatable)")
+    lg.add_argument("--no-chaos", action="store_true",
+                    help="ignore the scenario's chaos track (measure "
+                         "the same mix undisturbed)")
+    lg.set_defaults(fn=cmd_loadgen)
+
     ob = sub.add_parser(
         "obs",
         help="observability tooling: check-bench gates a compact bench "
@@ -2248,6 +2382,10 @@ def main(argv=None) -> int:
     ob.add_argument("--once", action="store_true",
                     help="top: render one frame and exit (no screen "
                          "clearing — scriptable)")
+    ob.add_argument("--loadgen",
+                    help="top: a loadgen verdict report (--report "
+                         "output) to render as a measurement block, "
+                         "re-read every frame")
     ob.add_argument("--baseline",
                     help="baseline record (BENCH_rNN.json driver shape "
                          "or a raw compact line); required for "

@@ -34,6 +34,12 @@ front-end, so clients, the obs stack, and the CLI see one engine:
                serving), readiness-gate, resume — with the SLO
                watchdog's pooled p99 budgets as an automatic brake
                and ``--abort-on-slo`` rollback.
+``chaos``      first-class fault injection: the ``FLEET_BACKEND_FAULT_*``
+               server-side hooks the two-process tests drive
+               (drop-nth, slow probes, reload failures, kill-after-N
+               schedules) and the scheduled :class:`ChaosTrack` the
+               loadgen harness folds into a scenario timeline
+               (SIGKILL / drain / resume / mid-run rollout).
 
 See docs/architecture.md ("The serving fleet") for the design and the
 failure model, and README.md for the serving-topology ladder
@@ -47,6 +53,14 @@ from shifu_tpu.fleet.backend import (
     CircuitBreaker,
     FleetUnavailable,
     RetryPolicy,
+)
+from shifu_tpu.fleet.chaos import (
+    ChaosEvent,
+    ChaosTrack,
+    FaultSpec,
+    faults_from_env,
+    install_fault_hooks,
+    parse_chaos_events,
 )
 from shifu_tpu.fleet.router import FleetRouter
 from shifu_tpu.fleet.bootstrap import (
@@ -65,7 +79,10 @@ __all__ = [
     "BackendClient",
     "BackendConfig",
     "BackendError",
+    "ChaosEvent",
+    "ChaosTrack",
     "CircuitBreaker",
+    "FaultSpec",
     "FleetProber",
     "FleetRouter",
     "FleetUnavailable",
@@ -74,6 +91,9 @@ __all__ = [
     "RolloutError",
     "RouterAdmin",
     "build_fleet",
+    "faults_from_env",
+    "install_fault_hooks",
+    "parse_chaos_events",
     "parse_fleet",
     "wait_ready",
 ]

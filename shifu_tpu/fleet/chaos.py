@@ -1,0 +1,328 @@
+"""Fault injection for fleet chaos testing and the loadgen chaos track.
+
+Two surfaces, one module:
+
+**Server-side fault hooks** (:class:`FaultSpec` +
+:func:`install_fault_hooks`) — the deterministic failure injectors the
+two-process fleet tests drive via ``FLEET_BACKEND_FAULT_*`` env vars
+(tests/_fleet_backend.py reads them with :func:`faults_from_env` and
+installs them on its real HTTP server). Each hook makes one failure
+path reproducible instead of waiting for the network to misbehave:
+
+  * ``drop_nth`` (``FLEET_BACKEND_FAULT_DROP_NTH=N``) — the Nth
+    ``/v1/completions`` request has its connection severed before any
+    response bytes (the router's failed-before-first-delta
+    resubmission path).
+  * ``slow_probe_s`` (``FLEET_BACKEND_FAULT_SLOW_PROBE=S``) — every
+    ``/healthz`` answer is delayed S seconds (probe timeouts, prober
+    failure backoff).
+  * ``reload_fail`` (``FLEET_BACKEND_FAULT_RELOAD_FAIL=1``) — every
+    ``POST /reloadz`` 503s without touching the weights (the rollout
+    controller's halt-and-resume-on-old-weights path).
+  * ``kill_after`` (``FLEET_BACKEND_FAULT_KILL_AFTER=N``) — the
+    process SIGKILLs itself right after answering its Nth completion:
+    a kill *schedule* the parent does not have to time, so "backend
+    dies mid-run" is deterministic in request counts, not seconds.
+
+**Scheduled chaos track** (:class:`ChaosEvent` + :class:`ChaosTrack`)
+— the loadgen timeline's fault choreography. A scenario declares
+events at offsets into the run (``{"at_s": 10, "action": "kill",
+"target": "127.0.0.1:8101"}``); the track executes them against a
+live fleet while the generator drives traffic: ``kill`` SIGKILLs a
+backend process (pid supplied by the operator — the router only knows
+addresses), ``drain``/``resume`` flip a backend via the router's
+``/drainz``, and ``rollout`` runs a full rolling weight update through
+:class:`~shifu_tpu.fleet.rollout.RolloutController` mid-run. Every
+execution counts into ``shifu_loadgen_chaos_events_total`` and leaves
+a flight-ring event, so a chaos run's verdict report can show exactly
+what was done to the fleet and when. Clock/sleep/action executors are
+injectable — the unit tests run the whole schedule on a fake clock
+with fake executors, no fleet and no sleeps.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import os
+import signal
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+ENV_PREFIX = "FLEET_BACKEND_FAULT_"
+
+CHAOS_ACTIONS = ("kill", "drain", "resume", "rollout")
+
+
+# ------------------------------------------------- server-side hooks
+@dataclasses.dataclass
+class FaultSpec:
+    """Declarative server-side fault selection (all off by default)."""
+
+    drop_nth: int = 0
+    slow_probe_s: float = 0.0
+    reload_fail: bool = False
+    kill_after: int = 0
+
+    def active(self) -> bool:
+        return bool(
+            self.drop_nth or self.slow_probe_s
+            or self.reload_fail or self.kill_after
+        )
+
+
+def faults_from_env(env=None) -> FaultSpec:
+    """The ``FLEET_BACKEND_FAULT_*`` env contract -> :class:`FaultSpec`
+    (the spawned test backends' configuration channel)."""
+    env = env if env is not None else os.environ
+    return FaultSpec(
+        drop_nth=int(env.get(ENV_PREFIX + "DROP_NTH", "0")),
+        slow_probe_s=float(env.get(ENV_PREFIX + "SLOW_PROBE", "0")),
+        reload_fail=bool(int(env.get(ENV_PREFIX + "RELOAD_FAIL", "0"))),
+        kill_after=int(env.get(ENV_PREFIX + "KILL_AFTER", "0")),
+    )
+
+
+def install_fault_hooks(server, spec: Optional[FaultSpec] = None) -> bool:
+    """Wrap ``server``'s handler class with the selected chaos hooks
+    (subclass + swap — ``make_server``'s handler stays untouched).
+    Returns True when any hook was installed."""
+    spec = spec if spec is not None else faults_from_env()
+    if not spec.active():
+        return False
+    import socket
+
+    base = server.RequestHandlerClass
+    counter = itertools.count(1)
+
+    class FaultyHandler(base):
+        def _handle_completions(self, chat):
+            n = next(counter)
+            if spec.drop_nth and n == spec.drop_nth:
+                # Sever before any response bytes: the client (the
+                # fleet router) sees a clean transport failure with
+                # the request still invisible to ITS caller, so it
+                # must resubmit.
+                try:
+                    self.connection.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                self.close_connection = True
+                return
+            out = super()._handle_completions(chat)
+            if spec.kill_after and n >= spec.kill_after:
+                # The response above is fully written: the caller saw
+                # a clean success, the NEXT request finds a corpse —
+                # the deterministic "died between requests" shape.
+                os.kill(os.getpid(), signal.SIGKILL)
+            return out
+
+        def do_GET(self):
+            if spec.slow_probe_s and self.path == "/healthz":
+                time.sleep(spec.slow_probe_s)
+            return super().do_GET()
+
+        def _handle_reload(self):
+            if spec.reload_fail:
+                self._send(503, {
+                    "error": "injected reload failure (chaos hook)",
+                    "reloaded": False,
+                })
+                return
+            return super()._handle_reload()
+
+    server.RequestHandlerClass = FaultyHandler
+    return True
+
+
+# -------------------------------------------------- scheduled track
+@dataclasses.dataclass
+class ChaosEvent:
+    """One scheduled fault: ``action`` at ``at_s`` seconds into the
+    run. ``target`` is a backend address for kill/drain/resume;
+    ``args`` carries action extras (``pid`` for kill, ``ckpt`` +
+    optional controller knobs for rollout)."""
+
+    at_s: float
+    action: str
+    target: Optional[str] = None
+    args: Dict[str, object] = dataclasses.field(default_factory=dict)
+
+
+def parse_chaos_events(docs) -> List[ChaosEvent]:
+    """Scenario ``chaos`` list -> validated, time-sorted events.
+    Raises ValueError with every problem collected (not just the
+    first) so ``loadgen --check`` reports the full damage."""
+    if docs is None:
+        return []
+    if not isinstance(docs, (list, tuple)):
+        raise ValueError("chaos must be a list of event objects")
+    events, problems = [], []
+    for i, doc in enumerate(docs):
+        if not isinstance(doc, dict):
+            problems.append(f"chaos[{i}]: not an object")
+            continue
+        action = doc.get("action")
+        if action not in CHAOS_ACTIONS:
+            problems.append(
+                f"chaos[{i}]: unknown action {action!r} "
+                f"(want one of {', '.join(CHAOS_ACTIONS)})"
+            )
+            continue
+        try:
+            at_s = float(doc.get("at_s", -1))
+        except (TypeError, ValueError):
+            at_s = -1.0
+        if at_s < 0:
+            problems.append(f"chaos[{i}]: at_s must be a number >= 0")
+            continue
+        target = doc.get("target")
+        args = {
+            k: v for k, v in doc.items()
+            if k not in ("at_s", "action", "target")
+        }
+        if action in ("drain", "resume", "kill") and not target:
+            problems.append(f"chaos[{i}]: {action} requires a target "
+                            "backend address")
+            continue
+        if action == "rollout" and not args.get("ckpt"):
+            problems.append(f"chaos[{i}]: rollout requires a ckpt")
+            continue
+        events.append(ChaosEvent(
+            at_s=at_s, action=str(action),
+            target=str(target) if target else None, args=args,
+        ))
+    if problems:
+        raise ValueError("; ".join(problems))
+    return sorted(events, key=lambda e: e.at_s)
+
+
+class ChaosTrack:
+    """Execute a chaos schedule against a live fleet on its own
+    thread. ``pids`` maps backend address -> OS pid (the kill action's
+    ammunition — only the process's parent knows it). ``actions`` maps
+    action name -> ``callable(event)`` and overrides the default
+    executors (the unit tests inject fakes and run the schedule on a
+    fake clock). Executions append ``{"at_s", "action", "target",
+    "outcome", "t_s"}`` rows to ``executed`` — the verdict report's
+    chaos ledger."""
+
+    def __init__(self, events: List[ChaosEvent], *,
+                 url: Optional[str] = None,
+                 pids: Optional[Dict[str, int]] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 sleep: Callable[[float], None] = time.sleep,
+                 actions: Optional[Dict[str, Callable]] = None,
+                 metrics=None, flight=None):
+        from shifu_tpu import obs as _obs
+
+        self.events = sorted(events, key=lambda e: e.at_s)
+        self.url = url.rstrip("/") if url else None
+        self.pids = dict(pids or {})
+        self.clock = clock
+        self.sleep = sleep
+        self.actions = dict(actions or {})
+        self.flight = flight if flight is not None else _obs.FLIGHT
+        reg = metrics if metrics is not None else _obs.REGISTRY
+        self._c_events = reg.counter(
+            "shifu_loadgen_chaos_events_total",
+            "Chaos-track events executed during a loadgen run",
+            labelnames=("action", "outcome"),
+        )
+        self.executed: List[dict] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._t0 = 0.0
+
+    # --------------------------------------------------- lifecycle
+    def start(self, t0: Optional[float] = None) -> None:
+        if not self.events:
+            return
+        t0 = self.clock() if t0 is None else t0
+        self._thread = threading.Thread(
+            target=self.run_events, args=(t0,),
+            name="shifu-chaos-track", daemon=True,
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def join(self, timeout_s: float = 30.0) -> None:
+        if self._thread is not None:
+            self._thread.join(timeout=timeout_s)
+
+    def run_events(self, t0: float) -> None:
+        """The schedule loop (public so fake-clock tests can run it
+        inline, no thread)."""
+        self._t0 = t0
+        for ev in self.events:
+            while not self._stop.is_set():
+                wait = t0 + ev.at_s - self.clock()
+                if wait <= 0:
+                    break
+                self.sleep(min(wait, 0.05))
+            if self._stop.is_set():
+                return
+            self._execute(ev)
+
+    # --------------------------------------------------- execution
+    def _execute(self, ev: ChaosEvent) -> None:
+        fn = self.actions.get(ev.action) or getattr(
+            self, "_do_" + ev.action
+        )
+        try:
+            fn(ev)
+            outcome = "ok"
+        except Exception as e:  # noqa: BLE001 — chaos must not kill the run
+            outcome = f"error:{type(e).__name__}"
+        self._c_events.labels(action=ev.action, outcome=(
+            "ok" if outcome == "ok" else "error"
+        )).inc()
+        self.flight.record(
+            "chaos_" + ev.action, target=ev.target, outcome=outcome,
+        )
+        self.executed.append({
+            "at_s": ev.at_s, "action": ev.action, "target": ev.target,
+            "outcome": outcome, "t_s": round(self.clock() - self._t0, 3),
+        })
+
+    def _do_kill(self, ev: ChaosEvent) -> None:
+        pid = ev.args.get("pid", self.pids.get(ev.target))
+        if pid is None:
+            raise ValueError(
+                f"no pid known for backend {ev.target!r} "
+                "(pass pids= or a pid arg on the event)"
+            )
+        os.kill(int(pid), signal.SIGKILL)
+
+    def _admin(self):
+        from shifu_tpu.fleet.rollout import RouterAdmin
+
+        if self.url is None:
+            raise ValueError("chaos drain/resume/rollout need a "
+                             "router url")
+        return RouterAdmin(self.url)
+
+    def _do_drain(self, ev: ChaosEvent) -> None:
+        self._admin().drain(ev.target)
+
+    def _do_resume(self, ev: ChaosEvent) -> None:
+        self._admin().resume(ev.target)
+
+    def _do_rollout(self, ev: ChaosEvent) -> None:
+        from shifu_tpu.fleet.rollout import RolloutController
+
+        ctl = RolloutController(
+            self._admin(), str(ev.args["ckpt"]),
+            max_unavailable=int(ev.args.get("max_unavailable", 1)),
+            drain_timeout_s=float(ev.args.get("drain_timeout_s", 30.0)),
+            ready_timeout_s=float(ev.args.get("ready_timeout_s", 30.0)),
+        )
+        report = ctl.run()
+        if report.get("status") != "complete":
+            raise RuntimeError(
+                f"mid-run rollout did not complete: "
+                f"{report.get('status')}"
+            )

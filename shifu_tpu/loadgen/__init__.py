@@ -1,0 +1,71 @@
+"""Measurement harness: trace-replay load generation with SLO verdicts.
+
+The instrument ROADMAP item 6 names: every fleet claim ("p99 held
+through the rolling update", "backfill costs X ms of interactive
+TTFT") becomes one repeatable command that offers a declared traffic
+mix at a fixed open-loop load, scrapes the live ``/sloz`` + ``/statz``
++ federated ``/metrics`` while driving, and exits with per-tier SLO
+verdicts plus a compact bench row the benchgate can regress against.
+
+``scenario``   the declarative contract: mix, rate, arrival process,
+               tier budgets, chaos timeline (docs/loadgen.md).
+``arrival``    seeded open-loop arrival processes (constant +
+               Poisson) — the offered schedule is a pure function of
+               the scenario, computed before the run.
+``workload``   trace-shaped request synthesis: multi-turn chat with
+               shared system prompts, RAG long prefills, json-mode
+               agent loops, tool-call bursts, batch backfill.
+``runner``     the open-loop HTTP driver + scrape loop + bounded
+               drain; ``shifu_tpu loadgen`` wraps it.
+``verdict``    scoring: the scenario's own SLOEngine over the real
+               scrape, fused with the client-side request ledger into
+               the machine-readable verdict report / ``lg_*`` row.
+
+The chaos track (SIGKILL / drain / resume / mid-run rollout folded
+into the scenario timeline) lives in :mod:`shifu_tpu.fleet.chaos` —
+the same module the two-process test backends draw their fault hooks
+from.
+"""
+
+from shifu_tpu.loadgen.arrival import (
+    arrival_times,
+    intervals,
+    offered_load,
+)
+from shifu_tpu.loadgen.runner import LoadRunner
+from shifu_tpu.loadgen.scenario import (
+    BUILTIN_SCENARIOS,
+    MixEntry,
+    Scenario,
+    ScenarioError,
+    check_scenario,
+    load_scenario,
+    parse_scenario,
+)
+from shifu_tpu.loadgen.verdict import (
+    ClientStats,
+    VerdictScorer,
+    compact_row,
+    pool_samples,
+)
+from shifu_tpu.loadgen.workload import Request, WorkloadModel
+
+__all__ = [
+    "BUILTIN_SCENARIOS",
+    "ClientStats",
+    "LoadRunner",
+    "MixEntry",
+    "Request",
+    "Scenario",
+    "ScenarioError",
+    "VerdictScorer",
+    "WorkloadModel",
+    "arrival_times",
+    "check_scenario",
+    "compact_row",
+    "intervals",
+    "load_scenario",
+    "offered_load",
+    "parse_scenario",
+    "pool_samples",
+]

@@ -1,0 +1,78 @@
+"""Open-loop arrival processes: when requests are offered, not when
+the server is ready for them.
+
+The defining property of an open-loop generator is that arrival times
+are computed BEFORE the run from (rate, process, seed) alone — a slow
+server does not slow the generator down, it just accumulates latency
+(the closed-loop coordinated-omission trap is designing the schedule
+around completions). Two processes:
+
+  * ``constant`` — metronome arrivals at exactly ``i / rate``:
+    deterministic spacing, the capacity-measurement default.
+  * ``poisson`` — i.i.d. exponential inter-arrivals (rate lambda):
+    memoryless bursts, the million-independent-users shape.
+
+Everything is a pure function of ``(rate_rps, kind, duration_s,
+seed)``: the same scenario replays the same offered timeline on every
+run, on every machine, with no clock in sight — the unit tests pin
+distributions and offered-load accounting with zero sleeps.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Iterator, List
+
+from shifu_tpu.loadgen.scenario import ARRIVALS
+
+
+def intervals(rate_rps: float, kind: str = "poisson",
+              seed: int = 0) -> Iterator[float]:
+    """Infinite seeded inter-arrival generator (seconds)."""
+    if rate_rps <= 0:
+        raise ValueError(f"rate_rps must be > 0, got {rate_rps}")
+    if kind not in ARRIVALS:
+        raise ValueError(f"unknown arrival process {kind!r}")
+    if kind == "constant":
+        gap = 1.0 / rate_rps
+        while True:
+            yield gap
+    rng = random.Random(seed)
+    while True:
+        yield rng.expovariate(rate_rps)
+
+
+def arrival_times(rate_rps: float, kind: str, duration_s: float,
+                  seed: int = 0) -> List[float]:
+    """The full offered timeline: arrival offsets in ``[0,
+    duration_s)``, first arrival at t=0 (constant) / after the first
+    exponential gap (poisson — an arrival AT zero would make the
+    empty-run probability zero, which a Poisson process forbids)."""
+    if duration_s <= 0:
+        raise ValueError(f"duration_s must be > 0, got {duration_s}")
+    out: List[float] = []
+    if kind == "constant":
+        # Exact i/rate, not an accumulated sum: 30 additions of 0.1
+        # drift below 3.0 and conjure a 31st arrival.
+        if rate_rps <= 0:
+            raise ValueError(f"rate_rps must be > 0, got {rate_rps}")
+        i = 0
+        while i / rate_rps < duration_s:
+            out.append(i / rate_rps)
+            i += 1
+        return out
+    gen = intervals(rate_rps, kind, seed)
+    t = next(gen)
+    while t < duration_s:
+        out.append(t)
+        t += next(gen)
+    return out
+
+
+def offered_load(times: List[float], duration_s: float) -> float:
+    """Offered load in requests/s — the schedule's own accounting
+    (achieved-vs-offered divides by THIS, not the nominal rate, so a
+    short Poisson draw doesn't masquerade as a server shortfall)."""
+    if duration_s <= 0:
+        return 0.0
+    return len(times) / duration_s

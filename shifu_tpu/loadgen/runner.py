@@ -1,0 +1,330 @@
+"""The open-loop driver: offered timeline -> live HTTP -> verdict.
+
+One :class:`LoadRunner` drives one scenario against one base URL (a
+fleet router or a bare engine server — both speak the same
+completions + scrape surface). The loop is strictly open:
+
+  1. The arrival timeline is computed up front
+     (:func:`~shifu_tpu.loadgen.arrival.arrival_times` — seeded, so
+     the offered schedule is a constant of the scenario).
+  2. At each arrival the request fires on its own thread and the loop
+     moves on — a slow server accumulates in-flight requests and
+     latency, it never slows the generator (in-flight is capped at
+     ``max_inflight``; arrivals past the cap are recorded as *shed*,
+     status 0, so saturation shows up as errors, not silence).
+  3. A scrape thread snapshots ``/metrics`` into the
+     :class:`~shifu_tpu.loadgen.verdict.VerdictScorer` (and keeps the
+     last ``/sloz`` + ``/statz`` documents) every
+     ``scrape_interval_s`` — polling ``/sloz`` also drives the
+     router's own lazily-sampled SLO engine, so server-side breach
+     incidents fire DURING the run, not after.
+  4. The chaos track (if the scenario declares one) runs its schedule
+     on its own thread against the same fleet.
+  5. After the last arrival the runner drains in-flight requests
+     (bounded by ``request_timeout_s`` + grace — a hung request
+     becomes a recorded timeout, never a hung harness), takes a final
+     scrape, and scores the verdict report.
+
+Every request lands in the client ledger AND the
+``shifu_loadgen_*`` metric families on the runner's registry, so a
+loadgen process scraped by something else tells the same story it
+reports. ``clock``/``sleep``/``transport`` are injectable; the unit
+tests drive the whole runner against a canned transport on a fake
+clock with zero sockets and zero sleeps.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Callable, List, Optional, Tuple
+
+from shifu_tpu.fleet.chaos import ChaosTrack
+from shifu_tpu.loadgen.arrival import arrival_times, offered_load
+from shifu_tpu.loadgen.scenario import Scenario
+from shifu_tpu.loadgen.verdict import ClientStats, VerdictScorer
+from shifu_tpu.loadgen.workload import Request, WorkloadModel
+
+# TTFT histogram buckets (ms) for the client-side families: spans
+# tiny-CPU-model instant answers through badly-burning seconds.
+_TTFT_BUCKETS = (
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+)
+
+
+def _http_transport(timeout_s: float):
+    """The default wire: POST a completions body, return
+    ``(status, parsed-or-None)``. Transport failures (refused, reset,
+    timeout) come back as status 0 — the client-visible "the fleet
+    hung up" outcome the chaos walks assert on."""
+
+    def post(url: str, body: dict) -> Tuple[int, Optional[dict]]:
+        req = urllib.request.Request(
+            url, data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=timeout_s) as r:
+                return r.status, json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            try:
+                e.read()
+            except OSError:
+                pass
+            return e.code, None
+        except (OSError, ValueError):
+            return 0, None
+
+    def get(url: str) -> Optional[str]:
+        try:
+            with urllib.request.urlopen(url, timeout=timeout_s) as r:
+                return r.read().decode()
+        except (OSError, ValueError):
+            return None
+
+    return post, get
+
+
+class LoadRunner:
+    """Drive one scenario at its offered load; ``run()`` returns the
+    verdict report (see docs/loadgen.md for the document schema)."""
+
+    def __init__(self, scenario: Scenario, url: str, *,
+                 clock: Callable[[], float] = time.monotonic,
+                 sleep: Callable[[float], None] = time.sleep,
+                 request_timeout_s: float = 30.0,
+                 scrape_interval_s: float = 1.0,
+                 max_inflight: int = 256,
+                 metrics=None, flight=None,
+                 chaos: Optional[ChaosTrack] = None,
+                 transport=None):
+        from shifu_tpu import obs as _obs
+
+        self.scenario = scenario
+        self.url = url.rstrip("/")
+        self.clock = clock
+        self.sleep = sleep
+        self.request_timeout_s = float(request_timeout_s)
+        self.scrape_interval_s = float(scrape_interval_s)
+        self.max_inflight = int(max_inflight)
+        self.flight = flight if flight is not None else _obs.FLIGHT
+        reg = metrics if metrics is not None else _obs.REGISTRY
+        self._post, self._get = (
+            transport if transport is not None
+            else _http_transport(self.request_timeout_s)
+        )
+        self.chaos = chaos
+        self.stats = ClientStats()
+        self.scorer = VerdictScorer(
+            scenario.tiers, duration_s=scenario.duration_s,
+            clock=clock, flight=self.flight,
+        )
+        self._lock = threading.Lock()
+        self._inflight = 0
+        self._threads: List[threading.Thread] = []
+        self._stop = threading.Event()
+        self._last_sloz: Optional[dict] = None
+        self._last_statz: Optional[dict] = None
+        # The run's own exported families.
+        self._c_requests = reg.counter(
+            "shifu_loadgen_requests_total",
+            "Requests the load generator completed, by traffic kind "
+            "and client-visible outcome code (0 = transport failure, "
+            "-1 = shed at the in-flight cap)",
+            labelnames=("kind", "tier", "code"),
+        )
+        self._h_ttft = reg.histogram(
+            "shifu_loadgen_ttft_seconds",
+            "Client-observed TTFT of successful loadgen requests "
+            "(server timing when reported, full latency otherwise)",
+            labelnames=("tier",), buckets=_TTFT_BUCKETS,
+        )
+        self._h_latency = reg.histogram(
+            "shifu_loadgen_request_seconds",
+            "Client-observed full request latency of loadgen requests",
+            labelnames=("tier",), buckets=_TTFT_BUCKETS,
+        )
+        self._g_inflight = reg.gauge(
+            "shifu_loadgen_in_flight",
+            "Loadgen requests currently in flight (open loop: grows "
+            "when the target falls behind the offered schedule)",
+        )
+        self._g_offered = reg.gauge(
+            "shifu_loadgen_offered_rps",
+            "Offered load of the running scenario (requests/s, from "
+            "the seeded arrival schedule)", labelnames=("scenario",),
+        )
+
+    # ---------------------------------------------------- the drive
+    def run(self) -> dict:
+        sc = self.scenario
+        times = arrival_times(
+            sc.rate_rps, sc.arrival, sc.duration_s, sc.seed
+        )
+        model = WorkloadModel(sc)
+        # Render every arrival's requests up front: the hot loop only
+        # sleeps and fires, and the request trace is a pure function
+        # of the scenario (chaos or server state cannot perturb the
+        # RNG draw order).
+        batches: List[List[Request]] = [
+            model.next_requests() for _ in times
+        ]
+        n_offered = sum(len(b) for b in batches)
+        offered_rps = n_offered / sc.duration_s
+        self._g_offered.labels(scenario=sc.name).set(offered_rps)
+        self.flight.record(
+            "loadgen_start", scenario=sc.name, offered=n_offered,
+            rate_rps=round(offered_load(times, sc.duration_s), 3),
+            arrival=sc.arrival,
+        )
+
+        t0 = self.clock()
+        if self.chaos is not None:
+            self.chaos.start(t0)
+        scraper = threading.Thread(
+            target=self._scrape_loop, args=(t0,),
+            name="shifu-loadgen-scrape", daemon=True,
+        )
+        scraper.start()
+        try:
+            for at, batch in zip(times, batches):
+                while True:
+                    wait = t0 + at - self.clock()
+                    if wait <= 0:
+                        break
+                    self.sleep(min(wait, 0.05))
+                for r in batch:
+                    self._fire(r)
+            # Hold the measurement window open to its scheduled end:
+            # achieved-vs-offered divides by the same duration the
+            # schedule offered over, not by the last-arrival time.
+            while True:
+                wait = t0 + sc.duration_s - self.clock()
+                if wait <= 0:
+                    break
+                self.sleep(min(wait, 0.05))
+            self._drain(t0)
+        finally:
+            self._stop.set()
+            if self.chaos is not None:
+                self.chaos.stop()
+                self.chaos.join(timeout_s=self.request_timeout_s)
+            scraper.join(timeout=self.scrape_interval_s + 5.0)
+        duration = max(self.clock() - t0, 1e-9)
+        self._scrape_once()  # final snapshot AFTER the drain
+        report = self.scorer.score(
+            scenario_name=sc.name,
+            duration_s=duration,
+            offered_rps=offered_rps,
+            offered_requests=n_offered,
+            client=self.stats,
+            server_sloz=self._last_sloz,
+            statz=self._last_statz,
+            chaos=(
+                self.chaos.executed if self.chaos is not None else None
+            ),
+        )
+        self.flight.record(
+            "loadgen_done", scenario=sc.name,
+            verdict=report["verdict"],
+            goodput_rps=report["goodput_rps"],
+        )
+        return report
+
+    # ------------------------------------------------- firing layer
+    def _fire(self, r: Request) -> None:
+        with self._lock:
+            if self._inflight >= self.max_inflight:
+                # Shed: the schedule stays open-loop, the ledger shows
+                # the target could not absorb the offered load.
+                self.stats.note(
+                    kind=r.kind, tier=r.tier, status=-1,
+                    ttft_ms=None, latency_ms=0.0, tokens=0,
+                    error="shed_max_inflight",
+                )
+                self._c_requests.labels(
+                    kind=r.kind, tier=r.tier, code="-1",
+                ).inc()
+                return
+            self._inflight += 1
+            self._g_inflight.set(self._inflight)
+        t = threading.Thread(
+            target=self._do_request, args=(r,), daemon=True,
+        )
+        self._threads.append(t)
+        t.start()
+
+    def _do_request(self, r: Request) -> None:
+        start = self.clock()
+        try:
+            status, doc = self._post(
+                self.url + "/v1/completions", r.body
+            )
+        except Exception as e:  # noqa: BLE001 — a transport bug is an outcome
+            status, doc = 0, None
+            err = f"transport:{type(e).__name__}: {e}"
+        else:
+            err = None if status == 200 else f"http_{status}"
+        latency_s = max(self.clock() - start, 0.0)
+        ttft_ms = None
+        tokens = 0
+        if status == 200 and isinstance(doc, dict):
+            timing = doc.get("timing") or {}
+            ttft_ms = timing.get("ttft_ms")
+            if ttft_ms is None:
+                ttft_ms = latency_s * 1000.0
+            tokens = len(doc.get("tokens") or ())
+        with self._lock:
+            self.stats.note(
+                kind=r.kind, tier=r.tier, status=status,
+                ttft_ms=ttft_ms, latency_ms=latency_s * 1000.0,
+                tokens=tokens, error=err,
+            )
+            self._inflight -= 1
+            self._g_inflight.set(self._inflight)
+        self._c_requests.labels(
+            kind=r.kind, tier=r.tier, code=str(status),
+        ).inc()
+        self._h_latency.labels(tier=r.tier).observe(latency_s)
+        if ttft_ms is not None:
+            self._h_ttft.labels(tier=r.tier).observe(ttft_ms / 1000.0)
+
+    def _drain(self, t0: float) -> None:
+        """Join every request thread, bounded: a request past its
+        timeout + grace is abandoned (its thread is a daemon) — the
+        harness NEVER hangs on a hung fleet."""
+        deadline = (
+            self.clock() + self.request_timeout_s + 5.0
+        )
+        for t in self._threads:
+            left = deadline - self.clock()
+            if left <= 0:
+                break
+            t.join(timeout=left)
+
+    # ------------------------------------------------- scrape layer
+    def _scrape_once(self) -> None:
+        text = self._get(self.url + "/metrics")
+        if text:
+            try:
+                self.scorer.note_text(text)
+            except ValueError:
+                pass  # a torn scrape mid-restart is not a run failure
+        for path, attr in (("/sloz", "_last_sloz"),
+                           ("/statz", "_last_statz")):
+            raw = self._get(self.url + path)
+            if raw:
+                try:
+                    setattr(self, attr, json.loads(raw))
+                except ValueError:
+                    pass
+        self.scorer.evaluate()
+
+    def _scrape_loop(self, t0: float) -> None:
+        while not self._stop.is_set():
+            self._scrape_once()
+            self._stop.wait(self.scrape_interval_s)

@@ -1,0 +1,309 @@
+"""Declarative loadgen scenarios: the workload contract as data.
+
+A scenario is one JSON object — what traffic to offer (the ``mix``),
+how fast (``rate_rps`` + ``arrival``), for how long (``duration_s``),
+which SLO budgets to score it against (``tiers``), and what to break
+while it runs (``chaos``). The full schema is documented in
+docs/loadgen.md; the shape in brief::
+
+    {
+      "name": "mixed_peak",
+      "seed": 0,
+      "duration_s": 30,
+      "rate_rps": 8,
+      "arrival": "poisson",                  # or "constant"
+      "tiers": ["interactive:ttft=250,itl=40,err=0.01",
+                "batch:ttft=5000,err=0.05"],
+      "mix": [
+        {"kind": "chat", "weight": 4, "turns": 3},
+        {"kind": "rag", "weight": 2, "prompt_tokens": 192},
+        {"kind": "json_agent", "weight": 1},
+        {"kind": "tool_burst", "weight": 1, "burst": 3},
+        {"kind": "batch_backfill", "weight": 1}
+      ],
+      "chaos": [
+        {"at_s": 10, "action": "kill", "target": "127.0.0.1:8101"}
+      ]
+    }
+
+``parse_scenario`` validates hard (every problem collected, not just
+the first — ``loadgen --check`` prints the lot); ``check_scenario``
+wraps it into the ``--check`` report without raising. ``tiers``
+reuses the SLO engine's budget grammar
+(:func:`shifu_tpu.obs.slo.parse_budget_spec`) so the scenario scores
+against exactly the budgets a router would declare, and every mix
+entry must land on a declared tier — a mix that offers batch traffic
+with no batch budget is a config bug, not a silent zero.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, List, Optional, Tuple
+
+from shifu_tpu.fleet.chaos import ChaosEvent, parse_chaos_events
+from shifu_tpu.obs.slo import TierBudget, parse_budget_spec
+
+ARRIVALS = ("constant", "poisson")
+
+# kind -> default tier (a mix entry may override with "tier").
+KINDS: Dict[str, str] = {
+    "chat": "interactive",
+    "rag": "interactive",
+    "json_agent": "interactive",
+    "tool_burst": "interactive",
+    "batch_backfill": "batch",
+}
+
+
+class ScenarioError(ValueError):
+    """A scenario that cannot be run; ``.problems`` carries every
+    validation failure found."""
+
+    def __init__(self, problems: List[str]):
+        super().__init__("; ".join(problems))
+        self.problems = list(problems)
+
+
+@dataclasses.dataclass
+class MixEntry:
+    kind: str
+    weight: float
+    tier: str
+    params: Dict[str, object]
+
+
+@dataclasses.dataclass
+class Scenario:
+    name: str
+    seed: int
+    duration_s: float
+    rate_rps: float
+    arrival: str
+    tiers: List[TierBudget]
+    mix: List[MixEntry]
+    chaos: List[ChaosEvent]
+
+    def budget(self, tier: str) -> Optional[TierBudget]:
+        for b in self.tiers:
+            if b.tier == tier:
+                return b
+        return None
+
+
+def parse_scenario(doc: dict) -> Scenario:
+    """Validate + normalise one scenario document. Raises
+    :class:`ScenarioError` carrying EVERY problem found."""
+    problems: List[str] = []
+    if not isinstance(doc, dict):
+        raise ScenarioError(["scenario must be a JSON object"])
+
+    name = doc.get("name")
+    if not name or not isinstance(name, str):
+        problems.append("name: required (a non-empty string)")
+        name = "<unnamed>"
+
+    def _num(key, default, lo):
+        try:
+            v = float(doc.get(key, default))
+        except (TypeError, ValueError):
+            problems.append(f"{key}: not a number")
+            return float(default)
+        if v <= lo:
+            problems.append(f"{key}: must be > {lo}, got {v}")
+        return v
+
+    duration_s = _num("duration_s", 30.0, 0.0)
+    rate_rps = _num("rate_rps", 1.0, 0.0)
+    seed = int(doc.get("seed", 0) or 0)
+    arrival = str(doc.get("arrival", "poisson"))
+    if arrival not in ARRIVALS:
+        problems.append(
+            f"arrival: unknown process {arrival!r} "
+            f"(want one of {', '.join(ARRIVALS)})"
+        )
+
+    # --- tiers: the SLO budgets the run is scored against
+    tiers: List[TierBudget] = []
+    specs = doc.get("tiers") or []
+    if not isinstance(specs, (list, tuple)) or not specs:
+        problems.append("tiers: at least one budget spec required "
+                        "(e.g. 'interactive:ttft=250,err=0.01')")
+        specs = []
+    for spec in specs:
+        try:
+            tiers.append(parse_budget_spec(str(spec)))
+        except ValueError as e:
+            problems.append(f"tiers: {e}")
+    seen = [b.tier for b in tiers]
+    if len(set(seen)) != len(seen):
+        problems.append(f"tiers: duplicate tier budgets: {seen}")
+
+    # --- mix: what the offered load is made of
+    mix: List[MixEntry] = []
+    entries = doc.get("mix") or []
+    if not isinstance(entries, (list, tuple)) or not entries:
+        problems.append("mix: at least one entry required")
+        entries = []
+    declared = {b.tier for b in tiers}
+    total_w = 0.0
+    for i, e in enumerate(entries):
+        if not isinstance(e, dict):
+            problems.append(f"mix[{i}]: not an object")
+            continue
+        kind = e.get("kind")
+        if kind not in KINDS:
+            problems.append(
+                f"mix[{i}]: unknown kind {kind!r} "
+                f"(want one of {', '.join(sorted(KINDS))})"
+            )
+            continue
+        try:
+            weight = float(e.get("weight", 1.0))
+        except (TypeError, ValueError):
+            weight = -1.0
+        if weight <= 0:
+            problems.append(f"mix[{i}] ({kind}): weight must be > 0")
+            continue
+        tier = str(e.get("tier", KINDS[kind]))
+        if declared and tier not in declared:
+            problems.append(
+                f"mix[{i}] ({kind}): tier {tier!r} has no declared "
+                f"budget (tiers: {sorted(declared)})"
+            )
+        params = {
+            k: v for k, v in e.items()
+            if k not in ("kind", "weight", "tier")
+        }
+        total_w += weight
+        mix.append(MixEntry(kind=str(kind), weight=weight,
+                            tier=tier, params=params))
+    if entries and mix and total_w <= 0:
+        problems.append("mix: weights must sum > 0")
+
+    # --- chaos: the scheduled fault track
+    chaos: List[ChaosEvent] = []
+    try:
+        chaos = parse_chaos_events(doc.get("chaos"))
+    except ValueError as e:
+        problems.append(str(e))
+    for ev in chaos:
+        if ev.at_s >= duration_s:
+            problems.append(
+                f"chaos: {ev.action} at {ev.at_s}s is at/after the "
+                f"run ends ({duration_s}s)"
+            )
+
+    if problems:
+        raise ScenarioError(problems)
+    return Scenario(
+        name=name, seed=seed, duration_s=duration_s,
+        rate_rps=rate_rps, arrival=arrival, tiers=tiers,
+        mix=mix, chaos=chaos,
+    )
+
+
+def load_scenario(path: str) -> Scenario:
+    """Parse a scenario JSON file (or a built-in name from
+    :data:`BUILTIN_SCENARIOS`)."""
+    if path in BUILTIN_SCENARIOS:
+        return parse_scenario(BUILTIN_SCENARIOS[path])
+    with open(path, encoding="utf-8") as f:
+        try:
+            doc = json.load(f)
+        except json.JSONDecodeError as e:
+            raise ScenarioError([f"{path}: not valid JSON ({e})"])
+    return parse_scenario(doc)
+
+
+def check_scenario(path: str) -> Tuple[bool, dict]:
+    """``loadgen --check``'s engine: (ok, report) with the scenario
+    summary on success and every problem on failure — no traffic, no
+    network, fast enough for tier-1."""
+    try:
+        sc = load_scenario(path)
+    except ScenarioError as e:
+        return False, {
+            "status": "fail", "scenario": path,
+            "problems": e.problems,
+        }
+    except OSError as e:
+        return False, {
+            "status": "fail", "scenario": path,
+            "problems": [f"cannot read {path}: {e}"],
+        }
+    total_w = sum(m.weight for m in sc.mix)
+    return True, {
+        "status": "ok",
+        "scenario": sc.name,
+        "duration_s": sc.duration_s,
+        "rate_rps": sc.rate_rps,
+        "arrival": sc.arrival,
+        "offered_requests": int(sc.rate_rps * sc.duration_s),
+        "tiers": {
+            b.tier: {
+                k: v for k, v in (
+                    ("p99_ttft_ms", b.p99_ttft_ms),
+                    ("p99_itl_ms", b.p99_itl_ms),
+                    ("max_error_rate", b.max_error_rate),
+                    ("objective", b.objective),
+                ) if v is not None
+            } for b in sc.tiers
+        },
+        "mix": {
+            m.kind: round(m.weight / total_w, 4) for m in sc.mix
+        },
+        "chaos_events": len(sc.chaos),
+        "problems": [],
+    }
+
+
+# Built-in scenarios: runnable by name (no file), small enough for the
+# dryrun / bench legs yet shaped like the real thing — every traffic
+# kind the schema knows, both tiers, no chaos (the chaos track needs
+# operator-supplied pids/ckpts).
+BUILTIN_SCENARIOS: Dict[str, dict] = {
+    "smoke": {
+        "name": "smoke",
+        "seed": 0,
+        "duration_s": 2.0,
+        "rate_rps": 4.0,
+        "arrival": "constant",
+        # Budgets sized for a cold tiny-CPU engine (first requests
+        # pay prefill/decode JIT compiles measured in seconds).
+        "tiers": ["interactive:ttft=15000,err=0.05",
+                  "batch:ttft=30000,err=0.10"],
+        "mix": [
+            {"kind": "chat", "weight": 2, "turns": 2,
+             "system_tokens": 12, "turn_tokens": 4,
+             "max_new_tokens": 3},
+            {"kind": "rag", "weight": 1, "prompt_tokens": 20,
+             "max_new_tokens": 2},
+            {"kind": "batch_backfill", "weight": 1,
+             "prompt_tokens": 6, "max_new_tokens": 4},
+        ],
+    },
+    "mixed_peak": {
+        "name": "mixed_peak",
+        "seed": 0,
+        "duration_s": 60.0,
+        "rate_rps": 16.0,
+        "arrival": "poisson",
+        "tiers": ["interactive:ttft=250,itl=40,err=0.01",
+                  "batch:ttft=5000,err=0.05"],
+        "mix": [
+            {"kind": "chat", "weight": 4, "turns": 4,
+             "system_tokens": 64, "turn_tokens": 24,
+             "max_new_tokens": 32},
+            {"kind": "rag", "weight": 2, "prompt_tokens": 512,
+             "max_new_tokens": 24},
+            {"kind": "json_agent", "weight": 1,
+             "max_new_tokens": 48},
+            {"kind": "tool_burst", "weight": 1, "burst": 3,
+             "max_new_tokens": 24},
+            {"kind": "batch_backfill", "weight": 1,
+             "prompt_tokens": 96, "max_new_tokens": 64},
+        ],
+    },
+}

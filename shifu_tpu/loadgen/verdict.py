@@ -1,0 +1,261 @@
+"""Per-tier SLO verdicts: scoring a loadgen run from the real scrape.
+
+The scorer runs its OWN :class:`~shifu_tpu.obs.slo.SLOEngine` over the
+target's ``/metrics`` exposition, snapshotted while the generator
+drives traffic — the same burn-rate window math the router's ``/sloz``
+uses, but seeded with the SCENARIO's tier budgets, so a run scores
+against the budgets the measurement declares even when the target
+server has no ``--slo`` flags at all. Against a fleet router the
+scrape is the federated pool (``shifu_fleet_agg_*``, one scrape covers
+every backend); against a bare engine server the raw per-host
+families are re-keyed under the federation prefix so the window math
+is identical either way.
+
+The final report combines three views:
+
+  * **server-side burn** — per-tier status (pass / burning /
+    breached), fast/slow-window burn rates and headroom from the
+    scraped latency histograms + error counters;
+  * **client-side truth** — offered vs achieved load, goodput,
+    error rate, and client-observed TTFT percentiles from the
+    generator's own per-request ledger (the view coordinated
+    omission cannot hide from: arrivals were scheduled open-loop);
+  * **the chaos ledger** — what the chaos track did and when, so a
+    burning verdict reads next to the fault that caused it.
+
+``compact_row`` flattens the headline into ``lg_*`` keys — the bench
+line / benchgate vocabulary (obs/benchgate.py declares them as
+dormant, armable rows).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from shifu_tpu.obs.disttrace import AGG_PREFIX
+from shifu_tpu.obs.registry import MetricsRegistry, parse_exposition
+from shifu_tpu.obs.slo import (
+    ITL_FAMILY,
+    SLOEngine,
+    STATUS_BREACHED,
+    STATUS_BURNING,
+    STATUS_OK,
+    TTFT_FAMILY,
+    TierBudget,
+    _agg,
+)
+
+# Verdict words (STATUS_OK is a server-side word; a RUN that holds its
+# budgets "passes").
+VERDICT_PASS = "pass"
+
+_RANK = {STATUS_OK: 0, STATUS_BURNING: 1, STATUS_BREACHED: 2}
+
+
+def pool_samples(parsed: Dict[tuple, float]) -> Dict[tuple, float]:
+    """Normalise one ``/metrics`` parse for the SLO window math:
+
+    * drop per-backend federated duplicates (series carrying a
+      ``backend`` label under the agg prefix — the pooled series
+      already counts them; keeping both would double-count), and
+    * when the scrape has NO federation (a bare engine server),
+      re-key the raw latency-histogram buckets under the agg name the
+      window math looks up.
+    """
+    out: Dict[tuple, float] = {}
+    for (name, labels), v in parsed.items():
+        if name.startswith(AGG_PREFIX) and dict(labels).get("backend"):
+            continue
+        out[(name, labels)] = v
+    for fam in (TTFT_FAMILY, ITL_FAMILY):
+        agg_bucket = _agg(fam) + "_bucket"
+        if any(n == agg_bucket for (n, _l) in out):
+            continue
+        for (n, labels), v in list(out.items()):
+            if n == fam + "_bucket":
+                out[(agg_bucket, labels)] = v
+    return out
+
+
+class ClientStats:
+    """The generator's own per-request ledger, aggregated per tier.
+    Thread-compatible: the runner appends under its lock."""
+
+    def __init__(self):
+        self.rows: List[dict] = []
+
+    def note(self, *, kind: str, tier: str, status: int,
+             ttft_ms: Optional[float], latency_ms: float,
+             tokens: int, error: Optional[str] = None) -> None:
+        self.rows.append({
+            "kind": kind, "tier": tier, "status": int(status),
+            "ttft_ms": ttft_ms, "latency_ms": float(latency_ms),
+            "tokens": int(tokens), "error": error,
+        })
+
+    @staticmethod
+    def _pct(values: List[float], q: float) -> Optional[float]:
+        if not values:
+            return None
+        vs = sorted(values)
+        i = min(int(q * len(vs)), len(vs) - 1)
+        return round(vs[i], 2)
+
+    def tier_doc(self, tier: str, duration_s: float) -> dict:
+        rows = [r for r in self.rows if r["tier"] == tier]
+        ok = [r for r in rows if r["status"] == 200]
+        ttfts = [r["ttft_ms"] for r in ok if r["ttft_ms"] is not None]
+        lats = [r["latency_ms"] for r in ok]
+        n = len(rows)
+        return {
+            "requests": n,
+            "ok": len(ok),
+            "errors": n - len(ok),
+            "error_rate": round((n - len(ok)) / n, 4) if n else 0.0,
+            "achieved_rps": round(n / duration_s, 3),
+            "goodput_rps": round(len(ok) / duration_s, 3),
+            "tokens_out": sum(r["tokens"] for r in ok),
+            "p50_ttft_ms": self._pct(ttfts, 0.50),
+            "p99_ttft_ms": self._pct(ttfts, 0.99),
+            "p50_latency_ms": self._pct(lats, 0.50),
+            "p99_latency_ms": self._pct(lats, 0.99),
+        }
+
+
+class VerdictScorer:
+    """One scenario's scoring engine. Feed it ``/metrics`` text (or
+    pre-parsed sample dicts) while the run drives; ``score()`` at the
+    end renders the machine-readable verdict report.
+
+    Windows default to the scenario timescale (a loadgen run lasts
+    seconds-to-minutes, not the router's 1m/15m operating windows):
+    fast = half the run, slow = the whole run, so "breached" means
+    the budget burned across the ENTIRE measurement."""
+
+    def __init__(self, budgets: List[TierBudget], *,
+                 duration_s: float,
+                 fast_window_s: Optional[float] = None,
+                 slow_window_s: Optional[float] = None,
+                 burn_threshold: float = 1.0,
+                 clock=time.monotonic, flight=None):
+        slow = slow_window_s if slow_window_s else max(duration_s, 2.0)
+        fast = fast_window_s if fast_window_s else slow / 2.0
+        self.transitions: List[dict] = []
+        # Isolated registry: the scorer's shifu_slo_* gauges describe
+        # THIS run, not the process hosting it.
+        self.registry = MetricsRegistry()
+        self.engine = SLOEngine(
+            list(budgets),
+            fast_window_s=fast, slow_window_s=slow,
+            sample_interval_s=0.0, burn_threshold=burn_threshold,
+            metrics=self.registry, flight=flight, clock=clock,
+            on_breach=self._on_breach,
+        )
+        self._clock = clock
+
+    def _on_breach(self, tier: str, info: dict) -> None:
+        self.transitions.append({
+            "tier": tier,
+            "status": info.get("status"),
+            "burn_rate": info.get("burn_rate"),
+            "t_s": round(self._clock(), 3),
+        })
+
+    # ------------------------------------------------------- feeding
+    def note_text(self, exposition: str) -> None:
+        self.note_samples(parse_exposition(exposition))
+
+    def note_samples(self, parsed: Dict[tuple, float]) -> None:
+        self.engine.note(pool_samples(parsed))
+
+    def evaluate(self) -> dict:
+        return self.engine.evaluate()
+
+    # ------------------------------------------------------- scoring
+    def score(self, *, scenario_name: str, duration_s: float,
+              offered_rps: float, offered_requests: int,
+              client: ClientStats,
+              server_sloz: Optional[dict] = None,
+              statz: Optional[dict] = None,
+              chaos: Optional[List[dict]] = None) -> dict:
+        sloz = self.evaluate()
+        tiers: Dict[str, dict] = {}
+        worst = STATUS_OK
+        for tier, doc in sloz.get("tiers", {}).items():
+            cdoc = client.tier_doc(tier, duration_s)
+            status = doc.get("status", STATUS_OK)
+            if _RANK.get(status, 0) > _RANK.get(worst, 0):
+                worst = status
+            tiers[tier] = {
+                "status": status,
+                "burn_rate": doc.get("burn_rate"),
+                "headroom": doc.get("headroom"),
+                "windows": doc.get("windows"),
+                "budget": doc.get("budget"),
+                "client": cdoc,
+            }
+        all_rows = client.rows
+        ok_rows = [r for r in all_rows if r["status"] == 200]
+        achieved_rps = round(len(all_rows) / duration_s, 3)
+        goodput_rps = round(len(ok_rows) / duration_s, 3)
+        err_rate = (
+            round((len(all_rows) - len(ok_rows)) / len(all_rows), 4)
+            if all_rows else 0.0
+        )
+        ttfts = [
+            r["ttft_ms"] for r in ok_rows if r["ttft_ms"] is not None
+        ]
+        verdict = VERDICT_PASS if worst == STATUS_OK else worst
+        report = {
+            "scenario": scenario_name,
+            "duration_s": round(duration_s, 3),
+            "verdict": verdict,
+            "offered_rps": round(offered_rps, 3),
+            "offered_requests": int(offered_requests),
+            "achieved_rps": achieved_rps,
+            "goodput_rps": goodput_rps,
+            "error_rate": err_rate,
+            "achieved_x_offered": (
+                round(achieved_rps / offered_rps, 4)
+                if offered_rps > 0 else None
+            ),
+            "p50_ttft_ms": ClientStats._pct(ttfts, 0.50),
+            "p99_ttft_ms": ClientStats._pct(ttfts, 0.99),
+            "tiers": tiers,
+            "transitions": self.transitions,
+            "chaos": list(chaos or []),
+            "samples": sloz.get("samples", 0),
+            "windows": {
+                "fast_s": self.engine.fast_window_s,
+                "slow_s": self.engine.slow_window_s,
+            },
+        }
+        if server_sloz is not None:
+            report["server_sloz"] = server_sloz
+        if statz is not None:
+            eng = (statz or {}).get("engine", {}) or {}
+            report["server"] = {
+                "requests_completed": eng.get("requests_completed"),
+                "active_slots": eng.get("active_slots"),
+                "queued": eng.get("queued"),
+            }
+        report["compact"] = compact_row(report)
+        return report
+
+
+def compact_row(report: dict) -> dict:
+    """The bench-line vocabulary: ``lg_*`` headline keys (dormant
+    benchgate rows until a baseline records them)."""
+    out = {
+        "scenario": report.get("scenario"),
+        "lg_verdict": report.get("verdict"),
+        "lg_offered_rps": report.get("offered_rps"),
+        "lg_achieved_rps": report.get("achieved_rps"),
+        "lg_goodput_rps": report.get("goodput_rps"),
+        "lg_err_rate": report.get("error_rate"),
+        "lg_achieved_x_offered": report.get("achieved_x_offered"),
+        "lg_p50_ttft_ms": report.get("p50_ttft_ms"),
+        "lg_p99_ttft_ms": report.get("p99_ttft_ms"),
+    }
+    return {k: v for k, v in out.items() if v is not None}

@@ -1,0 +1,167 @@
+"""Trace-shaped request synthesis: the scenario mix -> wire bodies.
+
+Each arrival draws a mix entry (seeded, weight-proportional) and
+renders one or more ``/v1/completions`` bodies. Kinds model the
+workload classes a production fleet actually serves, with the
+properties that stress different parts of the stack:
+
+  * ``chat`` — multi-turn sessions with a SHARED system prompt: every
+    session's prompt starts with the same token prefix and grows by
+    one turn per arrival (prefix-cache locality + growing prefills).
+    Sessions rotate round-robin; after ``turns`` turns a session
+    retires and a fresh one starts.
+  * ``rag`` — retrieval-augmented single shots: long prompt
+    (``prompt_tokens``), short answer — the prefill-bound shape.
+  * ``json_agent`` — agent-loop steps with
+    ``response_format: json_object`` (constrained decoding's FSM mask
+    on the hot path); ``constrained: false`` drops the format field
+    for targets without a tokenizer while keeping the length shape.
+  * ``tool_burst`` — one logical agent step fanning out into
+    ``burst`` near-simultaneous calls (one arrival -> N requests),
+    the thundering-herd shape tool dispatch produces.
+  * ``batch_backfill`` — ``tier: batch`` bodies riding the offline
+    admission queue underneath live traffic.
+
+Prompts are token lists (byte-range ints), so the generator needs no
+tokenizer and the bodies run against any engine server. Everything is
+driven by one ``random.Random(seed)``: same scenario + same seed =
+the same request trace, byte for byte.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Dict, List, Optional
+
+from shifu_tpu.loadgen.scenario import MixEntry, Scenario
+
+# Token alphabet for synthesized prompts: printable-byte range, safely
+# inside every engine's vocab (byte tokenizers use 256+specials).
+_TOK_LO, _TOK_HI = 32, 126
+
+
+class Request:
+    """One wire request: the body plus the labels the scorer needs."""
+
+    __slots__ = ("kind", "tier", "body", "session")
+
+    def __init__(self, kind: str, tier: str, body: dict,
+                 session: int = 0):
+        self.kind = kind
+        self.tier = tier
+        self.body = body
+        self.session = session
+
+
+class _ChatSession:
+    __slots__ = ("sid", "history", "turns_done")
+
+    def __init__(self, sid: int, system: List[int]):
+        self.sid = sid
+        self.history = list(system)
+        self.turns_done = 0
+
+
+class WorkloadModel:
+    """Seeded request factory for one scenario. ``next_requests()``
+    renders one arrival's request batch (len 1 except tool bursts)."""
+
+    def __init__(self, scenario: Scenario, seed: Optional[int] = None):
+        self.scenario = scenario
+        self.rng = random.Random(
+            scenario.seed if seed is None else seed
+        )
+        self._weights = [m.weight for m in scenario.mix]
+        # Chat state: one shared system prompt per run (THE point of
+        # the kind — every session's prefill opens identically), a
+        # small pool of live sessions advanced round-robin.
+        self._system: Dict[int, List[int]] = {}
+        self._sessions: Dict[int, List[_ChatSession]] = {}
+        self._rr: Dict[int, int] = {}
+        self._next_sid = 0
+
+    # ------------------------------------------------------ drawing
+    def next_requests(self) -> List[Request]:
+        entry = self.rng.choices(
+            self.scenario.mix, weights=self._weights, k=1
+        )[0]
+        fn = getattr(self, "_make_" + entry.kind)
+        return fn(entry)
+
+    def _tokens(self, n: int) -> List[int]:
+        return [
+            self.rng.randrange(_TOK_LO, _TOK_HI) for _ in range(max(n, 1))
+        ]
+
+    @staticmethod
+    def _p(entry: MixEntry, key: str, default):
+        return type(default)(entry.params.get(key, default))
+
+    # -------------------------------------------------------- kinds
+    def _make_chat(self, entry: MixEntry) -> List[Request]:
+        eid = id(entry)
+        sys_tok = self._p(entry, "system_tokens", 32)
+        if eid not in self._system:
+            self._system[eid] = self._tokens(sys_tok)
+            self._sessions[eid] = []
+            self._rr[eid] = 0
+        max_turns = self._p(entry, "turns", 3)
+        sessions = self._p(entry, "sessions", 4)
+        live = [
+            s for s in self._sessions[eid] if s.turns_done < max_turns
+        ]
+        if len(live) < sessions:
+            s = _ChatSession(self._next_sid, self._system[eid])
+            self._next_sid += 1
+            live.append(s)
+        self._sessions[eid] = live
+        self._rr[eid] += 1
+        s = live[self._rr[eid] % len(live)]
+        s.history.extend(self._tokens(self._p(entry, "turn_tokens", 16)))
+        s.turns_done += 1
+        body = {
+            "tokens": list(s.history),
+            "max_new_tokens": self._p(entry, "max_new_tokens", 16),
+            "tier": entry.tier,
+        }
+        return [Request("chat", entry.tier, body, session=s.sid)]
+
+    def _make_rag(self, entry: MixEntry) -> List[Request]:
+        body = {
+            "tokens": self._tokens(self._p(entry, "prompt_tokens", 256)),
+            "max_new_tokens": self._p(entry, "max_new_tokens", 8),
+            "tier": entry.tier,
+        }
+        return [Request("rag", entry.tier, body)]
+
+    def _make_json_agent(self, entry: MixEntry) -> List[Request]:
+        body = {
+            "tokens": self._tokens(self._p(entry, "prompt_tokens", 48)),
+            "max_new_tokens": self._p(entry, "max_new_tokens", 32),
+            "tier": entry.tier,
+        }
+        if entry.params.get("constrained", True):
+            body["response_format"] = {"type": "json_object"}
+        return [Request("json_agent", entry.tier, body)]
+
+    def _make_tool_burst(self, entry: MixEntry) -> List[Request]:
+        burst = max(self._p(entry, "burst", 2), 1)
+        out = []
+        for _ in range(burst):
+            body = {
+                "tokens": self._tokens(
+                    self._p(entry, "prompt_tokens", 32)
+                ),
+                "max_new_tokens": self._p(entry, "max_new_tokens", 8),
+                "tier": entry.tier,
+            }
+            out.append(Request("tool_burst", entry.tier, body))
+        return out
+
+    def _make_batch_backfill(self, entry: MixEntry) -> List[Request]:
+        body = {
+            "tokens": self._tokens(self._p(entry, "prompt_tokens", 64)),
+            "max_new_tokens": self._p(entry, "max_new_tokens", 32),
+            "tier": entry.tier,
+        }
+        return [Request("batch_backfill", entry.tier, body)]

@@ -142,6 +142,19 @@ METRIC_SPECS: Dict[str, Tuple[str, float]] = {
     # creeping up means handoff cost leaked into steady-state decode.
     "disagg_x_coloc_ttft": (LOWER, 0.50),
     "disagg_x_coloc_itl": (LOWER, 0.35),
+    # loadgen measurement harness (round 17): the headline of a scored
+    # scenario run (shifu_tpu loadgen / bench_loadgen) — goodput and
+    # achieved-vs-offered are the capacity claims, p99 TTFT and error
+    # rate the SLO ones. Armable — dormant until a baseline round
+    # records a run (missing keys skip with a machine-readable
+    # reason); lg_err_rate additionally stays dormant while the
+    # recorded baseline is 0 (check_bench skips zero baselines), so
+    # goodput + achieved_x_offered are the live guards against the
+    # serving path losing capacity under the standing mix.
+    "lg_goodput_rps": (HIGHER, 0.25),
+    "lg_achieved_x_offered": (HIGHER, 0.15),
+    "lg_p99_ttft_ms": (LOWER, 0.50),
+    "lg_err_rate": (LOWER, 0.50),
 }
 
 # Absolute floors for landed improve-direction wins (round 6): relative

@@ -6,6 +6,11 @@ watchdog reasons, load, cache occupancy) below. Deliberately
 curses-free: the frame is a pure function of the two JSON documents
 (``render_top``), so the chaos tests and a human terminal consume the
 exact same rendering, and ``--once`` mode pipes cleanly into files.
+
+``--loadgen REPORT.json`` adds the measurement block: the verdict,
+offered-vs-achieved load, goodput and per-tier client percentiles
+from a ``shifu_tpu loadgen --report`` file (re-read every frame, so
+a watcher sees the latest finished run next to the live fleet).
 """
 
 from __future__ import annotations
@@ -33,9 +38,11 @@ def _row(cols, widths) -> str:
     ).rstrip()
 
 
-def render_top(statz: dict, sloz: Optional[dict] = None) -> str:
-    """The dashboard frame for one poll of /statz (+ optional /sloz).
-    Pure: no I/O, no clock — testable against canned documents."""
+def render_top(statz: dict, sloz: Optional[dict] = None,
+               loadgen: Optional[dict] = None) -> str:
+    """The dashboard frame for one poll of /statz (+ optional /sloz,
+    + an optional loadgen verdict report). Pure: no I/O, no clock —
+    testable against canned documents."""
     lines = []
     eng = statz.get("engine", {}) or {}
     lat = statz.get("latency", {}) or {}
@@ -107,6 +114,42 @@ def render_top(statz: dict, sloz: Optional[dict] = None) -> str:
                     f"{pc.get('pages_total', 0)} pages"
                     f"  hit-rate {_fmt(pc.get('hit_rate'), 3)}"
                 )
+
+    if loadgen:
+        lines.append("")
+        lines.append(
+            f"loadgen: {loadgen.get('scenario', '-')}"
+            f"  verdict {loadgen.get('verdict', '-')}"
+            f"  offered {_fmt(loadgen.get('offered_rps'))} rps"
+            f"  achieved {_fmt(loadgen.get('achieved_rps'))}"
+            f"  goodput {_fmt(loadgen.get('goodput_rps'))}"
+            f"  err {_fmt(loadgen.get('error_rate'), 4)}"
+        )
+        lg_tiers = loadgen.get("tiers") or {}
+        if lg_tiers:
+            widths = (12, 9, 9, 11, 11, 8)
+            lines.append(_row(
+                ("LG-TIER", "STATUS", "HEADROOM", "P50-TTFT",
+                 "P99-TTFT", "REQS"), widths,
+            ))
+            for tier in sorted(lg_tiers):
+                d = lg_tiers[tier]
+                c = d.get("client", {}) or {}
+                lines.append(_row((
+                    tier,
+                    d.get("status", "-"),
+                    _fmt(d.get("headroom"), 2),
+                    _fmt(c.get("p50_ttft_ms")),
+                    _fmt(c.get("p99_ttft_ms")),
+                    c.get("requests", 0),
+                ), widths))
+        chaos = loadgen.get("chaos") or ()
+        for ev in chaos:
+            lines.append(
+                f"    chaos @{_fmt(ev.get('at_s'))}s "
+                f"{ev.get('action', '-')} {ev.get('target') or ''} "
+                f"-> {ev.get('outcome', '-')}"
+            )
     return "\n".join(lines) + "\n"
 
 
@@ -117,9 +160,11 @@ def _fetch(url: str, timeout_s: float) -> dict:
 
 def run_top(url: str, *, interval_s: float = 2.0,
             iterations: Optional[int] = None, out=None,
-            timeout_s: float = 10.0) -> int:
+            timeout_s: float = 10.0,
+            loadgen_path: Optional[str] = None) -> int:
     """Poll-and-render loop (``iterations=None`` = until ^C; ``1`` is
-    the ``--once`` mode). Returns a CLI exit code."""
+    the ``--once`` mode). ``loadgen_path`` names a loadgen verdict
+    report re-read each frame. Returns a CLI exit code."""
     out = out if out is not None else sys.stdout
     base = url.rstrip("/")
     n = 0
@@ -133,7 +178,14 @@ def run_top(url: str, *, interval_s: float = 2.0,
             sloz = _fetch(base + "/sloz", timeout_s)
         except (OSError, ValueError):
             sloz = None  # pre-/sloz server: dashboard still works
-        frame = render_top(statz, sloz)
+        lg = None
+        if loadgen_path:
+            try:
+                with open(loadgen_path, encoding="utf-8") as f:
+                    lg = json.load(f)
+            except (OSError, ValueError):
+                lg = None  # report not written yet: block stays off
+        frame = render_top(statz, sloz, loadgen=lg)
         if iterations != 1:
             out.write(_CLEAR)
         out.write(frame)

@@ -19,19 +19,12 @@ the server advertises), ``FLEET_BACKEND_KV_HOST_BYTES`` (nonzero
 enables the prefix cache + host KV tier, the /kv/pages handoff
 surface — the disagg tests set it on both hosts).
 
-CHAOS HOOKS (the ``chaos`` pytest marker's fault injectors — each
-makes one failure path deterministic instead of waiting for the
-network to misbehave):
-
-  * ``FLEET_BACKEND_FAULT_DROP_NTH=N`` — the Nth ``/v1/completions``
-    request has its connection severed before any response bytes
-    (exercises the router's failed-before-first-delta resubmission).
-  * ``FLEET_BACKEND_FAULT_SLOW_PROBE=S`` — every ``/healthz`` answer
-    is delayed S seconds (exercises probe timeouts and the prober's
-    failure backoff).
-  * ``FLEET_BACKEND_FAULT_RELOAD_FAIL=1`` — every ``POST /reloadz``
-    503s without touching the weights (exercises the rollout
-    controller's halt-and-resume-on-old-weights path).
+CHAOS HOOKS: the ``FLEET_BACKEND_FAULT_*`` env vars select the
+first-class fault injectors in :mod:`shifu_tpu.fleet.chaos`
+(``faults_from_env`` + ``install_fault_hooks`` — drop-nth, slow
+probes, reload failures, kill-after-N schedules). The loadgen chaos
+track drives the same module; see its docstring for the per-hook
+semantics.
 
 Not collected by pytest (leading underscore).
 """
@@ -52,58 +45,8 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 
 
-def _install_faults(server) -> None:
-    """Wrap the server's handler class with the env-selected chaos
-    hooks (subclass + swap — make_server's handler stays untouched)."""
-    drop_nth = int(os.environ.get("FLEET_BACKEND_FAULT_DROP_NTH", "0"))
-    slow_probe = float(
-        os.environ.get("FLEET_BACKEND_FAULT_SLOW_PROBE", "0")
-    )
-    reload_fail = bool(
-        int(os.environ.get("FLEET_BACKEND_FAULT_RELOAD_FAIL", "0"))
-    )
-    if not (drop_nth or slow_probe or reload_fail):
-        return
-    import itertools
-    import socket
-    import time
-
-    base = server.RequestHandlerClass
-    counter = itertools.count(1)
-
-    class FaultyHandler(base):
-        def _handle_completions(self, chat):
-            if drop_nth and next(counter) == drop_nth:
-                # Sever before any response bytes: the client (the
-                # fleet router) sees a clean transport failure with
-                # the request still invisible to ITS caller, so it
-                # must resubmit.
-                try:
-                    self.connection.shutdown(socket.SHUT_RDWR)
-                except OSError:
-                    pass
-                self.close_connection = True
-                return
-            return super()._handle_completions(chat)
-
-        def do_GET(self):
-            if slow_probe and self.path == "/healthz":
-                time.sleep(slow_probe)
-            return super().do_GET()
-
-        def _handle_reload(self):
-            if reload_fail:
-                self._send(503, {
-                    "error": "injected reload failure (chaos hook)",
-                    "reloaded": False,
-                })
-                return
-            return super()._handle_reload()
-
-    server.RequestHandlerClass = FaultyHandler
-
-
 def main() -> int:
+    from shifu_tpu.fleet.chaos import faults_from_env, install_fault_hooks
     from shifu_tpu.infer import PagedEngine, SampleConfig, make_server
     from shifu_tpu.models import Transformer, TransformerConfig
 
@@ -151,7 +94,7 @@ def main() -> int:
         engine.step_fold = slow_fold
     server = make_server(engine, port=0, model_id=model_id,
                          ckpt_path=ckpt, role=role)
-    _install_faults(server)
+    install_fault_hooks(server, faults_from_env())
     print(json.dumps({"port": server.server_port}), flush=True)
     try:
         server.serve_forever()

@@ -1,0 +1,755 @@
+"""Loadgen measurement-harness units: arrivals, workload synthesis,
+scenario validation, verdict math, the chaos track, and the runner —
+all seeded + fake-clocked, zero sleeps, zero sockets.
+
+The two-process chaos walk (real backends, real SIGKILL) lives in
+tests/test_loadgen_fleet.py; this file pins the deterministic core:
+
+  * inter-arrival distributions + offered-load accounting are pure
+    functions of (rate, process, seed);
+  * the workload model renders the same request trace for the same
+    seed, with each kind's defining shape (shared chat system prefix,
+    long RAG prefills, json_object response_format, tool-burst
+    fan-out, batch-tier bodies);
+  * scenario parsing collects EVERY problem and ``loadgen --check``
+    exits 0/1 on it (the tier-1 gate, same pattern as ``tune
+    --check``);
+  * verdict scoring reproduces hand-computed /sloz burn windows
+    (burning at +10s, breached only once the slow window has full
+    coverage at +20s);
+  * the chaos track runs its schedule on a fake clock with injected
+    executors, and errors in one event never kill the track;
+  * the whole LoadRunner drives a canned transport end to end,
+    including the shed-at-cap path.
+"""
+
+import json
+import math
+import os
+import statistics
+
+import pytest
+
+from shifu_tpu.fleet.chaos import (
+    ChaosEvent,
+    ChaosTrack,
+    FaultSpec,
+    faults_from_env,
+    parse_chaos_events,
+)
+from shifu_tpu.loadgen import (
+    BUILTIN_SCENARIOS,
+    ClientStats,
+    LoadRunner,
+    ScenarioError,
+    VerdictScorer,
+    WorkloadModel,
+    arrival_times,
+    check_scenario,
+    compact_row,
+    intervals,
+    load_scenario,
+    offered_load,
+    parse_scenario,
+    pool_samples,
+)
+from shifu_tpu.obs import FlightRecorder, MetricsRegistry, parse_exposition
+from shifu_tpu.obs.slo import (
+    STATUS_BREACHED,
+    STATUS_BURNING,
+    STATUS_OK,
+    TierBudget,
+)
+from shifu_tpu.obs.top import render_top
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def sleep(self, dt):
+        self.t += max(float(dt), 0.0)
+
+
+# ------------------------------------------------------------ arrivals
+
+
+def test_constant_arrivals_are_a_metronome():
+    times = arrival_times(4.0, "constant", 2.0, seed=123)
+    assert times == pytest.approx([i * 0.25 for i in range(8)])
+    assert offered_load(times, 2.0) == pytest.approx(4.0)
+
+
+def test_constant_rate_times_duration_requests():
+    for rate, dur in ((1.0, 5.0), (10.0, 3.0), (7.0, 2.0)):
+        times = arrival_times(rate, "constant", dur)
+        assert len(times) == int(rate * dur)
+        assert all(0.0 <= t < dur for t in times)
+
+
+def test_poisson_is_seed_deterministic():
+    a = arrival_times(8.0, "poisson", 10.0, seed=42)
+    b = arrival_times(8.0, "poisson", 10.0, seed=42)
+    c = arrival_times(8.0, "poisson", 10.0, seed=43)
+    assert a == b
+    assert a != c
+    assert all(t > 0.0 for t in a)  # no arrival AT zero
+    assert all(t < 10.0 for t in a)
+    assert a == sorted(a)
+
+
+def test_poisson_mean_interarrival_matches_rate():
+    rate = 20.0
+    gen = intervals(rate, "poisson", seed=7)
+    gaps = [next(gen) for _ in range(20000)]
+    assert statistics.mean(gaps) == pytest.approx(1.0 / rate, rel=0.05)
+    # Exponential: stdev == mean (the memoryless signature a
+    # constant process fails immediately).
+    assert statistics.stdev(gaps) == pytest.approx(1.0 / rate, rel=0.05)
+
+
+def test_arrival_rejects_bad_args():
+    with pytest.raises(ValueError):
+        next(intervals(0.0, "constant"))
+    with pytest.raises(ValueError):
+        next(intervals(1.0, "lognormal"))
+    with pytest.raises(ValueError):
+        arrival_times(1.0, "constant", 0.0)
+    assert offered_load([], 0.0) == 0.0
+
+
+# ------------------------------------------------------------ workload
+
+
+def _scenario(doc_overrides=None, mix=None):
+    doc = {
+        "name": "t",
+        "seed": 5,
+        "duration_s": 10.0,
+        "rate_rps": 4.0,
+        "arrival": "constant",
+        "tiers": ["interactive:ttft=250,err=0.01",
+                  "batch:ttft=5000,err=0.05"],
+        "mix": mix or [{"kind": "chat", "weight": 1}],
+    }
+    doc.update(doc_overrides or {})
+    return parse_scenario(doc)
+
+
+def test_chat_sessions_share_system_prefix_and_grow():
+    sc = _scenario(mix=[{
+        "kind": "chat", "weight": 1, "system_tokens": 8,
+        "turn_tokens": 4, "turns": 3, "sessions": 2,
+    }])
+    model = WorkloadModel(sc)
+    reqs = [model.next_requests()[0] for _ in range(12)]
+    system = reqs[0].body["tokens"][:8]
+    by_session = {}
+    for r in reqs:
+        assert r.kind == "chat" and r.tier == "interactive"
+        # THE chat property: every session's prefill opens with the
+        # one shared system prompt (prefix-cache locality).
+        assert r.body["tokens"][:8] == system
+        by_session.setdefault(r.session, []).append(r)
+    assert len(by_session) >= 2  # the pool rotates, sessions retire
+    for rows in by_session.values():
+        lens = [len(r.body["tokens"]) for r in rows]
+        assert lens == sorted(lens)          # history only grows
+        assert len(rows) <= 3                # retired after `turns`
+        for a, b in zip(rows, rows[1:]):
+            # Each turn extends the previous history in place.
+            assert b.body["tokens"][:len(a.body["tokens"])] == \
+                a.body["tokens"]
+
+
+def test_workload_kind_shapes():
+    sc = _scenario(mix=[
+        {"kind": "rag", "weight": 1, "prompt_tokens": 64,
+         "max_new_tokens": 4},
+    ])
+    (r,) = WorkloadModel(sc).next_requests()
+    assert r.kind == "rag"
+    assert len(r.body["tokens"]) == 64
+    assert r.body["max_new_tokens"] == 4
+
+    sc = _scenario(mix=[{"kind": "json_agent", "weight": 1}])
+    (r,) = WorkloadModel(sc).next_requests()
+    assert r.body["response_format"] == {"type": "json_object"}
+    sc = _scenario(mix=[
+        {"kind": "json_agent", "weight": 1, "constrained": False},
+    ])
+    (r,) = WorkloadModel(sc).next_requests()
+    assert "response_format" not in r.body
+
+    sc = _scenario(mix=[{"kind": "tool_burst", "weight": 1, "burst": 3}])
+    burst = WorkloadModel(sc).next_requests()
+    assert len(burst) == 3
+    assert all(r.kind == "tool_burst" for r in burst)
+
+    sc = _scenario(mix=[{"kind": "batch_backfill", "weight": 1}])
+    (r,) = WorkloadModel(sc).next_requests()
+    assert r.tier == "batch"
+    assert r.body["tier"] == "batch"
+
+
+def test_workload_trace_is_seed_deterministic():
+    sc = BUILTIN_SCENARIOS["mixed_peak"]
+    a = WorkloadModel(parse_scenario(sc))
+    b = WorkloadModel(parse_scenario(sc))
+    for _ in range(50):
+        ra, rb = a.next_requests(), b.next_requests()
+        assert [r.body for r in ra] == [r.body for r in rb]
+        assert [r.kind for r in ra] == [r.kind for r in rb]
+    # A different seed produces a different trace.
+    c = WorkloadModel(parse_scenario(sc), seed=999)
+    d = WorkloadModel(parse_scenario(sc))
+    trace_c = [r.body for _ in range(20) for r in c.next_requests()]
+    trace_d = [r.body for _ in range(20) for r in d.next_requests()]
+    assert trace_c != trace_d
+
+
+# ------------------------------------------------------------ scenario
+
+
+def test_parse_scenario_collects_every_problem():
+    with pytest.raises(ScenarioError) as ei:
+        parse_scenario({
+            "duration_s": -1,
+            "rate_rps": 0,
+            "arrival": "warp",
+            "tiers": ["interactive:ttft=250", "nonsense"],
+            "mix": [
+                {"kind": "teleport", "weight": 1},
+                {"kind": "chat", "weight": 0},
+                {"kind": "rag", "weight": 1, "tier": "premium"},
+            ],
+            "chaos": [{"action": "nuke", "at_s": 1}],
+        })
+    text = "\n".join(ei.value.problems)
+    assert "name:" in text
+    assert "duration_s:" in text
+    assert "rate_rps:" in text
+    assert "arrival:" in text
+    assert "teleport" in text
+    assert "weight must be > 0" in text
+    assert "nonsense" in text
+    assert "premium" in text
+    assert "nuke" in text
+    assert len(ei.value.problems) >= 8
+
+
+def test_parse_scenario_chaos_must_land_inside_run():
+    with pytest.raises(ScenarioError) as ei:
+        _scenario({"chaos": [
+            {"action": "kill", "at_s": 99, "target": "h:1"},
+        ]})
+    assert any("at/after the run ends" in p for p in ei.value.problems)
+
+
+def test_builtin_scenarios_all_parse():
+    for name in BUILTIN_SCENARIOS:
+        sc = load_scenario(name)
+        assert sc.name == name
+        assert sc.mix and sc.tiers
+        ok, report = check_scenario(name)
+        assert ok and report["status"] == "ok"
+        assert report["problems"] == []
+        assert abs(sum(report["mix"].values()) - 1.0) < 0.01
+
+
+def test_check_scenario_reports_file_problems(tmp_path):
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"name": "x"}))
+    ok, report = check_scenario(str(bad))
+    assert not ok
+    assert report["status"] == "fail"
+    assert report["problems"]
+    notjson = tmp_path / "nj.json"
+    notjson.write_text("{")
+    ok, report = check_scenario(str(notjson))
+    assert not ok and "not valid JSON" in report["problems"][0]
+    ok, report = check_scenario(str(tmp_path / "missing.json"))
+    assert not ok and "cannot read" in report["problems"][0]
+
+
+def test_cli_loadgen_check_gate(tmp_path, capsys):
+    from shifu_tpu.cli import main
+
+    assert main(["loadgen", "--check", "--scenario", "smoke"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["status"] == "ok"
+    assert doc["scenario"] == "smoke"
+
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"name": "b", "mix": []}))
+    assert main(["loadgen", "--check", "--scenario", str(bad)]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["status"] == "fail" and doc["problems"]
+
+
+# ------------------------------------------------------- verdict math
+
+
+def _ttft_snapshot(le_counts, requests, errors, tier="interactive"):
+    """A pooled-sample dict shaped like a bare engine server's scrape:
+    raw shifu_request_ttft_seconds buckets + slo counters."""
+    out = {}
+    for le, count in le_counts.items():
+        out[(
+            "shifu_request_ttft_seconds_bucket",
+            frozenset({"tier": tier, "le": le}.items()),
+        )] = float(count)
+    out[(
+        "shifu_slo_requests_total", frozenset({"tier": tier}.items()),
+    )] = float(requests)
+    out[(
+        "shifu_slo_errors_total", frozenset({"tier": tier}.items()),
+    )] = float(errors)
+    return out
+
+
+def test_pool_samples_rekeys_bare_server_buckets():
+    parsed = _ttft_snapshot({"0.1": 5, "+Inf": 6}, 6, 0)
+    pooled = pool_samples(parsed)
+    agg = "shifu_fleet_agg_request_ttft_seconds_bucket"
+    assert any(n == agg for (n, _l) in pooled)
+    # Raw series stay too (harmless: the window math only reads agg).
+    assert any(
+        n == "shifu_request_ttft_seconds_bucket" for (n, _l) in pooled
+    )
+
+
+def test_pool_samples_drops_per_backend_federated_duplicates():
+    agg = "shifu_fleet_agg_request_ttft_seconds_bucket"
+    parsed = {
+        (agg, frozenset({"tier": "interactive", "le": "+Inf"}.items())):
+            10.0,
+        (agg, frozenset({
+            "tier": "interactive", "le": "+Inf",
+            "backend": "127.0.0.1:9",
+        }.items())): 10.0,
+    }
+    pooled = pool_samples(parsed)
+    assert len(pooled) == 1
+    ((_n, labels),) = pooled.keys()
+    assert "backend" not in dict(labels)
+
+
+def test_verdict_scorer_hand_computed_windows():
+    """Burn math against hand-computed bucket deltas on a fake clock:
+
+    budget interactive:ttft=100ms objective .99 err=.05; windows
+    fast=10s slow=20s. t=0: clean snapshot. t=+10s: 4/100 requests
+    over 100ms (burn 0.04/0.01 = 4.0 -> burning; slow coverage 10 <
+    20 -> NOT breached). t=+20s: fast-window delta 6/100 over (burn
+    6.0), slow-window delta 10/200 over (burn 5.0) WITH full 20s
+    coverage -> breached. Headroom = 1 - slow burn = -4.0."""
+    clock = FakeClock(1000.0)
+    scorer = VerdictScorer(
+        [TierBudget(tier="interactive", p99_ttft_ms=100.0,
+                    max_error_rate=0.05)],
+        duration_s=20.0, fast_window_s=10.0, slow_window_s=20.0,
+        clock=clock, flight=FlightRecorder(),
+    )
+    scorer.note_samples(_ttft_snapshot(
+        {"0.05": 0, "0.1": 0, "+Inf": 0}, requests=0, errors=0,
+    ))
+    doc = scorer.evaluate()
+    assert doc["tiers"]["interactive"]["status"] == STATUS_OK
+
+    clock.t = 1010.0
+    scorer.note_samples(_ttft_snapshot(
+        {"0.05": 96, "0.1": 96, "+Inf": 100}, requests=100, errors=2,
+    ))
+    doc = scorer.evaluate()
+    tier = doc["tiers"]["interactive"]
+    assert tier["status"] == STATUS_BURNING
+    fast = tier["windows"]["fast"]
+    assert fast["burn_rate"] == pytest.approx(4.0)
+    assert fast["budgets"]["ttft"]["bad"] == pytest.approx(4.0)
+    assert fast["budgets"]["ttft"]["total"] == pytest.approx(100.0)
+    assert fast["budgets"]["error_rate"]["burn_rate"] == \
+        pytest.approx(0.4)
+    assert tier["windows"]["slow"]["coverage_s"] == pytest.approx(10.0)
+    # The ok -> burning edge fired the transition hook exactly once.
+    assert len(scorer.transitions) == 1
+    assert scorer.transitions[0]["tier"] == "interactive"
+    assert scorer.transitions[0]["status"] == STATUS_BURNING
+
+    clock.t = 1020.0
+    scorer.note_samples(_ttft_snapshot(
+        {"0.05": 190, "0.1": 190, "+Inf": 200}, requests=200, errors=4,
+    ))
+    stats = ClientStats()
+    for i in range(10):
+        stats.note(kind="rag", tier="interactive",
+                   status=200 if i < 9 else 503,
+                   ttft_ms=50.0 + i, latency_ms=80.0 + i,
+                   tokens=4, error=None if i < 9 else "http_503")
+    report = scorer.score(
+        scenario_name="hand", duration_s=20.0, offered_rps=0.5,
+        offered_requests=10, client=stats,
+    )
+    tier = report["tiers"]["interactive"]
+    assert report["verdict"] == STATUS_BREACHED
+    assert tier["status"] == STATUS_BREACHED
+    assert tier["windows"]["fast"]["burn_rate"] == pytest.approx(6.0)
+    assert tier["windows"]["slow"]["burn_rate"] == pytest.approx(5.0)
+    assert tier["windows"]["slow"]["coverage_s"] == pytest.approx(20.0)
+    assert tier["headroom"] == pytest.approx(-4.0)
+    # Client-side truth rides next to the server-side burn.
+    assert tier["client"]["requests"] == 10
+    assert tier["client"]["errors"] == 1
+    assert tier["client"]["goodput_rps"] == pytest.approx(0.45)
+    assert report["achieved_x_offered"] == pytest.approx(1.0)
+    # breached stays a single transition (edge-triggered, not level).
+    assert len(scorer.transitions) == 1
+    row = report["compact"]
+    assert row["lg_verdict"] == STATUS_BREACHED
+    assert row["lg_err_rate"] == pytest.approx(0.1)
+    assert compact_row(report) == row
+
+
+def test_client_stats_tier_doc_percentiles():
+    stats = ClientStats()
+    for i in range(100):
+        stats.note(kind="chat", tier="interactive", status=200,
+                   ttft_ms=float(i + 1), latency_ms=float(2 * (i + 1)),
+                   tokens=3)
+    doc = stats.tier_doc("interactive", duration_s=10.0)
+    assert doc["requests"] == 100 and doc["errors"] == 0
+    assert doc["achieved_rps"] == pytest.approx(10.0)
+    assert doc["p50_ttft_ms"] == pytest.approx(51.0)
+    assert doc["p99_ttft_ms"] == pytest.approx(100.0)
+    assert doc["tokens_out"] == 300
+    assert stats.tier_doc("batch", 10.0)["requests"] == 0
+
+
+# ---------------------------------------------------------- chaos track
+
+
+def test_faults_from_env_contract():
+    spec = faults_from_env({})
+    assert spec == FaultSpec() and not spec.active()
+    spec = faults_from_env({
+        "FLEET_BACKEND_FAULT_DROP_NTH": "3",
+        "FLEET_BACKEND_FAULT_SLOW_PROBE": "1.5",
+        "FLEET_BACKEND_FAULT_RELOAD_FAIL": "1",
+        "FLEET_BACKEND_FAULT_KILL_AFTER": "7",
+    })
+    assert spec == FaultSpec(drop_nth=3, slow_probe_s=1.5,
+                             reload_fail=True, kill_after=7)
+    assert spec.active()
+
+
+def test_parse_chaos_events_collects_problems():
+    with pytest.raises(ValueError) as ei:
+        parse_chaos_events([
+            {"action": "nuke", "at_s": 1},
+            {"action": "kill", "at_s": -2, "target": "h:1"},
+            {"action": "drain", "at_s": 1},          # no target
+            {"action": "rollout", "at_s": 1},        # no ckpt
+            "not-an-object",
+        ])
+    msg = str(ei.value)
+    for frag in ("nuke", "at_s", "requires a target", "requires a ckpt",
+                 "not an object"):
+        assert frag in msg
+    # Valid events come back time-sorted regardless of input order.
+    evs = parse_chaos_events([
+        {"action": "resume", "at_s": 9, "target": "h:1"},
+        {"action": "kill", "at_s": 2, "target": "h:1", "pid": 4},
+    ])
+    assert [e.action for e in evs] == ["kill", "resume"]
+    assert evs[0].args == {"pid": 4}
+
+
+def test_chaos_track_runs_schedule_on_fake_clock():
+    clock = FakeClock()
+    reg = MetricsRegistry()
+    calls = []
+
+    def good(ev):
+        calls.append((ev.action, ev.target, clock()))
+
+    def bad(ev):
+        raise ValueError("backend exploded")
+
+    track = ChaosTrack(
+        parse_chaos_events([
+            {"action": "kill", "at_s": 1.0, "target": "h:1", "pid": 1},
+            {"action": "drain", "at_s": 2.5, "target": "h:2"},
+            {"action": "resume", "at_s": 4.0, "target": "h:2"},
+        ]),
+        clock=clock, sleep=clock.sleep,
+        actions={"kill": good, "drain": bad, "resume": good},
+        metrics=reg, flight=FlightRecorder(),
+    )
+    track.run_events(t0=0.0)
+    assert [(c[0], c[2]) for c in calls] == [
+        ("kill", 1.0), ("resume", 4.0),
+    ]
+    assert [(e["action"], e["outcome"], e["t_s"])
+            for e in track.executed] == [
+        ("kill", "ok", 1.0),
+        ("drain", "error:ValueError", 2.5),
+        ("resume", "ok", 4.0),
+    ]
+    parsed = parse_exposition(reg.render())
+    fam = "shifu_loadgen_chaos_events_total"
+    assert parsed[(fam, frozenset(
+        {"action": "kill", "outcome": "ok"}.items()))] == 1.0
+    assert parsed[(fam, frozenset(
+        {"action": "drain", "outcome": "error"}.items()))] == 1.0
+
+
+def test_chaos_track_stop_cancels_pending_events():
+    clock = FakeClock()
+    calls = []
+    track = ChaosTrack(
+        [ChaosEvent(at_s=5.0, action="kill", target="h:1",
+                    args={"pid": 1})],
+        clock=clock,
+        sleep=lambda dt: (clock.sleep(dt), track.stop()),
+        actions={"kill": lambda ev: calls.append(ev)},
+        metrics=MetricsRegistry(), flight=FlightRecorder(),
+    )
+    track.run_events(t0=0.0)
+    assert calls == [] and track.executed == []
+
+
+def test_chaos_kill_requires_a_pid():
+    track = ChaosTrack(
+        [ChaosEvent(at_s=0.0, action="kill", target="unknown:1")],
+        clock=FakeClock(), sleep=lambda dt: None,
+        metrics=MetricsRegistry(), flight=FlightRecorder(),
+    )
+    track.run_events(t0=0.0)
+    assert track.executed[0]["outcome"] == "error:ValueError"
+
+
+# ---------------------------------------------------------- the runner
+
+
+def _fake_transport(status=200, ttft_ms=7.5, tokens=(1, 2, 3),
+                    metrics_text=None, calls=None):
+    def post(url, body):
+        if calls is not None:
+            calls.append((url, body))
+        if status != 200:
+            return status, None
+        return 200, {"tokens": list(tokens),
+                     "timing": {"ttft_ms": ttft_ms}}
+
+    def get(url):
+        if url.endswith("/metrics"):
+            return metrics_text
+        return None
+
+    return post, get
+
+
+def _runner(sc, transport, **kw):
+    clock = FakeClock()
+    kw.setdefault("scrape_interval_s", 0.05)
+    return LoadRunner(
+        sc, "http://fleet.test",
+        clock=clock, sleep=clock.sleep,
+        metrics=MetricsRegistry(), flight=FlightRecorder(),
+        transport=transport, **kw,
+    )
+
+
+def test_runner_end_to_end_against_fake_transport():
+    sc = _scenario(
+        {"duration_s": 2.0, "rate_rps": 5.0},
+        mix=[
+            {"kind": "chat", "weight": 2, "max_new_tokens": 2},
+            {"kind": "tool_burst", "weight": 1, "burst": 3},
+            {"kind": "batch_backfill", "weight": 1},
+        ],
+    )
+    calls = []
+    runner = _runner(sc, _fake_transport(calls=calls))
+    report = runner.run()
+    # 10 arrivals; tool bursts fan one arrival into 3 requests.
+    assert report["offered_requests"] >= 10
+    assert report["offered_requests"] == len(runner.stats.rows)
+    assert all(r["status"] == 200 for r in runner.stats.rows)
+    assert len(calls) == report["offered_requests"]
+    assert all(u == "http://fleet.test/v1/completions"
+               for u, _b in calls)
+    assert report["verdict"] == "pass"
+    assert report["error_rate"] == 0.0
+    assert report["achieved_rps"] == report["goodput_rps"]
+    assert report["achieved_x_offered"] == pytest.approx(1.0, abs=0.05)
+    assert set(report["tiers"]) == {"interactive", "batch"}
+    assert report["p50_ttft_ms"] == pytest.approx(7.5)
+    assert report["compact"]["lg_verdict"] == "pass"
+
+
+def test_runner_records_http_errors():
+    sc = _scenario({"duration_s": 1.0, "rate_rps": 4.0},
+                   mix=[{"kind": "rag", "weight": 1}])
+    runner = _runner(sc, _fake_transport(status=503))
+    report = runner.run()
+    assert report["error_rate"] == 1.0
+    assert report["goodput_rps"] == 0.0
+    assert all(r["error"] == "http_503" for r in runner.stats.rows)
+
+
+def test_runner_sheds_at_the_inflight_cap():
+    sc = _scenario({"duration_s": 1.0, "rate_rps": 6.0},
+                   mix=[{"kind": "rag", "weight": 1}])
+    runner = _runner(sc, _fake_transport(), max_inflight=0)
+    report = runner.run()
+    assert all(r["status"] == -1 for r in runner.stats.rows)
+    assert all(r["error"] == "shed_max_inflight"
+               for r in runner.stats.rows)
+    assert report["error_rate"] == 1.0
+    parsed = parse_exposition(runner.scorer.registry.render())
+    assert parsed is not None  # scorer registry renders cleanly
+
+
+def test_runner_feeds_scrapes_into_the_scorer():
+    text = (
+        "# TYPE shifu_slo_requests_total counter\n"
+        'shifu_slo_requests_total{tier="interactive"} 5\n'
+        "# TYPE shifu_slo_errors_total counter\n"
+        'shifu_slo_errors_total{tier="interactive"} 0\n'
+    )
+    sc = _scenario({"duration_s": 1.0, "rate_rps": 4.0},
+                   mix=[{"kind": "rag", "weight": 1}])
+    runner = _runner(sc, _fake_transport(metrics_text=text))
+    report = runner.run()
+    assert report["samples"] >= 1
+    assert report["verdict"] == "pass"
+    # The scrapes landed in the scorer's isolated registry.
+    names = {
+        n for (n, _l) in parse_exposition(
+            runner.scorer.registry.render()
+        )
+    }
+    assert any(n.startswith("shifu_slo_") for n in names)
+
+
+def test_runner_exports_loadgen_families():
+    sc = _scenario({"duration_s": 1.0, "rate_rps": 4.0},
+                   mix=[{"kind": "rag", "weight": 1}])
+    reg = MetricsRegistry()
+    clock = FakeClock()
+    runner = LoadRunner(
+        sc, "http://fleet.test", clock=clock, sleep=clock.sleep,
+        metrics=reg, flight=FlightRecorder(),
+        transport=_fake_transport(), scrape_interval_s=0.05,
+    )
+    runner.run()
+    parsed = parse_exposition(reg.render())
+    names = {n for (n, _l) in parsed}
+    for fam in ("shifu_loadgen_requests_total",
+                "shifu_loadgen_ttft_seconds_bucket",
+                "shifu_loadgen_request_seconds_bucket",
+                "shifu_loadgen_in_flight",
+                "shifu_loadgen_offered_rps"):
+        assert fam in names, fam
+    key = ("shifu_loadgen_requests_total", frozenset(
+        {"kind": "rag", "tier": "interactive", "code": "200"}.items()
+    ))
+    assert parsed[key] == 4.0
+    assert parsed[("shifu_loadgen_in_flight", frozenset())] == 0.0
+
+
+def test_runner_with_chaos_track_ledger_in_report():
+    sc = _scenario(
+        {"duration_s": 2.0, "rate_rps": 4.0,
+         "chaos": [{"action": "kill", "at_s": 1.0,
+                    "target": "h:1", "pid": 1}]},
+        mix=[{"kind": "rag", "weight": 1}],
+    )
+    clock = FakeClock()
+    fired = []
+    track = ChaosTrack(
+        sc.chaos, clock=clock, sleep=clock.sleep,
+        actions={"kill": lambda ev: fired.append(ev.target)},
+        metrics=MetricsRegistry(), flight=FlightRecorder(),
+    )
+    runner = LoadRunner(
+        sc, "http://fleet.test", clock=clock, sleep=clock.sleep,
+        metrics=MetricsRegistry(), flight=FlightRecorder(),
+        transport=_fake_transport(), chaos=track,
+        scrape_interval_s=0.05,
+    )
+    report = runner.run()
+    assert fired == ["h:1"]
+    assert len(report["chaos"]) == 1
+    assert report["chaos"][0]["action"] == "kill"
+    assert report["chaos"][0]["outcome"] == "ok"
+
+
+# ------------------------------------------------------------ rendering
+
+
+def test_render_top_loadgen_block():
+    lg = {
+        "scenario": "mixed_peak", "verdict": "burning",
+        "offered_rps": 16.0, "achieved_rps": 14.2,
+        "goodput_rps": 13.9, "error_rate": 0.021,
+        "tiers": {
+            "interactive": {
+                "status": "burning", "headroom": -0.5,
+                "client": {"p50_ttft_ms": 120.0, "p99_ttft_ms": 900.0,
+                           "requests": 480},
+            },
+            "batch": {
+                "status": "ok", "headroom": 0.9,
+                "client": {"p50_ttft_ms": 700.0, "p99_ttft_ms": 2100.0,
+                           "requests": 60},
+            },
+        },
+        "chaos": [{"at_s": 10.0, "action": "kill",
+                   "target": "127.0.0.1:8101", "outcome": "ok"}],
+    }
+    frame = render_top({"engine": {}}, None, loadgen=lg)
+    assert "loadgen: mixed_peak" in frame
+    assert "verdict burning" in frame
+    assert "LG-TIER" in frame
+    assert "interactive" in frame and "batch" in frame
+    assert "chaos @10.0s kill 127.0.0.1:8101 -> ok" in frame
+    # No loadgen report -> no block (the dashboard stays the same).
+    assert "loadgen:" not in render_top({"engine": {}})
+
+
+def test_run_top_rereads_loadgen_report(tmp_path, capsys):
+    import io
+
+    import shifu_tpu.obs.top as top_mod
+
+    path = tmp_path / "report.json"
+    path.write_text(json.dumps({
+        "scenario": "smoke", "verdict": "pass",
+        "offered_rps": 4.0, "achieved_rps": 4.0, "goodput_rps": 4.0,
+        "error_rate": 0.0, "tiers": {}, "chaos": [],
+    }))
+    statz = {"engine": {"active_slots": 0, "max_slots": 4}}
+
+    def fake_fetch(url, timeout_s):
+        if url.endswith("/statz"):
+            return statz
+        raise OSError("no sloz")
+
+    orig = top_mod._fetch
+    top_mod._fetch = fake_fetch
+    try:
+        buf = io.StringIO()
+        rc = top_mod.run_top(
+            "http://x", iterations=1, out=buf,
+            loadgen_path=str(path),
+        )
+    finally:
+        top_mod._fetch = orig
+    assert rc == 0
+    assert "loadgen: smoke" in buf.getvalue()
+    assert "verdict pass" in buf.getvalue()

@@ -1,0 +1,240 @@
+"""The acceptance walk: one loadgen run against a LIVE two-process
+fleet with the scheduled chaos track doing real damage mid-run.
+
+Two real engine-server processes (tests/_fleet_backend.py — tiny CPU
+model, manifest ckpt v0) behind an in-process FleetRouter that
+declares its own tight SLO + incident writer. The loadgen scenario
+replays a mixed trace (chat sessions, RAG prefills, batch backfill)
+at fixed open-loop load while the chaos track:
+
+  1. runs a full rolling weight update (v0 -> v1) through the live
+     ``/drainz`` + ``/reloadz`` surface, and
+  2. SIGKILLs the slow backend outright.
+
+The assertions are the ISSUE's acceptance bar: no request hangs
+(every ledger row is 200-or-503, the open loop never blocks), the
+verdict report is still computed from the real federated scrape, and
+the router's own burn fires EXACTLY ONE incident bundle (edge-
+triggered + rate-limited) — the loadgen scrape loop polling ``/sloz``
+is what drives the router's lazily-sampled engine, so the bundle
+lands DURING the run.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import jax
+import pytest
+
+from shifu_tpu.fleet import (
+    BackendClient,
+    BackendConfig,
+    FleetRouter,
+    RetryPolicy,
+    wait_ready,
+)
+from shifu_tpu.fleet.chaos import ChaosTrack, parse_chaos_events
+from shifu_tpu.infer import make_server
+from shifu_tpu.loadgen import LoadRunner, parse_scenario
+from shifu_tpu.obs import FlightRecorder, MetricsRegistry
+from shifu_tpu.obs.incident import IncidentWriter
+from shifu_tpu.obs.slo import SLOEngine, TierBudget
+
+pytestmark = pytest.mark.chaos
+
+_HELPER = os.path.join(os.path.dirname(__file__), "_fleet_backend.py")
+
+
+def _make_ckpt(tmp, name, seed):
+    from shifu_tpu.checkpoint import save_params_dir
+    from shifu_tpu.models import Transformer, TransformerConfig
+
+    model = Transformer(TransformerConfig.tiny())
+    params = model.init(jax.random.key(seed))
+    return save_params_dir(os.path.join(str(tmp), name), params)
+
+
+def _spawn(step_delay, ckpt):
+    env = dict(
+        os.environ,
+        PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu",
+        FLEET_BACKEND_MAX_SLOTS="2",
+        FLEET_BACKEND_STEP_DELAY=str(step_delay),
+        FLEET_BACKEND_CKPT=ckpt,
+    )
+    proc = subprocess.Popen(
+        [sys.executable, _HELPER], stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL, env=env, text=True,
+    )
+    line = proc.stdout.readline()
+    if not line:
+        proc.kill()
+        raise RuntimeError("backend died before printing its port")
+    return proc, f"127.0.0.1:{json.loads(line)['port']}"
+
+
+def _get(base, path, timeout=30):
+    with urllib.request.urlopen(base + path, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+_SCENARIO = {
+    "name": "chaos_walk",
+    "seed": 3,
+    "duration_s": 8.0,
+    "rate_rps": 5.0,
+    "arrival": "constant",
+    # ttft=50ms is unholdable on the slow backend (0.2s/step): the
+    # verdict MUST show the burn the chaos run causes.
+    "tiers": ["interactive:ttft=50,err=0.25",
+              "batch:ttft=10000,err=0.25"],
+    "mix": [
+        {"kind": "chat", "weight": 2, "turns": 2, "system_tokens": 8,
+         "turn_tokens": 3, "max_new_tokens": 2},
+        {"kind": "rag", "weight": 1, "prompt_tokens": 12,
+         "max_new_tokens": 2},
+        {"kind": "batch_backfill", "weight": 1, "prompt_tokens": 6,
+         "max_new_tokens": 2},
+    ],
+    # The chaos track itself is built in-test (it needs live pids and
+    # the ckpt path), so `chaos` stays out of the scenario doc here.
+}
+
+
+def test_chaos_walk_kill_and_rollout_under_load(tmp_path):
+    ckpt_v0 = _make_ckpt(tmp_path, "v0", seed=10)
+    ckpt_v1 = _make_ckpt(tmp_path, "v1", seed=11)
+
+    procs, server = [], None
+    try:
+        slow_proc, slow_addr = _spawn(0.2, ckpt_v0)
+        procs.append(slow_proc)
+        fast_proc, fast_addr = _spawn(0.0, ckpt_v0)
+        procs.append(fast_proc)
+
+        clients = [
+            BackendClient(a, BackendConfig(
+                connect_timeout_s=10.0, probe_timeout_s=5.0,
+                read_timeout_s=60.0, fail_threshold=3, reset_s=30.0,
+            ))
+            for a in (slow_addr, fast_addr)
+        ]
+        ready, pending = wait_ready(clients, timeout_s=90.0,
+                                    require_all=True)
+        assert not pending
+        router = FleetRouter(
+            clients, metrics=MetricsRegistry(),
+            flight=FlightRecorder(),
+            policy=RetryPolicy(base_s=0.01, cap_s=0.1, budget=16.0),
+        )
+        # The router's OWN tight SLO + incident writer: the loadgen
+        # scrape polling /sloz is what samples this engine.
+        incidents_root = str(tmp_path / "incidents")
+        slo = SLOEngine(
+            [TierBudget(tier="interactive", p99_ttft_ms=50.0)],
+            fast_window_s=300.0, slow_window_s=3600.0,
+            sample_interval_s=0.2,
+            metrics=router.metrics, flight=router.flight,
+        )
+        incident = IncidentWriter(
+            incidents_root, min_interval_s=3600.0,
+            metrics=router.metrics, flight=router.flight,
+        )
+        router.set_slo(slo, incident)
+
+        server = make_server(router, port=0)
+        threading.Thread(
+            target=server.serve_forever, daemon=True,
+        ).start()
+        base = f"http://127.0.0.1:{server.server_port}"
+
+        sc = parse_scenario(_SCENARIO)
+        reg, flight = MetricsRegistry(), FlightRecorder()
+        track = ChaosTrack(
+            parse_chaos_events([
+                {"action": "rollout", "at_s": 0.5, "ckpt": ckpt_v1,
+                 "drain_timeout_s": 60.0, "ready_timeout_s": 60.0},
+                {"action": "kill", "at_s": 5.0, "target": slow_addr},
+            ]),
+            url=base, pids={slow_addr: slow_proc.pid},
+            metrics=reg, flight=flight,
+        )
+        runner = LoadRunner(
+            sc, base,
+            request_timeout_s=60.0, scrape_interval_s=0.5,
+            metrics=reg, flight=flight, chaos=track,
+        )
+        report = runner.run()
+
+        # --- no request hangs: every ledger row is 200-or-503
+        assert report["offered_requests"] == len(runner.stats.rows)
+        statuses = {r["status"] for r in runner.stats.rows}
+        assert statuses <= {200, 503}, sorted(
+            (r["status"], r["error"]) for r in runner.stats.rows
+            if r["status"] not in (200, 503)
+        )
+        assert any(r["status"] == 200 for r in runner.stats.rows)
+
+        # --- the chaos ledger shows both acts, in order, executed
+        assert [e["action"] for e in report["chaos"]] == \
+            ["rollout", "kill"]
+        assert all(e["outcome"] == "ok" for e in report["chaos"]), \
+            report["chaos"]
+
+        # --- the verdict is computed from the real federated scrape
+        assert report["verdict"] in ("pass", "burning", "breached")
+        assert report["samples"] >= 2
+        tier = report["tiers"]["interactive"]
+        assert tier["client"]["requests"] > 0
+        # A 50ms budget against a 0.2s/step backend cannot hold.
+        assert report["verdict"] != "pass"
+        assert tier["status"] in ("burning", "breached")
+        assert report["compact"]["lg_goodput_rps"] > 0
+
+        # --- the rolled-out fleet really moved to v1: the surviving
+        # backend serves the new ckpt
+        doc = _get(f"http://{fast_addr}", "/v1/models")
+        assert doc["data"][0].get("ckpt") == ckpt_v1, doc
+
+        # --- the router's own burn captured EXACTLY ONE bundle
+        bundle = None
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            dirs = [
+                d for d in (
+                    os.listdir(incidents_root)
+                    if os.path.isdir(incidents_root) else []
+                )
+                if os.path.isfile(os.path.join(
+                    incidents_root, d, "manifest.json"
+                ))
+            ]
+            if dirs:
+                bundle = dirs
+                break
+            _get(base, "/sloz")
+            time.sleep(0.3)
+        assert bundle is not None, "no incident bundle captured"
+        for _ in range(3):
+            _get(base, "/sloz")
+            time.sleep(0.25)
+        dirs = [
+            d for d in os.listdir(incidents_root)
+            if os.path.isfile(os.path.join(
+                incidents_root, d, "manifest.json"
+            ))
+        ]
+        assert len(dirs) == 1, dirs
+    finally:
+        if server is not None:
+            server.shutdown()
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGKILL)
+            p.wait(timeout=10)
