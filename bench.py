@@ -139,6 +139,10 @@ def main(argv=None):
         except Exception as e:
             out["disagg"] = {"error": f"{type(e).__name__}: {e}"}
         try:
+            out["sticky"] = bench_sticky_routing()
+        except Exception as e:
+            out["sticky"] = {"error": f"{type(e).__name__}: {e}"}
+        try:
             out["loadgen"] = bench_loadgen()
         except Exception as e:
             out["loadgen"] = {"error": f"{type(e).__name__}: {e}"}
@@ -343,6 +347,15 @@ def _compact(out: dict) -> dict:
         # handoff leaked into steady-state decode
         ("disagg_x_coloc_ttft", g("disagg", "disagg_x_coloc_ttft")),
         ("disagg_x_coloc_itl", g("disagg", "disagg_x_coloc_itl")),
+        # sticky routing + live migration (round 18): computed-prefill
+        # ratio of a cache-oblivious fleet over the sticky one on the
+        # same chat trace (>1 = affinity saved compute), sticky p50,
+        # and the migrated-turn-vs-cold-prefill TTFT price (<1 = moving
+        # the pages beat recomputing them)
+        ("sticky_prefill_tok_saved_x",
+         g("sticky", "sticky_prefill_tok_saved_x")),
+        ("sticky_p50_ttft_ms", g("sticky", "sticky_p50_ttft_ms")),
+        ("migrate_x_cold_ttft", g("sticky", "migrate_x_cold_ttft")),
         # loadgen measurement harness (round 17): the scored smoke-mix
         # run's capacity headline — goodput, achieved-vs-offered, p99
         # TTFT and error rate under the standing scenario
@@ -884,6 +897,226 @@ def bench_disagg():
         }
     finally:
         for srv in bsrvs:
+            srv.shutdown()
+            srv.runner.shutdown()
+
+
+def bench_sticky_routing():
+    """Sticky cache-aware routing vs cache-oblivious placement on
+    identical work, plus the live-migration-vs-cold-prefill price.
+
+    Two host-tier "both" backends, twice over (fresh engines per
+    phase, so neither run inherits the other's caches). The sticky
+    phase puts them behind a FleetRouter (sticky sessions ON — the
+    default) and replays a deterministic multi-turn chat trace
+    (loadgen's ``chat_trace``), one thread per session. The control
+    phase replays the SAME trace with canonical cache-oblivious
+    placement: each session's turns round-robin across the hosts,
+    which is what an affinity-free balancer does to a session under
+    steady mixed traffic. (The control is deliberately NOT the
+    FleetRouter with stickiness off — in a quiet symmetric closed
+    loop, join-shortest-queue is accidentally sticky, because a
+    session's own completion makes its own host the least loaded;
+    real fleets never sit in that equilibrium.) The headline is
+    computed-prefill tokens — Σ(prompt - hit) from /cachez deltas —
+    oblivious over sticky (>1 = affinity saved real compute), plus
+    sticky p50 TTFT. The migration sub-leg then drains the host
+    serving session 0 mid-conversation and prices the migrated next
+    turn against a cold same-length prefill on the surviving host
+    (``migrate_x_cold_ttft`` < 1 = moving the pages beat recomputing
+    them)."""
+    import threading
+    import urllib.request
+
+    from shifu_tpu.fleet import BackendClient, FleetRouter
+    from shifu_tpu.infer import SampleConfig, make_server
+    from shifu_tpu.infer.engine import PagedEngine
+    from shifu_tpu.loadgen.workload import chat_trace
+    from shifu_tpu.models.transformer import Transformer, TransformerConfig
+    from shifu_tpu.obs import FlightRecorder, MetricsRegistry
+
+    cfg = TransformerConfig.small()
+    model = Transformer(cfg)
+    params = model.init(jax.random.key(0))
+    n_sessions, n_turns, turn_tok, max_new = 4, 4, 32, 8
+
+    trace = chat_trace(sessions=n_sessions, turns=n_turns,
+                       system_tokens=48, turn_tokens=turn_tok,
+                       max_new_tokens=max_new, seed=3)
+    by_sid: dict = {}
+    for r in trace:
+        by_sid.setdefault(r.session, []).append(r.body)
+
+    def post(port, body):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/completions",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=300) as r:
+            return json.loads(r.read())
+
+    def cz(clients):
+        """-> [(prompt_tokens, hit_tokens)] fresh from each /cachez."""
+        out = []
+        for c in clients:
+            c.refresh_cachez()
+            pc = (c.cache or {}).get("prefix_cache") or {}
+            out.append((int(pc.get("prompt_tokens", 0)),
+                        int(pc.get("hit_tokens", 0))))
+        return out
+
+    all_srvs = []
+    try:
+        def mk_backs():
+            """Fresh two-backend host-tier fleet, buckets pre-warmed
+            (disjoint token alphabet — no overlap with the trace's
+            prefixes) so neither phase's TTFTs pay compiles."""
+            backs = []
+            for _ in range(2):
+                eng = PagedEngine(
+                    model, params, max_slots=4, max_len=256, page_size=16,
+                    prefill_buckets=(32, 256), enable_prefix_cache=True,
+                    kv_host_bytes=256 << 20,
+                    sample_cfg=SampleConfig(temperature=0.0),
+                )
+                srv = make_server(eng, port=0)
+                threading.Thread(
+                    target=srv.serve_forever, daemon=True
+                ).start()
+                backs.append(srv)
+            all_srvs.extend(backs)
+            clients = [
+                BackendClient(f"127.0.0.1:{s.server_port}") for s in backs
+            ]
+            for c in clients:
+                c.probe()
+                c.models()
+                c.refresh_cachez()  # host-tier discovery, as the
+                # bootstrap prober does — gates kv_export + migration
+            for srv in backs:
+                for n in (96, 16):
+                    post(srv.server_port, {
+                        "tokens": [130 + (n + j) % 113 for j in range(n)],
+                        "max_new_tokens": 2,
+                    })
+            return backs, clients
+
+        def replay(post_fn):
+            """One thread per session, turns in order within a session
+            with think time between them (the chat shape).
+            ``post_fn(sid, turn, body)`` places one turn.
+            -> (ttfts, last response per session)."""
+            ttfts, last = [], {}
+            lock = threading.Lock()
+
+            def run(sid, bodies, delay):
+                time.sleep(delay)
+                for i, body in enumerate(bodies):
+                    if i:
+                        time.sleep(0.15)
+                    out = post_fn(sid, i, body)
+                    with lock:
+                        ttfts.append(out["timing"]["ttft_ms"])
+                        last[sid] = out
+
+            threads = [
+                threading.Thread(target=run, args=(sid, bodies, i * 0.05))
+                for i, (sid, bodies) in enumerate(sorted(by_sid.items()))
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            return ttfts, last
+
+        def computed(clients, base):
+            """Prefill tokens the fleet actually computed since
+            ``base``: Σ over hosts of Δprompt - Δhit."""
+            return sum(
+                (p1 - p0) - (h1 - h0)
+                for (p0, h0), (p1, h1) in zip(base, cz(clients))
+            )
+
+        def p50(vals):
+            vals = sorted(vals)
+            return round(vals[len(vals) // 2], 3)
+
+        # Phase 1: sticky fleet on the trace.
+        backs, s_clients = mk_backs()
+        s_router = FleetRouter(
+            s_clients, metrics=MetricsRegistry(), flight=FlightRecorder(),
+        )
+        s_fsrv = make_server(s_router, port=0)
+        threading.Thread(target=s_fsrv.serve_forever, daemon=True).start()
+        all_srvs.append(s_fsrv)
+        base = cz(s_clients)
+        s_ttfts, s_last = replay(
+            lambda sid, t, body: post(s_fsrv.server_port, body)
+        )
+        s_computed = computed(s_clients, base)
+        sc = s_router.counters()
+        assert sc.get("session_sticky", 0) > 0, (
+            "sticky bench never warm-placed a turn", sc
+        )
+
+        # Migration sub-leg on the still-warm sticky fleet: drain the
+        # host serving session 0 (detach=False keeps /kv/pages up — the
+        # migration window), then send its next turn.
+        src = s_last[0]["timing"]["backend"]
+        s_router.drain(src, detach=False)
+        nxt = dict(by_sid[0][-1])
+        nxt["tokens"] = list(nxt["tokens"]) + [
+            130 + j % 113 for j in range(turn_tok)
+        ]
+        m_out = post(s_fsrv.server_port, nxt)
+        mc = s_router.counters()
+        assert mc["migrations"] > 0, (
+            "sticky bench drain never migrated the session", mc
+        )
+        assert m_out["timing"]["backend"] != src
+        # Cold control: a FRESH same-length prompt — the surviving host
+        # is the only routable one, so this is the cold prefill the
+        # migration avoided.
+        cold = post(s_fsrv.server_port, {
+            "tokens": [131 + (j * 7) % 109 for j in range(len(nxt["tokens"]))],
+            "max_new_tokens": max_new,
+        })
+        migrate_x_cold = round(
+            m_out["timing"]["ttft_ms"] / cold["timing"]["ttft_ms"], 4
+        )
+
+        # Phase 2: cache-oblivious control (fresh engines), same trace,
+        # each session's turns round-robin across the hosts.
+        r_backs, r_clients = mk_backs()
+        base = cz(r_clients)
+        b_ttfts, _ = replay(
+            lambda sid, t, body: post(
+                r_backs[(sid + t) % len(r_backs)].server_port, body
+            )
+        )
+        b_computed = computed(r_clients, base)
+
+        return {
+            "sessions": n_sessions,
+            "turns": n_turns,
+            "sticky_prefill_tokens": s_computed,
+            "oblivious_prefill_tokens": b_computed,
+            "sticky_prefill_tok_saved_x": round(
+                b_computed / max(s_computed, 1), 4
+            ),
+            "sticky_p50_ttft_ms": p50(s_ttfts),
+            "oblivious_p50_ttft_ms": p50(b_ttfts),
+            "session_sticky": sc.get("session_sticky"),
+            "session_new": sc.get("session_new"),
+            "migrations": mc.get("migrations"),
+            "migrate_ttft_ms": round(m_out["timing"]["ttft_ms"], 3),
+            "cold_ttft_ms": round(cold["timing"]["ttft_ms"], 3),
+            "migrate_x_cold_ttft": migrate_x_cold,
+        }
+    finally:
+        for srv in all_srvs:
             srv.shutdown()
             srv.runner.shutdown()
 

@@ -1011,7 +1011,18 @@ def build_serve_engine(args, model, params, tok):
                 "a --spec engine): the host tier is keyed by "
                 "prefix-chain digests over the paged pool"
             )
+        kv_slots = getattr(args, "kv_export_slots", 64)
+        if kv_slots < 1:
+            raise ValueError(
+                f"--kv-export-slots must be >= 1, got {kv_slots}"
+            )
         kv_kw["kv_host_bytes"] = args.kv_host_bytes
+        kv_kw["kv_export_slots"] = kv_slots
+    elif getattr(args, "kv_export_slots", 64) != 64:
+        raise ValueError(
+            "--kv-export-slots sizes the /kv/pages export table, which "
+            "only exists with --kv-tier host"
+        )
 
     # Disaggregation roles (serve --role, docs/architecture.md). A
     # prefill host spills each exported request's KV chain into the
@@ -2005,6 +2016,13 @@ def main(argv=None) -> int:
                         default="4g",
                         help="host-tier byte budget (LRU beyond it); "
                              "accepts 512m/4g/… suffixes "
+                             "(--kv-tier host only)")
+        sp.add_argument("--kv-export-slots", type=int, default=64,
+                        help="live /kv/pages export records kept for "
+                             "peer pickup (rid -> page chain, FIFO "
+                             "beyond it); migration-heavy fleets size "
+                             "this up so a session's export survives "
+                             "the turn's think-time "
                              "(--kv-tier host only)")
         sp.add_argument("--role", default="both",
                         choices=["prefill", "decode", "both"],

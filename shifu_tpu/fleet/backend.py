@@ -340,6 +340,12 @@ class BackendClient:
         # phase-aware scheduling key. "both" until the host says
         # otherwise (every pre-disagg backend is colocated).
         self.role: str = "both"
+        # Last /cachez document (refreshed by the prober alongside the
+        # /healthz probe) — the sticky router's cache-pressure signal
+        # and its "can this host export/ingest KV?" gate, read off the
+        # hot path instead of a per-request scrape.
+        self.cache: Optional[dict] = None
+        self.cache_ts: Optional[float] = None
 
     # ------------------------------------------------------------- wire
     def _request(self, method: str, path: str, body,
@@ -435,10 +441,49 @@ class BackendClient:
         """GET /cachez — the backend's prefix-cache + host-KV-tier
         occupancy/hit-rate block (the per-backend scrape prefix-aware
         sticky routing reads; the router's own ``cache_stats`` renders
-        one block per backend from this)."""
-        return self._call_json(
+        one block per backend from this). Caches the document like
+        ``probe`` caches /healthz."""
+        doc = self._call_json(
             "GET", "/cachez", None, self.cfg.probe_timeout_s
         )
+        self.cache = doc
+        self.cache_ts = time.time()
+        return doc
+
+    def refresh_cachez(self) -> None:
+        """Best-effort /cachez refresh (prober tick). Failures keep the
+        last document — a missed scrape degrades the routing score to
+        slightly stale cache pressure, never to an error."""
+        try:
+            self.cachez()
+        except BackendError:
+            pass
+
+    def cache_occupancy(self) -> float:
+        """Fraction of this host's device prefix pool holding
+        registered prefix pages, from the cached /cachez doc (0.0 when
+        unknown or the cache is disabled). The sticky score reads this
+        as cache PRESSURE: a fuller pool evicts sooner, so new sessions
+        prefer emptier hosts."""
+        pc = (self.cache or {}).get("prefix_cache") or {}
+        try:
+            n = int(pc.get("n_pages") or 0)
+            reg = int(pc.get("registered_pages") or 0)
+        except (TypeError, ValueError):
+            return 0.0
+        return min(reg / n, 1.0) if n > 0 else 0.0
+
+    def cache_hit_rate(self):
+        """Lifetime prefix-cache token hit rate from the cached /cachez
+        doc (None when unknown)."""
+        pc = (self.cache or {}).get("prefix_cache") or {}
+        return pc.get("hit_rate")
+
+    def has_host_tier(self) -> bool:
+        """Does this host run the host KV tier — i.e. can it export
+        (``kv_export``/GET /kv/pages) and ingest (POST /kv/pages) page
+        chains? From the cached /cachez doc; False until scraped."""
+        return bool((self.cache or {}).get("host_tier"))
 
     def reload(self, ckpt: str,
                timeout_s: Optional[float] = None) -> dict:

@@ -189,6 +189,10 @@ class FleetProber(threading.Thread):
                 b.models()
             except BackendError:
                 pass  # healthz answered; models stay stale
+            # ...and /cachez: the sticky router scores hosts by cache
+            # pressure and gates migration on the host tier, both read
+            # from this cached doc — never a per-request scrape.
+            b.refresh_cachez()
 
     def run(self) -> None:
         while not self._stop_ev.wait(self.interval_s):
@@ -223,6 +227,7 @@ def build_fleet(
             router.probe_backend(b)
         except BackendError:
             pass  # the prober keeps retrying dead hosts
+        b.refresh_cachez()  # seed the sticky score's cache signal
     # The probes above also cached each backend's disaggregation role
     # (the /healthz + /v1/models "role" field — serve --role). Record
     # a disaggregated topology once so the flight ring says which

@@ -33,6 +33,23 @@ Mechanics:
     detaches it (``backend_draining``/``backend_detached`` flight
     events; re-attach by restarting the router with it in the roster).
 
+Sticky, cache-aware sessions (ROADMAP item 1, this round): every
+routed prompt is keyed by the SAME sha256 prefix-chain digest scheme
+the engines' prefix caches use (``infer/kvtier.chain_keys``), and a
+bounded LRU affinity table remembers which backend served each chain.
+A follow-up turn (its prompt extends the chain) routes back to that
+host — where the prefix cache makes its prefill nearly free — and the
+load score every pick uses folds in per-backend prefix-cache occupancy
+from the prober's ``/cachez`` scrape. When the sticky host is hot,
+draining (``/drainz``), or mid-rollout, the session MIGRATES: the
+router fetches the host's exported KV chain (``GET /kv/pages``, the
+PR-11 transfer) and ingests it into the new host before routing the
+turn there — gated by the same measured migrate-vs-cold-prefill
+breakeven EMAs the disaggregated path uses (unmeasured -> explore,
+loss -> counted cold prefill). ``shifu_session_*``/``shifu_migrate_*``
+families + ``kv_migrate`` spans under the caller's trace_id record
+every decision.
+
 Observability: ``shifu_fleet_*`` registry families (per-backend
 requests/retries/failures counters, breaker-state/up/in-flight gauges,
 request + probe latency histograms), ``backend_down``/``backend_up``
@@ -67,6 +84,7 @@ from shifu_tpu.infer.engine import (
     LiveRequest,
     UnknownModelError,
 )
+from shifu_tpu.infer.kvtier import chain_keys
 from shifu_tpu.infer.sampling import SampleConfig
 
 _SAMPLING_FIELDS = (
@@ -95,6 +113,15 @@ class _FleetRequest:
         self.backend: Optional[BackendClient] = None
         self.submitted = time.monotonic()
         self.first_tok_at: Optional[float] = None
+        # Sticky-session state (FleetRouter._session_route /
+        # _affinity_note): the prompt's prefix-chain keys, the table
+        # key the lookup matched (superseded on completion), whether
+        # the wire body carried kv_export, and the routing outcome —
+        # recorded once per request, first placement wins.
+        self.aff_keys: Optional[List[bytes]] = None
+        self.aff_key: Optional[bytes] = None
+        self.exported = False
+        self.session_outcome: Optional[str] = None
 
 
 class FleetRouter:
@@ -121,9 +148,26 @@ class FleetRouter:
                  step_wait_s: float = 0.02,
                  drain_poll_s: float = 0.05,
                  disagg_min_prompt: int = 64,
+                 sticky_sessions: bool = True,
+                 affinity_page: int = 32,
+                 affinity_slots: int = 2048,
+                 sticky_hot_gap: int = 4,
+                 cache_weight: float = 1.0,
                  sleep=time.sleep):
         if not backends:
             raise ValueError("need at least one fleet backend")
+        if int(affinity_page) < 1:
+            raise ValueError(
+                f"affinity_page must be >= 1, got {affinity_page}"
+            )
+        if int(affinity_slots) < 1:
+            raise ValueError(
+                f"affinity_slots must be >= 1, got {affinity_slots}"
+            )
+        if float(cache_weight) < 0.0:
+            raise ValueError(
+                f"cache_weight must be >= 0, got {cache_weight}"
+            )
         from shifu_tpu import obs as _obs
 
         self.backends = list(backends)
@@ -164,6 +208,37 @@ class FleetRouter:
         self.disagg_handoffs = 0          # handoffs that completed
         self.disagg_fallbacks = 0         # handoff failed -> colocated
         self.disagg_breakeven_losses = 0  # wire lost -> never attempted
+
+        # Sticky, cache-aware sessions. The affinity table maps the
+        # DEEPEST full-page prefix-chain digest of a served prompt (the
+        # kvtier.chain_keys scheme — ``affinity_page`` tokens per link,
+        # salted by adapter exactly like the engines' prefix caches) to
+        # the backend that served it, bounded-LRU at
+        # ``affinity_slots``. A later turn extends the chain, so its
+        # key list CONTAINS an earlier turn's deepest key — lookup
+        # walks deepest-first and follows the session with no wire
+        # session id at all. ``sticky_hot_gap`` is how much busier (in
+        # in-flight + queued requests) the sticky host may be than the
+        # best alternative before affinity yields; ``cache_weight`` is
+        # how many queued requests one FULL prefix cache counts for in
+        # the load score. ``sticky_sessions=False`` disables the whole
+        # surface (the bench's blind-routing control).
+        self.sticky_sessions = bool(sticky_sessions)
+        self.affinity_page = int(affinity_page)
+        self.affinity_slots = int(affinity_slots)
+        self.sticky_hot_gap = int(sticky_hot_gap)
+        self.cache_weight = float(cache_weight)
+        self._affinity: "collections.OrderedDict[bytes, dict]" = (
+            collections.OrderedDict()
+        )
+        self._affinity_lock = threading.Lock()
+        self.session_counts = {
+            "sticky": 0, "new": 0, "migrated": 0, "rebalanced": 0,
+        }
+        self.migrations = 0               # KV chains moved host-to-host
+        self.migrate_fallbacks = 0        # transfer failed -> cold prefill
+        self.migrate_breakeven_losses = 0  # wire lost -> cold prefill
+        self.migrate_bytes = 0            # SKVP payload bytes moved
 
         # Distributed tracing (obs/disttrace.py): the router is a hop —
         # it records router_hop/resubmit spans in its own store, keyed
@@ -251,6 +326,46 @@ class FleetRouter:
         )
         for oc in ("ok", "failed", "breakeven_loss"):
             self._c_disagg.labels(outcome=oc)
+        # shifu_session_* / shifu_migrate_* families: sticky-session
+        # placement outcomes and live KV migrations. All labels
+        # pre-seeded so scrapes show zero rows from the first request.
+        self._c_session = reg.counter(
+            "shifu_session_requests_total",
+            "Routed requests by sticky-session placement outcome: "
+            "sticky (affinity hit, served on the remembered host), new "
+            "(no affinity entry matched the prompt's prefix chain), "
+            "migrated (sticky host unavailable/hot — KV pages moved "
+            "and the turn served warm elsewhere), rebalanced (moved "
+            "hosts WITHOUT a migration — cold prefill)",
+            labelnames=("outcome",),
+        )
+        for oc in ("sticky", "new", "migrated", "rebalanced"):
+            self._c_session.labels(outcome=oc)
+        self._g_affinity = reg.gauge(
+            "shifu_session_affinity_entries",
+            "Live session->backend affinity-table entries (bounded LRU "
+            "at the router's affinity_slots)",
+        ).labels()
+        self._c_migrate = reg.counter(
+            "shifu_migrate_total",
+            "Session KV-migration attempts by outcome: ok (chain "
+            "fetched from the sticky host and ingested into the new "
+            "one), failed (either leg errored — fell back to cold "
+            "prefill), breakeven_loss (wire predicted slower than the "
+            "new host recomputing — never attempted)",
+            labelnames=("outcome",),
+        )
+        for oc in ("ok", "failed", "breakeven_loss"):
+            self._c_migrate.labels(outcome=oc)
+        self._c_migrate_bytes = reg.counter(
+            "shifu_migrate_bytes_total",
+            "SKVP payload bytes moved by completed session migrations",
+        ).labels()
+        self._h_migrate = reg.histogram(
+            "shifu_migrate_seconds",
+            "Session KV-migration wall time (fetch + ingest, one "
+            "timed unit — the breakeven EMAs' sample)",
+        ).labels()
         # shifu_rollout_* families: rolling-weight-rollout progress as
         # reported by the rollout controller via POST /rolloutz
         # (rollout_note). The controller may be a separate process —
@@ -358,17 +473,28 @@ class FleetRouter:
     def _role(b: BackendClient) -> str:
         return getattr(b, "role", "both") or "both"
 
+    def _queue_score(self, b: BackendClient) -> float:
+        """Remote queue depth with prefix-cache pressure folded in:
+        occupancy (registered/total pages off the prober's /cachez
+        scrape, 0..1) scaled by ``cache_weight`` — a FULL cache counts
+        like ``cache_weight`` queued requests, so of two otherwise-
+        equal hosts the one with cache headroom wins, while a genuine
+        load gap still dominates. Backends never scraped score 0 extra
+        (identical to the pre-sticky ordering)."""
+        return b.queue_depth() + self.cache_weight * b.cache_occupancy()
+
     def _pick(self, exclude=(),
               model: Optional[str] = None) -> Optional[BackendClient]:
         """Least-loaded routable backend: fewest router-local in-flight
-        requests, then shallowest remote queue (last probe), then
-        lowest index (deterministic). ``model`` restricts to backends
-        whose ``/v1/models`` listed that id (model-aware routing — the
-        multi-tenant tier); unknown-model rejection happens at
-        :meth:`submit`, so None here means "serving subset currently
-        unavailable" (503), not 404. Consults ``breaker.allow()`` LAST
-        and only on the winner-candidates, since allow() consumes the
-        half-open probe slot.
+        requests, then shallowest remote queue + cache pressure
+        (:meth:`_queue_score`), then lowest index (deterministic).
+        ``model`` restricts to backends whose ``/v1/models`` listed
+        that id (model-aware routing — the multi-tenant tier);
+        unknown-model rejection happens at :meth:`submit`, so None here
+        means "serving subset currently unavailable" (503), not 404.
+        Consults ``breaker.allow()`` LAST and only on the
+        winner-candidates, since allow() consumes the half-open probe
+        slot.
 
         Roles are advisory, not partitions: colocated work AVOIDS
         prefill-role hosts (they sort last — their chip belongs to
@@ -379,7 +505,7 @@ class FleetRouter:
              if b.routable() and b.addr not in exclude
              and (model is None or model in (b.model_ids or ()))),
             key=lambda b: (self._role(b) == "prefill", b.in_flight,
-                           b.queue_depth(), self.backends.index(b)),
+                           self._queue_score(b), self.backends.index(b)),
         )
         for b in order:
             if b.breaker.allow():
@@ -395,7 +521,7 @@ class FleetRouter:
              if b.routable() and b.addr not in exclude
              and self._role(b) in roles
              and (model is None or model in (b.model_ids or ()))),
-            key=lambda b: (b.in_flight, b.queue_depth(),
+            key=lambda b: (b.in_flight, self._queue_score(b),
                            self.backends.index(b)),
         )
         for b in order:
@@ -550,12 +676,19 @@ class FleetRouter:
             if self._try_disagg(req):
                 return
         attempt = 0
+        # Sticky placement decides the FIRST attempt only (and may
+        # migrate the session's KV pages before answering); retries
+        # after a failure fall back to plain least-loaded _pick — the
+        # sticky host just failed, re-pinning to it would be absurd.
+        sticky = self._session_route(req) if self.sticky_sessions else None
         while True:
             if req.cancelled:
                 self._finish(req, None, None)
                 return
             att0 = time.monotonic()
-            b = self._pick(model=req.model)
+            b, sticky = sticky, None
+            if b is None:
+                b = self._pick(model=req.model)
             if b is None:
                 self._finish(req, None, FleetUnavailable(
                     "no routable fleet backend (all down/draining)"
@@ -564,9 +697,11 @@ class FleetRouter:
                     retry_after_s=max(1.0, self.policy.cap_s),
                 ))
                 return
+            self._session_outcome(req, "new")
             self._attach(req, b)
             try:
-                err = self._run_stream(req, b)
+                err = self._run_stream(req, b,
+                                       body=self._export_body(req, b))
             finally:
                 self._detach(req, b)
             if err is None:
@@ -730,6 +865,7 @@ class FleetRouter:
                 ),
             )
         b.note_latency(total_ms)
+        self._affinity_note(req, b, final)
         self._h_request.labels(backend=b.addr).observe(total_ms / 1000.0)
         trace = {
             "ttft_ms": timing["ttft_ms"], "total_ms": timing["total_ms"],
@@ -978,6 +1114,262 @@ class FleetRouter:
         finally:
             self._detach(req, dec)
 
+    # ------------------------------ sticky sessions + live migration
+    @staticmethod
+    def _affinity_salt(body: dict) -> bytes:
+        """The chain-key salt — MUST match the engines' prefix-cache
+        salt (PagedEngine._prefix_salt): empty for the base model,
+        adapter-tagged otherwise, so a router-computed digest equals
+        the digest the backend's cache files the same tokens under."""
+        adapter = body.get("adapter")
+        return b"" if adapter is None else f"adapter:{int(adapter)}".encode()
+
+    def _session_outcome(self, req: _FleetRequest, outcome: str) -> None:
+        """Record the request's placement outcome ONCE (first routing
+        decision wins — retries after a failure don't reclassify)."""
+        if not self.sticky_sessions or req.session_outcome is not None:
+            return
+        req.session_outcome = outcome
+        with self._lock:
+            self.session_counts[outcome] += 1
+        self._c_session.labels(outcome=outcome).inc()
+
+    def _export_body(self, req: _FleetRequest,
+                     b: BackendClient) -> Optional[dict]:
+        """The kv_export rider: sticky routing asks every host-tier
+        backend to keep this request's prefill pages addressable
+        (``kv_export: true`` -> the final event's ``rid`` -> a later
+        ``GET /kv/pages`` can move the session). Returns the wire body
+        override, or None to send ``req.body`` untouched (backend has
+        no host tier, prompt too short to own a full chain page, or
+        sticky routing is off). Clients still cannot set kv_export
+        through :meth:`submit` — the router alone initiates this."""
+        req.exported = False
+        if not self.sticky_sessions or not b.has_host_tier():
+            return None
+        if len(req.body.get("tokens") or ()) < self.affinity_page:
+            return None
+        body = dict(req.body)
+        body["kv_export"] = True
+        req.exported = True
+        return body
+
+    def _affinity_lookup(self, req: _FleetRequest) -> Optional[dict]:
+        """Match the prompt's prefix chain against the affinity table,
+        DEEPEST key first (a follow-up turn's chain extends the turn
+        that created the entry — the deepest hit is the most recent
+        turn of the same session). Returns ``{"rec", "tokens"}`` (a
+        copy of the entry + how many prompt tokens its chain covers)
+        or None; stamps the computed keys + matched key on ``req`` so
+        :meth:`_affinity_note` reuses them."""
+        toks = req.body.get("tokens") or ()
+        ps = self.affinity_page
+        if len(toks) < ps:
+            return None
+        keys = chain_keys(toks, ps, self._affinity_salt(req.body))
+        req.aff_keys = keys
+        with self._affinity_lock:
+            for i in range(len(keys) - 1, -1, -1):
+                rec = self._affinity.get(keys[i])
+                if rec is not None:
+                    self._affinity.move_to_end(keys[i])
+                    req.aff_key = keys[i]
+                    return {"rec": dict(rec), "tokens": (i + 1) * ps}
+        return None
+
+    def _affinity_note(self, req: _FleetRequest, b: BackendClient,
+                       final: dict) -> None:
+        """Completion-side bookkeeping: remember that ``b`` now holds
+        this prompt's KV under its deepest full-page chain key (and
+        the export rid addressing it, when the wire body asked for
+        one). The shallower key the lookup matched is DROPPED — the
+        session slides forward through the table, one entry per live
+        session, LRU-bounded at ``affinity_slots``."""
+        if not self.sticky_sessions:
+            return
+        toks = req.body.get("tokens") or ()
+        ps = self.affinity_page
+        if len(toks) < ps:
+            return
+        keys = req.aff_keys
+        if keys is None:
+            keys = chain_keys(toks, ps, self._affinity_salt(req.body))
+        rid = final.get("rid") if req.exported else None
+        rec = {
+            "addr": b.addr,
+            "rid": int(rid) if rid is not None else None,
+            "tokens": len(keys) * ps,
+            "ts": time.time(),
+        }
+        with self._affinity_lock:
+            if req.aff_key is not None and req.aff_key != keys[-1]:
+                self._affinity.pop(req.aff_key, None)
+            self._affinity[keys[-1]] = rec
+            self._affinity.move_to_end(keys[-1])
+            while len(self._affinity) > self.affinity_slots:
+                self._affinity.popitem(last=False)
+            n = len(self._affinity)
+        self._g_affinity.set(float(n))
+
+    def _sticky_hot(self, src: BackendClient) -> bool:
+        """Should affinity yield to load? Only when the sticky host is
+        ``sticky_hot_gap`` or more requests (in-flight + queued)
+        BUSIER than the least-loaded routable alternative — mild
+        imbalance stays sticky (the prefix cache pays for it), a
+        genuinely hot host sheds its sessions."""
+        load = src.in_flight + src.queue_depth()
+        alts = [
+            b.in_flight + b.queue_depth() for b in self.backends
+            if b is not src and b.routable()
+        ]
+        return bool(alts) and load - min(alts) >= self.sticky_hot_gap
+
+    def _session_route(self,
+                       req: _FleetRequest) -> Optional[BackendClient]:
+        """The sticky placement decision for a request's first
+        attempt. Affinity hit on a healthy, not-hot host -> serve
+        there (outcome ``sticky``). Sticky host unavailable (draining
+        /drainz, mid-rollout, breaker-tripped, detached) or hot ->
+        pick a new host; when the session's pages are addressable
+        (export rid), BOTH hosts have tiers, the source isn't
+        breaker-open (a dead socket must fail fast, not hang a
+        fetch), and the measured breakeven favors the wire, MIGRATE
+        the KV chain first (outcome ``migrated``), else cold-prefill
+        (outcome ``rebalanced``). Returns the chosen backend, or None
+        to let the caller's ordinary ``_pick`` run (outcome ``new``
+        recorded there)."""
+        hit = self._affinity_lookup(req)
+        if hit is None:
+            return None
+        rec = hit["rec"]
+        src = next(
+            (b for b in self.backends if b.addr == rec["addr"]), None
+        )
+        routable_src = (
+            src is not None and src.routable()
+            and (req.model is None or req.model in (src.model_ids or ()))
+        )
+        if routable_src and not self._sticky_hot(src) \
+                and src.breaker.allow():
+            self._session_outcome(req, "sticky")
+            return src
+        dst = self._pick(exclude=(rec["addr"],), model=req.model)
+        if dst is None:
+            # Nowhere else to go: a hot (or half-open) sticky host
+            # still beats a 503 when it can take the request at all.
+            if routable_src and src.breaker.allow():
+                self._session_outcome(req, "sticky")
+                return src
+            return None
+        can_migrate = (
+            src is not None
+            and rec.get("rid") is not None
+            and not src.detached
+            and src.breaker.state != CircuitBreaker.OPEN
+            and dst.has_host_tier()
+        )
+        if not can_migrate:
+            self._session_outcome(req, "rebalanced")
+            return dst
+        if not self._disagg_wins(hit["tokens"], dst):
+            # Same measured migrate-vs-cold-prefill gate as the
+            # disaggregated path (shared EMAs — every SKVP transfer
+            # teaches both): the wire would lose to dst recomputing.
+            with self._lock:
+                self.migrate_breakeven_losses += 1
+            self._c_migrate.labels(outcome="breakeven_loss").inc()
+            self._session_outcome(req, "rebalanced")
+            return dst
+        if self._migrate_session(req, src, dst, rec, hit["tokens"]):
+            self._session_outcome(req, "migrated")
+        else:
+            self._session_outcome(req, "rebalanced")
+        return dst
+
+    def _migrate_session(self, req: _FleetRequest, src: BackendClient,
+                         dst: BackendClient, rec: dict,
+                         covered: int) -> bool:
+        """Move the session's exported KV chain ``src`` -> ``dst``
+        (``GET /kv/pages`` relayed into ``POST /kv/pages``, one timed
+        unit feeding the breakeven EMAs) so the turn prefills WARM on
+        the new host. False on any failure — the caller serves cold on
+        ``dst`` instead; a migration must never cost more than the
+        prefill it was avoiding, so there are no retries here. The
+        trace child rides both legs (both hosts record kv_migrate
+        spans) and the router adds its own kv_migrate span covering
+        the full transfer."""
+        trace_hdr = (req.trace.child().to_header()
+                     if req.trace is not None else None)
+        x0 = time.monotonic()
+        leg = src
+        try:
+            payload = src.kv_pages(int(rec["rid"]),
+                                   trace_header=trace_hdr)
+            leg = dst
+            dst.kv_ingest(payload, trace_header=trace_hdr)
+        except BackendError as e:
+            # Attribute the failure to the host whose leg broke — a
+            # dead source trips ITS breaker (later turns skip straight
+            # to cold prefill), not the healthy destination's.
+            leg.breaker.record_failure()
+            with self._lock:
+                self.migrate_fallbacks += 1
+            self._c_migrate.labels(outcome="failed").inc()
+            self.flight.record(
+                "session_migrate_failed", rid=req.rid, src=src.addr,
+                dst=dst.addr, at=leg.addr, error=str(e),
+            )
+            return False
+        ms = (time.monotonic() - x0) * 1000.0
+        self._note_xfer(len(payload), ms, covered)
+        with self._lock:
+            self.migrations += 1
+            self.migrate_bytes += len(payload)
+        self._c_migrate.labels(outcome="ok").inc()
+        self._c_migrate_bytes.inc(float(len(payload)))
+        self._h_migrate.observe(ms / 1000.0)
+        if req.trace is not None:
+            self._span_store.add(req.trace.trace_id, _dtrace.span_record(
+                "kv_migrate", req.trace, x0 * 1000.0, ms, rid=req.rid,
+                src=src.addr, dst=dst.addr, nbytes=len(payload),
+                tokens=covered,
+            ))
+        self.flight.record(
+            "session_migrated", rid=req.rid, src=src.addr, dst=dst.addr,
+            nbytes=len(payload), ms=round(ms, 3), tokens=covered,
+        )
+        return True
+
+    def session_stats(self) -> Optional[dict]:
+        """The /statz ``session`` block (and ``obs top``'s session
+        line): affinity-table occupancy, per-outcome request counts,
+        the warm-placement rate (sticky + migrated over everything
+        sticky routing classified), and migration totals. None when
+        sticky routing is disabled."""
+        if not self.sticky_sessions:
+            return None
+        with self._lock:
+            counts = dict(self.session_counts)
+            m_ok = self.migrations
+            m_fail = self.migrate_fallbacks
+            m_loss = self.migrate_breakeven_losses
+            m_bytes = self.migrate_bytes
+        with self._affinity_lock:
+            entries = len(self._affinity)
+        total = sum(counts.values())
+        warm = counts["sticky"] + counts["migrated"]
+        return {
+            "affinity_entries": entries,
+            "affinity_slots": self.affinity_slots,
+            "affinity_page": self.affinity_page,
+            "requests": counts,
+            "sticky_hit_rate": round(warm / total, 4) if total else None,
+            "migrations": m_ok,
+            "migrate_fallbacks": m_fail,
+            "migrate_breakeven_losses": m_loss,
+            "migrate_bytes": m_bytes,
+        }
+
     def _finish(self, req: _FleetRequest, completion, error) -> None:
         with self._lock:
             if self._reqs.pop(req.rid, None) is None:
@@ -1198,6 +1590,19 @@ class FleetRouter:
             "disagg_fallbacks": self.disagg_fallbacks,
             "disagg_breakeven_losses": self.disagg_breakeven_losses,
         }
+        if self.sticky_sessions:
+            with self._lock:
+                out.update(
+                    session_sticky=self.session_counts["sticky"],
+                    session_new=self.session_counts["new"],
+                    session_migrated=self.session_counts["migrated"],
+                    session_rebalanced=self.session_counts["rebalanced"],
+                    migrations=self.migrations,
+                    migrate_fallbacks=self.migrate_fallbacks,
+                    migrate_breakeven_losses=self.migrate_breakeven_losses,
+                )
+            with self._affinity_lock:
+                out["affinity_entries"] = len(self._affinity)
         if self._xfer_bytes_per_ms is not None:
             # The breakeven's learned wire speed — operators read this
             # next to each decode host's prefill_tok_per_ms to see WHY
@@ -1430,7 +1835,7 @@ class FleetRouter:
         rows = []
         for b in self.backends:
             h = b.health or {}
-            rows.append({
+            row = {
                 "backend": b.addr,
                 "status": b.status(),
                 "breaker": b.breaker.state,
@@ -1447,7 +1852,15 @@ class FleetRouter:
                 "last_probe_ts": b.health_ts,
                 "max_len": b.max_len,
                 "role": self._role(b),
-            })
+            }
+            if b.cache is not None:
+                # The prober's last /cachez scrape — the numbers the
+                # sticky score routes on, shown per host so an
+                # operator sees WHY placement prefers a backend.
+                row["cache_occupancy"] = round(b.cache_occupancy(), 4)
+                row["cache_hit_rate"] = b.cache_hit_rate()
+                row["host_tier"] = b.has_host_tier()
+            rows.append(row)
         return {
             "backends": rows,
             "retry_budget": round(self.policy.budget, 2),

@@ -221,6 +221,12 @@ ENGINE_INTERFACE = frozenset({
     # fleet router with declared tier budgets, None everywhere else
     # (the route then serves an empty tiers doc).
     "slo_report",
+    # sticky sessions (fleet/router.py): ``session_stats`` answers the
+    # /statz ``session`` block with affinity-table occupancy, warm-
+    # placement hit rate and migration counts — real on a fleet router
+    # with sticky sessions on, None everywhere else (the block is then
+    # omitted).
+    "session_stats",
     # prefill/decode disaggregation (fleet/router.py): the KV-handoff
     # wire surface. ``kv_export_payload`` answers ``GET /kv/pages?rid=``
     # with the serialized page chain a ``kv_export`` admission filed
@@ -1265,6 +1271,12 @@ class Engine:
         only a fleet router with declared tier budgets evaluates one
         (obs/slo.py); the per-host watchdog verdict stays on /healthz
         and /statz."""
+        return None
+
+    def session_stats(self):
+        """The /statz ``session`` block, or None — session affinity
+        lives at the fleet router (fleet/router.py); an in-process
+        engine has no roster to pin sessions to."""
         return None
 
     def _kv_export_ok(self) -> bool:
@@ -2695,6 +2707,7 @@ class PagedEngine(Engine):
         prefill_chunk: Optional[int] = None,
         kv_scale_dtype=jnp.float32,
         kv_host_bytes: int = 0,
+        kv_export_slots: int = 64,
         **kw,
     ):
         """``prefill_chunk``: when set, prompts longer than this many
@@ -2714,7 +2727,13 @@ class PagedEngine(Engine):
         restores it with an async ``device_put`` overlapped with decode
         — unless the measured restore estimate loses the
         restore-vs-recompute breakeven, in which case the prompt
-        recomputes as before (docs/kv_tiering.md)."""
+        recomputes as before (docs/kv_tiering.md).
+
+        ``kv_export_slots``: cap on live ``/kv/pages`` export records
+        (rid → page chain, FIFO-evicted). The default 64 suits the
+        disaggregation handoff's fetch-immediately pattern; fleets
+        doing session migration hold records for a whole turn's
+        think-time and size it up (``--kv-export-slots``)."""
         if getattr(model, "prefill_needs_mask", False):
             raise ValueError(
                 "recurrent models carry O(1) state per slot — a paged KV "
@@ -2836,6 +2855,13 @@ class PagedEngine(Engine):
         # transfers run on a single background worker so the engine
         # thread never blocks on PCIe (docs/kv_tiering.md).
         self.kv_host_bytes = int(kv_host_bytes or 0)
+        self.kv_export_slots = int(kv_export_slots)
+        if self.kv_export_slots < 1:
+            raise ValueError(
+                f"kv_export_slots must be >= 1, got {kv_export_slots}: "
+                "zero slots would evict every export before its peer "
+                "ever fetched it"
+            )
         self._kv_store = None
         if self.kv_host_bytes:
             if not enable_prefix_cache:
@@ -3350,7 +3376,7 @@ class PagedEngine(Engine):
                 "adapter": int(req.adapter),
                 "futs": futs,
             }
-            while len(self._kv_exports) > 64:
+            while len(self._kv_exports) > self.kv_export_slots:
                 self._kv_exports.popitem(last=False)
 
     def kv_export_payload(self, rid: int, trace: Optional[dict] = None):
@@ -3615,16 +3641,13 @@ class PagedEngine(Engine):
 
     @staticmethod
     def _chain_key(parent: bytes, page_tokens) -> bytes:
-        """Key of a prefix one page longer than ``parent``'s: a sha256
-        chain digest — O(page_size) to extend, 32 bytes resident per
-        page regardless of prefix depth (a flat tuple-of-tokens key
-        would cost O(prefix) memory per page and O(prefix) hashing per
-        probe)."""
-        import hashlib
+        """Key of a prefix one page longer than ``parent``'s — the
+        shared sha256 chain digest (:func:`kvtier.chain_digest`), so
+        the device prefix table, host tier, and the fleet router's
+        session-affinity table all speak the same key bytes."""
+        from shifu_tpu.infer.kvtier import chain_digest
 
-        h = hashlib.sha256(parent)
-        h.update(np.asarray(page_tokens, np.int32).tobytes())
-        return h.digest()
+        return chain_digest(parent, page_tokens)
 
     def _try_admit(self, req: _Request) -> bool:
         """Admit if a slot AND enough pages exist; False = leave queued."""

@@ -29,6 +29,7 @@ flush rules) lives in ``PagedEngine`` — see docs/kv_tiering.md.
 
 from __future__ import annotations
 
+import hashlib
 import json
 import struct
 import threading
@@ -46,7 +47,41 @@ __all__ = [
     "deserialize_pages",
     "pack_page_chain",
     "unpack_page_chain",
+    "chain_digest",
+    "chain_keys",
 ]
+
+
+# ------------------------------------------------------------ chain digests
+#
+# THE digest scheme for page-aligned KV prefixes, shared by every layer
+# that names a prefix: the engine's device prefix table, the host tier,
+# and the fleet router's session-affinity table all key on these exact
+# bytes, so a digest computed in one layer is meaningful in another.
+
+
+def chain_digest(parent: bytes, page_tokens) -> bytes:
+    """Key of a prefix one page longer than ``parent``'s: a sha256
+    chain digest over the parent digest plus the page's tokens as
+    int32 bytes — O(page_size) to extend, 32 bytes resident per page
+    regardless of prefix depth (a flat tuple-of-tokens key would cost
+    O(prefix) memory per page and O(prefix) hashing per probe)."""
+    h = hashlib.sha256(parent)
+    h.update(np.asarray(page_tokens, np.int32).tobytes())
+    return h.digest()
+
+
+def chain_keys(tokens, page_size: int, salt: bytes = b"") -> List[bytes]:
+    """Digest of every FULL page-aligned prefix of ``tokens`` (index i
+    covers tokens[: (i+1) * page_size]), rooted at ``salt`` (the
+    adapter partition; b"" = base model). The partial tail page never
+    gets a key — it is not shareable."""
+    keys: List[bytes] = []
+    key = salt
+    for i in range(len(tokens) // int(page_size)):
+        key = chain_digest(key, tokens[i * page_size : (i + 1) * page_size])
+        keys.append(key)
+    return keys
 
 
 # --------------------------------------------------------------- wire format

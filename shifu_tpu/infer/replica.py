@@ -334,6 +334,12 @@ class ReplicatedEngine:
         /sloz serves an empty tiers doc."""
         return None
 
+    def session_stats(self):
+        """No session affinity — sticky routing lives at the fleet
+        router (fleet/router.py); dp replicas share one page pool, so
+        there is nothing to pin. /statz omits the block."""
+        return None
+
     def reload_params(self, params) -> None:
         """Hot-swap serving weights on EVERY replica (each re-places
         the tree onto its own sub-mesh via its live leaf shardings).

@@ -1426,6 +1426,15 @@ class _Handler(BaseHTTPRequestHandler):
             cache = eng.cache_stats()
             if cache is not None:
                 out["cache"] = cache
+            # Session block (fleet routers only): sticky-routing
+            # affinity-table occupancy, per-outcome placement counts,
+            # the warm-placement rate, and KV-migration totals.
+            # Engines without sticky sessions omit the block.
+            sess = getattr(eng, "session_stats", None)
+            if callable(sess):
+                sess_doc = sess()
+                if sess_doc is not None:
+                    out["session"] = sess_doc
             # Speculative-decoding block: per-engine propose/accept
             # totals + the rolling acceptance rate (the spec engines'
             # counters carry them; non-spec engines omit the block).

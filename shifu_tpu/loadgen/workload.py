@@ -165,3 +165,36 @@ class WorkloadModel:
             "tier": entry.tier,
         }
         return [Request("batch_backfill", entry.tier, body)]
+
+
+def chat_trace(*, sessions: int = 4, turns: int = 4,
+               system_tokens: int = 48, turn_tokens: int = 32,
+               max_new_tokens: int = 8,
+               seed: int = 0) -> List[Request]:
+    """A standalone deterministic multi-turn chat trace, in arrival
+    order — the ``chat`` kind's shape without the scenario machinery.
+    All sessions share one system prompt; each arrival advances one
+    session round-robin and its prompt EXTENDS that session's previous
+    prompt (the prefix-chain signal sticky routing keys on). Same
+    arguments = the same trace byte for byte, so replaying it under
+    two placement policies (a sticky router vs cache-oblivious
+    round-robin) compares them on identical work — the
+    ``bench_sticky_routing`` leg's input."""
+    rng = random.Random(seed)
+    system = [
+        rng.randrange(_TOK_LO, _TOK_HI) for _ in range(max(system_tokens, 1))
+    ]
+    hist = {sid: list(system) for sid in range(max(sessions, 1))}
+    out: List[Request] = []
+    for _turn in range(max(turns, 1)):
+        for sid in sorted(hist):
+            hist[sid].extend(
+                rng.randrange(_TOK_LO, _TOK_HI)
+                for _ in range(max(turn_tokens, 1))
+            )
+            body = {
+                "tokens": list(hist[sid]),
+                "max_new_tokens": int(max_new_tokens),
+            }
+            out.append(Request("chat", "interactive", body, session=sid))
+    return out

@@ -142,6 +142,20 @@ METRIC_SPECS: Dict[str, Tuple[str, float]] = {
     # creeping up means handoff cost leaked into steady-state decode.
     "disagg_x_coloc_ttft": (LOWER, 0.50),
     "disagg_x_coloc_itl": (LOWER, 0.35),
+    # sticky routing + live migration (round 18): bench_sticky_routing
+    # replays one deterministic multi-turn chat trace through a sticky
+    # fleet and a cache-oblivious round-robin control. The saved-x
+    # ratio is oblivious computed-prefill tokens over sticky (>1 =
+    # session affinity turned follow-up turns into cache hits) — it collapsing toward 1 means affinity stopped
+    # placing sessions on their pages. migrate_x_cold_ttft prices a
+    # drain-forced mid-session migration against a cold same-length
+    # prefill on the surviving host; drifting UP past tolerance means
+    # the export/ingest walk got more expensive than the prefill it
+    # avoids. Armable — dormant until a baseline round records the leg
+    # (missing keys are skipped).
+    "sticky_prefill_tok_saved_x": (HIGHER, 0.25),
+    "sticky_p50_ttft_ms": (LOWER, 0.50),
+    "migrate_x_cold_ttft": (LOWER, 0.50),
     # loadgen measurement harness (round 17): the headline of a scored
     # scenario run (shifu_tpu loadgen / bench_loadgen) — goodput and
     # achieved-vs-offered are the capacity claims, p99 TTFT and error

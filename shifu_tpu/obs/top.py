@@ -1,8 +1,10 @@
 """``shifu_tpu obs top``: one pane of glass over a live router.
 
 Polls ``GET /statz`` + ``GET /sloz`` and renders a plain-text frame —
-tier burn rates/headroom on top, one row per backend (role, health,
-watchdog reasons, load, cache occupancy) below. Deliberately
+tier burn rates/headroom on top, the sticky-session line (affinity
+occupancy, warm-placement hit rate, migration counts — the /statz
+``session`` block), then one row per backend (role, health, watchdog
+reasons, load, prefix-cache occupancy) below. Deliberately
 curses-free: the frame is a pure function of the two JSON documents
 (``render_top``), so the chaos tests and a human terminal consume the
 exact same rendering, and ``--once`` mode pipes cleanly into files.
@@ -61,6 +63,21 @@ def render_top(statz: dict, sloz: Optional[dict] = None,
             f"  itl p99 {_fmt(lat.get('req_itl_ms_p99'))} ms"
             f"  window {lat.get('completions')} reqs"
         )
+    sess = statz.get("session") or {}
+    if sess:
+        reqs = sess.get("requests") or {}
+        lines.append(
+            "session: "
+            f"affinity {sess.get('affinity_entries', 0)}/"
+            f"{sess.get('affinity_slots', 0)}"
+            f"  hit-rate {_fmt(sess.get('sticky_hit_rate'), 3)}"
+            f"  sticky {reqs.get('sticky', 0)}"
+            f"  migrated {reqs.get('migrated', 0)}"
+            f"  rebalanced {reqs.get('rebalanced', 0)}"
+            f"  migrations {sess.get('migrations', 0)}"
+            f" (fail {sess.get('migrate_fallbacks', 0)}"
+            f", breakeven {sess.get('migrate_breakeven_losses', 0)})"
+        )
 
     tiers = (sloz or {}).get("tiers") or {}
     if tiers:
@@ -109,9 +126,12 @@ def render_top(statz: dict, sloz: Optional[dict] = None,
             blk = cache.get(r.get("backend"))
             pc = (blk or {}).get("prefix_cache")
             if pc:
+                # /cachez keys: registered_pages of n_pages total
+                # (the occupancy the sticky score routes on).
                 lines.append(
-                    f"    cache: {pc.get('pages_used', 0)}/"
-                    f"{pc.get('pages_total', 0)} pages"
+                    f"    cache: {pc.get('registered_pages', 0)}/"
+                    f"{pc.get('n_pages', 0)} pages"
+                    f"  occ {_fmt(r.get('cache_occupancy'), 3)}"
                     f"  hit-rate {_fmt(pc.get('hit_rate'), 3)}"
                 )
 

@@ -17,7 +17,10 @@ reported as the serving ckpt — the rollout tests' rollback anchor),
 ``FLEET_BACKEND_ROLE`` (prefill|decode|both — the disaggregation role
 the server advertises), ``FLEET_BACKEND_KV_HOST_BYTES`` (nonzero
 enables the prefix cache + host KV tier, the /kv/pages handoff
-surface — the disagg tests set it on both hosts).
+surface — the disagg tests set it on both hosts),
+``FLEET_BACKEND_KV_EXPORT_SLOTS`` (the /kv/pages export-record cap,
+the ``--kv-export-slots`` serve flag — migration tests shrink it to
+force FIFO eviction).
 
 CHAOS HOOKS: the ``FLEET_BACKEND_FAULT_*`` env vars select the
 first-class fault injectors in :mod:`shifu_tpu.fleet.chaos`
@@ -57,6 +60,7 @@ def main() -> int:
     ckpt = os.environ.get("FLEET_BACKEND_CKPT") or None
     role = os.environ.get("FLEET_BACKEND_ROLE") or "both"
     kv_host = int(os.environ.get("FLEET_BACKEND_KV_HOST_BYTES", "0"))
+    kv_slots = int(os.environ.get("FLEET_BACKEND_KV_EXPORT_SLOTS", "64"))
 
     cfg = TransformerConfig.tiny()
     model = Transformer(cfg)
@@ -70,7 +74,8 @@ def main() -> int:
         # The disaggregation surface: prefix cache + host KV tier is
         # what a prefill host spills exports into (and a decode host
         # ingests from) over /kv/pages.
-        extra.update(enable_prefix_cache=True, kv_host_bytes=kv_host)
+        extra.update(enable_prefix_cache=True, kv_host_bytes=kv_host,
+                     kv_export_slots=kv_slots)
     engine = PagedEngine(
         model, params, max_slots=max_slots, max_len=max_len,
         page_size=16, prefill_buckets=(16, max_len),

@@ -1,0 +1,486 @@
+"""Sticky, cache-aware routing + live session migration. Unit layer:
+the router-side prefix-chain digests match the engines' scheme, the
+affinity table is a deepest-first bounded LRU that slides forward with
+the session, cache occupancy breaks load ties in ``_pick``, and the
+``--kv-export-slots`` knob is validated at the engine and CLI seams
+with FIFO eviction at the cap. Process layer (tests/_fleet_backend.py,
+two host-tier backends + a colocated control): a mid-session
+``/drainz`` forces the next turn onto the other host VIA KV migration
+(nonzero ``shifu_migrate_*``, decode bitwise identical to the
+control), and a SIGKILL'd sticky host falls back to cold prefill with
+every request answered 200 or 503-with-Retry-After and the failed
+migration counted."""
+
+import hashlib
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from shifu_tpu.fleet import (
+    BackendClient,
+    BackendConfig,
+    FleetRouter,
+    RetryPolicy,
+    wait_ready,
+)
+from shifu_tpu.fleet.router import _FleetRequest
+from shifu_tpu.infer import make_server
+from shifu_tpu.infer.kvtier import chain_digest, chain_keys
+from shifu_tpu.obs import FlightRecorder, MetricsRegistry, parse_exposition
+
+_HELPER = os.path.join(os.path.dirname(__file__), "_fleet_backend.py")
+_KV = str(64 << 20)
+
+
+def _spawn_backend(max_slots=2, step_delay=0.01, extra_env=None):
+    env = dict(
+        os.environ,
+        PALLAS_AXON_POOL_IPS="",
+        JAX_PLATFORMS="cpu",
+        FLEET_BACKEND_MAX_SLOTS=str(max_slots),
+        FLEET_BACKEND_STEP_DELAY=str(step_delay),
+        **(extra_env or {}),
+    )
+    proc = subprocess.Popen(
+        [sys.executable, _HELPER],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        env=env, text=True,
+    )
+    line = proc.stdout.readline()
+    if not line:
+        proc.kill()
+        raise RuntimeError("backend process died before printing its port")
+    port = json.loads(line)["port"]
+    return proc, f"127.0.0.1:{port}"
+
+
+def _post(base, path, obj, timeout=120):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(obj).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def _get(base, path, timeout=30):
+    with urllib.request.urlopen(base + path, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _kv_env():
+    # "both"-role hosts with the host KV tier: every host can export
+    # AND ingest — the sticky-session topology (vs. the disagg tests'
+    # dedicated prefill/decode roles).
+    return {"FLEET_BACKEND_KV_HOST_BYTES": _KV}
+
+
+@pytest.fixture(scope="module")
+def duo():
+    """Two host-tier "both" backends (the sticky fleet) + a plain
+    colocated control for every bitwise-parity assertion."""
+    procs, addrs = [], []
+    try:
+        for env in (_kv_env(), _kv_env(), None):
+            p, a = _spawn_backend(extra_env=env)
+            procs.append(p)
+            addrs.append(a)
+        yield addrs
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGKILL)
+        for p in procs:
+            p.wait(timeout=10)
+
+
+def _clients(addrs, **cfg_over):
+    cfg = BackendConfig(connect_timeout_s=10.0, probe_timeout_s=5.0,
+                        read_timeout_s=60.0, **cfg_over)
+    clients = [BackendClient(a, cfg) for a in addrs]
+    ready, pending = wait_ready(clients, timeout_s=60.0, require_all=True)
+    assert not pending
+    for b in clients:
+        b.refresh_cachez()  # what build_fleet/the prober do in prod
+    return clients
+
+
+def _sticky_router(clients, **kw):
+    return FleetRouter(
+        clients, metrics=MetricsRegistry(), flight=FlightRecorder(),
+        policy=RetryPolicy(base_s=0.01, cap_s=0.1, budget=16.0),
+        **kw,
+    )
+
+
+def _metric_total(addr, name):
+    with urllib.request.urlopen(f"http://{addr}/metrics", timeout=30) as r:
+        samples = parse_exposition(r.read().decode())
+    return sum(v for (n, _), v in samples.items() if n == name)
+
+
+# ------------------------------------------------------------ unit layer
+
+
+def test_chain_digest_matches_engine_scheme():
+    """The router keys affinity on the SAME digest chain the engines'
+    prefix caches use: sha256(parent || int32 tokens), page by page —
+    and a longer prompt's key list extends a shorter one's."""
+    toks = list(range(1, 65))
+    want = hashlib.sha256(b"")
+    want.update(np.asarray(toks[:16], np.int32).tobytes())
+    assert chain_digest(b"", toks[:16]) == want.digest()
+
+    short = chain_keys(toks[:32], 16)
+    long = chain_keys(toks, 16)
+    assert len(short) == 2 and len(long) == 4
+    assert long[:2] == short  # prefix property — affinity's backbone
+    # Salt (adapter) separates chains over identical tokens.
+    assert chain_keys(toks, 16, b"adapter:0") != long
+    # Partial trailing page contributes no key.
+    assert chain_keys(toks[:31], 16) == short[:1]
+
+
+def _fake_backend(addr, occupancy=None, host_tier=True):
+    b = BackendClient(addr)
+    if occupancy is not None:
+        b.cache = {
+            "prefix_cache": {
+                "n_pages": 100,
+                "registered_pages": int(occupancy * 100),
+                "hit_rate": 0.5,
+            },
+            "host_tier": {"used_bytes": 0} if host_tier else None,
+        }
+    return b
+
+
+def test_pick_breaks_load_ties_by_cache_occupancy():
+    """Equal load: the emptier prefix cache wins (new sessions go
+    where pages won't evict). A real load gap still dominates — a full
+    cache prices like cache_weight queued requests, not a veto."""
+    full = _fake_backend("127.0.0.1:9101", occupancy=0.9)
+    empty = _fake_backend("127.0.0.1:9102", occupancy=0.1)
+    r = _sticky_router([full, empty])
+    assert r._pick() is empty  # index order would say `full`
+    empty.in_flight = 1
+    assert r._pick() is full   # load beats cache pressure
+    empty.in_flight = 0
+    r.cache_weight = 0.0       # weight 0 restores pure index order
+    assert r._pick() is full
+
+
+def test_affinity_table_deepest_first_lru_and_slide():
+    b1 = _fake_backend("127.0.0.1:9111", occupancy=0.0)
+    b2 = _fake_backend("127.0.0.1:9112", occupancy=0.0)
+    r = _sticky_router([b1, b2], affinity_slots=2)
+    t1 = list(range(1, 81))            # 80 tokens = 2 full 32-tok links
+    req = _FleetRequest(0, {"tokens": t1, "max_new_tokens": 4})
+    req.exported = True
+    r._affinity_note(req, b1, {"rid": 7})
+    assert r.session_stats()["affinity_entries"] == 1
+
+    # The follow-up turn EXTENDS t1 -> deepest-first walk finds the
+    # session through the shared 64-token prefix, rid and all.
+    t2 = t1 + list(range(100, 140))
+    req2 = _FleetRequest(1, {"tokens": t2, "max_new_tokens": 4})
+    hit = r._affinity_lookup(req2)
+    assert hit is not None
+    assert hit["rec"]["addr"] == b1.addr
+    assert hit["rec"]["rid"] == 7
+    assert hit["tokens"] == 64  # full links only
+
+    # An adapter'd request never aliases the base-model session.
+    assert r._affinity_lookup(_FleetRequest(
+        2, {"tokens": t2, "max_new_tokens": 4, "adapter": 0}
+    )) is None
+
+    # Completing turn 2 on b2 SLIDES the entry forward: the shallower
+    # matched key is dropped, one entry per live session.
+    req2.exported = True
+    r._affinity_note(req2, b2, {"rid": 9})
+    assert r.session_stats()["affinity_entries"] == 1
+    hit = r._affinity_lookup(_FleetRequest(
+        3, {"tokens": t2 + [1, 2], "max_new_tokens": 4}
+    ))
+    assert hit["rec"]["addr"] == b2.addr and hit["rec"]["rid"] == 9
+
+    # Bounded LRU: two more sessions at affinity_slots=2 evict the
+    # oldest; a prompt too short for one full link is never tabled.
+    for base_tok in (200, 300):
+        toks = [base_tok + i for i in range(40)]
+        rq = _FleetRequest(base_tok, {"tokens": toks, "max_new_tokens": 4})
+        r._affinity_note(rq, b1, {"rid": base_tok})
+    assert r.session_stats()["affinity_entries"] == 2
+    assert r._affinity_lookup(_FleetRequest(
+        4, {"tokens": t2 + [1, 2], "max_new_tokens": 4}
+    )) is None  # the slid entry was the LRU victim
+    short = _FleetRequest(5, {"tokens": [1, 2, 3], "max_new_tokens": 4})
+    r._affinity_note(short, b1, {"rid": 1})
+    assert r.session_stats()["affinity_entries"] == 2
+
+
+def test_sticky_hot_gap_yields_under_imbalance():
+    b1 = _fake_backend("127.0.0.1:9121", occupancy=0.0)
+    b2 = _fake_backend("127.0.0.1:9122", occupancy=0.0)
+    r = _sticky_router([b1, b2], sticky_hot_gap=4)
+    assert not r._sticky_hot(b1)       # balanced: stay sticky
+    b1.in_flight = 3
+    assert not r._sticky_hot(b1)       # mild imbalance: the cache pays
+    b1.in_flight = 4
+    assert r._sticky_hot(b1)           # gap reached: shed the session
+
+
+def test_router_validates_sticky_params():
+    b = _fake_backend("127.0.0.1:9131")
+    with pytest.raises(ValueError, match="affinity_page"):
+        _sticky_router([b], affinity_page=0)
+    with pytest.raises(ValueError, match="affinity_slots"):
+        _sticky_router([b], affinity_slots=0)
+    with pytest.raises(ValueError, match="cache_weight"):
+        _sticky_router([b], cache_weight=-1.0)
+    blind = _sticky_router([b], sticky_sessions=False)
+    assert blind.session_stats() is None
+    assert "session_sticky" not in blind.counters()
+
+
+def test_engine_kv_export_slots_validated_and_fifo():
+    """The PagedEngine export-record cap is a constructor knob
+    (--kv-export-slots): < 1 refuses; at the cap the table FIFOs, so
+    the oldest rid's /kv/pages payload is gone while newer survive."""
+    import jax
+
+    from shifu_tpu.infer import PagedEngine, SampleConfig
+    from shifu_tpu.models import Transformer, TransformerConfig
+
+    model = Transformer(TransformerConfig.tiny())
+    params = model.init(jax.random.key(0))
+    kw = dict(
+        max_slots=2, max_len=128, page_size=16, prefill_buckets=(16, 128),
+        enable_prefix_cache=True, kv_host_bytes=32 << 20,
+        sample_cfg=SampleConfig(temperature=0.0),
+    )
+    with pytest.raises(ValueError, match="kv_export_slots"):
+        PagedEngine(model, params, kv_export_slots=0, **kw)
+
+    eng = PagedEngine(model, params, kv_export_slots=2, **kw)
+    rids = []
+    for i in range(3):
+        prompt = [(17 * i + j) % 96 + 1 for j in range(32)]
+        rids.append(eng.submit(prompt, 2, kv_export=True))
+        eng.run()
+    assert eng.kv_export_payload(rids[0]) is None  # FIFO'd out
+    for rid in rids[1:]:
+        assert eng.kv_export_payload(rid)
+
+
+def test_cli_kv_export_slots_flag_validation():
+    """--kv-export-slots: refused < 1, refused without the host KV
+    tier it sizes, defaulted (getattr) for pre-flag callers."""
+    import argparse
+
+    import jax
+
+    from shifu_tpu.cli import build_serve_engine
+    from shifu_tpu.data.tokenizer import ByteTokenizer
+    from shifu_tpu.models import Transformer, TransformerConfig
+
+    model = Transformer(TransformerConfig.tiny())
+    params = model.init(jax.random.key(0))
+    tok = ByteTokenizer()
+
+    def args(**over):
+        base = dict(
+            family="transformer", preset="tiny", moe_experts=0, attn=None,
+            optimizer="adamw", schedule="constant", lr=3e-4, warmup=0,
+            ckpt_dir=None, seed=0, tokenizer=None, host="127.0.0.1",
+            port=0, max_slots=2, max_len=64, max_new_tokens=16,
+            temperature=0.0, top_p=0.95, decode_chunk=1, eos_id=-1,
+            paged=True, page_size=8, n_pages=None, prefix_cache=True,
+            per_request_sampling=False, penalties=False, logit_bias=False,
+            spec="off", spec_k=3, spec_ngram=2, spec_rounds=2,
+            draft_preset=None, draft_ckpt_dir=None, kv_tier="host",
+            kv_host_bytes=64 << 20, role="both", kv_export_slots=64,
+        )
+        base.update(over)
+        return argparse.Namespace(**base)
+
+    with pytest.raises(ValueError, match="kv-export-slots"):
+        build_serve_engine(args(kv_export_slots=0), model, params, tok)
+    with pytest.raises(ValueError, match="kv-export-slots"):
+        build_serve_engine(
+            args(kv_tier="off", prefix_cache=False, kv_export_slots=8),
+            model, params, tok,
+        )
+    eng = build_serve_engine(args(kv_export_slots=3), model, params, tok)
+    assert eng.kv_export_slots == 3
+    # Namespaces predating the flag (no attribute at all) still build.
+    ns = args()
+    del ns.kv_export_slots
+    eng = build_serve_engine(ns, model, params, tok)
+    assert eng.kv_export_slots == 64
+
+
+# --------------------------------------------------------- process layer
+
+
+def _turn(base, tokens, max_new=8):
+    status, out = _post(base, "/v1/completions",
+                        {"tokens": tokens, "max_new_tokens": max_new})
+    assert status == 200
+    return out
+
+
+def test_drain_migrates_session_bitwise(duo):
+    """The tentpole acceptance walk: turn 1 lands somewhere, turn 2
+    routes sticky to the same host, a mid-session /drainz then forces
+    turn 3 onto the OTHER host via KV migration — nonzero
+    shifu_migrate_* on the router, kv_xfer counters on both hosts, a
+    kv_migrate span in the merged trace, and decode output bitwise
+    identical to the colocated control (the migration was invisible to
+    the client)."""
+    a1, a2, ctl_addr = duo
+    clients = _clients([a1, a2])
+    router = _sticky_router(clients)
+    server = make_server(router, port=0)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    try:
+        base = f"http://127.0.0.1:{server.server_port}"
+        ctl = f"http://{ctl_addr}"
+
+        t1 = list(range(1, 49))  # 48 tokens: full 32-tok affinity link
+        out1 = _turn(base, t1)
+        src = out1["timing"]["backend"]
+        assert out1["tokens"] == _turn(ctl, t1)["tokens"]
+
+        # Turn 2 extends turn 1 (history + the reply + new user words):
+        # the affinity walk must route it to the SAME host.
+        t2 = t1 + out1["tokens"] + list(range(60, 76))
+        out2 = _turn(base, t2)
+        assert out2["timing"]["backend"] == src
+        assert out2["tokens"] == _turn(ctl, t2)["tokens"]
+        sess = router.session_stats()
+        assert sess["requests"]["sticky"] == 1
+        assert sess["requests"]["new"] == 1
+
+        # Rolling-update drain mid-session: new routing is blocked but
+        # /kv/pages still answers — exactly the migration window.
+        router.drain(src, detach=False)
+        t3 = t2 + out2["tokens"] + list(range(80, 96))
+        out3 = _turn(base, t3)
+        dst = out3["timing"]["backend"]
+        assert dst != src
+        assert out3["tokens"] == _turn(ctl, t3)["tokens"]  # bitwise
+
+        c = router.counters()
+        assert c["migrations"] == 1
+        assert c["session_migrated"] == 1
+        assert c["migrate_fallbacks"] == 0
+        assert c["kv_xfer_bytes_per_ms"] is not None  # EMA seeded
+        text = router.metrics.render()
+        assert 'shifu_migrate_total{outcome="ok"} 1' in text
+        assert _metric_total(src, "shifu_kv_xfer_export_bytes_total") > 0
+        assert _metric_total(dst, "shifu_kv_xfer_ingest_bytes_total") > 0
+
+        # The migration is one trace with the request: the router's
+        # kv_migrate span plus the per-host export/ingest spans.
+        tid = out3["timing"]["trace_id"]
+        doc = _get(base, f"/tracez?trace_id={tid}")
+        lanes = [
+            h["host"] for h in doc["hosts"]
+            if "kv_migrate" in [r.get("kind") for r in h.get("records", [])]
+        ]
+        assert len(lanes) >= 2, doc
+
+        # Turn 4 sticks to the NEW host — the session moved, for good.
+        router.resume(src)
+        t4 = t3 + out3["tokens"] + list(range(30, 46))
+        out4 = _turn(base, t4)
+        assert out4["timing"]["backend"] == dst
+        assert out4["tokens"] == _turn(ctl, t4)["tokens"]
+        assert router.session_stats()["requests"]["sticky"] == 2
+    finally:
+        server.shutdown()
+        server.runner.shutdown()
+        t.join(5)
+
+
+@pytest.mark.chaos
+def test_sigkill_sticky_host_cold_prefill_fallback(duo):
+    """Kill the sticky host outright (no drain): the next turn's
+    migration attempt fails FAST (connection refused, counted
+    shifu_migrate failed, attributed to the dead host's breaker) and
+    the turn cold-prefills on the survivor, bitwise identical to the
+    control. A follow-up burst of fresh sessions all answer 200 or
+    503-with-Retry-After — nothing hangs on the corpse."""
+    _, a2, ctl_addr = duo
+    proc, a1 = _spawn_backend(extra_env=_kv_env())
+    try:
+        clients = _clients([a1, a2])
+        router = _sticky_router(clients)
+        server = make_server(router, port=0)
+        t = threading.Thread(target=server.serve_forever, daemon=True)
+        t.start()
+        try:
+            base = f"http://127.0.0.1:{server.server_port}"
+            ctl = f"http://{ctl_addr}"
+            t1 = list(range(1, 49))
+            out1 = _turn(base, t1)
+            assert out1["timing"]["backend"] == a1  # index order
+
+            proc.send_signal(signal.SIGKILL)
+            proc.wait(timeout=10)
+            # Drain marks a1 un-routable so the sticky layer goes
+            # straight to migrate-or-rebalance (the breaker is still
+            # closed — the router does not yet KNOW the host is dead).
+            router.drain(a1, detach=False)
+
+            t2 = t1 + out1["tokens"] + list(range(60, 76))
+            out2 = _turn(base, t2)
+            assert out2["timing"]["backend"] == a2
+            assert out2["tokens"] == _turn(ctl, t2)["tokens"]
+            c = router.counters()
+            assert c["migrate_fallbacks"] >= 1   # fetch hit the corpse
+            assert c["migrations"] == 0
+            assert c["session_rebalanced"] >= 1
+            text = router.metrics.render()
+            assert 'shifu_migrate_total{outcome="failed"} 1' in text
+
+            # Fresh-session storm against the half-dead fleet.
+            results = [None] * 4
+
+            def worker(i):
+                body = {"tokens": [100 + i * 3 + j for j in range(40)],
+                        "max_new_tokens": 4}
+                try:
+                    results[i] = _post(base, "/v1/completions", body)
+                except urllib.error.HTTPError as e:
+                    assert e.code == 503
+                    assert e.headers.get("Retry-After")
+                    results[i] = (503, None)
+
+            threads = [threading.Thread(target=worker, args=(i,))
+                       for i in range(len(results))]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join(120)
+            assert all(r is not None for r in results), "a request hung"
+            assert [st for st, _ in results].count(200) >= 1
+        finally:
+            server.shutdown()
+            server.runner.shutdown()
+            t.join(5)
+    finally:
+        if proc.poll() is None:
+            proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=10)
