@@ -143,6 +143,10 @@ def main(argv=None):
         except Exception as e:
             out["sticky"] = {"error": f"{type(e).__name__}: {e}"}
         try:
+            out["kv_fleet"] = bench_kv_fleet()
+        except Exception as e:
+            out["kv_fleet"] = {"error": f"{type(e).__name__}: {e}"}
+        try:
             out["loadgen"] = bench_loadgen()
         except Exception as e:
             out["loadgen"] = {"error": f"{type(e).__name__}: {e}"}
@@ -356,6 +360,14 @@ def _compact(out: dict) -> dict:
          g("sticky", "sticky_prefill_tok_saved_x")),
         ("sticky_p50_ttft_ms", g("sticky", "sticky_p50_ttft_ms")),
         ("migrate_x_cold_ttft", g("sticky", "migrate_x_cold_ttft")),
+        # fleet prefix store (round 19): computed-prefill ratio of a
+        # peer-warmed cold host over a cold control on the same
+        # new-session turn (<1 = digest-keyed peer fetch turned the
+        # shared system prompt into cache hits), the bulk-warmup wall
+        # time, and how many pages moved
+        ("kvf_peer_x_cold", g("kv_fleet", "kvf_peer_x_cold")),
+        ("kvf_warmup_ms", g("kv_fleet", "kvf_warmup_ms")),
+        ("kvf_peer_pages", g("kv_fleet", "kvf_peer_pages")),
         # loadgen measurement harness (round 17): the scored smoke-mix
         # run's capacity headline — goodput, achieved-vs-offered, p99
         # TTFT and error rate under the standing scenario
@@ -1114,6 +1126,161 @@ def bench_sticky_routing():
             "migrate_ttft_ms": round(m_out["timing"]["ttft_ms"], 3),
             "cold_ttft_ms": round(cold["timing"]["ttft_ms"], 3),
             "migrate_x_cold_ttft": migrate_x_cold,
+        }
+    finally:
+        for srv in all_srvs:
+            srv.shutdown()
+            srv.runner.shutdown()
+
+
+def bench_kv_fleet():
+    """Content-addressed peer fetch (round 19): a cold host joining a
+    warm fleet vs the same host prefilling cold.
+
+    One warm host-tier backend (mirror-on, so freshly registered
+    prefix pages are advertised as chain digests on /cachez) serves a
+    deterministic multi-turn chat trace whose sessions share one
+    system prompt. A stone-cold second backend then joins behind a
+    FleetRouter and ``maybe_peer_warm`` bulk-fetches the fleet's chain
+    tips into it over ``GET /kv/pages?digest=`` — ``kvf_warmup_ms`` is
+    that whole pull. The headline is computed-prefill tokens
+    (Δprompt - Δhit from /cachez) for a NEW session's first turn on
+    the peer-warmed host over the same turn on a fresh cold control
+    engine: ``kvf_peer_x_cold`` < 1 means the fetched pages turned the
+    shared system prompt into cache hits instead of recomputed
+    prefill."""
+    import threading
+    import urllib.request
+
+    from shifu_tpu.fleet import BackendClient, FleetRouter
+    from shifu_tpu.infer import SampleConfig, make_server
+    from shifu_tpu.infer.engine import PagedEngine
+    from shifu_tpu.loadgen.workload import chat_trace
+    from shifu_tpu.models.transformer import Transformer, TransformerConfig
+    from shifu_tpu.obs import FlightRecorder, MetricsRegistry
+
+    cfg = TransformerConfig.small()
+    model = Transformer(cfg)
+    params = model.init(jax.random.key(0))
+    system_tok, turn_tok, max_new = 96, 16, 8
+
+    trace = chat_trace(sessions=3, turns=2, system_tokens=system_tok,
+                       turn_tokens=turn_tok, max_new_tokens=max_new,
+                       seed=5)
+
+    def post(port, body):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/completions",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=300) as r:
+            return json.loads(r.read())
+
+    def cz(client):
+        client.refresh_cachez()
+        pc = (client.cache or {}).get("prefix_cache") or {}
+        return (int(pc.get("prompt_tokens", 0)),
+                int(pc.get("hit_tokens", 0)))
+
+    def computed(client, base):
+        p0, h0 = base
+        p1, h1 = cz(client)
+        return (p1 - p0) - (h1 - h0)
+
+    all_srvs = []
+    try:
+        def mk_back():
+            """One host-tier backend with eager digest advertisement
+            (kv_mirror: registration spills through to the host store,
+            which is what /cachez advertises), buckets pre-warmed on a
+            disjoint token alphabet so no phase pays compiles."""
+            eng = PagedEngine(
+                model, params, max_slots=4, max_len=256, page_size=16,
+                prefill_buckets=(32, 256), enable_prefix_cache=True,
+                kv_host_bytes=256 << 20, kv_mirror=True,
+                sample_cfg=SampleConfig(temperature=0.0),
+            )
+            srv = make_server(eng, port=0)
+            threading.Thread(
+                target=srv.serve_forever, daemon=True
+            ).start()
+            all_srvs.append(srv)
+            client = BackendClient(f"127.0.0.1:{srv.server_port}")
+            client.probe()
+            client.models()
+            client.refresh_cachez()
+            for n in (96 + turn_tok, 32):
+                post(srv.server_port, {
+                    "tokens": [130 + (n + j) % 113 for j in range(n)],
+                    "max_new_tokens": 2,
+                })
+            return srv, client
+
+        # Phase 1: the warm host serves the whole trace.
+        w_srv, w_client = mk_back()
+        base = cz(w_client)
+        for r in trace:
+            post(w_srv.server_port, r.body)
+        warm_computed = computed(w_client, base)
+        w_client.refresh_cachez()
+        assert w_client.held_digests(), (
+            "warm backend advertised no digests — peer warming has "
+            "nothing to fetch"
+        )
+
+        # Phase 2: a stone-cold host joins the fleet and is bulk-
+        # warmed from its peer (the autoscale-join path build_fleet
+        # and the prober tick run).
+        c_srv, c_client = mk_back()
+        router = FleetRouter(
+            [w_client, c_client], metrics=MetricsRegistry(),
+            flight=FlightRecorder(),
+        )
+        t0 = time.perf_counter()
+        moved = router.maybe_peer_warm()
+        warmup_ms = (time.perf_counter() - t0) * 1000.0
+        assert moved > 0, "peer warmup moved no chains"
+        ps = router.peer_stats()
+
+        # A NEW session's first turn: the shared system prompt plus a
+        # fresh tail — on the peer-warmed host the system pages are
+        # already in its tiers.
+        system = list(trace[0].body["tokens"][:system_tok])
+        turn = {
+            "tokens": system + [131 + (j * 7) % 109
+                                for j in range(turn_tok)],
+            "max_new_tokens": max_new,
+        }
+        base = cz(c_client)
+        peer_out = post(c_srv.server_port, turn)
+        peer_computed = computed(c_client, base)
+
+        # Cold control: the identical turn on a fresh engine that
+        # never met the fleet — the full prompt prefills from scratch.
+        k_srv, k_client = mk_back()
+        base = cz(k_client)
+        cold_out = post(k_srv.server_port, turn)
+        cold_computed = computed(k_client, base)
+        assert peer_out["tokens"] == cold_out["tokens"], (
+            "peer-warmed decode diverged from cold decode"
+        )
+
+        return {
+            "system_tokens": system_tok,
+            "kvf_trace_prefill_tokens": warm_computed,
+            "kvf_peer_prefill_tokens": peer_computed,
+            "kvf_cold_prefill_tokens": cold_computed,
+            "kvf_peer_x_cold": round(
+                peer_computed / max(cold_computed, 1), 4
+            ),
+            "kvf_warmup_ms": round(warmup_ms, 3),
+            "kvf_warmup_chains": moved,
+            "kvf_peer_pages": ps["pages"],
+            "kvf_peer_bytes": ps["bytes"],
+            "kvf_peer_fetches": ps["fetches"],
+            "kvf_peer_failures": ps["failures"],
         }
     finally:
         for srv in all_srvs:

@@ -1018,10 +1018,48 @@ def build_serve_engine(args, model, params, tok):
             )
         kv_kw["kv_host_bytes"] = args.kv_host_bytes
         kv_kw["kv_export_slots"] = kv_slots
+        # Disk tier below the host tier (--kv-disk-bytes/--kv-disk-dir):
+        # validated HERE so a bad path refuses at startup with a fix
+        # hint, not as a DiskKVStore ValueError mid-construction.
+        kv_disk = getattr(args, "kv_disk_bytes", 0) or 0
+        disk_dir = getattr(args, "kv_disk_dir", None)
+        if kv_disk:
+            if not disk_dir:
+                raise ValueError(
+                    "--kv-disk-bytes needs --kv-disk-dir: the disk "
+                    "tier persists SKVP segment files there; fix: add "
+                    "--kv-disk-dir /path/to/kv"
+                )
+            if not os.path.isdir(disk_dir):
+                raise ValueError(
+                    f"--kv-disk-dir {disk_dir} does not exist (the "
+                    "tier reuses surviving segments, so it never "
+                    f"mkdirs an operator path); fix: mkdir -p {disk_dir}"
+                )
+            if not os.access(disk_dir, os.W_OK):
+                raise ValueError(
+                    f"--kv-disk-dir {disk_dir} is not writable by "
+                    "this process; fix: chmod/chown the directory"
+                )
+            kv_kw["kv_disk_bytes"] = kv_disk
+            kv_kw["kv_disk_dir"] = disk_dir
+        elif disk_dir:
+            raise ValueError(
+                "--kv-disk-dir without --kv-disk-bytes does nothing; "
+                "fix: add --kv-disk-bytes 16g (or drop the dir)"
+            )
     elif getattr(args, "kv_export_slots", 64) != 64:
         raise ValueError(
             "--kv-export-slots sizes the /kv/pages export table, which "
             "only exists with --kv-tier host"
+        )
+    elif getattr(args, "kv_disk_bytes", 0) or getattr(
+        args, "kv_disk_dir", None
+    ):
+        raise ValueError(
+            "--kv-disk-bytes/--kv-disk-dir add a disk tier BELOW the "
+            "host tier; fix: add --kv-tier host (with --paged "
+            "--prefix-cache)"
         )
 
     # Disaggregation roles (serve --role, docs/architecture.md). A
@@ -2017,6 +2055,19 @@ def main(argv=None) -> int:
                         help="host-tier byte budget (LRU beyond it); "
                              "accepts 512m/4g/… suffixes "
                              "(--kv-tier host only)")
+        sp.add_argument("--kv-disk-bytes", type=_size_bytes,
+                        default=0,
+                        help="disk tier below the host tier: evicted "
+                             "host entries demote to mmap'd SKVP "
+                             "segment files (LRU beyond the budget), "
+                             "torn segments are refused by checksum "
+                             "and survivors are reused after a "
+                             "restart; accepts 512m/4g/… suffixes "
+                             "(needs --kv-tier host and --kv-disk-dir)")
+        sp.add_argument("--kv-disk-dir",
+                        help="directory for the disk tier's segment "
+                             "files (must exist and be writable; one "
+                             "engine per directory)")
         sp.add_argument("--kv-export-slots", type=int, default=64,
                         help="live /kv/pages export records kept for "
                              "peer pickup (rid -> page chain, FIFO "

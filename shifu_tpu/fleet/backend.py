@@ -559,13 +559,46 @@ class BackendClient:
         torn frame — raises a *retryable* :class:`BackendError`: a
         failed handoff is never fatal to the request, the router just
         serves it colocated (cold prefill, PR-5 behavior)."""
+        return self._kv_fetch(f"rid={int(rid)}", trace_header)
+
+    def kv_pages_digest(self, digest: str,
+                        trace_header: Optional[str] = None) -> bytes:
+        """GET /kv/pages?digest= — content-addressed peer fetch: the
+        SKVP frame holding the full page chain ending at ``digest``
+        (a sha256 chain key this host advertised in its /cachez
+        ``digests.held`` block). Same validation and same always-
+        retryable failure contract as the rid-keyed fetch — a failed
+        peer fetch just means the requester prefills cold."""
+        from urllib.parse import quote
+
+        return self._kv_fetch(
+            f"digest={quote(str(digest))}", trace_header
+        )
+
+    def held_digests(self) -> dict:
+        """(digest hex → parent hex | None) this backend advertised in
+        its last /cachez scrape — the router folds these into the
+        fleet digest map. Empty when unscrapped or tier-less."""
+        dg = (self.cache or {}).get("digests") or {}
+        out = {}
+        for row in dg.get("held") or ():
+            try:
+                k, parent = row[0], row[1]
+            except (IndexError, TypeError):
+                continue
+            if isinstance(k, str):
+                out[k] = parent if isinstance(parent, str) else None
+        return out
+
+    def _kv_fetch(self, query: str,
+                  trace_header: Optional[str] = None) -> bytes:
         from shifu_tpu.infer.kvtier import (
             WireFormatError, deserialize_pages,
         )
 
         hdrs = {"x-shifu-trace": trace_header} if trace_header else None
         conn, resp = self._request(
-            "GET", f"/kv/pages?rid={int(rid)}", None,
+            "GET", f"/kv/pages?{query}", None,
             self.cfg.read_timeout_s, headers=hdrs,
         )
         try:

@@ -193,6 +193,15 @@ class FleetProber(threading.Thread):
             # pressure and gates migration on the host tier, both read
             # from this cached doc — never a per-request scrape.
             b.refresh_cachez()
+        # With every due backend's digest advertisement fresh, warm
+        # any stone-cold joiner from its peers (a no-op almost every
+        # tick: each backend is bulk-warmed at most once).
+        warm = getattr(self.router, "maybe_peer_warm", None)
+        if warm is not None and not self._stop_ev.is_set():
+            try:
+                warm()
+            except Exception:  # noqa: BLE001 — warming is best-effort
+                pass
 
     def run(self) -> None:
         while not self._stop_ev.wait(self.interval_s):
@@ -236,6 +245,13 @@ def build_fleet(
     roles = {b.addr: FleetRouter._role(b) for b in backends}
     if any(r != "both" for r in roles.values()):
         router.flight.record("fleet_roles", roles=roles)
+    # Cold hosts joining a fleet that already holds shared prefixes
+    # warm from their peers NOW, not a prober interval later — a
+    # freshly autoscaled backend's first request should prefill warm.
+    try:
+        router.maybe_peer_warm()
+    except Exception:  # noqa: BLE001 — warming is best-effort
+        pass
     prober = FleetProber(router, interval_s=probe_interval_s)
     router.prober = prober
     if start_prober:

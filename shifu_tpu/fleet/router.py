@@ -240,6 +240,26 @@ class FleetRouter:
         self.migrate_breakeven_losses = 0  # wire lost -> cold prefill
         self.migrate_bytes = 0            # SKVP payload bytes moved
 
+        # Fleet-wide content-addressed peer fetch (the tier-3 store):
+        # each backend advertises its held chain digests in /cachez;
+        # the router folds them into a fleet digest map and, before a
+        # cold attempt, pulls the prompt's deepest held prefix from
+        # whichever peer holds it via GET /kv/pages?digest=. Gated per
+        # SOURCE by a measured fetch-bandwidth EMA against the
+        # destination's own prefill rate — unmeasured sources explore.
+        self.peer_fetches = 0             # fetch+ingest completed
+        self.peer_failures = 0            # either leg errored -> cold
+        self.peer_breakeven_losses = 0    # wire lost -> never attempted
+        self.peer_pages = 0               # KV pages moved peer-to-peer
+        self.peer_bytes = 0               # SKVP payload bytes moved
+        self.peer_warmups = 0             # chains moved by cold-host warming
+        self._peer_bw: Dict[str, float] = {}   # src addr -> bytes/ms EMA
+        self._peer_lock = threading.Lock()
+        self._peer_warmed: set = set()         # addrs already bulk-warmed
+        self._peer_warm_strikes: Dict[str, int] = {}  # all-failed rounds
+        self._digest_map: Dict[str, List[BackendClient]] = {}
+        self._digest_map_sig = None
+
         # Distributed tracing (obs/disttrace.py): the router is a hop —
         # it records router_hop/resubmit spans in its own store, keyed
         # by a host label naming this process, and assembles fleet-wide
@@ -365,6 +385,27 @@ class FleetRouter:
             "shifu_migrate_seconds",
             "Session KV-migration wall time (fetch + ingest, one "
             "timed unit — the breakeven EMAs' sample)",
+        ).labels()
+        # shifu_kv_peer_* family: content-addressed peer page fetches
+        # (docs/observability.md). All labels pre-seeded.
+        self._c_peer = reg.counter(
+            "shifu_kv_peer_fetches_total",
+            "Digest-keyed peer KV fetches by outcome: ok (chain "
+            "fetched from the holder and ingested into the target), "
+            "failed (either leg errored — the target prefills cold), "
+            "breakeven_loss (the source's measured fetch bandwidth "
+            "predicted slower than the target recomputing — never "
+            "attempted)", labelnames=("outcome",),
+        )
+        for oc in ("ok", "failed", "breakeven_loss"):
+            self._c_peer.labels(outcome=oc)
+        self._c_peer_pages = reg.counter(
+            "shifu_kv_peer_pages_total",
+            "KV pages moved by completed peer fetches",
+        ).labels()
+        self._c_peer_bytes = reg.counter(
+            "shifu_kv_peer_bytes_total",
+            "SKVP payload bytes moved by completed peer fetches",
         ).labels()
         # shifu_rollout_* families: rolling-weight-rollout progress as
         # reported by the rollout controller via POST /rolloutz
@@ -698,6 +739,12 @@ class FleetRouter:
                 ))
                 return
             self._session_outcome(req, "new")
+            if attempt == 0:
+                # Content-addressed peer warm-up for the chosen host:
+                # if a peer advertises this prompt's prefix and b does
+                # not hold it, pull the chain before prefilling (best-
+                # effort; a fault just means a cold prefill).
+                self._peer_prefill(req, b)
             self._attach(req, b)
             try:
                 err = self._run_stream(req, b,
@@ -1340,6 +1387,244 @@ class FleetRouter:
         )
         return True
 
+    # ------------------------ content-addressed peer fetch (tier 3)
+    def fleet_digest_map(self) -> Dict[str, List[BackendClient]]:
+        """Digest hex -> backends holding it, folded from each
+        backend's cached /cachez ``digests`` advertisement. Rebuilt
+        only when some backend's scrape timestamp moved (the prober
+        refreshes /cachez every tick) — reading the map never blocks
+        on the wire."""
+        sig = tuple((b.addr, b.cache_ts) for b in self.backends)
+        with self._peer_lock:
+            if sig == self._digest_map_sig:
+                return self._digest_map
+        m: Dict[str, List[BackendClient]] = {}
+        for b in self.backends:
+            if b.detached:
+                continue
+            for d in b.held_digests():
+                m.setdefault(d, []).append(b)
+        with self._peer_lock:
+            self._digest_map = m
+            self._digest_map_sig = sig
+        return m
+
+    def _peer_page_sizes(self) -> List[int]:
+        """Distinct page sizes advertised across the fleet — chain
+        digests are page-size-dependent, so the prompt's keys must be
+        computed per advertised geometry (typically one value)."""
+        sizes: List[int] = []
+        for b in self.backends:
+            dg = (b.cache or {}).get("digests") or {}
+            try:
+                ps = int(dg.get("page_size") or 0)
+            except (TypeError, ValueError):
+                ps = 0
+            if ps > 0 and ps not in sizes:
+                sizes.append(ps)
+        return sizes
+
+    def _peer_wins(self, src: BackendClient, tokens: int,
+                   dst: BackendClient) -> bool:
+        """Measured fetch-vs-recompute breakeven, per SOURCE: this
+        source's fetch bytes/ms EMA against the destination
+        recomputing the prefill itself (its ``prefill_tok_per_ms``
+        from the last probe); the bytes estimate rides the shared
+        bytes/token EMA. Any side unmeasured -> True (explore — same
+        policy as every other breakeven gate in this file)."""
+        bpm = self._peer_bw.get(src.addr)
+        bpt = self._xfer_bytes_per_token
+        rate = None
+        if dst.health:
+            try:
+                r = dst.health.get("prefill_tok_per_ms")
+                rate = float(r) if r else None
+            except (TypeError, ValueError):
+                rate = None
+        if not bpm or not bpt or not rate:
+            return True
+        return (tokens * bpt) / bpm < tokens / rate
+
+    def _peer_prefill(self, req: _FleetRequest,
+                      dst: BackendClient) -> None:
+        """Before a cold attempt on ``dst``: if some OTHER backend
+        advertises a prefix of this prompt (deepest chain digest wins)
+        and dst does not already hold it, fetch the chain digest-keyed
+        from the holder and ingest it into dst so the prompt prefills
+        warm. Strictly best-effort — any fault leaves the request
+        exactly as cold as it already was."""
+        try:
+            if not dst.has_host_tier():
+                return
+            m = self.fleet_digest_map()
+            toks = req.body.get("tokens") or ()
+            if not m or not toks:
+                return
+            mine = dst.held_digests()
+            salt = self._affinity_salt(req.body)
+            for ps in self._peer_page_sizes():
+                if len(toks) < ps:
+                    continue
+                keys = chain_keys(toks, ps, salt)
+                for i in range(len(keys) - 1, -1, -1):
+                    d = keys[i].hex()
+                    if d in mine:
+                        return  # dst's deepest prefix >= the fleet's
+                    holders = [
+                        h for h in m.get(d, ())
+                        if h is not dst and h.routable()
+                    ]
+                    if holders:
+                        self._peer_fetch(
+                            req, holders[0], dst, d, (i + 1) * ps
+                        )
+                        return
+        except Exception:  # noqa: BLE001 — never block the request
+            pass
+
+    def _peer_fetch(self, req: Optional[_FleetRequest],
+                    src: BackendClient, dst: BackendClient,
+                    digest: str, covered: int, *,
+                    gate: bool = True) -> bool:
+        """One digest-keyed fetch+ingest, src -> dst (one timed unit
+        that teaches the per-source bandwidth EMA and the shared
+        transfer EMAs). False on a breakeven loss or either leg
+        failing — the caller proceeds cold either way."""
+        if gate and not self._peer_wins(src, covered, dst):
+            with self._lock:
+                self.peer_breakeven_losses += 1
+            self._c_peer.labels(outcome="breakeven_loss").inc()
+            return False
+        trace_hdr = (
+            req.trace.child().to_header()
+            if req is not None and req.trace is not None else None
+        )
+        x0 = time.monotonic()
+        leg = src
+        try:
+            payload = src.kv_pages_digest(digest,
+                                          trace_header=trace_hdr)
+            leg = dst
+            out = dst.kv_ingest(payload, trace_header=trace_hdr)
+        except BackendError as e:
+            # Attribute the failure to the host whose leg broke, like
+            # session migration does.
+            leg.breaker.record_failure()
+            with self._lock:
+                self.peer_failures += 1
+            self._c_peer.labels(outcome="failed").inc()
+            self.flight.record(
+                "kv_peer_fetch_failed", src=src.addr, dst=dst.addr,
+                digest=digest, at=leg.addr, error=str(e),
+            )
+            return False
+        ms = (time.monotonic() - x0) * 1000.0
+        pages = int(out.get("pages", 0) or 0)
+        a = 0.2
+        bpm = len(payload) / max(ms, 1e-9)
+        cur = self._peer_bw.get(src.addr)
+        self._peer_bw[src.addr] = (
+            bpm if cur is None else (1 - a) * cur + a * bpm
+        )
+        self._note_xfer(len(payload), ms, covered)
+        with self._lock:
+            self.peer_fetches += 1
+            self.peer_pages += pages
+            self.peer_bytes += len(payload)
+        self._c_peer.labels(outcome="ok").inc()
+        self._c_peer_pages.inc(float(pages))
+        self._c_peer_bytes.inc(float(len(payload)))
+        self.flight.record(
+            "kv_peer_fetch", src=src.addr, dst=dst.addr,
+            digest=digest, pages=pages, nbytes=len(payload),
+            ms=round(ms, 3), tokens=covered,
+        )
+        return True
+
+    def maybe_peer_warm(self, limit: int = 8) -> int:
+        """Warm every stone-cold host-tier backend from its peers: a
+        scraped backend advertising NO digests (fresh bootstrap or
+        autoscale join) gets the fleet's chain TIPS (held digests that
+        are no other held digest's parent — each tip's export carries
+        its whole chain) pushed into its tiers, once per backend. No
+        breakeven gate — warming is explicitly exploratory and runs
+        off the request path (prober tick / build_fleet). A backend is
+        marked warmed when a chain lands or there was nothing to fetch;
+        a warmup whose every fetch FAILED (e.g. a timeout during the
+        startup scramble) stays eligible, so the next prober tick
+        retries instead of leaving the host cold forever — bounded at
+        three all-failed rounds, so a deterministic refusal (a
+        page-size-mismatched fleet) cannot flap the destination's
+        breaker every tick from here. Returns the number of chains
+        moved."""
+        m = self.fleet_digest_map()
+        if not m:
+            return 0
+        moved = 0
+        for dst in self.backends:
+            if (dst.addr in self._peer_warmed or dst.detached
+                    or not dst.routable() or not dst.has_host_tier()
+                    or dst.held_digests()):
+                continue
+            parents = set()
+            for b in self.backends:
+                for par in b.held_digests().values():
+                    if par:
+                        parents.add(par)
+            tips = [d for d in m if d not in parents]
+            got = 0
+            attempted = 0
+            for d in tips:
+                if got >= int(limit):
+                    break
+                holders = [
+                    h for h in m.get(d, ())
+                    if h is not dst and h.routable()
+                ]
+                if not holders:
+                    continue
+                attempted += 1
+                if self._peer_fetch(
+                    None, holders[0], dst, d, 0, gate=False
+                ):
+                    got += 1
+            if got or not attempted:
+                self._peer_warmed.add(dst.addr)
+                self._peer_warm_strikes.pop(dst.addr, None)
+            else:
+                strikes = self._peer_warm_strikes.get(dst.addr, 0) + 1
+                self._peer_warm_strikes[dst.addr] = strikes
+                if strikes >= 3:
+                    self._peer_warmed.add(dst.addr)
+                    self.flight.record(
+                        "kv_peer_warmup_abandoned", backend=dst.addr,
+                        strikes=strikes,
+                    )
+            if got:
+                moved += got
+                with self._lock:
+                    self.peer_warmups += got
+                dst.refresh_cachez()
+                self.flight.record(
+                    "kv_peer_warmup", backend=dst.addr, chains=got,
+                )
+        return moved
+
+    def peer_stats(self) -> dict:
+        """The /cachez ``peer`` block (and ``obs top``'s peer line):
+        content-addressed fetch totals plus which backends were
+        bulk-warmed on join."""
+        with self._lock:
+            return {
+                "fetches": self.peer_fetches,
+                "failures": self.peer_failures,
+                "breakeven_losses": self.peer_breakeven_losses,
+                "pages": self.peer_pages,
+                "bytes": self.peer_bytes,
+                "warmups": self.peer_warmups,
+                "warmed_backends": sorted(self._peer_warmed),
+            }
+
     def session_stats(self) -> Optional[dict]:
         """The /statz ``session`` block (and ``obs top``'s session
         line): affinity-table occupancy, per-outcome request counts,
@@ -1547,7 +1832,12 @@ class FleetRouter:
                 out[b.addr] = b.cachez()
             except Exception as e:  # noqa: BLE001 — per-backend fault
                 out[b.addr] = {"error": str(e)}
-        return {"backends": out}
+        doc = {"backends": out}
+        # Duck-typed callers (tests drive this unbound on fakes) may
+        # not carry the peer-fetch surface.
+        if isinstance(getattr(self, "_peer_warmed", None), set):
+            doc["peer"] = self.peer_stats()
+        return doc
 
     def queue_depths(self) -> Dict[str, int]:
         """Per-tier backlog at THIS router: accepted requests whose
@@ -1589,6 +1879,12 @@ class FleetRouter:
             "disagg_handoffs": self.disagg_handoffs,
             "disagg_fallbacks": self.disagg_fallbacks,
             "disagg_breakeven_losses": self.disagg_breakeven_losses,
+            "peer_fetches": self.peer_fetches,
+            "peer_failures": self.peer_failures,
+            "peer_breakeven_losses": self.peer_breakeven_losses,
+            "peer_pages": self.peer_pages,
+            "peer_bytes": self.peer_bytes,
+            "peer_warmups": self.peer_warmups,
         }
         if self.sticky_sessions:
             with self._lock:

@@ -1291,6 +1291,13 @@ class Engine:
         404s); only PagedEngine with a host tier produces payloads."""
         return None
 
+    def kv_export_digest(self, digest: str, trace: Optional[dict] = None):
+        """Serialized KV page chain for a content-addressed prefix —
+        the ``GET /kv/pages?digest=`` surface (fleet-wide peer fetch).
+        None = digest not held (the server 404s); only PagedEngine
+        with a host tier produces payloads."""
+        return None
+
     def kv_ingest(self, payload, trace: Optional[dict] = None) -> dict:
         """Ingest a peer host's serialized KV page chain — the ``POST
         /kv/pages`` surface. Engines without a host KV tier refuse
@@ -2653,6 +2660,14 @@ class _RestoreJob:
     future: object = None
     device_pages: Optional[List] = None
     ms: float = 0.0
+    # Two-tier restores: per-link source ("host"|"disk"), per-link
+    # chain provenance (parent, page_tokens, adapter) adopted into
+    # _prefix_meta, and the portion of ms spent reading disk segments
+    # (subtracted before feeding the host restore-bandwidth EMA — the
+    # EMA measures the PCIe leg, the disk store measures its own).
+    sources: Optional[List[str]] = None
+    link_meta: Optional[List] = None
+    disk_ms: float = 0.0
 
 
 class PagedEngine(Engine):
@@ -2708,6 +2723,10 @@ class PagedEngine(Engine):
         kv_scale_dtype=jnp.float32,
         kv_host_bytes: int = 0,
         kv_export_slots: int = 64,
+        kv_disk_bytes: int = 0,
+        kv_disk_dir: Optional[str] = None,
+        kv_mirror: Optional[bool] = None,
+        kv_advertise_digests: int = 256,
         **kw,
     ):
         """``prefill_chunk``: when set, prompts longer than this many
@@ -2733,7 +2752,21 @@ class PagedEngine(Engine):
         (rid → page chain, FIFO-evicted). The default 64 suits the
         disaggregation handoff's fetch-immediately pattern; fleets
         doing session migration hold records for a whole turn's
-        think-time and size it up (``--kv-export-slots``)."""
+        think-time and size it up (``--kv-export-slots``).
+
+        ``kv_disk_bytes`` / ``kv_disk_dir``: when > 0 (requires the
+        host tier), spilled pages also persist as crash-safe SKVP
+        segment files under ``kv_disk_dir`` — the tier below host RAM
+        (:class:`~shifu_tpu.infer.kvtier.DiskKVStore`). Host-tier
+        budget evictions demote there instead of vanishing, restores
+        walk chains that span both tiers, and intact segments are
+        re-indexed after a restart (docs/kv_tiering.md, disk tier).
+
+        ``kv_mirror``: eagerly spill freshly registered prefix pages
+        into the tiers (the page stays device-resident) so the host
+        can ADVERTISE and SERVE them to peers before any eviction —
+        default on whenever the disk tier is on. ``kv_advertise_digests``
+        caps the ``/cachez`` digest summary."""
         if getattr(model, "prefill_needs_mask", False):
             raise ValueError(
                 "recurrent models carry O(1) state per slot — a paged KV "
@@ -2862,16 +2895,58 @@ class PagedEngine(Engine):
                 "zero slots would evict every export before its peer "
                 "ever fetched it"
             )
+        self.kv_disk_bytes = int(kv_disk_bytes or 0)
+        self.kv_disk_dir = kv_disk_dir
+        self.kv_advertise_digests = int(kv_advertise_digests)
         self._kv_store = None
+        self._kv_disk = None
+        if self.kv_disk_bytes and not self.kv_host_bytes:
+            raise ValueError(
+                "kv_disk_bytes needs kv_host_bytes: the disk tier sits "
+                "below the host tier (demotions come from it, restores "
+                "promote through it)"
+            )
+        if self.kv_disk_bytes and not self.kv_disk_dir:
+            raise ValueError(
+                "kv_disk_bytes needs kv_disk_dir: somewhere to keep "
+                "the SKVP segment files"
+            )
+        # Eager mirroring defaults on with the disk tier: a page only
+        # the device holds can be neither advertised nor served to a
+        # peer, and would not survive a crash.
+        self._kv_mirror = (
+            bool(kv_mirror) if kv_mirror is not None
+            else bool(self.kv_disk_bytes)
+        )
+        if self._kv_mirror and not self.kv_host_bytes:
+            raise ValueError(
+                "kv_mirror needs kv_host_bytes: mirroring spills "
+                "registered pages into the host tier"
+            )
         if self.kv_host_bytes:
             if not enable_prefix_cache:
                 raise ValueError(
                     "kv_host_bytes needs enable_prefix_cache: the host "
                     "tier is keyed by prefix-chain digests"
                 )
-            from shifu_tpu.infer.kvtier import HostKVStore
+            from shifu_tpu.infer.kvtier import DiskKVStore, HostKVStore
 
-            self._kv_store = HostKVStore(self.kv_host_bytes)
+            if self.kv_disk_bytes:
+                self._kv_disk = DiskKVStore(
+                    self.kv_disk_bytes, self.kv_disk_dir
+                )
+            self._kv_store = HostKVStore(
+                self.kv_host_bytes,
+                on_evict=(
+                    self._kv_demote
+                    if self._kv_disk is not None else None
+                ),
+            )
+            # Chain provenance of DEVICE-resident registered pages:
+            # key -> (parent, page_tokens, adapter). Spills read it so
+            # host/disk entries are self-describing (content-addressed
+            # export walks parents; disk segments survive restarts).
+            self._prefix_meta: Dict[bytes, tuple] = {}
             self._kv_worker = ThreadPoolExecutor(
                 max_workers=1, thread_name_prefix="kvtier"
             )
@@ -2971,6 +3046,33 @@ class PagedEngine(Engine):
         self._kv_metric_mark = {
             "spills": 0, "restores": 0, "hits": 0, "recomputes": 0,
         }
+        # Disk tier (zero-valued series when off, like the host tier).
+        self._c_kv_disk = {
+            k: m.counter(
+                f"shifu_kv_disk_{k}_total", desc, labelnames=("replica",)
+            ).labels(replica=r)
+            for k, desc in (
+                ("spills", "KV pages written as disk-tier segments"),
+                ("restores", "Disk-tier segment reads that validated"),
+                ("evictions", "Disk-tier segments dropped by the LRU "
+                              "byte budget"),
+                ("torn", "Torn/corrupt segments refused by the SKVP "
+                         "crc contract (startup scan or read)"),
+            )
+        }
+        self._g_kv_disk_bytes = m.gauge(
+            "shifu_kv_disk_bytes",
+            "Bytes of KV segment files resident in the disk tier",
+            labelnames=("replica",),
+        ).labels(replica=r)
+        self._g_kv_disk_segments = m.gauge(
+            "shifu_kv_disk_segments",
+            "Segment files indexed in the disk tier",
+            labelnames=("replica",),
+        ).labels(replica=r)
+        self._kv_disk_metric_mark = {
+            "spills": 0, "restores": 0, "evictions": 0, "torn": 0,
+        }
         # KV-over-the-wire transfer families (prefill/decode
         # disaggregation — docs/observability.md). Incremented directly
         # from the /kv/pages handler threads (plain float adds under
@@ -3011,6 +3113,21 @@ class PagedEngine(Engine):
                 if delta:
                     self._c_kv[k].inc(delta)
                     self._kv_metric_mark[k] = s[stat]
+        disk = getattr(self, "_kv_disk", None)
+        if disk is not None:
+            d = disk.stats()
+            self._g_kv_disk_bytes.set(d["bytes_used"])
+            self._g_kv_disk_segments.set(d["segments"])
+            for k, stat in (
+                ("spills", "spilled_pages"),
+                ("restores", "restored_pages"),
+                ("evictions", "evictions"),
+                ("torn", "torn_refused"),
+            ):
+                delta = d[stat] - self._kv_disk_metric_mark[k]
+                if delta:
+                    self._c_kv_disk[k].inc(delta)
+                    self._kv_disk_metric_mark[k] = d[stat]
 
     def counters(self) -> dict:
         out = super().counters()
@@ -3035,6 +3152,19 @@ class PagedEngine(Engine):
                 kv_tier_recomputes=s["recomputes"],
                 kv_tier_evictions=s["evictions"],
             )
+            disk = getattr(self, "_kv_disk", None)
+            if disk is not None:
+                d = disk.stats()
+                out.update(
+                    kv_disk_segments=d["segments"],
+                    kv_disk_bytes=d["bytes_used"],
+                    kv_disk_spilled_pages=d["spilled_pages"],
+                    kv_disk_restored_pages=d["restored_pages"],
+                    kv_disk_hits=d["hits"],
+                    kv_disk_evictions=d["evictions"],
+                    kv_disk_torn_refused=d["torn_refused"],
+                    kv_disk_resumed_segments=d["resumed_segments"],
+                )
             # Disaggregation surface: the wire-transfer lifecycle and
             # the measured prefill rate ride /healthz so the fleet
             # router's migrate-vs-cold-prefill breakeven can read the
@@ -3111,6 +3241,10 @@ class PagedEngine(Engine):
                 del self._prefix_lru[key]
                 self._page_key.pop(pg, None)
                 self._kv_spill(key, pg)
+                if self._kv_store is not None:
+                    # The spill captured the chain provenance; the
+                    # device-side record is done.
+                    self._prefix_meta.pop(key, None)
                 return pg
         return None
 
@@ -3130,6 +3264,11 @@ class PagedEngine(Engine):
         dev = self._kv_gather_jit(self.cache, np.int32(pg))
         gen = store.generation
         ps = self.page_size
+        # Chain provenance, captured on the engine thread while the
+        # registration is still live: lets the host/disk entries
+        # answer content-addressed exports and survive restarts.
+        meta = self._prefix_meta.get(key)
+        disk = self._kv_disk
 
         def work():
             t0 = time.monotonic()
@@ -3140,13 +3279,34 @@ class PagedEngine(Engine):
             nbytes = sum(
                 a.nbytes for a in jax.tree_util.tree_leaves(host)
             )
-            if store.put(key, host, tokens=ps, generation=gen):
+            parent, ptoks, adapter = (
+                meta if meta is not None else (None, None, 0)
+            )
+            if store.put(
+                key, host, tokens=ps, generation=gen,
+                parent=parent, page_tokens=ptoks, adapter=adapter,
+            ):
                 store.note_spill(nbytes, ms)
                 self.flight.record(
                     "kv_spill", replica=self.replica_label, page=pg,
                     bytes=nbytes, ms=round(ms, 3),
                     host_bytes=store.bytes_used,
                 )
+                if disk is not None and meta is not None:
+                    # Write-through: the segment lands on disk at spill
+                    # time, not eviction time — crash-safety for shared
+                    # prefixes requires the bytes to exist BEFORE the
+                    # process dies. Idempotent on an existing segment.
+                    flat, _ = jax.tree_util.tree_flatten_with_path(host)
+                    disk.put(
+                        key,
+                        {
+                            jax.tree_util.keystr(pth): np.asarray(a)
+                            for pth, a in flat
+                        },
+                        page_size=ps, page_tokens=ptoks,
+                        parent=parent, adapter=adapter, generation=gen,
+                    )
 
         fut = self._kv_worker.submit(work)
         self._kv_spill_futs.append(fut)
@@ -3155,6 +3315,36 @@ class PagedEngine(Engine):
                 f for f in self._kv_spill_futs if not f.done()
             ]
         return fut
+
+    def _kv_demote(self, entries) -> None:
+        """Host-tier budget evictions demote to the disk tier
+        (HostKVStore's ``on_evict``, invoked outside its lock on
+        whichever thread did the displacing put). The write-through
+        spill usually already landed the segment — ``DiskKVStore.put``
+        is idempotent then. Entries without chain provenance cannot
+        make self-describing segments and are simply dropped (they
+        also could not be served to a peer). ``ent.gen`` carries the
+        host generation at filing; host and disk clear back-to-back on
+        flush, so a stale demotion is refused by the disk store."""
+        disk = self._kv_disk
+        if disk is None:
+            return
+        for ent in entries:
+            if ent.page_tokens is None or ent.parent is None:
+                continue
+            flat, _ = jax.tree_util.tree_flatten_with_path(ent.arrays)
+            disk.put(
+                ent.key,
+                {
+                    jax.tree_util.keystr(pth): np.asarray(a)
+                    for pth, a in flat
+                },
+                page_size=self.page_size,
+                page_tokens=ent.page_tokens,
+                parent=ent.parent,
+                adapter=ent.adapter,
+                generation=ent.gen,
+            )
 
     def _kv_probe(self, req: "_Request", prompt, p: int) -> bool:
         """Host-tier admission gate, called before the device-chain
@@ -3184,11 +3374,21 @@ class PagedEngine(Engine):
         if key in self._kv_pending:
             self._kv_wait_flag = True
             return False  # restore already in flight for this prefix
-        # Collect the consecutive chain segment the store holds.
+        # Collect the consecutive chain segment the TIERS hold — a
+        # link may live in host RAM or (below it) on disk; the chain
+        # stays restorable as long as every link is in SOME tier.
         links: List[bytes] = []
+        sources: List[str] = []
+        disk = self._kv_disk
         lhit = hit
         lkey = key
-        while lhit + ps <= p - 1 and store.contains(lkey):
+        while lhit + ps <= p - 1:
+            if store.contains(lkey):
+                sources.append("host")
+            elif disk is not None and disk.contains(lkey):
+                sources.append("disk")
+            else:
+                break
             links.append(lkey)
             lhit += ps
             if lhit + ps <= p - 1:
@@ -3196,16 +3396,50 @@ class PagedEngine(Engine):
         if not links:
             return True  # plain miss: prefill as before
         tokens = len(links) * ps
-        nbytes = sum(store.entry_bytes(k) for k in links)
-        if not self._kv_restore_wins(tokens, nbytes):
+        host_bytes = sum(
+            store.entry_bytes(k)
+            for k, s in zip(links, sources) if s == "host"
+        )
+        disk_bytes = sum(
+            disk.entry_bytes(k)
+            for k, s in zip(links, sources) if s == "disk"
+        )
+        if not self._kv_tier_restore_wins(tokens, host_bytes, disk_bytes):
             if req.rid not in self._kv_recompute_rids:
                 self._kv_recompute_rids.add(req.rid)
                 store.note_recompute()
             return True  # measured breakeven says recompute
         store.note_hit()
-        self._kv_launch_restore(links, tokens, nbytes)
+        if "disk" in sources:
+            disk.note_hit()
+        self._kv_launch_restore(
+            links, tokens, host_bytes + disk_bytes, sources=sources
+        )
         self._kv_wait_flag = True
         return False
+
+    def _kv_tier_restore_wins(
+        self, tokens: int, host_bytes: int, disk_bytes: int
+    ) -> bool:
+        """Two-tier restore-vs-recompute breakeven. A host-only chain
+        IS the PR 9 decision (:meth:`_kv_restore_wins` — which tests
+        monkeypatch, so that path is delegated verbatim); a chain with
+        disk links adds the measured segment-read bandwidth to the
+        transfer estimate. Any unmeasured tier on the chain explores —
+        taking the restore is what produces the first sample."""
+        if not disk_bytes:
+            return self._kv_restore_wins(tokens, host_bytes)
+        rate = self._prefill_tok_per_ms
+        disk_bw = self._kv_disk.read_bytes_per_ms()
+        if rate is None or rate <= 0 or disk_bw is None or disk_bw <= 0:
+            return True
+        est = disk_bytes / disk_bw
+        if host_bytes:
+            bw = self._kv_store.restore_bytes_per_ms()
+            if bw is None or bw <= 0:
+                return True
+            est += host_bytes / bw
+        return est < (tokens / rate)
 
     def _kv_restore_wins(self, tokens: int, nbytes: int) -> bool:
         """MEASURED restore-vs-recompute breakeven: estimated transfer
@@ -3220,28 +3454,73 @@ class PagedEngine(Engine):
         return (nbytes / bw) < (tokens / rate)
 
     def _kv_launch_restore(
-        self, links: List[bytes], tokens: int, nbytes: int
+        self, links: List[bytes], tokens: int, nbytes: int,
+        sources: Optional[List[str]] = None,
     ) -> None:
-        """Start the async host→device transfer for a chain segment.
-        Snapshot the entries NOW (engine thread) so a concurrent
-        budget eviction cannot pull them out from under the worker."""
+        """Start the async (disk→)host→device transfer for a chain
+        segment. Host entries are snapshotted NOW (engine thread) so a
+        concurrent budget eviction cannot pull them out from under the
+        worker; disk links are read on the worker — the segment file
+        may be unlinked by a racing eviction, which the worker treats
+        as a failed job (the probe recomputes on the next step)."""
         store = self._kv_store
-        entries = [store.get(k) for k in links]
+        disk = self._kv_disk
+        srcs = list(sources) if sources is not None else ["host"] * len(links)
+        entries = [
+            store.get(k) if s == "host" else None
+            for k, s in zip(links, srcs)
+        ]
         job = _RestoreJob(
             keys=list(links), gen=self._kv_flush_gen, tokens=tokens,
-            link_bytes=[e.nbytes for e in entries],
+            link_bytes=[
+                (e.nbytes if e is not None else disk.entry_bytes(k))
+                for k, e in zip(links, entries)
+            ],
+            sources=srcs,
+            link_meta=[
+                (e.parent, e.page_tokens, e.adapter)
+                if e is not None else None
+                for e in entries
+            ],
         )
+        # Structure-only snapshot for rebuilding disk leaves into the
+        # cache pytree shape (taken on the engine thread: self.cache
+        # may be swapped while the worker runs).
+        flat, treedef = jax.tree_util.tree_flatten_with_path(self.cache)
+        names = [jax.tree_util.keystr(pth) for pth, _ in flat]
 
         def work():
             t0 = time.monotonic()
-            pages = [
-                jax.tree_util.tree_map(jax.device_put, e.arrays)
-                for e in entries
-            ]
+            disk_ms = 0.0
+            pages = []
+            for i, k in enumerate(job.keys):
+                e = entries[i]
+                if e is not None:
+                    tree = e.arrays
+                else:
+                    td = time.monotonic()
+                    got = disk.load(k)
+                    disk_ms += (time.monotonic() - td) * 1e3
+                    if got is None:
+                        raise RuntimeError(
+                            f"disk segment for {k.hex()} vanished or "
+                            "was torn between probe and restore"
+                        )
+                    ent_d, leaves = got
+                    job.link_meta[i] = (
+                        ent_d.parent, ent_d.page_tokens, ent_d.adapter
+                    )
+                    tree = jax.tree_util.tree_unflatten(
+                        treedef, [leaves[nm] for nm in names]
+                    )
+                pages.append(
+                    jax.tree_util.tree_map(jax.device_put, tree)
+                )
             for tree in pages:
                 for a in jax.tree_util.tree_leaves(tree):
                     a.block_until_ready()
             job.device_pages = pages
+            job.disk_ms = disk_ms
             job.ms = (time.monotonic() - t0) * 1e3
 
         job.future = self._kv_worker.submit(work)
@@ -3283,16 +3562,28 @@ class PagedEngine(Engine):
                     self._page_key[pg] = k
                     self._prefix_lru.pop(k, None)
                     self._prefix_lru[k] = None
+                    meta = job.link_meta[0] if job.link_meta else None
+                    if meta is not None and meta[1] is not None:
+                        self._prefix_meta[k] = meta
                     adopted += 1
                     nbytes += job.link_bytes[0]
                 job.keys.pop(0)
                 job.device_pages.pop(0)
                 job.link_bytes.pop(0)
+                if job.sources:
+                    job.sources.pop(0)
+                if job.link_meta:
+                    job.link_meta.pop(0)
             if adopted:
                 ps = self.page_size
+                # Host restore-bandwidth EMA measures the PCIe leg
+                # only: the worker's disk-read time is subtracted so
+                # disk-sourced chains don't poison the host breakeven
+                # (the disk store timed its own leg inside load()).
                 self._kv_store.note_restore(
                     adopted, nbytes, adopted * ps,
-                    job.ms + (time.monotonic() - t0) * 1e3,
+                    max(0.0, job.ms - job.disk_ms)
+                    + (time.monotonic() - t0) * 1e3,
                 )
                 self.flight.record(
                     "kv_restore", replica=self.replica_label,
@@ -3301,6 +3592,7 @@ class PagedEngine(Engine):
                 )
             if job.keys:  # re-key the remainder under its new head
                 job.ms = 0.0
+                job.disk_ms = 0.0
                 self._kv_pending[job.keys[0]] = job
 
     def _kv_note_prefill(self, tokens: int, ms: float) -> None:
@@ -3404,17 +3696,26 @@ class PagedEngine(Engine):
         pages: List[Dict[str, np.ndarray]] = []
         for k in rec["keys"]:
             ent = store.get(k, bump=False)
-            if ent is None:
+            if ent is not None:
+                flat, _ = jax.tree_util.tree_flatten_with_path(
+                    ent.arrays
+                )
+                pages.append({
+                    jax.tree_util.keystr(path): np.asarray(leaf)
+                    for path, leaf in flat
+                })
+                continue
+            got = (
+                self._kv_disk.load(k, bump=False)
+                if self._kv_disk is not None else None
+            )
+            if got is None:
                 raise RuntimeError(
                     f"kv export page for rid {rid} left the host tier "
                     "before pickup (budget eviction or flush — raise "
                     "kv_host_bytes or fetch sooner)"
                 )
-            flat, _ = jax.tree_util.tree_flatten_with_path(ent.arrays)
-            pages.append({
-                jax.tree_util.keystr(path): np.asarray(leaf)
-                for path, leaf in flat
-            })
+            pages.append(got[1])  # disk fallthrough: named leaves
         from shifu_tpu.infer.kvtier import pack_page_chain
 
         payload = pack_page_chain(
@@ -3422,14 +3723,7 @@ class PagedEngine(Engine):
             meta={"rid": int(rid), "adapter": rec["adapter"]},
         )
         ms = (time.monotonic() - t0) * 1e3
-        self._kv_xfer["export_frames"] += 1
-        self._kv_xfer["export_pages"] += len(pages)
-        self._kv_xfer["export_bytes"] += len(payload)
-        xfer = getattr(self, "_c_kv_xfer", None)
-        if xfer is not None:
-            xfer["export_frames"].inc()
-            xfer["export_pages"].inc(len(pages))
-            xfer["export_bytes"].inc(len(payload))
+        self._kv_note_export(len(pages), len(payload))
         self._kv_migrate_span(
             trace, "export", t0, ms, rid=int(rid), pages=len(pages),
             nbytes=len(payload),
@@ -3437,6 +3731,109 @@ class PagedEngine(Engine):
         self.flight.record(
             "kv_export", replica=self.replica_label, rid=int(rid),
             pages=len(pages), bytes=len(payload), ms=round(ms, 3),
+        )
+        return payload
+
+    def _kv_note_export(self, pages: int, nbytes: int) -> None:
+        """Fold one served export frame into the xfer counters (shared
+        by the rid-keyed and digest-keyed handlers)."""
+        self._kv_xfer["export_frames"] += 1
+        self._kv_xfer["export_pages"] += pages
+        self._kv_xfer["export_bytes"] += nbytes
+        xfer = getattr(self, "_c_kv_xfer", None)
+        if xfer is not None:
+            xfer["export_frames"].inc()
+            xfer["export_pages"].inc(pages)
+            xfer["export_bytes"].inc(nbytes)
+
+    def kv_export_digest(self, digest: str, trace: Optional[dict] = None):
+        """One SKVP frame holding the full page chain ENDING at the
+        content digest a peer saw in our ``/cachez`` advertisement
+        (``GET /kv/pages?digest=`` — HTTP handler thread). Unlike the
+        rid-keyed export there is no filed record: the chain is walked
+        back parent-by-parent through the provenance stored with each
+        tier entry until the adapter salt root. None = digest unknown
+        here (→ 404). RuntimeError = the tip is held but an ancestor
+        link is gone or unprovenanced (→ 503 retryable)."""
+        store = self._kv_store
+        if store is None:
+            return None
+        try:
+            target = bytes.fromhex(str(digest))
+        except ValueError:
+            raise ValueError(f"digest {digest!r} is not hex") from None
+        if len(target) != 32:
+            raise ValueError(
+                f"digest {digest!r} is not a 32-byte sha256 chain key"
+            )
+        t0 = time.monotonic()
+        disk = self._kv_disk
+        walk: List[tuple] = []  # (named leaves, page_tokens), tip last
+        adapter = None
+        cur = target
+        # max_depth bounds the parent walk — a well-formed chain for
+        # this engine is at most max_len/page_size pages deep, so
+        # anything longer is corrupt provenance, not a longer prompt.
+        for _ in range(max(1, self.max_len // self.page_size) + 1):
+            ent = store.get(cur, bump=False)
+            if ent is not None and ent.page_tokens is not None:
+                flat, _ = jax.tree_util.tree_flatten_with_path(
+                    ent.arrays
+                )
+                leaves = {
+                    jax.tree_util.keystr(path): np.asarray(leaf)
+                    for path, leaf in flat
+                }
+                parent, ptoks, adp = ent.parent, ent.page_tokens, ent.adapter
+            else:
+                got = disk.load(cur, bump=False) if disk is not None else None
+                if got is None:
+                    if cur == target:
+                        return None  # tip not held: plain 404
+                    raise RuntimeError(
+                        f"kv chain for digest {digest} broke at "
+                        f"ancestor {cur.hex()} — evicted between "
+                        "advertisement and fetch (retryable)"
+                    )
+                ent_d, leaves = got
+                parent, ptoks, adp = (
+                    ent_d.parent, ent_d.page_tokens, ent_d.adapter
+                )
+            if ptoks is None or parent is None:
+                raise RuntimeError(
+                    f"kv chain link {cur.hex()} has no recorded "
+                    "provenance — entry predates chain-digest export"
+                )
+            if adapter is None:
+                adapter = int(adp)
+            walk.append((leaves, ptoks))
+            if parent == self._prefix_salt(adapter):
+                break
+            cur = parent
+        else:
+            raise RuntimeError(
+                f"kv chain for digest {digest} exceeds this engine's "
+                "max depth — refusing a cyclic or foreign chain"
+            )
+        walk.reverse()
+        pages = [leaves for leaves, _ in walk]
+        tokens = [int(t) for _, ptoks in walk for t in ptoks]
+        from shifu_tpu.infer.kvtier import pack_page_chain
+
+        payload = pack_page_chain(
+            pages, page_size=self.page_size, tokens=tokens,
+            meta={"digest": str(digest), "adapter": int(adapter)},
+        )
+        ms = (time.monotonic() - t0) * 1e3
+        self._kv_note_export(len(pages), len(payload))
+        self._kv_migrate_span(
+            trace, "export", t0, ms, digest=str(digest),
+            pages=len(pages), nbytes=len(payload),
+        )
+        self.flight.record(
+            "kv_export", replica=self.replica_label,
+            digest=str(digest), pages=len(pages),
+            bytes=len(payload), ms=round(ms, 3),
         )
         return payload
 
@@ -3499,9 +3896,24 @@ class PagedEngine(Engine):
         nbytes = 0
         key = self._prefix_salt(adapter)
         for i, tree in enumerate(trees):
-            key = self._chain_key(key, tokens[i * ps : (i + 1) * ps])
-            if store.put(key, tree, tokens=ps):
+            parent = key
+            ptoks = tuple(
+                int(t) for t in tokens[i * ps : (i + 1) * ps]
+            )
+            key = self._chain_key(key, ptoks)
+            if store.put(
+                key, tree, tokens=ps, parent=parent,
+                page_tokens=ptoks, adapter=adapter,
+            ):
                 stored += 1
+                if self._kv_disk is not None:
+                    # Write-through: a peer-fed chain is crash-safe
+                    # and re-advertisable the moment it lands.
+                    self._kv_disk.put(
+                        key, pages[i], page_size=ps,
+                        page_tokens=ptoks, parent=parent,
+                        adapter=adapter,
+                    )
             nbytes += sum(
                 a.nbytes for a in jax.tree_util.tree_leaves(tree)
             )
@@ -3810,8 +4222,10 @@ class PagedEngine(Engine):
         # Register this prompt's NEW full pages (the partial tail
         # page takes decode writes and is never shareable)...
         keys = []
+        store = self._kv_store
         key = self._prefix_salt(adapter)
         for i in range(p // ps):
+            parent = key
             key = self._chain_key(key, prompt[i * ps : (i + 1) * ps])
             keys.append(key)
             if key not in self._prefix_pages and i < len(pages_used):
@@ -3821,6 +4235,19 @@ class PagedEngine(Engine):
                 if pg and pg not in self._page_key:
                     self._prefix_pages[key] = pg
                     self._page_key[pg] = key
+            if store is not None and key in self._prefix_pages:
+                # Chain provenance: lets eviction demote the page to
+                # disk and /kv/pages?digest= walk back to the root.
+                self._prefix_meta[key] = (
+                    parent,
+                    tuple(int(t) for t in prompt[i * ps : (i + 1) * ps]),
+                    int(adapter),
+                )
+                if self._kv_mirror:
+                    # Eager mirror: spill while device-resident so the
+                    # page is advertisable, peer-servable, and on disk
+                    # BEFORE any crash (spill dedups via contains()).
+                    self._kv_spill(key, self._prefix_pages[key])
         # ...then bump touched prefixes to MRU, LONGEST first so
         # shorter (more reusable) links of a chain evict LAST — a
         # chain missing its head can never be matched, stranding
@@ -3847,6 +4274,13 @@ class PagedEngine(Engine):
         if self._kv_store is not None:
             self._kv_flush_gen += 1
             self._kv_store.clear()  # bumps the store generation too
+            if self._kv_disk is not None:
+                # Back-to-back with the host clear: the two stores'
+                # generations stay in lockstep, which is what makes a
+                # host entry's filing generation valid as the disk
+                # put generation during demotion.
+                self._kv_disk.clear()
+            self._prefix_meta.clear()
             self._kv_pending.clear()
             self._kv_recompute_rids.clear()
         for key, pg in list(self._prefix_pages.items()):
@@ -3883,9 +4317,39 @@ class PagedEngine(Engine):
                 "hit_rate": round(hit_rate, 4),
             },
             "host_tier": None,
+            "disk_tier": None,
         }
         if self._kv_store is not None:
             out["host_tier"] = self._kv_store.stats()
+            if self._kv_disk is not None:
+                out["disk_tier"] = self._kv_disk.stats()
+            # Bounded digest advertisement: the fleet digest map is
+            # built from these (key, parent) pairs — MRU-first so the
+            # hottest shared prefixes are the ones peers can see.
+            limit = int(self.kv_advertise_digests)
+            held: List[List[Optional[str]]] = []
+            seen = set()
+            pools = [self._kv_store.keys_mru(limit)]
+            if self._kv_disk is not None:
+                pools.append(self._kv_disk.keys_mru(limit))
+            for pool in pools:
+                for k, parent in pool:
+                    if k in seen or len(held) >= limit:
+                        continue
+                    seen.add(k)
+                    held.append([
+                        k.hex(),
+                        parent.hex() if parent is not None else None,
+                    ])
+            st = out["host_tier"]
+            count = int(st.get("entries", len(held)) or 0)
+            tot = int(st.get("bytes_used", 0) or 0)
+            out["digests"] = {
+                "page_size": self.page_size,
+                "page_bytes": int(tot / count) if count else 0,
+                "count": len(held),
+                "held": held,
+            }
         return out
 
     def _advance_prefills(self) -> None:

@@ -23,25 +23,39 @@ module adds the classic next rung of the memory hierarchy:
   ROADMAP item 1, uses the same format); this PR pins the round-trip
   and corruption rejection in unit tests.
 
+* :class:`DiskKVStore` — the tier below host RAM: one SKVP segment
+  file per page under ``--kv-disk-dir``, named by the page's chain
+  digest, byte-budgeted LRU with the same generation discipline as
+  the host store. Segments are ordinary frames, so the trailing crc32
+  IS the crash contract: a process killed mid-write leaves a torn
+  tail that the restart scan refuses (and unlinks), while every
+  intact segment is re-indexed and serves restores again — a shared
+  system prompt outlives the process that computed it.
+
 Engine-side integration (spill hook, restore probe, breakeven policy,
 flush rules) lives in ``PagedEngine`` — see docs/kv_tiering.md.
 """
 
 from __future__ import annotations
 
+import contextlib
 import hashlib
 import json
+import mmap
+import os
 import struct
 import threading
+import time
 import zlib
 from collections import OrderedDict
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
 __all__ = [
     "HostKVStore",
+    "DiskKVStore",
     "WireFormatError",
     "serialize_pages",
     "deserialize_pages",
@@ -317,12 +331,22 @@ def _tree_nbytes(tree) -> int:
 
 @dataclass
 class _Entry:
-    """One spilled page: the cache pytree minus the page axis, on host."""
+    """One spilled page: the cache pytree minus the page axis, on host.
+
+    ``parent`` / ``page_tokens`` / ``adapter`` carry the chain-walk
+    provenance a content-addressed export needs (walking a digest back
+    to its salt root and re-deriving the token run — ``/kv/pages?digest=``);
+    ``gen`` stamps the store generation at filing so a demotion to the
+    disk tier after a flush is refused there too."""
 
     key: bytes
     arrays: Any  # pytree of np.ndarray, cache structure minus page axis
     nbytes: int
     tokens: int
+    parent: Optional[bytes] = None
+    page_tokens: Optional[Tuple[int, ...]] = None
+    adapter: int = 0
+    gen: int = 0
 
 
 @dataclass
@@ -356,13 +380,21 @@ class HostKVStore:
     ``shifu_kv_tier_*`` metrics.
     """
 
-    def __init__(self, capacity_bytes: int):
+    def __init__(
+        self, capacity_bytes: int,
+        on_evict: Optional[Callable[[List[_Entry]], None]] = None,
+    ):
         if capacity_bytes <= 0:
             raise ValueError(
                 f"host tier needs a positive byte budget, got "
                 f"{capacity_bytes}"
             )
         self.capacity_bytes = int(capacity_bytes)
+        # Demotion hook: budget-evicted entries are handed to the next
+        # tier down AFTER the lock is released (the callback writes to
+        # a store with its own lock — holding ours across it would
+        # order the two locks).
+        self.on_evict = on_evict
         self._lock = threading.Lock()
         self._entries: "OrderedDict[bytes, _Entry]" = OrderedDict()
         self._bytes = 0
@@ -413,29 +445,52 @@ class HostKVStore:
     def put(
         self, key: bytes, arrays, *, tokens: int,
         generation: Optional[int] = None,
+        parent: Optional[bytes] = None,
+        page_tokens: Optional[Tuple[int, ...]] = None,
+        adapter: int = 0,
     ) -> bool:
         """File a spilled page. False = refused (stale generation after
         a flush raced the spill, or the entry alone exceeds the
-        budget). Evicts LRU entries until the budget holds."""
+        budget). Evicts LRU entries until the budget holds; evicted
+        entries are offered to ``on_evict`` (demotion to the disk
+        tier) outside the lock."""
         nbytes = _tree_nbytes(arrays)
-        with self._lock:
-            if generation is not None and generation != self.generation:
-                self.rejects += 1
-                return False
-            if nbytes > self.capacity_bytes:
-                self.rejects += 1
-                return False
-            if key in self._entries:
-                return True  # already spilled (idempotent)
-            while self._bytes + nbytes > self.capacity_bytes:
-                _, old = self._entries.popitem(last=False)
-                self._bytes -= old.nbytes
-                self.evictions += 1
-            self._entries[key] = _Entry(key, arrays, nbytes, int(tokens))
-            self._bytes += nbytes
-            self.spilled_pages += 1
-            self.spilled_bytes += nbytes
-            return True
+        demoted: List[_Entry] = []
+        try:
+            with self._lock:
+                if (
+                    generation is not None
+                    and generation != self.generation
+                ):
+                    self.rejects += 1
+                    return False
+                if nbytes > self.capacity_bytes:
+                    self.rejects += 1
+                    return False
+                if key in self._entries:
+                    return True  # already spilled (idempotent)
+                while self._bytes + nbytes > self.capacity_bytes:
+                    _, old = self._entries.popitem(last=False)
+                    self._bytes -= old.nbytes
+                    self.evictions += 1
+                    demoted.append(old)
+                self._entries[key] = _Entry(
+                    key, arrays, nbytes, int(tokens),
+                    parent=parent,
+                    page_tokens=(
+                        tuple(int(t) for t in page_tokens)
+                        if page_tokens is not None else None
+                    ),
+                    adapter=int(adapter),
+                    gen=self.generation,
+                )
+                self._bytes += nbytes
+                self.spilled_pages += 1
+                self.spilled_bytes += nbytes
+                return True
+        finally:
+            if demoted and self.on_evict is not None:
+                self.on_evict(demoted)
 
     def pop(self, key: bytes) -> None:
         with self._lock:
@@ -462,6 +517,19 @@ class HostKVStore:
                     break
                 out.append(k)
         return out
+
+    def keys_mru(self, limit: int) -> List[Tuple[bytes, Optional[bytes]]]:
+        """Up to ``limit`` (key, parent) pairs, most-recently-used
+        first — the bounded digest summary ``/cachez`` advertises to
+        the fleet (MRU first so a truncated summary keeps the prefixes
+        most likely to be re-requested)."""
+        with self._lock:
+            out: List[Tuple[bytes, Optional[bytes]]] = []
+            for key in reversed(self._entries):
+                if len(out) >= max(0, int(limit)):
+                    break
+                out.append((key, self._entries[key].parent))
+            return out
 
     # ----------------------------------------------------- measurement
     def note_spill(self, nbytes: int, ms: float) -> None:
@@ -526,3 +594,356 @@ class HostKVStore:
                     else None
                 ),
             }
+
+
+# ----------------------------------------------------------------- disk tier
+@dataclass
+class _DiskEntry:
+    """Index record for one on-disk segment (the bytes stay on disk;
+    only this metadata is resident)."""
+
+    key: bytes
+    path: str
+    nbytes: int  # whole-frame size on disk (the budget unit)
+    tokens: int
+    parent: Optional[bytes]
+    page_tokens: Optional[Tuple[int, ...]]
+    adapter: int
+
+
+class DiskKVStore:
+    """Byte-budgeted LRU of KV pages as SKVP segment files on disk.
+
+    One page per segment, named ``<chain-digest-hex>.skvp`` under
+    ``dir_path``. Segments are written in place (no tmp-rename dance)
+    because the SKVP trailing crc32 already makes a torn write
+    detectable: a crash mid-write leaves a frame the restart scan (and
+    any later :meth:`load`) refuses and unlinks — ``torn_refused``
+    counts them — while intact segments are re-indexed
+    (``resumed_segments``) and keep serving restores, so shared system
+    prompts survive the process. Reads go through ``mmap`` (the frame
+    is validated and copied out leaf by leaf, so the mapping is
+    short-lived).
+
+    Thread-safety and generation discipline mirror
+    :class:`HostKVStore`: every public method takes the store lock,
+    ``clear()`` bumps ``generation``, and a put stamped with a
+    pre-flush generation is refused — the engine clears host and disk
+    back-to-back so a demotion racing a flush cannot resurrect
+    stale-weight KV from either side.
+    """
+
+    def __init__(self, capacity_bytes: int, dir_path: str):
+        if capacity_bytes <= 0:
+            raise ValueError(
+                f"disk tier needs a positive byte budget, got "
+                f"{capacity_bytes}"
+            )
+        if not os.path.isdir(dir_path):
+            raise ValueError(
+                f"disk tier directory {dir_path!r} does not exist"
+            )
+        self.capacity_bytes = int(capacity_bytes)
+        self.dir = os.path.abspath(dir_path)
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[bytes, _DiskEntry]" = OrderedDict()
+        self._bytes = 0
+        self.generation = 0
+        # -- counters (read under lock via stats()) -------------------
+        self.spilled_pages = 0  # segment writes
+        self.spilled_bytes = 0
+        self.restored_pages = 0  # segment reads that validated
+        self.restored_bytes = 0
+        self.hits = 0  # admissions whose chain touched the disk tier
+        self.evictions = 0
+        self.rejects = 0
+        self.torn_refused = 0  # frames refused by the crc/scan contract
+        self.resumed_segments = 0  # intact segments re-indexed at start
+        self.write_ms = 0.0
+        self.read_ms = 0.0
+        self._read_bw = _Ema()
+        self._write_bw = _Ema()
+        self._scan()
+
+    # ------------------------------------------------------------ scan
+    def _scan(self) -> None:
+        """Re-index surviving segments after a restart. Oldest-mtime
+        first so the survivors' LRU order approximates their previous
+        life; torn/truncated/corrupt frames (the crash contract) are
+        refused AND unlinked so they cannot be re-refused forever."""
+        try:
+            names = [
+                n for n in os.listdir(self.dir) if n.endswith(".skvp")
+            ]
+        except OSError:
+            return
+        paths = []
+        for n in names:
+            p = os.path.join(self.dir, n)
+            try:
+                paths.append((os.path.getmtime(p), p, n))
+            except OSError:
+                continue
+        for _, path, name in sorted(paths):
+            try:
+                with open(path, "rb") as f:
+                    buf = f.read()
+                header, leaves = deserialize_pages(buf)
+            except (WireFormatError, OSError):
+                self.torn_refused += 1
+                with contextlib.suppress(OSError):
+                    os.unlink(path)
+                continue
+            meta = header.get("meta") or {}
+            try:
+                key = bytes.fromhex(meta.get("digest", ""))
+            except ValueError:
+                key = b""
+            if not key or name != key.hex() + ".skvp":
+                # A frame that validates but does not name itself (or
+                # sits under the wrong filename) is not ours to serve.
+                self.torn_refused += 1
+                with contextlib.suppress(OSError):
+                    os.unlink(path)
+                continue
+            ptoks = meta.get("page_tokens")
+            parent_hex = meta.get("parent")
+            ent = _DiskEntry(
+                key=key,
+                path=path,
+                nbytes=len(buf),
+                tokens=len(ptoks) if isinstance(ptoks, list) else 0,
+                parent=(
+                    bytes.fromhex(parent_hex)
+                    if isinstance(parent_hex, str) else None
+                ),
+                page_tokens=(
+                    tuple(int(t) for t in ptoks)
+                    if isinstance(ptoks, list) else None
+                ),
+                adapter=int(meta.get("adapter", 0) or 0),
+            )
+            self._entries[key] = ent
+            self._bytes += ent.nbytes
+            self.resumed_segments += 1
+        # A restart with a smaller budget trims oldest-first.
+        while self._bytes > self.capacity_bytes and self._entries:
+            _, old = self._entries.popitem(last=False)
+            self._evict_locked(old)
+
+    def _evict_locked(self, ent: _DiskEntry) -> None:
+        self._bytes -= ent.nbytes
+        self.evictions += 1
+        with contextlib.suppress(OSError):
+            os.unlink(ent.path)
+
+    # ------------------------------------------------------------ data
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    @property
+    def bytes_used(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def contains(self, key: bytes) -> bool:
+        with self._lock:
+            return key in self._entries
+
+    __contains__ = contains
+
+    def entry_bytes(self, key: bytes) -> int:
+        with self._lock:
+            e = self._entries.get(key)
+            return e.nbytes if e is not None else 0
+
+    def put(
+        self, key: bytes, leaves: Dict[str, np.ndarray], *,
+        page_size: int,
+        page_tokens,
+        parent: Optional[bytes] = None,
+        adapter: int = 0,
+        generation: Optional[int] = None,
+    ) -> bool:
+        """Write one page as a segment file. ``leaves`` are the page's
+        named wire leaves (the engine's key-path naming, identical to
+        the /kv/pages frames); ``page_tokens``/``parent``/``adapter``
+        ride the frame's meta so a restart — or a peer walking the
+        chain — recovers the full provenance from disk alone. False =
+        refused (stale generation, oversized, or the write failed)."""
+        frame = serialize_pages(
+            dict(leaves), page_size=int(page_size),
+            meta={
+                "digest": key.hex(),
+                "parent": parent.hex() if parent is not None else None,
+                "page_tokens": [int(t) for t in page_tokens],
+                "adapter": int(adapter),
+            },
+        )
+        nbytes = len(frame)
+        with self._lock:
+            if generation is not None and generation != self.generation:
+                self.rejects += 1
+                return False
+            if nbytes > self.capacity_bytes:
+                self.rejects += 1
+                return False
+            if key in self._entries:
+                self._entries.move_to_end(key)
+                return True  # already on disk (idempotent)
+            while self._bytes + nbytes > self.capacity_bytes:
+                _, old = self._entries.popitem(last=False)
+                self._evict_locked(old)
+            path = os.path.join(self.dir, key.hex() + ".skvp")
+            t0 = time.monotonic()
+            try:
+                with open(path, "wb") as f:
+                    f.write(frame)
+            except OSError:
+                self.rejects += 1
+                with contextlib.suppress(OSError):
+                    os.unlink(path)
+                return False
+            ms = (time.monotonic() - t0) * 1e3
+            self._entries[key] = _DiskEntry(
+                key=key, path=path, nbytes=nbytes,
+                tokens=len(list(page_tokens)),
+                parent=parent,
+                page_tokens=tuple(int(t) for t in page_tokens),
+                adapter=int(adapter),
+            )
+            self._bytes += nbytes
+            self.spilled_pages += 1
+            self.spilled_bytes += nbytes
+            self.write_ms += ms
+            if ms > 0:
+                self._write_bw.note(nbytes / ms)
+            return True
+
+    def load(
+        self, key: bytes, *, bump: bool = True,
+    ) -> Optional[Tuple[_DiskEntry, Dict[str, np.ndarray]]]:
+        """Read + validate one segment → (index entry, named leaves).
+        None = not held, or the frame failed the crc contract (then
+        the segment is dropped from the index and unlinked, and
+        ``torn_refused`` counts it — a torn segment reads as a miss,
+        never as data)."""
+        with self._lock:
+            ent = self._entries.get(key)
+            if ent is None:
+                return None
+            if bump:
+                self._entries.move_to_end(key)
+            path, nbytes = ent.path, ent.nbytes
+        t0 = time.monotonic()
+        try:
+            with open(path, "rb") as f:
+                with mmap.mmap(
+                    f.fileno(), 0, access=mmap.ACCESS_READ
+                ) as mm:
+                    _, leaves = deserialize_pages(mm)
+        except (WireFormatError, OSError, ValueError):
+            # ValueError: mmap of an empty (fully torn) file.
+            with self._lock:
+                cur = self._entries.pop(key, None)
+                if cur is not None:
+                    self._bytes -= cur.nbytes
+                self.torn_refused += 1
+            with contextlib.suppress(OSError):
+                os.unlink(path)
+            return None
+        ms = (time.monotonic() - t0) * 1e3
+        with self._lock:
+            self.restored_pages += 1
+            self.restored_bytes += nbytes
+            self.read_ms += ms
+            if ms > 0:
+                self._read_bw.note(nbytes / ms)
+        return ent, leaves
+
+    def pop(self, key: bytes) -> None:
+        with self._lock:
+            e = self._entries.pop(key, None)
+            if e is not None:
+                self._bytes -= e.nbytes
+                with contextlib.suppress(OSError):
+                    os.unlink(e.path)
+
+    def clear(self) -> None:
+        """Unlink every segment and bump the generation — the disk
+        analogue of :meth:`HostKVStore.clear`, called back-to-back
+        with it on flush so the two tiers' generations stay in
+        lockstep."""
+        with self._lock:
+            for e in self._entries.values():
+                with contextlib.suppress(OSError):
+                    os.unlink(e.path)
+            self._entries.clear()
+            self._bytes = 0
+            self.generation += 1
+
+    def chain(self, keys: List[bytes]) -> List[bytes]:
+        """Longest held prefix of ``keys`` (see HostKVStore.chain)."""
+        out: List[bytes] = []
+        with self._lock:
+            for k in keys:
+                if k not in self._entries:
+                    break
+                out.append(k)
+        return out
+
+    def keys_mru(self, limit: int) -> List[Tuple[bytes, Optional[bytes]]]:
+        """Up to ``limit`` (key, parent) pairs, MRU first — the disk
+        half of the /cachez digest advertisement."""
+        with self._lock:
+            out: List[Tuple[bytes, Optional[bytes]]] = []
+            for key in reversed(self._entries):
+                if len(out) >= max(0, int(limit)):
+                    break
+                out.append((key, self._entries[key].parent))
+            return out
+
+    # ----------------------------------------------------- measurement
+    def note_hit(self) -> None:
+        with self._lock:
+            self.hits += 1
+
+    def read_bytes_per_ms(self) -> Optional[float]:
+        """Measured segment-read bandwidth EMA (None until the first
+        read lands — the breakeven explores, like the host tier)."""
+        with self._lock:
+            return self._read_bw.value
+
+    def stats(self) -> Dict[str, Any]:
+        """Snapshot for counters()/cache_stats()/ /cachez — plain
+        numbers (plus the dir path) so fleet aggregation can sum."""
+        with self._lock:
+            return {
+                "segments": len(self._entries),
+                "bytes_used": self._bytes,
+                "capacity_bytes": self.capacity_bytes,
+                "dir": self.dir,
+                "spilled_pages": self.spilled_pages,
+                "spilled_bytes": self.spilled_bytes,
+                "restored_pages": self.restored_pages,
+                "restored_bytes": self.restored_bytes,
+                "hits": self.hits,
+                "evictions": self.evictions,
+                "rejects": self.rejects,
+                "torn_refused": self.torn_refused,
+                "resumed_segments": self.resumed_segments,
+                "write_ms": round(self.write_ms, 3),
+                "read_ms": round(self.read_ms, 3),
+                "read_bytes_per_ms": (
+                    round(self._read_bw.value, 1)
+                    if self._read_bw.value is not None
+                    else None
+                ),
+                "write_bytes_per_ms": (
+                    round(self._write_bw.value, 1)
+                    if self._write_bw.value is not None
+                    else None
+                ),
+            }
+

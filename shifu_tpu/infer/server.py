@@ -1618,31 +1618,39 @@ class _Handler(BaseHTTPRequestHandler):
         from urllib.parse import parse_qs, urlparse
 
         q = parse_qs(urlparse(self.path).query)
-        try:
-            rid = int((q.get("rid") or [""])[0])
-        except ValueError:
-            self._send(400, {"error": "rid must be an integer"})
-            return
+        digest = (q.get("digest") or [None])[0]
         trace_ctx = _dtrace.ensure_context(
             self.headers.get(_dtrace.HEADER)
         )
         try:
-            payload = self.runner.engine.kv_export_payload(
-                rid, trace=trace_ctx.to_dict()
-            )
+            if digest is not None:
+                # Content-addressed fetch: any host holding the chain
+                # digest can serve it — no filed export record needed.
+                payload = self.runner.engine.kv_export_digest(
+                    digest, trace=trace_ctx.to_dict()
+                )
+                miss = f"no KV pages held for digest {digest}"
+            else:
+                try:
+                    rid = int((q.get("rid") or [""])[0])
+                except ValueError:
+                    self._send(400, {"error": "rid must be an integer"})
+                    return
+                payload = self.runner.engine.kv_export_payload(
+                    rid, trace=trace_ctx.to_dict()
+                )
+                miss = f"no exported KV pages for rid {rid}"
         except RuntimeError as e:
             # Export filed but unservable (spill failed, pages evicted
-            # before pickup): 503 so the fetching router retries or
-            # falls back colocated.
+            # before pickup, chain ancestor gone): 503 so the fetching
+            # router retries or falls back colocated.
             self._send(503, {"error": str(e)})
             return
         except ValueError as e:
             self._send(400, {"error": str(e)})
             return
         if payload is None:
-            self._send(404, {
-                "error": f"no exported KV pages for rid {rid}",
-            })
+            self._send(404, {"error": miss})
             return
         self.send_response(200)
         self.send_header("Content-Type", "application/octet-stream")

@@ -156,6 +156,17 @@ METRIC_SPECS: Dict[str, Tuple[str, float]] = {
     "sticky_prefill_tok_saved_x": (HIGHER, 0.25),
     "sticky_p50_ttft_ms": (LOWER, 0.50),
     "migrate_x_cold_ttft": (LOWER, 0.50),
+    # fleet prefix store (round 19): bench_kv_fleet warms a stone-cold
+    # host from its peer over GET /kv/pages?digest= and prices a new
+    # session's first turn against a cold control engine. The ratio is
+    # peer-warmed computed-prefill tokens over cold (< 1 = the fetched
+    # chains turned the shared system prompt into cache hits); it
+    # drifting UP past tolerance means peer warming stopped covering
+    # the shared prefix. kvf_warmup_ms prices the bulk pull itself.
+    # Armable — dormant until a baseline round records the leg
+    # (missing keys are skipped).
+    "kvf_peer_x_cold": (LOWER, 0.35),
+    "kvf_warmup_ms": (LOWER, 0.50),
     # loadgen measurement harness (round 17): the headline of a scored
     # scenario run (shifu_tpu loadgen / bench_loadgen) — goodput and
     # achieved-vs-offered are the capacity claims, p99 TTFT and error

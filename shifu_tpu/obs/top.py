@@ -78,6 +78,19 @@ def render_top(statz: dict, sloz: Optional[dict] = None,
             f" (fail {sess.get('migrate_fallbacks', 0)}"
             f", breakeven {sess.get('migrate_breakeven_losses', 0)})"
         )
+    peer = (statz.get("cache") or {}).get("peer") or {}
+    if peer:
+        # Content-addressed peer fetch totals (the router's /cachez
+        # "peer" block): chains pulled over /kv/pages?digest=.
+        lines.append(
+            "peer-kv: "
+            f"fetches {peer.get('fetches', 0)}"
+            f"  pages {peer.get('pages', 0)}"
+            f"  bytes {peer.get('bytes', 0)}"
+            f"  warmups {peer.get('warmups', 0)}"
+            f" (fail {peer.get('failures', 0)}"
+            f", breakeven {peer.get('breakeven_losses', 0)})"
+        )
 
     tiers = (sloz or {}).get("tiers") or {}
     if tiers:
@@ -133,6 +146,19 @@ def render_top(statz: dict, sloz: Optional[dict] = None,
                     f"{pc.get('n_pages', 0)} pages"
                     f"  occ {_fmt(r.get('cache_occupancy'), 3)}"
                     f"  hit-rate {_fmt(pc.get('hit_rate'), 3)}"
+                )
+            dt = (blk or {}).get("disk_tier")
+            if dt:
+                # /cachez disk_tier keys: the NVMe segment store below
+                # the host tier (bytes, segments, hit/evict totals).
+                lines.append(
+                    f"    disk: {dt.get('segments', 0)} seg"
+                    f"  {dt.get('bytes_used', 0)}/"
+                    f"{dt.get('capacity_bytes', 0)} B"
+                    f"  hits {dt.get('hits', 0)}"
+                    f"  evict {dt.get('evictions', 0)}"
+                    f"  torn {dt.get('torn_refused', 0)}"
+                    f"  resumed {dt.get('resumed_segments', 0)}"
                 )
 
     if loadgen:

@@ -20,7 +20,10 @@ enables the prefix cache + host KV tier, the /kv/pages handoff
 surface — the disagg tests set it on both hosts),
 ``FLEET_BACKEND_KV_EXPORT_SLOTS`` (the /kv/pages export-record cap,
 the ``--kv-export-slots`` serve flag — migration tests shrink it to
-force FIFO eviction).
+force FIFO eviction), ``FLEET_BACKEND_KV_DISK_BYTES`` +
+``FLEET_BACKEND_KV_DISK_DIR`` (nonzero bytes + a directory enable the
+disk tier below the host tier — the crash-restart and peer-warmup
+tests point two runs at the same directory).
 
 CHAOS HOOKS: the ``FLEET_BACKEND_FAULT_*`` env vars select the
 first-class fault injectors in :mod:`shifu_tpu.fleet.chaos`
@@ -61,6 +64,8 @@ def main() -> int:
     role = os.environ.get("FLEET_BACKEND_ROLE") or "both"
     kv_host = int(os.environ.get("FLEET_BACKEND_KV_HOST_BYTES", "0"))
     kv_slots = int(os.environ.get("FLEET_BACKEND_KV_EXPORT_SLOTS", "64"))
+    kv_disk = int(os.environ.get("FLEET_BACKEND_KV_DISK_BYTES", "0"))
+    kv_dir = os.environ.get("FLEET_BACKEND_KV_DISK_DIR") or None
 
     cfg = TransformerConfig.tiny()
     model = Transformer(cfg)
@@ -76,6 +81,8 @@ def main() -> int:
         # ingests from) over /kv/pages.
         extra.update(enable_prefix_cache=True, kv_host_bytes=kv_host,
                      kv_export_slots=kv_slots)
+        if kv_disk and kv_dir:
+            extra.update(kv_disk_bytes=kv_disk, kv_disk_dir=kv_dir)
     engine = PagedEngine(
         model, params, max_slots=max_slots, max_len=max_len,
         page_size=16, prefill_buckets=(16, max_len),

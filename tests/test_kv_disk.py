@@ -1,0 +1,401 @@
+"""Disk KV tier (shifu_tpu/infer/kvtier.DiskKVStore + PagedEngine).
+
+Pins the ISSUE-19 crash contract: one SKVP frame per segment file, so
+the trailing crc IS the torn-write detector — a crash mid-spill leaves
+a frame the restart scan refuses AND unlinks, while intact segments are
+re-indexed and a decode restored purely from disk is BITWISE identical
+to the original. Also covers generation lockstep with the host tier,
+the /cachez ``disk_tier``/``digests`` blocks, the ``--kv-disk-*`` CLI
+validation, and a real SIGKILL-mid-serve restart of a backend process.
+"""
+
+import os
+import signal
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from shifu_tpu.infer import SampleConfig
+from shifu_tpu.infer.engine import PagedEngine
+from shifu_tpu.infer.kvtier import DiskKVStore
+from shifu_tpu.models import Transformer, TransformerConfig
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = TransformerConfig.tiny()
+    model = Transformer(cfg)
+    return model, model.init(jax.random.key(0))
+
+
+def _tiered(model, params, disk_dir, **kw):
+    kw.setdefault("page_size", 8)
+    kw.setdefault("n_pages", 6)
+    kw.setdefault("max_slots", 1)
+    kw.setdefault("max_len", 32)
+    kw.setdefault("enable_prefix_cache", True)
+    kw.setdefault("kv_host_bytes", 1 << 20)
+    kw.setdefault("kv_disk_bytes", 8 << 20)
+    kw.setdefault("sample_cfg", SampleConfig(temperature=0.0))
+    kw.setdefault("prefill_buckets", (16, 32))
+    return PagedEngine(model, params, kv_disk_dir=str(disk_dir), **kw)
+
+
+def _drain(eng, budget_s=120):
+    done = []
+    t0 = time.time()
+    while not eng.idle:
+        done += eng.step()
+        assert time.time() - t0 < budget_s, "engine stuck"
+    return done
+
+
+def _prompt(vocab, length=17, seed=0):
+    rng = np.random.default_rng(seed)
+    return list(map(int, rng.integers(1, vocab, length)))
+
+
+def _page(fill):
+    return {"k": np.full((2, 4), fill, np.float32)}
+
+
+# -------------------------------------------------------------- disk store
+def test_disk_store_budget_lru_and_generation(tmp_path):
+    probe_dir = tmp_path / "probe"
+    probe_dir.mkdir()
+    probe = DiskKVStore(1 << 20, str(probe_dir))
+    assert probe.put(b"\x00", _page(0), page_size=4,
+                     page_tokens=[1, 2, 3, 4])
+    nb = probe.entry_bytes(b"\x00")
+    assert nb > 0
+
+    d = tmp_path / "kv"
+    d.mkdir()
+    store = DiskKVStore(3 * nb, str(d))
+    for i in range(3):
+        assert store.put(bytes([i]), _page(i), page_size=4,
+                         page_tokens=[1, 2, 3, 4])
+    assert store.bytes_used == 3 * nb
+    assert len(list(d.glob("*.skvp"))) == 3
+    # load() bumps key 0 to MRU; the next put evicts key 1 (LRU) and
+    # unlinks its segment file.
+    got = store.load(bytes([0]))
+    assert got is not None
+    ent, leaves = got
+    assert leaves["k"].tobytes() == _page(0)["k"].tobytes()  # bitwise
+    assert ent.page_tokens == (1, 2, 3, 4)
+    assert store.put(bytes([3]), _page(3), page_size=4,
+                     page_tokens=[1, 2, 3, 4])
+    assert store.bytes_used == 3 * nb
+    assert store.contains(bytes([0])) and not store.contains(bytes([1]))
+    assert not (d / (bytes([1]).hex() + ".skvp")).exists()
+    assert store.stats()["evictions"] == 1
+    # a frame alone over budget is refused
+    assert not store.put(b"big", {"k": np.zeros((256, 256), np.float32)},
+                         page_size=4, page_tokens=[1, 2, 3, 4])
+    assert store.stats()["rejects"] == 1
+    # re-putting a held key is idempotent (no second segment write)
+    spilled = store.stats()["spilled_pages"]
+    assert store.put(bytes([0]), _page(0), page_size=4,
+                     page_tokens=[1, 2, 3, 4])
+    assert store.stats()["spilled_pages"] == spilled
+    # generation: a put stamped before clear() lands rejected, and
+    # clear() leaves the directory empty.
+    gen = store.generation
+    store.clear()
+    assert len(store) == 0 and store.bytes_used == 0
+    assert not list(d.glob("*.skvp"))
+    assert not store.put(b"\x09", _page(9), page_size=4,
+                         page_tokens=[1, 2, 3, 4], generation=gen)
+    assert store.put(b"\x09", _page(9), page_size=4,
+                     page_tokens=[1, 2, 3, 4],
+                     generation=store.generation)
+
+
+def test_disk_store_restart_reindex_refuses_torn(tmp_path):
+    d = tmp_path / "kv"
+    d.mkdir()
+    store = DiskKVStore(8 << 20, str(d))
+    keys = [bytes([10 + i]) for i in range(3)]
+    parent = None
+    for i, k in enumerate(keys):
+        assert store.put(k, _page(i), page_size=4,
+                         page_tokens=[5 + i] * 4, parent=parent,
+                         adapter=0)
+        parent = k
+    files = {k: d / (k.hex() + ".skvp") for k in keys}
+    # Simulate the crash contract: one segment torn mid-write
+    # (truncated tail), one bit-flipped on the platter; one intact.
+    torn = files[keys[0]]
+    torn.write_bytes(torn.read_bytes()[:-7])
+    flipped = files[keys[1]]
+    buf = bytearray(flipped.read_bytes())
+    buf[len(buf) // 2] ^= 0x20
+    flipped.write_bytes(bytes(buf))
+    # ...and a validating frame under the wrong filename is not ours.
+    (d / ("ab" * 32 + ".skvp")).write_bytes(files[keys[2]].read_bytes())
+
+    resumed = DiskKVStore(8 << 20, str(d))
+    st = resumed.stats()
+    assert st["resumed_segments"] == 1
+    assert st["torn_refused"] == 3
+    # refused segments were unlinked, never to be re-refused
+    assert sorted(p.name for p in d.glob("*.skvp")) == [
+        keys[2].hex() + ".skvp"
+    ]
+    got = resumed.load(keys[2])
+    assert got is not None
+    ent, leaves = got
+    assert leaves["k"].tobytes() == _page(2)["k"].tobytes()  # bitwise
+    # provenance recovered from the frame meta alone
+    assert ent.parent == keys[1]
+    assert ent.page_tokens == (7, 7, 7, 7)
+
+    # a segment torn AFTER indexing reads as a miss, not as data
+    p = d / (keys[2].hex() + ".skvp")
+    p.write_bytes(p.read_bytes()[:-3])
+    assert resumed.load(keys[2]) is None
+    assert resumed.stats()["torn_refused"] == 4  # 3 at scan + this one
+    assert not p.exists()
+
+
+def test_disk_store_restart_smaller_budget_trims_oldest(tmp_path):
+    d = tmp_path / "kv"
+    d.mkdir()
+    store = DiskKVStore(8 << 20, str(d))
+    for i in range(3):
+        assert store.put(bytes([i]), _page(i), page_size=4,
+                         page_tokens=[1] * 4)
+    nb = store.entry_bytes(bytes([0]))
+    # distinct mtimes so the oldest-first trim order is deterministic
+    now = time.time()
+    for i in range(3):
+        os.utime(d / (bytes([i]).hex() + ".skvp"),
+                 (now - 30 + 10 * i, now - 30 + 10 * i))
+    trimmed = DiskKVStore(nb, str(d))
+    assert len(trimmed) == 1
+    assert trimmed.contains(bytes([2]))  # newest survives
+    assert trimmed.stats()["evictions"] == 2
+
+
+# ------------------------------------------------ engine restart parity
+def test_disk_restored_decode_bitwise_after_restart(tiny, tmp_path):
+    """The tentpole acceptance walk: mirror-on spill writes segments at
+    registration time; a fresh engine on the same directory re-indexes
+    them and a decode restored PURELY from disk (empty host tier, empty
+    device pool) is bitwise-identical to the original."""
+    model, params = tiny
+    d = tmp_path / "kv"
+    d.mkdir()
+    prompt = _prompt(model.cfg.vocab_size)
+
+    eng = _tiered(model, params, d)
+    eng.submit(prompt, 4)
+    first = _drain(eng)[0].tokens
+    eng.kv_tier_sync()
+    # kv_mirror defaults on with the disk tier: both full prefix pages
+    # were written through at registration, not at eviction.
+    assert eng._kv_disk.stats()["segments"] == 2
+    c = eng.counters()
+    assert c["kv_disk_segments"] == 2 and c["kv_disk_spilled_pages"] == 2
+
+    eng2 = _tiered(model, params, d)
+    eng2._kv_tier_restore_wins = lambda *a: True  # policy aside
+    st = eng2._kv_disk.stats()
+    assert st["resumed_segments"] == 2 and st["torn_refused"] == 0
+    assert len(eng2._kv_store) == 0  # host tier starts empty
+    eng2.submit(prompt, 4)
+    assert _drain(eng2)[0].tokens == first  # bitwise (greedy)
+    eng2.kv_tier_sync()
+    c2 = eng2.counters()
+    assert c2["kv_disk_restored_pages"] >= 2
+    assert c2["kv_disk_resumed_segments"] == 2
+
+
+def test_flush_clears_both_tiers_in_lockstep(tiny, tmp_path):
+    model, params = tiny
+    d = tmp_path / "kv"
+    d.mkdir()
+    eng = _tiered(model, params, d)
+    eng.submit(_prompt(model.cfg.vocab_size), 4)
+    _drain(eng)
+    eng.kv_tier_sync()
+    assert eng._kv_disk.stats()["segments"] == 2
+    gen_host, gen_disk = eng._kv_store.generation, eng._kv_disk.generation
+    eng.flush_prefix_cache()
+    assert len(eng._kv_store) == 0
+    assert eng._kv_disk.stats()["segments"] == 0
+    assert not list(d.glob("*.skvp"))
+    assert eng._kv_store.generation == gen_host + 1
+    assert eng._kv_disk.generation == gen_disk + 1
+
+
+def test_cache_stats_disk_tier_and_digest_blocks(tiny, tmp_path):
+    model, params = tiny
+    d = tmp_path / "kv"
+    d.mkdir()
+    eng = _tiered(model, params, d)
+    prompt = _prompt(model.cfg.vocab_size)
+    eng.submit(prompt, 4)
+    _drain(eng)
+    eng.kv_tier_sync()
+    cs = eng.cache_stats()
+    dt = cs["disk_tier"]
+    assert dt["segments"] == 2 and dt["dir"] == str(d)
+    assert 0 < dt["bytes_used"] <= dt["capacity_bytes"]
+    dg = cs["digests"]
+    assert dg["page_size"] == 8 and dg["count"] >= 2
+    assert dg["page_bytes"] > 0
+    key0 = PagedEngine._chain_key(b"", prompt[:8])
+    key1 = PagedEngine._chain_key(key0, prompt[8:16])
+    held = {row[0]: row[1] for row in dg["held"]}
+    # the advertisement carries the chain: tip -> parent -> salt root
+    assert held[key1.hex()] == key0.hex()
+    assert held[key0.hex()] == b"".hex()
+    # a tier-less engine advertises nothing and reports no disk block
+    bare = PagedEngine(
+        model, params, page_size=8, n_pages=6, max_slots=1, max_len=32,
+        prefill_buckets=(16, 32),
+        sample_cfg=SampleConfig(temperature=0.0),
+    )
+    assert bare.cache_stats()["disk_tier"] is None
+
+
+def test_engine_refuses_inconsistent_disk_config(tiny, tmp_path):
+    model, params = tiny
+    with pytest.raises(ValueError, match="kv_disk_bytes needs kv_host"):
+        _tiered(model, params, tmp_path, kv_host_bytes=0)
+    with pytest.raises(ValueError, match="kv_disk_bytes needs kv_disk_dir"):
+        PagedEngine(
+            model, params, page_size=8, n_pages=6, max_slots=1,
+            max_len=32, enable_prefix_cache=True,
+            kv_host_bytes=1 << 20, kv_disk_bytes=8 << 20,
+            prefill_buckets=(16, 32),
+            sample_cfg=SampleConfig(temperature=0.0),
+        )
+    with pytest.raises(ValueError, match="does not exist"):
+        _tiered(model, params, tmp_path / "nope")
+
+
+def test_cli_validates_disk_flags(tmp_path):
+    """``--kv-disk-*`` misconfigurations are refused at CLI time with
+    one-line fix hints — before any weights load (same contract as
+    --role, tests/test_disagg.py)."""
+    import argparse
+
+    from shifu_tpu.cli import build_serve_engine
+    from shifu_tpu.data.tokenizer import ByteTokenizer
+
+    model = Transformer(TransformerConfig.tiny())
+    params = model.init(jax.random.key(0))
+    tok = ByteTokenizer()
+
+    def args(**over):
+        base = dict(
+            family="transformer", preset="tiny", moe_experts=0, attn=None,
+            optimizer="adamw", schedule="constant", lr=3e-4, warmup=0,
+            ckpt_dir=None, seed=0, tokenizer=None, host="127.0.0.1",
+            port=0, max_slots=2, max_len=64, max_new_tokens=16,
+            temperature=0.0, top_p=0.95, decode_chunk=1, eos_id=-1,
+            paged=True, page_size=8, n_pages=None, prefix_cache=True,
+            per_request_sampling=False, penalties=False, logit_bias=False,
+            spec="off", spec_k=3, spec_ngram=2, spec_rounds=2,
+            draft_preset=None, draft_ckpt_dir=None, kv_tier="host",
+            kv_host_bytes=64 << 20, role="both",
+            kv_disk_bytes=0, kv_disk_dir=None,
+        )
+        base.update(over)
+        return argparse.Namespace(**base)
+
+    good = tmp_path / "kv"
+    good.mkdir()
+    cases = [
+        (dict(kv_disk_bytes=8 << 20), "needs --kv-disk-dir.*fix:"),
+        (dict(kv_disk_bytes=8 << 20, kv_disk_dir=str(tmp_path / "no")),
+         "does not exist.*fix: mkdir"),
+        (dict(kv_disk_dir=str(good)), "without --kv-disk-bytes.*fix:"),
+        (dict(kv_tier="off", kv_disk_bytes=8 << 20,
+              kv_disk_dir=str(good)), "BELOW the host tier.*fix:"),
+    ]
+    for over, match in cases:
+        with pytest.raises(ValueError, match=match):
+            build_serve_engine(args(**over), model, params, tok)
+    ro = tmp_path / "ro"
+    ro.mkdir()
+    os.chmod(ro, 0o500)
+    try:
+        if not os.access(ro, os.W_OK):  # skip under root
+            with pytest.raises(ValueError, match="not writable.*fix:"):
+                build_serve_engine(
+                    args(kv_disk_bytes=8 << 20, kv_disk_dir=str(ro)),
+                    model, params, tok,
+                )
+    finally:
+        os.chmod(ro, 0o700)
+    # the well-formed config constructs the tier
+    eng = build_serve_engine(
+        args(kv_disk_bytes=8 << 20, kv_disk_dir=str(good)),
+        model, params, tok,
+    )
+    assert eng._kv_disk is not None
+    assert eng._kv_disk.capacity_bytes == 8 << 20
+
+
+# --------------------------------------------- SIGKILL crash-restart
+def test_sigkill_restart_refuses_torn_serves_survivors(tmp_path):
+    """The full crash drill on a REAL backend process: serve (spilling
+    segments), SIGKILL it, tear one segment's tail (the on-disk state a
+    crash mid-spill leaves), restart on the same directory — the torn
+    segment is refused, the survivors are re-indexed, and the restarted
+    process serves the same prompt bitwise-identically from disk."""
+    from tests.test_fleet import _get, _post, _spawn_backend
+
+    d = tmp_path / "kv"
+    d.mkdir()
+    env = {
+        "FLEET_BACKEND_KV_HOST_BYTES": str(1 << 20),
+        "FLEET_BACKEND_KV_DISK_BYTES": str(64 << 20),
+        "FLEET_BACKEND_KV_DISK_DIR": str(d),
+    }
+    body = {"tokens": list(range(1, 40)), "max_new_tokens": 6}
+
+    proc, addr = _spawn_backend(step_delay=0, extra_env=env)
+    try:
+        base = f"http://{addr}"
+        status, out = _post(base, "/v1/completions", body)
+        assert status == 200
+        first = out["tokens"]
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            dt = _get(base, "/cachez").get("disk_tier") or {}
+            if dt.get("segments", 0) >= 2:
+                break
+            time.sleep(0.2)
+        else:
+            pytest.fail("backend never spilled segments to disk")
+    finally:
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=10)
+
+    segs = sorted(d.glob("*.skvp"), key=os.path.getmtime)
+    assert len(segs) >= 2
+    torn = segs[-1]
+    torn.write_bytes(torn.read_bytes()[:-9])
+
+    proc, addr = _spawn_backend(step_delay=0, extra_env=env)
+    try:
+        base = f"http://{addr}"
+        dt = _get(base, "/cachez").get("disk_tier") or {}
+        assert dt["torn_refused"] >= 1
+        assert dt["resumed_segments"] >= 1
+        assert not torn.exists()  # refused AND unlinked
+        status, out = _post(base, "/v1/completions", body)
+        assert status == 200
+        assert out["tokens"] == first  # bitwise (greedy, same seed)
+        dt = _get(base, "/cachez").get("disk_tier") or {}
+        assert dt["restored_pages"] >= 1
+    finally:
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=10)

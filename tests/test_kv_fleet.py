@@ -1,0 +1,279 @@
+"""Fleet-wide content-addressed prefix store (ISSUE-19 peer fetch).
+
+Two-process acceptance walk plus router units: a backend advertises
+its held chain digests on /cachez, peers fetch page chains digest-
+keyed over ``GET /kv/pages?digest=``, the router folds advertisements
+into a fleet digest map and gates request-path fetches on the
+measured fetch-vs-recompute breakeven, and a stone-cold host joining
+a warm fleet is bulk-warmed so the shared prompt prefills with ~zero
+computed tokens (/cachez-delta accounting) and decodes bitwise-
+identically to the warm host.
+"""
+
+import signal
+import threading
+import time
+import types
+
+import pytest
+
+from shifu_tpu.fleet import (
+    BackendClient,
+    BackendConfig,
+    BackendError,
+    FleetRouter,
+)
+from shifu_tpu.infer.kvtier import chain_keys, deserialize_pages
+from tests.test_fleet import _get, _make_router, _post, _spawn_backend
+
+# Shared "system prompt" (two full 16-token pages) plus a short
+# per-request tail — the shape peer warming exists for.
+_SHARED = list(range(1, 33))
+_PROMPT = _SHARED + [7, 11, 13, 17, 19, 23, 29]
+_BODY = {"tokens": _PROMPT, "max_new_tokens": 4}
+
+
+@pytest.fixture(scope="module")
+def warm_cold(tmp_path_factory):
+    """Backend A warm (host+disk tiers, mirror-on, already served the
+    shared prompt and advertises its chain), backend B stone cold
+    (host tier only). Yields (addrA, addrB, A's decode tokens)."""
+    d = tmp_path_factory.mktemp("kv_a")
+    env_a = {
+        "FLEET_BACKEND_KV_HOST_BYTES": str(1 << 20),
+        "FLEET_BACKEND_KV_DISK_BYTES": str(64 << 20),
+        "FLEET_BACKEND_KV_DISK_DIR": str(d),
+    }
+    env_b = {"FLEET_BACKEND_KV_HOST_BYTES": str(1 << 20)}
+    procs = []
+    try:
+        pa, addr_a = _spawn_backend(step_delay=0, extra_env=env_a)
+        procs.append(pa)
+        pb, addr_b = _spawn_backend(step_delay=0, extra_env=env_b)
+        procs.append(pb)
+        status, out = _post(
+            f"http://{addr_a}", "/v1/completions", _BODY
+        )
+        assert status == 200
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            dg = _get(f"http://{addr_a}", "/cachez").get("digests") or {}
+            if dg.get("count", 0) >= 2:
+                break
+            time.sleep(0.2)
+        else:
+            pytest.fail("warm backend never advertised its digests")
+        yield addr_a, addr_b, out["tokens"]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGKILL)
+        for p in procs:
+            p.wait(timeout=10)
+
+
+# ------------------------------------------------------- wire surface
+def test_kv_pages_digest_route(warm_cold):
+    addr_a, _, _ = warm_cold
+    b = BackendClient(addr_a, BackendConfig(
+        connect_timeout_s=10.0, probe_timeout_s=5.0,
+        read_timeout_s=60.0,
+    ))
+    b.cachez()
+    held = b.held_digests()
+    keys = chain_keys(_PROMPT, 16, b"")
+    tip = keys[-1].hex()
+    assert tip in held and held[tip] == keys[0].hex()
+    # digest-keyed export: the whole chain in one validated SKVP frame
+    frame = b.kv_pages_digest(tip)
+    header, leaves = deserialize_pages(frame)
+    assert header["page_size"] == 16
+    assert header["meta"]["digest"] == tip
+    assert leaves
+    # unknown digest -> 404, retryable (requester just prefills cold)
+    with pytest.raises(BackendError) as ei:
+        b.kv_pages_digest("0" * 64)
+    assert ei.value.status == 404 and ei.value.retryable
+    # non-hex digest -> 400
+    with pytest.raises(BackendError) as ei:
+        b.kv_pages_digest("not-a-digest")
+    assert ei.value.status == 400
+
+
+# ------------------------------------------------------- router units
+def _fake_backend(addr, ts, held, detached=False):
+    b = types.SimpleNamespace(addr=addr, cache_ts=ts, detached=detached)
+    b.held_digests = lambda h=held: dict(h)
+    return b
+
+
+def test_fleet_digest_map_folds_and_caches_on_scrape_signature():
+    b1 = _fake_backend("a:1", 1.0, {"d1": None, "d2": "d1"})
+    b2 = _fake_backend("b:2", 1.0, {"d2": "d1"})
+    b3 = _fake_backend("c:3", 1.0, {"d9": None}, detached=True)
+    fake = types.SimpleNamespace(
+        backends=[b1, b2, b3], _peer_lock=threading.Lock(),
+        _digest_map={}, _digest_map_sig=None,
+    )
+    m = FleetRouter.fleet_digest_map(fake)
+    assert [h.addr for h in m["d1"]] == ["a:1"]
+    assert [h.addr for h in m["d2"]] == ["a:1", "b:2"]
+    assert "d9" not in m  # detached backends advertise nothing
+    # unchanged scrape timestamps -> the SAME map object (no rebuild)
+    assert FleetRouter.fleet_digest_map(fake) is m
+    # a fresh scrape on any backend invalidates the signature
+    b2.cache_ts = 2.0
+    b2.held_digests = lambda: {}
+    m2 = FleetRouter.fleet_digest_map(fake)
+    assert m2 is not m and [h.addr for h in m2["d2"]] == ["a:1"]
+
+
+def test_peer_wins_explores_unmeasured_then_gates():
+    src = types.SimpleNamespace(addr="s:1")
+    dst = types.SimpleNamespace(health={"prefill_tok_per_ms": 10.0})
+    fake = types.SimpleNamespace(
+        _peer_bw={}, _xfer_bytes_per_token=None,
+    )
+    # any side unmeasured -> explore
+    assert FleetRouter._peer_wins(fake, src, 64, dst)
+    fake._xfer_bytes_per_token = 1e6
+    assert FleetRouter._peer_wins(fake, src, 64, dst)
+    fake._peer_bw["s:1"] = 1.0  # ~1 byte/ms: a hopeless link
+    assert not FleetRouter._peer_wins(fake, src, 64, dst)
+    fake._peer_bw["s:1"] = 1e9
+    assert FleetRouter._peer_wins(fake, src, 64, dst)
+    # destination prefill rate unknown -> explore
+    assert FleetRouter._peer_wins(
+        fake, src, 64, types.SimpleNamespace(health=None)
+    )
+
+
+def test_peer_prefill_picks_deepest_chain_and_skips_held():
+    keys = chain_keys(_SHARED, 16, b"")
+    calls = []
+    holder = types.SimpleNamespace(addr="h:1", detached=False)
+    holder.routable = lambda: True
+    dst = types.SimpleNamespace(addr="d:1")
+    dst.has_host_tier = lambda: True
+    dst.held_digests = lambda: {}
+    fake = types.SimpleNamespace(
+        fleet_digest_map=lambda: {
+            keys[0].hex(): [holder], keys[1].hex(): [holder],
+        },
+        _peer_page_sizes=lambda: [16],
+        _affinity_salt=lambda body: b"",
+        _peer_fetch=lambda req, src, d_, dig, cov, **kw: calls.append(
+            (src.addr, dig, cov)
+        ),
+    )
+    req = types.SimpleNamespace(body={"tokens": _PROMPT}, trace=None)
+    FleetRouter._peer_prefill(fake, req, dst)
+    assert calls == [("h:1", keys[1].hex(), 32)]  # deepest digest wins
+    # dst already holds the fleet's deepest prefix -> nothing to fetch
+    calls.clear()
+    dst.held_digests = lambda: {keys[1].hex(): keys[0].hex()}
+    FleetRouter._peer_prefill(fake, req, dst)
+    assert calls == []
+    # the only holder IS dst -> nothing to fetch from
+    dst.held_digests = lambda: {}
+    fake.fleet_digest_map = lambda: {keys[1].hex(): [dst]}
+    FleetRouter._peer_prefill(fake, req, dst)
+    assert calls == []
+
+
+def test_peer_warm_retries_after_failed_fetch():
+    keys = chain_keys(_SHARED, 16, b"")
+    holder = types.SimpleNamespace(addr="h:1", detached=False)
+    holder.routable = lambda: True
+    holder.has_host_tier = lambda: True
+    holder.held_digests = lambda: {keys[1].hex(): keys[0].hex()}
+    cold = types.SimpleNamespace(addr="c:1", detached=False)
+    cold.routable = lambda: True
+    cold.has_host_tier = lambda: True
+    cold.held_digests = lambda: {}
+    cold.refresh_cachez = lambda: None
+    outcome = {"ok": False}
+
+    def mk_fake(backends, held_by, fetch):
+        return types.SimpleNamespace(
+            backends=backends,
+            _peer_warmed=set(),
+            _peer_warm_strikes={},
+            _lock=threading.Lock(),
+            peer_warmups=0,
+            flight=types.SimpleNamespace(record=lambda *a, **k: None),
+            fleet_digest_map=lambda: {keys[1].hex(): held_by},
+            _peer_fetch=fetch,
+        )
+
+    fake = mk_fake(
+        [holder, cold], [holder], lambda *a, **kw: outcome["ok"]
+    )
+    # every fetch fails (startup-scramble timeout): the backend must
+    # stay eligible so the next prober tick retries, not stay cold
+    # forever.
+    assert FleetRouter.maybe_peer_warm(fake) == 0
+    assert "c:1" not in fake._peer_warmed
+    outcome["ok"] = True
+    assert FleetRouter.maybe_peer_warm(fake) == 1
+    assert "c:1" in fake._peer_warmed and fake.peer_warmups == 1
+    assert fake._peer_warm_strikes == {}  # success clears the count
+    # ...but a DETERMINISTIC refusal (page-size-mismatched fleet) is
+    # abandoned after three all-failed rounds, not retried every tick.
+    fake3 = mk_fake([holder, cold], [holder], lambda *a, **kw: False)
+    for _ in range(3):
+        assert FleetRouter.maybe_peer_warm(fake3) == 0
+    assert "c:1" in fake3._peer_warmed
+    # nothing fetchable (sole holder IS the cold host) -> marked done,
+    # no per-tick re-walk
+    lonely = types.SimpleNamespace(addr="l:1", detached=False)
+    lonely.routable = lambda: True
+    lonely.has_host_tier = lambda: True
+    lonely.held_digests = lambda: {}
+    fake2 = mk_fake(
+        [lonely], [lonely],
+        lambda *a, **kw: pytest.fail("nothing to fetch"),
+    )
+    assert FleetRouter.maybe_peer_warm(fake2) == 0
+    assert "l:1" in fake2._peer_warmed
+
+
+# ------------------------------------------- cold host joins warm fleet
+def test_cold_host_warms_from_peer_and_serves_warm(warm_cold):
+    addr_a, addr_b, warm_tokens = warm_cold
+    router = _make_router([addr_a, addr_b])
+    for b in router.backends:
+        router.probe_backend(b)
+        b.refresh_cachez()
+    cold = next(b for b in router.backends if b.addr == addr_b)
+    assert cold.held_digests() == {}  # stone cold before the warmup
+    before = _get(f"http://{addr_b}", "/cachez")["prefix_cache"]
+
+    moved = router.maybe_peer_warm()
+    assert moved == 1  # one chain tip carries the whole shared prefix
+    # warming is once-per-backend: the next tick is a no-op
+    assert router.maybe_peer_warm() == 0
+    held = cold.held_digests()
+    keys = chain_keys(_SHARED, 16, b"")
+    assert keys[-1].hex() in held
+    ps = router.peer_stats()
+    assert ps["fetches"] == 1 and ps["warmups"] == 1
+    assert ps["pages"] == 2 and ps["bytes"] > 0
+    assert ps["failures"] == 0
+    assert addr_b in ps["warmed_backends"]
+    c = router.counters()
+    assert c["peer_fetches"] == 1 and c["peer_warmups"] == 1
+    # the router /cachez doc now carries the peer block (obs top line)
+    assert router.cache_stats()["peer"]["fetches"] == 1
+
+    # The peer-warmed host serves the shared prompt with ~zero
+    # computed prefill tokens: the two shared pages restore from the
+    # ingested tier, only the 7-token tail computes.
+    status, out = _post(f"http://{addr_b}", "/v1/completions", _BODY)
+    assert status == 200
+    assert out["tokens"] == warm_tokens  # bitwise (greedy, same seed)
+    after = _get(f"http://{addr_b}", "/cachez")["prefix_cache"]
+    hit = after["hit_tokens"] - before["hit_tokens"]
+    prompt = after["prompt_tokens"] - before["prompt_tokens"]
+    assert hit >= len(_SHARED)
+    assert prompt - hit <= len(_PROMPT) - len(_SHARED)
