@@ -150,6 +150,10 @@ def main(argv=None):
             out["loadgen"] = bench_loadgen()
         except Exception as e:
             out["loadgen"] = {"error": f"{type(e).__name__}: {e}"}
+        try:
+            out["autoscale"] = bench_autoscale()
+        except Exception as e:
+            out["autoscale"] = {"error": f"{type(e).__name__}: {e}"}
     # Runtime self-telemetry in the full ledger: device-memory rollup
     # + how many compiles the bench's engines paid (the obs registry
     # counted them via the engines' tracked programs).
@@ -377,6 +381,14 @@ def _compact(out: dict) -> dict:
         ("lg_p99_ttft_ms", g("loadgen", "lg_p99_ttft_ms")),
         ("lg_err_rate", g("loadgen", "lg_err_rate")),
         ("lg_verdict", g("loadgen", "lg_verdict")),
+        # elastic fleet control plane (round 20): client p99 TTFT with
+        # the autoscale controller in the loop, how many pool/role
+        # actions it completed, mix-shift -> role-flip lag, and the
+        # batch-admission fraction the envelope left open
+        ("as_p99_ttft_ms", g("autoscale", "as_p99_ttft_ms")),
+        ("as_scale_actions", g("autoscale", "as_scale_actions")),
+        ("as_flip_lag_s", g("autoscale", "as_flip_lag_s")),
+        ("as_backfill_util", g("autoscale", "as_backfill_util")),
         ("fit_unstable", any(
             g(*sv, leg, "fit_unstable") for leg in
             ("bf16", "int8", "int8_kv", "int8_kv_b16s")
@@ -1429,6 +1441,263 @@ def bench_rollout():
             "rollout_report": {
                 "status": report["rollout"]["status"],
                 "updated": len(report["rollout"]["updated"]),
+            },
+        }
+    finally:
+        if prober is not None:
+            prober.stop()
+        if rsrv is not None:
+            rsrv.shutdown()
+            rsrv.runner.shutdown()
+        for srv in bsrvs:
+            srv.shutdown()
+            srv.runner.shutdown()
+
+
+def bench_autoscale():
+    """Elastic vs fixed fleet control under a bursty, shifting load
+    (round 20: the autoscale control plane, measured end to end).
+
+    Three small engines in this process: two base hosts (one "both",
+    one "prefill" — the flip candidate) behind a FleetRouter, plus one
+    standby host whose server runs but which starts OUTSIDE the
+    roster. Both phases replay the same load schedule — an overload
+    burst, then a moderate decode-heavy steady state:
+
+      * **fixed** — static two-host pool, no controller. The control.
+      * **elastic** — a tight SLO engine on the router plus an
+        :class:`AutoscaleController` (short dwell/tick, fast SLO
+        windows, a step-time envelope calibrated to ~0.9 utilization
+        of the measured steady decode step). The burst burns headroom
+        below the low watermark -> the standby is readiness-gated and
+        attached; recovery lifts headroom over the high watermark ->
+        the emptiest activated host is parked; the decode-heavy tail
+        (idle prefill host, zero handoff attempts) drives one real
+        drain -> /rolez -> resume role flip.
+
+    Headline numbers: ``as_p99_ttft_ms`` (client p99 TTFT over the
+    whole elastic phase, vs ``fixed_p99_ttft_ms``),
+    ``as_scale_actions`` (pool actions + flips the controller
+    completed), ``as_flip_lag_s`` (mix shift -> flip committed), and
+    ``as_backfill_util`` (the batch-admission fraction the envelope
+    left open — 1.0 means pacing never engaged)."""
+    import tempfile
+    import threading
+    import urllib.error
+    import urllib.request
+
+    from shifu_tpu.checkpoint import load_params_dir, save_params_dir
+    from shifu_tpu.fleet import (
+        AutoscaleController,
+        AutoscalePolicy,
+        BackendClient,
+        Envelope,
+        FleetProber,
+        FleetRouter,
+        RouterAdmin,
+    )
+    from shifu_tpu.infer import SampleConfig, make_server
+    from shifu_tpu.infer.engine import PagedEngine
+    from shifu_tpu.models.transformer import Transformer, TransformerConfig
+    from shifu_tpu.obs import FlightRecorder, MetricsRegistry
+    from shifu_tpu.obs.slo import SLOEngine, TierBudget
+
+    cfg = TransformerConfig.small()
+    model = Transformer(cfg)
+    tmp = tempfile.mkdtemp(prefix="shifu_bench_autoscale_")
+    ck = save_params_dir(
+        os.path.join(tmp, "v0"), model.init(jax.random.key(0))
+    )
+    params = load_params_dir(ck)
+    bsrvs, prober, rsrv = [], None, None
+    try:
+        for role in ("both", "prefill", "both"):
+            eng = PagedEngine(
+                model, params, max_slots=4, max_len=128, page_size=16,
+                prefill_buckets=(32, 128),
+                sample_cfg=SampleConfig(temperature=0.0),
+            )
+            srv = make_server(eng, port=0, ckpt_path=ck, role=role)
+            threading.Thread(
+                target=srv.serve_forever, daemon=True
+            ).start()
+            bsrvs.append(srv)
+        addrs = [f"127.0.0.1:{s.server_port}" for s in bsrvs]
+        standby_addr = addrs[2]  # server up, NOT in the roster
+        clients = [BackendClient(a) for a in addrs[:2]]
+        for c in clients:
+            c.probe()
+            c.models()
+        router = FleetRouter(
+            clients, metrics=MetricsRegistry(), flight=FlightRecorder()
+        )
+        prober = FleetProber(router, interval_s=0.1)
+        prober.start()
+        rsrv = make_server(router, port=0)
+        threading.Thread(target=rsrv.serve_forever, daemon=True).start()
+        base = f"http://127.0.0.1:{rsrv.server_port}"
+        admin = RouterAdmin(base)
+
+        def one(i, max_new, sink, errs):
+            req = urllib.request.Request(
+                base + "/v1/completions",
+                data=json.dumps({
+                    "tokens": [1, 2, 3 + (i % 5)],
+                    "max_new_tokens": max_new,
+                }).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=120) as r:
+                    out = json.loads(r.read())
+                t = out.get("timing", {}).get("ttft_ms")
+                if t is not None:
+                    sink.append(t)
+            except (urllib.error.HTTPError, urllib.error.URLError,
+                    OSError):
+                errs.append(1)
+
+        def drive(n_threads, max_new, sink, errs, stop_evt):
+            def loop(tid):
+                i = tid
+                while not stop_evt.is_set():
+                    one(i, max_new, sink, errs)
+                    i += n_threads
+            ts = [threading.Thread(target=loop, args=(t,), daemon=True)
+                  for t in range(n_threads)]
+            for t in ts:
+                t.start()
+            return ts
+
+        def run_phase(n_threads, max_new, duration_s=None,
+                      until=None, deadline_s=60.0):
+            """Drive load; stop after duration_s, or when until()
+            (polled) fires / deadline passes. -> (ttfts, errs, lag_s)"""
+            sink, errs = [], []
+            stop_evt = threading.Event()
+            ts = drive(n_threads, max_new, sink, errs, stop_evt)
+            t0 = time.monotonic()
+            lag = None
+            while True:
+                now = time.monotonic() - t0
+                if duration_s is not None and now >= duration_s:
+                    break
+                if until is not None and until():
+                    lag = now
+                    break
+                if until is not None and now >= deadline_s:
+                    break
+                time.sleep(0.2)
+            stop_evt.set()
+            for t in ts:
+                t.join(120)
+            return sink, errs, lag
+
+        def p99(vals):
+            if not vals:
+                return None
+            vals = sorted(vals)
+            return round(vals[min(int(0.99 * len(vals)),
+                                  len(vals) - 1)], 3)
+
+        one(0, 8, [], [])  # warm compiles on both hop paths
+
+        # --- FIXED control: static pool, same burst + steady schedule.
+        fx_burst, fx_berrs, _ = run_phase(12, 32, duration_s=8.0)
+        fx_steady, fx_serrs, _ = run_phase(4, 16, duration_s=8.0)
+        fixed_ttfts = fx_burst + fx_steady
+        fixed_errs = len(fx_berrs) + len(fx_serrs)
+
+        # Calibrate the SLO budget between the two load levels (the
+        # burst must burn it, the steady tail must not) and the
+        # envelope's step budget to ~0.9 utilization at steady state.
+        steady_p99 = p99(fx_steady) or 50.0
+        lat = admin.statz().get("latency") or {}
+        tps = lat.get("decode_tokens_per_s_p50")
+        envelope = None
+        if isinstance(tps, (int, float)) and tps > 0:
+            envelope = Envelope(step_ms=(1000.0 / tps) / 0.9, ramp=0.8)
+        slo = SLOEngine(
+            [TierBudget(tier="interactive",
+                        p99_ttft_ms=max(1.0, steady_p99 * 2.0))],
+            fast_window_s=5.0, slow_window_s=15.0,
+            sample_interval_s=0.2,
+            metrics=router.metrics, flight=router.flight,
+        )
+        router.set_slo(slo)
+
+        # --- ELASTIC: same schedule with the controller in the loop.
+        ctl = AutoscaleController(
+            admin, standby=[standby_addr],
+            policy=AutoscalePolicy(
+                low_headroom=0.15, high_headroom=0.60,
+                dwell_s=2.0, tick_s=0.5, flip_margin=1.5,
+                min_backends=1,
+            ),
+            envelope=envelope,
+            ready_timeout_s=30.0, drain_timeout_s=60.0,
+        )
+        ctl_report = {}
+
+        def run_ctl():
+            ctl_report.update(ctl.run())
+
+        ct = threading.Thread(target=run_ctl, daemon=True)
+        ct.start()
+        el_burst, el_berrs, up_lag = run_phase(
+            12, 32, until=lambda: ctl.report["scale_ups"] >= 1,
+            deadline_s=30.0,
+        )
+        # Mix shift: burst over, decode-heavy steady tail. Headroom
+        # recovery parks the extra host; the idle prefill host flips.
+        el_steady, el_serrs, flip_lag = run_phase(
+            4, 16, until=lambda: ctl.report["role_flips"] >= 1,
+            deadline_s=90.0,
+        )
+        ctl.stop()
+        ct.join(120)
+        elastic_ttfts = el_burst + el_steady
+        elastic_errs = len(el_berrs) + len(el_serrs)
+
+        scale_actions = (ctl_report.get("scale_ups", 0)
+                         + ctl_report.get("scale_downs", 0)
+                         + ctl_report.get("role_flips", 0))
+        backfill_util = 1.0
+        for a in ctl_report.get("actions", ()):
+            if a.get("action") == "envelope":
+                backfill_util = a["scale"]
+        ascale = (admin.statz() or {}).get("autoscale") or {}
+        return {
+            "as_p99_ttft_ms": p99(elastic_ttfts),
+            "as_scale_actions": scale_actions,
+            "as_flip_lag_s": (round(flip_lag, 2)
+                              if flip_lag is not None else None),
+            "as_backfill_util": round(backfill_util, 4),
+            "fixed_p99_ttft_ms": p99(fixed_ttfts),
+            "fixed_requests": len(fixed_ttfts),
+            "fixed_err_rate": round(
+                fixed_errs / max(len(fixed_ttfts) + fixed_errs, 1), 4
+            ),
+            "elastic_requests": len(elastic_ttfts),
+            "elastic_err_rate": round(
+                elastic_errs / max(len(elastic_ttfts) + elastic_errs, 1),
+                4,
+            ),
+            "scale_up_lag_s": (round(up_lag, 2)
+                               if up_lag is not None else None),
+            "controller": {
+                "status": ctl_report.get("status"),
+                "ticks": ctl_report.get("ticks"),
+                "scale_ups": ctl_report.get("scale_ups"),
+                "scale_downs": ctl_report.get("scale_downs"),
+                "role_flips": ctl_report.get("role_flips"),
+                "failures": ctl_report.get("failures"),
+            },
+            "statz_autoscale": {
+                k: ascale.get(k)
+                for k in ("pool", "status", "admission_scale")
+                if ascale.get(k) is not None
             },
         }
     finally:

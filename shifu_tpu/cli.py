@@ -1395,7 +1395,8 @@ def cmd_batch(args) -> int:
 
 
 def cmd_fleet(args) -> int:
-    """``shifu_tpu fleet rollout|snapshot`` — fleet administration.
+    """``shifu_tpu fleet rollout|snapshot|autoscale`` — fleet
+    administration.
 
     ``rollout --ckpt PATH --router URL [--max-unavailable N]
     [--abort-on-slo]``: zero-downtime rolling weight rollout across the
@@ -1413,7 +1414,23 @@ def cmd_fleet(args) -> int:
     ``snapshot --ckpt-dir ORBAX_DIR --out PARAMS_DIR``: convert a
     training checkpoint into the manifest params format
     (params-only, per-array sha256, atomically committed) — the
-    artifact ``rollout``/``/reloadz`` verifies before swapping."""
+    artifact ``rollout``/``/reloadz`` verifies before swapping.
+
+    ``autoscale --router URL [--standby host:port,...]
+    [--envelope hbm=F,step_ms=MS] [--low-headroom F --high-headroom F
+    --dwell S --tick S --flip-margin R --min-backends N] [--ticks N]``:
+    the elastic-fleet control loop (fleet/autoscale.py) — polls
+    ``/sloz`` + ``/statz`` and activates/parks standby hosts on the
+    headroom hysteresis band, flips one host's prefill/decode role
+    when the measured demand mix shifts past the margin
+    (drain -> ``POST /rolez`` -> readiness gate -> resume), and paces
+    batch admission against the declared envelope. ``--check``
+    validates the flags offline (one-line fix hints; exit 0/1) — the
+    fast CLI gate, like ``tune --check`` / ``loadgen --check``. Exit 0
+    on a clean stop, 1 when any actuator failed along the way, 2 on
+    unusable configuration."""
+    if args.action == "autoscale":
+        return _fleet_autoscale(args)
     if args.action == "snapshot":
         from shifu_tpu.checkpoint import save_params_dir
 
@@ -1465,6 +1482,64 @@ def cmd_fleet(args) -> int:
         return 1
     print(json.dumps(report))
     return 0 if report.get("status") == "complete" else 1
+
+
+def _fleet_autoscale(args) -> int:
+    """``shifu_tpu fleet autoscale`` — see :func:`cmd_fleet`."""
+    from shifu_tpu.fleet import (
+        AutoscaleController,
+        AutoscaleError,
+        AutoscalePolicy,
+        RouterAdmin,
+        check_policy,
+        parse_envelope_spec,
+        parse_fleet,
+    )
+
+    policy_kw = {
+        "low_headroom": args.low_headroom,
+        "high_headroom": args.high_headroom,
+        "dwell_s": args.dwell,
+        "tick_s": args.tick,
+        "flip_margin": args.flip_margin,
+        "min_backends": args.min_backends,
+    }
+    if args.check:
+        ok, report = check_policy(
+            policy_kw, standby=args.standby, envelope=args.envelope
+        )
+        print(json.dumps(report, indent=2))
+        return 0 if ok else 1
+    try:
+        policy = AutoscalePolicy(**policy_kw)
+        standby = parse_fleet(args.standby) if args.standby else []
+        envelope = (
+            parse_envelope_spec(args.envelope) if args.envelope else None
+        )
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    ctl = AutoscaleController(
+        RouterAdmin(args.router),
+        standby=standby, policy=policy, envelope=envelope,
+        ready_timeout_s=args.ready_timeout,
+        drain_timeout_s=args.drain_timeout,
+        max_ticks=args.ticks,
+    )
+    try:
+        report = ctl.run()
+    except AutoscaleError as e:
+        print(json.dumps({"status": "failed", "error": str(e)}))
+        return 1
+    except KeyboardInterrupt:
+        ctl.stop()
+        report = dict(ctl.report)
+        report["status"] = "interrupted"
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            json.dump(report, f, indent=2)
+    print(json.dumps(report))
+    return 0 if report.get("failures", 0) == 0 else 1
 
 
 def cmd_trace(args) -> int:
@@ -2262,9 +2337,12 @@ def main(argv=None) -> int:
              "(drain -> POST /reloadz hot-swap -> readiness gate -> "
              "resume, SLO watchdog as the brake); `snapshot` converts "
              "a training checkpoint into the checksum-manifest params "
-             "format the rollout verifies",
+             "format the rollout verifies; `autoscale` runs the "
+             "elastic-fleet control loop (SLO-headroom scaling over a "
+             "standby pool, prefill/decode role rebalancing, "
+             "envelope-paced batch backfill)",
     )
-    fl.add_argument("action", choices=["rollout", "snapshot"])
+    fl.add_argument("action", choices=["rollout", "snapshot", "autoscale"])
     model_flags(fl, schedule_default="constant")  # snapshot model build
     fl.add_argument("--router", default="http://127.0.0.1:8000",
                     help="the live fleet router's base URL (rollout "
@@ -2292,7 +2370,43 @@ def main(argv=None) -> int:
     fl.add_argument("--pause-timeout", type=float, default=300.0,
                     help="how long a paused wave waits for the SLO "
                          "verdict to clear before the rollout fails")
-    fl.add_argument("--out", help="snapshot: output params-dir path")
+    fl.add_argument("--out", help="snapshot: output params-dir path; "
+                    "autoscale: also write the run report JSON here")
+    fl.add_argument("--standby", default=None,
+                    help="autoscale: parked host pool as "
+                         "host:port,... — low SLO headroom activates "
+                         "the next one (readiness-gated, peer-warmed); "
+                         "fat headroom parks the emptiest back")
+    fl.add_argument("--envelope", default=None,
+                    help="autoscale: declared serving envelope, e.g. "
+                         "hbm=0.85,step_ms=120[,ramp=0.8] — batch "
+                         "admission is paced against it fleet-wide")
+    fl.add_argument("--low-headroom", type=float, default=0.15,
+                    help="autoscale: min per-tier SLO headroom below "
+                         "which a standby host is activated")
+    fl.add_argument("--high-headroom", type=float, default=0.60,
+                    help="autoscale: headroom above which the "
+                         "emptiest activated standby is parked")
+    fl.add_argument("--dwell", type=float, default=60.0,
+                    help="autoscale: min seconds between pool/role "
+                         "actions (the anti-flap brake; must exceed "
+                         "--tick)")
+    fl.add_argument("--tick", type=float, default=5.0,
+                    help="autoscale: control-loop period seconds")
+    fl.add_argument("--flip-margin", type=float, default=2.0,
+                    help="autoscale: how many times busier one role's "
+                         "hosts must measure than the other's before "
+                         "a drain-flip-resume role change")
+    fl.add_argument("--min-backends", type=int, default=1,
+                    help="autoscale: active-pool floor — scale-down "
+                         "and role flips never go below it")
+    fl.add_argument("--ticks", type=int, default=None,
+                    help="autoscale: stop after N ticks (default: "
+                         "run until interrupted)")
+    fl.add_argument("--check", action="store_true",
+                    help="autoscale: validate the policy flags, "
+                         "standby roster, and envelope spec (no "
+                         "network) and exit 0/1 — the tier-1 CLI gate")
     fl.set_defaults(fn=cmd_fleet)
 
     tr = sub.add_parser(

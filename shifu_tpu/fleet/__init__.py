@@ -40,6 +40,18 @@ front-end, so clients, the obs stack, and the CLI see one engine:
                schedules) and the scheduled :class:`ChaosTrack` the
                loadgen harness folds into a scenario timeline
                (SIGKILL / drain / resume / mid-run rollout).
+``autoscale``  the elastic fleet control plane
+               (``shifu_tpu fleet autoscale``): a control-loop daemon
+               over ``/sloz`` + ``/statz`` that activates/parks
+               standby hosts on SLO-headroom hysteresis bands,
+               rebalances prefill/decode roles on the measured demand
+               mix (drain -> ``POST /rolez`` -> resume), and paces
+               batch backfill against the declared ``envelope``
+               budget — every decision noted on the router, every
+               actuator failure degrading to "retry next tick".
+``envelope``   the declarative serving envelope the controller paces
+               against: HBM high-water fraction + a step-time power
+               proxy folded into one batch-admission scale.
 
 See docs/architecture.md ("The serving fleet") for the design and the
 failure model, and README.md for the serving-topology ladder
@@ -74,14 +86,25 @@ from shifu_tpu.fleet.rollout import (
     RolloutError,
     RouterAdmin,
 )
+from shifu_tpu.fleet.autoscale import (
+    AutoscaleController,
+    AutoscaleError,
+    AutoscalePolicy,
+    check_policy,
+)
+from shifu_tpu.fleet.envelope import Envelope, parse_envelope_spec
 
 __all__ = [
+    "AutoscaleController",
+    "AutoscaleError",
+    "AutoscalePolicy",
     "BackendClient",
     "BackendConfig",
     "BackendError",
     "ChaosEvent",
     "ChaosTrack",
     "CircuitBreaker",
+    "Envelope",
     "FaultSpec",
     "FleetProber",
     "FleetRouter",
@@ -91,9 +114,11 @@ __all__ = [
     "RolloutError",
     "RouterAdmin",
     "build_fleet",
+    "check_policy",
     "faults_from_env",
     "install_fault_hooks",
     "parse_chaos_events",
+    "parse_envelope_spec",
     "parse_fleet",
     "wait_ready",
 ]

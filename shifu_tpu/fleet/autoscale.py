@@ -1,0 +1,564 @@
+"""Elastic fleet control plane: SLO-headroom autoscaling, dynamic
+prefill/decode role rebalancing, and envelope-paced batch backfill.
+
+ROADMAP item 3's closing loop. Every signal and actuator this module
+needs already exists — PR 12's ``/sloz`` publishes per-tier burn-rate
+headroom, PR 11's backends report ``prefill_tok_per_ms`` EMAs and the
+router counts disagg handoff outcomes per prefill host, PR 6's
+drain/resume/readiness machinery plus PR 15's peer warmup make adding
+or reshaping a host cheap. The :class:`AutoscaleController` is the
+measure-and-act daemon that closes it, in the Autocomp spirit applied
+one level up: fleet SHAPE (size, role mix, backfill pace) is picked by
+measurement every tick, never by static assignment.
+
+One ``tick()`` is one decision round against the router's ``/statz`` +
+``/sloz``:
+
+1. **Envelope** (dwell-independent): fold the fleet's worst HBM
+   high-water fraction and the router-measured decode step time into
+   the declared :class:`~shifu_tpu.fleet.envelope.Envelope`, and push
+   the resulting batch-admission scale to the front-end
+   (``POST /envelopez``) when it moved materially. A scrape gap (no
+   signal measured anywhere) holds the last pushed scale.
+2. **Scale** (hysteresis bands + min-dwell): min per-tier SLO headroom
+   below the low-water mark activates the next parked standby host —
+   readiness-gated through the bootstrap path (:func:`wait_ready`),
+   then admitted via ``POST /fleetz`` where the router probes it again
+   and peer-warms it (``maybe_peer_warm``). Headroom above the
+   high-water mark drains and parks the emptiest ACTIVATED standby
+   (the declared base fleet is never parked). Between the bands, and
+   within ``dwell_s`` of the last action, the pool holds — the fleet
+   never flaps at a boundary.
+3. **Rebalance roles**: when the measured prefill/decode demand mix
+   (per-role load averages + the per-tick delta of disagg handoff
+   attempts) shifts past ``flip_margin``, one host is drained through
+   the router, its role flipped via ``POST /rolez`` (legal only on an
+   idle engine), readiness-gated until it advertises the new role, and
+   resumed.
+
+**Every actuator failure degrades to "do nothing and retry next
+tick"**: an unreachable router skips the round, a dead standby leaves
+the pool unchanged, a drain that never empties resumes the host
+unflipped. The controller can always crash or stop without leaving
+the fleet worse than it found it — the one deliberately asymmetric
+case (a host that flipped but whose resume failed) is recorded as
+``role_flip_failed`` with ``flipped=true`` so the operator knows the
+router, not the host, needs the retry.
+
+Every decision is visible three ways: ``autoscale_*`` flight events
+and the ``shifu_autoscale_*`` / ``shifu_role_flips_total`` /
+``shifu_envelope_*`` metric families on the ROUTER (reported via
+``POST /autoscalez`` so one scrape shows traffic and reshaping
+together), and the ``/statz`` ``autoscale`` block ``obs top`` renders.
+
+Structure mirrors :class:`~shifu_tpu.fleet.rollout.RolloutController`:
+injectable clock/sleep/backend-factory, a :class:`RouterAdmin` for all
+router HTTP, fake-clock unit tests driving ``tick()`` directly and a
+two-process acceptance walk driving ``run()`` against real backends
+(tests/test_autoscale.py, tests/test_autoscale_fleet.py).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence
+
+from shifu_tpu.fleet.backend import BackendClient, BackendError
+from shifu_tpu.fleet.bootstrap import parse_fleet, wait_ready
+from shifu_tpu.fleet.envelope import Envelope, parse_envelope_spec
+from shifu_tpu.fleet.rollout import RolloutError, RouterAdmin
+
+__all__ = [
+    "AutoscaleController",
+    "AutoscaleError",
+    "AutoscalePolicy",
+    "check_policy",
+]
+
+
+class AutoscaleError(RuntimeError):
+    """The controller cannot run at all (e.g. the router is
+    unreachable before the first tick). Mid-run failures never raise —
+    they degrade to a skipped tick and a note."""
+
+
+@dataclass(frozen=True)
+class AutoscalePolicy:
+    """The control loop's declared behavior. ``low_headroom`` /
+    ``high_headroom`` are the hysteresis band over min per-tier SLO
+    headroom (1 - burn; /sloz): below low activates a standby, above
+    high parks one, between holds. ``dwell_s`` is the minimum time
+    between pool/role ACTIONS (envelope pushes are exempt — pacing
+    backfill is how the fleet avoids needing an action). ``tick_s``
+    paces ``run()``. ``flip_margin`` is how many times busier one
+    role's hosts must be than the other's before a role flip.
+    ``min_backends`` floors the active pool — scale-down and role
+    flips never drop the serving set below it."""
+
+    low_headroom: float = 0.15
+    high_headroom: float = 0.60
+    dwell_s: float = 60.0
+    tick_s: float = 5.0
+    flip_margin: float = 2.0
+    min_backends: int = 1
+
+    def __post_init__(self):
+        if not (0.0 <= self.low_headroom < self.high_headroom <= 1.0):
+            raise ValueError(
+                "watermarks must satisfy 0 <= low < high <= 1, got "
+                f"low={self.low_headroom} high={self.high_headroom} — "
+                "e.g. --low-headroom 0.15 --high-headroom 0.6"
+            )
+        if self.tick_s <= 0.0:
+            raise ValueError(
+                f"tick must be > 0s, got {self.tick_s} — e.g. --tick 5"
+            )
+        if self.dwell_s <= self.tick_s:
+            raise ValueError(
+                f"dwell ({self.dwell_s}s) must exceed the tick "
+                f"({self.tick_s}s) or every tick could act — "
+                "e.g. --dwell 60 --tick 5"
+            )
+        if self.flip_margin <= 1.0:
+            raise ValueError(
+                f"flip-margin must be > 1 (it is a ratio), got "
+                f"{self.flip_margin} — e.g. --flip-margin 2"
+            )
+        if self.min_backends < 1:
+            raise ValueError(
+                f"min-backends must be >= 1, got {self.min_backends}"
+            )
+
+
+def check_policy(policy_kw: Optional[dict] = None,
+                 standby: Optional[str] = None,
+                 envelope: Optional[str] = None) -> tuple:
+    """The ``fleet autoscale --check`` gate: validate the policy flags
+    (watermarks ordered, dwell > tick), the standby roster syntax, and
+    the envelope spec — no network anywhere. Returns ``(ok, report)``
+    where ``report["checks"]`` carries one row per validation with a
+    one-line fix hint on failure (the hints are the ValueError texts
+    the real constructors raise, so --check and runtime agree by
+    construction)."""
+    checks: List[dict] = []
+
+    def _run(name: str, fn) -> None:
+        try:
+            detail = fn()
+        except ValueError as e:
+            checks.append({"check": name, "ok": False, "hint": str(e)})
+        else:
+            row = {"check": name, "ok": True}
+            if detail:
+                row.update(detail)
+            checks.append(row)
+
+    _run("policy", lambda: (
+        AutoscalePolicy(**(policy_kw or {})) and None
+    ))
+    _run("standby", lambda: (
+        {"standby": parse_fleet(standby)} if standby
+        else {"standby": [], "note": "no standby pool — scaling off"}
+    ))
+    _run("envelope", lambda: (
+        {"envelope": str(parse_envelope_spec(envelope))} if envelope
+        else {"note": "no envelope — backfill pacing off"}
+    ))
+    ok = all(c["ok"] for c in checks)
+    return ok, {"ok": ok, "checks": checks}
+
+
+class AutoscaleController:
+    """See module docstring. ``tick()`` is one synchronous decision
+    round (what the unit tests drive, fake clock in hand); ``run()``
+    notes ``begin``, ticks every ``policy.tick_s`` until ``stop()`` or
+    ``max_ticks``, notes ``end``, and returns the report dict."""
+
+    def __init__(
+        self,
+        admin: RouterAdmin,
+        *,
+        standby: Sequence[str] = (),
+        policy: Optional[AutoscalePolicy] = None,
+        envelope: Optional[Envelope] = None,
+        make_backend=BackendClient,
+        ready_timeout_s: float = 60.0,
+        drain_timeout_s: float = 120.0,
+        poll_s: float = 0.1,
+        max_ticks: Optional[int] = None,
+        clock=time.monotonic,
+        sleep=time.sleep,
+    ):
+        self.admin = admin
+        self.policy = policy if policy is not None else AutoscalePolicy()
+        self.standby = list(standby)
+        self.envelope = envelope
+        self.make_backend = make_backend
+        self.ready_timeout_s = float(ready_timeout_s)
+        self.drain_timeout_s = float(drain_timeout_s)
+        self.poll_s = float(poll_s)
+        self.max_ticks = max_ticks
+        self.clock = clock
+        self.sleep = sleep
+        self._stop = False
+        # Standby addrs THIS controller activated — the only hosts
+        # scale-down may ever park (the base fleet is the operator's).
+        self._activated: set = set()
+        self._last_action_ts: Optional[float] = None
+        self._last_scale = 1.0       # last envelope scale pushed
+        self._pushed_scale = False   # ever pushed at all
+        self._last_attempts: Optional[int] = None  # disagg attempt total
+        self.report: dict = {
+            "status": "idle", "ticks": 0, "actions": [],
+            "scale_ups": 0, "scale_downs": 0, "role_flips": 0,
+            "failures": 0, "skipped_ticks": 0,
+        }
+
+    def stop(self) -> None:
+        self._stop = True
+
+    # ------------------------------------------------------ observation
+    @staticmethod
+    def _min_headroom(sloz: dict) -> Optional[float]:
+        """Min per-tier SLO headroom, or None when no tier reports one
+        (no SLO engine / no samples yet — the controller then neither
+        scales up nor down: no evidence, no action)."""
+        vals = []
+        for doc in (sloz.get("tiers") or {}).values():
+            h = doc.get("headroom")
+            if isinstance(h, (int, float)):
+                vals.append(float(h))
+        return min(vals) if vals else None
+
+    @staticmethod
+    def _active_rows(statz: dict) -> List[dict]:
+        """Fleet rows currently IN the serving set (anything not
+        detached — draining/down hosts still count against pool size;
+        they are not free capacity but they are not parked either)."""
+        rows = (statz.get("fleet") or {}).get("backends") or []
+        return [r for r in rows if r.get("status") != "detached"]
+
+    @staticmethod
+    def _row_load(row: dict) -> float:
+        return (float(row.get("in_flight") or 0)
+                + float(row.get("queue_depth") or 0))
+
+    def _observe_envelope(self, statz: dict) -> Optional[float]:
+        """The fleet's current envelope utilization: worst per-host
+        HBM fraction (fleet rows) + the router-measured decode step
+        time (its pooled latency window). None = scrape gap."""
+        if self.envelope is None:
+            return None
+        hbm = None
+        for r in self._active_rows(statz):
+            v = r.get("hbm_frac_used")
+            if isinstance(v, (int, float)):
+                hbm = v if hbm is None else max(hbm, float(v))
+        lat = statz.get("latency") or {}
+        step_ms = None
+        tps = lat.get("decode_tokens_per_s_p50")
+        if isinstance(tps, (int, float)) and tps > 0:
+            step_ms = 1000.0 / float(tps)
+        return self.envelope.utilization(
+            hbm_frac_used=hbm, step_ms_now=step_ms
+        )
+
+    # ------------------------------------------------------------ notes
+    def _note(self, event: str, **fields) -> None:
+        """Best-effort decision record on the router — a note that
+        cannot land must not turn a healthy action into a failure."""
+        try:
+            self.admin.autoscale_note(event, **fields)
+        except RolloutError:
+            pass
+
+    def _record(self, action: str, **fields) -> dict:
+        entry = {"action": action, **fields}
+        self.report["actions"].append(entry)
+        # A long-lived daemon must not grow its report without bound
+        # (a week of skipped ticks against a dead router is 100k+
+        # entries) — keep the recent tail; the counters keep totals.
+        if len(self.report["actions"]) > 512:
+            del self.report["actions"][:-256]
+        return entry
+
+    # ------------------------------------------------------------- tick
+    def tick(self) -> dict:
+        """One decision round; returns what happened ({"action": ...}).
+        Never raises — an unobservable router is a skipped tick."""
+        self.report["ticks"] += 1
+        try:
+            statz = self.admin.statz()
+            sloz = self.admin.sloz()
+        except RolloutError as e:
+            self.report["skipped_ticks"] += 1
+            return self._record("skip", error=str(e))
+        # 1. Envelope pacing — independent of dwell: throttling batch
+        # admission IS how the fleet avoids needing a pool action.
+        self._tick_envelope(statz)
+        pool = len(self._active_rows(statz))
+        headroom = self._min_headroom(sloz)
+        now = self.clock()
+        if (self._last_action_ts is not None
+                and now - self._last_action_ts < self.policy.dwell_s):
+            return {"action": "dwell"}
+        # 2. Scale within the hysteresis band.
+        if headroom is not None and headroom < self.policy.low_headroom:
+            addr = self._next_standby(statz)
+            if addr is not None:
+                return self._scale_up(addr, headroom, pool)
+            return {"action": "hold", "why": "no standby left"}
+        if headroom is not None and headroom > self.policy.high_headroom:
+            addr = self._parkable(statz)
+            if addr is not None:
+                return self._scale_down(addr, headroom, pool)
+        # 3. Rebalance roles on the measured demand mix.
+        return self._maybe_flip(statz, pool)
+
+    def run(self) -> dict:
+        """The daemon loop; returns the report. Raises
+        :class:`AutoscaleError` only when the router is unreachable
+        before anything started."""
+        try:
+            statz = self.admin.statz()
+        except RolloutError as e:
+            raise AutoscaleError(
+                f"router unreachable before the first tick: {e}"
+            ) from e
+        pool = len(self._active_rows(statz))
+        self.report["status"] = "running"
+        self._note("begin", standby=list(self.standby), pool=pool)
+        ticks = 0
+        while not self._stop:
+            if self.max_ticks is not None and ticks >= self.max_ticks:
+                break
+            self.tick()
+            ticks += 1
+            if self._stop or (self.max_ticks is not None
+                              and ticks >= self.max_ticks):
+                break
+            self.sleep(self.policy.tick_s)
+        self.report["status"] = "stopped"
+        self._note("end", pool=self._pool_now())
+        return dict(self.report)
+
+    def _pool_now(self) -> Optional[int]:
+        try:
+            return len(self._active_rows(self.admin.statz()))
+        except RolloutError:
+            return None
+
+    # -------------------------------------------------------- envelope
+    def _tick_envelope(self, statz: dict) -> None:
+        util = self._observe_envelope(statz)
+        if util is None:
+            # Scrape gap (or no envelope declared): hold the last
+            # pushed scale — flapping the throttle on missing data is
+            # worse than a stale throttle.
+            return
+        scale = self.envelope.admission_fraction(util)
+        moved = abs(scale - self._last_scale) >= 0.05
+        if not moved and self._pushed_scale:
+            return
+        if not moved and scale >= 1.0:
+            # Never pushed and nothing to throttle: stay silent.
+            return
+        try:
+            self.admin.set_envelope(scale, util=util)
+        except RolloutError as e:
+            self.report["failures"] += 1
+            self._record("envelope_failed", error=str(e))
+            return
+        self._last_scale = scale
+        self._pushed_scale = True
+        self._record("envelope", scale=round(scale, 4),
+                     util=round(util, 4))
+        self._note("envelope", scale=round(scale, 4),
+                   util=round(util, 4))
+
+    # ------------------------------------------------------------ scale
+    def _next_standby(self, statz: dict) -> Optional[str]:
+        """The next standby addr NOT currently in the active set."""
+        active = {r.get("backend") for r in self._active_rows(statz)}
+        for addr in self.standby:
+            if addr not in active:
+                return addr
+        return None
+
+    def _parkable(self, statz: dict) -> Optional[str]:
+        """The emptiest ACTIVATED standby still in the active set —
+        never a base-fleet host, never below ``min_backends``."""
+        rows = self._active_rows(statz)
+        if len(rows) <= self.policy.min_backends:
+            return None
+        mine = [r for r in rows if r.get("backend") in self._activated]
+        if not mine:
+            return None
+        mine.sort(key=self._row_load)
+        return mine[0].get("backend")
+
+    def _scale_up(self, addr: str, headroom: float, pool: int) -> dict:
+        b = self.make_backend(addr)
+        try:
+            # The bootstrap readiness gate, with the controller's own
+            # clock — a standby that never answers /healthz within the
+            # budget leaves the pool unchanged.
+            wait_ready(
+                [b], timeout_s=self.ready_timeout_s,
+                poll_s=max(self.poll_s, 0.05),
+                sleep=self.sleep, clock=self.clock,
+            )
+            out = self.admin.attach(addr)
+        except (RuntimeError, RolloutError, BackendError) as e:
+            # RolloutError is a RuntimeError subclass in spirit but
+            # listed explicitly; either way: nothing changed, retry
+            # next tick.
+            self.report["failures"] += 1
+            self._note("scale_up_failed", backend=addr, error=str(e),
+                       headroom=round(headroom, 4), pool=pool)
+            return self._record("scale_up_failed", backend=addr,
+                                error=str(e))
+        self._activated.add(addr)
+        self._last_action_ts = self.clock()
+        self.report["scale_ups"] += 1
+        self._note("scale_up", backend=addr, pool=pool + 1,
+                   headroom=round(headroom, 4),
+                   warmed_chains=out.get("warmed_chains"))
+        return self._record("scale_up", backend=addr,
+                            warmed_chains=out.get("warmed_chains"))
+
+    def _scale_down(self, addr: str, headroom: float, pool: int) -> dict:
+        try:
+            self.admin.park(addr)
+        except RolloutError as e:
+            self.report["failures"] += 1
+            return self._record("scale_down_failed", backend=addr,
+                                error=str(e))
+        self._last_action_ts = self.clock()
+        self.report["scale_downs"] += 1
+        self._note("scale_down", backend=addr, pool=pool - 1,
+                   headroom=round(headroom, 4))
+        return self._record("scale_down", backend=addr)
+
+    # ------------------------------------------------------- role flips
+    def _maybe_flip(self, statz: dict, pool: int) -> dict:
+        """Flip one host when the measured demand mix has shifted past
+        the margin. Inputs: per-role load averages over the active
+        rows, and the per-tick delta of disagg handoff ATTEMPTS (ok +
+        failed + breakeven_loss, summed off the per-host fleet-row
+        counts) — attempts flowing means prefill capacity is being
+        consumed; a flat line means the prefill hosts are stranded
+        capital."""
+        rows = self._active_rows(statz)
+        pre = [r for r in rows if r.get("role") == "prefill"]
+        dec = [r for r in rows if r.get("role") in ("decode", "both")]
+        attempts = 0
+        for r in rows:
+            for n in (r.get("disagg") or {}).values():
+                attempts += int(n or 0)
+        delta = (attempts - self._last_attempts
+                 if self._last_attempts is not None else None)
+        self._last_attempts = attempts
+        if delta is None:
+            return {"action": "hold", "why": "first mix sample"}
+
+        def avg(group):
+            return (sum(self._row_load(r) for r in group) / len(group)
+                    if group else 0.0)
+
+        pre_load, dec_load = avg(pre), avg(dec)
+        m = self.policy.flip_margin
+        # Decode-heavy shift: prefill hosts idle (no handoff attempts
+        # this tick) while decode hosts queue — flip the emptiest
+        # prefill host to decode. Guarded so the LAST prefill host only
+        # flips when handoffs have genuinely stopped.
+        if (pre and dec and delta == 0 and dec_load >= 1.0
+                and dec_load > m * max(pre_load, 0.5)):
+            target = min(pre, key=self._row_load)
+            return self._flip(target["backend"], "decode", pool,
+                              pre_load=pre_load, dec_load=dec_load)
+        # Prefill-heavy shift: handoffs flowing and the prefill side
+        # drowning while decode idles — flip the emptiest decode-side
+        # host to prefill (never below min_backends decode/both hosts:
+        # decode capacity serves ALL traffic, prefill only offloads).
+        if (dec and len(dec) > self.policy.min_backends and delta
+                and delta > 0 and pre_load >= 1.0
+                and pre_load > m * max(dec_load, 0.5)):
+            target = min(dec, key=self._row_load)
+            return self._flip(target["backend"], "prefill", pool,
+                              pre_load=pre_load, dec_load=dec_load)
+        return {"action": "hold"}
+
+    def _flip(self, addr: str, new_role: str, pool: int, **mix) -> dict:
+        """drain -> idle-gate -> /rolez -> readiness-gate -> resume.
+        Any failure before the flip resumes the host in its OLD role
+        and retries a later tick; a failure AFTER the flip (resume or
+        readiness lost) is recorded with ``flipped=true``."""
+        was = None
+        try:
+            was = self.admin.fleet_row(addr).get("role")
+            self.admin.drain(addr)
+        except RolloutError as e:
+            self.report["failures"] += 1
+            self._note("role_flip_failed", backend=addr, role=new_role,
+                       error=str(e), pool=pool)
+            return self._record("role_flip_failed", backend=addr,
+                                error=str(e))
+        deadline = self.clock() + self.drain_timeout_s
+        flipped = False
+        try:
+            while True:
+                row = self.admin.fleet_row(addr)
+                if int(row.get("in_flight") or 0) == 0:
+                    break
+                if self.clock() >= deadline:
+                    raise AutoscaleError(
+                        f"drain of {addr} still has "
+                        f"{row.get('in_flight')} in-flight after "
+                        f"{self.drain_timeout_s:g}s"
+                    )
+                self.sleep(self.poll_s)
+            b = self.make_backend(addr)
+            b.rolez(new_role)
+            flipped = True
+            # Readiness gate: the host must advertise the NEW role on
+            # /healthz before traffic returns to it.
+            gate = self.clock() + self.ready_timeout_s
+            while True:
+                try:
+                    doc = b.probe()
+                except BackendError as e:
+                    doc = None
+                    err = e
+                if doc is not None and doc.get("role") == new_role:
+                    break
+                if self.clock() >= gate:
+                    raise AutoscaleError(
+                        f"{addr} never advertised role {new_role!r} "
+                        f"within {self.ready_timeout_s:g}s"
+                        + (f" (last probe error: {err})"
+                           if doc is None else "")
+                    )
+                self.sleep(self.poll_s)
+            self.admin.resume(addr)
+        except (AutoscaleError, RolloutError, BackendError) as e:
+            self.report["failures"] += 1
+            if not flipped:
+                # Nothing changed on the host — put it back to work in
+                # its old role and retry a later tick.
+                try:
+                    self.admin.resume(addr)
+                except RolloutError:
+                    pass
+            self._note("role_flip_failed", backend=addr, role=new_role,
+                       was=was, error=str(e), flipped=flipped,
+                       pool=pool)
+            return self._record("role_flip_failed", backend=addr,
+                                error=str(e), flipped=flipped)
+        self._last_action_ts = self.clock()
+        self.report["role_flips"] += 1
+        self._note("role_flip", backend=addr, role=new_role, was=was,
+                   pool=pool, **{k: round(v, 3) for k, v in mix.items()})
+        return self._record("role_flip", backend=addr, role=new_role,
+                            was=was)

@@ -500,6 +500,22 @@ class BackendClient:
             else self.cfg.read_timeout_s,
         )
 
+    def rolez(self, role: str,
+              timeout_s: Optional[float] = None) -> dict:
+        """POST /rolez {"role": ...} — flip this backend's advertised
+        disaggregation role (prefill|decode|both). Only legal on an
+        idle engine: the server answers 503 while requests are active
+        or queued, so the autoscale controller drains the host through
+        the router FIRST and only then flips. A non-retryable 4xx means
+        the role string was junk; a 5xx means the host refused (still
+        busy) and keeps its old role — the controller resumes it
+        unflipped and retries a later tick."""
+        return self._call_json(
+            "POST", "/rolez", {"role": str(role)},
+            timeout_s if timeout_s is not None
+            else self.cfg.probe_timeout_s,
+        )
+
     def metrics_text(self) -> str:
         """GET /metrics — raw Prometheus text pass-through (operators
         can scrape a backend THROUGH the router's statz links; the

@@ -1,0 +1,150 @@
+"""Declarative serving envelope: pace batch backfill against measured
+resource headroom instead of a fixed queue-depth cap.
+
+PR 6-7 bounded the batch tier with ``--batch-backlog N`` — a static
+queue-depth cap that knows nothing about WHY the fleet is loaded. The
+envelope replaces that guesswork with two measured signals every host
+already exposes:
+
+  * **HBM high-water fraction** — device bytes-in-use over bytes-limit
+    (the ``shifu_hbm_*`` gauge family; ``/healthz`` carries the pooled
+    fraction as ``hbm_frac_used``). Backfill that pushes HBM past the
+    high-water mark is backfill about to evict live prefix pages or
+    OOM a compile.
+  * **Step-time proxy for power** — the interactive tier's p50
+    inter-token latency (``/healthz``'s latency block). Decode step
+    time rising above the declared ceiling means the chip is saturated
+    (and, on TPU, drawing near its power envelope); batch admissions
+    are the first load to shed.
+
+The arithmetic is deliberately tiny and pure (fake-clock/unit tested
+with no HTTP anywhere): ``utilization`` folds the measured signals
+into one worst-dimension fraction of the declared budget, and
+``admission_fraction`` maps that to a batch-admission scale — 1.0
+(admit freely) below ``ramp``, linear down to 0.0 (shed all backfill)
+at the high-water mark. The autoscale controller pushes the scale to
+the fleet front-end via ``POST /envelopez``, where it multiplies the
+server's batch backlog cap (infer/server.py batch admission).
+
+**Scrape gaps fail safe**: a signal nobody measured (CPU hosts report
+no HBM; a fleet with no traffic has no ITL yet) contributes nothing,
+and when NO signal is measured ``utilization`` answers None — the
+controller then holds the last pushed scale instead of flapping the
+throttle on missing data.
+
+Spec syntax (the ``--envelope`` flag)::
+
+    hbm=0.85,step_ms=120          # either part optional
+    hbm=0.9,step_ms=80,ramp=0.7   # start shedding at 70% utilization
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+__all__ = ["Envelope", "parse_envelope_spec"]
+
+
+@dataclass(frozen=True)
+class Envelope:
+    """A declared serving envelope; see module docstring.
+
+    ``hbm_frac`` — HBM high-water mark as a fraction of bytes-limit in
+    (0, 1]; None disables the HBM dimension. ``step_ms`` — decode
+    step-time (interactive p50 ITL) ceiling in ms; None disables the
+    power-proxy dimension. ``ramp`` — utilization fraction where
+    batch-admission throttling starts (1.0 admission below it, linear
+    to 0.0 at utilization 1.0)."""
+
+    hbm_frac: Optional[float] = None
+    step_ms: Optional[float] = None
+    ramp: float = 0.8
+
+    def __post_init__(self):
+        if self.hbm_frac is not None and not (0.0 < self.hbm_frac <= 1.0):
+            raise ValueError(
+                f"envelope hbm fraction must be in (0, 1], got "
+                f"{self.hbm_frac} — e.g. hbm=0.85"
+            )
+        if self.step_ms is not None and not self.step_ms > 0.0:
+            raise ValueError(
+                f"envelope step_ms must be > 0, got {self.step_ms} — "
+                "e.g. step_ms=120"
+            )
+        if not (0.0 < self.ramp < 1.0):
+            raise ValueError(
+                f"envelope ramp must be in (0, 1), got {self.ramp} — "
+                "e.g. ramp=0.8"
+            )
+        if self.hbm_frac is None and self.step_ms is None:
+            raise ValueError(
+                "envelope declares no dimension — give hbm=FRAC "
+                "and/or step_ms=MS"
+            )
+
+    def utilization(self, *, hbm_frac_used: Optional[float] = None,
+                    step_ms_now: Optional[float] = None
+                    ) -> Optional[float]:
+        """Worst-dimension fraction of the declared budget (1.0 = AT
+        the high-water mark; may exceed 1.0). A dimension with no
+        measurement — or none declared — contributes nothing; None
+        when NOTHING was measured (the scrape-gap hold signal)."""
+        dims = []
+        if self.hbm_frac is not None and hbm_frac_used is not None:
+            if hbm_frac_used >= 0.0:
+                dims.append(float(hbm_frac_used) / self.hbm_frac)
+        if self.step_ms is not None and step_ms_now is not None:
+            if step_ms_now >= 0.0:
+                dims.append(float(step_ms_now) / self.step_ms)
+        return max(dims) if dims else None
+
+    def admission_fraction(self, util: Optional[float]) -> float:
+        """Batch-admission scale in [0, 1] for one utilization sample:
+        1.0 below ``ramp``, 0.0 at/over the high-water mark (util
+        1.0), linear between. An unmeasured utilization (None) admits
+        freely — throttling on missing data would turn every scrape
+        gap into a fleet-wide batch stall."""
+        if util is None or util <= self.ramp:
+            return 1.0
+        if util >= 1.0:
+            return 0.0
+        return (1.0 - util) / (1.0 - self.ramp)
+
+    @staticmethod
+    def scaled_cap(base_cap: int, scale: float) -> int:
+        """The effective batch backlog cap for one admission scale
+        (floor of base*scale, never negative — scale 0.0 means cap 0:
+        every batch arrival 429s until the envelope recovers)."""
+        return max(0, int(float(base_cap) * min(max(scale, 0.0), 1.0)))
+
+
+def parse_envelope_spec(spec: str) -> Envelope:
+    """``"hbm=0.85,step_ms=120[,ramp=0.8]"`` -> :class:`Envelope`.
+    Raises ValueError with a one-line fix hint on junk (the
+    ``fleet autoscale --check`` gate surfaces these verbatim)."""
+    if not spec or not str(spec).strip():
+        raise ValueError(
+            "empty envelope spec — e.g. --envelope hbm=0.85,step_ms=120"
+        )
+    kw = {}
+    for part in str(spec).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        key, sep, val = part.partition("=")
+        key = key.strip()
+        if not sep or key not in ("hbm", "step_ms", "ramp"):
+            raise ValueError(
+                f"envelope part {part!r} is not hbm=/step_ms=/ramp= — "
+                "e.g. hbm=0.85,step_ms=120"
+            )
+        try:
+            fval = float(val)
+        except ValueError:
+            raise ValueError(
+                f"envelope {key}={val!r} is not a number — "
+                "e.g. hbm=0.85,step_ms=120"
+            ) from None
+        kw["hbm_frac" if key == "hbm" else key] = fval
+    return Envelope(**kw)

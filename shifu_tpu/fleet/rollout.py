@@ -135,6 +135,40 @@ class RouterAdmin:
     def note(self, event: str, **fields) -> None:
         self._call("POST", "/rolloutz", {"event": event, **fields})
 
+    # ---------------------------------------- autoscale verbs (PR 20)
+    def sloz(self) -> dict:
+        """The router's GET /sloz document — per-tier burn-rate
+        headroom, the autoscale controller's primary input."""
+        return self._call("GET", "/sloz")
+
+    def attach(self, addr: str) -> dict:
+        """POST /fleetz {"attach": addr} — admit a standby host into
+        the serving set (the scale-up actuator; also the one path
+        back for a parked host). The router probes it synchronously,
+        so a dead standby raises :class:`RolloutError` here with the
+        roster unchanged."""
+        return self._call("POST", "/fleetz", {"attach": addr})
+
+    def park(self, addr: str) -> dict:
+        """POST /drainz {"detach": true} — drain ``addr`` and, once
+        its in-flight streams finish, detach it from the serving set
+        (the scale-down actuator; ``attach`` re-admits it)."""
+        return self._call(
+            "POST", "/drainz", {"backend": addr, "detach": True}
+        )
+
+    def autoscale_note(self, event: str, **fields) -> None:
+        self._call("POST", "/autoscalez", {"event": event, **fields})
+
+    def set_envelope(self, scale: float,
+                     util: Optional[float] = None) -> dict:
+        """POST /envelopez — push the fleet-wide batch-admission scale
+        the envelope arithmetic produced (fleet/envelope.py)."""
+        body = {"scale": float(scale)}
+        if util is not None:
+            body["util"] = float(util)
+        return self._call("POST", "/envelopez", body)
+
 
 class RolloutController:
     """Walk a roster through a rolling weight swap; see module

@@ -208,6 +208,12 @@ class FleetRouter:
         self.disagg_handoffs = 0          # handoffs that completed
         self.disagg_fallbacks = 0         # handoff failed -> colocated
         self.disagg_breakeven_losses = 0  # wire lost -> never attempted
+        # Per-PREFILL-HOST handoff outcome counts ({addr: {outcome:
+        # n}}), surfaced in the /statz fleet rows — the autoscale
+        # rebalancer's demand-mix signal: a prefill host whose
+        # attempts flatline while decode queues grow is a flip
+        # candidate.
+        self._disagg_by_host: Dict[str, Dict[str, int]] = {}
 
         # Sticky, cache-aware sessions. The affinity table maps the
         # DEEPEST full-page prefix-chain digest of a served prompt (the
@@ -431,6 +437,51 @@ class FleetRouter:
             "1 while the rollout wave is paused on an SLO breach",
         ).labels()
         self._rollout: Optional[dict] = None  # /statz rollout block
+        # shifu_autoscale_* / shifu_envelope_* families: elastic-fleet
+        # control-plane decisions as reported by the autoscale
+        # controller via POST /autoscalez (autoscale_note). Like the
+        # rollout families, the controller may be a separate process —
+        # the series live HERE so one /metrics scrape shows traffic
+        # AND the fleet reshaping under it.
+        self._c_autoscale_actions = reg.counter(
+            "shifu_autoscale_actions_total",
+            "Autoscale control-loop actions recorded via /autoscalez: "
+            "scale_up (standby activated), scale_down (host parked), "
+            "role_flip (drain-flip-resume completed), envelope "
+            "(batch-admission scale pushed), scale_up_failed / "
+            "role_flip_failed (actuator failure — fleet unchanged, "
+            "retry next tick)", labelnames=("action",),
+        )
+        for ac in ("scale_up", "scale_down", "role_flip", "envelope",
+                   "scale_up_failed", "role_flip_failed"):
+            self._c_autoscale_actions.labels(action=ac)
+        self._g_autoscale_active = reg.gauge(
+            "shifu_autoscale_active",
+            "1 while an autoscale controller is attached and ticking",
+        ).labels()
+        self._g_autoscale_pool = reg.gauge(
+            "shifu_autoscale_pool_size",
+            "Active serving-set size as the autoscale controller last "
+            "counted it (attached, non-parked backends)",
+        ).labels()
+        self._c_role_flips = reg.counter(
+            "shifu_role_flips_total",
+            "Completed prefill/decode role flips (drain -> /rolez -> "
+            "readiness gate -> resume) across the fleet",
+        ).labels()
+        self._g_envelope_util = reg.gauge(
+            "shifu_envelope_utilization",
+            "Worst-dimension serving-envelope utilization the "
+            "controller last measured (1.0 = at the declared "
+            "high-water mark)",
+        ).labels()
+        self._g_envelope_scale = reg.gauge(
+            "shifu_envelope_admission_scale",
+            "Batch-tier admission scale the controller last pushed "
+            "fleet-wide (1.0 = admit freely, 0.0 = shed all backfill)",
+        ).labels()
+        self._g_envelope_scale.set(1.0)
+        self._autoscale: Optional[dict] = None  # /statz autoscale block
         # shifu_slo_* per-tier traffic counters: the fleet SLO engine's
         # error-rate budget differences these over its burn windows
         # (obs/slo.py). Pre-seeded per tier so window deltas start at
@@ -996,6 +1047,16 @@ class FleetRouter:
             else (1 - a) * self._xfer_bytes_per_token + a * bpt
         )
 
+    def _disagg_host_note(self, addr: str, outcome: str) -> None:
+        """Bump one prefill host's handoff-outcome count (caller holds
+        ``self._lock``). Fleet rows carry these per host so the
+        autoscale rebalancer can see WHICH hosts the disagg mix flows
+        through, not just the fleet totals."""
+        d = self._disagg_by_host.setdefault(
+            addr, {"ok": 0, "failed": 0, "breakeven_loss": 0}
+        )
+        d[outcome] = d.get(outcome, 0) + 1
+
     def _try_disagg(self, req: _FleetRequest) -> bool:
         """One disaggregated attempt. True = the request is FINISHED
         (completed, or failed in a way the client must see); False =
@@ -1015,6 +1076,7 @@ class FleetRouter:
         if not self._disagg_wins(p_tokens, dec):
             with self._lock:
                 self.disagg_breakeven_losses += 1
+                self._disagg_host_note(pre.addr, "breakeven_loss")
             self._c_disagg.labels(outcome="breakeven_loss").inc()
             return False
         att0 = time.monotonic()
@@ -1022,10 +1084,12 @@ class FleetRouter:
         if err is None:
             with self._lock:
                 self.disagg_handoffs += 1
+                self._disagg_host_note(pre.addr, "ok")
             self._c_disagg.labels(outcome="ok").inc()
             return True
         with self._lock:
             self.disagg_fallbacks += 1
+            self._disagg_host_note(pre.addr, "failed")
         self._c_disagg.labels(outcome="failed").inc()
         self._c_failures.labels(backend=pre.addr).inc()
         if req.streamed or not err.retryable:
@@ -2099,6 +2163,9 @@ class FleetRouter:
     def kv_export_payload(self, rid, trace=None):
         return None
 
+    def kv_export_digest(self, digest, trace=None):
+        return None
+
     def kv_ingest(self, payload, trace=None):
         raise ValueError(
             "the fleet router holds no page pool; POST /kv/pages to a "
@@ -2148,6 +2215,17 @@ class FleetRouter:
                 "last_probe_ts": b.health_ts,
                 "max_len": b.max_len,
                 "role": self._role(b),
+                # The autoscale rebalancer's per-host inputs, mirrored
+                # off the prober's last /healthz scrape: measured
+                # prefill rate, HBM high-water fraction (absent on
+                # hosts whose devices report no limits — the envelope
+                # scrape gap), and this host's disagg handoff
+                # outcomes as the chosen PREFILL side.
+                "prefill_tok_per_ms": h.get("prefill_tok_per_ms"),
+                "hbm_frac_used": h.get("hbm_frac_used"),
+                "disagg": dict(
+                    self._disagg_by_host.get(b.addr) or {}
+                ),
             }
             if b.cache is not None:
                 # The prober's last /cachez scrape — the numbers the
@@ -2226,6 +2304,70 @@ class FleetRouter:
             self.flight.record("backend_resumed", backend=b.addr)
         return {"resumed": b.addr, "was_draining": was_draining}
 
+    def attach_backend(self, target: str) -> dict:
+        """Admit ``target`` (``host:port``) into the serving set — the
+        ``POST /fleetz {"attach": ...}`` admin verb, and the autoscale
+        controller's scale-up actuator. Two shapes:
+
+        * the addr was parked earlier (drain-detached): the SAME
+          client object is re-admitted — detached/draining cleared,
+          gauges re-upped. This is the one path out of detached state
+          short of a router restart (``resume`` still refuses it).
+        * a new addr: a :class:`BackendClient` is built with the
+          roster's config and wired into metrics like a boot-time
+          backend.
+
+        Either way the host is probed + its /v1/models and /cachez
+        read HERE (synchronous readiness gate — an unreachable host
+        raises RuntimeError and leaves the roster unchanged for a new
+        addr / parked for an old one), then ``maybe_peer_warm`` runs
+        so a stone-cold join takes its first requests with warm
+        prefixes (PR 15's promise)."""
+        addr = str(target)
+        existing = next(
+            (x for x in self.backends if x.addr == addr), None
+        )
+        b = existing
+        if b is None:
+            cfg = self.backends[0].cfg if self.backends else None
+            b = BackendClient(addr, cfg)
+        try:
+            self.probe_backend(b)
+            b.models()
+        except BackendError as e:
+            raise RuntimeError(
+                f"backend {addr} failed the attach readiness gate: {e}"
+            ) from e
+        b.refresh_cachez()
+        was_parked = False
+        if existing is None:
+            with self._lock:
+                self.backends.append(b)
+            self._wire_backend(b)
+        else:
+            was_parked = b.detached or b.draining
+            b.detached = False
+            b.draining = False
+            self._g_up.labels(backend=b.addr).set(
+                1.0 if b.routable() else 0.0
+            )
+        # Re-eligible for bulk warming: a host that left and came back
+        # cold gets its peers' chain tips again (still-warm hosts are
+        # skipped by maybe_peer_warm's held-digest check anyway).
+        self._peer_warmed.discard(addr)
+        self._peer_warm_strikes.pop(addr, None)
+        warmed = self.maybe_peer_warm()
+        self.flight.record(
+            "backend_attached", backend=addr,
+            was_parked=was_parked, warmed_chains=warmed,
+        )
+        return {
+            "attached": addr,
+            "was_parked": was_parked,
+            "warmed_chains": warmed,
+            "backends": len(self.backends),
+        }
+
     def _drain_watch(self, b: BackendClient) -> None:
         while b.draining and b.in_flight > 0:
             self._sleep(self._drain_poll_s)
@@ -2302,3 +2444,96 @@ class FleetRouter:
         document, or None before any rollout touched this router."""
         with self._lock:
             return dict(self._rollout) if self._rollout else None
+
+    # ----------------------------------------------- autoscale state
+    _AUTOSCALE_EVENTS = frozenset({
+        "begin", "scale_up", "scale_up_failed", "scale_down",
+        "role_flip", "role_flip_failed", "envelope", "end",
+    })
+
+    def autoscale_note(self, event: str, **fields) -> dict:
+        """Record one autoscale control-loop event (the ``POST
+        /autoscalez`` admin verb — the elastic-fleet controller,
+        possibly a separate process, reports every decision here so
+        the router's /metrics, /statz, and flight ring carry the
+        fleet's reshaping alongside the traffic driving it).
+
+        Well-known fields: ``pool`` (active serving-set size — tracked
+        on every event that carries it), ``backend``, ``role``/``was``
+        (role flips), ``scale``/``util`` (envelope pushes),
+        ``headroom`` (min per-tier SLO headroom at decision time),
+        ``error`` (the *_failed events)."""
+        event = str(event)
+        if event not in self._AUTOSCALE_EVENTS:
+            raise ValueError(
+                f"unknown autoscale event {event!r} "
+                f"(known: {sorted(self._AUTOSCALE_EVENTS)})"
+            )
+        with self._lock:
+            if event == "begin":
+                self._autoscale = {
+                    "status": "running",
+                    "standby": list(fields.get("standby") or ()),
+                    "pool": fields.get("pool"),
+                    "last_action": None,
+                    "last_error": None,
+                    "headroom": None,
+                    "envelope": None,
+                    "actions": {
+                        "scale_up": 0, "scale_up_failed": 0,
+                        "scale_down": 0, "role_flip": 0,
+                        "role_flip_failed": 0, "envelope": 0,
+                    },
+                    "events": 0,
+                }
+            a = self._autoscale
+            if a is None:
+                raise ValueError(
+                    f"autoscale event {event!r} before 'begin'"
+                )
+            a["events"] += 1
+            if event in a["actions"]:
+                a["actions"][event] += 1
+                a["last_action"] = {
+                    "action": event,
+                    **{k: v for k, v in fields.items()
+                       if k in ("backend", "role", "was", "scale",
+                                "util", "headroom", "error", "tier")},
+                }
+            if fields.get("pool") is not None:
+                a["pool"] = fields["pool"]
+            if fields.get("headroom") is not None:
+                a["headroom"] = fields["headroom"]
+            if event == "envelope":
+                a["envelope"] = {
+                    "util": fields.get("util"),
+                    "scale": fields.get("scale"),
+                }
+            if event.endswith("_failed"):
+                a["last_error"] = fields.get("error")
+            if event == "end":
+                a["status"] = "stopped"
+            active = a["status"] == "running"
+            pool = a.get("pool")
+        if event in ("scale_up", "scale_up_failed", "scale_down",
+                     "role_flip", "role_flip_failed", "envelope"):
+            self._c_autoscale_actions.labels(action=event).inc()
+        if event == "role_flip":
+            self._c_role_flips.inc()
+        if event == "envelope":
+            if fields.get("util") is not None:
+                self._g_envelope_util.set(float(fields["util"]))
+            if fields.get("scale") is not None:
+                self._g_envelope_scale.set(float(fields["scale"]))
+        self._g_autoscale_active.set(1.0 if active else 0.0)
+        if pool is not None:
+            self._g_autoscale_pool.set(float(pool))
+        self.flight.record("autoscale_" + event, **fields)
+        return {"recorded": event}
+
+    def autoscale_stats(self) -> Optional[dict]:
+        """The /statz autoscale block: the controller's running state
+        document (pool size, last action, per-action counts, last
+        envelope push), or None before any controller attached."""
+        with self._lock:
+            return dict(self._autoscale) if self._autoscale else None

@@ -234,7 +234,17 @@ ENGINE_INTERFACE = frozenset({
     # /kv/pages`` side — deserialize, validate, and file a peer's chain
     # into the local host tier. Engines without a host KV tier answer
     # None / refuse.
-    "kv_export_payload", "kv_ingest",
+    # ``kv_export_digest`` is the content-addressed variant
+    # (``GET /kv/pages?digest=`` — fleet-wide peer fetch).
+    "kv_export_payload", "kv_export_digest", "kv_ingest",
+    # elastic fleet control plane (fleet/autoscale.py):
+    # ``attach_backend`` admits a standby host into the serving set
+    # (``POST /fleetz`` — the scale-up actuator; also the one path
+    # back for a parked host); ``autoscale_note`` / ``autoscale_stats``
+    # record the controller's decisions for ``POST /autoscalez`` and
+    # the /statz autoscale block. In-process engines refuse / answer
+    # None — only the fleet router has a roster to reshape.
+    "attach_backend", "autoscale_note", "autoscale_stats",
 })
 
 
@@ -1240,6 +1250,27 @@ class Engine:
     def rollout_stats(self):
         """The /statz rollout block, or None when no rollout state
         exists (in-process engines, routers with no rollout yet)."""
+        return None
+
+    def attach_backend(self, target):
+        """``POST /fleetz {"attach": ...}`` — the autoscale
+        controller's scale-up actuator; only a fleet router has a
+        roster to grow."""
+        raise ValueError(
+            "no fleet: this server fronts an in-process engine, "
+            "backends attach at the fleet router"
+        )
+
+    def autoscale_note(self, event: str, **fields):
+        """``POST /autoscalez`` — an autoscale controller reporting
+        its decisions; only a fleet router tracks them."""
+        raise ValueError(
+            "no fleet: autoscale state is tracked by the fleet router"
+        )
+
+    def autoscale_stats(self):
+        """The /statz autoscale block, or None when no controller has
+        attached (in-process engines, routers never autoscaled)."""
         return None
 
     def cache_stats(self):

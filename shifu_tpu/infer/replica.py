@@ -245,11 +245,28 @@ class ReplicatedEngine:
     def rollout_stats(self):
         return None
 
+    def attach_backend(self, target):
+        raise ValueError(
+            "no fleet: this server fronts in-process dp replicas, "
+            "backends attach at the fleet router"
+        )
+
+    def autoscale_note(self, event: str, **fields):
+        raise ValueError(
+            "no fleet: autoscale state is tracked by the fleet router"
+        )
+
+    def autoscale_stats(self):
+        return None
+
     # ENGINE_INTERFACE KV-handoff surface (prefill/decode
     # disaggregation): dp replicas share no single page pool, so this
     # server neither exports nor ingests — GET /kv/pages 404s, POST
     # 400s, and the router keeps such a host out of handoffs.
     def kv_export_payload(self, rid, trace=None):
+        return None
+
+    def kv_export_digest(self, digest, trace=None):
         return None
 
     def kv_ingest(self, payload, trace=None):

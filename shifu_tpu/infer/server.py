@@ -932,6 +932,16 @@ class EngineRunner:
         if self.fatal is not None:
             out["fatal"] = repr(self.fatal)
         out["latency"] = eng.latency_stats()
+        # Serving-envelope signal (fleet/envelope.py): pooled HBM
+        # high-water fraction across reporting devices. The key is
+        # ABSENT when no device reports a bytes limit (CPU hosts) —
+        # that absence is the envelope's declared scrape gap, not a
+        # zero.
+        from shifu_tpu.utils.profiling import summarize_memory
+
+        hbm = summarize_memory().get("utilization")
+        if hbm is not None:
+            out["hbm_frac_used"] = hbm
         # SLO watchdog: "ok" | "degraded" (+ reasons) | "dead" — the
         # self-diagnosis verdict /healthz leads with (sliding-window
         # budgets; obs/watchdog.py).
@@ -1297,6 +1307,16 @@ class _Handler(BaseHTTPRequestHandler):
     # gets 429 + Retry-After — a mis-sized job cannot OOM the queue.
     # None = uncapped.
     batch_backlog_max: Optional[int] = None
+    # Envelope-paced backfill (fleet/envelope.py): the fleet-wide
+    # batch-admission scale the autoscale controller last pushed via
+    # POST /envelopez (class state on the per-server BoundHandler, so
+    # one push throttles every HTTP thread). 1.0 = admit freely up to
+    # ``batch_backlog_max``; below 1.0 the effective backlog cap
+    # shrinks proportionally (0.0 sheds all backfill). ``envelope_util``
+    # is the utilization the controller measured with it — /statz
+    # display only.
+    envelope_scale: float = 1.0
+    envelope_util: Optional[float] = None
     # The server-hosted batch-job table behind /v1/batches
     # (shifu_tpu/batch/service.py); wired by make_server.
     batches = None
@@ -1419,6 +1439,19 @@ class _Handler(BaseHTTPRequestHandler):
             roll = eng.rollout_stats()
             if roll is not None:
                 out["rollout"] = roll
+            # Autoscale block (ENGINE_INTERFACE "autoscale_stats"):
+            # the elastic-fleet controller's state as recorded via
+            # POST /autoscalez — pool size, last action, per-action
+            # counts, last envelope push — plus THIS front-end's live
+            # batch-admission scale (set via POST /envelopez). Omitted
+            # until a controller attaches or an envelope is pushed.
+            ascale = eng.autoscale_stats()
+            if ascale is not None or self.envelope_scale != 1.0:
+                ascale = dict(ascale or {})
+                ascale["admission_scale"] = self.envelope_scale
+                if self.envelope_util is not None:
+                    ascale["admission_util"] = self.envelope_util
+                out["autoscale"] = ascale
             # Cache block (ENGINE_INTERFACE "cache_stats"): prefix
             # cache + host KV tier occupancy/hit rates — the same
             # payload GET /cachez serves standalone. None (dense
@@ -1604,6 +1637,14 @@ class _Handler(BaseHTTPRequestHandler):
             self._handle_reload()
         elif self.path == "/rolloutz":
             self._handle_rollout_note()
+        elif self.path == "/rolez":
+            self._handle_role()
+        elif self.path == "/envelopez":
+            self._handle_envelope()
+        elif self.path == "/fleetz":
+            self._handle_fleet()
+        elif self.path == "/autoscalez":
+            self._handle_autoscale_note()
         else:
             self._send(404, {"error": f"no route {self.path}"})
 
@@ -1823,6 +1864,145 @@ class _Handler(BaseHTTPRequestHandler):
             return
         try:
             out = self.runner.engine.rollout_note(event, **req)
+        except (ValueError, TypeError) as e:
+            self._send(400, {"error": str(e)})
+            return
+        self._send(200, out)
+
+    def _handle_role(self):
+        """POST /rolez {"role": "prefill"|"decode"|"both"} — flip this
+        host's disaggregation role in place. Only legal on an IDLE
+        engine (no active slots, nothing queued, empty runner inbox):
+        a busy host answers 503 and keeps its old role, so the
+        autoscale controller's drain-flip-resume walk drains through
+        the router FIRST and only then flips. On success the new role
+        is advertised on /healthz and /v1/models exactly as if the
+        server had booted with it (class state on the per-server
+        BoundHandler — every HTTP thread sees it at once)."""
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            req = json.loads(self.rfile.read(length) or b"{}")
+        except (ValueError, json.JSONDecodeError):
+            self._send(400, {"error": "body must be JSON"})
+            return
+        role = req.get("role")
+        if role not in ("prefill", "decode", "both"):
+            self._send(400, {"error": (
+                'rolez needs {"role": "prefill"|"decode"|"both"}, '
+                f"got {role!r}"
+            )})
+            return
+        eng = self.runner.engine
+        counters = dict(eng.counters())
+        busy = (
+            int(counters.get("active_slots") or 0)
+            + int(counters.get("queued") or 0)
+            + len(self.runner._inbox)
+        )
+        if busy > 0:
+            # The role boundary moves the KV-handoff contract; flipping
+            # under live streams would strand their pages. 503 (not
+            # 400): the request is well-formed, the host just is not
+            # drained yet — the controller resumes or retries.
+            self._send(503, {
+                "error": (
+                    f"engine busy ({busy} active/queued requests); "
+                    "drain this host before flipping its role"
+                ),
+                "role": self.role,
+            }, headers={"Retry-After": "1"})
+            return
+        was = self.role
+        type(self).role = role
+        self.runner.flight.record("role_changed", role=role, was=was)
+        self._send(200, {"role": role, "was": was})
+
+    def _handle_envelope(self):
+        """POST /envelopez {"scale": 0..1[, "util": f]} — the autoscale
+        controller pushing the fleet-wide batch-admission scale it
+        derived from the declared serving envelope (fleet/envelope.py).
+        Class state on the per-server BoundHandler: one push at the
+        fleet front-end throttles batch admission for every HTTP
+        thread (and therefore every /v1/batches line, which loop back
+        through this server)."""
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            req = json.loads(self.rfile.read(length) or b"{}")
+        except (ValueError, json.JSONDecodeError):
+            self._send(400, {"error": "body must be JSON"})
+            return
+        scale = req.get("scale")
+        if not isinstance(scale, (int, float)) or isinstance(scale, bool) \
+                or not (0.0 <= float(scale) <= 1.0):
+            self._send(400, {"error": (
+                'envelopez needs {"scale": fraction in [0, 1]}, '
+                f"got {scale!r}"
+            )})
+            return
+        util = req.get("util")
+        if util is not None and (
+            not isinstance(util, (int, float)) or isinstance(util, bool)
+        ):
+            self._send(400, {"error": f"util must be a number, got {util!r}"})
+            return
+        cls = type(self)
+        was = cls.envelope_scale
+        cls.envelope_scale = float(scale)
+        cls.envelope_util = float(util) if util is not None else None
+        self.runner.flight.record(
+            "envelope_set", scale=float(scale), was=was, util=util,
+        )
+        self._send(200, {"scale": float(scale), "was": was})
+
+    def _handle_fleet(self):
+        """POST /fleetz {"attach": "host:port"} — admit a standby host
+        into the serving set (ENGINE_INTERFACE "attach_backend"; the
+        autoscale controller's scale-up actuator, and the one path back
+        for a parked host). The router probes the host synchronously —
+        an unreachable standby 503s with the roster unchanged; a
+        non-fleet server 400s with its refusal."""
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            req = json.loads(self.rfile.read(length) or b"{}")
+        except (ValueError, json.JSONDecodeError):
+            self._send(400, {"error": "body must be JSON"})
+            return
+        target = req.get("attach")
+        if not isinstance(target, str) or not target:
+            self._send(
+                400, {"error": 'fleetz needs {"attach": "host:port"}'}
+            )
+            return
+        try:
+            out = self.runner.engine.attach_backend(target)
+        except ValueError as e:
+            self._send(400, {"error": str(e)})
+            return
+        except RuntimeError as e:
+            # Readiness gate failed: the standby is dead or not yet
+            # serving. Nothing changed — the controller retries next
+            # tick.
+            self._send(503, {"error": str(e), "attached": False})
+            return
+        self._send(200, out)
+
+    def _handle_autoscale_note(self):
+        """POST /autoscalez {"event": ..., ...} — the autoscale
+        controller (possibly another process) recording its decisions
+        on THIS router's metrics/flight/statz (ENGINE_INTERFACE
+        "autoscale_note"; a non-fleet server 400s)."""
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            req = json.loads(self.rfile.read(length) or b"{}")
+        except (ValueError, json.JSONDecodeError):
+            self._send(400, {"error": "body must be JSON"})
+            return
+        event = req.pop("event", None)
+        if not isinstance(event, str) or not event:
+            self._send(400, {"error": 'autoscalez needs {"event": ...}'})
+            return
+        try:
+            out = self.runner.engine.autoscale_note(event, **req)
         except (ValueError, TypeError) as e:
             self._send(400, {"error": str(e)})
             return
@@ -2173,22 +2353,52 @@ class _Handler(BaseHTTPRequestHandler):
                 raise ValueError(
                     f'tier must be "interactive" or "batch", got {tier!r}'
                 )
-            if tier == "batch" and self.batch_backlog_max is not None:
+            scale = float(self.envelope_scale)
+            if tier == "batch" and (
+                self.batch_backlog_max is not None or scale < 1.0
+            ):
                 backlog = int(
                     self.runner.engine.queue_depths().get("batch", 0)
                 )
-                if backlog >= self.batch_backlog_max:
+                slots = max(1, int(self.runner.engine.max_slots))
+                # Envelope-paced backfill: the controller's pushed
+                # admission scale multiplies the configured backlog
+                # cap (an uncapped server under an envelope paces
+                # against a default of 4 backlog entries per slot).
+                base = (
+                    self.batch_backlog_max
+                    if self.batch_backlog_max is not None
+                    else 4 * slots
+                )
+                eff = max(0, int(base * scale))
+                if backlog >= eff:
                     # 429, not 503: the server is healthy, THIS tier is
-                    # full. Retry-After scales with how many backlog
-                    # entries each slot must clear (a blunt but honest
-                    # horizon); BatchRunner sleeps it and retries.
-                    slots = max(1, int(self.runner.engine.max_slots))
+                    # full (or envelope-throttled). Retry-After scales
+                    # with how many backlog entries each slot must
+                    # clear (a blunt but honest horizon); BatchRunner
+                    # sleeps it and retries.
+                    why = (
+                        f"batch backlog {backlog} at cap {eff}"
+                        + (f" (envelope scale {scale:g} over base "
+                           f"{base})" if scale < 1.0 else "")
+                        + "; retry later"
+                    )
+                    if scale < 1.0 and (
+                        self.batch_backlog_max is None
+                        or backlog < self.batch_backlog_max
+                    ):
+                        # The ENVELOPE (not the static cap) rejected
+                        # this — count it so "how much backfill did
+                        # the envelope shed" is one query.
+                        self.runner.metrics.counter(
+                            "shifu_envelope_rejections_total",
+                            "Batch-tier admissions rejected because "
+                            "the envelope-scaled backlog cap was "
+                            "below the configured/static cap",
+                        ).labels().inc()
                     self._send(
                         429,
-                        {"error": (
-                            f"batch backlog {backlog} at cap "
-                            f"{self.batch_backlog_max}; retry later"
-                        )},
+                        {"error": why},
                         headers={"Retry-After": str(
                             min(30, max(1, backlog // slots))
                         )},

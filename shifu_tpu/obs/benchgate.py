@@ -180,6 +180,22 @@ METRIC_SPECS: Dict[str, Tuple[str, float]] = {
     "lg_achieved_x_offered": (HIGHER, 0.15),
     "lg_p99_ttft_ms": (LOWER, 0.50),
     "lg_err_rate": (LOWER, 0.50),
+    # elastic fleet control plane (round 20): bench_autoscale drives a
+    # loadgen ramp with a shifting prefill/decode mix against an
+    # elastic fleet (standby pool + autoscale controller) and a
+    # fixed-size fixed-role control. as_p99_ttft_ms is the elastic
+    # fleet's client-visible tail under the ramp; as_scale_actions
+    # counts completed pool/role actions (it collapsing to 0 means the
+    # controller stopped reacting to the same stimulus);
+    # as_flip_lag_s prices one drain-flip-resume role change
+    # end-to-end; as_backfill_util is the batch-tier admission
+    # fraction the envelope sustained (1 = never throttled more than
+    # declared). Armable — dormant until a baseline round records the
+    # leg (missing keys are skipped with a machine-readable reason).
+    "as_p99_ttft_ms": (LOWER, 0.50),
+    "as_scale_actions": (HIGHER, 0.75),
+    "as_flip_lag_s": (LOWER, 0.75),
+    "as_backfill_util": (HIGHER, 0.50),
 }
 
 # Absolute floors for landed improve-direction wins (round 6): relative

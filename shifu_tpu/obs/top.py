@@ -78,6 +78,32 @@ def render_top(statz: dict, sloz: Optional[dict] = None,
             f" (fail {sess.get('migrate_fallbacks', 0)}"
             f", breakeven {sess.get('migrate_breakeven_losses', 0)})"
         )
+    ascale = statz.get("autoscale") or {}
+    if ascale:
+        # The elastic-fleet controller's /statz block: pool size, last
+        # action, min per-tier headroom at the last decision, and the
+        # envelope's utilization -> batch-admission scale.
+        last = ascale.get("last_action") or {}
+        env = ascale.get("envelope") or {}
+        headroom = ascale.get("headroom")
+        if headroom is None and last.get("headroom") is not None:
+            headroom = last.get("headroom")
+        acts = ascale.get("actions") or {}
+        scale = ascale.get("admission_scale", env.get("scale"))
+        util = env.get("util", ascale.get("admission_util"))
+        lines.append(
+            "autoscale: "
+            f"pool {ascale.get('pool', '-')}"
+            f"  status {ascale.get('status', '-')}"
+            f"  last {last.get('action', '-')}"
+            + (f" {last.get('backend')}" if last.get("backend") else "")
+            + f"  headroom {_fmt(headroom, 2)}"
+            f"  envelope {_fmt(util, 2)}"
+            f"->{_fmt(scale, 2)}"
+            f"  flips {acts.get('role_flip', 0)}"
+            f" (fail {acts.get('scale_up_failed', 0)}"
+            f"+{acts.get('role_flip_failed', 0)})"
+        )
     peer = (statz.get("cache") or {}).get("peer") or {}
     if peer:
         # Content-addressed peer fetch totals (the router's /cachez

@@ -1,0 +1,640 @@
+"""Elastic fleet control plane, without sockets (plus one in-process
+HTTP server for the /rolez / /envelopez actuators): the
+AutoscaleController's scale/flip/envelope decisions against fake
+admin+backend objects on a fake clock, the envelope arithmetic, the
+``fleet autoscale --check`` gate, and the router's autoscale_note
+state walk. The wire version — a real standby activation and a real
+drain -> /rolez -> resume flip across two backend processes — lives
+in tests/test_autoscale_fleet.py."""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import jax
+import pytest
+
+from shifu_tpu.fleet import (
+    AutoscaleController,
+    AutoscalePolicy,
+    BackendClient,
+    Envelope,
+    FleetRouter,
+    check_policy,
+    parse_envelope_spec,
+)
+from shifu_tpu.fleet.backend import BackendError
+from shifu_tpu.fleet.rollout import RolloutError
+from shifu_tpu.infer import PagedEngine, SampleConfig, make_server
+from shifu_tpu.models import Transformer, TransformerConfig
+from shifu_tpu.obs import FlightRecorder, MetricsRegistry
+
+
+# ------------------------------------------------------------- fakes
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def sleep(self, s):
+        self.t += s
+
+
+class FakeBackend:
+    """Stands in for BackendClient on the controller's direct-to-host
+    calls: the wait_ready probe and POST /rolez."""
+
+    def __init__(self, addr, ready=True, role="both"):
+        self.addr = addr
+        self.ready = ready
+        self.role = role
+        self.rolez_calls = []
+
+    def probe(self):
+        if not self.ready:
+            raise BackendError(f"{self.addr} down", retryable=True)
+        return {"healthy": True, "status": "ok", "role": self.role}
+
+    def models(self):
+        return {"data": []}
+
+    def rolez(self, role, timeout_s=None):
+        self.rolez_calls.append(role)
+        self.role = role
+        return {"role": role}
+
+
+class FakeAdmin:
+    """Stands in for RouterAdmin: a mutable fleet-row roster, scripted
+    /sloz headroom, recorded actuator calls and /autoscalez notes."""
+
+    def __init__(self, rows, headroom=None):
+        self.rows = [dict(r) for r in rows]
+        self.headroom = headroom  # None = no tier reports one
+        self.latency = {}
+        self.calls = []
+        self.notes = []
+        self.envelope_pushes = []
+        self.attach_error = None
+
+    def statz(self):
+        return {
+            "fleet": {"backends": [dict(r) for r in self.rows]},
+            "latency": dict(self.latency),
+        }
+
+    def sloz(self):
+        if self.headroom is None:
+            return {"tiers": {}}
+        return {"tiers": {"interactive": {"headroom": self.headroom}}}
+
+    def fleet_row(self, addr):
+        for r in self.rows:
+            if r["backend"] == addr:
+                return dict(r)
+        raise RolloutError(f"{addr} not in the fleet roster")
+
+    def attach(self, addr):
+        self.calls.append(("attach", addr))
+        if self.attach_error is not None:
+            raise self.attach_error
+        self.rows.append({
+            "backend": addr, "status": "up", "role": "both",
+            "in_flight": 0, "queue_depth": 0,
+        })
+        return {"attached": addr, "was_parked": False,
+                "warmed_chains": 2, "backends": len(self.rows)}
+
+    def park(self, addr):
+        self.calls.append(("park", addr))
+        self.rows[:] = [r for r in self.rows if r["backend"] != addr]
+
+    def drain(self, addr):
+        self.calls.append(("drain", addr))
+
+    def resume(self, addr):
+        self.calls.append(("resume", addr))
+
+    def autoscale_note(self, event, **fields):
+        self.notes.append((event, fields))
+
+    def set_envelope(self, scale, util=None):
+        self.envelope_pushes.append((scale, util))
+
+
+def _row(addr, role="both", in_flight=0, queue=0, **kw):
+    return {"backend": addr, "status": "up", "role": role,
+            "in_flight": in_flight, "queue_depth": queue, **kw}
+
+
+def _controller(admin, backends=None, **kw):
+    clock = FakeClock()
+    backends = backends if backends is not None else {}
+    kw.setdefault("clock", clock)
+    kw.setdefault("sleep", clock.sleep)
+    kw.setdefault("poll_s", 0.1)
+    kw.setdefault("policy", AutoscalePolicy(
+        low_headroom=0.2, high_headroom=0.6, dwell_s=10.0, tick_s=1.0,
+        flip_margin=2.0, min_backends=1,
+    ))
+    ctl = AutoscaleController(
+        admin,
+        make_backend=lambda a: backends.setdefault(a, FakeBackend(a)),
+        **kw,
+    )
+    return ctl, clock, backends
+
+
+# -------------------------------------------------- envelope arithmetic
+def test_envelope_utilization_is_worst_measured_ratio():
+    env = Envelope(hbm_frac=0.8, step_ms=100.0)
+    assert env.utilization(hbm_frac_used=0.8, step_ms_now=50.0) == 1.0
+    assert env.utilization(hbm_frac_used=0.4, step_ms_now=90.0) == \
+        pytest.approx(0.9)
+    # one dimension measured -> the other is simply absent, not zero
+    assert env.utilization(step_ms_now=120.0) == pytest.approx(1.2)
+    # scrape gap: nothing measured anywhere
+    assert env.utilization() is None
+
+
+def test_envelope_admission_ramp():
+    env = Envelope(step_ms=100.0, ramp=0.8)
+    assert env.admission_fraction(None) == 1.0   # gap: hold wide open
+    assert env.admission_fraction(0.5) == 1.0    # under the ramp
+    assert env.admission_fraction(0.8) == 1.0    # at the knee
+    assert env.admission_fraction(0.9) == pytest.approx(0.5)
+    assert env.admission_fraction(1.0) == 0.0
+    assert env.admission_fraction(1.3) == 0.0    # over budget: shut
+    assert Envelope.scaled_cap(8, 0.5) == 4
+    assert Envelope.scaled_cap(8, 0.0) == 0
+    assert Envelope.scaled_cap(8, 2.0) == 8      # clamped
+
+
+def test_parse_envelope_spec_and_validation():
+    env = parse_envelope_spec("hbm=0.85,step_ms=120")
+    assert env.hbm_frac == pytest.approx(0.85)
+    assert env.step_ms == pytest.approx(120.0)
+    assert parse_envelope_spec("step_ms=50,ramp=0.5").ramp == \
+        pytest.approx(0.5)
+    for bad in ("", "watts=5", "hbm=zero", "hbm", "ramp=0.8"):
+        with pytest.raises(ValueError):
+            parse_envelope_spec(bad)
+    with pytest.raises(ValueError):
+        Envelope(hbm_frac=1.5)
+    with pytest.raises(ValueError):
+        Envelope(step_ms=100.0, ramp=1.0)
+    with pytest.raises(ValueError):
+        Envelope()  # at least one dimension
+
+
+# -------------------------------------------------------- --check gate
+def test_check_policy_reports_hints():
+    ok, report = check_policy(
+        {"low_headroom": 0.1, "high_headroom": 0.5},
+        standby="127.0.0.1:7001,127.0.0.1:7002",
+        envelope="hbm=0.9",
+    )
+    assert ok and report["ok"]
+    assert all(c["ok"] for c in report["checks"])
+    ok, report = check_policy({"low_headroom": 0.8,
+                               "high_headroom": 0.5})
+    assert not ok
+    bad = [c for c in report["checks"] if not c["ok"]]
+    assert bad and "low" in bad[0]["hint"]
+    ok, report = check_policy(standby="notanaddr")
+    assert not ok
+    # no standby / no envelope is a NOTE, not a failure
+    ok, report = check_policy()
+    assert ok
+    notes = [c.get("note", "") for c in report["checks"]]
+    assert any("scaling off" in n for n in notes)
+    assert any("pacing off" in n for n in notes)
+
+
+def test_cli_autoscale_check_gate(capsys):
+    from shifu_tpu.cli import main
+
+    assert main([
+        "fleet", "autoscale", "--check",
+        "--standby", "127.0.0.1:7001", "--envelope", "hbm=0.85",
+    ]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["ok"] and all(c["ok"] for c in doc["checks"])
+
+    assert main([
+        "fleet", "autoscale", "--check",
+        "--low-headroom", "0.8", "--high-headroom", "0.5",
+    ]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert not doc["ok"]
+    assert any("hint" in c for c in doc["checks"] if not c["ok"])
+
+
+def test_policy_validation():
+    with pytest.raises(ValueError):
+        AutoscalePolicy(low_headroom=0.5, high_headroom=0.5)
+    with pytest.raises(ValueError):
+        AutoscalePolicy(dwell_s=1.0, tick_s=5.0)
+    with pytest.raises(ValueError):
+        AutoscalePolicy(flip_margin=1.0)
+    with pytest.raises(ValueError):
+        AutoscalePolicy(min_backends=0)
+
+
+# ----------------------------------------------------------- scale-up
+def test_scale_up_activates_standby_through_readiness_gate():
+    admin = FakeAdmin([_row("a:1")], headroom=0.05)
+    ctl, clock, backends = _controller(
+        admin, standby=["s:9"], ready_timeout_s=5.0,
+    )
+    out = ctl.tick()
+    assert out["action"] == "scale_up" and out["backend"] == "s:9"
+    assert ("attach", "s:9") in admin.calls
+    assert [r["backend"] for r in admin.rows] == ["a:1", "s:9"]
+    assert ctl.report["scale_ups"] == 1
+    ev = dict(admin.notes)["scale_up"]
+    assert ev["backend"] == "s:9" and ev["pool"] == 2
+    assert ev["warmed_chains"] == 2
+    # standby pool exhausted: the next breach holds, it cannot re-add
+    clock.t += 11.0
+    assert ctl.tick() == {"action": "hold", "why": "no standby left"}
+
+
+def test_scale_up_readiness_timeout_leaves_pool_unchanged():
+    admin = FakeAdmin([_row("a:1")], headroom=0.05)
+    backends = {"s:9": FakeBackend("s:9", ready=False)}
+    ctl, clock, _ = _controller(
+        admin, backends, standby=["s:9"], ready_timeout_s=3.0,
+    )
+    out = ctl.tick()
+    assert out["action"] == "scale_up_failed"
+    assert ("attach", "s:9") not in admin.calls
+    assert [r["backend"] for r in admin.rows] == ["a:1"]
+    assert ctl.report["failures"] == 1 and ctl.report["scale_ups"] == 0
+    assert admin.notes[-1][0] == "scale_up_failed"
+    # a FAILED action stamps no dwell: the very next tick retries
+    # (host recovered) without waiting out the dwell window
+    backends["s:9"].ready = True
+    out = ctl.tick()
+    assert out["action"] == "scale_up"
+    assert [r["backend"] for r in admin.rows] == ["a:1", "s:9"]
+
+
+def test_attach_refusal_is_a_failed_scale_up():
+    admin = FakeAdmin([_row("a:1")], headroom=0.0)
+    admin.attach_error = RolloutError("router said no")
+    ctl, _, _ = _controller(admin, standby=["s:9"])
+    out = ctl.tick()
+    assert out["action"] == "scale_up_failed"
+    assert [r["backend"] for r in admin.rows] == ["a:1"]
+    assert ctl.report["failures"] == 1
+
+
+# ------------------------------------------- hysteresis + dwell + park
+def test_hysteresis_band_boundaries_hold():
+    # AT the watermarks (not beyond them) nothing moves — the band is
+    # strict on both sides, so a fleet sitting on a boundary never
+    # flaps act/undo.
+    for h in (0.2, 0.4, 0.6):
+        admin = FakeAdmin([_row("a:1"), _row("s:9")], headroom=h)
+        ctl, _, _ = _controller(admin, standby=["s:9"])
+        ctl._activated.add("s:9")  # parkable if high-water tripped
+        ctl.tick()  # first mix sample
+        out = ctl.tick()
+        assert out == {"action": "hold"}, (h, out)
+        assert admin.calls == []
+
+
+def test_no_headroom_signal_means_no_scale_action():
+    admin = FakeAdmin([_row("a:1")], headroom=None)
+    ctl, _, _ = _controller(admin, standby=["s:9"])
+    ctl.tick()
+    out = ctl.tick()
+    assert out == {"action": "hold"}
+    assert admin.calls == []
+
+
+def test_min_dwell_blocks_consecutive_actions():
+    admin = FakeAdmin([_row("a:1")], headroom=0.05)
+    ctl, clock, _ = _controller(admin, standby=["s:9", "s:10"])
+    assert ctl.tick()["action"] == "scale_up"
+    # still breached, second standby available — but inside the dwell
+    clock.t += 5.0
+    assert ctl.tick() == {"action": "dwell"}
+    assert len([c for c in admin.calls if c[0] == "attach"]) == 1
+    clock.t += 5.1  # dwell (10s) expired
+    assert ctl.tick()["action"] == "scale_up"
+    assert [r["backend"] for r in admin.rows] == ["a:1", "s:9", "s:10"]
+
+
+def test_scale_down_parks_only_activated_standbys():
+    # Fat headroom over a pure base fleet: nothing to park, no action.
+    admin = FakeAdmin([_row("a:1"), _row("b:2")], headroom=0.9)
+    ctl, clock, _ = _controller(admin)
+    ctl.tick()
+    assert admin.calls == []
+    # Activate a standby, then recover: the ACTIVATED one is parked,
+    # the base fleet never is.
+    admin2 = FakeAdmin([_row("a:1")], headroom=0.05)
+    ctl2, clock2, _ = _controller(admin2, standby=["s:9"])
+    assert ctl2.tick()["action"] == "scale_up"
+    admin2.headroom = 0.9
+    clock2.t += 10.1
+    out = ctl2.tick()
+    assert out["action"] == "scale_down" and out["backend"] == "s:9"
+    assert ("park", "s:9") in admin2.calls
+    assert [r["backend"] for r in admin2.rows] == ["a:1"]
+    assert ctl2.report["scale_downs"] == 1
+    # min_backends floors the pool even with an activated host inside
+    admin3 = FakeAdmin([_row("s:9")], headroom=0.9)
+    ctl3, _, _ = _controller(admin3, standby=["s:9"])
+    ctl3._activated.add("s:9")
+    ctl3.tick()
+    assert ("park", "s:9") not in admin3.calls
+
+
+# ---------------------------------------------------------- role flips
+def _mix_admin(pre_load=0, dec_load=4, headroom=0.4):
+    return FakeAdmin([
+        _row("d:1", role="both", in_flight=dec_load),
+        _row("p:2", role="prefill", in_flight=pre_load),
+    ], headroom=headroom)
+
+
+def test_role_flip_walks_drain_rolez_resume():
+    admin = _mix_admin()
+    backends = {"p:2": FakeBackend("p:2", role="prefill")}
+    ctl, _, _ = _controller(admin, backends)
+    assert ctl.tick()["why"] == "first mix sample"
+    out = ctl.tick()
+    assert out["action"] == "role_flip"
+    assert out["backend"] == "p:2" and out["role"] == "decode"
+    assert out["was"] == "prefill"
+    assert admin.calls.index(("drain", "p:2")) < \
+        admin.calls.index(("resume", "p:2"))
+    assert backends["p:2"].rolez_calls == ["decode"]
+    assert ctl.report["role_flips"] == 1
+    ev = dict(admin.notes)["role_flip"]
+    assert ev["role"] == "decode" and ev["was"] == "prefill"
+
+
+def test_role_flip_needs_margin_and_idle_handoffs():
+    # Busy prefill side (load above margin ratio NOT met) -> hold.
+    admin = _mix_admin(pre_load=3, dec_load=4)
+    ctl, _, _ = _controller(admin)
+    ctl.tick()
+    assert ctl.tick() == {"action": "hold"}
+    # Handoff attempts flowing this tick -> the prefill host is
+    # earning its keep; no decode-ward flip even with idle load.
+    admin2 = _mix_admin()
+    ctl2, _, _ = _controller(admin2)
+    ctl2.tick()
+    admin2.rows[1]["disagg"] = {"ok": 7, "failed": 0,
+                                "breakeven_loss": 0}
+    assert ctl2.tick() == {"action": "hold"}
+
+
+def test_role_flip_drain_timeout_aborts_and_resumes_unflipped():
+    class StuckAdmin(FakeAdmin):
+        def fleet_row(self, addr):
+            return {"backend": addr, "in_flight": 1}  # never drains
+
+    admin = StuckAdmin([
+        _row("d:1", role="both", in_flight=4),
+        _row("p:2", role="prefill"),
+    ], headroom=0.4)
+    backends = {"p:2": FakeBackend("p:2", role="prefill")}
+    ctl, _, _ = _controller(admin, backends, drain_timeout_s=2.0)
+    ctl.tick()
+    out = ctl.tick()
+    assert out["action"] == "role_flip_failed"
+    assert out["flipped"] is False
+    # the host went back to work in its OLD role: resumed, /rolez
+    # never sent
+    assert ("resume", "p:2") in admin.calls
+    assert backends["p:2"].rolez_calls == []
+    assert ctl.report["failures"] == 1
+    assert ctl.report["role_flips"] == 0
+    ev = dict(admin.notes)["role_flip_failed"]
+    assert ev["flipped"] is False and "in-flight" in ev["error"]
+
+
+def test_prefill_ward_flip_keeps_min_decode_backends():
+    # Handoffs flowing + prefill drowning, but only ONE decode host:
+    # flipping it would leave no decode capacity — hold.
+    admin = FakeAdmin([
+        _row("d:1", role="both"),
+        _row("p:2", role="prefill", in_flight=5),
+    ], headroom=0.4)
+    ctl, _, _ = _controller(admin)
+    ctl.tick()
+    admin.rows[1]["disagg"] = {"ok": 3, "failed": 0,
+                               "breakeven_loss": 0}
+    assert ctl.tick() == {"action": "hold"}
+
+
+# ------------------------------------------------------ envelope loop
+def test_envelope_pushes_on_material_moves_and_holds_on_gap():
+    admin = FakeAdmin([_row("a:1", hbm_frac_used=0.9)], headroom=0.4)
+    env = Envelope(hbm_frac=1.0, ramp=0.8)  # util == hbm_frac_used
+    ctl, _, _ = _controller(admin, envelope=env)
+    ctl.tick()
+    assert admin.envelope_pushes == [(pytest.approx(0.5),
+                                      pytest.approx(0.9))]
+    # same utilization -> no re-push (|delta| < 0.05)
+    ctl.tick()
+    assert len(admin.envelope_pushes) == 1
+    # sub-threshold wiggle holds too
+    admin.rows[0]["hbm_frac_used"] = 0.905
+    ctl.tick()
+    assert len(admin.envelope_pushes) == 1
+    # material recovery -> push the reopened scale
+    admin.rows[0]["hbm_frac_used"] = 0.8
+    ctl.tick()
+    assert admin.envelope_pushes[-1][0] == pytest.approx(1.0)
+    # scrape gap: the last pushed scale HOLDS (no new push, no reset)
+    admin.rows[0].pop("hbm_frac_used")
+    ctl.tick()
+    assert len(admin.envelope_pushes) == 2
+
+
+def test_envelope_silent_while_unthrottled_and_counts_failures():
+    admin = FakeAdmin([_row("a:1", hbm_frac_used=0.3)], headroom=0.4)
+    ctl, _, _ = _controller(admin,
+                            envelope=Envelope(hbm_frac=1.0, ramp=0.8))
+    ctl.tick()
+    assert admin.envelope_pushes == []  # scale 1.0, never pushed: quiet
+
+    class DeafAdmin(FakeAdmin):
+        def set_envelope(self, scale, util=None):
+            raise RolloutError("router away")
+
+    admin2 = DeafAdmin([_row("a:1", hbm_frac_used=0.95)], headroom=0.4)
+    ctl2, _, _ = _controller(admin2,
+                             envelope=Envelope(hbm_frac=1.0, ramp=0.8))
+    ctl2.tick()
+    assert ctl2.report["failures"] == 1
+    assert any(a["action"] == "envelope_failed"
+               for a in ctl2.report["actions"])
+
+
+def test_unreachable_router_skips_the_tick():
+    class DeadAdmin(FakeAdmin):
+        def statz(self):
+            raise RolloutError("connection refused")
+
+    admin = DeadAdmin([], headroom=0.0)
+    ctl, _, _ = _controller(admin, standby=["s:9"])
+    out = ctl.tick()
+    assert out["action"] == "skip"
+    assert ctl.report["skipped_ticks"] == 1
+    assert admin.calls == []
+
+
+# ------------------------------------------- router autoscale_note walk
+def test_router_autoscale_note_state_and_metrics():
+    reg = MetricsRegistry()
+    fl = FlightRecorder()
+    r = FleetRouter(
+        [BackendClient("127.0.0.1:1")], metrics=reg, flight=fl
+    )
+    with pytest.raises(ValueError):
+        r.autoscale_note("scale_up", backend="x")  # before begin
+    with pytest.raises(ValueError):
+        r.autoscale_note("not_an_event")
+    assert r.autoscale_stats() is None
+    r.autoscale_note("begin", standby=["s:9"], pool=2)
+    r.autoscale_note("scale_up", backend="s:9", pool=3, headroom=0.1)
+    st = r.autoscale_stats()
+    assert st["status"] == "running" and st["pool"] == 3
+    assert st["headroom"] == 0.1
+    assert st["last_action"]["action"] == "scale_up"
+    assert st["actions"]["scale_up"] == 1
+    assert reg.value("shifu_autoscale_active") == 1.0
+    assert reg.value("shifu_autoscale_pool_size") == 3.0
+    assert reg.value("shifu_autoscale_actions_total",
+                     {"action": "scale_up"}) == 1.0
+    r.autoscale_note("envelope", scale=0.5, util=0.9)
+    assert reg.value("shifu_envelope_utilization") == 0.9
+    assert reg.value("shifu_envelope_admission_scale") == 0.5
+    assert r.autoscale_stats()["envelope"] == {"util": 0.9,
+                                               "scale": 0.5}
+    r.autoscale_note("role_flip", backend="p:2", role="decode",
+                     was="prefill", pool=3)
+    assert reg.value("shifu_role_flips_total") == 1.0
+    r.autoscale_note("scale_up_failed", backend="s:10", error="dead")
+    assert r.autoscale_stats()["last_error"] == "dead"
+    r.autoscale_note("end", pool=3)
+    st = r.autoscale_stats()
+    assert st["status"] == "stopped"
+    assert reg.value("shifu_autoscale_active") == 0.0
+    kinds = [e["kind"] for e in fl.snapshot()]
+    assert "autoscale_begin" in kinds and "autoscale_end" in kinds
+    assert "autoscale_role_flip" in kinds
+
+
+# ----------------------------------- in-process server: the actuators
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = TransformerConfig.tiny()
+    model = Transformer(cfg)
+    return model, model.init(jax.random.key(0))
+
+
+@pytest.fixture()
+def served(tiny):
+    model, params = tiny
+    engine = PagedEngine(
+        model, params, max_slots=2, max_len=32, page_size=8,
+        sample_cfg=SampleConfig(temperature=0.0),
+        prefill_buckets=(16, 32),
+    )
+    server = make_server(engine, port=0, role="both")
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    try:
+        yield f"http://127.0.0.1:{server.server_port}", server
+    finally:
+        server.shutdown()
+        server.runner.shutdown()
+        t.join(5)
+
+
+def _post(base, path, obj, timeout=120):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(obj).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _get(base, path, timeout=30):
+    with urllib.request.urlopen(base + path, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def test_rolez_flips_idle_engine_and_advertises(served):
+    base, server = served
+    assert _get(base, "/healthz")["role"] == "both"
+    status, out = _post(base, "/rolez", {"role": "lasagna"})
+    assert status == 400 and "rolez needs" in out["error"]
+    status, out = _post(base, "/rolez", {"role": "decode"})
+    assert status == 200
+    assert out == {"role": "decode", "was": "both"}
+    # the flip is advertised exactly as if the server booted with it
+    assert _get(base, "/healthz")["role"] == "decode"
+
+
+def test_rolez_refuses_busy_engine(served, monkeypatch):
+    base, server = served
+    monkeypatch.setattr(
+        server.runner.engine, "counters",
+        lambda: {"active_slots": 1, "queued": 0},
+    )
+    status, out = _post(base, "/rolez", {"role": "prefill"})
+    assert status == 503
+    assert "drain this host" in out["error"]
+    monkeypatch.undo()
+    assert _get(base, "/healthz")["role"] == "both"  # unchanged
+
+
+def test_envelopez_validates_and_throttles_batch_admission(served):
+    base, server = served
+    for bad in ("x", 1.5, -0.1, True, None):
+        status, _ = _post(base, "/envelopez", {"scale": bad})
+        assert status == 400, bad
+    status, out = _post(base, "/envelopez", {"scale": 0.5, "util": 0.9})
+    assert status == 200 and out["was"] == 1.0
+    # visible on /statz even with no controller attached to the engine
+    block = _get(base, "/statz")["autoscale"]
+    assert block["admission_scale"] == 0.5
+    assert block["admission_util"] == 0.9
+    # scale 0: every batch admission is envelope-shed, interactive
+    # traffic untouched
+    status, _ = _post(base, "/envelopez", {"scale": 0.0})
+    assert status == 200
+    status, out = _post(base, "/v1/completions", {
+        "tokens": [1, 2, 3], "max_new_tokens": 2, "tier": "batch",
+    })
+    assert status == 429
+    assert "envelope scale 0" in out["error"]
+    assert server.runner.metrics.value(
+        "shifu_envelope_rejections_total"
+    ) == 1.0
+    status, out = _post(base, "/v1/completions", {
+        "tokens": [1, 2, 3], "max_new_tokens": 2,
+    })
+    assert status == 200 and out["finished_by"] == "length"
+    # reopen: batch admission is back
+    _post(base, "/envelopez", {"scale": 1.0})
+    status, out = _post(base, "/v1/completions", {
+        "tokens": [1, 2, 3], "max_new_tokens": 2, "tier": "batch",
+    })
+    assert status == 200

@@ -1,0 +1,249 @@
+"""Elastic fleet control plane acceptance walk (ISSUE-20).
+
+Three real engine-server processes (tests/_fleet_backend.py): roster
+hosts A (role=both, host KV tier, pre-warmed with the shared two-page
+prompt) and P (role=prefill), plus standby B (role=both, host KV tier,
+spawned but OUTSIDE the roster). A FleetRouter + HTTP front-end runs
+in this process with a deliberately tight interactive SLO; an
+AutoscaleController daemon polls /sloz + /statz and must, under a live
+request hammer:
+
+  1. scale UP: the burn drives headroom under the low watermark, the
+     controller readiness-gates standby B and attaches it via
+     POST /fleetz — where the router peer-warms B from A's advertised
+     chains (a stone-cold join takes its first requests warm);
+  2. rebalance: once the SLO is swapped for a lenient one (headroom
+     recovers), the demand mix — decode hosts queueing, the prefill
+     host idle, zero disagg handoff attempts (every hammer prompt is
+     under disagg_min_prompt) — drives the drain -> /rolez ->
+     readiness-gate -> resume walk that flips P to decode.
+
+Throughout: every hammered request answers 200-or-503 (nothing
+hangs), the decisions are visible in the router's autoscale metric
+families + /statz block, and /sloz is non-breached at the end.
+"""
+
+import signal
+import threading
+import time
+
+import pytest
+
+from shifu_tpu.fleet import (
+    AutoscaleController,
+    AutoscalePolicy,
+    FleetProber,
+    RouterAdmin,
+)
+from shifu_tpu.infer import make_server
+from shifu_tpu.obs.slo import SLOEngine, TierBudget
+from tests.test_fleet import _get, _make_router, _post, _spawn_backend
+
+pytestmark = pytest.mark.chaos
+
+# Shared "system prompt" (two full 16-token pages) plus a short tail —
+# served to A up front so it advertises the chain the standby's
+# peer-warm will fetch (same shape as test_kv_fleet).
+_SHARED = list(range(1, 33))
+_WARM_BODY = {"tokens": _SHARED + [7, 11, 13, 17, 19, 23, 29],
+              "max_new_tokens": 4}
+
+
+def _hammer_body(i):
+    # 9 tokens — far under the router's disagg_min_prompt (64), so no
+    # two-host handoff is ever attempted and the controller's
+    # disagg-attempt delta stays at zero (the decode-ward flip's
+    # "handoffs have genuinely stopped" condition).
+    return {"tokens": [1, 2, 3, 4, 5, 6, 7, 8, (i % 20) + 9],
+            "max_new_tokens": 8}
+
+
+def _slo(p99_ttft_ms, router):
+    return SLOEngine(
+        [TierBudget(tier="interactive", p99_ttft_ms=p99_ttft_ms)],
+        fast_window_s=2.0, slow_window_s=6.0, sample_interval_s=0.2,
+        metrics=router.metrics, flight=router.flight,
+    )
+
+
+def _await(deadline_s, cond, what):
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.2)
+    pytest.fail(f"timed out after {deadline_s:g}s waiting for {what}")
+
+
+def test_autoscale_walk_scale_up_peer_warm_and_role_flip(tmp_path):
+    kv = {"FLEET_BACKEND_KV_HOST_BYTES": str(1 << 20)}
+    # The warm source needs the disk tier under its host tier (mirror-
+    # on): that is what spills served pages into /cachez-advertised
+    # chains. The standby only needs a host tier to be warmable.
+    disk_dir = tmp_path / "kv_a"
+    disk_dir.mkdir()
+    kv_warm = dict(kv, FLEET_BACKEND_KV_DISK_BYTES=str(64 << 20),
+                   FLEET_BACKEND_KV_DISK_DIR=str(disk_dir))
+    procs = []
+    prober = server = ctl = None
+    stop_evt = threading.Event()
+    threads = []
+    try:
+        pa, addr_a = _spawn_backend(max_slots=2, step_delay=0.05,
+                                    extra_env=kv_warm)
+        procs.append(pa)
+        pp, addr_p = _spawn_backend(
+            max_slots=2, step_delay=0.05,
+            extra_env={"FLEET_BACKEND_ROLE": "prefill"},
+        )
+        procs.append(pp)
+        pb, addr_b = _spawn_backend(max_slots=2, step_delay=0.05,
+                                    extra_env=kv)
+        procs.append(pb)
+
+        # Roster = A + P; B is the controller's standby.
+        router = _make_router([addr_a, addr_p])
+        prober = FleetProber(router, interval_s=0.1)
+        prober.start()
+        router.set_slo(_slo(25.0, router))  # tight: the hammer burns it
+        server = make_server(router, port=0)
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        base = f"http://127.0.0.1:{server.server_port}"
+
+        # Warm A so it advertises the shared chain (the prober's
+        # /cachez scrape folds it into the fleet digest map the attach
+        # path peer-warms from).
+        status, _ = _post(f"http://{addr_a}", "/v1/completions",
+                          _WARM_BODY)
+        assert status == 200
+        _await(
+            30.0,
+            lambda: (_get(f"http://{addr_a}", "/cachez")
+                     .get("digests") or {}).get("count", 0) >= 2,
+            "warm backend to advertise its digests",
+        )
+
+        # Live hammer: short interactive requests, every outcome
+        # recorded — the acceptance bar is 200-or-503, nothing hung.
+        statuses, errors = [], []
+
+        def worker(wid):
+            import urllib.error
+            i = 0
+            while not stop_evt.is_set():
+                i += 1
+                try:
+                    st, _ = _post(base, "/v1/completions",
+                                  _hammer_body(wid * 1000 + i),
+                                  timeout=60)
+                    statuses.append(st)
+                except urllib.error.HTTPError as e:
+                    statuses.append(e.code)
+                except Exception as e:  # hang/transport bug -> fail loud
+                    errors.append(repr(e))
+                    return
+
+        for wid in range(6):
+            t = threading.Thread(target=worker, args=(wid,), daemon=True)
+            t.start()
+            threads.append(t)
+
+        ctl = AutoscaleController(
+            RouterAdmin(base),
+            standby=[addr_b],
+            # high_headroom=1.0 disables scale-down (headroom is never
+            # > 1.0): B must STAY attached so the flip phase sees the
+            # grown pool.
+            policy=AutoscalePolicy(
+                low_headroom=0.15, high_headroom=1.0, dwell_s=1.5,
+                tick_s=0.3, flip_margin=1.5, min_backends=1,
+            ),
+            ready_timeout_s=30.0, drain_timeout_s=60.0,
+        )
+        ctl_thread = threading.Thread(target=ctl.run, daemon=True)
+        ctl_thread.start()
+
+        # Phase 1 — the tight SLO burns, the controller activates B.
+        _await(60.0, lambda: ctl.report["scale_ups"] >= 1,
+               "the controller to scale up the standby")
+
+        # Phase 2 — swap in a lenient SLO: headroom recovers (None
+        # until its first samples land, then ~1.0 — both skip the
+        # scale branches), so the tick reaches the role-mix check:
+        # decode hosts queueing, P idle, zero handoff attempts.
+        router.set_slo(_slo(100000.0, router))
+        _await(60.0, lambda: ctl.report["role_flips"] >= 1,
+               "the mix-driven role flip")
+
+        stop_evt.set()
+        for t in threads:
+            t.join(timeout=90)
+        assert not any(t.is_alive() for t in threads), \
+            "hammer thread hung past the stop flag"
+        ctl.stop()
+        ctl_thread.join(timeout=30)
+        assert not ctl_thread.is_alive()
+
+        # --- nothing hung, nothing leaked a 5xx other than 503
+        assert not errors, errors
+        assert statuses and set(statuses) <= {200, 503}, \
+            sorted(set(statuses))
+        assert statuses.count(200) > 0
+
+        # --- the scale-up was the standby, readiness-gated + warmed
+        report = ctl.report
+        ups = [a for a in report["actions"]
+               if a.get("action") == "scale_up"]
+        assert ups and ups[0]["backend"] == addr_b
+        warmed = ups[0].get("warmed_chains") or 0
+        assert (warmed >= 1
+                or addr_b in router.peer_stats()["warmed_backends"]), \
+            (ups[0], router.peer_stats())
+
+        # --- the flip ran the drain -> /rolez -> resume walk on P
+        flips = [a for a in report["actions"]
+                 if a.get("action") == "role_flip"]
+        assert flips and flips[0]["backend"] == addr_p
+        assert flips[0]["was"] == "prefill"
+        assert flips[0]["role"] == "decode"
+        doc = _get(f"http://{addr_p}", "/healthz")
+        assert doc.get("role") == "decode"
+
+        # --- decisions visible on the router: metric families, the
+        # /statz autoscale block, and the grown pool
+        m = router.metrics
+        assert m.value("shifu_autoscale_actions_total",
+                       {"action": "scale_up"}) >= 1.0
+        assert m.value("shifu_role_flips_total") >= 1.0
+        assert m.value("shifu_autoscale_pool_size") == 3.0
+        statz = _get(base, "/statz")
+        auto = statz.get("autoscale")
+        assert auto and auto["pool"] == 3
+        rows = {r["backend"]: r for r in statz["fleet"]["backends"]}
+        assert set(rows) == {addr_a, addr_p, addr_b}
+        _await(15.0,
+               lambda: (_get(base, "/statz")["fleet"]["backends"]
+                        and all(
+                            r["role"] in ("both", "decode")
+                            for r in _get(base, "/statz")
+                            ["fleet"]["backends"])),
+               "the prober to pick up P's new role")
+
+        # --- the fleet ends healthy: the (lenient) SLO is not breached
+        sloz = _get(base, "/sloz")
+        for tier, doc in (sloz.get("tiers") or {}).items():
+            assert doc.get("status") != "breached", (tier, doc)
+    finally:
+        stop_evt.set()
+        if ctl is not None:
+            ctl.stop()
+        if prober is not None:
+            prober.stop()
+        if server is not None:
+            server.shutdown()
+            server.runner.shutdown()
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGKILL)
+        for p in procs:
+            p.wait(timeout=10)
